@@ -1,0 +1,19 @@
+"""Erasure-code subsystem: interface, registry, GF math, codecs.
+
+Mirrors the capabilities of the reference's src/erasure-code/ (see SURVEY.md
+§2.1) with a TPU-first design: all codecs express parity as GF(2) bit-matrix
+linear maps so a single MXU matmul kernel serves encode, decode, and recovery.
+"""
+
+from ceph_tpu.ec.gf import GF, gf8
+from ceph_tpu.ec.interface import ErasureCodeInterface, ErasureCodeProfile
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry, registry
+
+__all__ = [
+    "GF",
+    "gf8",
+    "ErasureCodeInterface",
+    "ErasureCodeProfile",
+    "ErasureCodePluginRegistry",
+    "registry",
+]

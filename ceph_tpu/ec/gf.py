@@ -1,0 +1,221 @@
+"""Galois-field GF(2^w) arithmetic — the CPU correctness oracle.
+
+Reproduces the field the reference's codecs compute in: gf-complete's default
+primitive polynomials (galois_init_default_field, reference
+src/erasure-code/jerasure/jerasure_init.cc:27-37 pre-loads w in {4,8,16,32}).
+The w=8 polynomial is x^8+x^4+x^3+x^2+1 = 0x11D, the classic Reed-Solomon
+field jerasure/gf-complete use by default.
+
+Everything here is numpy on uint8/uint32 regions; this module is the oracle the
+TPU bit-plane kernel (ceph_tpu/ops/gf_matmul.py) is asserted byte-identical
+against, and it also serves the paths that stay on-CPU by design
+(minimum_to_decode chunk selection and decode-matrix inversion — see
+BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# gf-complete default primitive polynomials per word size (w -> poly including
+# the x^w term).  Classic jerasure galois.c table: w=4 -> 023 octal (0x13),
+# w=8 -> 0435 octal (0x11D), w=16 -> 0210013 octal (0x1100B).
+PRIM_POLY = {
+    4: 0x13,
+    8: 0x11D,
+    16: 0x1100B,
+}
+
+
+class GF:
+    """GF(2^w) with log/antilog tables; w in {4, 8, 16}."""
+
+    def __init__(self, w: int = 8):
+        if w not in PRIM_POLY:
+            raise ValueError(f"unsupported word size w={w}")
+        self.w = w
+        self.size = 1 << w
+        self.max = self.size - 1
+        self.poly = PRIM_POLY[w]
+
+        # Generator alpha = 2 (x) is primitive for all three polynomials.
+        log = np.zeros(self.size, dtype=np.int32)
+        antilog = np.zeros(2 * self.size, dtype=np.int32)
+        x = 1
+        for i in range(self.max):
+            log[x] = i
+            antilog[i] = x
+            x <<= 1
+            if x & self.size:
+                x ^= self.poly
+        # antilog repeated so mul can index log[a]+log[b] without a mod.
+        antilog[self.max : 2 * self.max] = antilog[: self.max]
+        log[0] = -1  # sentinel; never indexed on the fast paths
+        self.log = log
+        self.antilog = antilog
+
+    # -- scalar ops ---------------------------------------------------------
+
+    def mul(self, a: int, b: int) -> int:
+        if a == 0 or b == 0:
+            return 0
+        return int(self.antilog[self.log[a] + self.log[b]])
+
+    def div(self, a: int, b: int) -> int:
+        if b == 0:
+            raise ZeroDivisionError("GF division by zero")
+        if a == 0:
+            return 0
+        return int(self.antilog[self.log[a] - self.log[b] + self.max])
+
+    def inv(self, a: int) -> int:
+        return self.div(1, a)
+
+    def pow(self, a: int, n: int) -> int:
+        if n == 0:
+            return 1
+        if a == 0:
+            return 0
+        return int(self.antilog[(self.log[a] * n) % self.max])
+
+    # -- region (vectorized) ops -------------------------------------------
+
+    @functools.lru_cache(maxsize=None)
+    def _sym_row(self, c: int) -> np.ndarray:
+        """Symbol lookup: _sym_row(c)[v] == c * v over field symbols."""
+        v = np.arange(self.size, dtype=np.int64)
+        out = np.zeros(self.size, dtype=np.int64)
+        if c != 0:
+            nz = v != 0
+            out[nz] = self.antilog[self.log[c] + self.log[v[nz]]]
+        return out
+
+    @functools.lru_cache(maxsize=None)
+    def _mul_row(self, c: int) -> np.ndarray:
+        """Region lookup table in the region dtype: for w=8 a 256-entry byte
+        table; for w=4 a 256-entry byte table acting on both packed nibbles
+        (jerasure's w=4 region semantics); for w=16 a 65536-entry uint16
+        table (regions are viewed as native-endian uint16, matching
+        galois_w16 region multiply on 16-bit words)."""
+        sym = self._sym_row(c)
+        if self.w == 8:
+            return sym.astype(np.uint8)
+        if self.w == 4:
+            b = np.arange(256, dtype=np.int64)
+            return (sym[b & 0xF] | (sym[b >> 4] << 4)).astype(np.uint8)
+        return sym.astype(np.uint16)
+
+    def _region_view(self, region: np.ndarray) -> np.ndarray:
+        """View a uint8 region in the symbol-indexable dtype."""
+        if self.w == 16:
+            return region.view(np.uint16)
+        return region
+
+    def mul_region(self, c: int, region: np.ndarray) -> np.ndarray:
+        """c * region, elementwise over field symbols packed in uint8 bytes
+        (two nibbles per byte for w=4, little-endian byte pairs for w=16)."""
+        if c == 0:
+            return np.zeros_like(region)
+        if c == 1:
+            return region.copy()
+        view = self._region_view(np.ascontiguousarray(region))
+        return self._mul_row(c)[view].view(region.dtype).reshape(region.shape)
+
+    def matmul(self, matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+        """GF matrix [m,k] times symbol regions [k,B] -> [m,B].
+
+        This is the semantic the reference computes one stripe at a time in
+        jerasure_matrix_encode (via galois_w08_region_multiply + XOR); here it
+        is a table-gather + XOR reduce over k, fully vectorized.
+        """
+        matrix = np.asarray(matrix)
+        m, k = matrix.shape
+        if data.shape[0] != k:
+            raise ValueError(f"matmul shape mismatch: matrix k={k}, data k={data.shape[0]}")
+        regions = np.ascontiguousarray(data)
+        view = self._region_view(regions.reshape(k, -1))
+        out = np.zeros((m, view.shape[1]), dtype=view.dtype)
+        for i in range(m):
+            acc = out[i]
+            for j in range(k):
+                c = int(matrix[i, j])
+                if c == 0:
+                    continue
+                if c == 1:
+                    acc ^= view[j]
+                else:
+                    acc ^= self._mul_row(c)[view[j]]
+        return out.view(data.dtype).reshape((m, *data.shape[1:]))
+
+    # -- matrices -----------------------------------------------------------
+
+    def invert_matrix(self, matrix: np.ndarray) -> np.ndarray:
+        """Invert a square GF matrix by Gauss-Jordan; raises if singular."""
+        matrix = np.asarray(matrix, dtype=np.int64)
+        n = matrix.shape[0]
+        if matrix.shape != (n, n):
+            raise ValueError("invert_matrix needs a square matrix")
+        a = matrix.copy()
+        inv = np.eye(n, dtype=np.int64)
+        for col in range(n):
+            pivot = -1
+            for row in range(col, n):
+                if a[row, col]:
+                    pivot = row
+                    break
+            if pivot < 0:
+                raise np.linalg.LinAlgError("singular GF matrix")
+            if pivot != col:
+                a[[col, pivot]] = a[[pivot, col]]
+                inv[[col, pivot]] = inv[[pivot, col]]
+            p = int(a[col, col])
+            if p != 1:
+                pinv = self.inv(p)
+                a[col] = self._mul_vec(pinv, a[col])
+                inv[col] = self._mul_vec(pinv, inv[col])
+            for row in range(n):
+                if row != col and a[row, col]:
+                    c = int(a[row, col])
+                    a[row] ^= self._mul_vec(c, a[col])
+                    inv[row] ^= self._mul_vec(c, inv[col])
+        return inv
+
+    def _mul_vec(self, c: int, vec: np.ndarray) -> np.ndarray:
+        out = np.zeros_like(vec)
+        nz = vec != 0
+        if c != 0:
+            out[nz] = self.antilog[self.log[c] + self.log[vec[nz]]]
+        return out
+
+    def mul_by_two_matrix(self, e: int) -> np.ndarray:
+        """The w x w GF(2) matrix of 'multiply by e': column x holds the bits
+        of e * 2^x (bit l -> row l).  Matches the reference's
+        jerasure_matrix_to_bitmatrix element blocks."""
+        w = self.w
+        bm = np.zeros((w, w), dtype=np.uint8)
+        elt = e
+        for x in range(w):
+            for l in range(w):
+                bm[l, x] = (elt >> l) & 1
+            elt = self.mul(elt, 2)
+        return bm
+
+    def n_ones(self, e: int) -> int:
+        """Number of ones in the bit-matrix of multiply-by-e (the reference's
+        cauchy_n_ones cost function used by cauchy_good)."""
+        return int(self.mul_by_two_matrix(e).sum())
+
+
+@functools.lru_cache(maxsize=None)
+def _gf_cached(w: int) -> GF:
+    return GF(w)
+
+
+def gf(w: int = 8) -> GF:
+    """Shared per-w GF instance (tables are immutable)."""
+    return _gf_cached(w)
+
+
+gf8 = gf(8)

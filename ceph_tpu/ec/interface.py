@@ -1,0 +1,162 @@
+"""The erasure-code codec contract.
+
+Equivalent surface to the reference's ErasureCodeInterface (reference
+src/erasure-code/ErasureCodeInterface.h:170): systematic codes, an object is
+padded and split into k data + m coding chunks; full-object encode/decode on
+top of raw chunk-level encode_chunks/decode_chunks; chunk-selection via
+minimum_to_decode[_with_cost]; optional per-chunk remapping
+(get_chunk_mapping) and sub-chunk semantics for array codes
+(get_sub_chunk_count, reference ErasureCodeInterface.h:326 — required by
+CLAY).
+
+Differences from the reference, by design (TPU-first):
+  * chunks are numpy uint8 arrays, not refcounted bufferlists — the TPU
+    service consumes contiguous host buffers and the reference's
+    SIMD-alignment machinery (buffer.h:1073 rebuild_aligned) is replaced by
+    numpy's aligned allocations;
+  * errors are exceptions, not 0/-errno (the registry maps them back to
+    errno-style codes at the plugin boundary for API parity);
+  * every codec additionally exposes its linear map as a GF(2) bit-matrix
+    (``bit_generator``) so the single TPU matmul kernel can drive any codec.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+# Profile: string->string map, same as the reference's ErasureCodeProfile
+# (ErasureCodeInterface.h:155).
+ErasureCodeProfile = Dict[str, str]
+
+# minimum_to_decode result: chunk index -> list of (sub-chunk offset, count)
+# pairs, same shape as the reference's sub-chunk aware signature
+# (ErasureCodeInterface.h:365; full-chunk reads are [(0, sub_chunk_count)]).
+SubChunkPlan = Dict[int, List[Tuple[int, int]]]
+
+
+class ErasureCodeError(Exception):
+    """Codec-level failure; carries an errno-style code for registry parity."""
+
+    def __init__(self, errno_code: int, message: str):
+        super().__init__(message)
+        self.errno_code = errno_code
+
+
+class ErasureCodeInterface(abc.ABC):
+    """Abstract contract every codec implements."""
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @abc.abstractmethod
+    def init(self, profile: ErasureCodeProfile) -> None:
+        """Parse and validate the profile; prepare generator matrices.
+
+        Must store the completed profile (with defaults filled in) so
+        get_profile() returns it — the registry re-validates this round-trip
+        exactly like the reference does (ErasureCodePlugin.cc:108-112)."""
+
+    @abc.abstractmethod
+    def get_profile(self) -> ErasureCodeProfile:
+        ...
+
+    # -- geometry -----------------------------------------------------------
+
+    @abc.abstractmethod
+    def get_chunk_count(self) -> int:
+        """k + m."""
+
+    @abc.abstractmethod
+    def get_data_chunk_count(self) -> int:
+        """k."""
+
+    def get_coding_chunk_count(self) -> int:
+        return self.get_chunk_count() - self.get_data_chunk_count()
+
+    def get_sub_chunk_count(self) -> int:
+        """Array codes (CLAY) divide each chunk into sub-chunks; plain codes
+        report 1 (reference ErasureCodeInterface.h:326)."""
+        return 1
+
+    @abc.abstractmethod
+    def get_chunk_size(self, stripe_width: int) -> int:
+        """Chunk size for an object of stripe_width bytes, including each
+        codec's alignment/padding rules (these differ per plugin in the
+        reference — jerasure rounds the object up to its alignment then
+        divides by k, isa rounds the chunk up; byte-exactness depends on
+        reproducing them)."""
+
+    def get_chunk_mapping(self) -> List[int]:
+        """Optional remap of logical chunk position -> physical chunk index;
+        empty means identity (reference ErasureCodeInterface.h:411)."""
+        return []
+
+    # -- chunk selection ----------------------------------------------------
+
+    @abc.abstractmethod
+    def minimum_to_decode(
+        self, want_to_read: Set[int], available: Set[int]
+    ) -> SubChunkPlan:
+        """Smallest set of available chunks (with sub-chunk extents) needed
+        to reconstruct want_to_read.  Raises ErasureCodeError(EIO) if
+        impossible."""
+
+    def minimum_to_decode_with_cost(
+        self, want_to_read: Set[int], available: Mapping[int, int]
+    ) -> Set[int]:
+        """Cost-aware variant; default ignores costs (reference
+        ErasureCode.cc:121).  SHEC specializes this."""
+        return set(self.minimum_to_decode(want_to_read, set(available)).keys())
+
+    # -- full-object paths --------------------------------------------------
+
+    @abc.abstractmethod
+    def encode(self, want_to_encode: Set[int], data: bytes) -> Dict[int, np.ndarray]:
+        """Pad `data` per the codec's rules, split into k data chunks,
+        compute m coding chunks, return the requested subset."""
+
+    @abc.abstractmethod
+    def decode(
+        self, want_to_read: Set[int], chunks: Mapping[int, np.ndarray], chunk_size: int
+    ) -> Dict[int, np.ndarray]:
+        """Reconstruct the requested chunks from the available ones."""
+
+    def decode_concat(self, chunks: Mapping[int, np.ndarray]) -> bytes:
+        """Reconstruct and concatenate the data chunks in order (reference
+        ErasureCode.cc:331)."""
+        k = self.get_data_chunk_count()
+        chunk_size = len(next(iter(chunks.values())))
+        decoded = self.decode(set(range(k)), chunks, chunk_size)
+        return b"".join(bytes(decoded[i]) for i in range(k))
+
+    # -- raw chunk paths ----------------------------------------------------
+
+    @abc.abstractmethod
+    def encode_chunks(self, data: np.ndarray) -> np.ndarray:
+        """[k, chunk_size] uint8 -> [m, chunk_size] uint8 parity."""
+
+    @abc.abstractmethod
+    def decode_chunks(
+        self, want_to_read: Set[int], chunks: Mapping[int, np.ndarray]
+    ) -> Dict[int, np.ndarray]:
+        """Reconstruct chunks from equal-sized available chunks."""
+
+    # -- TPU hook -----------------------------------------------------------
+
+    def bit_generator(self) -> Optional[np.ndarray]:
+        """The codec's encode map as a GF(2) bit-matrix [m*w, k*w] over the
+        codec's bit-row layout, or None if the codec is not bit-linear
+        (none of the supported codecs are non-linear; composite codecs may
+        return None and delegate per-layer).  This is the seam the TPU
+        service uses to run any codec through one matmul kernel."""
+        return None
+
+    # -- placement hook -----------------------------------------------------
+
+    def create_rule(self, name: str, crush) -> int:
+        """Create a placement rule for this codec in the given crush map
+        (reference ErasureCodeInterface.h:259; base uses a simple indep
+        rule).  Returns the rule id."""
+        raise NotImplementedError

@@ -1,0 +1,3 @@
+"""In-tree erasure-code plugins, one module per plugin name (the equivalent
+of the reference's libec_<name>.so set): jerasure, isa, lrc, shec, clay, tpu,
+plus the xor example codec used by tests."""

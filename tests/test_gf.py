@@ -1,0 +1,156 @@
+"""GF(2^w) field and matrix-construction tests.
+
+Field axioms, known w=8 (poly 0x11D) values, matrix inversion, and the
+MDS property of every generator construction (any k of the k+m rows of
+[I; G] invertible) — the property the reference's exhaustive-erasure decode
+tests enforce end-to-end (ceph_erasure_code_non_regression.cc:268-284)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.gf import GF, gf
+from ceph_tpu.ec import matrices as M
+
+
+def test_field_tables_w8():
+    f = gf(8)
+    # alpha=2 is primitive: antilog covers all non-zero values exactly once
+    assert sorted(f.antilog[:255].tolist()) == list(range(1, 256))
+    # known values in the 0x11D field
+    assert f.mul(2, 128) == 0x1D  # x * x^7 = x^8 == 0x11D - x^8
+    assert f.pow(2, 8) == 0x1D
+    assert f.inv(2) == 0x8E  # 0x8E<<1 = 0x11C, ^ 0x11D = 1
+
+
+@pytest.mark.parametrize("w", [4, 8, 16])
+def test_field_axioms(w):
+    f = gf(w)
+    rng = np.random.default_rng(0)
+    vals = rng.integers(1, f.size, size=24).tolist()
+    for a, b in itertools.product(vals[:8], vals[8:16]):
+        a, b = int(a), int(b)
+        assert f.mul(a, b) == f.mul(b, a)
+        assert f.div(f.mul(a, b), b) == a
+        assert f.mul(a, f.inv(a)) == 1
+    c = int(vals[16])
+    for a, b in zip(vals[:8], vals[8:16]):
+        # distributivity over XOR (field addition)
+        assert f.mul(c, int(a) ^ int(b)) == f.mul(c, int(a)) ^ f.mul(c, int(b))
+
+
+def test_mul_region_matches_scalar():
+    f = gf(8)
+    region = np.arange(256, dtype=np.uint8)
+    for c in [0, 1, 2, 3, 0x1D, 0xFF]:
+        out = f.mul_region(c, region)
+        for v in [0, 1, 7, 130, 255]:
+            assert out[v] == f.mul(c, v)
+
+
+def test_matmul_matches_scalar():
+    f = gf(8)
+    rng = np.random.default_rng(1)
+    mat = rng.integers(0, 256, size=(3, 5))
+    data = rng.integers(0, 256, size=(5, 64), dtype=np.uint8)
+    out = f.matmul(mat, data)
+    for i in range(3):
+        for b in [0, 17, 63]:
+            acc = 0
+            for j in range(5):
+                acc ^= f.mul(int(mat[i, j]), int(data[j, b]))
+            assert out[i, b] == acc
+
+
+def test_invert_matrix_roundtrip():
+    f = gf(8)
+    rng = np.random.default_rng(2)
+    for n in [1, 2, 4, 8]:
+        while True:
+            a = rng.integers(0, 256, size=(n, n))
+            try:
+                inv = f.invert_matrix(a)
+                break
+            except np.linalg.LinAlgError:
+                continue
+        ident = f.matmul(a, inv.astype(np.uint8))
+        assert np.array_equal(ident, np.eye(n, dtype=np.uint8))
+
+
+def _assert_mds(coding: np.ndarray, k: int, w: int):
+    """All k-subsets of [I_k; coding] rows must be invertible."""
+    f = gf(w)
+    full = np.vstack([np.eye(k, dtype=np.int64), coding])
+    n = full.shape[0]
+    for rows in itertools.combinations(range(n), k):
+        sub = full[list(rows)]
+        f.invert_matrix(sub)  # raises if singular
+
+
+@pytest.mark.parametrize("k,m", [(2, 2), (4, 2), (8, 3), (10, 4)])
+def test_vandermonde_mds(k, m):
+    g = M.vandermonde_coding_matrix(k, m, 8)
+    assert g.shape == (m, k)
+    # systematization leaves the first coding row all-ones
+    assert np.all(g[0] == 1)
+    _assert_mds(g, k, 8)
+
+
+@pytest.mark.parametrize("k,m", [(4, 2), (8, 3), (6, 3)])
+def test_cauchy_mds(k, m):
+    _assert_mds(M.cauchy_orig_matrix(k, m, 8), k, 8)
+    g = M.cauchy_good_matrix(k, m, 8)
+    assert np.all(g[0] == 1)  # improvement normalizes the first row
+    _assert_mds(g, k, 8)
+
+
+def test_r6_matrix():
+    g = M.r6_coding_matrix(6, 8)
+    assert np.all(g[0] == 1)
+    assert g[1, 3] == gf(8).pow(2, 3)
+    _assert_mds(g, 6, 8)
+
+
+@pytest.mark.parametrize("k,m", [(4, 2), (8, 3)])
+def test_isa_cauchy_mds(k, m):
+    _assert_mds(M.isa_cauchy_matrix(k, m, 8), k, 8)
+
+
+def test_isa_vandermonde_small_mds():
+    # isa-l's RS matrix is only MDS inside its safety envelope (k<=32, m<=4)
+    _assert_mds(M.isa_vandermonde_matrix(8, 3, 8), 8, 8)
+
+
+def test_bitmatrix_equivalence():
+    """Bit-plane matmul over GF(2) == symbol matmul over GF(2^8).
+
+    This is THE load-bearing identity for the TPU design: every GF(2^w)
+    linear code is a GF(2) linear map on bit-planes, so one MXU matmul
+    kernel serves all codecs."""
+    f = gf(8)
+    rng = np.random.default_rng(3)
+    k, m, B = 4, 2, 128
+    mat = rng.integers(0, 256, size=(m, k))
+    data = rng.integers(0, 256, size=(k, B), dtype=np.uint8)
+    want = f.matmul(mat, data)
+
+    bm = M.matrix_to_bitmatrix(mat, 8)  # [m*8, k*8]
+    # data bit-planes: row j*8+x is bit x of data[j]
+    bits = np.zeros((k * 8, B), dtype=np.uint8)
+    for j in range(k):
+        for x in range(8):
+            bits[j * 8 + x] = (data[j] >> x) & 1
+    out_bits = (bm.astype(np.int64) @ bits.astype(np.int64)) % 2
+    out = np.zeros((m, B), dtype=np.uint8)
+    for i in range(m):
+        for x in range(8):
+            out[i] |= (out_bits[i * 8 + x] << x).astype(np.uint8)
+    assert np.array_equal(out, want)
+
+
+def test_invert_bitmatrix():
+    bm = M.matrix_to_bitmatrix(M.cauchy_orig_matrix(3, 3, 8)[:3, :3], 8)
+    inv = M.invert_bitmatrix(bm)
+    ident = (bm.astype(np.int64) @ inv.astype(np.int64)) % 2
+    assert np.array_equal(ident, np.eye(24, dtype=np.int64))

@@ -1,0 +1,184 @@
+"""Plugin-registry behavior tests, modeled on the reference's
+TestErasureCodePlugin.cc: load errors for every failure-mode fixture,
+version handshake, profile round-trip validation, and non-reentrancy of the
+registry lock against a hanging plugin (TestErasureCodePlugin.cc:31-76)."""
+
+import errno
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.ec.interface import ErasureCodeError
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+
+
+def write_plugin(tmp_path, name, body):
+    path = os.path.join(tmp_path, f"ec_{name}.py")
+    with open(path, "w") as f:
+        f.write(textwrap.dedent(body))
+    return str(tmp_path)
+
+
+def test_load_ok_and_factory():
+    reg = ErasureCodePluginRegistry()
+    codec = reg.factory("xor", "", {"plugin": "xor", "k": "2"})
+    assert codec.get_chunk_count() == 3
+    assert reg.get("xor") is not None
+
+
+def test_missing_plugin():
+    reg = ErasureCodePluginRegistry()
+    with pytest.raises(ErasureCodeError) as e:
+        reg.factory("doesnotexist", "", {})
+    assert e.value.errno_code == -errno.ENOENT
+
+
+def test_missing_version(tmp_path):
+    d = write_plugin(
+        tmp_path,
+        "noversion",
+        """
+        def __erasure_code_init__(name, registry):
+            return 0
+        """,
+    )
+    reg = ErasureCodePluginRegistry()
+    with pytest.raises(ErasureCodeError) as e:
+        reg.factory("noversion", d, {})
+    assert e.value.errno_code == -errno.ENOENT
+
+
+def test_version_mismatch(tmp_path):
+    d = write_plugin(
+        tmp_path,
+        "oldversion",
+        """
+        def __erasure_code_version__():
+            return "0.0.0-ancient"
+        def __erasure_code_init__(name, registry):
+            return 0
+        """,
+    )
+    reg = ErasureCodePluginRegistry()
+    with pytest.raises(ErasureCodeError) as e:
+        reg.factory("oldversion", d, {})
+    assert e.value.errno_code == -errno.EXDEV
+
+
+def test_missing_entry_point(tmp_path):
+    d = write_plugin(
+        tmp_path,
+        "noinit",
+        """
+        from ceph_tpu import PLUGIN_ABI_VERSION
+        def __erasure_code_version__():
+            return PLUGIN_ABI_VERSION
+        """,
+    )
+    reg = ErasureCodePluginRegistry()
+    with pytest.raises(ErasureCodeError) as e:
+        reg.factory("noinit", d, {})
+    assert e.value.errno_code == -errno.ENOENT
+
+
+def test_fail_to_initialize(tmp_path):
+    d = write_plugin(
+        tmp_path,
+        "failinit",
+        """
+        import errno
+        from ceph_tpu import PLUGIN_ABI_VERSION
+        def __erasure_code_version__():
+            return PLUGIN_ABI_VERSION
+        def __erasure_code_init__(name, registry):
+            return -errno.ESRCH
+        """,
+    )
+    reg = ErasureCodePluginRegistry()
+    with pytest.raises(ErasureCodeError) as e:
+        reg.factory("failinit", d, {})
+    assert e.value.errno_code == -errno.ESRCH
+
+
+def test_fail_to_register(tmp_path):
+    d = write_plugin(
+        tmp_path,
+        "noregister",
+        """
+        from ceph_tpu import PLUGIN_ABI_VERSION
+        def __erasure_code_version__():
+            return PLUGIN_ABI_VERSION
+        def __erasure_code_init__(name, registry):
+            return 0
+        """,
+    )
+    reg = ErasureCodePluginRegistry()
+    with pytest.raises(ErasureCodeError) as e:
+        reg.factory("noregister", d, {})
+    assert e.value.errno_code == -errno.EBADF
+
+
+def test_profile_roundtrip_validation():
+    """factory() must reject a plugin that silently alters a requested key
+    (reference ErasureCodePlugin.cc:108-112)."""
+    reg = ErasureCodePluginRegistry()
+    with pytest.raises(ErasureCodeError) as e:
+        # xor forces m=1; requesting m=9 must be refused, not ignored
+        reg.factory("xor", "", {"plugin": "xor", "k": "2", "m": "9"})
+    assert e.value.errno_code == -errno.EINVAL
+
+
+def test_registry_lock_nonreentrant(tmp_path):
+    """A plugin that hangs during load blocks other loads (the reference
+    proves the registry lock is held across dlopen/init with an
+    intentionally-hanging plugin)."""
+    event_path = os.path.join(tmp_path, "release")
+    d = write_plugin(
+        tmp_path,
+        "hangs",
+        f"""
+        import os, time
+        from ceph_tpu import PLUGIN_ABI_VERSION
+        from ceph_tpu.ec.plugins.xor import XorPlugin
+        def __erasure_code_version__():
+            return PLUGIN_ABI_VERSION
+        def __erasure_code_init__(name, registry):
+            while not os.path.exists({event_path!r}):
+                time.sleep(0.01)
+            registry.add(name, XorPlugin())
+            return 0
+        """,
+    )
+    reg = ErasureCodePluginRegistry()
+    results = {}
+
+    def load_hanging():
+        results["hangs"] = reg.factory("hangs", d, {})
+
+    def load_other():
+        results["xor"] = reg.factory("xor", "", {"plugin": "xor"})
+        results["xor_done_at"] = time.monotonic()
+
+    t1 = threading.Thread(target=load_hanging)
+    t1.start()
+    time.sleep(0.1)  # let the hanging load take the lock
+    t2 = threading.Thread(target=load_other)
+    t2.start()
+    time.sleep(0.2)
+    assert "xor" not in results  # blocked behind the hanging plugin
+    release_at = time.monotonic()
+    with open(event_path, "w") as f:
+        f.write("go")
+    t1.join(timeout=5)
+    t2.join(timeout=5)
+    assert results["hangs"] is not None
+    assert results["xor_done_at"] >= release_at
+
+
+def test_preload():
+    reg = ErasureCodePluginRegistry()
+    reg.preload("jerasure, isa, xor")
+    assert reg.get("jerasure") and reg.get("isa") and reg.get("xor")
