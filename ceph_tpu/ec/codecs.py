@@ -99,10 +99,18 @@ class MatrixErasureCode(ErasureCode):
         assert padded % self.k == 0
         return padded // self.k
 
+    def _apply(self, matrix: np.ndarray, regions: np.ndarray) -> np.ndarray:
+        """Apply a GF(2^w) matrix to symbol regions — THE compute seam.
+
+        CPU codecs use the table-gather oracle; the tpu plugin overrides
+        this one method to dispatch the bit-plane MXU matmul, which makes
+        encode, decode, and recovery all ride the same kernel."""
+        return gf(self.w).matmul(matrix, regions)
+
     def encode_chunks(self, data: np.ndarray) -> np.ndarray:
         if data.shape[0] != self.k:
             raise ErasureCodeError(-errno.EINVAL, "wrong data chunk count")
-        return gf(self.w).matmul(self.matrix, data)
+        return self._apply(self.matrix, data)
 
     def _decode_matrix(self, chosen: Tuple[int, ...]) -> np.ndarray:
         """Rows of [I; G] for `chosen` chunks, inverted: maps chosen-chunk
@@ -125,16 +133,15 @@ class MatrixErasureCode(ErasureCode):
     def decode_chunks(
         self, want_to_read: Set[int], chunks: Mapping[int, np.ndarray]
     ) -> Dict[int, np.ndarray]:
-        f = gf(self.w)
         available = set(chunks)
         plan = self.minimum_to_decode(set(range(self.k)) | set(want_to_read), available)
         chosen = tuple(sorted(plan))[: self.k]
         src = np.stack([np.asarray(chunks[c], dtype=np.uint8) for c in chosen])
         inv = self._decode_matrix(chosen)
-        data = f.matmul(inv, src)
+        data = self._apply(inv, src)
         out: Dict[int, np.ndarray] = {}
         need_coding = [c for c in want_to_read if c >= self.k]
-        coding = f.matmul(self.matrix, data) if need_coding else None
+        coding = self._apply(self.matrix, data) if need_coding else None
         for c in want_to_read:
             if c in chunks:
                 out[c] = np.asarray(chunks[c], dtype=np.uint8)
@@ -201,11 +208,16 @@ class BitmatrixErasureCode(ErasureCode):
             .reshape(n, nb * self.w * self.packetsize)
         )
 
+    def _apply_rows(self, bm: np.ndarray, rows: np.ndarray) -> np.ndarray:
+        """Apply a GF(2) bit-matrix to packet rows — the compute seam the
+        tpu plugin overrides (same role as MatrixErasureCode._apply)."""
+        return gf2_combine(bm, rows)
+
     def encode_chunks(self, data: np.ndarray) -> np.ndarray:
         if data.shape[0] != self.k:
             raise ErasureCodeError(-errno.EINVAL, "wrong data chunk count")
         rows = self._to_rows(np.ascontiguousarray(data, dtype=np.uint8))
-        return self._from_rows(gf2_combine(self.bitmatrix, rows))
+        return self._from_rows(self._apply_rows(self.bitmatrix, rows))
 
     def _decode_bitmatrix(self, chosen: Tuple[int, ...]) -> np.ndarray:
         cached = self._decode_cache.get(chosen)
@@ -233,10 +245,10 @@ class BitmatrixErasureCode(ErasureCode):
             [self._to_rows(np.asarray(chunks[c], dtype=np.uint8)[None, :]) for c in chosen]
         )
         inv = self._decode_bitmatrix(chosen)
-        data_rows = gf2_combine(inv, src_rows)
+        data_rows = self._apply_rows(inv, src_rows)
         out: Dict[int, np.ndarray] = {}
         need_coding = [c for c in want_to_read if c >= self.k]
-        coding_rows = gf2_combine(self.bitmatrix, data_rows) if need_coding else None
+        coding_rows = self._apply_rows(self.bitmatrix, data_rows) if need_coding else None
         for c in want_to_read:
             if c in chunks:
                 out[c] = np.asarray(chunks[c], dtype=np.uint8)

@@ -1,0 +1,187 @@
+"""plugin=tpu — the flagship backend: GF(2^8) Reed-Solomon on the TPU MXU.
+
+Registered through the same registry as every other plugin (the north-star
+seam, BASELINE.json): profiles say ``plugin=tpu technique=reed_sol_van k=8
+m=3`` and the codec produces chunks byte-identical to the jerasure-equivalent
+CPU codec — same matrices, same padding/alignment rules (it *subclasses* the
+jerasure technique classes, so get_chunk_size et al. are literally shared) —
+while encode/decode/recovery run as one bit-plane GF(2) matmul on the device
+(ceph_tpu/ops/gf2.py, Pallas kernel in ceph_tpu/ops/pallas_gf2.py).
+
+Failure semantics: the device is a new failure domain the in-process dlopen
+model never had (SURVEY.md §7 hard part 5).  Every dispatch falls back to
+the inherited CPU path on any JAX error, so EC I/O never wedges on a sick
+accelerator; the fallback flips a flag once and logs.
+
+Batching: column counts are bucketed to powers of two (min 1024) to bound
+XLA recompilation; full cross-object stripe batching lives in
+ceph_tpu.parallel.service.BatchingQueue, which concatenates many
+encode_chunks calls into one device dispatch.
+"""
+
+from __future__ import annotations
+
+import errno
+import logging
+from typing import Dict
+
+import numpy as np
+
+from ceph_tpu import PLUGIN_ABI_VERSION
+from ceph_tpu.ec.interface import ErasureCodeError, ErasureCodeProfile
+from ceph_tpu.ec.matrices import matrix_to_bitmatrix
+from ceph_tpu.ec.plugins.jerasure import (
+    CauchyGood,
+    CauchyOrig,
+    ReedSolomonR6Op,
+    ReedSolomonVandermonde,
+)
+from ceph_tpu.ec.registry import ErasureCodePlugin
+
+log = logging.getLogger("ceph_tpu.ec.tpu")
+
+
+class _TpuDispatch:
+    """Mixin overriding the codec compute seams with device dispatches."""
+
+    plugin_name = "tpu"
+
+    def _device_ok(self) -> bool:
+        if getattr(self, "_tpu_failed", False):
+            return False
+        return True
+
+    def _mark_failed(self, exc: Exception) -> None:
+        if not getattr(self, "_tpu_failed", False):
+            log.error("tpu dispatch failed, falling back to CPU: %s", exc)
+        self._tpu_failed = True
+
+    def _bm_cache(self) -> Dict[bytes, np.ndarray]:
+        cache = getattr(self, "_bitmatrix_cache", None)
+        if cache is None:
+            cache = self._bitmatrix_cache = {}
+        return cache
+
+    def _use_pallas(self, cols: int) -> bool:
+        import jax
+
+        from ceph_tpu.ops.pallas_gf2 import TILE_B
+
+        return jax.default_backend() == "tpu" and cols % TILE_B == 0
+
+    # seam override: GF(2^w) matrix applied to symbol regions
+    def _apply(self, matrix: np.ndarray, regions: np.ndarray) -> np.ndarray:
+        if not self._device_ok():
+            return super()._apply(matrix, regions)
+        try:
+            from ceph_tpu.ops.gf2 import bucket_columns as _bucket
+            from ceph_tpu.ops.gf2 import gf2_apply_bytes
+
+            cache = self._bm_cache()
+            key = matrix.tobytes()
+            bm = cache.get(key)
+            if bm is None:
+                bm = cache[key] = matrix_to_bitmatrix(matrix, self.w)
+            rows, B = regions.shape
+            out_rows = matrix.shape[0]
+            padded = _bucket(B)
+            buf = regions
+            if padded != B:
+                buf = np.zeros((rows, padded), dtype=np.uint8)
+                buf[:, :B] = regions
+            out = gf2_apply_bytes(
+                bm, buf, self.w, out_rows, use_pallas=self._use_pallas(padded)
+            )
+            return np.asarray(out)[:, :B]
+        except Exception as e:  # any device/compile failure -> CPU fallback
+            self._mark_failed(e)
+            return super()._apply(matrix, regions)
+
+    # seam override: GF(2) bit-matrix applied to packet rows
+    def _apply_rows(self, bm: np.ndarray, rows: np.ndarray) -> np.ndarray:
+        if not self._device_ok():
+            return super()._apply_rows(bm, rows)
+        try:
+            from ceph_tpu.ops.gf2 import bucket_columns as _bucket
+            from ceph_tpu.ops.gf2 import gf2_apply_packets
+
+            w, p = self.w, self.packetsize
+            R, nb, _ = rows.shape
+            n = R // w
+            out_n = bm.shape[0] // w
+            # rows -> chunk layout; the fused op does the 8x bit expansion
+            # on-device instead of in host memory
+            chunks = (
+                rows.reshape(n, w, nb, p).transpose(0, 2, 1, 3).reshape(n, nb * w * p)
+            )
+            # pad the block axis to a power-of-two bucket to bound recompiles
+            nb_pad = _bucket(nb, lo=1)
+            if nb_pad != nb:
+                buf = np.zeros((n, nb_pad * w * p), dtype=np.uint8)
+                buf[:, : chunks.shape[1]] = chunks
+                chunks = buf
+            out = np.asarray(
+                gf2_apply_packets(
+                    bm,
+                    chunks,
+                    w,
+                    p,
+                    out_n,
+                    use_pallas=self._use_pallas(nb_pad * p * 8),
+                )
+            )
+            out = out[:, : nb * w * p] if nb_pad != nb else out
+            return (
+                out.reshape(out_n, nb, w, p).transpose(0, 2, 1, 3).reshape(out_n * w, nb, p)
+            )
+        except Exception as e:
+            self._mark_failed(e)
+            return super()._apply_rows(bm, rows)
+
+
+class TpuReedSolomonVandermonde(_TpuDispatch, ReedSolomonVandermonde):
+    pass
+
+
+class TpuReedSolomonR6Op(_TpuDispatch, ReedSolomonR6Op):
+    pass
+
+
+class TpuCauchyOrig(_TpuDispatch, CauchyOrig):
+    pass
+
+
+class TpuCauchyGood(_TpuDispatch, CauchyGood):
+    pass
+
+
+TECHNIQUES = {
+    "reed_sol_van": TpuReedSolomonVandermonde,
+    "reed_sol_r6_op": TpuReedSolomonR6Op,
+    "cauchy_orig": TpuCauchyOrig,
+    "cauchy_good": TpuCauchyGood,
+}
+
+
+class TpuPlugin(ErasureCodePlugin):
+    def factory(self, profile: ErasureCodeProfile):
+        technique = profile.get("technique", "reed_sol_van")
+        cls = TECHNIQUES.get(technique)
+        if cls is None:
+            raise ErasureCodeError(
+                -errno.ENOENT,
+                f"technique={technique} is not a valid tpu technique "
+                f"(have {sorted(TECHNIQUES)})",
+            )
+        codec = cls()
+        codec.init(dict(profile, technique=technique))
+        return codec
+
+
+def __erasure_code_version__() -> str:
+    return PLUGIN_ABI_VERSION
+
+
+def __erasure_code_init__(name: str, registry) -> int:
+    registry.add(name, TpuPlugin())
+    return 0

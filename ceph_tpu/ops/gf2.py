@@ -1,0 +1,154 @@
+"""Bit-plane GF(2) matmul — the one TPU kernel behind every codec.
+
+A GF(2^w) linear code is a GF(2) linear map on bit-planes, so the parity
+computation the reference dispatches per-stripe to CPU SIMD
+(jerasure_matrix_encode / jerasure_schedule_encode, reference
+src/erasure-code/jerasure/ErasureCodeJerasure.cc:105-138) becomes ONE batched
+MXU matmul here:
+
+    out_bits[R, B] = (M_bits[R, C] @ data_bits[C, B]) & 1
+
+with int8 0/1 operands (int8 matmul maps natively onto the MXU) and the
+matrix as an *operand* — so the same compiled kernel serves encode (generator
+bit-matrix), decode (inverted signature matrix), and recovery, exactly the
+"one kernel" shape the north star asks for.
+
+Two data layouts feed it (see ceph_tpu/ec/codecs.py):
+  * byte layout  (reed_sol codes): bit-row j*w+x = bit x of chunk j's bytes;
+  * packet layout (cauchy/liberation): bit-row j*w+l = packet l of chunk j,
+    further unpacked bit-columns-within-bytes to reach the MXU.
+
+The pure-XLA path below is correct everywhere (CPU tests included); the
+Pallas kernel (ceph_tpu/ops/pallas_gf2.py) fuses unpack+matmul+pack in VMEM
+to avoid materializing the 8x-expanded bit arrays in HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bucket_columns(n: int, lo: int = 1024) -> int:
+    """Round a column count up to a power of two (>= lo) — the shared
+    batching policy bounding XLA recompilation across object sizes."""
+    b = lo
+    while b < n:
+        b <<= 1
+    return b
+
+
+def unpack_bits_bytes(data: jnp.ndarray, w: int) -> jnp.ndarray:
+    """[n, B] uint8 byte chunks -> [n*w, B] int8 bit-planes (byte layout).
+
+    For w=8 bit-row n*8+x is bit x of every byte.  For w=16 symbols are
+    little-endian byte pairs: row n*16+x is bit x of each uint16.  For w=4
+    each byte holds two symbols (lo nibble then hi nibble as consecutive
+    columns), matching the packed-nibble region semantics of the CPU
+    oracle (GF._mul_row w=4)."""
+    n, B = data.shape
+    if w == 16:
+        pairs = data.reshape(n, B // 2, 2)
+        planes = [((pairs[:, :, x // 8] >> (x % 8)) & 1) for x in range(16)]
+        bits = jnp.stack(planes, axis=1)  # [n, 16, B//2]
+        return bits.reshape(n * 16, B // 2).astype(jnp.int8)
+    if w == 4:
+        shifts = jnp.arange(4, dtype=jnp.uint8)
+        lo = (data[:, None, :] >> shifts[None, :, None]) & 1  # [n, 4, B]
+        hi = (data[:, None, :] >> (shifts + 4)[None, :, None]) & 1
+        bits = jnp.stack([lo, hi], axis=-1)  # [n, 4, B, 2]
+        return bits.reshape(n * 4, B * 2).astype(jnp.int8)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (data[:, None, :] >> shifts[None, :, None]) & 1  # [n, 8, B]
+    return bits.reshape(n * 8, B).astype(jnp.int8)
+
+
+def pack_bits_bytes(bits: jnp.ndarray, w: int, out_rows: int) -> jnp.ndarray:
+    """Inverse of unpack_bits_bytes: [out_rows*w, Bcols] -> [out_rows, B]."""
+    if w == 16:
+        Bc = bits.shape[1]
+        planes = bits.reshape(out_rows, 16, Bc).astype(jnp.int32)
+        lo = jnp.zeros((out_rows, Bc), jnp.int32)
+        hi = jnp.zeros((out_rows, Bc), jnp.int32)
+        for x in range(8):
+            lo = lo + (planes[:, x] << x)
+            hi = hi + (planes[:, x + 8] << x)
+        out = jnp.stack([lo, hi], axis=-1).reshape(out_rows, Bc * 2)
+        return out.astype(jnp.uint8)
+    if w == 4:
+        Bc2 = bits.shape[1]  # B*2 nibble columns
+        planes = bits.reshape(out_rows, 4, Bc2 // 2, 2).astype(jnp.int32)
+        shifts = jnp.arange(4, dtype=jnp.int32)
+        lo = jnp.sum(planes[..., 0] << shifts[None, :, None], axis=1)
+        hi = jnp.sum(planes[..., 1] << shifts[None, :, None], axis=1)
+        return (lo | (hi << 4)).astype(jnp.uint8)
+    Bc = bits.shape[1]
+    planes = bits.reshape(out_rows, 8, Bc).astype(jnp.int32)
+    shifts = jnp.arange(8, dtype=jnp.int32)
+    out = jnp.sum(planes << shifts[None, :, None], axis=1)
+    return out.astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("use_pallas",))
+def gf2_matmul(mbits: jnp.ndarray, bits: jnp.ndarray, use_pallas: bool = False) -> jnp.ndarray:
+    """(M @ bits) & 1 with int8 operands, int32 MXU accumulation."""
+    if use_pallas:
+        from ceph_tpu.ops.pallas_gf2 import pallas_gf2_matmul
+
+        return pallas_gf2_matmul(mbits, bits)
+    acc = jax.lax.dot_general(
+        mbits.astype(jnp.int8),
+        bits.astype(jnp.int8),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return (acc & 1).astype(jnp.int8)
+
+
+@functools.partial(jax.jit, static_argnames=("w", "out_rows", "use_pallas"))
+def gf2_apply_bytes(
+    mbits: jnp.ndarray,
+    data: jnp.ndarray,
+    w: int,
+    out_rows: int,
+    use_pallas: bool = False,
+) -> jnp.ndarray:
+    """Byte layout: apply a [out_rows*w, n*w] bit-matrix to [n, B] chunks."""
+    if use_pallas and w == 8:
+        from ceph_tpu.ops.pallas_gf2 import pallas_apply_bytes_w8
+
+        return pallas_apply_bytes_w8(mbits, data, out_rows)
+    bits = unpack_bits_bytes(data, w)
+    out = gf2_matmul(mbits, bits)
+    return pack_bits_bytes(out, w, out_rows)
+
+
+@functools.partial(jax.jit, static_argnames=("w", "packetsize", "out_rows", "use_pallas"))
+def gf2_apply_packets(
+    mbits: jnp.ndarray,
+    data: jnp.ndarray,
+    w: int,
+    packetsize: int,
+    out_rows: int,
+    use_pallas: bool = False,
+) -> jnp.ndarray:
+    """Packet layout: [n, chunk] chunks, chunk = nb*w*packetsize, apply
+    [out_rows*w, n*w] bit-matrix over packet rows."""
+    n, chunk = data.shape
+    wp = w * packetsize
+    nb = chunk // wp
+    rows = data.reshape(n, nb, w, packetsize).transpose(0, 2, 1, 3).reshape(n * w, nb * packetsize)
+    # bytes -> bit columns so the combine is an MXU matmul
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = ((rows[:, :, None] >> shifts[None, None, :]) & 1).reshape(n * w, nb * packetsize * 8)
+    out = gf2_matmul(mbits, bits, use_pallas=use_pallas)
+    out = out.reshape(out_rows * w, nb * packetsize, 8).astype(jnp.int32)
+    packed = jnp.sum(out << jnp.arange(8, dtype=jnp.int32)[None, None, :], axis=-1).astype(jnp.uint8)
+    return (
+        packed.reshape(out_rows, w, nb, packetsize)
+        .transpose(0, 2, 1, 3)
+        .reshape(out_rows, chunk)
+    )

@@ -1,0 +1,108 @@
+"""Pallas TPU kernels for the bit-plane GF(2) matmul.
+
+The fused byte-layout kernel keeps the 8x-expanded bit-planes in VMEM only:
+each grid step DMAs a [k, TILE_B] uint8 data tile, unpacks to [k*8, TILE_B]
+int8 bit-planes in VMEM, runs the MXU matmul against the resident
+[out*8, k*8] bit-matrix, packs the result back to [out, TILE_B] bytes, and
+stores it — so HBM traffic stays at (k + out) bytes per byte-column instead
+of 9x that for the unfused XLA path.
+
+The generator/decode matrix is an operand, not a constant: one compiled
+kernel serves encode, decode, and recovery (north star, BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# 8 KiB of byte-columns per grid step: bits tile [k*8, 8192] int8 = k*64 KiB
+# in VMEM (k=8 -> 512 KiB), well under the ~16 MiB budget with double
+# buffering.
+TILE_B = 8192
+
+
+def _apply_bytes_w8_kernel(g_ref, d_ref, o_ref, *, k: int, out_rows: int):
+    d = d_ref[:].astype(jnp.int32)  # [k, TILE_B]
+    planes = []
+    for x in range(8):
+        planes.append((d >> x) & 1)
+    bits = jnp.stack(planes, axis=1).reshape(k * 8, d.shape[-1]).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        g_ref[:],
+        bits,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [out_rows*8, TILE_B]
+    acc = acc & 1
+    acc = acc.reshape(out_rows, 8, d.shape[-1])
+    out = jnp.zeros((out_rows, d.shape[-1]), jnp.int32)
+    for x in range(8):
+        out = out | (acc[:, x, :] << x)
+    o_ref[:] = out.astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("out_rows", "interpret"))
+def pallas_apply_bytes_w8(
+    mbits: jnp.ndarray, data: jnp.ndarray, out_rows: int, interpret: bool = False
+) -> jnp.ndarray:
+    """[out_rows*8, k*8] bit-matrix applied to [k, B] uint8 chunks (w=8 byte
+    layout).  B must be a multiple of TILE_B (the tpu plugin pads batches).
+    Columns are padded to a TILE_B multiple here (and sliced back), so any
+    B is safe — an unpadded B < TILE_B must not produce an empty grid.
+    interpret=True runs the kernel in the Pallas interpreter (CPU tests)."""
+    k, B = data.shape
+    Bp = -(-B // TILE_B) * TILE_B
+    if Bp != B:
+        data = jnp.pad(data, ((0, 0), (0, Bp - B)))
+    grid = (Bp // TILE_B,)
+    kernel = functools.partial(_apply_bytes_w8_kernel, k=k, out_rows=out_rows)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((out_rows, Bp), jnp.uint8),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((out_rows * 8, k * 8), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, TILE_B), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((out_rows, TILE_B), lambda i: (0, i), memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(mbits.astype(jnp.int8), data)
+    return out[:, :B]
+
+
+def _gf2_matmul_kernel(m_ref, b_ref, o_ref):
+    acc = jax.lax.dot_general(
+        m_ref[:], b_ref[:], (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
+    )
+    o_ref[:] = (acc & 1).astype(jnp.int8)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_gf2_matmul(
+    mbits: jnp.ndarray, bits: jnp.ndarray, interpret: bool = False
+) -> jnp.ndarray:
+    """Plain (M @ bits) & 1 on pre-unpacked bit rows; columns padded to a
+    TILE_B multiple and tiled (remainder columns must not be dropped)."""
+    R, C = mbits.shape
+    B = bits.shape[1]
+    Bp = -(-B // TILE_B) * TILE_B
+    if Bp != B:
+        bits = jnp.pad(bits, ((0, 0), (0, Bp - B)))
+    grid = (Bp // TILE_B,)
+    out = pl.pallas_call(
+        _gf2_matmul_kernel,
+        out_shape=jax.ShapeDtypeStruct((R, Bp), jnp.int8),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((R, C), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, TILE_B), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((R, TILE_B), lambda i: (0, i), memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(mbits.astype(jnp.int8), bits.astype(jnp.int8))
+    return out[:, :B]

@@ -1,0 +1,1 @@
+"""Device mesh, shardings, and the batched EC dispatch service."""

@@ -1,0 +1,163 @@
+"""Stripe-batching dispatch queue — amortizing many small EC ops into one
+device call.
+
+The reference dispatches its codec once per 4 KiB-unit stripe inside
+ECUtil::encode (reference src/osd/ECUtil.cc:123-160) and per 1 MiB buffer in
+the benchmark; a TPU dispatch has fixed launch latency, so the >=10x target
+"lives or dies on the batching queue" (SURVEY.md §7 hard part 2).  This
+queue aggregates encode/decode requests from many objects/ops, concatenates
+them column-wise into one [rows, sum(B)] buffer per (matrix, layout) group,
+runs ONE bit-plane matmul, and fans completions back out — the same
+submit -> aggregate -> dispatch -> completion-fan-out pipeline ECBackend's
+write path drives (submit_transaction -> ... -> try_reads_to_commit,
+ECBackend.cc:1525->1989).
+
+Threading model: submit() is non-blocking and returns a Future; a worker
+thread flushes when pending bytes cross `max_pending_bytes` or `max_delay`
+elapses, whichever first.  flush() forces a synchronous drain (used by
+tests and by the benchmark's timed sections).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class _Group:
+    mbits: np.ndarray
+    w: int
+    out_rows: int
+    requests: List[Tuple[np.ndarray, Future]] = field(default_factory=list)
+    pending_bytes: int = 0
+
+
+class BatchingQueue:
+    def __init__(
+        self,
+        max_pending_bytes: int = 64 << 20,
+        max_delay: float = 0.002,
+        use_pallas: Optional[bool] = None,
+    ):
+        self.max_pending_bytes = max_pending_bytes
+        self.max_delay = max_delay
+        self._use_pallas = use_pallas
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._groups: Dict[bytes, _Group] = {}
+        self._pending = 0
+        self._oldest: Optional[float] = None
+        self._stop = False
+        self._worker = threading.Thread(target=self._run, daemon=True, name="ec-batch")
+        self._worker.start()
+        self.dispatches = 0  # perf counter: device calls issued
+        self.bytes_dispatched = 0
+
+    # -- client side ---------------------------------------------------------
+
+    def submit(
+        self, mbits: np.ndarray, regions: np.ndarray, w: int, out_rows: int
+    ) -> "Future[np.ndarray]":
+        """Queue (mbits @ regions) over the byte layout; resolves to the
+        [out_rows, B] parity/reconstruction buffer."""
+        fut: Future = Future()
+        key = mbits.tobytes()
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("BatchingQueue is closed")
+            group = self._groups.get(key)
+            if group is None:
+                group = self._groups[key] = _Group(mbits=mbits, w=w, out_rows=out_rows)
+            group.requests.append((regions, fut))
+            nbytes = regions.nbytes
+            group.pending_bytes += nbytes
+            self._pending += nbytes
+            if self._oldest is None:
+                self._oldest = time.monotonic()
+            self._cv.notify()
+        return fut
+
+    def flush(self) -> None:
+        """Synchronously drain everything queued right now."""
+        with self._cv:
+            groups = self._take_locked()
+        self._dispatch(groups)
+
+    def close(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        self._worker.join(timeout=5)
+        self.flush()
+
+    # -- worker side ---------------------------------------------------------
+
+    def _take_locked(self) -> List[_Group]:
+        groups = [g for g in self._groups.values() if g.requests]
+        self._groups = {}
+        self._pending = 0
+        self._oldest = None
+        return groups
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stop:
+                    if self._pending >= self.max_pending_bytes:
+                        break
+                    if self._oldest is not None:
+                        remaining = self.max_delay - (time.monotonic() - self._oldest)
+                        if remaining <= 0:
+                            break
+                        self._cv.wait(timeout=remaining)
+                    else:
+                        self._cv.wait()
+                if self._stop:
+                    return
+                groups = self._take_locked()
+            self._dispatch(groups)
+
+    def _dispatch(self, groups: List[_Group]) -> None:
+        from ceph_tpu.ops.gf2 import bucket_columns as _bucket
+        from ceph_tpu.ops.gf2 import gf2_apply_bytes
+
+        for g in groups:
+            if not g.requests:
+                continue
+            widths = [r.shape[1] for r, _ in g.requests]
+            batch = np.concatenate([r for r, _ in g.requests], axis=1)
+            pad = _bucket(batch.shape[1]) - batch.shape[1]
+            if pad:
+                batch = np.pad(batch, ((0, 0), (0, pad)))
+            use_pallas = self._use_pallas
+            if use_pallas is None:
+                import jax
+
+                from ceph_tpu.ops.pallas_gf2 import TILE_B
+
+                use_pallas = (
+                    jax.default_backend() == "tpu" and batch.shape[1] % TILE_B == 0
+                )
+            try:
+                out = np.asarray(
+                    gf2_apply_bytes(g.mbits, batch, g.w, g.out_rows, use_pallas=use_pallas)
+                )
+            except Exception as e:
+                for _, fut in g.requests:
+                    if not fut.done():
+                        fut.set_exception(e)
+                continue
+            self.dispatches += 1
+            self.bytes_dispatched += batch.nbytes
+            off = 0
+            for width, (_, fut) in zip(widths, g.requests):
+                # copy: a view would pin the whole batch buffer for as long
+                # as any single result stays alive
+                fut.set_result(out[:, off : off + width].copy())
+                off += width

@@ -1,0 +1,166 @@
+"""plugin=tpu tests: byte-equality vs the jerasure CPU oracle (the repo's
+non-regression contract, BASELINE.md), exhaustive-erasure decode through the
+device path, Pallas kernel in interpreter mode, CPU fallback semantics, and
+the stripe-batching queue."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.registry import registry
+from tests.test_codecs import make, payload, roundtrip_exhaustive
+
+
+@pytest.mark.parametrize(
+    "profile",
+    [
+        dict(technique="reed_sol_van", k=2, m=2),
+        dict(technique="reed_sol_van", k=4, m=2),
+        dict(technique="reed_sol_van", k=8, m=3),
+        dict(technique="reed_sol_van", k=3, m=2, w=16),
+        dict(technique="reed_sol_van", k=4, m=2, w=4),
+        dict(technique="reed_sol_r6_op", k=4),
+        dict(technique="cauchy_orig", k=3, m=2, packetsize=8),
+        dict(technique="cauchy_good", k=4, m=2, packetsize=8),
+    ],
+)
+def test_tpu_byte_identical_to_jerasure(profile):
+    """plugin=tpu chunks must memcmp-equal plugin=jerasure chunks — the
+    A/B property the reference's non-regression corpus enforces."""
+    t = make("tpu", **profile)
+    j = make("jerasure", **profile)
+    data = payload(1 << 16, seed=42)
+    n = t.get_chunk_count()
+    et = t.encode(set(range(n)), data)
+    ej = j.encode(set(range(n)), data)
+    assert not getattr(t, "_tpu_failed", False), "tpu path silently fell back"
+    for c in range(n):
+        assert np.array_equal(et[c], ej[c]), f"chunk {c} differs from jerasure"
+
+
+def test_tpu_exhaustive_decode():
+    codec = make("tpu", technique="reed_sol_van", k=4, m=2)
+    roundtrip_exhaustive(codec, payload(1 << 14))
+    assert not getattr(codec, "_tpu_failed", False)
+
+
+def test_tpu_decode_uses_device_path():
+    """Reconstruction (decode matrix as operand) must ride the same dispatch
+    seam as encode."""
+    codec = make("tpu", technique="reed_sol_van", k=8, m=3)
+    data = payload(1 << 18, seed=9)
+    enc = codec.encode(set(range(11)), data)
+    avail = {c: enc[c] for c in range(11) if c not in (0, 4, 10)}
+    out = codec.decode({0, 4, 10}, avail, len(enc[0]))
+    for c in (0, 4, 10):
+        assert np.array_equal(out[c], enc[c])
+    assert not getattr(codec, "_tpu_failed", False), "decode fell back to CPU"
+
+
+def test_tpu_cpu_fallback(monkeypatch):
+    """A sick device must not wedge EC I/O: dispatch errors flip to the
+    inherited CPU path and results stay correct (SURVEY.md §7 hard part 5)."""
+    import ceph_tpu.ops.gf2 as gf2
+
+    codec = make("tpu", technique="reed_sol_van", k=4, m=2)
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected device failure")
+
+    monkeypatch.setattr(gf2, "gf2_apply_bytes", boom)
+    data = payload(1 << 14, seed=3)
+    enc = codec.encode(set(range(6)), data)
+    assert codec._tpu_failed
+    j = make("jerasure", technique="reed_sol_van", k=4, m=2)
+    ej = j.encode(set(range(6)), data)
+    for c in range(6):
+        assert np.array_equal(enc[c], ej[c])
+
+
+def test_pallas_kernel_interpret():
+    """The fused Pallas kernel (interpreter mode) matches the CPU oracle."""
+    from ceph_tpu.ec.gf import gf
+    from ceph_tpu.ec.matrices import matrix_to_bitmatrix, vandermonde_coding_matrix
+    from ceph_tpu.ops.pallas_gf2 import TILE_B, pallas_apply_bytes_w8
+
+    k, m = 8, 3
+    mat = vandermonde_coding_matrix(k, m, 8)
+    bm = matrix_to_bitmatrix(mat, 8)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(k, TILE_B * 2), dtype=np.uint8)
+    out = np.asarray(pallas_apply_bytes_w8(bm, data, m, interpret=True))
+    want = gf(8).matmul(mat, data)
+    assert np.array_equal(out, want)
+
+
+def test_pallas_gf2_matmul_interpret():
+    from ceph_tpu.ops.pallas_gf2 import pallas_gf2_matmul
+
+    rng = np.random.default_rng(1)
+    M = rng.integers(0, 2, size=(16, 32), dtype=np.int8)
+    bits = rng.integers(0, 2, size=(32, 2048), dtype=np.int8)
+    out = np.asarray(pallas_gf2_matmul(M, bits, interpret=True))
+    want = (M.astype(np.int64) @ bits.astype(np.int64)) % 2
+    assert np.array_equal(out, want.astype(np.int8))
+
+
+def test_batching_queue():
+    """Many small encodes -> few device dispatches, identical bytes."""
+    from ceph_tpu.ec.matrices import matrix_to_bitmatrix, vandermonde_coding_matrix
+    from ceph_tpu.ec.gf import gf
+    from ceph_tpu.parallel.service import BatchingQueue
+
+    k, m = 4, 2
+    mat = vandermonde_coding_matrix(k, m, 8)
+    bm = matrix_to_bitmatrix(mat, 8)
+    q = BatchingQueue(max_pending_bytes=1 << 30, max_delay=60, use_pallas=False)
+    rng = np.random.default_rng(2)
+    reqs = [rng.integers(0, 256, size=(k, 4096), dtype=np.uint8) for _ in range(32)]
+    futs = [q.submit(bm, r, 8, m) for r in reqs]
+    assert not any(f.done() for f in futs)  # nothing dispatched yet
+    q.flush()
+    for r, f in zip(reqs, futs):
+        out = f.result(timeout=10)
+        assert np.array_equal(out, gf(8).matmul(mat, r))
+    assert q.dispatches == 1  # 32 requests, one device call
+    q.close()
+
+
+def test_batching_queue_delay_flush():
+    from ceph_tpu.ec.matrices import matrix_to_bitmatrix, vandermonde_coding_matrix
+    from ceph_tpu.parallel.service import BatchingQueue
+
+    bm = matrix_to_bitmatrix(vandermonde_coding_matrix(2, 1, 8), 8)
+    q = BatchingQueue(max_delay=0.01, use_pallas=False)
+    fut = q.submit(bm, np.zeros((2, 1024), dtype=np.uint8), 8, 1)
+    out = fut.result(timeout=5)  # worker must flush on its own
+    assert np.array_equal(out, np.zeros((1, 1024), dtype=np.uint8))
+    q.close()
+
+
+def test_pallas_small_batch_regression():
+    """B smaller than / not a multiple of TILE_B must not return unwritten
+    output (code-review regression: empty grid truncation)."""
+    from ceph_tpu.ec.gf import gf
+    from ceph_tpu.ec.matrices import matrix_to_bitmatrix, vandermonde_coding_matrix
+    from ceph_tpu.ops.pallas_gf2 import TILE_B, pallas_apply_bytes_w8, pallas_gf2_matmul
+
+    mat = vandermonde_coding_matrix(4, 2, 8)
+    bm = matrix_to_bitmatrix(mat, 8)
+    rng = np.random.default_rng(7)
+    for B in [256, TILE_B - 128, TILE_B + 512]:
+        data = rng.integers(0, 256, size=(4, B), dtype=np.uint8)
+        out = np.asarray(pallas_apply_bytes_w8(bm, data, 2, interpret=True))
+        assert np.array_equal(out, gf(8).matmul(mat, data)), f"B={B}"
+    M = rng.integers(0, 2, size=(8, 16), dtype=np.int8)
+    bits = rng.integers(0, 2, size=(16, TILE_B + 100), dtype=np.int8)
+    out = np.asarray(pallas_gf2_matmul(M, bits, interpret=True))
+    assert np.array_equal(out, ((M.astype(np.int64) @ bits.astype(np.int64)) % 2).astype(np.int8))
+
+
+def test_batching_queue_closed_submit():
+    from ceph_tpu.parallel.service import BatchingQueue
+
+    q = BatchingQueue(use_pallas=False)
+    q.close()
+    with pytest.raises(RuntimeError):
+        q.submit(np.ones((8, 16), np.uint8), np.zeros((2, 64), np.uint8), 8, 1)
