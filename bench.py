@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Headline benchmark: plugin=tpu Reed-Solomon encode throughput.
+
+Reproduces the reference's measurement protocol
+(ceph_erasure_code_benchmark, reference
+src/test/erasure-code/ceph_erasure_code_benchmark.cc: encode of --size
+bytes per iteration, throughput = bytes/seconds) for the north-star config
+k=8, m=3, 1 MiB stripes (BASELINE.md), with the TPU twist the design is
+built around: many stripes are batched into ONE device dispatch
+(SURVEY.md §5.7), and the measured path includes host->device transfer of
+the data chunks and device->host transfer of the parity — the real service
+boundary an OSD would see.
+
+Baseline: the reference publishes no absolute GB/s (BASELINE.md), so
+vs_baseline is measured locally against the CPU jerasure-equivalent oracle
+(same matrices, byte-identical output) on this host — the same A/B the
+reference's bench.sh performs between its plugins.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": GB/s, "unit": "GB/s", "vs_baseline": ratio}
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+K, M, W = 8, 3, 8
+STRIPE = 1 << 20  # 1 MiB object per stripe, reference default --size
+N_STRIPES = int(os.environ.get("BENCH_STRIPES", "64"))  # batched per dispatch
+ITERS = int(os.environ.get("BENCH_ITERS", "8"))
+CPU_ITERS = int(os.environ.get("BENCH_CPU_ITERS", "2"))
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import jax
+
+    from ceph_tpu.ec.gf import gf
+    from ceph_tpu.ec.matrices import matrix_to_bitmatrix, vandermonde_coding_matrix
+    from ceph_tpu.ops.gf2 import gf2_apply_bytes
+
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        # TPU tunnel down: re-exec once on CPU so the driver still gets a
+        # result line (the tpu plugin's CPU-fallback policy, applied here)
+        if os.environ.get("BENCH_FALLBACK") != "1":
+            env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_FALLBACK="1")
+            os.execve(sys.executable,
+                      [sys.executable, os.path.abspath(__file__)], env)
+        raise
+    mat = vandermonde_coding_matrix(K, M, W)
+    bm = matrix_to_bitmatrix(mat, W)
+
+    chunk = STRIPE // K  # 128 KiB per data chunk
+    B = chunk * N_STRIPES  # batched columns per dispatch
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(K, B), dtype=np.uint8)
+
+    use_pallas = backend == "tpu"
+
+    def dispatch() -> np.ndarray:
+        return np.asarray(gf2_apply_bytes(bm, data, W, M, use_pallas=use_pallas))
+
+    # correctness gate before any timing: byte-identical vs the oracle
+    parity = dispatch()
+    want = gf(W).matmul(mat, data[:, : chunk])
+    if not np.array_equal(parity[:, :chunk], want):
+        print(json.dumps({"metric": "encode_correctness", "value": 0, "unit": "bool",
+                          "vs_baseline": 0}))
+        return 1
+
+    dispatch()  # warm (compile already cached, page in)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        dispatch()
+    dt = time.perf_counter() - t0
+    total_bytes = ITERS * K * B  # data bytes encoded (reference counts in_size)
+    gbps = total_bytes / dt / 1e9
+
+    # CPU A/B: same bytes through the jerasure-equivalent oracle
+    f = gf(W)
+    t0 = time.perf_counter()
+    for _ in range(CPU_ITERS):
+        f.matmul(mat, data)
+    cpu_dt = (time.perf_counter() - t0) / CPU_ITERS
+    cpu_gbps = (K * B) / cpu_dt / 1e9
+
+    print(json.dumps({
+        "metric": f"ec_encode_GBps_k{K}m{M}_1MiB_stripes_batch{N_STRIPES}_{backend}",
+        "value": round(gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / cpu_gbps, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,9 @@
+"""Mini-RADOS: the distributed-object-store vertical slice.
+
+Reproduces the reference's pipeline shape (SURVEY.md §3.1) end-to-end on
+loopback: clients compute object->PG->OSD placement themselves from the
+mon-distributed OSDMap (CRUSH-style straw2, indep mode for EC), talk
+directly to the primary OSD over the async messenger, the primary fans out
+erasure-coded sub-ops to peer OSDs, and each OSD persists via its object
+store.  Monitors maintain maps only and never sit on the data path.
+"""

@@ -1,0 +1,170 @@
+"""RADOS client: computes placement itself and talks straight to primaries.
+
+Role-equivalent of librados + Objecter (reference src/osdc/Objecter.cc:2257
+op_submit / _calc_target): fetch the OSDMap from the mon, map
+object -> PG -> primary locally, send the op to the primary, and on failure
+refetch the map and resend (the Objecter's retry-across-epochs behavior,
+idempotent by reqid)."""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.rados.messenger import Messenger
+from ceph_tpu.rados.types import (
+    MCreatePool,
+    MCreatePoolReply,
+    MGetMap,
+    MMapReply,
+    MMarkDown,
+    MOSDOp,
+    MOSDOpReply,
+    OSDMap,
+)
+
+
+class RadosError(Exception):
+    pass
+
+
+class RadosClient:
+    def __init__(self, mon_addr: Tuple[str, int], conf: Optional[dict] = None):
+        self.mon_addr = tuple(mon_addr)
+        self.conf = conf or {}
+        self.op_timeout = self.conf.get("client_op_timeout", 10.0)
+        self.messenger = Messenger("client", self.conf)
+        self.osdmap: Optional[OSDMap] = None
+        self._replies: Dict[str, asyncio.Future] = {}
+        self._mon_fut: Optional[asyncio.Future] = None
+
+    async def start(self) -> None:
+        self.messenger.dispatcher = self._dispatch
+        await self.messenger.bind()
+
+    async def stop(self) -> None:
+        await self.messenger.shutdown()
+
+    async def _dispatch(self, conn, msg) -> None:
+        if isinstance(msg, (MMapReply, MCreatePoolReply)):
+            if self._mon_fut and not self._mon_fut.done():
+                self._mon_fut.set_result(msg)
+        elif isinstance(msg, MOSDOpReply):
+            fut = self._replies.pop(msg.reqid, None)
+            if fut and not fut.done():
+                fut.set_result(msg)
+
+    async def _mon_rpc(self, msg):
+        self._mon_fut = asyncio.get_running_loop().create_future()
+        await self.messenger.send(self.mon_addr, msg)
+        return await asyncio.wait_for(self._mon_fut, timeout=10)
+
+    async def refresh_map(self) -> OSDMap:
+        reply = await self._mon_rpc(MGetMap())
+        self.osdmap = reply.osdmap
+        return self.osdmap
+
+    async def create_pool(
+        self, name: str, pool_type: str = "ec", pg_num: int = 8,
+        profile: Optional[Dict[str, str]] = None,
+    ) -> int:
+        reply = await self._mon_rpc(
+            MCreatePool(name=name, pool_type=pool_type, pg_num=pg_num,
+                        profile=profile or {})
+        )
+        if not reply.ok:
+            raise RadosError(reply.error)
+        await self.refresh_map()
+        return reply.pool_id
+
+    async def mark_osd_down(self, osd_id: int) -> None:
+        """Admin: immediately mark an OSD down+out (test/thrash hook)."""
+        await self._mon_rpc(MMarkDown(osd_id=osd_id))
+        await self.refresh_map()
+
+    # -- data ops -------------------------------------------------------------
+
+    async def _op(self, op: MOSDOp, retries: int = 6) -> MOSDOpReply:
+        if self.osdmap is None:
+            await self.refresh_map()
+        last_error = "no attempt"
+        for attempt in range(retries):
+            pool = self.osdmap.pools.get(op.pool_id)
+            if pool is None:
+                raise RadosError(f"pool {op.pool_id} does not exist")
+            pg = self.osdmap.object_to_pg(pool, op.oid)
+            acting = self.osdmap.pg_to_acting(pool, pg)
+            primary = self.osdmap.primary_of(acting)
+            if primary is None:
+                last_error = "no primary (all acting osds down)"
+            else:
+                op.reqid = uuid.uuid4().hex
+                op.epoch = self.osdmap.epoch
+                fut: asyncio.Future = asyncio.get_running_loop().create_future()
+                self._replies[op.reqid] = fut
+                try:
+                    await self.messenger.send(self.osdmap.addr_of(primary), op)
+                    reply = await asyncio.wait_for(fut, timeout=self.op_timeout)
+                    if reply.ok:
+                        return reply
+                    last_error = reply.error
+                except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                    last_error = f"{type(e).__name__}: {e}"
+                finally:
+                    self._replies.pop(op.reqid, None)
+            await asyncio.sleep(0.3 * (attempt + 1))
+            await self.refresh_map()
+        raise RadosError(f"op {op.op} {op.oid} failed: {last_error}")
+
+    async def put(self, pool_id: int, oid: str, data: bytes) -> None:
+        await self._op(MOSDOp(op="write", pool_id=pool_id, oid=oid, data=data))
+
+    async def get(self, pool_id: int, oid: str) -> bytes:
+        reply = await self._op(MOSDOp(op="read", pool_id=pool_id, oid=oid))
+        return reply.data
+
+    async def delete(self, pool_id: int, oid: str) -> None:
+        await self._op(MOSDOp(op="delete", pool_id=pool_id, oid=oid))
+
+    async def list_objects(self, pool_id: int) -> List[str]:
+        """Union of shard listings across up OSDs (any OSD can answer for
+        its own shards; union covers holes)."""
+        if self.osdmap is None:
+            await self.refresh_map()
+        oids: set = set()
+        for osd in self.osdmap.osds.values():
+            if not osd.up:
+                continue
+            try:
+                reply = await self._op_direct(osd.osd_id,
+                                              MOSDOp(op="list", pool_id=pool_id))
+                oids.update(reply.oids)
+            except RadosError:
+                continue
+        return sorted(oids)
+
+    async def repair_pool(self, pool_id: int) -> None:
+        """Ask every up OSD to run primary-led repair for its PGs."""
+        for osd in list(self.osdmap.osds.values()):
+            if osd.up:
+                try:
+                    await self._op_direct(osd.osd_id,
+                                          MOSDOp(op="repair", pool_id=pool_id))
+                except RadosError:
+                    continue
+
+    async def _op_direct(self, osd_id: int, op: MOSDOp) -> MOSDOpReply:
+        op.reqid = uuid.uuid4().hex
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._replies[op.reqid] = fut
+        try:
+            await self.messenger.send(self.osdmap.addr_of(osd_id), op)
+            reply = await asyncio.wait_for(fut, timeout=self.op_timeout)
+        except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+            raise RadosError(str(e))
+        finally:
+            self._replies.pop(op.reqid, None)
+        if not reply.ok:
+            raise RadosError(reply.error)
+        return reply

@@ -1,0 +1,136 @@
+"""CRUSH-style placement: straw2 buckets with firstn and indep modes.
+
+Functional equivalent of the reference's crush core (reference
+src/crush/mapper.c): deterministic pseudo-random placement computed
+identically by every party from the map alone.  The property EC pools
+depend on is ``indep`` (crush_choose_indep, mapper.c:630): positions in
+the acting set are *stable* — when a device fails, surviving positions
+keep their shard index and the hole stays a hole — because an EC chunk id
+is positional, unlike replica copies (firstn).
+
+Hash: 64-bit FNV-1a-folded mix rather than rjenkins1 — placement quality
+and determinism are equivalent; byte-level parity with the reference's
+mapping is NOT a goal of this layer (documented divergence; the EC chunk
+bytes themselves are the byte-exact contract, not device selection).
+
+Straw2 selection (mapper.c bucket_straw2_choose semantics): each item
+draws ln(hash_unit)/weight and the maximum wins, which gives exact
+weighted subset sampling and minimal data movement on weight changes.
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+CRUSH_ITEM_NONE = -1  # hole marker in indep mode (reference CRUSH_ITEM_NONE)
+
+
+def _mix(*vals: int) -> int:
+    """Deterministic 64-bit hash of integers (placement draw)."""
+    h = 0xCBF29CE484222325
+    for v in vals:
+        for b in struct.pack("<q", v & 0x7FFFFFFFFFFFFFFF):
+            h ^= b
+            h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    h ^= h >> 33
+    h = (h * 0xFF51AFD7ED558CCD) & 0xFFFFFFFFFFFFFFFF
+    h ^= h >> 33
+    return h
+
+
+@dataclass
+class Bucket:
+    """A straw2 bucket: items are device ids (>=0) or child buckets (<0)."""
+
+    id: int
+    items: List[int] = field(default_factory=list)
+    weights: Dict[int, float] = field(default_factory=dict)  # item -> weight
+
+    def straw2_choose(self, x: int, r: int, exclude: set) -> Optional[int]:
+        best, best_draw = None, -math.inf
+        for item in self.items:
+            w = self.weights.get(item, 1.0)
+            if w <= 0 or item in exclude:
+                continue
+            u = (_mix(x, item, r) & 0xFFFF) / 65536.0
+            draw = math.log(u + 1.0 / 65536.0) / w
+            if draw > best_draw:
+                best, best_draw = item, draw
+        return best
+
+
+@dataclass
+class CrushMap:
+    buckets: Dict[int, Bucket] = field(default_factory=dict)
+    root_id: int = -1
+    rules: Dict[str, dict] = field(default_factory=dict)
+    _next_rule_id: int = 0
+
+    @classmethod
+    def flat(cls, osd_ids: List[int]) -> "CrushMap":
+        """One root bucket containing all OSDs (the vstart topology)."""
+        root = Bucket(id=-1, items=list(osd_ids), weights={i: 1.0 for i in osd_ids})
+        return cls(buckets={-1: root}, root_id=-1)
+
+    def set_weight(self, osd: int, weight: float) -> None:
+        for b in self.buckets.values():
+            if osd in b.weights:
+                b.weights[osd] = weight
+
+    def add_simple_rule(
+        self, name: str, root: str = "default", failure_domain: str = "osd",
+        mode: str = "indep",
+    ) -> int:
+        """Reference ErasureCode::create_rule -> add_simple_rule(...,"indep")."""
+        rule_id = self._next_rule_id
+        self._next_rule_id += 1
+        self.rules[name] = {"id": rule_id, "mode": mode, "root": self.root_id}
+        return rule_id
+
+    # -- the mapper ----------------------------------------------------------
+
+    def do_rule(self, rule_name: str, x: int, num_rep: int, weights: Dict[int, float]) -> List[int]:
+        """Map input x (PG seed) to num_rep devices.
+
+        indep mode (EC): each position r draws independently with bounded
+        retries; an unplaceable position stays CRUSH_ITEM_NONE — holes are
+        holes (mapper.c:630 crush_choose_indep).
+        firstn mode (replication): sequential distinct choices."""
+        rule = self.rules.get(rule_name, {"mode": "indep"})
+        root = self.buckets[self.root_id]
+        # overlay current reweights (out = weight 0)
+        saved = dict(root.weights)
+        for item, w in weights.items():
+            if item in root.weights:
+                root.weights[item] = w
+        try:
+            if rule.get("mode") == "firstn":
+                out: List[int] = []
+                exclude: set = set()
+                for r in range(num_rep * 4):
+                    c = root.straw2_choose(x, r, exclude)
+                    if c is None:
+                        break
+                    exclude.add(c)
+                    out.append(c)
+                    if len(out) == num_rep:
+                        break
+                return out
+            # indep: stable positions with per-position retry sequence
+            out = [CRUSH_ITEM_NONE] * num_rep
+            taken: set = set()
+            for r in range(num_rep):
+                for attempt in range(51):  # choose_total_tries-ish bound
+                    c = root.straw2_choose(x, r + attempt * num_rep, taken)
+                    if c is None:
+                        break
+                    if c not in taken:
+                        taken.add(c)
+                        out[r] = c
+                        break
+            return out
+        finally:
+            root.weights = saved

@@ -1,0 +1,172 @@
+"""Monitor: cluster-map authority (maps only — never on the data path).
+
+Role-equivalent of the reference's mon (reference src/mon/Monitor.h:108,
+OSDMonitor): allocates OSD ids at boot, tracks liveness from heartbeats and
+marks laggards down (failure detection, SURVEY.md §5.3), owns pool/EC-profile
+lifecycle — profiles are validated by instantiating the codec through the
+plugin registry exactly like OSDMonitor::normalize_profile
+(OSDMonitor.cc:7329), and stripe_width is computed from the codec's own
+chunk-size rule (prepare_pool_stripe_width, OSDMonitor.cc:7628) — and bumps
+the epoch on every change.  Single monitor: the reference's Paxos quorum is
+out of scope for this slice (documented gap; the map-distribution protocol
+is the part the data path depends on).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, Optional, Tuple
+
+from ceph_tpu.ec.interface import ErasureCodeError
+from ceph_tpu.ec.registry import registry
+from ceph_tpu.rados.crush import CrushMap
+from ceph_tpu.rados.messenger import Messenger
+from ceph_tpu.rados.types import (
+    MBootReply,
+    MCreatePool,
+    MCreatePoolReply,
+    MGetMap,
+    MMapReply,
+    MMarkDown,
+    MOsdBoot,
+    MPing,
+    OSDMap,
+    OsdInfo,
+    PoolInfo,
+)
+
+DEFAULT_STRIPE_UNIT = 4096  # reference osd_pool_erasure_code_stripe_unit
+
+
+class Monitor:
+    def __init__(self, conf: Optional[dict] = None):
+        self.conf = conf or {}
+        self.messenger = Messenger("mon", self.conf)
+        self.osdmap = OSDMap(epoch=1, crush=CrushMap.flat([]))
+        self._next_osd_id = 0
+        self._next_pool_id = 1
+        self._last_ping: Dict[int, float] = {}
+        self._grace = self.conf.get("mon_osd_report_grace", 1.5)
+        self._tick_task: Optional[asyncio.Task] = None
+        self.addr: Optional[Tuple[str, int]] = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
+        self.messenger.dispatcher = self._dispatch
+        self.addr = await self.messenger.bind(host, port)
+        self._tick_task = asyncio.get_running_loop().create_task(self._tick())
+        return self.addr
+
+    async def stop(self) -> None:
+        if self._tick_task:
+            self._tick_task.cancel()
+        await self.messenger.shutdown()
+
+    def _bump(self) -> None:
+        self.osdmap.epoch += 1
+
+    # -- liveness ------------------------------------------------------------
+
+    async def _tick(self) -> None:
+        while True:
+            await asyncio.sleep(self._grace / 3)
+            now = time.monotonic()
+            changed = False
+            for osd_id, info in self.osdmap.osds.items():
+                if info.up and now - self._last_ping.get(osd_id, now) > self._grace:
+                    info.up = False
+                    info.in_cluster = False  # auto-out for remap (mon_osd_down_out)
+                    changed = True
+            if changed:
+                self._bump()
+
+    # -- dispatch ------------------------------------------------------------
+
+    async def _dispatch(self, conn, msg) -> None:
+        if isinstance(msg, MGetMap):
+            await conn.send(MMapReply(osdmap=self.osdmap))
+        elif isinstance(msg, MOsdBoot):
+            osd_id = msg.osd_id
+            if osd_id < 0:
+                osd_id = self._next_osd_id
+                self._next_osd_id += 1
+            info = self.osdmap.osds.get(osd_id)
+            if info is None:
+                self.osdmap.osds[osd_id] = OsdInfo(osd_id=osd_id, addr=tuple(msg.addr))
+                self.osdmap.crush = CrushMap.flat(sorted(self.osdmap.osds))
+                # re-register rules on the rebuilt map, preserving each
+                # pool's placement mode (indep for EC, firstn for replicated)
+                for pool in self.osdmap.pools.values():
+                    self.osdmap.crush.add_simple_rule(
+                        pool.rule,
+                        mode="indep" if pool.pool_type == "ec" else "firstn",
+                    )
+            else:
+                info.addr = tuple(msg.addr)
+                info.up = True
+                info.in_cluster = True
+            self._last_ping[osd_id] = time.monotonic()
+            self._bump()
+            await conn.send(MBootReply(osd_id=osd_id, osdmap=self.osdmap))
+        elif isinstance(msg, MPing):
+            self._last_ping[msg.osd_id] = time.monotonic()
+            info = self.osdmap.osds.get(msg.osd_id)
+            if info is not None and not info.up:
+                info.up = True
+                info.in_cluster = True
+                self._bump()
+            if msg.epoch < self.osdmap.epoch:
+                await conn.send(MMapReply(osdmap=self.osdmap))
+        elif isinstance(msg, MMarkDown):
+            info = self.osdmap.osds.get(msg.osd_id)
+            if info is not None and info.up:
+                info.up = False
+                info.in_cluster = False
+                self._last_ping[msg.osd_id] = -1e9
+                self._bump()
+            await conn.send(MMapReply(osdmap=self.osdmap))
+        elif isinstance(msg, MCreatePool):
+            await conn.send(self._create_pool(msg))
+
+    # -- pool / profile lifecycle -------------------------------------------
+
+    def _create_pool(self, msg: MCreatePool) -> MCreatePoolReply:
+        if self.osdmap.pool_by_name(msg.name) is not None:
+            return MCreatePoolReply(ok=False, error=f"pool {msg.name} exists")
+        profile = dict(msg.profile)
+        if msg.pool_type == "ec":
+            plugin = profile.get("plugin", "jerasure")
+            try:
+                # normalize_profile: factory+init round-trip validates and
+                # completes the profile (defaults filled by the codec)
+                codec = registry.factory(plugin, profile.get("directory", ""), profile)
+            except ErasureCodeError as e:
+                return MCreatePoolReply(ok=False, error=str(e))
+            profile = dict(codec.get_profile())
+            k = codec.get_data_chunk_count()
+            size = codec.get_chunk_count()
+            min_size = min(size, k + 1)
+            stripe_width = k * codec.get_chunk_size(k * DEFAULT_STRIPE_UNIT)
+        else:
+            size = int(profile.get("size", "3"))
+            min_size = max(1, size // 2 + 1)
+            stripe_width = 0
+        pool_id = self._next_pool_id
+        self._next_pool_id += 1
+        rule = f"{msg.name}-rule"
+        self.osdmap.crush.add_simple_rule(
+            rule, mode="indep" if msg.pool_type == "ec" else "firstn"
+        )
+        self.osdmap.pools[pool_id] = PoolInfo(
+            pool_id=pool_id,
+            name=msg.name,
+            pool_type=msg.pool_type,
+            pg_num=msg.pg_num,
+            size=size,
+            min_size=min_size,
+            profile=profile,
+            rule=rule,
+            stripe_width=stripe_width,
+        )
+        self._bump()
+        return MCreatePoolReply(ok=True, pool_id=pool_id)

@@ -1,0 +1,582 @@
+"""OSD daemon: the EC data plane.
+
+Role-equivalent of the reference's OSD + ECBackend (reference
+src/osd/OSD.cc, src/osd/ECBackend.cc): boots against the mon, heartbeats,
+and for PGs where it is primary drives the EC pipeline in the reference's
+order — submit -> write plan -> encode -> per-shard fan-out -> commit
+gather -> client ack (ECBackend.cc:1525 -> 1889 -> 1989 -> 2159) — with the
+TPU twist that encode/decode ride the pool codec's device dispatch (and the
+codec's batching, plugin=tpu).  Degraded reads reconstruct transparently
+(objects_read_and_reconstruct, ECBackend.cc:2401); recovery re-creates
+missing shards on the current acting set and pushes them (RecoveryOp
+IDLE->READING->WRITING, ECBackend.cc:590-745).
+
+Divergences from the reference, by design of the slice: no PG log/peering
+state machine yet (repair is list-diff driven, one in-flight write per
+object version), single-stripe objects (the full ECUtil stripe cache is
+round-2 work).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ceph_tpu.ec.interface import ErasureCodeError
+from ceph_tpu.ec.registry import registry
+from ceph_tpu.rados.crush import CRUSH_ITEM_NONE
+from ceph_tpu.rados.messenger import Messenger
+from ceph_tpu.rados.store import MemStore, ObjectStore, ShardMeta, Transaction, shard_crc
+from ceph_tpu.rados.types import (
+    MBootReply,
+    MECSubDelete,
+    MECSubRead,
+    MECSubReadReply,
+    MECSubWrite,
+    MECSubWriteReply,
+    MFetchShards,
+    MFetchShardsReply,
+    MListShards,
+    MListShardsReply,
+    MMapReply,
+    MOSDOp,
+    MOSDOpReply,
+    MOsdBoot,
+    MPing,
+    MPushShard,
+    OSDMap,
+    PoolInfo,
+)
+
+
+class OSD:
+    def __init__(
+        self,
+        mon_addr: Tuple[str, int],
+        store: Optional[ObjectStore] = None,
+        conf: Optional[dict] = None,
+        osd_id: int = -1,
+    ):
+        self.conf = conf or {}
+        self.mon_addr = tuple(mon_addr)
+        self.store = store or MemStore()
+        self.osd_id = osd_id
+        self.messenger = Messenger(f"osd.{osd_id}", self.conf)
+        self.osdmap: Optional[OSDMap] = None
+        self._codecs: Dict[int, object] = {}
+        self._pending: Dict[str, asyncio.Future] = {}
+        self._collectors: Dict[str, Tuple[asyncio.Queue, int]] = {}
+        self._ping_task: Optional[asyncio.Task] = None
+        self._repair_task: Optional[asyncio.Task] = None
+        self.addr: Optional[Tuple[str, int]] = None
+        self._stopped = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> int:
+        self.messenger.dispatcher = self._dispatch
+        self.addr = await self.messenger.bind()
+        boot = MOsdBoot(osd_id=self.osd_id, addr=self.addr)
+        reply = await self._mon_rpc(boot, MBootReply)
+        self.osd_id = reply.osd_id
+        self.messenger.name = f"osd.{self.osd_id}"
+        self.osdmap = reply.osdmap
+        interval = self.conf.get("osd_heartbeat_interval", 0.3)
+        self._ping_task = asyncio.get_running_loop().create_task(self._ping_loop(interval))
+        return self.osd_id
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for t in (self._ping_task, self._repair_task):
+            if t:
+                t.cancel()
+        await self.messenger.shutdown()
+
+    async def _ping_loop(self, interval: float) -> None:
+        while not self._stopped:
+            try:
+                await self.messenger.send(
+                    self.mon_addr,
+                    MPing(osd_id=self.osd_id, epoch=self.osdmap.epoch if self.osdmap else 0),
+                )
+            except Exception:
+                pass
+            await asyncio.sleep(interval)
+
+    async def _mon_rpc(self, msg, reply_type):
+        """Send to mon and wait for the typed reply on the same connection."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        key = f"monrpc-{reply_type.__name__}"
+        self._pending[key] = fut
+        await self.messenger.send(self.mon_addr, msg)
+        return await asyncio.wait_for(fut, timeout=10)
+
+    # -- codecs --------------------------------------------------------------
+
+    def _codec(self, pool: PoolInfo):
+        codec = self._codecs.get(pool.pool_id)
+        if codec is None:
+            profile = dict(pool.profile)
+            codec = registry.factory(
+                profile.get("plugin", "jerasure"), profile.get("directory", ""), profile
+            )
+            self._codecs[pool.pool_id] = codec
+        return codec
+
+    # -- dispatch ------------------------------------------------------------
+
+    async def _dispatch(self, conn, msg) -> None:
+        if isinstance(msg, MMapReply):
+            self._on_map(msg.osdmap)
+            fut = self._pending.pop("monrpc-MMapReply", None)
+            if fut and not fut.done():
+                fut.set_result(msg)
+        elif isinstance(msg, MBootReply):
+            fut = self._pending.pop("monrpc-MBootReply", None)
+            if fut and not fut.done():
+                fut.set_result(msg)
+        elif isinstance(msg, MOSDOp):
+            await self._handle_client_op(conn, msg)
+        elif isinstance(msg, MECSubWrite):
+            await self._handle_sub_write(msg)
+        elif isinstance(msg, MECSubRead):
+            await self._handle_sub_read(msg)
+        elif isinstance(msg, MECSubDelete):
+            await self._handle_sub_delete(msg)
+        elif isinstance(msg, MListShards):
+            await self._handle_list_shards(msg)
+        elif isinstance(msg, MFetchShards):
+            await self._handle_fetch_shards(msg)
+        elif isinstance(msg, MPushShard):
+            self._apply_push(msg)
+        elif isinstance(
+            msg, (MECSubWriteReply, MECSubReadReply, MListShardsReply, MFetchShardsReply)
+        ):
+            q = self._collectors.get(msg.tid)
+            if q is not None:
+                q[0].put_nowait(msg)
+
+    def _on_map(self, osdmap: OSDMap) -> None:
+        old = self.osdmap
+        if old is not None and osdmap.epoch <= old.epoch:
+            return
+        self.osdmap = osdmap
+        # invalidate only codecs whose pool profile actually changed —
+        # plugin=tpu codecs carry jit caches worth keeping across epochs
+        for pool_id in list(self._codecs):
+            new_pool = osdmap.pools.get(pool_id)
+            old_pool = old.pools.get(pool_id) if old else None
+            if new_pool is None or old_pool is None or new_pool.profile != old_pool.profile:
+                self._codecs.pop(pool_id, None)
+        if self.conf.get("osd_auto_repair", True):
+            if self._repair_task is None or self._repair_task.done():
+                self._repair_task = asyncio.get_running_loop().create_task(
+                    self._delayed_repair()
+                )
+
+    async def _delayed_repair(self) -> None:
+        await asyncio.sleep(self.conf.get("osd_repair_delay", 0.5))
+        try:
+            for pool in list(self.osdmap.pools.values()):
+                if pool.pool_type == "ec":
+                    await self.repair_pool(pool)
+        except Exception:
+            pass
+
+    # -- sub-op RPC plumbing -------------------------------------------------
+
+    def _collector(self, tid: str, expected: int) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue()
+        self._collectors[tid] = (q, expected)
+        return q
+
+    async def _gather(self, tid: str, q: asyncio.Queue, expected: int, timeout: float = 5.0):
+        out = []
+        try:
+            for _ in range(expected):
+                out.append(await asyncio.wait_for(q.get(), timeout=timeout))
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            self._collectors.pop(tid, None)
+        return out
+
+    # -- client ops (primary) ------------------------------------------------
+
+    async def _handle_client_op(self, conn, op: MOSDOp) -> None:
+        try:
+            if op.op == "write":
+                reply = await self._do_write(op)
+            elif op.op == "read":
+                reply = await self._do_read(op)
+            elif op.op == "delete":
+                reply = await self._do_delete(op)
+            elif op.op == "list":
+                oids = sorted({oid for oid, _ in self.store.list_objects(op.pool_id)})
+                reply = MOSDOpReply(ok=True, oids=oids)
+            elif op.op == "repair":
+                pool = self.osdmap.pools.get(op.pool_id)
+                if pool is not None:
+                    await self.repair_pool(pool)
+                reply = MOSDOpReply(ok=True)
+            else:
+                reply = MOSDOpReply(ok=False, error=f"bad op {op.op}")
+        except ErasureCodeError as e:
+            reply = MOSDOpReply(ok=False, error=f"ec error: {e}")
+        except Exception as e:
+            reply = MOSDOpReply(ok=False, error=f"{type(e).__name__}: {e}")
+        reply.reqid = op.reqid
+        try:
+            await conn.send(reply)
+        except ConnectionError:
+            pass
+
+    def _acting(self, pool: PoolInfo, oid: str) -> Tuple[int, List[int]]:
+        pg = self.osdmap.object_to_pg(pool, oid)
+        return pg, self.osdmap.pg_to_acting(pool, pg)
+
+    async def _do_write(self, op: MOSDOp) -> MOSDOpReply:
+        pool = self.osdmap.pools[op.pool_id]
+        codec = self._codec(pool)
+        pg, acting = self._acting(pool, op.oid)
+        if self.osdmap.primary_of(acting) != self.osd_id:
+            return MOSDOpReply(ok=False, error="not primary")
+        live = [a for a in acting if a != CRUSH_ITEM_NONE]
+        if len(live) < pool.min_size:
+            return MOSDOpReply(
+                ok=False,
+                error=f"degraded below min_size ({len(live)}/{pool.min_size})",
+            )
+        n = codec.get_chunk_count()
+        encoded = codec.encode(set(range(n)), op.data)
+        version = time.time_ns()
+        tid = uuid.uuid4().hex
+        remote: List[Tuple[int, int]] = []  # (shard, osd)
+        for shard, osd in enumerate(acting):
+            if osd == CRUSH_ITEM_NONE:
+                continue
+            chunk = bytes(encoded[shard])
+            if osd == self.osd_id:
+                self._apply_shard_write(
+                    op.pool_id, op.oid, shard, chunk, version, len(op.data)
+                )
+            else:
+                remote.append((shard, osd))
+        q = self._collector(tid, len(remote))
+        sent = 0
+        for shard, osd in remote:
+            chunk = bytes(encoded[shard])
+            msg = MECSubWrite(
+                pool_id=op.pool_id, pg=pg, oid=op.oid, shard=shard, chunk=chunk,
+                version=version, object_size=len(op.data),
+                chunk_crc=shard_crc(chunk), tid=tid, reply_to=self.addr,
+            )
+            try:
+                await self.messenger.send(self.osdmap.addr_of(osd), msg)
+                sent += 1
+            except Exception:
+                pass  # failed send counts as a missing ack, not a 5s stall
+        replies = await self._gather(tid, q, sent)
+        acks = 1 + sum(1 for r in replies if r.ok)  # self + remote
+        if acks < pool.min_size:
+            return MOSDOpReply(
+                ok=False, error=f"write acked by {acks} < min_size {pool.min_size}"
+            )
+        return MOSDOpReply(ok=True)
+
+    async def _do_read(self, op: MOSDOp) -> MOSDOpReply:
+        pool = self.osdmap.pools[op.pool_id]
+        codec = self._codec(pool)
+        pg, acting = self._acting(pool, op.oid)
+        k = codec.get_data_chunk_count()
+        available = {
+            shard: osd for shard, osd in enumerate(acting) if osd != CRUSH_ITEM_NONE
+        }
+        # ask the codec which shards suffice (subchunk-aware plan)
+        try:
+            plan = codec.minimum_to_decode(set(range(k)), set(available))
+        except ErasureCodeError:
+            return MOSDOpReply(ok=False, error="not enough shards up")
+        tid = uuid.uuid4().hex
+        chunks: Dict[int, bytes] = {}
+        versions: Dict[int, int] = {}
+        sizes: Dict[int, int] = {}
+        remote = []
+        for shard in plan:
+            osd = available[shard]
+            if osd == self.osd_id:
+                got = self.store.read((op.pool_id, op.oid, shard))
+                if got is not None:
+                    chunks[shard] = got[0]
+                    versions[shard] = got[1].version
+                    sizes[shard] = got[1].object_size
+            else:
+                remote.append((shard, osd))
+        q = self._collector(tid, len(remote))
+        sent = 0
+        for shard, osd in remote:
+            msg = MECSubRead(
+                pool_id=op.pool_id, pg=pg, oid=op.oid, shard=shard, tid=tid,
+                reply_to=self.addr,
+            )
+            try:
+                await self.messenger.send(self.osdmap.addr_of(osd), msg)
+                sent += 1
+            except Exception:
+                pass
+        for r in await self._gather(tid, q, sent):
+            if r.ok:
+                chunks[r.shard] = r.chunk
+                versions[r.shard] = r.version
+                sizes[r.shard] = r.object_size
+        # consistent-version cut: only shards at the newest version count
+        newest = max(versions.values()) if versions else -1
+        chunks = {s: c for s, c in chunks.items() if versions[s] == newest}
+        if len(chunks) < k:
+            # shard hunt across ALL up OSDs: shards carry their id, so a
+            # degraded read survives placement drift between failure and
+            # recovery (send_all_remaining_reads + missing-set role)
+            hunted = await self._fetch_all_shards(op.pool_id, op.oid)
+            if hunted:
+                hunted_newest = max(v for (_, _, v, _) in hunted)
+                if hunted_newest > newest:
+                    newest = hunted_newest
+                    chunks = {}
+                for shard, chunk, version, osize in hunted:
+                    if version == newest and shard not in chunks:
+                        chunks[shard] = chunk
+                        sizes[shard] = osize
+                        versions[shard] = version
+            if not chunks:
+                return MOSDOpReply(ok=False, error="object not found")
+            if len(chunks) < k:
+                return MOSDOpReply(ok=False, error="cannot reconstruct: shards missing")
+        object_size = sizes[max(sizes, key=lambda s: versions.get(s, 0))]
+        arrays = {s: np.frombuffer(c, dtype=np.uint8) for s, c in chunks.items()}
+        data = codec.decode_concat(arrays)
+        return MOSDOpReply(ok=True, data=data[:object_size], version=newest)
+
+    async def _do_delete(self, op: MOSDOp) -> MOSDOpReply:
+        """Delete EVERY shard of the object on every up OSD, not just the
+        current acting positions — stray shards left by placement drift
+        would otherwise resurrect the object through the shard hunt."""
+        pool = self.osdmap.pools[op.pool_id]
+        pg, _ = self._acting(pool, op.oid)
+        n = self._codec(pool).get_chunk_count()
+        tid = uuid.uuid4().hex
+        # local: drop any shard we hold
+        txn = Transaction()
+        for oid, shard in list(self.store.list_objects(op.pool_id)):
+            if oid == op.oid:
+                txn.delete((op.pool_id, op.oid, shard))
+        self.store.queue_transaction(txn)
+        peers = [
+            o for o in self.osdmap.osds.values() if o.up and o.osd_id != self.osd_id
+        ]
+        q = self._collector(tid, len(peers) * n)
+        sent = 0
+        for o in peers:
+            for shard in range(n):
+                try:
+                    await self.messenger.send(
+                        o.addr,
+                        MECSubDelete(pool_id=op.pool_id, pg=pg, oid=op.oid,
+                                     shard=shard, tid=tid, reply_to=self.addr),
+                    )
+                    sent += 1
+                except Exception:
+                    pass
+        await self._gather(tid, q, sent)
+        return MOSDOpReply(ok=True)
+
+    # -- shard side ----------------------------------------------------------
+
+    def _apply_shard_write(
+        self, pool_id: int, oid: str, shard: int, chunk: bytes, version: int,
+        object_size: int,
+    ) -> None:
+        txn = Transaction()
+        txn.write(
+            (pool_id, oid, shard),
+            chunk,
+            ShardMeta(version=version, object_size=object_size, chunk_crc=shard_crc(chunk)),
+        )
+        self.store.queue_transaction(txn)
+
+    async def _handle_sub_write(self, msg: MECSubWrite) -> None:
+        ok = True
+        if msg.chunk_crc and shard_crc(msg.chunk) != msg.chunk_crc:
+            ok = False  # corrupted in flight
+        else:
+            self._apply_shard_write(
+                msg.pool_id, msg.oid, msg.shard, msg.chunk, msg.version, msg.object_size
+            )
+        try:
+            await self.messenger.send(
+                tuple(msg.reply_to), MECSubWriteReply(tid=msg.tid, shard=msg.shard, ok=ok)
+            )
+        except Exception:
+            pass
+
+    async def _handle_sub_read(self, msg: MECSubRead) -> None:
+        got = self.store.read((msg.pool_id, msg.oid, msg.shard))
+        if got is None:
+            reply = MECSubReadReply(tid=msg.tid, shard=msg.shard, ok=False)
+        else:
+            chunk, meta = got
+            reply = MECSubReadReply(
+                tid=msg.tid, shard=msg.shard, ok=True, chunk=chunk,
+                version=meta.version, object_size=meta.object_size,
+            )
+        try:
+            await self.messenger.send(tuple(msg.reply_to), reply)
+        except Exception:
+            pass
+
+    async def _handle_sub_delete(self, msg: MECSubDelete) -> None:
+        txn = Transaction()
+        txn.delete((msg.pool_id, msg.oid, msg.shard))
+        self.store.queue_transaction(txn)
+        try:
+            await self.messenger.send(
+                tuple(msg.reply_to), MECSubWriteReply(tid=msg.tid, shard=msg.shard, ok=True)
+            )
+        except Exception:
+            pass
+
+    async def _fetch_all_shards(self, pool_id: int, oid: str):
+        """Ask every up OSD for any shard of oid it holds; include our own."""
+        out = []
+        for oid2, shard in self.store.list_objects(pool_id):
+            if oid2 == oid:
+                got = self.store.read((pool_id, oid, shard))
+                if got is not None:
+                    out.append((shard, got[0], got[1].version, got[1].object_size))
+        peers = [
+            o for o in self.osdmap.osds.values() if o.up and o.osd_id != self.osd_id
+        ]
+        tid = uuid.uuid4().hex
+        q = self._collector(tid, len(peers))
+        sent = 0
+        for o in peers:
+            try:
+                await self.messenger.send(
+                    o.addr,
+                    MFetchShards(pool_id=pool_id, oid=oid, tid=tid, reply_to=self.addr),
+                )
+                sent += 1
+            except Exception:
+                pass
+        for r in await self._gather(tid, q, sent):
+            out.extend(tuple(s) for s in r.shards)
+        return out
+
+    async def _handle_fetch_shards(self, msg: MFetchShards) -> None:
+        shards = []
+        for oid, shard in self.store.list_objects(msg.pool_id):
+            if oid == msg.oid:
+                got = self.store.read((msg.pool_id, msg.oid, shard))
+                if got is not None:
+                    shards.append((shard, got[0], got[1].version, got[1].object_size))
+        try:
+            await self.messenger.send(
+                tuple(msg.reply_to),
+                MFetchShardsReply(tid=msg.tid, osd_id=self.osd_id, shards=shards),
+            )
+        except Exception:
+            pass
+
+    async def _handle_list_shards(self, msg: MListShards) -> None:
+        entries = []
+        for oid, shard in self.store.list_objects(msg.pool_id):
+            got = self.store.read((msg.pool_id, oid, shard))
+            if got is not None:
+                entries.append((oid, shard, got[1].version))
+        try:
+            await self.messenger.send(
+                tuple(msg.reply_to),
+                MListShardsReply(tid=msg.tid, osd_id=self.osd_id, entries=entries),
+            )
+        except Exception:
+            pass
+
+    def _apply_push(self, msg: MPushShard) -> None:
+        self._apply_shard_write(
+            msg.pool_id, msg.oid, msg.shard, msg.chunk, msg.version, msg.object_size
+        )
+
+    # -- recovery ------------------------------------------------------------
+
+    async def repair_pool(self, pool: PoolInfo) -> int:
+        """Reconstruct and push shards missing from the current acting sets
+        of objects this OSD is primary for.  Returns shards pushed."""
+        codec = self._codec(pool)
+        k = codec.get_data_chunk_count()
+        # union of shard listings from all up OSDs
+        tid = uuid.uuid4().hex
+        peers = [
+            o for o in self.osdmap.osds.values() if o.up and o.osd_id != self.osd_id
+        ]
+        q = self._collector(tid, len(peers))
+        sent = 0
+        for o in peers:
+            try:
+                await self.messenger.send(
+                    o.addr, MListShards(pool_id=pool.pool_id, tid=tid, reply_to=self.addr)
+                )
+                sent += 1
+            except Exception:
+                pass
+        # oid -> {(shard, osd, version)}: versions matter — a stale shard
+        # sitting at its acting position is NOT healthy redundancy
+        holdings: Dict[str, Set[Tuple[int, int, int]]] = {}
+        for oid, shard in self.store.list_objects(pool.pool_id):
+            got = self.store.read((pool.pool_id, oid, shard))
+            if got is not None:
+                holdings.setdefault(oid, set()).add((shard, self.osd_id, got[1].version))
+        for r in await self._gather(tid, q, sent):
+            for oid, shard, version in r.entries:
+                holdings.setdefault(oid, set()).add((shard, r.osd_id, version))
+        pushed = 0
+        for oid, locs in holdings.items():
+            pg, acting = self._acting(pool, oid)
+            if self.osdmap.primary_of(acting) != self.osd_id:
+                continue
+            newest = max(v for (_, _, v) in locs)
+            have = {shard: osd for shard, osd, v in locs if v == newest}
+            missing = [
+                (shard, osd)
+                for shard, osd in enumerate(acting)
+                if osd != CRUSH_ITEM_NONE and have.get(shard) != osd
+            ]
+            if not missing:
+                continue
+            # READING: gather k chunks (degraded-read machinery)
+            read_op = MOSDOp(op="read", pool_id=pool.pool_id, oid=oid)
+            reply = await self._do_read(read_op)
+            if not reply.ok:
+                continue
+            # re-encode at the object's CURRENT version: deterministic encode
+            # makes pushed shards byte-identical to the originals, and the
+            # version stays consistent with surviving shards
+            encoded = codec.encode(set(range(codec.get_chunk_count())), reply.data)
+            version = reply.version
+            for shard, osd in missing:
+                chunk = bytes(encoded[shard])
+                push = MPushShard(
+                    pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard, chunk=chunk,
+                    version=version, object_size=len(reply.data),
+                )
+                if osd == self.osd_id:
+                    self._apply_push(push)
+                else:
+                    try:
+                        await self.messenger.send(self.osdmap.addr_of(osd), push)
+                    except Exception:
+                        continue
+                pushed += 1
+        return pushed

@@ -1,0 +1,126 @@
+"""Object stores: the per-OSD persistence layer.
+
+Equivalent role to the reference's ObjectStore hierarchy (reference
+src/os/ObjectStore.h:229 queue_transactions): atomic transactions over
+(object, shard) -> bytes + metadata, with commit callbacks.  MemStore is
+the RAM store the reference also ships for testing (src/os/memstore/);
+DirStore persists shards as files (a minimal filestore) so OSD restart
+tests survive process death.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+Key = Tuple[int, str, int]  # (pool_id, oid, shard)
+
+
+@dataclass
+class ShardMeta:
+    version: int = 0
+    object_size: int = 0  # original (untrimmed) object length
+    chunk_crc: int = 0  # crc32 of the shard (HashInfo role,
+    # reference src/osd/ECUtil.h:101-160)
+
+
+@dataclass
+class Transaction:
+    """Atomic batch of shard writes/deletes."""
+
+    writes: List[Tuple[Key, bytes, ShardMeta]] = field(default_factory=list)
+    deletes: List[Key] = field(default_factory=list)
+
+    def write(self, key: Key, chunk: bytes, meta: ShardMeta) -> None:
+        self.writes.append((key, chunk, meta))
+
+    def delete(self, key: Key) -> None:
+        self.deletes.append(key)
+
+
+class ObjectStore:
+    def queue_transaction(self, txn: Transaction) -> None:
+        raise NotImplementedError
+
+    def read(self, key: Key) -> Optional[Tuple[bytes, ShardMeta]]:
+        raise NotImplementedError
+
+    def list_objects(self, pool_id: int) -> Iterable[Tuple[str, int]]:
+        """Yield (oid, shard) pairs stored for a pool."""
+        raise NotImplementedError
+
+
+class MemStore(ObjectStore):
+    def __init__(self) -> None:
+        self._data: Dict[Key, Tuple[bytes, ShardMeta]] = {}
+
+    def queue_transaction(self, txn: Transaction) -> None:
+        for key in txn.deletes:
+            self._data.pop(key, None)
+        for key, chunk, meta in txn.writes:
+            self._data[key] = (chunk, meta)
+
+    def read(self, key: Key) -> Optional[Tuple[bytes, ShardMeta]]:
+        return self._data.get(key)
+
+    def list_objects(self, pool_id: int):
+        for (pid, oid, shard) in list(self._data):
+            if pid == pool_id:
+                yield oid, shard
+
+
+class DirStore(ObjectStore):
+    """File-per-shard store with a sidecar json for metadata; writes are
+    tmp+rename atomic."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def _file(self, key: Key) -> str:
+        pid, oid, shard = key
+        safe = oid.replace("/", "_")
+        return os.path.join(self.path, f"{pid}__{safe}__{shard}")
+
+    def queue_transaction(self, txn: Transaction) -> None:
+        for key in txn.deletes:
+            for suffix in ("", ".meta"):
+                try:
+                    os.unlink(self._file(key) + suffix)
+                except FileNotFoundError:
+                    pass
+        for key, chunk, meta in txn.writes:
+            path = self._file(key)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(chunk)
+            os.replace(tmp, path)
+            with open(path + ".meta.tmp", "w") as f:
+                json.dump(meta.__dict__, f)
+            os.replace(path + ".meta.tmp", path + ".meta")
+
+    def read(self, key: Key) -> Optional[Tuple[bytes, ShardMeta]]:
+        path = self._file(key)
+        try:
+            with open(path, "rb") as f:
+                chunk = f.read()
+            with open(path + ".meta") as f:
+                meta = ShardMeta(**json.load(f))
+            return chunk, meta
+        except FileNotFoundError:
+            return None
+
+    def list_objects(self, pool_id: int):
+        prefix = f"{pool_id}__"
+        for name in os.listdir(self.path):
+            if name.startswith(prefix) and not name.endswith((".meta", ".tmp")):
+                _, oid, shard = name.rsplit("__", 2)
+                yield oid, int(shard)
+
+
+def shard_crc(chunk: bytes) -> int:
+    """crc32 of a shard chunk (deep-scrub comparison value)."""
+    return zlib.crc32(chunk) & 0xFFFFFFFF

@@ -1,0 +1,257 @@
+"""Cluster map types and wire messages for the mini-RADOS slice.
+
+OSDMap: the epoch-versioned cluster map every party computes placement from
+(reference src/osd/OSDMap.{h,cc}): OSD states (up/in, address, weight),
+pools (type, pg_num, EC profile), and the crush map.  Placement is
+object -> PG (stable hash) -> acting set (crush indep with holes), as in
+_pg_to_up_acting_osds (OSDMap.cc:2673).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.rados.crush import CRUSH_ITEM_NONE, CrushMap
+from ceph_tpu.rados.messenger import message
+
+
+@dataclass
+class PoolInfo:
+    pool_id: int
+    name: str
+    pool_type: str  # "ec" | "replicated"
+    pg_num: int
+    size: int  # k+m for ec, replica count otherwise
+    min_size: int
+    profile: Dict[str, str] = field(default_factory=dict)
+    rule: str = ""
+    stripe_width: int = 0
+
+
+@dataclass
+class OsdInfo:
+    osd_id: int
+    addr: Tuple[str, int]
+    up: bool = True
+    in_cluster: bool = True
+    weight: float = 1.0
+
+
+@dataclass
+class OSDMap:
+    epoch: int = 0
+    osds: Dict[int, OsdInfo] = field(default_factory=dict)
+    pools: Dict[int, PoolInfo] = field(default_factory=dict)
+    crush: CrushMap = field(default_factory=lambda: CrushMap.flat([]))
+
+    def pool_by_name(self, name: str) -> Optional[PoolInfo]:
+        for p in self.pools.values():
+            if p.name == name:
+                return p
+        return None
+
+    def object_to_pg(self, pool: PoolInfo, oid: str) -> int:
+        h = hashlib.blake2s(oid.encode(), digest_size=4).digest()
+        return int.from_bytes(h, "little") % pool.pg_num
+
+    def pg_to_acting(self, pool: PoolInfo, pg: int) -> List[int]:
+        """Acting set for a PG: crush indep over in+weighted OSDs; up=false
+        members become holes (EC positions are stable; holes stay holes)."""
+        weights = {
+            o.osd_id: (o.weight if o.in_cluster else 0.0) for o in self.osds.values()
+        }
+        x = (pool.pool_id << 20) | pg
+        acting = self.crush.do_rule(pool.rule or "default-ec", x, pool.size, weights)
+        return [
+            a if a != CRUSH_ITEM_NONE and self.osds.get(a) and self.osds[a].up else CRUSH_ITEM_NONE
+            for a in acting
+        ]
+
+    def primary_of(self, acting: List[int]) -> Optional[int]:
+        for a in acting:
+            if a != CRUSH_ITEM_NONE:
+                return a
+        return None
+
+    def addr_of(self, osd_id: int) -> Tuple[str, int]:
+        return self.osds[osd_id].addr
+
+
+# -- wire messages -----------------------------------------------------------
+# Client <-> mon
+
+
+@message(1)
+class MGetMap:
+    min_epoch: int = 0
+
+
+@message(2)
+class MMapReply:
+    osdmap: OSDMap = None
+
+
+@message(3)
+class MOsdBoot:
+    osd_id: int = -1  # -1: allocate
+    addr: Tuple[int, int] = (0, 0)
+
+
+@message(4)
+class MBootReply:
+    osd_id: int = 0
+    osdmap: OSDMap = None
+
+
+@message(5)
+class MCreatePool:
+    name: str = ""
+    pool_type: str = "ec"
+    pg_num: int = 8
+    profile: Dict[str, str] = field(default_factory=dict)
+
+
+@message(6)
+class MCreatePoolReply:
+    ok: bool = True
+    error: str = ""
+    pool_id: int = -1
+
+
+@message(7)
+class MPing:
+    osd_id: int = 0
+    epoch: int = 0
+
+
+@message(8)
+class MMarkDown:
+    osd_id: int = 0
+
+
+# Client <-> primary OSD
+
+
+@message(20)
+class MOSDOp:
+    op: str = "read"  # write | read | delete | list
+    pool_id: int = 0
+    oid: str = ""
+    data: bytes = b""
+    epoch: int = 0
+    reqid: str = ""
+
+
+@message(21)
+class MOSDOpReply:
+    ok: bool = True
+    error: str = ""
+    data: bytes = b""
+    oids: List[str] = field(default_factory=list)
+    reqid: str = ""
+    version: int = 0  # object version the data was read at
+
+
+# Primary OSD <-> shard OSDs (ECSubWrite/ECSubRead equivalents,
+# reference src/osd/ECMsgTypes.h:23,105)
+
+
+@message(30)
+class MECSubWrite:
+    pool_id: int = 0
+    pg: int = 0
+    oid: str = ""
+    shard: int = 0
+    chunk: bytes = b""
+    version: int = 0
+    object_size: int = 0
+    chunk_crc: int = 0
+    tid: str = ""
+    reply_to: Tuple[str, int] = ("", 0)
+
+
+@message(31)
+class MECSubWriteReply:
+    tid: str = ""
+    shard: int = 0
+    ok: bool = True
+
+
+@message(32)
+class MECSubRead:
+    pool_id: int = 0
+    pg: int = 0
+    oid: str = ""
+    shard: int = 0
+    tid: str = ""
+    reply_to: Tuple[str, int] = ("", 0)
+
+
+@message(33)
+class MECSubReadReply:
+    tid: str = ""
+    shard: int = 0
+    ok: bool = True
+    chunk: bytes = b""
+    version: int = 0
+    object_size: int = 0
+
+
+@message(34)
+class MECSubDelete:
+    pool_id: int = 0
+    pg: int = 0
+    oid: str = ""
+    shard: int = 0
+    tid: str = ""
+    reply_to: Tuple[str, int] = ("", 0)
+
+
+@message(35)
+class MPushShard:
+    """Recovery push of a reconstructed shard (reference PushOp)."""
+
+    pool_id: int = 0
+    pg: int = 0
+    oid: str = ""
+    shard: int = 0
+    chunk: bytes = b""
+    version: int = 0
+    object_size: int = 0
+
+
+@message(36)
+class MListShards:
+    pool_id: int = 0
+    tid: str = ""
+    reply_to: Tuple[str, int] = ("", 0)
+
+
+@message(37, version=2)
+class MListShardsReply:
+    tid: str = ""
+    osd_id: int = 0
+    # (oid, shard, version) — versions let repair spot stale shards
+    entries: List[Tuple[str, int, int]] = field(default_factory=list)
+
+
+@message(38)
+class MFetchShards:
+    """Shard hunt: return every shard of oid this OSD holds (degraded reads
+    survive placement drift because shards carry their id — the role the
+    reference's peering/missing-set machinery plays)."""
+
+    pool_id: int = 0
+    oid: str = ""
+    tid: str = ""
+    reply_to: Tuple[str, int] = ("", 0)
+
+
+@message(39)
+class MFetchShardsReply:
+    tid: str = ""
+    osd_id: int = 0
+    # (shard, chunk, version, object_size)
+    shards: List[Tuple[int, bytes, int, int]] = field(default_factory=list)

@@ -1,0 +1,85 @@
+"""vstart: a whole cluster on loopback, in one event loop.
+
+The reference's developer/test workflow (src/vstart.sh and
+qa/standalone/ceph-helpers.sh): real daemon topology — one mon, N OSDs,
+real messenger connections over 127.0.0.1 — sharing only hardware.  Used
+in-process by the integration tests and runnable standalone:
+
+    python -m ceph_tpu.rados.vstart --osds 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+from typing import Dict, List, Optional
+
+from ceph_tpu.rados.client import RadosClient
+from ceph_tpu.rados.mon import Monitor
+from ceph_tpu.rados.osd import OSD
+from ceph_tpu.rados.store import DirStore, MemStore
+
+
+class Cluster:
+    def __init__(self, n_osds: int = 5, conf: Optional[dict] = None,
+                 data_dir: Optional[str] = None):
+        self.conf = conf or {}
+        self.n_osds = n_osds
+        self.data_dir = data_dir
+        self.mon = Monitor(self.conf)
+        self.osds: Dict[int, OSD] = {}
+        self._next_store = 0  # monotonic: store dirs never reused after kills
+
+    async def start(self) -> None:
+        await self.mon.start()
+        for i in range(self.n_osds):
+            await self.add_osd()
+
+    async def add_osd(self) -> OSD:
+        store = (
+            DirStore(f"{self.data_dir}/osd.{self._next_store}")
+            if self.data_dir
+            else MemStore()
+        )
+        self._next_store += 1
+        osd = OSD(self.mon.addr, store=store, conf=self.conf)
+        osd_id = await osd.start()
+        self.osds[osd_id] = osd
+        return osd
+
+    async def kill_osd(self, osd_id: int) -> None:
+        """Hard-stop an OSD (no goodbye) — the thrasher primitive."""
+        osd = self.osds.pop(osd_id, None)
+        if osd is not None:
+            await osd.stop()
+
+    async def client(self) -> RadosClient:
+        c = RadosClient(self.mon.addr, self.conf)
+        await c.start()
+        await c.refresh_map()
+        return c
+
+    async def stop(self) -> None:
+        for osd in list(self.osds.values()):
+            await osd.stop()
+        await self.mon.stop()
+
+
+async def _main(args) -> None:
+    cluster = Cluster(n_osds=args.osds, data_dir=args.data_dir)
+    await cluster.start()
+    print(f"mon at {cluster.mon.addr}; {args.osds} OSDs up. Ctrl-C to stop.")
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await cluster.stop()
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--osds", type=int, default=5)
+    p.add_argument("--data-dir", default=None)
+    asyncio.run(_main(p.parse_args()))
