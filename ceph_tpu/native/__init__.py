@@ -1,0 +1,1 @@
+"""Python bridge to the native C++ EC core (ctypes, no pybind11)."""

@@ -1,0 +1,97 @@
+"""ctypes bridge to libceph_tpu_ec.so.
+
+Loads the native core built from native/ (cmake+ninja or the build()
+helper below compiles it on demand with g++).  Used by tests to assert the
+native GF/RS core is byte-identical to the numpy oracle, and available as
+a fast CPU fallback for the tpu plugin."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE = os.path.join(_REPO, "native")
+_BUILD = os.path.join(_NATIVE, "build")
+_LIB = os.path.join(_BUILD, "libceph_tpu_ec.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build(force: bool = False) -> str:
+    """Compile the native library (idempotent)."""
+    if os.path.exists(_LIB) and not force:
+        return _LIB
+    os.makedirs(_BUILD, exist_ok=True)
+    srcs = [os.path.join(_NATIVE, f) for f in ("gf256.cc", "rs.cc", "registry.cc", "capi.cc")]
+    cmd = [
+        "g++", "-std=c++17", "-O3", "-march=native", "-fPIC", "-shared",
+        "-o", _LIB, *srcs, "-ldl",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return _LIB
+
+
+def lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        _lib = ctypes.CDLL(build())
+        _lib.ceph_tpu_gf_mul.restype = ctypes.c_uint8
+        _lib.ceph_tpu_gf_mul.argtypes = [ctypes.c_uint8, ctypes.c_uint8]
+        _lib.ceph_tpu_rs_encode.restype = ctypes.c_int
+        _lib.ceph_tpu_rs_encode.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        _lib.ceph_tpu_rs_decode.restype = ctypes.c_int
+        _lib.ceph_tpu_rs_decode.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_char_p,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+            ctypes.c_char_p, ctypes.c_size_t,
+        ]
+    return _lib
+
+
+def gf_mul(a: int, b: int) -> int:
+    return lib().ceph_tpu_gf_mul(a, b)
+
+
+def rs_encode(technique: str, data: np.ndarray, m: int) -> np.ndarray:
+    """[k, chunk] uint8 -> [m, chunk] parity via the native core."""
+    k, chunk = data.shape
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    parity = np.zeros((m, chunk), dtype=np.uint8)
+    rc = lib().ceph_tpu_rs_encode(
+        technique.encode(), k, m,
+        data.ctypes.data_as(ctypes.c_char_p),
+        parity.ctypes.data_as(ctypes.c_char_p), chunk,
+    )
+    if rc != 0:
+        raise RuntimeError(f"native encode failed ({rc})")
+    return parity
+
+
+def rs_decode(
+    technique: str, k: int, m: int, sources: Sequence[int],
+    source_data: np.ndarray, targets: Sequence[int],
+) -> np.ndarray:
+    """Reconstruct `targets` chunks from k source chunks [k, chunk]."""
+    chunk = source_data.shape[1]
+    source_data = np.ascontiguousarray(source_data, dtype=np.uint8)
+    out = np.zeros((len(targets), chunk), dtype=np.uint8)
+    src = (ctypes.c_int * k)(*sources)
+    tgt = (ctypes.c_int * len(targets))(*targets)
+    rc = lib().ceph_tpu_rs_decode(
+        technique.encode(), k, m, src,
+        source_data.ctypes.data_as(ctypes.c_char_p),
+        len(targets), tgt,
+        out.ctypes.data_as(ctypes.c_char_p), chunk,
+    )
+    if rc != 0:
+        raise RuntimeError(f"native decode failed ({rc})")
+    return out

@@ -38,6 +38,9 @@ class RadosClient:
         self.osdmap: Optional[OSDMap] = None
         self._replies: Dict[str, asyncio.Future] = {}
         self._mon_fut: Optional[asyncio.Future] = None
+        # serialize mon RPCs: _mon_fut is a single slot, and concurrent ops
+        # retrying through refresh_map() must not clobber each other
+        self._mon_lock = asyncio.Lock()
 
     async def start(self) -> None:
         self.messenger.dispatcher = self._dispatch
@@ -56,9 +59,10 @@ class RadosClient:
                 fut.set_result(msg)
 
     async def _mon_rpc(self, msg):
-        self._mon_fut = asyncio.get_running_loop().create_future()
-        await self.messenger.send(self.mon_addr, msg)
-        return await asyncio.wait_for(self._mon_fut, timeout=10)
+        async with self._mon_lock:
+            self._mon_fut = asyncio.get_running_loop().create_future()
+            await self.messenger.send(self.mon_addr, msg)
+            return await asyncio.wait_for(self._mon_fut, timeout=10)
 
     async def refresh_map(self) -> OSDMap:
         reply = await self._mon_rpc(MGetMap())
@@ -114,7 +118,10 @@ class RadosClient:
                 finally:
                     self._replies.pop(op.reqid, None)
             await asyncio.sleep(0.3 * (attempt + 1))
-            await self.refresh_map()
+            try:
+                await self.refresh_map()
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                last_error = f"map refresh failed: {type(e).__name__}"
         raise RadosError(f"op {op.op} {op.oid} failed: {last_error}")
 
     async def put(self, pool_id: int, oid: str, data: bytes) -> None:

@@ -119,18 +119,15 @@ class CrushMap:
                     if len(out) == num_rep:
                         break
                 return out
-            # indep: stable positions with per-position retry sequence
+            # indep: one draw per position; straw2_choose already excludes
+            # taken items, so an unplaceable position stays a hole
             out = [CRUSH_ITEM_NONE] * num_rep
             taken: set = set()
             for r in range(num_rep):
-                for attempt in range(51):  # choose_total_tries-ish bound
-                    c = root.straw2_choose(x, r + attempt * num_rep, taken)
-                    if c is None:
-                        break
-                    if c not in taken:
-                        taken.add(c)
-                        out[r] = c
-                        break
+                c = root.straw2_choose(x, r, taken)
+                if c is not None:
+                    taken.add(c)
+                    out[r] = c
             return out
         finally:
             root.weights = saved

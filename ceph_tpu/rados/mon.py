@@ -131,6 +131,14 @@ class Monitor:
     # -- pool / profile lifecycle -------------------------------------------
 
     def _create_pool(self, msg: MCreatePool) -> MCreatePoolReply:
+        try:
+            return self._create_pool_inner(msg)
+        except Exception as e:
+            # a bad profile value must become an error reply, not a dead
+            # mon connection (the serve loop only absorbs ConnectionError)
+            return MCreatePoolReply(ok=False, error=f"{type(e).__name__}: {e}")
+
+    def _create_pool_inner(self, msg: MCreatePool) -> MCreatePoolReply:
         if self.osdmap.pool_by_name(msg.name) is not None:
             return MCreatePoolReply(ok=False, error=f"pool {msg.name} exists")
         profile = dict(msg.profile)

@@ -69,7 +69,7 @@ class OSD:
         self.osdmap: Optional[OSDMap] = None
         self._codecs: Dict[int, object] = {}
         self._pending: Dict[str, asyncio.Future] = {}
-        self._collectors: Dict[str, Tuple[asyncio.Queue, int]] = {}
+        self._collectors: Dict[str, asyncio.Queue] = {}
         self._ping_task: Optional[asyncio.Task] = None
         self._repair_task: Optional[asyncio.Task] = None
         self.addr: Optional[Tuple[str, int]] = None
@@ -158,7 +158,7 @@ class OSD:
         ):
             q = self._collectors.get(msg.tid)
             if q is not None:
-                q[0].put_nowait(msg)
+                q.put_nowait(msg)
 
     def _on_map(self, osdmap: OSDMap) -> None:
         old = self.osdmap
@@ -189,9 +189,9 @@ class OSD:
 
     # -- sub-op RPC plumbing -------------------------------------------------
 
-    def _collector(self, tid: str, expected: int) -> asyncio.Queue:
+    def _collector(self, tid: str) -> asyncio.Queue:
         q: asyncio.Queue = asyncio.Queue()
-        self._collectors[tid] = (q, expected)
+        self._collectors[tid] = q
         return q
 
     async def _gather(self, tid: str, q: asyncio.Queue, expected: int, timeout: float = 5.0):
@@ -266,7 +266,7 @@ class OSD:
                 )
             else:
                 remote.append((shard, osd))
-        q = self._collector(tid, len(remote))
+        q = self._collector(tid)
         sent = 0
         for shard, osd in remote:
             chunk = bytes(encoded[shard])
@@ -316,7 +316,7 @@ class OSD:
                     sizes[shard] = got[1].object_size
             else:
                 remote.append((shard, osd))
-        q = self._collector(tid, len(remote))
+        q = self._collector(tid)
         sent = 0
         for shard, osd in remote:
             msg = MECSubRead(
@@ -366,7 +366,6 @@ class OSD:
         would otherwise resurrect the object through the shard hunt."""
         pool = self.osdmap.pools[op.pool_id]
         pg, _ = self._acting(pool, op.oid)
-        n = self._codec(pool).get_chunk_count()
         tid = uuid.uuid4().hex
         # local: drop any shard we hold
         txn = Transaction()
@@ -377,19 +376,19 @@ class OSD:
         peers = [
             o for o in self.osdmap.osds.values() if o.up and o.osd_id != self.osd_id
         ]
-        q = self._collector(tid, len(peers) * n)
+        q = self._collector(tid)
         sent = 0
         for o in peers:
-            for shard in range(n):
-                try:
-                    await self.messenger.send(
-                        o.addr,
-                        MECSubDelete(pool_id=op.pool_id, pg=pg, oid=op.oid,
-                                     shard=shard, tid=tid, reply_to=self.addr),
-                    )
-                    sent += 1
-                except Exception:
-                    pass
+            try:
+                # shard=-1: drop every shard of the oid (one message per peer)
+                await self.messenger.send(
+                    o.addr,
+                    MECSubDelete(pool_id=op.pool_id, pg=pg, oid=op.oid,
+                                 shard=-1, tid=tid, reply_to=self.addr),
+                )
+                sent += 1
+            except Exception:
+                pass
         await self._gather(tid, q, sent)
         return MOSDOpReply(ok=True)
 
@@ -439,7 +438,12 @@ class OSD:
 
     async def _handle_sub_delete(self, msg: MECSubDelete) -> None:
         txn = Transaction()
-        txn.delete((msg.pool_id, msg.oid, msg.shard))
+        if msg.shard < 0:  # whole-object delete
+            for oid, shard in list(self.store.list_objects(msg.pool_id)):
+                if oid == msg.oid:
+                    txn.delete((msg.pool_id, msg.oid, shard))
+        else:
+            txn.delete((msg.pool_id, msg.oid, msg.shard))
         self.store.queue_transaction(txn)
         try:
             await self.messenger.send(
@@ -460,7 +464,7 @@ class OSD:
             o for o in self.osdmap.osds.values() if o.up and o.osd_id != self.osd_id
         ]
         tid = uuid.uuid4().hex
-        q = self._collector(tid, len(peers))
+        q = self._collector(tid)
         sent = 0
         for o in peers:
             try:
@@ -521,7 +525,7 @@ class OSD:
         peers = [
             o for o in self.osdmap.osds.values() if o.up and o.osd_id != self.osd_id
         ]
-        q = self._collector(tid, len(peers))
+        q = self._collector(tid)
         sent = 0
         for o in peers:
             try:

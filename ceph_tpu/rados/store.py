@@ -81,9 +81,10 @@ class DirStore(ObjectStore):
         os.makedirs(path, exist_ok=True)
 
     def _file(self, key: Key) -> str:
+        # hex-encode the oid: filenames stay unambiguous for ANY oid bytes
+        # (slashes, '__', unicode) and list parsing can invert exactly
         pid, oid, shard = key
-        safe = oid.replace("/", "_")
-        return os.path.join(self.path, f"{pid}__{safe}__{shard}")
+        return os.path.join(self.path, f"{pid}__{oid.encode().hex()}__{shard}")
 
     def queue_transaction(self, txn: Transaction) -> None:
         for key in txn.deletes:
@@ -117,8 +118,8 @@ class DirStore(ObjectStore):
         prefix = f"{pool_id}__"
         for name in os.listdir(self.path):
             if name.startswith(prefix) and not name.endswith((".meta", ".tmp")):
-                _, oid, shard = name.rsplit("__", 2)
-                yield oid, int(shard)
+                _, oid_hex, shard = name.rsplit("__", 2)
+                yield bytes.fromhex(oid_hex).decode(), int(shard)
 
 
 def shard_crc(chunk: bytes) -> int:

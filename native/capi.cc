@@ -1,0 +1,76 @@
+// Flat C API for the Python ctypes bridge (ceph_tpu/native/bridge.py):
+// byte-exactness cross-checks between the native core and the numpy
+// oracle, and a fast CPU fallback path for the tpu plugin.
+
+#include <cstring>
+#include <vector>
+
+#include "ec_api.h"
+#include "gf256.h"
+#include "rs.h"
+
+using namespace ceph_tpu;
+
+extern "C" {
+
+// flat GF ops (table cross-check)
+uint8_t ceph_tpu_gf_mul(uint8_t a, uint8_t b) {
+  return GF256::instance().mul(a, b);
+}
+
+// contiguous-buffer encode: data is k*chunk bytes, parity out m*chunk
+int ceph_tpu_rs_encode(const char* technique, int k, int m,
+                       const uint8_t* data, uint8_t* parity, size_t chunk) {
+  try {
+    Matrix coding;
+    std::string t = technique;
+    if (t == "reed_sol_van") coding = vandermonde_coding_matrix(k, m);
+    else if (t == "reed_sol_r6_op") coding = r6_coding_matrix(k);
+    else if (t == "cauchy_orig") coding = cauchy_orig_matrix(k, m);
+    else if (t == "isa_reed_sol_van") coding = isa_vandermonde_matrix(k, m);
+    else if (t == "isa_cauchy") coding = isa_cauchy_matrix(k, m);
+    else return -22;
+    RSCodec rs(k, m, std::move(coding));
+    std::vector<const uint8_t*> dptr(k);
+    std::vector<uint8_t*> pptr(m);
+    for (int i = 0; i < k; ++i) dptr[i] = data + static_cast<size_t>(i) * chunk;
+    for (int i = 0; i < m; ++i) pptr[i] = parity + static_cast<size_t>(i) * chunk;
+    rs.encode(dptr.data(), pptr.data(), chunk);
+    return 0;
+  } catch (...) {
+    return -22;
+  }
+}
+
+// decode: sources = k global ids; source_data k*chunk contiguous;
+// targets = ntargets ids; out ntargets*chunk
+int ceph_tpu_rs_decode(const char* technique, int k, int m,
+                       const int* sources, const uint8_t* source_data,
+                       int ntargets, const int* targets, uint8_t* out,
+                       size_t chunk) {
+  try {
+    Matrix coding;
+    std::string t = technique;
+    if (t == "reed_sol_van") coding = vandermonde_coding_matrix(k, m);
+    else if (t == "reed_sol_r6_op") coding = r6_coding_matrix(k);
+    else if (t == "cauchy_orig") coding = cauchy_orig_matrix(k, m);
+    else if (t == "isa_reed_sol_van") coding = isa_vandermonde_matrix(k, m);
+    else if (t == "isa_cauchy") coding = isa_cauchy_matrix(k, m);
+    else return -22;
+    RSCodec rs(k, m, std::move(coding));
+    std::vector<int> src(sources, sources + k);
+    std::vector<int> tgt(targets, targets + ntargets);
+    std::vector<const uint8_t*> sptr(k);
+    std::vector<uint8_t*> optr(ntargets);
+    for (int i = 0; i < k; ++i)
+      sptr[i] = source_data + static_cast<size_t>(i) * chunk;
+    for (int i = 0; i < ntargets; ++i)
+      optr[i] = out + static_cast<size_t>(i) * chunk;
+    rs.decode(src, sptr.data(), tgt, optr.data(), chunk);
+    return 0;
+  } catch (...) {
+    return -5;
+  }
+}
+
+}  // extern "C"

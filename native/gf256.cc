@@ -1,0 +1,74 @@
+#include "gf256.h"
+
+namespace ceph_tpu {
+
+static constexpr int kPoly = 0x11D;
+
+GF256::GF256() {
+  int x = 1;
+  for (int i = 0; i < 255; ++i) {
+    log_[x] = i;
+    antilog_[i] = static_cast<uint8_t>(x);
+    x <<= 1;
+    if (x & 0x100) x ^= kPoly;
+  }
+  for (int i = 255; i < 510; ++i) antilog_[i] = antilog_[i - 255];
+  log_[0] = -1;
+  for (int c = 0; c < 256; ++c) {
+    for (int v = 0; v < 16; ++v) {
+      nib_[c][0][v] = mul(static_cast<uint8_t>(c), static_cast<uint8_t>(v));
+      nib_[c][1][v] = mul(static_cast<uint8_t>(c), static_cast<uint8_t>(v << 4));
+    }
+  }
+}
+
+const GF256& GF256::instance() {
+  static GF256 gf;
+  return gf;
+}
+
+uint8_t GF256::div(uint8_t a, uint8_t b) const {
+  if (a == 0) return 0;
+  return antilog_[log_[a] - log_[b] + 255];
+}
+
+uint8_t GF256::pow(uint8_t a, unsigned n) const {
+  if (n == 0) return 1;
+  if (a == 0) return 0;
+  return antilog_[(static_cast<unsigned>(log_[a]) * n) % 255];
+}
+
+void GF256::mul_region_xor(uint8_t c, const uint8_t* src, uint8_t* dst,
+                           size_t len) const {
+  if (c == 0) return;
+  if (c == 1) {
+    for (size_t i = 0; i < len; ++i) dst[i] ^= src[i];
+    return;
+  }
+  const uint8_t* lo = nib_[c][0];
+  const uint8_t* hi = nib_[c][1];
+  for (size_t i = 0; i < len; ++i) {
+    uint8_t v = src[i];
+    dst[i] ^= static_cast<uint8_t>(lo[v & 0xF] ^ hi[v >> 4]);
+  }
+}
+
+void GF256::mul_region(uint8_t c, const uint8_t* src, uint8_t* dst,
+                       size_t len) const {
+  if (c == 0) {
+    for (size_t i = 0; i < len; ++i) dst[i] = 0;
+    return;
+  }
+  if (c == 1) {
+    for (size_t i = 0; i < len; ++i) dst[i] = src[i];
+    return;
+  }
+  const uint8_t* lo = nib_[c][0];
+  const uint8_t* hi = nib_[c][1];
+  for (size_t i = 0; i < len; ++i) {
+    uint8_t v = src[i];
+    dst[i] = static_cast<uint8_t>(lo[v & 0xF] ^ hi[v >> 4]);
+  }
+}
+
+}  // namespace ceph_tpu

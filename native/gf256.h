@@ -1,0 +1,47 @@
+// GF(2^8) arithmetic over the 0x11D field — the native CPU core.
+//
+// Same field and table discipline as the reference's jerasure/gf-complete
+// stack (galois_init_default_field w=8, poly 0435 octal = 0x11D); region
+// multiply uses split hi/lo-nibble tables, the layout both isa-l's pshufb
+// kernels and gf-complete's SPLIT_TABLE(8,4) use, which the compiler can
+// auto-vectorize with -O3 -mavx2.
+//
+// This library is the byte-exactness oracle's native twin: the Python
+// numpy oracle (ceph_tpu/ec/gf.py) and this file must agree bit-for-bit
+// (asserted by tests/test_native.py through the ctypes bridge).
+
+#pragma once
+
+#include <cstdint>
+#include <cstddef>
+
+namespace ceph_tpu {
+
+class GF256 {
+ public:
+  static const GF256& instance();
+
+  uint8_t mul(uint8_t a, uint8_t b) const {
+    if (a == 0 || b == 0) return 0;
+    return antilog_[log_[a] + log_[b]];
+  }
+  uint8_t div(uint8_t a, uint8_t b) const;  // b != 0
+  uint8_t inv(uint8_t a) const { return div(1, a); }
+  uint8_t pow(uint8_t a, unsigned n) const;
+
+  // dst[i] ^= c * src[i] over len bytes (the region kernel)
+  void mul_region_xor(uint8_t c, const uint8_t* src, uint8_t* dst,
+                      size_t len) const;
+  // dst[i] = c * src[i]
+  void mul_region(uint8_t c, const uint8_t* src, uint8_t* dst,
+                  size_t len) const;
+
+ private:
+  GF256();
+  int log_[256];
+  uint8_t antilog_[512];
+  // split nibble tables: nib_[c][0][x] = c*x, nib_[c][1][x] = c*(x<<4)
+  uint8_t nib_[256][2][16];
+};
+
+}  // namespace ceph_tpu

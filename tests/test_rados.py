@@ -59,7 +59,7 @@ def test_put_get_roundtrip():
         with pytest.raises(RadosError):
             await client.get(pool, "obj-1")
 
-    run(5, test_body := body)
+    run(5, body)
 
 
 def test_profile_validation_at_pool_create():
