@@ -202,6 +202,95 @@ def isa_cauchy_matrix(k: int, m: int, w: int = 8) -> np.ndarray:
     return matrix
 
 
+def is_prime(value: int) -> bool:
+    """Primality over the reference's supported w range (reference
+    ErasureCodeJerasure.cc:140-153 uses a table of the first 55 primes; any
+    valid w fits well inside trial division)."""
+    if value < 2:
+        return False
+    d = 2
+    while d * d <= value:
+        if value % d == 0:
+            return False
+        d += 1
+    return True
+
+
+def liberation_bitmatrix(k: int, w: int) -> np.ndarray:
+    """Liberation RAID-6 bit-matrix (m=2, w prime, k <= w): Plank, "The RAID-6
+    Liberation Codes" (FAST 2008).  Fills the role of jerasure's
+    liberation_coding_bitmatrix (submodule not vendored in the reference
+    snapshot; reconstructed from the published construction, MDS property
+    verified exhaustively in tests).
+
+    Layout [2w, k*w]: P rows are identity blocks (parity = XOR of all data
+    packets in the same bit position); Q block for data chunk j is the cyclic
+    shift-by-j permutation (output bit i reads input bit (i+j) mod w) plus,
+    for j > 0, one extra one at output row i0 = (j*(w-1)/2) mod w, input bit
+    (i0 + j - 1) mod w — the minimal-density bit that makes the code MDS."""
+    if not is_prime(w) or w <= 2:
+        raise ValueError(f"liberation requires prime w > 2, got {w}")
+    if k > w:
+        raise ValueError(f"liberation requires k <= w, got k={k} w={w}")
+    bm = np.zeros((2 * w, k * w), dtype=np.uint8)
+    for j in range(k):
+        for i in range(w):
+            bm[i, j * w + i] = 1  # P: identity block
+            bm[w + i, j * w + (j + i) % w] = 1  # Q: shift-by-j permutation
+        if j > 0:
+            i0 = (j * ((w - 1) // 2)) % w
+            bm[w + i0, j * w + (i0 + j - 1) % w] = 1
+    return bm
+
+
+def blaum_roth_bitmatrix(k: int, w: int) -> np.ndarray:
+    """Blaum-Roth RAID-6 bit-matrix (m=2, w+1 prime, k <= w): codes over the
+    ring R_p = GF(2)[x]/M_p(x), M_p(x) = 1 + x + ... + x^w, p = w + 1 prime
+    (Blaum & Roth, "On Lowest Density MDS Codes", IEEE-IT 1999).  Fills the
+    role of jerasure's blaum_roth_coding_bitmatrix (submodule not vendored).
+
+    In R_p, x^p = 1 and x^w = 1 + x + ... + x^(w-1).  P rows are identity
+    blocks; the Q block for data chunk j is multiply-by-x^j: basis x^t maps
+    to x^((t+j) mod p), where landing on exponent w spreads into every row."""
+    p = w + 1
+    if not is_prime(p) or w <= 2:
+        raise ValueError(f"blaum_roth requires w+1 prime, w > 2, got {w}")
+    if k > w:
+        raise ValueError(f"blaum_roth requires k <= w, got k={k} w={w}")
+    bm = np.zeros((2 * w, k * w), dtype=np.uint8)
+    for j in range(k):
+        for t in range(w):
+            bm[t, j * w + t] = 1  # P: identity block
+            s = (t + j) % p
+            if s < w:
+                bm[w + s, j * w + t] = 1
+            else:  # x^w = 1 + x + ... + x^(w-1)
+                bm[w : 2 * w, j * w + t] ^= 1
+    return bm
+
+
+def liber8tion_bitmatrix(k: int) -> np.ndarray:
+    """w=8, m=2, k <= 8 RAID-6 bit-matrix, the role of jerasure's
+    liber8tion_coding_bitmatrix.
+
+    Documented divergence: the original Liber8tion matrices (Plank, "A New
+    Minimum Density RAID-6 Code with a Word Size of Eight") are search-found
+    data tables living in the non-vendored jerasure submodule.  The density
+    optimization they encode is irrelevant to the TPU design (a bit-plane
+    matmul costs the same regardless of ones count), so this uses the
+    multiply-by-2^j companion blocks of GF(2^8) — MDS for the same (k, w=8,
+    m=2) envelope, verified exhaustively in tests."""
+    if k > 8:
+        raise ValueError(f"liber8tion requires k <= 8, got {k}")
+    w = 8
+    f = gf(w)
+    bm = np.zeros((2 * w, k * w), dtype=np.uint8)
+    for j in range(k):
+        bm[:w, j * w : (j + 1) * w] = np.eye(w, dtype=np.uint8)
+        bm[w:, j * w : (j + 1) * w] = f.mul_by_two_matrix(f.pow(2, j))
+    return bm
+
+
 def matrix_to_bitmatrix(matrix: np.ndarray, w: int) -> np.ndarray:
     """Expand a GF(2^w) matrix [m,k] into the GF(2) bit-matrix [m*w, k*w]:
     each element e becomes the w x w multiply-by-e matrix whose column x is

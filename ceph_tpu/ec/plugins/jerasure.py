@@ -40,16 +40,18 @@ class JerasureMixin:
 
     plugin_name = "jerasure"
 
-    def _parse_common(self, profile: ErasureCodeProfile) -> None:
+    def _parse_common(
+        self, profile: ErasureCodeProfile, allowed_w=(4, 8, 16)
+    ) -> None:
         self.k = to_int(profile, "k", DEFAULT_K)
         self.m = to_int(profile, "m", DEFAULT_M)
         self.w = to_int(profile, "w", DEFAULT_W)
         self.per_chunk_alignment = to_bool(profile, "jerasure-per-chunk-alignment", False)
         if self.k < 1 or self.m < 1:
             raise ErasureCodeError(-errno.EINVAL, f"k={self.k} m={self.m} must be >= 1")
-        if self.w not in (4, 8, 16):
+        if allowed_w is not None and self.w not in allowed_w:
             raise ErasureCodeError(
-                -errno.EINVAL, f"w={self.w} unsupported (use 4, 8 or 16)"
+                -errno.EINVAL, f"w={self.w} unsupported (use one of {allowed_w})"
             )
         self.parse_chunk_mapping(profile)
         profile = dict(profile)
@@ -142,9 +144,93 @@ class CauchyGood(CauchyBase):
         )
 
 
+class Liberation(JerasureMixin, BitmatrixErasureCode):
+    """Liberation codes: m=2, w prime > 2, k <= w (reference
+    ErasureCodeJerasureLiberation, ErasureCodeJerasure.cc:339-456; defaults
+    k=2 m=2 w=7 per ErasureCodeJerasure.h:204-206)."""
+
+    technique = "liberation"
+    default_w = 7
+
+    def _check_w(self) -> None:
+        if self.w <= 2 or not M.is_prime(self.w):
+            raise ErasureCodeError(
+                -errno.EINVAL, f"w={self.w} must be greater than two and be prime"
+            )
+
+    def _build(self) -> None:
+        self.bitmatrix = M.liberation_bitmatrix(self.k, self.w)
+
+    def init(self, profile: ErasureCodeProfile) -> None:
+        profile = dict(profile)
+        profile.setdefault("m", "2")
+        profile.setdefault("w", str(self.default_w))
+        self._parse_common(profile, allowed_w=None)
+        self.packetsize = to_int(profile, "packetsize", DEFAULT_PACKETSIZE)
+        if self.m != 2:
+            raise ErasureCodeError(-errno.EINVAL, f"{self.technique} requires m=2")
+        if self.k > self.w:
+            raise ErasureCodeError(
+                -errno.EINVAL, f"k={self.k} must be less than or equal to w={self.w}"
+            )
+        self._check_w()
+        if self.packetsize < 1 or self.packetsize % SIZEOF_INT:
+            raise ErasureCodeError(
+                -errno.EINVAL,
+                f"packetsize={self.packetsize} must be a positive multiple of "
+                f"sizeof(int) = {SIZEOF_INT}",
+            )
+        self._profile.setdefault("packetsize", str(self.packetsize))
+        self._build()
+
+
+class BlaumRoth(Liberation):
+    """Blaum-Roth codes: m=2, w+1 prime (reference ErasureCodeJerasureBlaumRoth,
+    ErasureCodeJerasure.cc:459-478)."""
+
+    technique = "blaum_roth"
+    default_w = 6
+
+    def _check_w(self) -> None:
+        # w=7 tolerated for backward compat in the reference despite 8 not
+        # being prime (ErasureCodeJerasure.cc:461-464); we reject it since
+        # the construction genuinely needs w+1 prime.
+        if self.w <= 2 or not M.is_prime(self.w + 1):
+            raise ErasureCodeError(
+                -errno.EINVAL,
+                f"w={self.w} must be greater than two and w+1 must be prime",
+            )
+
+    def _build(self) -> None:
+        self.bitmatrix = M.blaum_roth_bitmatrix(self.k, self.w)
+
+
+class Liber8tion(Liberation):
+    """Liber8tion codes: m=2, w=8 fixed (reference ErasureCodeJerasureLiber8tion,
+    ErasureCodeJerasure.cc:481-516; defaults k=2 m=2 w=8)."""
+
+    technique = "liber8tion"
+    default_w = 8
+
+    def _check_w(self) -> None:
+        if self.w != 8:
+            raise ErasureCodeError(-errno.EINVAL, "liber8tion requires w=8")
+
+    def _build(self) -> None:
+        self.bitmatrix = M.liber8tion_bitmatrix(self.k)
+
+
 TECHNIQUES = {
     cls.technique: cls
-    for cls in (ReedSolomonVandermonde, ReedSolomonR6Op, CauchyOrig, CauchyGood)
+    for cls in (
+        ReedSolomonVandermonde,
+        ReedSolomonR6Op,
+        CauchyOrig,
+        CauchyGood,
+        Liberation,
+        BlaumRoth,
+        Liber8tion,
+    )
 }
 
 

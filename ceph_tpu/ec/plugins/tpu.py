@@ -31,8 +31,11 @@ from ceph_tpu import PLUGIN_ABI_VERSION
 from ceph_tpu.ec.interface import ErasureCodeError, ErasureCodeProfile
 from ceph_tpu.ec.matrices import matrix_to_bitmatrix
 from ceph_tpu.ec.plugins.jerasure import (
+    BlaumRoth,
     CauchyGood,
     CauchyOrig,
+    Liber8tion,
+    Liberation,
     ReedSolomonR6Op,
     ReedSolomonVandermonde,
 )
@@ -155,11 +158,26 @@ class TpuCauchyGood(_TpuDispatch, CauchyGood):
     pass
 
 
+class TpuLiberation(_TpuDispatch, Liberation):
+    pass
+
+
+class TpuBlaumRoth(_TpuDispatch, BlaumRoth):
+    pass
+
+
+class TpuLiber8tion(_TpuDispatch, Liber8tion):
+    pass
+
+
 TECHNIQUES = {
     "reed_sol_van": TpuReedSolomonVandermonde,
     "reed_sol_r6_op": TpuReedSolomonR6Op,
     "cauchy_orig": TpuCauchyOrig,
     "cauchy_good": TpuCauchyGood,
+    "liberation": TpuLiberation,
+    "blaum_roth": TpuBlaumRoth,
+    "liber8tion": TpuLiber8tion,
 }
 
 

@@ -38,6 +38,7 @@ class RadosClient:
         self.osdmap: Optional[OSDMap] = None
         self._replies: Dict[str, asyncio.Future] = {}
         self._mon_fut: Optional[asyncio.Future] = None
+        self._mon_want: type = MMapReply
         # serialize mon RPCs: _mon_fut is a single slot, and concurrent ops
         # retrying through refresh_map() must not clobber each other
         self._mon_lock = asyncio.Lock()
@@ -51,15 +52,25 @@ class RadosClient:
 
     async def _dispatch(self, conn, msg) -> None:
         if isinstance(msg, (MMapReply, MCreatePoolReply)):
-            if self._mon_fut and not self._mon_fut.done():
+            # only fulfil the in-flight RPC if the reply type matches what it
+            # asked for — a reply landing after its RPC timed out must not
+            # leak into the next RPC's future with the wrong type
+            if (
+                self._mon_fut
+                and not self._mon_fut.done()
+                and isinstance(msg, self._mon_want)
+            ):
                 self._mon_fut.set_result(msg)
         elif isinstance(msg, MOSDOpReply):
             fut = self._replies.pop(msg.reqid, None)
             if fut and not fut.done():
                 fut.set_result(msg)
 
-    async def _mon_rpc(self, msg):
+    async def _mon_rpc(self, msg, reply_type=None):
+        if reply_type is None:
+            reply_type = MCreatePoolReply if isinstance(msg, MCreatePool) else MMapReply
         async with self._mon_lock:
+            self._mon_want = reply_type
             self._mon_fut = asyncio.get_running_loop().create_future()
             await self.messenger.send(self.mon_addr, msg)
             return await asyncio.wait_for(self._mon_fut, timeout=10)

@@ -118,8 +118,13 @@ class DirStore(ObjectStore):
         prefix = f"{pool_id}__"
         for name in os.listdir(self.path):
             if name.startswith(prefix) and not name.endswith((".meta", ".tmp")):
-                _, oid_hex, shard = name.rsplit("__", 2)
-                yield bytes.fromhex(oid_hex).decode(), int(shard)
+                try:
+                    _, oid_hex, shard = name.rsplit("__", 2)
+                    yield bytes.fromhex(oid_hex).decode(), int(shard)
+                except ValueError:
+                    # foreign or legacy-named file in the store dir: never
+                    # poison listing/repair for every other object
+                    continue
 
 
 def shard_crc(chunk: bytes) -> int:

@@ -102,6 +102,11 @@ int main(int argc, char** argv) {
     seconds = std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
                   .count();
   } else {  // decode: erase `erasures` random chunks, reconstruct
+    if (erasures < 1 || erasures > m) {
+      fprintf(stderr, "erasures=%d must be in [1, m=%d]\n", erasures, m);
+      codec->ops->destroy(codec);
+      return 1;
+    }
     codec->ops->encode(codec, dptr.data(), pptr.data(), chunk);
     std::vector<const uint8_t*> all(k + m);
     for (int i = 0; i < k; ++i) all[i] = data[i].data();
@@ -129,8 +134,13 @@ int main(int argc, char** argv) {
       }
       std::vector<uint8_t*> optr(erasures);
       for (int i = 0; i < erasures; ++i) optr[i] = out[i].data();
-      codec->ops->decode(codec, sources.data(), src.data(), erasures,
-                         erased.data(), optr.data(), chunk);
+      int rc = codec->ops->decode(codec, sources.data(), src.data(), erasures,
+                                  erased.data(), optr.data(), chunk);
+      if (rc != 0) {
+        fprintf(stderr, "decode failed: rc=%d\n", rc);
+        codec->ops->destroy(codec);
+        return 1;
+      }
     }
     seconds = std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
                   .count();

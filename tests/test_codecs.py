@@ -62,6 +62,14 @@ SMALL = 1 << 12
         ("jerasure", dict(technique="cauchy_orig", k=3, m=2, packetsize=8)),
         ("jerasure", dict(technique="cauchy_good", k=4, m=2, packetsize=8)),
         ("jerasure", dict(technique="cauchy_good", k=4, m=3, packetsize=16, w=4)),
+        ("jerasure", dict(technique="liberation", k=2, m=2, w=7, packetsize=8)),
+        ("jerasure", dict(technique="liberation", k=5, m=2, w=5, packetsize=8)),
+        ("jerasure", dict(technique="liberation", k=7, m=2, w=7, packetsize=4)),
+        ("jerasure", dict(technique="blaum_roth", k=4, m=2, w=6, packetsize=8)),
+        ("jerasure", dict(technique="blaum_roth", k=6, m=2, w=6, packetsize=4)),
+        ("jerasure", dict(technique="blaum_roth", k=4, m=2, w=10, packetsize=4)),
+        ("jerasure", dict(technique="liber8tion", k=2, m=2, w=8, packetsize=8)),
+        ("jerasure", dict(technique="liber8tion", k=8, m=2, w=8, packetsize=4)),
         ("isa", dict(technique="reed_sol_van", k=4, m=2)),
         ("isa", dict(technique="reed_sol_van", k=8, m=3)),
         ("isa", dict(technique="cauchy", k=5, m=3)),
@@ -148,3 +156,31 @@ def test_decode_cache_reuse():
         out = codec.decode({0, 1}, avail, len(encoded[0]))
         assert np.array_equal(out[0], encoded[0])
     assert len(codec._decode_cache._cache) >= 1
+
+
+def test_liberation_family_mds_property():
+    """The liberation/blaum_roth/liber8tion bit-matrices are MDS over their
+    whole parameter envelope: every k-subset of the k+2 chunks inverts
+    (reference property; constructions are reconstructed from the published
+    papers since the jerasure submodule is not vendored)."""
+    from ceph_tpu.ec.matrices import (
+        blaum_roth_bitmatrix,
+        invert_bitmatrix,
+        liber8tion_bitmatrix,
+        liberation_bitmatrix,
+    )
+
+    def check(bm, k, w):
+        full = np.vstack([np.eye(k * w, dtype=np.uint8), bm])
+        for chosen in itertools.combinations(range(k + 2), k):
+            sub = np.vstack([full[c * w : (c + 1) * w] for c in chosen])
+            invert_bitmatrix(sub)  # raises LinAlgError if singular
+
+    for w in (3, 5, 7):
+        for k in range(2, w + 1):
+            check(liberation_bitmatrix(k, w), k, w)
+    for w in (4, 6):
+        for k in range(2, w + 1):
+            check(blaum_roth_bitmatrix(k, w), k, w)
+    for k in range(2, 9):
+        check(liber8tion_bitmatrix(k), k, 8)
