@@ -277,18 +277,12 @@ def liber8tion_bitmatrix(k: int) -> np.ndarray:
     Minimum Density RAID-6 Code with a Word Size of Eight") are search-found
     data tables living in the non-vendored jerasure submodule.  The density
     optimization they encode is irrelevant to the TPU design (a bit-plane
-    matmul costs the same regardless of ones count), so this uses the
-    multiply-by-2^j companion blocks of GF(2^8) — MDS for the same (k, w=8,
+    matmul costs the same regardless of ones count), so this uses the RAID-6
+    P/Q rows (all-ones, 2^j) in bit-matrix form — MDS for the same (k, w=8,
     m=2) envelope, verified exhaustively in tests."""
     if k > 8:
         raise ValueError(f"liber8tion requires k <= 8, got {k}")
-    w = 8
-    f = gf(w)
-    bm = np.zeros((2 * w, k * w), dtype=np.uint8)
-    for j in range(k):
-        bm[:w, j * w : (j + 1) * w] = np.eye(w, dtype=np.uint8)
-        bm[w:, j * w : (j + 1) * w] = f.mul_by_two_matrix(f.pow(2, j))
-    return bm
+    return matrix_to_bitmatrix(r6_coding_matrix(k, 8), 8)
 
 
 def matrix_to_bitmatrix(matrix: np.ndarray, w: int) -> np.ndarray:

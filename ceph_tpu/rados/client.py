@@ -38,7 +38,7 @@ class RadosClient:
         self.osdmap: Optional[OSDMap] = None
         self._replies: Dict[str, asyncio.Future] = {}
         self._mon_fut: Optional[asyncio.Future] = None
-        self._mon_want: type = MMapReply
+        self._mon_tid: str = ""
         # serialize mon RPCs: _mon_fut is a single slot, and concurrent ops
         # retrying through refresh_map() must not clobber each other
         self._mon_lock = asyncio.Lock()
@@ -52,13 +52,13 @@ class RadosClient:
 
     async def _dispatch(self, conn, msg) -> None:
         if isinstance(msg, (MMapReply, MCreatePoolReply)):
-            # only fulfil the in-flight RPC if the reply type matches what it
-            # asked for — a reply landing after its RPC timed out must not
-            # leak into the next RPC's future with the wrong type
+            # the mon echoes our per-RPC tid (like MOSDOp's reqid): a reply
+            # landing after its RPC timed out has a stale tid and is dropped
+            # instead of fulfilling the next RPC's future
             if (
                 self._mon_fut
                 and not self._mon_fut.done()
-                and isinstance(msg, self._mon_want)
+                and msg.tid == self._mon_tid
             ):
                 self._mon_fut.set_result(msg)
         elif isinstance(msg, MOSDOpReply):
@@ -66,11 +66,9 @@ class RadosClient:
             if fut and not fut.done():
                 fut.set_result(msg)
 
-    async def _mon_rpc(self, msg, reply_type=None):
-        if reply_type is None:
-            reply_type = MCreatePoolReply if isinstance(msg, MCreatePool) else MMapReply
+    async def _mon_rpc(self, msg):
         async with self._mon_lock:
-            self._mon_want = reply_type
+            self._mon_tid = msg.tid = uuid.uuid4().hex
             self._mon_fut = asyncio.get_running_loop().create_future()
             await self.messenger.send(self.mon_addr, msg)
             return await asyncio.wait_for(self._mon_fut, timeout=10)

@@ -84,7 +84,7 @@ class Monitor:
 
     async def _dispatch(self, conn, msg) -> None:
         if isinstance(msg, MGetMap):
-            await conn.send(MMapReply(osdmap=self.osdmap))
+            await conn.send(MMapReply(osdmap=self.osdmap, tid=msg.tid))
         elif isinstance(msg, MOsdBoot):
             osd_id = msg.osd_id
             if osd_id < 0:
@@ -124,9 +124,11 @@ class Monitor:
                 info.in_cluster = False
                 self._last_ping[msg.osd_id] = -1e9
                 self._bump()
-            await conn.send(MMapReply(osdmap=self.osdmap))
+            await conn.send(MMapReply(osdmap=self.osdmap, tid=msg.tid))
         elif isinstance(msg, MCreatePool):
-            await conn.send(self._create_pool(msg))
+            reply = self._create_pool(msg)
+            reply.tid = msg.tid
+            await conn.send(reply)
 
     # -- pool / profile lifecycle -------------------------------------------
 

@@ -86,11 +86,13 @@ class OSDMap:
 @message(1)
 class MGetMap:
     min_epoch: int = 0
+    tid: str = ""
 
 
 @message(2)
 class MMapReply:
     osdmap: OSDMap = None
+    tid: str = ""
 
 
 @message(3)
@@ -107,6 +109,7 @@ class MBootReply:
 
 @message(5)
 class MCreatePool:
+    tid: str = ""
     name: str = ""
     pool_type: str = "ec"
     pg_num: int = 8
@@ -115,6 +118,7 @@ class MCreatePool:
 
 @message(6)
 class MCreatePoolReply:
+    tid: str = ""
     ok: bool = True
     error: str = ""
     pool_id: int = -1
@@ -129,6 +133,7 @@ class MPing:
 @message(8)
 class MMarkDown:
     osd_id: int = 0
+    tid: str = ""
 
 
 # Client <-> primary OSD
