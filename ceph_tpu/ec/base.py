@@ -57,6 +57,12 @@ class ErasureCode(ErasureCodeInterface):
     k: int = 0
     m: int = 0
 
+    # id space decode_chunks speaks: "logical" codecs (everything except
+    # lrc) index data 0..k-1 / coding k..k+m-1 and base.decode remaps
+    # physical ids through chunk_mapping for them; "physical" codecs (lrc)
+    # take mapped ids directly
+    decode_chunks_id_space: str = "logical"
+
     def get_profile(self) -> ErasureCodeProfile:
         return self._profile
 
@@ -73,21 +79,22 @@ class ErasureCode(ErasureCodeInterface):
         return self.chunk_mapping
 
     def parse_chunk_mapping(self, profile: ErasureCodeProfile) -> None:
-        """Reference ErasureCode::to_mapping: 'mapping' is a string whose
-        i-th character places logical chunk i; '-' marks unused slots."""
+        """Reference ErasureCode::to_mapping (ErasureCode.cc:260-279):
+        'mapping' is a string over physical positions; 'D' positions hold
+        the data chunks in order, every other position holds coding chunks.
+        chunk_mapping[logical id] = physical position."""
         mapping = profile.get("mapping")
         if not mapping:
             self.chunk_mapping = []
             return
-        position = 0
-        out: List[int] = []
-        for ch in mapping:
-            if ch == "-":
-                out.append(-1)
-            else:
-                out.append(position)
-                position += 1
-        self.chunk_mapping = out
+        data_positions = [i for i, ch in enumerate(mapping) if ch == "D"]
+        coding_positions = [i for i, ch in enumerate(mapping) if ch != "D"]
+        self.chunk_mapping = data_positions + coding_positions
+
+    def chunk_index(self, i: int) -> int:
+        """Logical chunk id -> physical position (reference
+        ErasureCode::chunk_index, ErasureCode.cc:97-100)."""
+        return self.chunk_mapping[i] if i < len(self.chunk_mapping) else i
 
     # -- chunk selection ----------------------------------------------------
 
@@ -127,9 +134,13 @@ class ErasureCode(ErasureCodeInterface):
         blocksize = self.get_chunk_size(len(data))
         chunks = self.encode_prepare(data, blocksize)
         coding = self.encode_chunks(chunks)
+        # chunk ids in the result are physical positions (chunk_index remap,
+        # identity for codecs without a 'mapping' profile)
         out: Dict[int, np.ndarray] = {}
+        phys = {self.chunk_index(i): i for i in range(k + m)}
         for c in want_to_encode:
-            out[c] = chunks[c] if c < k else coding[c - k]
+            logical = phys[c]
+            out[c] = chunks[logical] if logical < k else coding[logical - k]
         return out
 
     def decode(
@@ -145,7 +156,16 @@ class ErasureCode(ErasureCodeInterface):
             return {c: np.asarray(chunks[c]) for c in want_to_read}
         # ensure decodability before delegating
         self.minimum_to_decode(set(want_to_read), set(chunks))
-        return self.decode_chunks(set(want_to_read), chunks)
+        if not self.chunk_mapping or self.decode_chunks_id_space == "physical":
+            return self.decode_chunks(set(want_to_read), chunks)
+        # chunk ids at this boundary are physical; decode_chunks is logical
+        logical_of = {
+            self.chunk_index(i): i for i in range(self.get_chunk_count())
+        }
+        log_chunks = {logical_of[c]: buf for c, buf in chunks.items()}
+        log_want = {logical_of[c] for c in want_to_read}
+        decoded = self.decode_chunks(log_want, log_chunks)
+        return {self.chunk_index(c): buf for c, buf in decoded.items()}
 
     # -- default create_rule -------------------------------------------------
 

@@ -125,11 +125,15 @@ class ErasureCodeInterface(abc.ABC):
 
     def decode_concat(self, chunks: Mapping[int, np.ndarray]) -> bytes:
         """Reconstruct and concatenate the data chunks in order (reference
-        ErasureCode.cc:331)."""
+        ErasureCode.cc:331-345; chunk ids remapped through chunk_index for
+        codecs with a 'mapping' profile like lrc)."""
         k = self.get_data_chunk_count()
+        mapping = self.get_chunk_mapping()
+        index = (lambda i: mapping[i]) if mapping else (lambda i: i)
         chunk_size = len(next(iter(chunks.values())))
-        decoded = self.decode(set(range(k)), chunks, chunk_size)
-        return b"".join(bytes(decoded[i]) for i in range(k))
+        want = {index(i) for i in range(k)}
+        decoded = self.decode(want, chunks, chunk_size)
+        return b"".join(bytes(decoded[index(i)]) for i in range(k))
 
     # -- raw chunk paths ----------------------------------------------------
 

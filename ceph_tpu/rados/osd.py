@@ -296,9 +296,13 @@ class OSD:
         available = {
             shard: osd for shard, osd in enumerate(acting) if osd != CRUSH_ITEM_NONE
         }
-        # ask the codec which shards suffice (subchunk-aware plan)
+        # ask the codec which shards suffice (subchunk-aware plan); the
+        # wanted shards are the codec's DATA positions, which mapped codecs
+        # (lrc) place at chunk_index(i), not at 0..k-1
+        mapping = codec.get_chunk_mapping()
+        want = {mapping[i] if mapping else i for i in range(k)}
         try:
-            plan = codec.minimum_to_decode(set(range(k)), set(available))
+            plan = codec.minimum_to_decode(want, set(available))
         except ErasureCodeError:
             return MOSDOpReply(ok=False, error="not enough shards up")
         tid = uuid.uuid4().hex

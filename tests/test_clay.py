@@ -1,0 +1,151 @@
+"""CLAY plugin tests: sub-chunk geometry, full-erasure round-trips, the
+bandwidth-efficient single-chunk repair path, and MSR repair-bandwidth
+accounting (models reference src/test/erasure-code/TestErasureCodeClay.cc)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.interface import ErasureCodeError
+from ceph_tpu.ec.registry import registry
+
+
+def make(**profile):
+    profile = {k: str(v) for k, v in profile.items()}
+    profile["plugin"] = "clay"
+    return registry.factory("clay", "", profile)
+
+
+def payload(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+def test_geometry():
+    codec = make(k=4, m=2, d=5)
+    # q = d-k+1 = 2, k+m = 6 divisible by q -> nu=0, t = 3, q^t = 8
+    assert codec.q == 2 and codec.t == 3 and codec.nu == 0
+    assert codec.get_sub_chunk_count() == 8
+    assert codec.get_chunk_count() == 6
+    # shortening: k=3 m=2 d=4 -> q=2, k+m=5 odd -> nu=1, t=3
+    codec = make(k=3, m=2, d=4)
+    assert codec.nu == 1
+    assert codec.get_sub_chunk_count() == 8
+
+
+def test_d_envelope():
+    with pytest.raises(ErasureCodeError):
+        make(k=4, m=2, d=3)  # d < k
+    with pytest.raises(ErasureCodeError):
+        make(k=4, m=2, d=6)  # d > k+m-1
+    codec = make(k=4, m=2)  # default d = k+m-1
+    assert codec.d == 5
+
+
+@pytest.mark.parametrize(
+    "k,m,d",
+    [
+        (4, 2, 5),   # no shortening
+        (3, 2, 4),   # nu=1 shortening
+        (4, 3, 5),   # q=2, nu=0? (k+m)=7, q=2 -> nu=1
+        (6, 3, 8),   # q=3, k+m=9 -> nu=0
+        (8, 4, 11),  # the BASELINE.md A/B config 5 (q=4, t=3, 64 sub-chunks)
+    ],
+)
+def test_roundtrip_all_erasures(k, m, d):
+    codec = make(k=k, m=m, d=d)
+    n = codec.get_chunk_count()
+    data = payload(codec.get_chunk_size(1) * k, seed=k * 16 + m)
+    encoded = codec.encode(set(range(n)), data)
+    chunk_size = len(encoded[0])
+    concat = b"".join(bytes(encoded[i]) for i in range(k))
+    assert concat[: len(data)] == data  # systematic
+    # exhaustive erasures up to m (full-decode path)
+    max_r = min(m, 2)
+    for r in range(1, max_r + 1):
+        for erased in itertools.combinations(range(n), r):
+            avail = {c: encoded[c] for c in range(n) if c not in erased}
+            decoded = codec.decode(set(erased), avail, chunk_size)
+            for c in erased:
+                assert np.array_equal(decoded[c], encoded[c]), (erased, c)
+
+
+def test_minimum_to_decode_repair_plan():
+    """Single-chunk loss with >= d helpers returns a fragmented sub-chunk
+    plan covering only sub_chunk_no/q sub-chunks per helper."""
+    codec = make(k=4, m=2, d=5)
+    n = codec.get_chunk_count()
+    plan = codec.minimum_to_decode({0}, set(range(1, n)))
+    assert len(plan) == codec.d
+    for runs in plan.values():
+        total = sum(count for _, count in runs)
+        assert total == codec.get_sub_chunk_count() // codec.q
+    # loss of 2 chunks -> regular decode plan (full chunks)
+    plan = codec.minimum_to_decode({0, 1}, set(range(2, n)))
+    for runs in plan.values():
+        assert runs == [(0, codec.get_sub_chunk_count())]
+
+
+@pytest.mark.parametrize("k,m,d", [(4, 2, 5), (3, 2, 4), (6, 3, 8), (8, 4, 11)])
+def test_repair_single_chunk_bandwidth(k, m, d):
+    """The MSR property end-to-end: repair each chunk from d helpers that
+    each ship only the repair sub-chunks; result byte-identical."""
+    codec = make(k=k, m=m, d=d)
+    n = codec.get_chunk_count()
+    data = payload(codec.get_chunk_size(1) * k, seed=d)
+    encoded = codec.encode(set(range(n)), data)
+    chunk_size = len(encoded[0])
+    sc_size = chunk_size // codec.get_sub_chunk_count()
+    for lost in range(n):
+        plan = codec.minimum_to_decode({lost}, set(range(n)) - {lost})
+        assert len(plan) == d
+        helpers = {}
+        for c, runs in plan.items():
+            pieces = [
+                encoded[c][off * sc_size : (off + count) * sc_size]
+                for off, count in runs
+            ]
+            helpers[c] = np.concatenate(pieces)
+        # helpers carry only 1/q of each chunk
+        assert all(
+            len(h) == chunk_size // codec.q for h in helpers.values()
+        )
+        out = codec.decode({lost}, helpers, chunk_size)
+        assert np.array_equal(out[lost], encoded[lost]), f"lost={lost}"
+
+
+def test_repair_bandwidth_savings():
+    """Repair reads d/q chunks' worth vs k whole chunks for RS."""
+    codec = make(k=8, m=4, d=11)
+    repair_read = codec.d * codec.get_sub_chunk_count() // codec.q
+    rs_read = codec.k * codec.get_sub_chunk_count()
+    assert repair_read < rs_read / 2  # 11/4 vs 8 chunks -> ~2.9x less
+
+
+def test_scalar_mds_options():
+    for scalar in ("jerasure", "isa"):
+        codec = make(k=4, m=2, d=5, scalar_mds=scalar)
+        n = codec.get_chunk_count()
+        data = payload(codec.get_chunk_size(1) * 4, seed=7)
+        encoded = codec.encode(set(range(n)), data)
+        avail = {c: encoded[c] for c in range(n) if c != 2}
+        out = codec.decode({2}, avail, len(encoded[0]))
+        assert np.array_equal(out[2], encoded[2])
+    with pytest.raises(ErasureCodeError):
+        make(k=4, m=2, scalar_mds="nope")
+    with pytest.raises(ErasureCodeError):
+        make(k=4, m=2, scalar_mds="jerasure", technique="liberation")
+
+
+def test_scalar_mds_tpu_extension():
+    """scalar_mds=tpu routes the inner codecs through the tpu plugin (falls
+    back to its CPU path off-device) and stays byte-identical to jerasure."""
+    ref = make(k=4, m=2, d=5, scalar_mds="jerasure")
+    tpu = make(k=4, m=2, d=5, scalar_mds="tpu")
+    n = ref.get_chunk_count()
+    data = payload(ref.get_chunk_size(1) * 4, seed=11)
+    a = ref.encode(set(range(n)), data)
+    b = tpu.encode(set(range(n)), data)
+    for c in range(n):
+        assert np.array_equal(a[c], b[c]), f"chunk {c} differs"

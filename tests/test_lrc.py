@@ -1,0 +1,141 @@
+"""LRC plugin tests: kml layer generation, JSON layer parsing, locality-aware
+minimum_to_decode, layered encode/decode round-trips
+(models reference src/test/erasure-code/TestErasureCodeLrc.cc)."""
+
+import itertools
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.interface import ErasureCodeError
+from ceph_tpu.ec.registry import registry
+
+
+def make(**profile):
+    profile = {k: str(v) for k, v in profile.items()}
+    profile["plugin"] = "lrc"
+    return registry.factory("lrc", "", profile)
+
+
+def payload(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+def test_kml_generates_layers():
+    """k=4 m=2 l=3 -> 2 local groups of 3+1, one global layer
+    (the BASELINE.md A/B config 4)."""
+    codec = make(k=4, m=2, l=3)
+    assert codec.get_data_chunk_count() == 4
+    assert codec.get_chunk_count() == 8  # k + m + (k+m)/l local parities
+    assert len(codec.layers) == 3  # global + 2 local
+    # generated internals are not exposed (ErasureCodeLrc.cc:538-542)
+    assert "mapping" not in codec.get_profile()
+    assert "layers" not in codec.get_profile()
+
+
+def test_kml_constraints():
+    with pytest.raises(ErasureCodeError):
+        make(k=4, m=2)  # all of k,m,l or none
+    with pytest.raises(ErasureCodeError):
+        make(k=4, m=2, l=5)  # (k+m) % l != 0
+    with pytest.raises(ErasureCodeError):
+        make(k=3, m=3, l=3)  # k % groups != 0
+    with pytest.raises(ErasureCodeError):
+        make(k=4, m=2, l=3, mapping="DDDD____")  # generated + explicit
+
+
+def test_explicit_layers():
+    """Hand-written layers description with per-layer inner plugin choice."""
+    layers = [
+        ["DDc_DDc_", ""],
+        ["DDDc____", {"plugin": "jerasure", "technique": "reed_sol_van"}],
+        ["____DDDc", "plugin=jerasure technique=reed_sol_van"],
+    ]
+    codec = make(mapping="DD__DD__", layers=json.dumps(layers))
+    assert codec.get_data_chunk_count() == 4
+    assert codec.get_chunk_count() == 8
+    data = payload(1 << 12)
+    encoded = codec.encode(set(range(8)), data)
+    # all chunks equal-sized; data sits at the 'D' positions
+    mapping = "DD__DD__"
+    dpos = [i for i, ch in enumerate(mapping) if ch == "D"]
+    concat = b"".join(bytes(encoded[p]) for p in dpos)
+    assert concat[: len(data)] == data
+
+
+def test_layer_parse_errors():
+    for layers in [
+        "not json",
+        json.dumps({"a": 1}),
+        json.dumps([["DD__", 3, "x"]][:1] + [[5]]),
+        json.dumps([["DD__", 42]]),
+    ]:
+        with pytest.raises(ErasureCodeError):
+            make(mapping="DD__", layers=layers)
+    with pytest.raises(ErasureCodeError):  # layer size != mapping size
+        make(mapping="DD__", layers=json.dumps([["DDc", ""]]))
+    with pytest.raises(ErasureCodeError):  # no mapping
+        make(layers=json.dumps([["DDc_", ""]]))
+
+
+def test_single_failure_reads_local_group_only():
+    """THE LRC property: one lost chunk is repaired from its local group,
+    not from k chunks across the stripe."""
+    codec = make(k=4, m=2, l=3)
+    n = codec.get_chunk_count()  # 8: DD*_ DD*_ with local parity at 3, 7
+    # lose physical chunk 0 (a data chunk in local group 0)
+    plan = codec.minimum_to_decode({0}, set(range(1, n)))
+    # local group is l=3 chunks + local parity; reading the other 3 suffices
+    assert len(plan) == 3, sorted(plan)
+    assert set(plan) <= {1, 2, 3}, sorted(plan)
+
+
+def test_roundtrip_erasures():
+    codec = make(k=4, m=2, l=3)
+    n = codec.get_chunk_count()
+    data = payload(1 << 12)
+    encoded = codec.encode(set(range(n)), data)
+    chunk_size = len(encoded[0])
+    # every single and double erasure must be recoverable
+    for r in (1, 2):
+        for erased in itertools.combinations(range(n), r):
+            avail = {c: encoded[c] for c in range(n) if c not in erased}
+            decoded = codec.decode(set(erased), avail, chunk_size)
+            for c in erased:
+                assert np.array_equal(decoded[c], encoded[c]), (erased, c)
+
+
+def test_decode_concat_with_mapping():
+    codec = make(k=4, m=2, l=3)
+    n = codec.get_chunk_count()
+    data = payload(100_000, seed=9)
+    encoded = codec.encode(set(range(n)), data)
+    # drop two chunks, reconstruct the object
+    avail = {c: encoded[c] for c in range(n) if c not in (0, 4)}
+    assert codec.decode_concat(avail)[: len(data)] == data
+
+
+def test_unrecoverable_is_eio():
+    import errno
+
+    codec = make(k=4, m=2, l=3)
+    n = codec.get_chunk_count()
+    # lose an entire local group (4 chunks incl. its global parity slot):
+    # group 0 = {0,1,2,3} where 2 is a global parity, 3 local parity
+    with pytest.raises(ErasureCodeError) as ei:
+        codec.minimum_to_decode({0}, set(range(4, n)))
+    assert ei.value.errno_code == -errno.EIO
+
+
+def test_layer_uses_registry_composition():
+    """Inner codecs come from the registry — an lrc layer can even use the
+    tpu plugin (plugin composition is first-class)."""
+    layers = [["DDc", {"plugin": "xor", "k": "2"}]]
+    codec = make(mapping="DD_", layers=json.dumps(layers))
+    data = payload(4096)
+    encoded = codec.encode({0, 1, 2}, data)
+    avail = {1: encoded[1], 2: encoded[2]}
+    decoded = codec.decode({0}, avail, len(encoded[0]))
+    assert np.array_equal(decoded[0], encoded[0])
