@@ -27,6 +27,7 @@ TPU kernel accelerates.
 from __future__ import annotations
 
 import errno
+import threading
 from collections import OrderedDict
 from typing import Dict, List, Mapping, Optional, Set, Tuple
 
@@ -43,23 +44,28 @@ SIZEOF_INT = 4
 
 class DecodeMatrixCache:
     """LRU cache keyed by erasure signature -> decode matrix (reference
-    ErasureCodeIsaTableCache's role)."""
+    ErasureCodeIsaTableCache's role; that cache takes a guard mutex around
+    every lookup/insert, ErasureCodeIsaTableCache.cc:234,273 — same here so
+    codecs are safe under concurrent encode/decode threads)."""
 
     def __init__(self, capacity: int = 256):
         self.capacity = capacity
         self._cache: "OrderedDict[Tuple, np.ndarray]" = OrderedDict()
+        self._lock = threading.Lock()
 
     def get(self, key: Tuple) -> Optional[np.ndarray]:
-        m = self._cache.get(key)
-        if m is not None:
-            self._cache.move_to_end(key)
-        return m
+        with self._lock:
+            m = self._cache.get(key)
+            if m is not None:
+                self._cache.move_to_end(key)
+            return m
 
     def put(self, key: Tuple, value: np.ndarray) -> None:
-        self._cache[key] = value
-        self._cache.move_to_end(key)
-        while len(self._cache) > self.capacity:
-            self._cache.popitem(last=False)
+        with self._lock:
+            self._cache[key] = value
+            self._cache.move_to_end(key)
+            while len(self._cache) > self.capacity:
+                self._cache.popitem(last=False)
 
 
 def gf2_combine(select: np.ndarray, rows: np.ndarray) -> np.ndarray:

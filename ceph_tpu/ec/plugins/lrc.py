@@ -204,7 +204,12 @@ class ErasureCodeLrc(ErasureCode):
             )
 
     def _layers_sanity_checks(self) -> None:
-        """layers_sanity_checks (ErasureCodeLrc.cc:246-276)."""
+        """layers_sanity_checks (ErasureCodeLrc.cc:246-276), plus coverage
+        checks so every misconfiguration fails at init() with EINVAL rather
+        than surfacing as a KeyError on first encode: every parity position
+        must be computed by some layer, and each layer may only read
+        positions that are object data or parities computed by an EARLIER
+        layer (encode walks layers in order)."""
         if not self.layers:
             raise ErasureCodeError(
                 -errno.EINVAL, "layers must contain at least one layer"
@@ -216,6 +221,25 @@ class ErasureCodeLrc(ErasureCode):
                     f"layers[{position}] has {len(layer.chunks_map)} chunks, "
                     f"mapping has {self._chunk_count}",
                 )
+        data_positions = {i for i, ch in enumerate(self.mapping) if ch == "D"}
+        known = set(data_positions)
+        for position, layer in enumerate(self.layers):
+            unknown = set(layer.data) - known
+            if unknown:
+                raise ErasureCodeError(
+                    -errno.EINVAL,
+                    f"layers[{position}] reads positions {sorted(unknown)} "
+                    "that are neither object data nor computed by an "
+                    "earlier layer",
+                )
+            known |= set(layer.coding)
+        uncovered = set(range(self._chunk_count)) - known
+        if uncovered:
+            raise ErasureCodeError(
+                -errno.EINVAL,
+                f"mapping positions {sorted(uncovered)} are not computed "
+                "by any layer",
+            )
 
     # -- chunk selection (locality-aware) ------------------------------------
 

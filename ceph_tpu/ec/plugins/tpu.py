@@ -52,7 +52,12 @@ class _TpuDispatch:
     def _device_ok(self) -> bool:
         if getattr(self, "_tpu_failed", False):
             return False
-        return True
+        from ceph_tpu.utils.jaxdev import backend_available
+
+        # hang-proof: if backend init wedged (tunnel down), the probe pins
+        # "unavailable" and every dispatch takes the CPU path — a codec
+        # must return, never hang (registry contract)
+        return backend_available()
 
     def _mark_failed(self, exc: Exception) -> None:
         if not getattr(self, "_tpu_failed", False):
@@ -66,11 +71,10 @@ class _TpuDispatch:
         return cache
 
     def _use_pallas(self, cols: int) -> bool:
-        import jax
-
         from ceph_tpu.ops.pallas_gf2 import TILE_B
+        from ceph_tpu.utils.jaxdev import probe_backend
 
-        return jax.default_backend() == "tpu" and cols % TILE_B == 0
+        return probe_backend() == "tpu" and cols % TILE_B == 0
 
     # seam override: GF(2^w) matrix applied to symbol regions
     def _apply(self, matrix: np.ndarray, regions: np.ndarray) -> np.ndarray:

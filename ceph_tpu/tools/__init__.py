@@ -1,0 +1,22 @@
+"""Operator/test CLIs, mirroring the reference's tool surface:
+benchmark (ceph_erasure_code_benchmark), non_regression
+(ceph_erasure_code_non_regression), bench_suite (qa bench.sh sweep),
+and rados (the rados put/get CLI against a vstart cluster)."""
+
+import sys
+from typing import Dict, List
+
+
+def parse_parameters(params: List[str], warn: bool = True) -> Dict[str, str]:
+    """-P k=v list -> profile dict.  Values may themselves contain '='
+    (lrc layers profiles embed per-layer k=v strings), so split once."""
+    profile: Dict[str, str] = {}
+    for kv in params:
+        if "=" not in kv:
+            if warn:
+                print(f"--parameter {kv} ignored because it does not "
+                      "contain a =", file=sys.stderr)
+            continue
+        key, value = kv.split("=", 1)
+        profile[key] = value
+    return profile

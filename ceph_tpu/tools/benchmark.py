@@ -1,0 +1,141 @@
+"""Reference-compatible erasure-code benchmark CLI.
+
+Same flags and output protocol as the reference's
+ceph_erasure_code_benchmark (reference
+src/test/erasure-code/ceph_erasure_code_benchmark.cc:40-144): prints
+"<seconds>\t<KB processed>" on stdout, where KB = iterations * size/1024.
+
+    python -m ceph_tpu.tools.benchmark --plugin tpu -P k=8 -P m=3 \
+        --size 1048576 --iterations 16 --workload encode
+
+Workloads: encode (timed encode loop), decode (encode once, then timed
+decode with random | --erased | exhaustive erasure generation; exhaustive
+mode verifies recovered content, ceph_erasure_code_benchmark.cc:202-316).
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import random
+import sys
+import time
+
+import numpy as np
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="erasure code benchmark")
+    p.add_argument("--verbose", "-v", action="store_true")
+    p.add_argument("--size", "-s", type=int, default=1024 * 1024,
+                   help="size of the buffer to be encoded")
+    p.add_argument("--iterations", "-i", type=int, default=1,
+                   help="number of encode/decode runs")
+    p.add_argument("--plugin", "-p", default="jerasure",
+                   help="erasure code plugin name")
+    p.add_argument("--workload", "-w", default="encode",
+                   choices=("encode", "decode"))
+    p.add_argument("--erasures", "-e", type=int, default=1,
+                   help="number of erasures when decoding")
+    p.add_argument("--erased", type=int, action="append", default=None,
+                   help="erased chunk (repeat for more)")
+    p.add_argument("--erasures-generation", "-E", default="random",
+                   choices=("random", "exhaustive"))
+    p.add_argument("--parameter", "-P", action="append", default=[],
+                   help="add a parameter to the erasure code profile (k=v)")
+    p.add_argument("--directory", default="",
+                   help="plugin directory (ec_<name>.py files)")
+    return p.parse_args(argv)
+
+
+def build_profile(args):
+    from ceph_tpu.tools import parse_parameters
+
+    profile = {"plugin": args.plugin}
+    profile.update(parse_parameters(args.parameter))
+    return profile
+
+
+def make_codec(args, profile):
+    from ceph_tpu.ec.registry import registry
+
+    return registry.factory(args.plugin, args.directory, dict(profile))
+
+
+def bench_encode(codec, args) -> int:
+    n = codec.get_chunk_count()
+    data = b"X" * args.size
+    want = set(range(n))
+    begin = time.perf_counter()
+    for _ in range(args.iterations):
+        codec.encode(want, data)
+    elapsed = time.perf_counter() - begin
+    print(f"{elapsed:f}\t{args.iterations * (args.size // 1024)}")
+    return 0
+
+
+def decode_exhaustive(codec, encoded, erasures: int) -> int:
+    """All erasure combinations up to `erasures`, verifying content
+    (reference decode_erasures recursion,
+    ceph_erasure_code_benchmark.cc:202-249)."""
+    n = codec.get_chunk_count()
+    chunk_size = len(encoded[0])
+    for combo in itertools.combinations(range(n), erasures):
+        available = {c: b for c, b in encoded.items() if c not in combo}
+        decoded = codec.decode(set(combo), available, chunk_size)
+        for c in combo:
+            if not np.array_equal(decoded[c], encoded[c]):
+                print(f"chunk {c} content and recovered content are different",
+                      file=sys.stderr)
+                return 1
+    return 0
+
+
+def bench_decode(codec, args) -> int:
+    n = codec.get_chunk_count()
+    data = b"X" * args.size
+    encoded = codec.encode(set(range(n)), data)
+    chunk_size = len(encoded[0])
+    want = set(range(n))
+    erased = args.erased or []
+    if erased:
+        for c in erased:
+            encoded.pop(c, None)
+
+    begin = time.perf_counter()
+    for _ in range(args.iterations):
+        if args.erasures_generation == "exhaustive":
+            code = decode_exhaustive(codec, encoded, args.erasures)
+            if code:
+                return code
+        elif erased:
+            codec.decode(want, encoded, chunk_size)
+        else:
+            chunks = dict(encoded)
+            for _ in range(args.erasures):
+                while True:
+                    erasure = random.randrange(n)
+                    if erasure in chunks:
+                        break
+                del chunks[erasure]
+            codec.decode(want, chunks, chunk_size)
+    elapsed = time.perf_counter() - begin
+    print(f"{elapsed:f}\t{args.iterations * (args.size // 1024)}")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    profile = build_profile(args)
+    try:
+        codec = make_codec(args, profile)
+    except Exception as e:
+        print(f"factory({args.plugin}) failed: {e}", file=sys.stderr)
+        return 1
+    if args.workload == "encode":
+        return bench_encode(codec, args)
+    return bench_decode(codec, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

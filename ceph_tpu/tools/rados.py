@@ -1,0 +1,88 @@
+"""rados CLI: put/get/rm/ls against a running vstart cluster
+(the reference's src/tools/rados minimal surface).
+
+    python -m ceph_tpu.rados.vstart --osds 5          # terminal 1
+    python -m ceph_tpu.tools.rados --mon HOST:PORT mkpool data k=4 m=2
+    python -m ceph_tpu.tools.rados --mon HOST:PORT put data obj1 ./file
+    python -m ceph_tpu.tools.rados --mon HOST:PORT get data obj1 ./out
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="rados object tool")
+    p.add_argument("--mon", required=True, help="mon address host:port")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    mk = sub.add_parser("mkpool")
+    mk.add_argument("pool")
+    mk.add_argument("profile", nargs="*", help="profile k=v pairs")
+
+    put = sub.add_parser("put")
+    put.add_argument("pool")
+    put.add_argument("obj")
+    put.add_argument("path")
+
+    get = sub.add_parser("get")
+    get.add_argument("pool")
+    get.add_argument("obj")
+    get.add_argument("path")
+
+    rm = sub.add_parser("rm")
+    rm.add_argument("pool")
+    rm.add_argument("obj")
+
+    ls = sub.add_parser("ls")
+    ls.add_argument("pool")
+
+    return p.parse_args(argv)
+
+
+async def run(args) -> int:
+    from ceph_tpu.rados.client import RadosClient
+
+    host, port = args.mon.rsplit(":", 1)
+    client = RadosClient((host, int(port)))
+    await client.start()
+    try:
+        await client.refresh_map()
+        pools = {p.name: p.pool_id for p in client.osdmap.pools.values()}
+        if args.cmd == "mkpool":
+            profile = dict(kv.split("=", 1) for kv in args.profile)
+            profile.setdefault("plugin", "jerasure")
+            pool_id = await client.create_pool(args.pool, profile=profile)
+            print(f"pool {args.pool} created (id {pool_id})")
+            return 0
+        if args.pool not in pools:
+            print(f"pool {args.pool} does not exist", file=sys.stderr)
+            return 1
+        pool_id = pools[args.pool]
+        if args.cmd == "put":
+            with open(args.path, "rb") as f:
+                data = f.read()
+            await client.put(pool_id, args.obj, data)
+        elif args.cmd == "get":
+            data = await client.get(pool_id, args.obj)
+            with open(args.path, "wb") as f:
+                f.write(data)
+        elif args.cmd == "rm":
+            await client.delete(pool_id, args.obj)
+        elif args.cmd == "ls":
+            for name in await client.list_objects(pool_id):
+                print(name)
+        return 0
+    finally:
+        await client.stop()
+
+
+def main(argv=None) -> int:
+    return asyncio.run(run(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+"""Shared runtime utilities (device probing, misc glue)."""

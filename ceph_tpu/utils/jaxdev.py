@@ -1,0 +1,57 @@
+"""Hang-proof JAX backend discovery.
+
+The TPU service boundary is a failure domain the in-process dlopen model
+does not have (SURVEY.md §7 hard part 5): when the device tunnel wedges,
+``jax.default_backend()`` can block forever inside PJRT client creation —
+observed live in this environment — and the registry contract is that a
+codec returns -errno, it never hangs (the reference even ships a
+hanging-plugin test fixture, TestErasureCodePlugin.cc:31-76).
+
+``probe_backend()`` resolves the backend in a daemon thread with a
+timeout.  On timeout the thread is abandoned (it is wedged in native code
+and cannot be cancelled) and the result is pinned to "unavailable" for the
+life of the process; callers then take their CPU fallback path and never
+touch jax again.  The probe runs once; subsequent calls return the cached
+verdict.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_result: Optional[str] = None
+
+UNAVAILABLE = "unavailable"
+
+
+def probe_backend(timeout: Optional[float] = None) -> str:
+    """Return jax's default backend name ("tpu", "cpu", ...) or
+    "unavailable" if backend init fails or does not finish in time."""
+    global _result
+    with _lock:
+        if _result is not None:
+            return _result
+        if timeout is None:
+            timeout = float(os.environ.get("CEPH_TPU_PROBE_TIMEOUT", "30"))
+        box = {}
+
+        def _probe() -> None:
+            try:
+                import jax
+
+                box["backend"] = jax.default_backend()
+            except Exception as e:  # import or init failure
+                box["error"] = e
+
+        th = threading.Thread(target=_probe, daemon=True, name="jax-probe")
+        th.start()
+        th.join(timeout)
+        _result = box.get("backend", UNAVAILABLE)
+        return _result
+
+
+def backend_available() -> bool:
+    return probe_backend() != UNAVAILABLE

@@ -87,7 +87,15 @@ def test_minimum_to_decode_repair_plan():
         assert runs == [(0, codec.get_sub_chunk_count())]
 
 
-@pytest.mark.parametrize("k,m,d", [(4, 2, 5), (3, 2, 4), (6, 3, 8), (8, 4, 11)])
+@pytest.mark.parametrize(
+    "k,m,d",
+    [
+        (4, 2, 5), (3, 2, 4), (6, 3, 8), (8, 4, 11),
+        # d < k+m-1: repair runs with aloof nodes (helpers exclude some
+        # intact chunks), exercising the aloof-partner pft branch
+        (4, 3, 5), (6, 3, 7), (8, 4, 9),
+    ],
+)
 def test_repair_single_chunk_bandwidth(k, m, d):
     """The MSR property end-to-end: repair each chunk from d helpers that
     each ship only the repair sub-chunks; result byte-identical."""

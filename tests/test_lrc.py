@@ -139,3 +139,16 @@ def test_layer_uses_registry_composition():
     avail = {1: encoded[1], 2: encoded[2]}
     decoded = codec.decode({0}, avail, len(encoded[0]))
     assert np.array_equal(decoded[0], encoded[0])
+
+
+def test_uncovered_position_is_einval_at_init():
+    """A parity position no layer computes must fail at init(), not as a
+    KeyError on first encode (code-review regression)."""
+    with pytest.raises(ErasureCodeError) as ei:
+        make(mapping="DD__", layers=json.dumps([["DDc_", ""]]))
+    assert "not computed" in str(ei.value)
+    # a layer reading a position no earlier layer computed
+    with pytest.raises(ErasureCodeError) as ei:
+        make(mapping="DD__", layers=json.dumps(
+            [["DDcD", ""], ["__Dc", ""]]))
+    assert "earlier layer" in str(ei.value)

@@ -1,0 +1,91 @@
+"""Thread-safety under concurrent encode/decode on SHARED codec instances
+(models reference src/test/erasure-code/TestErasureCodeShec_thread.cc and
+the concurrent sections of TestErasureCodePlugin.cc)."""
+
+import itertools
+import threading
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.registry import registry
+
+
+def payload(n, seed):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+@pytest.mark.parametrize(
+    "plugin,profile",
+    [
+        ("shec", dict(k="4", m="3", c="2")),
+        ("jerasure", dict(technique="reed_sol_van", k="6", m="3")),
+        ("clay", dict(k="4", m="2", d="5")),
+    ],
+)
+def test_concurrent_encode_decode_shared_codec(plugin, profile):
+    """N threads hammer ONE codec instance with encode + rotating-erasure
+    decode; the shared decode-matrix caches must stay consistent and every
+    result byte-exact."""
+    codec = registry.factory(plugin, "", dict(profile, plugin=plugin))
+    n = codec.get_chunk_count()
+    data = payload(1 << 14, seed=42)
+    expected = codec.encode(set(range(n)), data)
+    chunk_size = len(expected[0])
+    erasure_patterns = list(itertools.combinations(range(n), 2))
+
+    errors = []
+    barrier = threading.Barrier(4)
+
+    def worker(tid):
+        try:
+            barrier.wait(timeout=10)
+            for i in range(12):
+                enc = codec.encode(set(range(n)), data)
+                for c in range(n):
+                    assert np.array_equal(enc[c], expected[c]), (tid, i, c)
+                erased = erasure_patterns[(tid * 12 + i) % len(erasure_patterns)]
+                avail = {c: expected[c] for c in range(n) if c not in erased}
+                dec = codec.decode(set(erased), avail, chunk_size)
+                for c in erased:
+                    assert np.array_equal(dec[c], expected[c]), (tid, i, c)
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append((tid, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+
+
+def test_concurrent_registry_factory():
+    """Concurrent factory() calls for different plugins must not corrupt
+    the registry (the reference's factory_mutex property)."""
+    from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+
+    reg = ErasureCodePluginRegistry()
+    errors = []
+    barrier = threading.Barrier(6)
+
+    def worker(tid):
+        try:
+            barrier.wait(timeout=10)
+            for _ in range(10):
+                plugin = ("xor", "jerasure", "isa")[tid % 3]
+                prof = {"plugin": plugin, "k": "3"}
+                if plugin != "xor":
+                    prof.update(m="2", technique="reed_sol_van")
+                codec = reg.factory(plugin, "", prof)
+                assert codec.get_data_chunk_count() == 3
+        except Exception as e:  # pragma: no cover
+            errors.append((tid, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors

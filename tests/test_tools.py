@@ -1,0 +1,128 @@
+"""Tool CLI tests: benchmark output protocol, exhaustive-erasure verify,
+non-regression corpus create/check (models the reference's benchmark and
+ceph_erasure_code_non_regression usage in qa scripts)."""
+
+import os
+
+import pytest
+
+from ceph_tpu.tools import bench_suite, benchmark, non_regression
+
+
+def run_bench(capsys, argv):
+    code = benchmark.main(argv)
+    out = capsys.readouterr().out.strip()
+    return code, out
+
+
+def test_benchmark_encode_output(capsys):
+    code, out = run_bench(capsys, [
+        "--plugin", "jerasure", "-P", "k=4", "-P", "m=2",
+        "--size", "65536", "--iterations", "3",
+    ])
+    assert code == 0
+    seconds, kb = out.split("\t")
+    assert float(seconds) > 0
+    assert int(kb) == 3 * 64
+
+
+def test_benchmark_decode_random(capsys):
+    code, out = run_bench(capsys, [
+        "--plugin", "jerasure", "-P", "k=4", "-P", "m=2",
+        "--size", "65536", "--iterations", "2",
+        "--workload", "decode", "--erasures", "2",
+    ])
+    assert code == 0
+    assert int(out.split("\t")[1]) == 2 * 64
+
+
+def test_benchmark_decode_exhaustive_verifies(capsys):
+    code, out = run_bench(capsys, [
+        "--plugin", "jerasure", "-P", "k=3", "-P", "m=2",
+        "--size", "16384", "--iterations", "1",
+        "--workload", "decode", "--erasures", "2",
+        "--erasures-generation", "exhaustive",
+    ])
+    assert code == 0
+
+
+def test_benchmark_decode_erased_list(capsys):
+    code, out = run_bench(capsys, [
+        "--plugin", "jerasure", "-P", "k=4", "-P", "m=2",
+        "--size", "16384", "--workload", "decode",
+        "--erased", "0", "--erased", "5",
+    ])
+    assert code == 0
+
+
+def test_benchmark_unknown_plugin(capsys):
+    code = benchmark.main(["--plugin", "doesnotexist"])
+    assert code == 1
+
+
+def test_benchmark_tpu_plugin(capsys):
+    code, out = run_bench(capsys, [
+        "--plugin", "tpu", "-P", "k=8", "-P", "m=3",
+        "--size", "262144", "--iterations", "2",
+    ])
+    assert code == 0
+
+
+def test_non_regression_create_check(tmp_path):
+    base = str(tmp_path)
+    argv = ["--plugin", "jerasure", "--base", base, "--stripe-width", "8192",
+            "-P", "k=4", "-P", "m=2", "-P", "technique=reed_sol_van"]
+    assert non_regression.main(argv + ["--create"]) == 0
+    # the corpus dir is profile-keyed like the reference
+    d = os.path.join(base, "plugin=jerasure stripe-width=8192 k=4 m=2 "
+                           "technique=reed_sol_van")
+    assert os.path.exists(os.path.join(d, "content"))
+    assert os.path.exists(os.path.join(d, "0"))
+    assert non_regression.main(argv + ["--check"]) == 0
+    # corrupt one chunk -> check must fail
+    with open(os.path.join(d, "2"), "r+b") as f:
+        f.write(b"\xff\xff")
+    assert non_regression.main(argv + ["--check"]) == 1
+
+
+@pytest.mark.parametrize("plugin,params", [
+    ("shec", ["-P", "k=4", "-P", "m=3", "-P", "c=2"]),
+    ("lrc", ["-P", "k=4", "-P", "m=2", "-P", "l=3"]),
+    ("clay", ["-P", "k=4", "-P", "m=2", "-P", "d=5"]),
+])
+def test_non_regression_all_plugins(tmp_path, plugin, params):
+    argv = ["--plugin", plugin, "--base", str(tmp_path)] + params
+    assert non_regression.main(argv + ["--create"]) == 0
+    assert non_regression.main(argv + ["--check"]) == 0
+
+
+def test_bench_suite_small(capsys):
+    code = bench_suite.main([
+        "--size", "16384", "--iterations", "1",
+        "--plugins", "jerasure", "--ks", "2", "--workloads", "encode",
+    ])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert code == 0
+    import json
+
+    rows = [json.loads(line) for line in out]
+    assert len(rows) == 4  # 2 techniques x m in {1,2}
+    assert all(r["mbps"] > 0 for r in rows)
+
+
+def test_parameter_values_may_contain_equals(tmp_path):
+    """lrc layers profiles embed k=v strings in the value; -P must split
+    only on the first '=' (code-review regression)."""
+    import json
+
+    layers = json.dumps([["DDc", "plugin=jerasure technique=reed_sol_van"]])
+    argv = ["--plugin", "lrc", "--base", str(tmp_path),
+            "-P", f"layers={layers}", "-P", "mapping=DD_"]
+    assert non_regression.main(argv + ["--create"]) == 0
+    assert non_regression.main(argv + ["--check"]) == 0
+
+
+def test_non_regression_error_is_exit_code(tmp_path):
+    """Profile errors exit 1 with a message, not a raw traceback."""
+    argv = ["--plugin", "lrc", "--base", str(tmp_path), "--create"]
+    assert non_regression.main(argv) == 1
