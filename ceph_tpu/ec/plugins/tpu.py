@@ -71,10 +71,13 @@ class _TpuDispatch:
         return cache
 
     def _use_pallas(self, cols: int) -> bool:
+        from ceph_tpu.ops.gf2 import pallas_enabled
         from ceph_tpu.ops.pallas_gf2 import TILE_B
         from ceph_tpu.utils.jaxdev import probe_backend
 
-        return probe_backend() == "tpu" and cols % TILE_B == 0
+        return (
+            pallas_enabled() and probe_backend() == "tpu" and cols % TILE_B == 0
+        )
 
     # seam override: GF(2^w) matrix applied to symbol regions
     def _apply(self, matrix: np.ndarray, regions: np.ndarray) -> np.ndarray:

@@ -26,10 +26,21 @@ to avoid materializing the 8x-expanded bit arrays in HBM.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def pallas_enabled() -> bool:
+    """Whether dispatchers should route w=8 byte-layout ops to the Pallas
+    kernel.  Off by default: on the v5e used for tuning, XLA's fused
+    unpack+matmul+pack path measured ~4x faster than the hand-written kernel
+    (59.7 vs 15.6 GB/s at k=8,m=3), so production and the headline bench both
+    take the XLA path until the kernel wins; set CEPH_TPU_PALLAS=1 to opt in
+    (e.g. when re-tuning on a different TPU generation)."""
+    return os.environ.get("CEPH_TPU_PALLAS", "") == "1"
 
 
 def bucket_columns(n: int, lo: int = 1024) -> int:

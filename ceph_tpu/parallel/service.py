@@ -137,11 +137,14 @@ class BatchingQueue:
                 batch = np.pad(batch, ((0, 0), (0, pad)))
             use_pallas = self._use_pallas
             if use_pallas is None:
+                from ceph_tpu.ops.gf2 import pallas_enabled
                 from ceph_tpu.ops.pallas_gf2 import TILE_B
                 from ceph_tpu.utils.jaxdev import probe_backend
 
                 use_pallas = (
-                    probe_backend() == "tpu" and batch.shape[1] % TILE_B == 0
+                    pallas_enabled()
+                    and probe_backend() == "tpu"
+                    and batch.shape[1] % TILE_B == 0
                 )
             try:
                 out = np.asarray(

@@ -75,12 +75,13 @@ def bench_encode(codec, args) -> int:
 
 
 def decode_exhaustive(codec, encoded, erasures: int) -> int:
-    """All erasure combinations up to `erasures`, verifying content
-    (reference decode_erasures recursion,
+    """All erasure combinations up to `erasures` over the chunks present in
+    `encoded` (chunks pre-erased via --erased are simply never available),
+    verifying content (reference decode_erasures recursion,
     ceph_erasure_code_benchmark.cc:202-249)."""
-    n = codec.get_chunk_count()
-    chunk_size = len(encoded[0])
-    for combo in itertools.combinations(range(n), erasures):
+    present = sorted(encoded)
+    chunk_size = len(encoded[present[0]])
+    for combo in itertools.combinations(present, erasures):
         available = {c: b for c, b in encoded.items() if c not in combo}
         decoded = codec.decode(set(combo), available, chunk_size)
         for c in combo:
@@ -95,7 +96,7 @@ def bench_decode(codec, args) -> int:
     n = codec.get_chunk_count()
     data = b"X" * args.size
     encoded = codec.encode(set(range(n)), data)
-    chunk_size = len(encoded[0])
+    chunk_size = len(next(iter(encoded.values())))
     want = set(range(n))
     erased = args.erased or []
     if erased:
@@ -132,9 +133,13 @@ def main(argv=None) -> int:
     except Exception as e:
         print(f"factory({args.plugin}) failed: {e}", file=sys.stderr)
         return 1
-    if args.workload == "encode":
-        return bench_encode(codec, args)
-    return bench_decode(codec, args)
+    try:
+        if args.workload == "encode":
+            return bench_encode(codec, args)
+        return bench_decode(codec, args)
+    except Exception as e:
+        print(f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
 
 
 if __name__ == "__main__":

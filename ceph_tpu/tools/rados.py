@@ -53,7 +53,9 @@ async def run(args) -> int:
         await client.refresh_map()
         pools = {p.name: p.pool_id for p in client.osdmap.pools.values()}
         if args.cmd == "mkpool":
-            profile = dict(kv.split("=", 1) for kv in args.profile)
+            from ceph_tpu.tools import parse_parameters
+
+            profile = parse_parameters(args.profile)
             profile.setdefault("plugin", "jerasure")
             pool_id = await client.create_pool(args.pool, profile=profile)
             print(f"pool {args.pool} created (id {pool_id})")

@@ -58,6 +58,7 @@ def test_concurrent_encode_decode_shared_codec(plugin, profile):
         t.start()
     for t in threads:
         t.join(timeout=120)
+        assert not t.is_alive(), "worker wedged (possible codec-lock deadlock)"
     assert not errors, errors
 
 
@@ -88,4 +89,5 @@ def test_concurrent_registry_factory():
         t.start()
     for t in threads:
         t.join(timeout=60)
+        assert not t.is_alive(), "worker wedged (possible registry deadlock)"
     assert not errors, errors
