@@ -95,10 +95,12 @@ def main() -> int:
     # per-dispatch round-trip floor (tunnel RPC latency; ~0 on a local chip)
     trivial = jax.jit(lambda: jnp.int32(1))
     int(trivial())
-    rtt = min(
-        (lambda t0: (int(trivial()), time.perf_counter() - t0)[1])(time.perf_counter())
-        for _ in range(5)
-    )
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        int(trivial())
+        rtts.append(time.perf_counter() - t0)
+    rtt = min(rtts)
 
     iters = int(os.environ.get("BENCH_ITERS", "32" if backend == "tpu" else "4"))
 

@@ -1,0 +1,283 @@
+"""Typed option tables + runtime config proxy.
+
+Role-equivalent of the reference's md_config_t/ConfigProxy
+(reference src/common/config.cc) and the YAML option schemas
+(src/common/options/{global,mon,osd}.yaml.in): every option is declared once
+with a type, default, level (basic/advanced/dev) and flags (startup options
+cannot change at runtime; runtime options notify registered observers on
+change).  Sources are layered the way the reference layers ceph.conf < env <
+CLI < mon-centralized config: ``set_source(name, values)`` installs a source
+at a priority, and effective values are resolved highest-priority-first.
+
+Observers mirror md_config_obs_t (src/common/config_obs.h): a subscriber
+names the keys it tracks and gets ``handle_conf_change(config, changed)``
+callbacks, the mechanism ThreadPool uses to resize itself at runtime
+(src/common/WorkQueue.h:44).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+OPT_STR = "str"
+OPT_INT = "int"
+OPT_FLOAT = "float"
+OPT_BOOL = "bool"
+OPT_SIZE = "size"  # accepts 4K/1M/2G suffixes
+OPT_SECS = "secs"  # accepts 500ms/2s/1m suffixes
+
+LEVEL_BASIC = "basic"
+LEVEL_ADVANCED = "advanced"
+LEVEL_DEV = "dev"
+
+FLAG_STARTUP = "startup"  # read once at daemon start; runtime set -> error
+FLAG_RUNTIME = "runtime"  # observers notified on change
+FLAG_CLUSTER = "cluster"  # distributed via the ConfigMonitor
+
+_SIZE_SUFFIX = {"": 1, "b": 1, "k": 1 << 10, "m": 1 << 20, "g": 1 << 30,
+                "t": 1 << 40}
+_SECS_SUFFIX = {"": 1.0, "ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0}
+
+
+@dataclass
+class Option:
+    name: str
+    type: str = OPT_STR
+    default: Any = None
+    level: str = LEVEL_ADVANCED
+    flags: Tuple[str, ...] = (FLAG_RUNTIME,)
+    desc: str = ""
+    min: Optional[float] = None
+    max: Optional[float] = None
+    enum_values: Tuple[str, ...] = ()
+
+    def parse(self, value: Any) -> Any:
+        if value is None:
+            return None
+        if self.type == OPT_STR:
+            out: Any = str(value)
+            if self.enum_values and out not in self.enum_values:
+                raise ValueError(
+                    f"{self.name}: {out!r} not in {sorted(self.enum_values)}"
+                )
+            return out
+        if self.type == OPT_BOOL:
+            if isinstance(value, bool):
+                return value
+            s = str(value).strip().lower()
+            if s in ("1", "true", "yes", "on"):
+                return True
+            if s in ("0", "false", "no", "off"):
+                return False
+            raise ValueError(f"{self.name}: bad bool {value!r}")
+        if self.type == OPT_INT:
+            out = int(value)
+        elif self.type == OPT_FLOAT:
+            out = float(value)
+        elif self.type == OPT_SIZE:
+            out = self._parse_suffixed(value, _SIZE_SUFFIX, int)
+        elif self.type == OPT_SECS:
+            out = self._parse_suffixed(value, _SECS_SUFFIX, float)
+        else:
+            raise ValueError(f"{self.name}: unknown option type {self.type}")
+        if self.min is not None and out < self.min:
+            raise ValueError(f"{self.name}: {out} < min {self.min}")
+        if self.max is not None and out > self.max:
+            raise ValueError(f"{self.name}: {out} > max {self.max}")
+        return out
+
+    def _parse_suffixed(self, value: Any, table: Dict[str, float], cast) -> Any:
+        if isinstance(value, (int, float)):
+            return cast(value)
+        m = re.fullmatch(r"\s*([0-9.]+)\s*([a-zA-Z]*)\s*", str(value))
+        if not m:
+            raise ValueError(f"{self.name}: bad value {value!r}")
+        suffix = m.group(2).lower().rstrip("ib") or m.group(2).lower()
+        # allow 4K / 4KB / 4KiB; 500ms stays "ms"
+        if suffix not in table:
+            suffix = m.group(2).lower()
+        if suffix not in table:
+            raise ValueError(f"{self.name}: bad suffix in {value!r}")
+        return cast(float(m.group(1)) * table[suffix])
+
+
+def _opts(*options: Option) -> Dict[str, Option]:
+    return {o.name: o for o in options}
+
+
+# Default schema: the subset of the reference's option tables this framework
+# consumes, same names where the semantic carries over
+# (src/common/options/global.yaml.in, mon.yaml.in, osd.yaml.in).
+DEFAULT_SCHEMA: Dict[str, Option] = _opts(
+    # EC plugin machinery (global.yaml.in:437,2507,2516; mon.yaml.in:16)
+    Option("erasure_code_dir", OPT_STR, "", flags=(FLAG_STARTUP,),
+           desc="directory to dlopen native EC plugins from"),
+    Option("osd_erasure_code_plugins", OPT_STR,
+           "jerasure isa shec lrc clay tpu", flags=(FLAG_STARTUP,),
+           desc="plugins preloaded at daemon start"),
+    Option("osd_pool_default_erasure_code_profile", OPT_STR,
+           "plugin=jerasure technique=reed_sol_van k=2 m=2"),
+    Option("osd_pool_erasure_code_stripe_unit", OPT_SIZE, 4096),
+    # messenger (global.yaml.in:1240-1265)
+    Option("ms_inject_socket_failures", OPT_INT, 0, level=LEVEL_DEV),
+    Option("ms_inject_delay_max", OPT_SECS, 0.0, level=LEVEL_DEV),
+    Option("ms_inject_internal_delays", OPT_SECS, 0.0, level=LEVEL_DEV),
+    Option("ms_crc_data", OPT_BOOL, True),
+    Option("ms_compress_min_size", OPT_SIZE, 0,
+           desc="compress frames >= this size; 0 disables on-wire compression"),
+    Option("ms_dispatch_throttle_bytes", OPT_SIZE, 100 << 20),
+    Option("ms_auth_secret", OPT_STR, "",
+           desc="shared cluster secret; non-empty enables cephx-style frames"),
+    # osd
+    Option("osd_heartbeat_interval", OPT_SECS, 0.3),
+    Option("osd_heartbeat_grace", OPT_SECS, 2.0),
+    Option("osd_auto_repair", OPT_BOOL, True),
+    Option("osd_repair_delay", OPT_SECS, 0.5),
+    Option("osd_op_num_shards", OPT_INT, 4),
+    Option("osd_op_queue", OPT_STR, "wpq", enum_values=("wpq", "mclock")),
+    Option("osd_scrub_auto", OPT_BOOL, False),
+    Option("osd_debug_inject_read_err", OPT_BOOL, False, level=LEVEL_DEV),
+    Option("osd_debug_inject_dispatch_delay_probability", OPT_FLOAT, 0.0,
+           level=LEVEL_DEV),
+    Option("osd_debug_inject_dispatch_delay_duration", OPT_SECS, 0.1,
+           level=LEVEL_DEV),
+    # objectstore
+    Option("bluestore_csum_type", OPT_STR, "crc32c",
+           enum_values=("none", "crc32c")),
+    Option("bluestore_debug_inject_read_err", OPT_BOOL, False, level=LEVEL_DEV),
+    Option("bluestore_debug_inject_csum_err_probability", OPT_FLOAT, 0.0,
+           level=LEVEL_DEV),
+    Option("bluestore_prefer_deferred_size", OPT_SIZE, 32768),
+    # mon
+    Option("mon_lease", OPT_SECS, 5.0),
+    Option("mon_election_timeout", OPT_SECS, 1.0),
+    Option("paxos_propose_interval", OPT_SECS, 0.05),
+    # logging (src/common/dout.h per-subsys levels)
+    Option("log_max_recent", OPT_INT, 500),
+    Option("debug_osd", OPT_INT, 1, level=LEVEL_DEV),
+    Option("debug_mon", OPT_INT, 1, level=LEVEL_DEV),
+    Option("debug_ms", OPT_INT, 0, level=LEVEL_DEV),
+    Option("debug_ec", OPT_INT, 1, level=LEVEL_DEV),
+    Option("debug_bluestore", OPT_INT, 1, level=LEVEL_DEV),
+    Option("debug_client", OPT_INT, 1, level=LEVEL_DEV),
+)
+
+
+class Config:
+    """Layered, observable, typed config (ConfigProxy role).
+
+    Unknown keys are accepted as untyped passthrough values so subsystem
+    experiments don't need schema edits first (the reference requires
+    declarations; we degrade to OPT_STR-like behavior and flag them in
+    ``show()``).
+    """
+
+    # source priorities, low to high (mon-centralized beats file, CLI beats all)
+    SOURCES = ("default", "file", "env", "mon", "override", "cli")
+
+    def __init__(self, values: Optional[Dict[str, Any]] = None,
+                 schema: Optional[Dict[str, Option]] = None):
+        self.schema: Dict[str, Option] = dict(schema or DEFAULT_SCHEMA)
+        self._sources: Dict[str, Dict[str, Any]] = {s: {} for s in self.SOURCES}
+        self._observers: List[Tuple[Callable, Tuple[str, ...]]] = []
+        self._started = False
+        if values:
+            self.set_source("override", values)
+
+    # -- resolution ----------------------------------------------------------
+
+    def get(self, name: str, default: Any = None) -> Any:
+        opt = self.schema.get(name)
+        for source in reversed(self.SOURCES):
+            if name in self._sources[source]:
+                raw = self._sources[source][name]
+                return opt.parse(raw) if opt else raw
+        if opt is not None:
+            return opt.default
+        return default
+
+    def __contains__(self, name: str) -> bool:
+        return any(name in vals for vals in self._sources.values()) or name in self.schema
+
+    def show(self) -> Dict[str, Any]:
+        """Effective values for every known + set key, schema'd or not."""
+        names: Set[str] = set(self.schema)
+        for vals in self._sources.values():
+            names |= set(vals)
+        return {n: self.get(n) for n in sorted(names)}
+
+    def diff(self) -> Dict[str, Any]:
+        """Keys whose effective value differs from the schema default."""
+        out = {}
+        for name, value in self.show().items():
+            opt = self.schema.get(name)
+            if opt is None or value != opt.default:
+                out[name] = value
+        return out
+
+    # -- mutation ------------------------------------------------------------
+
+    def mark_started(self) -> None:
+        """Daemon finished global_init: startup-flagged options freeze."""
+        self._started = True
+
+    def set(self, name: str, value: Any, source: str = "cli") -> None:
+        opt = self.schema.get(name)
+        if opt is not None:
+            if self._started and FLAG_STARTUP in opt.flags:
+                raise ValueError(f"{name} can only be set at daemon startup")
+            opt.parse(value)  # validate eagerly
+        old = self.get(name)
+        self._sources[source][name] = value
+        if self.get(name) != old:
+            self._notify({name})
+
+    def rm(self, name: str, source: str = "cli") -> None:
+        old = self.get(name)
+        self._sources[source].pop(name, None)
+        if self.get(name) != old:
+            self._notify({name})
+
+    def set_source(self, source: str, values: Dict[str, Any]) -> None:
+        """Install/replace a whole source layer (e.g. a mon config epoch)."""
+        if source not in self._sources:
+            raise ValueError(f"unknown config source {source}")
+        before = {k: self.get(k) for k in set(self._sources[source]) | set(values)}
+        self._sources[source] = dict(values)
+        changed = {k for k, v in before.items() if self.get(k) != v}
+        if changed:
+            self._notify(changed)
+
+    # -- observers -----------------------------------------------------------
+
+    def add_observer(self, handler: Callable[["Config", Set[str]], None],
+                     keys: Iterable[str]) -> None:
+        self._observers.append((handler, tuple(keys)))
+
+    def remove_observer(self, handler: Callable) -> None:
+        self._observers = [(h, k) for h, k in self._observers if h is not handler]
+
+    def _notify(self, changed: Set[str]) -> None:
+        for handler, keys in list(self._observers):
+            hit = changed & set(keys)
+            if hit:
+                handler(self, hit)
+
+    # -- parsing helpers -----------------------------------------------------
+
+    @classmethod
+    def from_conf_file(cls, text: str) -> "Config":
+        """Parse a minimal ceph.conf-style ini (global section only for now)."""
+        cfg = cls()
+        values: Dict[str, Any] = {}
+        for line in text.splitlines():
+            line = line.split("#", 1)[0].split(";", 1)[0].strip()
+            if not line or line.startswith("["):
+                continue
+            if "=" in line:
+                k, v = line.split("=", 1)
+                values[k.strip().replace(" ", "_")] = v.strip()
+        cfg.set_source("file", values)
+        return cfg

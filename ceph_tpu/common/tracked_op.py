@@ -1,0 +1,94 @@
+"""In-flight op tracking with event timelines.
+
+Role-equivalent of the reference's TrackedOp/OpTracker (reference
+src/common/TrackedOp.h): every client op gets a TrackedOp at dispatch;
+pipeline stages call ``mark_event`` ("queued_for_pg", "start ec write",
+"commit_sent", ...); the admin socket serves ``dump_ops_in_flight`` and
+``dump_historic_ops`` (a bounded ring of the slowest/most recent completed
+ops) — the primary live-debugging tool for stuck I/O.  TrackedOp doubles as
+the span carrier for the zipkin/jaeger-style trace annotations the EC write
+path emits (reference ECBackend.cc:2027).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import time
+from typing import Any, Deque, Dict, List, Optional
+
+_seq = itertools.count(1)
+
+
+class TrackedOp:
+    __slots__ = ("tracker", "seq", "desc", "initiated_at", "events", "done_at")
+
+    def __init__(self, tracker: "OpTracker", desc: str):
+        self.tracker = tracker
+        self.seq = next(_seq)
+        self.desc = desc
+        self.initiated_at = time.time()
+        self.events: List[Dict[str, Any]] = []
+        self.done_at: Optional[float] = None
+
+    def mark_event(self, event: str) -> None:
+        self.events.append({"time": time.time(), "event": event})
+
+    def finish(self) -> None:
+        if self.done_at is None:
+            self.done_at = time.time()
+            self.tracker._complete(self)
+
+    @property
+    def duration(self) -> float:
+        return (self.done_at or time.time()) - self.initiated_at
+
+    def dump(self) -> Dict[str, Any]:
+        return {
+            "seq": self.seq,
+            "description": self.desc,
+            "initiated_at": self.initiated_at,
+            "age": self.duration,
+            "done": self.done_at is not None,
+            "type_data": {"events": list(self.events)},
+        }
+
+
+class OpTracker:
+    def __init__(self, history_size: int = 20, history_slow_size: int = 20,
+                 slow_threshold: float = 0.5):
+        self._in_flight: Dict[int, TrackedOp] = {}
+        self._history: Deque[TrackedOp] = collections.deque(maxlen=history_size)
+        self._slow: Deque[TrackedOp] = collections.deque(maxlen=history_slow_size)
+        self.slow_threshold = slow_threshold
+
+    def create(self, desc: str) -> TrackedOp:
+        op = TrackedOp(self, desc)
+        self._in_flight[op.seq] = op
+        return op
+
+    def _complete(self, op: TrackedOp) -> None:
+        self._in_flight.pop(op.seq, None)
+        self._history.append(op)
+        if op.duration >= self.slow_threshold:
+            self._slow.append(op)
+
+    def dump_ops_in_flight(self) -> Dict[str, Any]:
+        ops = [op.dump() for op in self._in_flight.values()]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic_ops(self) -> Dict[str, Any]:
+        ops = [op.dump() for op in self._history]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic_slow_ops(self) -> Dict[str, Any]:
+        ops = [op.dump() for op in self._slow]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def register_asok(self, asok) -> None:
+        asok.register("dump_ops_in_flight", lambda a: self.dump_ops_in_flight(),
+                      "in-flight ops with event timelines")
+        asok.register("dump_historic_ops", lambda a: self.dump_historic_ops(),
+                      "recently completed ops")
+        asok.register("dump_historic_slow_ops", lambda a: self.dump_historic_slow_ops(),
+                      "recent slow ops")
