@@ -241,9 +241,15 @@ class Config:
             self._notify({name})
 
     def set_source(self, source: str, values: Dict[str, Any]) -> None:
-        """Install/replace a whole source layer (e.g. a mon config epoch)."""
+        """Install/replace a whole source layer (e.g. a mon config epoch).
+        Values are validated BEFORE the swap so a bad pushed value can't
+        poison the layer."""
         if source not in self._sources:
             raise ValueError(f"unknown config source {source}")
+        for k, v in values.items():
+            opt = self.schema.get(k)
+            if opt is not None:
+                opt.parse(v)
         before = {k: self.get(k) for k in set(self._sources[source]) | set(values)}
         self._sources[source] = dict(values)
         changed = {k for k, v in before.items() if self.get(k) != v}

@@ -35,8 +35,13 @@ class Throttle:
         return True
 
     async def get(self, amount: int) -> None:
-        """Blocking acquire, FIFO order so large requests can't starve."""
-        if self.max == 0 or (not self._waiters and self.current + amount <= self.max):
+        """Blocking acquire, FIFO order so large requests can't starve.
+        An idle throttle admits even an oversize request (ref behavior:
+        a single op larger than the budget must not wedge)."""
+        if self.max == 0 or (
+            not self._waiters
+            and (self.current + amount <= self.max or self.current == 0)
+        ):
             self.current += amount
             return
         fut: asyncio.Future = asyncio.get_running_loop().create_future()

@@ -34,7 +34,7 @@ class RadosClient:
         self.mon_addr = tuple(mon_addr)
         self.conf = conf or {}
         self.op_timeout = self.conf.get("client_op_timeout", 10.0)
-        self.messenger = Messenger("client", self.conf)
+        self.messenger = Messenger("client", self.conf, entity_type="client")
         self.osdmap: Optional[OSDMap] = None
         self._replies: Dict[str, asyncio.Future] = {}
         self._mon_fut: Optional[asyncio.Future] = None

@@ -1,30 +1,62 @@
-"""Async messenger: typed messages over length-prefixed TCP frames.
+"""Async messenger: typed messages over an authenticated, crc-guarded,
+replay-safe framed TCP protocol.
 
 Role-equivalent of the reference's AsyncMessenger + ProtocolV2 stack
-(reference src/msg/async/AsyncMessenger.h:73, ProtocolV2.cc): every daemon
-creates one Messenger, registers a Dispatcher, and exchanges versioned typed
-messages over ordered per-peer Connections; a config-driven fault injector
-(ms_inject_socket_failures, reference src/common/options/global.yaml.in:1240)
-can sever connections to exercise retry/recovery paths without code changes.
+(reference src/msg/async/AsyncMessenger.h:73, ProtocolV2.cc, frames_v2.cc):
+every daemon creates one Messenger, registers a Dispatcher, and exchanges
+versioned typed messages over ordered per-peer Connections.  The v2-style
+connection bring-up is banner -> hello (peer name/type, nonce, session
+cookie, requested policy, optional HMAC auth over a shared secret — the
+cephx role, src/auth/) -> session.  Data frames carry a crc32 (ms_crc_data
+mode) and an optional zlib-compressed payload (compression_onwire.cc role,
+ms_compress_min_size).
 
-Transport is asyncio TCP on loopback (the standalone-test topology the
-reference uses, qa/standalone/ceph-helpers.sh); frames are
-[u32 length][u16 type][u32 version][payload].  Payloads are pickled dataclass
-fields — an internal trusted-cluster format; the reference's cross-version
-dencoder discipline is represented by the per-type version field checked on
-decode.
+Policies mirror the reference's (Policy::lossy_client vs lossless_peer),
+negotiated at handshake: on a lossless session BOTH sides keep one
+long-lived Connection object per peer session — frames are sequenced,
+acked, and kept queued until acked; after a transport drop the initiator
+reconnects and each side replays its un-acked frames onto the new transport
+(the server adopts the new socket into the existing session Connection, the
+reference's session-reconnect + out_queue replay, ProtocolV2.cc
+reuse_connection) — with receiver-side seq dedupe making dispatch
+exactly-once in both directions, the OSD<->OSD guarantee PG consistency is
+built on.  Lossy connections just fail and are replaced wholesale.
+
+A config-driven fault injector (ms_inject_socket_failures, ms_inject_delay_max;
+reference src/common/options/global.yaml.in:1240) severs connections to
+exercise those paths without code changes, and a dispatch throttle
+(ms_dispatch_throttle_bytes) applies receive-side backpressure.
+
+Payloads are pickled dataclass fields — an internal trusted-cluster format;
+the reference's cross-version dencoder discipline is represented by the
+per-type version field checked on decode (and exercised by tools/dencoder).
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
+import hashlib
+import hmac
+import json
 import pickle
 import random
 import struct
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Optional, Tuple
+import traceback
+import zlib
+from dataclasses import dataclass
+from typing import Any, Callable, Deque, Dict, Optional, Tuple
 
-_HDR = struct.Struct("<IHI")
+from ceph_tpu.common.throttle import Throttle
+
+BANNER = b"ceph_tpu msgr v2\n"
+_HDR = struct.Struct("<IHHBIQ")  # len, type, version, flags, crc, seq
+
+FLAG_COMPRESSED = 1
+
+ACK_TYPE = 0xFFF0  # control frame: payload is the acked seq (u64)
+
+MAX_SESSIONS = 4096  # LRU cap on server-side peer sessions
 
 # -- message registry --------------------------------------------------------
 
@@ -46,9 +78,8 @@ def message(type_id: int, version: int = 1):
     return deco
 
 
-def encode_message(msg: Any) -> bytes:
-    payload = pickle.dumps(msg.__dict__, protocol=5)
-    return _HDR.pack(len(payload), msg.TYPE_ID, msg.VERSION) + payload
+def encode_payload(msg: Any) -> bytes:
+    return pickle.dumps(msg.__dict__, protocol=5)
 
 
 def decode_message(type_id: int, version: int, payload: bytes) -> Any:
@@ -64,40 +95,173 @@ def decode_message(type_id: int, version: int, payload: bytes) -> Any:
     return obj
 
 
-# -- connection / messenger --------------------------------------------------
+class BadFrame(Exception):
+    pass
+
+
+
+
+# -- policies ----------------------------------------------------------------
+
+
+@dataclass
+class Policy:
+    lossy: bool = True
+    replay: bool = False  # keep unacked queue + replay on reconnect
+
+    @classmethod
+    def lossy_client(cls) -> "Policy":
+        return cls(lossy=True, replay=False)
+
+    @classmethod
+    def lossless_peer(cls) -> "Policy":
+        return cls(lossy=False, replay=True)
+
+
+def _cget(conf, key: str, default: Any) -> Any:
+    try:
+        v = conf.get(key, default)
+    except TypeError:
+        v = conf.get(key) if key in conf else default
+    return default if v is None else v
+
+
+# -- connection --------------------------------------------------------------
 
 
 class Connection:
-    def __init__(self, messenger: "Messenger", reader, writer, peer: Tuple[str, int]):
+    """One ordered session with a peer.  For lossless sessions this object
+    outlives TCP transports: seqs, the unacked queue, and the dedupe floor
+    persist while transports come and go (transport_gen fences stale serve
+    loops)."""
+
+    def __init__(self, messenger: "Messenger", reader, writer,
+                 peer: Tuple[str, int], policy: Policy,
+                 peer_name: str = "", outbound: bool = False):
         self.messenger = messenger
         self.reader = reader
         self.writer = writer
         self.peer = peer
+        self.peer_name = peer_name
+        self.policy = policy
+        self.outbound = outbound
         self.closed = False
+        self.transport_gen = 0
+        self.out_seq = 0
+        self.in_seq = 0  # highest data seq dispatched (dedupe floor)
+        # per-connection session id: acceptors key replay sessions on it, so
+        # a REPLACED connection never collides with its predecessor's seqs
+        self.session_id = random.randbytes(8).hex()
+        self.unacked: Deque[Tuple[int, bytes]] = collections.deque()
         self._send_lock = asyncio.Lock()
+        # crc/compression resolved once per connection (v2 negotiates at
+        # handshake time; avoids typed-config parsing on the hot path)
+        conf = messenger.conf
+        self.crc_enabled = bool(_cget(conf, "ms_crc_data", True))
+        self.compress_min = int(_cget(conf, "ms_compress_min_size", 0) or 0)
 
-    async def send(self, msg: Any) -> None:
-        inj = self.messenger.conf.get("ms_inject_socket_failures", 0)
-        if inj and random.randrange(inj) == 0:
-            await self.close()
-            raise ConnectionResetError("injected socket failure")
-        delay = self.messenger.conf.get("ms_inject_delay_max", 0)
-        if delay:
-            await asyncio.sleep(random.uniform(0, delay))
-        data = encode_message(msg)
+    # -- frame IO ------------------------------------------------------------
+
+    def _frame(self, type_id: int, version: int, payload: bytes, seq: int) -> bytes:
+        flags = 0
+        if self.compress_min and len(payload) >= self.compress_min:
+            compressed = zlib.compress(payload, 1)
+            if len(compressed) < len(payload):
+                payload = compressed
+                flags |= FLAG_COMPRESSED
+        crc = zlib.crc32(payload) if self.crc_enabled else 0
+        return _HDR.pack(len(payload), type_id, version, flags, crc, seq) + payload
+
+    async def _write_raw(self, data: bytes) -> None:
         async with self._send_lock:
             if self.closed:
                 raise ConnectionResetError("connection closed")
             self.writer.write(data)
             await self.writer.drain()
 
-    async def read_message(self) -> Any:
-        hdr = await self.reader.readexactly(_HDR.size)
-        length, type_id, version = _HDR.unpack(hdr)
-        payload = await self.reader.readexactly(length)
-        return decode_message(type_id, version, payload)
+    async def send(self, msg: Any) -> None:
+        conf = self.messenger.conf
+        inj = _cget(conf, "ms_inject_socket_failures", 0)
+        injected = bool(inj) and random.randrange(inj) == 0
+        if injected and not self.policy.replay:
+            await self.close()
+            raise ConnectionResetError("injected socket failure")
+        delay = _cget(conf, "ms_inject_delay_max", 0)
+        if delay:
+            await asyncio.sleep(random.uniform(0, delay))
+        self.out_seq += 1
+        seq = self.out_seq
+        data = self._frame(msg.TYPE_ID, msg.VERSION, encode_payload(msg), seq)
+        if self.policy.replay:
+            # lossless send never fails: the frame joins the session queue
+            # and reconnect+replay delivers it exactly once (reference
+            # lossless_peer out_queue semantics)
+            self.unacked.append((seq, data))
+            if injected:
+                # injected transport failure: frame stays queued, session
+                # survives, reconnect+replay delivers
+                await self.close()
+                return
+            try:
+                await self._write_raw(data)
+            except (ConnectionError, OSError):
+                await self.close()
+        else:
+            await self._write_raw(data)
 
-    async def close(self) -> None:
+    async def send_ack(self, seq: int) -> None:
+        payload = struct.pack("<Q", seq)
+        await self._write_raw(
+            _HDR.pack(8, ACK_TYPE, 1, 0, zlib.crc32(payload), 0) + payload
+        )
+
+    def handle_ack(self, seq: int) -> None:
+        while self.unacked and self.unacked[0][0] <= seq:
+            self.unacked.popleft()
+
+    async def read_frame(self) -> Tuple[int, int, int, bytes, int]:
+        """Returns (type_id, version, seq, payload, cost).  The dispatch
+        throttle is charged `cost` bytes BEFORE the payload is read
+        (receive-side backpressure, reference DispatchQueue throttle);
+        the caller must put() cost back when done with the payload."""
+        hdr = await self.reader.readexactly(_HDR.size)
+        length, type_id, version, flags, crc, seq = _HDR.unpack(hdr)
+        cost = length
+        await self.messenger.dispatch_throttle.get(cost)
+        try:
+            payload = await self.reader.readexactly(length)
+            if crc and self.crc_enabled and zlib.crc32(payload) != crc:
+                raise BadFrame(f"crc mismatch on frame type {type_id}")
+            if flags & FLAG_COMPRESSED:
+                payload = zlib.decompress(payload)
+        except BaseException:
+            self.messenger.dispatch_throttle.put(cost)
+            raise
+        return type_id, version, seq, payload, cost
+
+    async def adopt_transport(self, reader, writer) -> None:
+        """Adopt a fresh transport into this session and replay unacked
+        frames (both directions of the reference's session reconnect:
+        the initiator replays requests, the acceptor replays replies)."""
+        old_writer = self.writer
+        async with self._send_lock:
+            self.reader = reader
+            self.writer = writer
+            self.closed = False
+            self.transport_gen += 1
+            try:
+                old_writer.close()
+            except Exception:
+                pass
+            for _, data in list(self.unacked):
+                self.writer.write(data)
+            await self.writer.drain()
+
+    async def close(self, gen: Optional[int] = None) -> None:
+        """Close the current transport.  With gen, only close if the
+        transport hasn't been replaced since the caller observed it."""
+        if gen is not None and gen != self.transport_gen:
+            return
         if not self.closed:
             self.closed = True
             self.writer.close()
@@ -108,18 +272,105 @@ class Connection:
                 pass
 
 
+# -- messenger ---------------------------------------------------------------
+
+
 class Messenger:
     """One per daemon.  dispatcher(conn, msg) is awaited per message
-    (fast-dispatch style: no intermediate queue)."""
+    (fast-dispatch style); receive-side bytes ride a dispatch throttle."""
 
-    def __init__(self, name: str, conf: Optional[dict] = None):
+    def __init__(self, name: str, conf: Optional[Any] = None,
+                 entity_type: str = "client"):
         self.name = name
-        self.conf = conf or {}
+        self.conf = conf if conf is not None else {}
+        self.entity_type = entity_type
         self.dispatcher: Optional[Callable] = None
         self.server: Optional[asyncio.AbstractServer] = None
         self.addr: Optional[Tuple[str, int]] = None
         self._conns: Dict[Tuple[str, int], Connection] = {}
+        self._conn_locks: Dict[Tuple[str, int], asyncio.Lock] = {}
         self._tasks: set = set()
+        # reference defaults: clients are lossy, daemon peers lossless
+        self.policies: Dict[str, Policy] = {
+            "client": Policy.lossy_client(),
+            "osd": Policy.lossless_peer(),
+            "mon": Policy.lossless_peer(),
+            "mgr": Policy.lossless_peer(),
+        }
+        self.dispatch_throttle = Throttle(
+            f"{name}-dispatch", _cget(self.conf, "ms_dispatch_throttle_bytes", 100 << 20)
+        )
+        self._shutdown = False
+        # session id -> session Connection, LRU-capped (peers come and go)
+        self._sessions: "collections.OrderedDict[str, Connection]" = (
+            collections.OrderedDict()
+        )
+
+    def policy_for(self, peer_type: str) -> Policy:
+        return self.policies.get(peer_type, Policy.lossy_client())
+
+    # -- handshake -----------------------------------------------------------
+
+    def _auth_tag(self, nonce: bytes) -> str:
+        secret = str(_cget(self.conf, "ms_auth_secret", "") or "")
+        if not secret:
+            return ""
+        return hmac.new(secret.encode(), nonce, hashlib.sha256).hexdigest()
+
+    async def _handshake_out(self, reader, writer, lossless: bool,
+                             session_id: str) -> Tuple[str, bool]:
+        writer.write(BANNER)
+        nonce = random.randbytes(16)
+        hello = {"name": self.name, "type": self.entity_type,
+                 "nonce": nonce.hex(), "auth": "",
+                 "session": session_id, "lossless": lossless}
+        writer.write(json.dumps(hello).encode() + b"\n")
+        await writer.drain()
+        banner = await reader.readexactly(len(BANNER))
+        if banner != BANNER:
+            raise BadFrame("bad banner from peer")
+        peer_hello = json.loads(await reader.readline())
+        # acceptor proves knowledge of the secret by tagging OUR nonce
+        expect = self._auth_tag(nonce)
+        if expect and not hmac.compare_digest(peer_hello.get("auth", ""), expect):
+            raise PermissionError("peer failed auth (bad cluster secret)")
+        # then we prove ourselves by tagging THEIR nonce
+        their_nonce = bytes.fromhex(peer_hello.get("nonce", ""))
+        tag = self._auth_tag(their_nonce)
+        writer.write(json.dumps({"auth": tag}).encode() + b"\n")
+        await writer.drain()
+        fin = json.loads(await reader.readline())
+        if not fin.get("ok", False):
+            raise PermissionError("peer rejected our auth")
+        return peer_hello.get("name", ""), bool(peer_hello.get("resumed"))
+
+    async def _handshake_in(self, reader, writer) -> Tuple[str, str, str, bool]:
+        banner = await reader.readexactly(len(BANNER))
+        if banner != BANNER:
+            raise BadFrame("bad banner from peer")
+        peer_hello = json.loads(await reader.readline())
+        writer.write(BANNER)
+        nonce = random.randbytes(16)
+        their_nonce = bytes.fromhex(peer_hello.get("nonce", ""))
+        # tell the initiator whether we still hold its session: if not, it
+        # must reset its reply-dedupe floor (our out_seq restarts at 1)
+        resumed = peer_hello.get("session", "") in self._sessions
+        hello = {"name": self.name, "type": self.entity_type,
+                 "nonce": nonce.hex(), "auth": self._auth_tag(their_nonce),
+                 "resumed": resumed}
+        writer.write(json.dumps(hello).encode() + b"\n")
+        await writer.drain()
+        proof = json.loads(await reader.readline())
+        expect = self._auth_tag(nonce)
+        ok = not expect or hmac.compare_digest(proof.get("auth", ""), expect)
+        writer.write(json.dumps({"ok": ok}).encode() + b"\n")
+        await writer.drain()
+        if not ok:
+            raise PermissionError(f"auth failed for peer {peer_hello.get('name')}")
+        return (peer_hello.get("name", ""), peer_hello.get("type", "client"),
+                peer_hello.get("session", ""), bool(peer_hello.get("lossless")))
+
+    # -- lifecycle -----------------------------------------------------------
 
     async def bind(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
         self.server = await asyncio.start_server(self._accept, host, port)
@@ -128,60 +379,194 @@ class Messenger:
 
     async def _accept(self, reader, writer) -> None:
         peer = writer.get_extra_info("peername")[:2]
-        conn = Connection(self, reader, writer, peer)
         task = asyncio.current_task()
         self._tasks.add(task)
         try:
+            try:
+                peer_name, peer_type, cookie, lossless = await self._handshake_in(
+                    reader, writer
+                )
+            except (PermissionError, BadFrame, ConnectionError, json.JSONDecodeError,
+                    asyncio.IncompleteReadError, ValueError):
+                writer.close()
+                return
+            if lossless and cookie:
+                conn = self._sessions.get(cookie)
+                if conn is not None:
+                    # session reconnect: adopt the new socket, replay our
+                    # un-acked frames (e.g. replies lost in the drop)
+                    self._sessions.move_to_end(cookie)
+                    await conn.adopt_transport(reader, writer)
+                else:
+                    conn = Connection(self, reader, writer, peer,
+                                      Policy.lossless_peer(), peer_name)
+                    self._sessions[cookie] = conn
+                    while len(self._sessions) > MAX_SESSIONS:
+                        _, evicted = self._sessions.popitem(last=False)
+                        await evicted.close()
+            else:
+                conn = Connection(self, reader, writer, peer,
+                                  Policy.lossy_client(), peer_name)
             await self._serve(conn)
         finally:
             self._tasks.discard(task)
 
     async def _serve(self, conn: Connection) -> None:
+        gen = conn.transport_gen
         try:
-            while not conn.closed:
-                msg = await conn.read_message()
-                if self.dispatcher is not None:
-                    await self.dispatcher(conn, msg)
-        except (asyncio.IncompleteReadError, ConnectionError):
+            while not conn.closed and conn.transport_gen == gen:
+                type_id, version, seq, payload, cost = await conn.read_frame()
+                try:
+                    if conn.transport_gen != gen:
+                        return  # transport replaced while we were suspended
+                    if type_id == ACK_TYPE:
+                        conn.handle_ack(struct.unpack("<Q", payload)[0])
+                        continue
+                    if seq and seq <= conn.in_seq:
+                        # replayed duplicate: re-ack (the original ack may
+                        # have been lost in the drop) but don't re-dispatch
+                        await self._ack_quietly(conn, seq)
+                        continue
+                    try:
+                        msg = decode_message(type_id, version, payload)
+                    except Exception as e:
+                        # undecodable (type/version skew): poison-discard so
+                        # replay can't redeliver it forever
+                        print(f"messenger {self.name}: dropping undecodable "
+                              f"frame type={type_id} v={version}: {e}")
+                        if seq:
+                            conn.in_seq = seq
+                            await self._ack_quietly(conn, seq)
+                        continue
+                    try:
+                        if self.dispatcher is not None:
+                            await self.dispatcher(conn, msg)
+                    except (asyncio.CancelledError, GeneratorExit):
+                        raise
+                    except Exception:
+                        # a dispatcher bug must not wedge the session into
+                        # infinite redelivery; log loudly and consume
+                        traceback.print_exc()
+                    # ack AFTER dispatch: an ack'd frame is a consumed frame
+                    if seq:
+                        conn.in_seq = seq
+                        await self._ack_quietly(conn, seq)
+                finally:
+                    self.dispatch_throttle.put(cost)
+        except (asyncio.IncompleteReadError, ConnectionError, BadFrame):
             pass
         finally:
-            await conn.close()
+            await conn.close(gen)
+            # lossless sessions reconnect from the initiator side so queued
+            # frames (ours AND the acceptor's pending replies) replay even
+            # when no further application send would trigger it
+            if (conn.outbound and conn.policy.replay and conn.closed
+                    and not self._shutdown):
+                t = asyncio.get_running_loop().create_task(self._reconnect(conn))
+                self._tasks.add(t)
+                t.add_done_callback(self._tasks.discard)
 
-    async def connect(self, addr: Tuple[str, int]) -> Connection:
-        """Get (or create) an ordered connection to a peer; a cached dead
-        connection is replaced (lossless_peer reconnect semantics)."""
+    async def _reconnect(self, conn: Connection) -> None:
+        delay = 0.02
+        for _ in range(10):
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, 1.0)
+            if self._shutdown or self._conns.get(conn.peer) is not conn:
+                return
+            if not conn.closed:
+                return  # something else already revived it
+            try:
+                await self.connect(conn.peer)
+                return
+            except (ConnectionError, OSError):
+                continue
+        # peer looks gone for good: forget the session (the cluster map's
+        # failure detection is responsible for marking it down)
+        if self._conns.get(conn.peer) is conn:
+            self._conns.pop(conn.peer, None)
+
+    async def _ack_quietly(self, conn: Connection, seq: int) -> None:
+        try:
+            await conn.send_ack(seq)
+        except (ConnectionError, OSError):
+            pass
+
+    # -- outbound ------------------------------------------------------------
+
+    async def connect(self, addr: Tuple[str, int],
+                      peer_type: str = "osd") -> Connection:
+        """Get (or create) an ordered connection to a peer.  A cached dead
+        lossless connection is revived in place (same session state, fresh
+        transport, unacked replay); dead lossy connections are replaced.
+        Serialized per addr so concurrent senders share one session."""
         addr = tuple(addr)
         conn = self._conns.get(addr)
         if conn is not None and not conn.closed:
             return conn
-        reader, writer = await asyncio.open_connection(*addr)
-        conn = Connection(self, reader, writer, addr)
-        self._conns[addr] = conn
-        # serve replies arriving on the outbound connection too
-        task = asyncio.get_running_loop().create_task(self._serve(conn))
-        self._tasks.add(task)
-        task.add_done_callback(self._tasks.discard)
-        return conn
+        lock = self._conn_locks.setdefault(addr, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(addr)
+            if conn is not None and not conn.closed:
+                return conn
+            policy = self.policy_for(peer_type)
+            reviving = conn is not None and conn.policy.replay
+            session_id = conn.session_id if reviving else random.randbytes(8).hex()
+            reader, writer = await asyncio.open_connection(*addr)
+            try:
+                peer_name, resumed = await self._handshake_out(
+                    reader, writer, policy.replay, session_id
+                )
+            except Exception:
+                writer.close()
+                raise
+            if reviving:
+                if not resumed:
+                    # acceptor lost the session (restart/eviction): its reply
+                    # stream restarts at seq 1, so our dedupe floor must too.
+                    # Replayed frames may re-dispatch there (at-least-once
+                    # across an acceptor restart, as in the reference — PG
+                    # reqid dedupe above absorbs it).
+                    conn.in_seq = 0
+                await conn.adopt_transport(reader, writer)
+            else:
+                conn = Connection(self, reader, writer, addr, policy,
+                                  peer_name, outbound=True)
+                conn.session_id = session_id
+                self._conns[addr] = conn
+            # serve replies arriving on the outbound connection too
+            task = asyncio.get_running_loop().create_task(self._serve(conn))
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+            return conn
 
-    async def send(self, addr: Tuple[str, int], msg: Any, retries: int = 3) -> None:
+    async def send(self, addr: Tuple[str, int], msg: Any, retries: int = 3,
+                   peer_type: str = "osd") -> None:
         last: Optional[Exception] = None
         for _ in range(retries + 1):
             try:
-                conn = await self.connect(addr)
+                conn = await self.connect(addr, peer_type)
                 await conn.send(msg)
                 return
+            except PermissionError:
+                raise
             except (ConnectionError, OSError) as e:
                 last = e
-                self._conns.pop(tuple(addr), None)
+                conn = self._conns.get(tuple(addr))
+                if conn is not None and not conn.policy.replay:
+                    self._conns.pop(tuple(addr), None)
         raise last  # type: ignore[misc]
 
     async def shutdown(self) -> None:
+        self._shutdown = True
         # cancel serve loops FIRST: in py3.12 Server.wait_closed() waits for
         # all connection handlers, so live inbound loops would deadlock it
         for t in list(self._tasks):
             t.cancel()
         for conn in list(self._conns.values()):
             await conn.close()
+        for conn in list(self._sessions.values()):
+            await conn.close()
+        self._sessions.clear()
         if self.server is not None:
             self.server.close()
             try:

@@ -42,7 +42,7 @@ DEFAULT_STRIPE_UNIT = 4096  # reference osd_pool_erasure_code_stripe_unit
 class Monitor:
     def __init__(self, conf: Optional[dict] = None):
         self.conf = conf or {}
-        self.messenger = Messenger("mon", self.conf)
+        self.messenger = Messenger("mon", self.conf, entity_type="mon")
         self.osdmap = OSDMap(epoch=1, crush=CrushMap.flat([]))
         self._next_osd_id = 0
         self._next_pool_id = 1

@@ -65,7 +65,7 @@ class OSD:
         self.mon_addr = tuple(mon_addr)
         self.store = store or MemStore()
         self.osd_id = osd_id
-        self.messenger = Messenger(f"osd.{osd_id}", self.conf)
+        self.messenger = Messenger(f"osd.{osd_id}", self.conf, entity_type="osd")
         self.osdmap: Optional[OSDMap] = None
         self._codecs: Dict[int, object] = {}
         self._pending: Dict[str, asyncio.Future] = {}

@@ -1,0 +1,345 @@
+"""Messenger v2 protocol tests: handshake/auth, crc, compression, lossless
+replay with exactly-once dispatch, dispatch throttle, fault injection
+(reference src/msg/async/ProtocolV2.cc behaviors)."""
+
+import asyncio
+import struct
+import zlib
+
+import pytest
+
+from ceph_tpu.rados.messenger import (
+    ACK_TYPE,
+    BadFrame,
+    Messenger,
+    Policy,
+    _HDR,
+    message,
+)
+
+
+@message(900)
+class MTest:
+    text: str = ""
+    blob: bytes = b""
+    seqno: int = 0
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _pair(server_conf=None, client_conf=None, server_type="osd",
+                client_type="osd"):
+    server = Messenger("server", server_conf or {}, entity_type=server_type)
+    client = Messenger("client", client_conf or {}, entity_type=client_type)
+    addr = await server.bind()
+    return server, client, addr
+
+
+class TestHandshakeAuth:
+    def test_plain_connect_and_exchange(self):
+        async def go():
+            server, client, addr = await _pair()
+            got = asyncio.Queue()
+
+            async def dispatch(conn, msg):
+                await got.put(msg)
+
+            server.dispatcher = dispatch
+            await client.send(addr, MTest(text="hello"))
+            msg = await asyncio.wait_for(got.get(), 2)
+            assert msg.text == "hello"
+            await client.shutdown()
+            await server.shutdown()
+
+        run(go())
+
+    def test_peer_name_flows_through_handshake(self):
+        async def go():
+            server, client, addr = await _pair()
+            names = []
+            server.dispatcher = lambda conn, msg: names.append(conn.peer_name) or _noop()
+            conn = await client.connect(addr)
+            assert conn.peer_name == "server"
+            await client.shutdown()
+            await server.shutdown()
+
+        async def _noop():
+            return None
+
+        run(go())
+
+    def test_auth_mutual_success(self):
+        async def go():
+            conf = {"ms_auth_secret": "sesame"}
+            server, client, addr = await _pair(conf, conf)
+            got = asyncio.Queue()
+
+            async def dispatch(conn, msg):
+                await got.put(msg)
+
+            server.dispatcher = dispatch
+            await client.send(addr, MTest(text="authed"))
+            assert (await asyncio.wait_for(got.get(), 2)).text == "authed"
+            await client.shutdown()
+            await server.shutdown()
+
+        run(go())
+
+    def test_auth_reject_bad_secret(self):
+        async def go():
+            server, client, addr = await _pair({"ms_auth_secret": "right"},
+                                               {"ms_auth_secret": "wrong"})
+            with pytest.raises((PermissionError, ConnectionError, OSError)):
+                await client.send(addr, MTest(text="nope"), retries=0)
+            await client.shutdown()
+            await server.shutdown()
+
+        run(go())
+
+    def test_auth_reject_secretless_client(self):
+        async def go():
+            server, client, addr = await _pair({"ms_auth_secret": "right"}, {})
+            with pytest.raises((PermissionError, ConnectionError, OSError)):
+                await client.send(addr, MTest(text="nope"), retries=0)
+            await client.shutdown()
+            await server.shutdown()
+
+        run(go())
+
+
+class TestFrames:
+    def test_crc_detects_corruption(self):
+        async def go():
+            server, client, addr = await _pair()
+            got = asyncio.Queue()
+
+            async def dispatch(conn, msg):
+                await got.put(msg)
+
+            server.dispatcher = dispatch
+            conn = await client.connect(addr)
+            # hand-corrupt a frame: flip a payload byte after framing
+            from ceph_tpu.rados.messenger import encode_payload
+
+            payload = encode_payload(MTest(text="x" * 100))
+            crc = zlib.crc32(payload)
+            frame = bytearray(_HDR.pack(len(payload), 900, 1, 0, crc, 1) + payload)
+            frame[-1] ^= 0xFF
+            conn.writer.write(bytes(frame))
+            await conn.writer.drain()
+            # server must drop the connection, not dispatch garbage
+            with pytest.raises(asyncio.TimeoutError):
+                await asyncio.wait_for(got.get(), 0.3)
+            await client.shutdown()
+            await server.shutdown()
+
+        run(go())
+
+    def test_compression_roundtrip(self):
+        async def go():
+            conf = {"ms_compress_min_size": 64}
+            server, client, addr = await _pair(conf, conf)
+            got = asyncio.Queue()
+
+            async def dispatch(conn, msg):
+                await got.put(msg)
+
+            server.dispatcher = dispatch
+            blob = b"A" * 100_000  # compressible
+            await client.send(addr, MTest(text="big", blob=blob))
+            msg = await asyncio.wait_for(got.get(), 2)
+            assert msg.blob == blob
+            await client.shutdown()
+            await server.shutdown()
+
+        run(go())
+
+
+class TestLosslessReplay:
+    def test_exactly_once_under_injected_failures(self):
+        async def go():
+            # every ~6th send attempt severs the connection; lossless policy
+            # must reconnect + replay, and dedupe must prevent double dispatch
+            server, client, addr = await _pair(
+                client_conf={"ms_inject_socket_failures": 6}
+            )
+            received = []
+
+            async def dispatch(conn, msg):
+                received.append(msg.seqno)
+
+            server.dispatcher = dispatch
+            n = 60
+            for i in range(n):
+                await client.send(addr, MTest(seqno=i), retries=8)
+            # acks drain asynchronously; wait for all dispatches
+            for _ in range(100):
+                if len(set(received)) == n:
+                    break
+                await asyncio.sleep(0.05)
+            assert sorted(set(received)) == list(range(n))
+            assert len(received) == len(set(received)), "duplicate dispatch"
+            await client.shutdown()
+            await server.shutdown()
+
+        run(go())
+
+    def test_bidirectional_rpc_exactly_once_under_failures(self):
+        async def go():
+            # failures injected on BOTH sides: requests and replies each get
+            # dropped mid-flight; session replay must deliver every request
+            # once to the server and every reply once to the client
+            server, client, addr = await _pair(
+                server_conf={"ms_inject_socket_failures": 8},
+                client_conf={"ms_inject_socket_failures": 8},
+            )
+            served = []
+            replies = []
+
+            async def server_dispatch(conn, msg):
+                served.append(msg.seqno)
+                for attempt in range(8):
+                    try:
+                        await conn.send(MTest(text="reply", seqno=msg.seqno))
+                        return
+                    except ConnectionError:
+                        await asyncio.sleep(0.02)
+
+            async def client_dispatch(conn, msg):
+                replies.append(msg.seqno)
+
+            server.dispatcher = server_dispatch
+            client.dispatcher = client_dispatch
+            n = 40
+            for i in range(n):
+                await client.send(addr, MTest(seqno=i), retries=10)
+            for _ in range(200):
+                if len(replies) >= n:
+                    break
+                await asyncio.sleep(0.05)
+            assert sorted(served) == list(range(n)), "request loss/dup"
+            assert sorted(replies) == list(range(n)), "reply loss/dup"
+            await client.shutdown()
+            await server.shutdown()
+
+        run(go())
+
+    def test_unacked_queue_trims_on_ack(self):
+        async def go():
+            server, client, addr = await _pair()
+            server.dispatcher = _swallow
+            conn = await client.connect(addr, peer_type="osd")
+            assert conn.policy.replay
+            for i in range(10):
+                await client.send(addr, MTest(seqno=i))
+            for _ in range(100):
+                if not conn.unacked:
+                    break
+                await asyncio.sleep(0.02)
+            assert not conn.unacked
+            await client.shutdown()
+            await server.shutdown()
+
+        run(go())
+
+    def test_acceptor_session_loss_resets_dedupe_floor(self):
+        async def go():
+            # the acceptor forgetting a session (restart/LRU eviction) must
+            # not leave the initiator deaf to the fresh reply stream
+            server, client, addr = await _pair()
+            replies = []
+
+            async def server_dispatch(conn, msg):
+                await conn.send(MTest(text="reply", seqno=msg.seqno))
+
+            async def client_dispatch(conn, msg):
+                replies.append(msg.seqno)
+
+            server.dispatcher = server_dispatch
+            client.dispatcher = client_dispatch
+            for i in range(5):
+                await client.send(addr, MTest(seqno=i))
+            for _ in range(100):
+                if len(replies) == 5:
+                    break
+                await asyncio.sleep(0.02)
+            assert sorted(replies) == list(range(5))
+            conn = client._conns[tuple(addr)]
+            assert conn.in_seq >= 5
+            # acceptor drops the session and severs the transport
+            for sess in server._sessions.values():
+                await sess.close()
+            server._sessions.clear()
+            for _ in range(100):
+                if conn.closed:
+                    break
+                await asyncio.sleep(0.02)
+            # reconnect happens automatically; new replies (seq restarting
+            # at 1 on the server's fresh session) must still dispatch
+            await client.send(addr, MTest(seqno=100), retries=8)
+            for _ in range(200):
+                if 100 in replies:
+                    break
+                await asyncio.sleep(0.02)
+            assert 100 in replies, "reply stream deaf after session loss"
+            await client.shutdown()
+            await server.shutdown()
+
+        run(go())
+
+    def test_lossy_client_does_not_queue(self):
+        async def go():
+            server, client, addr = await _pair()
+            server.dispatcher = _swallow
+            conn = await client.connect(addr, peer_type="client")
+            assert not conn.policy.replay
+            await conn.send(MTest(seqno=1))
+            assert not conn.unacked
+            await client.shutdown()
+            await server.shutdown()
+
+        run(go())
+
+
+async def _swallow(conn, msg):
+    return None
+
+
+class TestDispatchThrottle:
+    def test_throttle_applies_backpressure(self):
+        async def go():
+            server, client, addr = await _pair(
+                server_conf={"ms_dispatch_throttle_bytes": 1}
+            )
+            # 1-byte budget: each frame exceeds it, but an idle throttle
+            # admits one oversize request at a time -> strictly serial
+            inflight = []
+            peak = []
+
+            async def dispatch(conn, msg):
+                inflight.append(1)
+                peak.append(len(inflight))
+                await asyncio.sleep(0.02)
+                inflight.pop()
+
+            server.dispatcher = dispatch
+            await asyncio.gather(
+                *(client.send(addr, MTest(blob=b"x" * 100)) for _ in range(5))
+            )
+            await asyncio.sleep(0.5)
+            assert peak and max(peak) == 1
+            await client.shutdown()
+            await server.shutdown()
+
+        run(go())
+
+
+class TestPolicyTable:
+    def test_defaults(self):
+        m = Messenger("x", {})
+        assert m.policy_for("client").lossy
+        assert not m.policy_for("osd").lossy
+        assert m.policy_for("mon").replay
+        assert m.policy_for("unknown").lossy
