@@ -13,7 +13,11 @@ import uuid
 from typing import Dict, List, Optional, Tuple
 
 from ceph_tpu.rados.messenger import Messenger
+from ceph_tpu.rados.monclient import MonTargets
 from ceph_tpu.rados.types import (
+    MConfigGet,
+    MConfigReply,
+    MConfigSet,
     MCreatePool,
     MCreatePoolReply,
     MGetMap,
@@ -30,8 +34,9 @@ class RadosError(Exception):
 
 
 class RadosClient:
-    def __init__(self, mon_addr: Tuple[str, int], conf: Optional[dict] = None):
-        self.mon_addr = tuple(mon_addr)
+    def __init__(self, mon_addr, conf: Optional[dict] = None):
+        # one mon addr or a monmap list; RPCs rotate on mon failure
+        self.mons = MonTargets(mon_addr)
         self.conf = conf or {}
         self.op_timeout = self.conf.get("client_op_timeout", 10.0)
         self.messenger = Messenger("client", self.conf, entity_type="client")
@@ -51,7 +56,7 @@ class RadosClient:
         await self.messenger.shutdown()
 
     async def _dispatch(self, conn, msg) -> None:
-        if isinstance(msg, (MMapReply, MCreatePoolReply)):
+        if isinstance(msg, (MMapReply, MCreatePoolReply, MConfigReply)):
             # the mon echoes our per-RPC tid (like MOSDOp's reqid): a reply
             # landing after its RPC timed out has a stale tid and is dropped
             # instead of fulfilling the next RPC's future
@@ -66,12 +71,23 @@ class RadosClient:
             if fut and not fut.done():
                 fut.set_result(msg)
 
+    @property
+    def mon_addr(self) -> Tuple[str, int]:
+        return self.mons.current
+
     async def _mon_rpc(self, msg):
         async with self._mon_lock:
-            self._mon_tid = msg.tid = uuid.uuid4().hex
-            self._mon_fut = asyncio.get_running_loop().create_future()
-            await self.messenger.send(self.mon_addr, msg)
-            return await asyncio.wait_for(self._mon_fut, timeout=10)
+            last: Exception = TimeoutError("no mon reachable")
+            for _ in range(len(self.mons)):
+                self._mon_tid = msg.tid = uuid.uuid4().hex
+                self._mon_fut = asyncio.get_running_loop().create_future()
+                try:
+                    await self.messenger.send(self.mons.current, msg)
+                    return await asyncio.wait_for(self._mon_fut, timeout=5)
+                except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                    last = e
+                    self.mons.rotate()
+            raise last
 
     async def refresh_map(self) -> OSDMap:
         reply = await self._mon_rpc(MGetMap())
@@ -91,6 +107,17 @@ class RadosClient:
         await self.refresh_map()
         return reply.pool_id
 
+    async def config_set(self, key: str, value: str) -> None:
+        """Centralized config: `ceph config set` equivalent (replicated by
+        the mon quorum, distributed to daemons at boot)."""
+        reply = await self._mon_rpc(MConfigSet(key=key, value=str(value)))
+        if not reply.ok:
+            raise RadosError(reply.error)
+
+    async def config_get(self, key: str = "") -> Dict[str, str]:
+        reply = await self._mon_rpc(MConfigGet(key=key))
+        return reply.values
+
     async def mark_osd_down(self, osd_id: int) -> None:
         """Admin: immediately mark an OSD down+out (test/thrash hook)."""
         await self._mon_rpc(MMarkDown(osd_id=osd_id))
@@ -105,7 +132,17 @@ class RadosClient:
         for attempt in range(retries):
             pool = self.osdmap.pools.get(op.pool_id)
             if pool is None:
-                raise RadosError(f"pool {op.pool_id} does not exist")
+                # a lagging mon may have served us a pre-creation map:
+                # refresh-and-retry (Objecter catches up across epochs)
+                if attempt == retries - 1:
+                    raise RadosError(f"pool {op.pool_id} does not exist")
+                last_error = f"pool {op.pool_id} not in map epoch {self.osdmap.epoch}"
+                await asyncio.sleep(0.2 * (attempt + 1))
+                try:
+                    await self.refresh_map()
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    pass
+                continue
             pg = self.osdmap.object_to_pg(pool, op.oid)
             acting = self.osdmap.pg_to_acting(pool, pg)
             primary = self.osdmap.primary_of(acting)

@@ -1,34 +1,54 @@
 """Monitor: cluster-map authority (maps only — never on the data path).
 
-Role-equivalent of the reference's mon (reference src/mon/Monitor.h:108,
-OSDMonitor): allocates OSD ids at boot, tracks liveness from heartbeats and
-marks laggards down (failure detection, SURVEY.md §5.3), owns pool/EC-profile
-lifecycle — profiles are validated by instantiating the codec through the
-plugin registry exactly like OSDMonitor::normalize_profile
-(OSDMonitor.cc:7329), and stripe_width is computed from the codec's own
-chunk-size rule (prepare_pool_stripe_width, OSDMonitor.cc:7628) — and bumps
-the epoch on every change.  Single monitor: the reference's Paxos quorum is
-out of scope for this slice (documented gap; the map-distribution protocol
-is the part the data path depends on).
+Role-equivalent of the reference's mon (reference src/mon/Monitor.h:108):
+a quorum of monitors replicates all cluster state — the OSDMap, the
+centralized config database, id allocators — through a single Paxos log
+(src/mon/Paxos.h:174; our ceph_tpu.rados.paxos).  The leader (lowest rank
+winning a rank-based election, src/mon/Elector.cc) drives all mutations;
+peons forward client writes to the leader (reference MForward) and serve
+map reads locally under a lease the leader renews (Paxos::lease_*).  Losing
+quorum blocks writes; elections re-run when the leader's lease lapses.
+
+OSDMonitor duties live here too: OSD id allocation at boot, liveness from
+pings with mark-down/out of laggards (failure detection, SURVEY.md §5.3),
+and pool/EC-profile lifecycle — profiles are validated by instantiating the
+codec through the plugin registry exactly like OSDMonitor::normalize_profile
+(OSDMonitor.cc:7329), stripe_width computed from the codec's own chunk-size
+rule (prepare_pool_stripe_width, OSDMonitor.cc:7628).  The ConfigMonitor
+(src/mon/ConfigMonitor.cc) replicates `config set` keys and distributes
+them to daemons at boot (daemons install them as their "mon" config layer).
+
+Each mon persists committed state in a MonitorDBStore; a restarted mon
+recovers its state from disk and syncs forward via the collect phase.
 """
 
 from __future__ import annotations
 
 import asyncio
+import pickle
 import time
-from typing import Dict, Optional, Tuple
+import uuid
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ceph_tpu.ec.interface import ErasureCodeError
 from ceph_tpu.ec.registry import registry
 from ceph_tpu.rados.crush import CrushMap
 from ceph_tpu.rados.messenger import Messenger
+from ceph_tpu.rados.paxos import ElectionLogic, MonitorDBStore, Paxos
 from ceph_tpu.rados.types import (
     MBootReply,
+    MConfigGet,
+    MConfigReply,
+    MConfigSet,
     MCreatePool,
     MCreatePoolReply,
+    MForward,
+    MForwardReply,
     MGetMap,
     MMapReply,
     MMarkDown,
+    MMonElection,
+    MMonPaxos,
     MOsdBoot,
     MPing,
     OSDMap,
@@ -39,96 +59,519 @@ from ceph_tpu.rados.types import (
 DEFAULT_STRIPE_UNIT = 4096  # reference osd_pool_erasure_code_stripe_unit
 
 
+class NoQuorum(Exception):
+    pass
+
+
 class Monitor:
-    def __init__(self, conf: Optional[dict] = None):
+    def __init__(self, conf: Optional[dict] = None, rank: int = 0,
+                 monmap: Optional[List[Tuple[str, int]]] = None,
+                 data_path: Optional[str] = None):
         self.conf = conf or {}
-        self.messenger = Messenger("mon", self.conf, entity_type="mon")
+        self.rank = rank
+        self.monmap = [tuple(a) for a in monmap] if monmap else None
+        self.messenger = Messenger(f"mon.{rank}", self.conf, entity_type="mon")
+        self.store = MonitorDBStore(data_path)
+        n = len(self.monmap) if self.monmap else 1
+        self.logic = ElectionLogic(rank, n)
+        self.paxos = Paxos(self.store, rank, self._paxos_send)
+        self.paxos.on_commit = self._apply_committed
+        # replicated state machine
         self.osdmap = OSDMap(epoch=1, crush=CrushMap.flat([]))
+        self.cluster_conf: Dict[str, str] = {}
         self._next_osd_id = 0
         self._next_pool_id = 1
+        # recover committed state from a previous life
+        _, latest = self.store.latest()
+        if latest is not None:
+            self._apply_committed(self.store.last_committed, latest)
+        # runtime
         self._last_ping: Dict[int, float] = {}
         self._grace = self.conf.get("mon_osd_report_grace", 1.5)
+        self._lease = float(self.conf.get("mon_lease", 5.0))
+        self._election_timeout = float(self.conf.get("mon_election_timeout", 0.5))
+        self._last_lease_renew = 0.0
         self._tick_task: Optional[asyncio.Task] = None
+        self._election_task: Optional[asyncio.Task] = None
         self.addr: Optional[Tuple[str, int]] = None
+        self._commit_lock = asyncio.Lock()
+        self._accept_event: Optional[asyncio.Event] = None
+        self._pending_forwards: Dict[str, Any] = {}  # tid -> (conn, stamp)
+        # recently-executed write tids -> reply: suppresses re-execution of
+        # messenger-replayed/forward-retried writes (PG-reqid-dedupe role)
+        self._applied_tids: "Dict[str, Any]" = {}
+        self._stopped = False
+
+    # -- replicated state (de)serialization ----------------------------------
+
+    def _snapshot_state(self) -> bytes:
+        return pickle.dumps(
+            {
+                "osdmap": self.osdmap,
+                "cluster_conf": self.cluster_conf,
+                "next_osd_id": self._next_osd_id,
+                "next_pool_id": self._next_pool_id,
+            },
+            protocol=5,
+        )
+
+    def _apply_committed(self, version: int, value: bytes) -> None:
+        state = pickle.loads(value)
+        new_map = state["osdmap"]
+        if new_map.epoch >= self.osdmap.epoch:
+            self.osdmap = new_map
+        self.cluster_conf = state["cluster_conf"]
+        self._next_osd_id = max(self._next_osd_id, state["next_osd_id"])
+        self._next_pool_id = max(self._next_pool_id, state["next_pool_id"])
+
+    # -- lifecycle -----------------------------------------------------------
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
         self.messenger.dispatcher = self._dispatch
+        if self.monmap:
+            host, port = self.monmap[self.rank]
         self.addr = await self.messenger.bind(host, port)
+        if self.monmap is None:
+            self.monmap = [self.addr]
+        if len(self.monmap) == 1:
+            # single mon: trivially leader of a one-man quorum
+            self.logic.start()
+            self.logic.acked_by = {self.rank}
+            self.logic.declare_victory()
+        else:
+            self._election_task = asyncio.get_running_loop().create_task(
+                self._run_election()
+            )
         self._tick_task = asyncio.get_running_loop().create_task(self._tick())
         return self.addr
 
     async def stop(self) -> None:
-        if self._tick_task:
-            self._tick_task.cancel()
+        self._stopped = True
+        for t in (self._tick_task, self._election_task):
+            if t:
+                t.cancel()
         await self.messenger.shutdown()
 
-    def _bump(self) -> None:
-        self.osdmap.epoch += 1
+    @property
+    def is_leader(self) -> bool:
+        return self.logic.is_leader
 
-    # -- liveness ------------------------------------------------------------
+    @property
+    def leader_addr(self) -> Optional[Tuple[str, int]]:
+        if self.logic.leader is None:
+            return None
+        return self.monmap[self.logic.leader]
+
+    def quorum_status(self) -> Dict[str, Any]:
+        return {
+            "rank": self.rank,
+            "election_epoch": self.logic.epoch,
+            "leader": self.logic.leader,
+            "quorum": sorted(self.logic.quorum),
+            "is_leader": self.is_leader,
+            "map_epoch": self.osdmap.epoch,
+            "paxos_version": self.store.last_committed,
+        }
+
+    # -- elections -----------------------------------------------------------
+
+    async def _run_election(self) -> None:
+        """Candidate loop: propose, gather acks, declare victory or retry."""
+        await asyncio.sleep(0.05 * self.rank)  # stagger: let rank 0 go first
+        while not self._stopped and not self.logic.in_quorum:
+            epoch = self.logic.start()
+            await self._broadcast(MMonElection(op="propose", epoch=epoch,
+                                               rank=self.rank))
+            await asyncio.sleep(self._election_timeout)
+            if not self.logic.electing:
+                return  # lost to a better candidate mid-wait
+            if len(self.logic.acked_by) >= self.logic.majority:
+                epoch, quorum = self.logic.declare_victory()
+                await self._broadcast(MMonElection(op="victory", epoch=epoch,
+                                                   rank=self.rank,
+                                                   quorum=sorted(quorum)))
+                await self._on_won_election()
+                return
+
+    async def _on_won_election(self) -> None:
+        """Collect: bring the quorum to the newest committed state, then
+        re-propose it so laggards (including us) sync."""
+        for peer in self.logic.quorum:
+            if peer != self.rank:
+                await self._paxos_send(peer, {"op": "collect"})
+        await asyncio.sleep(min(0.3, self._election_timeout))
+        self._last_lease_renew = time.monotonic()
+        # start every up OSD's liveness countdown at takeover: an OSD that
+        # died before we became leader must still go laggard -> down
+        now = time.monotonic()
+        for osd_id, info in self.osdmap.osds.items():
+            if info.up:
+                self._last_ping.setdefault(osd_id, now)
+        try:
+            await self._commit_state()
+        except NoQuorum:
+            pass
+
+    def _spawn_election(self) -> None:
+        if self._election_task is None or self._election_task.done():
+            self._election_task = asyncio.get_running_loop().create_task(
+                self._run_election()
+            )
+
+    async def _handle_election(self, msg: MMonElection) -> None:
+        if msg.op == "propose":
+            verdict = self.logic.receive_propose(msg.rank, msg.epoch)
+            if verdict == "ack":
+                # carry OUR epoch so a restarted candidate catches up
+                await self._send_rank(
+                    msg.rank,
+                    MMonElection(op="ack", epoch=self.logic.epoch,
+                                 rank=self.rank))
+                # if no victory follows, the lease-lapse tick re-elects
+            elif verdict == "counter":
+                self._spawn_election()
+        elif msg.op == "ack":
+            if self.logic.receive_ack(msg.rank, msg.epoch):
+                pass  # majority reached; _run_election declares victory
+        elif msg.op == "victory":
+            if not self.logic.receive_victory(msg.rank, msg.epoch,
+                                              set(msg.quorum)):
+                # stale victory from a restarted mon: wake it into a real
+                # election at the current epoch
+                await self._send_rank(
+                    msg.rank,
+                    MMonElection(op="propose", epoch=self.logic.epoch,
+                                 rank=self.rank))
+                self._spawn_election()
+            else:
+                self._last_lease_renew = time.monotonic()
+
+    # -- paxos transport -----------------------------------------------------
+
+    async def _paxos_send(self, peer_rank: int, payload: Dict[str, Any]) -> None:
+        try:
+            await self._send_rank(peer_rank,
+                                  MMonPaxos(rank=self.rank, payload=payload))
+        except (ConnectionError, OSError):
+            pass
+
+    async def _handle_paxos(self, msg: MMonPaxos) -> None:
+        p = msg.payload
+        op = p.get("op")
+        if op == "collect":
+            await self._paxos_send(msg.rank, self.paxos.collect_state())
+        elif op == "last":
+            self.paxos.absorb_last(p)
+        elif op == "begin":
+            await self.paxos.handle_begin(msg.rank, p["version"], p["value"])
+        elif op == "accept":
+            if self.paxos.handle_accept(msg.rank, p["version"]):
+                if self._accept_event:
+                    self._accept_event.set()
+        elif op == "commit":
+            self.paxos.handle_commit(p["version"], p["value"])
+        elif op == "lease":
+            self._last_lease_renew = time.monotonic()
+            # lease implies this leader's quorum view
+            self.logic.receive_victory(msg.rank, p.get("epoch", self.logic.epoch),
+                                       set(p.get("quorum", [])))
+            # a lease can readmit a restarted mon before any election ran:
+            # if the leader is ahead, pull the state we missed
+            if p.get("version", 0) > self.store.last_committed:
+                await self._paxos_send(msg.rank, {"op": "sync_req"})
+        elif op == "sync_req":
+            v, val = self.store.latest()
+            if val is not None:
+                await self._paxos_send(msg.rank, {"op": "commit", "version": v,
+                                                  "value": val})
+
+    async def _commit_state(self) -> None:
+        """Replicate the current state snapshot; blocks until majority."""
+        async with self._commit_lock:
+            quorum = self.logic.quorum or {self.rank}
+            if not self.is_leader:
+                raise NoQuorum("not the leader")
+            if len(quorum) < self.logic.majority:
+                raise NoQuorum("quorum too small")
+            self._accept_event = asyncio.Event()
+            await self.paxos.propose(self._snapshot_state(), quorum)
+            need = len(quorum) // 2 + 1
+            if len(self.paxos.accepts) < need:
+                try:
+                    await asyncio.wait_for(self._accept_event.wait(),
+                                           timeout=self._lease)
+                except asyncio.TimeoutError:
+                    self.paxos.proposing = None
+                    raise NoQuorum("proposal not accepted by majority")
+            await self.paxos.commit_current()
+
+    # -- ticks: leases, liveness --------------------------------------------
 
     async def _tick(self) -> None:
-        while True:
-            await asyncio.sleep(self._grace / 3)
+        while not self._stopped:
+            await asyncio.sleep(min(self._grace / 3, self._lease / 3))
             now = time.monotonic()
-            changed = False
-            for osd_id, info in self.osdmap.osds.items():
-                if info.up and now - self._last_ping.get(osd_id, now) > self._grace:
-                    info.up = False
-                    info.in_cluster = False  # auto-out for remap (mon_osd_down_out)
-                    changed = True
-            if changed:
-                self._bump()
+            if self.is_leader:
+                # renew peon leases
+                if len(self.monmap) > 1:
+                    for peer in self.logic.quorum:
+                        if peer != self.rank:
+                            await self._paxos_send(
+                                peer, {"op": "lease", "epoch": self.logic.epoch,
+                                       "quorum": sorted(self.logic.quorum),
+                                       "version": self.store.last_committed})
+                # OSD liveness: mark laggards down+out (countdown starts at
+                # first observation, so a never-pinging OSD still expires)
+                changed = False
+                for osd_id, info in self.osdmap.osds.items():
+                    if not info.up:
+                        continue
+                    last = self._last_ping.setdefault(osd_id, now)
+                    if now - last > self._grace:
+                        info.up = False
+                        info.in_cluster = False  # auto-out for remap
+                        changed = True
+                if changed:
+                    self.osdmap.epoch += 1
+                    try:
+                        await self._commit_state()
+                    except NoQuorum:
+                        pass
+            elif len(self.monmap) > 1:
+                # leaderless (rejoin, lost election round) or lease lapsed
+                # (leader died): elect
+                if (self.logic.leader is None
+                        or now - self._last_lease_renew > self._lease):
+                    if now - self._last_lease_renew > self._lease:
+                        self.logic.leader = None
+                        self.logic.quorum = set()
+                    self._spawn_election()
+            # prune forwarded requests whose leader never replied
+            if self._pending_forwards:
+                cutoff = now - 2 * self._lease
+                for tid, (_fconn, t0) in list(self._pending_forwards.items()):
+                    if t0 < cutoff:
+                        self._pending_forwards.pop(tid, None)
+
+    # -- mon-mon send helpers ------------------------------------------------
+
+    async def _send_rank(self, peer_rank: int, msg: Any) -> None:
+        await self.messenger.send(self.monmap[peer_rank], msg, peer_type="mon")
+
+    async def _broadcast(self, msg: Any) -> None:
+        for r in range(len(self.monmap)):
+            if r != self.rank:
+                try:
+                    await self._send_rank(r, msg)
+                except (ConnectionError, OSError):
+                    pass
 
     # -- dispatch ------------------------------------------------------------
 
+    WRITE_TYPES = (MOsdBoot, MCreatePool, MMarkDown, MConfigSet)
+
     async def _dispatch(self, conn, msg) -> None:
-        if isinstance(msg, MGetMap):
+        if isinstance(msg, MMonElection):
+            await self._handle_election(msg)
+        elif isinstance(msg, MMonPaxos):
+            await self._handle_paxos(msg)
+        elif isinstance(msg, MForward):
+            reply = await self._process_write(pickle.loads(msg.inner))
+            await self._send_rank(
+                msg.from_rank,
+                MForwardReply(tid=msg.tid, inner=pickle.dumps(reply, protocol=5)),
+            )
+        elif isinstance(msg, MForwardReply):
+            entry = self._pending_forwards.pop(msg.tid, None)
+            if entry is not None:
+                try:
+                    await entry[0].send(pickle.loads(msg.inner))
+                except (ConnectionError, OSError):
+                    pass
+        elif isinstance(msg, MGetMap):
             await conn.send(MMapReply(osdmap=self.osdmap, tid=msg.tid))
-        elif isinstance(msg, MOsdBoot):
-            osd_id = msg.osd_id
-            if osd_id < 0:
-                osd_id = self._next_osd_id
-                self._next_osd_id += 1
-            info = self.osdmap.osds.get(osd_id)
-            if info is None:
-                self.osdmap.osds[osd_id] = OsdInfo(osd_id=osd_id, addr=tuple(msg.addr))
-                self.osdmap.crush = CrushMap.flat(sorted(self.osdmap.osds))
-                # re-register rules on the rebuilt map, preserving each
-                # pool's placement mode (indep for EC, firstn for replicated)
-                for pool in self.osdmap.pools.values():
-                    self.osdmap.crush.add_simple_rule(
-                        pool.rule,
-                        mode="indep" if pool.pool_type == "ec" else "firstn",
-                    )
-            else:
-                info.addr = tuple(msg.addr)
-                info.up = True
-                info.in_cluster = True
-            self._last_ping[osd_id] = time.monotonic()
-            self._bump()
-            await conn.send(MBootReply(osd_id=osd_id, osdmap=self.osdmap))
+        elif isinstance(msg, MConfigGet):
+            values = ({msg.key: self.cluster_conf.get(msg.key, "")}
+                      if msg.key else dict(self.cluster_conf))
+            await conn.send(MConfigReply(tid=msg.tid, values=values))
         elif isinstance(msg, MPing):
-            self._last_ping[msg.osd_id] = time.monotonic()
-            info = self.osdmap.osds.get(msg.osd_id)
-            if info is not None and not info.up:
-                info.up = True
-                info.in_cluster = True
-                self._bump()
+            await self._handle_ping(conn, msg)
+        elif isinstance(msg, self.WRITE_TYPES):
+            if self.is_leader:
+                reply = await self._process_write(msg)
+                try:
+                    await conn.send(reply)
+                except (ConnectionError, OSError):
+                    pass
+            elif self.leader_addr is not None:
+                tid = uuid.uuid4().hex
+                self._pending_forwards[tid] = (conn, time.monotonic())
+                try:
+                    await self._send_rank(
+                        self.logic.leader,
+                        MForward(tid=tid, from_rank=self.rank,
+                                 inner=pickle.dumps(msg, protocol=5)),
+                    )
+                except (ConnectionError, OSError):
+                    self._pending_forwards.pop(tid, None)
+                    reply = self._error_reply(msg, "leader unreachable")
+                    if reply is not None:
+                        await conn.send(reply)
+            else:
+                reply = self._error_reply(msg, "no quorum")
+                if reply is not None:
+                    await conn.send(reply)
+
+    async def _handle_ping(self, conn, msg: MPing) -> None:
+        if not self.is_leader:
+            # relay liveness to the leader (fire and forget; a dead leader
+            # is the lease-lapse path's problem, not the ping's)
+            if self.leader_addr is not None:
+                try:
+                    await self._send_rank(
+                        self.logic.leader,
+                        MForward(tid="", from_rank=self.rank,
+                                 inner=pickle.dumps(msg, protocol=5)),
+                    )
+                except (ConnectionError, OSError):
+                    pass
             if msg.epoch < self.osdmap.epoch:
                 await conn.send(MMapReply(osdmap=self.osdmap))
-        elif isinstance(msg, MMarkDown):
+            return
+        await self._process_ping(msg)
+        if msg.epoch < self.osdmap.epoch:
+            try:
+                await conn.send(MMapReply(osdmap=self.osdmap))
+            except (ConnectionError, OSError):
+                pass
+
+    async def _process_ping(self, msg: MPing) -> None:
+        self._last_ping[msg.osd_id] = time.monotonic()
+        info = self.osdmap.osds.get(msg.osd_id)
+        if info is not None and not info.up:
+            info.up = True
+            info.in_cluster = True
+            self.osdmap.epoch += 1
+            try:
+                await self._commit_state()
+            except NoQuorum:
+                return
+            # push the new map straight to the rejoining OSD
+            if msg.addr and msg.addr[0]:
+                try:
+                    await self.messenger.send(tuple(msg.addr),
+                                              MMapReply(osdmap=self.osdmap))
+                except (ConnectionError, OSError):
+                    pass
+
+    # -- writes (leader only) ------------------------------------------------
+
+    async def _process_write(self, msg: Any) -> Any:
+        """Apply one mutating request and replicate; returns the reply.
+        Re-executions (messenger replay, forward retry) are suppressed by
+        tid; a failed consensus round rolls the in-memory state back so a
+        write reported failed cannot leak into a later snapshot."""
+        tid = getattr(msg, "tid", "")
+        if tid and tid in self._applied_tids:
+            return self._applied_tids[tid]
+        backup = self._snapshot_state()
+        try:
+            reply = await self._process_write_inner(msg)
+        except NoQuorum as e:
+            self._restore_state(backup)
+            reply = self._error_reply(msg, str(e))
+            if reply is None:
+                raise
+            return reply
+        if tid:
+            self._applied_tids[tid] = reply
+            while len(self._applied_tids) > 1024:
+                self._applied_tids.pop(next(iter(self._applied_tids)))
+        return reply
+
+    def _restore_state(self, backup: bytes) -> None:
+        state = pickle.loads(backup)
+        self.osdmap = state["osdmap"]
+        self.cluster_conf = state["cluster_conf"]
+        self._next_osd_id = state["next_osd_id"]
+        self._next_pool_id = state["next_pool_id"]
+
+    async def _process_write_inner(self, msg: Any) -> Any:
+        if isinstance(msg, MPing):  # forwarded liveness
+            await self._process_ping(msg)
+            return MMapReply(osdmap=self.osdmap)
+        if isinstance(msg, MOsdBoot):
+            return await self._process_boot(msg)
+        if isinstance(msg, MCreatePool):
+            reply = self._create_pool(msg)
+            reply.tid = msg.tid
+            if reply.ok:
+                await self._commit_state()
+            return reply
+        if isinstance(msg, MMarkDown):
             info = self.osdmap.osds.get(msg.osd_id)
             if info is not None and info.up:
                 info.up = False
                 info.in_cluster = False
                 self._last_ping[msg.osd_id] = -1e9
-                self._bump()
-            await conn.send(MMapReply(osdmap=self.osdmap, tid=msg.tid))
-        elif isinstance(msg, MCreatePool):
-            reply = self._create_pool(msg)
-            reply.tid = msg.tid
-            await conn.send(reply)
+                self.osdmap.epoch += 1
+                await self._commit_state()
+            return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+        if isinstance(msg, MConfigSet):
+            if not msg.remove:
+                # validate against the option schema before replicating
+                # (reference: `config set` rejects bad values at the mon)
+                from ceph_tpu.common.config import Config
+
+                try:
+                    Config().set(msg.key, msg.value)
+                except ValueError as e:
+                    return MConfigReply(tid=msg.tid, ok=False, error=str(e))
+            if msg.remove:
+                self.cluster_conf.pop(msg.key, None)
+            else:
+                self.cluster_conf[msg.key] = msg.value
+            await self._commit_state()
+            return MConfigReply(tid=msg.tid, values=dict(self.cluster_conf))
+        raise ValueError(f"unhandled write {type(msg).__name__}")
+
+    def _error_reply(self, msg: Any, error: str) -> Any:
+        tid = getattr(msg, "tid", "")
+        if isinstance(msg, MCreatePool):
+            return MCreatePoolReply(tid=tid, ok=False, error=error)
+        if isinstance(msg, MConfigSet):
+            return MConfigReply(tid=tid, ok=False, error=error)
+        if isinstance(msg, (MMarkDown, MGetMap, MPing)):
+            return MMapReply(osdmap=self.osdmap, tid=tid)
+        if isinstance(msg, MOsdBoot):
+            return MBootReply(osd_id=-1, osdmap=self.osdmap, tid=tid)
+        return None
+
+    async def _process_boot(self, msg: MOsdBoot) -> MBootReply:
+        osd_id = msg.osd_id
+        if osd_id < 0:
+            osd_id = self._next_osd_id
+            self._next_osd_id += 1
+        info = self.osdmap.osds.get(osd_id)
+        if info is None:
+            self.osdmap.osds[osd_id] = OsdInfo(osd_id=osd_id, addr=tuple(msg.addr))
+            self.osdmap.crush = CrushMap.flat(sorted(self.osdmap.osds))
+            # re-register rules on the rebuilt map, preserving each pool's
+            # placement mode (indep for EC, firstn for replicated)
+            for pool in self.osdmap.pools.values():
+                self.osdmap.crush.add_simple_rule(
+                    pool.rule,
+                    mode="indep" if pool.pool_type == "ec" else "firstn",
+                )
+        else:
+            info.addr = tuple(msg.addr)
+            info.up = True
+            info.in_cluster = True
+        self._last_ping[osd_id] = time.monotonic()
+        self.osdmap.epoch += 1
+        await self._commit_state()
+        return MBootReply(osd_id=osd_id, osdmap=self.osdmap, tid=msg.tid,
+                          cluster_conf=dict(self.cluster_conf))
 
     # -- pool / profile lifecycle -------------------------------------------
 
@@ -178,5 +621,5 @@ class Monitor:
             rule=rule,
             stripe_width=stripe_width,
         )
-        self._bump()
+        self.osdmap.epoch += 1
         return MCreatePoolReply(ok=True, pool_id=pool_id)

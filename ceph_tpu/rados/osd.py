@@ -30,6 +30,7 @@ from ceph_tpu.ec.interface import ErasureCodeError
 from ceph_tpu.ec.registry import registry
 from ceph_tpu.rados.crush import CRUSH_ITEM_NONE
 from ceph_tpu.rados.messenger import Messenger
+from ceph_tpu.rados.monclient import MonTargets
 from ceph_tpu.rados.store import MemStore, ObjectStore, ShardMeta, Transaction, shard_crc
 from ceph_tpu.rados.types import (
     MBootReply,
@@ -62,7 +63,8 @@ class OSD:
         osd_id: int = -1,
     ):
         self.conf = conf or {}
-        self.mon_addr = tuple(mon_addr)
+        # one mon addr or a monmap list; RPCs rotate on mon failure
+        self.mons = MonTargets(mon_addr)
         self.store = store or MemStore()
         self.osd_id = osd_id
         self.messenger = Messenger(f"osd.{osd_id}", self.conf, entity_type="osd")
@@ -81,10 +83,32 @@ class OSD:
         self.messenger.dispatcher = self._dispatch
         self.addr = await self.messenger.bind()
         boot = MOsdBoot(osd_id=self.osd_id, addr=self.addr)
-        reply = await self._mon_rpc(boot, MBootReply)
+        # a no-quorum window answers boot with osd_id=-1: retry, don't run
+        # as a ghost daemon the mon will never recognize
+        for attempt in range(8):
+            reply = await self._mon_rpc(boot, MBootReply)
+            if reply.osd_id >= 0:
+                break
+            self.mons.rotate()
+            await asyncio.sleep(0.25 * (attempt + 1))
+        else:
+            raise RuntimeError("mon refused boot (no quorum?)")
         self.osd_id = reply.osd_id
         self.messenger.name = f"osd.{self.osd_id}"
         self.osdmap = reply.osdmap
+        # centralized config distributed at boot (ConfigMonitor role)
+        cluster_conf = getattr(reply, "cluster_conf", None)
+        if cluster_conf:
+            if hasattr(self.conf, "set"):
+                # per-key: one bad replicated value must not brick boot
+                for k, v in cluster_conf.items():
+                    try:
+                        self.conf.set(k, v, source="mon")
+                    except ValueError:
+                        pass
+            else:
+                for k, v in cluster_conf.items():
+                    self.conf.setdefault(k, v)
         interval = self.conf.get("osd_heartbeat_interval", 0.3)
         self._ping_task = asyncio.get_running_loop().create_task(self._ping_loop(interval))
         return self.osd_id
@@ -96,24 +120,38 @@ class OSD:
                 t.cancel()
         await self.messenger.shutdown()
 
+    @property
+    def mon_addr(self):
+        return self.mons.current
+
     async def _ping_loop(self, interval: float) -> None:
         while not self._stopped:
             try:
                 await self.messenger.send(
-                    self.mon_addr,
-                    MPing(osd_id=self.osd_id, epoch=self.osdmap.epoch if self.osdmap else 0),
+                    self.mons.current,
+                    MPing(osd_id=self.osd_id,
+                          epoch=self.osdmap.epoch if self.osdmap else 0,
+                          addr=self.addr or ("", 0)),
                 )
             except Exception:
-                pass
+                self.mons.rotate()  # that mon looks dead
             await asyncio.sleep(interval)
 
     async def _mon_rpc(self, msg, reply_type):
-        """Send to mon and wait for the typed reply on the same connection."""
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        """Send to a mon and wait for the typed reply; rotate through the
+        monmap on timeout (peons forward writes to the leader)."""
         key = f"monrpc-{reply_type.__name__}"
-        self._pending[key] = fut
-        await self.messenger.send(self.mon_addr, msg)
-        return await asyncio.wait_for(fut, timeout=10)
+        last: Exception = TimeoutError("no mon reachable")
+        for _ in range(len(self.mons)):
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._pending[key] = fut
+            try:
+                await self.messenger.send(self.mons.current, msg)
+                return await asyncio.wait_for(fut, timeout=10)
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                last = e
+                self.mons.rotate()
+        raise last
 
     # -- codecs --------------------------------------------------------------
 

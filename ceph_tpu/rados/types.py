@@ -95,16 +95,19 @@ class MMapReply:
     tid: str = ""
 
 
-@message(3)
+@message(3, version=2)
 class MOsdBoot:
     osd_id: int = -1  # -1: allocate
     addr: Tuple[int, int] = (0, 0)
+    tid: str = ""
 
 
-@message(4)
+@message(4, version=2)
 class MBootReply:
     osd_id: int = 0
     osdmap: OSDMap = None
+    tid: str = ""
+    cluster_conf: Dict[str, str] = field(default_factory=dict)
 
 
 @message(5)
@@ -124,16 +127,74 @@ class MCreatePoolReply:
     pool_id: int = -1
 
 
-@message(7)
+@message(7, version=2)
 class MPing:
     osd_id: int = 0
     epoch: int = 0
+    addr: Tuple[str, int] = ("", 0)  # for direct map pushes from the leader
 
 
 @message(8)
 class MMarkDown:
     osd_id: int = 0
     tid: str = ""
+
+
+# Mon <-> mon (consensus; reference src/messages/MMonElection.h, MMonPaxos.h)
+
+
+@message(10)
+class MMonElection:
+    op: str = "propose"  # propose | ack | victory
+    epoch: int = 0
+    rank: int = 0
+    quorum: List[int] = field(default_factory=list)
+
+
+@message(11)
+class MMonPaxos:
+    rank: int = 0
+    payload: Dict = field(default_factory=dict)  # op/version/value/...
+
+
+@message(12)
+class MForward:
+    """Peon -> leader relay of a client request (reference MForward)."""
+
+    tid: str = ""
+    from_rank: int = 0
+    inner: bytes = b""  # pickled client message
+
+
+@message(13)
+class MForwardReply:
+    tid: str = ""
+    inner: bytes = b""  # pickled reply message
+
+
+# Centralized config (reference src/mon/ConfigMonitor.cc)
+
+
+@message(14)
+class MConfigSet:
+    tid: str = ""
+    key: str = ""
+    value: str = ""
+    remove: bool = False
+
+
+@message(15)
+class MConfigGet:
+    tid: str = ""
+    key: str = ""  # empty: dump all
+
+
+@message(16)
+class MConfigReply:
+    tid: str = ""
+    ok: bool = True
+    error: str = ""
+    values: Dict[str, str] = field(default_factory=dict)
 
 
 # Client <-> primary OSD

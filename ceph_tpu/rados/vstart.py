@@ -22,18 +22,66 @@ from ceph_tpu.rados.store import DirStore, MemStore
 
 class Cluster:
     def __init__(self, n_osds: int = 5, conf: Optional[dict] = None,
-                 data_dir: Optional[str] = None):
+                 data_dir: Optional[str] = None, n_mons: int = 1):
         self.conf = conf or {}
         self.n_osds = n_osds
+        self.n_mons = n_mons
         self.data_dir = data_dir
-        self.mon = Monitor(self.conf)
+        self.mons: List[Monitor] = []
         self.osds: Dict[int, OSD] = {}
         self._next_store = 0  # monotonic: store dirs never reused after kills
 
+    @property
+    def mon(self) -> Monitor:
+        """First still-running mon (single-mon clusters: the mon)."""
+        return self.mons[0]
+
+    @property
+    def mon_addrs(self) -> List:
+        return [m.addr for m in self.mons if m.addr]
+
+    @staticmethod
+    def _free_ports(n: int) -> List[int]:
+        import socket
+
+        socks, ports = [], []
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+        for s in socks:
+            s.close()
+        return ports
+
     async def start(self) -> None:
-        await self.mon.start()
+        if self.n_mons == 1:
+            mon = Monitor(self.conf,
+                          data_path=(f"{self.data_dir}/mon.0/store.db"
+                                     if self.data_dir else None))
+            await mon.start()
+            self.mons = [mon]
+        else:
+            monmap = [("127.0.0.1", p) for p in self._free_ports(self.n_mons)]
+            self.mons = [
+                Monitor(self.conf, rank=r, monmap=monmap,
+                        data_path=(f"{self.data_dir}/mon.{r}/store.db"
+                                   if self.data_dir else None))
+                for r in range(self.n_mons)
+            ]
+            for mon in self.mons:
+                await mon.start()
+            await self.wait_for_quorum()
         for i in range(self.n_osds):
             await self.add_osd()
+
+    async def wait_for_quorum(self, timeout: float = 10.0) -> None:
+        deadline = asyncio.get_running_loop().time() + timeout
+        while asyncio.get_running_loop().time() < deadline:
+            if any(m.is_leader for m in self.mons):
+                return
+            await asyncio.sleep(0.05)
+        raise TimeoutError("mon quorum did not form")
 
     async def add_osd(self) -> OSD:
         store = (
@@ -42,7 +90,7 @@ class Cluster:
             else MemStore()
         )
         self._next_store += 1
-        osd = OSD(self.mon.addr, store=store, conf=self.conf)
+        osd = OSD(self.mon_addrs, store=store, conf=self.conf)
         osd_id = await osd.start()
         self.osds[osd_id] = osd
         return osd
@@ -53,8 +101,16 @@ class Cluster:
         if osd is not None:
             await osd.stop()
 
+    async def kill_mon(self, rank: int) -> None:
+        """Hard-stop a monitor and drop it from the cluster's view
+        (leader-failover exercise)."""
+        for m in list(self.mons):
+            if m.rank == rank:
+                await m.stop()
+                self.mons.remove(m)
+
     async def client(self) -> RadosClient:
-        c = RadosClient(self.mon.addr, self.conf)
+        c = RadosClient(self.mon_addrs, self.conf)
         await c.start()
         await c.refresh_map()
         return c
@@ -62,13 +118,15 @@ class Cluster:
     async def stop(self) -> None:
         for osd in list(self.osds.values()):
             await osd.stop()
-        await self.mon.stop()
+        for mon in self.mons:
+            await mon.stop()
 
 
 async def _main(args) -> None:
-    cluster = Cluster(n_osds=args.osds, data_dir=args.data_dir)
+    cluster = Cluster(n_osds=args.osds, data_dir=args.data_dir,
+                      n_mons=args.mons)
     await cluster.start()
-    print(f"mon at {cluster.mon.addr}; {args.osds} OSDs up. Ctrl-C to stop.")
+    print(f"mons at {cluster.mon_addrs}; {args.osds} OSDs up. Ctrl-C to stop.")
     try:
         while True:
             await asyncio.sleep(3600)
@@ -81,5 +139,6 @@ async def _main(args) -> None:
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--osds", type=int, default=5)
+    p.add_argument("--mons", type=int, default=1)
     p.add_argument("--data-dir", default=None)
     asyncio.run(_main(p.parse_args()))
