@@ -145,7 +145,7 @@ class RadosClient:
                 continue
             pg = self.osdmap.object_to_pg(pool, op.oid)
             acting = self.osdmap.pg_to_acting(pool, pg)
-            primary = self.osdmap.primary_of(acting)
+            primary = self.osdmap.primary_of(acting, seed=(op.pool_id << 20) | pg)
             if primary is None:
                 last_error = "no primary (all acting osds down)"
             else:

@@ -52,6 +52,7 @@ from ceph_tpu.rados.types import (
     MOsdBoot,
     MPing,
     OSDMap,
+    OSDMapIncremental,
     OsdInfo,
     PoolInfo,
 )
@@ -81,6 +82,8 @@ class Monitor:
         self.cluster_conf: Dict[str, str] = {}
         self._next_osd_id = 0
         self._next_pool_id = 1
+        self._inc_ring: Dict[int, OSDMapIncremental] = {}
+        self._published: Optional[OSDMap] = None
         # recover committed state from a previous life
         _, latest = self.store.latest()
         if latest is not None:
@@ -123,6 +126,38 @@ class Monitor:
         self.cluster_conf = state["cluster_conf"]
         self._next_osd_id = max(self._next_osd_id, state["next_osd_id"])
         self._next_pool_id = max(self._next_pool_id, state["next_pool_id"])
+        # publish an incremental for subscribers lagging a few epochs
+        # (reference: mon hands out OSDMap::Incremental ranges, full map
+        # only when the gap exceeds what it kept)
+        prev = self._published
+        cur = self.osdmap
+        if prev is not None and cur.epoch > prev.epoch:
+            inc = OSDMapIncremental.diff(prev, cur)
+            self._inc_ring[inc.base_epoch] = inc  # keyed for O(1) chaining
+            while len(self._inc_ring) > 64:
+                self._inc_ring.pop(min(self._inc_ring))
+        if prev is None or cur.epoch > prev.epoch:
+            # `value` is already a pickled copy of this state: one loads
+            # gives an independent snapshot at half the dumps+loads cost
+            self._published = pickle.loads(value)["osdmap"]
+
+    def _map_reply_for(self, since_epoch: int, tid: str = "") -> MMapReply:
+        """Incremental chain when we still hold every delta past
+        since_epoch; full map otherwise."""
+        cur = self.osdmap
+        if 0 < since_epoch < cur.epoch:
+            chain: List[OSDMapIncremental] = []
+            e = since_epoch
+            while e < cur.epoch:
+                nxt = self._inc_ring.get(e)
+                if nxt is None:
+                    chain = []
+                    break
+                chain.append(nxt)
+                e = nxt.epoch
+            if chain:
+                return MMapReply(incrementals=chain, tid=tid)
+        return MMapReply(osdmap=cur, tid=tid)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -389,7 +424,7 @@ class Monitor:
                 except (ConnectionError, OSError):
                     pass
         elif isinstance(msg, MGetMap):
-            await conn.send(MMapReply(osdmap=self.osdmap, tid=msg.tid))
+            await conn.send(self._map_reply_for(msg.min_epoch, tid=msg.tid))
         elif isinstance(msg, MConfigGet):
             values = ({msg.key: self.cluster_conf.get(msg.key, "")}
                       if msg.key else dict(self.cluster_conf))
@@ -441,7 +476,7 @@ class Monitor:
         await self._process_ping(msg)
         if msg.epoch < self.osdmap.epoch:
             try:
-                await conn.send(MMapReply(osdmap=self.osdmap))
+                await conn.send(self._map_reply_for(msg.epoch))
             except (ConnectionError, OSError):
                 pass
 
@@ -555,14 +590,7 @@ class Monitor:
         info = self.osdmap.osds.get(osd_id)
         if info is None:
             self.osdmap.osds[osd_id] = OsdInfo(osd_id=osd_id, addr=tuple(msg.addr))
-            self.osdmap.crush = CrushMap.flat(sorted(self.osdmap.osds))
-            # re-register rules on the rebuilt map, preserving each pool's
-            # placement mode (indep for EC, firstn for replicated)
-            for pool in self.osdmap.pools.values():
-                self.osdmap.crush.add_simple_rule(
-                    pool.rule,
-                    mode="indep" if pool.pool_type == "ec" else "firstn",
-                )
+            self._rebuild_crush()
         else:
             info.addr = tuple(msg.addr)
             info.up = True
@@ -574,6 +602,22 @@ class Monitor:
                           cluster_conf=dict(self.cluster_conf))
 
     # -- pool / profile lifecycle -------------------------------------------
+
+    def _rebuild_crush(self) -> None:
+        """Rebuild the crush tree over the current OSD set (flat by
+        default; host buckets when crush_num_hosts is configured) and
+        re-register every pool's rule with its failure domain."""
+        ids = sorted(self.osdmap.osds)
+        n_hosts = int(self.conf.get("crush_num_hosts", 0) or 0)
+        self.osdmap.crush = (
+            CrushMap.with_hosts(ids, n_hosts) if n_hosts else CrushMap.flat(ids)
+        )
+        for pool in self.osdmap.pools.values():
+            self.osdmap.crush.add_simple_rule(
+                pool.rule,
+                failure_domain=pool.profile.get("crush-failure-domain", "osd"),
+                mode="indep" if pool.pool_type == "ec" else "firstn",
+            )
 
     def _create_pool(self, msg: MCreatePool) -> MCreatePoolReply:
         try:
@@ -608,7 +652,9 @@ class Monitor:
         self._next_pool_id += 1
         rule = f"{msg.name}-rule"
         self.osdmap.crush.add_simple_rule(
-            rule, mode="indep" if msg.pool_type == "ec" else "firstn"
+            rule,
+            failure_domain=profile.get("crush-failure-domain", "osd"),
+            mode="indep" if msg.pool_type == "ec" else "firstn",
         )
         self.osdmap.pools[pool_id] = PoolInfo(
             pool_id=pool_id,

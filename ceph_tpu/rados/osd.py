@@ -20,6 +20,7 @@ round-2 work).
 from __future__ import annotations
 
 import asyncio
+import pickle
 import time
 import uuid
 from typing import Dict, List, Optional, Set, Tuple
@@ -34,6 +35,7 @@ from ceph_tpu.rados.monclient import MonTargets
 from ceph_tpu.rados.store import MemStore, ObjectStore, ShardMeta, Transaction, shard_crc
 from ceph_tpu.rados.types import (
     MBootReply,
+    MGetMap,
     MECSubDelete,
     MECSubRead,
     MECSubReadReply,
@@ -169,7 +171,16 @@ class OSD:
 
     async def _dispatch(self, conn, msg) -> None:
         if isinstance(msg, MMapReply):
-            self._on_map(msg.osdmap)
+            if msg.osdmap is not None:
+                self._on_map(msg.osdmap)
+            elif msg.incrementals and self.osdmap is not None:
+                # apply the delta chain to a copy; on a broken chain fall
+                # back to a full-map fetch (reference subscriber behavior)
+                m = pickle.loads(pickle.dumps(self.osdmap, protocol=5))
+                if all(m.apply_incremental(inc) for inc in msg.incrementals):
+                    self._on_map(m)
+                else:
+                    asyncio.get_running_loop().create_task(self._fetch_full_map())
             fut = self._pending.pop("monrpc-MMapReply", None)
             if fut and not fut.done():
                 fut.set_result(msg)
@@ -197,6 +208,12 @@ class OSD:
             q = self._collectors.get(msg.tid)
             if q is not None:
                 q.put_nowait(msg)
+
+    async def _fetch_full_map(self) -> None:
+        try:
+            await self._mon_rpc(MGetMap(min_epoch=0), MMapReply)
+        except Exception:
+            pass
 
     def _on_map(self, osdmap: OSDMap) -> None:
         old = self.osdmap
@@ -277,11 +294,14 @@ class OSD:
         pg = self.osdmap.object_to_pg(pool, oid)
         return pg, self.osdmap.pg_to_acting(pool, pg)
 
+    def _primary(self, pool: PoolInfo, pg: int, acting: List[int]):
+        return self.osdmap.primary_of(acting, seed=(pool.pool_id << 20) | pg)
+
     async def _do_write(self, op: MOSDOp) -> MOSDOpReply:
         pool = self.osdmap.pools[op.pool_id]
         codec = self._codec(pool)
         pg, acting = self._acting(pool, op.oid)
-        if self.osdmap.primary_of(acting) != self.osd_id:
+        if self._primary(pool, pg, acting) != self.osd_id:
             return MOSDOpReply(ok=False, error="not primary")
         live = [a for a in acting if a != CRUSH_ITEM_NONE]
         if len(live) < pool.min_size:
@@ -590,7 +610,7 @@ class OSD:
         pushed = 0
         for oid, locs in holdings.items():
             pg, acting = self._acting(pool, oid)
-            if self.osdmap.primary_of(acting) != self.osd_id:
+            if self._primary(pool, pg, acting) != self.osd_id:
                 continue
             newest = max(v for (_, _, v) in locs)
             have = {shard: osd for shard, osd, v in locs if v == newest}

@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ceph_tpu.rados.crush import CRUSH_ITEM_NONE, CrushMap
+from ceph_tpu.rados.crush import _mix as _crush_mix
 from ceph_tpu.rados.messenger import message
 
 
@@ -41,10 +42,17 @@ class OsdInfo:
 
 @dataclass
 class OSDMap:
+    """Epoch-versioned cluster map (reference src/osd/OSDMap.{h,cc}):
+    OSD states, pools, crush, plus pg_temp overrides (temporary acting sets
+    installed during recovery, _pg_to_up_acting_osds OSDMap.cc:2673) and
+    per-OSD primary affinity (probabilistic primary demotion)."""
+
     epoch: int = 0
     osds: Dict[int, OsdInfo] = field(default_factory=dict)
     pools: Dict[int, PoolInfo] = field(default_factory=dict)
     crush: CrushMap = field(default_factory=lambda: CrushMap.flat([]))
+    pg_temp: Dict[Tuple[int, int], List[int]] = field(default_factory=dict)
+    primary_affinity: Dict[int, float] = field(default_factory=dict)
 
     def pool_by_name(self, name: str) -> Optional[PoolInfo]:
         for p in self.pools.values():
@@ -56,27 +64,123 @@ class OSDMap:
         h = hashlib.blake2s(oid.encode(), digest_size=4).digest()
         return int.from_bytes(h, "little") % pool.pg_num
 
-    def pg_to_acting(self, pool: PoolInfo, pg: int) -> List[int]:
-        """Acting set for a PG: crush indep over in+weighted OSDs; up=false
-        members become holes (EC positions are stable; holes stay holes)."""
+    def pg_to_raw(self, pool: PoolInfo, pg: int) -> List[int]:
+        """CRUSH output before up/pg_temp filtering (_pg_to_raw_osds)."""
         weights = {
-            o.osd_id: (o.weight if o.in_cluster else 0.0) for o in self.osds.values()
+            o.osd_id: (o.weight if o.in_cluster else 0.0)
+            for o in self.osds.values()
         }
         x = (pool.pool_id << 20) | pg
-        acting = self.crush.do_rule(pool.rule or "default-ec", x, pool.size, weights)
+        return self.crush.do_rule(pool.rule or "default-ec", x, pool.size, weights)
+
+    def pg_to_acting(self, pool: PoolInfo, pg: int) -> List[int]:
+        """Acting set for a PG: crush indep over in+weighted OSDs; up=false
+        members become holes (EC positions are stable; holes stay holes).
+        A pg_temp entry overrides the crush result wholesale
+        (_pg_to_up_acting_osds applying pg_temp, OSDMap.cc:2673)."""
+        temp = self.pg_temp.get((pool.pool_id, pg))
+        acting = list(temp) if temp is not None else self.pg_to_raw(pool, pg)
         return [
-            a if a != CRUSH_ITEM_NONE and self.osds.get(a) and self.osds[a].up else CRUSH_ITEM_NONE
+            a if a != CRUSH_ITEM_NONE and self.osds.get(a) and self.osds[a].up
+            else CRUSH_ITEM_NONE
             for a in acting
         ]
 
-    def primary_of(self, acting: List[int]) -> Optional[int]:
-        for a in acting:
-            if a != CRUSH_ITEM_NONE:
+    def primary_of(self, acting: List[int], seed: int = 0) -> Optional[int]:
+        """First non-hole, demoted past low-affinity OSDs when a later
+        candidate exists (primary-affinity semantics, OSDMap.cc
+        _apply_primary_affinity).  `seed` is the PG id so affinity demotes
+        a FRACTION of PGs, with a process-independent hash."""
+        candidates = [a for a in acting if a != CRUSH_ITEM_NONE]
+        if not candidates:
+            return None
+        for a in candidates:
+            aff = self.primary_affinity.get(a, 1.0)
+            if aff >= 1.0:
                 return a
-        return None
+            draw = (_crush_mix(seed, a) & 0xFFFF) / 65536.0
+            if draw < aff:
+                return a
+        return candidates[0]
 
     def addr_of(self, osd_id: int) -> Tuple[str, int]:
         return self.osds[osd_id].addr
+
+    def apply_incremental(self, inc: "OSDMapIncremental") -> bool:
+        """Apply a delta (reference OSDMap::Incremental): returns False if
+        the delta doesn't chain onto our epoch (caller must fetch full)."""
+        if inc.base_epoch != self.epoch:
+            return False
+        for osd_id, info in inc.new_osds.items():
+            self.osds[osd_id] = info
+        for osd_id, (up, in_cluster) in inc.osd_states.items():
+            if osd_id in self.osds:
+                self.osds[osd_id].up = up
+                self.osds[osd_id].in_cluster = in_cluster
+        for pool_id, pool in inc.new_pools.items():
+            self.pools[pool_id] = pool
+        for pool_id in inc.removed_pools:
+            self.pools.pop(pool_id, None)
+        for key, acting in inc.new_pg_temp.items():
+            if acting:
+                self.pg_temp[key] = acting
+            else:
+                self.pg_temp.pop(key, None)
+        for osd_id, aff in inc.new_primary_affinity.items():
+            self.primary_affinity[osd_id] = aff
+        if inc.crush is not None:
+            self.crush = inc.crush
+        self.epoch = inc.epoch
+        return True
+
+
+@dataclass
+class OSDMapIncremental:
+    """Delta between consecutive epochs (reference OSDMap::Incremental,
+    OSDMap.h) — what the mon publishes to subscribers instead of full maps
+    when the gap is small."""
+
+    epoch: int = 0
+    base_epoch: int = 0
+    new_osds: Dict[int, OsdInfo] = field(default_factory=dict)
+    osd_states: Dict[int, Tuple[bool, bool]] = field(default_factory=dict)
+    new_pools: Dict[int, PoolInfo] = field(default_factory=dict)
+    removed_pools: List[int] = field(default_factory=list)
+    new_pg_temp: Dict[Tuple[int, int], List[int]] = field(default_factory=dict)
+    new_primary_affinity: Dict[int, float] = field(default_factory=dict)
+    crush: Optional[CrushMap] = None
+
+    @classmethod
+    def diff(cls, old: "OSDMap", new: "OSDMap") -> "OSDMapIncremental":
+        inc = cls(epoch=new.epoch, base_epoch=old.epoch)
+        for osd_id, info in new.osds.items():
+            if osd_id not in old.osds:
+                inc.new_osds[osd_id] = info
+            else:
+                o = old.osds[osd_id]
+                if (o.addr, o.weight) != (info.addr, info.weight):
+                    # addr/weight change (e.g. restart on a new port) ships
+                    # the whole record — state-only deltas stay compact
+                    inc.new_osds[osd_id] = info
+                elif (o.up, o.in_cluster) != (info.up, info.in_cluster):
+                    inc.osd_states[osd_id] = (info.up, info.in_cluster)
+        for pool_id, pool in new.pools.items():
+            if pool_id not in old.pools or old.pools[pool_id] != pool:
+                inc.new_pools[pool_id] = pool
+        inc.removed_pools = [p for p in old.pools if p not in new.pools]
+        for key, acting in new.pg_temp.items():
+            if old.pg_temp.get(key) != acting:
+                inc.new_pg_temp[key] = acting
+        for key in old.pg_temp:
+            if key not in new.pg_temp:
+                inc.new_pg_temp[key] = []
+        for osd_id, aff in new.primary_affinity.items():
+            if old.primary_affinity.get(osd_id) != aff:
+                inc.new_primary_affinity[osd_id] = aff
+        if (new.crush.devices() != old.crush.devices()
+                or new.crush.rules.keys() != old.crush.rules.keys()):
+            inc.crush = new.crush
+        return inc
 
 
 # -- wire messages -----------------------------------------------------------
@@ -89,9 +193,11 @@ class MGetMap:
     tid: str = ""
 
 
-@message(2)
+@message(2, version=2)
 class MMapReply:
+    # either a full map or a chain of incrementals from the requester's epoch
     osdmap: OSDMap = None
+    incrementals: List["OSDMapIncremental"] = field(default_factory=list)
     tid: str = ""
 
 
