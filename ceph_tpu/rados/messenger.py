@@ -68,6 +68,12 @@ def message(type_id: int, version: int = 1):
     """Register a message dataclass with a wire type id + version."""
 
     def deco(cls):
+        existing = _MSG_TYPES.get(type_id)
+        if existing is not None and existing.__name__ != cls.__name__:
+            raise ValueError(
+                f"wire type id {type_id} already taken by "
+                f"{existing.__name__}; cannot register {cls.__name__}"
+            )
         cls = dataclass(cls)
         cls.TYPE_ID = type_id
         cls.VERSION = version

@@ -49,6 +49,7 @@ from ceph_tpu.rados.types import (
     MMarkDown,
     MMonElection,
     MMonPaxos,
+    MOSDFailure,
     MOsdBoot,
     MPing,
     OSDMap,
@@ -103,6 +104,8 @@ class Monitor:
         # recently-executed write tids -> reply: suppresses re-execution of
         # messenger-replayed/forward-retried writes (PG-reqid-dedupe role)
         self._applied_tids: "Dict[str, Any]" = {}
+        # target_osd -> {reporter: stamp} (OSD failure reports)
+        self._failure_reports: Dict[int, Dict[int, float]] = {}
         self._stopped = False
 
     # -- replicated state (de)serialization ----------------------------------
@@ -403,7 +406,7 @@ class Monitor:
 
     # -- dispatch ------------------------------------------------------------
 
-    WRITE_TYPES = (MOsdBoot, MCreatePool, MMarkDown, MConfigSet)
+    WRITE_TYPES = (MOsdBoot, MCreatePool, MMarkDown, MConfigSet, MOSDFailure)
 
     async def _dispatch(self, conn, msg) -> None:
         if isinstance(msg, MMonElection):
@@ -552,6 +555,26 @@ class Monitor:
                 self.osdmap.epoch += 1
                 await self._commit_state()
             return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+        if isinstance(msg, MOSDFailure):
+            # OSD-observed failure report (OSDMonitor::prepare_failure):
+            # mark down once enough distinct reporters agree
+            now = time.monotonic()
+            reporters = self._failure_reports.setdefault(msg.target_osd, {})
+            reporters[msg.from_osd] = now
+            # drop stale reports
+            for r, t0 in list(reporters.items()):
+                if now - t0 > 2 * self._grace:
+                    reporters.pop(r, None)
+            need = int(self.conf.get("mon_osd_min_down_reporters", 1) or 1)
+            info = self.osdmap.osds.get(msg.target_osd)
+            if info is not None and info.up and len(reporters) >= need:
+                info.up = False
+                info.in_cluster = False
+                self._last_ping[msg.target_osd] = -1e9
+                self.osdmap.epoch += 1
+                self._failure_reports.pop(msg.target_osd, None)
+                await self._commit_state()
+            return MMapReply(osdmap=self.osdmap)
         if isinstance(msg, MConfigSet):
             if not msg.remove:
                 # validate against the option schema before replicating
@@ -576,7 +599,7 @@ class Monitor:
             return MCreatePoolReply(tid=tid, ok=False, error=error)
         if isinstance(msg, MConfigSet):
             return MConfigReply(tid=tid, ok=False, error=error)
-        if isinstance(msg, (MMarkDown, MGetMap, MPing)):
+        if isinstance(msg, (MMarkDown, MGetMap, MPing, MOSDFailure)):
             return MMapReply(osdmap=self.osdmap, tid=tid)
         if isinstance(msg, MOsdBoot):
             return MBootReply(osd_id=-1, osdmap=self.osdmap, tid=tid)
@@ -648,12 +671,23 @@ class Monitor:
             size = int(profile.get("size", "3"))
             min_size = max(1, size // 2 + 1)
             stripe_width = 0
+        fd = profile.get("crush-failure-domain", "osd")
+        if fd != "osd" and not any(
+            b.type == fd for b in self.osdmap.crush.buckets.values()
+        ):
+            # reference add_simple_rule errors on an unknown bucket type; a
+            # rule over a nonexistent domain would place nothing, silently
+            return MCreatePoolReply(
+                ok=False,
+                error=f"crush-failure-domain={fd}: no bucket of that type "
+                      f"in the crush map (set crush_num_hosts?)",
+            )
         pool_id = self._next_pool_id
         self._next_pool_id += 1
         rule = f"{msg.name}-rule"
         self.osdmap.crush.add_simple_rule(
             rule,
-            failure_domain=profile.get("crush-failure-domain", "osd"),
+            failure_domain=fd,
             mode="indep" if msg.pool_type == "ec" else "firstn",
         )
         self.osdmap.pools[pool_id] = PoolInfo(

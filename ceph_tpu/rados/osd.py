@@ -11,6 +11,15 @@ codec's batching, plugin=tpu).  Degraded reads reconstruct transparently
 missing shards on the current acting set and pushes them (RecoveryOp
 IDLE->READING->WRITING, ECBackend.cc:590-745).
 
+Client and sub-ops ride a sharded op queue (op_shardedwq, OSD.h:1590) with
+a pluggable WPQ/mClock scheduler (osd_op_queue); PG id pins an op to a
+shard so per-PG ordering holds.  Liveness is two-tier like the reference:
+OSD<->OSD heartbeats (OSD::heartbeat OSD.cc:5837, handle_osd_ping :5417)
+produce MOSDFailure reports to the mon when a peer misses its grace, and
+the mon's own laggard scan is the fallback.  Per-daemon observability:
+perf counters, TrackedOp timelines, and an optional admin socket
+(`status`, `perf dump`, `dump_ops_in_flight`).
+
 Divergences from the reference, by design of the slice: no PG log/peering
 state machine yet (repair is list-diff driven, one in-flight write per
 object version), single-stripe objects (the full ECUtil stripe cache is
@@ -27,11 +36,14 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ceph_tpu.common.context import Context
+from ceph_tpu.common.perf_counters import PerfCountersBuilder
 from ceph_tpu.ec.interface import ErasureCodeError
 from ceph_tpu.ec.registry import registry
 from ceph_tpu.rados.crush import CRUSH_ITEM_NONE
 from ceph_tpu.rados.messenger import Messenger
 from ceph_tpu.rados.monclient import MonTargets
+from ceph_tpu.rados.scheduler import CLASS_CLIENT, CLASS_RECOVERY, ShardedOpQueue
 from ceph_tpu.rados.store import MemStore, ObjectStore, ShardMeta, Transaction, shard_crc
 from ceph_tpu.rados.types import (
     MBootReply,
@@ -46,8 +58,10 @@ from ceph_tpu.rados.types import (
     MListShards,
     MListShardsReply,
     MMapReply,
+    MOSDFailure,
     MOSDOp,
     MOSDOpReply,
+    MOSDPing,
     MOsdBoot,
     MPing,
     MPushShard,
@@ -75,9 +89,37 @@ class OSD:
         self._pending: Dict[str, asyncio.Future] = {}
         self._collectors: Dict[str, asyncio.Queue] = {}
         self._ping_task: Optional[asyncio.Task] = None
+        self._hb_task: Optional[asyncio.Task] = None
         self._repair_task: Optional[asyncio.Task] = None
         self.addr: Optional[Tuple[str, int]] = None
         self._stopped = False
+        # observability (CephContext role): perf counters + op tracker;
+        # the admin socket starts only when admin_socket_dir is configured
+        self.ctx = Context(f"osd.{osd_id}",
+                           conf if isinstance(conf, dict) else None)
+        self.perf = self.ctx.perf.add(
+            PerfCountersBuilder("osd")
+            .add_u64_counter("op", "client ops")
+            .add_u64_counter("op_w", "client writes")
+            .add_u64_counter("op_r", "client reads")
+            .add_time_avg("op_lat", "client op latency")
+            .add_u64_counter("subop_w", "EC sub-writes applied")
+            .add_u64_counter("subop_r", "EC sub-reads served")
+            .add_u64_counter("recovery_push", "recovery shards pushed")
+            .add_u64_counter("op_queued", "ops entering the sharded queue")
+            .add_u64_counter("op_dequeued", "ops drained")
+            .add_time_avg("op_queue_lat", "op service time")
+            .add_u64_counter("heartbeat_failures", "peer failures reported")
+            .create_perf_counters()
+        )
+        self.op_queue = ShardedOpQueue(
+            int(self.conf.get("osd_op_num_shards", 4) or 4), self.conf,
+            perf=self.perf)
+        # OSD<->OSD heartbeat state (two-tier failure detection);
+        # _hb_reported maps peer -> last MOSDFailure stamp so reports
+        # re-send while the peer stays silent (evidence at the mon expires)
+        self._hb_last: Dict[int, float] = {}
+        self._hb_reported: Dict[int, float] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -112,14 +154,33 @@ class OSD:
                 for k, v in cluster_conf.items():
                     self.conf.setdefault(k, v)
         interval = self.conf.get("osd_heartbeat_interval", 0.3)
-        self._ping_task = asyncio.get_running_loop().create_task(self._ping_loop(interval))
+        loop = asyncio.get_running_loop()
+        self._ping_task = loop.create_task(self._ping_loop(interval))
+        self._hb_task = loop.create_task(self._heartbeat_loop(interval))
+        self.op_queue.start()
+        self.ctx.name = f"osd.{self.osd_id}"
+        asok_dir = self.conf.get("admin_socket_dir")
+        if asok_dir:
+            self.ctx.asok.register(
+                "status", lambda a: self.status(), "osd status")
+            await self.ctx.asok.start(f"{asok_dir}/osd.{self.osd_id}.asok")
         return self.osd_id
+
+    def status(self) -> dict:
+        return {
+            "osd_id": self.osd_id,
+            "epoch": self.osdmap.epoch if self.osdmap else 0,
+            "op_queue_depth": self.op_queue.depth(),
+            "hb_peers": sorted(self._hb_last),
+        }
 
     async def stop(self) -> None:
         self._stopped = True
-        for t in (self._ping_task, self._repair_task):
+        for t in (self._ping_task, self._hb_task, self._repair_task):
             if t:
                 t.cancel()
+        await self.op_queue.stop()
+        await self.ctx.shutdown()
         await self.messenger.shutdown()
 
     @property
@@ -138,6 +199,50 @@ class OSD:
             except Exception:
                 self.mons.rotate()  # that mon looks dead
             await asyncio.sleep(interval)
+
+    async def _heartbeat_loop(self, interval: float) -> None:
+        """OSD<->OSD liveness (maybe_update_heartbeat_peers + heartbeat,
+        OSD.cc:5278,5837): ping every up peer; a peer silent past the grace
+        is reported to the mon as MOSDFailure."""
+        grace = self.conf.get("osd_heartbeat_grace", 2.0)
+        while not self._stopped:
+            await asyncio.sleep(interval)
+            if self.osdmap is None:
+                continue
+            now = time.monotonic()
+            peers = [o for o in self.osdmap.osds.values()
+                     if o.up and o.osd_id != self.osd_id]
+            for o in peers:
+                try:
+                    await self.messenger.send(
+                        o.addr, MOSDPing(op="ping", from_osd=self.osd_id,
+                                         stamp=now,
+                                         epoch=self.osdmap.epoch))
+                except Exception:
+                    pass
+                last = self._hb_last.setdefault(o.osd_id, now)
+                last_report = self._hb_reported.get(o.osd_id, -1e9)
+                if now - last > grace and now - last_report > grace:
+                    # re-report each grace interval while the peer stays
+                    # silent: the mon ages out stale reporter evidence, so
+                    # one-shot reports could never meet a multi-reporter
+                    # threshold (reference re-sends MOSDFailure too)
+                    self._hb_reported[o.osd_id] = now
+                    self.perf.inc("heartbeat_failures")
+                    try:
+                        await self.messenger.send(
+                            self.mons.current,
+                            MOSDFailure(target_osd=o.osd_id,
+                                        from_osd=self.osd_id,
+                                        failed_for=now - last))
+                    except Exception:
+                        pass
+            # prune state for peers no longer up in the map
+            live = {o.osd_id for o in peers}
+            for dead in list(self._hb_last):
+                if dead not in live:
+                    self._hb_last.pop(dead, None)
+                    self._hb_reported.pop(dead, None)
 
     async def _mon_rpc(self, msg, reply_type):
         """Send to a mon and wait for the typed reply; rotate through the
@@ -188,8 +293,27 @@ class OSD:
             fut = self._pending.pop("monrpc-MBootReply", None)
             if fut and not fut.done():
                 fut.set_result(msg)
+        elif isinstance(msg, MOSDPing):
+            if msg.op == "ping":
+                try:
+                    await conn.send(MOSDPing(op="reply", from_osd=self.osd_id,
+                                             stamp=msg.stamp))
+                except (ConnectionError, OSError):
+                    pass
+            else:
+                self._hb_last[msg.from_osd] = time.monotonic()
+                self._hb_reported.pop(msg.from_osd, None)
         elif isinstance(msg, MOSDOp):
-            await self._handle_client_op(conn, msg)
+            # client ops ride the sharded op queue: PG-pinned shard keeps
+            # per-PG order; scheduler arbitrates client vs recovery
+            # classes; a full queue blocks HERE so the messenger stops
+            # reading and backpressure reaches the sender
+            pg_key = self._pg_key_of(msg)
+            await self.op_queue.enqueue(
+                pg_key, lambda: self._handle_client_op(conn, msg),
+                CLASS_RECOVERY if msg.op == "repair" else CLASS_CLIENT,
+                cost=max(1, len(msg.data) // 4096),
+            )
         elif isinstance(msg, MECSubWrite):
             await self._handle_sub_write(msg)
         elif isinstance(msg, MECSubRead):
@@ -262,7 +386,32 @@ class OSD:
 
     # -- client ops (primary) ------------------------------------------------
 
+    def _pg_key_of(self, op: MOSDOp) -> int:
+        if self.osdmap is None:
+            return 0
+        pool = self.osdmap.pools.get(op.pool_id)
+        if pool is None:
+            return op.pool_id
+        return (op.pool_id << 20) | self.osdmap.object_to_pg(pool, op.oid)
+
     async def _handle_client_op(self, conn, op: MOSDOp) -> None:
+        tracked = self.ctx.op_tracker.create(
+            f"osd_op({op.op} {op.pool_id}:{op.oid})")
+        t0 = time.monotonic()
+        self.perf.inc("op")
+        if op.op == "write":
+            self.perf.inc("op_w")
+        elif op.op == "read":
+            self.perf.inc("op_r")
+        try:
+            await self._handle_client_op_inner(conn, op, tracked)
+        finally:
+            self.perf.tinc("op_lat", time.monotonic() - t0)
+            tracked.finish()
+
+    async def _handle_client_op_inner(self, conn, op: MOSDOp,
+                                      tracked) -> None:
+        tracked.mark_event("reached_pg")
         try:
             if op.op == "write":
                 reply = await self._do_write(op)
@@ -476,6 +625,7 @@ class OSD:
             self._apply_shard_write(
                 msg.pool_id, msg.oid, msg.shard, msg.chunk, msg.version, msg.object_size
             )
+            self.perf.inc("subop_w")
         try:
             await self.messenger.send(
                 tuple(msg.reply_to), MECSubWriteReply(tid=msg.tid, shard=msg.shard, ok=ok)
@@ -484,6 +634,7 @@ class OSD:
             pass
 
     async def _handle_sub_read(self, msg: MECSubRead) -> None:
+        self.perf.inc("subop_r")
         got = self.store.read((msg.pool_id, msg.oid, msg.shard))
         if got is None:
             reply = MECSubReadReply(tid=msg.tid, shard=msg.shard, ok=False)

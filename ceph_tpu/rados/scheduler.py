@@ -1,0 +1,267 @@
+"""OSD op scheduling: sharded op queue with WPQ and mClock schedulers.
+
+Role-equivalent of the reference's op queue stack (reference
+src/osd/scheduler/{OpScheduler,mClockScheduler}.cc, the sharded op queue
+`op_shardedwq` at src/osd/OSD.h:1590): incoming ops are hashed by PG onto
+one of N shards — per-PG ordering is preserved because a PG always lands on
+the same shard — and each shard's worker drains a pluggable scheduler:
+
+- WPQ (weighted priority queue, OpScheduler.cc WeightedPriorityQueue):
+  strict classes above the high-priority cutoff, weighted-fair draining of
+  the rest by priority.
+- mClock (mClockScheduler.cc, after the mClock paper): per-class QoS tags
+  (reservation r, weight w, limit l).  Each op gets tags R/P/L from its
+  class state; dequeue serves first any class with R-tag due (reservation
+  guarantee), else the eligible class with the smallest P-tag (weighted
+  sharing) subject to L (limit).  Classes here mirror the reference's:
+  client, recovery (background_recovery), best_effort (scrub/snaptrim).
+
+The asyncio translation: shard workers are tasks, not threads; the
+scheduler decides ORDER, the worker awaits each op handler to completion
+before dequeuing the next (the reference's one-op-per-shard-thread-at-a-
+time discipline, which PG lock ordering relies on).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+
+CLASS_CLIENT = "client"
+CLASS_RECOVERY = "recovery"
+CLASS_BEST_EFFORT = "best_effort"
+
+_seq = itertools.count()
+
+
+@dataclass(order=True)
+class _Item:
+    sort_key: Tuple = field(compare=True)
+    run: Callable[[], Awaitable[None]] = field(compare=False, default=None)
+    op_class: str = field(compare=False, default=CLASS_CLIENT)
+    cost: int = field(compare=False, default=1)
+
+
+class WPQScheduler:
+    """Weighted priority queue: higher priority drained proportionally more
+    often; strict classes (priority >= cutoff) always first."""
+
+    PRIORITIES = {CLASS_CLIENT: 63, CLASS_RECOVERY: 10, CLASS_BEST_EFFORT: 5}
+    STRICT_CUTOFF = 196  # reference osd_op_queue_cut_off high
+
+    def __init__(self, conf: Optional[dict] = None):
+        self._strict: List[_Item] = []
+        self._queues: Dict[int, List[_Item]] = {}  # priority -> FIFO heap
+        self._size = 0
+
+    def enqueue(self, op_class: str, run, cost: int = 1,
+                priority: Optional[int] = None) -> None:
+        prio = priority if priority is not None else self.PRIORITIES.get(
+            op_class, 1)
+        item = _Item(sort_key=(next(_seq),), run=run, op_class=op_class,
+                     cost=cost)
+        if prio >= self.STRICT_CUTOFF:
+            heapq.heappush(self._strict, item)
+        else:
+            heapq.heappush(self._queues.setdefault(prio, []), item)
+        self._size += 1
+
+    def dequeue(self) -> Optional[_Item]:
+        if self._strict:
+            self._size -= 1
+            return heapq.heappop(self._strict)
+        if not self._queues:
+            return None
+        # weighted-fair: draw a priority with probability ~ priority
+        total = sum(p * len(q) for p, q in self._queues.items() if q)
+        if total == 0:
+            return None
+        draw = (next(_seq) * 2654435761) % total
+        for p in sorted(self._queues, reverse=True):
+            q = self._queues[p]
+            if not q:
+                continue
+            draw -= p * len(q)
+            if draw < 0:
+                item = heapq.heappop(q)
+                if not q:
+                    del self._queues[p]
+                self._size -= 1
+                return item
+        # fallback: highest priority
+        p = max(p for p, q in self._queues.items() if q)
+        item = heapq.heappop(self._queues[p])
+        self._size -= 1
+        return item
+
+    def __len__(self) -> int:
+        return self._size
+
+
+@dataclass
+class _MClockClass:
+    reservation: float  # ops/sec guaranteed
+    weight: float  # share when capacity remains
+    limit: float  # ops/sec cap (0 = unlimited)
+    r_tag: float = 0.0
+    p_tag: float = 0.0
+    l_tag: float = 0.0
+    queue: List[_Item] = field(default_factory=list)
+
+
+class MClockScheduler:
+    """dmClock-style tag scheduler (reference mClockScheduler.cc profiles:
+    client gets reservation+weight, recovery gets weight-only with a limit,
+    best-effort gets leftovers)."""
+
+    DEFAULT_PROFILE = {
+        CLASS_CLIENT: (100.0, 10.0, 0.0),
+        CLASS_RECOVERY: (10.0, 3.0, 50.0),
+        CLASS_BEST_EFFORT: (1.0, 1.0, 20.0),
+    }
+
+    def __init__(self, conf: Optional[dict] = None):
+        conf = conf or {}
+        self.classes: Dict[str, _MClockClass] = {}
+        for name, (r, w, l) in self.DEFAULT_PROFILE.items():
+            r = float(conf.get(f"mclock_{name}_res", r))
+            w = float(conf.get(f"mclock_{name}_wgt", w))
+            l = float(conf.get(f"mclock_{name}_lim", l))
+            self.classes[name] = _MClockClass(r, w, l)
+        self._size = 0
+
+    def enqueue(self, op_class: str, run, cost: int = 1,
+                priority: Optional[int] = None) -> None:
+        c = self.classes.setdefault(
+            op_class, _MClockClass(1.0, 1.0, 0.0))
+        now = time.monotonic()
+        cost = max(1, cost)
+        c.r_tag = max(c.r_tag + cost / c.reservation, now) if c.reservation else 1e18
+        c.p_tag = max(c.p_tag + cost / c.weight, now)
+        c.l_tag = max(c.l_tag + cost / c.limit, now) if c.limit else 0.0
+        item = _Item(sort_key=(c.r_tag, c.p_tag, next(_seq)), run=run,
+                     op_class=op_class, cost=cost)
+        c.queue.append(item)
+        self._size += 1
+
+    def dequeue(self) -> Optional[_Item]:
+        now = time.monotonic()
+        # phase 1: reservations due
+        best_c, best_tag = None, None
+        for c in self.classes.values():
+            if c.queue and c.reservation:
+                head_tag = c.queue[0].sort_key[0]
+                if head_tag <= now and (best_tag is None or head_tag < best_tag):
+                    best_c, best_tag = c, head_tag
+        if best_c is None:
+            # phase 2: weight-based among classes under their limit
+            for c in self.classes.values():
+                if not c.queue:
+                    continue
+                if c.limit and c.queue[0].sort_key[1] > now and c.l_tag > now:
+                    continue  # over limit
+                head_p = c.queue[0].sort_key[1]
+                if best_tag is None or head_p < best_tag:
+                    best_c, best_tag = c, head_p
+        if best_c is None:
+            # work-conserving fallback: everything left is over its limit;
+            # rather than idle the shard, serve the smallest P-tag (the
+            # limit shapes ordering under contention, it never starves the
+            # queue — divergence from strict dmClock limit semantics)
+            for c in self.classes.values():
+                if not c.queue:
+                    continue
+                head_p = c.queue[0].sort_key[1]
+                if best_tag is None or head_p < best_tag:
+                    best_c, best_tag = c, head_p
+        if best_c is None:
+            return None
+        self._size -= 1
+        return best_c.queue.pop(0)
+
+    def __len__(self) -> int:
+        return self._size
+
+
+def make_scheduler(conf: Optional[dict] = None):
+    kind = (conf or {}).get("osd_op_queue", "wpq")
+    return MClockScheduler(conf) if kind == "mclock" else WPQScheduler(conf)
+
+
+class ShardedOpQueue:
+    """N shards, each with its own scheduler + drain task (op_shardedwq
+    role).  `shard_of(key)` pins a PG to a shard so per-PG order holds."""
+
+    def __init__(self, n_shards: int = 4, conf: Optional[dict] = None,
+                 perf=None, max_cost: int = 8192):
+        self.n_shards = max(1, n_shards)
+        self.conf = conf or {}
+        self.perf = perf
+        self._scheds = [make_scheduler(conf) for _ in range(self.n_shards)]
+        self._events = [asyncio.Event() for _ in range(self.n_shards)]
+        self._tasks: List[asyncio.Task] = []
+        self._stopped = False
+        # bounded queue budget: enqueue blocks when full, so the caller
+        # (the messenger serve loop) stops reading and TCP backpressure
+        # propagates to the sender — without this, handing ops to the
+        # queue would defeat ms_dispatch_throttle_bytes entirely
+        from ceph_tpu.common.throttle import Throttle
+
+        self._budget = Throttle("opq-cost", max_cost)
+
+    def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._tasks = [
+            loop.create_task(self._drain(i)) for i in range(self.n_shards)
+        ]
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for e in self._events:
+            e.set()
+        for t in self._tasks:
+            t.cancel()
+
+    def shard_of(self, key: int) -> int:
+        return (key * 2654435761 & 0xFFFFFFFF) % self.n_shards
+
+    async def enqueue(self, pg_key: int, run: Callable[[], Awaitable[None]],
+                      op_class: str = CLASS_CLIENT, cost: int = 1) -> None:
+        cost = max(1, cost)
+        await self._budget.get(cost)  # blocks when queues are full
+        shard = self.shard_of(pg_key)
+        self._scheds[shard].enqueue(op_class, run, cost)
+        if self.perf is not None:
+            self.perf.inc("op_queued")
+        self._events[shard].set()
+
+    async def _drain(self, shard: int) -> None:
+        sched = self._scheds[shard]
+        event = self._events[shard]
+        while not self._stopped:
+            item = sched.dequeue()
+            if item is None:
+                event.clear()
+                await event.wait()
+                continue
+            t0 = time.monotonic()
+            try:
+                await item.run()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+            finally:
+                self._budget.put(item.cost)
+            if self.perf is not None:
+                self.perf.inc("op_dequeued")
+                self.perf.tinc("op_queue_lat", time.monotonic() - t0)
+
+    def depth(self) -> int:
+        return sum(len(s) for s in self._scheds)

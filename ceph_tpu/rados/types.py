@@ -246,6 +246,29 @@ class MMarkDown:
     tid: str = ""
 
 
+# OSD <-> OSD heartbeats + failure reports (reference MOSDPing.h,
+# MOSDFailure.h; OSD::heartbeat OSD.cc:5837, handle_osd_ping :5417)
+
+
+@message(17)
+class MOSDPing:
+    op: str = "ping"  # ping | reply
+    from_osd: int = 0
+    stamp: float = 0.0
+    epoch: int = 0
+
+
+@message(18)
+class MOSDFailure:
+    """OSD-observed peer failure reported to the mon (failure detection
+    path that beats the mon's own laggard grace)."""
+
+    target_osd: int = 0
+    from_osd: int = 0
+    failed_for: float = 0.0
+    tid: str = ""
+
+
 # Mon <-> mon (consensus; reference src/messages/MMonElection.h, MMonPaxos.h)
 
 
