@@ -1,0 +1,335 @@
+"""BlueStore-lite: block-file object store with WAL, checksums, allocator.
+
+Role-equivalent of the reference's BlueStore (reference
+src/os/bluestore/BlueStore.cc): object data lives in one raw block file
+carved by an extent allocator; all metadata (object -> extents, per-extent
+crc32c checksums, shard meta, xattrs, omap) lives in a KeyValueDB whose WAL
+provides the commit point — a transaction is durable exactly when its
+metadata batch hits the KV WAL.  Small writes are DEFERRED
+(bluestore_prefer_deferred_size): the data rides inside the KV record and
+is flushed to the block file after commit, saving the block-file sync on
+the latency path; large writes go to freshly allocated extents first
+(copy-on-write — crash before KV commit leaves the old object intact),
+then the metadata flips atomically.
+
+Checksums: crc32c per extent (bluestore_csum_type), verified on every
+read; bluestore_debug_inject_read_err / _csum_err_probability inject
+failures for the EIO-handling tests (reference
+src/common/options/global.yaml.in:4977,5017).
+
+Recovery contract: open() replays the KV WAL (WalDB does this), then
+flushes any deferred writes recorded-but-not-flushed.  The allocator
+rebuilds its free map from the extent metadata.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import random
+import zlib
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from ceph_tpu.rados.kv import KeyValueDB, MemDB, WalDB, WriteBatch
+from ceph_tpu.rados.store import Key, ObjectStore, ShardMeta, Transaction
+
+PREFIX_OBJ = "O"  # object metadata (extents, csums, ShardMeta, xattrs)
+PREFIX_DEFERRED = "D"  # deferred write payloads awaiting block flush
+PREFIX_OMAP = "M"  # per-object sorted key/value (PG log lives here)
+PREFIX_SUPER = "S"  # store-wide state (size watermark)
+
+
+class EIOError(IOError):
+    """Read failed checksum / injected EIO (the OSD turns this into the
+    shard-level error path the reference tests with test-erasure-eio.sh)."""
+
+
+@dataclass
+class _Onode:
+    """Object metadata record (BlueStore onode role)."""
+
+    extents: List[Tuple[int, int]] = field(default_factory=list)  # (off, len)
+    csums: List[int] = field(default_factory=list)  # crc32c per extent
+    meta: ShardMeta = field(default_factory=ShardMeta)
+    deferred: bool = False  # data still only in the KV (deferred write)
+    xattrs: Dict[str, bytes] = field(default_factory=dict)
+
+
+def _okey(key: Key) -> str:
+    pid, oid, shard = key
+    return f"{pid}/{oid.encode().hex()}/{shard}"
+
+
+def _unokey(s: str) -> Key:
+    pid, oid_hex, shard = s.split("/")
+    return int(pid), bytes.fromhex(oid_hex).decode(), int(shard)
+
+
+class Allocator:
+    """Free-extent allocator (AvlAllocator role): first-fit with merge."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self.free: List[Tuple[int, int]] = [(0, size)] if size else []
+
+    def allocate(self, want: int) -> int:
+        for i, (off, length) in enumerate(self.free):
+            if length >= want:
+                if length == want:
+                    self.free.pop(i)
+                else:
+                    self.free[i] = (off + want, length - want)
+                return off
+        # grow the device (file-backed: sparse growth is free); the grown
+        # region beyond this allocation joins the free list
+        off = self.size
+        grow = max(want, 1 << 20)
+        self.size += grow
+        if grow > want:
+            self.release(off + want, grow - want)
+        return off
+
+    def release(self, off: int, length: int) -> None:
+        self.free.append((off, length))
+        self.free.sort()
+        merged: List[Tuple[int, int]] = []
+        for o, l in self.free:
+            if merged and merged[-1][0] + merged[-1][1] == o:
+                merged[-1] = (merged[-1][0], merged[-1][1] + l)
+            else:
+                merged.append((o, l))
+        self.free = merged
+
+    def reserve(self, off: int, length: int) -> None:
+        """Mark [off, off+len) used (startup rebuild)."""
+        out = []
+        for o, l in self.free:
+            if off >= o + l or off + length <= o:
+                out.append((o, l))
+                continue
+            if o < off:
+                out.append((o, off - o))
+            if off + length < o + l:
+                out.append((off + length, o + l - off - length))
+        self.free = out
+        self.size = max(self.size, off + length)
+
+
+class BlueStore(ObjectStore):
+    def __init__(self, path: Optional[str] = None,
+                 conf: Optional[dict] = None,
+                 db: Optional[KeyValueDB] = None):
+        self.conf = conf or {}
+        self.path = path
+        if path is not None:
+            os.makedirs(path, exist_ok=True)
+            self.db: KeyValueDB = db or WalDB(os.path.join(path, "db"))
+            self._block_path = os.path.join(path, "block")
+            if not os.path.exists(self._block_path):
+                open(self._block_path, "wb").close()
+            # r+b: positioned writes (a+b would append regardless of seek)
+            self._block = open(self._block_path, "r+b")
+        else:
+            self.db = db or MemDB()
+            self._block = None
+            self._blob: Dict[int, bytes] = {}  # off -> data (RAM mode)
+        self.alloc = Allocator(0)
+        self._onodes: Dict[Key, _Onode] = {}
+        # committed-but-unflushed deferred writes, drained in batches off
+        # the commit latency path (bluestore deferred_batch semantics)
+        self._deferred_pending: List[Tuple[Key, _Onode, bytes]] = []
+        self._deferred_batch_max = 16
+        self._load()
+        self._flush_deferred()
+
+    # -- startup -------------------------------------------------------------
+
+    def _load(self) -> None:
+        for k, v in self.db.iterate(PREFIX_OBJ):
+            onode: _Onode = pickle.loads(v)
+            key = _unokey(k)
+            self._onodes[key] = onode
+            for off, length in onode.extents:
+                self.alloc.reserve(off, length)
+
+    def _flush_deferred(self) -> None:
+        """Finish deferred writes that committed but weren't flushed to the
+        block file before shutdown (BlueStore deferred replay)."""
+        for k, v in list(self.db.iterate(PREFIX_DEFERRED)):
+            key = _unokey(k)
+            onode = self._onodes.get(key)
+            if onode is not None and onode.deferred:
+                self._write_extents(onode.extents, v)
+                onode.deferred = False
+                batch = WriteBatch()
+                batch.set(PREFIX_OBJ, _okey(key),
+                          pickle.dumps(onode, protocol=5))
+                batch.rm(PREFIX_DEFERRED, k)
+                self.db.submit(batch)
+            else:
+                batch = WriteBatch()
+                batch.rm(PREFIX_DEFERRED, k)
+                self.db.submit(batch)
+
+    # -- block IO ------------------------------------------------------------
+
+    def _write_extents(self, extents: List[Tuple[int, int]], data: bytes) -> None:
+        pos = 0
+        for off, length in extents:
+            piece = data[pos:pos + length]
+            if self._block is not None:
+                self._block.seek(off)
+                self._block.write(piece)
+            else:
+                self._blob[off] = piece
+            pos += length
+        if self._block is not None:
+            self._block.flush()
+
+    def _read_extents(self, extents: List[Tuple[int, int]]) -> bytes:
+        out = []
+        for off, length in extents:
+            if self._block is not None:
+                self._block.seek(off)
+                out.append(self._block.read(length))
+            else:
+                out.append(self._blob.get(off, b"")[:length])
+        return b"".join(out)
+
+    # -- ObjectStore interface -----------------------------------------------
+
+    def queue_transaction(self, txn: Transaction,
+                          on_commit: Optional[Callable[[], None]] = None) -> None:
+        """Apply atomically: ONE KV batch is the commit point for every
+        write/delete in the transaction (ObjectStore::queue_transactions
+        with register_on_commit semantics)."""
+        prefer_deferred = int(self.conf.get("bluestore_prefer_deferred_size",
+                                            32768) or 0)
+        batch = WriteBatch()
+        freed: List[Tuple[int, int]] = []
+        for key in txn.deletes:
+            onode = self._onodes.pop(key, None)
+            if onode is not None:
+                freed.extend(onode.extents)
+            batch.rm(PREFIX_OBJ, _okey(key))
+            batch.rm(PREFIX_DEFERRED, _okey(key))
+            batch.rm_prefix(PREFIX_OMAP + _okey(key))
+        deferred_flush: List[Tuple[Key, _Onode, bytes]] = []
+        for key, chunk, meta in txn.writes:
+            old = self._onodes.get(key)
+            if old is not None:
+                freed.extend(old.extents)
+            onode = _Onode(meta=meta,
+                           xattrs=dict(old.xattrs) if old else {})
+            off = self.alloc.allocate(max(1, len(chunk)))
+            onode.extents = [(off, len(chunk))]
+            onode.csums = [zlib.crc32(chunk)]
+            if len(chunk) <= prefer_deferred:
+                # deferred: payload rides the KV WAL; block flush later
+                onode.deferred = True
+                batch.set(PREFIX_DEFERRED, _okey(key), chunk)
+                deferred_flush.append((key, onode, chunk))
+            else:
+                # large write: data to fresh extents BEFORE commit (COW)
+                self._write_extents(onode.extents, chunk)
+            self._onodes[key] = onode
+            batch.set(PREFIX_OBJ, _okey(key), pickle.dumps(onode, protocol=5))
+        self.db.submit(batch)  # <- THE commit point
+        if on_commit is not None:
+            on_commit()
+        # post-commit: deferred payloads drain in batches so a small write
+        # costs ONE fsync on the latency path (the open-time replay covers
+        # anything pending at a crash)
+        self._deferred_pending.extend(deferred_flush)
+        if len(self._deferred_pending) >= self._deferred_batch_max:
+            self.flush_deferred_batch()
+        for off, length in freed:
+            self.alloc.release(off, length)
+
+    def flush_deferred_batch(self) -> None:
+        if not self._deferred_pending:
+            return
+        pending, self._deferred_pending = self._deferred_pending, []
+        b2 = WriteBatch()
+        for key, onode, chunk in pending:
+            if self._onodes.get(key) is not onode:
+                continue  # overwritten/deleted since; its extents are gone
+            self._write_extents(onode.extents, chunk)
+            onode.deferred = False
+            b2.set(PREFIX_OBJ, _okey(key), pickle.dumps(onode, protocol=5))
+            b2.rm(PREFIX_DEFERRED, _okey(key))
+        if b2.ops:
+            self.db.submit(b2)
+
+    def read(self, key: Key) -> Optional[Tuple[bytes, ShardMeta]]:
+        onode = self._onodes.get(key)
+        if onode is None:
+            return None
+        if self.conf.get("bluestore_debug_inject_read_err", False):
+            raise EIOError(f"injected read error on {key}")
+        if onode.deferred:
+            data = self.db.get(PREFIX_DEFERRED, _okey(key)) or b""
+        else:
+            data = self._read_extents(onode.extents)
+        prob = float(self.conf.get(
+            "bluestore_debug_inject_csum_err_probability", 0.0) or 0.0)
+        if prob and random.random() < prob:
+            raise EIOError(f"injected csum error on {key}")
+        if self.conf.get("bluestore_csum_type", "crc32c") != "none":
+            pos = 0
+            for (off, length), want in zip(onode.extents, onode.csums):
+                if zlib.crc32(data[pos:pos + length]) != want:
+                    raise EIOError(f"checksum mismatch on {key} @{off}")
+                pos += length
+        return data, onode.meta
+
+    def list_objects(self, pool_id: int) -> Iterable[Tuple[str, int]]:
+        for (pid, oid, shard) in list(self._onodes):
+            if pid == pool_id:
+                yield oid, shard
+
+    # -- xattrs / omap (HashInfo + PG log substrate) -------------------------
+
+    def setattr(self, key: Key, name: str, value: bytes) -> None:
+        onode = self._onodes.get(key)
+        if onode is None:
+            onode = _Onode()
+            self._onodes[key] = onode
+        onode.xattrs[name] = value
+        batch = WriteBatch()
+        batch.set(PREFIX_OBJ, _okey(key), pickle.dumps(onode, protocol=5))
+        self.db.submit(batch)
+
+    def getattr(self, key: Key, name: str) -> Optional[bytes]:
+        onode = self._onodes.get(key)
+        return onode.xattrs.get(name) if onode else None
+
+    def omap_set(self, key: Key, entries: Dict[str, bytes]) -> None:
+        batch = WriteBatch()
+        for k, v in entries.items():
+            batch.set(PREFIX_OMAP + _okey(key), k, v)
+        self.db.submit(batch)
+
+    def omap_get(self, key: Key) -> Dict[str, bytes]:
+        return dict(self.db.iterate(PREFIX_OMAP + _okey(key)))
+
+    def omap_rm(self, key: Key, keys: List[str]) -> None:
+        batch = WriteBatch()
+        for k in keys:
+            batch.rm(PREFIX_OMAP + _okey(key), k)
+        self.db.submit(batch)
+
+    # -- admin ----------------------------------------------------------------
+
+    def statfs(self) -> Dict[str, int]:
+        free = sum(l for _, l in self.alloc.free)
+        return {"size": self.alloc.size, "free": free,
+                "used": self.alloc.size - free,
+                "num_objects": len(self._onodes)}
+
+    def close(self) -> None:
+        self.flush_deferred_batch()
+        self.db.close()
+        if self._block is not None:
+            self._block.close()

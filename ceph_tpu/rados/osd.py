@@ -182,6 +182,9 @@ class OSD:
         await self.op_queue.stop()
         await self.ctx.shutdown()
         await self.messenger.shutdown()
+        close = getattr(self.store, "close", None)
+        if close is not None:
+            close()
 
     @property
     def mon_addr(self):
@@ -204,7 +207,7 @@ class OSD:
         """OSD<->OSD liveness (maybe_update_heartbeat_peers + heartbeat,
         OSD.cc:5278,5837): ping every up peer; a peer silent past the grace
         is reported to the mon as MOSDFailure."""
-        grace = self.conf.get("osd_heartbeat_grace", 2.0)
+        grace = float(self.conf.get("osd_heartbeat_grace", 2.0) or 2.0)
         while not self._stopped:
             await asyncio.sleep(interval)
             if self.osdmap is None:
@@ -386,6 +389,15 @@ class OSD:
 
     # -- client ops (primary) ------------------------------------------------
 
+    def _store_read(self, key):
+        """store.read with EIO absorbed to a missing-shard result: a bad
+        local shard must degrade, never crash, the op (EIO handling the
+        reference tests via bluestore read-error injection)."""
+        try:
+            return self.store.read(key)
+        except IOError:
+            return None
+
     def _pg_key_of(self, op: MOSDOp) -> int:
         if self.osdmap is None:
             return 0
@@ -520,7 +532,7 @@ class OSD:
         for shard in plan:
             osd = available[shard]
             if osd == self.osd_id:
-                got = self.store.read((op.pool_id, op.oid, shard))
+                got = self._store_read((op.pool_id, op.oid, shard))
                 if got is not None:
                     chunks[shard] = got[0]
                     versions[shard] = got[1].version
@@ -635,7 +647,13 @@ class OSD:
 
     async def _handle_sub_read(self, msg: MECSubRead) -> None:
         self.perf.inc("subop_r")
-        got = self.store.read((msg.pool_id, msg.oid, msg.shard))
+        try:
+            got = self.store.read((msg.pool_id, msg.oid, msg.shard))
+        except IOError:
+            # EIO / checksum failure on our shard: reply error so the
+            # primary reconstructs from other shards (the behavior
+            # qa/standalone/erasure-code/test-erasure-eio.sh exercises)
+            got = None
         if got is None:
             reply = MECSubReadReply(tid=msg.tid, shard=msg.shard, ok=False)
         else:
@@ -670,7 +688,7 @@ class OSD:
         out = []
         for oid2, shard in self.store.list_objects(pool_id):
             if oid2 == oid:
-                got = self.store.read((pool_id, oid, shard))
+                got = self._store_read((pool_id, oid, shard))
                 if got is not None:
                     out.append((shard, got[0], got[1].version, got[1].object_size))
         peers = [
@@ -696,7 +714,7 @@ class OSD:
         shards = []
         for oid, shard in self.store.list_objects(msg.pool_id):
             if oid == msg.oid:
-                got = self.store.read((msg.pool_id, msg.oid, shard))
+                got = self._store_read((msg.pool_id, msg.oid, shard))
                 if got is not None:
                     shards.append((shard, got[0], got[1].version, got[1].object_size))
         try:
@@ -710,7 +728,7 @@ class OSD:
     async def _handle_list_shards(self, msg: MListShards) -> None:
         entries = []
         for oid, shard in self.store.list_objects(msg.pool_id):
-            got = self.store.read((msg.pool_id, oid, shard))
+            got = self._store_read((msg.pool_id, oid, shard))
             if got is not None:
                 entries.append((oid, shard, got[1].version))
         try:
@@ -722,6 +740,7 @@ class OSD:
             pass
 
     def _apply_push(self, msg: MPushShard) -> None:
+        self.perf.inc("recovery_push")
         self._apply_shard_write(
             msg.pool_id, msg.oid, msg.shard, msg.chunk, msg.version, msg.object_size
         )
@@ -752,7 +771,7 @@ class OSD:
         # sitting at its acting position is NOT healthy redundancy
         holdings: Dict[str, Set[Tuple[int, int, int]]] = {}
         for oid, shard in self.store.list_objects(pool.pool_id):
-            got = self.store.read((pool.pool_id, oid, shard))
+            got = self._store_read((pool.pool_id, oid, shard))
             if got is not None:
                 holdings.setdefault(oid, set()).add((shard, self.osd_id, got[1].version))
         for r in await self._gather(tid, q, sent):

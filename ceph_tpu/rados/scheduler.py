@@ -92,11 +92,7 @@ class WPQScheduler:
                     del self._queues[p]
                 self._size -= 1
                 return item
-        # fallback: highest priority
-        p = max(p for p, q in self._queues.items() if q)
-        item = heapq.heappop(self._queues[p])
-        self._size -= 1
-        return item
+        raise AssertionError("weighted draw must land in a non-empty queue")
 
     def __len__(self) -> int:
         return self._size

@@ -14,10 +14,11 @@ import argparse
 import asyncio
 from typing import Dict, List, Optional
 
+from ceph_tpu.rados.bluestore import BlueStore
 from ceph_tpu.rados.client import RadosClient
 from ceph_tpu.rados.mon import Monitor
 from ceph_tpu.rados.osd import OSD
-from ceph_tpu.rados.store import DirStore, MemStore
+from ceph_tpu.rados.store import MemStore
 
 
 class Cluster:
@@ -85,7 +86,7 @@ class Cluster:
 
     async def add_osd(self) -> OSD:
         store = (
-            DirStore(f"{self.data_dir}/osd.{self._next_store}")
+            BlueStore(f"{self.data_dir}/osd.{self._next_store}", self.conf)
             if self.data_dir
             else MemStore()
         )
