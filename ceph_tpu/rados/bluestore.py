@@ -215,6 +215,12 @@ class BlueStore(ObjectStore):
             batch.rm(PREFIX_OBJ, _okey(key))
             batch.rm(PREFIX_DEFERRED, _okey(key))
             batch.rm_prefix(PREFIX_OMAP + _okey(key))
+        for key, entries in txn.omap_sets:
+            for k, v in entries.items():
+                batch.set(PREFIX_OMAP + _okey(key), k, v)
+        for key, keys in txn.omap_rms:
+            for k in keys:
+                batch.rm(PREFIX_OMAP + _okey(key), k)
         deferred_flush: List[Tuple[Key, _Onode, bytes]] = []
         for key, chunk, meta in txn.writes:
             old = self._onodes.get(key)

@@ -129,6 +129,9 @@ class RadosClient:
         if self.osdmap is None:
             await self.refresh_map()
         last_error = "no attempt"
+        # ONE reqid per logical op: resends carry the same id so the PG
+        # log's dup detection can recognize them (reference osd_reqid_t)
+        op.reqid = uuid.uuid4().hex
         for attempt in range(retries):
             pool = self.osdmap.pools.get(op.pool_id)
             if pool is None:
@@ -149,7 +152,6 @@ class RadosClient:
             if primary is None:
                 last_error = "no primary (all acting osds down)"
             else:
-                op.reqid = uuid.uuid4().hex
                 op.epoch = self.osdmap.epoch
                 fut: asyncio.Future = asyncio.get_running_loop().create_future()
                 self._replies[op.reqid] = fut
@@ -170,8 +172,30 @@ class RadosClient:
                 last_error = f"map refresh failed: {type(e).__name__}"
         raise RadosError(f"op {op.op} {op.oid} failed: {last_error}")
 
-    async def put(self, pool_id: int, oid: str, data: bytes) -> None:
-        await self._op(MOSDOp(op="write", pool_id=pool_id, oid=oid, data=data))
+    async def put(self, pool_id: int, oid: str, data: bytes,
+                  offset: Optional[int] = None) -> None:
+        """Full-object write, or a partial overwrite at `offset` (the
+        primary takes the read-modify-write path)."""
+        await self._op(MOSDOp(op="write", pool_id=pool_id, oid=oid, data=data,
+                              offset=-1 if offset is None else int(offset)))
+
+    async def deep_scrub(self, pool_id: int) -> Dict[str, int]:
+        """Ask every up OSD to deep-scrub the PGs it leads; sums the
+        per-primary summaries."""
+        import pickle as _pickle
+
+        total = {"scrubbed": 0, "errors": 0, "repaired": 0}
+        for osd in list(self.osdmap.osds.values()):
+            if not osd.up:
+                continue
+            try:
+                reply = await self._op_direct(
+                    osd.osd_id, MOSDOp(op="deep-scrub", pool_id=pool_id))
+                for k, v in _pickle.loads(reply.data).items():
+                    total[k] = total.get(k, 0) + v
+            except RadosError:
+                continue
+        return total
 
     async def get(self, pool_id: int, oid: str) -> bytes:
         reply = await self._op(MOSDOp(op="read", pool_id=pool_id, oid=oid))

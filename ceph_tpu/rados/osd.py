@@ -20,10 +20,14 @@ the mon's own laggard scan is the fallback.  Per-daemon observability:
 perf counters, TrackedOp timelines, and an optional admin socket
 (`status`, `perf dump`, `dump_ops_in_flight`).
 
-Divergences from the reference, by design of the slice: no PG log/peering
-state machine yet (repair is list-diff driven, one in-flight write per
-object version), single-stripe objects (the full ECUtil stripe cache is
-round-2 work).
+Write path bookkeeping matches the reference's shape: every mutation
+appends a PG log entry (src/osd/PGLog.cc) on each acting shard in the same
+store transaction as the data; client resends dedupe against the log's
+reqid set; recovery is two-phase — log-driven delta recovery for peers
+whose logs overlap, backfill scan otherwise.  Partial overwrites take the
+read-modify-write path with a primary-side extent cache
+(try_state_to_reads + ExtentCache roles); deep scrub recomputes shard crcs
+against stored meta and repairs mismatches (be_deep_scrub).
 """
 
 from __future__ import annotations
@@ -43,7 +47,13 @@ from ceph_tpu.ec.registry import registry
 from ceph_tpu.rados.crush import CRUSH_ITEM_NONE
 from ceph_tpu.rados.messenger import Messenger
 from ceph_tpu.rados.monclient import MonTargets
-from ceph_tpu.rados.scheduler import CLASS_CLIENT, CLASS_RECOVERY, ShardedOpQueue
+from ceph_tpu.rados.pglog import ZERO, LogEntry, PGLog
+from ceph_tpu.rados.scheduler import (
+    CLASS_BEST_EFFORT,
+    CLASS_CLIENT,
+    CLASS_RECOVERY,
+    ShardedOpQueue,
+)
 from ceph_tpu.rados.store import MemStore, ObjectStore, ShardMeta, Transaction, shard_crc
 from ceph_tpu.rados.types import (
     MBootReply,
@@ -63,11 +73,19 @@ from ceph_tpu.rados.types import (
     MOSDOpReply,
     MOSDPing,
     MOsdBoot,
+    MPGInfoReply,
+    MPGInfoReq,
+    MPGLogReply,
+    MPGLogReq,
     MPing,
     MPushShard,
+    MScrubShard,
+    MScrubShardReply,
     OSDMap,
     PoolInfo,
 )
+
+PGMETA_PREFIX = "__pgmeta_"  # per-PG metadata object carrying the PG log
 
 
 class OSD:
@@ -120,6 +138,15 @@ class OSD:
         # re-send while the peer stays silent (evidence at the mon expires)
         self._hb_last: Dict[int, float] = {}
         self._hb_reported: Dict[int, float] = {}
+        # per-PG logs (src/osd/PGLog.cc role), lazily loaded from omap
+        self._pglogs: Dict[Tuple[int, int], PGLog] = {}
+        # reqids whose write failed min_size: a resend must RE-EXECUTE,
+        # not be acked as a dup
+        self._failed_writes: Set[str] = set()
+        # primary-side cache of decoded objects pinned across RMW rounds
+        # (src/osd/ExtentCache.{h,cc} role)
+        self._extent_cache: "Dict[Tuple[int, str], Tuple[int, bytes]]" = {}
+        self._extent_cache_max = 64
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -312,10 +339,12 @@ class OSD:
             # classes; a full queue blocks HERE so the messenger stops
             # reading and backpressure reaches the sender
             pg_key = self._pg_key_of(msg)
+            op_class = {"repair": CLASS_RECOVERY,
+                        "deep-scrub": CLASS_BEST_EFFORT}.get(
+                msg.op, CLASS_CLIENT)
             await self.op_queue.enqueue(
                 pg_key, lambda: self._handle_client_op(conn, msg),
-                CLASS_RECOVERY if msg.op == "repair" else CLASS_CLIENT,
-                cost=max(1, len(msg.data) // 4096),
+                op_class, cost=max(1, len(msg.data) // 4096),
             )
         elif isinstance(msg, MECSubWrite):
             await self._handle_sub_write(msg)
@@ -329,8 +358,27 @@ class OSD:
             await self._handle_fetch_shards(msg)
         elif isinstance(msg, MPushShard):
             self._apply_push(msg)
+        elif isinstance(msg, MPGInfoReq):
+            await self._handle_pg_info(msg)
+        elif isinstance(msg, MPGLogReq):
+            await self._handle_pg_log_req(msg)
+        elif isinstance(msg, MScrubShard):
+            await self._handle_scrub_shard(msg)
+        elif isinstance(msg, MPGLogReply) and not msg.tid:
+            # unsolicited authoritative log push from the primary: merge
+            # (with divergent-entry rollback) so our head catches up
+            entries = []
+            for blob in msg.entries:
+                e = LogEntry.decode(blob)
+                e.version = tuple(e.version)
+                e.prior_version = tuple(e.prior_version)
+                entries.append(e)
+            if entries:
+                await self._merge_log_entries(msg.pool_id, msg.pg, entries)
         elif isinstance(
-            msg, (MECSubWriteReply, MECSubReadReply, MListShardsReply, MFetchShardsReply)
+            msg, (MECSubWriteReply, MECSubReadReply, MListShardsReply,
+                  MFetchShardsReply, MPGInfoReply, MPGLogReply,
+                  MScrubShardReply)
         ):
             q = self._collectors.get(msg.tid)
             if q is not None:
@@ -347,6 +395,9 @@ class OSD:
         if old is not None and osdmap.epoch <= old.epoch:
             return
         self.osdmap = osdmap
+        # primaryship may have moved: cached decodes can silently go stale
+        # across an interval we didn't serve (ExtentCache is per-interval)
+        self._extent_cache.clear()
         # invalidate only codecs whose pool profile actually changed —
         # plugin=tpu codecs carry jit caches worth keeping across epochs
         for pool_id in list(self._codecs):
@@ -398,6 +449,59 @@ class OSD:
         except IOError:
             return None
 
+    # -- PG log --------------------------------------------------------------
+
+    @staticmethod
+    def _pgmeta_key(pool_id: int, pg: int) -> Tuple[int, str, int]:
+        return (pool_id, f"{PGMETA_PREFIX}{pg}", -1)
+
+    def _pglog(self, pool_id: int, pg: int) -> PGLog:
+        log = self._pglogs.get((pool_id, pg))
+        if log is None:
+            omap = {}
+            try:
+                omap = self.store.omap_get(self._pgmeta_key(pool_id, pg))
+            except Exception:
+                pass
+            log = PGLog.load(omap) if omap else PGLog()
+            self._pglogs[(pool_id, pg)] = log
+        return log
+
+    def _log_in_txn(self, txn: Transaction, pool_id: int, pg: int,
+                    entry: LogEntry) -> None:
+        """Append to the in-memory log and persist the entry in the SAME
+        transaction as the data (reference log_operation +
+        queue_transactions coupling)."""
+        log = self._pglog(pool_id, pg)
+        if entry.version <= log.head:
+            return  # replayed/duplicate entry
+        trimmed = log.append(entry)
+        key = self._pgmeta_key(pool_id, pg)
+        txn.omap_set(key, log.omap_entries(entry))
+        if trimmed:
+            txn.omap_rm(key, trimmed)
+
+    def _list_pool_objects(self, pool_id: int):
+        """list_objects minus PG metadata objects."""
+        for oid, shard in self.store.list_objects(pool_id):
+            if not oid.startswith(PGMETA_PREFIX):
+                yield oid, shard
+
+    # -- extent cache (primary-side RMW pinning) ------------------------------
+
+    def _cache_put(self, pool_id: int, oid: str, version: int,
+                   data: bytes) -> None:
+        cache = self._extent_cache
+        cache[(pool_id, oid)] = (version, data)
+        while len(cache) > self._extent_cache_max:
+            cache.pop(next(iter(cache)))
+
+    def _cache_get(self, pool_id: int, oid: str) -> Optional[Tuple[int, bytes]]:
+        return self._extent_cache.get((pool_id, oid))
+
+    def _cache_drop(self, pool_id: int, oid: str) -> None:
+        self._extent_cache.pop((pool_id, oid), None)
+
     def _pg_key_of(self, op: MOSDOp) -> int:
         if self.osdmap is None:
             return 0
@@ -432,13 +536,20 @@ class OSD:
             elif op.op == "delete":
                 reply = await self._do_delete(op)
             elif op.op == "list":
-                oids = sorted({oid for oid, _ in self.store.list_objects(op.pool_id)})
+                oids = sorted({oid for oid, _ in self._list_pool_objects(op.pool_id)})
                 reply = MOSDOpReply(ok=True, oids=oids)
             elif op.op == "repair":
                 pool = self.osdmap.pools.get(op.pool_id)
                 if pool is not None:
                     await self.repair_pool(pool)
                 reply = MOSDOpReply(ok=True)
+            elif op.op == "deep-scrub":
+                pool = self.osdmap.pools.get(op.pool_id)
+                if pool is None:
+                    reply = MOSDOpReply(ok=False, error="no such pool")
+                else:
+                    summary = await self.deep_scrub_pool(pool)
+                    reply = MOSDOpReply(ok=True, data=pickle.dumps(summary))
             else:
                 reply = MOSDOpReply(ok=False, error=f"bad op {op.op}")
         except ErasureCodeError as e:
@@ -470,9 +581,34 @@ class OSD:
                 ok=False,
                 error=f"degraded below min_size ({len(live)}/{pool.min_size})",
             )
+        log = self._pglog(op.pool_id, pg)
+        if log.has_reqid(op.reqid) and op.reqid not in self._failed_writes:
+            # client resend of an op we already applied (pg log dups role)
+            return MOSDOpReply(ok=True)
+        self._failed_writes.discard(op.reqid)
+        data = op.data
+        if op.offset >= 0:
+            # partial overwrite: READ-modify-write (try_state_to_reads,
+            # ECBackend.cc:1915).  The extent cache pins recently decoded
+            # objects so back-to-back partial writes skip the read.
+            cached = self._cache_get(op.pool_id, op.oid)
+            if cached is not None:
+                base = bytearray(cached[1])
+            else:
+                read = await self._do_read(
+                    MOSDOp(op="read", pool_id=op.pool_id, oid=op.oid))
+                base = bytearray(read.data) if read.ok else bytearray()
+            if len(base) < op.offset:
+                base.extend(b"\x00" * (op.offset - len(base)))
+            base[op.offset:op.offset + len(op.data)] = op.data
+            data = bytes(base)
         n = codec.get_chunk_count()
-        encoded = codec.encode(set(range(n)), op.data)
+        encoded = codec.encode(set(range(n)), data)
         version = time.time_ns()
+        entry = LogEntry(version=log.next_version(self.osdmap.epoch),
+                         op="write", oid=op.oid, prior_version=log.head,
+                         reqid=op.reqid, object_version=version)
+        entry_blob = entry.encode()
         tid = uuid.uuid4().hex
         remote: List[Tuple[int, int]] = []  # (shard, osd)
         for shard, osd in enumerate(acting):
@@ -481,7 +617,8 @@ class OSD:
             chunk = bytes(encoded[shard])
             if osd == self.osd_id:
                 self._apply_shard_write(
-                    op.pool_id, op.oid, shard, chunk, version, len(op.data)
+                    op.pool_id, op.oid, shard, chunk, version, len(data),
+                    pg=pg, entry=entry,
                 )
             else:
                 remote.append((shard, osd))
@@ -491,8 +628,9 @@ class OSD:
             chunk = bytes(encoded[shard])
             msg = MECSubWrite(
                 pool_id=op.pool_id, pg=pg, oid=op.oid, shard=shard, chunk=chunk,
-                version=version, object_size=len(op.data),
+                version=version, object_size=len(data),
                 chunk_crc=shard_crc(chunk), tid=tid, reply_to=self.addr,
+                log_entry=entry_blob,
             )
             try:
                 await self.messenger.send(self.osdmap.addr_of(osd), msg)
@@ -502,18 +640,30 @@ class OSD:
         replies = await self._gather(tid, q, sent)
         acks = 1 + sum(1 for r in replies if r.ok)  # self + remote
         if acks < pool.min_size:
+            # the entry is logged but the write failed: a same-reqid resend
+            # must re-execute rather than be deduped into false success
+            if op.reqid:
+                self._failed_writes.add(op.reqid)
+                while len(self._failed_writes) > 1024:
+                    self._failed_writes.pop()
             return MOSDOpReply(
                 ok=False, error=f"write acked by {acks} < min_size {pool.min_size}"
             )
+        self._cache_put(op.pool_id, op.oid, version, data)
         return MOSDOpReply(ok=True)
 
-    async def _do_read(self, op: MOSDOp) -> MOSDOpReply:
+    async def _do_read(self, op: MOSDOp,
+                       exclude_shards: frozenset = frozenset()) -> MOSDOpReply:
+        """Reconstructing read.  `exclude_shards` drops shards KNOWN bad
+        (scrub found a crc mismatch) from every source, so a repair read
+        cannot launder corruption back into the object."""
         pool = self.osdmap.pools[op.pool_id]
         codec = self._codec(pool)
         pg, acting = self._acting(pool, op.oid)
         k = codec.get_data_chunk_count()
         available = {
-            shard: osd for shard, osd in enumerate(acting) if osd != CRUSH_ITEM_NONE
+            shard: osd for shard, osd in enumerate(acting)
+            if osd != CRUSH_ITEM_NONE and shard not in exclude_shards
         }
         # ask the codec which shards suffice (subchunk-aware plan); the
         # wanted shards are the codec's DATA positions, which mapped codecs
@@ -570,6 +720,8 @@ class OSD:
                     newest = hunted_newest
                     chunks = {}
                 for shard, chunk, version, osize in hunted:
+                    if shard in exclude_shards:
+                        continue
                     if version == newest and shard not in chunks:
                         chunks[shard] = chunk
                         sizes[shard] = osize
@@ -581,6 +733,7 @@ class OSD:
         object_size = sizes[max(sizes, key=lambda s: versions.get(s, 0))]
         arrays = {s: np.frombuffer(c, dtype=np.uint8) for s, c in chunks.items()}
         data = codec.decode_concat(arrays)
+        self._cache_put(op.pool_id, op.oid, newest, bytes(data[:object_size]))
         return MOSDOpReply(ok=True, data=data[:object_size], version=newest)
 
     async def _do_delete(self, op: MOSDOp) -> MOSDOpReply:
@@ -588,14 +741,24 @@ class OSD:
         current acting positions — stray shards left by placement drift
         would otherwise resurrect the object through the shard hunt."""
         pool = self.osdmap.pools[op.pool_id]
-        pg, _ = self._acting(pool, op.oid)
+        pg, acting = self._acting(pool, op.oid)
+        log = self._pglog(op.pool_id, pg)
+        if log.has_reqid(op.reqid):
+            return MOSDOpReply(ok=True)  # resent delete: already applied
         tid = uuid.uuid4().hex
-        # local: drop any shard we hold
+        self._cache_drop(op.pool_id, op.oid)
+        entry = LogEntry(version=log.next_version(self.osdmap.epoch),
+                         op="delete", oid=op.oid, prior_version=log.head,
+                         reqid=op.reqid)
+        entry_blob = entry.encode()
+        # local: drop any shard we hold; the delete is a PG log event
         txn = Transaction()
-        for oid, shard in list(self.store.list_objects(op.pool_id)):
+        for oid, shard in list(self._list_pool_objects(op.pool_id)):
             if oid == op.oid:
                 txn.delete((op.pool_id, op.oid, shard))
+        self._log_in_txn(txn, op.pool_id, pg, entry)
         self.store.queue_transaction(txn)
+        acting_set = {a for a in acting if a != CRUSH_ITEM_NONE}
         peers = [
             o for o in self.osdmap.osds.values() if o.up and o.osd_id != self.osd_id
         ]
@@ -603,11 +766,15 @@ class OSD:
         sent = 0
         for o in peers:
             try:
-                # shard=-1: drop every shard of the oid (one message per peer)
+                # shard=-1: drop every shard of the oid (one message per
+                # peer); acting members also log the delete so their PG
+                # logs advance with the primary's
                 await self.messenger.send(
                     o.addr,
                     MECSubDelete(pool_id=op.pool_id, pg=pg, oid=op.oid,
-                                 shard=-1, tid=tid, reply_to=self.addr),
+                                 shard=-1, tid=tid, reply_to=self.addr,
+                                 log_entry=entry_blob
+                                 if o.osd_id in acting_set else b""),
                 )
                 sent += 1
             except Exception:
@@ -619,7 +786,8 @@ class OSD:
 
     def _apply_shard_write(
         self, pool_id: int, oid: str, shard: int, chunk: bytes, version: int,
-        object_size: int,
+        object_size: int, pg: Optional[int] = None,
+        entry: Optional[LogEntry] = None,
     ) -> None:
         txn = Transaction()
         txn.write(
@@ -627,6 +795,8 @@ class OSD:
             chunk,
             ShardMeta(version=version, object_size=object_size, chunk_crc=shard_crc(chunk)),
         )
+        if entry is not None and pg is not None:
+            self._log_in_txn(txn, pool_id, pg, entry)
         self.store.queue_transaction(txn)
 
     async def _handle_sub_write(self, msg: MECSubWrite) -> None:
@@ -634,9 +804,16 @@ class OSD:
         if msg.chunk_crc and shard_crc(msg.chunk) != msg.chunk_crc:
             ok = False  # corrupted in flight
         else:
+            entry = LogEntry.decode(msg.log_entry) if msg.log_entry else None
+            if entry is not None:
+                entry.version = tuple(entry.version)
+                entry.prior_version = tuple(entry.prior_version)
             self._apply_shard_write(
-                msg.pool_id, msg.oid, msg.shard, msg.chunk, msg.version, msg.object_size
+                msg.pool_id, msg.oid, msg.shard, msg.chunk, msg.version,
+                msg.object_size, pg=msg.pg, entry=entry,
             )
+            # another primary wrote this object: our cached decode is stale
+            self._cache_drop(msg.pool_id, msg.oid)
             self.perf.inc("subop_w")
         try:
             await self.messenger.send(
@@ -670,11 +847,17 @@ class OSD:
     async def _handle_sub_delete(self, msg: MECSubDelete) -> None:
         txn = Transaction()
         if msg.shard < 0:  # whole-object delete
-            for oid, shard in list(self.store.list_objects(msg.pool_id)):
+            for oid, shard in list(self._list_pool_objects(msg.pool_id)):
                 if oid == msg.oid:
                     txn.delete((msg.pool_id, msg.oid, shard))
         else:
             txn.delete((msg.pool_id, msg.oid, msg.shard))
+        if msg.log_entry:
+            entry = LogEntry.decode(msg.log_entry)
+            entry.version = tuple(entry.version)
+            entry.prior_version = tuple(entry.prior_version)
+            self._log_in_txn(txn, msg.pool_id, msg.pg, entry)
+        self._cache_drop(msg.pool_id, msg.oid)
         self.store.queue_transaction(txn)
         try:
             await self.messenger.send(
@@ -686,7 +869,7 @@ class OSD:
     async def _fetch_all_shards(self, pool_id: int, oid: str):
         """Ask every up OSD for any shard of oid it holds; include our own."""
         out = []
-        for oid2, shard in self.store.list_objects(pool_id):
+        for oid2, shard in self._list_pool_objects(pool_id):
             if oid2 == oid:
                 got = self._store_read((pool_id, oid, shard))
                 if got is not None:
@@ -712,7 +895,7 @@ class OSD:
 
     async def _handle_fetch_shards(self, msg: MFetchShards) -> None:
         shards = []
-        for oid, shard in self.store.list_objects(msg.pool_id):
+        for oid, shard in self._list_pool_objects(msg.pool_id):
             if oid == msg.oid:
                 got = self._store_read((msg.pool_id, msg.oid, shard))
                 if got is not None:
@@ -727,7 +910,7 @@ class OSD:
 
     async def _handle_list_shards(self, msg: MListShards) -> None:
         entries = []
-        for oid, shard in self.store.list_objects(msg.pool_id):
+        for oid, shard in self._list_pool_objects(msg.pool_id):
             got = self._store_read((msg.pool_id, oid, shard))
             if got is not None:
                 entries.append((oid, shard, got[1].version))
@@ -741,15 +924,353 @@ class OSD:
 
     def _apply_push(self, msg: MPushShard) -> None:
         self.perf.inc("recovery_push")
+        self._cache_drop(msg.pool_id, msg.oid)
         self._apply_shard_write(
             msg.pool_id, msg.oid, msg.shard, msg.chunk, msg.version, msg.object_size
         )
 
+    # -- peering (GetInfo/GetLog exchange, reference PeeringState) -----------
+
+    async def _handle_pg_info(self, msg: MPGInfoReq) -> None:
+        log = self._pglog(msg.pool_id, msg.pg)
+        try:
+            await self.messenger.send(
+                tuple(msg.reply_to),
+                MPGInfoReply(tid=msg.tid, osd_id=self.osd_id,
+                             last_update=log.head, log_tail=log.tail),
+            )
+        except (ConnectionError, OSError):
+            pass
+
+    async def _handle_pg_log_req(self, msg: MPGLogReq) -> None:
+        log = self._pglog(msg.pool_id, msg.pg)
+        delta = log.entries_after(tuple(msg.since))
+        reply = MPGLogReply(tid=msg.tid, osd_id=self.osd_id,
+                            pool_id=msg.pool_id, pg=msg.pg,
+                            backfill=delta is None,
+                            entries=[e.encode() for e in (delta or [])])
+        try:
+            await self.messenger.send(tuple(msg.reply_to), reply)
+        except (ConnectionError, OSError):
+            pass
+
+    async def _peer_pg(self, pool: PoolInfo, pg: int,
+                       acting: List[int]) -> Tuple[Dict[int, Tuple[int, int]], bool]:
+        """GetInfo round: each acting peer's last_update.  Returns
+        (peer -> last_update, any_needs_backfill)."""
+        log = self._pglog(pool.pool_id, pg)
+        peers = [o for o in acting
+                 if o != CRUSH_ITEM_NONE and o != self.osd_id]
+        tid = uuid.uuid4().hex
+        q = self._collector(tid)
+        sent = 0
+        for osd in set(peers):
+            try:
+                await self.messenger.send(
+                    self.osdmap.addr_of(osd),
+                    MPGInfoReq(pool_id=pool.pool_id, pg=pg, tid=tid,
+                               reply_to=self.addr))
+                sent += 1
+            except Exception:
+                pass
+        infos: Dict[int, Tuple[int, int]] = {self.osd_id: log.head}
+        for r in await self._gather(tid, q, sent, timeout=2.0):
+            infos[r.osd_id] = tuple(r.last_update)
+        backfill = any(
+            log.calc_missing(v) is None for v in infos.values()
+        )
+        return infos, backfill
+
+    async def _merge_log_entries(self, pool_id: int, pg: int,
+                                 entries: List[LogEntry]) -> List[LogEntry]:
+        """Adopt authoritative log entries; local entries NEWER than the
+        incoming base are divergent — writes a dead primary never committed
+        cluster-wide — and get rolled back (shard dropped + log rewound,
+        the reference's divergent-entry rollback).  Returns merged entries."""
+        log = self._pglog(pool_id, pg)
+        entries = sorted(entries, key=lambda e: e.version)
+        if not entries:
+            return []
+        base = entries[0].prior_version
+        divergent = log.divergent_against(base) if base < log.head else []
+        txn = Transaction()
+        for d in divergent:
+            if d.version >= entries[0].version:
+                continue  # same entry arriving again, not divergence
+            for oid, shard in list(self._list_pool_objects(pool_id)):
+                if oid == d.oid:
+                    txn.delete((pool_id, d.oid, shard))
+            self._cache_drop(pool_id, d.oid)
+        if divergent:
+            log.rewind_to(base)
+        merged = []
+        for e in entries:
+            if e.version > log.head:
+                self._log_in_txn(txn, pool_id, pg, e)
+                merged.append(e)
+        if txn.writes or txn.deletes or txn.omap_sets or txn.omap_rms:
+            self.store.queue_transaction(txn)
+        return merged
+
+    async def _push_log_to_peer(self, pool_id: int, pg: int, osd: int,
+                                entries: List[LogEntry]) -> None:
+        """Unsolicited authoritative log push (tid='') so a caught-up
+        peer's log head advances with the objects it just received."""
+        if not entries:
+            return
+        try:
+            await self.messenger.send(
+                self.osdmap.addr_of(osd),
+                MPGLogReply(tid="", osd_id=self.osd_id, pool_id=pool_id,
+                            pg=pg, entries=[e.encode() for e in entries]))
+        except Exception:
+            pass
+
+    async def _log_recover_pg(self, pool: PoolInfo, pg: int,
+                              acting: List[int]) -> Tuple[int, bool]:
+        """Log-driven delta recovery (PGLog::calc_missing path): push only
+        objects a lagging peer's log says it is missing, then advance the
+        peer's log.  A peer AHEAD of us (it saw commits we missed) is
+        pulled from via MPGLogReq and its entries adopted.  Returns
+        (pushes, backfill_needed)."""
+        log = self._pglog(pool.pool_id, pg)
+        infos, backfill = await self._peer_pg(pool, pg, acting)
+        # peers AHEAD of us hold commits we missed: pull + adopt their log
+        ahead = [(osd, v) for osd, v in infos.items() if v > log.head]
+        for osd, _v in sorted(ahead, key=lambda t: t[1], reverse=True)[:1]:
+            tid = uuid.uuid4().hex
+            q = self._collector(tid)
+            try:
+                await self.messenger.send(
+                    self.osdmap.addr_of(osd),
+                    MPGLogReq(pool_id=pool.pool_id, pg=pg, since=log.head,
+                              tid=tid, reply_to=self.addr))
+            except Exception:
+                continue
+            for r in await self._gather(tid, q, 1, timeout=2.0):
+                if r.backfill:
+                    backfill = True
+                    continue
+                entries = []
+                for blob in r.entries:
+                    e = LogEntry.decode(blob)
+                    e.version = tuple(e.version)
+                    e.prior_version = tuple(e.prior_version)
+                    entries.append(e)
+                merged = await self._merge_log_entries(pool.pool_id, pg,
+                                                       entries)
+                # resync the objects those entries touch across the acting
+                # set (the shard data lives on the ahead peer)
+                if merged:
+                    backfill = True
+        pushed = 0
+        for osd, last in infos.items():
+            if osd == self.osd_id or last >= log.head:
+                continue
+            missing = log.calc_missing(last)
+            if missing is None:
+                backfill = True
+                continue
+            for oid, entry in missing.items():
+                shard_of_peer = None
+                for shard, a in enumerate(acting):
+                    if a == osd:
+                        shard_of_peer = shard
+                        break
+                if shard_of_peer is None:
+                    continue
+                if entry.op == "delete":
+                    try:
+                        await self.messenger.send(
+                            self.osdmap.addr_of(osd),
+                            MECSubDelete(pool_id=pool.pool_id, pg=pg, oid=oid,
+                                         shard=-1, tid="", reply_to=self.addr))
+                        pushed += 1
+                    except Exception:
+                        pass
+                    continue
+                read = await self._do_read(
+                    MOSDOp(op="read", pool_id=pool.pool_id, oid=oid))
+                if not read.ok:
+                    continue
+                codec = self._codec(pool)
+                encoded = codec.encode(set(range(codec.get_chunk_count())),
+                                       read.data)
+                push = MPushShard(
+                    pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard_of_peer,
+                    chunk=bytes(encoded[shard_of_peer]), version=read.version,
+                    object_size=len(read.data))
+                try:
+                    await self.messenger.send(self.osdmap.addr_of(osd), push)
+                    pushed += 1
+                except Exception:
+                    pass
+            # the peer now holds the objects: advance its log so the next
+            # GetInfo round sees it caught up (and its dup set learns the
+            # replayed reqids)
+            delta = log.entries_after(last)
+            if delta:
+                await self._push_log_to_peer(pool.pool_id, pg, osd, delta)
+        return pushed, backfill
+
+    # -- scrub (be_deep_scrub role, ECBackend.cc:2530) -----------------------
+
+    async def _handle_scrub_shard(self, msg: MScrubShard) -> None:
+        key = (msg.pool_id, msg.oid, msg.shard)
+        present = crc_ok = False
+        version = 0
+        try:
+            got = self.store.read(key)
+            if got is not None:
+                present = True
+                chunk, meta = got
+                version = meta.version
+                crc_ok = shard_crc(chunk) == meta.chunk_crc
+        except IOError:
+            present, crc_ok = True, False  # unreadable = scrub error
+        try:
+            await self.messenger.send(
+                tuple(msg.reply_to),
+                MScrubShardReply(tid=msg.tid, osd_id=self.osd_id,
+                                 shard=msg.shard, present=present,
+                                 crc_ok=crc_ok, version=version))
+        except (ConnectionError, OSError):
+            pass
+
+    async def deep_scrub_pool(self, pool: PoolInfo) -> Dict[str, int]:
+        """Primary-led deep scrub: every acting shard of every object this
+        OSD is primary for recomputes its crc against stored meta; bad or
+        missing shards are repaired by re-encode + push."""
+        scrubbed = errors = repaired = 0
+        oids = sorted({oid for oid, _ in self._list_pool_objects(pool.pool_id)})
+        # include objects whose shards live elsewhere
+        for oid, shard, _v in await self._list_all_shards(pool.pool_id):
+            if oid not in oids:
+                oids.append(oid)
+        for oid in oids:
+            pg, acting = self._acting(pool, oid)
+            if self._primary(pool, pg, acting) != self.osd_id:
+                continue
+            scrubbed += 1
+            bad: List[Tuple[int, int]] = []  # (shard, osd)
+            tid = uuid.uuid4().hex
+            q = self._collector(tid)
+            sent = 0
+            local_results: List[MScrubShardReply] = []
+            for shard, osd in enumerate(acting):
+                if osd == CRUSH_ITEM_NONE:
+                    continue
+                if osd == self.osd_id:
+                    key = (pool.pool_id, oid, shard)
+                    try:
+                        got = self.store.read(key)
+                        ok = (got is not None
+                              and shard_crc(got[0]) == got[1].chunk_crc)
+                        local_results.append(MScrubShardReply(
+                            osd_id=self.osd_id, shard=shard,
+                            present=got is not None, crc_ok=ok))
+                    except IOError:
+                        local_results.append(MScrubShardReply(
+                            osd_id=self.osd_id, shard=shard, present=True,
+                            crc_ok=False))
+                else:
+                    try:
+                        await self.messenger.send(
+                            self.osdmap.addr_of(osd),
+                            MScrubShard(pool_id=pool.pool_id, oid=oid,
+                                        shard=shard, tid=tid,
+                                        reply_to=self.addr))
+                        sent += 1
+                    except Exception:
+                        pass
+            replies = local_results + await self._gather(tid, q, sent,
+                                                         timeout=2.0)
+            by_shard = {r.shard: r for r in replies}
+            for shard, osd in enumerate(acting):
+                if osd == CRUSH_ITEM_NONE:
+                    continue
+                r = by_shard.get(shard)
+                if r is None or not r.present or not r.crc_ok:
+                    bad.append((shard, osd))
+            if bad:
+                errors += len(bad)
+                # repair: reconstruct WITHOUT the damaged shards and
+                # re-push them
+                read = await self._do_read(
+                    MOSDOp(op="read", pool_id=pool.pool_id, oid=oid),
+                    exclude_shards=frozenset(s for s, _ in bad))
+                if read.ok:
+                    codec = self._codec(pool)
+                    encoded = codec.encode(
+                        set(range(codec.get_chunk_count())), read.data)
+                    for shard, osd in bad:
+                        push = MPushShard(
+                            pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard,
+                            chunk=bytes(encoded[shard]), version=read.version,
+                            object_size=len(read.data))
+                        if osd == self.osd_id:
+                            self._apply_push(push)
+                            repaired += 1
+                        else:
+                            try:
+                                await self.messenger.send(
+                                    self.osdmap.addr_of(osd), push)
+                                repaired += 1
+                            except Exception:
+                                pass
+        return {"scrubbed": scrubbed, "errors": errors, "repaired": repaired}
+
+    async def _list_all_shards(self, pool_id: int):
+        """Union shard listing (oid, shard, version) across up OSDs."""
+        tid = uuid.uuid4().hex
+        peers = [o for o in self.osdmap.osds.values()
+                 if o.up and o.osd_id != self.osd_id]
+        q = self._collector(tid)
+        sent = 0
+        for o in peers:
+            try:
+                await self.messenger.send(
+                    o.addr, MListShards(pool_id=pool_id, tid=tid,
+                                        reply_to=self.addr))
+                sent += 1
+            except Exception:
+                pass
+        out = []
+        for oid, shard in self._list_pool_objects(pool_id):
+            got = self._store_read((pool_id, oid, shard))
+            if got is not None:
+                out.append((oid, shard, got[1].version))
+        for r in await self._gather(tid, q, sent):
+            out.extend((o, s, v) for (o, s, v) in r.entries)
+        return out
+
     # -- recovery ------------------------------------------------------------
 
     async def repair_pool(self, pool: PoolInfo) -> int:
-        """Reconstruct and push shards missing from the current acting sets
-        of objects this OSD is primary for.  Returns shards pushed."""
+        """Two-phase recovery like the reference: log-driven delta recovery
+        first (peers whose PG logs overlap ours get only their missing
+        objects pushed), then a backfill scan (full list-diff) when any
+        peer's log window doesn't reach, or to sweep strays."""
+        pushed = 0
+        need_backfill = False
+        for pg in range(pool.pg_num):
+            acting = self.osdmap.pg_to_acting(pool, pg)
+            if self._primary(pool, pg, acting) != self.osd_id:
+                continue
+            try:
+                p, backfill = await self._log_recover_pg(pool, pg, acting)
+                pushed += p
+                need_backfill |= backfill
+            except Exception:
+                need_backfill = True  # backfill sweep is the safety net
+        if need_backfill or self.conf.get("osd_repair_full_sweep", True):
+            pushed += await self._backfill_pool(pool)
+        return pushed
+
+    async def _backfill_pool(self, pool: PoolInfo) -> int:
+        """Full-scan recovery (reference backfill): reconstruct and push
+        shards missing from the current acting sets of objects this OSD is
+        primary for.  Returns shards pushed."""
         codec = self._codec(pool)
         k = codec.get_data_chunk_count()
         # union of shard listings from all up OSDs
@@ -770,7 +1291,7 @@ class OSD:
         # oid -> {(shard, osd, version)}: versions matter — a stale shard
         # sitting at its acting position is NOT healthy redundancy
         holdings: Dict[str, Set[Tuple[int, int, int]]] = {}
-        for oid, shard in self.store.list_objects(pool.pool_id):
+        for oid, shard in self._list_pool_objects(pool.pool_id):
             got = self._store_read((pool.pool_id, oid, shard))
             if got is not None:
                 holdings.setdefault(oid, set()).add((shard, self.osd_id, got[1].version))

@@ -29,10 +29,14 @@ class ShardMeta:
 
 @dataclass
 class Transaction:
-    """Atomic batch of shard writes/deletes."""
+    """Atomic batch of shard writes/deletes plus omap mutations (the PG
+    log rides omap in the same transaction as the data, the reference's
+    log_operation + queue_transactions coupling)."""
 
     writes: List[Tuple[Key, bytes, ShardMeta]] = field(default_factory=list)
     deletes: List[Key] = field(default_factory=list)
+    omap_sets: List[Tuple[Key, Dict[str, bytes]]] = field(default_factory=list)
+    omap_rms: List[Tuple[Key, List[str]]] = field(default_factory=list)
 
     def write(self, key: Key, chunk: bytes, meta: ShardMeta) -> None:
         self.writes.append((key, chunk, meta))
@@ -40,9 +44,15 @@ class Transaction:
     def delete(self, key: Key) -> None:
         self.deletes.append(key)
 
+    def omap_set(self, key: Key, entries: Dict[str, bytes]) -> None:
+        self.omap_sets.append((key, dict(entries)))
+
+    def omap_rm(self, key: Key, keys: List[str]) -> None:
+        self.omap_rms.append((key, list(keys)))
+
 
 class ObjectStore:
-    def queue_transaction(self, txn: Transaction) -> None:
+    def queue_transaction(self, txn: Transaction, on_commit=None) -> None:
         raise NotImplementedError
 
     def read(self, key: Key) -> Optional[Tuple[bytes, ShardMeta]]:
@@ -52,16 +62,33 @@ class ObjectStore:
         """Yield (oid, shard) pairs stored for a pool."""
         raise NotImplementedError
 
+    def omap_get(self, key: Key) -> Dict[str, bytes]:
+        return {}
+
 
 class MemStore(ObjectStore):
     def __init__(self) -> None:
         self._data: Dict[Key, Tuple[bytes, ShardMeta]] = {}
+        self._omap: Dict[Key, Dict[str, bytes]] = {}
 
-    def queue_transaction(self, txn: Transaction) -> None:
+    def queue_transaction(self, txn: Transaction, on_commit=None) -> None:
         for key in txn.deletes:
             self._data.pop(key, None)
+            self._omap.pop(key, None)
         for key, chunk, meta in txn.writes:
             self._data[key] = (chunk, meta)
+        for key, entries in txn.omap_sets:
+            self._omap.setdefault(key, {}).update(entries)
+        for key, keys in txn.omap_rms:
+            table = self._omap.get(key)
+            if table:
+                for k in keys:
+                    table.pop(k, None)
+        if on_commit is not None:
+            on_commit()
+
+    def omap_get(self, key: Key) -> Dict[str, bytes]:
+        return dict(self._omap.get(key, {}))
 
     def read(self, key: Key) -> Optional[Tuple[bytes, ShardMeta]]:
         return self._data.get(key)
@@ -86,7 +113,7 @@ class DirStore(ObjectStore):
         pid, oid, shard = key
         return os.path.join(self.path, f"{pid}__{oid.encode().hex()}__{shard}")
 
-    def queue_transaction(self, txn: Transaction) -> None:
+    def queue_transaction(self, txn: Transaction, on_commit=None) -> None:
         for key in txn.deletes:
             for suffix in ("", ".meta"):
                 try:
@@ -102,6 +129,9 @@ class DirStore(ObjectStore):
             with open(path + ".meta.tmp", "w") as f:
                 json.dump(meta.__dict__, f)
             os.replace(path + ".meta.tmp", path + ".meta")
+        # legacy filestore: no omap support (BlueStore carries the PG log)
+        if on_commit is not None:
+            on_commit()
 
     def read(self, key: Key) -> Optional[Tuple[bytes, ShardMeta]]:
         path = self._file(key)

@@ -329,14 +329,17 @@ class MConfigReply:
 # Client <-> primary OSD
 
 
-@message(20)
+@message(20, version=2)
 class MOSDOp:
-    op: str = "read"  # write | read | delete | list
+    op: str = "read"  # write | read | delete | list | repair | deep-scrub
     pool_id: int = 0
     oid: str = ""
     data: bytes = b""
     epoch: int = 0
     reqid: str = ""
+    # offset >= 0: partial overwrite at that byte offset (RMW path,
+    # reference ECBackend try_state_to_reads); -1: full-object write
+    offset: int = -1
 
 
 @message(21)
@@ -353,7 +356,7 @@ class MOSDOpReply:
 # reference src/osd/ECMsgTypes.h:23,105)
 
 
-@message(30)
+@message(30, version=2)
 class MECSubWrite:
     pool_id: int = 0
     pg: int = 0
@@ -365,6 +368,10 @@ class MECSubWrite:
     chunk_crc: int = 0
     tid: str = ""
     reply_to: Tuple[str, int] = ("", 0)
+    # pickled pglog.LogEntry: the replica appends it to its PG log in the
+    # SAME store transaction as the shard write (log_operation coupling,
+    # reference ECBackend::handle_sub_write ECBackend.cc:992)
+    log_entry: bytes = b""
 
 
 @message(31)
@@ -394,7 +401,7 @@ class MECSubReadReply:
     object_size: int = 0
 
 
-@message(34)
+@message(34, version=2)
 class MECSubDelete:
     pool_id: int = 0
     pg: int = 0
@@ -402,6 +409,9 @@ class MECSubDelete:
     shard: int = 0
     tid: str = ""
     reply_to: Tuple[str, int] = ("", 0)
+    # pickled LogEntry: acting-set members log the delete (empty for the
+    # stray-sweep broadcast to non-acting peers)
+    log_entry: bytes = b""
 
 
 @message(35)
@@ -450,3 +460,68 @@ class MFetchShardsReply:
     osd_id: int = 0
     # (shard, chunk, version, object_size)
     shards: List[Tuple[int, bytes, int, int]] = field(default_factory=list)
+
+
+# Peering + scrub (reference MOSDPGQuery/MOSDPGLog, scrub messages)
+
+
+@message(40)
+class MPGInfoReq:
+    pool_id: int = 0
+    pg: int = 0
+    tid: str = ""
+    reply_to: Tuple[str, int] = ("", 0)
+
+
+@message(41)
+class MPGInfoReply:
+    tid: str = ""
+    osd_id: int = 0
+    last_update: Tuple[int, int] = (0, 0)
+    log_tail: Tuple[int, int] = (0, 0)
+
+
+@message(42)
+class MPGLogReq:
+    """Pull log entries after `since` from a peer (MOSDPGLog role)."""
+
+    pool_id: int = 0
+    pg: int = 0
+    since: Tuple[int, int] = (0, 0)
+    tid: str = ""
+    reply_to: Tuple[str, int] = ("", 0)
+
+
+@message(43, version=2)
+class MPGLogReply:
+    """Log entries in answer to MPGLogReq, or (tid='') an unsolicited
+    authoritative push from the primary after recovery."""
+
+    tid: str = ""
+    osd_id: int = 0
+    pool_id: int = 0
+    pg: int = 0
+    backfill: bool = False  # since predates my tail: log can't catch you up
+    entries: List[bytes] = field(default_factory=list)  # pickled LogEntry
+
+
+@message(44)
+class MScrubShard:
+    """Deep-scrub probe: recompute the stored chunk's crc and compare with
+    the persisted meta (be_deep_scrub role, ECBackend.cc:2530)."""
+
+    pool_id: int = 0
+    oid: str = ""
+    shard: int = 0
+    tid: str = ""
+    reply_to: Tuple[str, int] = ("", 0)
+
+
+@message(45)
+class MScrubShardReply:
+    tid: str = ""
+    osd_id: int = 0
+    shard: int = 0
+    present: bool = False
+    crc_ok: bool = False
+    version: int = 0
