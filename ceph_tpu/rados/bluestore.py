@@ -311,6 +311,10 @@ class BlueStore(ObjectStore):
         onode = self._onodes.get(key)
         return onode.xattrs.get(name) if onode else None
 
+    def getattrs(self, key: Key) -> Dict[str, bytes]:
+        onode = self._onodes.get(key)
+        return dict(onode.xattrs) if onode else {}
+
     def omap_set(self, key: Key, entries: Dict[str, bytes]) -> None:
         batch = WriteBatch()
         for k, v in entries.items():

@@ -81,6 +81,7 @@ from ceph_tpu.rados.types import (
     MPushShard,
     MScrubShard,
     MScrubShardReply,
+    MSetXattrs,
     OSDMap,
     PoolInfo,
 )
@@ -143,6 +144,9 @@ class OSD:
         # reqids whose write failed min_size: a resend must RE-EXECUTE,
         # not be acked as a dup
         self._failed_writes: Set[str] = set()
+        # class-call results by reqid (non-idempotent methods must not
+        # re-execute on a resend)
+        self._call_results: Dict[str, MOSDOpReply] = {}
         # primary-side cache of decoded objects pinned across RMW rounds
         # (src/osd/ExtentCache.{h,cc} role)
         self._extent_cache: "Dict[Tuple[int, str], Tuple[int, bytes]]" = {}
@@ -364,6 +368,13 @@ class OSD:
             await self._handle_pg_log_req(msg)
         elif isinstance(msg, MScrubShard):
             await self._handle_scrub_shard(msg)
+        elif isinstance(msg, MSetXattrs):
+            key = (msg.pool_id, msg.oid, msg.shard)
+            try:
+                for name, value in msg.xattrs.items():
+                    self.store.setattr(key, name, value)
+            except NotImplementedError:
+                pass
         elif isinstance(msg, MPGLogReply) and not msg.tid:
             # unsolicited authoritative log push from the primary: merge
             # (with divergent-entry rollback) so our head catches up
@@ -415,8 +426,7 @@ class OSD:
         await asyncio.sleep(self.conf.get("osd_repair_delay", 0.5))
         try:
             for pool in list(self.osdmap.pools.values()):
-                if pool.pool_type == "ec":
-                    await self.repair_pool(pool)
+                await self.repair_pool(pool)
         except Exception:
             pass
 
@@ -502,6 +512,12 @@ class OSD:
     def _cache_drop(self, pool_id: int, oid: str) -> None:
         self._extent_cache.pop((pool_id, oid), None)
 
+    def _mark_failed_write(self, reqid: str) -> None:
+        if reqid:
+            self._failed_writes.add(reqid)
+            while len(self._failed_writes) > 1024:
+                self._failed_writes.pop()
+
     def _pg_key_of(self, op: MOSDOp) -> int:
         if self.osdmap is None:
             return 0
@@ -543,6 +559,8 @@ class OSD:
                 if pool is not None:
                     await self.repair_pool(pool)
                 reply = MOSDOpReply(ok=True)
+            elif op.op == "call":
+                reply = await self._do_call(op)
             elif op.op == "deep-scrub":
                 pool = self.osdmap.pools.get(op.pool_id)
                 if pool is None:
@@ -586,6 +604,8 @@ class OSD:
             # client resend of an op we already applied (pg log dups role)
             return MOSDOpReply(ok=True)
         self._failed_writes.discard(op.reqid)
+        if pool.pool_type != "ec":
+            return await self._do_write_replicated(op, pool, pg, acting)
         data = op.data
         if op.offset >= 0:
             # partial overwrite: READ-modify-write (try_state_to_reads,
@@ -642,10 +662,7 @@ class OSD:
         if acks < pool.min_size:
             # the entry is logged but the write failed: a same-reqid resend
             # must re-execute rather than be deduped into false success
-            if op.reqid:
-                self._failed_writes.add(op.reqid)
-                while len(self._failed_writes) > 1024:
-                    self._failed_writes.pop()
+            self._mark_failed_write(op.reqid)
             return MOSDOpReply(
                 ok=False, error=f"write acked by {acks} < min_size {pool.min_size}"
             )
@@ -658,6 +675,8 @@ class OSD:
         (scrub found a crc mismatch) from every source, so a repair read
         cannot launder corruption back into the object."""
         pool = self.osdmap.pools[op.pool_id]
+        if pool.pool_type != "ec":
+            return await self._do_read_replicated(op, pool, exclude_shards)
         codec = self._codec(pool)
         pg, acting = self._acting(pool, op.oid)
         k = codec.get_data_chunk_count()
@@ -735,6 +754,176 @@ class OSD:
         data = codec.decode_concat(arrays)
         self._cache_put(op.pool_id, op.oid, newest, bytes(data[:object_size]))
         return MOSDOpReply(ok=True, data=data[:object_size], version=newest)
+
+    class _AllShards:
+        """Replicated 'encoding': every position gets the full object."""
+
+        def __init__(self, data: bytes):
+            self.data = data
+
+        def __getitem__(self, shard: int) -> bytes:
+            return self.data
+
+    def _encode_for(self, pool: PoolInfo, data: bytes):
+        if pool.pool_type == "ec":
+            codec = self._codec(pool)
+            return codec.encode(set(range(codec.get_chunk_count())), data)
+        return OSD._AllShards(data)
+
+    # -- ReplicatedBackend (reference src/osd/ReplicatedBackend.cc) ----------
+
+    async def _do_write_replicated(self, op: MOSDOp, pool: PoolInfo,
+                                   pg: int, acting: List[int]) -> MOSDOpReply:
+        """Full copies to every acting position; same log/ack machinery as
+        EC but without encode.  Dedupe/failed-write gating already happened
+        in _do_write, the single entry point."""
+        log = self._pglog(op.pool_id, pg)
+        data = op.data
+        if op.offset >= 0:
+            cached = self._cache_get(op.pool_id, op.oid)
+            if cached is not None:
+                base = bytearray(cached[1])
+            else:
+                read = await self._do_read_replicated(
+                    MOSDOp(op="read", pool_id=op.pool_id, oid=op.oid), pool)
+                base = bytearray(read.data) if read.ok else bytearray()
+            if len(base) < op.offset:
+                base.extend(b"\x00" * (op.offset - len(base)))
+            base[op.offset:op.offset + len(op.data)] = op.data
+            data = bytes(base)
+        version = time.time_ns()
+        entry = LogEntry(version=log.next_version(self.osdmap.epoch),
+                         op="write", oid=op.oid, prior_version=log.head,
+                         reqid=op.reqid, object_version=version)
+        entry_blob = entry.encode()
+        tid = uuid.uuid4().hex
+        q = self._collector(tid)
+        sent = 0
+        for shard, osd in enumerate(acting):
+            if osd == CRUSH_ITEM_NONE:
+                continue
+            if osd == self.osd_id:
+                self._apply_shard_write(op.pool_id, op.oid, shard, data,
+                                        version, len(data), pg=pg, entry=entry)
+            else:
+                try:
+                    await self.messenger.send(
+                        self.osdmap.addr_of(osd),
+                        MECSubWrite(pool_id=op.pool_id, pg=pg, oid=op.oid,
+                                    shard=shard, chunk=data, version=version,
+                                    object_size=len(data),
+                                    chunk_crc=shard_crc(data), tid=tid,
+                                    reply_to=self.addr, log_entry=entry_blob))
+                    sent += 1
+                except Exception:
+                    pass
+        replies = await self._gather(tid, q, sent)
+        acks = 1 + sum(1 for r in replies if r.ok)
+        if acks < pool.min_size:
+            self._mark_failed_write(op.reqid)
+            return MOSDOpReply(
+                ok=False, error=f"write acked by {acks} < min_size {pool.min_size}")
+        self._cache_put(op.pool_id, op.oid, version, data)
+        return MOSDOpReply(ok=True)
+
+    async def _do_read_replicated(self, op: MOSDOp, pool: PoolInfo,
+                                  exclude_shards: frozenset = frozenset()
+                                  ) -> MOSDOpReply:
+        """Serve from the local copy, else ask acting peers; newest wins."""
+        pg, acting = self._acting(pool, op.oid)
+        best: Optional[Tuple[bytes, int, int]] = None  # data, version, size
+        for shard, osd in enumerate(acting):
+            if osd != self.osd_id or shard in exclude_shards:
+                continue
+            got = self._store_read((op.pool_id, op.oid, shard))
+            if got is not None:
+                best = (got[0], got[1].version, got[1].object_size)
+        # a local copy older than what the PG log says was committed is a
+        # stale survivor from a degraded write: hunt for the newer copy
+        log = self._pglog(op.pool_id, pg)
+        latest_logged = max(
+            (e.object_version for e in log.entries if e.oid == op.oid),
+            default=0,
+        )
+        if best is not None and best[1] < latest_logged:
+            best = None
+        if best is None:
+            # a copy is a copy regardless of the position key it was stored
+            # under in an earlier interval: hunt every up OSD for any shard
+            # of the oid and take the newest (placement-drift tolerance)
+            for shard, chunk, version, osize in await self._fetch_all_shards(
+                    op.pool_id, op.oid):
+                if shard in exclude_shards:
+                    continue
+                if best is None or version > best[1]:
+                    best = (chunk, version, osize)
+        if best is None:
+            return MOSDOpReply(ok=False, error="object not found")
+        data, version, size = best
+        self._cache_put(op.pool_id, op.oid, version, data[:size])
+        return MOSDOpReply(ok=True, data=data[:size], version=version)
+
+    # -- object classes (reference src/cls/, ClassHandler) -------------------
+
+    async def _do_call(self, op: MOSDOp) -> MOSDOpReply:
+        from ceph_tpu.services.cls import ClsContext
+        from ceph_tpu.services.cls import registry as cls_registry
+
+        pool = self.osdmap.pools[op.pool_id]
+        if pool.pool_type == "ec":
+            # reference parity: EC pools do not support class calls
+            return MOSDOpReply(ok=False,
+                               error="EOPNOTSUPP: class calls on EC pools")
+        pg, acting = self._acting(pool, op.oid)
+        if self._primary(pool, pg, acting) != self.osd_id:
+            return MOSDOpReply(ok=False, error="not primary")
+        # class methods are not idempotent (refcount.get): a resend whose
+        # reply was lost must return the ORIGINAL result, not re-execute
+        if op.reqid and op.reqid in self._call_results:
+            return self._call_results[op.reqid]
+        fn = cls_registry.get(op.cls, op.method)
+        if fn is None:
+            return MOSDOpReply(ok=False,
+                               error=f"ENOENT: no class {op.cls}.{op.method}")
+        my_shard = next((s for s, o in enumerate(acting)
+                         if o == self.osd_id), None)
+        key = (op.pool_id, op.oid, my_shard if my_shard is not None else 0)
+        # data via the replicated read path (a just-promoted primary may
+        # not hold a local copy); xattrs from local, kept fresh by
+        # MSetXattrs replication below
+        read = await self._do_read_replicated(
+            MOSDOp(op="read", pool_id=op.pool_id, oid=op.oid), pool)
+        hctx = ClsContext(read.data if read.ok else None,
+                          dict(self.store.getattrs(key)))
+        ret, out = fn(hctx, op.data)
+        if hctx.data_dirty and ret == 0:
+            wr = await self._do_write_replicated(
+                MOSDOp(op="write", pool_id=op.pool_id, oid=op.oid,
+                       data=hctx.data, reqid=uuid.uuid4().hex),
+                pool, pg, acting)
+            if not wr.ok:
+                return MOSDOpReply(ok=False, error=wr.error)
+        if hctx.xattrs_dirty and ret >= 0:
+            for name, value in hctx.xattrs.items():
+                self.store.setattr(key, name, value)
+            # replicate xattr state to the other acting members so a
+            # failover primary still sees locks/refcounts
+            for shard, osd in enumerate(acting):
+                if osd in (CRUSH_ITEM_NONE, self.osd_id):
+                    continue
+                try:
+                    await self.messenger.send(
+                        self.osdmap.addr_of(osd),
+                        MSetXattrs(pool_id=op.pool_id, oid=op.oid,
+                                   shard=shard, xattrs=dict(hctx.xattrs)))
+                except Exception:
+                    pass
+        reply = MOSDOpReply(ok=True, data=pickle.dumps((ret, out)))
+        if op.reqid:
+            self._call_results[op.reqid] = reply
+            while len(self._call_results) > 512:
+                self._call_results.pop(next(iter(self._call_results)))
+        return reply
 
     async def _do_delete(self, op: MOSDOp) -> MOSDOpReply:
         """Delete EVERY shard of the object on every up OSD, not just the
@@ -1093,9 +1282,7 @@ class OSD:
                     MOSDOp(op="read", pool_id=pool.pool_id, oid=oid))
                 if not read.ok:
                     continue
-                codec = self._codec(pool)
-                encoded = codec.encode(set(range(codec.get_chunk_count())),
-                                       read.data)
+                encoded = self._encode_for(pool, read.data)
                 push = MPushShard(
                     pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard_of_peer,
                     chunk=bytes(encoded[shard_of_peer]), version=read.version,
@@ -1200,9 +1387,7 @@ class OSD:
                     MOSDOp(op="read", pool_id=pool.pool_id, oid=oid),
                     exclude_shards=frozenset(s for s, _ in bad))
                 if read.ok:
-                    codec = self._codec(pool)
-                    encoded = codec.encode(
-                        set(range(codec.get_chunk_count())), read.data)
+                    encoded = self._encode_for(pool, read.data)
                     for shard, osd in bad:
                         push = MPushShard(
                             pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard,
@@ -1271,8 +1456,6 @@ class OSD:
         """Full-scan recovery (reference backfill): reconstruct and push
         shards missing from the current acting sets of objects this OSD is
         primary for.  Returns shards pushed."""
-        codec = self._codec(pool)
-        k = codec.get_data_chunk_count()
         # union of shard listings from all up OSDs
         tid = uuid.uuid4().hex
         peers = [
@@ -1320,7 +1503,7 @@ class OSD:
             # re-encode at the object's CURRENT version: deterministic encode
             # makes pushed shards byte-identical to the originals, and the
             # version stays consistent with surviving shards
-            encoded = codec.encode(set(range(codec.get_chunk_count())), reply.data)
+            encoded = self._encode_for(pool, reply.data)
             version = reply.version
             for shard, osd in missing:
                 chunk = bytes(encoded[shard])

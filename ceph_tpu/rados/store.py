@@ -65,11 +65,21 @@ class ObjectStore:
     def omap_get(self, key: Key) -> Dict[str, bytes]:
         return {}
 
+    def getattr(self, key: Key, name: str) -> Optional[bytes]:
+        return None
+
+    def setattr(self, key: Key, name: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def getattrs(self, key: Key) -> Dict[str, bytes]:
+        return {}
+
 
 class MemStore(ObjectStore):
     def __init__(self) -> None:
         self._data: Dict[Key, Tuple[bytes, ShardMeta]] = {}
         self._omap: Dict[Key, Dict[str, bytes]] = {}
+        self._xattrs: Dict[Key, Dict[str, bytes]] = {}
 
     def queue_transaction(self, txn: Transaction, on_commit=None) -> None:
         for key in txn.deletes:
@@ -89,6 +99,15 @@ class MemStore(ObjectStore):
 
     def omap_get(self, key: Key) -> Dict[str, bytes]:
         return dict(self._omap.get(key, {}))
+
+    def getattr(self, key: Key, name: str) -> Optional[bytes]:
+        return self._xattrs.get(key, {}).get(name)
+
+    def setattr(self, key: Key, name: str, value: bytes) -> None:
+        self._xattrs.setdefault(key, {})[name] = value
+
+    def getattrs(self, key: Key) -> Dict[str, bytes]:
+        return dict(self._xattrs.get(key, {}))
 
     def read(self, key: Key) -> Optional[Tuple[bytes, ShardMeta]]:
         return self._data.get(key)

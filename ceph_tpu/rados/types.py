@@ -329,9 +329,9 @@ class MConfigReply:
 # Client <-> primary OSD
 
 
-@message(20, version=2)
+@message(20, version=3)
 class MOSDOp:
-    op: str = "read"  # write | read | delete | list | repair | deep-scrub
+    op: str = "read"  # write | read | delete | list | repair | deep-scrub | call
     pool_id: int = 0
     oid: str = ""
     data: bytes = b""
@@ -340,6 +340,10 @@ class MOSDOp:
     # offset >= 0: partial overwrite at that byte offset (RMW path,
     # reference ECBackend try_state_to_reads); -1: full-object write
     offset: int = -1
+    # op == "call": in-OSD object class execution (reference src/cls/;
+    # EC pools answer ENOTSUP, doc/dev/osd_internals/erasure_coding)
+    cls: str = ""
+    method: str = ""
 
 
 @message(21)
@@ -515,6 +519,17 @@ class MScrubShard:
     shard: int = 0
     tid: str = ""
     reply_to: Tuple[str, int] = ("", 0)
+
+
+@message(46)
+class MSetXattrs:
+    """Primary -> acting peers: replicate object-class xattr state so a
+    failover primary still sees locks/refcounts (cls durability)."""
+
+    pool_id: int = 0
+    oid: str = ""
+    shard: int = 0
+    xattrs: Dict[str, bytes] = field(default_factory=dict)
 
 
 @message(45)

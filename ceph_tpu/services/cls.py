@@ -1,0 +1,143 @@
+"""In-OSD object classes (reference src/cls/ + src/osd/ClassHandler.cc).
+
+A class is a named bundle of methods executed ON the OSD against an
+object: ``method(hctx, input) -> (retcode, output)`` where hctx exposes
+read/write/xattr access to the target object.  The registry mirrors the
+reference's dlopen ClassHandler: classes register at import; the OSD looks
+them up at `op=call` dispatch.  EC pools return -EOPNOTSUPP exactly as the
+reference does (doc/dev/osd_internals/erasure_coding/ecbackend.rst
+"Object Classes") — class methods read/modify objects in place, which the
+EC write path cannot do server-side.
+
+Shipped classes mirror the most-used reference ones in miniature:
+- ``lock``: advisory lock (cls_lock role) stored in an xattr
+- ``refcount``: get/put a reference counter (cls_refcount role)
+- ``version``: object version stamp (cls_version role)
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+ENOTSUP = -95
+ENOENT = -2
+EBUSY = -16
+
+
+class ClsContext:
+    """Handle the OSD passes to a class method (cls_method_context role)."""
+
+    def __init__(self, data: Optional[bytes], xattrs: Dict[str, bytes]):
+        self.data = data  # None: object absent
+        self.xattrs = xattrs
+        self.data_dirty = False
+        self.xattrs_dirty = False
+
+    def read(self) -> Optional[bytes]:
+        return self.data
+
+    def write(self, data: bytes) -> None:
+        self.data = data
+        self.data_dirty = True
+
+    def getxattr(self, name: str) -> Optional[bytes]:
+        return self.xattrs.get(name)
+
+    def setxattr(self, name: str, value: bytes) -> None:
+        self.xattrs[name] = value
+        self.xattrs_dirty = True
+
+
+Method = Callable[[ClsContext, bytes], Tuple[int, bytes]]
+
+
+class ClassRegistry:
+    def __init__(self):
+        self._classes: Dict[str, Dict[str, Method]] = {}
+
+    def register(self, cls_name: str, method: str, fn: Method) -> None:
+        self._classes.setdefault(cls_name, {})[method] = fn
+
+    def get(self, cls_name: str, method: str) -> Optional[Method]:
+        return self._classes.get(cls_name, {}).get(method)
+
+    def classes(self) -> Dict[str, list]:
+        return {c: sorted(m) for c, m in self._classes.items()}
+
+
+registry = ClassRegistry()
+
+
+def cls_method(cls_name: str, method: str):
+    def deco(fn: Method) -> Method:
+        registry.register(cls_name, method, fn)
+        return fn
+
+    return deco
+
+
+# -- shipped classes ---------------------------------------------------------
+
+
+@cls_method("lock", "lock")
+def _lock_acquire(hctx: ClsContext, inp: bytes) -> Tuple[int, bytes]:
+    req = json.loads(inp or b"{}")
+    owner = req.get("owner", "")
+    ttl = float(req.get("ttl", 30.0))
+    raw = hctx.getxattr("lock.state")
+    if raw:
+        st = json.loads(raw)
+        if (st.get("owner") and st["owner"] != owner
+                and st.get("expires", 0) > time.time()):
+            return EBUSY, json.dumps(st).encode()
+    hctx.setxattr("lock.state", json.dumps(
+        {"owner": owner, "expires": time.time() + ttl}).encode())
+    return 0, b""
+
+
+@cls_method("lock", "unlock")
+def _lock_release(hctx: ClsContext, inp: bytes) -> Tuple[int, bytes]:
+    req = json.loads(inp or b"{}")
+    raw = hctx.getxattr("lock.state")
+    st = json.loads(raw) if raw else {}
+    if not st.get("owner"):
+        return ENOENT, b""
+    if st["owner"] != req.get("owner", ""):
+        return EBUSY, json.dumps(st).encode()
+    hctx.setxattr("lock.state", b"{}")
+    return 0, b""
+
+
+@cls_method("lock", "info")
+def _lock_info(hctx: ClsContext, inp: bytes) -> Tuple[int, bytes]:
+    return 0, hctx.getxattr("lock.state") or b"{}"
+
+
+@cls_method("refcount", "get")
+def _ref_get(hctx: ClsContext, inp: bytes) -> Tuple[int, bytes]:
+    n = int(hctx.getxattr("refcount") or b"0") + 1
+    hctx.setxattr("refcount", str(n).encode())
+    return 0, str(n).encode()
+
+
+@cls_method("refcount", "put")
+def _ref_put(hctx: ClsContext, inp: bytes) -> Tuple[int, bytes]:
+    n = max(0, int(hctx.getxattr("refcount") or b"0") - 1)
+    hctx.setxattr("refcount", str(n).encode())
+    return 0, str(n).encode()
+
+
+@cls_method("version", "set")
+def _ver_set(hctx: ClsContext, inp: bytes) -> Tuple[int, bytes]:
+    hctx.setxattr("cls.version", inp)
+    return 0, b""
+
+
+@cls_method("version", "get")
+def _ver_get(hctx: ClsContext, inp: bytes) -> Tuple[int, bytes]:
+    v = hctx.getxattr("cls.version")
+    if v is None:
+        return ENOENT, b""
+    return 0, v
