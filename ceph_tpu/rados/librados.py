@@ -60,8 +60,12 @@ class IoCtx:
         await self._c.delete(self.pool_id, oid)
 
     async def stat(self, oid: str) -> Dict[str, int]:
-        data = await self._c.get(self.pool_id, oid)
-        return {"size": len(data)}
+        """Size/version from shard metadata — no payload transfer."""
+        from ceph_tpu.rados.types import MOSDOp
+
+        reply = await self._c._op(MOSDOp(op="stat", pool_id=self.pool_id,
+                                         oid=oid))
+        return {"size": int(reply.data), "version": reply.version}
 
     async def list_objects(self) -> List[str]:
         return await self._c.list_objects(self.pool_id)

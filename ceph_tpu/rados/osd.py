@@ -561,6 +561,8 @@ class OSD:
                 reply = MOSDOpReply(ok=True)
             elif op.op == "call":
                 reply = await self._do_call(op)
+            elif op.op == "stat":
+                reply = await self._do_stat(op)
             elif op.op == "deep-scrub":
                 pool = self.osdmap.pools.get(op.pool_id)
                 if pool is None:
@@ -589,7 +591,6 @@ class OSD:
 
     async def _do_write(self, op: MOSDOp) -> MOSDOpReply:
         pool = self.osdmap.pools[op.pool_id]
-        codec = self._codec(pool)
         pg, acting = self._acting(pool, op.oid)
         if self._primary(pool, pg, acting) != self.osd_id:
             return MOSDOpReply(ok=False, error="not primary")
@@ -606,6 +607,7 @@ class OSD:
         self._failed_writes.discard(op.reqid)
         if pool.pool_type != "ec":
             return await self._do_write_replicated(op, pool, pg, acting)
+        codec = self._codec(pool)
         data = op.data
         if op.offset >= 0:
             # partial overwrite: READ-modify-write (try_state_to_reads,
@@ -836,7 +838,7 @@ class OSD:
             if osd != self.osd_id or shard in exclude_shards:
                 continue
             got = self._store_read((op.pool_id, op.oid, shard))
-            if got is not None:
+            if got is not None and (best is None or got[1].version > best[1]):
                 best = (got[0], got[1].version, got[1].object_size)
         # a local copy older than what the PG log says was committed is a
         # stale survivor from a degraded write: hunt for the newer copy
@@ -885,18 +887,16 @@ class OSD:
         if fn is None:
             return MOSDOpReply(ok=False,
                                error=f"ENOENT: no class {op.cls}.{op.method}")
-        my_shard = next((s for s, o in enumerate(acting)
-                         if o == self.osd_id), None)
-        key = (op.pool_id, op.oid, my_shard if my_shard is not None else 0)
-        # data via the replicated read path (a just-promoted primary may
-        # not hold a local copy); xattrs from local, kept fresh by
-        # MSetXattrs replication below
+        # cls state lives under a CANONICAL shard key (0) so it survives
+        # acting-position drift; data via the replicated read path (a
+        # just-promoted primary may not hold a local copy)
+        key = (op.pool_id, op.oid, 0)
         read = await self._do_read_replicated(
             MOSDOp(op="read", pool_id=op.pool_id, oid=op.oid), pool)
         hctx = ClsContext(read.data if read.ok else None,
                           dict(self.store.getattrs(key)))
         ret, out = fn(hctx, op.data)
-        if hctx.data_dirty and ret == 0:
+        if hctx.data_dirty and ret >= 0:
             wr = await self._do_write_replicated(
                 MOSDOp(op="write", pool_id=op.pool_id, oid=op.oid,
                        data=hctx.data, reqid=uuid.uuid4().hex),
@@ -915,7 +915,7 @@ class OSD:
                     await self.messenger.send(
                         self.osdmap.addr_of(osd),
                         MSetXattrs(pool_id=op.pool_id, oid=op.oid,
-                                   shard=shard, xattrs=dict(hctx.xattrs)))
+                                   shard=0, xattrs=dict(hctx.xattrs)))
                 except Exception:
                     pass
         reply = MOSDOpReply(ok=True, data=pickle.dumps((ret, out)))
@@ -924,6 +924,44 @@ class OSD:
             while len(self._call_results) > 512:
                 self._call_results.pop(next(iter(self._call_results)))
         return reply
+
+    async def _do_stat(self, op: MOSDOp) -> MOSDOpReply:
+        """Size/version from shard metadata — no payload transfer/decode
+        (stat must not cost a full read)."""
+        pool = self.osdmap.pools[op.pool_id]
+        pg, acting = self._acting(pool, op.oid)
+        best: Optional[Tuple[int, int]] = None  # (version, object_size)
+        for shard, osd in enumerate(acting):
+            if osd != self.osd_id:
+                continue
+            got = self._store_read((op.pool_id, op.oid, shard))
+            if got is not None and (best is None or got[1].version > best[0]):
+                best = (got[1].version, got[1].object_size)
+        if best is None:
+            # one sub-read to the first live acting peer (transfers one
+            # chunk, not k) carries the metadata we need
+            tid = uuid.uuid4().hex
+            q = self._collector(tid)
+            sent = 0
+            for shard, osd in enumerate(acting):
+                if osd in (CRUSH_ITEM_NONE, self.osd_id):
+                    continue
+                try:
+                    await self.messenger.send(
+                        self.osdmap.addr_of(osd),
+                        MECSubRead(pool_id=op.pool_id, pg=pg, oid=op.oid,
+                                   shard=shard, tid=tid, reply_to=self.addr))
+                    sent += 1
+                except Exception:
+                    continue
+                break
+            for r in await self._gather(tid, q, sent, timeout=2.0):
+                if r.ok:
+                    best = (r.version, r.object_size)
+        if best is None:
+            return MOSDOpReply(ok=False, error="object not found")
+        return MOSDOpReply(ok=True, version=best[0],
+                           data=str(best[1]).encode())
 
     async def _do_delete(self, op: MOSDOp) -> MOSDOpReply:
         """Delete EVERY shard of the object on every up OSD, not just the
@@ -1117,6 +1155,12 @@ class OSD:
         self._apply_shard_write(
             msg.pool_id, msg.oid, msg.shard, msg.chunk, msg.version, msg.object_size
         )
+        if msg.xattrs:
+            try:
+                for name, value in msg.xattrs.items():
+                    self.store.setattr((msg.pool_id, msg.oid, 0), name, value)
+            except NotImplementedError:
+                pass
 
     # -- peering (GetInfo/GetLog exchange, reference PeeringState) -----------
 
@@ -1505,11 +1549,13 @@ class OSD:
             # version stays consistent with surviving shards
             encoded = self._encode_for(pool, reply.data)
             version = reply.version
+            xattrs = dict(self.store.getattrs((pool.pool_id, oid, 0)))
             for shard, osd in missing:
                 chunk = bytes(encoded[shard])
                 push = MPushShard(
                     pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard, chunk=chunk,
                     version=version, object_size=len(reply.data),
+                    xattrs=xattrs,
                 )
                 if osd == self.osd_id:
                     self._apply_push(push)

@@ -35,6 +35,14 @@ class RadosStriper:
     async def write(self, soid: str, data: bytes) -> None:
         """Full-object striped write: pieces in parallel + header
         (layout + size)."""
+        # the previous header (one tiny read) tells us exactly which tail
+        # pieces a shrinking rewrite must trim — never a pool listing
+        old_pieces = 0
+        try:
+            old_pieces = json.loads(
+                await self.ioctx.read(self._header(soid)))["pieces"]
+        except (RadosError, KeyError, ValueError):
+            pass
         n = max(1, (len(data) + self.object_size - 1) // self.object_size)
         await asyncio.gather(*(
             self.ioctx.write_full(
@@ -46,15 +54,11 @@ class RadosStriper:
                   "pieces": n}
         await self.ioctx.write_full(self._header(soid),
                                     json.dumps(header).encode())
-        # trim pieces left over from a previous, larger incarnation —
-        # existence comes from the object listing, not full-piece reads
-        prefix = f"{soid}."
-        stale = [
-            o for o in await self.ioctx.list_objects()
-            if o.startswith(prefix) and not o.endswith("__striper__")
-            and o[len(prefix):].isdigit() and int(o[len(prefix):]) >= n
-        ]
-        await asyncio.gather(*(self.ioctx.remove(o) for o in stale))
+        if old_pieces > n:
+            await asyncio.gather(*(
+                self.ioctx.remove(self._piece(soid, i))
+                for i in range(n, old_pieces)
+            ), return_exceptions=True)
 
     async def read(self, soid: str) -> bytes:
         header = json.loads(await self.ioctx.read(self._header(soid)))

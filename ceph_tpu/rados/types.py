@@ -418,9 +418,11 @@ class MECSubDelete:
     log_entry: bytes = b""
 
 
-@message(35)
+@message(35, version=2)
 class MPushShard:
-    """Recovery push of a reconstructed shard (reference PushOp)."""
+    """Recovery push of a reconstructed shard (reference PushOp).  Carries
+    the object's cls xattr state so a backfilled OSD can serve class calls
+    (reference pushes attrs alongside data)."""
 
     pool_id: int = 0
     pg: int = 0
@@ -429,6 +431,7 @@ class MPushShard:
     chunk: bytes = b""
     version: int = 0
     object_size: int = 0
+    xattrs: Dict[str, bytes] = field(default_factory=dict)
 
 
 @message(36)

@@ -1,0 +1,168 @@
+"""MDS-lite: a POSIX-style file namespace over RADOS.
+
+Role-equivalent of the reference's CephFS metadata path in miniature
+(reference src/mds/, src/client/): directories are metadata objects
+holding dentries (the CInode/CDir/CDentry cache's persistent form —
+reference stores dirfrags as omap on meta-pool objects); file data is
+striped over data-pool objects exactly like the reference's
+``<ino>.<frag>`` layout (via the striper).  The API mirrors libcephfs's
+shape: mkdir/listdir/stat/write/read/unlink/rename.
+
+Divergence by design: a single MDS with no journaling/subtree migration —
+the namespace-over-objects layout and path-walk semantics are the core
+being reproduced; locking rides the cls lock class when callers need it.
+"""
+
+from __future__ import annotations
+
+import json
+import posixpath
+import time
+from typing import Dict, List, Optional
+
+from ceph_tpu.rados.client import RadosError
+from ceph_tpu.rados.librados import IoCtx
+from ceph_tpu.rados.striper import RadosStriper
+
+
+class FsError(Exception):
+    pass
+
+
+class FileSystem:
+    def __init__(self, meta_ioctx: IoCtx, data_ioctx: Optional[IoCtx] = None,
+                 object_size: int = 1 << 22):
+        self.meta = meta_ioctx
+        self.data = data_ioctx or meta_ioctx
+        self.striper = RadosStriper(self.data, object_size=object_size)
+
+    # -- dentries ------------------------------------------------------------
+
+    @staticmethod
+    def _norm(path: str) -> str:
+        p = posixpath.normpath("/" + path.strip("/"))
+        return p
+
+    @staticmethod
+    def _dir_oid(path: str) -> str:
+        return f"dir:{path}"
+
+    @staticmethod
+    def _file_oid(path: str) -> str:
+        return f"file:{path}"
+
+    async def _load_dir(self, path: str) -> Optional[Dict[str, Dict]]:
+        try:
+            return json.loads(await self.meta.read(self._dir_oid(path)))
+        except RadosError:
+            return None
+
+    async def _save_dir(self, path: str, dentries: Dict[str, Dict]) -> None:
+        await self.meta.write_full(self._dir_oid(path),
+                                   json.dumps(dentries).encode())
+
+    async def mkfs(self) -> None:
+        if await self._load_dir("/") is None:
+            await self._save_dir("/", {})
+
+    async def _parent_of(self, path: str):
+        parent = posixpath.dirname(path)
+        name = posixpath.basename(path)
+        dentries = await self._load_dir(parent)
+        if dentries is None:
+            raise FsError(f"ENOENT: parent {parent}")
+        return parent, name, dentries
+
+    # -- namespace ops -------------------------------------------------------
+
+    async def mkdir(self, path: str) -> None:
+        path = self._norm(path)
+        if path == "/":
+            raise FsError("EEXIST: /")
+        parent, name, dentries = await self._parent_of(path)
+        if name in dentries:
+            raise FsError(f"EEXIST: {path}")
+        await self._save_dir(path, {})
+        dentries[name] = {"type": "dir", "mtime": time.time()}
+        await self._save_dir(parent, dentries)
+
+    async def listdir(self, path: str) -> List[str]:
+        path = self._norm(path)
+        dentries = await self._load_dir(path)
+        if dentries is None:
+            raise FsError(f"ENOENT: {path}")
+        return sorted(dentries)
+
+    async def stat(self, path: str) -> Dict:
+        path = self._norm(path)
+        if path == "/":
+            return {"type": "dir"}
+        parent, name, dentries = await self._parent_of(path)
+        if name not in dentries:
+            raise FsError(f"ENOENT: {path}")
+        return dict(dentries[name])
+
+    async def write_file(self, path: str, data: bytes) -> None:
+        path = self._norm(path)
+        parent, name, dentries = await self._parent_of(path)
+        existing = dentries.get(name)
+        if existing and existing["type"] == "dir":
+            raise FsError(f"EISDIR: {path}")
+        await self.striper.write(self._file_oid(path), data)
+        dentries[name] = {"type": "file", "size": len(data),
+                          "mtime": time.time()}
+        await self._save_dir(parent, dentries)
+
+    async def read_file(self, path: str) -> bytes:
+        path = self._norm(path)
+        parent, name, dentries = await self._parent_of(path)
+        ent = dentries.get(name)
+        if ent is None:
+            raise FsError(f"ENOENT: {path}")
+        if ent["type"] != "file":
+            raise FsError(f"EISDIR: {path}")
+        return await self.striper.read(self._file_oid(path))
+
+    async def unlink(self, path: str) -> None:
+        path = self._norm(path)
+        parent, name, dentries = await self._parent_of(path)
+        ent = dentries.get(name)
+        if ent is None:
+            raise FsError(f"ENOENT: {path}")
+        if ent["type"] == "dir":
+            children = await self._load_dir(path)
+            if children:
+                raise FsError(f"ENOTEMPTY: {path}")
+            try:
+                await self.meta.remove(self._dir_oid(path))
+            except RadosError:
+                pass
+        else:
+            await self.striper.remove(self._file_oid(path))
+        del dentries[name]
+        await self._save_dir(parent, dentries)
+
+    async def rename(self, src: str, dst: str) -> None:
+        src, dst = self._norm(src), self._norm(dst)
+        sparent, sname, sdentries = await self._parent_of(src)
+        ent = sdentries.get(sname)
+        if ent is None:
+            raise FsError(f"ENOENT: {src}")
+        if ent["type"] == "dir":
+            raise FsError("EINVAL: dir rename unsupported in mds-lite")
+        data = await self.striper.read(self._file_oid(src))
+        await self.write_file(dst, data)
+        await self.unlink(src)
+
+    async def walk(self, path: str = "/") -> Dict:
+        """Recursive tree dump (debugging/`ceph fs dump` role)."""
+        path = self._norm(path)
+        out: Dict = {}
+        for name in await self.listdir(path):
+            full = posixpath.join(path, name)
+            st = await self.stat(full)
+            if st["type"] == "dir":
+                out[name] = await self.walk(full)
+            else:
+                out[name] = st.get("size", 0)
+        return out

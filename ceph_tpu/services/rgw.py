@@ -1,0 +1,186 @@
+"""RGW-lite: S3-style object gateway over RADOS.
+
+Role-equivalent of the reference's RGW core request path (reference
+src/rgw/): an asyncio HTTP frontend (the beast frontend role) maps
+S3-shaped requests onto RADOS — buckets are index objects, object data is
+striped over RADOS objects (rgw_max_chunk_size-style chunking via the
+striper), and listings come from the bucket index, not pool scans, exactly
+the reference's bucket-index discipline.
+
+API subset: PUT /b (create bucket), GET / (list buckets), PUT /b/k,
+GET /b/k, DELETE /b/k, GET /b (list objects), HEAD /b/k.  Divergence by
+design: no S3 auth/multipart/versioning/multisite.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import unquote
+
+from ceph_tpu.rados.client import RadosError
+from ceph_tpu.rados.librados import IoCtx
+from ceph_tpu.rados.striper import RadosStriper
+
+BUCKETS_ROOT = ".rgw.buckets"  # registry of buckets
+
+
+class RgwService:
+    """Bucket/object operations (usable directly or via the HTTP frontend)."""
+
+    def __init__(self, ioctx: IoCtx, chunk_size: int = 1 << 20):
+        self.ioctx = ioctx
+        self.striper = RadosStriper(ioctx, object_size=chunk_size)
+
+    @staticmethod
+    def _index_oid(bucket: str) -> str:
+        return f".bucket.index.{bucket}"
+
+    async def _load_index(self, bucket: str) -> Optional[Dict[str, Dict]]:
+        try:
+            return json.loads(await self.ioctx.read(self._index_oid(bucket)))
+        except RadosError:
+            return None
+
+    async def _save_index(self, bucket: str, index: Dict[str, Dict]) -> None:
+        await self.ioctx.write_full(self._index_oid(bucket),
+                                    json.dumps(index).encode())
+
+    async def create_bucket(self, bucket: str) -> None:
+        if await self._load_index(bucket) is None:
+            await self._save_index(bucket, {})
+            buckets = await self.list_buckets()
+            if bucket not in buckets:
+                buckets.append(bucket)
+                await self.ioctx.write_full(
+                    BUCKETS_ROOT, json.dumps(sorted(buckets)).encode())
+
+    async def list_buckets(self) -> List[str]:
+        try:
+            return json.loads(await self.ioctx.read(BUCKETS_ROOT))
+        except RadosError:
+            return []
+
+    async def put_object(self, bucket: str, key: str, data: bytes) -> None:
+        index = await self._load_index(bucket)
+        if index is None:
+            raise RadosError(f"NoSuchBucket: {bucket}")
+        await self.striper.write(f"{bucket}/{key}", data)
+        index[key] = {"size": len(data)}
+        await self._save_index(bucket, index)
+
+    async def get_object(self, bucket: str, key: str) -> bytes:
+        index = await self._load_index(bucket)
+        if index is None:
+            raise RadosError(f"NoSuchBucket: {bucket}")
+        if key not in index:
+            raise RadosError(f"NoSuchKey: {key}")
+        return await self.striper.read(f"{bucket}/{key}")
+
+    async def delete_object(self, bucket: str, key: str) -> None:
+        index = await self._load_index(bucket)
+        if index is None:
+            raise RadosError(f"NoSuchBucket: {bucket}")
+        index.pop(key, None)
+        await self.striper.remove(f"{bucket}/{key}")
+        await self._save_index(bucket, index)
+
+    async def list_objects(self, bucket: str) -> Dict[str, Dict]:
+        index = await self._load_index(bucket)
+        if index is None:
+            raise RadosError(f"NoSuchBucket: {bucket}")
+        return index
+
+
+class RgwFrontend:
+    """Minimal HTTP frontend (beast role): newline-framed HTTP/1.1."""
+
+    def __init__(self, service: RgwService):
+        self.service = service
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.addr: Optional[Tuple[str, int]] = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = await asyncio.start_server(self._serve, host, port)
+        self.addr = self._server.sockets[0].getsockname()[:2]
+        return self.addr
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=1)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                request = await reader.readline()
+                if not request:
+                    return
+                try:
+                    method, path, _ = request.decode().split(" ", 2)
+                except ValueError:
+                    return
+                headers = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = line.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                length = int(headers.get("content-length", 0))
+                if length:
+                    body = await reader.readexactly(length)
+                status, payload = await self._route(method, unquote(path), body)
+                writer.write(
+                    f"HTTP/1.1 {status}\r\nContent-Length: {len(payload)}\r\n"
+                    f"Connection: keep-alive\r\n\r\n".encode() + payload)
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+    async def _route(self, method: str, path: str,
+                     body: bytes) -> Tuple[str, bytes]:
+        parts = [p for p in path.split("/") if p]
+        try:
+            if not parts:
+                if method == "GET":
+                    return "200 OK", json.dumps(
+                        await self.service.list_buckets()).encode()
+                return "405 Method Not Allowed", b""
+            bucket = parts[0]
+            if len(parts) == 1:
+                if method == "PUT":
+                    await self.service.create_bucket(bucket)
+                    return "200 OK", b""
+                if method == "GET":
+                    return "200 OK", json.dumps(
+                        await self.service.list_objects(bucket)).encode()
+                return "405 Method Not Allowed", b""
+            key = "/".join(parts[1:])
+            if method == "PUT":
+                await self.service.put_object(bucket, key, body)
+                return "200 OK", b""
+            if method == "GET":
+                return "200 OK", await self.service.get_object(bucket, key)
+            if method == "HEAD":
+                index = await self.service.list_objects(bucket)
+                if key in index:
+                    return "200 OK", b""
+                return "404 Not Found", b""
+            if method == "DELETE":
+                await self.service.delete_object(bucket, key)
+                return "204 No Content", b""
+            return "405 Method Not Allowed", b""
+        except RadosError as e:
+            msg = str(e)
+            if "NoSuch" in msg:
+                return "404 Not Found", msg.encode()
+            return "500 Internal Server Error", msg.encode()

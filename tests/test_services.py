@@ -1,0 +1,220 @@
+"""Service layer tests: rbd-lite block images, rgw-lite S3 gateway,
+mds-lite file namespace (reference src/librbd/, src/rgw/, src/mds/)."""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from ceph_tpu.rados.librados import Rados
+from ceph_tpu.rados.vstart import Cluster
+from ceph_tpu.services.mds import FileSystem, FsError
+from ceph_tpu.services.rbd import RBD, RbdError
+from ceph_tpu.services.rgw import RgwFrontend, RgwService
+
+CONF = {"osd_auto_repair": False}
+EC_PROFILE = {"plugin": "jerasure", "technique": "reed_sol_van",
+              "k": "2", "m": "1"}
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _cluster_io(n_osds=4, pool="svc"):
+    cluster = Cluster(n_osds=n_osds, conf=dict(CONF))
+    await cluster.start()
+    rados = await Rados(cluster.mon_addrs, CONF).connect()
+    await rados.pool_create(pool, profile=EC_PROFILE)
+    io = await rados.open_ioctx(pool)
+    return cluster, rados, io
+
+
+class TestRBD:
+    def test_image_lifecycle_and_sparse_io(self):
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                rbd = RBD(io)
+                img = await rbd.create("vm-disk", 8 << 20, order=18)  # 256K objs
+                assert await rbd.list() == ["vm-disk"]
+                with pytest.raises(RbdError):
+                    await rbd.create("vm-disk", 1 << 20)
+                # sparse read before any write: zeros
+                assert await img.read(0, 4096) == b"\x00" * 4096
+                # write spanning two objects
+                blob = os.urandom(300_000)
+                await img.write(200_000, blob)
+                assert await img.read(200_000, len(blob)) == blob
+                # unwritten gap before remains zeros
+                assert await img.read(0, 1000) == b"\x00" * 1000
+                st = await img.stat()
+                assert st["num_objs"] >= 2
+                # partial in-object overwrite (RMW path)
+                await img.write(200_100, b"PATCH")
+                got = await img.read(200_000, 200)
+                assert got[100:105] == b"PATCH"
+                with pytest.raises(RbdError):
+                    await img.write(8 << 20, b"x")  # beyond size
+                await rados.shutdown()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_resize_and_remove(self):
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                rbd = RBD(io)
+                img = await rbd.create("disk2", 2 << 20, order=18)
+                await img.write(0, os.urandom(1 << 20))
+                await img.resize(256 << 10)  # shrink: trims objects
+                st = await img.stat()
+                assert st["size"] == 256 << 10
+                await img.resize(4 << 20)  # grow
+                assert (await img.read(3 << 20, 100)) == b"\x00" * 100
+                await rbd.remove("disk2")
+                assert await rbd.list() == []
+                # data objects are gone too
+                assert not [o for o in await io.list_objects()
+                            if o.startswith("rbd_data.")]
+                await rados.shutdown()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+
+class TestRGW:
+    def test_service_bucket_object_ops(self):
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                svc = RgwService(io, chunk_size=64 * 1024)
+                await svc.create_bucket("photos")
+                assert await svc.list_buckets() == ["photos"]
+                data = os.urandom(200_000)  # multi-chunk
+                await svc.put_object("photos", "cat.jpg", data)
+                assert await svc.get_object("photos", "cat.jpg") == data
+                listing = await svc.list_objects("photos")
+                assert listing["cat.jpg"]["size"] == len(data)
+                await svc.delete_object("photos", "cat.jpg")
+                assert await svc.list_objects("photos") == {}
+                from ceph_tpu.rados.client import RadosError
+
+                with pytest.raises(RadosError, match="NoSuchBucket"):
+                    await svc.put_object("nope", "k", b"v")
+                await rados.shutdown()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_http_frontend(self):
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            frontend = None
+            try:
+                svc = RgwService(io, chunk_size=64 * 1024)
+                frontend = RgwFrontend(svc)
+                host, port = await frontend.start()
+
+                async def http(method, path, body=b""):
+                    reader, writer = await asyncio.open_connection(host, port)
+                    writer.write(
+                        f"{method} {path} HTTP/1.1\r\n"
+                        f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+                    await writer.drain()
+                    status_line = await reader.readline()
+                    headers = {}
+                    while True:
+                        line = await reader.readline()
+                        if line in (b"\r\n", b""):
+                            break
+                        k, _, v = line.decode().partition(":")
+                        headers[k.strip().lower()] = v.strip()
+                    payload = await reader.readexactly(
+                        int(headers.get("content-length", 0)))
+                    writer.close()
+                    return status_line.decode().split(" ", 1)[1].strip(), payload
+
+                assert (await http("PUT", "/bkt"))[0] == "200 OK"
+                data = os.urandom(150_000)
+                assert (await http("PUT", "/bkt/file.bin", data))[0] == "200 OK"
+                status, got = await http("GET", "/bkt/file.bin")
+                assert status == "200 OK" and got == data
+                status, listing = await http("GET", "/bkt")
+                assert json.loads(listing)["file.bin"]["size"] == len(data)
+                assert (await http("HEAD", "/bkt/file.bin"))[0] == "200 OK"
+                assert (await http("GET", "/bkt/missing"))[0] == "404 Not Found"
+                assert (await http("DELETE", "/bkt/file.bin"))[0] == "204 No Content"
+                assert (await http("HEAD", "/bkt/file.bin"))[0] == "404 Not Found"
+                status, buckets = await http("GET", "/")
+                assert json.loads(buckets) == ["bkt"]
+                await rados.shutdown()
+            finally:
+                if frontend:
+                    await frontend.stop()
+                await cluster.stop()
+
+        run(go())
+
+
+class TestMDS:
+    def test_namespace_tree(self):
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                fs = FileSystem(io)
+                await fs.mkfs()
+                await fs.mkdir("/home")
+                await fs.mkdir("/home/user")
+                await fs.write_file("/home/user/notes.txt", b"hello fs")
+                await fs.write_file("/home/user/big.bin", os.urandom(120_000))
+                assert await fs.listdir("/home/user") == ["big.bin",
+                                                          "notes.txt"]
+                assert await fs.read_file("/home/user/notes.txt") == b"hello fs"
+                st = await fs.stat("/home/user/big.bin")
+                assert st["type"] == "file" and st["size"] == 120_000
+                tree = await fs.walk("/")
+                assert tree == {"home": {"user": {"big.bin": 120_000,
+                                                  "notes.txt": 8}}}
+                # errors
+                with pytest.raises(FsError, match="EEXIST"):
+                    await fs.mkdir("/home")
+                with pytest.raises(FsError, match="ENOENT"):
+                    await fs.read_file("/home/user/none")
+                with pytest.raises(FsError, match="ENOTEMPTY"):
+                    await fs.unlink("/home/user")
+                # rename + unlink
+                await fs.rename("/home/user/notes.txt", "/home/moved.txt")
+                assert await fs.read_file("/home/moved.txt") == b"hello fs"
+                assert "notes.txt" not in await fs.listdir("/home/user")
+                await fs.unlink("/home/user/big.bin")
+                await fs.unlink("/home/user")
+                assert await fs.listdir("/home") == ["moved.txt"]
+                await rados.shutdown()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_data_survives_osd_kill(self):
+        async def go():
+            cluster, rados, io = await _cluster_io(n_osds=5)
+            try:
+                fs = FileSystem(io)
+                await fs.mkfs()
+                blob = os.urandom(80_000)
+                await fs.write_file("/f.bin", blob)
+                victim = next(iter(cluster.osds))
+                await cluster.kill_osd(victim)
+                await rados._client.mark_osd_down(victim)
+                assert await fs.read_file("/f.bin") == blob
+                await rados.shutdown()
+            finally:
+                await cluster.stop()
+
+        run(go())
