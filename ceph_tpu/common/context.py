@@ -16,6 +16,7 @@ from ceph_tpu.common.admin_socket import AdminSocket
 from ceph_tpu.common.config import Config
 from ceph_tpu.common.log import Log
 from ceph_tpu.common.perf_counters import PerfCountersCollection
+from ceph_tpu.common.tracing import Tracer
 from ceph_tpu.common.tracked_op import OpTracker
 
 VERSION = "1.0.0-tpu"
@@ -36,6 +37,8 @@ class Context:
         self.asok = AdminSocket(self)
         self.op_tracker = OpTracker()
         self.op_tracker.register_asok(self.asok)
+        self.tracer = Tracer()
+        self.tracer.register_asok(self.asok)
 
     def dout(self, subsys: str, level: int, message: str) -> None:
         self.log.dout(subsys, level, message)

@@ -1,0 +1,81 @@
+"""Distributed-trace spans (zipkin/blkin + jaeger wrapper role).
+
+Role-equivalent of the reference's ZTracer/jaeger integration (reference
+src/common/zipkin_trace.h, src/common/tracer.{h,cc}): ops carry a trace
+with named spans; pipeline stages open child spans ("start ec write",
+per-shard sub-writes, ECBackend.cc:2027,2113) and annotate events.  Spans
+land in a bounded per-daemon ring dumped via the admin socket
+(`dump_traces`) — the in-process stand-in for shipping to a collector.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import time
+from typing import Any, Deque, Dict, List, Optional
+
+_ids = itertools.count(1)
+
+
+class Span:
+    __slots__ = ("tracer", "trace_id", "span_id", "parent_id", "name",
+                 "start", "end", "events")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: int,
+                 parent_id: Optional[int]):
+        self.tracer = tracer
+        self.trace_id = trace_id
+        self.span_id = next(_ids)
+        self.parent_id = parent_id
+        self.name = name
+        self.start = time.time()
+        self.end: Optional[float] = None
+        self.events: List[Dict[str, Any]] = []
+
+    def event(self, name: str) -> None:
+        self.events.append({"time": time.time(), "event": name})
+
+    def child(self, name: str) -> "Span":
+        return self.tracer._span(name, self.trace_id, self.span_id)
+
+    def finish(self) -> None:
+        if self.end is None:
+            self.end = time.time()
+            self.tracer._record(self)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.finish()
+
+    def dump(self) -> Dict[str, Any]:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_id": self.parent_id, "name": self.name,
+                "start": self.start,
+                "duration": (self.end or time.time()) - self.start,
+                "events": list(self.events)}
+
+
+class Tracer:
+    def __init__(self, max_spans: int = 256, enabled: bool = True):
+        self.enabled = enabled
+        self._ring: Deque[Span] = collections.deque(maxlen=max_spans)
+
+    def new_trace(self, name: str) -> Span:
+        return self._span(name, next(_ids), None)
+
+    def _span(self, name: str, trace_id: int, parent_id: Optional[int]) -> Span:
+        return Span(self, name, trace_id, parent_id)
+
+    def _record(self, span: Span) -> None:
+        if self.enabled:
+            self._ring.append(span)
+
+    def dump(self) -> List[Dict[str, Any]]:
+        return [s.dump() for s in self._ring]
+
+    def register_asok(self, asok) -> None:
+        asok.register("dump_traces", lambda a: self.dump(),
+                      "recent trace spans")

@@ -1,0 +1,3 @@
+"""Management plane (reference src/mgr/, src/pybind/mgr/): the mgr daemon
+aggregates per-daemon perf reports and serves them to operators — the
+prometheus exporter module and the crash module in miniature."""

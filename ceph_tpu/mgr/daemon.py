@@ -1,0 +1,157 @@
+"""Mgr daemon: perf aggregation + prometheus exporter + crash registry.
+
+Role-equivalent of the reference's ceph-mgr (reference src/mgr/,
+src/pybind/mgr/prometheus, src/pybind/mgr/crash): daemons push MMgrReport
+(perf counter dumps + status) on their heartbeat cadence; the mgr keeps the
+latest report per daemon and serves:
+
+- ``/metrics`` — prometheus text format over HTTP (the prometheus module),
+  with per-daemon labels, counters, and longrunavg sum/count pairs;
+- crash reports — daemons post crash dumps (the ceph-crash agent +
+  mgr/crash module flow), listed/inspected via mgr commands.
+
+Daemons discover the mgr through the centralized config key ``mgr_addr``
+(set by whoever starts the mgr — vstart does), the role the mgrmap plays
+in the reference.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ceph_tpu.rados.messenger import Messenger, message
+
+
+@message(50)
+class MMgrReport:
+    """Daemon -> mgr perf/status push (reference MMgrReport.h)."""
+
+    name: str = ""
+    perf: Dict = None
+    status: Dict = None
+    stamp: float = 0.0
+
+
+@message(51)
+class MCrashReport:
+    name: str = ""
+    crash_id: str = ""
+    payload: Dict = None
+
+
+class MgrDaemon:
+    def __init__(self, conf: Optional[dict] = None):
+        self.conf = conf or {}
+        self.messenger = Messenger("mgr", self.conf, entity_type="mgr")
+        self.reports: Dict[str, MMgrReport] = {}
+        self.crashes: Dict[str, Dict] = {}
+        self.addr: Optional[Tuple[str, int]] = None
+        self._http: Optional[asyncio.AbstractServer] = None
+        self.http_addr: Optional[Tuple[str, int]] = None
+
+    async def start(self) -> Tuple[str, int]:
+        self.messenger.dispatcher = self._dispatch
+        self.addr = await self.messenger.bind()
+        self._http = await asyncio.start_server(self._serve_http,
+                                                "127.0.0.1", 0)
+        self.http_addr = self._http.sockets[0].getsockname()[:2]
+        return self.addr
+
+    async def stop(self) -> None:
+        if self._http:
+            self._http.close()
+            try:
+                await asyncio.wait_for(self._http.wait_closed(), timeout=1)
+            except asyncio.TimeoutError:
+                pass
+        await self.messenger.shutdown()
+
+    async def _dispatch(self, conn, msg) -> None:
+        if isinstance(msg, MMgrReport):
+            self.reports[msg.name] = msg
+        elif isinstance(msg, MCrashReport):
+            self.crashes[msg.crash_id] = {"name": msg.name, **(msg.payload or {})}
+
+    # -- queries -------------------------------------------------------------
+
+    def daemon_status(self) -> Dict[str, Any]:
+        now = time.time()
+        return {
+            name: {"age": now - r.stamp, "status": r.status}
+            for name, r in self.reports.items()
+        }
+
+    def crash_ls(self) -> List[str]:
+        return sorted(self.crashes)
+
+    def crash_info(self, crash_id: str) -> Optional[Dict]:
+        return self.crashes.get(crash_id)
+
+    # -- prometheus text format (mgr/prometheus role) ------------------------
+
+    def prometheus_text(self) -> str:
+        lines: List[str] = []
+        seen_help = set()
+        for name, report in sorted(self.reports.items()):
+            for set_name, counters in (report.perf or {}).items():
+                for cname, value in counters.items():
+                    metric = f"ceph_{set_name}_{cname}"
+                    if isinstance(value, dict) and "avgcount" in value:
+                        for suffix, v in (("_sum", value["sum"]),
+                                          ("_count", value["avgcount"])):
+                            m = metric + suffix
+                            if m not in seen_help:
+                                lines.append(f"# TYPE {m} counter")
+                                seen_help.add(m)
+                            lines.append(f'{m}{{daemon="{name}"}} {v}')
+                    elif isinstance(value, (int, float)):
+                        if metric not in seen_help:
+                            lines.append(f"# TYPE {metric} counter")
+                            seen_help.add(metric)
+                        lines.append(f'{metric}{{daemon="{name}"}} {value}')
+        lines.append(f"ceph_mgr_daemons_reporting {len(self.reports)}")
+        return "\n".join(lines) + "\n"
+
+    async def _serve_http(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        try:
+            request = await reader.readline()
+            while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+                pass
+            path = request.decode().split(" ")[1] if b" " in request else "/"
+            if path == "/metrics":
+                body = self.prometheus_text().encode()
+                status = "200 OK"
+            elif path == "/crash":
+                body = json.dumps(self.crash_ls()).encode()
+                status = "200 OK"
+            elif path.startswith("/crash/"):
+                info = self.crash_info(path[len("/crash/"):])
+                body = json.dumps(info).encode() if info else b"{}"
+                status = "200 OK" if info else "404 Not Found"
+            else:
+                body, status = b"ceph_tpu mgr\n", "200 OK"
+            writer.write(f"HTTP/1.1 {status}\r\nContent-Length: "
+                         f"{len(body)}\r\n\r\n".encode() + body)
+            await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+
+def crash_dump(exc: BaseException, name: str) -> Dict:
+    """Build a crash payload (ceph-crash agent's meta file role)."""
+    import traceback
+    import uuid
+
+    return {
+        "crash_id": f"{time.strftime('%Y-%m-%d_%H%M%S')}_{uuid.uuid4().hex[:8]}",
+        "timestamp": time.time(),
+        "entity_name": name,
+        "exception": repr(exc),
+        "backtrace": traceback.format_exception(exc),
+    }

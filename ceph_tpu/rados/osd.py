@@ -222,6 +222,7 @@ class OSD:
         return self.mons.current
 
     async def _ping_loop(self, interval: float) -> None:
+        ticks = 0
         while not self._stopped:
             try:
                 await self.messenger.send(
@@ -232,7 +233,31 @@ class OSD:
                 )
             except Exception:
                 self.mons.rotate()  # that mon looks dead
+            ticks += 1
+            if ticks % 3 == 0:
+                await self._report_to_mgr()
             await asyncio.sleep(interval)
+
+    async def _report_to_mgr(self) -> None:
+        """Push perf/status to the mgr (MMgrReport flow) when one is
+        configured (mgr_addr rides the centralized config)."""
+        raw = self.conf.get("mgr_addr", "")
+        if not raw:
+            return
+        try:
+            host, port = str(raw).rsplit(":", 1)
+            from ceph_tpu.mgr.daemon import MMgrReport
+
+            await asyncio.wait_for(
+                self.messenger.send(
+                    (host, int(port)),
+                    MMgrReport(name=f"osd.{self.osd_id}",
+                               perf=self.ctx.perf.dump(),
+                               status=self.status(), stamp=time.time()),
+                    peer_type="mgr"),
+                timeout=2.0)  # a stalled mgr must not starve mon pings
+        except Exception:
+            pass
 
     async def _heartbeat_loop(self, interval: float) -> None:
         """OSD<->OSD liveness (maybe_update_heartbeat_peers + heartbeat,
@@ -608,8 +633,11 @@ class OSD:
         if pool.pool_type != "ec":
             return await self._do_write_replicated(op, pool, pg, acting)
         codec = self._codec(pool)
+        span = self.ctx.tracer.new_trace("ec write")
+        span.event("start ec write")
         data = op.data
         if op.offset >= 0:
+            span.event("rmw read")
             # partial overwrite: READ-modify-write (try_state_to_reads,
             # ECBackend.cc:1915).  The extent cache pins recently decoded
             # objects so back-to-back partial writes skip the read.
@@ -659,7 +687,10 @@ class OSD:
                 sent += 1
             except Exception:
                 pass  # failed send counts as a missing ack, not a 5s stall
+        span.event(f"sub writes sent ({sent})")
         replies = await self._gather(tid, q, sent)
+        span.event("commit gathered")
+        span.finish()
         acks = 1 + sum(1 for r in replies if r.ok)  # self + remote
         if acks < pool.min_size:
             # the entry is logged but the write failed: a same-reqid resend
@@ -937,9 +968,17 @@ class OSD:
             got = self._store_read((op.pool_id, op.oid, shard))
             if got is not None and (best is None or got[1].version > best[0]):
                 best = (got[1].version, got[1].object_size)
+        # a local copy older than the log's committed version is stale
+        log = self._pglog(op.pool_id, pg)
+        latest_logged = max(
+            (e.object_version for e in log.entries if e.oid == op.oid),
+            default=0,
+        )
+        if best is not None and best[0] < latest_logged:
+            best = None
         if best is None:
-            # one sub-read to the first live acting peer (transfers one
-            # chunk, not k) carries the metadata we need
+            # sub-reads to every live acting peer (each transfers one
+            # chunk, not k) carry the metadata we need; newest wins
             tid = uuid.uuid4().hex
             q = self._collector(tid)
             sent = 0
@@ -954,10 +993,15 @@ class OSD:
                     sent += 1
                 except Exception:
                     continue
-                break
             for r in await self._gather(tid, q, sent, timeout=2.0):
-                if r.ok:
+                if r.ok and (best is None or r.version > best[0]):
                     best = (r.version, r.object_size)
+        if best is None:
+            # placement drift: hunt any shard cluster-wide (metadata only)
+            for _s, _c, version, osize in await self._fetch_all_shards(
+                    op.pool_id, op.oid):
+                if best is None or version > best[0]:
+                    best = (version, osize)
         if best is None:
             return MOSDOpReply(ok=False, error="object not found")
         return MOSDOpReply(ok=True, version=best[0],

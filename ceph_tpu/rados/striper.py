@@ -44,12 +44,21 @@ class RadosStriper:
         except (RadosError, KeyError, ValueError):
             pass
         n = max(1, (len(data) + self.object_size - 1) // self.object_size)
-        await asyncio.gather(*(
-            self.ioctx.write_full(
-                self._piece(soid, i),
-                data[i * self.object_size:(i + 1) * self.object_size])
-            for i in range(n)
-        ))
+        try:
+            await asyncio.gather(*(
+                self.ioctx.write_full(
+                    self._piece(soid, i),
+                    data[i * self.object_size:(i + 1) * self.object_size])
+                for i in range(n)
+            ))
+        except BaseException:
+            # a half-written object would orphan pieces the header never
+            # references; delete what this attempt created before failing
+            await asyncio.gather(*(
+                self.ioctx.remove(self._piece(soid, i))
+                for i in range(max(0, old_pieces), n)
+            ), return_exceptions=True)
+            raise
         header = {"object_size": self.object_size, "size": len(data),
                   "pieces": n}
         await self.ioctx.write_full(self._header(soid),

@@ -23,12 +23,15 @@ from ceph_tpu.rados.store import MemStore
 
 class Cluster:
     def __init__(self, n_osds: int = 5, conf: Optional[dict] = None,
-                 data_dir: Optional[str] = None, n_mons: int = 1):
+                 data_dir: Optional[str] = None, n_mons: int = 1,
+                 with_mgr: bool = False):
         self.conf = conf or {}
         self.n_osds = n_osds
         self.n_mons = n_mons
+        self.with_mgr = with_mgr
         self.data_dir = data_dir
         self.mons: List[Monitor] = []
+        self.mgr = None
         self.osds: Dict[int, OSD] = {}
         self._next_store = 0  # monotonic: store dirs never reused after kills
 
@@ -73,6 +76,13 @@ class Cluster:
             for mon in self.mons:
                 await mon.start()
             await self.wait_for_quorum()
+        if self.with_mgr:
+            from ceph_tpu.mgr.daemon import MgrDaemon
+
+            self.mgr = MgrDaemon(self.conf)
+            addr = await self.mgr.start()
+            # daemons discover the mgr through config (mgrmap role)
+            self.conf["mgr_addr"] = f"{addr[0]}:{addr[1]}"
         for i in range(self.n_osds):
             await self.add_osd()
 
@@ -119,6 +129,8 @@ class Cluster:
     async def stop(self) -> None:
         for osd in list(self.osds.values()):
             await osd.stop()
+        if self.mgr is not None:
+            await self.mgr.stop()
         for mon in self.mons:
             await mon.stop()
 

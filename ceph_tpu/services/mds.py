@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import posixpath
 import time
+import uuid
 from typing import Dict, List, Optional
 
 from ceph_tpu.rados.client import RadosError
@@ -48,8 +49,10 @@ class FileSystem:
         return f"dir:{path}"
 
     @staticmethod
-    def _file_oid(path: str) -> str:
-        return f"file:{path}"
+    def _file_oid(ino: str) -> str:
+        # data rides an IMMUTABLE inode id (the reference's <ino>.<frag>
+        # layout), so rename never touches data objects
+        return f"ino:{ino}"
 
     async def _load_dir(self, path: str) -> Optional[Dict[str, Dict]]:
         try:
@@ -108,9 +111,10 @@ class FileSystem:
         existing = dentries.get(name)
         if existing and existing["type"] == "dir":
             raise FsError(f"EISDIR: {path}")
-        await self.striper.write(self._file_oid(path), data)
+        ino = (existing or {}).get("ino") or uuid.uuid4().hex
+        await self.striper.write(self._file_oid(ino), data)
         dentries[name] = {"type": "file", "size": len(data),
-                          "mtime": time.time()}
+                          "mtime": time.time(), "ino": ino}
         await self._save_dir(parent, dentries)
 
     async def read_file(self, path: str) -> bytes:
@@ -121,7 +125,7 @@ class FileSystem:
             raise FsError(f"ENOENT: {path}")
         if ent["type"] != "file":
             raise FsError(f"EISDIR: {path}")
-        return await self.striper.read(self._file_oid(path))
+        return await self.striper.read(self._file_oid(ent["ino"]))
 
     async def unlink(self, path: str) -> None:
         path = self._norm(path)
@@ -138,11 +142,13 @@ class FileSystem:
             except RadosError:
                 pass
         else:
-            await self.striper.remove(self._file_oid(path))
+            await self.striper.remove(self._file_oid(ent["ino"]))
         del dentries[name]
         await self._save_dir(parent, dentries)
 
     async def rename(self, src: str, dst: str) -> None:
+        """Dentry-only move: the inode id stays, so no data transfer and
+        no window where the data exists twice."""
         src, dst = self._norm(src), self._norm(dst)
         sparent, sname, sdentries = await self._parent_of(src)
         ent = sdentries.get(sname)
@@ -150,9 +156,21 @@ class FileSystem:
             raise FsError(f"ENOENT: {src}")
         if ent["type"] == "dir":
             raise FsError("EINVAL: dir rename unsupported in mds-lite")
-        data = await self.striper.read(self._file_oid(src))
-        await self.write_file(dst, data)
-        await self.unlink(src)
+        dparent, dname, ddentries = await self._parent_of(dst)
+        if ddentries.get(dname, {}).get("type") == "dir":
+            raise FsError(f"EISDIR: {dst}")
+        if dparent == sparent:
+            sdentries[dname] = ent
+            del sdentries[sname]
+            await self._save_dir(sparent, sdentries)
+        else:
+            old_dst = ddentries.get(dname)
+            ddentries[dname] = ent
+            await self._save_dir(dparent, ddentries)
+            del sdentries[sname]
+            await self._save_dir(sparent, sdentries)
+            if old_dst and old_dst.get("ino"):
+                await self.striper.remove(self._file_oid(old_dst["ino"]))
 
     async def walk(self, path: str = "/") -> Dict:
         """Recursive tree dump (debugging/`ceph fs dump` role)."""

@@ -122,9 +122,10 @@ class Image:
             await self._save_header()
 
     async def resize(self, new_size: int) -> None:
-        old_objects = (self.size + self.object_size - 1) // self.object_size
+        old_size = self.size
+        old_objects = (old_size + self.object_size - 1) // self.object_size
         new_objects = (new_size + self.object_size - 1) // self.object_size
-        if new_objects < old_objects:
+        if new_size < old_size:
             objmap = set(self._hdr["object_map"])
             for idx in range(new_objects, old_objects):
                 if idx in objmap:
@@ -133,6 +134,17 @@ class Image:
                     except RadosError:
                         pass
                     objmap.discard(idx)
+            # truncate the partial boundary object so a later grow reads
+            # zeros, not pre-shrink data (reference librbd trims it)
+            tail = new_size % self.object_size
+            bidx = new_size // self.object_size
+            if tail and bidx in objmap:
+                try:
+                    blob = await self.ioctx.read(self._data_oid(bidx))
+                    await self.ioctx.write_full(self._data_oid(bidx),
+                                                blob[:tail])
+                except RadosError:
+                    pass
             self._hdr["object_map"] = sorted(objmap)
         self._hdr["size"] = new_size
         await self._save_header()

@@ -133,7 +133,13 @@ class RgwFrontend:
                     k, _, v = line.decode().partition(":")
                     headers[k.strip().lower()] = v.strip()
                 body = b""
-                length = int(headers.get("content-length", 0))
+                try:
+                    length = max(0, int(headers.get("content-length", 0)))
+                except ValueError:
+                    writer.write(b"HTTP/1.1 400 Bad Request\r\n"
+                                 b"Content-Length: 0\r\n\r\n")
+                    await writer.drain()
+                    return
                 if length:
                     body = await reader.readexactly(length)
                 status, payload = await self._route(method, unquote(path), body)

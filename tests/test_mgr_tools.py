@@ -1,0 +1,215 @@
+"""Mgr + tracing + offline tools tests (reference src/mgr/,
+src/pybind/mgr/prometheus, src/tools/)."""
+
+import asyncio
+import json
+import os
+import pickle
+
+from ceph_tpu.common.tracing import Tracer
+from ceph_tpu.rados.vstart import Cluster
+
+CONF = {"osd_auto_repair": False, "osd_heartbeat_interval": 0.1}
+EC_PROFILE = {"plugin": "jerasure", "technique": "reed_sol_van",
+              "k": "2", "m": "1"}
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestTracer:
+    def test_span_hierarchy_and_ring(self):
+        t = Tracer(max_spans=4)
+        with t.new_trace("op") as root:
+            root.event("start")
+            with root.child("sub") as sub:
+                sub.event("inner")
+            assert sub.trace_id == root.trace_id
+            assert sub.parent_id == root.span_id
+        spans = t.dump()
+        assert [s["name"] for s in spans] == ["sub", "op"]
+        assert spans[1]["events"][0]["event"] == "start"
+        for i in range(10):
+            t.new_trace(f"x{i}").finish()
+        assert len(t.dump()) == 4  # bounded ring
+
+
+class TestMgr:
+    def test_reports_prometheus_and_crash(self):
+        async def go():
+            cluster = Cluster(n_osds=3, conf=dict(CONF), with_mgr=True)
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("mp", profile=EC_PROFILE)
+                for i in range(5):
+                    await c.put(pool, f"o{i}", os.urandom(8_000))
+                # reports flow on the ping cadence (every 3rd ping)
+                mgr = cluster.mgr
+                for _ in range(100):
+                    if len(mgr.reports) >= 3:
+                        break
+                    await asyncio.sleep(0.05)
+                assert len(mgr.reports) >= 3, mgr.reports.keys()
+                status = mgr.daemon_status()
+                assert any(name.startswith("osd.") for name in status)
+                text = mgr.prometheus_text()
+                assert "ceph_osd_op_w" in text
+                assert 'daemon="osd.' in text
+                assert "ceph_osd_op_lat_sum" in text
+                # /metrics over HTTP
+                host, port = mgr.http_addr
+                reader, writer = await asyncio.open_connection(host, port)
+                writer.write(b"GET /metrics HTTP/1.1\r\n\r\n")
+                await writer.drain()
+                head = await reader.readline()
+                assert b"200" in head
+                body = await reader.read(-1)
+                assert b"ceph_mgr_daemons_reporting" in body
+                writer.close()
+                # crash flow
+                from ceph_tpu.mgr.daemon import MCrashReport, crash_dump
+
+                try:
+                    raise RuntimeError("daemon exploded")
+                except RuntimeError as e:
+                    payload = crash_dump(e, "osd.0")
+                some_osd = next(iter(cluster.osds.values()))
+                await some_osd.messenger.send(
+                    mgr.addr, MCrashReport(name="osd.0",
+                                           crash_id=payload["crash_id"],
+                                           payload=payload))
+                for _ in range(50):
+                    if mgr.crash_ls():
+                        break
+                    await asyncio.sleep(0.05)
+                assert mgr.crash_ls()
+                info = mgr.crash_info(mgr.crash_ls()[0])
+                assert "daemon exploded" in info["exception"]
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_osd_write_emits_trace_spans(self):
+        async def go():
+            cluster = Cluster(n_osds=3, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("tp", profile=EC_PROFILE)
+                await c.put(pool, "obj", b"traced" * 100)
+                spans = [s for o in cluster.osds.values()
+                         for s in o.ctx.tracer.dump()]
+                ec_spans = [s for s in spans if s["name"] == "ec write"]
+                assert ec_spans, "no ec write span recorded"
+                events = [e["event"] for e in ec_spans[0]["events"]]
+                assert "start ec write" in events
+                assert any(e.startswith("sub writes sent") for e in events)
+                assert "commit gathered" in events
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+
+class TestObjectstoreTool:
+    def test_list_info_export_import_remove(self, tmp_path):
+        from ceph_tpu.rados.bluestore import BlueStore
+        from ceph_tpu.rados.store import ShardMeta, Transaction
+        from ceph_tpu.tools import objectstore_tool as ost
+
+        path = str(tmp_path / "osd0")
+        store = BlueStore(path)
+        t = Transaction()
+        t.write((1, "obj", 0), b"DATA" * 100, ShardMeta(version=7,
+                                                        object_size=400))
+        store.queue_transaction(t)
+        store.setattr((1, "obj", 0), "hinfo", b"\x01")
+        store.omap_set((1, "obj", 0), {"k": b"v"})
+        store.close()
+        # list
+        assert ost.main(["--data-path", path, "--op", "list"]) == 0
+        # info
+        assert ost.main(["--data-path", path, "--op", "info", "--pool", "1",
+                         "--oid", "obj", "--shard", "0"]) == 0
+        # export -> remove -> import round-trip
+        blob = str(tmp_path / "exp.bin")
+        assert ost.main(["--data-path", path, "--op", "export", "--pool", "1",
+                         "--oid", "obj", "--shard", "0", "--file", blob]) == 0
+        assert ost.main(["--data-path", path, "--op", "remove", "--pool", "1",
+                         "--oid", "obj", "--shard", "0"]) == 0
+        s2 = BlueStore(path)
+        assert s2.read((1, "obj", 0)) is None
+        s2.close()
+        assert ost.main(["--data-path", path, "--op", "import",
+                         "--file", blob]) == 0
+        s3 = BlueStore(path)
+        data, meta = s3.read((1, "obj", 0))
+        assert data == b"DATA" * 100 and meta.version == 7
+        assert s3.getattr((1, "obj", 0), "hinfo") == b"\x01"
+        assert s3.omap_get((1, "obj", 0)) == {"k": b"v"}
+        s3.close()
+
+
+class TestMonstoreTool:
+    def test_dump_state_rewrite(self, tmp_path, capsys):
+        async def make_store():
+            cluster = Cluster(n_osds=2, conf=dict(CONF),
+                              data_dir=str(tmp_path))
+            await cluster.start()
+            c = await cluster.client()
+            await c.create_pool("p1", profile=EC_PROFILE)
+            await c.config_set("debug_osd", "3")
+            await c.stop()
+            await cluster.stop()
+
+        run(make_store())
+        from ceph_tpu.tools import monstore_tool as mst
+
+        path = str(tmp_path / "mon.0" / "store.db")
+        assert mst.main([path, "dump"]) == 0
+        dump = json.loads(capsys.readouterr().out)
+        assert dump["last_committed"] >= 2
+        assert mst.main([path, "get-state"]) == 0
+        state = json.loads(capsys.readouterr().out)
+        assert any(p["name"] == "p1" for p in state["pools"].values())
+        assert state["cluster_conf"].get("debug_osd") == "3"
+        # rewind one version
+        assert mst.main([path, "rewrite",
+                         str(dump["last_committed"] - 1)]) == 0
+        capsys.readouterr()
+        assert mst.main([path, "dump"]) == 0
+        dump2 = json.loads(capsys.readouterr().out)
+        assert dump2["last_committed"] == dump["last_committed"] - 1
+
+
+class TestDencoder:
+    def test_roundtrip_all_types(self, capsys):
+        from ceph_tpu.tools import dencoder
+
+        assert dencoder.main(["roundtrip"]) == 0
+        out = capsys.readouterr().out
+        assert "round-trip" in out
+
+    def test_corpus_write_check_and_regression(self, tmp_path, capsys):
+        from ceph_tpu.tools import dencoder
+
+        corpus = str(tmp_path / "corpus.json")
+        assert dencoder.main(["corpus", "--write", corpus]) == 0
+        capsys.readouterr()
+        assert dencoder.main(["corpus", "--check", corpus]) == 0
+        # simulate a wire regression: bump a recorded version beyond current
+        with open(corpus) as f:
+            snap = json.load(f)
+        snap["MOSDOp"]["version"] += 5
+        snap["MOSDOp"]["fields"].append("ghost_field")
+        with open(corpus, "w") as f:
+            json.dump(snap, f)
+        capsys.readouterr()
+        assert dencoder.main(["corpus", "--check", corpus]) == 1
+        out = capsys.readouterr().out
+        assert "VERSION REGRESSION" in out and "FIELDS REMOVED" in out
