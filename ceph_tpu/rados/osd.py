@@ -88,6 +88,13 @@ from ceph_tpu.rados.types import (
 
 PGMETA_PREFIX = "__pgmeta_"  # per-PG metadata object carrying the PG log
 
+# rollback slot: each shard keeps its PREVIOUS version at shard+PREV_SLOT
+# (the reference retains old extents as rollback info in the EC
+# transaction, ECBackend rollback_append/ECTransaction) so a failed
+# overwrite that lands on some shards cannot destroy the last complete
+# version of the object
+PREV_SLOT = 1 << 20
+
 
 class OSD:
     def __init__(
@@ -517,9 +524,9 @@ class OSD:
             txn.omap_rm(key, trimmed)
 
     def _list_pool_objects(self, pool_id: int):
-        """list_objects minus PG metadata objects."""
+        """list_objects minus PG metadata objects and rollback slots."""
         for oid, shard in self.store.list_objects(pool_id):
-            if not oid.startswith(PGMETA_PREFIX):
+            if not oid.startswith(PGMETA_PREFIX) and shard < PREV_SLOT:
                 yield oid, shard
 
     # -- extent cache (primary-side RMW pinning) ------------------------------
@@ -758,30 +765,37 @@ class OSD:
                 chunks[r.shard] = r.chunk
                 versions[r.shard] = r.version
                 sizes[r.shard] = r.object_size
-        # consistent-version cut: only shards at the newest version count
+        # consistent-version cut: only shards at ONE version may mix in a
+        # decode.  Prefer the newest version that is COMPLETE (>= k
+        # shards): a failed overwrite can leave a partial newer version
+        # that must not poison reads of the intact older one (the
+        # reference's last_complete / rollback semantics).
         newest = max(versions.values()) if versions else -1
-        chunks = {s: c for s, c in chunks.items() if versions[s] == newest}
-        if len(chunks) < k:
+        complete = {s: c for s, c in chunks.items() if versions[s] == newest}
+        if len(complete) < k:
             # shard hunt across ALL up OSDs: shards carry their id, so a
             # degraded read survives placement drift between failure and
             # recovery (send_all_remaining_reads + missing-set role)
             hunted = await self._fetch_all_shards(op.pool_id, op.oid)
-            if hunted:
-                hunted_newest = max(v for (_, _, v, _) in hunted)
-                if hunted_newest > newest:
-                    newest = hunted_newest
-                    chunks = {}
-                for shard, chunk, version, osize in hunted:
-                    if shard in exclude_shards:
-                        continue
-                    if version == newest and shard not in chunks:
-                        chunks[shard] = chunk
-                        sizes[shard] = osize
-                        versions[shard] = version
-            if not chunks:
+            by_version: Dict[int, Dict[int, Tuple[bytes, int]]] = {}
+            for s_, c_ in chunks.items():
+                by_version.setdefault(versions[s_], {})[s_] = (c_, sizes[s_])
+            for shard, chunk, version, osize in hunted:
+                if shard in exclude_shards:
+                    continue
+                by_version.setdefault(version, {}).setdefault(
+                    shard, (chunk, osize))
+            if not by_version:
                 return MOSDOpReply(ok=False, error="object not found")
-            if len(chunks) < k:
+            viable = [v for v, m in by_version.items() if len(m) >= k]
+            if not viable:
                 return MOSDOpReply(ok=False, error="cannot reconstruct: shards missing")
+            newest = max(viable)
+            chunks = {s_: cm[0] for s_, cm in by_version[newest].items()}
+            sizes = {s_: cm[1] for s_, cm in by_version[newest].items()}
+            versions = {s_: newest for s_ in chunks}
+        else:
+            chunks = complete
         object_size = sizes[max(sizes, key=lambda s: versions.get(s, 0))]
         arrays = {s: np.frombuffer(c, dtype=np.uint8) for s, c in chunks.items()}
         data = codec.decode_concat(arrays)
@@ -1022,9 +1036,10 @@ class OSD:
                          op="delete", oid=op.oid, prior_version=log.head,
                          reqid=op.reqid)
         entry_blob = entry.encode()
-        # local: drop any shard we hold; the delete is a PG log event
+        # local: drop any shard we hold (rollback slots included); the
+        # delete is a PG log event
         txn = Transaction()
-        for oid, shard in list(self._list_pool_objects(op.pool_id)):
+        for oid, shard in list(self.store.list_objects(op.pool_id)):
             if oid == op.oid:
                 txn.delete((op.pool_id, op.oid, shard))
         self._log_in_txn(txn, op.pool_id, pg, entry)
@@ -1061,6 +1076,11 @@ class OSD:
         entry: Optional[LogEntry] = None,
     ) -> None:
         txn = Transaction()
+        # retain the outgoing version in the rollback slot (same txn):
+        # reads fall back to it when a newer write never completed
+        old = self._store_read((pool_id, oid, shard))
+        if old is not None and old[1].version != version:
+            txn.write((pool_id, oid, shard + PREV_SLOT), old[0], old[1])
         txn.write(
             (pool_id, oid, shard),
             chunk,
@@ -1117,8 +1137,8 @@ class OSD:
 
     async def _handle_sub_delete(self, msg: MECSubDelete) -> None:
         txn = Transaction()
-        if msg.shard < 0:  # whole-object delete
-            for oid, shard in list(self._list_pool_objects(msg.pool_id)):
+        if msg.shard < 0:  # whole-object delete (rollback slots included)
+            for oid, shard in list(self.store.list_objects(msg.pool_id)):
                 if oid == msg.oid:
                     txn.delete((msg.pool_id, msg.oid, shard))
         else:
@@ -1140,11 +1160,13 @@ class OSD:
     async def _fetch_all_shards(self, pool_id: int, oid: str):
         """Ask every up OSD for any shard of oid it holds; include our own."""
         out = []
-        for oid2, shard in self._list_pool_objects(pool_id):
-            if oid2 == oid:
-                got = self._store_read((pool_id, oid, shard))
-                if got is not None:
-                    out.append((shard, got[0], got[1].version, got[1].object_size))
+        for oid2, shard in self.store.list_objects(pool_id):
+            if oid2 != oid or oid2.startswith(PGMETA_PREFIX):
+                continue
+            got = self._store_read((pool_id, oid, shard))
+            if got is not None:
+                out.append((shard % PREV_SLOT, got[0], got[1].version,
+                            got[1].object_size))
         peers = [
             o for o in self.osdmap.osds.values() if o.up and o.osd_id != self.osd_id
         ]
@@ -1166,11 +1188,13 @@ class OSD:
 
     async def _handle_fetch_shards(self, msg: MFetchShards) -> None:
         shards = []
-        for oid, shard in self._list_pool_objects(msg.pool_id):
-            if oid == msg.oid:
-                got = self._store_read((msg.pool_id, msg.oid, shard))
-                if got is not None:
-                    shards.append((shard, got[0], got[1].version, got[1].object_size))
+        for oid, shard in self.store.list_objects(msg.pool_id):
+            if oid != msg.oid or oid.startswith(PGMETA_PREFIX):
+                continue
+            got = self._store_read((msg.pool_id, msg.oid, shard))
+            if got is not None:
+                shards.append((shard % PREV_SLOT, got[0], got[1].version,
+                               got[1].object_size))
         try:
             await self.messenger.send(
                 tuple(msg.reply_to),
@@ -1569,12 +1593,33 @@ class OSD:
         for r in await self._gather(tid, q, sent):
             for oid, shard, version in r.entries:
                 holdings.setdefault(oid, set()).add((shard, r.osd_id, version))
+        k_need = (self._codec(pool).get_data_chunk_count()
+                  if pool.pool_type == "ec" else 1)
         pushed = 0
         for oid, locs in holdings.items():
             pg, acting = self._acting(pool, oid)
             if self._primary(pool, pg, acting) != self.osd_id:
                 continue
-            newest = max(v for (_, _, v) in locs)
+            # newest COMPLETE version wins; shards newer than it are
+            # uncommitted leftovers of a failed write -> roll them back
+            # (reference divergent-entry rollback, ECBackend rollback)
+            shards_at: Dict[int, Set[int]] = {}
+            for (shard, _, v) in locs:
+                shards_at.setdefault(v, set()).add(shard)
+            viable = [v for v, sh in shards_at.items() if len(sh) >= k_need]
+            if not viable:
+                continue
+            newest = max(viable)
+            for shard, osd, v in locs:
+                if v > newest:
+                    try:
+                        await self.messenger.send(
+                            self.osdmap.addr_of(osd),
+                            MECSubDelete(pool_id=pool.pool_id, pg=pg,
+                                         oid=oid, shard=shard, tid="",
+                                         reply_to=self.addr))
+                    except Exception:
+                        pass
             have = {shard: osd for shard, osd, v in locs if v == newest}
             missing = [
                 (shard, osd)

@@ -52,8 +52,17 @@ class RadosStriper:
                 for i in range(n)
             ))
         except BaseException:
-            # a half-written object would orphan pieces the header never
-            # references; delete what this attempt created before failing
+            # pieces 0..old_n may now hold MIXED generations: mark the
+            # object unreadable (size -1 tombstone) rather than let reads
+            # stitch old and new bytes together, then drop this attempt's
+            # orphan tail pieces
+            try:
+                await self.ioctx.write_full(
+                    self._header(soid),
+                    json.dumps({"object_size": self.object_size,
+                                "size": -1, "pieces": 0}).encode())
+            except Exception:
+                pass
             await asyncio.gather(*(
                 self.ioctx.remove(self._piece(soid, i))
                 for i in range(max(0, old_pieces), n)
@@ -71,6 +80,8 @@ class RadosStriper:
 
     async def read(self, soid: str) -> bytes:
         header = json.loads(await self.ioctx.read(self._header(soid)))
+        if header.get("size", 0) < 0:
+            raise RadosError(f"{soid}: torn by an interrupted write")
         pieces = await asyncio.gather(*(
             self.ioctx.read(self._piece(soid, i))
             for i in range(header["pieces"])

@@ -159,7 +159,10 @@ class FileSystem:
         dparent, dname, ddentries = await self._parent_of(dst)
         if ddentries.get(dname, {}).get("type") == "dir":
             raise FsError(f"EISDIR: {dst}")
+        if src == dst:
+            return
         if dparent == sparent:
+            old_dst = sdentries.get(dname)
             sdentries[dname] = ent
             del sdentries[sname]
             await self._save_dir(sparent, sdentries)
@@ -169,8 +172,9 @@ class FileSystem:
             await self._save_dir(dparent, ddentries)
             del sdentries[sname]
             await self._save_dir(sparent, sdentries)
-            if old_dst and old_dst.get("ino"):
-                await self.striper.remove(self._file_oid(old_dst["ino"]))
+        # an overwritten destination file's data objects are unreferenced
+        if old_dst and old_dst.get("ino") and old_dst["ino"] != ent.get("ino"):
+            await self.striper.remove(self._file_oid(old_dst["ino"]))
 
     async def walk(self, path: str = "/") -> Dict:
         """Recursive tree dump (debugging/`ceph fs dump` role)."""

@@ -58,6 +58,9 @@ def corpus_snapshot() -> dict:
 
 
 def cmd_corpus(write: str = "", check: str = "") -> int:
+    if not write and not check:
+        print("corpus requires --write FILE or --check FILE", file=sys.stderr)
+        return 2
     snap = corpus_snapshot()
     if write:
         with open(write, "w") as f:
