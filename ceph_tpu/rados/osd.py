@@ -1161,7 +1161,7 @@ class OSD:
         """Ask every up OSD for any shard of oid it holds; include our own."""
         out = []
         for oid2, shard in self.store.list_objects(pool_id):
-            if oid2 != oid or oid2.startswith(PGMETA_PREFIX):
+            if oid2 != oid:
                 continue
             got = self._store_read((pool_id, oid, shard))
             if got is not None:
@@ -1189,7 +1189,7 @@ class OSD:
     async def _handle_fetch_shards(self, msg: MFetchShards) -> None:
         shards = []
         for oid, shard in self.store.list_objects(msg.pool_id):
-            if oid != msg.oid or oid.startswith(PGMETA_PREFIX):
+            if oid != msg.oid:
                 continue
             got = self._store_read((msg.pool_id, msg.oid, shard))
             if got is not None:
@@ -1491,6 +1491,26 @@ class OSD:
                 r = by_shard.get(shard)
                 if r is None or not r.present or not r.crc_ok:
                     bad.append((shard, osd))
+            if not bad:
+                # the object is clean: its rollback slots are stale
+                # retention — trim them (the reference trims rollback
+                # extents once the interval is stable; scrub is our hook)
+                txn = Transaction()
+                for shard, osd in enumerate(acting):
+                    if osd == self.osd_id:
+                        txn.delete((pool.pool_id, oid, shard + PREV_SLOT))
+                    elif osd != CRUSH_ITEM_NONE:
+                        try:
+                            await self.messenger.send(
+                                self.osdmap.addr_of(osd),
+                                MECSubDelete(pool_id=pool.pool_id, pg=pg,
+                                             oid=oid,
+                                             shard=shard + PREV_SLOT,
+                                             tid="", reply_to=self.addr))
+                        except Exception:
+                            pass
+                if txn.deletes:
+                    self.store.queue_transaction(txn)
             if bad:
                 errors += len(bad)
                 # repair: reconstruct WITHOUT the damaged shards and

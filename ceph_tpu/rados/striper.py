@@ -60,7 +60,8 @@ class RadosStriper:
                 await self.ioctx.write_full(
                     self._header(soid),
                     json.dumps({"object_size": self.object_size,
-                                "size": -1, "pieces": 0}).encode())
+                                "size": -1,
+                                "pieces": max(old_pieces, n)}).encode())
             except Exception:
                 pass
             await asyncio.gather(*(
