@@ -16,6 +16,8 @@ from ceph_tpu.rados.messenger import Messenger
 from ceph_tpu.rados.monclient import MonTargets
 from ceph_tpu.rados.types import (
     MConfigGet,
+    MNotifyAck,
+    MWatchNotify,
     MConfigReply,
     MConfigSet,
     MCreatePool,
@@ -47,6 +49,8 @@ class RadosClient:
         # serialize mon RPCs: _mon_fut is a single slot, and concurrent ops
         # retrying through refresh_map() must not clobber each other
         self._mon_lock = asyncio.Lock()
+        # (pool, oid) -> callback(oid, payload) for watch/notify
+        self._watches: Dict = {}
 
     async def start(self) -> None:
         self.messenger.dispatcher = self._dispatch
@@ -56,6 +60,26 @@ class RadosClient:
         await self.messenger.shutdown()
 
     async def _dispatch(self, conn, msg) -> None:
+        if isinstance(msg, MWatchNotify):
+            # watch callback + ack back to the gathering primary
+            cb = self._watches.get((msg.pool_id, msg.oid))
+            if cb is not None:
+                try:
+                    res = cb(msg.oid, msg.payload)
+                    if asyncio.iscoroutine(res):
+                        await res
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()  # a broken callback must be loud
+            try:
+                await self.messenger.send(
+                    tuple(msg.reply_to),
+                    MNotifyAck(notify_id=msg.notify_id,
+                               watcher=self.messenger.addr))
+            except (ConnectionError, OSError):
+                pass
+            return
         if isinstance(msg, (MMapReply, MCreatePoolReply, MConfigReply)):
             # the mon echoes our per-RPC tid (like MOSDOp's reqid): a reply
             # landing after its RPC timed out has a stale tid and is dropped
@@ -203,6 +227,37 @@ class RadosClient:
 
     async def delete(self, pool_id: int, oid: str) -> None:
         await self._op(MOSDOp(op="delete", pool_id=pool_id, oid=oid))
+
+    async def watch(self, pool_id: int, oid: str, callback) -> None:
+        """Register a notify callback on oid (librados watch2 role).  After
+        a primary change, call watch() again — the reference's clients
+        re-register on watch errors the same way."""
+        import pickle as _pickle
+
+        self._watches[(pool_id, oid)] = callback
+        try:
+            await self._op(MOSDOp(op="watch", pool_id=pool_id, oid=oid,
+                                  data=_pickle.dumps(self.messenger.addr)))
+        except BaseException:
+            self._watches.pop((pool_id, oid), None)  # registration failed
+            raise
+
+    async def unwatch(self, pool_id: int, oid: str) -> None:
+        import pickle as _pickle
+
+        await self._op(MOSDOp(op="unwatch", pool_id=pool_id, oid=oid,
+                              data=_pickle.dumps(self.messenger.addr)))
+        self._watches.pop((pool_id, oid), None)  # only after the OSD agreed
+
+    async def notify(self, pool_id: int, oid: str,
+                     payload: bytes = b"") -> List:
+        """Notify watchers; returns the list of watcher addrs that acked
+        (librados notify2 reply role)."""
+        import pickle as _pickle
+
+        reply = await self._op(MOSDOp(op="notify", pool_id=pool_id, oid=oid,
+                                      data=payload))
+        return _pickle.loads(reply.data)
 
     async def list_objects(self, pool_id: int) -> List[str]:
         """Union of shard listings across up OSDs (any OSD can answer for

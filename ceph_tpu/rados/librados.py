@@ -83,6 +83,15 @@ class IoCtx:
                                          method=method), retries=3)
         return pickle.loads(reply.data)
 
+    async def watch(self, oid: str, callback) -> None:
+        await self._c.watch(self.pool_id, oid, callback)
+
+    async def unwatch(self, oid: str) -> None:
+        await self._c.unwatch(self.pool_id, oid)
+
+    async def notify(self, oid: str, payload: bytes = b"") -> List:
+        return await self._c.notify(self.pool_id, oid, payload)
+
     # -- async (aio_*) -------------------------------------------------------
 
     def aio_write(self, oid: str, data: bytes) -> Completion:

@@ -79,9 +79,11 @@ from ceph_tpu.rados.types import (
     MPGLogReq,
     MPing,
     MPushShard,
+    MNotifyAck,
     MScrubShard,
     MScrubShardReply,
     MSetXattrs,
+    MWatchNotify,
     OSDMap,
     PoolInfo,
 )
@@ -154,6 +156,9 @@ class OSD:
         # class-call results by reqid (non-idempotent methods must not
         # re-execute on a resend)
         self._call_results: Dict[str, MOSDOpReply] = {}
+        # (pool, oid) -> {watcher addr} (reference Watch registry; watchers
+        # re-register after a primary change, as librados clients do)
+        self._watchers: Dict[Tuple[int, str], Set[Tuple[str, int]]] = {}
         # primary-side cache of decoded objects pinned across RMW rounds
         # (src/osd/ExtentCache.{h,cc} role)
         self._extent_cache: "Dict[Tuple[int, str], Tuple[int, bytes]]" = {}
@@ -376,7 +381,8 @@ class OSD:
             # reading and backpressure reaches the sender
             pg_key = self._pg_key_of(msg)
             op_class = {"repair": CLASS_RECOVERY,
-                        "deep-scrub": CLASS_BEST_EFFORT}.get(
+                        "deep-scrub": CLASS_BEST_EFFORT,
+                        "notify": CLASS_BEST_EFFORT}.get(
                 msg.op, CLASS_CLIENT)
             await self.op_queue.enqueue(
                 pg_key, lambda: self._handle_client_op(conn, msg),
@@ -400,6 +406,10 @@ class OSD:
             await self._handle_pg_log_req(msg)
         elif isinstance(msg, MScrubShard):
             await self._handle_scrub_shard(msg)
+        elif isinstance(msg, MNotifyAck):
+            q = self._collectors.get(msg.notify_id)
+            if q is not None:
+                q.put_nowait(msg)
         elif isinstance(msg, MSetXattrs):
             key = (msg.pool_id, msg.oid, msg.shard)
             try:
@@ -595,6 +605,12 @@ class OSD:
                 reply = await self._do_call(op)
             elif op.op == "stat":
                 reply = await self._do_stat(op)
+            elif op.op == "watch":
+                reply = await self._do_watch(op)
+            elif op.op == "unwatch":
+                reply = await self._do_watch(op, remove=True)
+            elif op.op == "notify":
+                reply = await self._do_notify(op)
             elif op.op == "deep-scrub":
                 pool = self.osdmap.pools.get(op.pool_id)
                 if pool is None:
@@ -964,6 +980,68 @@ class OSD:
                 except Exception:
                     pass
         reply = MOSDOpReply(ok=True, data=pickle.dumps((ret, out)))
+        if op.reqid:
+            self._call_results[op.reqid] = reply
+            while len(self._call_results) > 512:
+                self._call_results.pop(next(iter(self._call_results)))
+        return reply
+
+    # -- watch/notify (reference src/osd/Watch.{h,cc}) -----------------------
+
+    async def _do_watch(self, op: MOSDOp, remove: bool = False) -> MOSDOpReply:
+        pool = self.osdmap.pools[op.pool_id]
+        pg, acting = self._acting(pool, op.oid)
+        if self._primary(pool, pg, acting) != self.osd_id:
+            return MOSDOpReply(ok=False, error="not primary")
+        watcher = tuple(pickle.loads(op.data))
+        key = (op.pool_id, op.oid)
+        if remove:
+            self._watchers.get(key, set()).discard(watcher)
+        else:
+            self._watchers.setdefault(key, set()).add(watcher)
+        return MOSDOpReply(ok=True)
+
+    async def _do_notify(self, op: MOSDOp) -> MOSDOpReply:
+        """Deliver to every watcher, gather acks (notify2 semantics:
+        the notifier's reply lists who acked).  Dedupes by reqid (a resend
+        must not re-fire side-effecting callbacks) and gathers acks on a
+        SIDE task so the PG shard worker is never blocked — a watcher
+        callback that itself issues ops to this shard would otherwise
+        deadlock against the gather."""
+        pool = self.osdmap.pools[op.pool_id]
+        pg, acting = self._acting(pool, op.oid)
+        if self._primary(pool, pg, acting) != self.osd_id:
+            return MOSDOpReply(ok=False, error="not primary")
+        if op.reqid and op.reqid in self._call_results:
+            return self._call_results[op.reqid]
+        watchers = list(self._watchers.get((op.pool_id, op.oid), ()))
+        notify_id = uuid.uuid4().hex
+        q = self._collector(notify_id)
+        sent = []
+        for watcher in watchers:
+            try:
+                await self.messenger.send(
+                    watcher,
+                    MWatchNotify(pool_id=op.pool_id, oid=op.oid,
+                                 notify_id=notify_id, payload=op.data,
+                                 reply_to=self.addr),
+                    peer_type="client")
+                sent.append(watcher)
+            except Exception:
+                # dead watcher: drop the registration (watch timeout role)
+                self._watchers.get((op.pool_id, op.oid), set()).discard(watcher)
+        acked = []
+        gather = asyncio.get_running_loop().create_task(
+            self._gather(notify_id, q, len(sent), timeout=2.0))
+        for r in await asyncio.shield(gather):
+            acked.append(tuple(r.watcher))
+        # a watcher that took the frame but never acked is hung or gone:
+        # prune it so it can't tax every future notify (watch expiry role);
+        # live clients re-register, as the reference's do on watch errors
+        for watcher in sent:
+            if tuple(watcher) not in acked:
+                self._watchers.get((op.pool_id, op.oid), set()).discard(watcher)
+        reply = MOSDOpReply(ok=True, data=pickle.dumps(acked))
         if op.reqid:
             self._call_results[op.reqid] = reply
             while len(self._call_results) > 512:

@@ -535,6 +535,26 @@ class MSetXattrs:
     xattrs: Dict[str, bytes] = field(default_factory=dict)
 
 
+# watch/notify (reference src/osd/Watch.{h,cc}, librados watch2/notify2)
+
+
+@message(47)
+class MWatchNotify:
+    """Primary -> watcher delivery of a notify (MWatchNotify.h role)."""
+
+    pool_id: int = 0
+    oid: str = ""
+    notify_id: str = ""
+    payload: bytes = b""
+    reply_to: Tuple[str, int] = ("", 0)  # primary gathering the acks
+
+
+@message(48)
+class MNotifyAck:
+    notify_id: str = ""
+    watcher: Tuple[str, int] = ("", 0)
+
+
 @message(45)
 class MScrubShardReply:
     tid: str = ""

@@ -1,0 +1,115 @@
+"""ECUtil stripe math + cumulative HashInfo + batched multi-stripe encode
+(reference src/osd/ECUtil.{h,cc})."""
+
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.registry import registry
+from ceph_tpu.rados.ecutil import HashInfo, StripeInfo, batched_encode
+
+
+def codec(k=4, m=2):
+    return registry.factory("jerasure", "", {
+        "plugin": "jerasure", "technique": "reed_sol_van",
+        "k": str(k), "m": str(m)})
+
+
+class TestStripeInfo:
+    def test_conversions(self):
+        s = StripeInfo(k=4, stripe_width=16384)  # chunk 4096
+        assert s.chunk_size == 4096
+        assert s.logical_to_prev_chunk_offset(0) == 0
+        assert s.logical_to_prev_chunk_offset(16384) == 4096
+        assert s.logical_to_prev_chunk_offset(20000) == 4096
+        assert s.logical_to_next_chunk_offset(1) == 4096
+        assert s.logical_to_next_chunk_offset(16384) == 4096
+        assert s.logical_to_prev_stripe_offset(20000) == 16384
+        assert s.logical_to_next_stripe_offset(16385) == 32768
+        assert s.aligned_logical_offset_to_chunk_offset(32768) == 8192
+        assert s.aligned_chunk_offset_to_logical_offset(8192) == 32768
+
+    def test_stripe_bounds_rmw_read_set(self):
+        s = StripeInfo(k=2, stripe_width=8192)
+        # a 100-byte overwrite at 5000 must read the whole first stripe
+        assert s.offset_len_to_stripe_bounds(5000, 100) == (0, 8192)
+        # spanning a boundary pulls in both stripes
+        assert s.offset_len_to_stripe_bounds(8000, 400) == (0, 16384)
+        assert s.offset_len_to_stripe_bounds(8192, 10) == (8192, 8192)
+
+    def test_pad(self):
+        s = StripeInfo(k=2, stripe_width=100)
+        assert len(s.pad_to_stripe(b"x" * 150)) == 200
+        assert len(s.pad_to_stripe(b"x" * 200)) == 200
+
+    def test_invalid_width_rejected(self):
+        with pytest.raises(AssertionError):
+            StripeInfo(k=3, stripe_width=100)
+
+
+class TestHashInfo:
+    def test_cumulative_append_chaining(self):
+        h = HashInfo(3)
+        a1 = {0: b"one", 1: b"two", 2: b"par"}
+        a2 = {0: b"ONE", 1: b"TWO", 2: b"PAR"}
+        h.append(a1)
+        h.append(a2)
+        assert h.total_chunk_size == 6
+        # chained crc == crc of the concatenation (the scrub comparison)
+        assert h.shard_crc(0) == zlib.crc32(b"oneONE")
+        assert h.shard_crc(2) == zlib.crc32(b"parPAR")
+
+    def test_encode_decode_xattr_roundtrip(self):
+        h = HashInfo(2)
+        h.append({0: b"abcd", 1: b"efgh"})
+        h2 = HashInfo.decode(h.encode())
+        assert h2.crcs == h.crcs
+        assert h2.total_chunk_size == 4
+
+    def test_unequal_append_rejected(self):
+        h = HashInfo(2)
+        with pytest.raises(AssertionError):
+            h.append({0: b"ab", 1: b"c"})
+
+
+class TestBatchedEncode:
+    def test_matches_per_stripe_loop(self):
+        c = codec(k=4, m=2)
+        s = StripeInfo(k=4, stripe_width=4 * 1024)
+        data = os.urandom(10_000)  # 3 stripes, padded
+        loop = batched_encode(c, s, data, queue=None)
+        from ceph_tpu.parallel.service import BatchingQueue
+
+        q = BatchingQueue(max_delay=0.001)
+        try:
+            batched = batched_encode(c, s, data, queue=q)
+            assert q.dispatches >= 1
+        finally:
+            q.close()
+        assert len(batched) == len(loop) == 6
+        for a, b in zip(batched, loop):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), \
+                "batched dispatch diverged from the per-stripe loop"
+
+    def test_single_stripe_short_circuit(self):
+        c = codec(k=2, m=1)
+        s = StripeInfo(k=2, stripe_width=1 << 16)
+        data = os.urandom(1000)
+        out = batched_encode(c, s, data, queue=None)
+        assert len(out) == 3
+
+    def test_one_dispatch_for_many_stripes(self):
+        from ceph_tpu.parallel.service import BatchingQueue
+
+        c = codec(k=4, m=2)
+        s = StripeInfo(k=4, stripe_width=4 * 4096)  # reference default unit
+        data = os.urandom(64 * 4 * 4096)  # 64 stripes
+        q = BatchingQueue(max_delay=0.001)
+        try:
+            batched_encode(c, s, data, queue=q)
+            # the reference would dispatch 64 times; we dispatch ONCE
+            assert q.dispatches == 1, q.dispatches
+        finally:
+            q.close()
