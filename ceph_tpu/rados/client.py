@@ -61,7 +61,16 @@ class RadosClient:
 
     async def _dispatch(self, conn, msg) -> None:
         if isinstance(msg, MWatchNotify):
-            # watch callback + ack back to the gathering primary
+            # ack FIRST (delivery receipt — divergence from notify2, which
+            # acks after processing): a slow callback must not look like a
+            # dead watcher and get pruned; then run the callback
+            try:
+                await self.messenger.send(
+                    tuple(msg.reply_to),
+                    MNotifyAck(notify_id=msg.notify_id,
+                               watcher=self.messenger.addr))
+            except (ConnectionError, OSError):
+                pass
             cb = self._watches.get((msg.pool_id, msg.oid))
             if cb is not None:
                 try:
@@ -72,13 +81,6 @@ class RadosClient:
                     import traceback
 
                     traceback.print_exc()  # a broken callback must be loud
-            try:
-                await self.messenger.send(
-                    tuple(msg.reply_to),
-                    MNotifyAck(notify_id=msg.notify_id,
-                               watcher=self.messenger.addr))
-            except (ConnectionError, OSError):
-                pass
             return
         if isinstance(msg, (MMapReply, MCreatePoolReply, MConfigReply)):
             # the mon echoes our per-RPC tid (like MOSDOp's reqid): a reply

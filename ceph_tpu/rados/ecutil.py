@@ -139,11 +139,15 @@ def batched_encode(codec, sinfo: StripeInfo, data: bytes,
     arr = np.frombuffer(padded, dtype=np.uint8).reshape(
         n_stripes, k, sinfo.chunk_size)
     if queue is not None:
-        from ceph_tpu.ec.matrices import matrix_to_bitmatrix
-
-        mat = codec.matrix  # Vandermonde coding matrix [m, k]
+        # the interface's bit seam drives ANY byte-layout codec through
+        # the one matmul kernel; packet-layout codecs (cauchy/liberation
+        # family) take the per-stripe path below
+        mbits = codec.bit_generator()
+        if mbits is None or getattr(codec, "bit_layout", "byte") != "byte":
+            queue = None
+    if queue is not None:
         w = getattr(codec, "w", 8)
-        mbits = matrix_to_bitmatrix(mat, w).astype(np.int8)
+        mbits = np.asarray(mbits).astype(np.int8)
         m = n - k
         # columns = stripes concatenated; one submit -> one device call
         flat = np.ascontiguousarray(

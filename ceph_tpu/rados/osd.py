@@ -380,9 +380,18 @@ class OSD:
             # classes; a full queue blocks HERE so the messenger stops
             # reading and backpressure reaches the sender
             pg_key = self._pg_key_of(msg)
+            if msg.op == "notify":
+                # notify gathers watcher acks for seconds and touches no
+                # PG state: it runs as its OWN task so neither the shard
+                # worker nor this serve loop blocks (a watcher callback
+                # may issue ops through both)
+                t = asyncio.get_running_loop().create_task(
+                    self._handle_client_op(conn, msg))
+                self.messenger._tasks.add(t)
+                t.add_done_callback(self.messenger._tasks.discard)
+                return
             op_class = {"repair": CLASS_RECOVERY,
-                        "deep-scrub": CLASS_BEST_EFFORT,
-                        "notify": CLASS_BEST_EFFORT}.get(
+                        "deep-scrub": CLASS_BEST_EFFORT}.get(
                 msg.op, CLASS_CLIENT)
             await self.op_queue.enqueue(
                 pg_key, lambda: self._handle_client_op(conn, msg),
@@ -1005,9 +1014,9 @@ class OSD:
         """Deliver to every watcher, gather acks (notify2 semantics:
         the notifier's reply lists who acked).  Dedupes by reqid (a resend
         must not re-fire side-effecting callbacks) and gathers acks on a
-        SIDE task so the PG shard worker is never blocked — a watcher
-        callback that itself issues ops to this shard would otherwise
-        deadlock against the gather."""
+        task of its own (see _dispatch) so the PG shard worker is never
+        blocked — a watcher callback that itself issues ops to this shard
+        would otherwise deadlock against the gather."""
         pool = self.osdmap.pools[op.pool_id]
         pg, acting = self._acting(pool, op.oid)
         if self._primary(pool, pg, acting) != self.osd_id:
@@ -1031,9 +1040,7 @@ class OSD:
                 # dead watcher: drop the registration (watch timeout role)
                 self._watchers.get((op.pool_id, op.oid), set()).discard(watcher)
         acked = []
-        gather = asyncio.get_running_loop().create_task(
-            self._gather(notify_id, q, len(sent), timeout=2.0))
-        for r in await asyncio.shield(gather):
+        for r in await self._gather(notify_id, q, len(sent), timeout=2.0):
             acked.append(tuple(r.watcher))
         # a watcher that took the frame but never acked is hung or gone:
         # prune it so it can't tax every future notify (watch expiry role);

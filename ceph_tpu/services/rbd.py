@@ -7,9 +7,17 @@ byte extents onto data objects; unwritten extents read as zeros (sparse).
 The object map (which blocks exist, reference object-map feature) lives in
 the header and makes sparse reads and fast remove possible without listing.
 
-Divergence by design: no snapshots/clones/mirroring/journaling — the
-extent-to-object data path, resize semantics, and object-map bookkeeping
-are the core being reproduced.
+Snapshots are per-object copy-on-write, the reference's clone-object model
+(``rbd_data.<id>.<n>@<snapid>``): snap_create records the object map; the
+first head write to an object after a snapshot copies the old content into
+the newest snapshot's clone before overwriting; reading a snapshot resolves
+each object to the OLDEST clone with snap id >= the requested snapshot,
+falling back to the head (never rewritten) or zeros (never existed) —
+librados self-managed-snap resolution in miniature.
+
+Divergence by design: no mirroring/journaling/layered clones of other
+images — the extent-to-object data path, object-map bookkeeping, and snap
+COW are the core being reproduced.
 """
 
 from __future__ import annotations
@@ -103,6 +111,8 @@ class Image:
             off_in = lofs % self.object_size
             n = min(self.object_size - off_in, len(data) - pos)
             piece = data[pos:pos + n]
+            if self._hdr.get("snaps"):
+                await self._cow_before_write(idx)
             if idx in objmap and (off_in or n < self.object_size):
                 # partial overwrite rides the OSD's RMW path
                 await self.ioctx.write(self._data_oid(idx), piece,
@@ -129,6 +139,8 @@ class Image:
             objmap = set(self._hdr["object_map"])
             for idx in range(new_objects, old_objects):
                 if idx in objmap:
+                    if self._hdr.get("snaps"):
+                        await self._cow_before_write(idx)  # snaps keep it
                     try:
                         await self.ioctx.remove(self._data_oid(idx))
                     except RadosError:
@@ -139,6 +151,8 @@ class Image:
             tail = new_size % self.object_size
             bidx = new_size // self.object_size
             if tail and bidx in objmap:
+                if self._hdr.get("snaps"):
+                    await self._cow_before_write(bidx)
                 try:
                     blob = await self.ioctx.read(self._data_oid(bidx))
                     await self.ioctx.write_full(self._data_oid(bidx),
@@ -152,7 +166,135 @@ class Image:
     async def stat(self) -> Dict:
         return {"size": self.size, "object_size": self.object_size,
                 "num_objs": len(self._hdr["object_map"]),
+                "snaps": sorted(self._hdr.get("snaps", {})),
                 "id": self._hdr["id"]}
+
+    # -- snapshots (per-object COW clones, librbd snapshot role) -------------
+
+    def _snaps(self) -> Dict[str, Dict]:
+        return self._hdr.setdefault("snaps", {})
+
+    def _clone_oid(self, index: int, snap_id: int) -> str:
+        return f"{self._data_oid(index)}@{snap_id}"
+
+    async def snap_create(self, name: str) -> None:
+        snaps = self._snaps()
+        if name in snaps:
+            raise RbdError(f"snapshot {name!r} exists")
+        snap_id = 1 + max((s["id"] for s in snaps.values()), default=0)
+        snaps[name] = {"id": snap_id, "size": self.size,
+                       "object_map": list(self._hdr["object_map"]),
+                       "cow": []}
+        await self._save_header()
+
+    def snap_list(self) -> List[str]:
+        return sorted(self._snaps())
+
+    async def _cow_before_write(self, idx: int) -> None:
+        """First head write to `idx` after a snapshot: preserve the old
+        content as the newest such snapshot's clone (librbd head->clone
+        copyup direction is inverted here — same effect, simpler)."""
+        newest = None
+        for snap in self._snaps().values():
+            if idx in snap["object_map"] and idx not in snap["cow"]:
+                if newest is None or snap["id"] > newest["id"]:
+                    newest = snap
+        if newest is None:
+            return
+        try:
+            old = await self.ioctx.read(self._data_oid(idx))
+        except RadosError:
+            old = b""
+        await self.ioctx.write_full(self._clone_oid(idx, newest["id"]), old)
+        newest["cow"].append(idx)
+        await self._save_header()
+
+    async def read_snap(self, name: str, offset: int, length: int) -> bytes:
+        """Read from a snapshot: per object, the OLDEST clone with
+        snap id >= this snapshot, else the (never rewritten) head, else
+        zeros."""
+        snap = self._snaps().get(name)
+        if snap is None:
+            raise RbdError(f"no snapshot {name!r}")
+        size = snap["size"]
+        if offset >= size:
+            return b""
+        length = min(length, size - offset)
+        clones_at = sorted(
+            (s["id"], set(s["cow"])) for s in self._snaps().values()
+            if s["id"] >= snap["id"]
+        )
+        spans = []
+        pos = offset
+        end = offset + length
+        while pos < end:
+            idx = pos // self.object_size
+            off_in = pos % self.object_size
+            n = min(self.object_size - off_in, end - pos)
+            spans.append((idx, off_in, n))
+            pos += n
+
+        async def resolve(idx: int):
+            if idx not in snap["object_map"]:
+                return None
+            for snap_id, cow in clones_at:
+                if idx in cow:
+                    return await self.ioctx.read(self._clone_oid(idx, snap_id))
+            try:
+                return await self.ioctx.read(self._data_oid(idx))
+            except RadosError:
+                return b""
+
+        blobs = await asyncio.gather(*(resolve(idx) for idx, _, _ in spans))
+        out = bytearray()
+        for (idx, off_in, n), blob in zip(spans, blobs):
+            if blob is None:
+                out.extend(b"\x00" * n)
+            else:
+                piece = blob[off_in:off_in + n]
+                out.extend(piece)
+                out.extend(b"\x00" * (n - len(piece)))
+        return bytes(out)
+
+    async def snap_remove(self, name: str) -> None:
+        """Remove a snapshot.  A clone the removed snap owns may still be
+        the resolution target of an OLDER snapshot (no intermediate clone
+        covers it): such clones are re-homed to the newest dependent older
+        snap instead of deleted (the reference's snap-trim keeps clones
+        while any snap in the set still needs them)."""
+        snaps = self._snaps()
+        snap = snaps.pop(name, None)
+        if snap is None:
+            raise RbdError(f"no snapshot {name!r}")
+        for idx in snap["cow"]:
+            # newest older snap that sees idx and has no clone of its own
+            # in [its id, removed id) — it was resolving through ours
+            dependent = None
+            for other in snaps.values():
+                if other["id"] >= snap["id"] or idx not in other["object_map"]:
+                    continue
+                covered = any(
+                    s2["id"] >= other["id"] and s2["id"] < snap["id"]
+                    and idx in s2["cow"]
+                    for s2 in snaps.values()
+                )
+                if not covered and (dependent is None
+                                    or other["id"] > dependent["id"]):
+                    dependent = other
+            src = self._clone_oid(idx, snap["id"])
+            if dependent is not None:
+                try:
+                    blob = await self.ioctx.read(src)
+                    await self.ioctx.write_full(
+                        self._clone_oid(idx, dependent["id"]), blob)
+                    dependent["cow"].append(idx)
+                except RadosError:
+                    pass
+            try:
+                await self.ioctx.remove(src)
+            except RadosError:
+                pass
+        await self._save_header()
 
 
 class RBD:
@@ -182,13 +324,22 @@ class RBD:
         return Image(self.ioctx, name, json.loads(raw))
 
     async def remove(self, name: str) -> None:
+        """Remove an image.  Refuses while snapshots exist (reference
+        librbd behavior: `rbd snap purge` first)."""
         img = await self.open(name)
+        if img._hdr.get("snaps"):
+            raise RbdError(f"image {name!r} has snapshots; purge them first")
         for idx in img._hdr["object_map"]:
             try:
                 await self.ioctx.remove(img._data_oid(idx))
             except RadosError:
                 pass
         await self.ioctx.remove(Image._header_oid(name))
+
+    async def snap_purge(self, name: str) -> None:
+        img = await self.open(name)
+        for snap in list(img.snap_list()):
+            await img.snap_remove(snap)
 
     async def list(self) -> List[str]:
         prefix = "rbd_header."

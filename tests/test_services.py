@@ -63,6 +63,71 @@ class TestRBD:
 
         run(go())
 
+    def test_snapshots_cow(self):
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                rbd = RBD(io)
+                img = await rbd.create("snapdisk", 4 << 20, order=18)
+                v1 = os.urandom(300_000)
+                await img.write(0, v1)
+                await img.snap_create("s1")
+                assert img.snap_list() == ["s1"]
+                # head write after the snapshot: COW preserves v1
+                v2 = os.urandom(300_000)
+                await img.write(0, v2)
+                assert await img.read(0, len(v2)) == v2
+                assert await img.read_snap("s1", 0, len(v1)) == v1
+                # a second snapshot captures v2; another head write
+                await img.snap_create("s2")
+                v3 = os.urandom(100)
+                await img.write(50, v3)
+                expect_v2 = bytearray(v2)
+                assert await img.read_snap("s2", 0, len(v2)) == bytes(expect_v2)
+                assert await img.read_snap("s1", 0, len(v1)) == v1
+                head = bytearray(v2)
+                head[50:150] = v3
+                assert await img.read(0, len(v2)) == bytes(head)
+                # regions never written read as zeros in snapshots too
+                assert await img.read_snap("s1", 1 << 20, 100) == b"\x00" * 100
+                # duplicate snap rejected; removal frees clones
+                with pytest.raises(RbdError):
+                    await img.snap_create("s1")
+                await img.snap_remove("s1")
+                assert img.snap_list() == ["s2"]
+                assert await img.read_snap("s2", 0, 100) == v2[:100]
+                with pytest.raises(RbdError):
+                    await img.read_snap("s1", 0, 10)
+                await rados.shutdown()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_middle_snapshot_removal_rehomes_clones(self):
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                rbd = RBD(io)
+                img = await rbd.create("mid", 2 << 20, order=18)
+                v1 = os.urandom(50_000)
+                await img.write(0, v1)
+                await img.snap_create("s0")     # sees v1
+                # no write between s0 and s1: s0 resolves through s1's clone
+                await img.snap_create("s1")     # also sees v1
+                v2 = os.urandom(50_000)
+                await img.write(0, v2)          # COW -> s1's clone holds v1
+                assert await img.read_snap("s0", 0, len(v1)) == v1
+                await img.snap_remove("s1")     # middle snap gone
+                # s0 must STILL see v1 (clone re-homed, not deleted)
+                assert await img.read_snap("s0", 0, len(v1)) == v1
+                assert await img.read(0, len(v2)) == v2
+                await rados.shutdown()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
     def test_resize_and_remove(self):
         async def go():
             cluster, rados, io = await _cluster_io()
