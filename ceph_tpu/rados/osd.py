@@ -154,8 +154,10 @@ class OSD:
         # not be acked as a dup
         self._failed_writes: Set[str] = set()
         # class-call results by reqid (non-idempotent methods must not
-        # re-execute on a resend)
+        # re-execute on a resend); notify resends arriving while the first
+        # execution is still gathering await its future
         self._call_results: Dict[str, MOSDOpReply] = {}
+        self._notify_inflight: Dict[str, asyncio.Future] = {}
         # (pool, oid) -> {watcher addr} (reference Watch registry; watchers
         # re-register after a primary change, as librados clients do)
         self._watchers: Dict[Tuple[int, str], Set[Tuple[str, int]]] = {}
@@ -1021,8 +1023,15 @@ class OSD:
         pg, acting = self._acting(pool, op.oid)
         if self._primary(pool, pg, acting) != self.osd_id:
             return MOSDOpReply(ok=False, error="not primary")
-        if op.reqid and op.reqid in self._call_results:
-            return self._call_results[op.reqid]
+        if op.reqid:
+            if op.reqid in self._call_results:
+                return self._call_results[op.reqid]
+            inflight = self._notify_inflight.get(op.reqid)
+            if inflight is not None:
+                # resend while the first execution still gathers: share it
+                return await asyncio.shield(inflight)
+            self._notify_inflight[op.reqid] = \
+                asyncio.get_running_loop().create_future()
         watchers = list(self._watchers.get((op.pool_id, op.oid), ()))
         notify_id = uuid.uuid4().hex
         q = self._collector(notify_id)
@@ -1053,6 +1062,9 @@ class OSD:
             self._call_results[op.reqid] = reply
             while len(self._call_results) > 512:
                 self._call_results.pop(next(iter(self._call_results)))
+            fut = self._notify_inflight.pop(op.reqid, None)
+            if fut is not None and not fut.done():
+                fut.set_result(reply)
         return reply
 
     async def _do_stat(self, op: MOSDOp) -> MOSDOpReply:

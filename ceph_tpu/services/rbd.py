@@ -111,8 +111,8 @@ class Image:
             off_in = lofs % self.object_size
             n = min(self.object_size - off_in, len(data) - pos)
             piece = data[pos:pos + n]
-            if self._hdr.get("snaps"):
-                await self._cow_before_write(idx)
+            if self._hdr.get("snaps") and await self._cow_before_write(idx):
+                dirty_map = True  # cow bookkeeping rides the same save
             if idx in objmap and (off_in or n < self.object_size):
                 # partial overwrite rides the OSD's RMW path
                 await self.ioctx.write(self._data_oid(idx), piece,
@@ -140,7 +140,7 @@ class Image:
             for idx in range(new_objects, old_objects):
                 if idx in objmap:
                     if self._hdr.get("snaps"):
-                        await self._cow_before_write(idx)  # snaps keep it
+                        await self._cow_before_write(idx)  # saved below
                     try:
                         await self.ioctx.remove(self._data_oid(idx))
                     except RadosError:
@@ -190,24 +190,28 @@ class Image:
     def snap_list(self) -> List[str]:
         return sorted(self._snaps())
 
-    async def _cow_before_write(self, idx: int) -> None:
+    async def _cow_before_write(self, idx: int) -> bool:
         """First head write to `idx` after a snapshot: preserve the old
-        content as the newest such snapshot's clone (librbd head->clone
-        copyup direction is inverted here — same effect, simpler)."""
+        content as a clone of the NEWEST snapshot covering it.  If that
+        newest snapshot already holds a clone, the head no longer carries
+        any snapshot's content — older snaps resolve through existing
+        clones (oldest-clone-wins), and copying the CURRENT head into an
+        older snap's slot would corrupt it.  Returns True if the header
+        needs saving (caller batches the save)."""
         newest = None
         for snap in self._snaps().values():
-            if idx in snap["object_map"] and idx not in snap["cow"]:
+            if idx in snap["object_map"]:
                 if newest is None or snap["id"] > newest["id"]:
                     newest = snap
-        if newest is None:
-            return
+        if newest is None or idx in newest["cow"]:
+            return False
         try:
             old = await self.ioctx.read(self._data_oid(idx))
         except RadosError:
             old = b""
         await self.ioctx.write_full(self._clone_oid(idx, newest["id"]), old)
         newest["cow"].append(idx)
-        await self._save_header()
+        return True
 
     async def read_snap(self, name: str, offset: int, length: int) -> bytes:
         """Read from a snapshot: per object, the OLDEST clone with

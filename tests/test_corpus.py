@@ -1,0 +1,41 @@
+"""Replay the committed non-regression corpus: every archived encoding must
+re-encode byte-identically with today's code (the reference's
+encode-decode-non-regression.sh + ceph-erasure-code-corpus mechanism,
+SURVEY.md §4 tier 3)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+CORPUS = os.path.join(os.path.dirname(__file__), "..", "corpus")
+
+
+def corpus_dirs():
+    if not os.path.isdir(CORPUS):
+        return []
+    return sorted(d for d in os.listdir(CORPUS)
+                  if os.path.isdir(os.path.join(CORPUS, d)))
+
+
+@pytest.mark.parametrize("profile_dir", corpus_dirs())
+def test_corpus_replays_byte_identical(profile_dir):
+    """--check re-encodes the archived content and memcmps every chunk,
+    then proves 1- and 2-erasure decode (non_regression.cc:252-284)."""
+    parts = profile_dir.split()
+    plugin = parts[0].split("=", 1)[1]
+    stripe_width = parts[1].split("=", 1)[1]
+    args = [sys.executable, "-m", "ceph_tpu.tools.non_regression",
+            "--check", "--base", CORPUS, "--plugin", plugin,
+            "--stripe-width", stripe_width]
+    for kv in parts[2:]:
+        args += ["-P", kv]
+    res = subprocess.run(args, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, \
+        f"corpus replay FAILED for {profile_dir}:\n{res.stdout}\n{res.stderr}"
+
+
+def test_corpus_is_populated():
+    dirs = corpus_dirs()
+    assert len(dirs) >= 6, f"committed corpus shrank: {dirs}"

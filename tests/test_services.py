@@ -104,6 +104,31 @@ class TestRBD:
 
         run(go())
 
+    def test_two_snaps_two_writes_oldest_snap_intact(self):
+        """Regression: a second head write after two snapshots must not
+        copy post-snapshot content into the older snap's clone slot."""
+
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                img = await RBD(io).create("tw", 1 << 20, order=18)
+                v1 = os.urandom(10_000)
+                await img.write(0, v1)
+                await img.snap_create("a")
+                await img.snap_create("b")
+                v2 = os.urandom(10_000)
+                await img.write(0, v2)  # COW -> clone@b = v1
+                v3 = os.urandom(10_000)
+                await img.write(0, v3)  # must NOT create clone@a = v2
+                assert await img.read_snap("a", 0, len(v1)) == v1
+                assert await img.read_snap("b", 0, len(v1)) == v1
+                assert await img.read(0, len(v3)) == v3
+                await rados.shutdown()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
     def test_middle_snapshot_removal_rehomes_clones(self):
         async def go():
             cluster, rados, io = await _cluster_io()
