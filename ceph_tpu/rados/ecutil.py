@@ -82,14 +82,22 @@ class StripeInfo:
 
 
 class HashInfo:
-    """Cumulative per-shard crc32s, chained across appends (ECUtil.h:101)."""
+    """Cumulative per-shard crc32s, chained across appends (ECUtil.h:101).
+
+    ``dirty`` marks a record whose non-self entries went stale: a partial
+    (spliced) overwrite rewrites one shard's bytes without the primary
+    holding every other shard's blob, so each shard refreshes only its OWN
+    crc entry.  Deep scrub always trusts the self entry; cross-shard
+    comparison is only meaningful while the record is clean (the reference
+    sidesteps this by disabling hinfo under ec_overwrites)."""
 
     XATTR_KEY = "hinfo_key"
 
     def __init__(self, n_shards: int, total_chunk_size: int = 0,
-                 crcs: Optional[List[int]] = None):
+                 crcs: Optional[List[int]] = None, dirty: bool = False):
         self.total_chunk_size = total_chunk_size
         self.crcs = list(crcs) if crcs else [0] * n_shards
+        self.dirty = dirty
 
     def append(self, shard_chunks: Dict[int, bytes]) -> None:
         """Fold the NEW chunk bytes of one append into each shard's
@@ -106,12 +114,40 @@ class HashInfo:
 
     def encode(self) -> bytes:
         return json.dumps({"total_chunk_size": self.total_chunk_size,
-                           "crcs": self.crcs}).encode()
+                           "crcs": self.crcs, "dirty": self.dirty}).encode()
 
     @classmethod
     def decode(cls, blob: bytes) -> "HashInfo":
         d = json.loads(blob)
-        return cls(len(d["crcs"]), d["total_chunk_size"], d["crcs"])
+        return cls(len(d["crcs"]), d["total_chunk_size"], d["crcs"],
+                   d.get("dirty", False))
+
+
+def concat_safe(codec) -> bool:
+    """True when the codec transforms a chunk as independent aligned
+    blocks, making the concatenation of per-stripe chunks itself a valid
+    chunk set: byte-layout codecs operate column-wise per byte, and the
+    packet (bitmatrix) family operates per w*packetsize block — both
+    divide chunks into units the per-stripe alignment already respects.
+    Only sub-chunk codecs (CLAY) derive intra-chunk structure from the
+    TOTAL chunk size and must be driven stripe by stripe."""
+    try:
+        return codec.get_sub_chunk_count() == 1
+    except Exception:
+        return False
+
+
+def _mapped_shard_list(codec, data_rows: np.ndarray,
+                       coding_rows: np.ndarray) -> List[np.ndarray]:
+    """Arrange logical data/coding rows into PHYSICAL shard order (the
+    chunk_index remap base.encode applies for 'mapping' profiles)."""
+    k = codec.get_data_chunk_count()
+    n = codec.get_chunk_count()
+    out: List[Optional[np.ndarray]] = [None] * n
+    for logical in range(n):
+        row = data_rows[logical] if logical < k else coding_rows[logical - k]
+        out[codec.chunk_index(logical)] = row
+    return out  # type: ignore[return-value]
 
 
 def batched_encode(codec, sinfo: StripeInfo, data: bytes,
@@ -121,29 +157,34 @@ def batched_encode(codec, sinfo: StripeInfo, data: bytes,
     The reference's ECUtil::encode calls the codec once per stripe_width
     piece (ECUtil.cc:123-160, the ▓ hot loop); on a TPU that per-stripe
     dispatch is the bottleneck, so here every stripe rides one batched
-    call — either through the codec directly (it vectorizes the batch) or
-    through the shared BatchingQueue when one is provided.  Returns one
-    concatenated per-shard buffer each, `[n_shards][n_stripes*chunk]`.
+    call: the buffer is re-interleaved into per-shard rows
+    (`[k, n_stripes*chunk]`) and the codec transforms all stripes at once
+    — through encode_chunks (one device dispatch for plugin=tpu) or
+    through the shared BatchingQueue when one is provided.  Byte-identical
+    to the per-stripe loop for every concat-safe codec (see concat_safe);
+    CLAY takes the per-stripe path.  Returns one concatenated per-shard
+    buffer each, `[n_shards][n_stripes*chunk]`, in physical shard order.
     """
     k = codec.get_data_chunk_count()
     n = codec.get_chunk_count()
     assert sinfo.k == k
     padded = sinfo.pad_to_stripe(data)
-    n_stripes = len(padded) // sinfo.stripe_width
+    n_stripes = max(1, len(padded) // sinfo.stripe_width)
     if n_stripes <= 1:
         # one stripe IS one dispatch: the codec encodes the whole buffer
         enc = codec.encode(set(range(n)), padded)
         return [np.asarray(enc[i]) for i in range(n)]
     # stripe-major: view as [n_stripes, stripe_width], carve each stripe's
-    # k chunks, batch ALL stripes through one queue dispatch per matrix
+    # k chunks, batch ALL stripes through one dispatch per matrix
     arr = np.frombuffer(padded, dtype=np.uint8).reshape(
         n_stripes, k, sinfo.chunk_size)
     if queue is not None:
         # the interface's bit seam drives ANY byte-layout codec through
         # the one matmul kernel; packet-layout codecs (cauchy/liberation
-        # family) take the per-stripe path below
+        # family) take the encode_chunks/per-stripe paths below
         mbits = codec.bit_generator()
-        if mbits is None or getattr(codec, "bit_layout", "byte") != "byte":
+        if (mbits is None or getattr(codec, "bit_layout", "byte") != "byte"
+                or codec.get_chunk_mapping()):
             queue = None
     if queue is not None:
         w = getattr(codec, "w", 8)
@@ -160,10 +201,48 @@ def batched_encode(codec, sinfo: StripeInfo, data: bytes,
         for j in range(m):
             out.append(parity[j].reshape(-1))
         return out
-    # no queue: per-stripe loop (the reference's shape, for comparison)
+    if concat_safe(codec):
+        # ONE encode_chunks call over all stripes: per-shard rows are the
+        # stored blob layout, so no post-hoc concatenation either
+        rows = np.ascontiguousarray(
+            arr.transpose(1, 0, 2).reshape(k, n_stripes * sinfo.chunk_size))
+        coding = np.asarray(codec.encode_chunks(rows))
+        return _mapped_shard_list(codec, rows, coding)
+    # sub-chunk codecs: per-stripe loop (the reference's shape)
     shards: List[List[np.ndarray]] = [[] for _ in range(n)]
     for s in range(n_stripes):
         enc = codec.encode(set(range(n)), arr[s].tobytes())
         for i in range(n):
             shards[i].append(np.asarray(enc[i]))
     return [np.concatenate(chunks) for chunks in shards]
+
+
+def decode_object(codec, sinfo: StripeInfo,
+                  blobs: Dict[int, np.ndarray], object_size: int) -> bytes:
+    """Reconstruct a striped object from per-shard blobs (each the
+    concatenation of that shard's per-stripe chunks) and de-interleave
+    back to logical byte order, trimmed to `object_size`.
+
+    Concat-safe codecs decode ALL stripes in one codec.decode call — the
+    multi-stripe mirror of the reference's per-stripe
+    objects_read_and_reconstruct loop (ECBackend.cc:2401, ECUtil.cc:25-60
+    decode) collapsed into a single device dispatch."""
+    k = codec.get_data_chunk_count()
+    cs = sinfo.chunk_size
+    arrays = {s: np.asarray(b, dtype=np.uint8) for s, b in blobs.items()}
+    blob_len = len(next(iter(arrays.values())))
+    n_stripes = max(1, blob_len // cs)
+    if n_stripes <= 1 or not concat_safe(codec):
+        if n_stripes <= 1:
+            return bytes(codec.decode_concat(arrays)[:object_size])
+        pieces: List[bytes] = []
+        for s in range(n_stripes):
+            stripe_chunks = {c: a[s * cs:(s + 1) * cs]
+                             for c, a in arrays.items()}
+            pieces.append(bytes(codec.decode_concat(stripe_chunks)))
+        return b"".join(pieces)[:object_size]
+    # decode_concat over whole blobs yields the data rows (shard-major);
+    # de-interleave [k, S, cs] -> stripe-major logical bytes
+    rows = np.frombuffer(codec.decode_concat(arrays), dtype=np.uint8)
+    rows = rows.reshape(k, n_stripes, cs).transpose(1, 0, 2)
+    return rows.reshape(-1)[:object_size].tobytes()

@@ -50,6 +50,7 @@ from ceph_tpu.rados.types import (
     MMonElection,
     MMonPaxos,
     MOSDFailure,
+    MOSDPGTemp,
     MOsdBoot,
     MPing,
     OSDMap,
@@ -234,9 +235,11 @@ class Monitor:
     async def _on_won_election(self) -> None:
         """Collect: bring the quorum to the newest committed state, then
         re-propose it so laggards (including us) sync."""
+        self.paxos.promise(self.logic.epoch)
         for peer in self.logic.quorum:
             if peer != self.rank:
-                await self._paxos_send(peer, {"op": "collect"})
+                await self._paxos_send(peer, {"op": "collect",
+                                              "epoch": self.logic.epoch})
         await asyncio.sleep(min(0.3, self._election_timeout))
         self._last_lease_renew = time.monotonic()
         # start every up OSD's liveness countdown at takeover: an OSD that
@@ -272,8 +275,11 @@ class Monitor:
             if self.logic.receive_ack(msg.rank, msg.epoch):
                 pass  # majority reached; _run_election declares victory
         elif msg.op == "victory":
-            if not self.logic.receive_victory(msg.rank, msg.epoch,
-                                              set(msg.quorum)):
+            if self.logic.receive_victory(msg.rank, msg.epoch,
+                                          set(msg.quorum)):
+                self.paxos.promise(msg.epoch)
+                self._last_lease_renew = time.monotonic()
+            else:
                 # stale victory from a restarted mon: wake it into a real
                 # election at the current epoch
                 await self._send_rank(
@@ -281,8 +287,6 @@ class Monitor:
                     MMonElection(op="propose", epoch=self.logic.epoch,
                                  rank=self.rank))
                 self._spawn_election()
-            else:
-                self._last_lease_renew = time.monotonic()
 
     # -- paxos transport -----------------------------------------------------
 
@@ -297,17 +301,37 @@ class Monitor:
         p = msg.payload
         op = p.get("op")
         if op == "collect":
+            # answering collect promises that leader's epoch (reference
+            # handle_collect records accepted_pn); stale collectors get
+            # state too but no promise — their begin will be nacked
+            self.paxos.promise(p.get("epoch", 0))
             await self._paxos_send(msg.rank, self.paxos.collect_state())
         elif op == "last":
             self.paxos.absorb_last(p)
         elif op == "begin":
-            await self.paxos.handle_begin(msg.rank, p["version"], p["value"])
+            await self.paxos.handle_begin(msg.rank, p["version"], p["value"],
+                                          p.get("epoch"))
         elif op == "accept":
-            if self.paxos.handle_accept(msg.rank, p["version"]):
+            if self.paxos.handle_accept(msg.rank, p["version"],
+                                        p.get("epoch")):
                 if self._accept_event:
                     self._accept_event.set()
+        elif op == "nack":
+            # a peon promised a newer epoch: we were deposed while
+            # believing we still led — abandon and re-elect at that epoch.
+            # (handle_nack ignores stale nacks from rounds we already
+            # superseded, so a delayed frame can't break a healthy quorum)
+            if self.paxos.handle_nack(p.get("epoch", 0)):
+                if self.logic.epoch < p["epoch"]:
+                    self.logic.epoch = p["epoch"]
+                self.logic.leader = None
+                self.logic.quorum = set()
+                if self._accept_event:
+                    self._accept_event.set()
+                self._spawn_election()
         elif op == "commit":
-            self.paxos.handle_commit(p["version"], p["value"])
+            self.paxos.handle_commit(p["version"], p["value"],
+                                     p.get("epoch"))
         elif op == "lease":
             self._last_lease_renew = time.monotonic()
             # lease implies this leader's quorum view
@@ -320,8 +344,10 @@ class Monitor:
         elif op == "sync_req":
             v, val = self.store.latest()
             if val is not None:
-                await self._paxos_send(msg.rank, {"op": "commit", "version": v,
-                                                  "value": val})
+                await self._paxos_send(msg.rank,
+                                       {"op": "commit", "version": v,
+                                        "value": val,
+                                        "epoch": self.logic.epoch})
 
     async def _commit_state(self) -> None:
         """Replicate the current state snapshot; blocks until majority."""
@@ -332,7 +358,8 @@ class Monitor:
             if len(quorum) < self.logic.majority:
                 raise NoQuorum("quorum too small")
             self._accept_event = asyncio.Event()
-            await self.paxos.propose(self._snapshot_state(), quorum)
+            await self.paxos.propose(self._snapshot_state(), quorum,
+                                     epoch=self.logic.epoch)
             need = len(quorum) // 2 + 1
             if len(self.paxos.accepts) < need:
                 try:
@@ -341,6 +368,8 @@ class Monitor:
                 except asyncio.TimeoutError:
                     self.paxos.proposing = None
                     raise NoQuorum("proposal not accepted by majority")
+            if self.paxos.nacked or self.paxos.proposing is None:
+                raise NoQuorum("deposed: a peer promised a newer epoch")
             await self.paxos.commit_current()
 
     # -- ticks: leases, liveness --------------------------------------------
@@ -406,7 +435,8 @@ class Monitor:
 
     # -- dispatch ------------------------------------------------------------
 
-    WRITE_TYPES = (MOsdBoot, MCreatePool, MMarkDown, MConfigSet, MOSDFailure)
+    WRITE_TYPES = (MOsdBoot, MCreatePool, MMarkDown, MConfigSet, MOSDFailure,
+                   MOSDPGTemp)
 
     async def _dispatch(self, conn, msg) -> None:
         if isinstance(msg, MMonElection):
@@ -575,6 +605,23 @@ class Monitor:
                 self._failure_reports.pop(msg.target_osd, None)
                 await self._commit_state()
             return MMapReply(osdmap=self.osdmap)
+        if isinstance(msg, MOSDPGTemp):
+            # primary-requested temporary acting set
+            # (OSDMonitor::prepare_pgtemp role)
+            key = (msg.pool_id, msg.pg)
+            changed = False
+            if msg.acting:
+                if (self.osdmap.pools.get(msg.pool_id) is not None
+                        and self.osdmap.pg_temp.get(key) != list(msg.acting)):
+                    self.osdmap.pg_temp[key] = list(msg.acting)
+                    changed = True
+            elif key in self.osdmap.pg_temp:
+                self.osdmap.pg_temp.pop(key)
+                changed = True
+            if changed:
+                self.osdmap.epoch += 1
+                await self._commit_state()
+            return MMapReply(osdmap=self.osdmap, tid=msg.tid)
         if isinstance(msg, MConfigSet):
             if not msg.remove:
                 # validate against the option schema before replicating
@@ -599,7 +646,8 @@ class Monitor:
             return MCreatePoolReply(tid=tid, ok=False, error=error)
         if isinstance(msg, MConfigSet):
             return MConfigReply(tid=tid, ok=False, error=error)
-        if isinstance(msg, (MMarkDown, MGetMap, MPing, MOSDFailure)):
+        if isinstance(msg, (MMarkDown, MGetMap, MPing, MOSDFailure,
+                            MOSDPGTemp)):
             return MMapReply(osdmap=self.osdmap, tid=tid)
         if isinstance(msg, MOsdBoot):
             return MBootReply(osd_id=-1, osdmap=self.osdmap, tid=tid)

@@ -36,6 +36,7 @@ import asyncio
 import pickle
 import time
 import uuid
+import zlib
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -45,9 +46,10 @@ from ceph_tpu.common.perf_counters import PerfCountersBuilder
 from ceph_tpu.ec.interface import ErasureCodeError
 from ceph_tpu.ec.registry import registry
 from ceph_tpu.rados.crush import CRUSH_ITEM_NONE
+from ceph_tpu.rados.ecutil import HashInfo, StripeInfo, batched_encode, decode_object
 from ceph_tpu.rados.messenger import Messenger
 from ceph_tpu.rados.monclient import MonTargets
-from ceph_tpu.rados.pglog import ZERO, LogEntry, PGLog
+from ceph_tpu.rados.pglog import ZERO, LogEntry, PGLog, pack_eversion
 from ceph_tpu.rados.scheduler import (
     CLASS_BEST_EFFORT,
     CLASS_CLIENT,
@@ -71,6 +73,7 @@ from ceph_tpu.rados.types import (
     MOSDFailure,
     MOSDOp,
     MOSDOpReply,
+    MOSDPGTemp,
     MOSDPing,
     MOsdBoot,
     MPGInfoReply,
@@ -114,6 +117,7 @@ class OSD:
         self.messenger = Messenger(f"osd.{osd_id}", self.conf, entity_type="osd")
         self.osdmap: Optional[OSDMap] = None
         self._codecs: Dict[int, object] = {}
+        self._sinfos: Dict[int, StripeInfo] = {}
         self._pending: Dict[str, asyncio.Future] = {}
         self._collectors: Dict[str, asyncio.Queue] = {}
         self._ping_task: Optional[asyncio.Task] = None
@@ -133,7 +137,12 @@ class OSD:
             .add_time_avg("op_lat", "client op latency")
             .add_u64_counter("subop_w", "EC sub-writes applied")
             .add_u64_counter("subop_r", "EC sub-reads served")
+            .add_u64_counter("rmw_partial", "stripe-scoped partial overwrites")
+            .add_u64_counter("rmw_read_bytes", "bytes read for stripe RMW")
+            .add_u64_counter("recovery_subchunk_bytes",
+                             "helper bytes read by sub-chunk repair")
             .add_u64_counter("recovery_push", "recovery shards pushed")
+            .add_u64_counter("recovery_errors", "repair rounds that errored")
             .add_u64_counter("op_queued", "ops entering the sharded queue")
             .add_u64_counter("op_dequeued", "ops drained")
             .add_time_avg("op_queue_lat", "op service time")
@@ -165,6 +174,10 @@ class OSD:
         # (src/osd/ExtentCache.{h,cc} role)
         self._extent_cache: "Dict[Tuple[int, str], Tuple[int, bytes]]" = {}
         self._extent_cache_max = 64
+        # acting set of the last DIFFERENT interval per PG: the set a
+        # pg_temp request points the mon at when a remapped PG needs
+        # backfill (the data lives with the prior interval's members)
+        self._prior_acting: Dict[Tuple[int, int], List[int]] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -319,18 +332,29 @@ class OSD:
 
     async def _mon_rpc(self, msg, reply_type):
         """Send to a mon and wait for the typed reply; rotate through the
-        monmap on timeout (peons forward writes to the leader)."""
-        key = f"monrpc-{reply_type.__name__}"
+        monmap on timeout (peons forward writes to the leader).  Pending
+        futures key on a per-RPC tid echoed by the mon, so two concurrent
+        RPCs expecting the same reply type cannot clobber each other;
+        type-name keying remains only for untagged messages."""
+        if hasattr(msg, "tid"):
+            if not msg.tid:
+                msg.tid = uuid.uuid4().hex
+            key = f"monrpc-{msg.tid}"
+        else:
+            key = f"monrpc-{reply_type.__name__}"
         last: Exception = TimeoutError("no mon reachable")
-        for _ in range(len(self.mons)):
-            fut: asyncio.Future = asyncio.get_running_loop().create_future()
-            self._pending[key] = fut
-            try:
-                await self.messenger.send(self.mons.current, msg)
-                return await asyncio.wait_for(fut, timeout=10)
-            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
-                last = e
-                self.mons.rotate()
+        try:
+            for _ in range(len(self.mons)):
+                fut: asyncio.Future = asyncio.get_running_loop().create_future()
+                self._pending[key] = fut
+                try:
+                    await self.messenger.send(self.mons.current, msg)
+                    return await asyncio.wait_for(fut, timeout=10)
+                except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                    last = e
+                    self.mons.rotate()
+        finally:
+            self._pending.pop(key, None)
         raise last
 
     # -- codecs --------------------------------------------------------------
@@ -345,7 +369,37 @@ class OSD:
             self._codecs[pool.pool_id] = codec
         return codec
 
+    def _sinfo(self, pool: PoolInfo) -> StripeInfo:
+        """Per-pool stripe geometry (the reference's sinfo, ECUtil.h:27):
+        stripe_unit rides the pool profile (or osd_ec_stripe_unit), rounded
+        up to the codec's per-chunk alignment so every stripe's chunks land
+        on codec block boundaries."""
+        si = self._sinfos.get(pool.pool_id)
+        if si is None:
+            codec = self._codec(pool)
+            k = codec.get_data_chunk_count()
+            if pool.stripe_width:
+                su = max(1, pool.stripe_width // k)
+            else:
+                su = int(pool.profile.get(
+                    "stripe_unit",
+                    self.conf.get("osd_ec_stripe_unit", 4096)) or 4096)
+            cs = codec.get_chunk_size(k * max(1, su))
+            si = StripeInfo(k, cs * k)
+            self._sinfos[pool.pool_id] = si
+        return si
+
     # -- dispatch ------------------------------------------------------------
+
+    def _resolve_monrpc(self, msg) -> None:
+        fut = None
+        tid = getattr(msg, "tid", "")
+        if tid:
+            fut = self._pending.pop(f"monrpc-{tid}", None)
+        if fut is None:
+            fut = self._pending.pop(f"monrpc-{type(msg).__name__}", None)
+        if fut and not fut.done():
+            fut.set_result(msg)
 
     async def _dispatch(self, conn, msg) -> None:
         if isinstance(msg, MMapReply):
@@ -359,13 +413,9 @@ class OSD:
                     self._on_map(m)
                 else:
                     asyncio.get_running_loop().create_task(self._fetch_full_map())
-            fut = self._pending.pop("monrpc-MMapReply", None)
-            if fut and not fut.done():
-                fut.set_result(msg)
+            self._resolve_monrpc(msg)
         elif isinstance(msg, MBootReply):
-            fut = self._pending.pop("monrpc-MBootReply", None)
-            if fut and not fut.done():
-                fut.set_result(msg)
+            self._resolve_monrpc(msg)
         elif isinstance(msg, MOSDPing):
             if msg.op == "ping":
                 try:
@@ -458,6 +508,18 @@ class OSD:
         old = self.osdmap
         if old is not None and osdmap.epoch <= old.epoch:
             return
+        if old is not None:
+            # remember the outgoing interval's acting set for PGs whose
+            # mapping changed (past_intervals role): it is the set a
+            # pg_temp request must name during backfill
+            for pool in osdmap.pools.values():
+                old_pool = old.pools.get(pool.pool_id)
+                if old_pool is None:
+                    continue
+                for pg in range(min(pool.pg_num, old_pool.pg_num)):
+                    oa = old.pg_to_acting(old_pool, pg)
+                    if oa != osdmap.pg_to_acting(pool, pg):
+                        self._prior_acting[(pool.pool_id, pg)] = oa
         self.osdmap = osdmap
         # primaryship may have moved: cached decodes can silently go stale
         # across an interval we didn't serve (ExtentCache is per-interval)
@@ -469,6 +531,7 @@ class OSD:
             old_pool = old.pools.get(pool_id) if old else None
             if new_pool is None or old_pool is None or new_pool.profile != old_pool.profile:
                 self._codecs.pop(pool_id, None)
+                self._sinfos.pop(pool_id, None)
         if self.conf.get("osd_auto_repair", True):
             if self._repair_task is None or self._repair_task.done():
                 self._repair_task = asyncio.get_running_loop().create_task(
@@ -526,7 +589,9 @@ class OSD:
                 omap = self.store.omap_get(self._pgmeta_key(pool_id, pg))
             except Exception:
                 pass
-            log = PGLog.load(omap) if omap else PGLog()
+            maxe = int(self.conf.get("osd_min_pg_log_entries", 500) or 500)
+            log = PGLog.load(omap, max_entries=maxe) if omap \
+                else PGLog(max_entries=maxe)
             self._pglogs[(pool_id, pg)] = log
         return log
 
@@ -664,57 +729,107 @@ class OSD:
             # client resend of an op we already applied (pg log dups role)
             return MOSDOpReply(ok=True)
         self._failed_writes.discard(op.reqid)
+        if op.offset >= 0 and not op.data:
+            return MOSDOpReply(ok=True)  # zero-length overwrite: no-op
         if pool.pool_type != "ec":
             return await self._do_write_replicated(op, pool, pg, acting)
         codec = self._codec(pool)
+        sinfo = self._sinfo(pool)
+        n = codec.get_chunk_count()
         span = self.ctx.tracer.new_trace("ec write")
         span.event("start ec write")
-        data = op.data
-        if op.offset >= 0:
-            span.event("rmw read")
-            # partial overwrite: READ-modify-write (try_state_to_reads,
-            # ECBackend.cc:1915).  The extent cache pins recently decoded
-            # objects so back-to-back partial writes skip the read.
-            cached = self._cache_get(op.pool_id, op.oid)
-            if cached is not None:
-                base = bytearray(cached[1])
-            else:
-                read = await self._do_read(
-                    MOSDOp(op="read", pool_id=op.pool_id, oid=op.oid))
-                base = bytearray(read.data) if read.ok else bytearray()
-            if len(base) < op.offset:
-                base.extend(b"\x00" * (op.offset - len(base)))
-            base[op.offset:op.offset + len(op.data)] = op.data
-            data = bytes(base)
-        n = codec.get_chunk_count()
-        encoded = codec.encode(set(range(n)), data)
-        version = time.time_ns()
         entry = LogEntry(version=log.next_version(self.osdmap.epoch),
                          op="write", oid=op.oid, prior_version=log.head,
-                         reqid=op.reqid, object_version=version)
+                         reqid=op.reqid)
+        version = pack_eversion(entry.version)
+        entry.object_version = version
+        # splice plan: chunk_off >= 0 means each shard splices `blobs[shard]`
+        # into its stored blob at chunk_off (per-stripe RMW, the reference's
+        # write plan ECTransaction.cc:37-95); -1 replaces the whole blob
+        data = op.data
+        chunk_off = -1
+        shard_size = 0
+        base_version = 0
+        object_size = len(op.data)
+        full_for_cache: Optional[bytes] = bytes(op.data)
+        if op.offset >= 0:
+            span.event("rmw read")
+            # partial overwrite: read ONLY the stripes the write touches
+            # (try_state_to_reads, ECBackend.cc:1915); the extent cache
+            # pins recently decoded objects so back-to-back partial writes
+            # skip the read entirely
+            s0, slen = sinfo.offset_len_to_stripe_bounds(
+                op.offset, len(op.data))
+            seg: Optional[bytes] = None
+            cached = self._cache_get(op.pool_id, op.oid)
+            if cached is not None:
+                base_version, cached_data = cached
+                base = bytearray(cached_data)
+                if len(base) < op.offset:
+                    base.extend(b"\x00" * (op.offset - len(base)))
+                base[op.offset:op.offset + len(op.data)] = op.data
+                full = bytes(base)
+                object_size = len(full)
+                seg = full[s0:s0 + slen]
+                full_for_cache = full
+            else:
+                got = await self._read_stripe_range(op, pool, codec, sinfo,
+                                                    s0, slen)
+                if got is not None:
+                    old_size, stripes, base_version = got
+                    seg_buf = bytearray(stripes)
+                    lo = op.offset - s0
+                    seg_buf[lo:lo + len(op.data)] = op.data
+                    seg = bytes(seg_buf)
+                    object_size = max(old_size, op.offset + len(op.data))
+                    full_for_cache = None  # only the segment is in hand
+                else:
+                    # degraded / inconsistent / absent: whole-object path
+                    read = await self._do_read(
+                        MOSDOp(op="read", pool_id=op.pool_id, oid=op.oid))
+                    base = bytearray(read.data) if read.ok else bytearray()
+                    if len(base) < op.offset:
+                        base.extend(b"\x00" * (op.offset - len(base)))
+                    base[op.offset:op.offset + len(op.data)] = op.data
+                    data = bytes(base)
+                    object_size = len(data)
+                    full_for_cache = data
+            if seg is not None:
+                self.perf.inc("rmw_partial")
+                data = seg
+                chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(s0)
+                shard_size = sinfo.logical_to_next_chunk_offset(object_size)
+        blobs = batched_encode(codec, sinfo, data)
+        span.event("encoded")
+        hinfo_blob = self._hinfo_for(pool, blobs) if chunk_off < 0 else b""
         entry_blob = entry.encode()
         tid = uuid.uuid4().hex
+        local_ok = 0
         remote: List[Tuple[int, int]] = []  # (shard, osd)
         for shard, osd in enumerate(acting):
             if osd == CRUSH_ITEM_NONE:
                 continue
-            chunk = bytes(encoded[shard])
             if osd == self.osd_id:
-                self._apply_shard_write(
-                    op.pool_id, op.oid, shard, chunk, version, len(data),
-                    pg=pg, entry=entry,
-                )
+                if self._apply_shard_write(
+                    op.pool_id, op.oid, shard, bytes(blobs[shard]), version,
+                    object_size, pg=pg, entry=entry, chunk_off=chunk_off,
+                    shard_size=shard_size, hinfo=hinfo_blob,
+                    prior_version=base_version,
+                ):
+                    local_ok += 1
             else:
                 remote.append((shard, osd))
         q = self._collector(tid)
         sent = 0
         for shard, osd in remote:
-            chunk = bytes(encoded[shard])
+            chunk = bytes(blobs[shard])
             msg = MECSubWrite(
                 pool_id=op.pool_id, pg=pg, oid=op.oid, shard=shard, chunk=chunk,
-                version=version, object_size=len(data),
+                version=version, object_size=object_size,
                 chunk_crc=shard_crc(chunk), tid=tid, reply_to=self.addr,
-                log_entry=entry_blob,
+                log_entry=entry_blob, chunk_off=chunk_off,
+                shard_size=shard_size, hinfo=hinfo_blob,
+                prior_version=base_version,
             )
             try:
                 await self.messenger.send(self.osdmap.addr_of(osd), msg)
@@ -725,16 +840,93 @@ class OSD:
         replies = await self._gather(tid, q, sent)
         span.event("commit gathered")
         span.finish()
-        acks = 1 + sum(1 for r in replies if r.ok)  # self + remote
+        acks = local_ok + sum(1 for r in replies if r.ok)  # self + remote
         if acks < pool.min_size:
             # the entry is logged but the write failed: a same-reqid resend
             # must re-execute rather than be deduped into false success
             self._mark_failed_write(op.reqid)
+            self._cache_drop(op.pool_id, op.oid)
             return MOSDOpReply(
                 ok=False, error=f"write acked by {acks} < min_size {pool.min_size}"
             )
-        self._cache_put(op.pool_id, op.oid, version, data)
+        if full_for_cache is not None:
+            self._cache_put(op.pool_id, op.oid, version, full_for_cache)
+        else:
+            self._cache_drop(op.pool_id, op.oid)
         return MOSDOpReply(ok=True)
+
+    async def _read_stripe_range(self, op: MOSDOp, pool: PoolInfo, codec,
+                                 sinfo: StripeInfo, s0: int,
+                                 slen: int) -> Optional[Tuple[int, bytes, int]]:
+        """Stripe-scoped RMW read: fetch only the affected chunk ranges of
+        a decodable shard set (extent sub-reads) and decode just those
+        stripes.  Returns (object_size, segment_bytes, base_version) — the
+        segment covers logical [s0, s0+slen) zero-padded past EOF — or None
+        when a consistent single-version cut isn't cheaply available
+        (degraded, mid-write drift, absent object) and the caller must take
+        the full reconstructing read."""
+        pg, acting = self._acting(pool, op.oid)
+        k = codec.get_data_chunk_count()
+        chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(s0)
+        clen = slen // k
+        available = {shard: osd for shard, osd in enumerate(acting)
+                     if osd != CRUSH_ITEM_NONE}
+        mapping = codec.get_chunk_mapping()
+        want = {mapping[i] if mapping else i for i in range(k)}
+        try:
+            plan = codec.minimum_to_decode(want, set(available))
+        except ErasureCodeError:
+            return None
+        tid = uuid.uuid4().hex
+        pieces: Dict[int, bytes] = {}
+        versions: Dict[int, int] = {}
+        sizes: Dict[int, int] = {}
+        remote = []
+        for shard in plan:
+            osd = available[shard]
+            if osd == self.osd_id:
+                got = self._store_read((op.pool_id, op.oid, shard))
+                if got is not None:
+                    blob, meta = got
+                    pieces[shard] = bytes(blob[chunk_off:chunk_off + clen])
+                    versions[shard] = meta.version
+                    sizes[shard] = meta.object_size
+            else:
+                remote.append((shard, osd))
+        q = self._collector(tid)
+        sent = 0
+        for shard, osd in remote:
+            try:
+                await self.messenger.send(
+                    self.osdmap.addr_of(osd),
+                    MECSubRead(pool_id=op.pool_id, pg=pg, oid=op.oid,
+                               shard=shard, tid=tid, reply_to=self.addr,
+                               extents=[(chunk_off, clen)]))
+                sent += 1
+            except Exception:
+                pass
+        for r in await self._gather(tid, q, sent):
+            if r.ok:
+                pieces[r.shard] = r.chunk
+                versions[r.shard] = r.version
+                sizes[r.shard] = r.object_size
+        if len(pieces) < k or len(set(versions.values())) != 1:
+            return None
+        # a cut older than the log's committed head is a stale survivor
+        log = self._pglog(op.pool_id, pg)
+        latest_logged = max(
+            (e.object_version for e in log.entries if e.oid == op.oid),
+            default=0)
+        if max(versions.values()) < latest_logged:
+            return None
+        arrays = {}
+        for shard, piece in pieces.items():
+            if len(piece) < clen:  # stripes past EOF read back as zeros
+                piece = piece + b"\x00" * (clen - len(piece))
+            self.perf.inc("rmw_read_bytes", len(piece))
+            arrays[shard] = np.frombuffer(piece, dtype=np.uint8)
+        seg = decode_object(codec, sinfo, arrays, slen)
+        return sizes[next(iter(sizes))], seg, max(versions.values())
 
     async def _do_read(self, op: MOSDOp,
                        exclude_shards: frozenset = frozenset()) -> MOSDOpReply:
@@ -825,9 +1017,9 @@ class OSD:
             chunks = complete
         object_size = sizes[max(sizes, key=lambda s: versions.get(s, 0))]
         arrays = {s: np.frombuffer(c, dtype=np.uint8) for s, c in chunks.items()}
-        data = codec.decode_concat(arrays)
-        self._cache_put(op.pool_id, op.oid, newest, bytes(data[:object_size]))
-        return MOSDOpReply(ok=True, data=data[:object_size], version=newest)
+        data = decode_object(codec, self._sinfo(pool), arrays, object_size)
+        self._cache_put(op.pool_id, op.oid, newest, data)
+        return MOSDOpReply(ok=True, data=data, version=newest)
 
     class _AllShards:
         """Replicated 'encoding': every position gets the full object."""
@@ -840,9 +1032,25 @@ class OSD:
 
     def _encode_for(self, pool: PoolInfo, data: bytes):
         if pool.pool_type == "ec":
-            codec = self._codec(pool)
-            return codec.encode(set(range(codec.get_chunk_count())), data)
+            return batched_encode(self._codec(pool), self._sinfo(pool), data)
         return OSD._AllShards(data)
+
+    def _cls_xattrs(self, pool_id: int, oid: str) -> Dict[str, bytes]:
+        """Object-class xattrs to ride a recovery push — minus the
+        hinfo_key record, which is per-shard state the push recomputes."""
+        attrs = dict(self.store.getattrs((pool_id, oid, 0)))
+        attrs.pop(HashInfo.XATTR_KEY, None)
+        return attrs
+
+    def _hinfo_for(self, pool: PoolInfo, encoded) -> bytes:
+        """HashInfo blob for a freshly (re-)encoded object (rides recovery
+        pushes so the hinfo_key xattr survives, ECUtil.h:101)."""
+        if pool.pool_type != "ec":
+            return b""
+        n = self._codec(pool).get_chunk_count()
+        h = HashInfo(n)
+        h.append({i: bytes(encoded[i]) for i in range(n)})
+        return h.encode()
 
     # -- ReplicatedBackend (reference src/osd/ReplicatedBackend.cc) ----------
 
@@ -865,10 +1073,11 @@ class OSD:
                 base.extend(b"\x00" * (op.offset - len(base)))
             base[op.offset:op.offset + len(op.data)] = op.data
             data = bytes(base)
-        version = time.time_ns()
         entry = LogEntry(version=log.next_version(self.osdmap.epoch),
                          op="write", oid=op.oid, prior_version=log.head,
-                         reqid=op.reqid, object_version=version)
+                         reqid=op.reqid)
+        version = pack_eversion(entry.version)
+        entry.object_version = version
         entry_blob = entry.encode()
         tid = uuid.uuid4().hex
         q = self._collector(tid)
@@ -1032,36 +1241,45 @@ class OSD:
                 return await asyncio.shield(inflight)
             self._notify_inflight[op.reqid] = \
                 asyncio.get_running_loop().create_future()
-        watchers = list(self._watchers.get((op.pool_id, op.oid), ()))
-        notify_id = uuid.uuid4().hex
-        q = self._collector(notify_id)
-        sent = []
-        for watcher in watchers:
-            try:
-                await self.messenger.send(
-                    watcher,
-                    MWatchNotify(pool_id=op.pool_id, oid=op.oid,
-                                 notify_id=notify_id, payload=op.data,
-                                 reply_to=self.addr),
-                    peer_type="client")
-                sent.append(watcher)
-            except Exception:
-                # dead watcher: drop the registration (watch timeout role)
-                self._watchers.get((op.pool_id, op.oid), set()).discard(watcher)
-        acked = []
-        for r in await self._gather(notify_id, q, len(sent), timeout=2.0):
-            acked.append(tuple(r.watcher))
-        # a watcher that took the frame but never acked is hung or gone:
-        # prune it so it can't tax every future notify (watch expiry role);
-        # live clients re-register, as the reference's do on watch errors
-        for watcher in sent:
-            if tuple(watcher) not in acked:
-                self._watchers.get((op.pool_id, op.oid), set()).discard(watcher)
-        reply = MOSDOpReply(ok=True, data=pickle.dumps(acked))
+        try:
+            watchers = list(self._watchers.get((op.pool_id, op.oid), ()))
+            notify_id = uuid.uuid4().hex
+            q = self._collector(notify_id)
+            sent = []
+            for watcher in watchers:
+                try:
+                    await self.messenger.send(
+                        watcher,
+                        MWatchNotify(pool_id=op.pool_id, oid=op.oid,
+                                     notify_id=notify_id, payload=op.data,
+                                     reply_to=self.addr),
+                        peer_type="client")
+                    sent.append(watcher)
+                except Exception:
+                    # dead watcher: drop the registration (watch timeout role)
+                    self._watchers.get((op.pool_id, op.oid), set()).discard(watcher)
+            acked = []
+            for r in await self._gather(notify_id, q, len(sent), timeout=2.0):
+                acked.append(tuple(r.watcher))
+            # a watcher that took the frame but never acked is hung or gone:
+            # prune it so it can't tax every future notify (watch expiry
+            # role); live clients re-register, as the reference's do on
+            # watch errors
+            for watcher in sent:
+                if tuple(watcher) not in acked:
+                    self._watchers.get((op.pool_id, op.oid), set()).discard(watcher)
+            reply = MOSDOpReply(ok=True, data=pickle.dumps(acked))
+        except Exception as e:
+            # the inflight future must resolve even on failure, or every
+            # same-reqid resend would hang on a forever-pending shield
+            reply = MOSDOpReply(ok=False, error=f"{type(e).__name__}: {e}")
         if op.reqid:
-            self._call_results[op.reqid] = reply
-            while len(self._call_results) > 512:
-                self._call_results.pop(next(iter(self._call_results)))
+            if reply.ok:
+                # only successes are replayable results; a failed notify
+                # resend should re-execute
+                self._call_results[op.reqid] = reply
+                while len(self._call_results) > 512:
+                    self._call_results.pop(next(iter(self._call_results)))
             fut = self._notify_inflight.pop(op.reqid, None)
             if fut is not None and not fut.done():
                 fut.set_result(reply)
@@ -1170,22 +1388,78 @@ class OSD:
     def _apply_shard_write(
         self, pool_id: int, oid: str, shard: int, chunk: bytes, version: int,
         object_size: int, pg: Optional[int] = None,
-        entry: Optional[LogEntry] = None,
-    ) -> None:
+        entry: Optional[LogEntry] = None, chunk_off: int = -1,
+        shard_size: int = 0, hinfo: bytes = b"", prior_version: int = 0,
+    ) -> bool:
         txn = Transaction()
         # retain the outgoing version in the rollback slot (same txn):
         # reads fall back to it when a newer write never completed
         old = self._store_read((pool_id, oid, shard))
         if old is not None and old[1].version != version:
             txn.write((pool_id, oid, shard + PREV_SLOT), old[0], old[1])
+        appended = False
+        if chunk_off >= 0:
+            # splice precondition: the delta only composes with the exact
+            # base the primary read.  A shard that missed an intermediate
+            # write (or lost the object) must refuse — splicing into a
+            # stale blob would stamp corrupt bytes as newest with a
+            # self-consistent crc.  Refusal costs one ack; recovery
+            # re-pushes the full blob.
+            if old is None or old[1].version != prior_version:
+                return False
+            # splice the chunk range into the stored blob (per-stripe RMW);
+            # zero-extension to shard_size covers gap stripes — zero chunks
+            # ARE the parity of zero stripes for these linear codes
+            base = bytearray(old[0])
+            appended = chunk_off == len(base)
+            want = max(shard_size, chunk_off + len(chunk), len(base))
+            if len(base) < want:
+                base.extend(b"\x00" * (want - len(base)))
+            base[chunk_off:chunk_off + len(chunk)] = chunk
+            blob = bytes(base)
+        else:
+            blob = chunk
         txn.write(
             (pool_id, oid, shard),
-            chunk,
-            ShardMeta(version=version, object_size=object_size, chunk_crc=shard_crc(chunk)),
+            blob,
+            ShardMeta(version=version, object_size=object_size, chunk_crc=shard_crc(blob)),
         )
         if entry is not None and pg is not None:
             self._log_in_txn(txn, pool_id, pg, entry)
         self.store.queue_transaction(txn)
+        self._update_hinfo(pool_id, oid, shard, blob, chunk, hinfo,
+                           chunk_off, appended)
+        return True
+
+    def _update_hinfo(self, pool_id: int, oid: str, shard: int, blob: bytes,
+                      chunk: bytes, hinfo: bytes, chunk_off: int,
+                      appended: bool) -> None:
+        """Maintain the hinfo_key xattr (cumulative shard crcs, reference
+        ECUtil.h:101-160): full writes store the primary-computed record;
+        splices refresh our OWN entry — by crc32 chaining when the splice
+        is a pure append (no re-read of prior bytes), by recompute
+        otherwise — and mark the record dirty (other entries went stale)."""
+        key = (pool_id, oid, shard)
+        try:
+            if chunk_off < 0:
+                if hinfo:
+                    self.store.setattr(key, HashInfo.XATTR_KEY, hinfo)
+                return
+            raw = self.store.getattr(key, HashInfo.XATTR_KEY)
+            if raw is None:
+                return
+            h = HashInfo.decode(raw)
+            if shard >= len(h.crcs):
+                return
+            if appended and h.total_chunk_size == chunk_off:
+                h.crcs[shard] = zlib.crc32(chunk, h.crcs[shard]) & 0xFFFFFFFF
+            else:
+                h.crcs[shard] = shard_crc(blob)
+            h.total_chunk_size = len(blob)
+            h.dirty = True
+            self.store.setattr(key, HashInfo.XATTR_KEY, h.encode())
+        except NotImplementedError:
+            pass  # store without xattr support
 
     async def _handle_sub_write(self, msg: MECSubWrite) -> None:
         ok = True
@@ -1196,13 +1470,16 @@ class OSD:
             if entry is not None:
                 entry.version = tuple(entry.version)
                 entry.prior_version = tuple(entry.prior_version)
-            self._apply_shard_write(
+            ok = self._apply_shard_write(
                 msg.pool_id, msg.oid, msg.shard, msg.chunk, msg.version,
                 msg.object_size, pg=msg.pg, entry=entry,
+                chunk_off=msg.chunk_off, shard_size=msg.shard_size,
+                hinfo=msg.hinfo, prior_version=msg.prior_version,
             )
             # another primary wrote this object: our cached decode is stale
             self._cache_drop(msg.pool_id, msg.oid)
-            self.perf.inc("subop_w")
+            if ok:
+                self.perf.inc("subop_w")
         try:
             await self.messenger.send(
                 tuple(msg.reply_to), MECSubWriteReply(tid=msg.tid, shard=msg.shard, ok=ok)
@@ -1223,8 +1500,15 @@ class OSD:
             reply = MECSubReadReply(tid=msg.tid, shard=msg.shard, ok=False)
         else:
             chunk, meta = got
+            if msg.extents:
+                # fragmented read: only the requested blob ranges cross the
+                # wire (stripe-RMW + sub-chunk recovery, ECMsgTypes.h:105)
+                payload = b"".join(bytes(chunk[o:o + l])
+                                   for o, l in msg.extents)
+            else:
+                payload = chunk
             reply = MECSubReadReply(
-                tid=msg.tid, shard=msg.shard, ok=True, chunk=chunk,
+                tid=msg.tid, shard=msg.shard, ok=True, chunk=payload,
                 version=meta.version, object_size=meta.object_size,
             )
         try:
@@ -1318,11 +1602,16 @@ class OSD:
         self.perf.inc("recovery_push")
         self._cache_drop(msg.pool_id, msg.oid)
         self._apply_shard_write(
-            msg.pool_id, msg.oid, msg.shard, msg.chunk, msg.version, msg.object_size
+            msg.pool_id, msg.oid, msg.shard, msg.chunk, msg.version,
+            msg.object_size, hinfo=msg.hinfo,
         )
         if msg.xattrs:
             try:
                 for name, value in msg.xattrs.items():
+                    if name == HashInfo.XATTR_KEY:
+                        # cls xattrs ride pushes, but a stale hinfo record
+                        # must never clobber the fresh one written above
+                        continue
                     self.store.setattr((msg.pool_id, msg.oid, 0), name, value)
             except NotImplementedError:
                 pass
@@ -1495,7 +1784,8 @@ class OSD:
                 push = MPushShard(
                     pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard_of_peer,
                     chunk=bytes(encoded[shard_of_peer]), version=read.version,
-                    object_size=len(read.data))
+                    object_size=len(read.data),
+                    hinfo=self._hinfo_for(pool, encoded))
                 try:
                     await self.messenger.send(self.osdmap.addr_of(osd), push)
                     pushed += 1
@@ -1511,25 +1801,71 @@ class OSD:
 
     # -- scrub (be_deep_scrub role, ECBackend.cc:2530) -----------------------
 
-    async def _handle_scrub_shard(self, msg: MScrubShard) -> None:
-        key = (msg.pool_id, msg.oid, msg.shard)
-        present = crc_ok = False
-        version = 0
+    def _scrub_shard_state(self, key: Tuple[int, str, int],
+                           shard: int) -> Tuple[bool, bool, int, int]:
+        """(present, crc_ok, version, crc) for a stored shard: the blob crc
+        must match BOTH the shard meta and the stored cumulative HashInfo
+        entry (hinfo_key, the reference's be_deep_scrub comparison against
+        hinfo's cumulative crc, ECBackend.cc:2530).  The raw crc rides the
+        reply so the primary can cross-check it against its own hinfo."""
         try:
             got = self.store.read(key)
-            if got is not None:
-                present = True
-                chunk, meta = got
-                version = meta.version
-                crc_ok = shard_crc(chunk) == meta.chunk_crc
         except IOError:
-            present, crc_ok = True, False  # unreadable = scrub error
+            return True, False, 0, 0  # unreadable = scrub error
+        if got is None:
+            return False, False, 0, 0
+        chunk, meta = got
+        crc = shard_crc(chunk)
+        ok = crc == meta.chunk_crc
+        try:
+            raw = self.store.getattr(key, HashInfo.XATTR_KEY)
+        except Exception:
+            raw = None
+        if raw:
+            try:
+                h = HashInfo.decode(raw)
+                if shard < len(h.crcs):
+                    ok = ok and h.crcs[shard] == crc \
+                        and h.total_chunk_size == len(chunk)
+            except Exception:
+                ok = False  # unparseable hinfo is itself a scrub error
+        return True, ok, meta.version, crc
+
+    def _hinfo_cross_check(self, pool_id: int, oid: str,
+                           acting: List[int]) -> Optional[HashInfo]:
+        """The primary's own stored hinfo record, IF it is clean (no splice
+        since the last full write): then its per-shard crcs are
+        authoritative for every shard and scrub replies can be compared
+        against it — catching a shard whose blob, meta crc AND own hinfo
+        entry were all consistently rewritten.  Dirty records (stale
+        non-self entries) opt out, which is exactly what HashInfo.dirty
+        exists to mark."""
+        for shard, osd in enumerate(acting):
+            if osd != self.osd_id:
+                continue
+            try:
+                raw = self.store.getattr((pool_id, oid, shard),
+                                         HashInfo.XATTR_KEY)
+            except Exception:
+                return None
+            if not raw:
+                return None
+            try:
+                h = HashInfo.decode(raw)
+            except Exception:
+                return None
+            return None if h.dirty else h
+        return None
+
+    async def _handle_scrub_shard(self, msg: MScrubShard) -> None:
+        key = (msg.pool_id, msg.oid, msg.shard)
+        present, crc_ok, version, crc = self._scrub_shard_state(key, msg.shard)
         try:
             await self.messenger.send(
                 tuple(msg.reply_to),
                 MScrubShardReply(tid=msg.tid, osd_id=self.osd_id,
                                  shard=msg.shard, present=present,
-                                 crc_ok=crc_ok, version=version))
+                                 crc_ok=crc_ok, version=version, crc=crc))
         except (ConnectionError, OSError):
             pass
 
@@ -1557,18 +1893,11 @@ class OSD:
                 if osd == CRUSH_ITEM_NONE:
                     continue
                 if osd == self.osd_id:
-                    key = (pool.pool_id, oid, shard)
-                    try:
-                        got = self.store.read(key)
-                        ok = (got is not None
-                              and shard_crc(got[0]) == got[1].chunk_crc)
-                        local_results.append(MScrubShardReply(
-                            osd_id=self.osd_id, shard=shard,
-                            present=got is not None, crc_ok=ok))
-                    except IOError:
-                        local_results.append(MScrubShardReply(
-                            osd_id=self.osd_id, shard=shard, present=True,
-                            crc_ok=False))
+                    present, ok, _v, crc = self._scrub_shard_state(
+                        (pool.pool_id, oid, shard), shard)
+                    local_results.append(MScrubShardReply(
+                        osd_id=self.osd_id, shard=shard,
+                        present=present, crc_ok=ok, crc=crc))
                 else:
                     try:
                         await self.messenger.send(
@@ -1582,12 +1911,28 @@ class OSD:
             replies = local_results + await self._gather(tid, q, sent,
                                                          timeout=2.0)
             by_shard = {r.shard: r for r in replies}
+            x_bad: List[Tuple[int, int]] = []
+            xcheck = (self._hinfo_cross_check(pool.pool_id, oid, acting)
+                      if pool.pool_type == "ec" else None)
             for shard, osd in enumerate(acting):
                 if osd == CRUSH_ITEM_NONE:
                     continue
                 r = by_shard.get(shard)
                 if r is None or not r.present or not r.crc_ok:
                     bad.append((shard, osd))
+                elif xcheck is not None and shard < len(xcheck.crcs) \
+                        and xcheck.crcs[shard] != r.crc:
+                    # cross-shard comparison against the primary's clean
+                    # hinfo record: self-consistent rewrites still fail
+                    x_bad.append((shard, osd))
+            if x_bad:
+                # a record disagreeing with more shards than the code can
+                # even repair is itself the suspect copy: fall back to
+                # self-checks only (the reference majority-votes hinfo)
+                codec = self._codec(pool)
+                m_count = codec.get_coding_chunk_count()
+                if len(x_bad) <= m_count:
+                    bad.extend(x_bad)
             if not bad:
                 # the object is clean: its rollback slots are stale
                 # retention — trim them (the reference trims rollback
@@ -1621,7 +1966,8 @@ class OSD:
                         push = MPushShard(
                             pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard,
                             chunk=bytes(encoded[shard]), version=read.version,
-                            object_size=len(read.data))
+                            object_size=len(read.data),
+                            hinfo=self._hinfo_for(pool, encoded))
                         if osd == self.osd_id:
                             self._apply_push(push)
                             repaired += 1
@@ -1675,17 +2021,35 @@ class OSD:
                 p, backfill = await self._log_recover_pg(pool, pg, acting)
                 pushed += p
                 need_backfill |= backfill
-            except Exception:
+                if backfill:
+                    await self._maybe_request_pg_temp(pool, pg, acting)
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                need_backfill = True  # peer unreachable: sweep catches up
+            except ErasureCodeError as e:
+                # a codec failure is NOT recoverable by retrying forever:
+                # surface it instead of spinning an eternal backfill loop
+                self.perf.inc("recovery_errors")
+                self.ctx.log.error(
+                    "osd", f"repair pg {pool.pool_id}.{pg} codec error: {e}")
+            except Exception as e:
+                self.perf.inc("recovery_errors")
+                self.ctx.log.error(
+                    "osd",
+                    f"repair pg {pool.pool_id}.{pg}: {type(e).__name__}: {e}")
                 need_backfill = True  # backfill sweep is the safety net
+        holdings = None
         if need_backfill or self.conf.get("osd_repair_full_sweep", True):
-            pushed += await self._backfill_pool(pool)
+            bf_pushed, holdings = await self._backfill_pool(pool)
+            pushed += bf_pushed
+        if self.osdmap.pg_temp:
+            await self._clear_done_pg_temps(pool, pushed, holdings)
         return pushed
 
-    async def _backfill_pool(self, pool: PoolInfo) -> int:
-        """Full-scan recovery (reference backfill): reconstruct and push
-        shards missing from the current acting sets of objects this OSD is
-        primary for.  Returns shards pushed."""
-        # union of shard listings from all up OSDs
+    async def _gather_holdings(self, pool: PoolInfo
+                               ) -> Dict[str, Set[Tuple[int, int, int]]]:
+        """oid -> {(shard, osd, version)} across all up OSDs.  Versions
+        matter — a stale shard sitting at its acting position is NOT
+        healthy redundancy."""
         tid = uuid.uuid4().hex
         peers = [
             o for o in self.osdmap.osds.values() if o.up and o.osd_id != self.osd_id
@@ -1700,8 +2064,6 @@ class OSD:
                 sent += 1
             except Exception:
                 pass
-        # oid -> {(shard, osd, version)}: versions matter — a stale shard
-        # sitting at its acting position is NOT healthy redundancy
         holdings: Dict[str, Set[Tuple[int, int, int]]] = {}
         for oid, shard in self._list_pool_objects(pool.pool_id):
             got = self._store_read((pool.pool_id, oid, shard))
@@ -1710,6 +2072,186 @@ class OSD:
         for r in await self._gather(tid, q, sent):
             for oid, shard, version in r.entries:
                 holdings.setdefault(oid, set()).add((shard, r.osd_id, version))
+        return holdings
+
+    def _raw_up(self, pool: PoolInfo, pg: int) -> List[int]:
+        """The CRUSH mapping filtered to up OSDs — backfill's TARGET set.
+        With pg_temp installed, `acting` (who serves IO) and this up-set
+        (who should eventually hold the data) differ; backfill pushes to
+        the up-set so the override can be cleared (reference up vs acting,
+        OSDMap.cc:2673)."""
+        return [
+            a if a != CRUSH_ITEM_NONE and self.osdmap.osds.get(a)
+            and self.osdmap.osds[a].up else CRUSH_ITEM_NONE
+            for a in self.osdmap.pg_to_raw(pool, pg)
+        ]
+
+    async def _maybe_request_pg_temp(self, pool: PoolInfo, pg: int,
+                                     acting: List[int]) -> None:
+        """This PG needs backfill: ask the mon to install the prior
+        interval's acting set as pg_temp so the data-holding members keep
+        serving IO meanwhile (reference MOSDPGTemp request flow,
+        OSDMonitor::prepare_pgtemp)."""
+        key = (pool.pool_id, pg)
+        if self.osdmap.pg_temp.get(key):
+            return  # an override is already serving
+        prior = self._prior_acting.get(key)
+        if not prior or list(prior) == list(acting):
+            return
+        live = [a for a in prior
+                if a != CRUSH_ITEM_NONE and self.osdmap.osds.get(a)
+                and self.osdmap.osds[a].up]
+        if len(live) < pool.min_size:
+            return  # the prior set cannot serve either
+        try:
+            await self._mon_rpc(
+                MOSDPGTemp(pool_id=pool.pool_id, pg=pg, acting=list(prior),
+                           from_osd=self.osd_id), MMapReply)
+        except Exception:
+            pass
+
+    async def _clear_done_pg_temps(
+        self, pool: PoolInfo, pushed: int,
+        holdings: Optional[Dict[str, Set[Tuple[int, int, int]]]] = None,
+    ) -> None:
+        """Backfill-completion check for PGs we serve under pg_temp: once
+        every object's newest version covers all up-set positions, ask the
+        mon to drop the override so the map returns to the CRUSH mapping.
+        Reuses the caller's holdings when no pushes were issued this round
+        (nothing moved, so they're still current)."""
+        temp_pgs = [pg for (pid, pg) in self.osdmap.pg_temp
+                    if pid == pool.pool_id]
+        temp_pgs = [pg for pg in temp_pgs
+                    if self._primary(pool, pg,
+                                     self.osdmap.pg_to_acting(pool, pg))
+                    == self.osd_id]
+        if not temp_pgs:
+            return
+        if pushed or holdings is None:
+            if pushed:
+                await asyncio.sleep(0.3)  # fire-and-forget pushes land
+            holdings = await self._gather_holdings(pool)
+        k_need = (self._codec(pool).get_data_chunk_count()
+                  if pool.pool_type == "ec" else 1)
+        incomplete: Set[int] = set()
+        for oid, locs in holdings.items():
+            pg = self.osdmap.object_to_pg(pool, oid)
+            if pg not in temp_pgs or pg in incomplete:
+                continue
+            shards_at: Dict[int, Set[int]] = {}
+            for (shard, _osd, v) in locs:
+                shards_at.setdefault(v, set()).add(shard)
+            viable = [v for v, sh in shards_at.items() if len(sh) >= k_need]
+            if not viable:
+                incomplete.add(pg)
+                continue
+            newest = max(viable)
+            at_newest = {(shard, osd) for shard, osd, v in locs
+                         if v == newest}
+            for shard, osd in enumerate(self._raw_up(pool, pg)):
+                if osd != CRUSH_ITEM_NONE and (shard, osd) not in at_newest:
+                    incomplete.add(pg)
+                    break
+        for pg in temp_pgs:
+            if pg in incomplete:
+                continue
+            # complete (or the PG holds no objects at all): drop override
+            try:
+                await self._mon_rpc(
+                    MOSDPGTemp(pool_id=pool.pool_id, pg=pg, acting=[],
+                               from_osd=self.osd_id), MMapReply)
+            except Exception:
+                pass
+
+    async def _recover_shard_subchunk(
+        self, pool: PoolInfo, pg: int, oid: str, lost: int,
+        holders: Dict[int, int], newest: int,
+    ) -> Optional[Tuple[bytes, int]]:
+        """Bandwidth-efficient single-shard repair for sub-chunk codecs
+        (CLAY): each helper ships only the repair sub-chunk byte ranges of
+        its blob instead of whole chunks (reference fragmented helper
+        reads ECBackend.cc:1049-1071 + ErasureCodeClay.cc:396
+        repair_one_lost_chunk; the runs come from
+        minimum_to_decode's SubChunkPlan).  Returns (shard_blob,
+        object_size) or None when the generic full-decode path must run.
+        """
+        codec = self._codec(pool)
+        sinfo = self._sinfo(pool)
+        sub = codec.get_sub_chunk_count()
+        if sub <= 1:
+            return None
+        try:
+            plan = codec.minimum_to_decode({lost}, set(holders))
+        except ErasureCodeError:
+            return None
+        runs = next(iter(plan.values()))
+        if all(r == [(0, sub)] for r in plan.values()):
+            return None  # plan is whole-chunk: no sub-chunk saving
+        cs = sinfo.chunk_size
+        sc_size = cs // sub
+        # stat one helper for the object extent -> stripe count
+        stat_shard = next(iter(plan))
+        stat = await self._sub_read_extents(pool, pg, oid, stat_shard,
+                                            holders[stat_shard], [(0, 0)])
+        if stat is None or stat[2] != newest:
+            return None
+        object_size = stat[1]
+        n_stripes = max(1, -(-object_size // sinfo.stripe_width))
+        extents = [(s * cs + idx * sc_size, cnt * sc_size)
+                   for s in range(n_stripes) for (idx, cnt) in runs]
+        rb = sum(cnt for _i, cnt in runs) * sc_size  # per-stripe bytes
+        pieces: Dict[int, bytes] = {}
+        for shard, shard_runs in plan.items():
+            got = await self._sub_read_extents(pool, pg, oid, shard,
+                                               holders[shard], extents)
+            if got is None or got[2] != newest or len(got[0]) != rb * n_stripes:
+                return None
+            pieces[shard] = got[0]
+            self.perf.inc("recovery_subchunk_bytes", len(got[0]))
+        out: List[bytes] = []
+        for s in range(n_stripes):
+            stripe_chunks = {
+                shard: np.frombuffer(buf[s * rb:(s + 1) * rb], dtype=np.uint8)
+                for shard, buf in pieces.items()
+            }
+            decoded = codec.decode({lost}, stripe_chunks, cs)
+            out.append(bytes(decoded[lost]))
+        return b"".join(out), object_size
+
+    async def _sub_read_extents(
+        self, pool: PoolInfo, pg: int, oid: str, shard: int, osd: int,
+        extents: List[Tuple[int, int]],
+    ) -> Optional[Tuple[bytes, int, int]]:
+        """One extent sub-read -> (bytes, object_size, version) or None."""
+        if osd == self.osd_id:
+            got = self._store_read((pool.pool_id, oid, shard))
+            if got is None:
+                return None
+            blob, meta = got
+            payload = b"".join(bytes(blob[o:o + l]) for o, l in extents)
+            return payload, meta.object_size, meta.version
+        tid = uuid.uuid4().hex
+        q = self._collector(tid)
+        try:
+            await self.messenger.send(
+                self.osdmap.addr_of(osd),
+                MECSubRead(pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard,
+                           tid=tid, reply_to=self.addr, extents=extents))
+        except Exception:
+            self._collectors.pop(tid, None)
+            return None
+        for r in await self._gather(tid, q, 1, timeout=2.0):
+            if r.ok:
+                return r.chunk, r.object_size, r.version
+        return None
+
+    async def _backfill_pool(
+        self, pool: PoolInfo,
+    ) -> Tuple[int, Dict[str, Set[Tuple[int, int, int]]]]:
+        """Full-scan recovery (reference backfill): reconstruct and push
+        shards missing from the up-set positions of objects this OSD is
+        primary for.  Returns (shards_pushed, the gathered holdings)."""
+        holdings = await self._gather_holdings(pool)
         k_need = (self._codec(pool).get_data_chunk_count()
                   if pool.pool_type == "ec" else 1)
         pushed = 0
@@ -1737,14 +2279,44 @@ class OSD:
                                          reply_to=self.addr))
                     except Exception:
                         pass
-            have = {shard: osd for shard, osd, v in locs if v == newest}
+            # membership by (shard, osd) pair: a shard may legitimately
+            # live on several OSDs mid-backfill (old holder + new target)
+            at_newest = {(shard, osd) for shard, osd, v in locs
+                         if v == newest}
+            # push targets are the UP-SET positions: identical to acting
+            # normally, but under pg_temp the override serves IO while
+            # backfill fills the crush-mapped members
             missing = [
                 (shard, osd)
-                for shard, osd in enumerate(acting)
-                if osd != CRUSH_ITEM_NONE and have.get(shard) != osd
+                for shard, osd in enumerate(self._raw_up(pool, pg))
+                if osd != CRUSH_ITEM_NONE and (shard, osd) not in at_newest
             ]
             if not missing:
                 continue
+            if len(missing) == 1 and pool.pool_type == "ec":
+                # single lost shard: try the sub-chunk repair path (CLAY)
+                # — helpers move sub_chunk_no/q of a chunk, not k chunks
+                lost, target = missing[0]
+                hold = {shard: osd for shard, osd, v in locs if v == newest}
+                hold.pop(lost, None)
+                got = await self._recover_shard_subchunk(
+                    pool, pg, oid, lost, hold, newest)
+                if got is not None:
+                    blob, osize = got
+                    push = MPushShard(
+                        pool_id=pool.pool_id, pg=pg, oid=oid, shard=lost,
+                        chunk=blob, version=newest, object_size=osize,
+                        xattrs=self._cls_xattrs(pool.pool_id, oid))
+                    if target == self.osd_id:
+                        self._apply_push(push)
+                    else:
+                        try:
+                            await self.messenger.send(
+                                self.osdmap.addr_of(target), push)
+                        except Exception:
+                            continue
+                    pushed += 1
+                    continue
             # READING: gather k chunks (degraded-read machinery)
             read_op = MOSDOp(op="read", pool_id=pool.pool_id, oid=oid)
             reply = await self._do_read(read_op)
@@ -1755,13 +2327,14 @@ class OSD:
             # version stays consistent with surviving shards
             encoded = self._encode_for(pool, reply.data)
             version = reply.version
-            xattrs = dict(self.store.getattrs((pool.pool_id, oid, 0)))
+            xattrs = self._cls_xattrs(pool.pool_id, oid)
+            hinfo_blob = self._hinfo_for(pool, encoded)
             for shard, osd in missing:
                 chunk = bytes(encoded[shard])
                 push = MPushShard(
                     pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard, chunk=chunk,
                     version=version, object_size=len(reply.data),
-                    xattrs=xattrs,
+                    xattrs=xattrs, hinfo=hinfo_blob,
                 )
                 if osd == self.osd_id:
                     self._apply_push(push)
@@ -1771,4 +2344,4 @@ class OSD:
                     except Exception:
                         continue
                 pushed += 1
-        return pushed
+        return pushed, holdings

@@ -208,6 +208,14 @@ class Paxos:
         self.quorum: Set[int] = set()
         # pending (uncommitted) value seen by a peon
         self.pending: Optional[Tuple[int, bytes]] = None
+        # epoch fencing (the reference's proposal-number machinery,
+        # Paxos.h accepted_pn/last_pn): peons promise the election epoch
+        # at collect/victory and reject begin/commit from lower epochs, so
+        # a deposed leader that still believes it leads cannot commit a
+        # divergent value against the same peons
+        self.epoch = 0  # leader: the epoch current proposals carry
+        self.promised_epoch = 0  # peon: floor for begin/commit acceptance
+        self.nacked = False  # leader: a peer refused our epoch
 
     # -- collect phase (leader, after election) ------------------------------
 
@@ -235,10 +243,14 @@ class Paxos:
 
     # -- proposals (leader) --------------------------------------------------
 
-    async def propose(self, value: bytes, quorum: Set[int]) -> int:
+    async def propose(self, value: bytes, quorum: Set[int],
+                      epoch: Optional[int] = None) -> int:
         """Replicate one value; returns the committed version.  The caller
         (Monitor) awaits acceptance via handle_accept -> _check_commit."""
         assert self.proposing is None, "one in-flight proposal at a time"
+        if epoch is not None:
+            self.epoch = epoch
+        self.nacked = False
         version = self.store.last_committed + 1
         self.proposing = (version, value)
         self.accepts = {self.rank}
@@ -246,16 +258,33 @@ class Paxos:
         for peer in quorum:
             if peer != self.rank:
                 await self.send(peer, {"op": "begin", "version": version,
-                                       "value": value})
+                                       "value": value, "epoch": self.epoch})
         return version
 
-    def handle_accept(self, from_rank: int, version: int) -> bool:
+    def handle_accept(self, from_rank: int, version: int,
+                      epoch: Optional[int] = None) -> bool:
         """Returns True when the proposal just reached majority."""
         if self.proposing is None or self.proposing[0] != version:
             return False
+        if epoch is not None and epoch != self.epoch:
+            return False  # accept for some other leadership's round
         self.accepts.add(from_rank)
         need = len(self.quorum) // 2 + 1
         return len(self.accepts) >= need
+
+    def handle_nack(self, epoch: int) -> bool:
+        """A peer promised a newer epoch: we are deposed.  Abandon the
+        in-flight proposal (the reference leader bootstraps on seeing a
+        higher pn).  A nack at or below our CURRENT proposal epoch is a
+        stale packet from an older round — a single delayed frame must not
+        tear down a healthy re-elected leadership — and is ignored.
+        Returns True when the nack actually deposed us."""
+        if epoch <= self.epoch:
+            return False
+        self.nacked = True
+        self.promised_epoch = max(self.promised_epoch, epoch)
+        self.proposing = None
+        return True
 
     async def commit_current(self) -> Tuple[int, bytes]:
         version, value = self.proposing  # type: ignore[misc]
@@ -266,17 +295,37 @@ class Paxos:
         for peer in self.quorum:
             if peer != self.rank:
                 await self.send(peer, {"op": "commit", "version": version,
-                                       "value": value})
+                                       "value": value, "epoch": self.epoch})
         return version, value
 
     # -- peon side -----------------------------------------------------------
 
-    async def handle_begin(self, from_rank: int, version: int,
-                           value: bytes) -> None:
-        self.pending = (version, value)
-        await self.send(from_rank, {"op": "accept", "version": version})
+    def promise(self, epoch: int) -> bool:
+        """Record the election epoch at collect/victory time; returns False
+        for a stale (lower-epoch) overture."""
+        if epoch < self.promised_epoch:
+            return False
+        self.promised_epoch = epoch
+        return True
 
-    def handle_commit(self, version: int, value: bytes) -> None:
+    async def handle_begin(self, from_rank: int, version: int,
+                           value: bytes, epoch: Optional[int] = None) -> None:
+        if epoch is not None and epoch < self.promised_epoch:
+            # stale leader (healed partition / lost lease): refuse, teach
+            await self.send(from_rank, {"op": "nack", "version": version,
+                                        "epoch": self.promised_epoch})
+            return
+        if epoch is not None:
+            self.promised_epoch = epoch
+        self.pending = (version, value)
+        await self.send(from_rank, {"op": "accept", "version": version,
+                                    "epoch": epoch if epoch is not None
+                                    else self.promised_epoch})
+
+    def handle_commit(self, version: int, value: bytes,
+                      epoch: Optional[int] = None) -> None:
+        if epoch is not None and epoch < self.promised_epoch:
+            return  # a deposed leader's commit must not land
         if self.pending and self.pending[0] == version:
             self.pending = None
         if version > self.store.last_committed:

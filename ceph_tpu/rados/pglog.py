@@ -34,6 +34,16 @@ Version = Tuple[int, int]  # (epoch, seq) — eversion_t role
 ZERO: Version = (0, 0)
 
 
+def pack_eversion(v: Version) -> int:
+    """eversion -> one epoch-major ordered int, the version stamped on
+    shard metadata.  Shard 'newest' resolution (reads, recovery, backfill)
+    thereby follows PG-log order, never wall clocks: a failover primary on
+    a slow clock still outranks pre-failover writes because its epoch is
+    higher (the reference orders by eversion_t everywhere, e.g.
+    src/osd/osd_types.h eversion_t)."""
+    return (v[0] << 32) | (v[1] & 0xFFFFFFFF)
+
+
 @dataclass
 class LogEntry:
     version: Version

@@ -360,7 +360,7 @@ class MOSDOpReply:
 # reference src/osd/ECMsgTypes.h:23,105)
 
 
-@message(30, version=2)
+@message(30, version=3)
 class MECSubWrite:
     pool_id: int = 0
     pg: int = 0
@@ -376,6 +376,21 @@ class MECSubWrite:
     # SAME store transaction as the shard write (log_operation coupling,
     # reference ECBackend::handle_sub_write ECBackend.cc:992)
     log_entry: bytes = b""
+    # chunk_off >= 0: splice `chunk` into the shard blob at that offset
+    # (the per-stripe RMW write plan, reference ECTransaction.cc:37-95)
+    # instead of replacing the blob; the blob zero-extends to at least
+    # `shard_size` (zero chunks ARE the parity of zero stripes, so gap
+    # stripes created by a sparse write need no extra encode)
+    chunk_off: int = -1
+    shard_size: int = 0
+    # splice precondition: the shard version the primary's RMW base was
+    # read at.  A shard that missed an intermediate write must NOT have
+    # the delta spliced into its stale blob (it would stamp corrupt bytes
+    # as newest); it rejects and lets recovery re-push the full blob.
+    prior_version: int = 0
+    # ecutil.HashInfo blob (hinfo_key xattr, reference ECUtil.h:101-160);
+    # empty on splice writes — the shard then self-updates its own entry
+    hinfo: bytes = b""
 
 
 @message(31)
@@ -385,7 +400,7 @@ class MECSubWriteReply:
     ok: bool = True
 
 
-@message(32)
+@message(32, version=2)
 class MECSubRead:
     pool_id: int = 0
     pg: int = 0
@@ -393,14 +408,19 @@ class MECSubRead:
     shard: int = 0
     tid: str = ""
     reply_to: Tuple[str, int] = ("", 0)
+    # (offset, length) byte ranges WITHIN the shard blob; empty = whole
+    # blob.  Serves both the per-stripe RMW read plan and fragmented
+    # sub-chunk recovery reads (reference ECMsgTypes.h:105 to_read lists,
+    # ECBackend.cc:1049-1071 CLAY helper reads).
+    extents: List[Tuple[int, int]] = field(default_factory=list)
 
 
-@message(33)
+@message(33, version=2)
 class MECSubReadReply:
     tid: str = ""
     shard: int = 0
     ok: bool = True
-    chunk: bytes = b""
+    chunk: bytes = b""  # whole blob, or the requested extents concatenated
     version: int = 0
     object_size: int = 0
 
@@ -418,11 +438,12 @@ class MECSubDelete:
     log_entry: bytes = b""
 
 
-@message(35, version=2)
+@message(35, version=3)
 class MPushShard:
     """Recovery push of a reconstructed shard (reference PushOp).  Carries
     the object's cls xattr state so a backfilled OSD can serve class calls
-    (reference pushes attrs alongside data)."""
+    (reference pushes attrs alongside data), and the recomputed HashInfo
+    so the hinfo_key xattr survives recovery."""
 
     pool_id: int = 0
     pg: int = 0
@@ -432,6 +453,7 @@ class MPushShard:
     version: int = 0
     object_size: int = 0
     xattrs: Dict[str, bytes] = field(default_factory=dict)
+    hinfo: bytes = b""
 
 
 @message(36)
@@ -555,7 +577,7 @@ class MNotifyAck:
     watcher: Tuple[str, int] = ("", 0)
 
 
-@message(45)
+@message(45, version=2)
 class MScrubShardReply:
     tid: str = ""
     osd_id: int = 0
@@ -563,3 +585,23 @@ class MScrubShardReply:
     present: bool = False
     crc_ok: bool = False
     version: int = 0
+    # the recomputed blob crc: the scrubbing primary cross-checks it
+    # against its OWN stored (clean) HashInfo record of that shard, so a
+    # shard whose blob+meta+hinfo were consistently rewritten still fails
+    # scrub (the reference compares all shards' hinfo copies)
+    crc: int = 0
+
+
+@message(52)
+class MOSDPGTemp:
+    """Primary-requested temporary acting set (reference MOSDPGTemp +
+    OSDMonitor::prepare_pgtemp; applied in _pg_to_up_acting_osds,
+    OSDMap.cc:2673): while a remapped PG backfills, the prior
+    (data-holding) interval's set keeps serving IO.  Empty `acting`
+    clears the override once backfill completes."""
+
+    pool_id: int = 0
+    pg: int = 0
+    acting: List[int] = field(default_factory=list)
+    from_osd: int = -1
+    tid: str = ""

@@ -49,6 +49,92 @@ class TestElectionLogic:
         assert not logic.is_leader and logic.in_quorum and logic.leader == 0
 
 
+class TestPaxosEpochFencing:
+    """A deposed leader (healed partition, lost lease) must not be able to
+    commit a value concurrently with the new leader: peons promise the
+    election epoch and nack lower-epoch begin/commit (the reference's
+    accepted_pn machinery, src/mon/Paxos.cc handle_collect/handle_begin)."""
+
+    def _paxos_pair(self):
+        from ceph_tpu.rados.paxos import Paxos
+
+        sent = []
+
+        def make(rank):
+            async def send(peer, payload):
+                sent.append((rank, peer, payload))
+            return Paxos(MonitorDBStore(), rank, send)
+
+        return make, sent
+
+    def test_peon_nacks_stale_begin_and_ignores_stale_commit(self):
+        async def go():
+            make, sent = self._paxos_pair()
+            peon = make(1)
+            peon.promise(6)  # new leader's collect/victory at epoch 6
+            # old leader (epoch 4) tries begin: peon must nack, not accept
+            await peon.handle_begin(0, 1, b"old-value", epoch=4)
+            assert sent[-1][2]["op"] == "nack"
+            assert sent[-1][2]["epoch"] == 6
+            assert peon.pending is None
+            # and its commit must not land either
+            peon.handle_commit(1, b"old-value", epoch=4)
+            assert peon.store.last_committed == 0
+            # the rightful leader's round at epoch 6 proceeds
+            await peon.handle_begin(2, 1, b"new-value", epoch=6)
+            assert sent[-1][2]["op"] == "accept"
+            peon.handle_commit(1, b"new-value", epoch=6)
+            assert peon.store.get(1) == b"new-value"
+
+        run(go())
+
+    def test_leader_abandons_on_nack(self):
+        async def go():
+            make, _sent = self._paxos_pair()
+            leader = make(0)
+            await leader.propose(b"v", {0, 1, 2}, epoch=4)
+            leader.handle_nack(6)
+            assert leader.nacked
+            assert leader.proposing is None
+            # accepts for a foreign epoch are not counted
+            await leader.propose(b"v2", {0, 1, 2}, epoch=8)
+            assert not leader.handle_accept(1, leader.proposing[0], epoch=6)
+            assert leader.handle_accept(1, leader.proposing[0], epoch=8)
+
+        run(go())
+
+    def test_divergent_concurrent_commit_is_impossible(self):
+        async def go():
+            from ceph_tpu.rados.paxos import Paxos
+
+            # one shared peon, two would-be leaders — the advisor scenario
+            wires = []
+
+            def mk(rank):
+                async def send(peer, payload):
+                    wires.append((rank, peer, payload))
+                return Paxos(MonitorDBStore(), rank, send)
+
+            old_leader, new_leader, peon = mk(0), mk(1), mk(2)
+            # new leader collected at epoch 6; old leader stuck at 4
+            peon.promise(6)
+            await old_leader.propose(b"A", {0, 2}, epoch=4)
+            await new_leader.propose(b"B", {1, 2}, epoch=6)
+            # deliver both begins to the shared peon
+            for frm, _to, p in list(wires):
+                if p["op"] == "begin":
+                    await peon.handle_begin(frm, p["version"], p["value"],
+                                            p["epoch"])
+            # peon acked exactly ONE of them (the epoch-6 proposal)
+            accepts = [(f, t, p) for f, t, p in wires if p["op"] == "accept"]
+            nacks = [(f, t, p) for f, t, p in wires if p["op"] == "nack"]
+            assert len(accepts) == 1 and accepts[0][1] == 1
+            assert len(nacks) == 1 and nacks[0][1] == 0
+            assert peon.pending[1] == b"B"
+
+        run(go())
+
+
 class TestMonitorDBStore:
     def test_commit_persist_recover(self, tmp_path):
         path = str(tmp_path / "store.db")

@@ -82,6 +82,25 @@ class TestMClock:
         first = [s.dequeue().op_class for _ in range(10)]
         assert first.count(CLASS_CLIENT) >= 7, first
 
+    def test_recovery_bounded_but_not_starved_under_client_load(self):
+        """The reason mClock exists (reference mClockScheduler.cc): under
+        saturating client load, recovery still progresses (weight > 0) but
+        its share is bounded near the weight ratio — client weight 10 vs
+        recovery weight 3 — instead of fair-queue 50%."""
+        s = MClockScheduler()
+        n = 150
+        for _ in range(n):
+            s.enqueue(CLASS_CLIENT, _noop)
+            s.enqueue(CLASS_RECOVERY, _noop)
+        served = [s.dequeue().op_class for _ in range(n)]
+        recov = served.count(CLASS_RECOVERY)
+        assert recov > 0, "recovery fully starved"
+        # bounded: well under a fair half, in the weight-ratio ballpark
+        # (3/13 ~ 23%); allow slack for the reservation phase
+        assert recov <= int(n * 0.40), f"recovery unbounded: {recov}/{n}"
+        # and clients were not the starved party either
+        assert served.count(CLASS_CLIENT) >= int(n * 0.60)
+
     def test_make_scheduler_selects(self):
         assert isinstance(make_scheduler({"osd_op_queue": "mclock"}),
                           MClockScheduler)

@@ -1,0 +1,109 @@
+"""CLAY sub-chunk recovery on the wire (reference ECBackend.cc:1049-1071
+fragmented helper reads + ErasureCodeClay.cc:396 repair_one_lost_chunk):
+repairing ONE lost shard reads only the repair sub-chunk extents from each
+helper — sub_chunk_no/q of a chunk — instead of k whole chunks."""
+
+import asyncio
+
+import numpy as np
+
+from ceph_tpu.rados.vstart import Cluster
+
+CONF = {
+    "mon_osd_report_grace": 0.8,
+    "osd_heartbeat_interval": 0.2,
+    "osd_repair_delay": 0.3,
+    "client_op_timeout": 2.0,
+    "osd_auto_repair": False,
+}
+
+CLAY = {"plugin": "clay", "k": "4", "m": "2"}
+
+
+def payload(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+def run(coro, timeout=90):
+    asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+class TestSubchunkRecovery:
+    def test_single_shard_repair_moves_subchunk_bytes_only(self):
+        async def go():
+            cluster = Cluster(n_osds=7, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("clay", profile=dict(CLAY))
+                data = payload(200_000, seed=1)
+                await c.put(pool, "obj", data)
+                p = c.osdmap.pools[pool]
+                pg = c.osdmap.object_to_pg(p, "obj")
+                acting = c.osdmap.pg_to_acting(p, pg)
+                primary_id = c.osdmap.primary_of(acting, seed=(pool << 20) | pg)
+                primary = cluster.osds[primary_id]
+                # delete ONE shard (not the primary's own store access
+                # path, any acting member's) to create a single loss
+                lost_shard, lost_osd = next(
+                    (s, o) for s, o in enumerate(acting) if o >= 0)
+                victim = cluster.osds[lost_osd]
+                original = victim.store.read((pool, "obj", lost_shard))
+                assert original is not None
+                blob_len = len(original[0])
+                from ceph_tpu.rados.store import Transaction
+                txn = Transaction()
+                txn.delete((pool, "obj", lost_shard))
+                victim.store.queue_transaction(txn)
+                before = primary.perf.get("recovery_subchunk_bytes")
+                await c.repair_pool(pool)
+                await asyncio.sleep(0.4)  # pushes are fire-and-forget
+                restored = victim.store.read((pool, "obj", lost_shard))
+                assert restored is not None, "shard not repaired"
+                assert restored[0] == original[0], "repair not byte-identical"
+                moved = primary.perf.get("recovery_subchunk_bytes") - before
+                assert moved > 0, "sub-chunk path not taken"
+                # d=5 helpers x blob/q (q=2) each; full-chunk helper reads
+                # would be d x blob_len.  Assert the q-fold saving held.
+                d = 5
+                assert moved <= d * blob_len // 2 + 1024, (moved, blob_len)
+                assert moved < 4 * blob_len, "no saving vs reading k chunks"
+                # object still reads back
+                for o in cluster.osds.values():
+                    o._extent_cache.clear()
+                assert await c.get(pool, "obj") == data
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_subchunk_repair_falls_back_when_two_shards_lost(self):
+        async def go():
+            cluster = Cluster(n_osds=7, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("clay2", profile=dict(CLAY))
+                data = payload(60_000, seed=2)
+                await c.put(pool, "obj", data)
+                p = c.osdmap.pools[pool]
+                pg = c.osdmap.object_to_pg(p, "obj")
+                acting = c.osdmap.pg_to_acting(p, pg)
+                from ceph_tpu.rados.store import Transaction
+                victims = [(s, o) for s, o in enumerate(acting) if o >= 0][:2]
+                for s, o in victims:
+                    txn = Transaction()
+                    txn.delete((pool, "obj", s))
+                    cluster.osds[o].store.queue_transaction(txn)
+                await c.repair_pool(pool)
+                await asyncio.sleep(0.4)
+                for s, o in victims:
+                    assert cluster.osds[o].store.read((pool, "obj", s)) \
+                        is not None, f"shard {s} not repaired"
+                for o in cluster.osds.values():
+                    o._extent_cache.clear()
+                assert await c.get(pool, "obj") == data
+            finally:
+                await cluster.stop()
+
+        run(go())
