@@ -32,7 +32,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ceph_tpu.ec.interface import ErasureCodeError
 from ceph_tpu.ec.registry import registry
-from ceph_tpu.rados.crush import CrushMap
+from ceph_tpu.rados.crush import CRUSH_ITEM_NONE, CrushMap
 from ceph_tpu.rados.messenger import Messenger
 from ceph_tpu.rados.paxos import ElectionLogic, MonitorDBStore, Paxos
 from ceph_tpu.rados.types import (
@@ -349,8 +349,31 @@ class Monitor:
                                         "value": val,
                                         "epoch": self.logic.epoch})
 
+    def _clean_pg_temps(self) -> None:
+        """Prune unserviceable pg_temp overrides (reference
+        OSDMap::clean_temps): entries of deleted pools, out-of-range pgs,
+        and overrides with NO live member — a pg_temp whose members all
+        died would otherwise pin the PG primary-less forever, since only
+        the override's own primary ever asks to clear it."""
+        dead = []
+        for key, acting in self.osdmap.pg_temp.items():
+            pool = self.osdmap.pools.get(key[0])
+            if pool is None or key[1] >= pool.pg_num:
+                dead.append(key)
+                continue
+            live = [a for a in acting
+                    if a != CRUSH_ITEM_NONE and self.osdmap.osds.get(a)
+                    and self.osdmap.osds[a].up]
+            if not live:
+                dead.append(key)
+        if dead:
+            for key in dead:
+                self.osdmap.pg_temp.pop(key, None)
+            self.osdmap.epoch += 1
+
     async def _commit_state(self) -> None:
         """Replicate the current state snapshot; blocks until majority."""
+        self._clean_pg_temps()
         async with self._commit_lock:
             quorum = self.logic.quorum or {self.rank}
             if not self.is_leader:
@@ -611,8 +634,17 @@ class Monitor:
             key = (msg.pool_id, msg.pg)
             changed = False
             if msg.acting:
-                if (self.osdmap.pools.get(msg.pool_id) is not None
-                        and self.osdmap.pg_temp.get(key) != list(msg.acting)):
+                pool = self.osdmap.pools.get(msg.pool_id)
+                valid = (
+                    pool is not None
+                    and msg.pg < pool.pg_num
+                    and all(a == CRUSH_ITEM_NONE or a in self.osdmap.osds
+                            for a in msg.acting)
+                    # an override equal to the crush mapping is a no-op
+                    # that would only linger in the map
+                    and list(msg.acting) != self.osdmap.pg_to_raw(pool, msg.pg)
+                )
+                if valid and self.osdmap.pg_temp.get(key) != list(msg.acting):
                     self.osdmap.pg_temp[key] = list(msg.acting)
                     changed = True
             elif key in self.osdmap.pg_temp:

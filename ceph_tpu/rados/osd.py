@@ -508,18 +508,34 @@ class OSD:
         old = self.osdmap
         if old is not None and osdmap.epoch <= old.epoch:
             return
-        if old is not None:
+        if old is not None and self._mapping_inputs_changed(old, osdmap):
             # remember the outgoing interval's acting set for PGs whose
             # mapping changed (past_intervals role): it is the set a
-            # pg_temp request must name during backfill
+            # pg_temp request must name during backfill.  The dual-CRUSH
+            # scan only runs when a mapping INPUT changed (osd states,
+            # weights, pools, pg_temp, crush) — config-only epochs skip it.
             for pool in osdmap.pools.values():
                 old_pool = old.pools.get(pool.pool_id)
                 if old_pool is None:
                     continue
                 for pg in range(min(pool.pg_num, old_pool.pg_num)):
+                    key = (pool.pool_id, pg)
                     oa = old.pg_to_acting(old_pool, pg)
-                    if oa != osdmap.pg_to_acting(pool, pg):
-                        self._prior_acting[(pool.pool_id, pg)] = oa
+                    if oa == osdmap.pg_to_acting(pool, pg):
+                        continue
+                    if key in old.pg_temp and key not in osdmap.pg_temp:
+                        # the override was CLEARED: backfill to the crush
+                        # set completed, so the outgoing acting (the
+                        # override itself) is obsolete history — recording
+                        # it would let a later transient degradation
+                        # reinstall a long-stale interval as pg_temp
+                        self._prior_acting.pop(key, None)
+                    else:
+                        self._prior_acting[key] = oa
+            # prune intervals of deleted pools (bounded memory)
+            for key in [k for k in self._prior_acting
+                        if k[0] not in osdmap.pools]:
+                self._prior_acting.pop(key, None)
         self.osdmap = osdmap
         # primaryship may have moved: cached decodes can silently go stale
         # across an interval we didn't serve (ExtentCache is per-interval)
@@ -537,6 +553,26 @@ class OSD:
                 self._repair_task = asyncio.get_running_loop().create_task(
                     self._delayed_repair()
                 )
+
+    @staticmethod
+    def _mapping_inputs_changed(old: OSDMap, new: OSDMap) -> bool:
+        """True when something that can move a PG mapping changed between
+        two maps: OSD up/in/weight states, pools, pg_temp, or crush."""
+        if old.pg_temp != new.pg_temp or old.pools != new.pools:
+            return True
+        if old.primary_affinity != new.primary_affinity:
+            return True
+        # same crush-change heuristic the incremental diff uses
+        if (old.crush.devices() != new.crush.devices()
+                or old.crush.rules.keys() != new.crush.rules.keys()):
+            return True
+        if old.osds.keys() != new.osds.keys():
+            return True
+        return any(
+            (o.up, o.in_cluster, o.weight)
+            != (new.osds[i].up, new.osds[i].in_cluster, new.osds[i].weight)
+            for i, o in old.osds.items()
+        )
 
     async def _delayed_repair(self) -> None:
         await asyncio.sleep(self.conf.get("osd_repair_delay", 0.5))
@@ -738,11 +774,6 @@ class OSD:
         n = codec.get_chunk_count()
         span = self.ctx.tracer.new_trace("ec write")
         span.event("start ec write")
-        entry = LogEntry(version=log.next_version(self.osdmap.epoch),
-                         op="write", oid=op.oid, prior_version=log.head,
-                         reqid=op.reqid)
-        version = pack_eversion(entry.version)
-        entry.object_version = version
         # splice plan: chunk_off >= 0 means each shard splices `blobs[shard]`
         # into its stored blob at chunk_off (per-stripe RMW, the reference's
         # write plan ECTransaction.cc:37-95); -1 replaces the whole blob
@@ -799,6 +830,16 @@ class OSD:
                 data = seg
                 chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(s0)
                 shard_size = sinfo.logical_to_next_chunk_offset(object_size)
+        # Allocate the PG-log eversion only after every await above: the
+        # RMW reads yield to the loop, and a concurrent log merge (repair
+        # task / unsolicited log reply) advancing the head would invalidate
+        # a version handed out earlier.  From here to the local apply the
+        # path is synchronous, so the head cannot move underneath us.
+        entry = LogEntry(version=log.next_version(self.osdmap.epoch),
+                         op="write", oid=op.oid, prior_version=log.head,
+                         reqid=op.reqid)
+        version = pack_eversion(entry.version)
+        entry.object_version = version
         blobs = batched_encode(codec, sinfo, data)
         span.event("encoded")
         hinfo_blob = self._hinfo_for(pool, blobs) if chunk_off < 0 else b""
@@ -877,48 +918,68 @@ class OSD:
             plan = codec.minimum_to_decode(want, set(available))
         except ErasureCodeError:
             return None
+        # a cut older than the log's committed head is a stale survivor;
+        # when the log holds NO entry for this oid (trimmed, or written in
+        # a prior interval) the log cannot corroborate — stat-probe the
+        # shards OUTSIDE the plan in the same fan-out and refuse the cut
+        # if any of them holds a newer version (a consistent k-subset of
+        # stale survivors would otherwise pass and an acked write's bytes
+        # would be spliced away)
+        log = self._pglog(op.pool_id, pg)
+        latest_logged = max(
+            (e.object_version for e in log.entries if e.oid == op.oid),
+            default=0)
+        probe = ([s for s in available if s not in plan]
+                 if latest_logged == 0 else [])
         tid = uuid.uuid4().hex
         pieces: Dict[int, bytes] = {}
         versions: Dict[int, int] = {}
+        probe_versions: Dict[int, int] = {}
         sizes: Dict[int, int] = {}
         remote = []
-        for shard in plan:
+        for shard in list(plan) + probe:
             osd = available[shard]
+            stat_only = shard not in plan
             if osd == self.osd_id:
                 got = self._store_read((op.pool_id, op.oid, shard))
                 if got is not None:
                     blob, meta = got
-                    pieces[shard] = bytes(blob[chunk_off:chunk_off + clen])
-                    versions[shard] = meta.version
-                    sizes[shard] = meta.object_size
+                    if stat_only:
+                        probe_versions[shard] = meta.version
+                    else:
+                        pieces[shard] = bytes(blob[chunk_off:chunk_off + clen])
+                        versions[shard] = meta.version
+                        sizes[shard] = meta.object_size
             else:
-                remote.append((shard, osd))
+                remote.append((shard, osd, stat_only))
         q = self._collector(tid)
         sent = 0
-        for shard, osd in remote:
+        for shard, osd, stat_only in remote:
             try:
                 await self.messenger.send(
                     self.osdmap.addr_of(osd),
                     MECSubRead(pool_id=op.pool_id, pg=pg, oid=op.oid,
                                shard=shard, tid=tid, reply_to=self.addr,
-                               extents=[(chunk_off, clen)]))
+                               extents=[(0, 0)] if stat_only
+                               else [(chunk_off, clen)]))
                 sent += 1
             except Exception:
                 pass
+        plan_set = set(plan)
         for r in await self._gather(tid, q, sent):
-            if r.ok:
+            if r.ok and r.shard in plan_set:
                 pieces[r.shard] = r.chunk
                 versions[r.shard] = r.version
                 sizes[r.shard] = r.object_size
+            elif r.ok:
+                probe_versions[r.shard] = r.version
         if len(pieces) < k or len(set(versions.values())) != 1:
             return None
-        # a cut older than the log's committed head is a stale survivor
-        log = self._pglog(op.pool_id, pg)
-        latest_logged = max(
-            (e.object_version for e in log.entries if e.oid == op.oid),
-            default=0)
-        if max(versions.values()) < latest_logged:
+        cut_version = max(versions.values())
+        if cut_version < latest_logged:
             return None
+        if any(v > cut_version for v in probe_versions.values()):
+            return None  # someone holds newer: the cut is a stale survivor
         arrays = {}
         for shard, piece in pieces.items():
             if len(piece) < clen:  # stripes past EOF read back as zeros
@@ -1439,11 +1500,30 @@ class OSD:
         splices refresh our OWN entry — by crc32 chaining when the splice
         is a pure append (no re-read of prior bytes), by recompute
         otherwise — and mark the record dirty (other entries went stale)."""
+        pool = self.osdmap.pools.get(pool_id) if self.osdmap else None
+        if pool is not None and pool.pool_type != "ec":
+            return  # replicated pools carry no hinfo; skip the xattr I/O
         key = (pool_id, oid, shard)
         try:
             if chunk_off < 0:
                 if hinfo:
                     self.store.setattr(key, HashInfo.XATTR_KEY, hinfo)
+                else:
+                    # full-blob write without a primary-computed record
+                    # (e.g. a sub-chunk recovery push whose helper record
+                    # was dirty): an existing record is now stale for this
+                    # shard — refresh our own entry and mark it dirty so
+                    # scrub trusts the self crc and skips the cross-shard
+                    # comparison, instead of flagging fresh data as bad
+                    raw0 = self.store.getattr(key, HashInfo.XATTR_KEY)
+                    if raw0 is not None:
+                        h0 = HashInfo.decode(raw0)
+                        if shard < len(h0.crcs):
+                            h0.crcs[shard] = shard_crc(blob)
+                            h0.total_chunk_size = len(blob)
+                            h0.dirty = True
+                            self.store.setattr(key, HashInfo.XATTR_KEY,
+                                               h0.encode())
                 return
             raw = self.store.getattr(key, HashInfo.XATTR_KEY)
             if raw is None:
@@ -1507,9 +1587,17 @@ class OSD:
                                    for o, l in msg.extents)
             else:
                 payload = chunk
+            hraw = None
+            if getattr(msg, "want_hinfo", False):
+                try:
+                    hraw = self.store.getattr(
+                        (msg.pool_id, msg.oid, msg.shard), HashInfo.XATTR_KEY)
+                except NotImplementedError:
+                    pass
             reply = MECSubReadReply(
                 tid=msg.tid, shard=msg.shard, ok=True, chunk=payload,
                 version=meta.version, object_size=meta.object_size,
+                hinfo=hraw or b"",
             )
         try:
             await self.messenger.send(tuple(msg.reply_to), reply)
@@ -2023,6 +2111,11 @@ class OSD:
                 need_backfill |= backfill
                 if backfill:
                     await self._maybe_request_pg_temp(pool, pg, acting)
+                elif (pool.pool_id, pg) not in self.osdmap.pg_temp:
+                    # fully recovered at the current acting set: the prior
+                    # interval is obsolete — keeping it would let a later
+                    # transient degradation reinstall ancient members
+                    self._prior_acting.pop((pool.pool_id, pg), None)
             except (ConnectionError, OSError, asyncio.TimeoutError):
                 need_backfill = True  # peer unreachable: sweep catches up
             except ErasureCodeError as e:
@@ -2138,20 +2231,13 @@ class OSD:
             pg = self.osdmap.object_to_pg(pool, oid)
             if pg not in temp_pgs or pg in incomplete:
                 continue
-            shards_at: Dict[int, Set[int]] = {}
-            for (shard, _osd, v) in locs:
-                shards_at.setdefault(v, set()).add(shard)
-            viable = [v for v, sh in shards_at.items() if len(sh) >= k_need]
-            if not viable:
+            got = self._newest_complete(locs, k_need)
+            if got is None:
                 incomplete.add(pg)
                 continue
-            newest = max(viable)
-            at_newest = {(shard, osd) for shard, osd, v in locs
-                         if v == newest}
-            for shard, osd in enumerate(self._raw_up(pool, pg)):
-                if osd != CRUSH_ITEM_NONE and (shard, osd) not in at_newest:
-                    incomplete.add(pg)
-                    break
+            _newest, at_newest = got
+            if self._missing_up_positions(pool, pg, at_newest):
+                incomplete.add(pg)
         for pg in temp_pgs:
             if pg in incomplete:
                 continue
@@ -2160,20 +2246,22 @@ class OSD:
                 await self._mon_rpc(
                     MOSDPGTemp(pool_id=pool.pool_id, pg=pg, acting=[],
                                from_osd=self.osd_id), MMapReply)
+                self._prior_acting.pop((pool.pool_id, pg), None)
             except Exception:
                 pass
 
     async def _recover_shard_subchunk(
         self, pool: PoolInfo, pg: int, oid: str, lost: int,
         holders: Dict[int, int], newest: int,
-    ) -> Optional[Tuple[bytes, int]]:
+    ) -> Optional[Tuple[bytes, int, bytes]]:
         """Bandwidth-efficient single-shard repair for sub-chunk codecs
         (CLAY): each helper ships only the repair sub-chunk byte ranges of
         its blob instead of whole chunks (reference fragmented helper
         reads ECBackend.cc:1049-1071 + ErasureCodeClay.cc:396
         repair_one_lost_chunk; the runs come from
         minimum_to_decode's SubChunkPlan).  Returns (shard_blob,
-        object_size) or None when the generic full-decode path must run.
+        object_size, hinfo_blob) or None when the generic full-decode path
+        must run.
         """
         codec = self._codec(pool)
         sinfo = self._sinfo(pool)
@@ -2189,13 +2277,16 @@ class OSD:
             return None  # plan is whole-chunk: no sub-chunk saving
         cs = sinfo.chunk_size
         sc_size = cs // sub
-        # stat one helper for the object extent -> stripe count
+        # stat one helper for the object extent -> stripe count (its stored
+        # hinfo record rides along for the push)
         stat_shard = next(iter(plan))
         stat = await self._sub_read_extents(pool, pg, oid, stat_shard,
-                                            holders[stat_shard], [(0, 0)])
+                                            holders[stat_shard], [(0, 0)],
+                                            want_hinfo=True)
         if stat is None or stat[2] != newest:
             return None
         object_size = stat[1]
+        helper_hinfo = stat[3]
         n_stripes = max(1, -(-object_size // sinfo.stripe_width))
         extents = [(s * cs + idx * sc_size, cnt * sc_size)
                    for s in range(n_stripes) for (idx, cnt) in runs]
@@ -2216,34 +2307,90 @@ class OSD:
             }
             decoded = codec.decode({lost}, stripe_chunks, cs)
             out.append(bytes(decoded[lost]))
-        return b"".join(out), object_size
+        blob = b"".join(out)
+        # ship the helper's hinfo record with the push only when it is
+        # clean AND agrees with the reconstruction; otherwise the push
+        # carries none and the target dirties its own entry
+        hinfo_blob = b""
+        if helper_hinfo:
+            try:
+                h = HashInfo.decode(helper_hinfo)
+                if (not h.dirty and lost < len(h.crcs)
+                        and h.crcs[lost] == shard_crc(blob)):
+                    hinfo_blob = helper_hinfo
+            except Exception:
+                pass
+        return blob, object_size, hinfo_blob
 
     async def _sub_read_extents(
         self, pool: PoolInfo, pg: int, oid: str, shard: int, osd: int,
-        extents: List[Tuple[int, int]],
-    ) -> Optional[Tuple[bytes, int, int]]:
-        """One extent sub-read -> (bytes, object_size, version) or None."""
+        extents: List[Tuple[int, int]], want_hinfo: bool = False,
+    ) -> Optional[Tuple[bytes, int, int, bytes]]:
+        """One extent sub-read -> (bytes, object_size, version, hinfo) or
+        None.  hinfo is only fetched/shipped when want_hinfo is set (the
+        once-per-recovery stat probe) — hot-path stripe-RMW sub-reads skip
+        the xattr lookup and the extra wire bytes."""
         if osd == self.osd_id:
             got = self._store_read((pool.pool_id, oid, shard))
             if got is None:
                 return None
             blob, meta = got
             payload = b"".join(bytes(blob[o:o + l]) for o, l in extents)
-            return payload, meta.object_size, meta.version
+            hraw = None
+            if want_hinfo:
+                try:
+                    hraw = self.store.getattr((pool.pool_id, oid, shard),
+                                              HashInfo.XATTR_KEY)
+                except NotImplementedError:
+                    pass
+            return payload, meta.object_size, meta.version, hraw or b""
         tid = uuid.uuid4().hex
         q = self._collector(tid)
         try:
             await self.messenger.send(
                 self.osdmap.addr_of(osd),
                 MECSubRead(pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard,
-                           tid=tid, reply_to=self.addr, extents=extents))
+                           tid=tid, reply_to=self.addr, extents=extents,
+                           want_hinfo=want_hinfo))
         except Exception:
             self._collectors.pop(tid, None)
             return None
         for r in await self._gather(tid, q, 1, timeout=2.0):
             if r.ok:
-                return r.chunk, r.object_size, r.version
+                return r.chunk, r.object_size, r.version, getattr(r, "hinfo", b"")
         return None
+
+    @staticmethod
+    def _newest_complete(
+        locs: Set[Tuple[int, int, int]], k_need: int,
+    ) -> Optional[Tuple[int, Set[Tuple[int, int]]]]:
+        """Newest COMPLETE version of one object's shard holdings: group
+        (shard, osd, version) triples by version, keep versions with at
+        least k_need distinct shards (decodable), and return (newest such
+        version, {(shard, osd)} holding it) — or None when nothing is
+        decodable.  Membership is by (shard, osd) pair: a shard may
+        legitimately live on several OSDs mid-backfill (old holder + new
+        target).  Shared by backfill push planning and pg_temp completion
+        so the two can never disagree about doneness."""
+        shards_at: Dict[int, Set[int]] = {}
+        for (shard, _osd, v) in locs:
+            shards_at.setdefault(v, set()).add(shard)
+        viable = [v for v, sh in shards_at.items() if len(sh) >= k_need]
+        if not viable:
+            return None
+        newest = max(viable)
+        return newest, {(shard, osd) for shard, osd, v in locs if v == newest}
+
+    def _missing_up_positions(
+        self, pool: PoolInfo, pg: int, at_newest: Set[Tuple[int, int]],
+    ) -> List[Tuple[int, int]]:
+        """Up-set positions (shard, osd) not holding the newest complete
+        version — the push targets backfill must fill."""
+        return [
+            (shard, osd)
+            for shard, osd in enumerate(self._raw_up(pool, pg))
+            if osd != CRUSH_ITEM_NONE and (shard, osd) not in at_newest
+        ]
 
     async def _backfill_pool(
         self, pool: PoolInfo,
@@ -2262,13 +2409,10 @@ class OSD:
             # newest COMPLETE version wins; shards newer than it are
             # uncommitted leftovers of a failed write -> roll them back
             # (reference divergent-entry rollback, ECBackend rollback)
-            shards_at: Dict[int, Set[int]] = {}
-            for (shard, _, v) in locs:
-                shards_at.setdefault(v, set()).add(shard)
-            viable = [v for v, sh in shards_at.items() if len(sh) >= k_need]
-            if not viable:
+            got = self._newest_complete(locs, k_need)
+            if got is None:
                 continue
-            newest = max(viable)
+            newest, at_newest = got
             for shard, osd, v in locs:
                 if v > newest:
                     try:
@@ -2279,18 +2423,10 @@ class OSD:
                                          reply_to=self.addr))
                     except Exception:
                         pass
-            # membership by (shard, osd) pair: a shard may legitimately
-            # live on several OSDs mid-backfill (old holder + new target)
-            at_newest = {(shard, osd) for shard, osd, v in locs
-                         if v == newest}
             # push targets are the UP-SET positions: identical to acting
             # normally, but under pg_temp the override serves IO while
             # backfill fills the crush-mapped members
-            missing = [
-                (shard, osd)
-                for shard, osd in enumerate(self._raw_up(pool, pg))
-                if osd != CRUSH_ITEM_NONE and (shard, osd) not in at_newest
-            ]
+            missing = self._missing_up_positions(pool, pg, at_newest)
             if not missing:
                 continue
             if len(missing) == 1 and pool.pool_type == "ec":
@@ -2302,11 +2438,12 @@ class OSD:
                 got = await self._recover_shard_subchunk(
                     pool, pg, oid, lost, hold, newest)
                 if got is not None:
-                    blob, osize = got
+                    blob, osize, sub_hinfo = got
                     push = MPushShard(
                         pool_id=pool.pool_id, pg=pg, oid=oid, shard=lost,
                         chunk=blob, version=newest, object_size=osize,
-                        xattrs=self._cls_xattrs(pool.pool_id, oid))
+                        xattrs=self._cls_xattrs(pool.pool_id, oid),
+                        hinfo=sub_hinfo)
                     if target == self.osd_id:
                         self._apply_push(push)
                     else:

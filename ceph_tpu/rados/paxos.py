@@ -277,9 +277,12 @@ class Paxos:
         in-flight proposal (the reference leader bootstraps on seeing a
         higher pn).  A nack at or below our CURRENT proposal epoch is a
         stale packet from an older round — a single delayed frame must not
-        tear down a healthy re-elected leadership — and is ignored.
+        tear down a healthy re-elected leadership — and is ignored.  The
+        floor includes promised_epoch: a leadership we already promised
+        (e.g. the election we just won, before the first propose() stamps
+        self.epoch) is not news and must not depose us either.
         Returns True when the nack actually deposed us."""
-        if epoch <= self.epoch:
+        if epoch <= max(self.epoch, self.promised_epoch):
             return False
         self.nacked = True
         self.promised_epoch = max(self.promised_epoch, epoch)
@@ -306,6 +309,13 @@ class Paxos:
         if epoch < self.promised_epoch:
             return False
         self.promised_epoch = epoch
+        # Promising a NEWER leadership while our own proposal is in flight
+        # means we were deposed mid-round: abandon it, or the commit we
+        # send after gathering the remaining accepts would carry the new
+        # leader's epoch and land on its peons as a divergent value.
+        if self.proposing is not None and epoch > self.epoch:
+            self.proposing = None
+            self.nacked = True
         return True
 
     async def handle_begin(self, from_rank: int, version: int,
@@ -316,7 +326,10 @@ class Paxos:
                                         "epoch": self.promised_epoch})
             return
         if epoch is not None:
-            self.promised_epoch = epoch
+            # route through promise(): a begin from a NEWER leadership must
+            # also abandon any proposal WE have in flight (collect/victory
+            # frames can be lost; the begin may be the first we hear of it)
+            self.promise(epoch)
         self.pending = (version, value)
         await self.send(from_rank, {"op": "accept", "version": version,
                                     "epoch": epoch if epoch is not None
@@ -326,6 +339,8 @@ class Paxos:
                       epoch: Optional[int] = None) -> None:
         if epoch is not None and epoch < self.promised_epoch:
             return  # a deposed leader's commit must not land
+        if epoch is not None:
+            self.promise(epoch)  # same deposition semantics as handle_begin
         if self.pending and self.pending[0] == version:
             self.pending = None
         if version > self.store.last_committed:

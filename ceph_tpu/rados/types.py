@@ -400,7 +400,7 @@ class MECSubWriteReply:
     ok: bool = True
 
 
-@message(32, version=2)
+@message(32, version=3)
 class MECSubRead:
     pool_id: int = 0
     pg: int = 0
@@ -413,9 +413,12 @@ class MECSubRead:
     # sub-chunk recovery reads (reference ECMsgTypes.h:105 to_read lists,
     # ECBackend.cc:1049-1071 CLAY helper reads).
     extents: List[Tuple[int, int]] = field(default_factory=list)
+    # attach the stored hinfo record to the reply (recovery stat probes
+    # only — hot-path sub-reads skip the xattr lookup + wire bytes)
+    want_hinfo: bool = False
 
 
-@message(33, version=2)
+@message(33, version=3)
 class MECSubReadReply:
     tid: str = ""
     shard: int = 0
@@ -423,6 +426,10 @@ class MECSubReadReply:
     chunk: bytes = b""  # whole blob, or the requested extents concatenated
     version: int = 0
     object_size: int = 0
+    # stored hinfo_key record (all-shard cumulative crcs): lets sub-chunk
+    # recovery ship a correct HashInfo with its push instead of leaving the
+    # target's stale record to fail the next deep scrub
+    hinfo: bytes = b""
 
 
 @message(34, version=2)

@@ -9,6 +9,10 @@ import os
 # Hard-set (not setdefault): the container env pins JAX_PLATFORMS=axon for
 # the real-TPU bench path; tests must never depend on the TPU tunnel.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Under full-suite load the default 30s backend probe can time out and pin
+# "unavailable" for the whole process, silently flipping plugin=tpu tests to
+# their CPU path.  The CPU backend always comes up; give it ample time.
+os.environ.setdefault("CEPH_TPU_PROBE_TIMEOUT", "300")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

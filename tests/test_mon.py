@@ -103,6 +103,44 @@ class TestPaxosEpochFencing:
 
         run(go())
 
+    def test_deposed_mid_round_leader_cannot_commit(self):
+        """A leader whose proposal is in flight when it promises a NEWER
+        leadership (victory/collect from the new leader) must abandon the
+        round: otherwise its commit would carry the new epoch and land on
+        the new leader's peons as a divergent value."""
+        async def go():
+            make, sent = self._paxos_pair()
+            leader = make(0)
+            await leader.propose(b"stale", {0, 1, 2}, epoch=2)
+            assert leader.handle_accept(1, leader.proposing[0], epoch=2)
+            # new leader wins at epoch 4; we promise it before committing
+            assert leader.promise(4)
+            assert leader.proposing is None and leader.nacked
+            # the depose-nack for our old round arrives late: already-known
+            # leadership, must not be treated as a fresh deposition
+            assert not leader.handle_nack(4)
+
+        run(go())
+
+    def test_stale_nack_ignored_after_rewin(self):
+        """A re-elected leader must not be torn down by a delayed nack from
+        the leadership it just superseded — even before its first propose()
+        stamps the new epoch (the promise() at victory sets the floor)."""
+        async def go():
+            make, _sent = self._paxos_pair()
+            leader = make(0)
+            await leader.propose(b"old", {0, 1, 2}, epoch=2)
+            assert leader.handle_nack(4)  # genuinely deposed by epoch 4
+            # we re-elect and win at epoch 6; promise() precedes propose()
+            assert leader.promise(6)
+            assert not leader.handle_nack(4), "stale nack must be ignored"
+            await leader.propose(b"new", {0, 1, 2}, epoch=6)
+            assert leader.handle_accept(1, leader.proposing[0], epoch=6)
+            # a genuine newer deposition still lands
+            assert leader.handle_nack(8)
+
+        run(go())
+
     def test_divergent_concurrent_commit_is_impossible(self):
         async def go():
             from ceph_tpu.rados.paxos import Paxos

@@ -10,6 +10,18 @@ from ceph_tpu.ec.registry import registry
 from tests.test_codecs import make, payload, roundtrip_exhaustive
 
 
+@pytest.fixture(autouse=True)
+def pinned_backend(monkeypatch):
+    """Pin the hang-proof backend probe to a live verdict so every test in
+    this file exercises the device dispatch seam deterministically (a probe
+    that timed out earlier in the suite would silently flip the plugin to
+    its CPU path and make these tests vacuous)."""
+    from ceph_tpu.utils import jaxdev
+
+    verdict = jaxdev._result if jaxdev._result not in (None, jaxdev.UNAVAILABLE) else "cpu"
+    monkeypatch.setattr(jaxdev, "_result", verdict)
+
+
 @pytest.mark.parametrize(
     "profile",
     [
