@@ -364,7 +364,9 @@ class Monitor:
             live = [a for a in acting
                     if a != CRUSH_ITEM_NONE and self.osdmap.osds.get(a)
                     and self.osdmap.osds[a].up]
-            if not live:
+            if len(live) < pool.min_size:
+                # an override that cannot serve IO is strictly worse than
+                # the crush mapping it hides: drop it
                 dead.append(key)
         if dead:
             for key in dead:

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import asyncio
 import pickle
+import random
 import time
 import uuid
 import zlib
@@ -49,6 +50,19 @@ from ceph_tpu.rados.crush import CRUSH_ITEM_NONE
 from ceph_tpu.rados.ecutil import HashInfo, StripeInfo, batched_encode, decode_object
 from ceph_tpu.rados.messenger import Messenger
 from ceph_tpu.rados.monclient import MonTargets
+from ceph_tpu.rados.peering import (
+    ACTIVE,
+    BACKFILLING,
+    CLEAN,
+    GET_INFO,
+    GET_LOG,
+    GET_MISSING,
+    RECOVERING,
+    WAIT_LOCAL_RESERVE,
+    WAIT_REMOTE_RESERVE,
+    PGMachine,
+    ReservationSlots,
+)
 from ceph_tpu.rados.pglog import ZERO, LogEntry, PGLog, pack_eversion
 from ceph_tpu.rados.scheduler import (
     CLASS_BEST_EFFORT,
@@ -58,6 +72,9 @@ from ceph_tpu.rados.scheduler import (
 )
 from ceph_tpu.rados.store import MemStore, ObjectStore, ShardMeta, Transaction, shard_crc
 from ceph_tpu.rados.types import (
+    MBackfillReserve,
+    MBackfillReserveReply,
+    MECSubRollback,
     MBootReply,
     MGetMap,
     MECSubDelete,
@@ -142,6 +159,9 @@ class OSD:
             .add_u64_counter("recovery_subchunk_bytes",
                              "helper bytes read by sub-chunk repair")
             .add_u64_counter("recovery_push", "recovery shards pushed")
+            .add_u64_counter("stray_purged", "stray shards purged after backfill")
+            .add_u64_counter("unfound_reverted",
+                             "shards reverted to rollback slots (unfound)")
             .add_u64_counter("recovery_errors", "repair rounds that errored")
             .add_u64_counter("op_queued", "ops entering the sharded queue")
             .add_u64_counter("op_dequeued", "ops drained")
@@ -178,6 +198,24 @@ class OSD:
         # pg_temp request points the mon at when a remapped PG needs
         # backfill (the data lives with the prior interval's members)
         self._prior_acting: Dict[Tuple[int, int], List[int]] = {}
+        # peering statecharts for PGs this OSD leads (reference
+        # PeeringState machine per PG) + reservation throttles bounding
+        # concurrent recovery (reference local/remote AsyncReserver,
+        # osd_max_backfills) + per-PG membership history since the PG was
+        # last clean (past_intervals role: the OSDs that may hold shards,
+        # the scope set for deletes/hunts/backfill instead of O(cluster)
+        # broadcasts)
+        self._pg_machines: Dict[Tuple[int, int], PGMachine] = {}
+        # default 4 (reference defaults to 1, but its recovery pipeline is
+        # object-granular and overlaps with IO; our per-PG sweep is
+        # coarser, so a 1-slot default starves replenishment under churn)
+        max_backfills = int(self.conf.get("osd_max_backfills", 4) or 1)
+        self._local_reserver = ReservationSlots(max_backfills)
+        self._remote_reserver = ReservationSlots(max_backfills)
+        self._past_members: Dict[Tuple[int, int], Set[int]] = {}
+        # (oid, version) pairs observed partial-above-newest-complete in a
+        # COMPLETE listing, per PG: confirmed again next pass => revert
+        self._partial_newer: Dict[Tuple[int, int], Set[Tuple[str, int]]] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -197,8 +235,9 @@ class OSD:
             raise RuntimeError("mon refused boot (no quorum?)")
         self.osd_id = reply.osd_id
         self.messenger.name = f"osd.{self.osd_id}"
-        self.osdmap = reply.osdmap
-        # centralized config distributed at boot (ConfigMonitor role)
+        # centralized config distributed at boot (ConfigMonitor role);
+        # merged BEFORE the boot-time peering kick below so cluster-wide
+        # settings (osd_auto_repair, repair delays) govern it
         cluster_conf = getattr(reply, "cluster_conf", None)
         if cluster_conf:
             if hasattr(self.conf, "set"):
@@ -211,6 +250,12 @@ class OSD:
             else:
                 for k, v in cluster_conf.items():
                     self.conf.setdefault(k, v)
+        # through _on_map, NOT direct assignment: a freshly added OSD can
+        # already be primary of remapped PGs (crush reshuffles on boot),
+        # and those PGs need their peering kicked NOW — waiting for the
+        # next epoch that happens to touch them leaves them driverless
+        # while the old holders keep failing
+        self._on_map(reply.osdmap)
         interval = self.conf.get("osd_heartbeat_interval", 0.3)
         loop = asyncio.get_running_loop()
         self._ping_task = loop.create_task(self._ping_loop(interval))
@@ -237,6 +282,9 @@ class OSD:
         for t in (self._ping_task, self._hb_task, self._repair_task):
             if t:
                 t.cancel()
+        for m in self._pg_machines.values():
+            if m.task is not None:
+                m.task.cancel()
         await self.op_queue.stop()
         await self.ctx.shutdown()
         await self.messenger.shutdown()
@@ -467,6 +515,10 @@ class OSD:
             await self._handle_pg_log_req(msg)
         elif isinstance(msg, MScrubShard):
             await self._handle_scrub_shard(msg)
+        elif isinstance(msg, MBackfillReserve):
+            await self._handle_backfill_reserve(msg)
+        elif isinstance(msg, MECSubRollback):
+            self._handle_sub_rollback(msg)
         elif isinstance(msg, MNotifyAck):
             q = self._collectors.get(msg.notify_id)
             if q is not None:
@@ -492,7 +544,7 @@ class OSD:
         elif isinstance(
             msg, (MECSubWriteReply, MECSubReadReply, MListShardsReply,
                   MFetchShardsReply, MPGInfoReply, MPGLogReply,
-                  MScrubShardReply)
+                  MScrubShardReply, MBackfillReserveReply)
         ):
             q = self._collectors.get(msg.tid)
             if q is not None:
@@ -508,12 +560,16 @@ class OSD:
         old = self.osdmap
         if old is not None and osdmap.epoch <= old.epoch:
             return
+        changed_pgs: List[Tuple[PoolInfo, int]] = []
         if old is not None and self._mapping_inputs_changed(old, osdmap):
             # remember the outgoing interval's acting set for PGs whose
             # mapping changed (past_intervals role): it is the set a
-            # pg_temp request must name during backfill.  The dual-CRUSH
-            # scan only runs when a mapping INPUT changed (osd states,
-            # weights, pools, pg_temp, crush) — config-only epochs skip it.
+            # pg_temp request must name during backfill, and its members
+            # accumulate in _past_members (the scope set for deletes,
+            # shard hunts and backfill until the PG is clean again).  The
+            # dual-CRUSH scan only runs when a mapping INPUT changed (osd
+            # states, weights, pools, pg_temp, crush) — config-only
+            # epochs skip it.
             for pool in osdmap.pools.values():
                 old_pool = old.pools.get(pool.pool_id)
                 if old_pool is None:
@@ -523,6 +579,9 @@ class OSD:
                     oa = old.pg_to_acting(old_pool, pg)
                     if oa == osdmap.pg_to_acting(pool, pg):
                         continue
+                    changed_pgs.append((pool, pg))
+                    self._past_members.setdefault(key, set()).update(
+                        a for a in oa if a != CRUSH_ITEM_NONE)
                     if key in old.pg_temp and key not in osdmap.pg_temp:
                         # the override was CLEARED: backfill to the crush
                         # set completed, so the outgoing acting (the
@@ -533,9 +592,14 @@ class OSD:
                     else:
                         self._prior_acting[key] = oa
             # prune intervals of deleted pools (bounded memory)
-            for key in [k for k in self._prior_acting
-                        if k[0] not in osdmap.pools]:
-                self._prior_acting.pop(key, None)
+            for d in (self._prior_acting, self._past_members,
+                      self._pg_machines, self._partial_newer):
+                for key in [k for k in d if k[0] not in osdmap.pools]:
+                    d.pop(key, None)
+        elif old is None:
+            # first map: every PG we lead needs an initial peering pass
+            changed_pgs = [(pool, pg) for pool in osdmap.pools.values()
+                           for pg in range(pool.pg_num)]
         self.osdmap = osdmap
         # primaryship may have moved: cached decodes can silently go stale
         # across an interval we didn't serve (ExtentCache is per-interval)
@@ -548,11 +612,14 @@ class OSD:
             if new_pool is None or old_pool is None or new_pool.profile != old_pool.profile:
                 self._codecs.pop(pool_id, None)
                 self._sinfos.pop(pool_id, None)
+        # event-driven recovery (reference AdvMap/ActMap): kick the peering
+        # statechart for exactly the PGs whose mapping changed — repair
+        # traffic for one failed OSD touches only that OSD's PGs
         if self.conf.get("osd_auto_repair", True):
-            if self._repair_task is None or self._repair_task.done():
-                self._repair_task = asyncio.get_running_loop().create_task(
-                    self._delayed_repair()
-                )
+            for pool, pg in changed_pgs:
+                acting = osdmap.pg_to_acting(pool, pg)
+                if self._primary(pool, pg, acting) == self.osd_id:
+                    self._kick_peering(pool, pg, acting)
 
     @staticmethod
     def _mapping_inputs_changed(old: OSDMap, new: OSDMap) -> bool:
@@ -574,13 +641,429 @@ class OSD:
             for i, o in old.osds.items()
         )
 
-    async def _delayed_repair(self) -> None:
-        await asyncio.sleep(self.conf.get("osd_repair_delay", 0.5))
+    def _machine(self, pool_id: int, pg: int) -> PGMachine:
+        key = (pool_id, pg)
+        m = self._pg_machines.get(key)
+        if m is None:
+            m = self._pg_machines[key] = PGMachine(pool_id, pg)
+        return m
+
+    def _kick_peering(self, pool: PoolInfo, pg: int,
+                      acting: List[int]) -> None:
+        """Open a new interval on the PG's statechart and (re)start its
+        peering task.  A task already running for an older interval keeps
+        running but aborts at its next is_stale check."""
+        m = self._machine(pool.pool_id, pg)
+        if not m.new_interval(self.osdmap.epoch, acting):
+            return
+        if m.task is not None and not m.task.done():
+            # the running pass belongs to a dead interval and may be
+            # blocked in a multi-second gather against a zombie peer —
+            # cancel it NOW; waiting for its next staleness check would
+            # delay recovery past the next failure
+            m.task.cancel()
+        m.task = asyncio.get_running_loop().create_task(
+            self._run_peering(pool.pool_id, pg))
+
+    def _kick_recovery(self, pool: PoolInfo, pg: int) -> None:
+        """Restart the PG's peering task WITHOUT an interval change — used
+        when a write completes degraded (a member missed its sub-write):
+        the pass re-peers, computes the peer's missing set from the log,
+        and re-pushes promptly (the reference's write-time missing-set
+        update)."""
+        if not self.conf.get("osd_auto_repair", True):
+            return
+        m = self._machine(pool.pool_id, pg)
+        if m.task is None or m.task.done():
+            m.task = asyncio.get_running_loop().create_task(
+                self._run_peering(pool.pool_id, pg))
+
+    async def _run_peering(self, pool_id: int, pg: int) -> None:
+        """Walk one PG through the peering statechart:
+
+            GetInfo -> GetLog -> GetMissing -> Active
+              -> Recovering (missing-set-scoped pushes)      [local slot]
+              -> WaitLocal/RemoteBackfillReserved
+              -> Backfilling (per-PG scoped copy sweep)      [both slots]
+              -> Clean
+
+        (reference PeeringState.cc transitions; recovery runs off peering
+        events, not timers).  The loop re-enters GetInfo whenever the
+        interval advances underneath it."""
+        m = self._machine(pool_id, pg)
+        if any(a == CRUSH_ITEM_NONE for a in m.acting):
+            # degraded: every member of the acting set is load-bearing for
+            # redundancy — recover immediately, don't coalesce
+            await asyncio.sleep(0.05)
+        else:
+            await asyncio.sleep(self.conf.get("osd_repair_delay", 0.5))
+        delay = self.conf.get("osd_recovery_retry", 1.0)
+        while True:  # until Clean / deposed / stopped; backoff on retries
+            epoch = m.interval_epoch
+            pool = self.osdmap.pools.get(pool_id)
+            if pool is None or self._stopped:
+                return
+            acting = self.osdmap.pg_to_acting(pool, pg)
+            if self._primary(pool, pg, acting) != self.osd_id:
+                return  # not ours this interval
+            try:
+                done, _pushed = await self._peer_and_recover_pg(
+                    m, pool, pg, acting)
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                done = False
+            except ErasureCodeError as e:
+                self.perf.inc("recovery_errors")
+                self.ctx.log.error(
+                    "osd", f"peering pg {pool_id}.{pg} codec error: {e}")
+                return
+            except Exception as e:
+                self.perf.inc("recovery_errors")
+                self.ctx.log.error(
+                    "osd",
+                    f"peering pg {pool_id}.{pg}: {type(e).__name__}: {e}")
+                done = False
+            if done and not m.is_stale(epoch):
+                return
+            if m.is_stale(epoch):
+                delay = self.conf.get("osd_recovery_retry", 1.0)
+                continue  # interval advanced: re-peer immediately
+            if m.reserve_blocked:
+                # a reservation was refused, not a verification failure:
+                # slots free in O(one backfill) — retry quickly, with
+                # jitter so colliding primaries don't re-collide forever
+                await asyncio.sleep(0.15 + 0.2 * random.random())
+                continue
+            await asyncio.sleep(delay)
+            delay = min(delay * 1.6, 15.0)
+
+    async def _peer_and_recover_pg(self, m: PGMachine, pool: PoolInfo,
+                                   pg: int, acting: List[int],
+                                   force_backfill: bool = False,
+                                   reset_interval: bool = False,
+                                   ) -> Tuple[bool, int]:
+        """One full statechart pass for one PG.  Returns (clean, pushed):
+        clean=True when the PG reached Clean (or needed nothing) this
+        interval.  ``force_backfill`` runs the copy sweep even when the
+        logs agree — the admin repair path uses it to catch silently-lost
+        shards the logs cannot see.  ``reset_interval`` applies
+        new_interval under the machine lock (admin repair must not mutate
+        statechart state while the event-driven task is mid-pass)."""
+        async with m.lock:
+            if reset_interval:
+                m.new_interval(self.osdmap.epoch, acting)
+            return await self._peer_and_recover_pg_locked(
+                m, pool, pg, acting, force_backfill)
+
+    async def _peer_and_recover_pg_locked(
+        self, m: PGMachine, pool: PoolInfo, pg: int,
+        acting: List[int], force_backfill: bool = False,
+    ) -> Tuple[bool, int]:
+        epoch = m.interval_epoch
+        key = (pool.pool_id, pg)
+        log = self._pglog(pool.pool_id, pg)
+        pushed = 0
+        # -- GetInfo: every acting peer's last_update ------------------------
+        m.transition(GET_INFO)
+        infos, backfill = await self._peer_pg(pool, pg, acting)
+        if m.is_stale(epoch):
+            return False, pushed
+        m.peer_info = dict(infos)
+        # an acting member that did not answer GetInfo (lost frame, boot
+        # race) is INVISIBLE, not absent: we cannot know what it lacks, so
+        # the pass can neither skip it nor declare Clean — route it through
+        # backfill (whose holdings listing retries it) and verify later
+        live_acting = {a for a in acting if a != CRUSH_ITEM_NONE}
+        if not live_acting <= set(infos):
+            backfill = True
+        # -- GetLog: adopt from peers AHEAD of us ----------------------------
+        m.transition(GET_LOG)
+        pulled = await self._pull_log_from_ahead(pool, pg, infos, log)
+        backfill |= pulled
+        if m.is_stale(epoch):
+            return False, pushed
+        # -- GetMissing: per-peer missing sets from the log ------------------
+        m.transition(GET_MISSING)
+        m.missing = {}
+        for osd, last in infos.items():
+            if osd == self.osd_id or last >= log.head:
+                continue
+            miss = log.calc_missing(last)
+            if miss is None:
+                backfill = True  # log window can't bridge: needs backfill
+            elif miss:
+                m.missing[osd] = miss
+        # -- Active ----------------------------------------------------------
+        m.transition(ACTIVE)
+        if m.missing:
+            m.transition(RECOVERING)
+            got_slot = await self._local_reserver.acquire(
+                key, priority=1, timeout=10.0)
+            try:
+                if m.is_stale(epoch):
+                    return False, pushed
+                pushed += await self._push_missing(pool, pg, acting, m.missing,
+                                                   log)
+            finally:
+                if got_slot:
+                    self._local_reserver.release(key)
+            m.transition(ACTIVE)
+        if m.is_stale(epoch):
+            return False, pushed
+        # an active override means the crush up-set still needs filling —
+        # the override primary (us) drives that backfill even though its
+        # own acting peers are all caught up
+        backfill |= bool(self.osdmap.pg_temp.get(key)) or force_backfill
+        # the mapping changed since this PG was last clean: a surviving
+        # member may have MOVED POSITION (it holds shard i, now serves
+        # shard j) — its log is current, so log recovery skips it, but its
+        # data is wrong for its seat.  Only the backfill sweep compares
+        # data-at-position; run it until a verified-clean pass pops the
+        # interval record.
+        backfill |= key in self._prior_acting
+        covered = True
+        if backfill:
+            await self._maybe_request_pg_temp(pool, pg, acting)
+            if m.is_stale(epoch):
+                # installing the override changed the mapping: the next
+                # round (as override primary, possibly another OSD) drives
+                # the backfill
+                return False, pushed
+            ran, bf_pushed, covered = await self._reserved_backfill(
+                m, pool, pg)
+            pushed += bf_pushed
+            if not ran or m.is_stale(epoch):
+                return False, pushed
+        # -- Clean -----------------------------------------------------------
+        # Clean requires a VERIFIED no-op pass: pushes are fire-and-forget,
+        # so a pass that pushed anything (or saw an unanswered peer, or
+        # found uncovered up-set positions) only made progress — the retry
+        # loop re-peers and Clean is declared when a full pass finds
+        # nothing left to do.  Declaring Clean optimistically would drop
+        # the interval history (_past_members) while data is still in
+        # flight, and the next failure could land before it ever arrived.
+        if pushed or not covered:
+            return False, pushed
+        if self.osdmap.pg_temp.get(key):
+            await self._clear_done_pg_temps(pool, pushed, None)
+            if self.osdmap.pg_temp.get(key):
+                return False, pushed  # override still serving: not clean
+        if m.is_stale(epoch):
+            return False, pushed  # interval moved while we verified
+        m.transition(CLEAN)
+        self._past_members.pop(key, None)
+        self._prior_acting.pop(key, None)
+        return True, pushed
+
+    async def _pull_log_from_ahead(self, pool: PoolInfo, pg: int,
+                                   infos: Dict[int, Tuple[int, int]],
+                                   log: PGLog) -> bool:
+        """GetLog role: pull entries from the furthest-ahead peer and adopt
+        them (with divergent-entry rollback).  Returns True when objects
+        were adopted (their shards need resync = backfill)."""
+        ahead = [(osd, v) for osd, v in infos.items() if v > log.head]
+        adopted = False
+        for osd, _v in sorted(ahead, key=lambda t: t[1], reverse=True)[:1]:
+            tid = uuid.uuid4().hex
+            q = self._collector(tid)
+            try:
+                await self.messenger.send(
+                    self.osdmap.addr_of(osd),
+                    MPGLogReq(pool_id=pool.pool_id, pg=pg, since=log.head,
+                              tid=tid, reply_to=self.addr))
+            except Exception:
+                continue
+            for r in await self._gather(tid, q, 1, timeout=0.8):
+                if r.backfill:
+                    adopted = True
+                    continue
+                entries = []
+                for blob in r.entries:
+                    e = LogEntry.decode(blob)
+                    e.version = tuple(e.version)
+                    e.prior_version = tuple(e.prior_version)
+                    entries.append(e)
+                merged = await self._merge_log_entries(pool.pool_id, pg,
+                                                       entries)
+                if merged:
+                    adopted = True
+        return adopted
+
+    async def _push_missing(self, pool: PoolInfo, pg: int,
+                            acting: List[int],
+                            missing: Dict[int, Dict[str, LogEntry]],
+                            log: PGLog) -> int:
+        """Recovering role: push exactly the objects each lagging peer's
+        log says it lacks (missing-set-scoped, reference PGLog missing),
+        then advance the peer's log."""
+        pushed = 0
+        for osd, miss in missing.items():
+            shard_of_peer = None
+            for shard, a in enumerate(acting):
+                if a == osd:
+                    shard_of_peer = shard
+                    break
+            for oid, entry in miss.items():
+                if entry.op == "delete":
+                    try:
+                        await self.messenger.send(
+                            self.osdmap.addr_of(osd),
+                            MECSubDelete(pool_id=pool.pool_id, pg=pg, oid=oid,
+                                         shard=-1, tid="", reply_to=self.addr))
+                        pushed += 1
+                    except Exception:
+                        pass
+                    continue
+                if shard_of_peer is None:
+                    continue
+                read = await self._do_read(
+                    MOSDOp(op="read", pool_id=pool.pool_id, oid=oid))
+                if not read.ok:
+                    continue
+                encoded = self._encode_for(pool, read.data)
+                push = MPushShard(
+                    pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard_of_peer,
+                    chunk=bytes(encoded[shard_of_peer]), version=read.version,
+                    object_size=len(read.data),
+                    hinfo=self._hinfo_for(pool, encoded))
+                try:
+                    await self.messenger.send(self.osdmap.addr_of(osd), push)
+                    pushed += 1
+                except Exception:
+                    pass
+            # the peer now holds the objects: advance its log so the next
+            # GetInfo round sees it caught up (and its dup set learns the
+            # replayed reqids)
+            last = self._machine(pool.pool_id, pg).peer_info.get(osd)
+            delta = log.entries_after(last) if last is not None else None
+            if delta:
+                await self._push_log_to_peer(pool.pool_id, pg, osd, delta)
+        return pushed
+
+    async def _reserved_backfill(self, m: PGMachine, pool: PoolInfo,
+                                 pg: int) -> Tuple[bool, int, bool]:
+        """Backfill under reservations: take a local slot, then a remote
+        slot on every backfill target, run the per-PG scoped copy sweep,
+        release everything.  Returns (ran, shards_pushed, fully_covered)."""
+        key = (pool.pool_id, pg)
+        epoch = m.interval_epoch
+        m.reserve_blocked = False
+        # a degraded PG (holes in the acting set) recovers redundancy, not
+        # placement: it outranks plain rebalancing in the slot queues
+        # (reference recovery-vs-backfill priority)
+        degraded = any(a == CRUSH_ITEM_NONE
+                       for a in self.osdmap.pg_to_acting(pool, pg))
+        m.transition(WAIT_LOCAL_RESERVE)
+        if not await self._local_reserver.acquire(
+                key, priority=2 if degraded else 0, timeout=15.0):
+            m.transition(ACTIVE)
+            m.reserve_blocked = True
+            return False, 0, False
+        targets: List[int] = []
+        granted: List[int] = []
         try:
-            for pool in list(self.osdmap.pools.values()):
-                await self.repair_pool(pool)
+            if m.is_stale(epoch):
+                return False, 0, False
+            m.transition(WAIT_REMOTE_RESERVE)
+            targets = sorted({
+                osd for osd in self._raw_up(pool, pg)
+                if osd != CRUSH_ITEM_NONE and osd != self.osd_id
+            })
+            m.backfill_targets = targets
+            # DEGRADED PGs skip remote reservations entirely: restoring
+            # redundancy is the one thing reservations must never delay
+            # (the reference throttles backfill, not degraded recovery —
+            # partial-grant livelock here would leave objects one failure
+            # from loss while primaries politely retry)
+            if not degraded:
+                for osd in targets:
+                    if await self._remote_reserve(pool.pool_id, pg, osd):
+                        granted.append(osd)
+                if len(granted) < len(targets):
+                    # partial grant: back off rather than hog slots
+                    m.transition(ACTIVE)
+                    m.reserve_blocked = True
+                    return False, 0, False
+            m.transition(BACKFILLING)
+            pushed, _holdings, covered = await self._backfill_pg(pool, pg)
+            m.transition(ACTIVE)
+            return True, pushed, covered
+        finally:
+            # local slot first and synchronously: this block can run under
+            # task cancellation, and the slot must never leak.
+            # _remote_release swallows its own transport errors.
+            self._local_reserver.release(key)
+            for osd in granted:
+                await self._remote_release(pool.pool_id, pg, osd)
+
+    async def _remote_reserve(self, pool_id: int, pg: int, osd: int) -> bool:
+        tid = uuid.uuid4().hex
+        q = self._collector(tid)
+        try:
+            await self.messenger.send(
+                self.osdmap.addr_of(osd),
+                MBackfillReserve(op="request", pool_id=pool_id, pg=pg,
+                                 from_osd=self.osd_id, tid=tid,
+                                 reply_to=self.addr))
+        except Exception:
+            self._collectors.pop(tid, None)
+            return False
+        for r in await self._gather(tid, q, 1, timeout=0.8):
+            return bool(r.ok)
+        return False
+
+    async def _remote_release(self, pool_id: int, pg: int, osd: int) -> None:
+        try:
+            await self.messenger.send(
+                self.osdmap.addr_of(osd),
+                MBackfillReserve(op="release", pool_id=pool_id, pg=pg,
+                                 from_osd=self.osd_id))
         except Exception:
             pass
+
+    def _handle_sub_rollback(self, msg: MECSubRollback) -> None:
+        """Revert one shard to its rollback slot (primary-confirmed the
+        newer version is unrecoverable cluster-wide).  With no PREV copy,
+        drop the orphaned shard — it can never decode and its version
+        guard would hold the seat hostage against restore pushes."""
+        key = (msg.pool_id, msg.oid, msg.shard)
+        cur = self._store_read(key)
+        if cur is None or cur[1].version != msg.bad_version:
+            return  # already moved on
+        prev_key = (msg.pool_id, msg.oid, msg.shard + PREV_SLOT)
+        prev = self._store_read(prev_key)
+        txn = Transaction()
+        if prev is not None:
+            txn.write(key, prev[0], prev[1])
+            txn.delete(prev_key)
+        else:
+            txn.delete(key)
+        self._cache_drop(msg.pool_id, msg.oid)
+        self.store.queue_transaction(txn)
+        self.perf.inc("unfound_reverted")
+
+    async def _handle_backfill_reserve(self, msg: MBackfillReserve) -> None:
+        key = (msg.pool_id, msg.pg)
+        if msg.op == "release":
+            self._remote_reserver.release(key)
+            return
+        was_held = key in self._remote_reserver.held
+        ok = self._remote_reserver.try_acquire(key)
+        try:
+            await self.messenger.send(
+                tuple(msg.reply_to),
+                MBackfillReserveReply(tid=msg.tid, osd_id=self.osd_id, ok=ok))
+        except Exception:
+            # only roll back a slot THIS request took: a duplicate request
+            # for an already-held key must not free the real holder's slot
+            if ok and not was_held:
+                self._remote_reserver.release(key)
+
+    def dump_peering(self) -> List[Dict[str, object]]:
+        """Admin-socket hook: every PG statechart + reservation state."""
+        out = [m.dump() for m in self._pg_machines.values()]
+        out.append({"local_reserver": self._local_reserver.dump(),
+                    "remote_reserver": self._remote_reserver.dump()})
+        return out
 
     # -- sub-op RPC plumbing -------------------------------------------------
 
@@ -890,6 +1373,12 @@ class OSD:
             return MOSDOpReply(
                 ok=False, error=f"write acked by {acks} < min_size {pool.min_size}"
             )
+        if acks < len(live):
+            # acked but DEGRADED: a member missed its sub-write (lost
+            # frame, refused splice).  The reference marks it missing and
+            # recovers promptly; waiting for the next interval change
+            # would leave the object one failure from loss
+            self._kick_recovery(pool, pg)
         if full_for_cache is not None:
             self._cache_put(op.pool_id, op.oid, version, full_for_cache)
         else:
@@ -1012,7 +1501,10 @@ class OSD:
         try:
             plan = codec.minimum_to_decode(want, set(available))
         except ErasureCodeError:
-            return MOSDOpReply(ok=False, error="not enough shards up")
+            # fewer than k live ACTING members (e.g. a pg_temp override
+            # whose members died): the data may still exist on past
+            # holders — fall through to the shard hunt instead of failing
+            plan = []
         tid = uuid.uuid4().hex
         chunks: Dict[int, bytes] = {}
         versions: Dict[int, int] = {}
@@ -1053,21 +1545,30 @@ class OSD:
         newest = max(versions.values()) if versions else -1
         complete = {s: c for s, c in chunks.items() if versions[s] == newest}
         if len(complete) < k:
-            # shard hunt across ALL up OSDs: shards carry their id, so a
-            # degraded read survives placement drift between failure and
-            # recovery (send_all_remaining_reads + missing-set role)
-            hunted = await self._fetch_all_shards(op.pool_id, op.oid)
+            # shard hunt: shards carry their id, so a degraded read
+            # survives placement drift between failure and recovery
+            # (send_all_remaining_reads + missing-set role).  Scoped to
+            # the PG's possible holders first; if that cannot assemble k
+            # shards (purge/bookkeeping messages can be lost under churn)
+            # retry once as a cluster-wide broadcast before failing.
+            viable: List[int] = []
             by_version: Dict[int, Dict[int, Tuple[bytes, int]]] = {}
-            for s_, c_ in chunks.items():
-                by_version.setdefault(versions[s_], {})[s_] = (c_, sizes[s_])
-            for shard, chunk, version, osize in hunted:
-                if shard in exclude_shards:
-                    continue
-                by_version.setdefault(version, {}).setdefault(
-                    shard, (chunk, osize))
+            for broadcast in (False, True):
+                hunted = await self._fetch_all_shards(op.pool_id, op.oid,
+                                                      broadcast=broadcast)
+                by_version = {}
+                for s_, c_ in chunks.items():
+                    by_version.setdefault(versions[s_], {})[s_] = (c_, sizes[s_])
+                for shard, chunk, version, osize in hunted:
+                    if shard in exclude_shards:
+                        continue
+                    by_version.setdefault(version, {}).setdefault(
+                        shard, (chunk, osize))
+                viable = [v for v, m in by_version.items() if len(m) >= k]
+                if viable:
+                    break
             if not by_version:
                 return MOSDOpReply(ok=False, error="object not found")
-            viable = [v for v, m in by_version.items() if len(m) >= k]
             if not viable:
                 return MOSDOpReply(ok=False, error="cannot reconstruct: shards missing")
             newest = max(viable)
@@ -1167,6 +1668,8 @@ class OSD:
             self._mark_failed_write(op.reqid)
             return MOSDOpReply(
                 ok=False, error=f"write acked by {acks} < min_size {pool.min_size}")
+        if acks < len([a for a in acting if a != CRUSH_ITEM_NONE]):
+            self._kick_recovery(pool, pg)  # degraded write: recover now
         self._cache_put(op.pool_id, op.oid, version, data)
         return MOSDOpReply(ok=True)
 
@@ -1398,9 +1901,12 @@ class OSD:
                            data=str(best[1]).encode())
 
     async def _do_delete(self, op: MOSDOp) -> MOSDOpReply:
-        """Delete EVERY shard of the object on every up OSD, not just the
-        current acting positions — stray shards left by placement drift
-        would otherwise resurrect the object through the shard hunt."""
+        """Delete every shard of the object on the PG's possible holders
+        (acting + up-set + members of intervals since the PG was last
+        clean) — stray shards left by placement drift would otherwise
+        resurrect the object through the shard hunt.  The scope set, not a
+        cluster broadcast: OSDs outside it can only hold copies from
+        intervals that ended with a clean PG, and those were purged."""
         pool = self.osdmap.pools[op.pool_id]
         pg, acting = self._acting(pool, op.oid)
         log = self._pglog(op.pool_id, pg)
@@ -1421,22 +1927,20 @@ class OSD:
         self._log_in_txn(txn, op.pool_id, pg, entry)
         self.store.queue_transaction(txn)
         acting_set = {a for a in acting if a != CRUSH_ITEM_NONE}
-        peers = [
-            o for o in self.osdmap.osds.values() if o.up and o.osd_id != self.osd_id
-        ]
+        peers = [o for o in self._scope_osds(pool, pg) if o != self.osd_id]
         q = self._collector(tid)
         sent = 0
-        for o in peers:
+        for osd in peers:
             try:
                 # shard=-1: drop every shard of the oid (one message per
                 # peer); acting members also log the delete so their PG
                 # logs advance with the primary's
                 await self.messenger.send(
-                    o.addr,
+                    self.osdmap.addr_of(osd),
                     MECSubDelete(pool_id=op.pool_id, pg=pg, oid=op.oid,
                                  shard=-1, tid=tid, reply_to=self.addr,
                                  log_entry=entry_blob
-                                 if o.osd_id in acting_set else b""),
+                                 if osd in acting_set else b""),
                 )
                 sent += 1
             except Exception:
@@ -1626,8 +2130,15 @@ class OSD:
         except Exception:
             pass
 
-    async def _fetch_all_shards(self, pool_id: int, oid: str):
-        """Ask every up OSD for any shard of oid it holds; include our own."""
+    async def _fetch_all_shards(self, pool_id: int, oid: str,
+                                broadcast: bool = False):
+        """Shard hunt scoped to the object's PG: ask the PG's possible
+        holders (acting + up + past-interval members) for any shard of oid
+        they hold; include our own.  Not a cluster broadcast by default —
+        OSDs outside the scope set were purged of strays when their
+        interval closed; ``broadcast=True`` is the slow-path fallback for
+        when that bookkeeping was itself disrupted (lost purges under
+        socket failures)."""
         out = []
         for oid2, shard in self.store.list_objects(pool_id):
             if oid2 != oid:
@@ -1636,16 +2147,23 @@ class OSD:
             if got is not None:
                 out.append((shard % PREV_SLOT, got[0], got[1].version,
                             got[1].object_size))
-        peers = [
-            o for o in self.osdmap.osds.values() if o.up and o.osd_id != self.osd_id
-        ]
+        pool = self.osdmap.pools.get(pool_id)
+        if pool is None:
+            return out
+        pg = self.osdmap.object_to_pg(pool, oid)
+        if broadcast:
+            peers = [o.osd_id for o in self.osdmap.osds.values()
+                     if o.up and o.osd_id != self.osd_id]
+        else:
+            peers = [o for o in self._scope_osds(pool, pg)
+                     if o != self.osd_id]
         tid = uuid.uuid4().hex
         q = self._collector(tid)
         sent = 0
-        for o in peers:
+        for osd in peers:
             try:
                 await self.messenger.send(
-                    o.addr,
+                    self.osdmap.addr_of(osd),
                     MFetchShards(pool_id=pool_id, oid=oid, tid=tid, reply_to=self.addr),
                 )
                 sent += 1
@@ -1674,7 +2192,12 @@ class OSD:
 
     async def _handle_list_shards(self, msg: MListShards) -> None:
         entries = []
+        want_pg = getattr(msg, "pg", -1)
+        pool = self.osdmap.pools.get(msg.pool_id) if self.osdmap else None
         for oid, shard in self._list_pool_objects(msg.pool_id):
+            if (want_pg >= 0 and pool is not None
+                    and self.osdmap.object_to_pg(pool, oid) != want_pg):
+                continue
             got = self._store_read((msg.pool_id, oid, shard))
             if got is not None:
                 entries.append((oid, shard, got[1].version))
@@ -1687,6 +2210,14 @@ class OSD:
             pass
 
     def _apply_push(self, msg: MPushShard) -> None:
+        # a recovery push must never regress the object: the primary read
+        # and re-encoded at some version, but a client write may have
+        # landed here since — applying the stale push would bury the newer
+        # acked bytes in the rollback slot where the next write evicts
+        # them (the reference's recovery also refuses to move backward)
+        cur = self._store_read((msg.pool_id, msg.oid, msg.shard))
+        if cur is not None and cur[1].version > msg.version:
+            return
         self.perf.inc("recovery_push")
         self._cache_drop(msg.pool_id, msg.oid)
         self._apply_shard_write(
@@ -1712,7 +2243,9 @@ class OSD:
             await self.messenger.send(
                 tuple(msg.reply_to),
                 MPGInfoReply(tid=msg.tid, osd_id=self.osd_id,
-                             last_update=log.head, log_tail=log.tail),
+                             last_update=log.head, log_tail=log.tail,
+                             past_members=sorted(self._past_members.get(
+                                 (msg.pool_id, msg.pg), ()))),
             )
         except (ConnectionError, OSError):
             pass
@@ -1749,8 +2282,16 @@ class OSD:
             except Exception:
                 pass
         infos: Dict[int, Tuple[int, int]] = {self.osd_id: log.head}
-        for r in await self._gather(tid, q, sent, timeout=2.0):
+        # short timeout: one dropped frame must not stall the recovery
+        # window; the retry loop re-peers and lossless replay catches up
+        for r in await self._gather(tid, q, sent, timeout=0.8):
             infos[r.osd_id] = tuple(r.last_update)
+            peer_past = getattr(r, "past_members", None)
+            if peer_past:
+                # union interval history: a primary that missed intervals
+                # (down / newly added) inherits the scope its peers saw
+                self._past_members.setdefault(
+                    (pool.pool_id, pg), set()).update(peer_past)
         backfill = any(
             log.calc_missing(v) is None for v in infos.values()
         )
@@ -1800,92 +2341,6 @@ class OSD:
                             pg=pg, entries=[e.encode() for e in entries]))
         except Exception:
             pass
-
-    async def _log_recover_pg(self, pool: PoolInfo, pg: int,
-                              acting: List[int]) -> Tuple[int, bool]:
-        """Log-driven delta recovery (PGLog::calc_missing path): push only
-        objects a lagging peer's log says it is missing, then advance the
-        peer's log.  A peer AHEAD of us (it saw commits we missed) is
-        pulled from via MPGLogReq and its entries adopted.  Returns
-        (pushes, backfill_needed)."""
-        log = self._pglog(pool.pool_id, pg)
-        infos, backfill = await self._peer_pg(pool, pg, acting)
-        # peers AHEAD of us hold commits we missed: pull + adopt their log
-        ahead = [(osd, v) for osd, v in infos.items() if v > log.head]
-        for osd, _v in sorted(ahead, key=lambda t: t[1], reverse=True)[:1]:
-            tid = uuid.uuid4().hex
-            q = self._collector(tid)
-            try:
-                await self.messenger.send(
-                    self.osdmap.addr_of(osd),
-                    MPGLogReq(pool_id=pool.pool_id, pg=pg, since=log.head,
-                              tid=tid, reply_to=self.addr))
-            except Exception:
-                continue
-            for r in await self._gather(tid, q, 1, timeout=2.0):
-                if r.backfill:
-                    backfill = True
-                    continue
-                entries = []
-                for blob in r.entries:
-                    e = LogEntry.decode(blob)
-                    e.version = tuple(e.version)
-                    e.prior_version = tuple(e.prior_version)
-                    entries.append(e)
-                merged = await self._merge_log_entries(pool.pool_id, pg,
-                                                       entries)
-                # resync the objects those entries touch across the acting
-                # set (the shard data lives on the ahead peer)
-                if merged:
-                    backfill = True
-        pushed = 0
-        for osd, last in infos.items():
-            if osd == self.osd_id or last >= log.head:
-                continue
-            missing = log.calc_missing(last)
-            if missing is None:
-                backfill = True
-                continue
-            for oid, entry in missing.items():
-                shard_of_peer = None
-                for shard, a in enumerate(acting):
-                    if a == osd:
-                        shard_of_peer = shard
-                        break
-                if shard_of_peer is None:
-                    continue
-                if entry.op == "delete":
-                    try:
-                        await self.messenger.send(
-                            self.osdmap.addr_of(osd),
-                            MECSubDelete(pool_id=pool.pool_id, pg=pg, oid=oid,
-                                         shard=-1, tid="", reply_to=self.addr))
-                        pushed += 1
-                    except Exception:
-                        pass
-                    continue
-                read = await self._do_read(
-                    MOSDOp(op="read", pool_id=pool.pool_id, oid=oid))
-                if not read.ok:
-                    continue
-                encoded = self._encode_for(pool, read.data)
-                push = MPushShard(
-                    pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard_of_peer,
-                    chunk=bytes(encoded[shard_of_peer]), version=read.version,
-                    object_size=len(read.data),
-                    hinfo=self._hinfo_for(pool, encoded))
-                try:
-                    await self.messenger.send(self.osdmap.addr_of(osd), push)
-                    pushed += 1
-                except Exception:
-                    pass
-            # the peer now holds the objects: advance its log so the next
-            # GetInfo round sees it caught up (and its dup set learns the
-            # replayed reqids)
-            delta = log.entries_after(last)
-            if delta:
-                await self._push_log_to_peer(pool.pool_id, pg, osd, delta)
-        return pushed, backfill
 
     # -- scrub (be_deep_scrub role, ECBackend.cc:2530) -----------------------
 
@@ -2095,77 +2550,129 @@ class OSD:
     # -- recovery ------------------------------------------------------------
 
     async def repair_pool(self, pool: PoolInfo) -> int:
-        """Two-phase recovery like the reference: log-driven delta recovery
-        first (peers whose PG logs overlap ours get only their missing
-        objects pushed), then a backfill scan (full list-diff) when any
-        peer's log window doesn't reach, or to sweep strays."""
-        pushed = 0
-        need_backfill = False
-        for pg in range(pool.pg_num):
-            acting = self.osdmap.pg_to_acting(pool, pg)
-            if self._primary(pool, pg, acting) != self.osd_id:
-                continue
-            try:
-                p, backfill = await self._log_recover_pg(pool, pg, acting)
-                pushed += p
-                need_backfill |= backfill
-                if backfill:
-                    await self._maybe_request_pg_temp(pool, pg, acting)
-                elif (pool.pool_id, pg) not in self.osdmap.pg_temp:
-                    # fully recovered at the current acting set: the prior
-                    # interval is obsolete — keeping it would let a later
-                    # transient degradation reinstall ancient members
-                    self._prior_acting.pop((pool.pool_id, pg), None)
-            except (ConnectionError, OSError, asyncio.TimeoutError):
-                need_backfill = True  # peer unreachable: sweep catches up
-            except ErasureCodeError as e:
-                # a codec failure is NOT recoverable by retrying forever:
-                # surface it instead of spinning an eternal backfill loop
-                self.perf.inc("recovery_errors")
-                self.ctx.log.error(
-                    "osd", f"repair pg {pool.pool_id}.{pg} codec error: {e}")
-            except Exception as e:
-                self.perf.inc("recovery_errors")
-                self.ctx.log.error(
-                    "osd",
-                    f"repair pg {pool.pool_id}.{pg}: {type(e).__name__}: {e}")
-                need_backfill = True  # backfill sweep is the safety net
-        holdings = None
-        if need_backfill or self.conf.get("osd_repair_full_sweep", True):
-            bf_pushed, holdings = await self._backfill_pool(pool)
-            pushed += bf_pushed
-        if self.osdmap.pg_temp:
-            await self._clear_done_pg_temps(pool, pushed, holdings)
-        return pushed
+        """Admin/safety-net repair: run one full statechart pass (GetInfo
+        -> GetLog -> GetMissing -> recover/backfill) for every PG of the
+        pool this OSD leads.  Normal recovery does NOT come through here —
+        it is event-driven per PG from _on_map (_kick_peering)."""
+        async def one(pg: int) -> int:
+            pushed = 0
+            # iterate to a verified no-op pass: pushes are fire-and-forget
+            # and an admin repair must leave the PG actually clean, not
+            # merely "progress was made"
+            for round_ in range(4):
+                acting = self.osdmap.pg_to_acting(pool, pg)
+                if self._primary(pool, pg, acting) != self.osd_id:
+                    return pushed
+                m = self._machine(pool.pool_id, pg)
+                try:
+                    done, p = await self._peer_and_recover_pg(
+                        m, pool, pg, acting,
+                        force_backfill=self.conf.get("osd_repair_full_sweep",
+                                                     True),
+                        reset_interval=True)
+                    pushed += p
+                    if done:
+                        return pushed
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    pass
+                except ErasureCodeError as e:
+                    # a codec failure is NOT recoverable by retrying
+                    # forever: surface it, don't spin an eternal loop
+                    self.perf.inc("recovery_errors")
+                    self.ctx.log.error(
+                        "osd",
+                        f"repair pg {pool.pool_id}.{pg} codec error: {e}")
+                    return pushed
+                except Exception as e:
+                    self.perf.inc("recovery_errors")
+                    self.ctx.log.error(
+                        "osd",
+                        f"repair pg {pool.pool_id}.{pg}: {type(e).__name__}: {e}")
+                await asyncio.sleep(0.25)
+            return pushed
 
-    async def _gather_holdings(self, pool: PoolInfo
-                               ) -> Dict[str, Set[Tuple[int, int, int]]]:
-        """oid -> {(shard, osd, version)} across all up OSDs.  Versions
-        matter — a stale shard sitting at its acting position is NOT
-        healthy redundancy."""
-        tid = uuid.uuid4().hex
-        peers = [
-            o for o in self.osdmap.osds.values() if o.up and o.osd_id != self.osd_id
+        # PGs peer concurrently (reservations bound the actual backfill
+        # concurrency); a zombie peer stalling one PG's RPCs must not
+        # serialize the whole pool's recovery behind it
+        jobs = [
+            one(pg) for pg in range(pool.pg_num)
+            if self._primary(pool, pg,
+                             self.osdmap.pg_to_acting(pool, pg)) == self.osd_id
         ]
+        if not jobs:
+            return 0
+        return sum(await asyncio.gather(*jobs))
+
+    def _scope_osds(self, pool: PoolInfo, pg: int) -> List[int]:
+        """The OSDs that can possibly hold shards of this PG: current
+        acting, crush up-set, and every member of intervals since the PG
+        was last clean (_past_members / _prior_acting — the reference's
+        past_intervals role).  Deletes, shard hunts, and backfill scans
+        contact only this set instead of broadcasting to the cluster."""
+        key = (pool.pool_id, pg)
+        scope = {a for a in self.osdmap.pg_to_acting(pool, pg)
+                 if a != CRUSH_ITEM_NONE}
+        scope.update(a for a in self._raw_up(pool, pg)
+                     if a != CRUSH_ITEM_NONE)
+        scope.update(a for a in self._prior_acting.get(key, [])
+                     if a != CRUSH_ITEM_NONE)
+        scope.update(self._past_members.get(key, ()))
+        return [o for o in scope
+                if self.osdmap.osds.get(o) and self.osdmap.osds[o].up]
+
+    async def _gather_holdings(
+        self, pool: PoolInfo, pg: int = -1,
+        osds: Optional[List[int]] = None,
+    ) -> Tuple[Dict[str, Set[Tuple[int, int, int]]], bool]:
+        """(oid -> {(shard, osd, version)}, complete).  Versions matter —
+        a stale shard sitting at its acting position is NOT healthy
+        redundancy.  With ``pg``/``osds`` given, the listing is scoped to
+        one PG's objects on its possible holders; the default remains the
+        pool-wide all-up-OSDs union (stray sweep / scrub).
+
+        ``complete`` is True only when EVERY queried peer answered: a
+        partial listing makes healthy objects look under-replicated, and
+        any decision that treats absence as doneness (Clean, pg_temp
+        clear, stray purge) must refuse to act on it."""
+        tid = uuid.uuid4().hex
+        if osds is None:
+            peers = [o.osd_id for o in self.osdmap.osds.values()
+                     if o.up and o.osd_id != self.osd_id]
+        else:
+            peers = [o for o in osds if o != self.osd_id]
         q = self._collector(tid)
         sent = 0
-        for o in peers:
+        complete = True
+        for osd in peers:
             try:
                 await self.messenger.send(
-                    o.addr, MListShards(pool_id=pool.pool_id, tid=tid, reply_to=self.addr)
-                )
+                    self.osdmap.addr_of(osd),
+                    MListShards(pool_id=pool.pool_id, tid=tid,
+                                reply_to=self.addr, pg=pg))
                 sent += 1
             except Exception:
-                pass
+                complete = False  # unreachable peer: listing is partial
         holdings: Dict[str, Set[Tuple[int, int, int]]] = {}
         for oid, shard in self._list_pool_objects(pool.pool_id):
+            if pg >= 0 and self.osdmap.object_to_pg(pool, oid) != pg:
+                continue
             got = self._store_read((pool.pool_id, oid, shard))
             if got is not None:
                 holdings.setdefault(oid, set()).add((shard, self.osd_id, got[1].version))
-        for r in await self._gather(tid, q, sent):
+        # short timeout: a just-killed peer can still be "up" in our map
+        # (heartbeat grace), its send buffers, and no reply ever comes —
+        # recovery must not stall a full RPC window on every zombie
+        replies = await self._gather(tid, q, sent, timeout=1.5)
+        if len(replies) < sent:
+            complete = False
+        for r in replies:
             for oid, shard, version in r.entries:
+                # re-filter: a peer on an older map may lack the pool and
+                # skip its pg filter, returning the whole pool's shards
+                if pg >= 0 and self.osdmap.object_to_pg(pool, oid) != pg:
+                    continue
                 holdings.setdefault(oid, set()).add((shard, r.osd_id, version))
-        return holdings
+        return holdings, complete
 
     def _raw_up(self, pool: PoolInfo, pg: int) -> List[int]:
         """The CRUSH mapping filtered to up OSDs — backfill's TARGET set.
@@ -2223,7 +2730,16 @@ class OSD:
         if pushed or holdings is None:
             if pushed:
                 await asyncio.sleep(0.3)  # fire-and-forget pushes land
-            holdings = await self._gather_holdings(pool)
+            holdings = {}
+            listing_ok = True
+            for pg in temp_pgs:  # scoped per-PG listings, not O(pool)
+                h, ok = await self._gather_holdings(
+                    pool, pg=pg, osds=self._scope_osds(pool, pg))
+                holdings.update(h)
+                listing_ok &= ok
+            if not listing_ok:
+                return  # partial view: clearing the override on it could
+                        # hand IO to members that are not actually caught up
         k_need = (self._codec(pool).get_data_chunk_count()
                   if pool.pool_type == "ec" else 1)
         incomplete: Set[int] = set()
@@ -2370,16 +2886,23 @@ class OSD:
         version, {(shard, osd)} holding it) — or None when nothing is
         decodable.  Membership is by (shard, osd) pair: a shard may
         legitimately live on several OSDs mid-backfill (old holder + new
-        target).  Shared by backfill push planning and pg_temp completion
-        so the two can never disagree about doneness."""
+        target).  Rollback-slot copies (shard >= PREV_SLOT) normalize to
+        their real shard id: they are decodable data for their version but
+        must not inflate the DISTINCT-shard count.  Shared by backfill
+        push planning and pg_temp completion so the two can never disagree
+        about doneness."""
         shards_at: Dict[int, Set[int]] = {}
         for (shard, _osd, v) in locs:
-            shards_at.setdefault(v, set()).add(shard)
+            shards_at.setdefault(v, set()).add(shard % PREV_SLOT)
         viable = [v for v, sh in shards_at.items() if len(sh) >= k_need]
         if not viable:
             return None
         newest = max(viable)
-        return newest, {(shard, osd) for shard, osd, v in locs if v == newest}
+        # membership counts LIVE slots only: a rollback-slot copy decodes,
+        # but it must not satisfy seat coverage — it dies with the shard
+        # that displaced it, so backfill needs a live home for the data
+        return newest, {(shard, osd) for shard, osd, v in locs
+                        if v == newest and shard < PREV_SLOT}
 
     def _missing_up_positions(
         self, pool: PoolInfo, pg: int, at_newest: Set[Tuple[int, int]],
@@ -2395,17 +2918,38 @@ class OSD:
     async def _backfill_pool(
         self, pool: PoolInfo,
     ) -> Tuple[int, Dict[str, Set[Tuple[int, int, int]]]]:
-        """Full-scan recovery (reference backfill): reconstruct and push
-        shards missing from the up-set positions of objects this OSD is
-        primary for.  Returns (shards_pushed, the gathered holdings)."""
-        holdings = await self._gather_holdings(pool)
+        """Pool-wide backfill: per-PG scoped sweeps over every PG this OSD
+        leads (each contacts only that PG's possible holders)."""
+        pushed = 0
+        merged: Dict[str, Set[Tuple[int, int, int]]] = {}
+        for pg in range(pool.pg_num):
+            acting = self.osdmap.pg_to_acting(pool, pg)
+            if self._primary(pool, pg, acting) != self.osd_id:
+                continue
+            p, holdings, _covered = await self._backfill_pg(pool, pg)
+            pushed += p
+            merged.update(holdings)
+        return pushed, merged
+
+    async def _backfill_pg(
+        self, pool: PoolInfo, pg: int,
+    ) -> Tuple[int, Dict[str, Set[Tuple[int, int, int]]], bool]:
+        """Scoped backfill of ONE PG (reference backfill): list shards on
+        the PG's possible holders only, reconstruct and push whatever is
+        missing from the up-set positions, and purge strays once the
+        up-set is fully covered.  Returns (shards_pushed, the gathered
+        holdings, fully_covered)."""
+        gather_epoch = self.osdmap.epoch
+        holdings, listing_ok = await self._gather_holdings(
+            pool, pg=pg, osds=self._scope_osds(pool, pg))
         k_need = (self._codec(pool).get_data_chunk_count()
                   if pool.pool_type == "ec" else 1)
         pushed = 0
+        # a partial listing (unanswered peer) makes healthy objects look
+        # under-replicated: never declare coverage (or purge) on one
+        fully_covered = listing_ok
         for oid, locs in holdings.items():
-            pg, acting = self._acting(pool, oid)
-            if self._primary(pool, pg, acting) != self.osd_id:
-                continue
+            acting = self.osdmap.pg_to_acting(pool, pg)
             # newest COMPLETE version wins; shards newer than it are
             # uncommitted leftovers of a failed write -> roll them back
             # (reference divergent-entry rollback, ECBackend rollback)
@@ -2413,22 +2957,44 @@ class OSD:
             if got is None:
                 continue
             newest, at_newest = got
-            for shard, osd, v in locs:
-                if v > newest:
-                    try:
-                        await self.messenger.send(
-                            self.osdmap.addr_of(osd),
-                            MECSubDelete(pool_id=pool.pool_id, pg=pg,
-                                         oid=oid, shard=shard, tid="",
-                                         reply_to=self.addr))
-                    except Exception:
-                        pass
+            # shards NEWER than the newest complete version are either
+            # leftovers of a failed write, a concurrent write racing this
+            # scan, or an acked write whose holders died (unfound).  A
+            # single observation must not destroy anything — a just-acked
+            # write can look partial for a moment — but a version that
+            # stays partial across TWO complete listings is unrecoverable
+            # (fewer than k shards exist anywhere): revert its shards to
+            # their rollback slots so the newest COMPLETE version regains
+            # live seats (automated mark_unfound_lost-revert).
+            newer_partial = {v for (_s, _o, v) in locs if v > newest}
+            if newer_partial and listing_ok:
+                seen = self._partial_newer.setdefault((pool.pool_id, pg), set())
+                fully_covered = False
+                for v_bad in newer_partial:
+                    if (oid, v_bad) not in seen:
+                        continue  # first sighting: give in-flight acks time
+                    for shard, osd, v in locs:
+                        if v != v_bad or shard >= PREV_SLOT:
+                            continue
+                        rb = MECSubRollback(pool_id=pool.pool_id, pg=pg,
+                                            oid=oid, shard=shard,
+                                            bad_version=v_bad,
+                                            reply_to=self.addr)
+                        if osd == self.osd_id:
+                            self._handle_sub_rollback(rb)
+                        else:
+                            try:
+                                await self.messenger.send(
+                                    self.osdmap.addr_of(osd), rb)
+                            except Exception:
+                                pass
             # push targets are the UP-SET positions: identical to acting
             # normally, but under pg_temp the override serves IO while
             # backfill fills the crush-mapped members
             missing = self._missing_up_positions(pool, pg, at_newest)
             if not missing:
                 continue
+            fully_covered = False  # pushes are in flight; purge next round
             if len(missing) == 1 and pool.pool_type == "ec":
                 # single lost shard: try the sub-chunk repair path (CLAY)
                 # — helpers move sub_chunk_no/q of a chunk, not k chunks
@@ -2481,4 +3047,45 @@ class OSD:
                     except Exception:
                         continue
                 pushed += 1
-        return pushed, holdings
+        if listing_ok:
+            observed = set()
+            for oid, locs in holdings.items():
+                got = self._newest_complete(locs, k_need)
+                base = got[0] if got else 0
+                observed.update((oid, v) for (_s, _o, v) in locs if v > base)
+            self._partial_newer[(pool.pool_id, pg)] = observed
+        if fully_covered and not self.osdmap.pg_temp.get((pool.pool_id, pg)):
+            await self._purge_strays(pool, pg, holdings, gather_epoch)
+        return pushed, holdings, fully_covered
+
+    async def _purge_strays(
+        self, pool: PoolInfo, pg: int,
+        holdings: Dict[str, Set[Tuple[int, int, int]]],
+        gather_epoch: int,
+    ) -> None:
+        """Once every up-set position holds the newest complete version
+        and no override is serving, copies on OSDs OUTSIDE the up set are
+        strays from prior intervals: delete them (reference stray purge
+        after activation, PG::purge_strays).  Without this, moved-away
+        shards would linger forever and the shard hunt could resurrect a
+        deleted object from them.  Skipped when the map moved since the
+        holdings were gathered — a "stray" under the old map may be an
+        acting member under the new one."""
+        if self.osdmap.epoch != gather_epoch:
+            return
+        up = {osd for osd in self._raw_up(pool, pg) if osd != CRUSH_ITEM_NONE}
+        stray_osds: Dict[int, Set[str]] = {}
+        for oid, locs in holdings.items():
+            for _shard, osd, _v in locs:
+                if osd not in up:
+                    stray_osds.setdefault(osd, set()).add(oid)
+        for osd, oids in stray_osds.items():
+            for oid in oids:
+                try:
+                    await self.messenger.send(
+                        self.osdmap.addr_of(osd),
+                        MECSubDelete(pool_id=pool.pool_id, pg=pg, oid=oid,
+                                     shard=-1, tid="", reply_to=self.addr))
+                    self.perf.inc("stray_purged")
+                except Exception:
+                    pass

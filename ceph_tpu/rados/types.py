@@ -463,11 +463,52 @@ class MPushShard:
     hinfo: bytes = b""
 
 
-@message(36)
+@message(36, version=2)
 class MListShards:
     pool_id: int = 0
     tid: str = ""
     reply_to: Tuple[str, int] = ("", 0)
+    # scope the listing to one PG (-1 = whole pool): per-PG backfill asks
+    # only for the objects it can act on instead of O(pool) listings
+    pg: int = -1
+
+
+@message(55)
+class MECSubRollback:
+    """Primary-ordered revert of one shard to its rollback slot: the
+    newer version it holds was confirmed unrecoverable (fewer than k
+    shards survive anywhere, over two complete listings), so the durable
+    state of the object is the PREV version (the automated equivalent of
+    the reference's `mark_unfound_lost revert`)."""
+
+    pool_id: int = 0
+    pg: int = 0
+    oid: str = ""
+    shard: int = 0
+    bad_version: int = 0
+    reply_to: Tuple[str, int] = ("", 0)
+
+
+@message(53)
+class MBackfillReserve:
+    """Remote recovery reservation (reference MBackfillReserve +
+    AsyncReserver): the primary takes a slot on every backfill target
+    before bulk pushes so osd_max_backfills bounds cluster-wide recovery
+    concurrency.  op: "request" | "release"."""
+
+    op: str = "request"
+    pool_id: int = 0
+    pg: int = 0
+    from_osd: int = -1
+    tid: str = ""
+    reply_to: Tuple[str, int] = ("", 0)
+
+
+@message(54)
+class MBackfillReserveReply:
+    tid: str = ""
+    osd_id: int = 0
+    ok: bool = False
 
 
 @message(37, version=2)
@@ -509,12 +550,17 @@ class MPGInfoReq:
     reply_to: Tuple[str, int] = ("", 0)
 
 
-@message(41)
+@message(41, version=2)
 class MPGInfoReply:
     tid: str = ""
     osd_id: int = 0
     last_update: Tuple[int, int] = (0, 0)
     log_tail: Tuple[int, int] = (0, 0)
+    # the peer's view of this PG's interval membership since it was last
+    # clean (past_intervals role): a failover primary that missed those
+    # intervals (down, or newly added) unions these so its scope set —
+    # deletes, shard hunts, backfill sources — still reaches old holders
+    past_members: List[int] = field(default_factory=list)
 
 
 @message(42)
