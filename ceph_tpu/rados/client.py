@@ -115,9 +115,32 @@ class RadosClient:
                     self.mons.rotate()
             raise last
 
-    async def refresh_map(self) -> OSDMap:
-        reply = await self._mon_rpc(MGetMap())
-        self.osdmap = reply.osdmap
+    async def refresh_map(self, min_epoch: int = 0) -> OSDMap:
+        """Fetch the cluster map; with ``min_epoch``, poll until we hold
+        AT LEAST that epoch (the Objecter's epoch barrier — a retryable
+        error reply names the OSD's epoch, and re-targeting on anything
+        older would recompute the same stale primary).  The mon answers
+        with an incremental chain from our epoch when it can (subscriber
+        protocol); otherwise a full map."""
+        import pickle as _pickle
+
+        for _ in range(20):
+            since = self.osdmap.epoch if self.osdmap is not None else 0
+            reply = await self._mon_rpc(MGetMap(min_epoch=since))
+            if reply.osdmap is not None:
+                self.osdmap = reply.osdmap
+            elif getattr(reply, "incrementals", None) and self.osdmap is not None:
+                # apply the delta chain to a copy; a broken chain falls
+                # back to a full fetch next iteration
+                m = _pickle.loads(_pickle.dumps(self.osdmap, protocol=5))
+                if all(m.apply_incremental(inc) for inc in reply.incrementals):
+                    self.osdmap = m
+                else:
+                    self.osdmap = (await self._mon_rpc(MGetMap())).osdmap
+            if min_epoch <= 0 or (self.osdmap is not None
+                                  and self.osdmap.epoch >= min_epoch):
+                break
+            await asyncio.sleep(0.1)
         return self.osdmap
 
     async def create_pool(
@@ -151,51 +174,88 @@ class RadosClient:
 
     # -- data ops -------------------------------------------------------------
 
+    def _calc_target(self, op: MOSDOp) -> Optional[int]:
+        """object -> PG -> primary on the current map (reference
+        Objecter::_calc_target, Objecter.cc:2764)."""
+        pool = self.osdmap.pools.get(op.pool_id)
+        if pool is None:
+            return None
+        pg = self.osdmap.object_to_pg(pool, op.oid)
+        acting = self.osdmap.pg_to_acting(pool, pg)
+        return self.osdmap.primary_of(acting, seed=(op.pool_id << 20) | pg)
+
     async def _op(self, op: MOSDOp, retries: int = 6) -> MOSDOpReply:
+        """Objecter-grade submit (reference op_submit/_calc_target/_send_op,
+        Objecter.cc:2257,2764,3233): ONE reqid for the op's whole lifetime
+        (server dedupe = exactly-once), re-target on every map change, and
+        an epoch barrier on retryable errors — the error reply names the
+        OSD's epoch and we refresh to AT LEAST that before recomputing the
+        target, so a stale map cannot bounce the op between two OSDs that
+        each think the other is primary."""
         if self.osdmap is None:
             await self.refresh_map()
         last_error = "no attempt"
         # ONE reqid per logical op: resends carry the same id so the PG
         # log's dup detection can recognize them (reference osd_reqid_t)
         op.reqid = uuid.uuid4().hex
+        fence = 0  # minimum epoch the next target may be computed on
         for attempt in range(retries):
+            if fence > self.osdmap.epoch or (attempt and fence == 0):
+                try:
+                    await self.refresh_map(min_epoch=fence)
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    last_error = "map refresh failed"
+                    await asyncio.sleep(0.3 * (attempt + 1))
+                    continue
             pool = self.osdmap.pools.get(op.pool_id)
             if pool is None:
                 # a lagging mon may have served us a pre-creation map:
                 # refresh-and-retry (Objecter catches up across epochs)
                 if attempt == retries - 1:
                     raise RadosError(f"pool {op.pool_id} does not exist")
-                last_error = f"pool {op.pool_id} not in map epoch {self.osdmap.epoch}"
+                last_error = (
+                    f"pool {op.pool_id} not in map epoch {self.osdmap.epoch}")
+                fence = self.osdmap.epoch + 1
                 await asyncio.sleep(0.2 * (attempt + 1))
-                try:
-                    await self.refresh_map()
-                except (ConnectionError, OSError, asyncio.TimeoutError):
-                    pass
                 continue
-            pg = self.osdmap.object_to_pg(pool, op.oid)
-            acting = self.osdmap.pg_to_acting(pool, pg)
-            primary = self.osdmap.primary_of(acting, seed=(op.pool_id << 20) | pg)
+            primary = self._calc_target(op)
             if primary is None:
                 last_error = "no primary (all acting osds down)"
-            else:
-                op.epoch = self.osdmap.epoch
-                fut: asyncio.Future = asyncio.get_running_loop().create_future()
-                self._replies[op.reqid] = fut
-                try:
-                    await self.messenger.send(self.osdmap.addr_of(primary), op)
-                    reply = await asyncio.wait_for(fut, timeout=self.op_timeout)
-                    if reply.ok:
-                        return reply
-                    last_error = reply.error
-                except (ConnectionError, OSError, asyncio.TimeoutError) as e:
-                    last_error = f"{type(e).__name__}: {e}"
-                finally:
-                    self._replies.pop(op.reqid, None)
-            await asyncio.sleep(0.3 * (attempt + 1))
+                fence = self.osdmap.epoch + 1
+                await asyncio.sleep(0.3 * (attempt + 1))
+                continue
+            op.epoch = self.osdmap.epoch
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._replies[op.reqid] = fut
             try:
-                await self.refresh_map()
+                await self.messenger.send(self.osdmap.addr_of(primary), op)
+                reply = await asyncio.wait_for(fut, timeout=self.op_timeout)
+                if reply.ok:
+                    return reply
+                last_error = reply.error
+                # epoch barrier: never re-target on a map older than the
+                # replying OSD's (it refused exactly because placement
+                # moved — recomputing on our stale map re-picks it)
+                fence = max(fence, getattr(reply, "map_epoch", 0),
+                            self.osdmap.epoch + 1)
+                # retryable refusals re-target promptly — the barrier
+                # already orders us behind the newer map — but repeated
+                # bounces mean recovery is still moving seats: give it a
+                # growing (small) window instead of burning retries dry
+                if ("not primary" in reply.error
+                        or "degraded" in reply.error):
+                    if attempt:
+                        await asyncio.sleep(min(0.25 * attempt, 1.0))
+                    continue
+                await asyncio.sleep(0.2 * (attempt + 1))
             except (ConnectionError, OSError, asyncio.TimeoutError) as e:
-                last_error = f"map refresh failed: {type(e).__name__}"
+                last_error = f"{type(e).__name__}: {e}"
+                # the target may have died: re-target on a fresh map; if
+                # the target is UNCHANGED the resend is dedupe-safe
+                fence = max(fence, self.osdmap.epoch + 1)
+                await asyncio.sleep(0.2 * (attempt + 1))
+            finally:
+                self._replies.pop(op.reqid, None)
         raise RadosError(f"op {op.op} {op.oid} failed: {last_error}")
 
     async def put(self, pool_id: int, oid: str, data: bytes,

@@ -352,6 +352,24 @@ class OSD:
                         o.addr, MOSDPing(op="ping", from_osd=self.osd_id,
                                          stamp=now,
                                          epoch=self.osdmap.epoch))
+                except ConnectionRefusedError:
+                    # nothing is LISTENING at the peer's address: the
+                    # process is gone, not slow — report immediately
+                    # instead of burning the grace window (the reference
+                    # reports connection faults ahead of ping timeouts).
+                    # A restarting OSD re-boots and re-registers, so a
+                    # false positive costs one re-peer, not data.
+                    if now - self._hb_reported.get(o.osd_id, -1e9) > 1.0:
+                        self._hb_reported[o.osd_id] = now
+                        self.perf.inc("heartbeat_failures")
+                        try:
+                            await self.messenger.send(
+                                self.mons.current,
+                                MOSDFailure(target_osd=o.osd_id,
+                                            from_osd=self.osd_id,
+                                            failed_for=grace))
+                        except Exception:
+                            pass
                 except Exception:
                     pass
                 last = self._hb_last.setdefault(o.osd_id, now)
@@ -1182,6 +1200,12 @@ class OSD:
                                       tracked) -> None:
         tracked.mark_event("reached_pg")
         try:
+            if op.epoch > (self.osdmap.epoch if self.osdmap else 0):
+                # epoch barrier (reference require_same_or_newer_map): the
+                # client computed its target on a newer map than ours —
+                # deciding primaryship on the stale one could execute an
+                # op we no longer own.  Catch up first.
+                await self._fetch_full_map()
             if op.op == "write":
                 reply = await self._do_write(op)
             elif op.op == "read":
@@ -1220,6 +1244,9 @@ class OSD:
         except Exception as e:
             reply = MOSDOpReply(ok=False, error=f"{type(e).__name__}: {e}")
         reply.reqid = op.reqid
+        # our epoch rides every reply: on retryable errors the client
+        # fences its re-target on at least this epoch
+        reply.map_epoch = self.osdmap.epoch if self.osdmap else 0
         try:
             await conn.send(reply)
         except ConnectionError:
@@ -1354,6 +1381,7 @@ class OSD:
                 log_entry=entry_blob, chunk_off=chunk_off,
                 shard_size=shard_size, hinfo=hinfo_blob,
                 prior_version=base_version,
+                from_osd=self.osd_id, epoch=self.osdmap.epoch,
             )
             try:
                 await self.messenger.send(self.osdmap.addr_of(osd), msg)
@@ -1658,7 +1686,9 @@ class OSD:
                                     shard=shard, chunk=data, version=version,
                                     object_size=len(data),
                                     chunk_crc=shard_crc(data), tid=tid,
-                                    reply_to=self.addr, log_entry=entry_blob))
+                                    reply_to=self.addr, log_entry=entry_blob,
+                                    from_osd=self.osd_id,
+                                    epoch=self.osdmap.epoch))
                     sent += 1
                 except Exception:
                     pass
@@ -2047,7 +2077,24 @@ class OSD:
 
     async def _handle_sub_write(self, msg: MECSubWrite) -> None:
         ok = True
-        if msg.chunk_crc and shard_crc(msg.chunk) != msg.chunk_crc:
+        sender = getattr(msg, "from_osd", -1)
+        if sender >= 0 and self.osdmap is not None:
+            # interval fence (reference same_interval_since): refuse a
+            # sub-write from an OSD that is not this pg's primary in OUR
+            # map — a deposed primary with in-flight sub-ops must not
+            # complete a write concurrently with its successor.  Catch up
+            # first when the sender's map is newer than ours.
+            if msg.epoch > self.osdmap.epoch:
+                await self._fetch_full_map()
+            pool = self.osdmap.pools.get(msg.pool_id)
+            if pool is not None:
+                acting = self.osdmap.pg_to_acting(pool, msg.pg)
+                if (self._primary(pool, msg.pg, acting)
+                        not in (sender, None)):
+                    ok = False
+        if not ok:
+            pass
+        elif msg.chunk_crc and shard_crc(msg.chunk) != msg.chunk_crc:
             ok = False  # corrupted in flight
         else:
             entry = LogEntry.decode(msg.log_entry) if msg.log_entry else None

@@ -346,7 +346,7 @@ class MOSDOp:
     method: str = ""
 
 
-@message(21)
+@message(21, version=2)
 class MOSDOpReply:
     ok: bool = True
     error: str = ""
@@ -354,16 +354,26 @@ class MOSDOpReply:
     oids: List[str] = field(default_factory=list)
     reqid: str = ""
     version: int = 0  # object version the data was read at
+    # the replying OSD's map epoch: on a retryable error (not primary,
+    # degraded) the client fetches AT LEAST this epoch before
+    # re-targeting (the Objecter's epoch barrier, Objecter.cc:2764)
+    map_epoch: int = 0
 
 
 # Primary OSD <-> shard OSDs (ECSubWrite/ECSubRead equivalents,
 # reference src/osd/ECMsgTypes.h:23,105)
 
 
-@message(30, version=3)
+@message(30, version=4)
 class MECSubWrite:
     pool_id: int = 0
     pg: int = 0
+    # interval fence (reference same_interval_since): the sender's osd id
+    # and map epoch; a replica whose map shows a DIFFERENT primary for
+    # this pg refuses the sub-write, so a deposed primary cannot complete
+    # a write concurrently with its successor
+    from_osd: int = -1
+    epoch: int = 0
     oid: str = ""
     shard: int = 0
     chunk: bytes = b""

@@ -1,0 +1,214 @@
+"""Objecter resend discipline (reference Objecter.cc:2257 op_submit,
+:2764 _calc_target, :3233 _send_op): exactly-once execution across map
+flips, epoch barriers on retryable errors, and the interval fence that
+stops a deposed primary from completing a write behind its successor."""
+
+import asyncio
+import os
+
+from ceph_tpu.rados.types import MECSubWrite, MOSDOp
+from ceph_tpu.rados.vstart import Cluster
+
+CONF = {
+    "mon_osd_report_grace": 0.8,
+    "osd_heartbeat_interval": 0.2,
+    "osd_repair_delay": 0.2,
+    "client_op_timeout": 1.5,
+}
+
+PROFILE = {"plugin": "jerasure", "technique": "reed_sol_van",
+           "k": "2", "m": "1"}
+
+
+def run(coro, timeout=90):
+    asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def _locate(c, cluster, pool, oid):
+    p = c.osdmap.pools[pool]
+    pg = c.osdmap.object_to_pg(p, oid)
+    acting = c.osdmap.pg_to_acting(p, pg)
+    primary = c.osdmap.primary_of(acting, seed=(pool << 20) | pg)
+    return p, pg, acting, primary
+
+
+class TestExactlyOnce:
+    def test_map_flip_mid_write_executes_once(self):
+        """The reply to the first send is stalled past the client timeout
+        while the map flips (primary marked down); the client re-targets
+        and resends with the SAME reqid.  The op must execute exactly
+        once: one PG-log entry for the reqid on every surviving log, and
+        the object lands at one single version."""
+        async def go():
+            cluster = Cluster(n_osds=4, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("once", profile=dict(PROFILE))
+                await c.put(pool, "obj", os.urandom(8000))
+                p, pg, acting, primary_id = _locate(c, cluster, pool, "obj")
+                prim = cluster.osds[primary_id]
+                # swallow the primary's next client-op reply: the client
+                # times out, refreshes, re-targets, resends same reqid
+                real_inner = prim._handle_client_op_inner
+                stalled = []
+
+                async def stall_reply(conn, op, tracked):
+                    if op.op == "write" and op.oid == "obj" and not stalled:
+                        stalled.append(op.reqid)
+
+                        class _Blackhole:
+                            async def send(self, msg):
+                                pass
+
+                        return await real_inner(_Blackhole(), op, tracked)
+                    return await real_inner(conn, op, tracked)
+
+                prim._handle_client_op_inner = stall_reply
+                data = os.urandom(8000)
+
+                async def flip():
+                    # wait until the first (stalled) execution happened,
+                    # then flip the map out from under the client
+                    for _ in range(100):
+                        if stalled:
+                            break
+                        await asyncio.sleep(0.02)
+                    await c.mark_osd_down(primary_id)
+
+                flip_task = asyncio.create_task(flip())
+                await c.put(pool, "obj", data)
+                await flip_task
+                assert stalled, "test setup: first send was not stalled"
+                reqid = stalled[0]
+                await asyncio.sleep(0.5)
+                # exactly-once: every surviving PG log holds AT MOST one
+                # entry for the reqid, and all logs agree it ran once
+                counts = []
+                for o in cluster.osds.values():
+                    if o.osd_id == primary_id:
+                        continue
+                    log = o._pglog(pool, pg)
+                    n = sum(1 for e in log.entries if e.reqid == reqid)
+                    counts.append(n)
+                    assert n <= 1, f"reqid executed {n} times on osd{o.osd_id}"
+                assert any(n == 1 for n in counts), \
+                    "the write never reached a surviving log"
+                assert await c.get(pool, "obj") == data
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_resend_same_reqid_is_deduped(self):
+        """A duplicate of an applied write (same reqid) must not bump the
+        object version — the PG log's dup detection answers it."""
+        async def go():
+            cluster = Cluster(n_osds=3, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("dup", profile=dict(PROFILE))
+                data = os.urandom(6000)
+                await c.put(pool, "obj", data)
+                p, pg, acting, primary_id = _locate(c, cluster, pool, "obj")
+                prim = cluster.osds[primary_id]
+                shard = acting.index(primary_id)
+                v1 = prim.store.read((pool, "obj", shard))[1].version
+                log = prim._pglog(pool, pg)
+                reqid = next(e.reqid for e in log.entries if e.oid == "obj")
+                dup = MOSDOp(op="write", pool_id=pool, oid="obj",
+                             data=os.urandom(6000), reqid=reqid,
+                             epoch=c.osdmap.epoch)
+                reply = await prim._do_write(dup)
+                assert reply.ok  # deduped, acknowledged
+                v2 = prim.store.read((pool, "obj", shard))[1].version
+                assert v1 == v2, "duplicate reqid re-executed the write"
+                assert await c.get(pool, "obj") == data
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+
+class TestEpochBarrier:
+    def test_error_reply_carries_epoch_and_client_fences(self):
+        """A 'not primary' refusal names the OSD's epoch; the client must
+        not re-target on an older map (it would recompute the same stale
+        primary and bounce forever)."""
+        async def go():
+            cluster = Cluster(n_osds=4, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("fence", profile=dict(PROFILE))
+                await c.put(pool, "obj", os.urandom(5000))
+                p, pg, acting, primary_id = _locate(c, cluster, pool, "obj")
+                # flip the map at the mon; the client keeps its stale map
+                stale_epoch = c.osdmap.epoch
+                wrong = next(o for o in cluster.osds if o != primary_id
+                             and o in [a for a in acting if a >= 0])
+                await cluster.kill_osd(primary_id)
+                # wait for the mon to notice so a new epoch exists
+                mon_c = await cluster.client()
+                for _ in range(60):
+                    await asyncio.sleep(0.1)
+                    await mon_c.refresh_map()
+                    if not mon_c.osdmap.osds[primary_id].up:
+                        break
+                # the stale client writes: first target is the dead
+                # primary; the fence + re-target must land it exactly once
+                data = os.urandom(5000)
+                await c.put(pool, "obj", data)
+                assert c.osdmap.epoch > stale_epoch, \
+                    "client never advanced past its stale epoch"
+                assert await c.get(pool, "obj") == data
+                await mon_c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+
+class TestIntervalFence:
+    def test_replica_refuses_subwrite_from_non_primary(self):
+        """A sub-write stamped by an OSD that is NOT the pg's primary in
+        the replica's map is refused — a deposed primary cannot complete
+        a write concurrently with its successor (reference
+        same_interval_since fencing)."""
+        async def go():
+            cluster = Cluster(n_osds=3, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("iv", profile=dict(PROFILE))
+                data = os.urandom(6000)
+                await c.put(pool, "obj", data)
+                p, pg, acting, primary_id = _locate(c, cluster, pool, "obj")
+                replica_id = next(a for a in acting
+                                  if a >= 0 and a != primary_id)
+                replica = cluster.osds[replica_id]
+                shard = acting.index(replica_id)
+                before = replica.store.read((pool, "obj", shard))
+                # forge a sub-write claiming to come from a NON-primary
+                imposter = next(a for a in acting
+                                if a >= 0 and a not in (primary_id,))
+                forged = MECSubWrite(
+                    pool_id=pool, pg=pg, oid="obj", shard=shard,
+                    chunk=b"\x00" * len(before[0]),
+                    version=before[1].version + 1000,
+                    object_size=before[1].object_size,
+                    tid="forged", reply_to=("127.0.0.1", 1),
+                    from_osd=imposter if imposter != primary_id
+                    else replica_id,
+                    epoch=c.osdmap.epoch)
+                await replica._handle_sub_write(forged)
+                after = replica.store.read((pool, "obj", shard))
+                assert after[1].version == before[1].version, \
+                    "replica applied a sub-write from a non-primary"
+                assert bytes(after[0]) == bytes(before[0])
+                assert await c.get(pool, "obj") == data
+            finally:
+                await cluster.stop()
+
+        run(go())

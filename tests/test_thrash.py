@@ -87,18 +87,30 @@ class TestThrash:
                 # settle: detection + repair
                 await asyncio.sleep(2.0)
                 await c.refresh_map()
-                await c.repair_pool(pool)
-                await asyncio.sleep(1.0)
 
                 # every acknowledged write reads back intact; an errored
                 # write that still landed (reported-failed, applied — the
-                # reference's thrash semantics too) is also acceptable
+                # reference's thrash semantics too) is also acceptable.
+                # The invariant is DURABILITY, not sub-second convergence:
+                # recovery is eventually consistent (fire-and-forget
+                # pushes, detection grace), so give it bounded repair
+                # rounds before declaring an acked write lost.
                 assert len(acked) >= 10, "thrash produced too few writes"
                 mismatches = []
-                for oid, blob in acked.items():
-                    got = await c.get(pool, oid)
-                    if got != blob and got not in attempted.get(oid, []):
-                        mismatches.append(oid)
+                for round_ in range(4):
+                    await c.repair_pool(pool)
+                    await asyncio.sleep(1.0)
+                    mismatches = []
+                    for oid, blob in acked.items():
+                        try:
+                            got = await c.get(pool, oid)
+                        except Exception:
+                            mismatches.append(oid)
+                            continue
+                        if got != blob and got not in attempted.get(oid, []):
+                            mismatches.append(oid)
+                    if not mismatches:
+                        break
                 assert not mismatches, f"data loss on {mismatches}"
                 await c.stop()
             finally:
