@@ -126,14 +126,24 @@ def main() -> int:
     gbps = total_bytes / dt / 1e9
 
     # CPU A/B baseline: the native C++ jerasure-equivalent codec (same
-    # matrices, byte-identical output); numpy oracle as last resort
+    # matrices, byte-identical output).  The default build vectorizes the
+    # GF region kernel (GFNI affine or AVX2 pshufb split tables, cache-
+    # tiled) so vs_baseline is an HONEST ratio against an isa-l-class
+    # single-core encode, not a scalar strawman; the scalar nibble-table
+    # rate is also measured (subprocess with CEPH_TPU_NO_SIMD=1) and
+    # reported as vs_scalar for continuity with earlier rounds.
+    simd_kind = "numpy"
+
     def cpu_once() -> float:
+        nonlocal simd_kind
         try:
             from ceph_tpu.native import bridge
 
             t0 = time.perf_counter()
             bridge.rs_encode("reed_sol_van", data, M)
-            return time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            simd_kind = bridge.simd_kind()
+            return dt
         except Exception:
             t0 = time.perf_counter()
             gf(W).matmul(mat, data)
@@ -143,11 +153,52 @@ def main() -> int:
     cpu_dt = min(cpu_once() for _ in range(CPU_ITERS))
     cpu_gbps = (K * B) / cpu_dt / 1e9
 
+    def scalar_gbps() -> float:
+        import subprocess
+
+        code = (
+            "import numpy as np, timeit;"
+            "from ceph_tpu.native import bridge;"
+            "d = np.random.default_rng(0).integers(0, 256, (%d, 1 << 20),"
+            " dtype=np.uint8);"
+            "bridge.rs_encode('reed_sol_van', d, %d);"
+            "dt = min(timeit.repeat(lambda: bridge.rs_encode("
+            "'reed_sol_van', d, %d), number=1, repeat=3));"
+            "print(d.size / dt / 1e9)" % (K, M, M))
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                env=dict(os.environ, CEPH_TPU_NO_SIMD="1"),
+                capture_output=True, text=True, timeout=120, check=True)
+            return float(out.stdout.strip().splitlines()[-1])
+        except Exception:
+            return 0.0
+
+    scalar = scalar_gbps()
+
+    # end-to-end host-memory path: bytes start in host RAM, parity lands
+    # back in host RAM (what the batching queue amortizes).  Behind the
+    # dev tunnel this is dominated by the tunnel's mirrored-transfer
+    # throughput (an artifact — a real deployment colocates the service
+    # with the chip); it is recorded so the transfer cost is never
+    # invisible in the methodology.
+    t0 = time.perf_counter()
+    host_parity = np.asarray(encode(jax.device_put(bm.astype(np.int8)),
+                                    jax.device_put(data)))
+    e2e_dt = time.perf_counter() - t0
+    e2e_gbps = (K * B) / e2e_dt / 1e9
+    del host_parity
+
     print(json.dumps({
         "metric": f"ec_encode_GBps_k{K}m{M}_1MiB_stripes_batch{N_STRIPES}_{backend}",
         "value": round(gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(gbps / cpu_gbps, 2),
+        "baseline_GBps": round(cpu_gbps, 3),
+        "baseline_kind": f"native-{simd_kind}",
+        "scalar_GBps": round(scalar, 3),
+        "vs_scalar": round(gbps / scalar, 2) if scalar else 0,
+        "e2e_hostmem_GBps": round(e2e_gbps, 3),
     }))
     return 0
 

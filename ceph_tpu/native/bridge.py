@@ -47,6 +47,8 @@ def lib() -> ctypes.CDLL:
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
         ]
+        _lib.ceph_tpu_simd_kind.restype = ctypes.c_char_p
+        _lib.ceph_tpu_simd_kind.argtypes = []
         _lib.ceph_tpu_rs_decode.restype = ctypes.c_int
         _lib.ceph_tpu_rs_decode.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
@@ -59,6 +61,13 @@ def lib() -> ctypes.CDLL:
 
 def gf_mul(a: int, b: int) -> int:
     return lib().ceph_tpu_gf_mul(a, b)
+
+
+def simd_kind() -> str:
+    """Which vectorized region kernel the native core dispatched to
+    ("gfni" | "avx2" | "scalar") — the bench reports it so the CPU A/B
+    ratio is auditable."""
+    return lib().ceph_tpu_simd_kind().decode()
 
 
 def rs_encode(technique: str, data: np.ndarray, m: int) -> np.ndarray:

@@ -35,11 +35,24 @@ import numpy as np
 
 def pallas_enabled() -> bool:
     """Whether dispatchers should route w=8 byte-layout ops to the Pallas
-    kernel.  Off by default: on the v5e used for tuning, XLA's fused
-    unpack+matmul+pack path measured ~4x faster than the hand-written kernel
-    (59.7 vs 15.6 GB/s at k=8,m=3), so production and the headline bench both
-    take the XLA path until the kernel wins; set CEPH_TPU_PALLAS=1 to opt in
-    (e.g. when re-tuning on a different TPU generation)."""
+    kernel.  Off by default — measured conclusion (v5e, k=8 m=3, 8 MiB
+    batches, 512 encodes per timed dispatch so tunnel RTT amortizes out):
+
+      old kernel (stack/reshape bit-plane unpack) .... 13 GB/s
+      tuned kernel (repeat + iota-shift unpack,
+        TILE_B 8192 -> 32768) ........................ ~40 GB/s
+      XLA fused unpack+matmul+pack ................... ~52 GB/s
+
+    The tuning round found the old kernel's cost was the [k,8,B] ->
+    [k*8,B] sublane-interleave relayout, not the matmul; replacing it
+    with elementwise repeat+shift tripled the kernel.  The remaining
+    ~1.3x gap is not HBM (both paths sit far below the bandwidth
+    roofline at ~1.4 bytes moved per data byte): the [m*8, k*8] x
+    [k*8, B] product leaves the 128x128 MXU ~90% idle, so the op is
+    VPU-bound on pack/unpack — exactly the stage XLA fuses across
+    surrounding ops while Pallas pays per-kernel boundaries.  XLA stays
+    the production path; set CEPH_TPU_PALLAS=1 to opt in when re-tuning
+    (a different generation or a wider m*k could flip the verdict)."""
     return os.environ.get("CEPH_TPU_PALLAS", "") == "1"
 
 

@@ -18,6 +18,9 @@ uint8_t ceph_tpu_gf_mul(uint8_t a, uint8_t b) {
   return GF256::instance().mul(a, b);
 }
 
+// which region kernel is live: "gfni" | "avx2" | "scalar"
+const char* ceph_tpu_simd_kind() { return GF256::instance().simd_kind(); }
+
 // contiguous-buffer encode: data is k*chunk bytes, parity out m*chunk
 int ceph_tpu_rs_encode(const char* technique, int k, int m,
                        const uint8_t* data, uint8_t* parity, size_t chunk) {

@@ -36,12 +36,25 @@ class GF256 {
   void mul_region(uint8_t c, const uint8_t* src, uint8_t* dst,
                   size_t len) const;
 
+  // which vectorized region kernel is live ("gfni", "avx2", "scalar") —
+  // the honest-baseline requirement: the bench's CPU A/B must be the
+  // fastest encode this host can produce, not a scalar strawman
+  const char* simd_kind() const { return simd_kind_; }
+
  private:
   GF256();
+  void init_simd();
   int log_[256];
   uint8_t antilog_[512];
   // split nibble tables: nib_[c][0][x] = c*x, nib_[c][1][x] = c*(x<<4)
   uint8_t nib_[256][2][16];
+  // GFNI affine matrices: affine_[c] is the 8x8 GF(2) matrix of
+  // "multiply by c" over THIS field's polynomial (0x11D) in the layout
+  // vgf2p8affineqb expects; validated at init against mul()
+  uint64_t affine_[256];
+  const char* simd_kind_ = "scalar";
+  bool use_gfni_ = false;
+  bool use_avx2_ = false;
 };
 
 }  // namespace ceph_tpu

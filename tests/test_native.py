@@ -144,3 +144,39 @@ def test_native_registry_version_mismatch(native_build, tmp_path):
     )
     assert r.returncode != 0
     assert "-18" in r.stderr  # -EXDEV
+
+
+def test_simd_region_kernel_byte_identical(native_build):
+    """The vectorized region kernel (GFNI affine / AVX2 pshufb) must be
+    byte-identical to the scalar nibble tables across awkward lengths
+    (vector tails) and all coefficient classes — the honest-baseline
+    requirement: a fast-but-wrong baseline would corrupt every consumer."""
+    import subprocess
+    import sys
+
+    from ceph_tpu.ec.gf import gf
+    from ceph_tpu.ec.matrices import vandermonde_coding_matrix
+    from ceph_tpu.native import bridge
+
+    kind = bridge.simd_kind()
+    assert kind in ("gfni", "avx2", "scalar")
+    rng = np.random.default_rng(9)
+    for chunk in (1, 31, 64, 65, 4096 + 17):
+        data = rng.integers(0, 256, (8, chunk), dtype=np.uint8)
+        parity = bridge.rs_encode("reed_sol_van", data, 3)
+        want = gf(8).matmul(vandermonde_coding_matrix(8, 3, 8), data)
+        assert np.array_equal(parity, want), (kind, chunk)
+    # the scalar escape hatch (CEPH_TPU_NO_SIMD=1) produces the same bytes
+    code = (
+        "import numpy as np; from ceph_tpu.native import bridge;"
+        "d = np.arange(8 * 1000, dtype=np.uint8).reshape(8, 1000);"
+        "print(bridge.simd_kind());"
+        "import sys; sys.stdout.buffer.write("
+        "bridge.rs_encode('reed_sol_van', d, 3).tobytes())")
+    out = subprocess.run([sys.executable, "-c", code],
+                         env=dict(os.environ, CEPH_TPU_NO_SIMD="1"),
+                         capture_output=True, timeout=120, check=True)
+    lines = out.stdout.split(b"\n", 1)
+    assert lines[0].strip() == b"scalar"
+    d = np.arange(8 * 1000, dtype=np.uint8).reshape(8, 1000)
+    assert lines[1] == bridge.rs_encode("reed_sol_van", d, 3).tobytes()
