@@ -15,6 +15,8 @@ from typing import Dict, List, Optional, Tuple
 from ceph_tpu.rados.messenger import Messenger
 from ceph_tpu.rados.monclient import MonTargets
 from ceph_tpu.rados.types import (
+    MAuthTicket,
+    MAuthTicketReply,
     MConfigGet,
     MNotifyAck,
     MWatchNotify,
@@ -55,6 +57,17 @@ class RadosClient:
     async def start(self) -> None:
         self.messenger.dispatcher = self._dispatch
         await self.messenger.bind()
+        if self.conf.get("auth_cephx", False):
+            await self._fetch_ticket()
+
+    async def _fetch_ticket(self) -> None:
+        """cephx-lite: obtain a service ticket over the (bootstrap-
+        authenticated) mon connection; OSD dials present it instead of
+        the cluster secret."""
+        reply = await self._mon_rpc(
+            MAuthTicket(entity="client", entity_type="client"))
+        self.messenger.ticket = bytes.fromhex(reply.ticket)
+        self.messenger.session_key = bytes.fromhex(reply.session_key)
 
     async def stop(self) -> None:
         await self.messenger.shutdown()
@@ -82,7 +95,8 @@ class RadosClient:
 
                     traceback.print_exc()  # a broken callback must be loud
             return
-        if isinstance(msg, (MMapReply, MCreatePoolReply, MConfigReply)):
+        if isinstance(msg, (MMapReply, MCreatePoolReply, MConfigReply,
+                            MAuthTicketReply)):
             # the mon echoes our per-RPC tid (like MOSDOp's reqid): a reply
             # landing after its RPC timed out has a stale tid and is dropped
             # instead of fulfilling the next RPC's future
@@ -248,6 +262,13 @@ class RadosClient:
                         await asyncio.sleep(min(0.25 * attempt, 1.0))
                     continue
                 await asyncio.sleep(0.2 * (attempt + 1))
+            except PermissionError:
+                # expired/rotated-away ticket: fetch a fresh one and retry
+                last_error = "ticket rejected"
+                try:
+                    await self._fetch_ticket()
+                except Exception:
+                    await asyncio.sleep(0.2 * (attempt + 1))
             except (ConnectionError, OSError, asyncio.TimeoutError) as e:
                 last_error = f"{type(e).__name__}: {e}"
                 # the target may have died: re-target on a fresh map; if

@@ -307,6 +307,16 @@ class Messenger:
             f"{name}-dispatch", _cget(self.conf, "ms_dispatch_throttle_bytes", 100 << 20)
         )
         self._shutdown = False
+        # cephx-lite state: this entity's service ticket + session key
+        # (initiator side) and the rotating-secret keyring used to
+        # validate presented tickets (acceptor side, daemons only)
+        self.ticket: Optional[bytes] = None
+        self.session_key: Optional[bytes] = None
+        self.keyring = None  # Optional[TicketKeyring]
+        # async callable: re-fetch rotating secrets on a validation miss
+        # (a ticket sealed under a JUST-rotated secret must not be
+        # refused until the periodic refresh happens to run)
+        self.keyring_refresh: Optional[Callable] = None
         # session id -> session Connection, LRU-capped (peers come and go)
         self._sessions: "collections.OrderedDict[str, Connection]" = (
             collections.OrderedDict()
@@ -317,40 +327,81 @@ class Messenger:
 
     # -- handshake -----------------------------------------------------------
 
-    def _auth_tag(self, nonce: bytes) -> str:
+    def _auth_tag(self, nonce: bytes, key: Optional[bytes] = None) -> str:
+        """HMAC proof over a handshake nonce: with a ticket session key
+        when one is in play (cephx role), else the cluster bootstrap
+        secret."""
+        if key is not None:
+            return hmac.new(key, nonce, hashlib.sha256).hexdigest()
         secret = str(_cget(self.conf, "ms_auth_secret", "") or "")
         if not secret:
             return ""
         return hmac.new(secret.encode(), nonce, hashlib.sha256).hexdigest()
 
+    def _secure_key(self, session_key: Optional[bytes],
+                    nonce_a: bytes, nonce_b: bytes) -> Optional[bytes]:
+        """Key material for AES-GCM on-wire mode: the ticket session key,
+        else a key derived from the cluster secret and both nonces."""
+        if session_key is not None:
+            return session_key
+        secret = str(_cget(self.conf, "ms_auth_secret", "") or "")
+        if not secret:
+            return None
+        return hmac.new(secret.encode(), b"onwire" + nonce_a + nonce_b,
+                        hashlib.sha256).digest()
+
+    def _wrap_secure(self, reader, writer, key: bytes):
+        from ceph_tpu.rados.auth import SecureStream
+
+        s = SecureStream(reader, writer, key)
+        return s, s
+
     async def _handshake_out(self, reader, writer, lossless: bool,
-                             session_id: str) -> Tuple[str, bool]:
+                             session_id: str):
+        """Returns (peer_name, resumed, reader, writer) — the pair is
+        AES-GCM wrapped when secure mode was negotiated."""
+        secure_want = bool(_cget(self.conf, "ms_secure_mode", False))
         writer.write(BANNER)
         nonce = random.randbytes(16)
         hello = {"name": self.name, "type": self.entity_type,
                  "nonce": nonce.hex(), "auth": "",
-                 "session": session_id, "lossless": lossless}
+                 "session": session_id, "lossless": lossless,
+                 "secure": secure_want}
+        if self.ticket is not None:
+            hello["ticket"] = self.ticket.hex()
         writer.write(json.dumps(hello).encode() + b"\n")
         await writer.drain()
         banner = await reader.readexactly(len(BANNER))
         if banner != BANNER:
             raise BadFrame("bad banner from peer")
         peer_hello = json.loads(await reader.readline())
-        # acceptor proves knowledge of the secret by tagging OUR nonce
-        expect = self._auth_tag(nonce)
+        key = self.session_key if self.ticket is not None else None
+        # acceptor proves knowledge of the secret (or of OUR ticket's
+        # session key, which only rotating-secret holders can open) by
+        # tagging OUR nonce
+        expect = self._auth_tag(nonce, key)
         if expect and not hmac.compare_digest(peer_hello.get("auth", ""), expect):
             raise PermissionError("peer failed auth (bad cluster secret)")
         # then we prove ourselves by tagging THEIR nonce
         their_nonce = bytes.fromhex(peer_hello.get("nonce", ""))
-        tag = self._auth_tag(their_nonce)
+        tag = self._auth_tag(their_nonce, key)
         writer.write(json.dumps({"auth": tag}).encode() + b"\n")
         await writer.drain()
         fin = json.loads(await reader.readline())
         if not fin.get("ok", False):
             raise PermissionError("peer rejected our auth")
-        return peer_hello.get("name", ""), bool(peer_hello.get("resumed"))
+        if secure_want and peer_hello.get("secure"):
+            skey = self._secure_key(key, nonce, their_nonce)
+            if skey is not None:
+                reader, writer = self._wrap_secure(reader, writer, skey)
+        return (peer_hello.get("name", ""), bool(peer_hello.get("resumed")),
+                reader, writer)
 
-    async def _handshake_in(self, reader, writer) -> Tuple[str, str, str, bool]:
+    async def _handshake_in(self, reader, writer):
+        """Returns (peer_name, peer_type, session, lossless, reader,
+        writer) — the pair is AES-GCM wrapped when secure mode was
+        negotiated."""
+        secure_want = bool(_cget(self.conf, "ms_secure_mode", False))
         banner = await reader.readexactly(len(BANNER))
         if banner != BANNER:
             raise BadFrame("bad banner from peer")
@@ -358,23 +409,49 @@ class Messenger:
         writer.write(BANNER)
         nonce = random.randbytes(16)
         their_nonce = bytes.fromhex(peer_hello.get("nonce", ""))
+        key: Optional[bytes] = None
+        ticket_hex = peer_hello.get("ticket", "")
+        if ticket_hex and self.keyring is not None:
+            tkt = self.keyring.validate(bytes.fromhex(ticket_hex))
+            if tkt is None and self.keyring_refresh is not None:
+                # maybe sealed under a rotation we haven't fetched yet
+                try:
+                    await asyncio.wait_for(self.keyring_refresh(), timeout=2.0)
+                except Exception:
+                    pass
+                tkt = self.keyring.validate(bytes.fromhex(ticket_hex))
+            if tkt is None:
+                # a PRESENTED ticket must verify: silently falling back to
+                # the shared-secret path would let an expired/forged
+                # ticket ride a daemon's bootstrap credentials
+                writer.write(json.dumps({"ok": False}).encode() + b"\n")
+                await writer.drain()
+                raise PermissionError(
+                    f"invalid ticket from {peer_hello.get('name')}")
+            key = tkt["session_key"]
         # tell the initiator whether we still hold its session: if not, it
         # must reset its reply-dedupe floor (our out_seq restarts at 1)
         resumed = peer_hello.get("session", "") in self._sessions
         hello = {"name": self.name, "type": self.entity_type,
-                 "nonce": nonce.hex(), "auth": self._auth_tag(their_nonce),
-                 "resumed": resumed}
+                 "nonce": nonce.hex(),
+                 "auth": self._auth_tag(their_nonce, key),
+                 "resumed": resumed, "secure": secure_want}
         writer.write(json.dumps(hello).encode() + b"\n")
         await writer.drain()
         proof = json.loads(await reader.readline())
-        expect = self._auth_tag(nonce)
+        expect = self._auth_tag(nonce, key)
         ok = not expect or hmac.compare_digest(proof.get("auth", ""), expect)
         writer.write(json.dumps({"ok": ok}).encode() + b"\n")
         await writer.drain()
         if not ok:
             raise PermissionError(f"auth failed for peer {peer_hello.get('name')}")
+        if secure_want and peer_hello.get("secure"):
+            skey = self._secure_key(key, their_nonce, nonce)
+            if skey is not None:
+                reader, writer = self._wrap_secure(reader, writer, skey)
         return (peer_hello.get("name", ""), peer_hello.get("type", "client"),
-                peer_hello.get("session", ""), bool(peer_hello.get("lossless")))
+                peer_hello.get("session", ""), bool(peer_hello.get("lossless")),
+                reader, writer)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -389,9 +466,8 @@ class Messenger:
         self._tasks.add(task)
         try:
             try:
-                peer_name, peer_type, cookie, lossless = await self._handshake_in(
-                    reader, writer
-                )
+                (peer_name, peer_type, cookie, lossless,
+                 reader, writer) = await self._handshake_in(reader, writer)
             except (PermissionError, BadFrame, ConnectionError, json.JSONDecodeError,
                     asyncio.IncompleteReadError, ValueError):
                 writer.close()
@@ -519,7 +595,7 @@ class Messenger:
             session_id = conn.session_id if reviving else random.randbytes(8).hex()
             reader, writer = await asyncio.open_connection(*addr)
             try:
-                peer_name, resumed = await self._handshake_out(
+                peer_name, resumed, reader, writer = await self._handshake_out(
                     reader, writer, policy.replay, session_id
                 )
             except Exception:

@@ -32,10 +32,15 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ceph_tpu.ec.interface import ErasureCodeError
 from ceph_tpu.ec.registry import registry
+from ceph_tpu.rados.auth import KeyServer
 from ceph_tpu.rados.crush import CRUSH_ITEM_NONE, CrushMap
 from ceph_tpu.rados.messenger import Messenger
 from ceph_tpu.rados.paxos import ElectionLogic, MonitorDBStore, Paxos
 from ceph_tpu.rados.types import (
+    MAuthRotating,
+    MAuthRotatingReply,
+    MAuthTicket,
+    MAuthTicketReply,
     MBootReply,
     MConfigGet,
     MConfigReply,
@@ -86,6 +91,19 @@ class Monitor:
         self._next_pool_id = 1
         self._inc_ring: Dict[int, OSDMapIncremental] = {}
         self._published: Optional[OSDMap] = None
+        # cephx-lite key server: rotating service secrets + ticket issue
+        # (reference AuthMonitor/CephxKeyServer); state rides the paxos
+        # snapshot so the quorum shares one ring.  MUST exist before the
+        # state recovery below (it restores the replicated ring).
+        self.keyserver = KeyServer(
+            ttl=float(self.conf.get("auth_ticket_ttl", 3600.0) or 3600.0))
+        # the mon's own acceptor validates tickets against the SAME ring
+        # (OSDs attach their tickets when dialing the mon); .keys shares
+        # the dict so rotation is visible without re-plumbing
+        from ceph_tpu.rados.auth import TicketKeyring
+        kr = TicketKeyring()
+        kr.keys = self.keyserver.secrets
+        self.messenger.keyring = kr
         # recover committed state from a previous life
         _, latest = self.store.latest()
         if latest is not None:
@@ -107,6 +125,7 @@ class Monitor:
         self._applied_tids: "Dict[str, Any]" = {}
         # target_osd -> {reporter: stamp} (OSD failure reports)
         self._failure_reports: Dict[int, Dict[int, float]] = {}
+        self._last_rotation = time.monotonic()
         self._stopped = False
 
     # -- replicated state (de)serialization ----------------------------------
@@ -118,6 +137,8 @@ class Monitor:
                 "cluster_conf": self.cluster_conf,
                 "next_osd_id": self._next_osd_id,
                 "next_pool_id": self._next_pool_id,
+                "auth_keys": (self.keyserver.current_id,
+                              self.keyserver.export_keys()),
             },
             protocol=5,
         )
@@ -130,6 +151,15 @@ class Monitor:
         self.cluster_conf = state["cluster_conf"]
         self._next_osd_id = max(self._next_osd_id, state["next_osd_id"])
         self._next_pool_id = max(self._next_pool_id, state["next_pool_id"])
+        auth = state.get("auth_keys")
+        if auth and auth[0] >= self.keyserver.current_id:
+            # adopt the quorum's rotating secrets: every mon must seal and
+            # open tickets with the SAME ring (reference CephxKeyServer is
+            # paxos-replicated state)
+            self.keyserver.current_id = auth[0]
+            self.keyserver.secrets.clear()
+            self.keyserver.secrets.update(
+                {int(k): bytes.fromhex(v) for k, v in auth[1].items()})
         # publish an incremental for subscribers lagging a few epochs
         # (reference: mon hands out OSDMap::Incremental ranges, full map
         # only when the gap exceeds what it kept)
@@ -404,6 +434,16 @@ class Monitor:
             await asyncio.sleep(min(self._grace / 3, self._lease / 3))
             now = time.monotonic()
             if self.is_leader:
+                # rotate the service secrets each ticket lifetime so a
+                # leaked ticket ages out (reference rotating-key cadence);
+                # the new ring replicates via the commit
+                if now - self._last_rotation > self.keyserver.ttl:
+                    self._last_rotation = now
+                    self.keyserver.rotate()
+                    try:
+                        await self._commit_state()
+                    except NoQuorum:
+                        pass
                 # renew peon leases
                 if len(self.monmap) > 1:
                     for peer in self.logic.quorum:
@@ -483,6 +523,14 @@ class Monitor:
                     pass
         elif isinstance(msg, MGetMap):
             await conn.send(self._map_reply_for(msg.min_epoch, tid=msg.tid))
+        elif isinstance(msg, MAuthTicket):
+            blob, skey = self.keyserver.issue_ticket(
+                msg.entity or conn.peer_name, msg.entity_type)
+            await conn.send(MAuthTicketReply(
+                tid=msg.tid, ticket=blob.hex(), session_key=skey.hex()))
+        elif isinstance(msg, MAuthRotating):
+            await conn.send(MAuthRotatingReply(
+                tid=msg.tid, keys=self.keyserver.export_keys()))
         elif isinstance(msg, MConfigGet):
             values = ({msg.key: self.cluster_conf.get(msg.key, "")}
                       if msg.key else dict(self.cluster_conf))

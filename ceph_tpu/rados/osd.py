@@ -71,7 +71,12 @@ from ceph_tpu.rados.scheduler import (
     ShardedOpQueue,
 )
 from ceph_tpu.rados.store import MemStore, ObjectStore, ShardMeta, Transaction, shard_crc
+from ceph_tpu.rados.auth import TicketKeyring
 from ceph_tpu.rados.types import (
+    MAuthRotating,
+    MAuthRotatingReply,
+    MAuthTicket,
+    MAuthTicketReply,
     MBackfillReserve,
     MBackfillReserveReply,
     MECSubRollback,
@@ -250,6 +255,9 @@ class OSD:
             else:
                 for k, v in cluster_conf.items():
                     self.conf.setdefault(k, v)
+        if self.conf.get("auth_cephx", False):
+            await self._refresh_auth()
+            self.messenger.keyring_refresh = self._refresh_auth
         # through _on_map, NOT direct assignment: a freshly added OSD can
         # already be primary of remapped PGs (crush reshuffles on boot),
         # and those PGs need their peering kicked NOW — waiting for the
@@ -296,6 +304,24 @@ class OSD:
     def mon_addr(self):
         return self.mons.current
 
+    async def _refresh_auth(self) -> None:
+        """cephx-lite daemon setup: fetch the rotating service secrets
+        (ticket validation) and our own service ticket (OSD->OSD dials)
+        from the mon.  Called at boot and periodically so rotations
+        propagate (reference RotatingKeyRing refresh)."""
+        try:
+            rot = await self._mon_rpc(MAuthRotating(), MAuthRotatingReply)
+            if self.messenger.keyring is None:
+                self.messenger.keyring = TicketKeyring()
+            self.messenger.keyring.load(rot.keys)
+            tkt = await self._mon_rpc(
+                MAuthTicket(entity=f"osd.{self.osd_id}", entity_type="osd"),
+                MAuthTicketReply)
+            self.messenger.ticket = bytes.fromhex(tkt.ticket)
+            self.messenger.session_key = bytes.fromhex(tkt.session_key)
+        except Exception as e:
+            self.ctx.log.error("osd", f"auth refresh failed: {e}")
+
     async def _ping_loop(self, interval: float) -> None:
         ticks = 0
         while not self._stopped:
@@ -311,6 +337,11 @@ class OSD:
             ticks += 1
             if ticks % 3 == 0:
                 await self._report_to_mgr()
+            if self.conf.get("auth_cephx", False):
+                ttl = float(self.conf.get("auth_ticket_ttl", 3600.0) or 3600.0)
+                period = max(1, int(ttl / 4 / max(interval, 0.01)))
+                if ticks % period == 0:
+                    await self._refresh_auth()
             await asyncio.sleep(interval)
 
     async def _report_to_mgr(self) -> None:
@@ -481,6 +512,8 @@ class OSD:
                     asyncio.get_running_loop().create_task(self._fetch_full_map())
             self._resolve_monrpc(msg)
         elif isinstance(msg, MBootReply):
+            self._resolve_monrpc(msg)
+        elif isinstance(msg, (MAuthRotatingReply, MAuthTicketReply)):
             self._resolve_monrpc(msg)
         elif isinstance(msg, MOSDPing):
             if msg.op == "ping":

@@ -312,6 +312,40 @@ class MConfigSet:
     remove: bool = False
 
 
+@message(56)
+class MAuthTicket:
+    """Request a service ticket from the mon (reference CEPHX_GET_AUTH_
+    SESSION_KEY): the requester's identity was proven by the mon-
+    connection handshake; the reply carries the sealed ticket plus the
+    session key for the requester's own use."""
+
+    entity: str = ""
+    entity_type: str = "client"
+    tid: str = ""
+
+
+@message(57)
+class MAuthTicketReply:
+    tid: str = ""
+    ticket: str = ""  # hex blob, sealed under the rotating service secret
+    session_key: str = ""  # hex
+
+
+@message(58)
+class MAuthRotating:
+    """OSD fetch of the rotating service secrets (reference
+    CEPHX_GET_ROTATING_KEY) — only daemons holding the cluster bootstrap
+    secret reach this handler (messenger handshake gates it)."""
+
+    tid: str = ""
+
+
+@message(59)
+class MAuthRotatingReply:
+    tid: str = ""
+    keys: Dict[int, str] = field(default_factory=dict)
+
+
 @message(15)
 class MConfigGet:
     tid: str = ""

@@ -15,9 +15,20 @@ each object to the OLDEST clone with snap id >= the requested snapshot,
 falling back to the head (never rewritten) or zeros (never existed) —
 librados self-managed-snap resolution in miniature.
 
-Divergence by design: no mirroring/journaling/layered clones of other
-images — the extent-to-object data path, object-map bookkeeping, and snap
-COW are the core being reproduced.
+Layered clones (reference librbd clone v2, src/librbd/ + cls_rbd
+children bookkeeping): a PROTECTED snapshot can be cloned into a child
+image whose header records the parent (image, snap).  Child reads fall
+through to the parent snapshot for objects the child has never written;
+child writes COPY-UP the parent block first when partially overwriting
+(reference CopyupRequest), so the child diverges object by object.
+``flatten`` copies every remaining parent block into the child and drops
+the parent link; ``snap_unprotect`` refuses while children exist (tracked
+in a pool-level ``rbd_children`` registry, the reference's cls_rbd
+children object).
+
+Divergence by design: no mirroring/journaling — the extent-to-object
+data path, object-map bookkeeping, snap COW, and the clone layer are the
+core being reproduced.
 """
 
 from __future__ import annotations
@@ -66,15 +77,45 @@ class Image:
 
     # -- IO ------------------------------------------------------------------
 
+    async def _parent(self) -> Optional["Image"]:
+        """Open the parent image of a clone.  NOT cached: the parent's
+        header carries the snap COW bookkeeping, and a parent head write
+        after we opened it would otherwise leak post-snap bytes into the
+        child's read-through (the clone must always resolve through the
+        parent's CURRENT clone map)."""
+        p = self._hdr.get("parent")
+        if not p:
+            return None
+        raw = await self.ioctx.read(self._header_oid(p["image"]))
+        return Image(self.ioctx, p["image"], json.loads(raw))
+
+    async def _read_from_parent(self, idx: int,
+                                parent: Optional["Image"] = None) -> bytes:
+        """A clone's view of one object it never wrote: the parent
+        SNAPSHOT's bytes for that block (zeros past the snap's extent) —
+        the read-fall-through half of the reference's clone layering.
+        Callers doing many blocks pass ``parent`` (opened once per call)
+        so each block does not re-read the parent header."""
+        p = self._hdr.get("parent")
+        parent = parent if parent is not None else await self._parent()
+        if parent is None:
+            return b""
+        base = idx * self.object_size
+        limit = min(p["size"], self.size)
+        if base >= limit:
+            return b""
+        n = min(self.object_size, limit - base)
+        return await parent.read_snap(p["snap"], base, n)
+
     async def read(self, offset: int, length: int) -> bytes:
         if offset >= self.size:
             return b""
         length = min(length, self.size - offset)
         objmap = set(self._hdr["object_map"])
+        layered = bool(self._hdr.get("parent"))
         out = bytearray()
         pos = offset
         end = offset + length
-        reads = []
         spans = []
         while pos < end:
             idx = pos // self.object_size
@@ -82,18 +123,21 @@ class Image:
             n = min(self.object_size - off_in, end - pos)
             spans.append((idx, off_in, n))
             pos += n
-        for idx, off_in, n in spans:
+
+        parent = await self._parent() if layered else None
+
+        async def fetch(idx: int):
             if idx in objmap:
-                reads.append(self.ioctx.read(self._data_oid(idx)))
-            else:
-                reads.append(None)
-        datas = await asyncio.gather(*(r for r in reads if r is not None))
-        it = iter(datas)
-        for (idx, off_in, n), r in zip(spans, reads):
-            if r is None:
+                return await self.ioctx.read(self._data_oid(idx))
+            if layered:
+                return await self._read_from_parent(idx, parent)
+            return None
+
+        datas = await asyncio.gather(*(fetch(idx) for idx, _, _ in spans))
+        for (idx, off_in, n), blob in zip(spans, datas):
+            if not blob:
                 out.extend(b"\x00" * n)  # sparse hole
             else:
-                blob = next(it)
                 piece = blob[off_in:off_in + n]
                 out.extend(piece)
                 out.extend(b"\x00" * (n - len(piece)))  # short object tail
@@ -103,6 +147,7 @@ class Image:
         if offset + len(data) > self.size:
             raise RbdError("write beyond image size (resize first)")
         objmap = set(self._hdr["object_map"])
+        layered = bool(self._hdr.get("parent"))
         pos = 0
         dirty_map = False
         while pos < len(data):
@@ -113,6 +158,17 @@ class Image:
             piece = data[pos:pos + n]
             if self._hdr.get("snaps") and await self._cow_before_write(idx):
                 dirty_map = True  # cow bookkeeping rides the same save
+            if (layered and idx not in objmap
+                    and (off_in or n < self.object_size)):
+                # copy-up (reference CopyupRequest): a partial write to a
+                # block the clone never owned must compose with the
+                # PARENT's bytes, not zeros — materialize the parent block
+                # in the child first, then overwrite part of it
+                base = await self._read_from_parent(idx)
+                if base:
+                    await self.ioctx.write_full(self._data_oid(idx), base)
+                    objmap.add(idx)
+                    dirty_map = True
             if idx in objmap and (off_in or n < self.object_size):
                 # partial overwrite rides the OSD's RMW path
                 await self.ioctx.write(self._data_oid(idx), piece,
@@ -238,8 +294,17 @@ class Image:
             spans.append((idx, off_in, n))
             pos += n
 
+        layered = bool(self._hdr.get("parent"))
+        parent = await self._parent() if layered else None
+
         async def resolve(idx: int):
             if idx not in snap["object_map"]:
+                # a clone's snapshot: blocks it never wrote were (and
+                # still are) served by ITS parent snapshot — fall through
+                # so clones-of-clones don't read zeros for
+                # grandparent-backed data
+                if layered:
+                    return await self._read_from_parent(idx, parent)
                 return None
             for snap_id, cow in clones_at:
                 if idx in cow:
@@ -260,6 +325,53 @@ class Image:
                 out.extend(b"\x00" * (n - len(piece)))
         return bytes(out)
 
+    async def snap_protect(self, name: str) -> None:
+        """Mark a snapshot protected — the precondition for cloning
+        (reference: clones may only be made from protected snaps, so a
+        snap can never vanish under its children)."""
+        snap = self._snaps().get(name)
+        if snap is None:
+            raise RbdError(f"no snapshot {name!r}")
+        snap["protected"] = True
+        await self._save_header()
+
+    async def snap_unprotect(self, name: str) -> None:
+        snap = self._snaps().get(name)
+        if snap is None:
+            raise RbdError(f"no snapshot {name!r}")
+        children = await RBD(self.ioctx).children(self.name, name)
+        if children:
+            raise RbdError(
+                f"snapshot {name!r} has children {children}; flatten or "
+                f"remove them first")
+        snap["protected"] = False
+        await self._save_header()
+
+    async def flatten(self) -> None:
+        """Copy every block the clone still reads through its parent into
+        the clone itself, then drop the parent link (reference
+        librbd::flatten) — afterwards the parent snap can be unprotected
+        and the parent removed."""
+        p = self._hdr.get("parent")
+        if not p:
+            return
+        objmap = set(self._hdr["object_map"])
+        limit = min(p["size"], self.size)
+        n_objs = (limit + self.object_size - 1) // self.object_size
+        parent = await self._parent()
+        for idx in range(n_objs):
+            if idx in objmap:
+                continue
+            blob = await self._read_from_parent(idx, parent)
+            if blob and blob.strip(b"\x00"):
+                await self.ioctx.write_full(self._data_oid(idx), blob)
+                objmap.add(idx)
+        self._hdr["object_map"] = sorted(objmap)
+        parent_ref = f"{p['image']}@{p['snap']}"
+        self._hdr.pop("parent", None)
+        await self._save_header()
+        await RBD(self.ioctx)._unregister_child(parent_ref, self.name)
+
     async def snap_remove(self, name: str) -> None:
         """Remove a snapshot.  A clone the removed snap owns may still be
         the resolution target of an OLDER snapshot (no intermediate clone
@@ -267,6 +379,8 @@ class Image:
         snap instead of deleted (the reference's snap-trim keeps clones
         while any snap in the set still needs them)."""
         snaps = self._snaps()
+        if name in snaps and snaps[name].get("protected"):
+            raise RbdError(f"snapshot {name!r} is protected")
         snap = snaps.pop(name, None)
         if snap is None:
             raise RbdError(f"no snapshot {name!r}")
@@ -327,6 +441,64 @@ class RBD:
             raise RbdError(f"image {name!r} does not exist")
         return Image(self.ioctx, name, json.loads(raw))
 
+    CHILDREN_OID = "rbd_children"  # pool-level clone registry (cls_rbd role)
+
+    async def _children_map(self) -> Dict[str, List[str]]:
+        try:
+            return json.loads(await self.ioctx.read(self.CHILDREN_OID))
+        except RadosError:
+            return {}
+
+    async def _register_child(self, parent_ref: str, child: str) -> None:
+        cm = await self._children_map()
+        kids = cm.setdefault(parent_ref, [])
+        if child not in kids:
+            kids.append(child)
+        await self.ioctx.write_full(self.CHILDREN_OID,
+                                    json.dumps(cm).encode())
+
+    async def _unregister_child(self, parent_ref: str, child: str) -> None:
+        cm = await self._children_map()
+        kids = cm.get(parent_ref, [])
+        if child in kids:
+            kids.remove(child)
+            if not kids:
+                cm.pop(parent_ref, None)
+            await self.ioctx.write_full(self.CHILDREN_OID,
+                                        json.dumps(cm).encode())
+
+    async def children(self, image: str, snap: str) -> List[str]:
+        """Clones of image@snap (reference `rbd children`)."""
+        return sorted((await self._children_map()).get(f"{image}@{snap}", []))
+
+    async def clone(self, parent: str, snap: str, child: str,
+                    order: Optional[int] = None) -> Image:
+        """Create a copy-on-write child of a protected parent snapshot
+        (reference librbd clone v2).  The child starts with no objects of
+        its own: reads fall through to the parent snap, writes copy-up."""
+        pimg = await self.open(parent)
+        psnap = pimg._snaps().get(snap)
+        if psnap is None:
+            raise RbdError(f"no snapshot {parent}@{snap}")
+        if not psnap.get("protected"):
+            raise RbdError(f"snapshot {parent}@{snap} is not protected")
+        hdr_oid = Image._header_oid(child)
+        try:
+            await self.ioctx.read(hdr_oid)
+            raise RbdError(f"image {child!r} exists")
+        except RadosError:
+            pass
+        header = {
+            "id": uuid.uuid4().hex[:12],
+            "size": psnap["size"],
+            "order": order if order is not None else pimg._hdr["order"],
+            "object_map": [],
+            "parent": {"image": parent, "snap": snap, "size": psnap["size"]},
+        }
+        await self.ioctx.write_full(hdr_oid, json.dumps(header).encode())
+        await self._register_child(f"{parent}@{snap}", child)
+        return Image(self.ioctx, child, header)
+
     async def remove(self, name: str) -> None:
         """Remove an image.  Refuses while snapshots exist (reference
         librbd behavior: `rbd snap purge` first)."""
@@ -338,6 +510,9 @@ class RBD:
                 await self.ioctx.remove(img._data_oid(idx))
             except RadosError:
                 pass
+        p = img._hdr.get("parent")
+        if p:
+            await self._unregister_child(f"{p['image']}@{p['snap']}", name)
         await self.ioctx.remove(Image._header_oid(name))
 
     async def snap_purge(self, name: str) -> None:

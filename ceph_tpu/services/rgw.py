@@ -7,17 +7,36 @@ striped over RADOS objects (rgw_max_chunk_size-style chunking via the
 striper), and listings come from the bucket index, not pool scans, exactly
 the reference's bucket-index discipline.
 
+Multipart uploads (reference rgw_multipart): POST ?uploads opens an
+upload, PUT ?uploadId=..&partNumber=N stores each part as its own striped
+object, complete-POST records a MANIFEST in the bucket index (the
+reference's RGWObjManifest role) that GET stitches back in part order —
+parts are never rewritten into one blob.  Abort deletes the parts.
+
+Auth (reference rgw_auth + AWS SigV4): when the service is constructed
+with credentials, every request must carry an AWS4-HMAC-SHA256
+Authorization header whose signature verifies over the canonical request
+(method, path, signed headers, payload hash) with the standard SigV4
+signing-key chain (date -> region -> service -> aws4_request).  Unsigned
+requests get 403.  Without configured credentials the gateway stays open
+(the reference's anonymous/system mode), so embedded uses need no keys.
+
 API subset: PUT /b (create bucket), GET / (list buckets), PUT /b/k,
-GET /b/k, DELETE /b/k, GET /b (list objects), HEAD /b/k.  Divergence by
-design: no S3 auth/multipart/versioning/multisite.
+GET /b/k, DELETE /b/k, GET /b (list objects), HEAD /b/k, POST
+/b/k?uploads, PUT /b/k?uploadId&partNumber, POST /b/k?uploadId
+(complete), DELETE /b/k?uploadId (abort).  Divergence by design: no
+versioning/multisite/ACL policies.
 """
 
 from __future__ import annotations
 
 import asyncio
+import hashlib
+import hmac
 import json
+import uuid
 from typing import Dict, List, Optional, Tuple
-from urllib.parse import unquote
+from urllib.parse import parse_qsl, unquote, urlsplit
 
 from ceph_tpu.rados.client import RadosError
 from ceph_tpu.rados.librados import IoCtx
@@ -29,9 +48,12 @@ BUCKETS_ROOT = ".rgw.buckets"  # registry of buckets
 class RgwService:
     """Bucket/object operations (usable directly or via the HTTP frontend)."""
 
-    def __init__(self, ioctx: IoCtx, chunk_size: int = 1 << 20):
+    def __init__(self, ioctx: IoCtx, chunk_size: int = 1 << 20,
+                 credentials: Optional[Dict[str, str]] = None):
         self.ioctx = ioctx
         self.striper = RadosStriper(ioctx, object_size=chunk_size)
+        # access_key -> secret_key; empty = anonymous gateway
+        self.credentials = dict(credentials or {})
 
     @staticmethod
     def _index_oid(bucket: str) -> str:
@@ -66,8 +88,12 @@ class RgwService:
         index = await self._load_index(bucket)
         if index is None:
             raise RadosError(f"NoSuchBucket: {bucket}")
+        prev = index.get(key)
+        if prev and "parts" in prev:
+            await self._drop_object_data(bucket, key, prev)
         await self.striper.write(f"{bucket}/{key}", data)
-        index[key] = {"size": len(data)}
+        index[key] = {"size": len(data),
+                      "etag": hashlib.md5(data).hexdigest()}
         await self._save_index(bucket, index)
 
     async def get_object(self, bucket: str, key: str) -> bytes:
@@ -76,14 +102,37 @@ class RgwService:
             raise RadosError(f"NoSuchBucket: {bucket}")
         if key not in index:
             raise RadosError(f"NoSuchKey: {key}")
+        entry = index[key]
+        if "parts" in entry:
+            # manifest object: stitch the parts in order (RGWObjManifest)
+            blobs = await asyncio.gather(
+                *(self.striper.read(p["oid"]) for p in entry["parts"]))
+            return b"".join(blobs)
         return await self.striper.read(f"{bucket}/{key}")
+
+    async def _drop_object_data(self, bucket: str, key: str,
+                                entry: Optional[Dict]) -> None:
+        """Remove an index entry's backing data: its manifest parts AND
+        the plain striped object — a key may have been written both ways
+        over its lifetime, and replacing a plain object with a multipart
+        manifest (or vice versa) must not orphan the other form."""
+        if entry and "parts" in entry:
+            for p in entry["parts"]:
+                try:
+                    await self.striper.remove(p["oid"])
+                except RadosError:
+                    pass
+        try:
+            await self.striper.remove(f"{bucket}/{key}")
+        except RadosError:
+            pass
 
     async def delete_object(self, bucket: str, key: str) -> None:
         index = await self._load_index(bucket)
         if index is None:
             raise RadosError(f"NoSuchBucket: {bucket}")
-        index.pop(key, None)
-        await self.striper.remove(f"{bucket}/{key}")
+        entry = index.pop(key, None)
+        await self._drop_object_data(bucket, key, entry)
         await self._save_index(bucket, index)
 
     async def list_objects(self, bucket: str) -> Dict[str, Dict]:
@@ -91,6 +140,158 @@ class RgwService:
         if index is None:
             raise RadosError(f"NoSuchBucket: {bucket}")
         return index
+
+    # -- multipart (reference rgw multipart upload machinery) ---------------
+
+    @staticmethod
+    def _upload_meta_oid(bucket: str, upload_id: str) -> str:
+        return f".upload.{bucket}.{upload_id}"
+
+    def _part_oid(self, bucket: str, upload_id: str, part: int) -> str:
+        return f"_mp.{bucket}.{upload_id}.{part:05d}"
+
+    async def initiate_multipart(self, bucket: str, key: str) -> str:
+        if await self._load_index(bucket) is None:
+            raise RadosError(f"NoSuchBucket: {bucket}")
+        upload_id = uuid.uuid4().hex
+        await self.ioctx.write_full(
+            self._upload_meta_oid(bucket, upload_id),
+            json.dumps({"key": key, "parts": {}}).encode())
+        return upload_id
+
+    async def _load_upload(self, bucket: str, upload_id: str) -> Dict:
+        try:
+            return json.loads(await self.ioctx.read(
+                self._upload_meta_oid(bucket, upload_id)))
+        except RadosError:
+            raise RadosError(f"NoSuchUpload: {upload_id}")
+
+    async def upload_part(self, bucket: str, upload_id: str, part: int,
+                          data: bytes) -> str:
+        meta = await self._load_upload(bucket, upload_id)
+        oid = self._part_oid(bucket, upload_id, part)
+        await self.striper.write(oid, data)
+        etag = hashlib.md5(data).hexdigest()
+        meta["parts"][str(part)] = {"oid": oid, "size": len(data),
+                                    "etag": etag}
+        await self.ioctx.write_full(
+            self._upload_meta_oid(bucket, upload_id),
+            json.dumps(meta).encode())
+        return etag
+
+    async def complete_multipart(self, bucket: str, upload_id: str,
+                                 parts: Optional[List[int]] = None) -> str:
+        """Assemble the object from its parts; the bucket index entry
+        becomes a manifest referencing the part objects in order."""
+        meta = await self._load_upload(bucket, upload_id)
+        index = await self._load_index(bucket)
+        if index is None:
+            raise RadosError(f"NoSuchBucket: {bucket}")
+        have = {int(n): p for n, p in meta["parts"].items()}
+        order = sorted(have) if parts is None else list(parts)
+        if not order or any(n not in have for n in order):
+            raise RadosError("InvalidPart: upload has missing parts")
+        key = meta["key"]
+        await self._drop_object_data(bucket, key, index.get(key))
+        manifest = [have[n] for n in order]
+        # S3 multipart etag convention: md5 of concatenated part md5s
+        etag = hashlib.md5(
+            b"".join(bytes.fromhex(p["etag"]) for p in manifest)
+        ).hexdigest() + f"-{len(manifest)}"
+        index[key] = {"size": sum(p["size"] for p in manifest),
+                      "etag": etag, "parts": manifest}
+        await self._save_index(bucket, index)
+        await self.ioctx.remove(self._upload_meta_oid(bucket, upload_id))
+        return etag
+
+    async def abort_multipart(self, bucket: str, upload_id: str) -> None:
+        meta = await self._load_upload(bucket, upload_id)
+        for p in meta["parts"].values():
+            try:
+                await self.striper.remove(p["oid"])
+            except RadosError:
+                pass
+        await self.ioctx.remove(self._upload_meta_oid(bucket, upload_id))
+
+
+# -- SigV4 (reference rgw_auth; AWS Signature Version 4) --------------------
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def signing_key(secret: str, date: str, region: str = "us-east-1",
+                service: str = "s3") -> bytes:
+    k = _hmac(("AWS4" + secret).encode(), date)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    return _hmac(k, "aws4_request")
+
+
+def canonical_request(method: str, path: str, query: str,
+                      headers: Dict[str, str], signed: List[str],
+                      payload_hash: str) -> str:
+    canon_q = "&".join(sorted(
+        f"{k}={v}" for k, v in parse_qsl(query, keep_blank_values=True)))
+    canon_h = "".join(f"{h}:{headers.get(h, '').strip()}\n" for h in signed)
+    return "\n".join([method, path, canon_q, canon_h, ";".join(signed),
+                      payload_hash])
+
+
+def sign_request(access_key: str, secret: str, method: str, path: str,
+                 query: str, headers: Dict[str, str],
+                 payload: bytes) -> Dict[str, str]:
+    """Produce the Authorization (+x-amz-*) headers for a request — the
+    client half, used by tests and any embedded S3 client."""
+    amzdate = headers.get("x-amz-date", "20260101T000000Z")
+    date = amzdate[:8]
+    payload_hash = hashlib.sha256(payload).hexdigest()
+    hdrs = dict(headers)
+    hdrs["x-amz-date"] = amzdate
+    hdrs["x-amz-content-sha256"] = payload_hash
+    hdrs.setdefault("host", "")
+    signed = sorted(["host", "x-amz-content-sha256", "x-amz-date"])
+    scope = f"{date}/us-east-1/s3/aws4_request"
+    creq = canonical_request(method, path, query, hdrs, signed, payload_hash)
+    sts = "\n".join(["AWS4-HMAC-SHA256", amzdate, scope,
+                     hashlib.sha256(creq.encode()).hexdigest()])
+    sig = hmac.new(signing_key(secret, date), sts.encode(),
+                   hashlib.sha256).hexdigest()
+    hdrs["authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+    return hdrs
+
+
+def verify_request(credentials: Dict[str, str], method: str, path: str,
+                   query: str, headers: Dict[str, str],
+                   payload: bytes) -> bool:
+    """Server half: recompute the signature from the stored secret and
+    compare (constant time)."""
+    auth = headers.get("authorization", "")
+    if not auth.startswith("AWS4-HMAC-SHA256 "):
+        return False
+    fields = dict(
+        kv.strip().split("=", 1)
+        for kv in auth[len("AWS4-HMAC-SHA256 "):].split(",") if "=" in kv)
+    cred = fields.get("Credential", "")
+    access_key, _, scope = cred.partition("/")
+    secret = credentials.get(access_key)
+    if secret is None:
+        return False
+    signed = [h for h in fields.get("SignedHeaders", "").split(";") if h]
+    date = scope.split("/")[0] if scope else ""
+    payload_hash = headers.get("x-amz-content-sha256", "")
+    if payload_hash != hashlib.sha256(payload).hexdigest():
+        return False
+    creq = canonical_request(method, path, query, headers, signed,
+                             payload_hash)
+    sts = "\n".join(["AWS4-HMAC-SHA256", headers.get("x-amz-date", ""),
+                     scope, hashlib.sha256(creq.encode()).hexdigest()])
+    want = hmac.new(signing_key(secret, date), sts.encode(),
+                    hashlib.sha256).hexdigest()
+    return hmac.compare_digest(want, fields.get("Signature", ""))
 
 
 class RgwFrontend:
@@ -122,7 +323,7 @@ class RgwFrontend:
                 if not request:
                     return
                 try:
-                    method, path, _ = request.decode().split(" ", 2)
+                    method, target, _ = request.decode().split(" ", 2)
                 except ValueError:
                     return
                 headers = {}
@@ -142,7 +343,16 @@ class RgwFrontend:
                     return
                 if length:
                     body = await reader.readexactly(length)
-                status, payload = await self._route(method, unquote(path), body)
+                url = urlsplit(target)
+                path, query = unquote(url.path), url.query
+                if (self.service.credentials
+                        and not verify_request(self.service.credentials,
+                                               method, path, query, headers,
+                                               body)):
+                    status, payload = "403 Forbidden", b"SignatureDoesNotMatch"
+                else:
+                    status, payload = await self._route(method, path, query,
+                                                        body)
                 writer.write(
                     f"HTTP/1.1 {status}\r\nContent-Length: {len(payload)}\r\n"
                     f"Connection: keep-alive\r\n\r\n".encode() + payload)
@@ -152,9 +362,10 @@ class RgwFrontend:
         finally:
             writer.close()
 
-    async def _route(self, method: str, path: str,
+    async def _route(self, method: str, path: str, query: str,
                      body: bytes) -> Tuple[str, bytes]:
         parts = [p for p in path.split("/") if p]
+        q = dict(parse_qsl(query, keep_blank_values=True))
         try:
             if not parts:
                 if method == "GET":
@@ -171,6 +382,30 @@ class RgwFrontend:
                         await self.service.list_objects(bucket)).encode()
                 return "405 Method Not Allowed", b""
             key = "/".join(parts[1:])
+            if method == "POST" and "uploads" in q:
+                upload_id = await self.service.initiate_multipart(bucket, key)
+                return "200 OK", json.dumps({"UploadId": upload_id}).encode()
+            if method == "POST" and "uploadId" in q:
+                order = None
+                if body:
+                    try:
+                        order = [int(n) for n in json.loads(body)["Parts"]]
+                    except (ValueError, KeyError, TypeError):
+                        return "400 Bad Request", b"MalformedXML"
+                etag = await self.service.complete_multipart(
+                    bucket, q["uploadId"], order)
+                return "200 OK", json.dumps({"ETag": etag}).encode()
+            if method == "PUT" and "uploadId" in q and "partNumber" in q:
+                try:
+                    part = int(q["partNumber"])
+                except ValueError:
+                    return "400 Bad Request", b"InvalidArgument: partNumber"
+                etag = await self.service.upload_part(
+                    bucket, q["uploadId"], part, body)
+                return "200 OK", json.dumps({"ETag": etag}).encode()
+            if method == "DELETE" and "uploadId" in q:
+                await self.service.abort_multipart(bucket, q["uploadId"])
+                return "204 No Content", b""
             if method == "PUT":
                 await self.service.put_object(bucket, key, body)
                 return "200 OK", b""
@@ -189,4 +424,6 @@ class RgwFrontend:
             msg = str(e)
             if "NoSuch" in msg:
                 return "404 Not Found", msg.encode()
+            if "InvalidPart" in msg:
+                return "400 Bad Request", msg.encode()
             return "500 Internal Server Error", msg.encode()

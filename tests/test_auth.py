@@ -1,0 +1,215 @@
+"""cephx-lite: rotating keys, service tickets, AES-GCM secure mode
+(reference src/auth/ CephxKeyServer/CephxServiceTicket + crypto_onwire.cc
+session security)."""
+
+import asyncio
+import os
+
+import pytest
+
+from ceph_tpu.rados.auth import KeyServer, SecureStream, TicketKeyring
+from ceph_tpu.rados.vstart import Cluster
+
+EC_PROFILE = {"plugin": "jerasure", "technique": "reed_sol_van",
+              "k": "2", "m": "1"}
+
+
+def run(coro, timeout=90):
+    asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+class TestTickets:
+    def test_issue_validate_roundtrip(self):
+        ks = KeyServer(ttl=60)
+        kr = TicketKeyring()
+        kr.load(ks.export_keys())
+        blob, skey = ks.issue_ticket("client.admin", "client")
+        t = kr.validate(blob)
+        assert t is not None
+        assert t["entity"] == "client.admin"
+        assert t["session_key"] == skey
+
+    def test_expired_ticket_refused(self):
+        ks = KeyServer(ttl=0.0)
+        kr = TicketKeyring()
+        kr.load(ks.export_keys())
+        blob, _ = ks.issue_ticket("c", "client", now=0.0)
+        assert kr.validate(blob) is None  # expired long ago
+
+    def test_tampered_ticket_refused(self):
+        ks = KeyServer(ttl=60)
+        kr = TicketKeyring()
+        kr.load(ks.export_keys())
+        blob, _ = ks.issue_ticket("c", "client")
+        bad = bytearray(blob)
+        bad[-1] ^= 0xFF
+        assert kr.validate(bytes(bad)) is None
+        assert kr.validate(b"") is None
+
+    def test_rotation_window(self):
+        """A ticket sealed under the previous secret stays valid for one
+        rotation (the reference keeps a window), then ages out."""
+        ks = KeyServer(ttl=60)
+        blob, _ = ks.issue_ticket("c", "client")
+        ks.rotate()
+        kr = TicketKeyring()
+        kr.load(ks.export_keys())
+        assert kr.validate(blob) is not None  # previous secret retained
+        ks.rotate()
+        kr.load(ks.export_keys())
+        assert kr.validate(blob) is None  # two rotations: sealed key gone
+
+
+class TestSecureStream:
+    def test_roundtrip_and_confidentiality(self):
+        async def go():
+            server_got = []
+            key = os.urandom(32)
+            raw_server_bytes = bytearray()
+
+            async def handle(reader, writer):
+                # record the RAW socket bytes, then serve decrypted echo
+                s = SecureStream(reader, writer, key)
+                data = await s.readexactly(26)
+                server_got.append(data)
+                s.write(b"pong:" + data)
+                await s.drain()
+
+            server = await asyncio.start_server(handle, "127.0.0.1", 0)
+            host, port = server.sockets[0].getsockname()[:2]
+            reader, writer = await asyncio.open_connection(host, port)
+            s = SecureStream(reader, writer, key)
+            marker = b"TOPSECRETPLAINTEXTPAYLOAD!"
+            assert len(marker) == 26
+            s.write(marker)
+            await s.drain()
+            echoed = await s.readexactly(31)
+            assert echoed == b"pong:" + marker
+            assert server_got == [marker]
+
+            # confidentiality: the bytes that hit the wire never contain
+            # the plaintext
+            class _W:
+                def __init__(self):
+                    self.buf = bytearray()
+
+                def write(self, b):
+                    self.buf.extend(b)
+
+            w = _W()
+            probe = SecureStream(None, w, key)
+            probe.write(marker)
+            assert marker not in bytes(w.buf)
+            assert len(w.buf) == 4 + 12 + len(marker) + 16  # len+nonce+ct+tag
+            writer.close()
+            server.close()
+
+        run(go())
+
+    def test_wrong_key_fails(self):
+        async def go():
+            async def handle(reader, writer):
+                s = SecureStream(reader, writer, os.urandom(32))
+                try:
+                    await s.readexactly(5)
+                except Exception:
+                    pass
+                writer.close()
+
+            server = await asyncio.start_server(handle, "127.0.0.1", 0)
+            host, port = server.sockets[0].getsockname()[:2]
+            reader, writer = await asyncio.open_connection(host, port)
+            s = SecureStream(reader, writer, os.urandom(32))
+            s.write(b"hello")
+            await s.drain()
+            # server side failed to decrypt; nothing sane comes back
+            writer.close()
+            server.close()
+
+        run(go())
+
+
+class TestCephxCluster:
+    CONF = {
+        "osd_auto_repair": False,
+        "ms_auth_secret": "cluster-bootstrap-secret",
+        "auth_cephx": True,
+        "ms_secure_mode": True,
+    }
+
+    def test_io_with_cephx_and_secure_mode(self):
+        async def go():
+            cluster = Cluster(n_osds=3, conf=dict(self.CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                assert c.messenger.ticket is not None, "no ticket fetched"
+                pool = await c.create_pool("sec", profile=EC_PROFILE)
+                data = os.urandom(50_000)
+                await c.put(pool, "obj", data)
+                assert await c.get(pool, "obj") == data
+                # the live OSD connection is AES-GCM wrapped
+                conn = next(iter(c.messenger._conns.values()))
+                assert isinstance(conn.writer, SecureStream), \
+                    "secure mode negotiated but frames are plaintext"
+                # OSDs validated the ticket via rotating keys
+                osd = next(iter(cluster.osds.values()))
+                assert osd.messenger.keyring is not None
+                assert osd.messenger.keyring.keys
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_garbage_ticket_refused_by_osd(self):
+        async def go():
+            cluster = Cluster(n_osds=3, conf=dict(self.CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("ref", profile=EC_PROFILE)
+                await c.put(pool, "obj", b"data" * 100)
+                # corrupt the ticket and drop live OSD connections: the
+                # next dial must be REFUSED even though the client still
+                # holds the correct cluster secret
+                c.messenger.ticket = os.urandom(64)
+                for conn in list(c.messenger._conns.values()):
+                    await conn.close()
+                c.messenger._conns.clear()
+                osd = next(iter(cluster.osds.values()))
+                with pytest.raises(PermissionError):
+                    await c.messenger.send(osd.addr, __import__(
+                        "ceph_tpu.rados.types", fromlist=["MPing"]
+                    ).MPing())
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_ticket_rotation_refreshes_transparently(self):
+        """With a sub-second ticket TTL the mon rotates keys while IO
+        runs; client ticket refresh + OSD rotating-key refresh must keep
+        IO flowing (reference rotating-key cadence)."""
+        async def go():
+            conf = dict(self.CONF, auth_ticket_ttl=0.8,
+                        mon_lease=0.5, osd_heartbeat_interval=0.1)
+            cluster = Cluster(n_osds=3, conf=conf)
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("rot", profile=EC_PROFILE)
+                first_ring = dict(cluster.mons[0].keyserver.secrets)
+                for i in range(10):
+                    blob = os.urandom(4000)
+                    await c.put(pool, f"o{i}", blob)
+                    assert await c.get(pool, f"o{i}") == blob
+                    await asyncio.sleep(0.3)
+                ring = cluster.mons[0].keyserver.secrets
+                assert set(ring) != set(first_ring), "keys never rotated"
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go(), timeout=120)

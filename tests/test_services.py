@@ -308,3 +308,224 @@ class TestMDS:
                 await cluster.stop()
 
         run(go())
+
+
+class TestRbdClones:
+    def test_layered_clone_read_write_flatten(self):
+        """Clone v2 lifecycle (reference src/librbd/): protect -> clone ->
+        read-through -> copy-up on partial write -> flatten -> unprotect."""
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                rbd = RBD(io)
+                parent = await rbd.create("golden", 2 << 20, order=18)
+                base = os.urandom(600_000)
+                await parent.write(0, base)
+                await parent.snap_create("v1")
+                # clone requires protection (reference precondition)
+                with pytest.raises(RbdError):
+                    await rbd.clone("golden", "v1", "vm1")
+                await parent.snap_protect("v1")
+                child = await rbd.clone("golden", "v1", "vm1")
+                assert await rbd.children("golden", "v1") == ["vm1"]
+                # read-through: the child sees the parent snap's bytes
+                assert await child.read(0, len(base)) == base
+                # parent head diverges AFTER the snap; child must not see it
+                await parent.write(0, b"NEWHEAD")
+                assert (await child.read(0, 7)) == base[:7]
+                # copy-up: a partial child write composes with parent bytes
+                await child.write(100, b"CHILD")
+                got = await child.read(0, 200)
+                assert got[100:105] == b"CHILD"
+                assert got[:100] == base[:100]
+                assert got[105:200] == base[105:200]
+                # the parent is untouched by the child's write
+                assert (await parent.read_snap("v1", 100, 5)) == base[100:105]
+                # protected snap cannot be removed; unprotect blocked by child
+                with pytest.raises(RbdError):
+                    await parent.snap_remove("v1")
+                with pytest.raises(RbdError):
+                    await parent.snap_unprotect("v1")
+                # flatten: child becomes standalone, unprotect now allowed
+                await child.flatten()
+                assert await rbd.children("golden", "v1") == []
+                want = bytearray(base)
+                want[100:105] = b"CHILD"
+                assert await child.read(0, len(base)) == bytes(want)
+                await parent.snap_unprotect("v1")
+                await parent.snap_remove("v1")
+                await rbd.snap_purge("golden")
+                await rbd.remove("golden")
+                # the flattened child still reads after the parent is gone
+                child2 = await rbd.open("vm1")
+                assert (await child2.read(100, 5)) == b"CHILD"
+                await rados.shutdown()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_clone_removal_unregisters(self):
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                rbd = RBD(io)
+                parent = await rbd.create("tmpl", 1 << 20, order=18)
+                await parent.write(0, b"seed" * 1000)
+                await parent.snap_create("s")
+                await parent.snap_protect("s")
+                await rbd.clone("tmpl", "s", "c1")
+                await rbd.clone("tmpl", "s", "c2")
+                assert await rbd.children("tmpl", "s") == ["c1", "c2"]
+                await rbd.remove("c1")
+                assert await rbd.children("tmpl", "s") == ["c2"]
+                await rbd.remove("c2")
+                await parent.snap_unprotect("s")
+                await rados.shutdown()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+
+    def test_clone_of_clone_reads_grandparent_blocks(self):
+        """A clone of a (never-written) clone's snapshot must serve the
+        GRANDPARENT's data for blocks neither descendant ever wrote —
+        read_snap falls through the layer chain, not to zeros."""
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                rbd = RBD(io)
+                a = await rbd.create("A", 1 << 20, order=18)
+                base = os.urandom(400_000)
+                await a.write(0, base)
+                await a.snap_create("s1")
+                await a.snap_protect("s1")
+                b = await rbd.clone("A", "s1", "B")
+                # B writes ONE block only; the rest stays parent-backed
+                await b.write(0, b"BBLOCK")
+                await b.snap_create("s2")
+                await b.snap_protect("s2")
+                c = await rbd.clone("B", "s2", "C")
+                got = await c.read(0, 400_000)
+                assert got[:6] == b"BBLOCK"
+                assert got[6:262144] == base[6:262144]  # B's written block
+                assert got[262144:] == base[262144:], \
+                    "grandparent-backed blocks read as zeros"
+                await c.flatten()
+                assert (await c.read(262144, 100)) == base[262144:262244]
+                await rados.shutdown()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+
+class TestRgwMultipartAuth:
+    def test_multipart_upload_lifecycle(self):
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                svc = RgwService(io, chunk_size=64 * 1024)
+                await svc.create_bucket("mp")
+                upload = await svc.initiate_multipart("mp", "big.bin")
+                p1, p2, p3 = (os.urandom(150_000) for _ in range(3))
+                await svc.upload_part("mp", upload, 2, p2)
+                await svc.upload_part("mp", upload, 1, p1)
+                await svc.upload_part("mp", upload, 3, p3)
+                etag = await svc.complete_multipart("mp", upload)
+                assert etag.endswith("-3")
+                # stitched in PART order regardless of upload order
+                assert await svc.get_object("mp", "big.bin") == p1 + p2 + p3
+                idx = await svc.list_objects("mp")
+                assert idx["big.bin"]["size"] == 450_000
+                # delete cleans the manifest's part objects too
+                await svc.delete_object("mp", "big.bin")
+                with pytest.raises(Exception):
+                    await svc.get_object("mp", "big.bin")
+                # abort path
+                u2 = await svc.initiate_multipart("mp", "never.bin")
+                await svc.upload_part("mp", u2, 1, b"x" * 1000)
+                await svc.abort_multipart("mp", u2)
+                with pytest.raises(Exception):
+                    await svc.complete_multipart("mp", u2)
+                await rados.shutdown()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_sigv4_auth_on_http_frontend(self):
+        """With credentials configured, unsigned requests get 403 and
+        correctly signed SigV4 requests succeed (reference rgw_auth)."""
+        async def go():
+            from ceph_tpu.services.rgw import sign_request
+
+            cluster, rados, io = await _cluster_io()
+            frontend = None
+            try:
+                creds = {"AKIDEXAMPLE": "secretsauce"}
+                svc = RgwService(io, chunk_size=64 * 1024, credentials=creds)
+                frontend = RgwFrontend(svc)
+                host, port = await frontend.start()
+
+                async def http(method, target, body=b"", signed=True,
+                               key="AKIDEXAMPLE", secret="secretsauce"):
+                    from urllib.parse import urlsplit
+
+                    url = urlsplit(target)
+                    headers = {"host": f"{host}:{port}",
+                               "x-amz-date": "20260730T120000Z"}
+                    if signed:
+                        headers = sign_request(key, secret, method, url.path,
+                                               url.query, headers, body)
+                    reader, writer = await asyncio.open_connection(host, port)
+                    hdr_lines = "".join(f"{k}: {v}\r\n"
+                                        for k, v in headers.items())
+                    writer.write(
+                        f"{method} {target} HTTP/1.1\r\n{hdr_lines}"
+                        f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+                    await writer.drain()
+                    status_line = await reader.readline()
+                    rh = {}
+                    while True:
+                        line = await reader.readline()
+                        if line in (b"\r\n", b""):
+                            break
+                        k, _, v = line.decode().partition(":")
+                        rh[k.strip().lower()] = v.strip()
+                    payload = await reader.readexactly(
+                        int(rh.get("content-length", 0)))
+                    writer.close()
+                    return status_line.decode().split(" ", 1)[1].strip(), payload
+
+                # unsigned and wrong-secret requests are refused
+                assert (await http("PUT", "/b", signed=False))[0] == "403 Forbidden"
+                assert (await http("PUT", "/b", secret="wrong"))[0] == "403 Forbidden"
+                # signed requests flow end to end, multipart included
+                assert (await http("PUT", "/b"))[0] == "200 OK"
+                data = os.urandom(99_000)
+                assert (await http("PUT", "/b/k", data))[0] == "200 OK"
+                st, got = await http("GET", "/b/k")
+                assert st == "200 OK" and got == data
+                st, resp = await http("POST", "/b/big?uploads")
+                assert st == "200 OK"
+                upload = json.loads(resp)["UploadId"]
+                pa, pb = os.urandom(70_000), os.urandom(30_000)
+                st, _ = await http(
+                    "PUT", f"/b/big?uploadId={upload}&partNumber=1", pa)
+                assert st == "200 OK"
+                st, _ = await http(
+                    "PUT", f"/b/big?uploadId={upload}&partNumber=2", pb)
+                assert st == "200 OK"
+                st, _ = await http("POST", f"/b/big?uploadId={upload}")
+                assert st == "200 OK"
+                st, got = await http("GET", "/b/big")
+                assert st == "200 OK" and got == pa + pb
+                await rados.shutdown()
+            finally:
+                if frontend:
+                    await frontend.stop()
+                await cluster.stop()
+
+        run(go())
