@@ -247,17 +247,29 @@ class RadosClient:
                 if reply.ok:
                     return reply
                 last_error = reply.error
+                # DEFINITIVE errors are answers, not failures: the primary
+                # executed the op and the result is "no" — retrying (and
+                # paying the epoch-barrier poll) would turn every expected
+                # miss (striper header probes, stat of absent objects)
+                # into a multi-second stall
+                if any(m in reply.error for m in
+                       ("object not found", "no such pool", "EOPNOTSUPP",
+                        "bad op", "ec error")):
+                    raise RadosError(
+                        f"op {op.op} {op.oid} failed: {reply.error}")
                 # epoch barrier: never re-target on a map older than the
                 # replying OSD's (it refused exactly because placement
                 # moved — recomputing on our stale map re-picks it)
-                fence = max(fence, getattr(reply, "map_epoch", 0),
-                            self.osdmap.epoch + 1)
+                fence = max(fence, getattr(reply, "map_epoch", 0))
                 # retryable refusals re-target promptly — the barrier
                 # already orders us behind the newer map — but repeated
                 # bounces mean recovery is still moving seats: give it a
-                # growing (small) window instead of burning retries dry
+                # growing (small) window instead of burning retries dry.
+                # Placement-moved refusals additionally fence PAST our own
+                # epoch (the mapping that picked this primary is wrong).
                 if ("not primary" in reply.error
                         or "degraded" in reply.error):
+                    fence = max(fence, self.osdmap.epoch + 1)
                     if attempt:
                         await asyncio.sleep(min(0.25 * attempt, 1.0))
                     continue

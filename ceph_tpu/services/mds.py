@@ -8,34 +8,205 @@ striped over data-pool objects exactly like the reference's
 ``<ino>.<frag>`` layout (via the striper).  The API mirrors libcephfs's
 shape: mkdir/listdir/stat/write/read/unlink/rename.
 
-Divergence by design: a single MDS with no journaling/subtree migration —
-the namespace-over-objects layout and path-walk semantics are the core
-being reproduced; locking rides the cls lock class when callers need it.
+Journaling (reference src/mds/MDLog.cc + osdc/Journaler): every
+metadata mutation appends a journal EVENT to a segmented journal in the
+metadata pool BEFORE the dirfrag updates are written.  Events record
+idempotent POST-state (set/remove this dentry, ensure/remove this dir),
+so a standby taking over after a crash calls ``mount()``, which replays
+every unexpired event — completing half-applied multi-object updates —
+exactly the reference's up:replay stage.  Fully applied positions are
+expired (LogSegment trim) and their segments removed.
+
+Divergence by design: single-active MDS, no subtree migration —
+namespace-over-objects layout, path-walk semantics, and the journal
+replay/expiry cycle are the core being reproduced; locking rides the
+cls lock class when callers need it.
 """
 
 from __future__ import annotations
 
 import json
 import posixpath
+import struct
 import time
 import uuid
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ceph_tpu.rados.client import RadosError
 from ceph_tpu.rados.librados import IoCtx
 from ceph_tpu.rados.striper import RadosStriper
+
+SEGMENT_EVENTS = 128  # events per journal segment (LogSegment role)
+_REC = struct.Struct("<I")  # length prefix per journal record
 
 
 class FsError(Exception):
     pass
 
 
+class MDLog:
+    """Segmented metadata journal (reference MDLog/Journaler): append
+    length-prefixed JSON events to segment objects; replay probes each
+    segment to its end (a torn tail terminates the scan, exactly the
+    reference's journal-end probe); expiry advances past applied events
+    and removes fully expired segments."""
+
+    HEAD_OID = "mds_journal_head"
+
+    def __init__(self, ioctx: IoCtx):
+        self.ioctx = ioctx
+        self.seg = 0          # segment being appended
+        self.off = 0          # byte offset within it
+        self.expire_seg = 0   # first segment that may hold unapplied events
+        self.count = 0        # events in the current segment
+
+    @staticmethod
+    def _seg_oid(seg: int) -> str:
+        return f"mds_journal.{seg:08d}"
+
+    async def _save_head(self) -> None:
+        await self.ioctx.write_full(self.HEAD_OID, json.dumps(
+            {"expire_seg": self.expire_seg, "write_seg": self.seg}).encode())
+
+    async def load(self) -> List[Dict]:
+        """Read the head and scan unexpired segments; positions the
+        append cursor at the end.  Returns every event that may not have
+        been fully applied (mount() replays them)."""
+        try:
+            head = json.loads(await self.ioctx.read(self.HEAD_OID))
+        except RadosError:
+            head = {"expire_seg": 0, "write_seg": 0}
+        self.expire_seg = head["expire_seg"]
+        events: List[Dict] = []
+        seg = self.expire_seg
+        last_seg, last_off, last_count = head["write_seg"], 0, 0
+        while True:
+            try:
+                blob = await self.ioctx.read(self._seg_oid(seg))
+            except RadosError:
+                if seg <= head["write_seg"]:
+                    seg += 1  # removed/expired segment inside the window
+                    continue
+                break
+            off = count = 0
+            while off + _REC.size <= len(blob):
+                (n,) = _REC.unpack_from(blob, off)
+                if off + _REC.size + n > len(blob):
+                    break  # torn tail: journal ends here
+                try:
+                    events.append(json.loads(
+                        blob[off + _REC.size:off + _REC.size + n]))
+                except ValueError:
+                    break
+                off += _REC.size + n
+                count += 1
+            last_seg, last_off, last_count = seg, off, count
+            seg += 1
+        self.seg, self.off, self.count = last_seg, last_off, last_count
+        return events
+
+    async def append(self, event: Dict) -> None:
+        if self.count >= SEGMENT_EVENTS:
+            self.seg += 1
+            self.off = 0
+            self.count = 0
+            await self._save_head()
+        rec = json.dumps(event).encode()
+        await self.ioctx.write(self._seg_oid(self.seg),
+                               _REC.pack(len(rec)) + rec, offset=self.off)
+        self.off += _REC.size + len(rec)
+        self.count += 1
+
+    async def expire(self) -> None:
+        """Everything appended so far is applied: move the expiry floor
+        to the current segment and drop older segments (LogSegment
+        expiry)."""
+        if self.expire_seg == self.seg:
+            return
+        old, self.expire_seg = self.expire_seg, self.seg
+        await self._save_head()
+        for s in range(old, self.expire_seg):
+            try:
+                await self.ioctx.remove(self._seg_oid(s))
+            except RadosError:
+                pass
+
+
 class FileSystem:
     def __init__(self, meta_ioctx: IoCtx, data_ioctx: Optional[IoCtx] = None,
-                 object_size: int = 1 << 22):
+                 object_size: int = 1 << 22, journal: bool = True):
         self.meta = meta_ioctx
         self.data = data_ioctx or meta_ioctx
         self.striper = RadosStriper(self.data, object_size=object_size)
+        self.mdlog: Optional[MDLog] = MDLog(meta_ioctx) if journal else None
+        self._applied_since_expire = 0
+
+    async def mount(self) -> int:
+        """Recover the namespace: replay unexpired journal events (the
+        up:replay stage a standby runs at takeover).  Returns the number
+        of events replayed.  Safe to call on a fresh filesystem."""
+        if self.mdlog is None:
+            return 0
+        events = await self.mdlog.load()
+        for ev in events:
+            await self._apply_event(ev)
+        if events:
+            await self.mdlog.expire()
+        return len(events)
+
+    # -- journal ------------------------------------------------------------
+
+    async def _journal(self, event: Dict) -> None:
+        if self.mdlog is not None:
+            await self.mdlog.append(event)
+
+    async def _journal_applied(self) -> None:
+        """Called after an op's dirfrag updates landed: periodically
+        expire the journal so replay stays short (the reference expires
+        segments whose events are all flushed)."""
+        if self.mdlog is None:
+            return
+        self._applied_since_expire += 1
+        if self._applied_since_expire >= SEGMENT_EVENTS:
+            self._applied_since_expire = 0
+            await self.mdlog.expire()
+
+    async def _apply_event(self, ev: Dict) -> None:
+        """Idempotent replay of one journal event: events carry POST-
+        state, so applying an already-applied event is a no-op."""
+        op = ev.get("op")
+        if op == "set_dentry":
+            if ev.get("mkdir"):
+                if await self._load_dir(ev["mkdir"]) is None:
+                    await self._save_dir(ev["mkdir"], {})
+            dentries = await self._load_dir(ev["parent"])
+            if dentries is None:
+                return  # parent itself gone (later event removed it)
+            dentries[ev["name"]] = ev["dentry"]
+            await self._save_dir(ev["parent"], dentries)
+        elif op == "rm_dentry":
+            dentries = await self._load_dir(ev["parent"])
+            if dentries is not None and ev["name"] in dentries:
+                del dentries[ev["name"]]
+                await self._save_dir(ev["parent"], dentries)
+            if ev.get("rmdir"):
+                try:
+                    await self.meta.remove(self._dir_oid(ev["rmdir"]))
+                except RadosError:
+                    pass
+            if ev.get("drop_ino"):
+                try:
+                    await self.striper.remove(self._file_oid(ev["drop_ino"]))
+                except RadosError:
+                    pass
+        elif op == "drop_ino":
+            try:
+                await self.striper.remove(self._file_oid(ev["ino"]))
+            except RadosError:
+                pass
+        elif op == "rename":
+            for sub in ev["events"]:
+                await self._apply_event(sub)
 
     # -- dentries ------------------------------------------------------------
 
@@ -85,9 +256,12 @@ class FileSystem:
         parent, name, dentries = await self._parent_of(path)
         if name in dentries:
             raise FsError(f"EEXIST: {path}")
-        await self._save_dir(path, {})
-        dentries[name] = {"type": "dir", "mtime": time.time()}
-        await self._save_dir(parent, dentries)
+        event = {"op": "set_dentry", "parent": parent, "name": name,
+                 "mkdir": path,
+                 "dentry": {"type": "dir", "mtime": time.time()}}
+        await self._journal(event)
+        await self._apply_event(event)
+        await self._journal_applied()
 
     async def listdir(self, path: str) -> List[str]:
         path = self._norm(path)
@@ -112,10 +286,15 @@ class FileSystem:
         if existing and existing["type"] == "dir":
             raise FsError(f"EISDIR: {path}")
         ino = (existing or {}).get("ino") or uuid.uuid4().hex
+        # data first (an inode without a dentry is harmless garbage; a
+        # dentry without data would not be), then journal, then dirfrag
         await self.striper.write(self._file_oid(ino), data)
-        dentries[name] = {"type": "file", "size": len(data),
-                          "mtime": time.time(), "ino": ino}
-        await self._save_dir(parent, dentries)
+        event = {"op": "set_dentry", "parent": parent, "name": name,
+                 "dentry": {"type": "file", "size": len(data),
+                            "mtime": time.time(), "ino": ino}}
+        await self._journal(event)
+        await self._apply_event(event)
+        await self._journal_applied()
 
     async def read_file(self, path: str) -> bytes:
         path = self._norm(path)
@@ -133,18 +312,17 @@ class FileSystem:
         ent = dentries.get(name)
         if ent is None:
             raise FsError(f"ENOENT: {path}")
+        event = {"op": "rm_dentry", "parent": parent, "name": name}
         if ent["type"] == "dir":
             children = await self._load_dir(path)
             if children:
                 raise FsError(f"ENOTEMPTY: {path}")
-            try:
-                await self.meta.remove(self._dir_oid(path))
-            except RadosError:
-                pass
+            event["rmdir"] = path
         else:
-            await self.striper.remove(self._file_oid(ent["ino"]))
-        del dentries[name]
-        await self._save_dir(parent, dentries)
+            event["drop_ino"] = ent["ino"]
+        await self._journal(event)
+        await self._apply_event(event)
+        await self._journal_applied()
 
     async def rename(self, src: str, dst: str) -> None:
         """Dentry-only move: the inode id stays, so no data transfer and
@@ -161,20 +339,21 @@ class FileSystem:
             raise FsError(f"EISDIR: {dst}")
         if src == dst:
             return
-        if dparent == sparent:
-            old_dst = sdentries.get(dname)
-            sdentries[dname] = ent
-            del sdentries[sname]
-            await self._save_dir(sparent, sdentries)
-        else:
-            old_dst = ddentries.get(dname)
-            ddentries[dname] = ent
-            await self._save_dir(dparent, ddentries)
-            del sdentries[sname]
-            await self._save_dir(sparent, sdentries)
-        # an overwritten destination file's data objects are unreferenced
+        old_dst = (sdentries if dparent == sparent else ddentries).get(dname)
+        # one journal event covering the whole multi-object update: set
+        # the destination dentry FIRST, then drop the source (replay
+        # after a crash between the two completes the move; worst case
+        # both dentries briefly exist, never neither — the reference's
+        # EUpdate orders its metablob the same way)
+        subs = [{"op": "set_dentry", "parent": dparent, "name": dname,
+                 "dentry": ent},
+                {"op": "rm_dentry", "parent": sparent, "name": sname}]
         if old_dst and old_dst.get("ino") and old_dst["ino"] != ent.get("ino"):
-            await self.striper.remove(self._file_oid(old_dst["ino"]))
+            subs.append({"op": "drop_ino", "ino": old_dst["ino"]})
+        event = {"op": "rename", "events": subs}
+        await self._journal(event)
+        await self._apply_event(event)
+        await self._journal_applied()
 
     async def walk(self, path: str = "/") -> Dict:
         """Recursive tree dump (debugging/`ceph fs dump` role)."""
