@@ -102,7 +102,9 @@ def main() -> int:
         rtts.append(time.perf_counter() - t0)
     rtt = min(rtts)
 
-    iters = int(os.environ.get("BENCH_ITERS", "32" if backend == "tpu" else "4"))
+    # enough iterations that compute time >> the tunnel's ~70 ms RTT —
+    # at 32 the subtraction left the number swinging 2x run to run
+    iters = int(os.environ.get("BENCH_ITERS", "256" if backend == "tpu" else "4"))
 
     @jax.jit
     def loop(m, x):
