@@ -43,7 +43,7 @@ class MCrashReport:
 
 
 class MgrDaemon:
-    def __init__(self, conf: Optional[dict] = None):
+    def __init__(self, conf: Optional[dict] = None, mon_addrs=None):
         self.conf = conf or {}
         self.messenger = Messenger("mgr", self.conf, entity_type="mgr")
         self.reports: Dict[str, MMgrReport] = {}
@@ -51,6 +51,12 @@ class MgrDaemon:
         self.addr: Optional[Tuple[str, int]] = None
         self._http: Optional[asyncio.AbstractServer] = None
         self.http_addr: Optional[Tuple[str, int]] = None
+        # active modules (reference mgr/balancer + mgr/pg_autoscaler):
+        # enabled when the mgr knows the mons and conf turns them on
+        self.mon_addrs = mon_addrs
+        self._modules_task: Optional[asyncio.Task] = None
+        self.balancer_rounds = 0
+        self.autoscaler_changes = 0
 
     async def start(self) -> Tuple[str, int]:
         self.messenger.dispatcher = self._dispatch
@@ -58,9 +64,15 @@ class MgrDaemon:
         self._http = await asyncio.start_server(self._serve_http,
                                                 "127.0.0.1", 0)
         self.http_addr = self._http.sockets[0].getsockname()[:2]
+        if self.mon_addrs and (self.conf.get("mgr_balancer", False)
+                               or self.conf.get("mgr_pg_autoscaler", False)):
+            self._modules_task = asyncio.get_running_loop().create_task(
+                self._run_modules())
         return self.addr
 
     async def stop(self) -> None:
+        if self._modules_task:
+            self._modules_task.cancel()
         if self._http:
             self._http.close()
             try:
@@ -68,6 +80,49 @@ class MgrDaemon:
             except asyncio.TimeoutError:
                 pass
         await self.messenger.shutdown()
+
+    async def _run_modules(self) -> None:
+        """Periodic active-module tick: read the map, compute proposals,
+        apply them through mon commands."""
+        from ceph_tpu.mgr.modules import Balancer, PgAutoscaler
+        from ceph_tpu.rados.client import RadosClient
+        from ceph_tpu.rados.types import MPoolSet, MSetUpmap
+
+        interval = float(self.conf.get("mgr_module_interval", 5.0))
+        balancer = Balancer()
+        scaler = PgAutoscaler(
+            target_objects_per_pg=int(
+                self.conf.get("mgr_target_objects_per_pg", 32)))
+        client = RadosClient(self.mon_addrs, self.conf)
+        await client.start()
+        try:
+            while True:
+                await asyncio.sleep(interval)
+                try:
+                    osdmap = await client.refresh_map()
+                    if self.conf.get("mgr_balancer", False):
+                        for pool_id, pg, seats in balancer.compute(osdmap):
+                            await client._mon_rpc(MSetUpmap(
+                                pool_id=pool_id, pg=pg, acting=seats))
+                            self.balancer_rounds += 1
+                    if self.conf.get("mgr_pg_autoscaler", False):
+                        for pool in list(osdmap.pools.values()):
+                            try:
+                                oids = await client.list_objects(pool.pool_id)
+                            except Exception:
+                                continue
+                            want = scaler.compute(pool, len(oids))
+                            if want is not None:
+                                await client._mon_rpc(MPoolSet(
+                                    pool_id=pool.pool_id, key="pg_num",
+                                    value=str(want)))
+                                self.autoscaler_changes += 1
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    continue  # mon unreachable this tick: try again
+        finally:
+            await client.stop()
 
     async def _dispatch(self, conn, msg) -> None:
         if isinstance(msg, MMgrReport):

@@ -26,6 +26,8 @@ from ceph_tpu.rados.types import (
     MCreatePoolReply,
     MGetMap,
     MMapReply,
+    MPoolSet,
+    MSetUpmap,
     MMarkDown,
     MOSDOp,
     MOSDOpReply,
@@ -180,6 +182,20 @@ class RadosClient:
     async def config_get(self, key: str = "") -> Dict[str, str]:
         reply = await self._mon_rpc(MConfigGet(key=key))
         return reply.values
+
+    async def set_upmap(self, pool_id: int, pg: int,
+                        acting: Optional[List[int]] = None) -> None:
+        """Install (or clear, with acting=None) a persistent placement
+        override — `ceph osd pg-upmap-items` role."""
+        await self._mon_rpc(MSetUpmap(pool_id=pool_id, pg=pg,
+                                      acting=list(acting or [])))
+        await self.refresh_map()
+
+    async def pool_set(self, pool_id: int, key: str, value) -> None:
+        """`ceph osd pool set` role (pg_num drives PG splitting)."""
+        await self._mon_rpc(MPoolSet(pool_id=pool_id, key=key,
+                                     value=str(value)))
+        await self.refresh_map()
 
     async def mark_osd_down(self, osd_id: int) -> None:
         """Admin: immediately mark an OSD down+out (test/thrash hook)."""

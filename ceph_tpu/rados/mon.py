@@ -57,6 +57,8 @@ from ceph_tpu.rados.types import (
     MOSDFailure,
     MOSDPGTemp,
     MOsdBoot,
+    MPoolSet,
+    MSetUpmap,
     MPing,
     OSDMap,
     OSDMapIncremental,
@@ -501,7 +503,7 @@ class Monitor:
     # -- dispatch ------------------------------------------------------------
 
     WRITE_TYPES = (MOsdBoot, MCreatePool, MMarkDown, MConfigSet, MOSDFailure,
-                   MOSDPGTemp)
+                   MOSDPGTemp, MSetUpmap, MPoolSet)
 
     async def _dispatch(self, conn, msg) -> None:
         if isinstance(msg, MMonElection):
@@ -685,14 +687,17 @@ class Monitor:
             changed = False
             if msg.acting:
                 pool = self.osdmap.pools.get(msg.pool_id)
+                live_req = [a for a in msg.acting if a != CRUSH_ITEM_NONE]
                 valid = (
                     pool is not None
                     and msg.pg < pool.pg_num
+                    and len(set(live_req)) == len(live_req)
                     and all(a == CRUSH_ITEM_NONE or a in self.osdmap.osds
                             for a in msg.acting)
-                    # an override equal to the crush mapping is a no-op
-                    # that would only linger in the map
-                    and list(msg.acting) != self.osdmap.pg_to_raw(pool, msg.pg)
+                    # an override equal to the effective placement (crush
+                    # adjusted by upmap) is a no-op that would only linger
+                    and list(msg.acting) != self.osdmap.pg_to_placed(pool,
+                                                                     msg.pg)
                 )
                 if valid and self.osdmap.pg_temp.get(key) != list(msg.acting):
                     self.osdmap.pg_temp[key] = list(msg.acting)
@@ -703,6 +708,51 @@ class Monitor:
             if changed:
                 self.osdmap.epoch += 1
                 await self._commit_state()
+            return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+        if isinstance(msg, MSetUpmap):
+            # balancer-installed persistent override (pg-upmap role)
+            key = (msg.pool_id, msg.pg)
+            pool = self.osdmap.pools.get(msg.pool_id)
+            changed = False
+            if msg.acting:
+                live_req = [a for a in msg.acting if a != CRUSH_ITEM_NONE]
+                valid = (
+                    pool is not None and msg.pg < pool.pg_num
+                    and len(msg.acting) == pool.size
+                    and len(set(live_req)) == len(live_req)
+                    and all(a == CRUSH_ITEM_NONE or a in self.osdmap.osds
+                            for a in msg.acting)
+                )
+                if valid and self.osdmap.pg_upmap.get(key) != list(msg.acting):
+                    self.osdmap.pg_upmap[key] = list(msg.acting)
+                    changed = True
+            elif key in self.osdmap.pg_upmap:
+                self.osdmap.pg_upmap.pop(key)
+                changed = True
+            if changed:
+                self.osdmap.epoch += 1
+                await self._commit_state()
+            return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+        if isinstance(msg, MPoolSet):
+            pool = self.osdmap.pools.get(msg.pool_id)
+            if pool is None:
+                return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+            if msg.key == "pg_num":
+                try:
+                    n = int(msg.value)
+                except ValueError:
+                    return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+                if 0 < n <= 4096 and n != pool.pg_num:
+                    import dataclasses as _dc
+
+                    new_pool = _dc.replace(pool, pg_num=n)
+                    self.osdmap.pools[msg.pool_id] = new_pool
+                    # overrides keyed on the old pg space are meaningless
+                    for d in (self.osdmap.pg_temp, self.osdmap.pg_upmap):
+                        for k in [k for k in d if k[0] == msg.pool_id]:
+                            d.pop(k, None)
+                    self.osdmap.epoch += 1
+                    await self._commit_state()
             return MMapReply(osdmap=self.osdmap, tid=msg.tid)
         if isinstance(msg, MConfigSet):
             if not msg.remove:
@@ -729,7 +779,7 @@ class Monitor:
         if isinstance(msg, MConfigSet):
             return MConfigReply(tid=tid, ok=False, error=error)
         if isinstance(msg, (MMarkDown, MGetMap, MPing, MOSDFailure,
-                            MOSDPGTemp)):
+                            MOSDPGTemp, MSetUpmap, MPoolSet)):
             return MMapReply(osdmap=self.osdmap, tid=tid)
         if isinstance(msg, MOsdBoot):
             return MBootReply(osd_id=-1, osdmap=self.osdmap, tid=tid)

@@ -625,12 +625,31 @@ class OSD:
                 old_pool = old.pools.get(pool.pool_id)
                 if old_pool is None:
                     continue
-                for pg in range(min(pool.pg_num, old_pool.pg_num)):
+                if old_pool.pg_num != pool.pg_num:
+                    # PG split/merge: every object REHASHES, so any OSD
+                    # that held any of the pool's PGs may hold objects of
+                    # any NEW pg — seed every new pg's interval history
+                    # with the union of the old mapping's members, or
+                    # backfill/hunt scope would never visit the old
+                    # holders and the data would sit stranded
+                    old_members = set()
+                    for opg in range(old_pool.pg_num):
+                        old_members.update(
+                            a for a in old.pg_to_acting(old_pool, opg)
+                            if a != CRUSH_ITEM_NONE)
+                    for npg in range(pool.pg_num):
+                        self._past_members.setdefault(
+                            (pool.pool_id, npg), set()).update(old_members)
+                for pg in range(max(pool.pg_num, old_pool.pg_num)):
                     key = (pool.pool_id, pg)
-                    oa = old.pg_to_acting(old_pool, pg)
-                    if oa == osdmap.pg_to_acting(pool, pg):
+                    oa = (old.pg_to_acting(old_pool, pg)
+                          if pg < old_pool.pg_num else [])
+                    na = (osdmap.pg_to_acting(pool, pg)
+                          if pg < pool.pg_num else [])
+                    if oa == na:
                         continue
-                    changed_pgs.append((pool, pg))
+                    if pg < pool.pg_num:  # a shrunk-away pg needs no kick
+                        changed_pgs.append((pool, pg))
                     self._past_members.setdefault(key, set()).update(
                         a for a in oa if a != CRUSH_ITEM_NONE)
                     if key in old.pg_temp and key not in osdmap.pg_temp:
@@ -677,6 +696,8 @@ class OSD:
         """True when something that can move a PG mapping changed between
         two maps: OSD up/in/weight states, pools, pg_temp, or crush."""
         if old.pg_temp != new.pg_temp or old.pools != new.pools:
+            return True
+        if old.pg_upmap != new.pg_upmap:
             return True
         if old.primary_affinity != new.primary_affinity:
             return True
@@ -2763,7 +2784,7 @@ class OSD:
         return [
             a if a != CRUSH_ITEM_NONE and self.osdmap.osds.get(a)
             and self.osdmap.osds[a].up else CRUSH_ITEM_NONE
-            for a in self.osdmap.pg_to_raw(pool, pg)
+            for a in self.osdmap.pg_to_placed(pool, pg)
         ]
 
     async def _maybe_request_pg_temp(self, pool: PoolInfo, pg: int,

@@ -52,6 +52,10 @@ class OSDMap:
     pools: Dict[int, PoolInfo] = field(default_factory=dict)
     crush: CrushMap = field(default_factory=lambda: CrushMap.flat([]))
     pg_temp: Dict[Tuple[int, int], List[int]] = field(default_factory=dict)
+    # persistent placement overrides installed by the balancer (reference
+    # pg_upmap_items): applied over the crush result, NOT auto-cleared by
+    # recovery (unlike pg_temp, which is a transient serving override)
+    pg_upmap: Dict[Tuple[int, int], List[int]] = field(default_factory=dict)
     primary_affinity: Dict[int, float] = field(default_factory=dict)
 
     def pool_by_name(self, name: str) -> Optional[PoolInfo]:
@@ -63,6 +67,12 @@ class OSDMap:
     def object_to_pg(self, pool: PoolInfo, oid: str) -> int:
         h = hashlib.blake2s(oid.encode(), digest_size=4).digest()
         return int.from_bytes(h, "little") % pool.pg_num
+
+    def pg_to_placed(self, pool: PoolInfo, pg: int) -> List[int]:
+        """The PG's intended placement: crush adjusted by pg_upmap (the
+        up set before liveness filtering and pg_temp serving overrides)."""
+        upmap = self.pg_upmap.get((pool.pool_id, pg))
+        return list(upmap) if upmap is not None else self.pg_to_raw(pool, pg)
 
     def pg_to_raw(self, pool: PoolInfo, pg: int) -> List[int]:
         """CRUSH output before up/pg_temp filtering (_pg_to_raw_osds)."""
@@ -76,10 +86,16 @@ class OSDMap:
     def pg_to_acting(self, pool: PoolInfo, pg: int) -> List[int]:
         """Acting set for a PG: crush indep over in+weighted OSDs; up=false
         members become holes (EC positions are stable; holes stay holes).
-        A pg_temp entry overrides the crush result wholesale
-        (_pg_to_up_acting_osds applying pg_temp, OSDMap.cc:2673)."""
+        A pg_temp entry overrides the (upmap-adjusted) crush result
+        wholesale (_pg_to_up_acting_osds applying pg_upmap then pg_temp,
+        OSDMap.cc:2673)."""
         temp = self.pg_temp.get((pool.pool_id, pg))
-        acting = list(temp) if temp is not None else self.pg_to_raw(pool, pg)
+        if temp is not None:
+            acting = list(temp)
+        else:
+            upmap = self.pg_upmap.get((pool.pool_id, pg))
+            acting = list(upmap) if upmap is not None \
+                else self.pg_to_raw(pool, pg)
         return [
             a if a != CRUSH_ITEM_NONE and self.osds.get(a) and self.osds[a].up
             else CRUSH_ITEM_NONE
@@ -126,6 +142,11 @@ class OSDMap:
                 self.pg_temp[key] = acting
             else:
                 self.pg_temp.pop(key, None)
+        for key, acting in getattr(inc, "new_pg_upmap", {}).items():
+            if acting:
+                self.pg_upmap[key] = acting
+            else:
+                self.pg_upmap.pop(key, None)
         for osd_id, aff in inc.new_primary_affinity.items():
             self.primary_affinity[osd_id] = aff
         if inc.crush is not None:
@@ -147,6 +168,7 @@ class OSDMapIncremental:
     new_pools: Dict[int, PoolInfo] = field(default_factory=dict)
     removed_pools: List[int] = field(default_factory=list)
     new_pg_temp: Dict[Tuple[int, int], List[int]] = field(default_factory=dict)
+    new_pg_upmap: Dict[Tuple[int, int], List[int]] = field(default_factory=dict)
     new_primary_affinity: Dict[int, float] = field(default_factory=dict)
     crush: Optional[CrushMap] = None
 
@@ -174,6 +196,12 @@ class OSDMapIncremental:
         for key in old.pg_temp:
             if key not in new.pg_temp:
                 inc.new_pg_temp[key] = []
+        for key, acting in new.pg_upmap.items():
+            if old.pg_upmap.get(key) != acting:
+                inc.new_pg_upmap[key] = acting
+        for key in old.pg_upmap:
+            if key not in new.pg_upmap:
+                inc.new_pg_upmap[key] = []
         for osd_id, aff in new.primary_affinity.items():
             if old.primary_affinity.get(osd_id) != aff:
                 inc.new_primary_affinity[osd_id] = aff
@@ -344,6 +372,28 @@ class MAuthRotating:
 class MAuthRotatingReply:
     tid: str = ""
     keys: Dict[int, str] = field(default_factory=dict)
+
+
+@message(60)
+class MSetUpmap:
+    """Balancer-installed placement override (reference pg-upmap): empty
+    acting clears the entry.  A mon write op; replicated via the map."""
+
+    pool_id: int = 0
+    pg: int = 0
+    acting: List[int] = field(default_factory=list)
+    tid: str = ""
+
+
+@message(61)
+class MPoolSet:
+    """Adjust a pool parameter (reference `ceph osd pool set`); the
+    pg_autoscaler drives pg_num through this."""
+
+    pool_id: int = 0
+    key: str = ""
+    value: str = ""
+    tid: str = ""
 
 
 @message(15)

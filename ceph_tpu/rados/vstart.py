@@ -79,7 +79,7 @@ class Cluster:
         if self.with_mgr:
             from ceph_tpu.mgr.daemon import MgrDaemon
 
-            self.mgr = MgrDaemon(self.conf)
+            self.mgr = MgrDaemon(self.conf, mon_addrs=self.mon_addrs)
             addr = await self.mgr.start()
             # daemons discover the mgr through config (mgrmap role)
             self.conf["mgr_addr"] = f"{addr[0]}:{addr[1]}"

@@ -1,0 +1,236 @@
+"""Active mgr modules: upmap balancer and pg_autoscaler (reference
+src/pybind/mgr/balancer + pg_autoscaler), plus the pg-upmap map machinery
+and pg_num splitting they drive."""
+
+import asyncio
+import os
+
+import pytest
+
+from ceph_tpu.mgr.modules import Balancer, PgAutoscaler
+from ceph_tpu.rados.vstart import Cluster
+
+CONF = {
+    "mon_osd_report_grace": 0.8,
+    "osd_heartbeat_interval": 0.2,
+    "osd_repair_delay": 0.2,
+}
+EC_PROFILE = {"plugin": "jerasure", "technique": "reed_sol_van",
+              "k": "2", "m": "1"}
+
+
+def run(coro, timeout=120):
+    asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+class TestBalancerCompute:
+    def test_proposals_reduce_spread(self):
+        async def go():
+            cluster = Cluster(n_osds=5, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                await c.create_pool("b", pg_num=16, profile=dict(EC_PROFILE))
+                osdmap = c.osdmap
+                counts = Balancer.seat_counts(osdmap)
+                # skew the map: upmap several PGs onto one OSD
+                hot = max(counts, key=counts.get)
+                pool = osdmap.pools[1]
+                moved = 0
+                for pg in range(pool.pg_num):
+                    seats = osdmap.pg_to_placed(pool, pg)
+                    if hot not in seats and moved < 4:
+                        osdmap.pg_upmap[(1, pg)] = [hot] + [
+                            s for s in seats[1:]]
+                        moved += 1
+                before = Balancer.seat_counts(osdmap)
+                spread0 = max(before.values()) - min(before.values())
+                assert spread0 >= 2
+                props = Balancer(max_changes_per_round=8).compute(osdmap)
+                assert props, "balancer proposed nothing for a skewed map"
+                for pool_id, pg, seats in props:
+                    osdmap.pg_upmap[(pool_id, pg)] = seats
+                after = Balancer.seat_counts(osdmap)
+                spread1 = max(after.values()) - min(after.values())
+                assert spread1 <= 1, (before, after)
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+
+class TestPgAutoscalerCompute:
+    def test_thresholded_pow2_proposals(self):
+        from ceph_tpu.rados.types import PoolInfo
+
+        pool = PoolInfo(pool_id=1, name="p", pool_type="ec", pg_num=4,
+                        size=3, min_size=2)
+        sc = PgAutoscaler(target_objects_per_pg=32)
+        # within band: no change
+        assert sc.compute(pool, 100) is None
+        # far above: grow to a power of two
+        want = sc.compute(pool, 32 * 64)
+        assert want == 64
+        # far below from a big pool: shrink
+        big = PoolInfo(pool_id=1, name="p", pool_type="ec", pg_num=128,
+                       size=3, min_size=2)
+        assert sc.compute(big, 10) == 4
+
+
+class TestUpmapMachinery:
+    def test_upmap_overrides_placement_and_survives_recovery(self):
+        async def go():
+            cluster = Cluster(n_osds=5, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("um", pg_num=8,
+                                           profile=dict(EC_PROFILE))
+                blobs = {}
+                for i in range(12):
+                    blobs[f"o{i}"] = os.urandom(8000)
+                    await c.put(pool, f"o{i}", blobs[f"o{i}"])
+                p = c.osdmap.pools[pool]
+                pg = c.osdmap.object_to_pg(p, "o0")
+                seats = c.osdmap.pg_to_placed(p, pg)
+                spare = next(o.osd_id for o in c.osdmap.osds.values()
+                             if o.osd_id not in seats)
+                new_seats = [spare] + list(seats[1:])
+                await c.set_upmap(pool, pg, new_seats)
+                assert c.osdmap.pg_to_placed(p, pg) == new_seats
+                assert c.osdmap.pg_to_acting(p, pg) == new_seats
+                # recovery migrates the data to the new seats; the upmap
+                # is NOT auto-cleared (unlike pg_temp)
+                for _ in range(100):
+                    await asyncio.sleep(0.2)
+                    tgt = cluster.osds[spare]
+                    have = {o for o, _s in tgt.store.list_objects(pool)}
+                    if any(c.osdmap.object_to_pg(p, o) == pg for o in have
+                           if not o.startswith("__")):
+                        break
+                await c.refresh_map()
+                assert (pool, pg) in c.osdmap.pg_upmap
+                for oid, blob in blobs.items():
+                    assert await c.get(pool, oid) == blob
+                # clearing restores the crush placement
+                await c.set_upmap(pool, pg, None)
+                assert (pool, pg) not in c.osdmap.pg_upmap
+                assert c.osdmap.pg_to_placed(p, pg) == seats
+                for oid, blob in blobs.items():
+                    assert await c.get(pool, oid) == blob
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+
+class TestPgSplitting:
+    def test_pg_num_change_migrates_and_data_survives(self):
+        """The autoscaler's actuator: raising pg_num rehashes every
+        object; event-driven peering + backfill + shard hunts keep all
+        data readable through and after the migration."""
+        async def go():
+            cluster = Cluster(n_osds=5, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("sp", pg_num=4,
+                                           profile=dict(EC_PROFILE))
+                blobs = {}
+                for i in range(20):
+                    blobs[f"x{i}"] = os.urandom(6000)
+                    await c.put(pool, f"x{i}", blobs[f"x{i}"])
+                await c.pool_set(pool, "pg_num", 8)
+                assert c.osdmap.pools[pool].pg_num == 8
+                # every object stays readable THROUGH the migration
+                for oid, blob in blobs.items():
+                    assert await c.get(pool, oid) == blob
+                await asyncio.sleep(3.0)  # let backfill settle
+                # and survives a failure AFTER it (redundancy at the new
+                # mapping, not just stale copies at the old one)
+                victim = next(iter(cluster.osds))
+                await cluster.kill_osd(victim)
+                await asyncio.sleep(2.5)
+                await c.refresh_map()
+                for oid, blob in blobs.items():
+                    assert await c.get(pool, oid) == blob
+                # writes land at the new mapping too
+                await c.put(pool, "post-split", b"fresh")
+                assert await c.get(pool, "post-split") == b"fresh"
+            finally:
+                await cluster.stop()
+
+        run(go(), timeout=180)
+
+
+class TestMgrActiveModules:
+    def test_autoscaler_end_to_end(self):
+        """The mgr's module loop observes an overloaded pool and raises
+        its pg_num through the mon."""
+        async def go():
+            conf = dict(CONF, mgr_pg_autoscaler=True,
+                        mgr_module_interval=0.5,
+                        mgr_target_objects_per_pg=4)
+            cluster = Cluster(n_osds=4, conf=conf, with_mgr=True)
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("auto", pg_num=4,
+                                           profile=dict(EC_PROFILE))
+                for i in range(40):  # 40 objs / target 4 -> wants 16 pgs
+                    await c.put(pool, f"a{i}", os.urandom(2000))
+                grown = False
+                for _ in range(60):
+                    await asyncio.sleep(0.5)
+                    await c.refresh_map()
+                    if c.osdmap.pools[pool].pg_num > 4:
+                        grown = True
+                        break
+                assert grown, "autoscaler never resized the pool"
+                await asyncio.sleep(2.0)
+                for i in range(40):
+                    assert len(await c.get(pool, f"a{i}")) == 2000
+            finally:
+                await cluster.stop()
+
+        run(go(), timeout=180)
+
+    def test_balancer_end_to_end(self):
+        """The mgr's balancer observes a skewed map (synthetic upmaps)
+        and installs corrective upmaps through the mon."""
+        async def go():
+            conf = dict(CONF, mgr_balancer=True, mgr_module_interval=0.5)
+            cluster = Cluster(n_osds=5, conf=conf, with_mgr=True)
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("bal", pg_num=16,
+                                           profile=dict(EC_PROFILE))
+                await c.put(pool, "obj", os.urandom(4000))
+                # skew: pile several PGs onto one OSD via raw upmaps
+                p = c.osdmap.pools[pool]
+                counts = Balancer.seat_counts(c.osdmap)
+                hot = max(counts, key=counts.get)
+                moved = 0
+                for pg in range(p.pg_num):
+                    seats = c.osdmap.pg_to_placed(p, pg)
+                    if hot not in seats and moved < 4:
+                        await c.set_upmap(pool, pg, [hot] + list(seats[1:]))
+                        moved += 1
+                before = Balancer.seat_counts(c.osdmap)
+                spread0 = max(before.values()) - min(before.values())
+                assert spread0 >= 2
+                ok = False
+                for _ in range(60):
+                    await asyncio.sleep(0.5)
+                    await c.refresh_map()
+                    counts = Balancer.seat_counts(c.osdmap)
+                    if max(counts.values()) - min(counts.values()) <= 1:
+                        ok = True
+                        break
+                assert ok, f"balancer never evened the spread: {counts}"
+                assert await c.get(pool, "obj")  # IO fine throughout
+            finally:
+                await cluster.stop()
+
+        run(go(), timeout=180)
