@@ -50,16 +50,27 @@ def main() -> int:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import jax
 
-    try:
-        backend = jax.default_backend()
-    except Exception:
-        # TPU tunnel down: re-exec once on CPU so the driver still gets a
-        # result line (the tpu plugin's CPU-fallback policy, applied here)
+    # Hang-proof backend resolution: a wedged tunnel can make
+    # jax.default_backend() block forever inside PJRT client creation, so it
+    # runs through the timed probe. On failure OR timeout, re-exec once on a
+    # scrubbed CPU env so the driver still gets a result line (the tpu
+    # plugin's CPU-fallback policy, applied here). The env must be scrubbed
+    # of accelerator plugin triggers, not just set to JAX_PLATFORMS=cpu —
+    # the sitecustomize would otherwise re-register the wedged plugin in
+    # the re-exec'd child.
+    from ceph_tpu.utils.jaxdev import (
+        UNAVAILABLE, probe_backend, probe_error, scrub_accelerator_env)
+
+    backend = probe_backend()
+    if backend == UNAVAILABLE:
         if os.environ.get("BENCH_FALLBACK") != "1":
-            env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_FALLBACK="1")
+            env = scrub_accelerator_env()
+            env["BENCH_FALLBACK"] = "1"
             os.execve(sys.executable,
                       [sys.executable, os.path.abspath(__file__)], env)
-        raise
+        raise RuntimeError(
+            "jax backend unavailable even on scrubbed CPU env"
+        ) from probe_error()
 
     import jax.numpy as jnp
     from jax import lax

@@ -23,8 +23,15 @@ from typing import Optional
 
 _lock = threading.Lock()
 _result: Optional[str] = None
+_error: Optional[BaseException] = None
 
 UNAVAILABLE = "unavailable"
+
+
+def probe_error() -> Optional[BaseException]:
+    """The exception that made probe_backend() return UNAVAILABLE, if the
+    probe failed with an error rather than a timeout."""
+    return _error
 
 
 def probe_backend(timeout: Optional[float] = None) -> str:
@@ -49,9 +56,51 @@ def probe_backend(timeout: Optional[float] = None) -> str:
         th = threading.Thread(target=_probe, daemon=True, name="jax-probe")
         th.start()
         th.join(timeout)
+        global _error
+        _error = box.get("error")
         _result = box.get("backend", UNAVAILABLE)
         return _result
 
 
 def backend_available() -> bool:
     return probe_backend() != UNAVAILABLE
+
+
+def scrub_accelerator_env(n_cpu_devices: Optional[int] = None) -> dict:
+    """Copy of os.environ safe for a CPU-only child process.
+
+    Setting JAX_PLATFORMS=cpu in the child is not enough on hosts whose
+    sitecustomize (PYTHONPATH entries containing "axon_site") registers an
+    accelerator PJRT plugin in every python process when PALLAS_AXON_* vars
+    are present: the child would still initialize libtpu and collide with
+    an accelerator-holding parent on /tmp/libtpu_lockfile.  Strip the
+    plugin triggers, force the CPU platform, and optionally force a virtual
+    CPU device count.
+    """
+    out = dict(os.environ)
+    for var in list(out):
+        if var.startswith(("PALLAS_AXON_", "AXON_")) or var in (
+            "TPU_LIBRARY_PATH",
+            "PJRT_DEVICE",
+        ):
+            out.pop(var, None)
+    pypath = [
+        p
+        for p in out.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon_site" not in p
+    ]
+    if pypath:
+        out["PYTHONPATH"] = os.pathsep.join(pypath)
+    else:
+        out.pop("PYTHONPATH", None)
+    out["JAX_PLATFORMS"] = "cpu"
+    if n_cpu_devices is not None:
+        kept = [
+            f
+            for f in out.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        out["XLA_FLAGS"] = " ".join(
+            kept + [f"--xla_force_host_platform_device_count={n_cpu_devices}"]
+        )
+    return out
