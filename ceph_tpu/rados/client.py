@@ -9,6 +9,7 @@ idempotent by reqid)."""
 from __future__ import annotations
 
 import asyncio
+import errno
 import uuid
 from typing import Dict, List, Optional, Tuple
 
@@ -36,7 +37,31 @@ from ceph_tpu.rados.types import (
 
 
 class RadosError(Exception):
-    pass
+    """Client-visible failure.  ``code`` is the negative errno from the
+    reply (0 when the failure had no typed reply, e.g. transport errors),
+    so services can branch on errno instead of message text."""
+
+    def __init__(self, message: str, code: int = 0):
+        super().__init__(message)
+        self.code = code
+
+
+# reply codes that are ANSWERS, not failures: the primary executed the op
+# and the result is "no" — retrying would turn every expected miss into a
+# multi-second epoch-barrier stall (reference: definitive errno from
+# PrimaryLogPG are returned to the caller, not retried by the Objecter)
+_DEFINITIVE_CODES = frozenset((
+    -errno.ENOENT, -errno.EOPNOTSUPP, -errno.EINVAL, -errno.EPERM,
+    -errno.EBADMSG, -errno.ENXIO, -errno.EEXIST, -errno.ERANGE,
+))
+# -ESTALE (not primary): the placement this op was computed on is WRONG —
+# re-target only after fencing past our own epoch (a newer map exists or
+# is imminent; recomputing on the stale one re-picks the same primary).
+# -EAGAIN (degraded / below min_size / transient ack shortfall / shards
+# unavailable): the primary is RIGHT but momentarily unable — retry
+# promptly on a freshly FETCHED map without awaiting a newer epoch, since
+# none may be coming (e.g. one dropped sub-write ack on a healthy
+# cluster must not pay a multi-second epoch poll).
 
 
 class RadosClient:
@@ -63,11 +88,24 @@ class RadosClient:
             await self._fetch_ticket()
 
     async def _fetch_ticket(self) -> None:
-        """cephx-lite: obtain a service ticket over the (bootstrap-
-        authenticated) mon connection; OSD dials present it instead of
-        the cluster secret."""
+        """cephx-lite: obtain a service ticket over a BOOTSTRAP-
+        authenticated mon connection; OSD dials present it instead of
+        the cluster secret.  The mon refuses to mint tickets over
+        ticket-authenticated conns (self-renewal would void the TTL), so
+        drop any held ticket and live mon conns first — the re-dial then
+        proves the cluster secret."""
+        if self.messenger.ticket is not None:
+            self.messenger.ticket = None
+            self.messenger.session_key = None
+            for addr in list(self.mons.addrs):
+                conn = self.messenger._conns.get(tuple(addr))
+                if conn is not None:
+                    await conn.close()
+                    self.messenger._conns.pop(tuple(addr), None)
         reply = await self._mon_rpc(
             MAuthTicket(entity="client", entity_type="client"))
+        if getattr(reply, "denied", False):
+            raise PermissionError("mon refused to mint a client ticket")
         self.messenger.ticket = bytes.fromhex(reply.ticket)
         self.messenger.session_key = bytes.fromhex(reply.session_key)
 
@@ -225,6 +263,7 @@ class RadosClient:
         if self.osdmap is None:
             await self.refresh_map()
         last_error = "no attempt"
+        last_code = 0
         # ONE reqid per logical op: resends carry the same id so the PG
         # log's dup detection can recognize them (reference osd_reqid_t)
         op.reqid = uuid.uuid4().hex
@@ -242,7 +281,8 @@ class RadosClient:
                 # a lagging mon may have served us a pre-creation map:
                 # refresh-and-retry (Objecter catches up across epochs)
                 if attempt == retries - 1:
-                    raise RadosError(f"pool {op.pool_id} does not exist")
+                    raise RadosError(f"pool {op.pool_id} does not exist",
+                                     code=-errno.ENOENT)
                 last_error = (
                     f"pool {op.pool_id} not in map epoch {self.osdmap.epoch}")
                 fence = self.osdmap.epoch + 1
@@ -263,31 +303,35 @@ class RadosClient:
                 if reply.ok:
                     return reply
                 last_error = reply.error
-                # DEFINITIVE errors are answers, not failures: the primary
-                # executed the op and the result is "no" — retrying (and
-                # paying the epoch-barrier poll) would turn every expected
-                # miss (striper header probes, stat of absent objects)
-                # into a multi-second stall
-                if any(m in reply.error for m in
-                       ("object not found", "no such pool", "EOPNOTSUPP",
-                        "bad op", "ec error")):
+                # classification is by TYPED code (reference 0/-errno):
+                # a reworded error string can never silently change an
+                # op's retry behavior
+                code = last_code = getattr(reply, "code", 0)
+                if code in _DEFINITIVE_CODES:
                     raise RadosError(
-                        f"op {op.op} {op.oid} failed: {reply.error}")
+                        f"op {op.op} {op.oid} failed: {reply.error}",
+                        code=code)
                 # epoch barrier: never re-target on a map older than the
                 # replying OSD's (it refused exactly because placement
                 # moved — recomputing on our stale map re-picks it)
                 fence = max(fence, getattr(reply, "map_epoch", 0))
-                # retryable refusals re-target promptly — the barrier
-                # already orders us behind the newer map — but repeated
-                # bounces mean recovery is still moving seats: give it a
-                # growing (small) window instead of burning retries dry.
-                # Placement-moved refusals additionally fence PAST our own
-                # epoch (the mapping that picked this primary is wrong).
-                if ("not primary" in reply.error
-                        or "degraded" in reply.error):
+                if code == -errno.ESTALE:
+                    # placement moved: fence PAST our own epoch (the map
+                    # that picked this primary is wrong), growing window
+                    # while recovery moves seats
                     fence = max(fence, self.osdmap.epoch + 1)
                     if attempt:
                         await asyncio.sleep(min(0.25 * attempt, 1.0))
+                    continue
+                if code == -errno.EAGAIN:
+                    # busy, right primary: one cheap map fetch (no newer-
+                    # epoch wait) so real map changes are picked up, then
+                    # a prompt retry
+                    try:
+                        await self.refresh_map(min_epoch=fence)
+                    except (ConnectionError, OSError, asyncio.TimeoutError):
+                        pass
+                    await asyncio.sleep(min(0.2 * (attempt + 1), 1.0))
                     continue
                 await asyncio.sleep(0.2 * (attempt + 1))
             except PermissionError:
@@ -299,13 +343,15 @@ class RadosClient:
                     await asyncio.sleep(0.2 * (attempt + 1))
             except (ConnectionError, OSError, asyncio.TimeoutError) as e:
                 last_error = f"{type(e).__name__}: {e}"
+                last_code = 0  # transport failure: no typed OSD answer
                 # the target may have died: re-target on a fresh map; if
                 # the target is UNCHANGED the resend is dedupe-safe
                 fence = max(fence, self.osdmap.epoch + 1)
                 await asyncio.sleep(0.2 * (attempt + 1))
             finally:
                 self._replies.pop(op.reqid, None)
-        raise RadosError(f"op {op.op} {op.oid} failed: {last_error}")
+        raise RadosError(f"op {op.op} {op.oid} failed: {last_error}",
+                         code=last_code)
 
     async def put(self, pool_id: int, oid: str, data: bytes,
                   offset: Optional[int] = None) -> None:
@@ -409,5 +455,5 @@ class RadosClient:
         finally:
             self._replies.pop(op.reqid, None)
         if not reply.ok:
-            raise RadosError(reply.error)
+            raise RadosError(reply.error, code=getattr(reply, "code", 0))
         return reply

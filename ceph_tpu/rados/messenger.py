@@ -105,6 +105,16 @@ class BadFrame(Exception):
     pass
 
 
+# Everything a send/dial can legitimately raise when the PEER (not this
+# process) is at fault: socket errors, handshake refusals/garbage, dial
+# timeouts.  Daemons catching "send failed, treat as missing ack" catch
+# THIS, not Exception — a TypeError in our own framing code must crash
+# loudly, not melt into a silent degraded loop.  (ConnectionError and
+# PermissionError are OSError subclasses and IncompleteReadError an
+# EOFError subclass — listed anyway to document the intended surface.)
+TRANSPORT_ERRORS = (ConnectionError, OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError, EOFError, BadFrame,
+                    PermissionError, json.JSONDecodeError)
 
 
 # -- policies ----------------------------------------------------------------
@@ -151,6 +161,10 @@ class Connection:
         self.peer_name = peer_name
         self.policy = policy
         self.outbound = outbound
+        # how the peer authenticated ("ticket" / "secret" / "none") — set
+        # by the acceptor after _handshake_in; outbound conns keep "none"
+        self.auth_kind = "none"
+        self.auth_entity_type = ""
         self.closed = False
         self.transport_gen = 0
         self.out_seq = 0
@@ -327,16 +341,25 @@ class Messenger:
 
     # -- handshake -----------------------------------------------------------
 
-    def _auth_tag(self, nonce: bytes, key: Optional[bytes] = None) -> str:
-        """HMAC proof over a handshake nonce: with a ticket session key
-        when one is in play (cephx role), else the cluster bootstrap
-        secret."""
+    def _auth_tag(self, nonce: bytes, key: Optional[bytes] = None,
+                  transcript: bytes = b"") -> str:
+        """HMAC proof over a handshake nonce + negotiated-mode transcript:
+        with a ticket session key when one is in play (cephx role), else
+        the cluster bootstrap secret.  Binding the transcript (the secure
+        flags both sides sent) into the tag makes mode-stripping by an
+        active MITM detectable — the reference binds the negotiated mode
+        into msgr2's signed handshake payload the same way."""
         if key is not None:
-            return hmac.new(key, nonce, hashlib.sha256).hexdigest()
+            return hmac.new(key, nonce + transcript, hashlib.sha256).hexdigest()
         secret = str(_cget(self.conf, "ms_auth_secret", "") or "")
         if not secret:
             return ""
-        return hmac.new(secret.encode(), nonce, hashlib.sha256).hexdigest()
+        return hmac.new(secret.encode(), nonce + transcript,
+                        hashlib.sha256).hexdigest()
+
+    @staticmethod
+    def _mode_transcript(initiator_secure: bool, acceptor_secure: bool) -> bytes:
+        return f"|mode:i{int(bool(initiator_secure))}a{int(bool(acceptor_secure))}".encode()
 
     def _secure_key(self, session_key: Optional[bytes],
                     nonce_a: bytes, nonce_b: bytes) -> Optional[bytes]:
@@ -376,31 +399,47 @@ class Messenger:
             raise BadFrame("bad banner from peer")
         peer_hello = json.loads(await reader.readline())
         key = self.session_key if self.ticket is not None else None
+        # both secure flags ride the HMAC material: a stripped flag makes
+        # the tags disagree instead of silently downgrading to plaintext
+        transcript = self._mode_transcript(secure_want,
+                                           peer_hello.get("secure", False))
         # acceptor proves knowledge of the secret (or of OUR ticket's
         # session key, which only rotating-secret holders can open) by
         # tagging OUR nonce
-        expect = self._auth_tag(nonce, key)
+        expect = self._auth_tag(nonce, key, transcript)
         if expect and not hmac.compare_digest(peer_hello.get("auth", ""), expect):
             raise PermissionError("peer failed auth (bad cluster secret)")
         # then we prove ourselves by tagging THEIR nonce
-        their_nonce = bytes.fromhex(peer_hello.get("nonce", ""))
-        tag = self._auth_tag(their_nonce, key)
+        try:
+            their_nonce = bytes.fromhex(peer_hello.get("nonce", ""))
+        except ValueError:
+            raise BadFrame("garbled nonce in peer hello") from None
+        tag = self._auth_tag(their_nonce, key, transcript)
         writer.write(json.dumps({"auth": tag}).encode() + b"\n")
         await writer.drain()
         fin = json.loads(await reader.readline())
         if not fin.get("ok", False):
             raise PermissionError("peer rejected our auth")
-        if secure_want and peer_hello.get("secure"):
-            skey = self._secure_key(key, nonce, their_nonce)
-            if skey is not None:
-                reader, writer = self._wrap_secure(reader, writer, skey)
+        if secure_want:
+            # ms_secure_mode is a REQUIREMENT, not a preference: ending up
+            # on plaintext (peer refused, or no key material to derive a
+            # session key from) is a failed connection, never a downgrade
+            skey = (self._secure_key(key, nonce, their_nonce)
+                    if peer_hello.get("secure") else None)
+            if skey is None:
+                raise PermissionError(
+                    "ms_secure_mode set but connection would be plaintext")
+            reader, writer = self._wrap_secure(reader, writer, skey)
         return (peer_hello.get("name", ""), bool(peer_hello.get("resumed")),
                 reader, writer)
 
     async def _handshake_in(self, reader, writer):
-        """Returns (peer_name, peer_type, session, lossless, reader,
-        writer) — the pair is AES-GCM wrapped when secure mode was
-        negotiated."""
+        """Returns (peer_name, peer_type, session, lossless, auth_kind,
+        auth_entity_type, reader, writer) — the pair is AES-GCM wrapped
+        when secure mode was negotiated.  ``auth_kind`` records HOW the
+        peer proved itself ("ticket", "secret", or "none"): authorization
+        decisions (e.g. who may fetch the rotating service secrets) key on
+        it, not on the peer's self-declared type."""
         secure_want = bool(_cget(self.conf, "ms_secure_mode", False))
         banner = await reader.readexactly(len(BANNER))
         if banner != BANNER:
@@ -410,6 +449,8 @@ class Messenger:
         nonce = random.randbytes(16)
         their_nonce = bytes.fromhex(peer_hello.get("nonce", ""))
         key: Optional[bytes] = None
+        auth_kind = "none"
+        auth_entity_type = ""
         ticket_hex = peer_hello.get("ticket", "")
         if ticket_hex and self.keyring is not None:
             tkt = self.keyring.validate(bytes.fromhex(ticket_hex))
@@ -429,29 +470,39 @@ class Messenger:
                 raise PermissionError(
                     f"invalid ticket from {peer_hello.get('name')}")
             key = tkt["session_key"]
+            auth_kind = "ticket"
+            auth_entity_type = tkt.get("type", "")
         # tell the initiator whether we still hold its session: if not, it
         # must reset its reply-dedupe floor (our out_seq restarts at 1)
         resumed = peer_hello.get("session", "") in self._sessions
+        transcript = self._mode_transcript(peer_hello.get("secure", False),
+                                           secure_want)
         hello = {"name": self.name, "type": self.entity_type,
                  "nonce": nonce.hex(),
-                 "auth": self._auth_tag(their_nonce, key),
+                 "auth": self._auth_tag(their_nonce, key, transcript),
                  "resumed": resumed, "secure": secure_want}
         writer.write(json.dumps(hello).encode() + b"\n")
         await writer.drain()
         proof = json.loads(await reader.readline())
-        expect = self._auth_tag(nonce, key)
+        expect = self._auth_tag(nonce, key, transcript)
         ok = not expect or hmac.compare_digest(proof.get("auth", ""), expect)
         writer.write(json.dumps({"ok": ok}).encode() + b"\n")
         await writer.drain()
         if not ok:
             raise PermissionError(f"auth failed for peer {peer_hello.get('name')}")
-        if secure_want and peer_hello.get("secure"):
-            skey = self._secure_key(key, their_nonce, nonce)
-            if skey is not None:
-                reader, writer = self._wrap_secure(reader, writer, skey)
+        if expect and auth_kind == "none":
+            auth_kind = "secret"  # peer proved the cluster bootstrap secret
+        if secure_want:
+            # required, not best-effort (see _handshake_out)
+            skey = (self._secure_key(key, their_nonce, nonce)
+                    if peer_hello.get("secure") else None)
+            if skey is None:
+                raise PermissionError(
+                    "ms_secure_mode set but connection would be plaintext")
+            reader, writer = self._wrap_secure(reader, writer, skey)
         return (peer_hello.get("name", ""), peer_hello.get("type", "client"),
                 peer_hello.get("session", ""), bool(peer_hello.get("lossless")),
-                reader, writer)
+                auth_kind, auth_entity_type, reader, writer)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -466,7 +517,8 @@ class Messenger:
         self._tasks.add(task)
         try:
             try:
-                (peer_name, peer_type, cookie, lossless,
+                (peer_name, peer_type, cookie, lossless, auth_kind,
+                 auth_entity_type,
                  reader, writer) = await self._handshake_in(reader, writer)
             except (PermissionError, BadFrame, ConnectionError, json.JSONDecodeError,
                     asyncio.IncompleteReadError, ValueError):
@@ -489,6 +541,10 @@ class Messenger:
             else:
                 conn = Connection(self, reader, writer, peer,
                                   Policy.lossy_client(), peer_name)
+            # how the peer proved itself, for authorization decisions
+            # (refreshed on every reconnect handshake)
+            conn.auth_kind = auth_kind
+            conn.auth_entity_type = auth_entity_type
             await self._serve(conn)
         finally:
             self._tasks.discard(task)

@@ -505,6 +505,16 @@ class Monitor:
     WRITE_TYPES = (MOsdBoot, MCreatePool, MMarkDown, MConfigSet, MOSDFailure,
                    MOSDPGTemp, MSetUpmap, MPoolSet)
 
+    @staticmethod
+    def _conn_is_daemon(conn) -> bool:
+        """Did this connection prove daemon-level credentials: the cluster
+        bootstrap secret, or a daemon-type service ticket?  (A peer's
+        self-declared entity_type is NOT consulted.)"""
+        kind = getattr(conn, "auth_kind", "none")
+        etype = getattr(conn, "auth_entity_type", "")
+        return kind == "secret" or (
+            kind == "ticket" and etype in ("osd", "mon", "mgr", "mds"))
+
     async def _dispatch(self, conn, msg) -> None:
         if isinstance(msg, MMonElection):
             await self._handle_election(msg)
@@ -526,13 +536,37 @@ class Monitor:
         elif isinstance(msg, MGetMap):
             await conn.send(self._map_reply_for(msg.min_epoch, tid=msg.tid))
         elif isinstance(msg, MAuthTicket):
-            blob, skey = self.keyserver.issue_ticket(
-                msg.entity or conn.peer_name, msg.entity_type)
-            await conn.send(MAuthTicketReply(
-                tid=msg.tid, ticket=blob.hex(), session_key=skey.hex()))
+            # Ticket minting is a credential-class decision:
+            #  - daemon-type tickets pass the rotating-key gate below, so
+            #    only bootstrap-proved conns or already-daemon tickets may
+            #    mint one (else a leaked client ticket upgrades itself);
+            #  - CLIENT tickets may only be minted over a bootstrap-proved
+            #    conn: ticket-authenticated self-renewal would make the
+            #    TTL on a leaked ticket meaningless (holders re-prove the
+            #    long-lived secret to renew, as with cephx keyrings).
+            want = msg.entity_type or "client"
+            allowed = (self._conn_is_daemon(conn)
+                       if want in ("osd", "mon", "mgr", "mds")
+                       else getattr(conn, "auth_kind", "none") == "secret")
+            if not allowed:
+                await conn.send(MAuthTicketReply(tid=msg.tid, denied=True))
+            else:
+                blob, skey = self.keyserver.issue_ticket(
+                    msg.entity or conn.peer_name, want)
+                await conn.send(MAuthTicketReply(
+                    tid=msg.tid, ticket=blob.hex(), session_key=skey.hex()))
         elif isinstance(msg, MAuthRotating):
-            await conn.send(MAuthRotatingReply(
-                tid=msg.tid, keys=self.keyserver.export_keys()))
+            # the rotating service secrets can open/forge ANY ticket: only
+            # peers that proved the bootstrap secret, or hold a daemon-type
+            # ticket, may fetch them.  A ticket-authenticated CLIENT must
+            # not be able to upgrade a leaked short-lived ticket into the
+            # secrets themselves (reference: rotating keys are served to
+            # daemons via their keyring auth, never to cephx clients).
+            if self._conn_is_daemon(conn):
+                await conn.send(MAuthRotatingReply(
+                    tid=msg.tid, keys=self.keyserver.export_keys()))
+            else:
+                await conn.send(MAuthRotatingReply(tid=msg.tid, denied=True))
         elif isinstance(msg, MConfigGet):
             values = ({msg.key: self.cluster_conf.get(msg.key, "")}
                       if msg.key else dict(self.cluster_conf))

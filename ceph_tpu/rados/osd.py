@@ -33,6 +33,7 @@ against stored meta and repairs mismatches (be_deep_scrub).
 from __future__ import annotations
 
 import asyncio
+import errno
 import pickle
 import random
 import time
@@ -48,7 +49,7 @@ from ceph_tpu.ec.interface import ErasureCodeError
 from ceph_tpu.ec.registry import registry
 from ceph_tpu.rados.crush import CRUSH_ITEM_NONE
 from ceph_tpu.rados.ecutil import HashInfo, StripeInfo, batched_encode, decode_object
-from ceph_tpu.rados.messenger import Messenger
+from ceph_tpu.rados.messenger import TRANSPORT_ERRORS, Messenger
 from ceph_tpu.rados.monclient import MonTargets
 from ceph_tpu.rados.peering import (
     ACTIVE,
@@ -220,7 +221,9 @@ class OSD:
         self._past_members: Dict[Tuple[int, int], Set[int]] = {}
         # (oid, version) pairs observed partial-above-newest-complete in a
         # COMPLETE listing, per PG: confirmed again next pass => revert
-        self._partial_newer: Dict[Tuple[int, int], Set[Tuple[str, int]]] = {}
+        # (pool, pg) -> {(oid, version): first_seen_monotonic} for versions
+        # newer than the newest complete one (unfound-revert grace clock)
+        self._partial_newer: Dict[Tuple[int, int], Dict[Tuple[str, int], float]] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -311,16 +314,31 @@ class OSD:
         propagate (reference RotatingKeyRing refresh)."""
         try:
             rot = await self._mon_rpc(MAuthRotating(), MAuthRotatingReply)
+            if getattr(rot, "denied", False):
+                raise PermissionError(
+                    "mon refused rotating keys (connection not "
+                    "daemon-authenticated)")
             if self.messenger.keyring is None:
                 self.messenger.keyring = TicketKeyring()
             self.messenger.keyring.load(rot.keys)
             tkt = await self._mon_rpc(
                 MAuthTicket(entity=f"osd.{self.osd_id}", entity_type="osd"),
                 MAuthTicketReply)
+            if getattr(tkt, "denied", False):
+                raise PermissionError(
+                    "mon refused osd ticket (connection not "
+                    "daemon-authenticated)")
             self.messenger.ticket = bytes.fromhex(tkt.ticket)
             self.messenger.session_key = bytes.fromhex(tkt.session_key)
-        except Exception as e:
+        except TRANSPORT_ERRORS as e:
             self.ctx.log.error("osd", f"auth refresh failed: {e}")
+            if isinstance(e, PermissionError) and \
+                    self.messenger.ticket is not None:
+                # an expired/refused ticket wedges every dial (a presented
+                # ticket MUST verify — no silent fallback): drop it so the
+                # next refresh re-proves the bootstrap secret instead
+                self.messenger.ticket = None
+                self.messenger.session_key = None
 
     async def _ping_loop(self, interval: float) -> None:
         ticks = 0
@@ -332,7 +350,7 @@ class OSD:
                           epoch=self.osdmap.epoch if self.osdmap else 0,
                           addr=self.addr or ("", 0)),
                 )
-            except Exception:
+            except TRANSPORT_ERRORS:
                 self.mons.rotate()  # that mon looks dead
             ticks += 1
             if ticks % 3 == 0:
@@ -362,7 +380,7 @@ class OSD:
                                status=self.status(), stamp=time.time()),
                     peer_type="mgr"),
                 timeout=2.0)  # a stalled mgr must not starve mon pings
-        except Exception:
+        except TRANSPORT_ERRORS:
             pass
 
     async def _heartbeat_loop(self, interval: float) -> None:
@@ -399,9 +417,9 @@ class OSD:
                                 MOSDFailure(target_osd=o.osd_id,
                                             from_osd=self.osd_id,
                                             failed_for=grace))
-                        except Exception:
+                        except TRANSPORT_ERRORS:
                             pass
-                except Exception:
+                except TRANSPORT_ERRORS:
                     pass
                 last = self._hb_last.setdefault(o.osd_id, now)
                 last_report = self._hb_reported.get(o.osd_id, -1e9)
@@ -418,7 +436,7 @@ class OSD:
                             MOSDFailure(target_osd=o.osd_id,
                                         from_osd=self.osd_id,
                                         failed_for=now - last))
-                    except Exception:
+                    except TRANSPORT_ERRORS:
                         pass
             # prune state for peers no longer up in the map
             live = {o.osd_id for o in peers}
@@ -604,7 +622,7 @@ class OSD:
     async def _fetch_full_map(self) -> None:
         try:
             await self._mon_rpc(MGetMap(min_epoch=0), MMapReply)
-        except Exception:
+        except TRANSPORT_ERRORS:
             pass
 
     def _on_map(self, osdmap: OSDMap) -> None:
@@ -682,6 +700,24 @@ class OSD:
             if new_pool is None or old_pool is None or new_pool.profile != old_pool.profile:
                 self._codecs.pop(pool_id, None)
                 self._sinfos.pop(pool_id, None)
+        # revoke remote backfill-reservation grants whose requesting
+        # primary is no longer this PG's primary (or is down): its release
+        # message will never come, and without revocation a few primary
+        # deaths would permanently exhaust the slots (reference: remote
+        # reservations are cancelled on interval change / peer reset)
+        def _grant_still_valid(key, grantee, _t):
+            if grantee is None:
+                return True  # local grant, owned by a task on this OSD
+            pool = osdmap.pools.get(key[0])
+            if pool is None:
+                return False
+            info = osdmap.osds.get(grantee)
+            if info is None or not info.up:
+                return False
+            acting = osdmap.pg_to_acting(pool, key[1])
+            return self._primary(pool, key[1], acting) == grantee
+
+        self._remote_reserver.revoke_stale(_grant_still_valid)
         # event-driven recovery (reference AdvMap/ActMap): kick the peering
         # statechart for exactly the PGs whose mapping changed — repair
         # traffic for one failed OSD touches only that OSD's PGs
@@ -942,7 +978,7 @@ class OSD:
                     self.osdmap.addr_of(osd),
                     MPGLogReq(pool_id=pool.pool_id, pg=pg, since=log.head,
                               tid=tid, reply_to=self.addr))
-            except Exception:
+            except TRANSPORT_ERRORS:
                 continue
             for r in await self._gather(tid, q, 1, timeout=0.8):
                 if r.backfill:
@@ -982,7 +1018,7 @@ class OSD:
                             MECSubDelete(pool_id=pool.pool_id, pg=pg, oid=oid,
                                          shard=-1, tid="", reply_to=self.addr))
                         pushed += 1
-                    except Exception:
+                    except TRANSPORT_ERRORS:
                         pass
                     continue
                 if shard_of_peer is None:
@@ -1000,7 +1036,7 @@ class OSD:
                 try:
                     await self.messenger.send(self.osdmap.addr_of(osd), push)
                     pushed += 1
-                except Exception:
+                except TRANSPORT_ERRORS:
                     pass
             # the peer now holds the objects: advance its log so the next
             # GetInfo round sees it caught up (and its dup set learns the
@@ -1056,7 +1092,24 @@ class OSD:
                     m.reserve_blocked = True
                     return False, 0, False
             m.transition(BACKFILLING)
-            pushed, _holdings, covered = await self._backfill_pg(pool, pg)
+            # renew remote leases while the sweep runs: grant times refresh
+            # on re-request, so only holders that actually died (and can't
+            # renew) age past osd_backfill_reserve_lease and get expired
+            lease = self._reserve_lease()
+
+            async def _renew_leases() -> None:
+                while True:
+                    await asyncio.sleep(max(lease / 3.0, 0.5))
+                    for osd in granted:
+                        await self._remote_reserve(pool.pool_id, pg, osd)
+
+            renewer = (asyncio.get_running_loop().create_task(_renew_leases())
+                       if granted else None)
+            try:
+                pushed, _holdings, covered = await self._backfill_pg(pool, pg)
+            finally:
+                if renewer is not None:
+                    renewer.cancel()
             m.transition(ACTIVE)
             return True, pushed, covered
         finally:
@@ -1076,7 +1129,7 @@ class OSD:
                 MBackfillReserve(op="request", pool_id=pool_id, pg=pg,
                                  from_osd=self.osd_id, tid=tid,
                                  reply_to=self.addr))
-        except Exception:
+        except TRANSPORT_ERRORS:
             self._collectors.pop(tid, None)
             return False
         for r in await self._gather(tid, q, 1, timeout=0.8):
@@ -1089,7 +1142,7 @@ class OSD:
                 self.osdmap.addr_of(osd),
                 MBackfillReserve(op="release", pool_id=pool_id, pg=pg,
                                  from_osd=self.osd_id))
-        except Exception:
+        except TRANSPORT_ERRORS:
             pass
 
     def _handle_sub_rollback(self, msg: MECSubRollback) -> None:
@@ -1119,12 +1172,22 @@ class OSD:
             self._remote_reserver.release(key)
             return
         was_held = key in self._remote_reserver.held
-        ok = self._remote_reserver.try_acquire(key)
+        if not was_held and len(self._remote_reserver.held) >= \
+                self._remote_reserver.slots:
+            # all slots taken: expire leases whose grant outlived the
+            # reservation lease (a primary that died without releasing —
+            # its release message is not retried) so one crashed peer
+            # cannot wedge backfill onto this OSD forever
+            lease = self._reserve_lease()
+            now = time.monotonic()
+            self._remote_reserver.revoke_stale(
+                lambda _k, g, t: g is None or now - t < lease)
+        ok = self._remote_reserver.try_acquire(key, grantee=msg.from_osd)
         try:
             await self.messenger.send(
                 tuple(msg.reply_to),
                 MBackfillReserveReply(tid=msg.tid, osd_id=self.osd_id, ok=ok))
-        except Exception:
+        except TRANSPORT_ERRORS:
             # only roll back a slot THIS request took: a duplicate request
             # for an already-held key must not free the real holder's slot
             if ok and not was_held:
@@ -1178,8 +1241,8 @@ class OSD:
             omap = {}
             try:
                 omap = self.store.omap_get(self._pgmeta_key(pool_id, pg))
-            except Exception:
-                pass
+            except (IOError, OSError):
+                pass  # unreadable pgmeta: start a fresh log (redo covers)
             maxe = int(self.conf.get("osd_min_pg_log_entries", 500) or 500)
             log = PGLog.load(omap, max_entries=maxe) if omap \
                 else PGLog(max_entries=maxe)
@@ -1287,16 +1350,25 @@ class OSD:
             elif op.op == "deep-scrub":
                 pool = self.osdmap.pools.get(op.pool_id)
                 if pool is None:
-                    reply = MOSDOpReply(ok=False, error="no such pool")
+                    reply = MOSDOpReply(ok=False, code=-errno.ENOENT,
+                                        error="no such pool")
                 else:
                     summary = await self.deep_scrub_pool(pool)
                     reply = MOSDOpReply(ok=True, data=pickle.dumps(summary))
             else:
-                reply = MOSDOpReply(ok=False, error=f"bad op {op.op}")
+                reply = MOSDOpReply(ok=False, code=-errno.EINVAL,
+                                    error=f"bad op {op.op}")
         except ErasureCodeError as e:
-            reply = MOSDOpReply(ok=False, error=f"ec error: {e}")
+            # the codec REJECTED the operation (unsatisfiable decode,
+            # profile violation): deterministic, so definitive
+            reply = MOSDOpReply(ok=False, code=-errno.EBADMSG,
+                                error=f"ec error: {e}")
         except Exception as e:
-            reply = MOSDOpReply(ok=False, error=f"{type(e).__name__}: {e}")
+            # unexpected: conservatively retryable (transient state races
+            # dominate here; a true logic bug surfaces in the counter)
+            self.perf.inc("op_unexpected_error")
+            reply = MOSDOpReply(ok=False, code=-errno.EIO,
+                                error=f"{type(e).__name__}: {e}")
         reply.reqid = op.reqid
         # our epoch rides every reply: on retryable errors the client
         # fences its re-target on at least this epoch
@@ -1317,11 +1389,12 @@ class OSD:
         pool = self.osdmap.pools[op.pool_id]
         pg, acting = self._acting(pool, op.oid)
         if self._primary(pool, pg, acting) != self.osd_id:
-            return MOSDOpReply(ok=False, error="not primary")
+            return MOSDOpReply(ok=False, code=-errno.ESTALE,
+                               error="not primary")
         live = [a for a in acting if a != CRUSH_ITEM_NONE]
         if len(live) < pool.min_size:
             return MOSDOpReply(
-                ok=False,
+                ok=False, code=-errno.EAGAIN,
                 error=f"degraded below min_size ({len(live)}/{pool.min_size})",
             )
         log = self._pglog(op.pool_id, pg)
@@ -1440,7 +1513,7 @@ class OSD:
             try:
                 await self.messenger.send(self.osdmap.addr_of(osd), msg)
                 sent += 1
-            except Exception:
+            except TRANSPORT_ERRORS:
                 pass  # failed send counts as a missing ack, not a 5s stall
         span.event(f"sub writes sent ({sent})")
         replies = await self._gather(tid, q, sent)
@@ -1453,7 +1526,8 @@ class OSD:
             self._mark_failed_write(op.reqid)
             self._cache_drop(op.pool_id, op.oid)
             return MOSDOpReply(
-                ok=False, error=f"write acked by {acks} < min_size {pool.min_size}"
+                ok=False, code=-errno.EAGAIN,
+                error=f"write acked by {acks} < min_size {pool.min_size}"
             )
         if acks < len(live):
             # acked but DEGRADED: a member missed its sub-write (lost
@@ -1534,7 +1608,7 @@ class OSD:
                                extents=[(0, 0)] if stat_only
                                else [(chunk_off, clen)]))
                 sent += 1
-            except Exception:
+            except TRANSPORT_ERRORS:
                 pass
         plan_set = set(plan)
         for r in await self._gather(tid, q, sent):
@@ -1612,7 +1686,7 @@ class OSD:
             try:
                 await self.messenger.send(self.osdmap.addr_of(osd), msg)
                 sent += 1
-            except Exception:
+            except TRANSPORT_ERRORS:
                 pass
         for r in await self._gather(tid, q, sent):
             if r.ok:
@@ -1635,9 +1709,10 @@ class OSD:
             # retry once as a cluster-wide broadcast before failing.
             viable: List[int] = []
             by_version: Dict[int, Dict[int, Tuple[bytes, int]]] = {}
+            hunt_complete = False
             for broadcast in (False, True):
-                hunted = await self._fetch_all_shards(op.pool_id, op.oid,
-                                                      broadcast=broadcast)
+                hunted, hunt_complete = await self._fetch_all_shards(
+                    op.pool_id, op.oid, broadcast=broadcast)
                 by_version = {}
                 for s_, c_ in chunks.items():
                     by_version.setdefault(versions[s_], {})[s_] = (c_, sizes[s_])
@@ -1650,9 +1725,10 @@ class OSD:
                 if viable:
                     break
             if not by_version:
-                return MOSDOpReply(ok=False, error="object not found")
+                return self._absent_reply(hunt_complete, "shards")
             if not viable:
-                return MOSDOpReply(ok=False, error="cannot reconstruct: shards missing")
+                return MOSDOpReply(ok=False, code=-errno.EAGAIN,
+                                   error="cannot reconstruct: shards missing")
             newest = max(viable)
             chunks = {s_: cm[0] for s_, cm in by_version[newest].items()}
             sizes = {s_: cm[1] for s_, cm in by_version[newest].items()}
@@ -1744,14 +1820,15 @@ class OSD:
                                     from_osd=self.osd_id,
                                     epoch=self.osdmap.epoch))
                     sent += 1
-                except Exception:
+                except TRANSPORT_ERRORS:
                     pass
         replies = await self._gather(tid, q, sent)
         acks = 1 + sum(1 for r in replies if r.ok)
         if acks < pool.min_size:
             self._mark_failed_write(op.reqid)
             return MOSDOpReply(
-                ok=False, error=f"write acked by {acks} < min_size {pool.min_size}")
+                ok=False, code=-errno.EAGAIN,
+                error=f"write acked by {acks} < min_size {pool.min_size}")
         if acks < len([a for a in acting if a != CRUSH_ITEM_NONE]):
             self._kick_recovery(pool, pg)  # degraded write: recover now
         self._cache_put(op.pool_id, op.oid, version, data)
@@ -1778,18 +1855,20 @@ class OSD:
         )
         if best is not None and best[1] < latest_logged:
             best = None
+        hunt_complete = True
         if best is None:
             # a copy is a copy regardless of the position key it was stored
             # under in an earlier interval: hunt every up OSD for any shard
             # of the oid and take the newest (placement-drift tolerance)
-            for shard, chunk, version, osize in await self._fetch_all_shards(
-                    op.pool_id, op.oid):
+            hunted, hunt_complete = await self._fetch_all_shards(
+                op.pool_id, op.oid)
+            for shard, chunk, version, osize in hunted:
                 if shard in exclude_shards:
                     continue
                 if best is None or version > best[1]:
                     best = (chunk, version, osize)
         if best is None:
-            return MOSDOpReply(ok=False, error="object not found")
+            return self._absent_reply(hunt_complete, "copies")
         data, version, size = best
         self._cache_put(op.pool_id, op.oid, version, data[:size])
         return MOSDOpReply(ok=True, data=data[:size], version=version)
@@ -1803,18 +1882,19 @@ class OSD:
         pool = self.osdmap.pools[op.pool_id]
         if pool.pool_type == "ec":
             # reference parity: EC pools do not support class calls
-            return MOSDOpReply(ok=False,
+            return MOSDOpReply(ok=False, code=-errno.EOPNOTSUPP,
                                error="EOPNOTSUPP: class calls on EC pools")
         pg, acting = self._acting(pool, op.oid)
         if self._primary(pool, pg, acting) != self.osd_id:
-            return MOSDOpReply(ok=False, error="not primary")
+            return MOSDOpReply(ok=False, code=-errno.ESTALE,
+                               error="not primary")
         # class methods are not idempotent (refcount.get): a resend whose
         # reply was lost must return the ORIGINAL result, not re-execute
         if op.reqid and op.reqid in self._call_results:
             return self._call_results[op.reqid]
         fn = cls_registry.get(op.cls, op.method)
         if fn is None:
-            return MOSDOpReply(ok=False,
+            return MOSDOpReply(ok=False, code=-errno.ENOENT,
                                error=f"ENOENT: no class {op.cls}.{op.method}")
         # cls state lives under a CANONICAL shard key (0) so it survives
         # acting-position drift; data via the replicated read path (a
@@ -1831,7 +1911,7 @@ class OSD:
                        data=hctx.data, reqid=uuid.uuid4().hex),
                 pool, pg, acting)
             if not wr.ok:
-                return MOSDOpReply(ok=False, error=wr.error)
+                return MOSDOpReply(ok=False, code=wr.code, error=wr.error)
         if hctx.xattrs_dirty and ret >= 0:
             for name, value in hctx.xattrs.items():
                 self.store.setattr(key, name, value)
@@ -1845,7 +1925,7 @@ class OSD:
                         self.osdmap.addr_of(osd),
                         MSetXattrs(pool_id=op.pool_id, oid=op.oid,
                                    shard=0, xattrs=dict(hctx.xattrs)))
-                except Exception:
+                except TRANSPORT_ERRORS:
                     pass
         reply = MOSDOpReply(ok=True, data=pickle.dumps((ret, out)))
         if op.reqid:
@@ -1860,7 +1940,8 @@ class OSD:
         pool = self.osdmap.pools[op.pool_id]
         pg, acting = self._acting(pool, op.oid)
         if self._primary(pool, pg, acting) != self.osd_id:
-            return MOSDOpReply(ok=False, error="not primary")
+            return MOSDOpReply(ok=False, code=-errno.ESTALE,
+                               error="not primary")
         watcher = tuple(pickle.loads(op.data))
         key = (op.pool_id, op.oid)
         if remove:
@@ -1879,7 +1960,8 @@ class OSD:
         pool = self.osdmap.pools[op.pool_id]
         pg, acting = self._acting(pool, op.oid)
         if self._primary(pool, pg, acting) != self.osd_id:
-            return MOSDOpReply(ok=False, error="not primary")
+            return MOSDOpReply(ok=False, code=-errno.ESTALE,
+                               error="not primary")
         if op.reqid:
             if op.reqid in self._call_results:
                 return self._call_results[op.reqid]
@@ -1903,7 +1985,7 @@ class OSD:
                                      reply_to=self.addr),
                         peer_type="client")
                     sent.append(watcher)
-                except Exception:
+                except TRANSPORT_ERRORS:
                     # dead watcher: drop the registration (watch timeout role)
                     self._watchers.get((op.pool_id, op.oid), set()).discard(watcher)
             acked = []
@@ -1918,9 +2000,12 @@ class OSD:
                     self._watchers.get((op.pool_id, op.oid), set()).discard(watcher)
             reply = MOSDOpReply(ok=True, data=pickle.dumps(acked))
         except Exception as e:
-            # the inflight future must resolve even on failure, or every
-            # same-reqid resend would hang on a forever-pending shield
-            reply = MOSDOpReply(ok=False, error=f"{type(e).__name__}: {e}")
+            # deliberately BROAD: the inflight future must resolve even on
+            # an own-code failure, or every same-reqid resend would hang
+            # on a forever-pending shield (counted, not silent)
+            self.perf.inc("op_unexpected_error")
+            reply = MOSDOpReply(ok=False, code=-errno.EIO,
+                                error=f"{type(e).__name__}: {e}")
         if op.reqid:
             if reply.ok:
                 # only successes are replayable results; a failed notify
@@ -1968,19 +2053,21 @@ class OSD:
                         MECSubRead(pool_id=op.pool_id, pg=pg, oid=op.oid,
                                    shard=shard, tid=tid, reply_to=self.addr))
                     sent += 1
-                except Exception:
+                except TRANSPORT_ERRORS:
                     continue
             for r in await self._gather(tid, q, sent, timeout=2.0):
                 if r.ok and (best is None or r.version > best[0]):
                     best = (r.version, r.object_size)
+        hunt_complete = True
         if best is None:
             # placement drift: hunt any shard cluster-wide (metadata only)
-            for _s, _c, version, osize in await self._fetch_all_shards(
-                    op.pool_id, op.oid):
+            hunted, hunt_complete = await self._fetch_all_shards(
+                op.pool_id, op.oid)
+            for _s, _c, version, osize in hunted:
                 if best is None or version > best[0]:
                     best = (version, osize)
         if best is None:
-            return MOSDOpReply(ok=False, error="object not found")
+            return self._absent_reply(hunt_complete, "shards")
         return MOSDOpReply(ok=True, version=best[0],
                            data=str(best[1]).encode())
 
@@ -2027,7 +2114,7 @@ class OSD:
                                  if osd in acting_set else b""),
                 )
                 sent += 1
-            except Exception:
+            except TRANSPORT_ERRORS:
                 pass
         await self._gather(tid, q, sent)
         return MOSDOpReply(ok=True)
@@ -2169,7 +2256,7 @@ class OSD:
             await self.messenger.send(
                 tuple(msg.reply_to), MECSubWriteReply(tid=msg.tid, shard=msg.shard, ok=ok)
             )
-        except Exception:
+        except TRANSPORT_ERRORS:
             pass
 
     async def _handle_sub_read(self, msg: MECSubRead) -> None:
@@ -2206,7 +2293,7 @@ class OSD:
             )
         try:
             await self.messenger.send(tuple(msg.reply_to), reply)
-        except Exception:
+        except TRANSPORT_ERRORS:
             pass
 
     async def _handle_sub_delete(self, msg: MECSubDelete) -> None:
@@ -2228,7 +2315,7 @@ class OSD:
             await self.messenger.send(
                 tuple(msg.reply_to), MECSubWriteReply(tid=msg.tid, shard=msg.shard, ok=True)
             )
-        except Exception:
+        except TRANSPORT_ERRORS:
             pass
 
     async def _fetch_all_shards(self, pool_id: int, oid: str,
@@ -2239,8 +2326,15 @@ class OSD:
         OSDs outside the scope set were purged of strays when their
         interval closed; ``broadcast=True`` is the slow-path fallback for
         when that bookkeeping was itself disrupted (lost purges under
-        socket failures)."""
+        socket failures).
+
+        Returns (shards, complete): ``complete`` is True only when every
+        possible holder was up, was reached, and answered — the bar for
+        treating an empty result as VERIFIED absence (-ENOENT) rather than
+        cannot-locate (-EAGAIN).  A gather timeout or an unreachable/down
+        holder makes the hunt incomplete: the shards may exist there."""
         out = []
+        complete = True
         for oid2, shard in self.store.list_objects(pool_id):
             if oid2 != oid:
                 continue
@@ -2250,8 +2344,12 @@ class OSD:
                             got[1].object_size))
         pool = self.osdmap.pools.get(pool_id)
         if pool is None:
-            return out
+            return out, False
         pg = self.osdmap.object_to_pg(pool, oid)
+        # a down possible-holder may be carrying the shards through a
+        # restart: its absence from the queried set forfeits "complete"
+        if not self._scope_all_up(pool, pg):
+            complete = False
         if broadcast:
             peers = [o.osd_id for o in self.osdmap.osds.values()
                      if o.up and o.osd_id != self.osd_id]
@@ -2268,11 +2366,14 @@ class OSD:
                     MFetchShards(pool_id=pool_id, oid=oid, tid=tid, reply_to=self.addr),
                 )
                 sent += 1
-            except Exception:
-                pass
-        for r in await self._gather(tid, q, sent):
+            except TRANSPORT_ERRORS:
+                complete = False  # unreachable holder: unknown contents
+        replies = await self._gather(tid, q, sent)
+        if len(replies) < sent:
+            complete = False  # gather timeout: someone never answered
+        for r in replies:
             out.extend(tuple(s) for s in r.shards)
-        return out
+        return out, complete
 
     async def _handle_fetch_shards(self, msg: MFetchShards) -> None:
         shards = []
@@ -2288,7 +2389,7 @@ class OSD:
                 tuple(msg.reply_to),
                 MFetchShardsReply(tid=msg.tid, osd_id=self.osd_id, shards=shards),
             )
-        except Exception:
+        except TRANSPORT_ERRORS:
             pass
 
     async def _handle_list_shards(self, msg: MListShards) -> None:
@@ -2307,7 +2408,7 @@ class OSD:
                 tuple(msg.reply_to),
                 MListShardsReply(tid=msg.tid, osd_id=self.osd_id, entries=entries),
             )
-        except Exception:
+        except TRANSPORT_ERRORS:
             pass
 
     def _apply_push(self, msg: MPushShard) -> None:
@@ -2380,7 +2481,7 @@ class OSD:
                     MPGInfoReq(pool_id=pool.pool_id, pg=pg, tid=tid,
                                reply_to=self.addr))
                 sent += 1
-            except Exception:
+            except TRANSPORT_ERRORS:
                 pass
         infos: Dict[int, Tuple[int, int]] = {self.osd_id: log.head}
         # short timeout: one dropped frame must not stall the recovery
@@ -2440,7 +2541,7 @@ class OSD:
                 self.osdmap.addr_of(osd),
                 MPGLogReply(tid="", osd_id=self.osd_id, pool_id=pool_id,
                             pg=pg, entries=[e.encode() for e in entries]))
-        except Exception:
+        except TRANSPORT_ERRORS:
             pass
 
     # -- scrub (be_deep_scrub role, ECBackend.cc:2530) -----------------------
@@ -2463,15 +2564,15 @@ class OSD:
         ok = crc == meta.chunk_crc
         try:
             raw = self.store.getattr(key, HashInfo.XATTR_KEY)
-        except Exception:
-            raw = None
+        except (IOError, OSError):
+            raw = None  # unreadable xattr: scrub treats as missing hinfo
         if raw:
             try:
                 h = HashInfo.decode(raw)
                 if shard < len(h.crcs):
                     ok = ok and h.crcs[shard] == crc \
                         and h.total_chunk_size == len(chunk)
-            except Exception:
+            except (ValueError, KeyError, TypeError):
                 ok = False  # unparseable hinfo is itself a scrub error
         return True, ok, meta.version, crc
 
@@ -2490,13 +2591,13 @@ class OSD:
             try:
                 raw = self.store.getattr((pool_id, oid, shard),
                                          HashInfo.XATTR_KEY)
-            except Exception:
+            except (IOError, OSError):
                 return None
             if not raw:
                 return None
             try:
                 h = HashInfo.decode(raw)
-            except Exception:
+            except (ValueError, KeyError, TypeError):
                 return None
             return None if h.dirty else h
         return None
@@ -2550,7 +2651,7 @@ class OSD:
                                         shard=shard, tid=tid,
                                         reply_to=self.addr))
                         sent += 1
-                    except Exception:
+                    except TRANSPORT_ERRORS:
                         pass
             replies = local_results + await self._gather(tid, q, sent,
                                                          timeout=2.0)
@@ -2593,7 +2694,7 @@ class OSD:
                                              oid=oid,
                                              shard=shard + PREV_SLOT,
                                              tid="", reply_to=self.addr))
-                        except Exception:
+                        except TRANSPORT_ERRORS:
                             pass
                 if txn.deletes:
                     self.store.queue_transaction(txn)
@@ -2620,7 +2721,7 @@ class OSD:
                                 await self.messenger.send(
                                     self.osdmap.addr_of(osd), push)
                                 repaired += 1
-                            except Exception:
+                            except TRANSPORT_ERRORS:
                                 pass
         return {"scrubbed": scrubbed, "errors": errors, "repaired": repaired}
 
@@ -2637,7 +2738,7 @@ class OSD:
                     o.addr, MListShards(pool_id=pool_id, tid=tid,
                                         reply_to=self.addr))
                 sent += 1
-            except Exception:
+            except TRANSPORT_ERRORS:
                 pass
         out = []
         for oid, shard in self._list_pool_objects(pool_id):
@@ -2704,12 +2805,17 @@ class OSD:
             return 0
         return sum(await asyncio.gather(*jobs))
 
-    def _scope_osds(self, pool: PoolInfo, pg: int) -> List[int]:
+    def _scope_osds(self, pool: PoolInfo, pg: int,
+                    up_only: bool = True) -> List[int]:
         """The OSDs that can possibly hold shards of this PG: current
         acting, crush up-set, and every member of intervals since the PG
         was last clean (_past_members / _prior_acting — the reference's
         past_intervals role).  Deletes, shard hunts, and backfill scans
-        contact only this set instead of broadcasting to the cluster."""
+        contact only this set instead of broadcasting to the cluster.
+        ``up_only=False`` returns the full holder set including down
+        members — decisions that treat absence-of-shards as proof (the
+        unfound revert, verified-absent replies) must check that EVERY
+        possible holder is up and was heard from, not just the up ones."""
         key = (pool.pool_id, pg)
         scope = {a for a in self.osdmap.pg_to_acting(pool, pg)
                  if a != CRUSH_ITEM_NONE}
@@ -2718,8 +2824,34 @@ class OSD:
         scope.update(a for a in self._prior_acting.get(key, [])
                      if a != CRUSH_ITEM_NONE)
         scope.update(self._past_members.get(key, ()))
+        if not up_only:
+            return [o for o in scope if o in self.osdmap.osds]
         return [o for o in scope
                 if self.osdmap.osds.get(o) and self.osdmap.osds[o].up]
+
+    def _scope_all_up(self, pool: PoolInfo, pg: int) -> bool:
+        """Is every POSSIBLE holder of this PG (including past-interval
+        members) up right now?  The bar for treating shard absence as
+        proof rather than suspicion."""
+        return all(
+            self.osdmap.osds.get(o) and self.osdmap.osds[o].up
+            for o in self._scope_osds(pool, pg, up_only=False))
+
+    def _reserve_lease(self) -> float:
+        return float(self.conf.get("osd_backfill_reserve_lease", 300.0)
+                     or 300.0)
+
+    @staticmethod
+    def _absent_reply(hunt_complete: bool, what: str) -> MOSDOpReply:
+        """Typed reply for a fruitless shard hunt: VERIFIED absence only
+        when every possible holder answered; otherwise the client must
+        retry, not take "no" for an answer."""
+        if hunt_complete:
+            return MOSDOpReply(ok=False, code=-errno.ENOENT,
+                               error="object not found")
+        return MOSDOpReply(ok=False, code=-errno.EAGAIN,
+                           error=f"{what} unavailable (holders unreachable "
+                                 "or listing incomplete)")
 
     async def _gather_holdings(
         self, pool: PoolInfo, pg: int = -1,
@@ -2751,7 +2883,7 @@ class OSD:
                     MListShards(pool_id=pool.pool_id, tid=tid,
                                 reply_to=self.addr, pg=pg))
                 sent += 1
-            except Exception:
+            except TRANSPORT_ERRORS:
                 complete = False  # unreachable peer: listing is partial
         holdings: Dict[str, Set[Tuple[int, int, int]]] = {}
         for oid, shard in self._list_pool_objects(pool.pool_id):
@@ -2808,7 +2940,7 @@ class OSD:
             await self._mon_rpc(
                 MOSDPGTemp(pool_id=pool.pool_id, pg=pg, acting=list(prior),
                            from_osd=self.osd_id), MMapReply)
-        except Exception:
+        except TRANSPORT_ERRORS:
             pass
 
     async def _clear_done_pg_temps(
@@ -2864,7 +2996,7 @@ class OSD:
                     MOSDPGTemp(pool_id=pool.pool_id, pg=pg, acting=[],
                                from_osd=self.osd_id), MMapReply)
                 self._prior_acting.pop((pool.pool_id, pg), None)
-            except Exception:
+            except TRANSPORT_ERRORS:
                 pass
 
     async def _recover_shard_subchunk(
@@ -2935,8 +3067,8 @@ class OSD:
                 if (not h.dirty and lost < len(h.crcs)
                         and h.crcs[lost] == shard_crc(blob)):
                     hinfo_blob = helper_hinfo
-            except Exception:
-                pass
+            except (ValueError, KeyError, TypeError):
+                pass  # garbled helper hinfo: target recomputes its own
         return blob, object_size, hinfo_blob
 
     async def _sub_read_extents(
@@ -2969,7 +3101,7 @@ class OSD:
                 MECSubRead(pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard,
                            tid=tid, reply_to=self.addr, extents=extents,
                            want_hinfo=want_hinfo))
-        except Exception:
+        except TRANSPORT_ERRORS:
             self._collectors.pop(tid, None)
             return None
         for r in await self._gather(tid, q, 1, timeout=2.0):
@@ -3041,8 +3173,20 @@ class OSD:
         up-set is fully covered.  Returns (shards_pushed, the gathered
         holdings, fully_covered)."""
         gather_epoch = self.osdmap.epoch
+        # snapshot BEFORE the gather: the revert decision must be made
+        # about the cluster as it was when the listing was taken.  A
+        # holder that was down during the gather (never queried) but up
+        # by decision time would otherwise make its unseen shards count
+        # as verified-absent (TOCTOU).  The queried set is the up-filtered
+        # scope at this same instant, so "all holders up at gather_epoch
+        # AND every queried peer answered" == complete knowledge.
+        holders_all_up = self._scope_all_up(pool, pg)
         holdings, listing_ok = await self._gather_holdings(
             pool, pg=pg, osds=self._scope_osds(pool, pg))
+        if self.osdmap.epoch != gather_epoch:
+            # the map moved mid-gather: the listing may straddle two
+            # membership views — never revert on it
+            holders_all_up = False
         k_need = (self._codec(pool).get_data_chunk_count()
                   if pool.pool_type == "ec" else 1)
         pushed = 0
@@ -3060,20 +3204,32 @@ class OSD:
             newest, at_newest = got
             # shards NEWER than the newest complete version are either
             # leftovers of a failed write, a concurrent write racing this
-            # scan, or an acked write whose holders died (unfound).  A
-            # single observation must not destroy anything — a just-acked
-            # write can look partial for a moment — but a version that
-            # stays partial across TWO complete listings is unrecoverable
-            # (fewer than k shards exist anywhere): revert its shards to
-            # their rollback slots so the newest COMPLETE version regains
-            # live seats (automated mark_unfound_lost-revert).
+            # scan, or an acked write whose holders died (unfound).  The
+            # reference leaves resolving this to the operator
+            # (mark_unfound_lost revert) because reverting wrongly
+            # DESTROYS an acked write; the automated revert here therefore
+            # fires only when absence is proof, not suspicion:
+            #   - every possible holder of the PG (including down/past-
+            #     interval members, who may be holding the missing shards
+            #     through a restart) is up and answered the listing;
+            #   - the version has stayed partial for at least
+            #     osd_unfound_revert_grace seconds AND across two complete
+            #     listings (in-flight acks get time to land);
+            #   - osd_auto_revert_unfound has not been switched off (the
+            #     operator escape hatch to reference behavior).
             newer_partial = {v for (_s, _o, v) in locs if v > newest}
-            if newer_partial and listing_ok:
-                seen = self._partial_newer.setdefault((pool.pool_id, pg), set())
-                fully_covered = False
+            if newer_partial:
+                fully_covered = False  # unresolved versions: never purge
+            if newer_partial and listing_ok and holders_all_up \
+                    and self.conf.get("osd_auto_revert_unfound", True):
+                grace = float(
+                    self.conf.get("osd_unfound_revert_grace", 30.0) or 30.0)
+                seen = self._partial_newer.setdefault((pool.pool_id, pg), {})
+                now = time.monotonic()
                 for v_bad in newer_partial:
-                    if (oid, v_bad) not in seen:
-                        continue  # first sighting: give in-flight acks time
+                    first_seen = seen.get((oid, v_bad))
+                    if first_seen is None or now - first_seen < grace:
+                        continue  # first sighting / inside grace: wait
                     for shard, osd, v in locs:
                         if v != v_bad or shard >= PREV_SLOT:
                             continue
@@ -3087,7 +3243,7 @@ class OSD:
                             try:
                                 await self.messenger.send(
                                     self.osdmap.addr_of(osd), rb)
-                            except Exception:
+                            except TRANSPORT_ERRORS:
                                 pass
             # push targets are the UP-SET positions: identical to acting
             # normally, but under pg_temp the override serves IO while
@@ -3117,7 +3273,7 @@ class OSD:
                         try:
                             await self.messenger.send(
                                 self.osdmap.addr_of(target), push)
-                        except Exception:
+                        except TRANSPORT_ERRORS:
                             continue
                     pushed += 1
                     continue
@@ -3145,16 +3301,29 @@ class OSD:
                 else:
                     try:
                         await self.messenger.send(self.osdmap.addr_of(osd), push)
-                    except Exception:
+                    except TRANSPORT_ERRORS:
                         continue
                 pushed += 1
-        if listing_ok:
-            observed = set()
+        if listing_ok and holders_all_up:
+            # refresh the partial-version watchlist: entries keep their
+            # first-seen time across sweeps (the grace clock), entries no
+            # longer partial drop out, new ones start their clock now.
+            # Accrual requires FULL visibility (every possible holder up
+            # and answering): grace accumulated during an outage that
+            # hides the shards would be worthless evidence.
+            prev = self._partial_newer.get((pool.pool_id, pg), {})
+            now = time.monotonic()
+            observed: Dict[Tuple[str, int], float] = {}
             for oid, locs in holdings.items():
                 got = self._newest_complete(locs, k_need)
                 base = got[0] if got else 0
-                observed.update((oid, v) for (_s, _o, v) in locs if v > base)
+                for (_s, _o, v) in locs:
+                    if v > base:
+                        observed[(oid, v)] = prev.get((oid, v), now)
             self._partial_newer[(pool.pool_id, pg)] = observed
+        elif not holders_all_up:
+            # incomplete visibility invalidates any accrued grace
+            self._partial_newer.pop((pool.pool_id, pg), None)
         if fully_covered and not self.osdmap.pg_temp.get((pool.pool_id, pg)):
             await self._purge_strays(pool, pg, holdings, gather_epoch)
         return pushed, holdings, fully_covered
@@ -3188,5 +3357,5 @@ class OSD:
                         MECSubDelete(pool_id=pool.pool_id, pg=pg, oid=oid,
                                      shard=-1, tid="", reply_to=self.addr))
                     self.perf.inc("stray_purged")
-                except Exception:
+                except TRANSPORT_ERRORS:
                     pass

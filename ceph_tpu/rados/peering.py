@@ -144,19 +144,35 @@ class ReservationSlots:
 
     def __init__(self, slots: int):
         self.slots = max(1, int(slots))
-        self.held: Set[Tuple[int, int]] = set()
+        # key -> grant metadata (grantee osd id or None, monotonic grant
+        # time).  Remote grants carry who they were granted TO so stale
+        # holds can be revoked when that primary dies or loses the PG
+        # (the reference cancels remote reservations on interval change).
+        self.held: Dict[Tuple[int, int], Tuple[Optional[int], float]] = {}
         self._waiters: List[Tuple[int, int, Tuple[int, int], asyncio.Future]] = []
         self._seq = 0
 
-    def try_acquire(self, key: Tuple[int, int]) -> bool:
+    def try_acquire(self, key: Tuple[int, int],
+                    grantee: Optional[int] = None) -> bool:
         """Non-blocking grant (remote reservation RPC path): the requester
-        retries later on rejection rather than holding a wire slot open."""
+        retries later on rejection rather than holding a wire slot open.
+        Re-acquiring a held key refreshes its grant time (lease renewal)."""
         if key in self.held:
+            self.held[key] = (grantee, time.monotonic())
             return True
         if len(self.held) < self.slots:
-            self.held.add(key)
+            self.held[key] = (grantee, time.monotonic())
             return True
         return False
+
+    def revoke_stale(self, keep) -> int:
+        """Drop held grants a predicate no longer endorses; returns the
+        number revoked and wakes queued waiters for the freed slots.
+        ``keep(key, grantee, granted_at)`` -> bool."""
+        stale = [k for k, (g, t) in self.held.items() if not keep(k, g, t)]
+        for k in stale:
+            self.release(k)
+        return len(stale)
 
     async def acquire(self, key: Tuple[int, int], priority: int = 0,
                       timeout: Optional[float] = None) -> bool:
@@ -186,12 +202,12 @@ class ReservationSlots:
             raise
 
     def release(self, key: Tuple[int, int]) -> None:
-        self.held.discard(key)
+        self.held.pop(key, None)
         while self._waiters and len(self.held) < self.slots:
             _p, _s, k, fut = self._waiters.pop(0)
             if fut.done():
                 continue
-            self.held.add(k)
+            self.held[k] = (None, time.monotonic())
             fut.set_result(True)
 
     def dump(self) -> Dict[str, object]:

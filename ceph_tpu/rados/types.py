@@ -357,6 +357,9 @@ class MAuthTicketReply:
     tid: str = ""
     ticket: str = ""  # hex blob, sealed under the rotating service secret
     session_key: str = ""  # hex
+    # daemon-type tickets are refused to non-daemon-authenticated
+    # connections (they would pass the rotating-key gate)
+    denied: bool = False
 
 
 @message(58)
@@ -372,6 +375,10 @@ class MAuthRotating:
 class MAuthRotatingReply:
     tid: str = ""
     keys: Dict[int, str] = field(default_factory=dict)
+    # the connection's auth level does not entitle it to the rotating
+    # secrets (ticket-authenticated client): distinct from an empty
+    # keyring so the requester logs a refusal, not a mystery
+    denied: bool = False
 
 
 @message(60)
@@ -434,6 +441,15 @@ class MOSDOp:
 class MOSDOpReply:
     ok: bool = True
     error: str = ""
+    # typed result, reference 0/-errno contract (ErasureCodeInterface.h:155
+    # and MOSDOpReply's result field): 0 on success, else a NEGATIVE errno.
+    # The client classifies definitive / placement-moved / retryable by
+    # code — the human-readable `error` string is never matched on.
+    #   definitive  : -ENOENT -EOPNOTSUPP -EINVAL -EPERM -EBADMSG -ENXIO
+    #   moved       : -ESTALE  (not primary: re-target past the reply epoch)
+    #   retryable   : -EAGAIN  (degraded / below min_size / shards
+    #                 transiently unavailable), -EIO and anything else
+    code: int = 0
     data: bytes = b""
     oids: List[str] = field(default_factory=list)
     reqid: str = ""

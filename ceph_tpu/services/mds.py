@@ -25,6 +25,7 @@ cls lock class when callers need it.
 
 from __future__ import annotations
 
+import errno
 import json
 import posixpath
 import struct
@@ -74,7 +75,12 @@ class MDLog:
         been fully applied (mount() replays them)."""
         try:
             head = json.loads(await self.ioctx.read(self.HEAD_OID))
-        except RadosError:
+        except RadosError as e:
+            # a fresh journal is only the right answer for VERIFIED
+            # absence; resetting the cursor on a transient read failure
+            # would replay from scratch / lose the append position
+            if e.code != -errno.ENOENT:
+                raise
             head = {"expire_seg": 0, "write_seg": 0}
         self.expire_seg = head["expire_seg"]
         events: List[Dict] = []

@@ -34,6 +34,7 @@ core being reproduced.
 from __future__ import annotations
 
 import asyncio
+import errno
 import json
 import uuid
 from typing import Dict, List, Optional
@@ -263,7 +264,13 @@ class Image:
             return False
         try:
             old = await self.ioctx.read(self._data_oid(idx))
-        except RadosError:
+        except RadosError as e:
+            # only VERIFIED absence (typed -ENOENT) may be treated as a
+            # never-written block; a transient failure (-EAGAIN, timeout
+            # exhaustion) must abort the write, or the snapshot would
+            # permanently capture an EMPTY clone of a block that exists
+            if e.code != -errno.ENOENT:
+                raise
             old = b""
         await self.ioctx.write_full(self._clone_oid(idx, newest["id"]), old)
         newest["cow"].append(idx)

@@ -31,6 +31,7 @@ versioning/multisite/ACL policies.
 from __future__ import annotations
 
 import asyncio
+import errno
 import hashlib
 import hmac
 import json
@@ -62,8 +63,14 @@ class RgwService:
     async def _load_index(self, bucket: str) -> Optional[Dict[str, Dict]]:
         try:
             return json.loads(await self.ioctx.read(self._index_oid(bucket)))
-        except RadosError:
-            return None
+        except RadosError as e:
+            # None means the bucket verifiably does not exist (-ENOENT).
+            # A transient failure (-EAGAIN shard unavailability, timeout
+            # exhaustion) must surface as an error — mapping it to None
+            # would 404 a bucket that exists (NoSuchBucket vs 503).
+            if e.code == -errno.ENOENT:
+                return None
+            raise
 
     async def _save_index(self, bucket: str, index: Dict[str, Dict]) -> None:
         await self.ioctx.write_full(self._index_oid(bucket),

@@ -213,3 +213,114 @@ class TestCephxCluster:
                 await cluster.stop()
 
         run(go(), timeout=120)
+
+
+class TestSecureModeDowngrade:
+    """ms_secure_mode is a requirement: a connection that would end up
+    plaintext (peer not in secure mode, or mode bits stripped in flight)
+    must FAIL, not silently downgrade (reference msgr2 binds the
+    negotiated mode into the signed handshake payload)."""
+
+    def test_plaintext_peer_refused(self):
+        async def go():
+            from ceph_tpu.rados.messenger import Messenger
+            from ceph_tpu.rados.types import MPing
+
+            secure = Messenger("a", {"ms_auth_secret": "s",
+                                     "ms_secure_mode": True})
+            received: list = []
+
+            async def recorder(conn, msg):
+                received.append(msg)
+
+            plain = Messenger("b", {"ms_auth_secret": "s"})
+            secure.dispatcher = plain.dispatcher = recorder
+            await secure.bind()
+            await plain.bind()
+            try:
+                # secure initiator -> plaintext acceptor: the dial FAILS
+                with pytest.raises((PermissionError, ConnectionError, OSError)):
+                    await secure.send(plain.addr, MPing())
+                # plaintext initiator -> secure acceptor: the acceptor
+                # refuses the handshake, so the frame is never dispatched
+                # (the send itself returns — socket writes are async)
+                try:
+                    await plain.send(secure.addr, MPing())
+                except (PermissionError, ConnectionError, OSError):
+                    pass
+                await asyncio.sleep(0.3)
+                assert not received, "a plaintext frame crossed a secure peer"
+            finally:
+                await secure.shutdown()
+                await plain.shutdown()
+
+        run(go())
+
+    def test_stripped_mode_bits_break_the_auth_tag(self):
+        """The secure flags ride the HMAC'd material: recomputing the
+        acceptor tag over stripped bits must not verify."""
+        from ceph_tpu.rados.messenger import Messenger
+
+        m = Messenger("a", {"ms_auth_secret": "s", "ms_secure_mode": True})
+        nonce = b"n" * 16
+        tag_secure = m._auth_tag(nonce, None, m._mode_transcript(True, True))
+        tag_stripped = m._auth_tag(nonce, None, m._mode_transcript(False, True))
+        assert tag_secure != tag_stripped
+
+
+async def _sink(conn, msg):
+    pass
+
+
+class TestRotatingKeyAccess:
+    CONF = {
+        "osd_auto_repair": False,
+        "ms_auth_secret": "cluster-bootstrap-secret",
+        "auth_cephx": True,
+    }
+
+    def test_client_ticket_cannot_fetch_rotating_keys(self):
+        """A ticket-authenticated CLIENT connection must be refused the
+        rotating service secrets — a leaked short-lived client ticket
+        must not upgrade to the ability to forge arbitrary tickets."""
+        async def go():
+            from ceph_tpu.rados.types import MAuthRotating, MAuthRotatingReply
+
+            cluster = Cluster(n_osds=3, conf=dict(self.CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                assert c.messenger.ticket is not None
+                # drop the bootstrap-authenticated mon connection so the
+                # next dial presents the (client) ticket
+                for conn in list(c.messenger._conns.values()):
+                    await conn.close()
+                c.messenger._conns.clear()
+                got: list = []
+
+                orig = c._dispatch
+
+                async def spy(conn, msg):
+                    if isinstance(msg, MAuthRotatingReply):
+                        got.append(msg)
+                        return
+                    await orig(conn, msg)
+
+                c.messenger.dispatcher = spy
+                await c.messenger.send(cluster.mons[0].addr, MAuthRotating())
+                for _ in range(50):
+                    if got:
+                        break
+                    await asyncio.sleep(0.05)
+                assert got, "no MAuthRotatingReply received"
+                assert got[0].denied, "client ticket was served rotating keys"
+                assert not got[0].keys
+                # daemons still get them (the OSDs booted with a keyring)
+                osd = next(iter(cluster.osds.values()))
+                assert osd.messenger.keyring is not None
+                assert osd.messenger.keyring.keys
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())

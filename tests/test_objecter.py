@@ -212,3 +212,64 @@ class TestIntervalFence:
                 await cluster.stop()
 
         run(go())
+
+
+class TestTypedErrorCodes:
+    def test_absent_object_is_definitive_enoent(self):
+        """GET of an object that never existed answers fast with a typed
+        -ENOENT (verified absent: every holder answered the hunt) instead
+        of burning retries (reference: definitive errno are returned, not
+        retried)."""
+        async def go():
+            import errno
+            import time as _time
+
+            from ceph_tpu.rados.client import RadosError
+
+            cluster = Cluster(n_osds=3, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("codes", profile=PROFILE)
+                await c.put(pool, "exists", b"x" * 1000)
+                t0 = _time.monotonic()
+                try:
+                    await c.get(pool, "never-written")
+                    assert False, "absent object read succeeded"
+                except RadosError as e:
+                    assert e.code == -errno.ENOENT, e.code
+                # definitive answer, no retry stall
+                assert _time.monotonic() - t0 < 3.0
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_wrong_primary_reply_is_typed_estale(self):
+        """A non-primary member answers a direct op with -ESTALE so the
+        client re-targets by code, never by matching the error string."""
+        async def go():
+            import errno
+
+            cluster = Cluster(n_osds=3, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("estale", profile=PROFILE)
+                await c.put(pool, "obj", b"y" * 500)
+                _p, _pg, acting, primary = _locate(c, cluster, pool, "obj")
+                wrong = [o for o in acting if o != primary][0]
+                from ceph_tpu.rados.client import RadosError
+                try:
+                    await c._op_direct(
+                        wrong, MOSDOp(op="write", pool_id=pool, oid="obj",
+                                      data=b"z"))
+                    assert False, "non-primary accepted a write"
+                except RadosError as e:
+                    assert e.code == -errno.ESTALE, (e.code, str(e))
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())

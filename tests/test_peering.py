@@ -313,3 +313,62 @@ class TestRecoveryTriggers:
                 await cluster.stop()
 
         run(go())
+
+
+class TestReservationLeases:
+    def test_revoke_stale_by_predicate(self):
+        async def go():
+            r = ReservationSlots(2)
+            assert r.try_acquire((1, 0), grantee=7)
+            assert r.try_acquire((1, 1), grantee=8)
+            # predicate keeps only grants from osd 8
+            revoked = r.revoke_stale(lambda key, g, t: g == 8)
+            assert revoked == 1
+            assert (1, 0) not in r.held and (1, 1) in r.held
+            # the freed slot is usable again
+            assert r.try_acquire((1, 2), grantee=9)
+
+        run(go())
+
+    def test_reacquire_renews_grant_time(self):
+        async def go():
+            r = ReservationSlots(1)
+            assert r.try_acquire((1, 0), grantee=7)
+            _, t0 = r.held[(1, 0)]
+            await asyncio.sleep(0.02)
+            assert r.try_acquire((1, 0), grantee=7)  # lease renewal
+            _, t1 = r.held[(1, 0)]
+            assert t1 > t0
+
+        run(go())
+
+    def test_map_change_revokes_dead_primarys_remote_grant(self):
+        """A remote backfill reservation granted to a primary that then
+        dies (without releasing) must be revoked on the next map change —
+        otherwise a few primary crashes would permanently exhaust the
+        slots (reference: remote reservations are cancelled on interval
+        change / peer reset)."""
+        async def go():
+            cluster = Cluster(n_osds=4, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                await c.create_pool("rl", profile=PROFILE)
+                osd = next(iter(cluster.osds.values()))
+                # forge a grant from an OSD that is about to die
+                victim = [o for o in cluster.osds if o != osd.osd_id][0]
+                pool_id = next(iter(c.osdmap.pools))
+                osd._remote_reserver.held[(pool_id, 0)] = (victim, 0.0)
+                await cluster.kill_osd(victim)
+                await c.mark_osd_down(victim)
+                for _ in range(50):
+                    if (pool_id, 0) not in osd._remote_reserver.held:
+                        break
+                    await asyncio.sleep(0.1)
+                assert (pool_id, 0) not in osd._remote_reserver.held, \
+                    "stale remote grant survived the interval change"
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
