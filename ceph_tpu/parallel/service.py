@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -50,7 +50,7 @@ class BatchingQueue:
         self._use_pallas = use_pallas
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._groups: Dict[bytes, _Group] = {}
+        self._groups: Dict[Tuple, _Group] = {}
         self._pending = 0
         self._oldest: Optional[float] = None
         self._stop = False
@@ -67,7 +67,9 @@ class BatchingQueue:
         """Queue (mbits @ regions) over the byte layout; resolves to the
         [out_rows, B] parity/reconstruction buffer."""
         fut: Future = Future()
-        key = mbits.tobytes()
+        # the full dispatch signature: identical matrix BYTES under a
+        # different w or output arity is a different computation
+        key = (w, out_rows, mbits.shape, mbits.tobytes())
         with self._cv:
             if self._stop:
                 raise RuntimeError("BatchingQueue is closed")
@@ -121,7 +123,24 @@ class BatchingQueue:
                 if self._stop:
                     return
                 groups = self._take_locked()
-            self._dispatch(groups)
+            try:
+                self._dispatch(groups)
+            except Exception as e:
+                # the worker must NEVER die: a process-shared queue with a
+                # dead worker hangs every later submit.  _dispatch fans
+                # per-group errors out; anything that escapes is a bug in
+                # the fan-out itself — fail the taken groups' futures
+                # (they were already removed from _groups, so nobody else
+                # will resolve them) and keep serving.
+                import traceback
+
+                traceback.print_exc()
+                for g in groups:
+                    for _, fut in g.requests:
+                        try:
+                            fut.set_exception(e)
+                        except InvalidStateError:
+                            pass
 
     def _dispatch(self, groups: List[_Group]) -> None:
         from ceph_tpu.ops.gf2 import bucket_columns as _bucket
@@ -152,14 +171,23 @@ class BatchingQueue:
                 )
             except Exception as e:
                 for _, fut in g.requests:
-                    if not fut.done():
+                    try:
                         fut.set_exception(e)
+                    except InvalidStateError:
+                        pass
                 continue
             self.dispatches += 1
             self.bytes_dispatched += batch.nbytes
             off = 0
             for width, (_, fut) in zip(widths, g.requests):
-                # copy: a view would pin the whole batch buffer for as long
-                # as any single result stays alive
-                fut.set_result(out[:, off : off + width].copy())
+                # a submitter may have been CANCELLED while waiting (an
+                # async op torn down mid-flight propagates cancellation
+                # into the future via asyncio.wrap_future): its slice is
+                # simply dropped
+                try:
+                    # copy: a view would pin the whole batch buffer for as
+                    # long as any single result stays alive
+                    fut.set_result(out[:, off : off + width].copy())
+                except InvalidStateError:
+                    pass  # cancelled in the check-to-set window
                 off += width

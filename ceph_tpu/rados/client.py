@@ -98,10 +98,7 @@ class RadosClient:
             self.messenger.ticket = None
             self.messenger.session_key = None
             for addr in list(self.mons.addrs):
-                conn = self.messenger._conns.get(tuple(addr))
-                if conn is not None:
-                    await conn.close()
-                    self.messenger._conns.pop(tuple(addr), None)
+                await self.messenger.disconnect(addr)
         reply = await self._mon_rpc(
             MAuthTicket(entity="client", entity_type="client"))
         if getattr(reply, "denied", False):

@@ -150,6 +150,39 @@ def _mapped_shard_list(codec, data_rows: np.ndarray,
     return out  # type: ignore[return-value]
 
 
+def _queue_encode_plan(codec, sinfo: StripeInfo, arr: np.ndarray,
+                       n_stripes: int, queue):
+    """When the codec/queue combination is batchable (byte-layout bit
+    seam, no chunk remap), submit the whole buffer as ONE queue request
+    and return (future, reassemble) — reassemble turns the parity rows
+    into the per-shard blob list.  None when the queue path does not
+    apply (packet-layout, mapped, or sub-chunk codecs)."""
+    mbits = codec.bit_generator()
+    if (mbits is None or getattr(codec, "bit_layout", "byte") != "byte"
+            or codec.get_chunk_mapping()):
+        return None
+    k = codec.get_data_chunk_count()
+    n = codec.get_chunk_count()
+    m = n - k
+    w = getattr(codec, "w", 8)
+    mbits = np.asarray(mbits).astype(np.int8)
+    # columns = stripes concatenated; one submit -> one device call
+    flat = np.ascontiguousarray(
+        arr.transpose(1, 0, 2).reshape(k, n_stripes * sinfo.chunk_size))
+    fut = queue.submit(mbits, flat, w, m)
+
+    def reassemble(parity: np.ndarray) -> List[np.ndarray]:
+        p = np.asarray(parity).reshape(m, n_stripes, sinfo.chunk_size)
+        out: List[np.ndarray] = []
+        for i in range(k):
+            out.append(arr[:, i, :].reshape(-1))
+        for j in range(m):
+            out.append(p[j].reshape(-1))
+        return out
+
+    return fut, reassemble
+
+
 def batched_encode(codec, sinfo: StripeInfo, data: bytes,
                    queue=None) -> List[np.ndarray]:
     """Encode a multi-stripe buffer with ONE device dispatch.
@@ -164,43 +197,37 @@ def batched_encode(codec, sinfo: StripeInfo, data: bytes,
     to the per-stripe loop for every concat-safe codec (see concat_safe);
     CLAY takes the per-stripe path.  Returns one concatenated per-shard
     buffer each, `[n_shards][n_stripes*chunk]`, in physical shard order.
+
+    Blocking variant (tests/benchmark); daemons on an event loop use
+    ``batched_encode_async`` so concurrent ops actually COALESCE — a
+    blocking .result() on the loop thread would serialize submissions.
     """
     k = codec.get_data_chunk_count()
     n = codec.get_chunk_count()
     assert sinfo.k == k
     padded = sinfo.pad_to_stripe(data)
     n_stripes = max(1, len(padded) // sinfo.stripe_width)
-    if n_stripes <= 1:
+    # stripe-major view (no copy): [n_stripes, k, chunk].  Empty objects
+    # (len 0) cannot take the queue path — the codec's own encode handles
+    # the degenerate padding rules.
+    arr = (np.frombuffer(padded, dtype=np.uint8).reshape(
+               n_stripes, k, sinfo.chunk_size)
+           if len(padded) else None)
+    if queue is not None and arr is not None:
+        # the interface's bit seam drives ANY byte-layout codec through
+        # the one matmul kernel; packet-layout codecs (cauchy/liberation
+        # family) take the encode_chunks/per-stripe paths below.
+        # Single-stripe objects ride the queue too — coalescing across
+        # OBJECTS/ops is the point (SURVEY.md §7.5), and small concurrent
+        # writes are exactly the dispatch-latency-bound workload.
+        planned = _queue_encode_plan(codec, sinfo, arr, n_stripes, queue)
+        if planned is not None:
+            fut, reassemble = planned
+            return reassemble(fut.result())
+    if n_stripes <= 1 or arr is None:
         # one stripe IS one dispatch: the codec encodes the whole buffer
         enc = codec.encode(set(range(n)), padded)
         return [np.asarray(enc[i]) for i in range(n)]
-    # stripe-major: view as [n_stripes, stripe_width], carve each stripe's
-    # k chunks, batch ALL stripes through one dispatch per matrix
-    arr = np.frombuffer(padded, dtype=np.uint8).reshape(
-        n_stripes, k, sinfo.chunk_size)
-    if queue is not None:
-        # the interface's bit seam drives ANY byte-layout codec through
-        # the one matmul kernel; packet-layout codecs (cauchy/liberation
-        # family) take the encode_chunks/per-stripe paths below
-        mbits = codec.bit_generator()
-        if (mbits is None or getattr(codec, "bit_layout", "byte") != "byte"
-                or codec.get_chunk_mapping()):
-            queue = None
-    if queue is not None:
-        w = getattr(codec, "w", 8)
-        mbits = np.asarray(mbits).astype(np.int8)
-        m = n - k
-        # columns = stripes concatenated; one submit -> one device call
-        flat = np.ascontiguousarray(
-            arr.transpose(1, 0, 2).reshape(k, n_stripes * sinfo.chunk_size))
-        parity = queue.submit(mbits, flat, w, m).result()
-        parity = np.asarray(parity).reshape(m, n_stripes, sinfo.chunk_size)
-        out: List[np.ndarray] = []
-        for i in range(k):
-            out.append(arr[:, i, :].reshape(-1))
-        for j in range(m):
-            out.append(parity[j].reshape(-1))
-        return out
     if concat_safe(codec):
         # ONE encode_chunks call over all stripes: per-shard rows are the
         # stored blob layout, so no post-hoc concatenation either
@@ -217,8 +244,64 @@ def batched_encode(codec, sinfo: StripeInfo, data: bytes,
     return [np.concatenate(chunks) for chunks in shards]
 
 
+async def batched_encode_async(codec, sinfo: StripeInfo, data: bytes,
+                               queue=None) -> List[np.ndarray]:
+    """Event-loop-friendly batched_encode: the queue future is AWAITED,
+    so concurrent ops keep submitting while this one waits — that
+    concurrency is what the queue coalesces into one device dispatch."""
+    if queue is not None:
+        import asyncio
+
+        k = codec.get_data_chunk_count()
+        padded = sinfo.pad_to_stripe(data)
+        if len(padded):
+            n_stripes = max(1, len(padded) // sinfo.stripe_width)
+            arr = np.frombuffer(padded, dtype=np.uint8).reshape(
+                n_stripes, k, sinfo.chunk_size)
+            planned = _queue_encode_plan(codec, sinfo, arr, n_stripes, queue)
+            if planned is not None:
+                fut, reassemble = planned
+                return reassemble(await asyncio.wrap_future(fut))
+    return batched_encode(codec, sinfo, data, queue=None)
+
+
+def _queue_decode_plan(codec, sinfo: StripeInfo,
+                       arrays: Dict[int, np.ndarray], queue):
+    """Queue submission for a reconstructing decode: CPU picks/inverts
+    the decode matrix (LRU-cached per erasure signature, the ISA table
+    cache design), the device applies it — so decode and recovery ride
+    the same batched kernel as encode.  Returns (future, finish) with
+    finish(rows) -> logical data rows [k, n_stripes*chunk], or None when
+    the queue path does not apply."""
+    if (getattr(codec, "bit_layout", "byte") != "byte"
+            or codec.get_chunk_mapping() or not concat_safe(codec)
+            or not hasattr(codec, "_decode_matrix")):
+        return None
+    blob_len = len(next(iter(arrays.values())))
+    if blob_len == 0 or blob_len % sinfo.chunk_size:
+        return None  # degenerate/ragged blobs: codec paths handle them
+    k = codec.get_data_chunk_count()
+    if all(i in arrays for i in range(k)):
+        return None  # nothing erased that matters: pure de-interleave
+    try:
+        plan = codec.minimum_to_decode(set(range(k)), set(arrays))
+    except Exception:
+        return None
+    chosen = tuple(sorted(plan))[:k]
+    if any(c not in arrays for c in chosen):
+        return None
+    from ceph_tpu.ec.matrices import matrix_to_bitmatrix
+
+    inv = codec._decode_matrix(chosen)
+    inv_bm = matrix_to_bitmatrix(inv, codec.w).astype(np.int8)
+    src = np.ascontiguousarray(np.stack([arrays[c] for c in chosen]))
+    fut = queue.submit(inv_bm, src, codec.w, k)
+    return fut, (lambda rows: np.asarray(rows))
+
+
 def decode_object(codec, sinfo: StripeInfo,
-                  blobs: Dict[int, np.ndarray], object_size: int) -> bytes:
+                  blobs: Dict[int, np.ndarray], object_size: int,
+                  queue=None) -> bytes:
     """Reconstruct a striped object from per-shard blobs (each the
     concatenation of that shard's per-stripe chunks) and de-interleave
     back to logical byte order, trimmed to `object_size`.
@@ -232,6 +315,13 @@ def decode_object(codec, sinfo: StripeInfo,
     arrays = {s: np.asarray(b, dtype=np.uint8) for s, b in blobs.items()}
     blob_len = len(next(iter(arrays.values())))
     n_stripes = max(1, blob_len // cs)
+    if queue is not None:
+        planned = _queue_decode_plan(codec, sinfo, arrays, queue)
+        if planned is not None:
+            fut, finish = planned
+            rows = finish(fut.result())
+            rows = rows.reshape(k, n_stripes, cs).transpose(1, 0, 2)
+            return rows.reshape(-1)[:object_size].tobytes()
     if n_stripes <= 1 or not concat_safe(codec):
         if n_stripes <= 1:
             return bytes(codec.decode_concat(arrays)[:object_size])
@@ -246,3 +336,24 @@ def decode_object(codec, sinfo: StripeInfo,
     rows = np.frombuffer(codec.decode_concat(arrays), dtype=np.uint8)
     rows = rows.reshape(k, n_stripes, cs).transpose(1, 0, 2)
     return rows.reshape(-1)[:object_size].tobytes()
+
+
+async def decode_object_async(codec, sinfo: StripeInfo,
+                              blobs: Dict[int, np.ndarray],
+                              object_size: int, queue=None) -> bytes:
+    """Event-loop-friendly decode_object (see batched_encode_async)."""
+    if queue is not None:
+        import asyncio
+
+        k = codec.get_data_chunk_count()
+        cs = sinfo.chunk_size
+        arrays = {s: np.asarray(b, dtype=np.uint8) for s, b in blobs.items()}
+        blob_len = len(next(iter(arrays.values())))
+        n_stripes = max(1, blob_len // cs)
+        planned = _queue_decode_plan(codec, sinfo, arrays, queue)
+        if planned is not None:
+            fut, finish = planned
+            rows = finish(await asyncio.wrap_future(fut))
+            rows = rows.reshape(k, n_stripes, cs).transpose(1, 0, 2)
+            return rows.reshape(-1)[:object_size].tobytes()
+    return decode_object(codec, sinfo, blobs, object_size, queue=None)

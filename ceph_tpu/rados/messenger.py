@@ -506,6 +506,16 @@ class Messenger:
 
     # -- lifecycle -----------------------------------------------------------
 
+    async def disconnect(self, addr) -> None:
+        """Drop the live outbound connection to ``addr`` (if any): the
+        next send re-dials and re-runs the handshake — used when the
+        credentials the old handshake was built on changed (e.g. a ticket
+        was dropped to force bootstrap-secret auth)."""
+        key = tuple(addr)
+        conn = self._conns.pop(key, None)
+        if conn is not None:
+            await conn.close()
+
     async def bind(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
         self.server = await asyncio.start_server(self._accept, host, port)
         self.addr = self.server.sockets[0].getsockname()[:2]

@@ -36,6 +36,7 @@ import asyncio
 import errno
 import pickle
 import random
+import threading
 import time
 import uuid
 import zlib
@@ -48,7 +49,9 @@ from ceph_tpu.common.perf_counters import PerfCountersBuilder
 from ceph_tpu.ec.interface import ErasureCodeError
 from ceph_tpu.ec.registry import registry
 from ceph_tpu.rados.crush import CRUSH_ITEM_NONE
-from ceph_tpu.rados.ecutil import HashInfo, StripeInfo, batched_encode, decode_object
+from ceph_tpu.rados.ecutil import (HashInfo, StripeInfo,
+                                   batched_encode_async,
+                                   decode_object_async)
 from ceph_tpu.rados.messenger import TRANSPORT_ERRORS, Messenger
 from ceph_tpu.rados.monclient import MonTargets
 from ceph_tpu.rados.peering import (
@@ -123,6 +126,37 @@ PGMETA_PREFIX = "__pgmeta_"  # per-PG metadata object carrying the PG log
 # version of the object
 PREV_SLOT = 1 << 20
 
+# ONE stripe-batching queue per process, shared by every OSD instance in
+# it: the device is a process-level resource, and cross-daemon coalescing
+# (a vstart cluster runs many OSDs in one process) only helps — more
+# concurrent stripes per dispatch.  Lazy: processes that never touch an
+# EC pool never start the worker thread.
+_BATCH_QUEUE = None
+_BATCH_QUEUE_LOCK = threading.Lock()
+
+
+def shared_batching_queue():
+    """The process queue, or None when batching through the device would
+    LOSE: on a CPU-only backend the codecs' numpy table paths beat a
+    JAX round-trip (and its per-shape compiles), so the queue engages
+    only when an accelerator is actually the default backend.
+    CEPH_TPU_FORCE_BATCH=1 overrides (tests exercising coalescing on the
+    CPU backend; perf experiments)."""
+    global _BATCH_QUEUE
+    import os as _os
+
+    if _os.environ.get("CEPH_TPU_FORCE_BATCH") != "1":
+        from ceph_tpu.utils.jaxdev import probe_backend
+
+        if probe_backend() == "cpu" or probe_backend() == "unavailable":
+            return None
+    with _BATCH_QUEUE_LOCK:
+        if _BATCH_QUEUE is None:
+            from ceph_tpu.parallel.service import BatchingQueue
+
+            _BATCH_QUEUE = BatchingQueue()
+        return _BATCH_QUEUE
+
 
 class OSD:
     def __init__(
@@ -173,6 +207,14 @@ class OSD:
             .add_u64_counter("op_dequeued", "ops drained")
             .add_time_avg("op_queue_lat", "op service time")
             .add_u64_counter("heartbeat_failures", "peer failures reported")
+            .add_u64_counter("op_unexpected_error",
+                             "ops failed by an unclassified exception")
+            .add_u64_counter("ec_batch_ops",
+                             "encode/decode ops submitted to the batching queue")
+            .add_u64("ec_batch_dispatches",
+                     "device dispatches issued by the shared queue (gauge)")
+            .add_u64("ec_batch_bytes",
+                     "bytes pushed through the shared queue (gauge)")
             .create_perf_counters()
         )
         self.op_queue = ShardedOpQueue(
@@ -224,6 +266,13 @@ class OSD:
         # (pool, pg) -> {(oid, version): first_seen_monotonic} for versions
         # newer than the newest complete one (unfound-revert grace clock)
         self._partial_newer: Dict[Tuple[int, int], Dict[Tuple[str, int], float]] = {}
+        # the process-wide stripe-batching queue (None = batching off):
+        # every EC encode/decode this daemon issues is submitted here so
+        # CONCURRENT ops coalesce into one device dispatch (SURVEY.md
+        # §7.5; the reference's per-stripe ECUtil::encode loop inverted
+        # at process scope)
+        self._ec_queue = (shared_batching_queue()
+                          if self.conf.get("osd_ec_batching", True) else None)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -353,6 +402,11 @@ class OSD:
             except TRANSPORT_ERRORS:
                 self.mons.rotate()  # that mon looks dead
             ticks += 1
+            if self._ec_queue is not None:
+                # mirror the shared queue's dispatch stats into this
+                # daemon's counters (perf dump / prometheus visibility)
+                self.perf.set("ec_batch_dispatches", self._ec_queue.dispatches)
+                self.perf.set("ec_batch_bytes", self._ec_queue.bytes_dispatched)
             if ticks % 3 == 0:
                 await self._report_to_mgr()
             if self.conf.get("auth_cephx", False):
@@ -1027,7 +1081,7 @@ class OSD:
                     MOSDOp(op="read", pool_id=pool.pool_id, oid=oid))
                 if not read.ok:
                     continue
-                encoded = self._encode_for(pool, read.data)
+                encoded = await self._encode_for(pool, read.data)
                 push = MPushShard(
                     pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard_of_peer,
                     chunk=bytes(encoded[shard_of_peer]), version=read.version,
@@ -1477,7 +1531,10 @@ class OSD:
                          reqid=op.reqid)
         version = pack_eversion(entry.version)
         entry.object_version = version
-        blobs = batched_encode(codec, sinfo, data)
+        blobs = await batched_encode_async(codec, sinfo, data,
+                                           queue=self._ec_queue)
+        if self._ec_queue is not None:
+            self.perf.inc("ec_batch_ops")
         span.event("encoded")
         hinfo_blob = self._hinfo_for(pool, blobs) if chunk_off < 0 else b""
         entry_blob = entry.encode()
@@ -1631,7 +1688,10 @@ class OSD:
                 piece = piece + b"\x00" * (clen - len(piece))
             self.perf.inc("rmw_read_bytes", len(piece))
             arrays[shard] = np.frombuffer(piece, dtype=np.uint8)
-        seg = decode_object(codec, sinfo, arrays, slen)
+        if self._ec_queue is not None:
+            self.perf.inc("ec_batch_ops")
+        seg = await decode_object_async(codec, sinfo, arrays, slen,
+                                        queue=self._ec_queue)
         return sizes[next(iter(sizes))], seg, max(versions.values())
 
     async def _do_read(self, op: MOSDOp,
@@ -1737,7 +1797,10 @@ class OSD:
             chunks = complete
         object_size = sizes[max(sizes, key=lambda s: versions.get(s, 0))]
         arrays = {s: np.frombuffer(c, dtype=np.uint8) for s, c in chunks.items()}
-        data = decode_object(codec, self._sinfo(pool), arrays, object_size)
+        if self._ec_queue is not None:
+            self.perf.inc("ec_batch_ops")
+        data = await decode_object_async(codec, self._sinfo(pool), arrays,
+                                         object_size, queue=self._ec_queue)
         self._cache_put(op.pool_id, op.oid, newest, data)
         return MOSDOpReply(ok=True, data=data, version=newest)
 
@@ -1750,9 +1813,13 @@ class OSD:
         def __getitem__(self, shard: int) -> bytes:
             return self.data
 
-    def _encode_for(self, pool: PoolInfo, data: bytes):
+    async def _encode_for(self, pool: PoolInfo, data: bytes):
         if pool.pool_type == "ec":
-            return batched_encode(self._codec(pool), self._sinfo(pool), data)
+            if self._ec_queue is not None:
+                self.perf.inc("ec_batch_ops")
+            return await batched_encode_async(
+                self._codec(pool), self._sinfo(pool), data,
+                queue=self._ec_queue)
         return OSD._AllShards(data)
 
     def _cls_xattrs(self, pool_id: int, oid: str) -> Dict[str, bytes]:
@@ -2706,7 +2773,7 @@ class OSD:
                     MOSDOp(op="read", pool_id=pool.pool_id, oid=oid),
                     exclude_shards=frozenset(s for s, _ in bad))
                 if read.ok:
-                    encoded = self._encode_for(pool, read.data)
+                    encoded = await self._encode_for(pool, read.data)
                     for shard, osd in bad:
                         push = MPushShard(
                             pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard,
@@ -3285,7 +3352,7 @@ class OSD:
             # re-encode at the object's CURRENT version: deterministic encode
             # makes pushed shards byte-identical to the originals, and the
             # version stays consistent with surviving shards
-            encoded = self._encode_for(pool, reply.data)
+            encoded = await self._encode_for(pool, reply.data)
             version = reply.version
             xattrs = self._cls_xattrs(pool.pool_id, oid)
             hinfo_blob = self._hinfo_for(pool, encoded)

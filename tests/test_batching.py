@@ -1,0 +1,81 @@
+"""The OSD data path drives the process-shared stripe-batching queue:
+N concurrent client writes to DIFFERENT objects must coalesce into far
+fewer device dispatches (SURVEY.md §7.5 — the aggregate-across-ops half
+of the north-star batching design; the per-object half is
+batched_encode's stripe batching, tests/test_ecutil.py)."""
+
+import asyncio
+import os
+
+import pytest
+
+from ceph_tpu.rados import osd as osdmod
+from ceph_tpu.rados.vstart import Cluster
+
+
+@pytest.fixture(autouse=True)
+def force_batching(monkeypatch):
+    # tests run on the CPU backend where the queue normally stays off
+    # (numpy table paths win there); force it so coalescing is exercised
+    monkeypatch.setenv("CEPH_TPU_FORCE_BATCH", "1")
+
+PROFILE = {"plugin": "jerasure", "technique": "reed_sol_van",
+           "k": "2", "m": "1"}
+
+
+def run(coro, timeout=120):
+    asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+class TestDaemonPathBatching:
+    def test_concurrent_puts_coalesce_into_few_dispatches(self):
+        async def go():
+            cluster = Cluster(n_osds=3, conf={"osd_auto_repair": False})
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("bq", profile=PROFILE)
+                q = osdmod.shared_batching_queue()
+                # settle: pool-create traffic must not pollute the count
+                await asyncio.sleep(0.1)
+                before_d, before_ops = q.dispatches, 0
+                osds = list(cluster.osds.values())
+                before_ops = sum(
+                    o.perf.get("ec_batch_ops") for o in osds)
+                n = 24
+                blobs = [os.urandom(8192) for _ in range(n)]
+                await asyncio.gather(
+                    *(c.put(pool, f"o{i}", blobs[i]) for i in range(n)))
+                ops = sum(o.perf.get("ec_batch_ops") for o in osds) - before_ops
+                dispatches = q.dispatches - before_d
+                assert ops >= n, (ops, n)
+                # the whole point: ops per device dispatch >> 1
+                assert dispatches < ops / 2, \
+                    f"{ops} encode ops took {dispatches} dispatches"
+                # correctness untouched by coalescing
+                for i in range(n):
+                    assert await c.get(pool, f"o{i}") == blobs[i]
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_batching_can_be_disabled(self):
+        async def go():
+            cluster = Cluster(n_osds=3, conf={"osd_auto_repair": False,
+                                              "osd_ec_batching": False})
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("nbq", profile=PROFILE)
+                assert all(o._ec_queue is None
+                           for o in cluster.osds.values())
+                blob = os.urandom(50_000)
+                await c.put(pool, "obj", blob)
+                assert await c.get(pool, "obj") == blob
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
