@@ -23,14 +23,22 @@ _lib: Optional[ctypes.CDLL] = None
 
 
 def build(force: bool = False) -> str:
-    """Compile the native library (idempotent)."""
+    """Compile the native library (idempotent; rebuilds when any source
+    is newer than the .so, so an old build can never miss symbols the
+    bridge expects)."""
+    srcs = [os.path.join(_NATIVE, f)
+            for f in ("gf256.cc", "rs.cc", "registry.cc", "capi.cc")]
     if os.path.exists(_LIB) and not force:
-        return _LIB
+        lib_mtime = os.path.getmtime(_LIB)
+        hdrs = [os.path.join(_NATIVE, f)
+                for f in ("gf256.h", "rs.h", "ec_api.h", "plugin_common.h")]
+        if all(os.path.getmtime(s) <= lib_mtime
+               for s in srcs + hdrs if os.path.exists(s)):
+            return _LIB
     os.makedirs(_BUILD, exist_ok=True)
-    srcs = [os.path.join(_NATIVE, f) for f in ("gf256.cc", "rs.cc", "registry.cc", "capi.cc")]
     cmd = [
         "g++", "-std=c++17", "-O3", "-march=native", "-fPIC", "-shared",
-        "-o", _LIB, *srcs, "-ldl",
+        "-o", _LIB, *srcs, "-ldl", "-pthread",
     ]
     subprocess.run(cmd, check=True, capture_output=True)
     return _LIB
@@ -39,24 +47,44 @@ def build(force: bool = False) -> str:
 def lib() -> ctypes.CDLL:
     global _lib
     if _lib is None:
-        _lib = ctypes.CDLL(build())
-        _lib.ceph_tpu_gf_mul.restype = ctypes.c_uint8
-        _lib.ceph_tpu_gf_mul.argtypes = [ctypes.c_uint8, ctypes.c_uint8]
-        _lib.ceph_tpu_rs_encode.restype = ctypes.c_int
-        _lib.ceph_tpu_rs_encode.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
-            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
-        ]
-        _lib.ceph_tpu_simd_kind.restype = ctypes.c_char_p
-        _lib.ceph_tpu_simd_kind.argtypes = []
-        _lib.ceph_tpu_rs_decode.restype = ctypes.c_int
-        _lib.ceph_tpu_rs_decode.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_int), ctypes.c_char_p,
-            ctypes.c_int, ctypes.POINTER(ctypes.c_int),
-            ctypes.c_char_p, ctypes.c_size_t,
-        ]
+        # configure on a LOCAL before publishing: a failure mid-setup
+        # (e.g. a stale .so missing a symbol) must not leave a
+        # half-configured CDLL behind for the next caller
+        _local = ctypes.CDLL(build())
+        try:
+            _configure(_local)
+        except AttributeError:
+            _local = ctypes.CDLL(build(force=True))
+            _configure(_local)
+        _lib = _local
     return _lib
+
+
+def _configure(_lib: ctypes.CDLL) -> None:
+    """Declare every exported symbol's signature; raises AttributeError
+    when the loaded .so predates a symbol (caller rebuilds)."""
+    _lib.ceph_tpu_gf_mul.restype = ctypes.c_uint8
+    _lib.ceph_tpu_gf_mul.argtypes = [ctypes.c_uint8, ctypes.c_uint8]
+    _lib.ceph_tpu_rs_encode.restype = ctypes.c_int
+    _lib.ceph_tpu_rs_encode.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    _lib.ceph_tpu_simd_kind.restype = ctypes.c_char_p
+    _lib.ceph_tpu_simd_kind.argtypes = []
+    _lib.ceph_tpu_rs_encode_mt.restype = ctypes.c_int
+    _lib.ceph_tpu_rs_encode_mt.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_int,
+    ]
+    _lib.ceph_tpu_rs_decode.restype = ctypes.c_int
+    _lib.ceph_tpu_rs_decode.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_char_p,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ctypes.c_char_p, ctypes.c_size_t,
+    ]
 
 
 def gf_mul(a: int, b: int) -> int:
@@ -83,6 +111,25 @@ def rs_encode(technique: str, data: np.ndarray, m: int) -> np.ndarray:
     if rc != 0:
         raise RuntimeError(f"native encode failed ({rc})")
     return parity
+
+
+def rs_encode_mt(technique: str, data: np.ndarray, m: int,
+                 nthreads: int = 0) -> tuple:
+    """Socket-level encode: every core runs the region kernel on its own
+    column range.  Returns (parity, threads_used) — the denominator the
+    north star's 'single-socket' clause actually means (a socket is not
+    one core)."""
+    k, chunk = data.shape
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    parity = np.zeros((m, chunk), dtype=np.uint8)
+    rc = lib().ceph_tpu_rs_encode_mt(
+        technique.encode(), k, m,
+        data.ctypes.data_as(ctypes.c_char_p),
+        parity.ctypes.data_as(ctypes.c_char_p), chunk, nthreads,
+    )
+    if rc < 0:
+        raise RuntimeError(f"native mt encode failed ({rc})")
+    return parity, rc
 
 
 def rs_decode(

@@ -58,6 +58,7 @@ class BatchingQueue:
         self._worker.start()
         self.dispatches = 0  # perf counter: device calls issued
         self.bytes_dispatched = 0
+        self.submits = 0  # requests accepted (ops/dispatch = submits/dispatches)
 
     # -- client side ---------------------------------------------------------
 
@@ -77,6 +78,7 @@ class BatchingQueue:
             if group is None:
                 group = self._groups[key] = _Group(mbits=mbits, w=w, out_rows=out_rows)
             group.requests.append((regions, fut))
+            self.submits += 1
             nbytes = regions.nbytes
             group.pending_bytes += nbytes
             self._pending += nbytes

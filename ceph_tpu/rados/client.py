@@ -57,11 +57,14 @@ _DEFINITIVE_CODES = frozenset((
 # -ESTALE (not primary): the placement this op was computed on is WRONG —
 # re-target only after fencing past our own epoch (a newer map exists or
 # is imminent; recomputing on the stale one re-picks the same primary).
-# -EAGAIN (degraded / below min_size / transient ack shortfall / shards
-# unavailable): the primary is RIGHT but momentarily unable — retry
-# promptly on a freshly FETCHED map without awaiting a newer epoch, since
-# none may be coming (e.g. one dropped sub-write ack on a healthy
-# cluster must not pay a multi-second epoch poll).
+# -EAGAIN (degraded below min_size / shards unavailable): the cure is a
+# MAP CHANGE (failure detection marking the dead member down, recovery
+# re-seating shards) — fence past our epoch and wait for it, or the
+# retries burn out inside the detection grace window.
+# -EBUSY (sub-write ack shortfall): the write partially landed and a
+# plain resend usually completes it — retry promptly WITHOUT an epoch
+# wait (one dropped ack on a healthy cluster must not pay a multi-second
+# epoch poll).
 
 
 class RadosClient:
@@ -265,8 +268,11 @@ class RadosClient:
         # log's dup detection can recognize them (reference osd_reqid_t)
         op.reqid = uuid.uuid4().hex
         fence = 0  # minimum epoch the next target may be computed on
+        refresh_next = False  # one refresh owed (transport blip)
         for attempt in range(retries):
-            if fence > self.osdmap.epoch or (attempt and fence == 0):
+            if fence > self.osdmap.epoch or (attempt and fence == 0) \
+                    or refresh_next:
+                refresh_next = False
                 try:
                     await self.refresh_map(min_epoch=fence)
                 except (ConnectionError, OSError, asyncio.TimeoutError):
@@ -312,24 +318,15 @@ class RadosClient:
                 # replying OSD's (it refused exactly because placement
                 # moved — recomputing on our stale map re-picks it)
                 fence = max(fence, getattr(reply, "map_epoch", 0))
-                if code == -errno.ESTALE:
-                    # placement moved: fence PAST our own epoch (the map
-                    # that picked this primary is wrong), growing window
-                    # while recovery moves seats
+                if code in (-errno.ESTALE, -errno.EAGAIN):
+                    # placement moved / PG degraded: both are cured by a
+                    # newer map — fence PAST our own epoch, growing window
+                    # while detection + recovery move seats
                     fence = max(fence, self.osdmap.epoch + 1)
                     if attempt:
                         await asyncio.sleep(min(0.25 * attempt, 1.0))
                     continue
-                if code == -errno.EAGAIN:
-                    # busy, right primary: one cheap map fetch (no newer-
-                    # epoch wait) so real map changes are picked up, then
-                    # a prompt retry
-                    try:
-                        await self.refresh_map(min_epoch=fence)
-                    except (ConnectionError, OSError, asyncio.TimeoutError):
-                        pass
-                    await asyncio.sleep(min(0.2 * (attempt + 1), 1.0))
-                    continue
+                # -EBUSY and anything unclassified: prompt plain retry
                 await asyncio.sleep(0.2 * (attempt + 1))
             except PermissionError:
                 # expired/rotated-away ticket: fetch a fresh one and retry
@@ -341,9 +338,13 @@ class RadosClient:
             except (ConnectionError, OSError, asyncio.TimeoutError) as e:
                 last_error = f"{type(e).__name__}: {e}"
                 last_code = 0  # transport failure: no typed OSD answer
-                # the target may have died: re-target on a fresh map; if
-                # the target is UNCHANGED the resend is dedupe-safe
-                fence = max(fence, self.osdmap.epoch + 1)
+                # the target may have died — but a transport blip has NO
+                # map change coming, so the next attempt refreshes to the
+                # CURRENT map (one RPC at loop top), not a future epoch
+                # (a 2s poll per blip).  If the target is unchanged the
+                # resend is dedupe-safe; if the OSD really died, failure
+                # detection bumps the epoch and re-targets us.
+                refresh_next = True
                 await asyncio.sleep(0.2 * (attempt + 1))
             finally:
                 self._replies.pop(op.reqid, None)

@@ -266,37 +266,45 @@ async def batched_encode_async(codec, sinfo: StripeInfo, data: bytes,
 
 
 def _queue_decode_plan(codec, sinfo: StripeInfo,
-                       arrays: Dict[int, np.ndarray], queue):
+                       arrays: Dict[int, np.ndarray], object_size: int,
+                       queue):
     """Queue submission for a reconstructing decode: CPU picks/inverts
-    the decode matrix (LRU-cached per erasure signature, the ISA table
-    cache design), the device applies it — so decode and recovery ride
-    the same batched kernel as encode.  Returns (future, finish) with
-    finish(rows) -> logical data rows [k, n_stripes*chunk], or None when
-    the queue path does not apply."""
+    the decode matrix via the codec's OWN selection rule (LRU-cached per
+    erasure signature, the ISA table cache design), the device applies it
+    — so decode and recovery ride the same batched kernel as encode.
+    Returns (future, finish) with finish(rows) -> the reconstructed
+    logical bytes trimmed to object_size, or None when the queue path
+    does not apply."""
     if (getattr(codec, "bit_layout", "byte") != "byte"
             or codec.get_chunk_mapping() or not concat_safe(codec)
-            or not hasattr(codec, "_decode_matrix")):
+            or not hasattr(codec, "decode_selection")):
         return None
     blob_len = len(next(iter(arrays.values())))
     if blob_len == 0 or blob_len % sinfo.chunk_size:
         return None  # degenerate/ragged blobs: codec paths handle them
     k = codec.get_data_chunk_count()
+    cs = sinfo.chunk_size
+    n_stripes = blob_len // cs
     if all(i in arrays for i in range(k)):
         return None  # nothing erased that matters: pure de-interleave
     try:
-        plan = codec.minimum_to_decode(set(range(k)), set(arrays))
+        chosen, inv = codec.decode_selection(set(range(k)), set(arrays))
     except Exception:
         return None
-    chosen = tuple(sorted(plan))[:k]
     if any(c not in arrays for c in chosen):
         return None
     from ceph_tpu.ec.matrices import matrix_to_bitmatrix
 
-    inv = codec._decode_matrix(chosen)
     inv_bm = matrix_to_bitmatrix(inv, codec.w).astype(np.int8)
     src = np.ascontiguousarray(np.stack([arrays[c] for c in chosen]))
     fut = queue.submit(inv_bm, src, codec.w, k)
-    return fut, (lambda rows: np.asarray(rows))
+
+    def finish(rows: np.ndarray) -> bytes:
+        # de-interleave [k, S, cs] -> stripe-major logical bytes
+        r = np.asarray(rows).reshape(k, n_stripes, cs).transpose(1, 0, 2)
+        return r.reshape(-1)[:object_size].tobytes()
+
+    return fut, finish
 
 
 def decode_object(codec, sinfo: StripeInfo,
@@ -316,12 +324,10 @@ def decode_object(codec, sinfo: StripeInfo,
     blob_len = len(next(iter(arrays.values())))
     n_stripes = max(1, blob_len // cs)
     if queue is not None:
-        planned = _queue_decode_plan(codec, sinfo, arrays, queue)
+        planned = _queue_decode_plan(codec, sinfo, arrays, object_size, queue)
         if planned is not None:
             fut, finish = planned
-            rows = finish(fut.result())
-            rows = rows.reshape(k, n_stripes, cs).transpose(1, 0, 2)
-            return rows.reshape(-1)[:object_size].tobytes()
+            return finish(fut.result())
     if n_stripes <= 1 or not concat_safe(codec):
         if n_stripes <= 1:
             return bytes(codec.decode_concat(arrays)[:object_size])
@@ -345,15 +351,9 @@ async def decode_object_async(codec, sinfo: StripeInfo,
     if queue is not None:
         import asyncio
 
-        k = codec.get_data_chunk_count()
-        cs = sinfo.chunk_size
         arrays = {s: np.asarray(b, dtype=np.uint8) for s, b in blobs.items()}
-        blob_len = len(next(iter(arrays.values())))
-        n_stripes = max(1, blob_len // cs)
-        planned = _queue_decode_plan(codec, sinfo, arrays, queue)
+        planned = _queue_decode_plan(codec, sinfo, arrays, object_size, queue)
         if planned is not None:
             fut, finish = planned
-            rows = finish(await asyncio.wrap_future(fut))
-            rows = rows.reshape(k, n_stripes, cs).transpose(1, 0, 2)
-            return rows.reshape(-1)[:object_size].tobytes()
+            return finish(await asyncio.wrap_future(fut))
     return decode_object(codec, sinfo, blobs, object_size, queue=None)

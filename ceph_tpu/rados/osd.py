@@ -146,9 +146,16 @@ def shared_batching_queue():
     import os as _os
 
     if _os.environ.get("CEPH_TPU_FORCE_BATCH") != "1":
+        # an EXPLICIT JAX_PLATFORMS=cpu is an operator decision (tests,
+        # CPU-only deployments) and wins outright — on some hosts a
+        # sitecustomize-registered accelerator plugin overrides the
+        # platform selection, so the probe would still report the
+        # accelerator and silently route every EC op through it
+        if _os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            return None
         from ceph_tpu.utils.jaxdev import probe_backend
 
-        if probe_backend() == "cpu" or probe_backend() == "unavailable":
+        if probe_backend() in ("cpu", "unavailable"):
             return None
     with _BATCH_QUEUE_LOCK:
         if _BATCH_QUEUE is None:
@@ -209,8 +216,8 @@ class OSD:
             .add_u64_counter("heartbeat_failures", "peer failures reported")
             .add_u64_counter("op_unexpected_error",
                              "ops failed by an unclassified exception")
-            .add_u64_counter("ec_batch_ops",
-                             "encode/decode ops submitted to the batching queue")
+            .add_u64("ec_batch_ops",
+                     "requests submitted to the shared queue (gauge)")
             .add_u64("ec_batch_dispatches",
                      "device dispatches issued by the shared queue (gauge)")
             .add_u64("ec_batch_bytes",
@@ -403,8 +410,10 @@ class OSD:
                 self.mons.rotate()  # that mon looks dead
             ticks += 1
             if self._ec_queue is not None:
-                # mirror the shared queue's dispatch stats into this
-                # daemon's counters (perf dump / prometheus visibility)
+                # mirror the shared queue's stats into this daemon's
+                # counters (perf dump / prometheus visibility); submits
+                # vs dispatches is the coalescing ratio
+                self.perf.set("ec_batch_ops", self._ec_queue.submits)
                 self.perf.set("ec_batch_dispatches", self._ec_queue.dispatches)
                 self.perf.set("ec_batch_bytes", self._ec_queue.bytes_dispatched)
             if ticks % 3 == 0:
@@ -1533,8 +1542,6 @@ class OSD:
         entry.object_version = version
         blobs = await batched_encode_async(codec, sinfo, data,
                                            queue=self._ec_queue)
-        if self._ec_queue is not None:
-            self.perf.inc("ec_batch_ops")
         span.event("encoded")
         hinfo_blob = self._hinfo_for(pool, blobs) if chunk_off < 0 else b""
         entry_blob = entry.encode()
@@ -1583,7 +1590,7 @@ class OSD:
             self._mark_failed_write(op.reqid)
             self._cache_drop(op.pool_id, op.oid)
             return MOSDOpReply(
-                ok=False, code=-errno.EAGAIN,
+                ok=False, code=-errno.EBUSY,
                 error=f"write acked by {acks} < min_size {pool.min_size}"
             )
         if acks < len(live):
@@ -1688,8 +1695,6 @@ class OSD:
                 piece = piece + b"\x00" * (clen - len(piece))
             self.perf.inc("rmw_read_bytes", len(piece))
             arrays[shard] = np.frombuffer(piece, dtype=np.uint8)
-        if self._ec_queue is not None:
-            self.perf.inc("ec_batch_ops")
         seg = await decode_object_async(codec, sinfo, arrays, slen,
                                         queue=self._ec_queue)
         return sizes[next(iter(sizes))], seg, max(versions.values())
@@ -1797,8 +1802,6 @@ class OSD:
             chunks = complete
         object_size = sizes[max(sizes, key=lambda s: versions.get(s, 0))]
         arrays = {s: np.frombuffer(c, dtype=np.uint8) for s, c in chunks.items()}
-        if self._ec_queue is not None:
-            self.perf.inc("ec_batch_ops")
         data = await decode_object_async(codec, self._sinfo(pool), arrays,
                                          object_size, queue=self._ec_queue)
         self._cache_put(op.pool_id, op.oid, newest, data)
@@ -1815,8 +1818,6 @@ class OSD:
 
     async def _encode_for(self, pool: PoolInfo, data: bytes):
         if pool.pool_type == "ec":
-            if self._ec_queue is not None:
-                self.perf.inc("ec_batch_ops")
             return await batched_encode_async(
                 self._codec(pool), self._sinfo(pool), data,
                 queue=self._ec_queue)
@@ -1894,7 +1895,7 @@ class OSD:
         if acks < pool.min_size:
             self._mark_failed_write(op.reqid)
             return MOSDOpReply(
-                ok=False, code=-errno.EAGAIN,
+                ok=False, code=-errno.EBUSY,
                 error=f"write acked by {acks} < min_size {pool.min_size}")
         if acks < len([a for a in acting if a != CRUSH_ITEM_NONE]):
             self._kick_recovery(pool, pg)  # degraded write: recover now

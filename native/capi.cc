@@ -2,7 +2,9 @@
 // byte-exactness cross-checks between the native core and the numpy
 // oracle, and a fast CPU fallback path for the tpu plugin.
 
+#include <algorithm>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 #include "ec_api.h"
@@ -10,6 +12,22 @@
 #include "rs.h"
 
 using namespace ceph_tpu;
+
+namespace {
+
+// technique name -> coding matrix; false when the name is unknown.
+// Shared by the ST and MT encodes so they can never diverge.
+bool make_coding_matrix(const std::string& t, int k, int m, Matrix* out) {
+  if (t == "reed_sol_van") *out = vandermonde_coding_matrix(k, m);
+  else if (t == "reed_sol_r6_op") *out = r6_coding_matrix(k);
+  else if (t == "cauchy_orig") *out = cauchy_orig_matrix(k, m);
+  else if (t == "isa_reed_sol_van") *out = isa_vandermonde_matrix(k, m);
+  else if (t == "isa_cauchy") *out = isa_cauchy_matrix(k, m);
+  else return false;
+  return true;
+}
+
+}  // namespace
 
 extern "C" {
 
@@ -26,13 +44,7 @@ int ceph_tpu_rs_encode(const char* technique, int k, int m,
                        const uint8_t* data, uint8_t* parity, size_t chunk) {
   try {
     Matrix coding;
-    std::string t = technique;
-    if (t == "reed_sol_van") coding = vandermonde_coding_matrix(k, m);
-    else if (t == "reed_sol_r6_op") coding = r6_coding_matrix(k);
-    else if (t == "cauchy_orig") coding = cauchy_orig_matrix(k, m);
-    else if (t == "isa_reed_sol_van") coding = isa_vandermonde_matrix(k, m);
-    else if (t == "isa_cauchy") coding = isa_cauchy_matrix(k, m);
-    else return -22;
+    if (!make_coding_matrix(technique, k, m, &coding)) return -22;
     RSCodec rs(k, m, std::move(coding));
     std::vector<const uint8_t*> dptr(k);
     std::vector<uint8_t*> pptr(m);
@@ -40,6 +52,60 @@ int ceph_tpu_rs_encode(const char* technique, int k, int m,
     for (int i = 0; i < m; ++i) pptr[i] = parity + static_cast<size_t>(i) * chunk;
     rs.encode(dptr.data(), pptr.data(), chunk);
     return 0;
+  } catch (...) {
+    return -22;
+  }
+}
+
+// Multi-threaded contiguous-buffer encode: the SOCKET-level baseline.
+// Each thread encodes a contiguous column range of every chunk (the GF
+// region kernels are column-independent), the way a saturated multi-core
+// isa-l deployment would run — one core per range, no cross-thread
+// synchronization inside the kernel.  nthreads <= 0 picks
+// hardware_concurrency.  Returns the thread count used, or -errno.
+int ceph_tpu_rs_encode_mt(const char* technique, int k, int m,
+                          const uint8_t* data, uint8_t* parity, size_t chunk,
+                          int nthreads) {
+  try {
+    Matrix coding;
+    if (!make_coding_matrix(technique, k, m, &coding)) return -22;
+    RSCodec rs(k, m, std::move(coding));
+    if (nthreads <= 0) {
+      nthreads = static_cast<int>(std::thread::hardware_concurrency());
+      if (nthreads <= 0) nthreads = 1;
+    }
+    // ceil-divide FIRST so nthreads ranges always cover the whole chunk
+    // (floor + align could leave an unencoded tail), then 64B-align so
+    // every thread's kernel runs on full vectors
+    size_t per = (((chunk + nthreads - 1) / nthreads + 63) / 64) * 64;
+    if (per == 0) per = chunk;
+    std::vector<std::thread> threads;
+    int used = 0;
+    try {
+      for (int ti = 0; ti < nthreads; ++ti) {
+        size_t lo = static_cast<size_t>(ti) * per;
+        if (lo >= chunk) break;
+        size_t len = std::min(per, chunk - lo);
+        threads.emplace_back([&, lo, len] {
+          std::vector<const uint8_t*> dptr(k);
+          std::vector<uint8_t*> pptr(m);
+          for (int i = 0; i < k; ++i)
+            dptr[i] = data + static_cast<size_t>(i) * chunk + lo;
+          for (int i = 0; i < m; ++i)
+            pptr[i] = parity + static_cast<size_t>(i) * chunk + lo;
+          rs.encode(dptr.data(), pptr.data(), len);
+        });
+        ++used;
+      }
+    } catch (...) {
+      // spawn failure (thread limits): join what started — destroying a
+      // joinable std::thread would std::terminate the whole process —
+      // then report the failure
+      for (auto& th : threads) th.join();
+      return -11;
+    }
+    for (auto& th : threads) th.join();
+    return used;
   } catch (...) {
     return -22;
   }
@@ -53,13 +119,7 @@ int ceph_tpu_rs_decode(const char* technique, int k, int m,
                        size_t chunk) {
   try {
     Matrix coding;
-    std::string t = technique;
-    if (t == "reed_sol_van") coding = vandermonde_coding_matrix(k, m);
-    else if (t == "reed_sol_r6_op") coding = r6_coding_matrix(k);
-    else if (t == "cauchy_orig") coding = cauchy_orig_matrix(k, m);
-    else if (t == "isa_reed_sol_van") coding = isa_vandermonde_matrix(k, m);
-    else if (t == "isa_cauchy") coding = isa_cauchy_matrix(k, m);
-    else return -22;
+    if (!make_coding_matrix(technique, k, m, &coding)) return -22;
     RSCodec rs(k, m, std::move(coding));
     std::vector<int> src(sources, sources + k);
     std::vector<int> tgt(targets, targets + ntargets);

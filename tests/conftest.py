@@ -8,6 +8,12 @@ import os
 
 # Hard-set (not setdefault): the container env pins JAX_PLATFORMS=axon for
 # the real-TPU bench path; tests must never depend on the TPU tunnel.
+# NOTE this does not fully banish the accelerator on hosts whose
+# sitecustomize force-registers its PJRT plugin (the plugin can override
+# the platform selection); consumers that must stay off the device under
+# an explicit JAX_PLATFORMS=cpu gate on the env var itself (see
+# osd.shared_batching_queue) — scrubbing the plugin's trigger vars here
+# would be worse, breaking its already-registered late initialization.
 os.environ["JAX_PLATFORMS"] = "cpu"
 # Under full-suite load the default 30s backend probe can time out and pin
 # "unavailable" for the whole process, silently flipping plugin=tpu tests to

@@ -38,15 +38,12 @@ class TestDaemonPathBatching:
                 q = osdmod.shared_batching_queue()
                 # settle: pool-create traffic must not pollute the count
                 await asyncio.sleep(0.1)
-                before_d, before_ops = q.dispatches, 0
-                osds = list(cluster.osds.values())
-                before_ops = sum(
-                    o.perf.get("ec_batch_ops") for o in osds)
+                before_d, before_ops = q.dispatches, q.submits
                 n = 24
                 blobs = [os.urandom(8192) for _ in range(n)]
                 await asyncio.gather(
                     *(c.put(pool, f"o{i}", blobs[i]) for i in range(n)))
-                ops = sum(o.perf.get("ec_batch_ops") for o in osds) - before_ops
+                ops = q.submits - before_ops
                 dispatches = q.dispatches - before_d
                 assert ops >= n, (ops, n)
                 # the whole point: ops per device dispatch >> 1

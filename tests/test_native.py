@@ -180,3 +180,26 @@ def test_simd_region_kernel_byte_identical(native_build):
     assert lines[0].strip() == b"scalar"
     d = np.arange(8 * 1000, dtype=np.uint8).reshape(8, 1000)
     assert lines[1] == bridge.rs_encode("reed_sol_van", d, 3).tobytes()
+
+
+def test_mt_encode_byte_identical_and_reports_threads():
+    """The socket-baseline encode (per-thread column ranges) must produce
+    byte-identical parity to the single-threaded kernel."""
+    bridge = pytest.importorskip("ceph_tpu.native.bridge")
+    try:
+        bridge.build()
+    except Exception as e:
+        pytest.skip(f"native build unavailable: {e}")
+    rng = np.random.default_rng(3)
+    # chunk sizes chosen to hit range-split edge cases: non-64-multiples,
+    # chunks smaller than 64B*threads, and thread counts that don't
+    # divide the chunk (a floor-divided range once left the tail
+    # unencoded — silent zero parity)
+    for chunk in (1 << 20, 4096, 4097, 64, 63, 130):
+        data = rng.integers(0, 256, (8, chunk), dtype=np.uint8)
+        p1 = bridge.rs_encode("reed_sol_van", data, 3)
+        for nthreads in (0, 1, 3, 4, 7):
+            p2, used = bridge.rs_encode_mt("reed_sol_van", data, 3,
+                                           nthreads=nthreads)
+            assert used >= 1
+            assert np.array_equal(p1, p2), f"chunk={chunk} nt={nthreads}"
