@@ -32,7 +32,10 @@ from ceph_tpu.rados.types import (
     MMarkDown,
     MOSDOp,
     MOSDOpReply,
+    MSnapOp,
+    MSnapOpReply,
     OSDMap,
+    SNAP_SEP,
 )
 
 
@@ -136,7 +139,7 @@ class RadosClient:
                     traceback.print_exc()  # a broken callback must be loud
             return
         if isinstance(msg, (MMapReply, MCreatePoolReply, MConfigReply,
-                            MAuthTicketReply)):
+                            MAuthTicketReply, MSnapOpReply)):
             # the mon echoes our per-RPC tid (like MOSDOp's reqid): a reply
             # landing after its RPC timed out has a stale tid and is dropped
             # instead of fulfilling the next RPC's future
@@ -351,12 +354,57 @@ class RadosClient:
         raise RadosError(f"op {op.op} {op.oid} failed: {last_error}",
                          code=last_code)
 
+    @staticmethod
+    def _check_oid(oid: str) -> None:
+        if SNAP_SEP in oid:
+            raise RadosError("oid contains the reserved snap separator",
+                             code=-errno.EINVAL)
+
     async def put(self, pool_id: int, oid: str, data: bytes,
-                  offset: Optional[int] = None) -> None:
+                  offset: Optional[int] = None,
+                  snapc: Optional[Tuple[int, List[int]]] = None) -> None:
         """Full-object write, or a partial overwrite at `offset` (the
-        primary takes the read-modify-write path)."""
+        primary takes the read-modify-write path).  ``snapc`` is a
+        self-managed snap context (seq, snaps-descending): the primary
+        clones the head before the first write past a new snap
+        (reference SnapContext on every write)."""
+        self._check_oid(oid)
+        seq, snaps = snapc if snapc else (0, [])
         await self._op(MOSDOp(op="write", pool_id=pool_id, oid=oid, data=data,
-                              offset=-1 if offset is None else int(offset)))
+                              offset=-1 if offset is None else int(offset),
+                              snapc_seq=seq, snapc_snaps=list(snaps)))
+
+    # -- self-managed snapshots (reference IoCtxImpl selfmanaged_snap_*) ----
+
+    async def selfmanaged_snap_create(self, pool_id: int) -> int:
+        """Allocate a new cluster-unique snap id (the mon is the
+        allocator)."""
+        reply = await self._mon_rpc(MSnapOp(pool_id=pool_id, op="create"))
+        if not reply.ok:
+            raise RadosError(reply.error)
+        await self.refresh_map()
+        return reply.snap_id
+
+    async def selfmanaged_snap_remove(self, pool_id: int,
+                                      snap_id: int) -> None:
+        """Mark the snap removed in the pool and trim its clones
+        (reference snap trimmer).  Trim is best-effort immediate and
+        idempotent: an OSD that was down during the fan-out keeps its
+        clones until this call is re-run (the mon records the removal
+        first, so re-running re-trims everywhere)."""
+        reply = await self._mon_rpc(
+            MSnapOp(pool_id=pool_id, op="remove", snap_id=snap_id))
+        if not reply.ok:
+            raise RadosError(reply.error)
+        await self.refresh_map()
+        for osd in list(self.osdmap.osds.values()):
+            if not osd.up:
+                continue
+            try:
+                await self._op_direct(osd.osd_id, MOSDOp(
+                    op="snap-trim", pool_id=pool_id, snap_id=snap_id))
+            except RadosError:
+                continue
 
     async def deep_scrub(self, pool_id: int) -> Dict[str, int]:
         """Ask every up OSD to deep-scrub the PGs it leads; sums the
@@ -376,12 +424,22 @@ class RadosClient:
                 continue
         return total
 
-    async def get(self, pool_id: int, oid: str) -> bytes:
-        reply = await self._op(MOSDOp(op="read", pool_id=pool_id, oid=oid))
+    async def get(self, pool_id: int, oid: str, snap: int = 0) -> bytes:
+        """Read the head, or the object's state AT a snap id (resolved
+        through the primary's SnapSet clone list)."""
+        self._check_oid(oid)
+        reply = await self._op(MOSDOp(op="read", pool_id=pool_id, oid=oid,
+                                      snap_read=int(snap)))
         return reply.data
 
-    async def delete(self, pool_id: int, oid: str) -> None:
-        await self._op(MOSDOp(op="delete", pool_id=pool_id, oid=oid))
+    async def delete(self, pool_id: int, oid: str,
+                     snapc: Optional[Tuple[int, List[int]]] = None) -> None:
+        """Delete the head; under a snap context the primary clones
+        first and leaves a whiteout so snapshots keep resolving."""
+        self._check_oid(oid)
+        seq, snaps = snapc if snapc else (0, [])
+        await self._op(MOSDOp(op="delete", pool_id=pool_id, oid=oid,
+                              snapc_seq=seq, snapc_snaps=list(snaps)))
 
     async def watch(self, pool_id: int, oid: str, callback) -> None:
         """Register a notify callback on oid (librados watch2 role).  After

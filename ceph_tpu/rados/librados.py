@@ -40,24 +40,92 @@ class IoCtx:
         self._rados = rados
         self.pool_id = pool_id
         self.pool_name = pool_name
+        # self-managed snapshot state (librados set_snap_write_context /
+        # snap_set_read roles): writes carry the context; reads resolve
+        # at the read snap when set
+        self._snapc_seq = 0
+        self._snapc_snaps: List[int] = []
+        self._snap_read = 0
 
     @property
     def _c(self) -> RadosClient:
         return self._rados._client
 
+    # -- self-managed snapshots (reference rados_ioctx_selfmanaged_*) --------
+
+    async def selfmanaged_snap_create(self) -> int:
+        """Allocate a snap id and fold it into this ioctx's write
+        context."""
+        snap_id = await self._c.selfmanaged_snap_create(self.pool_id)
+        self.set_snap_write_context(
+            snap_id, [snap_id] + list(self._snapc_snaps))
+        return snap_id
+
+    async def selfmanaged_snap_remove(self, snap_id: int) -> None:
+        await self._c.selfmanaged_snap_remove(self.pool_id, snap_id)
+        self._snapc_snaps = [s for s in self._snapc_snaps if s != snap_id]
+
+    async def selfmanaged_snap_rollback(self, oid: str,
+                                        snap_id: int) -> None:
+        """Restore the head to its state at `snap_id` (reference
+        rollback: read-at-snap -> write head; an object absent at the
+        snap is removed)."""
+        try:
+            old = await self._c.get(self.pool_id, oid, snap=snap_id)
+        except RadosError as e:
+            import errno as _errno
+
+            if e.code != -_errno.ENOENT:
+                raise
+            await self.remove(oid)
+            return
+        await self.write_full(oid, old)
+
+    async def allocate_snap_id(self) -> int:
+        """Allocate a snap id WITHOUT touching this ioctx's write
+        context — services managing many volumes over one ioctx (RBD)
+        build per-volume contexts themselves."""
+        return await self._c.selfmanaged_snap_create(self.pool_id)
+
+    async def release_snap_id(self, snap_id: int) -> None:
+        await self._c.selfmanaged_snap_remove(self.pool_id, snap_id)
+
+    def set_snap_write_context(self, seq: int, snaps: List[int]) -> None:
+        """snaps must be DESCENDING (newest first), seq >= snaps[0]."""
+        self._snapc_seq = int(seq)
+        self._snapc_snaps = sorted((int(s) for s in snaps), reverse=True)
+
+    def snap_set_read(self, snap_id: int) -> None:
+        """0 = head; else reads resolve at that snap."""
+        self._snap_read = int(snap_id)
+
+    @property
+    def _snapc(self):
+        if self._snapc_seq:
+            return (self._snapc_seq, self._snapc_snaps)
+        return None
+
     # -- sync ops ------------------------------------------------------------
+    # per-call snapc/snap overrides let services (RBD) manage MANY
+    # logical volumes' contexts over one shared ioctx
 
-    async def write_full(self, oid: str, data: bytes) -> None:
-        await self._c.put(self.pool_id, oid, data)
+    async def write_full(self, oid: str, data: bytes, snapc=None) -> None:
+        await self._c.put(self.pool_id, oid, data,
+                          snapc=snapc if snapc is not None else self._snapc)
 
-    async def write(self, oid: str, data: bytes, offset: int = 0) -> None:
-        await self._c.put(self.pool_id, oid, data, offset=offset)
+    async def write(self, oid: str, data: bytes, offset: int = 0,
+                    snapc=None) -> None:
+        await self._c.put(self.pool_id, oid, data, offset=offset,
+                          snapc=snapc if snapc is not None else self._snapc)
 
-    async def read(self, oid: str) -> bytes:
-        return await self._c.get(self.pool_id, oid)
+    async def read(self, oid: str, snap: Optional[int] = None) -> bytes:
+        return await self._c.get(
+            self.pool_id, oid,
+            snap=snap if snap is not None else self._snap_read)
 
-    async def remove(self, oid: str) -> None:
-        await self._c.delete(self.pool_id, oid)
+    async def remove(self, oid: str, snapc=None) -> None:
+        await self._c.delete(self.pool_id, oid,
+                             snapc=snapc if snapc is not None else self._snapc)
 
     async def stat(self, oid: str) -> Dict[str, int]:
         """Size/version from shard metadata — no payload transfer."""

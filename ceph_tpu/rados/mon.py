@@ -59,6 +59,8 @@ from ceph_tpu.rados.types import (
     MOsdBoot,
     MPoolSet,
     MSetUpmap,
+    MSnapOp,
+    MSnapOpReply,
     MPing,
     OSDMap,
     OSDMapIncremental,
@@ -503,7 +505,7 @@ class Monitor:
     # -- dispatch ------------------------------------------------------------
 
     WRITE_TYPES = (MOsdBoot, MCreatePool, MMarkDown, MConfigSet, MOSDFailure,
-                   MOSDPGTemp, MSetUpmap, MPoolSet)
+                   MOSDPGTemp, MSetUpmap, MPoolSet, MSnapOp)
 
     @staticmethod
     def _conn_is_daemon(conn) -> bool:
@@ -767,6 +769,27 @@ class Monitor:
                 self.osdmap.epoch += 1
                 await self._commit_state()
             return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+        if isinstance(msg, MSnapOp):
+            pool = self.osdmap.pools.get(msg.pool_id)
+            if pool is None:
+                return MSnapOpReply(tid=msg.tid, ok=False,
+                                    error="no such pool")
+            if msg.op == "create":
+                pool.snap_seq += 1
+                self.osdmap.epoch += 1
+                await self._commit_state()
+                return MSnapOpReply(tid=msg.tid, snap_id=pool.snap_seq)
+            if msg.op == "remove":
+                if msg.snap_id <= 0 or msg.snap_id > pool.snap_seq:
+                    return MSnapOpReply(tid=msg.tid, ok=False,
+                                        error="bad snap id")
+                if msg.snap_id not in pool.removed_snaps:
+                    pool.removed_snaps.append(msg.snap_id)
+                    pool.removed_snaps.sort()
+                    self.osdmap.epoch += 1
+                    await self._commit_state()
+                return MSnapOpReply(tid=msg.tid, snap_id=msg.snap_id)
+            return MSnapOpReply(tid=msg.tid, ok=False, error="bad snap op")
         if isinstance(msg, MPoolSet):
             pool = self.osdmap.pools.get(msg.pool_id)
             if pool is None:

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import asyncio
 import errno
+import json
 import pickle
 import random
 import threading
@@ -115,6 +116,9 @@ from ceph_tpu.rados.types import (
     MWatchNotify,
     OSDMap,
     PoolInfo,
+    is_snap_clone,
+    snap_clone_oid,
+    snap_head,
 )
 
 PGMETA_PREFIX = "__pgmeta_"  # per-PG metadata object carrying the PG log
@@ -1389,12 +1393,13 @@ class OSD:
             if op.op == "write":
                 reply = await self._do_write(op)
             elif op.op == "read":
-                reply = await self._do_read(op)
+                reply = await self._snap_routed(op, self._do_read)
             elif op.op == "delete":
                 reply = await self._do_delete(op)
+            elif op.op == "snap-trim":
+                reply = await self._do_snap_trim(op)
             elif op.op == "list":
-                oids = sorted({oid for oid, _ in self._list_pool_objects(op.pool_id)})
-                reply = MOSDOpReply(ok=True, oids=oids)
+                reply = MOSDOpReply(ok=True, oids=self._list_heads(op.pool_id))
             elif op.op == "repair":
                 pool = self.osdmap.pools.get(op.pool_id)
                 if pool is not None:
@@ -1403,7 +1408,7 @@ class OSD:
             elif op.op == "call":
                 reply = await self._do_call(op)
             elif op.op == "stat":
-                reply = await self._do_stat(op)
+                reply = await self._snap_routed(op, self._do_stat)
             elif op.op == "watch":
                 reply = await self._do_watch(op)
             elif op.op == "unwatch":
@@ -1448,6 +1453,204 @@ class OSD:
     def _primary(self, pool: PoolInfo, pg: int, acting: List[int]):
         return self.osdmap.primary_of(acting, seed=(pool.pool_id << 20) | pg)
 
+    # -- snapshots (reference SnapMapper.h:43, PrimaryLogPG::make_writeable,
+    #    librados selfmanaged snap ops IoCtxImpl.cc) --------------------------
+
+    SNAPSET_XATTR = "snapset_key"
+
+    def _load_snapset(self, pool_id: int, oid: str) -> Dict:
+        """The object's SnapSet (per-object clone list, reference
+        SnapSet in osd_types.h): {"seq", "born", "whiteout",
+        "clones": [[clone_id, [snaps...]], ...]}."""
+        try:
+            raw = self.store.getattr((pool_id, oid, 0), self.SNAPSET_XATTR)
+        except (IOError, OSError):
+            raw = None
+        if not raw:
+            return {"seq": 0, "born": 0, "whiteout": False, "clones": []}
+        try:
+            return json.loads(raw)
+        except (ValueError, KeyError, TypeError):
+            return {"seq": 0, "born": 0, "whiteout": False, "clones": []}
+
+    async def _save_snapset(self, pool: PoolInfo, pg: int,
+                            acting: List[int], oid: str, ss: Dict) -> None:
+        """Persist the SnapSet on the head's canonical shard and replicate
+        to the acting members (same pattern as cls xattrs: a failover
+        primary must resolve snap reads without the old primary)."""
+        blob = json.dumps(ss).encode()
+        self.store.setattr((pool.pool_id, oid, 0), self.SNAPSET_XATTR, blob)
+        for osd in acting:
+            if osd in (CRUSH_ITEM_NONE, self.osd_id):
+                continue
+            try:
+                await self.messenger.send(
+                    self.osdmap.addr_of(osd),
+                    MSetXattrs(pool_id=pool.pool_id, oid=oid, shard=0,
+                               xattrs={self.SNAPSET_XATTR: blob}))
+            except TRANSPORT_ERRORS:
+                pass  # recovery pushes carry xattrs; scrub repairs drift
+
+    def _live_snaps(self, pool: PoolInfo, snaps: List[int]) -> List[int]:
+        removed = set(pool.removed_snaps)
+        return [s for s in snaps if s not in removed]
+
+    async def _make_writeable(self, op: MOSDOp, pool: PoolInfo, pg: int,
+                              acting: List[int]) -> None:
+        """COW before the first write past a new snap (the reference's
+        make_writeable): clone the current head into a clone object
+        (placed in the SAME PG — object_to_pg hashes the head name) and
+        record it in the SnapSet.  Clone writes ride the normal write
+        pipeline, so they are erasure-coded, logged, and recoverable like
+        any object."""
+        if is_snap_clone(op.oid) or op.snapc_seq <= 0:
+            return
+        snapc = self._live_snaps(pool, op.snapc_snaps)
+        ss = self._load_snapset(op.pool_id, op.oid)
+        newer = [s for s in snapc if s > ss["seq"]]
+        if newer:
+            head = await self._do_read(
+                MOSDOp(op="read", pool_id=op.pool_id, oid=op.oid))
+            if head.ok and not ss.get("whiteout"):
+                clone_id = max(newer)
+                await self._do_write(MOSDOp(
+                    op="write", pool_id=op.pool_id,
+                    oid=snap_clone_oid(op.oid, clone_id), data=head.data,
+                    reqid=uuid.uuid4().hex))
+                ss["clones"].append([clone_id, sorted(newer)])
+            elif not head.ok and ss["seq"] == 0 and not ss["clones"]:
+                # object is being CREATED under this context: snaps at or
+                # before snapc_seq predate it (existence-at-snap gate)
+                ss["born"] = op.snapc_seq
+            else:
+                # the object was ABSENT (whiteout, or vanished) while
+                # these snaps were taken: record that, or recreating the
+                # head would make reads at those snaps serve FUTURE data
+                absent = ss.setdefault("absent", [])
+                absent.extend(s for s in newer if s not in absent)
+        if op.snapc_seq > ss["seq"]:
+            ss["seq"] = op.snapc_seq
+            ss["whiteout"] = False
+            await self._save_snapset(pool, pg, acting, op.oid, ss)
+        elif ss.get("whiteout"):
+            ss["whiteout"] = False
+            await self._save_snapset(pool, pg, acting, op.oid, ss)
+
+    def _resolve_snap_read(self, pool: PoolInfo, oid: str,
+                           snap: int) -> Optional[str]:
+        """Which object serves a read at `snap`: the covering clone, the
+        (unchanged-since) head, or None for ENOENT (removed snap, or the
+        object did not exist at that snap)."""
+        if snap in pool.removed_snaps:
+            return None
+        ss = self._load_snapset(pool.pool_id, oid)
+        if 0 < snap <= ss.get("born", 0):
+            return None  # created after the snapshot
+        if snap in ss.get("absent", ()):
+            return None  # object was deleted while this snap was taken
+        removed = set(pool.removed_snaps)
+        for clone_id, snaps in sorted(ss["clones"]):
+            live = [s for s in snaps if s not in removed]
+            if live and clone_id >= snap:
+                # first clone at-or-past the snap holds the bytes as they
+                # were WHEN that snap was live (reference clone coverage)
+                return snap_clone_oid(oid, clone_id)
+        if ss.get("whiteout"):
+            return None  # deleted after the last clone: gone at this snap
+        return oid  # unchanged since the snap: the head serves
+
+    async def _snap_routed(self, op: MOSDOp, handler) -> MOSDOpReply:
+        """Route a read/stat through snap resolution when snap_read is
+        set; a whiteout head answers ENOENT even for head reads."""
+        pool = self.osdmap.pools.get(op.pool_id)
+        if pool is None:
+            return MOSDOpReply(ok=False, code=-errno.ENOENT,
+                               error="no such pool")
+        snap = getattr(op, "snap_read", 0)
+        if snap > 0 and not is_snap_clone(op.oid):
+            target = self._resolve_snap_read(pool, op.oid, snap)
+            if target is None:
+                return MOSDOpReply(ok=False, code=-errno.ENOENT,
+                                   error="object not found (at snap)")
+            if target != op.oid:
+                routed = MOSDOp(op=op.op, pool_id=op.pool_id, oid=target,
+                                reqid=op.reqid)
+                return await handler(routed)
+        elif not is_snap_clone(op.oid):
+            ss = self._load_snapset(op.pool_id, op.oid)
+            if ss.get("whiteout"):
+                return MOSDOpReply(ok=False, code=-errno.ENOENT,
+                                   error="object not found")
+        return await handler(op)
+
+    def _list_heads(self, pool_id: int) -> List[str]:
+        """User-visible listing: heads only — no clones, no whiteouts."""
+        out = []
+        for oid in sorted({oid for oid, _ in
+                           self._list_pool_objects(pool_id)}):
+            if is_snap_clone(oid):
+                continue
+            if self._load_snapset(pool_id, oid).get("whiteout"):
+                continue
+            out.append(oid)
+        return out
+
+    async def _do_snap_trim(self, op: MOSDOp) -> MOSDOpReply:
+        """Remove one snap pool-wide for the PGs this OSD leads
+        (reference snap trimmer + SnapMapper reverse index; here the
+        per-PG object walk is the scoped listing already used by
+        backfill).  Idempotent — safe to re-run."""
+        pool = self.osdmap.pools.get(op.pool_id)
+        if pool is None:
+            return MOSDOpReply(ok=False, code=-errno.ENOENT,
+                               error="no such pool")
+        snapid = op.snap_id
+        trimmed = 0
+        heads = {snap_head(oid)
+                 for oid, _ in self._list_pool_objects(op.pool_id)}
+        for oid in sorted(heads):
+            pg, acting = self._acting(pool, oid)
+            if self._primary(pool, pg, acting) != self.osd_id:
+                continue
+            ss = self._load_snapset(op.pool_id, oid)
+            if (not ss["clones"] and not ss.get("whiteout")
+                    and snapid not in ss.get("absent", ())):
+                continue
+            changed = False
+            if snapid in ss.get("absent", ()):
+                ss["absent"] = [s for s in ss["absent"] if s != snapid]
+                changed = True
+            kept = []
+            for clone_id, snaps in ss["clones"]:
+                live = [s for s in snaps if s != snapid]
+                if live != snaps:
+                    changed = True
+                if live:
+                    kept.append([clone_id, live])
+                else:
+                    # no snap references the clone: delete it
+                    await self._do_delete(MOSDOp(
+                        op="delete", pool_id=op.pool_id,
+                        oid=snap_clone_oid(oid, clone_id),
+                        reqid=uuid.uuid4().hex))
+                    trimmed += 1
+                    changed = True
+            ss["clones"] = kept
+            if ss.get("whiteout") and not kept:
+                # a deleted head whose last clone just went: fully gone.
+                # Persist the emptied clone list FIRST so the delete path
+                # (which re-reads the SnapSet) takes the real-delete
+                # branch instead of re-whiteouting.
+                await self._save_snapset(pool, pg, acting, oid, ss)
+                await self._do_delete(MOSDOp(
+                    op="delete", pool_id=op.pool_id, oid=oid,
+                    reqid=uuid.uuid4().hex))
+                trimmed += 1
+                continue
+            if changed:
+                await self._save_snapset(pool, pg, acting, oid, ss)
+        return MOSDOpReply(ok=True, data=str(trimmed).encode())
+
     async def _do_write(self, op: MOSDOp) -> MOSDOpReply:
         pool = self.osdmap.pools[op.pool_id]
         pg, acting = self._acting(pool, op.oid)
@@ -1467,6 +1670,7 @@ class OSD:
         self._failed_writes.discard(op.reqid)
         if op.offset >= 0 and not op.data:
             return MOSDOpReply(ok=True)  # zero-length overwrite: no-op
+        await self._make_writeable(op, pool, pg, acting)
         if pool.pool_type != "ec":
             return await self._do_write_replicated(op, pool, pg, acting)
         codec = self._codec(pool)
@@ -2151,6 +2355,25 @@ class OSD:
         log = self._pglog(op.pool_id, pg)
         if log.has_reqid(op.reqid):
             return MOSDOpReply(ok=True)  # resent delete: already applied
+        # snapshot semantics (reference make_writeable on delete): a
+        # delete under a snap context first clones the head, then leaves
+        # a WHITEOUT carrying the SnapSet so snap reads keep resolving;
+        # the head reads as ENOENT.  Without live clones, a delete is a
+        # real delete.
+        if not is_snap_clone(op.oid):
+            await self._make_writeable(op, pool, pg, acting)
+            ss = self._load_snapset(op.pool_id, op.oid)
+            if ss["clones"]:
+                self._cache_drop(op.pool_id, op.oid)
+                wr = await self._do_write(MOSDOp(
+                    op="write", pool_id=op.pool_id, oid=op.oid, data=b"",
+                    reqid=op.reqid or uuid.uuid4().hex))
+                if not wr.ok:
+                    return wr
+                ss = self._load_snapset(op.pool_id, op.oid)
+                ss["whiteout"] = True
+                await self._save_snapset(pool, pg, acting, op.oid, ss)
+                return MOSDOpReply(ok=True)
         tid = uuid.uuid4().hex
         self._cache_drop(op.pool_id, op.oid)
         entry = LogEntry(version=log.next_version(self.osdmap.epoch),

@@ -18,6 +18,28 @@ from ceph_tpu.rados.crush import _mix as _crush_mix
 from ceph_tpu.rados.messenger import message
 
 
+# -- snapshot naming ----------------------------------------------------------
+
+# clone objects are named <head><SNAP_SEP><snapid>; the separator cannot
+# appear in user oids (rejected at the client), so head-name recovery is
+# unambiguous (reference: clones are the same hobject with a snap field)
+SNAP_SEP = "\x00snap\x00"
+
+
+def snap_clone_oid(oid: str, snapid: int) -> str:
+    return f"{oid}{SNAP_SEP}{snapid:016d}"
+
+
+def snap_head(oid: str) -> str:
+    """The head object's name for any oid (identity for non-clones)."""
+    i = oid.find(SNAP_SEP)
+    return oid if i < 0 else oid[:i]
+
+
+def is_snap_clone(oid: str) -> bool:
+    return SNAP_SEP in oid
+
+
 @dataclass
 class PoolInfo:
     pool_id: int
@@ -29,6 +51,12 @@ class PoolInfo:
     profile: Dict[str, str] = field(default_factory=dict)
     rule: str = ""
     stripe_width: int = 0
+    # self-managed snapshot state (reference pg_pool_t snap_seq /
+    # removed_snaps, src/osd/osd_types.h): the mon allocates monotonically
+    # increasing snap ids; removed ids are recorded so lazy trimming and
+    # snap-read resolution can skip them
+    snap_seq: int = 0
+    removed_snaps: List[int] = field(default_factory=list)
 
 
 @dataclass
@@ -65,7 +93,11 @@ class OSDMap:
         return None
 
     def object_to_pg(self, pool: PoolInfo, oid: str) -> int:
-        h = hashlib.blake2s(oid.encode(), digest_size=4).digest()
+        # snapshot clones hash by their HEAD name so every clone lives in
+        # the head's PG (the reference keeps clones in the head's PG via
+        # the ghobject snap field; co-location is what lets the primary
+        # resolve snap reads and trim locally)
+        h = hashlib.blake2s(snap_head(oid).encode(), digest_size=4).digest()
         return int.from_bytes(h, "little") % pool.pg_num
 
     def pg_to_placed(self, pool: PoolInfo, pg: int) -> List[int]:
@@ -403,6 +435,26 @@ class MPoolSet:
     tid: str = ""
 
 
+@message(62)
+class MSnapOp:
+    """Self-managed snapshot id allocation / removal (reference
+    IoCtxImpl::selfmanaged_snap_create/remove via the OSDMonitor): the
+    mon is the allocator so ids are cluster-unique and monotonic."""
+
+    pool_id: int = 0
+    op: str = "create"  # create | remove
+    snap_id: int = 0  # for remove
+    tid: str = ""
+
+
+@message(63)
+class MSnapOpReply:
+    tid: str = ""
+    ok: bool = True
+    error: str = ""
+    snap_id: int = 0  # the allocated id (create)
+
+
 @message(15)
 class MConfigGet:
     tid: str = ""
@@ -435,6 +487,17 @@ class MOSDOp:
     # EC pools answer ENOTSUP, doc/dev/osd_internals/erasure_coding)
     cls: str = ""
     method: str = ""
+    # self-managed snap context riding every write (reference SnapContext,
+    # IoCtxImpl selfmanaged snap ops): seq = newest snap the writer knows,
+    # snaps = existing snap ids DESCENDING.  The primary clones the head
+    # before the first write past a new snap (make_writeable role).
+    snapc_seq: int = 0
+    snapc_snaps: List[int] = field(default_factory=list)
+    # op == "read"/"stat": read AT this snap id (0 = head); resolution
+    # walks the object's SnapSet clone list
+    snap_read: int = 0
+    # op == "snap-trim": the snap id being removed pool-wide
+    snap_id: int = 0
 
 
 @message(21, version=2)

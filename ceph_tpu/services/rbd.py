@@ -7,13 +7,14 @@ byte extents onto data objects; unwritten extents read as zeros (sparse).
 The object map (which blocks exist, reference object-map feature) lives in
 the header and makes sparse reads and fast remove possible without listing.
 
-Snapshots are per-object copy-on-write, the reference's clone-object model
-(``rbd_data.<id>.<n>@<snapid>``): snap_create records the object map; the
-first head write to an object after a snapshot copies the old content into
-the newest snapshot's clone before overwriting; reading a snapshot resolves
-each object to the OLDEST clone with snap id >= the requested snapshot,
-falling back to the head (never rewritten) or zeros (never existed) —
-librados self-managed-snap resolution in miniature.
+Snapshots sit on RADOS self-managed snaps exactly as the reference's
+librbd sits on librados (IoCtxImpl selfmanaged snap ops): snap_create
+allocates a pool-unique snap id from the mon and records the object map;
+every data write carries the image's SnapContext so the OSD primary
+clones a block before its first post-snap write (make_writeable);
+snapshot reads resolve per object through the RADOS SnapSet (covering
+clone, unchanged head, or absent); snap removal trims clones that no
+live snap still references.
 
 Layered clones (reference librbd clone v2, src/librbd/ + cls_rbd
 children bookkeeping): a PROTECTED snapshot can be cloned into a child
@@ -149,6 +150,8 @@ class Image:
             raise RbdError("write beyond image size (resize first)")
         objmap = set(self._hdr["object_map"])
         layered = bool(self._hdr.get("parent"))
+        snapc = self._image_snapc()  # every data write carries the context:
+        # the OSD primary clones a block before its first post-snap write
         pos = 0
         dirty_map = False
         while pos < len(data):
@@ -157,8 +160,6 @@ class Image:
             off_in = lofs % self.object_size
             n = min(self.object_size - off_in, len(data) - pos)
             piece = data[pos:pos + n]
-            if self._hdr.get("snaps") and await self._cow_before_write(idx):
-                dirty_map = True  # cow bookkeeping rides the same save
             if (layered and idx not in objmap
                     and (off_in or n < self.object_size)):
                 # copy-up (reference CopyupRequest): a partial write to a
@@ -167,19 +168,22 @@ class Image:
                 # in the child first, then overwrite part of it
                 base = await self._read_from_parent(idx)
                 if base:
-                    await self.ioctx.write_full(self._data_oid(idx), base)
+                    await self.ioctx.write_full(self._data_oid(idx), base,
+                                                snapc=snapc)
                     objmap.add(idx)
                     dirty_map = True
             if idx in objmap and (off_in or n < self.object_size):
                 # partial overwrite rides the OSD's RMW path
                 await self.ioctx.write(self._data_oid(idx), piece,
-                                       offset=off_in)
+                                       offset=off_in, snapc=snapc)
             elif off_in or n < self.object_size:
                 # sparse partial write into a fresh object: pad the head
                 await self.ioctx.write_full(self._data_oid(idx),
-                                            b"\x00" * off_in + piece)
+                                            b"\x00" * off_in + piece,
+                                            snapc=snapc)
             else:
-                await self.ioctx.write_full(self._data_oid(idx), piece)
+                await self.ioctx.write_full(self._data_oid(idx), piece,
+                                            snapc=snapc)
             if idx not in objmap:
                 objmap.add(idx)
                 dirty_map = True
@@ -193,13 +197,15 @@ class Image:
         old_objects = (old_size + self.object_size - 1) // self.object_size
         new_objects = (new_size + self.object_size - 1) // self.object_size
         if new_size < old_size:
+            snapc = self._image_snapc()
             objmap = set(self._hdr["object_map"])
             for idx in range(new_objects, old_objects):
                 if idx in objmap:
-                    if self._hdr.get("snaps"):
-                        await self._cow_before_write(idx)  # saved below
                     try:
-                        await self.ioctx.remove(self._data_oid(idx))
+                        # under a snap context the OSD clones first and
+                        # whiteouts, so snapshots keep their blocks
+                        await self.ioctx.remove(self._data_oid(idx),
+                                                snapc=snapc)
                     except RadosError:
                         pass
                     objmap.discard(idx)
@@ -208,12 +214,10 @@ class Image:
             tail = new_size % self.object_size
             bidx = new_size // self.object_size
             if tail and bidx in objmap:
-                if self._hdr.get("snaps"):
-                    await self._cow_before_write(bidx)
                 try:
                     blob = await self.ioctx.read(self._data_oid(bidx))
                     await self.ioctx.write_full(self._data_oid(bidx),
-                                                blob[:tail])
+                                                blob[:tail], snapc=snapc)
                 except RadosError:
                     pass
             self._hdr["object_map"] = sorted(objmap)
@@ -226,60 +230,43 @@ class Image:
                 "snaps": sorted(self._hdr.get("snaps", {})),
                 "id": self._hdr["id"]}
 
-    # -- snapshots (per-object COW clones, librbd snapshot role) -------------
+    # -- snapshots (RADOS self-managed snaps, librbd snapshot role) ----------
+    # Rebased onto the RADOS-level primitive: writes carry the image's
+    # snap context, the OSD primary does the per-object COW clone
+    # (make_writeable), snap reads resolve through the object's SnapSet,
+    # and snap removal trims clones that no live snap references — the
+    # clone-sharing/re-homing bookkeeping the service layer used to
+    # maintain is the storage layer's job now (reference librbd sits on
+    # librados selfmanaged snaps the same way).
 
     def _snaps(self) -> Dict[str, Dict]:
         return self._hdr.setdefault("snaps", {})
 
-    def _clone_oid(self, index: int, snap_id: int) -> str:
-        return f"{self._data_oid(index)}@{snap_id}"
+    def _image_snapc(self):
+        """(seq, snaps-descending) over the image's live snaps — the
+        SnapContext every data-object write rides."""
+        ids = sorted((s["id"] for s in self._snaps().values()),
+                     reverse=True)
+        if not ids:
+            return None
+        return (ids[0], ids)
 
     async def snap_create(self, name: str) -> None:
         snaps = self._snaps()
         if name in snaps:
             raise RbdError(f"snapshot {name!r} exists")
-        snap_id = 1 + max((s["id"] for s in snaps.values()), default=0)
+        snap_id = await self.ioctx.allocate_snap_id()
         snaps[name] = {"id": snap_id, "size": self.size,
-                       "object_map": list(self._hdr["object_map"]),
-                       "cow": []}
+                       "object_map": list(self._hdr["object_map"])}
         await self._save_header()
 
     def snap_list(self) -> List[str]:
         return sorted(self._snaps())
 
-    async def _cow_before_write(self, idx: int) -> bool:
-        """First head write to `idx` after a snapshot: preserve the old
-        content as a clone of the NEWEST snapshot covering it.  If that
-        newest snapshot already holds a clone, the head no longer carries
-        any snapshot's content — older snaps resolve through existing
-        clones (oldest-clone-wins), and copying the CURRENT head into an
-        older snap's slot would corrupt it.  Returns True if the header
-        needs saving (caller batches the save)."""
-        newest = None
-        for snap in self._snaps().values():
-            if idx in snap["object_map"]:
-                if newest is None or snap["id"] > newest["id"]:
-                    newest = snap
-        if newest is None or idx in newest["cow"]:
-            return False
-        try:
-            old = await self.ioctx.read(self._data_oid(idx))
-        except RadosError as e:
-            # only VERIFIED absence (typed -ENOENT) may be treated as a
-            # never-written block; a transient failure (-EAGAIN, timeout
-            # exhaustion) must abort the write, or the snapshot would
-            # permanently capture an EMPTY clone of a block that exists
-            if e.code != -errno.ENOENT:
-                raise
-            old = b""
-        await self.ioctx.write_full(self._clone_oid(idx, newest["id"]), old)
-        newest["cow"].append(idx)
-        return True
-
     async def read_snap(self, name: str, offset: int, length: int) -> bytes:
-        """Read from a snapshot: per object, the OLDEST clone with
-        snap id >= this snapshot, else the (never rewritten) head, else
-        zeros."""
+        """Read from a snapshot: each object resolves at the snap id
+        through its RADOS SnapSet (covering clone, unchanged head, or
+        absent)."""
         snap = self._snaps().get(name)
         if snap is None:
             raise RbdError(f"no snapshot {name!r}")
@@ -287,10 +274,6 @@ class Image:
         if offset >= size:
             return b""
         length = min(length, size - offset)
-        clones_at = sorted(
-            (s["id"], set(s["cow"])) for s in self._snaps().values()
-            if s["id"] >= snap["id"]
-        )
         spans = []
         pos = offset
         end = offset + length
@@ -313,12 +296,12 @@ class Image:
                 if layered:
                     return await self._read_from_parent(idx, parent)
                 return None
-            for snap_id, cow in clones_at:
-                if idx in cow:
-                    return await self.ioctx.read(self._clone_oid(idx, snap_id))
             try:
-                return await self.ioctx.read(self._data_oid(idx))
-            except RadosError:
+                return await self.ioctx.read(self._data_oid(idx),
+                                             snap=snap["id"])
+            except RadosError as e:
+                if e.code != -errno.ENOENT:
+                    raise
                 return b""
 
         blobs = await asyncio.gather(*(resolve(idx) for idx, _, _ in spans))
@@ -380,45 +363,21 @@ class Image:
         await RBD(self.ioctx)._unregister_child(parent_ref, self.name)
 
     async def snap_remove(self, name: str) -> None:
-        """Remove a snapshot.  A clone the removed snap owns may still be
-        the resolution target of an OLDER snapshot (no intermediate clone
-        covers it): such clones are re-homed to the newest dependent older
-        snap instead of deleted (the reference's snap-trim keeps clones
-        while any snap in the set still needs them)."""
+        """Remove a snapshot: the RADOS snap-trim deletes only clones no
+        LIVE snap still references (each clone records the snap ids it
+        covers), so clones shared with older snapshots survive without
+        any service-level re-homing."""
         snaps = self._snaps()
         if name in snaps and snaps[name].get("protected"):
             raise RbdError(f"snapshot {name!r} is protected")
-        snap = snaps.pop(name, None)
+        snap = snaps.get(name)
         if snap is None:
             raise RbdError(f"no snapshot {name!r}")
-        for idx in snap["cow"]:
-            # newest older snap that sees idx and has no clone of its own
-            # in [its id, removed id) — it was resolving through ours
-            dependent = None
-            for other in snaps.values():
-                if other["id"] >= snap["id"] or idx not in other["object_map"]:
-                    continue
-                covered = any(
-                    s2["id"] >= other["id"] and s2["id"] < snap["id"]
-                    and idx in s2["cow"]
-                    for s2 in snaps.values()
-                )
-                if not covered and (dependent is None
-                                    or other["id"] > dependent["id"]):
-                    dependent = other
-            src = self._clone_oid(idx, snap["id"])
-            if dependent is not None:
-                try:
-                    blob = await self.ioctx.read(src)
-                    await self.ioctx.write_full(
-                        self._clone_oid(idx, dependent["id"]), blob)
-                    dependent["cow"].append(idx)
-                except RadosError:
-                    pass
-            try:
-                await self.ioctx.remove(src)
-            except RadosError:
-                pass
+        # release FIRST: if the mon call fails, the header still names
+        # the snap and snap_remove can be retried — the reverse order
+        # would leak the snap id and its clones with no handle left
+        await self.ioctx.release_snap_id(snap["id"])
+        snaps.pop(name, None)
         await self._save_header()
 
 

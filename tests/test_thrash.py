@@ -79,6 +79,10 @@ class TestThrash:
                         await cluster.kill_osd(victim)
                     await asyncio.sleep(1.0)
                     await cluster.add_osd()
+                # calm tail: under machine load a put can take seconds
+                # during churn; give writers a recovered cluster so the
+                # acked-write floor reflects the system, not the host
+                await asyncio.sleep(2.0)
                 stop.set()
                 for w in workers:
                     w.cancel()
