@@ -86,6 +86,12 @@ class RadosClient:
         self._mon_lock = asyncio.Lock()
         # (pool, oid) -> callback(oid, payload) for watch/notify
         self._watches: Dict = {}
+        # linger state (reference Objecter::linger_watch, Objecter.cc:598):
+        # (pool, oid) -> primary the watch was registered with; on a map
+        # change that moves the primary, the watch re-registers itself
+        self._watch_primaries: Dict[Tuple[int, int], Optional[int]] = {}
+        self._relinger_task: Optional[asyncio.Task] = None
+        self._linger_poll_task: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
         self.messenger.dispatcher = self._dispatch
@@ -113,6 +119,9 @@ class RadosClient:
         self.messenger.session_key = bytes.fromhex(reply.session_key)
 
     async def stop(self) -> None:
+        for t in (self._linger_poll_task, self._relinger_task):
+            if t is not None and not t.done():
+                t.cancel()
         await self.messenger.shutdown()
 
     async def _dispatch(self, conn, msg) -> None:
@@ -198,6 +207,8 @@ class RadosClient:
                                   and self.osdmap.epoch >= min_epoch):
                 break
             await asyncio.sleep(0.1)
+        if self._watches:
+            self._kick_relinger()
         return self.osdmap
 
     async def create_pool(
@@ -324,10 +335,14 @@ class RadosClient:
                 if code in (-errno.ESTALE, -errno.EAGAIN):
                     # placement moved / PG degraded: both are cured by a
                     # newer map — fence PAST our own epoch, growing window
-                    # while detection + recovery move seats
+                    # while detection + recovery move seats.  A server-
+                    # provided backoff (MOSDBackoff role) extends the
+                    # pause: the PG told us how long it wants.
                     fence = max(fence, self.osdmap.epoch + 1)
-                    if attempt:
-                        await asyncio.sleep(min(0.25 * attempt, 1.0))
+                    pause = max(getattr(reply, "backoff", 0.0),
+                                min(0.25 * attempt, 1.0) if attempt else 0.0)
+                    if pause:
+                        await asyncio.sleep(pause)
                     continue
                 # -EBUSY and anything unclassified: prompt plain retry
                 await asyncio.sleep(0.2 * (attempt + 1))
@@ -397,11 +412,9 @@ class RadosClient:
         if not reply.ok:
             raise RadosError(reply.error)
         await self.refresh_map()
-        for osd in list(self.osdmap.osds.values()):
-            if not osd.up:
-                continue
+        for osd_id in self._pg_primaries(pool_id):
             try:
-                await self._op_direct(osd.osd_id, MOSDOp(
+                await self._op_direct(osd_id, MOSDOp(
                     op="snap-trim", pool_id=pool_id, snap_id=snap_id))
             except RadosError:
                 continue
@@ -412,12 +425,10 @@ class RadosClient:
         import pickle as _pickle
 
         total = {"scrubbed": 0, "errors": 0, "repaired": 0}
-        for osd in list(self.osdmap.osds.values()):
-            if not osd.up:
-                continue
+        for osd_id in self._pg_primaries(pool_id):
             try:
                 reply = await self._op_direct(
-                    osd.osd_id, MOSDOp(op="deep-scrub", pool_id=pool_id))
+                    osd_id, MOSDOp(op="deep-scrub", pool_id=pool_id))
                 for k, v in _pickle.loads(reply.data).items():
                     total[k] = total.get(k, 0) + v
             except RadosError:
@@ -442,9 +453,11 @@ class RadosClient:
                               snapc_seq=seq, snapc_snaps=list(snaps)))
 
     async def watch(self, pool_id: int, oid: str, callback) -> None:
-        """Register a notify callback on oid (librados watch2 role).  After
-        a primary change, call watch() again — the reference's clients
-        re-register on watch errors the same way."""
+        """Register a notify callback on oid (librados watch2 role).
+        Watches are LINGER ops (reference Objecter::linger_watch): the
+        client tracks the registered primary and automatically
+        re-registers when a map refresh shows the primary moved — the
+        new primary has no watcher state for us until then."""
         import pickle as _pickle
 
         self._watches[(pool_id, oid)] = callback
@@ -454,6 +467,61 @@ class RadosClient:
         except BaseException:
             self._watches.pop((pool_id, oid), None)  # registration failed
             raise
+        self._watch_primaries[(pool_id, oid)] = self._primary_for(pool_id, oid)
+        if self._linger_poll_task is None or self._linger_poll_task.done():
+            # an IDLE watcher issues no ops, so nothing would ever pull a
+            # new map: poll while watches exist (reference: the Objecter
+            # subscribes to maps; this is the polling analog)
+            self._linger_poll_task = asyncio.get_running_loop().create_task(
+                self._linger_poll())
+
+    async def _linger_poll(self) -> None:
+        interval = float(self.conf.get("client_linger_poll", 1.0) or 1.0)
+        while self._watches:
+            await asyncio.sleep(interval)
+            if not self._watches:
+                break
+            try:
+                await self.refresh_map()  # _kick_relinger rides this
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                pass
+
+    def _primary_for(self, pool_id: int, oid: str) -> Optional[int]:
+        pool = self.osdmap.pools.get(pool_id) if self.osdmap else None
+        if pool is None:
+            return None
+        pg = self.osdmap.object_to_pg(pool, oid)
+        acting = self.osdmap.pg_to_acting(pool, pg)
+        return self.osdmap.primary_of(acting, seed=(pool_id << 20) | pg)
+
+    def _kick_relinger(self) -> None:
+        """After a map change: re-register watches whose primary moved
+        (on a task of its own — refresh_map runs inside op retries and
+        must not recurse into more ops)."""
+        stale = [key for key, registered in self._watch_primaries.items()
+                 if key in self._watches
+                 and self._primary_for(*key) not in (None, registered)]
+        if not stale or (self._relinger_task
+                         and not self._relinger_task.done()):
+            return
+
+        async def _relinger() -> None:
+            import pickle as _pickle
+
+            for pool_id, oid in stale:
+                if (pool_id, oid) not in self._watches:
+                    continue  # unwatched meanwhile
+                try:
+                    await self._op(MOSDOp(
+                        op="watch", pool_id=pool_id, oid=oid,
+                        data=_pickle.dumps(self.messenger.addr)))
+                    self._watch_primaries[(pool_id, oid)] = \
+                        self._primary_for(pool_id, oid)
+                except RadosError:
+                    pass  # next map change retries
+
+        self._relinger_task = asyncio.get_running_loop().create_task(
+            _relinger())
 
     async def unwatch(self, pool_id: int, oid: str) -> None:
         import pickle as _pickle
@@ -461,6 +529,7 @@ class RadosClient:
         await self._op(MOSDOp(op="unwatch", pool_id=pool_id, oid=oid,
                               data=_pickle.dumps(self.messenger.addr)))
         self._watches.pop((pool_id, oid), None)  # only after the OSD agreed
+        self._watch_primaries.pop((pool_id, oid), None)
 
     async def notify(self, pool_id: int, oid: str,
                      payload: bytes = b"") -> List:
@@ -473,31 +542,76 @@ class RadosClient:
         return _pickle.loads(reply.data)
 
     async def list_objects(self, pool_id: int) -> List[str]:
-        """Union of shard listings across up OSDs (any OSD can answer for
-        its own shards; union covers holes)."""
+        """Paginated per-PG-primary listing (reference pgls/do_pgnls):
+        admin listings scale with PG count, never cluster size.  Falls
+        back to the all-OSD union for a PG whose primary cannot answer
+        (mid-peering) — correctness over elegance for admin tooling."""
         if self.osdmap is None:
             await self.refresh_map()
+        pool = self.osdmap.pools.get(pool_id)
+        if pool is None:
+            # our map may predate the pool: one refresh before concluding
+            await self.refresh_map()
+            pool = self.osdmap.pools.get(pool_id)
+        if pool is None:
+            raise RadosError(f"pool {pool_id} does not exist",
+                             code=-errno.ENOENT)
         oids: set = set()
-        for osd in self.osdmap.osds.values():
-            if not osd.up:
+        fallback = False
+        for pg in range(pool.pg_num):
+            acting = self.osdmap.pg_to_acting(pool, pg)
+            primary = self.osdmap.primary_of(acting,
+                                             seed=(pool_id << 20) | pg)
+            if primary is None:
+                fallback = True
                 continue
-            try:
-                reply = await self._op_direct(osd.osd_id,
-                                              MOSDOp(op="list", pool_id=pool_id))
-                oids.update(reply.oids)
-            except RadosError:
-                continue
-        return sorted(oids)
-
-    async def repair_pool(self, pool_id: int) -> None:
-        """Ask every up OSD to run primary-led repair for its PGs."""
-        for osd in list(self.osdmap.osds.values()):
-            if osd.up:
+            cursor = ""
+            while True:
                 try:
-                    await self._op_direct(osd.osd_id,
-                                          MOSDOp(op="repair", pool_id=pool_id))
+                    reply = await self._op_direct(primary, MOSDOp(
+                        op="pgls", pool_id=pool_id, pg=pg, cursor=cursor))
+                except RadosError:
+                    fallback = True
+                    break
+                oids.update(reply.oids)
+                cursor = getattr(reply, "cursor", "")
+                if not cursor:
+                    break
+        if fallback:
+            # degraded path: union of per-OSD listings covers the holes
+            for osd in self.osdmap.osds.values():
+                if not osd.up:
+                    continue
+                try:
+                    reply = await self._op_direct(
+                        osd.osd_id, MOSDOp(op="list", pool_id=pool_id))
+                    oids.update(reply.oids)
                 except RadosError:
                     continue
+        return sorted(oids)
+
+    def _pg_primaries(self, pool_id: int) -> List[int]:
+        """The distinct primaries of a pool's PGs — the scrub/repair
+        fan-out set (per-PG primaries, not every OSD in the cluster)."""
+        pool = self.osdmap.pools.get(pool_id)
+        if pool is None:
+            return []
+        primaries = set()
+        for pg in range(pool.pg_num):
+            acting = self.osdmap.pg_to_acting(pool, pg)
+            p = self.osdmap.primary_of(acting, seed=(pool_id << 20) | pg)
+            if p is not None:
+                primaries.add(p)
+        return sorted(primaries)
+
+    async def repair_pool(self, pool_id: int) -> None:
+        """Primary-led repair, fanned out to the pool's PG primaries."""
+        for osd_id in self._pg_primaries(pool_id):
+            try:
+                await self._op_direct(osd_id,
+                                      MOSDOp(op="repair", pool_id=pool_id))
+            except RadosError:
+                continue
 
     async def _op_direct(self, osd_id: int, op: MOSDOp) -> MOSDOpReply:
         op.reqid = uuid.uuid4().hex

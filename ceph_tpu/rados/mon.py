@@ -34,7 +34,7 @@ from ceph_tpu.ec.interface import ErasureCodeError
 from ceph_tpu.ec.registry import registry
 from ceph_tpu.rados.auth import KeyServer
 from ceph_tpu.rados.crush import CRUSH_ITEM_NONE, CrushMap
-from ceph_tpu.rados.messenger import Messenger
+from ceph_tpu.rados.messenger import TRANSPORT_ERRORS, Messenger
 from ceph_tpu.rados.paxos import ElectionLogic, MonitorDBStore, Paxos
 from ceph_tpu.rados.types import (
     MAuthRotating,
@@ -130,6 +130,11 @@ class Monitor:
         # target_osd -> {reporter: stamp} (OSD failure reports)
         self._failure_reports: Dict[int, Dict[int, float]] = {}
         self._last_rotation = time.monotonic()
+        # peer rank -> reachability EMA (ConnectionTracker role)
+        self._conn_scores: Dict[int, float] = {}
+        # strong refs to in-flight forward tasks (asyncio holds tasks
+        # weakly; a GC'd task would silently drop a client write)
+        self._forward_tasks: Set[asyncio.Task] = set()
         self._stopped = False
 
     # -- replicated state (de)serialization ----------------------------------
@@ -253,8 +258,10 @@ class Monitor:
         await asyncio.sleep(0.05 * self.rank)  # stagger: let rank 0 go first
         while not self._stopped and not self.logic.in_quorum:
             epoch = self.logic.start()
+            self.logic.score = self.connectivity_score()
             await self._broadcast(MMonElection(op="propose", epoch=epoch,
-                                               rank=self.rank))
+                                               rank=self.rank,
+                                               score=self.logic.score))
             await asyncio.sleep(self._election_timeout)
             if not self.logic.electing:
                 return  # lost to a better candidate mid-wait
@@ -295,7 +302,9 @@ class Monitor:
 
     async def _handle_election(self, msg: MMonElection) -> None:
         if msg.op == "propose":
-            verdict = self.logic.receive_propose(msg.rank, msg.epoch)
+            self.logic.score = self.connectivity_score()
+            verdict = self.logic.receive_propose(
+                msg.rank, msg.epoch, getattr(msg, "score", -1.0))
             if verdict == "ack":
                 # carry OUR epoch so a restarted candidate catches up
                 await self._send_rank(
@@ -321,6 +330,20 @@ class Monitor:
                     MMonElection(op="propose", epoch=self.logic.epoch,
                                  rank=self.rank))
                 self._spawn_election()
+
+    async def _handle_forward(self, msg: MForward) -> None:
+        try:
+            reply = await self._process_write(pickle.loads(msg.inner))
+            await self._send_rank(
+                msg.from_rank,
+                MForwardReply(tid=msg.tid,
+                              inner=pickle.dumps(reply, protocol=5)))
+        except TRANSPORT_ERRORS:
+            pass  # forwarder retries / client times out and resends
+        except Exception:
+            import traceback
+
+            traceback.print_exc()  # a dispatcher-bug must be loud, not lost
 
     # -- paxos transport -----------------------------------------------------
 
@@ -492,7 +515,28 @@ class Monitor:
     # -- mon-mon send helpers ------------------------------------------------
 
     async def _send_rank(self, peer_rank: int, msg: Any) -> None:
-        await self.messenger.send(self.monmap[peer_rank], msg, peer_type="mon")
+        try:
+            await self.messenger.send(self.monmap[peer_rank], msg,
+                                      peer_type="mon")
+        except BaseException:
+            self._track_peer(peer_rank, ok=False)
+            raise
+        self._track_peer(peer_rank, ok=True)
+
+    def _track_peer(self, peer_rank: int, ok: bool) -> None:
+        """Per-peer reachability EMA (reference ConnectionTracker.h:80):
+        feeds the election connectivity score so a mon that cannot reach
+        its peers stops winning leadership."""
+        prev = self._conn_scores.get(peer_rank, 1.0)
+        self._conn_scores[peer_rank] = 0.8 * prev + (0.2 if ok else 0.0)
+
+    def connectivity_score(self) -> float:
+        """Mean peer-reachability in [0,1]; 1.0 with no history."""
+        if not self.monmap or len(self.monmap) <= 1:
+            return 1.0
+        vals = [self._conn_scores.get(r, 1.0)
+                for r in range(len(self.monmap)) if r != self.rank]
+        return sum(vals) / len(vals)
 
     async def _broadcast(self, msg: Any) -> None:
         for r in range(len(self.monmap)):
@@ -523,11 +567,15 @@ class Monitor:
         elif isinstance(msg, MMonPaxos):
             await self._handle_paxos(msg)
         elif isinstance(msg, MForward):
-            reply = await self._process_write(pickle.loads(msg.inner))
-            await self._send_rank(
-                msg.from_rank,
-                MForwardReply(tid=msg.tid, inner=pickle.dumps(reply, protocol=5)),
-            )
+            # NEVER process a forwarded write inline: this serve loop is
+            # the peon's connection, which ALSO carries its paxos accepts
+            # — blocking here on consensus would deadlock the very accept
+            # the proposal is waiting for (exposed when a score-elected
+            # leader is not the client's first live mon)
+            t = asyncio.get_running_loop().create_task(
+                self._handle_forward(msg))
+            self._forward_tasks.add(t)
+            t.add_done_callback(self._forward_tasks.discard)
         elif isinstance(msg, MForwardReply):
             entry = self._pending_forwards.pop(msg.tid, None)
             if entry is not None:

@@ -277,6 +277,11 @@ class OSD:
         # (pool, pg) -> {(oid, version): first_seen_monotonic} for versions
         # newer than the newest complete one (unfound-revert grace clock)
         self._partial_newer: Dict[Tuple[int, int], Dict[Tuple[str, int], float]] = {}
+        # (pool, pg) -> last self-scheduled deep-scrub time (monotonic);
+        # the scrub scheduler picks the oldest-due PG each tick
+        self._last_scrub: Dict[Tuple[int, int], float] = {}
+        self._last_scrub_scan = 0.0
+        self._scrub_task: Optional[asyncio.Task] = None
         # the process-wide stripe-batching queue (None = batching off):
         # every EC encode/decode this daemon issues is submitted here so
         # CONCURRENT ops coalesce into one device dispatch (SURVEY.md
@@ -413,6 +418,7 @@ class OSD:
             except TRANSPORT_ERRORS:
                 self.mons.rotate()  # that mon looks dead
             ticks += 1
+            self._maybe_schedule_scrubs()
             if self._ec_queue is not None:
                 # mirror the shared queue's stats into this daemon's
                 # counters (perf dump / prometheus visibility); submits
@@ -1398,6 +1404,8 @@ class OSD:
                 reply = await self._do_delete(op)
             elif op.op == "snap-trim":
                 reply = await self._do_snap_trim(op)
+            elif op.op == "pgls":
+                reply = await self._do_pgls(op)
             elif op.op == "list":
                 reply = MOSDOpReply(ok=True, oids=self._list_heads(op.pool_id))
             elif op.op == "repair":
@@ -1583,6 +1591,43 @@ class OSD:
                                    error="object not found")
         return await handler(op)
 
+    async def _do_pgls(self, op: MOSDOp) -> MOSDOpReply:
+        """Paginated listing of ONE PG's objects (reference do_pgnls,
+        PrimaryLogPG.cc): the primary answers from its local shards —
+        after backfill it holds a shard of every object in the PG — so
+        admin listings fan out to per-PG primaries and page, instead of
+        broadcasting to every OSD.  Returns up to max_entries heads past
+        `cursor`, plus the resume cursor ("" when exhausted)."""
+        pool = self.osdmap.pools.get(op.pool_id)
+        if pool is None:
+            return MOSDOpReply(ok=False, code=-errno.ENOENT,
+                               error="no such pool")
+        pg = op.pg
+        acting = self.osdmap.pg_to_acting(pool, pg)
+        if self._primary(pool, pg, acting) != self.osd_id:
+            return MOSDOpReply(ok=False, code=-errno.ESTALE,
+                               error="not primary")
+        limit = op.max_entries or 512
+        heads = sorted({
+            snap_head(oid)
+            for oid, _ in self._list_pool_objects(op.pool_id)
+            if self.osdmap.object_to_pg(pool, oid) == pg
+        })
+        out: List[str] = []
+        for oid in heads:
+            if op.cursor and oid <= op.cursor:
+                continue
+            if is_snap_clone(oid):
+                continue
+            if self._load_snapset(op.pool_id, oid).get("whiteout"):
+                continue
+            out.append(oid)
+            if len(out) >= limit:
+                break
+        exhausted = not out or out[-1] == (heads[-1] if heads else "")
+        return MOSDOpReply(ok=True, oids=out,
+                           cursor="" if exhausted else out[-1])
+
     def _list_heads(self, pool_id: int) -> List[str]:
         """User-visible listing: heads only — no clones, no whiteouts."""
         out = []
@@ -1662,6 +1707,7 @@ class OSD:
             return MOSDOpReply(
                 ok=False, code=-errno.EAGAIN,
                 error=f"degraded below min_size ({len(live)}/{pool.min_size})",
+                backoff=float(self.conf.get("osd_backoff_secs", 0.5) or 0),
             )
         log = self._pglog(op.pool_id, pg)
         if log.has_reqid(op.reqid) and op.reqid not in self._failed_writes:
@@ -2905,19 +2951,79 @@ class OSD:
         except (ConnectionError, OSError):
             pass
 
-    async def deep_scrub_pool(self, pool: PoolInfo) -> Dict[str, int]:
+    def _maybe_schedule_scrubs(self) -> None:
+        """Self-scheduled deep scrub (reference osd_scrub_sched.h: PGs
+        scrub themselves on configurable intervals, not only on operator
+        request).  The due-scan is throttled, runs at most one scrub at
+        a time, and runs it on its OWN task — the beacon loop must never
+        block behind a scrub gather or the mon would mark this OSD down.
+        A freshly-seen PG starts with a STAGGERED deadline (rank-spread
+        fraction of the interval) so daemon start does not trigger a
+        scrub burst."""
+        interval = float(self.conf.get("osd_deep_scrub_interval", 3600.0)
+                         or 0)
+        if interval <= 0 or self.osdmap is None:
+            return
+        now = time.monotonic()
+        if now - self._last_scrub_scan < max(interval / 20.0, 0.05):
+            return
+        if self._scrub_task is not None and not self._scrub_task.done():
+            return  # one scrub at a time (reference scrub reservations)
+        self._last_scrub_scan = now
+        due: Optional[Tuple[float, PoolInfo, int]] = None
+        for pool in list(self.osdmap.pools.values()):
+            for pg in range(pool.pg_num):
+                acting = self.osdmap.pg_to_acting(pool, pg)
+                if self._primary(pool, pg, acting) != self.osd_id:
+                    continue
+                last = self._last_scrub.get((pool.pool_id, pg))
+                if last is None:
+                    # stagger the first due time across PGs and OSDs
+                    self._last_scrub[(pool.pool_id, pg)] = now -                         interval * (((pg * 31 + self.osd_id * 17) % 97)
+                                    / 97.0)
+                    continue
+                if now - last < interval:
+                    continue
+                if due is None or last < due[0]:
+                    due = (last, pool, pg)
+        if due is None:
+            return
+        _, pool, pg = due
+        self._last_scrub[(pool.pool_id, pg)] = now
+
+        async def _run() -> None:
+            try:
+                await self._deep_scrub_pg(pool, pg)
+            except Exception:
+                self.perf.inc("recovery_errors")
+
+        self._scrub_task = asyncio.get_running_loop().create_task(_run())
+
+    async def _deep_scrub_pg(self, pool: PoolInfo, pg: int) -> Dict[str, int]:
+        """Deep scrub the objects of ONE PG this OSD leads."""
+        return await self.deep_scrub_pool(pool, only_pg=pg)
+
+    async def deep_scrub_pool(self, pool: PoolInfo,
+                              only_pg: int = -1) -> Dict[str, int]:
         """Primary-led deep scrub: every acting shard of every object this
         OSD is primary for recomputes its crc against stored meta; bad or
         missing shards are repaired by re-encode + push."""
         scrubbed = errors = repaired = 0
-        oids = sorted({oid for oid, _ in self._list_pool_objects(pool.pool_id)})
-        # include objects whose shards live elsewhere
-        for oid, shard, _v in await self._list_all_shards(pool.pool_id):
+        oids = sorted({
+            oid for oid, _ in self._list_pool_objects(pool.pool_id)
+            if only_pg < 0
+            or self.osdmap.object_to_pg(pool, oid) == only_pg})
+        # include objects whose shards live elsewhere (scoped to the one
+        # PG when scrubbing one PG — peers filter server-side)
+        for oid, shard, _v in await self._list_all_shards(pool.pool_id,
+                                                          pg=only_pg):
             if oid not in oids:
                 oids.append(oid)
         for oid in oids:
             pg, acting = self._acting(pool, oid)
             if self._primary(pool, pg, acting) != self.osd_id:
+                continue
+            if only_pg >= 0 and pg != only_pg:
                 continue
             scrubbed += 1
             bad: List[Tuple[int, int]] = []  # (shard, osd)
@@ -3016,8 +3122,9 @@ class OSD:
                                 pass
         return {"scrubbed": scrubbed, "errors": errors, "repaired": repaired}
 
-    async def _list_all_shards(self, pool_id: int):
-        """Union shard listing (oid, shard, version) across up OSDs."""
+    async def _list_all_shards(self, pool_id: int, pg: int = -1):
+        """Union shard listing (oid, shard, version) across up OSDs,
+        optionally scoped to one PG (peers filter server-side)."""
         tid = uuid.uuid4().hex
         peers = [o for o in self.osdmap.osds.values()
                  if o.up and o.osd_id != self.osd_id]
@@ -3026,13 +3133,17 @@ class OSD:
         for o in peers:
             try:
                 await self.messenger.send(
-                    o.addr, MListShards(pool_id=pool_id, tid=tid,
+                    o.addr, MListShards(pool_id=pool_id, pg=pg, tid=tid,
                                         reply_to=self.addr))
                 sent += 1
             except TRANSPORT_ERRORS:
                 pass
         out = []
+        pool = self.osdmap.pools.get(pool_id)
         for oid, shard in self._list_pool_objects(pool_id):
+            if (pg >= 0 and pool is not None
+                    and self.osdmap.object_to_pg(pool, oid) != pg):
+                continue
             got = self._store_read((pool_id, oid, shard))
             if got is not None:
                 out.append((oid, shard, got[1].version))

@@ -105,6 +105,14 @@ class MonitorDBStore:
 class ElectionLogic:
     """Rank-based election state; the Monitor wires sends/timeouts."""
 
+    # scores are QUANTIZED into buckets before comparison (tie -> lowest
+    # rank): a mon must be meaningfully better connected to displace a
+    # lower rank, or jittery measurements would flap leadership.
+    # Quantization (unlike a pairwise margin) keeps the ordering
+    # TRANSITIVE — pairwise margins let a chain of within-margin wins
+    # hand victory to the worst-connected candidate.
+    SCORE_BUCKET = 0.2
+
     def __init__(self, rank: int, n_mons: int):
         self.rank = rank
         self.n_mons = n_mons
@@ -113,6 +121,22 @@ class ElectionLogic:
         self.acked_by: Set[int] = set()
         self.leader: Optional[int] = None
         self.quorum: Set[int] = set()
+        # this mon's own connectivity score (mean peer-reachability EMA,
+        # reference ConnectionTracker); the Monitor refreshes it before
+        # each election round
+        self.score = 1.0
+
+    @classmethod
+    def _bucket(cls, score: float) -> int:
+        return int(round(score / cls.SCORE_BUCKET))
+
+    def _beats(self, their_score: float, their_rank: int) -> bool:
+        """Does the remote candidate beat US (we should ack them)?"""
+        if their_score >= 0:
+            theirs, ours = self._bucket(their_score), self._bucket(self.score)
+            if theirs != ours:
+                return theirs > ours
+        return their_rank < self.rank
 
     @property
     def majority(self) -> int:
@@ -130,11 +154,15 @@ class ElectionLogic:
             self.epoch += 2
         return self.epoch
 
-    def receive_propose(self, from_rank: int, epoch: int) -> str:
+    def receive_propose(self, from_rank: int, epoch: int,
+                        from_score: float = -1.0) -> str:
         """Any propose pulls us into the election (reference: an election
         message bumps everyone into electing).  Returns 'ack' (defer to a
         better candidate), 'ignore', or 'counter' (we are the better
-        candidate: propose ourselves)."""
+        candidate: propose ourselves).  "Better" is connectivity score
+        first (a well-connected mon routes around partial network
+        failure), rank as the tiebreak — the reference's CONNECTIVITY
+        election strategy (ElectionLogic.cc, ConnectionTracker.h:80)."""
         if epoch > self.epoch:
             self.epoch = epoch
         if from_rank == self.rank:
@@ -145,7 +173,7 @@ class ElectionLogic:
         self.electing = True
         self.leader = None
         self.quorum = set()
-        if from_rank < self.rank:
+        if self._beats(from_score, from_rank):
             return "ack"
         return "counter"
 

@@ -338,6 +338,10 @@ class MMonElection:
     epoch: int = 0
     rank: int = 0
     quorum: List[int] = field(default_factory=list)
+    # candidate's connectivity score (reference ConnectionTracker.h:80 /
+    # ElectionLogic CONNECTIVITY strategy): mean peer-reachability EMA in
+    # [0,1]; -1 = not reported (rank-based fallback)
+    score: float = -1.0
 
 
 @message(11)
@@ -498,6 +502,11 @@ class MOSDOp:
     snap_read: int = 0
     # op == "snap-trim": the snap id being removed pool-wide
     snap_id: int = 0
+    # op == "pgls": paginated per-PG listing (reference do_pgnls,
+    # PrimaryLogPG.cc) — admin fan-outs scale with PGs, not cluster size
+    pg: int = -1
+    cursor: str = ""  # resume after this oid ("" = start)
+    max_entries: int = 0  # 0 = server default
 
 
 @message(21, version=2)
@@ -515,6 +524,12 @@ class MOSDOpReply:
     code: int = 0
     data: bytes = b""
     oids: List[str] = field(default_factory=list)
+    # pgls pagination: resume cursor ("" = listing exhausted)
+    cursor: str = ""
+    # MOSDBackoff role (reference src/messages/MOSDBackoff.h:20): a busy/
+    # degraded PG tells the client how long to pause before the resend,
+    # instead of eating a blind retry storm
+    backoff: float = 0.0
     reqid: str = ""
     version: int = 0  # object version the data was read at
     # the replying OSD's map epoch: on a retryable error (not primary,

@@ -1,0 +1,152 @@
+"""Admin-path scale + liveness machinery: per-PG-primary paginated
+listings (reference pgls/do_pgnls), linger watch re-registration across
+primary changes (Objecter::linger_watch), self-scheduled deep scrub
+(osd_scrub_sched), and server-driven client backoff (MOSDBackoff)."""
+
+import asyncio
+import os
+
+from ceph_tpu.rados.types import MOSDOp
+from ceph_tpu.rados.vstart import Cluster
+
+EC_PROFILE = {"plugin": "jerasure", "technique": "reed_sol_van",
+              "k": "2", "m": "1"}
+
+
+def run(coro, timeout=120):
+    asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+class TestPgls:
+    def test_listing_pages_through_pg_primaries(self):
+        async def go():
+            cluster = Cluster(n_osds=4, conf={"osd_auto_repair": False})
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("pl", profile=EC_PROFILE)
+                names = {f"obj-{i:03d}" for i in range(60)}
+                for n in sorted(names):
+                    await c.put(pool, n, b"x" * 200)
+                assert set(await c.list_objects(pool)) == names
+                # pagination machinery: tiny pages still cover everything
+                p = c.osdmap.pools[pool]
+                got = set()
+                for pg in range(p.pg_num):
+                    acting = c.osdmap.pg_to_acting(p, pg)
+                    primary = c.osdmap.primary_of(
+                        acting, seed=(pool << 20) | pg)
+                    cursor = ""
+                    pages = 0
+                    while True:
+                        reply = await c._op_direct(primary, MOSDOp(
+                            op="pgls", pool_id=pool, pg=pg,
+                            cursor=cursor, max_entries=3))
+                        assert len(reply.oids) <= 3
+                        got.update(reply.oids)
+                        pages += 1
+                        cursor = reply.cursor
+                        if not cursor:
+                            break
+                    assert pages >= 1
+                assert got == names
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+
+class TestLingerWatch:
+    def test_watch_survives_primary_change(self):
+        """Kill the watched object's primary: the linger machinery must
+        re-register on the new primary so notifies keep arriving without
+        the app calling watch() again."""
+        async def go():
+            conf = {"mon_osd_report_grace": 0.8,
+                    "osd_heartbeat_interval": 0.2, "osd_repair_delay": 0.2}
+            cluster = Cluster(n_osds=4, conf=conf)
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                notifier = await cluster.client()
+                pool = await c.create_pool("lw", profile=EC_PROFILE)
+                await c.put(pool, "watched", b"w")
+                got = []
+                await c.watch(pool, "watched", lambda oid, p: got.append(p))
+                await notifier.notify(pool, "watched", b"one")
+                assert got == [b"one"]
+                # move the primary
+                primary = c._primary_for(pool, "watched")
+                await cluster.kill_osd(primary)
+                await c.mark_osd_down(primary)
+                await asyncio.sleep(2.0)
+                await c.refresh_map()  # linger kicks here
+                for _ in range(50):
+                    if (c._relinger_task is None
+                            or c._relinger_task.done()):
+                        break
+                    await asyncio.sleep(0.1)
+                # notify through the NEW primary reaches the watcher
+                await notifier.refresh_map()
+                acked = await notifier.notify(pool, "watched", b"two")
+                assert got[-1] == b"two", got
+                assert acked, "watcher not registered on new primary"
+                await notifier.stop()
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+
+class TestScrubScheduling:
+    def test_pgs_scrub_themselves_on_interval(self):
+        async def go():
+            conf = {"osd_auto_repair": False,
+                    "osd_deep_scrub_interval": 0.3,
+                    "osd_heartbeat_interval": 0.1}
+            cluster = Cluster(n_osds=3, conf=conf)
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("ss", profile=EC_PROFILE)
+                for i in range(6):
+                    await c.put(pool, f"o{i}", os.urandom(4000))
+                # corrupt one stored shard: the SELF-scheduled scrub must
+                # find and repair it without any client scrub request
+                osd = next(iter(cluster.osds.values()))
+                key = next((k for k in [(pool, f"o{i}", s)
+                                        for i in range(6)
+                                        for s in range(3)]
+                            if osd._store_read(k) is not None), None)
+                assert key is not None
+                blob, meta = osd._store_read(key)
+                from ceph_tpu.rados.bluestore import Transaction
+                bad = bytearray(blob)
+                bad[0] ^= 0xFF
+                txn = Transaction()
+                txn.write(key, bytes(bad), meta)
+                osd.store.queue_transaction(txn)
+                # wait for the scheduler to sweep every PG at least once
+                scrubs_started = 0
+                for _ in range(100):
+                    if all((pool, pg) in o._last_scrub
+                           for o in cluster.osds.values()
+                           for pg in range(c.osdmap.pools[pool].pg_num)
+                           if o._primary(c.osdmap.pools[pool], pg,
+                                         c.osdmap.pg_to_acting(
+                                             c.osdmap.pools[pool], pg))
+                           == o.osd_id):
+                        scrubs_started = 1
+                        break
+                    await asyncio.sleep(0.1)
+                assert scrubs_started, "scheduler never swept the PGs"
+                # data still reads back (scrub repaired or shards healthy)
+                for i in range(6):
+                    assert len(await c.get(pool, f"o{i}")) == 4000
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
