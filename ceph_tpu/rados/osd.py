@@ -50,6 +50,7 @@ from ceph_tpu.common.perf_counters import PerfCountersBuilder
 from ceph_tpu.ec.interface import ErasureCodeError
 from ceph_tpu.ec.registry import registry
 from ceph_tpu.rados.crush import CRUSH_ITEM_NONE
+from ceph_tpu.rados.extent_cache import ExtentCache
 from ceph_tpu.rados.ecutil import (HashInfo, StripeInfo,
                                    batched_encode_async,
                                    decode_object_async)
@@ -206,6 +207,8 @@ class OSD:
             .add_u64_counter("subop_w", "EC sub-writes applied")
             .add_u64_counter("subop_r", "EC sub-reads served")
             .add_u64_counter("rmw_partial", "stripe-scoped partial overwrites")
+            .add_u64_counter("rmw_extent_hits",
+                             "RMW reads served from the extent cache")
             .add_u64_counter("rmw_read_bytes", "bytes read for stripe RMW")
             .add_u64_counter("recovery_subchunk_bytes",
                              "helper bytes read by sub-chunk repair")
@@ -251,8 +254,7 @@ class OSD:
         self._watchers: Dict[Tuple[int, str], Set[Tuple[str, int]]] = {}
         # primary-side cache of decoded objects pinned across RMW rounds
         # (src/osd/ExtentCache.{h,cc} role)
-        self._extent_cache: "Dict[Tuple[int, str], Tuple[int, bytes]]" = {}
-        self._extent_cache_max = 64
+        self._extent_cache = ExtentCache(max_objects=64)
         # acting set of the last DIFFERENT interval per PG: the set a
         # pg_temp request points the mon at when a remapped PG needs
         # backfill (the data lives with the prior interval's members)
@@ -1346,16 +1348,13 @@ class OSD:
 
     def _cache_put(self, pool_id: int, oid: str, version: int,
                    data: bytes) -> None:
-        cache = self._extent_cache
-        cache[(pool_id, oid)] = (version, data)
-        while len(cache) > self._extent_cache_max:
-            cache.pop(next(iter(cache)))
+        self._extent_cache.put_full((pool_id, oid), version, data)
 
     def _cache_get(self, pool_id: int, oid: str) -> Optional[Tuple[int, bytes]]:
-        return self._extent_cache.get((pool_id, oid))
+        return self._extent_cache.get_full((pool_id, oid))
 
     def _cache_drop(self, pool_id: int, oid: str) -> None:
-        self._extent_cache.pop((pool_id, oid), None)
+        self._extent_cache.drop((pool_id, oid))
 
     def _mark_failed_write(self, reqid: str) -> None:
         if reqid:
@@ -1754,8 +1753,19 @@ class OSD:
                 seg = full[s0:s0 + slen]
                 full_for_cache = full
             else:
-                got = await self._read_stripe_range(op, pool, codec, sinfo,
-                                                    s0, slen)
+                # extent-granular hit (reference ExtentCache pinning): a
+                # prior RMW on an overlapping range left its decoded
+                # stripes here — no shard reads at all
+                ranged = self._extent_cache.get_range(
+                    (op.pool_id, op.oid), s0, slen)
+                got = None
+                if ranged is not None and ranged[2] > 0                         and len(ranged[1]) == slen:
+                    base_version, stripes, old_size = ranged
+                    self.perf.inc("rmw_extent_hits")
+                    got = (old_size, stripes, base_version)
+                else:
+                    got = await self._read_stripe_range(
+                        op, pool, codec, sinfo, s0, slen)
                 if got is not None:
                     old_size, stripes, base_version = got
                     seg_buf = bytearray(stripes)
@@ -1780,20 +1790,23 @@ class OSD:
                 data = seg
                 chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(s0)
                 shard_size = sinfo.logical_to_next_chunk_offset(object_size)
-        # Allocate the PG-log eversion only after every await above: the
-        # RMW reads yield to the loop, and a concurrent log merge (repair
-        # task / unsolicited log reply) advancing the head would invalidate
-        # a version handed out earlier.  From here to the local apply the
-        # path is synchronous, so the head cannot move underneath us.
+        # encode BEFORE allocating the PG-log eversion: the batched encode
+        # awaits the device queue, and the version->local-apply window
+        # below must stay SYNCHRONOUS — a concurrent log merge (repair
+        # task / unsolicited log reply) advancing the head across an await
+        # would invalidate a version handed out earlier.
+        blobs = await batched_encode_async(codec, sinfo, data,
+                                           queue=self._ec_queue)
+        span.event("encoded")
+        hinfo_blob = self._hinfo_for(pool, blobs) if chunk_off < 0 else b""
+        # Allocate the eversion only after every await above; from here to
+        # the local apply the path is synchronous, so the head cannot move
+        # underneath us.
         entry = LogEntry(version=log.next_version(self.osdmap.epoch),
                          op="write", oid=op.oid, prior_version=log.head,
                          reqid=op.reqid)
         version = pack_eversion(entry.version)
         entry.object_version = version
-        blobs = await batched_encode_async(codec, sinfo, data,
-                                           queue=self._ec_queue)
-        span.event("encoded")
-        hinfo_blob = self._hinfo_for(pool, blobs) if chunk_off < 0 else b""
         entry_blob = entry.encode()
         tid = uuid.uuid4().hex
         local_ok = 0
@@ -1851,6 +1864,14 @@ class OSD:
             self._kick_recovery(pool, pg)
         if full_for_cache is not None:
             self._cache_put(op.pool_id, op.oid, version, full_for_cache)
+        elif chunk_off >= 0:
+            # segment RMW: pin the freshly-written stripes at the NEW
+            # version; carry_from upgrades the entry in place (nothing
+            # outside this extent changed — our write made the version)
+            self._extent_cache.put_extent(
+                (op.pool_id, op.oid), version,
+                sinfo.aligned_chunk_offset_to_logical_offset(chunk_off),
+                data, size_hint=object_size, carry_from=base_version)
         else:
             self._cache_drop(op.pool_id, op.oid)
         return MOSDOpReply(ok=True)

@@ -16,10 +16,14 @@ the same shard — and each shard's worker drains a pluggable scheduler:
   sharing) subject to L (limit).  Classes here mirror the reference's:
   client, recovery (background_recovery), best_effort (scrub/snaptrim).
 
-The asyncio translation: shard workers are tasks, not threads; the
-scheduler decides ORDER, the worker awaits each op handler to completion
-before dequeuing the next (the reference's one-op-per-shard-thread-at-a-
-time discipline, which PG lock ordering relies on).
+The asyncio translation: shard workers are tasks, not threads.  The
+scheduler decides ORDER; execution preserves strict ordering only per
+order_key (the PG): ops for the SAME PG run one at a time in dequeue
+order (the PG lock discipline version assignment and log appends rely
+on), while ops for DIFFERENT PGs on one shard overlap up to
+osd_pg_op_concurrency — the reference's pipeline overlap
+(ECBackend.h:557-560) at PG granularity.  Handlers must not assume
+shard-level exclusivity for cross-PG or OSD-global state.
 """
 
 from __future__ import annotations
@@ -44,6 +48,10 @@ class _Item:
     run: Callable[[], Awaitable[None]] = field(compare=False, default=None)
     op_class: str = field(compare=False, default=CLASS_CLIENT)
     cost: int = field(compare=False, default=1)
+    # ops sharing an order_key execute strictly in dequeue order (the
+    # per-PG lock discipline); different keys on one shard may OVERLAP —
+    # the pipelining that keeps the device batching queue fed
+    order_key: Any = field(compare=False, default=None)
 
 
 class WPQScheduler:
@@ -59,11 +67,11 @@ class WPQScheduler:
         self._size = 0
 
     def enqueue(self, op_class: str, run, cost: int = 1,
-                priority: Optional[int] = None) -> None:
+                priority: Optional[int] = None, order_key: Any = None) -> None:
         prio = priority if priority is not None else self.PRIORITIES.get(
             op_class, 1)
         item = _Item(sort_key=(next(_seq),), run=run, op_class=op_class,
-                     cost=cost)
+                     cost=cost, order_key=order_key)
         if prio >= self.STRICT_CUTOFF:
             heapq.heappush(self._strict, item)
         else:
@@ -131,7 +139,7 @@ class MClockScheduler:
         self._size = 0
 
     def enqueue(self, op_class: str, run, cost: int = 1,
-                priority: Optional[int] = None) -> None:
+                priority: Optional[int] = None, order_key: Any = None) -> None:
         c = self.classes.setdefault(
             op_class, _MClockClass(1.0, 1.0, 0.0))
         now = time.monotonic()
@@ -140,7 +148,7 @@ class MClockScheduler:
         c.p_tag = max(c.p_tag + cost / c.weight, now)
         c.l_tag = max(c.l_tag + cost / c.limit, now) if c.limit else 0.0
         item = _Item(sort_key=(c.r_tag, c.p_tag, next(_seq)), run=run,
-                     op_class=op_class, cost=cost)
+                     op_class=op_class, cost=cost, order_key=order_key)
         c.queue.append(item)
         self._size += 1
 
@@ -208,6 +216,9 @@ class ShardedOpQueue:
         from ceph_tpu.common.throttle import Throttle
 
         self._budget = Throttle("opq-cost", max_cost)
+        # per-shard strong refs to spawned op tasks: stop() cancels them,
+        # and asyncio's weak task refs cannot GC one mid-flight
+        self._inflight: List[set] = [set() for _ in range(self.n_shards)]
 
     def start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -219,6 +230,9 @@ class ShardedOpQueue:
         self._stopped = True
         for e in self._events:
             e.set()
+        for tasks in self._inflight:
+            for t in list(tasks):
+                t.cancel()
         for t in self._tasks:
             t.cancel()
 
@@ -230,34 +244,72 @@ class ShardedOpQueue:
         cost = max(1, cost)
         await self._budget.get(cost)  # blocks when queues are full
         shard = self.shard_of(pg_key)
-        self._scheds[shard].enqueue(op_class, run, cost)
+        self._scheds[shard].enqueue(op_class, run, cost, order_key=pg_key)
         if self.perf is not None:
             self.perf.inc("op_queued")
         self._events[shard].set()
 
     async def _drain(self, shard: int) -> None:
+        """Shard worker: ops with the SAME order_key (PG) run strictly in
+        dequeue order (version assignment and log appends rely on it);
+        ops for DIFFERENT PGs overlap up to osd_pg_op_concurrency — the
+        reference's pipeline overlap (ECBackend.h:557-560 three-queue
+        design) at PG granularity, which is what keeps concurrent stripes
+        flowing into the device batching queue instead of serializing
+        behind one PG's commit round-trips."""
         sched = self._scheds[shard]
         event = self._events[shard]
+        width = max(1, int(self.conf.get("osd_pg_op_concurrency", 4) or 1))
+        running: Dict[Any, asyncio.Task] = {}  # order_key -> tail task
+        slots = asyncio.Semaphore(width)
+        inflight = self._inflight[shard]
+
+        async def _run_item(item, after: Optional[asyncio.Task]) -> None:
+            try:
+                if after is not None:
+                    # per-key ordering: wait out the predecessor (its
+                    # failure is its own; ours still runs).  The slot is
+                    # acquired AFTER this wait — queued successors of a
+                    # hot PG must not hold width hostage and starve other
+                    # PGs out of the very overlap this design adds.
+                    await asyncio.gather(after, return_exceptions=True)
+                async with slots:
+                    t0 = time.monotonic()
+                    try:
+                        await item.run()
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
+                    if self.perf is not None:
+                        self.perf.inc("op_dequeued")
+                        self.perf.tinc("op_queue_lat",
+                                       time.monotonic() - t0)
+            finally:
+                # budget was taken at enqueue: released on EVERY exit,
+                # cancellation included (a leaked token would shrink the
+                # queue forever)
+                self._budget.put(item.cost)
+
         while not self._stopped:
             item = sched.dequeue()
             if item is None:
                 event.clear()
                 await event.wait()
                 continue
-            t0 = time.monotonic()
-            try:
-                await item.run()
-            except asyncio.CancelledError:
-                raise
-            except Exception:
-                import traceback
-
-                traceback.print_exc()
-            finally:
-                self._budget.put(item.cost)
-            if self.perf is not None:
-                self.perf.inc("op_dequeued")
-                self.perf.tinc("op_queue_lat", time.monotonic() - t0)
+            key = item.order_key
+            prev = running.get(key)
+            task = asyncio.get_running_loop().create_task(
+                _run_item(item, prev))
+            inflight.add(task)
+            task.add_done_callback(inflight.discard)
+            if key is not None:
+                running[key] = task
+                task.add_done_callback(
+                    lambda t, k=key: running.pop(k, None)
+                    if running.get(k) is t else None)
 
     def depth(self) -> int:
         return sum(len(s) for s in self._scheds)
