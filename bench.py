@@ -77,7 +77,8 @@ def main() -> int:
 
     from ceph_tpu.ec.gf import gf
     from ceph_tpu.ec.matrices import matrix_to_bitmatrix, vandermonde_coding_matrix
-    from ceph_tpu.ops.gf2 import gf2_apply_bytes, pallas_enabled
+    from ceph_tpu.ops.gf2 import (gf2_apply_bytes, gf2_matmul,
+                                  pallas_enabled, unpack_bits_bytes)
 
     mat = vandermonde_coding_matrix(K, M, W)
     bm = matrix_to_bitmatrix(mat, W)
@@ -117,11 +118,24 @@ def main() -> int:
     # at 32 the subtraction left the number swinging 2x run to run
     iters = int(os.environ.get("BENCH_ITERS", "256" if backend == "tpu" else "4"))
 
+    ones_b = jnp.ones((B,), jnp.int8)
+
+    def fold(out, carry):
+        # anti-DCE consumer: a full-width MXU matvec touches every output
+        # column at negligible VPU cost (a plain jnp.sum over the output
+        # is VPU work of the same order as the pack stage and would bias
+        # the packed-vs-planar comparison; a slice would let XLA narrow
+        # the matmul itself)
+        colsum = jax.lax.dot_general(
+            out.astype(jnp.int8), ones_b, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        return carry ^ jnp.sum(colsum)
+
     @jax.jit
     def loop(m, x):
         def body(i, carry):
             out = encode(m, x ^ i.astype(jnp.uint8))
-            return carry ^ jnp.sum(out.astype(jnp.int32))
+            return fold(out, carry)
         return lax.fori_loop(0, iters, body, jnp.int32(0))
 
     int(loop(bmd, d))  # warm / compile
@@ -177,7 +191,7 @@ def main() -> int:
                 mstack, i % mstack.shape[0], keepdims=False)
             out = gf2_apply_bytes(mb, x ^ i.astype(jnp.uint8), W, K,
                                   use_pallas=use_pallas)
-            return carry ^ jnp.sum(out.astype(jnp.int32))
+            return fold(out, carry)
         return lax.fori_loop(0, iters, body, jnp.int32(0))
 
     # correctness gate through the SAME kernel configuration the timed
@@ -202,6 +216,32 @@ def main() -> int:
                           "value": 0, "unit": "GB/s", "vs_baseline": 0}))
         return 1
     dec_gbps = (iters * K * B) / (dec_wall - rtt) / 1e9
+
+    # BIT-PLANAR RESIDENCY: the steady-state rate when shards stay
+    # bit-planar in HBM across the pipeline and pack/unpack is paid once
+    # at the host boundary (ops/gf2.py writeup) — the matmul-only rate,
+    # the ceiling a residency-aware EC service reaches.
+    bits = jax.jit(lambda x: unpack_bits_bytes(x, W))(d)
+    bits.block_until_ready()
+
+    @jax.jit
+    def planar_loop(m, xb):
+        def body(i, carry):
+            x = xb ^ (i & 1).astype(jnp.int8)  # vary input, stay 0/1
+            out = gf2_matmul(m, x)
+            return fold(out, carry)
+        return lax.fori_loop(0, iters, body, jnp.int32(0))
+
+    int(planar_loop(bmd, bits))  # warm
+    t0 = time.perf_counter()
+    int(planar_loop(bmd, bits))
+    planar_wall = time.perf_counter() - t0
+    if planar_wall <= rtt * 1.05:
+        print(json.dumps({"metric": "measurement_invalid_rtt_dominated",
+                          "value": 0, "unit": "GB/s", "vs_baseline": 0}))
+        return 1
+    planar_gbps = (iters * K * B) / (planar_wall - rtt) / 1e9
+    del bits
 
     # CPU A/B baseline: the native C++ jerasure-equivalent codec (same
     # matrices, byte-identical output).  The default build vectorizes the
@@ -356,6 +396,7 @@ def main() -> int:
         "unit": "GB/s",
         "vs_baseline": round(gbps / cpu_gbps, 2),
         "ec_decode_GBps": round(dec_gbps, 3),
+        "ec_encode_bitplanar_GBps": round(planar_gbps, 3),
         "baseline_GBps": round(cpu_gbps, 3),
         "baseline_kind": f"native-{simd_kind}",
         "baseline_socket_GBps": round(socket_gbps, 3),

@@ -21,6 +21,30 @@ Two data layouts feed it (see ceph_tpu/ec/codecs.py):
 The pure-XLA path below is correct everywhere (CPU tests included); the
 Pallas kernel (ceph_tpu/ops/pallas_gf2.py) fuses unpack+matmul+pack in VMEM
 to avoid materializing the 8x-expanded bit arrays in HBM.
+
+BIT-PLANAR RESIDENCY (measured, v5e, k=8 m=3, 8 MiB batches, 256 encodes
+per timed dispatch, tunnel RTT subtracted):
+
+    packed-resident (unpack+matmul+pack per dispatch) .... 48.6 GB/s
+    bit-planar resident (matmul only per dispatch) ....... 76.3 GB/s
+    planar input, packed output .......................... 47.1 GB/s
+
+(Those three used a full jnp.sum anti-DCE consumer; with the cheaper
+MXU-matvec consumer the bench records ~55 packed vs ~93 planar — same
+~1.6-1.7x conclusion, slightly higher absolutes.)
+
+Keeping shards bit-planar in HBM across the pipeline — pack/unpack paid
+once at the host/wire boundary — is worth ~1.57x.  The middle row
+pinpoints WHERE: unpack fuses into the matmul almost for free, while the
+output PACK (8 int32 plane-shifts + adds per byte) is the dominant VPU
+stage; eliminating it is the entire win.  The 8x HBM footprint/traffic of
+planar residency does not bite at these sizes (consistent with the
+round-2 roofline finding that the op sits far below HBM bandwidth).
+Adopting residency end-to-end requires the EC service to keep device
+buffers planar between encode, decode, and recovery and pack only when
+bytes leave for the wire — a chip-local-deployment optimization recorded
+here with the measured ceiling; bench.py reports it as
+ec_encode_bitplanar_GBps alongside the packed-boundary headline.
 """
 
 from __future__ import annotations
