@@ -373,3 +373,172 @@ class FileSystem:
             else:
                 out[name] = st.get("size", 0)
         return out
+
+
+# -- client sessions + capabilities (reference src/mds/SessionMap.h,
+#    src/mds/Locker.cc caps/lease machinery) ---------------------------------
+
+
+class CapConflict(FsError):
+    """The cap is held by a live conflicting session (retry after the
+    holder releases, acks the revoke, or its lease lapses)."""
+
+
+class MDSSession:
+    """One client's stateful session (reference Session): identity, a
+    renewable lease, the caps it holds, and a revoke queue the client is
+    expected to drain (ack) — exactly the contract CephFS clients follow."""
+
+    def __init__(self, client: str, session_id: str, ttl: float):
+        self.client = client
+        self.session_id = session_id
+        self.ttl = ttl
+        self.renewed = time.monotonic()
+        self.caps: Dict[str, str] = {}  # path -> "r" | "rw"
+        self.revoked: List[str] = []  # paths the MDS wants back
+
+    @property
+    def expired(self) -> bool:
+        return time.monotonic() - self.renewed > self.ttl
+
+    def renew(self) -> List[str]:
+        """Refresh the lease; returns (and clears) pending revokes — the
+        client must stop using those paths and release_cap() them."""
+        self.renewed = time.monotonic()
+        out, self.revoked = self.revoked, []
+        return out
+
+
+class MDSServer:
+    """Session/caps gatekeeper over a FileSystem (reference mds Server +
+    Locker in miniature): clients open sessions, acquire read (shared) or
+    rw (exclusive) capabilities per path, and operate through the server,
+    which enforces that the needed cap is held and live.  Conflicting
+    grants revoke the loser: live holders get the path queued on their
+    revoke list and the requester is refused with CapConflict until the
+    holder releases or its lease lapses (session autoclose role).
+
+    Divergence by design: single active MDS, path-granular caps (the
+    reference's are per-inode with Fw/Fr/Fx bit splits), no subtree
+    migration."""
+
+    def __init__(self, fs: FileSystem, session_timeout: float = 60.0):
+        self.fs = fs
+        self.session_timeout = session_timeout
+        self.sessions: Dict[str, MDSSession] = {}
+        # path -> {session_id: mode}
+        self._caps: Dict[str, Dict[str, str]] = {}
+
+    # -- session lifecycle ---------------------------------------------------
+
+    def open_session(self, client: str) -> MDSSession:
+        s = MDSSession(client, uuid.uuid4().hex, self.session_timeout)
+        self.sessions[s.session_id] = s
+        return s
+
+    def close_session(self, session_id: str) -> None:
+        s = self.sessions.pop(session_id, None)
+        if s is None:
+            return
+        for path in list(s.caps):
+            self._drop(path, session_id)
+
+    def _evict_if_dead(self, session_id: str) -> bool:
+        s = self.sessions.get(session_id)
+        if s is None:
+            return True
+        if s.expired:
+            self.close_session(session_id)  # autoclose: caps released
+            return True
+        return False
+
+    def _drop(self, path: str, session_id: str) -> None:
+        holders = self._caps.get(path)
+        if holders:
+            holders.pop(session_id, None)
+            if not holders:
+                self._caps.pop(path, None)
+        s = self.sessions.get(session_id)
+        if s:
+            s.caps.pop(path, None)
+
+    # -- capabilities --------------------------------------------------------
+
+    def acquire_cap(self, session: MDSSession, path: str,
+                    mode: str = "r") -> None:
+        """Grant `mode` on `path` or raise CapConflict.  "r" caps are
+        shared; "rw" is exclusive.  Conflicting live holders get the path
+        queued for revoke (they learn at their next renew()); dead
+        holders are evicted on the spot."""
+        assert mode in ("r", "rw")
+        if self._evict_if_dead(session.session_id):
+            raise FsError("ESTALE: session expired")
+        path = FileSystem._norm(path)
+        conflict = False
+        for sid, held in list(self._caps.get(path, {}).items()):
+            if sid == session.session_id:
+                continue
+            if mode == "r" and held == "r":
+                continue  # shared read
+            if self._evict_if_dead(sid):
+                continue
+            # live conflicting holder: ask for the cap back, refuse now
+            other = self.sessions[sid]
+            if path not in other.revoked:
+                other.revoked.append(path)
+            conflict = True
+        if conflict:
+            raise CapConflict(f"EAGAIN: cap on {path} held elsewhere")
+        # re-fetch AFTER evictions: evicting the last holder pops the
+        # path's dict from _caps, and granting into the detached dict
+        # would make the cap invisible to later conflict checks
+        holders = self._caps.setdefault(path, {})
+        holders[session.session_id] = mode
+        session.caps[path] = mode
+
+    def release_cap(self, session: MDSSession, path: str) -> None:
+        self._drop(FileSystem._norm(path), session.session_id)
+
+    def _require(self, session: MDSSession, path: str, mode: str) -> None:
+        if self._evict_if_dead(session.session_id):
+            raise FsError("ESTALE: session expired")
+        path = FileSystem._norm(path)
+        held = session.caps.get(path)
+        if held is None or (mode == "rw" and held != "rw"):
+            # implicit acquisition, as clients do on open
+            self.acquire_cap(session, path, mode)
+        elif path in session.revoked:
+            raise FsError(f"ESTALE: cap on {path} revoked; renew first")
+
+    # -- capped operations (the libcephfs-style surface) ---------------------
+
+    async def write_file(self, session: MDSSession, path: str,
+                         data: bytes) -> None:
+        self._require(session, path, "rw")
+        await self.fs.write_file(path, data)
+
+    async def read_file(self, session: MDSSession, path: str) -> bytes:
+        self._require(session, path, "r")
+        return await self.fs.read_file(path)
+
+    async def mkdir(self, session: MDSSession, path: str) -> None:
+        self._require(session, path, "rw")
+        await self.fs.mkdir(path)
+
+    async def unlink(self, session: MDSSession, path: str) -> None:
+        self._require(session, path, "rw")
+        await self.fs.unlink(path)
+        self._drop(FileSystem._norm(path), session.session_id)
+
+    async def rename(self, session: MDSSession, src: str, dst: str) -> None:
+        self._require(session, src, "rw")
+        self._require(session, dst, "rw")
+        await self.fs.rename(src, dst)
+
+    async def listdir(self, session: MDSSession, path: str) -> List[str]:
+        self._require(session, path, "r")
+        return await self.fs.listdir(path)
+
+    async def stat(self, session: MDSSession, path: str) -> Dict:
+        self._require(session, path, "r")
+        return await self.fs.stat(path)

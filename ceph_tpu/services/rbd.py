@@ -27,9 +27,11 @@ the parent link; ``snap_unprotect`` refuses while children exist (tracked
 in a pool-level ``rbd_children`` registry, the reference's cls_rbd
 children object).
 
-Divergence by design: no mirroring/journaling — the extent-to-object
-data path, object-map bookkeeping, snap COW, and the clone layer are the
-core being reproduced.
+Journaling + mirroring (reference journal feature, src/journal/
+Journaler.h, and the rbd-mirror daemon): a JournaledImage appends every
+mutation to a per-image segmented journal BEFORE applying it, and a
+Mirrorer replays those events into a peer pool's image resumably (the
+replay position persists with the peer), expiring replayed segments.
 """
 
 from __future__ import annotations
@@ -403,8 +405,13 @@ class RBD:
     async def open(self, name: str) -> Image:
         try:
             raw = await self.ioctx.read(Image._header_oid(name))
-        except RadosError:
-            raise RbdError(f"image {name!r} does not exist")
+        except RadosError as e:
+            # only typed absence means "no image": a transient failure
+            # must surface, or callers (the mirrorer!) would treat a
+            # blip as image-gone and recreate over live data
+            if e.code == -errno.ENOENT:
+                raise RbdError(f"image {name!r} does not exist")
+            raise
         return Image(self.ioctx, name, json.loads(raw))
 
     CHILDREN_OID = "rbd_children"  # pool-level clone registry (cls_rbd role)
@@ -490,3 +497,213 @@ class RBD:
         prefix = "rbd_header."
         return sorted(o[len(prefix):] for o in await self.ioctx.list_objects()
                       if o.startswith(prefix))
+
+
+# -- image journaling + mirroring (reference src/journal/Journaler.h,
+#    src/librbd/mirror/, the rbd-mirror daemon) ------------------------------
+
+
+class ImageJournal:
+    """Per-image write journal (reference journal feature / Journaler):
+    every mutating op appends an event BEFORE it applies, into
+    length-capped journal segments; a mirror peer replays the events in
+    order to reproduce the image bit-for-bit.  Events carry a
+    monotonically increasing entry id so replay is resumable and
+    idempotent (the mirror records its replay position)."""
+
+    SEGMENT_EVENTS = 256
+
+    def __init__(self, ioctx: IoCtx, image_id: str):
+        self.ioctx = ioctx
+        self.image_id = image_id
+        # appends are read-modify-writes of the segment + head objects:
+        # serialized per journal instance.  Cross-INSTANCE writers are the
+        # reference's exclusive-lock feature's job (one journaling writer
+        # per image at a time); this mirrors that single-writer contract.
+        self._append_lock = asyncio.Lock()
+
+    def _head_oid(self) -> str:
+        return f"journal.{self.image_id}.head"
+
+    def _seg_oid(self, seg: int) -> str:
+        return f"journal.{self.image_id}.{seg:08d}"
+
+    async def _load_head(self) -> Dict:
+        try:
+            return json.loads(await self.ioctx.read(self._head_oid()))
+        except RadosError as e:
+            if e.code != -errno.ENOENT:
+                raise
+            return {"next_id": 0, "write_seg": 0, "expire_seg": 0}
+
+    async def append(self, event: Dict) -> int:
+        """Append one event; returns its entry id."""
+        async with self._append_lock:
+            return await self._append_locked(event)
+
+    async def _append_locked(self, event: Dict) -> int:
+        head = await self._load_head()
+        seg = head["write_seg"]
+        oid = self._seg_oid(seg)
+        try:
+            events = json.loads(await self.ioctx.read(oid))
+        except RadosError as e:
+            if e.code != -errno.ENOENT:
+                raise
+            events = []
+        event = dict(event)
+        event["id"] = head["next_id"]
+        events.append(event)
+        await self.ioctx.write_full(oid, json.dumps(events).encode())
+        head["next_id"] += 1
+        if len(events) >= self.SEGMENT_EVENTS:
+            head["write_seg"] += 1
+        await self.ioctx.write_full(self._head_oid(),
+                                    json.dumps(head).encode())
+        return event["id"]
+
+    async def events_after(self, last_id: int) -> List[Dict]:
+        """Every event with id > last_id, in order."""
+        head = await self._load_head()
+        out: List[Dict] = []
+        for seg in range(head["expire_seg"], head["write_seg"] + 1):
+            try:
+                events = json.loads(await self.ioctx.read(self._seg_oid(seg)))
+            except RadosError as e:
+                if e.code != -errno.ENOENT:
+                    raise
+                continue
+            out.extend(ev for ev in events if ev["id"] > last_id)
+        return out
+
+    async def expire_through(self, entry_id: int) -> None:
+        """Drop whole segments whose every event id <= entry_id (mirror
+        peers record their positions; the caller passes the minimum)."""
+        head = await self._load_head()
+        seg = head["expire_seg"]
+        changed = False
+        while seg < head["write_seg"]:
+            try:
+                events = json.loads(await self.ioctx.read(self._seg_oid(seg)))
+            except RadosError as e:
+                if e.code != -errno.ENOENT:
+                    raise
+                events = []
+            if events and events[-1]["id"] > entry_id:
+                break
+            try:
+                await self.ioctx.remove(self._seg_oid(seg))
+            except RadosError:
+                pass
+            seg += 1
+            changed = True
+        if changed:
+            head["expire_seg"] = seg
+            await self.ioctx.write_full(self._head_oid(),
+                                        json.dumps(head).encode())
+
+
+class JournaledImage:
+    """An Image whose writes/resizes journal before applying (the rbd
+    journaling feature): wrap an open Image; mutations append an event,
+    then apply.  Reads pass through."""
+
+    def __init__(self, image: Image):
+        self.image = image
+        self.journal = ImageJournal(image.ioctx, image._hdr["id"])
+
+    @property
+    def size(self) -> int:
+        return self.image.size
+
+    async def write(self, offset: int, data: bytes) -> None:
+        # validate BEFORE journaling: a write the primary would refuse
+        # must never reach the journal, or the mirror (which auto-grows)
+        # would apply bytes the primary never accepted
+        if offset + len(data) > self.image.size:
+            raise RbdError("write beyond image size (resize first)")
+        await self.journal.append({"op": "write", "offset": offset,
+                                   "data": data.hex()})
+        await self.image.write(offset, data)
+
+    async def resize(self, new_size: int) -> None:
+        await self.journal.append({"op": "resize", "size": new_size})
+        await self.image.resize(new_size)
+
+    async def read(self, offset: int, length: int) -> bytes:
+        return await self.image.read(offset, length)
+
+
+class Mirrorer:
+    """rbd-mirror daemon role (reference src/librbd/mirror/ +
+    src/tools/rbd_mirror): replays a primary image's journal into a
+    peer image, resumably — the replay position persists in the peer
+    pool so a restarted mirrorer continues where it left off."""
+
+    def __init__(self, src_ioctx: IoCtx, dst_ioctx: IoCtx):
+        self.src = src_ioctx
+        self.dst = dst_ioctx
+
+    def _pos_oid(self, image_id: str) -> str:
+        return f"rbd_mirror.pos.{image_id}"
+
+    def _peers_oid(self, image_id: str) -> str:
+        # lives in the SRC pool: every peer's replay position, so journal
+        # expiry advances only past what EVERY registered peer replayed
+        return f"rbd_mirror.peers.{image_id}"
+
+    async def _load_pos(self, image_id: str) -> int:
+        try:
+            return json.loads(await self.dst.read(self._pos_oid(image_id)))
+        except RadosError as e:
+            if e.code != -errno.ENOENT:
+                raise
+            return -1
+
+    async def _update_peer_positions(self, image_id: str,
+                                     pos: int) -> int:
+        """Record this peer's position in the src pool; returns the
+        MINIMUM across peers (the safe expiry floor)."""
+        oid = self._peers_oid(image_id)
+        try:
+            peers = json.loads(await self.src.read(oid))
+        except RadosError as e:
+            if e.code != -errno.ENOENT:
+                raise
+            peers = {}
+        peers[f"pool{self.dst.pool_id}"] = pos
+        await self.src.write_full(oid, json.dumps(peers).encode())
+        return min(peers.values())
+
+    async def replay(self, name: str) -> int:
+        """Replay new journal events of src image `name` into the dst
+        pool's image of the same name (created on first replay).
+        Returns the number of events applied."""
+        src_img = await RBD(self.src).open(name)
+        journal = ImageJournal(self.src, src_img._hdr["id"])
+        dst_rbd = RBD(self.dst)
+        try:
+            dst_img = await dst_rbd.open(name)
+        except RbdError:
+            dst_img = await dst_rbd.create(
+                name, src_img.size, order=src_img._hdr["order"])
+        pos = await self._load_pos(src_img._hdr["id"])
+        events = await journal.events_after(pos)
+        applied = 0
+        for ev in events:
+            if ev["op"] == "write":
+                data = bytes.fromhex(ev["data"])
+                if ev["offset"] + len(data) > dst_img.size:
+                    await dst_img.resize(ev["offset"] + len(data))
+                await dst_img.write(ev["offset"], data)
+            elif ev["op"] == "resize":
+                await dst_img.resize(ev["size"])
+            pos = ev["id"]
+            applied += 1
+        if applied:
+            await self.dst.write_full(self._pos_oid(src_img._hdr["id"]),
+                                      json.dumps(pos).encode())
+            floor = await self._update_peer_positions(
+                src_img._hdr["id"], pos)
+            await journal.expire_through(floor)
+        return applied

@@ -148,6 +148,29 @@ class RgwService:
             raise RadosError(f"NoSuchBucket: {bucket}")
         return index
 
+    async def delete_bucket(self, bucket: str) -> None:
+        """Delete an EMPTY bucket (both S3 and Swift refuse non-empty
+        deletion: BucketNotEmpty / 409 Conflict)."""
+        index = await self._load_index(bucket)
+        if index is None:
+            raise RadosError(f"NoSuchBucket: {bucket}")
+        if index:
+            raise RadosError(f"BucketNotEmpty: {bucket}")
+        prefix = f".upload.{bucket}."
+        uploads = [o for o in await self.ioctx.list_objects()
+                   if o.startswith(prefix)]
+        if uploads:
+            # the reference refuses deletion while multipart uploads are
+            # in flight; allowing it would orphan every part object
+            raise RadosError(f"BucketNotEmpty: {bucket} has "
+                             f"{len(uploads)} multipart upload(s) in flight")
+        await self.ioctx.remove(self._index_oid(bucket))
+        buckets = await self.list_buckets()
+        if bucket in buckets:
+            buckets.remove(bucket)
+            await self.ioctx.write_full(
+                BUCKETS_ROOT, json.dumps(sorted(buckets)).encode())
+
     # -- multipart (reference rgw multipart upload machinery) ---------------
 
     @staticmethod
@@ -302,12 +325,22 @@ def verify_request(credentials: Dict[str, str], method: str, path: str,
 
 
 class RgwFrontend:
-    """Minimal HTTP frontend (beast role): newline-framed HTTP/1.1."""
+    """Minimal HTTP frontend (beast role): newline-framed HTTP/1.1.
+
+    Serves BOTH API dialects the reference gateway does: the S3-style
+    routes (bucket/key paths, SigV4 when credentials are set) and the
+    Swift API (reference src/rgw/rgw_rest_swift.h): tempauth-style token
+    issue at /auth/v1.0 (X-Auth-User/X-Auth-Key -> X-Auth-Token +
+    X-Storage-Url) and /v1/AUTH_<account>/<container>/<object> routes
+    over the same bucket/object backend."""
 
     def __init__(self, service: RgwService):
         self.service = service
         self._server: Optional[asyncio.AbstractServer] = None
         self.addr: Optional[Tuple[str, int]] = None
+        # Swift tempauth tokens: token -> account (credentials doubles as
+        # the user->key table, as the reference's tempauth does)
+        self._swift_tokens: Dict[str, str] = {}
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
         self._server = await asyncio.start_server(self._serve, host, port)
@@ -352,7 +385,11 @@ class RgwFrontend:
                     body = await reader.readexactly(length)
                 url = urlsplit(target)
                 path, query = unquote(url.path), url.query
-                if (self.service.credentials
+                extra: Dict[str, str] = {}
+                if path == "/auth/v1.0" or path.startswith("/v1/"):
+                    status, payload, extra = await self._route_swift(
+                        method, path, query, body, headers)
+                elif (self.service.credentials
                         and not verify_request(self.service.credentials,
                                                method, path, query, headers,
                                                body)):
@@ -360,14 +397,102 @@ class RgwFrontend:
                 else:
                     status, payload = await self._route(method, path, query,
                                                         body)
+                hdr_lines = "".join(f"{k}: {v}\r\n" for k, v in extra.items())
                 writer.write(
                     f"HTTP/1.1 {status}\r\nContent-Length: {len(payload)}\r\n"
+                    f"{hdr_lines}"
                     f"Connection: keep-alive\r\n\r\n".encode() + payload)
                 await writer.drain()
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         finally:
             writer.close()
+
+    async def _route_swift(self, method: str, path: str, query: str,
+                           body: bytes, headers: Dict[str, str]
+                           ) -> Tuple[str, bytes, Dict[str, str]]:
+        """Swift dialect (reference rgw_rest_swift.h).  Containers map to
+        buckets, objects to keys; accounts are authentication scope only
+        (one backing store, as the reference's rados driver)."""
+        if path == "/auth/v1.0":
+            # tempauth: user "acct:user" + key -> token + storage URL
+            if not self.service.credentials:
+                return "501 Not Implemented", b"no credentials configured", {}
+            user = headers.get("x-auth-user", "")
+            key = headers.get("x-auth-key", "")
+            acct = user.split(":")[0] if user else ""
+            want = self.service.credentials.get(user) \
+                or self.service.credentials.get(acct)
+            if want is None or not hmac.compare_digest(want, key):
+                return "401 Unauthorized", b"", {}
+            token = "AUTH_tk" + uuid.uuid4().hex
+            self._swift_tokens[token] = acct or user
+            host, port = self.addr or ("127.0.0.1", 0)
+            return "200 OK", b"", {
+                "X-Auth-Token": token,
+                "X-Storage-Token": token,
+                "X-Storage-Url": f"http://{host}:{port}/v1/AUTH_{acct or user}",
+            }
+        if self.service.credentials:
+            token = headers.get("x-auth-token", "")
+            if token not in self._swift_tokens:
+                return "401 Unauthorized", b"", {}
+        parts = [p for p in path.split("/") if p]
+        # parts = ["v1", "AUTH_acct", container?, object...]
+        if len(parts) < 2 or not parts[1].startswith("AUTH_"):
+            return "400 Bad Request", b"", {}
+        try:
+            if len(parts) == 2:  # account: list containers
+                if method in ("GET", "HEAD"):
+                    names = await self.service.list_buckets()
+                    extra = {"X-Account-Container-Count": str(len(names))}
+                    if method == "HEAD":
+                        return "204 No Content", b"", extra
+                    return "200 OK", "\n".join(names).encode(), extra
+                return "405 Method Not Allowed", b"", {}
+            container = parts[2]
+            if len(parts) == 3:
+                if method == "PUT":
+                    await self.service.create_bucket(container)
+                    return "201 Created", b"", {}
+                if method in ("GET", "HEAD"):
+                    index = await self.service.list_objects(container)
+                    extra = {"X-Container-Object-Count": str(len(index)),
+                             "X-Container-Bytes-Used": str(sum(
+                                 e.get("size", 0) for e in index.values()))}
+                    if method == "HEAD":
+                        return "204 No Content", b"", extra
+                    return "200 OK", "\n".join(sorted(index)).encode(), extra
+                if method == "DELETE":
+                    await self.service.delete_bucket(container)
+                    return "204 No Content", b"", {}
+                return "405 Method Not Allowed", b"", {}
+            key = "/".join(parts[3:])
+            if method == "PUT":
+                await self.service.put_object(container, key, body)
+                etag = hashlib.md5(body).hexdigest()
+                return "201 Created", b"", {"ETag": etag}
+            if method == "GET":
+                data = await self.service.get_object(container, key)
+                return "200 OK", data, {}
+            if method == "HEAD":
+                index = await self.service.list_objects(container)
+                if key in index:
+                    return "200 OK", b"", {
+                        "Content-Length-Hint": str(index[key].get("size", 0)),
+                        "ETag": index[key].get("etag", "")}
+                return "404 Not Found", b"", {}
+            if method == "DELETE":
+                await self.service.delete_object(container, key)
+                return "204 No Content", b"", {}
+            return "405 Method Not Allowed", b"", {}
+        except RadosError as e:
+            msg = str(e)
+            if "NoSuch" in msg:
+                return "404 Not Found", msg.encode(), {}
+            if "BucketNotEmpty" in msg:
+                return "409 Conflict", msg.encode(), {}
+            return "500 Internal Server Error", msg.encode(), {}
 
     async def _route(self, method: str, path: str, query: str,
                      body: bytes) -> Tuple[str, bytes]:
@@ -387,6 +512,9 @@ class RgwFrontend:
                 if method == "GET":
                     return "200 OK", json.dumps(
                         await self.service.list_objects(bucket)).encode()
+                if method == "DELETE":
+                    await self.service.delete_bucket(bucket)
+                    return "204 No Content", b""
                 return "405 Method Not Allowed", b""
             key = "/".join(parts[1:])
             if method == "POST" and "uploads" in q:
