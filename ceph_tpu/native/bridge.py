@@ -72,6 +72,11 @@ def _configure(_lib: ctypes.CDLL) -> None:
     ]
     _lib.ceph_tpu_simd_kind.restype = ctypes.c_char_p
     _lib.ceph_tpu_simd_kind.argtypes = []
+    _lib.ceph_tpu_gf_apply.restype = ctypes.c_int
+    _lib.ceph_tpu_gf_apply.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+    ]
     _lib.ceph_tpu_rs_encode_mt.restype = ctypes.c_int
     _lib.ceph_tpu_rs_encode_mt.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
@@ -111,6 +116,26 @@ def rs_encode(technique: str, data: np.ndarray, m: int) -> np.ndarray:
     if rc != 0:
         raise RuntimeError(f"native encode failed ({rc})")
     return parity
+
+
+def gf_apply(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """out[rows, chunk] = matrix[rows, cols] (x) data[cols, chunk] over
+    GF(2^8) with the vectorized region kernels — the codec _apply seam's
+    native fast path (any matrix: generator, inverted decode, recovery)."""
+    rows, cols = matrix.shape
+    k2, chunk = data.shape
+    assert cols == k2, (matrix.shape, data.shape)
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    out = np.zeros((rows, chunk), dtype=np.uint8)
+    rc = lib().ceph_tpu_gf_apply(
+        matrix.ctypes.data_as(ctypes.c_char_p), rows, cols,
+        data.ctypes.data_as(ctypes.c_char_p),
+        out.ctypes.data_as(ctypes.c_char_p), chunk,
+    )
+    if rc != 0:
+        raise RuntimeError(f"native gf_apply failed ({rc})")
+    return out
 
 
 def rs_encode_mt(technique: str, data: np.ndarray, m: int,

@@ -495,9 +495,16 @@ class MDSServer:
         holders = self._caps.setdefault(path, {})
         holders[session.session_id] = mode
         session.caps[path] = mode
+        if path in session.revoked:
+            session.revoked.remove(path)  # fresh grant supersedes
 
     def release_cap(self, session: MDSSession, path: str) -> None:
-        self._drop(FileSystem._norm(path), session.session_id)
+        path = FileSystem._norm(path)
+        self._drop(path, session.session_id)
+        # releasing IS complying with a pending revoke: a later fresh
+        # grant must not trip over the stale revocation marker
+        if path in session.revoked:
+            session.revoked.remove(path)
 
     def _require(self, session: MDSSession, path: str, mode: str) -> None:
         if self._evict_if_dead(session.session_id):

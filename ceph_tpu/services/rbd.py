@@ -395,8 +395,12 @@ class RBD:
         try:
             await self.ioctx.read(hdr_oid)
             raise RbdError(f"image {name!r} exists")
-        except RadosError:
-            pass
+        except RadosError as e:
+            # only typed absence clears the way: a transient read failure
+            # must not let create() overwrite a LIVE header (orphaning its
+            # data objects and journal) — same discipline as open()
+            if e.code != -errno.ENOENT:
+                raise
         header = {"id": uuid.uuid4().hex[:12], "size": size, "order": order,
                   "object_map": []}
         await self.ioctx.write_full(hdr_oid, json.dumps(header).encode())
@@ -553,20 +557,34 @@ class ImageJournal:
             events = []
         event = dict(event)
         event["id"] = head["next_id"]
-        events.append(event)
-        await self.ioctx.write_full(oid, json.dumps(events).encode())
+        # persist the HEAD (id reservation + per-segment first-id index)
+        # BEFORE the segment: a crash between the two leaves an unused id
+        # (a harmless gap) — the reverse order would REUSE an id after
+        # restart, and a mirror already past it would skip the event
+        # silently forever
         head["next_id"] += 1
-        if len(events) >= self.SEGMENT_EVENTS:
+        head.setdefault("seg_first", {}).setdefault(str(seg), event["id"])
+        if len(events) + 1 >= self.SEGMENT_EVENTS:
             head["write_seg"] += 1
         await self.ioctx.write_full(self._head_oid(),
                                     json.dumps(head).encode())
+        events.append(event)
+        await self.ioctx.write_full(oid, json.dumps(events).encode())
         return event["id"]
 
     async def events_after(self, last_id: int) -> List[Dict]:
-        """Every event with id > last_id, in order."""
+        """Every event with id > last_id, in order.  The per-segment
+        first-id index in the head lets the scan skip fully-replayed
+        segments instead of re-reading the whole unexpired journal."""
         head = await self._load_head()
         out: List[Dict] = []
+        start = head["expire_seg"]
+        seg_first = head.get("seg_first", {})
         for seg in range(head["expire_seg"], head["write_seg"] + 1):
+            first = seg_first.get(str(seg))
+            if first is not None and first <= last_id:
+                start = seg  # last_id lies at/after this segment's start
+        for seg in range(start, head["write_seg"] + 1):
             try:
                 events = json.loads(await self.ioctx.read(self._seg_oid(seg)))
             except RadosError as e:
@@ -688,6 +706,20 @@ class Mirrorer:
             dst_img = await dst_rbd.create(
                 name, src_img.size, order=src_img._hdr["order"])
         pos = await self._load_pos(src_img._hdr["id"])
+        if pos < 0:
+            # first contact (rbd-mirror initial image sync): journal
+            # events before now may already be expired for other peers,
+            # so copy the CURRENT image content, then tail the journal
+            # from the newest reserved id
+            head = await journal._load_head()
+            content = await src_img.read(0, src_img.size)
+            if dst_img.size != src_img.size:
+                await dst_img.resize(src_img.size)
+            await dst_img.write(0, content)
+            pos = head["next_id"] - 1
+            await self.dst.write_full(self._pos_oid(src_img._hdr["id"]),
+                                      json.dumps(pos).encode())
+            await self._update_peer_positions(src_img._hdr["id"], pos)
         events = await journal.events_after(pos)
         applied = 0
         for ev in events:

@@ -35,6 +35,7 @@ import errno
 import hashlib
 import hmac
 import json
+import time
 import uuid
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qsl, unquote, urlsplit
@@ -156,9 +157,9 @@ class RgwService:
             raise RadosError(f"NoSuchBucket: {bucket}")
         if index:
             raise RadosError(f"BucketNotEmpty: {bucket}")
-        prefix = f".upload.{bucket}."
         uploads = [o for o in await self.ioctx.list_objects()
-                   if o.startswith(prefix)]
+                   if o.startswith(".upload.")
+                   and o.rsplit(".", 1)[0] == f".upload.{bucket}"]
         if uploads:
             # the reference refuses deletion while multipart uploads are
             # in flight; allowing it would orphan every part object
@@ -338,9 +339,10 @@ class RgwFrontend:
         self.service = service
         self._server: Optional[asyncio.AbstractServer] = None
         self.addr: Optional[Tuple[str, int]] = None
-        # Swift tempauth tokens: token -> account (credentials doubles as
-        # the user->key table, as the reference's tempauth does)
-        self._swift_tokens: Dict[str, str] = {}
+        # Swift tempauth tokens: token -> (account, issued_monotonic);
+        # TTL-bounded and size-capped (reference tempauth tokens expire)
+        self._swift_tokens: Dict[str, Tuple[str, float]] = {}
+        self.swift_token_ttl = 3600.0
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
         self._server = await asyncio.start_server(self._serve, host, port)
@@ -425,8 +427,14 @@ class RgwFrontend:
                 or self.service.credentials.get(acct)
             if want is None or not hmac.compare_digest(want, key):
                 return "401 Unauthorized", b"", {}
+            now = time.monotonic()
+            for t, (_a, issued) in list(self._swift_tokens.items()):
+                if now - issued > self.swift_token_ttl:
+                    self._swift_tokens.pop(t, None)
             token = "AUTH_tk" + uuid.uuid4().hex
-            self._swift_tokens[token] = acct or user
+            self._swift_tokens[token] = (acct or user, now)
+            while len(self._swift_tokens) > 10_000:
+                self._swift_tokens.pop(next(iter(self._swift_tokens)))
             host, port = self.addr or ("127.0.0.1", 0)
             return "200 OK", b"", {
                 "X-Auth-Token": token,
@@ -435,7 +443,9 @@ class RgwFrontend:
             }
         if self.service.credentials:
             token = headers.get("x-auth-token", "")
-            if token not in self._swift_tokens:
+            entry = self._swift_tokens.get(token)
+            if entry is None or                     time.monotonic() - entry[1] > self.swift_token_ttl:
+                self._swift_tokens.pop(token, None)
                 return "401 Unauthorized", b"", {}
         parts = [p for p in path.split("/") if p]
         # parts = ["v1", "AUTH_acct", container?, object...]
@@ -559,6 +569,8 @@ class RgwFrontend:
             msg = str(e)
             if "NoSuch" in msg:
                 return "404 Not Found", msg.encode()
+            if "BucketNotEmpty" in msg:
+                return "409 Conflict", msg.encode()
             if "InvalidPart" in msg:
                 return "400 Bad Request", msg.encode()
             return "500 Internal Server Error", msg.encode()

@@ -111,6 +111,32 @@ int ceph_tpu_rs_encode_mt(const char* technique, int k, int m,
   }
 }
 
+// Apply an ARBITRARY GF(2^8) matrix to symbol regions: out[rows x chunk]
+// = M[rows x cols] (x) data[cols x chunk].  This is the codec _apply
+// seam — encode (generator), decode (inverted signature matrix), and
+// recovery all ride it, so the daemon's CPU path gets the vectorized
+// region kernels for every matrix, not just named techniques.
+int ceph_tpu_gf_apply(const uint8_t* matrix, int rows, int cols,
+                      const uint8_t* data, uint8_t* out, size_t chunk) {
+  try {
+    Matrix mat(rows, std::vector<uint8_t>(cols));
+    for (int r = 0; r < rows; ++r)
+      for (int c = 0; c < cols; ++c)
+        mat[r][c] = matrix[static_cast<size_t>(r) * cols + c];
+    RSCodec rs(cols, rows, std::move(mat));
+    std::vector<const uint8_t*> dptr(cols);
+    std::vector<uint8_t*> optr(rows);
+    for (int i = 0; i < cols; ++i)
+      dptr[i] = data + static_cast<size_t>(i) * chunk;
+    for (int i = 0; i < rows; ++i)
+      optr[i] = out + static_cast<size_t>(i) * chunk;
+    rs.encode(dptr.data(), optr.data(), chunk);
+    return 0;
+  } catch (...) {
+    return -22;
+  }
+}
+
 // decode: sources = k global ids; source_data k*chunk contiguous;
 // targets = ntargets ids; out ntargets*chunk
 int ceph_tpu_rs_decode(const char* technique, int k, int m,

@@ -197,8 +197,10 @@ class TestRbdMirroring:
                 await jimg.write(0, w1)
                 await jimg.write(200_000, b"tail" * 2500)
                 mir = Mirrorer(io_a, io_b)
+                # first contact = initial image SYNC (journal history may
+                # be expired for other peers), not event replay
                 applied = await mir.replay("vm")
-                assert applied == 2
+                assert applied == 0
                 peer = await RBD(io_b).open("vm")
                 assert await peer.read(0, 1 << 20) == \
                     await jimg.read(0, 1 << 20)
@@ -210,6 +212,51 @@ class TestRbdMirroring:
                     await jimg.read(0, 1 << 20)
                 # idempotent: nothing new -> nothing applied
                 assert await mir.replay("vm") == 0
+                await r.shutdown()
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+
+class TestMirrorLateJoin:
+    def test_late_peer_bootstraps_from_image_not_expired_journal(self):
+        """A peer registered AFTER journal segments expired must still
+        reproduce the primary exactly (initial image sync, the
+        rbd-mirror bootstrap)."""
+        async def go():
+            from ceph_tpu.services.rbd import (JournaledImage, Mirrorer,
+                                               RBD)
+
+            cluster = Cluster(n_osds=3, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                for p in ("p-a", "p-b", "p-c"):
+                    await c.create_pool(p, profile=EC_PROFILE)
+                r = await Rados(cluster.mons[0].addr).connect()
+                io_a = await r.open_ioctx("p-a")
+                io_b = await r.open_ioctx("p-b")
+                io_c = await r.open_ioctx("p-c")
+                img = await RBD(io_a).create("vm", 1 << 19, order=15)
+                j = JournaledImage(img)
+                await j.write(0, os.urandom(200_000))
+                # peer B replays and the journal expires behind it
+                await Mirrorer(io_a, io_b).replay("vm")
+                await j.write(100_000, os.urandom(50_000))
+                await Mirrorer(io_a, io_b).replay("vm")
+                # peer C joins LATE: events before its registration are
+                # gone; it must initial-sync, then tail increments
+                await Mirrorer(io_a, io_c).replay("vm")
+                late = await RBD(io_c).open("vm")
+                assert await late.read(0, 1 << 19) == \
+                    await j.read(0, 1 << 19)
+                await j.write(5_000, b"post-join" * 100)
+                assert await Mirrorer(io_a, io_c).replay("vm") == 1
+                late = await RBD(io_c).open("vm")
+                assert await late.read(0, 1 << 19) == \
+                    await j.read(0, 1 << 19)
                 await r.shutdown()
                 await c.stop()
             finally:
