@@ -22,10 +22,19 @@ requests get 403.  Without configured credentials the gateway stays open
 (the reference's anonymous/system mode), so embedded uses need no keys.
 
 API subset: PUT /b (create bucket), GET / (list buckets), PUT /b/k,
-GET /b/k, DELETE /b/k, GET /b (list objects), HEAD /b/k, POST
-/b/k?uploads, PUT /b/k?uploadId&partNumber, POST /b/k?uploadId
-(complete), DELETE /b/k?uploadId (abort).  Divergence by design: no
-versioning/multisite/ACL policies.
+GET /b/k, DELETE /b/k (and bucket), GET /b (list objects), HEAD /b/k,
+POST /b/k?uploads, PUT /b/k?uploadId&partNumber, POST /b/k?uploadId
+(complete), DELETE /b/k?uploadId (abort) — plus the Swift dialect
+(tempauth /auth/v1.0, /v1/AUTH_<acct>/container/object routes,
+reference rgw_rest_swift.h).
+
+Multisite (reference src/rgw/driver/rados/rgw_sync.cc): every mutation
+appends to the zone's bounded data log; a ZoneSyncAgent replays another
+zone's log resumably — full image sync (including deletions) when first
+contacted or when trimmed past its position, incremental tail after.
+Replicated applies suppress the destination's own datalog so
+active-active pairs do not echo.  Divergence by design: no
+versioning/ACL policies.
 """
 
 from __future__ import annotations
@@ -61,6 +70,45 @@ class RgwService:
     def _index_oid(bucket: str) -> str:
         return f".bucket.index.{bucket}"
 
+    # -- data log (multisite source side; reference datalog/bilog) ----------
+
+    async def datalog_state(self) -> Dict:
+        """One read: {"log": [...], "trimmed": floor} — callers must not
+        stitch log and floor from two reads (a trim in between would
+        force a spurious full re-sync)."""
+        try:
+            return json.loads(await self.ioctx.read(".rgw.datalog"))
+        except RadosError as e:
+            if e.code != -errno.ENOENT:
+                raise
+            return {"log": [], "trimmed": 0}
+
+    async def _log_mutation(self, op: str, bucket: str,
+                            key: Optional[str] = None) -> None:
+        """Append one mutation to the zone's data log (bounded: agents
+        whose position predates the trim floor run a full re-sync).
+        Serialized — the append is a read-modify-write of one object,
+        and a lost entry is a silent replication gap.  Suppressed while
+        a sync agent is APPLYING replicated mutations, so active-active
+        topologies do not echo entries back and forth forever.  The
+        whole-object rewrite is O(window) per mutation; the reference
+        shards its datalog — acceptable at this gateway's scale, noted
+        as the next step if the log becomes hot."""
+        if getattr(self, "_datalog_suppressed", False):
+            return
+        lock = getattr(self, "_datalog_lock", None)
+        if lock is None:
+            lock = self._datalog_lock = asyncio.Lock()
+        async with lock:
+            d = await self.datalog_state()
+            seq = (d["log"][-1]["seq"] + 1) if d["log"] else d["trimmed"] + 1
+            d["log"].append({"seq": seq, "op": op, "bucket": bucket,
+                             "key": key})
+            while len(d["log"]) > 4096:
+                d["trimmed"] = d["log"].pop(0)["seq"]
+            await self.ioctx.write_full(".rgw.datalog",
+                                        json.dumps(d).encode())
+
     async def _load_index(self, bucket: str) -> Optional[Dict[str, Dict]]:
         try:
             return json.loads(await self.ioctx.read(self._index_oid(bucket)))
@@ -85,6 +133,7 @@ class RgwService:
                 buckets.append(bucket)
                 await self.ioctx.write_full(
                     BUCKETS_ROOT, json.dumps(sorted(buckets)).encode())
+            await self._log_mutation("create_bucket", bucket)
 
     async def list_buckets(self) -> List[str]:
         try:
@@ -103,6 +152,7 @@ class RgwService:
         index[key] = {"size": len(data),
                       "etag": hashlib.md5(data).hexdigest()}
         await self._save_index(bucket, index)
+        await self._log_mutation("put", bucket, key)
 
     async def get_object(self, bucket: str, key: str) -> bytes:
         index = await self._load_index(bucket)
@@ -142,6 +192,7 @@ class RgwService:
         entry = index.pop(key, None)
         await self._drop_object_data(bucket, key, entry)
         await self._save_index(bucket, index)
+        await self._log_mutation("delete", bucket, key)
 
     async def list_objects(self, bucket: str) -> Dict[str, Dict]:
         index = await self._load_index(bucket)
@@ -171,6 +222,7 @@ class RgwService:
             buckets.remove(bucket)
             await self.ioctx.write_full(
                 BUCKETS_ROOT, json.dumps(sorted(buckets)).encode())
+        await self._log_mutation("delete_bucket", bucket)
 
     # -- multipart (reference rgw multipart upload machinery) ---------------
 
@@ -233,6 +285,9 @@ class RgwService:
                       "etag": etag, "parts": manifest}
         await self._save_index(bucket, index)
         await self.ioctx.remove(self._upload_meta_oid(bucket, upload_id))
+        # a completed multipart IS an object mutation: without this the
+        # zone sync agent never replicates multipart uploads
+        await self._log_mutation("put", bucket, key)
         return etag
 
     async def abort_multipart(self, bucket: str, upload_id: str) -> None:
@@ -574,3 +629,99 @@ class RgwFrontend:
             if "InvalidPart" in msg:
                 return "400 Bad Request", msg.encode()
             return "500 Internal Server Error", msg.encode()
+
+
+# -- multisite sync (reference src/rgw/driver/rados/rgw_sync.cc: zones
+#    replicate via datalog/bilog replay) -------------------------------------
+
+DATALOG_OID = ".rgw.datalog"
+
+
+class ZoneSyncAgent:
+    """radosgw sync agent role: replays one zone's data log into another
+    zone, resumably.  The source gateway appends an entry per mutation
+    (the reference's datalog/bucket-index-log pair collapsed into one
+    ordered log); the agent reads entries past its persisted position,
+    fetches the referenced objects from the source, and applies them to
+    the destination — full-sync bootstrap first, then incremental tail,
+    exactly the reference's full-sync -> incremental state machine in
+    miniature."""
+
+    def __init__(self, src: RgwService, dst: RgwService,
+                 zone_id: str = "zone"):
+        self.src = src
+        self.dst = dst
+        self.zone_id = zone_id
+
+    def _pos_oid(self) -> str:
+        return f".rgw.sync.pos.{self.zone_id}"
+
+    async def _load_pos(self) -> int:
+        try:
+            return json.loads(await self.dst.ioctx.read(self._pos_oid()))
+        except RadosError as e:
+            if e.code != -errno.ENOENT:
+                raise
+            return -1
+
+    async def sync(self) -> int:
+        """Apply new source mutations to the destination; returns the
+        number applied.  First contact runs a FULL SYNC of every bucket
+        (log history may predate this zone), then tails the log."""
+        pos = await self._load_pos()
+        state = await self.src.datalog_state()
+        log, trimmed = state["log"], state.get("trimmed", 0)
+        if 0 <= pos < trimmed:
+            pos = -1  # fell behind the trim floor: full re-sync
+        # replicated applies must not re-enter the DESTINATION's datalog:
+        # in active-active topologies the echo would ping-pong forever
+        self.dst._datalog_suppressed = True
+        try:
+            if pos < 0:
+                src_buckets = set(await self.src.list_buckets())
+                for bucket in sorted(src_buckets):
+                    await self.dst.create_bucket(bucket)
+                    src_keys = set(await self.src.list_objects(bucket))
+                    for key in sorted(src_keys):
+                        data = await self.src.get_object(bucket, key)
+                        await self.dst.put_object(bucket, key, data)
+                    # deletions the trimmed log no longer tells us about
+                    for key in set(await self.dst.list_objects(bucket))                             - src_keys:
+                        await self.dst.delete_object(bucket, key)
+                for bucket in set(await self.dst.list_buckets())                         - src_buckets:
+                    for key in await self.dst.list_objects(bucket):
+                        await self.dst.delete_object(bucket, key)
+                    await self.dst.delete_bucket(bucket)
+                pos = log[-1]["seq"] if log else trimmed
+                await self.dst.ioctx.write_full(self._pos_oid(),
+                                                json.dumps(pos).encode())
+                return 0
+            applied = 0
+            for ev in log:
+                if ev["seq"] <= pos:
+                    continue
+                bucket, key, op = ev["bucket"], ev.get("key"), ev["op"]
+                try:
+                    if op == "create_bucket":
+                        await self.dst.create_bucket(bucket)
+                    elif op == "delete_bucket":
+                        await self.dst.delete_bucket(bucket)
+                    elif op == "put":
+                        data = await self.src.get_object(bucket, key)
+                        await self.dst.create_bucket(bucket)
+                        await self.dst.put_object(bucket, key, data)
+                    elif op == "delete":
+                        await self.dst.delete_object(bucket, key)
+                except RadosError as e:
+                    # the source object may be gone again (put then
+                    # delete before we synced): a later entry covers it
+                    if e.code != -errno.ENOENT and "NoSuch" not in str(e):
+                        raise
+                pos = ev["seq"]
+                applied += 1
+            if applied:
+                await self.dst.ioctx.write_full(self._pos_oid(),
+                                                json.dumps(pos).encode())
+            return applied
+        finally:
+            self.dst._datalog_suppressed = False

@@ -263,3 +263,44 @@ class TestMirrorLateJoin:
                 await cluster.stop()
 
         run(go())
+
+
+class TestRgwMultisite:
+    def test_zone_sync_full_then_incremental(self):
+        async def go():
+            from ceph_tpu.services.rgw import RgwService, ZoneSyncAgent
+
+            cluster = Cluster(n_osds=3, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                for p in ("zone-a", "zone-b"):
+                    await c.create_pool(p, profile=EC_PROFILE)
+                r = await Rados(cluster.mons[0].addr).connect()
+                a = RgwService(await r.open_ioctx("zone-a"))
+                b = RgwService(await r.open_ioctx("zone-b"))
+                await a.create_bucket("docs")
+                blob1 = os.urandom(30_000)
+                await a.put_object("docs", "one", blob1)
+                agent = ZoneSyncAgent(a, b, zone_id="b")
+                # first contact: full sync
+                await agent.sync()
+                assert await b.get_object("docs", "one") == blob1
+                # incremental: put + delete + new bucket replay in order
+                blob2 = os.urandom(10_000)
+                await a.put_object("docs", "two", blob2)
+                await a.delete_object("docs", "one")
+                await a.create_bucket("media")
+                applied = await agent.sync()
+                assert applied == 3
+                assert await b.get_object("docs", "two") == blob2
+                assert "one" not in await b.list_objects("docs")
+                assert "media" in await b.list_buckets()
+                # idempotent tail
+                assert await agent.sync() == 0
+                await r.shutdown()
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
