@@ -198,7 +198,9 @@ def test_ec_pool_with_tpu_plugin():
             await client.mark_osd_down(victim)
         assert await client.get(pool, "obj") == data  # 2 erasures, m=2
 
-    run(7, body)
+    # generous: the tpu codec's first dispatches jit-compile, and under
+    # full-suite machine load those compiles have blown a 60s budget
+    run(7, body, timeout=180)
 
 
 def test_fault_injection_socket_failures():
