@@ -183,19 +183,42 @@ class MatrixErasureCode(ErasureCode):
         self, want_to_read: Set[int], chunks: Mapping[int, np.ndarray]
     ) -> Dict[int, np.ndarray]:
         chosen, inv = self.decode_selection(set(want_to_read), set(chunks))
-        src = np.stack([np.asarray(chunks[c], dtype=np.uint8) for c in chosen])
-        data = self._apply(inv, src)
         out: Dict[int, np.ndarray] = {}
-        need_coding = [c for c in want_to_read if c >= self.k]
-        coding = self._apply(self.matrix, data) if need_coding else None
+        # reconstruct ONLY the missing rows (the reference decodes erased
+        # chunks, not all k): available chunks pass through untouched, so
+        # the matmul shrinks from k rows to n_lost rows — typically a
+        # k/n_lost compute cut on every degraded read and recovery
+        need_coding = sorted(c for c in want_to_read
+                             if c >= self.k and c not in chunks)
+        # rebuild only the data rows somebody needs: the requested ones,
+        # plus ALL missing data rows when a coding chunk must be re-made
+        # (its generator row spans every data row)
+        missing_data = sorted(
+            c for c in range(self.k) if c not in chunks
+            and (need_coding or c in want_to_read))
+        if missing_data:
+            src = np.stack([np.asarray(chunks[c], dtype=np.uint8)
+                            for c in chosen])
+            rebuilt = self._apply(inv[missing_data], src)
+            for i, c in enumerate(missing_data):
+                out[c] = rebuilt[i]
+        if need_coding:
+            # coding rows = their generator rows applied to the full data
+            # rows (reconstructed ones + pass-through survivors)
+            data_rows = np.stack([
+                out[c] if c in out
+                else np.asarray(chunks[c], dtype=np.uint8)
+                for c in range(self.k)])
+            coding = self._apply(
+                self.matrix[[c - self.k for c in need_coding]], data_rows)
+            for i, c in enumerate(need_coding):
+                out[c] = coding[i]
         for c in want_to_read:
             if c in chunks:
                 out[c] = np.asarray(chunks[c], dtype=np.uint8)
-            elif c < self.k:
-                out[c] = data[c]
-            else:
-                out[c] = coding[c - self.k]
-        return out
+        # contract (interface.py): return exactly the requested subset —
+        # helper rows rebuilt for a coding reconstruction stay internal
+        return {c: v for c, v in out.items() if c in want_to_read}
 
     def bit_generator(self) -> np.ndarray:
         return matrix_to_bitmatrix(self.matrix, self.w)

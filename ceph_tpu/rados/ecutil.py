@@ -295,13 +295,24 @@ def _queue_decode_plan(codec, sinfo: StripeInfo,
         return None
     from ceph_tpu.ec.matrices import matrix_to_bitmatrix
 
-    inv_bm = matrix_to_bitmatrix(inv, codec.w).astype(np.int8)
+    # dispatch ONLY the missing data rows (available ones pass through):
+    # the matmul shrinks from k rows to n_lost — same trimming the codec
+    # CPU path does, so queue and CPU decode stay work-equivalent
+    missing = sorted(c for c in range(k) if c not in arrays)
+    inv_bm = matrix_to_bitmatrix(inv[missing], codec.w).astype(np.int8)
     src = np.ascontiguousarray(np.stack([arrays[c] for c in chosen]))
-    fut = queue.submit(inv_bm, src, codec.w, k)
+    fut = queue.submit(inv_bm, src, codec.w, len(missing))
 
     def finish(rows: np.ndarray) -> bytes:
+        rebuilt = np.asarray(rows)
+        full = np.empty((k, n_stripes * cs), dtype=np.uint8)
+        for i, c in enumerate(missing):
+            full[c] = rebuilt[i]
+        for c in range(k):
+            if c not in missing:
+                full[c] = arrays[c]
         # de-interleave [k, S, cs] -> stripe-major logical bytes
-        r = np.asarray(rows).reshape(k, n_stripes, cs).transpose(1, 0, 2)
+        r = full.reshape(k, n_stripes, cs).transpose(1, 0, 2)
         return r.reshape(-1)[:object_size].tobytes()
 
     return fut, finish
