@@ -139,10 +139,15 @@ async def _main(args) -> None:
     cluster = Cluster(n_osds=args.osds, data_dir=args.data_dir,
                       n_mons=args.mons)
     await cluster.start()
-    print(f"mons at {cluster.mon_addrs}; {args.osds} OSDs up. Ctrl-C to stop.")
+    print(f"mons at {cluster.mon_addrs}; {args.osds} OSDs up. "
+          + ("Ctrl-C to stop." if args.run_for <= 0
+             else f"Running {args.run_for}s."), flush=True)
     try:
-        while True:
-            await asyncio.sleep(3600)
+        if args.run_for > 0:
+            await asyncio.sleep(args.run_for)
+        else:
+            while True:
+                await asyncio.sleep(3600)
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
@@ -154,4 +159,6 @@ if __name__ == "__main__":
     p.add_argument("--osds", type=int, default=5)
     p.add_argument("--mons", type=int, default=1)
     p.add_argument("--data-dir", default=None)
+    p.add_argument("--run-for", type=float, default=0.0,
+                   help="seconds to run before clean shutdown (0 = forever)")
     asyncio.run(_main(p.parse_args()))
