@@ -30,13 +30,18 @@ def run(coro, timeout=120):
 class TestDaemonPathBatching:
     def test_concurrent_puts_coalesce_into_few_dispatches(self):
         async def go():
-            cluster = Cluster(n_osds=3, conf={"osd_auto_repair": False})
+            # generous op timeout: the queue's first dispatch jit-compiles
+            # (JAX CPU here), and under machine load that compile has
+            # exceeded the default 10s and failed the warm-up put
+            cluster = Cluster(n_osds=3, conf={"osd_auto_repair": False,
+                                              "client_op_timeout": 60.0})
             await cluster.start()
             try:
                 c = await cluster.client()
                 pool = await c.create_pool("bq", profile=PROFILE)
                 q = osdmod.shared_batching_queue()
-                # settle: pool-create traffic must not pollute the count
+                # warm the jit caches OUTSIDE the counted window
+                await c.put(pool, "warmup", os.urandom(8192))
                 await asyncio.sleep(0.1)
                 before_d, before_ops = q.dispatches, q.submits
                 n = 24
