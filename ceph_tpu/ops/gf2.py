@@ -33,6 +33,16 @@ per timed dispatch, tunnel RTT subtracted):
 MXU-matvec consumer the bench records ~55 packed vs ~93 planar — same
 ~1.6-1.7x conclusion, slightly higher absolutes.)
 
+Two pack-acceleration alternatives were tried and REFUTED (same rig):
+  * MXU pack (plane-major matrix rows so the output reshapes to
+    [8, M*B] and a pow2-weight dot packs it): 8.7 GB/s vs 49 — the
+    plane-major relayout plus a contraction dim of 8 starve the MXU
+    and the int32 plane materialization adds HBM traffic.
+  * uint8 shift-accumulate pack (narrower lanes than the int32 plane
+    sum): 48.5 vs 49 — XLA already narrows the existing pack.
+Planar residency (skip the output pack entirely) remains the only
+measured pack win.
+
 Keeping shards bit-planar in HBM across the pipeline — pack/unpack paid
 once at the host/wire boundary — is worth ~1.57x.  The middle row
 pinpoints WHERE: unpack fuses into the matmul almost for free, while the
