@@ -396,7 +396,7 @@ class RadosClient:
         allocator)."""
         reply = await self._mon_rpc(MSnapOp(pool_id=pool_id, op="create"))
         if not reply.ok:
-            raise RadosError(reply.error)
+            raise RadosError(reply.error, code=reply.code)
         await self.refresh_map()
         return reply.snap_id
 
@@ -410,7 +410,7 @@ class RadosClient:
         reply = await self._mon_rpc(
             MSnapOp(pool_id=pool_id, op="remove", snap_id=snap_id))
         if not reply.ok:
-            raise RadosError(reply.error)
+            raise RadosError(reply.error, code=reply.code)
         await self.refresh_map()
         for osd_id in self._pg_primaries(pool_id):
             try:

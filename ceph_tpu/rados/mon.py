@@ -25,6 +25,7 @@ recovers its state from disk and syncs forward via the collect phase.
 from __future__ import annotations
 
 import asyncio
+import errno
 import pickle
 import time
 import uuid
@@ -821,6 +822,7 @@ class Monitor:
             pool = self.osdmap.pools.get(msg.pool_id)
             if pool is None:
                 return MSnapOpReply(tid=msg.tid, ok=False,
+                                    code=-errno.ENOENT,
                                     error="no such pool")
             if msg.op == "create":
                 pool.snap_seq += 1
@@ -830,14 +832,15 @@ class Monitor:
             if msg.op == "remove":
                 if msg.snap_id <= 0 or msg.snap_id > pool.snap_seq:
                     return MSnapOpReply(tid=msg.tid, ok=False,
+                                        code=-errno.EINVAL,
                                         error="bad snap id")
                 if msg.snap_id not in pool.removed_snaps:
-                    pool.removed_snaps.append(msg.snap_id)
-                    pool.removed_snaps.sort()
+                    pool.removed_snaps.add(msg.snap_id)
                     self.osdmap.epoch += 1
                     await self._commit_state()
                 return MSnapOpReply(tid=msg.tid, snap_id=msg.snap_id)
-            return MSnapOpReply(tid=msg.tid, ok=False, error="bad snap op")
+            return MSnapOpReply(tid=msg.tid, ok=False, code=-errno.EINVAL,
+                                error="bad snap op")
         if isinstance(msg, MPoolSet):
             pool = self.osdmap.pools.get(msg.pool_id)
             if pool is None:

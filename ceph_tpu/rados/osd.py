@@ -1499,19 +1499,27 @@ class OSD:
                 pass  # recovery pushes carry xattrs; scrub repairs drift
 
     def _live_snaps(self, pool: PoolInfo, snaps: List[int]) -> List[int]:
-        removed = set(pool.removed_snaps)
-        return [s for s in snaps if s not in removed]
+        # IntervalSet membership: O(log runs) per id, no materialization
+        return [s for s in snaps if s not in pool.removed_snaps]
 
     async def _make_writeable(self, op: MOSDOp, pool: PoolInfo, pg: int,
-                              acting: List[int]) -> None:
+                              acting: List[int]) -> Optional[MOSDOpReply]:
         """COW before the first write past a new snap (the reference's
         make_writeable): clone the current head into a clone object
         (placed in the SAME PG — object_to_pg hashes the head name) and
         record it in the SnapSet.  Clone writes ride the normal write
         pipeline, so they are erasure-coded, logged, and recoverable like
-        any object."""
+        any object.
+
+        Returns an error reply the parent write must surface (and NOT
+        proceed past) when snapshot preservation could not be guaranteed;
+        None means the write may go ahead.  The born/absent branches fire
+        only on VERIFIED absence (typed -ENOENT / whiteout) — a transient
+        head-read failure (-EAGAIN degraded, -EIO) on an existing object
+        must not skip the COW clone, or the pre-snap bytes are destroyed.
+        """
         if is_snap_clone(op.oid) or op.snapc_seq <= 0:
-            return
+            return None
         snapc = self._live_snaps(pool, op.snapc_snaps)
         ss = self._load_snapset(op.pool_id, op.oid)
         newer = [s for s in snapc if s > ss["seq"]]
@@ -1520,21 +1528,43 @@ class OSD:
                 MOSDOp(op="read", pool_id=op.pool_id, oid=op.oid))
             if head.ok and not ss.get("whiteout"):
                 clone_id = max(newer)
-                await self._do_write(MOSDOp(
+                wr = await self._do_write(MOSDOp(
                     op="write", pool_id=op.pool_id,
                     oid=snap_clone_oid(op.oid, clone_id), data=head.data,
                     reqid=uuid.uuid4().hex))
+                if not wr.ok:
+                    # the clone did not durably land (below min_size, …):
+                    # overwriting the head now would lose the pre-snap
+                    # bytes.  Fail the parent write retryably instead.
+                    return MOSDOpReply(
+                        ok=False, code=-errno.EAGAIN,
+                        error=f"snap clone write failed: {wr.error}",
+                        backoff=float(
+                            self.conf.get("osd_backoff_secs", 0.5) or 0))
                 ss["clones"].append([clone_id, sorted(newer)])
-            elif not head.ok and ss["seq"] == 0 and not ss["clones"]:
-                # object is being CREATED under this context: snaps at or
-                # before snapc_seq predate it (existence-at-snap gate)
-                ss["born"] = op.snapc_seq
+            elif head.ok or head.code == -errno.ENOENT:
+                # verified absence: whiteout head, or every possible
+                # holder answered ENOENT (_absent_reply discipline)
+                if not head.ok and ss["seq"] == 0 and not ss["clones"]:
+                    # object is being CREATED under this context: snaps at
+                    # or before snapc_seq predate it (existence-at-snap)
+                    ss["born"] = op.snapc_seq
+                else:
+                    # the object was ABSENT (whiteout, or vanished) while
+                    # these snaps were taken: record that, or recreating
+                    # the head would make reads at those snaps serve
+                    # FUTURE data
+                    absent = ss.setdefault("absent", [])
+                    absent.extend(s for s in newer if s not in absent)
             else:
-                # the object was ABSENT (whiteout, or vanished) while
-                # these snaps were taken: record that, or recreating the
-                # head would make reads at those snaps serve FUTURE data
-                absent = ss.setdefault("absent", [])
-                absent.extend(s for s in newer if s not in absent)
+                # transient head-read failure (-EAGAIN, -EIO): existence
+                # is UNKNOWN — neither clone nor record absence.  The
+                # parent write must back off rather than mutate the head.
+                return MOSDOpReply(
+                    ok=False, code=-errno.EAGAIN,
+                    error=f"snap COW head read failed: {head.error}",
+                    backoff=float(
+                        self.conf.get("osd_backoff_secs", 0.5) or 0))
         if op.snapc_seq > ss["seq"]:
             ss["seq"] = op.snapc_seq
             ss["whiteout"] = False
@@ -1542,6 +1572,7 @@ class OSD:
         elif ss.get("whiteout"):
             ss["whiteout"] = False
             await self._save_snapset(pool, pg, acting, op.oid, ss)
+        return None
 
     def _resolve_snap_read(self, pool: PoolInfo, oid: str,
                            snap: int) -> Optional[str]:
@@ -1555,7 +1586,7 @@ class OSD:
             return None  # created after the snapshot
         if snap in ss.get("absent", ()):
             return None  # object was deleted while this snap was taken
-        removed = set(pool.removed_snaps)
+        removed = pool.removed_snaps
         for clone_id, snaps in sorted(ss["clones"]):
             live = [s for s in snaps if s not in removed]
             if live and clone_id >= snap:
@@ -1715,7 +1746,9 @@ class OSD:
         self._failed_writes.discard(op.reqid)
         if op.offset >= 0 and not op.data:
             return MOSDOpReply(ok=True)  # zero-length overwrite: no-op
-        await self._make_writeable(op, pool, pg, acting)
+        cow_err = await self._make_writeable(op, pool, pg, acting)
+        if cow_err is not None:
+            return cow_err
         if pool.pool_type != "ec":
             return await self._do_write_replicated(op, pool, pg, acting)
         codec = self._codec(pool)
@@ -2428,7 +2461,9 @@ class OSD:
         # the head reads as ENOENT.  Without live clones, a delete is a
         # real delete.
         if not is_snap_clone(op.oid):
-            await self._make_writeable(op, pool, pg, acting)
+            cow_err = await self._make_writeable(op, pool, pg, acting)
+            if cow_err is not None:
+                return cow_err
             ss = self._load_snapset(op.pool_id, op.oid)
             if ss["clones"]:
                 self._cache_drop(op.pool_id, op.oid)

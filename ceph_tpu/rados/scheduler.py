@@ -128,6 +128,8 @@ class MClockScheduler:
         CLASS_BEST_EFFORT: (1.0, 1.0, 20.0),
     }
 
+    STRICT_CUTOFF = WPQScheduler.STRICT_CUTOFF
+
     def __init__(self, conf: Optional[dict] = None):
         conf = conf or {}
         self.classes: Dict[str, _MClockClass] = {}
@@ -136,10 +138,20 @@ class MClockScheduler:
             w = float(conf.get(f"mclock_{name}_wgt", w))
             l = float(conf.get(f"mclock_{name}_lim", l))
             self.classes[name] = _MClockClass(r, w, l)
+        # ops at/above the cutoff bypass tag scheduling entirely (the
+        # reference mClockScheduler keeps the same strict high_priority
+        # queue, mClockScheduler.h) — both schedulers honor `priority`
+        self._strict: List[_Item] = []
         self._size = 0
 
     def enqueue(self, op_class: str, run, cost: int = 1,
                 priority: Optional[int] = None, order_key: Any = None) -> None:
+        if priority is not None and priority >= self.STRICT_CUTOFF:
+            self._strict.append(_Item(sort_key=(next(_seq),), run=run,
+                                      op_class=op_class, cost=cost,
+                                      order_key=order_key))
+            self._size += 1
+            return
         c = self.classes.setdefault(
             op_class, _MClockClass(1.0, 1.0, 0.0))
         now = time.monotonic()
@@ -153,6 +165,9 @@ class MClockScheduler:
         self._size += 1
 
     def dequeue(self) -> Optional[_Item]:
+        if self._strict:
+            self._size -= 1
+            return self._strict.pop(0)
         now = time.monotonic()
         # phase 1: reservations due
         best_c, best_tag = None, None
@@ -240,11 +255,13 @@ class ShardedOpQueue:
         return (key * 2654435761 & 0xFFFFFFFF) % self.n_shards
 
     async def enqueue(self, pg_key: int, run: Callable[[], Awaitable[None]],
-                      op_class: str = CLASS_CLIENT, cost: int = 1) -> None:
+                      op_class: str = CLASS_CLIENT, cost: int = 1,
+                      priority: Optional[int] = None) -> None:
         cost = max(1, cost)
         await self._budget.get(cost)  # blocks when queues are full
         shard = self.shard_of(pg_key)
-        self._scheds[shard].enqueue(op_class, run, cost, order_key=pg_key)
+        self._scheds[shard].enqueue(op_class, run, cost, priority=priority,
+                                    order_key=pg_key)
         if self.perf is not None:
             self.perf.inc("op_queued")
         self._events[shard].set()
@@ -265,42 +282,59 @@ class ShardedOpQueue:
         inflight = self._inflight[shard]
 
         async def _run_item(item, after: Optional[asyncio.Task]) -> None:
+            # The drain loop acquired our slot BEFORE dequeuing us.
+            holds_slot = True
             try:
                 if after is not None:
                     # per-key ordering: wait out the predecessor (its
                     # failure is its own; ours still runs).  The slot is
-                    # acquired AFTER this wait — queued successors of a
-                    # hot PG must not hold width hostage and starve other
-                    # PGs out of the very overlap this design adds.
+                    # given BACK during this wait — queued successors of
+                    # a hot PG must not hold width hostage and starve
+                    # other PGs out of the very overlap this design adds.
+                    slots.release()
+                    holds_slot = False
                     await asyncio.gather(after, return_exceptions=True)
-                async with slots:
-                    t0 = time.monotonic()
-                    try:
-                        await item.run()
-                    except asyncio.CancelledError:
-                        raise
-                    except Exception:
-                        import traceback
+                    await slots.acquire()
+                    holds_slot = True
+                t0 = time.monotonic()
+                try:
+                    await item.run()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    import traceback
 
-                        traceback.print_exc()
-                    if self.perf is not None:
-                        self.perf.inc("op_dequeued")
-                        self.perf.tinc("op_queue_lat",
-                                       time.monotonic() - t0)
+                    traceback.print_exc()
+                if self.perf is not None:
+                    self.perf.inc("op_dequeued")
+                    self.perf.tinc("op_queue_lat",
+                                   time.monotonic() - t0)
             finally:
+                if holds_slot:
+                    slots.release()
                 # budget was taken at enqueue: released on EVERY exit,
                 # cancellation included (a leaked token would shrink the
                 # queue forever)
                 self._budget.put(item.cost)
 
         while not self._stopped:
+            # Capacity-gate the dequeue: hold an execution slot BEFORE
+            # asking the scheduler for the next op, so the WPQ/mClock
+            # policy decides at each free slot among EVERYTHING queued at
+            # that moment — a later-arriving high-priority op still beats
+            # an earlier low-priority one.  Draining the whole backlog
+            # into tasks up front would hand ordering to the FIFO
+            # semaphore and bypass QoS entirely under load.
+            await slots.acquire()
             item = sched.dequeue()
             if item is None:
+                slots.release()
                 event.clear()
                 await event.wait()
                 continue
             key = item.order_key
             prev = running.get(key)
+            # the slot acquired above is transferred to _run_item
             task = asyncio.get_running_loop().create_task(
                 _run_item(item, prev))
             inflight.add(task)

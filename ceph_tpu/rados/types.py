@@ -40,6 +40,83 @@ def is_snap_clone(oid: str) -> bool:
     return SNAP_SEP in oid
 
 
+class IntervalSet:
+    """Sorted disjoint half-open [start, end) runs of snap ids (reference
+    interval_set<snapid_t>, src/include/interval_set.h).  pg_pool_t ships
+    removed_snaps inside EVERY OSDMap, so a long-lived pool that has
+    removed many snapshots must coalesce — map size and membership tests
+    scale with the number of RUNS, not the number of removed ids
+    (contiguous removals, the common case, collapse to one run)."""
+
+    __slots__ = ("_runs",)
+
+    def __init__(self, ids=()):
+        self._runs: List[List[int]] = []  # [[start, end), ...] sorted
+        for i in ids:
+            self.add(i)
+
+    def add(self, snapid: int) -> None:
+        runs = self._runs
+        lo, hi = 0, len(runs)
+        while lo < hi:  # bisect by run start
+            mid = (lo + hi) // 2
+            if runs[mid][0] <= snapid:
+                lo = mid + 1
+            else:
+                hi = mid
+        # runs[lo-1].start <= snapid < runs[lo].start
+        if lo > 0 and snapid < runs[lo - 1][1]:
+            return  # already present
+        if lo > 0 and snapid == runs[lo - 1][1]:
+            runs[lo - 1][1] += 1
+            if lo < len(runs) and runs[lo][0] == runs[lo - 1][1]:
+                runs[lo - 1][1] = runs[lo][1]
+                del runs[lo]
+            return
+        if lo < len(runs) and snapid + 1 == runs[lo][0]:
+            runs[lo][0] = snapid
+            return
+        runs.insert(lo, [snapid, snapid + 1])
+
+    def __contains__(self, snapid: int) -> bool:
+        runs = self._runs
+        lo, hi = 0, len(runs)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if runs[mid][0] <= snapid:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo > 0 and snapid < runs[lo - 1][1]
+
+    def __iter__(self):
+        for start, end in self._runs:
+            yield from range(start, end)
+
+    def __len__(self) -> int:
+        return sum(end - start for start, end in self._runs)
+
+    def num_intervals(self) -> int:
+        return len(self._runs)
+
+    def __bool__(self) -> bool:
+        return bool(self._runs)
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, IntervalSet)
+                and self._runs == other._runs)
+
+    def __repr__(self) -> str:
+        return f"IntervalSet({self._runs!r})"
+
+    # pickle support for a __slots__ class (OSDMap rides the messenger)
+    def __getstate__(self):
+        return self._runs
+
+    def __setstate__(self, state):
+        self._runs = state
+
+
 @dataclass
 class PoolInfo:
     pool_id: int
@@ -53,10 +130,11 @@ class PoolInfo:
     stripe_width: int = 0
     # self-managed snapshot state (reference pg_pool_t snap_seq /
     # removed_snaps, src/osd/osd_types.h): the mon allocates monotonically
-    # increasing snap ids; removed ids are recorded so lazy trimming and
-    # snap-read resolution can skip them
+    # increasing snap ids; removed ids are recorded (as coalesced
+    # intervals, like the reference's interval_set) so lazy trimming and
+    # snap-read resolution can skip them without bloating the map
     snap_seq: int = 0
-    removed_snaps: List[int] = field(default_factory=list)
+    removed_snaps: IntervalSet = field(default_factory=IntervalSet)
 
 
 @dataclass
@@ -456,6 +534,10 @@ class MSnapOpReply:
     tid: str = ""
     ok: bool = True
     error: str = ""
+    # typed 0/-errno result (same discipline as MOSDOpReply.code): callers
+    # distinguish definitive failures (-ENOENT no such pool, -EINVAL bad
+    # snap id) from transient ones instead of matching on `error` text
+    code: int = 0
     snap_id: int = 0  # the allocated id (create)
 
 

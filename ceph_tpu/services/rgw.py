@@ -40,6 +40,7 @@ versioning/ACL policies.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import errno
 import hashlib
 import hmac
@@ -54,6 +55,15 @@ from ceph_tpu.rados.librados import IoCtx
 from ceph_tpu.rados.striper import RadosStriper
 
 BUCKETS_ROOT = ".rgw.buckets"  # registry of buckets
+
+# Task-scoped datalog suppression: set while a ZoneSyncAgent task APPLIES
+# replicated mutations, so they do not re-enter the destination's datalog
+# (active-active echo).  A contextvar — NOT a service attribute — so a
+# concurrent local client mutation on the same gateway in another task
+# still logs; a service-wide flag would silently skip its _log_mutation
+# and leave a permanent replication gap.
+_DATALOG_SUPPRESS: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "rgw_datalog_suppress", default=False)
 
 
 class RgwService:
@@ -94,7 +104,7 @@ class RgwService:
         whole-object rewrite is O(window) per mutation; the reference
         shards its datalog — acceptable at this gateway's scale, noted
         as the next step if the log becomes hot."""
-        if getattr(self, "_datalog_suppressed", False):
+        if _DATALOG_SUPPRESS.get():
             return
         lock = getattr(self, "_datalog_lock", None)
         if lock is None:
@@ -674,8 +684,10 @@ class ZoneSyncAgent:
         if 0 <= pos < trimmed:
             pos = -1  # fell behind the trim floor: full re-sync
         # replicated applies must not re-enter the DESTINATION's datalog:
-        # in active-active topologies the echo would ping-pong forever
-        self.dst._datalog_suppressed = True
+        # in active-active topologies the echo would ping-pong forever.
+        # Scoped to THIS task (contextvar): concurrent local mutations on
+        # the destination gateway keep logging normally.
+        token = _DATALOG_SUPPRESS.set(True)
         try:
             if pos < 0:
                 src_buckets = set(await self.src.list_buckets())
@@ -724,4 +736,4 @@ class ZoneSyncAgent:
                                                 json.dumps(pos).encode())
             return applied
         finally:
-            self.dst._datalog_suppressed = False
+            _DATALOG_SUPPRESS.reset(token)

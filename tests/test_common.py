@@ -258,3 +258,52 @@ class TestContextAndAsok:
         op.finish()
         assert ctx.asok.execute("dump_ops_in_flight")["num_ops"] == 0
         assert ctx.asok.execute("dump_historic_ops")["num_ops"] == 1
+
+
+# -- IntervalSet (reference src/include/interval_set.h) ----------------------
+
+
+class TestIntervalSet:
+    def test_coalescing_and_membership(self):
+        from ceph_tpu.rados.types import IntervalSet
+
+        s = IntervalSet()
+        assert not s
+        for i in (5, 3, 4, 10, 1):
+            s.add(i)
+        # 3,4,5 coalesce into one run; 1 and 10 stand alone
+        assert s.num_intervals() == 3
+        assert len(s) == 5
+        for i in (1, 3, 4, 5, 10):
+            assert i in s
+        for i in (0, 2, 6, 9, 11):
+            assert i not in s
+        assert sorted(s) == [1, 3, 4, 5, 10]
+        # idempotent re-add
+        s.add(4)
+        assert len(s) == 5
+        # bridging add merges two runs into one
+        s.add(2)
+        assert s.num_intervals() == 2
+        assert 2 in s
+
+    def test_contiguous_removals_stay_one_run(self):
+        from ceph_tpu.rados.types import IntervalSet
+
+        s = IntervalSet()
+        for i in range(1, 10_001):
+            s.add(i)
+        # the common case — every snap eventually removed — is O(1) space
+        assert s.num_intervals() == 1
+        assert len(s) == 10_000
+        assert 10_000 in s and 10_001 not in s
+
+    def test_pickle_roundtrip(self):
+        import pickle
+
+        from ceph_tpu.rados.types import IntervalSet
+
+        s = IntervalSet([7, 8, 20])
+        s2 = pickle.loads(pickle.dumps(s, protocol=5))
+        assert s2 == s
+        assert 8 in s2 and 9 not in s2

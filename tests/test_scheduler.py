@@ -159,6 +159,54 @@ class TestShardedQueue:
         asyncio.run(go())
 
 
+    def test_backlog_respects_qos_at_each_free_slot(self):
+        """ADVICE r3 (low): the drain loop must capacity-gate dequeue so
+        scheduler policy — not FIFO task-creation order — decides what
+        runs when a slot frees.  A high-priority op arriving AFTER a
+        backlog of best-effort ops must still run before most of them."""
+        async def go():
+            q = ShardedOpQueue(
+                n_shards=1,
+                conf={"osd_pg_op_concurrency": 1, "osd_op_queue": "wpq"})
+            q.start()
+            order = []
+            gate = asyncio.Event()
+
+            def mk(tag):
+                async def run():
+                    if not order:
+                        # first op parks, letting a backlog accumulate
+                        await gate.wait()
+                    order.append(tag)
+                return run
+
+            # distinct order_keys: ordering must come from the scheduler,
+            # not per-PG chaining
+            await q.enqueue(0, mk("first"),
+                            op_class=CLASS_BEST_EFFORT)
+            await asyncio.sleep(0.01)  # first op is now running (parked)
+            for i in range(8):
+                await q.enqueue(10 + i, mk(f"be{i}"),
+                                op_class=CLASS_BEST_EFFORT)
+            # the latecomer: strict-priority op, queued AFTER the
+            # backlog (>= STRICT_CUTOFF => WPQ serves it unconditionally
+            # first among whatever is QUEUED when a slot frees)
+            await q.enqueue(99, mk("client"),
+                            op_class=CLASS_CLIENT, priority=200)
+            gate.set()
+            for _ in range(300):
+                if len(order) == 10:
+                    break
+                await asyncio.sleep(0.01)
+            await q.stop()
+            assert len(order) == 10, order
+            # the late strict-priority op runs at the FIRST free slot —
+            # impossible if the backlog was pre-converted to FIFO tasks
+            assert order[1] == "client", order
+
+        asyncio.run(go())
+
+
 class TestHeartbeatFailureDetection:
     def test_peer_reports_accelerate_markdown(self):
         async def go():

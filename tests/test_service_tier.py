@@ -304,3 +304,59 @@ class TestRgwMultisite:
                 await cluster.stop()
 
         run(go())
+
+    def test_concurrent_local_mutation_during_sync_still_logs(self):
+        """ADVICE r3 (medium): datalog suppression is scoped to the sync
+        agent's own task — a local client mutation on the DESTINATION
+        gateway while a sync window is open must still append to the
+        destination's datalog, or active-active replication silently
+        loses it."""
+        async def go():
+            from ceph_tpu.services.rgw import (RgwService, ZoneSyncAgent,
+                                               _DATALOG_SUPPRESS)
+
+            cluster = Cluster(n_osds=3, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                for p in ("zz-a", "zz-b"):
+                    await c.create_pool(p, profile=EC_PROFILE)
+                r = await Rados(cluster.mons[0].addr).connect()
+                a = RgwService(await r.open_ioctx("zz-a"))
+                b = RgwService(await r.open_ioctx("zz-b"))
+                await a.create_bucket("docs")
+                await a.put_object("docs", "one", os.urandom(5_000))
+                agent = ZoneSyncAgent(a, b, zone_id="b")
+                await agent.sync()  # full sync; position established
+
+                # hold the sync window open: gate the agent's first apply
+                gate = asyncio.Event()
+                real_put = b.put_object
+
+                async def gated_put(bucket, key, data, **kw):
+                    assert _DATALOG_SUPPRESS.get()  # agent task IS scoped
+                    await gate.wait()
+                    return await real_put(bucket, key, data, **kw)
+
+                await a.put_object("docs", "two", os.urandom(2_000))
+                b.put_object = gated_put
+                sync_task = asyncio.create_task(agent.sync())
+                await asyncio.sleep(0.05)  # agent now parked inside apply
+                # concurrent LOCAL mutation on the destination gateway
+                b.put_object = real_put
+                await b.put_object("docs", "local-write", b"payload")
+                gate.set()
+                b.put_object = gated_put  # irrelevant; agent already past
+                await sync_task
+                b.put_object = real_put
+                dlog = await b.datalog_state()
+                ops = [(e["op"], e.get("key")) for e in dlog["log"]]
+                # the local write logged; the replicated apply did not
+                assert ("put", "local-write") in ops
+                assert ("put", "two") not in ops
+                await r.shutdown()
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())

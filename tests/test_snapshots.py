@@ -234,3 +234,91 @@ class TestWhiteoutRecreate:
                 await cluster.stop()
 
         run(go())
+
+
+class TestCowFailureDiscipline:
+    def test_transient_head_read_failure_aborts_cow(self):
+        """ADVICE r3 (high): a transient head-read failure (-EAGAIN) on an
+        EXISTING object must fail the parent write retryably — not skip
+        the COW clone and record the snaps as 'absent', which would
+        destroy the pre-snap bytes and permanently ENOENT snap reads."""
+        async def go():
+            import errno as _errno
+
+            from ceph_tpu.rados.types import MOSDOp, MOSDOpReply
+
+            cluster = Cluster(n_osds=3, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool_id = await c.create_pool("sncow", profile=EC_PROFILE)
+                v1 = os.urandom(30_000)
+                await c.put(pool_id, "obj", v1)
+                snap = await c.selfmanaged_snap_create(pool_id)
+                # locate the acting primary for the head object
+                primary = None
+                for osd in cluster.osds.values():
+                    pool = osd.osdmap.pools[pool_id]
+                    pg, acting = osd._acting(pool, "obj")
+                    if osd._primary(pool, pg, acting) == osd.osd_id:
+                        primary = osd
+                assert primary is not None
+                real_read = primary._do_read
+
+                async def failing_read(op, **kw):
+                    if op.op == "read" and op.oid == "obj":
+                        return MOSDOpReply(ok=False, code=-_errno.EAGAIN,
+                                           error="injected degraded read")
+                    return await real_read(op, **kw)
+
+                primary._do_read = failing_read
+                try:
+                    wr = await primary._do_write(MOSDOp(
+                        op="write", pool_id=pool_id, oid="obj",
+                        data=os.urandom(1_000), reqid="cow-inject-1",
+                        snapc_seq=snap, snapc_snaps=[snap]))
+                finally:
+                    primary._do_read = real_read
+                # the write failed retryably and nothing was recorded
+                assert not wr.ok and wr.code == -_errno.EAGAIN
+                ss = primary._load_snapset(pool_id, "obj")
+                assert ss["seq"] < snap
+                assert not ss.get("absent")
+                # once the transient failure clears, the same overwrite
+                # clones properly and the pre-snap bytes survive
+                v2 = os.urandom(31_000)
+                await c.put(pool_id, "obj", v2, snapc=(snap, [snap]))
+                assert await c.get(pool_id, "obj") == v2
+                assert await c.get(pool_id, "obj", snap=snap) == v1
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+
+class TestSnapOpTypedErrors:
+    def test_bad_snap_ids_raise_typed_errno(self):
+        """ADVICE r3 (low): MSnapOpReply carries a typed code so callers
+        can tell definitive failures from transient ones."""
+        async def go():
+            import errno as _errno
+
+            from ceph_tpu.rados.client import RadosError
+
+            cluster = Cluster(n_osds=3, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("snerr", profile=EC_PROFILE)
+                with pytest.raises(RadosError) as ei:
+                    await c.selfmanaged_snap_remove(pool, 12345)
+                assert ei.value.code == -_errno.EINVAL
+                with pytest.raises(RadosError) as ei:
+                    await c.selfmanaged_snap_create(777)
+                assert ei.value.code == -_errno.ENOENT
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
