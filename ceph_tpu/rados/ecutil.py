@@ -368,3 +368,108 @@ async def decode_object_async(codec, sinfo: StripeInfo,
             fut, finish = planned
             return finish(await asyncio.wrap_future(fut))
     return decode_object(codec, sinfo, blobs, object_size, queue=None)
+
+
+# -- bit-planar residency (ceph_tpu/parallel/service.py PlanarShardStore) ----
+#
+# The measured ~1.6x win (ops/gf2.py writeup): shards stay in HBM as int8
+# bit-planes across encode -> decode -> recovery, and the pack/unpack
+# boundary is paid once, when bytes enter or leave the device tier.  The
+# reference's per-stripe hot loop (src/osd/ECUtil.cc:123-160) keeps its
+# buffer cache-resident for one stripe; residency here spans pipeline
+# stages.  Byte-layout, unmapped, concat-safe codecs only — the same
+# eligibility as the batching-queue encode plan.
+
+
+def planar_eligible(codec) -> bool:
+    return (getattr(codec, "bit_layout", "byte") == "byte"
+            and not codec.get_chunk_mapping()
+            and concat_safe(codec)
+            and codec.bit_generator() is not None)
+
+
+async def planar_encode_async(codec, sinfo: StripeInfo, data: bytes,
+                              queue=None):
+    """Encode with planar residency: the data rows ride the queue's
+    RESIDENT lane — one fused batched device call (unpack + matmul +
+    parity pack) shared with every concurrent op — and come back as
+    (packed parity for persistence, planar rows to keep HBM-resident).
+    Submission does no device work on the caller's thread, so concurrent
+    ops coalesce exactly like the packed lane.  Returns (blobs, all_bits,
+    n_rows, n_cols, w) — blobs is the per-shard host list (same contract
+    as batched_encode); w MUST be recorded with the resident (w=16/w=4
+    pools unpack to different plane layouts) — or None when the codec is
+    not planar-eligible."""
+    if not planar_eligible(codec):
+        return None
+    padded = sinfo.pad_to_stripe(data)
+    if not len(padded):
+        return None
+    import asyncio
+
+    k = codec.get_data_chunk_count()
+    n = codec.get_chunk_count()
+    m = n - k
+    w = getattr(codec, "w", 8)
+    n_stripes = max(1, len(padded) // sinfo.stripe_width)
+    flat = np.ascontiguousarray(
+        np.frombuffer(padded, dtype=np.uint8)
+        .reshape(n_stripes, k, sinfo.chunk_size)
+        .transpose(1, 0, 2).reshape(k, n_stripes * sinfo.chunk_size))
+    L = flat.shape[1]
+    mbits = np.asarray(codec.bit_generator()).astype(np.int8)
+    if queue is not None:
+        parity, all_bits = await asyncio.wrap_future(
+            queue.submit_resident(mbits, flat, w, m))
+    else:
+        from ceph_tpu.ops.gf2 import bucket_columns, gf2_encode_resident
+
+        Lb = bucket_columns(L)  # pow2 bucketing bounds XLA recompiles
+        buf = flat
+        if Lb != L:
+            buf = np.zeros((k, Lb), dtype=np.uint8)
+            buf[:, :L] = flat
+        parity, all_bits = gf2_encode_resident(mbits, buf, w, m)
+        parity = np.asarray(parity)
+    parity = parity[:, :L]
+    blobs = [flat[i] for i in range(k)] + [parity[j] for j in range(m)]
+    return blobs, all_bits, n, L, w
+
+
+def planar_rows(store, key, version) -> Optional[List[np.ndarray]]:
+    """All n shard rows packed from the planar resident under `key`, or
+    None when absent or at a different version.  ONE device pack serves
+    recovery/repair re-encodes with no matmul at all — the resident IS
+    the encoded object."""
+    got = store.get_planar(key)
+    if got is None:
+        return None
+    bits, w, n_rows, meta = got
+    if not meta or meta[0] != version:
+        return None
+    from ceph_tpu.ops.gf2 import from_planar
+
+    L = meta[1]
+    rows = np.asarray(from_planar(bits, w, n_rows))[:, :L]
+    return [rows[i] for i in range(n_rows)]
+
+
+def planar_object_bytes(store, key, version, k: int, cs: int,
+                        object_size: int) -> Optional[bytes]:
+    """The logical object bytes packed from the planar resident's DATA
+    rows (a reconstructing read with zero shard reads and zero decode),
+    or None when absent/stale."""
+    got = store.get_planar(key)
+    if got is None:
+        return None
+    bits, w, n_rows, meta = got
+    if not meta or meta[0] != version:
+        return None
+    from ceph_tpu.ops.gf2 import from_planar
+
+    L = meta[1]
+    data_bits = bits[:k * w]
+    rows = np.asarray(from_planar(data_bits, w, k))[:, :L]
+    n_stripes = max(1, L // cs)
+    out = rows.reshape(k, n_stripes, cs).transpose(1, 0, 2)
+    return out.reshape(-1)[:object_size].tobytes()

@@ -102,6 +102,17 @@ class PGLog:
     def has_reqid(self, reqid: str) -> bool:
         return bool(reqid) and reqid in self._dups
 
+    def latest_entry(self, oid: str) -> Optional[LogEntry]:
+        """The newest log entry touching `oid` within the window, or None
+        when the object has no entry here (trimmed away, or never
+        written) — callers must then fall back to shard queries.  This is
+        the primary's authoritative per-object version source (reference
+        pg_log_t objects index role)."""
+        for e in reversed(self.entries):
+            if e.oid == oid:
+                return e
+        return None
+
     def entries_after(self, version: Version) -> Optional[List[LogEntry]]:
         """Entries with version > `version`, or None if `version` predates
         the tail (log can't catch that peer up -> backfill)."""
