@@ -63,10 +63,24 @@ class BatchingQueue:
         max_pending_bytes: int = 16 << 20,
         max_delay: float = 0.002,
         use_pallas: Optional[bool] = None,
+        mesh=None,
     ):
         self.max_pending_bytes = max_pending_bytes
         self.max_delay = max_delay
         self._use_pallas = use_pallas
+        # device-mesh execution (ceph_tpu/parallel/mesh.py): when a mesh
+        # is attached (or auto-engages on a multi-chip backend), every
+        # dispatch lane lays its batch out across the mesh's column axis
+        # — the same compiled ops run SPMD over all devices, collectives
+        # inserted by XLA where a consumer needs them.  mesh=None means
+        # auto-detect; mesh=False pins the queue single-device (bench
+        # arms and n=1 dryruns that must not auto-engage).
+        if mesh is None:
+            from ceph_tpu.parallel.mesh import shared_mesh
+
+            mesh = shared_mesh()
+        self.mesh = mesh or None
+        self.sharded_dispatches = 0  # dispatches that ran across the mesh
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._groups: Dict[Tuple, _Group] = {}
@@ -205,6 +219,29 @@ class BatchingQueue:
             else:
                 self._dispatch_packed(g)
 
+
+    def _maybe_shard(self, batch, pad_np: bool):
+        """Lay a dispatch batch across the mesh when one is attached.
+        Columns pad out to a device-grid multiple (bucket_columns gives
+        powers of two, which a 6-device grid would never divide) — the
+        pad is zeros beyond every request's slice, so fan-out offsets
+        are unaffected.  Returns (batch, sharded)."""
+        if self.mesh is None:
+            return batch, False
+        try:
+            want = self.mesh.pad_cols(batch.shape[1])
+            if want != batch.shape[1]:
+                extra = want - batch.shape[1]
+                if pad_np:
+                    batch = np.pad(batch, ((0, 0), (0, extra)))
+                else:
+                    import jax.numpy as jnp
+
+                    batch = jnp.pad(batch, ((0, 0), (0, extra)))
+            return self.mesh.shard_batch(batch), True
+        except Exception:
+            return batch, False  # sick mesh: single-device still serves
+
     def _dispatch_packed(self, g: _Group) -> None:
         from ceph_tpu.ops.gf2 import bucket_columns as _bucket
         from ceph_tpu.ops.gf2 import gf2_apply_bytes
@@ -214,14 +251,18 @@ class BatchingQueue:
         pad = _bucket(batch.shape[1]) - batch.shape[1]
         if pad:
             batch = np.pad(batch, ((0, 0), (0, pad)))
-        use_pallas = self._use_pallas
+        batch, sharded = self._maybe_shard(batch, pad_np=True)
+        use_pallas = self._use_pallas and not sharded
         if use_pallas is None:
             from ceph_tpu.ops.gf2 import pallas_enabled
             from ceph_tpu.ops.pallas_gf2 import TILE_B
             from ceph_tpu.utils.jaxdev import probe_backend
 
+            # pallas_call does not run under GSPMD sharding (it would
+            # need a shard_map wrapper); sharded batches take XLA
             use_pallas = (
-                pallas_enabled()
+                not sharded
+                and pallas_enabled()
                 and probe_backend() == "tpu"
                 and batch.shape[1] % TILE_B == 0
             )
@@ -237,6 +278,7 @@ class BatchingQueue:
                     pass
             return
         self.dispatches += 1
+        self.sharded_dispatches += 1 if sharded else 0
         self.bytes_dispatched += batch.nbytes
         off = 0
         for width, (_, fut) in zip(widths, g.requests):
@@ -270,6 +312,7 @@ class BatchingQueue:
             pad = _bucket(batch.shape[1]) - batch.shape[1]
             if pad:
                 batch = jnp.pad(batch, ((0, 0), (0, pad)))
+            batch, sharded = self._maybe_shard(batch, pad_np=False)
             out = gf2_matmul(jnp.asarray(g.mbits), batch)
         except Exception as e:
             for _, fut in g.requests:
@@ -279,6 +322,7 @@ class BatchingQueue:
                     pass
             return
         self.dispatches += 1
+        self.sharded_dispatches += 1 if sharded else 0
         self.bytes_dispatched += sum(w for w in widths) * g.mbits.shape[1] // 8
         off = 0
         for width, (_, fut) in zip(widths, g.requests):
@@ -303,6 +347,7 @@ class BatchingQueue:
             pad = _bucket(batch.shape[1]) - batch.shape[1]
             if pad:
                 batch = np.pad(batch, ((0, 0), (0, pad)))
+            batch, sharded = self._maybe_shard(batch, pad_np=True)
             packed, all_bits = gf2_encode_resident(
                 g.mbits, batch, g.w, g.out_rows)
             packed = np.asarray(packed)
@@ -314,6 +359,7 @@ class BatchingQueue:
                     pass
             return
         self.dispatches += 1
+        self.sharded_dispatches += 1 if sharded else 0
         self.bytes_dispatched += batch.nbytes
         # planar columns per packed byte-column depends on w (w=16: B//2)
         cfac = all_bits.shape[1] / batch.shape[1]
