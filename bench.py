@@ -581,13 +581,20 @@ def daemon_path_bench() -> int:
             payload = np.random.default_rng(0).integers(
                 0, 256, size, dtype=np.uint8).tobytes()
             await c.put(pool, "warm", payload[:1 << 20])
-            t0 = time.perf_counter()
-            await c.put(pool, "big", payload)
-            put_dt = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            got = await c.get(pool, "big")
-            get_dt = time.perf_counter() - t0
-            assert got == payload
+            # best-of-3 (timeit's min discipline): single-core hosts
+            # swing 3x run to run on page-allocation churn; the delete
+            # between trials returns the buffers so each trial measures
+            # the path, not the allocator's cold-page luck
+            put_dt = get_dt = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                await c.put(pool, "big", payload)
+                put_dt = min(put_dt, time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                got = await c.get(pool, "big")
+                get_dt = min(get_dt, time.perf_counter() - t0)
+                assert got == payload
+                await c.delete(pool, "big")
             await c.stop()
             return put_dt, get_dt
         finally:

@@ -27,7 +27,8 @@ def build(force: bool = False) -> str:
     is newer than the .so, so an old build can never miss symbols the
     bridge expects)."""
     srcs = [os.path.join(_NATIVE, f)
-            for f in ("gf256.cc", "rs.cc", "registry.cc", "capi.cc")]
+            for f in ("gf256.cc", "rs.cc", "registry.cc", "capi.cc",
+                      "crc32c.cc")]
     if os.path.exists(_LIB) and not force:
         lib_mtime = os.path.getmtime(_LIB)
         hdrs = [os.path.join(_NATIVE, f)
@@ -83,6 +84,11 @@ def _configure(_lib: ctypes.CDLL) -> None:
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
         ctypes.c_int,
     ]
+    _lib.ceph_tpu_crc32c.restype = ctypes.c_uint32
+    _lib.ceph_tpu_crc32c.argtypes = [
+        ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t]
+    _lib.ceph_tpu_crc32c_kind.restype = ctypes.c_char_p
+    _lib.ceph_tpu_crc32c_kind.argtypes = []
     _lib.ceph_tpu_rs_decode.restype = ctypes.c_int
     _lib.ceph_tpu_rs_decode.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
@@ -176,3 +182,38 @@ def rs_decode(
     if rc != 0:
         raise RuntimeError(f"native decode failed ({rc})")
     return out
+
+
+def _buf_arg(data):
+    """Zero-copy ctypes argument for any contiguous buffer: bytes pass
+    through; bytearray/writable memoryview wrap via from_buffer (a c_char
+    array is accepted where c_char_p is declared); anything else copies."""
+    if isinstance(data, bytes):
+        return data
+    if isinstance(data, bytearray):
+        return (ctypes.c_char * len(data)).from_buffer(data)
+    if isinstance(data, memoryview):
+        if not data.contiguous:
+            return bytes(data)
+        if data.readonly:
+            obj = getattr(data, "obj", None)
+            if isinstance(obj, bytes) and data.nbytes == len(obj):
+                return obj  # whole-bytes view: pass the bytes directly
+            return bytes(data)
+        return (ctypes.c_char * data.nbytes).from_buffer(data)
+    try:
+        return _buf_arg(memoryview(data))  # numpy arrays et al.
+    except TypeError:
+        return bytes(data)
+
+
+def crc32c(data, seed: int = 0) -> int:
+    """Seedable hardware CRC32C (SSE4.2, table fallback) — the native
+    checksum behind the messenger frames and BlueStore extents (reference
+    src/common/crc32c.cc role)."""
+    n = data.nbytes if isinstance(data, memoryview) else len(data)
+    return lib().ceph_tpu_crc32c(seed, _buf_arg(data), n)
+
+
+def crc32c_kind() -> str:
+    return lib().ceph_tpu_crc32c_kind().decode()

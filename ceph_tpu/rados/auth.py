@@ -129,6 +129,11 @@ class SecureStream:
         ct = self._gcm.encrypt(nonce, bytes(data), None)
         self._writer.write(len(ct).to_bytes(4, "big") + nonce + ct)
 
+    def writelines(self, segments) -> None:
+        # AES-GCM copies into the ciphertext anyway: scatter-gather
+        # degrades to one join + one encrypted record (still one syscall)
+        self.write(b"".join(bytes(s) for s in segments))
+
     async def drain(self) -> None:
         await self._writer.drain()
 

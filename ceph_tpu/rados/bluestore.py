@@ -28,6 +28,8 @@ import os
 import pickle
 import random
 import zlib
+
+from ceph_tpu.utils.checksum import checksum
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
@@ -230,7 +232,7 @@ class BlueStore(ObjectStore):
                            xattrs=dict(old.xattrs) if old else {})
             off = self.alloc.allocate(max(1, len(chunk)))
             onode.extents = [(off, len(chunk))]
-            onode.csums = [zlib.crc32(chunk)]
+            onode.csums = [checksum(chunk)]
             if len(chunk) <= prefer_deferred:
                 # deferred: payload rides the KV WAL; block flush later
                 onode.deferred = True
@@ -285,7 +287,9 @@ class BlueStore(ObjectStore):
         if self.conf.get("bluestore_csum_type", "crc32c") != "none":
             pos = 0
             for (off, length), want in zip(onode.extents, onode.csums):
-                if zlib.crc32(data[pos:pos + length]) != want:
+                got_crc = checksum(data[pos:pos + length])
+                if got_crc != want and zlib.crc32(
+                        data[pos:pos + length]) != want:
                     raise EIOError(f"checksum mismatch on {key} @{off}")
                 pos += length
         return data, onode.meta

@@ -78,6 +78,8 @@ class StripeInfo:
 
     def pad_to_stripe(self, data: bytes) -> bytes:
         want = self.logical_to_next_stripe_offset(len(data))
+        if want == len(data):
+            return data  # aligned: no copy on the hot path
         return data + b"\x00" * (want - len(data))
 
 
@@ -103,10 +105,12 @@ class HashInfo:
         """Fold the NEW chunk bytes of one append into each shard's
         running crc (crc32 chaining, as the reference's bufferlist crc32c
         cumulative update does)."""
+        from ceph_tpu.utils.checksum import checksum
+
         sizes = {len(c) for c in shard_chunks.values()}
         assert len(sizes) == 1, "appends must be chunk-aligned and equal"
         for shard, chunk in shard_chunks.items():
-            self.crcs[shard] = zlib.crc32(chunk, self.crcs[shard])
+            self.crcs[shard] = checksum(chunk, self.crcs[shard])
         self.total_chunk_size += sizes.pop()
 
     def shard_crc(self, shard: int) -> int:

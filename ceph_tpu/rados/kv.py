@@ -17,6 +17,8 @@ import os
 import pickle
 import struct
 import zlib
+
+from ceph_tpu.utils.checksum import checksum
 from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
 _REC = struct.Struct("<II")
@@ -105,8 +107,14 @@ class WalDB(MemDB):
                         break
                     length, crc = _REC.unpack(hdr)
                     blob = f.read(length)
-                    if len(blob) < length or zlib.crc32(blob) != crc:
+                    if len(blob) < length:
                         break  # torn tail: committed prefix only
+                    # algorithm-agnostic verify: a WAL written by a build
+                    # whose checksum resolved differently (crc32c vs
+                    # zlib) must not be mistaken for a torn tail — that
+                    # would silently TRUNCATE committed batches
+                    if checksum(blob) != crc and zlib.crc32(blob) != crc:
+                        break
                     valid_end = f.tell()
                     batch = WriteBatch()
                     batch.ops = pickle.loads(blob)
@@ -121,7 +129,7 @@ class WalDB(MemDB):
 
     def submit(self, batch: WriteBatch) -> None:
         blob = pickle.dumps(batch.ops, protocol=5)
-        self._log.write(_REC.pack(len(blob), zlib.crc32(blob)) + blob)
+        self._log.write(_REC.pack(len(blob), checksum(blob)) + blob)
         self._log.flush()
         os.fsync(self._log.fileno())
         self._apply(batch)

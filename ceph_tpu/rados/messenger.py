@@ -52,7 +52,21 @@ from ceph_tpu.common.throttle import Throttle
 BANNER = b"ceph_tpu msgr v2\n"
 _HDR = struct.Struct("<IHHBIQ")  # len, type, version, flags, crc, seq
 
+# blob-frame payload prefix: pickled length + blob checksum
+_BLOB_PFX = struct.Struct("<II")
+
 FLAG_COMPRESSED = 1
+# FLAG_BLOB: payload = [u32 plen][u32 blob_crc][pickled(plen)][blob].
+# The large binary field of a message (MOSDOp.data, MECSubWrite.chunk, ...)
+# rides OUT OF BAND from the pickle: the sender never copies it into a
+# serialized buffer (scatter-gather writev via writer.writelines), the
+# header crc covers only the small pickled part, and the blob's own
+# hardware crc32c protects the bulk bytes — the zero-copy framing half of
+# the reference's bufferlist-based wire path (src/msg/async/ProtocolV2.cc
+# segments + crc sections role).
+FLAG_BLOB = 2
+# only bulk payloads are worth the second checksum + reattach bookkeeping
+BLOB_MIN = 16 * 1024
 
 ACK_TYPE = 0xFFF0  # control frame: payload is the acked seq (u64)
 
@@ -88,7 +102,29 @@ def encode_payload(msg: Any) -> bytes:
     return pickle.dumps(msg.__dict__, protocol=5)
 
 
-def decode_message(type_id: int, version: int, payload: bytes) -> Any:
+def encode_payload_parts(msg: Any):
+    """(pickled, blob): when the message class declares BLOB_ATTR and the
+    field is bulk bytes, it is stripped from the pickle and returned
+    separately so framing can scatter-gather it with zero copies."""
+    attr = getattr(type(msg), "BLOB_ATTR", None)
+    if attr is not None:
+        blob = msg.__dict__.get(attr)
+        if isinstance(blob, (bytes, bytearray, memoryview)):
+            if len(blob) >= BLOB_MIN:
+                d = dict(msg.__dict__)
+                d[attr] = None  # reattached by decode_message
+                return pickle.dumps(d, protocol=5), blob
+            if isinstance(blob, memoryview):
+                # below the blob threshold the field rides the pickle,
+                # which cannot serialize memoryviews
+                d = dict(msg.__dict__)
+                d[attr] = bytes(blob)
+                return pickle.dumps(d, protocol=5), None
+    return pickle.dumps(msg.__dict__, protocol=5), None
+
+
+def decode_message(type_id: int, version: int, payload: bytes,
+                   blob: Any = None) -> Any:
     cls = _MSG_TYPES.get(type_id)
     if cls is None:
         raise ValueError(f"unknown message type {type_id}")
@@ -98,7 +134,16 @@ def decode_message(type_id: int, version: int, payload: bytes) -> Any:
         )
     obj = cls.__new__(cls)
     obj.__dict__.update(pickle.loads(payload))
+    if blob is not None:
+        setattr(obj, getattr(cls, "BLOB_ATTR"), blob)
     return obj
+
+
+# frame/bulk checksum: the shared hardware-crc32c resolver.  The KIND in
+# use rides the handshake hello: when the two ends resolved differently
+# (one host's native build failed), the connection falls back to zlib for
+# its frames instead of looping on BadFrame forever.
+from ceph_tpu.utils.checksum import checksum, checksum_kind  # noqa: E402
 
 
 class BadFrame(Exception):
@@ -145,6 +190,153 @@ def _cget(conf, key: str, default: Any) -> Any:
 # -- connection --------------------------------------------------------------
 
 
+class FrameReceiver(asyncio.BufferedProtocol):
+    """Zero-copy receive path: installed over the connection's transport
+    (transport.set_protocol) AFTER the handshake, replacing the
+    StreamReader chain whose kernel-copy -> feed_data-extend ->
+    readexactly-slice pipeline double-copies every byte.  BufferedProtocol
+    hands the transport OUR buffer: while a readexactly() is pending, the
+    destination frame buffer itself is exposed, so payload bytes land
+    exactly once.  Write-side flow control keeps working by forwarding
+    pause_writing/resume_writing to the original stream protocol (the
+    StreamWriter's drain() still consults it)."""
+
+    # small backlog cap: bytes that arrive before a readexactly() is
+    # waiting land in _pending and must be COPIED out, so the transport
+    # pauses early — the single-copy path is bytes landing directly in
+    # the registered destination buffer
+    _LIMIT = 128 << 10
+
+    def __init__(self, transport, stream_protocol, leftover: bytes = b""):
+        self._transport = transport
+        self._stream_protocol = stream_protocol
+        self._pending = bytearray(leftover)
+        self._off = 0  # consumed prefix of _pending (O(1) front-consume)
+        self._dest = None  # memoryview being filled by get_buffer
+        self._dest_pos = 0
+        self._scratch = bytearray(64 * 1024)
+        self._scratch_view = memoryview(self._scratch)
+        self._waiter: Optional[asyncio.Future] = None
+        self._eof = False
+        self._exc: Optional[BaseException] = None
+        self._read_paused = False
+
+    # -- protocol side -------------------------------------------------------
+
+    def get_buffer(self, sizehint: int):
+        if self._dest is not None and self._dest_pos < len(self._dest):
+            return self._dest[self._dest_pos:]
+        return self._scratch_view
+
+    def buffer_updated(self, nbytes: int) -> None:
+        if self._dest is not None and self._dest_pos < len(self._dest):
+            self._dest_pos += nbytes
+            # wake the reader only when its buffer is COMPLETE: a wake
+            # per network chunk would round-trip the event loop hundreds
+            # of times per blob, each competing with every other ready
+            # callback in a busy daemon
+            if self._dest_pos >= len(self._dest):
+                self._wake()
+        else:
+            self._pending += self._scratch_view[:nbytes]
+            if len(self._pending) - self._off > self._LIMIT \
+                    and not self._read_paused:
+                self._read_paused = True
+                try:
+                    self._transport.pause_reading()
+                except Exception:
+                    pass
+            self._wake()
+
+    def eof_received(self):
+        self._eof = True
+        self._wake()
+        return False
+
+    def connection_lost(self, exc) -> None:
+        self._eof = True
+        self._exc = exc
+        self._wake()
+        # the StreamWriter still drains through the ORIGINAL stream
+        # protocol: without this forward, a drain() parked on a paused
+        # writer never learns the connection died and waits forever —
+        # holding the connection send lock and wedging every reconnect
+        try:
+            self._stream_protocol.connection_lost(exc)
+        except Exception:
+            pass
+
+    def pause_writing(self) -> None:
+        self._stream_protocol.pause_writing()
+
+    def resume_writing(self) -> None:
+        self._stream_protocol.resume_writing()
+
+    def _wake(self) -> None:
+        w = self._waiter
+        if w is not None and not w.done():
+            w.set_result(None)
+
+    # -- reader side ---------------------------------------------------------
+
+    async def readexactly(self, n: int):
+        pend = self._pending
+        avail = len(pend) - self._off
+        if avail >= n:
+            out = bytes(pend[self._off:self._off + n])
+            self._consume(n)
+            return out
+        buf = bytearray(n)
+        mv = memoryview(buf)
+        pos = avail
+        if pos:
+            mv[:pos] = pend[self._off:]
+            self._off = 0
+            pend.clear()
+            self._maybe_resume()
+        self._dest = mv
+        self._dest_pos = pos
+        try:
+            while self._dest_pos < n:
+                if self._eof:
+                    if self._exc is not None and not isinstance(
+                            self._exc, (ConnectionError, OSError)):
+                        raise self._exc
+                    raise asyncio.IncompleteReadError(
+                        bytes(mv[:self._dest_pos]), n)
+                self._waiter = asyncio.get_running_loop().create_future()
+                try:
+                    await self._waiter
+                finally:
+                    self._waiter = None
+        finally:
+            self._dest = None
+        return buf
+
+    def _consume(self, n: int) -> None:
+        """Advance the consumed-prefix pointer; compact only when the
+        dead prefix dominates (amortized O(1) — a del-from-front per
+        read is an O(len) memmove that dominated profiles)."""
+        self._off += n
+        pend = self._pending
+        if self._off == len(pend):
+            self._off = 0
+            pend.clear()
+        elif self._off > 1 << 16 and self._off * 2 > len(pend):
+            del pend[:self._off]
+            self._off = 0
+        self._maybe_resume()
+
+    def _maybe_resume(self) -> None:
+        if self._read_paused \
+                and len(self._pending) - self._off < self._LIMIT // 2:
+            self._read_paused = False
+            try:
+                self._transport.resume_reading()
+            except Exception:
+                pass
+
+
 class Connection:
     """One ordered session with a peer.  For lossless sessions this object
     outlives TCP transports: seqs, the unacked queue, and the dedupe floor
@@ -179,6 +371,41 @@ class Connection:
         conf = messenger.conf
         self.crc_enabled = bool(_cget(conf, "ms_crc_data", True))
         self.compress_min = int(_cget(conf, "ms_compress_min_size", 0) or 0)
+        # frame checksum for THIS connection: crc32c when both ends run
+        # the native build (negotiated via the hello's "ckind"), zlib
+        # otherwise — a silent per-host resolver difference must degrade,
+        # not deadlock (set by the handshake; default local resolver)
+        self.crc_fn = checksum
+
+    def enable_fast_read(self) -> None:
+        """Swap the StreamReader for the zero-copy FrameReceiver when the
+        transport allows it (plaintext TCP; not already swapped).  Called
+        at serve-loop start — the handshake has fully drained its reads,
+        and any bytes the stream already buffered carry over."""
+        r = self.reader
+        if not isinstance(r, asyncio.StreamReader):
+            return  # SecureStream (AES-GCM) or already a FrameReceiver
+        try:
+            transport = r._transport  # the stream pair shares it
+            if transport is None:
+                return
+            proto = transport.get_protocol()
+            leftover = bytes(r._buffer)
+            r._buffer.clear()
+            receiver = FrameReceiver(transport, proto, leftover)
+            if r.at_eof():
+                receiver._eof = True  # FIN landed before the swap
+            transport.set_protocol(receiver)
+            # the StreamReader may have left the transport paused (its
+            # own flow control); the receiver starts unpaused, so resume
+            # or reads would hang forever once the leftover drains
+            try:
+                transport.resume_reading()
+            except Exception:
+                pass
+        except Exception:
+            return
+        self.reader = receiver
 
     # -- frame IO ------------------------------------------------------------
 
@@ -189,14 +416,33 @@ class Connection:
             if len(compressed) < len(payload):
                 payload = compressed
                 flags |= FLAG_COMPRESSED
-        crc = zlib.crc32(payload) if self.crc_enabled else 0
+        crc = self.crc_fn(payload) if self.crc_enabled else 0
         return _HDR.pack(len(payload), type_id, version, flags, crc, seq) + payload
 
-    async def _write_raw(self, data: bytes) -> None:
+    def _frame_segments(self, type_id: int, version: int, pickled: bytes,
+                        blob, seq: int):
+        """Scatter-gather frame for a blob message: the bulk bytes are
+        never concatenated into a serialized buffer — the transport
+        writev's [hdr, prefix, pickled, blob] as-is.  The header crc
+        covers prefix+pickled (small); the blob carries its own crc32c.
+        Blob frames skip on-wire compression (bulk data is usually
+        incompressible shard bytes; the pickled part is tiny)."""
+        blob_crc = self.crc_fn(blob) if self.crc_enabled else 0
+        prefix = _BLOB_PFX.pack(len(pickled), blob_crc)
+        crc = (self.crc_fn(pickled, self.crc_fn(prefix))
+               if self.crc_enabled else 0)
+        hdr = _HDR.pack(_BLOB_PFX.size + len(pickled) + len(blob),
+                        type_id, version, FLAG_BLOB, crc, seq)
+        return [hdr, prefix, pickled, blob]
+
+    async def _write_raw(self, data) -> None:
         async with self._send_lock:
             if self.closed:
                 raise ConnectionResetError("connection closed")
-            self.writer.write(data)
+            if isinstance(data, list):
+                self.writer.writelines(data)
+            else:
+                self.writer.write(data)
             await self.writer.drain()
 
     async def send(self, msg: Any) -> None:
@@ -211,7 +457,19 @@ class Connection:
             await asyncio.sleep(random.uniform(0, delay))
         self.out_seq += 1
         seq = self.out_seq
-        data = self._frame(msg.TYPE_ID, msg.VERSION, encode_payload(msg), seq)
+        pickled, blob = encode_payload_parts(msg)
+        if blob is not None and self.policy.replay \
+                and isinstance(blob, memoryview):
+            # a view entering the lossless REPLAY queue would pin its
+            # whole backing buffer (e.g. the full k-row encode matrix)
+            # until acked — an unreachable peer would hold object-sized
+            # memory per queued frame.  Lossy sends keep the zero-copy.
+            blob = bytes(blob)
+        if blob is not None:
+            data = self._frame_segments(msg.TYPE_ID, msg.VERSION, pickled,
+                                        blob, seq)
+        else:
+            data = self._frame(msg.TYPE_ID, msg.VERSION, pickled, seq)
         if self.policy.replay:
             # lossless send never fails: the frame joins the session queue
             # and reconnect+replay delivers it exactly once (reference
@@ -232,32 +490,56 @@ class Connection:
     async def send_ack(self, seq: int) -> None:
         payload = struct.pack("<Q", seq)
         await self._write_raw(
-            _HDR.pack(8, ACK_TYPE, 1, 0, zlib.crc32(payload), 0) + payload
+            _HDR.pack(8, ACK_TYPE, 1, 0, self.crc_fn(payload), 0) + payload
         )
 
     def handle_ack(self, seq: int) -> None:
         while self.unacked and self.unacked[0][0] <= seq:
             self.unacked.popleft()
 
-    async def read_frame(self) -> Tuple[int, int, int, bytes, int]:
-        """Returns (type_id, version, seq, payload, cost).  The dispatch
-        throttle is charged `cost` bytes BEFORE the payload is read
-        (receive-side backpressure, reference DispatchQueue throttle);
-        the caller must put() cost back when done with the payload."""
+    async def read_frame(self) -> Tuple[int, int, int, bytes, int, Any]:
+        """Returns (type_id, version, seq, payload, cost, blob).  The
+        dispatch throttle is charged `cost` bytes BEFORE the payload is
+        read (receive-side backpressure, reference DispatchQueue
+        throttle); the caller must put() cost back when done with the
+        payload.  Blob frames (FLAG_BLOB) return the bulk bytes
+        separately, checked against their own crc32c."""
         hdr = await self.reader.readexactly(_HDR.size)
         length, type_id, version, flags, crc, seq = _HDR.unpack(hdr)
         cost = length
         await self.messenger.dispatch_throttle.get(cost)
         try:
-            payload = await self.reader.readexactly(length)
-            if crc and self.crc_enabled and zlib.crc32(payload) != crc:
-                raise BadFrame(f"crc mismatch on frame type {type_id}")
-            if flags & FLAG_COMPRESSED:
-                payload = zlib.decompress(payload)
+            blob = None
+            if flags & FLAG_BLOB:
+                # the blob reads into ITS OWN buffer (FrameReceiver lands
+                # bytes there directly — no giant payload slice)
+                head = await self.reader.readexactly(_BLOB_PFX.size)
+                plen, blob_crc = _BLOB_PFX.unpack_from(head)
+                if _BLOB_PFX.size + plen > length:
+                    # a corrupt plen would drive the blob read negative
+                    # and desync the stream — reject before any read
+                    raise BadFrame(f"bad blob prefix on type {type_id}")
+                pickled = await self.reader.readexactly(plen)
+                blob = await self.reader.readexactly(
+                    length - _BLOB_PFX.size - plen)
+                if crc and self.crc_enabled \
+                        and self.crc_fn(pickled, self.crc_fn(head)) != crc:
+                    raise BadFrame(f"crc mismatch on frame type {type_id}")
+                if blob_crc and self.crc_enabled \
+                        and self.crc_fn(blob) != blob_crc:
+                    raise BadFrame(f"blob crc mismatch on type {type_id}")
+                payload = pickled
+            else:
+                payload = await self.reader.readexactly(length)
+                if crc and self.crc_enabled \
+                        and self.crc_fn(payload) != crc:
+                    raise BadFrame(f"crc mismatch on frame type {type_id}")
+                if flags & FLAG_COMPRESSED:
+                    payload = zlib.decompress(payload)
         except BaseException:
             self.messenger.dispatch_throttle.put(cost)
             raise
-        return type_id, version, seq, payload, cost
+        return type_id, version, seq, payload, cost, blob
 
     async def adopt_transport(self, reader, writer) -> None:
         """Adopt a fresh transport into this session and replay unacked
@@ -274,7 +556,10 @@ class Connection:
             except Exception:
                 pass
             for _, data in list(self.unacked):
-                self.writer.write(data)
+                if isinstance(data, list):
+                    self.writer.writelines(data)
+                else:
+                    self.writer.write(data)
             await self.writer.drain()
 
     async def close(self, gen: Optional[int] = None) -> None:
@@ -304,6 +589,9 @@ class Messenger:
         self.name = name
         self.conf = conf if conf is not None else {}
         self.entity_type = entity_type
+        # resolve the frame checksum NOW (may g++-build the native
+        # library, seconds): daemon construction, never the hot path
+        checksum_kind()
         self.dispatcher: Optional[Callable] = None
         self.server: Optional[asyncio.AbstractServer] = None
         self.addr: Optional[Tuple[str, int]] = None
@@ -381,15 +669,15 @@ class Messenger:
 
     async def _handshake_out(self, reader, writer, lossless: bool,
                              session_id: str):
-        """Returns (peer_name, resumed, reader, writer) — the pair is
-        AES-GCM wrapped when secure mode was negotiated."""
+        """Returns (peer_name, resumed, peer_ckind, reader, writer) —
+        the pair is AES-GCM wrapped when secure mode was negotiated."""
         secure_want = bool(_cget(self.conf, "ms_secure_mode", False))
         writer.write(BANNER)
         nonce = random.randbytes(16)
         hello = {"name": self.name, "type": self.entity_type,
                  "nonce": nonce.hex(), "auth": "",
                  "session": session_id, "lossless": lossless,
-                 "secure": secure_want}
+                 "secure": secure_want, "ckind": checksum_kind()}
         if self.ticket is not None:
             hello["ticket"] = self.ticket.hex()
         writer.write(json.dumps(hello).encode() + b"\n")
@@ -431,7 +719,7 @@ class Messenger:
                     "ms_secure_mode set but connection would be plaintext")
             reader, writer = self._wrap_secure(reader, writer, skey)
         return (peer_hello.get("name", ""), bool(peer_hello.get("resumed")),
-                reader, writer)
+                peer_hello.get("ckind", "zlib"), reader, writer)
 
     async def _handshake_in(self, reader, writer):
         """Returns (peer_name, peer_type, session, lossless, auth_kind,
@@ -480,7 +768,8 @@ class Messenger:
         hello = {"name": self.name, "type": self.entity_type,
                  "nonce": nonce.hex(),
                  "auth": self._auth_tag(their_nonce, key, transcript),
-                 "resumed": resumed, "secure": secure_want}
+                 "resumed": resumed, "secure": secure_want,
+                 "ckind": checksum_kind()}
         writer.write(json.dumps(hello).encode() + b"\n")
         await writer.drain()
         proof = json.loads(await reader.readline())
@@ -502,7 +791,8 @@ class Messenger:
             reader, writer = self._wrap_secure(reader, writer, skey)
         return (peer_hello.get("name", ""), peer_hello.get("type", "client"),
                 peer_hello.get("session", ""), bool(peer_hello.get("lossless")),
-                auth_kind, auth_entity_type, reader, writer)
+                auth_kind, auth_entity_type,
+                peer_hello.get("ckind", "zlib"), reader, writer)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -521,6 +811,14 @@ class Messenger:
         self.addr = self.server.sockets[0].getsockname()[:2]
         return self.addr
 
+    @staticmethod
+    def _negotiated_crc(peer_ckind: str):
+        """Per-connection frame checksum: the fast shared resolver when
+        both ends resolved the same KIND, zlib (which every build has)
+        when they differ — a per-host native-build failure must degrade,
+        never loop every frame through BadFrame."""
+        return checksum if peer_ckind == checksum_kind() else zlib.crc32
+
     async def _accept(self, reader, writer) -> None:
         peer = writer.get_extra_info("peername")[:2]
         task = asyncio.current_task()
@@ -528,7 +826,7 @@ class Messenger:
         try:
             try:
                 (peer_name, peer_type, cookie, lossless, auth_kind,
-                 auth_entity_type,
+                 auth_entity_type, peer_ckind,
                  reader, writer) = await self._handshake_in(reader, writer)
             except (PermissionError, BadFrame, ConnectionError, json.JSONDecodeError,
                     asyncio.IncompleteReadError, ValueError):
@@ -555,15 +853,18 @@ class Messenger:
             # (refreshed on every reconnect handshake)
             conn.auth_kind = auth_kind
             conn.auth_entity_type = auth_entity_type
+            conn.crc_fn = self._negotiated_crc(peer_ckind)
             await self._serve(conn)
         finally:
             self._tasks.discard(task)
 
     async def _serve(self, conn: Connection) -> None:
         gen = conn.transport_gen
+        conn.enable_fast_read()
         try:
             while not conn.closed and conn.transport_gen == gen:
-                type_id, version, seq, payload, cost = await conn.read_frame()
+                (type_id, version, seq, payload, cost,
+                 blob) = await conn.read_frame()
                 try:
                     if conn.transport_gen != gen:
                         return  # transport replaced while we were suspended
@@ -576,7 +877,7 @@ class Messenger:
                         await self._ack_quietly(conn, seq)
                         continue
                     try:
-                        msg = decode_message(type_id, version, payload)
+                        msg = decode_message(type_id, version, payload, blob)
                     except Exception as e:
                         # undecodable (type/version skew): poison-discard so
                         # replay can't redeliver it forever
@@ -661,12 +962,14 @@ class Messenger:
             session_id = conn.session_id if reviving else random.randbytes(8).hex()
             reader, writer = await asyncio.open_connection(*addr)
             try:
-                peer_name, resumed, reader, writer = await self._handshake_out(
+                (peer_name, resumed, peer_ckind, reader,
+                 writer) = await self._handshake_out(
                     reader, writer, policy.replay, session_id
                 )
             except Exception:
                 writer.close()
                 raise
+            crc_fn = self._negotiated_crc(peer_ckind)
             if reviving:
                 if not resumed:
                     # acceptor lost the session (restart/eviction): its reply
@@ -675,10 +978,12 @@ class Messenger:
                     # across an acceptor restart, as in the reference — PG
                     # reqid dedupe above absorbs it).
                     conn.in_seq = 0
+                conn.crc_fn = crc_fn
                 await conn.adopt_transport(reader, writer)
             else:
                 conn = Connection(self, reader, writer, addr, policy,
                                   peer_name, outbound=True)
+                conn.crc_fn = crc_fn
                 conn.session_id = session_id
                 self._conns[addr] = conn
             # serve replies arriving on the outbound connection too

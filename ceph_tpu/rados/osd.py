@@ -1887,7 +1887,14 @@ class OSD:
             blobs = await batched_encode_async(codec, sinfo, data,
                                                queue=self._ec_queue)
         span.event("encoded")
-        hinfo_blob = self._hinfo_for(pool, blobs) if chunk_off < 0 else b""
+        # one crc pass per shard, shared by the hinfo record and every
+        # sub-write's chunk_crc (a fresh object's chained hinfo crc IS
+        # the shard crc)
+        shard_crcs = ([shard_crc(blobs[i])
+                       for i in range(codec.get_chunk_count())]
+                      if chunk_off < 0 else None)
+        hinfo_blob = (self._hinfo_for(pool, blobs, crcs=shard_crcs)
+                      if chunk_off < 0 else b"")
         # Allocate the eversion only after every await above; from here to
         # the local apply the path is synchronous, so the head cannot move
         # underneath us.
@@ -1916,11 +1923,15 @@ class OSD:
         q = self._collector(tid)
         sent = 0
         for shard, osd in remote:
-            chunk = bytes(blobs[shard])
+            # memoryview: the shard row rides the messenger's blob lane
+            # without a bytes() copy; crc reuses the per-shard pass above
+            chunk = memoryview(np.ascontiguousarray(blobs[shard]))
+            crc = (shard_crcs[shard] if shard_crcs is not None
+                   else shard_crc(chunk))
             msg = MECSubWrite(
                 pool_id=op.pool_id, pg=pg, oid=op.oid, shard=shard, chunk=chunk,
                 version=version, object_size=object_size,
-                chunk_crc=shard_crc(chunk), tid=tid, reply_to=self.addr,
+                chunk_crc=crc, tid=tid, reply_to=self.addr,
                 log_entry=entry_blob, chunk_off=chunk_off,
                 shard_size=shard_size, hinfo=hinfo_blob,
                 prior_version=base_version,
@@ -2243,12 +2254,20 @@ class OSD:
         attrs.pop(HashInfo.XATTR_KEY, None)
         return attrs
 
-    def _hinfo_for(self, pool: PoolInfo, encoded) -> bytes:
+    def _hinfo_for(self, pool: PoolInfo, encoded,
+                   crcs: Optional[List[int]] = None) -> bytes:
         """HashInfo blob for a freshly (re-)encoded object (rides recovery
-        pushes so the hinfo_key xattr survives, ECUtil.h:101)."""
+        pushes so the hinfo_key xattr survives, ECUtil.h:101).  A fresh
+        object's chained crc equals the plain shard crc, so callers that
+        already computed per-shard crcs pass them instead of re-hashing
+        every chunk."""
         if pool.pool_type != "ec":
             return b""
         n = self._codec(pool).get_chunk_count()
+        if crcs is not None:
+            sizes = len(encoded[0])
+            h = HashInfo(n, total_chunk_size=sizes, crcs=list(crcs))
+            return h.encode()
         h = HashInfo(n)
         h.append({i: bytes(encoded[i]) for i in range(n)})
         return h.encode()
@@ -2709,7 +2728,9 @@ class OSD:
             if shard >= len(h.crcs):
                 return
             if appended and h.total_chunk_size == chunk_off:
-                h.crcs[shard] = zlib.crc32(chunk, h.crcs[shard]) & 0xFFFFFFFF
+                from ceph_tpu.utils.checksum import checksum
+
+                h.crcs[shard] = checksum(chunk, h.crcs[shard]) & 0xFFFFFFFF
             else:
                 h.crcs[shard] = shard_crc(blob)
             h.total_chunk_size = len(blob)

@@ -178,4 +178,6 @@ class DirStore(ObjectStore):
 
 def shard_crc(chunk: bytes) -> int:
     """crc32 of a shard chunk (deep-scrub comparison value)."""
-    return zlib.crc32(chunk) & 0xFFFFFFFF
+    from ceph_tpu.utils.checksum import checksum
+
+    return checksum(chunk) & 0xFFFFFFFF

@@ -928,3 +928,12 @@ class MOSDPGTemp:
     acting: List[int] = field(default_factory=list)
     from_osd: int = -1
     tid: str = ""
+
+
+# bulk-payload fields that ride the messenger's zero-copy blob
+# channel (FLAG_BLOB scatter-gather framing, messenger.py)
+MOSDOp.BLOB_ATTR = "data"
+MOSDOpReply.BLOB_ATTR = "data"
+MECSubWrite.BLOB_ATTR = "chunk"
+MECSubReadReply.BLOB_ATTR = "chunk"
+MPushShard.BLOB_ATTR = "chunk"
