@@ -211,7 +211,12 @@ def crc32c(data, seed: int = 0) -> int:
     """Seedable hardware CRC32C (SSE4.2, table fallback) — the native
     checksum behind the messenger frames and BlueStore extents (reference
     src/common/crc32c.cc role)."""
-    n = data.nbytes if isinstance(data, memoryview) else len(data)
+    if isinstance(data, (bytes, bytearray)):
+        n = len(data)
+    else:
+        # nbytes, NOT len(): a 2-D or wider-dtype buffer's len() is its
+        # row/element count and would silently checksum a prefix
+        n = memoryview(data).nbytes
     return lib().ceph_tpu_crc32c(seed, _buf_arg(data), n)
 
 

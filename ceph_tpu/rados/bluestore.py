@@ -287,9 +287,9 @@ class BlueStore(ObjectStore):
         if self.conf.get("bluestore_csum_type", "crc32c") != "none":
             pos = 0
             for (off, length), want in zip(onode.extents, onode.csums):
-                got_crc = checksum(data[pos:pos + length])
-                if got_crc != want and zlib.crc32(
-                        data[pos:pos + length]) != want:
+                from ceph_tpu.utils.checksum import verify_any
+
+                if not verify_any(data[pos:pos + length], want):
                     raise EIOError(f"checksum mismatch on {key} @{off}")
                 pos += length
         return data, onode.meta

@@ -111,9 +111,11 @@ class WalDB(MemDB):
                         break  # torn tail: committed prefix only
                     # algorithm-agnostic verify: a WAL written by a build
                     # whose checksum resolved differently (crc32c vs
-                    # zlib) must not be mistaken for a torn tail — that
-                    # would silently TRUNCATE committed batches
-                    if checksum(blob) != crc and zlib.crc32(blob) != crc:
+                    # zlib, either direction) must not be mistaken for a
+                    # torn tail — that would TRUNCATE committed batches
+                    from ceph_tpu.utils.checksum import verify_any
+
+                    if not verify_any(blob, crc):
                         break
                     valid_end = f.tell()
                     batch = WriteBatch()

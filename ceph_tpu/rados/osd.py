@@ -51,6 +51,7 @@ from ceph_tpu.ec.interface import ErasureCodeError
 from ceph_tpu.ec.registry import registry
 from ceph_tpu.rados.crush import CRUSH_ITEM_NONE
 from ceph_tpu.rados.extent_cache import ExtentCache
+from ceph_tpu.utils.checksum import verify_any as crc_verify_any
 from ceph_tpu.rados.ecutil import (HashInfo, StripeInfo,
                                    batched_encode_async,
                                    decode_object_async,
@@ -2758,7 +2759,7 @@ class OSD:
                     ok = False
         if not ok:
             pass
-        elif msg.chunk_crc and shard_crc(msg.chunk) != msg.chunk_crc:
+        elif msg.chunk_crc and not crc_verify_any(msg.chunk, msg.chunk_crc):
             ok = False  # corrupted in flight
         else:
             entry = LogEntry.decode(msg.log_entry) if msg.log_entry else None
@@ -3084,7 +3085,10 @@ class OSD:
             return False, False, 0, 0
         chunk, meta = got
         crc = shard_crc(chunk)
-        ok = crc == meta.chunk_crc
+        # accept-either: a persisted chunk_crc may predate a checksum
+        # algorithm change (crc32c vs zlib) — scrub must not flag every
+        # pre-upgrade object as corrupted
+        ok = crc == meta.chunk_crc or crc_verify_any(chunk, meta.chunk_crc)
         try:
             raw = self.store.getattr(key, HashInfo.XATTR_KEY)
         except (IOError, OSError):
@@ -3093,7 +3097,8 @@ class OSD:
             try:
                 h = HashInfo.decode(raw)
                 if shard < len(h.crcs):
-                    ok = ok and h.crcs[shard] == crc \
+                    ok = ok and (h.crcs[shard] == crc
+                                 or crc_verify_any(chunk, h.crcs[shard])) \
                         and h.total_chunk_size == len(chunk)
             except (ValueError, KeyError, TypeError):
                 ok = False  # unparseable hinfo is itself a scrub error
@@ -3654,7 +3659,7 @@ class OSD:
             try:
                 h = HashInfo.decode(helper_hinfo)
                 if (not h.dirty and lost < len(h.crcs)
-                        and h.crcs[lost] == shard_crc(blob)):
+                        and crc_verify_any(blob, h.crcs[lost])):
                     hinfo_blob = helper_hinfo
             except (ValueError, KeyError, TypeError):
                 pass  # garbled helper hinfo: target recomputes its own

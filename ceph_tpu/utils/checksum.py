@@ -50,3 +50,42 @@ def checksum_kind() -> str:
     if _KIND is None:
         _resolve()
     return _KIND
+
+
+_PY_TABLE = None
+
+
+def _crc32c_py(data, seed: int = 0) -> int:
+    """Pure-Python CRC32C (Castagnoli) — recovery/scrub-time verification
+    only (slow): lets a build whose native library is gone still VERIFY
+    records a crc32c build wrote, so persisted state never reads as torn."""
+    global _PY_TABLE
+    if _PY_TABLE is None:
+        t = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+            t.append(c)
+        _PY_TABLE = t
+    crc = seed ^ 0xFFFFFFFF
+    tbl = _PY_TABLE
+    for b in bytes(data):
+        crc = tbl[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def verify_any(data, want: int) -> bool:
+    """True when `want` matches this data under ANY checksum a build of
+    this framework may have written it with (current resolver, zlib,
+    crc32c-by-table) — the accept-either discipline for persisted state
+    and cross-build wire comparisons; an algorithm change must degrade,
+    never masquerade as corruption or a torn tail."""
+    want &= 0xFFFFFFFF
+    if checksum(data) & 0xFFFFFFFF == want:
+        return True
+    if zlib.crc32(data) & 0xFFFFFFFF == want:
+        return True
+    if _KIND != "crc32c" and _crc32c_py(data) == want:
+        return True
+    return False

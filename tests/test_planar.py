@@ -310,3 +310,88 @@ class TestOsdPlanarResidency:
                 await cluster.stop()
 
         run(go())
+
+
+class TestTransferOverlap:
+    """VERDICT r03 #4: the queue worker double-buffers — round N+1's
+    device staging and compute launch happen BEFORE round N's results
+    are fetched, so H2D transfer overlaps dispatch."""
+
+    def test_split_phase_launch_complete_is_byte_exact(self):
+        from ceph_tpu.ec.gf import gf
+        from ceph_tpu.ec.matrices import (matrix_to_bitmatrix,
+                                          vandermonde_coding_matrix)
+        from ceph_tpu.parallel.service import _Group
+
+        k, m, w = 4, 2, 8
+        mat = vandermonde_coding_matrix(k, m, w)
+        bm = matrix_to_bitmatrix(mat, w).astype(np.int8)
+        fgf = gf(w)
+        rng = np.random.default_rng(21)
+        q = BatchingQueue(max_delay=60.0)  # worker stays idle
+        try:
+            from concurrent.futures import Future
+
+            def group(datas):
+                g = _Group(mbits=bm, w=w, out_rows=m)
+                futs = []
+                for d in datas:
+                    f = Future()
+                    g.requests.append((d, f))
+                    futs.append(f)
+                return g, futs
+
+            d1 = [rng.integers(0, 256, (k, 1024), dtype=np.uint8)
+                  for _ in range(3)]
+            d2 = [rng.integers(0, 256, (k, 2048), dtype=np.uint8)
+                  for _ in range(2)]
+            g1, f1 = group(d1)
+            g2, f2 = group(d2)
+            # launch BOTH rounds before completing either: round 2's
+            # staging must not disturb round 1's in-flight results
+            l1 = q._launch_safe([g1])
+            l2 = q._launch_safe([g2])
+            q._complete_safe(l1)
+            q._complete_safe(l2)
+            for d, f in zip(d1, f1):
+                assert np.array_equal(f.result(timeout=5),
+                                      fgf.matmul(mat, d))
+            for d, f in zip(d2, f2):
+                assert np.array_equal(f.result(timeout=5),
+                                      fgf.matmul(mat, d))
+        finally:
+            q.close()
+
+    def test_backlog_holds_round_in_flight_and_overlaps(self):
+        from ceph_tpu.ec.gf import gf
+        from ceph_tpu.ec.matrices import (matrix_to_bitmatrix,
+                                          vandermonde_coding_matrix)
+
+        k, m, w = 4, 2, 8
+        mat = vandermonde_coding_matrix(k, m, w)
+        bm = matrix_to_bitmatrix(mat, w).astype(np.int8)
+        fgf = gf(w)
+        rng = np.random.default_rng(22)
+        q = BatchingQueue(max_pending_bytes=1, max_delay=0.001)
+        try:
+            late = []
+
+            def inject_backlog():
+                # runs on the WORKER thread right after a round launches:
+                # queue the next round so the backlog check sees pending
+                # work and holds the launched round in flight
+                q._launch_hook = None  # once
+                late.append(q.submit(
+                    bm, rng.integers(0, 256, (k, 2048), dtype=np.uint8),
+                    w, m))
+
+            q._launch_hook = inject_backlog
+            d0 = rng.integers(0, 256, (k, 1024), dtype=np.uint8)
+            f0 = q.submit(bm, d0, w, m)
+            out0 = f0.result(timeout=60)
+            assert np.array_equal(out0, fgf.matmul(mat, d0))
+            late[0].result(timeout=60)
+            assert q.overlapped_rounds >= 1, \
+                "backlogged round did not overlap the in-flight fetch"
+        finally:
+            q.close()
