@@ -281,6 +281,11 @@ class OSD:
         # execution is still gathering await its future
         self._call_results: Dict[str, MOSDOpReply] = {}
         self._notify_inflight: Dict[str, asyncio.Future] = {}
+        # per-object critical sections for in-OSD class calls (the
+        # ClassHandler PG-lock role; see _do_call): (pool, oid) ->
+        # [lock, refcount] — refcounted so eviction can never orphan a
+        # lock some waiter still holds a reference to
+        self._cls_locks: Dict[Tuple[int, str], list] = {}
         # (pool, oid) -> {watcher addr} (reference Watch registry; watchers
         # re-register after a primary change, as librados clients do)
         self._watchers: Dict[Tuple[int, str], Set[Tuple[str, int]]] = {}
@@ -2401,33 +2406,62 @@ class OSD:
         # acting-position drift; data via the replicated read path (a
         # just-promoted primary may not hold a local copy)
         key = (op.pool_id, op.oid, 0)
-        read = await self._do_read_replicated(
-            MOSDOp(op="read", pool_id=op.pool_id, oid=op.oid), pool)
-        hctx = ClsContext(read.data if read.ok else None,
-                          dict(self.store.getattrs(key)))
-        ret, out = fn(hctx, op.data)
-        if hctx.data_dirty and ret >= 0:
-            wr = await self._do_write_replicated(
-                MOSDOp(op="write", pool_id=op.pool_id, oid=op.oid,
-                       data=hctx.data, reqid=uuid.uuid4().hex),
-                pool, pg, acting)
-            if not wr.ok:
-                return MOSDOpReply(ok=False, code=wr.code, error=wr.error)
-        if hctx.xattrs_dirty and ret >= 0:
-            for name, value in hctx.xattrs.items():
-                self.store.setattr(key, name, value)
-            # replicate xattr state to the other acting members so a
-            # failover primary still sees locks/refcounts
-            for shard, osd in enumerate(acting):
-                if osd in (CRUSH_ITEM_NONE, self.osd_id):
-                    continue
-                try:
-                    await self.messenger.send(
-                        self.osdmap.addr_of(osd),
-                        MSetXattrs(pool_id=op.pool_id, oid=op.oid,
-                                   shard=0, xattrs=dict(hctx.xattrs)))
-                except TRANSPORT_ERRORS:
-                    pass
+        # the read-execute-write MUST be atomic per object — that is the
+        # entire contract in-OSD classes exist for (reference
+        # ClassHandler under the PG lock, src/osd/ClassHandler.cc).  The
+        # sharded queue serializes per PG in steady state, but a map
+        # race around pool creation can key two calls differently, so
+        # the primary holds its own per-object critical section.
+        ent = self._cls_locks.setdefault((op.pool_id, op.oid),
+                                         [asyncio.Lock(), 0])
+        ent[1] += 1  # waiter refcount: eviction must never orphan a lock
+        try:
+            return await self._do_call_locked(op, pool, pg, acting, fn,
+                                              key, ent[0])
+        finally:
+            ent[1] -= 1
+            while len(self._cls_locks) > 512:
+                k = next(iter(self._cls_locks))
+                if self._cls_locks[k][1] > 0:
+                    break  # oldest still referenced: trim next time
+                del self._cls_locks[k]
+
+    async def _do_call_locked(self, op, pool, pg, acting, fn, key,
+                              lock) -> MOSDOpReply:
+        from ceph_tpu.services.cls import ClsContext
+
+        async with lock:
+            read = await self._do_read_replicated(
+                MOSDOp(op="read", pool_id=op.pool_id, oid=op.oid), pool)
+            hctx = ClsContext(read.data if read.ok else None,
+                              dict(self.store.getattrs(key)))
+            ret, out = fn(hctx, op.data)
+            if hctx.data_dirty and ret >= 0:
+                wr = await self._do_write_replicated(
+                    MOSDOp(op="write", pool_id=op.pool_id, oid=op.oid,
+                           data=hctx.data, reqid=uuid.uuid4().hex),
+                    pool, pg, acting)
+                if not wr.ok:
+                    return MOSDOpReply(ok=False, code=wr.code,
+                                       error=wr.error)
+            if hctx.xattrs_dirty and ret >= 0:
+                # xattr apply stays INSIDE the critical section: the
+                # advisory-lock class's read-check-set is only atomic if
+                # the next call observes these bytes
+                for name, value in hctx.xattrs.items():
+                    self.store.setattr(key, name, value)
+                # replicate xattr state to the other acting members so a
+                # failover primary still sees locks/refcounts
+                for shard, osd in enumerate(acting):
+                    if osd in (CRUSH_ITEM_NONE, self.osd_id):
+                        continue
+                    try:
+                        await self.messenger.send(
+                            self.osdmap.addr_of(osd),
+                            MSetXattrs(pool_id=op.pool_id, oid=op.oid,
+                                       shard=0, xattrs=dict(hctx.xattrs)))
+                    except TRANSPORT_ERRORS:
+                        pass
         reply = MOSDOpReply(ok=True, data=pickle.dumps((ret, out)))
         if op.reqid:
             self._call_results[op.reqid] = reply

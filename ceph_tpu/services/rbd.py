@@ -75,9 +75,39 @@ class Image:
     def _header_oid(name: str) -> str:
         return f"rbd_header.{name}"
 
-    async def _save_header(self) -> None:
+    async def _save_header(self, drop_blocks=()) -> None:
+        """Whole-header write-back.  Routed through the in-OSD rbd class
+        (reference cls_rbd, src/cls/rbd/cls_rbd.cc) when the pool
+        supports class calls, so a concurrent merge_object_map cannot
+        interleave mid-update; EC pools (class calls answer EOPNOTSUPP
+        per reference semantics) keep the client-side write."""
+        got = await self._hdr_cls(
+            "set_header",
+            {"header": self._hdr, "drop_blocks": sorted(drop_blocks)})
+        if got is not None:
+            ret, out = got
+            if ret == 0:
+                # adopt the server-side merge: concurrent writers'
+                # object-map/snap updates survive our push
+                self._hdr = json.loads(out)
+                return
+            if ret != -errno.ENOENT:
+                raise RbdError(f"set_header failed ({ret})")
+            # header object vanished (image being removed): fall through
         await self.ioctx.write_full(self._header_oid(self.name),
                                     json.dumps(self._hdr).encode())
+
+    async def _hdr_cls(self, method: str, payload: Dict):
+        """(ret, out) from an in-OSD rbd-class call on this image's
+        header, or None on an EC pool (caller takes the client path)."""
+        try:
+            return await self.ioctx.execute(
+                self._header_oid(self.name), "rbd", method,
+                json.dumps(payload).encode())
+        except RadosError as e:
+            if e.code == -errno.EOPNOTSUPP:
+                return None
+            raise
 
     # -- IO ------------------------------------------------------------------
 
@@ -191,13 +221,30 @@ class Image:
                 dirty_map = True
             pos += n
         if dirty_map:
-            self._hdr["object_map"] = sorted(objmap)
-            await self._save_header()
+            await self._merge_object_map(objmap)
+
+    async def _merge_object_map(self, objmap) -> None:
+        """Record newly-materialized blocks.  In-OSD merge (cls_rbd
+        object_map_update role): two clients writing disjoint blocks
+        concurrently must both land — the client-side whole-header
+        rewrite loses one side's blocks in that race."""
+        got = await self._hdr_cls("merge_object_map",
+                                  {"add": sorted(objmap)})
+        if got is not None:
+            ret, out = got
+            if ret != 0:
+                raise RbdError(f"object map update failed ({ret})")
+            self._hdr = json.loads(out)
+            return
+        self._hdr["object_map"] = sorted(
+            set(self._hdr["object_map"]) | set(objmap))
+        await self._save_header()
 
     async def resize(self, new_size: int) -> None:
         old_size = self.size
         old_objects = (old_size + self.object_size - 1) // self.object_size
         new_objects = (new_size + self.object_size - 1) // self.object_size
+        dropped = []
         if new_size < old_size:
             snapc = self._image_snapc()
             objmap = set(self._hdr["object_map"])
@@ -211,6 +258,7 @@ class Image:
                     except RadosError:
                         pass
                     objmap.discard(idx)
+                    dropped.append(idx)
             # truncate the partial boundary object so a later grow reads
             # zeros, not pre-shrink data (reference librbd trims it)
             tail = new_size % self.object_size
@@ -224,7 +272,7 @@ class Image:
                     pass
             self._hdr["object_map"] = sorted(objmap)
         self._hdr["size"] = new_size
-        await self._save_header()
+        await self._save_header(drop_blocks=dropped)
 
     async def stat(self) -> Dict:
         return {"size": self.size, "object_size": self.object_size,
@@ -254,10 +302,26 @@ class Image:
         return (ids[0], ids)
 
     async def snap_create(self, name: str) -> None:
-        snaps = self._snaps()
-        if name in snaps:
+        """Single in-OSD call (cls_rbd snapshot_add role): the snap
+        lands in the header atomically against concurrent writers'
+        object-map merges."""
+        if name in self._snaps():
             raise RbdError(f"snapshot {name!r} exists")
         snap_id = await self.ioctx.allocate_snap_id()
+        got = await self._hdr_cls("snap_create",
+                                  {"name": name, "snap_id": snap_id})
+        if got is not None:
+            ret, out = got
+            if ret != 0:
+                # ANY failure releases the freshly-allocated id — a
+                # leaked id keeps its clones untrimmable forever
+                await self.ioctx.release_snap_id(snap_id)
+                if ret == -17:
+                    raise RbdError(f"snapshot {name!r} exists")
+                raise RbdError(f"snap_create failed ({ret})")
+            self._hdr = json.loads(out)
+            return
+        snaps = self._snaps()
         snaps[name] = {"id": snap_id, "size": self.size,
                        "object_map": list(self._hdr["object_map"])}
         await self._save_header()
@@ -324,6 +388,14 @@ class Image:
         snap = self._snaps().get(name)
         if snap is None:
             raise RbdError(f"no snapshot {name!r}")
+        got = await self._hdr_cls("set_protection",
+                                  {"name": name, "protected": True})
+        if got is not None:
+            ret, out = got
+            if ret != 0:
+                raise RbdError(f"snap_protect failed ({ret})")
+            self._hdr = json.loads(out)
+            return
         snap["protected"] = True
         await self._save_header()
 
@@ -336,6 +408,14 @@ class Image:
             raise RbdError(
                 f"snapshot {name!r} has children {children}; flatten or "
                 f"remove them first")
+        got = await self._hdr_cls("set_protection",
+                                  {"name": name, "protected": False})
+        if got is not None:
+            ret, out = got
+            if ret != 0:
+                raise RbdError(f"snap_unprotect failed ({ret})")
+            self._hdr = json.loads(out)
+            return
         snap["protected"] = False
         await self._save_header()
 
@@ -375,9 +455,23 @@ class Image:
         snap = snaps.get(name)
         if snap is None:
             raise RbdError(f"no snapshot {name!r}")
-        # release FIRST: if the mon call fails, the header still names
-        # the snap and snap_remove can be retried — the reverse order
-        # would leak the snap id and its clones with no handle left
+        # the AUTHORITATIVE protection check is the in-OSD header (a
+        # concurrent client may have protected the snap after we opened
+        # the image): remove from the header FIRST, release the id after.
+        # A failed release then leaks the snap id (space, retried by an
+        # operator) — the reverse order could release a PROTECTED snap's
+        # id and let snap-trim destroy its clones (data loss).
+        got = await self._hdr_cls("snap_remove", {"name": name})
+        if got is not None:
+            ret, out = got
+            if ret == -16:
+                raise RbdError(f"snapshot {name!r} is protected")
+            if ret not in (0, -2):
+                raise RbdError(f"snap_remove failed ({ret})")
+            if ret == 0:
+                self._hdr = json.loads(out)
+            await self.ioctx.release_snap_id(snap["id"])
+            return
         await self.ioctx.release_snap_id(snap["id"])
         snaps.pop(name, None)
         await self._save_header()
@@ -392,6 +486,22 @@ class RBD:
     async def create(self, name: str, size: int,
                      order: int = DEFAULT_ORDER) -> Image:
         hdr_oid = Image._header_oid(name)
+        header = {"id": uuid.uuid4().hex[:12], "size": size, "order": order,
+                  "object_map": []}
+        # single in-OSD call (cls_rbd create role): exclusive creation —
+        # two racing create()s cannot both win the check-then-write
+        try:
+            ret, _ = await self.ioctx.execute(
+                hdr_oid, "rbd", "create",
+                json.dumps({"header": header}).encode())
+            if ret == -17:
+                raise RbdError(f"image {name!r} exists")
+            if ret != 0:
+                raise RbdError(f"create failed ({ret})")
+            return Image(self.ioctx, name, header)
+        except RadosError as e:
+            if e.code != -errno.EOPNOTSUPP:
+                raise
         try:
             await self.ioctx.read(hdr_oid)
             raise RbdError(f"image {name!r} exists")
@@ -401,8 +511,6 @@ class RBD:
             # data objects and journal) — same discipline as open()
             if e.code != -errno.ENOENT:
                 raise
-        header = {"id": uuid.uuid4().hex[:12], "size": size, "order": order,
-                  "object_map": []}
         await self.ioctx.write_full(hdr_oid, json.dumps(header).encode())
         return Image(self.ioctx, name, header)
 

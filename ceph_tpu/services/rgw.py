@@ -135,7 +135,39 @@ class RgwService:
         await self.ioctx.write_full(self._index_oid(bucket),
                                     json.dumps(index).encode())
 
+    async def _idx_cls(self, bucket: str, method: str, payload: Dict):
+        """Bucket-index mutation as a single in-OSD class call
+        (reference cls_rgw, src/cls/rgw/cls_rgw.cc: the index is
+        cls-maintained precisely so concurrent gateways update it
+        atomically).  Returns (ret, out), or None on an EC pool — where
+        class calls answer EOPNOTSUPP per reference semantics — so
+        callers fall back to the client-side read-modify-write (which is
+        then the ONLY writer path and keeps its existing behavior)."""
+        try:
+            return await self.ioctx.execute(
+                self._index_oid(bucket), "rgw", method,
+                json.dumps(payload).encode())
+        except RadosError as e:
+            if e.code == -errno.EOPNOTSUPP:
+                return None
+            raise
+
     async def create_bucket(self, bucket: str) -> None:
+        made = await self._idx_cls(bucket, "bucket_init", {})
+        if made is not None:
+            ret, _ = made
+            if ret not in (0, -17):  # -EEXIST: already created, idempotent
+                raise RadosError(f"bucket_init failed ({ret})", code=ret)
+            if ret == 0:
+                try:
+                    await self.ioctx.execute(
+                        BUCKETS_ROOT, "rgw", "registry_add",
+                        json.dumps({"bucket": bucket}).encode())
+                except RadosError as e:
+                    if e.code != -errno.EOPNOTSUPP:
+                        raise
+                await self._log_mutation("create_bucket", bucket)
+            return
         if await self._load_index(bucket) is None:
             await self._save_index(bucket, {})
             buckets = await self.list_buckets()
@@ -151,17 +183,49 @@ class RgwService:
         except RadosError:
             return []
 
+    async def _drop_parts(self, entry: Dict) -> None:
+        """Remove ONLY a manifest entry's part objects — never the plain
+        striped object, which after a multipart->plain replace holds the
+        bytes that were JUST written."""
+        for p in entry.get("parts", ()):
+            try:
+                await self.striper.remove(p["oid"])
+            except RadosError:
+                pass
+
     async def put_object(self, bucket: str, key: str, data: bytes) -> None:
+        # existence check BEFORE writing data: a put to a missing bucket
+        # must not orphan striped objects (small TOCTOU window against a
+        # concurrent bucket delete is bounded and matches the reference)
+        if await self._load_index(bucket) is None:
+            raise RadosError(f"NoSuchBucket: {bucket}", code=-errno.ENOENT)
+        meta = {"size": len(data), "etag": hashlib.md5(data).hexdigest()}
+        await self.striper.write(f"{bucket}/{key}", data)
+        got = await self._idx_cls(bucket, "index_put",
+                                  {"key": key, "meta": meta})
+        if got is not None:
+            ret, out = got
+            if ret == -2:
+                raise RadosError(f"NoSuchBucket: {bucket}",
+                                 code=-errno.ENOENT)
+            if ret < 0:
+                raise RadosError(f"index_put failed ({ret})", code=ret)
+            prev = json.loads(out or b"{}").get("prev")
+            if prev and "parts" in prev:
+                # the replaced entry was a multipart manifest: its part
+                # objects are unreferenced now (parts ONLY — the plain
+                # striped object is the data just written)
+                await self._drop_parts(prev)
+            await self._log_mutation("put", bucket, key)
+            return
         index = await self._load_index(bucket)
         if index is None:
             raise RadosError(f"NoSuchBucket: {bucket}")
         prev = index.get(key)
-        if prev and "parts" in prev:
-            await self._drop_object_data(bucket, key, prev)
-        await self.striper.write(f"{bucket}/{key}", data)
-        index[key] = {"size": len(data),
-                      "etag": hashlib.md5(data).hexdigest()}
+        index[key] = meta
         await self._save_index(bucket, index)
+        if prev and "parts" in prev:
+            await self._drop_parts(prev)
         await self._log_mutation("put", bucket, key)
 
     async def get_object(self, bucket: str, key: str) -> bytes:
@@ -196,6 +260,17 @@ class RgwService:
             pass
 
     async def delete_object(self, bucket: str, key: str) -> None:
+        got = await self._idx_cls(bucket, "index_rm", {"key": key})
+        if got is not None:
+            ret, out = got
+            if ret == -2 and await self._load_index(bucket) is None:
+                raise RadosError(f"NoSuchBucket: {bucket}",
+                                 code=-errno.ENOENT)
+            entry = (json.loads(out or b"{}").get("prev")
+                     if ret == 0 else None)
+            await self._drop_object_data(bucket, key, entry)
+            await self._log_mutation("delete", bucket, key)
+            return
         index = await self._load_index(bucket)
         if index is None:
             raise RadosError(f"NoSuchBucket: {bucket}")
@@ -227,11 +302,19 @@ class RgwService:
             raise RadosError(f"BucketNotEmpty: {bucket} has "
                              f"{len(uploads)} multipart upload(s) in flight")
         await self.ioctx.remove(self._index_oid(bucket))
-        buckets = await self.list_buckets()
-        if bucket in buckets:
-            buckets.remove(bucket)
-            await self.ioctx.write_full(
-                BUCKETS_ROOT, json.dumps(sorted(buckets)).encode())
+        try:
+            await self.ioctx.execute(
+                BUCKETS_ROOT, "rgw", "registry_rm",
+                json.dumps({"bucket": bucket}).encode())
+        except RadosError as e:
+            if e.code != -errno.EOPNOTSUPP:
+                raise
+            # EC pool: client-side registry (single-writer semantics)
+            buckets = await self.list_buckets()
+            if bucket in buckets:
+                buckets.remove(bucket)
+                await self.ioctx.write_full(
+                    BUCKETS_ROOT, json.dumps(sorted(buckets)).encode())
         await self._log_mutation("delete_bucket", bucket)
 
     # -- multipart (reference rgw multipart upload machinery) ---------------
@@ -285,15 +368,28 @@ class RgwService:
         if not order or any(n not in have for n in order):
             raise RadosError("InvalidPart: upload has missing parts")
         key = meta["key"]
-        await self._drop_object_data(bucket, key, index.get(key))
         manifest = [have[n] for n in order]
         # S3 multipart etag convention: md5 of concatenated part md5s
         etag = hashlib.md5(
             b"".join(bytes.fromhex(p["etag"]) for p in manifest)
         ).hexdigest() + f"-{len(manifest)}"
-        index[key] = {"size": sum(p["size"] for p in manifest),
-                      "etag": etag, "parts": manifest}
-        await self._save_index(bucket, index)
+        entry = {"size": sum(p["size"] for p in manifest),
+                 "etag": etag, "parts": manifest}
+        got = await self._idx_cls(bucket, "index_put",
+                                  {"key": key, "meta": entry})
+        if got is not None:
+            ret, out = got
+            if ret < 0:
+                raise RadosError(f"index_put failed ({ret})", code=ret)
+            # the REPLACED entry's data is stale now: old parts and the
+            # old plain object both (the new bytes live in OUR parts)
+            prev = json.loads(out or b"{}").get("prev")
+            await self._drop_object_data(bucket, key, prev)
+        else:
+            prev = index.get(key)
+            index[key] = entry
+            await self._save_index(bucket, index)
+            await self._drop_object_data(bucket, key, prev)
         await self.ioctx.remove(self._upload_meta_oid(bucket, upload_id))
         # a completed multipart IS an object mutation: without this the
         # zone sync agent never replicates multipart uploads
