@@ -381,3 +381,60 @@ def _rgw_registry_rm(hctx: ClsContext, inp: bytes) -> Tuple[int, bytes]:
         buckets.remove(bucket)
         hctx.write(json.dumps(buckets).encode())
     return 0, b""
+
+
+@cls_method("rgw", "index_put_version")
+def _rgw_index_put_version(hctx: ClsContext, inp: bytes) -> Tuple[int, bytes]:
+    """Append one object VERSION to an index entry atomically (reference
+    cls_rgw versioned-bucket index ops): the entry keeps its full
+    version stack plus derived newest-live size/etag for flat readers."""
+    raw = hctx.read()
+    if raw is None:
+        return ENOENT, b""
+    index = _json_or({}, raw)
+    req = _json_or({}, inp)
+    key, ver = req.get("key"), req.get("version")
+    if not key or not isinstance(ver, dict):
+        return EINVAL, b""
+    entry = index.get(key)
+    if not isinstance(entry, dict) or "versions" not in entry:
+        entry = {"versions": ([] if entry is None else
+                              [dict(entry, vid="null",
+                                    ts=entry.get("ts", 0))])}
+    entry["versions"].append(ver)
+    cur = entry["versions"][-1]
+    cur = None if cur.get("delete_marker") else cur
+    entry["size"] = cur.get("size", 0) if cur else 0
+    entry["etag"] = cur.get("etag", "") if cur else ""
+    index[key] = entry
+    hctx.write(json.dumps(index).encode())
+    return 0, json.dumps(entry).encode()
+
+
+@cls_method("rgw", "index_rm_version")
+def _rgw_index_rm_version(hctx: ClsContext, inp: bytes) -> Tuple[int, bytes]:
+    raw = hctx.read()
+    if raw is None:
+        return ENOENT, b""
+    index = _json_or({}, raw)
+    req = _json_or({}, inp)
+    key, vid = req.get("key"), req.get("vid")
+    entry = index.get(key)
+    if not key or not vid or not isinstance(entry, dict) \
+            or "versions" not in entry:
+        return ENOENT, b""
+    removed = [v for v in entry["versions"] if v.get("vid") == vid]
+    if not removed:
+        return ENOENT, b""
+    entry["versions"] = [v for v in entry["versions"]
+                         if v.get("vid") != vid]
+    if entry["versions"]:
+        cur = entry["versions"][-1]
+        cur = None if cur.get("delete_marker") else cur
+        entry["size"] = cur.get("size", 0) if cur else 0
+        entry["etag"] = cur.get("etag", "") if cur else ""
+        index[key] = entry
+    else:
+        index.pop(key)
+    hctx.write(json.dumps(index).encode())
+    return 0, json.dumps({"removed": removed[0]}).encode()

@@ -25,6 +25,7 @@ cls lock class when callers need it.
 
 from __future__ import annotations
 
+import asyncio
 import errno
 import json
 import posixpath
@@ -549,3 +550,141 @@ class MDSServer:
     async def stat(self, session: MDSSession, path: str) -> Dict:
         self._require(session, path, "r")
         return await self.fs.stat(path)
+
+
+class CephFSClient:
+    """The CLIENT half of the filesystem (reference src/client/Client.cc
+    in miniature): a cap-aware cache over an MDSServer session.
+
+    Capability semantics, as the reference client enforces them in its
+    own cache (Client.cc Fc/Fb handling):
+    - under a SHARED "r" cap, reads are cached locally and served
+      without touching the MDS until the cap goes away;
+    - under an EXCLUSIVE "rw" cap, writes are WRITE-BEHIND: they land in
+      the local dirty cache and reach the MDS only on flush — revoke,
+      release, fsync, or unmount;
+    - a revoke (delivered on lease renewal, the reference's cap message
+      flow) forces compliance before the conflicting client's grant can
+      succeed: flush dirty bytes, drop the cache, release the cap.
+
+    Coherence across clients therefore holds exactly because the server
+    refuses a conflicting grant until the holder has complied — the
+    writer's dirty data is visible to the next reader by construction.
+
+    ``renew_interval`` piggybacks a lease renewal (and thus revoke
+    processing) on client operations, so a busy client converges without
+    a background thread; tests and embedders may call renew() directly.
+    """
+
+    def __init__(self, mds: MDSServer, client: str = "client",
+                 renew_interval: float = 1.0):
+        self.mds = mds
+        self.session = mds.open_session(client)
+        self.renew_interval = renew_interval
+        self._last_renew = time.monotonic()
+        self._clean: Dict[str, bytes] = {}  # path -> cached file data
+        self._dirty: Dict[str, bytes] = {}  # path -> write-behind data
+        self.cache_hits = 0
+        self.flushes = 0
+
+    # -- cap compliance ------------------------------------------------------
+
+    async def renew(self) -> None:
+        """Renew the lease and COMPLY with pending revokes: flush dirty
+        data, drop the cache, release the cap — the contract that lets
+        the MDS grant the path to the conflicting client."""
+        for path in self.mds.sessions.get(
+                self.session.session_id, self.session).renew():
+            await self._flush_path(path)
+            self._clean.pop(path, None)
+            self.mds.release_cap(self.session, path)
+
+    async def _maybe_renew(self) -> None:
+        if time.monotonic() - self._last_renew >= self.renew_interval:
+            self._last_renew = time.monotonic()
+            await self.renew()
+
+    async def _flush_path(self, path: str) -> None:
+        data = self._dirty.pop(path, None)
+        if data is not None:
+            self.flushes += 1
+            await self.mds.write_file(self.session, path, data)
+            self._clean[path] = data
+
+    async def _acquire(self, path: str, mode: str,
+                       retries: int = 20, delay: float = 0.05) -> None:
+        """Acquire with revoke-processing retries: a CapConflict means a
+        live holder was asked to give the cap back — renew (processing
+        OUR revokes too) and retry while the holder complies."""
+        for attempt in range(retries):
+            try:
+                self.mds.acquire_cap(self.session, path, mode)
+                return
+            except CapConflict:
+                await self.renew()
+                if attempt == retries - 1:
+                    raise
+                await asyncio.sleep(delay)
+
+    # -- file surface (libcephfs role) ---------------------------------------
+
+    async def write(self, path: str, data: bytes) -> None:
+        await self._maybe_renew()
+        held = self.session.caps.get(FileSystem._norm(path))
+        if held != "rw":
+            await self._acquire(path, "rw")
+        # write-behind under the exclusive cap: bytes stay local
+        self._dirty[FileSystem._norm(path)] = bytes(data)
+
+    async def read(self, path: str) -> bytes:
+        await self._maybe_renew()
+        p = FileSystem._norm(path)
+        if p in self._dirty:
+            self.cache_hits += 1
+            return self._dirty[p]  # our own write-behind bytes
+        held = self.session.caps.get(p)
+        if held in ("r", "rw") and p in self._clean:
+            self.cache_hits += 1
+            return self._clean[p]
+        if held is None:
+            await self._acquire(path, "r")
+        data = await self.mds.read_file(self.session, path)
+        self._clean[p] = data
+        return data
+
+    async def fsync(self, path: str) -> None:
+        await self._flush_path(FileSystem._norm(path))
+
+    async def mkdir(self, path: str) -> None:
+        await self._maybe_renew()
+        await self.mds.mkdir(self.session, path)
+
+    async def listdir(self, path: str) -> List[str]:
+        await self._maybe_renew()
+        # a fresh listing must see peers' flushed creates: dir listings
+        # are not cached (the reference caches dentries under Fs caps;
+        # path-granular caps make that a follow-up, not a default)
+        return await self.mds.listdir(self.session, path)
+
+    async def stat(self, path: str) -> Dict:
+        await self._maybe_renew()
+        p = FileSystem._norm(path)
+        if p in self._dirty:
+            return {"type": "file", "size": len(self._dirty[p])}
+        return await self.mds.stat(self.session, path)
+
+    async def unlink(self, path: str) -> None:
+        await self._maybe_renew()
+        p = FileSystem._norm(path)
+        self._dirty.pop(p, None)
+        self._clean.pop(p, None)
+        await self._acquire(path, "rw")
+        await self.mds.unlink(self.session, path)
+
+    async def unmount(self) -> None:
+        """Flush every dirty file, release every cap, close the session
+        (the reference client's unmount barrier)."""
+        for path in list(self._dirty):
+            await self._flush_path(path)
+        self._clean.clear()
+        self.mds.close_session(self.session.session_id)

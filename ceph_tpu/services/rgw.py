@@ -33,8 +33,15 @@ appends to the zone's bounded data log; a ZoneSyncAgent replays another
 zone's log resumably — full image sync (including deletions) when first
 contacted or when trimmed past its position, incremental tail after.
 Replicated applies suppress the destination's own datalog so
-active-active pairs do not echo.  Divergence by design: no
-versioning/ACL policies.
+active-active pairs do not echo.
+
+Data management (reference src/rgw/rgw_lc.cc, rgw_acl.cc): per-bucket
+VERSIONING (every put appends a version; deletes add delete markers;
+gets resolve the newest live version or an explicit versionId),
+LIFECYCLE expiration rules swept by lifecycle_tick (prefix + age; the
+mgr/embedder drives the tick, injectable clock), and bucket ACLs
+(owner + grants, canned private/public-read) enforced by the HTTP
+frontend's principal resolution.
 """
 
 from __future__ import annotations
@@ -152,6 +159,134 @@ class RgwService:
                 return None
             raise
 
+    # -- bucket metadata: versioning / lifecycle / ACL ----------------------
+    #
+    # Stored beside the index (rare admin writes: client-side RMW is the
+    # single-writer admin path, like the reference's bucket-info cache).
+
+    @staticmethod
+    def _meta_oid(bucket: str) -> str:
+        return f".bucket.meta.{bucket}"
+
+    async def get_bucket_meta(self, bucket: str) -> Dict:
+        try:
+            return json.loads(await self.ioctx.read(self._meta_oid(bucket)))
+        except RadosError as e:
+            if e.code != -errno.ENOENT:
+                raise
+            return {"versioning": False, "lifecycle": [], "acl": None}
+
+    async def _save_bucket_meta(self, bucket: str, meta: Dict) -> None:
+        await self.ioctx.write_full(self._meta_oid(bucket),
+                                    json.dumps(meta).encode())
+
+    async def set_versioning(self, bucket: str, enabled: bool) -> None:
+        if await self._load_index(bucket) is None:
+            raise RadosError(f"NoSuchBucket: {bucket}", code=-errno.ENOENT)
+        meta = await self.get_bucket_meta(bucket)
+        meta["versioning"] = bool(enabled)
+        await self._save_bucket_meta(bucket, meta)
+
+    async def put_lifecycle(self, bucket: str, rules: List[Dict]) -> None:
+        """rules: [{"prefix": str, "days": N}, ...] — objects whose key
+        matches prefix and whose age exceeds N days expire on the next
+        lifecycle_tick (reference RGWLC rule model in miniature)."""
+        if await self._load_index(bucket) is None:
+            raise RadosError(f"NoSuchBucket: {bucket}", code=-errno.ENOENT)
+        for rule in rules:
+            try:
+                float(rule["days"])
+            except (KeyError, TypeError, ValueError):
+                raise RadosError("MalformedXML: lifecycle rule needs "
+                                 "numeric days", code=-errno.EINVAL) from None
+        meta = await self.get_bucket_meta(bucket)
+        meta["lifecycle"] = list(rules)
+        await self._save_bucket_meta(bucket, meta)
+
+    async def put_bucket_acl(self, bucket: str, acl: Dict) -> None:
+        """acl: {"owner": access_key, "grants": [{"grantee": "*"|key,
+        "perm": "READ"|"WRITE"|"FULL_CONTROL"}]} (canned "private" =
+        owner-only, "public-read" = owner + {"*": READ})."""
+        if await self._load_index(bucket) is None:
+            raise RadosError(f"NoSuchBucket: {bucket}", code=-errno.ENOENT)
+        meta = await self.get_bucket_meta(bucket)
+        meta["acl"] = acl
+        await self._save_bucket_meta(bucket, meta)
+
+    @staticmethod
+    def acl_allows(acl: Optional[Dict], principal: Optional[str],
+                   need: str) -> bool:
+        """ACL check (reference rgw_acl verify_permission): no ACL set =
+        open (the gateway's anonymous/system mode keeps working); the
+        owner may do anything; grants match an explicit principal or
+        the public grantee "*"."""
+        if not acl:
+            return True
+        if principal is not None and acl.get("owner") == principal:
+            return True
+        for g in acl.get("grants", ()):
+            if g.get("grantee") not in ("*", principal):
+                continue
+            perm = g.get("perm", "")
+            if perm == "FULL_CONTROL" or perm == need:
+                return True
+        return False
+
+    async def lifecycle_tick(self, now: Optional[float] = None) -> int:
+        """One expiration sweep over every bucket's lifecycle rules
+        (reference RGWLC::process): expired objects are deleted through
+        the normal path (so versioned buckets get delete markers and the
+        datalog replicates the expiry).  The mgr/embedder drives this on
+        its periodic tick; `now` is injectable for tests.  Returns the
+        number of objects expired."""
+        now = time.time() if now is None else now
+        expired = 0
+        for bucket in list(await self.list_buckets()):
+            try:
+                expired += await self._lifecycle_bucket(bucket, now)
+            except Exception:
+                # one bucket's bad state must not stop the cluster-wide
+                # sweep (reference RGWLC isolates per-bucket failures)
+                continue
+        return expired
+
+    async def _lifecycle_bucket(self, bucket: str, now: float) -> int:
+        meta = await self.get_bucket_meta(bucket)
+        rules = meta.get("lifecycle") or []
+        if not rules:
+            return 0
+        index = await self._load_index(bucket)
+        if not index:
+            return 0
+        expired = 0
+        for key, entry in list(index.items()):
+            ts = entry.get("ts")
+            if "versions" in entry:
+                vs = entry["versions"]
+                if not vs or vs[-1].get("delete_marker"):
+                    continue  # already expired/deleted
+                ts = vs[-1].get("ts")
+            if ts is None:
+                # unknown age (pre-versioning or multipart entries
+                # without a stamp) must NEVER expire — deleting data of
+                # unknown age is silent loss, not lifecycle policy
+                continue
+            for rule in rules:
+                if not key.startswith(rule.get("prefix", "")):
+                    continue
+                try:
+                    days = float(rule["days"])
+                except (KeyError, TypeError, ValueError):
+                    continue  # malformed legacy rule: skip, not crash
+                if now - ts >= days * 86400.0:
+                    try:
+                        await self.delete_object(bucket, key, now=now)
+                        expired += 1
+                    except RadosError:
+                        pass
+                    break
+        return expired
+
     async def create_bucket(self, bucket: str) -> None:
         made = await self._idx_cls(bucket, "bucket_init", {})
         if made is not None:
@@ -193,13 +328,19 @@ class RgwService:
             except RadosError:
                 pass
 
-    async def put_object(self, bucket: str, key: str, data: bytes) -> None:
+    async def put_object(self, bucket: str, key: str, data: bytes,
+                         now: Optional[float] = None) -> Optional[str]:
         # existence check BEFORE writing data: a put to a missing bucket
         # must not orphan striped objects (small TOCTOU window against a
         # concurrent bucket delete is bounded and matches the reference)
         if await self._load_index(bucket) is None:
             raise RadosError(f"NoSuchBucket: {bucket}", code=-errno.ENOENT)
-        meta = {"size": len(data), "etag": hashlib.md5(data).hexdigest()}
+        now = time.time() if now is None else now
+        bmeta = await self.get_bucket_meta(bucket)
+        if bmeta.get("versioning"):
+            return await self._put_versioned(bucket, key, data, now)
+        meta = {"size": len(data), "etag": hashlib.md5(data).hexdigest(),
+                "ts": now}
         await self.striper.write(f"{bucket}/{key}", data)
         got = await self._idx_cls(bucket, "index_put",
                                   {"key": key, "meta": meta})
@@ -217,7 +358,7 @@ class RgwService:
                 # striped object is the data just written)
                 await self._drop_parts(prev)
             await self._log_mutation("put", bucket, key)
-            return
+            return None
         index = await self._load_index(bucket)
         if index is None:
             raise RadosError(f"NoSuchBucket: {bucket}")
@@ -227,14 +368,80 @@ class RgwService:
         if prev and "parts" in prev:
             await self._drop_parts(prev)
         await self._log_mutation("put", bucket, key)
+        return None
 
-    async def get_object(self, bucket: str, key: str) -> bytes:
+    @staticmethod
+    def _version_oid(bucket: str, key: str, vid: str) -> str:
+        return f"{bucket}/{key}@{vid}"
+
+    async def _put_versioned(self, bucket: str, key: str, data: bytes,
+                             now: float) -> str:
+        """Versioned PUT (reference versioned-bucket semantics): every
+        put appends a NEW version; nothing is overwritten."""
+        vid = uuid.uuid4().hex[:16]
+        await self.striper.write(self._version_oid(bucket, key, vid), data)
+        ver = {"vid": vid, "size": len(data),
+               "etag": hashlib.md5(data).hexdigest(), "ts": now}
+        got = await self._idx_cls(bucket, "index_put_version",
+                                  {"key": key, "version": ver})
+        if got is not None:
+            ret, _ = got
+            if ret == -2:
+                raise RadosError(f"NoSuchBucket: {bucket}",
+                                 code=-errno.ENOENT)
+            if ret < 0:
+                raise RadosError(f"index_put_version failed ({ret})",
+                                 code=ret)
+        else:
+            index = await self._load_index(bucket)
+            if index is None:
+                raise RadosError(f"NoSuchBucket: {bucket}")
+            entry = index.get(key)
+            if not isinstance(entry, dict) or "versions" not in entry:
+                entry = {"versions": ([] if entry is None else
+                                      [dict(entry, vid="null")])}
+            entry["versions"].append(ver)
+            entry["size"], entry["etag"] = len(data), ver["etag"]
+            index[key] = entry
+            await self._save_index(bucket, index)
+        await self._log_mutation("put", bucket, key)
+        return vid
+
+    async def get_object(self, bucket: str, key: str,
+                         version_id: Optional[str] = None) -> bytes:
         index = await self._load_index(bucket)
         if index is None:
             raise RadosError(f"NoSuchBucket: {bucket}")
         if key not in index:
             raise RadosError(f"NoSuchKey: {key}")
         entry = index[key]
+        if "versions" in entry:
+            versions = entry["versions"]
+            if version_id is not None:
+                match = [v for v in versions if v.get("vid") == version_id]
+                if not match:
+                    raise RadosError(f"NoSuchVersion: {version_id}",
+                                     code=-errno.ENOENT)
+                v = match[0]
+                if v.get("delete_marker"):
+                    raise RadosError(f"MethodNotAllowed: {version_id} is "
+                                     f"a delete marker")
+            else:
+                if not versions or versions[-1].get("delete_marker"):
+                    # the CURRENT (newest) version is a delete marker:
+                    # the object reads as absent (S3 semantics)
+                    raise RadosError(f"NoSuchKey: {key}",
+                                     code=-errno.ENOENT)
+                v = versions[-1]
+            if "parts" in v:
+                # the version snapshots a multipart manifest: stitch it
+                blobs = await asyncio.gather(
+                    *(self.striper.read(p["oid"]) for p in v["parts"]))
+                return b"".join(blobs)
+            if v.get("vid") == "null":
+                return await self.striper.read(f"{bucket}/{key}")
+            return await self.striper.read(
+                self._version_oid(bucket, key, v["vid"]))
         if "parts" in entry:
             # manifest object: stitch the parts in order (RGWObjManifest)
             blobs = await asyncio.gather(
@@ -244,10 +451,25 @@ class RgwService:
 
     async def _drop_object_data(self, bucket: str, key: str,
                                 entry: Optional[Dict]) -> None:
-        """Remove an index entry's backing data: its manifest parts AND
-        the plain striped object — a key may have been written both ways
-        over its lifetime, and replacing a plain object with a multipart
-        manifest (or vice versa) must not orphan the other form."""
+        """Remove an index entry's backing data in EVERY form it may
+        exist: version objects (bucket/key@vid), manifest parts, and the
+        plain striped object — a key may have been written all three
+        ways over its lifetime, and dropping one form must not orphan
+        another."""
+        for v in (entry or {}).get("versions", ()):
+            if v.get("delete_marker"):
+                continue
+            for p in v.get("parts", ()):
+                try:
+                    await self.striper.remove(p["oid"])
+                except RadosError:
+                    pass
+            if v.get("vid") not in (None, "null"):
+                try:
+                    await self.striper.remove(
+                        self._version_oid(bucket, key, v["vid"]))
+                except RadosError:
+                    pass
         if entry and "parts" in entry:
             for p in entry["parts"]:
                 try:
@@ -259,7 +481,37 @@ class RgwService:
         except RadosError:
             pass
 
-    async def delete_object(self, bucket: str, key: str) -> None:
+    async def delete_object(self, bucket: str, key: str,
+                            version_id: Optional[str] = None,
+                            now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        bmeta = await self.get_bucket_meta(bucket)
+        if version_id is not None:
+            return await self._delete_version(bucket, key, version_id)
+        if bmeta.get("versioning"):
+            # versioned delete: a DELETE MARKER becomes the newest
+            # version; data stays reachable via explicit versionIds
+            marker = {"vid": uuid.uuid4().hex[:16], "delete_marker": True,
+                      "ts": now}
+            got = await self._idx_cls(bucket, "index_put_version",
+                                      {"key": key, "version": marker})
+            if got is None:
+                index = await self._load_index(bucket)
+                if index is None:
+                    raise RadosError(f"NoSuchBucket: {bucket}")
+                entry = index.get(key)
+                if not isinstance(entry, dict) or "versions" not in entry:
+                    entry = {"versions": ([] if entry is None else
+                                          [dict(entry, vid="null")])}
+                entry["versions"].append(marker)
+                entry["size"], entry["etag"] = 0, ""
+                index[key] = entry
+                await self._save_index(bucket, index)
+            elif got[0] == -2:
+                raise RadosError(f"NoSuchBucket: {bucket}",
+                                 code=-errno.ENOENT)
+            await self._log_mutation("delete", bucket, key)
+            return
         got = await self._idx_cls(bucket, "index_rm", {"key": key})
         if got is not None:
             ret, out = got
@@ -279,11 +531,88 @@ class RgwService:
         await self._save_index(bucket, index)
         await self._log_mutation("delete", bucket, key)
 
+    async def _delete_version(self, bucket: str, key: str,
+                              vid: str) -> None:
+        """Permanently remove ONE version (S3 DELETE ?versionId=...)."""
+        got = await self._idx_cls(bucket, "index_rm_version",
+                                  {"key": key, "vid": vid})
+        removed = None
+        if got is not None:
+            ret, out = got
+            if ret == -2:
+                raise RadosError(f"NoSuchVersion: {vid}",
+                                 code=-errno.ENOENT)
+            if ret < 0:
+                raise RadosError(f"index_rm_version failed ({ret})",
+                                 code=ret)
+            removed = json.loads(out or b"{}").get("removed")
+        else:
+            index = await self._load_index(bucket)
+            if index is None:
+                raise RadosError(f"NoSuchBucket: {bucket}")
+            entry = index.get(key)
+            if not entry or "versions" not in entry:
+                raise RadosError(f"NoSuchVersion: {vid}",
+                                 code=-errno.ENOENT)
+            match = [v for v in entry["versions"] if v.get("vid") == vid]
+            if not match:
+                raise RadosError(f"NoSuchVersion: {vid}",
+                                 code=-errno.ENOENT)
+            removed = match[0]
+            entry["versions"] = [v for v in entry["versions"]
+                                 if v.get("vid") != vid]
+            if entry["versions"]:
+                cur = entry["versions"][-1]
+                cur = None if cur.get("delete_marker") else cur
+                entry["size"] = cur.get("size", 0) if cur else 0
+                entry["etag"] = cur.get("etag", "") if cur else ""
+                index[key] = entry
+            else:
+                index.pop(key)
+            await self._save_index(bucket, index)
+        if removed and not removed.get("delete_marker"):
+            for p in removed.get("parts", ()):
+                try:
+                    await self.striper.remove(p["oid"])
+                except RadosError:
+                    pass
+            if "parts" not in removed:
+                oid = (f"{bucket}/{key}" if removed.get("vid") == "null"
+                       else self._version_oid(bucket, key, vid))
+                try:
+                    await self.striper.remove(oid)
+                except RadosError:
+                    pass
+        await self._log_mutation("delete", bucket, key)
+
+    async def list_object_versions(self, bucket: str,
+                                   key: Optional[str] = None) -> Dict:
+        """{key: [versions newest-last]} (S3 ListObjectVersions role)."""
+        index = await self._load_index(bucket)
+        if index is None:
+            raise RadosError(f"NoSuchBucket: {bucket}")
+        out: Dict[str, List[Dict]] = {}
+        for k, entry in index.items():
+            if key is not None and k != key:
+                continue
+            if "versions" in entry:
+                out[k] = list(entry["versions"])
+            else:
+                out[k] = [dict(entry, vid="null")]
+        return out
+
     async def list_objects(self, bucket: str) -> Dict[str, Dict]:
         index = await self._load_index(bucket)
         if index is None:
             raise RadosError(f"NoSuchBucket: {bucket}")
-        return index
+        out = {}
+        for k, entry in index.items():
+            if "versions" in entry:
+                vs = entry["versions"]
+                if not vs or vs[-1].get("delete_marker"):
+                    continue  # current version is a delete marker: hidden
+            out[k] = entry
+        return out
 
     async def delete_bucket(self, bucket: str) -> None:
         """Delete an EMPTY bucket (both S3 and Swift refuse non-empty
@@ -302,6 +631,12 @@ class RgwService:
             raise RadosError(f"BucketNotEmpty: {bucket} has "
                              f"{len(uploads)} multipart upload(s) in flight")
         await self.ioctx.remove(self._index_oid(bucket))
+        try:
+            # the bucket's versioning/lifecycle/ACL die with it — a
+            # recreated bucket must not resurrect the old owner's policy
+            await self.ioctx.remove(self._meta_oid(bucket))
+        except RadosError:
+            pass
         try:
             await self.ioctx.execute(
                 BUCKETS_ROOT, "rgw", "registry_rm",
@@ -374,7 +709,7 @@ class RgwService:
             b"".join(bytes.fromhex(p["etag"]) for p in manifest)
         ).hexdigest() + f"-{len(manifest)}"
         entry = {"size": sum(p["size"] for p in manifest),
-                 "etag": etag, "parts": manifest}
+                 "etag": etag, "parts": manifest, "ts": time.time()}
         got = await self._idx_cls(bucket, "index_put",
                                   {"key": key, "meta": entry})
         if got is not None:
@@ -407,6 +742,18 @@ class RgwService:
 
 
 # -- SigV4 (reference rgw_auth; AWS Signature Version 4) --------------------
+
+
+def _access_key_of(headers: Dict[str, str]) -> Optional[str]:
+    """The SigV4 access key naming the request's principal (verification
+    already happened; this only extracts identity for ACL checks)."""
+    auth = headers.get("authorization", "")
+    if "Credential=" not in auth:
+        return None
+    try:
+        return auth.split("Credential=")[1].split("/")[0]
+    except IndexError:
+        return None
 
 
 def _hmac(key: bytes, msg: str) -> bytes:
@@ -558,8 +905,11 @@ class RgwFrontend:
                                                body)):
                     status, payload = "403 Forbidden", b"SignatureDoesNotMatch"
                 else:
+                    # the ACL principal: the SigV4 access key that signed
+                    # the request; anonymous (None) without credentials
+                    principal = _access_key_of(headers)
                     status, payload = await self._route(method, path, query,
-                                                        body)
+                                                        body, principal)
                 hdr_lines = "".join(f"{k}: {v}\r\n" for k, v in extra.items())
                 writer.write(
                     f"HTTP/1.1 {status}\r\nContent-Length: {len(payload)}\r\n"
@@ -602,16 +952,26 @@ class RgwFrontend:
                 "X-Storage-Token": token,
                 "X-Storage-Url": f"http://{host}:{port}/v1/AUTH_{acct or user}",
             }
+        principal = None
         if self.service.credentials:
             token = headers.get("x-auth-token", "")
             entry = self._swift_tokens.get(token)
             if entry is None or                     time.monotonic() - entry[1] > self.swift_token_ttl:
                 self._swift_tokens.pop(token, None)
                 return "401 Unauthorized", b"", {}
+            principal = entry[0]  # the token's account, for ACL checks
         parts = [p for p in path.split("/") if p]
         # parts = ["v1", "AUTH_acct", container?, object...]
         if len(parts) < 2 or not parts[1].startswith("AUTH_"):
             return "400 Bad Request", b"", {}
+        if len(parts) >= 3 and not (len(parts) == 3 and method == "PUT"):
+            # bucket ACLs bind BOTH dialects (reference: one policy
+            # store behind rgw_rest_swift and rgw_rest_s3) — container
+            # creation itself is ungated, like the S3 create path
+            need = "READ" if method in ("GET", "HEAD") else "WRITE"
+            meta = await self.service.get_bucket_meta(parts[2])
+            if not RgwService.acl_allows(meta.get("acl"), principal, need):
+                return "403 Forbidden", b"AccessDenied", {}
         try:
             if len(parts) == 2:  # account: list containers
                 if method in ("GET", "HEAD"):
@@ -666,7 +1026,8 @@ class RgwFrontend:
             return "500 Internal Server Error", msg.encode(), {}
 
     async def _route(self, method: str, path: str, query: str,
-                     body: bytes) -> Tuple[str, bytes]:
+                     body: bytes,
+                     principal: Optional[str] = None) -> Tuple[str, bytes]:
         parts = [p for p in path.split("/") if p]
         q = dict(parse_qsl(query, keep_blank_values=True))
         try:
@@ -676,7 +1037,53 @@ class RgwFrontend:
                         await self.service.list_buckets()).encode()
                 return "405 Method Not Allowed", b""
             bucket = parts[0]
+            # bucket ACL gate (reference rgw_op verify_permission): reads
+            # need READ, mutations need WRITE; the owner passes anything
+            if parts and method in ("GET", "HEAD", "PUT", "POST", "DELETE"):
+                need = "READ" if method in ("GET", "HEAD") else "WRITE"
+                if method == "PUT" and q.keys() & {"acl", "versioning",
+                                                   "lifecycle"}:
+                    # policy mutation is owner-level (S3 WRITE_ACP /
+                    # FULL_CONTROL): a WRITE grantee must not be able to
+                    # rewrite the ACL and seize the bucket
+                    need = "FULL_CONTROL"
+                is_create = len(parts) == 1 and method == "PUT" \
+                    and not q.keys() & {"versioning", "lifecycle", "acl"}
+                if not is_create:
+                    meta = await self.service.get_bucket_meta(bucket)
+                    if not RgwService.acl_allows(meta.get("acl"),
+                                                 principal, need):
+                        return "403 Forbidden", b"AccessDenied"
             if len(parts) == 1:
+                if method == "PUT" and "versioning" in q:
+                    cfg = json.loads(body or b"{}")
+                    await self.service.set_versioning(
+                        bucket, cfg.get("Status") == "Enabled")
+                    return "200 OK", b""
+                if method == "GET" and "versioning" in q:
+                    meta = await self.service.get_bucket_meta(bucket)
+                    return "200 OK", json.dumps(
+                        {"Status": "Enabled" if meta.get("versioning")
+                         else "Suspended"}).encode()
+                if method == "PUT" and "lifecycle" in q:
+                    rules = json.loads(body or b"[]")
+                    await self.service.put_lifecycle(bucket, rules)
+                    return "200 OK", b""
+                if method == "GET" and "lifecycle" in q:
+                    meta = await self.service.get_bucket_meta(bucket)
+                    return "200 OK", json.dumps(
+                        meta.get("lifecycle") or []).encode()
+                if method == "PUT" and "acl" in q:
+                    acl = json.loads(body or b"{}")
+                    await self.service.put_bucket_acl(bucket, acl)
+                    return "200 OK", b""
+                if method == "GET" and "acl" in q:
+                    meta = await self.service.get_bucket_meta(bucket)
+                    return "200 OK", json.dumps(meta.get("acl")).encode()
+                if method == "GET" and "versions" in q:
+                    return "200 OK", json.dumps(
+                        await self.service.list_object_versions(
+                            bucket)).encode()
                 if method == "PUT":
                     await self.service.create_bucket(bucket)
                     return "200 OK", b""
@@ -713,17 +1120,20 @@ class RgwFrontend:
                 await self.service.abort_multipart(bucket, q["uploadId"])
                 return "204 No Content", b""
             if method == "PUT":
-                await self.service.put_object(bucket, key, body)
-                return "200 OK", b""
+                vid = await self.service.put_object(bucket, key, body)
+                return "200 OK", (json.dumps({"VersionId": vid}).encode()
+                                  if vid else b"")
             if method == "GET":
-                return "200 OK", await self.service.get_object(bucket, key)
+                return "200 OK", await self.service.get_object(
+                    bucket, key, version_id=q.get("versionId"))
             if method == "HEAD":
                 index = await self.service.list_objects(bucket)
                 if key in index:
                     return "200 OK", b""
                 return "404 Not Found", b""
             if method == "DELETE":
-                await self.service.delete_object(bucket, key)
+                await self.service.delete_object(
+                    bucket, key, version_id=q.get("versionId"))
                 return "204 No Content", b""
             return "405 Method Not Allowed", b""
         except RadosError as e:
@@ -732,8 +1142,10 @@ class RgwFrontend:
                 return "404 Not Found", msg.encode()
             if "BucketNotEmpty" in msg:
                 return "409 Conflict", msg.encode()
-            if "InvalidPart" in msg:
+            if "InvalidPart" in msg or "MalformedXML" in msg:
                 return "400 Bad Request", msg.encode()
+            if "MethodNotAllowed" in msg:
+                return "405 Method Not Allowed", msg.encode()
             return "500 Internal Server Error", msg.encode()
 
 
