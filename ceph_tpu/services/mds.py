@@ -653,6 +653,10 @@ class CephFSClient:
         return data
 
     async def fsync(self, path: str) -> None:
+        # process pending revokes FIRST: flushing a path whose cap was
+        # revoked would ESTALE mid-flush (renew() both complies and
+        # flushes revoked paths, so the dirty bytes land either way)
+        await self.renew()
         await self._flush_path(FileSystem._norm(path))
 
     async def mkdir(self, path: str) -> None:
@@ -684,6 +688,7 @@ class CephFSClient:
     async def unmount(self) -> None:
         """Flush every dirty file, release every cap, close the session
         (the reference client's unmount barrier)."""
+        await self.renew()  # comply with pending revokes before flushing
         for path in list(self._dirty):
             await self._flush_path(path)
         self._clean.clear()

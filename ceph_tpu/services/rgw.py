@@ -329,15 +329,24 @@ class RgwService:
                 pass
 
     async def put_object(self, bucket: str, key: str, data: bytes,
-                         now: Optional[float] = None) -> Optional[str]:
+                         now: Optional[float] = None,
+                         bmeta: Optional[Dict] = None) -> Optional[str]:
         # existence check BEFORE writing data: a put to a missing bucket
         # must not orphan striped objects (small TOCTOU window against a
         # concurrent bucket delete is bounded and matches the reference)
-        if await self._load_index(bucket) is None:
+        index0 = await self._load_index(bucket)
+        if index0 is None:
             raise RadosError(f"NoSuchBucket: {bucket}", code=-errno.ENOENT)
         now = time.time() if now is None else now
-        bmeta = await self.get_bucket_meta(bucket)
-        if bmeta.get("versioning"):
+        if bmeta is None:
+            bmeta = await self.get_bucket_meta(bucket)
+        entry0 = index0.get(key)
+        if bmeta.get("versioning") or (
+                isinstance(entry0, dict) and "versions" in entry0):
+            # versioned bucket — or a SUSPENDED bucket whose key already
+            # has a version stack: history must survive suspension
+            # (divergence: suspended puts append a fresh vid rather than
+            # replacing the "null" version)
             return await self._put_versioned(bucket, key, data, now)
         meta = {"size": len(data), "etag": hashlib.md5(data).hexdigest(),
                 "ts": now}
@@ -374,6 +383,29 @@ class RgwService:
     def _version_oid(bucket: str, key: str, vid: str) -> str:
         return f"{bucket}/{key}@{vid}"
 
+    @staticmethod
+    def _as_versioned_entry(entry: Optional[Dict]) -> Dict:
+        """Flat index entry -> versioned form (the existing state becomes
+        the addressable "null" version, as S3 does on enabling
+        versioning)."""
+        if isinstance(entry, dict) and "versions" in entry:
+            return entry
+        return {"versions": ([] if entry is None else
+                             [dict(entry, vid="null",
+                                   ts=entry.get("ts", 0))])}
+
+    @staticmethod
+    def _set_derived(entry: Dict) -> Dict:
+        """Size/etag follow the CURRENT (newest) version; a delete-marker
+        current means the flat view reads empty.  One rule, shared with
+        the in-OSD class methods."""
+        cur = entry["versions"][-1] if entry["versions"] else None
+        if cur is not None and cur.get("delete_marker"):
+            cur = None
+        entry["size"] = cur.get("size", 0) if cur else 0
+        entry["etag"] = cur.get("etag", "") if cur else ""
+        return entry
+
     async def _put_versioned(self, bucket: str, key: str, data: bytes,
                              now: float) -> str:
         """Versioned PUT (reference versioned-bucket semantics): every
@@ -396,13 +428,9 @@ class RgwService:
             index = await self._load_index(bucket)
             if index is None:
                 raise RadosError(f"NoSuchBucket: {bucket}")
-            entry = index.get(key)
-            if not isinstance(entry, dict) or "versions" not in entry:
-                entry = {"versions": ([] if entry is None else
-                                      [dict(entry, vid="null")])}
+            entry = self._as_versioned_entry(index.get(key))
             entry["versions"].append(ver)
-            entry["size"], entry["etag"] = len(data), ver["etag"]
-            index[key] = entry
+            index[key] = self._set_derived(entry)
             await self._save_index(bucket, index)
         await self._log_mutation("put", bucket, key)
         return vid
@@ -483,12 +511,19 @@ class RgwService:
 
     async def delete_object(self, bucket: str, key: str,
                             version_id: Optional[str] = None,
-                            now: Optional[float] = None) -> None:
+                            now: Optional[float] = None,
+                            bmeta: Optional[Dict] = None) -> None:
         now = time.time() if now is None else now
-        bmeta = await self.get_bucket_meta(bucket)
+        if bmeta is None:
+            bmeta = await self.get_bucket_meta(bucket)
         if version_id is not None:
             return await self._delete_version(bucket, key, version_id)
-        if bmeta.get("versioning"):
+        versioned = bmeta.get("versioning")
+        if not versioned:
+            index0 = await self._load_index(bucket)
+            entry0 = (index0 or {}).get(key)
+            versioned = isinstance(entry0, dict) and "versions" in entry0
+        if versioned:
             # versioned delete: a DELETE MARKER becomes the newest
             # version; data stays reachable via explicit versionIds
             marker = {"vid": uuid.uuid4().hex[:16], "delete_marker": True,
@@ -499,13 +534,9 @@ class RgwService:
                 index = await self._load_index(bucket)
                 if index is None:
                     raise RadosError(f"NoSuchBucket: {bucket}")
-                entry = index.get(key)
-                if not isinstance(entry, dict) or "versions" not in entry:
-                    entry = {"versions": ([] if entry is None else
-                                          [dict(entry, vid="null")])}
+                entry = self._as_versioned_entry(index.get(key))
                 entry["versions"].append(marker)
-                entry["size"], entry["etag"] = 0, ""
-                index[key] = entry
+                index[key] = self._set_derived(entry)
                 await self._save_index(bucket, index)
             elif got[0] == -2:
                 raise RadosError(f"NoSuchBucket: {bucket}",
@@ -562,11 +593,7 @@ class RgwService:
             entry["versions"] = [v for v in entry["versions"]
                                  if v.get("vid") != vid]
             if entry["versions"]:
-                cur = entry["versions"][-1]
-                cur = None if cur.get("delete_marker") else cur
-                entry["size"] = cur.get("size", 0) if cur else 0
-                entry["etag"] = cur.get("etag", "") if cur else ""
-                index[key] = entry
+                index[key] = self._set_derived(entry)
             else:
                 index.pop(key)
             await self._save_index(bucket, index)
@@ -583,7 +610,10 @@ class RgwService:
                     await self.striper.remove(oid)
                 except RadosError:
                     pass
-        await self._log_mutation("delete", bucket, key)
+        # a version-targeted delete changes the key's CURRENT state in a
+        # direction only the source knows (prune, or undelete by marker
+        # removal): replicas RESYNC the key instead of blindly deleting
+        await self._log_mutation("resync", bucket, key)
 
     async def list_object_versions(self, bucket: str,
                                    key: Optional[str] = None) -> Dict:
@@ -710,6 +740,28 @@ class RgwService:
         ).hexdigest() + f"-{len(manifest)}"
         entry = {"size": sum(p["size"] for p in manifest),
                  "etag": etag, "parts": manifest, "ts": time.time()}
+        bmeta = await self.get_bucket_meta(bucket)
+        if bmeta.get("versioning") or (
+                isinstance(index.get(key), dict)
+                and "versions" in index[key]):
+            # versioned bucket: multipart completion appends a VERSION
+            # carrying its manifest — prior versions' data survives
+            ver = dict(entry, vid=uuid.uuid4().hex[:16])
+            got = await self._idx_cls(bucket, "index_put_version",
+                                      {"key": key, "version": ver})
+            if got is not None:
+                ret, _ = got
+                if ret < 0:
+                    raise RadosError(f"index_put_version failed ({ret})",
+                                     code=ret)
+            else:
+                e = self._as_versioned_entry(index.get(key))
+                e["versions"].append(ver)
+                index[key] = self._set_derived(e)
+                await self._save_index(bucket, index)
+            await self.ioctx.remove(self._upload_meta_oid(bucket, upload_id))
+            await self._log_mutation("put", bucket, key)
+            return etag
         got = await self._idx_cls(bucket, "index_put",
                                   {"key": key, "meta": entry})
         if got is not None:
@@ -1039,6 +1091,7 @@ class RgwFrontend:
             bucket = parts[0]
             # bucket ACL gate (reference rgw_op verify_permission): reads
             # need READ, mutations need WRITE; the owner passes anything
+            gate_meta = None
             if parts and method in ("GET", "HEAD", "PUT", "POST", "DELETE"):
                 need = "READ" if method in ("GET", "HEAD") else "WRITE"
                 if method == "PUT" and q.keys() & {"acl", "versioning",
@@ -1050,8 +1103,8 @@ class RgwFrontend:
                 is_create = len(parts) == 1 and method == "PUT" \
                     and not q.keys() & {"versioning", "lifecycle", "acl"}
                 if not is_create:
-                    meta = await self.service.get_bucket_meta(bucket)
-                    if not RgwService.acl_allows(meta.get("acl"),
+                    gate_meta = await self.service.get_bucket_meta(bucket)
+                    if not RgwService.acl_allows(gate_meta.get("acl"),
                                                  principal, need):
                         return "403 Forbidden", b"AccessDenied"
             if len(parts) == 1:
@@ -1120,7 +1173,8 @@ class RgwFrontend:
                 await self.service.abort_multipart(bucket, q["uploadId"])
                 return "204 No Content", b""
             if method == "PUT":
-                vid = await self.service.put_object(bucket, key, body)
+                vid = await self.service.put_object(bucket, key, body,
+                                                    bmeta=gate_meta)
                 return "200 OK", (json.dumps({"VersionId": vid}).encode()
                                   if vid else b"")
             if method == "GET":
@@ -1133,7 +1187,8 @@ class RgwFrontend:
                 return "404 Not Found", b""
             if method == "DELETE":
                 await self.service.delete_object(
-                    bucket, key, version_id=q.get("versionId"))
+                    bucket, key, version_id=q.get("versionId"),
+                    bmeta=gate_meta)
                 return "204 No Content", b""
             return "405 Method Not Allowed", b""
         except RadosError as e:
@@ -1230,6 +1285,25 @@ class ZoneSyncAgent:
                         data = await self.src.get_object(bucket, key)
                         await self.dst.create_bucket(bucket)
                         await self.dst.put_object(bucket, key, data)
+                    elif op == "resync":
+                        # version-targeted mutations change the key's
+                        # current state in a source-only way: mirror the
+                        # VISIBLE state (present -> copy, absent -> del)
+                        try:
+                            data = await self.src.get_object(bucket, key)
+                        except RadosError as e:
+                            if e.code != -errno.ENOENT \
+                                    and "NoSuch" not in str(e):
+                                raise
+                            data = None
+                        if data is None:
+                            try:
+                                await self.dst.delete_object(bucket, key)
+                            except RadosError:
+                                pass
+                        else:
+                            await self.dst.create_bucket(bucket)
+                            await self.dst.put_object(bucket, key, data)
                     elif op == "delete":
                         await self.dst.delete_object(bucket, key)
                 except RadosError as e:

@@ -227,8 +227,14 @@ class TestMonQuorum:
                     "k": "2", "m": "1"})
                 await c.put(pool, "obj", b"forwarded-write" * 100)
                 assert await c.get(pool, "obj") == b"forwarded-write" * 100
-                # the pool exists on every mon (replicated state)
-                await asyncio.sleep(0.3)
+                # the pool exists on every mon (replicated state) —
+                # DEADLINE-polled, not a fixed sleep: paxos round latency
+                # under host load is unbounded, replication is not
+                for _ in range(200):
+                    if all(m.osdmap.pool_by_name("fwd") is not None
+                           for m in cluster.mons):
+                        break
+                    await asyncio.sleep(0.05)
                 for m in cluster.mons:
                     assert m.osdmap.pool_by_name("fwd") is not None
                 await c.stop()
@@ -348,8 +354,13 @@ class TestConfigMonitor:
                 await c.config_set("debug_osd", "5")
                 got = await c.config_get()
                 assert got["osd_scrub_auto"] == "true"
-                # replicated to every mon
-                await asyncio.sleep(0.3)
+                # replicated to every mon (deadline-polled, not a
+                # fixed sleep: paxos latency under load is unbounded)
+                for _ in range(200):
+                    if all(m.cluster_conf.get("debug_osd") == "5"
+                           for m in cluster.mons):
+                        break
+                    await asyncio.sleep(0.05)
                 for m in cluster.mons:
                     assert m.cluster_conf.get("debug_osd") == "5"
                 # a NEW osd boots with the centralized config applied
