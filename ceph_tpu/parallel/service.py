@@ -193,15 +193,19 @@ class BatchingQueue:
         while True:
             with self._cv:
                 while not self._stop:
-                    if inflight is not None:
-                        break  # finish the in-flight round first
                     if self._pending >= self.max_pending_bytes:
                         break
                     if self._oldest is not None:
+                        # pending work fills its normal coalescing window
+                        # even while a round is in flight — that round's
+                        # compute is proceeding on-device regardless, and
+                        # an eager take here would fragment batches
                         remaining = self.max_delay - (time.monotonic() - self._oldest)
                         if remaining <= 0:
                             break
                         self._cv.wait(timeout=remaining)
+                    elif inflight is not None:
+                        break  # nothing queued: fetch the in-flight round
                     else:
                         self._cv.wait()
                 if self._stop:
@@ -445,9 +449,11 @@ class PlanarShardStore:
 
     def __init__(self, capacity_bytes: int = 256 << 20,
                  queue: Optional[BatchingQueue] = None):
+        from ceph_tpu.common.lockdep import make_mutex
+
         self.capacity_bytes = capacity_bytes
         self.queue = queue
-        self._lock = threading.Lock()
+        self._lock = make_mutex("planar-store")
         self._entries: "OrderedDict[Any, Any]" = OrderedDict()
         self._bytes: Dict[Any, int] = {}
         self.resident_bytes = 0

@@ -365,7 +365,9 @@ class Connection:
         # a REPLACED connection never collides with its predecessor's seqs
         self.session_id = random.randbytes(8).hex()
         self.unacked: Deque[Tuple[int, bytes]] = collections.deque()
-        self._send_lock = asyncio.Lock()
+        from ceph_tpu.common.lockdep import make_async_mutex
+
+        self._send_lock = make_async_mutex("conn-send")
         # crc/compression resolved once per connection (v2 negotiates at
         # handshake time; avoids typed-config parsing on the hot path)
         conf = messenger.conf

@@ -2412,8 +2412,10 @@ class OSD:
         # sharded queue serializes per PG in steady state, but a map
         # race around pool creation can key two calls differently, so
         # the primary holds its own per-object critical section.
-        ent = self._cls_locks.setdefault((op.pool_id, op.oid),
-                                         [asyncio.Lock(), 0])
+        from ceph_tpu.common.lockdep import make_async_mutex
+
+        ent = self._cls_locks.setdefault(
+            (op.pool_id, op.oid), [make_async_mutex("osd-cls-call"), 0])
         ent[1] += 1  # waiter refcount: eviction must never orphan a lock
         try:
             return await self._do_call_locked(op, pool, pg, acting, fn,

@@ -292,6 +292,24 @@ class Image:
     def _snaps(self) -> Dict[str, Dict]:
         return self._hdr.setdefault("snaps", {})
 
+    async def _refresh(self) -> None:
+        """Re-read the header (reference ImageCtx refresh on header
+        watch): another handle (a group snapshot sweep, a concurrent
+        admin) may have changed snaps/map since this handle opened."""
+        raw = await self.ioctx.read(self._header_oid(self.name))
+        self._hdr = json.loads(raw)
+
+    async def _snap_or_refresh(self, name: str) -> Optional[Dict]:
+        """The snap record, refreshing ONCE when the local header does
+        not know the name — absorbing out-of-band snap creation without
+        a watch/notify channel.  Data WRITES still require the owning
+        handle (the reference's exclusive-lock discipline)."""
+        snap = self._snaps().get(name)
+        if snap is None:
+            await self._refresh()
+            snap = self._snaps().get(name)
+        return snap
+
     def _image_snapc(self):
         """(seq, snaps-descending) over the image's live snaps — the
         SnapContext every data-object write rides."""
@@ -333,7 +351,7 @@ class Image:
         """Read from a snapshot: each object resolves at the snap id
         through its RADOS SnapSet (covering clone, unchanged head, or
         absent)."""
-        snap = self._snaps().get(name)
+        snap = await self._snap_or_refresh(name)
         if snap is None:
             raise RbdError(f"no snapshot {name!r}")
         size = snap["size"]
@@ -385,7 +403,7 @@ class Image:
         """Mark a snapshot protected — the precondition for cloning
         (reference: clones may only be made from protected snaps, so a
         snap can never vanish under its children)."""
-        snap = self._snaps().get(name)
+        snap = await self._snap_or_refresh(name)
         if snap is None:
             raise RbdError(f"no snapshot {name!r}")
         got = await self._hdr_cls("set_protection",
@@ -444,15 +462,42 @@ class Image:
         await self._save_header()
         await RBD(self.ioctx)._unregister_child(parent_ref, self.name)
 
+    async def rebuild_object_map(self) -> int:
+        """Reconstruct the object map by scanning the pool for this
+        image's data objects (reference object_map rebuild operation):
+        the recovery path when the header's map was lost or corrupted —
+        reads would otherwise treat existing blocks as sparse holes.
+        Returns the number of blocks recovered into the map."""
+        prefix = f"rbd_data.{self._hdr['id']}."
+        found = set()
+        for oid in await self.ioctx.list_objects():
+            if not oid.startswith(prefix):
+                continue
+            try:
+                found.add(int(oid[len(prefix):]))
+            except ValueError:
+                continue
+        before = set(self._hdr["object_map"])
+        n_objs = (self.size + self.object_size - 1) // self.object_size
+        rebuilt = {i for i in found if i < n_objs}
+        await self._merge_object_map(rebuilt)
+        # blocks past the current size stay out of the map (a shrink
+        # already trimmed them); blocks the old map falsely claimed are
+        # corrected by the authoritative scan
+        if before - rebuilt:
+            self._hdr["object_map"] = sorted(rebuilt)
+            await self._save_header(drop_blocks=sorted(before - rebuilt))
+        return len(rebuilt - before)
+
     async def snap_remove(self, name: str) -> None:
         """Remove a snapshot: the RADOS snap-trim deletes only clones no
         LIVE snap still references (each clone records the snap ids it
         covers), so clones shared with older snapshots survive without
         any service-level re-homing."""
+        snap = await self._snap_or_refresh(name)
         snaps = self._snaps()
-        if name in snaps and snaps[name].get("protected"):
+        if snap is not None and snap.get("protected"):
             raise RbdError(f"snapshot {name!r} is protected")
-        snap = snaps.get(name)
         if snap is None:
             raise RbdError(f"no snapshot {name!r}")
         # the AUTHORITATIVE protection check is the in-OSD header (a
@@ -583,6 +628,142 @@ class RBD:
         await self.ioctx.write_full(hdr_oid, json.dumps(header).encode())
         await self._register_child(f"{parent}@{snap}", child)
         return Image(self.ioctx, child, header)
+
+    # -- consistency groups (reference src/librbd/api/Group.cc) -------------
+    #
+    # A named set of images snapshotted together: the group snapshot is a
+    # per-member image snapshot taken under one sweep, named
+    # group.<group>.<snap> so member snaps are identifiable and the
+    # group object records the membership at snap time.
+
+    @staticmethod
+    def _group_oid(group: str) -> str:
+        return f"rbd_group.{group}"
+
+    async def _load_group(self, group: str) -> Dict:
+        try:
+            raw = await self.ioctx.read(self._group_oid(group))
+        except RadosError as e:
+            if e.code != -errno.ENOENT:
+                raise
+            raise RbdError(f"no group {group!r}") from None
+        return json.loads(raw)
+
+    async def _save_group(self, group: str, state: Dict) -> None:
+        await self.ioctx.write_full(self._group_oid(group),
+                                    json.dumps(state).encode())
+
+    async def group_create(self, group: str) -> None:
+        state = {"members": [], "snaps": {}}
+        # exclusive creation via the in-OSD class (same discipline as
+        # image create: two racing creates must not both win)
+        try:
+            ret, _ = await self.ioctx.execute(
+                self._group_oid(group), "rbd", "create",
+                json.dumps({"header": state}).encode())
+            if ret == -17:
+                raise RbdError(f"group {group!r} exists")
+            if ret != 0:
+                raise RbdError(f"group create failed ({ret})")
+            return
+        except RadosError as e:
+            if e.code != -errno.EOPNOTSUPP:
+                raise
+        # EC pool fallback: typed absence check, then write
+        exists = True
+        try:
+            await self._load_group(group)
+        except RbdError:
+            exists = False
+        if exists:
+            raise RbdError(f"group {group!r} exists")
+        await self._save_group(group, state)
+
+    async def group_remove(self, group: str) -> None:
+        state = await self._load_group(group)
+        if state["snaps"]:
+            raise RbdError(f"group {group!r} has snapshots; remove them")
+        await self.ioctx.remove(self._group_oid(group))
+
+    async def group_list(self) -> List[str]:
+        pfx = "rbd_group."
+        return sorted(o[len(pfx):] for o in await self.ioctx.list_objects()
+                      if o.startswith(pfx))
+
+    async def group_image_add(self, group: str, image: str) -> None:
+        await self.open(image)  # must exist
+        state = await self._load_group(group)
+        if image not in state["members"]:
+            state["members"].append(image)
+            await self._save_group(group, state)
+
+    async def group_image_remove(self, group: str, image: str) -> None:
+        state = await self._load_group(group)
+        if image in state["members"]:
+            state["members"].remove(image)
+            await self._save_group(group, state)
+
+    async def group_image_list(self, group: str) -> List[str]:
+        return sorted((await self._load_group(group))["members"])
+
+    async def group_snap_create(self, group: str, snap: str) -> None:
+        """Snapshot EVERY member at one sweep (the reference quiesces
+        via exclusive locks; here member snaps are taken back-to-back on
+        one event loop — writes issued after the sweep started land
+        after their image's snap, the same point-in-time-per-image
+        guarantee a non-quiesced reference group snap gives)."""
+        state = await self._load_group(group)
+        if snap in state["snaps"]:
+            raise RbdError(f"group snapshot {snap!r} exists")
+        member_snap = f"group.{group}.{snap}"
+        done = []
+        try:
+            for name in state["members"]:
+                img = await self.open(name)
+                await img.snap_create(member_snap)
+                done.append(name)
+        except Exception:
+            # partial failure: roll the sweep back so the group snap is
+            # all-or-nothing (reference group snap create semantics)
+            for name in done:
+                try:
+                    img = await self.open(name)
+                    await img.snap_remove(member_snap)
+                except Exception:
+                    pass
+            raise
+        state["snaps"][snap] = {"members": list(state["members"])}
+        await self._save_group(group, state)
+
+    async def group_snap_remove(self, group: str, snap: str) -> None:
+        state = await self._load_group(group)
+        info = state["snaps"].get(snap)
+        if info is None:
+            raise RbdError(f"no group snapshot {snap!r}")
+        member_snap = f"group.{group}.{snap}"
+        failed = []
+        for name in info["members"]:
+            try:
+                img = await self.open(name)
+            except RbdError:
+                continue  # member image since removed: nothing to clean
+            try:
+                await img.snap_remove(member_snap)
+            except RbdError as e:
+                if "no snapshot" in str(e):
+                    continue  # already gone: idempotent
+                failed.append((name, str(e)))
+        if failed:
+            # keep the group record so the removal can be RETRIED once
+            # the blocker clears (e.g. a protected member snap) — popping
+            # it would orphan member snaps with no handle left
+            raise RbdError(f"group snapshot {snap!r} not fully removed: "
+                           f"{failed}")
+        state["snaps"].pop(snap)
+        await self._save_group(group, state)
+
+    async def group_snap_list(self, group: str) -> List[str]:
+        return sorted((await self._load_group(group))["snaps"])
 
     async def remove(self, name: str) -> None:
         """Remove an image.  Refuses while snapshots exist (reference

@@ -1,0 +1,214 @@
+"""The ``ceph`` status command (reference src/ceph.in): cluster-state
+queries over the mon-distributed maps and the client's admin fan-outs.
+
+    python -m ceph_tpu.tools.ceph --mon HOST:PORT status
+    python -m ceph_tpu.tools.ceph --mon HOST:PORT health
+    python -m ceph_tpu.tools.ceph --mon HOST:PORT osd tree
+    python -m ceph_tpu.tools.ceph --mon HOST:PORT pg dump
+    python -m ceph_tpu.tools.ceph --mon HOST:PORT df
+
+Everything derives from the same sources the reference CLI reads: the
+OSDMap (epoch, OSD up/in states, pools, crush) fetched from the mon
+quorum, per-PG acting sets computed client-side exactly as the data path
+computes them (holes = degraded), and object counts via the paginated
+per-PG listing fan-out (`pgls`, the scalable listing discipline).
+``--format json`` emits machine-readable output; default is the
+reference's human layout in miniature.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+from typing import Dict, List
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="ceph cluster status tool")
+    p.add_argument("--mon", required=True, help="mon address host:port")
+    p.add_argument("--format", choices=("plain", "json"), default="plain")
+    p.add_argument("words", nargs="+",
+                   help="status | health | df | osd tree | pg dump")
+    return p.parse_args(argv)
+
+
+def _pg_states(osdmap) -> List[Dict]:
+    """Per-PG rows derived from the map: acting set, primary, state
+    (active+clean, or degraded when acting has holes) — the map-derived
+    half of the reference's `pg dump` (per-OSD runtime stats live behind
+    each daemon's admin socket)."""
+    from ceph_tpu.rados.crush import CRUSH_ITEM_NONE
+
+    rows = []
+    for pool in osdmap.pools.values():
+        for pg in range(pool.pg_num):
+            acting = osdmap.pg_to_acting(pool, pg)
+            holes = sum(1 for a in acting if a == CRUSH_ITEM_NONE)
+            live = [a for a in acting if a != CRUSH_ITEM_NONE]
+            primary = osdmap.primary_of(
+                acting, seed=(pool.pool_id << 20) | pg) if live else -1
+            if holes == 0:
+                state = "active+clean"
+            elif len(live) >= pool.min_size:
+                state = "active+degraded"
+            else:
+                state = "incomplete"
+            rows.append({"pgid": f"{pool.pool_id}.{pg:x}", "state": state,
+                         "acting": acting, "primary": primary})
+    return rows
+
+
+def _health(osdmap, pg_rows) -> Dict:
+    checks = []
+    down = [o.osd_id for o in osdmap.osds.values() if not o.up]
+    if down:
+        checks.append({"check": "OSD_DOWN",
+                       "summary": f"{len(down)} osds down: {down}"})
+    out = [o.osd_id for o in osdmap.osds.values() if not o.in_cluster]
+    if out:
+        checks.append({"check": "OSD_OUT",
+                       "summary": f"{len(out)} osds out: {out}"})
+    degraded = [r["pgid"] for r in pg_rows if r["state"] != "active+clean"]
+    if degraded:
+        checks.append({"check": "PG_DEGRADED",
+                       "summary": f"{len(degraded)} pgs not active+clean"})
+    status = "HEALTH_OK" if not checks else (
+        "HEALTH_ERR" if any(r["state"] == "incomplete" for r in pg_rows)
+        else "HEALTH_WARN")
+    return {"status": status, "checks": checks}
+
+
+def _osd_tree(osdmap) -> List[Dict]:
+    """Flattened crush tree rows (reference `ceph osd tree` layout):
+    buckets depth-first, devices with up/in status and weight."""
+    crush = osdmap.crush
+    rows: List[Dict] = []
+    seen = set()
+
+    def walk(bid: int, depth: int) -> None:
+        b = crush.buckets.get(bid)
+        if b is None or bid in seen:
+            return
+        seen.add(bid)
+        rows.append({"id": b.id, "name": b.name, "type": b.type,
+                     "depth": depth})
+        for item in b.items:
+            if item < 0:
+                walk(item, depth + 1)
+            else:
+                info = osdmap.osds.get(item)
+                rows.append({
+                    "id": item, "name": f"osd.{item}", "type": "osd",
+                    "depth": depth + 1,
+                    "weight": b.weights.get(item, 1.0),
+                    "status": "up" if info and info.up else "down",
+                    "in": bool(info and info.in_cluster),
+                })
+    walk(crush.root_id, 0)
+    # stray devices not in any bucket (flat maps place all under root)
+    for osd_id, info in sorted(osdmap.osds.items()):
+        if not any(r.get("name") == f"osd.{osd_id}" for r in rows):
+            rows.append({"id": osd_id, "name": f"osd.{osd_id}",
+                         "type": "osd", "depth": 1, "weight": info.weight,
+                         "status": "up" if info.up else "down",
+                         "in": info.in_cluster})
+    return rows
+
+
+async def _df(client) -> List[Dict]:
+    rows = []
+    for pool in client.osdmap.pools.values():
+        objects = await client.list_objects(pool.pool_id)
+        rows.append({"pool": pool.name, "id": pool.pool_id,
+                     "type": pool.pool_type, "objects": len(objects)})
+    return rows
+
+
+async def run(args) -> int:
+    from ceph_tpu.rados.client import RadosClient
+
+    host, port = args.mon.rsplit(":", 1)
+    client = RadosClient((host, int(port)))
+    await client.start()
+    try:
+        await client.refresh_map()
+        m = client.osdmap
+        cmd = " ".join(args.words)
+        pg_rows = _pg_states(m)
+        if cmd == "status":
+            health = _health(m, pg_rows)
+            up = sum(1 for o in m.osds.values() if o.up)
+            inc = sum(1 for o in m.osds.values() if o.in_cluster)
+            clean = sum(1 for r in pg_rows if r["state"] == "active+clean")
+            out = {
+                "health": health["status"],
+                "osdmap": {"epoch": m.epoch, "num_osds": len(m.osds),
+                           "num_up_osds": up, "num_in_osds": inc},
+                "pgmap": {"num_pgs": len(pg_rows),
+                          "active_clean": clean},
+                "pools": len(m.pools),
+            }
+            if args.format == "json":
+                print(json.dumps(out))
+            else:
+                print(f"  health: {out['health']}")
+                print(f"  osdmap: e{m.epoch}: {len(m.osds)} osds: "
+                      f"{up} up, {inc} in")
+                print(f"  pgmap: {len(pg_rows)} pgs, {clean} active+clean"
+                      f", {len(m.pools)} pools")
+            return 0
+        if cmd == "health":
+            health = _health(m, pg_rows)
+            if args.format == "json":
+                print(json.dumps(health))
+            else:
+                print(health["status"])
+                for c in health["checks"]:
+                    print(f"  {c['check']}: {c['summary']}")
+            return 0
+        if cmd == "osd tree":
+            rows = _osd_tree(m)
+            if args.format == "json":
+                print(json.dumps(rows))
+            else:
+                for r in rows:
+                    pad = "  " * r["depth"]
+                    if r["type"] == "osd":
+                        print(f"{r['id']:>4} {pad}{r['name']:<12}"
+                              f"{r.get('weight', 1.0):>8.4f}  "
+                              f"{r['status']}"
+                              f"{'' if r['in'] else ' (out)'}")
+                    else:
+                        print(f"{r['id']:>4} {pad}{r['type']} {r['name']}")
+            return 0
+        if cmd == "pg dump":
+            if args.format == "json":
+                print(json.dumps(pg_rows))
+            else:
+                for r in pg_rows:
+                    print(f"{r['pgid']:<10} {r['state']:<18} "
+                          f"acting {r['acting']} primary {r['primary']}")
+            return 0
+        if cmd == "df":
+            rows = await _df(client)
+            if args.format == "json":
+                print(json.dumps(rows))
+            else:
+                for r in rows:
+                    print(f"{r['pool']:<20} id {r['id']:<4} "
+                          f"{r['type']:<12} {r['objects']} objects")
+            return 0
+        print(f"unknown command: {cmd}", file=sys.stderr)
+        return 2
+    finally:
+        await client.stop()
+
+
+def main(argv=None) -> int:
+    return asyncio.run(run(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

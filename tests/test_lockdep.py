@@ -1,0 +1,152 @@
+"""Lock-order cycle detection (VERDICT r03 missing #7, reference
+src/common/lockdep.cc): debug mutexes register lock-order edges and
+raise the first time an acquisition would close a cycle — across BOTH
+real threads and asyncio tasks, the mix this codebase runs."""
+
+import asyncio
+import threading
+
+import pytest
+
+from ceph_tpu.common import lockdep
+from ceph_tpu.common.lockdep import (DebugAsyncLock, DebugLock,
+                                     LockOrderError)
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    lockdep.reset()
+    lockdep.enable()
+    yield
+    lockdep.disable()
+    lockdep.reset()
+
+
+class TestThreadLockdep:
+    def test_abba_inversion_detected_without_deadlocking(self):
+        a, b = DebugLock("A"), DebugLock("B")
+        with a:
+            with b:
+                pass  # establishes A -> B
+        err = []
+
+        def inverted():
+            try:
+                with b:
+                    with a:  # B -> A closes the cycle
+                        pass
+            except LockOrderError as e:
+                err.append(e)
+
+        t = threading.Thread(target=inverted)
+        t.start()
+        t.join(timeout=10)
+        assert err, "ABBA inversion not detected"
+        assert "A" in str(err[0]) and "B" in str(err[0])
+
+    def test_consistent_order_never_fires(self):
+        a, b, c = DebugLock("A"), DebugLock("B"), DebugLock("C")
+        for _ in range(5):
+            with a:
+                with b:
+                    with c:
+                        pass
+
+    def test_recursive_same_name_is_not_an_edge(self):
+        # per-object locks share a class-level name: object X's lock
+        # held while taking object Y's (same name) must not self-cycle
+        a1, a2 = DebugLock("cls-call"), DebugLock("cls-call")
+        with a1:
+            with a2:
+                pass
+
+    def test_three_lock_cycle(self):
+        a, b, c = DebugLock("A"), DebugLock("B"), DebugLock("C")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with pytest.raises(LockOrderError):
+            with c:
+                with a:
+                    pass
+
+
+class TestCrossRuntimeLockdep:
+    def test_task_vs_thread_inversion(self):
+        """An asyncio task locking T->U against a worker thread locking
+        U->T — the cross-runtime inversion a thread-only lockdep never
+        sees."""
+        t_lock, u_lock = DebugLock("T"), DebugLock("U")
+
+        async def task_order():
+            at = DebugAsyncLock("AT")
+            async with at:
+                # async holder takes the THREAD lock next: AT -> T
+                t_lock.acquire()
+                t_lock.release()
+
+        asyncio.run(task_order())
+        # a plain thread now inverts: T -> AT
+        err = []
+
+        def thread_order():
+            try:
+                with t_lock:
+                    lockdep.will_lock("AT")
+            except LockOrderError as e:
+                err.append(e)
+
+        th = threading.Thread(target=thread_order)
+        th.start()
+        th.join(timeout=10)
+        assert err, "cross-runtime inversion not detected"
+
+    def test_async_locks_track_per_task(self):
+        async def go():
+            a, b = DebugAsyncLock("A2"), DebugAsyncLock("B2")
+            async with a:
+                async with b:
+                    pass
+            with pytest.raises(LockOrderError):
+                async with b:
+                    async with a:
+                        pass
+
+        asyncio.run(go())
+
+
+class TestLockdepOnDaemons:
+    def test_cluster_workload_runs_clean_under_lockdep(self):
+        """Smoke: a live cluster's production locks (messenger send,
+        cls calls, planar store) under the detector — a clean run means
+        no established order is ever inverted."""
+        async def go():
+            import os
+
+            from ceph_tpu.rados.vstart import Cluster
+
+            cluster = Cluster(n_osds=3, conf={"osd_auto_repair": False})
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("ld", pool_type="replicated")
+                for i in range(4):
+                    await c.put(pool, f"o{i}", os.urandom(30_000))
+                for i in range(4):
+                    assert len(await c.get(pool, f"o{i}")) == 30_000
+                # cls calls (their per-object locks) exercised too
+                from ceph_tpu.rados.librados import Rados
+
+                r = await Rados(cluster.mons[0].addr).connect()
+                io = await r.open_ioctx("ld")
+                ret, _ = await io.execute("o0", "version", "set", b"7")
+                assert ret == 0
+                await r.shutdown()
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        asyncio.run(go())

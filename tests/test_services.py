@@ -1068,3 +1068,104 @@ class TestRgwDataManagement:
                 await cluster.stop()
 
         run(go())
+
+
+class TestRbdGroupsAndRebuild:
+    """RBD consistency groups + object-map rebuild (VERDICT r03
+    missing #5, reference src/librbd/api/Group.cc and the object-map
+    rebuild operation)."""
+
+    def test_group_snapshot_lifecycle(self):
+        async def go():
+            cluster = Cluster(n_osds=3, conf={"osd_auto_repair": False})
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                await c.create_pool("grp", pool_type="replicated")
+                r = await Rados(cluster.mons[0].addr).connect()
+                io = await r.open_ioctx("grp")
+                rbd = RBD(io)
+                vm1 = await rbd.create("vm1", 2 << 20, order=19)
+                vm2 = await rbd.create("vm2", 2 << 20, order=19)
+                d1, d2 = os.urandom(100_000), os.urandom(100_000)
+                await vm1.write(0, d1)
+                await vm2.write(0, d2)
+                await rbd.group_create("appgrp")
+                await rbd.group_image_add("appgrp", "vm1")
+                await rbd.group_image_add("appgrp", "vm2")
+                assert await rbd.group_image_list("appgrp") == ["vm1", "vm2"]
+                assert "appgrp" in await rbd.group_list()
+                # the group snapshot captures BOTH images
+                await rbd.group_snap_create("appgrp", "checkpoint")
+                assert await rbd.group_snap_list("appgrp") == ["checkpoint"]
+                # reopen after the out-of-band sweep: data writes need
+                # the CURRENT snap context (the reference's
+                # exclusive-lock/refresh discipline for shared images)
+                vm1 = await rbd.open("vm1")
+                vm2 = await rbd.open("vm2")
+                await vm1.write(0, os.urandom(100_000))
+                await vm2.write(0, os.urandom(100_000))
+                snap = "group.appgrp.checkpoint"
+                assert await vm1.read_snap(snap, 0, len(d1)) == d1
+                assert await vm2.read_snap(snap, 0, len(d2)) == d2
+                # all-or-nothing: the SECOND member's duplicate snap
+                # fails the sweep AFTER vm1 was snapped — the rollback
+                # must undo vm1's member snap
+                vm2b = await rbd.open("vm2")
+                await vm2b.snap_create("group.appgrp.dup")
+                with pytest.raises(RbdError):
+                    await rbd.group_snap_create("appgrp", "dup")
+                assert "group.appgrp.dup" not in (await rbd.open(
+                    "vm1")).snap_list(), "rollback left vm1's member snap"
+                assert await rbd.group_snap_list("appgrp") == ["checkpoint"]
+                await (await rbd.open("vm2")).snap_remove(
+                    "group.appgrp.dup")
+                # teardown order enforced
+                with pytest.raises(RbdError, match="has snapshots"):
+                    await rbd.group_remove("appgrp")
+                await rbd.group_snap_remove("appgrp", "checkpoint")
+                await rbd.group_remove("appgrp")
+                assert await rbd.group_list() == []
+                await r.shutdown()
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_object_map_rebuild_recovers_lost_map(self):
+        async def go():
+            cluster = Cluster(n_osds=3, conf={"osd_auto_repair": False})
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                await c.create_pool("omr", pool_type="replicated")
+                r = await Rados(cluster.mons[0].addr).connect()
+                io = await r.open_ioctx("omr")
+                rbd = RBD(io)
+                img = await rbd.create("disk", 8 << 20, order=20)
+                blocks = {0: os.urandom(4096), 3: os.urandom(4096),
+                          6: os.urandom(4096)}
+                for idx, blob in blocks.items():
+                    await img.write(idx << 20, blob)
+                # corrupt the header's map (simulated loss; the
+                # explicit drop list — a plain push would be MERGED with
+                # the stored map, which is itself the anti-lost-update
+                # behavior working as designed)
+                img._hdr["object_map"] = []
+                await img._save_header(drop_blocks=[0, 3, 6])
+                fresh = await rbd.open("disk")
+                assert fresh._hdr["object_map"] == []
+                # reads now see holes where data exists — rebuild scans
+                # the pool and restores the map
+                recovered = await fresh.rebuild_object_map()
+                assert recovered == 3
+                assert fresh._hdr["object_map"] == [0, 3, 6]
+                for idx, blob in blocks.items():
+                    assert await fresh.read(idx << 20, 4096) == blob
+                await r.shutdown()
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
