@@ -147,6 +147,58 @@ class MgrDaemon:
 
     # -- prometheus text format (mgr/prometheus role) ------------------------
 
+    def cluster_status(self) -> Dict:
+        """Aggregated cluster view from the daemons' pushed reports (the
+        dashboard/REST role of reference src/pybind/mgr/dashboard in
+        miniature): per-daemon freshness + headline counters."""
+        now = time.time()
+        daemons = {}
+        for name, r in self.reports.items():
+            # perf is {set_name: {counter: value}} (the collection dump)
+            flat = {}
+            for set_name, counters in (r.perf or {}).items():
+                if not isinstance(counters, dict):
+                    continue
+                for k, v in counters.items():
+                    if isinstance(v, (int, float)):
+                        flat[f"{set_name}.{k}"] = v
+            daemons[name] = {
+                "stale_s": round(max(0.0, now - r.stamp), 1),
+                "status": dict(r.status or {}),
+                "perf": flat,
+            }
+        return {"daemons": daemons,
+                "num_daemons": len(daemons),
+                "crashes": len(self.crash_ls())}
+
+    def dashboard_html(self) -> str:
+        """Read-only status dashboard (reference mgr/dashboard role —
+        the operator's one-glance page; mutations stay with the CLI)."""
+        import html as _html
+
+        st = self.cluster_status()
+        # escape EVERYTHING daemon-supplied: reports arrive over the
+        # cluster messenger, and a poisoned name/status must not become
+        # stored XSS in the operator's browser
+        rows = "".join(
+            f"<tr><td>{_html.escape(str(name))}</td>"
+            f"<td>{d['stale_s']}s</td>"
+            f"<td>{_html.escape(json.dumps(d['status']))}</td></tr>"
+            for name, d in sorted(st["daemons"].items()))
+        return (
+            "<!doctype html><html><head><title>ceph_tpu mgr</title>"
+            "<style>body{font-family:monospace;margin:2em}"
+            "table{border-collapse:collapse}"
+            "td,th{border:1px solid #999;padding:4px 8px}</style></head>"
+            f"<body><h1>ceph_tpu cluster</h1>"
+            f"<p>{st['num_daemons']} reporting daemons, "
+            f"{st['crashes']} crash reports</p>"
+            f"<table><tr><th>daemon</th><th>report age</th>"
+            f"<th>status</th></tr>{rows}</table>"
+            "<p><a href=/metrics>prometheus metrics</a> | "
+            "<a href=/status>status json</a> | "
+            "<a href=/crash>crash reports</a></p></body></html>")
+
     def prometheus_text(self) -> str:
         lines: List[str] = []
         seen_help = set()
@@ -179,6 +231,12 @@ class MgrDaemon:
             path = request.decode().split(" ")[1] if b" " in request else "/"
             if path == "/metrics":
                 body = self.prometheus_text().encode()
+                status = "200 OK"
+            elif path in ("/", "/dashboard"):
+                body = self.dashboard_html().encode()
+                status = "200 OK"
+            elif path == "/status":
+                body = json.dumps(self.cluster_status()).encode()
                 status = "200 OK"
             elif path == "/crash":
                 body = json.dumps(self.crash_ls()).encode()

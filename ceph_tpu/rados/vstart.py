@@ -137,11 +137,22 @@ class Cluster:
 
 async def _main(args) -> None:
     cluster = Cluster(n_osds=args.osds, data_dir=args.data_dir,
-                      n_mons=args.mons)
+                      n_mons=args.mons, with_mgr=args.mgr)
     await cluster.start()
     print(f"mons at {cluster.mon_addrs}; {args.osds} OSDs up. "
           + ("Ctrl-C to stop." if args.run_for <= 0
              else f"Running {args.run_for}s."), flush=True)
+    if args.addr_file:
+        # machine-readable endpoint dump for the deploy tool (cephadm
+        # bootstrap polls this file to learn the mon quorum)
+        import json as _json
+        import os as _os
+
+        tmp = args.addr_file + ".tmp"
+        with open(tmp, "w") as f:
+            _json.dump({"mons": [list(a) for a in cluster.mon_addrs],
+                        "osds": args.osds, "pid": _os.getpid()}, f)
+        _os.replace(tmp, args.addr_file)
     try:
         if args.run_for > 0:
             await asyncio.sleep(args.run_for)
@@ -161,4 +172,8 @@ if __name__ == "__main__":
     p.add_argument("--data-dir", default=None)
     p.add_argument("--run-for", type=float, default=0.0,
                    help="seconds to run before clean shutdown (0 = forever)")
+    p.add_argument("--mgr", action="store_true",
+                   help="start a mgr daemon (balancer/autoscaler/metrics)")
+    p.add_argument("--addr-file", default=None,
+                   help="write the mon quorum addresses here once up")
     asyncio.run(_main(p.parse_args()))

@@ -68,6 +68,25 @@ class TestMgr:
                 body = await reader.read(-1)
                 assert b"ceph_mgr_daemons_reporting" in body
                 writer.close()
+                # dashboard + status endpoints (mgr/dashboard role)
+                import json as _json
+
+                async def http(path):
+                    r2, w2 = await asyncio.open_connection(host, port)
+                    w2.write(f"GET {path} HTTP/1.1\r\n\r\n".encode())
+                    await w2.drain()
+                    head2 = await r2.readline()
+                    body2 = await r2.read(-1)
+                    w2.close()
+                    return head2, body2
+                head2, page = await http("/dashboard")
+                assert b"200" in head2
+                assert b"ceph_tpu cluster" in page
+                assert b"osd." in page  # daemons table rendered
+                _h, sjson = await http("/status")
+                st = _json.loads(sjson[sjson.index(b"{"):])
+                assert st["num_daemons"] >= 3
+                assert any(n.startswith("osd.") for n in st["daemons"])
                 # crash flow
                 from ceph_tpu.mgr.daemon import MCrashReport, crash_dump
 

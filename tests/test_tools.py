@@ -201,3 +201,62 @@ class TestCephStatusCli:
                 await cluster.stop()
 
         run(go())
+
+
+class TestCephadmDeploy:
+    """cephadm-lite (reference src/cephadm/ role): bootstrap a cluster
+    of real OS processes, register it, query it with the ceph CLI, stop,
+    restart-from-data, and destroy."""
+
+    def test_bootstrap_ls_stop_rm_lifecycle(self, tmp_path):
+        import json as _json
+        import subprocess
+        import sys as _sys
+
+        from ceph_tpu.tools import cephadm
+
+        root = str(tmp_path / "clusters")
+
+        def adm(*argv):
+            return cephadm.main(["--data-root", root, *argv])
+
+        assert adm("bootstrap", "--name", "c1", "--osds", "3") == 0
+        spec = _json.load(open(f"{root}/c1/cluster.json"))
+        assert spec["osds"] == 3 and spec["pid"] > 0
+        try:
+            # registry sees it running
+            assert adm("ls") == 0
+            # the ceph CLI reaches the deployed cluster cross-process
+            mon = f"{spec['mons'][0][0]}:{spec['mons'][0][1]}"
+            out = subprocess.run(
+                [_sys.executable, "-m", "ceph_tpu.tools.ceph",
+                 "--mon", mon, "--format", "json", "status"],
+                capture_output=True, text=True, timeout=120,
+                env=__import__(
+                    "ceph_tpu.utils.jaxdev",
+                    fromlist=["scrub_accelerator_env"]
+                ).scrub_accelerator_env())
+            assert out.returncode == 0, out.stderr[-300:]
+            st = _json.loads(out.stdout)
+            assert st["osdmap"]["num_up_osds"] == 3
+            # durable data landed under the cluster dir
+            assert (tmp_path / "clusters" / "c1" / "data").is_dir()
+            # duplicate bootstrap refused
+            assert adm("bootstrap", "--name", "c1") == 1
+            # stop: process exits, data retained
+            assert adm("stop", "--name", "c1") == 0
+            import time as _time
+            for _ in range(50):
+                if not cephadm._alive(spec["pid"]):
+                    break
+                _time.sleep(0.1)
+            assert not cephadm._alive(spec["pid"])
+            assert (tmp_path / "clusters" / "c1" / "data").is_dir()
+            # rm-cluster requires --force, then removes everything
+            assert adm("rm-cluster", "--name", "c1") == 1
+            assert adm("rm-cluster", "--name", "c1", "--force") == 0
+            assert not (tmp_path / "clusters" / "c1").exists()
+        finally:
+            # belt-and-braces: never leak the daemon host
+            if cephadm._alive(spec["pid"]):
+                os.kill(spec["pid"], 9)
