@@ -1028,3 +1028,144 @@ class Mirrorer:
                 src_img._hdr["id"], pos)
             await journal.expire_through(floor)
         return applied
+
+
+class ImageMigrator:
+    """Live image migration between pools (reference src/librbd/migration/):
+    prepare -> execute -> commit, with abort at any point before commit.
+
+    prepare() creates the destination image and marks BOTH headers with
+    the migration link; execute() copies the head and re-materializes
+    every snapshot's content at the destination (point-in-time copies —
+    destination snap ids are fresh, as the reference's snapshot-copy
+    phase produces); commit() verifies the copy, drops the links, and
+    removes the source; abort() removes the destination and clears the
+    source's link.  The source stays readable throughout (migration is a
+    background copy, not a cut-over), matching the reference's
+    read-from-source-until-commit behavior."""
+
+    def __init__(self, src_ioctx: IoCtx, dst_ioctx: IoCtx):
+        self.src_rbd = RBD(src_ioctx)
+        self.dst_rbd = RBD(dst_ioctx)
+
+    async def prepare(self, name: str) -> None:
+        src = await self.src_rbd.open(name)
+        if src._hdr.get("migration"):
+            raise RbdError(f"image {name!r} is already migrating")
+        dst = await self.dst_rbd.create(name, src.size,
+                                        order=src._hdr["order"])
+        dst._hdr["migration"] = {"role": "destination", "state": "prepared"}
+        await dst._save_header()
+        src._hdr["migration"] = {"role": "source", "state": "prepared"}
+        await src._save_header()
+
+    @staticmethod
+    async def _copy_blocks(read_at, dst: Image, size: int,
+                           blocks) -> None:
+        """Block-granular copy: bounded memory for any image size, and
+        holes stay holes (only the source's materialized blocks are
+        written, so a sparse source does not become a fully-allocated
+        destination)."""
+        bs = dst.object_size
+        for idx in sorted(blocks):
+            base = idx * bs
+            if base >= size:
+                continue
+            n = min(bs, size - base)
+            await dst.write(base, await read_at(base, n))
+
+    async def execute(self, name: str) -> None:
+        src = await self.src_rbd.open(name)
+        dst = await self.dst_rbd.open(name)
+        mig = src._hdr.get("migration")
+        if not mig or mig.get("role") != "source":
+            raise RbdError(f"image {name!r} is not migration-prepared")
+        # snapshots first, OLDEST to newest: each snap's content is
+        # written then snapped at the destination, rebuilding the
+        # point-in-time history before the head lands on top.
+        # Idempotent: a re-execute after a failed commit skips snapshots
+        # the first pass already rebuilt (commit's advertised recovery).
+        existing = set(dst.snap_list())
+        snaps = sorted(src._snaps().items(), key=lambda kv: kv[1]["id"])
+        for snap_name, info in snaps:
+            if snap_name in existing:
+                continue
+            if dst.size != info["size"]:
+                await dst.resize(info["size"])
+            await self._copy_blocks(
+                lambda off, n, s=snap_name: src.read_snap(s, off, n),
+                dst, info["size"], info.get("object_map", ()))
+            await dst.snap_create(snap_name)
+            if info.get("protected"):
+                await dst.snap_protect(snap_name)
+        if dst.size != src.size:
+            await dst.resize(src.size)
+        await self._copy_blocks(src.read, dst, src.size,
+                                src._hdr["object_map"])
+        dst._hdr["migration"] = {"role": "destination", "state": "executed"}
+        await dst._save_header()
+
+    async def commit(self, name: str) -> None:
+        src = await self.src_rbd.open(name)
+        dst = await self.dst_rbd.open(name)
+        if dst._hdr.get("migration", {}).get("state") != "executed":
+            raise RbdError(f"migration of {name!r} has not executed")
+        # ALL validation before ANY destructive step: sizes + snap names
+        # line up, and no source snapshot has clone children (teardown
+        # would wedge half-committed otherwise)
+        if dst.size != src.size or sorted(dst.snap_list()) != \
+                sorted(src.snap_list()):
+            raise RbdError(f"migration of {name!r} failed validation; "
+                           f"abort or re-execute")
+        for snap in src.snap_list():
+            children = await self.src_rbd.children(name, snap)
+            if children:
+                raise RbdError(
+                    f"source snapshot {snap!r} has clone children "
+                    f"{children}; flatten them before committing")
+        # final catch-up pass: writes that landed on the source AFTER
+        # execute() are re-copied now, so commit is a sync point, not a
+        # silent cutoff (the reference's commit-time final sync role)
+        if dst.size != src.size:
+            await dst.resize(src.size)
+        await self._copy_blocks(src.read, dst, src.size,
+                                src._hdr["object_map"])
+        dst._hdr.pop("migration", None)
+        await dst._save_header()
+        # the source's snaps (and protection) die with it
+        for snap in list(src.snap_list()):
+            snap_obj = src._snaps().get(snap, {})
+            if snap_obj.get("protected"):
+                await src.snap_unprotect(snap)
+            await src.snap_remove(snap)
+        src = await self.src_rbd.open(name)
+        src._hdr.pop("migration", None)
+        await src._save_header()
+        await self.src_rbd.remove(name)
+
+    async def abort(self, name: str) -> None:
+        try:
+            dst = await self.dst_rbd.open(name)
+            if dst._hdr.get("migration", {}).get("role") != "destination":
+                # a same-named image that was never a migration
+                # destination must NOT be torn down by an aborted (or
+                # mistyped) migration
+                raise RbdError(
+                    f"image {name!r} in the destination pool is not a "
+                    f"migration destination; refusing to remove it")
+            for snap in list(dst.snap_list()):
+                snap_obj = dst._snaps().get(snap, {})
+                if snap_obj.get("protected"):
+                    await dst.snap_unprotect(snap)
+                await dst.snap_remove(snap)
+            dst = await self.dst_rbd.open(name)
+            dst._hdr.pop("migration", None)
+            await dst._save_header()
+            await self.dst_rbd.remove(name)
+        except RbdError as e:
+            if "not a migration destination" in str(e):
+                raise
+            # destination may not exist yet: abort is idempotent
+        src = await self.src_rbd.open(name)
+        if src._hdr.pop("migration", None) is not None:
+            await src._save_header()

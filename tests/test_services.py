@@ -1169,3 +1169,136 @@ class TestRbdGroupsAndRebuild:
                 await cluster.stop()
 
         run(go())
+
+
+class TestRbdMigration:
+    """Pool-to-pool image migration (reference src/librbd/migration/):
+    prepare -> execute -> commit with snapshot history, plus abort."""
+
+    def test_migrate_with_snapshots_then_commit(self):
+        async def go():
+            from ceph_tpu.services.rbd import ImageMigrator, RbdError
+
+            cluster = Cluster(n_osds=3, conf={"osd_auto_repair": False})
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                await c.create_pool("src", pool_type="replicated")
+                await c.create_pool("dst", pool_type="replicated")
+                r = await Rados(cluster.mons[0].addr).connect()
+                src_io = await r.open_ioctx("src")
+                dst_io = await r.open_ioctx("dst")
+                rbd = RBD(src_io)
+                img = await rbd.create("vm", 2 << 20, order=19)
+                v1 = os.urandom(200_000)
+                await img.write(0, v1)
+                await img.snap_create("s1")
+                v2 = os.urandom(200_000)
+                await img.write(0, v2)
+                await img.snap_create("s2")
+                v3 = os.urandom(200_000)
+                await img.write(0, v3)
+
+                mig = ImageMigrator(src_io, dst_io)
+                await mig.prepare("vm")
+                # double-prepare refused
+                with pytest.raises(RbdError, match="already migrating"):
+                    await mig.prepare("vm")
+                # source stays readable mid-migration
+                assert await (await rbd.open("vm")).read(
+                    0, len(v3)) == v3
+                await mig.execute("vm")
+                await mig.commit("vm")
+                # source is gone; destination serves head AND history
+                with pytest.raises(RbdError):
+                    await rbd.open("vm")
+                moved = await RBD(dst_io).open("vm")
+                assert await moved.read(0, len(v3)) == v3
+                assert sorted(moved.snap_list()) == ["s1", "s2"]
+                assert await moved.read_snap("s1", 0, len(v1)) == v1
+                assert await moved.read_snap("s2", 0, len(v2)) == v2
+                await r.shutdown()
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_commit_syncs_post_execute_writes_and_abort_refuses_stranger(self):
+        async def go():
+            from ceph_tpu.services.rbd import ImageMigrator
+
+            cluster = Cluster(n_osds=3, conf={"osd_auto_repair": False})
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                await c.create_pool("msrc", pool_type="replicated")
+                await c.create_pool("mdst", pool_type="replicated")
+                r = await Rados(cluster.mons[0].addr).connect()
+                src_io = await r.open_ioctx("msrc")
+                dst_io = await r.open_ioctx("mdst")
+                rbd = RBD(src_io)
+                img = await rbd.create("vol", 1 << 20, order=19)
+                await img.write(0, b"A" * 50_000)
+                mig = ImageMigrator(src_io, dst_io)
+                await mig.prepare("vol")
+                await mig.execute("vol")
+                # a write lands on the SOURCE after execute: commit's
+                # final catch-up pass must carry it over, not lose it
+                late = b"B" * 50_000
+                img = await rbd.open("vol")
+                await img.write(0, late)
+                await mig.commit("vol")
+                moved = await RBD(dst_io).open("vol")
+                assert await moved.read(0, len(late)) == late
+                # abort must refuse to destroy a same-named image that
+                # was never a migration destination
+                stranger = await RBD(dst_io).open("vol")  # committed image
+                assert "migration" not in stranger._hdr
+                await rbd.create("vol", 1 << 20, order=19)  # new source
+                mig2 = ImageMigrator(src_io, dst_io)
+                with pytest.raises(RbdError, match="not a migration"):
+                    await mig2.abort("vol")
+                assert await (await RBD(dst_io).open("vol")).read(
+                    0, len(late)) == late  # untouched
+                await r.shutdown()
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_abort_keeps_source_intact(self):
+        async def go():
+            from ceph_tpu.services.rbd import ImageMigrator
+
+            cluster = Cluster(n_osds=3, conf={"osd_auto_repair": False})
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                await c.create_pool("asrc", pool_type="replicated")
+                await c.create_pool("adst", pool_type="replicated")
+                r = await Rados(cluster.mons[0].addr).connect()
+                src_io = await r.open_ioctx("asrc")
+                dst_io = await r.open_ioctx("adst")
+                rbd = RBD(src_io)
+                img = await rbd.create("disk", 1 << 20, order=19)
+                data = os.urandom(100_000)
+                await img.write(0, data)
+                mig = ImageMigrator(src_io, dst_io)
+                await mig.prepare("disk")
+                await mig.execute("disk")
+                await mig.abort("disk")
+                # source intact and re-migratable; destination gone
+                fresh = await rbd.open("disk")
+                assert await fresh.read(0, len(data)) == data
+                assert "migration" not in fresh._hdr
+                with pytest.raises(RbdError):
+                    await RBD(dst_io).open("disk")
+                await mig.prepare("disk")  # can start over
+                await r.shutdown()
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
