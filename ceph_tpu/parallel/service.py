@@ -65,8 +65,14 @@ class BatchingQueue:
         use_pallas: Optional[bool] = None,
         mesh=None,
     ):
+        import os as _os
+
         self.max_pending_bytes = max_pending_bytes
-        self.max_delay = max_delay
+        # the coalescing window is tunable (CEPH_TPU_BATCH_DELAY seconds):
+        # loaded CI hosts widen it so coalescing tests assert the
+        # MECHANISM rather than the 2ms production default's luck
+        env_delay = _os.environ.get("CEPH_TPU_BATCH_DELAY")
+        self.max_delay = float(env_delay) if env_delay else max_delay
         self._use_pallas = use_pallas
         # device-mesh execution (ceph_tpu/parallel/mesh.py): when a mesh
         # is attached (or auto-engages on a multi-chip backend), every

@@ -1052,12 +1052,31 @@ class ImageMigrator:
         src = await self.src_rbd.open(name)
         if src._hdr.get("migration"):
             raise RbdError(f"image {name!r} is already migrating")
+        if src._hdr.get("parent"):
+            # a clone's parent-backed blocks are not in its object map;
+            # the block copier would silently migrate zeros there
+            raise RbdError(f"image {name!r} is a clone; flatten it "
+                           f"before migrating")
         dst = await self.dst_rbd.create(name, src.size,
                                         order=src._hdr["order"])
         dst._hdr["migration"] = {"role": "destination", "state": "prepared"}
         await dst._save_header()
         src._hdr["migration"] = {"role": "source", "state": "prepared"}
         await src._save_header()
+
+    @staticmethod
+    async def _sync_block_set(dst: Image, keep, size: int) -> None:
+        """Zero destination blocks absent from the source's map for this
+        pass: a snapshot (or head) whose map shrank between passes must
+        not expose the previous pass's bytes where the source reads
+        zeros."""
+        bs = dst.object_size
+        keep = set(keep)
+        for idx in sorted(set(dst._hdr["object_map"]) - keep):
+            base = idx * bs
+            if base >= size:
+                continue
+            await dst.write(base, b"\x00" * min(bs, size - base))
 
     @staticmethod
     async def _copy_blocks(read_at, dst: Image, size: int,
@@ -1092,6 +1111,8 @@ class ImageMigrator:
                 continue
             if dst.size != info["size"]:
                 await dst.resize(info["size"])
+            await self._sync_block_set(dst, info.get("object_map", ()),
+                                       info["size"])
             await self._copy_blocks(
                 lambda off, n, s=snap_name: src.read_snap(s, off, n),
                 dst, info["size"], info.get("object_map", ()))
@@ -1100,14 +1121,25 @@ class ImageMigrator:
                 await dst.snap_protect(snap_name)
         if dst.size != src.size:
             await dst.resize(src.size)
+        await self._sync_block_set(dst, src._hdr["object_map"], src.size)
         await self._copy_blocks(src.read, dst, src.size,
                                 src._hdr["object_map"])
         dst._hdr["migration"] = {"role": "destination", "state": "executed"}
         await dst._save_header()
 
     async def commit(self, name: str) -> None:
-        src = await self.src_rbd.open(name)
         dst = await self.dst_rbd.open(name)
+        try:
+            src = await self.src_rbd.open(name)
+        except RbdError:
+            # crash-resume: the source was already torn down by a prior
+            # commit that died before unmarking the destination — finish
+            # that last step
+            if dst._hdr.get("migration", {}).get("state") == "executed":
+                dst._hdr.pop("migration", None)
+                await dst._save_header()
+                return
+            raise
         if dst._hdr.get("migration", {}).get("state") != "executed":
             raise RbdError(f"migration of {name!r} has not executed")
         # ALL validation before ANY destructive step: sizes + snap names
@@ -1125,14 +1157,15 @@ class ImageMigrator:
                     f"{children}; flatten them before committing")
         # final catch-up pass: writes that landed on the source AFTER
         # execute() are re-copied now, so commit is a sync point, not a
-        # silent cutoff (the reference's commit-time final sync role)
-        if dst.size != src.size:
-            await dst.resize(src.size)
+        # silent cutoff (the reference's commit-time final sync role);
+        # sizes were validated equal above
         await self._copy_blocks(src.read, dst, src.size,
                                 src._hdr["object_map"])
-        dst._hdr.pop("migration", None)
-        await dst._save_header()
-        # the source's snaps (and protection) die with it
+        # teardown order matters for crash recovery: the source dies
+        # FIRST and the destination is unmarked LAST, so a crash at any
+        # point leaves a state commit() can resume from (src-gone +
+        # dst-executed = the resume branch above); the reverse order
+        # would strand a marked source no API call can clear
         for snap in list(src.snap_list()):
             snap_obj = src._snaps().get(snap, {})
             if snap_obj.get("protected"):
@@ -1142,10 +1175,16 @@ class ImageMigrator:
         src._hdr.pop("migration", None)
         await src._save_header()
         await self.src_rbd.remove(name)
+        dst._hdr.pop("migration", None)
+        await dst._save_header()
 
     async def abort(self, name: str) -> None:
+        dst = None
         try:
             dst = await self.dst_rbd.open(name)
+        except RbdError:
+            pass  # destination never created: abort is idempotent
+        if dst is not None:
             if dst._hdr.get("migration", {}).get("role") != "destination":
                 # a same-named image that was never a migration
                 # destination must NOT be torn down by an aborted (or
@@ -1153,6 +1192,9 @@ class ImageMigrator:
                 raise RbdError(
                     f"image {name!r} in the destination pool is not a "
                     f"migration destination; refusing to remove it")
+            # teardown failures SURFACE (the destination stays marked and
+            # abort can be retried) — swallowing them would clear the
+            # source link below and wedge the half-removed destination
             for snap in list(dst.snap_list()):
                 snap_obj = dst._snaps().get(snap, {})
                 if snap_obj.get("protected"):
@@ -1162,10 +1204,6 @@ class ImageMigrator:
             dst._hdr.pop("migration", None)
             await dst._save_header()
             await self.dst_rbd.remove(name)
-        except RbdError as e:
-            if "not a migration destination" in str(e):
-                raise
-            # destination may not exist yet: abort is idempotent
         src = await self.src_rbd.open(name)
         if src._hdr.pop("migration", None) is not None:
             await src._save_header()

@@ -16,8 +16,18 @@ from ceph_tpu.rados.vstart import Cluster
 @pytest.fixture(autouse=True)
 def force_batching(monkeypatch):
     # tests run on the CPU backend where the queue normally stays off
-    # (numpy table paths win there); force it so coalescing is exercised
+    # (numpy table paths win there); force it so coalescing is exercised.
+    # A WIDE coalescing window pins the mechanism under host load: with
+    # the 2ms production default, a stalled event loop fragments rounds
+    # and the ops/dispatch assertion measures the host, not the queue.
     monkeypatch.setenv("CEPH_TPU_FORCE_BATCH", "1")
+    monkeypatch.setenv("CEPH_TPU_BATCH_DELAY", "0.05")
+    monkeypatch.setattr(osdmod, "_BATCH_QUEUE", None)
+    yield
+    q = osdmod._BATCH_QUEUE
+    if q is not None:
+        q.close()
+    monkeypatch.setattr(osdmod, "_BATCH_QUEUE", None)
 
 PROFILE = {"plugin": "jerasure", "technique": "reed_sol_van",
            "k": "2", "m": "1"}

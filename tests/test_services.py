@@ -1268,6 +1268,55 @@ class TestRbdMigration:
 
         run(go())
 
+    def test_clone_source_refused_and_crash_resume(self):
+        async def go():
+            from ceph_tpu.services.rbd import ImageMigrator
+
+            cluster = Cluster(n_osds=3, conf={"osd_auto_repair": False})
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                await c.create_pool("csrc", pool_type="replicated")
+                await c.create_pool("cdst", pool_type="replicated")
+                r = await Rados(cluster.mons[0].addr).connect()
+                src_io = await r.open_ioctx("csrc")
+                dst_io = await r.open_ioctx("cdst")
+                rbd = RBD(src_io)
+                base = await rbd.create("base", 1 << 20, order=19)
+                await base.write(0, b"P" * 40_000)
+                await base.snap_create("s")
+                await base.snap_protect("s")
+                clone = await rbd.clone("base", "s", "child")
+                mig = ImageMigrator(src_io, dst_io)
+                # clones carry parent-backed blocks the block copier
+                # cannot see: refused up front, not silently zeroed
+                with pytest.raises(RbdError, match="clone"):
+                    await mig.prepare("child")
+                # crash-resume: source torn down, destination still
+                # marked executed -> a commit retry finishes the unmark
+                img = await rbd.create("plain", 1 << 20, order=19)
+                await img.write(0, b"Q" * 40_000)
+                await mig.prepare("plain")
+                await mig.execute("plain")
+                dst_img = await RBD(dst_io).open("plain")
+                assert dst_img._hdr["migration"]["state"] == "executed"
+                # simulate the crash window: source fully removed, dst
+                # still marked
+                src_img = await rbd.open("plain")
+                src_img._hdr.pop("migration", None)
+                await src_img._save_header()
+                await rbd.remove("plain")
+                await mig.commit("plain")  # resume branch
+                done = await RBD(dst_io).open("plain")
+                assert "migration" not in done._hdr
+                assert await done.read(0, 40_000) == b"Q" * 40_000
+                await r.shutdown()
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
     def test_abort_keeps_source_intact(self):
         async def go():
             from ceph_tpu.services.rbd import ImageMigrator
