@@ -118,15 +118,22 @@ def main() -> int:
     trivial = jax.jit(lambda: jnp.int32(1))
     int(trivial())
     rtts = []
-    for _ in range(5):
+    for _ in range(9):
         t0 = time.perf_counter()
         int(trivial())
         rtts.append(time.perf_counter() - t0)
+    # the FLOOR is the honest subtraction: each timed section is ONE
+    # dispatch, and we remove only its unavoidable RPC latency.  The
+    # validity guard below (wall > 2x floor) rejects measurements where
+    # jitter, not compute, set the wall time.
     rtt = min(rtts)
 
-    # enough iterations that compute time >> the tunnel's ~70 ms RTT —
-    # at 32 the subtraction left the number swinging 2x run to run
-    iters = int(os.environ.get("BENCH_ITERS", "256" if backend == "tpu" else "4"))
+    # enough iterations that compute time >> the tunnel's RPC floor
+    # (~70-110 ms observed): at 256 the batch16 wall sat within 2x of a
+    # congested floor and tripped the validity guard; 1024 puts the net
+    # compute near half a second
+    iters = int(os.environ.get("BENCH_ITERS",
+                               "1024" if backend == "tpu" else "4"))
 
     ones_b = jnp.ones((B,), jnp.int8)
 
@@ -148,17 +155,48 @@ def main() -> int:
             return fold(out, carry)
         return lax.fori_loop(0, iters, body, jnp.int32(0))
 
+    def timed(fn, *a) -> float:
+        """Best-of-2 wall time (timeit's min discipline): the shared dev
+        chip's transient congestion must not masquerade as a slower
+        kernel."""
+        best = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            int(fn(*a))
+            w = time.perf_counter() - t0
+            best = w if best is None else min(best, w)
+        return best
+
+    def fresh_rtt() -> float:
+        samples = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            int(trivial())
+            samples.append(time.perf_counter() - t0)
+        return min(samples)
+
+    def measure_net(fn, *a):
+        """Net compute time with the RPC floor subtracted, self-retrying:
+        a congested tunnel window (wall within 2x the floor, where jitter
+        rather than compute sets the time) re-measures both the section
+        and the floor instead of poisoning the whole run.  None when
+        every attempt stayed rtt-dominated."""
+        floor = rtt
+        for _ in range(3):
+            wall = timed(fn, *a)
+            if wall > floor * 2.0:
+                return wall - floor
+            floor = fresh_rtt()
+        return None
+
     int(loop(bmd, d))  # warm / compile
-    t0 = time.perf_counter()
-    int(loop(bmd, d))
-    wall = time.perf_counter() - t0
-    if wall <= rtt * 1.05:
+    dt = measure_net(loop, bmd, d)
+    if dt is None:
         # compute is lost in RPC jitter (tiny BENCH_STRIPES/ITERS overrides):
         # report a measurement failure rather than an absurd GB/s
         print(json.dumps({"metric": "measurement_invalid_rtt_dominated",
                           "value": 0, "unit": "GB/s", "vs_baseline": 0}))
         return 1
-    dt = wall - rtt
     total_bytes = iters * K * B  # data bytes encoded (reference counts in_size)
     packed_gbps = total_bytes / dt / 1e9
 
@@ -192,14 +230,12 @@ def main() -> int:
                           "unit": "bool", "vs_baseline": 0}))
         return 1
     int(resident_pipeline(bmd, d))  # warm / compile
-    t0 = time.perf_counter()
-    int(resident_pipeline(bmd, d))
-    res_wall = time.perf_counter() - t0
-    if res_wall <= rtt * 1.05:
+    res_wall = measure_net(resident_pipeline, bmd, d)
+    if res_wall is None:
         print(json.dumps({"metric": "measurement_invalid_rtt_dominated",
                           "value": 0, "unit": "GB/s", "vs_baseline": 0}))
         return 1
-    gbps = total_bytes / (res_wall - rtt) / 1e9
+    gbps = total_bytes / res_wall / 1e9
 
     # TPU DECODE: the other half of the headline metric ("encode+decode
     # GB/s", BASELINE.md; reference decode workload
@@ -276,14 +312,12 @@ def main() -> int:
                           "unit": "bool", "vs_baseline": 0}))
         return 1
     int(decode_loop(inv_stack, d))  # warm
-    t0 = time.perf_counter()
-    int(decode_loop(inv_stack, d))
-    dec_wall = time.perf_counter() - t0
-    if dec_wall <= rtt * 1.05:
+    dec_wall = measure_net(decode_loop, inv_stack, d)
+    if dec_wall is None:
         print(json.dumps({"metric": "measurement_invalid_rtt_dominated",
                           "value": 0, "unit": "GB/s", "vs_baseline": 0}))
         return 1
-    dec_packed_gbps = (iters * K * B) / (dec_wall - rtt) / 1e9
+    dec_packed_gbps = (iters * K * B) / dec_wall / 1e9
 
     # planar-resident decode (production shape under residency): the
     # survivors were admitted as bit-planes at write time, each decode is
@@ -305,14 +339,12 @@ def main() -> int:
         return acc ^ jnp.sum(packed.astype(jnp.int32))
 
     int(planar_decode_loop(inv_stack, d))  # warm
-    t0 = time.perf_counter()
-    int(planar_decode_loop(inv_stack, d))
-    pdec_wall = time.perf_counter() - t0
-    if pdec_wall <= rtt * 1.05:
+    pdec_wall = measure_net(planar_decode_loop, inv_stack, d)
+    if pdec_wall is None:
         print(json.dumps({"metric": "measurement_invalid_rtt_dominated",
                           "value": 0, "unit": "GB/s", "vs_baseline": 0}))
         return 1
-    dec_gbps = (iters * K * B) / (pdec_wall - rtt) / 1e9
+    dec_gbps = (iters * K * B) / pdec_wall / 1e9
 
     # BIT-PLANAR RESIDENCY: the steady-state rate when shards stay
     # bit-planar in HBM across the pipeline and pack/unpack is paid once
@@ -330,14 +362,12 @@ def main() -> int:
         return lax.fori_loop(0, iters, body, jnp.int32(0))
 
     int(planar_loop(bmd, bits))  # warm
-    t0 = time.perf_counter()
-    int(planar_loop(bmd, bits))
-    planar_wall = time.perf_counter() - t0
-    if planar_wall <= rtt * 1.05:
+    planar_wall = measure_net(planar_loop, bmd, bits)
+    if planar_wall is None:
         print(json.dumps({"metric": "measurement_invalid_rtt_dominated",
                           "value": 0, "unit": "GB/s", "vs_baseline": 0}))
         return 1
-    planar_gbps = (iters * K * B) / (planar_wall - rtt) / 1e9
+    planar_gbps = (iters * K * B) / planar_wall / 1e9
 
     # Pallas re-test under planar residency (VERDICT r03 #9): the fused
     # kernel lost to XLA when pack/unpack dominated; with residency the
@@ -361,11 +391,9 @@ def main() -> int:
             xk = np.asarray(gf2_matmul(bmd, bits[:, :TILE_CHECK]))
             if np.array_equal(pk, xk):
                 int(pallas_planar_loop(bmd, bits))  # warm
-                t0 = time.perf_counter()
-                int(pallas_planar_loop(bmd, bits))
-                pw = time.perf_counter() - t0
-                if pw > rtt * 1.05:
-                    pallas_planar_gbps = (iters * K * B) / (pw - rtt) / 1e9
+                pw = measure_net(pallas_planar_loop, bmd, bits)
+                if pw is not None:
+                    pallas_planar_gbps = (iters * K * B) / pw / 1e9
         except Exception:
             pass
     del bits

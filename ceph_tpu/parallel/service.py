@@ -61,18 +61,25 @@ class BatchingQueue:
         # pipeline (bench.py r4 sweep — the 8x bit-plane expansion makes
         # 64 MiB batches HBM-bound on v5e; 2 MiB of columns at k=8 wins)
         max_pending_bytes: int = 16 << 20,
-        max_delay: float = 0.002,
+        max_delay: Optional[float] = None,
         use_pallas: Optional[bool] = None,
         mesh=None,
     ):
         import os as _os
 
         self.max_pending_bytes = max_pending_bytes
-        # the coalescing window is tunable (CEPH_TPU_BATCH_DELAY seconds):
-        # loaded CI hosts widen it so coalescing tests assert the
-        # MECHANISM rather than the 2ms production default's luck
-        env_delay = _os.environ.get("CEPH_TPU_BATCH_DELAY")
-        self.max_delay = float(env_delay) if env_delay else max_delay
+        # the DEFAULT coalescing window is tunable (CEPH_TPU_BATCH_DELAY
+        # seconds): loaded CI hosts widen it so coalescing tests assert
+        # the MECHANISM rather than the 2ms default's luck.  An explicit
+        # max_delay argument always wins, and a malformed value falls
+        # back rather than crashing the first EC write.
+        if max_delay is None:
+            try:
+                max_delay = float(
+                    _os.environ.get("CEPH_TPU_BATCH_DELAY") or 0.002)
+            except ValueError:
+                max_delay = 0.002
+        self.max_delay = max_delay
         self._use_pallas = use_pallas
         # device-mesh execution (ceph_tpu/parallel/mesh.py): when a mesh
         # is attached (or auto-engages on a multi-chip backend), every

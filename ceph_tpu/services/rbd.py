@@ -1066,17 +1066,25 @@ class ImageMigrator:
 
     @staticmethod
     async def _sync_block_set(dst: Image, keep, size: int) -> None:
-        """Zero destination blocks absent from the source's map for this
-        pass: a snapshot (or head) whose map shrank between passes must
-        not expose the previous pass's bytes where the source reads
-        zeros."""
-        bs = dst.object_size
+        """DEALLOCATE destination blocks absent from the source's map for
+        this pass: a snapshot (or head) whose map shrank between passes
+        must not expose the previous pass's bytes where the source reads
+        zeros.  Removal (the resize-shrink pattern) keeps holes holes —
+        zero-WRITES would materialize the blocks and make every later
+        pass re-process them."""
         keep = set(keep)
-        for idx in sorted(set(dst._hdr["object_map"]) - keep):
-            base = idx * bs
-            if base >= size:
-                continue
-            await dst.write(base, b"\x00" * min(bs, size - base))
+        extra = sorted(set(dst._hdr["object_map"]) - keep)
+        if not extra:
+            return
+        snapc = dst._image_snapc()
+        for idx in extra:
+            try:
+                await dst.ioctx.remove(dst._data_oid(idx), snapc=snapc)
+            except RadosError:
+                pass
+        dst._hdr["object_map"] = sorted(
+            set(dst._hdr["object_map"]) - set(extra))
+        await dst._save_header(drop_blocks=extra)
 
     @staticmethod
     async def _copy_blocks(read_at, dst: Image, size: int,
@@ -1142,11 +1150,15 @@ class ImageMigrator:
             raise
         if dst._hdr.get("migration", {}).get("state") != "executed":
             raise RbdError(f"migration of {name!r} has not executed")
-        # ALL validation before ANY destructive step: sizes + snap names
-        # line up, and no source snapshot has clone children (teardown
-        # would wedge half-committed otherwise)
-        if dst.size != src.size or sorted(dst.snap_list()) != \
-                sorted(src.snap_list()):
+        # ALL validation before ANY destructive step: sizes line up,
+        # every SOURCE snapshot exists at the destination, and no source
+        # snapshot has clone children (teardown would wedge otherwise).
+        # Subset, not equality: a commit that crashed mid-source-teardown
+        # resumes with some source snaps already gone — the destination
+        # holding MORE history than the torn source is the expected
+        # resumable state, not a validation failure.
+        if dst.size != src.size or not set(src.snap_list()) <= \
+                set(dst.snap_list()):
             raise RbdError(f"migration of {name!r} failed validation; "
                            f"abort or re-execute")
         for snap in src.snap_list():
@@ -1156,9 +1168,11 @@ class ImageMigrator:
                     f"source snapshot {snap!r} has clone children "
                     f"{children}; flatten them before committing")
         # final catch-up pass: writes that landed on the source AFTER
-        # execute() are re-copied now, so commit is a sync point, not a
-        # silent cutoff (the reference's commit-time final sync role);
-        # sizes were validated equal above
+        # execute() are re-copied now — and blocks the source trimmed
+        # since execute are deallocated — so commit is a full sync point,
+        # not a silent cutoff (the reference's commit-time final sync
+        # role); sizes were validated equal above
+        await self._sync_block_set(dst, src._hdr["object_map"], src.size)
         await self._copy_blocks(src.read, dst, src.size,
                                 src._hdr["object_map"])
         # teardown order matters for crash recovery: the source dies
