@@ -315,6 +315,15 @@ class BlueStore(ObjectStore):
         onode = self._onodes.get(key)
         return onode.xattrs.get(name) if onode else None
 
+    def rmattr(self, key: Key, name: str) -> None:
+        onode = self._onodes.get(key)
+        if onode is None or name not in onode.xattrs:
+            return
+        del onode.xattrs[name]
+        batch = WriteBatch()
+        batch.set(PREFIX_OBJ, _okey(key), pickle.dumps(onode, protocol=5))
+        self.db.submit(batch)
+
     def getattrs(self, key: Key) -> Dict[str, bytes]:
         onode = self._onodes.get(key)
         return dict(onode.xattrs) if onode else {}

@@ -56,6 +56,9 @@ class RadosError(Exception):
 _DEFINITIVE_CODES = frozenset((
     -errno.ENOENT, -errno.EOPNOTSUPP, -errno.EINVAL, -errno.EPERM,
     -errno.EBADMSG, -errno.ENXIO, -errno.EEXIST, -errno.ERANGE,
+    # compound-op asserts: cmpxattr mismatch / missing xattr are verdicts
+    # about object state, not transients (reference rados_exec rvals)
+    -errno.ECANCELED, -errno.ENODATA,
 ))
 # -ESTALE (not primary): the placement this op was computed on is WRONG —
 # re-target only after fencing past our own epoch (a newer map exists or
@@ -388,6 +391,23 @@ class RadosClient:
         await self._op(MOSDOp(op="write", pool_id=pool_id, oid=oid, data=data,
                               offset=-1 if offset is None else int(offset),
                               snapc_seq=seq, snapc_snaps=list(snaps)))
+
+    async def multi(self, pool_id: int, oid: str, ops,
+                    snapc: Optional[Tuple[int, List[int]]] = None):
+        """Compound atomic op (reference MOSDOp vector<OSDOp> /
+        ObjectWriteOperation): `ops` is an ordered list of (name, kwargs)
+        sub-ops executed all-or-nothing on one object.  Returns
+        (per-sub-op results, object version the op observed); a failing
+        sub-op raises RadosError with its typed code and nothing
+        applied."""
+        import pickle as _pickle
+
+        self._check_oid(oid)
+        seq, snaps = snapc if snapc else (0, [])
+        reply = await self._op(MOSDOp(op="multi", pool_id=pool_id, oid=oid,
+                                      ops=list(ops), snapc_seq=seq,
+                                      snapc_snaps=list(snaps)))
+        return _pickle.loads(reply.data), reply.version
 
     # -- self-managed snapshots (reference IoCtxImpl selfmanaged_snap_*) ----
 

@@ -151,6 +151,53 @@ class IoCtx:
                                          method=method), retries=3)
         return pickle.loads(reply.data)
 
+    # -- xattr / omap conveniences (rados_{set,get}xattr, rados_omap_*) -----
+    # each is a one-sub-op compound (the multi executor is the single
+    # server-side metadata path, so these are atomic with cls calls)
+
+    async def setxattr(self, oid: str, name: str, value: bytes) -> None:
+        await self._c.multi(self.pool_id, oid,
+                            [("setxattr", {"name": name,
+                                           "value": bytes(value)})],
+                            snapc=self._snapc)
+
+    async def getxattr(self, oid: str, name: str) -> bytes:
+        results, _v = await self._c.multi(
+            self.pool_id, oid, [("getxattr", {"name": name})])
+        return results[0][1]
+
+    async def rmxattr(self, oid: str, name: str) -> None:
+        await self._c.multi(self.pool_id, oid,
+                            [("rmxattr", {"name": name})],
+                            snapc=self._snapc)
+
+    async def getxattrs(self, oid: str) -> Dict[str, bytes]:
+        results, _v = await self._c.multi(self.pool_id, oid,
+                                          [("getxattrs", {})])
+        return results[0][1]
+
+    async def omap_set(self, oid: str, entries: Dict[str, bytes]) -> None:
+        await self._c.multi(self.pool_id, oid,
+                            [("omap_set", {"entries": dict(entries)})],
+                            snapc=self._snapc)
+
+    async def omap_get_vals(self, oid: str) -> Dict[str, bytes]:
+        results, _v = await self._c.multi(self.pool_id, oid,
+                                          [("omap_get_vals", {})])
+        return results[0][1]
+
+    async def omap_rm_keys(self, oid: str, keys) -> None:
+        await self._c.multi(self.pool_id, oid,
+                            [("omap_rm_keys", {"keys": list(keys)})],
+                            snapc=self._snapc)
+
+    async def operate(self, oid: str, op) -> list:
+        """Execute a neorados WriteOp/ReadOp through this ioctx
+        (librados operate/operate_read role over the same engine)."""
+        results, _v = await self._c.multi(self.pool_id, oid, op._ops,
+                                          snapc=self._snapc)
+        return results
+
     async def watch(self, oid: str, callback) -> None:
         await self._c.watch(self.pool_id, oid, callback)
 

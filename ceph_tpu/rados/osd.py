@@ -33,6 +33,7 @@ against stored meta and repairs mismatches (be_deep_scrub).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import errno
 import json
 import pickle
@@ -116,6 +117,7 @@ from ceph_tpu.rados.types import (
     MNotifyAck,
     MScrubShard,
     MScrubShardReply,
+    MSetOmap,
     MSetXattrs,
     MWatchNotify,
     OSDMap,
@@ -718,6 +720,19 @@ class OSD:
             try:
                 for name, value in msg.xattrs.items():
                     self.store.setattr(key, name, value)
+                for name in msg.removals:
+                    self.store.rmattr(key, name)
+            except NotImplementedError:
+                pass
+        elif isinstance(msg, MSetOmap):
+            key = (msg.pool_id, msg.oid, msg.shard)
+            try:
+                if msg.clear:
+                    self.store.omap_rm(key, list(self.store.omap_get(key)))
+                if msg.entries:
+                    self.store.omap_set(key, msg.entries)
+                if msg.removals:
+                    self.store.omap_rm(key, msg.removals)
             except NotImplementedError:
                 pass
         elif isinstance(msg, MPGLogReply) and not msg.tid:
@@ -1467,6 +1482,8 @@ class OSD:
                 reply = MOSDOpReply(ok=True)
             elif op.op == "call":
                 reply = await self._do_call(op)
+            elif op.op == "multi":
+                reply = await self._do_multi(op)
             elif op.op == "stat":
                 reply = await self._snap_routed(op, self._do_stat)
             elif op.op == "watch":
@@ -2412,14 +2429,26 @@ class OSD:
         # sharded queue serializes per PG in steady state, but a map
         # race around pool creation can key two calls differently, so
         # the primary holds its own per-object critical section.
+        async with self._object_critical_section(op.pool_id, op.oid):
+            reply = await self._do_call_locked(op, pool, pg, acting, fn,
+                                               key)
+        if reply.ok:
+            self._cache_call_reply(op.reqid, reply)
+        return reply
+
+    @contextlib.asynccontextmanager
+    async def _object_critical_section(self, pool_id: int, oid: str):
+        """Refcounted per-object mutex shared by cls calls and compound
+        (multi) ops — the two must be mutually atomic.  Eviction never
+        orphans a lock another task still waits on."""
         from ceph_tpu.common.lockdep import make_async_mutex
 
         ent = self._cls_locks.setdefault(
-            (op.pool_id, op.oid), [make_async_mutex("osd-cls-call"), 0])
-        ent[1] += 1  # waiter refcount: eviction must never orphan a lock
+            (pool_id, oid), [make_async_mutex("osd-cls-call"), 0])
+        ent[1] += 1  # waiter refcount
         try:
-            return await self._do_call_locked(op, pool, pg, acting, fn,
-                                              key, ent[0])
+            async with ent[0]:
+                yield
         finally:
             ent[1] -= 1
             while len(self._cls_locks) > 512:
@@ -2428,48 +2457,403 @@ class OSD:
                     break  # oldest still referenced: trim next time
                 del self._cls_locks[k]
 
-    async def _do_call_locked(self, op, pool, pg, acting, fn, key,
-                              lock) -> MOSDOpReply:
+    def _cache_call_reply(self, reqid: str, reply: MOSDOpReply) -> None:
+        """Bounded replay cache for non-idempotent ops (cls calls,
+        multis, notifies): a resend whose reply was lost replays the
+        ORIGINAL result instead of re-executing."""
+        if not reqid:
+            return
+        self._call_results[reqid] = reply
+        while len(self._call_results) > 512:
+            self._call_results.pop(next(iter(self._call_results)))
+
+    async def _do_call_locked(self, op, pool, pg, acting, fn,
+                              key) -> MOSDOpReply:
         from ceph_tpu.services.cls import ClsContext
 
-        async with lock:
-            read = await self._do_read_replicated(
-                MOSDOp(op="read", pool_id=op.pool_id, oid=op.oid), pool)
-            hctx = ClsContext(read.data if read.ok else None,
-                              dict(self.store.getattrs(key)))
-            ret, out = fn(hctx, op.data)
-            if hctx.data_dirty and ret >= 0:
-                wr = await self._do_write_replicated(
-                    MOSDOp(op="write", pool_id=op.pool_id, oid=op.oid,
-                           data=hctx.data, reqid=uuid.uuid4().hex),
-                    pool, pg, acting)
-                if not wr.ok:
-                    return MOSDOpReply(ok=False, code=wr.code,
-                                       error=wr.error)
-            if hctx.xattrs_dirty and ret >= 0:
-                # xattr apply stays INSIDE the critical section: the
-                # advisory-lock class's read-check-set is only atomic if
-                # the next call observes these bytes
-                for name, value in hctx.xattrs.items():
-                    self.store.setattr(key, name, value)
-                # replicate xattr state to the other acting members so a
-                # failover primary still sees locks/refcounts
-                for shard, osd in enumerate(acting):
-                    if osd in (CRUSH_ITEM_NONE, self.osd_id):
-                        continue
-                    try:
+        read = await self._do_read_replicated(
+            MOSDOp(op="read", pool_id=op.pool_id, oid=op.oid), pool)
+        hctx = ClsContext(read.data if read.ok else None,
+                          dict(self.store.getattrs(key)))
+        ret, out = fn(hctx, op.data)
+        if hctx.data_dirty and ret >= 0:
+            wr = await self._do_write_replicated(
+                MOSDOp(op="write", pool_id=op.pool_id, oid=op.oid,
+                       data=hctx.data, reqid=uuid.uuid4().hex),
+                pool, pg, acting)
+            if not wr.ok:
+                return MOSDOpReply(ok=False, code=wr.code,
+                                   error=wr.error)
+        if hctx.xattrs_dirty and ret >= 0:
+            # xattr apply stays INSIDE the critical section: the
+            # advisory-lock class's read-check-set is only atomic if
+            # the next call observes these bytes
+            for name, value in hctx.xattrs.items():
+                self.store.setattr(key, name, value)
+            # replicate xattr state to the other acting members so a
+            # failover primary still sees locks/refcounts
+            for shard, osd in enumerate(acting):
+                if osd in (CRUSH_ITEM_NONE, self.osd_id):
+                    continue
+                try:
+                    await self.messenger.send(
+                        self.osdmap.addr_of(osd),
+                        MSetXattrs(pool_id=op.pool_id, oid=op.oid,
+                                   shard=0, xattrs=dict(hctx.xattrs)))
+                except TRANSPORT_ERRORS:
+                    pass
+        return MOSDOpReply(ok=True, data=pickle.dumps((ret, out)))
+
+    # -- compound atomic ops (reference MOSDOp vector<OSDOp>,
+    # PrimaryLogPG::do_osd_ops; client side ObjectWriteOperation /
+    # neorados WriteOp) ------------------------------------------------------
+
+    # sub-ops whose execution needs the object's prior data image; a multi
+    # containing none of these serves existence/version/size from a cheap
+    # metadata stat instead of a full (possibly decoding) head read
+    _MULTI_NEEDS_DATA = frozenset({
+        "read", "write", "append", "truncate", "zero", "call",
+    })
+    _MULTI_OMAP = frozenset({"omap_set", "omap_rm_keys", "omap_clear",
+                             "omap_get_vals", "omap_get_keys"})
+    # sub-ops allowed on EC pools (reference parity: EC pools support
+    # neither omap nor class calls — doc/dev/osd_internals/erasure_coding)
+    _MULTI_EC_OK = frozenset({
+        "create", "assert_exists", "assert_version", "cmpxattr",
+        "read", "stat", "getxattr", "getxattrs",
+        "write", "write_full", "append", "truncate", "zero", "remove",
+        "setxattr", "rmxattr",
+    })
+
+    async def _do_multi(self, op: MOSDOp) -> MOSDOpReply:
+        """Execute op.ops — an ordered vector of (name, kwargs) sub-ops —
+        atomically on one object.  All-or-nothing: sub-ops run against a
+        STAGED image (data bytes + xattrs + omap) under the object's
+        critical section; nothing touches the store or the wire until the
+        whole vector has succeeded, so a failing assert/sub-op aborts with
+        zero side effects.  Reads inside the vector observe earlier
+        staged writes (reference do_osd_ops execution order)."""
+        pool = self.osdmap.pools.get(op.pool_id)
+        if pool is None:
+            return MOSDOpReply(ok=False, code=-errno.ENOENT,
+                               error="no such pool")
+        pg, acting = self._acting(pool, op.oid)
+        if self._primary(pool, pg, acting) != self.osd_id:
+            return MOSDOpReply(ok=False, code=-errno.ESTALE,
+                               error="not primary")
+        # compound ops are not idempotent (append, cls calls): replay the
+        # original reply on a resend, exactly as _do_call does
+        if op.reqid and op.reqid in self._call_results:
+            return self._call_results[op.reqid]
+        if pool.pool_type == "ec":
+            for i, (name, _kw) in enumerate(op.ops):
+                if name not in self._MULTI_EC_OK:
+                    return MOSDOpReply(
+                        ok=False, code=-errno.EOPNOTSUPP,
+                        error=f"EOPNOTSUPP: sub-op {i} ({name}) on EC pool")
+        # the SAME per-object critical section cls calls use: a multi and
+        # a cls call (or two multis) on one object serialize, so the
+        # read-stage-commit below is atomic per object
+        async with self._object_critical_section(op.pool_id, op.oid):
+            reply = await self._do_multi_locked(op, pool, pg, acting)
+        if reply.ok:
+            # only successes replay; a failed multi applied nothing, so a
+            # resend may legitimately re-execute (and could then succeed)
+            self._cache_call_reply(op.reqid, reply)
+        return reply
+
+    async def _do_multi_locked(self, op: MOSDOp, pool: PoolInfo,
+                               pg: int, acting: List[int]) -> MOSDOpReply:
+        from ceph_tpu.services.cls import ClsContext
+        from ceph_tpu.services.cls import registry as cls_registry
+
+        key0 = (op.pool_id, op.oid, 0)  # canonical metadata shard (cls role)
+        # -- gather the current image --------------------------------------
+        exists = False
+        data = bytearray()
+        data_loaded = False  # False: `size` is authoritative, not len(data)
+        size = 0
+        version = 0
+        if any(name in self._MULTI_NEEDS_DATA for name, _ in op.ops):
+            read = await self._do_read(
+                MOSDOp(op="read", pool_id=op.pool_id, oid=op.oid))
+            if read.ok:
+                exists, data, version = True, bytearray(read.data), read.version
+                data_loaded = True
+            elif read.code != -errno.ENOENT:
+                # transient failure reading the head: the multi must not
+                # run against a guessed image — bubble the retryable error
+                return MOSDOpReply(ok=False, code=read.code,
+                                   error=read.error, backoff=read.backoff)
+        else:
+            # metadata-only vector: existence + version + size from the
+            # stat path (shard metadata fan-out, no payload transfer)
+            st = await self._do_stat(
+                MOSDOp(op="stat", pool_id=op.pool_id, oid=op.oid))
+            if st.ok:
+                exists, version, size = True, st.version, int(st.data or b"0")
+            elif st.code != -errno.ENOENT:
+                return MOSDOpReply(ok=False, code=st.code,
+                                   error=st.error, backoff=st.backoff)
+        reserved = {self.SNAPSET_XATTR, HashInfo.XATTR_KEY}
+        try:
+            xattrs = {k: v for k, v in self.store.getattrs(key0).items()
+                      if k not in reserved}
+        except NotImplementedError:
+            xattrs = {}
+        for i, (name, kw) in enumerate(op.ops):
+            if (name in ("setxattr", "rmxattr", "getxattr", "cmpxattr")
+                    and kw.get("name") in reserved):
+                return MOSDOpReply(
+                    ok=False, code=-errno.EINVAL,
+                    error=f"sub-op {i} ({name}): reserved xattr name",
+                    data=pickle.dumps([]))
+        omap: Dict[str, bytes] = {}
+        if any(name in self._MULTI_OMAP for name, _ in op.ops):
+            try:
+                omap = dict(self.store.omap_get(key0))
+            except NotImplementedError:
+                omap = {}
+        # -- staged execution ----------------------------------------------
+        results: List[Tuple[int, object]] = []
+        data_dirty = False
+        removed = False
+        xattr_sets: Dict[str, bytes] = {}
+        xattr_rms: set = set()
+        omap_cleared = False
+        omap_sets: Dict[str, bytes] = {}
+        omap_rms: set = set()
+
+        def fail(i: int, name: str, code: int, why: str) -> MOSDOpReply:
+            return MOSDOpReply(
+                ok=False, code=code,
+                error=f"sub-op {i} ({name}): {why}",
+                data=pickle.dumps(results))
+
+        for i, (name, kw) in enumerate(op.ops):
+            rval = 0
+            out: object = None
+            if name == "create":
+                if kw.get("exclusive") and exists:
+                    return fail(i, name, -errno.EEXIST, "object exists")
+                if not exists:
+                    exists, data_dirty, removed = True, True, False
+                    data_loaded = True  # fresh empty image IS the data
+            elif name == "assert_exists":
+                if not exists:
+                    return fail(i, name, -errno.ENOENT, "object absent")
+            elif name == "assert_version":
+                want = int(kw.get("version", 0))
+                if not exists or version != want:
+                    return fail(i, name, -errno.ERANGE,
+                                f"version {version} != asserted {want}")
+            elif name == "cmpxattr":
+                if not exists:
+                    return fail(i, name, -errno.ENOENT, "object absent")
+                if xattrs.get(kw["name"]) != kw.get("value"):
+                    return fail(i, name, -errno.ECANCELED,
+                                "xattr comparison failed")
+            elif name == "read":
+                if not exists:
+                    return fail(i, name, -errno.ENOENT, "object absent")
+                off = int(kw.get("offset", 0))
+                length = kw.get("length")
+                end = len(data) if length is None else off + int(length)
+                out = bytes(data[off:end])
+            elif name == "stat":
+                if not exists:
+                    return fail(i, name, -errno.ENOENT, "object absent")
+                out = {"size": len(data) if data_loaded else size,
+                       "version": version}
+            elif name == "getxattr":
+                if not exists:
+                    return fail(i, name, -errno.ENOENT, "object absent")
+                val = xattrs.get(kw["name"])
+                if val is None:
+                    return fail(i, name, -errno.ENODATA,
+                                f"no xattr {kw['name']!r}")
+                out = val
+            elif name == "getxattrs":
+                if not exists:
+                    return fail(i, name, -errno.ENOENT, "object absent")
+                out = dict(xattrs)
+            elif name == "omap_get_vals":
+                if not exists:
+                    return fail(i, name, -errno.ENOENT, "object absent")
+                out = dict(omap)
+            elif name == "omap_get_keys":
+                if not exists:
+                    return fail(i, name, -errno.ENOENT, "object absent")
+                out = sorted(omap)
+            elif name == "write":
+                off = int(kw.get("offset", 0))
+                blob = kw["data"]
+                if len(data) < off:
+                    data.extend(b"\x00" * (off - len(data)))
+                data[off:off + len(blob)] = blob
+                exists, data_dirty, removed = True, True, False
+            elif name == "write_full":
+                data = bytearray(kw["data"])
+                exists, data_dirty, removed = True, True, False
+            elif name == "append":
+                data.extend(kw["data"])
+                exists, data_dirty, removed = True, True, False
+            elif name == "truncate":
+                size = int(kw.get("size", 0))
+                if len(data) < size:
+                    data.extend(b"\x00" * (size - len(data)))
+                else:
+                    del data[size:]
+                exists, data_dirty, removed = True, True, False
+            elif name == "zero":
+                off, length = int(kw.get("offset", 0)), int(kw["length"])
+                if len(data) < off + length:
+                    data.extend(b"\x00" * (off + length - len(data)))
+                data[off:off + length] = b"\x00" * length
+                exists, data_dirty, removed = True, True, False
+            elif name == "remove":
+                if not exists:
+                    return fail(i, name, -errno.ENOENT, "object absent")
+                exists, removed, data_dirty = False, True, False
+                data = bytearray()
+                # a removed object has no metadata: later sub-ops must
+                # not see it, earlier-staged sets must not be applied,
+                # and commit purges the persisted user names
+                xattr_rms.update(xattrs)
+                xattrs.clear()
+                xattr_sets.clear()
+                omap.clear()
+                omap_sets.clear()
+                omap_rms.clear()
+                omap_cleared = True
+            elif name == "setxattr":
+                if removed:  # write-class op after remove recreates
+                    exists, data_dirty, removed = True, True, False
+                    data_loaded = True
+                xattrs[kw["name"]] = kw["value"]
+                xattr_sets[kw["name"]] = kw["value"]
+                xattr_rms.discard(kw["name"])
+            elif name == "rmxattr":
+                if kw["name"] not in xattrs:
+                    return fail(i, name, -errno.ENODATA,
+                                f"no xattr {kw['name']!r}")
+                del xattrs[kw["name"]]
+                xattr_sets.pop(kw["name"], None)
+                xattr_rms.add(kw["name"])
+            elif name == "omap_set":
+                if removed:  # write-class op after remove recreates
+                    exists, data_dirty, removed = True, True, False
+                    data_loaded = True
+                entries = dict(kw["entries"])
+                omap.update(entries)
+                omap_sets.update(entries)
+                omap_rms.difference_update(entries)
+            elif name == "omap_rm_keys":
+                for k in kw["keys"]:
+                    omap.pop(k, None)
+                    omap_sets.pop(k, None)
+                    omap_rms.add(k)
+            elif name == "omap_clear":
+                omap.clear()
+                omap_sets.clear()
+                omap_rms.clear()
+                omap_cleared = True
+            elif name == "call":
+                fn = cls_registry.get(kw["cls"], kw["method"])
+                if fn is None:
+                    return fail(i, name, -errno.ENOENT,
+                                f"no class {kw['cls']}.{kw['method']}")
+                hctx = ClsContext(bytes(data) if exists else None,
+                                  dict(xattrs))
+                ret, cout = fn(hctx, kw.get("input", b""))
+                if ret < 0:
+                    return fail(i, name, ret,
+                                f"class {kw['cls']}.{kw['method']} -> {ret}")
+                if hctx.data_dirty:
+                    data = bytearray(hctx.data or b"")
+                    exists, data_dirty, removed = True, True, False
+                if hctx.xattrs_dirty:
+                    for k, v in hctx.xattrs.items():
+                        if xattrs.get(k) != v:
+                            xattr_sets[k] = v
+                            xattr_rms.discard(k)
+                    for k in list(xattrs):
+                        if k not in hctx.xattrs:
+                            xattr_sets.pop(k, None)
+                            xattr_rms.add(k)
+                    xattrs = dict(hctx.xattrs)
+                rval, out = ret, cout
+            else:
+                return fail(i, name, -errno.EINVAL, "unknown sub-op")
+            results.append((rval, out))
+        # -- commit (all sub-ops passed) -----------------------------------
+        if (not exists and not removed
+                and (xattr_sets or omap_sets or omap_rms or omap_cleared)):
+            # metadata mutation on a nonexistent object creates it
+            # (reference: every write-class op, setxattr/omap included,
+            # creates the object) — commit an empty data write so the
+            # object has a PG-log identity, not just orphan metadata
+            exists, data_dirty = True, True
+        if removed:
+            dr = await self._do_delete(MOSDOp(
+                op="delete", pool_id=op.pool_id, oid=op.oid,
+                reqid=uuid.uuid4().hex, snapc_seq=op.snapc_seq,
+                snapc_snaps=list(op.snapc_snaps)))
+            if not dr.ok and dr.code != -errno.ENOENT:
+                return MOSDOpReply(ok=False, code=dr.code, error=dr.error,
+                                   backoff=dr.backoff)
+        elif data_dirty:
+            wr = await self._do_write(MOSDOp(
+                op="write", pool_id=op.pool_id, oid=op.oid,
+                data=bytes(data), reqid=uuid.uuid4().hex,
+                snapc_seq=op.snapc_seq, snapc_snaps=list(op.snapc_snaps)))
+            if not wr.ok:
+                # data commit failed: xattr/omap staging is NOT applied —
+                # the all-or-nothing contract holds even at commit time
+                return MOSDOpReply(ok=False, code=wr.code, error=wr.error,
+                                   backoff=wr.backoff)
+        if xattr_sets or xattr_rms:
+            for k, v in xattr_sets.items():
+                self.store.setattr(key0, k, v)
+            for k in xattr_rms:
+                try:
+                    self.store.rmattr(key0, k)
+                except NotImplementedError:
+                    pass
+        if omap_cleared or omap_sets or omap_rms:
+            try:
+                if omap_cleared:
+                    self.store.omap_rm(key0, list(self.store.omap_get(key0)))
+                if omap_sets:
+                    self.store.omap_set(key0, omap_sets)
+                if omap_rms:
+                    self.store.omap_rm(key0, sorted(omap_rms))
+            except NotImplementedError:
+                pass
+        # replicate metadata mutations to the acting peers so a failover
+        # primary serves the same xattrs/omap (cls durability discipline)
+        if xattr_sets or xattr_rms or omap_cleared or omap_sets or omap_rms:
+            for shard, osd in enumerate(acting):
+                if osd in (CRUSH_ITEM_NONE, self.osd_id):
+                    continue
+                try:
+                    if xattr_sets or xattr_rms:
                         await self.messenger.send(
                             self.osdmap.addr_of(osd),
                             MSetXattrs(pool_id=op.pool_id, oid=op.oid,
-                                       shard=0, xattrs=dict(hctx.xattrs)))
-                    except TRANSPORT_ERRORS:
-                        pass
-        reply = MOSDOpReply(ok=True, data=pickle.dumps((ret, out)))
-        if op.reqid:
-            self._call_results[op.reqid] = reply
-            while len(self._call_results) > 512:
-                self._call_results.pop(next(iter(self._call_results)))
-        return reply
+                                       shard=0, xattrs=dict(xattr_sets),
+                                       removals=sorted(xattr_rms)))
+                    if omap_cleared or omap_sets or omap_rms:
+                        await self.messenger.send(
+                            self.osdmap.addr_of(osd),
+                            MSetOmap(pool_id=op.pool_id, oid=op.oid,
+                                     shard=0, clear=omap_cleared,
+                                     entries=dict(omap_sets),
+                                     removals=sorted(omap_rms)))
+                except TRANSPORT_ERRORS:
+                    pass
+        return MOSDOpReply(ok=True, data=pickle.dumps(results),
+                           version=version)
 
     # -- watch/notify (reference src/osd/Watch.{h,cc}) -----------------------
 

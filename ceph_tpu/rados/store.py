@@ -65,10 +65,19 @@ class ObjectStore:
     def omap_get(self, key: Key) -> Dict[str, bytes]:
         return {}
 
+    def omap_set(self, key: Key, entries: Dict[str, bytes]) -> None:
+        raise NotImplementedError
+
+    def omap_rm(self, key: Key, keys: List[str]) -> None:
+        raise NotImplementedError
+
     def getattr(self, key: Key, name: str) -> Optional[bytes]:
         return None
 
     def setattr(self, key: Key, name: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def rmattr(self, key: Key, name: str) -> None:
         raise NotImplementedError
 
     def getattrs(self, key: Key) -> Dict[str, bytes]:
@@ -100,11 +109,23 @@ class MemStore(ObjectStore):
     def omap_get(self, key: Key) -> Dict[str, bytes]:
         return dict(self._omap.get(key, {}))
 
+    def omap_set(self, key: Key, entries: Dict[str, bytes]) -> None:
+        self._omap.setdefault(key, {}).update(entries)
+
+    def omap_rm(self, key: Key, keys: List[str]) -> None:
+        table = self._omap.get(key)
+        if table:
+            for k in keys:
+                table.pop(k, None)
+
     def getattr(self, key: Key, name: str) -> Optional[bytes]:
         return self._xattrs.get(key, {}).get(name)
 
     def setattr(self, key: Key, name: str, value: bytes) -> None:
         self._xattrs.setdefault(key, {})[name] = value
+
+    def rmattr(self, key: Key, name: str) -> None:
+        self._xattrs.get(key, {}).pop(name, None)
 
     def getattrs(self, key: Key) -> Dict[str, bytes]:
         return dict(self._xattrs.get(key, {}))

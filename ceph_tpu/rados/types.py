@@ -558,9 +558,9 @@ class MConfigReply:
 # Client <-> primary OSD
 
 
-@message(20, version=3)
+@message(20, version=4)
 class MOSDOp:
-    op: str = "read"  # write | read | delete | list | repair | deep-scrub | call
+    op: str = "read"  # write | read | delete | list | repair | deep-scrub | call | multi
     pool_id: int = 0
     oid: str = ""
     data: bytes = b""
@@ -589,6 +589,14 @@ class MOSDOp:
     pg: int = -1
     cursor: str = ""  # resume after this oid ("" = start)
     max_entries: int = 0  # 0 = server default
+    # op == "multi": compound atomic operation — an ORDERED vector of
+    # (name, kwargs) sub-ops executed on one object under the object's
+    # critical section, all-or-nothing (reference MOSDOp's vector<OSDOp>
+    # driving ObjectWriteOperation/neorados WriteOp semantics,
+    # PrimaryLogPG::do_osd_ops).  Reads inside the vector observe the
+    # effects of earlier sub-ops; any failing sub-op aborts the whole op
+    # with nothing applied.
+    ops: List[Tuple[str, Dict]] = field(default_factory=list)
 
 
 @message(21, version=2)
@@ -869,7 +877,7 @@ class MScrubShard:
     reply_to: Tuple[str, int] = ("", 0)
 
 
-@message(46)
+@message(46, version=2)
 class MSetXattrs:
     """Primary -> acting peers: replicate object-class xattr state so a
     failover primary still sees locks/refcounts (cls durability)."""
@@ -878,9 +886,25 @@ class MSetXattrs:
     oid: str = ""
     shard: int = 0
     xattrs: Dict[str, bytes] = field(default_factory=dict)
+    removals: List[str] = field(default_factory=list)
 
 
 # watch/notify (reference src/osd/Watch.{h,cc}, librados watch2/notify2)
+
+
+@message(49)
+class MSetOmap:
+    """Primary -> acting peers: replicate object omap mutations applied by
+    a compound (multi) op, so a failover primary serves the same omap
+    (the replicated-pool omap durability the reference gets from each
+    replica applying the full ObjectStore::Transaction)."""
+
+    pool_id: int = 0
+    oid: str = ""
+    shard: int = 0
+    clear: bool = False  # applied before entries/removals
+    entries: Dict[str, bytes] = field(default_factory=dict)
+    removals: List[str] = field(default_factory=list)
 
 
 @message(47)

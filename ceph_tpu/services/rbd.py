@@ -1065,7 +1065,7 @@ class ImageMigrator:
         await src._save_header()
 
     @staticmethod
-    async def _sync_block_set(dst: Image, keep, size: int) -> None:
+    async def _sync_block_set(dst: Image, keep) -> None:
         """DEALLOCATE destination blocks absent from the source's map for
         this pass: a snapshot (or head) whose map shrank between passes
         must not expose the previous pass's bytes where the source reads
@@ -1119,8 +1119,7 @@ class ImageMigrator:
                 continue
             if dst.size != info["size"]:
                 await dst.resize(info["size"])
-            await self._sync_block_set(dst, info.get("object_map", ()),
-                                       info["size"])
+            await self._sync_block_set(dst, info.get("object_map", ()))
             await self._copy_blocks(
                 lambda off, n, s=snap_name: src.read_snap(s, off, n),
                 dst, info["size"], info.get("object_map", ()))
@@ -1129,7 +1128,7 @@ class ImageMigrator:
                 await dst.snap_protect(snap_name)
         if dst.size != src.size:
             await dst.resize(src.size)
-        await self._sync_block_set(dst, src._hdr["object_map"], src.size)
+        await self._sync_block_set(dst, src._hdr["object_map"])
         await self._copy_blocks(src.read, dst, src.size,
                                 src._hdr["object_map"])
         dst._hdr["migration"] = {"role": "destination", "state": "executed"}
@@ -1172,7 +1171,7 @@ class ImageMigrator:
         # since execute are deallocated — so commit is a full sync point,
         # not a silent cutoff (the reference's commit-time final sync
         # role); sizes were validated equal above
-        await self._sync_block_set(dst, src._hdr["object_map"], src.size)
+        await self._sync_block_set(dst, src._hdr["object_map"])
         await self._copy_blocks(src.read, dst, src.size,
                                 src._hdr["object_map"])
         # teardown order matters for crash recovery: the source dies
