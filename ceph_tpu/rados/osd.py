@@ -2430,6 +2430,10 @@ class OSD:
         # race around pool creation can key two calls differently, so
         # the primary holds its own per-object critical section.
         async with self._object_critical_section(op.pool_id, op.oid):
+            # resend racing the original: it queued on the lock; replay
+            # the original's reply instead of re-executing
+            if op.reqid and op.reqid in self._call_results:
+                return self._call_results[op.reqid]
             reply = await self._do_call_locked(op, pool, pg, acting, fn,
                                                key)
         if reply.ok:
@@ -2555,6 +2559,12 @@ class OSD:
         # a cls call (or two multis) on one object serialize, so the
         # read-stage-commit below is atomic per object
         async with self._object_critical_section(op.pool_id, op.oid):
+            # re-check the replay cache INSIDE the section: a resend
+            # racing the original execution queues on the lock, then
+            # finds the original's reply here instead of re-applying a
+            # non-idempotent vector
+            if op.reqid and op.reqid in self._call_results:
+                return self._call_results[op.reqid]
             reply = await self._do_multi_locked(op, pool, pg, acting)
         if reply.ok:
             # only successes replay; a failed multi applied nothing, so a
@@ -2787,13 +2797,30 @@ class OSD:
                 return fail(i, name, -errno.EINVAL, "unknown sub-op")
             results.append((rval, out))
         # -- commit (all sub-ops passed) -----------------------------------
-        if (not exists and not removed
-                and (xattr_sets or omap_sets or omap_rms or omap_cleared)):
+        meta_dirty = bool(xattr_sets or xattr_rms or omap_sets or omap_rms
+                          or omap_cleared)
+        if not exists and not removed and meta_dirty:
             # metadata mutation on a nonexistent object creates it
             # (reference: every write-class op, setxattr/omap included,
             # creates the object) — commit an empty data write so the
             # object has a PG-log identity, not just orphan metadata
-            exists, data_dirty = True, True
+            exists, data_dirty, data_loaded = True, True, True
+        elif exists and not removed and meta_dirty and not data_dirty:
+            # metadata mutation on an EXISTING object must still bump the
+            # object version (reference: every op logs), or two
+            # assert_version CAS writers racing on xattrs/omap would both
+            # pass the same guard and silently lose one update
+            if not data_loaded:
+                read = await self._do_read(
+                    MOSDOp(op="read", pool_id=op.pool_id, oid=op.oid))
+                if read.ok:
+                    data = bytearray(read.data)
+                    data_loaded = True
+                elif read.code != -errno.ENOENT:
+                    return MOSDOpReply(ok=False, code=read.code,
+                                       error=read.error,
+                                       backoff=read.backoff)
+            data_dirty = True
         if removed:
             dr = await self._do_delete(MOSDOp(
                 op="delete", pool_id=op.pool_id, oid=op.oid,
@@ -2931,9 +2958,7 @@ class OSD:
             if reply.ok:
                 # only successes are replayable results; a failed notify
                 # resend should re-execute
-                self._call_results[op.reqid] = reply
-                while len(self._call_results) > 512:
-                    self._call_results.pop(next(iter(self._call_results)))
+                self._cache_call_reply(op.reqid, reply)
             fut = self._notify_inflight.pop(op.reqid, None)
             if fut is not None and not fut.done():
                 fut.set_result(reply)

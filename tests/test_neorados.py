@@ -326,6 +326,36 @@ class TestReviewFindings:
                 await cluster.stop()
         run(go())
 
+    def test_metadata_mutation_bumps_version(self):
+        """Two assert_version CAS writers racing on XATTRS: the loser
+        must fail -ERANGE (metadata commits bump the version)."""
+        async def go():
+            cluster, client, neo, ioc = await _cluster()
+            try:
+                await neo.execute("obj", ioc, WriteOp().write_full(b"d"))
+                _r, v1 = await neo.execute_versioned(
+                    "obj", ioc, ReadOp().getxattrs())
+                await neo.execute("obj", ioc,
+                                  WriteOp().assert_version(v1)
+                                  .setxattr("winner", b"A"))
+                _r, v2 = await neo.execute_versioned(
+                    "obj", ioc, ReadOp().getxattrs())
+                assert v2 > v1
+                with pytest.raises(RadosError) as ei:
+                    await neo.execute("obj", ioc,
+                                      WriteOp().assert_version(v1)
+                                      .setxattr("winner", b"B"))
+                assert ei.value.code == -errno.ERANGE
+                res = await neo.execute("obj", ioc,
+                                        ReadOp().getxattr("winner")
+                                        .read())
+                assert res[0][1] == b"A"
+                assert res[1][1] == b"d"  # data preserved by the bump
+            finally:
+                await client.stop()
+                await cluster.stop()
+        run(go())
+
     def test_metadata_only_create(self):
         """setxattr/omap_set on a nonexistent object creates it
         (reference: every write-class op creates the object)."""
