@@ -17,10 +17,9 @@ every unexpired event — completing half-applied multi-object updates —
 exactly the reference's up:replay stage.  Fully applied positions are
 expired (LogSegment trim) and their segments removed.
 
-Divergence by design: single-active MDS, no subtree migration —
-namespace-over-objects layout, path-walk semantics, and the journal
-replay/expiry cycle are the core being reproduced; locking rides the
-cls lock class when callers need it.
+Multi-active MDS lives in :mod:`ceph_tpu.services.mds_cluster`
+(subtree partitioning across ranks, journaled export/import, balancer,
+rank failover); this module is the single-rank core it composes.
 """
 
 from __future__ import annotations
@@ -55,19 +54,23 @@ class MDLog:
 
     HEAD_OID = "mds_journal_head"
 
-    def __init__(self, ioctx: IoCtx):
+    def __init__(self, ioctx: IoCtx, prefix: str = ""):
+        # `prefix` names WHOSE journal this is: multi-active MDS gives
+        # each rank its own journal objects (the reference's per-rank
+        # 20X.xxxx journal inodes), so rank recovery replays only its
+        # own log
         self.ioctx = ioctx
+        self.prefix = prefix
         self.seg = 0          # segment being appended
         self.off = 0          # byte offset within it
         self.expire_seg = 0   # first segment that may hold unapplied events
         self.count = 0        # events in the current segment
 
-    @staticmethod
-    def _seg_oid(seg: int) -> str:
-        return f"mds_journal.{seg:08d}"
+    def _seg_oid(self, seg: int) -> str:
+        return f"{self.prefix}mds_journal.{seg:08d}"
 
     async def _save_head(self) -> None:
-        await self.ioctx.write_full(self.HEAD_OID, json.dumps(
+        await self.ioctx.write_full(self.prefix + self.HEAD_OID, json.dumps(
             {"expire_seg": self.expire_seg, "write_seg": self.seg}).encode())
 
     async def load(self) -> List[Dict]:
@@ -75,7 +78,8 @@ class MDLog:
         append cursor at the end.  Returns every event that may not have
         been fully applied (mount() replays them)."""
         try:
-            head = json.loads(await self.ioctx.read(self.HEAD_OID))
+            head = json.loads(await self.ioctx.read(self.prefix
+                                                    + self.HEAD_OID))
         except RadosError as e:
             # a fresh journal is only the right answer for VERIFIED
             # absence; resetting the cursor on a transient read failure
@@ -141,12 +145,19 @@ class MDLog:
 
 class FileSystem:
     def __init__(self, meta_ioctx: IoCtx, data_ioctx: Optional[IoCtx] = None,
-                 object_size: int = 1 << 22, journal: bool = True):
+                 object_size: int = 1 << 22, journal: bool = True,
+                 journal_prefix: str = ""):
         self.meta = meta_ioctx
         self.data = data_ioctx or meta_ioctx
         self.striper = RadosStriper(self.data, object_size=object_size)
-        self.mdlog: Optional[MDLog] = MDLog(meta_ioctx) if journal else None
+        self.mdlog: Optional[MDLog] = (
+            MDLog(meta_ioctx, journal_prefix) if journal else None)
         self._applied_since_expire = 0
+        # serializes this rank's metadata mutations: dirfrag updates are
+        # read-modify-write of one dentries object, so two interleaved
+        # ops on the same directory would lose the first update (the
+        # reference serializes through per-CDir locks under the mds_lock)
+        self._mutate = asyncio.Lock()
 
     async def mount(self) -> int:
         """Recover the namespace: replay unexpired journal events (the
@@ -191,6 +202,14 @@ class FileSystem:
                 return  # parent itself gone (later event removed it)
             dentries[ev["name"]] = ev["dentry"]
             await self._save_dir(ev["parent"], dentries)
+            old_ino = ev.get("drop_old_ino")
+            if old_ino and old_ino != ev["dentry"].get("ino"):
+                # whole-file replace: the superseded inode's data goes
+                # with the same event (idempotent: already-gone is fine)
+                try:
+                    await self.striper.remove(self._file_oid(old_ino))
+                except RadosError:
+                    pass
         elif op == "rm_dentry":
             dentries = await self._load_dir(ev["parent"])
             if dentries is not None and ev["name"] in dentries:
@@ -260,15 +279,16 @@ class FileSystem:
         path = self._norm(path)
         if path == "/":
             raise FsError("EEXIST: /")
-        parent, name, dentries = await self._parent_of(path)
-        if name in dentries:
-            raise FsError(f"EEXIST: {path}")
-        event = {"op": "set_dentry", "parent": parent, "name": name,
-                 "mkdir": path,
-                 "dentry": {"type": "dir", "mtime": time.time()}}
-        await self._journal(event)
-        await self._apply_event(event)
-        await self._journal_applied()
+        async with self._mutate:
+            parent, name, dentries = await self._parent_of(path)
+            if name in dentries:
+                raise FsError(f"EEXIST: {path}")
+            event = {"op": "set_dentry", "parent": parent, "name": name,
+                     "mkdir": path,
+                     "dentry": {"type": "dir", "mtime": time.time()}}
+            await self._journal(event)
+            await self._apply_event(event)
+            await self._journal_applied()
 
     async def listdir(self, path: str) -> List[str]:
         path = self._norm(path)
@@ -288,20 +308,29 @@ class FileSystem:
 
     async def write_file(self, path: str, data: bytes) -> None:
         path = self._norm(path)
-        parent, name, dentries = await self._parent_of(path)
-        existing = dentries.get(name)
-        if existing and existing["type"] == "dir":
-            raise FsError(f"EISDIR: {path}")
-        ino = (existing or {}).get("ino") or uuid.uuid4().hex
-        # data first (an inode without a dentry is harmless garbage; a
-        # dentry without data would not be), then journal, then dirfrag
+        # data rides a FRESH inode, written OUTSIDE the rank mutation
+        # lock: bulk data transfers from unrelated files proceed
+        # concurrently, and the dentry flip below makes each write an
+        # atomic whole-file replace (an inode without a dentry is
+        # harmless garbage; a dentry without data would not be)
+        ino = uuid.uuid4().hex
         await self.striper.write(self._file_oid(ino), data)
-        event = {"op": "set_dentry", "parent": parent, "name": name,
-                 "dentry": {"type": "file", "size": len(data),
-                            "mtime": time.time(), "ino": ino}}
-        await self._journal(event)
-        await self._apply_event(event)
-        await self._journal_applied()
+        async with self._mutate:
+            parent, name, dentries = await self._parent_of(path)
+            existing = dentries.get(name)
+            if existing and existing["type"] == "dir":
+                raise FsError(f"EISDIR: {path}")
+            event = {"op": "set_dentry", "parent": parent, "name": name,
+                     "dentry": {"type": "file", "size": len(data),
+                                "mtime": time.time(), "ino": ino}}
+            if existing and existing.get("ino"):
+                # the replaced inode's data is dropped in the same
+                # journaled event (concurrent readers are excluded by the
+                # caps layer: writes need the exclusive cap)
+                event["drop_old_ino"] = existing["ino"]
+            await self._journal(event)
+            await self._apply_event(event)
+            await self._journal_applied()
 
     async def read_file(self, path: str) -> bytes:
         path = self._norm(path)
@@ -315,52 +344,56 @@ class FileSystem:
 
     async def unlink(self, path: str) -> None:
         path = self._norm(path)
-        parent, name, dentries = await self._parent_of(path)
-        ent = dentries.get(name)
-        if ent is None:
-            raise FsError(f"ENOENT: {path}")
-        event = {"op": "rm_dentry", "parent": parent, "name": name}
-        if ent["type"] == "dir":
-            children = await self._load_dir(path)
-            if children:
-                raise FsError(f"ENOTEMPTY: {path}")
-            event["rmdir"] = path
-        else:
-            event["drop_ino"] = ent["ino"]
-        await self._journal(event)
-        await self._apply_event(event)
-        await self._journal_applied()
+        async with self._mutate:
+            parent, name, dentries = await self._parent_of(path)
+            ent = dentries.get(name)
+            if ent is None:
+                raise FsError(f"ENOENT: {path}")
+            event = {"op": "rm_dentry", "parent": parent, "name": name}
+            if ent["type"] == "dir":
+                children = await self._load_dir(path)
+                if children:
+                    raise FsError(f"ENOTEMPTY: {path}")
+                event["rmdir"] = path
+            else:
+                event["drop_ino"] = ent["ino"]
+            await self._journal(event)
+            await self._apply_event(event)
+            await self._journal_applied()
 
     async def rename(self, src: str, dst: str) -> None:
         """Dentry-only move: the inode id stays, so no data transfer and
         no window where the data exists twice."""
         src, dst = self._norm(src), self._norm(dst)
-        sparent, sname, sdentries = await self._parent_of(src)
-        ent = sdentries.get(sname)
-        if ent is None:
-            raise FsError(f"ENOENT: {src}")
-        if ent["type"] == "dir":
-            raise FsError("EINVAL: dir rename unsupported in mds-lite")
-        dparent, dname, ddentries = await self._parent_of(dst)
-        if ddentries.get(dname, {}).get("type") == "dir":
-            raise FsError(f"EISDIR: {dst}")
-        if src == dst:
-            return
-        old_dst = (sdentries if dparent == sparent else ddentries).get(dname)
-        # one journal event covering the whole multi-object update: set
-        # the destination dentry FIRST, then drop the source (replay
-        # after a crash between the two completes the move; worst case
-        # both dentries briefly exist, never neither — the reference's
-        # EUpdate orders its metablob the same way)
-        subs = [{"op": "set_dentry", "parent": dparent, "name": dname,
-                 "dentry": ent},
-                {"op": "rm_dentry", "parent": sparent, "name": sname}]
-        if old_dst and old_dst.get("ino") and old_dst["ino"] != ent.get("ino"):
-            subs.append({"op": "drop_ino", "ino": old_dst["ino"]})
-        event = {"op": "rename", "events": subs}
-        await self._journal(event)
-        await self._apply_event(event)
-        await self._journal_applied()
+        async with self._mutate:
+            sparent, sname, sdentries = await self._parent_of(src)
+            ent = sdentries.get(sname)
+            if ent is None:
+                raise FsError(f"ENOENT: {src}")
+            if ent["type"] == "dir":
+                raise FsError("EINVAL: dir rename unsupported in mds-lite")
+            dparent, dname, ddentries = await self._parent_of(dst)
+            if ddentries.get(dname, {}).get("type") == "dir":
+                raise FsError(f"EISDIR: {dst}")
+            if src == dst:
+                return
+            old_dst = (sdentries if dparent == sparent
+                       else ddentries).get(dname)
+            # one journal event covering the whole multi-object update:
+            # set the destination dentry FIRST, then drop the source
+            # (replay after a crash between the two completes the move;
+            # worst case both dentries briefly exist, never neither — the
+            # reference's EUpdate orders its metablob the same way)
+            subs = [{"op": "set_dentry", "parent": dparent, "name": dname,
+                     "dentry": ent},
+                    {"op": "rm_dentry", "parent": sparent, "name": sname}]
+            if (old_dst and old_dst.get("ino")
+                    and old_dst["ino"] != ent.get("ino")):
+                subs.append({"op": "drop_ino", "ino": old_dst["ino"]})
+            event = {"op": "rename", "events": subs}
+            await self._journal(event)
+            await self._apply_event(event)
+            await self._journal_applied()
 
     async def walk(self, path: str = "/") -> Dict:
         """Recursive tree dump (debugging/`ceph fs dump` role)."""
@@ -419,9 +452,10 @@ class MDSServer:
     revoke list and the requester is refused with CapConflict until the
     holder releases or its lease lapses (session autoclose role).
 
-    Divergence by design: single active MDS, path-granular caps (the
-    reference's are per-inode with Fw/Fr/Fx bit splits), no subtree
-    migration."""
+    Divergence by design: path-granular caps (the reference's are
+    per-inode with Fw/Fr/Fx bit splits).  One MDSServer serves one
+    RANK; multi-active deployments compose several through
+    mds_cluster.MDSCluster, which owns subtree authority + migration."""
 
     def __init__(self, fs: FileSystem, session_timeout: float = 60.0):
         self.fs = fs

@@ -1,0 +1,409 @@
+"""Multi-active MDS: subtree partitioning, migration, and balancing.
+
+Role-equivalent of the reference's multi-MDS metadata cluster (reference
+src/mds/Migrator.cc subtree export/import, src/mds/MDBalancer.cc load
+balancing, src/mds/MDSMap.h rank table): the namespace is partitioned by
+DIRECTORY SUBTREE across N active ranks, each rank serializes and
+journals mutations for the subtrees it is authoritative over, and
+authority over a subtree can MIGRATE between ranks online.
+
+TPU-first simplifications that keep the semantics honest:
+
+- dirfrags live in shared RADOS objects, so migration moves AUTHORITY
+  (who may mutate + grant caps), never data — the same property the
+  reference gets from metadata-in-RADOS;
+- the export protocol is two-phase against a persisted subtree map:
+  freeze -> revoke caps under the subtree -> drain+flush the exporter's
+  journal -> persist a pending record -> commit the map.  A crash
+  between pending and commit is completed at next start() (the
+  reference's EExport/EImportStart journal pair in miniature);
+- cap/lease state is volatile per rank (the reference journals it in
+  ESessions; here clients re-acquire after a rank replacement, the
+  up:reconnect stage).
+
+Single-rank deployments are unchanged: MDSCluster(n_ranks=1) behaves
+exactly like a lone MDSServer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import posixpath
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.rados.client import RadosError
+from ceph_tpu.rados.librados import IoCtx
+from ceph_tpu.services.mds import (CephFSClient, FileSystem, FsError,
+                                   MDSServer)
+
+SUBTREE_MAP_OID = "mds_subtree_map"
+
+
+def _norm(path: str) -> str:
+    return FileSystem._norm(path)
+
+
+def _is_under(path: str, root: str) -> bool:
+    """True if `path` is `root` or inside it (component-wise)."""
+    return path == root or (root == "/" and path.startswith("/")) \
+        or path.startswith(root + "/")
+
+
+class MDSCluster:
+    """N active MDS ranks over one metadata/data pool pair.
+
+    The subtree map (persisted at SUBTREE_MAP_OID) assigns each subtree
+    root to a rank; a path's authority is the DEEPEST matching root (the
+    reference resolves auth the same way through its subtree bounds).
+    """
+
+    def __init__(self, meta_ioctx: IoCtx, data_ioctx: Optional[IoCtx] = None,
+                 n_ranks: int = 2, session_timeout: float = 60.0,
+                 revoke_timeout: float = 5.0):
+        self.meta = meta_ioctx
+        self.data = data_ioctx or meta_ioctx
+        self.n_ranks = int(n_ranks)
+        self.session_timeout = session_timeout
+        self.revoke_timeout = revoke_timeout
+        self.epoch = 0
+        self.subtrees: Dict[str, int] = {"/": 0}
+        self.ranks: List[MDSServer] = []
+        self._frozen: set = set()      # subtree roots mid-export
+        self.rank_ops: List[int] = []  # balancer heat, per rank
+        self._dir_ops: Dict[str, int] = {}  # top-level dir -> ops
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> "MDSCluster":
+        """Load (or create) the subtree map, start every rank (each
+        replays its OWN journal — up:replay), and resolve any export
+        that was cut down mid-flight."""
+        try:
+            m = json.loads(await self.meta.read(SUBTREE_MAP_OID))
+            self.epoch = m["epoch"]
+            self.subtrees = {p: int(r) for p, r in m["subtrees"].items()}
+            pending = m.get("pending")
+        except RadosError:
+            pending = None
+            await self._save_map(pending=None)
+        self.ranks = []
+        for r in range(self.n_ranks):
+            fs = FileSystem(self.meta, self.data,
+                            journal_prefix=f"mds{r}.")
+            if r == 0:
+                await fs.mkfs()
+            await fs.mount()
+            self.ranks.append(MDSServer(fs, self.session_timeout))
+        self.rank_ops = [0] * self.n_ranks
+        if pending is not None:
+            # the exporter flushed its journal BEFORE the pending record
+            # was persisted, so completing the map flip is always safe
+            # (EImportFinish replay role)
+            self.subtrees[pending["path"]] = int(pending["to"])
+            await self._save_map(pending=None)
+        return self
+
+    async def _save_map(self, pending) -> None:
+        self.epoch += 1
+        await self.meta.write_full(SUBTREE_MAP_OID, json.dumps(
+            {"epoch": self.epoch, "subtrees": self.subtrees,
+             "pending": pending}).encode())
+
+    # -- authority resolution ------------------------------------------------
+
+    def rank_of(self, path: str) -> int:
+        path = _norm(path)
+        best, best_len = 0, -1
+        for root, rank in self.subtrees.items():
+            if _is_under(path, root):
+                depth = 0 if root == "/" else root.count("/")
+                if depth > best_len:
+                    best, best_len = rank, depth
+        return best
+
+    def server(self, rank: int) -> MDSServer:
+        return self.ranks[rank]
+
+    def _check_frozen(self, path: str) -> None:
+        path = _norm(path)
+        for root in self._frozen:
+            if _is_under(path, root):
+                raise FsError(f"EAGAIN: subtree {root} migrating")
+
+    def route(self, path: str) -> MDSServer:
+        """Authoritative server for `path`; raises retryable EAGAIN
+        while the covering subtree is mid-export (the reference freezes
+        the exported CDir the same way)."""
+        self._check_frozen(path)
+        rank = self.rank_of(path)
+        self.rank_ops[rank] += 1
+        p = _norm(path)
+        top = "/" + p.split("/")[1] if p != "/" else "/"
+        self._dir_ops[top] = self._dir_ops.get(top, 0) + 1
+        return self.ranks[rank]
+
+    # -- subtree migration (Migrator role) -----------------------------------
+
+    async def export_dir(self, path: str, to_rank: int) -> None:
+        """Move authority over the subtree at `path` to `to_rank`:
+        freeze -> revoke caps -> drain + flush exporter journal ->
+        persist pending -> commit map -> thaw."""
+        path = _norm(path)
+        if not (0 <= to_rank < self.n_ranks):
+            raise FsError(f"EINVAL: no rank {to_rank}")
+        from_rank = self.rank_of(path)
+        if from_rank == to_rank:
+            return
+        src = self.ranks[from_rank]
+        st = await src.fs.stat(path)
+        if st["type"] != "dir":
+            raise FsError(f"ENOTDIR: {path}")
+        if path in self._frozen:
+            raise FsError(f"EAGAIN: {path} already migrating")
+        self._frozen.add(path)
+        try:
+            await self._revoke_subtree_caps(src, path)
+            # drain in-flight mutations, then flush: after this the
+            # journal holds nothing unapplied for the subtree
+            async with src.fs._mutate:
+                if src.fs.mdlog is not None:
+                    await src.fs.mdlog.expire()
+            # two-phase commit against the persisted map
+            await self._save_map(pending={"path": path, "to": to_rank})
+            self.subtrees[path] = to_rank
+            await self._save_map(pending=None)
+        finally:
+            self._frozen.discard(path)
+
+    async def _revoke_subtree_caps(self, src: MDSServer, root: str) -> None:
+        """Queue revokes for every cap under the subtree and wait for
+        the holders to comply (flush + release on their next renew).
+        Holders that never comply within revoke_timeout are evicted —
+        the session-autoclose semantics the reference applies to
+        unresponsive clients."""
+        deadline = time.monotonic() + self.revoke_timeout
+        while True:
+            live = []
+            for path, holders in list(src._caps.items()):
+                if not _is_under(path, root):
+                    continue
+                for sid in list(holders):
+                    if src._evict_if_dead(sid):
+                        continue
+                    sess = src.sessions[sid]
+                    if path not in sess.revoked:
+                        sess.revoked.append(path)
+                    live.append((path, sid))
+            if not live:
+                return
+            if time.monotonic() >= deadline:
+                # forcible eviction: identical outcome to lease expiry
+                for path, sid in live:
+                    src._drop(path, sid)
+                return
+            await asyncio.sleep(0.02)
+
+    # -- rank failure / replacement ------------------------------------------
+
+    async def replace_rank(self, rank: int) -> MDSServer:
+        """Stand up a replacement for a failed rank: a fresh server
+        mounts the SAME per-rank journal and replays it (up:replay),
+        then serves.  Sessions/caps are gone — clients reconnect
+        (up:reconnect is client-driven here)."""
+        fs = FileSystem(self.meta, self.data, journal_prefix=f"mds{rank}.")
+        await fs.mount()
+        self.ranks[rank] = MDSServer(fs, self.session_timeout)
+        return self.ranks[rank]
+
+    # -- balancing (MDBalancer role) -----------------------------------------
+
+    async def maybe_rebalance(self, ratio: float = 2.0) -> Optional[Tuple]:
+        """If the hottest rank carries > `ratio` x the coldest rank's
+        ops, export the hottest top-level subtree it owns to the coldest
+        rank.  Returns (path, from, to) when a migration ran."""
+        if self.n_ranks < 2 or not any(self.rank_ops):
+            return None
+        hot = max(range(self.n_ranks), key=lambda r: self.rank_ops[r])
+        cold = min(range(self.n_ranks), key=lambda r: self.rank_ops[r])
+        if self.rank_ops[hot] < ratio * max(1, self.rank_ops[cold]):
+            return None
+        candidates = [
+            (ops, d) for d, ops in self._dir_ops.items()
+            if d != "/" and self.rank_of(d) == hot
+        ]
+        if not candidates:
+            return None
+        _ops, path = max(candidates)
+        try:
+            if (await self.ranks[hot].fs.stat(path))["type"] != "dir":
+                return None
+        except FsError:
+            return None
+        await self.export_dir(path, cold)
+        self.rank_ops = [0] * self.n_ranks
+        self._dir_ops.clear()
+        return (path, hot, cold)
+
+    # -- cross-rank rename ---------------------------------------------------
+
+    async def rename(self, src_path: str, dst_path: str) -> None:
+        """Rename whose source and destination live under different
+        authorities (the reference's slave-request rename): both ranks'
+        mutation locks are held (rank order, so two concurrent cross
+        renames cannot deadlock), the intent is journaled at the SOURCE
+        rank as one event, and both dirfrag halves are applied under the
+        locks.  Same-rank renames route normally."""
+        src_path, dst_path = _norm(src_path), _norm(dst_path)
+        self._check_frozen(src_path)
+        self._check_frozen(dst_path)
+        r_src, r_dst = self.rank_of(src_path), self.rank_of(dst_path)
+        if r_src == r_dst:
+            await self.ranks[r_src].fs.rename(src_path, dst_path)
+            return
+        fs_src, fs_dst = self.ranks[r_src].fs, self.ranks[r_dst].fs
+        first, second = sorted((fs_src, fs_dst), key=id)
+        async with first._mutate:
+            async with second._mutate:
+                sparent = posixpath.dirname(src_path)
+                sname = posixpath.basename(src_path)
+                sdentries = await fs_src._load_dir(sparent)
+                if sdentries is None or sname not in sdentries:
+                    raise FsError(f"ENOENT: {src_path}")
+                ent = sdentries[sname]
+                if ent["type"] == "dir":
+                    raise FsError("EINVAL: dir rename unsupported")
+                dparent = posixpath.dirname(dst_path)
+                dname = posixpath.basename(dst_path)
+                ddentries = await fs_dst._load_dir(dparent)
+                if ddentries is None:
+                    raise FsError(f"ENOENT: parent {dparent}")
+                if ddentries.get(dname, {}).get("type") == "dir":
+                    raise FsError(f"EISDIR: {dst_path}")
+                subs = [{"op": "set_dentry", "parent": dparent,
+                         "name": dname, "dentry": ent},
+                        {"op": "rm_dentry", "parent": sparent,
+                         "name": sname}]
+                old = ddentries.get(dname)
+                if old and old.get("ino") and old["ino"] != ent.get("ino"):
+                    subs.append({"op": "drop_ino", "ino": old["ino"]})
+                event = {"op": "rename", "events": subs}
+                # intent journaled at the source rank: its replay applies
+                # BOTH halves (recovery is single-threaded, so touching
+                # the peer's dirfrag there cannot race live mutations —
+                # ranks sharing a journal replay window are restarted
+                # together by start())
+                await fs_src._journal(event)
+                await fs_src._apply_event(event)
+                await fs_src._journal_applied()
+
+
+class CephFSMultiClient:
+    """Client facade over an MDSCluster: one cap-aware CephFSClient per
+    rank, each op routed to the path's authoritative rank.  Frozen
+    subtrees (mid-export) are retried; the retry loop renews EVERY
+    per-rank session so pending revokes get complied with — which is
+    exactly what lets the exporter finish."""
+
+    def __init__(self, cluster: MDSCluster, client: str = "client",
+                 renew_interval: float = 1.0):
+        self.cluster = cluster
+        self.name = client
+        self.renew_interval = renew_interval
+        self._clients: Dict[int, CephFSClient] = {}
+
+    def _client_for(self, rank: int) -> CephFSClient:
+        c = self._clients.get(rank)
+        if c is None or c.session.session_id not in \
+                self.cluster.ranks[rank].sessions:
+            # first contact, or the rank was replaced (sessions are
+            # volatile): open a fresh session — up:reconnect role
+            c = CephFSClient(self.cluster.ranks[rank], self.name,
+                             self.renew_interval)
+            self._clients[rank] = c
+        return c
+
+    async def _handoff(self, path: str, rank: int) -> None:
+        """Cache handoff after a migration: write-behind bytes staged at
+        a rank that is no longer the path's authority are re-staged at
+        the new one (the reference client re-targets its caps to the
+        importing MDS after an export).  Without this, dirty data from
+        before a forced cap drop would be stranded — or worse, flushed
+        through the stale authority."""
+        from ceph_tpu.services.mds import FileSystem
+        p = FileSystem._norm(path)
+        for r, c in list(self._clients.items()):
+            if r == rank:
+                continue
+            data = c._dirty.pop(p, None)
+            c._clean.pop(p, None)
+            if p in c.session.caps:
+                c.mds.release_cap(c.session, p)
+            if data is not None:
+                await self._client_for(rank).write(p, data)
+
+    async def _routed(self, path: str, op: str, *args,
+                      retries: int = 100, delay: float = 0.02):
+        for attempt in range(retries):
+            try:
+                self.cluster._check_frozen(path)
+                self.cluster.route(path)  # heat accounting
+                rank = self.cluster.rank_of(path)
+                await self._handoff(path, rank)
+                return await getattr(self._client_for(rank), op)(
+                    path, *args)
+            except FsError as e:
+                if "EAGAIN" not in str(e) or attempt == retries - 1:
+                    raise
+                await self.renew_all()
+                await asyncio.sleep(delay)
+
+    async def renew_all(self) -> None:
+        for c in list(self._clients.values()):
+            await c.renew()
+
+    async def write(self, path: str, data: bytes) -> None:
+        await self._routed(path, "write", data)
+
+    async def read(self, path: str) -> bytes:
+        return await self._routed(path, "read")
+
+    async def fsync(self, path: str) -> None:
+        await self._routed(path, "fsync")
+
+    async def mkdir(self, path: str) -> None:
+        await self._routed(path, "mkdir")
+
+    async def listdir(self, path: str) -> List[str]:
+        return await self._routed(path, "listdir")
+
+    async def stat(self, path: str) -> Dict:
+        return await self._routed(path, "stat")
+
+    async def unlink(self, path: str) -> None:
+        await self._routed(path, "unlink")
+
+    async def rename(self, src: str, dst: str) -> None:
+        """Cross-rank renames go through the cluster's two-lock path.
+        The SOURCE's write-behind bytes are flushed first (they are the
+        content being renamed); the DESTINATION's caches are dropped
+        WITHOUT flushing — the rename clobbers that content by
+        definition, and a later flush of stale dst bytes would overwrite
+        the renamed file."""
+        from ceph_tpu.services.mds import FileSystem
+        s, d = FileSystem._norm(src), FileSystem._norm(dst)
+        await self._routed(s, "fsync")
+        for c in self._clients.values():
+            c._dirty.pop(d, None)
+            c._clean.pop(d, None)
+            c._clean.pop(s, None)
+            for p in (s, d):
+                if p in c.session.caps:
+                    c.mds.release_cap(c.session, p)
+        await self.cluster.rename(s, d)
+
+    async def unmount(self) -> None:
+        for c in self._clients.values():
+            await c.unmount()
+        self._clients.clear()

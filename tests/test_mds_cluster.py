@@ -1,0 +1,297 @@
+"""Multi-active MDS: subtree partitioning, journaled export/import,
+rank failover, balancing, cross-rank rename (reference src/mds/
+Migrator.cc, MDBalancer.cc, multi-rank MDSMap)."""
+
+import asyncio
+import json
+
+import pytest
+
+from ceph_tpu.rados.librados import Rados
+from ceph_tpu.rados.vstart import Cluster
+from ceph_tpu.services.mds import FsError
+from ceph_tpu.services.mds_cluster import (SUBTREE_MAP_OID, CephFSMultiClient,
+                                           MDSCluster)
+
+CONF = {"osd_auto_repair": False}
+EC_PROFILE = {"plugin": "jerasure", "technique": "reed_sol_van",
+              "k": "2", "m": "1"}
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _cluster_io(pool="mdsc"):
+    cluster = Cluster(n_osds=4, conf=dict(CONF))
+    await cluster.start()
+    rados = await Rados(cluster.mon_addrs, CONF).connect()
+    await rados.pool_create(pool, profile=EC_PROFILE)
+    io = await rados.open_ioctx(pool)
+    return cluster, rados, io
+
+
+class TestSubtreeRouting:
+    def test_deepest_prefix_wins_and_ops_route(self):
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=3).start()
+                mc.subtrees.update({"/a": 1, "/a/deep": 2})
+                assert mc.rank_of("/") == 0
+                assert mc.rank_of("/b/c") == 0
+                assert mc.rank_of("/a") == 1
+                assert mc.rank_of("/a/x") == 1
+                assert mc.rank_of("/a/deep/file") == 2
+                # /ab must NOT match subtree /a (component boundaries)
+                assert mc.rank_of("/ab") == 0
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_multi_rank_io_through_facade(self):
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=2).start()
+                fsc = CephFSMultiClient(mc)
+                await fsc.mkdir("/proj")
+                await mc.export_dir("/proj", 1)
+                assert mc.rank_of("/proj/f") == 1
+                await fsc.write("/proj/f", b"on-rank-1")
+                await fsc.fsync("/proj/f")
+                await fsc.write("/top", b"on-rank-0")
+                await fsc.fsync("/top")
+                assert await fsc.read("/proj/f") == b"on-rank-1"
+                assert await fsc.read("/top") == b"on-rank-0"
+                # mutations under /proj journal at rank 1, not rank 0
+                assert mc.ranks[1].fs.mdlog.seg * 1000 + \
+                    mc.ranks[1].fs.mdlog.count > 0
+                await fsc.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+
+class TestExport:
+    def test_export_revokes_caps_and_flushes_writeback(self):
+        """A client holding dirty write-behind data under the exported
+        subtree must have flushed it by the time authority moves."""
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=2,
+                                      revoke_timeout=3.0).start()
+                fsc = CephFSMultiClient(mc, renew_interval=0.01)
+                await fsc.mkdir("/hot")
+                await fsc.write("/hot/f", b"dirty-bytes")  # write-behind
+                export = asyncio.create_task(mc.export_dir("/hot", 1))
+                # the holder complies via renewals while export waits
+                for _ in range(200):
+                    if export.done():
+                        break
+                    await fsc.renew_all()
+                    await asyncio.sleep(0.01)
+                await export
+                assert mc.rank_of("/hot/f") == 1
+                # flushed bytes visible through the NEW authority
+                assert await fsc.read("/hot/f") == b"dirty-bytes"
+                await fsc.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_ops_frozen_during_export_then_succeed(self):
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=2,
+                                      revoke_timeout=0.5).start()
+                fsc = CephFSMultiClient(mc, renew_interval=0.01)
+                await fsc.mkdir("/m")
+                await fsc.write("/m/a", b"1")
+                await fsc.fsync("/m/a")
+                mc._frozen.add("/m")
+                with pytest.raises(FsError):
+                    await fsc._routed("/m/a", "read", retries=2, delay=0.01)
+                mc._frozen.discard("/m")
+                export = asyncio.create_task(mc.export_dir("/m", 1))
+                writes = asyncio.create_task(fsc.write("/m/b", b"2"))
+                await fsc.renew_all()
+                await export
+                await writes
+                await fsc.fsync("/m/b")
+                assert await fsc.read("/m/b") == b"2"
+                await fsc.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_crash_between_pending_and_commit_completes(self):
+        """The two-phase map flip: a pending record without the commit
+        is completed at next start() (EImportFinish replay role)."""
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=2).start()
+                fsc = CephFSMultiClient(mc)
+                await fsc.mkdir("/x")
+                await fsc.write("/x/f", b"v")
+                await fsc.fsync("/x/f")
+                await fsc.unmount()
+                # simulate: exporter crashed after persisting pending
+                m = json.loads(await io.read(SUBTREE_MAP_OID))
+                m["pending"] = {"path": "/x", "to": 1}
+                await io.write_full(SUBTREE_MAP_OID,
+                                    json.dumps(m).encode())
+                mc2 = await MDSCluster(io, n_ranks=2).start()
+                assert mc2.rank_of("/x/f") == 1
+                m2 = json.loads(await io.read(SUBTREE_MAP_OID))
+                assert m2["pending"] is None
+                fsc2 = CephFSMultiClient(mc2)
+                assert await fsc2.read("/x/f") == b"v"
+                await fsc2.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+
+class TestFailover:
+    def test_rank_replacement_replays_own_journal(self):
+        """Kill rank 1 after a mutation whose dirfrag write was cut
+        short; the replacement's journal replay completes it."""
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=2).start()
+                fsc = CephFSMultiClient(mc)
+                await fsc.mkdir("/svc")
+                await mc.export_dir("/svc", 1)
+                await fsc.write("/svc/f", b"payload")
+                await fsc.fsync("/svc/f")
+                # crash-consistency probe: journal the event at rank 1
+                # WITHOUT applying it (the dirfrag write never happened)
+                fs1 = mc.ranks[1].fs
+                await fs1._journal({"op": "set_dentry", "parent": "/svc",
+                                    "name": "half",
+                                    "dentry": {"type": "file", "size": 0,
+                                               "ino": "deadbeef" * 4,
+                                               "mtime": 0.0}})
+                await mc.replace_rank(1)
+                # the replacement replayed rank 1's journal: the
+                # half-applied dentry now exists
+                names = await mc.ranks[1].fs.listdir("/svc")
+                assert "half" in names and "f" in names
+                # facade reconnects (old session died with the rank)
+                assert await fsc.read("/svc/f") == b"payload"
+                await fsc.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+
+class TestBalancer:
+    def test_hot_subtree_moves_to_cold_rank(self):
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=2,
+                                      revoke_timeout=0.2).start()
+                fsc = CephFSMultiClient(mc, renew_interval=0.01)
+                await fsc.mkdir("/busy")
+                await fsc.write("/busy/f", b"x")
+                await fsc.fsync("/busy/f")
+                for _ in range(50):  # heat /busy on rank 0
+                    await fsc.read("/busy/f")
+                await fsc.renew_all()
+                moved = await mc.maybe_rebalance(ratio=2.0)
+                assert moved is not None
+                path, from_rank, to_rank = moved
+                assert path == "/busy" and from_rank == 0 and to_rank == 1
+                assert mc.rank_of("/busy/f") == 1
+                assert await fsc.read("/busy/f") == b"x"
+                await fsc.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+
+class TestCrossRankRename:
+    def test_rename_across_authorities(self):
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=2).start()
+                fsc = CephFSMultiClient(mc)
+                await fsc.mkdir("/a")
+                await fsc.mkdir("/b")
+                await mc.export_dir("/b", 1)
+                await fsc.write("/a/src", b"moved-bytes")
+                await fsc.fsync("/a/src")
+                await fsc.rename("/a/src", "/b/dst")
+                assert await fsc.read("/b/dst") == b"moved-bytes"
+                with pytest.raises(FsError):
+                    await mc.ranks[0].fs.read_file("/a/src")
+                # both halves landed exactly once
+                assert "src" not in await fsc.listdir("/a")
+                assert "dst" in await fsc.listdir("/b")
+                await fsc.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+
+class TestRenameCacheCoherence:
+    def test_stale_dst_writeback_cannot_clobber_rename(self):
+        """Write-behind bytes staged for the DESTINATION before a rename
+        must be discarded, not flushed over the renamed content."""
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=2).start()
+                fsc = CephFSMultiClient(mc, renew_interval=0.01)
+                await fsc.mkdir("/a")
+                await fsc.mkdir("/b")
+                await mc.export_dir("/b", 1)
+                await fsc.write("/a/src", b"KEEP")
+                await fsc.fsync("/a/src")
+                await fsc.write("/b/dst", b"STALE")  # dirty, unflushed
+                await fsc.rename("/a/src", "/b/dst")
+                # renews/fsyncs after the rename must not resurrect STALE
+                await fsc.renew_all()
+                await fsc.fsync("/b/dst")
+                assert await fsc.read("/b/dst") == b"KEEP"
+                await fsc.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+
+class TestConcurrencyRegression:
+    def test_concurrent_mkdir_same_parent_loses_nothing(self):
+        """Two interleaved mkdirs in one directory: the per-rank
+        mutation lock keeps the dirfrag read-modify-write atomic."""
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=1).start()
+                fsc = CephFSMultiClient(mc)
+                await fsc.mkdir("/p")
+                await asyncio.gather(*[
+                    fsc.mkdir(f"/p/d{i}") for i in range(8)])
+                assert await fsc.listdir("/p") == [f"d{i}"
+                                                   for i in range(8)]
+                await fsc.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
