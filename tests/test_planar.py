@@ -395,3 +395,52 @@ class TestTransferOverlap:
                 "backlogged round did not overlap the in-flight fetch"
         finally:
             q.close()
+
+    def test_deep_backlog_splits_into_budgeted_rounds(self):
+        """A backlog far above max_pending_bytes must dispatch as
+        MULTIPLE budget-sized rounds (which the worker can pipeline),
+        not one oversized round nothing overlaps with — and every
+        request must still resolve byte-exactly in FIFO order."""
+        from ceph_tpu.ec.gf import gf
+        from ceph_tpu.ec.matrices import (matrix_to_bitmatrix,
+                                          vandermonde_coding_matrix)
+
+        k, m, w = 4, 2, 8
+        mat = vandermonde_coding_matrix(k, m, w)
+        bm = matrix_to_bitmatrix(mat, w).astype(np.int8)
+        fgf = gf(w)
+        rng = np.random.default_rng(23)
+        # budget = one request's bytes: 8 queued requests => >= 8 rounds
+        q = BatchingQueue(max_pending_bytes=k * 1024, max_delay=10.0)
+        try:
+            with q._cv:  # stall the worker while the backlog forms
+                datas = [rng.integers(0, 256, (k, 1024), dtype=np.uint8)
+                         for _ in range(8)]
+            futs = [q.submit(bm, d, w, m) for d in datas]
+            d0 = q.dispatches
+            for d, f in zip(datas, futs):
+                assert np.array_equal(f.result(timeout=60),
+                                      fgf.matmul(mat, d))
+            assert q.dispatches - d0 >= 4, \
+                f"backlog dispatched as {q.dispatches - d0} round(s)"
+        finally:
+            q.close()
+
+    def test_flush_takes_everything_regardless_of_budget(self):
+        from ceph_tpu.ec.matrices import (matrix_to_bitmatrix,
+                                          vandermonde_coding_matrix)
+
+        k, m, w = 4, 2, 8
+        bm = matrix_to_bitmatrix(
+            vandermonde_coding_matrix(k, m, w), w).astype(np.int8)
+        rng = np.random.default_rng(24)
+        q = BatchingQueue(max_pending_bytes=16, max_delay=10.0)
+        try:
+            futs = [q.submit(bm, rng.integers(0, 256, (k, 512),
+                                              dtype=np.uint8), w, m)
+                    for _ in range(4)]
+            q.flush()
+            for f in futs:
+                f.result(timeout=60)
+        finally:
+            q.close()
