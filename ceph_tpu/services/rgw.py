@@ -213,6 +213,70 @@ class RgwService:
         meta["acl"] = acl
         await self._save_bucket_meta(bucket, meta)
 
+    # -- bucket policy (reference src/rgw/rgw_iam_policy.cc) ------------------
+
+    async def put_bucket_policy(self, bucket: str, policy: Dict) -> None:
+        """S3-style policy document: {"Version": ..., "Statement":
+        [{"Effect": "Allow"|"Deny", "Principal": "*"|key|{"AWS": [...]},
+        "Action": "s3:GetObject"|[...], "Resource": arn|[...]}]}.
+        Statements support trailing-* wildcards in Action and Resource
+        exactly like the reference's IAM matcher."""
+        if await self._load_index(bucket) is None:
+            raise RadosError(f"NoSuchBucket: {bucket}", code=-errno.ENOENT)
+        for stmt in policy.get("Statement", ()):
+            if stmt.get("Effect") not in ("Allow", "Deny"):
+                raise RadosError("MalformedPolicy: bad Effect",
+                                 code=-errno.EINVAL)
+        meta = await self.get_bucket_meta(bucket)
+        meta["policy"] = policy
+        await self._save_bucket_meta(bucket, meta)
+
+    async def delete_bucket_policy(self, bucket: str) -> None:
+        if await self._load_index(bucket) is None:
+            raise RadosError(f"NoSuchBucket: {bucket}", code=-errno.ENOENT)
+        meta = await self.get_bucket_meta(bucket)
+        meta["policy"] = None
+        await self._save_bucket_meta(bucket, meta)
+
+    @staticmethod
+    def _iam_match(pattern: str, value: str) -> bool:
+        if pattern.endswith("*"):
+            return value.startswith(pattern[:-1])
+        return pattern == value
+
+    @staticmethod
+    def policy_eval(policy: Optional[Dict], principal: Optional[str],
+                    action: str, resource: str) -> Optional[str]:
+        """Evaluate the bucket policy for (principal, action, resource):
+        returns "Deny" (explicit deny — overrides everything), "Allow"
+        (explicit allow), or None (no statement matched — the caller
+        falls through to the ACL, the reference's PASS verdict)."""
+        if not policy:
+            return None
+        verdict: Optional[str] = None
+        for stmt in policy.get("Statement", ()):
+            pr = stmt.get("Principal", "*")
+            if isinstance(pr, dict):
+                pr = pr.get("AWS", [])
+            principals = [pr] if isinstance(pr, str) else list(pr)
+            if "*" not in principals and principal not in principals:
+                continue
+            actions = stmt.get("Action", [])
+            if isinstance(actions, str):
+                actions = [actions]
+            if not any(RgwService._iam_match(a, action) for a in actions):
+                continue
+            resources = stmt.get("Resource", [])
+            if isinstance(resources, str):
+                resources = [resources]
+            if resources and not any(RgwService._iam_match(r, resource)
+                                     for r in resources):
+                continue
+            if stmt.get("Effect") == "Deny":
+                return "Deny"  # deny-overrides: stop immediately
+            verdict = "Allow"
+        return verdict
+
     @staticmethod
     def acl_allows(acl: Optional[Dict], principal: Optional[str],
                    need: str) -> bool:
@@ -1089,23 +1153,50 @@ class RgwFrontend:
                         await self.service.list_buckets()).encode()
                 return "405 Method Not Allowed", b""
             bucket = parts[0]
-            # bucket ACL gate (reference rgw_op verify_permission): reads
-            # need READ, mutations need WRITE; the owner passes anything
+            # authorization gate (reference rgw_op verify_permission):
+            # the bucket POLICY is consulted first — explicit Deny wins,
+            # explicit Allow grants, and no match falls through to the
+            # ACL (reads need READ, mutations WRITE).  Administrative
+            # subresources (acl/versioning/lifecycle/policy mutations)
+            # are owner-level and deliberately NOT policy-gated, so a
+            # bad Deny statement can never lock the owner out of
+            # repairing the policy (AWS root-user semantics).
             gate_meta = None
             if parts and method in ("GET", "HEAD", "PUT", "POST", "DELETE"):
                 need = "READ" if method in ("GET", "HEAD") else "WRITE"
-                if method == "PUT" and q.keys() & {"acl", "versioning",
-                                                   "lifecycle"}:
-                    # policy mutation is owner-level (S3 WRITE_ACP /
-                    # FULL_CONTROL): a WRITE grantee must not be able to
-                    # rewrite the ACL and seize the bucket
+                admin_op = method in ("PUT", "DELETE") and q.keys() & {
+                    "acl", "versioning", "lifecycle", "policy"}
+                if admin_op:
                     need = "FULL_CONTROL"
                 is_create = len(parts) == 1 and method == "PUT" \
-                    and not q.keys() & {"versioning", "lifecycle", "acl"}
+                    and not q.keys() & {"versioning", "lifecycle", "acl",
+                                        "policy"}
                 if not is_create:
                     gate_meta = await self.service.get_bucket_meta(bucket)
-                    if not RgwService.acl_allows(gate_meta.get("acl"),
-                                                 principal, need):
+                    if len(parts) >= 2:
+                        action = {"GET": "s3:GetObject",
+                                  "HEAD": "s3:GetObject",
+                                  "PUT": "s3:PutObject",
+                                  "POST": "s3:PutObject",
+                                  "DELETE": "s3:DeleteObject"}[method]
+                        resource = f"arn:aws:s3:::{bucket}/" + \
+                            "/".join(parts[1:])
+                    else:
+                        action = {"GET": "s3:ListBucket",
+                                  "HEAD": "s3:ListBucket",
+                                  "PUT": "s3:CreateBucket",
+                                  "POST": "s3:PutObject",
+                                  "DELETE": "s3:DeleteBucket"}[method]
+                        resource = f"arn:aws:s3:::{bucket}"
+                    verdict = None
+                    if not admin_op:
+                        verdict = RgwService.policy_eval(
+                            gate_meta.get("policy"), principal, action,
+                            resource)
+                    if verdict == "Deny":
+                        return "403 Forbidden", b"AccessDenied"
+                    if verdict != "Allow" and not RgwService.acl_allows(
+                            gate_meta.get("acl"), principal, need):
                         return "403 Forbidden", b"AccessDenied"
             if len(parts) == 1:
                 if method == "PUT" and "versioning" in q:
@@ -1133,6 +1224,21 @@ class RgwFrontend:
                 if method == "GET" and "acl" in q:
                     meta = await self.service.get_bucket_meta(bucket)
                     return "200 OK", json.dumps(meta.get("acl")).encode()
+                if method == "PUT" and "policy" in q:
+                    try:
+                        doc = json.loads(body or b"{}")
+                    except ValueError:
+                        return "400 Bad Request", b"MalformedPolicy"
+                    await self.service.put_bucket_policy(bucket, doc)
+                    return "200 OK", b""
+                if method == "GET" and "policy" in q:
+                    meta = await self.service.get_bucket_meta(bucket)
+                    if not meta.get("policy"):
+                        return "404 Not Found", b"NoSuchBucketPolicy"
+                    return "200 OK", json.dumps(meta["policy"]).encode()
+                if method == "DELETE" and "policy" in q:
+                    await self.service.delete_bucket_policy(bucket)
+                    return "204 No Content", b""
                 if method == "GET" and "versions" in q:
                     return "200 OK", json.dumps(
                         await self.service.list_object_versions(
@@ -1197,7 +1303,8 @@ class RgwFrontend:
                 return "404 Not Found", msg.encode()
             if "BucketNotEmpty" in msg:
                 return "409 Conflict", msg.encode()
-            if "InvalidPart" in msg or "MalformedXML" in msg:
+            if "InvalidPart" in msg or "MalformedXML" in msg \
+                    or "MalformedPolicy" in msg:
                 return "400 Bad Request", msg.encode()
             if "MethodNotAllowed" in msg:
                 return "405 Method Not Allowed", msg.encode()
