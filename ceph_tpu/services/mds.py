@@ -142,6 +142,19 @@ class MDLog:
             except RadosError:
                 pass
 
+    async def roll(self) -> None:
+        """Close the CURRENT segment (start a fresh one), so a following
+        expire() retires every event appended so far — expire() alone
+        cannot drop the in-progress segment.  Subtree export uses this
+        as its flush barrier: after roll+expire, nothing a replay could
+        re-apply refers to the migrated subtree."""
+        if self.count == 0 and self.off == 0:
+            return  # current segment already empty
+        self.seg += 1
+        self.off = 0
+        self.count = 0
+        await self._save_head()
+
 
 class FileSystem:
     def __init__(self, meta_ioctx: IoCtx, data_ioctx: Optional[IoCtx] = None,

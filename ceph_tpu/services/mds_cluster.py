@@ -85,7 +85,13 @@ class MDSCluster:
             self.epoch = m["epoch"]
             self.subtrees = {p: int(r) for p, r in m["subtrees"].items()}
             pending = m.get("pending")
-        except RadosError:
+        except RadosError as e:
+            import errno as _errno
+            # a fresh map is only right for VERIFIED absence: writing
+            # the default over a transiently-unreadable real map would
+            # silently revert every migrated subtree to rank 0
+            if e.code != -_errno.ENOENT:
+                raise
             pending = None
             await self._save_map(pending=None)
         self.ranks = []
@@ -132,17 +138,18 @@ class MDSCluster:
             if _is_under(path, root):
                 raise FsError(f"EAGAIN: subtree {root} migrating")
 
-    def route(self, path: str) -> MDSServer:
-        """Authoritative server for `path`; raises retryable EAGAIN
-        while the covering subtree is mid-export (the reference freezes
-        the exported CDir the same way)."""
+    def route(self, path: str) -> Tuple[int, MDSServer]:
+        """(rank, authoritative server) for `path`, with balancer heat
+        accounting; raises retryable EAGAIN while the covering subtree
+        is mid-export (the reference freezes the exported CDir the same
+        way)."""
         self._check_frozen(path)
         rank = self.rank_of(path)
         self.rank_ops[rank] += 1
         p = _norm(path)
         top = "/" + p.split("/")[1] if p != "/" else "/"
         self._dir_ops[top] = self._dir_ops.get(top, 0) + 1
-        return self.ranks[rank]
+        return rank, self.ranks[rank]
 
     # -- subtree migration (Migrator role) -----------------------------------
 
@@ -165,10 +172,14 @@ class MDSCluster:
         self._frozen.add(path)
         try:
             await self._revoke_subtree_caps(src, path)
-            # drain in-flight mutations, then flush: after this the
-            # journal holds nothing unapplied for the subtree
+            # drain in-flight mutations, then flush: roll closes the
+            # write segment so expire retires EVERY applied event —
+            # without the roll, current-segment events survive and a
+            # later replace_rank() of the exporter would replay them
+            # onto dirfrags the importer has since rewritten
             async with src.fs._mutate:
                 if src.fs.mdlog is not None:
+                    await src.fs.mdlog.roll()
                     await src.fs.mdlog.expire()
             # two-phase commit against the persisted map
             await self._save_map(pending={"path": path, "to": to_rank})
@@ -281,21 +292,28 @@ class MDSCluster:
                     raise FsError(f"ENOENT: parent {dparent}")
                 if ddentries.get(dname, {}).get("type") == "dir":
                     raise FsError(f"EISDIR: {dst_path}")
-                subs = [{"op": "set_dentry", "parent": dparent,
-                         "name": dname, "dentry": ent},
-                        {"op": "rm_dentry", "parent": sparent,
-                         "name": sname}]
+                # each HALF is journaled at the rank that owns its
+                # dirfrag, destination first (set) then source (rm) — a
+                # crash between the two leaves both dentries briefly
+                # existing, never neither (same EUpdate metablob order
+                # as the single-rank rename), and each rank's replay
+                # touches ONLY its own dirfrags, so replaying one rank
+                # while the peer serves live traffic cannot race the
+                # peer's read-modify-writes
+                dst_subs = [{"op": "set_dentry", "parent": dparent,
+                             "name": dname, "dentry": ent}]
                 old = ddentries.get(dname)
                 if old and old.get("ino") and old["ino"] != ent.get("ino"):
-                    subs.append({"op": "drop_ino", "ino": old["ino"]})
-                event = {"op": "rename", "events": subs}
-                # intent journaled at the source rank: its replay applies
-                # BOTH halves (recovery is single-threaded, so touching
-                # the peer's dirfrag there cannot race live mutations —
-                # ranks sharing a journal replay window are restarted
-                # together by start())
-                await fs_src._journal(event)
-                await fs_src._apply_event(event)
+                    dst_subs.append({"op": "drop_ino", "ino": old["ino"]})
+                dst_event = {"op": "rename", "events": dst_subs}
+                src_event = {"op": "rename", "events": [
+                    {"op": "rm_dentry", "parent": sparent,
+                     "name": sname}]}
+                await fs_dst._journal(dst_event)
+                await fs_dst._apply_event(dst_event)
+                await fs_dst._journal_applied()
+                await fs_src._journal(src_event)
+                await fs_src._apply_event(src_event)
                 await fs_src._journal_applied()
 
 
@@ -347,9 +365,7 @@ class CephFSMultiClient:
                       retries: int = 100, delay: float = 0.02):
         for attempt in range(retries):
             try:
-                self.cluster._check_frozen(path)
-                self.cluster.route(path)  # heat accounting
-                rank = self.cluster.rank_of(path)
+                rank, _server = self.cluster.route(path)
                 await self._handoff(path, rank)
                 return await getattr(self._client_for(rank), op)(
                     path, *args)
@@ -384,24 +400,36 @@ class CephFSMultiClient:
     async def unlink(self, path: str) -> None:
         await self._routed(path, "unlink")
 
-    async def rename(self, src: str, dst: str) -> None:
+    async def rename(self, src: str, dst: str,
+                     retries: int = 100, delay: float = 0.02) -> None:
         """Cross-rank renames go through the cluster's two-lock path.
         The SOURCE's write-behind bytes are flushed first (they are the
         content being renamed); the DESTINATION's caches are dropped
         WITHOUT flushing — the rename clobbers that content by
         definition, and a later flush of stale dst bytes would overwrite
-        the renamed file."""
+        the renamed file.  A frozen subtree (mid-export) retries like
+        every other facade op."""
         from ceph_tpu.services.mds import FileSystem
         s, d = FileSystem._norm(src), FileSystem._norm(dst)
-        await self._routed(s, "fsync")
-        for c in self._clients.values():
-            c._dirty.pop(d, None)
-            c._clean.pop(d, None)
-            c._clean.pop(s, None)
-            for p in (s, d):
-                if p in c.session.caps:
-                    c.mds.release_cap(c.session, p)
-        await self.cluster.rename(s, d)
+        for attempt in range(retries):
+            try:
+                self.cluster._check_frozen(s)
+                self.cluster._check_frozen(d)
+                await self._routed(s, "fsync")
+                for c in self._clients.values():
+                    c._dirty.pop(d, None)
+                    c._clean.pop(d, None)
+                    c._clean.pop(s, None)
+                    for p in (s, d):
+                        if p in c.session.caps:
+                            c.mds.release_cap(c.session, p)
+                await self.cluster.rename(s, d)
+                return
+            except FsError as e:
+                if "EAGAIN" not in str(e) or attempt == retries - 1:
+                    raise
+                await self.renew_all()
+                await asyncio.sleep(delay)
 
     async def unmount(self) -> None:
         for c in self._clients.values():

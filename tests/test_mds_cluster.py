@@ -249,6 +249,62 @@ class TestCrossRankRename:
         run(go())
 
 
+class TestExportReplaySafety:
+    def test_exporter_replay_cannot_regress_migrated_subtree(self):
+        """Pre-export events are retired (journal roll + expire) during
+        export: replacing the EXPORTER later must not replay them over
+        dirfrags the importer has since rewritten."""
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=2,
+                                      revoke_timeout=0.2).start()
+                fsc = CephFSMultiClient(mc, renew_interval=0.01)
+                await fsc.mkdir("/hot")
+                await fsc.write("/hot/f", b"OLD")       # rank 0 journal
+                await fsc.fsync("/hot/f")
+                await mc.export_dir("/hot", 1)
+                await fsc.write("/hot/f", b"NEW")       # rank 1 owns it
+                await fsc.fsync("/hot/f")
+                # exporter crashes and is replaced: its replay must NOT
+                # resurrect the OLD dentry/ino
+                await mc.replace_rank(0)
+                assert await fsc.read("/hot/f") == b"NEW"
+                await fsc.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_cross_rename_replay_touches_only_own_dirfrags(self):
+        """Each rename half is journaled at the rank owning its dirfrag;
+        replaying the source rank must not rewrite the destination."""
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=2).start()
+                fsc = CephFSMultiClient(mc)
+                await fsc.mkdir("/a")
+                await fsc.mkdir("/b")
+                await mc.export_dir("/b", 1)
+                await fsc.write("/a/src", b"v1")
+                await fsc.fsync("/a/src")
+                await fsc.rename("/a/src", "/b/dst")
+                # destination later overwritten through its own rank
+                await fsc.write("/b/dst", b"v2")
+                await fsc.fsync("/b/dst")
+                # replaying rank 0 (the rename SOURCE) must not regress
+                # /b/dst to the renamed v1 entry
+                await mc.replace_rank(0)
+                assert await fsc.read("/b/dst") == b"v2"
+                assert "src" not in await fsc.listdir("/a")
+                await fsc.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+
 class TestRenameCacheCoherence:
     def test_stale_dst_writeback_cannot_clobber_rename(self):
         """Write-behind bytes staged for the DESTINATION before a rename
