@@ -41,6 +41,12 @@ SEGMENT_EVENTS = 128  # events per journal segment (LogSegment role)
 _REC = struct.Struct("<I")  # length prefix per journal record
 
 
+def is_under(path: str, root: str) -> bool:
+    """True if `path` is `root` or inside it (component-wise)."""
+    return path == root or (root == "/" and path.startswith("/")) \
+        or path.startswith(root + "/")
+
+
 class FsError(Exception):
     pass
 
@@ -171,6 +177,7 @@ class FileSystem:
         # ops on the same directory would lose the first update (the
         # reference serializes through per-CDir locks under the mds_lock)
         self._mutate = asyncio.Lock()
+        self._snap_cache: Optional[Dict[str, Dict]] = None
 
     async def mount(self) -> int:
         """Recover the namespace: replay unexpired journal events (the
@@ -246,6 +253,22 @@ class FileSystem:
         elif op == "rename":
             for sub in ev["events"]:
                 await self._apply_event(sub)
+        elif op == "snap_create":
+            table = await self._load_snaptable()
+            table[ev["key"]] = {"root": ev["root"], "name": ev["name"],
+                                "created": ev.get("created", 0.0),
+                                "tree": ev["tree"]}
+            await self._save_snaptable(table)
+        elif op == "snap_delete":
+            table = await self._load_snaptable()
+            if ev["key"] in table:
+                del table[ev["key"]]
+                await self._save_snaptable(table)
+            for ino in ev.get("drop", ()):
+                try:
+                    await self.striper.remove(self._file_oid(ino))
+                except RadosError:
+                    pass
 
     # -- dentries ------------------------------------------------------------
 
@@ -339,8 +362,11 @@ class FileSystem:
             if existing and existing.get("ino"):
                 # the replaced inode's data is dropped in the same
                 # journaled event (concurrent readers are excluded by the
-                # caps layer: writes need the exclusive cap)
-                event["drop_old_ino"] = existing["ino"]
+                # caps layer: writes need the exclusive cap) — UNLESS a
+                # snapshot pins it (COW: the snap keeps the old bytes)
+                if existing["ino"] not in self._snap_inos(
+                        await self._load_snaptable(use_cache=True)):
+                    event["drop_old_ino"] = existing["ino"]
             await self._journal(event)
             await self._apply_event(event)
             await self._journal_applied()
@@ -368,7 +394,8 @@ class FileSystem:
                 if children:
                     raise FsError(f"ENOTEMPTY: {path}")
                 event["rmdir"] = path
-            else:
+            elif ent["ino"] not in self._snap_inos(
+                    await self._load_snaptable(use_cache=True)):
                 event["drop_ino"] = ent["ino"]
             await self._journal(event)
             await self._apply_event(event)
@@ -401,12 +428,178 @@ class FileSystem:
                      "dentry": ent},
                     {"op": "rm_dentry", "parent": sparent, "name": sname}]
             if (old_dst and old_dst.get("ino")
-                    and old_dst["ino"] != ent.get("ino")):
+                    and old_dst["ino"] != ent.get("ino")
+                    and old_dst["ino"] not in self._snap_inos(
+                        await self._load_snaptable(use_cache=True))):
                 subs.append({"op": "drop_ino", "ino": old_dst["ino"]})
             event = {"op": "rename", "events": subs}
             await self._journal(event)
             await self._apply_event(event)
             await self._journal_applied()
+
+    # -- snapshots (reference src/mds/SnapServer.cc + SnapRealm COW) ---------
+    #
+    # The fresh-inode-per-write discipline makes file data naturally
+    # copy-on-write: a snapshot is a frozen {relpath -> dentry} tree in
+    # the snap table plus a liveness rule — an inode referenced by any
+    # snapshot is never dropped by overwrite/unlink/rename.  Snapshots
+    # are crash-consistent (callers flush their write-behind first; the
+    # client does).  In multi-rank deployments every snap-table mutation
+    # routes through rank 0, the reference's snapserver seat.
+
+    SNAPS_OID = "mds_snaptable"
+
+    async def _load_snaptable(self, use_cache: bool = False
+                              ) -> Dict[str, Dict]:
+        """The hot-path pinned-ino checks pass use_cache=True: with no
+        snapshots (the common case) the cache is a dict-hit, not a
+        meta-pool round-trip per mutation.  Cache coherence across
+        FileSystem instances is the CLUSTER's job: MDSCluster snapshot
+        ops run under an all-ranks barrier and invalidate every rank's
+        cache (invalidate_snap_cache)."""
+        if use_cache and self._snap_cache is not None:
+            return self._snap_cache
+        try:
+            table = json.loads(await self.meta.read(self.SNAPS_OID))
+        except RadosError as e:
+            if e.code != -errno.ENOENT:
+                raise
+            table = {}
+        self._snap_cache = table
+        return table
+
+    def invalidate_snap_cache(self) -> None:
+        self._snap_cache = None
+
+    async def _save_snaptable(self, table: Dict[str, Dict]) -> None:
+        await self.meta.write_full(self.SNAPS_OID,
+                                   json.dumps(table).encode())
+        self._snap_cache = table
+
+    @staticmethod
+    def _snap_inos(table: Dict[str, Dict]) -> set:
+        out = set()
+        for snap in table.values():
+            for ent in snap.get("tree", {}).values():
+                if ent.get("ino"):
+                    out.add(ent["ino"])
+        return out
+
+    async def _collect_tree(self, root: str) -> Dict[str, Dict]:
+        """{relpath -> dentry} for the subtree at root ('' = root dir
+        itself); dirs carry {"type": "dir"}, files keep ino/size."""
+        tree: Dict[str, Dict] = {}
+
+        async def rec(path: str, rel: str) -> None:
+            dentries = await self._load_dir(path)
+            if dentries is None:
+                return
+            for name, ent in dentries.items():
+                r = f"{rel}/{name}" if rel else name
+                tree[r] = dict(ent)
+                if ent["type"] == "dir":
+                    await rec(posixpath.join(path, name), r)
+
+        await rec(root, "")
+        return tree
+
+    async def snap_create(self, root: str, name: str) -> None:
+        async with self._mutate:
+            await self._snap_create_locked(root, name)
+
+    async def _snap_create_locked(self, root: str, name: str) -> None:
+        """Body of snap_create, caller holds the mutation barrier —
+        MDSCluster calls this holding EVERY rank's lock, so no rank can
+        race a drop_old_ino decision against the table commit."""
+        root = self._norm(root)
+        if "|" in name or "/" in name or not name:
+            raise FsError(f"EINVAL: bad snap name {name!r}")
+        if await self._load_dir(root) is None:
+            raise FsError(f"ENOENT: {root}")
+        table = await self._load_snaptable()
+        key = f"{root}|{name}"
+        if key in table:
+            raise FsError(f"EEXIST: snap {name} on {root}")
+        tree = await self._collect_tree(root)
+        event = {"op": "snap_create", "key": key, "root": root,
+                 "name": name, "tree": tree,
+                 "created": time.time()}
+        await self._journal(event)
+        await self._apply_event(event)
+        await self._journal_applied()
+
+    async def snap_delete(self, root: str, name: str) -> None:
+        async with self._mutate:
+            await self._snap_delete_locked(root, name)
+
+    async def _snap_delete_locked(self, root: str, name: str) -> None:
+        root = self._norm(root)
+        table = await self._load_snaptable()
+        key = f"{root}|{name}"
+        snap = table.get(key)
+        if snap is None:
+            raise FsError(f"ENOENT: snap {name} on {root}")
+        # reclaim inodes only this snapshot pins AND no live dentry
+        # references.  Liveness is decided by a NAMESPACE-WIDE walk, not
+        # the snapshot-time path: a rename since the snapshot moved the
+        # dentry (possibly out of the subtree) while the inode stayed
+        # live — a path-stat would misread it as dead and destroy the
+        # live file's data
+        others = {k: v for k, v in table.items() if k != key}
+        pinned_elsewhere = self._snap_inos(others)
+        candidates = {ent["ino"] for ent in snap.get("tree", {}).values()
+                      if ent.get("ino")
+                      and ent["ino"] not in pinned_elsewhere}
+        if candidates:
+            live = {ent.get("ino")
+                    for ent in (await self._collect_tree("/")).values()
+                    if ent.get("ino")}
+            candidates -= live
+        event = {"op": "snap_delete", "key": key,
+                 "drop": sorted(candidates)}
+        await self._journal(event)
+        await self._apply_event(event)
+        await self._journal_applied()
+
+    async def snap_list(self, root: str) -> List[str]:
+        root = self._norm(root)
+        table = await self._load_snaptable()
+        return sorted(v["name"] for k, v in table.items()
+                      if v.get("root") == root)
+
+    async def _snap_entry(self, root: str, name: str) -> Dict:
+        table = await self._load_snaptable()
+        snap = table.get(f"{self._norm(root)}|{name}")
+        if snap is None:
+            raise FsError(f"ENOENT: snap {name} on {root}")
+        return snap
+
+    async def listdir_snap(self, root: str, name: str,
+                           rel: str = "") -> List[str]:
+        snap = await self._snap_entry(root, name)
+        rel = rel.strip("/")
+        if rel:
+            ent = snap.get("tree", {}).get(rel)
+            if ent is None:
+                raise FsError(f"ENOENT: {rel} in snap {name}")
+            if ent["type"] != "dir":
+                raise FsError(f"ENOTDIR: {rel}")
+        prefix = f"{rel}/" if rel else ""
+        out = set()
+        for r in snap.get("tree", {}):
+            if r.startswith(prefix) and r != rel:
+                out.add(r[len(prefix):].split("/")[0])
+        return sorted(out)
+
+    async def read_snap_file(self, root: str, name: str,
+                             rel: str) -> bytes:
+        snap = await self._snap_entry(root, name)
+        ent = snap.get("tree", {}).get(rel.strip("/"))
+        if ent is None:
+            raise FsError(f"ENOENT: {rel} in snap {name}")
+        if ent["type"] != "file":
+            raise FsError(f"EISDIR: {rel}")
+        return await self.striper.read(self._file_oid(ent["ino"]))
 
     async def walk(self, path: str = "/") -> Dict:
         """Recursive tree dump (debugging/`ceph fs dump` role)."""
@@ -598,6 +791,32 @@ class MDSServer:
         self._require(session, path, "r")
         return await self.fs.stat(path)
 
+    # snapshots: creation is a metadata write on the root (rw); reads
+    # are read-capped on the root, like the reference's .snap dirs
+    async def snap_create(self, session: MDSSession, path: str,
+                          name: str) -> None:
+        self._require(session, path, "rw")
+        await self.fs.snap_create(path, name)
+
+    async def snap_delete(self, session: MDSSession, path: str,
+                          name: str) -> None:
+        self._require(session, path, "rw")
+        await self.fs.snap_delete(path, name)
+
+    async def snap_list(self, session: MDSSession, path: str) -> List[str]:
+        self._require(session, path, "r")
+        return await self.fs.snap_list(path)
+
+    async def read_snap_file(self, session: MDSSession, path: str,
+                             name: str, rel: str) -> bytes:
+        self._require(session, path, "r")
+        return await self.fs.read_snap_file(path, name, rel)
+
+    async def listdir_snap(self, session: MDSSession, path: str,
+                           name: str, rel: str = "") -> List[str]:
+        self._require(session, path, "r")
+        return await self.fs.listdir_snap(path, name, rel)
+
 
 class CephFSClient:
     """The CLIENT half of the filesystem (reference src/client/Client.cc
@@ -731,6 +950,37 @@ class CephFSClient:
         self._clean.pop(p, None)
         await self._acquire(path, "rw")
         await self.mds.unlink(self.session, path)
+
+    # -- snapshots -----------------------------------------------------------
+
+    async def snap_create(self, path: str, name: str) -> None:
+        """Snapshot the subtree at `path`.  The client's own
+        write-behind bytes under the subtree are flushed FIRST, so the
+        snapshot captures them (crash consistency is only as good as
+        what has reached the MDS)."""
+        await self.renew()
+        p = FileSystem._norm(path)
+        for dirty in list(self._dirty):
+            if is_under(dirty, p):
+                await self._flush_path(dirty)
+        await self.mds.snap_create(self.session, path, name)
+
+    async def snap_delete(self, path: str, name: str) -> None:
+        await self._maybe_renew()
+        await self.mds.snap_delete(self.session, path, name)
+
+    async def snap_list(self, path: str) -> List[str]:
+        await self._maybe_renew()
+        return await self.mds.snap_list(self.session, path)
+
+    async def read_snap(self, path: str, name: str, rel: str) -> bytes:
+        await self._maybe_renew()
+        return await self.mds.read_snap_file(self.session, path, name, rel)
+
+    async def listdir_snap(self, path: str, name: str,
+                           rel: str = "") -> List[str]:
+        await self._maybe_renew()
+        return await self.mds.listdir_snap(self.session, path, name, rel)
 
     async def unmount(self) -> None:
         """Flush every dirty file, release every cap, close the session

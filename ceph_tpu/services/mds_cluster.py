@@ -28,6 +28,7 @@ exactly like a lone MDSServer.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import posixpath
 import time
@@ -36,19 +37,13 @@ from typing import Dict, List, Optional, Tuple
 from ceph_tpu.rados.client import RadosError
 from ceph_tpu.rados.librados import IoCtx
 from ceph_tpu.services.mds import (CephFSClient, FileSystem, FsError,
-                                   MDSServer)
+                                   MDSServer, is_under as _is_under)
 
 SUBTREE_MAP_OID = "mds_subtree_map"
 
 
 def _norm(path: str) -> str:
     return FileSystem._norm(path)
-
-
-def _is_under(path: str, root: str) -> bool:
-    """True if `path` is `root` or inside it (component-wise)."""
-    return path == root or (root == "/" and path.startswith("/")) \
-        or path.startswith(root + "/")
 
 
 class MDSCluster:
@@ -109,6 +104,9 @@ class MDSCluster:
             # (EImportFinish replay role)
             self.subtrees[pending["path"]] = int(pending["to"])
             await self._save_map(pending=None)
+        for r in range(self.n_ranks):
+            async with self.ranks[r].fs._mutate:
+                await self._reconcile_renames(r)
         return self
 
     async def _save_map(self, pending) -> None:
@@ -216,15 +214,100 @@ class MDSCluster:
                 return
             await asyncio.sleep(0.02)
 
+    # -- snapshots (snapserver seat: rank 0) ---------------------------------
+
+    @contextlib.asynccontextmanager
+    async def _all_ranks_barrier(self):
+        """Hold every rank's mutation lock (stable id order, matching
+        the cross-rank rename's two-lock ordering, so the two cannot
+        deadlock against each other)."""
+        locks = sorted((r.fs for r in self.ranks), key=id)
+        async with contextlib.AsyncExitStack() as stack:
+            for fs in locks:
+                await stack.enter_async_context(fs._mutate)
+            yield
+
+    async def snap_create(self, path: str, name: str) -> None:
+        async with self._all_ranks_barrier():
+            await self.ranks[0].fs._snap_create_locked(path, name)
+            for r in self.ranks:
+                r.fs.invalidate_snap_cache()
+
+    async def snap_delete(self, path: str, name: str) -> None:
+        async with self._all_ranks_barrier():
+            await self.ranks[0].fs._snap_delete_locked(path, name)
+            for r in self.ranks:
+                r.fs.invalidate_snap_cache()
+
+    # -- cross-rank rename intent log ----------------------------------------
+    # One log object per SOURCE rank ("mds<r>.rename_log"): an entry is
+    # persisted BEFORE either dentry half mutates and removed after both
+    # landed, so a crash between the two journal appends leaves a
+    # durable intent that reconciliation completes (the reference's
+    # EPeerUpdate prepare/commit pair in miniature).  All mutations of a
+    # rank's log happen while holding that rank's _mutate lock.
+
+    def _rename_log_oid(self, rank: int) -> str:
+        return f"mds{rank}.rename_log"
+
+    async def _load_rename_log(self, rank: int) -> List[Dict]:
+        try:
+            return json.loads(await self.meta.read(
+                self._rename_log_oid(rank)))
+        except RadosError as e:
+            import errno as _errno
+            if e.code != -_errno.ENOENT:
+                raise
+            return []
+
+    async def _save_rename_log(self, rank: int,
+                               entries: List[Dict]) -> None:
+        await self.meta.write_full(self._rename_log_oid(rank),
+                                   json.dumps(entries).encode())
+
+    async def _reconcile_renames(self, rank: int,
+                                 fs_override: Optional[FileSystem] = None
+                                 ) -> None:
+        """Complete (or discard) cross-rank renames whose source half
+        may not have landed.  If the destination dentry shows the
+        rename committed, the stale source dentry is removed — through
+        the SOURCE rank's own journal; dirfrags of other ranks are only
+        ever read."""
+        entries = await self._load_rename_log(rank)
+        if not entries:
+            return
+        fs_src = fs_override or self.ranks[rank].fs
+        for e in list(entries):
+            fs_dst = self.ranks[e["dst_rank"]].fs
+            ddentries = await fs_dst._load_dir(e["dparent"])
+            committed = bool(ddentries) and \
+                ddentries.get(e["dname"], {}).get("ino") == e["ino"]
+            if committed:
+                sdentries = await fs_src._load_dir(e["sparent"])
+                if sdentries is not None and \
+                        sdentries.get(e["sname"], {}).get("ino") == e["ino"]:
+                    ev = {"op": "rename", "events": [
+                        {"op": "rm_dentry", "parent": e["sparent"],
+                         "name": e["sname"]}]}
+                    await fs_src._journal(ev)
+                    await fs_src._apply_event(ev)
+                    await fs_src._journal_applied()
+            # not committed: the rename never happened — source stays
+            entries.remove(e)
+        await self._save_rename_log(rank, entries)
+
     # -- rank failure / replacement ------------------------------------------
 
     async def replace_rank(self, rank: int) -> MDSServer:
         """Stand up a replacement for a failed rank: a fresh server
         mounts the SAME per-rank journal and replays it (up:replay),
-        then serves.  Sessions/caps are gone — clients reconnect
-        (up:reconnect is client-driven here)."""
+        then completes any cross-rank rename whose source half the
+        crash cut short, then serves.  Sessions/caps are gone — clients
+        reconnect (up:reconnect is client-driven here)."""
         fs = FileSystem(self.meta, self.data, journal_prefix=f"mds{rank}.")
         await fs.mount()
+        async with fs._mutate:
+            await self._reconcile_renames(rank, fs_override=fs)
         self.ranks[rank] = MDSServer(fs, self.session_timeout)
         return self.ranks[rank]
 
@@ -293,17 +376,27 @@ class MDSCluster:
                 if ddentries.get(dname, {}).get("type") == "dir":
                     raise FsError(f"EISDIR: {dst_path}")
                 # each HALF is journaled at the rank that owns its
-                # dirfrag, destination first (set) then source (rm) — a
-                # crash between the two leaves both dentries briefly
-                # existing, never neither (same EUpdate metablob order
-                # as the single-rank rename), and each rank's replay
-                # touches ONLY its own dirfrags, so replaying one rank
-                # while the peer serves live traffic cannot race the
-                # peer's read-modify-writes
+                # dirfrag, destination first (set) then source (rm), so
+                # each rank's replay touches ONLY its own dirfrags and
+                # replaying one rank never races the live peer's
+                # read-modify-writes.  The durable INTENT goes to the
+                # source rank's rename log FIRST: a crash between the
+                # two halves leaves a record that reconciliation uses to
+                # finish the source removal — without it the stale
+                # source dentry would share the inode with the renamed
+                # file forever, and unlinking it would destroy the data.
+                intent = {"ino": ent.get("ino"), "sparent": sparent,
+                          "sname": sname, "dparent": dparent,
+                          "dname": dname, "dst_rank": r_dst}
+                log = await self._load_rename_log(r_src)
+                log.append(intent)
+                await self._save_rename_log(r_src, log)
                 dst_subs = [{"op": "set_dentry", "parent": dparent,
                              "name": dname, "dentry": ent}]
                 old = ddentries.get(dname)
-                if old and old.get("ino") and old["ino"] != ent.get("ino"):
+                if (old and old.get("ino") and old["ino"] != ent.get("ino")
+                        and old["ino"] not in fs_dst._snap_inos(
+                            await fs_dst._load_snaptable(use_cache=True))):
                     dst_subs.append({"op": "drop_ino", "ino": old["ino"]})
                 dst_event = {"op": "rename", "events": dst_subs}
                 src_event = {"op": "rename", "events": [
@@ -315,6 +408,9 @@ class MDSCluster:
                 await fs_src._journal(src_event)
                 await fs_src._apply_event(src_event)
                 await fs_src._journal_applied()
+                log = [e for e in await self._load_rename_log(r_src)
+                       if e != intent]
+                await self._save_rename_log(r_src, log)
 
 
 class CephFSMultiClient:
@@ -430,6 +526,37 @@ class CephFSMultiClient:
                     raise
                 await self.renew_all()
                 await asyncio.sleep(delay)
+
+    # -- snapshots: every snap-table mutation routes through rank 0 (the
+    # reference's snapserver runs on rank 0) UNDER AN ALL-RANKS BARRIER,
+    # so no rank can decide a drop_old_ino against a table the snapshot
+    # is about to change (and the walk is point-in-time, not fuzzy) ---------
+
+    async def snap_create(self, path: str, name: str) -> None:
+        p = _norm(path)
+        # flush EVERY per-rank client's write-behind under the subtree
+        # THROUGH THE ROUTER (handoff + frozen retry): bytes staged at a
+        # stale authority must not be flushed through it
+        for c in list(self._clients.values()):
+            for dirty in list(c._dirty):
+                if _is_under(dirty, p):
+                    await self._routed(dirty, "fsync")
+        await self.cluster.snap_create(p, name)
+
+    async def snap_delete(self, path: str, name: str) -> None:
+        await self.cluster.snap_delete(path, name)
+
+    async def snap_list(self, path: str) -> List[str]:
+        return await self.cluster.ranks[0].fs.snap_list(path)
+
+    async def read_snap(self, path: str, name: str, rel: str) -> bytes:
+        return await self.cluster.ranks[0].fs.read_snap_file(
+            path, name, rel)
+
+    async def listdir_snap(self, path: str, name: str,
+                           rel: str = "") -> List[str]:
+        return await self.cluster.ranks[0].fs.listdir_snap(
+            path, name, rel)
 
     async def unmount(self) -> None:
         for c in self._clients.values():

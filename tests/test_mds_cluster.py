@@ -305,6 +305,78 @@ class TestExportReplaySafety:
         run(go())
 
 
+class TestCrossRenameCrashRecovery:
+    def test_intent_log_completes_interrupted_rename(self):
+        """Crash between the destination and source journal halves: the
+        persisted intent makes reconciliation remove the stale source
+        dentry instead of leaving two dentries sharing one inode."""
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=2).start()
+                fsc = CephFSMultiClient(mc)
+                await fsc.mkdir("/a")
+                await fsc.mkdir("/b")
+                await mc.export_dir("/b", 1)
+                await fsc.write("/a/src", b"payload")
+                await fsc.fsync("/a/src")
+                await fsc.unmount()
+                # simulate the crash window BY HAND: intent persisted,
+                # destination half applied, source half never ran
+                fs0, fs1 = mc.ranks[0].fs, mc.ranks[1].fs
+                ent = (await fs0._load_dir("/a"))["src"]
+                await mc._save_rename_log(0, [{
+                    "ino": ent["ino"], "sparent": "/a", "sname": "src",
+                    "dparent": "/b", "dname": "dst", "dst_rank": 1}])
+                ev = {"op": "rename", "events": [
+                    {"op": "set_dentry", "parent": "/b", "name": "dst",
+                     "dentry": ent}]}
+                await fs1._journal(ev)
+                await fs1._apply_event(ev)
+                # "restart": a new cluster start() reconciles
+                mc2 = await MDSCluster(io, n_ranks=2).start()
+                fsc2 = CephFSMultiClient(mc2)
+                assert await fsc2.read("/b/dst") == b"payload"
+                assert "src" not in await fsc2.listdir("/a")
+                assert await mc2._load_rename_log(0) == []
+                # unlinking anything stale can no longer destroy data
+                await fsc2.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_uncommitted_intent_is_discarded(self):
+        """Intent persisted but destination half never landed: the
+        source file stays; the log entry is dropped."""
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=2).start()
+                fsc = CephFSMultiClient(mc)
+                await fsc.mkdir("/a")
+                await fsc.mkdir("/b")
+                await mc.export_dir("/b", 1)
+                await fsc.write("/a/src", b"stay")
+                await fsc.fsync("/a/src")
+                await fsc.unmount()
+                ent = (await mc.ranks[0].fs._load_dir("/a"))["src"]
+                await mc._save_rename_log(0, [{
+                    "ino": ent["ino"], "sparent": "/a", "sname": "src",
+                    "dparent": "/b", "dname": "dst", "dst_rank": 1}])
+                mc2 = await MDSCluster(io, n_ranks=2).start()
+                fsc2 = CephFSMultiClient(mc2)
+                assert await fsc2.read("/a/src") == b"stay"
+                with pytest.raises(FsError):
+                    await fsc2.read("/b/dst")
+                assert await mc2._load_rename_log(0) == []
+                await fsc2.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+
 class TestRenameCacheCoherence:
     def test_stale_dst_writeback_cannot_clobber_rename(self):
         """Write-behind bytes staged for the DESTINATION before a rename

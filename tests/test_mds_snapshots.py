@@ -1,0 +1,219 @@
+"""CephFS snapshots: COW via the fresh-inode-per-write discipline, a
+rank-0-owned snap table, and pinned-inode liveness (reference
+src/mds/SnapServer.cc, SnapRealm semantics)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.rados.client import RadosError
+from ceph_tpu.rados.librados import Rados
+from ceph_tpu.rados.vstart import Cluster
+from ceph_tpu.services.mds import CephFSClient, FileSystem, FsError, MDSServer
+from ceph_tpu.services.mds_cluster import CephFSMultiClient, MDSCluster
+
+CONF = {"osd_auto_repair": False}
+EC_PROFILE = {"plugin": "jerasure", "technique": "reed_sol_van",
+              "k": "2", "m": "1"}
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _fs(pool="snapfs"):
+    cluster = Cluster(n_osds=4, conf=dict(CONF))
+    await cluster.start()
+    rados = await Rados(cluster.mon_addrs, CONF).connect()
+    await rados.pool_create(pool, profile=EC_PROFILE)
+    io = await rados.open_ioctx(pool)
+    fs = FileSystem(io)
+    await fs.mkfs()
+    await fs.mount()
+    return cluster, rados, fs
+
+
+class TestSnapshotCore:
+    def test_snapshot_preserves_bytes_across_overwrite_and_unlink(self):
+        async def go():
+            cluster, rados, fs = await _fs()
+            try:
+                await fs.mkdir("/d")
+                await fs.write_file("/d/a", b"v1")
+                await fs.write_file("/d/b", b"keep")
+                await fs.snap_create("/d", "s1")
+                # overwrite and unlink AFTER the snapshot
+                await fs.write_file("/d/a", b"v2")
+                await fs.unlink("/d/b")
+                assert await fs.read_file("/d/a") == b"v2"
+                with pytest.raises(FsError):
+                    await fs.read_file("/d/b")
+                # the snapshot still serves the old bytes (COW pinning)
+                assert await fs.read_snap_file("/d", "s1", "a") == b"v1"
+                assert await fs.read_snap_file("/d", "s1", "b") == b"keep"
+                assert await fs.listdir_snap("/d", "s1") == ["a", "b"]
+                assert await fs.snap_list("/d") == ["s1"]
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_snap_delete_reclaims_only_unpinned_inos(self):
+        async def go():
+            cluster, rados, fs = await _fs()
+            try:
+                await fs.mkdir("/d")
+                await fs.write_file("/d/f", b"gen1")
+                await fs.snap_create("/d", "s1")
+                await fs.write_file("/d/f", b"gen2")
+                await fs.snap_create("/d", "s2")  # pins gen2's ino (live)
+                await fs.write_file("/d/f", b"gen3")
+                # delete s1: gen1's ino is reclaimable; s2 still serves
+                await fs.snap_delete("/d", "s1")
+                assert await fs.snap_list("/d") == ["s2"]
+                assert await fs.read_snap_file("/d", "s2", "f") == b"gen2"
+                assert await fs.read_file("/d/f") == b"gen3"
+                # delete s2: gen2 reclaimed, live file untouched
+                await fs.snap_delete("/d", "s2")
+                assert await fs.read_file("/d/f") == b"gen3"
+                with pytest.raises(FsError):
+                    await fs.read_snap_file("/d", "s2", "f")
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_snapshot_survives_crash_replay(self):
+        """snap_create is journaled: a standby that replays the journal
+        serves the snapshot (and its pinned bytes)."""
+        async def go():
+            cluster, rados, fs = await _fs()
+            try:
+                await fs.mkdir("/d")
+                await fs.write_file("/d/f", b"old")
+                await fs.snap_create("/d", "s")
+                await fs.write_file("/d/f", b"new")
+                standby = FileSystem(fs.meta, fs.data)
+                await standby.mount()
+                assert await standby.read_snap_file("/d", "s", "f") == b"old"
+                assert await standby.read_file("/d/f") == b"new"
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_nested_tree_and_bad_names(self):
+        async def go():
+            cluster, rados, fs = await _fs()
+            try:
+                await fs.mkdir("/d")
+                await fs.mkdir("/d/sub")
+                await fs.write_file("/d/sub/deep", b"x")
+                await fs.snap_create("/d", "s")
+                assert await fs.listdir_snap("/d", "s") == ["sub"]
+                assert await fs.listdir_snap("/d", "s", "sub") == ["deep"]
+                assert await fs.read_snap_file("/d", "s", "sub/deep") == b"x"
+                with pytest.raises(FsError):
+                    await fs.snap_create("/d", "a|b")
+                with pytest.raises(FsError):
+                    await fs.snap_create("/d", "s")  # EEXIST
+                with pytest.raises(FsError):
+                    await fs.snap_create("/nope", "s")
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+
+class TestSnapDeleteLiveness:
+    def test_snap_delete_spares_renamed_live_file(self):
+        """A file renamed since the snapshot keeps its inode live; the
+        snap delete must not reclaim it (liveness is namespace-wide,
+        not snapshot-path)."""
+        async def go():
+            cluster, rados, fs = await _fs()
+            try:
+                await fs.mkdir("/d")
+                await fs.mkdir("/elsewhere")
+                await fs.write_file("/d/f", b"payload")
+                await fs.snap_create("/d", "s")
+                # move OUT of the snapped subtree; inode unchanged
+                await fs.rename("/d/f", "/elsewhere/g")
+                await fs.snap_delete("/d", "s")
+                assert await fs.read_file("/elsewhere/g") == b"payload"
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_listdir_snap_on_file_is_enotdir(self):
+        async def go():
+            cluster, rados, fs = await _fs()
+            try:
+                await fs.mkdir("/d")
+                await fs.write_file("/d/f", b"x")
+                await fs.snap_create("/d", "s")
+                with pytest.raises(FsError) as ei:
+                    await fs.listdir_snap("/d", "s", "f")
+                assert "ENOTDIR" in str(ei.value)
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+
+class TestSnapshotsThroughClient:
+    def test_client_flushes_writeback_into_snapshot(self):
+        """Dirty write-behind bytes must be captured by snap_create."""
+        async def go():
+            cluster, rados, fs = await _fs()
+            try:
+                mds = MDSServer(fs)
+                c = CephFSClient(mds, "writer", renew_interval=0.01)
+                await c.mkdir("/d")
+                await c.write("/d/f", b"behind")  # stays in client cache
+                await c.snap_create("/d", "snap")
+                await c.write("/d/f", b"after")
+                await c.fsync("/d/f")
+                assert await c.read_snap("/d", "snap", "f") == b"behind"
+                assert await c.read("/d/f") == b"after"
+                assert await c.snap_list("/d") == ["snap"]
+                await c.snap_delete("/d", "snap")
+                assert await c.snap_list("/d") == []
+                await c.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+
+class TestSnapshotsMultiRank:
+    def test_snap_across_subtree_authorities(self):
+        """Snap of a subtree owned by rank 1, table mutation at rank 0
+        (the snapserver seat); write-behind at rank 1 is captured."""
+        async def go():
+            cluster = Cluster(n_osds=4, conf=dict(CONF))
+            await cluster.start()
+            rados = await Rados(cluster.mon_addrs, CONF).connect()
+            await rados.pool_create("snapmc", profile=EC_PROFILE)
+            io = await rados.open_ioctx("snapmc")
+            try:
+                mc = await MDSCluster(io, n_ranks=2).start()
+                fsc = CephFSMultiClient(mc, renew_interval=0.01)
+                await fsc.mkdir("/proj")
+                await mc.export_dir("/proj", 1)
+                await fsc.write("/proj/f", b"r1-bytes")  # dirty at rank 1
+                await fsc.snap_create("/proj", "s")
+                await fsc.write("/proj/f", b"changed")
+                await fsc.fsync("/proj/f")
+                assert await fsc.read_snap("/proj", "s", "f") == b"r1-bytes"
+                assert await fsc.read("/proj/f") == b"changed"
+                assert await fsc.snap_list("/proj") == ["s"]
+                # snap table replays with rank 0 (its owner)
+                await mc.replace_rank(0)
+                assert await fsc.read_snap("/proj", "s", "f") == b"r1-bytes"
+                await fsc.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
