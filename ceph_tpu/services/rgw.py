@@ -80,8 +80,143 @@ class RgwService:
                  credentials: Optional[Dict[str, str]] = None):
         self.ioctx = ioctx
         self.striper = RadosStriper(ioctx, object_size=chunk_size)
-        # access_key -> secret_key; empty = anonymous gateway
+        # access_key -> secret_key; empty = anonymous gateway.  The
+        # ctor dict seeds static credentials; managed users (RgwAdmin)
+        # are merged in by load_users().
         self.credentials = dict(credentials or {})
+        self._static_credentials = dict(credentials or {})
+        self.users: Dict[str, Dict] = {}  # uid -> user record
+        self._users_loaded_at = 0.0
+        # user-record staleness bound on a RUNNING gateway: admin
+        # changes from another process (suspend, quota enable) take
+        # effect within this window without a restart
+        self.users_refresh_ttl = 2.0
+        # usage figures are cached per principal/bucket for this long:
+        # quota enforcement is deliberately approximate within the
+        # window (the reference's RGWQuotaCache makes the same trade)
+        self.usage_cache_ttl = 2.0
+        self._usage_cache: Dict[str, Tuple[float, Dict[str, int]]] = {}
+        self._bucket_usage_cache: Dict[str, Tuple[float,
+                                                  Tuple[int, int]]] = {}
+
+    # -- users / quotas (reference rgw_user.cc, RGWQuotaHandler) -------------
+
+    USERS_OID = ".rgw.users"
+
+    async def load_users(self) -> None:
+        """Load the persisted user store and rebuild the credential
+        map (static ctor credentials + every active managed user)."""
+        try:
+            self.users = json.loads(await self.ioctx.read(self.USERS_OID))
+        except RadosError as e:
+            if e.code != -errno.ENOENT:
+                raise
+            self.users = {}
+        creds = dict(self._static_credentials)
+        for u in self.users.values():
+            creds[u["access_key"]] = u["secret_key"]
+        self.credentials = creds
+        self._users_loaded_at = time.monotonic()
+
+    async def maybe_reload_users(self) -> None:
+        """TTL-bounded refresh of the user store, so a live gateway
+        honors out-of-process admin changes (suspend/quota) without a
+        restart."""
+        if time.monotonic() - self._users_loaded_at > self.users_refresh_ttl:
+            await self.load_users()
+
+    def user_by_access(self, access_key: Optional[str]) -> Optional[Dict]:
+        if access_key is None:
+            return None
+        for u in self.users.values():
+            if u.get("access_key") == access_key:
+                return u
+        return None
+
+    async def bucket_usage(self, bucket: str,
+                           use_cache: bool = False) -> Tuple[int, int]:
+        """(bytes, objects) currently indexed in the bucket — versions
+        and multipart manifests count every stored generation."""
+        if use_cache:
+            hit = self._bucket_usage_cache.get(bucket)
+            if hit and time.monotonic() - hit[0] < self.usage_cache_ttl:
+                return hit[1]
+        index = await self._load_index(bucket)
+        size = objects = 0
+        for entry in (index or {}).values():
+            if isinstance(entry, dict) and "versions" in entry:
+                live = [v for v in entry["versions"]
+                        if not v.get("delete_marker")]
+                size += sum(int(v.get("size", 0)) for v in live)
+                objects += 1 if live else 0
+            elif isinstance(entry, dict):
+                size += int(entry.get("size", 0))
+                objects += 1
+        self._bucket_usage_cache[bucket] = (time.monotonic(),
+                                            (size, objects))
+        while len(self._bucket_usage_cache) > 4096:
+            self._bucket_usage_cache.pop(
+                next(iter(self._bucket_usage_cache)))
+        return size, objects
+
+    async def usage(self, access_key: str,
+                    use_cache: bool = False) -> Dict[str, int]:
+        """Aggregate usage over every bucket the principal owns
+        (radosgw-admin usage role)."""
+        if use_cache:
+            hit = self._usage_cache.get(access_key)
+            if hit and time.monotonic() - hit[0] < self.usage_cache_ttl:
+                return hit[1]
+        total_size = total_objects = buckets = 0
+        for bucket in await self.list_buckets():
+            meta = await self.get_bucket_meta(bucket)
+            if meta.get("owner") != access_key:
+                continue
+            s, o = await self.bucket_usage(bucket, use_cache=use_cache)
+            total_size += s
+            total_objects += o
+            buckets += 1
+        out = {"size": total_size, "objects": total_objects,
+               "buckets": buckets}
+        self._usage_cache[access_key] = (time.monotonic(), out)
+        while len(self._usage_cache) > 4096:
+            self._usage_cache.pop(next(iter(self._usage_cache)))
+        return out
+
+    @staticmethod
+    def _quota_violated(quota: Optional[Dict], size: int, objects: int,
+                        add_bytes: int, add_objects: int) -> bool:
+        if not quota or not quota.get("enabled"):
+            return False
+        max_size = int(quota.get("max_size", -1))
+        max_objects = int(quota.get("max_objects", -1))
+        if max_size >= 0 and size + add_bytes > max_size:
+            return True
+        if max_objects >= 0 and objects + add_objects > max_objects:
+            return True
+        return False
+
+    async def check_quota(self, access_key: Optional[str], bucket: str,
+                          add_bytes: int, add_objects: int = 1) -> None:
+        """Raise QuotaExceeded (EDQUOT) if the write would break the
+        principal's user quota or the bucket quota (reference
+        RGWQuotaHandler::check_quota, consulted pre-exec)."""
+        user = self.user_by_access(access_key)
+        if user is None:
+            return
+        bq = user.get("bucket_quota")
+        uq = user.get("quota")
+        if bq and bq.get("enabled"):
+            s, o = await self.bucket_usage(bucket, use_cache=True)
+            if self._quota_violated(bq, s, o, add_bytes, add_objects):
+                raise RadosError("QuotaExceeded: bucket quota",
+                                 code=-errno.EDQUOT)
+        if uq and uq.get("enabled"):
+            u = await self.usage(access_key, use_cache=True)
+            if self._quota_violated(uq, u["size"], u["objects"],
+                                    add_bytes, add_objects):
+                raise RadosError("QuotaExceeded: user quota",
+                                 code=-errno.EDQUOT)
 
     @staticmethod
     def _index_oid(bucket: str) -> str:
@@ -111,6 +246,12 @@ class RgwService:
         whole-object rewrite is O(window) per mutation; the reference
         shards its datalog — acceptable at this gateway's scale, noted
         as the next step if the log becomes hot."""
+        # any mutation invalidates the usage caches FIRST (before the
+        # sync-agent suppression — replicated applies change usage too),
+        # so this gateway's own quota checks never see their own writes
+        # stale; cross-gateway writes are bounded by usage_cache_ttl
+        self._bucket_usage_cache.pop(bucket, None)
+        self._usage_cache.clear()
         if _DATALOG_SUPPRESS.get():
             return
         lock = getattr(self, "_datalog_lock", None)
@@ -351,13 +492,16 @@ class RgwService:
                     break
         return expired
 
-    async def create_bucket(self, bucket: str) -> None:
+    async def create_bucket(self, bucket: str,
+                            owner: Optional[str] = None) -> None:
+        created = False
         made = await self._idx_cls(bucket, "bucket_init", {})
         if made is not None:
             ret, _ = made
             if ret not in (0, -17):  # -EEXIST: already created, idempotent
                 raise RadosError(f"bucket_init failed ({ret})", code=ret)
             if ret == 0:
+                created = True
                 try:
                     await self.ioctx.execute(
                         BUCKETS_ROOT, "rgw", "registry_add",
@@ -366,8 +510,8 @@ class RgwService:
                     if e.code != -errno.EOPNOTSUPP:
                         raise
                 await self._log_mutation("create_bucket", bucket)
-            return
-        if await self._load_index(bucket) is None:
+        elif await self._load_index(bucket) is None:
+            created = True
             await self._save_index(bucket, {})
             buckets = await self.list_buckets()
             if bucket not in buckets:
@@ -375,6 +519,12 @@ class RgwService:
                 await self.ioctx.write_full(
                     BUCKETS_ROOT, json.dumps(sorted(buckets)).encode())
             await self._log_mutation("create_bucket", bucket)
+        if created and owner is not None:
+            # bucket ownership (reference rgw_bucket owner field): the
+            # creating principal's uid keys quota/usage accounting
+            meta = await self.get_bucket_meta(bucket)
+            meta["owner"] = owner
+            await self._save_bucket_meta(bucket, meta)
 
     async def list_buckets(self) -> List[str]:
         try:
@@ -785,9 +935,13 @@ class RgwService:
         return etag
 
     async def complete_multipart(self, bucket: str, upload_id: str,
-                                 parts: Optional[List[int]] = None) -> str:
+                                 parts: Optional[List[int]] = None,
+                                 principal: Optional[str] = None) -> str:
         """Assemble the object from its parts; the bucket index entry
-        becomes a manifest referencing the part objects in order."""
+        becomes a manifest referencing the part objects in order.  With
+        a `principal`, the assembled size (the SELECTED parts only) is
+        quota-checked before anything mutates (reference checks at
+        completion too)."""
         meta = await self._load_upload(bucket, upload_id)
         index = await self._load_index(bucket)
         if index is None:
@@ -796,6 +950,10 @@ class RgwService:
         order = sorted(have) if parts is None else list(parts)
         if not order or any(n not in have for n in order):
             raise RadosError("InvalidPart: upload has missing parts")
+        if principal is not None:
+            await self.check_quota(
+                principal, bucket,
+                sum(int(have[n].get("size", 0)) for n in order))
         key = meta["key"]
         manifest = [have[n] for n in order]
         # S3 multipart etag convention: md5 of concatenated part md5s
@@ -858,6 +1016,113 @@ class RgwService:
 
 
 # -- SigV4 (reference rgw_auth; AWS Signature Version 4) --------------------
+
+
+class RgwAdmin:
+    """radosgw-admin role (reference src/rgw/rgw_admin.cc, rgw_user.cc):
+    managed-user lifecycle, quotas, and usage over a gateway's user
+    store.  Users persist in the pool, so a restarted gateway serves
+    the same principals."""
+
+    def __init__(self, service: RgwService):
+        self.service = service
+
+    async def _load(self) -> Dict[str, Dict]:
+        await self.service.load_users()
+        return self.service.users
+
+    async def _save(self, users: Dict[str, Dict]) -> None:
+        await self.service.ioctx.write_full(
+            self.service.USERS_OID, json.dumps(users).encode())
+        await self.service.load_users()
+
+    async def user_create(self, uid: str, display_name: str = "",
+                          access_key: Optional[str] = None,
+                          secret_key: Optional[str] = None) -> Dict:
+        users = await self._load()
+        if uid in users:
+            raise RadosError(f"UserAlreadyExists: {uid}",
+                             code=-errno.EEXIST)
+        user = {
+            "uid": uid,
+            "display_name": display_name or uid,
+            "access_key": access_key or uuid.uuid4().hex[:20].upper(),
+            "secret_key": secret_key or uuid.uuid4().hex,
+            "suspended": False,
+            "quota": None,          # user-scope quota
+            "bucket_quota": None,   # per-bucket quota
+        }
+        users[uid] = user
+        await self._save(users)
+        return dict(user)
+
+    async def user_rm(self, uid: str) -> None:
+        users = await self._load()
+        if uid not in users:
+            raise RadosError(f"NoSuchUser: {uid}", code=-errno.ENOENT)
+        del users[uid]
+        await self._save(users)
+
+    async def user_info(self, uid: str) -> Dict:
+        users = await self._load()
+        if uid not in users:
+            raise RadosError(f"NoSuchUser: {uid}", code=-errno.ENOENT)
+        return dict(users[uid])
+
+    async def user_list(self) -> List[str]:
+        return sorted(await self._load())
+
+    async def _set_suspended(self, uid: str, suspended: bool) -> None:
+        users = await self._load()
+        if uid not in users:
+            raise RadosError(f"NoSuchUser: {uid}", code=-errno.ENOENT)
+        users[uid]["suspended"] = suspended
+        await self._save(users)
+
+    async def user_suspend(self, uid: str) -> None:
+        await self._set_suspended(uid, True)
+
+    async def user_enable(self, uid: str) -> None:
+        await self._set_suspended(uid, False)
+
+    async def quota_set(self, uid: str, scope: str = "user",
+                        max_size: int = -1,
+                        max_objects: int = -1) -> None:
+        """-1 = unlimited on that axis (reference quota semantics);
+        setting leaves the quota disabled until quota_enable."""
+        if scope not in ("user", "bucket"):
+            raise RadosError(f"InvalidArgument: scope {scope!r}",
+                             code=-errno.EINVAL)
+        users = await self._load()
+        if uid not in users:
+            raise RadosError(f"NoSuchUser: {uid}", code=-errno.ENOENT)
+        field = "quota" if scope == "user" else "bucket_quota"
+        prev = users[uid].get(field) or {}
+        users[uid][field] = {"enabled": bool(prev.get("enabled")),
+                             "max_size": int(max_size),
+                             "max_objects": int(max_objects)}
+        await self._save(users)
+
+    async def _quota_toggle(self, uid: str, scope: str,
+                            enabled: bool) -> None:
+        users = await self._load()
+        if uid not in users:
+            raise RadosError(f"NoSuchUser: {uid}", code=-errno.ENOENT)
+        field = "quota" if scope == "user" else "bucket_quota"
+        q = users[uid].get(field) or {"max_size": -1, "max_objects": -1}
+        q["enabled"] = enabled
+        users[uid][field] = q
+        await self._save(users)
+
+    async def quota_enable(self, uid: str, scope: str = "user") -> None:
+        await self._quota_toggle(uid, scope, True)
+
+    async def quota_disable(self, uid: str, scope: str = "user") -> None:
+        await self._quota_toggle(uid, scope, False)
+
+    async def usage(self, uid: str) -> Dict[str, int]:
+        user = await self.user_info(uid)
+        return await self.service.usage(user["access_key"])
 
 
 def _access_key_of(headers: Dict[str, str]) -> Optional[str]:
@@ -969,6 +1234,7 @@ class RgwFrontend:
         self.swift_token_ttl = 3600.0
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
+        await self.service.load_users()  # managed principals + statics
         self._server = await asyncio.start_server(self._serve, host, port)
         self.addr = self._server.sockets[0].getsockname()[:2]
         return self.addr
@@ -1012,6 +1278,9 @@ class RgwFrontend:
                 url = urlsplit(target)
                 path, query = unquote(url.path), url.query
                 extra: Dict[str, str] = {}
+                # TTL-bounded user-store refresh: out-of-process admin
+                # changes (suspend, quota) bite live gateways
+                await self.service.maybe_reload_users()
                 if path == "/auth/v1.0" or path.startswith("/v1/"):
                     status, payload, extra = await self._route_swift(
                         method, path, query, body, headers)
@@ -1024,8 +1293,13 @@ class RgwFrontend:
                     # the ACL principal: the SigV4 access key that signed
                     # the request; anonymous (None) without credentials
                     principal = _access_key_of(headers)
-                    status, payload = await self._route(method, path, query,
-                                                        body, principal)
+                    user = self.service.user_by_access(principal)
+                    if user is not None and user.get("suspended"):
+                        status, payload = ("403 Forbidden",
+                                           b"UserSuspended")
+                    else:
+                        status, payload = await self._route(
+                            method, path, query, body, principal)
                 hdr_lines = "".join(f"{k}: {v}\r\n" for k, v in extra.items())
                 writer.write(
                     f"HTTP/1.1 {status}\r\nContent-Length: {len(payload)}\r\n"
@@ -1054,6 +1328,10 @@ class RgwFrontend:
                 or self.service.credentials.get(acct)
             if want is None or not hmac.compare_digest(want, key):
                 return "401 Unauthorized", b"", {}
+            managed = (self.service.user_by_access(user)
+                       or self.service.user_by_access(acct))
+            if managed is not None and managed.get("suspended"):
+                return "403 Forbidden", b"UserSuspended", {}
             now = time.monotonic()
             for t, (_a, issued) in list(self._swift_tokens.items()):
                 if now - issued > self.swift_token_ttl:
@@ -1076,6 +1354,11 @@ class RgwFrontend:
                 self._swift_tokens.pop(token, None)
                 return "401 Unauthorized", b"", {}
             principal = entry[0]  # the token's account, for ACL checks
+            managed = self.service.user_by_access(principal)
+            if managed is not None and managed.get("suspended"):
+                # suspension after token issue still bites (reference:
+                # every op re-checks the user record)
+                return "403 Forbidden", b"UserSuspended", {}
         parts = [p for p in path.split("/") if p]
         # parts = ["v1", "AUTH_acct", container?, object...]
         if len(parts) < 2 or not parts[1].startswith("AUTH_"):
@@ -1100,7 +1383,8 @@ class RgwFrontend:
             container = parts[2]
             if len(parts) == 3:
                 if method == "PUT":
-                    await self.service.create_bucket(container)
+                    await self.service.create_bucket(container,
+                                                     owner=principal)
                     return "201 Created", b"", {}
                 if method in ("GET", "HEAD"):
                     index = await self.service.list_objects(container)
@@ -1116,6 +1400,9 @@ class RgwFrontend:
                 return "405 Method Not Allowed", b"", {}
             key = "/".join(parts[3:])
             if method == "PUT":
+                # quotas bind both dialects (one store behind them)
+                await self.service.check_quota(principal, container,
+                                               len(body))
                 await self.service.put_object(container, key, body)
                 etag = hashlib.md5(body).hexdigest()
                 return "201 Created", b"", {"ETag": etag}
@@ -1139,6 +1426,8 @@ class RgwFrontend:
                 return "404 Not Found", msg.encode(), {}
             if "BucketNotEmpty" in msg:
                 return "409 Conflict", msg.encode(), {}
+            if "QuotaExceeded" in msg:
+                return "403 Forbidden", msg.encode(), {}
             return "500 Internal Server Error", msg.encode(), {}
 
     async def _route(self, method: str, path: str, query: str,
@@ -1244,7 +1533,8 @@ class RgwFrontend:
                         await self.service.list_object_versions(
                             bucket)).encode()
                 if method == "PUT":
-                    await self.service.create_bucket(bucket)
+                    await self.service.create_bucket(bucket,
+                                                     owner=principal)
                     return "200 OK", b""
                 if method == "GET":
                     return "200 OK", json.dumps(
@@ -1265,13 +1555,19 @@ class RgwFrontend:
                     except (ValueError, KeyError, TypeError):
                         return "400 Bad Request", b"MalformedXML"
                 etag = await self.service.complete_multipart(
-                    bucket, q["uploadId"], order)
+                    bucket, q["uploadId"], order, principal=principal)
                 return "200 OK", json.dumps({"ETag": etag}).encode()
             if method == "PUT" and "uploadId" in q and "partNumber" in q:
                 try:
                     part = int(q["partNumber"])
                 except ValueError:
                     return "400 Bad Request", b"InvalidArgument: partNumber"
+                # staged parts are quota-charged too (against indexed
+                # usage — a bound, not exact accounting), or a capped
+                # user could park unlimited bytes in never-completed
+                # uploads
+                await self.service.check_quota(principal, bucket,
+                                               len(body), add_objects=0)
                 etag = await self.service.upload_part(
                     bucket, q["uploadId"], part, body)
                 return "200 OK", json.dumps({"ETag": etag}).encode()
@@ -1279,6 +1575,8 @@ class RgwFrontend:
                 await self.service.abort_multipart(bucket, q["uploadId"])
                 return "204 No Content", b""
             if method == "PUT":
+                await self.service.check_quota(principal, bucket,
+                                               len(body))
                 vid = await self.service.put_object(bucket, key, body,
                                                     bmeta=gate_meta)
                 return "200 OK", (json.dumps({"VersionId": vid}).encode()
@@ -1308,6 +1606,8 @@ class RgwFrontend:
                 return "400 Bad Request", msg.encode()
             if "MethodNotAllowed" in msg:
                 return "405 Method Not Allowed", msg.encode()
+            if "QuotaExceeded" in msg:
+                return "403 Forbidden", msg.encode()
             return "500 Internal Server Error", msg.encode()
 
 
