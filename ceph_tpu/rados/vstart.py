@@ -135,6 +135,49 @@ class Cluster:
             await mon.stop()
 
 
+def _write_addr_file(path: str, cluster: Cluster, n_osds: int) -> None:
+    """Machine-readable endpoint dump for the deploy tool (cephadm
+    bootstrap polls this to learn the mon quorum; the orchestrator
+    re-reads it after reconciliation)."""
+    import json as _json
+    import os as _os
+
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        _json.dump({"mons": [list(a) for a in cluster.mon_addrs],
+                    "osds": n_osds, "pid": _os.getpid()}, f)
+    _os.replace(tmp, path)
+
+
+async def _reconcile(cluster: Cluster, control_file: str,
+                     addr_file: Optional[str]) -> None:
+    """Orchestrator reconciliation (reference mgr/cephadm serve loop):
+    converge the live daemon set to the spec in the control file —
+    `cephadm orch apply` writes {"target_osds": N}, this loop adds or
+    stops OSDs until reality matches, then republishes the addr file."""
+    import json as _json
+
+    try:
+        with open(control_file) as f:
+            spec = _json.load(f)
+    except (OSError, ValueError):
+        return
+    target = int(spec.get("target_osds", -1))
+    if target < 0:
+        return
+    changed = False
+    while len(cluster.osds) < target:
+        await cluster.add_osd()
+        changed = True
+    while len(cluster.osds) > max(target, 1):
+        # scale-down drains the HIGHEST id first (deterministic,
+        # mirrors `ceph orch apply osd` converging by removal)
+        await cluster.kill_osd(max(cluster.osds))
+        changed = True
+    if changed and addr_file:
+        _write_addr_file(addr_file, cluster, len(cluster.osds))
+
+
 async def _main(args) -> None:
     cluster = Cluster(n_osds=args.osds, data_dir=args.data_dir,
                       n_mons=args.mons, with_mgr=args.mgr)
@@ -143,22 +186,17 @@ async def _main(args) -> None:
           + ("Ctrl-C to stop." if args.run_for <= 0
              else f"Running {args.run_for}s."), flush=True)
     if args.addr_file:
-        # machine-readable endpoint dump for the deploy tool (cephadm
-        # bootstrap polls this file to learn the mon quorum)
-        import json as _json
-        import os as _os
-
-        tmp = args.addr_file + ".tmp"
-        with open(tmp, "w") as f:
-            _json.dump({"mons": [list(a) for a in cluster.mon_addrs],
-                        "osds": args.osds, "pid": _os.getpid()}, f)
-        _os.replace(tmp, args.addr_file)
+        _write_addr_file(args.addr_file, cluster, args.osds)
     try:
-        if args.run_for > 0:
-            await asyncio.sleep(args.run_for)
-        else:
-            while True:
-                await asyncio.sleep(3600)
+        import time as _time
+
+        deadline = (_time.monotonic() + args.run_for
+                    if args.run_for > 0 else None)
+        while deadline is None or _time.monotonic() < deadline:
+            await asyncio.sleep(1.0)
+            if args.control_file:
+                await _reconcile(cluster, args.control_file,
+                                 args.addr_file)
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
@@ -176,4 +214,7 @@ if __name__ == "__main__":
                    help="start a mgr daemon (balancer/autoscaler/metrics)")
     p.add_argument("--addr-file", default=None,
                    help="write the mon quorum addresses here once up")
+    p.add_argument("--control-file", default=None,
+                   help="poll this spec file and converge daemons to it "
+                        "(orchestrator reconciliation)")
     asyncio.run(_main(p.parse_args()))

@@ -98,6 +98,7 @@ class RgwService:
         self._usage_cache: Dict[str, Tuple[float, Dict[str, int]]] = {}
         self._bucket_usage_cache: Dict[str, Tuple[float,
                                                   Tuple[int, int]]] = {}
+        self._owner_cache: Dict[str, Optional[str]] = {}  # bucket -> owner
 
     # -- users / quotas (reference rgw_user.cc, RGWQuotaHandler) -------------
 
@@ -133,10 +134,24 @@ class RgwService:
                 return u
         return None
 
+    def _invalidate_usage(self, bucket: str) -> None:
+        """Drop the usage figures a mutation on `bucket` staled: the
+        bucket's own entry, and the owning principal's aggregate (owner
+        known from the meta-read cache; unknown owner falls back to a
+        full clear, the safe direction)."""
+        self._bucket_usage_cache.pop(bucket, None)
+        owner = self._owner_cache.get(bucket)
+        if owner is not None:
+            self._usage_cache.pop(owner, None)
+        else:
+            self._usage_cache.clear()
+
     async def bucket_usage(self, bucket: str,
                            use_cache: bool = False) -> Tuple[int, int]:
         """(bytes, objects) currently indexed in the bucket — versions
-        and multipart manifests count every stored generation."""
+        and multipart manifests count every stored generation, and
+        STAGED multipart parts count toward bytes (or a capped user
+        could park unbounded data in never-completed uploads)."""
         if use_cache:
             hit = self._bucket_usage_cache.get(bucket)
             if hit and time.monotonic() - hit[0] < self.usage_cache_ttl:
@@ -152,6 +167,13 @@ class RgwService:
             elif isinstance(entry, dict):
                 size += int(entry.get("size", 0))
                 objects += 1
+        for upload_id in await self._uploads_registry(bucket):
+            try:
+                up = await self._load_upload(bucket, upload_id)
+            except RadosError:
+                continue  # completed/aborted since the registry read
+            size += sum(int(p.get("size", 0))
+                        for p in up.get("parts", {}).values())
         self._bucket_usage_cache[bucket] = (time.monotonic(),
                                             (size, objects))
         while len(self._bucket_usage_cache) > 4096:
@@ -168,7 +190,7 @@ class RgwService:
             if hit and time.monotonic() - hit[0] < self.usage_cache_ttl:
                 return hit[1]
         total_size = total_objects = buckets = 0
-        for bucket in await self.list_buckets():
+        for bucket in await self.list_buckets(strict=True):
             meta = await self.get_bucket_meta(bucket)
             if meta.get("owner") != access_key:
                 continue
@@ -250,8 +272,7 @@ class RgwService:
         # sync-agent suppression — replicated applies change usage too),
         # so this gateway's own quota checks never see their own writes
         # stale; cross-gateway writes are bounded by usage_cache_ttl
-        self._bucket_usage_cache.pop(bucket, None)
-        self._usage_cache.clear()
+        self._invalidate_usage(bucket)
         if _DATALOG_SUPPRESS.get():
             return
         lock = getattr(self, "_datalog_lock", None)
@@ -311,15 +332,20 @@ class RgwService:
 
     async def get_bucket_meta(self, bucket: str) -> Dict:
         try:
-            return json.loads(await self.ioctx.read(self._meta_oid(bucket)))
+            meta = json.loads(await self.ioctx.read(self._meta_oid(bucket)))
         except RadosError as e:
             if e.code != -errno.ENOENT:
                 raise
-            return {"versioning": False, "lifecycle": [], "acl": None}
+            meta = {"versioning": False, "lifecycle": [], "acl": None}
+        self._owner_cache[bucket] = meta.get("owner")
+        while len(self._owner_cache) > 8192:
+            self._owner_cache.pop(next(iter(self._owner_cache)))
+        return meta
 
     async def _save_bucket_meta(self, bucket: str, meta: Dict) -> None:
         await self.ioctx.write_full(self._meta_oid(bucket),
                                     json.dumps(meta).encode())
+        self._owner_cache[bucket] = meta.get("owner")
 
     async def set_versioning(self, bucket: str, enabled: bool) -> None:
         if await self._load_index(bucket) is None:
@@ -526,10 +552,15 @@ class RgwService:
             meta["owner"] = owner
             await self._save_bucket_meta(bucket, meta)
 
-    async def list_buckets(self) -> List[str]:
+    async def list_buckets(self, strict: bool = False) -> List[str]:
+        """strict=True re-raises transient read failures instead of
+        answering [] — quota enforcement must fail CLOSED, not admit
+        writes because the registry was momentarily unreadable."""
         try:
             return json.loads(await self.ioctx.read(BUCKETS_ROOT))
-        except RadosError:
+        except RadosError as e:
+            if strict and e.code != -errno.ENOENT:
+                raise
             return []
 
     async def _drop_parts(self, entry: Dict) -> None:
@@ -905,6 +936,27 @@ class RgwService:
     def _part_oid(self, bucket: str, upload_id: str, part: int) -> str:
         return f"_mp.{bucket}.{upload_id}.{part:05d}"
 
+    @staticmethod
+    def _uploads_oid(bucket: str) -> str:
+        return f".uploads.{bucket}"
+
+    async def _uploads_registry(self, bucket: str) -> List[str]:
+        try:
+            return json.loads(await self.ioctx.read(
+                self._uploads_oid(bucket)))
+        except RadosError:
+            return []
+
+    async def _uploads_registry_update(self, bucket: str, add=None,
+                                       remove=None) -> None:
+        ids = await self._uploads_registry(bucket)
+        if add is not None and add not in ids:
+            ids.append(add)
+        if remove is not None and remove in ids:
+            ids.remove(remove)
+        await self.ioctx.write_full(self._uploads_oid(bucket),
+                                    json.dumps(ids).encode())
+
     async def initiate_multipart(self, bucket: str, key: str) -> str:
         if await self._load_index(bucket) is None:
             raise RadosError(f"NoSuchBucket: {bucket}")
@@ -912,6 +964,10 @@ class RgwService:
         await self.ioctx.write_full(
             self._upload_meta_oid(bucket, upload_id),
             json.dumps({"key": key, "parts": {}}).encode())
+        # in-progress registry: staged parts are visible to usage
+        # accounting (reference: uploads live in the bucket index's
+        # multipart namespace and are listable/chargeable)
+        await self._uploads_registry_update(bucket, add=upload_id)
         return upload_id
 
     async def _load_upload(self, bucket: str, upload_id: str) -> Dict:
@@ -932,16 +988,20 @@ class RgwService:
         await self.ioctx.write_full(
             self._upload_meta_oid(bucket, upload_id),
             json.dumps(meta).encode())
+        # staged bytes count toward usage: the next part's quota check
+        # must see this one
+        self._invalidate_usage(bucket)
         return etag
 
     async def complete_multipart(self, bucket: str, upload_id: str,
                                  parts: Optional[List[int]] = None,
                                  principal: Optional[str] = None) -> str:
         """Assemble the object from its parts; the bucket index entry
-        becomes a manifest referencing the part objects in order.  With
-        a `principal`, the assembled size (the SELECTED parts only) is
-        quota-checked before anything mutates (reference checks at
-        completion too)."""
+        becomes a manifest referencing the part objects in order.
+        Quota was charged when each part was STAGED (staged parts count
+        in bucket_usage), so completion — which never grows stored
+        bytes — needs no second check; `principal` is accepted for
+        interface symmetry with the staging path."""
         meta = await self._load_upload(bucket, upload_id)
         index = await self._load_index(bucket)
         if index is None:
@@ -950,10 +1010,6 @@ class RgwService:
         order = sorted(have) if parts is None else list(parts)
         if not order or any(n not in have for n in order):
             raise RadosError("InvalidPart: upload has missing parts")
-        if principal is not None:
-            await self.check_quota(
-                principal, bucket,
-                sum(int(have[n].get("size", 0)) for n in order))
         key = meta["key"]
         manifest = [have[n] for n in order]
         # S3 multipart etag convention: md5 of concatenated part md5s
@@ -982,6 +1038,7 @@ class RgwService:
                 index[key] = self._set_derived(e)
                 await self._save_index(bucket, index)
             await self.ioctx.remove(self._upload_meta_oid(bucket, upload_id))
+            await self._uploads_registry_update(bucket, remove=upload_id)
             await self._log_mutation("put", bucket, key)
             return etag
         got = await self._idx_cls(bucket, "index_put",
@@ -1000,6 +1057,8 @@ class RgwService:
             await self._save_index(bucket, index)
             await self._drop_object_data(bucket, key, prev)
         await self.ioctx.remove(self._upload_meta_oid(bucket, upload_id))
+        await self._uploads_registry_update(bucket, remove=upload_id)
+        self._invalidate_usage(bucket)
         # a completed multipart IS an object mutation: without this the
         # zone sync agent never replicates multipart uploads
         await self._log_mutation("put", bucket, key)
@@ -1013,6 +1072,8 @@ class RgwService:
             except RadosError:
                 pass
         await self.ioctx.remove(self._upload_meta_oid(bucket, upload_id))
+        await self._uploads_registry_update(bucket, remove=upload_id)
+        self._invalidate_usage(bucket)
 
 
 # -- SigV4 (reference rgw_auth; AWS Signature Version 4) --------------------

@@ -82,7 +82,8 @@ def bootstrap(args) -> int:
     cmd = [sys.executable, "-m", "ceph_tpu.rados.vstart",
            "--osds", str(args.osds), "--mons", str(args.mons),
            "--data-dir", os.path.join(cdir, "data"),
-           "--addr-file", addr_file]
+           "--addr-file", addr_file,
+           "--control-file", os.path.join(cdir, "orch_spec.json")]
     if args.mgr:
         cmd.append("--mgr")
     # scrubbed accelerator env: on hosts whose sitecustomize force-
@@ -200,6 +201,85 @@ def rm_cluster(args) -> int:
     return 0
 
 
+def orch_apply(args) -> int:
+    """`ceph orch apply osd` role: write the service spec; the daemon
+    host's reconciliation loop converges the live daemon set to it."""
+    if args.osds < 1:
+        # the reconcile loop never drains below one OSD (a clusterless
+        # cluster is rm-cluster's job) — reject rather than publish a
+        # spec that can never converge
+        print("--osds must be >= 1", file=sys.stderr)
+        return 1
+    spec = _load_spec(args.data_root, args.name)
+    if spec is None:
+        print(f"no cluster {args.name!r}", file=sys.stderr)
+        return 1
+    cdir = os.path.join(args.data_root, args.name)
+    control = os.path.join(cdir, "orch_spec.json")
+    tmp = control + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"target_osds": args.osds}, f)
+    os.replace(tmp, control)
+    print(f"scheduled: {args.name} -> {args.osds} osds "
+          f"(daemon host converges within its poll interval)")
+    return 0
+
+
+def orch_ps(args) -> int:
+    """`ceph orch ps` role: live per-daemon table — registry liveness
+    for the host process plus the mon's osd up/in states."""
+    spec = _load_spec(args.data_root, args.name)
+    if spec is None:
+        print(f"no cluster {args.name!r}", file=sys.stderr)
+        return 1
+    # re-read the addr file: reconciliation republishes osd counts
+    addr_file = os.path.join(args.data_root, args.name, "mons.json")
+    try:
+        with open(addr_file) as f:
+            info = json.load(f)
+    except (OSError, ValueError):
+        info = {"mons": spec["mons"], "osds": spec["osds"]}
+    rows: List[Dict] = [{"daemon": "host", "id": spec["name"],
+                         "status": "running" if _alive(spec["pid"])
+                         else "stopped", "pid": spec["pid"]}]
+    import asyncio as _asyncio
+
+    async def probe():
+        from ceph_tpu.rados.client import RadosClient
+
+        mon = info["mons"][0]
+        c = RadosClient((mon[0], int(mon[1])))
+        await c.start()
+        try:
+            await c.refresh_map()
+            for osd_id in sorted(c.osdmap.osds):
+                st = c.osdmap.osds[osd_id]
+                rows.append({
+                    "daemon": "osd", "id": osd_id,
+                    "status": "running" if st.up else "stopped",
+                    "addr": f"{st.addr[0]}:{st.addr[1]}" if st.addr
+                    else ""})
+            for rank, mon_addr in enumerate(info["mons"]):
+                rows.append({"daemon": "mon", "id": rank,
+                             "status": "running",
+                             "addr": f"{mon_addr[0]}:{mon_addr[1]}"})
+        finally:
+            await c.stop()
+
+    try:
+        _asyncio.run(probe())
+    except Exception as e:
+        rows.append({"daemon": "mon", "id": "?",
+                     "status": f"unreachable ({type(e).__name__})"})
+    if args.format == "json":
+        print(json.dumps(rows, indent=2))
+    else:
+        for r in rows:
+            print(f"{r['daemon']:>5}.{r['id']:<8} {r['status']:<10} "
+                  f"{r.get('addr', '')}")
+    return 0
+
+
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="cluster deploy tool")
     p.add_argument("--data-root", default="./ceph-clusters",
@@ -223,13 +303,25 @@ def parse_args(argv=None):
     r.add_argument("--name", required=True)
     r.add_argument("--force", action="store_true")
 
+    oa = sub.add_parser("orch-apply",
+                        help="converge a cluster's OSD count to a spec")
+    oa.add_argument("--name", required=True)
+    oa.add_argument("--osds", type=int, required=True)
+
+    op = sub.add_parser("orch-ps",
+                        help="live per-daemon status table")
+    op.add_argument("--name", required=True)
+    op.add_argument("--format", choices=("plain", "json"),
+                    default="plain")
+
     return p.parse_args(argv)
 
 
 def main(argv=None) -> int:
     args = parse_args(argv)
     return {"bootstrap": bootstrap, "ls": ls, "stop": stop,
-            "rm-cluster": rm_cluster}[args.cmd](args)
+            "rm-cluster": rm_cluster, "orch-apply": orch_apply,
+            "orch-ps": orch_ps}[args.cmd](args)
 
 
 if __name__ == "__main__":

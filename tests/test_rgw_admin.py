@@ -167,27 +167,48 @@ class TestQuotasOverHttp:
                 await _req(host, port, creds, "PUT", "/mp", access=ak)
                 await admin.quota_set("carol", "bucket", max_size=150)
                 await admin.quota_enable("carol", "bucket")
-                # multipart whose total exceeds the bucket quota is
-                # rejected at COMPLETE time (parts are staged, charged
-                # on assembly — reference checks at completion too)
+                # STAGED parts are charged as they land (or a capped
+                # user could park unbounded bytes in never-completed
+                # uploads): the second 100-byte part breaks the 150
+                # cap at staging time
                 st, body = await _req(host, port, creds, "POST",
                                       "/mp/big", access=ak,
                                       query="uploads")
                 upload_id = json.loads(body)["UploadId"]
-                for part in (1, 2):
-                    st, _ = await _req(
-                        host, port, creds, "PUT", "/mp/big",
-                        b"p" * 100, access=ak,
-                        query=f"uploadId={upload_id}&partNumber={part}")
-                    assert st.startswith("200")
-                st, body = await _req(host, port, creds, "POST",
-                                      "/mp/big", access=ak,
-                                      query=f"uploadId={upload_id}")
+                st, _ = await _req(
+                    host, port, creds, "PUT", "/mp/big", b"p" * 100,
+                    access=ak,
+                    query=f"uploadId={upload_id}&partNumber=1")
+                assert st.startswith("200")
+                st, body = await _req(
+                    host, port, creds, "PUT", "/mp/big", b"p" * 100,
+                    access=ak,
+                    query=f"uploadId={upload_id}&partNumber=2")
                 assert st.startswith("403") and b"QuotaExceeded" in body
+                # completion of the staged part fits and frees nothing
+                st, _ = await _req(host, port, creds, "POST",
+                                   "/mp/big", access=ak,
+                                   query=f"uploadId={upload_id}")
+                assert st.startswith("200")
                 # a small single put under the cap is fine
                 st, _ = await _req(host, port, creds, "PUT", "/mp/ok",
-                                   b"s" * 50, access=ak)
+                                   b"s" * 40, access=ak)
                 assert st.startswith("200")
+                # aborted uploads release their staged charge
+                st, body = await _req(host, port, creds, "POST",
+                                      "/mp/tmp", access=ak,
+                                      query="uploads")
+                up2 = json.loads(body)["UploadId"]
+                st, _ = await _req(
+                    host, port, creds, "PUT", "/mp/tmp", b"q" * 10,
+                    access=ak, query=f"uploadId={up2}&partNumber=1")
+                assert st.startswith("200")
+                st, _ = await _req(host, port, creds, "DELETE",
+                                   "/mp/tmp", access=ak,
+                                   query=f"uploadId={up2}")
+                assert st.startswith("204")
+                s, _o = await svc.bucket_usage("mp")
+                assert s == 140  # 100 (completed) + 40 (mp/ok)
             finally:
                 if frontend:
                     await frontend.stop()

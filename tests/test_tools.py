@@ -260,3 +260,74 @@ class TestCephadmDeploy:
             # belt-and-braces: never leak the daemon host
             if cephadm._alive(spec["pid"]):
                 os.kill(spec["pid"], 9)
+
+    def test_orch_apply_converges_osd_count(self, tmp_path):
+        """`ceph orch apply osd` role: the daemon host's reconciliation
+        loop converges the live daemon set to the written spec, both
+        directions."""
+        import asyncio
+        import json as _json
+        import time as _time
+
+        from ceph_tpu.tools import cephadm
+
+        root = str(tmp_path / "clusters")
+
+        def adm(*argv):
+            return cephadm.main(["--data-root", root, *argv])
+
+        assert adm("bootstrap", "--name", "c2", "--osds", "2") == 0
+        spec = _json.load(open(f"{root}/c2/cluster.json"))
+        try:
+            assert adm("orch-apply", "--name", "c2", "--osds", "4") == 0
+
+            def published_osds():
+                try:
+                    return _json.load(
+                        open(f"{root}/c2/mons.json"))["osds"]
+                except (OSError, ValueError):
+                    return -1
+
+            deadline = _time.monotonic() + 60
+            while published_osds() != 4 and _time.monotonic() < deadline:
+                _time.sleep(0.5)
+            assert published_osds() == 4
+            # the mon's map agrees: 4 up OSDs
+            mon = spec["mons"][0]
+
+            async def up_count():
+                from ceph_tpu.rados.client import RadosClient
+                c = RadosClient((mon[0], int(mon[1])))
+                await c.start()
+                try:
+                    await c.refresh_map()
+                    return sum(1 for o in c.osdmap.osds.values() if o.up)
+                finally:
+                    await c.stop()
+
+            deadline = _time.monotonic() + 30
+            while _time.monotonic() < deadline:
+                if asyncio.run(up_count()) == 4:
+                    break
+                _time.sleep(0.5)
+            assert asyncio.run(up_count()) == 4
+            # live daemon table
+            import io
+            from contextlib import redirect_stdout
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                assert adm("orch-ps", "--name", "c2",
+                           "--format", "json") == 0
+            rows = _json.loads(buf.getvalue())
+            assert sum(1 for r in rows if r["daemon"] == "osd"
+                       and r["status"] == "running") == 4
+            # scale back down: daemon-host truth converges
+            assert adm("orch-apply", "--name", "c2", "--osds", "2") == 0
+            deadline = _time.monotonic() + 60
+            while published_osds() != 2 and _time.monotonic() < deadline:
+                _time.sleep(0.5)
+            assert published_osds() == 2
+        finally:
+            adm("rm-cluster", "--name", "c2", "--force")
+            if cephadm._alive(spec["pid"]):
+                os.kill(spec["pid"], 9)
