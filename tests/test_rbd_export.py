@@ -1,0 +1,195 @@
+"""RBD export/import/diff streams and the rbd CLI (reference
+`rbd export`, `rbd export-diff`/`import-diff`, DiffIterate fast-diff)."""
+
+import asyncio
+import io
+import json
+import os
+
+import pytest
+
+from ceph_tpu.rados.librados import Rados
+from ceph_tpu.rados.vstart import Cluster
+from ceph_tpu.services import rbd_export
+from ceph_tpu.services.rbd import RBD, RbdError
+
+CONF = {"osd_auto_repair": False}
+EC_PROFILE = {"plugin": "jerasure", "technique": "reed_sol_van",
+              "k": "2", "m": "1"}
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _rbd(pool="rbdx"):
+    cluster = Cluster(n_osds=4, conf=dict(CONF))
+    await cluster.start()
+    rados = await Rados(cluster.mon_addrs, CONF).connect()
+    await rados.pool_create(pool, profile=EC_PROFILE)
+    io_ = await rados.open_ioctx(pool)
+    return cluster, rados, RBD(io_)
+
+
+class TestFullExportImport:
+    def test_sparse_roundtrip(self):
+        """Full export of a sparse image; import reproduces bytes AND
+        sparseness (holes stay holes)."""
+        async def go():
+            cluster, rados, rbd = await _rbd()
+            try:
+                img = await rbd.create("src", 4 << 20, order=18)  # 256K
+                blob1 = os.urandom(300_000)
+                await img.write(0, blob1)
+                await img.write(3 << 20, b"tail-bytes")
+                buf = io.BytesIO()
+                stats = await rbd_export.export_image(img, buf)
+                assert stats["size"] == 4 << 20
+                buf.seek(0)
+                dst = await rbd_export.import_image(rbd, "dst", buf,
+                                                    order=18)
+                assert await dst.read(0, len(blob1)) == blob1
+                assert await dst.read(3 << 20, 10) == b"tail-bytes"
+                # untouched middle reads zeros AND stayed unallocated
+                assert await dst.read(1 << 20, 4096) == b"\x00" * 4096
+                src_blocks = set(img._hdr["object_map"])
+                assert set(dst._hdr["object_map"]) == src_blocks
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_export_of_snapshot(self):
+        async def go():
+            cluster, rados, rbd = await _rbd()
+            try:
+                img = await rbd.create("s", 1 << 20, order=18)
+                await img.write(0, b"frozen")
+                await img.snap_create("snap1")
+                await img.write(0, b"edited")
+                buf = io.BytesIO()
+                await rbd_export.export_image(img, buf, snap="snap1")
+                buf.seek(0)
+                dst = await rbd_export.import_image(rbd, "restored", buf,
+                                                    order=18)
+                assert await dst.read(0, 6) == b"frozen"
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+
+class TestDiffs:
+    def test_incremental_backup_chain(self):
+        """snap s1 -> full export; changes -> snap s2 -> diff s1..s2;
+        apply both to a fresh image: byte-identical, trims propagate."""
+        async def go():
+            cluster, rados, rbd = await _rbd()
+            try:
+                bs = 1 << 18
+                img = await rbd.create("vm", 2 << 20, order=18)
+                await img.write(0, b"A" * bs)           # block 0
+                await img.write(bs, b"B" * bs)          # block 1
+                await img.snap_create("s1")
+                full = io.BytesIO()
+                await rbd_export.export_image(img, full, snap="s1")
+                # mutate: overwrite block 0, add block 4, zero block 1
+                await img.write(0, b"X" * bs)
+                await img.write(4 * bs, b"D" * 1000)
+                zeros = b"\x00" * bs
+                await img.write(bs, zeros)
+                await img.snap_create("s2")
+                delta = io.BytesIO()
+                stats = await rbd_export.export_diff(
+                    img, delta, from_snap="s1", to_snap="s2")
+                # unchanged blocks are NOT shipped
+                assert stats["blocks_written"] == 2  # block 0 + block 4
+                # restore chain on a fresh image
+                full.seek(0)
+                dst = await rbd_export.import_image(rbd, "restore", full,
+                                                    order=18)
+                delta.seek(0)
+                await rbd_export.apply_diff(dst, delta)
+                assert await dst.read(0, bs) == b"X" * bs
+                assert await dst.read(bs, bs) == zeros
+                assert await dst.read(4 * bs, 1000) == b"D" * 1000
+                # the zeroed block became a HOLE on the destination
+                assert 1 not in dst._hdr["object_map"]
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_diff_resize_propagates(self):
+        async def go():
+            cluster, rados, rbd = await _rbd()
+            try:
+                img = await rbd.create("g", 1 << 20, order=18)
+                await img.write(0, b"base")
+                await img.snap_create("s1")
+                await img.resize(2 << 20)
+                await img.write(1 << 20, b"grown")
+                delta = io.BytesIO()
+                await rbd_export.export_diff(img, delta, from_snap="s1")
+                # destination starts at the OLD size
+                dst = await rbd.create("g2", 1 << 20, order=18)
+                await dst.write(0, b"base")
+                delta.seek(0)
+                await rbd_export.apply_diff(dst, delta)
+                assert dst.size == 2 << 20
+                assert await dst.read(1 << 20, 5) == b"grown"
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_corrupt_stream_rejected(self):
+        async def go():
+            cluster, rados, rbd = await _rbd()
+            try:
+                img = await rbd.create("c", 1 << 20, order=18)
+                with pytest.raises(RbdError):
+                    await rbd_export.apply_diff(
+                        img, io.BytesIO(b"not a stream"))
+                trunc = io.BytesIO(rbd_export.MAGIC + b"w")
+                with pytest.raises(RbdError):
+                    await rbd_export.apply_diff(img, trunc)
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+
+class TestRbdCli:
+    def test_cli_backup_workflow(self, tmp_path):
+        async def go():
+            cluster, rados, rbd = await _rbd()
+            try:
+                from ceph_tpu.tools.rbd import parse_args
+                from ceph_tpu.tools.rbd import run as cli_run
+
+                mon = f"{cluster.mons[0].addr[0]}:{cluster.mons[0].addr[1]}"
+
+                async def cli(*argv):
+                    return await cli_run(parse_args(
+                        ["--mon", mon, "--pool", "rbdx", *argv]))
+
+                assert await cli("create", "disk", "--size", "1M",
+                                 "--order", "18") == 0
+                img = await rbd.open("disk")
+                await img.write(0, b"cli-bytes")
+                assert await cli("snap", "create", "disk@backup") == 0
+                path = str(tmp_path / "disk.full")
+                assert await cli("export", "disk@backup", path) == 0
+                assert await cli("import", path, "disk2",
+                                 "--order", "18") == 0
+                img2 = await rbd.open("disk2")
+                assert await img2.read(0, 9) == b"cli-bytes"
+                assert await cli("ls") == 0
+                assert await cli("info", "disk") == 0
+                assert await cli("rm", "disk2") == 0
+                assert "disk2" not in await rbd.list()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
