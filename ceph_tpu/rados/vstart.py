@@ -160,9 +160,11 @@ async def _reconcile(cluster: Cluster, control_file: str,
     try:
         with open(control_file) as f:
             spec = _json.load(f)
-    except (OSError, ValueError):
+        target = int(spec.get("target_osds", -1))
+    except (OSError, ValueError, TypeError):
+        # unreadable or malformed spec must never take the daemon host
+        # down — skip this cycle, the operator can rewrite the file
         return
-    target = int(spec.get("target_osds", -1))
     if target < 0:
         return
     changed = False
@@ -192,8 +194,14 @@ async def _main(args) -> None:
 
         deadline = (_time.monotonic() + args.run_for
                     if args.run_for > 0 else None)
+        # only orchestrated hosts poll; a plain vstart idles at the old
+        # long interval instead of waking every second for nothing
+        interval = 1.0 if args.control_file else 3600.0
         while deadline is None or _time.monotonic() < deadline:
-            await asyncio.sleep(1.0)
+            nap = interval
+            if deadline is not None:
+                nap = min(nap, max(0.05, deadline - _time.monotonic()))
+            await asyncio.sleep(nap)
             if args.control_file:
                 await _reconcile(cluster, args.control_file,
                                  args.addr_file)

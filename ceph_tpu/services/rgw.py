@@ -941,21 +941,33 @@ class RgwService:
         return f".uploads.{bucket}"
 
     async def _uploads_registry(self, bucket: str) -> List[str]:
+        """Fail-closed like list_buckets(strict=True): quota accounting
+        consumes this, so a transient read error must propagate rather
+        than under-count staged bytes."""
         try:
             return json.loads(await self.ioctx.read(
                 self._uploads_oid(bucket)))
-        except RadosError:
+        except RadosError as e:
+            if e.code != -errno.ENOENT:
+                raise
             return []
 
     async def _uploads_registry_update(self, bucket: str, add=None,
                                        remove=None) -> None:
-        ids = await self._uploads_registry(bucket)
-        if add is not None and add not in ids:
-            ids.append(add)
-        if remove is not None and remove in ids:
-            ids.remove(remove)
-        await self.ioctx.write_full(self._uploads_oid(bucket),
-                                    json.dumps(ids).encode())
+        # serialized read-modify-write (same discipline as
+        # _log_mutation): a lost registry entry is staged bytes the
+        # quota can never see again
+        lock = getattr(self, "_uploads_lock", None)
+        if lock is None:
+            lock = self._uploads_lock = asyncio.Lock()
+        async with lock:
+            ids = await self._uploads_registry(bucket)
+            if add is not None and add not in ids:
+                ids.append(add)
+            if remove is not None and remove in ids:
+                ids.remove(remove)
+            await self.ioctx.write_full(self._uploads_oid(bucket),
+                                        json.dumps(ids).encode())
 
     async def initiate_multipart(self, bucket: str, key: str) -> str:
         if await self._load_index(bucket) is None:
@@ -1012,6 +1024,15 @@ class RgwService:
             raise RadosError("InvalidPart: upload has missing parts")
         key = meta["key"]
         manifest = [have[n] for n in order]
+        # parts NOT selected into the manifest are discarded now (S3
+        # semantics) — leaving them stored after the upload's registry
+        # entry vanished would be bytes no quota ever counts again
+        for n, p in have.items():
+            if n not in order:
+                try:
+                    await self.striper.remove(p["oid"])
+                except RadosError:
+                    pass
         # S3 multipart etag convention: md5 of concatenated part md5s
         etag = hashlib.md5(
             b"".join(bytes.fromhex(p["etag"]) for p in manifest)
@@ -1058,9 +1079,9 @@ class RgwService:
             await self._drop_object_data(bucket, key, prev)
         await self.ioctx.remove(self._upload_meta_oid(bucket, upload_id))
         await self._uploads_registry_update(bucket, remove=upload_id)
-        self._invalidate_usage(bucket)
         # a completed multipart IS an object mutation: without this the
-        # zone sync agent never replicates multipart uploads
+        # zone sync agent never replicates multipart uploads (and its
+        # first act invalidates the usage caches)
         await self._log_mutation("put", bucket, key)
         return etag
 

@@ -194,13 +194,34 @@ class TestQuotasOverHttp:
                 st, _ = await _req(host, port, creds, "PUT", "/mp/ok",
                                    b"s" * 40, access=ak)
                 assert st.startswith("200")
+                # completing with a SUBSET discards the unselected
+                # parts' objects (S3 semantics) — no uncharged bytes
+                # survive the upload
+                st, body = await _req(host, port, creds, "POST",
+                                      "/mp/sub", access=ak,
+                                      query="uploads")
+                up3 = json.loads(body)["UploadId"]
+                for part in (1, 2):
+                    st, _ = await _req(
+                        host, port, creds, "PUT", "/mp/sub", b"s" * 5,
+                        access=ak,
+                        query=f"uploadId={up3}&partNumber={part}")
+                    assert st.startswith("200")
+                part2_oid = svc._part_oid("mp", up3, 2)
+                st, _ = await _req(host, port, creds, "POST", "/mp/sub",
+                                   json.dumps({"Parts": [1]}).encode(),
+                                   access=ak, query=f"uploadId={up3}")
+                assert st.startswith("200")
+                from ceph_tpu.rados.client import RadosError as _RErr
+                with pytest.raises(_RErr):
+                    await svc.striper.read(part2_oid)
                 # aborted uploads release their staged charge
                 st, body = await _req(host, port, creds, "POST",
                                       "/mp/tmp", access=ak,
                                       query="uploads")
                 up2 = json.loads(body)["UploadId"]
                 st, _ = await _req(
-                    host, port, creds, "PUT", "/mp/tmp", b"q" * 10,
+                    host, port, creds, "PUT", "/mp/tmp", b"q" * 5,
                     access=ak, query=f"uploadId={up2}&partNumber=1")
                 assert st.startswith("200")
                 st, _ = await _req(host, port, creds, "DELETE",
@@ -208,7 +229,8 @@ class TestQuotasOverHttp:
                                    query=f"uploadId={up2}")
                 assert st.startswith("204")
                 s, _o = await svc.bucket_usage("mp")
-                assert s == 140  # 100 (completed) + 40 (mp/ok)
+                # 100 (mp/big) + 40 (mp/ok) + 5 (mp/sub, part 1 only)
+                assert s == 145
             finally:
                 if frontend:
                     await frontend.stop()
