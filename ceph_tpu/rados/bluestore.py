@@ -299,6 +299,9 @@ class BlueStore(ObjectStore):
             if pid == pool_id:
                 yield oid, shard
 
+    def list_pools(self) -> Iterable[int]:
+        return sorted({pid for (pid, _o, _s) in self._onodes})
+
     # -- xattrs / omap (HashInfo + PG log substrate) -------------------------
 
     def setattr(self, key: Key, name: str, value: bytes) -> None:

@@ -25,6 +25,7 @@ from ceph_tpu.rados.types import (
     MConfigSet,
     MCreatePool,
     MCreatePoolReply,
+    MDeletePool,
     MGetMap,
     MMapReply,
     MPoolSet,
@@ -250,6 +251,16 @@ class RadosClient:
         """`ceph osd pool set` role (pg_num drives PG splitting)."""
         await self._mon_rpc(MPoolSet(pool_id=pool_id, key=key,
                                      value=str(value)))
+        await self.refresh_map()
+
+    async def delete_pool(self, pool_id: int, confirm_name: str) -> None:
+        """`ceph osd pool rm` role: `confirm_name` must echo the pool's
+        name (the reference's --yes-i-really-really-mean-it guard).
+        OSDs purge the pool's data when they see it gone from the map."""
+        reply = await self._mon_rpc(MDeletePool(pool_id=pool_id,
+                                                confirm_name=confirm_name))
+        if not reply.ok:
+            raise RadosError(reply.error)
         await self.refresh_map()
 
     async def mark_osd_down(self, osd_id: int) -> None:

@@ -48,6 +48,7 @@ from ceph_tpu.rados.types import (
     MConfigSet,
     MCreatePool,
     MCreatePoolReply,
+    MDeletePool,
     MForward,
     MForwardReply,
     MGetMap,
@@ -549,7 +550,8 @@ class Monitor:
 
     # -- dispatch ------------------------------------------------------------
 
-    WRITE_TYPES = (MOsdBoot, MCreatePool, MMarkDown, MConfigSet, MOSDFailure,
+    WRITE_TYPES = (MOsdBoot, MCreatePool, MDeletePool, MMarkDown,
+                   MConfigSet, MOSDFailure,
                    MOSDPGTemp, MSetUpmap, MPoolSet, MSnapOp)
 
     @staticmethod
@@ -736,6 +738,25 @@ class Monitor:
             if reply.ok:
                 await self._commit_state()
             return reply
+        if isinstance(msg, MDeletePool):
+            pool = self.osdmap.pools.get(msg.pool_id)
+            if pool is None:
+                return MCreatePoolReply(tid=msg.tid, ok=False,
+                                        error="ENOENT: no such pool")
+            if msg.confirm_name != pool.name:
+                # the reference refuses deletion unless the pool name is
+                # echoed back (--yes-i-really-really-mean-it discipline)
+                return MCreatePoolReply(
+                    tid=msg.tid, ok=False,
+                    error="EPERM: confirmation name mismatch")
+            del self.osdmap.pools[msg.pool_id]
+            for d in (self.osdmap.pg_temp, self.osdmap.pg_upmap):
+                for k in [k for k in d if k[0] == msg.pool_id]:
+                    d.pop(k, None)
+            self.osdmap.epoch += 1
+            await self._commit_state()
+            return MCreatePoolReply(tid=msg.tid, ok=True,
+                                    pool_id=msg.pool_id)
         if isinstance(msg, MMarkDown):
             info = self.osdmap.osds.get(msg.osd_id)
             if info is not None and info.up:
@@ -882,7 +903,7 @@ class Monitor:
 
     def _error_reply(self, msg: Any, error: str) -> Any:
         tid = getattr(msg, "tid", "")
-        if isinstance(msg, MCreatePool):
+        if isinstance(msg, (MCreatePool, MDeletePool)):
             return MCreatePoolReply(tid=tid, ok=False, error=error)
         if isinstance(msg, MConfigSet):
             return MConfigReply(tid=tid, ok=False, error=error)

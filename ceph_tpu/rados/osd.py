@@ -237,6 +237,8 @@ class OSD:
             .add_time_avg("op_lat", "client op latency")
             .add_u64_counter("subop_w", "EC sub-writes applied")
             .add_u64_counter("subop_r", "EC sub-reads served")
+            .add_u64_counter("pools_purged",
+                             "deleted pools locally purged")
             .add_u64_counter("rmw_partial", "stripe-scoped partial overwrites")
             .add_u64_counter("rmw_extent_hits",
                              "RMW reads served from the extent cache")
@@ -765,6 +767,17 @@ class OSD:
         old = self.osdmap
         if old is not None and osdmap.epoch <= old.epoch:
             return
+        if old is None:
+            # FIRST map after boot: pools deleted while this OSD was
+            # down never produce an old→new transition here, so sweep
+            # the persistent store for pools absent from the map
+            # (reference: PG deletion resumes on activation)
+            try:
+                for pid in self.store.list_pools():
+                    if pid not in osdmap.pools:
+                        self._purge_pool(pid)
+            except NotImplementedError:
+                pass
         changed_pgs: List[Tuple[PoolInfo, int]] = []
         if old is not None and self._mapping_inputs_changed(old, osdmap):
             # remember the outgoing interval's acting set for PGs whose
@@ -772,6 +785,12 @@ class OSD:
             # pg_temp request must name during backfill, and its members
             # accumulate in _past_members (the scope set for deletes,
             # shard hunts and backfill until the PG is clean again).  The
+            # pool DELETION (reference PG deletion after `osd pool rm`):
+            # a pool present in the old map and gone from the new one is
+            # authoritatively deleted cluster-wide — purge every local
+            # object/shard of it, its PG logs, and its cache entries
+            for gone_id in [p for p in old.pools if p not in osdmap.pools]:
+                self._purge_pool(gone_id)
             # dual-CRUSH scan only runs when a mapping INPUT changed (osd
             # states, weights, pools, pg_temp, crush) — config-only
             # epochs skip it.
@@ -1423,6 +1442,29 @@ class OSD:
     def _planar_key(self, pool_id: int, oid: str):
         # namespaced per OSD: in-process clusters share one store/budget
         return (self.osd_id, pool_id, oid)
+
+    def _purge_pool(self, pool_id: int) -> None:
+        """Delete every locally stored object of a pool removed from the
+        map (reference PG deletion): data shards, rollback slots, PG
+        logs, and cache residents all go."""
+        txn = Transaction()
+        seen = set()
+        try:
+            for oid, shard in self.store.list_objects(pool_id):
+                txn.delete((pool_id, oid, shard))
+                seen.add(oid)
+        except NotImplementedError:
+            return
+        if txn.deletes:
+            self.store.queue_transaction(txn)
+        for oid in seen:
+            self._cache_drop(pool_id, snap_head(oid))
+        for key in [k for k in self._pglogs if k[0] == pool_id]:
+            del self._pglogs[key]
+        for d in (self._past_members, self._prior_acting):
+            for k in [k for k in d if k[0] == pool_id]:
+                d.pop(k, None)
+        self.perf.inc("pools_purged")
 
     def _mark_failed_write(self, reqid: str) -> None:
         if reqid:

@@ -62,6 +62,11 @@ class ObjectStore:
         """Yield (oid, shard) pairs stored for a pool."""
         raise NotImplementedError
 
+    def list_pools(self) -> Iterable[int]:
+        """Pool ids with at least one stored shard (boot-time sweep for
+        pools deleted while this OSD was down)."""
+        raise NotImplementedError
+
     def omap_get(self, key: Key) -> Dict[str, bytes]:
         return {}
 
@@ -138,6 +143,9 @@ class MemStore(ObjectStore):
             if pid == pool_id:
                 yield oid, shard
 
+    def list_pools(self):
+        return sorted({pid for (pid, _o, _s) in self._data})
+
 
 class DirStore(ObjectStore):
     """File-per-shard store with a sidecar json for metadata; writes are
@@ -195,6 +203,16 @@ class DirStore(ObjectStore):
                     # foreign or legacy-named file in the store dir: never
                     # poison listing/repair for every other object
                     continue
+
+    def list_pools(self):
+        pools = set()
+        for name in os.listdir(self.path):
+            if name.endswith((".meta", ".tmp")):
+                continue
+            pid, sep, _ = name.partition("__")
+            if sep and pid.isdigit():
+                pools.add(int(pid))
+        return sorted(pools)
 
 
 def shard_crc(chunk: bytes) -> int:

@@ -371,6 +371,19 @@ class MCreatePoolReply:
     pool_id: int = -1
 
 
+@message(50)
+class MDeletePool:
+    """`ceph osd pool rm` (reference OSDMonitor::prepare_pool_op
+    delete): the mon drops the pool from the map; every OSD purges the
+    pool's objects when it sees the pool gone (PG deletion role).
+    Requires the double-confirmation name echo, like the reference's
+    --yes-i-really-really-mean-it discipline."""
+
+    tid: str = ""
+    pool_id: int = -1
+    confirm_name: str = ""  # must equal the pool's name
+
+
 @message(7, version=2)
 class MPing:
     osd_id: int = 0

@@ -99,6 +99,7 @@ class RgwService:
         self._bucket_usage_cache: Dict[str, Tuple[float,
                                                   Tuple[int, int]]] = {}
         self._owner_cache: Dict[str, Optional[str]] = {}  # bucket -> owner
+        self._uploads_lock = asyncio.Lock()
 
     # -- users / quotas (reference rgw_user.cc, RGWQuotaHandler) -------------
 
@@ -957,10 +958,7 @@ class RgwService:
         # serialized read-modify-write (same discipline as
         # _log_mutation): a lost registry entry is staged bytes the
         # quota can never see again
-        lock = getattr(self, "_uploads_lock", None)
-        if lock is None:
-            lock = self._uploads_lock = asyncio.Lock()
-        async with lock:
+        async with self._uploads_lock:
             ids = await self._uploads_registry(bucket)
             if add is not None and add not in ids:
                 ids.append(add)
@@ -1024,15 +1022,20 @@ class RgwService:
             raise RadosError("InvalidPart: upload has missing parts")
         key = meta["key"]
         manifest = [have[n] for n in order]
-        # parts NOT selected into the manifest are discarded now (S3
-        # semantics) — leaving them stored after the upload's registry
-        # entry vanished would be bytes no quota ever counts again
-        for n, p in have.items():
-            if n not in order:
-                try:
-                    await self.striper.remove(p["oid"])
-                except RadosError:
-                    pass
+
+        async def discard_unselected():
+            # parts NOT selected into the manifest are discarded (S3
+            # semantics) — leaving them stored after the upload's
+            # registry entry vanished would be bytes no quota ever
+            # counts again.  Runs AFTER the index commit: deleting them
+            # first would let a failed commit + retried complete build a
+            # manifest referencing already-deleted part objects.
+            for n, p in have.items():
+                if n not in order:
+                    try:
+                        await self.striper.remove(p["oid"])
+                    except RadosError:
+                        pass
         # S3 multipart etag convention: md5 of concatenated part md5s
         etag = hashlib.md5(
             b"".join(bytes.fromhex(p["etag"]) for p in manifest)
@@ -1058,6 +1061,7 @@ class RgwService:
                 e["versions"].append(ver)
                 index[key] = self._set_derived(e)
                 await self._save_index(bucket, index)
+            await discard_unselected()
             await self.ioctx.remove(self._upload_meta_oid(bucket, upload_id))
             await self._uploads_registry_update(bucket, remove=upload_id)
             await self._log_mutation("put", bucket, key)
@@ -1077,6 +1081,7 @@ class RgwService:
             index[key] = entry
             await self._save_index(bucket, index)
             await self._drop_object_data(bucket, key, prev)
+        await discard_unselected()
         await self.ioctx.remove(self._upload_meta_oid(bucket, upload_id))
         await self._uploads_registry_update(bucket, remove=upload_id)
         # a completed multipart IS an object mutation: without this the

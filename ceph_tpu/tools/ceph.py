@@ -29,8 +29,14 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser(description="ceph cluster status tool")
     p.add_argument("--mon", required=True, help="mon address host:port")
     p.add_argument("--format", choices=("plain", "json"), default="plain")
+    p.add_argument("--yes-i-really-really-mean-it", action="store_true",
+                   dest="confirm_destroy",
+                   help="required acknowledgement for `osd pool rm`")
     p.add_argument("words", nargs="+",
-                   help="status | health | df | osd tree | pg dump")
+                   help="status | health | df | osd tree | pg dump | "
+                        "osd pool ls | osd pool create NAME [k=v...] | "
+                        "osd pool set NAME KEY VALUE | "
+                        "osd pool rm NAME NAME --yes-i-really-really-mean-it")
     return p.parse_args(argv)
 
 
@@ -199,6 +205,80 @@ async def run(args) -> int:
                 for r in rows:
                     print(f"{r['pool']:<20} id {r['id']:<4} "
                           f"{r['type']:<12} {r['objects']} objects")
+            return 0
+        if args.words[:3] == ["osd", "pool", "ls"]:
+            rows = [{"id": p.pool_id, "name": p.name,
+                     "type": p.pool_type, "pg_num": p.pg_num,
+                     "size": p.size}
+                    for p in sorted(m.pools.values(),
+                                    key=lambda x: x.pool_id)]
+            if args.format == "json":
+                print(json.dumps(rows))
+            else:
+                for r in rows:
+                    print(f"{r['id']:>3} {r['name']:<20} {r['type']:<11} "
+                          f"pg_num {r['pg_num']} size {r['size']}")
+            return 0
+        if args.words[:3] == ["osd", "pool", "create"]:
+            rest = args.words[3:]
+            if not rest:
+                print("usage: osd pool create NAME [replicated|k=v ...]",
+                      file=sys.stderr)
+                return 2
+            name, params = rest[0], rest[1:]
+            if params and params[0] == "replicated":
+                extra = params[1:]
+                pg_num = 8
+                if extra and extra[0].isdigit():
+                    pg_num = int(extra.pop(0))
+                if extra:
+                    print(f"unrecognized arguments: {extra}",
+                          file=sys.stderr)
+                    return 2
+                pool_id = await client.create_pool(
+                    name, pool_type="replicated", pg_num=pg_num)
+            else:
+                bad = [kv for kv in params if "=" not in kv]
+                if bad:
+                    # silently dropping tokens here could turn a typo'd
+                    # `replicated` request into an EC pool
+                    print(f"unrecognized arguments: {bad}",
+                          file=sys.stderr)
+                    return 2
+                profile = dict(kv.split("=", 1) for kv in params)
+                pool_id = await client.create_pool(
+                    name, profile=profile or None)
+            print(f"pool '{name}' created (id {pool_id})")
+            return 0
+        if args.words[:3] == ["osd", "pool", "set"]:
+            rest = args.words[3:]
+            if len(rest) != 3:
+                print("usage: osd pool set NAME KEY VALUE",
+                      file=sys.stderr)
+                return 2
+            name, key, value = rest
+            pool = m.pool_by_name(name)
+            if pool is None:
+                print(f"no pool {name!r}", file=sys.stderr)
+                return 2
+            await client.pool_set(pool.pool_id, key, value)
+            print(f"set pool {name} {key} = {value}")
+            return 0
+        if args.words[:3] == ["osd", "pool", "rm"]:
+            rest = args.words[3:]
+            confirmed = args.confirm_destroy
+            if len(rest) != 2 or rest[0] != rest[1] or not confirmed:
+                # reference guard: the name twice AND the flag
+                print("Error EPERM: pool removal requires the pool name "
+                      "TWICE plus --yes-i-really-really-mean-it",
+                      file=sys.stderr)
+                return 1
+            pool = m.pool_by_name(rest[0])
+            if pool is None:
+                print(f"no pool {rest[0]!r}", file=sys.stderr)
+                return 2
+            await client.delete_pool(pool.pool_id, rest[0])
+            print(f"pool '{rest[0]}' removed")
             return 0
         print(f"unknown command: {cmd}", file=sys.stderr)
         return 2

@@ -40,7 +40,99 @@ def parse_args(argv=None):
     ls = sub.add_parser("ls")
     ls.add_argument("pool")
 
+    be = sub.add_parser("bench", help="reference `rados bench` role")
+    be.add_argument("pool")
+    be.add_argument("seconds", type=float)
+    be.add_argument("mode", choices=("write", "seq"))
+    be.add_argument("--object-size", type=int, default=1 << 22)
+    be.add_argument("--concurrency", type=int, default=16)
+    be.add_argument("--no-cleanup", action="store_true",
+                    help="keep written objects (needed before a seq run)")
+    be.add_argument("--run-name", default="benchmark_data",
+                    help="object name prefix (ties write and seq runs)")
+
     return p.parse_args(argv)
+
+
+async def _bench(client, pool_id: int, args) -> int:
+    """Timed write/seq workload (reference rados bench: bounded
+    concurrency, per-op latency tracking, MB/s summary)."""
+    import json
+    import os
+    import time
+
+    oid = lambda i: f"{args.run_name}_{i:08d}"  # noqa: E731
+    payload = os.urandom(args.object_size) if args.mode == "write" else b""
+    deadline = time.monotonic() + args.seconds
+    lats = []
+    issued = 0
+    done = 0
+    total_bytes = 0
+    names: list = []
+    sem = asyncio.Semaphore(max(1, args.concurrency))
+
+    async def one(i: int):
+        nonlocal done, total_bytes
+        t0 = time.monotonic()
+        try:
+            if args.mode == "write":
+                await client.put(pool_id, oid(i), payload)
+                nbytes = len(payload)
+            else:
+                # read the DISCOVERED names, not a regenerated counter:
+                # gaps from a partially failed write run must not shift
+                # every later read onto a missing object
+                nbytes = len(await client.get(pool_id, names[i]))
+        except Exception:
+            return
+        finally:
+            sem.release()
+        lats.append(time.monotonic() - t0)
+        done += 1
+        total_bytes += nbytes
+
+    if args.mode == "seq":
+        names = sorted(n for n in await client.list_objects(pool_id)
+                       if n.startswith(args.run_name + "_"))
+        if not names:
+            print("no benchmark objects; run "
+                  "`bench ... write --no-cleanup` first", file=sys.stderr)
+            return 1
+    t_start = time.monotonic()
+    tasks = []
+    # issuance is BOUNDED by the concurrency window (a slot must free
+    # before the next op is issued, the reference's in-flight cap): at
+    # the deadline at most `concurrency` ops remain to drain
+    while time.monotonic() < deadline:
+        if args.mode == "seq" and issued >= len(names):
+            break
+        await sem.acquire()
+        if time.monotonic() >= deadline:
+            sem.release()
+            break
+        tasks.append(asyncio.ensure_future(one(issued)))
+        issued += 1
+        tasks = [t for t in tasks if not t.done()]
+    if tasks:
+        await asyncio.gather(*tasks, return_exceptions=True)
+    dt = max(time.monotonic() - t_start, 1e-9)
+    total_mb = total_bytes / (1 << 20)  # bytes actually moved
+    out = {
+        "mode": args.mode,
+        "ops": done,
+        "seconds": round(dt, 3),
+        "bandwidth_MBps": round(total_mb / dt, 3),
+        "avg_lat_s": round(sum(lats) / len(lats), 5) if lats else None,
+        "max_lat_s": round(max(lats), 5) if lats else None,
+    }
+    print(json.dumps(out))
+    if args.mode == "write" and not args.no_cleanup:
+        for i in range(issued):
+            try:
+                await client.delete(pool_id, oid(i))
+            except Exception:
+                pass
+    return 0
 
 
 async def run(args) -> int:
@@ -77,6 +169,8 @@ async def run(args) -> int:
         elif args.cmd == "ls":
             for name in await client.list_objects(pool_id):
                 print(name)
+        elif args.cmd == "bench":
+            return await _bench(client, pool_id, args)
         return 0
     finally:
         await client.stop()

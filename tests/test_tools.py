@@ -331,3 +331,147 @@ class TestCephadmDeploy:
             adm("rm-cluster", "--name", "c2", "--force")
             if cephadm._alive(spec["pid"]):
                 os.kill(spec["pid"], 9)
+
+
+class TestPoolLifecycleCli:
+    def test_pool_create_set_rm_via_ceph_cli(self):
+        """`ceph osd pool create/set/ls/rm`: deletion needs the
+        double-name + flag guard, and OSDs purge the pool's data."""
+        import asyncio
+        import io
+        import json as _json
+        from contextlib import redirect_stdout
+
+        from ceph_tpu.rados.vstart import Cluster
+
+        async def go():
+            cluster = Cluster(n_osds=4, conf={"osd_auto_repair": False})
+            await cluster.start()
+            try:
+                from ceph_tpu.tools.ceph import parse_args
+                from ceph_tpu.tools.ceph import run as ceph_run
+
+                mon = f"{cluster.mons[0].addr[0]}:{cluster.mons[0].addr[1]}"
+
+                async def ceph(*words, fmt="plain"):
+                    buf = io.StringIO()
+                    with redirect_stdout(buf):
+                        rc = await ceph_run(parse_args(
+                            ["--mon", mon, "--format", fmt, *words]))
+                    return rc, buf.getvalue()
+
+                rc, _ = await ceph("osd", "pool", "create", "data",
+                                   "k=2", "m=1")
+                assert rc == 0
+                rc, out = await ceph("osd", "pool", "ls", fmt="json")
+                pools = _json.loads(out)
+                assert [p["name"] for p in pools] == ["data"]
+                rc, _ = await ceph("osd", "pool", "set", "data",
+                                   "pg_num", "16")
+                assert rc == 0
+                rc, out = await ceph("osd", "pool", "ls", fmt="json")
+                assert _json.loads(out)[0]["pg_num"] == 16
+                # write an object, then remove the pool
+                c = await cluster.client()
+                pid = _json.loads(out)[0]["id"]
+                await c.put(pid, "doomed", b"bytes" * 100)
+                assert await c.get(pid, "doomed") == b"bytes" * 100
+                # guard: no flag / name mismatch refused
+                rc, _ = await ceph("osd", "pool", "rm", "data", "data")
+                assert rc == 1
+                rc, _ = await ceph("osd", "pool", "rm", "data", "typo",
+                                   "--yes-i-really-really-mean-it")
+                assert rc == 1
+                rc, _ = await ceph("osd", "pool", "rm", "data", "data",
+                                   "--yes-i-really-really-mean-it")
+                assert rc == 0
+                rc, out = await ceph("osd", "pool", "ls", fmt="json")
+                assert _json.loads(out) == []
+                # OSDs purged the stored shards once the map caught up
+                await c.refresh_map()
+                import time as _time
+                deadline = _time.monotonic() + 10
+                def residue():
+                    return sum(
+                        1 for osd in cluster.osds.values()
+                        for _o in osd.store.list_objects(pid))
+                while residue() and _time.monotonic() < deadline:
+                    await asyncio.sleep(0.2)
+                assert residue() == 0
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        asyncio.run(go())
+
+    def test_rados_bench(self):
+        import asyncio
+        import io
+        import json as _json
+        from contextlib import redirect_stdout
+
+        from ceph_tpu.rados.vstart import Cluster
+
+        async def go():
+            cluster = Cluster(n_osds=4, conf={"osd_auto_repair": False})
+            await cluster.start()
+            try:
+                from ceph_tpu.tools.rados import parse_args
+                from ceph_tpu.tools.rados import run as rados_run
+
+                mon = f"{cluster.mons[0].addr[0]}:{cluster.mons[0].addr[1]}"
+
+                async def rados(*argv):
+                    buf = io.StringIO()
+                    with redirect_stdout(buf):
+                        rc = await rados_run(parse_args(
+                            ["--mon", mon, *argv]))
+                    return rc, buf.getvalue()
+
+                rc, _ = await rados("mkpool", "bp", "k=2", "m=1")
+                assert rc == 0
+                rc, out = await rados(
+                    "bench", "bp", "2", "write",
+                    "--object-size", str(64 * 1024),
+                    "--concurrency", "4", "--no-cleanup")
+                assert rc == 0
+                stats = _json.loads(out)
+                assert stats["ops"] > 0 and stats["bandwidth_MBps"] > 0
+                rc, out = await rados(
+                    "bench", "bp", "2", "seq",
+                    "--object-size", str(64 * 1024), "--concurrency", "4")
+                assert rc == 0
+                stats = _json.loads(out)
+                assert stats["mode"] == "seq" and stats["ops"] > 0
+            finally:
+                await cluster.stop()
+
+        asyncio.run(go())
+
+    def test_boot_sweep_purges_pool_deleted_while_down(self):
+        """An OSD that missed the `osd pool rm` epoch purges the dead
+        pool's shards from its persistent store on its FIRST map."""
+        import asyncio
+
+        from ceph_tpu.rados.store import MemStore, ShardMeta, Transaction
+        from ceph_tpu.rados.types import OSDMap, PoolInfo
+        from ceph_tpu.rados.crush import CrushMap
+
+        async def go():
+            from ceph_tpu.rados.osd import OSD
+
+            osd = OSD(("127.0.0.1", 1), store=MemStore(), osd_id=0)
+            txn = Transaction()
+            meta = ShardMeta(version=1, object_size=4)
+            txn.write((7, "ghost", 0), b"dead", meta)   # deleted pool
+            txn.write((1, "alive", 0), b"live", meta)   # surviving pool
+            osd.store.queue_transaction(txn)
+            live_pool = PoolInfo(pool_id=1, name="keep",
+                                 pool_type="replicated", pg_num=8,
+                                 size=2, min_size=1)
+            osd._on_map(OSDMap(epoch=5, pools={1: live_pool},
+                               crush=CrushMap.flat([0])))
+            assert list(osd.store.list_objects(7)) == []
+            assert list(osd.store.list_objects(1)) == [("alive", 0)]
+
+        asyncio.run(go())
