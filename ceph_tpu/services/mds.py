@@ -253,6 +253,44 @@ class FileSystem:
         elif op == "rename":
             for sub in ev["events"]:
                 await self._apply_event(sub)
+        elif op == "rename_dir":
+            # ordering for lock-free readers: write every destination
+            # dirfrag from the JOURNALED post-state, flip the parent
+            # dentries (dst set, src rm — never-neither), THEN delete
+            # the old dirfrag objects.  At every point the namespace
+            # resolves: pre-flip readers walk src over still-present
+            # old frags, post-flip readers walk dst over the new ones.
+            sdentries = await self._load_dir(ev["sparent"])
+            fully_applied = (sdentries is not None
+                             and ev["sname"] not in sdentries)
+            if not fully_applied:
+                for rel, frag in ev["frags"].items():
+                    new_path = posixpath.join(ev["dst"], rel) if rel \
+                        else ev["dst"]
+                    await self._save_dir(new_path, frag)
+                ddentries = await self._load_dir(ev["dparent"])
+                if ddentries is not None:
+                    ddentries[ev["dname"]] = ev["dentry"]
+                    await self._save_dir(ev["dparent"], ddentries)
+                sdentries = await self._load_dir(ev["sparent"])
+                if sdentries is not None and ev["sname"] in sdentries:
+                    del sdentries[ev["sname"]]
+                    await self._save_dir(ev["sparent"], sdentries)
+            # old-frag cleanup (also on replay after a crash between the
+            # flip and the deletes): remove a source object only if its
+            # CONTENT matches the journaled post-state — a re-created
+            # directory at the old path has different contents and is
+            # left alone (content-addressed idempotency)
+            for rel, frag in ev["frags"].items():
+                old_path = posixpath.join(ev["src"], rel) if rel \
+                    else ev["src"]
+                cur = await self._load_dir(old_path)
+                if cur is None or (fully_applied and cur != frag):
+                    continue
+                try:
+                    await self.meta.remove(self._dir_oid(old_path))
+                except RadosError:
+                    pass
         elif op == "snap_create":
             table = await self._load_snaptable()
             table[ev["key"]] = {"root": ev["root"], "name": ev["name"],
@@ -402,8 +440,13 @@ class FileSystem:
             await self._journal_applied()
 
     async def rename(self, src: str, dst: str) -> None:
-        """Dentry-only move: the inode id stays, so no data transfer and
-        no window where the data exists twice."""
+        """File rename is a dentry-only move (the inode id stays, so no
+        data transfer and no window where the data exists twice).
+        Directory rename additionally RE-KEYS the subtree's dirfrag
+        objects — dirfrags are path-keyed here, so this is O(subtree)
+        where the reference's inode-keyed layout is O(1); the whole
+        re-key rides ONE journal event, so replay finishes a half-moved
+        tree."""
         src, dst = self._norm(src), self._norm(dst)
         async with self._mutate:
             sparent, sname, sdentries = await self._parent_of(src)
@@ -411,7 +454,8 @@ class FileSystem:
             if ent is None:
                 raise FsError(f"ENOENT: {src}")
             if ent["type"] == "dir":
-                raise FsError("EINVAL: dir rename unsupported in mds-lite")
+                await self._rename_dir_locked(src, dst, ent)
+                return
             dparent, dname, ddentries = await self._parent_of(dst)
             if ddentries.get(dname, {}).get("type") == "dir":
                 raise FsError(f"EISDIR: {dst}")
@@ -601,6 +645,38 @@ class FileSystem:
             raise FsError(f"EISDIR: {rel}")
         return await self.striper.read(self._file_oid(ent["ino"]))
 
+    async def _rename_dir_locked(self, src: str, dst: str,
+                                 ent: Dict) -> None:
+        """Directory move (caller holds _mutate).  Guards: dst must not
+        exist (no dir-over-dir replace), dst must not be inside src
+        (EINVAL, the classic cycle), parents must exist.  The journal
+        event carries the POST-STATE dirfrag contents (like every other
+        event), so replay never re-reads live objects a later mkdir may
+        have re-created."""
+        if src == dst:
+            return  # POSIX: same entry, success
+        if is_under(dst, src):
+            raise FsError(f"EINVAL: cannot move {src} into itself")
+        dparent, dname, ddentries = await self._parent_of(dst)
+        if dname in ddentries:
+            raise FsError(f"EEXIST: {dst}")
+        # post-state snapshot: rel dir path -> its dentries (root = "")
+        frags: Dict[str, Dict] = {
+            "": dict(await self._load_dir(src) or {})}
+        for rel, e in (await self._collect_tree(src)).items():
+            if e["type"] == "dir":
+                frags[rel] = dict(
+                    await self._load_dir(posixpath.join(src, rel)) or {})
+        sparent = posixpath.dirname(src)
+        sname = posixpath.basename(src)
+        event = {"op": "rename_dir", "src": src, "dst": dst,
+                 "frags": frags,
+                 "sparent": sparent, "sname": sname,
+                 "dparent": dparent, "dname": dname, "dentry": ent}
+        await self._journal(event)
+        await self._apply_event(event)
+        await self._journal_applied()
+
     async def walk(self, path: str = "/") -> Dict:
         """Recursive tree dump (debugging/`ceph fs dump` role)."""
         path = self._norm(path)
@@ -778,10 +854,49 @@ class MDSServer:
         await self.fs.unlink(path)
         self._drop(FileSystem._norm(path), session.session_id)
 
+    def _revoke_subtree(self, root: str, keep_session: str) -> bool:
+        """Queue revokes for every OTHER session's caps under `root`
+        (directory rename must not strand caps naming dead paths);
+        returns True if a live conflicting holder remains."""
+        root = FileSystem._norm(root)
+        conflict = False
+        for path, holders in list(self._caps.items()):
+            if not is_under(path, root):
+                continue
+            for sid in list(holders):
+                if sid == keep_session:
+                    continue
+                if self._evict_if_dead(sid):
+                    continue
+                other = self.sessions[sid]
+                if path not in other.revoked:
+                    other.revoked.append(path)
+                conflict = True
+        return conflict
+
     async def rename(self, session: MDSSession, src: str, dst: str) -> None:
         self._require(session, src, "rw")
         self._require(session, dst, "rw")
+        src_n, dst_n = FileSystem._norm(src), FileSystem._norm(dst)
+        is_dir = False
+        try:
+            is_dir = (await self.fs.stat(src_n))["type"] == "dir"
+        except FsError:
+            pass
+        if is_dir and self._revoke_subtree(src_n, session.session_id):
+            # live holders under the moving tree must flush + release
+            # first, or their write-behind would later flush into dead
+            # paths (same compliance contract as subtree export)
+            raise CapConflict(
+                f"EAGAIN: caps under {src_n} held elsewhere")
         await self.fs.rename(src, dst)
+        if is_dir:
+            # every cap under either path now names a dead (or brand
+            # new) path: drop them; clients re-acquire at the new paths
+            for path in list(self._caps):
+                if is_under(path, src_n) or is_under(path, dst_n):
+                    for sid in list(self._caps.get(path, {})):
+                        self._drop(path, sid)
 
     async def listdir(self, session: MDSSession, path: str) -> List[str]:
         self._require(session, path, "r")
@@ -950,6 +1065,38 @@ class CephFSClient:
         self._clean.pop(p, None)
         await self._acquire(path, "rw")
         await self.mds.unlink(self.session, path)
+
+    async def rename(self, path: str, dst: str) -> None:
+        """Rename through the server (cap-checked; directory renames
+        force other holders under the tree to comply first).  The local
+        cache entries under BOTH paths are purged — they name dead
+        paths afterwards."""
+        s, d = FileSystem._norm(path), FileSystem._norm(dst)
+        await self.renew()
+        # our own write-behind under the source tree must land first:
+        # it flushes by OLD path, which is only writable pre-rename
+        for dirty in list(self._dirty):
+            if is_under(dirty, s):
+                await self._flush_path(dirty)
+        # few internal retries only: OTHER holders comply through THEIR
+        # renewals, which an embedding facade drives between ITS retries
+        # — spinning here would just delay that outer loop
+        for attempt in range(3):
+            try:
+                await self.mds.rename(self.session, s, d)
+                break
+            except CapConflict:
+                await self.renew()
+                if attempt == 2:
+                    raise
+                await asyncio.sleep(0.02)
+        for cache in (self._dirty, self._clean):
+            for p in list(cache):
+                if is_under(p, s) or is_under(p, d):
+                    cache.pop(p, None)
+        for p in list(self.session.caps):
+            if is_under(p, s) or is_under(p, d):
+                self.mds.release_cap(self.session, p)
 
     # -- snapshots -----------------------------------------------------------
 

@@ -68,6 +68,10 @@ class MDSCluster:
         self._frozen: set = set()      # subtree roots mid-export
         self.rank_ops: List[int] = []  # balancer heat, per rank
         self._dir_ops: Dict[str, int] = {}  # top-level dir -> ops
+        # serializes TOPOLOGY-changing operations (subtree export and
+        # directory rename): a directory rename racing an export could
+        # otherwise commit a subtree root whose path just moved
+        self._topology = asyncio.Lock()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -154,37 +158,47 @@ class MDSCluster:
     async def export_dir(self, path: str, to_rank: int) -> None:
         """Move authority over the subtree at `path` to `to_rank`:
         freeze -> revoke caps -> drain + flush exporter journal ->
-        persist pending -> commit map -> thaw."""
-        path = _norm(path)
-        if not (0 <= to_rank < self.n_ranks):
-            raise FsError(f"EINVAL: no rank {to_rank}")
-        from_rank = self.rank_of(path)
-        if from_rank == to_rank:
-            return
-        src = self.ranks[from_rank]
-        st = await src.fs.stat(path)
-        if st["type"] != "dir":
-            raise FsError(f"ENOTDIR: {path}")
-        if path in self._frozen:
-            raise FsError(f"EAGAIN: {path} already migrating")
-        self._frozen.add(path)
-        try:
-            await self._revoke_subtree_caps(src, path)
-            # drain in-flight mutations, then flush: roll closes the
-            # write segment so expire retires EVERY applied event —
-            # without the roll, current-segment events survive and a
-            # later replace_rank() of the exporter would replay them
-            # onto dirfrags the importer has since rewritten
-            async with src.fs._mutate:
-                if src.fs.mdlog is not None:
-                    await src.fs.mdlog.roll()
-                    await src.fs.mdlog.expire()
-            # two-phase commit against the persisted map
-            await self._save_map(pending={"path": path, "to": to_rank})
-            self.subtrees[path] = to_rank
-            await self._save_map(pending=None)
-        finally:
-            self._frozen.discard(path)
+        persist pending -> commit map -> thaw.  Holds the topology lock
+        so a concurrent directory rename cannot move the path out from
+        under the commit."""
+        async with self._topology:
+            path = _norm(path)
+            if not (0 <= to_rank < self.n_ranks):
+                raise FsError(f"EINVAL: no rank {to_rank}")
+            from_rank = self.rank_of(path)
+            if from_rank == to_rank:
+                return
+            src = self.ranks[from_rank]
+            st = await src.fs.stat(path)
+            if st["type"] != "dir":
+                raise FsError(f"ENOTDIR: {path}")
+            if path in self._frozen:
+                raise FsError(f"EAGAIN: {path} already migrating")
+            self._frozen.add(path)
+            try:
+                await self._revoke_subtree_caps(src, path)
+                # drain in-flight mutations, then flush: roll closes the
+                # write segment so expire retires EVERY applied event —
+                # without the roll, current-segment events survive and a
+                # later replace_rank() of the exporter would replay them
+                # onto dirfrags the importer has since rewritten.  The
+                # map commit stays INSIDE the rank lock: with the drain
+                # barrier held, nothing can rename the path between the
+                # re-validation and the commit.
+                async with src.fs._mutate:
+                    if src.fs.mdlog is not None:
+                        await src.fs.mdlog.roll()
+                        await src.fs.mdlog.expire()
+                    if await src.fs._load_dir(path) is None:
+                        raise FsError(f"EAGAIN: {path} vanished "
+                                      f"before export commit")
+                    # two-phase commit against the persisted map
+                    await self._save_map(
+                        pending={"path": path, "to": to_rank})
+                    self.subtrees[path] = to_rank
+                    await self._save_map(pending=None)
+            finally:
+                self._frozen.discard(path)
 
     async def _revoke_subtree_caps(self, src: MDSServer, root: str) -> None:
         """Queue revokes for every cap under the subtree and wait for
@@ -354,7 +368,25 @@ class MDSCluster:
         self._check_frozen(dst_path)
         r_src, r_dst = self.rank_of(src_path), self.rank_of(dst_path)
         if r_src == r_dst:
-            await self.ranks[r_src].fs.rename(src_path, dst_path)
+            # a directory move must not carry a SUBTREE ROOT to a new
+            # path — the subtree map keys authority by path, so the
+            # root would dangle; export it away first (EXDEV, like the
+            # reference's unmovable subtree bounds).  The topology lock
+            # orders this decision against concurrent exports.
+            async with self._topology:
+                try:
+                    st = await self.ranks[r_src].fs.stat(src_path)
+                except FsError:
+                    st = {}
+                if st.get("type") == "dir":
+                    # covers the src being a root ITSELF too: its map
+                    # entry would name a dead path after the move
+                    for root in self.subtrees:
+                        if root != "/" and _is_under(root, src_path):
+                            raise FsError(
+                                f"EXDEV: {src_path} contains/is subtree "
+                                f"root {root}; move authority first")
+                await self.ranks[r_src].fs.rename(src_path, dst_path)
             return
         fs_src, fs_dst = self.ranks[r_src].fs, self.ranks[r_dst].fs
         first, second = sorted((fs_src, fs_dst), key=id)
@@ -367,7 +399,9 @@ class MDSCluster:
                     raise FsError(f"ENOENT: {src_path}")
                 ent = sdentries[sname]
                 if ent["type"] == "dir":
-                    raise FsError("EINVAL: dir rename unsupported")
+                    raise FsError("EXDEV: cross-rank directory rename "
+                                  "unsupported; export the subtree "
+                                  "instead")
                 dparent = posixpath.dirname(dst_path)
                 dname = posixpath.basename(dst_path)
                 ddentries = await fs_dst._load_dir(dparent)
@@ -498,28 +532,54 @@ class CephFSMultiClient:
 
     async def rename(self, src: str, dst: str,
                      retries: int = 100, delay: float = 0.02) -> None:
-        """Cross-rank renames go through the cluster's two-lock path.
-        The SOURCE's write-behind bytes are flushed first (they are the
-        content being renamed); the DESTINATION's caches are dropped
-        WITHOUT flushing — the rename clobbers that content by
-        definition, and a later flush of stale dst bytes would overwrite
-        the renamed file.  A frozen subtree (mid-export) retries like
-        every other facade op."""
-        from ceph_tpu.services.mds import FileSystem
-        s, d = FileSystem._norm(src), FileSystem._norm(dst)
+        """Same-rank renames (files AND directories) go through the
+        authoritative rank's SERVER, so cap holders under a moving
+        directory are forced to comply first; the topology lock keeps
+        directory moves ordered against subtree exports.  Cross-rank
+        renames (files only) take the cluster's two-lock path.  The
+        SOURCE's write-behind is flushed first; DESTINATION caches are
+        dropped WITHOUT flushing — the rename clobbers that content by
+        definition.  Frozen subtrees retry like every other facade op."""
+        s, d = _norm(src), _norm(dst)
         for attempt in range(retries):
             try:
                 self.cluster._check_frozen(s)
                 self.cluster._check_frozen(d)
-                await self._routed(s, "fsync")
+                r_src, r_dst = self.cluster.rank_of(s), \
+                    self.cluster.rank_of(d)
+                if r_src == r_dst:
+                    async with self.cluster._topology:
+                        try:
+                            st = await self.cluster.ranks[
+                                r_src].fs.stat(s)
+                        except FsError:
+                            st = {}
+                        if st.get("type") == "dir":
+                            for root in self.cluster.subtrees:
+                                if root != "/" and _is_under(root, s):
+                                    raise FsError(
+                                        f"EXDEV: {s} contains/is "
+                                        f"subtree root {root}; move "
+                                        f"authority first")
+                        await self._handoff(s, r_src)
+                        await self._client_for(r_src).rename(s, d)
+                else:
+                    await self._routed(s, "fsync")
+                    for c in self._clients.values():
+                        c._dirty.pop(d, None)
+                        c._clean.pop(d, None)
+                        c._clean.pop(s, None)
+                        for p in (s, d):
+                            if p in c.session.caps:
+                                c.mds.release_cap(c.session, p)
+                    await self.cluster.rename(s, d)
+                # purge EVERY client's caches under both trees (the
+                # rename may have moved a whole subtree)
                 for c in self._clients.values():
-                    c._dirty.pop(d, None)
-                    c._clean.pop(d, None)
-                    c._clean.pop(s, None)
-                    for p in (s, d):
-                        if p in c.session.caps:
-                            c.mds.release_cap(c.session, p)
-                await self.cluster.rename(s, d)
+                    for cache in (c._dirty, c._clean):
+                        for p in list(cache):
+                            if _is_under(p, s) or _is_under(p, d):
+                                cache.pop(p, None)
                 return
             except FsError as e:
                 if "EAGAIN" not in str(e) or attempt == retries - 1:

@@ -423,3 +423,193 @@ class TestConcurrencyRegression:
                 await rados.shutdown()
                 await cluster.stop()
         run(go())
+
+
+class TestDirectoryRename:
+    def test_dir_rename_moves_subtree(self):
+        """Directory rename re-keys every descendant dirfrag; files keep
+        their inodes (no data movement)."""
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=1).start()
+                fsc = CephFSMultiClient(mc)
+                await fsc.mkdir("/proj")
+                await fsc.mkdir("/proj/src")
+                await fsc.mkdir("/proj/src/deep")
+                await fsc.write("/proj/src/deep/f", b"payload")
+                await fsc.fsync("/proj/src/deep/f")
+                await fsc.mkdir("/archive")
+                await fsc.rename("/proj/src", "/archive/v1")
+                assert await fsc.read("/archive/v1/deep/f") == b"payload"
+                assert await fsc.listdir("/archive/v1") == ["deep"]
+                assert await fsc.listdir("/proj") == []
+                with pytest.raises(FsError):
+                    await fsc.listdir("/proj/src")
+                # cycle guard
+                await fsc.mkdir("/proj/a")
+                with pytest.raises(FsError) as ei:
+                    await mc.ranks[0].fs.rename("/proj", "/proj/a/x")
+                assert "EINVAL" in str(ei.value)
+                # dir-over-dir refused
+                with pytest.raises(FsError) as ei:
+                    await mc.ranks[0].fs.rename("/archive/v1", "/proj/a")
+                assert "EEXIST" in str(ei.value)
+                await fsc.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_dir_rename_replay_completes_half_move(self):
+        """Crash mid re-key: the journaled event finishes the move on
+        replay (some dirfrags moved, dentries not yet flipped)."""
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=1).start()
+                fs = mc.ranks[0].fs
+                await fs.mkdir("/d")
+                await fs.mkdir("/d/sub")
+                await fs.write_file("/d/sub/f", b"x")
+                # simulate the crash window BY HAND: journal the event
+                # (carrying post-state frags), re-key only PART of the
+                # tree, never flip dentries
+                frags = {"": dict(await fs._load_dir("/d")),
+                         "sub": dict(await fs._load_dir("/d/sub"))}
+                event = {"op": "rename_dir", "src": "/d", "dst": "/moved",
+                         "frags": frags, "sparent": "/", "sname": "d",
+                         "dparent": "/", "dname": "moved",
+                         "dentry": {"type": "dir", "mtime": 0.0}}
+                await fs._journal(event)
+                await fs._save_dir("/moved/sub", frags["sub"])
+                await fs.meta.remove(fs._dir_oid("/d/sub"))
+                # replay via a standby mount
+                from ceph_tpu.services.mds import FileSystem
+                standby = FileSystem(io, journal_prefix="mds0.")
+                await standby.mount()
+                assert await standby.read_file("/moved/sub/f") == b"x"
+                assert "moved" in await standby.listdir("/")
+                assert "d" not in await standby.listdir("/")
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_subtree_root_guard(self):
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=2).start()
+                fsc = CephFSMultiClient(mc)
+                await fsc.mkdir("/team")
+                await fsc.mkdir("/team/hot")
+                await mc.export_dir("/team/hot", 1)
+                await fsc.mkdir("/attic")
+                # moving a dir that CONTAINS a subtree root: refused
+                with pytest.raises(FsError) as ei:
+                    await fsc.rename("/team", "/attic/team")
+                assert "EXDEV" in str(ei.value)
+                # cross-rank dir rename: refused
+                with pytest.raises(FsError) as ei:
+                    await fsc.rename("/attic", "/team/hot/attic")
+                assert "EXDEV" in str(ei.value)
+                await fsc.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+
+class TestDirRenameReviewFindings:
+    def test_replay_spares_recreated_source(self):
+        """A source path re-created AFTER the rename must survive a
+        replay of the rename event (journaled post-state, not live
+        re-reads)."""
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                from ceph_tpu.services.mds import FileSystem
+                fs = FileSystem(io)
+                await fs.mkfs()
+                await fs.mount()
+                await fs.mkdir("/d")
+                await fs.mkdir("/d/sub")
+                await fs.write_file("/d/sub/f", b"keep-me")
+                await fs.rename("/d", "/b")
+                # re-create the old path with DIFFERENT content
+                await fs.mkdir("/d")
+                await fs.mkdir("/d/sub")
+                await fs.write_file("/d/sub/new", b"fresh")
+                # crash + replay (journal unexpired): neither tree is
+                # harmed
+                standby = FileSystem(io)
+                await standby.mount()
+                assert await standby.read_file("/b/sub/f") == b"keep-me"
+                assert await standby.read_file("/d/sub/new") == b"fresh"
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_dir_rename_revokes_other_holders_caps(self):
+        """A second client with write-behind under the moving tree is
+        forced to flush+release before the rename lands; its bytes land
+        at the OLD path and move with the tree."""
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=1).start()
+                a = CephFSMultiClient(mc, "a", renew_interval=0.01)
+                b = CephFSMultiClient(mc, "b", renew_interval=0.01)
+                await a.mkdir("/d")
+                await b.write("/d/f", b"b-bytes")  # write-behind at b
+                rename = asyncio.create_task(a.rename("/d", "/moved"))
+                for _ in range(200):
+                    if rename.done():
+                        break
+                    await b.renew_all()
+                    await asyncio.sleep(0.01)
+                await rename
+                assert await a.read("/moved/f") == b"b-bytes"
+                # b's stale cache was revoked; it reads the new path
+                assert await b.read("/moved/f") == b"b-bytes"
+                await a.unmount()
+                await b.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_rename_dir_onto_itself_is_noop(self):
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=1).start()
+                fs = mc.ranks[0].fs
+                await fs.mkdir("/same")
+                await fs.write_file("/same/f", b"x")
+                await fs.rename("/same", "/same")  # POSIX: success
+                assert await fs.read_file("/same/f") == b"x"
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_moving_a_subtree_root_itself_is_exdev(self):
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=2).start()
+                fsc = CephFSMultiClient(mc)
+                await fsc.mkdir("/hot")
+                await mc.export_dir("/hot", 1)
+                await fsc.mkdir("/cold")
+                with pytest.raises(FsError) as ei:
+                    await fsc.rename("/hot", "/cold/hot")
+                assert "EXDEV" in str(ei.value)
+                await fsc.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
