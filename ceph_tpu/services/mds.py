@@ -660,13 +660,20 @@ class FileSystem:
         dparent, dname, ddentries = await self._parent_of(dst)
         if dname in ddentries:
             raise FsError(f"EEXIST: {dst}")
-        # post-state snapshot: rel dir path -> its dentries (root = "")
-        frags: Dict[str, Dict] = {
-            "": dict(await self._load_dir(src) or {})}
-        for rel, e in (await self._collect_tree(src)).items():
-            if e["type"] == "dir":
-                frags[rel] = dict(
-                    await self._load_dir(posixpath.join(src, rel)) or {})
+        # post-state snapshot: rel dir path -> its dentries (root = ""),
+        # collected in ONE walk (each dirfrag read exactly once while
+        # the rank lock is held)
+        frags: Dict[str, Dict] = {}
+
+        async def collect(path: str, rel: str) -> None:
+            dentries = dict(await self._load_dir(path) or {})
+            frags[rel] = dentries
+            for name, e in dentries.items():
+                if e["type"] == "dir":
+                    await collect(posixpath.join(path, name),
+                                  f"{rel}/{name}" if rel else name)
+
+        await collect(src, "")
         sparent = posixpath.dirname(src)
         sname = posixpath.basename(src)
         event = {"op": "rename_dir", "src": src, "dst": dst,

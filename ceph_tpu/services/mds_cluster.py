@@ -161,30 +161,33 @@ class MDSCluster:
         persist pending -> commit map -> thaw.  Holds the topology lock
         so a concurrent directory rename cannot move the path out from
         under the commit."""
-        async with self._topology:
-            path = _norm(path)
-            if not (0 <= to_rank < self.n_ranks):
-                raise FsError(f"EINVAL: no rank {to_rank}")
-            from_rank = self.rank_of(path)
-            if from_rank == to_rank:
-                return
-            src = self.ranks[from_rank]
-            st = await src.fs.stat(path)
-            if st["type"] != "dir":
-                raise FsError(f"ENOTDIR: {path}")
-            if path in self._frozen:
-                raise FsError(f"EAGAIN: {path} already migrating")
-            self._frozen.add(path)
-            try:
-                await self._revoke_subtree_caps(src, path)
-                # drain in-flight mutations, then flush: roll closes the
-                # write segment so expire retires EVERY applied event —
-                # without the roll, current-segment events survive and a
-                # later replace_rank() of the exporter would replay them
-                # onto dirfrags the importer has since rewritten.  The
-                # map commit stays INSIDE the rank lock: with the drain
-                # barrier held, nothing can rename the path between the
-                # re-validation and the commit.
+        path = _norm(path)
+        if not (0 <= to_rank < self.n_ranks):
+            raise FsError(f"EINVAL: no rank {to_rank}")
+        from_rank = self.rank_of(path)
+        if from_rank == to_rank:
+            return
+        src = self.ranks[from_rank]
+        st = await src.fs.stat(path)
+        if st["type"] != "dir":
+            raise FsError(f"ENOTDIR: {path}")
+        if path in self._frozen:
+            raise FsError(f"EAGAIN: {path} already migrating")
+        self._frozen.add(path)
+        try:
+            # the revoke wait (up to revoke_timeout of client
+            # compliance) runs OUTSIDE the topology lock: one slow
+            # client must not stall unrelated exports/renames
+            await self._revoke_subtree_caps(src, path)
+            # drain in-flight mutations, then flush: roll closes the
+            # write segment so expire retires EVERY applied event —
+            # without the roll, current-segment events survive and a
+            # later replace_rank() of the exporter would replay them
+            # onto dirfrags the importer has since rewritten.  The
+            # re-validation + map commit hold topology + rank locks:
+            # a directory rename (which takes the same pair) cannot
+            # move the path between them.
+            async with self._topology:
                 async with src.fs._mutate:
                     if src.fs.mdlog is not None:
                         await src.fs.mdlog.roll()
@@ -197,8 +200,8 @@ class MDSCluster:
                         pending={"path": path, "to": to_rank})
                     self.subtrees[path] = to_rank
                     await self._save_map(pending=None)
-            finally:
-                self._frozen.discard(path)
+        finally:
+            self._frozen.discard(path)
 
     async def _revoke_subtree_caps(self, src: MDSServer, root: str) -> None:
         """Queue revokes for every cap under the subtree and wait for
@@ -252,6 +255,16 @@ class MDSCluster:
             await self.ranks[0].fs._snap_delete_locked(path, name)
             for r in self.ranks:
                 r.fs.invalidate_snap_cache()
+
+    def _guard_dir_move(self, src_path: str) -> None:
+        """A directory move must not carry (or be) a SUBTREE ROOT — the
+        map keys authority by path, so the root would dangle; export
+        authority away first (EXDEV, the reference's unmovable subtree
+        bounds).  Call with the topology lock held."""
+        for root in self.subtrees:
+            if root != "/" and _is_under(root, src_path):
+                raise FsError(f"EXDEV: {src_path} contains/is subtree "
+                              f"root {root}; move authority first")
 
     # -- cross-rank rename intent log ----------------------------------------
     # One log object per SOURCE rank ("mds<r>.rename_log"): an entry is
@@ -368,25 +381,27 @@ class MDSCluster:
         self._check_frozen(dst_path)
         r_src, r_dst = self.rank_of(src_path), self.rank_of(dst_path)
         if r_src == r_dst:
-            # a directory move must not carry a SUBTREE ROOT to a new
-            # path — the subtree map keys authority by path, so the
-            # root would dangle; export it away first (EXDEV, like the
-            # reference's unmovable subtree bounds).  The topology lock
-            # orders this decision against concurrent exports.
+            server = self.ranks[r_src]
+            is_dir = False
+            try:
+                is_dir = (await server.fs.stat(src_path))["type"] == "dir"
+            except FsError:
+                pass
+            if is_dir:
+                # other sessions' caps under the moving tree must be
+                # revoked first (their write-behind would flush into
+                # dead paths) — same compliance wait as export
+                await self._revoke_subtree_caps(server, src_path)
             async with self._topology:
-                try:
-                    st = await self.ranks[r_src].fs.stat(src_path)
-                except FsError:
-                    st = {}
-                if st.get("type") == "dir":
-                    # covers the src being a root ITSELF too: its map
-                    # entry would name a dead path after the move
-                    for root in self.subtrees:
-                        if root != "/" and _is_under(root, src_path):
-                            raise FsError(
-                                f"EXDEV: {src_path} contains/is subtree "
-                                f"root {root}; move authority first")
-                await self.ranks[r_src].fs.rename(src_path, dst_path)
+                if is_dir:
+                    self._guard_dir_move(src_path)
+                await server.fs.rename(src_path, dst_path)
+            if is_dir:
+                # caps under either tree now name dead paths
+                for p in list(server._caps):
+                    if _is_under(p, src_path) or _is_under(p, dst_path):
+                        for sid in list(server._caps.get(p, {})):
+                            server._drop(p, sid)
             return
         fs_src, fs_dst = self.ranks[r_src].fs, self.ranks[r_dst].fs
         first, second = sorted((fs_src, fs_dst), key=id)
@@ -555,12 +570,7 @@ class CephFSMultiClient:
                         except FsError:
                             st = {}
                         if st.get("type") == "dir":
-                            for root in self.cluster.subtrees:
-                                if root != "/" and _is_under(root, s):
-                                    raise FsError(
-                                        f"EXDEV: {s} contains/is "
-                                        f"subtree root {root}; move "
-                                        f"authority first")
+                            self.cluster._guard_dir_move(s)
                         await self._handoff(s, r_src)
                         await self._client_for(r_src).rename(s, d)
                 else:

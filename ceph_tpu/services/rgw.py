@@ -55,7 +55,7 @@ import json
 import time
 import uuid
 from typing import Dict, List, Optional, Tuple
-from urllib.parse import parse_qsl, unquote, urlsplit
+from urllib.parse import parse_qsl, quote, unquote, urlsplit
 
 from ceph_tpu.rados.client import RadosError
 from ceph_tpu.rados.librados import IoCtx
@@ -1271,6 +1271,78 @@ def sign_request(access_key: str, secret: str, method: str, path: str,
     return hdrs
 
 
+def presign_url(access_key: str, secret: str, method: str, path: str,
+                host: str, expires: int = 3600,
+                amzdate: Optional[str] = None) -> str:
+    """Client half of query-string auth (reference rgw_auth_s3
+    presigned URLs / AWS SigV4 query parameters): returns path?query
+    that grants `method` on `path` until amzdate+expires, bearer-style
+    — no headers or secret needed by the holder."""
+    if amzdate is None:
+        amzdate = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    date = amzdate[:8]
+    scope = f"{date}/us-east-1/s3/aws4_request"
+    params = [
+        ("X-Amz-Algorithm", "AWS4-HMAC-SHA256"),
+        ("X-Amz-Credential", f"{access_key}/{scope}"),
+        ("X-Amz-Date", amzdate),
+        ("X-Amz-Expires", str(int(expires))),
+        ("X-Amz-SignedHeaders", "host"),
+    ]
+    query = "&".join(f"{k}={quote(v, safe='')}" for k, v in params)
+    # sign the DECODED path (the frontend unquotes before verifying),
+    # ship the ENCODED one (keys with %, spaces, etc. stay valid URLs)
+    creq = canonical_request(method, path, query, {"host": host},
+                             ["host"], "UNSIGNED-PAYLOAD")
+    sts = "\n".join(["AWS4-HMAC-SHA256", amzdate, scope,
+                     hashlib.sha256(creq.encode()).hexdigest()])
+    sig = hmac.new(signing_key(secret, date), sts.encode(),
+                   hashlib.sha256).hexdigest()
+    return f"{quote(path)}?{query}&X-Amz-Signature={sig}"
+
+
+def verify_presigned(credentials: Dict[str, str], method: str, path: str,
+                     query: str, headers: Dict[str, str],
+                     now: Optional[float] = None) -> Optional[str]:
+    """Server half: returns the authenticated access key, or None when
+    the signature is wrong or the grant expired.  The signature covers
+    method+path+query (minus the signature itself) and the host header;
+    the payload is unsigned, as AWS defines for presigned uploads."""
+    q = dict(parse_qsl(query, keep_blank_values=True))
+    sig = q.pop("X-Amz-Signature", "")
+    cred = q.get("X-Amz-Credential", "")
+    access_key, _, scope = cred.partition("/")
+    secret = credentials.get(access_key)
+    if not sig or secret is None:
+        return None
+    amzdate = q.get("X-Amz-Date", "")
+    try:
+        import calendar
+        expires = int(q.get("X-Amz-Expires", "0"))
+        # amzdate is Zulu: timegm, NOT mktime (which reads local time)
+        issued = calendar.timegm(time.strptime(amzdate,
+                                               "%Y%m%dT%H%M%SZ"))
+    except (ValueError, OverflowError):
+        return None
+    if now is None:
+        now = time.time()
+    expires = min(expires, 604800)  # AWS caps presigned life at 7 days
+    if not (0 < expires and issued <= now + 300  # small clock skew
+            and now <= issued + expires):
+        return None
+    canon_q = "&".join(f"{quote(k, safe='')}={quote(v, safe='')}"
+                       for k, v in sorted(q.items()))
+    creq = canonical_request(method, path, canon_q,
+                             {"host": headers.get("host", "")},
+                             ["host"], "UNSIGNED-PAYLOAD")
+    date = scope.split("/")[0] if scope else ""
+    sts = "\n".join(["AWS4-HMAC-SHA256", amzdate, scope,
+                     hashlib.sha256(creq.encode()).hexdigest()])
+    want = hmac.new(signing_key(secret, date), sts.encode(),
+                    hashlib.sha256).hexdigest()
+    return access_key if hmac.compare_digest(want, sig) else None
+
+
 def verify_request(credentials: Dict[str, str], method: str, path: str,
                    query: str, headers: Dict[str, str],
                    payload: bytes) -> bool:
@@ -1368,9 +1440,26 @@ class RgwFrontend:
                 # TTL-bounded user-store refresh: out-of-process admin
                 # changes (suspend, quota) bite live gateways
                 await self.service.maybe_reload_users()
+                presigned = "X-Amz-Signature=" in query
                 if path == "/auth/v1.0" or path.startswith("/v1/"):
                     status, payload, extra = await self._route_swift(
                         method, path, query, body, headers)
+                elif presigned:
+                    # query-string auth (presigned URL): the signature
+                    # IS the credential — no Authorization header
+                    principal = verify_presigned(
+                        self.service.credentials, method, path, query,
+                        headers)
+                    user = self.service.user_by_access(principal)
+                    if principal is None:
+                        status, payload = ("403 Forbidden",
+                                           b"AccessDenied")
+                    elif user is not None and user.get("suspended"):
+                        status, payload = ("403 Forbidden",
+                                           b"UserSuspended")
+                    else:
+                        status, payload = await self._route(
+                            method, path, query, body, principal)
                 elif (self.service.credentials
                         and not verify_request(self.service.credentials,
                                                method, path, query, headers,

@@ -613,3 +613,34 @@ class TestDirRenameReviewFindings:
                 await rados.shutdown()
                 await cluster.stop()
         run(go())
+
+
+class TestClusterRenameRevocation:
+    def test_cluster_level_dir_rename_revokes_caps(self):
+        """The PUBLIC MDSCluster.rename must enforce the same cap
+        revocation as the facade-routed path: a holder's write-behind
+        flushes before the tree moves."""
+        async def go():
+            cluster, rados, io = await _cluster_io()
+            try:
+                mc = await MDSCluster(io, n_ranks=1,
+                                      revoke_timeout=1.0).start()
+                b = CephFSMultiClient(mc, "b", renew_interval=0.01)
+                await b.mkdir("/d")
+                await b.write("/d/f", b"held")  # write-behind at b
+                rename = asyncio.create_task(mc.rename("/d", "/m"))
+                for _ in range(200):
+                    if rename.done():
+                        break
+                    await b.renew_all()
+                    await asyncio.sleep(0.01)
+                await rename
+                assert await mc.ranks[0].fs.read_file("/m/f") == b"held"
+                # holder's caps were dropped (dead paths)
+                assert not any(p.startswith("/d")
+                               for p in mc.ranks[0]._caps)
+                await b.unmount()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())

@@ -369,3 +369,150 @@ class TestUsageAndCli:
                 await c.stop()
                 await cluster.stop()
         run(go())
+
+
+class TestPresignedUrls:
+    def test_presigned_get_put_expiry_and_tamper(self):
+        """Query-string auth: a presigned GET/PUT works with no auth
+        headers; expired or tampered URLs are refused; ACL/policy
+        evaluation uses the signer as principal."""
+        async def go():
+            import time as _time
+
+            from ceph_tpu.services.rgw import presign_url
+
+            cluster, c, rados, svc = await _svc()
+            frontend = None
+            try:
+                admin = RgwAdmin(svc)
+                u = await admin.user_create("frank")
+                ak, sk = u["access_key"], u["secret_key"]
+                creds = {ak: sk}
+                frontend = RgwFrontend(svc)
+                host, port = await frontend.start()
+                hosthdr = f"{host}:{port}"
+                await _req(host, port, creds, "PUT", "/pb", access=ak)
+                await _req(host, port, creds, "PUT", "/pb/doc",
+                           b"shared-bytes", access=ak)
+                # lock the bucket down: anonymous would be denied
+                await _req(host, port, creds, "PUT", "/pb", json.dumps(
+                    {"owner": ak, "grants": []}).encode(),
+                    access=ak, query="acl")
+                st, _ = await _req(host, port, creds, "GET", "/pb/doc")
+                assert st.startswith("403")
+
+                async def raw(method, target, body=b""):
+                    reader, writer = await asyncio.open_connection(
+                        host, port)
+                    writer.write(
+                        f"{method} {target} HTTP/1.1\r\n"
+                        f"host: {hosthdr}\r\n"
+                        f"content-length: {len(body)}\r\n\r\n".encode()
+                        + body)
+                    await writer.drain()
+                    status = (await reader.readline()).decode()
+                    hdrs = {}
+                    while True:
+                        line = await reader.readline()
+                        if line in (b"\r\n", b"\n", b""):
+                            break
+                        k, _, v = line.decode().partition(":")
+                        hdrs[k.strip().lower()] = v.strip()
+                    blen = int(hdrs.get("content-length", 0))
+                    payload = (await reader.readexactly(blen)
+                               if blen else b"")
+                    writer.close()
+                    return status.split(" ", 1)[1].strip(), payload
+
+                # the presigned grant opens exactly that one object
+                url = presign_url(ak, sk, "GET", "/pb/doc", hosthdr)
+                st, body = await raw("GET", url)
+                assert st.startswith("200") and body == b"shared-bytes"
+                # method binding: the GET grant does not authorize PUT
+                st, _ = await raw("PUT", url, b"overwrite")
+                assert st.startswith("403")
+                # a presigned PUT uploads without headers
+                up = presign_url(ak, sk, "PUT", "/pb/upload", hosthdr)
+                st, _ = await raw("PUT", up, b"pushed")
+                assert st.startswith("200")
+                st, body = await _req(host, port, creds, "GET",
+                                      "/pb/upload", access=ak)
+                assert body == b"pushed"
+                # tampered signature refused
+                st, _ = await raw("GET", url[:-4] + "beef")
+                assert st.startswith("403")
+                # expired grant refused
+                old = _time.strftime("%Y%m%dT%H%M%SZ",
+                                     _time.gmtime(_time.time() - 7200))
+                stale = presign_url(ak, sk, "GET", "/pb/doc", hosthdr,
+                                    expires=60, amzdate=old)
+                st, _ = await raw("GET", stale)
+                assert st.startswith("403")
+                # suspension beats a valid presigned URL
+                await admin.user_suspend("frank")
+                st, body = await raw("GET", presign_url(
+                    ak, sk, "GET", "/pb/doc", hosthdr))
+                assert st.startswith("403") and b"UserSuspended" in body
+            finally:
+                if frontend:
+                    await frontend.stop()
+                await rados.shutdown()
+                await c.stop()
+                await cluster.stop()
+        run(go())
+
+    def test_presigned_url_with_awkward_key(self):
+        """Keys containing % and spaces survive the encode/verify
+        round-trip (path is signed decoded, shipped encoded)."""
+        async def go():
+            from ceph_tpu.services.rgw import presign_url
+
+            cluster, c, rados, svc = await _svc()
+            frontend = None
+            try:
+                admin = RgwAdmin(svc)
+                u = await admin.user_create("gina")
+                ak, sk = u["access_key"], u["secret_key"]
+                creds = {ak: sk}
+                frontend = RgwFrontend(svc)
+                host, port = await frontend.start()
+                hosthdr = f"{host}:{port}"
+                await _req(host, port, creds, "PUT", "/aw", access=ak)
+                key = "sale 100%25 off.txt"  # decoded: 'sale 100% off.txt'
+                from urllib.parse import quote, unquote
+                raw_key = unquote(key)
+                # upload via signed headers on the ENCODED path
+                enc_path = "/aw/" + quote(raw_key)
+                # sign_request signs the path as sent; server unquotes
+                # for routing but verifies on the wire path — upload
+                # through the service directly to isolate presign
+                await svc.put_object("aw", raw_key, b"discount")
+                url = presign_url(ak, sk, "GET", f"/aw/{raw_key}",
+                                  hosthdr)
+                reader, writer = await asyncio.open_connection(host,
+                                                               port)
+                writer.write(f"GET {url} HTTP/1.1\r\n"
+                             f"host: {hosthdr}\r\n"
+                             f"content-length: 0\r\n\r\n".encode())
+                await writer.drain()
+                status = (await reader.readline()).decode()
+                hdrs = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = line.decode().partition(":")
+                    hdrs[k.strip().lower()] = v.strip()
+                blen = int(hdrs.get("content-length", 0))
+                payload = (await reader.readexactly(blen)
+                           if blen else b"")
+                writer.close()
+                assert status.split(" ", 1)[1].startswith("200"), status
+                assert payload == b"discount"
+            finally:
+                if frontend:
+                    await frontend.stop()
+                await rados.shutdown()
+                await c.stop()
+                await cluster.stop()
+        run(go())
