@@ -371,7 +371,7 @@ class MCreatePoolReply:
     pool_id: int = -1
 
 
-@message(50)
+@message(64)
 class MDeletePool:
     """`ceph osd pool rm` (reference OSDMonitor::prepare_pool_op
     delete): the mon drops the pool from the map; every OSD purges the

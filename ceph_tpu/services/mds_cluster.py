@@ -188,6 +188,13 @@ class MDSCluster:
             # a directory rename (which takes the same pair) cannot
             # move the path between them.
             async with self._topology:
+                # re-resolve UNDER the lock: an ancestor export that
+                # committed while we waited may have moved authority —
+                # draining the stale rank's journal would leave the
+                # real authority's in-flight events undrained
+                if self.rank_of(path) != from_rank:
+                    raise FsError(f"EAGAIN: authority of {path} moved "
+                                  f"during export; retry")
                 async with src.fs._mutate:
                     if src.fs.mdlog is not None:
                         await src.fs.mdlog.roll()

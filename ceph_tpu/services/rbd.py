@@ -39,6 +39,7 @@ from __future__ import annotations
 import asyncio
 import errno
 import json
+import time
 import uuid
 from typing import Dict, List, Optional
 
@@ -790,6 +791,98 @@ class RBD:
         prefix = "rbd_header."
         return sorted(o[len(prefix):] for o in await self.ioctx.list_objects()
                       if o.startswith(prefix))
+
+    # -- trash (reference librbd trash_* API / `rbd trash`) ------------------
+    # Deferred deletion: the header moves to a trash record (the data
+    # objects are untouched, keyed by the image id), the image vanishes
+    # from list(), and until the deferment window passes it can be
+    # restored byte-identically.  Purge deletes expired entries' data.
+
+    @staticmethod
+    def _trash_oid(image_id: str) -> str:
+        return f"rbd_trash_header.{image_id}"
+
+    async def trash_mv(self, name: str, delay: float = 0.0,
+                       now: Optional[float] = None) -> str:
+        """Move an image to trash; returns the trash id.  Same snapshot
+        guard as remove(): purge snapshots first (divergence: the
+        reference allows trashing snapshotted images)."""
+        img = await self.open(name)
+        if img._hdr.get("snaps"):
+            raise RbdError(f"image {name!r} has snapshots; purge them "
+                           f"first")
+        now = time.time() if now is None else now
+        record = {"name": name, "header": img._hdr, "trashed_at": now,
+                  "deferment_end": now + max(0.0, delay)}
+        image_id = img._hdr["id"]
+        await self.ioctx.write_full(self._trash_oid(image_id),
+                                    json.dumps(record).encode())
+        p = img._hdr.get("parent")
+        if p:
+            await self._unregister_child(f"{p['image']}@{p['snap']}",
+                                         name)
+        await self.ioctx.remove(Image._header_oid(name))
+        return image_id
+
+    async def trash_ls(self) -> List[Dict]:
+        prefix = "rbd_trash_header."
+        out = []
+        for oid in await self.ioctx.list_objects():
+            if not oid.startswith(prefix):
+                continue
+            try:
+                rec = json.loads(await self.ioctx.read(oid))
+            except RadosError:
+                continue
+            out.append({"id": rec["header"]["id"], "name": rec["name"],
+                        "trashed_at": rec["trashed_at"],
+                        "deferment_end": rec["deferment_end"]})
+        return sorted(out, key=lambda r: r["trashed_at"])
+
+    async def _trash_rec(self, image_id: str) -> Dict:
+        try:
+            return json.loads(await self.ioctx.read(
+                self._trash_oid(image_id)))
+        except RadosError as e:
+            if e.code == -errno.ENOENT:
+                raise RbdError(f"no trash entry {image_id!r}")
+            raise
+
+    async def trash_restore(self, image_id: str,
+                            new_name: Optional[str] = None) -> Image:
+        rec = await self._trash_rec(image_id)
+        name = new_name or rec["name"]
+        if name in await self.list():
+            raise RbdError(f"image {name!r} exists; restore under "
+                           f"another name")
+        await self.ioctx.write_full(Image._header_oid(name),
+                                    json.dumps(rec["header"]).encode())
+        p = rec["header"].get("parent")
+        if p:
+            await self._register_child(f"{p['image']}@{p['snap']}", name)
+        await self.ioctx.remove(self._trash_oid(image_id))
+        return Image(self.ioctx, name, rec["header"])
+
+    async def trash_purge(self, now: Optional[float] = None,
+                          force: bool = False) -> int:
+        """Delete expired trash entries' data (all entries with
+        force=True).  Returns how many images were reclaimed."""
+        now = time.time() if now is None else now
+        purged = 0
+        for entry in await self.trash_ls():
+            if not force and now < entry["deferment_end"]:
+                continue
+            rec = await self._trash_rec(entry["id"])
+            hdr = rec["header"]
+            img = Image(self.ioctx, rec["name"], hdr)
+            for idx in hdr["object_map"]:
+                try:
+                    await self.ioctx.remove(img._data_oid(idx))
+                except RadosError:
+                    pass
+            await self.ioctx.remove(self._trash_oid(entry["id"]))
+            purged += 1
+        return purged
 
 
 # -- image journaling + mirroring (reference src/journal/Journaler.h,

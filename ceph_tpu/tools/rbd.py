@@ -61,6 +61,17 @@ def parse_args(argv=None):
     sn.add_argument("action", choices=("create", "ls", "rm"))
     sn.add_argument("spec", help="img@snap (ls: img)")
 
+    tr = sub.add_parser("trash")
+    tr.add_argument("action", choices=("mv", "ls", "restore", "purge"))
+    tr.add_argument("target", nargs="?", default=None,
+                    help="mv: image name; restore: trash id")
+    tr.add_argument("--delay", type=float, default=0.0,
+                    help="mv: deferment seconds before purge may reclaim")
+    tr.add_argument("--image", default=None,
+                    help="restore: optional new image name")
+    tr.add_argument("--force", action="store_true",
+                    help="purge: ignore deferment windows")
+
     e = sub.add_parser("export")
     e.add_argument("spec", help="img or img@snap")
     e.add_argument("path")
@@ -125,6 +136,23 @@ async def run(args) -> int:
             else:
                 for s in img.snap_list():
                     print(s)
+        elif args.cmd == "trash":
+            if args.action == "mv":
+                if not args.target:
+                    raise SystemExit("trash mv needs an image name")
+                tid = await rbd.trash_mv(args.target, delay=args.delay)
+                print(json.dumps({"id": tid}))
+            elif args.action == "ls":
+                print(json.dumps(await rbd.trash_ls(), indent=2))
+            elif args.action == "restore":
+                if not args.target:
+                    raise SystemExit("trash restore needs a trash id")
+                img = await rbd.trash_restore(args.target,
+                                              new_name=args.image)
+                print(f"restored {img.name}")
+            else:
+                n = await rbd.trash_purge(force=args.force)
+                print(json.dumps({"purged": n}))
         elif args.cmd == "export":
             name, snap = _split_at(args.spec)
             img = await rbd.open(name)

@@ -193,3 +193,72 @@ class TestRbdCli:
                 await rados.shutdown()
                 await cluster.stop()
         run(go())
+
+
+class TestTrash:
+    def test_trash_lifecycle(self):
+        """trash mv hides the image but keeps its data; restore brings
+        it back byte-identical; purge respects the deferment window."""
+        async def go():
+            cluster, rados, rbd = await _rbd()
+            try:
+                img = await rbd.create("vm", 1 << 20, order=18)
+                payload = os.urandom(100_000)
+                await img.write(0, payload)
+                tid = await rbd.trash_mv("vm", delay=3600)
+                assert await rbd.list() == []
+                ls = await rbd.trash_ls()
+                assert len(ls) == 1 and ls[0]["name"] == "vm"
+                assert ls[0]["id"] == tid
+                # within the deferment window purge reclaims nothing
+                assert await rbd.trash_purge() == 0
+                assert len(await rbd.trash_ls()) == 1
+                # restore: bytes intact
+                restored = await rbd.trash_restore(tid)
+                assert await restored.read(0, len(payload)) == payload
+                assert await rbd.list() == ["vm"]
+                assert await rbd.trash_ls() == []
+                # trash again and force-purge: data gone for real
+                tid = await rbd.trash_mv("vm", delay=3600)
+                assert await rbd.trash_purge(force=True) == 1
+                assert await rbd.trash_ls() == []
+                with pytest.raises(RbdError):
+                    await rbd.open("vm")
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_trash_restore_name_collision_and_rename(self):
+        async def go():
+            cluster, rados, rbd = await _rbd()
+            try:
+                img = await rbd.create("disk", 1 << 20, order=18)
+                await img.write(0, b"old-gen")
+                tid = await rbd.trash_mv("disk")
+                # a NEW image takes the name; restore must not clobber
+                await rbd.create("disk", 1 << 20, order=18)
+                with pytest.raises(RbdError):
+                    await rbd.trash_restore(tid)
+                restored = await rbd.trash_restore(tid,
+                                                   new_name="disk-old")
+                assert await restored.read(0, 7) == b"old-gen"
+                assert sorted(await rbd.list()) == ["disk", "disk-old"]
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_trash_refuses_snapshotted_image(self):
+        async def go():
+            cluster, rados, rbd = await _rbd()
+            try:
+                img = await rbd.create("s", 1 << 20, order=18)
+                await img.write(0, b"x")
+                await img.snap_create("keep")
+                with pytest.raises(RbdError):
+                    await rbd.trash_mv("s")
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
