@@ -42,6 +42,7 @@ import threading
 import time
 import uuid
 import zlib
+from collections import deque
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -223,6 +224,14 @@ class OSD:
         self._ping_task: Optional[asyncio.Task] = None
         self._hb_task: Optional[asyncio.Task] = None
         self._repair_task: Optional[asyncio.Task] = None
+        # metadata-replication retry queue (per peer, FIFO — ordering
+        # matters: an omap clear+set sequence applied out of order is a
+        # different omap).  A transient send failure must NOT leave a
+        # replica permanently stale: RGW bucket indexes and cls lock
+        # state ride this path, and a failover primary would serve the
+        # stale copy.
+        self._meta_repl_pending: Dict[int, deque] = {}
+        self._meta_repl_task: Optional[asyncio.Task] = None
         self.addr: Optional[Tuple[str, int]] = None
         self._stopped = False
         # observability (CephContext role): perf counters + op tracker;
@@ -257,6 +266,9 @@ class OSD:
             .add_u64_counter("op_dequeued", "ops drained")
             .add_time_avg("op_queue_lat", "op service time")
             .add_u64_counter("heartbeat_failures", "peer failures reported")
+            .add_u64_counter("meta_repl_dropped",
+                             "metadata replications dropped on queue "
+                             "overflow (replica stale until scrub)")
             .add_u64_counter("op_unexpected_error",
                              "ops failed by an unclassified exception")
             .add_u64("ec_batch_ops",
@@ -407,7 +419,8 @@ class OSD:
 
     async def stop(self) -> None:
         self._stopped = True
-        for t in (self._ping_task, self._hb_task, self._repair_task):
+        for t in (self._ping_task, self._hb_task, self._repair_task,
+                  self._meta_repl_task):
             if t:
                 t.cancel()
         for m in self._pg_machines.values():
@@ -2537,17 +2550,15 @@ class OSD:
             for name, value in hctx.xattrs.items():
                 self.store.setattr(key, name, value)
             # replicate xattr state to the other acting members so a
-            # failover primary still sees locks/refcounts
+            # failover primary still sees locks/refcounts (same
+            # queue-on-failure discipline as the multi path — cls lock
+            # state must not go silently stale either)
             for shard, osd in enumerate(acting):
                 if osd in (CRUSH_ITEM_NONE, self.osd_id):
                     continue
-                try:
-                    await self.messenger.send(
-                        self.osdmap.addr_of(osd),
-                        MSetXattrs(pool_id=op.pool_id, oid=op.oid,
-                                   shard=0, xattrs=dict(hctx.xattrs)))
-                except TRANSPORT_ERRORS:
-                    pass
+                await self._send_meta_repl(
+                    osd, MSetXattrs(pool_id=op.pool_id, oid=op.oid,
+                                    shard=0, xattrs=dict(hctx.xattrs)))
         return MOSDOpReply(ok=True, data=pickle.dumps((ret, out)))
 
     # -- compound atomic ops (reference MOSDOp vector<OSDOp>,
@@ -2900,29 +2911,101 @@ class OSD:
             except NotImplementedError:
                 pass
         # replicate metadata mutations to the acting peers so a failover
-        # primary serves the same xattrs/omap (cls durability discipline)
+        # primary serves the same xattrs/omap (cls durability discipline).
+        # A failed send is queued for retry, never dropped: silently
+        # losing one leaves the replica stale until the next deep scrub.
         if xattr_sets or xattr_rms or omap_cleared or omap_sets or omap_rms:
-            for shard, osd in enumerate(acting):
-                if osd in (CRUSH_ITEM_NONE, self.osd_id):
-                    continue
-                try:
-                    if xattr_sets or xattr_rms:
-                        await self.messenger.send(
-                            self.osdmap.addr_of(osd),
-                            MSetXattrs(pool_id=op.pool_id, oid=op.oid,
+            msgs = []
+            if xattr_sets or xattr_rms:
+                msgs.append(MSetXattrs(pool_id=op.pool_id, oid=op.oid,
                                        shard=0, xattrs=dict(xattr_sets),
                                        removals=sorted(xattr_rms)))
-                    if omap_cleared or omap_sets or omap_rms:
-                        await self.messenger.send(
-                            self.osdmap.addr_of(osd),
-                            MSetOmap(pool_id=op.pool_id, oid=op.oid,
+            if omap_cleared or omap_sets or omap_rms:
+                msgs.append(MSetOmap(pool_id=op.pool_id, oid=op.oid,
                                      shard=0, clear=omap_cleared,
                                      entries=dict(omap_sets),
                                      removals=sorted(omap_rms)))
-                except TRANSPORT_ERRORS:
-                    pass
+            for shard, osd in enumerate(acting):
+                if osd in (CRUSH_ITEM_NONE, self.osd_id):
+                    continue
+                for msg in msgs:
+                    await self._send_meta_repl(osd, msg)
         return MOSDOpReply(ok=True, data=pickle.dumps(results),
                            version=version)
+
+    async def _send_meta_repl(self, osd: int, msg) -> None:
+        """Send one metadata-replication message (MSetXattrs/MSetOmap)
+        to an acting peer, preserving per-peer FIFO order: while earlier
+        messages to this peer sit in the retry queue, new ones must
+        queue BEHIND them — a direct send racing ahead of a queued
+        older mutation would let the pump later overwrite newer state
+        with stale bytes."""
+        if self._meta_repl_pending.get(osd):
+            self._queue_meta_repl(osd, msg)
+            return
+        try:
+            await self.messenger.send(self.osdmap.addr_of(osd), msg)
+        except TRANSPORT_ERRORS:
+            self._queue_meta_repl(osd, msg)
+
+    def _queue_meta_repl(self, osd: int, msg) -> None:
+        """Queue a failed MSetXattrs/MSetOmap for redelivery to `osd`
+        (FIFO per peer — reordering a clear+set sequence corrupts the
+        replica) and make sure the retry pump is running.  Bounded: on
+        overflow the OLDEST entry is dropped with a cluster-visible
+        error, so sustained unreachability degrades loudly, not
+        silently."""
+        q = self._meta_repl_pending.setdefault(osd, deque())
+        q.append(msg)
+        while len(q) > 4096:
+            dropped = q.popleft()
+            self.perf.inc("meta_repl_dropped")
+            self.ctx.log.error(
+                "osd", f"meta replication queue to osd.{osd} overflowed; "
+                f"dropping {type(dropped).__name__} for "
+                f"{dropped.pool_id}/{dropped.oid} (replica stale until "
+                "next deep scrub)")
+        if self._meta_repl_task is None or self._meta_repl_task.done():
+            self._meta_repl_task = asyncio.get_running_loop().create_task(
+                self._meta_repl_pump())
+
+    async def _meta_repl_pump(self) -> None:
+        """Drain the per-peer metadata-replication retry queues with
+        backoff.  A peer marked OUT has its queue dropped — once out,
+        the data is re-mapped and a rejoining OSD is rebuilt by
+        peering/backfill, so redelivery is pointless (and entries in
+        osdmap.osds are never deleted, so keying off presence would
+        never fire).  A merely-down peer keeps its queue: it may return
+        with its store intact, and redelivery is idempotent (absolute
+        sets/removals)."""
+        delay = 0.2
+        while self._meta_repl_pending and not self._stopped:
+            progressed = False
+            for osd in list(self._meta_repl_pending):
+                q = self._meta_repl_pending.get(osd)
+                if not q:
+                    self._meta_repl_pending.pop(osd, None)
+                    continue
+                info = self.osdmap.osds.get(osd)
+                if info is None or not info.in_cluster:
+                    self._meta_repl_pending.pop(osd, None)
+                    continue
+                if not info.up:
+                    continue  # keep the queue; retry when it returns
+                while q:
+                    try:
+                        await self.messenger.send(
+                            self.osdmap.addr_of(osd), q[0])
+                    except TRANSPORT_ERRORS:
+                        break
+                    q.popleft()
+                    progressed = True
+                if not q:
+                    self._meta_repl_pending.pop(osd, None)
+            if not self._meta_repl_pending:
+                return
+            delay = 0.2 if progressed else min(delay * 1.6, 5.0)
+            await asyncio.sleep(delay)
 
     # -- watch/notify (reference src/osd/Watch.{h,cc}) -----------------------
 

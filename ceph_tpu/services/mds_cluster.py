@@ -400,6 +400,19 @@ class MDSCluster:
                 # dead paths) — same compliance wait as export
                 await self._revoke_subtree_caps(server, src_path)
             async with self._topology:
+                # re-resolve UNDER the lock (same discipline as
+                # export_dir and the cross-rank branch below): an
+                # export that committed while the revoke wait ran may
+                # have moved authority — renaming via the stale rank
+                # would mutate dirfrags the new authority owns outside
+                # its _mutate lock.
+                self._check_frozen(src_path)
+                self._check_frozen(dst_path)
+                if (self.rank_of(src_path) != r_src
+                        or self.rank_of(dst_path) != r_src):
+                    raise FsError(
+                        f"EAGAIN: authority of {src_path} or {dst_path} "
+                        "moved during rename lock wait")
                 if is_dir:
                     self._guard_dir_move(src_path)
                 await server.fs.rename(src_path, dst_path)
@@ -414,6 +427,20 @@ class MDSCluster:
         first, second = sorted((fs_src, fs_dst), key=id)
         async with first._mutate:
             async with second._mutate:
+                # re-resolve UNDER the locks (mirror export_dir): a
+                # subtree export may have committed while we waited, in
+                # which case journaling dentry mutations at the stale
+                # ranks would mutate dirfrags outside the new
+                # authority's _mutate lock — lost updates, and a later
+                # replace_rank() would replay them onto importer-owned
+                # dirfrags.  Retryable EAGAIN, same as export_dir.
+                self._check_frozen(src_path)
+                self._check_frozen(dst_path)
+                if (self.rank_of(src_path) != r_src
+                        or self.rank_of(dst_path) != r_dst):
+                    raise FsError(
+                        f"EAGAIN: authority of {src_path} or {dst_path} "
+                        "moved during rename lock wait")
                 sparent = posixpath.dirname(src_path)
                 sname = posixpath.basename(src_path)
                 sdentries = await fs_src._load_dir(sparent)

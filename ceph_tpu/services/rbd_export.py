@@ -129,13 +129,48 @@ async def apply_diff(img: Image, inp: BinaryIO) -> dict:
             applied += 1
         elif tag == b"z":
             off, n = _W.unpack(_read_exact(inp, _W.size))
-            # a zero record must DEALLOCATE, not materialize zeros:
-            # drop the covered blocks from the data set + object map
-            # (the resize-shrink pattern — holes stay holes)
+            # a zero record must DEALLOCATE where it can, but only
+            # blocks the extent FULLY covers — a partial-block zero
+            # extent (legal in the framed format) must not discard
+            # live bytes outside [off, off+n).  The extent is clamped
+            # to the image size (export_diff emits tail trims with
+            # n = size - off; a foreign over-long extent must not
+            # abort mid-stream after earlier records applied).
             bs = img.object_size
-            first, last = off // bs, (off + n - 1) // bs
-            drop = [i for i in range(first, last + 1)
-                    if i in img._hdr["object_map"]]
+            end = min(off + n, img.size)
+            if bool(img._hdr.get("parent")) and end > off:
+                # a CLONE's hole is parent data, not zeros (reads fall
+                # through to the parent snapshot) — dropping blocks or
+                # skipping unallocated ones would resurrect the
+                # parent's bytes where the stream says zero.
+                # Materialize zeros instead (copy-up keeps the rest of
+                # each block intact); hole preservation is the
+                # flat-image optimization only.  Block-sized steps
+                # bound memory for huge extents.
+                pos = off
+                while pos < end:
+                    step = min(end - pos, bs - pos % bs)
+                    await img.write(pos, b"\x00" * step)
+                    pos += step
+                trims += 1
+                continue
+            drop = []
+            partial = []
+            for i in (range(off // bs, (end - 1) // bs + 1)
+                      if end > off else ()):
+                b_start = i * bs
+                b_end = min((i + 1) * bs, img.size)
+                if off <= b_start and end >= b_end:
+                    # fully covered up to the image size: the tail
+                    # block of a non-aligned image deallocates too
+                    # (holes stay holes through a backup round-trip)
+                    if i in img._hdr["object_map"]:
+                        drop.append(i)
+                elif i in img._hdr["object_map"]:
+                    # allocated partial head/tail: explicit zeros over
+                    # just the extent; an UNALLOCATED partial is
+                    # already zeros — writing would materialize it
+                    partial.append((max(off, b_start), min(end, b_end)))
             for i in drop:
                 try:
                     await img.ioctx.remove(img._data_oid(i),
@@ -146,6 +181,8 @@ async def apply_diff(img: Image, inp: BinaryIO) -> dict:
                 img._hdr["object_map"] = sorted(
                     set(img._hdr["object_map"]) - set(drop))
                 await img._save_header(drop_blocks=drop)
+            for p_off, p_end in partial:
+                await img.write(p_off, b"\x00" * (p_end - p_off))
             trims += 1
         else:
             raise RbdError(f"bad record tag {tag!r}")

@@ -1008,14 +1008,22 @@ class RgwService:
                                  principal: Optional[str] = None) -> str:
         """Assemble the object from its parts; the bucket index entry
         becomes a manifest referencing the part objects in order.
-        Quota was charged when each part was STAGED (staged parts count
-        in bucket_usage), so completion — which never grows stored
-        bytes — needs no second check; `principal` is accepted for
-        interface symmetry with the staging path."""
+        Byte quota was charged when each part was STAGED (staged parts
+        count in bucket_usage), but completion creates a NEW indexed
+        object — the object-count axis must be re-checked here or
+        multipart becomes a max_objects bypass (parts stage with
+        add_objects=0; reference re-checks quota at completion)."""
         meta = await self._load_upload(bucket, upload_id)
         index = await self._load_index(bucket)
         if index is None:
             raise RadosError(f"NoSuchBucket: {bucket}")
+        # overwrite of an existing key replaces its index entry — the
+        # object count only grows when the key is new (the index is in
+        # hand here, so be exact where the plain-PUT pre-check is
+        # conservative)
+        await self.check_quota(
+            principal, bucket, 0,
+            add_objects=0 if meta["key"] in index else 1)
         have = {int(n): p for n, p in meta["parts"].items()}
         order = sorted(have) if parts is None else list(parts)
         if not order or any(n not in have for n in order):
@@ -1629,8 +1637,14 @@ class RgwFrontend:
             gate_meta = None
             if parts and method in ("GET", "HEAD", "PUT", "POST", "DELETE"):
                 need = "READ" if method in ("GET", "HEAD") else "WRITE"
-                admin_op = method in ("PUT", "DELETE") and q.keys() & {
-                    "acl", "versioning", "lifecycle", "policy"}
+                # GET ?acl / ?policy are READ_ACP-class subresources
+                # (AWS: READ_ACP / s3:GetBucketPolicy is owner-level) —
+                # a plain read grantee must not be able to enumerate
+                # grants or the policy document, so they share the
+                # owner-level gate with the mutating admin ops.
+                admin_op = (method in ("PUT", "DELETE") and q.keys() & {
+                    "acl", "versioning", "lifecycle", "policy"}) or (
+                    method == "GET" and q.keys() & {"acl", "policy"})
                 if admin_op:
                     need = "FULL_CONTROL"
                 is_create = len(parts) == 1 and method == "PUT" \

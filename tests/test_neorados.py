@@ -58,6 +58,62 @@ class TestWriteOp:
                 await cluster.stop()
         run(go())
 
+    def test_meta_replication_retries_after_transport_failure(self):
+        """r4 advisor regression: a transient send failure while
+        replicating xattr/omap mutations to acting peers must be
+        RETRIED, not swallowed — a failover primary would otherwise
+        serve stale omap (RGW bucket indexes ride this path)."""
+        async def go():
+            cluster, client, neo, ioc = await _cluster(n_osds=3)
+            try:
+                # land the object first so the acting set is known
+                await neo.execute("robj", ioc,
+                                  WriteOp().write_full(b"seed"))
+                # find the primary for this object
+                primary = None
+                for osd in cluster.osds.values():
+                    pool = osd.osdmap.pools[ioc.pool_id]
+                    pg, acting = osd._acting(pool, "robj")
+                    if osd._primary(pool, pg, acting) == osd.osd_id:
+                        primary = osd
+                        peers = [a for a in acting
+                                 if a != osd.osd_id]
+                        break
+                assert primary is not None and peers
+                # wedge sends of metadata-replication messages only
+                from ceph_tpu.rados.types import MSetOmap, MSetXattrs
+                real_send = primary.messenger.send
+                fail = {"n": 3}
+
+                async def flaky(addr, msg, *a, **kw):
+                    if isinstance(msg, (MSetOmap, MSetXattrs)) \
+                            and fail["n"] > 0:
+                        fail["n"] -= 1
+                        raise ConnectionError("injected")
+                    return await real_send(addr, msg, *a, **kw)
+
+                primary.messenger.send = flaky
+                await neo.execute("robj", ioc,
+                                  WriteOp().setxattr("who", b"x")
+                                  .omap_set({"idx": b"entry"}))
+                # the failed sends were queued, and the pump drains them
+                for _ in range(200):
+                    if not primary._meta_repl_pending:
+                        break
+                    await asyncio.sleep(0.05)
+                assert not primary._meta_repl_pending
+                assert fail["n"] == 0  # injection actually fired
+                # every acting peer now holds the replicated metadata
+                key = (ioc.pool_id, "robj", 0)
+                for peer_id in peers:
+                    peer = cluster.osds[peer_id]
+                    assert peer.store.omap_get(key).get("idx") == b"entry"
+                    assert peer.store.getattr(key, "who") == b"x"
+            finally:
+                await client.stop()
+                await cluster.stop()
+        run(go())
+
     def test_failing_assert_applies_nothing(self):
         """cmpxattr mismatch mid-vector: earlier staged sub-ops must NOT
         land (all-or-nothing)."""

@@ -143,6 +143,143 @@ class TestDiffs:
                 await cluster.stop()
         run(go())
 
+    def test_partial_block_zero_record_preserves_live_bytes(self):
+        """r4 advisor regression: a zero record covering PART of a block
+        (legal in the framed format) must zero only [off, off+n) —
+        never drop the whole block and discard live bytes around it."""
+        async def go():
+            import struct
+            from ceph_tpu.services.rbd_export import MAGIC, _W
+            cluster, rados, rbd = await _rbd()
+            try:
+                bs = 1 << 18  # order=18
+                img = await rbd.create("pz", 1 << 20, order=18)
+                await img.write(0, b"A" * bs)          # block 0: live
+                await img.write(bs, b"B" * bs)         # block 1: live
+                # hand-build a diff: zero an extent straddling the
+                # middle of block 0 into the start of block 1
+                meta = json.dumps({"size": 1 << 20}).encode()
+                z_off, z_len = 1000, bs  # [1000, 1000+bs): both partial
+                stream = (MAGIC
+                          + b"m" + struct.pack("<I", len(meta)) + meta
+                          + b"z" + _W.pack(z_off, z_len)
+                          + b"e")
+                stats = await rbd_export.apply_diff(img,
+                                                    io.BytesIO(stream))
+                assert stats["trims"] == 1
+                # bytes outside the extent survive
+                assert await img.read(0, z_off) == b"A" * z_off
+                tail_off = z_off + z_len
+                assert await img.read(tail_off, 100) == b"B" * 100
+                # bytes inside the extent are zeros
+                assert await img.read(z_off, 50) == b"\x00" * 50
+                assert await img.read(bs, 100) == b"\x00" * 100
+                # a FULLY covered block is still deallocated
+                stream2 = (MAGIC
+                           + b"m" + struct.pack("<I", len(meta)) + meta
+                           + b"z" + _W.pack(bs, bs)
+                           + b"e")
+                await rbd_export.apply_diff(img, io.BytesIO(stream2))
+                assert 1 not in img._hdr["object_map"]
+                # a PARTIAL zero over the now-unallocated block
+                # materializes nothing: the hole stays a hole
+                stream3 = (MAGIC
+                           + b"m" + struct.pack("<I", len(meta)) + meta
+                           + b"z" + _W.pack(bs + 100, 500)
+                           + b"e")
+                await rbd_export.apply_diff(img, io.BytesIO(stream3))
+                assert 1 not in img._hdr["object_map"]
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_tail_block_trim_on_unaligned_image(self):
+        """The last block of a non-block-aligned image is still
+        deallocated by a trim whose extent ends at the image size
+        (export_diff emits n = size - off for the tail), and an extent
+        reaching PAST the size is clamped, not fatal mid-stream."""
+        async def go():
+            import struct
+            from ceph_tpu.services.rbd_export import MAGIC, _W
+            cluster, rados, rbd = await _rbd()
+            try:
+                bs = 1 << 18
+                size = bs + bs // 2  # 1.5 blocks: tail block is short
+                img = await rbd.create("tail", size, order=18)
+                await img.write(0, b"A" * bs)
+                await img.write(bs, b"T" * (size - bs))
+                buf = io.BytesIO()
+                # the exporter's own hole propagation: snapshotting
+                # state, trimming the tail, then export/apply round
+                # trip is covered elsewhere — here, hand-build the
+                # tail trim the exporter emits
+                meta = json.dumps({"size": size}).encode()
+                stream = (MAGIC
+                          + b"m" + struct.pack("<I", len(meta)) + meta
+                          + b"z" + _W.pack(bs, size - bs)
+                          + b"e")
+                await rbd_export.apply_diff(img, io.BytesIO(stream))
+                assert 1 not in img._hdr["object_map"], \
+                    "tail block must deallocate (holes stay holes)"
+                assert await img.read(bs, 100) == b"\x00" * 100
+                # over-long extent: clamped to size, block 0 partial
+                stream2 = (MAGIC
+                           + b"m" + struct.pack("<I", len(meta)) + meta
+                           + b"z" + _W.pack(bs - 10, 10 * bs)
+                           + b"e")
+                await rbd_export.apply_diff(img, io.BytesIO(stream2))
+                assert await img.read(bs - 10, 10) == b"\x00" * 10
+                assert await img.read(0, 10) == b"A" * 10
+                del buf
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_zero_record_on_clone_writes_zeros_not_parent(self):
+        """On a layered image a hole reads the PARENT's bytes, so a
+        zero record must materialize zeros — dropping the block (or
+        skipping an unallocated one) would resurrect parent data the
+        diff stream says is gone."""
+        async def go():
+            import struct
+            from ceph_tpu.services.rbd_export import MAGIC, _W
+            cluster, rados, rbd = await _rbd()
+            try:
+                bs = 1 << 18
+                parent = await rbd.create("par", 1 << 20, order=18)
+                await parent.write(0, b"P" * bs)
+                await parent.write(bs, b"Q" * bs)
+                await parent.snap_create("base")
+                await parent.snap_protect("base")
+                clone = await rbd.clone("par", "base", "kid")
+                assert await clone.read(0, 4) == b"PPPP"
+                meta = json.dumps({"size": 1 << 20}).encode()
+                # full-block zero over an unwritten clone block
+                stream = (MAGIC
+                          + b"m" + struct.pack("<I", len(meta)) + meta
+                          + b"z" + _W.pack(0, bs)
+                          + b"e")
+                await rbd_export.apply_diff(clone, io.BytesIO(stream))
+                assert await clone.read(0, 100) == b"\x00" * 100, \
+                    "zeroed clone block must not fall through to parent"
+                # partial zero over another unwritten clone block
+                stream2 = (MAGIC
+                           + b"m" + struct.pack("<I", len(meta)) + meta
+                           + b"z" + _W.pack(bs + 100, 200)
+                           + b"e")
+                await rbd_export.apply_diff(clone, io.BytesIO(stream2))
+                assert await clone.read(bs, 100) == b"Q" * 100
+                assert await clone.read(bs + 100, 200) == b"\x00" * 200
+                assert await clone.read(bs + 300, 100) == b"Q" * 100
+                # parent itself is untouched
+                assert await parent.read(0, 4) == b"PPPP"
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
     def test_corrupt_stream_rejected(self):
         async def go():
             cluster, rados, rbd = await _rbd()

@@ -239,6 +239,70 @@ class TestQuotasOverHttp:
                 await cluster.stop()
         run(go())
 
+    def test_multipart_completion_checks_object_count_quota(self):
+        """r4 advisor regression: parts stage with add_objects=0, so
+        complete_multipart MUST re-check the object-count axis — or
+        multipart is a max_objects bypass."""
+        async def go():
+            cluster, c, rados, svc = await _svc()
+            frontend = None
+            try:
+                admin = RgwAdmin(svc)
+                u = await admin.user_create("dave")
+                ak = u["access_key"]
+                creds = {ak: u["secret_key"]}
+                frontend = RgwFrontend(svc)
+                host, port = await frontend.start()
+                await _req(host, port, creds, "PUT", "/cap", access=ak)
+                await admin.quota_set("dave", "user", max_objects=1)
+                await admin.quota_enable("dave", "user")
+                st, _ = await _req(host, port, creds, "PUT", "/cap/one",
+                                   b"x", access=ak)
+                assert st.startswith("200")
+                # a second plain put is refused...
+                st, body = await _req(host, port, creds, "PUT",
+                                      "/cap/two", b"x", access=ak)
+                assert st.startswith("403") and b"QuotaExceeded" in body
+                # ...and so is the multipart route to the same object
+                st, body = await _req(host, port, creds, "POST",
+                                      "/cap/two", access=ak,
+                                      query="uploads")
+                upload_id = json.loads(body)["UploadId"]
+                st, _ = await _req(
+                    host, port, creds, "PUT", "/cap/two", b"p" * 10,
+                    access=ak,
+                    query=f"uploadId={upload_id}&partNumber=1")
+                assert st.startswith("200")  # staging adds no object
+                st, body = await _req(host, port, creds, "POST",
+                                      "/cap/two", access=ak,
+                                      query=f"uploadId={upload_id}")
+                assert st.startswith("403") and b"QuotaExceeded" in body
+                # the bucket index never gained the object
+                keys = await svc.list_objects("cap")
+                assert "two" not in keys
+                # but OVERWRITING the existing key via multipart is not
+                # an object-count increase — it must complete
+                st, body = await _req(host, port, creds, "POST",
+                                      "/cap/one", access=ak,
+                                      query="uploads")
+                up_ow = json.loads(body)["UploadId"]
+                st, _ = await _req(
+                    host, port, creds, "PUT", "/cap/one", b"n" * 4,
+                    access=ak,
+                    query=f"uploadId={up_ow}&partNumber=1")
+                assert st.startswith("200")
+                st, _ = await _req(host, port, creds, "POST",
+                                   "/cap/one", access=ak,
+                                   query=f"uploadId={up_ow}")
+                assert st.startswith("200"), st
+            finally:
+                if frontend:
+                    await frontend.stop()
+                await rados.shutdown()
+                await c.stop()
+                await cluster.stop()
+        run(go())
+
 
 class TestSwiftDialectEnforcement:
     def test_suspension_and_quota_bind_swift_too(self):

@@ -996,6 +996,18 @@ class TestRgwDataManagement:
                 assert st.startswith("200") and body == b"secret"
                 st, _ = await req("DELETE", "/priv/k", access="bob")
                 assert st.startswith("403")
+                # READ_ACP-class subresources: a plain read grantee may
+                # NOT enumerate grants or the policy document (r4
+                # advisor finding — AWS requires READ_ACP/owner)
+                st, _ = await req("GET", "/priv", access="bob",
+                                  query="acl")
+                assert st.startswith("403"), st
+                st, _ = await req("GET", "/priv", access="bob",
+                                  query="policy")
+                assert st.startswith("403"), st
+                st, _ = await req("GET", "/priv", access="alice",
+                                  query="acl")
+                assert st.startswith("200"), st
                 await r.shutdown()
                 await c.stop()
             finally:
