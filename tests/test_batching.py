@@ -50,9 +50,12 @@ class TestDaemonPathBatching:
                 c = await cluster.client()
                 pool = await c.create_pool("bq", profile=PROFILE)
                 q = osdmod.shared_batching_queue()
-                # warm the jit caches OUTSIDE the counted window
+                # warm the jit caches OUTSIDE the counted window;
+                # flush() synchronously drains any straggling queued
+                # work from the warmup, so the counter snapshot below
+                # is deterministic (no wall-clock wait)
                 await c.put(pool, "warmup", os.urandom(8192))
-                await asyncio.sleep(0.1)
+                q.flush()
                 before_d, before_ops = q.dispatches, q.submits
                 n = 24
                 blobs = [os.urandom(8192) for _ in range(n)]
