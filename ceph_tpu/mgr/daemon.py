@@ -86,7 +86,7 @@ class MgrDaemon:
         apply them through mon commands."""
         from ceph_tpu.mgr.modules import Balancer, PgAutoscaler
         from ceph_tpu.rados.client import RadosClient
-        from ceph_tpu.rados.types import MPoolSet, MSetUpmap
+        from ceph_tpu.rados.types import ALL_NSPACES, MPoolSet, MSetUpmap
 
         interval = float(self.conf.get("mgr_module_interval", 5.0))
         balancer = Balancer()
@@ -108,7 +108,10 @@ class MgrDaemon:
                     if self.conf.get("mgr_pg_autoscaler", False):
                         for pool in list(osdmap.pools.values()):
                             try:
-                                oids = await client.list_objects(pool.pool_id)
+                                # pool-WIDE count: namespaced objects
+                                # must size pg_num too
+                                oids = await client.list_objects(
+                                    pool.pool_id, nspace=ALL_NSPACES)
                             except Exception:
                                 continue
                             want = scaler.compute(pool, len(oids))

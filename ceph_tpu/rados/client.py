@@ -389,6 +389,19 @@ class RadosClient:
             raise RadosError("oid contains the reserved snap separator",
                              code=-errno.EINVAL)
 
+    def _write_snapc(self, pool_id: int, snapc):
+        """The SnapContext a write carries: the caller's, or — for a
+        pool in pool-snaps mode — the POOL's own context from the
+        osdmap (reference IoCtxImpl: the ioctx snapc defaults to the
+        pool snapc), so every writer path clones pre-snap heads without
+        knowing pool snapshots exist."""
+        if snapc:
+            return snapc
+        pool = self.osdmap.pools.get(pool_id) if self.osdmap else None
+        if pool is not None and getattr(pool, "snap_mode", "") == "pool":
+            return pool.pool_snapc()
+        return (0, [])
+
     async def put(self, pool_id: int, oid: str, data: bytes,
                   offset: Optional[int] = None,
                   snapc: Optional[Tuple[int, List[int]]] = None) -> None:
@@ -398,7 +411,7 @@ class RadosClient:
         clones the head before the first write past a new snap
         (reference SnapContext on every write)."""
         self._check_oid(oid)
-        seq, snaps = snapc if snapc else (0, [])
+        seq, snaps = self._write_snapc(pool_id, snapc)
         await self._op(MOSDOp(op="write", pool_id=pool_id, oid=oid, data=data,
                               offset=-1 if offset is None else int(offset),
                               snapc_seq=seq, snapc_snaps=list(snaps)))
@@ -414,7 +427,7 @@ class RadosClient:
         import pickle as _pickle
 
         self._check_oid(oid)
-        seq, snaps = snapc if snapc else (0, [])
+        seq, snaps = self._write_snapc(pool_id, snapc)
         reply = await self._op(MOSDOp(op="multi", pool_id=pool_id, oid=oid,
                                       ops=list(ops), snapc_seq=seq,
                                       snapc_snaps=list(snaps)))
@@ -450,6 +463,63 @@ class RadosClient:
             except RadosError:
                 continue
 
+    # -- pool-managed snapshots (reference `ceph osd pool mksnap`,
+    # OSDMonitor pool-op SNAP_CREATE/SNAP_RM; mixing with self-managed
+    # snaps is a typed -EINVAL at the mon) ----------------------------------
+
+    async def pool_snap_create(self, pool_id: int, name: str) -> int:
+        """Create a mon-managed pool snapshot; every subsequent write
+        carries the pool's SnapContext, so heads clone lazily on first
+        overwrite (the same make_writeable machinery as self-managed
+        snaps)."""
+        reply = await self._mon_rpc(
+            MSnapOp(pool_id=pool_id, op="mksnap", name=name))
+        if not reply.ok:
+            raise RadosError(reply.error, code=reply.code)
+        await self.refresh_map()
+        return reply.snap_id
+
+    async def pool_snap_remove(self, pool_id: int, name: str) -> None:
+        """Remove a pool snapshot and trim its clones (same fan-out
+        discipline as selfmanaged_snap_remove: mon records first, trim
+        is idempotent best-effort)."""
+        reply = await self._mon_rpc(
+            MSnapOp(pool_id=pool_id, op="rmsnap", name=name))
+        if not reply.ok:
+            raise RadosError(reply.error, code=reply.code)
+        await self.refresh_map()
+        for osd_id in self._pg_primaries(pool_id):
+            try:
+                await self._op_direct(osd_id, MOSDOp(
+                    op="snap-trim", pool_id=pool_id,
+                    snap_id=reply.snap_id))
+            except RadosError:
+                continue
+
+    async def rollback_object(self, pool_id: int, oid: str, snap_id: int,
+                              snapc=None) -> None:
+        """Restore one object's head to its state at `snap_id`
+        (reference rollback: read-at-snap -> write head; an object
+        absent at the snap is removed).  The ONE implementation behind
+        ioctx self-managed rollback, pool-snap rollback, and the rados
+        CLI."""
+        try:
+            old = await self.get(pool_id, oid, snap=snap_id)
+        except RadosError as e:
+            if e.code != -errno.ENOENT:
+                raise
+            await self.delete(pool_id, oid, snapc=snapc)
+            return
+        await self.put(pool_id, oid, old, snapc=snapc)
+
+    async def pool_snap_list(self, pool_id: int) -> Dict[str, int]:
+        await self.refresh_map()
+        pool = self.osdmap.pools.get(pool_id)
+        if pool is None:
+            raise RadosError(f"pool {pool_id} does not exist",
+                             code=-errno.ENOENT)
+        return dict(getattr(pool, "pool_snaps", {}) or {})
+
     async def deep_scrub(self, pool_id: int) -> Dict[str, int]:
         """Ask every up OSD to deep-scrub the PGs it leads; sums the
         per-primary summaries."""
@@ -479,7 +549,7 @@ class RadosClient:
         """Delete the head; under a snap context the primary clones
         first and leaves a whiteout so snapshots keep resolving."""
         self._check_oid(oid)
-        seq, snaps = snapc if snapc else (0, [])
+        seq, snaps = self._write_snapc(pool_id, snapc)
         await self._op(MOSDOp(op="delete", pool_id=pool_id, oid=oid,
                               snapc_seq=seq, snapc_snaps=list(snaps)))
 
@@ -572,11 +642,15 @@ class RadosClient:
                                       data=payload))
         return _pickle.loads(reply.data)
 
-    async def list_objects(self, pool_id: int) -> List[str]:
+    async def list_objects(self, pool_id: int,
+                           nspace: str = "") -> List[str]:
         """Paginated per-PG-primary listing (reference pgls/do_pgnls):
         admin listings scale with PG count, never cluster size.  Falls
         back to the all-OSD union for a PG whose primary cannot answer
-        (mid-peering) — correctness over elegance for admin tooling."""
+        (mid-peering) — correctness over elegance for admin tooling.
+        `nspace` filters server-side ("" = default namespace,
+        ALL_NSPACES = everything); returned names are WIRE names — the
+        IoCtx strips its namespace prefix for its callers."""
         if self.osdmap is None:
             await self.refresh_map()
         pool = self.osdmap.pools.get(pool_id)
@@ -600,7 +674,8 @@ class RadosClient:
             while True:
                 try:
                     reply = await self._op_direct(primary, MOSDOp(
-                        op="pgls", pool_id=pool_id, pg=pg, cursor=cursor))
+                        op="pgls", pool_id=pool_id, pg=pg, cursor=cursor,
+                        nspace=nspace))
                 except RadosError:
                     fallback = True
                     break
@@ -615,7 +690,8 @@ class RadosClient:
                     continue
                 try:
                     reply = await self._op_direct(
-                        osd.osd_id, MOSDOp(op="list", pool_id=pool_id))
+                        osd.osd_id, MOSDOp(op="list", pool_id=pool_id,
+                                           nspace=nspace))
                     oids.update(reply.oids)
                 except RadosError:
                     continue

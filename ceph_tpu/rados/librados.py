@@ -12,6 +12,7 @@ placement, resend across epochs, reqid idempotency).
 from __future__ import annotations
 
 import asyncio
+import errno as _errno
 from typing import Any, Dict, List, Optional
 
 from ceph_tpu.rados.client import RadosClient, RadosError
@@ -46,10 +47,46 @@ class IoCtx:
         self._snapc_seq = 0
         self._snapc_snaps: List[int] = []
         self._snap_read = 0
+        # rados namespace (reference rados_ioctx_set_namespace /
+        # object_locator_t nspace): part of object IDENTITY — the same
+        # name in two namespaces is two objects, placed independently
+        self._nspace = ""
 
     @property
     def _c(self) -> RadosClient:
         return self._rados._client
+
+    # -- namespaces (reference rados_ioctx_set_namespace) --------------------
+
+    def set_namespace(self, nspace: str) -> None:
+        """All subsequent I/O on this ioctx targets (nspace, name)
+        identities; "" returns to the default namespace and the
+        ALL_NSPACES sentinel makes listings span every namespace
+        (I/O in that state is rejected, as in the reference)."""
+        from ceph_tpu.rados.types import ALL_NSPACES, NS_SEP, SNAP_SEP
+
+        if nspace != ALL_NSPACES and (NS_SEP in nspace
+                                      or SNAP_SEP in nspace):
+            raise RadosError("invalid namespace", code=-_errno.EINVAL)
+        self._nspace = nspace
+
+    def get_namespace(self) -> str:
+        return self._nspace
+
+    def _full(self, oid: str) -> str:
+        """Compose the wire object name for this ioctx's namespace;
+        the separator (and the all-namespaces sentinel) cannot ride in
+        from user names."""
+        from ceph_tpu.rados.types import ALL_NSPACES, NS_SEP, make_oid
+
+        if NS_SEP in oid:
+            raise RadosError("oid contains the reserved namespace "
+                             "separator", code=-_errno.EINVAL)
+        if self._nspace == ALL_NSPACES:
+            raise RadosError("I/O requires a concrete namespace "
+                             "(ioctx is set to ALL_NSPACES)",
+                             code=-_errno.EINVAL)
+        return make_oid(self._nspace, oid)
 
     # -- self-managed snapshots (reference rados_ioctx_selfmanaged_*) --------
 
@@ -70,16 +107,37 @@ class IoCtx:
         """Restore the head to its state at `snap_id` (reference
         rollback: read-at-snap -> write head; an object absent at the
         snap is removed)."""
-        try:
-            old = await self._c.get(self.pool_id, oid, snap=snap_id)
-        except RadosError as e:
-            import errno as _errno
+        await self._c.rollback_object(self.pool_id, self._full(oid),
+                                      snap_id, snapc=self._snapc)
 
-            if e.code != -_errno.ENOENT:
-                raise
-            await self.remove(oid)
-            return
-        await self.write_full(oid, old)
+    # -- pool snapshots (reference rados_ioctx_snap_create / mksnap) ---------
+
+    async def snap_create(self, name: str) -> int:
+        """Mon-managed POOL snapshot (reference `rados mksnap`): the
+        whole pool's state becomes readable at the returned snap id;
+        mixing with self-managed snaps is refused by the mon
+        (-EINVAL)."""
+        return await self._c.pool_snap_create(self.pool_id, name)
+
+    async def snap_remove(self, name: str) -> None:
+        await self._c.pool_snap_remove(self.pool_id, name)
+
+    async def snap_list(self) -> Dict[str, int]:
+        return await self._c.pool_snap_list(self.pool_id)
+
+    async def snap_lookup(self, name: str) -> int:
+        snaps = await self._c.pool_snap_list(self.pool_id)
+        if name not in snaps:
+            raise RadosError(f"no pool snap {name!r}",
+                             code=-_errno.ENOENT)
+        return snaps[name]
+
+    async def snap_rollback(self, oid: str, name: str) -> None:
+        """Restore one object's head to its state at the named pool
+        snapshot (reference `rados rollback <obj> <snap>`: per-object,
+        not pool-wide)."""
+        sid = await self.snap_lookup(name)
+        await self._c.rollback_object(self.pool_id, self._full(oid), sid)
 
     async def allocate_snap_id(self) -> int:
         """Allocate a snap id WITHOUT touching this ioctx's write
@@ -101,6 +159,9 @@ class IoCtx:
 
     @property
     def _snapc(self):
+        # None lets the client supply the pool's SnapContext for a
+        # pool-snaps-mode pool (client._write_snapc — ONE fallback for
+        # every writer path, ioctx or raw)
         if self._snapc_seq:
             return (self._snapc_seq, self._snapc_snaps)
         return None
@@ -110,21 +171,21 @@ class IoCtx:
     # logical volumes' contexts over one shared ioctx
 
     async def write_full(self, oid: str, data: bytes, snapc=None) -> None:
-        await self._c.put(self.pool_id, oid, data,
+        await self._c.put(self.pool_id, self._full(oid), data,
                           snapc=snapc if snapc is not None else self._snapc)
 
     async def write(self, oid: str, data: bytes, offset: int = 0,
                     snapc=None) -> None:
-        await self._c.put(self.pool_id, oid, data, offset=offset,
+        await self._c.put(self.pool_id, self._full(oid), data, offset=offset,
                           snapc=snapc if snapc is not None else self._snapc)
 
     async def read(self, oid: str, snap: Optional[int] = None) -> bytes:
         return await self._c.get(
-            self.pool_id, oid,
+            self.pool_id, self._full(oid),
             snap=snap if snap is not None else self._snap_read)
 
     async def remove(self, oid: str, snapc=None) -> None:
-        await self._c.delete(self.pool_id, oid,
+        await self._c.delete(self.pool_id, self._full(oid),
                              snapc=snapc if snapc is not None else self._snapc)
 
     async def stat(self, oid: str) -> Dict[str, int]:
@@ -132,11 +193,20 @@ class IoCtx:
         from ceph_tpu.rados.types import MOSDOp
 
         reply = await self._c._op(MOSDOp(op="stat", pool_id=self.pool_id,
-                                         oid=oid))
+                                         oid=self._full(oid)))
         return {"size": int(reply.data), "version": reply.version}
 
     async def list_objects(self) -> List[str]:
-        return await self._c.list_objects(self.pool_id)
+        """Objects in THIS ioctx's namespace, bare names; with the
+        ALL_NSPACES sentinel set, every namespace's WIRE names (callers
+        split them with types.split_ns)."""
+        from ceph_tpu.rados.types import ALL_NSPACES, split_ns
+
+        wire = await self._c.list_objects(self.pool_id,
+                                          nspace=self._nspace)
+        if self._nspace == ALL_NSPACES:
+            return wire
+        return [split_ns(o)[1] for o in wire]
 
     async def execute(self, oid: str, cls: str, method: str,
                       inp: bytes = b"") -> Any:
@@ -147,8 +217,8 @@ class IoCtx:
         from ceph_tpu.rados.types import MOSDOp
 
         reply = await self._c._op(MOSDOp(op="call", pool_id=self.pool_id,
-                                         oid=oid, data=inp, cls=cls,
-                                         method=method), retries=3)
+                                         oid=self._full(oid), data=inp,
+                                         cls=cls, method=method), retries=3)
         return pickle.loads(reply.data)
 
     # -- xattr / omap conveniences (rados_{set,get}xattr, rados_omap_*) -----
@@ -156,56 +226,56 @@ class IoCtx:
     # server-side metadata path, so these are atomic with cls calls)
 
     async def setxattr(self, oid: str, name: str, value: bytes) -> None:
-        await self._c.multi(self.pool_id, oid,
+        await self._c.multi(self.pool_id, self._full(oid),
                             [("setxattr", {"name": name,
                                            "value": bytes(value)})],
                             snapc=self._snapc)
 
     async def getxattr(self, oid: str, name: str) -> bytes:
         results, _v = await self._c.multi(
-            self.pool_id, oid, [("getxattr", {"name": name})])
+            self.pool_id, self._full(oid), [("getxattr", {"name": name})])
         return results[0][1]
 
     async def rmxattr(self, oid: str, name: str) -> None:
-        await self._c.multi(self.pool_id, oid,
+        await self._c.multi(self.pool_id, self._full(oid),
                             [("rmxattr", {"name": name})],
                             snapc=self._snapc)
 
     async def getxattrs(self, oid: str) -> Dict[str, bytes]:
-        results, _v = await self._c.multi(self.pool_id, oid,
+        results, _v = await self._c.multi(self.pool_id, self._full(oid),
                                           [("getxattrs", {})])
         return results[0][1]
 
     async def omap_set(self, oid: str, entries: Dict[str, bytes]) -> None:
-        await self._c.multi(self.pool_id, oid,
+        await self._c.multi(self.pool_id, self._full(oid),
                             [("omap_set", {"entries": dict(entries)})],
                             snapc=self._snapc)
 
     async def omap_get_vals(self, oid: str) -> Dict[str, bytes]:
-        results, _v = await self._c.multi(self.pool_id, oid,
+        results, _v = await self._c.multi(self.pool_id, self._full(oid),
                                           [("omap_get_vals", {})])
         return results[0][1]
 
     async def omap_rm_keys(self, oid: str, keys) -> None:
-        await self._c.multi(self.pool_id, oid,
+        await self._c.multi(self.pool_id, self._full(oid),
                             [("omap_rm_keys", {"keys": list(keys)})],
                             snapc=self._snapc)
 
     async def operate(self, oid: str, op) -> list:
         """Execute a neorados WriteOp/ReadOp through this ioctx
         (librados operate/operate_read role over the same engine)."""
-        results, _v = await self._c.multi(self.pool_id, oid, op._ops,
-                                          snapc=self._snapc)
+        results, _v = await self._c.multi(self.pool_id, self._full(oid),
+                                          op._ops, snapc=self._snapc)
         return results
 
     async def watch(self, oid: str, callback) -> None:
-        await self._c.watch(self.pool_id, oid, callback)
+        await self._c.watch(self.pool_id, self._full(oid), callback)
 
     async def unwatch(self, oid: str) -> None:
-        await self._c.unwatch(self.pool_id, oid)
+        await self._c.unwatch(self.pool_id, self._full(oid))
 
     async def notify(self, oid: str, payload: bytes = b"") -> List:
-        return await self._c.notify(self.pool_id, oid, payload)
+        return await self._c.notify(self.pool_id, self._full(oid), payload)
 
     # -- async (aio_*) -------------------------------------------------------
 

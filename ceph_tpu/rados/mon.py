@@ -845,12 +845,30 @@ class Monitor:
                 return MSnapOpReply(tid=msg.tid, ok=False,
                                     code=-errno.ENOENT,
                                     error="no such pool")
+            # one snapshot DISCIPLINE per pool (reference
+            # is_pool_snaps_mode/is_unmanaged_snaps_mode): pool ops and
+            # self-managed ids disagree about who owns the SnapContext,
+            # so the first use latches the mode and mixing is -EINVAL
             if msg.op == "create":
+                if pool.snap_mode == "pool":
+                    return MSnapOpReply(
+                        tid=msg.tid, ok=False, code=-errno.EINVAL,
+                        error="pool is in pool-snaps mode; self-managed "
+                              "snap ids are not allowed")
+                pool.snap_mode = "selfmanaged"
                 pool.snap_seq += 1
                 self.osdmap.epoch += 1
                 await self._commit_state()
                 return MSnapOpReply(tid=msg.tid, snap_id=pool.snap_seq)
             if msg.op == "remove":
+                if pool.snap_mode == "pool":
+                    # symmetric latch: a self-managed remove on a
+                    # pool-snaps pool could retire a pool snapshot's id
+                    # while its name stays listed — exactly the
+                    # inconsistency the mode latch exists to prevent
+                    return MSnapOpReply(
+                        tid=msg.tid, ok=False, code=-errno.EINVAL,
+                        error="pool is in pool-snaps mode; use rmsnap")
                 if msg.snap_id <= 0 or msg.snap_id > pool.snap_seq:
                     return MSnapOpReply(tid=msg.tid, ok=False,
                                         code=-errno.EINVAL,
@@ -860,6 +878,40 @@ class Monitor:
                     self.osdmap.epoch += 1
                     await self._commit_state()
                 return MSnapOpReply(tid=msg.tid, snap_id=msg.snap_id)
+            if msg.op == "mksnap":
+                if pool.snap_mode == "selfmanaged":
+                    return MSnapOpReply(
+                        tid=msg.tid, ok=False, code=-errno.EINVAL,
+                        error="pool already uses self-managed snaps; "
+                              "pool snapshots are not allowed")
+                if not msg.name:
+                    return MSnapOpReply(tid=msg.tid, ok=False,
+                                        code=-errno.EINVAL,
+                                        error="snap name required")
+                if msg.name in pool.pool_snaps:
+                    return MSnapOpReply(tid=msg.tid, ok=False,
+                                        code=-errno.EEXIST,
+                                        error=f"snap {msg.name!r} exists")
+                pool.snap_mode = "pool"
+                pool.snap_seq += 1
+                pool.pool_snaps[msg.name] = pool.snap_seq
+                self.osdmap.epoch += 1
+                await self._commit_state()
+                return MSnapOpReply(tid=msg.tid, snap_id=pool.snap_seq)
+            if msg.op == "rmsnap":
+                sid = pool.pool_snaps.pop(msg.name, None)
+                if sid is None:
+                    return MSnapOpReply(tid=msg.tid, ok=False,
+                                        code=-errno.ENOENT,
+                                        error=f"no snap {msg.name!r}")
+                if sid not in pool.removed_snaps:
+                    pool.removed_snaps.add(sid)
+                # the mode latch survives an empty snap list (reference
+                # POOL_SNAPS flag is sticky) — pool vs self-managed is
+                # a pool lifetime decision
+                self.osdmap.epoch += 1
+                await self._commit_state()
+                return MSnapOpReply(tid=msg.tid, snap_id=sid)
             return MSnapOpReply(tid=msg.tid, ok=False, code=-errno.EINVAL,
                                 error="bad snap op")
         if isinstance(msg, MPoolSet):

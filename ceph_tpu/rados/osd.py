@@ -123,10 +123,20 @@ from ceph_tpu.rados.types import (
     MWatchNotify,
     OSDMap,
     PoolInfo,
+    ALL_NSPACES,
     is_snap_clone,
     snap_clone_oid,
     snap_head,
+    split_ns,
 )
+
+
+def _ns_match(oid: str, nspace: str) -> bool:
+    """Listing namespace filter (reference pgnls oloc nspace): "" means
+    the DEFAULT namespace only; the ALL_NSPACES sentinel matches
+    everything."""
+    return nspace == ALL_NSPACES or split_ns(oid)[0] == nspace
+
 
 PGMETA_PREFIX = "__pgmeta_"  # per-PG metadata object carrying the PG log
 
@@ -1529,7 +1539,9 @@ class OSD:
             elif op.op == "pgls":
                 reply = await self._do_pgls(op)
             elif op.op == "list":
-                reply = MOSDOpReply(ok=True, oids=self._list_heads(op.pool_id))
+                reply = MOSDOpReply(ok=True, oids=[
+                    o for o in self._list_heads(op.pool_id)
+                    if _ns_match(o, op.nspace)])
             elif op.op == "repair":
                 pool = self.osdmap.pools.get(op.pool_id)
                 if pool is not None:
@@ -1773,6 +1785,8 @@ class OSD:
             if op.cursor and oid <= op.cursor:
                 continue
             if is_snap_clone(oid):
+                continue
+            if not _ns_match(oid, op.nspace):
                 continue
             if self._load_snapset(op.pool_id, oid).get("whiteout"):
                 continue

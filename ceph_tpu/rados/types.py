@@ -40,6 +40,36 @@ def is_snap_clone(oid: str) -> bool:
     return SNAP_SEP in oid
 
 
+# -- rados namespaces ---------------------------------------------------------
+
+# object identity is (nspace, name) (reference object_locator_t nspace,
+# src/librados/IoCtxImpl.cc oloc plumbing): composed here into one wire
+# name <nspace><NS_SEP><name> so the SAME string flows through placement
+# hashing, OSD store keys, PG logs and scrub untouched — the namespace
+# participates in the placement hash exactly like the reference's
+# pg_pool_t::hash_key (ns + '\\037' + key).  The separator cannot appear
+# in user oids or namespace names (rejected at the IoCtx boundary).
+NS_SEP = "\x00ns\x00"
+
+# listing sentinel (reference LIBRADOS_ALL_NSPACES): an IoCtx whose
+# namespace is set to this lists every namespace; it is not a valid
+# namespace for I/O
+ALL_NSPACES = "\x01all\x01"
+
+
+def make_oid(nspace: str, name: str) -> str:
+    """Compose the wire object name for (nspace, name); the default
+    namespace '' keeps bare names (and full wire compatibility with
+    pre-namespace data)."""
+    return f"{nspace}{NS_SEP}{name}" if nspace else name
+
+
+def split_ns(oid: str) -> Tuple[str, str]:
+    """(nspace, name) for any wire object name."""
+    i = oid.find(NS_SEP)
+    return ("", oid) if i < 0 else (oid[:i], oid[i + len(NS_SEP):])
+
+
 class IntervalSet:
     """Sorted disjoint half-open [start, end) runs of snap ids (reference
     interval_set<snapid_t>, src/include/interval_set.h).  pg_pool_t ships
@@ -135,6 +165,22 @@ class PoolInfo:
     # snap-read resolution can skip them without bloating the map
     snap_seq: int = 0
     removed_snaps: IntervalSet = field(default_factory=IntervalSet)
+    # pool-managed snapshots (reference pg_pool_t::snaps + the
+    # POOL_SNAPS/SELFMANAGED_SNAPS mode latch, src/osd/osd_types.h
+    # is_pool_snaps_mode/is_unmanaged_snaps_mode): a pool commits to ONE
+    # snapshot discipline at first use — mon pool ops (mksnap/rmsnap)
+    # or client-allocated self-managed ids — and mixing is a typed
+    # -EINVAL, because the two disagree about who owns the SnapContext
+    snap_mode: str = "none"  # none | pool | selfmanaged
+    pool_snaps: Dict[str, int] = field(default_factory=dict)  # name -> id
+
+    def pool_snapc(self) -> Tuple[int, List[int]]:
+        """The pool's SnapContext (seq, live snap ids DESCENDING) that
+        every write to a pool-snaps-mode pool carries (reference
+        IoCtxImpl picks the pool snapc when the ioctx has none)."""
+        live = sorted((s for s in self.pool_snaps.values()
+                       if s not in self.removed_snaps), reverse=True)
+        return (self.snap_seq, live)
 
 
 @dataclass
@@ -537,8 +583,12 @@ class MSnapOp:
     mon is the allocator so ids are cluster-unique and monotonic."""
 
     pool_id: int = 0
-    op: str = "create"  # create | remove
+    # create | remove: self-managed id allocation/retirement
+    # mksnap | rmsnap: mon-managed POOL snapshots (reference
+    #   OSDMonitor pool-op SNAP_CREATE/SNAP_RM handlers)
+    op: str = "create"
     snap_id: int = 0  # for remove
+    name: str = ""  # for mksnap/rmsnap
     tid: str = ""
 
 
@@ -602,6 +652,10 @@ class MOSDOp:
     pg: int = -1
     cursor: str = ""  # resume after this oid ("" = start)
     max_entries: int = 0  # 0 = server default
+    # op == "pgls"/"list": namespace filter — "" = default namespace
+    # only, ALL_NSPACES sentinel = every namespace (reference
+    # object_locator_t nspace on the list op)
+    nspace: str = ""
     # op == "multi": compound atomic operation — an ORDERED vector of
     # (name, kwargs) sub-ops executed on one object under the object's
     # critical section, all-or-nothing (reference MOSDOp's vector<OSDOp>

@@ -124,9 +124,13 @@ def _osd_tree(osdmap) -> List[Dict]:
 
 
 async def _df(client) -> List[Dict]:
+    from ceph_tpu.rados.types import ALL_NSPACES
+
     rows = []
     for pool in client.osdmap.pools.values():
-        objects = await client.list_objects(pool.pool_id)
+        # df is a pool-wide stat: include every namespace
+        objects = await client.list_objects(pool.pool_id,
+                                            nspace=ALL_NSPACES)
         rows.append({"pool": pool.name, "id": pool.pool_id,
                      "type": pool.pool_type, "objects": len(objects)})
     return rows
@@ -263,6 +267,24 @@ async def run(args) -> int:
                 return 2
             await client.pool_set(pool.pool_id, key, value)
             print(f"set pool {name} {key} = {value}")
+            return 0
+        if args.words[:3] in (["osd", "pool", "mksnap"],
+                              ["osd", "pool", "rmsnap"]):
+            rest = args.words[3:]
+            if len(rest) != 2:
+                print(f"usage: osd pool {args.words[2]} POOL SNAP",
+                      file=sys.stderr)
+                return 2
+            pool = m.pool_by_name(rest[0])
+            if pool is None:
+                print(f"no pool {rest[0]!r}", file=sys.stderr)
+                return 2
+            if args.words[2] == "mksnap":
+                await client.pool_snap_create(pool.pool_id, rest[1])
+                print(f"created pool {rest[0]} snap {rest[1]}")
+            else:
+                await client.pool_snap_remove(pool.pool_id, rest[1])
+                print(f"removed pool {rest[0]} snap {rest[1]}")
             return 0
         if args.words[:3] == ["osd", "pool", "rm"]:
             rest = args.words[3:]

@@ -17,6 +17,11 @@ import sys
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="rados object tool")
     p.add_argument("--mon", required=True, help="mon address host:port")
+    p.add_argument("-N", "--namespace", default="",
+                   help="rados namespace for object ops (reference "
+                        "rados -N; --all-namespaces for ls)")
+    p.add_argument("--all-namespaces", action="store_true",
+                   help="ls spans every namespace (prints ns/name)")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     mk = sub.add_parser("mkpool")
@@ -39,6 +44,23 @@ def parse_args(argv=None):
 
     ls = sub.add_parser("ls")
     ls.add_argument("pool")
+
+    mks = sub.add_parser("mksnap", help="create a pool snapshot")
+    mks.add_argument("pool")
+    mks.add_argument("snap")
+
+    rms = sub.add_parser("rmsnap", help="remove a pool snapshot")
+    rms.add_argument("pool")
+    rms.add_argument("snap")
+
+    lss = sub.add_parser("lssnap", help="list pool snapshots")
+    lss.add_argument("pool")
+
+    rb = sub.add_parser("rollback",
+                        help="roll one object back to a pool snapshot")
+    rb.add_argument("pool")
+    rb.add_argument("obj")
+    rb.add_argument("snap")
 
     be = sub.add_parser("bench", help="reference `rados bench` role")
     be.add_argument("pool")
@@ -156,19 +178,54 @@ async def run(args) -> int:
             print(f"pool {args.pool} does not exist", file=sys.stderr)
             return 1
         pool_id = pools[args.pool]
+        from ceph_tpu.rados.types import (ALL_NSPACES, NS_SEP, SNAP_SEP,
+                                          make_oid, split_ns)
+
+        ns = getattr(args, "namespace", "") or ""
+        if ns == ALL_NSPACES or NS_SEP in ns or SNAP_SEP in ns:
+            # same boundary validation as IoCtx.set_namespace: the
+            # reserved separator and the all-namespaces sentinel are
+            # not valid I/O namespaces
+            print("invalid namespace", file=sys.stderr)
+            return 2
         if args.cmd == "put":
             with open(args.path, "rb") as f:
                 data = f.read()
-            await client.put(pool_id, args.obj, data)
+            await client.put(pool_id, make_oid(ns, args.obj), data)
         elif args.cmd == "get":
-            data = await client.get(pool_id, args.obj)
+            data = await client.get(pool_id, make_oid(ns, args.obj))
             with open(args.path, "wb") as f:
                 f.write(data)
         elif args.cmd == "rm":
-            await client.delete(pool_id, args.obj)
+            await client.delete(pool_id, make_oid(ns, args.obj))
         elif args.cmd == "ls":
-            for name in await client.list_objects(pool_id):
-                print(name)
+            if args.all_namespaces:
+                for wire in await client.list_objects(
+                        pool_id, nspace=ALL_NSPACES):
+                    w_ns, name = split_ns(wire)
+                    print(f"{w_ns}/{name}" if w_ns else name)
+            else:
+                for wire in await client.list_objects(pool_id, nspace=ns):
+                    print(split_ns(wire)[1])
+        elif args.cmd == "mksnap":
+            sid = await client.pool_snap_create(pool_id, args.snap)
+            print(f"created pool {args.pool} snap {args.snap} (id {sid})")
+        elif args.cmd == "rmsnap":
+            await client.pool_snap_remove(pool_id, args.snap)
+            print(f"removed pool {args.pool} snap {args.snap}")
+        elif args.cmd == "lssnap":
+            snaps = await client.pool_snap_list(pool_id)
+            for name, sid in sorted(snaps.items(), key=lambda kv: kv[1]):
+                print(f"{sid}\t{name}")
+            print(f"{len(snaps)} snaps")
+        elif args.cmd == "rollback":
+            snaps = await client.pool_snap_list(pool_id)
+            if args.snap not in snaps:
+                print(f"no snap {args.snap}", file=sys.stderr)
+                return 1
+            await client.rollback_object(pool_id, make_oid(ns, args.obj),
+                                         snaps[args.snap])
+            print(f"rolled back {args.obj} to {args.snap}")
         elif args.cmd == "bench":
             return await _bench(client, pool_id, args)
         return 0
