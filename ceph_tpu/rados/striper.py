@@ -89,6 +89,27 @@ class RadosStriper:
         ))
         return b"".join(pieces)[:header["size"]]
 
+    async def read_range(self, soid: str, off: int, length: int) -> bytes:
+        """Partial read: only the pieces overlapping [off, off+length)
+        are fetched (reference libradosstriper read path: extent →
+        per-object extents via the layout, no full-object
+        materialization).  Clamped to the object size."""
+        header = json.loads(await self.ioctx.read(self._header(soid)))
+        if header.get("size", 0) < 0:
+            raise RadosError(f"{soid}: torn by an interrupted write")
+        size = header["size"]
+        osize = header["object_size"]
+        end = min(off + max(0, length), size)
+        if off >= end:
+            return b""
+        first, last = off // osize, (end - 1) // osize
+        pieces = await asyncio.gather(*(
+            self.ioctx.read(self._piece(soid, i))
+            for i in range(first, last + 1)
+        ))
+        base = first * osize
+        return b"".join(pieces)[off - base:end - base]
+
     async def stat(self, soid: str) -> dict:
         return json.loads(await self.ioctx.read(self._header(soid)))
 

@@ -343,6 +343,31 @@ def _rgw_index_rm(hctx: ClsContext, inp: bytes) -> Tuple[int, bytes]:
     return 0, json.dumps({"prev": prev}).encode()
 
 
+@cls_method("rgw", "index_set_tags")
+def _rgw_index_set_tags(hctx: ClsContext, inp: bytes) -> Tuple[int, bytes]:
+    """Object tagging rides the bucket index entry (reference
+    cls_rgw + rgw_tag.cc: tags live in the object's index/attrs, not
+    the data): set, or clear with tags=None."""
+    raw = hctx.read()
+    if raw is None:
+        return ENOENT, b""
+    index = _json_or({}, raw)
+    req = _json_or({}, inp)
+    key = req.get("key")
+    if not key or key not in index:
+        return ENOENT, b""
+    entry = index[key]
+    tags = req.get("tags")
+    if tags is None:
+        entry.pop("tags", None)
+    else:
+        if not isinstance(tags, dict) or len(tags) > 10:
+            return EINVAL, b""  # S3 caps object tag sets at 10
+        entry["tags"] = tags
+    hctx.write(json.dumps(index).encode())
+    return 0, b""
+
+
 @cls_method("rgw", "index_list")
 def _rgw_index_list(hctx: ClsContext, inp: bytes) -> Tuple[int, bytes]:
     raw = hctx.read()

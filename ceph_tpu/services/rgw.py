@@ -52,6 +52,7 @@ import errno
 import hashlib
 import hmac
 import json
+import re
 import time
 import uuid
 from typing import Dict, List, Optional, Tuple
@@ -681,8 +682,12 @@ class RgwService:
         await self._log_mutation("put", bucket, key)
         return vid
 
-    async def get_object(self, bucket: str, key: str,
-                         version_id: Optional[str] = None) -> bytes:
+    async def _resolve_object(self, bucket: str, key: str,
+                              version_id: Optional[str] = None):
+        """One resolution of (bucket, key[, version]) to its storage
+        form: ("plain", soid, size) or ("manifest", parts, size) — the
+        shared head of full GET, Range GET, and CopyObject (reference
+        RGWObjManifest resolution in RGWGetObj/RGWCopyObj)."""
         index = await self._load_index(bucket)
         if index is None:
             raise RadosError(f"NoSuchBucket: {bucket}")
@@ -708,20 +713,179 @@ class RgwService:
                                      code=-errno.ENOENT)
                 v = versions[-1]
             if "parts" in v:
-                # the version snapshots a multipart manifest: stitch it
-                blobs = await asyncio.gather(
-                    *(self.striper.read(p["oid"]) for p in v["parts"]))
-                return b"".join(blobs)
+                return ("manifest", v["parts"],
+                        sum(p["size"] for p in v["parts"]))
             if v.get("vid") == "null":
-                return await self.striper.read(f"{bucket}/{key}")
-            return await self.striper.read(
-                self._version_oid(bucket, key, v["vid"]))
+                return ("plain", f"{bucket}/{key}", v.get("size", 0))
+            return ("plain", self._version_oid(bucket, key, v["vid"]),
+                    v.get("size", 0))
         if "parts" in entry:
+            return ("manifest", entry["parts"],
+                    sum(p["size"] for p in entry["parts"]))
+        return ("plain", f"{bucket}/{key}", entry.get("size", 0))
+
+    async def _read_resolved(self, kind: str, ref) -> bytes:
+        if kind == "manifest":
             # manifest object: stitch the parts in order (RGWObjManifest)
             blobs = await asyncio.gather(
-                *(self.striper.read(p["oid"]) for p in entry["parts"]))
+                *(self.striper.read(p["oid"]) for p in ref))
             return b"".join(blobs)
-        return await self.striper.read(f"{bucket}/{key}")
+        return await self.striper.read(ref)
+
+    async def get_object(self, bucket: str, key: str,
+                         version_id: Optional[str] = None) -> bytes:
+        kind, ref, _size = await self._resolve_object(bucket, key,
+                                                      version_id)
+        return await self._read_resolved(kind, ref)
+
+    @staticmethod
+    def parse_range(spec: str, total: int) -> Optional[Tuple[int, int]]:
+        """RFC 7233 single byte-range (reference RGWGetObj range
+        parsing): 'bytes=a-b' / 'bytes=a-' / 'bytes=-N' -> (start,
+        end_inclusive) clamped to `total`.  Returns None for a
+        malformed spec (S3: ignore the header, serve the whole
+        object); raises InvalidRange (-ERANGE) when syntactically
+        valid but unsatisfiable — the 416 contract."""
+        m = re.fullmatch(r"bytes=(\d*)-(\d*)", spec.strip())
+        if not m or (not m.group(1) and not m.group(2)):
+            return None
+        a, b = m.group(1), m.group(2)
+        if a and b and int(b) < int(a):
+            # RFC 7233 §2.1: last-byte-pos < first-byte-pos makes the
+            # spec syntactically INVALID — ignored, not 416
+            return None
+        if not a:  # suffix form: last N bytes
+            n = int(b)
+            if n == 0 or total == 0:
+                raise RadosError("InvalidRange", code=-errno.ERANGE)
+            start, end = max(0, total - n), total - 1
+        else:
+            start = int(a)
+            if start >= total:
+                raise RadosError("InvalidRange", code=-errno.ERANGE)
+            end = min(int(b), total - 1) if b else total - 1
+        return (start, end)
+
+    async def get_object_range(self, bucket: str, key: str, spec: str,
+                               version_id: Optional[str] = None
+                               ) -> Tuple[bytes, int,
+                                          Optional[Tuple[int, int]]]:
+        """Range GET (reference RGWGetObj with ofs/end): only the
+        stripes/parts overlapping the range are read.  Returns
+        (bytes, total_size, (start, end_inclusive)); a malformed spec
+        degrades to the full object per S3."""
+        kind, ref, total = await self._resolve_object(bucket, key,
+                                                      version_id)
+        try:
+            rng = self.parse_range(spec, total)
+        except RadosError as e:
+            # unsatisfiable: carry the total so the 416 reply's
+            # Content-Range needs no second resolution
+            e.total = total
+            raise
+        if rng is None:
+            # malformed spec: serve the whole object (S3 ignores the
+            # header); rng=None tells the frontend to answer 200 —
+            # read the form already resolved, no second index read
+            return await self._read_resolved(kind, ref), total, None
+        start, end = rng
+        length = end - start + 1
+        if kind == "plain":
+            return (await self.striper.read_range(ref, start, length),
+                    total, rng)
+        # manifest: walk parts by cumulative offset, partial-read only
+        # the overlapping ones (the multipart analog of the stripe walk)
+        chunks, pos = [], 0
+        for p in ref:
+            p_end = pos + p["size"]
+            if p_end > start and pos <= end:
+                sub_off = max(0, start - pos)
+                sub_len = min(end + 1, p_end) - (pos + sub_off)
+                chunks.append(self.striper.read_range(
+                    p["oid"], sub_off, sub_len))
+            pos = p_end
+            if pos > end:
+                break
+        return b"".join(await asyncio.gather(*chunks)), total, rng
+
+    async def stat_object(self, bucket: str, key: str,
+                          version_id: Optional[str] = None) -> int:
+        """Total size without reading data (HEAD / 416 support)."""
+        _kind, _ref, total = await self._resolve_object(bucket, key,
+                                                        version_id)
+        return total
+
+    async def copy_object(self, src_bucket: str, src_key: str,
+                          dst_bucket: str, dst_key: str,
+                          version_id: Optional[str] = None,
+                          principal: Optional[str] = None) -> Dict:
+        """Server-side copy (reference RGWCopyObj, x-amz-copy-source):
+        the data never leaves the cluster — read source form, write
+        destination through the normal put path (index + versioning +
+        datalog all apply).  Tags copy with the object (S3 default
+        COPY directive)."""
+        data = await self.get_object(src_bucket, src_key,
+                                     version_id=version_id)
+        # read source tags BEFORE the destination put: copying an
+        # object onto itself replaces the index entry, and reading
+        # after would see the fresh (tagless) entry and drop them
+        src_index = await self._load_index(src_bucket)
+        tags = (src_index or {}).get(src_key, {}).get("tags")
+        await self.check_quota(principal, dst_bucket, len(data))
+        vid = await self.put_object(dst_bucket, dst_key, data)
+        if tags and version_id is None:
+            await self.put_object_tagging(dst_bucket, dst_key, tags)
+        out = {"ETag": hashlib.md5(data).hexdigest(),
+               "LastModified": time.time()}
+        if vid:
+            out["VersionId"] = vid
+        return out
+
+    # -- object tagging (reference rgw_tag.cc, cls_rgw: tags ride the
+    #    bucket index entry, not the object data) ---------------------------
+
+    async def put_object_tagging(self, bucket: str, key: str,
+                                 tags: Dict[str, str]) -> None:
+        if not isinstance(tags, dict) or len(tags) > 10:
+            raise RadosError("InvalidTag: at most 10 tags",
+                             code=-errno.EINVAL)
+        await self._set_tags(bucket, key, dict(tags))
+
+    async def get_object_tagging(self, bucket: str, key: str
+                                 ) -> Dict[str, str]:
+        index = await self._load_index(bucket)
+        if index is None:
+            raise RadosError(f"NoSuchBucket: {bucket}")
+        if key not in index:
+            raise RadosError(f"NoSuchKey: {key}")
+        return dict(index[key].get("tags") or {})
+
+    async def delete_object_tagging(self, bucket: str, key: str) -> None:
+        await self._set_tags(bucket, key, None)
+
+    async def _set_tags(self, bucket: str, key: str,
+                        tags: Optional[Dict[str, str]]) -> None:
+        got = await self._idx_cls(bucket, "index_set_tags",
+                                  {"key": key, "tags": tags})
+        if got is not None:
+            ret, _ = got
+            if ret == -errno.ENOENT:
+                raise RadosError(f"NoSuchKey: {key}", code=ret)
+            if ret < 0:
+                raise RadosError(f"index_set_tags failed ({ret})",
+                                 code=ret)
+            return
+        # EC pool: client-side RMW is the single writer path
+        index = await self._load_index(bucket)
+        if index is None:
+            raise RadosError(f"NoSuchBucket: {bucket}")
+        if key not in index:
+            raise RadosError(f"NoSuchKey: {key}")
+        if tags is None:
+            index[key].pop("tags", None)
+        else:
+            index[key]["tags"] = tags
+        await self._save_index(bucket, index)
 
     async def _drop_object_data(self, bucket: str, key: str,
                                 entry: Optional[Dict]) -> None:
@@ -1466,8 +1630,11 @@ class RgwFrontend:
                         status, payload = ("403 Forbidden",
                                            b"UserSuspended")
                     else:
-                        status, payload = await self._route(
-                            method, path, query, body, principal)
+                        out = await self._route(
+                            method, path, query, body, principal, headers)
+                        status, payload = out[0], out[1]
+                        if len(out) == 3:
+                            extra.update(out[2])
                 elif (self.service.credentials
                         and not verify_request(self.service.credentials,
                                                method, path, query, headers,
@@ -1482,8 +1649,11 @@ class RgwFrontend:
                         status, payload = ("403 Forbidden",
                                            b"UserSuspended")
                     else:
-                        status, payload = await self._route(
-                            method, path, query, body, principal)
+                        out = await self._route(
+                            method, path, query, body, principal, headers)
+                        status, payload = out[0], out[1]
+                        if len(out) == 3:
+                            extra.update(out[2])
                 hdr_lines = "".join(f"{k}: {v}\r\n" for k, v in extra.items())
                 writer.write(
                     f"HTTP/1.1 {status}\r\nContent-Length: {len(payload)}\r\n"
@@ -1616,7 +1786,11 @@ class RgwFrontend:
 
     async def _route(self, method: str, path: str, query: str,
                      body: bytes,
-                     principal: Optional[str] = None) -> Tuple[str, bytes]:
+                     principal: Optional[str] = None,
+                     headers: Optional[Dict[str, str]] = None):
+        """Returns (status, payload) or (status, payload, extra
+        response headers) — Range GETs carry Content-Range."""
+        headers = headers or {}
         parts = [p for p in path.split("/") if p]
         q = dict(parse_qsl(query, keep_blank_values=True))
         try:
@@ -1752,6 +1926,40 @@ class RgwFrontend:
                     part = int(q["partNumber"])
                 except ValueError:
                     return "400 Bad Request", b"InvalidArgument: partNumber"
+                if headers.get("x-amz-copy-source"):
+                    # UploadPartCopy (reference RGWCopyObj part mode):
+                    # the part bytes come from an existing object, with
+                    # an optional x-amz-copy-source-range — silently
+                    # staging the empty request body instead would
+                    # complete into a truncated object
+                    src = unquote(headers["x-amz-copy-source"])
+                    src_path, _, src_q = src.partition("?")
+                    sparts = [p for p in src_path.split("/") if p]
+                    if len(sparts) < 2:
+                        return ("400 Bad Request",
+                                b"InvalidArgument: copy-source")
+                    sbucket, skey = sparts[0], "/".join(sparts[1:])
+                    svid = dict(parse_qsl(src_q)).get("versionId")
+                    smeta = await self.service.get_bucket_meta(sbucket)
+                    sverdict = RgwService.policy_eval(
+                        smeta.get("policy"), principal, "s3:GetObject",
+                        f"arn:aws:s3:::{sbucket}/{skey}")
+                    if sverdict == "Deny" or (
+                            sverdict != "Allow"
+                            and not RgwService.acl_allows(
+                                smeta.get("acl"), principal, "READ")):
+                        return "403 Forbidden", b"AccessDenied"
+                    src_rng = headers.get("x-amz-copy-source-range")
+                    if src_rng:
+                        body, _total, rng = \
+                            await self.service.get_object_range(
+                                sbucket, skey, src_rng, version_id=svid)
+                        if rng is None:
+                            return ("400 Bad Request",
+                                    b"InvalidArgument: copy-source-range")
+                    else:
+                        body = await self.service.get_object(
+                            sbucket, skey, version_id=svid)
                 # staged parts are quota-charged too (against indexed
                 # usage — a bound, not exact accounting), or a capped
                 # user could park unlimited bytes in never-completed
@@ -1764,6 +1972,46 @@ class RgwFrontend:
             if method == "DELETE" and "uploadId" in q:
                 await self.service.abort_multipart(bucket, q["uploadId"])
                 return "204 No Content", b""
+            if method == "PUT" and "tagging" in q:
+                try:
+                    parsed = json.loads(body or b"{}")
+                except ValueError:
+                    return "400 Bad Request", b"MalformedXML"
+                if not isinstance(parsed, dict) or not isinstance(
+                        parsed.get("TagSet", {}), dict):
+                    return "400 Bad Request", b"MalformedXML"
+                await self.service.put_object_tagging(
+                    bucket, key, parsed.get("TagSet", {}))
+                return "200 OK", b""
+            if method == "GET" and "tagging" in q:
+                tags = await self.service.get_object_tagging(bucket, key)
+                return "200 OK", json.dumps({"TagSet": tags}).encode()
+            if method == "DELETE" and "tagging" in q:
+                await self.service.delete_object_tagging(bucket, key)
+                return "204 No Content", b""
+            if method == "PUT" and headers.get("x-amz-copy-source"):
+                # server-side copy (reference RGWCopyObj): the caller
+                # needs WRITE on the destination (already gated above)
+                # AND read access to the SOURCE bucket/key
+                src = unquote(headers["x-amz-copy-source"])
+                src_path, _, src_q = src.partition("?")
+                sparts = [p for p in src_path.split("/") if p]
+                if len(sparts) < 2:
+                    return "400 Bad Request", b"InvalidArgument: copy-source"
+                sbucket, skey = sparts[0], "/".join(sparts[1:])
+                svid = dict(parse_qsl(src_q)).get("versionId")
+                smeta = await self.service.get_bucket_meta(sbucket)
+                sverdict = RgwService.policy_eval(
+                    smeta.get("policy"), principal, "s3:GetObject",
+                    f"arn:aws:s3:::{sbucket}/{skey}")
+                if sverdict == "Deny" or (
+                        sverdict != "Allow" and not RgwService.acl_allows(
+                            smeta.get("acl"), principal, "READ")):
+                    return "403 Forbidden", b"AccessDenied"
+                out = await self.service.copy_object(
+                    sbucket, skey, bucket, key, version_id=svid,
+                    principal=principal)
+                return "200 OK", json.dumps(out).encode()
             if method == "PUT":
                 await self.service.check_quota(principal, bucket,
                                                len(body))
@@ -1772,6 +2020,31 @@ class RgwFrontend:
                 return "200 OK", (json.dumps({"VersionId": vid}).encode()
                                   if vid else b"")
             if method == "GET":
+                rng_hdr = headers.get("range")
+                if rng_hdr:
+                    try:
+                        data, total, rng = \
+                            await self.service.get_object_range(
+                                bucket, key, rng_hdr,
+                                version_id=q.get("versionId"))
+                    except RadosError as e:
+                        if e.code == -errno.ERANGE:
+                            total = getattr(e, "total", None)
+                            if total is None:
+                                total = await self.service.stat_object(
+                                    bucket, key,
+                                    version_id=q.get("versionId"))
+                            return ("416 Requested Range Not Satisfiable",
+                                    b"InvalidRange",
+                                    {"Content-Range": f"bytes */{total}"})
+                        raise
+                    if rng is None:
+                        # malformed spec: S3 ignores the header
+                        return "200 OK", data
+                    a, b = rng
+                    return ("206 Partial Content", data,
+                            {"Content-Range": f"bytes {a}-{b}/{total}",
+                             "Accept-Ranges": "bytes"})
                 return "200 OK", await self.service.get_object(
                     bucket, key, version_id=q.get("versionId"))
             if method == "HEAD":
@@ -1792,7 +2065,8 @@ class RgwFrontend:
             if "BucketNotEmpty" in msg:
                 return "409 Conflict", msg.encode()
             if "InvalidPart" in msg or "MalformedXML" in msg \
-                    or "MalformedPolicy" in msg:
+                    or "MalformedPolicy" in msg or "InvalidTag" in msg \
+                    or "InvalidArgument" in msg:
                 return "400 Bad Request", msg.encode()
             if "MethodNotAllowed" in msg:
                 return "405 Method Not Allowed", msg.encode()
