@@ -568,6 +568,8 @@ def main() -> int:
     # Python messenger tax, not the accelerator, is what this measures).
     daemon_put_mbps = 0.0
     daemon_get_mbps = 0.0
+    daemon_wire_put_mbps = 0.0
+    daemon_wire_get_mbps = 0.0
     try:
         import subprocess
 
@@ -581,6 +583,8 @@ def main() -> int:
             got = json.loads(child.stdout.strip().splitlines()[-1])
             daemon_put_mbps = got.get("put_MBps", 0.0)
             daemon_get_mbps = got.get("get_MBps", 0.0)
+            daemon_wire_put_mbps = got.get("wire_put_MBps", 0.0)
+            daemon_wire_get_mbps = got.get("wire_get_MBps", 0.0)
     except Exception:
         pass
 
@@ -613,14 +617,21 @@ def main() -> int:
         "batch_hostmem_GBps": round(batch_gbps, 3),
         "daemon_put_MBps": round(daemon_put_mbps, 1),
         "daemon_get_MBps": round(daemon_get_mbps, 1),
+        "daemon_wire_put_MBps": round(daemon_wire_put_mbps, 1),
+        "daemon_wire_get_MBps": round(daemon_wire_get_mbps, 1),
     }))
     return 0
 
 
 def daemon_path_bench() -> int:
     """64 MiB rados put+get through a 6-OSD in-process cluster — the
-    cluster-path number (VERDICT r02 #7): quantifies the Python
-    messenger/daemon tax independent of the device."""
+    cluster-path number (VERDICT r02 #7).  Measured on BOTH transports:
+    the colocated-daemons fast dispatch (ms_local_fastpath, the
+    production shape for daemons sharing a host process: by-reference
+    handoff + ownership-transferring stores) and the real TCP wire with
+    fixed-binary data-plane framing (the cross-host shape).  The
+    headline put/get numbers are the fastpath; wire numbers carry the
+    _wire suffix so neither transport's tax hides in the other."""
     import asyncio
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -628,10 +639,12 @@ def daemon_path_bench() -> int:
 
     size = 64 << 20
 
-    async def go():
+    async def go(fastpath: bool):
         # k=4 m=2 on 6 OSDs: every shard gets a distinct daemon, the
         # representative fan-out shape without an 11-daemon cluster
-        cluster = Cluster(n_osds=6, conf={"osd_auto_repair": False})
+        cluster = Cluster(n_osds=6, conf={
+            "osd_auto_repair": False,
+            "ms_local_fastpath": fastpath})
         await cluster.start()
         try:
             c = await cluster.client()
@@ -660,9 +673,13 @@ def daemon_path_bench() -> int:
         finally:
             await cluster.stop()
 
-    put_dt, get_dt = asyncio.run(go())
-    print(json.dumps({"put_MBps": round(size / put_dt / 1e6, 1),
-                      "get_MBps": round(size / get_dt / 1e6, 1)}))
+    put_dt, get_dt = asyncio.run(go(True))
+    wire_put_dt, wire_get_dt = asyncio.run(go(False))
+    print(json.dumps({
+        "put_MBps": round(size / put_dt / 1e6, 1),
+        "get_MBps": round(size / get_dt / 1e6, 1),
+        "wire_put_MBps": round(size / wire_put_dt / 1e6, 1),
+        "wire_get_MBps": round(size / wire_get_dt / 1e6, 1)}))
     return 0
 
 

@@ -34,7 +34,8 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from ceph_tpu.rados.kv import KeyValueDB, MemDB, WalDB, WriteBatch
-from ceph_tpu.rados.store import Key, ObjectStore, ShardMeta, Transaction
+from ceph_tpu.rados.store import (Key, ObjectStore, ShardMeta, Transaction,
+                                  unwrap as store_unwrap)
 
 PREFIX_OBJ = "O"  # object metadata (extents, csums, ShardMeta, xattrs)
 PREFIX_DEFERRED = "D"  # deferred write payloads awaiting block flush
@@ -225,6 +226,7 @@ class BlueStore(ObjectStore):
                 batch.rm(PREFIX_OMAP + _okey(key), k)
         deferred_flush: List[Tuple[Key, _Onode, bytes]] = []
         for key, chunk, meta in txn.writes:
+            chunk = store_unwrap(chunk)  # disk store copies to media anyway
             old = self._onodes.get(key)
             if old is not None:
                 freed.extend(old.extents)
@@ -234,7 +236,10 @@ class BlueStore(ObjectStore):
             onode.extents = [(off, len(chunk))]
             onode.csums = [checksum(chunk)]
             if len(chunk) <= prefer_deferred:
-                # deferred: payload rides the KV WAL; block flush later
+                # deferred: payload rides the KV WAL (pickled) — needs
+                # real bytes, a memoryview cannot serialize
+                if not isinstance(chunk, bytes):
+                    chunk = bytes(chunk)
                 onode.deferred = True
                 batch.set(PREFIX_DEFERRED, _okey(key), chunk)
                 deferred_flush.append((key, onode, chunk))

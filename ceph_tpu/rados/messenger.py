@@ -27,9 +27,20 @@ reference src/common/options/global.yaml.in:1240) severs connections to
 exercise those paths without code changes, and a dispatch throttle
 (ms_dispatch_throttle_bytes) applies receive-side backpressure.
 
-Payloads are pickled dataclass fields — an internal trusted-cluster format;
-the reference's cross-version dencoder discipline is represented by the
-per-type version field checked on decode (and exercised by tools/dencoder).
+Wire formats, by plane (see README "Wire-format threat model"):
+- DATA plane (MOSDOp/MOSDOpReply/ECSub*/MPushShard): fixed binary field
+  layouts (FLAG_FIXED; FIXED_FIELDS declared in types.py) — struct-speed
+  and incapable of executing code on decode, like the reference's
+  fixed-layout dencoder structs.  Bulk bytes ride the zero-copy blob
+  lane with their own crc32c.
+- CONTROL plane (maps, peering, paxos, config): pickled dataclass
+  fields — an internal trusted-cluster format behind cephx-lite auth.
+- COLOCATED daemons (ms_local_fastpath): no serialization at all —
+  typed messages hand over by reference (Messenger local_connection
+  role).
+The reference's cross-version dencoder discipline is represented by the
+per-type version field checked on decode (and exercised by
+tools/dencoder + the wire corpus).
 """
 
 from __future__ import annotations
@@ -56,6 +67,14 @@ _HDR = struct.Struct("<IHHBIQ")  # len, type, version, flags, crc, seq
 _BLOB_PFX = struct.Struct("<II")
 
 FLAG_COMPRESSED = 1
+# FLAG_FIXED: the payload (or the header part of a blob frame) is the
+# class's FIXED_FIELDS binary layout, not pickle — the data-plane
+# framing discipline (reference fixed-layout dencoder encode for
+# MOSDOp/ECSubWrite wire structs, src/osd/ECMsgTypes.h encode_payload):
+# nothing on the hot path can execute code on decode, and field packing
+# is struct-speed.  Control-plane types keep pickle (internal
+# trusted-cluster format; see module docstring).
+FLAG_FIXED = 4
 # FLAG_BLOB: payload = [u32 plen][u32 blob_crc][pickled(plen)][blob].
 # The large binary field of a message (MOSDOp.data, MECSubWrite.chunk, ...)
 # rides OUT OF BAND from the pickle: the sender never copies it into a
@@ -98,33 +117,185 @@ def message(type_id: int, version: int = 1):
     return deco
 
 
+# store-resident buffers may be memoryviews (ownership-transferred
+# encode outputs); when one rides a pickled message field on the REAL
+# wire, serialize it as its bytes — the local fastpath never serializes
+import copyreg  # noqa: E402
+
+copyreg.pickle(memoryview, lambda m: (bytes, (bytes(m),)))
+
+
+# -- fixed binary field codec ------------------------------------------------
+# Data-plane messages declare FIXED_FIELDS = [(name, kind)]: a flat,
+# versioned-by-frame binary layout.  Kinds: q/Q/d/? scalars, s (u32-len
+# utf8), y (u32-len bytes), Q* (u64 list), s* (str list), qq* (list of
+# (i64, i64) pairs), addr ((host, port) or None).  A class may gate
+# eligibility with FIXED_WHEN(msg) — e.g. MOSDOp falls back to pickle
+# when a compound op vector is attached.
+
+_FIX = {k: struct.Struct("<" + k) for k in ("q", "Q", "d", "?")}
+_LEN32 = struct.Struct("<I")
+_PAIR = struct.Struct("<qq")
+
+
+def _pack_fixed(msg: Any, fields, blob_attr=None) -> bytes:
+    parts = []
+    for name, kind in fields:
+        v = msg.__dict__.get(name)
+        if name == blob_attr:
+            v = b""  # rides the blob lane; reattached on decode
+        st = _FIX.get(kind)
+        if st is not None:
+            parts.append(st.pack(v if kind != "?" else bool(v)))
+        elif kind == "s":
+            b = (v or "").encode()
+            parts.append(_LEN32.pack(len(b)))
+            parts.append(b)
+        elif kind == "y":
+            b = v if isinstance(v, (bytes, bytearray)) else \
+                (b"" if v is None else bytes(v))
+            parts.append(_LEN32.pack(len(b)))
+            parts.append(b)
+        elif kind == "Q*":
+            v = v or ()
+            parts.append(_LEN32.pack(len(v)))
+            parts.append(struct.pack(f"<{len(v)}Q", *v))
+        elif kind == "s*":
+            v = v or ()
+            parts.append(_LEN32.pack(len(v)))
+            for s in v:
+                b = s.encode()
+                parts.append(_LEN32.pack(len(b)))
+                parts.append(b)
+        elif kind == "qq*":
+            v = v or ()
+            parts.append(_LEN32.pack(len(v)))
+            for a, b in v:
+                parts.append(_PAIR.pack(a, b))
+        elif kind == "addr":
+            if not v:
+                parts.append(_LEN32.pack(0xFFFFFFFF))
+            else:
+                h = str(v[0]).encode()
+                parts.append(_LEN32.pack(len(h)))
+                parts.append(h)
+                parts.append(_FIX["q"].pack(int(v[1])))
+        else:  # pragma: no cover - schema bug
+            raise ValueError(f"unknown fixed kind {kind!r}")
+    return b"".join(parts)
+
+
+def _unpack_fixed(cls, payload: bytes, blob: Any):
+    obj = cls.__new__(cls)
+    d = obj.__dict__
+    # non-fixed fields keep their dataclass defaults (fresh containers)
+    defaults = _FIXED_DEFAULTS.get(cls)
+    if defaults is None:
+        defaults = _FIXED_DEFAULTS[cls] = {
+            k: v for k, v in cls().__dict__.items()}
+    fixed_names = {n for n, _ in cls.FIXED_FIELDS}
+    for k, v in defaults.items():
+        if k not in fixed_names:
+            d[k] = list(v) if isinstance(v, list) else (
+                dict(v) if isinstance(v, dict) else v)
+    off = 0
+    mv = memoryview(payload)
+    for name, kind in cls.FIXED_FIELDS:
+        st = _FIX.get(kind)
+        if st is not None:
+            d[name] = st.unpack_from(payload, off)[0]
+            off += st.size
+        elif kind in ("s", "y"):
+            (n,) = _LEN32.unpack_from(payload, off)
+            off += 4
+            raw = bytes(mv[off:off + n])
+            off += n
+            d[name] = raw.decode() if kind == "s" else raw
+        elif kind == "Q*":
+            (n,) = _LEN32.unpack_from(payload, off)
+            off += 4
+            d[name] = list(struct.unpack_from(f"<{n}Q", payload, off))
+            off += 8 * n
+        elif kind == "s*":
+            (n,) = _LEN32.unpack_from(payload, off)
+            off += 4
+            out = []
+            for _ in range(n):
+                (sn,) = _LEN32.unpack_from(payload, off)
+                off += 4
+                out.append(bytes(mv[off:off + sn]).decode())
+                off += sn
+            d[name] = out
+        elif kind == "qq*":
+            (n,) = _LEN32.unpack_from(payload, off)
+            off += 4
+            out = []
+            for _ in range(n):
+                out.append(_PAIR.unpack_from(payload, off))
+                off += _PAIR.size
+            d[name] = out
+        elif kind == "addr":
+            (n,) = _LEN32.unpack_from(payload, off)
+            off += 4
+            if n == 0xFFFFFFFF:
+                d[name] = None
+            else:
+                host = bytes(mv[off:off + n]).decode()
+                off += n
+                port = _FIX["q"].unpack_from(payload, off)[0]
+                off += 8
+                d[name] = (host, port)
+    if blob is not None:
+        d[getattr(cls, "BLOB_ATTR")] = blob
+    return obj
+
+
+_FIXED_DEFAULTS: Dict[type, Dict[str, Any]] = {}
+
+
 def encode_payload(msg: Any) -> bytes:
     return pickle.dumps(msg.__dict__, protocol=5)
 
 
 def encode_payload_parts(msg: Any):
-    """(pickled, blob): when the message class declares BLOB_ATTR and the
-    field is bulk bytes, it is stripped from the pickle and returned
-    separately so framing can scatter-gather it with zero copies."""
-    attr = getattr(type(msg), "BLOB_ATTR", None)
+    """(header, blob, fixed): when the message class declares BLOB_ATTR
+    and the field is bulk bytes, it is stripped from the header part and
+    returned separately so framing can scatter-gather it with zero
+    copies.  Data-plane classes with FIXED_FIELDS get the fixed binary
+    layout for the header part (fixed=True) instead of pickle."""
+    cls = type(msg)
+    attr = getattr(cls, "BLOB_ATTR", None)
+    blob = None
     if attr is not None:
-        blob = msg.__dict__.get(attr)
-        if isinstance(blob, (bytes, bytearray, memoryview)):
-            if len(blob) >= BLOB_MIN:
-                d = dict(msg.__dict__)
-                d[attr] = None  # reattached by decode_message
-                return pickle.dumps(d, protocol=5), blob
-            if isinstance(blob, memoryview):
-                # below the blob threshold the field rides the pickle,
-                # which cannot serialize memoryviews
-                d = dict(msg.__dict__)
-                d[attr] = bytes(blob)
-                return pickle.dumps(d, protocol=5), None
-    return pickle.dumps(msg.__dict__, protocol=5), None
+        b = msg.__dict__.get(attr)
+        if isinstance(b, (bytes, bytearray, memoryview)) \
+                and len(b) >= BLOB_MIN:
+            blob = b
+    fields = getattr(cls, "FIXED_FIELDS", None)
+    if fields is not None:
+        when = getattr(cls, "FIXED_WHEN", None)
+        if when is None or when(msg):
+            return (_pack_fixed(msg, fields,
+                                blob_attr=attr if blob is not None
+                                else None),
+                    blob, True)
+    if blob is not None:
+        d = dict(msg.__dict__)
+        d[attr] = None  # reattached by decode_message
+        return pickle.dumps(d, protocol=5), blob, False
+    if attr is not None:
+        b = msg.__dict__.get(attr)
+        if isinstance(b, memoryview):
+            # below the blob threshold the field rides the pickle,
+            # which cannot serialize memoryviews natively fast
+            d = dict(msg.__dict__)
+            d[attr] = bytes(b)
+            return pickle.dumps(d, protocol=5), None, False
+    return pickle.dumps(msg.__dict__, protocol=5), None, False
 
 
 def decode_message(type_id: int, version: int, payload: bytes,
-                   blob: Any = None) -> Any:
+                   blob: Any = None, fixed: bool = False) -> Any:
     cls = _MSG_TYPES.get(type_id)
     if cls is None:
         raise ValueError(f"unknown message type {type_id}")
@@ -132,6 +303,10 @@ def decode_message(type_id: int, version: int, payload: bytes,
         raise ValueError(
             f"{cls.__name__} wire version {version} > supported {cls.VERSION}"
         )
+    if fixed:
+        if getattr(cls, "FIXED_FIELDS", None) is None:
+            raise ValueError(f"{cls.__name__}: unexpected fixed frame")
+        return _unpack_fixed(cls, payload, blob)
     obj = cls.__new__(cls)
     obj.__dict__.update(pickle.loads(payload))
     if blob is not None:
@@ -185,6 +360,98 @@ def _cget(conf, key: str, default: Any) -> Any:
     except TypeError:
         v = conf.get(key) if key in conf else default
     return default if v is None else v
+
+
+# -- local fast dispatch -----------------------------------------------------
+
+# addr -> live Messenger in THIS process.  Colocated daemons' frames can
+# skip the TCP stack entirely (ms_local_fastpath): the in-process
+# equivalent of the reference's Messenger local_connection fast dispatch
+# and the colocated-transport seam its pluggable NetworkStack keeps open
+# (src/msg/async/Stack.h; DPDK/RDMA lanes plug in there the same way).
+_LOCAL_REGISTRY: Dict[Tuple[str, int], "Messenger"] = {}
+
+
+class LocalConnection:
+    """In-process session with a colocated daemon: typed messages hand
+    over BY REFERENCE through a receiver-side FIFO — no sockets,
+    framing, checksums, or serialization.  Delivery matches a lossless
+    wire session: per-connection order (one pump task), exactly-once
+    (no transport to fail mid-frame), and dispatcher isolation
+    (exceptions log, never propagate into the sender — the _serve
+    discipline).  Shared contract with the reference's local delivery:
+    a message is immutable once sent.
+
+    Enabled per-messenger by ms_local_fastpath; vstart turns it on for
+    plain clusters, while any wire-exercising configuration (auth,
+    secure mode, fault injection) keeps real sockets so those paths
+    stay covered."""
+
+    def __init__(self, messenger: "Messenger", peer_messenger: "Messenger",
+                 reverse: Optional["LocalConnection"] = None):
+        self.messenger = messenger
+        self.peer_messenger = peer_messenger
+        self.peer = tuple(peer_messenger.addr or ("local", 0))
+        self.peer_name = peer_messenger.name
+        self.policy = Policy.lossless_peer()
+        self.outbound = reverse is None
+        # how the peer "authenticated": same-process construction IS the
+        # trust statement (fastpath is off whenever auth is configured)
+        self.auth_kind = "local"
+        self.auth_entity_type = peer_messenger.entity_type
+        self.closed = False
+        # bounded: a flooding sender parks on put() exactly like a full
+        # socket buffer parks drain()
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=1024)
+        self._pump: Optional[asyncio.Task] = None
+        self.reverse = reverse if reverse is not None else \
+            LocalConnection(peer_messenger, messenger, reverse=self)
+
+    async def send(self, msg: Any) -> None:
+        peer = self.peer_messenger
+        if (self.closed or peer._shutdown
+                or _LOCAL_REGISTRY.get(self.peer) is not peer):
+            self.closed = True
+            raise ConnectionError(f"local peer {self.peer_name} gone")
+        cls = type(msg)
+        fields = getattr(cls, "FIXED_FIELDS", None)
+        when = getattr(cls, "FIXED_WHEN", None)
+        if fields is None or (when is not None and not when(msg)):
+            # CONTROL-plane (or exotic) payload: give the receiver its
+            # own object graph, exactly as the pickled wire would.
+            # By-reference handoff is only safe for the flat, immutable
+            # data-plane set — a control payload like MMapReply carries
+            # the mon's LIVE OSDMap, whose next in-place mutation would
+            # otherwise tear every colocated daemon's shared copy.
+            msg = pickle.loads(pickle.dumps(msg, protocol=5))
+        await self.reverse._deliver(msg)
+
+    async def _deliver(self, msg: Any) -> None:
+        await self._queue.put(msg)
+        if self._pump is None or self._pump.done():
+            m = self.messenger
+            self._pump = asyncio.get_running_loop().create_task(
+                self._pump_loop())
+            m._tasks.add(self._pump)
+            self._pump.add_done_callback(m._tasks.discard)
+
+    async def _pump_loop(self) -> None:
+        while not self.closed and not self.messenger._shutdown:
+            msg = await self._queue.get()
+            disp = self.messenger.dispatcher
+            if disp is None:
+                continue
+            try:
+                await disp(self, msg)
+            except (asyncio.CancelledError, GeneratorExit):
+                raise
+            except Exception:
+                traceback.print_exc()
+
+    async def close(self, gen: int = 0) -> None:
+        self.closed = True
+        if self._pump is not None:
+            self._pump.cancel()
 
 
 # -- connection --------------------------------------------------------------
@@ -411,8 +678,8 @@ class Connection:
 
     # -- frame IO ------------------------------------------------------------
 
-    def _frame(self, type_id: int, version: int, payload: bytes, seq: int) -> bytes:
-        flags = 0
+    def _frame(self, type_id: int, version: int, payload: bytes, seq: int,
+               flags: int = 0) -> bytes:
         if self.compress_min and len(payload) >= self.compress_min:
             compressed = zlib.compress(payload, 1)
             if len(compressed) < len(payload):
@@ -422,7 +689,7 @@ class Connection:
         return _HDR.pack(len(payload), type_id, version, flags, crc, seq) + payload
 
     def _frame_segments(self, type_id: int, version: int, pickled: bytes,
-                        blob, seq: int):
+                        blob, seq: int, flags: int = 0):
         """Scatter-gather frame for a blob message: the bulk bytes are
         never concatenated into a serialized buffer — the transport
         writev's [hdr, prefix, pickled, blob] as-is.  The header crc
@@ -434,7 +701,7 @@ class Connection:
         crc = (self.crc_fn(pickled, self.crc_fn(prefix))
                if self.crc_enabled else 0)
         hdr = _HDR.pack(_BLOB_PFX.size + len(pickled) + len(blob),
-                        type_id, version, FLAG_BLOB, crc, seq)
+                        type_id, version, FLAG_BLOB | flags, crc, seq)
         return [hdr, prefix, pickled, blob]
 
     async def _write_raw(self, data) -> None:
@@ -459,7 +726,8 @@ class Connection:
             await asyncio.sleep(random.uniform(0, delay))
         self.out_seq += 1
         seq = self.out_seq
-        pickled, blob = encode_payload_parts(msg)
+        pickled, blob, fixed = encode_payload_parts(msg)
+        flags = FLAG_FIXED if fixed else 0
         if blob is not None and self.policy.replay \
                 and isinstance(blob, memoryview):
             # a view entering the lossless REPLAY queue would pin its
@@ -469,9 +737,10 @@ class Connection:
             blob = bytes(blob)
         if blob is not None:
             data = self._frame_segments(msg.TYPE_ID, msg.VERSION, pickled,
-                                        blob, seq)
+                                        blob, seq, flags)
         else:
-            data = self._frame(msg.TYPE_ID, msg.VERSION, pickled, seq)
+            data = self._frame(msg.TYPE_ID, msg.VERSION, pickled, seq,
+                               flags)
         if self.policy.replay:
             # lossless send never fails: the frame joins the session queue
             # and reconnect+replay delivers it exactly once (reference
@@ -541,7 +810,8 @@ class Connection:
         except BaseException:
             self.messenger.dispatch_throttle.put(cost)
             raise
-        return type_id, version, seq, payload, cost, blob
+        return (type_id, version, seq, payload, cost, blob,
+                bool(flags & FLAG_FIXED))
 
     async def adopt_transport(self, reader, writer) -> None:
         """Adopt a fresh transport into this session and replay unacked
@@ -625,6 +895,12 @@ class Messenger:
         self._sessions: "collections.OrderedDict[str, Connection]" = (
             collections.OrderedDict()
         )
+        # colocated-daemon fast dispatch (LocalConnection): opt-in, and
+        # only meaningful when BOTH endpoints run with it on
+        self._local_fastpath = bool(
+            _cget(self.conf, "ms_local_fastpath", False))
+        self._local_conns: Dict[Tuple[str, int], LocalConnection] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
 
     def policy_for(self, peer_type: str) -> Policy:
         return self.policies.get(peer_type, Policy.lossy_client())
@@ -811,6 +1087,9 @@ class Messenger:
     async def bind(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
         self.server = await asyncio.start_server(self._accept, host, port)
         self.addr = self.server.sockets[0].getsockname()[:2]
+        if self._local_fastpath:
+            self._loop = asyncio.get_running_loop()
+            _LOCAL_REGISTRY[tuple(self.addr)] = self
         return self.addr
 
     @staticmethod
@@ -866,7 +1145,7 @@ class Messenger:
         try:
             while not conn.closed and conn.transport_gen == gen:
                 (type_id, version, seq, payload, cost,
-                 blob) = await conn.read_frame()
+                 blob, fixed) = await conn.read_frame()
                 try:
                     if conn.transport_gen != gen:
                         return  # transport replaced while we were suspended
@@ -879,7 +1158,8 @@ class Messenger:
                         await self._ack_quietly(conn, seq)
                         continue
                     try:
-                        msg = decode_message(type_id, version, payload, blob)
+                        msg = decode_message(type_id, version, payload,
+                                             blob, fixed)
                     except Exception as e:
                         # undecodable (type/version skew): poison-discard so
                         # replay can't redeliver it forever
@@ -996,6 +1276,24 @@ class Messenger:
 
     async def send(self, addr: Tuple[str, int], msg: Any, retries: int = 3,
                    peer_type: str = "osd") -> None:
+        if self._local_fastpath:
+            addr_t = tuple(addr)
+            for _ in range(2):  # one retry: the peer may have re-bound
+                peer = _LOCAL_REGISTRY.get(addr_t)
+                if (peer is None or peer._shutdown
+                        or not peer._local_fastpath
+                        or peer._loop is not asyncio.get_running_loop()):
+                    break  # not colocated (or another loop): real wire
+                conn = self._local_conns.get(addr_t)
+                if conn is None or conn.closed \
+                        or conn.peer_messenger is not peer:
+                    conn = LocalConnection(self, peer)
+                    self._local_conns[addr_t] = conn
+                try:
+                    await conn.send(msg)
+                    return
+                except ConnectionError:
+                    self._local_conns.pop(addr_t, None)
         last: Optional[Exception] = None
         for _ in range(retries + 1):
             try:
@@ -1013,6 +1311,12 @@ class Messenger:
 
     async def shutdown(self) -> None:
         self._shutdown = True
+        if self.addr is not None \
+                and _LOCAL_REGISTRY.get(tuple(self.addr)) is self:
+            _LOCAL_REGISTRY.pop(tuple(self.addr), None)
+        for lconn in list(self._local_conns.values()):
+            await lconn.close()
+        self._local_conns.clear()
         # cancel serve loops FIRST: in py3.12 Server.wait_closed() waits for
         # all connection handlers, so live inbound loops would deadlock it
         for t in list(self._tasks):

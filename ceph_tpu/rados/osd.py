@@ -81,7 +81,9 @@ from ceph_tpu.rados.scheduler import (
     CLASS_RECOVERY,
     ShardedOpQueue,
 )
-from ceph_tpu.rados.store import MemStore, ObjectStore, ShardMeta, Transaction, shard_crc
+from ceph_tpu.rados.store import (MemStore, ObjectStore, ShardMeta,
+                                  Transaction, shard_crc,
+                                  Owned as StoreOwned)
 from ceph_tpu.rados.auth import TicketKeyring
 from ceph_tpu.rados.types import (
     MAuthRotating,
@@ -2003,11 +2005,17 @@ class OSD:
             if osd == CRUSH_ITEM_NONE:
                 continue
             if osd == self.osd_id:
+                # memoryview, not bytes(): ownership of the fresh
+                # encode-output row passes to the store (Owned marking
+                # in _apply_shard_write) — no per-shard copy
                 if self._apply_shard_write(
-                    op.pool_id, op.oid, shard, bytes(blobs[shard]), version,
+                    op.pool_id, op.oid, shard,
+                    memoryview(np.ascontiguousarray(blobs[shard])), version,
                     object_size, pg=pg, entry=entry, chunk_off=chunk_off,
                     shard_size=shard_size, hinfo=hinfo_blob,
                     prior_version=base_version,
+                    chunk_crc=(shard_crcs[shard]
+                               if shard_crcs is not None else None),
                 ):
                     local_ok += 1
             else:
@@ -3232,13 +3240,18 @@ class OSD:
         object_size: int, pg: Optional[int] = None,
         entry: Optional[LogEntry] = None, chunk_off: int = -1,
         shard_size: int = 0, hinfo: bytes = b"", prior_version: int = 0,
+        chunk_crc: Optional[int] = None,
     ) -> bool:
         txn = Transaction()
         # retain the outgoing version in the rollback slot (same txn):
         # reads fall back to it when a newer write never completed
         old = self._store_read((pool_id, oid, shard))
         if old is not None and old[1].version != version:
-            txn.write((pool_id, oid, shard + PREV_SLOT), old[0], old[1])
+            # the retained blob is already store-owned: re-mark, don't
+            # re-copy
+            txn.write((pool_id, oid, shard + PREV_SLOT),
+                      old[0] if isinstance(old[0], bytes)
+                      else StoreOwned(old[0]), old[1])
         appended = False
         if chunk_off >= 0:
             # splice precondition: the delta only composes with the exact
@@ -3259,12 +3272,24 @@ class OSD:
                 base.extend(b"\x00" * (want - len(base)))
             base[chunk_off:chunk_off + len(chunk)] = chunk
             blob = bytes(base)
+            chunk_crc = None  # splice: the shipped crc covered the delta
         else:
             blob = chunk
+        # one crc per shard per write: reuse the crc the primary already
+        # computed (or the receiver already VERIFIED the frame against)
+        # instead of a third pass over the same bytes
+        crc = shard_crc(blob) if chunk_crc is None else chunk_crc
         txn.write(
             (pool_id, oid, shard),
-            blob,
-            ShardMeta(version=version, object_size=object_size, chunk_crc=shard_crc(blob)),
+            # a non-bytes full-write blob is an encode-output (or
+            # fetched-shard) buffer whose ownership transfers to the
+            # store here: mark it Owned so the RAM store keeps the view
+            # instead of a 16 MiB defensive copy per shard (stored
+            # buffers are never mutated in place — overwrites replace
+            # entries)
+            blob if isinstance(blob, bytes) else StoreOwned(blob),
+            ShardMeta(version=version, object_size=object_size,
+                      chunk_crc=crc),
         )
         if entry is not None and pg is not None:
             self._log_in_txn(txn, pool_id, pg, entry)
@@ -3355,6 +3380,8 @@ class OSD:
                 msg.object_size, pg=msg.pg, entry=entry,
                 chunk_off=msg.chunk_off, shard_size=msg.shard_size,
                 hinfo=msg.hinfo, prior_version=msg.prior_version,
+                # just verified against the frame: reuse, don't re-crc
+                chunk_crc=msg.chunk_crc or None,
             )
             # another primary wrote this object: our cached decode is stale
             self._cache_drop(msg.pool_id, msg.oid)

@@ -1028,3 +1028,54 @@ MOSDOpReply.BLOB_ATTR = "data"
 MECSubWrite.BLOB_ATTR = "chunk"
 MECSubReadReply.BLOB_ATTR = "chunk"
 MPushShard.BLOB_ATTR = "chunk"
+
+# -- fixed binary wire layouts (messenger FLAG_FIXED) ------------------------
+# The DATA-PLANE message set encodes as a flat struct-packed field list
+# instead of pickle (reference: ECSubWrite/MOSDOp are fixed-layout
+# dencoder structs, src/osd/ECMsgTypes.h, src/messages/MOSDOp.h) — a
+# malformed hot-path frame cannot execute code on decode, and
+# pack/unpack is struct-speed.  Control-plane types (maps, peering,
+# mon/paxos) keep the pickled internal format; the per-type version in
+# every frame header still gates cross-version decode.
+MOSDOp.FIXED_FIELDS = [
+    ("op", "s"), ("pool_id", "q"), ("oid", "s"), ("data", "y"),
+    ("epoch", "q"), ("reqid", "s"), ("offset", "q"), ("cls", "s"),
+    ("method", "s"), ("snapc_seq", "Q"), ("snapc_snaps", "Q*"),
+    ("snap_read", "Q"), ("snap_id", "Q"), ("pg", "q"), ("cursor", "s"),
+    ("max_entries", "q"), ("nspace", "s"),
+]
+# a compound op vector (multi) carries arbitrary typed kwargs: pickle
+MOSDOp.FIXED_WHEN = staticmethod(lambda m: not m.ops)
+MOSDOpReply.FIXED_FIELDS = [
+    ("ok", "?"), ("error", "s"), ("code", "q"), ("data", "y"),
+    ("oids", "s*"), ("cursor", "s"), ("backoff", "d"), ("reqid", "s"),
+    ("version", "Q"), ("map_epoch", "q"),
+]
+MOSDOpReply.FIXED_WHEN = staticmethod(
+    lambda m: isinstance(m.data, (bytes, bytearray, memoryview)))
+MECSubWrite.FIXED_FIELDS = [
+    ("pool_id", "q"), ("pg", "q"), ("from_osd", "q"), ("epoch", "q"),
+    ("oid", "s"), ("shard", "q"), ("chunk", "y"), ("version", "Q"),
+    ("object_size", "q"), ("chunk_crc", "Q"), ("tid", "s"),
+    ("reply_to", "addr"), ("log_entry", "y"), ("chunk_off", "q"),
+    ("shard_size", "q"), ("prior_version", "Q"), ("hinfo", "y"),
+]
+MECSubWriteReply.FIXED_FIELDS = [
+    ("tid", "s"), ("shard", "q"), ("ok", "?"),
+]
+MECSubRead.FIXED_FIELDS = [
+    ("pool_id", "q"), ("pg", "q"), ("oid", "s"), ("shard", "q"),
+    ("tid", "s"), ("reply_to", "addr"), ("extents", "qq*"),
+    ("want_hinfo", "?"),
+]
+MECSubReadReply.FIXED_FIELDS = [
+    ("tid", "s"), ("shard", "q"), ("ok", "?"), ("chunk", "y"),
+    ("version", "Q"), ("object_size", "q"), ("hinfo", "y"),
+]
+MPushShard.FIXED_FIELDS = [
+    ("pool_id", "q"), ("pg", "q"), ("oid", "s"), ("shard", "q"),
+    ("chunk", "y"), ("version", "Q"), ("object_size", "q"),
+    ("hinfo", "y"),
+]
+# xattr pushes carry an arbitrary dict: pickle those
+MPushShard.FIXED_WHEN = staticmethod(lambda m: not m.xattrs)

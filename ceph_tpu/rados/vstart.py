@@ -26,6 +26,17 @@ class Cluster:
                  data_dir: Optional[str] = None, n_mons: int = 1,
                  with_mgr: bool = False):
         self.conf = conf or {}
+        # colocated-daemon fast dispatch (messenger LocalConnection):
+        # every daemon of an in-process cluster shares this process, so
+        # frames skip the TCP stack by default — UNLESS the conf
+        # exercises the wire itself (auth/secure/fault injection), where
+        # real sockets are the point of the test
+        wire_keys = ("ms_auth_secret", "auth_cephx", "ms_secure_mode",
+                     "ms_inject_socket_failures", "ms_inject_delay_max",
+                     "ms_compress_min_size", "ms_dispatch_throttle_bytes")
+        if "ms_local_fastpath" not in self.conf \
+                and not any(self.conf.get(k) for k in wire_keys):
+            self.conf["ms_local_fastpath"] = True
         self.n_osds = n_osds
         self.n_mons = n_mons
         self.with_mgr = with_mgr

@@ -1,0 +1,241 @@
+"""Data-plane wire discipline (VERDICT r4 #1): fixed binary framing for
+hot-path message types over the REAL socket path, and the colocated
+local fast dispatch (Messenger local_connection role) with its store
+ownership-transfer contract."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.rados.messenger import (Messenger, _LOCAL_REGISTRY,
+                                      encode_payload_parts)
+from ceph_tpu.rados.store import MemStore, Owned, ShardMeta, Transaction
+from ceph_tpu.rados.types import (MECSubRead, MECSubReadReply, MECSubWrite,
+                                  MECSubWriteReply, MOSDOp, MOSDOpReply,
+                                  MPushShard)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestFixedFraming:
+    def test_hot_types_encode_fixed_not_pickle(self):
+        """The data-plane set must take the FLAG_FIXED path; pickled
+        fallbacks remain only for compound/exotic payloads."""
+        fixed_cases = [
+            MOSDOp(op="write", pool_id=1, oid="o", data=b"x" * 20_000,
+                   snapc_seq=3, snapc_snaps=[3, 1]),
+            MOSDOpReply(ok=True, data=b"d", oids=["a"], version=7),
+            MECSubWrite(oid="o", shard=2, chunk=b"c" * 20_000,
+                        reply_to=("h", 1), chunk_crc=5),
+            MECSubWriteReply(tid="t", ok=False),
+            MECSubRead(oid="o", extents=[(0, 4096), (8192, 100)]),
+            MECSubReadReply(chunk=b"c" * 20_000, version=9),
+            MPushShard(oid="o", chunk=b"p" * 20_000),
+        ]
+        for m in fixed_cases:
+            _p, _b, fixed = encode_payload_parts(m)
+            assert fixed, f"{type(m).__name__} must use fixed framing"
+        # compound op vectors and xattr dicts fall back to pickle
+        for m in (MOSDOp(op="multi", ops=[("read", {})]),
+                  MPushShard(oid="o", chunk=b"p" * 20_000,
+                             xattrs={"k": b"v"})):
+            _p, _b, fixed = encode_payload_parts(m)
+            assert not fixed
+
+    def test_fixed_frames_cross_a_real_socket(self):
+        """End-to-end over TCP: every hot type round-trips through the
+        framed wire (blob lane + fixed header) byte-exactly."""
+        async def go():
+            server = Messenger("srv", {}, entity_type="osd")
+            client = Messenger("cli", {}, entity_type="osd")
+            addr = await server.bind()
+            got = asyncio.Queue()
+
+            async def dispatch(conn, msg):
+                await got.put(msg)
+
+            server.dispatcher = dispatch
+            big = bytes(range(256)) * 256  # 64 KiB, rides the blob lane
+            sent = MECSubWrite(pool_id=4, pg=2, from_osd=1, epoch=7,
+                               oid="obj/with/slashes", shard=3, chunk=big,
+                               version=(9 << 32) | 5, object_size=123,
+                               chunk_crc=42, tid="tid",
+                               reply_to=("127.0.0.1", 9999),
+                               log_entry=b"LE", chunk_off=-1,
+                               shard_size=0, prior_version=8,
+                               hinfo=b"HH")
+            await client.send(addr, sent)
+            back = await asyncio.wait_for(got.get(), 10)
+            for k, v in sent.__dict__.items():
+                b = back.__dict__[k]
+                if isinstance(v, (bytes, memoryview)):
+                    assert bytes(b) == bytes(v), k
+                elif isinstance(v, tuple):
+                    assert tuple(b) == tuple(v), k
+                else:
+                    assert b == v, k
+            # a small-data op rides fixed WITHOUT the blob lane
+            await client.send(addr, MOSDOp(op="read", pool_id=2,
+                                           oid="small", snap_read=3))
+            back = await asyncio.wait_for(got.get(), 10)
+            assert back.op == "read" and back.oid == "small" \
+                and back.snap_read == 3 and back.ops == []
+            await client.shutdown()
+            await server.shutdown()
+        run(go())
+
+
+class TestLocalFastpath:
+    def test_colocated_send_skips_sockets(self):
+        async def go():
+            conf = {"ms_local_fastpath": True}
+            a = Messenger("a", conf, entity_type="osd")
+            b = Messenger("b", conf, entity_type="osd")
+            addr_a = await a.bind()
+            addr_b = await b.bind()
+            got = asyncio.Queue()
+
+            async def dispatch(conn, msg):
+                await got.put((conn, msg))
+
+            b.dispatcher = dispatch
+            payload = MOSDOp(op="write", oid="x", data=b"D" * 100_000)
+            await a.send(addr_b, payload)
+            conn, msg = await asyncio.wait_for(got.get(), 10)
+            # by-reference handoff: the SAME object, no serialization
+            assert msg is payload
+            assert conn.peer_name == "a" and conn.auth_kind == "local"
+            assert not a._conns, "no TCP connection must have been made"
+            # replies flow back over the mirrored connection
+            got_a = asyncio.Queue()
+
+            async def dispatch_a(c, m):
+                await got_a.put(m)
+
+            a.dispatcher = dispatch_a
+            reply = MOSDOpReply(ok=True, data=b"r")
+            await conn.send(reply)
+            assert (await asyncio.wait_for(got_a.get(), 10)) is reply
+            # shutdown deregisters: further sends fall back to the wire
+            # (and fail against the closed server)
+            await b.shutdown()
+            assert tuple(addr_b) not in _LOCAL_REGISTRY
+            with pytest.raises(Exception):
+                await a.send(addr_b, MOSDOp(op="read", oid="x"),
+                             retries=0)
+            await a.shutdown()
+        run(go())
+
+    def test_fastpath_preserves_order(self):
+        async def go():
+            conf = {"ms_local_fastpath": True}
+            a = Messenger("a", conf)
+            b = Messenger("b", conf)
+            await a.bind()
+            addr_b = await b.bind()
+            seen = []
+            done = asyncio.Event()
+
+            async def dispatch(conn, msg):
+                seen.append(msg.snap_id)
+                if len(seen) == 50:
+                    done.set()
+
+            b.dispatcher = dispatch
+            for i in range(50):
+                await a.send(addr_b, MOSDOp(op="read", oid="o",
+                                            snap_id=i))
+            await asyncio.wait_for(done.wait(), 10)
+            assert seen == list(range(50))
+            await a.shutdown()
+            await b.shutdown()
+        run(go())
+
+    def test_fastpath_requires_both_ends_opted_in(self):
+        async def go():
+            a = Messenger("a", {"ms_local_fastpath": True})
+            b = Messenger("b", {})  # wire-only peer
+            await a.bind()
+            addr_b = await b.bind()
+            got = asyncio.Queue()
+
+            async def dispatch(conn, msg):
+                await got.put(msg)
+
+            b.dispatcher = dispatch
+            sent = MOSDOp(op="read", oid="q")
+            await a.send(addr_b, sent)
+            back = await asyncio.wait_for(got.get(), 10)
+            assert back is not sent  # serialized: went over the socket
+            assert back.oid == "q"
+            await a.shutdown()
+            await b.shutdown()
+        run(go())
+
+
+class TestControlPlaneIsolation:
+    def test_fastpath_map_replies_are_isolated_copies(self):
+        """r5 review regression: the mon must never hand its LIVE
+        OSDMap to colocated daemons by reference — its next in-place
+        mutation (pool delete, epoch bump) would tear every daemon's
+        copy, and map-driven transitions (pool purge) would silently
+        skip (the OSD's epoch guard sees its own map already
+        'advanced')."""
+        async def go():
+            from ceph_tpu.rados.vstart import Cluster
+
+            cluster = Cluster(n_osds=3, conf={"osd_auto_repair": False})
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("doomed",
+                                           pool_type="replicated")
+                await c.put(pool, "obj", b"payload")
+                # daemons' maps are isolated objects, not the mon's
+                mon_map = cluster.mons[0].osdmap
+                for osd in cluster.osds.values():
+                    assert osd.osdmap is not mon_map
+                assert any(
+                    list(o.store.list_objects(pool))
+                    for o in cluster.osds.values())
+                await c.delete_pool(pool, "doomed")
+                # the pool-purge transition must actually run: shards
+                # disappear from every OSD store
+                for _ in range(100):
+                    if not any(list(o.store.list_objects(pool))
+                               for o in cluster.osds.values()):
+                        break
+                    await asyncio.sleep(0.1)
+                leftovers = {o.osd_id: list(o.store.list_objects(pool))
+                             for o in cluster.osds.values()
+                             if list(o.store.list_objects(pool))}
+                assert not leftovers, leftovers
+                await c.stop()
+            finally:
+                await cluster.stop()
+        run(go())
+
+
+class TestStoreOwnership:
+    def test_owned_buffers_kept_others_frozen(self):
+        store = MemStore()
+        src = bytearray(b"A" * 64)
+        txn = Transaction()
+        txn.write((1, "owned", 0), Owned(memoryview(src)), ShardMeta())
+        txn.write((1, "foreign", 0), memoryview(bytearray(b"B" * 64)),
+                  ShardMeta())
+        txn.write((1, "plain", 0), b"C" * 64, ShardMeta())
+        store.queue_transaction(txn)
+        owned, _ = store.read((1, "owned", 0))
+        foreign, _ = store.read((1, "foreign", 0))
+        plain, _ = store.read((1, "plain", 0))
+        # owned: the view itself (no copy) — mutating the source shows
+        # through, which is exactly why ownership transfer is required
+        assert isinstance(owned, memoryview)
+        src[0] = ord("Z")
+        assert bytes(owned[:1]) == b"Z"
+        # non-owned views are frozen to bytes at the boundary
+        assert isinstance(foreign, bytes) and foreign == b"B" * 64
+        assert isinstance(plain, bytes)
