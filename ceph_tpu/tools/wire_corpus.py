@@ -1,0 +1,216 @@
+"""Wire-format corpus: archived encoded frames of the core message
+types, replayed against today's decoder (reference ceph-object-corpus +
+src/test/encoding/readable.sh: every archived past version must stay
+decodable, so an accidental field rename / layout change is caught the
+round it happens, not at the first mixed-version cluster).
+
+    python -m ceph_tpu.tools.wire_corpus --create   # archive current
+    python -m ceph_tpu.tools.wire_corpus --check    # replay archive
+
+Each archived frame is a self-contained binary file:
+
+    [u16 type_id][u16 version][u8 fixed][u32 plen][payload][u32 blen][blob]
+
+plus a sidecar .json with the expected decoded field values (bytes as
+hex) — the check decodes the frame with TODAY's decode_message and
+compares field-for-field, so both the binary layout and the field NAMES
+are pinned.  Data-plane types archive their FIXED layout; control-plane
+types archive their pickled layout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import struct
+import sys
+from typing import Any, Dict, List, Tuple
+
+_FRAME_HDR = struct.Struct("<HHBI")
+
+CORPUS_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "corpus", "wire")
+
+
+def _sample_messages() -> List[Any]:
+    """Representative instances of the core message set — every field
+    non-default so a dropped/renamed field cannot hide behind a
+    default value."""
+    from ceph_tpu.rados import types as t
+
+    return [
+        t.MOSDOp(op="write", pool_id=3, oid="corpus/oid", data=b"payload",
+                 epoch=11, reqid="req-1", offset=4096, cls="lock",
+                 method="lock", snapc_seq=9, snapc_snaps=[9, 4, 2],
+                 snap_read=7, snap_id=5, pg=12, cursor="after",
+                 max_entries=64, nspace="blue"),
+        t.MOSDOp(op="multi", pool_id=1, oid="m", reqid="r2",
+                 ops=[("setxattr", {"name": "a", "value": b"v"}),
+                      ("omap_set", {"entries": {"k": b"x"}})]),
+        t.MOSDOpReply(ok=False, error="nope", code=-17, data=b"reply",
+                      oids=["a", "b"], cursor="cur", backoff=0.25,
+                      reqid="rq", version=(7 << 32) | 3, map_epoch=21),
+        t.MECSubWrite(pool_id=2, pg=5, from_osd=3, epoch=13, oid="obj",
+                      shard=4, chunk=b"chunkdata", version=99,
+                      object_size=1234, chunk_crc=0xDEAD, tid="t1",
+                      reply_to=("127.0.0.1", 6800), log_entry=b"LE",
+                      chunk_off=8192, shard_size=65536, prior_version=42,
+                      hinfo=b"HINFO"),
+        t.MECSubWriteReply(tid="t1", shard=4, ok=False),
+        t.MECSubRead(pool_id=2, pg=5, oid="obj", shard=1, tid="t2",
+                     reply_to=("host", 1), extents=[(0, 4096), (8192, 64)],
+                     want_hinfo=True),
+        t.MECSubReadReply(tid="t2", shard=1, ok=True, chunk=b"bytes",
+                          version=7, object_size=55, hinfo=b"H"),
+        t.MECSubDelete(pool_id=1, pg=2, oid="gone", shard=0, tid="t3",
+                       reply_to=("h", 2)),
+        t.MPushShard(pool_id=1, pg=0, oid="pushed", shard=2,
+                     chunk=b"recovered", version=3, object_size=9,
+                     hinfo=b"HH"),
+        t.MPushShard(pool_id=1, pg=0, oid="pushed2", shard=2,
+                     chunk=b"r2", version=3, object_size=2,
+                     xattrs={"lock.x": b"owner"}),
+        t.MListShards(pool_id=4, tid="t4"),
+        t.MFetchShards(pool_id=4, oid="a", tid="t5",
+                       reply_to=("h", 9)),
+        t.MPGInfoReq(pool_id=1, pg=7, tid="t6"),
+        t.MPGLogReq(pool_id=1, pg=7, since=(3, 9), tid="t7"),
+        t.MOSDPing(op="ping", from_osd=2, epoch=5),
+        t.MGetMap(min_epoch=4, tid="t8"),
+        t.MSnapOp(pool_id=2, op="mksnap", snap_id=0, name="snapname",
+                  tid="t9"),
+        t.MSnapOpReply(tid="t9", ok=False, error="bad", code=-22,
+                       snap_id=6),
+        t.MSetXattrs(pool_id=1, oid="x", shard=0,
+                     xattrs={"k": b"v"}, removals=["old"]),
+        t.MSetOmap(pool_id=1, oid="x", shard=0, clear=True,
+                   entries={"a": b"1"}, removals=["b"]),
+        t.MWatchNotify(pool_id=1, oid="w", notify_id="n1",
+                       payload=b"ping"),
+        t.MNotifyAck(notify_id="n1", watcher=("h", 3)),
+        t.MBackfillReserve(pool_id=1, pg=3, op="request", from_osd=2,
+                           tid="t10", reply_to=("h", 4)),
+        t.MOSDFailure(target_osd=4, from_osd=1, failed_for=12.5,
+                      tid="t11"),
+    ]
+
+
+def _encode_frame(msg: Any) -> Tuple[bytes, Dict]:
+    from ceph_tpu.rados.messenger import encode_payload_parts
+
+    payload, blob, fixed = encode_payload_parts(msg)
+    blob_b = b"" if blob is None else bytes(blob)
+    frame = (_FRAME_HDR.pack(type(msg).TYPE_ID, type(msg).VERSION,
+                             1 if fixed else 0, len(payload))
+             + payload + struct.pack("<I", len(blob_b)) + blob_b)
+    expect = {k: _norm(v) for k, v in msg.__dict__.items()}
+    return frame, {"type": type(msg).__name__,
+                   "type_id": type(msg).TYPE_ID,
+                   "version": type(msg).VERSION,
+                   "fixed": bool(fixed),
+                   "fields": expect}
+
+
+def _norm(v: Any) -> Any:
+    """Decoded value -> comparable JSON-ish form (tuples and lists
+    collapse; bytes to hex)."""
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return {"__hex__": bytes(v).hex()}
+    if isinstance(v, tuple):
+        return [_norm(x) for x in v]
+    if isinstance(v, list):
+        return [_norm(x) for x in v]
+    if isinstance(v, dict):
+        return {"__dict__": {k: _norm(x) for k, x in v.items()}}
+    return v
+
+
+def create(directory: str = CORPUS_DIR) -> int:
+    os.makedirs(directory, exist_ok=True)
+    names = set()
+    for msg in _sample_messages():
+        frame, meta = _encode_frame(msg)
+        base = meta["type"]
+        if base in names:
+            base = f"{base}.alt"
+        names.add(base)
+        with open(os.path.join(directory, base + ".frame"), "wb") as f:
+            f.write(frame)
+        with open(os.path.join(directory, base + ".json"), "w") as f:
+            json.dump(meta, f, indent=1, sort_keys=True)
+    print(f"archived {len(names)} frames to {directory}")
+    return 0
+
+
+def check(directory: str = CORPUS_DIR) -> int:
+    import ceph_tpu.rados.types  # noqa: F401 — registers the message set
+    from ceph_tpu.rados.messenger import decode_message
+
+    failures = []
+    frames = sorted(n for n in os.listdir(directory)
+                    if n.endswith(".frame"))
+    if not frames:
+        print(f"no archived frames in {directory}", file=sys.stderr)
+        return 1
+    import dataclasses
+
+    for name in frames:
+        try:
+            with open(os.path.join(directory, name), "rb") as f:
+                raw = f.read()
+            with open(os.path.join(directory,
+                                   name[:-6] + ".json")) as f:
+                meta = json.load(f)
+            type_id, version, fixed, plen = _FRAME_HDR.unpack_from(raw, 0)
+            off = _FRAME_HDR.size
+            payload = raw[off:off + plen]
+            off += plen
+            (blen,) = struct.unpack_from("<I", raw, off)
+            blob = raw[off + 4:off + 4 + blen] if blen else None
+        except Exception as e:
+            failures.append(f"{name}: unreadable archive entry: {e}")
+            continue
+        try:
+            msg = decode_message(type_id, version, payload, blob,
+                                 bool(fixed))
+        except Exception as e:
+            failures.append(f"{name}: decode failed: {e}")
+            continue
+        got = {k: _norm(v) for k, v in msg.__dict__.items()}
+        want = meta["fields"]
+        if got != want:
+            diffs = sorted(set(got) ^ set(want)) or [
+                k for k in want if got.get(k) != want[k]]
+            failures.append(f"{name}: field drift: {diffs}")
+            continue
+        # pickled payloads restore ARCHIVED attribute names verbatim, so
+        # equality above cannot catch a rename of a control-plane field:
+        # also pin the archive's names against the CURRENT dataclass
+        # declaration
+        names_now = {f.name for f in dataclasses.fields(type(msg))}
+        if set(want) != names_now:
+            failures.append(
+                f"{name}: declared fields drifted: "
+                f"{sorted(set(want) ^ names_now)}")
+    if failures:
+        for f in failures:
+            print(f"FAIL {f}", file=sys.stderr)
+        return 1
+    print(f"{len(frames)} archived frames decode byte-exactly")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="wire-format corpus")
+    p.add_argument("--create", action="store_true")
+    p.add_argument("--check", action="store_true")
+    p.add_argument("--dir", default=CORPUS_DIR)
+    args = p.parse_args(argv)
+    if args.create:
+        return create(args.dir)
+    return check(args.dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
