@@ -12,10 +12,22 @@ the latency path; large writes go to freshly allocated extents first
 (copy-on-write — crash before KV commit leaves the old object intact),
 then the metadata flips atomically.
 
-Checksums: crc32c per extent (bluestore_csum_type), verified on every
-read; bluestore_debug_inject_read_err / _csum_err_probability inject
-failures for the EIO-handling tests (reference
-src/common/options/global.yaml.in:4977,5017).
+Checksums: per-extent, algorithm selected by bluestore_csum_type
+(crc32c default, zlib, none — reference csum_type per blob), verified
+on every read BEFORE decompression; bluestore_debug_inject_read_err /
+_csum_err_probability inject failures for the EIO-handling tests
+(reference src/common/options/global.yaml.in:4977,5017).
+
+Compression (reference BlueStore _do_write compression at blob
+granularity): per-POOL mode/algorithm from pool opts (`ceph osd pool
+set NAME compression_mode aggressive` -> pg_pool_t::opts -> OSDMap ->
+set_pool_opts here), falling back to bluestore_compression_mode/
+_algorithm conf.  zlib / zstd / lzma; a blob is stored compressed only
+when >= bluestore_compression_min_blob_size and the result beats
+bluestore_compression_required_ratio (default 0.875) — otherwise raw,
+exactly the reference's required-ratio discipline.  Checksums cover
+the STORED (compressed) bytes, so a corrupted compressed extent fails
+the csum before the decompressor ever sees it.
 
 Recovery contract: open() replays the KV WAL (WalDB does this), then
 flushes any deferred writes recorded-but-not-flushed.  The allocator
@@ -53,10 +65,39 @@ class _Onode:
     """Object metadata record (BlueStore onode role)."""
 
     extents: List[Tuple[int, int]] = field(default_factory=list)  # (off, len)
-    csums: List[int] = field(default_factory=list)  # crc32c per extent
+    csums: List[int] = field(default_factory=list)  # per-extent, of STORED bytes
     meta: ShardMeta = field(default_factory=ShardMeta)
     deferred: bool = False  # data still only in the KV (deferred write)
     xattrs: Dict[str, bytes] = field(default_factory=dict)
+    # blob compression (reference bluestore_blob_t compressed flag):
+    # algorithm name or None; raw_len pins the decompressed size
+    compression: Optional[str] = None
+    raw_len: int = -1
+    csum_type: str = "crc32c"
+
+
+def _compress(algo: str, raw) -> bytes:
+    if algo == "zstd":
+        import zstandard
+
+        return zstandard.ZstdCompressor(level=1).compress(bytes(raw))
+    if algo == "lzma":
+        import lzma
+
+        return lzma.compress(bytes(raw), preset=0)
+    return zlib.compress(bytes(raw), 1)
+
+
+def _decompress(algo: str, data: bytes) -> bytes:
+    if algo == "zstd":
+        import zstandard
+
+        return zstandard.ZstdDecompressor().decompress(data)
+    if algo == "lzma":
+        import lzma
+
+        return lzma.decompress(data)
+    return zlib.decompress(data)
 
 
 def _okey(key: Key) -> str:
@@ -139,6 +180,10 @@ class BlueStore(ObjectStore):
             self._blob: Dict[int, bytes] = {}  # off -> data (RAM mode)
         self.alloc = Allocator(0)
         self._onodes: Dict[Key, _Onode] = {}
+        # per-pool store options pushed from the OSDMap (pg_pool_t::opts
+        # role): compression_mode/algorithm/ratio/min_blob_size
+        self.pool_opts: Dict[int, Dict[str, str]] = {}
+        self._compress_warned: set = set()
         # committed-but-unflushed deferred writes, drained in batches off
         # the commit latency path (bluestore deferred_batch semantics)
         self._deferred_pending: List[Tuple[Key, _Onode, bytes]] = []
@@ -232,9 +277,53 @@ class BlueStore(ObjectStore):
                 freed.extend(old.extents)
             onode = _Onode(meta=meta,
                            xattrs=dict(old.xattrs) if old else {})
+            # blob compression decision (reference _do_write + the
+            # required-ratio gate): per-pool opts override global conf
+            raw_len = len(chunk)
+            popts = self.pool_opts.get(key[0], {})
+            mode = popts.get("compression_mode",
+                             self.conf.get("bluestore_compression_mode",
+                                           "none")) or "none"
+            # passive = compress only on a client compressible-hint
+            # (reference alloc-hint plumbing); no hints exist in this
+            # transaction format, so passive stores raw — treating it
+            # as aggressive would invert its documented meaning
+            if mode in ("aggressive", "force"):
+                algo = popts.get(
+                    "compression_algorithm",
+                    self.conf.get("bluestore_compression_algorithm",
+                                  "zlib"))
+                min_blob = int(popts.get(
+                    "compression_min_blob_size",
+                    self.conf.get("bluestore_compression_min_blob_size",
+                                  4096)))
+                ratio = float(popts.get(
+                    "compression_required_ratio",
+                    self.conf.get("bluestore_compression_required_ratio",
+                                  0.875)))
+                if raw_len >= min_blob:
+                    try:
+                        cand = _compress(algo, chunk)
+                    except Exception as e:
+                        cand = None
+                        # loudly, once per (pool, algo): a missing
+                        # compressor module must not silently store a
+                        # "compressed" pool raw forever
+                        warn_key = (key[0], algo)
+                        if warn_key not in self._compress_warned:
+                            self._compress_warned.add(warn_key)
+                            print(f"bluestore: pool {key[0]} "
+                                  f"compression_algorithm={algo} "
+                                  f"unavailable ({e}); storing raw")
+                    if cand is not None and len(cand) <= raw_len * ratio:
+                        chunk = cand
+                        onode.compression = algo
+                        onode.raw_len = raw_len
+            onode.csum_type = str(self.conf.get("bluestore_csum_type",
+                                                "crc32c") or "crc32c")
             off = self.alloc.allocate(max(1, len(chunk)))
             onode.extents = [(off, len(chunk))]
-            onode.csums = [checksum(chunk)]
+            onode.csums = [self._csum(onode.csum_type, chunk)]
             if len(chunk) <= prefer_deferred:
                 # deferred: payload rides the KV WAL (pickled) — needs
                 # real bytes, a memoryview cannot serialize
@@ -275,6 +364,21 @@ class BlueStore(ObjectStore):
         if b2.ops:
             self.db.submit(b2)
 
+    @staticmethod
+    def _csum(ctype: str, data) -> int:
+        if ctype == "none":
+            return 0
+        if ctype == "zlib":
+            return zlib.crc32(bytes(data)) & 0xFFFFFFFF
+        return checksum(data) & 0xFFFFFFFF
+
+    def set_pool_opts(self, pool_id: int, opts: Dict[str, str]) -> None:
+        """OSDMap pool-opts push (pg_pool_t::opts role)."""
+        if opts:
+            self.pool_opts[pool_id] = dict(opts)
+        else:
+            self.pool_opts.pop(pool_id, None)
+
     def read(self, key: Key) -> Optional[Tuple[bytes, ShardMeta]]:
         onode = self._onodes.get(key)
         if onode is None:
@@ -289,7 +393,11 @@ class BlueStore(ObjectStore):
             "bluestore_debug_inject_csum_err_probability", 0.0) or 0.0)
         if prob and random.random() < prob:
             raise EIOError(f"injected csum error on {key}")
-        if self.conf.get("bluestore_csum_type", "crc32c") != "none":
+        # verify BEFORE decompression, over the stored bytes: a
+        # corrupted compressed extent must fail here, never feed the
+        # decompressor garbage (pre-selection onode pickles lack the
+        # csum_type field; verify_any keeps them readable)
+        if getattr(onode, "csum_type", "crc32c") != "none":
             pos = 0
             for (off, length), want in zip(onode.extents, onode.csums):
                 from ceph_tpu.utils.checksum import verify_any
@@ -297,6 +405,18 @@ class BlueStore(ObjectStore):
                 if not verify_any(data[pos:pos + length], want):
                     raise EIOError(f"checksum mismatch on {key} @{off}")
                 pos += length
+        comp = getattr(onode, "compression", None)
+        if comp:
+            try:
+                data = _decompress(comp, data)
+            except Exception as e:
+                raise EIOError(
+                    f"decompression failed on {key} ({comp}): {e}")
+            raw_len = getattr(onode, "raw_len", -1)
+            if raw_len >= 0 and len(data) != raw_len:
+                raise EIOError(
+                    f"decompressed length mismatch on {key}: "
+                    f"{len(data)} != {raw_len}")
         return data, onode.meta
 
     def list_objects(self, pool_id: int) -> Iterable[Tuple[str, int]]:

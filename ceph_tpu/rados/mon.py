@@ -918,6 +918,36 @@ class Monitor:
             pool = self.osdmap.pools.get(msg.pool_id)
             if pool is None:
                 return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+            if msg.key in ("compression_mode", "compression_algorithm",
+                           "compression_required_ratio",
+                           "compression_min_blob_size"):
+                # per-pool store options (reference `ceph osd pool set
+                # NAME compression_mode ...`, pg_pool_t::opts): validated
+                # here, applied by every OSD at its blob boundary
+                valid = {
+                    "compression_mode": ("none", "passive", "aggressive",
+                                         "force"),
+                    "compression_algorithm": ("zlib", "zstd", "lzma"),
+                }.get(msg.key)
+                if valid is not None and msg.value not in valid:
+                    return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+                if msg.key in ("compression_required_ratio",
+                               "compression_min_blob_size"):
+                    # numeric opts parse HERE, not in the OSD write
+                    # path — a garbage value must be refused, never
+                    # fail every subsequent write to the pool
+                    try:
+                        (float if "ratio" in msg.key else int)(msg.value)
+                    except ValueError:
+                        return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+                if not hasattr(pool, "opts"):
+                    # PoolInfo unpickled from a pre-opts mon store:
+                    # default_factory fields are not class attributes
+                    pool.opts = {}
+                pool.opts[msg.key] = msg.value
+                self.osdmap.epoch += 1
+                await self._commit_state()
+                return MMapReply(osdmap=self.osdmap, tid=msg.tid)
             if msg.key == "pg_num":
                 try:
                     n = int(msg.value)

@@ -792,6 +792,12 @@ class OSD:
         old = self.osdmap
         if old is not None and osdmap.epoch <= old.epoch:
             return
+        # push per-pool store options (pg_pool_t::opts role) so the
+        # ObjectStore applies compression policy at its blob boundary
+        spo = getattr(self.store, "set_pool_opts", None)
+        if spo is not None:
+            for pool in osdmap.pools.values():
+                spo(pool.pool_id, getattr(pool, "opts", {}) or {})
         if old is None:
             # FIRST map after boot: pools deleted while this OSD was
             # down never produce an old→new transition here, so sweep

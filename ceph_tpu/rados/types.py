@@ -173,6 +173,10 @@ class PoolInfo:
     # -EINVAL, because the two disagree about who owns the SnapContext
     snap_mode: str = "none"  # none | pool | selfmanaged
     pool_snaps: Dict[str, int] = field(default_factory=dict)  # name -> id
+    # per-pool store options (reference pool opts, pg_pool_t::opts:
+    # compression_mode/algorithm ride the OSDMap so every OSD applies
+    # them at its own ObjectStore blob boundary)
+    opts: Dict[str, str] = field(default_factory=dict)
 
     def pool_snapc(self) -> Tuple[int, List[int]]:
         """The pool's SnapContext (seq, live snap ids DESCENDING) that

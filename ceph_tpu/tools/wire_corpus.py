@@ -132,8 +132,10 @@ def create(directory: str = CORPUS_DIR) -> int:
     for msg in _sample_messages():
         frame, meta = _encode_frame(msg)
         base = meta["type"]
-        if base in names:
-            base = f"{base}.alt"
+        n = 2
+        while base in names:  # numbered variants: nothing overwrites
+            base = f"{meta['type']}.alt{n}"
+            n += 1
         names.add(base)
         with open(os.path.join(directory, base + ".frame"), "wb") as f:
             f.write(frame)

@@ -256,3 +256,210 @@ class _PoisonedMemStore(MemStore):
 
     def read(self, key):
         raise EIOError(f"injected EIO on {key}")
+
+
+class TestCompression:
+    """Per-pool blob compression + csum selection (VERDICT r4 #7;
+    reference BlueStore _do_write compression, csum handling)."""
+
+    def _store(self, tmp_path=None, conf=None):
+        return BlueStore(str(tmp_path) if tmp_path else None, conf or {})
+
+    def test_aggressive_mode_compresses_and_roundtrips(self):
+        bs = self._store(conf={"bluestore_compression_mode": "aggressive"})
+        blob = b"compressible " * 8000  # ~100 KiB, very redundant
+        txn = Transaction()
+        txn.write((1, "o", 0), blob, ShardMeta(object_size=len(blob)))
+        bs.queue_transaction(txn)
+        onode = bs._onodes[(1, "o", 0)]
+        assert onode.compression == "zlib"
+        assert onode.raw_len == len(blob)
+        stored = sum(n for _, n in onode.extents)
+        assert stored < len(blob) * 0.5
+        data, meta = bs.read((1, "o", 0))
+        assert data == blob
+
+    def test_required_ratio_keeps_incompressible_raw(self):
+        bs = self._store(conf={"bluestore_compression_mode": "aggressive"})
+        blob = os.urandom(64 * 1024)  # incompressible
+        txn = Transaction()
+        txn.write((1, "r", 0), blob, ShardMeta())
+        bs.queue_transaction(txn)
+        onode = bs._onodes[(1, "r", 0)]
+        assert onode.compression is None
+        assert bs.read((1, "r", 0))[0] == blob
+
+    def test_passive_mode_stores_raw_without_hints(self):
+        """passive compresses only on a client compressible-hint; no
+        hint plumbing exists, so passive must store raw (treating it
+        as aggressive would invert its meaning)."""
+        bs = self._store(conf={"bluestore_compression_mode": "passive"})
+        blob = b"very compressible " * 8000
+        txn = Transaction()
+        txn.write((1, "p", 0), blob, ShardMeta())
+        bs.queue_transaction(txn)
+        assert bs._onodes[(1, "p", 0)].compression is None
+        assert bs.read((1, "p", 0))[0] == blob
+
+    def test_algorithms_zstd_lzma(self):
+        for algo in ("zstd", "lzma"):
+            bs = self._store(conf={
+                "bluestore_compression_mode": "aggressive",
+                "bluestore_compression_algorithm": algo})
+            blob = (b"pattern-%d " % 7) * 9000
+            txn = Transaction()
+            txn.write((1, algo, 0), blob, ShardMeta())
+            bs.queue_transaction(txn)
+            assert bs._onodes[(1, algo, 0)].compression == algo
+            assert bs.read((1, algo, 0))[0] == blob
+
+    def test_per_pool_opts_override_conf(self):
+        bs = self._store()  # global mode: none
+        bs.set_pool_opts(7, {"compression_mode": "aggressive",
+                             "compression_algorithm": "zstd"})
+        blob = b"tenant data " * 8000
+        txn = Transaction()
+        txn.write((7, "a", 0), blob, ShardMeta())
+        txn.write((8, "b", 0), blob, ShardMeta())  # pool 8: no opts
+        bs.queue_transaction(txn)
+        assert bs._onodes[(7, "a", 0)].compression == "zstd"
+        assert bs._onodes[(8, "b", 0)].compression is None
+        assert bs.read((7, "a", 0))[0] == blob
+
+    def test_restart_recovery_over_compressed_blobs(self, tmp_path):
+        """The r4 done-bar: compressed blobs survive close + reopen,
+        including one still DEFERRED (in the KV WAL) at shutdown."""
+        conf = {"bluestore_compression_mode": "aggressive",
+                "bluestore_prefer_deferred_size": 32768}
+        bs = BlueStore(str(tmp_path), conf)
+        big = b"large compressible block " * 40000   # ~1 MiB raw
+        small = b"tiny deferred payload " * 100      # compresses < 32 KiB
+        txn = Transaction()
+        txn.write((1, "big", 0), big, ShardMeta(object_size=len(big)))
+        txn.write((1, "small", 0), small, ShardMeta())
+        bs.queue_transaction(txn)
+        assert bs._onodes[(1, "big", 0)].compression == "zlib"
+        assert bs._onodes[(1, "small", 0)].deferred  # not yet flushed
+        bs.db.close()            # simulate crash: skip the batch flush
+        bs._block.close()
+        bs2 = BlueStore(str(tmp_path), conf)
+        assert bs2.read((1, "big", 0))[0] == big
+        assert bs2.read((1, "small", 0))[0] == small
+        assert not bs2._onodes[(1, "small", 0)].deferred  # replayed
+        bs2.close()
+
+    def test_corrupted_compressed_extent_fails_csum(self, tmp_path):
+        """A flipped byte inside a compressed extent raises EIO at the
+        csum check (before the decompressor) — the shard-level error
+        scrub turns into a repair."""
+        bs = BlueStore(str(tmp_path),
+                       {"bluestore_compression_mode": "aggressive",
+                        "bluestore_prefer_deferred_size": 0})
+        blob = b"scrubbed content " * 9000
+        txn = Transaction()
+        txn.write((1, "c", 0), blob, ShardMeta())
+        bs.queue_transaction(txn)
+        onode = bs._onodes[(1, "c", 0)]
+        assert onode.compression == "zlib"
+        off, length = onode.extents[0]
+        with open(os.path.join(str(tmp_path), "block"), "r+b") as f:
+            f.seek(off + length // 2)
+            orig = f.read(1)
+            f.seek(off + length // 2)
+            f.write(bytes([orig[0] ^ 0xFF]))
+        with pytest.raises(EIOError, match="checksum mismatch"):
+            bs.read((1, "c", 0))
+        bs.close()
+
+    def test_csum_type_selection(self, tmp_path):
+        # zlib crc selected at write: verify_any still reads it
+        bs = BlueStore(None, {"bluestore_csum_type": "zlib"})
+        txn = Transaction()
+        txn.write((1, "z", 0), b"x" * 100, ShardMeta())
+        bs.queue_transaction(txn)
+        import zlib as _z
+        assert bs._onodes[(1, "z", 0)].csums[0] == \
+            _z.crc32(b"x" * 100) & 0xFFFFFFFF
+        assert bs.read((1, "z", 0))[0] == b"x" * 100
+        # none: no verification, bitrot goes undetected BY DESIGN
+        bs2 = BlueStore(str(tmp_path), {"bluestore_csum_type": "none",
+                                        "bluestore_prefer_deferred_size": 0})
+        txn = Transaction()
+        txn.write((1, "n", 0), os.urandom(4096), ShardMeta())
+        bs2.queue_transaction(txn)
+        assert bs2._onodes[(1, "n", 0)].csums == [0]
+        off, _ = bs2._onodes[(1, "n", 0)].extents[0]
+        with open(os.path.join(str(tmp_path), "block"), "r+b") as f:
+            f.seek(off)
+            f.write(b"\x00\x00")
+        bs2.read((1, "n", 0))  # no EIO: csum_type none skips the check
+        bs2.close()
+
+
+class TestCompressionClusterPath:
+    def test_pool_opts_flow_map_to_store_and_scrub_repairs(self, tmp_path):
+        """End to end: `pool set compression_mode` rides the OSDMap into
+        every OSD's BlueStore; a corrupted compressed shard EIOs and
+        deep scrub REPAIRS it from the surviving shards."""
+        import numpy as np
+
+        from ceph_tpu.rados.vstart import Cluster
+
+        async def go():
+            cluster = Cluster(n_osds=4, conf={
+                "osd_auto_repair": False,
+                # straight-to-block writes: the corruption below targets
+                # the block file, not the KV WAL's deferred payloads
+                "bluestore_prefer_deferred_size": 0,
+            }, data_dir=str(tmp_path))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("comp", profile={
+                    "plugin": "jerasure", "technique": "reed_sol_van",
+                    "k": "2", "m": "1"})
+                await c.pool_set(pool, "compression_mode", "aggressive")
+                await c.pool_set(pool, "compression_algorithm", "zstd")
+                # wait for every OSD to see the opts epoch
+                for _ in range(100):
+                    if all(o.store.pool_opts.get(pool, {}).get(
+                            "compression_mode") == "aggressive"
+                           for o in cluster.osds.values()):
+                        break
+                    await asyncio.sleep(0.05)
+                blob = b"cluster compressible payload " * 30000
+                await c.put(pool, "obj", blob)
+                # at least one stored shard is compressed
+                comp_osds = [
+                    o for o in cluster.osds.values()
+                    for key in [(pool, "obj", s) for s in range(3)]
+                    if key in o.store._onodes
+                    and o.store._onodes[key].compression == "zstd"]
+                assert comp_osds, "no shard stored compressed"
+                # corrupt one compressed shard's extent on disk
+                victim = comp_osds[0]
+                vkey = next(k for k in victim.store._onodes
+                            if k[0] == pool and k[1] == "obj"
+                            and victim.store._onodes[k].compression)
+                onode = victim.store._onodes[vkey]
+                off, length = onode.extents[0]
+                victim.store._block.seek(off)
+                raw = victim.store._block.read(length)
+                victim.store._block.seek(off)
+                victim.store._block.write(
+                    bytes([raw[0] ^ 0xFF]) + raw[1:])
+                victim.store._block.flush()
+                with pytest.raises(Exception):
+                    victim.store.read(vkey)
+                # reads still serve (degraded reconstruction), and deep
+                # scrub repairs the corrupted shard in place
+                assert await c.get(pool, "obj") == blob
+                stats = await c.deep_scrub(pool)
+                assert stats["repaired"] >= 1, stats
+                data, _ = victim.store.read(vkey)  # EIO gone
+                assert await c.get(pool, "obj") == blob
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        asyncio.run(go())
