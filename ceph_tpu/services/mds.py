@@ -1025,20 +1025,9 @@ class CephFSClient:
         self._dirty[FileSystem._norm(path)] = bytes(data)
 
     async def read(self, path: str) -> bytes:
-        await self._maybe_renew()
-        p = FileSystem._norm(path)
-        if p in self._dirty:
-            self.cache_hits += 1
-            return self._dirty[p]  # our own write-behind bytes
-        held = self.session.caps.get(p)
-        if held in ("r", "rw") and p in self._clean:
-            self.cache_hits += 1
-            return self._clean[p]
-        if held is None:
-            await self._acquire(path, "r")
-        data = await self.mds.read_file(self.session, path)
-        self._clean[p] = data
-        return data
+        # whole-file read = positional read of everything: ONE copy of
+        # the dirty/clean/server tier logic (_image), one counter
+        return await self.pread(path, 0, -1)
 
     async def fsync(self, path: str) -> None:
         # process pending revokes FIRST: flushing a path whose cap was
@@ -1136,6 +1125,82 @@ class CephFSClient:
         await self._maybe_renew()
         return await self.mds.listdir_snap(self.session, path, name, rel)
 
+    # -- positional I/O (the ll_read/ll_write substrate for handles) ---------
+
+    async def _image(self, p: str, create: bool = False) -> bytes:
+        """The file's current image through the cache tiers (the ONE
+        copy of the dirty/clean/server resolution — read() and the
+        positional ops all ride it): our own write-behind bytes, the
+        clean cache, else the server.  ENOENT raises unless `create`
+        (the write path treats a missing file as empty)."""
+        if p in self._dirty:
+            self.cache_hits += 1
+            return self._dirty[p]
+        if p in self._clean and self.session.caps.get(p):
+            self.cache_hits += 1
+            return self._clean[p]
+        try:
+            data = await self.mds.read_file(self.session, p)
+        except FsError as e:
+            if create and "ENOENT" in str(e):
+                return b""
+            raise
+        self._clean[p] = data
+        return data
+
+    async def pread(self, path: str, off: int, n: int = -1) -> bytes:
+        await self._maybe_renew()
+        p = FileSystem._norm(path)
+        if p not in self._dirty and self.session.caps.get(p) is None:
+            await self._acquire(p, "r")
+        data = await self._image(p)
+        return data[off:] if n < 0 else data[off:off + n]
+
+    async def pwrite(self, path: str, off: int, data: bytes) -> int:
+        """Positional write-behind: splice `data` at `off` over the
+        current image (zero-extending a hole), dirty under the
+        exclusive cap (Client::_write role)."""
+        await self._maybe_renew()
+        p = FileSystem._norm(path)
+        if self.session.caps.get(p) != "rw":
+            await self._acquire(p, "rw")
+        buf = bytearray(await self._image(p, create=True))
+        if len(buf) < off:
+            buf.extend(b"\x00" * (off - len(buf)))
+        buf[off:off + len(data)] = data
+        self._dirty[p] = bytes(buf)
+        return len(data)
+
+    async def append(self, path: str, data: bytes) -> int:
+        """O_APPEND write: EOF resolves and the splice lands in ONE
+        step under the exclusive cap, so a concurrent client cannot
+        slip an append between a stat and a pwrite.  Returns the
+        offset the data landed at."""
+        await self._maybe_renew()
+        p = FileSystem._norm(path)
+        if self.session.caps.get(p) != "rw":
+            await self._acquire(p, "rw")
+        buf = bytearray(await self._image(p, create=True))
+        off = len(buf)
+        buf.extend(data)
+        self._dirty[p] = bytes(buf)
+        return off
+
+    async def truncate(self, path: str, size: int) -> None:
+        await self._maybe_renew()
+        p = FileSystem._norm(path)
+        if self.session.caps.get(p) != "rw":
+            await self._acquire(p, "rw")
+        buf = bytearray(await self._image(p, create=True))
+        if len(buf) < size:
+            buf.extend(b"\x00" * (size - len(buf)))
+        else:
+            del buf[size:]
+        self._dirty[p] = bytes(buf)
+
+    async def open(self, path: str, mode: str = "r") -> "CephFSFile":
+        return await open_file(self, path, mode)
+
     async def unmount(self) -> None:
         """Flush every dirty file, release every cap, close the session
         (the reference client's unmount barrier)."""
@@ -1144,3 +1209,135 @@ class CephFSClient:
             await self._flush_path(path)
         self._clean.clear()
         self.mds.close_session(self.session.session_id)
+
+
+# -- file handles (libcephfs ll_open/ll_read/ll_write/ll_fsync role) ---------
+
+
+async def open_file(io, path: str, mode: str = "r") -> "CephFSFile":
+    """Open a handle on `io` (a CephFSClient or any facade exposing the
+    same pread/pwrite/truncate/stat/fsync surface).  Modes follow the
+    POSIX open flags the reference's ll_open honors:
+
+      r   read-only, must exist (O_RDONLY)
+      r+  read/write, must exist (O_RDWR)
+      w   write-only, create or TRUNCATE (O_WRONLY|O_CREAT|O_TRUNC)
+      a   write-only append, create if missing (O_WRONLY|O_CREAT|O_APPEND)
+
+    Permission checks happen HERE (EISDIR on directories, ENOENT for
+    must-exist modes) and per-op (EBADF for the wrong direction on a
+    one-way handle) — cap acquisition rides the first read/write, per
+    handle direction."""
+    if mode not in ("r", "r+", "w", "a"):
+        raise FsError(f"EINVAL: bad open mode {mode!r}")
+    p = FileSystem._norm(path)
+    st = None
+    last: Optional[FsError] = None
+    for _attempt in range(50):
+        try:
+            st = await io.stat(p)
+            break
+        except FsError as e:
+            if "ENOENT" in str(e):
+                st = None
+                break
+            if "EAGAIN" not in str(e) and "ESTALE" not in str(e):
+                raise
+            # a conflicting holder was asked for the cap back: drive
+            # our own revoke compliance and retry while it complies
+            # (the same loop every capped client op runs)
+            last = e
+            renew = getattr(io, "renew_all", None) or getattr(
+                io, "renew", None)
+            if renew is not None:
+                await renew()
+            await asyncio.sleep(0.05)
+    else:
+        raise last if last is not None else FsError(f"EAGAIN: {p}")
+    if st is not None and st.get("type") == "dir":
+        raise FsError(f"EISDIR: {p}")
+    if st is None and mode in ("r", "r+"):
+        raise FsError(f"ENOENT: {p}")
+    fh = CephFSFile(io, p, mode)
+    if mode == "w":
+        # O_TRUNC|O_CREAT: the handle starts from an empty image (a
+        # close with no writes still creates the empty file)
+        await io.truncate(p, 0)
+    elif mode == "a" and st is None:
+        await io.truncate(p, 0)  # O_CREAT
+    return fh
+
+
+class CephFSFile:
+    """An open file handle (reference Fh, src/client/Client.cc
+    ll_read/ll_write semantics): per-handle mode enforcement, a
+    sequential offset for read()/write(), positional pread/pwrite, and
+    O_APPEND writes landing at the current EOF.  Data rides the owning
+    client's cap-aware write-behind cache, so a revoke mid-write
+    flushes and the next operation transparently re-acquires."""
+
+    def __init__(self, io, path: str, mode: str):
+        self._io = io
+        self.path = path
+        self.mode = mode
+        self.offset = 0
+        self.closed = False
+
+    def _check(self, want: str) -> None:
+        if self.closed:
+            raise FsError(f"EBADF: {self.path} handle closed")
+        if want == "r" and self.mode in ("w", "a"):
+            raise FsError(f"EBADF: {self.path} not open for read")
+        if want == "w" and self.mode == "r":
+            raise FsError(f"EBADF: {self.path} not open for write")
+
+    async def pread(self, off: int, n: int = -1) -> bytes:
+        self._check("r")
+        return await self._io.pread(self.path, off, n)
+
+    async def pwrite(self, off: int, data: bytes) -> int:
+        self._check("w")
+        return await self._io.pwrite(self.path, off, data)
+
+    async def read(self, n: int = -1) -> bytes:
+        self._check("r")
+        data = await self._io.pread(self.path, self.offset, n)
+        self.offset += len(data)
+        return data
+
+    async def write(self, data: bytes) -> int:
+        self._check("w")
+        if self.mode == "a":
+            # O_APPEND: EOF resolution and splice are ONE operation
+            # under the exclusive cap (io.append) — a stat-then-pwrite
+            # pair would let a concurrent append slip in between
+            off = await self._io.append(self.path, data)
+            self.offset = off + len(data)
+            return len(data)
+        n = await self._io.pwrite(self.path, self.offset, data)
+        self.offset += n
+        return n
+
+    async def truncate(self, size: int) -> None:
+        self._check("w")
+        await self._io.truncate(self.path, size)
+
+    async def fsync(self) -> None:
+        if self.closed:
+            raise FsError(f"EBADF: {self.path} handle closed")
+        await self._io.fsync(self.path)
+
+    async def close(self) -> None:
+        """Flush on close (the reference's ll_release -> _flush): the
+        handle's writes are durable at the MDS once close returns."""
+        if self.closed:
+            return
+        self.closed = True
+        if self.mode != "r":
+            await self._io.fsync(self.path)
+
+    async def __aenter__(self) -> "CephFSFile":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()

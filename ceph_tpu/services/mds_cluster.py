@@ -37,7 +37,8 @@ from typing import Dict, List, Optional, Tuple
 from ceph_tpu.rados.client import RadosError
 from ceph_tpu.rados.librados import IoCtx
 from ceph_tpu.services.mds import (CephFSClient, FileSystem, FsError,
-                                   MDSServer, is_under as _is_under)
+                                   MDSServer, is_under as _is_under,
+                                   open_file)
 
 SUBTREE_MAP_OID = "mds_subtree_map"
 
@@ -563,6 +564,27 @@ class CephFSMultiClient:
 
     async def read(self, path: str) -> bytes:
         return await self._routed(path, "read")
+
+    # -- file handles (libcephfs ll_open surface over the cluster) -----------
+
+    async def pread(self, path: str, off: int, n: int = -1) -> bytes:
+        return await self._routed(path, "pread", off, n)
+
+    async def pwrite(self, path: str, off: int, data: bytes) -> int:
+        return await self._routed(path, "pwrite", off, data)
+
+    async def append(self, path: str, data: bytes) -> int:
+        return await self._routed(path, "append", data)
+
+    async def truncate(self, path: str, size: int) -> None:
+        await self._routed(path, "truncate", size)
+
+    async def open(self, path: str, mode: str = "r"):
+        """Open a handle whose every operation re-routes to the path's
+        CURRENT authoritative rank — a subtree export mid-handle just
+        redirects the next op (with cache handoff), it does not
+        invalidate the handle."""
+        return await open_file(self, path, mode)
 
     async def fsync(self, path: str) -> None:
         await self._routed(path, "fsync")

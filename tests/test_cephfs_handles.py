@@ -1,0 +1,356 @@
+"""CephFS file handles (VERDICT r4 #6; reference src/client/Client.cc
+ll_open/ll_read/ll_write/ll_fsync/ll_release): per-handle open-mode
+permission enforcement, positional + sequential I/O over the cap-aware
+write-behind cache, revoke-under-write compliance, and a two-client
+write-interleave stress over multi-active MDS ranks."""
+
+import asyncio
+import random
+
+import pytest
+
+from ceph_tpu.rados.librados import Rados
+from ceph_tpu.rados.vstart import Cluster
+from ceph_tpu.services.mds import CephFSClient, FileSystem, FsError, MDSServer
+from ceph_tpu.services.mds_cluster import CephFSMultiClient, MDSCluster
+
+CONF = {"osd_auto_repair": False}
+EC_PROFILE = {"plugin": "jerasure", "technique": "reed_sol_van",
+              "k": "2", "m": "1"}
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _mds(pool="fsh"):
+    cluster = Cluster(n_osds=4, conf=dict(CONF))
+    await cluster.start()
+    rados = await Rados(cluster.mon_addrs, CONF).connect()
+    await rados.pool_create(pool, profile=EC_PROFILE)
+    io = await rados.open_ioctx(pool)
+    fs = FileSystem(io)
+    await fs.mkfs()
+    await fs.mount()
+    return cluster, rados, MDSServer(fs)
+
+
+class TestOpenModes:
+    def test_mode_and_permission_enforcement(self):
+        async def go():
+            cluster, rados, mds = await _mds()
+            try:
+                c = CephFSClient(mds, "alice")
+                # r on a missing file: ENOENT
+                with pytest.raises(FsError, match="ENOENT"):
+                    await c.open("/missing", "r")
+                # opening a directory for file I/O: EISDIR
+                await c.mkdir("/d")
+                with pytest.raises(FsError, match="EISDIR"):
+                    await c.open("/d", "r")
+                with pytest.raises(FsError, match="EINVAL"):
+                    await c.open("/x", "rw")
+                # w creates (even with no writes before close)
+                fh = await c.open("/empty", "w")
+                await fh.close()
+                await c.fsync("/empty")
+                st = await c.stat("/empty")
+                assert st["type"] == "file" and st["size"] == 0
+                # one-way handles refuse the other direction
+                fh = await c.open("/empty", "w")
+                with pytest.raises(FsError, match="EBADF"):
+                    await fh.read()
+                await fh.pwrite(0, b"data")
+                await fh.close()
+                fh = await c.open("/empty", "r")
+                with pytest.raises(FsError, match="EBADF"):
+                    await fh.pwrite(0, b"x")
+                assert await fh.read() == b"data"
+                await fh.close()
+                # a closed handle refuses everything
+                with pytest.raises(FsError, match="EBADF"):
+                    await fh.pread(0, 1)
+                # w TRUNCATES an existing file
+                fh = await c.open("/empty", "w")
+                await fh.close()
+                assert (await c.stat("/empty"))["size"] == 0
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_positional_sequential_append(self):
+        async def go():
+            cluster, rados, mds = await _mds()
+            try:
+                c = CephFSClient(mds, "alice")
+                async with await c.open("/f", "w") as fh:
+                    await fh.write(b"hello ")
+                    await fh.write(b"world")
+                    # positional write past EOF zero-extends the hole
+                    await fh.pwrite(16, b"TAIL")
+                async with await c.open("/f", "r") as fh:
+                    assert await fh.read(6) == b"hello "
+                    assert await fh.read() == b"world\x00\x00\x00\x00\x00TAIL"
+                    assert await fh.pread(0, 5) == b"hello"
+                    assert await fh.pread(16, 4) == b"TAIL"
+                # r+ read-modify-write in place
+                async with await c.open("/f", "r+") as fh:
+                    await fh.pwrite(0, b"HELLO")
+                    assert await fh.pread(0, 11) == b"HELLO world"
+                    await fh.truncate(11)
+                # O_APPEND: every write lands at current EOF
+                async with await c.open("/f", "a") as fh:
+                    await fh.write(b"+one")
+                    await fh.write(b"+two")
+                assert await c.read("/f") == b"HELLO world+one+two"
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_write_behind_until_fsync(self):
+        """Handle writes are write-behind under the exclusive cap: the
+        MDS sees nothing until fsync/close flushes."""
+        async def go():
+            cluster, rados, mds = await _mds()
+            try:
+                c = CephFSClient(mds, "alice")
+                fh = await c.open("/wb", "w")
+                await fh.pwrite(0, b"buffered")
+                # server-side: file does not exist yet
+                with pytest.raises(FsError, match="ENOENT"):
+                    await mds.fs.read_file("/wb")
+                await fh.fsync()
+                assert await mds.fs.read_file("/wb") == b"buffered"
+                await fh.pwrite(0, b"BUFFERED")
+                assert await mds.fs.read_file("/wb") == b"buffered"
+                await fh.close()  # close flushes
+                assert await mds.fs.read_file("/wb") == b"BUFFERED"
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+
+class TestRevokeUnderWrite:
+    def test_conflicting_open_revokes_and_handle_recovers(self):
+        """Client A holds an exclusive handle with dirty bytes; client
+        B opens the same file for write.  A's revoke (processed at its
+        next renewal) flushes the dirty bytes and releases the cap; B
+        then reads A's data, writes its own, and A's handle keeps
+        working by re-acquiring — the full cap ping-pong the reference
+        plays between two writers."""
+        async def go():
+            cluster, rados, mds = await _mds()
+            try:
+                a = CephFSClient(mds, "alice", renew_interval=0.01)
+                b = CephFSClient(mds, "bob", renew_interval=0.01)
+                fa = await a.open("/shared", "w")
+                await fa.pwrite(0, b"from-alice")
+                # B's open blocks on the cap until A complies; drive
+                # both sides concurrently
+                async def a_side():
+                    for _ in range(50):
+                        await a.renew()
+                        await asyncio.sleep(0.01)
+                opened = asyncio.create_task(b.open("/shared", "r+"))
+                pump = asyncio.create_task(a_side())
+                fb = await asyncio.wait_for(opened, 10)
+                # the revoke flushed A's write-behind: B sees it
+                assert await fb.pread(0, -1) == b"from-alice"
+                await fb.pwrite(0, b"BOB!")
+                await fb.fsync()
+                pump.cancel()
+                # A's handle transparently re-acquires (B must comply
+                # with ITS revoke, so pump B's renewals concurrently)
+                async def b_side():
+                    for _ in range(200):
+                        await b.renew()
+                        await asyncio.sleep(0.01)
+                bp = asyncio.create_task(b_side())
+                # fa is write-only: A's VIEW goes through the client
+                # (fresh "r" acquisition, another cap ping-pong)
+                got = await asyncio.wait_for(a.pread("/shared", 0, 4), 10)
+                assert got == b"BOB!"
+                await fa.pwrite(0, b"ALIC")
+                await fa.fsync()
+                bp.cancel()
+                assert await mds.fs.read_file("/shared") == b"ALIC-alice"
+                await fa.close()
+                await fb.close()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+
+class TestMultiRankInterleave:
+    def test_two_client_write_interleave_across_ranks(self):
+        """The r4 done-bar stress: two independent clients interleave
+        positional writes on shared files spread across TWO active MDS
+        ranks.  Disjoint slices from both writers must all survive the
+        cap ping-pong (every pwrite bases on the freshly flushed image,
+        by construction of the revoke protocol)."""
+        async def go():
+            cluster, rados, io = None, None, None
+            cluster = Cluster(n_osds=4, conf=dict(CONF))
+            await cluster.start()
+            try:
+                rados = await Rados(cluster.mon_addrs, CONF).connect()
+                await rados.pool_create("mr", profile=EC_PROFILE)
+                io = await rados.open_ioctx("mr")
+                mc = await MDSCluster(io, n_ranks=2).start()
+                c1 = CephFSMultiClient(mc, "c1", renew_interval=0.01)
+                c2 = CephFSMultiClient(mc, "c2", renew_interval=0.01)
+                await c1.mkdir("/a")
+                await c1.mkdir("/b")
+                await mc.export_dir("/b", 1)  # two ACTIVE ranks
+                assert mc.rank_of("/b/f") == 1 and mc.rank_of("/a/f") == 0
+                files = ["/a/f", "/b/f"]
+                slot = 16
+                n_slots = 8
+                for f in files:
+                    await c1.write(f, b"\x00" * (slot * n_slots))
+                    await c1.fsync(f)
+
+                rng = random.Random(5)
+
+                async def writer(client, tag: bytes, slots):
+                    for s in slots:
+                        f = files[s % 2]
+                        payload = tag * slot
+                        for attempt in range(200):
+                            try:
+                                await client.pwrite(
+                                    f, (s // 2) * slot, payload)
+                                await client.fsync(f)
+                                break
+                            except FsError as e:
+                                if "EAGAIN" not in str(e) \
+                                        and "ESTALE" not in str(e):
+                                    raise
+                                await client.renew_all()
+                                await asyncio.sleep(0.005)
+                        await asyncio.sleep(0)
+
+                # even slots to c1, odd to c2, shuffled: writes to the
+                # same files interleave arbitrarily across both ranks
+                all_slots = list(range(n_slots * 2))
+                rng.shuffle(all_slots)
+                s1 = [s for s in all_slots if s % 4 < 2]
+                s2 = [s for s in all_slots if s % 4 >= 2]
+                await asyncio.gather(writer(c1, b"1", s1),
+                                     writer(c2, b"2", s2))
+                for c in (c1, c2):
+                    await c.renew_all()
+                    for f in files:
+                        await c.fsync(f)
+                # every slot holds exactly its writer's tag
+                for f_i, f in enumerate(files):
+                    data = await mc.route(f)[1].fs.read_file(f)
+                    assert len(data) == slot * n_slots, (f, len(data))
+                    for s_i in range(n_slots):
+                        s = s_i * 2 + f_i
+                        want = (b"1" if s % 4 < 2 else b"2") * slot
+                        got = data[s_i * slot:(s_i + 1) * slot]
+                        assert got == want, (f, s_i, got[:4], want[:4])
+            finally:
+                if rados:
+                    await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_handle_survives_subtree_export(self):
+        """A handle opened before a subtree export keeps working: every
+        op re-routes to the path's new authoritative rank (with cache
+        handoff), the libcephfs behavior of caps following the MDS
+        authority."""
+        async def go():
+            cluster = Cluster(n_osds=4, conf=dict(CONF))
+            await cluster.start()
+            rados = None
+            try:
+                rados = await Rados(cluster.mon_addrs, CONF).connect()
+                await rados.pool_create("hx", profile=EC_PROFILE)
+                io = await rados.open_ioctx("hx")
+                mc = await MDSCluster(io, n_ranks=2).start()
+                c = CephFSMultiClient(mc, "c", renew_interval=0.01)
+                await c.mkdir("/mig")
+                fh = await c.open("/mig/file", "w")
+                await fh.pwrite(0, b"before-export")
+                await fh.fsync()
+                await mc.export_dir("/mig", 1)
+                assert mc.rank_of("/mig/file") == 1
+                # the SAME handle reads and writes through the new rank
+                # (6-byte splice over "before" leaves "-export")
+                assert await fh.pwrite(0, b"AFTER-") == 6
+                await fh.fsync()
+                fh2 = await c.open("/mig/file", "r")
+                assert await fh2.pread(0, -1) == b"AFTER--export"
+                await fh.close()
+                await fh2.close()
+            finally:
+                if rados:
+                    await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+
+class TestPositionalContracts:
+    def test_pread_missing_file_raises_enoent(self):
+        """pread must not mask a typo'd path as empty data (review
+        finding: the create-as-empty contract belongs to writes)."""
+        async def go():
+            cluster, rados, mds = await _mds("fsc1")
+            try:
+                c = CephFSClient(mds, "alice")
+                with pytest.raises(FsError, match="ENOENT"):
+                    await c.pread("/no-such", 0, 4)
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_append_is_atomic_under_the_cap(self):
+        """Two clients interleaving O_APPEND writes must lose nothing:
+        EOF resolution and the splice are one operation under the
+        exclusive cap (review finding: stat-then-pwrite had a window)."""
+        async def go():
+            cluster, rados, mds = await _mds("fsc2")
+            try:
+                a = CephFSClient(mds, "alice", renew_interval=0.01)
+                b = CephFSClient(mds, "bob", renew_interval=0.01)
+                fh = await a.open("/log", "a")
+                await fh.close()
+
+                async def appender(client, tag, n=10):
+                    fh = None
+                    for i in range(n):
+                        line = f"{tag}{i};".encode()
+                        for _ in range(200):
+                            try:
+                                await client.append("/log", line)
+                                await client.fsync("/log")
+                                break
+                            except FsError as e:
+                                if "EAGAIN" not in str(e) \
+                                        and "ESTALE" not in str(e):
+                                    raise
+                                await client.renew()
+                                await asyncio.sleep(0.005)
+                        await asyncio.sleep(0)
+
+                await asyncio.gather(appender(a, "A"), appender(b, "B"))
+                for c in (a, b):
+                    await c.renew()
+                    await c.fsync("/log")
+                data = await mds.fs.read_file("/log")
+                parts = [p for p in data.decode().split(";") if p]
+                assert sorted(parts) == sorted(
+                    [f"A{i}" for i in range(10)]
+                    + [f"B{i}" for i in range(10)]), parts
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
