@@ -15,8 +15,11 @@ CORPUS = os.path.join(os.path.dirname(__file__), "..", "corpus")
 def corpus_dirs():
     if not os.path.isdir(CORPUS):
         return []
+    # EC profile archives only — corpus/wire/ is the (separately
+    # replayed) wire-format corpus, not an encode profile
     return sorted(d for d in os.listdir(CORPUS)
-                  if os.path.isdir(os.path.join(CORPUS, d)))
+                  if os.path.isdir(os.path.join(CORPUS, d))
+                  and d.startswith("plugin="))
 
 
 @pytest.mark.parametrize("profile_dir", corpus_dirs())
