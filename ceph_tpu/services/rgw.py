@@ -1144,12 +1144,48 @@ class RgwService:
         await self._uploads_registry_update(bucket, add=upload_id)
         return upload_id
 
+    async def list_multipart_uploads(self, bucket: str) -> List[Dict]:
+        """In-progress uploads (reference RGWListBucketMultiparts, GET
+        /bucket?uploads): upload id + target key per entry."""
+        if await self._load_index(bucket) is None:
+            raise RadosError(f"NoSuchBucket: {bucket}")
+        out = []
+        for upload_id in await self._uploads_registry(bucket):
+            try:
+                meta = await self._load_upload(bucket, upload_id)
+            except RadosError as e:
+                if e.code == -errno.ENOENT:
+                    continue  # registry/meta race: entry mid-abort
+                raise  # transient I/O error: fail closed, never omit
+            out.append({"UploadId": upload_id, "Key": meta["key"]})
+        return out
+
+    async def list_parts(self, bucket: str, upload_id: str,
+                         key: Optional[str] = None) -> List[Dict]:
+        """Staged parts of one upload (reference RGWListMultipart, GET
+        /bucket/key?uploadId): number, size, etag — what a resuming
+        client needs to skip already-staged parts.  When `key` is
+        given it must match the upload's target (the frontend's
+        per-object authorization gate was evaluated against IT —
+        a mismatch is NoSuchUpload, as S3 answers)."""
+        meta = await self._load_upload(bucket, upload_id)
+        if key is not None and meta["key"] != key:
+            raise RadosError(f"NoSuchUpload: {upload_id} targets a "
+                             "different key", code=-errno.ENOENT)
+        return [{"PartNumber": int(n), "Size": p["size"],
+                 "ETag": p["etag"]}
+                for n, p in sorted(meta["parts"].items(),
+                                   key=lambda kv: int(kv[0]))]
+
     async def _load_upload(self, bucket: str, upload_id: str) -> Dict:
         try:
             return json.loads(await self.ioctx.read(
                 self._upload_meta_oid(bucket, upload_id)))
-        except RadosError:
-            raise RadosError(f"NoSuchUpload: {upload_id}")
+        except RadosError as e:
+            if e.code == -errno.ENOENT:
+                raise RadosError(f"NoSuchUpload: {upload_id}",
+                                 code=-errno.ENOENT)
+            raise  # transient I/O: keep the typed code, fail closed
 
     async def upload_part(self, bucket: str, upload_id: str, part: int,
                           data: bytes) -> str:
@@ -1896,6 +1932,11 @@ class RgwFrontend:
                     return "200 OK", json.dumps(
                         await self.service.list_object_versions(
                             bucket)).encode()
+                if method == "GET" and "uploads" in q:
+                    return "200 OK", json.dumps({
+                        "Uploads":
+                        await self.service.list_multipart_uploads(
+                            bucket)}).encode()
                 if method == "PUT":
                     await self.service.create_bucket(bucket,
                                                      owner=principal)
@@ -1972,6 +2013,12 @@ class RgwFrontend:
             if method == "DELETE" and "uploadId" in q:
                 await self.service.abort_multipart(bucket, q["uploadId"])
                 return "204 No Content", b""
+            if method == "GET" and "uploadId" in q:
+                # key must match the upload's target: the per-object
+                # authorization gate above was evaluated against it
+                return "200 OK", json.dumps({
+                    "Parts": await self.service.list_parts(
+                        bucket, q["uploadId"], key=key)}).encode()
             if method == "PUT" and "tagging" in q:
                 try:
                     parsed = json.loads(body or b"{}")

@@ -1,0 +1,54 @@
+"""ceph-volume-lite: OSD directory preparation/inventory (reference
+src/ceph-volume lvm prepare/list/zap + inventory, on directory-backed
+BlueStore)."""
+
+import json
+import os
+
+from ceph_tpu.tools import ceph_volume
+
+
+def _run(argv):
+    return ceph_volume.main(argv)
+
+
+class TestCephVolume:
+    def test_prepare_list_inventory_zap(self, tmp_path, capsys):
+        base = str(tmp_path)
+        assert _run(["prepare", "--base", base, "--osd-id", "0"]) == 0
+        assert _run(["prepare", "--base", base, "--osd-id", "1"]) == 0
+        # double-prepare refused
+        assert _run(["prepare", "--base", base, "--osd-id", "0"]) == 1
+        capsys.readouterr()
+        assert _run(["list", "--base", base]) == 0
+        rows = json.loads(capsys.readouterr().out)
+        assert [r["osd_id"] for r in rows] == [0, 1]
+        assert all(r["osd_fsid"] for r in rows)
+        # the prepared shape IS a mountable BlueStore
+        assert os.path.exists(os.path.join(base, "osd.0", "block"))
+        from ceph_tpu.rados.bluestore import BlueStore
+        from ceph_tpu.rados.store import ShardMeta, Transaction
+
+        bs = BlueStore(os.path.join(base, "osd.0"), {})
+        txn = Transaction()
+        txn.write((1, "o", 0), b"adopted", ShardMeta())
+        bs.queue_transaction(txn)
+        bs.close()
+        bs2 = BlueStore(os.path.join(base, "osd.0"), {})
+        assert bs2.read((1, "o", 0))[0] == b"adopted"
+        bs2.close()
+        # inventory reports used vs available directories
+        os.makedirs(os.path.join(base, "spare"))
+        assert _run(["inventory", "--base", base]) == 0
+        inv = {r["path"]: r for r in json.loads(capsys.readouterr().out)}
+        assert inv[os.path.join(base, "osd.0")]["available"] is False
+        assert inv[os.path.join(base, "spare")]["available"] is True
+        # zap needs the confirmation flag, then destroys
+        assert _run(["zap", "--base", base, "--osd-id", "1"]) == 1
+        assert _run(["zap", "--base", base, "--osd-id", "1",
+                     "--yes"]) == 0
+        assert not os.path.exists(os.path.join(base, "osd.1"))
+        capsys.readouterr()
+        assert _run(["list", "--base", base]) == 0
+        assert [r["osd_id"]
+                for r in json.loads(capsys.readouterr().out)] == [0]

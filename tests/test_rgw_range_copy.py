@@ -419,3 +419,69 @@ class TestObjectTagging:
                 await c.stop()
                 await cluster.stop()
         run(go())
+
+
+class TestMultipartListing:
+    def test_list_uploads_and_parts(self):
+        """GET ?uploads / GET ?uploadId (reference
+        RGWListBucketMultiparts / RGWListMultipart): a resuming client
+        can discover in-flight uploads and skip staged parts."""
+        async def go():
+            cluster, c, rados, svc = await _svc()
+            frontend = None
+            try:
+                frontend, host, port, creds, ak = await _frontend(svc)
+                await _req(host, port, creds, "PUT", "/lp", access=ak)
+                st, body, _ = await _req(host, port, creds, "GET",
+                                         "/lp", access=ak,
+                                         query="uploads")
+                assert st.startswith("200"), st
+                assert json.loads(body)["Uploads"] == []
+                st, body, _ = await _req(host, port, creds, "POST",
+                                         "/lp/big", access=ak,
+                                         query="uploads")
+                assert st.startswith("200"), st
+                up = json.loads(body)["UploadId"]
+                for i, size in ((1, 5000), (3, 700)):
+                    st, _, _ = await _req(
+                        host, port, creds, "PUT", "/lp/big",
+                        b"x" * size, access=ak,
+                        query=f"uploadId={up}&partNumber={i}")
+                    assert st.startswith("200"), st
+                st, body, _ = await _req(host, port, creds, "GET",
+                                         "/lp", access=ak,
+                                         query="uploads")
+                assert st.startswith("200"), st
+                ups = json.loads(body)["Uploads"]
+                assert ups == [{"UploadId": up, "Key": "big"}]
+                st, body, _ = await _req(host, port, creds, "GET",
+                                         "/lp/big", access=ak,
+                                         query=f"uploadId={up}")
+                assert st.startswith("200"), st
+                parts = json.loads(body)["Parts"]
+                assert [p["PartNumber"] for p in parts] == [1, 3]
+                assert [p["Size"] for p in parts] == [5000, 700]
+                assert all(p["ETag"] for p in parts)
+                # the key must match the upload's target (the gate was
+                # evaluated against it): mismatch is NoSuchUpload
+                st, body, _ = await _req(host, port, creds, "GET",
+                                         "/lp/other", access=ak,
+                                         query=f"uploadId={up}")
+                assert st.startswith("404"), st
+                # completion clears the listing
+                st, _, _ = await _req(host, port, creds, "POST",
+                                      "/lp/big", access=ak,
+                                      query=f"uploadId={up}")
+                assert st.startswith("200"), st
+                st, body, _ = await _req(host, port, creds, "GET",
+                                         "/lp", access=ak,
+                                         query="uploads")
+                assert st.startswith("200"), st
+                assert json.loads(body)["Uploads"] == []
+            finally:
+                if frontend:
+                    await frontend.stop()
+                await rados.shutdown()
+                await c.stop()
+                await cluster.stop()
+        run(go())
