@@ -230,6 +230,11 @@ class FileSystem:
                     await self.striper.remove(self._file_oid(old_ino))
                 except RadosError:
                     pass
+        elif op == "setattr_dentry":
+            dentries = await self._load_dir(ev["parent"])
+            if dentries is not None and ev["name"] in dentries:
+                dentries[ev["name"]].update(ev["attrs"])
+                await self._save_dir(ev["parent"], dentries)
         elif op == "rm_dentry":
             dentries = await self._load_dir(ev["parent"])
             if dentries is not None and ev["name"] in dentries:
@@ -349,7 +354,7 @@ class FileSystem:
 
     # -- namespace ops -------------------------------------------------------
 
-    async def mkdir(self, path: str) -> None:
+    async def mkdir(self, path: str, owner: Optional[str] = None) -> None:
         path = self._norm(path)
         if path == "/":
             raise FsError("EEXIST: /")
@@ -357,9 +362,39 @@ class FileSystem:
             parent, name, dentries = await self._parent_of(path)
             if name in dentries:
                 raise FsError(f"EEXIST: {path}")
+            # no umask model: creations default to world-rw (0777/0666
+            # like a 000-umask process) so multi-client workflows keep
+            # working until an owner narrows with chmod
+            dentry = {"type": "dir", "mtime": time.time(), "mode": 0o777}
+            if owner is not None:
+                dentry["owner"] = owner
             event = {"op": "set_dentry", "parent": parent, "name": name,
-                     "mkdir": path,
-                     "dentry": {"type": "dir", "mtime": time.time()}}
+                     "mkdir": path, "dentry": dentry}
+            await self._journal(event)
+            await self._apply_event(event)
+            await self._journal_applied()
+
+    async def chmod(self, path: str, mode: int,
+                    requester: Optional[str] = None) -> None:
+        """Journaled permission-bit update (reference CInode mode +
+        MClientRequest setattr): merges into the dentry, preserving
+        everything else.  The ownership gate runs HERE, under _mutate,
+        against the dentry the change will land on — a check-then-act
+        pair outside the lock would race a rename re-binding the
+        path."""
+        path = self._norm(path)
+        if path == "/":
+            raise FsError("EPERM: cannot chmod /")
+        async with self._mutate:
+            parent, name, dentries = await self._parent_of(path)
+            if name not in dentries:
+                raise FsError(f"ENOENT: {path}")
+            owner = dentries[name].get("owner")
+            if requester is not None and owner is not None \
+                    and owner != requester:
+                raise FsError(f"EPERM: {path} owned by {owner}")
+            event = {"op": "setattr_dentry", "parent": parent,
+                     "name": name, "attrs": {"mode": int(mode) & 0o7777}}
             await self._journal(event)
             await self._apply_event(event)
             await self._journal_applied()
@@ -380,7 +415,8 @@ class FileSystem:
             raise FsError(f"ENOENT: {path}")
         return dict(dentries[name])
 
-    async def write_file(self, path: str, data: bytes) -> None:
+    async def write_file(self, path: str, data: bytes,
+                         owner: Optional[str] = None) -> None:
         path = self._norm(path)
         # data rides a FRESH inode, written OUTSIDE the rank mutation
         # lock: bulk data transfers from unrelated files proceed
@@ -394,9 +430,20 @@ class FileSystem:
             existing = dentries.get(name)
             if existing and existing["type"] == "dir":
                 raise FsError(f"EISDIR: {path}")
+            dentry = {"type": "file", "size": len(data),
+                      "mtime": time.time(), "ino": ino}
+            if existing:
+                # overwrite keeps identity metadata (POSIX: writing
+                # does not chown/chmod)
+                for k in ("mode", "owner"):
+                    if k in existing:
+                        dentry[k] = existing[k]
+            else:
+                dentry["mode"] = 0o666
+                if owner is not None:
+                    dentry["owner"] = owner
             event = {"op": "set_dentry", "parent": parent, "name": name,
-                     "dentry": {"type": "file", "size": len(data),
-                                "mtime": time.time(), "ino": ino}}
+                     "dentry": dentry}
             if existing and existing.get("ino"):
                 # the replaced inode's data is dropped in the same
                 # journaled event (concurrent readers are excluded by the
@@ -846,15 +893,47 @@ class MDSServer:
     async def write_file(self, session: MDSSession, path: str,
                          data: bytes) -> None:
         self._require(session, path, "rw")
-        await self.fs.write_file(path, data)
+        await self._may(session, path, "w")
+        await self.fs.write_file(path, data, owner=session.client)
 
     async def read_file(self, session: MDSSession, path: str) -> bytes:
         self._require(session, path, "r")
+        await self._may(session, path, "r")
         return await self.fs.read_file(path)
 
     async def mkdir(self, session: MDSSession, path: str) -> None:
         self._require(session, path, "rw")
-        await self.fs.mkdir(path)
+        await self.fs.mkdir(path, owner=session.client)
+
+    async def chmod(self, session: MDSSession, path: str,
+                    mode: int) -> None:
+        """Owner-gated permission update (POSIX chmod needs ownership,
+        not write permission; files created before ownership stamping
+        have no owner and stay mutable by anyone, like uid-0-less
+        legacy data).  Ownership verifies INSIDE FileSystem.chmod
+        under the mutation lock; here only session liveness."""
+        if self._evict_if_dead(session.session_id):
+            raise FsError("ESTALE: session expired")
+        await self.fs.chmod(path, mode, requester=session.client)
+
+    async def _may(self, session: MDSSession, path: str,
+                   want: str) -> None:
+        """Mode-bit check for the path-based surface (reference
+        Client::may_read/may_write): owner and unstamped entries pass;
+        others need the other-class bit.  Absent files pass (creation;
+        parent-directory permissions are out of scope)."""
+        try:
+            st = await self.fs.stat(path)
+        except FsError:
+            return
+        owner = st.get("owner")
+        if owner is None or owner == session.client:
+            return
+        bits = int(st.get("mode", 0o666))
+        if want == "r" and not bits & 0o004:
+            raise FsError(f"EACCES: {path} not readable")
+        if want == "w" and not bits & 0o002:
+            raise FsError(f"EACCES: {path} not writable")
 
     async def unlink(self, session: MDSSession, path: str) -> None:
         self._require(session, path, "rw")
@@ -967,6 +1046,7 @@ class CephFSClient:
     def __init__(self, mds: MDSServer, client: str = "client",
                  renew_interval: float = 1.0):
         self.mds = mds
+        self.client_name = client
         self.session = mds.open_session(client)
         self.renew_interval = renew_interval
         self._last_renew = time.monotonic()
@@ -1148,12 +1228,27 @@ class CephFSClient:
         self._clean[p] = data
         return data
 
+    async def _image_capped(self, p: str, mode: str,
+                            create: bool = False) -> bytes:
+        """Acquire (or upgrade to) `mode` and resolve the image.  A
+        permission denial RELEASES the cap acquired for this very op —
+        a denied client squatting an exclusive cap would wedge every
+        authorized client behind a revoke it has no reason to answer."""
+        had = self.session.caps.get(p)
+        need = (had is None) if mode == "r" else (had != "rw")
+        if need and not (mode == "r" and p in self._dirty):
+            await self._acquire(p, mode)
+        try:
+            return await self._image(p, create=create)
+        except FsError as e:
+            if "EACCES" in str(e) and had != self.session.caps.get(p):
+                self.mds.release_cap(self.session, p)
+            raise
+
     async def pread(self, path: str, off: int, n: int = -1) -> bytes:
         await self._maybe_renew()
         p = FileSystem._norm(path)
-        if p not in self._dirty and self.session.caps.get(p) is None:
-            await self._acquire(p, "r")
-        data = await self._image(p)
+        data = await self._image_capped(p, "r")
         return data[off:] if n < 0 else data[off:off + n]
 
     async def pwrite(self, path: str, off: int, data: bytes) -> int:
@@ -1162,9 +1257,7 @@ class CephFSClient:
         exclusive cap (Client::_write role)."""
         await self._maybe_renew()
         p = FileSystem._norm(path)
-        if self.session.caps.get(p) != "rw":
-            await self._acquire(p, "rw")
-        buf = bytearray(await self._image(p, create=True))
+        buf = bytearray(await self._image_capped(p, "rw", create=True))
         if len(buf) < off:
             buf.extend(b"\x00" * (off - len(buf)))
         buf[off:off + len(data)] = data
@@ -1178,9 +1271,7 @@ class CephFSClient:
         offset the data landed at."""
         await self._maybe_renew()
         p = FileSystem._norm(path)
-        if self.session.caps.get(p) != "rw":
-            await self._acquire(p, "rw")
-        buf = bytearray(await self._image(p, create=True))
+        buf = bytearray(await self._image_capped(p, "rw", create=True))
         off = len(buf)
         buf.extend(data)
         self._dirty[p] = bytes(buf)
@@ -1189,14 +1280,16 @@ class CephFSClient:
     async def truncate(self, path: str, size: int) -> None:
         await self._maybe_renew()
         p = FileSystem._norm(path)
-        if self.session.caps.get(p) != "rw":
-            await self._acquire(p, "rw")
-        buf = bytearray(await self._image(p, create=True))
+        buf = bytearray(await self._image_capped(p, "rw", create=True))
         if len(buf) < size:
             buf.extend(b"\x00" * (size - len(buf)))
         else:
             del buf[size:]
         self._dirty[p] = bytes(buf)
+
+    async def chmod(self, path: str, mode: int) -> None:
+        await self._maybe_renew()
+        await self.mds.chmod(self.session, path, mode)
 
     async def open(self, path: str, mode: str = "r") -> "CephFSFile":
         return await open_file(self, path, mode)
@@ -1258,6 +1351,17 @@ async def open_file(io, path: str, mode: str = "r") -> "CephFSFile":
         raise FsError(f"EISDIR: {p}")
     if st is None and mode in ("r", "r+"):
         raise FsError(f"ENOENT: {p}")
+    # permission bits (reference Client::may_open): the owner always
+    # passes; others check the "other" rwx class of the file's mode.
+    # Unstamped legacy entries (no owner) are open to all.
+    if st is not None and st.get("owner") is not None:
+        me = getattr(io, "client_name", None)
+        if me != st["owner"]:
+            bits = int(st.get("mode", 0o644))
+            if mode in ("r", "r+") and not bits & 0o004:
+                raise FsError(f"EACCES: {p} not readable")
+            if mode in ("r+", "w", "a") and not bits & 0o002:
+                raise FsError(f"EACCES: {p} not writable")
     fh = CephFSFile(io, p, mode)
     if mode == "w":
         # O_TRUNC|O_CREAT: the handle starts from an empty image (a

@@ -508,6 +508,7 @@ class CephFSMultiClient:
                  renew_interval: float = 1.0):
         self.cluster = cluster
         self.name = client
+        self.client_name = client  # identity for open-time permission
         self.renew_interval = renew_interval
         self._clients: Dict[int, CephFSClient] = {}
 
@@ -578,6 +579,9 @@ class CephFSMultiClient:
 
     async def truncate(self, path: str, size: int) -> None:
         await self._routed(path, "truncate", size)
+
+    async def chmod(self, path: str, mode: int) -> None:
+        await self._routed(path, "chmod", mode)
 
     async def open(self, path: str, mode: str = "r"):
         """Open a handle whose every operation re-routes to the path's

@@ -1797,6 +1797,12 @@ class RgwFrontend:
                 etag = hashlib.md5(body).hexdigest()
                 return "201 Created", b"", {"ETag": etag}
             if method == "GET":
+                rng_hdr = headers.get("range")
+                if rng_hdr:
+                    # same range engine AND reply shape as the S3
+                    # dialect: one shared helper, zero divergence
+                    return await self._ranged_get(container, key,
+                                                  rng_hdr)
                 data = await self.service.get_object(container, key)
                 return "200 OK", data, {}
             if method == "HEAD":
@@ -1819,6 +1825,31 @@ class RgwFrontend:
             if "QuotaExceeded" in msg:
                 return "403 Forbidden", msg.encode(), {}
             return "500 Internal Server Error", msg.encode(), {}
+
+    async def _ranged_get(self, bucket: str, key: str, rng_hdr: str,
+                          version_id: Optional[str] = None):
+        """Range GET reply, shared by the S3 and Swift dialects: 206 +
+        Content-Range for a satisfiable range, 416 + 'bytes */total'
+        when past the end, plain 200 for a malformed spec."""
+        try:
+            data, total, rng = await self.service.get_object_range(
+                bucket, key, rng_hdr, version_id=version_id)
+        except RadosError as e:
+            if e.code == -errno.ERANGE:
+                total = getattr(e, "total", None)
+                if total is None:
+                    total = await self.service.stat_object(
+                        bucket, key, version_id=version_id)
+                return ("416 Requested Range Not Satisfiable",
+                        b"InvalidRange",
+                        {"Content-Range": f"bytes */{total}"})
+            raise
+        if rng is None:
+            return "200 OK", data, {}
+        a, b = rng
+        return ("206 Partial Content", data,
+                {"Content-Range": f"bytes {a}-{b}/{total}",
+                 "Accept-Ranges": "bytes"})
 
     async def _route(self, method: str, path: str, query: str,
                      body: bytes,
@@ -2069,29 +2100,9 @@ class RgwFrontend:
             if method == "GET":
                 rng_hdr = headers.get("range")
                 if rng_hdr:
-                    try:
-                        data, total, rng = \
-                            await self.service.get_object_range(
-                                bucket, key, rng_hdr,
-                                version_id=q.get("versionId"))
-                    except RadosError as e:
-                        if e.code == -errno.ERANGE:
-                            total = getattr(e, "total", None)
-                            if total is None:
-                                total = await self.service.stat_object(
-                                    bucket, key,
-                                    version_id=q.get("versionId"))
-                            return ("416 Requested Range Not Satisfiable",
-                                    b"InvalidRange",
-                                    {"Content-Range": f"bytes */{total}"})
-                        raise
-                    if rng is None:
-                        # malformed spec: S3 ignores the header
-                        return "200 OK", data
-                    a, b = rng
-                    return ("206 Partial Content", data,
-                            {"Content-Range": f"bytes {a}-{b}/{total}",
-                             "Accept-Ranges": "bytes"})
+                    return await self._ranged_get(
+                        bucket, key, rng_hdr,
+                        version_id=q.get("versionId"))
                 return "200 OK", await self.service.get_object(
                     bucket, key, version_id=q.get("versionId"))
             if method == "HEAD":

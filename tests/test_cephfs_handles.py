@@ -354,3 +354,91 @@ class TestPositionalContracts:
                 await rados.shutdown()
                 await cluster.stop()
         run(go())
+
+
+class TestPermissions:
+    def test_chmod_and_open_enforcement(self):
+        """Owner/mode bits (reference Client::may_open + setattr):
+        chmod is owner-gated, open checks the other-class rw bits,
+        the owner always passes, unstamped legacy entries stay open."""
+        async def go():
+            cluster, rados, mds = await _mds("fsperm")
+            try:
+                alice = CephFSClient(mds, "alice", renew_interval=0.01)
+                bob = CephFSClient(mds, "bob", renew_interval=0.01)
+                fh = await alice.open("/secret", "w")
+                await fh.pwrite(0, b"mine")
+                await fh.close()
+                st = await alice.stat("/secret")
+                # no umask model: creations default world-rw until the
+                # owner narrows (multi-client workflows keep working)
+                assert st["owner"] == "alice" and st["mode"] == 0o666
+
+                async def pump_alice():
+                    while True:  # until cancelled: never exhaust early
+                        await alice.renew()
+                        await asyncio.sleep(0.005)
+
+                pump = asyncio.create_task(pump_alice())
+                # owner narrows to 0644: bob reads, cannot write
+                await alice.chmod("/secret", 0o644)
+                fb = await asyncio.wait_for(bob.open("/secret", "r"), 10)
+                assert await fb.pread(0, -1) == b"mine"
+                await fb.close()
+                with pytest.raises(FsError, match="EACCES"):
+                    await bob.open("/secret", "r+")
+                with pytest.raises(FsError, match="EACCES"):
+                    await bob.open("/secret", "a")
+                # non-owner chmod: EPERM
+                with pytest.raises(FsError, match="EPERM"):
+                    await bob.chmod("/secret", 0o666)
+                # owner locks it down: bob loses read too
+                await alice.chmod("/secret", 0o600)
+                # bob must drop his cached cap/data to see the change;
+                # (mode rides the dentry, not the cap — revoke-free)
+                bob._clean.pop("/secret", None)
+                with pytest.raises(FsError, match="EACCES"):
+                    await bob.open("/secret", "r")
+                # the PATH-based surface is gated server-side too (r5
+                # review: open-only checks protect nothing for callers
+                # riding pread/pwrite directly)
+                with pytest.raises(FsError, match="EACCES"):
+                    await bob.read("/secret")
+                with pytest.raises(FsError, match="EACCES"):
+                    await bob.pwrite("/secret", 0, b"x")
+                # the denied client must NOT squat the exclusive cap it
+                # acquired for the attempt (it would wedge authorized
+                # clients behind a revoke it has no reason to answer)
+                assert bob.session.caps.get("/secret") != "rw"
+                # the owner still passes everything
+                fa = await asyncio.wait_for(
+                    alice.open("/secret", "r+"), 10)
+                assert await fa.pread(0, 4) == b"mine"
+                await fa.close()
+                # opening up again: bob can write
+                await alice.chmod("/secret", 0o666)
+                pump.cancel()
+
+                async def pump2():
+                    while True:
+                        await alice.renew()
+                        await bob.renew()
+                        await asyncio.sleep(0.005)
+
+                p2 = asyncio.create_task(pump2())
+                fb = await asyncio.wait_for(bob.open("/secret", "r+"), 10)
+                await fb.pwrite(0, b"ours")
+                await fb.close()
+                p2.cancel()
+                # overwrite kept alice's ownership (POSIX write)
+                st = await bob.stat("/secret")
+                assert st["owner"] == "alice"
+                # unstamped legacy entry (written below the server):
+                # open to all
+                await mds.fs.write_file("/legacy", b"old")
+                fb = await bob.open("/legacy", "r+")
+                await fb.close()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
