@@ -749,6 +749,26 @@ class FileSystem:
 #    src/mds/Locker.cc caps/lease machinery) ---------------------------------
 
 
+def may_access(st: Optional[Dict], client: Optional[str],
+               want: str, path: str = "") -> None:
+    """THE permission check (reference Client::may_read/may_write/
+    may_open), shared by the server's path ops, snapshot reads, and
+    open_file: owner and unstamped entries pass; others need the
+    other-class bit of the mode (default world-rw 0o666 — no umask
+    model).  `st` None (absent file) passes: creation is allowed,
+    parent-directory permissions are out of scope."""
+    if st is None:
+        return
+    owner = st.get("owner")
+    if owner is None or owner == client:
+        return
+    bits = int(st.get("mode", 0o666))
+    if want == "r" and not bits & 0o004:
+        raise FsError(f"EACCES: {path} not readable")
+    if want == "w" and not bits & 0o002:
+        raise FsError(f"EACCES: {path} not writable")
+
+
 class CapConflict(FsError):
     """The cap is held by a live conflicting session (retry after the
     holder releases, acks the revoke, or its lease lapses)."""
@@ -918,22 +938,13 @@ class MDSServer:
 
     async def _may(self, session: MDSSession, path: str,
                    want: str) -> None:
-        """Mode-bit check for the path-based surface (reference
-        Client::may_read/may_write): owner and unstamped entries pass;
-        others need the other-class bit.  Absent files pass (creation;
-        parent-directory permissions are out of scope)."""
+        """Mode-bit check for the path-based surface: one shared rule
+        (module-level may_access) for every enforcement point."""
         try:
             st = await self.fs.stat(path)
         except FsError:
             return
-        owner = st.get("owner")
-        if owner is None or owner == session.client:
-            return
-        bits = int(st.get("mode", 0o666))
-        if want == "r" and not bits & 0o004:
-            raise FsError(f"EACCES: {path} not readable")
-        if want == "w" and not bits & 0o002:
-            raise FsError(f"EACCES: {path} not writable")
+        may_access(st, session.client, want, path)
 
     async def unlink(self, session: MDSSession, path: str) -> None:
         self._require(session, path, "rw")
@@ -1011,6 +1022,12 @@ class MDSServer:
     async def read_snap_file(self, session: MDSSession, path: str,
                              name: str, rel: str) -> bytes:
         self._require(session, path, "r")
+        # the snapshot captured the file's mode/owner with its dentry:
+        # a 0600 file's content must not leak through a snapshot of an
+        # ancestor (r5 review bypass)
+        snap = await self.fs._snap_entry(FileSystem._norm(path), name)
+        may_access(snap.get("tree", {}).get(rel.strip("/")),
+                   session.client, "r", f"{path}@{name}/{rel}")
         return await self.fs.read_snap_file(path, name, rel)
 
     async def listdir_snap(self, session: MDSSession, path: str,
@@ -1198,7 +1215,18 @@ class CephFSClient:
 
     async def read_snap(self, path: str, name: str, rel: str) -> bytes:
         await self._maybe_renew()
-        return await self.mds.read_snap_file(self.session, path, name, rel)
+        # same conflict-retry discipline as every capped op: the
+        # implicit "r" acquisition on the snap root may need a holder
+        # to comply first
+        for attempt in range(20):
+            try:
+                return await self.mds.read_snap_file(
+                    self.session, path, name, rel)
+            except CapConflict:
+                await self.renew()
+                if attempt == 19:
+                    raise
+                await asyncio.sleep(0.05)
 
     async def listdir_snap(self, path: str, name: str,
                            rel: str = "") -> List[str]:
@@ -1239,6 +1267,12 @@ class CephFSClient:
         if need and not (mode == "r" and p in self._dirty):
             await self._acquire(p, mode)
         try:
+            if mode == "rw":
+                # write permission checks UP FRONT, not at flush time:
+                # a denied write surfacing later from renew() would
+                # have already dropped the dirty bytes and left this
+                # client squatting the exclusive cap (r5 review repro)
+                await self.mds._may(self.session, p, "w")
             return await self._image(p, create=create)
         except FsError as e:
             if "EACCES" in str(e) and had != self.session.caps.get(p):
@@ -1289,6 +1323,9 @@ class CephFSClient:
 
     async def chmod(self, path: str, mode: int) -> None:
         await self._maybe_renew()
+        # our own write-behind must land first: the file may exist only
+        # in the dirty cache, and FileSystem.chmod stats the server
+        await self._flush_path(FileSystem._norm(path))
         await self.mds.chmod(self.session, path, mode)
 
     async def open(self, path: str, mode: str = "r") -> "CephFSFile":
@@ -1351,17 +1388,13 @@ async def open_file(io, path: str, mode: str = "r") -> "CephFSFile":
         raise FsError(f"EISDIR: {p}")
     if st is None and mode in ("r", "r+"):
         raise FsError(f"ENOENT: {p}")
-    # permission bits (reference Client::may_open): the owner always
-    # passes; others check the "other" rwx class of the file's mode.
-    # Unstamped legacy entries (no owner) are open to all.
-    if st is not None and st.get("owner") is not None:
-        me = getattr(io, "client_name", None)
-        if me != st["owner"]:
-            bits = int(st.get("mode", 0o644))
-            if mode in ("r", "r+") and not bits & 0o004:
-                raise FsError(f"EACCES: {p} not readable")
-            if mode in ("r+", "w", "a") and not bits & 0o002:
-                raise FsError(f"EACCES: {p} not writable")
+    # permission bits: the ONE shared check (may_access) against the
+    # open direction(s)
+    me = getattr(io, "client_name", None)
+    if mode in ("r", "r+"):
+        may_access(st, me, "r", p)
+    if mode in ("r+", "w", "a"):
+        may_access(st, me, "w", p)
     fh = CephFSFile(io, p, mode)
     if mode == "w":
         # O_TRUNC|O_CREAT: the handle starts from an empty image (a

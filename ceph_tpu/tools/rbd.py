@@ -47,6 +47,10 @@ def parse_args(argv=None):
 
     sub.add_parser("ls")
 
+    du = sub.add_parser("du", help="provisioned vs USED bytes per image "
+                                   "(fast-diff object-map accounting)")
+    du.add_argument("image", nargs="?", help="one image (default: all)")
+
     i = sub.add_parser("info")
     i.add_argument("image")
 
@@ -110,6 +114,32 @@ async def run(args) -> int:
         elif args.cmd == "ls":
             for name in await rbd.list():
                 print(name)
+        elif args.cmd == "du":
+            # reference `rbd du`: USED = allocated blocks from the
+            # object map (the fast-diff accounting), no data reads;
+            # snapshots add their own pinned allocations
+            names = [args.image] if args.image else await rbd.list()
+            rows = []
+            for name in names:
+                img = await rbd.open(name)
+                used = len(img._hdr.get("object_map", ())) \
+                    * img.object_size
+                snap_used = 0
+                for info in img._snaps().values():
+                    snap_used += len(info.get("object_map", ())) \
+                        * img.object_size
+                rows.append({"NAME": name, "PROVISIONED": img.size,
+                             "USED": used, "SNAP_USED": snap_used})
+            print(f"{'NAME':<20} {'PROVISIONED':>14} {'USED':>14} "
+                  f"{'SNAP_USED':>14}")
+            for r in rows:
+                print(f"{r['NAME']:<20} {r['PROVISIONED']:>14} "
+                      f"{r['USED']:>14} {r['SNAP_USED']:>14}")
+            if not args.image:
+                print(f"{'TOTAL':<20} "
+                      f"{sum(r['PROVISIONED'] for r in rows):>14} "
+                      f"{sum(r['USED'] for r in rows):>14} "
+                      f"{sum(r['SNAP_USED'] for r in rows):>14}")
         elif args.cmd == "info":
             img = await rbd.open(args.image)
             print(json.dumps(await img.stat(), indent=2, sort_keys=True))

@@ -442,3 +442,87 @@ class TestPermissions:
                 await rados.shutdown()
                 await cluster.stop()
         run(go())
+
+
+class TestPermissionEdges:
+    def test_denied_pwrite_fails_up_front_on_0644(self):
+        """r5 review repro: with 0644 (other-READ passes) a denied
+        pwrite must fail AT THE WRITE, not later at flush — late
+        denial drops the dirty bytes and squats the exclusive cap."""
+        async def go():
+            cluster, rados, mds = await _mds("fse1")
+            try:
+                alice = CephFSClient(mds, "alice", renew_interval=0.01)
+                bob = CephFSClient(mds, "bob", renew_interval=0.01)
+                await alice.write("/f", b"hers")
+                await alice.fsync("/f")
+                await alice.chmod("/f", 0o644)
+
+                async def pump():
+                    while True:
+                        await alice.renew()
+                        await asyncio.sleep(0.005)
+
+                t = asyncio.create_task(pump())
+                with pytest.raises(FsError, match="EACCES"):
+                    await bob.pwrite("/f", 0, b"evil")
+                assert "/f" not in bob._dirty
+                assert bob.session.caps.get("/f") != "rw"
+                # alice (owner) still operates freely
+                got = await asyncio.wait_for(alice.read("/f"), 10)
+                assert got == b"hers"
+                t.cancel()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_snapshot_read_honors_mode(self):
+        """r5 review repro: a 0600 file's content must not leak
+        through a snapshot of an ancestor directory."""
+        async def go():
+            cluster, rados, mds = await _mds("fse2")
+            try:
+                alice = CephFSClient(mds, "alice", renew_interval=0.01)
+                bob = CephFSClient(mds, "bob", renew_interval=0.01)
+                await alice.mkdir("/docs")
+                await alice.write("/docs/secret", b"topsecret")
+                await alice.fsync("/docs/secret")
+                await alice.chmod("/docs/secret", 0o600)
+                await alice.snap_create("/docs", "s1")
+
+                async def pump():
+                    while True:  # alice complies with bob's cap asks
+                        await alice.renew()
+                        await asyncio.sleep(0.005)
+
+                t = asyncio.create_task(pump())
+                with pytest.raises(FsError, match="EACCES"):
+                    await asyncio.wait_for(
+                        bob.read_snap("/docs", "s1", "secret"), 15)
+                # the owner still reads the snapshot
+                got = await asyncio.wait_for(
+                    alice.read_snap("/docs", "s1", "secret"), 15)
+                assert got == b"topsecret"
+                t.cancel()
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
+
+    def test_chmod_flushes_write_behind_first(self):
+        """r5 review repro: chmod right after a write-behind write must
+        not ENOENT — the dirty bytes flush first."""
+        async def go():
+            cluster, rados, mds = await _mds("fse3")
+            try:
+                alice = CephFSClient(mds, "alice")
+                await alice.write("/g", b"x")
+                await alice.chmod("/g", 0o600)  # no fsync in between
+                st = await alice.stat("/g")
+                assert st["mode"] == 0o600
+                assert await mds.fs.read_file("/g") == b"x"
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())

@@ -399,3 +399,50 @@ class TestTrash:
                 await rados.shutdown()
                 await cluster.stop()
         run(go())
+
+
+class TestRbdDu:
+    def test_du_reports_used_from_object_map(self, capsys):
+        """`rbd du` (reference fast-diff accounting): USED comes from
+        allocated blocks, not provisioned size; snapshots account
+        their pinned allocations."""
+        async def go():
+            cluster, rados, rbd = await _rbd()
+            try:
+                from ceph_tpu.tools.rbd import parse_args
+                from ceph_tpu.tools.rbd import run as cli_run
+
+                mon = f"{cluster.mons[0].addr[0]}:" \
+                      f"{cluster.mons[0].addr[1]}"
+
+                async def cli(*argv):
+                    return await cli_run(parse_args(
+                        ["--mon", mon, "--pool", "rbdx", *argv]))
+
+                img = await rbd.create("sparse", 8 << 20, order=18)
+                await img.write(0, b"x" * (1 << 18))       # 1 block
+                await img.write(4 << 20, b"y" * 100)       # 1 more
+                await img.snap_create("s1")
+                await img.write(0, b"z" * (1 << 18))       # COW: snap pins
+                capsys.readouterr()
+                await cli("du", "sparse")
+                out = capsys.readouterr().out
+                row = [ln for ln in out.splitlines()
+                       if ln.startswith("sparse")][0]
+                name, prov, used, snap_used = row.split()
+                assert int(prov) == 8 << 20
+                assert int(used) == 2 * (1 << 18)       # 2 live blocks
+                assert int(snap_used) == 2 * (1 << 18)  # snap pins 2
+                # all-images form prints a TOTAL row
+                await rbd.create("thin", 4 << 20, order=18)
+                capsys.readouterr()
+                await cli("du")
+                out = capsys.readouterr().out
+                assert any(ln.startswith("thin") and " 0" in ln
+                           for ln in out.splitlines())
+                assert any(ln.startswith("TOTAL") for ln in
+                           out.splitlines())
+            finally:
+                await rados.shutdown()
+                await cluster.stop()
+        run(go())
