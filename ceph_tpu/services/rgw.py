@@ -1826,6 +1826,31 @@ class RgwFrontend:
                 return "403 Forbidden", msg.encode(), {}
             return "500 Internal Server Error", msg.encode(), {}
 
+    async def _resolve_copy_source(self, headers: Dict[str, str],
+                                   principal: Optional[str]):
+        """Parse + authorize x-amz-copy-source, shared by CopyObject
+        and UploadPartCopy: returns (sbucket, skey, svid) on success,
+        or an (status, body) error pair — ONE copy of the source
+        policy/ACL gate, so a fix to either branch cannot miss the
+        other."""
+        src = unquote(headers["x-amz-copy-source"])
+        src_path, _, src_q = src.partition("?")
+        sparts = [p for p in src_path.split("/") if p]
+        if len(sparts) < 2:
+            return None, ("400 Bad Request",
+                          b"InvalidArgument: copy-source")
+        sbucket, skey = sparts[0], "/".join(sparts[1:])
+        svid = dict(parse_qsl(src_q)).get("versionId")
+        smeta = await self.service.get_bucket_meta(sbucket)
+        sverdict = RgwService.policy_eval(
+            smeta.get("policy"), principal, "s3:GetObject",
+            f"arn:aws:s3:::{sbucket}/{skey}")
+        if sverdict == "Deny" or (
+                sverdict != "Allow" and not RgwService.acl_allows(
+                    smeta.get("acl"), principal, "READ")):
+            return None, ("403 Forbidden", b"AccessDenied")
+        return (sbucket, skey, svid), None
+
     async def _ranged_get(self, bucket: str, key: str, rng_hdr: str,
                           version_id: Optional[str] = None):
         """Range GET reply, shared by the S3 and Swift dialects: 206 +
@@ -2004,28 +2029,25 @@ class RgwFrontend:
                     # an optional x-amz-copy-source-range — silently
                     # staging the empty request body instead would
                     # complete into a truncated object
-                    src = unquote(headers["x-amz-copy-source"])
-                    src_path, _, src_q = src.partition("?")
-                    sparts = [p for p in src_path.split("/") if p]
-                    if len(sparts) < 2:
-                        return ("400 Bad Request",
-                                b"InvalidArgument: copy-source")
-                    sbucket, skey = sparts[0], "/".join(sparts[1:])
-                    svid = dict(parse_qsl(src_q)).get("versionId")
-                    smeta = await self.service.get_bucket_meta(sbucket)
-                    sverdict = RgwService.policy_eval(
-                        smeta.get("policy"), principal, "s3:GetObject",
-                        f"arn:aws:s3:::{sbucket}/{skey}")
-                    if sverdict == "Deny" or (
-                            sverdict != "Allow"
-                            and not RgwService.acl_allows(
-                                smeta.get("acl"), principal, "READ")):
-                        return "403 Forbidden", b"AccessDenied"
+                    resolved, err = await self._resolve_copy_source(
+                        headers, principal)
+                    if err is not None:
+                        return err
+                    sbucket, skey, svid = resolved
                     src_rng = headers.get("x-amz-copy-source-range")
                     if src_rng:
-                        body, _total, rng = \
-                            await self.service.get_object_range(
-                                sbucket, skey, src_rng, version_id=svid)
+                        try:
+                            body, _total, rng = \
+                                await self.service.get_object_range(
+                                    sbucket, skey, src_rng,
+                                    version_id=svid)
+                        except RadosError as e:
+                            if e.code == -errno.ERANGE:
+                                # unsatisfiable source range: the S3
+                                # contract is 416, never a 500
+                                return ("416 Requested Range Not "
+                                        "Satisfiable", b"InvalidRange")
+                            raise
                         if rng is None:
                             return ("400 Bad Request",
                                     b"InvalidArgument: copy-source-range")
@@ -2071,21 +2093,11 @@ class RgwFrontend:
                 # server-side copy (reference RGWCopyObj): the caller
                 # needs WRITE on the destination (already gated above)
                 # AND read access to the SOURCE bucket/key
-                src = unquote(headers["x-amz-copy-source"])
-                src_path, _, src_q = src.partition("?")
-                sparts = [p for p in src_path.split("/") if p]
-                if len(sparts) < 2:
-                    return "400 Bad Request", b"InvalidArgument: copy-source"
-                sbucket, skey = sparts[0], "/".join(sparts[1:])
-                svid = dict(parse_qsl(src_q)).get("versionId")
-                smeta = await self.service.get_bucket_meta(sbucket)
-                sverdict = RgwService.policy_eval(
-                    smeta.get("policy"), principal, "s3:GetObject",
-                    f"arn:aws:s3:::{sbucket}/{skey}")
-                if sverdict == "Deny" or (
-                        sverdict != "Allow" and not RgwService.acl_allows(
-                            smeta.get("acl"), principal, "READ")):
-                    return "403 Forbidden", b"AccessDenied"
+                resolved, err = await self._resolve_copy_source(
+                    headers, principal)
+                if err is not None:
+                    return err
+                sbucket, skey, svid = resolved
                 out = await self.service.copy_object(
                     sbucket, skey, bucket, key, version_id=svid,
                     principal=principal)

@@ -1,0 +1,76 @@
+"""cephfs-shell-lite (reference cephfs-shell): one-shot operator file
+access over the cap-aware client, each invocation a fresh mount with
+journal replay."""
+
+import asyncio
+import os
+
+from ceph_tpu.rados.librados import Rados
+from ceph_tpu.rados.vstart import Cluster
+from ceph_tpu.tools.cephfs_shell import parse_args
+from ceph_tpu.tools.cephfs_shell import run as shell_run
+
+CONF = {"osd_auto_repair": False}
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestCephFSShell:
+    def test_workflow(self, tmp_path, capsys):
+        async def go():
+            cluster = Cluster(n_osds=3, conf=dict(CONF))
+            await cluster.start()
+            rados = None
+            try:
+                rados = await Rados(cluster.mon_addrs, CONF).connect()
+                await rados.pool_create("fsx", pool_type="replicated")
+                io = await rados.open_ioctx("fsx")
+                from ceph_tpu.services.mds import FileSystem
+
+                fs = FileSystem(io)
+                await fs.mkfs()
+                mon = f"{cluster.mons[0].addr[0]}:" \
+                      f"{cluster.mons[0].addr[1]}"
+
+                async def sh(*argv):
+                    return await shell_run(parse_args(
+                        ["--mon", mon, "--pool", "fsx", *argv]))
+
+                local = tmp_path / "in.txt"
+                local.write_bytes(b"hello from the shell\n")
+                assert await sh("mkdir", "/docs") == 0
+                assert await sh("put", str(local), "/docs/hello") == 0
+                capsys.readouterr()
+                assert await sh("ls", "/docs") == 0
+                assert capsys.readouterr().out.strip() == "hello"
+                assert await sh("cat", "/docs/hello") == 0
+                assert b"hello from the shell" in \
+                    capsys.readouterr().out.encode()
+                out = tmp_path / "out.txt"
+                assert await sh("get", "/docs/hello", str(out)) == 0
+                assert out.read_bytes() == local.read_bytes()
+                capsys.readouterr()
+                assert await sh("stat", "/docs/hello") == 0
+                assert '"file"' in capsys.readouterr().out
+                assert await sh("chmod", "600", "/docs/hello") == 0
+                capsys.readouterr()
+                assert await sh("stat", "/docs/hello") == 0
+                assert "0o600" in capsys.readouterr().out
+                assert await sh("mv", "/docs/hello", "/docs/hi") == 0
+                capsys.readouterr()
+                assert await sh("du", "/") == 0
+                assert capsys.readouterr().out.strip() == \
+                    str(len(local.read_bytes()))
+                assert await sh("rm", "/docs/hi") == 0
+                capsys.readouterr()
+                assert await sh("ls", "/docs") == 0
+                assert capsys.readouterr().out.strip() == ""
+                # errors come back as exit code 1, not tracebacks
+                assert await sh("cat", "/missing") == 1
+            finally:
+                if rados:
+                    await rados.shutdown()
+                await cluster.stop()
+        run(go())

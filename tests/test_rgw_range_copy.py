@@ -255,6 +255,14 @@ class TestCopyObject:
                         "x-amz-copy-source": "/pc/src",
                         "x-amz-copy-source-range": "bytes=1000-1999"})
                 assert st.startswith("200"), st
+                # unsatisfiable copy-source-range: 416, not 500
+                st, _, _ = await _req(
+                    host, port, creds, "PUT", "/pc/assembled",
+                    access=ak, query=f"uploadId={up}&partNumber=3",
+                    extra_headers={
+                        "x-amz-copy-source": "/pc/src",
+                        "x-amz-copy-source-range": "bytes=999999-"})
+                assert st.startswith("416"), st
                 st, _, _ = await _req(host, port, creds, "POST",
                                       "/pc/assembled", access=ak,
                                       query=f"uploadId={up}")
