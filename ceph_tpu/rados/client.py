@@ -520,6 +520,14 @@ class RadosClient:
                              code=-errno.ENOENT)
         return dict(getattr(pool, "pool_snaps", {}) or {})
 
+    async def osd_statfs(self, osd_id: int) -> Dict:
+        """One OSD's store utilization (reference ObjectStore::statfs
+        feeding `ceph osd df`)."""
+        import json as _json
+
+        reply = await self._op_direct(osd_id, MOSDOp(op="statfs"))
+        return _json.loads(reply.data)
+
     async def deep_scrub(self, pool_id: int) -> Dict[str, int]:
         """Ask every up OSD to deep-scrub the PGs it leads; sums the
         per-primary summaries."""

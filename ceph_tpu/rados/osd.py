@@ -1575,6 +1575,20 @@ class OSD:
                 else:
                     summary = await self.deep_scrub_pool(pool)
                     reply = MOSDOpReply(ok=True, data=pickle.dumps(summary))
+            elif op.op == "statfs":
+                # per-OSD store utilization (reference
+                # ObjectStore::statfs feeding `ceph osd df`); stores
+                # without the hook (memstore) report object counts only
+                fn = getattr(self.store, "statfs", None)
+                if fn is not None:
+                    stats = dict(fn())
+                else:
+                    n = sum(1 for p in self.store.list_pools()
+                            for _ in self.store.list_objects(p))
+                    stats = {"num_objects": n}
+                stats["store"] = type(self.store).__name__
+                reply = MOSDOpReply(ok=True,
+                                    data=json.dumps(stats).encode())
             else:
                 reply = MOSDOpReply(ok=False, code=-errno.EINVAL,
                                     error=f"bad op {op.op}")

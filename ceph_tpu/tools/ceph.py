@@ -33,7 +33,8 @@ def parse_args(argv=None):
                    dest="confirm_destroy",
                    help="required acknowledgement for `osd pool rm`")
     p.add_argument("words", nargs="+",
-                   help="status | health | df | osd tree | pg dump | "
+                   help="status | health | df | osd df | osd tree | "
+                        "pg dump | "
                         "osd pool ls | osd pool create NAME [k=v...] | "
                         "osd pool set NAME KEY VALUE | "
                         "osd pool rm NAME NAME --yes-i-really-really-mean-it")
@@ -267,6 +268,39 @@ async def run(args) -> int:
                 return 2
             await client.pool_set(pool.pool_id, key, value)
             print(f"set pool {name} {key} = {value}")
+            return 0
+        if cmd == "osd df":
+            # per-OSD utilization (reference `ceph osd df`): statfs
+            # fan-out to every UP osd, CONCURRENTLY — one unresponsive
+            # OSD must cost one timeout, not serialize the sweep
+            import asyncio as _aio
+
+            async def one(osd_id, info):
+                if not info.up:
+                    return {"id": osd_id, "status": "down"}
+                try:
+                    stats = await client.osd_statfs(osd_id)
+                except Exception as e:
+                    return {"id": osd_id, "status": f"error: {e}"}
+                return {"id": osd_id, "status": "up",
+                        "weight": info.weight, **stats}
+
+            rows = list(await _aio.gather(
+                *(one(osd_id, info)
+                  for osd_id, info in sorted(m.osds.items()))))
+            if args.format == "json":
+                print(json.dumps(rows))
+            else:
+                print(f"{'ID':<4} {'STATUS':<8} {'STORE':<12} "
+                      f"{'SIZE':>12} {'USED':>12} {'FREE':>12} "
+                      f"{'OBJECTS':>8}")
+                for r in rows:
+                    print(f"{r['id']:<4} {r.get('status', ''):<8} "
+                          f"{r.get('store', '-'):<12} "
+                          f"{r.get('size', 0):>12} "
+                          f"{r.get('used', 0):>12} "
+                          f"{r.get('free', 0):>12} "
+                          f"{r.get('num_objects', 0):>8}")
             return 0
         if args.words[:3] in (["osd", "pool", "mksnap"],
                               ["osd", "pool", "rmsnap"]):

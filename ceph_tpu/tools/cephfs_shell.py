@@ -110,7 +110,9 @@ async def run(args) -> int:
             elif args.cmd == "du":
                 print(await _du(client, args.path))
             return 0
-        except FsError as e:
+        except (FsError, OSError, ValueError) as e:
+            # one error contract: message + exit 1, never a traceback
+            # (OSError: local file I/O; ValueError: e.g. a bad octal)
             print(str(e), file=sys.stderr)
             return 1
         finally:

@@ -232,3 +232,51 @@ class TestDencoder:
         assert dencoder.main(["corpus", "--check", corpus]) == 1
         out = capsys.readouterr().out
         assert "VERSION REGRESSION" in out and "FIELDS REMOVED" in out
+
+
+class TestOsdDf:
+    def test_osd_df_reports_store_utilization(self, tmp_path, capsys):
+        """`ceph osd df` (reference role): per-OSD statfs fan-out —
+        BlueStore reports sizes, down OSDs report down."""
+        import json as _json
+
+        async def go():
+            from ceph_tpu.rados.vstart import Cluster
+            from ceph_tpu.tools.ceph import parse_args
+            from ceph_tpu.tools.ceph import run as ceph_run
+
+            cluster = Cluster(n_osds=3, conf={"osd_auto_repair": False},
+                              data_dir=str(tmp_path))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("dfp", pool_type="replicated")
+                await c.put(pool, "obj", b"x" * 100_000)
+                mon = f"{cluster.mons[0].addr[0]}:" \
+                      f"{cluster.mons[0].addr[1]}"
+                capsys.readouterr()
+                rc = await ceph_run(parse_args(
+                    ["--mon", mon, "--format", "json", "osd", "df"]))
+                assert rc == 0
+                rows = _json.loads(capsys.readouterr().out)
+                assert len(rows) == 3
+                assert all(r["status"] == "up" for r in rows)
+                assert all(r["store"] == "BlueStore" for r in rows)
+                # the replicated object's bytes show up as usage
+                assert sum(r.get("used", 0) for r in rows) >= 100_000
+                assert all(r.get("num_objects", 0) >= 1 for r in rows)
+                # a down OSD reports down instead of erroring the sweep
+                victim = sorted(cluster.osds)[0]
+                await cluster.kill_osd(victim)
+                capsys.readouterr()
+                rc = await ceph_run(parse_args(
+                    ["--mon", mon, "--format", "json", "osd", "df"]))
+                rows = _json.loads(capsys.readouterr().out)
+                by_id = {r["id"]: r for r in rows}
+                assert by_id[victim]["status"].startswith(
+                    ("down", "error"))
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        asyncio.run(go())
