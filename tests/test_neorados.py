@@ -64,7 +64,21 @@ class TestWriteOp:
         RETRIED, not swallowed — a failover primary would otherwise
         serve stale omap (RGW bucket indexes ride this path)."""
         async def go():
-            cluster, client, neo, ioc = await _cluster(n_osds=3)
+            # generous heartbeat grace: on a loaded 1-core host, missed
+            # heartbeats mark peers down, and the retry pump (by
+            # design) parks a down peer's queue — that liveness
+            # interplay is another test's subject; THIS test pins the
+            # retry mechanism itself, so peers must stay up
+            cluster = Cluster(n_osds=3, conf={
+                "osd_auto_repair": False,
+                "osd_heartbeat_grace": 300.0})
+            await cluster.start()
+            client = RadosClient(cluster.mon_addrs, CONF)
+            await client.start()
+            pool_id = await client.create_pool("neo",
+                                               pool_type="replicated")
+            neo = RADOS(None, client=client)
+            ioc = IOContext(pool_id)
             try:
                 # land the object first so the acting set is known
                 await neo.execute("robj", ioc,
