@@ -85,7 +85,9 @@ class _TpuDispatch:
             return super()._apply(matrix, regions)
         try:
             from ceph_tpu.ops.gf2 import bucket_columns as _bucket
-            from ceph_tpu.ops.gf2 import gf2_apply_bytes
+            from ceph_tpu.ops.gf2 import (gf2_apply_bytes,
+                                          gf2_apply_packedbit,
+                                          packedbit_enabled)
 
             cache = self._bm_cache()
             key = matrix.tobytes()
@@ -99,9 +101,16 @@ class _TpuDispatch:
             if padded != B:
                 buf = np.zeros((rows, padded), dtype=np.uint8)
                 buf[:, :B] = regions
-            out = gf2_apply_bytes(
-                bm, buf, self.w, out_rows, use_pallas=self._use_pallas(padded)
-            )
+            use_pallas = self._use_pallas(padded)
+            if packedbit_enabled() and self.w == 8 and not use_pallas:
+                # production lane: one fused static-XOR-schedule call,
+                # compiled per matrix behind the gf2 LRU — encode
+                # generators AND decode signature matrices alike (pow2
+                # bucketing keeps B a whole number of u32 words)
+                out = gf2_apply_packedbit(bm, buf)
+            else:
+                out = gf2_apply_bytes(
+                    bm, buf, self.w, out_rows, use_pallas=use_pallas)
             return np.asarray(out)[:, :B]
         except Exception as e:  # any device/compile failure -> CPU fallback
             self._mark_failed(e)
@@ -113,7 +122,25 @@ class _TpuDispatch:
             return super()._apply_rows(bm, rows)
         try:
             from ceph_tpu.ops.gf2 import bucket_columns as _bucket
-            from ceph_tpu.ops.gf2 import gf2_apply_packets
+            from ceph_tpu.ops.gf2 import (gf2_apply_packets, gf2_xor_packed,
+                                          packedbit_enabled)
+
+            if packedbit_enabled():
+                # production lane for the bitmatrix (cauchy/liberation)
+                # family: a packet-row combine IS a GF(2) XOR of whole
+                # rows, so the static XOR schedule applies DIRECTLY to
+                # the packet bytes — no 8x bit expansion at all (this is
+                # jerasure_schedule_encode's shape, compiled by XLA).
+                R, nb, p = rows.shape
+                flat = np.ascontiguousarray(rows.reshape(R, nb * p))
+                padded = _bucket(flat.shape[1])
+                if padded != flat.shape[1]:
+                    buf = np.zeros((R, padded), dtype=np.uint8)
+                    buf[:, :flat.shape[1]] = flat
+                    flat = buf
+                out = np.asarray(gf2_xor_packed(
+                    np.asarray(bm, dtype=np.uint8), flat))
+                return out[:, :nb * p].reshape(bm.shape[0], nb, p)
 
             w, p = self.w, self.packetsize
             R, nb, _ = rows.shape

@@ -27,6 +27,16 @@ when they enter or leave the device tier (the reference's analog is the
 buffer staying in L2/registers across ECUtil::encode's per-stripe loop,
 reference src/osd/ECUtil.cc:123-160; on a TPU the "stay resident" scope
 is HBM across whole pipeline stages).
+
+PACKED-BIT PRODUCTION LANE (the measured 1.45x over int8 planes,
+ceph_tpu/ops/gf2.py lane-promotion writeup): for w=8 byte-layout codes
+the resident trio has a u32-word mirror — `submit_packedbit` (bytes in,
+bytes out), `submit_packedbit_resident` (bytes in, parity bytes + u32
+planes out), `submit_packedbit_planes` (resident planes in/out) — each
+dispatch running the matrix as a static XOR schedule compiled per matrix
+(encode generators and decode signatures alike) behind the gf2 LRU.
+Residents store at 1 HBM byte per data byte instead of 8, so the same
+store budget holds 8x the objects.
 """
 
 from __future__ import annotations
@@ -47,8 +57,11 @@ class _Group:
     w: int
     out_rows: int
     # dispatch lane: "packed" (unpack+matmul+pack fused per dispatch),
-    # "planar" (matmul-only over resident bit-planes), "resident"
-    # (packed in -> packed parity + planar rows out, the write path)
+    # "planar" (matmul-only over resident int8 bit-planes), "resident"
+    # (packed in -> packed parity + planar rows out, the write path);
+    # plus the packed-bit production trio mirroring them over u32 plane
+    # words + static XOR schedules (ceph_tpu/ops/gf2.py lane promotion):
+    # "packedbit", "packedbit_planes", "packedbit_resident"
     kind: str = "packed"
     requests: List[Tuple[Any, Future]] = field(default_factory=list)
     pending_bytes: int = 0
@@ -143,6 +156,50 @@ class BatchingQueue:
         concurrent ops coalesce exactly like the packed lane."""
         return self._submit(mbits, rows, w, out_rows, "resident")
 
+    # -- packed-bit lanes (the production w=8 trio, ceph_tpu/ops/gf2.py
+    #    lane-promotion writeup: u32-word bit-planes + static XOR
+    #    schedules compiled per matrix behind the LRU) ----------------------
+
+    def submit_packedbit(
+        self, mbits: np.ndarray, regions: np.ndarray, w: int, out_rows: int
+    ) -> "Future[np.ndarray]":
+        """Queue a [out_rows*8, n*8] GF(2) bit-matrix over packed [n, B]
+        uint8 rows through the packed-bit XOR-schedule lane (one fused
+        unpack -> u32 words -> schedule -> byte pack device call per
+        coalesced group); resolves to the [out_rows, B] parity or
+        reconstruction buffer.  Encode generators AND per-decode-
+        signature matrices both land here — each matrix is its own
+        dispatch group and its own LRU-cached compiled schedule."""
+        assert w == 8, "packed-bit lane is the w=8 byte-layout lane"
+        return self._submit(mbits, regions, w, out_rows, "packedbit")
+
+    def submit_packedbit_resident(
+        self, mbits: np.ndarray, rows: np.ndarray, w: int, out_rows: int
+    ) -> "Future[object]":
+        """Packed-bit residency WRITE path: packed [n, B] uint8 rows in
+        (B % 32 == 0), resolves to (packed_parity np [out_rows, B],
+        all_planes u32 [(n+out_rows)*8, B//32]) — parity bytes for
+        persistence, u32 plane words to stay HBM-resident at 1/8th the
+        int8-plane footprint."""
+        assert w == 8, "packed-bit lane is the w=8 byte-layout lane"
+        if rows.shape[1] % 32:
+            # reject at SUBMISSION: a misaligned request that reached
+            # launch would fail every innocent request coalesced with it
+            raise ValueError(
+                "packedbit_resident requests must be 32-byte-column "
+                f"aligned, got width {rows.shape[1]}")
+        return self._submit(mbits, rows, w, out_rows, "packedbit_resident")
+
+    def submit_packedbit_planes(
+        self, mbits: np.ndarray, planes, w: int, out_rows: int
+    ) -> "Future[object]":
+        """Queue an XOR schedule over ALREADY-RESIDENT u32 plane words
+        ([rows*8, Wc] uint32); resolves to the [out_rows*8, Wc] device
+        buffer — no pack, the result stays resident for the next stage
+        (the packed-bit mirror of submit_planar)."""
+        assert w == 8, "packed-bit lane is the w=8 byte-layout lane"
+        return self._submit(mbits, planes, w, out_rows, "packedbit_planes")
+
     def _submit(self, mbits, regions, w, out_rows, kind) -> Future:
         fut: Future = Future()
         # the full dispatch signature: identical matrix BYTES under a
@@ -187,8 +244,13 @@ class BatchingQueue:
     @staticmethod
     def _req_bytes(kind: str, mbits: np.ndarray, regions) -> int:
         # flush thresholds are tuned in PACKED bytes (see _submit)
-        return (regions.shape[1] * mbits.shape[1] // 8
-                if kind == "planar" else regions.nbytes)
+        if kind == "planar":
+            return regions.shape[1] * mbits.shape[1] // 8
+        if kind == "packedbit_planes":
+            # u32 plane words carry exactly 1 bit/bit: total plane bytes
+            # == packed bytes (the layout's whole point)
+            return int(regions.shape[0]) * int(regions.shape[1]) * 4
+        return regions.nbytes
 
     def _take_locked(self, budget: Optional[int] = None) -> List[_Group]:
         """Detach queued work for one round.  With a `budget`, the round
@@ -302,6 +364,12 @@ class BatchingQueue:
                     state = self._launch_planar(g)
                 elif g.kind == "resident":
                     state = self._launch_resident(g)
+                elif g.kind == "packedbit":
+                    state = self._launch_packedbit(g)
+                elif g.kind == "packedbit_resident":
+                    state = self._launch_packedbit_resident(g)
+                elif g.kind == "packedbit_planes":
+                    state = self._launch_packedbit_planes(g)
                 else:
                     state = self._launch_packed(g)
                 launched.append((g, state))
@@ -318,7 +386,13 @@ class BatchingQueue:
                     self._complete_planar(g, state)
                 elif g.kind == "resident":
                     self._complete_resident(g, state)
+                elif g.kind == "packedbit_resident":
+                    self._complete_packedbit_resident(g, state)
+                elif g.kind == "packedbit_planes":
+                    self._complete_packedbit_planes(g, state)
                 else:
+                    # "packed" and "packedbit": both fan packed uint8
+                    # byte columns back out
                     self._complete_packed(g, state)
             except Exception as e:
                 self._fail_group(g, e)
@@ -336,16 +410,25 @@ class BatchingQueue:
         self._complete_safe(self._launch_safe(groups))
 
 
-    def _maybe_shard(self, batch, pad_np: bool):
+    def _maybe_shard(self, batch, pad_np: bool, align: int = 1):
         """Lay a dispatch batch across the mesh when one is attached.
         Columns pad out to a device-grid multiple (bucket_columns gives
         powers of two, which a 6-device grid would never divide) — the
         pad is zeros beyond every request's slice, so fan-out offsets
-        are unaffected.  Returns (batch, sharded)."""
+        are unaffected.  `align` additionally rounds the padded width to
+        a multiple of lcm(grid, align): the packed-bit lanes need whole
+        u32 words per plane row (align=32) even after grid padding.
+        Returns (batch, sharded)."""
         if self.mesh is None:
             return batch, False
         try:
             want = self.mesh.pad_cols(batch.shape[1])
+            if align > 1:
+                import math
+
+                lcm = (align * self.mesh.n_devices
+                       // math.gcd(align, self.mesh.n_devices))
+                want = -(-want // lcm) * lcm
             if want != batch.shape[1]:
                 extra = want - batch.shape[1]
                 if pad_np:
@@ -358,11 +441,15 @@ class BatchingQueue:
         except Exception:
             return batch, False  # sick mesh: single-device still serves
 
-    def _launch_packed(self, g: _Group):
+    def _stage_packed_batch(self, g: _Group, align: int = 1):
+        """The shared launch preamble for packed-byte request groups:
+        coalesce the requests column-wise, pow2-bucket the width (bounds
+        XLA recompiles), shard across the mesh when one is attached, and
+        otherwise start the H2D transfer NOW so it overlaps the previous
+        round's result fetch.  Returns (widths, batch, sharded, nbytes)."""
         import jax
 
         from ceph_tpu.ops.gf2 import bucket_columns as _bucket
-        from ceph_tpu.ops.gf2 import gf2_apply_bytes
 
         widths = [r.shape[1] for r, _ in g.requests]
         batch = np.concatenate([r for r, _ in g.requests], axis=1)
@@ -370,11 +457,15 @@ class BatchingQueue:
         if pad:
             batch = np.pad(batch, ((0, 0), (0, pad)))
         nbytes = batch.nbytes
-        batch, sharded = self._maybe_shard(batch, pad_np=True)
+        batch, sharded = self._maybe_shard(batch, pad_np=True, align=align)
         if not sharded:
-            # explicit async staging: the H2D transfer starts NOW and
-            # overlaps the previous round's result fetch
-            batch = jax.device_put(batch)
+            batch = jax.device_put(batch)  # async H2D staging
+        return widths, batch, sharded, nbytes
+
+    def _launch_packed(self, g: _Group):
+        from ceph_tpu.ops.gf2 import gf2_apply_bytes
+
+        widths, batch, sharded, nbytes = self._stage_packed_batch(g)
         use_pallas = self._use_pallas and not sharded
         if use_pallas is None:
             from ceph_tpu.ops.gf2 import pallas_enabled
@@ -454,23 +545,12 @@ class BatchingQueue:
         concatenated packed rows, matmul, pack the parity — and fan both
         products out per request: (packed parity for persistence, planar
         rows to stay HBM-resident)."""
-        import jax
-
-        from ceph_tpu.ops.gf2 import bucket_columns as _bucket
         from ceph_tpu.ops.gf2 import gf2_encode_resident
 
-        widths = [r.shape[1] for r, _ in g.requests]
-        batch = np.concatenate([r for r, _ in g.requests], axis=1)
-        pad = _bucket(batch.shape[1]) - batch.shape[1]
-        if pad:
-            batch = np.pad(batch, ((0, 0), (0, pad)))
-        nbytes = batch.nbytes
-        batch, sharded = self._maybe_shard(batch, pad_np=True)
+        widths, batch, sharded, nbytes = self._stage_packed_batch(g)
         # AFTER any mesh grid-padding: the planar fan-out factor must
         # relate all_bits' columns to the columns the matmul actually saw
         cols = batch.shape[1]
-        if not sharded:
-            batch = jax.device_put(batch)  # async H2D staging
         packed, all_bits = gf2_encode_resident(
             g.mbits, batch, g.w, g.out_rows)
         return widths, packed, all_bits, sharded, nbytes, cols
@@ -489,6 +569,87 @@ class BatchingQueue:
                 c0, c1 = int(off * cfac), int((off + width) * cfac)
                 fut.set_result((packed[:, off : off + width].copy(),
                                 all_bits[:, c0:c1]))
+            except InvalidStateError:
+                pass
+            off += width
+
+    # -- packed-bit lanes (u32 plane words + static XOR schedules) -----------
+
+    def _launch_packedbit(self, g: _Group):
+        """One fused schedule call over the coalesced packed rows:
+        unpack -> u32 words -> XOR schedule -> byte pack, compiled per
+        matrix behind the gf2 LRU.  Fan-out is byte columns, so requests
+        of ANY width coalesce (pow2 bucketing keeps B % 32 == 0)."""
+        from ceph_tpu.ops.gf2 import gf2_apply_packedbit
+
+        widths, batch, sharded, nbytes = self._stage_packed_batch(g, align=32)
+        out = gf2_apply_packedbit(g.mbits, batch)
+        return widths, out, sharded, nbytes
+
+    # completion: _complete_packed (identical packed-byte fan-out)
+
+    def _launch_packedbit_resident(self, g: _Group):
+        """Packed-bit residency write path: one fused batched call, both
+        products fanned out per request — packed parity bytes for
+        persistence, u32 plane words to stay HBM-resident.  Request
+        widths must be whole u32 words (B % 32 == 0) so the plane
+        fan-out slices stay word-aligned; submit_packedbit_resident
+        rejects misaligned requests before they can coalesce."""
+        from ceph_tpu.ops.gf2 import gf2_encode_packedbit_resident
+
+        widths, batch, sharded, nbytes = self._stage_packed_batch(g, align=32)
+        packed, planes = gf2_encode_packedbit_resident(g.mbits, batch)
+        return widths, packed, planes, sharded, nbytes
+
+    def _complete_packedbit_resident(self, g: _Group, state) -> None:
+        widths, packed, planes, sharded, nbytes = state
+        packed = np.asarray(packed)  # blocks until ready
+        self.dispatches += 1
+        self.sharded_dispatches += 1 if sharded else 0
+        self.bytes_dispatched += nbytes
+        off = 0
+        for width, (_, fut) in zip(widths, g.requests):
+            try:
+                # 32 byte columns per u32 plane word (integer exact: the
+                # launch asserted width % 32 == 0)
+                fut.set_result((packed[:, off : off + width].copy(),
+                                planes[:, off // 32 : (off + width) // 32]))
+            except InvalidStateError:
+                pass
+            off += width
+
+    def _launch_packedbit_planes(self, g: _Group):
+        """Schedule-only dispatch over resident u32 plane words — the
+        packed-bit mirror of the planar lane: results stay device-side
+        plane buffers, chaining without a host bounce."""
+        import jax.numpy as jnp
+
+        from ceph_tpu.ops.gf2 import bucket_columns as _bucket
+        from ceph_tpu.ops.gf2 import gf2_xor_packed
+
+        widths = [b.shape[1] for b, _ in g.requests]  # u32 words
+        batch = (g.requests[0][0] if len(g.requests) == 1
+                 else jnp.concatenate([b for b, _ in g.requests], axis=1))
+        # pow2 word bucketing (lo=32 words == the byte lanes' 1024 cols)
+        pad = _bucket(batch.shape[1], lo=32) - batch.shape[1]
+        if pad:
+            batch = jnp.pad(batch, ((0, 0), (0, pad)))
+        batch, sharded = self._maybe_shard(batch, pad_np=False)
+        out = gf2_xor_packed(g.mbits, batch)
+        return widths, out, sharded
+
+    def _complete_packedbit_planes(self, g: _Group, state) -> None:
+        widths, out, sharded = state
+        self.dispatches += 1
+        self.sharded_dispatches += 1 if sharded else 0
+        # u32 plane words carry 1 bit/bit, so plane bytes == packed-
+        # equivalent bytes (same arithmetic as _req_bytes: C rows x Wc
+        # words x 4 B/word; no 8x int8 expansion to divide back out)
+        self.bytes_dispatched += sum(widths) * 4 * g.mbits.shape[1]
+        off = 0
+        for width, (_, fut) in zip(widths, g.requests):
+            try:
+                fut.set_result(out[:, off : off + width])  # stays resident
             except InvalidStateError:
                 pass
             off += width
@@ -522,6 +683,7 @@ class PlanarShardStore:
         self._lock = make_mutex("planar-store")
         self._entries: "OrderedDict[Any, Any]" = OrderedDict()
         self._bytes: Dict[Any, int] = {}
+        self._trim: Dict[Any, int] = {}  # packedbit admits: pre-pad width
         self.resident_bytes = 0
         self.admits = 0
         self.hits = 0
@@ -531,46 +693,81 @@ class PlanarShardStore:
     # -- host boundary (pack/unpack paid here, once) -------------------------
 
     def admit(self, key: Any, rows: np.ndarray, w: int = 8,
-              meta: Any = None):
+              meta: Any = None, layout: str = "planes"):
         """Unpack packed [n, B] uint8 rows onto the device and keep them
-        planar under `key`.  Returns the planar device buffer."""
-        from ceph_tpu.ops.gf2 import to_planar
+        resident under `key`.  Returns the resident device buffer.
+        layout="planes" stores int8 bit-planes (any w); "packedbit"
+        stores u32 plane words (w=8 only, 1/8th the footprint — the
+        production lane), padding B out to whole words and trimming on
+        read."""
+        if layout == "packedbit":
+            from ceph_tpu.ops.gf2 import to_packedbit
 
-        bits = to_planar(np.ascontiguousarray(rows), w)
-        self.put_planar(key, bits, w=w, n_rows=rows.shape[0], meta=meta)
+            assert w == 8, "packed-bit residency is the w=8 byte layout"
+            B = rows.shape[1]
+            buf = np.ascontiguousarray(rows)
+            if B % 32:
+                buf = np.pad(buf, ((0, 0), (0, 32 - B % 32)))
+            bits = to_packedbit(buf)
+            self.put_planar(key, bits, w=w, n_rows=rows.shape[0], meta=meta,
+                            trim=B)
+        else:
+            from ceph_tpu.ops.gf2 import to_planar
+
+            bits = to_planar(np.ascontiguousarray(rows), w)
+            self.put_planar(key, bits, w=w, n_rows=rows.shape[0], meta=meta)
         self.admits += 1
         return bits
 
     def read(self, key: Any) -> Optional[np.ndarray]:
-        """Pack the resident planar rows back to [n, B] uint8 host bytes —
-        the EXIT boundary.  None when not resident."""
-        from ceph_tpu.ops.gf2 import from_planar
-
+        """Pack the resident rows back to [n, B] uint8 host bytes — the
+        EXIT boundary.  None when not resident.  Handles both layouts
+        (entry dtype tells them apart: uint32 words vs int8 planes)."""
         got = self.get_planar(key)
         if got is None:
             return None
         bits, w, n_rows, _meta = got
+        if np.dtype(bits.dtype) == np.uint32:
+            from ceph_tpu.ops.gf2 import from_packedbit
+
+            out = np.asarray(from_packedbit(bits, n_rows))
+            with self._lock:
+                trim = self._trim.get(key)
+            return out if trim is None else out[:, :trim]
+        from ceph_tpu.ops.gf2 import from_planar
+
         return np.asarray(from_planar(bits, w, n_rows))
 
     # -- resident side (no pack/unpack anywhere below) -----------------------
 
     def put_planar(self, key: Any, bits, w: int = 8,
-                   n_rows: Optional[int] = None, meta: Any = None) -> None:
+                   n_rows: Optional[int] = None, meta: Any = None,
+                   trim: Optional[int] = None) -> None:
         """`meta` is caller state carried with the entry (the OSD stores
-        the object VERSION there, so a read can reject a stale resident)."""
+        the object VERSION there, so a read can reject a stale resident).
+        `trim` is the pre-pad byte width of a packed-bit admit, installed
+        under the same lock as the entry so a concurrent read never sees
+        the entry without its trim."""
         if n_rows is None:
             n_rows = bits.shape[0] // w
-        nbytes = int(np.prod(bits.shape))  # int8 planes: 1 byte/element
+        # HBM footprint by element width: int8 planes are 1 B/element
+        # (8x the packed bytes), u32 packed-bit words 4 B/element (1x)
+        nbytes = int(np.prod(bits.shape)) * np.dtype(bits.dtype).itemsize
         with self._lock:
             if key in self._entries:
                 self.resident_bytes -= self._bytes[key]
             self._entries[key] = (bits, w, n_rows, meta)
             self._entries.move_to_end(key)
             self._bytes[key] = nbytes
+            if trim is None:
+                self._trim.pop(key, None)  # re-put resets admit-time trim
+            else:
+                self._trim[key] = trim
             self.resident_bytes += nbytes
             while self.resident_bytes > self.capacity_bytes and self._entries:
                 old_key, _ = self._entries.popitem(last=False)
                 self.resident_bytes -= self._bytes.pop(old_key)
+                self._trim.pop(old_key, None)
                 self.evictions += 1
 
     def get_planar(self, key: Any):
@@ -596,7 +793,19 @@ class PlanarShardStore:
         if got is None:
             return None
         bits, w, _, _meta = got
-        if self.queue is not None:
+        if np.dtype(bits.dtype) == np.uint32:
+            # packed-bit resident: the matrix runs as a static XOR
+            # schedule over the u32 plane words (compiled per matrix
+            # behind the gf2 LRU — decode signatures included)
+            mb = np.asarray(mbits, dtype=np.uint8)
+            if self.queue is not None:
+                out = self.queue.submit_packedbit_planes(
+                    mb, bits, w, out_rows).result()
+            else:
+                from ceph_tpu.ops.gf2 import gf2_xor_packed
+
+                out = gf2_xor_packed(mb, bits)
+        elif self.queue is not None:
             out = self.queue.submit_planar(
                 np.asarray(mbits), bits, w, out_rows).result()
         else:
@@ -614,6 +823,7 @@ class PlanarShardStore:
             if key in self._entries:
                 del self._entries[key]
                 self.resident_bytes -= self._bytes.pop(key)
+                self._trim.pop(key, None)
 
     def __contains__(self, key: Any) -> bool:
         with self._lock:

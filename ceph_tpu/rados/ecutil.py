@@ -154,6 +154,15 @@ def _mapped_shard_list(codec, data_rows: np.ndarray,
     return out  # type: ignore[return-value]
 
 
+def _packedbit_route(codec) -> bool:
+    """Whether this codec's queue plans ride the packed-bit XOR-schedule
+    lane (the w=8 production lane, ceph_tpu/ops/gf2.py lane-promotion
+    writeup) instead of the int8-plane lanes."""
+    from ceph_tpu.ops.gf2 import packedbit_enabled
+
+    return packedbit_enabled() and getattr(codec, "w", 8) == 8
+
+
 def _queue_encode_plan(codec, sinfo: StripeInfo, arr: np.ndarray,
                        n_stripes: int, queue):
     """When the codec/queue combination is batchable (byte-layout bit
@@ -169,11 +178,15 @@ def _queue_encode_plan(codec, sinfo: StripeInfo, arr: np.ndarray,
     n = codec.get_chunk_count()
     m = n - k
     w = getattr(codec, "w", 8)
-    mbits = np.asarray(mbits).astype(np.int8)
     # columns = stripes concatenated; one submit -> one device call
     flat = np.ascontiguousarray(
         arr.transpose(1, 0, 2).reshape(k, n_stripes * sinfo.chunk_size))
-    fut = queue.submit(mbits, flat, w, m)
+    if _packedbit_route(codec):
+        # production lane: static XOR schedule over u32 plane words
+        fut = queue.submit_packedbit(
+            np.asarray(mbits).astype(np.uint8), flat, w, m)
+    else:
+        fut = queue.submit(np.asarray(mbits).astype(np.int8), flat, w, m)
 
     def reassemble(parity: np.ndarray) -> List[np.ndarray]:
         p = np.asarray(parity).reshape(m, n_stripes, sinfo.chunk_size)
@@ -303,9 +316,18 @@ def _queue_decode_plan(codec, sinfo: StripeInfo,
     # the matmul shrinks from k rows to n_lost — same trimming the codec
     # CPU path does, so queue and CPU decode stay work-equivalent
     missing = sorted(c for c in range(k) if c not in arrays)
-    inv_bm = matrix_to_bitmatrix(inv[missing], codec.w).astype(np.int8)
+    inv_bm = matrix_to_bitmatrix(inv[missing], codec.w)
     src = np.ascontiguousarray(np.stack([arrays[c] for c in chosen]))
-    fut = queue.submit(inv_bm, src, codec.w, len(missing))
+    if _packedbit_route(codec):
+        # decode rides the production packed-bit lane: the inverted
+        # signature matrix compiles to its own static XOR schedule
+        # behind the gf2 LRU (per-decode-signature compilation — the
+        # ErasureCodeIsaTableCache design at compile scope)
+        fut = queue.submit_packedbit(
+            inv_bm.astype(np.uint8), src, codec.w, len(missing))
+    else:
+        fut = queue.submit(inv_bm.astype(np.int8), src, codec.w,
+                           len(missing))
 
     def finish(rows: np.ndarray) -> bytes:
         rebuilt = np.asarray(rows)
@@ -409,13 +431,17 @@ async def decode_object_async(codec, sinfo: StripeInfo,
 
 # -- bit-planar residency (ceph_tpu/parallel/service.py PlanarShardStore) ----
 #
-# The measured ~1.6x win (ops/gf2.py writeup): shards stay in HBM as int8
+# The measured ~1.6x win (ops/gf2.py writeup): shards stay in HBM as
 # bit-planes across encode -> decode -> recovery, and the pack/unpack
 # boundary is paid once, when bytes enter or leave the device tier.  The
 # reference's per-stripe hot loop (src/osd/ECUtil.cc:123-160) keeps its
 # buffer cache-resident for one stripe; residency here spans pipeline
 # stages.  Byte-layout, unmapped, concat-safe codecs only — the same
-# eligibility as the batching-queue encode plan.
+# eligibility as the batching-queue encode plan.  For w=8 codecs the
+# resident layout is PACKED-BIT u32 words (the production lane, 1 HBM
+# byte per data byte and the measured 1.45x XOR-schedule kernel);
+# w=16/w=4 pools keep int8 planes.  planar_rows/planar_object_bytes tell
+# the layouts apart by the resident's dtype.
 
 
 def planar_eligible(codec) -> bool:
@@ -454,19 +480,33 @@ async def planar_encode_async(codec, sinfo: StripeInfo, data: bytes,
         .reshape(n_stripes, k, sinfo.chunk_size)
         .transpose(1, 0, 2).reshape(k, n_stripes * sinfo.chunk_size))
     L = flat.shape[1]
-    mbits = np.asarray(codec.bit_generator()).astype(np.int8)
-    if queue is not None:
-        parity, all_bits = await asyncio.wrap_future(
-            queue.submit_resident(mbits, flat, w, m))
+    # the packed-bit production lane needs whole u32 words per plane row
+    # (w=8 byte codecs guarantee it: chunk_size is a multiple of w*4=32)
+    packedbit = _packedbit_route(codec) and L % 32 == 0
+    if packedbit:
+        mbits = np.asarray(codec.bit_generator()).astype(np.uint8)
     else:
-        from ceph_tpu.ops.gf2 import bucket_columns, gf2_encode_resident
+        mbits = np.asarray(codec.bit_generator()).astype(np.int8)
+    if queue is not None:
+        if packedbit:
+            parity, all_bits = await asyncio.wrap_future(
+                queue.submit_packedbit_resident(mbits, flat, w, m))
+        else:
+            parity, all_bits = await asyncio.wrap_future(
+                queue.submit_resident(mbits, flat, w, m))
+    else:
+        from ceph_tpu.ops.gf2 import (bucket_columns, gf2_encode_resident,
+                                      gf2_encode_packedbit_resident)
 
         Lb = bucket_columns(L)  # pow2 bucketing bounds XLA recompiles
         buf = flat
         if Lb != L:
             buf = np.zeros((k, Lb), dtype=np.uint8)
             buf[:, :L] = flat
-        parity, all_bits = gf2_encode_resident(mbits, buf, w, m)
+        if packedbit:
+            parity, all_bits = gf2_encode_packedbit_resident(mbits, buf)
+        else:
+            parity, all_bits = gf2_encode_resident(mbits, buf, w, m)
         parity = np.asarray(parity)
     parity = parity[:, :L]
     blobs = [flat[i] for i in range(k)] + [parity[j] for j in range(m)]
@@ -484,10 +524,16 @@ def planar_rows(store, key, version) -> Optional[List[np.ndarray]]:
     bits, w, n_rows, meta = got
     if not meta or meta[0] != version:
         return None
-    from ceph_tpu.ops.gf2 import from_planar
-
     L = meta[1]
-    rows = np.asarray(from_planar(bits, w, n_rows))[:, :L]
+    if np.dtype(bits.dtype) == np.uint32:
+        # packed-bit resident (u32 plane words, the production lane)
+        from ceph_tpu.ops.gf2 import from_packedbit
+
+        rows = np.asarray(from_packedbit(bits, n_rows))[:, :L]
+    else:
+        from ceph_tpu.ops.gf2 import from_planar
+
+        rows = np.asarray(from_planar(bits, w, n_rows))[:, :L]
     return [rows[i] for i in range(n_rows)]
 
 
@@ -502,11 +548,16 @@ def planar_object_bytes(store, key, version, k: int, cs: int,
     bits, w, n_rows, meta = got
     if not meta or meta[0] != version:
         return None
-    from ceph_tpu.ops.gf2 import from_planar
-
     L = meta[1]
     data_bits = bits[:k * w]
-    rows = np.asarray(from_planar(data_bits, w, k))[:, :L]
+    if np.dtype(bits.dtype) == np.uint32:
+        from ceph_tpu.ops.gf2 import from_packedbit
+
+        rows = np.asarray(from_packedbit(data_bits, k))[:, :L]
+    else:
+        from ceph_tpu.ops.gf2 import from_planar
+
+        rows = np.asarray(from_planar(data_bits, w, k))[:, :L]
     n_stripes = max(1, L // cs)
     out = rows.reshape(k, n_stripes, cs).transpose(1, 0, 2)
     return out.reshape(-1)[:object_size].tobytes()

@@ -9,8 +9,12 @@ src/test/erasure-code/ceph_erasure_code_benchmark.cc:40-144): prints
         --size 1048576 --iterations 16 --workload encode
 
 Workloads: encode (timed encode loop), decode (encode once, then timed
-decode with random | --erased | exhaustive erasure generation; exhaustive
-mode verifies recovered content, ceph_erasure_code_benchmark.cc:202-316).
+decode with random | --erased | exhaustive erasure generation).  Every
+decode mode verifies recovered content: exhaustive checks inline
+(ceph_erasure_code_benchmark.cc:202-316); random and --erased collect the
+erasure signatures the timed loop exercised and re-decode each distinct
+one AFTER the loop (outside the timed window), so the CLI cannot report
+a fast-but-wrong decode.
 """
 
 from __future__ import annotations
@@ -92,6 +96,28 @@ def decode_exhaustive(codec, encoded, erasures: int) -> int:
     return 0
 
 
+#: post-loop verification re-decodes at most this many distinct erasure
+#: signatures (random mode can touch many over a long run; the content
+#: check must stay O(signatures), not O(iterations))
+VERIFY_SIGNATURE_CAP = 64
+
+
+def verify_signatures(codec, encoded_full, signatures, chunk_size) -> int:
+    """Re-decode each erasure signature outside the timed window and
+    compare recovered content against the originally encoded chunks —
+    the content check the reference only performs in exhaustive mode,
+    applied to the random/--erased workloads' signature set."""
+    for combo in signatures:
+        available = {c: b for c, b in encoded_full.items() if c not in combo}
+        decoded = codec.decode(set(combo), available, chunk_size)
+        for c in combo:
+            if not np.array_equal(decoded[c], encoded_full[c]):
+                print(f"chunk {c} content and recovered content are different",
+                      file=sys.stderr)
+                return 1
+    return 0
+
+
 def bench_decode(codec, args) -> int:
     n = codec.get_chunk_count()
     data = b"X" * args.size
@@ -99,10 +125,12 @@ def bench_decode(codec, args) -> int:
     chunk_size = len(next(iter(encoded.values())))
     want = set(range(n))
     erased = args.erased or []
+    encoded_full = dict(encoded)  # pre-erasure originals for verification
     if erased:
         for c in erased:
             encoded.pop(c, None)
 
+    seen_signatures = set()
     begin = time.perf_counter()
     for _ in range(args.iterations):
         if args.erasures_generation == "exhaustive":
@@ -111,6 +139,7 @@ def bench_decode(codec, args) -> int:
                 return code
         elif erased:
             codec.decode(want, encoded, chunk_size)
+            seen_signatures.add(tuple(sorted(erased)))
         else:
             chunks = dict(encoded)
             for _ in range(args.erasures):
@@ -119,8 +148,16 @@ def bench_decode(codec, args) -> int:
                     if erasure in chunks:
                         break
                 del chunks[erasure]
+            seen_signatures.add(tuple(sorted(set(encoded) - set(chunks))))
             codec.decode(want, chunks, chunk_size)
     elapsed = time.perf_counter() - begin
+    # content check (outside the timed window): every distinct signature
+    # the loop decoded, capped so verification stays bounded
+    code = verify_signatures(
+        codec, encoded_full,
+        sorted(seen_signatures)[:VERIFY_SIGNATURE_CAP], chunk_size)
+    if code:
+        return code
     print(f"{elapsed:f}\t{args.iterations * (args.size // 1024)}")
     return 0
 

@@ -42,3 +42,27 @@ def test_corpus_replays_byte_identical(profile_dir):
 def test_corpus_is_populated():
     dirs = corpus_dirs()
     assert len(dirs) >= 6, f"committed corpus shrank: {dirs}"
+
+
+def test_tpu_corpus_replays_on_both_lanes():
+    """The plugin=tpu archive must replay byte-identically (encode AND
+    1/2-erasure decode) on BOTH dispatch lanes: the packed-bit
+    XOR-schedule production lane and the int8-plane fallback
+    (CEPH_TPU_PACKEDBIT=0) — the lane promotion must not fork the wire
+    bytes."""
+    tpu_dirs = [d for d in corpus_dirs() if d.startswith("plugin=tpu")]
+    assert tpu_dirs, "committed corpus lost its plugin=tpu archive"
+    for flag in ("1", "0"):
+        for profile_dir in tpu_dirs:
+            parts = profile_dir.split()
+            args = [sys.executable, "-m", "ceph_tpu.tools.non_regression",
+                    "--check", "--base", CORPUS, "--plugin", "tpu",
+                    "--stripe-width", parts[1].split("=", 1)[1]]
+            for kv in parts[2:]:
+                args += ["-P", kv]
+            env = dict(os.environ, CEPH_TPU_PACKEDBIT=flag)
+            res = subprocess.run(args, capture_output=True, text=True,
+                                 timeout=300, env=env)
+            assert res.returncode == 0, \
+                f"tpu corpus replay FAILED (packedbit={flag}) for " \
+                f"{profile_dir}:\n{res.stdout}\n{res.stderr}"

@@ -187,3 +187,98 @@ class TestQueuePaths:
             ref = batched_encode(c, s, b, queue=None)
             for a, r in zip(out, ref):
                 assert np.array_equal(np.asarray(a), np.asarray(r))
+
+
+class TestPackedbitQueuePaths:
+    """The packed-bit production lane through the ecutil plans
+    (ops/gf2.py lane promotion): w=8 codec dispatch routes to the
+    XOR-schedule queue lanes, byte-identical to the CPU path, with the
+    int8-plane lanes behind the CEPH_TPU_PACKEDBIT=0 kill switch."""
+
+    def test_encode_plan_routes_packedbit(self, monkeypatch):
+        from ceph_tpu.parallel.service import BatchingQueue
+
+        c = codec(k=4, m=2)
+        s = StripeInfo(k=4, stripe_width=4 * 2048)
+        data = os.urandom(16 * 4 * 2048 - 100)
+        want = batched_encode(c, s, data, queue=None)
+        q = BatchingQueue(max_delay=0.001)
+        calls = []
+        real = q.submit_packedbit
+        monkeypatch.setattr(
+            q, "submit_packedbit",
+            lambda *a, **kw: (calls.append(1), real(*a, **kw))[1])
+        try:
+            got = batched_encode(c, s, data, queue=q)
+            assert calls, "encode plan did not ride the packed-bit lane"
+            assert q.dispatches == 1
+        finally:
+            q.close()
+        for a, b in zip(got, want):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_decode_plan_routes_packedbit(self, monkeypatch):
+        from ceph_tpu.parallel.service import BatchingQueue
+        from ceph_tpu.rados.ecutil import decode_object
+
+        c = codec(k=4, m=2)
+        s = StripeInfo(k=4, stripe_width=4 * 2048)
+        data = os.urandom(5 * 4 * 2048 - 333)
+        blobs = batched_encode(c, s, data, queue=None)
+        avail = {i: np.asarray(b) for i, b in enumerate(blobs)
+                 if i not in (1, 3)}
+        q = BatchingQueue(max_delay=0.001)
+        calls = []
+        real = q.submit_packedbit
+        monkeypatch.setattr(
+            q, "submit_packedbit",
+            lambda *a, **kw: (calls.append(1), real(*a, **kw))[1])
+        try:
+            got = decode_object(c, s, dict(avail), len(data), queue=q)
+            assert calls, "decode plan did not ride the packed-bit lane"
+        finally:
+            q.close()
+        assert got == data
+
+    def test_packedbit_kill_switch_pins_int8_lane(self, monkeypatch):
+        from ceph_tpu.parallel.service import BatchingQueue
+
+        monkeypatch.setenv("CEPH_TPU_PACKEDBIT", "0")
+        c = codec(k=4, m=2)
+        s = StripeInfo(k=4, stripe_width=4 * 2048)
+        data = os.urandom(4 * 4 * 2048)
+        want = batched_encode(c, s, data, queue=None)
+        q = BatchingQueue(max_delay=0.001)
+        monkeypatch.setattr(
+            q, "submit_packedbit",
+            lambda *a, **kw: (_ for _ in ()).throw(
+                AssertionError("packed-bit lane used while disabled")))
+        try:
+            got = batched_encode(c, s, data, queue=q)
+        finally:
+            q.close()
+        for a, b in zip(got, want):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_w16_stays_off_the_packedbit_lane(self, monkeypatch):
+        """Packed-bit is the w=8 byte-layout lane; w=16 pools must keep
+        riding the int8-plane lanes."""
+        from ceph_tpu.parallel.service import BatchingQueue
+
+        c = registry.factory("jerasure", "", {
+            "plugin": "jerasure", "technique": "reed_sol_van",
+            "k": "3", "m": "2", "w": "16"})
+        s = StripeInfo(k=3, stripe_width=3 * 2048)
+        data = os.urandom(4 * 3 * 2048)
+        want = batched_encode(c, s, data, queue=None)
+        q = BatchingQueue(max_delay=0.001)
+        monkeypatch.setattr(
+            q, "submit_packedbit",
+            lambda *a, **kw: (_ for _ in ()).throw(
+                AssertionError("w=16 dispatched on the packed-bit lane")))
+        try:
+            got = batched_encode(c, s, data, queue=q)
+        finally:
+            q.close()
+        for a, b in zip(got, want):
+            assert np.array_equal(np.asarray(a), np.asarray(b))

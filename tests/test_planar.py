@@ -444,3 +444,131 @@ class TestTransferOverlap:
                 f.result(timeout=60)
         finally:
             q.close()
+
+
+class TestPackedbitResidency:
+    """The packed-bit (u32-word) resident layout — the production lane
+    promoted in round 6 (ceph_tpu/ops/gf2.py lane-promotion writeup):
+    1/8th the int8-plane HBM footprint, static XOR schedules per matrix,
+    byte-identical to every oracle path."""
+
+    def test_admit_read_roundtrip_nonword_width(self):
+        """Arbitrary (non-multiple-of-32) chunk widths round-trip: the
+        admit boundary pads to whole u32 words, read trims back."""
+        rng = np.random.default_rng(41)
+        store = PlanarShardStore(capacity_bytes=8 << 20)
+        for B in (100, 1024, 1000):
+            rows = rng.integers(0, 256, size=(4, B), dtype=np.uint8)
+            store.admit(("pb", B), rows, w=8, layout="packedbit")
+            back = store.read(("pb", B))
+            assert back is not None and back.shape == (4, B)
+            assert np.array_equal(back, rows), B
+
+    def test_packedbit_resident_is_8x_denser(self):
+        """The promotion's capacity win: a u32 resident accounts 1 byte
+        per data byte where int8 planes account 8 — same budget, 8x the
+        objects."""
+        rng = np.random.default_rng(43)
+        rows = rng.integers(0, 256, size=(4, 1024), dtype=np.uint8)
+        s_planes = PlanarShardStore(capacity_bytes=8 << 20)
+        s_packed = PlanarShardStore(capacity_bytes=8 << 20)
+        s_planes.admit("x", rows, w=8, layout="planes")
+        s_packed.admit("x", rows, w=8, layout="packedbit")
+        assert s_planes.resident_bytes == 8 * s_packed.resident_bytes
+
+    def test_apply_runs_schedule_on_packedbit_residents(self):
+        """store.apply over a u32 resident routes through the XOR
+        schedule (queue lane when attached, direct otherwise) and
+        reconstructs byte-exactly."""
+        from ceph_tpu.ec.gf import gf
+        from ceph_tpu.ec.matrices import (matrix_to_bitmatrix,
+                                          vandermonde_coding_matrix)
+        from ceph_tpu.ops.gf2 import from_packedbit
+
+        k, m, w = 4, 2, 8
+        f = gf(w)
+        mat = vandermonde_coding_matrix(k, m, w)
+        rng = np.random.default_rng(47)
+        data = rng.integers(0, 256, size=(k, 1024), dtype=np.uint8)
+        parity = f.matmul(mat, data)
+        full = np.vstack([np.eye(k, dtype=np.int64), mat])
+        chosen = [c for c in range(k + m) if c != 2][:k]
+        inv = f.invert_matrix(full[chosen])
+        inv_bm = matrix_to_bitmatrix(inv[2:3], w).astype(np.uint8)
+        surv = np.vstack([data[[0, 1, 3]], parity[0:1]])
+        for queue in (None, BatchingQueue(max_delay=0.001)):
+            try:
+                store = PlanarShardStore(capacity_bytes=8 << 20,
+                                         queue=queue)
+                store.admit("surv", surv, w=8, layout="packedbit")
+                rec_words = store.apply("surv", inv_bm, 1)
+                assert np.asarray(rec_words).dtype == np.uint32
+                rec = np.asarray(from_packedbit(np.asarray(rec_words), 1))
+                assert np.array_equal(rec[0], data[2])
+            finally:
+                if queue is not None:
+                    queue.close()
+
+    def test_planar_encode_async_installs_packedbit_residents(self):
+        """The w=8 write path admits u32 residents end-to-end: encode
+        rides the packedbit_resident queue lane, planar_rows and
+        planar_object_bytes read the u32 layout back byte-exactly."""
+        codec = _codec()
+        sinfo = StripeInfo(k=8, stripe_width=8 * 4096)
+        data = os.urandom(3 * 8 * 4096 + 100)
+        want = batched_encode(codec, sinfo, data)
+        q = BatchingQueue(max_delay=0.001)
+        try:
+
+            async def go():
+                return await planar_encode_async(codec, sinfo, data,
+                                                 queue=q)
+
+            got = asyncio.run(go())
+        finally:
+            q.close()
+        assert got is not None
+        blobs, all_bits, n_rows, n_cols, w = got
+        assert np.asarray(all_bits).dtype == np.uint32, \
+            "w=8 write path must install packed-bit residents"
+        for a, b in zip(want, blobs):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        store = PlanarShardStore(capacity_bytes=256 << 20)
+        store.put_planar("k", all_bits, n_rows=n_rows, meta=(7, n_cols))
+        rows = planar_rows(store, "k", 7)
+        assert rows is not None
+        for a, b in zip(want, rows):
+            assert np.array_equal(np.asarray(a), b)
+        obj = planar_object_bytes(store, "k", 7, 8, sinfo.chunk_size,
+                                  len(data))
+        assert obj == data
+
+    def test_packedbit_planes_lane_coalesces(self):
+        """Concurrent schedule-only dispatches over resident u32 planes
+        coalesce into one device call (the packed-bit mirror of the
+        planar lane) and the results stay resident (no host bounce)."""
+        from ceph_tpu.ec.gf import gf
+        from ceph_tpu.ec.matrices import (matrix_to_bitmatrix,
+                                          vandermonde_coding_matrix)
+        from ceph_tpu.ops.gf2 import from_packedbit, to_packedbit
+
+        k, m, w = 4, 2, 8
+        mat = vandermonde_coding_matrix(k, m, w)
+        bm = matrix_to_bitmatrix(mat, w).astype(np.uint8)
+        rng = np.random.default_rng(53)
+        q = BatchingQueue(max_pending_bytes=1 << 30, max_delay=60)
+        try:
+            reqs = [rng.integers(0, 256, size=(k, 2048), dtype=np.uint8)
+                    for _ in range(8)]
+            planes = [to_packedbit(r) for r in reqs]
+            futs = [q.submit_packedbit_planes(bm, p, w, m)
+                    for p in planes]
+            assert not any(f.done() for f in futs)
+            q.flush()
+            outs = [f.result(timeout=30) for f in futs]
+            assert q.dispatches == 1
+        finally:
+            q.close()
+        for r, out in zip(reqs, outs):
+            got = np.asarray(from_packedbit(np.asarray(out), m))
+            assert np.array_equal(got, gf(w).matmul(mat, r))

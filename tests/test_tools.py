@@ -475,3 +475,82 @@ class TestPoolLifecycleCli:
             assert list(osd.store.list_objects(1)) == [("alive", 0)]
 
         asyncio.run(go())
+
+
+class _CorruptingDecode:
+    """Delegates to a real codec but flips a byte in every recovered
+    chunk — the fast-but-wrong decoder the post-loop content check
+    exists to catch."""
+
+    def __init__(self, real):
+        self._real = real
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+    def decode(self, want, available, chunk_size):
+        out = self._real.decode(want, available, chunk_size)
+        return {c: bytes([b[0] ^ 0xFF]) + bytes(b[1:])
+                if c not in available else b
+                for c, b in out.items()}
+
+
+def test_benchmark_decode_random_verifies_content(capsys, monkeypatch):
+    """Random-erasure decode must fail loudly when recovered bytes are
+    wrong — the reference CLI only content-checked exhaustive mode."""
+    import numpy as _np
+
+    real_make = benchmark.make_codec
+    monkeypatch.setattr(benchmark, "make_codec",
+                        lambda a, p: _CorruptingDecode(real_make(a, p)))
+    code = benchmark.main([
+        "--plugin", "jerasure", "-P", "k=4", "-P", "m=2",
+        "--size", "16384", "--iterations", "2",
+        "--workload", "decode", "--erasures", "1",
+    ])
+    assert code == 1
+    assert "recovered content are different" in capsys.readouterr().err
+
+
+def test_benchmark_decode_erased_verifies_content(capsys, monkeypatch):
+    real_make = benchmark.make_codec
+    monkeypatch.setattr(benchmark, "make_codec",
+                        lambda a, p: _CorruptingDecode(real_make(a, p)))
+    code = benchmark.main([
+        "--plugin", "jerasure", "-P", "k=4", "-P", "m=2",
+        "--size", "16384", "--workload", "decode",
+        "--erased", "0", "--erased", "5",
+    ])
+    assert code == 1
+    assert "recovered content are different" in capsys.readouterr().err
+
+
+def test_benchmark_decode_verification_caps_signatures(monkeypatch):
+    """The post-loop check re-decodes each DISTINCT signature once,
+    capped — verification work must stay O(signatures), not
+    O(iterations)."""
+    real_make = benchmark.make_codec
+    counting = {}
+
+    class _Counting:
+        def __init__(self, real):
+            self._real = real
+
+        def __getattr__(self, name):
+            return getattr(self._real, name)
+
+        def decode(self, want, available, chunk_size):
+            counting["calls"] = counting.get("calls", 0) + 1
+            return self._real.decode(want, available, chunk_size)
+
+    monkeypatch.setattr(benchmark, "make_codec",
+                        lambda a, p: _Counting(real_make(a, p)))
+    iters = 40
+    code = benchmark.main([
+        "--plugin", "jerasure", "-P", "k=4", "-P", "m=2",
+        "--size", "16384", "--iterations", str(iters),
+        "--workload", "decode", "--erasures", "1",
+    ])
+    assert code == 0
+    # loop decodes + at most C(6,1)=6 distinct verification decodes
+    assert counting["calls"] <= iters + 6

@@ -78,6 +78,9 @@ def test_tpu_cpu_fallback(monkeypatch):
     def boom(*a, **kw):
         raise RuntimeError("injected device failure")
 
+    # break BOTH dispatch seams: the packed-bit XOR-schedule production
+    # lane and the int8-plane fallback lane
+    monkeypatch.setattr(gf2, "gf2_apply_packedbit", boom)
     monkeypatch.setattr(gf2, "gf2_apply_bytes", boom)
     data = payload(1 << 14, seed=3)
     enc = codec.encode(set(range(6)), data)
@@ -179,3 +182,85 @@ def test_batching_queue_closed_submit():
     q.close()
     with pytest.raises(RuntimeError):
         q.submit(np.ones((8, 16), np.uint8), np.zeros((2, 64), np.uint8), 8, 1)
+
+
+def test_tpu_encode_rides_packedbit_lane(monkeypatch):
+    """w=8 byte-layout dispatch must route through the packed-bit
+    XOR-schedule production lane (ops/gf2.py lane promotion), and the
+    output must stay byte-identical to jerasure."""
+    import ceph_tpu.ops.gf2 as gf2
+
+    calls = []
+    real = gf2.gf2_apply_packedbit
+
+    def spy(bm, data):
+        calls.append(np.asarray(bm).shape)
+        return real(bm, data)
+
+    monkeypatch.setattr(gf2, "gf2_apply_packedbit", spy)
+    codec = make("tpu", technique="reed_sol_van", k=4, m=2)
+    j = make("jerasure", technique="reed_sol_van", k=4, m=2)
+    data = payload(1 << 14, seed=21)
+    enc = codec.encode(set(range(6)), data)
+    assert calls, "encode did not ride the packed-bit lane"
+    assert not getattr(codec, "_tpu_failed", False)
+    ej = j.encode(set(range(6)), data)
+    for c in range(6):
+        assert np.array_equal(enc[c], ej[c])
+    # decode rides it too: the inverted signature matrix compiles to its
+    # own schedule (per-decode-signature compilation behind the LRU)
+    del calls[:]
+    avail = {c: enc[c] for c in range(6) if c not in (1, 4)}
+    out = codec.decode({1, 4}, avail, len(enc[0]))
+    assert calls, "decode did not ride the packed-bit lane"
+    for c in (1, 4):
+        assert np.array_equal(out[c], enc[c])
+
+
+def test_tpu_packedbit_kill_switch(monkeypatch):
+    """CEPH_TPU_PACKEDBIT=0 pins the int8-plane lanes (the proven
+    fallback layout) — packed-bit must never be dispatched, bytes stay
+    identical."""
+    import ceph_tpu.ops.gf2 as gf2
+
+    monkeypatch.setenv("CEPH_TPU_PACKEDBIT", "0")
+
+    def forbidden(*a, **kw):
+        raise AssertionError("packed-bit lane dispatched while disabled")
+
+    monkeypatch.setattr(gf2, "gf2_apply_packedbit", forbidden)
+    codec = make("tpu", technique="reed_sol_van", k=4, m=2)
+    j = make("jerasure", technique="reed_sol_van", k=4, m=2)
+    data = payload(1 << 14, seed=22)
+    enc = codec.encode(set(range(6)), data)
+    assert not getattr(codec, "_tpu_failed", False)
+    ej = j.encode(set(range(6)), data)
+    for c in range(6):
+        assert np.array_equal(enc[c], ej[c])
+
+
+def test_tpu_bitmatrix_family_packedbit_rows(monkeypatch):
+    """The cauchy/liberation packet-row path applies the XOR schedule
+    DIRECTLY to packet bytes (no 8x bit expansion) — byte-identical to
+    jerasure, and the schedule seam must actually be exercised."""
+    import ceph_tpu.ops.gf2 as gf2
+
+    calls = []
+    real = gf2.gf2_xor_packed
+
+    def spy(bm, rows, cse=None):
+        calls.append(np.asarray(rows).dtype)
+        return real(bm, rows, cse=cse)
+
+    monkeypatch.setattr(gf2, "gf2_xor_packed", spy)
+    profile = dict(technique="cauchy_good", k=4, m=2, packetsize=8)
+    t = make("tpu", **profile)
+    j = make("jerasure", **profile)
+    data = payload(1 << 14, seed=23)
+    n = t.get_chunk_count()
+    et = t.encode(set(range(n)), data)
+    ej = j.encode(set(range(n)), data)
+    assert not getattr(t, "_tpu_failed", False)
+    assert calls and all(d == np.uint8 for d in calls), calls
+    for c in range(n):
+        assert np.array_equal(et[c], ej[c])
