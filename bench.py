@@ -59,6 +59,53 @@ N_STRIPES = int(os.environ.get("BENCH_STRIPES", "16"))  # batched per dispatch
 CPU_ITERS = int(os.environ.get("BENCH_CPU_ITERS", "2"))
 
 
+def sched_perf_snapshot() -> dict:
+    """Compact `gf2_sched` counter snapshot for the BENCH record: the
+    schedule-cache hit rate, compile cost, and realized CSE saving ride
+    the perf trajectory files instead of living only in `perf dump`."""
+    try:
+        from ceph_tpu.ops.gf2 import SCHED_PERF
+
+        d = SCHED_PERF.dump()
+        lookups = d["hit"] + d["miss"]
+        return {
+            "hit_rate": round(d["hit"] / lookups, 3) if lookups else 0.0,
+            "compiles": d["compile"],
+            "compile_s_avg": round(SCHED_PERF.avg("compile_s"), 5),
+            "evictions": d["evict"],
+            "xor_ops_naive": d["xor_ops_naive"],
+            "xor_ops_final": d["xor_ops_final"],
+        }
+    except Exception as e:  # never sink the bench run, but never silently
+        print(f"bench: gf2_sched snapshot failed: {e!r}", file=sys.stderr)
+        return {}
+
+
+def queue_perf_snapshot(q) -> dict:
+    """Compact `ec_tpu` counter snapshot of a BatchingQueue: per-lane
+    submit/byte counts (non-zero lanes only), latency averages, and
+    flush causes — the breakdown the BENCH record carries each run."""
+    try:
+        from ceph_tpu.parallel.service import LANES
+
+        d = q.perf.dump()
+        return {
+            "submits": d["submit"], "dispatches": d["dispatch"],
+            "bytes": d["bytes"],
+            "queue_wait_s_avg": round(q.perf.avg("queue_wait"), 6),
+            "dispatch_dev_s_avg": round(q.perf.avg("dispatch_dev"), 6),
+            "flush_causes": {c: d[f"flush_{c}"]
+                             for c in ("bytes", "delay", "forced")},
+            "lane_submits": {ln: d[f"submit_{ln}"] for ln in LANES
+                             if d[f"submit_{ln}"]},
+            "lane_bytes": {ln: d[f"bytes_{ln}"] for ln in LANES
+                           if d[f"bytes_{ln}"]},
+        }
+    except Exception as e:  # a counter rename must not erase the record
+        print(f"bench: ec_tpu snapshot failed: {e!r}", file=sys.stderr)
+        return {}
+
+
 def main() -> int:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import jax
@@ -660,6 +707,7 @@ def main() -> int:
     batch_gbps = 0.0
     pipelined_gbps = 0.0
     overlapped = 0
+    ec_tpu_perf = {}
     try:
         from concurrent.futures import ThreadPoolExecutor
 
@@ -711,6 +759,7 @@ def main() -> int:
         dt = time.perf_counter() - t0
         pipelined_gbps = (rounds * K * B) / dt / 1e9
         overlapped = q.overlapped_rounds - ov0
+        ec_tpu_perf = queue_perf_snapshot(q)
         q.close()
     except Exception:
         pass
@@ -851,6 +900,10 @@ def main() -> int:
         "e2e_onhost_overlapped_rounds": onhost_overlapped,
         "batch_ops_per_dispatch": round(batch_ops_per_dispatch, 1),
         "batch_hostmem_GBps": round(batch_gbps, 3),
+        # EC data-plane counter snapshots (ISSUE 2): the trajectory
+        # files carry the per-lane/cache breakdown each round
+        "ec_tpu_perf": ec_tpu_perf,
+        "gf2_sched_perf": sched_perf_snapshot(),
         "daemon_put_MBps": round(daemon_put_mbps, 1),
         "daemon_get_MBps": round(daemon_get_mbps, 1),
         "daemon_wire_put_MBps": round(daemon_wire_put_mbps, 1),

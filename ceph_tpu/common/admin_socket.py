@@ -32,6 +32,8 @@ class AdminSocket:
                       "dump perf counters")
         self.register("perf schema", lambda a: self.ctx.perf.schema(),
                       "dump perf counter schema")
+        self.register("perf reset", self._perf_reset,
+                      "zero counters in one set (name=<set>) or all sets")
         self.register("config show", lambda a: self.ctx.conf.show(),
                       "effective config")
         self.register("config diff", lambda a: self.ctx.conf.diff(),
@@ -52,6 +54,10 @@ class AdminSocket:
     def unregister(self, prefix: str) -> None:
         self._hooks.pop(prefix, None)
         self._help.pop(prefix, None)
+
+    def _perf_reset(self, args: Dict[str, Any]) -> Dict[str, Any]:
+        reset = self.ctx.perf.reset(args.get("name", "all"))
+        return {"success": bool(reset), "reset": reset}
 
     def _config_set(self, args: Dict[str, Any]) -> Dict[str, Any]:
         self.ctx.conf.set(args["key"], args["value"], source="cli")

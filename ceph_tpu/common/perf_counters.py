@@ -14,7 +14,9 @@ mgr's prometheus exporter.  Three kinds mirror the reference:
 
 from __future__ import annotations
 
+import contextlib
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 U64 = "u64"
@@ -42,6 +44,11 @@ class PerfCounters:
         self.name = name
         self._counters: Dict[str, _Counter] = {}
         self._lock = threading.Lock()
+        # optional owner callback invoked after reset(): gauge-style
+        # counters (cache entries, resident bytes) mirror LIVE state that
+        # zeroing misreports until the next mutation — the owner re-sets
+        # them here so `perf reset` restarts rates without lying gauges
+        self.resync: Optional[Any] = None
 
     # -- hot path ------------------------------------------------------------
 
@@ -56,7 +63,9 @@ class PerfCounters:
             c.value -= amount
 
     def set(self, name: str, value: int) -> None:
-        self._counters[name].value = value
+        c = self._counters[name]
+        with self._lock:
+            c.value = value
 
     def tinc(self, name: str, seconds: float) -> None:
         """Add one latency observation to a longrunavg."""
@@ -64,6 +73,29 @@ class PerfCounters:
         with self._lock:
             c.sum += seconds
             c.count += 1
+
+    @contextlib.contextmanager
+    def time_avg(self, name: str):
+        """Time a block into a longrunavg — ``with pc.time_avg("op_lat"):``
+        instead of hand-rolled time.monotonic() pairs at every call site.
+        The observation is recorded even when the block raises (a failed
+        op still spent the time)."""
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.tinc(name, time.monotonic() - t0)
+
+    def ensure(self, name: str, kind: str = U64,
+               desc: str = "") -> None:
+        """Declare a counter after build time (dynamic families, e.g. the
+        messenger's per-message-type counts).  Idempotent; thread-safe
+        against dump()."""
+        if name in self._counters:
+            return
+        with self._lock:
+            if name not in self._counters:
+                self._counters[name] = _Counter(name, kind, desc)
 
     def hinc(self, name: str, value: float) -> None:
         """Add an observation to a power-of-2-bucketed histogram."""
@@ -87,11 +119,31 @@ class PerfCounters:
         c = self._counters[name]
         return c.sum / c.count if c.count else 0.0
 
+    def reset(self) -> None:
+        """Zero every counter in the set (the `perf reset` admin command):
+        tests and bench warmup/timed windows isolate measurement intervals
+        instead of diffing snapshots by hand."""
+        with self._lock:
+            for c in self._counters.values():
+                c.value = 0
+                c.sum = 0.0
+                c.count = 0
+                if c.buckets is not None:
+                    c.buckets = [0] * len(c.buckets)
+        if self.resync is not None:
+            try:
+                self.resync()  # outside the lock: resync calls set()
+            except Exception:
+                pass
+
     # -- dump ----------------------------------------------------------------
 
     def dump(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
-        for c in self._counters.values():
+        # snapshot under the lock: ensure() may add counters concurrently
+        with self._lock:
+            counters = list(self._counters.values())
+        for c in counters:
             if c.kind == U64:
                 out[c.name] = c.value
             elif c.kind == LONGRUNAVG:
@@ -105,10 +157,11 @@ class PerfCounters:
         return out
 
     def schema(self) -> Dict[str, Dict[str, str]]:
-        return {
-            c.name: {"type": c.kind, "description": c.desc}
-            for c in self._counters.values()
-        }
+        # snapshot under the lock, same ensure() race as dump()
+        with self._lock:
+            counters = list(self._counters.values())
+        return {c.name: {"type": c.kind, "description": c.desc}
+                for c in counters}
 
 
 class PerfCountersBuilder:
@@ -158,6 +211,18 @@ class PerfCountersCollection:
     def dump(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
             return {name: pc.dump() for name, pc in self._sets.items()}
+
+    def reset(self, name: Optional[str] = None) -> List[str]:
+        """Zero one named set, or every set when name is None/"all".
+        Returns the names of the sets that were reset."""
+        with self._lock:
+            if name and name != "all":
+                targets = [self._sets[name]] if name in self._sets else []
+            else:
+                targets = list(self._sets.values())
+        for pc in targets:
+            pc.reset()
+        return [pc.name for pc in targets]
 
     def schema(self) -> Dict[str, Any]:
         with self._lock:

@@ -20,7 +20,7 @@ _ids = itertools.count(1)
 
 class Span:
     __slots__ = ("tracer", "trace_id", "span_id", "parent_id", "name",
-                 "start", "end", "events")
+                 "start", "end", "events", "tags")
 
     def __init__(self, tracer: "Tracer", name: str, trace_id: int,
                  parent_id: Optional[int]):
@@ -32,9 +32,17 @@ class Span:
         self.start = time.time()
         self.end: Optional[float] = None
         self.events: List[Dict[str, Any]] = []
+        self.tags: Dict[str, Any] = {}
 
     def event(self, name: str) -> None:
         self.events.append({"time": time.time(), "event": name})
+
+    def tag(self, key: str, value: Any) -> "Span":
+        """Attach a key/value annotation (zipkin binary-annotation role):
+        the batching queue tags dispatch spans with lane, group size, and
+        byte counts so the asok timeline is self-describing."""
+        self.tags[key] = value
+        return self
 
     def child(self, name: str) -> "Span":
         return self.tracer._span(name, self.trace_id, self.span_id)
@@ -55,7 +63,7 @@ class Span:
                 "parent_id": self.parent_id, "name": self.name,
                 "start": self.start,
                 "duration": (self.end or time.time()) - self.start,
-                "events": list(self.events)}
+                "events": list(self.events), "tags": dict(self.tags)}
 
 
 class Tracer:
@@ -74,7 +82,11 @@ class Tracer:
             self._ring.append(span)
 
     def dump(self) -> List[Dict[str, Any]]:
-        return [s.dump() for s in self._ring]
+        # snapshot FIRST (one C-level call, safe under the GIL): the
+        # batching worker thread finishes dispatch spans concurrently,
+        # and iterating the live deque from the asok thread would raise
+        # "deque mutated during iteration" mid-dump
+        return [s.dump() for s in list(self._ring)]
 
     def register_asok(self, asok) -> None:
         asok.register("dump_traces", lambda a: self.dump(),

@@ -39,9 +39,32 @@ from ceph_tpu.ec.plugins.jerasure import (
     ReedSolomonR6Op,
     ReedSolomonVandermonde,
 )
+from ceph_tpu.common.perf_counters import PerfCountersBuilder
 from ceph_tpu.ec.registry import ErasureCodePlugin
 
 log = logging.getLogger("ceph_tpu.ec.tpu")
+
+# The `ec_plugin` counter set: the NON-queue dispatch path (direct codec
+# calls through the _apply/_apply_rows seams — benchmark CLI, per-stripe
+# paths, recovery helpers).  Process-global like the codec classes;
+# daemons add it next to `ec_tpu`/`gf2_sched`.  COUNTER SCHEMA:
+#   apply / apply_rows        u64         device dispatches per seam
+#   apply_s / apply_rows_s    longrunavg  device seconds per dispatch
+#                                         (includes first-call compiles)
+#   cpu_fallback              u64         seam calls served by the CPU
+#                                         oracle (device off/sick)
+#   device_failed             u64         dispatch exceptions that flipped
+#                                         a codec to its CPU fallback
+PLUGIN_PERF = (
+    PerfCountersBuilder("ec_plugin")
+    .add_u64_counter("apply", "byte-layout seam device dispatches")
+    .add_u64_counter("apply_rows", "packet-layout seam device dispatches")
+    .add_time_avg("apply_s", "byte-layout seam device seconds")
+    .add_time_avg("apply_rows_s", "packet-layout seam device seconds")
+    .add_u64_counter("cpu_fallback", "seam calls served by the CPU path")
+    .add_u64_counter("device_failed",
+                     "dispatch exceptions flipping a codec to CPU")
+    .create_perf_counters())
 
 
 class _TpuDispatch:
@@ -62,6 +85,7 @@ class _TpuDispatch:
     def _mark_failed(self, exc: Exception) -> None:
         if not getattr(self, "_tpu_failed", False):
             log.error("tpu dispatch failed, falling back to CPU: %s", exc)
+        PLUGIN_PERF.inc("device_failed")
         self._tpu_failed = True
 
     def _bm_cache(self) -> Dict[bytes, np.ndarray]:
@@ -82,6 +106,7 @@ class _TpuDispatch:
     # seam override: GF(2^w) matrix applied to symbol regions
     def _apply(self, matrix: np.ndarray, regions: np.ndarray) -> np.ndarray:
         if not self._device_ok():
+            PLUGIN_PERF.inc("cpu_fallback")
             return super()._apply(matrix, regions)
         try:
             from ceph_tpu.ops.gf2 import bucket_columns as _bucket
@@ -102,16 +127,20 @@ class _TpuDispatch:
                 buf = np.zeros((rows, padded), dtype=np.uint8)
                 buf[:, :B] = regions
             use_pallas = self._use_pallas(padded)
-            if packedbit_enabled() and self.w == 8 and not use_pallas:
-                # production lane: one fused static-XOR-schedule call,
-                # compiled per matrix behind the gf2 LRU — encode
-                # generators AND decode signature matrices alike (pow2
-                # bucketing keeps B a whole number of u32 words)
-                out = gf2_apply_packedbit(bm, buf)
-            else:
-                out = gf2_apply_bytes(
-                    bm, buf, self.w, out_rows, use_pallas=use_pallas)
-            return np.asarray(out)[:, :B]
+            with PLUGIN_PERF.time_avg("apply_s"):
+                if packedbit_enabled() and self.w == 8 and not use_pallas:
+                    # production lane: one fused static-XOR-schedule
+                    # call, compiled per matrix behind the gf2 LRU —
+                    # encode generators AND decode signature matrices
+                    # alike (pow2 bucketing keeps B a whole number of
+                    # u32 words)
+                    out = gf2_apply_packedbit(bm, buf)
+                else:
+                    out = gf2_apply_bytes(
+                        bm, buf, self.w, out_rows, use_pallas=use_pallas)
+                out = np.asarray(out)
+            PLUGIN_PERF.inc("apply")
+            return out[:, :B]
         except Exception as e:  # any device/compile failure -> CPU fallback
             self._mark_failed(e)
             return super()._apply(matrix, regions)
@@ -119,6 +148,7 @@ class _TpuDispatch:
     # seam override: GF(2) bit-matrix applied to packet rows
     def _apply_rows(self, bm: np.ndarray, rows: np.ndarray) -> np.ndarray:
         if not self._device_ok():
+            PLUGIN_PERF.inc("cpu_fallback")
             return super()._apply_rows(bm, rows)
         try:
             from ceph_tpu.ops.gf2 import bucket_columns as _bucket
@@ -138,8 +168,10 @@ class _TpuDispatch:
                     buf = np.zeros((R, padded), dtype=np.uint8)
                     buf[:, :flat.shape[1]] = flat
                     flat = buf
-                out = np.asarray(gf2_xor_packed(
-                    np.asarray(bm, dtype=np.uint8), flat))
+                with PLUGIN_PERF.time_avg("apply_rows_s"):
+                    out = np.asarray(gf2_xor_packed(
+                        np.asarray(bm, dtype=np.uint8), flat))
+                PLUGIN_PERF.inc("apply_rows")
                 return out[:, :nb * p].reshape(bm.shape[0], nb, p)
 
             w, p = self.w, self.packetsize
@@ -157,16 +189,18 @@ class _TpuDispatch:
                 buf = np.zeros((n, nb_pad * w * p), dtype=np.uint8)
                 buf[:, : chunks.shape[1]] = chunks
                 chunks = buf
-            out = np.asarray(
-                gf2_apply_packets(
-                    bm,
-                    chunks,
-                    w,
-                    p,
-                    out_n,
-                    use_pallas=self._use_pallas(nb_pad * p * 8),
+            with PLUGIN_PERF.time_avg("apply_rows_s"):
+                out = np.asarray(
+                    gf2_apply_packets(
+                        bm,
+                        chunks,
+                        w,
+                        p,
+                        out_n,
+                        use_pallas=self._use_pallas(nb_pad * p * 8),
+                    )
                 )
-            )
+            PLUGIN_PERF.inc("apply_rows")
             out = out[:, : nb * w * p] if nb_pad != nb else out
             return (
                 out.reshape(out_n, nb, w, p).transpose(0, 2, 1, 3).reshape(out_n * w, nb, p)

@@ -6,7 +6,9 @@ src/pybind/mgr/prometheus, src/pybind/mgr/crash): daemons push MMgrReport
 latest report per daemon and serves:
 
 - ``/metrics`` — prometheus text format over HTTP (the prometheus module),
-  with per-daemon labels, counters, and longrunavg sum/count pairs;
+  with per-daemon labels, counters, longrunavg sum/count pairs, and
+  HISTOGRAM-kind counters as cumulative ``_bucket{le="..."}``/``_sum``/
+  ``_count`` series (power-of-2 upper bounds, trailing empty run elided);
 - crash reports — daemons post crash dumps (the ceph-crash agent +
   mgr/crash module flow), listed/inspected via mgr commands.
 
@@ -205,6 +207,12 @@ class MgrDaemon:
     def prometheus_text(self) -> str:
         lines: List[str] = []
         seen_help = set()
+
+        def typed(metric: str, kind: str = "counter") -> None:
+            if metric not in seen_help:
+                lines.append(f"# TYPE {metric} {kind}")
+                seen_help.add(metric)
+
         for name, report in sorted(self.reports.items()):
             for set_name, counters in (report.perf or {}).items():
                 for cname, value in counters.items():
@@ -212,15 +220,47 @@ class MgrDaemon:
                     if isinstance(value, dict) and "avgcount" in value:
                         for suffix, v in (("_sum", value["sum"]),
                                           ("_count", value["avgcount"])):
-                            m = metric + suffix
-                            if m not in seen_help:
-                                lines.append(f"# TYPE {m} counter")
-                                seen_help.add(m)
-                            lines.append(f'{m}{{daemon="{name}"}} {v}')
+                            typed(metric + suffix)
+                            lines.append(
+                                f'{metric + suffix}{{daemon="{name}"}} {v}')
+                    elif isinstance(value, dict) and "buckets" in value:
+                        # HISTOGRAM kind (power-of-2 buckets: slot i holds
+                        # observations with bit_length == i, i.e. values
+                        # in [2^(i-1), 2^i - 1]) rendered cumulative: the
+                        # le bound for slot i is its LARGEST member,
+                        # 2^i - 1 (le="2^i" would exclude exact powers of
+                        # two — the common case for batch sizes — from
+                        # their own bucket, breaking the prometheus
+                        # invariant that bucket{le=x} counts all obs <= x)
+                        typed(metric, "histogram")
+                        buckets = value["buckets"]
+                        last = max((i for i, c in enumerate(buckets) if c),
+                                   default=-1)
+                        # the final slot is hinc's CLAMP (bit_length >=
+                        # len-1 all land there): no finite le bound is
+                        # true for it, so its counts surface via +Inf only
+                        last = min(last, len(buckets) - 2)
+                        cum = 0
+                        for i in range(last + 1):
+                            cum += buckets[i]
+                            if not cum:
+                                continue  # skip the leading empty run
+                            lines.append(
+                                f'{metric}_bucket{{daemon="{name}",'
+                                f'le="{(1 << i) - 1}"}} {cum}')
+                        lines.append(
+                            f'{metric}_bucket{{daemon="{name}",'
+                            f'le="+Inf"}} {value["count"]}')
+                        # _sum/_count belong to the histogram family
+                        # declared above: no separate TYPE lines
+                        lines.append(
+                            f'{metric}_sum{{daemon="{name}"}} '
+                            f'{value["sum"]}')
+                        lines.append(
+                            f'{metric}_count{{daemon="{name}"}} '
+                            f'{value["count"]}')
                     elif isinstance(value, (int, float)):
-                        if metric not in seen_help:
-                            lines.append(f"# TYPE {metric} counter")
-                            seen_help.add(metric)
+                        typed(metric)
                         lines.append(f'{metric}{{daemon="{name}"}} {value}')
         lines.append(f"ceph_mgr_daemons_reporting {len(self.reports)}")
         return "\n".join(lines) + "\n"

@@ -147,6 +147,26 @@ the packed-bit lane as headline the margin is wide anyway: traffic is
 1 HBM byte per data byte when the parity planes are consumed fused
 (1.375 when they persist), so the roofline band is bw/1.375..bw and
 the measured 126.2 GB/s sits at ~23% of it — fraction well under 1.0.
+
+OBSERVABILITY — the `gf2_sched` counter set (COUNTER SCHEMA: name ->
+meaning -> kind), owned by this module because the schedule LRU is
+process-global; daemons that engage the device tier add it to their
+PerfCountersCollection so `perf dump` / the mgr prometheus exporter
+carry it:
+
+    hit            u64         compiled-schedule LRU hits
+    miss           u64         LRU misses (a compile follows)
+    evict          u64         entries dropped at capacity
+    compile        u64         schedules compiled (program build + trace)
+    compile_s      longrunavg  seconds per schedule compile
+    xor_ops_naive  u64         pre-CSE XOR op count, summed over compiles
+    xor_ops_final  u64         post-CSE (as-configured) XOR op count
+    entries        u64         live LRU entries (gauge)
+
+xor_ops_final / xor_ops_naive is the realized CSE saving; compile_s
+times the Python program build + greedy CSE (the XLA trace happens
+lazily at first call).  `perf reset` (admin socket) zeroes the set so
+bench warmup/timed windows can isolate measurement intervals.
 """
 
 from __future__ import annotations
@@ -154,11 +174,30 @@ from __future__ import annotations
 import functools
 import os
 import threading
+import time
 from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ceph_tpu.common.perf_counters import PerfCountersBuilder
+
+# Schedule-cache observability: the `gf2_sched` counter set (schema in
+# the module docstring's OBSERVABILITY section).
+SCHED_PERF = (
+    PerfCountersBuilder("gf2_sched")
+    .add_u64_counter("hit", "compiled-schedule LRU hits")
+    .add_u64_counter("miss", "compiled-schedule LRU misses")
+    .add_u64_counter("evict", "compiled schedules evicted at capacity")
+    .add_u64_counter("compile", "schedules compiled")
+    .add_time_avg("compile_s", "schedule program build seconds per matrix")
+    .add_u64_counter("xor_ops_naive",
+                     "XOR ops before CSE, summed over compiled matrices")
+    .add_u64_counter("xor_ops_final",
+                     "XOR ops after the configured CSE pass")
+    .add_u64("entries", "live compiled schedules (gauge)")
+    .create_perf_counters())
 
 
 def pallas_enabled() -> bool:
@@ -313,6 +352,18 @@ _XOR_SCHEDULE_CAPACITY = 64
 _XOR_SCHEDULES: "OrderedDict" = OrderedDict()
 _XOR_LOCK = threading.Lock()
 
+# `perf reset` must not leave the entries GAUGE lying at 0 while the LRU
+# still holds compiled schedules: resync re-reads the live size (under
+# the cache lock, same as _sched_cache_put's gauge write)
+
+
+def _sched_resync() -> None:
+    with _XOR_LOCK:
+        SCHED_PERF.set("entries", len(_XOR_SCHEDULES))
+
+
+SCHED_PERF.resync = _sched_resync
+
 
 def packedbit_enabled() -> bool:
     """Whether the packed-bit static-XOR-schedule lane is the production
@@ -434,15 +485,24 @@ def _sched_cache_get(key):
         fn = _XOR_SCHEDULES.get(key)
         if fn is not None:
             _XOR_SCHEDULES.move_to_end(key)  # true LRU: hits refresh
-        return fn
+    SCHED_PERF.inc("hit" if fn is not None else "miss")
+    return fn
 
 
 def _sched_cache_put(key, fn):
+    evicted = 0
     with _XOR_LOCK:
         _XOR_SCHEDULES[key] = fn
         _XOR_SCHEDULES.move_to_end(key)
         while len(_XOR_SCHEDULES) > _XOR_SCHEDULE_CAPACITY:
             _XOR_SCHEDULES.popitem(last=False)
+            evicted += 1
+        # gauge write stays under the cache lock: an unlocked set could
+        # overwrite a newer value with a stale snapshot (lock order is
+        # cache -> perf, same as the resync lambda)
+        SCHED_PERF.set("entries", len(_XOR_SCHEDULES))
+    if evicted:
+        SCHED_PERF.inc("evict", evicted)
 
 
 def _compiled_schedule(tag: str, bitmatrix, build, cse=None):
@@ -455,8 +515,16 @@ def _compiled_schedule(tag: str, bitmatrix, build, cse=None):
     key = (tag, bm.shape, bm.tobytes(), cse)
     fn = _sched_cache_get(key)
     if fn is None:
-        ops, outs, _ = xor_schedule_program(bm, cse=cse)
-        fn = build(ops, outs)
+        with SCHED_PERF.time_avg("compile_s"):
+            ops, outs, n_xors = xor_schedule_program(bm, cse=cse)
+            fn = build(ops, outs)
+        SCHED_PERF.inc("compile")
+        # naive cost is row popcounts alone (no temps): the CSE saving
+        # is visible as xor_ops_final / xor_ops_naive across compiles
+        naive = int(np.maximum(
+            (bm != 0).sum(axis=1).astype(np.int64) - 1, 0).sum())
+        SCHED_PERF.inc("xor_ops_naive", naive)
+        SCHED_PERF.inc("xor_ops_final", int(n_xors))
         _sched_cache_put(key, fn)
     return fn
 

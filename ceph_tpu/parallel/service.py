@@ -37,18 +37,93 @@ dispatch running the matrix as a static XOR schedule compiled per matrix
 (encode generators and decode signatures alike) behind the gf2 LRU.
 Residents store at 1 HBM byte per data byte instead of 8, so the same
 store budget holds 8x the objects.
+
+OBSERVABILITY (the `ec_tpu` + `planar_store` counter sets): the queue owns
+a PerfCounters set — name -> meaning -> kind in _build_ec_tpu_perf — with
+per-lane submit/byte counters (submit_<lane>/bytes_<lane>, u64), queue-wait
+and device-dispatch longrunavg latencies (queue_wait, dispatch_dev), a
+coalesced-group-size histogram (group_size), and flush-cause counters
+(flush_bytes/flush_delay/flush_forced, u64).  Daemons add the set to their
+PerfCountersCollection (`perf dump`, mgr prometheus); `dump_timeline()`
+backs the `dump_ec_batch_timeline` asok command with the last 128
+dispatches (lane, group size, bytes, wait, device seconds).  Trace spans
+ride submissions: a `span=` parent (the OSD's `ec write` trace) gets
+submit/coalesce/fan-out events plus a per-dispatch child span tagged with
+lane/group_size/bytes.  PlanarShardStore mirrors its residency stats into
+a `planar_store` set: admit/hit/miss/evict (u64), resident_bytes + entries
+(gauges), and pack_s/unpack_s longrunavg — the host<->device boundary
+seconds paid at admit()/read().
 """
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from collections import OrderedDict
 from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
+
+from ceph_tpu.common.perf_counters import PerfCounters, PerfCountersBuilder
+
+#: the six dispatch lanes, in promotion order (int8 trio, packed-bit trio)
+LANES = ("packed", "planar", "resident",
+         "packedbit", "packedbit_resident", "packedbit_planes")
+
+
+def _build_ec_tpu_perf() -> PerfCounters:
+    """The `ec_tpu` counter set (COUNTER SCHEMA below; dumped via `perf
+    dump` on any daemon sharing the process queue, exported by the mgr
+    prometheus module, snapshotted into BENCH records):
+
+      submit               u64         requests accepted, all lanes
+      submit_<lane>        u64         requests accepted per lane
+      bytes_<lane>         u64         packed-equivalent bytes submitted per lane
+      dispatch             u64         device calls issued
+      sharded_dispatch     u64         dispatches laid across the mesh
+      overlapped_rounds    u64         rounds whose launch overlapped a fetch
+      bytes                u64         bytes dispatched (incl. bucket padding)
+      queue_wait           longrunavg  submit -> launch wait per request
+      dispatch_dev         longrunavg  launch -> fan-out device seconds per dispatch
+      group_size           histogram   coalesced requests per dispatch (pow2 buckets)
+      flush_bytes          u64         rounds cut by the bytes threshold
+      flush_delay          u64         rounds cut by max_delay expiry
+      flush_forced         u64         rounds cut by an explicit flush()/close()
+    """
+    b = PerfCountersBuilder("ec_tpu")
+    b.add_u64_counter("submit", "requests accepted across all lanes")
+    b.add_u64_counter("dispatch", "device calls issued")
+    b.add_u64_counter("sharded_dispatch",
+                      "dispatches that ran across the device mesh")
+    b.add_u64_counter("overlapped_rounds",
+                      "rounds whose launch overlapped the previous fetch")
+    b.add_u64_counter("bytes",
+                      "bytes dispatched to the device (incl. padding)")
+    for lane in LANES:
+        b.add_u64_counter(f"submit_{lane}", f"requests on the {lane} lane")
+        b.add_u64_counter(f"bytes_{lane}",
+                          f"packed-equivalent bytes submitted on {lane}")
+    b.add_time_avg("queue_wait", "submit -> launch coalescing wait")
+    b.add_time_avg("dispatch_dev", "launch -> fan-out device time")
+    b.add_histogram("group_size", "coalesced requests per dispatch")
+    b.add_u64_counter("flush_bytes", "rounds flushed by the bytes threshold")
+    b.add_u64_counter("flush_delay", "rounds flushed by max_delay expiry")
+    b.add_u64_counter("flush_forced", "rounds flushed by explicit flush()")
+    return b.create_perf_counters()
+
+
+class _Request(NamedTuple):
+    """One queued lane submission.  t_submit feeds the queue_wait
+    latency; span threads the submitter's trace (the OSD's `ec write`)
+    through coalesce -> dispatch -> fan-out."""
+
+    regions: Any
+    future: Future
+    t_submit: float
+    span: Any = None
 
 
 @dataclass
@@ -63,8 +138,19 @@ class _Group:
     # words + static XOR schedules (ceph_tpu/ops/gf2.py lane promotion):
     # "packedbit", "packedbit_planes", "packedbit_resident"
     kind: str = "packed"
-    requests: List[Tuple[Any, Future]] = field(default_factory=list)
+    requests: List[_Request] = field(default_factory=list)
     pending_bytes: int = 0
+
+
+@dataclass
+class _Launched:
+    """One launched dispatch awaiting completion (fan-out)."""
+
+    group: _Group
+    state: Any
+    t_launch: float
+    span: Any = None  # child of a submitter's trace, or queue-tracer root
+    wait_s: float = 0.0  # mean submit->launch wait across the group
 
 
 class BatchingQueue:
@@ -106,10 +192,17 @@ class BatchingQueue:
 
             mesh = shared_mesh()
         self.mesh = mesh or None
-        self.sharded_dispatches = 0  # dispatches that ran across the mesh
-        # rounds whose H2D+launch overlapped the previous round's
-        # result fetch (the double-buffering VERDICT r03 #4 asks for)
-        self.overlapped_rounds = 0
+        # the ec_tpu perf counter set (schema: _build_ec_tpu_perf).  The
+        # legacy bare ints (submits/dispatches/bytes_dispatched/...) are
+        # now read-only views over it — daemons add this set to their
+        # PerfCountersCollection so `perf dump` carries the full breakdown.
+        self.perf = _build_ec_tpu_perf()
+        # optional per-daemon Tracer: dispatch spans with no submitter
+        # parent (e.g. bench traffic) root here; the OSD attaches its ctx
+        # tracer so spans land in its dump_traces ring
+        self.tracer = None
+        # bounded ring of recent dispatches for `dump_ec_batch_timeline`
+        self.timeline: "collections.deque" = collections.deque(maxlen=128)
         # test seam: invoked (worker thread) after a round is launched,
         # before the backlog check — lets tests inject a standing backlog
         # deterministically instead of racing thread schedulers
@@ -122,30 +215,65 @@ class BatchingQueue:
         self._stop = False
         self._worker = threading.Thread(target=self._run, daemon=True, name="ec-batch")
         self._worker.start()
-        self.dispatches = 0  # perf counter: device calls issued
-        self.bytes_dispatched = 0
-        self.submits = 0  # requests accepted (ops/dispatch = submits/dispatches)
+
+    # -- legacy counter views (the pre-instrumentation bare ints) ------------
+
+    @property
+    def submits(self) -> int:
+        return self.perf.get("submit")
+
+    @property
+    def dispatches(self) -> int:
+        return self.perf.get("dispatch")
+
+    @property
+    def bytes_dispatched(self) -> int:
+        return self.perf.get("bytes")
+
+    @property
+    def sharded_dispatches(self) -> int:
+        return self.perf.get("sharded_dispatch")
+
+    @property
+    def overlapped_rounds(self) -> int:
+        return self.perf.get("overlapped_rounds")
+
+    def dump_timeline(self, count: int = 32) -> List[Dict[str, Any]]:
+        """Most-recent-first dispatch records for the asok command
+        `dump_ec_batch_timeline`: lane, group size, bytes, queue wait,
+        device time, and whether the dispatch ran sharded."""
+        return list(self.timeline)[-max(1, int(count)):][::-1]
+
+    def register_asok(self, asok) -> None:
+        """Expose the dispatch timeline on a daemon's admin socket
+        (`dump_ec_batch_timeline [count=N]`)."""
+        asok.register(
+            "dump_ec_batch_timeline",
+            lambda a: self.dump_timeline(int(a.get("count", 32))),
+            "recent EC batch dispatches (lane, group size, wait, device s)")
 
     # -- client side ---------------------------------------------------------
 
     def submit(
-        self, mbits: np.ndarray, regions: np.ndarray, w: int, out_rows: int
+        self, mbits: np.ndarray, regions: np.ndarray, w: int, out_rows: int,
+        span=None,
     ) -> "Future[np.ndarray]":
         """Queue (mbits @ regions) over the byte layout; resolves to the
         [out_rows, B] parity/reconstruction buffer."""
-        return self._submit(mbits, regions, w, out_rows, "packed")
+        return self._submit(mbits, regions, w, out_rows, "packed", span)
 
     def submit_planar(
-        self, mbits: np.ndarray, bits, w: int, out_rows: int
+        self, mbits: np.ndarray, bits, w: int, out_rows: int, span=None
     ) -> "Future[object]":
         """Queue (mbits @ bits) over ALREADY-PLANAR device bit-planes
         ([rows*w, Bcols] int8); resolves to the [out_rows*w, Bcols] planar
         device buffer — no pack, the result stays HBM-resident for the
         next pipeline stage."""
-        return self._submit(mbits, bits, w, out_rows, "planar")
+        return self._submit(mbits, bits, w, out_rows, "planar", span)
 
     def submit_resident(
-        self, mbits: np.ndarray, rows: np.ndarray, w: int, out_rows: int
+        self, mbits: np.ndarray, rows: np.ndarray, w: int, out_rows: int,
+        span=None,
     ) -> "Future[object]":
         """The residency WRITE path: packed [n, B] uint8 rows in, ONE
         fused batched device call (unpack + matmul + parity pack), and
@@ -154,14 +282,15 @@ class BatchingQueue:
         persistence, planar rows to keep HBM-resident.  Submission is
         non-blocking (no device work on the caller's thread), so
         concurrent ops coalesce exactly like the packed lane."""
-        return self._submit(mbits, rows, w, out_rows, "resident")
+        return self._submit(mbits, rows, w, out_rows, "resident", span)
 
     # -- packed-bit lanes (the production w=8 trio, ceph_tpu/ops/gf2.py
     #    lane-promotion writeup: u32-word bit-planes + static XOR
     #    schedules compiled per matrix behind the LRU) ----------------------
 
     def submit_packedbit(
-        self, mbits: np.ndarray, regions: np.ndarray, w: int, out_rows: int
+        self, mbits: np.ndarray, regions: np.ndarray, w: int, out_rows: int,
+        span=None,
     ) -> "Future[np.ndarray]":
         """Queue a [out_rows*8, n*8] GF(2) bit-matrix over packed [n, B]
         uint8 rows through the packed-bit XOR-schedule lane (one fused
@@ -171,10 +300,11 @@ class BatchingQueue:
         signature matrices both land here — each matrix is its own
         dispatch group and its own LRU-cached compiled schedule."""
         assert w == 8, "packed-bit lane is the w=8 byte-layout lane"
-        return self._submit(mbits, regions, w, out_rows, "packedbit")
+        return self._submit(mbits, regions, w, out_rows, "packedbit", span)
 
     def submit_packedbit_resident(
-        self, mbits: np.ndarray, rows: np.ndarray, w: int, out_rows: int
+        self, mbits: np.ndarray, rows: np.ndarray, w: int, out_rows: int,
+        span=None,
     ) -> "Future[object]":
         """Packed-bit residency WRITE path: packed [n, B] uint8 rows in
         (B % 32 == 0), resolves to (packed_parity np [out_rows, B],
@@ -188,24 +318,30 @@ class BatchingQueue:
             raise ValueError(
                 "packedbit_resident requests must be 32-byte-column "
                 f"aligned, got width {rows.shape[1]}")
-        return self._submit(mbits, rows, w, out_rows, "packedbit_resident")
+        return self._submit(mbits, rows, w, out_rows, "packedbit_resident",
+                            span)
 
     def submit_packedbit_planes(
-        self, mbits: np.ndarray, planes, w: int, out_rows: int
+        self, mbits: np.ndarray, planes, w: int, out_rows: int, span=None
     ) -> "Future[object]":
         """Queue an XOR schedule over ALREADY-RESIDENT u32 plane words
         ([rows*8, Wc] uint32); resolves to the [out_rows*8, Wc] device
         buffer — no pack, the result stays resident for the next stage
         (the packed-bit mirror of submit_planar)."""
         assert w == 8, "packed-bit lane is the w=8 byte-layout lane"
-        return self._submit(mbits, planes, w, out_rows, "packedbit_planes")
+        return self._submit(mbits, planes, w, out_rows, "packedbit_planes",
+                            span)
 
-    def _submit(self, mbits, regions, w, out_rows, kind) -> Future:
+    def _submit(self, mbits, regions, w, out_rows, kind,
+                span=None) -> Future:
         fut: Future = Future()
         # the full dispatch signature: identical matrix BYTES under a
         # different w or output arity is a different computation; the
         # three lanes never share a dispatch (different layouts)
         key = (w, out_rows, kind, mbits.shape, mbits.tobytes())
+        now = time.monotonic()
+        if span is not None:
+            span.event(f"ec submit lane={kind}")
         with self._cv:
             if self._stop:
                 raise RuntimeError("BatchingQueue is closed")
@@ -213,8 +349,7 @@ class BatchingQueue:
             if group is None:
                 group = self._groups[key] = _Group(
                     mbits=mbits, w=w, out_rows=out_rows, kind=kind)
-            group.requests.append((regions, fut))
-            self.submits += 1
+            group.requests.append(_Request(regions, fut, now, span))
             # planar bit-plane submissions are 8x-expanded int8: count
             # their packed-equivalent size or the lane would flush at 1/8
             # the measured batch sweet spot
@@ -222,14 +357,19 @@ class BatchingQueue:
             group.pending_bytes += nbytes
             self._pending += nbytes
             if self._oldest is None:
-                self._oldest = time.monotonic()
+                self._oldest = now
             self._cv.notify()
+        self.perf.inc("submit")
+        self.perf.inc(f"submit_{kind}")
+        self.perf.inc(f"bytes_{kind}", nbytes)
         return fut
 
     def flush(self) -> None:
         """Synchronously drain everything queued right now."""
         with self._cv:
             groups = self._take_locked()
+        if groups:
+            self.perf.inc("flush_forced")
         self._dispatch(groups)
 
     def close(self) -> None:
@@ -287,9 +427,9 @@ class BatchingQueue:
                           kind=g.kind)
             while g.requests and (taken_bytes < budget
                                   or not part.requests):
-                regions, fut = g.requests.pop(0)
-                n = self._req_bytes(g.kind, g.mbits, regions)
-                part.requests.append((regions, fut))
+                req = g.requests.pop(0)
+                n = self._req_bytes(g.kind, g.mbits, req.regions)
+                part.requests.append(req)
                 part.pending_bytes += n
                 g.pending_bytes -= n
                 taken_bytes += n
@@ -319,9 +459,11 @@ class BatchingQueue:
         # still completes immediately.
         inflight: Optional[list] = None
         while True:
+            cause = None  # why this round was cut: bytes | delay
             with self._cv:
                 while not self._stop:
                     if self._pending >= self.max_pending_bytes:
+                        cause = "bytes"
                         break
                     if self._oldest is not None:
                         # pending work fills its normal coalescing window
@@ -330,6 +472,7 @@ class BatchingQueue:
                         # an eager take here would fragment batches
                         remaining = self.max_delay - (time.monotonic() - self._oldest)
                         if remaining <= 0:
+                            cause = "delay"
                             break
                         self._cv.wait(timeout=remaining)
                     elif inflight is not None:
@@ -341,10 +484,12 @@ class BatchingQueue:
                         self._complete_safe(inflight)
                     return
                 groups = self._take_locked(budget=self.max_pending_bytes)
+            if groups and cause is not None:
+                self.perf.inc(f"flush_{cause}")
             launched = self._launch_safe(groups)
             if inflight is not None:
                 if launched:
-                    self.overlapped_rounds += 1
+                    self.perf.inc("overlapped_rounds")
                 self._complete_safe(inflight)
                 inflight = None
             with self._cv:
@@ -354,11 +499,39 @@ class BatchingQueue:
             elif launched:
                 self._complete_safe(launched)
 
+    def _dispatch_span(self, g: _Group):
+        """A span for one device dispatch: child of the first submitter's
+        trace when one rode in (the OSD's `ec write`), else a root on the
+        queue's own tracer; None when neither exists (tracing off)."""
+        parent = next((req.span for req in g.requests
+                       if req.span is not None), None)
+        if parent is not None:
+            sp = parent.child("ec batch dispatch")
+        elif self.tracer is not None:
+            sp = self.tracer.new_trace("ec batch dispatch")
+        else:
+            return None
+        return (sp.tag("lane", g.kind)
+                  .tag("group_size", len(g.requests))
+                  .tag("bytes", g.pending_bytes))
+
     def _launch_safe(self, groups: List[_Group]) -> list:
         launched = []
         for g in groups:
             if not g.requests:
                 continue
+            now = time.monotonic()
+            # queue-wait: how long each request coalesced before launch
+            wait_s = 0.0
+            for req in g.requests:
+                w = now - req.t_submit
+                self.perf.tinc("queue_wait", w)
+                wait_s += w
+                if req.span is not None:
+                    req.span.event(f"ec coalesced lane={g.kind} "
+                                   f"group={len(g.requests)}")
+            wait_s /= len(g.requests)
+            sp = self._dispatch_span(g)
             try:
                 if g.kind == "planar":
                     state = self._launch_planar(g)
@@ -372,15 +545,22 @@ class BatchingQueue:
                     state = self._launch_packedbit_planes(g)
                 else:
                     state = self._launch_packed(g)
-                launched.append((g, state))
+                if sp is not None:
+                    sp.event("launched")
+                launched.append(_Launched(g, state, time.monotonic(), sp,
+                                          wait_s))
             except Exception as e:
+                if sp is not None:
+                    sp.event(f"launch failed: {type(e).__name__}")
+                    sp.finish()
                 self._fail_group(g, e)
         if launched and self._launch_hook is not None:
             self._launch_hook()
         return launched
 
     def _complete_safe(self, launched: list) -> None:
-        for g, state in launched:
+        for lc in launched:
+            g, state = lc.group, lc.state
             try:
                 if g.kind == "planar":
                     self._complete_planar(g, state)
@@ -395,19 +575,45 @@ class BatchingQueue:
                     # byte columns back out
                     self._complete_packed(g, state)
             except Exception as e:
+                if lc.span is not None:
+                    lc.span.event(f"complete failed: {type(e).__name__}")
+                    lc.span.finish()
                 self._fail_group(g, e)
+                continue
+            device_s = time.monotonic() - lc.t_launch
+            self.perf.tinc("dispatch_dev", device_s)
+            self.perf.hinc("group_size", len(g.requests))
+            if lc.span is not None:
+                lc.span.event("fan-out")
+                lc.span.finish()
+            for req in g.requests:
+                if req.span is not None:
+                    req.span.event(f"ec fan-out lane={g.kind}")
+            self.timeline.append({
+                "ts": time.time(), "lane": g.kind,
+                "group_size": len(g.requests),
+                "bytes": g.pending_bytes,
+                "queue_wait_s": round(lc.wait_s, 6),
+                "device_s": round(device_s, 6)})
 
     @staticmethod
     def _fail_group(g: _Group, e: Exception) -> None:
-        for _, fut in g.requests:
+        for req in g.requests:
             try:
-                fut.set_exception(e)
+                req.future.set_exception(e)
             except InvalidStateError:
                 pass
 
     def _dispatch(self, groups: List[_Group]) -> None:
         # synchronous drain (flush()/close()): launch then complete
         self._complete_safe(self._launch_safe(groups))
+
+    def _note_dispatch(self, nbytes: int, sharded: bool) -> None:
+        """Dispatch-complete accounting shared by every lane."""
+        self.perf.inc("dispatch")
+        if sharded:
+            self.perf.inc("sharded_dispatch")
+        self.perf.inc("bytes", nbytes)
 
 
     def _maybe_shard(self, batch, pad_np: bool, align: int = 1):
@@ -451,8 +657,8 @@ class BatchingQueue:
 
         from ceph_tpu.ops.gf2 import bucket_columns as _bucket
 
-        widths = [r.shape[1] for r, _ in g.requests]
-        batch = np.concatenate([r for r, _ in g.requests], axis=1)
+        widths = [req.regions.shape[1] for req in g.requests]
+        batch = np.concatenate([req.regions for req in g.requests], axis=1)
         pad = _bucket(batch.shape[1]) - batch.shape[1]
         if pad:
             batch = np.pad(batch, ((0, 0), (0, pad)))
@@ -488,11 +694,9 @@ class BatchingQueue:
     def _complete_packed(self, g: _Group, state) -> None:
         widths, out, sharded, nbytes = state
         out = np.asarray(out)  # blocks until compute + D2H done
-        self.dispatches += 1
-        self.sharded_dispatches += 1 if sharded else 0
-        self.bytes_dispatched += nbytes
+        self._note_dispatch(nbytes, sharded)
         off = 0
-        for width, (_, fut) in zip(widths, g.requests):
+        for width, req in zip(widths, g.requests):
             # a submitter may have been CANCELLED while waiting (an
             # async op torn down mid-flight propagates cancellation
             # into the future via asyncio.wrap_future): its slice is
@@ -500,7 +704,7 @@ class BatchingQueue:
             try:
                 # copy: a view would pin the whole batch buffer for as
                 # long as any single result stays alive
-                fut.set_result(out[:, off : off + width].copy())
+                req.future.set_result(out[:, off : off + width].copy())
             except InvalidStateError:
                 pass  # cancelled in the check-to-set window
             off += width
@@ -514,9 +718,10 @@ class BatchingQueue:
         from ceph_tpu.ops.gf2 import bucket_columns as _bucket
         from ceph_tpu.ops.gf2 import gf2_matmul
 
-        widths = [b.shape[1] for b, _ in g.requests]
-        batch = (g.requests[0][0] if len(g.requests) == 1
-                 else jnp.concatenate([b for b, _ in g.requests], axis=1))
+        widths = [req.regions.shape[1] for req in g.requests]
+        batch = (g.requests[0].regions if len(g.requests) == 1
+                 else jnp.concatenate([req.regions
+                                       for req in g.requests], axis=1))
         # pow2 column bucketing, same as the other lanes: varying
         # coalesced widths must not each compile a fresh gf2_matmul
         pad = _bucket(batch.shape[1]) - batch.shape[1]
@@ -528,14 +733,13 @@ class BatchingQueue:
 
     def _complete_planar(self, g: _Group, state) -> None:
         widths, out, sharded = state
-        self.dispatches += 1
-        self.sharded_dispatches += 1 if sharded else 0
-        self.bytes_dispatched += sum(w for w in widths) * g.mbits.shape[1] // 8
+        self._note_dispatch(
+            sum(w for w in widths) * g.mbits.shape[1] // 8, sharded)
         off = 0
-        for width, (_, fut) in zip(widths, g.requests):
+        for width, req in zip(widths, g.requests):
             try:
                 # device-side slice: stays planar-resident; no host copy
-                fut.set_result(out[:, off : off + width])
+                req.future.set_result(out[:, off : off + width])
             except InvalidStateError:
                 pass
             off += width
@@ -558,17 +762,15 @@ class BatchingQueue:
     def _complete_resident(self, g: _Group, state) -> None:
         widths, packed, all_bits, sharded, nbytes, cols = state
         packed = np.asarray(packed)  # blocks until ready
-        self.dispatches += 1
-        self.sharded_dispatches += 1 if sharded else 0
-        self.bytes_dispatched += nbytes
+        self._note_dispatch(nbytes, sharded)
         # planar columns per packed byte-column depends on w (w=16: B//2)
         cfac = all_bits.shape[1] / cols
         off = 0
-        for width, (_, fut) in zip(widths, g.requests):
+        for width, req in zip(widths, g.requests):
             try:
                 c0, c1 = int(off * cfac), int((off + width) * cfac)
-                fut.set_result((packed[:, off : off + width].copy(),
-                                all_bits[:, c0:c1]))
+                req.future.set_result((packed[:, off : off + width].copy(),
+                                   all_bits[:, c0:c1]))
             except InvalidStateError:
                 pass
             off += width
@@ -604,16 +806,14 @@ class BatchingQueue:
     def _complete_packedbit_resident(self, g: _Group, state) -> None:
         widths, packed, planes, sharded, nbytes = state
         packed = np.asarray(packed)  # blocks until ready
-        self.dispatches += 1
-        self.sharded_dispatches += 1 if sharded else 0
-        self.bytes_dispatched += nbytes
+        self._note_dispatch(nbytes, sharded)
         off = 0
-        for width, (_, fut) in zip(widths, g.requests):
+        for width, req in zip(widths, g.requests):
             try:
                 # 32 byte columns per u32 plane word (integer exact: the
                 # launch asserted width % 32 == 0)
-                fut.set_result((packed[:, off : off + width].copy(),
-                                planes[:, off // 32 : (off + width) // 32]))
+                req.future.set_result((packed[:, off : off + width].copy(),
+                                   planes[:, off // 32 : (off + width) // 32]))
             except InvalidStateError:
                 pass
             off += width
@@ -627,9 +827,10 @@ class BatchingQueue:
         from ceph_tpu.ops.gf2 import bucket_columns as _bucket
         from ceph_tpu.ops.gf2 import gf2_xor_packed
 
-        widths = [b.shape[1] for b, _ in g.requests]  # u32 words
-        batch = (g.requests[0][0] if len(g.requests) == 1
-                 else jnp.concatenate([b for b, _ in g.requests], axis=1))
+        widths = [req.regions.shape[1] for req in g.requests]  # u32 words
+        batch = (g.requests[0].regions if len(g.requests) == 1
+                 else jnp.concatenate([req.regions
+                                       for req in g.requests], axis=1))
         # pow2 word bucketing (lo=32 words == the byte lanes' 1024 cols)
         pad = _bucket(batch.shape[1], lo=32) - batch.shape[1]
         if pad:
@@ -640,16 +841,14 @@ class BatchingQueue:
 
     def _complete_packedbit_planes(self, g: _Group, state) -> None:
         widths, out, sharded = state
-        self.dispatches += 1
-        self.sharded_dispatches += 1 if sharded else 0
         # u32 plane words carry 1 bit/bit, so plane bytes == packed-
         # equivalent bytes (same arithmetic as _req_bytes: C rows x Wc
         # words x 4 B/word; no 8x int8 expansion to divide back out)
-        self.bytes_dispatched += sum(widths) * 4 * g.mbits.shape[1]
+        self._note_dispatch(sum(widths) * 4 * g.mbits.shape[1], sharded)
         off = 0
-        for width, (_, fut) in zip(widths, g.requests):
+        for width, req in zip(widths, g.requests):
             try:
-                fut.set_result(out[:, off : off + width])  # stays resident
+                req.future.set_result(out[:, off : off + width])  # stays resident
             except InvalidStateError:
                 pass
             off += width
@@ -689,6 +888,33 @@ class PlanarShardStore:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # the `planar_store` perf set mirrors the bare ints above (kept:
+        # eviction logic and tests read them) and adds the boundary
+        # latencies the ints can't carry (module-docstring schema)
+        self.perf = (
+            PerfCountersBuilder("planar_store")
+            .add_u64_counter("admit", "packed rows admitted (one unpack)")
+            .add_u64_counter("hit", "resident lookups served")
+            .add_u64_counter("miss", "lookups that fell to the packed path")
+            .add_u64_counter("evict", "LRU evictions under the byte budget")
+            .add_u64("resident_bytes", "planar HBM footprint (gauge)")
+            .add_u64("entries", "resident objects (gauge)")
+            .add_time_avg("pack_s",
+                          "device->host pack seconds at the exit boundary")
+            .add_time_avg("unpack_s",
+                          "host->device unpack seconds at admission")
+            .create_perf_counters())
+        # `perf reset` re-reads the live gauges instead of leaving the
+        # residency footprint misreported as 0 until the next admit
+        self.perf.resync = self._resync_gauges
+
+    def _resync_gauges(self) -> None:
+        # gauges are written INSIDE the store lock everywhere (here,
+        # put_planar, drop): an unlocked write could overwrite a newer
+        # value with a stale snapshot.  Lock order is store -> perf.
+        with self._lock:
+            self.perf.set("resident_bytes", self.resident_bytes)
+            self.perf.set("entries", len(self._entries))
 
     # -- host boundary (pack/unpack paid here, once) -------------------------
 
@@ -700,23 +926,26 @@ class PlanarShardStore:
         stores u32 plane words (w=8 only, 1/8th the footprint — the
         production lane), padding B out to whole words and trimming on
         read."""
-        if layout == "packedbit":
-            from ceph_tpu.ops.gf2 import to_packedbit
+        with self.perf.time_avg("unpack_s"):
+            if layout == "packedbit":
+                from ceph_tpu.ops.gf2 import to_packedbit
 
-            assert w == 8, "packed-bit residency is the w=8 byte layout"
-            B = rows.shape[1]
-            buf = np.ascontiguousarray(rows)
-            if B % 32:
-                buf = np.pad(buf, ((0, 0), (0, 32 - B % 32)))
-            bits = to_packedbit(buf)
-            self.put_planar(key, bits, w=w, n_rows=rows.shape[0], meta=meta,
-                            trim=B)
-        else:
-            from ceph_tpu.ops.gf2 import to_planar
+                assert w == 8, "packed-bit residency is the w=8 byte layout"
+                B = rows.shape[1]
+                buf = np.ascontiguousarray(rows)
+                if B % 32:
+                    buf = np.pad(buf, ((0, 0), (0, 32 - B % 32)))
+                bits = to_packedbit(buf)
+                self.put_planar(key, bits, w=w, n_rows=rows.shape[0],
+                                meta=meta, trim=B)
+            else:
+                from ceph_tpu.ops.gf2 import to_planar
 
-            bits = to_planar(np.ascontiguousarray(rows), w)
-            self.put_planar(key, bits, w=w, n_rows=rows.shape[0], meta=meta)
+                bits = to_planar(np.ascontiguousarray(rows), w)
+                self.put_planar(key, bits, w=w, n_rows=rows.shape[0],
+                                meta=meta)
         self.admits += 1
+        self.perf.inc("admit")
         return bits
 
     def read(self, key: Any) -> Optional[np.ndarray]:
@@ -730,13 +959,15 @@ class PlanarShardStore:
         if np.dtype(bits.dtype) == np.uint32:
             from ceph_tpu.ops.gf2 import from_packedbit
 
-            out = np.asarray(from_packedbit(bits, n_rows))
+            with self.perf.time_avg("pack_s"):
+                out = np.asarray(from_packedbit(bits, n_rows))
             with self._lock:
                 trim = self._trim.get(key)
             return out if trim is None else out[:, :trim]
         from ceph_tpu.ops.gf2 import from_planar
 
-        return np.asarray(from_planar(bits, w, n_rows))
+        with self.perf.time_avg("pack_s"):
+            return np.asarray(from_planar(bits, w, n_rows))
 
     # -- resident side (no pack/unpack anywhere below) -----------------------
 
@@ -764,11 +995,18 @@ class PlanarShardStore:
             else:
                 self._trim[key] = trim
             self.resident_bytes += nbytes
+            evicted = 0
             while self.resident_bytes > self.capacity_bytes and self._entries:
                 old_key, _ = self._entries.popitem(last=False)
                 self.resident_bytes -= self._bytes.pop(old_key)
                 self._trim.pop(old_key, None)
                 self.evictions += 1
+                evicted += 1
+            # gauge writes stay under the store lock (see _resync_gauges)
+            self.perf.set("resident_bytes", self.resident_bytes)
+            self.perf.set("entries", len(self._entries))
+        if evicted:
+            self.perf.inc("evict", evicted)
 
     def get_planar(self, key: Any):
         """(bits, w, n_rows, meta) or None; refreshes LRU position."""
@@ -776,10 +1014,11 @@ class PlanarShardStore:
             ent = self._entries.get(key)
             if ent is None:
                 self.misses += 1
-                return None
-            self._entries.move_to_end(key)
-            self.hits += 1
-            return ent
+            else:
+                self._entries.move_to_end(key)
+                self.hits += 1
+        self.perf.inc("hit" if ent is not None else "miss")
+        return ent
 
     def apply(self, key: Any, mbits: np.ndarray, out_rows: int,
               out_key: Any = None):
@@ -824,6 +1063,8 @@ class PlanarShardStore:
                 del self._entries[key]
                 self.resident_bytes -= self._bytes.pop(key)
                 self._trim.pop(key, None)
+            self.perf.set("resident_bytes", self.resident_bytes)
+            self.perf.set("entries", len(self._entries))
 
     def __contains__(self, key: Any) -> bool:
         with self._lock:

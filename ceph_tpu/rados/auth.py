@@ -28,7 +28,19 @@ import os
 import time
 from typing import Dict, Optional, Tuple
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # gated: hosts without `cryptography` still run
+    # plaintext clusters; ticket sealing / ms_secure_mode raise on USE
+    # (a missing crypto backend must never silently downgrade security)
+    AESGCM = None
+
+
+def _require_aesgcm():
+    if AESGCM is None:
+        raise RuntimeError(
+            "the `cryptography` package is required for cephx tickets / "
+            "ms_secure_mode but is not installed")
 
 TICKET_TTL = 3600.0  # auth_service_ticket_ttl role
 
@@ -60,6 +72,7 @@ class KeyServer:
         goes back to the requester in the clear over its already-
         authenticated mon connection."""
         now = time.time() if now is None else now
+        _require_aesgcm()
         session_key = os.urandom(32)
         body = json.dumps({
             "entity": entity,
@@ -94,6 +107,7 @@ class TicketKeyring:
         now = time.time() if now is None else now
         if len(blob) < 17:
             return None
+        _require_aesgcm()
         key_id = int.from_bytes(blob[:4], "big")
         secret = self.keys.get(key_id)
         if secret is None:
@@ -117,6 +131,7 @@ class SecureStream:
     unchanged.  Installed AFTER the plaintext handshake."""
 
     def __init__(self, reader, writer, key: bytes):
+        _require_aesgcm()
         self._reader = reader
         self._writer = writer
         self._gcm = AESGCM(key)

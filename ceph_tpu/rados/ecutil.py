@@ -164,7 +164,7 @@ def _packedbit_route(codec) -> bool:
 
 
 def _queue_encode_plan(codec, sinfo: StripeInfo, arr: np.ndarray,
-                       n_stripes: int, queue):
+                       n_stripes: int, queue, span=None):
     """When the codec/queue combination is batchable (byte-layout bit
     seam, no chunk remap), submit the whole buffer as ONE queue request
     and return (future, reassemble) — reassemble turns the parity rows
@@ -184,9 +184,10 @@ def _queue_encode_plan(codec, sinfo: StripeInfo, arr: np.ndarray,
     if _packedbit_route(codec):
         # production lane: static XOR schedule over u32 plane words
         fut = queue.submit_packedbit(
-            np.asarray(mbits).astype(np.uint8), flat, w, m)
+            np.asarray(mbits).astype(np.uint8), flat, w, m, span=span)
     else:
-        fut = queue.submit(np.asarray(mbits).astype(np.int8), flat, w, m)
+        fut = queue.submit(np.asarray(mbits).astype(np.int8), flat, w, m,
+                           span=span)
 
     def reassemble(parity: np.ndarray) -> List[np.ndarray]:
         p = np.asarray(parity).reshape(m, n_stripes, sinfo.chunk_size)
@@ -201,7 +202,7 @@ def _queue_encode_plan(codec, sinfo: StripeInfo, arr: np.ndarray,
 
 
 def batched_encode(codec, sinfo: StripeInfo, data: bytes,
-                   queue=None) -> List[np.ndarray]:
+                   queue=None, span=None) -> List[np.ndarray]:
     """Encode a multi-stripe buffer with ONE device dispatch.
 
     The reference's ECUtil::encode calls the codec once per stripe_width
@@ -237,7 +238,8 @@ def batched_encode(codec, sinfo: StripeInfo, data: bytes,
         # Single-stripe objects ride the queue too — coalescing across
         # OBJECTS/ops is the point (SURVEY.md §7.5), and small concurrent
         # writes are exactly the dispatch-latency-bound workload.
-        planned = _queue_encode_plan(codec, sinfo, arr, n_stripes, queue)
+        planned = _queue_encode_plan(codec, sinfo, arr, n_stripes, queue,
+                                     span=span)
         if planned is not None:
             fut, reassemble = planned
             return reassemble(fut.result())
@@ -262,7 +264,7 @@ def batched_encode(codec, sinfo: StripeInfo, data: bytes,
 
 
 async def batched_encode_async(codec, sinfo: StripeInfo, data: bytes,
-                               queue=None) -> List[np.ndarray]:
+                               queue=None, span=None) -> List[np.ndarray]:
     """Event-loop-friendly batched_encode: the queue future is AWAITED,
     so concurrent ops keep submitting while this one waits — that
     concurrency is what the queue coalesces into one device dispatch."""
@@ -275,7 +277,8 @@ async def batched_encode_async(codec, sinfo: StripeInfo, data: bytes,
             n_stripes = max(1, len(padded) // sinfo.stripe_width)
             arr = np.frombuffer(padded, dtype=np.uint8).reshape(
                 n_stripes, k, sinfo.chunk_size)
-            planned = _queue_encode_plan(codec, sinfo, arr, n_stripes, queue)
+            planned = _queue_encode_plan(codec, sinfo, arr, n_stripes, queue,
+                                         span=span)
             if planned is not None:
                 fut, reassemble = planned
                 return reassemble(await asyncio.wrap_future(fut))
@@ -284,7 +287,7 @@ async def batched_encode_async(codec, sinfo: StripeInfo, data: bytes,
 
 def _queue_decode_plan(codec, sinfo: StripeInfo,
                        arrays: Dict[int, np.ndarray], object_size: int,
-                       queue):
+                       queue, span=None):
     """Queue submission for a reconstructing decode: CPU picks/inverts
     the decode matrix via the codec's OWN selection rule (LRU-cached per
     erasure signature, the ISA table cache design), the device applies it
@@ -324,10 +327,10 @@ def _queue_decode_plan(codec, sinfo: StripeInfo,
         # behind the gf2 LRU (per-decode-signature compilation — the
         # ErasureCodeIsaTableCache design at compile scope)
         fut = queue.submit_packedbit(
-            inv_bm.astype(np.uint8), src, codec.w, len(missing))
+            inv_bm.astype(np.uint8), src, codec.w, len(missing), span=span)
     else:
         fut = queue.submit(inv_bm.astype(np.int8), src, codec.w,
-                           len(missing))
+                           len(missing), span=span)
 
     def finish(rows: np.ndarray) -> bytes:
         rebuilt = np.asarray(rows)
@@ -370,7 +373,7 @@ def _all_data_fast(codec, arrays: Dict[int, np.ndarray], cs: int,
 
 def decode_object(codec, sinfo: StripeInfo,
                   blobs: Dict[int, np.ndarray], object_size: int,
-                  queue=None) -> bytes:
+                  queue=None, span=None) -> bytes:
     """Reconstruct a striped object from per-shard blobs (each the
     concatenation of that shard's per-stripe chunks) and de-interleave
     back to logical byte order, trimmed to `object_size`.
@@ -388,7 +391,8 @@ def decode_object(codec, sinfo: StripeInfo,
     if fast is not None:
         return fast
     if queue is not None:
-        planned = _queue_decode_plan(codec, sinfo, arrays, object_size, queue)
+        planned = _queue_decode_plan(codec, sinfo, arrays, object_size, queue,
+                                     span=span)
         if planned is not None:
             fut, finish = planned
             return finish(fut.result())
@@ -410,7 +414,8 @@ def decode_object(codec, sinfo: StripeInfo,
 
 async def decode_object_async(codec, sinfo: StripeInfo,
                               blobs: Dict[int, np.ndarray],
-                              object_size: int, queue=None) -> bytes:
+                              object_size: int, queue=None,
+                              span=None) -> bytes:
     """Event-loop-friendly decode_object (see batched_encode_async)."""
     if queue is not None:
         import asyncio
@@ -422,7 +427,8 @@ async def decode_object_async(codec, sinfo: StripeInfo,
                               object_size)
         if fast is not None:
             return fast
-        planned = _queue_decode_plan(codec, sinfo, arrays, object_size, queue)
+        planned = _queue_decode_plan(codec, sinfo, arrays, object_size, queue,
+                                     span=span)
         if planned is not None:
             fut, finish = planned
             return finish(await asyncio.wrap_future(fut))
@@ -452,7 +458,7 @@ def planar_eligible(codec) -> bool:
 
 
 async def planar_encode_async(codec, sinfo: StripeInfo, data: bytes,
-                              queue=None):
+                              queue=None, span=None):
     """Encode with planar residency: the data rows ride the queue's
     RESIDENT lane — one fused batched device call (unpack + matmul +
     parity pack) shared with every concurrent op — and come back as
@@ -490,10 +496,11 @@ async def planar_encode_async(codec, sinfo: StripeInfo, data: bytes,
     if queue is not None:
         if packedbit:
             parity, all_bits = await asyncio.wrap_future(
-                queue.submit_packedbit_resident(mbits, flat, w, m))
+                queue.submit_packedbit_resident(mbits, flat, w, m,
+                                                span=span))
         else:
             parity, all_bits = await asyncio.wrap_future(
-                queue.submit_resident(mbits, flat, w, m))
+                queue.submit_resident(mbits, flat, w, m, span=span))
     else:
         from ceph_tpu.ops.gf2 import (bucket_columns, gf2_encode_resident,
                                       gf2_encode_packedbit_resident)

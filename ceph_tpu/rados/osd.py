@@ -365,6 +365,34 @@ class OSD:
             shared_planar_store(
                 int(self.conf.get("osd_ec_planar_bytes", 0) or 0))
             if self.conf.get("osd_ec_planar_residency", True) else None)
+        # EC data-plane observability: ONE `perf dump` on this daemon
+        # carries the whole pipeline breakdown — the messenger's `wire`
+        # set (framing vs socket io), the shared queue's `ec_tpu` set
+        # (per-lane submits/bytes, queue-wait/dispatch latencies, flush
+        # causes), the gf2 `gf2_sched` schedule-cache set, the tpu
+        # plugin's `ec_plugin` seam set (device dispatches vs CPU
+        # fallbacks — the non-queue path), and the planar store's
+        # `planar_store` residency set.  The queue/store/sched/plugin
+        # sets are process-shared (as the resources are); every
+        # colocated OSD dumps the same numbers.
+        self.ctx.perf.add(self.messenger.perf)
+        from ceph_tpu.ops.gf2 import SCHED_PERF
+
+        self.ctx.perf.add(SCHED_PERF)
+        try:
+            from ceph_tpu.ec.plugins.tpu import PLUGIN_PERF
+
+            self.ctx.perf.add(PLUGIN_PERF)
+        except ImportError:  # plugin tier absent: nothing to count
+            pass
+        if self._ec_queue is not None:
+            self.ctx.perf.add(self._ec_queue.perf)
+            if self._ec_queue.tracer is None:
+                # dispatch spans with no submitter parent (repair/bench
+                # traffic) root in this daemon's trace ring
+                self._ec_queue.tracer = self.ctx.tracer
+        if self._planar is not None:
+            self.ctx.perf.add(self._planar.perf)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -414,6 +442,10 @@ class OSD:
         self._hb_task = loop.create_task(self._heartbeat_loop(interval))
         self.op_queue.start()
         self.ctx.name = f"osd.{self.osd_id}"
+        if self._ec_queue is not None:
+            # in-process execute() works without the unix socket, so the
+            # timeline command registers whether or not asok_dir is set
+            self._ec_queue.register_asok(self.ctx.asok)
         asok_dir = self.conf.get("admin_socket_dir")
         if asok_dir:
             self.ctx.asok.register(
@@ -1994,12 +2026,14 @@ class OSD:
             # full-object write: leave the shard rows planar-resident so
             # later decodes / repair re-encodes skip the unpack boundary
             planar = await planar_encode_async(codec, sinfo, data,
-                                               queue=self._ec_queue)
+                                               queue=self._ec_queue,
+                                               span=span)
         if planar is not None:
             blobs = planar[0]
         else:
             blobs = await batched_encode_async(codec, sinfo, data,
-                                               queue=self._ec_queue)
+                                               queue=self._ec_queue,
+                                               span=span)
         span.event("encoded")
         # one crc pass per shard, shared by the hinfo record and every
         # sub-write's chunk_crc (a fresh object's chained hinfo crc IS

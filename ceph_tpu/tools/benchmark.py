@@ -49,7 +49,35 @@ def parse_args(argv=None):
                    help="add a parameter to the erasure code profile (k=v)")
     p.add_argument("--directory", default="",
                    help="plugin directory (ec_<name>.py files)")
+    p.add_argument("--perf-dump", action="store_true",
+                   help="after the run, print the gf2_sched/ec_plugin "
+                        "perf counter snapshot as JSON on stderr (stdout "
+                        "keeps the reference '<seconds>\\t<KB>' protocol)")
     return p.parse_args(argv)
+
+
+def perf_dump_json() -> str:
+    """The EC data-plane counter sets this CLI can exercise, as one JSON
+    object: `gf2_sched` (schedule-cache hit/miss/compile/CSE) and
+    `ec_plugin` (device dispatches vs CPU fallbacks through the tpu
+    plugin seams).  Used with --perf-dump so BENCH-style harnesses can
+    snapshot the breakdown without an admin socket."""
+    import json
+
+    sets = {}
+    try:
+        from ceph_tpu.ops.gf2 import SCHED_PERF
+
+        sets["gf2_sched"] = SCHED_PERF.dump()
+    except Exception:
+        pass
+    try:
+        from ceph_tpu.ec.plugins.tpu import PLUGIN_PERF
+
+        sets["ec_plugin"] = PLUGIN_PERF.dump()
+    except Exception:
+        pass
+    return json.dumps(sets)
 
 
 def build_profile(args):
@@ -172,8 +200,12 @@ def main(argv=None) -> int:
         return 1
     try:
         if args.workload == "encode":
-            return bench_encode(codec, args)
-        return bench_decode(codec, args)
+            code = bench_encode(codec, args)
+        else:
+            code = bench_decode(codec, args)
+        if args.perf_dump:
+            print(perf_dump_json(), file=sys.stderr)
+        return code
     except Exception as e:
         print(f"{type(e).__name__}: {e}", file=sys.stderr)
         return 1

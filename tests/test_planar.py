@@ -8,6 +8,7 @@ through both the service layer and the OSD data path."""
 
 import asyncio
 import os
+import time
 
 import numpy as np
 import pytest
@@ -321,7 +322,7 @@ class TestTransferOverlap:
         from ceph_tpu.ec.gf import gf
         from ceph_tpu.ec.matrices import (matrix_to_bitmatrix,
                                           vandermonde_coding_matrix)
-        from ceph_tpu.parallel.service import _Group
+        from ceph_tpu.parallel.service import _Group, _Request
 
         k, m, w = 4, 2, 8
         mat = vandermonde_coding_matrix(k, m, w)
@@ -337,7 +338,8 @@ class TestTransferOverlap:
                 futs = []
                 for d in datas:
                     f = Future()
-                    g.requests.append((d, f))
+                    g.requests.append(
+                        _Request(d, f, time.monotonic(), None))
                     futs.append(f)
                 return g, futs
 
