@@ -804,6 +804,7 @@ def main() -> int:
     daemon_get_mbps = 0.0
     daemon_wire_put_mbps = 0.0
     daemon_wire_get_mbps = 0.0
+    daemon_wire_perf: dict = {}
     try:
         import subprocess
 
@@ -819,6 +820,7 @@ def main() -> int:
             daemon_get_mbps = got.get("get_MBps", 0.0)
             daemon_wire_put_mbps = got.get("wire_put_MBps", 0.0)
             daemon_wire_get_mbps = got.get("wire_get_MBps", 0.0)
+            daemon_wire_perf = got.get("wire_perf", {})
     except Exception:
         pass
 
@@ -908,8 +910,72 @@ def main() -> int:
         "daemon_get_MBps": round(daemon_get_mbps, 1),
         "daemon_wire_put_MBps": round(daemon_wire_put_mbps, 1),
         "daemon_wire_get_MBps": round(daemon_wire_get_mbps, 1),
+        # the `wire` perf snapshot of the daemon TCP run (framing-vs-io
+        # averages, per-type counts, flush-size histogram): the
+        # framing/io split trends round over round alongside the MB/s
+        "wire_perf": daemon_wire_perf,
     }))
     return 0
+
+
+def _wire_perf_summary(dumps) -> dict:
+    """Aggregate the `wire` perf sets of every daemon in the bench
+    cluster into the BENCH-record snapshot: the framing-vs-io split
+    (tx_framing/rx_framing/tx_io/rx_io longrunavgs), per-message-type
+    byte/message counts, and the corked-outbox flush-size histogram —
+    so the framing/io trend and the flush batching are visible round
+    over round, not just the headline MB/s."""
+    avgs = {}
+    for name in ("tx_framing", "tx_io", "rx_io", "rx_framing"):
+        c = sum(d.get(name, {}).get("avgcount", 0) for d in dumps)
+        s = sum(d.get(name, {}).get("sum", 0.0) for d in dumps)
+        avgs[name] = {"avgcount": c, "sum_s": round(s, 6),
+                      "avg_us": round(s / c * 1e6, 3) if c else 0.0}
+    counters = {}
+    for name in ("tx_msgs", "tx_bytes", "rx_msgs", "rx_bytes",
+                 "tx_flushes", "tx_flush_data", "tx_flush_ack",
+                 "tx_acks", "tx_acks_coalesced", "tx_crc_reused",
+                 "rx_batches", "local_msgs"):
+        counters[name] = sum(d.get(name, 0) for d in dumps
+                             if isinstance(d.get(name, 0), int))
+    # per-message socket time: the number the corked outbox moves —
+    # tx_io is per FLUSH WINDOW, so batching drives this down while
+    # tx_msgs stays put
+    tx_msgs = counters["tx_msgs"]
+    per_msg = {
+        "tx_io_per_msg_us": round(
+            avgs["tx_io"]["sum_s"] / tx_msgs * 1e6, 3) if tx_msgs else 0.0,
+        "tx_framing_per_msg_us": round(
+            avgs["tx_framing"]["sum_s"] / tx_msgs * 1e6, 3)
+        if tx_msgs else 0.0,
+    }
+    hists = {}
+    for name in ("tx_flush_frames", "tx_flush_bytes", "rx_batch_msgs"):
+        buckets = [0] * 32
+        count = 0
+        total = 0.0
+        for d in dumps:
+            h = d.get(name)
+            if isinstance(h, dict) and "buckets" in h:
+                for i, v in enumerate(h["buckets"]):
+                    buckets[i] += v
+                count += h.get("count", 0)
+                total += h.get("sum", 0.0)
+        while buckets and not buckets[-1]:
+            buckets.pop()
+        hists[name] = {"count": count, "sum": total, "buckets": buckets,
+                       "mean": round(total / count, 2) if count else 0.0}
+    per_type = {}
+    for d in dumps:
+        for k, v in d.items():
+            if not isinstance(v, int):
+                continue
+            if k.startswith(("tx_bytes_", "rx_bytes_")) or (
+                    k.startswith(("tx_", "rx_"))
+                    and k.split("_", 1)[1][:1].isupper()):
+                per_type[k] = per_type.get(k, 0) + v
+    return {"avgs": avgs, "counters": counters, "per_msg": per_msg,
+            "flush_hist": hists, "per_type": per_type}
 
 
 def daemon_path_bench() -> int:
@@ -943,6 +1009,11 @@ def daemon_path_bench() -> int:
             payload = np.random.default_rng(0).integers(
                 0, 256, size, dtype=np.uint8).tobytes()
             await c.put(pool, "warm", payload[:1 << 20])
+            # isolate the measured window in the wire counters: the
+            # warm put's handshake/boot traffic is not the data plane
+            for osd in cluster.osds.values():
+                osd.messenger.perf.reset()
+            c.messenger.perf.reset()
             # best-of-3 (timeit's min discipline): single-core hosts
             # swing 3x run to run on page-allocation churn; the delete
             # between trials returns the buffers so each trial measures
@@ -955,20 +1026,24 @@ def daemon_path_bench() -> int:
                 t0 = time.perf_counter()
                 got = await c.get(pool, "big")
                 get_dt = min(get_dt, time.perf_counter() - t0)
-                assert got == payload
+                assert bytes(got) == payload
                 await c.delete(pool, "big")
+            wire_perf = _wire_perf_summary(
+                [o.messenger.perf.dump() for o in cluster.osds.values()]
+                + [c.messenger.perf.dump()])
             await c.stop()
-            return put_dt, get_dt
+            return put_dt, get_dt, wire_perf
         finally:
             await cluster.stop()
 
-    put_dt, get_dt = asyncio.run(go(True))
-    wire_put_dt, wire_get_dt = asyncio.run(go(False))
+    put_dt, get_dt, _ = asyncio.run(go(True))
+    wire_put_dt, wire_get_dt, wire_perf = asyncio.run(go(False))
     print(json.dumps({
         "put_MBps": round(size / put_dt / 1e6, 1),
         "get_MBps": round(size / get_dt / 1e6, 1),
         "wire_put_MBps": round(size / wire_put_dt / 1e6, 1),
-        "wire_get_MBps": round(size / wire_get_dt / 1e6, 1)}))
+        "wire_get_MBps": round(size / wire_get_dt / 1e6, 1),
+        "wire_perf": wire_perf}))
     return 0
 
 

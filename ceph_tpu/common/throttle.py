@@ -59,6 +59,17 @@ class Throttle:
             self._wake()
             raise
 
+    def would_admit(self, amount: int) -> bool:
+        """True when get(amount) would return WITHOUT waiting — the
+        messenger's rx batching peeks this before pulling another frame
+        into a batch, because blocking on the throttle while holding
+        undispatched frames (whose cost is only put() back after
+        dispatch) would deadlock the serve loop against itself."""
+        if self.max == 0:
+            return True
+        return not self._waiters and (
+            self.current + amount <= self.max or self.current == 0)
+
     def put(self, amount: int) -> None:
         self.current = max(0, self.current - amount)
         self._wake()

@@ -89,6 +89,9 @@ def _build_ec_tpu_perf() -> PerfCounters:
       queue_wait           longrunavg  submit -> launch wait per request
       dispatch_dev         longrunavg  launch -> fan-out device seconds per dispatch
       group_size           histogram   coalesced requests per dispatch (pow2 buckets)
+      submit_group         u64         multi-item submit_group() calls (the
+                                       whole-stripe-group handoff seam)
+      group_submit_size    histogram   items per submit_group() call
       flush_bytes          u64         rounds cut by the bytes threshold
       flush_delay          u64         rounds cut by max_delay expiry
       flush_forced         u64         rounds cut by an explicit flush()/close()
@@ -109,6 +112,8 @@ def _build_ec_tpu_perf() -> PerfCounters:
     b.add_time_avg("queue_wait", "submit -> launch coalescing wait")
     b.add_time_avg("dispatch_dev", "launch -> fan-out device time")
     b.add_histogram("group_size", "coalesced requests per dispatch")
+    b.add_u64_counter("submit_group", "multi-item group submits")
+    b.add_histogram("group_submit_size", "items per group submit")
     b.add_u64_counter("flush_bytes", "rounds flushed by the bytes threshold")
     b.add_u64_counter("flush_delay", "rounds flushed by max_delay expiry")
     b.add_u64_counter("flush_forced", "rounds flushed by explicit flush()")
@@ -332,32 +337,73 @@ class BatchingQueue:
         return self._submit(mbits, planes, w, out_rows, "packedbit_planes",
                             span)
 
-    def _submit(self, mbits, regions, w, out_rows, kind,
-                span=None) -> Future:
-        fut: Future = Future()
+    def submit_group(self, items, span=None) -> List[Future]:
+        """Group-aware submit (the messenger/recovery whole-stripe-group
+        handoff seam): queue a LIST of lane submissions — each item is
+        (mbits, regions, w, out_rows, kind) — under ONE lock acquisition
+        and ONE worker wakeup, so a coalesced group of objects reaches
+        the EC tier as a single buffer-list submission instead of N
+        contended submits.  Items sharing a dispatch signature land in
+        the same _Group exactly as per-item submits would; returns the
+        per-item futures, index-aligned."""
+        futs: List[Future] = []
+        sizes: List[int] = []
+        now = time.monotonic()
+        if span is not None:
+            span.event(f"ec submit group n={len(items)}")
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("BatchingQueue is closed")
+            for mbits, regions, w, out_rows, kind in items:
+                fut: Future = Future()
+                futs.append(fut)
+                sizes.append(self._queue_locked(
+                    mbits, regions, w, out_rows, kind, fut, now, span))
+            if items:
+                self._cv.notify()
+        for (_, _, _, _, kind), nbytes in zip(items, sizes):
+            self.perf.inc("submit")
+            self.perf.inc(f"submit_{kind}")
+            self.perf.inc(f"bytes_{kind}", nbytes)
+        if len(items) > 1:
+            self.perf.inc("submit_group")
+            self.perf.hinc("group_submit_size", len(items))
+        return futs
+
+    def _queue_locked(self, mbits, regions, w, out_rows, kind, fut,
+                      now, span) -> int:
+        """Insert one request into its dispatch group (caller holds the
+        lock).  Returns the packed-equivalent byte size counted."""
         # the full dispatch signature: identical matrix BYTES under a
         # different w or output arity is a different computation; the
         # three lanes never share a dispatch (different layouts)
         key = (w, out_rows, kind, mbits.shape, mbits.tobytes())
+        group = self._groups.get(key)
+        if group is None:
+            group = self._groups[key] = _Group(
+                mbits=mbits, w=w, out_rows=out_rows, kind=kind)
+        group.requests.append(_Request(regions, fut, now, span))
+        # planar bit-plane submissions are 8x-expanded int8: count
+        # their packed-equivalent size or the lane would flush at 1/8
+        # the measured batch sweet spot
+        nbytes = self._req_bytes(kind, mbits, regions)
+        group.pending_bytes += nbytes
+        self._pending += nbytes
+        if self._oldest is None:
+            self._oldest = now
+        return nbytes
+
+    def _submit(self, mbits, regions, w, out_rows, kind,
+                span=None) -> Future:
+        fut: Future = Future()
         now = time.monotonic()
         if span is not None:
             span.event(f"ec submit lane={kind}")
         with self._cv:
             if self._stop:
                 raise RuntimeError("BatchingQueue is closed")
-            group = self._groups.get(key)
-            if group is None:
-                group = self._groups[key] = _Group(
-                    mbits=mbits, w=w, out_rows=out_rows, kind=kind)
-            group.requests.append(_Request(regions, fut, now, span))
-            # planar bit-plane submissions are 8x-expanded int8: count
-            # their packed-equivalent size or the lane would flush at 1/8
-            # the measured batch sweet spot
-            nbytes = self._req_bytes(kind, mbits, regions)
-            group.pending_bytes += nbytes
-            self._pending += nbytes
-            if self._oldest is None:
-                self._oldest = now
+            nbytes = self._queue_locked(mbits, regions, w, out_rows, kind,
+                                        fut, now, span)
             self._cv.notify()
         self.perf.inc("submit")
         self.perf.inc(f"submit_{kind}")

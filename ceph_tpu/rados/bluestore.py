@@ -76,11 +76,29 @@ class _Onode:
     csum_type: str = "crc32c"
 
 
+# gated like auth.py's `cryptography` import: hosts without `zstandard`
+# still run every non-zstd cluster shape — only the actual use of a
+# zstd-compressed blob raises (writes degrade to raw with a warning at
+# the caller; reads of an EXISTING zstd blob must raise, never return
+# garbage)
+try:
+    import zstandard as _zstandard
+except ImportError:
+    _zstandard = None
+
+
+def _require_zstd():
+    if _zstandard is None:
+        raise ImportError(
+            "the `zstandard` package is required for zstd-compressed "
+            "blobs but is not installed; pick compression_algorithm "
+            "zlib/lzma or install zstandard")
+    return _zstandard
+
+
 def _compress(algo: str, raw) -> bytes:
     if algo == "zstd":
-        import zstandard
-
-        return zstandard.ZstdCompressor(level=1).compress(bytes(raw))
+        return _require_zstd().ZstdCompressor(level=1).compress(bytes(raw))
     if algo == "lzma":
         import lzma
 
@@ -90,9 +108,7 @@ def _compress(algo: str, raw) -> bytes:
 
 def _decompress(algo: str, data: bytes) -> bytes:
     if algo == "zstd":
-        import zstandard
-
-        return zstandard.ZstdDecompressor().decompress(data)
+        return _require_zstd().ZstdDecompressor().decompress(data)
     if algo == "lzma":
         import lzma
 

@@ -13,7 +13,7 @@ import errno
 import uuid
 from typing import Dict, List, Optional, Tuple
 
-from ceph_tpu.rados.messenger import Messenger
+from ceph_tpu.rados.messenger import BufferList, Messenger
 from ceph_tpu.rados.monclient import MonTargets
 from ceph_tpu.rados.types import (
     MAuthTicket,
@@ -99,6 +99,10 @@ class RadosClient:
 
     async def start(self) -> None:
         self.messenger.dispatcher = self._dispatch
+        # rx batches resolve their reply futures in one pass (and the
+        # batch's frames get ONE piggybacked ack instead of one each —
+        # an op-reply flood from a busy primary costs a single flush)
+        self.messenger.group_dispatcher = self._dispatch_group
         await self.messenger.bind()
         if self.conf.get("auth_cephx", False):
             await self._fetch_ticket()
@@ -127,6 +131,24 @@ class RadosClient:
             if t is not None and not t.done():
                 t.cancel()
         await self.messenger.shutdown()
+
+    async def _dispatch_group(self, conn, msgs) -> None:
+        """A whole rx batch (already-buffered frames): replies resolve
+        their futures back-to-back; per-message work is future-set cheap,
+        so order-preserving serial dispatch is the right partition here —
+        the win is the messenger's single cumulative ack for the batch.
+        Per-message isolation matches the serve loop's: one raising
+        message (e.g. a watch-ack dial failing) must not drop — and
+        still ack — the rest of the batch."""
+        for msg in msgs:
+            try:
+                await self._dispatch(conn, msg)
+            except (asyncio.CancelledError, GeneratorExit):
+                raise
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
 
     async def _dispatch(self, conn, msg) -> None:
         if isinstance(msg, MWatchNotify):
@@ -550,7 +572,13 @@ class RadosClient:
         self._check_oid(oid)
         reply = await self._op(MOSDOp(op="read", pool_id=pool_id, oid=oid,
                                       snap_read=int(snap)))
-        return reply.data
+        data = reply.data
+        if isinstance(data, BufferList):
+            # colocated fastpath hands the primary's scatter-gather read
+            # reply over by reference; materialize at the API boundary
+            # (the wire path already delivered one contiguous buffer)
+            data = data.tobytes()
+        return data
 
     async def delete(self, pool_id: int, oid: str,
                      snapc: Optional[Tuple[int, List[int]]] = None) -> None:

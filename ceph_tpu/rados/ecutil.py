@@ -80,6 +80,10 @@ class StripeInfo:
         want = self.logical_to_next_stripe_offset(len(data))
         if want == len(data):
             return data  # aligned: no copy on the hot path
+        if not isinstance(data, (bytes, bytearray)):
+            # buffer view (an rx blob landed uninitialized): materialize
+            # for the pad concat — only UNALIGNED tails pay this
+            data = bytes(data)
         return data + b"\x00" * (want - len(data))
 
 
@@ -163,13 +167,14 @@ def _packedbit_route(codec) -> bool:
     return packedbit_enabled() and getattr(codec, "w", 8) == 8
 
 
-def _queue_encode_plan(codec, sinfo: StripeInfo, arr: np.ndarray,
-                       n_stripes: int, queue, span=None):
-    """When the codec/queue combination is batchable (byte-layout bit
-    seam, no chunk remap), submit the whole buffer as ONE queue request
-    and return (future, reassemble) — reassemble turns the parity rows
-    into the per-shard blob list.  None when the queue path does not
-    apply (packet-layout, mapped, or sub-chunk codecs)."""
+def _encode_plan_parts(codec, sinfo: StripeInfo, arr: np.ndarray,
+                       n_stripes: int):
+    """The submit-free half of the queue encode plan: when the codec is
+    batchable (byte-layout bit seam, no chunk remap), returns
+    (kind, mbits, flat, w, m, reassemble) — the exact lane submission a
+    caller can hand to queue.submit/submit_packedbit, or (with several
+    buffers) to BatchingQueue.submit_group as one whole-stripe-group
+    handoff.  None when the queue path does not apply."""
     mbits = codec.bit_generator()
     if (mbits is None or getattr(codec, "bit_layout", "byte") != "byte"
             or codec.get_chunk_mapping()):
@@ -183,21 +188,43 @@ def _queue_encode_plan(codec, sinfo: StripeInfo, arr: np.ndarray,
         arr.transpose(1, 0, 2).reshape(k, n_stripes * sinfo.chunk_size))
     if _packedbit_route(codec):
         # production lane: static XOR schedule over u32 plane words
-        fut = queue.submit_packedbit(
-            np.asarray(mbits).astype(np.uint8), flat, w, m, span=span)
+        kind = "packedbit"
+        mat = np.asarray(mbits).astype(np.uint8)
     else:
-        fut = queue.submit(np.asarray(mbits).astype(np.int8), flat, w, m,
-                           span=span)
+        kind = "packed"
+        mat = np.asarray(mbits).astype(np.int8)
 
     def reassemble(parity: np.ndarray) -> List[np.ndarray]:
-        p = np.asarray(parity).reshape(m, n_stripes, sinfo.chunk_size)
+        p = np.asarray(parity).reshape(m, n_stripes * sinfo.chunk_size)
         out: List[np.ndarray] = []
         for i in range(k):
-            out.append(arr[:, i, :].reshape(-1))
+            # the flat rows ARE the per-shard data blobs, already
+            # contiguous — handing back arr[:, i, :] views here would
+            # make every consumer (store write, sub-write framing) pay
+            # an ascontiguousarray copy per shard
+            out.append(flat[i])
         for j in range(m):
-            out.append(p[j].reshape(-1))
+            out.append(p[j])
         return out
 
+    return kind, mat, flat, w, m, reassemble
+
+
+def _queue_encode_plan(codec, sinfo: StripeInfo, arr: np.ndarray,
+                       n_stripes: int, queue, span=None):
+    """When the codec/queue combination is batchable (byte-layout bit
+    seam, no chunk remap), submit the whole buffer as ONE queue request
+    and return (future, reassemble) — reassemble turns the parity rows
+    into the per-shard blob list.  None when the queue path does not
+    apply (packet-layout, mapped, or sub-chunk codecs)."""
+    parts = _encode_plan_parts(codec, sinfo, arr, n_stripes)
+    if parts is None:
+        return None
+    kind, mat, flat, w, m, reassemble = parts
+    if kind == "packedbit":
+        fut = queue.submit_packedbit(mat, flat, w, m, span=span)
+    else:
+        fut = queue.submit(mat, flat, w, m, span=span)
     return fut, reassemble
 
 
@@ -285,6 +312,44 @@ async def batched_encode_async(codec, sinfo: StripeInfo, data: bytes,
     return batched_encode(codec, sinfo, data, queue=None)
 
 
+async def batched_encode_group_async(codec, sinfo: StripeInfo, buffers,
+                                     queue=None, span=None):
+    """Encode SEVERAL objects' buffers with ONE group-aware queue submit
+    (BatchingQueue.submit_group): the whole-stripe-group handoff seam —
+    a recovery round's re-encodes, or a messenger rx batch of writes,
+    reach the EC tier as one buffer-list submission (one queue lock, one
+    worker wakeup, one coalesced dispatch window) instead of per-object
+    submits that only the delay window may happen to coalesce.
+
+    Returns the per-buffer shard lists, index-aligned with ``buffers``.
+    Buffers the queue plan cannot take (packet-layout codecs, empty
+    objects, no queue) fall back to the plain batched_encode path."""
+    import asyncio
+
+    out: List[Optional[List[np.ndarray]]] = [None] * len(buffers)
+    items = []
+    metas = []
+    for i, data in enumerate(buffers):
+        if queue is not None:
+            padded = sinfo.pad_to_stripe(data)
+            if len(padded):
+                n_stripes = max(1, len(padded) // sinfo.stripe_width)
+                arr = np.frombuffer(padded, dtype=np.uint8).reshape(
+                    n_stripes, sinfo.k, sinfo.chunk_size)
+                parts = _encode_plan_parts(codec, sinfo, arr, n_stripes)
+                if parts is not None:
+                    kind, mat, flat, w, m, reassemble = parts
+                    items.append((mat, flat, w, m, kind))
+                    metas.append((i, reassemble))
+                    continue
+        out[i] = batched_encode(codec, sinfo, data, queue=None)
+    if items:
+        futs = queue.submit_group(items, span=span)
+        for (i, reassemble), fut in zip(metas, futs):
+            out[i] = reassemble(await asyncio.wrap_future(fut))
+    return out
+
+
 def _queue_decode_plan(codec, sinfo: StripeInfo,
                        arrays: Dict[int, np.ndarray], object_size: int,
                        queue, span=None):
@@ -348,32 +413,56 @@ def _queue_decode_plan(codec, sinfo: StripeInfo,
 
 
 def _all_data_fast(codec, arrays: Dict[int, np.ndarray], cs: int,
-                   n_stripes: int, object_size: int) -> Optional[bytes]:
+                   n_stripes: int, object_size: int,
+                   scatter: bool = False) -> Optional[bytes]:
     """When every DATA shard is present (the normal, non-degraded read)
     reconstruction is pure de-interleave — no GF math, no codec, no
     device: one strided gather into the output buffer.  The reference's
     read path similarly skips decode when want ⊆ avail
     (ECBackend::CallClientContexts with no reconstruction needed).
-    Identity-mapped, concat-safe codecs only; returns None otherwise."""
+    Identity-mapped, concat-safe codecs only; returns None otherwise.
+
+    With ``scatter=True`` the gather copy itself disappears: the result
+    is a messenger BufferList of per-stripe chunk VIEWS over the shard
+    buffers in logical order — the wire path writev's them as one blob
+    (the reference's bufferlist read reply), so a whole-object read never
+    materializes a contiguous copy on the primary at all."""
     k = codec.get_data_chunk_count()
     if (n_stripes <= 1 or not concat_safe(codec)
             or codec.get_chunk_mapping()
             or any(c not in arrays for c in range(k))):
         return None
     want = n_stripes * cs
+    for c in range(k):
+        if len(arrays[c]) < want:
+            return None  # short shard: let the codec's padding rules run
+    if scatter:
+        from ceph_tpu.rados.messenger import BufferList
+
+        views = [memoryview(np.ascontiguousarray(arrays[c][:want]))
+                 for c in range(k)]
+        segs = []
+        remaining = object_size
+        base = 0
+        for _ in range(n_stripes):
+            for c in range(k):
+                if remaining <= 0:
+                    break
+                n = cs if remaining >= cs else remaining
+                segs.append(views[c][base:base + n])
+                remaining -= n
+            base += cs
+        return BufferList(segs)
     out = np.empty(n_stripes * k * cs, dtype=np.uint8)
     view = out.reshape(n_stripes, k, cs)
     for c in range(k):
-        a = arrays[c]
-        if len(a) < want:
-            return None  # short shard: let the codec's padding rules run
-        view[:, c, :] = a[:want].reshape(n_stripes, cs)
+        view[:, c, :] = arrays[c][:want].reshape(n_stripes, cs)
     return out[:object_size].tobytes()
 
 
 def decode_object(codec, sinfo: StripeInfo,
                   blobs: Dict[int, np.ndarray], object_size: int,
-                  queue=None, span=None) -> bytes:
+                  queue=None, span=None, scatter: bool = False) -> bytes:
     """Reconstruct a striped object from per-shard blobs (each the
     concatenation of that shard's per-stripe chunks) and de-interleave
     back to logical byte order, trimmed to `object_size`.
@@ -381,13 +470,18 @@ def decode_object(codec, sinfo: StripeInfo,
     Concat-safe codecs decode ALL stripes in one codec.decode call — the
     multi-stripe mirror of the reference's per-stripe
     objects_read_and_reconstruct loop (ECBackend.cc:2401, ECUtil.cc:25-60
-    decode) collapsed into a single device dispatch."""
+    decode) collapsed into a single device dispatch.
+
+    ``scatter=True`` permits a BufferList return on the all-data fast
+    path (zero-copy stripe views; see _all_data_fast) — callers that hand
+    the result to the messenger opt in; everyone else gets bytes."""
     k = codec.get_data_chunk_count()
     cs = sinfo.chunk_size
     arrays = {s: np.asarray(b, dtype=np.uint8) for s, b in blobs.items()}
     blob_len = len(next(iter(arrays.values())))
     n_stripes = max(1, blob_len // cs)
-    fast = _all_data_fast(codec, arrays, cs, n_stripes, object_size)
+    fast = _all_data_fast(codec, arrays, cs, n_stripes, object_size,
+                          scatter=scatter)
     if fast is not None:
         return fast
     if queue is not None:
@@ -415,7 +509,7 @@ def decode_object(codec, sinfo: StripeInfo,
 async def decode_object_async(codec, sinfo: StripeInfo,
                               blobs: Dict[int, np.ndarray],
                               object_size: int, queue=None,
-                              span=None) -> bytes:
+                              span=None, scatter: bool = False) -> bytes:
     """Event-loop-friendly decode_object (see batched_encode_async)."""
     if queue is not None:
         import asyncio
@@ -424,7 +518,7 @@ async def decode_object_async(codec, sinfo: StripeInfo,
         blob_len = len(next(iter(arrays.values())))
         n_stripes = max(1, blob_len // sinfo.chunk_size)
         fast = _all_data_fast(codec, arrays, sinfo.chunk_size, n_stripes,
-                              object_size)
+                              object_size, scatter=scatter)
         if fast is not None:
             return fast
         planned = _queue_decode_plan(codec, sinfo, arrays, object_size, queue,
@@ -432,7 +526,8 @@ async def decode_object_async(codec, sinfo: StripeInfo,
         if planned is not None:
             fut, finish = planned
             return finish(await asyncio.wrap_future(fut))
-    return decode_object(codec, sinfo, blobs, object_size, queue=None)
+    return decode_object(codec, sinfo, blobs, object_size, queue=None,
+                         scatter=scatter)
 
 
 # -- bit-planar residency (ceph_tpu/parallel/service.py PlanarShardStore) ----

@@ -41,6 +41,36 @@ Wire formats, by plane (see README "Wire-format threat model"):
 The reference's cross-version dencoder discipline is represented by the
 per-type version field checked on decode (and exercised by
 tools/dencoder + the wire corpus).
+
+Cork/flush discipline (the corked wire data plane): every Connection owns
+an OUTBOX.  ``send()`` frames the message and appends the segments to the
+outbox; a single per-connection flusher task drains the outbox with ONE
+``writelines`` + ONE ``drain()`` per flush window, so frames queued by
+concurrent senders (a k+m stripe fan-out, a burst of sub-write replies)
+coalesce into one scatter-gather write instead of paying a
+lock/write/drain round-trip each (the reference's ProtocolV2 out_queue +
+segment writev).  The flush window is self-clocking: while one window
+drains, new frames pile into the next — no added latency for an isolated
+send, automatic batching under load.  On plaintext TCP the flusher also
+swaps the StreamWriter for a CorkedWriter that ``sendmsg``-writevs the
+frame segments STRAIGHT FROM their owning buffers (encode outputs, store
+blobs, BufferList pieces) — zero copies between codec and kernel.
+
+Acks are PIGGYBACKED: dispatching a frame queues a cumulative ack
+(highest contiguous seq) on the connection instead of writing a
+standalone ACK_TYPE frame; the next flush carries one ack frame for the
+whole window (acks are cumulative, so the latest seq covers every
+earlier one).  An ack-only flush is still written promptly when no data
+frames are outbound.  The rx side mirrors the batching: the serve loop
+drains every frame ALREADY BUFFERED on the transport into one batch,
+dispatches the batch (through ``group_dispatcher`` when the daemon
+installs one — the whole-stripe group handoff seam), and acks once.
+
+Lossless-replay interaction: a frame enters the unacked replay queue
+BEFORE it enters the outbox, and close() fails the pending flush window
+and clears the outbox — un-flushed frames replay from the unacked queue
+onto the adopted transport in seq order, and the receiver's dedupe floor
+makes any flush/replay overlap exactly-once.
 """
 
 from __future__ import annotations
@@ -49,6 +79,7 @@ import asyncio
 import collections
 import hashlib
 import hmac
+import itertools
 import json
 import pickle
 import random
@@ -58,6 +89,8 @@ import traceback
 import zlib
 from dataclasses import dataclass
 from typing import Any, Callable, Deque, Dict, Optional, Tuple
+
+import numpy as np
 
 from ceph_tpu.common.perf_counters import PerfCounters, PerfCountersBuilder
 from ceph_tpu.common.throttle import Throttle
@@ -85,22 +118,49 @@ def _build_wire_perf() -> PerfCounters:
       rx_framing           longrunavg  decode_message seconds per dispatch
       local_msgs           u64         colocated-fastpath handoffs (no
                                        framing or socket at all)
+      tx_flushes           u64         outbox flush windows written (each is
+                                       one writelines + one drain)
+      tx_flush_frames      histogram   frames coalesced per flush window
+      tx_flush_bytes       histogram   bytes per flush window
+      tx_flush_data        u64         windows cut carrying data frames
+      tx_flush_ack         u64         ack-only windows (no data pending)
+      tx_acks              u64         ack frames written
+      tx_acks_coalesced    u64         acks absorbed into a pending ack
+                                       (would have been standalone frames)
+      tx_crc_reused        u64         blob frames whose wire crc reused an
+                                       app-level crc (no recompute pass)
+      rx_batches           u64         multi-frame rx batches drained
+      rx_batch_msgs        histogram   messages per rx dispatch batch
       tx_<Type> / rx_<Type>        u64  per-message-type counts (dynamic)
       tx_bytes_<Type> / rx_bytes_<Type>  u64  per-type frame bytes
 
     framing vs io is the actionable split: framing seconds are Python
     encode cost a scatter-gather/zero-copy PR can remove; io seconds are
-    the socket's."""
+    the socket's.  With the corked outbox, tx_io is per FLUSH WINDOW (not
+    per message): sum(tx_io)/tx_msgs is the per-message socket cost and
+    drops as flush windows batch more frames."""
     b = PerfCountersBuilder("wire")
     b.add_u64_counter("tx_msgs", "messages sent")
     b.add_u64_counter("tx_bytes", "frame bytes sent")
     b.add_u64_counter("rx_msgs", "messages dispatched")
     b.add_u64_counter("rx_bytes", "frame bytes received")
     b.add_time_avg("tx_framing", "encode + frame-build seconds per send")
-    b.add_time_avg("tx_io", "socket write + drain seconds per send")
+    b.add_time_avg("tx_io", "socket write + drain seconds per flush window")
     b.add_time_avg("rx_io", "payload read seconds per frame (post-header)")
     b.add_time_avg("rx_framing", "decode seconds per dispatched message")
     b.add_u64_counter("local_msgs", "colocated-fastpath handoffs")
+    b.add_u64_counter("tx_flushes", "outbox flush windows written")
+    b.add_histogram("tx_flush_frames", "frames coalesced per flush window")
+    b.add_histogram("tx_flush_bytes", "bytes per flush window")
+    b.add_u64_counter("tx_flush_data", "flush windows carrying data frames")
+    b.add_u64_counter("tx_flush_ack", "ack-only flush windows")
+    b.add_u64_counter("tx_acks", "ack frames written")
+    b.add_u64_counter("tx_acks_coalesced",
+                      "acks absorbed into a pending cumulative ack")
+    b.add_u64_counter("tx_crc_reused",
+                      "blob frames reusing an app-level crc on the wire")
+    b.add_u64_counter("rx_batches", "multi-frame rx dispatch batches")
+    b.add_histogram("rx_batch_msgs", "messages per rx dispatch batch")
     return b.create_perf_counters()
 
 BANNER = b"ceph_tpu msgr v2\n"
@@ -166,6 +226,66 @@ def message(type_id: int, version: int = 1):
 import copyreg  # noqa: E402
 
 copyreg.pickle(memoryview, lambda m: (bytes, (bytes(m),)))
+
+
+def _norm_segments(segments):
+    """Normalize buffers to non-empty 1-D byte memoryviews; returns
+    (views, total_bytes).  Shared by BufferList and CorkedWriter so the
+    cast/skip-empty rules cannot drift apart."""
+    segs = []
+    total = 0
+    for s in segments:
+        mv = s if isinstance(s, memoryview) else memoryview(s)
+        if mv.ndim != 1 or mv.itemsize != 1:
+            mv = mv.cast("B")
+        if mv.nbytes:
+            segs.append(mv)
+            total += mv.nbytes
+    return segs, total
+
+
+class BufferList:
+    """A blob made of multiple buffers (the reference's bufferlist,
+    src/common/buffer.h): a message's bulk field may be handed over as a
+    LIST of byte pieces — per-stripe chunk views, extent slices — and the
+    corked send path writev's the pieces straight from their owning
+    buffers.  No producer-side gather copy: the de-interleave a read
+    reply used to pay (stripes -> one contiguous buffer -> frame) becomes
+    a list of views the kernel gathers.  The frame crc chains across the
+    pieces, so the bytes on the wire (and the receiver, which sees one
+    contiguous blob land in its frame buffer) are identical to the
+    concatenation.  Pickling one (control-plane ride-along, sub-threshold
+    fallback) materializes to plain bytes."""
+
+    __slots__ = ("segments", "nbytes")
+
+    def __init__(self, segments=()):
+        self.segments, self.nbytes = _norm_segments(segments)
+
+    def __len__(self) -> int:
+        return self.nbytes
+
+    def tobytes(self) -> bytes:
+        return b"".join(self.segments)
+
+    def __bytes__(self) -> bytes:
+        return self.tobytes()
+
+
+# a BufferList that rides pickle (local-fastpath control copy, or a
+# sub-threshold blob folded into the payload) lands as plain bytes
+copyreg.pickle(BufferList, lambda bl: (bytes, (bl.tobytes(),)))
+
+
+def as_bytes(data) -> bytes:
+    """Materialize a message bulk field to bytes: blob-lane fields may be
+    bytes, bytearray, memoryview, or BufferList depending on the path the
+    message took (wire rx buffer, store view, scatter-gather reply)."""
+    if isinstance(data, bytes):
+        return data
+    if isinstance(data, BufferList):
+        return data.tobytes()
+    return bytes(data)
 
 
 # -- fixed binary field codec ------------------------------------------------
@@ -311,7 +431,7 @@ def encode_payload_parts(msg: Any):
     blob = None
     if attr is not None:
         b = msg.__dict__.get(attr)
-        if isinstance(b, (bytes, bytearray, memoryview)) \
+        if isinstance(b, (bytes, bytearray, memoryview, BufferList)) \
                 and len(b) >= BLOB_MIN:
             blob = b
     fields = getattr(cls, "FIXED_FIELDS", None)
@@ -531,33 +651,68 @@ class FrameReceiver(asyncio.BufferedProtocol):
         self._eof = False
         self._exc: Optional[BaseException] = None
         self._read_paused = False
+        self._via_scratch = True  # last get_buffer handed out scratch
+        # the connection's CorkedWriter, when one took over the tx side:
+        # connection_lost must fail its drain waiters too
+        self.corked = None
 
     # -- protocol side -------------------------------------------------------
 
     def get_buffer(self, sizehint: int):
         if self._dest is not None and self._dest_pos < len(self._dest):
-            return self._dest[self._dest_pos:]
+            remaining = len(self._dest) - self._dest_pos
+            if remaining >= len(self._scratch):
+                # bulk destination (blob body): single-copy direct fill
+                self._via_scratch = False
+                return self._dest[self._dest_pos:]
+            # SMALL destination (frame header, short payload): read
+            # GREEDILY through scratch so one recv drains everything the
+            # kernel has — the surplus (trailing frames of a burst)
+            # lands in _pending, which is what the serve loop's rx
+            # batching predicate looks at.  A per-dest-sized recv here
+            # would hand frames over one at a time (two syscalls per
+            # tiny frame) and batching would never see a second frame.
+            self._via_scratch = True
+            return self._scratch_view
+        self._via_scratch = True
         return self._scratch_view
 
     def buffer_updated(self, nbytes: int) -> None:
         if self._dest is not None and self._dest_pos < len(self._dest):
-            self._dest_pos += nbytes
-            # wake the reader only when its buffer is COMPLETE: a wake
-            # per network chunk would round-trip the event loop hundreds
-            # of times per blob, each competing with every other ready
-            # callback in a busy daemon
+            if not self._via_scratch:
+                self._dest_pos += nbytes
+                # wake the reader only when its buffer is COMPLETE: a
+                # wake per network chunk would round-trip the event loop
+                # hundreds of times per blob, each competing with every
+                # other ready callback in a busy daemon
+                if self._dest_pos >= len(self._dest):
+                    self._wake()
+                return
+            # greedy scratch read: split between the waiting dest and
+            # the pending backlog
+            remaining = len(self._dest) - self._dest_pos
+            take = min(nbytes, remaining)
+            self._dest[self._dest_pos:self._dest_pos + take] = \
+                self._scratch_view[:take]
+            self._dest_pos += take
+            if nbytes > take:
+                self._pending += self._scratch_view[take:nbytes]
+                self._check_limit()
             if self._dest_pos >= len(self._dest):
                 self._wake()
         else:
             self._pending += self._scratch_view[:nbytes]
-            if len(self._pending) - self._off > self._LIMIT \
-                    and not self._read_paused:
-                self._read_paused = True
-                try:
-                    self._transport.pause_reading()
-                except Exception:
-                    pass
+            self._check_limit()
             self._wake()
+
+    def _check_limit(self) -> None:
+        if len(self._pending) - self._off > self._LIMIT \
+                and not self._read_paused:
+            self._read_paused = True
+            try:
+                self._transport.pause_reading()
+            except Exception:
+                pass
 
     def eof_received(self):
         self._eof = True
@@ -568,6 +723,8 @@ class FrameReceiver(asyncio.BufferedProtocol):
         self._eof = True
         self._exc = exc
         self._wake()
+        if self.corked is not None:
+            self.corked._on_lost(exc)
         # the StreamWriter still drains through the ORIGINAL stream
         # protocol: without this forward, a drain() parked on a paused
         # writer never learns the connection died and waits forever —
@@ -590,15 +747,26 @@ class FrameReceiver(asyncio.BufferedProtocol):
 
     # -- reader side ---------------------------------------------------------
 
-    async def readexactly(self, n: int):
+    async def readexactly(self, n: int, uninit: bool = False):
+        """Read n bytes.  With ``uninit=True`` the destination is an
+        UNINITIALIZED buffer (np.empty) returned as a memoryview:
+        bytearray(n) memsets n zero bytes the socket is about to
+        overwrite, a full extra pass over the data volume on blob
+        frames.  Only blob fields whose consumers are buffer-safe
+        (BLOB_VIEW_OK types: store/decode lanes) opt in — everything
+        else keeps bytearray semantics (concat, decode, mutation)."""
         pend = self._pending
         avail = len(pend) - self._off
         if avail >= n:
             out = bytes(pend[self._off:self._off + n])
             self._consume(n)
             return out
-        buf = bytearray(n)
-        mv = memoryview(buf)
+        if uninit:
+            buf = memoryview(np.empty(n, dtype=np.uint8)).cast("B")
+            mv = buf
+        else:
+            buf = bytearray(n)
+            mv = memoryview(buf)
         pos = avail
         if pos:
             mv[:pos] = pend[self._off:]
@@ -648,6 +816,154 @@ class FrameReceiver(asyncio.BufferedProtocol):
                 pass
 
 
+class CorkedWriter:
+    """Zero-copy scatter-gather tx path: once the handshake is done (and
+    the transport's own write buffer is empty), the connection's flusher
+    swaps the StreamWriter for this — writes go STRAIGHT from the frame
+    segments to ``socket.sendmsg`` (writev), so frame bytes are never
+    joined or copied into a transport buffer.  The asyncio transport
+    keeps owning the rx side (FrameReceiver) and the fd's lifetime; this
+    class only owns which bytes leave.
+
+    Congestion handling: segments queue in a deque; a full socket
+    registers an add_writer callback that resumes sendmsg as the kernel
+    drains.  ``drain()`` parks senders until the backlog is fully
+    written: queued segments are VIEWS of live caller buffers (encode
+    outputs, store blobs), and a drain that returned with segments still
+    queued would let the owner mutate bytes before the kernel reads
+    them.  Zero-copy therefore trades the overlap a buffered writer has
+    — the copies it saves are the whole point.
+
+    Failure: a send error (or the transport's connection_lost, forwarded
+    by FrameReceiver) fails queued segments and drain waiters with the
+    transport error — the same surface StreamWriter.drain() has."""
+
+    IOV_MAX = 512  # segments per sendmsg call (conservative vs UIO_MAXIOV)
+
+    def __init__(self, transport, sock, stream_writer):
+        self._transport = transport
+        self._sock = sock
+        self._sw = stream_writer  # close/wait_closed/extra-info delegate
+        loop = asyncio.get_running_loop()
+        self._loop = loop
+        # the PRIVATE writer registration transports themselves use: the
+        # public add_writer refuses fds owned by a transport (ours is —
+        # the transport keeps the rx side).  _maybe_cork gates on these
+        # existing, so an event loop without them just never corks.
+        self._add_writer = loop._add_writer
+        self._remove_writer = loop._remove_writer
+        self._fd = sock.fileno()
+        self._segs: Deque = collections.deque()
+        self._buffered = 0
+        self._writer_on = False  # add_writer registered
+        self._waiters: list = []
+        self._exc: Optional[BaseException] = None
+
+    # -- StreamWriter surface -------------------------------------------------
+
+    def write(self, data) -> None:
+        self.writelines([data])
+
+    def writelines(self, segments) -> None:
+        if self._exc is not None:
+            return  # error surfaces at drain(), like StreamWriter
+        segs, total = _norm_segments(segments)
+        self._segs.extend(segs)
+        self._buffered += total
+        if not self._writer_on:
+            self._do_send()
+
+    async def drain(self) -> None:
+        while self._exc is None and self._buffered > 0:
+            fut = self._loop.create_future()
+            self._waiters.append(fut)
+            await fut
+        if self._exc is not None:
+            exc = self._exc
+            raise exc if isinstance(exc, Exception) \
+                else ConnectionResetError("connection lost")
+
+    def close(self) -> None:
+        # best-effort final flush, then the transport closes the fd; any
+        # still-unsent segments are dropped (lossless replay re-delivers)
+        if self._exc is None and self._segs and not self._writer_on:
+            self._do_send()
+        self._detach()
+        self._sw.close()
+
+    async def wait_closed(self) -> None:
+        await self._sw.wait_closed()
+
+    def get_extra_info(self, *a, **kw):
+        return self._sw.get_extra_info(*a, **kw)
+
+    @property
+    def transport(self):
+        return self._transport
+
+    # -- socket side ----------------------------------------------------------
+
+    def _do_send(self) -> None:
+        try:
+            while self._segs:
+                if len(self._segs) > self.IOV_MAX:
+                    batch = list(itertools.islice(self._segs, self.IOV_MAX))
+                else:
+                    batch = list(self._segs)
+                sent = self._sock.sendmsg(batch)
+                self._advance(sent)
+        except (BlockingIOError, InterruptedError):
+            if not self._writer_on:
+                self._writer_on = True
+                self._add_writer(self._fd, self._do_send)
+            return
+        except OSError as e:
+            self._on_lost(e)
+            return
+        if self._writer_on:
+            self._writer_on = False
+            try:
+                self._remove_writer(self._fd)
+            except Exception:
+                pass
+        self._wake()
+
+    def _advance(self, n: int) -> None:
+        self._buffered -= n
+        while n and self._segs:
+            head = self._segs[0]
+            if n >= head.nbytes:
+                n -= head.nbytes
+                self._segs.popleft()
+            else:
+                self._segs[0] = head[n:]
+                n = 0
+
+    def _wake(self) -> None:
+        if self._buffered == 0 or self._exc is not None:
+            waiters, self._waiters = self._waiters, []
+            for w in waiters:
+                if not w.done():
+                    w.set_result(None)
+
+    def _detach(self) -> None:
+        if self._writer_on:
+            self._writer_on = False
+            try:
+                self._remove_writer(self._fd)
+            except Exception:
+                pass
+
+    def _on_lost(self, exc) -> None:
+        if self._exc is None:
+            self._exc = exc if exc is not None else \
+                ConnectionResetError("connection lost")
+        self._detach()
+        self._segs.clear()
+        self._buffered = 0
+        self._wake()
+
+
 class Connection:
     """One ordered session with a peer.  For lossless sessions this object
     outlives TCP transports: seqs, the unacked queue, and the dedupe floor
@@ -679,6 +995,18 @@ class Connection:
         from ceph_tpu.common.lockdep import make_async_mutex
 
         self._send_lock = make_async_mutex("conn-send")
+        # corked outbox (module docstring "Cork/flush discipline"):
+        # framed segments awaiting the next flush window, the shared
+        # future senders in that window await, and the single flusher
+        # task that drains windows with one writelines+drain each
+        self._outbox: list = []
+        self._outbox_frames = 0
+        self._outbox_bytes = 0
+        self._ack_pending = -1  # highest seq owed an ack; -1 = none
+        self._flush_fut: Optional[asyncio.Future] = None
+        self._flusher: Optional[asyncio.Task] = None
+        self._corked_ok = bool(_cget(messenger.conf, "ms_corked_writev",
+                                     True))
         # crc/compression resolved once per connection (v2 negotiates at
         # handshake time; avoids typed-config parsing on the hot path)
         conf = messenger.conf
@@ -733,39 +1061,221 @@ class Connection:
         return _HDR.pack(len(payload), type_id, version, flags, crc, seq) + payload
 
     def _frame_segments(self, type_id: int, version: int, pickled: bytes,
-                        blob, seq: int, flags: int = 0):
+                        blob, seq: int, flags: int = 0,
+                        blob_crc: Optional[int] = None):
         """Scatter-gather frame for a blob message: the bulk bytes are
         never concatenated into a serialized buffer — the transport
-        writev's [hdr, prefix, pickled, blob] as-is.  The header crc
-        covers prefix+pickled (small); the blob carries its own crc32c.
-        Blob frames skip on-wire compression (bulk data is usually
-        incompressible shard bytes; the pickled part is tiny)."""
-        blob_crc = self.crc_fn(blob) if self.crc_enabled else 0
+        writev's [hdr, prefix, pickled, blob...] as-is (a BufferList blob
+        contributes each piece unjoined).  The header crc covers
+        prefix+pickled (small); the blob carries its own crc32c —
+        ``blob_crc`` passes a crc the sender already holds over exactly
+        these bytes (MECSubWrite.chunk_crc, a stored shard's meta crc) so
+        the wire pass is skipped, the reference's bufferlist cached-crc
+        discipline.  Blob frames skip on-wire compression (bulk data is
+        usually incompressible shard bytes; the pickled part is tiny)."""
+        if isinstance(blob, BufferList):
+            segs = blob.segments
+            blob_len = blob.nbytes
+        else:
+            segs = [blob]
+            blob_len = len(blob)
+        if blob_crc is None:
+            if self.crc_enabled:
+                blob_crc = 0
+                for s in segs:
+                    blob_crc = self.crc_fn(s, blob_crc)
+            else:
+                blob_crc = 0
+        else:
+            self.messenger.perf.inc("tx_crc_reused")
         prefix = _BLOB_PFX.pack(len(pickled), blob_crc)
         crc = (self.crc_fn(pickled, self.crc_fn(prefix))
                if self.crc_enabled else 0)
-        hdr = _HDR.pack(_BLOB_PFX.size + len(pickled) + len(blob),
+        hdr = _HDR.pack(_BLOB_PFX.size + len(pickled) + blob_len,
                         type_id, version, FLAG_BLOB | flags, crc, seq)
-        return [hdr, prefix, pickled, blob]
+        return [hdr, prefix, pickled, *segs]
 
-    async def _write_raw(self, data) -> None:
-        nbytes = (sum(len(p) for p in data) if isinstance(data, list)
-                  else len(data))
-        # tx accounting lives HERE so every socket write — messages,
-        # acks, session replays — lands in tx_io/tx_bytes; per-message
-        # framing cost and per-type counts are send()'s (_note_tx).
-        # The timer starts INSIDE the lock: queueing behind concurrent
-        # senders is not socket time
-        async with self._send_lock:
+    # -- corked outbox (tx coalescing) ---------------------------------------
+
+    def _seg_len(self, s) -> int:
+        return s.nbytes if isinstance(s, memoryview) else len(s)
+
+    async def _enqueue(self, data) -> None:
+        """Append one framed message to the outbox and await the flush
+        window that carries it.  Concurrent senders in the same window
+        share ONE writelines + ONE drain; a transport failure fails the
+        whole window (each sender sees ConnectionResetError)."""
+        if self.closed:
+            raise ConnectionResetError("connection closed")
+        segs = data if isinstance(data, list) else [data]
+        self._outbox.extend(segs)
+        self._outbox_frames += 1
+        self._outbox_bytes += sum(self._seg_len(s) for s in segs)
+        fut = self._flush_fut
+        if fut is None:
+            fut = self._flush_fut = \
+                asyncio.get_running_loop().create_future()
+        self._kick_flusher()
+        await fut
+
+    def queue_ack(self, seq: int) -> None:
+        """Queue a cumulative ack for ``seq`` (acks are cumulative: the
+        receiver pops every unacked frame <= seq, so only the highest
+        pending seq ever needs a frame).  The ack piggybacks on the next
+        flush window — one ack frame per window instead of one per
+        dispatched message."""
+        if self.closed:
+            return
+        if self._ack_pending >= 0:
+            self.messenger.perf.inc("tx_acks_coalesced")
+        self._ack_pending = max(self._ack_pending, seq)
+        self._kick_flusher()
+
+    def _kick_flusher(self) -> None:
+        if self._flusher is None or self._flusher.done():
+            m = self.messenger
+            self._flusher = asyncio.get_running_loop().create_task(
+                self._flush_loop())
+            m._tasks.add(self._flusher)
+            self._flusher.add_done_callback(m._tasks.discard)
+
+    def _ack_frame(self) -> bytes:
+        payload = struct.pack("<Q", self._ack_pending)
+        self._ack_pending = -1
+        return _HDR.pack(8, ACK_TYPE, 1, 0, self.crc_fn(payload), 0) + payload
+
+    async def _flush_loop(self) -> None:
+        """The per-connection flusher: drains flush windows until the
+        outbox and pending ack are empty.  tx accounting lives HERE so
+        every socket write — messages, acks — lands in tx_io/tx_bytes;
+        per-message framing cost and per-type counts are send()'s
+        (_note_tx).  The tx_io timer starts INSIDE the lock: queueing
+        behind an adopt_transport replay is not socket time."""
+        perf = self.messenger.perf
+        try:
+            while (self._outbox or self._ack_pending >= 0) \
+                    and not self.closed:
+                async with self._send_lock:
+                    if self.closed:
+                        break
+                    self._maybe_cork()
+                    segs = self._outbox
+                    self._outbox = []
+                    frames = self._outbox_frames
+                    self._outbox_frames = 0
+                    nbytes = self._outbox_bytes
+                    self._outbox_bytes = 0
+                    fut, self._flush_fut = self._flush_fut, None
+                    had_data = bool(segs)
+                    if self._ack_pending >= 0:
+                        ack = self._ack_frame()
+                        segs.append(ack)
+                        frames += 1
+                        nbytes += len(ack)
+                        perf.inc("tx_acks")
+                    if not segs:
+                        break
+                    perf.inc("tx_flush_data" if had_data else "tx_flush_ack")
+                    perf.inc("tx_flushes")
+                    perf.hinc("tx_flush_frames", frames)
+                    perf.hinc("tx_flush_bytes", nbytes)
+                    gen = self.transport_gen
+                    try:
+                        with perf.time_avg("tx_io"):
+                            self.writer.writelines(segs)
+                            await self.writer.drain()
+                    except (ConnectionError, OSError,
+                            asyncio.TimeoutError) as e:
+                        if fut is not None and not fut.done():
+                            fut.set_exception(ConnectionResetError(
+                                f"flush failed: {e}"))
+                            fut.exception()  # mark retrieved (no-waiter GC)
+                        # gen-fenced: a no-op here means adopt_transport
+                        # replaced the transport under us — loop again and
+                        # retry the remaining windows on the new writer
+                        # (a genuine close ends the loop via its condition)
+                        await self.close(gen)
+                        continue
+                    except asyncio.CancelledError:
+                        raise
+                    except BaseException as e:
+                        # a framing/writer BUG must crash loudly — but
+                        # never by leaving the window's senders parked on
+                        # a future nobody will resolve
+                        if fut is not None and not fut.done():
+                            fut.set_exception(
+                                ConnectionResetError(f"flush failed: {e}"))
+                            fut.exception()
+                        await self.close(gen)
+                        raise
+                    perf.inc("tx_bytes", nbytes)
+                    if fut is not None and not fut.done():
+                        fut.set_result(None)
+        finally:
             if self.closed:
-                raise ConnectionResetError("connection closed")
-            with self.messenger.perf.time_avg("tx_io"):
-                if isinstance(data, list):
-                    self.writer.writelines(data)
-                else:
-                    self.writer.write(data)
-                await self.writer.drain()
-        self.messenger.perf.inc("tx_bytes", nbytes)
+                self._fail_pending(ConnectionResetError("connection closed"))
+
+    def _pin_replay_queue(self) -> None:
+        """Materialize view segments of queued unacked frames to bytes.
+        Runs at transport death: from here the frames may sit queued for
+        a whole reconnect window (or forever, for a gone peer), and a
+        queued VIEW would pin its whole backing buffer (e.g. the k-row
+        encode matrix behind one shard's 1/k-sized view) for that long.
+        While the transport is healthy the queue turns over within an
+        RTT, so the hot path never pays this copy."""
+        for i, (seq, data) in enumerate(self.unacked):
+            if isinstance(data, list) \
+                    and any(not isinstance(s, bytes) for s in data):
+                self.unacked[i] = (seq, [
+                    s if isinstance(s, bytes) else bytes(s) for s in data])
+
+    def _fail_pending(self, exc: Exception) -> None:
+        """Fail the pending flush window (senders awaiting it see the
+        transport error) and drop un-flushed segments: lossless frames
+        live in the unacked queue and replay on the adopted transport;
+        un-flushed acks are re-queued by the dedupe path when the peer
+        replays."""
+        fut, self._flush_fut = self._flush_fut, None
+        self._outbox = []
+        self._outbox_frames = 0
+        self._outbox_bytes = 0
+        self._ack_pending = -1
+        if fut is not None and not fut.done():
+            fut.set_exception(exc)
+            fut.exception()  # mark retrieved: ok if every sender left
+
+    def _maybe_cork(self) -> None:
+        """Swap the StreamWriter for the zero-copy CorkedWriter when the
+        transport allows it (plaintext TCP, nothing buffered in the
+        transport, sendmsg available).  Called under the send lock at
+        flush time — lazily, so it naturally re-engages after an
+        adopt_transport handed us a fresh StreamWriter."""
+        if not self._corked_ok:
+            return
+        w = self.writer
+        if not isinstance(w, asyncio.StreamWriter):
+            return  # SecureStream (AES-GCM) or already corked
+        try:
+            transport = w.transport
+            if (transport is None or transport.is_closing()
+                    or transport.get_write_buffer_size() != 0):
+                return
+            sock = transport.get_extra_info("socket")
+            # unwrap asyncio's TransportSocket: its sendmsg() warns (and
+            # is slated for removal); the raw socket is the real surface
+            sock = getattr(sock, "_sock", sock)
+            if sock is None or not hasattr(sock, "sendmsg"):
+                return
+            loop = asyncio.get_running_loop()
+            if not hasattr(loop, "_add_writer"):
+                return  # non-selector loop: keep the stream writer
+            corked = CorkedWriter(transport, sock, w)
+            proto = transport.get_protocol()
+            if isinstance(proto, FrameReceiver):
+                proto.corked = corked  # connection_lost fails its waiters
+        except Exception:
+            return
+        self.writer = corked
 
     async def send(self, msg: Any) -> None:
         conf = self.messenger.conf
@@ -782,27 +1292,36 @@ class Connection:
         t_frame = time.monotonic()
         pickled, blob, fixed = encode_payload_parts(msg)
         flags = FLAG_FIXED if fixed else 0
-        if blob is not None and self.policy.replay \
-                and isinstance(blob, memoryview):
-            # a view entering the lossless REPLAY queue would pin its
-            # whole backing buffer (e.g. the full k-row encode matrix)
-            # until acked — an unreachable peer would hold object-sized
-            # memory per queued frame.  Lossy sends keep the zero-copy.
-            blob = bytes(blob)
         if blob is not None:
+            # cached-crc reuse: a message that already carries a crc of
+            # EXACTLY its blob bytes (BLOB_CRC_ATTR) skips the wire crc
+            # pass — only when this connection's negotiated checksum is
+            # the shared resolver the app-level crc was computed with
+            pre_crc = None
+            crc_attr = getattr(type(msg), "BLOB_CRC_ATTR", None)
+            if crc_attr is not None and self.crc_enabled \
+                    and self.crc_fn is checksum:
+                v = msg.__dict__.get(crc_attr) or 0
+                if v:
+                    pre_crc = v & 0xFFFFFFFF
             data = self._frame_segments(msg.TYPE_ID, msg.VERSION, pickled,
-                                        blob, seq, flags)
+                                        blob, seq, flags, blob_crc=pre_crc)
         else:
             data = self._frame(msg.TYPE_ID, msg.VERSION, pickled, seq,
                                flags)
         self.messenger._note_tx(type(msg).__name__,
-                                sum(len(p) for p in data)
+                                sum(self._seg_len(p) for p in data)
                                 if isinstance(data, list) else len(data),
                                 time.monotonic() - t_frame)
         if self.policy.replay:
             # lossless send never fails: the frame joins the session queue
             # and reconnect+replay delivers it exactly once (reference
-            # lossless_peer out_queue semantics)
+            # lossless_peer out_queue semantics).  Blob VIEWS stay views
+            # here — on a healthy session the ack pops the frame within
+            # an RTT, so the pin on the backing buffer is transient; the
+            # frames only materialize to bytes when the transport DIES
+            # (close() -> _pin_replay_queue), which is when a frame can
+            # actually sit queued long enough for pinning to matter.
             self.unacked.append((seq, data))
             if injected:
                 # injected transport failure: frame stays queued, session
@@ -810,29 +1329,32 @@ class Connection:
                 await self.close()
                 return
             try:
-                await self._write_raw(data)
+                await self._enqueue(data)
             except (ConnectionError, OSError):
                 await self.close()
         else:
-            await self._write_raw(data)
+            await self._enqueue(data)
 
     async def send_ack(self, seq: int) -> None:
-        payload = struct.pack("<Q", seq)
-        await self._write_raw(
-            _HDR.pack(8, ACK_TYPE, 1, 0, self.crc_fn(payload), 0) + payload
-        )
+        """Compat shim: queue a cumulative ack (piggybacked on the next
+        flush window; see queue_ack)."""
+        self.queue_ack(seq)
 
     def handle_ack(self, seq: int) -> None:
         while self.unacked and self.unacked[0][0] <= seq:
             self.unacked.popleft()
 
-    async def read_frame(self) -> Tuple[int, int, int, bytes, int, Any]:
-        """Returns (type_id, version, seq, payload, cost, blob).  The
-        dispatch throttle is charged `cost` bytes BEFORE the payload is
-        read (receive-side backpressure, reference DispatchQueue
-        throttle); the caller must put() cost back when done with the
-        payload.  Blob frames (FLAG_BLOB) return the bulk bytes
-        separately, checked against their own crc32c."""
+    async def read_frame(self) -> Tuple[int, int, int, bytes, int, Any,
+                                        bool, bool]:
+        """Returns (type_id, version, seq, payload, cost, blob, fixed,
+        blob_verified).  The dispatch throttle is charged `cost` bytes
+        BEFORE the payload is read (receive-side backpressure, reference
+        DispatchQueue throttle); the caller must put() cost back when
+        done with the payload.  Blob frames (FLAG_BLOB) return the bulk
+        bytes separately, checked against their own crc32c —
+        ``blob_verified`` says that check actually ran (crc enabled and
+        present), so handlers holding an app-level crc of the same bytes
+        (MECSubWrite.chunk_crc) can skip their own verify pass."""
         hdr = await self.reader.readexactly(_HDR.size)
         length, type_id, version, flags, crc, seq = _HDR.unpack(hdr)
         cost = length
@@ -841,6 +1363,7 @@ class Connection:
         # where idle between-message waiting parks, and folding that into
         # the per-frame number would drown the transfer cost it measures
         t_io = time.monotonic()
+        blob_verified = False
         try:
             blob = None
             if flags & FLAG_BLOB:
@@ -853,14 +1376,23 @@ class Connection:
                     # and desync the stream — reject before any read
                     raise BadFrame(f"bad blob prefix on type {type_id}")
                 pickled = await self.reader.readexactly(plen)
-                blob = await self.reader.readexactly(
-                    length - _BLOB_PFX.size - plen)
+                blob_len = length - _BLOB_PFX.size - plen
+                cls = _MSG_TYPES.get(type_id)
+                if getattr(cls, "BLOB_VIEW_OK", False) \
+                        and isinstance(self.reader, FrameReceiver):
+                    # store/decode-lane blob: land in an uninitialized
+                    # buffer (no memset pass over the data volume)
+                    blob = await self.reader.readexactly(blob_len,
+                                                         uninit=True)
+                else:
+                    blob = await self.reader.readexactly(blob_len)
                 if crc and self.crc_enabled \
                         and self.crc_fn(pickled, self.crc_fn(head)) != crc:
                     raise BadFrame(f"crc mismatch on frame type {type_id}")
-                if blob_crc and self.crc_enabled \
-                        and self.crc_fn(blob) != blob_crc:
-                    raise BadFrame(f"blob crc mismatch on type {type_id}")
+                if blob_crc and self.crc_enabled:
+                    if self.crc_fn(blob) != blob_crc:
+                        raise BadFrame(f"blob crc mismatch on type {type_id}")
+                    blob_verified = True
                 payload = pickled
             else:
                 payload = await self.reader.readexactly(length)
@@ -876,7 +1408,7 @@ class Connection:
         perf.tinc("rx_io", time.monotonic() - t_io)
         perf.inc("rx_bytes", _HDR.size + length)
         return (type_id, version, seq, payload, cost, blob,
-                bool(flags & FLAG_FIXED))
+                bool(flags & FLAG_FIXED), blob_verified)
 
     async def adopt_transport(self, reader, writer) -> None:
         """Adopt a fresh transport into this session and replay unacked
@@ -912,6 +1444,10 @@ class Connection:
             return
         if not self.closed:
             self.closed = True
+            # senders parked on the pending flush window see the error
+            # now; their frames replay from the unacked queue (lossless)
+            self._fail_pending(ConnectionResetError("connection closed"))
+            self._pin_replay_queue()
             self.writer.close()
             try:
                 # bounded: wait_closed can block if the peer never reads
@@ -939,6 +1475,11 @@ class Messenger:
         # _build_wire_perf) — owning daemons add it to their collection
         self.perf = _build_wire_perf()
         self.dispatcher: Optional[Callable] = None
+        # optional group-dispatch hook: group_dispatcher(conn, msgs) gets
+        # a whole rx batch (frames that were already buffered) so the
+        # daemon can hand stripe groups to the EC tier in one submit and
+        # coalesce replies; falls back to per-message dispatcher when None
+        self.group_dispatcher: Optional[Callable] = None
         self.server: Optional[asyncio.AbstractServer] = None
         self.addr: Optional[Tuple[str, int]] = None
         self._conns: Dict[Tuple[str, int], Connection] = {}
@@ -1236,55 +1777,140 @@ class Messenger:
         finally:
             self._tasks.discard(task)
 
+    # rx batch budget: how many already-buffered frames one dispatch
+    # round may drain before acking (bounds latency of the first ack and
+    # the throttle bytes held across a group dispatch)
+    RX_BATCH_MSGS = 32
+    RX_BATCH_BYTES = 32 << 20
+
+    @staticmethod
+    def _buffered_frame_len(reader) -> Optional[int]:
+        """Payload length of a COMPLETE frame (header + payload) already
+        buffered on the reader, else None — the rx batching predicate:
+        batch only what needs no further network wait, so a half-arrived
+        frame never stalls dispatch of messages already in hand."""
+        try:
+            if isinstance(reader, FrameReceiver):
+                buf, off = reader._pending, reader._off
+            elif isinstance(reader, asyncio.StreamReader):
+                buf, off = reader._buffer, 0
+            else:  # SecureStream
+                buf, off = reader._buf, 0
+            avail = len(buf) - off
+            if avail < _HDR.size:
+                return None
+            (length,) = struct.unpack_from("<I", buf, off)
+            return length if avail >= _HDR.size + length else None
+        except (AttributeError, struct.error):
+            return None
+
     async def _serve(self, conn: Connection) -> None:
         gen = conn.transport_gen
         conn.enable_fast_read()
         try:
             while not conn.closed and conn.transport_gen == gen:
-                (type_id, version, seq, payload, cost,
-                 blob, fixed) = await conn.read_frame()
+                # drain every frame ALREADY buffered into one batch: one
+                # dispatch round, one cumulative ack — under a sub-write
+                # burst or an op-reply flood the per-message standalone
+                # ack (and its flush) collapses into one frame
+                batch: list = []  # (seq, msg)
+                costs: list = []
+                top_seq = 0
                 try:
-                    if conn.transport_gen != gen:
-                        return  # transport replaced while we were suspended
-                    if type_id == ACK_TYPE:
-                        conn.handle_ack(struct.unpack("<Q", payload)[0])
-                        continue
-                    if seq and seq <= conn.in_seq:
-                        # replayed duplicate: re-ack (the original ack may
-                        # have been lost in the drop) but don't re-dispatch
-                        await self._ack_quietly(conn, seq)
-                        continue
-                    try:
-                        t_dec = time.monotonic()
-                        msg = decode_message(type_id, version, payload,
-                                             blob, fixed)
-                        self._note_rx(type(msg).__name__,
-                                      _HDR.size + cost,
-                                      time.monotonic() - t_dec)
-                    except Exception as e:
-                        # undecodable (type/version skew): poison-discard so
-                        # replay can't redeliver it forever
-                        print(f"messenger {self.name}: dropping undecodable "
-                              f"frame type={type_id} v={version}: {e}")
+                    while (len(batch) < self.RX_BATCH_MSGS
+                           and sum(costs) < self.RX_BATCH_BYTES):
+                        if batch:
+                            nxt = self._buffered_frame_len(conn.reader)
+                            if nxt is None or not \
+                                    self.dispatch_throttle.would_admit(nxt):
+                                # nothing fully buffered, or the throttle
+                                # would BLOCK — and its budget only
+                                # returns after dispatch, which this
+                                # batch still owes (self-deadlock)
+                                break
+                        (type_id, version, seq, payload, cost,
+                         blob, fixed, verified) = await conn.read_frame()
+                        if conn.transport_gen != gen:
+                            self.dispatch_throttle.put(cost)
+                            return  # transport replaced while suspended
+                        if type_id == ACK_TYPE:
+                            conn.handle_ack(struct.unpack("<Q", payload)[0])
+                            self.dispatch_throttle.put(cost)
+                            continue
+                        if seq and seq <= conn.in_seq:
+                            # replayed duplicate: re-ack (the original ack
+                            # may have been lost) but don't re-dispatch
+                            conn.queue_ack(seq)
+                            self.dispatch_throttle.put(cost)
+                            continue
+                        try:
+                            t_dec = time.monotonic()
+                            msg = decode_message(type_id, version, payload,
+                                                 blob, fixed)
+                            if verified:
+                                # the frame layer checked the blob's crc:
+                                # handlers holding an app-level crc of the
+                                # same bytes skip their own pass
+                                msg._wire_verified = True
+                            self._note_rx(type(msg).__name__,
+                                          _HDR.size + cost,
+                                          time.monotonic() - t_dec)
+                        except Exception as e:
+                            # undecodable (type/version skew): poison-
+                            # discard so replay can't redeliver it forever
+                            print(f"messenger {self.name}: dropping "
+                                  f"undecodable frame type={type_id} "
+                                  f"v={version}: {e}")
+                            if seq:
+                                conn.in_seq = seq
+                                conn.queue_ack(seq)
+                            self.dispatch_throttle.put(cost)
+                            continue
+                        batch.append((seq, msg))
+                        costs.append(cost)
                         if seq:
-                            conn.in_seq = seq
-                            await self._ack_quietly(conn, seq)
+                            top_seq = max(top_seq, seq)
+                    if not batch:
                         continue
+                    if len(batch) > 1:
+                        self.perf.inc("rx_batches")
+                        self.perf.hinc("rx_batch_msgs", len(batch))
                     try:
-                        if self.dispatcher is not None:
-                            await self.dispatcher(conn, msg)
+                        if self.group_dispatcher is not None \
+                                and (len(batch) > 1
+                                     or self.dispatcher is None):
+                            # whole-group handoff: the daemon partitions
+                            # the batch itself (stripe groups to the EC
+                            # tier in one submit, coalesced replies).
+                            # Singletons also route here when no plain
+                            # dispatcher is installed — a group-only
+                            # daemon must not have isolated frames
+                            # consumed-and-acked undispatched.
+                            await self.group_dispatcher(
+                                conn, [m for _, m in batch])
+                        elif self.dispatcher is not None:
+                            for _, msg in batch:
+                                try:
+                                    await self.dispatcher(conn, msg)
+                                except (asyncio.CancelledError,
+                                        GeneratorExit):
+                                    raise
+                                except Exception:
+                                    # a dispatcher bug must not wedge the
+                                    # session into infinite redelivery
+                                    traceback.print_exc()
                     except (asyncio.CancelledError, GeneratorExit):
                         raise
                     except Exception:
-                        # a dispatcher bug must not wedge the session into
-                        # infinite redelivery; log loudly and consume
                         traceback.print_exc()
-                    # ack AFTER dispatch: an ack'd frame is a consumed frame
-                    if seq:
-                        conn.in_seq = seq
-                        await self._ack_quietly(conn, seq)
+                    # ack AFTER dispatch: an ack'd frame is a consumed
+                    # frame; one cumulative ack covers the whole batch
+                    if top_seq:
+                        conn.in_seq = max(conn.in_seq, top_seq)
+                        conn.queue_ack(top_seq)
                 finally:
-                    self.dispatch_throttle.put(cost)
+                    for c in costs:
+                        self.dispatch_throttle.put(c)
         except (asyncio.IncompleteReadError, ConnectionError, BadFrame):
             pass
         finally:
@@ -1316,12 +1942,6 @@ class Messenger:
         # failure detection is responsible for marking it down)
         if self._conns.get(conn.peer) is conn:
             self._conns.pop(conn.peer, None)
-
-    async def _ack_quietly(self, conn: Connection, seq: int) -> None:
-        try:
-            await conn.send_ack(seq)
-        except (ConnectionError, OSError):
-            pass
 
     # -- outbound ------------------------------------------------------------
 

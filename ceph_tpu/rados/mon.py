@@ -931,6 +931,20 @@ class Monitor:
                 }.get(msg.key)
                 if valid is not None and msg.value not in valid:
                     return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+                if msg.key == "compression_algorithm" \
+                        and msg.value == "zstd":
+                    # zstd needs the optional `zstandard` package
+                    # (gated in bluestore the way auth gates
+                    # `cryptography`): still a VALID cluster-wide
+                    # setting — other hosts may have it — but warn when
+                    # this mon's host would store raw, so the operator
+                    # learns at config time, not from per-OSD noise
+                    import importlib.util
+
+                    if importlib.util.find_spec("zstandard") is None:
+                        print("mon: compression_algorithm=zstd set but "
+                              "the `zstandard` package is missing on "
+                              "this host; OSDs without it store raw")
                 if msg.key in ("compression_required_ratio",
                                "compression_min_blob_size"):
                     # numeric opts parse HERE, not in the OSD write

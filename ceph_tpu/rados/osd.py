@@ -43,7 +43,7 @@ import time
 import uuid
 import zlib
 from collections import deque
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -56,10 +56,12 @@ from ceph_tpu.rados.extent_cache import ExtentCache
 from ceph_tpu.utils.checksum import verify_any as crc_verify_any
 from ceph_tpu.rados.ecutil import (HashInfo, StripeInfo,
                                    batched_encode_async,
+                                   batched_encode_group_async,
                                    decode_object_async,
                                    planar_encode_async,
                                    planar_object_bytes, planar_rows)
-from ceph_tpu.rados.messenger import TRANSPORT_ERRORS, Messenger
+from ceph_tpu.rados.messenger import (TRANSPORT_ERRORS, BufferList,
+                                      Messenger, as_bytes)
 from ceph_tpu.rados.monclient import MonTargets
 from ceph_tpu.rados.peering import (
     ACTIVE,
@@ -398,6 +400,7 @@ class OSD:
 
     async def start(self) -> int:
         self.messenger.dispatcher = self._dispatch
+        self.messenger.group_dispatcher = self._dispatch_group
         self.addr = await self.messenger.bind()
         boot = MOsdBoot(osd_id=self.osd_id, addr=self.addr)
         # a no-quorum window answers boot with osd_id=-1: retry, don't run
@@ -698,6 +701,42 @@ class OSD:
         if fut and not fut.done():
             fut.set_result(msg)
 
+    async def _dispatch_group(self, conn, msgs) -> None:
+        """Whole-group handoff from the messenger rx batch (frames that
+        were already buffered on the transport).  Partitioning preserves
+        per-connection order — only CONSECUTIVE runs of one type batch:
+        sub-write runs apply together and coalesce their replies into
+        one flush window; everything else (including MOSDOps, whose
+        sharded-op-queue enqueue already returns at queue time, so a
+        batch of writes reaches the BatchingQueue's coalescing window
+        together) dispatches singly in arrival order."""
+        i = 0
+        n = len(msgs)
+        while i < n:
+            if isinstance(msgs[i], MECSubWrite):
+                j = i
+                while j < n and isinstance(msgs[j], MECSubWrite):
+                    j += 1
+                try:
+                    await self._handle_sub_write_group(msgs[i:j])
+                except (asyncio.CancelledError, GeneratorExit):
+                    raise
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+                i = j
+                continue
+            try:
+                await self._dispatch(conn, msgs[i])
+            except (asyncio.CancelledError, GeneratorExit):
+                raise
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+            i += 1
+
     async def _dispatch(self, conn, msg) -> None:
         if isinstance(msg, MMapReply):
             if msg.osdmap is not None:
@@ -726,6 +765,13 @@ class OSD:
                 self._hb_last[msg.from_osd] = time.monotonic()
                 self._hb_reported.pop(msg.from_osd, None)
         elif isinstance(msg, MOSDOp):
+            # a wire blob may have landed as an uninitialized-buffer VIEW
+            # (MOSDOp.BLOB_VIEW_OK): only the write path is audited for
+            # buffer semantics — every other op's handlers (object
+            # classes, multi vectors) get real bytes
+            if msg.op != "write" \
+                    and not isinstance(msg.data, (bytes, bytearray)):
+                msg.data = as_bytes(msg.data)
             # client ops ride the sharded op queue: PG-pinned shard keeps
             # per-PG order; scheduler arbitrates client vs recovery
             # classes; a full queue blocks HERE so the messenger stops
@@ -1246,7 +1292,7 @@ class OSD:
                 if not read.ok:
                     continue
                 encoded = await self._encode_for(
-                    pool, read.data, oid=oid, version=read.version)
+                    pool, as_bytes(read.data), oid=oid, version=read.version)
                 push = MPushShard(
                     pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard_of_peer,
                     chunk=bytes(encoded[shard_of_peer]), version=read.version,
@@ -1721,7 +1767,8 @@ class OSD:
                 clone_id = max(newer)
                 wr = await self._do_write(MOSDOp(
                     op="write", pool_id=op.pool_id,
-                    oid=snap_clone_oid(op.oid, clone_id), data=head.data,
+                    oid=snap_clone_oid(op.oid, clone_id),
+                    data=as_bytes(head.data),
                     reqid=uuid.uuid4().hex))
                 if not wr.ok:
                     # the clone did not durably land (below min_size, …):
@@ -2004,7 +2051,8 @@ class OSD:
                     # degraded / inconsistent / absent: whole-object path
                     read = await self._do_read(
                         MOSDOp(op="read", pool_id=op.pool_id, oid=op.oid))
-                    base = bytearray(read.data) if read.ok else bytearray()
+                    base = bytearray(as_bytes(read.data)) \
+                        if read.ok else bytearray()
                     if len(base) < op.offset:
                         base.extend(b"\x00" * (op.offset - len(base)))
                     base[op.offset:op.offset + len(op.data)] = op.data
@@ -2075,7 +2123,7 @@ class OSD:
             else:
                 remote.append((shard, osd))
         q = self._collector(tid)
-        sent = 0
+        sends = []
         for shard, osd in remote:
             # memoryview: the shard row rides the messenger's blob lane
             # without a bytes() copy; crc reuses the per-shard pass above
@@ -2091,11 +2139,17 @@ class OSD:
                 prior_version=base_version,
                 from_osd=self.osd_id, epoch=self.osdmap.epoch,
             )
-            try:
-                await self.messenger.send(self.osdmap.addr_of(osd), msg)
+            sends.append(self.messenger.send(self.osdmap.addr_of(osd), msg))
+        # CONCURRENT stripe fan-out: all k+m sub-writes enqueue and their
+        # per-connection flushes interleave on the loop, instead of each
+        # send serializing on the previous one's socket drain; a failed
+        # send counts as a missing ack, not a 5s stall
+        sent = 0
+        for got in await asyncio.gather(*sends, return_exceptions=True):
+            if got is None:
                 sent += 1
-            except TRANSPORT_ERRORS:
-                pass  # failed send counts as a missing ack, not a 5s stall
+            elif not isinstance(got, TRANSPORT_ERRORS):
+                raise got  # framing bug etc: crash loudly (the _serve rule)
         span.event(f"sub writes sent ({sent})")
         replies = await self._gather(tid, q, sent)
         span.event("commit gathered")
@@ -2210,7 +2264,9 @@ class OSD:
         plan_set = set(plan)
         for r in await self._gather(tid, q, sent):
             if r.ok and r.shard in plan_set:
-                pieces[r.shard] = r.chunk
+                # extents replies ride as a BufferList of views (local
+                # fastpath hands it over by reference): materialize here
+                pieces[r.shard] = as_bytes(r.chunk)
                 versions[r.shard] = r.version
                 sizes[r.shard] = r.object_size
             elif r.ok:
@@ -2371,9 +2427,20 @@ class OSD:
                 self._cache_put(op.pool_id, op.oid, newest, got_planar)
                 return MOSDOpReply(ok=True, data=got_planar, version=newest)
         arrays = {s: np.frombuffer(c, dtype=np.uint8) for s, c in chunks.items()}
+        # scatter=True: the healthy-read fast path hands back a
+        # BufferList of stripe VIEWS over the sub-read reply buffers —
+        # the reply writev's them as one blob, no gather copy on the
+        # primary.  Consumers that need contiguous bytes (RMW base,
+        # recovery re-encode, the local-fastpath client) materialize at
+        # their own boundary (messenger.as_bytes).
         data = await decode_object_async(codec, self._sinfo(pool), arrays,
-                                         object_size, queue=self._ec_queue)
-        self._cache_put(op.pool_id, op.oid, newest, data)
+                                         object_size, queue=self._ec_queue,
+                                         scatter=True)
+        if not isinstance(data, BufferList):
+            # a scatter result is views over this read's rx buffers; the
+            # RMW cache wants a stable contiguous copy — caching it would
+            # re-pay exactly the gather the scatter path avoids
+            self._cache_put(op.pool_id, op.oid, newest, data)
         return MOSDOpReply(ok=True, data=data, version=newest)
 
     class _AllShards:
@@ -2717,7 +2784,8 @@ class OSD:
             read = await self._do_read(
                 MOSDOp(op="read", pool_id=op.pool_id, oid=op.oid))
             if read.ok:
-                exists, data, version = True, bytearray(read.data), read.version
+                exists, data, version = (
+                    True, bytearray(as_bytes(read.data)), read.version)
                 data_loaded = True
             elif read.code != -errno.ENOENT:
                 # transient failure reading the head: the multi must not
@@ -2943,7 +3011,7 @@ class OSD:
                 read = await self._do_read(
                     MOSDOp(op="read", pool_id=op.pool_id, oid=op.oid))
                 if read.ok:
-                    data = bytearray(read.data)
+                    data = bytearray(as_bytes(read.data))
                     data_loaded = True
                 elif read.code != -errno.ENOENT:
                     return MOSDOpReply(ok=False, code=read.code,
@@ -3403,7 +3471,10 @@ class OSD:
         except NotImplementedError:
             pass  # store without xattr support
 
-    async def _handle_sub_write(self, msg: MECSubWrite) -> None:
+    async def _apply_sub_write(self, msg: MECSubWrite) -> MECSubWriteReply:
+        """Validate + apply one sub-write; the reply is the CALLER's to
+        send (the group path batches a whole run of them so the replies
+        coalesce into one flush window on the primary's connection)."""
         ok = True
         sender = getattr(msg, "from_osd", -1)
         if sender >= 0 and self.osdmap is not None:
@@ -3422,7 +3493,11 @@ class OSD:
                     ok = False
         if not ok:
             pass
-        elif msg.chunk_crc and not crc_verify_any(msg.chunk, msg.chunk_crc):
+        elif msg.chunk_crc and not getattr(msg, "_wire_verified", False) \
+                and not crc_verify_any(msg.chunk, msg.chunk_crc):
+            # _wire_verified: the frame layer already checked the blob
+            # against chunk_crc (the sender reused it as the wire crc) —
+            # a second pass over the same bytes proves nothing new
             ok = False  # corrupted in flight
         else:
             entry = LogEntry.decode(msg.log_entry) if msg.log_entry else None
@@ -3441,12 +3516,35 @@ class OSD:
             self._cache_drop(msg.pool_id, msg.oid)
             if ok:
                 self.perf.inc("subop_w")
+        return MECSubWriteReply(tid=msg.tid, shard=msg.shard, ok=ok)
+
+    async def _handle_sub_write(self, msg: MECSubWrite) -> None:
+        reply = await self._apply_sub_write(msg)
         try:
-            await self.messenger.send(
-                tuple(msg.reply_to), MECSubWriteReply(tid=msg.tid, shard=msg.shard, ok=ok)
-            )
+            await self.messenger.send(tuple(msg.reply_to), reply)
         except TRANSPORT_ERRORS:
             pass
+
+    async def _handle_sub_write_group(self, msgs: List[MECSubWrite]) -> None:
+        """A consecutive run of sub-writes from one rx batch: apply all
+        in arrival order FIRST, then send the replies — replies to the
+        same primary land in the same outbox flush window (one writev +
+        one piggybacked ack instead of a write+drain per sub-write)."""
+        replies = []
+        for msg in msgs:
+            replies.append((tuple(msg.reply_to),
+                            await self._apply_sub_write(msg)))
+
+        async def _send_one(addr, reply):
+            try:
+                await self.messenger.send(addr, reply)
+            except TRANSPORT_ERRORS:
+                pass
+
+        # concurrent enqueue (not sequential awaits): every reply joins
+        # the connection outbox before the flusher runs, so one flush
+        # window carries the whole run
+        await asyncio.gather(*[_send_one(a, r) for a, r in replies])
 
     async def _handle_sub_read(self, msg: MECSubRead) -> None:
         self.perf.inc("subop_r")
@@ -3461,13 +3559,25 @@ class OSD:
             reply = MECSubReadReply(tid=msg.tid, shard=msg.shard, ok=False)
         else:
             chunk, meta = got
+            stored_crc = 0
             if msg.extents:
-                # fragmented read: only the requested blob ranges cross the
-                # wire (stripe-RMW + sub-chunk recovery, ECMsgTypes.h:105)
-                payload = b"".join(bytes(chunk[o:o + l])
-                                   for o, l in msg.extents)
+                # fragmented read: only the requested blob ranges cross
+                # the wire, as a BufferList of extent VIEWS — no join
+                # copy (stripe-RMW + sub-chunk recovery, ECMsgTypes.h:105)
+                payload = BufferList(
+                    [memoryview(chunk)[o:o + l] for o, l in msg.extents])
             else:
                 payload = chunk
+                # whole-blob reply: the stored meta crc IS the crc of
+                # these bytes — the messenger reuses it as the frame's
+                # blob crc (BLOB_CRC_ATTR), skipping the checksum pass.
+                # MemStore only: its contents were written by THIS
+                # process, so the crc kind is the current resolver's; a
+                # persistent store may hold crcs from another build/kind
+                # (the crc_verify_any discipline), and shipping one as
+                # the wire crc would fail every frame at the receiver
+                if isinstance(self.store, MemStore):
+                    stored_crc = meta.chunk_crc
             hraw = None
             if getattr(msg, "want_hinfo", False):
                 try:
@@ -3478,7 +3588,7 @@ class OSD:
             reply = MECSubReadReply(
                 tid=msg.tid, shard=msg.shard, ok=True, chunk=payload,
                 version=meta.version, object_size=meta.object_size,
-                hinfo=hraw or b"",
+                hinfo=hraw or b"", chunk_crc=stored_crc,
             )
         try:
             await self.messenger.send(tuple(msg.reply_to), reply)
@@ -3960,7 +4070,8 @@ class OSD:
                     exclude_shards=frozenset(s for s, _ in bad))
                 if read.ok:
                     encoded = await self._encode_for(
-                        pool, read.data, oid=oid, version=read.version)
+                        pool, as_bytes(read.data), oid=oid,
+                        version=read.version)
                     for shard, osd in bad:
                         push = MPushShard(
                             pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard,
@@ -4365,8 +4476,65 @@ class OSD:
             return None
         for r in await self._gather(tid, q, 1, timeout=2.0):
             if r.ok:
-                return r.chunk, r.object_size, r.version, getattr(r, "hinfo", b"")
+                return (as_bytes(r.chunk), r.object_size, r.version,
+                        getattr(r, "hinfo", b""))
         return None
+
+    async def _push_reencoded(self, pool: PoolInfo, pg: int,
+                              items) -> int:
+        """Re-encode a recovery round's worth of objects and push their
+        missing shards.  Every object without a planar-resident (or
+        replicated) fast path rides ONE group-aware EC submit
+        (ecutil.batched_encode_group_async -> BatchingQueue.submit_group)
+        — one queue lock, one worker wakeup, one coalesced dispatch for
+        the whole stripe group.  ``items``: (oid, data, version, missing)."""
+        if not items:
+            return 0
+        encoded_by_idx: Dict[int, Any] = {}
+        group_idx: List[int] = []
+        group_bufs: List[bytes] = []
+        for i, (oid, data, version, _missing) in enumerate(items):
+            if pool.pool_type != "ec":
+                encoded_by_idx[i] = OSD._AllShards(data)
+                continue
+            if self._planar is not None:
+                # residency: the resident planar rows at this version ARE
+                # the encoded object — one pack, zero matmuls
+                rows = planar_rows(
+                    self._planar, self._planar_key(pool.pool_id, oid),
+                    version)
+                if rows is not None:
+                    encoded_by_idx[i] = rows
+                    continue
+            group_idx.append(i)
+            group_bufs.append(data)
+        if group_bufs:
+            encoded_list = await batched_encode_group_async(
+                self._codec(pool), self._sinfo(pool), group_bufs,
+                queue=self._ec_queue)
+            for i, enc in zip(group_idx, encoded_list):
+                encoded_by_idx[i] = enc
+        pushed = 0
+        for i, (oid, data, version, missing) in enumerate(items):
+            encoded = encoded_by_idx[i]
+            xattrs = self._cls_xattrs(pool.pool_id, oid)
+            hinfo_blob = self._hinfo_for(pool, encoded)
+            for shard, osd in missing:
+                push = MPushShard(
+                    pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard,
+                    chunk=bytes(encoded[shard]), version=version,
+                    object_size=len(data), xattrs=xattrs, hinfo=hinfo_blob,
+                )
+                if osd == self.osd_id:
+                    self._apply_push(push)
+                else:
+                    try:
+                        await self.messenger.send(self.osdmap.addr_of(osd),
+                                                  push)
+                    except TRANSPORT_ERRORS:
+                        continue
+                pushed += 1
+        return pushed
 
     @staticmethod
     def _newest_complete(
@@ -4449,6 +4617,9 @@ class OSD:
         k_need = (self._codec(pool).get_data_chunk_count()
                   if pool.pool_type == "ec" else 1)
         pushed = 0
+        # objects whose re-encode is deferred into one group submit:
+        # (oid, data, version, missing) tuples
+        pending_encode: List[Tuple[str, bytes, int, List[Tuple[int, int]]]] = []
         # a partial listing (unanswered peer) makes healthy objects look
         # under-replicated: never declare coverage (or purge) on one
         fully_covered = listing_ok
@@ -4536,34 +4707,22 @@ class OSD:
                             continue
                     pushed += 1
                     continue
-            # READING: gather k chunks (degraded-read machinery)
+            # READING: gather k chunks (degraded-read machinery); the
+            # re-encode is DEFERRED so every object this round joins one
+            # whole-stripe-group submit to the EC tier (below)
             read_op = MOSDOp(op="read", pool_id=pool.pool_id, oid=oid)
             reply = await self._do_read(read_op)
             if not reply.ok:
                 continue
-            # re-encode at the object's CURRENT version: deterministic encode
-            # makes pushed shards byte-identical to the originals, and the
-            # version stays consistent with surviving shards
-            encoded = await self._encode_for(
-                pool, reply.data, oid=oid, version=reply.version)
-            version = reply.version
-            xattrs = self._cls_xattrs(pool.pool_id, oid)
-            hinfo_blob = self._hinfo_for(pool, encoded)
-            for shard, osd in missing:
-                chunk = bytes(encoded[shard])
-                push = MPushShard(
-                    pool_id=pool.pool_id, pg=pg, oid=oid, shard=shard, chunk=chunk,
-                    version=version, object_size=len(reply.data),
-                    xattrs=xattrs, hinfo=hinfo_blob,
-                )
-                if osd == self.osd_id:
-                    self._apply_push(push)
-                else:
-                    try:
-                        await self.messenger.send(self.osdmap.addr_of(osd), push)
-                    except TRANSPORT_ERRORS:
-                        continue
-                pushed += 1
+            pending_encode.append((oid, as_bytes(reply.data), reply.version,
+                                   missing))
+        # re-encode at each object's CURRENT version: deterministic encode
+        # makes pushed shards byte-identical to the originals, and the
+        # version stays consistent with surviving shards.  All plain
+        # re-encodes of this round ride ONE group-aware submit
+        # (BatchingQueue.submit_group) — the recovery half of the
+        # whole-stripe-group handoff.
+        pushed += await self._push_reencoded(pool, pg, pending_encode)
         if listing_ok and holders_all_up:
             # refresh the partial-version watchlist: entries keep their
             # first-seen time across sweeps (the grace clock), entries no

@@ -15,7 +15,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ceph_tpu.rados.crush import CRUSH_ITEM_NONE, CrushMap
 from ceph_tpu.rados.crush import _mix as _crush_mix
-from ceph_tpu.rados.messenger import message
+from ceph_tpu.rados.messenger import BufferList, message
 
 
 # -- snapshot naming ----------------------------------------------------------
@@ -779,6 +779,11 @@ class MECSubReadReply:
     # recovery ship a correct HashInfo with its push instead of leaving the
     # target's stale record to fail the next deep scrub
     hinfo: bytes = b""
+    # SENDER-LOCAL (not a wire field — absent from FIXED_FIELDS): the
+    # stored shard's meta crc when `chunk` is the whole blob; the
+    # messenger reuses it as the frame's blob crc (BLOB_CRC_ATTR) so a
+    # full-blob sub-read reply ships without a checksum pass
+    chunk_crc: int = 0
 
 
 @message(34, version=2)
@@ -1033,6 +1038,30 @@ MECSubWrite.BLOB_ATTR = "chunk"
 MECSubReadReply.BLOB_ATTR = "chunk"
 MPushShard.BLOB_ATTR = "chunk"
 
+# BLOB_CRC_ATTR: this field holds a crc32c the sender ALREADY computed
+# over exactly the blob bytes (the primary's per-shard pass, a stored
+# shard's meta crc) — the messenger reuses it as the frame's blob crc
+# instead of a second checksum pass over the same bytes (the reference's
+# bufferlist cached-crc discipline).  A handler must only set it to a
+# crc of the CURRENT field bytes; 0 means "compute on the wire".
+MECSubWrite.BLOB_CRC_ATTR = "chunk_crc"
+MECSubReadReply.BLOB_CRC_ATTR = "chunk_crc"
+
+# BLOB_VIEW_OK: every consumer of this blob field treats it as a
+# read-only BUFFER (store ownership transfer, np.frombuffer decode,
+# as_bytes-normalized recovery paths) — so the messenger may land it in
+# an uninitialized np buffer and hand over a memoryview, skipping the
+# bytearray(n) memset over the whole data volume.  Fields whose
+# consumers expect bytes/bytearray semantics (MOSDOp.data into object
+# classes, MOSDOpReply.data to client code) must NOT set this.
+MECSubWrite.BLOB_VIEW_OK = True
+MECSubReadReply.BLOB_VIEW_OK = True
+# MOSDOp.data: the WRITE path is buffer-safe end to end (pad_to_stripe,
+# splice slicing, np.frombuffer encode, bytes() cache copy); the OSD
+# dispatcher normalizes data to bytes for every OTHER op (multi/call/...)
+# whose handlers — object classes especially — expect bytes semantics
+MOSDOp.BLOB_VIEW_OK = True
+
 # -- fixed binary wire layouts (messenger FLAG_FIXED) ------------------------
 # The DATA-PLANE message set encodes as a flat struct-packed field list
 # instead of pickle (reference: ECSubWrite/MOSDOp are fixed-layout
@@ -1056,7 +1085,7 @@ MOSDOpReply.FIXED_FIELDS = [
     ("version", "Q"), ("map_epoch", "q"),
 ]
 MOSDOpReply.FIXED_WHEN = staticmethod(
-    lambda m: isinstance(m.data, (bytes, bytearray, memoryview)))
+    lambda m: isinstance(m.data, (bytes, bytearray, memoryview, BufferList)))
 MECSubWrite.FIXED_FIELDS = [
     ("pool_id", "q"), ("pg", "q"), ("from_osd", "q"), ("epoch", "q"),
     ("oid", "s"), ("shard", "q"), ("chunk", "y"), ("version", "Q"),

@@ -32,6 +32,13 @@ def parse_args(argv=None):
     p.add_argument("--parameter", "-P", action="append", default=[])
     p.add_argument("--create", action="store_true")
     p.add_argument("--check", action="store_true")
+    # wire-throughput floor (warn-only): compare a fresh BENCH record's
+    # daemon_wire_put/get_MBps against the previous round's record
+    p.add_argument("--wire-floor", action="store_true")
+    p.add_argument("--bench", default="", help="current BENCH json")
+    p.add_argument("--prev", default="", help="previous round's BENCH json")
+    p.add_argument("--floor", type=float, default=0.8,
+                   help="warn when current < floor * previous")
     return p.parse_args(argv)
 
 
@@ -111,8 +118,60 @@ def run_check(args) -> int:
     return 0
 
 
+def _bench_metrics(path: str) -> dict:
+    """Flatten a BENCH record: either the raw `bench.py` output dict or
+    the round-trajectory shape {"parsed": {...}} the driver archives."""
+    import json
+
+    with open(path) as f:
+        rec = json.load(f)
+    if isinstance(rec, dict) and isinstance(rec.get("parsed"), dict):
+        rec = rec["parsed"]
+    return rec if isinstance(rec, dict) else {}
+
+
+def run_wire_floor(args) -> int:
+    """Warn-only daemon-wire throughput floor: every round compares its
+    fresh BENCH record's daemon_wire_put/get_MBps against the previous
+    round's, so a wire-path regression surfaces in the round it lands
+    (the byte-exact corpus above pins ENCODINGS over time; this pins the
+    data plane's measured throughput the same way).  Warn-only because
+    bench hosts swing run to run — the floor flags, a human judges."""
+    if not args.bench or not args.prev:
+        print("--wire-floor needs --bench and --prev", file=sys.stderr)
+        return 1
+    try:
+        cur = _bench_metrics(args.bench)
+        prev = _bench_metrics(args.prev)
+    except (OSError, ValueError) as e:
+        print(f"wire-floor: unreadable BENCH record: {e}", file=sys.stderr)
+        return 1
+    warned = False
+    for key in ("daemon_wire_put_MBps", "daemon_wire_get_MBps"):
+        c = float(cur.get(key, 0.0) or 0.0)
+        p = float(prev.get(key, 0.0) or 0.0)
+        if p <= 0:
+            print(f"wire-floor: no previous {key}; skipping")
+            continue
+        floor = p * args.floor
+        if c < floor:
+            warned = True
+            print(f"WARN wire-floor: {key} {c:.1f} MB/s < "
+                  f"{args.floor:.2f} x previous {p:.1f} "
+                  f"(floor {floor:.1f})")
+        else:
+            print(f"wire-floor: {key} {c:.1f} MB/s vs previous {p:.1f} ok")
+    if warned:
+        print("WARN wire throughput regressed vs the previous BENCH "
+              "record (warn-only; investigate before claiming "
+              "cluster-path numbers)")
+    return 0  # warn-only by design
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.wire_floor:
+        return run_wire_floor(args)
     if not args.create and not args.check:
         print("must specify either --check, or --create", file=sys.stderr)
         return 1

@@ -61,6 +61,9 @@ def _sample_messages() -> List[Any]:
         t.MECSubRead(pool_id=2, pg=5, oid="obj", shard=1, tid="t2",
                      reply_to=("host", 1), extents=[(0, 4096), (8192, 64)],
                      want_hinfo=True),
+        # chunk_crc stays default: it is SENDER-LOCAL (not in
+        # FIXED_FIELDS — the frame's blob-crc slot carries it), so the
+        # decoded archive must see the dataclass default
         t.MECSubReadReply(tid="t2", shard=1, ok=True, chunk=b"bytes",
                           version=7, object_size=55, hinfo=b"H"),
         t.MECSubDelete(pool_id=1, pg=2, oid="gone", shard=0, tid="t3",

@@ -9,6 +9,13 @@ import pickle
 import pytest
 
 from ceph_tpu.rados.bluestore import Allocator, BlueStore, EIOError
+from ceph_tpu.rados.bluestore import _zstandard
+
+# zstd rides the optional `zstandard` package (gated in bluestore like
+# auth gates `cryptography`): hosts without it run the whole suite minus
+# the zstd-exercising cases
+needs_zstd = pytest.mark.skipif(
+    _zstandard is None, reason="zstandard package not installed")
 from ceph_tpu.rados.kv import MemDB, WalDB, WriteBatch
 from ceph_tpu.rados.store import ShardMeta, Transaction
 
@@ -301,6 +308,7 @@ class TestCompression:
         assert bs._onodes[(1, "p", 0)].compression is None
         assert bs.read((1, "p", 0))[0] == blob
 
+    @needs_zstd
     def test_algorithms_zstd_lzma(self):
         for algo in ("zstd", "lzma"):
             bs = self._store(conf={
@@ -313,6 +321,7 @@ class TestCompression:
             assert bs._onodes[(1, algo, 0)].compression == algo
             assert bs.read((1, algo, 0))[0] == blob
 
+    @needs_zstd
     def test_per_pool_opts_override_conf(self):
         bs = self._store()  # global mode: none
         bs.set_pool_opts(7, {"compression_mode": "aggressive",
@@ -397,6 +406,7 @@ class TestCompression:
 
 
 class TestCompressionClusterPath:
+    @needs_zstd
     def test_pool_opts_flow_map_to_store_and_scrub_repairs(self, tmp_path):
         """End to end: `pool set compression_mode` rides the OSDMap into
         every OSD's BlueStore; a corrupted compressed shard EIOs and

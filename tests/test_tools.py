@@ -554,3 +554,49 @@ def test_benchmark_decode_verification_caps_signatures(monkeypatch):
     assert code == 0
     # loop decodes + at most C(6,1)=6 distinct verification decodes
     assert counting["calls"] <= iters + 6
+
+
+class TestWireFloor:
+    """non_regression --wire-floor: warn-only daemon-wire throughput
+    floor against the previous round's BENCH record."""
+
+    def _write(self, path, put, get, wrapped=False):
+        import json
+
+        rec = {"daemon_wire_put_MBps": put, "daemon_wire_get_MBps": get}
+        if wrapped:
+            rec = {"n": 5, "parsed": rec}
+        path.write_text(json.dumps(rec))
+
+    def test_ok_and_warn_paths_both_exit_zero(self, tmp_path, capsys):
+        prev = tmp_path / "prev.json"
+        cur = tmp_path / "cur.json"
+        self._write(prev, 200.0, 300.0, wrapped=True)
+        # regression on get only
+        self._write(cur, 210.0, 100.0)
+        argv = ["--wire-floor", "--bench", str(cur), "--prev", str(prev)]
+        assert non_regression.main(argv) == 0  # warn-only
+        out = capsys.readouterr().out
+        assert "WARN wire-floor: daemon_wire_get_MBps" in out
+        assert "daemon_wire_put_MBps 210.0" in out
+        # healthy record: no warning
+        self._write(cur, 210.0, 290.0)
+        assert non_regression.main(argv) == 0
+        out = capsys.readouterr().out
+        assert "WARN" not in out
+
+    def test_missing_previous_metric_skips(self, tmp_path, capsys):
+        prev = tmp_path / "prev.json"
+        cur = tmp_path / "cur.json"
+        prev.write_text("{}")
+        self._write(cur, 100.0, 100.0)
+        assert non_regression.main(
+            ["--wire-floor", "--bench", str(cur), "--prev", str(prev)]) == 0
+        assert "skipping" in capsys.readouterr().out
+
+    def test_unreadable_record_fails(self, tmp_path):
+        cur = tmp_path / "cur.json"
+        self._write(cur, 1.0, 1.0)
+        assert non_regression.main(
+            ["--wire-floor", "--bench", str(cur),
+             "--prev", str(tmp_path / "nope.json")]) == 1
