@@ -805,6 +805,7 @@ def main() -> int:
     daemon_wire_put_mbps = 0.0
     daemon_wire_get_mbps = 0.0
     daemon_wire_perf: dict = {}
+    daemon_objecter_perf: dict = {}
     try:
         import subprocess
 
@@ -821,6 +822,7 @@ def main() -> int:
             daemon_wire_put_mbps = got.get("wire_put_MBps", 0.0)
             daemon_wire_get_mbps = got.get("wire_get_MBps", 0.0)
             daemon_wire_perf = got.get("wire_perf", {})
+            daemon_objecter_perf = got.get("objecter_perf", {})
     except Exception:
         pass
 
@@ -914,6 +916,10 @@ def main() -> int:
         # averages, per-type counts, flush-size histogram): the
         # framing/io split trends round over round alongside the MB/s
         "wire_perf": daemon_wire_perf,
+        # the client `objecter` snapshot of the same run (resends,
+        # timeouts, backoffs, paused ops): nonzero resilience counters
+        # flag that a wire number was measured through recovery noise
+        "objecter_perf": daemon_objecter_perf,
     }))
     return 0
 
@@ -1019,6 +1025,7 @@ def daemon_path_bench() -> int:
             # between trials returns the buffers so each trial measures
             # the path, not the allocator's cold-page luck
             put_dt = get_dt = float("inf")
+            c.perf.reset()
             for _ in range(3):
                 t0 = time.perf_counter()
                 await c.put(pool, "big", payload)
@@ -1031,19 +1038,25 @@ def daemon_path_bench() -> int:
             wire_perf = _wire_perf_summary(
                 [o.messenger.perf.dump() for o in cluster.osds.values()]
                 + [c.messenger.perf.dump()])
+            objecter_perf = c.perf.dump()
             await c.stop()
-            return put_dt, get_dt, wire_perf
+            return put_dt, get_dt, wire_perf, objecter_perf
         finally:
             await cluster.stop()
 
-    put_dt, get_dt, _ = asyncio.run(go(True))
-    wire_put_dt, wire_get_dt, wire_perf = asyncio.run(go(False))
+    put_dt, get_dt, _, _ = asyncio.run(go(True))
+    wire_put_dt, wire_get_dt, wire_perf, objecter_perf = asyncio.run(
+        go(False))
     print(json.dumps({
         "put_MBps": round(size / put_dt / 1e6, 1),
         "get_MBps": round(size / get_dt / 1e6, 1),
         "wire_put_MBps": round(size / wire_put_dt / 1e6, 1),
         "wire_get_MBps": round(size / wire_get_dt / 1e6, 1),
-        "wire_perf": wire_perf}))
+        "wire_perf": wire_perf,
+        # the client `objecter` set for the measured window: resends /
+        # timeouts / backoffs should be ZERO on a healthy bench host —
+        # a nonzero count explains an anomalous MB/s sample
+        "objecter_perf": objecter_perf}))
     return 0
 
 

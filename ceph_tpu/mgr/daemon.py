@@ -24,6 +24,7 @@ import json
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ceph_tpu.common.perf_counters import PerfCountersCollection
 from ceph_tpu.rados.messenger import Messenger, message
 
 
@@ -59,6 +60,11 @@ class MgrDaemon:
         self._modules_task: Optional[asyncio.Task] = None
         self.balancer_rounds = 0
         self.autoscaler_changes = 0
+        # the mgr's OWN perf sets, rendered into /metrics under
+        # daemon="mgr" — the module client's `objecter` + `wire` sets
+        # land here, so client-side resilience telemetry (resends,
+        # backoffs, paused ops) is scrapeable like any daemon set
+        self.extra_perf = PerfCountersCollection()
 
     async def start(self) -> Tuple[str, int]:
         self.messenger.dispatcher = self._dispatch
@@ -97,6 +103,8 @@ class MgrDaemon:
                 self.conf.get("mgr_target_objects_per_pg", 32)))
         client = RadosClient(self.mon_addrs, self.conf)
         await client.start()
+        self.extra_perf.add(client.perf)
+        self.extra_perf.add(client.messenger.perf)
         try:
             while True:
                 await asyncio.sleep(interval)
@@ -213,8 +221,13 @@ class MgrDaemon:
                 lines.append(f"# TYPE {metric} {kind}")
                 seen_help.add(metric)
 
-        for name, report in sorted(self.reports.items()):
-            for set_name, counters in (report.perf or {}).items():
+        sources = [(name, report.perf or {})
+                   for name, report in sorted(self.reports.items())]
+        own = self.extra_perf.dump()
+        if own:
+            sources.append(("mgr", own))
+        for name, perf_sets in sources:
+            for set_name, counters in perf_sets.items():
                 for cname, value in counters.items():
                     metric = f"ceph_{set_name}_{cname}"
                     if isinstance(value, dict) and "avgcount" in value:

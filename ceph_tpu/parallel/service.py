@@ -38,6 +38,20 @@ dispatch running the matrix as a static XOR schedule compiled per matrix
 Residents store at 1 HBM byte per data byte instead of 8, so the same
 store budget holds 8x the objects.
 
+DEVICE-DISPATCH CIRCUIT BREAKER (the robustness layer): every lane owns a
+breaker with three states.  CLOSED: dispatches go to the device; one that
+RAISES is rescued host-side (the group's futures resolve with
+byte-identical numpy GF(2) results — submitters never see the device
+die) and trips the lane OPEN; one that completes but exceeds
+``dispatch_timeout`` trips it after the fact.  OPEN: the lane's groups
+are served by the CPU mirrors (``_cpu_apply_request``) until the
+cooldown elapses (doubling per consecutive trip, capped).  HALF-OPEN:
+one group re-probes the device; success closes the breaker, failure
+re-opens it.  ``inject_dispatch_delay`` (osd_debug_inject_dispatch_delay
+/ CEPH_TPU_INJECT_DISPATCH_DELAY) slows dispatches to exercise the
+watchdog.  Counted in `ec_tpu`: breaker_trip / breaker_probe /
+breaker_recover / breaker_fallback + the breaker_open_lanes gauge.
+
 OBSERVABILITY (the `ec_tpu` + `planar_store` counter sets): the queue owns
 a PerfCounters set — name -> meaning -> kind in _build_ec_tpu_perf — with
 per-lane submit/byte counters (submit_<lane>/bytes_<lane>, u64), queue-wait
@@ -117,7 +131,130 @@ def _build_ec_tpu_perf() -> PerfCounters:
     b.add_u64_counter("flush_bytes", "rounds flushed by the bytes threshold")
     b.add_u64_counter("flush_delay", "rounds flushed by max_delay expiry")
     b.add_u64_counter("flush_forced", "rounds flushed by explicit flush()")
+    b.add_u64_counter("breaker_trip",
+                      "lane breaker trips (dispatch raised or exceeded "
+                      "dispatch_timeout)")
+    b.add_u64_counter("breaker_probe", "half-open device re-probes")
+    b.add_u64_counter("breaker_recover",
+                      "breakers closed by a successful probe")
+    b.add_u64_counter("breaker_fallback",
+                      "groups served by the host CPU path (breaker open "
+                      "or dispatch failure rescue)")
+    b.add_u64("breaker_open_lanes", "lanes currently tripped open (gauge)")
     return b.create_perf_counters()
+
+
+# -- host-side GF(2) mirrors (the circuit-breaker CPU fallback path) ---------
+# Byte-for-byte numpy mirrors of the device lanes in ceph_tpu/ops/gf2.py:
+# GF(2) arithmetic is exact, so a group served here fans out results
+# BYTE-IDENTICAL to what the device lane would have produced (the content
+# gates in tests/test_batching.py hold across the failover).  Kept
+# jax-free on purpose — this path must work when the device stack is the
+# thing that is broken.
+
+
+def _np_unpack_bits(data: np.ndarray, w: int) -> np.ndarray:
+    """[n, B] uint8 chunks -> [n*w, Bc] int8 bit-planes (mirror of
+    ops/gf2.unpack_bits_bytes for w in 4/8/16)."""
+    n, B = data.shape
+    if w == 16:
+        pairs = data.reshape(n, B // 2, 2)
+        planes = [((pairs[:, :, x // 8] >> (x % 8)) & 1) for x in range(16)]
+        return np.stack(planes, axis=1).reshape(n * 16, B // 2).astype(np.int8)
+    if w == 4:
+        shifts = np.arange(4, dtype=np.uint8)
+        lo = (data[:, None, :] >> shifts[None, :, None]) & 1
+        hi = (data[:, None, :] >> (shifts + 4)[None, :, None]) & 1
+        return np.stack([lo, hi], axis=-1).reshape(n * 4, B * 2).astype(np.int8)
+    shifts = np.arange(8, dtype=np.uint8)
+    return (((data[:, None, :] >> shifts[None, :, None]) & 1)
+            .reshape(n * 8, B).astype(np.int8))
+
+
+def _np_pack_bits(bits: np.ndarray, w: int, out_rows: int) -> np.ndarray:
+    """Inverse of _np_unpack_bits (mirror of ops/gf2.pack_bits_bytes)."""
+    if w == 16:
+        Bc = bits.shape[1]
+        planes = bits.reshape(out_rows, 16, Bc).astype(np.int32)
+        lo = np.zeros((out_rows, Bc), np.int32)
+        hi = np.zeros((out_rows, Bc), np.int32)
+        for x in range(8):
+            lo = lo + (planes[:, x] << x)
+            hi = hi + (planes[:, x + 8] << x)
+        return np.stack([lo, hi], axis=-1).reshape(out_rows, Bc * 2) \
+            .astype(np.uint8)
+    if w == 4:
+        Bc2 = bits.shape[1]
+        planes = bits.reshape(out_rows, 4, Bc2 // 2, 2).astype(np.int32)
+        shifts = np.arange(4, dtype=np.int32)
+        lo = np.sum(planes[..., 0] << shifts[None, :, None], axis=1)
+        hi = np.sum(planes[..., 1] << shifts[None, :, None], axis=1)
+        return (lo | (hi << 4)).astype(np.uint8)
+    Bc = bits.shape[1]
+    planes = bits.reshape(out_rows, 8, Bc).astype(np.int32)
+    shifts = np.arange(8, dtype=np.int32)
+    return np.sum(planes << shifts[None, :, None], axis=1).astype(np.uint8)
+
+
+def _np_matmul_gf2(mbits: np.ndarray, bits: np.ndarray) -> np.ndarray:
+    return ((np.asarray(mbits, dtype=np.int32)
+             @ np.asarray(bits, dtype=np.int32)) & 1).astype(np.int8)
+
+
+def _np_words(bits: np.ndarray) -> np.ndarray:
+    """[R, B] 0/1 bit rows -> [R, B//32] uint32 plane words (mirror of
+    ops/gf2._bits_to_words / pack_bitplanes_u32's word layout)."""
+    return np.packbits(bits.astype(np.uint8), axis=1,
+                       bitorder="little").view(np.uint32)
+
+
+def _cpu_apply_request(kind: str, mbits: np.ndarray, regions, w: int,
+                       out_rows: int):
+    """Serve ONE lane request host-side; returns exactly what the device
+    lane's fan-out would have resolved the request's future with (device
+    buffers become numpy arrays — every consumer accepts both)."""
+    mb = np.asarray(mbits, dtype=np.uint8)
+    if kind in ("packed", "packedbit"):
+        bits = _np_unpack_bits(np.asarray(regions, dtype=np.uint8), w)
+        return _np_pack_bits(_np_matmul_gf2(mb, bits), w, out_rows)
+    if kind == "planar":
+        return _np_matmul_gf2(mb, np.asarray(regions))
+    if kind == "resident":
+        bits = _np_unpack_bits(np.asarray(regions, dtype=np.uint8), w)
+        pbits = _np_matmul_gf2(mb, bits)
+        return (_np_pack_bits(pbits, w, out_rows),
+                np.concatenate([bits, pbits], axis=0))
+    if kind == "packedbit_resident":
+        bits = _np_unpack_bits(np.asarray(regions, dtype=np.uint8), 8)
+        pbits = _np_matmul_gf2(mb, bits)
+        return (_np_pack_bits(pbits, 8, out_rows),
+                np.concatenate([_np_words(bits), _np_words(pbits)], axis=0))
+    if kind == "packedbit_planes":
+        pl = np.asarray(regions)
+        out = np.zeros((mb.shape[0], pl.shape[1]), dtype=pl.dtype)
+        for r in range(mb.shape[0]):
+            cols = np.nonzero(mb[r])[0]
+            if len(cols):
+                out[r] = np.bitwise_xor.reduce(pl[cols], axis=0)
+        return out
+    raise ValueError(f"unknown lane kind {kind!r}")
+
+
+class _LaneBreaker:
+    """Per-lane circuit breaker state.  closed -> (trip) -> open ->
+    (cooldown elapses) -> one half-open probe -> closed on success, or
+    re-open with doubled cooldown on failure."""
+
+    __slots__ = ("state", "open_until", "cooldown", "probing")
+
+    CLOSED = "closed"
+    OPEN = "open"
+
+    def __init__(self):
+        self.state = self.CLOSED
+        self.open_until = 0.0
+        self.cooldown = 0.0
+        self.probing = False
 
 
 class _Request(NamedTuple):
@@ -208,6 +345,32 @@ class BatchingQueue:
         self.tracer = None
         # bounded ring of recent dispatches for `dump_ec_batch_timeline`
         self.timeline: "collections.deque" = collections.deque(maxlen=128)
+        # -- device-dispatch watchdog + per-lane circuit breaker ------------
+        # A dispatch that RAISES is rescued host-side immediately (its
+        # requests resolve with byte-identical numpy results) and trips
+        # the lane's breaker; one that completes but exceeds
+        # dispatch_timeout trips it after the fact (the results were
+        # fine, the lane is slow/sick).  While a breaker is OPEN the
+        # lane's groups are served by the CPU mirrors; after
+        # breaker_cooldown (doubling per consecutive trip, capped at
+        # breaker_cooldown_max) ONE group re-probes the device —
+        # success closes the breaker (half-open re-engage).
+        try:
+            self.dispatch_timeout = float(
+                _os.environ.get("CEPH_TPU_DISPATCH_TIMEOUT") or 30.0)
+        except ValueError:
+            self.dispatch_timeout = 30.0
+        # osd_debug_inject_dispatch_delay: slow every device dispatch by
+        # this many seconds (exercises the watchdog/breaker; 0 = off)
+        try:
+            self.inject_dispatch_delay = float(
+                _os.environ.get("CEPH_TPU_INJECT_DISPATCH_DELAY") or 0.0)
+        except ValueError:
+            self.inject_dispatch_delay = 0.0
+        self.breaker_cooldown = 1.0
+        self.breaker_cooldown_max = 30.0
+        self._breakers: Dict[str, _LaneBreaker] = {}
+        self._breaker_lock = threading.Lock()
         # test seam: invoked (worker thread) after a round is launched,
         # before the backlog check — lets tests inject a standing backlog
         # deterministically instead of racing thread schedulers
@@ -561,6 +724,89 @@ class BatchingQueue:
                   .tag("group_size", len(g.requests))
                   .tag("bytes", g.pending_bytes))
 
+    # -- circuit breaker (device-dispatch watchdog) --------------------------
+
+    def _breaker(self, kind: str) -> _LaneBreaker:
+        br = self._breakers.get(kind)
+        if br is None:
+            br = self._breakers[kind] = _LaneBreaker()
+        return br
+
+    def _gauge_open_lanes_locked(self) -> None:
+        self.perf.set("breaker_open_lanes",
+                      sum(1 for b in self._breakers.values()
+                          if b.state == _LaneBreaker.OPEN))
+
+    def _breaker_route_cpu(self, kind: str) -> bool:
+        """True = serve this group host-side (breaker open); False =
+        dispatch to the device (closed, or the half-open probe)."""
+        with self._breaker_lock:
+            br = self._breakers.get(kind)
+            if br is None or br.state != _LaneBreaker.OPEN:
+                return False
+            if time.monotonic() >= br.open_until and not br.probing:
+                br.probing = True  # half-open: ONE group probes the device
+                self.perf.inc("breaker_probe")
+                return False
+            return True
+
+    def _breaker_failure(self, kind: str) -> None:
+        with self._breaker_lock:
+            br = self._breaker(kind)
+            br.cooldown = (min(br.cooldown * 2, self.breaker_cooldown_max)
+                           if br.cooldown else self.breaker_cooldown)
+            br.state = _LaneBreaker.OPEN
+            br.open_until = time.monotonic() + br.cooldown
+            br.probing = False
+            self.perf.inc("breaker_trip")
+            self._gauge_open_lanes_locked()
+
+    def _breaker_success(self, kind: str) -> None:
+        with self._breaker_lock:
+            br = self._breakers.get(kind)
+            if br is None or br.state == _LaneBreaker.CLOSED:
+                return
+            if not br.probing:
+                # a STRAGGLER from before the trip completing fine must
+                # not close the breaker (and zero the escalating
+                # cooldown) — only the designated half-open probe is
+                # evidence about the lane's CURRENT health
+                return
+            br.state = _LaneBreaker.CLOSED
+            br.cooldown = 0.0
+            br.probing = False
+            self.perf.inc("breaker_recover")
+            self._gauge_open_lanes_locked()
+
+    def _complete_cpu(self, g: _Group, wait_s: float = 0.0) -> None:
+        """Serve a whole group on the host CPU mirrors (breaker open, or
+        rescue after a device failure): every request resolves with the
+        byte-identical numpy result.  A CPU-path error fails the group's
+        futures like any dispatch error would."""
+        t0 = time.monotonic()
+        try:
+            results = [
+                _cpu_apply_request(g.kind, g.mbits, req.regions, g.w,
+                                   g.out_rows)
+                for req in g.requests
+            ]
+        except Exception as e:
+            self._fail_group(g, e)
+            return
+        for req, res in zip(g.requests, results):
+            try:
+                req.future.set_result(res)
+            except InvalidStateError:
+                pass
+        self.perf.inc("breaker_fallback")
+        self.timeline.append({
+            "ts": time.time(), "lane": g.kind,
+            "group_size": len(g.requests),
+            "bytes": g.pending_bytes,
+            "queue_wait_s": round(wait_s, 6),
+            "device_s": round(time.monotonic() - t0, 6),
+            "cpu_fallback": True})
+
     def _launch_safe(self, groups: List[_Group]) -> list:
         launched = []
         for g in groups:
@@ -577,7 +823,17 @@ class BatchingQueue:
                     req.span.event(f"ec coalesced lane={g.kind} "
                                    f"group={len(g.requests)}")
             wait_s /= len(g.requests)
+            if self._breaker_route_cpu(g.kind):
+                # lane breaker open: the device is sick — serve the whole
+                # group host-side, byte-identical
+                self._complete_cpu(g, wait_s)
+                continue
             sp = self._dispatch_span(g)
+            if self.inject_dispatch_delay:
+                # osd_debug_inject_dispatch_delay: counted into the
+                # dispatch elapsed (t_launch = now, above) so the
+                # watchdog sees the slow dispatch
+                time.sleep(self.inject_dispatch_delay)
             try:
                 if g.kind == "planar":
                     state = self._launch_planar(g)
@@ -593,13 +849,15 @@ class BatchingQueue:
                     state = self._launch_packed(g)
                 if sp is not None:
                     sp.event("launched")
-                launched.append(_Launched(g, state, time.monotonic(), sp,
-                                          wait_s))
+                launched.append(_Launched(g, state, now, sp, wait_s))
             except Exception as e:
+                # device launch failure: trip the breaker and RESCUE the
+                # group host-side — submitters never see the device die
                 if sp is not None:
                     sp.event(f"launch failed: {type(e).__name__}")
                     sp.finish()
-                self._fail_group(g, e)
+                self._breaker_failure(g.kind)
+                self._complete_cpu(g, wait_s)
         if launched and self._launch_hook is not None:
             self._launch_hook()
         return launched
@@ -621,12 +879,22 @@ class BatchingQueue:
                     # byte columns back out
                     self._complete_packed(g, state)
             except Exception as e:
+                # device completion failure: trip the breaker and rescue
+                # the group host-side (byte-identical CPU mirrors)
                 if lc.span is not None:
                     lc.span.event(f"complete failed: {type(e).__name__}")
                     lc.span.finish()
-                self._fail_group(g, e)
+                self._breaker_failure(g.kind)
+                self._complete_cpu(g, lc.wait_s)
                 continue
             device_s = time.monotonic() - lc.t_launch
+            if self.dispatch_timeout and device_s > self.dispatch_timeout:
+                # the dispatch COMPLETED (results are good) but blew the
+                # watchdog budget: the lane is sick — trip so the next
+                # groups take the CPU path until a probe proves it healthy
+                self._breaker_failure(g.kind)
+            else:
+                self._breaker_success(g.kind)
             self.perf.tinc("dispatch_dev", device_s)
             self.perf.hinc("group_size", len(g.requests))
             if lc.span is not None:

@@ -4,15 +4,44 @@ Role-equivalent of librados + Objecter (reference src/osdc/Objecter.cc:2257
 op_submit / _calc_target): fetch the OSDMap from the mon, map
 object -> PG -> primary locally, send the op to the primary, and on failure
 refetch the map and resend (the Objecter's retry-across-epochs behavior,
-idempotent by reqid)."""
+idempotent by reqid).
+
+Resend/backoff discipline (the Objecter-grade op-resilience layer):
+
+- Every data op gets ONE reqid for its whole lifetime and a persistent
+  in-flight record (target pg/primary, epoch the target was computed on,
+  deadline).  The OSD's PG log dedupes by reqid, so resends are
+  exactly-once no matter how many transports they cross.
+- Ops RESEND, they do not fail, on transient trouble: wrong-primary /
+  degraded replies (typed -ESTALE/-EAGAIN, with the reply's epoch as a
+  re-target fence), transport death, per-attempt reply timeouts, and map
+  epoch bumps (a refresh that moves an in-flight op's primary wakes its
+  reply wait immediately — the Objecter's _scan_requests resend).
+  Retry pacing is capped exponential backoff with jitter
+  (client_backoff_base/_cap); only DEFINITIVE typed answers (-ENOENT,
+  -EPERM, ...) or the op deadline (client_op_deadline) surface errors.
+- MOSDBackoff: a blocked PG (peering below min_size, saturated dispatch
+  queue) parks every op targeting it until the matching unblock — or
+  until the block's duration expires / a map change moves the primary
+  (the liveness bounds for a primary that dies holding blocks).
+- Paused maps: while the osdmap carries "pausewr"/"full" (writes) or
+  "pauserd" (reads), matching ops QUEUE and poll for the map that lifts
+  the gate instead of failing (Objecter pauserd/pausewr handling).
+
+The `objecter` perf set counts all of it (resends, timeouts,
+backoffs_received, backoff_wait_s, paused_ops, map_kicks); read it via
+``perf_dump()``."""
 
 from __future__ import annotations
 
 import asyncio
 import errno
+import random
+import time
 import uuid
 from typing import Dict, List, Optional, Tuple
 
+from ceph_tpu.common.perf_counters import PerfCounters, PerfCountersBuilder
 from ceph_tpu.rados.messenger import BufferList, Messenger
 from ceph_tpu.rados.monclient import MonTargets
 from ceph_tpu.rados.types import (
@@ -28,6 +57,8 @@ from ceph_tpu.rados.types import (
     MDeletePool,
     MGetMap,
     MMapReply,
+    MOSDBackoff,
+    MOSDSetFlag,
     MPoolSet,
     MSetUpmap,
     MMarkDown,
@@ -73,6 +104,69 @@ _DEFINITIVE_CODES = frozenset((
 # wait (one dropped ack on a healthy cluster must not pay a multi-second
 # epoch poll).
 
+# ops that mutate object state: gated by the map's write-pause flags
+# ("pausewr"/"full"); reads pause only under "pauserd".  Class calls and
+# watch registration count as writes (the reference flags
+# CEPH_OSD_OP_CALL/WATCH as WR ops — cls_rbd/cls_rgw mutations ride
+# "call", so excluding it would let metadata writes through a write
+# freeze).
+_WRITE_OPS = frozenset(("write", "delete", "multi", "snap-trim",
+                        "call", "watch", "unwatch"))
+
+
+class _OpKick(Exception):
+    """Internal: an in-flight op's reply wait was woken early — the map
+    epoch advanced and its target moved, or an MOSDBackoff landed for its
+    PG.  The submit loop re-targets (or parks) immediately instead of
+    waiting out the reply timeout."""
+
+
+class _OpRecord:
+    """Persistent in-flight op record (the Objecter's op_t role): one per
+    logical op for its whole lifetime, across every resend."""
+
+    __slots__ = ("op", "pg", "target", "epoch", "deadline", "fut",
+                 "paused_counted")
+
+    def __init__(self, op: MOSDOp, deadline: float):
+        self.op = op
+        self.pg: Optional[int] = None          # target pg (last send)
+        self.target: Optional[int] = None      # primary osd (last send)
+        self.epoch = 0                         # epoch target was computed on
+        self.deadline = deadline               # monotonic() ceiling
+        self.fut: Optional[asyncio.Future] = None  # live reply wait
+        self.paused_counted = False            # paused_ops bumped once
+
+
+def _build_objecter_perf() -> PerfCounters:
+    """The `objecter` counter set — client-side op-resilience telemetry
+    (name -> meaning -> kind):
+
+      op                 u64         logical data ops submitted
+      resends            u64         op sends beyond the first (map change,
+                                     timeout, transport death, backoff)
+      timeouts           u64         per-attempt reply timeouts
+      backoffs_received  u64         MOSDBackoff blocks received
+      backoffs_released  u64         MOSDBackoff unblocks received
+      backoff_wait_s     longrunavg  seconds ops spent parked under a block
+      paused_ops         u64         ops queued on a paused map (pausewr/
+                                     pauserd/full flags)
+      map_kicks          u64         in-flight reply waits woken early
+                                     (target moved / backoff landed)
+      inflight           u64         ops currently in flight (gauge)
+    """
+    b = PerfCountersBuilder("objecter")
+    b.add_u64_counter("op", "logical data ops submitted")
+    b.add_u64_counter("resends", "op sends beyond the first")
+    b.add_u64_counter("timeouts", "per-attempt reply timeouts")
+    b.add_u64_counter("backoffs_received", "MOSDBackoff blocks received")
+    b.add_u64_counter("backoffs_released", "MOSDBackoff unblocks received")
+    b.add_time_avg("backoff_wait_s", "seconds parked under a PG backoff")
+    b.add_u64_counter("paused_ops", "ops queued on a paused map")
+    b.add_u64_counter("map_kicks", "in-flight waits woken by map/backoff")
+    b.add_u64("inflight", "ops currently in flight (gauge)")
+    return b.create_perf_counters()
+
 
 class RadosClient:
     def __init__(self, mon_addr, conf: Optional[dict] = None):
@@ -80,9 +174,32 @@ class RadosClient:
         self.mons = MonTargets(mon_addr)
         self.conf = conf or {}
         self.op_timeout = self.conf.get("client_op_timeout", 10.0)
+        # overall per-op deadline: transient failures RESEND until this
+        # long before surfacing an error (definitive typed answers still
+        # return immediately) — the bound that keeps "never fail a
+        # transient op" from becoming "hang forever on a dead cluster"
+        self.op_deadline = float(
+            self.conf.get("client_op_deadline", 0) or 0) \
+            or max(3.0 * float(self.op_timeout), 15.0)
+        # retry pacing: capped exponential backoff with jitter
+        self.backoff_base = float(
+            self.conf.get("client_backoff_base", 0.1) or 0.1)
+        self.backoff_cap = float(
+            self.conf.get("client_backoff_cap", 2.0) or 2.0)
+        # park ceiling for a server backoff whose unblock never arrives
+        self.backoff_park_max = float(
+            self.conf.get("client_backoff_park_max", 3.0) or 3.0)
         self.messenger = Messenger("client", self.conf, entity_type="client")
+        # the `objecter` perf set (schema: _build_objecter_perf)
+        self.perf = _build_objecter_perf()
         self.osdmap: Optional[OSDMap] = None
         self._replies: Dict[str, asyncio.Future] = {}
+        # reqid -> persistent op record; map changes and backoffs kick
+        # matching in-flight waits (resend-on-map-change)
+        self._inflight: Dict[str, _OpRecord] = {}
+        # (pool, pg) -> {"event", "expiry", "epoch", "id", "from"}:
+        # active MOSDBackoff blocks parking ops for that PG
+        self._backoffs: Dict[Tuple[int, int], Dict] = {}
         self._mon_fut: Optional[asyncio.Future] = None
         self._mon_tid: str = ""
         # serialize mon RPCs: _mon_fut is a single slot, and concurrent ops
@@ -151,6 +268,9 @@ class RadosClient:
                 traceback.print_exc()
 
     async def _dispatch(self, conn, msg) -> None:
+        if isinstance(msg, MOSDBackoff):
+            self._handle_backoff(conn, msg)
+            return
         if isinstance(msg, MWatchNotify):
             # ack FIRST (delivery receipt — divergence from notify2, which
             # acks after processing): a slow callback must not look like a
@@ -189,6 +309,91 @@ class RadosClient:
             if fut and not fut.done():
                 fut.set_result(msg)
 
+    # -- MOSDBackoff handling (reference Objecter::_handle_backoff) ----------
+
+    def _handle_backoff(self, conn, msg: MOSDBackoff) -> None:
+        key = (msg.pool_id, msg.pg)
+        if msg.op == "block":
+            self.perf.inc("backoffs_received")
+            ent = self._backoffs.get(key)
+            if ent is not None:
+                if ent.get("id") == msg.id:
+                    return  # duplicate block for the same interval
+                # a NEW block (new interval/primary) displaces the old
+                # one: release ops parked on the displaced event — they
+                # re-enter the loop and park on the new block, instead
+                # of sleeping out the dead entry's full expiry
+                ent["event"].set()
+            duration = msg.duration if msg.duration > 0 \
+                else self.backoff_park_max
+            self._backoffs[key] = {
+                "event": asyncio.Event(),
+                "expiry": time.monotonic() + duration,
+                "epoch": msg.epoch,
+                "id": msg.id,
+                # who blocked us: a map change that moves the primary off
+                # this addr releases the block (the new primary has no
+                # backoff state for us)
+                "from": tuple(conn.peer) if conn is not None
+                and getattr(conn, "peer", None) else None,
+            }
+            # the op that triggered this block got DROPPED server-side:
+            # wake its reply wait so it parks instead of timing out
+            self._kick_pg(key)
+        else:
+            ent = self._backoffs.get(key)
+            if ent is not None and (not msg.id or ent.get("id") == msg.id):
+                self.perf.inc("backoffs_released")
+                self._release_backoff(key)
+
+    def _release_backoff(self, key: Tuple[int, int]) -> None:
+        ent = self._backoffs.pop(key, None)
+        if ent is not None:
+            ent["event"].set()
+
+    def _pg_primary(self, pool_id: int, pg: int) -> Optional[int]:
+        pool = self.osdmap.pools.get(pool_id) if self.osdmap else None
+        if pool is None or pg >= pool.pg_num:
+            return None
+        acting = self.osdmap.pg_to_acting(pool, pg)
+        return self.osdmap.primary_of(acting, seed=(pool_id << 20) | pg)
+
+    def _kick_pg(self, key: Tuple[int, int]) -> None:
+        """Wake in-flight ops targeting a just-blocked PG: their reply is
+        never coming (the OSD dropped the op), so the loop should park on
+        the backoff now, not after a full reply timeout."""
+        for rec in list(self._inflight.values()):
+            if (rec.op.pool_id, rec.pg) == key and rec.fut is not None \
+                    and not rec.fut.done():
+                rec.fut.set_exception(_OpKick())
+
+    def _kick_inflight(self) -> None:
+        """Map epoch advanced: release backoffs whose blocking primary is
+        no longer the PG's primary, and wake in-flight ops whose computed
+        target moved so they resend NOW (the Objecter's _scan_requests
+        resend-on-map-change, Objecter.cc:1142)."""
+        for key, ent in list(self._backoffs.items()):
+            p = self._pg_primary(*key)
+            if p is None:
+                continue  # PG unservable: keep parked, epoch fence cures
+            if ent.get("from") and tuple(self.osdmap.addr_of(p)) \
+                    != tuple(ent["from"]):
+                self._release_backoff(key)
+        for rec in list(self._inflight.values()):
+            if rec.fut is None or rec.fut.done() \
+                    or self.osdmap.epoch <= rec.epoch:
+                continue
+            pg, primary = self._calc_target(rec.op)
+            if pg != rec.pg or primary != rec.target:
+                rec.fut.set_exception(_OpKick())
+
+    def perf_dump(self) -> Dict[str, Dict]:
+        """Client-side `perf dump` role: the `objecter` set plus the
+        messenger's `wire` set (clients own no admin socket — tools,
+        benches, and embedding daemons read this)."""
+        return {"objecter": self.perf.dump(),
+                "wire": self.messenger.perf.dump()}
+
     @property
     def mon_addr(self) -> Tuple[str, int]:
         return self.mons.current
@@ -216,6 +421,7 @@ class RadosClient:
         protocol); otherwise a full map."""
         import pickle as _pickle
 
+        prev_epoch = self.osdmap.epoch if self.osdmap is not None else -1
         for _ in range(20):
             since = self.osdmap.epoch if self.osdmap is not None else 0
             reply = await self._mon_rpc(MGetMap(min_epoch=since))
@@ -233,6 +439,10 @@ class RadosClient:
                                   and self.osdmap.epoch >= min_epoch):
                 break
             await asyncio.sleep(0.1)
+        if self.osdmap is not None and self.osdmap.epoch > prev_epoch:
+            # resend-on-map-change: in-flight ops whose target moved
+            # resend now; backoffs from deposed primaries release
+            self._kick_inflight()
         if self._watches:
             self._kick_relinger()
         return self.osdmap
@@ -290,36 +500,122 @@ class RadosClient:
         await self._mon_rpc(MMarkDown(osd_id=osd_id))
         await self.refresh_map()
 
+    async def osd_set_flag(self, flag: str, on: bool = True) -> None:
+        """`ceph osd set/unset <flag>` role: toggle a cluster-wide op
+        gate ("pausewr", "pauserd", "full") in the OSDMap.  Clients
+        QUEUE matching ops while the flag is set (paused-map handling),
+        so unsetting it releases the queued work rather than retrying
+        failures."""
+        await self._mon_rpc(MOSDSetFlag(flag=flag, set=bool(on)))
+        await self.refresh_map()
+
     # -- data ops -------------------------------------------------------------
 
-    def _calc_target(self, op: MOSDOp) -> Optional[int]:
-        """object -> PG -> primary on the current map (reference
+    def _calc_target(self, op: MOSDOp) -> Tuple[Optional[int], Optional[int]]:
+        """object -> (PG, primary) on the current map (reference
         Objecter::_calc_target, Objecter.cc:2764)."""
         pool = self.osdmap.pools.get(op.pool_id)
         if pool is None:
-            return None
+            return None, None
         pg = self.osdmap.object_to_pg(pool, op.oid)
         acting = self.osdmap.pg_to_acting(pool, pg)
-        return self.osdmap.primary_of(acting, seed=(op.pool_id << 20) | pg)
+        return pg, self.osdmap.primary_of(acting,
+                                          seed=(op.pool_id << 20) | pg)
 
-    async def _op(self, op: MOSDOp, retries: int = 6) -> MOSDOpReply:
+    def _retry_pause(self, attempt: int) -> float:
+        """Retry pacing: capped exponential backoff with jitter —
+        min(base * 2^attempt, cap) scaled by a uniform [0.5, 1.5) draw,
+        so colliding clients decorrelate instead of re-colliding every
+        backoff period (the Objecter's retry discipline + thundering-herd
+        jitter)."""
+        return min(self.backoff_base * (2 ** attempt), self.backoff_cap) \
+            * (0.5 + random.random())
+
+    def _paused_for(self, op: MOSDOp) -> bool:
+        """Is this op gated by the map's pause flags? (reference
+        Objecter::target_should_be_paused)"""
+        flags = getattr(self.osdmap, "flags", None) or ()
+        if op.op in _WRITE_OPS:
+            return "pausewr" in flags or "full" in flags
+        return "pauserd" in flags
+
+    async def _wait_unpaused(self, rec: _OpRecord) -> None:
+        """Paused ops QUEUE, they do not fail: poll the mon for the map
+        that lifts the gate (the Objecter keeps paused ops queued and
+        resubmits on the flag-clearing map)."""
+        interval = 0.2
+        while time.monotonic() < rec.deadline:
+            await asyncio.sleep(interval)
+            interval = min(interval * 1.5, 1.0)
+            try:
+                await self.refresh_map()
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                continue
+            if not self._paused_for(rec.op):
+                return
+        # deadline reached: fall back to the loop, which raises
+
+    async def _park_backoff(self, key: Tuple[int, int],
+                            rec: _OpRecord) -> None:
+        """Park until the PG's backoff releases — or until its duration
+        expires / the op deadline nears (liveness when the unblock is
+        lost).  Wait seconds land in the backoff_wait_s longrunavg."""
+        ent = self._backoffs.get(key)
+        if ent is None:
+            return
+        now = time.monotonic()
+        if now >= ent["expiry"]:
+            self._release_backoff(key)  # expired: resend anyway
+            return
+        timeout = max(0.01, min(ent["expiry"] - now, rec.deadline - now))
+        with self.perf.time_avg("backoff_wait_s"):
+            try:
+                await asyncio.wait_for(ent["event"].wait(), timeout=timeout)
+            except asyncio.TimeoutError:
+                if self._backoffs.get(key) is ent:
+                    self._release_backoff(key)
+
+    async def _op(self, op: MOSDOp,
+                  retries: Optional[int] = None) -> MOSDOpReply:
         """Objecter-grade submit (reference op_submit/_calc_target/_send_op,
         Objecter.cc:2257,2764,3233): ONE reqid for the op's whole lifetime
-        (server dedupe = exactly-once), re-target on every map change, and
-        an epoch barrier on retryable errors — the error reply names the
-        OSD's epoch and we refresh to AT LEAST that before recomputing the
-        target, so a stale map cannot bounce the op between two OSDs that
-        each think the other is primary."""
+        (server dedupe = exactly-once) and a persistent in-flight record;
+        re-target on every map change (a refresh that moves the primary
+        wakes the reply wait), epoch barriers on retryable errors, pause
+        flags queue, MOSDBackoff parks, and capped-exponential-jitter
+        pacing between resends.  Transient trouble NEVER fails the op
+        before the deadline (client_op_deadline); ``retries`` caps
+        attempts for callers that want the old bounded behavior."""
         if self.osdmap is None:
             await self.refresh_map()
-        last_error = "no attempt"
-        last_code = 0
         # ONE reqid per logical op: resends carry the same id so the PG
         # log's dup detection can recognize them (reference osd_reqid_t)
         op.reqid = uuid.uuid4().hex
+        rec = _OpRecord(op, time.monotonic() + self.op_deadline)
+        self.perf.inc("op")
+        self._inflight[op.reqid] = rec
+        self.perf.set("inflight", len(self._inflight))
+        try:
+            return await self._op_submit(op, rec, retries)
+        finally:
+            self._inflight.pop(op.reqid, None)
+            self.perf.set("inflight", len(self._inflight))
+
+    async def _op_submit(self, op: MOSDOp, rec: _OpRecord,
+                         retries: Optional[int]) -> MOSDOpReply:
+        loop = asyncio.get_running_loop()
+        last_error = "no attempt"
+        last_code = 0
         fence = 0  # minimum epoch the next target may be computed on
         refresh_next = False  # one refresh owed (transport blip)
-        for attempt in range(retries):
+        attempt = 0  # attempts CONSUMED (sends + failed refreshes)
+        sends = 0
+        # the deadline governs from the moment ANY work happened (a send
+        # OR a consumed attempt); the virgin first iteration is always
+        # admitted so a deadline in the past still tries once
+        while (retries is None or attempt < retries) \
+                and (time.monotonic() < rec.deadline
+                     or (attempt == 0 and sends == 0)):
             if fence > self.osdmap.epoch or (attempt and fence == 0) \
                     or refresh_next:
                 refresh_next = False
@@ -327,32 +623,62 @@ class RadosClient:
                     await self.refresh_map(min_epoch=fence)
                 except (ConnectionError, OSError, asyncio.TimeoutError):
                     last_error = "map refresh failed"
-                    await asyncio.sleep(0.3 * (attempt + 1))
+                    await asyncio.sleep(self._retry_pause(attempt))
+                    attempt += 1
                     continue
+            if self._paused_for(op):
+                # paused map (pausewr/pauserd/full): queue, don't fail —
+                # and consume no attempt (the cluster asked us to wait)
+                if not rec.paused_counted:
+                    rec.paused_counted = True
+                    self.perf.inc("paused_ops")
+                last_error = "osdmap paused"
+                await self._wait_unpaused(rec)
+                if self._paused_for(op):
+                    break  # deadline ran out still paused
+                continue
             pool = self.osdmap.pools.get(op.pool_id)
             if pool is None:
                 # a lagging mon may have served us a pre-creation map:
                 # refresh-and-retry (Objecter catches up across epochs)
-                if attempt == retries - 1:
-                    raise RadosError(f"pool {op.pool_id} does not exist",
-                                     code=-errno.ENOENT)
                 last_error = (
                     f"pool {op.pool_id} not in map epoch {self.osdmap.epoch}")
+                last_code = -errno.ENOENT
                 fence = self.osdmap.epoch + 1
-                await asyncio.sleep(0.2 * (attempt + 1))
+                await asyncio.sleep(self._retry_pause(attempt))
+                attempt += 1
                 continue
-            primary = self._calc_target(op)
+            pg, primary = self._calc_target(op)
             if primary is None:
                 last_error = "no primary (all acting osds down)"
+                last_code = 0
                 fence = self.osdmap.epoch + 1
-                await asyncio.sleep(0.3 * (attempt + 1))
+                await asyncio.sleep(self._retry_pause(attempt))
+                attempt += 1
                 continue
+            rec.pg = pg
+            if (op.pool_id, pg) in self._backoffs:
+                # the PG told us to hold off: park until release/expiry,
+                # then re-target (no attempt consumed — server-directed)
+                last_error = f"backoff on pg {op.pool_id}.{pg}"
+                await self._park_backoff((op.pool_id, pg), rec)
+                if time.monotonic() >= rec.deadline:
+                    break
+                continue
+            rec.target = primary
+            rec.epoch = self.osdmap.epoch
             op.epoch = self.osdmap.epoch
-            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            fut: asyncio.Future = loop.create_future()
+            rec.fut = fut
             self._replies[op.reqid] = fut
             try:
+                if sends:
+                    self.perf.inc("resends")
+                sends += 1
                 await self.messenger.send(self.osdmap.addr_of(primary), op)
-                reply = await asyncio.wait_for(fut, timeout=self.op_timeout)
+                timeout = min(float(self.op_timeout),
+                              max(0.05, rec.deadline - time.monotonic()))
+                reply = await asyncio.wait_for(fut, timeout=timeout)
                 if reply.ok:
                     return reply
                 last_error = reply.error
@@ -372,24 +698,43 @@ class RadosClient:
                     # placement moved / PG degraded: both are cured by a
                     # newer map — fence PAST our own epoch, growing window
                     # while detection + recovery move seats.  A server-
-                    # provided backoff (MOSDBackoff role) extends the
-                    # pause: the PG told us how long it wants.
+                    # provided backoff hint extends the pause: the PG told
+                    # us how long it wants.
                     fence = max(fence, self.osdmap.epoch + 1)
                     pause = max(getattr(reply, "backoff", 0.0),
-                                min(0.25 * attempt, 1.0) if attempt else 0.0)
+                                self._retry_pause(attempt) if attempt
+                                else 0.0)
                     if pause:
                         await asyncio.sleep(pause)
+                    attempt += 1
                     continue
                 # -EBUSY and anything unclassified: prompt plain retry
-                await asyncio.sleep(0.2 * (attempt + 1))
+                await asyncio.sleep(self._retry_pause(attempt))
+                attempt += 1
+            except _OpKick:
+                # the map moved our target, or a backoff landed for our
+                # PG: re-enter the loop NOW (re-target / park) — no
+                # attempt consumed, no pause (the kicker knows better)
+                self.perf.inc("map_kicks")
             except PermissionError:
                 # expired/rotated-away ticket: fetch a fresh one and retry
                 last_error = "ticket rejected"
                 try:
                     await self._fetch_ticket()
                 except Exception:
-                    await asyncio.sleep(0.2 * (attempt + 1))
-            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                    await asyncio.sleep(self._retry_pause(attempt))
+                attempt += 1
+            except asyncio.TimeoutError:
+                # per-op reply timeout: the target may be wedged or the
+                # reply lost — refresh to the CURRENT map and resend
+                # (dedupe-safe); only the deadline fails the op
+                self.perf.inc("timeouts")
+                last_error = "op timed out"
+                last_code = 0
+                refresh_next = True
+                await asyncio.sleep(self._retry_pause(attempt))
+                attempt += 1
+            except (ConnectionError, OSError) as e:
                 last_error = f"{type(e).__name__}: {e}"
                 last_code = 0  # transport failure: no typed OSD answer
                 # the target may have died — but a transport blip has NO
@@ -399,8 +744,15 @@ class RadosClient:
                 # resend is dedupe-safe; if the OSD really died, failure
                 # detection bumps the epoch and re-targets us.
                 refresh_next = True
-                await asyncio.sleep(0.2 * (attempt + 1))
+                await asyncio.sleep(self._retry_pause(attempt))
+                attempt += 1
             finally:
+                # a kick may have raced a send() error into the same
+                # iteration: mark any unawaited exception retrieved so
+                # the abandoned future never logs at GC
+                if fut.done() and not fut.cancelled():
+                    fut.exception()
+                rec.fut = None
                 self._replies.pop(op.reqid, None)
         raise RadosError(f"op {op.op} {op.oid} failed: {last_error}",
                          code=last_code)

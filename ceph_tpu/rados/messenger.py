@@ -22,9 +22,13 @@ reuse_connection) — with receiver-side seq dedupe making dispatch
 exactly-once in both directions, the OSD<->OSD guarantee PG consistency is
 built on.  Lossy connections just fail and are replaced wholesale.
 
-A config-driven fault injector (ms_inject_socket_failures, ms_inject_delay_max;
-reference src/common/options/global.yaml.in:1240) severs connections to
-exercise those paths without code changes, and a dispatch throttle
+A config-driven fault injector (reference
+src/common/options/global.yaml.in:1240) exercises the failure paths
+without code changes: ms_inject_socket_failures severs connections,
+ms_inject_delay_max delays sends, and ms_inject_dup_frames delivers
+client-op-plane messages twice (two frames, two seqs — duplicates the
+receiver's seq dedupe CANNOT filter, proving the application layer's
+reqid/pop-once dedup instead).  A dispatch throttle
 (ms_dispatch_throttle_bytes) applies receive-side backpressure.
 
 Wire formats, by plane (see README "Wire-format threat model"):
@@ -1287,6 +1291,19 @@ class Connection:
         delay = _cget(conf, "ms_inject_delay_max", 0)
         if delay:
             await asyncio.sleep(random.uniform(0, delay))
+        # ms_inject_dup_frames: deliver this message TWICE (two frames,
+        # two seqs — a genuine at-least-once delivery the receiver's seq
+        # dedupe cannot filter), exercising the APPLICATION layer's
+        # duplicate absorption.  Scoped to the client-op plane, which is
+        # the layer contracted to absorb duplicates: MOSDOp dups dedupe
+        # against the PG log's reqid set, MOSDOpReply dups against the
+        # client's pop-once reply futures.  Other planes (sub-write
+        # replies, peering gathers) count messages and are entitled to
+        # the session's exactly-once delivery.
+        dup_inj = _cget(conf, "ms_inject_dup_frames", 0)
+        duplicate = (bool(dup_inj)
+                     and type(msg).__name__ in ("MOSDOp", "MOSDOpReply")
+                     and random.randrange(dup_inj) == 0)
         self.out_seq += 1
         seq = self.out_seq
         t_frame = time.monotonic()
@@ -1307,6 +1324,7 @@ class Connection:
             data = self._frame_segments(msg.TYPE_ID, msg.VERSION, pickled,
                                         blob, seq, flags, blob_crc=pre_crc)
         else:
+            pre_crc = None
             data = self._frame(msg.TYPE_ID, msg.VERSION, pickled, seq,
                                flags)
         self.messenger._note_tx(type(msg).__name__,
@@ -1334,6 +1352,25 @@ class Connection:
                 await self.close()
         else:
             await self._enqueue(data)
+        if duplicate and not self.closed:
+            # the duplicate frame is best-effort: the knob exists to
+            # exercise dedup, and a transport error here already has the
+            # original frame's failure handling covering the message
+            self.out_seq += 1
+            dseq = self.out_seq
+            if blob is not None:
+                ddata = self._frame_segments(
+                    msg.TYPE_ID, msg.VERSION, pickled, blob, dseq, flags,
+                    blob_crc=pre_crc)
+            else:
+                ddata = self._frame(msg.TYPE_ID, msg.VERSION, pickled,
+                                    dseq, flags)
+            if self.policy.replay:
+                self.unacked.append((dseq, ddata))
+            try:
+                await self._enqueue(ddata)
+            except (ConnectionError, OSError):
+                pass
 
     async def send_ack(self, seq: int) -> None:
         """Compat shim: queue a cumulative ack (piggybacked on the next

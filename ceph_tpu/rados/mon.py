@@ -59,6 +59,7 @@ from ceph_tpu.rados.types import (
     MOSDFailure,
     MOSDPGTemp,
     MOsdBoot,
+    MOSDSetFlag,
     MPoolSet,
     MSetUpmap,
     MSnapOp,
@@ -552,7 +553,7 @@ class Monitor:
 
     WRITE_TYPES = (MOsdBoot, MCreatePool, MDeletePool, MMarkDown,
                    MConfigSet, MOSDFailure,
-                   MOSDPGTemp, MSetUpmap, MPoolSet, MSnapOp)
+                   MOSDPGTemp, MSetUpmap, MPoolSet, MSnapOp, MOSDSetFlag)
 
     @staticmethod
     def _conn_is_daemon(conn) -> bool:
@@ -815,6 +816,22 @@ class Monitor:
                 self.osdmap.epoch += 1
                 await self._commit_state()
             return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+        if isinstance(msg, MOSDSetFlag):
+            # `ceph osd set/unset <flag>` (OSDMonitor prepare_set_flag):
+            # cluster-wide op gates clients honor by QUEUEING matching
+            # ops (pausewr/pauserd/full) until the flag clears
+            flags = set(getattr(self.osdmap, "flags", []) or [])
+            changed = (msg.flag not in flags) if msg.set \
+                else (msg.flag in flags)
+            if msg.set:
+                flags.add(msg.flag)
+            else:
+                flags.discard(msg.flag)
+            if changed:
+                self.osdmap.flags = sorted(flags)
+                self.osdmap.epoch += 1
+                await self._commit_state()
+            return MMapReply(osdmap=self.osdmap, tid=msg.tid)
         if isinstance(msg, MSetUpmap):
             # balancer-installed persistent override (pg-upmap role)
             key = (msg.pool_id, msg.pg)
@@ -1004,7 +1021,7 @@ class Monitor:
         if isinstance(msg, MConfigSet):
             return MConfigReply(tid=tid, ok=False, error=error)
         if isinstance(msg, (MMarkDown, MGetMap, MPing, MOSDFailure,
-                            MOSDPGTemp, MSetUpmap, MPoolSet)):
+                            MOSDPGTemp, MSetUpmap, MPoolSet, MOSDSetFlag)):
             return MMapReply(osdmap=self.osdmap, tid=tid)
         if isinstance(msg, MOsdBoot):
             return MBootReply(osd_id=-1, osdmap=self.osdmap, tid=tid)
@@ -1105,6 +1122,10 @@ class Monitor:
             profile=profile,
             rule=rule,
             stripe_width=stripe_width,
+            # the epoch this pool first APPEARS in: an OSD whose map
+            # jumps past it knows the pool may already carry history
+            # (osd _on_map catch-up peering)
+            created_epoch=self.osdmap.epoch + 1,
         )
         self.osdmap.epoch += 1
         return MCreatePoolReply(ok=True, pool_id=pool_id)

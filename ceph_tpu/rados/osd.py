@@ -110,6 +110,7 @@ from ceph_tpu.rados.types import (
     MOSDFailure,
     MOSDOp,
     MOSDOpReply,
+    MOSDBackoff,
     MOSDPGTemp,
     MOSDPing,
     MOsdBoot,
@@ -280,6 +281,11 @@ class OSD:
             .add_u64_counter("op_dequeued", "ops drained")
             .add_time_avg("op_queue_lat", "op service time")
             .add_u64_counter("heartbeat_failures", "peer failures reported")
+            .add_u64_counter("backoffs_sent",
+                             "MOSDBackoff blocks sent (op dropped, client "
+                             "parks until release)")
+            .add_u64_counter("backoffs_released",
+                             "MOSDBackoff unblocks sent")
             .add_u64_counter("meta_repl_dropped",
                              "metadata replications dropped on queue "
                              "overflow (replica stale until scrub)")
@@ -351,6 +357,11 @@ class OSD:
         self._last_scrub: Dict[Tuple[int, int], float] = {}
         self._last_scrub_scan = 0.0
         self._scrub_task: Optional[asyncio.Task] = None
+        # active MOSDBackoff blocks this primary holds on clients:
+        # (pool, pg) -> {"id": block id, "conns": {id(conn): conn}} —
+        # released (unblock sent to every registered conn) when the PG's
+        # peering pass reaches Active, or when we stop being primary
+        self._backoffs_sent: Dict[Tuple[int, int], Dict] = {}
         # the process-wide stripe-batching queue (None = batching off):
         # every EC encode/decode this daemon issues is submitted here so
         # CONCURRENT ops coalesce into one device dispatch (SURVEY.md
@@ -358,6 +369,18 @@ class OSD:
         # at process scope)
         self._ec_queue = (shared_batching_queue()
                           if self.conf.get("osd_ec_batching", True) else None)
+        if self._ec_queue is not None:
+            # device-dispatch watchdog knobs (BatchingQueue circuit
+            # breaker): a configured timeout/injected delay applies to
+            # the PROCESS queue — last writer wins, matching the queue's
+            # process-shared nature
+            t = float(self.conf.get("osd_ec_dispatch_timeout", 0) or 0)
+            if t:
+                self._ec_queue.dispatch_timeout = t
+            d = float(self.conf.get(
+                "osd_debug_inject_dispatch_delay", 0) or 0)
+            if d:
+                self._ec_queue.inject_dispatch_delay = d
         # bit-planar HBM residency (VERDICT r03 #1): full-object EC
         # writes leave their shard rows planar-resident on the device, so
         # later decodes, repair re-encodes, and recovery packs are
@@ -906,6 +929,23 @@ class OSD:
             for pool in osdmap.pools.values():
                 old_pool = old.pools.get(pool.pool_id)
                 if old_pool is None:
+                    # the pool APPEARED between our old and new maps.  If
+                    # it appeared in the very epoch it was created, it is
+                    # brand new (no writes can predate us).  If our map
+                    # JUMPED past its creation (created_epoch < new
+                    # epoch, or an unknown pre-field 0), its PGs may
+                    # carry history our logs never saw: kick peering and
+                    # mark the interval "unknown prior" (empty prior
+                    # acting) so the mutation backoff gate holds writes
+                    # until the authoritative log is merged.
+                    created = getattr(pool, "created_epoch", 0)
+                    if 0 < created and created > old.epoch \
+                            and created == osdmap.epoch:
+                        continue  # appeared the epoch it was created
+                    for pg in range(pool.pg_num):
+                        changed_pgs.append((pool, pg))
+                        self._prior_acting.setdefault(
+                            (pool.pool_id, pg), [])
                     continue
                 if old_pool.pg_num != pool.pg_num:
                     # PG split/merge: every object REHASHES, so any OSD
@@ -952,6 +992,15 @@ class OSD:
             # first map: every PG we lead needs an initial peering pass
             changed_pgs = [(pool, pg) for pool in osdmap.pools.values()
                            for pg in range(pool.pg_num)]
+            # a pool that predates this map (or an unknown pre-field 0)
+            # may carry history our logs never saw — a freshly-booted
+            # primary must merge the authoritative log before serving
+            # mutations (empty prior = "unknown prior interval", the
+            # backoff gate's failover condition)
+            for pool, pg in changed_pgs:
+                created = getattr(pool, "created_epoch", 0)
+                if not created or created < osdmap.epoch:
+                    self._prior_acting.setdefault((pool.pool_id, pg), [])
         self.osdmap = osdmap
         # primaryship may have moved: cached decodes can silently go stale
         # across an interval we didn't serve (ExtentCache is per-interval)
@@ -982,6 +1031,15 @@ class OSD:
             return self._primary(pool, key[1], acting) == grantee
 
         self._remote_reserver.revoke_stale(_grant_still_valid)
+        # release client backoffs for PGs we no longer lead: the new
+        # primary has no state for our blocks, and the client's own
+        # primary-change check drops them too — belt and braces
+        for key in list(self._backoffs_sent):
+            pool = osdmap.pools.get(key[0])
+            if pool is None or key[1] >= pool.pg_num or self._primary(
+                    pool, key[1],
+                    osdmap.pg_to_acting(pool, key[1])) != self.osd_id:
+                self._release_backoffs(key)
         # event-driven recovery (reference AdvMap/ActMap): kick the peering
         # statechart for exactly the PGs whose mapping changed — repair
         # traffic for one failed OSD touches only that OSD's PGs
@@ -1074,9 +1132,11 @@ class OSD:
             epoch = m.interval_epoch
             pool = self.osdmap.pools.get(pool_id)
             if pool is None or self._stopped:
+                self._release_backoffs((pool_id, pg))
                 return
             acting = self.osdmap.pg_to_acting(pool, pg)
             if self._primary(pool, pg, acting) != self.osd_id:
+                self._release_backoffs((pool_id, pg))
                 return  # not ours this interval
             try:
                 done, _pushed = await self._peer_and_recover_pg(
@@ -1087,6 +1147,7 @@ class OSD:
                 self.perf.inc("recovery_errors")
                 self.ctx.log.error(
                     "osd", f"peering pg {pool_id}.{pg} codec error: {e}")
+                self._release_backoffs((pool_id, pg))
                 return
             except Exception as e:
                 self.perf.inc("recovery_errors")
@@ -1094,6 +1155,11 @@ class OSD:
                     "osd",
                     f"peering pg {pool_id}.{pg}: {type(e).__name__}: {e}")
                 done = False
+            # the pass merged the authoritative log (Active or beyond):
+            # clients parked on this PG may resend now — their reqids
+            # dedupe against the merged log
+            if m.state not in (GET_INFO, GET_LOG, GET_MISSING):
+                self._release_backoffs((pool_id, pg))
             if done and not m.is_stale(epoch):
                 return
             if m.is_stale(epoch):
@@ -1604,6 +1670,114 @@ class OSD:
             self.perf.tinc("op_lat", time.monotonic() - t0)
             tracked.finish()
 
+    # ops the backoff gate may drop-and-block (client data plane; admin
+    # fan-outs like repair/deep-scrub/pgls answer normally)
+    _BACKOFF_OPS = frozenset(("write", "read", "delete", "multi", "stat",
+                              "call"))
+    # mutations gated by the peering-window check (reads can serve from
+    # any interval; mutations must not race the authoritative log merge).
+    # "call" belongs here: class-call results dedupe through the
+    # primary-LOCAL _call_results cache, so a failover resend racing the
+    # prior primary is exactly the non-idempotent double-execute window.
+    _BACKOFF_MUTATIONS = frozenset(("write", "delete", "multi", "call"))
+
+    def _op_backoff_reason(self, op: MOSDOp) -> Optional[Tuple[Tuple[int, int], str]]:
+        """((pool, pg), reason) when this op must be BLOCKED via
+        MOSDBackoff instead of served (reference PrimaryLogPG
+        maybe_handle_backoff / the waiting_for_peered queue):
+
+        - "queue": the sharded dispatch queue is saturated past
+          osd_backoff_queue_depth — shed load with a short timed block
+          instead of buffering unboundedly (0 disables).
+        - "peering": a mutation while the PG's peering pass has not yet
+          merged the authoritative log AND the window is actually unsafe
+          — the interval moved primaryship onto us (a resend racing the
+          prior primary's in-flight sub-writes could double-execute its
+          reqid) or the PG is below min_size (the write would only burn
+          EAGAIN retries).  Healthy same-primary intervals (pool create,
+          rebalance without failover) serve ops as before.
+        """
+        if self.osdmap is None or op.op not in self._BACKOFF_OPS:
+            return None
+        pool = self.osdmap.pools.get(op.pool_id)
+        if pool is None or not op.oid:
+            return None
+        pg = self.osdmap.object_to_pg(pool, op.oid)
+        key = (op.pool_id, pg)
+        qmax = int(self.conf.get("osd_backoff_queue_depth", 0) or 0)
+        if qmax and self.op_queue.depth() > qmax:
+            return key, "queue"
+        if op.op not in self._BACKOFF_MUTATIONS:
+            return None
+        m = self._pg_machines.get(key)
+        if m is None or m.task is None or m.task.done() \
+                or m.state not in (GET_INFO, GET_LOG, GET_MISSING):
+            return None
+        acting = self.osdmap.pg_to_acting(pool, pg)
+        live = [a for a in acting if a != CRUSH_ITEM_NONE]
+        prior = self._prior_acting.get(key)
+        failover = prior is not None and self.osdmap.primary_of(
+            prior, seed=(op.pool_id << 20) | pg) != self.osd_id
+        if len(live) < pool.min_size or failover:
+            return key, "peering"
+        return None
+
+    async def _maybe_backoff(self, conn, op: MOSDOp) -> bool:
+        """Send an MOSDBackoff block and DROP the op when the PG cannot
+        serve it right now; returns True when the op was dropped.  The
+        client parks everything for the PG until the unblock (peering
+        blocks register the conn for release) or until ``duration``
+        expires (queue-shed blocks, and the liveness bound for a dying
+        primary)."""
+        got = self._op_backoff_reason(op)
+        if got is None:
+            return False
+        key, reason = got
+        ent = self._backoffs_sent.get(key) if reason == "peering" else None
+        bid = ent["id"] if ent is not None else uuid.uuid4().hex
+        duration = (float(self.conf.get("osd_backoff_secs", 0.5) or 0.5)
+                    if reason == "queue"
+                    else float(self.conf.get("osd_backoff_max", 3.0) or 3.0))
+        self.perf.inc("backoffs_sent")
+        msg = MOSDBackoff(op="block", pool_id=key[0], pg=key[1], id=bid,
+                          epoch=self.osdmap.epoch, duration=duration)
+        try:
+            await conn.send(msg)
+        except TRANSPORT_ERRORS:
+            return True  # op dropped either way; client times out + resends
+        if reason == "peering":
+            ent = self._backoffs_sent.setdefault(
+                key, {"id": bid, "conns": {}})
+            ent["conns"][id(conn)] = conn
+        return True
+
+    def _release_backoffs(self, key: Tuple[int, int]) -> None:
+        """Unblock every client parked on this PG (peering reached
+        Active / primaryship moved off us).  Sends ride their own task —
+        callers sit on the peering/map path and must not serialize on
+        client sockets."""
+        ent = self._backoffs_sent.pop(key, None)
+        if ent is None or not ent["conns"]:
+            return
+        self.perf.inc("backoffs_released", len(ent["conns"]))
+        msg = MOSDBackoff(op="unblock", pool_id=key[0], pg=key[1],
+                          id=ent["id"],
+                          epoch=self.osdmap.epoch if self.osdmap else 0)
+
+        async def _send() -> None:
+            for c in ent["conns"].values():
+                try:
+                    await c.send(msg)
+                except TRANSPORT_ERRORS:
+                    pass  # client's park duration is the liveness bound
+
+        try:
+            t = asyncio.get_running_loop().create_task(_send())
+        except RuntimeError:
+            return  # no loop (teardown): clients release on expiry
+        self.messenger._tasks.add(t)
+        t.add_done_callback(self.messenger._tasks.discard)
+
     async def _handle_client_op_inner(self, conn, op: MOSDOp,
                                       tracked) -> None:
         tracked.mark_event("reached_pg")
@@ -1614,6 +1788,9 @@ class OSD:
                 # deciding primaryship on the stale one could execute an
                 # op we no longer own.  Catch up first.
                 await self._fetch_full_map()
+            if await self._maybe_backoff(conn, op):
+                tracked.mark_event("backoff")
+                return  # dropped: the client parks and resends on release
             if op.op == "write":
                 reply = await self._do_write(op)
             elif op.op == "read":

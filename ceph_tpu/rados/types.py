@@ -158,6 +158,11 @@ class PoolInfo:
     profile: Dict[str, str] = field(default_factory=dict)
     rule: str = ""
     stripe_width: int = 0
+    # epoch the pool first appeared in the map (0 = unknown/pre-field):
+    # an OSD whose map jumps from before this epoch to after it missed
+    # the pool's whole lifetime so far — its PGs may carry history the
+    # local logs never saw (the _on_map catch-up peering trigger)
+    created_epoch: int = 0
     # self-managed snapshot state (reference pg_pool_t snap_seq /
     # removed_snaps, src/osd/osd_types.h): the mon allocates monotonically
     # increasing snap ids; removed ids are recorded (as coalesced
@@ -207,6 +212,12 @@ class OSDMap:
     osds: Dict[int, OsdInfo] = field(default_factory=dict)
     pools: Dict[int, PoolInfo] = field(default_factory=dict)
     crush: CrushMap = field(default_factory=lambda: CrushMap.flat([]))
+    # cluster-wide op gates (reference OSDMap flags CEPH_OSDMAP_PAUSEWR/
+    # PAUSERD/FULL): clients QUEUE matching ops while a flag is set
+    # instead of failing them (the Objecter's pauserd/pausewr handling).
+    # Read with getattr(map, "flags", []) — maps pickled before this
+    # field existed have no attribute.
+    flags: List[str] = field(default_factory=list)
     pg_temp: Dict[Tuple[int, int], List[int]] = field(default_factory=dict)
     # persistent placement overrides installed by the balancer (reference
     # pg_upmap_items): applied over the crush result, NOT auto-cleared by
@@ -311,6 +322,9 @@ class OSDMap:
             self.primary_affinity[osd_id] = aff
         if inc.crush is not None:
             self.crush = inc.crush
+        new_flags = getattr(inc, "new_flags", None)
+        if new_flags is not None:
+            self.flags = list(new_flags)
         self.epoch = inc.epoch
         return True
 
@@ -331,6 +345,8 @@ class OSDMapIncremental:
     new_pg_upmap: Dict[Tuple[int, int], List[int]] = field(default_factory=dict)
     new_primary_affinity: Dict[int, float] = field(default_factory=dict)
     crush: Optional[CrushMap] = None
+    # None = flags unchanged; a list (possibly empty) replaces them
+    new_flags: Optional[List[str]] = None
 
     @classmethod
     def diff(cls, old: "OSDMap", new: "OSDMap") -> "OSDMapIncremental":
@@ -362,6 +378,9 @@ class OSDMapIncremental:
         for key in old.pg_upmap:
             if key not in new.pg_upmap:
                 inc.new_pg_upmap[key] = []
+        if list(getattr(old, "flags", []) or []) \
+                != list(getattr(new, "flags", []) or []):
+            inc.new_flags = list(getattr(new, "flags", []) or [])
         for osd_id, aff in new.primary_affinity.items():
             if old.primary_affinity.get(osd_id) != aff:
                 inc.new_primary_affinity[osd_id] = aff
@@ -569,6 +588,18 @@ class MSetUpmap:
     tid: str = ""
 
 
+@message(66)
+class MOSDSetFlag:
+    """`ceph osd set/unset <flag>` role (reference OSDMonitor
+    prepare_set_flag): toggle a cluster-wide op gate — "pausewr",
+    "pauserd", "full" — in the OSDMap.  Clients QUEUE matching ops while
+    a flag is set (Objecter pause handling) instead of failing them."""
+
+    flag: str = ""
+    set: bool = True
+    tid: str = ""
+
+
 @message(61)
 class MPoolSet:
     """Adjust a pool parameter (reference `ceph osd pool set`); the
@@ -697,6 +728,32 @@ class MOSDOpReply:
     # degraded) the client fetches AT LEAST this epoch before
     # re-targeting (the Objecter's epoch barrier, Objecter.cc:2764)
     map_epoch: int = 0
+
+
+@message(65)
+class MOSDBackoff:
+    """OSD -> client flow control for one PG (reference
+    src/messages/MOSDBackoff.h, BACKOFF_OP_BLOCK/BACKOFF_OP_UNBLOCK): a
+    PG that cannot serve an op right now (mid-peering below min_size, or
+    a saturated dispatch queue) BLOCKS the client instead of eating a
+    blind retry storm — the op is dropped server-side and the client
+    parks everything targeting that PG until the matching unblock (or
+    until ``duration`` expires, the liveness bound for a primary that
+    dies holding blocks).  ``id`` names the block so a late unblock of a
+    previous interval cannot release a newer block; ``epoch`` lets the
+    client drop the backoff when a map change moves the primary."""
+
+    op: str = "block"  # block | unblock
+    pool_id: int = 0
+    pg: int = 0
+    id: str = ""
+    epoch: int = 0
+    # client-side park ceiling in seconds (0 = client default): the
+    # resend-anyway bound when the unblock is lost
+    duration: float = 0.0
+
+    FIXED_FIELDS = [("op", "s"), ("pool_id", "q"), ("pg", "q"),
+                    ("id", "s"), ("epoch", "q"), ("duration", "d")]
 
 
 # Primary OSD <-> shard OSDs (ECSubWrite/ECSubRead equivalents,

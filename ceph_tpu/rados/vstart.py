@@ -33,6 +33,7 @@ class Cluster:
         # real sockets are the point of the test
         wire_keys = ("ms_auth_secret", "auth_cephx", "ms_secure_mode",
                      "ms_inject_socket_failures", "ms_inject_delay_max",
+                     "ms_inject_dup_frames",
                      "ms_compress_min_size", "ms_dispatch_throttle_bytes")
         if "ms_local_fastpath" not in self.conf \
                 and not any(self.conf.get(k) for k in wire_keys):

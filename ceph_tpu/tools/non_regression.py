@@ -39,6 +39,12 @@ def parse_args(argv=None):
     p.add_argument("--prev", default="", help="previous round's BENCH json")
     p.add_argument("--floor", type=float, default=0.8,
                    help="warn when current < floor * previous")
+    # chaos smoke (CI): short injected-failure put/get loop against an
+    # in-process cluster; exit nonzero on ANY acked-op failure
+    p.add_argument("--chaos", action="store_true")
+    p.add_argument("--chaos-seconds", type=float, default=6.0,
+                   help="length of the chaos put/get loop")
+    p.add_argument("--chaos-osds", type=int, default=4)
     return p.parse_args(argv)
 
 
@@ -168,8 +174,76 @@ def run_wire_floor(args) -> int:
     return 0  # warn-only by design
 
 
+def run_chaos(args) -> int:
+    """Chaos smoke mode (CI): hammer put/get against an in-process
+    cluster with socket-failure + duplicate-frame injection for a few
+    seconds; ANY acked-op failure — a put that raises despite the client
+    resilience layer, or an acked write that does not read back
+    byte-identical — exits nonzero.  The acceptance bar of the op-
+    resilience layer (resend-on-map-change, MOSDBackoff, reqid dedup),
+    runnable as one command:
+
+        python -m ceph_tpu.tools.non_regression --chaos
+    """
+    import asyncio
+    import os as _os
+
+    from ceph_tpu.rados.vstart import Cluster
+
+    async def go() -> int:
+        conf = {"osd_auto_repair": True, "osd_repair_delay": 0.2,
+                "osd_heartbeat_interval": 0.2,
+                "mon_osd_report_grace": 1.0,
+                "ms_inject_socket_failures": 80,
+                "ms_inject_dup_frames": 20}
+        cluster = Cluster(n_osds=max(3, args.chaos_osds), conf=conf)
+        await cluster.start()
+        failures = []
+        try:
+            c = await cluster.client()
+            pool = await c.create_pool("chaos", profile={
+                "plugin": "jerasure", "technique": "reed_sol_van",
+                "k": "2", "m": "1"})
+            acked = {}
+            import time as _time
+
+            deadline = _time.monotonic() + args.chaos_seconds
+            i = 0
+            while _time.monotonic() < deadline:
+                oid = f"c{i % 16}"
+                blob = _os.urandom(3000 + (i % 512))
+                try:
+                    await c.put(pool, oid, blob)
+                    acked[oid] = blob
+                except Exception as e:
+                    failures.append(f"acked-op failure: put {oid}: {e}")
+                if acked and i % 3 == 0:
+                    roid = sorted(acked)[i % len(acked)]
+                    try:
+                        got = await c.get(pool, roid)
+                        if got != acked[roid]:
+                            failures.append(
+                                f"readback mismatch on {roid}")
+                    except Exception as e:
+                        failures.append(f"read {roid} failed: {e}")
+                i += 1
+            print(f"chaos: {i} ops, {len(acked)} objects, "
+                  f"{len(failures)} failures; objecter: "
+                  f"{ {k: v for k, v in c.perf.dump().items() if isinstance(v, int)} }")
+            await c.stop()
+        finally:
+            await cluster.stop()
+        for f in failures:
+            print(f"FAIL {f}", file=sys.stderr)
+        return 1 if failures else 0
+
+    return asyncio.run(go())
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.chaos:
+        return run_chaos(args)
     if args.wire_floor:
         return run_wire_floor(args)
     if not args.create and not args.check:

@@ -96,6 +96,8 @@ def _sample_messages() -> List[Any]:
                            tid="t10", reply_to=("h", 4)),
         t.MOSDFailure(target_osd=4, from_osd=1, failed_for=12.5,
                       tid="t11"),
+        t.MOSDBackoff(op="unblock", pool_id=2, pg=9, id="bk-1", epoch=33,
+                      duration=1.5),
     ]
 
 

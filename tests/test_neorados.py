@@ -301,7 +301,7 @@ class TestWriteOp:
                             ops=[("append", {"data": b"X"})],
                             reqid="fixed-reqid-1",
                             epoch=client.osdmap.epoch)
-                primary = client._calc_target(op)
+                _pg, primary = client._calc_target(op)
 
                 async def send_same_reqid():
                     # _op_direct would mint a fresh reqid; a true resend

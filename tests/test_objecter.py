@@ -95,6 +95,9 @@ class TestExactlyOnce:
                 assert any(n == 1 for n in counts), \
                     "the write never reached a surviving log"
                 assert await c.get(pool, "obj") == data
+                # the objecter counters recorded the recovery: the op
+                # re-sent at least once (map kick or timeout driven)
+                assert c.perf.get("resends") >= 1, c.perf.dump()
             finally:
                 await cluster.stop()
 
