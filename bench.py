@@ -826,6 +826,32 @@ def main() -> int:
     except Exception:
         pass
 
+    # CACHE-TIER hot-read arm (scrubbed CPU child with the planar store
+    # forced on): resident-hit read MB/s vs the cold decode path on the
+    # same run window + the aggregated `tier` perf snapshot
+    tier_hot_mbps = 0.0
+    tier_cold_mbps = 0.0
+    tier_ratio = 0.0
+    tier_perf: dict = {}
+    try:
+        import subprocess
+
+        from ceph_tpu.utils.jaxdev import scrub_accelerator_env
+
+        env = scrub_accelerator_env()
+        env["CEPH_TPU_FORCE_BATCH"] = "1"
+        child = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--hot-read"],
+            env=env, capture_output=True, text=True, timeout=300)
+        if child.returncode == 0 and child.stdout.strip():
+            got = json.loads(child.stdout.strip().splitlines()[-1])
+            tier_hot_mbps = got.get("tier_hot_read_MBps", 0.0)
+            tier_cold_mbps = got.get("tier_cold_read_MBps", 0.0)
+            tier_ratio = got.get("tier_hot_vs_cold", 0.0)
+            tier_perf = got.get("tier_perf", {})
+    except Exception:
+        pass
+
     print(json.dumps({
         "metric": f"ec_encode_GBps_k{K}m{M}_1MiB_stripes_batch{N_STRIPES}"
                   f"_packedbit_resident_{backend}",
@@ -920,6 +946,15 @@ def main() -> int:
         # timeouts, backoffs, paused ops): nonzero resilience counters
         # flag that a wire number was measured through recovery noise
         "objecter_perf": daemon_objecter_perf,
+        # cache-tier hot-read arm: zipfian re-reads on a small hot set,
+        # resident-hit path vs cold decode path on the SAME window (same
+        # schedule, same cluster); tier_perf is the aggregated `tier`
+        # counter snapshot of that window (promotes, evictions,
+        # resident hits, throttle refusals, agent pass latency)
+        "tier_hot_read_MBps": round(tier_hot_mbps, 1),
+        "tier_cold_read_MBps": round(tier_cold_mbps, 1),
+        "tier_hot_vs_cold": round(tier_ratio, 2),
+        "tier_perf": tier_perf,
     }))
     return 0
 
@@ -1060,6 +1095,131 @@ def daemon_path_bench() -> int:
     return 0
 
 
+def hot_read_bench() -> int:
+    """Cache-tier hot-read arm: zipfian re-reads over a small hot set
+    through a 6-OSD TCP cluster, measured on BOTH serving paths in the
+    SAME run window — the resident-hit fast path (objects promoted to
+    device residency by the tier: zero shard reads, zero decode) vs the
+    cold decode path (residents dropped before every read, fadvise
+    dontneed so the scan never heats the hit sets).  Byte-identity is
+    asserted on every measured read.  Emits the aggregated `tier` perf
+    snapshot for the BENCH record."""
+    import asyncio
+
+    # the planar store engages only on an accelerator backend; this arm
+    # runs in a scrubbed CPU child, so force the CPU override BEFORE any
+    # OSD asks for the shared queue
+    os.environ["CEPH_TPU_FORCE_BATCH"] = "1"
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from ceph_tpu.rados.vstart import Cluster
+    import ceph_tpu.rados.osd as osdmod
+
+    n_hot = 8
+    obj_size = 4 << 20
+    n_reads = 64
+
+    async def go():
+        cluster = Cluster(n_osds=6, conf={
+            "osd_auto_repair": False,
+            "ms_local_fastpath": False,
+            "client_op_timeout": 60.0,
+            "osd_hit_set_period": 1.0,
+            "osd_min_read_recency_for_promote": 1,
+            # promotion must not throttle the warmup of an 8-object set
+            "osd_tier_promote_max_objects_sec": 64,
+            "osd_tier_promote_max_bytes_sec": 512 << 20,
+            "osd_tier_agent_interval": 0.5})
+        await cluster.start()
+        try:
+            c = await cluster.client()
+            pool = await c.create_pool("hot", profile={
+                "plugin": "jerasure", "technique": "reed_sol_van",
+                "k": "4", "m": "2"})
+            store = osdmod.shared_planar_store()
+            assert store is not None
+            rng = np.random.default_rng(7)
+            blobs = {f"h{i}": rng.integers(0, 256, obj_size,
+                                           dtype=np.uint8).tobytes()
+                     for i in range(n_hot)}
+            for oid, blob in blobs.items():
+                await c.put(pool, oid, blob)
+
+            def drop_residents(oid):
+                for o in cluster.osds.values():
+                    if o._planar is not None:
+                        o._planar.drop(o._planar_key(pool, oid))
+
+            def resident(oid):
+                return any(o._planar is not None
+                           and o._planar_key(pool, oid) in store
+                           for o in cluster.osds.values())
+
+            # zipfian re-read schedule over the hot set (rank-weighted):
+            # the same schedule drives both arms, so the windows compare
+            # the PATH, not the access pattern
+            weights = np.array([1.0 / (r + 1) for r in range(n_hot)])
+            weights /= weights.sum()
+            schedule = [f"h{i}" for i in rng.choice(
+                n_hot, size=n_reads, p=weights)]
+
+            # COLD arm first (it leaves nothing resident): drop
+            # residents before every read, advise dontneed
+            for oid in blobs:  # warm TCP connections outside the window
+                drop_residents(oid)
+                await c.get(pool, oid, fadvise="dontneed")
+            t0 = time.perf_counter()
+            for oid in schedule:
+                drop_residents(oid)
+                got = await c.get(pool, oid, fadvise="dontneed")
+                assert got == blobs[oid]
+            cold_dt = time.perf_counter() - t0
+
+            # PROMOTE the hot set, then the resident-hit arm
+            for oid in blobs:
+                await c.get(pool, oid, fadvise="willneed")
+            for _ in range(200):
+                if all(resident(oid) for oid in blobs):
+                    break
+                await asyncio.sleep(0.02)
+            hits0 = sum(o.tier_perf.get("resident_hit")
+                        for o in cluster.osds.values())
+            t0 = time.perf_counter()
+            for oid in schedule:
+                got = await c.get(pool, oid)
+                assert got == blobs[oid]
+            hot_dt = time.perf_counter() - t0
+            hits = sum(o.tier_perf.get("resident_hit")
+                       for o in cluster.osds.values()) - hits0
+
+            tier_perf: dict = {}
+            for o in cluster.osds.values():
+                for k, v in o.tier_perf.dump().items():
+                    if isinstance(v, int):
+                        tier_perf[k] = tier_perf.get(k, 0) + v
+                    elif isinstance(v, dict) and "avgcount" in v:
+                        # longrunavg dump shape (agent_pass_s):
+                        # {"avgcount": N, "sum": seconds}
+                        agg = tier_perf.setdefault(
+                            k, {"sum_s": 0.0, "count": 0})
+                        agg["sum_s"] += v.get("sum", 0.0)
+                        agg["count"] += v.get("avgcount", 0)
+            await c.stop()
+            return cold_dt, hot_dt, hits, tier_perf
+        finally:
+            await cluster.stop()
+
+    cold_dt, hot_dt, hits, tier_perf = asyncio.run(go())
+    total = n_reads * obj_size
+    print(json.dumps({
+        "tier_hot_read_MBps": round(total / hot_dt / 1e6, 1),
+        "tier_cold_read_MBps": round(total / cold_dt / 1e6, 1),
+        "tier_hot_vs_cold": round(cold_dt / hot_dt, 2),
+        "tier_resident_hits_in_window": hits,
+        "tier_window_reads": n_reads,
+        "tier_perf": tier_perf}))
+    return 0
+
+
 def onhost_overlap_bench() -> int:
     """Serial vs pipelined batching-queue rounds on the CPU backend (no
     tunnel): the double-buffer mechanism measured on its own.  Serial
@@ -1121,6 +1281,8 @@ def onhost_overlap_bench() -> int:
 if __name__ == "__main__":
     if "--daemon-path" in sys.argv:
         sys.exit(daemon_path_bench())
+    if "--hot-read" in sys.argv:
+        sys.exit(hot_read_bench())
     if "--onhost-overlap" in sys.argv:
         sys.exit(onhost_overlap_bench())
     sys.exit(main())

@@ -138,6 +138,35 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
     Option("osd_op_num_shards", OPT_INT, 4),
     Option("osd_op_queue", OPT_STR, "wpq", enum_values=("wpq", "mclock")),
     Option("osd_scrub_auto", OPT_BOOL, False),
+    # cache tier (osd.yaml.in osd_tier_promote_max_*; pg_pool_t
+    # hit_set_*/target_max_bytes/cache_target_full_ratio defaults —
+    # pool opts set via `pool set` override these per pool)
+    Option("osd_tier_enabled", OPT_BOOL, True,
+           desc="record read hits and manage device residency as a "
+                "cache tier"),
+    Option("osd_hit_set_period", OPT_SECS, 2.0,
+           desc="seconds of reads each hit-set interval covers"),
+    Option("osd_hit_set_count", OPT_INT, 8,
+           desc="archived hit-set intervals retained per PG"),
+    Option("osd_hit_set_fpp", OPT_FLOAT, 0.05,
+           desc="bloom hit-set target false-positive rate"),
+    Option("osd_hit_set_target_size", OPT_INT, 128,
+           desc="expected inserts a hit-set interval is sized for"),
+    Option("osd_min_read_recency_for_promote", OPT_INT, 1,
+           desc="consecutive newest hit sets an object must appear in "
+                "before a read promotes it (0 = always)"),
+    Option("osd_tier_promote_max_objects_sec", OPT_INT, 32,
+           desc="promotion rate ceiling, objects/sec (0 = unthrottled)"),
+    Option("osd_tier_promote_max_bytes_sec", OPT_SIZE, 64 << 20,
+           desc="promotion rate ceiling, bytes/sec (0 = unthrottled)"),
+    Option("osd_tier_target_max_bytes", OPT_SIZE, 0,
+           desc="resident byte budget the tier agent enforces "
+                "(0 = the planar store's capacity)"),
+    Option("osd_cache_target_full_ratio", OPT_FLOAT, 0.8,
+           desc="agent evicts when resident bytes exceed this fraction "
+                "of the target"),
+    Option("osd_tier_agent_interval", OPT_SECS, 0.5,
+           desc="tier agent due-scan cadence (0 disables the agent)"),
     Option("osd_debug_inject_read_err", OPT_BOOL, False, level=LEVEL_DEV),
     Option("osd_debug_inject_dispatch_delay_probability", OPT_FLOAT, 0.0,
            level=LEVEL_DEV),

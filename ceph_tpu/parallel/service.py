@@ -1197,6 +1197,17 @@ class PlanarShardStore:
         self._entries: "OrderedDict[Any, Any]" = OrderedDict()
         self._bytes: Dict[Any, int] = {}
         self._trim: Dict[Any, int] = {}  # packedbit admits: pre-pad width
+        # exit-boundary memo: key -> (version, packed host result).  The
+        # store's contract is "pack exactly once per resident lifetime",
+        # but a cache-tier resident is READ many times — without a memo
+        # every resident-hit read re-pays the device pack.  Lives and
+        # dies WITH the entry (cleared on put/drop/LRU-evict), so a
+        # memo can never outlive or contradict its resident.  Host RAM,
+        # not HBM — tracked separately (memo_bytes gauge) and capped at
+        # the store's capacity so the total footprint the operator
+        # budgets for is at most 2x capacity_bytes, never unbounded.
+        self._memo: Dict[Any, Tuple[Any, Any]] = {}
+        self.memo_bytes = 0
         self.resident_bytes = 0
         self.admits = 0
         self.hits = 0
@@ -1213,6 +1224,8 @@ class PlanarShardStore:
             .add_u64_counter("evict", "LRU evictions under the byte budget")
             .add_u64("resident_bytes", "planar HBM footprint (gauge)")
             .add_u64("entries", "resident objects (gauge)")
+            .add_u64("memo_bytes",
+                     "exit-boundary packed memo host footprint (gauge)")
             .add_time_avg("pack_s",
                           "device->host pack seconds at the exit boundary")
             .add_time_avg("unpack_s",
@@ -1229,6 +1242,7 @@ class PlanarShardStore:
         with self._lock:
             self.perf.set("resident_bytes", self.resident_bytes)
             self.perf.set("entries", len(self._entries))
+            self.perf.set("memo_bytes", self.memo_bytes)
 
     # -- host boundary (pack/unpack paid here, once) -------------------------
 
@@ -1304,6 +1318,7 @@ class PlanarShardStore:
             self._entries[key] = (bits, w, n_rows, meta)
             self._entries.move_to_end(key)
             self._bytes[key] = nbytes
+            self._memo_discard(key)  # new rows: stale packed memo dies
             if trim is None:
                 self._trim.pop(key, None)  # re-put resets admit-time trim
             else:
@@ -1314,6 +1329,7 @@ class PlanarShardStore:
                 old_key, _ = self._entries.popitem(last=False)
                 self.resident_bytes -= self._bytes.pop(old_key)
                 self._trim.pop(old_key, None)
+                self._memo_discard(old_key)
                 self.evictions += 1
                 evicted += 1
             # gauge writes stay under the store lock (see _resync_gauges)
@@ -1371,14 +1387,80 @@ class PlanarShardStore:
             self.put_planar(out_key, out, w=w, n_rows=out_rows)
         return out
 
-    def drop(self, key: Any) -> None:
+    def drop(self, key: Any) -> bool:
+        """Remove `key` if resident; True when an entry was actually
+        dropped.  Dropping an absent key is a supported no-op (the tier
+        agent races the LRU here: either side may have evicted first,
+        and the loser must count a no-op, not error)."""
         with self._lock:
-            if key in self._entries:
+            dropped = key in self._entries
+            if dropped:
                 del self._entries[key]
                 self.resident_bytes -= self._bytes.pop(key)
                 self._trim.pop(key, None)
+            self._memo_discard(key)
             self.perf.set("resident_bytes", self.resident_bytes)
             self.perf.set("entries", len(self._entries))
+        return dropped
+
+    def peek(self, key: Any):
+        """(bits, w, n_rows, meta) or None WITHOUT touching LRU order or
+        the hit/miss counters — policy probes (the tier promotion gate
+        asking "already resident at this version?") must not make an
+        entry look recently used or pollute the hit ratio."""
+        with self._lock:
+            return self._entries.get(key)
+
+    def entries_snapshot(self) -> List[Tuple[Any, int]]:
+        """(key, planar nbytes) pairs in LRU order, oldest first — the
+        tier agent's eviction-candidate input.  A point-in-time copy:
+        the agent ranks against it and tolerates entries that vanish
+        before its drop lands (drop() reports the no-op)."""
+        with self._lock:
+            return [(k, self._bytes[k]) for k in self._entries]
+
+    def _memo_discard(self, key: Any) -> None:
+        """Drop a key's memo and its byte accounting.  Caller holds the
+        store lock."""
+        got = self._memo.pop(key, None)
+        if got is not None:
+            self.memo_bytes -= len(got[1])
+
+    def memo_get(self, key: Any, version: Any):
+        """The exit-boundary memo for `key` at `version`, or None.  Only
+        valid while the entry is RESIDENT (callers validate residency
+        via get_planar first); the memo is version-tagged so a re-put at
+        a newer version can never serve yesterday's bytes."""
+        with self._lock:
+            if key not in self._entries:
+                return None
+            got = self._memo.get(key)
+        if got is None or got[0] != version:
+            return None
+        return got[1]
+
+    def memo_put(self, key: Any, version: Any, value: Any) -> None:
+        """Record the packed host result of this resident at `version`
+        (one entry per key, latest version wins): subsequent resident
+        hits skip the device pack entirely — the 'pack once per
+        resident lifetime' contract made true under repeated reads.
+        Ignored when the entry is not resident (a drop/evict raced the
+        pack: the memo must not outlive the entry), and when the memo
+        pool is at its budget (capacity_bytes: host RAM stays the same
+        order as the HBM budget, so the operator's total footprint is
+        bounded by ~2x capacity — a refused memo only costs a re-pack
+        on the next read, never correctness)."""
+        nbytes = len(value)
+        with self._lock:
+            if key not in self._entries:
+                return
+            self._memo_discard(key)
+            if self.memo_bytes + nbytes > self.capacity_bytes:
+                self.perf.set("memo_bytes", self.memo_bytes)
+                return
+            self._memo[key] = (version, value)
+            self.memo_bytes += nbytes
+            self.perf.set("memo_bytes", self.memo_bytes)
 
     def __contains__(self, key: Any) -> bool:
         with self._lock:
@@ -1386,6 +1468,7 @@ class PlanarShardStore:
 
     def stats(self) -> Dict[str, int]:
         return {"resident_bytes": self.resident_bytes,
+                "memo_bytes": self.memo_bytes,
                 "entries": len(self._entries), "admits": self.admits,
                 "hits": self.hits, "misses": self.misses,
                 "evictions": self.evictions}

@@ -918,12 +918,19 @@ class RadosClient:
                 continue
         return total
 
-    async def get(self, pool_id: int, oid: str, snap: int = 0) -> bytes:
+    async def get(self, pool_id: int, oid: str, snap: int = 0,
+                  fadvise: str = "") -> bytes:
         """Read the head, or the object's state AT a snap id (resolved
-        through the primary's SnapSet clone list)."""
+        through the primary's SnapSet clone list).  ``fadvise`` is
+        cache-tier advice (reference librados FADVISE_DONTNEED/WILLNEED
+        op flags): "dontneed" keeps this read out of the hit sets and
+        off the promotion path (scans, backups); "willneed" asks the
+        primary to promote the object to device residency on this read
+        regardless of its recency (still promotion-throttled)."""
         self._check_oid(oid)
         reply = await self._op(MOSDOp(op="read", pool_id=pool_id, oid=oid,
-                                      snap_read=int(snap)))
+                                      snap_read=int(snap),
+                                      fadvise=fadvise))
         data = reply.data
         if isinstance(data, BufferList):
             # colocated fastpath hands the primary's scatter-gather read

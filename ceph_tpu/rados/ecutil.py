@@ -643,13 +643,22 @@ def planar_object_bytes(store, key, version, k: int, cs: int,
                         object_size: int) -> Optional[bytes]:
     """The logical object bytes packed from the planar resident's DATA
     rows (a reconstructing read with zero shard reads and zero decode),
-    or None when absent/stale."""
+    or None when absent/stale.  The pack result memoizes in the store's
+    exit-boundary memo (dies with the entry / on version change), so a
+    cache-tier resident read many times pays the device pack ONCE —
+    the store's 'pack once per resident lifetime' contract held under
+    repeated reads."""
     got = store.get_planar(key)
     if got is None:
         return None
     bits, w, n_rows, meta = got
     if not meta or meta[0] != version:
         return None
+    memo_get = getattr(store, "memo_get", None)
+    if memo_get is not None:
+        cached = memo_get(key, version)
+        if cached is not None:
+            return cached
     L = meta[1]
     data_bits = bits[:k * w]
     if np.dtype(bits.dtype) == np.uint32:
@@ -662,4 +671,7 @@ def planar_object_bytes(store, key, version, k: int, cs: int,
         rows = np.asarray(from_planar(data_bits, w, k))[:, :L]
     n_stripes = max(1, L // cs)
     out = rows.reshape(k, n_stripes, cs).transpose(1, 0, 2)
-    return out.reshape(-1)[:object_size].tobytes()
+    result = out.reshape(-1)[:object_size].tobytes()
+    if memo_get is not None:
+        store.memo_put(key, version, result)
+    return result

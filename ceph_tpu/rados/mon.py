@@ -935,6 +935,39 @@ class Monitor:
             pool = self.osdmap.pools.get(msg.pool_id)
             if pool is None:
                 return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+            if msg.key in ("hit_set_period", "hit_set_count",
+                           "hit_set_fpp", "hit_set_target_size",
+                           "min_read_recency_for_promote",
+                           "target_max_bytes",
+                           "cache_target_full_ratio"):
+                # cache-tier pool parameters (reference `ceph osd pool
+                # set NAME hit_set_period ...`, pg_pool_t hit_set_*
+                # and the tier agent knobs): validated here, read by
+                # every primary through pool.opts (OSD._tier_opt) so a
+                # bad value can never wedge the read path cluster-wide
+                validators = {
+                    "hit_set_period": lambda v: float(v) > 0,
+                    "hit_set_count": lambda v: int(v) >= 1,
+                    "hit_set_fpp": lambda v: 0.0 < float(v) < 1.0,
+                    "hit_set_target_size": lambda v: int(v) >= 1,
+                    "min_read_recency_for_promote":
+                        lambda v: int(v) >= 0,
+                    "target_max_bytes": lambda v: int(v) >= 0,
+                    "cache_target_full_ratio":
+                        lambda v: 0.0 < float(v) <= 1.0,
+                }
+                try:
+                    if not validators[msg.key](msg.value):
+                        return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+                except (TypeError, ValueError):
+                    return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+                if not hasattr(pool, "opts"):
+                    # PoolInfo unpickled from a pre-opts mon store
+                    pool.opts = {}
+                pool.opts[msg.key] = msg.value
+                self.osdmap.epoch += 1
+                await self._commit_state()
+                return MMapReply(osdmap=self.osdmap, tid=msg.tid)
             if msg.key in ("compression_mode", "compression_algorithm",
                            "compression_required_ratio",
                            "compression_min_blob_size"):

@@ -58,7 +58,7 @@ from ceph_tpu.rados.ecutil import (HashInfo, StripeInfo,
                                    batched_encode_async,
                                    batched_encode_group_async,
                                    decode_object_async,
-                                   planar_encode_async,
+                                   planar_eligible, planar_encode_async,
                                    planar_object_bytes, planar_rows)
 from ceph_tpu.rados.messenger import (TRANSPORT_ERRORS, BufferList,
                                       Messenger, as_bytes)
@@ -86,6 +86,8 @@ from ceph_tpu.rados.scheduler import (
 from ceph_tpu.rados.store import (MemStore, ObjectStore, ShardMeta,
                                   Transaction, shard_crc,
                                   Owned as StoreOwned)
+from ceph_tpu.rados.tiering import (HitSetArchive, PromoteThrottle,
+                                    build_tier_perf, eviction_candidates)
 from ceph_tpu.rados.auth import TicketKeyring
 from ceph_tpu.rados.types import (
     MAuthRotating,
@@ -111,6 +113,7 @@ from ceph_tpu.rados.types import (
     MOSDOp,
     MOSDOpReply,
     MOSDBackoff,
+    MOSDPGHitSet,
     MOSDPGTemp,
     MOSDPing,
     MOsdBoot,
@@ -390,6 +393,29 @@ class OSD:
             shared_planar_store(
                 int(self.conf.get("osd_ec_planar_bytes", 0) or 0))
             if self.conf.get("osd_ec_planar_residency", True) else None)
+        # cache-tier policy state (ceph_tpu/rados/tiering.py): per-PG
+        # bloom hit-set archives, the promotion rate throttle, and the
+        # best-effort tier agent that makes HBM residency a POLICY —
+        # hot objects are promoted into the planar store, cold residents
+        # evicted coldest-temperature-first.  Hit recording runs even
+        # without a device (temperatures are cheap and feed `tier
+        # status`); promotion/eviction engage only when _planar exists.
+        self._hit_sets: Dict[Tuple[int, int], HitSetArchive] = {}
+        # per-PG epoch of the last ACCEPTED archive push (fencing:
+        # cross-sender delivery has no wire ordering, see
+        # _handle_pg_hit_set)
+        self._hit_set_epochs: Dict[Tuple[int, int], int] = {}
+        self._promote_throttle = PromoteThrottle(
+            float(self.conf.get("osd_tier_promote_max_objects_sec", 32)
+                  or 0),
+            float(self.conf.get("osd_tier_promote_max_bytes_sec", 64 << 20)
+                  or 0))
+        self.tier_perf = self.ctx.perf.add(build_tier_perf())
+        self._tier_agent_busy = False
+        self._last_tier_scan = 0.0
+        # promotions in flight, keyed by planar key: N hot reads racing
+        # before the first install must fund ONE encode, not N
+        self._promoting: Set[Tuple[int, int, str]] = set()
         # EC data-plane observability: ONE `perf dump` on this daemon
         # carries the whole pipeline breakdown — the messenger's `wire`
         # set (framing vs socket io), the shared queue's `ec_tpu` set
@@ -472,6 +498,15 @@ class OSD:
             # in-process execute() works without the unix socket, so the
             # timeline command registers whether or not asok_dir is set
             self._ec_queue.register_asok(self.ctx.asok)
+        # in-process execute() works without the unix socket (the asok
+        # command registers whether or not asok_dir is set, like the EC
+        # batch timeline above)
+        self.ctx.asok.register(
+            "dump_hit_sets", lambda a: self._dump_hit_sets(),
+            "per-PG hit-set archives (intervals, fill, estimated fpp)")
+        self.ctx.asok.register(
+            "tier status", lambda a: self.tier_status(),
+            "cache-tier residency/promotion/eviction status")
         asok_dir = self.conf.get("admin_socket_dir")
         if asok_dir:
             self.ctx.asok.register(
@@ -554,6 +589,7 @@ class OSD:
                 self.mons.rotate()  # that mon looks dead
             ticks += 1
             self._maybe_schedule_scrubs()
+            self._maybe_schedule_tier_agent()
             if self._ec_queue is not None:
                 # mirror the shared queue's stats into this daemon's
                 # counters (perf dump / prometheus visibility); submits
@@ -863,6 +899,8 @@ class OSD:
                     self.store.omap_rm(key, msg.removals)
             except NotImplementedError:
                 pass
+        elif isinstance(msg, MOSDPGHitSet):
+            self._handle_pg_hit_set(msg)
         elif isinstance(msg, MPGLogReply) and not msg.tid:
             # unsolicited authoritative log push from the primary: merge
             # (with divergent-entry rollback) so our head catches up
@@ -985,7 +1023,8 @@ class OSD:
                         self._prior_acting[key] = oa
             # prune intervals of deleted pools (bounded memory)
             for d in (self._prior_acting, self._past_members,
-                      self._pg_machines, self._partial_newer):
+                      self._pg_machines, self._partial_newer,
+                      self._hit_sets, self._hit_set_epochs):
                 for key in [k for k in d if k[0] not in osdmap.pools]:
                     d.pop(key, None)
         elif old is None:
@@ -1636,9 +1675,11 @@ class OSD:
             self._cache_drop(pool_id, snap_head(oid))
         for key in [k for k in self._pglogs if k[0] == pool_id]:
             del self._pglogs[key]
-        for d in (self._past_members, self._prior_acting):
+        for d in (self._past_members, self._prior_acting, self._hit_sets,
+                  self._hit_set_epochs):
             for k in [k for k in d if k[0] == pool_id]:
                 d.pop(k, None)
+        self.tier_perf.set("hit_sets", len(self._hit_sets))
         self.perf.inc("pools_purged")
 
     def _mark_failed_write(self, reqid: str) -> None:
@@ -1795,6 +1836,12 @@ class OSD:
                 reply = await self._do_write(op)
             elif op.op == "read":
                 reply = await self._snap_routed(op, self._do_read)
+                if reply.ok and op.snap_read == 0:
+                    # tier policy hook: record the hit in the PG's
+                    # hit-set archive and maybe promote (client reads
+                    # only — internal reads via _do_read must not heat
+                    # the working set)
+                    self._tier_observe_read(op, reply)
             elif op.op == "delete":
                 reply = await self._do_delete(op)
             elif op.op == "snap-trim":
@@ -2352,9 +2399,15 @@ class OSD:
             # under the version it landed as): a failed write must not
             # leave resident rows that reads would serve
             _, all_bits, n_rows, n_cols, pw = planar
+            pkey = self._planar_key(op.pool_id, op.oid)
             self._planar.put_planar(
-                self._planar_key(op.pool_id, op.oid), all_bits,
+                pkey, all_bits,
                 w=pw, n_rows=n_rows, meta=(version, n_cols, object_size))
+            # seed the exit-boundary memo with the just-written bytes:
+            # the first resident-hit read serves host bytes instead of
+            # paying a device pack (see PlanarShardStore.memo_put)
+            if isinstance(data, bytes) and len(data) == object_size:
+                self._planar.memo_put(pkey, version, data)
         if full_for_cache is not None:
             self._cache_put(op.pool_id, op.oid, version, full_for_cache)
         elif chunk_off >= 0:
@@ -2501,6 +2554,9 @@ class OSD:
                             self._sinfo(pool).chunk_size, meta[2])
                         if data is not None:
                             self.perf.inc("planar_read_hits")
+                            self.tier_perf.inc("resident_hit")
+                            self.tier_perf.inc("resident_hit_bytes",
+                                               len(data))
                             return MOSDOpReply(ok=True, data=data,
                                                version=ent.object_version)
         available = {
@@ -2601,6 +2657,12 @@ class OSD:
                 self._planar, self._planar_key(op.pool_id, op.oid),
                 newest, k, self._sinfo(pool).chunk_size, object_size)
             if got_planar is not None:
+                # decode skipped (shard reads already happened): counts
+                # as a resident hit for the tier — the resident absorbed
+                # the decode dispatch even though the log could not
+                # corroborate the zero-shard-read path above
+                self.tier_perf.inc("resident_hit")
+                self.tier_perf.inc("resident_hit_bytes", len(got_planar))
                 self._cache_put(op.pool_id, op.oid, newest, got_planar)
                 return MOSDOpReply(ok=True, data=got_planar, version=newest)
         arrays = {s: np.frombuffer(c, dtype=np.uint8) for s, c in chunks.items()}
@@ -4093,6 +4155,364 @@ class OSD:
                                  crc_ok=crc_ok, version=version, crc=crc))
         except (ConnectionError, OSError):
             pass
+
+    # -- cache tier (reference HitSet + tiering agent, here over the
+    #    planar HBM residency; policy classes in ceph_tpu/rados/tiering.py) --
+
+    def _tier_enabled(self, pool: PoolInfo) -> bool:
+        return (pool.pool_type == "ec"
+                and bool(self.conf.get("osd_tier_enabled", True)))
+
+    def _tier_opt(self, pool: PoolInfo, key: str, default, cast):
+        """One tier tunable: the pool's mon-settable opt (reference
+        `ceph osd pool set NAME hit_set_period ...`) wins over the OSD
+        config default; garbage values fall back to the default rather
+        than wedging the read path."""
+        opts = getattr(pool, "opts", {}) or {}
+        raw = opts.get(key)
+        if raw is None:
+            raw = self.conf.get(f"osd_{key}", default)
+        try:
+            return cast(raw)
+        except (TypeError, ValueError):
+            return cast(default)
+
+    def _tier_archive(self, pool: PoolInfo, pg: int) -> HitSetArchive:
+        """The PG's hit-set archive, (re)built when the pool's hit-set
+        tunables changed (old intervals were sized for different
+        guarantees, so they do not carry over)."""
+        key = (pool.pool_id, pg)
+        period = max(1e-3, self._tier_opt(pool, "hit_set_period", 2.0,
+                                          float))
+        count = max(1, self._tier_opt(pool, "hit_set_count", 8, int))
+        target = self._tier_opt(pool, "hit_set_target_size", 128, int)
+        fpp = self._tier_opt(pool, "hit_set_fpp", 0.05, float)
+        arch = self._hit_sets.get(key)
+        if arch is None or arch.params_key() != (period, count, target,
+                                                 fpp):
+            arch = HitSetArchive(period, count, target, fpp,
+                                 seed=(pool.pool_id << 20) | pg)
+            self._hit_sets[key] = arch
+            self.tier_perf.set("hit_sets", len(self._hit_sets))
+        return arch
+
+    def _tier_observe_read(self, op: MOSDOp, reply: MOSDOpReply) -> None:
+        """Read-path tier hook (reference PrimaryLogPG::maybe_promote):
+        record the hit in the PG's hit-set archive and, when the
+        object's recency crosses min_read_recency_for_promote (or the
+        client fadvised willneed), promote its full stripe into the
+        planar store — throttled by osd_tier_promote_max_objects_sec /
+        _bytes_sec.  fadvise=dontneed reads neither record nor promote
+        (scans and backups must not heat the working set)."""
+        if op.fadvise == "dontneed" or self.osdmap is None:
+            return
+        pool = self.osdmap.pools.get(op.pool_id)
+        if pool is None or not self._tier_enabled(pool):
+            return
+        pg, acting = self._acting(pool, op.oid)
+        if self._primary(pool, pg, acting) != self.osd_id:
+            return
+        arch = self._tier_archive(pool, pg)
+        rotated = arch.record(op.oid)
+        self.tier_perf.inc("read_hits_recorded")
+        if rotated:
+            self.tier_perf.inc("hitset_rotations")
+            worst = max((a.estimated_fpp()
+                         for a in self._hit_sets.values()), default=0.0)
+            self.tier_perf.set("hitset_fpp_ppm", int(worst * 1e6))
+            self._replicate_hit_set(pool, pg, acting, arch)
+        if self._planar is None:
+            return
+        # already resident at this version?  peek: a policy probe must
+        # not refresh LRU position or pollute the hit/miss ratio
+        pkey = self._planar_key(op.pool_id, op.oid)
+        ent = self._planar.peek(pkey)
+        if ent is not None and ent[3] and ent[3][0] == reply.version:
+            return
+        if pkey in self._promoting:
+            return  # racing reads fund one encode, not N
+        recency_min = self._tier_opt(pool, "min_read_recency_for_promote",
+                                     1, int)
+        if op.fadvise != "willneed" and arch.recency(op.oid) < recency_min:
+            return
+        nbytes = len(reply.data)
+        if not nbytes:
+            return
+        # eligibility BEFORE the throttle: a pool whose codec can never
+        # plane (mapped/bit-layout plugins) must not burn shared tokens
+        # on promotions that are guaranteed to skip — that would starve
+        # promotable pools on the same OSD
+        if not planar_eligible(self._codec(pool)):
+            self.tier_perf.inc("promote_skipped")
+            return
+        if not self._promote_throttle.allow(nbytes):
+            self.tier_perf.inc("promote_throttled")
+            return
+        # materialize once, AFTER the throttle: a scatter reply's views
+        # are copied only for promotions that will actually run
+        data = as_bytes(reply.data)
+        self._promoting.add(pkey)
+        t = asyncio.get_running_loop().create_task(
+            self._promote_object(pool, op.oid, data, reply.version))
+        self.messenger._tasks.add(t)
+        t.add_done_callback(self.messenger._tasks.discard)
+
+    async def _promote_object(self, pool: PoolInfo, oid: str, data: bytes,
+                              version: int) -> None:
+        """Pack the object's full stripe into the planar store as a
+        device resident via the packed-bit lane; subsequent reads serve
+        from the resident fast path (zero shard reads, zero decode) with
+        byte-identical results — the serving path re-validates the
+        resident's version against the PG log on every read."""
+        try:
+            await self._promote_object_inner(pool, oid, data, version)
+        finally:
+            self._promoting.discard(self._planar_key(pool.pool_id, oid))
+
+    async def _promote_object_inner(self, pool: PoolInfo, oid: str,
+                                    data: bytes, version: int) -> None:
+        try:
+            planar = await planar_encode_async(
+                self._codec(pool), self._sinfo(pool), data,
+                queue=self._ec_queue)
+            if planar is None:
+                # codec not planar-eligible (mapped/bit-layout plugins)
+                self.tier_perf.inc("promote_skipped")
+                return
+            # staleness gate: between the read and this install a write
+            # may have landed.  The log check and the install below are
+            # synchronous (no await between them), so a write appending
+            # a newer entry either already moved the head (we skip) or
+            # will install its own newer resident after ours.  A TRIMMED
+            # log (latest_entry None — long-lived objects outlive the
+            # per-PG log window) is NOT stale: no entry means no recent
+            # write, and the serving paths re-validate the resident's
+            # version on every read anyway, so a mis-install can never
+            # be served.
+            pg = self.osdmap.object_to_pg(pool, oid)
+            ent = self._pglog(pool.pool_id, pg).latest_entry(oid)
+            if ent is not None and (ent.op != "write"
+                                    or ent.object_version != version):
+                self.tier_perf.inc("promote_stale")
+                return
+            _, all_bits, n_rows, n_cols, pw = planar
+            pkey = self._planar_key(pool.pool_id, oid)
+            self._planar.put_planar(
+                pkey, all_bits, w=pw,
+                n_rows=n_rows, meta=(version, n_cols, len(data)))
+            # the promoted bytes ARE the pack of the resident's data
+            # rows at this version: seed the exit-boundary memo so the
+            # first resident hit serves host bytes with zero device
+            # work (the pack is already paid — it happened as part of
+            # this promote's encode)
+            self._planar.memo_put(pkey, version, data)
+            self.tier_perf.inc("promote")
+            self.tier_perf.inc("promote_bytes", len(data))
+        except (asyncio.CancelledError, GeneratorExit):
+            raise
+        except Exception as e:
+            self.tier_perf.inc("promote_skipped")
+            self.ctx.log.error(
+                "osd", f"tier promote {oid}: {type(e).__name__}: {e}")
+
+    def _replicate_hit_set(self, pool: PoolInfo, pg: int,
+                           acting: List[int], arch: HitSetArchive) -> None:
+        """Push the PG's encoded archive to the acting peers at rotation
+        (reference hit_set_persist): a failover primary seeds its
+        temperature state from the freshest received archive instead of
+        restarting every object at cold.  Sends ride their own task —
+        the read path must not serialize on peer sockets."""
+        peers = [a for a in acting
+                 if a not in (CRUSH_ITEM_NONE, self.osd_id)]
+        if not peers:
+            return
+        msg = MOSDPGHitSet(pool_id=pool.pool_id, pg=pg,
+                           from_osd=self.osd_id, epoch=self.osdmap.epoch,
+                           archive=arch.encode())
+
+        async def _send() -> None:
+            for osd in peers:
+                info = self.osdmap.osds.get(osd)
+                if info is None or not info.up:
+                    continue
+                try:
+                    await self.messenger.send(self.osdmap.addr_of(osd), msg)
+                except TRANSPORT_ERRORS:
+                    pass  # the peer catches the next rotation's push
+
+        t = asyncio.get_running_loop().create_task(_send())
+        self.messenger._tasks.add(t)
+        t.add_done_callback(self.messenger._tasks.discard)
+
+    def _handle_pg_hit_set(self, msg: MOSDPGHitSet) -> None:
+        if msg.from_osd == self.osd_id or self.osdmap is None:
+            return
+        pool = self.osdmap.pools.get(msg.pool_id)
+        if pool is None or msg.pg >= pool.pg_num:
+            return
+        acting = self.osdmap.pg_to_acting(pool, msg.pg)
+        if self._primary(pool, msg.pg, acting) == self.osd_id:
+            return  # we lead this PG: our live archive is authoritative
+        key = (msg.pool_id, msg.pg)
+        # epoch fencing: pushes from different senders have no ordering
+        # on the wire — a delayed final push from a DEAD former primary
+        # must not overwrite the fresher archive the current primary
+        # already sent (the exact failover window the replication
+        # exists for)
+        if msg.epoch < self._hit_set_epochs.get(key, 0):
+            return
+        try:
+            arch = HitSetArchive.decode(as_bytes(msg.archive))
+        except ValueError:
+            return  # truncated/foreign blob: keep local state
+        self._hit_sets[key] = arch
+        self._hit_set_epochs[key] = msg.epoch
+        self.tier_perf.set("hit_sets", len(self._hit_sets))
+
+    def _tier_effective_target(self) -> int:
+        """The byte budget the agent enforces against: the OSD config
+        (osd_tier_target_max_bytes, 0 = the planar store's capacity)
+        tightened by any pool's mon-set target_max_bytes — the store is
+        one process-shared HBM pool, so the tightest configured bound
+        governs."""
+        if self._planar is None:
+            return 0
+        target = int(self.conf.get("osd_tier_target_max_bytes", 0) or 0) \
+            or self._planar.capacity_bytes
+        if self.osdmap is not None:
+            for pool in self.osdmap.pools.values():
+                raw = (getattr(pool, "opts", {}) or {}).get(
+                    "target_max_bytes")
+                if raw:
+                    try:
+                        t = int(raw)
+                    except (TypeError, ValueError):
+                        continue
+                    if t > 0:
+                        target = min(target, t)
+        return target
+
+    def _tier_full_ratio(self) -> float:
+        ratio = float(self.conf.get("osd_cache_target_full_ratio", 0.8)
+                      or 0.8)
+        if self.osdmap is not None:
+            for pool in self.osdmap.pools.values():
+                raw = (getattr(pool, "opts", {}) or {}).get(
+                    "cache_target_full_ratio")
+                if raw:
+                    try:
+                        ratio = min(ratio, float(raw))
+                    except (TypeError, ValueError):
+                        pass
+        return min(max(ratio, 0.01), 1.0)
+
+    def _maybe_schedule_tier_agent(self) -> None:
+        """Tier agent scheduling (reference PrimaryLogPG::agent_work via
+        the OSD's agent queue): at most ONE pass in flight, scheduled
+        through the sharded op queue's best_effort class so mClock/WPQ
+        arbitrate it against client and recovery work — the same
+        discipline as the scrub scheduler."""
+        if (self._planar is None or self.osdmap is None
+                or self._tier_agent_busy
+                or not self.conf.get("osd_tier_enabled", True)):
+            return
+        interval = float(self.conf.get("osd_tier_agent_interval", 0.5)
+                         or 0)
+        if interval <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_tier_scan < interval:
+            return
+        self._last_tier_scan = now
+        self._tier_agent_busy = True
+
+        async def _enqueue() -> None:
+            try:
+                await self.op_queue.enqueue(
+                    -2, self._tier_agent_pass, CLASS_BEST_EFFORT, cost=1)
+            except BaseException:
+                self._tier_agent_busy = False
+                raise
+
+        t = asyncio.get_running_loop().create_task(_enqueue())
+        self.messenger._tasks.add(t)
+        t.add_done_callback(self.messenger._tasks.discard)
+
+    async def _tier_agent_pass(self) -> None:
+        try:
+            with self.tier_perf.time_avg("agent_pass_s"):
+                self._tier_agent_once()
+        finally:
+            self._tier_agent_busy = False
+
+    def _tier_agent_once(self) -> None:
+        """One flush/evict pass: when the planar store's resident bytes
+        exceed cache_target_full_ratio of the effective target, evict
+        this OSD's residents coldest-temperature-first until back under.
+        An entry the LRU already dropped underneath the plan is a
+        COUNTED no-op (agent_evict_noop), never an error — either side
+        may win that race."""
+        store = self._planar
+        if store is None:
+            return
+        target = self._tier_effective_target()
+        self.tier_perf.set("resident_target_bytes", target)
+        if target <= 0:
+            return
+        high = int(target * self._tier_full_ratio())
+        if store.resident_bytes <= high:
+            self.tier_perf.inc("agent_skip")
+            return
+        self.tier_perf.inc("agent_pass")
+        excess = store.resident_bytes - high
+        mine = [(k, b) for k, b in store.entries_snapshot()
+                if isinstance(k, tuple) and len(k) == 3
+                and k[0] == self.osd_id]
+        my_bytes = sum(b for _, b in mine)
+        # the store is process-shared and every colocated OSD's agent
+        # fires on the same excess: evict only OUR proportional share of
+        # it, or N agents would each purge the full excess (Nx
+        # over-eviction -> promote/evict thrash).  Rounding up keeps the
+        # shares covering the whole excess; the next pass (one agent
+        # interval away) mops up any remainder.
+        need = min(my_bytes, excess * my_bytes
+                   // max(1, store.resident_bytes) + 1)
+
+        def temp_of(key) -> float:
+            _osd, pool_id, oid = key
+            pool = self.osdmap.pools.get(pool_id) if self.osdmap else None
+            if pool is None:
+                return 0.0
+            arch = self._hit_sets.get(
+                (pool_id, self.osdmap.object_to_pg(pool, oid)))
+            return arch.temperature(oid) if arch is not None else 0.0
+
+        for key, nbytes in eviction_candidates(mine, temp_of, need):
+            if store.drop(key):
+                self.tier_perf.inc("agent_evict")
+                self.tier_perf.inc("agent_evict_bytes", nbytes)
+            else:
+                self.tier_perf.inc("agent_evict_noop")
+
+    def tier_status(self) -> dict:
+        """`tier status` admin-socket shape."""
+        store = self._planar
+        return {
+            "enabled": bool(self.conf.get("osd_tier_enabled", True)),
+            "device_residency": store is not None,
+            "resident_bytes": store.resident_bytes if store else 0,
+            "memo_bytes": store.memo_bytes if store else 0,
+            "resident_entries": len(store.entries_snapshot())
+            if store else 0,
+            "target_max_bytes": self._tier_effective_target(),
+            "cache_target_full_ratio": self._tier_full_ratio(),
+            "hit_set_archives": len(self._hit_sets),
+            "perf": self.tier_perf.dump(),
+        }
+
+    def _dump_hit_sets(self) -> dict:
+        return {f"{pool_id}.{pg}": arch.dump()
+                for (pool_id, pg), arch in sorted(self._hit_sets.items())}
 
     def _maybe_schedule_scrubs(self) -> None:
         """Self-scheduled deep scrub (reference osd_scrub_sched.h: PGs

@@ -14,7 +14,9 @@ the same shard — and each shard's worker drains a pluggable scheduler:
   class state; dequeue serves first any class with R-tag due (reservation
   guarantee), else the eligible class with the smallest P-tag (weighted
   sharing) subject to L (limit).  Classes here mirror the reference's:
-  client, recovery (background_recovery), best_effort (scrub/snaptrim).
+  client, recovery (background_recovery), best_effort (scrub/snaptrim —
+  and the cache-tier flush/evict agent, whose single-flight passes ride
+  CLASS_BEST_EFFORT so eviction work never outruns client reads).
 
 The asyncio translation: shard workers are tasks, not threads.  The
 scheduler decides ORDER; execution preserves strict ordering only per

@@ -699,6 +699,14 @@ class MOSDOp:
     # effects of earlier sub-ops; any failing sub-op aborts the whole op
     # with nothing applied.
     ops: List[Tuple[str, Dict]] = field(default_factory=list)
+    # cache-tier advice riding reads (reference librados
+    # LIBRADOS_OP_FLAG_FADVISE_DONTNEED/_WILLNEED gating cache-tier
+    # promotion, src/osd/PrimaryLogPG.cc maybe_promote): "" = default
+    # policy (hit recording + recency-gated promotion), "dontneed" =
+    # neither record nor promote (scan/backup traffic must not heat the
+    # working set), "willneed" = promote on this read regardless of
+    # recency (still promotion-throttled)
+    fadvise: str = ""
 
 
 @message(21, version=2)
@@ -754,6 +762,28 @@ class MOSDBackoff:
 
     FIXED_FIELDS = [("op", "s"), ("pool_id", "q"), ("pg", "q"),
                     ("id", "s"), ("epoch", "q"), ("duration", "d")]
+
+
+@message(67)
+class MOSDPGHitSet:
+    """Primary -> acting peers: one PG's encoded HitSetArchive, pushed
+    at every hit-set rotation (reference: the primary PERSISTS HitSets
+    as PG objects so hit history survives primary changes,
+    PrimaryLogPG::hit_set_persist; here the archive rides the wire to
+    the acting set instead).  A peer that later becomes primary seeds
+    its temperature estimator from the freshest received archive, so a
+    failover does not reset every object to cold.  ``archive`` is the
+    HitSetArchive binary encoding (ceph_tpu/rados/tiering.py), whose
+    layout the wire corpus pins alongside this message's."""
+
+    pool_id: int = 0
+    pg: int = 0
+    from_osd: int = -1
+    epoch: int = 0
+    archive: bytes = b""
+
+    FIXED_FIELDS = [("pool_id", "q"), ("pg", "q"), ("from_osd", "q"),
+                    ("epoch", "q"), ("archive", "y")]
 
 
 # Primary OSD <-> shard OSDs (ECSubWrite/ECSubRead equivalents,
@@ -1132,7 +1162,7 @@ MOSDOp.FIXED_FIELDS = [
     ("epoch", "q"), ("reqid", "s"), ("offset", "q"), ("cls", "s"),
     ("method", "s"), ("snapc_seq", "Q"), ("snapc_snaps", "Q*"),
     ("snap_read", "Q"), ("snap_id", "Q"), ("pg", "q"), ("cursor", "s"),
-    ("max_entries", "q"), ("nspace", "s"),
+    ("max_entries", "q"), ("nspace", "s"), ("fadvise", "s"),
 ]
 # a compound op vector (multi) carries arbitrary typed kwargs: pickle
 MOSDOp.FIXED_WHEN = staticmethod(lambda m: not m.ops)

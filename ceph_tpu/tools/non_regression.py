@@ -45,6 +45,13 @@ def parse_args(argv=None):
     p.add_argument("--chaos-seconds", type=float, default=6.0,
                    help="length of the chaos put/get loop")
     p.add_argument("--chaos-osds", type=int, default=4)
+    # tier smoke (CI): promote/evict/read loop against an in-process
+    # cluster; exit nonzero on ANY content mismatch between a
+    # resident-hit read and the cold decode path for the same object
+    p.add_argument("--tier", action="store_true")
+    p.add_argument("--tier-seconds", type=float, default=6.0,
+                   help="length of the tier promote/evict/read loop")
+    p.add_argument("--tier-osds", type=int, default=3)
     return p.parse_args(argv)
 
 
@@ -240,8 +247,153 @@ def run_chaos(args) -> int:
     return asyncio.run(go())
 
 
+def run_tier(args) -> int:
+    """Tier smoke mode (CI): a promote/evict/read loop against an
+    in-process cluster with the device-residency tier forced on.  Every
+    iteration reads one hot object through BOTH paths — the cold decode
+    path (residents dropped first) and, after promotion, the
+    resident-hit fast path — and exits nonzero on ANY content mismatch
+    between the two (the tier's byte-identity gate), on any read
+    failure, and on the agent failing to bound resident bytes.  The
+    acceptance bar of the cache tier, runnable as one command:
+
+        python -m ceph_tpu.tools.non_regression --tier
+    """
+    import asyncio
+    import os as _os
+
+    # the planar store (and with it promotion) engages only on an
+    # accelerator backend; FORCE_BATCH is the sanctioned CPU override —
+    # set BEFORE any OSD asks for the shared queue
+    _os.environ["CEPH_TPU_FORCE_BATCH"] = "1"
+
+    from ceph_tpu.rados.vstart import Cluster
+    import ceph_tpu.rados.osd as osdmod
+
+    target_bytes = 3 << 20
+
+    async def go() -> int:
+        conf = {"osd_auto_repair": False, "client_op_timeout": 60.0,
+                "osd_heartbeat_interval": 0.1,
+                "osd_hit_set_period": 0.5,
+                "osd_min_read_recency_for_promote": 1,
+                "osd_tier_agent_interval": 0.1,
+                "osd_tier_target_max_bytes": target_bytes,
+                "osd_cache_target_full_ratio": 0.8}
+        cluster = Cluster(n_osds=max(3, args.tier_osds), conf=conf)
+        await cluster.start()
+        failures = []
+        resident_reads = cold_reads = 0
+        try:
+            c = await cluster.client()
+            pool = await c.create_pool("tier", profile={
+                "plugin": "jerasure", "technique": "reed_sol_van",
+                "k": "2", "m": "1"})
+            store = osdmod.shared_planar_store()
+            if store is None:
+                print("FAIL planar store did not engage under "
+                      "CEPH_TPU_FORCE_BATCH=1", file=sys.stderr)
+                return 1
+            import time as _time
+
+            blobs = {}
+            # hot set larger than the agent target: evictions must run
+            for i in range(24):
+                oid = f"h{i}"
+                blobs[oid] = _os.urandom(150_000 + 512 * i)
+                await c.put(pool, oid, blobs[oid])
+
+            def drop_residents(oid: str) -> None:
+                for o in cluster.osds.values():
+                    if o._planar is not None:
+                        o._planar.drop(o._planar_key(pool, oid))
+
+            def resident_on(oid: str) -> bool:
+                return any(o._planar is not None
+                           and o._planar_key(pool, oid) in store
+                           for o in cluster.osds.values())
+
+            deadline = _time.monotonic() + args.tier_seconds
+            i = 0
+            while _time.monotonic() < deadline:
+                oid = f"h{i % len(blobs)}"
+                want = blobs[oid]
+                # COLD path: force the decode pipeline
+                drop_residents(oid)
+                try:
+                    cold = await c.get(pool, oid, fadvise="dontneed")
+                    cold_reads += 1
+                    if cold != want:
+                        failures.append(f"cold-path mismatch on {oid}")
+                except Exception as e:
+                    failures.append(f"cold read {oid} failed: {e}")
+                    i += 1
+                    continue
+                # PROMOTE (willneed bypasses recency, not the throttle)
+                # then read the resident-hit path
+                try:
+                    await c.get(pool, oid, fadvise="willneed")
+                    for _ in range(50):
+                        if resident_on(oid):
+                            break
+                        await asyncio.sleep(0.01)
+                    hot = await c.get(pool, oid)
+                    if resident_on(oid):
+                        resident_reads += 1
+                    if hot != cold:
+                        failures.append(
+                            f"resident-hit vs cold mismatch on {oid}")
+                    if hot != want:
+                        failures.append(f"resident-hit mismatch on {oid}")
+                except Exception as e:
+                    failures.append(f"hot read {oid} failed: {e}")
+                if i % 7 == 3:
+                    # churn: overwrite invalidates the resident; the next
+                    # round must serve the NEW bytes on both paths
+                    blobs[oid] = _os.urandom(140_000 + 256 * i)
+                    await c.put(pool, oid, blobs[oid])
+                i += 1
+            # bounded residency: the agent must be holding the line.
+            # Settle for a few agent intervals first — the loop above
+            # promotes flat-out and the agent enforces on its cadence,
+            # so an instantaneous sample can catch promotions that
+            # landed since the last pass (by-design transient, same as
+            # the reference agent)
+            await asyncio.sleep(0.5)
+            if store.resident_bytes > target_bytes:
+                failures.append(
+                    f"resident_bytes {store.resident_bytes} exceeds "
+                    f"target {target_bytes} after settling")
+            tier = {}
+            for o in cluster.osds.values():
+                for k, v in o.tier_perf.dump().items():
+                    if isinstance(v, int):
+                        tier[k] = tier.get(k, 0) + v
+            print(f"tier: {i} iterations, {resident_reads} resident-hit "
+                  f"reads, {cold_reads} cold reads, "
+                  f"{len(failures)} failures; "
+                  f"promote={tier.get('promote', 0)} "
+                  f"evict={tier.get('agent_evict', 0)} "
+                  f"evict_noop={tier.get('agent_evict_noop', 0)} "
+                  f"resident_hit={tier.get('resident_hit', 0)} "
+                  f"throttled={tier.get('promote_throttled', 0)}")
+            if not resident_reads:
+                failures.append("no resident-hit read ever happened "
+                                "(promotion never engaged)")
+            await c.stop()
+        finally:
+            await cluster.stop()
+        for f in failures:
+            print(f"FAIL {f}", file=sys.stderr)
+        return 1 if failures else 0
+
+    return asyncio.run(go())
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.tier:
+        return run_tier(args)
     if args.chaos:
         return run_chaos(args)
     if args.wire_floor:

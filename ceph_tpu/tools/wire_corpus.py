@@ -38,13 +38,24 @@ def _sample_messages() -> List[Any]:
     non-default so a dropped/renamed field cannot hide behind a
     default value."""
     from ceph_tpu.rados import types as t
+    from ceph_tpu.rados.tiering import HitSetArchive
+
+    # deterministic hit-set archive (explicit clocks, seeded blake2b
+    # hashing): the MOSDPGHitSet frame below pins the BloomHitSet /
+    # HitSetArchive BINARY encoding alongside the message layout —
+    # an accidental re-layout of either fails the corpus check
+    arch = HitSetArchive(period=2.0, count=4, target_size=32,
+                         fpp=0.05, seed=77, now=100.0)
+    arch.record("corpus/hot", now=100.5)
+    arch.record("corpus/hot", now=102.5)  # rotates the first interval
+    arch.record("corpus/warm", now=102.6)
 
     return [
         t.MOSDOp(op="write", pool_id=3, oid="corpus/oid", data=b"payload",
                  epoch=11, reqid="req-1", offset=4096, cls="lock",
                  method="lock", snapc_seq=9, snapc_snaps=[9, 4, 2],
                  snap_read=7, snap_id=5, pg=12, cursor="after",
-                 max_entries=64, nspace="blue"),
+                 max_entries=64, nspace="blue", fadvise="willneed"),
         t.MOSDOp(op="multi", pool_id=1, oid="m", reqid="r2",
                  ops=[("setxattr", {"name": "a", "value": b"v"}),
                       ("omap_set", {"entries": {"k": b"x"}})]),
@@ -98,6 +109,8 @@ def _sample_messages() -> List[Any]:
                       tid="t11"),
         t.MOSDBackoff(op="unblock", pool_id=2, pg=9, id="bk-1", epoch=33,
                       duration=1.5),
+        t.MOSDPGHitSet(pool_id=3, pg=7, from_osd=2, epoch=44,
+                       archive=arch.encode(now=103.0)),
     ]
 
 
