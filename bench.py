@@ -806,6 +806,7 @@ def main() -> int:
     daemon_wire_get_mbps = 0.0
     daemon_wire_perf: dict = {}
     daemon_objecter_perf: dict = {}
+    daemon_phase_pcts: dict = {}
     try:
         import subprocess
 
@@ -823,6 +824,7 @@ def main() -> int:
             daemon_wire_get_mbps = got.get("wire_get_MBps", 0.0)
             daemon_wire_perf = got.get("wire_perf", {})
             daemon_objecter_perf = got.get("objecter_perf", {})
+            daemon_phase_pcts = got.get("op_phase_percentiles", {})
     except Exception:
         pass
 
@@ -946,6 +948,13 @@ def main() -> int:
         # timeouts, backoffs, paused ops): nonzero resilience counters
         # flag that a wire number was measured through recovery noise
         "objecter_perf": daemon_objecter_perf,
+        # per-phase op-latency percentiles (p50/p99/p999 µs) of the TCP
+        # daemon arm, for both put and get: queue_wait / ec_dispatch /
+        # subop_wait from the OSD op trackers' sample rings, wire tx/rx
+        # from the `wire` µs histograms — EC-cluster behavior is
+        # characterized by per-phase TAILS, not throughput averages
+        # (arXiv:1709.05365), and the ROADMAP wire work is judged here
+        "op_phase_percentiles": daemon_phase_pcts,
         # cache-tier hot-read arm: zipfian re-reads on a small hot set,
         # resident-hit path vs cold decode path on the SAME window (same
         # schedule, same cluster); tier_perf is the aggregated `tier`
@@ -1074,14 +1083,54 @@ def daemon_path_bench() -> int:
                 [o.messenger.perf.dump() for o in cluster.osds.values()]
                 + [c.messenger.perf.dump()])
             objecter_perf = c.perf.dump()
+            # per-phase op-latency percentiles (p50/p99/p999 for
+            # queue_wait / ec_dispatch / subop_wait + wire tx/rx tails),
+            # one burst of small ops per arm: the OSD op trackers'
+            # raw-sample rings give exact phase percentiles, the `wire`
+            # µs histograms give the socket-io tails of the same window
+            phase_pcts = {}
+            if not fastpath:
+                burst = 24
+                small = payload[:512 << 10]
+                wires = [o.messenger for o in cluster.osds.values()] \
+                    + [c.messenger]
+
+                def _clear():
+                    for o in cluster.osds.values():
+                        o.ctx.op_tracker.clear_samples()
+                    for w in wires:
+                        w.perf.reset()
+
+                def _collect():
+                    merged = {}
+                    for o in cluster.osds.values():
+                        for ph, ss in \
+                                o.ctx.op_tracker.phase_samples().items():
+                            merged.setdefault(ph, []).extend(ss)
+                    out = {ph: _sample_percentiles(ss)
+                           for ph, ss in merged.items()}
+                    out["wire_tx_io_us"] = _hist_percentiles(
+                        [w.perf.get("tx_io_us") for w in wires])
+                    out["wire_rx_io_us"] = _hist_percentiles(
+                        [w.perf.get("rx_io_us") for w in wires])
+                    return out
+
+                _clear()
+                for i in range(burst):
+                    await c.put(pool, f"p{i}", small)
+                phase_pcts["put"] = _collect()
+                _clear()
+                for i in range(burst):
+                    await c.get(pool, f"p{i}")
+                phase_pcts["get"] = _collect()
             await c.stop()
-            return put_dt, get_dt, wire_perf, objecter_perf
+            return put_dt, get_dt, wire_perf, objecter_perf, phase_pcts
         finally:
             await cluster.stop()
 
-    put_dt, get_dt, _, _ = asyncio.run(go(True))
-    wire_put_dt, wire_get_dt, wire_perf, objecter_perf = asyncio.run(
-        go(False))
+    put_dt, get_dt, _, _, _ = asyncio.run(go(True))
+    (wire_put_dt, wire_get_dt, wire_perf, objecter_perf,
+     phase_pcts) = asyncio.run(go(False))
     print(json.dumps({
         "put_MBps": round(size / put_dt / 1e6, 1),
         "get_MBps": round(size / get_dt / 1e6, 1),
@@ -1091,8 +1140,45 @@ def daemon_path_bench() -> int:
         # the client `objecter` set for the measured window: resends /
         # timeouts / backoffs should be ZERO on a healthy bench host —
         # a nonzero count explains an anomalous MB/s sample
-        "objecter_perf": objecter_perf}))
+        "objecter_perf": objecter_perf,
+        # per-phase p50/p99/p999 (µs) from the TCP arm's op trackers +
+        # wire histograms — where each op's time goes, as tails
+        "op_phase_percentiles": phase_pcts}))
     return 0
+
+
+def _sample_percentiles(samples) -> dict:
+    """p50/p99/p999 (µs) over raw per-phase seconds samples (the shared
+    tracked_op reduction; bench merges across OSDs first)."""
+    from ceph_tpu.common.tracked_op import percentile_summary
+
+    return percentile_summary(samples)
+
+
+def _hist_percentiles(bucket_lists) -> dict:
+    """Approximate p50/p99/p999 from summed power-of-2 µs histograms
+    (bucket i counts observations with bit_length == i; the reported
+    value is the bucket's upper bound, 2^i - 1)."""
+    buckets = [0] * 32
+    for bl in bucket_lists:
+        if isinstance(bl, list):
+            for i, v in enumerate(bl):
+                buckets[i] += v
+    total = sum(buckets)
+
+    def pct(q: float) -> int:
+        if not total:
+            return 0
+        need = q * total
+        cum = 0
+        for i, v in enumerate(buckets):
+            cum += v
+            if cum >= need:
+                return (1 << i) - 1
+        return (1 << 31) - 1
+
+    return {"p50_us": pct(0.50), "p99_us": pct(0.99),
+            "p999_us": pct(0.999), "count": total}
 
 
 def hot_read_bench() -> int:

@@ -128,6 +128,9 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
     Option("ms_compress_min_size", OPT_SIZE, 0,
            desc="compress frames >= this size; 0 disables on-wire compression"),
     Option("ms_dispatch_throttle_bytes", OPT_SIZE, 100 << 20),
+    Option("ms_trace_propagation", OPT_BOOL, True,
+           desc="stamp trace-id/parent-span fields onto data-plane "
+                "messages so cross-daemon spans stitch into one tree"),
     Option("ms_auth_secret", OPT_STR, "",
            desc="shared cluster secret; non-empty enables cephx-style frames"),
     # osd
@@ -137,6 +140,18 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
     Option("osd_repair_delay", OPT_SECS, 0.5),
     Option("osd_op_num_shards", OPT_INT, 4),
     Option("osd_op_queue", OPT_STR, "wpq", enum_values=("wpq", "mclock")),
+    # op tracking + slow-op health (reference osd_op_complaint_time /
+    # osd_op_history_size, TrackedOp.h)
+    Option("osd_op_complaint_time", OPT_SECS, 2.0,
+           desc="ops older than this raise SLOW_OPS and join the "
+                "historic slow ring"),
+    Option("osd_op_history_size", OPT_INT, 64,
+           desc="completed ops retained by dump_historic_ops"),
+    Option("osd_op_history_slow_size", OPT_INT, 64,
+           desc="slow completions retained by dump_historic_slow_ops"),
+    Option("osd_op_tracker_max_events", OPT_INT, 128,
+           desc="timeline events retained per tracked op (bound against "
+                "stuck-op timeline growth)"),
     Option("osd_scrub_auto", OPT_BOOL, False),
     # cache tier (osd.yaml.in osd_tier_promote_max_*; pg_pool_t
     # hit_set_*/target_max_bytes/cache_target_full_ratio defaults —

@@ -35,9 +35,21 @@ class Context:
         self.perf = PerfCountersCollection()
         self.log = Log(self.conf, sink=log_sink, name=name)
         self.asok = AdminSocket(self)
-        self.op_tracker = OpTracker()
+        # op tracker sized/thresholded by config (reference
+        # osd_op_complaint_time / osd_op_history_size); its `optracker`
+        # perf set joins the daemon collection so per-phase latencies
+        # ride `perf dump` and the mgr exporter
+        self.op_tracker = OpTracker(
+            history_size=int(self.conf.get("osd_op_history_size", 64) or 64),
+            history_slow_size=int(
+                self.conf.get("osd_op_history_slow_size", 64) or 64),
+            slow_threshold=float(
+                self.conf.get("osd_op_complaint_time", 2.0) or 2.0),
+            max_events=int(
+                self.conf.get("osd_op_tracker_max_events", 128) or 128))
+        self.perf.add(self.op_tracker.perf)
         self.op_tracker.register_asok(self.asok)
-        self.tracer = Tracer()
+        self.tracer = Tracer(service=name)
         self.tracer.register_asok(self.asok)
 
     def dout(self, subsys: str, level: int, message: str) -> None:

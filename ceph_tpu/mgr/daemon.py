@@ -58,6 +58,15 @@ class MgrDaemon:
         # enabled when the mgr knows the mons and conf turns them on
         self.mon_addrs = mon_addrs
         self._modules_task: Optional[asyncio.Task] = None
+        self._health_task: Optional[asyncio.Task] = None
+        # the mon's latest aggregated health document (polled when
+        # mon_addrs is known): /metrics renders it as
+        # ceph_health_status + per-check ceph_health_check series.
+        # _health_stamp gates staleness: a mon outage must surface as
+        # HEALTH_ERR/MON_UNREACHABLE, never as the last-known OK frozen
+        # in the exporter
+        self.latest_health: Dict = {}
+        self._health_stamp = 0.0
         self.balancer_rounds = 0
         self.autoscaler_changes = 0
         # the mgr's OWN perf sets, rendered into /metrics under
@@ -76,11 +85,16 @@ class MgrDaemon:
                                or self.conf.get("mgr_pg_autoscaler", False)):
             self._modules_task = asyncio.get_running_loop().create_task(
                 self._run_modules())
+        if self.mon_addrs:
+            self._health_task = asyncio.get_running_loop().create_task(
+                self._poll_health())
         return self.addr
 
     async def stop(self) -> None:
         if self._modules_task:
             self._modules_task.cancel()
+        if self._health_task:
+            self._health_task.cancel()
         if self._http:
             self._http.close()
             try:
@@ -136,6 +150,47 @@ class MgrDaemon:
                     continue  # mon unreachable this tick: try again
         finally:
             await client.stop()
+
+    async def _poll_health(self) -> None:
+        """Poll the mon's aggregated health (HealthMonitor answer) on
+        the report cadence so /metrics carries cluster health alongside
+        the per-daemon perf sets."""
+        from ceph_tpu.rados.client import RadosClient
+
+        interval = float(self.conf.get("mgr_health_interval", 1.0) or 1.0)
+        # start the staleness clock NOW: a mon that is down from mgr
+        # startup must surface as MON_UNREACHABLE, not as an absent
+        # health series no alert rule ever matches
+        if not self._health_stamp:
+            self._health_stamp = time.monotonic()
+        # client bring-up retries too: a mon down AT MGR STARTUP must
+        # not kill the poll task for good (the exporter would freeze on
+        # MON_UNREACHABLE even after the mons recover)
+        client = None
+        try:
+            while True:
+                await asyncio.sleep(interval)
+                try:
+                    if client is None:
+                        client = RadosClient(self.mon_addrs, self.conf)
+                        await client.start()
+                    self.latest_health = await client.get_health()
+                    self._health_stamp = time.monotonic()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    if client is not None:
+                        try:
+                            await client.stop()
+                        except Exception:
+                            pass
+                        client = None
+                    continue  # unreachable: staleness gate handles it
+        finally:
+            if client is not None:
+                await client.stop()
+
+    _HEALTH_STATUS = {"HEALTH_OK": 0, "HEALTH_WARN": 1, "HEALTH_ERR": 2}
 
     async def _dispatch(self, conn, msg) -> None:
         if isinstance(msg, MMgrReport):
@@ -275,6 +330,33 @@ class MgrDaemon:
                     elif isinstance(value, (int, float)):
                         typed(metric)
                         lines.append(f'{metric}{{daemon="{name}"}} {value}')
+        # cluster health (mon HealthMonitor aggregation): status gauge
+        # (0 OK / 1 WARN / 2 ERR) + one series per raised check, so
+        # SLOW_OPS & co. alert straight off the exporter.  A poll that
+        # has not succeeded for several intervals means the MON is
+        # unreachable — export THAT, not a frozen last-known HEALTH_OK.
+        health = self.latest_health
+        if self._health_stamp:
+            interval = float(self.conf.get("mgr_health_interval", 1.0)
+                             or 1.0)
+            if time.monotonic() - self._health_stamp > 5 * interval:
+                health = {"status": "HEALTH_ERR",
+                          "checks": {"MON_UNREACHABLE": {
+                              "severity": "error", "count": 1}}}
+        if health:
+            typed("ceph_health_status", "gauge")
+            lines.append(f"ceph_health_status "
+                         f"{self._HEALTH_STATUS.get(health.get('status'), 0)}")
+            for name, c in sorted((health.get("checks") or {}).items()):
+                typed("ceph_health_check", "gauge")
+                sev = c.get("severity", "warning")
+                lines.append(
+                    f'ceph_health_check{{check="{name}",'
+                    f'severity="{sev}"}} {int(c.get("count", 1) or 1)}')
+            for name in sorted(health.get("muted") or {}):
+                typed("ceph_health_check_muted", "gauge")
+                lines.append(
+                    f'ceph_health_check_muted{{check="{name}"}} 1')
         lines.append(f"ceph_mgr_daemons_reporting {len(self.reports)}")
         return "\n".join(lines) + "\n"
 

@@ -732,6 +732,13 @@ class BatchingQueue:
             br = self._breakers[kind] = _LaneBreaker()
         return br
 
+    def open_lanes(self) -> List[str]:
+        """Lane names whose breaker is currently OPEN (serving from the
+        CPU mirrors) — the BREAKER_OPEN health check's feed."""
+        with self._breaker_lock:
+            return [k for k, b in self._breakers.items()
+                    if b.state == _LaneBreaker.OPEN]
+
     def _gauge_open_lanes_locked(self) -> None:
         self.perf.set("breaker_open_lanes",
                       sum(1 for b in self._breakers.values()

@@ -42,12 +42,16 @@ import uuid
 from typing import Dict, List, Optional, Tuple
 
 from ceph_tpu.common.perf_counters import PerfCounters, PerfCountersBuilder
+from ceph_tpu.common.tracing import Tracer
 from ceph_tpu.rados.messenger import BufferList, Messenger
 from ceph_tpu.rados.monclient import MonTargets
 from ceph_tpu.rados.types import (
     MAuthTicket,
     MAuthTicketReply,
     MConfigGet,
+    MGetHealth,
+    MHealthMute,
+    MHealthReply,
     MNotifyAck,
     MWatchNotify,
     MConfigReply,
@@ -192,6 +196,12 @@ class RadosClient:
         self.messenger = Messenger("client", self.conf, entity_type="client")
         # the `objecter` perf set (schema: _build_objecter_perf)
         self.perf = _build_objecter_perf()
+        # client-side trace ring: every logical data op roots a span here
+        # and propagates its context on the MOSDOp (ms_trace_propagation)
+        # so the primary's and peers' spans stitch under it — the client
+        # half of the end-to-end trace
+        self.tracer = Tracer(max_spans=512, service="client")
+        self._trace_on = bool(self.conf.get("ms_trace_propagation", True))
         self.osdmap: Optional[OSDMap] = None
         self._replies: Dict[str, asyncio.Future] = {}
         # reqid -> persistent op record; map changes and backoffs kick
@@ -294,7 +304,7 @@ class RadosClient:
                     traceback.print_exc()  # a broken callback must be loud
             return
         if isinstance(msg, (MMapReply, MCreatePoolReply, MConfigReply,
-                            MAuthTicketReply, MSnapOpReply)):
+                            MAuthTicketReply, MSnapOpReply, MHealthReply)):
             # the mon echoes our per-RPC tid (like MOSDOp's reqid): a reply
             # landing after its RPC timed out has a stale tid and is dropped
             # instead of fulfilling the next RPC's future
@@ -500,6 +510,23 @@ class RadosClient:
         await self._mon_rpc(MMarkDown(osd_id=osd_id))
         await self.refresh_map()
 
+    async def get_health(self, detail: bool = False) -> Dict:
+        """Cluster health from the mon's aggregation (reference `ceph
+        health [detail]`): map-derived checks (OSD_DOWN, PG_DEGRADED,
+        OSDMAP_FLAGS) plus daemon-reported ones (SLOW_OPS, BREAKER_OPEN,
+        TIER_OVER_TARGET), with the mute lifecycle applied — the mon is
+        the authority, not client-side osdmap math."""
+        reply = await self._mon_rpc(MGetHealth(detail=detail))
+        return reply.health
+
+    async def health_mute(self, check: str, ttl: float = 0.0,
+                          unmute: bool = False) -> Dict:
+        """`ceph health mute/unmute <check> [ttl]`: a muted check keeps
+        being tracked but no longer degrades the health status."""
+        reply = await self._mon_rpc(
+            MHealthMute(check=check, ttl=float(ttl), unmute=bool(unmute)))
+        return reply.health
+
     async def osd_set_flag(self, flag: str, on: bool = True) -> None:
         """`ceph osd set/unset <flag>` role: toggle a cluster-wide op
         gate ("pausewr", "pauserd", "full") in the OSDMap.  Clients
@@ -592,17 +619,35 @@ class RadosClient:
         # log's dup detection can recognize them (reference osd_reqid_t)
         op.reqid = uuid.uuid4().hex
         rec = _OpRecord(op, time.monotonic() + self.op_deadline)
+        # root span for the whole logical op (across every resend); its
+        # context rides the MOSDOp so the primary's osd_op span — and
+        # through it the k+m sub-write peers — stitch under ONE trace_id
+        span = None
+        if self._trace_on:
+            span = self.tracer.new_trace(f"client_op {op.op} {op.oid}")
+            span.tag("reqid", op.reqid).tag("pool", op.pool_id)
+            op.trace_id, op.span_id = span.context()
         self.perf.inc("op")
         self._inflight[op.reqid] = rec
         self.perf.set("inflight", len(self._inflight))
         try:
-            return await self._op_submit(op, rec, retries)
+            reply = await self._op_submit(op, rec, retries, span)
+            if span is not None:
+                span.tag("ok", True)
+            return reply
+        except BaseException as e:
+            if span is not None:
+                span.tag("ok", False).tag("error", type(e).__name__)
+            raise
         finally:
+            if span is not None:
+                span.finish()
             self._inflight.pop(op.reqid, None)
             self.perf.set("inflight", len(self._inflight))
 
     async def _op_submit(self, op: MOSDOp, rec: _OpRecord,
-                         retries: Optional[int]) -> MOSDOpReply:
+                         retries: Optional[int],
+                         span=None) -> MOSDOpReply:
         loop = asyncio.get_running_loop()
         last_error = "no attempt"
         last_code = 0
@@ -675,6 +720,9 @@ class RadosClient:
                 if sends:
                     self.perf.inc("resends")
                 sends += 1
+                if span is not None:
+                    span.event("resend" if sends > 1
+                               else f"sent to osd.{primary}")
                 await self.messenger.send(self.osdmap.addr_of(primary), op)
                 timeout = min(float(self.op_timeout),
                               max(0.05, rec.deadline - time.monotonic()))

@@ -165,6 +165,11 @@ def _build_wire_perf() -> PerfCounters:
                       "blob frames reusing an app-level crc on the wire")
     b.add_u64_counter("rx_batches", "multi-frame rx dispatch batches")
     b.add_histogram("rx_batch_msgs", "messages per rx dispatch batch")
+    # µs histograms of the socket-io longrunavgs: tail-latency
+    # percentiles (p50/p99/p999) come out of the power-of-2 buckets, so
+    # the BENCH record reports wire tx/rx TAILS, not just means
+    b.add_histogram("tx_io_us", "socket write+drain µs per flush window")
+    b.add_histogram("rx_io_us", "payload read µs per frame")
     return b.create_perf_counters()
 
 BANNER = b"ceph_tpu msgr v2\n"
@@ -352,6 +357,11 @@ def _pack_fixed(msg: Any, fields, blob_attr=None) -> bytes:
     return b"".join(parts)
 
 
+def _default_copy(v):
+    return list(v) if isinstance(v, list) else (
+        dict(v) if isinstance(v, dict) else v)
+
+
 def _unpack_fixed(cls, payload: bytes, blob: Any):
     obj = cls.__new__(cls)
     d = obj.__dict__
@@ -363,11 +373,19 @@ def _unpack_fixed(cls, payload: bytes, blob: Any):
     fixed_names = {n for n, _ in cls.FIXED_FIELDS}
     for k, v in defaults.items():
         if k not in fixed_names:
-            d[k] = list(v) if isinstance(v, list) else (
-                dict(v) if isinstance(v, dict) else v)
+            d[k] = _default_copy(v)
     off = 0
     mv = memoryview(payload)
-    for name, kind in cls.FIXED_FIELDS:
+    for idx, (name, kind) in enumerate(cls.FIXED_FIELDS):
+        if off >= len(payload):
+            # truncated tail: the sender's FIXED_FIELDS list was SHORTER
+            # — an old build predating trailing additions like the
+            # trace-id pair.  Default the unsent remainder (the
+            # fixed-layout analog of the reference's versioned-decode
+            # "new fields default" rule); new fields MUST append.
+            for tail_name, _ in cls.FIXED_FIELDS[idx:]:
+                d[tail_name] = _default_copy(defaults[tail_name])
+            break
         st = _FIX.get(kind)
         if st is not None:
             d[name] = st.unpack_from(payload, off)[0]
@@ -1184,6 +1202,7 @@ class Connection:
                     perf.hinc("tx_flush_frames", frames)
                     perf.hinc("tx_flush_bytes", nbytes)
                     gen = self.transport_gen
+                    t_io = time.monotonic()
                     try:
                         with perf.time_avg("tx_io"):
                             self.writer.writelines(segs)
@@ -1213,6 +1232,8 @@ class Connection:
                         await self.close(gen)
                         raise
                     perf.inc("tx_bytes", nbytes)
+                    perf.hinc("tx_io_us",
+                              (time.monotonic() - t_io) * 1e6)
                     if fut is not None and not fut.done():
                         fut.set_result(None)
         finally:
@@ -1442,7 +1463,9 @@ class Connection:
             self.messenger.dispatch_throttle.put(cost)
             raise
         perf = self.messenger.perf
-        perf.tinc("rx_io", time.monotonic() - t_io)
+        rx_dt = time.monotonic() - t_io
+        perf.tinc("rx_io", rx_dt)
+        perf.hinc("rx_io_us", rx_dt * 1e6)
         perf.inc("rx_bytes", _HDR.size + length)
         return (type_id, version, seq, payload, cost, blob,
                 bool(flags & FLAG_FIXED), blob_verified)

@@ -51,7 +51,10 @@ from ceph_tpu.rados.types import (
     MDeletePool,
     MForward,
     MForwardReply,
+    MGetHealth,
     MGetMap,
+    MHealthMute,
+    MHealthReply,
     MMapReply,
     MMarkDown,
     MMonElection,
@@ -111,6 +114,19 @@ class Monitor:
         kr = TicketKeyring()
         kr.keys = self.keyserver.secrets
         self.messenger.keyring = kr
+        # HealthMonitor state (reference src/mon/HealthMonitor.cc): the
+        # per-OSD health reports pushed on liveness pings (only the
+        # LEADER holds them — peons forward pings there) and the mute
+        # lifecycle: check name -> monotonic expiry (inf = until
+        # unmuted).  Mutes are paxos-replicated (rebased remaining-ttl
+        # in the snapshot) so a leader change keeps them; declared
+        # BEFORE the state recovery below, which may restore them.
+        self._health_reports: Dict[int, Dict] = {}  # osd -> {checks, stamp}
+        self._health_mutes: Dict[str, float] = {}
+        # (epoch, checks) memo for the per-PG degradation sweep — a pure
+        # function of the map, recomputed only when the epoch moves (the
+        # mgr polls health at ~1 Hz)
+        self._pg_health_memo: Tuple[int, Dict[str, Dict]] = (-1, {})
         # recover committed state from a previous life
         _, latest = self.store.latest()
         if latest is not None:
@@ -143,6 +159,15 @@ class Monitor:
     # -- replicated state (de)serialization ----------------------------------
 
     def _snapshot_state(self) -> bytes:
+        # mutes replicate as REMAINING seconds (None = until unmuted):
+        # monotonic clocks don't transfer across processes, so the
+        # receiver rebases onto its own clock (the HitSetArchive.decode
+        # discipline) — a leader change must not silently drop an
+        # operator's mutes
+        now = time.monotonic()
+        mutes = {name: (None if expiry == float("inf")
+                        else max(0.0, expiry - now))
+                 for name, expiry in self._health_mutes.items()}
         return pickle.dumps(
             {
                 "osdmap": self.osdmap,
@@ -151,6 +176,7 @@ class Monitor:
                 "next_pool_id": self._next_pool_id,
                 "auth_keys": (self.keyserver.current_id,
                               self.keyserver.export_keys()),
+                "health_mutes": mutes,
             },
             protocol=5,
         )
@@ -163,6 +189,12 @@ class Monitor:
         self.cluster_conf = state["cluster_conf"]
         self._next_osd_id = max(self._next_osd_id, state["next_osd_id"])
         self._next_pool_id = max(self._next_pool_id, state["next_pool_id"])
+        mutes = state.get("health_mutes")
+        if mutes is not None:
+            now = time.monotonic()
+            self._health_mutes = {
+                name: (float("inf") if rem is None else now + rem)
+                for name, rem in mutes.items()}
         auth = state.get("auth_keys")
         if auth and auth[0] >= self.keyserver.current_id:
             # adopt the quorum's rotating secrets: every mon must seal and
@@ -253,6 +285,155 @@ class Monitor:
             "map_epoch": self.osdmap.epoch,
             "paxos_version": self.store.last_committed,
         }
+
+    # -- health (HealthMonitor role, reference src/mon/HealthMonitor.cc) ----
+
+    def _map_health_checks(self) -> Dict[str, Dict]:
+        """Checks derivable from the map alone (the half tools/ceph.py
+        used to fake client-side): OSD_DOWN/OSD_OUT, OSDMAP_FLAGS, and
+        per-PG degradation computed exactly as the data path places."""
+        m = self.osdmap
+        checks: Dict[str, Dict] = {}
+        down = sorted(o.osd_id for o in m.osds.values() if not o.up)
+        if down:
+            checks["OSD_DOWN"] = {
+                "severity": "warning",
+                "summary": f"{len(down)} osds down: {down}",
+                "osds": down}
+        out = sorted(o.osd_id for o in m.osds.values() if not o.in_cluster)
+        if out:
+            checks["OSD_OUT"] = {
+                "severity": "warning",
+                "summary": f"{len(out)} osds out: {out}",
+                "osds": out}
+        flags = sorted(getattr(m, "flags", []) or [])
+        if flags:
+            checks["OSDMAP_FLAGS"] = {
+                "severity": "warning",
+                "summary": f"flags set: {','.join(flags)}",
+                "flags": flags}
+        checks.update(self._pg_health_checks())
+        return checks
+
+    def _pg_health_checks(self) -> Dict[str, Dict]:
+        """The per-PG degradation sweep, memoized per osdmap epoch: a
+        pure function of the map, and the mgr polls health at ~1 Hz —
+        O(total_pgs) CRUSH work must not recur on an unchanged map."""
+        m = self.osdmap
+        if self._pg_health_memo[0] == m.epoch:
+            # shallow-copy the entries: callers annotate them (mute
+            # expiry, detail stripping) and must not mutate the memo
+            return {k: dict(v) for k, v in self._pg_health_memo[1].items()}
+        degraded: List[str] = []
+        incomplete: List[str] = []
+        for pool in m.pools.values():
+            for pg in range(pool.pg_num):
+                acting = m.pg_to_acting(pool, pg)
+                live = [a for a in acting if a != CRUSH_ITEM_NONE]
+                if len(live) == len(acting):
+                    continue
+                pgid = f"{pool.pool_id}.{pg:x}"
+                if len(live) >= pool.min_size:
+                    degraded.append(pgid)
+                else:
+                    incomplete.append(pgid)
+        checks: Dict[str, Dict] = {}
+        if degraded:
+            checks["PG_DEGRADED"] = {
+                "severity": "warning",
+                "summary": f"{len(degraded)} pgs degraded",
+                "pgs": degraded[:32]}
+        if incomplete:
+            checks["PG_INCOMPLETE"] = {
+                "severity": "error",
+                "summary": f"{len(incomplete)} pgs below min_size "
+                           f"(unserviceable)",
+                "pgs": incomplete[:32]}
+        self._pg_health_memo = (m.epoch, checks)
+        return {k: dict(v) for k, v in checks.items()}
+
+    def _daemon_health_checks(self) -> Dict[str, Dict]:
+        """Aggregate the OSD-pushed reports: same-named checks merge
+        (counts sum, oldest age wins, per-daemon detail concatenates).
+        Reports from daemons the map says are down — or stale past a few
+        grace periods — are dropped, so a dead OSD cannot wedge a check
+        raised forever."""
+        now = time.monotonic()
+        cutoff = now - max(3.0 * self._grace, 5.0)
+        merged: Dict[str, Dict] = {}
+        for osd_id, rec in list(self._health_reports.items()):
+            info = self.osdmap.osds.get(osd_id)
+            if rec["stamp"] < cutoff or info is None or not info.up:
+                self._health_reports.pop(osd_id, None)
+                continue
+            for name, check in rec["checks"].items():
+                agg = merged.get(name)
+                if agg is None:
+                    agg = merged[name] = {
+                        "severity": check.get("severity", "warning"),
+                        "count": 0, "oldest_age": 0.0,
+                        "daemons": [], "detail": []}
+                agg["count"] += int(check.get("count", 1) or 1)
+                agg["oldest_age"] = max(agg["oldest_age"],
+                                        float(check.get("oldest_age", 0.0)
+                                              or 0.0))
+                agg["daemons"].append(f"osd.{osd_id}")
+                if check.get("severity") == "error":
+                    agg["severity"] = "error"
+                for line in (check.get("detail") or [])[:8]:
+                    agg["detail"].append(f"osd.{osd_id}: {line}")
+                if not check.get("detail"):
+                    agg["detail"].append(
+                        f"osd.{osd_id}: {check.get('summary', name)}")
+        for name, agg in merged.items():
+            if name == "SLOW_OPS":
+                agg["summary"] = (
+                    f"{agg['count']} slow ops, oldest one blocked for "
+                    f"{agg['oldest_age']:.1f} sec, "
+                    f"daemons {sorted(set(agg['daemons']))} have slow ops")
+            else:
+                agg["summary"] = (f"{name} on "
+                                  f"{sorted(set(agg['daemons']))}")
+        return merged
+
+    def health_summary(self, detail: bool = False) -> Dict:
+        """The aggregated health document `ceph -s` / `ceph health
+        detail` render: map-derived + daemon-reported checks, with the
+        mute lifecycle applied (muted checks are listed separately and
+        do not degrade the status)."""
+        now = time.monotonic()
+        for name, expiry in list(self._health_mutes.items()):
+            if expiry != float("inf") and now >= expiry:
+                del self._health_mutes[name]
+        checks = self._map_health_checks()
+        checks.update(self._daemon_health_checks())
+        if not detail:
+            for c in checks.values():
+                c.pop("detail", None)
+        muted = {}
+        for name in list(checks):
+            if name in self._health_mutes:
+                expiry = self._health_mutes[name]
+                entry = checks.pop(name)
+                entry["expires_in"] = (round(expiry - now, 1)
+                                       if expiry != float("inf") else 0.0)
+                muted[name] = entry
+        if any(c.get("severity") == "error" for c in checks.values()):
+            status = "HEALTH_ERR"
+        elif checks:
+            status = "HEALTH_WARN"
+        else:
+            status = "HEALTH_OK"
+        return {"status": status, "checks": checks, "muted": muted,
+                "mutes": sorted(self._health_mutes)}
+
+    def _handle_health_mute(self, msg: MHealthMute) -> MHealthReply:
+        if msg.unmute:
+            self._health_mutes.pop(msg.check, None)
+        elif msg.check:
+            self._health_mutes[msg.check] = (
+                time.monotonic() + msg.ttl if msg.ttl > 0 else float("inf"))
+        return MHealthReply(tid=msg.tid, health=self.health_summary())
 
     # -- elections -----------------------------------------------------------
 
@@ -551,9 +732,14 @@ class Monitor:
 
     # -- dispatch ------------------------------------------------------------
 
+    # MGetHealth/MHealthMute ride the leader-forward path too: only the
+    # leader holds the OSD-pushed health reports (pings forward there),
+    # so a peon answering from its own empty report map would render a
+    # degraded cluster HEALTH_OK
     WRITE_TYPES = (MOsdBoot, MCreatePool, MDeletePool, MMarkDown,
                    MConfigSet, MOSDFailure,
-                   MOSDPGTemp, MSetUpmap, MPoolSet, MSnapOp, MOSDSetFlag)
+                   MOSDPGTemp, MSetUpmap, MPoolSet, MSnapOp, MOSDSetFlag,
+                   MGetHealth, MHealthMute)
 
     @staticmethod
     def _conn_is_daemon(conn) -> bool:
@@ -678,6 +864,16 @@ class Monitor:
 
     async def _process_ping(self, msg: MPing) -> None:
         self._last_ping[msg.osd_id] = time.monotonic()
+        # daemon-observed health rides the ping (v3 field; older daemons
+        # simply never report): the LATEST report per OSD wins, and an
+        # empty dict actively CLEARS that OSD's checks
+        health = getattr(msg, "health", None)
+        if health is not None:
+            if health:
+                self._health_reports[msg.osd_id] = {
+                    "checks": dict(health), "stamp": time.monotonic()}
+            else:
+                self._health_reports.pop(msg.osd_id, None)
         info = self.osdmap.osds.get(msg.osd_id)
         if info is not None and not info.up:
             info.up = True
@@ -702,6 +898,13 @@ class Monitor:
         Re-executions (messenger replay, forward retry) are suppressed by
         tid; a failed consensus round rolls the in-memory state back so a
         write reported failed cannot leak into a later snapshot."""
+        # health QUERIES ride the leader-forward plumbing but are reads:
+        # no state snapshot (a full osdmap pickle per mgr health poll),
+        # no replay-dedup entry (each answer is recomputed; caching one
+        # would also evict a genuine write's).  Mutes stay on the write
+        # path — they replicate.
+        if isinstance(msg, MGetHealth):
+            return await self._process_write_inner(msg)
         tid = getattr(msg, "tid", "")
         if tid and tid in self._applied_tids:
             return self._applied_tids[tid]
@@ -726,11 +929,29 @@ class Monitor:
         self.cluster_conf = state["cluster_conf"]
         self._next_osd_id = state["next_osd_id"]
         self._next_pool_id = state["next_pool_id"]
+        # mutes roll back too: a mute whose commit failed must not leak
+        # into a later snapshot (the operator was told it failed)
+        mutes = state.get("health_mutes")
+        if mutes is not None:
+            now = time.monotonic()
+            self._health_mutes = {
+                name: (float("inf") if rem is None else now + rem)
+                for name, rem in mutes.items()}
 
     async def _process_write_inner(self, msg: Any) -> Any:
         if isinstance(msg, MPing):  # forwarded liveness
             await self._process_ping(msg)
             return MMapReply(osdmap=self.osdmap)
+        if isinstance(msg, MGetHealth):
+            return MHealthReply(
+                tid=msg.tid,
+                health=self.health_summary(detail=msg.detail))
+        if isinstance(msg, MHealthMute):
+            reply = self._handle_health_mute(msg)
+            # replicate: an operator's mute must survive a leader change
+            # (the snapshot carries rebased remaining-ttls)
+            await self._commit_state()
+            return reply
         if isinstance(msg, MOsdBoot):
             return await self._process_boot(msg)
         if isinstance(msg, MCreatePool):
@@ -1051,6 +1272,14 @@ class Monitor:
         tid = getattr(msg, "tid", "")
         if isinstance(msg, (MCreatePool, MDeletePool)):
             return MCreatePoolReply(tid=tid, ok=False, error=error)
+        if isinstance(msg, (MGetHealth, MHealthMute)):
+            # no quorum IS a health statement: answer with what this mon
+            # can see locally rather than timing the client out
+            h = self.health_summary()
+            h.setdefault("checks", {})["MON_NO_QUORUM"] = {
+                "severity": "error", "summary": error}
+            h["status"] = "HEALTH_ERR"
+            return MHealthReply(tid=tid, health=h)
         if isinstance(msg, MConfigSet):
             return MConfigReply(tid=tid, ok=False, error=error)
         if isinstance(msg, (MMarkDown, MGetMap, MPing, MOSDFailure,

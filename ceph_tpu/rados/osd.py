@@ -256,6 +256,10 @@ class OSD:
         # the admin socket starts only when admin_socket_dir is configured
         self.ctx = Context(f"osd.{osd_id}",
                            conf if isinstance(conf, dict) else None)
+        # stamp trace-id/parent-span context onto outbound data-plane
+        # messages (cross-daemon stitching); decode always tolerates
+        # absent fields, so this only gates the SENDING side
+        self._trace_on = bool(self.conf.get("ms_trace_propagation", True))
         self.perf = self.ctx.perf.add(
             PerfCountersBuilder("osd")
             .add_u64_counter("op", "client ops")
@@ -494,6 +498,11 @@ class OSD:
         self._hb_task = loop.create_task(self._heartbeat_loop(interval))
         self.op_queue.start()
         self.ctx.name = f"osd.{self.osd_id}"
+        self.ctx.tracer.service = f"osd.{self.osd_id}"
+        # mon-distributed config landed after the Context was built:
+        # re-apply the op-tracker thresholds it governs
+        self.ctx.op_tracker.slow_threshold = float(
+            self.conf.get("osd_op_complaint_time", 2.0) or 2.0)
         if self._ec_queue is not None:
             # in-process execute() works without the unix socket, so the
             # timeline command registers whether or not asok_dir is set
@@ -575,6 +584,49 @@ class OSD:
                 self.messenger.ticket = None
                 self.messenger.session_key = None
 
+    def _health_checks(self) -> Dict[str, Dict]:
+        """Daemon-observed health checks riding the liveness ping (the
+        reference's OSD -> mon health report path): SLOW_OPS from the op
+        tracker's complaint aging, BREAKER_OPEN from the device-dispatch
+        circuit breaker, TIER_OVER_TARGET from planar residency vs the
+        agent's budget.  Empty dict = healthy; the mon clears a check
+        when the next report omits it."""
+        checks: Dict[str, Dict] = {}
+        slow = self.ctx.op_tracker.slow_op_summary()
+        if slow["count"]:
+            checks["SLOW_OPS"] = {
+                "severity": "warning",
+                "summary": f"{slow['count']} slow ops, oldest "
+                           f"{slow['oldest_age']:.1f}s "
+                           f"(complaint time {slow['complaint_time']:g}s)",
+                "count": slow["count"],
+                "oldest_age": slow["oldest_age"],
+                "detail": [f"{o['description']} age {o['age']:.1f}s "
+                           f"last event {o['last_event']}"
+                           for o in slow["ops"]],
+            }
+        if self._ec_queue is not None:
+            lanes = self._ec_queue.open_lanes()
+            if lanes:
+                checks["BREAKER_OPEN"] = {
+                    "severity": "warning",
+                    "summary": f"{len(lanes)} device-dispatch lanes open "
+                               f"(CPU fallback): {sorted(lanes)}",
+                    "lanes": sorted(lanes),
+                }
+        if self._planar is not None:
+            target = self._tier_effective_target()
+            resident = self._planar.resident_bytes
+            if target and resident > target:
+                checks["TIER_OVER_TARGET"] = {
+                    "severity": "warning",
+                    "summary": f"tier resident {resident} bytes over "
+                               f"target {target}",
+                    "resident_bytes": resident,
+                    "target_bytes": target,
+                }
+        return checks
+
     async def _ping_loop(self, interval: float) -> None:
         ticks = 0
         while not self._stopped:
@@ -583,7 +635,8 @@ class OSD:
                     self.mons.current,
                     MPing(osd_id=self.osd_id,
                           epoch=self.osdmap.epoch if self.osdmap else 0,
-                          addr=self.addr or ("", 0)),
+                          addr=self.addr or ("", 0),
+                          health=self._health_checks()),
                 )
             except TRANSPORT_ERRORS:
                 self.mons.rotate()  # that mon looks dead
@@ -831,6 +884,11 @@ class OSD:
             if msg.op != "write" \
                     and not isinstance(msg.data, (bytes, bytearray)):
                 msg.data = as_bytes(msg.data)
+            # op tracking starts at ARRIVAL (not at dequeue) so the
+            # queued_for_pg -> reached_pg gap measures real queue wait;
+            # when the client propagated a trace context, our op span
+            # JOINS it as a child — the cross-daemon stitch point
+            tracked = self._track_client_op(msg)
             # client ops ride the sharded op queue: PG-pinned shard keeps
             # per-PG order; scheduler arbitrates client vs recovery
             # classes; a full queue blocks HERE so the messenger stops
@@ -849,10 +907,24 @@ class OSD:
             op_class = {"repair": CLASS_RECOVERY,
                         "deep-scrub": CLASS_BEST_EFFORT}.get(
                 msg.op, CLASS_CLIENT)
-            await self.op_queue.enqueue(
-                pg_key, lambda: self._handle_client_op(conn, msg),
-                op_class, cost=max(1, len(msg.data) // 4096),
-            )
+            try:
+                await self.op_queue.enqueue(
+                    pg_key, lambda: self._handle_client_op(conn, msg),
+                    op_class, cost=max(1, len(msg.data) // 4096),
+                )
+            except BaseException:
+                # cancelled (or failed) while parked on a full queue:
+                # the handler will never run, so the tracked op must not
+                # sit in the in-flight map forever raising SLOW_OPS —
+                # and its span must still record (spans only land in the
+                # ring on finish)
+                if tracked.done_at is None:
+                    tracked.mark_event("enqueue_aborted")
+                    if tracked.trace is not None:
+                        tracked.trace.tag("aborted", True)
+                        tracked.trace.finish()
+                    tracked.finish()
+                raise
         elif isinstance(msg, MECSubWrite):
             await self._handle_sub_write(msg)
         elif isinstance(msg, MECSubRead):
@@ -1696,9 +1768,49 @@ class OSD:
             return op.pool_id
         return (op.pool_id << 20) | self.osdmap.object_to_pg(pool, op.oid)
 
-    async def _handle_client_op(self, conn, op: MOSDOp) -> None:
+    def _track_client_op(self, op: MOSDOp):
+        """TrackedOp + trace span for one arriving client op.  The span
+        joins the client's propagated trace context when one rode the
+        wire (ms_trace_propagation), else roots a fresh trace; the
+        TrackedOp carries it so the asok timeline and the stitched span
+        tree name the same op.  Attached as a private attribute — resends
+        overwrite it, and the attribute never rides a wire encode (fixed
+        layouts enumerate FIXED_FIELDS; the only pickled MOSDOp variant,
+        `multi`, is deep-copied by the local fastpath before delivery)."""
+        prev = getattr(op, "_tracked", None)
+        if prev is not None and prev.done_at is None:
+            # a resend/duplicate delivery of the SAME op object (local
+            # fastpath hands by reference) displaces the prior record:
+            # finish it (and its span — spans only record on finish) so
+            # neither can dangle forever
+            if prev.trace is not None:
+                prev.trace.finish()
+            prev.finish()
+        t_tid = getattr(op, "trace_id", "")
+        if t_tid:
+            span = self.ctx.tracer.join(f"osd_op {op.op}", t_tid,
+                                        getattr(op, "span_id", "") or None)
+        else:
+            span = self.ctx.tracer.new_trace(f"osd_op {op.op}")
+        span.tag("osd", self.osd_id)
+        if op.reqid:
+            span.tag("reqid", op.reqid)
         tracked = self.ctx.op_tracker.create(
-            f"osd_op({op.op} {op.pool_id}:{op.oid})")
+            f"osd_op({op.op} {op.pool_id}:{op.oid})", reqid=op.reqid,
+            trace=span)
+        if op.op == "notify":
+            # a notify legitimately parks for its whole watcher-ack
+            # gather window — aging it would raise SLOW_OPS on every
+            # notify with one sluggish watcher
+            tracked.complaint_ok = False
+        tracked.mark_event("queued_for_pg")
+        op._tracked = tracked
+        return tracked
+
+    async def _handle_client_op(self, conn, op: MOSDOp) -> None:
+        tracked = getattr(op, "_tracked", None)
+        if tracked is None or tracked.done_at is not None:
+            tracked = self._track_client_op(op)
         t0 = time.monotonic()
         self.perf.inc("op")
         if op.op == "write":
@@ -1709,6 +1821,9 @@ class OSD:
             await self._handle_client_op_inner(conn, op, tracked)
         finally:
             self.perf.tinc("op_lat", time.monotonic() - t0)
+            if tracked.trace is not None:
+                tracked.trace.finish()
+            tracked.mark_event("done")
             tracked.finish()
 
     # ops the backoff gate may drop-and-block (client data plane; admin
@@ -1780,8 +1895,16 @@ class OSD:
                     if reason == "queue"
                     else float(self.conf.get("osd_backoff_max", 3.0) or 3.0))
         self.perf.inc("backoffs_sent")
+        tracked = getattr(op, "_tracked", None)
+        b_tid = b_sid = ""
+        if self._trace_on and tracked is not None \
+                and tracked.trace is not None:
+            # the block rides the op's trace: the client sees WHY its op
+            # parked inside the same stitched tree
+            b_tid, b_sid = tracked.trace.context()
         msg = MOSDBackoff(op="block", pool_id=key[0], pg=key[1], id=bid,
-                          epoch=self.osdmap.epoch, duration=duration)
+                          epoch=self.osdmap.epoch, duration=duration,
+                          trace_id=b_tid, span_id=b_sid)
         try:
             await conn.send(msg)
         except TRANSPORT_ERRORS:
@@ -1909,6 +2032,7 @@ class OSD:
         # our epoch rides every reply: on retryable errors the client
         # fences its re-target on at least this epoch
         reply.map_epoch = self.osdmap.epoch if self.osdmap else 0
+        tracked.mark_event("commit_sent")
         try:
             await conn.send(reply)
         except ConnectionError:
@@ -2218,8 +2342,17 @@ class OSD:
         codec = self._codec(pool)
         sinfo = self._sinfo(pool)
         n = codec.get_chunk_count()
-        span = self.ctx.tracer.new_trace("ec write")
+        tracked = getattr(op, "_tracked", None)
+        parent = tracked.trace if tracked is not None else None
+        # the EC pipeline span is a CHILD of the op span (which itself
+        # joined the client's trace): the whole write renders as one tree
+        span = (parent.child("ec write") if parent is not None
+                else self.ctx.tracer.new_trace("ec write"))
         span.event("start ec write")
+
+        def mark(event: str) -> None:
+            if tracked is not None:
+                tracked.mark_event(event)
         # splice plan: chunk_off >= 0 means each shard splices `blobs[shard]`
         # into its stored blob at chunk_off (per-stripe RMW, the reference's
         # write plan ECTransaction.cc:37-95); -1 replaces the whole blob
@@ -2231,6 +2364,7 @@ class OSD:
         full_for_cache: Optional[bytes] = bytes(op.data)
         if op.offset >= 0:
             span.event("rmw read")
+            mark("rmw_read")
             # partial overwrite: read ONLY the stripes the write touches
             # (try_state_to_reads, ECBackend.cc:1915); the extent cache
             # pins recently decoded objects so back-to-back partial writes
@@ -2294,6 +2428,7 @@ class OSD:
         # task / unsolicited log reply) advancing the head across an await
         # would invalidate a version handed out earlier.
         planar = None
+        mark("ec_encode_dispatched")
         if self._planar is not None and chunk_off < 0:
             # full-object write: leave the shard rows planar-resident so
             # later decodes / repair re-encodes skip the unpack boundary
@@ -2307,6 +2442,7 @@ class OSD:
                                                queue=self._ec_queue,
                                                span=span)
         span.event("encoded")
+        mark("encoded")
         # one crc pass per shard, shared by the hinfo record and every
         # sub-write's chunk_crc (a fresh object's chained hinfo crc IS
         # the shard crc)
@@ -2331,23 +2467,33 @@ class OSD:
             if osd == CRUSH_ITEM_NONE:
                 continue
             if osd == self.osd_id:
-                # memoryview, not bytes(): ownership of the fresh
-                # encode-output row passes to the store (Owned marking
-                # in _apply_shard_write) — no per-shard copy
-                if self._apply_shard_write(
-                    op.pool_id, op.oid, shard,
-                    memoryview(np.ascontiguousarray(blobs[shard])), version,
-                    object_size, pg=pg, entry=entry, chunk_off=chunk_off,
-                    shard_size=shard_size, hinfo=hinfo_blob,
-                    prior_version=base_version,
-                    chunk_crc=(shard_crcs[shard]
-                               if shard_crcs is not None else None),
-                ):
-                    local_ok += 1
+                # the local shard gets a sub-write span of its own, so
+                # the stitched trace shows ALL k+m shard applies (the
+                # remote peers record theirs in their own rings)
+                with span.child(f"ec_sub_write s{shard}") as lsp:
+                    lsp.tag("osd", self.osd_id).tag("local", True)
+                    # memoryview, not bytes(): ownership of the fresh
+                    # encode-output row passes to the store (Owned
+                    # marking in _apply_shard_write) — no per-shard copy
+                    if self._apply_shard_write(
+                        op.pool_id, op.oid, shard,
+                        memoryview(np.ascontiguousarray(blobs[shard])),
+                        version,
+                        object_size, pg=pg, entry=entry,
+                        chunk_off=chunk_off,
+                        shard_size=shard_size, hinfo=hinfo_blob,
+                        prior_version=base_version,
+                        chunk_crc=(shard_crcs[shard]
+                                   if shard_crcs is not None else None),
+                    ):
+                        local_ok += 1
             else:
                 remote.append((shard, osd))
         q = self._collector(tid)
         sends = []
+        # trace propagation on the fan-out: each peer joins a child
+        # ec_sub_write span under OUR ec-write span (feature-gated)
+        w_tid, w_sid = (span.context() if self._trace_on else ("", ""))
         for shard, osd in remote:
             # memoryview: the shard row rides the messenger's blob lane
             # without a bytes() copy; crc reuses the per-shard pass above
@@ -2362,6 +2508,7 @@ class OSD:
                 shard_size=shard_size, hinfo=hinfo_blob,
                 prior_version=base_version,
                 from_osd=self.osd_id, epoch=self.osdmap.epoch,
+                trace_id=w_tid, span_id=w_sid,
             )
             sends.append(self.messenger.send(self.osdmap.addr_of(osd), msg))
         # CONCURRENT stripe fan-out: all k+m sub-writes enqueue and their
@@ -2375,8 +2522,11 @@ class OSD:
             elif not isinstance(got, TRANSPORT_ERRORS):
                 raise got  # framing bug etc: crash loudly (the _serve rule)
         span.event(f"sub writes sent ({sent})")
+        mark("sub_writes_sent")
+        mark("waiting_for_subops")
         replies = await self._gather(tid, q, sent)
         span.event("commit gathered")
+        mark("commit_gathered")
         span.finish()
         acks = local_ok + sum(1 for r in replies if r.ok)  # self + remote
         if acks < pool.min_size:
@@ -2557,6 +2707,9 @@ class OSD:
                             self.tier_perf.inc("resident_hit")
                             self.tier_perf.inc("resident_hit_bytes",
                                                len(data))
+                            t = getattr(op, "_tracked", None)
+                            if t is not None:
+                                t.mark_event("resident_hit")
                             return MOSDOpReply(ok=True, data=data,
                                                version=ent.object_version)
         available = {
@@ -2591,6 +2744,9 @@ class OSD:
             else:
                 remote.append((shard, osd))
         q = self._collector(tid)
+        tracked = getattr(op, "_tracked", None)
+        if tracked is not None:
+            tracked.mark_event("sub_reads_sent")
         sent = 0
         for shard, osd in remote:
             msg = MECSubRead(
@@ -2666,6 +2822,8 @@ class OSD:
                 self._cache_put(op.pool_id, op.oid, newest, got_planar)
                 return MOSDOpReply(ok=True, data=got_planar, version=newest)
         arrays = {s: np.frombuffer(c, dtype=np.uint8) for s, c in chunks.items()}
+        if tracked is not None:
+            tracked.mark_event("decode_dispatched")
         # scatter=True: the healthy-read fast path hands back a
         # BufferList of stripe VIEWS over the sub-read reply buffers —
         # the reply writev's them as one blob, no gather copy on the
@@ -2675,6 +2833,8 @@ class OSD:
         data = await decode_object_async(codec, self._sinfo(pool), arrays,
                                          object_size, queue=self._ec_queue,
                                          scatter=True)
+        if tracked is not None:
+            tracked.mark_event("decoded")
         if not isinstance(data, BufferList):
             # a scatter result is views over this read's rx buffers; the
             # RMW cache wants a stable contiguous copy — caching it would
@@ -3714,48 +3874,73 @@ class OSD:
         """Validate + apply one sub-write; the reply is the CALLER's to
         send (the group path batches a whole run of them so the replies
         coalesce into one flush window on the primary's connection)."""
-        ok = True
-        sender = getattr(msg, "from_osd", -1)
-        if sender >= 0 and self.osdmap is not None:
-            # interval fence (reference same_interval_since): refuse a
-            # sub-write from an OSD that is not this pg's primary in OUR
-            # map — a deposed primary with in-flight sub-ops must not
-            # complete a write concurrently with its successor.  Catch up
-            # first when the sender's map is newer than ours.
-            if msg.epoch > self.osdmap.epoch:
-                await self._fetch_full_map()
-            pool = self.osdmap.pools.get(msg.pool_id)
-            if pool is not None:
-                acting = self.osdmap.pg_to_acting(pool, msg.pg)
-                if (self._primary(pool, msg.pg, acting)
-                        not in (sender, None)):
-                    ok = False
-        if not ok:
-            pass
-        elif msg.chunk_crc and not getattr(msg, "_wire_verified", False) \
-                and not crc_verify_any(msg.chunk, msg.chunk_crc):
-            # _wire_verified: the frame layer already checked the blob
-            # against chunk_crc (the sender reused it as the wire crc) —
-            # a second pass over the same bytes proves nothing new
-            ok = False  # corrupted in flight
-        else:
-            entry = LogEntry.decode(msg.log_entry) if msg.log_entry else None
-            if entry is not None:
-                entry.version = tuple(entry.version)
-                entry.prior_version = tuple(entry.prior_version)
-            ok = self._apply_shard_write(
-                msg.pool_id, msg.oid, msg.shard, msg.chunk, msg.version,
-                msg.object_size, pg=msg.pg, entry=entry,
-                chunk_off=msg.chunk_off, shard_size=msg.shard_size,
-                hinfo=msg.hinfo, prior_version=msg.prior_version,
-                # just verified against the frame: reuse, don't re-crc
-                chunk_crc=msg.chunk_crc or None,
-            )
-            # another primary wrote this object: our cached decode is stale
-            self._cache_drop(msg.pool_id, msg.oid)
-            if ok:
-                self.perf.inc("subop_w")
-        return MECSubWriteReply(tid=msg.tid, shard=msg.shard, ok=ok)
+        # every sub-write is a first-class tracked op with a span that
+        # joins the primary's propagated `ec write` context — this is
+        # the peer leg of the client->primary->k+m stitched trace
+        t_tid = getattr(msg, "trace_id", "")
+        span = None
+        if t_tid:
+            span = self.ctx.tracer.join(
+                f"ec_sub_write s{msg.shard}", t_tid,
+                getattr(msg, "span_id", "") or None)
+            span.tag("osd", self.osd_id)
+        tracked = self.ctx.op_tracker.create(
+            f"ec_sub_write({msg.pool_id}.{msg.pg} {msg.oid} s{msg.shard})",
+            reqid=msg.tid, trace=span)
+        ok = False
+        try:
+            ok = True
+            sender = getattr(msg, "from_osd", -1)
+            if sender >= 0 and self.osdmap is not None:
+                # interval fence (reference same_interval_since): refuse a
+                # sub-write from an OSD that is not this pg's primary in
+                # OUR map — a deposed primary with in-flight sub-ops must
+                # not complete a write concurrently with its successor.
+                # Catch up first when the sender's map is newer than ours.
+                if msg.epoch > self.osdmap.epoch:
+                    await self._fetch_full_map()
+                pool = self.osdmap.pools.get(msg.pool_id)
+                if pool is not None:
+                    acting = self.osdmap.pg_to_acting(pool, msg.pg)
+                    if (self._primary(pool, msg.pg, acting)
+                            not in (sender, None)):
+                        ok = False
+            if not ok:
+                tracked.mark_event("refused_interval")
+            elif msg.chunk_crc and not getattr(msg, "_wire_verified", False) \
+                    and not crc_verify_any(msg.chunk, msg.chunk_crc):
+                # _wire_verified: the frame layer already checked the blob
+                # against chunk_crc (the sender reused it as the wire crc)
+                # — a second pass over the same bytes proves nothing new
+                ok = False  # corrupted in flight
+                tracked.mark_event("refused_crc")
+            else:
+                entry = LogEntry.decode(msg.log_entry) \
+                    if msg.log_entry else None
+                if entry is not None:
+                    entry.version = tuple(entry.version)
+                    entry.prior_version = tuple(entry.prior_version)
+                ok = self._apply_shard_write(
+                    msg.pool_id, msg.oid, msg.shard, msg.chunk, msg.version,
+                    msg.object_size, pg=msg.pg, entry=entry,
+                    chunk_off=msg.chunk_off, shard_size=msg.shard_size,
+                    hinfo=msg.hinfo, prior_version=msg.prior_version,
+                    # just verified against the frame: reuse, don't re-crc
+                    chunk_crc=msg.chunk_crc or None,
+                )
+                # another primary wrote this object: cached decode is stale
+                self._cache_drop(msg.pool_id, msg.oid)
+                tracked.mark_event("applied" if ok else "refused_splice")
+                if ok:
+                    self.perf.inc("subop_w")
+        finally:
+            if span is not None:
+                span.tag("ok", ok)
+                span.finish()
+            tracked.finish()
+        return MECSubWriteReply(tid=msg.tid, shard=msg.shard, ok=ok,
+                                trace_id=t_tid,
+                                span_id=getattr(msg, "span_id", ""))
 
     async def _handle_sub_write(self, msg: MECSubWrite) -> None:
         reply = await self._apply_sub_write(msg)
@@ -3950,30 +4135,42 @@ class OSD:
             pass
 
     def _apply_push(self, msg: MPushShard) -> None:
-        # a recovery push must never regress the object: the primary read
-        # and re-encoded at some version, but a client write may have
-        # landed here since — applying the stale push would bury the newer
-        # acked bytes in the rollback slot where the next write evicts
-        # them (the reference's recovery also refuses to move backward)
-        cur = self._store_read((msg.pool_id, msg.oid, msg.shard))
-        if cur is not None and cur[1].version > msg.version:
-            return
-        self.perf.inc("recovery_push")
-        self._cache_drop(msg.pool_id, msg.oid)
-        self._apply_shard_write(
-            msg.pool_id, msg.oid, msg.shard, msg.chunk, msg.version,
-            msg.object_size, hinfo=msg.hinfo,
-        )
-        if msg.xattrs:
-            try:
-                for name, value in msg.xattrs.items():
-                    if name == HashInfo.XATTR_KEY:
-                        # cls xattrs ride pushes, but a stale hinfo record
-                        # must never clobber the fresh one written above
-                        continue
-                    self.store.setattr((msg.pool_id, msg.oid, 0), name, value)
-            except NotImplementedError:
-                pass
+        # recovery pushes are first-class tracked ops too: a recovering
+        # OSD's dump_ops_in_flight shows what it is applying
+        tracked = self.ctx.op_tracker.create(
+            f"recovery_push({msg.pool_id} {msg.oid} s{msg.shard})")
+        try:
+            # a push must never regress the object: the primary read and
+            # re-encoded at some version, but a client write may have
+            # landed here since — applying the stale push would bury the
+            # newer acked bytes in the rollback slot where the next write
+            # evicts them (the reference's recovery also refuses to move
+            # backward)
+            cur = self._store_read((msg.pool_id, msg.oid, msg.shard))
+            if cur is not None and cur[1].version > msg.version:
+                tracked.mark_event("refused_stale")
+                return
+            self.perf.inc("recovery_push")
+            self._cache_drop(msg.pool_id, msg.oid)
+            self._apply_shard_write(
+                msg.pool_id, msg.oid, msg.shard, msg.chunk, msg.version,
+                msg.object_size, hinfo=msg.hinfo,
+            )
+            tracked.mark_event("applied")
+            if msg.xattrs:
+                try:
+                    for name, value in msg.xattrs.items():
+                        if name == HashInfo.XATTR_KEY:
+                            # cls xattrs ride pushes, but a stale hinfo
+                            # record must never clobber the fresh one
+                            # written above
+                            continue
+                        self.store.setattr((msg.pool_id, msg.oid, 0),
+                                           name, value)
+                except NotImplementedError:
+                    pass
+        finally:
+            tracked.finish()
 
     # -- peering (GetInfo/GetLog exchange, reference PeeringState) -----------
 
@@ -4271,13 +4468,17 @@ class OSD:
 
     async def _promote_object_inner(self, pool: PoolInfo, oid: str,
                                     data: bytes, version: int) -> None:
+        tracked = self.ctx.op_tracker.create(
+            f"tier_promote({pool.pool_id} {oid})")
         try:
+            tracked.mark_event("encode_dispatched")
             planar = await planar_encode_async(
                 self._codec(pool), self._sinfo(pool), data,
                 queue=self._ec_queue)
             if planar is None:
                 # codec not planar-eligible (mapped/bit-layout plugins)
                 self.tier_perf.inc("promote_skipped")
+                tracked.mark_event("skipped")
                 return
             # staleness gate: between the read and this install a write
             # may have landed.  The log check and the install below are
@@ -4294,6 +4495,7 @@ class OSD:
             if ent is not None and (ent.op != "write"
                                     or ent.object_version != version):
                 self.tier_perf.inc("promote_stale")
+                tracked.mark_event("stale")
                 return
             _, all_bits, n_rows, n_cols, pw = planar
             pkey = self._planar_key(pool.pool_id, oid)
@@ -4308,12 +4510,15 @@ class OSD:
             self._planar.memo_put(pkey, version, data)
             self.tier_perf.inc("promote")
             self.tier_perf.inc("promote_bytes", len(data))
+            tracked.mark_event("installed")
         except (asyncio.CancelledError, GeneratorExit):
             raise
         except Exception as e:
             self.tier_perf.inc("promote_skipped")
             self.ctx.log.error(
                 "osd", f"tier promote {oid}: {type(e).__name__}: {e}")
+        finally:
+            tracked.finish()
 
     def _replicate_hit_set(self, pool: PoolInfo, pg: int,
                            acting: List[int], arch: HitSetArchive) -> None:
@@ -4329,16 +4534,30 @@ class OSD:
         msg = MOSDPGHitSet(pool_id=pool.pool_id, pg=pg,
                            from_osd=self.osd_id, epoch=self.osdmap.epoch,
                            archive=arch.encode())
+        span = None
+        if self._trace_on:
+            span = self.ctx.tracer.new_trace("hitset push")
+            span.tag("osd", self.osd_id).tag("pg", f"{pool.pool_id}.{pg}")
+            msg.trace_id, msg.span_id = span.context()
 
         async def _send() -> None:
-            for osd in peers:
-                info = self.osdmap.osds.get(osd)
-                if info is None or not info.up:
-                    continue
-                try:
-                    await self.messenger.send(self.osdmap.addr_of(osd), msg)
-                except TRANSPORT_ERRORS:
-                    pass  # the peer catches the next rotation's push
+            tracked = self.ctx.op_tracker.create(
+                f"hitset_push({pool.pool_id}.{pg})")
+            try:
+                for osd in peers:
+                    info = self.osdmap.osds.get(osd)
+                    if info is None or not info.up:
+                        continue
+                    try:
+                        await self.messenger.send(
+                            self.osdmap.addr_of(osd), msg)
+                    except TRANSPORT_ERRORS:
+                        pass  # the peer catches the next rotation's push
+                tracked.mark_event("pushed")
+            finally:
+                tracked.finish()
+                if span is not None:
+                    span.finish()
 
         t = asyncio.get_running_loop().create_task(_send())
         self.messenger._tasks.add(t)
@@ -4439,11 +4658,16 @@ class OSD:
         t.add_done_callback(self.messenger._tasks.discard)
 
     async def _tier_agent_pass(self) -> None:
+        # the evict agent's pass is a tracked op like any other: a
+        # wedged agent shows up in dump_ops_in_flight with its age
+        tracked = self.ctx.op_tracker.create("tier_agent_pass")
         try:
             with self.tier_perf.time_avg("agent_pass_s"):
                 self._tier_agent_once()
+            tracked.mark_event("evicted")
         finally:
             self._tier_agent_busy = False
+            tracked.finish()
 
     def _tier_agent_once(self) -> None:
         """One flush/evict pass: when the planar store's resident bytes

@@ -453,11 +453,17 @@ class MDeletePool:
     confirm_name: str = ""  # must equal the pool's name
 
 
-@message(7, version=2)
+@message(7, version=3)
 class MPing:
     osd_id: int = 0
     epoch: int = 0
     addr: Tuple[str, int] = ("", 0)  # for direct map pushes from the leader
+    # daemon-observed health checks riding the liveness ping (the mon's
+    # HealthMonitor feed, reference MMonHealthChecks): {check_name:
+    # {"severity", "summary", "detail": [...], ...}}.  Empty = healthy;
+    # the mon drops a check the next ping omits it (raise/clear follows
+    # the ping cadence).  Read with getattr — v2 pickles lack the field.
+    health: Dict[str, Dict] = field(default_factory=dict)
 
 
 @message(8)
@@ -656,7 +662,7 @@ class MConfigReply:
 # Client <-> primary OSD
 
 
-@message(20, version=4)
+@message(20, version=5)
 class MOSDOp:
     op: str = "read"  # write | read | delete | list | repair | deep-scrub | call | multi
     pool_id: int = 0
@@ -707,6 +713,14 @@ class MOSDOp:
     # working set), "willneed" = promote on this read regardless of
     # recency (still promotion-throttled)
     fadvise: str = ""
+    # distributed-trace propagation (reference: jaeger trace context on
+    # MOSDOp, src/messages/MOSDOp.h otel trace riding the wire): the
+    # client's trace id and its root span's id; the primary JOINS as a
+    # child span, so client->primary->peer spans stitch into one tree.
+    # Empty when ms_trace_propagation is off; v4 frames lack the fields
+    # entirely (truncated-tail fixed decode leaves the defaults).
+    trace_id: str = ""
+    span_id: str = ""
 
 
 @message(21, version=2)
@@ -738,7 +752,7 @@ class MOSDOpReply:
     map_epoch: int = 0
 
 
-@message(65)
+@message(65, version=2)
 class MOSDBackoff:
     """OSD -> client flow control for one PG (reference
     src/messages/MOSDBackoff.h, BACKOFF_OP_BLOCK/BACKOFF_OP_UNBLOCK): a
@@ -759,12 +773,17 @@ class MOSDBackoff:
     # client-side park ceiling in seconds (0 = client default): the
     # resend-anyway bound when the unblock is lost
     duration: float = 0.0
+    # trace propagation: the op whose arrival triggered this block, so
+    # the park shows up inside the op's stitched trace
+    trace_id: str = ""
+    span_id: str = ""
 
     FIXED_FIELDS = [("op", "s"), ("pool_id", "q"), ("pg", "q"),
-                    ("id", "s"), ("epoch", "q"), ("duration", "d")]
+                    ("id", "s"), ("epoch", "q"), ("duration", "d"),
+                    ("trace_id", "s"), ("span_id", "s")]
 
 
-@message(67)
+@message(67, version=2)
 class MOSDPGHitSet:
     """Primary -> acting peers: one PG's encoded HitSetArchive, pushed
     at every hit-set rotation (reference: the primary PERSISTS HitSets
@@ -781,16 +800,57 @@ class MOSDPGHitSet:
     from_osd: int = -1
     epoch: int = 0
     archive: bytes = b""
+    # trace propagation: the rotation push is a tracked op on the
+    # primary; peers join its span so tier replication traces stitch
+    trace_id: str = ""
+    span_id: str = ""
 
     FIXED_FIELDS = [("pool_id", "q"), ("pg", "q"), ("from_osd", "q"),
-                    ("epoch", "q"), ("archive", "y")]
+                    ("epoch", "q"), ("archive", "y"),
+                    ("trace_id", "s"), ("span_id", "s")]
+
+
+@message(68)
+class MGetHealth:
+    """Cluster health query (reference `ceph health [detail]` hitting
+    the mon's HealthMonitor): forwarded to the LEADER (only it holds the
+    daemons' pushed health reports) and answered with the aggregated
+    check set — map-derived checks (OSD_DOWN, PG_DEGRADED, OSDMAP_FLAGS)
+    plus daemon-reported ones (SLOW_OPS, BREAKER_OPEN,
+    TIER_OVER_TARGET), with the mute lifecycle applied."""
+
+    tid: str = ""
+    detail: bool = False
+
+
+@message(69)
+class MHealthReply:
+    tid: str = ""
+    # {"status": HEALTH_OK|HEALTH_WARN|HEALTH_ERR,
+    #  "checks": {name: {"severity", "summary", "detail", ...}},
+    #  "muted": {name: {"expires_in", ...}}}
+    health: Dict = field(default_factory=dict)
+
+
+@message(70)
+class MHealthMute:
+    """`ceph health mute/unmute <check> [ttl]` (reference
+    HealthMonitor mute lifecycle): a muted check keeps being tracked and
+    listed under "muted" but no longer degrades the health status; the
+    mute expires after ``ttl`` seconds (0 = until unmuted or the check
+    clears)."""
+
+    check: str = ""
+    ttl: float = 0.0
+    unmute: bool = False
+    tid: str = ""
 
 
 # Primary OSD <-> shard OSDs (ECSubWrite/ECSubRead equivalents,
 # reference src/osd/ECMsgTypes.h:23,105)
 
 
-@message(30, version=4)
+@message(30, version=5)
 class MECSubWrite:
     pool_id: int = 0
     pg: int = 0
@@ -827,13 +887,21 @@ class MECSubWrite:
     # ecutil.HashInfo blob (hinfo_key xattr, reference ECUtil.h:101-160);
     # empty on splice writes — the shard then self-updates its own entry
     hinfo: bytes = b""
+    # trace propagation: the primary's `ec write` span context; the
+    # shard peer joins a child `ec_sub_write` span under it
+    trace_id: str = ""
+    span_id: str = ""
 
 
-@message(31)
+@message(31, version=2)
 class MECSubWriteReply:
     tid: str = ""
     shard: int = 0
     ok: bool = True
+    # echo of the request's trace context: the primary can correlate a
+    # straggler reply with the op's trace without a tid lookup
+    trace_id: str = ""
+    span_id: str = ""
 
 
 @message(32, version=3)
@@ -1163,6 +1231,10 @@ MOSDOp.FIXED_FIELDS = [
     ("method", "s"), ("snapc_seq", "Q"), ("snapc_snaps", "Q*"),
     ("snap_read", "Q"), ("snap_id", "Q"), ("pg", "q"), ("cursor", "s"),
     ("max_entries", "q"), ("nspace", "s"), ("fadvise", "s"),
+    # v5 tail: trace context.  NEW FIXED FIELDS MUST APPEND — a v4 frame
+    # simply ends here and the decoder's truncated-tail rule defaults
+    # them (golden-replay-guarded in tests/test_op_tracking.py)
+    ("trace_id", "s"), ("span_id", "s"),
 ]
 # a compound op vector (multi) carries arbitrary typed kwargs: pickle
 MOSDOp.FIXED_WHEN = staticmethod(lambda m: not m.ops)
@@ -1179,9 +1251,11 @@ MECSubWrite.FIXED_FIELDS = [
     ("object_size", "q"), ("chunk_crc", "Q"), ("tid", "s"),
     ("reply_to", "addr"), ("log_entry", "y"), ("chunk_off", "q"),
     ("shard_size", "q"), ("prior_version", "Q"), ("hinfo", "y"),
+    ("trace_id", "s"), ("span_id", "s"),  # v5 tail (append-only rule)
 ]
 MECSubWriteReply.FIXED_FIELDS = [
     ("tid", "s"), ("shard", "q"), ("ok", "?"),
+    ("trace_id", "s"), ("span_id", "s"),  # v2 tail (append-only rule)
 ]
 MECSubRead.FIXED_FIELDS = [
     ("pool_id", "q"), ("pg", "q"), ("oid", "s"), ("shard", "q"),

@@ -33,8 +33,9 @@ def parse_args(argv=None):
                    dest="confirm_destroy",
                    help="required acknowledgement for `osd pool rm`")
     p.add_argument("words", nargs="+",
-                   help="status | health | df | osd df | osd tree | "
-                        "pg dump | "
+                   help="status | health [detail] | "
+                        "health mute CHECK [TTL] | health unmute CHECK | "
+                        "df | osd df | osd tree | pg dump | "
                         "osd pool ls | osd pool create NAME [k=v...] | "
                         "osd pool set NAME KEY VALUE | "
                         "osd pool rm NAME NAME --yes-i-really-really-mean-it")
@@ -67,24 +68,26 @@ def _pg_states(osdmap) -> List[Dict]:
     return rows
 
 
-def _health(osdmap, pg_rows) -> Dict:
-    checks = []
-    down = [o.osd_id for o in osdmap.osds.values() if not o.up]
-    if down:
-        checks.append({"check": "OSD_DOWN",
-                       "summary": f"{len(down)} osds down: {down}"})
-    out = [o.osd_id for o in osdmap.osds.values() if not o.in_cluster]
-    if out:
-        checks.append({"check": "OSD_OUT",
-                       "summary": f"{len(out)} osds out: {out}"})
-    degraded = [r["pgid"] for r in pg_rows if r["state"] != "active+clean"]
-    if degraded:
-        checks.append({"check": "PG_DEGRADED",
-                       "summary": f"{len(degraded)} pgs not active+clean"})
-    status = "HEALTH_OK" if not checks else (
-        "HEALTH_ERR" if any(r["state"] == "incomplete" for r in pg_rows)
-        else "HEALTH_WARN")
-    return {"status": status, "checks": checks}
+def render_health(health: Dict, detail: bool = False) -> List[str]:
+    """Render the mon's aggregated health document (the server-side
+    HealthMonitor answer — map-derived checks PLUS daemon-reported
+    SLOW_OPS / BREAKER_OPEN / TIER_OVER_TARGET, mutes applied) in the
+    reference `ceph health [detail]` layout.  Pure so tests can pin the
+    rendering of every check type."""
+    lines = [health.get("status", "HEALTH_OK")]
+    for name, c in sorted((health.get("checks") or {}).items()):
+        sev = c.get("severity", "warning").upper()
+        lines.append(f"  [{'ERR' if sev == 'ERROR' else 'WRN'}] {name}: "
+                     f"{c.get('summary', '')}")
+        if detail:
+            for d in c.get("detail") or []:
+                lines.append(f"      {d}")
+    muted = health.get("muted") or {}
+    for name, c in sorted(muted.items()):
+        extra = (f" (expires in {c['expires_in']:g}s)"
+                 if c.get("expires_in") else "")
+        lines.append(f"  (muted) {name}: {c.get('summary', '')}{extra}")
+    return lines
 
 
 def _osd_tree(osdmap) -> List[Dict]:
@@ -149,12 +152,16 @@ async def run(args) -> int:
         cmd = " ".join(args.words)
         pg_rows = _pg_states(m)
         if cmd == "status":
-            health = _health(m, pg_rows)
+            # health comes from the MON's aggregation (HealthMonitor
+            # role) — the authority that also sees daemon-reported
+            # checks, not client-side osdmap math
+            health = await client.get_health()
             up = sum(1 for o in m.osds.values() if o.up)
             inc = sum(1 for o in m.osds.values() if o.in_cluster)
             clean = sum(1 for r in pg_rows if r["state"] == "active+clean")
             out = {
                 "health": health["status"],
+                "checks": sorted(health.get("checks") or {}),
                 "osdmap": {"epoch": m.epoch, "num_osds": len(m.osds),
                            "num_up_osds": up, "num_in_osds": inc},
                 "pgmap": {"num_pgs": len(pg_rows),
@@ -165,19 +172,40 @@ async def run(args) -> int:
                 print(json.dumps(out))
             else:
                 print(f"  health: {out['health']}")
+                for line in render_health(health)[1:]:
+                    print(f"  {line.strip()}")
                 print(f"  osdmap: e{m.epoch}: {len(m.osds)} osds: "
                       f"{up} up, {inc} in")
                 print(f"  pgmap: {len(pg_rows)} pgs, {clean} active+clean"
                       f", {len(m.pools)} pools")
             return 0
-        if cmd == "health":
-            health = _health(m, pg_rows)
+        if cmd in ("health", "health detail"):
+            detail = cmd == "health detail"
+            health = await client.get_health(detail=detail)
             if args.format == "json":
                 print(json.dumps(health))
             else:
-                print(health["status"])
-                for c in health["checks"]:
-                    print(f"  {c['check']}: {c['summary']}")
+                for line in render_health(health, detail=detail):
+                    print(line)
+            return 0
+        if args.words[:2] == ["health", "mute"] and len(args.words) >= 3:
+            try:
+                ttl = float(args.words[3]) if len(args.words) > 3 else 0.0
+            except ValueError:
+                print("usage: health mute CHECK [TTL_SECONDS]",
+                      file=sys.stderr)
+                return 2
+            health = await client.health_mute(args.words[2], ttl=ttl)
+            print(f"muted {args.words[2]}"
+                  + (f" for {ttl:g}s" if ttl else ""))
+            for line in render_health(health):
+                print(line)
+            return 0
+        if args.words[:2] == ["health", "unmute"] and len(args.words) == 3:
+            health = await client.health_mute(args.words[2], unmute=True)
+            print(f"unmuted {args.words[2]}")
+            for line in render_health(health):
+                print(line)
             return 0
         if cmd == "osd tree":
             rows = _osd_tree(m)

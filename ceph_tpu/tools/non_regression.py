@@ -45,6 +45,13 @@ def parse_args(argv=None):
     p.add_argument("--chaos-seconds", type=float, default=6.0,
                    help="length of the chaos put/get loop")
     p.add_argument("--chaos-osds", type=int, default=4)
+    # slow-op health smoke (CI): injected dispatch delay must RAISE
+    # SLOW_OPS while ops age and the check must CLEAR after recovery;
+    # nonzero exit if it never surfaces or wedges raised once idle
+    p.add_argument("--slow-ops", action="store_true")
+    p.add_argument("--slow-seconds", type=float, default=10.0,
+                   help="ceiling on the wait for SLOW_OPS to raise")
+    p.add_argument("--slow-osds", type=int, default=3)
     # tier smoke (CI): promote/evict/read loop against an in-process
     # cluster; exit nonzero on ANY content mismatch between a
     # resident-hit read and the cold decode path for the same object
@@ -247,6 +254,107 @@ def run_chaos(args) -> int:
     return asyncio.run(go())
 
 
+def run_slow_ops(args) -> int:
+    """Slow-op health smoke (CI): a chaos loop under
+    CEPH_TPU_INJECT_DISPATCH_DELAY — every device dispatch sleeps, so
+    in-flight writes age past osd_op_complaint_time and the OSDs'
+    ping-borne health reports must RAISE the mon's SLOW_OPS check; when
+    the injection stops and the backlog drains, the check must CLEAR
+    within about one complaint interval (plus the ping cadence).
+    Nonzero exit if a slow op never surfaces, or if the check wedges
+    raised after the cluster is idle.  The acceptance bar of the health
+    model, runnable as one command:
+
+        python -m ceph_tpu.tools.non_regression --slow-ops
+    """
+    import asyncio
+    import os as _os
+    import time as _time
+
+    # the batching queue (the injection point) engages only on an
+    # accelerator backend; FORCE_BATCH is the sanctioned CPU override —
+    # set BEFORE any OSD asks for the shared queue
+    _os.environ["CEPH_TPU_FORCE_BATCH"] = "1"
+    _os.environ.setdefault("CEPH_TPU_INJECT_DISPATCH_DELAY", "0.6")
+
+    from ceph_tpu.rados.vstart import Cluster
+    import ceph_tpu.rados.osd as osdmod
+
+    complaint = 0.25
+
+    async def go() -> int:
+        conf = {"osd_auto_repair": False,
+                "osd_heartbeat_interval": 0.1,
+                "mon_osd_report_grace": 5.0,
+                "client_op_timeout": 30.0,
+                "client_op_deadline": 120.0,
+                "osd_op_complaint_time": complaint}
+        cluster = Cluster(n_osds=max(3, args.slow_osds), conf=conf)
+        await cluster.start()
+        failures = []
+        try:
+            c = await cluster.client()
+            pool = await c.create_pool("slow", profile={
+                "plugin": "jerasure", "technique": "reed_sol_van",
+                "k": "2", "m": "1"})
+            q = osdmod.shared_batching_queue()
+            if q is None:
+                print("FAIL batching queue did not engage under "
+                      "CEPH_TPU_FORCE_BATCH=1", file=sys.stderr)
+                return 1
+            delay = float(_os.environ["CEPH_TPU_INJECT_DISPATCH_DELAY"])
+            q.inject_dispatch_delay = delay
+            loop = asyncio.get_running_loop()
+            # a standing burst of writes: each one's encode dispatch
+            # sleeps `delay`, so in-flight ops age past the complaint
+            tasks = [loop.create_task(
+                c.put(pool, f"s{i}", _os.urandom(60_000 + 512 * i)))
+                for i in range(8)]
+            raised = False
+            deadline = _time.monotonic() + args.slow_seconds
+            while _time.monotonic() < deadline:
+                h = await c.get_health(detail=True)
+                if "SLOW_OPS" in (h.get("checks") or {}):
+                    chk = h["checks"]["SLOW_OPS"]
+                    print(f"slow-ops raised: {chk['summary']} "
+                          f"(oldest {chk.get('oldest_age', 0):.2f}s)")
+                    raised = True
+                    break
+                await asyncio.sleep(0.05)
+            if not raised:
+                failures.append("SLOW_OPS never raised under injected "
+                                "dispatch delay")
+            # recovery: stop the injection, drain the backlog
+            q.inject_dispatch_delay = 0.0
+            got = await asyncio.gather(*tasks, return_exceptions=True)
+            for g in got:
+                if isinstance(g, Exception):
+                    failures.append(f"write failed under delay: {g}")
+            # the check must clear within ~one complaint interval after
+            # the cluster idles (next ping carries an empty report)
+            cleared = False
+            clear_deadline = _time.monotonic() + complaint + 3.0
+            while _time.monotonic() < clear_deadline:
+                h = await c.get_health()
+                if "SLOW_OPS" not in (h.get("checks") or {}):
+                    cleared = True
+                    break
+                await asyncio.sleep(0.05)
+            if raised and not cleared:
+                failures.append("SLOW_OPS wedged raised after the "
+                                "cluster went idle")
+            if cleared:
+                print("slow-ops cleared after recovery")
+            await c.stop()
+        finally:
+            await cluster.stop()
+        for f in failures:
+            print(f"FAIL {f}", file=sys.stderr)
+        return 1 if failures else 0
+
+    return asyncio.run(go())
+
+
 def run_tier(args) -> int:
     """Tier smoke mode (CI): a promote/evict/read loop against an
     in-process cluster with the device-residency tier forced on.  Every
@@ -392,6 +500,8 @@ def run_tier(args) -> int:
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.slow_ops:
+        return run_slow_ops(args)
     if args.tier:
         return run_tier(args)
     if args.chaos:

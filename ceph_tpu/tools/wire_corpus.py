@@ -55,7 +55,8 @@ def _sample_messages() -> List[Any]:
                  epoch=11, reqid="req-1", offset=4096, cls="lock",
                  method="lock", snapc_seq=9, snapc_snaps=[9, 4, 2],
                  snap_read=7, snap_id=5, pg=12, cursor="after",
-                 max_entries=64, nspace="blue", fadvise="willneed"),
+                 max_entries=64, nspace="blue", fadvise="willneed",
+                 trace_id="deadbeefcafef00d", span_id="0123456789abcdef"),
         t.MOSDOp(op="multi", pool_id=1, oid="m", reqid="r2",
                  ops=[("setxattr", {"name": "a", "value": b"v"}),
                       ("omap_set", {"entries": {"k": b"x"}})]),
@@ -67,8 +68,11 @@ def _sample_messages() -> List[Any]:
                       object_size=1234, chunk_crc=0xDEAD, tid="t1",
                       reply_to=("127.0.0.1", 6800), log_entry=b"LE",
                       chunk_off=8192, shard_size=65536, prior_version=42,
-                      hinfo=b"HINFO"),
-        t.MECSubWriteReply(tid="t1", shard=4, ok=False),
+                      hinfo=b"HINFO", trace_id="deadbeefcafef00d",
+                      span_id="fedcba9876543210"),
+        t.MECSubWriteReply(tid="t1", shard=4, ok=False,
+                           trace_id="deadbeefcafef00d",
+                           span_id="fedcba9876543210"),
         t.MECSubRead(pool_id=2, pg=5, oid="obj", shard=1, tid="t2",
                      reply_to=("host", 1), extents=[(0, 4096), (8192, 64)],
                      want_hinfo=True),
@@ -108,9 +112,20 @@ def _sample_messages() -> List[Any]:
         t.MOSDFailure(target_osd=4, from_osd=1, failed_for=12.5,
                       tid="t11"),
         t.MOSDBackoff(op="unblock", pool_id=2, pg=9, id="bk-1", epoch=33,
-                      duration=1.5),
+                      duration=1.5, trace_id="deadbeefcafef00d",
+                      span_id="0011223344556677"),
         t.MOSDPGHitSet(pool_id=3, pg=7, from_osd=2, epoch=44,
-                       archive=arch.encode(now=103.0)),
+                       archive=arch.encode(now=103.0),
+                       trace_id="deadbeefcafef00d",
+                       span_id="8899aabbccddeeff"),
+        t.MGetHealth(tid="t12", detail=True),
+        t.MHealthReply(tid="t12", health={
+            "status": "HEALTH_WARN",
+            "checks": {"SLOW_OPS": {"severity": "warning",
+                                    "summary": "1 slow ops"}},
+            "muted": {}}),
+        t.MHealthMute(check="SLOW_OPS", ttl=30.0, unmute=False,
+                      tid="t13"),
     ]
 
 
@@ -213,11 +228,34 @@ def check(directory: str = CORPUS_DIR) -> int:
             failures.append(
                 f"{name}: declared fields drifted: "
                 f"{sorted(set(want) ^ names_now)}")
+    # golden replay: frames archived by OLDER builds (e.g. pre-trace-id
+    # layouts) must still DECODE — field values aren't compared (the new
+    # fields default), only that the truncated-tail rule holds
+    golden_dir = os.path.join(directory, "golden")
+    golden = sorted(n for n in os.listdir(golden_dir)
+                    if n.endswith(".frame")) \
+        if os.path.isdir(golden_dir) else []
+    for name in golden:
+        try:
+            with open(os.path.join(golden_dir, name), "rb") as f:
+                raw = f.read()
+            type_id, version, fixed, plen = _FRAME_HDR.unpack_from(raw, 0)
+            off = _FRAME_HDR.size
+            payload = raw[off:off + plen]
+            off += plen
+            (blen,) = struct.unpack_from("<I", raw, off)
+            blob = raw[off + 4:off + 4 + blen] if blen else None
+            decode_message(type_id, version, payload, blob, bool(fixed))
+        except Exception as e:
+            failures.append(f"golden/{name}: old frame no longer "
+                            f"decodes: {e}")
     if failures:
         for f in failures:
             print(f"FAIL {f}", file=sys.stderr)
         return 1
-    print(f"{len(frames)} archived frames decode byte-exactly")
+    print(f"{len(frames)} archived frames decode byte-exactly"
+          + (f"; {len(golden)} golden old frames still decode"
+             if golden else ""))
     return 0
 
 

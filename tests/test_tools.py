@@ -188,8 +188,9 @@ class TestCephStatusCli:
                         break
                     await asyncio.sleep(0.1)
                 assert health["status"] in ("HEALTH_WARN", "HEALTH_ERR")
-                assert any(ch["check"] == "OSD_DOWN"
-                           for ch in health["checks"])
+                # mon-backed health (HealthMonitor aggregation): checks
+                # keyed by name, not the old client-side list
+                assert "OSD_DOWN" in health["checks"]
                 tree = _json.loads(await cli("osd", "tree"))
                 down = [r for r in tree if r.get("name") == f"osd.{victim}"]
                 assert down and down[0]["status"] == "down"
