@@ -1306,6 +1306,184 @@ def hot_read_bench() -> int:
     return 0
 
 
+def macro_bench() -> int:
+    """Multi-tenant macro traffic arm (bench.py --macro): thousands of
+    simulated tenants over a handful of client processes drive zipfian
+    mixed-phase traffic (write-heavy / read-heavy / degraded-read under
+    a downed OSD / repair-concurrent — the arXiv:1709.05365 workload
+    shape) at a TCP cluster running the mClock scheduler with per-client
+    dmClock QoS.  Emits per-tenant-class end-to-end op percentiles per
+    phase, the OSDs' per-class op-phase p50/p99/p999 (the optracker
+    cls:<name>|<phase> rings), the aggregated `osd_scheduler` snapshot,
+    and the ISOLATION EXPERIMENT: the reserved class's solo-run get p99
+    vs its p99 with a noisy neighbor offering ~10x its limit — the
+    flooder must be the one backoff-shed, the reserved tenant must see
+    zero acked-op failures and a bounded p99."""
+    import asyncio
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from ceph_tpu.rados.vstart import Cluster
+    from ceph_tpu.tools.traffic import (TenantClass, TrafficHarness,
+                                        merge_osd_class_phases)
+
+    phase_secs = float(os.environ.get("MACRO_PHASE_SECS", "2.0"))
+    flood_limit = 40.0
+
+    async def go():
+        cluster = Cluster(n_osds=4, conf={
+            "osd_auto_repair": False,
+            "ms_local_fastpath": False,
+            "osd_op_queue": "mclock",
+            "osd_backoff_queue_depth": 6,
+            "osd_qos_shed_grace": 0.05,
+            "osd_backoff_secs": 0.5,
+            "client_op_timeout": 30.0,
+            "client_op_deadline": 90.0})
+        await cluster.start()
+        try:
+            c0 = await cluster.client()
+            pool = await c0.create_pool("macro", profile={
+                "plugin": "jerasure", "technique": "reed_sol_van",
+                "k": "2", "m": "1"})
+            # mon-validated per-pool QoS profiles, osdmap-distributed:
+            # gold is the reserved class, flood is capped hard; the
+            # pool-wide defaults cover the anonymous bulk tenants
+            await c0.pool_set(pool, "qos_reservation", "100")
+            await c0.pool_set(pool, "qos_weight", "10")
+            await c0.pool_set(pool, "qos_class:gold", "150:20:0")
+            await c0.pool_set(pool, "qos_class:flood",
+                              f"0:1:{flood_limit:g}")
+            # one client PROCESS per tenant class: a backoff aimed at
+            # the flooding class parks its connection, not its neighbors
+            c_gold, c_bulk = [await cluster.client() for _ in range(2)]
+            # the flooding class runs with a SHORT op deadline: an
+            # over-limit tenant seeing timeouts while shed is the honest
+            # outcome, and it bounds every phase's straggler tail
+            from ceph_tpu.rados.client import RadosClient
+
+            fconf = dict(cluster.conf)
+            fconf["client_op_deadline"] = 5.0
+            c_flood = RadosClient(cluster.mon_addrs, fconf)
+            await c_flood.start()
+            await c_flood.refresh_map()
+            gold = TenantClass("gold", c_gold, tenants=300, workers=4,
+                              rate=60.0)
+            bulk = TenantClass("", c_bulk, tenants=1000, workers=4,
+                              rate=80.0)
+            flood = TenantClass("flood", c_flood, tenants=2, workers=64,
+                                rate=0.0)  # unpaced: offers >> limit
+            h = TrafficHarness([gold, bulk, flood], pool,
+                               n_objects=48, obj_size=32 << 10)
+            await h.preload()
+            for o in cluster.osds.values():
+                o.ctx.op_tracker.clear_samples()
+
+            # -- isolation experiment (healthy cluster) ----------------
+            solo = await h.run_phase("solo", phase_secs, 0.2,
+                                     classes=[gold])
+            shed0 = sum(o.sched_perf.get("qos_shed")
+                        for o in cluster.osds.values())
+            contended = await h.run_phase("contended", phase_secs, 0.2,
+                                          classes=[gold, flood])
+            sheds = sum(o.sched_perf.get("qos_shed")
+                        for o in cluster.osds.values()) - shed0
+            flood_backoffs = c_flood.perf.get("backoffs_received")
+            gold_backoffs = c_gold.perf.get("backoffs_received")
+
+            # -- mixed phases ------------------------------------------
+            phases = {}
+            phases["write_heavy"] = (await h.run_phase(
+                "write_heavy", phase_secs, 0.8)).summary()
+            phases["read_heavy"] = (await h.run_phase(
+                "read_heavy", phase_secs, 0.2)).summary()
+            # snapshot BEFORE the kill: kill_osd pops the victim from
+            # cluster.osds, but its trackers still hold the first four
+            # phases' samples — the report must aggregate all 4 daemons
+            all_osds = list(cluster.osds.values())
+            victim = sorted(cluster.osds)[-1]
+            await cluster.kill_osd(victim)
+            await c0.mark_osd_down(victim)
+            for c in (c_gold, c_bulk, c_flood):
+                await c.refresh_map()
+            phases["degraded_read"] = (await h.run_phase(
+                "degraded_read", phase_secs, 0.1)).summary()
+            repair_task = asyncio.get_running_loop().create_task(
+                c0.repair_pool(pool))
+            phases["repair_concurrent"] = (await h.run_phase(
+                "repair_concurrent", phase_secs, 0.3)).summary()
+            try:
+                await asyncio.wait_for(repair_task, timeout=30)
+            except asyncio.TimeoutError:
+                repair_task.cancel()
+
+            osd_phase_pcts = merge_osd_class_phases(all_osds)
+            sched = {}
+            for o in all_osds:
+                for k, v in o.sched_perf.dump().items():
+                    if isinstance(v, int):
+                        sched[k] = sched.get(k, 0) + v
+            solo_s, cont_s = solo.summary(), contended.summary()
+            solo_p99 = solo_s.get("gold", {}).get("get", {}).get(
+                "p99_us", 0.0)
+            cont_p99 = cont_s.get("gold", {}).get("get", {}).get(
+                "p99_us", 0.0)
+            flood_ops = cont_s.get("flood", {}).get("ops", 0)
+            # served = COMPLETED ops only (the per-kind sample counts
+            # exclude failures; "ops" counts attempts incl. timeouts)
+            flood_done = sum(
+                v.get("count", 0)
+                for v in cont_s.get("flood", {}).values()
+                if isinstance(v, dict))
+            served = flood_done / max(contended.seconds, 1e-9)
+            # attempts = tries + shed drops: the flooder's offered
+            # pressure (64 unpaced workers; parks suppress it)
+            attempted = (flood_ops + flood_backoffs) \
+                / max(contended.seconds, 1e-9)
+            isolation = {
+                "solo_get_p99_us": solo_p99,
+                "contended_get_p99_us": cont_p99,
+                "p99_ratio": round(cont_p99 / solo_p99, 2)
+                if solo_p99 else 0.0,
+                "reserved_failures":
+                    cont_s.get("gold", {}).get("failures", 0)
+                    + solo_s.get("gold", {}).get("failures", 0),
+                "flooder_limit_ops_sec": flood_limit,
+                "flooder_workers": flood.workers,
+                "flooder_attempted_ops_sec": round(attempted, 1),
+                "flooder_served_ops_sec": round(served, 1),
+                "flooder_served_vs_limit": round(served / flood_limit, 2),
+                "qos_sheds": sheds,
+                "flooder_backoffs_received": flood_backoffs,
+                "reserved_backoffs_received": gold_backoffs,
+                "isolation_ok": bool(
+                    sheds > 0 and flood_backoffs > 0
+                    and cont_s.get("gold", {}).get("failures", 0) == 0
+                    and solo_p99 and cont_p99 <= 2.0 * solo_p99),
+            }
+            total_tenants = sum(
+                tc.tenants for tc in (gold, bulk, flood))
+            for c in (c0, c_gold, c_bulk, c_flood):
+                await c.stop()
+            return (total_tenants, phases, osd_phase_pcts, sched,
+                    isolation, solo_s, cont_s)
+        finally:
+            await cluster.stop()
+
+    (tenants, phases, osd_pcts, sched, isolation,
+     solo_s, cont_s) = asyncio.run(go())
+    print(json.dumps({
+        # per-tenant-class end-to-end percentiles per traffic phase
+        # (client-side), plus the OSDs' per-class op-phase tails from
+        # the optracker rings — the numbers QoS regressions move
+        "macro_tenants": tenants,
+        "macro_phases": phases,
+        "macro_isolation_phases": {"solo": solo_s, "contended": cont_s},
+        "macro_osd_phase_percentiles": osd_pcts,
+        "macro_scheduler_perf": sched,
+        "qos_isolation": isolation}))
+    return 0
+
+
 def onhost_overlap_bench() -> int:
     """Serial vs pipelined batching-queue rounds on the CPU backend (no
     tunnel): the double-buffer mechanism measured on its own.  Serial
@@ -1369,6 +1547,8 @@ if __name__ == "__main__":
         sys.exit(daemon_path_bench())
     if "--hot-read" in sys.argv:
         sys.exit(hot_read_bench())
+    if "--macro" in sys.argv:
+        sys.exit(macro_bench())
     if "--onhost-overlap" in sys.argv:
         sys.exit(onhost_overlap_bench())
     sys.exit(main())

@@ -140,6 +140,34 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
     Option("osd_repair_delay", OPT_SECS, 0.5),
     Option("osd_op_num_shards", OPT_INT, 4),
     Option("osd_op_queue", OPT_STR, "wpq", enum_values=("wpq", "mclock")),
+    # multi-tenant QoS (reference mClockScheduler client profiles; pool
+    # opts qos_reservation/qos_weight/qos_limit + qos_class:<name>
+    # override these cluster defaults per pool)
+    Option("osd_backoff_queue_depth", OPT_INT, 0,
+           desc="sharded-queue depth past which arriving client ops are "
+                "shed via MOSDBackoff (0 disables); with client "
+                "identities the shed targets the most over-limit client"),
+    Option("osd_qos_default_reservation", OPT_FLOAT, 100.0,
+           desc="per-client guaranteed ops/sec when the pool declares "
+                "no qos_reservation"),
+    Option("osd_qos_default_weight", OPT_FLOAT, 10.0,
+           desc="per-client share of surplus when the pool declares no "
+                "qos_weight"),
+    Option("osd_qos_default_limit", OPT_FLOAT, 0.0,
+           desc="per-client ops/sec cap when the pool declares no "
+                "qos_limit (0 = unlimited)"),
+    Option("osd_qos_arrears_cap", OPT_FLOAT, 2.0,
+           desc="ceiling (seconds) on a client's accumulated over-limit "
+                "arrears — bounds how long a quieted flooder stays "
+                "shed-eligible"),
+    Option("osd_qos_shed_grace", OPT_FLOAT, 0.25,
+           desc="seconds of over-limit arrears a client may accumulate "
+                "before the saturation shed targets it"),
+    Option("osd_mclock_max_clients", OPT_INT, 1024,
+           desc="per-shard bound on per-client dmClock states (idle "
+                "states pruned oldest-first)"),
+    Option("osd_qos_max_clients", OPT_INT, 4096,
+           desc="bound on the admission tracker's per-client states"),
     # op tracking + slow-op health (reference osd_op_complaint_time /
     # osd_op_history_size, TrackedOp.h)
     Option("osd_op_complaint_time", OPT_SECS, 2.0,

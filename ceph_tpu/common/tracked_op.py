@@ -117,7 +117,8 @@ def percentile_summary(samples: List[float]) -> Dict[str, float]:
 
 class TrackedOp:
     __slots__ = ("tracker", "seq", "desc", "reqid", "initiated_at",
-                 "events", "done_at", "trace", "complaint_ok", "_dropped")
+                 "events", "done_at", "trace", "complaint_ok", "_dropped",
+                 "qos_tag")
 
     def __init__(self, tracker: "OpTracker", desc: str, reqid: str = "",
                  trace: Any = None):
@@ -136,6 +137,11 @@ class TrackedOp:
         # acks) must not raise SLOW_OPS on a healthy cluster
         self.complaint_ok = True
         self._dropped = 0
+        # tenant-class tag (qos.tenant_class of the op's client): when
+        # set, phase samples ALSO land in a per-class ring keyed
+        # "cls:<tag>|<phase>" — the per-tenant-class percentile path the
+        # macro bench reduces ("" = untagged, no extra ring)
+        self.qos_tag = ""
 
     def mark_event(self, event: str) -> None:
         # bounded: a stuck op re-marked by a poller must not grow its
@@ -194,6 +200,12 @@ class OpTracker:
     ``slow_op_summary`` (the SLOW_OPS health feed)."""
 
     SAMPLE_RING = 2048  # raw per-phase samples kept for percentiles
+    # bound on DISTINCT sample-ring keys: the per-class keys derive from
+    # the wire-controlled client name, so without a cap a sender minting
+    # a fresh tenant class per op would grow a new ring forever; at the
+    # cap, samples for NEW tagged keys are dropped (untagged phase rings
+    # are few and always created first)
+    MAX_SAMPLE_KEYS = 256
 
     def __init__(self, history_size: int = 20, history_slow_size: int = 20,
                  slow_threshold: float = 2.0, max_events: int = 128,
@@ -239,12 +251,17 @@ class OpTracker:
         for phase, dt in op.phase_latencies().items():
             self.perf.tinc(f"lat_{phase}", dt)
             self.perf.hinc(f"hist_{phase}_us", dt * 1e6)
+            keys = (phase,) if not op.qos_tag \
+                else (phase, f"cls:{op.qos_tag}|{phase}")
             with self._lock:
-                ring = self._samples.get(phase)
-                if ring is None:
-                    ring = self._samples[phase] = collections.deque(
-                        maxlen=self.SAMPLE_RING)
-                ring.append(dt)
+                for key in keys:
+                    ring = self._samples.get(key)
+                    if ring is None:
+                        if len(self._samples) >= self.MAX_SAMPLE_KEYS:
+                            continue  # key-cardinality bound (see above)
+                        ring = self._samples[key] = collections.deque(
+                            maxlen=self.SAMPLE_RING)
+                    ring.append(dt)
 
     # -- percentiles ---------------------------------------------------------
 

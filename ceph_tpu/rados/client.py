@@ -193,6 +193,15 @@ class RadosClient:
         # park ceiling for a server backoff whose unblock never arrives
         self.backoff_park_max = float(
             self.conf.get("client_backoff_park_max", 3.0) or 3.0)
+        # entity name riding every data op (MOSDOp v6 `client`): the
+        # identity the OSD's per-client dmClock QoS keys on.  Format
+        # client.<class>.<id> names a tenant class (pool qos_class:<name>
+        # profiles); the default two-part name rides the pool's default
+        # client profile.  Multi-tenant harnesses stamp per-op identities
+        # through the `client=` kwarg on put/get/delete instead — one
+        # client process carries many simulated tenants.
+        self.name = str(self.conf.get("client_name", "")
+                        or f"client.{uuid.uuid4().hex[:6]}")
         self.messenger = Messenger("client", self.conf, entity_type="client")
         # the `objecter` perf set (schema: _build_objecter_perf)
         self.perf = _build_objecter_perf()
@@ -601,6 +610,11 @@ class RadosClient:
             except asyncio.TimeoutError:
                 if self._backoffs.get(key) is ent:
                     self._release_backoff(key)
+        # decorrelate the release burst: every op parked on this PG wakes
+        # at once, and without jitter the resend order is stable cycle
+        # after cycle — under repeated saturation sheds the same ops win
+        # admission every time while the tail starves deterministically
+        await asyncio.sleep(random.random() * 0.05)
 
     async def _op(self, op: MOSDOp,
                   retries: Optional[int] = None) -> MOSDOpReply:
@@ -618,6 +632,8 @@ class RadosClient:
         # ONE reqid per logical op: resends carry the same id so the PG
         # log's dup detection can recognize them (reference osd_reqid_t)
         op.reqid = uuid.uuid4().hex
+        if not getattr(op, "client", ""):
+            op.client = self.name
         rec = _OpRecord(op, time.monotonic() + self.op_deadline)
         # root span for the whole logical op (across every resend); its
         # context rides the MOSDOp so the primary's osd_op span — and
@@ -826,17 +842,21 @@ class RadosClient:
 
     async def put(self, pool_id: int, oid: str, data: bytes,
                   offset: Optional[int] = None,
-                  snapc: Optional[Tuple[int, List[int]]] = None) -> None:
+                  snapc: Optional[Tuple[int, List[int]]] = None,
+                  client: str = "") -> None:
         """Full-object write, or a partial overwrite at `offset` (the
         primary takes the read-modify-write path).  ``snapc`` is a
         self-managed snap context (seq, snaps-descending): the primary
         clones the head before the first write past a new snap
-        (reference SnapContext on every write)."""
+        (reference SnapContext on every write).  ``client`` overrides
+        the entity name this op carries (simulated-tenant identity for
+        the macro traffic harness; default: this client's name)."""
         self._check_oid(oid)
         seq, snaps = self._write_snapc(pool_id, snapc)
         await self._op(MOSDOp(op="write", pool_id=pool_id, oid=oid, data=data,
                               offset=-1 if offset is None else int(offset),
-                              snapc_seq=seq, snapc_snaps=list(snaps)))
+                              snapc_seq=seq, snapc_snaps=list(snaps),
+                              client=client))
 
     async def multi(self, pool_id: int, oid: str, ops,
                     snapc: Optional[Tuple[int, List[int]]] = None):
@@ -967,7 +987,7 @@ class RadosClient:
         return total
 
     async def get(self, pool_id: int, oid: str, snap: int = 0,
-                  fadvise: str = "") -> bytes:
+                  fadvise: str = "", client: str = "") -> bytes:
         """Read the head, or the object's state AT a snap id (resolved
         through the primary's SnapSet clone list).  ``fadvise`` is
         cache-tier advice (reference librados FADVISE_DONTNEED/WILLNEED
@@ -978,7 +998,7 @@ class RadosClient:
         self._check_oid(oid)
         reply = await self._op(MOSDOp(op="read", pool_id=pool_id, oid=oid,
                                       snap_read=int(snap),
-                                      fadvise=fadvise))
+                                      fadvise=fadvise, client=client))
         data = reply.data
         if isinstance(data, BufferList):
             # colocated fastpath hands the primary's scatter-gather read
@@ -988,13 +1008,15 @@ class RadosClient:
         return data
 
     async def delete(self, pool_id: int, oid: str,
-                     snapc: Optional[Tuple[int, List[int]]] = None) -> None:
+                     snapc: Optional[Tuple[int, List[int]]] = None,
+                     client: str = "") -> None:
         """Delete the head; under a snap context the primary clones
         first and leaves a whiteout so snapshots keep resolving."""
         self._check_oid(oid)
         seq, snaps = self._write_snapc(pool_id, snapc)
         await self._op(MOSDOp(op="delete", pool_id=pool_id, oid=oid,
-                              snapc_seq=seq, snapc_snaps=list(snaps)))
+                              snapc_seq=seq, snapc_snaps=list(snaps),
+                              client=client))
 
     async def watch(self, pool_id: int, oid: str, callback) -> None:
         """Register a notify callback on oid (librados watch2 role).
@@ -1165,6 +1187,8 @@ class RadosClient:
 
     async def _op_direct(self, osd_id: int, op: MOSDOp) -> MOSDOpReply:
         op.reqid = uuid.uuid4().hex
+        if not getattr(op, "client", ""):
+            op.client = self.name
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._replies[op.reqid] = fut
         try:

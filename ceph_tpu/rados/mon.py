@@ -1156,6 +1156,24 @@ class Monitor:
             pool = self.osdmap.pools.get(msg.pool_id)
             if pool is None:
                 return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+            if msg.key in ("qos_reservation", "qos_weight", "qos_limit") \
+                    or msg.key.startswith("qos_class:"):
+                # per-pool dmClock QoS profile (`pool set qos_reservation/
+                # qos_weight/qos_limit` defaults + qos_class:<name> =
+                # "r:w:l" tenant-class overrides): validated HERE
+                # (qos.validate_pool_qos) and distributed via pool.opts
+                # in the osdmap, so a malformed profile can never wedge
+                # OSD admission cluster-wide
+                from ceph_tpu.rados.qos import validate_pool_qos
+
+                if not validate_pool_qos(msg.key, msg.value):
+                    return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+                if not hasattr(pool, "opts"):
+                    pool.opts = {}
+                pool.opts[msg.key] = msg.value
+                self.osdmap.epoch += 1
+                await self._commit_state()
+                return MMapReply(osdmap=self.osdmap, tid=msg.tid)
             if msg.key in ("hit_set_period", "hit_set_count",
                            "hit_set_fpp", "hit_set_target_size",
                            "min_read_recency_for_promote",

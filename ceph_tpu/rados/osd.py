@@ -77,6 +77,8 @@ from ceph_tpu.rados.peering import (
     ReservationSlots,
 )
 from ceph_tpu.rados.pglog import ZERO, LogEntry, PGLog, pack_eversion
+from ceph_tpu.rados.qos import (QosParams, QosTracker, build_scheduler_perf,
+                                pool_qos, tenant_class)
 from ceph_tpu.rados.scheduler import (
     CLASS_BEST_EFFORT,
     CLASS_CLIENT,
@@ -306,9 +308,21 @@ class OSD:
                      "bytes pushed through the shared queue (gauge)")
             .create_perf_counters()
         )
+        # the `osd_scheduler` set: per-class queue flow, the dmClock
+        # serving split, and the QoS shed counter — one set per daemon
+        # (the queue's shards share it), riding perf dump -> mgr /metrics
+        self.sched_perf = self.ctx.perf.add(build_scheduler_perf())
         self.op_queue = ShardedOpQueue(
             int(self.conf.get("osd_op_num_shards", 4) or 4), self.conf,
-            perf=self.perf)
+            perf=self.perf, sched_perf=self.sched_perf)
+        # OSD-level per-client admission tracker (qos.QosTracker): sees
+        # every arriving client data op at FULL offered rate (per-shard
+        # scheduler states each see ~1/n_shards), so the saturation shed
+        # can name the most over-limit client
+        self.qos = QosTracker(
+            int(self.conf.get("osd_qos_max_clients", 4096) or 4096),
+            arrears_cap=float(
+                self.conf.get("osd_qos_arrears_cap", 2.0) or 2.0))
         # OSD<->OSD heartbeat state (two-tier failure detection);
         # _hb_reported maps peer -> last MOSDFailure stamp so reports
         # re-send while the peer stays silent (evidence at the mon expires)
@@ -516,6 +530,9 @@ class OSD:
         self.ctx.asok.register(
             "tier status", lambda a: self.tier_status(),
             "cache-tier residency/promotion/eviction status")
+        self.ctx.asok.register(
+            "dump_op_queue", lambda a: self.dump_op_queue(),
+            "per-class/per-client queue depths and dmClock tags")
         asok_dir = self.conf.get("admin_socket_dir")
         if asok_dir:
             self.ctx.asok.register(
@@ -530,6 +547,14 @@ class OSD:
             "op_queue_depth": self.op_queue.depth(),
             "hb_peers": sorted(self._hb_last),
         }
+
+    def dump_op_queue(self) -> dict:
+        """asok ``dump_op_queue``: the sharded queue's per-class /
+        per-client depths and current dmClock tags, plus the admission
+        tracker's per-client over-limit excess (the shed-ranking view)."""
+        out = self.op_queue.dump()
+        out["admission"] = self.qos.dump()
+        return out
 
     async def stop(self) -> None:
         self._stopped = True
@@ -907,10 +932,39 @@ class OSD:
             op_class = {"repair": CLASS_RECOVERY,
                         "deep-scrub": CLASS_BEST_EFFORT}.get(
                 msg.op, CLASS_CLIENT)
+            # per-client QoS: resolve the sender's profile from the
+            # pool's osdmap-distributed opts and observe the ARRIVAL in
+            # the admission tracker (the offered-rate view the
+            # saturation shed ranks over — shed arrivals count too, with
+            # the tracker's arrears cap bounding the memory); the same
+            # profile seeds the op's per-client dmClock state in the
+            # scheduler shard
+            client = getattr(msg, "client", "")
+            qos_params: Optional[QosParams] = None
+            if client and op_class == CLASS_CLIENT:
+                pool = self.osdmap.pools.get(msg.pool_id) \
+                    if self.osdmap else None
+                qos_params = pool_qos(pool, client, self.conf) \
+                    if pool is not None else None
+                if qos_params is not None:
+                    self.qos.observe(client, qos_params)
+            # arrival-side saturation shed: a saturated OSD drops-and-
+            # blocks HERE, before the op consumes a queue slot — the
+            # post-dequeue point would drop a whole admitted burst in
+            # lockstep instead of letting the first qmax ops through
+            if await self._maybe_shed_queue(conn, msg):
+                tracked.mark_event("backoff")
+                if tracked.trace is not None:
+                    tracked.trace.tag("backoff", True)
+                    tracked.trace.finish()
+                tracked.finish()
+                return
             try:
                 await self.op_queue.enqueue(
                     pg_key, lambda: self._handle_client_op(conn, msg),
                     op_class, cost=max(1, len(msg.data) // 4096),
+                    client=client if qos_params is not None else "",
+                    qos=qos_params,
                 )
             except BaseException:
                 # cancelled (or failed) while parked on a full queue:
@@ -1798,6 +1852,14 @@ class OSD:
         tracked = self.ctx.op_tracker.create(
             f"osd_op({op.op} {op.pool_id}:{op.oid})", reqid=op.reqid,
             trace=span)
+        # tenant-class tag: phase samples also land in per-class rings
+        # ("cls:<name>|<phase>") so the macro bench can reduce
+        # per-tenant-class p50/p99/p999 from the same optracker path.
+        # "|" is the ring-key separator and the client name is
+        # wire-controlled: sanitize so a crafted name cannot mislabel
+        # the per-class reduction
+        tracked.qos_tag = tenant_class(
+            getattr(op, "client", "")).replace("|", "_")
         if op.op == "notify":
             # a notify legitimately parks for its whole watcher-ack
             # gather window — aging it would raise SLOW_OPS on every
@@ -1837,14 +1899,65 @@ class OSD:
     # prior primary is exactly the non-idempotent double-execute window.
     _BACKOFF_MUTATIONS = frozenset(("write", "delete", "multi", "call"))
 
+    async def _maybe_shed_queue(self, conn, op: MOSDOp) -> bool:
+        """Arrival-side saturation shed (the "queue" backoff reason):
+        when admitted-but-unfinished ops exceed osd_backoff_queue_depth
+        (0 disables; under per-PG chaining an overload lives in RUNNING
+        chains, not the scheduler queue, so raw depth() would never see
+        it), the arriving op is dropped and its client blocked for a
+        short timed window via MOSDBackoff.  The shed is QoS-DIRECTED
+        when client identities are in play: if any client's OFFERED rate
+        is past its limit (qos.QosTracker), only over-limit clients' ops
+        are shed — the flooder parks while the reserved tenant keeps
+        being admitted; with nobody over limit the legacy
+        shed-the-arrival behavior applies.  Returns True when the op was
+        dropped."""
+        if self.osdmap is None or op.op not in self._BACKOFF_OPS:
+            return False
+        qmax = int(self.conf.get("osd_backoff_queue_depth", 0) or 0)
+        if not qmax or self.op_queue.inflight_ops <= qmax:
+            return False
+        pool = self.osdmap.pools.get(op.pool_id)
+        if pool is None or not op.oid:
+            return False
+        shed, qos_directed = self.qos.should_shed(
+            getattr(op, "client", ""),
+            float(self.conf.get("osd_qos_shed_grace", 0.25) or 0.0))
+        if not shed:
+            # an over-limit client exists and it is not this one: admit
+            # (the flooder eats the shed at its own next arrival)
+            return False
+        if qos_directed:
+            self.sched_perf.inc("qos_shed")
+        pg = self.osdmap.object_to_pg(pool, op.oid)
+        await self._send_queue_block(conn, (op.pool_id, pg), op)
+        return True
+
+    async def _send_queue_block(self, conn, key: Tuple[int, int],
+                                op: MOSDOp) -> None:
+        """Send the timed MOSDBackoff block for a queue-saturation shed
+        (expiry-released: the client resends after osd_backoff_secs)."""
+        self.perf.inc("backoffs_sent")
+        tracked = getattr(op, "_tracked", None)
+        b_tid = b_sid = ""
+        if self._trace_on and tracked is not None \
+                and tracked.trace is not None:
+            b_tid, b_sid = tracked.trace.context()
+        msg = MOSDBackoff(
+            op="block", pool_id=key[0], pg=key[1], id=uuid.uuid4().hex,
+            epoch=self.osdmap.epoch,
+            duration=float(self.conf.get("osd_backoff_secs", 0.5) or 0.5),
+            trace_id=b_tid, span_id=b_sid)
+        try:
+            await conn.send(msg)
+        except TRANSPORT_ERRORS:
+            pass  # op dropped either way; client times out + resends
+
     def _op_backoff_reason(self, op: MOSDOp) -> Optional[Tuple[Tuple[int, int], str]]:
         """((pool, pg), reason) when this op must be BLOCKED via
         MOSDBackoff instead of served (reference PrimaryLogPG
         maybe_handle_backoff / the waiting_for_peered queue):
 
-        - "queue": the sharded dispatch queue is saturated past
-          osd_backoff_queue_depth — shed load with a short timed block
-          instead of buffering unboundedly (0 disables).
         - "peering": a mutation while the PG's peering pass has not yet
           merged the authoritative log AND the window is actually unsafe
           — the interval moved primaryship onto us (a resend racing the
@@ -1852,6 +1965,10 @@ class OSD:
           reqid) or the PG is below min_size (the write would only burn
           EAGAIN retries).  Healthy same-primary intervals (pool create,
           rebalance without failover) serve ops as before.
+
+        (The "queue" saturation shed moved to the ARRIVAL side —
+        _maybe_shed_queue — so a saturated OSD drops before the op
+        consumes a queue slot.)
         """
         if self.osdmap is None or op.op not in self._BACKOFF_OPS:
             return None
@@ -1860,9 +1977,6 @@ class OSD:
             return None
         pg = self.osdmap.object_to_pg(pool, op.oid)
         key = (op.pool_id, pg)
-        qmax = int(self.conf.get("osd_backoff_queue_depth", 0) or 0)
-        if qmax and self.op_queue.depth() > qmax:
-            return key, "queue"
         if op.op not in self._BACKOFF_MUTATIONS:
             return None
         m = self._pg_machines.get(key)
@@ -1879,21 +1993,20 @@ class OSD:
         return None
 
     async def _maybe_backoff(self, conn, op: MOSDOp) -> bool:
-        """Send an MOSDBackoff block and DROP the op when the PG cannot
-        serve it right now; returns True when the op was dropped.  The
-        client parks everything for the PG until the unblock (peering
-        blocks register the conn for release) or until ``duration``
-        expires (queue-shed blocks, and the liveness bound for a dying
-        primary)."""
+        """Send an MOSDBackoff block and DROP the op when the PG's
+        peering window cannot serve it right now; returns True when the
+        op was dropped.  The client parks everything for the PG until
+        the unblock (the conn registers for release) or until
+        ``duration`` expires (the liveness bound for a dying primary).
+        Queue-saturation sheds live on the arrival side
+        (_maybe_shed_queue)."""
         got = self._op_backoff_reason(op)
         if got is None:
             return False
         key, reason = got
-        ent = self._backoffs_sent.get(key) if reason == "peering" else None
+        ent = self._backoffs_sent.get(key)
         bid = ent["id"] if ent is not None else uuid.uuid4().hex
-        duration = (float(self.conf.get("osd_backoff_secs", 0.5) or 0.5)
-                    if reason == "queue"
-                    else float(self.conf.get("osd_backoff_max", 3.0) or 3.0))
+        duration = float(self.conf.get("osd_backoff_max", 3.0) or 3.0)
         self.perf.inc("backoffs_sent")
         tracked = getattr(op, "_tracked", None)
         b_tid = b_sid = ""

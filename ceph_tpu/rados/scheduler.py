@@ -18,6 +18,28 @@ the same shard — and each shard's worker drains a pluggable scheduler:
   and the cache-tier flush/evict agent, whose single-flight passes ride
   CLASS_BEST_EFFORT so eviction work never outruns client reads).
 
+dmClock tag discipline (multi-tenant QoS, reference mClockScheduler.cc
+client_profile_id_map): a CLASS_CLIENT op that carries a client entity
+name (MOSDOp v6 ``client``) gets its OWN tag state — per-client
+isolation, managed by qos.ClientRegistry — created from the pool's
+resolved profile (qos.pool_qos: ``pool set qos_reservation /
+qos_weight / qos_limit`` defaults plus ``qos_class:<name>`` tenant-class
+overrides, all mon-validated and osdmap-distributed).  Tags at arrival
+t:  R = max(R + 1/r, t), P = max(P + 1/w, t), L = max(L + 1/l, t);
+reservation and limit are ops/sec (IOPS — tags advance by one op; byte
+cost stays with the queue's budget throttle).  Dequeue: (1) any state
+with a due R-tag, earliest first — the reservation guarantee; (2) else
+the smallest P-tag among states under their limit — weighted surplus
+sharing; (3) else the smallest P-tag outright — work-conserving: the
+limit SHAPES ordering under contention but never idles the shard (the
+hard enforcement of a flooder's limit is the admission-side saturation
+shed, osd.py _op_backoff_reason via qos.QosTracker).  The serving split
+is counted in the ``osd_scheduler`` perf set
+(served_reservation/served_weight/served_fallback); per-shard states
+each see ~1/n_shards of a client's traffic, so profiles apply
+per-shard while the OSD-level QosTracker sees the full offered rate.
+``clock`` is injectable for deterministic tag-math tests.
+
 The asyncio translation: shard workers are tasks, not threads.  The
 scheduler decides ORDER; execution preserves strict ordering only per
 order_key (the PG): ops for the SAME PG run one at a time in dequeue
@@ -36,6 +58,8 @@ import itertools
 import time
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+
+from ceph_tpu.rados.qos import ClientRegistry, ClientState, QosParams
 
 CLASS_CLIENT = "client"
 CLASS_RECOVERY = "recovery"
@@ -69,7 +93,10 @@ class WPQScheduler:
         self._size = 0
 
     def enqueue(self, op_class: str, run, cost: int = 1,
-                priority: Optional[int] = None, order_key: Any = None) -> None:
+                priority: Optional[int] = None, order_key: Any = None,
+                client: str = "", qos: Optional[QosParams] = None) -> None:
+        # WPQ has no per-client state: client/qos are accepted (one
+        # enqueue signature across schedulers) and ignored
         prio = priority if priority is not None else self.PRIORITIES.get(
             op_class, 1)
         item = _Item(sort_key=(next(_seq),), run=run, op_class=op_class,
@@ -108,21 +135,17 @@ class WPQScheduler:
         return self._size
 
 
-@dataclass
-class _MClockClass:
-    reservation: float  # ops/sec guaranteed
-    weight: float  # share when capacity remains
-    limit: float  # ops/sec cap (0 = unlimited)
-    r_tag: float = 0.0
-    p_tag: float = 0.0
-    l_tag: float = 0.0
-    queue: List[_Item] = field(default_factory=list)
+# the per-class tag state lives in qos.py (shared with the per-client
+# registry); the historic name stays importable
+_MClockClass = ClientState
 
 
 class MClockScheduler:
     """dmClock-style tag scheduler (reference mClockScheduler.cc profiles:
     client gets reservation+weight, recovery gets weight-only with a limit,
-    best-effort gets leftovers)."""
+    best-effort gets leftovers) with per-CLIENT states for CLASS_CLIENT
+    ops carrying an entity name (the module docstring's dmClock tag
+    discipline)."""
 
     DEFAULT_PROFILE = {
         CLASS_CLIENT: (100.0, 10.0, 0.0),
@@ -132,14 +155,22 @@ class MClockScheduler:
 
     STRICT_CUTOFF = WPQScheduler.STRICT_CUTOFF
 
-    def __init__(self, conf: Optional[dict] = None):
+    def __init__(self, conf: Optional[dict] = None, perf=None,
+                 clock=time.monotonic):
         conf = conf or {}
+        self.clock = clock  # injectable for deterministic tag-math tests
+        self.perf = perf
         self.classes: Dict[str, _MClockClass] = {}
         for name, (r, w, l) in self.DEFAULT_PROFILE.items():
             r = float(conf.get(f"mclock_{name}_res", r))
             w = float(conf.get(f"mclock_{name}_wgt", w))
             l = float(conf.get(f"mclock_{name}_lim", l))
             self.classes[name] = _MClockClass(r, w, l)
+        # per-client tag states (reference client_profile_id_map),
+        # bounded; only CLASS_CLIENT ops with an identity land here
+        self.clients = ClientRegistry(
+            int(conf.get("osd_mclock_max_clients", 1024) or 1024),
+            perf=perf)
         # ops at/above the cutoff bypass tag scheduling entirely (the
         # reference mClockScheduler keeps the same strict high_priority
         # queue, mClockScheduler.h) — both schedulers honor `priority`
@@ -147,53 +178,77 @@ class MClockScheduler:
         self._size = 0
 
     def enqueue(self, op_class: str, run, cost: int = 1,
-                priority: Optional[int] = None, order_key: Any = None) -> None:
+                priority: Optional[int] = None, order_key: Any = None,
+                client: str = "", qos: Optional[QosParams] = None) -> None:
         if priority is not None and priority >= self.STRICT_CUTOFF:
             self._strict.append(_Item(sort_key=(next(_seq),), run=run,
                                       op_class=op_class, cost=cost,
                                       order_key=order_key))
             self._size += 1
             return
-        c = self.classes.setdefault(
-            op_class, _MClockClass(1.0, 1.0, 0.0))
-        now = time.monotonic()
-        cost = max(1, cost)
-        c.r_tag = max(c.r_tag + cost / c.reservation, now) if c.reservation else 1e18
-        c.p_tag = max(c.p_tag + cost / c.weight, now)
-        c.l_tag = max(c.l_tag + cost / c.limit, now) if c.limit else 0.0
-        item = _Item(sort_key=(c.r_tag, c.p_tag, next(_seq)), run=run,
-                     op_class=op_class, cost=cost, order_key=order_key)
+        now = self.clock()
+        if op_class == CLASS_CLIENT and client:
+            # per-client dmClock state, created/refreshed from the op's
+            # resolved pool profile; tags advance by ONE op (IOPS)
+            c = self.clients.get(
+                client, qos if qos is not None else QosParams(
+                    *self.DEFAULT_PROFILE[CLASS_CLIENT]), now)
+            tag_cost = 1
+        else:
+            c = self.classes.setdefault(
+                op_class, _MClockClass(1.0, 1.0, 0.0))
+            tag_cost = max(1, cost)
+        c.r_tag = max(c.r_tag + tag_cost / c.reservation, now) \
+            if c.reservation else 1e18
+        c.p_tag = max(c.p_tag + tag_cost / c.weight, now)
+        c.l_tag = max(c.l_tag + tag_cost / c.limit, now) if c.limit else 0.0
+        # sort_key = (R, P, seq, L): the item's OWN tags — phase 1 serves
+        # a due head R, phase 2 skips a class whose head L is still in
+        # the future (the strict dmClock limit check; the class-level
+        # l_tag alone would let a high-weight backlog outrun its limit)
+        item = _Item(sort_key=(c.r_tag, c.p_tag, next(_seq), c.l_tag),
+                     run=run, op_class=op_class, cost=cost,
+                     order_key=order_key)
         c.queue.append(item)
         self._size += 1
+
+    def _states(self):
+        yield from self.classes.values()
+        yield from self.clients.states.values()
 
     def dequeue(self) -> Optional[_Item]:
         if self._strict:
             self._size -= 1
             return self._strict.pop(0)
-        now = time.monotonic()
+        now = self.clock()
         # phase 1: reservations due
-        best_c, best_tag = None, None
-        for c in self.classes.values():
+        best_c, best_tag, phase = None, None, "reservation"
+        for c in self._states():
             if c.queue and c.reservation:
                 head_tag = c.queue[0].sort_key[0]
                 if head_tag <= now and (best_tag is None or head_tag < best_tag):
                     best_c, best_tag = c, head_tag
         if best_c is None:
-            # phase 2: weight-based among classes under their limit
-            for c in self.classes.values():
+            # phase 2: weight-based among states under their limit
+            phase = "weight"
+            for c in self._states():
                 if not c.queue:
                     continue
-                if c.limit and c.queue[0].sort_key[1] > now and c.l_tag > now:
-                    continue  # over limit
-                head_p = c.queue[0].sort_key[1]
+                head = c.queue[0]
+                if c.limit and (head.sort_key[3] if len(head.sort_key) > 3
+                                else c.l_tag) > now:
+                    continue  # over limit: the head's L-tag is in the future
+                head_p = head.sort_key[1]
                 if best_tag is None or head_p < best_tag:
                     best_c, best_tag = c, head_p
         if best_c is None:
             # work-conserving fallback: everything left is over its limit;
             # rather than idle the shard, serve the smallest P-tag (the
             # limit shapes ordering under contention, it never starves the
-            # queue — divergence from strict dmClock limit semantics)
-            for c in self.classes.values():
+            # queue — divergence from strict dmClock limit semantics; the
+            # HARD cap on a flooder is the admission-side saturation shed)
+            phase = "fallback"
+            for c in self._states():
                 if not c.queue:
                     continue
                 head_p = c.queue[0].sort_key[1]
@@ -202,15 +257,40 @@ class MClockScheduler:
         if best_c is None:
             return None
         self._size -= 1
+        if self.perf is not None:
+            self.perf.inc(f"served_{phase}")
         return best_c.queue.pop(0)
+
+    def dump(self) -> Dict[str, Any]:
+        """Per-class and per-client queue depths + current dmClock tags
+        (the asok ``dump_op_queue`` payload for one shard)."""
+        now = self.clock()
+
+        def one(c: _MClockClass) -> Dict[str, Any]:
+            # tags are absolute clock values; report them as deltas from
+            # now (negative = due).  0.0 = never enqueued: unset (None).
+            return {"depth": len(c.queue),
+                    "reservation": c.reservation, "weight": c.weight,
+                    "limit": c.limit,
+                    "r_tag": round(c.r_tag - now, 6)
+                    if c.r_tag and c.r_tag < 1e17 else None,
+                    "p_tag": round(c.p_tag - now, 6) if c.p_tag else None,
+                    "l_tag": round(c.l_tag - now, 6) if c.l_tag else 0.0}
+
+        return {"strict": len(self._strict),
+                "classes": {n: one(c) for n, c in self.classes.items()},
+                "clients": {n: one(c)
+                            for n, c in self.clients.states.items()}}
 
     def __len__(self) -> int:
         return self._size
 
 
-def make_scheduler(conf: Optional[dict] = None):
+def make_scheduler(conf: Optional[dict] = None, perf=None,
+                   clock=time.monotonic):
     kind = (conf or {}).get("osd_op_queue", "wpq")
-    return MClockScheduler(conf) if kind == "mclock" else WPQScheduler(conf)
+    return MClockScheduler(conf, perf=perf, clock=clock) \
+        if kind == "mclock" else WPQScheduler(conf)
 
 
 class ShardedOpQueue:
@@ -218,11 +298,15 @@ class ShardedOpQueue:
     role).  `shard_of(key)` pins a PG to a shard so per-PG order holds."""
 
     def __init__(self, n_shards: int = 4, conf: Optional[dict] = None,
-                 perf=None, max_cost: int = 8192):
+                 perf=None, max_cost: int = 8192, sched_perf=None):
         self.n_shards = max(1, n_shards)
         self.conf = conf or {}
         self.perf = perf
-        self._scheds = [make_scheduler(conf) for _ in range(self.n_shards)]
+        # the `osd_scheduler` set (qos.build_scheduler_perf): per-class
+        # flow counters + dmClock serving split, shared by all shards
+        self.sched_perf = sched_perf
+        self._scheds = [make_scheduler(conf, perf=sched_perf)
+                        for _ in range(self.n_shards)]
         self._events = [asyncio.Event() for _ in range(self.n_shards)]
         self._tasks: List[asyncio.Task] = []
         self._stopped = False
@@ -236,6 +320,10 @@ class ShardedOpQueue:
         # per-shard strong refs to spawned op tasks: stop() cancels them,
         # and asyncio's weak task refs cannot GC one mid-flight
         self._inflight: List[set] = [set() for _ in range(self.n_shards)]
+        # admitted-but-unfinished ops (queued + running): the saturation
+        # signal the QoS shed gates on — depth() alone misses ops whose
+        # lifetime is spent RUNNING on per-PG chains rather than queued
+        self.inflight_ops = 0
 
     def start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -258,14 +346,22 @@ class ShardedOpQueue:
 
     async def enqueue(self, pg_key: int, run: Callable[[], Awaitable[None]],
                       op_class: str = CLASS_CLIENT, cost: int = 1,
-                      priority: Optional[int] = None) -> None:
+                      priority: Optional[int] = None, client: str = "",
+                      qos: Optional[QosParams] = None) -> None:
         cost = max(1, cost)
         await self._budget.get(cost)  # blocks when queues are full
+        self.inflight_ops += 1
         shard = self.shard_of(pg_key)
         self._scheds[shard].enqueue(op_class, run, cost, priority=priority,
-                                    order_key=pg_key)
+                                    order_key=pg_key, client=client,
+                                    qos=qos)
         if self.perf is not None:
             self.perf.inc("op_queued")
+        if self.sched_perf is not None:
+            self.sched_perf.ensure(f"enqueue_{op_class}")
+            self.sched_perf.inc(f"enqueue_{op_class}")
+            self.sched_perf.set("queue_depth", self.depth())
+            self.sched_perf.set("qos_clients", self.qos_clients())
         self._events[shard].set()
 
     async def _drain(self, shard: int) -> None:
@@ -318,6 +414,7 @@ class ShardedOpQueue:
                 # cancellation included (a leaked token would shrink the
                 # queue forever)
                 self._budget.put(item.cost)
+                self.inflight_ops -= 1
 
         while not self._stopped:
             # Capacity-gate the dequeue: hold an execution slot BEFORE
@@ -334,6 +431,10 @@ class ShardedOpQueue:
                 event.clear()
                 await event.wait()
                 continue
+            if self.sched_perf is not None:
+                self.sched_perf.ensure(f"dequeue_{item.op_class}")
+                self.sched_perf.inc(f"dequeue_{item.op_class}")
+                self.sched_perf.set("queue_depth", self.depth())
             key = item.order_key
             prev = running.get(key)
             # the slot acquired above is transferred to _run_item
@@ -349,3 +450,28 @@ class ShardedOpQueue:
 
     def depth(self) -> int:
         return sum(len(s) for s in self._scheds)
+
+    def qos_clients(self) -> int:
+        """Per-client dmClock states alive across shards (0 for WPQ)."""
+        return sum(len(s.clients) for s in self._scheds
+                   if isinstance(s, MClockScheduler))
+
+    def dump(self) -> Dict[str, Any]:
+        """Per-shard scheduler snapshot — the asok ``dump_op_queue``
+        payload: per-class/per-client queue depths and current dmClock
+        tags (mClock shards) or per-priority depths (WPQ shards)."""
+        shards = []
+        for i, s in enumerate(self._scheds):
+            if isinstance(s, MClockScheduler):
+                d = s.dump()
+            else:
+                d = {"strict": len(s._strict),
+                     "priorities": {p: len(q)
+                                    for p, q in s._queues.items()}}
+            d["shard"] = i
+            d["depth"] = len(s)
+            shards.append(d)
+        return {"scheduler": type(self._scheds[0]).__name__,
+                "depth": self.depth(),
+                "qos_clients": self.qos_clients(),
+                "shards": shards}

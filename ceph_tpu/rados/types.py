@@ -662,7 +662,7 @@ class MConfigReply:
 # Client <-> primary OSD
 
 
-@message(20, version=5)
+@message(20, version=6)
 class MOSDOp:
     op: str = "read"  # write | read | delete | list | repair | deep-scrub | call | multi
     pool_id: int = 0
@@ -721,6 +721,13 @@ class MOSDOp:
     # entirely (truncated-tail fixed decode leaves the defaults).
     trace_id: str = ""
     span_id: str = ""
+    # v6: the sender's entity name (reference MOSDOp's osd_reqid_t
+    # carries entity_name_t) — the identity the OSD's per-client dmClock
+    # QoS keys on.  "client.<class>.<id>" names a tenant class (the
+    # middle token selects a pool's qos_class:<name> profile override);
+    # "" = anonymous (pre-v6 frames, admin fan-outs) rides the pool's
+    # default client profile.
+    client: str = ""
 
 
 @message(21, version=2)
@@ -1235,6 +1242,9 @@ MOSDOp.FIXED_FIELDS = [
     # simply ends here and the decoder's truncated-tail rule defaults
     # them (golden-replay-guarded in tests/test_op_tracking.py)
     ("trace_id", "s"), ("span_id", "s"),
+    # v6 tail: client entity name (golden pre-v6 frames replayed by the
+    # corpus check and tests/test_qos.py decode with the "" default)
+    ("client", "s"),
 ]
 # a compound op vector (multi) carries arbitrary typed kwargs: pickle
 MOSDOp.FIXED_WHEN = staticmethod(lambda m: not m.ops)

@@ -27,7 +27,8 @@ from typing import Dict, List
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="ceph cluster status tool")
-    p.add_argument("--mon", required=True, help="mon address host:port")
+    p.add_argument("--mon", help="mon address host:port (not needed for "
+                                 "`daemon ASOK CMD`)")
     p.add_argument("--format", choices=("plain", "json"), default="plain")
     p.add_argument("--yes-i-really-really-mean-it", action="store_true",
                    dest="confirm_destroy",
@@ -38,8 +39,46 @@ def parse_args(argv=None):
                         "df | osd df | osd tree | pg dump | "
                         "osd pool ls | osd pool create NAME [k=v...] | "
                         "osd pool set NAME KEY VALUE | "
-                        "osd pool rm NAME NAME --yes-i-really-really-mean-it")
+                        "osd pool rm NAME NAME --yes-i-really-really-mean-it"
+                        " | daemon ASOK_PATH CMD [k=v...]")
     return p.parse_args(argv)
+
+
+def render_op_queue(dump: Dict) -> List[str]:
+    """Render a daemon's ``dump_op_queue`` answer (scheduler.py
+    ShardedOpQueue.dump + the OSD's admission-tracker view): per-shard
+    per-class/per-client depths and current dmClock tags, then the
+    over-limit ranking the saturation shed uses.  Pure so tests can pin
+    the layout."""
+    lines = [f"{dump.get('scheduler', '?')}: depth {dump.get('depth', 0)}"
+             f", {dump.get('qos_clients', 0)} client states"]
+
+    def tag(v) -> str:
+        return "-" if v is None else f"{v:+.3f}"
+
+    for sh in dump.get("shards", []):
+        lines.append(f"  shard {sh.get('shard')}: depth {sh.get('depth', 0)}"
+                     f" (strict {sh.get('strict', 0)})")
+        for kind in ("classes", "clients"):
+            for name, c in sorted((sh.get(kind) or {}).items()):
+                lines.append(
+                    f"    {'client ' if kind == 'clients' else ''}"
+                    f"{name:<24} depth {c['depth']:<4} "
+                    f"r/w/l {c['reservation']:g}/{c['weight']:g}/"
+                    f"{c['limit']:g}  tags r {tag(c['r_tag'])} "
+                    f"p {tag(c['p_tag'])} l {tag(c['l_tag'])}")
+    admission = dump.get("admission") or {}
+    if admission:
+        lines.append("  admission (over-limit ranking):")
+        ranked = sorted(admission.items(),
+                        key=lambda kv: -kv[1].get("excess_s", 0.0))
+        for name, st in ranked[:16]:
+            lines.append(f"    {name:<24} limit {st.get('limit', 0):g}  "
+                         f"excess {st.get('excess_s', 0.0):+.3f}s  "
+                         f"idle {st.get('idle_s', 0.0):.1f}s")
+        if len(ranked) > 16:
+            lines.append(f"    ... {len(ranked) - 16} more clients")
+    return lines
 
 
 def _pg_states(osdmap) -> List[Dict]:
@@ -143,6 +182,32 @@ async def _df(client) -> List[Dict]:
 async def run(args) -> int:
     from ceph_tpu.rados.client import RadosClient
 
+    if args.words[0] == "daemon":
+        # `ceph daemon ASOK CMD [k=v...]` role: one admin-socket command
+        # against a running daemon — no mon needed
+        if len(args.words) < 3:
+            print("usage: daemon ASOK_PATH COMMAND [k=v...]",
+                  file=sys.stderr)
+            return 2
+        from ceph_tpu.common.admin_socket import asok_command
+
+        path, prefix = args.words[1], " ".join(args.words[2:3])
+        # multi-word asok prefixes ("perf dump", "tier status") and
+        # k=v arguments after them
+        rest = args.words[3:]
+        while rest and "=" not in rest[0]:
+            prefix += " " + rest.pop(0)
+        kwargs = dict(kv.split("=", 1) for kv in rest)
+        result = await asok_command(path, prefix, **kwargs)
+        if args.format == "json" or prefix != "dump_op_queue":
+            print(json.dumps(result, indent=1, default=repr))
+        else:
+            for line in render_op_queue(result):
+                print(line)
+        return 0
+    if not args.mon:
+        print("--mon is required for cluster commands", file=sys.stderr)
+        return 2
     host, port = args.mon.rsplit(":", 1)
     client = RadosClient((host, int(port)))
     await client.start()

@@ -10,7 +10,12 @@ types whose wire version regressed vs a recorded corpus file.
     python -m ceph_tpu.tools.dencoder roundtrip
     python -m ceph_tpu.tools.dencoder corpus --write corpus.json
     python -m ceph_tpu.tools.dencoder corpus --check corpus.json
-"""
+    python -m ceph_tpu.tools.dencoder golden     # replay corpus/wire
+
+`golden` replays the archived binary frame corpus (corpus/wire/*.frame,
+field-for-field) AND the golden old-build frames (corpus/wire/golden/ —
+pre-trace v4, pre-qos MOSDOp v5), proving the truncated-tail decode
+rule keeps every archived generation decodable."""
 
 from __future__ import annotations
 
@@ -97,6 +102,7 @@ def main(argv=None) -> int:
     sub = p.add_subparsers(dest="cmd", required=True)
     sub.add_parser("list")
     sub.add_parser("roundtrip")
+    sub.add_parser("golden")
     c = sub.add_parser("corpus")
     c.add_argument("--write", default="")
     c.add_argument("--check", default="")
@@ -105,6 +111,10 @@ def main(argv=None) -> int:
         return cmd_list()
     if args.cmd == "roundtrip":
         return cmd_roundtrip()
+    if args.cmd == "golden":
+        from ceph_tpu.tools.wire_corpus import check
+
+        return check()
     return cmd_corpus(args.write, args.check)
 
 

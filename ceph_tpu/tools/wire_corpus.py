@@ -56,7 +56,8 @@ def _sample_messages() -> List[Any]:
                  method="lock", snapc_seq=9, snapc_snaps=[9, 4, 2],
                  snap_read=7, snap_id=5, pg=12, cursor="after",
                  max_entries=64, nspace="blue", fadvise="willneed",
-                 trace_id="deadbeefcafef00d", span_id="0123456789abcdef"),
+                 trace_id="deadbeefcafef00d", span_id="0123456789abcdef",
+                 client="client.gold.7"),
         t.MOSDOp(op="multi", pool_id=1, oid="m", reqid="r2",
                  ops=[("setxattr", {"name": "a", "value": b"v"}),
                       ("omap_set", {"entries": {"k": b"x"}})]),

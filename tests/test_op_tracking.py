@@ -364,7 +364,13 @@ class TestOldFrameDecode:
             blob = raw[off + 4:off + 4 + blen] if blen else None
             msg = decode_message(type_id, version, payload, blob,
                                  bool(fixed))
-            assert getattr(msg, "trace_id", "") == ""
+            if "pretrace" in name:
+                # archived before the trace tail existed: the truncated-
+                # tail rule must default it
+                assert getattr(msg, "trace_id", "") == ""
+            if "preqos" in name:
+                # archived before the MOSDOp v6 client tail existed
+                assert getattr(msg, "client", "") == ""
 
 
 # -- health model: raise / clear / mute lifecycle ----------------------------

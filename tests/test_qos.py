@@ -1,0 +1,524 @@
+"""Multi-tenant QoS tests: dmClock tag math under a fake clock, the
+per-client registry/admission tracker, pool profile resolution + mon
+validation, the MOSDOp v6 client field, the saturation shed e2e, and
+the dump_op_queue surfaces (reference src/osd/scheduler/mClockScheduler
+client_profile_id_map semantics)."""
+
+import asyncio
+import os
+
+import pytest
+
+from ceph_tpu.rados.qos import (ClientRegistry, QosParams, QosTracker,
+                                parse_class_profile, pool_qos,
+                                tenant_class, validate_pool_qos)
+from ceph_tpu.rados.scheduler import CLASS_CLIENT, MClockScheduler
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _drain(s, n, rate, clock):
+    """Dequeue n items at `rate` per virtual second; items carry their
+    label as the (never-called) run field."""
+    served = []
+    for _ in range(n):
+        item = s.dequeue()
+        if item is None:
+            break
+        served.append(item.run)
+        clock.advance(1.0 / rate)
+    return served
+
+
+class TestTagMath:
+    """MClockScheduler tag math under a fake clock (previously only
+    exercised e2e through the OSD)."""
+
+    def test_reservation_guarantee_holds_under_overload(self):
+        clock = FakeClock()
+        s = MClockScheduler({}, clock=clock)
+        # reserved client guaranteed 20 ops/s; flooder has weight only.
+        # 10x flooder backlog must not dent the reservation.
+        for i in range(200):
+            s.enqueue(CLASS_CLIENT, f"F{i}", client="client.flood.1",
+                      qos=QosParams(0.0, 10.0, 0.0))
+        for i in range(20):
+            s.enqueue(CLASS_CLIENT, f"R{i}", client="client.gold.1",
+                      qos=QosParams(20.0, 1.0, 0.0))
+        served = _drain(s, 40, 40.0, clock)  # one virtual second
+        reserved = [x for x in served if x.startswith("R")]
+        # 20 ops/s reservation over 1s of virtual time: all 20 due
+        assert len(reserved) >= 18, served
+
+    def test_limit_caps_flooding_class(self):
+        clock = FakeClock()
+        s = MClockScheduler({}, clock=clock)
+        for i in range(300):
+            s.enqueue(CLASS_CLIENT, f"F{i}", client="client.flood.1",
+                      qos=QosParams(0.0, 10.0, 5.0))  # limit 5/s
+            s.enqueue(CLASS_CLIENT, f"B{i}", client="client.bulk.1",
+                      qos=QosParams(0.0, 1.0, 0.0))  # unlimited
+        served = _drain(s, 60, 30.0, clock)  # two virtual seconds
+        flooder = [x for x in served if x.startswith("F")]
+        # despite 10x the weight, the flooder is held near limit * t
+        # (2s * 5/s = 10) while the unlimited class absorbs the surplus
+        assert len(flooder) <= 14, f"limit not enforced: {len(flooder)}"
+        assert len(served) == 60  # work-conserving: server never idles
+
+    def test_weights_split_surplus_proportionally(self):
+        clock = FakeClock()  # frozen: pure weight-phase ordering
+        s = MClockScheduler({}, clock=clock)
+        for i in range(200):
+            s.enqueue(CLASS_CLIENT, f"A{i}", client="client.a.1",
+                      qos=QosParams(0.0, 6.0, 0.0))
+            s.enqueue(CLASS_CLIENT, f"B{i}", client="client.b.1",
+                      qos=QosParams(0.0, 2.0, 0.0))
+            s.enqueue(CLASS_CLIENT, f"C{i}", client="client.c.1",
+                      qos=QosParams(0.0, 1.0, 0.0))
+        served = [s.dequeue().run for _ in range(90)]
+        counts = {k: len([x for x in served if x.startswith(k)])
+                  for k in "ABC"}
+        # 6:2:1 split of 90 = 60/20/10
+        assert abs(counts["A"] - 60) <= 3, counts
+        assert abs(counts["B"] - 20) <= 3, counts
+        assert abs(counts["C"] - 10) <= 3, counts
+
+    def test_serving_split_counters(self):
+        from ceph_tpu.rados.qos import build_scheduler_perf
+
+        perf = build_scheduler_perf()
+        clock = FakeClock()
+        s = MClockScheduler({}, perf=perf, clock=clock)
+        s.enqueue(CLASS_CLIENT, "r1", client="client.g.1",
+                  qos=QosParams(10.0, 1.0, 0.0))
+        clock.advance(1.0)  # the reservation tag is due
+        assert s.dequeue().run == "r1"
+        assert perf.get("served_reservation") == 1
+        s.enqueue(CLASS_CLIENT, "w1", client="client.w.1",
+                  qos=QosParams(0.0, 1.0, 0.0))
+        assert s.dequeue().run == "w1"
+        assert perf.get("served_weight") == 1
+        s.enqueue(CLASS_CLIENT, "f1", client="client.f.1",
+                  qos=QosParams(0.0, 1.0, 0.001))  # hopelessly over limit
+        s.enqueue(CLASS_CLIENT, "f2", client="client.f.1",
+                  qos=QosParams(0.0, 1.0, 0.001))
+        assert {s.dequeue().run, s.dequeue().run} == {"f1", "f2"}
+        assert perf.get("served_fallback") >= 1
+
+    def test_profile_refresh_applies_to_live_state(self):
+        clock = FakeClock()
+        s = MClockScheduler({}, clock=clock)
+        s.enqueue(CLASS_CLIENT, "x", client="client.g.1",
+                  qos=QosParams(10.0, 1.0, 0.0))
+        st = s.clients.states["client.g.1"]
+        assert st.reservation == 10.0
+        s.enqueue(CLASS_CLIENT, "y", client="client.g.1",
+                  qos=QosParams(99.0, 7.0, 3.0))
+        assert (st.reservation, st.weight, st.limit) == (99.0, 7.0, 3.0)
+
+
+class TestClientRegistry:
+    def test_bounded_prunes_idle_only(self):
+        clock = FakeClock()
+        reg = ClientRegistry(max_clients=8)
+        p = QosParams(1.0, 1.0, 0.0)
+        busy = reg.get("busy", p, clock())
+        busy.queue.append(object())  # queued op: never prunable
+        for i in range(20):
+            clock.advance(0.1)
+            reg.get(f"idle{i}", p, clock())
+        assert len(reg) <= 9  # bound respected (modulo the new state)
+        assert "busy" in reg.states
+
+
+class TestQosTracker:
+    def test_excess_builds_and_decays(self):
+        clock = FakeClock()
+        t = QosTracker(clock=clock)
+        p = QosParams(0.0, 1.0, 10.0)  # limit 10/s
+        for _ in range(20):
+            t.observe("c", p)  # instantaneous 20-op burst: +2s of tags
+        assert t.excess("c") == pytest.approx(2.0, abs=0.01)
+        clock.advance(1.5)
+        assert t.excess("c") == pytest.approx(0.5, abs=0.01)
+        clock.advance(1.0)
+        assert t.excess("c") <= 0.0
+
+    def test_arrears_cap_bounds_memory(self):
+        clock = FakeClock()
+        t = QosTracker(clock=clock, arrears_cap=1.0)
+        p = QosParams(0.0, 1.0, 10.0)
+        for _ in range(500):
+            t.observe("c", p)
+        assert t.excess("c") <= 1.0 + 1e-9
+
+    def test_worst_and_should_shed(self):
+        clock = FakeClock()
+        t = QosTracker(clock=clock)
+        lim = QosParams(0.0, 1.0, 10.0)
+        free = QosParams(0.0, 1.0, 0.0)
+        for _ in range(30):
+            t.observe("flood", lim)
+        t.observe("gold", free)
+        worst, excess = t.worst_over_limit(0.25)
+        assert worst == "flood" and excess > 0.25
+        # qos-directed: flooder shed, compliant client admitted
+        assert t.should_shed("flood", 0.25) == (True, True)
+        assert t.should_shed("gold", 0.25) == (False, True)
+        assert t.should_shed("", 0.25) == (False, True)
+        # nobody over limit: legacy shed-the-arrival
+        clock.advance(100.0)
+        assert t.should_shed("gold", 0.25) == (True, False)
+
+    def test_unlimited_pool_cannot_launder_arrears(self):
+        """State is per client, params per pool: one op resolved through
+        a limit-free pool must not reset a flooder's accumulated
+        over-limit arrears (the shed-evasion hole)."""
+        clock = FakeClock()
+        t = QosTracker(clock=clock)
+        limited = QosParams(0.0, 1.0, 10.0)
+        unlimited = QosParams(0.0, 1.0, 0.0)
+        for _ in range(30):
+            t.observe("flood", limited)
+        before = t.excess("flood")
+        assert before > 1.0
+        t.observe("flood", unlimited)  # the laundering attempt
+        assert t.excess("flood") == pytest.approx(before, abs=0.01)
+        assert t.should_shed("flood", 0.25) == (True, True)
+
+    def test_worst_candidate_survives_within_grace(self):
+        """The max-L-tag candidate is kept even while within grace, so
+        saturated arrivals stay O(1) (no rescan per op)."""
+        clock = FakeClock()
+        t = QosTracker(clock=clock)
+        p = QosParams(0.0, 1.0, 10.0)
+        for _ in range(3):
+            t.observe("c", p)  # 0.3s of arrears: under a 0.5 grace
+        assert t.worst_over_limit(0.5) == (None, 0.0)
+        assert t._worst == "c"  # candidate retained for the fast path
+
+    def test_bounded_clients(self):
+        clock = FakeClock()
+        t = QosTracker(max_clients=16, clock=clock)
+        p = QosParams(0.0, 1.0, 5.0)
+        for i in range(100):
+            clock.advance(0.01)
+            t.observe(f"c{i}", p)
+        assert len(t) <= 16
+
+
+class TestProfiles:
+    def test_tenant_class(self):
+        assert tenant_class("client.gold.123") == "gold"
+        assert tenant_class("client.17") == ""
+        assert tenant_class("client") == ""
+        assert tenant_class("") == ""
+        assert tenant_class("client.a.b.c") == "a"
+
+    def test_parse_class_profile(self):
+        p = parse_class_profile("100:10:50")
+        assert (p.reservation, p.weight, p.limit) == (100.0, 10.0, 50.0)
+        for bad in ("1:2", "a:b:c", "1:0:1", "-1:2:3", "1:2:-3"):
+            with pytest.raises(ValueError):
+                parse_class_profile(bad)
+
+    def test_validate_pool_qos(self):
+        assert validate_pool_qos("qos_reservation", "50")
+        assert validate_pool_qos("qos_limit", "0")
+        assert not validate_pool_qos("qos_weight", "0")
+        assert not validate_pool_qos("qos_reservation", "-1")
+        assert not validate_pool_qos("qos_reservation", "abc")
+        assert validate_pool_qos("qos_class:gold", "100:10:0")
+        assert not validate_pool_qos("qos_class:gold", "nope")
+        assert not validate_pool_qos("qos_class:", "1:1:1")
+        assert not validate_pool_qos("something_else", "1")
+
+    def test_pool_qos_resolution(self):
+        class Pool:
+            opts = {"qos_reservation": "30", "qos_weight": "3",
+                    "qos_limit": "60", "qos_class:gold": "200:20:0"}
+
+        # tenant-class override wins
+        p = pool_qos(Pool(), "client.gold.1")
+        assert (p.reservation, p.weight, p.limit) == (200.0, 20.0, 0.0)
+        # other classes and plain clients ride the pool defaults
+        p = pool_qos(Pool(), "client.other.1")
+        assert (p.reservation, p.weight, p.limit) == (30.0, 3.0, 60.0)
+        p = pool_qos(Pool(), "client.17")
+        assert p.reservation == 30.0
+
+        class Bare:
+            opts = {}
+
+        # config fallback
+        p = pool_qos(Bare(), "client.x.1",
+                     {"osd_qos_default_limit": 77})
+        assert p.limit == 77.0
+        # garbage opts never raise (pre-validation stores)
+        class Bad:
+            opts = {"qos_reservation": "zzz"}
+
+        assert pool_qos(Bad(), "client.1").reservation == 100.0
+
+
+class TestWireV6:
+    def test_client_field_round_trip(self):
+        from ceph_tpu.rados import types as t
+        from ceph_tpu.rados.messenger import (decode_message,
+                                              encode_payload_parts)
+
+        m = t.MOSDOp(op="write", pool_id=1, oid="o", data=b"d",
+                     reqid="r", client="client.gold.9")
+        payload, blob, fixed = encode_payload_parts(m)
+        assert fixed
+        back = decode_message(20, t.MOSDOp.VERSION, payload, blob, True)
+        assert back.client == "client.gold.9"
+
+    def test_pre_v6_truncated_tail_defaults(self):
+        from ceph_tpu.rados import types as t
+        from ceph_tpu.rados.messenger import _pack_fixed, decode_message
+
+        m = t.MOSDOp(op="write", pool_id=3, oid="o", data=b"d",
+                     epoch=4, reqid="r")
+        payload = _pack_fixed(m, t.MOSDOp.FIXED_FIELDS[:-1])  # v5 layout
+        back = decode_message(20, 5, payload, None, True)
+        assert back.oid == "o" and back.client == ""
+
+
+class TestTrackedOpClassRings:
+    def test_qos_tag_feeds_class_ring(self):
+        import time
+
+        from ceph_tpu.common.tracked_op import OpTracker
+
+        tr = OpTracker()
+        op = tr.create("osd_op(write 1:o)")
+        op.qos_tag = "gold"
+        op.mark_event("queued_for_pg")
+        time.sleep(0.002)
+        op.mark_event("reached_pg")
+        op.finish()
+        samples = tr.phase_samples()
+        assert samples.get("queue_wait"), samples
+        assert samples.get("cls:gold|queue_wait"), samples
+        # untagged ops do not grow class rings
+        op2 = tr.create("osd_op(write 1:p)")
+        op2.mark_event("queued_for_pg")
+        op2.mark_event("reached_pg")
+        op2.finish()
+        assert len(tr.phase_samples()["queue_wait"]) == 2
+        assert len(tr.phase_samples()["cls:gold|queue_wait"]) == 1
+
+
+class TestTraffic:
+    def test_zipf_and_stats(self):
+        from ceph_tpu.tools.traffic import PhaseStats, zipf_weights
+
+        w = zipf_weights(16)
+        assert abs(w.sum() - 1.0) < 1e-9 and w[0] > w[-1]
+        st = PhaseStats("x")
+        st.record("gold", "get", 0.001, True)
+        st.record("gold", "get", 0.002, True)
+        st.record("gold", "put", 0.003, False)
+        st.seconds = 1.0
+        s = st.summary()
+        assert s["gold"]["ops"] == 3 and s["gold"]["failures"] == 1
+        assert s["gold"]["get"]["count"] == 2
+
+    def test_merge_osd_class_phases(self):
+        from ceph_tpu.tools.traffic import merge_osd_class_phases
+
+        class Tracker:
+            def phase_samples(self):
+                return {"queue_wait": [0.5],
+                        "cls:gold|queue_wait": [0.001, 0.002]}
+
+        class Ctx:
+            op_tracker = Tracker()
+
+        class Osd:
+            ctx = Ctx()
+
+        out = merge_osd_class_phases([Osd(), Osd()])
+        assert out["gold"]["queue_wait"]["count"] == 4
+        assert "queue_wait" not in out.get("", {})
+
+
+class TestRenderer:
+    def test_render_op_queue(self):
+        from ceph_tpu.tools.ceph import render_op_queue
+
+        dump = {
+            "scheduler": "MClockScheduler", "depth": 3, "qos_clients": 1,
+            "shards": [{"shard": 0, "depth": 3, "strict": 0,
+                        "classes": {"recovery": {
+                            "depth": 1, "reservation": 10.0, "weight": 3.0,
+                            "limit": 50.0, "r_tag": 0.1, "p_tag": 0.2,
+                            "l_tag": 0.02}},
+                        "clients": {"client.gold.1": {
+                            "depth": 2, "reservation": 100.0,
+                            "weight": 10.0, "limit": 0.0, "r_tag": -0.01,
+                            "p_tag": 0.5, "l_tag": 0.0}}}],
+            "admission": {"client.flood.1": {
+                "limit": 30.0, "excess_s": 1.25, "idle_s": 0.0}},
+        }
+        lines = render_op_queue(dump)
+        text = "\n".join(lines)
+        assert "MClockScheduler: depth 3" in text
+        assert "client client.gold.1" in text
+        assert "recovery" in text
+        assert "excess +1.250s" in text
+
+
+class TestQosE2E:
+    def test_mon_validates_and_distributes_qos_opts(self):
+        async def go():
+            from ceph_tpu.rados.vstart import Cluster
+
+            cluster = Cluster(n_osds=3, conf={"osd_auto_repair": False})
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("q", profile={
+                    "plugin": "jerasure", "technique": "reed_sol_van",
+                    "k": "2", "m": "1"}, pg_num=4)
+                await c.pool_set(pool, "qos_reservation", "25")
+                await c.pool_set(pool, "qos_class:gold", "100:10:0")
+                opts = c.osdmap.pools[pool].opts
+                assert opts["qos_reservation"] == "25"
+                assert opts["qos_class:gold"] == "100:10:0"
+                # invalid values are refused (opts unchanged)
+                await c.pool_set(pool, "qos_weight", "0")
+                await c.pool_set(pool, "qos_class:gold", "garbage")
+                opts = c.osdmap.pools[pool].opts
+                assert "qos_weight" not in opts
+                assert opts["qos_class:gold"] == "100:10:0"
+                # every OSD resolves the distributed profile (maps push
+                # on the ping cadence: poll for convergence)
+                def converged():
+                    return all(
+                        "qos_class:gold" in getattr(
+                            o.osdmap.pools.get(pool), "opts", {})
+                        for o in cluster.osds.values()
+                        if o.osdmap is not None)
+                for _ in range(100):
+                    if converged():
+                        break
+                    await asyncio.sleep(0.05)
+                assert converged(), "pool qos opts never reached the OSDs"
+                for o in cluster.osds.values():
+                    p = pool_qos(o.osdmap.pools[pool], "client.gold.1")
+                    assert p.reservation == 100.0
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        asyncio.run(go())
+
+    def test_flooder_shed_reserved_unharmed(self):
+        """The gate's shape in miniature: under saturation the flooding
+        tenant (past its limit) is backoff-shed while the reserved
+        tenant sees zero failures and zero backoffs."""
+        async def go():
+            from ceph_tpu.rados.client import RadosClient
+            from ceph_tpu.rados.vstart import Cluster
+            from ceph_tpu.tools.traffic import TenantClass, TrafficHarness
+
+            cluster = Cluster(n_osds=3, conf={
+                "osd_auto_repair": False, "ms_local_fastpath": False,
+                "osd_op_queue": "mclock",
+                "osd_backoff_queue_depth": 6,
+                "osd_qos_shed_grace": 0.05,
+                "osd_backoff_secs": 0.4,
+                "client_op_timeout": 30.0,
+                "client_op_deadline": 60.0})
+            await cluster.start()
+            try:
+                c0 = await cluster.client()
+                pool = await c0.create_pool("iso", profile={
+                    "plugin": "jerasure", "technique": "reed_sol_van",
+                    "k": "2", "m": "1"})
+                await c0.pool_set(pool, "qos_class:gold", "80:10:0")
+                await c0.pool_set(pool, "qos_class:flood", "0:1:25")
+                c_gold = await cluster.client()
+                fconf = dict(cluster.conf)
+                fconf["client_op_deadline"] = 4.0
+                c_flood = RadosClient(cluster.mon_addrs, fconf)
+                await c_flood.start()
+                await c_flood.refresh_map()
+                gold = TenantClass("gold", c_gold, tenants=1, workers=3,
+                                  rate=30.0)
+                flood = TenantClass("flood", c_flood, tenants=1,
+                                    workers=48, rate=0.0)
+                h = TrafficHarness([gold, flood], pool, n_objects=16,
+                                   obj_size=8 << 10, verify=True)
+                await h.preload()
+                stats = await h.run_phase("contended", 2.5, 0.25)
+                s = stats.summary()
+                sheds = sum(o.sched_perf.get("qos_shed")
+                            for o in cluster.osds.values())
+                assert sheds > 0, "no qos-directed shed under a flooder"
+                assert c_flood.perf.get("backoffs_received") > 0
+                assert c_gold.perf.get("backoffs_received") == 0, \
+                    "reserved tenant was blocked"
+                assert s.get("gold", {}).get("failures", 0) == 0
+                # per-class optracker rings populated (the macro-bench
+                # percentile path)
+                from ceph_tpu.tools.traffic import merge_osd_class_phases
+
+                cls = merge_osd_class_phases(cluster.osds.values())
+                assert "gold" in cls and "queue_wait" in cls["gold"]
+                # asok surface
+                dump = next(iter(cluster.osds.values())) \
+                    .ctx.asok.execute("dump_op_queue")
+                assert dump["scheduler"] == "MClockScheduler"
+                assert dump["admission"], "admission tracker empty"
+                for c in (c0, c_gold, c_flood):
+                    await c.stop()
+            finally:
+                await cluster.stop()
+
+        asyncio.run(go())
+
+    def test_scheduler_perf_counts_flow(self):
+        async def go():
+            from ceph_tpu.rados.vstart import Cluster
+
+            cluster = Cluster(n_osds=3, conf={
+                "osd_auto_repair": False, "osd_op_queue": "mclock"})
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("sp", profile={
+                    "plugin": "jerasure", "technique": "reed_sol_van",
+                    "k": "2", "m": "1"}, pg_num=4)
+                await c.put(pool, "x", os.urandom(5000))
+                assert await c.get(pool, "x")
+                enq = sum(o.sched_perf.get("enqueue_client")
+                          for o in cluster.osds.values())
+                deq = sum(o.sched_perf.get("dequeue_client")
+                          for o in cluster.osds.values())
+                assert enq >= 2 and deq >= 2
+                served = sum(
+                    o.sched_perf.get("served_reservation")
+                    + o.sched_perf.get("served_weight")
+                    + o.sched_perf.get("served_fallback")
+                    for o in cluster.osds.values())
+                assert served >= 2
+                # perf dump carries the set (mgr /metrics rides this)
+                d = next(iter(cluster.osds.values())).ctx.perf.dump()
+                assert "osd_scheduler" in d
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        asyncio.run(go())
