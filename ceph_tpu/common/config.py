@@ -133,6 +133,21 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
                 "messages so cross-daemon spans stitch into one tree"),
     Option("ms_auth_secret", OPT_STR, "",
            desc="shared cluster secret; non-empty enables cephx-style frames"),
+    # sharded multi-reactor wire plane (reference AsyncMessenger worker
+    # pool, src/msg/async/AsyncMessenger.h ms_async_op_threads)
+    Option("ms_async_op_threads", OPT_INT, 0, flags=(FLAG_STARTUP,),
+           desc="reactor workers per messenger, each its own event loop "
+                "owning a socket shard (0 = single-loop legacy path)"),
+    Option("ms_lanes_per_peer", OPT_INT, 1, flags=(FLAG_STARTUP,), min=1,
+           desc="parallel lanes per peer session (negotiated; lane 0 is "
+                "control-only, data stripes across the rest; 1 = single "
+                "connection)"),
+    Option("ms_lane_stripe_min", OPT_SIZE, 1 << 20,
+           desc="blobs at least this large fragment across ALL data "
+                "lanes concurrently (0 disables fragmentation)"),
+    Option("ms_colocated_ring", OPT_BOOL, False,
+           desc="negotiate a zero-serialization in-process ring with "
+                "colocated peers at connect time (falls back to TCP)"),
     # osd
     Option("osd_heartbeat_interval", OPT_SECS, 0.3),
     Option("osd_heartbeat_grace", OPT_SECS, 2.0),
@@ -156,6 +171,10 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
     Option("osd_qos_default_limit", OPT_FLOAT, 0.0,
            desc="per-client ops/sec cap when the pool declares no "
                 "qos_limit (0 = unlimited)"),
+    Option("osd_qos_cost_per_io", OPT_SIZE, 65536,
+           desc="bytes of op payload that cost one extra IOPS unit in "
+                "the dmClock tags (byte-COST: a B-byte op tags as "
+                "1 + B/this; 0 = pure per-op tagging)"),
     Option("osd_qos_arrears_cap", OPT_FLOAT, 2.0,
            desc="ceiling (seconds) on a client's accumulated over-limit "
                 "arrears — bounds how long a quieted flooder stays "

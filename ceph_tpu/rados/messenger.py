@@ -75,6 +75,40 @@ BEFORE it enters the outbox, and close() fails the pending flush window
 and clears the outbox — un-flushed frames replay from the unacked queue
 onto the adopted transport in seq order, and the receiver's dedupe floor
 makes any flush/replay overlap exactly-once.
+
+Sharded multi-reactor wire plane (reactor.py + the lane layer here):
+
+- **Reactor pool** (``ms_async_op_threads``): N reactor workers, each a
+  thread with its own event loop owning a shard of sockets (reference
+  AsyncMessenger worker pool).  Outbound data lanes are bound to workers
+  by a stable hash of (peer, lane); inbound sockets shard across the
+  workers' dup'd listening fds.  Socket work (framing, crc, sendmsg,
+  recv memcpy — all GIL-releasing) runs on the owning reactor; dispatch
+  hops back to the daemon's home loop, so daemon state stays
+  single-loop.  Each reactor-owned connection charges a per-worker
+  dispatch throttle (receive backpressure is per shard).
+- **Multi-lane peer striping** (``ms_lanes_per_peer`` > 1, negotiated —
+  an old peer that doesn't advertise ``lanes_ok`` gets one lane): a peer
+  pair opens N parallel lanes, each a full Connection (own cork/outbox,
+  own seq space, own unacked replay queue, own flusher).  Lane 0 is the
+  CONTROL lane — pings, acks, maps, backoffs, health are never queued
+  behind data.  Data-plane messages (LANE_STRIPE types) are striped
+  round-robin across lanes 1..N-1, stamped with a connection-global
+  ``gseq``; the receiving LaneGroup reassembles gseq order before
+  dispatch, so per-(peer,type) ordering (in fact total data-plane
+  order) and the reqid/dedup machinery above are preserved.  Messages
+  with blobs >= ``ms_lane_stripe_min`` are FRAGMENTED: the blob splits
+  into per-lane MLaneSegment frames sent concurrently and reassembled
+  into one buffer on the receiver — one large transfer rides all lanes
+  at once.  A dead lane pins and replays only ITS unacked frames
+  (per-lane sessions); the remaining lanes keep draining, and the gseq
+  reorder buffer absorbs the replayed hole.
+- **Colocated ring transport** (``ms_colocated_ring``): the handshake
+  hello carries a per-process token; when both ends share the process
+  (vstart/test topology, bench loopback arm) the acceptor offers an
+  in-process RingPipe pair in its fin and both sides swap the TCP
+  session for a zero-serialization ring (BufferList views hand over by
+  reference).  Any negotiation failure falls back to TCP transparently.
 """
 
 from __future__ import annotations
@@ -88,16 +122,19 @@ import json
 import pickle
 import random
 import struct
+import threading
 import time
 import traceback
 import zlib
-from dataclasses import dataclass
-from typing import Any, Callable, Deque, Dict, Optional, Tuple
+from dataclasses import dataclass, field
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ceph_tpu.common.perf_counters import PerfCounters, PerfCountersBuilder
 from ceph_tpu.common.throttle import Throttle
+from ceph_tpu.rados.reactor import (PROC_TOKEN, ReactorPool, RingConnection,
+                                    ring_abandon, ring_claim, ring_offer)
 
 
 def _build_wire_perf() -> PerfCounters:
@@ -165,6 +202,19 @@ def _build_wire_perf() -> PerfCounters:
                       "blob frames reusing an app-level crc on the wire")
     b.add_u64_counter("rx_batches", "multi-frame rx dispatch batches")
     b.add_histogram("rx_batch_msgs", "messages per rx dispatch batch")
+    # multi-lane / reactor / ring plane (module docstring "Sharded
+    # multi-reactor wire plane"); per-lane splits ride dynamic
+    # tx_lane<k>_msgs / tx_lane<k>_bytes counters
+    b.add_u64_counter("ring_msgs", "colocated ring handoffs (no framing, "
+                                   "no socket, no serialization)")
+    b.add_u64_counter("lane_rx_parked",
+                      "striped frames parked awaiting a gseq gap")
+    b.add_u64_counter("lane_frag_tx", "lane fragments sent (large blobs "
+                                      "split across data lanes)")
+    b.add_u64_counter("lane_frag_rx", "lane fragments reassembled")
+    b.add_u64_counter("lane_frag_overflow",
+                      "fragments refused by the reassembly memory cap")
+    b.add_u64_counter("lane_revivals", "dead lanes redialed and replayed")
     # µs histograms of the socket-io longrunavgs: tail-latency
     # percentiles (p50/p99/p999) come out of the power-of-2 buckets, so
     # the BENCH record reports wire tx/rx TAILS, not just means
@@ -227,6 +277,61 @@ def message(type_id: int, version: int = 1):
         return cls
 
     return deco
+
+
+# -- lane negotiation / fragmentation wire types -----------------------------
+# Messenger-internal data-plane types (fixed layouts; corpus + dencoder
+# covered like every other wire type).  They live HERE, not types.py,
+# because the lane layer itself produces and consumes them.
+
+
+@message(71)
+class MLaneHello:
+    """First frame on every lane of a multi-lane peer session: binds the
+    carrying connection to lane ``lane`` of lane-group ``group`` (the
+    connection-negotiation fields of the wire plane).  Lane 0's hello
+    CREATES the group on the acceptor; joining lanes attach to it.
+    ``proc`` carries a short digest of the sender's process token for
+    diagnostics only — colocation trust rides the handshake hello."""
+
+    group: str = ""
+    lane: int = 0
+    n_lanes: int = 1
+    proc: str = ""
+    flags: int = 0
+
+    FIXED_FIELDS = [("group", "s"), ("lane", "q"), ("n_lanes", "q"),
+                    ("proc", "s"), ("flags", "Q")]
+
+
+@message(72)
+class MLaneSegment:
+    """One fragment of a striped large message: blobs >=
+    ``ms_lane_stripe_min`` split into per-data-lane segments sent
+    concurrently; the receiver reassembles ``nfrags`` chunks into one
+    contiguous buffer, decodes the original message from ``header``
+    (fragment 0 carries it) and releases it into the gseq reorder at
+    ``gseq``.  ``total`` is the full blob length, ``off`` this chunk's
+    byte offset — explicit, so reassembly never depends on arrival
+    order or even chunk sizing."""
+
+    gseq: int = 0
+    idx: int = 0
+    nfrags: int = 1
+    total: int = 0
+    off: int = 0
+    type_id: int = 0
+    version: int = 1
+    fixed: bool = False
+    header: bytes = b""
+    chunk: bytes = b""
+
+    FIXED_FIELDS = [("gseq", "Q"), ("idx", "q"), ("nfrags", "q"),
+                    ("total", "q"), ("off", "q"), ("type_id", "q"),
+                    ("version", "q"), ("fixed", "?"), ("header", "y"),
+                    ("chunk", "y")]
+    BLOB_ATTR = "chunk"
+    BLOB_VIEW_OK = True
 
 
 # store-resident buffers may be memoryviews (ownership-transferred
@@ -769,21 +874,35 @@ class FrameReceiver(asyncio.BufferedProtocol):
 
     # -- reader side ---------------------------------------------------------
 
-    async def readexactly(self, n: int, uninit: bool = False):
+    async def readexactly(self, n: int, uninit: bool = False, into=None):
         """Read n bytes.  With ``uninit=True`` the destination is an
         UNINITIALIZED buffer (np.empty) returned as a memoryview:
         bytearray(n) memsets n zero bytes the socket is about to
         overwrite, a full extra pass over the data volume on blob
         frames.  Only blob fields whose consumers are buffer-safe
         (BLOB_VIEW_OK types: store/decode lanes) opt in — everything
-        else keeps bytearray semantics (concat, decode, mutation)."""
+        else keeps bytearray semantics (concat, decode, mutation).
+        With ``into=`` the bytes land DIRECTLY in the caller's buffer
+        (the lane-fragment reassembly seam: a striped blob's segments
+        fill their slice of the assembly buffer with zero extra
+        passes); the buffer is returned."""
         pend = self._pending
         avail = len(pend) - self._off
-        if avail >= n:
+        if into is not None:
+            buf = into if isinstance(into, memoryview) \
+                else memoryview(into)
+            if buf.ndim != 1 or buf.itemsize != 1:
+                buf = buf.cast("B")
+            mv = buf
+            if avail >= n:
+                mv[:n] = pend[self._off:self._off + n]
+                self._consume(n)
+                return buf
+        elif avail >= n:
             out = bytes(pend[self._off:self._off + n])
             self._consume(n)
             return out
-        if uninit:
+        elif uninit:
             buf = memoryview(np.empty(n, dtype=np.uint8)).cast("B")
             mv = buf
         else:
@@ -1010,6 +1129,24 @@ class Connection:
         self.transport_gen = 0
         self.out_seq = 0
         self.in_seq = 0  # highest data seq dispatched (dedupe floor)
+        # multi-reactor plane: the event loop owning this connection's
+        # transport (all of its coroutine work runs there; cross-loop
+        # senders hop via Messenger._conn_send), the reactor worker when
+        # one owns the shard, and the lane-group membership when this
+        # connection is one lane of a striped peer session
+        try:
+            self.loop: Optional[asyncio.AbstractEventLoop] = \
+                asyncio.get_running_loop()
+        except RuntimeError:
+            self.loop = None
+        self.reactor = None  # ReactorWorker owning this socket's shard
+        self.lane_group: Optional["LaneGroup"] = None
+        self.lane_idx = 0
+        # dispatch throttle for THIS connection's loop: the home loop
+        # shares the messenger-wide throttle; each reactor worker gets
+        # its own (receive backpressure is per shard — asyncio futures
+        # inside Throttle are loop-bound)
+        self.throttle = messenger._throttle_here()
         # per-connection session id: acceptors key replay sessions on it, so
         # a REPLACED connection never collides with its predecessor's seqs
         self.session_id = random.randbytes(8).hex()
@@ -1416,7 +1553,7 @@ class Connection:
         hdr = await self.reader.readexactly(_HDR.size)
         length, type_id, version, flags, crc, seq = _HDR.unpack(hdr)
         cost = length
-        await self.messenger.dispatch_throttle.get(cost)
+        await self.throttle.get(cost)
         # rx_io clock starts AFTER the header lands: the header read is
         # where idle between-message waiting parks, and folding that into
         # the per-frame number would drown the transfer cost it measures
@@ -1438,10 +1575,34 @@ class Connection:
                 cls = _MSG_TYPES.get(type_id)
                 if getattr(cls, "BLOB_VIEW_OK", False) \
                         and isinstance(self.reader, FrameReceiver):
-                    # store/decode-lane blob: land in an uninitialized
-                    # buffer (no memset pass over the data volume)
-                    blob = await self.reader.readexactly(blob_len,
-                                                         uninit=True)
+                    # lane-fragment reassembly seam: a striped segment's
+                    # chunk lands DIRECTLY in its slice of the group's
+                    # assembly buffer — no per-fragment staging buffer,
+                    # no gather copy at reassembly time
+                    dest = None
+                    if cls is MLaneSegment and self.lane_group is not None \
+                            and (flags & FLAG_FIXED) and blob_len \
+                            and not (seq and seq <= self.in_seq):
+                        # the in_seq guard keeps a REPLAYED duplicate
+                        # (acked but re-sent across a lane revival) from
+                        # re-creating reassembly state the serve loop is
+                        # about to drop — that would leak one assembly
+                        # buffer per replayed fragment
+                        try:
+                            seg = _unpack_fixed(cls, bytes(pickled), None)
+                            dest = self.lane_group.frag_view(
+                                seg, blob_len)
+                        except Exception:
+                            dest = None
+                    if dest is not None:
+                        blob = await self.reader.readexactly(blob_len,
+                                                             into=dest)
+                    else:
+                        # store/decode-lane blob: land in an
+                        # uninitialized buffer (no memset pass over the
+                        # data volume)
+                        blob = await self.reader.readexactly(blob_len,
+                                                             uninit=True)
                 else:
                     blob = await self.reader.readexactly(blob_len)
                 if crc and self.crc_enabled \
@@ -1460,7 +1621,7 @@ class Connection:
                 if flags & FLAG_COMPRESSED:
                     payload = zlib.decompress(payload)
         except BaseException:
-            self.messenger.dispatch_throttle.put(cost)
+            self.throttle.put(cost)
             raise
         perf = self.messenger.perf
         rx_dt = time.monotonic() - t_io
@@ -1514,6 +1675,472 @@ class Connection:
                 await asyncio.wait_for(self.writer.wait_closed(), timeout=0.5)
             except Exception:
                 pass
+
+
+# -- multi-lane peer sessions ------------------------------------------------
+
+
+class LaneGroup:
+    """A striped peer session: N lane Connections plus the cross-lane
+    sequencing/reassembly seam (module docstring "Sharded multi-reactor
+    wire plane").  Duck-types the Connection surface daemons touch
+    (send / close / peer / peer_name / auth metadata), so handlers reply
+    through the group and replies stripe too.
+
+    TX: LANE_STRIPE messages get the next connection-global ``gseq`` and
+    round-robin across lanes 1..N-1 (lane 0 is control-only); blobs >=
+    ``frag_min`` split into MLaneSegment fragments sent over ALL data
+    lanes concurrently.  RX: every lane's serve loop pushes decoded
+    messages here; gseq order is restored (holes park, a dead lane's
+    replay fills them), fragments reassemble, and a single pump task on
+    the messenger's home loop dispatches in order — one serialization
+    point, so the ordering guarantee holds even when lanes live on
+    different reactor threads.
+
+    Throttle note: frames PARKED for a gap or a partial reassembly
+    release their dispatch-throttle cost at park time (a dead lane may
+    hold a gap open for seconds; holding budget hostage would stall the
+    shard's other sessions) — parked memory is instead bounded by
+    PARK_CAP, past which the reorderer force-drains in gseq order."""
+
+    PARK_CAP = 8192  # parked frames before the reorderer force-drains
+    # reassembly memory caps: fragment geometry is PEER-CLAIMED and read
+    # before the frame crc can reject it, so the allocation it drives
+    # must be bounded independently of the dispatch throttle (which only
+    # accounts wire bytes).  Overflowing assemblies are refused and
+    # counted (lane_frag_overflow); upper-layer resend recovers.
+    FRAG_MAX_ASSEMBLIES = 64
+    FRAG_MAX_BYTES = 256 << 20
+
+    def __init__(self, messenger: "Messenger", addr: Tuple[str, int],
+                 group_id: str, n_lanes: int, outbound: bool,
+                 policy: Policy):
+        self.messenger = messenger
+        self.peer = tuple(addr)
+        self.group_id = group_id
+        self.n_lanes = max(2, int(n_lanes))
+        self.outbound = outbound
+        self.policy = policy
+        self.lanes: List[Optional[Connection]] = [None] * self.n_lanes
+        self.closed = False
+        self.frag_min = int(_cget(messenger.conf, "ms_lane_stripe_min",
+                                  1 << 20) or 0)
+        self._tx_gseq = 0
+        self._rr = 0
+        # rx reorder + reassembly state, guarded for cross-reactor lanes
+        self._lock = threading.Lock()
+        self._rx_next = 1
+        self._parked: Dict[int, Tuple[Any, Any]] = {}  # gseq -> (conn, msg)
+        # gseq -> [seen, chunks, hdr, all_verified, buf, confirmed_ranges]
+        self._frags: Dict[int, list] = {}
+        self._frag_bytes = 0  # aggregate assembly-buffer bytes live
+        self._fifo: Deque = collections.deque()  # (conn, msg, cost)
+        self._pump_task: Optional[asyncio.Task] = None
+        self._wake: Optional[asyncio.Event] = None
+        self._reviving: set = set()
+
+    # -- Connection surface ---------------------------------------------------
+
+    def _lane0(self) -> Optional[Connection]:
+        return self.lanes[0]
+
+    @property
+    def peer_name(self) -> str:
+        c = self._lane0()
+        return c.peer_name if c is not None else ""
+
+    @property
+    def auth_kind(self) -> str:
+        c = self._lane0()
+        return c.auth_kind if c is not None else "none"
+
+    @property
+    def auth_entity_type(self) -> str:
+        c = self._lane0()
+        return c.auth_entity_type if c is not None else ""
+
+    def _lane(self, idx: int) -> Connection:
+        conn = self.lanes[idx]
+        if conn is None:
+            conn = self.lanes[0]
+        if conn is None:
+            raise ConnectionResetError("lane group has no lanes")
+        return conn
+
+    @property
+    def n_data_lanes(self) -> int:
+        return self.n_lanes - 1
+
+    async def send(self, msg: Any) -> None:
+        if self.closed:
+            raise ConnectionResetError("lane group closed")
+        cls = type(msg)
+        if not getattr(cls, "LANE_STRIPE", False):
+            # control plane: lane 0, no gseq — never queued behind data
+            await self.messenger._conn_send(self._lane(0), msg)
+            return
+        self._tx_gseq += 1
+        gseq = self._tx_gseq
+        msg.gseq = gseq
+        blob_attr = getattr(cls, "BLOB_ATTR", None)
+        blob = msg.__dict__.get(blob_attr) if blob_attr else None
+        blob_len = len(blob) if blob is not None else 0
+        if (self.frag_min and blob_len >= self.frag_min
+                and self.n_data_lanes > 1):
+            if await self._send_fragmented(msg, gseq):
+                return
+        idx = 1 + (gseq - 1) % self.n_data_lanes
+        self._note_lane_tx(idx, blob_len)
+        await self.messenger._conn_send(self._lane(idx), msg)
+
+    def _note_lane_tx(self, idx: int, nbytes: int) -> None:
+        p = self.messenger.perf
+        p.ensure(f"tx_lane{idx}_msgs", desc=f"messages striped to lane {idx}")
+        p.ensure(f"tx_lane{idx}_bytes", desc=f"blob bytes striped to lane {idx}")
+        p.inc(f"tx_lane{idx}_msgs")
+        p.inc(f"tx_lane{idx}_bytes", nbytes)
+
+    async def _send_fragmented(self, msg: Any, gseq: int) -> bool:
+        """Split a large blob across all data lanes as MLaneSegment
+        frames sent concurrently; returns False when the message isn't
+        actually blob-framed (caller falls back to whole-message)."""
+        header, blob, fixed = encode_payload_parts(msg)
+        if blob is None:
+            return False
+        if isinstance(blob, BufferList):
+            segs, total = blob.segments, blob.nbytes
+        else:
+            segs, total = _norm_segments([blob])
+        n = self.n_data_lanes
+        base, extra = divmod(total, n)
+        # walk the segment list once, carving n contiguous byte ranges
+        sends = []
+        seg_i, seg_off = 0, 0
+        off = 0
+        for i in range(n):
+            want = base + (1 if i < extra else 0)
+            pieces = []
+            while want and seg_i < len(segs):
+                seg = segs[seg_i]
+                take = min(want, seg.nbytes - seg_off)
+                pieces.append(seg[seg_off:seg_off + take])
+                want -= take
+                seg_off += take
+                if seg_off >= seg.nbytes:
+                    seg_i += 1
+                    seg_off = 0
+            chunk: Any = pieces[0] if len(pieces) == 1 else BufferList(pieces)
+            frag = MLaneSegment(gseq=gseq, idx=i, nfrags=n, total=total,
+                                off=off,
+                                type_id=type(msg).TYPE_ID,
+                                version=type(msg).VERSION,
+                                fixed=bool(fixed),
+                                header=header if i == 0 else b"",
+                                chunk=chunk)
+            lane_idx = 1 + (gseq + i - 1) % n
+            self._note_lane_tx(lane_idx, len(chunk))
+            sends.append(self.messenger._conn_send(
+                self._lane(lane_idx), frag))
+            off += len(chunk)
+        self.messenger.perf.inc("lane_frag_tx", n)
+        results = await asyncio.gather(*sends, return_exceptions=True)
+        for r in results:
+            if isinstance(r, BaseException):
+                raise r
+        return True
+
+    # -- rx: reassembly + ordered dispatch ------------------------------------
+
+    def rx_push(self, conn: Connection, msg: Any, cost: int) -> None:
+        """Called by each lane's serve loop with a decoded message.
+        Restores gseq order (parking holes), reassembles fragments, and
+        feeds the ready run to the single dispatch pump.  Cost transfers
+        with READY messages (released after dispatch); parked frames
+        release theirs immediately (see class docstring)."""
+        with self._lock:
+            ready = self._ingest(conn, msg)
+            first = True
+            for c, m in ready:
+                # THIS arrival's cost rides the first ready entry
+                # (parked entries released theirs at park time; a
+                # reassembled message inherits its completing
+                # fragment's) — pump returns it, to the ARRIVAL's shard
+                # throttle, after dispatch
+                self._fifo.append((c, m, cost if first else 0, conn))
+                first = False
+        if not ready and cost:
+            self.messenger._throttle_put(conn, cost)
+        if ready:
+            self._kick_pump()
+
+    def _ingest(self, conn: Connection, msg: Any):
+        """Under _lock: returns the in-order run of (conn, msg) this
+        arrival unlocks ([] when it parked)."""
+        if type(msg).__name__ == "MLaneSegment":
+            msg = self._ingest_fragment(conn, msg)
+            if msg is None:
+                return []
+        g = getattr(msg, "gseq", 0) or 0
+        if g == 0 or g < self._rx_next:
+            # control-plane (no gseq) dispatches immediately; g <
+            # expected is a cross-lane duplicate (dup injection, replay
+            # overlap) the application layer's reqid dedupe absorbs
+            return [(conn, msg)]
+        if g > self._rx_next:
+            self._parked[g] = (conn, msg)
+            self.messenger.perf.inc("lane_rx_parked")
+            if len(self._parked) > self.PARK_CAP:
+                # liveness backstop: force-drain in gseq order rather
+                # than grow without bound (a hole this old means the
+                # owning lane session is gone for good)
+                keys = sorted(self._parked)
+                out = [self._parked.pop(k) for k in keys]
+                self._rx_next = keys[-1] + 1
+                return out
+            return []
+        out = [(conn, msg)]
+        self._rx_next += 1
+        while self._rx_next in self._parked:
+            out.append(self._parked.pop(self._rx_next))
+            self._rx_next += 1
+        return out
+
+    def frag_view(self, seg: Any, blob_len: int):
+        """Reassembly destination for one inbound MLaneSegment: the
+        [off, off+blob_len) slice of gseq's assembly buffer, so the
+        frame reader lands the bytes in place (zero-copy reassembly).
+        None when the segment's geometry doesn't fit (corrupt/hostile
+        frame: the caller falls back to a private buffer and the normal
+        bounds-checked ingest)."""
+        if (seg.total <= 0 or seg.total > (1 << 31) or seg.off < 0
+                or seg.off + blob_len > seg.total
+                or not (0 <= seg.idx < seg.nfrags <= 4096)):
+            # implausible geometry (corrupt/hostile frame): refuse the
+            # assembly allocation before the crc check can reject it
+            return None
+        with self._lock:
+            st = self._frag_state(seg.gseq, seg.nfrags, seg.total)
+            if st is None:
+                return None
+            if self._range_conflict(st, seg.idx, seg.off, blob_len):
+                # overlaps a CONFIRMED fragment (or re-claims a consumed
+                # idx): land in a private buffer instead — the crc check
+                # will kill the corrupt frame without stomping verified
+                # bytes, and a mere duplicate is dropped by _ingest
+                return None
+            return memoryview(st[4]).cast("B")[seg.off:seg.off + blob_len]
+
+    def _frag_state(self, gseq: int, nfrags: int, total: int):
+        """Under _lock: the reassembly entry for gseq, created if absent
+        and the caps allow; None when refused (stale gseq, geometry
+        mismatch, or the FRAG_MAX_* memory bounds)."""
+        st = self._frags.get(gseq)
+        if st is not None:
+            return st if len(st[4]) == total else None
+        if 0 < gseq < self._rx_next:
+            # gseq already dispatched: a stale duplicate must not
+            # re-open a completed (deleted) assembly
+            return None
+        if (len(self._frags) >= self.FRAG_MAX_ASSEMBLIES
+                or self._frag_bytes + total > self.FRAG_MAX_BYTES):
+            self.messenger.perf.inc("lane_frag_overflow")
+            return None
+        st = self._frags[gseq] = [0, [None] * nfrags, b"", True,
+                                  np.empty(total, dtype=np.uint8), {}]
+        self._frag_bytes += total
+        return st
+
+    @staticmethod
+    def _range_conflict(st, idx: int, off: int, length: int) -> bool:
+        """True when [off, off+length) overlaps a CONFIRMED fragment's
+        bytes (or idx itself is already confirmed) — the guard that
+        keeps a corrupt-geometry frame, whose blob lands BEFORE its crc
+        is checked, from stomping verified regions of the assembly."""
+        ranges = st[5]
+        if idx in ranges:
+            return True
+        end = off + length
+        for o, ln in ranges.values():
+            if off < o + ln and o < end:
+                return True
+        return False
+
+    def _frag_drop(self, gseq: int) -> None:
+        st = self._frags.pop(gseq, None)
+        if st is not None:
+            self._frag_bytes -= len(st[4])
+
+    def _ingest_fragment(self, conn: Connection, frag: Any):
+        """Collect one MLaneSegment; returns the reassembled original
+        message when complete, else None."""
+        if frag.total <= 0 or frag.total > (1 << 31) \
+                or not (0 < frag.nfrags <= 4096):
+            return None
+        st = self._frag_state(frag.gseq, frag.nfrags, frag.total)
+        if st is None:
+            return None
+        seen, chunks, _hdr, ok, buf, ranges = st
+        if 0 <= frag.idx < len(chunks) and chunks[frag.idx] is None:
+            chunk = frag.chunk
+            in_place = (isinstance(chunk, memoryview)
+                        and chunk.obj is buf)
+            nbytes = len(chunk)
+            if not in_place:
+                if frag.off < 0 or frag.off + nbytes > len(buf) \
+                        or self._range_conflict(st, frag.idx, frag.off,
+                                                nbytes):
+                    # corrupt geometry: drop the fragment WITHOUT
+                    # consuming its slot — a valid retransmission of
+                    # this index must still be able to land
+                    return None
+                view = memoryview(buf).cast("B")
+                mv = chunk if isinstance(chunk, memoryview) \
+                    else memoryview(as_bytes(chunk)
+                                    if isinstance(chunk, BufferList)
+                                    else chunk)
+                if mv.ndim != 1 or mv.itemsize != 1:
+                    mv = mv.cast("B")
+                view[frag.off:frag.off + mv.nbytes] = mv
+            chunks[frag.idx] = True
+            ranges[frag.idx] = (frag.off, nbytes)
+            st[0] = seen = seen + 1
+            if frag.header:
+                st[2] = frag.header
+            if not getattr(frag, "_wire_verified", False):
+                st[3] = False
+        if seen < len(chunks):
+            return None
+        self._frag_drop(frag.gseq)
+        self.messenger.perf.inc("lane_frag_rx", len(chunks))
+        msg = decode_message(frag.type_id, frag.version,
+                             bytes(st[2]) if isinstance(st[2], (bytearray,
+                                                                memoryview))
+                             else st[2],
+                             memoryview(st[4]).cast("B"), bool(frag.fixed))
+        if st[3]:
+            msg._wire_verified = True
+        msg.gseq = frag.gseq
+        return msg
+
+    def _kick_pump(self) -> None:
+        home = self.messenger.home_loop
+        if home is None:
+            try:
+                home = asyncio.get_running_loop()
+            except RuntimeError:
+                return
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is home:
+            self._ensure_pump()
+        else:
+            home.call_soon_threadsafe(self._ensure_pump)
+
+    def _ensure_pump(self) -> None:
+        if self._wake is None:
+            self._wake = asyncio.Event()
+        self._wake.set()
+        if self._pump_task is None or self._pump_task.done():
+            m = self.messenger
+            self._pump_task = asyncio.get_running_loop().create_task(
+                self._pump())
+            m._tasks.add(self._pump_task)
+            self._pump_task.add_done_callback(m._tasks.discard)
+
+    async def _pump(self) -> None:
+        """The group's single ordered dispatcher, on the home loop."""
+        m = self.messenger
+        while not self.closed and not m._shutdown:
+            await self._wake.wait()
+            self._wake.clear()
+            while self._fifo and not self.closed and not m._shutdown:
+                await self._pump_once(m)
+
+    async def _pump_once(self, m: "Messenger") -> None:
+        batch: list = []
+        costs: list = []
+        with self._lock:
+            while self._fifo and len(batch) < m.RX_BATCH_MSGS:
+                conn, msg, cost, cost_conn = self._fifo.popleft()
+                batch.append((conn, msg))
+                if cost:
+                    costs.append((cost_conn, cost))
+        if not batch:
+            return
+        try:
+            if m.group_dispatcher is not None \
+                    and (len(batch) > 1 or m.dispatcher is None):
+                await m.group_dispatcher(self, [msg for _, msg in batch])
+            elif m.dispatcher is not None:
+                for _, msg in batch:
+                    try:
+                        await m.dispatcher(self, msg)
+                    except (asyncio.CancelledError, GeneratorExit):
+                        raise
+                    except Exception:
+                        traceback.print_exc()
+        except (asyncio.CancelledError, GeneratorExit):
+            raise
+        except Exception:
+            traceback.print_exc()
+        finally:
+            for conn, cost in costs:
+                m._throttle_put(conn, cost)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def bind_lane(self, conn: Connection, lane: int) -> None:
+        if 0 <= lane < self.n_lanes:
+            self.lanes[lane] = conn
+        conn.lane_group = self
+        conn.lane_idx = lane
+
+    async def close(self) -> None:
+        self.closed = True
+        for conn in self.lanes:
+            if conn is not None:
+                await self.messenger._conn_close(conn)
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+        # undispatched fifo entries still hold dispatch-throttle budget
+        # (pump releases after dispatch): return it now or the shard's
+        # receive path leaks it permanently under group churn
+        with self._lock:
+            entries = list(self._fifo)
+            self._fifo.clear()
+            self._parked.clear()
+            self._frags.clear()
+            self._frag_bytes = 0
+        for _c, _m, cost, cost_conn in entries:
+            if cost:
+                self.messenger._throttle_put(cost_conn, cost)
+
+    def dump(self) -> Dict[str, Any]:
+        lanes = []
+        for i, c in enumerate(self.lanes):
+            if c is None:
+                lanes.append({"lane": i, "state": "absent"})
+                continue
+            lanes.append({
+                "lane": i, "state": "closed" if c.closed else "open",
+                "control": i == 0,
+                "outbox_frames": c._outbox_frames,
+                "outbox_bytes": c._outbox_bytes,
+                "unacked": len(c.unacked),
+                "out_seq": c.out_seq, "in_seq": c.in_seq,
+                "reactor": c.reactor.index if c.reactor is not None
+                else None})
+        with self._lock:
+            parked = len(self._parked)
+            fifo = len(self._fifo)
+            frags = len(self._frags)
+        return {"peer": list(self.peer), "group": self.group_id,
+                "outbound": self.outbound, "n_lanes": self.n_lanes,
+                "tx_gseq": self._tx_gseq, "rx_next": self._rx_next,
+                "rx_parked": parked, "rx_fifo": fifo,
+                "reassembling": frags, "lanes": lanes}
 
 
 # -- messenger ---------------------------------------------------------------
@@ -1576,9 +2203,126 @@ class Messenger:
             _cget(self.conf, "ms_local_fastpath", False))
         self._local_conns: Dict[Tuple[str, int], LocalConnection] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # -- sharded multi-reactor wire plane (module docstring) -------------
+        # the daemon's dispatch loop; reactor-owned serve loops hop here
+        self.home_loop: Optional[asyncio.AbstractEventLoop] = None
+        n_reactors = int(_cget(self.conf, "ms_async_op_threads", 0) or 0)
+        self.reactors: Optional[ReactorPool] = (
+            ReactorPool(name, n_reactors) if n_reactors > 0 else None)
+        self.lanes_per_peer = max(1, int(
+            _cget(self.conf, "ms_lanes_per_peer", 1) or 1))
+        # colocated ring transport: negotiated at connect time; never
+        # engaged under secure mode, configured auth, or socket-fault
+        # injection (those configurations exist to exercise the real
+        # wire, and authorization decisions key on how a peer proved
+        # itself over it)
+        self._ring_ok = bool(
+            _cget(self.conf, "ms_colocated_ring", False)
+            and not _cget(self.conf, "ms_secure_mode", False)
+            and not _cget(self.conf, "ms_auth_secret", "")
+            and not _cget(self.conf, "auth_cephx", False)
+            and not _cget(self.conf, "ms_inject_socket_failures", 0))
+        # live ring connections (both directions), for dump_reactors
+        # and shutdown — acceptor-side rings are not in _conns
+        self._ring_conns: list = []
+        # acceptor-side lane groups, keyed by group id (LRU-capped with
+        # the session table); guarded — lanes may land on reactor loops
+        self._lane_groups: "collections.OrderedDict[str, LaneGroup]" = (
+            collections.OrderedDict())
+        self._lane_lock = threading.Lock()
+        self._sessions_lock = threading.Lock()
+        # per-reactor-loop dispatch throttles (Throttle futures are
+        # loop-bound; backpressure is per shard)
+        self._loop_throttles: Dict[Any, Throttle] = {}
 
     def policy_for(self, peer_type: str) -> Policy:
         return self.policies.get(peer_type, Policy.lossy_client())
+
+    # -- cross-loop plumbing (reactor plane) ---------------------------------
+
+    def _throttle_here(self) -> Throttle:
+        """Dispatch throttle for the CURRENT loop: the messenger-wide
+        one on the home loop, a per-worker one on reactor loops."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return self.dispatch_throttle
+        if self.home_loop is None or loop is self.home_loop:
+            return self.dispatch_throttle
+        t = self._loop_throttles.get(loop)
+        if t is None:
+            t = self._loop_throttles[loop] = Throttle(
+                f"{self.name}-dispatch-shard",
+                _cget(self.conf, "ms_dispatch_throttle_bytes", 100 << 20))
+        return t
+
+    def _throttle_put(self, conn, cost: int) -> None:
+        """Return dispatch-throttle budget to ``conn``'s shard, from any
+        loop (Throttle wakeups are loop-bound futures)."""
+        if not cost:
+            return
+        loop = getattr(conn, "loop", None)
+        throttle = getattr(conn, "throttle", None)
+        if throttle is None:
+            return
+        try:
+            here = asyncio.get_running_loop()
+        except RuntimeError:
+            here = None
+        if loop is None or loop is here or loop.is_closed():
+            throttle.put(cost)
+        else:
+            loop.call_soon_threadsafe(throttle.put, cost)
+
+    async def _conn_send(self, conn, msg: Any) -> None:
+        """Send on a connection that may live on another loop (its
+        reactor shard): hop with run_coroutine_threadsafe, no-op hop for
+        home-loop connections."""
+        loop = getattr(conn, "loop", None)
+        if loop is None or loop is asyncio.get_running_loop():
+            await conn.send(msg)
+            return
+        fut = asyncio.run_coroutine_threadsafe(conn.send(msg), loop)
+        await asyncio.wrap_future(fut)
+
+    async def _conn_close(self, conn) -> None:
+        loop = getattr(conn, "loop", None)
+        try:
+            here = asyncio.get_running_loop()
+        except RuntimeError:
+            here = None
+        if loop is None or loop is here or loop.is_closed():
+            await conn.close()
+            return
+        fut = asyncio.run_coroutine_threadsafe(conn.close(), loop)
+        try:
+            await asyncio.wait_for(asyncio.wrap_future(fut), timeout=1.0)
+        except Exception:
+            pass
+
+    async def _dispatch_home(self, conn, msg: Any) -> None:
+        """Invoke the daemon dispatcher on the HOME loop (daemon state is
+        single-loop); serve loops on reactor shards hop here."""
+        if self.dispatcher is None:
+            return
+        if self.home_loop is None \
+                or self.home_loop is asyncio.get_running_loop():
+            await self.dispatcher(conn, msg)
+            return
+        fut = asyncio.run_coroutine_threadsafe(
+            self.dispatcher(conn, msg), self.home_loop)
+        await asyncio.wrap_future(fut)
+
+    async def _dispatch_group_home(self, conn, msgs: list) -> None:
+        if self.group_dispatcher is None:
+            return
+        if self.home_loop is None \
+                or self.home_loop is asyncio.get_running_loop():
+            await self.group_dispatcher(conn, msgs)
+            return
+        fut = asyncio.run_coroutine_threadsafe(
+            self.group_dispatcher(conn, msgs), self.home_loop)
+        await asyncio.wrap_future(fut)
 
     # -- wire accounting -----------------------------------------------------
 
@@ -1644,16 +2388,22 @@ class Messenger:
         return s, s
 
     async def _handshake_out(self, reader, writer, lossless: bool,
-                             session_id: str):
-        """Returns (peer_name, resumed, peer_ckind, reader, writer) —
-        the pair is AES-GCM wrapped when secure mode was negotiated."""
+                             session_id: str, want_ring: bool = False):
+        """Returns (peer_name, resumed, peer_ckind, lanes_ok, ring_id,
+        reader, writer) — the pair is AES-GCM wrapped when secure mode
+        was negotiated.  ``lanes_ok`` says the acceptor understands the
+        multi-lane plane (old peers fall back to one lane); ``ring_id``
+        is non-empty when the acceptor offered a colocated in-process
+        ring (its fin carries the id; see reactor.py)."""
         secure_want = bool(_cget(self.conf, "ms_secure_mode", False))
         writer.write(BANNER)
         nonce = random.randbytes(16)
         hello = {"name": self.name, "type": self.entity_type,
                  "nonce": nonce.hex(), "auth": "",
                  "session": session_id, "lossless": lossless,
-                 "secure": secure_want, "ckind": checksum_kind()}
+                 "secure": secure_want, "ckind": checksum_kind(),
+                 "proc": PROC_TOKEN, "ring": bool(want_ring),
+                 "lanes_ok": True}
         if self.ticket is not None:
             hello["ticket"] = self.ticket.hex()
         writer.write(json.dumps(hello).encode() + b"\n")
@@ -1695,7 +2445,9 @@ class Messenger:
                     "ms_secure_mode set but connection would be plaintext")
             reader, writer = self._wrap_secure(reader, writer, skey)
         return (peer_hello.get("name", ""), bool(peer_hello.get("resumed")),
-                peer_hello.get("ckind", "zlib"), reader, writer)
+                peer_hello.get("ckind", "zlib"),
+                bool(peer_hello.get("lanes_ok")),
+                str(fin.get("ring", "") or ""), reader, writer)
 
     async def _handshake_in(self, reader, writer):
         """Returns (peer_name, peer_type, session, lossless, auth_kind,
@@ -1745,13 +2497,28 @@ class Messenger:
                  "nonce": nonce.hex(),
                  "auth": self._auth_tag(their_nonce, key, transcript),
                  "resumed": resumed, "secure": secure_want,
-                 "ckind": checksum_kind()}
+                 "ckind": checksum_kind(),
+                 "proc": PROC_TOKEN, "lanes_ok": True}
         writer.write(json.dumps(hello).encode() + b"\n")
         await writer.drain()
         proof = json.loads(await reader.readline())
         expect = self._auth_tag(nonce, key, transcript)
         ok = not expect or hmac.compare_digest(proof.get("auth", ""), expect)
-        writer.write(json.dumps({"ok": ok}).encode() + b"\n")
+        # colocated ring offer (reactor.py): only to an AUTHENTICATED
+        # peer that shares our process token and asked for one — the fin
+        # carries the ring id the initiator claims from the in-process
+        # registry.  Never under secure mode (the wire security applies
+        # to wires; a colocated ring has none, but the configuration
+        # asked to exercise the secured path).
+        ring_offered: Optional[Tuple[str, Any, Any]] = None
+        fin: Dict[str, Any] = {"ok": ok}
+        if (ok and self._ring_ok and not secure_want
+                and peer_hello.get("ring")
+                and peer_hello.get("proc") == PROC_TOKEN):
+            ring_id, rx, tx = ring_offer()
+            ring_offered = (ring_id, rx, tx)
+            fin["ring"] = ring_id
+        writer.write(json.dumps(fin).encode() + b"\n")
         await writer.drain()
         if not ok:
             raise PermissionError(f"auth failed for peer {peer_hello.get('name')}")
@@ -1768,7 +2535,8 @@ class Messenger:
         return (peer_hello.get("name", ""), peer_hello.get("type", "client"),
                 peer_hello.get("session", ""), bool(peer_hello.get("lossless")),
                 auth_kind, auth_entity_type,
-                peer_hello.get("ckind", "zlib"), reader, writer)
+                peer_hello.get("ckind", "zlib"), ring_offered,
+                reader, writer)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -1783,8 +2551,18 @@ class Messenger:
             await conn.close()
 
     async def bind(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
+        self.home_loop = asyncio.get_running_loop()
         self.server = await asyncio.start_server(self._accept, host, port)
         self.addr = self.server.sockets[0].getsockname()[:2]
+        if self.reactors is not None:
+            # shard the listening socket across the reactor workers:
+            # inbound sockets are owned by whichever reactor accepts
+            self.reactors.start()
+            try:
+                await self.reactors.serve_shards(
+                    self.server.sockets[0], self._accept)
+            except (OSError, NotImplementedError):
+                pass  # platform without dup'd-fd accept: home loop only
         if self._local_fastpath:
             self._loop = asyncio.get_running_loop()
             _LOCAL_REGISTRY[tuple(self.addr)] = self
@@ -1805,26 +2583,77 @@ class Messenger:
         try:
             try:
                 (peer_name, peer_type, cookie, lossless, auth_kind,
-                 auth_entity_type, peer_ckind,
+                 auth_entity_type, peer_ckind, ring_offered,
                  reader, writer) = await self._handshake_in(reader, writer)
             except (PermissionError, BadFrame, ConnectionError, json.JSONDecodeError,
                     asyncio.IncompleteReadError, ValueError):
                 writer.close()
                 return
+            if ring_offered is not None:
+                # colocated ring negotiated: the TCP socket's job is
+                # done — serve the in-process ring instead
+                ring_id, rx, tx = ring_offered
+                rconn = RingConnection(self, peer, peer_name, rx, tx,
+                                       outbound=False,
+                                       auth_entity_type=auth_entity_type)
+                self._ring_conns.append(rconn)
+                rconn.start_pump()
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+                return
+            evicted_conns = []
             if lossless and cookie:
-                conn = self._sessions.get(cookie)
-                if conn is not None:
+                with self._sessions_lock:
+                    conn = self._sessions.get(cookie)
+                    if conn is not None:
+                        self._sessions.move_to_end(cookie)
+                    else:
+                        conn = Connection(self, reader, writer, peer,
+                                          Policy.lossless_peer(), peer_name)
+                        self._sessions[cookie] = conn
+                        while len(self._sessions) > MAX_SESSIONS:
+                            _, ev = self._sessions.popitem(last=False)
+                            evicted_conns.append(ev)
+                for ev in evicted_conns:
+                    await self._conn_close(ev)
+                here = asyncio.get_running_loop()
+                if conn.reader is not reader \
+                        and conn.loop not in (None, here):
+                    # session reconnect landed on a different reactor
+                    # shard than the session's owner: migrate the fresh
+                    # socket to the owning loop (transports and the
+                    # session's replay machinery are loop-bound)
+                    conn.auth_kind = auth_kind
+                    conn.auth_entity_type = auth_entity_type
+                    pair = await self._migrate_transport(reader, writer,
+                                                         conn.loop)
+                    if pair is None:
+                        # unmigratable (secure stream / dead socket):
+                        # forget the session — the initiator's next dial
+                        # starts a fresh one (reqid dedupe above absorbs
+                        # the at-least-once window, acceptor-restart rule)
+                        with self._sessions_lock:
+                            if self._sessions.get(cookie) is conn:
+                                self._sessions.pop(cookie, None)
+                        await self._conn_close(conn)
+                        return
+                    r2, w2 = pair
+                    conn.crc_fn = self._negotiated_crc(peer_ckind)
+
+                    async def _adopt_and_serve():
+                        await conn.adopt_transport(r2, w2)
+                        await self._serve(conn)
+
+                    fut = asyncio.run_coroutine_threadsafe(
+                        _adopt_and_serve(), conn.loop)
+                    await asyncio.wrap_future(fut)
+                    return
+                if conn.reader is not reader:
                     # session reconnect: adopt the new socket, replay our
                     # un-acked frames (e.g. replies lost in the drop)
-                    self._sessions.move_to_end(cookie)
                     await conn.adopt_transport(reader, writer)
-                else:
-                    conn = Connection(self, reader, writer, peer,
-                                      Policy.lossless_peer(), peer_name)
-                    self._sessions[cookie] = conn
-                    while len(self._sessions) > MAX_SESSIONS:
-                        _, evicted = self._sessions.popitem(last=False)
-                        await evicted.close()
             else:
                 conn = Connection(self, reader, writer, peer,
                                   Policy.lossy_client(), peer_name)
@@ -1833,9 +2662,61 @@ class Messenger:
             conn.auth_kind = auth_kind
             conn.auth_entity_type = auth_entity_type
             conn.crc_fn = self._negotiated_crc(peer_ckind)
+            if conn.reactor is None and self.reactors is not None:
+                try:
+                    conn.reactor = next(
+                        w for w in self.reactors.workers
+                        if w.loop is asyncio.get_running_loop())
+                    conn.reactor.sockets += 1
+                except StopIteration:
+                    pass
             await self._serve(conn)
         finally:
             self._tasks.discard(task)
+
+    async def _migrate_transport(self, reader, writer, target_loop):
+        """Move a freshly-accepted plaintext socket to another loop:
+        dup the fd, close the local transport (the dup keeps the socket
+        open), rebuild the stream pair on the target loop with any
+        already-buffered bytes carried over.  Returns (reader, writer)
+        on the target loop, or None when the socket can't be migrated."""
+        if not isinstance(reader, asyncio.StreamReader):
+            return None  # SecureStream: no raw transport to migrate
+        transport = writer.transport
+        try:
+            transport.pause_reading()
+        except Exception:
+            pass
+        leftover = bytes(reader._buffer)
+        reader._buffer.clear()
+        sock = transport.get_extra_info("socket")
+        sock = getattr(sock, "_sock", sock)
+        try:
+            dup = sock.dup()
+            dup.setblocking(False)
+        except Exception:
+            return None
+        transport.close()
+
+        async def _attach():
+            r, w = await asyncio.open_connection(sock=dup)
+            if leftover:
+                # no await between open and feed: the new transport has
+                # not had a chance to deliver socket bytes yet, so the
+                # leftover keeps its position at the front of the stream
+                r.feed_data(leftover)
+            return r, w
+
+        fut = asyncio.run_coroutine_threadsafe(_attach(), target_loop)
+        try:
+            return await asyncio.wait_for(asyncio.wrap_future(fut),
+                                          timeout=2.0)
+        except Exception:
+            try:
+                dup.close()
+            except Exception:
+                pass
+            return None
 
     # rx batch budget: how many already-buffered frames one dispatch
     # round may drain before acking (bounds latency of the first ack and
@@ -1882,7 +2763,7 @@ class Messenger:
                         if batch:
                             nxt = self._buffered_frame_len(conn.reader)
                             if nxt is None or not \
-                                    self.dispatch_throttle.would_admit(nxt):
+                                    conn.throttle.would_admit(nxt):
                                 # nothing fully buffered, or the throttle
                                 # would BLOCK — and its budget only
                                 # returns after dispatch, which this
@@ -1891,17 +2772,17 @@ class Messenger:
                         (type_id, version, seq, payload, cost,
                          blob, fixed, verified) = await conn.read_frame()
                         if conn.transport_gen != gen:
-                            self.dispatch_throttle.put(cost)
+                            conn.throttle.put(cost)
                             return  # transport replaced while suspended
                         if type_id == ACK_TYPE:
                             conn.handle_ack(struct.unpack("<Q", payload)[0])
-                            self.dispatch_throttle.put(cost)
+                            conn.throttle.put(cost)
                             continue
                         if seq and seq <= conn.in_seq:
                             # replayed duplicate: re-ack (the original ack
                             # may have been lost) but don't re-dispatch
                             conn.queue_ack(seq)
-                            self.dispatch_throttle.put(cost)
+                            conn.throttle.put(cost)
                             continue
                         try:
                             t_dec = time.monotonic()
@@ -1915,6 +2796,8 @@ class Messenger:
                             self._note_rx(type(msg).__name__,
                                           _HDR.size + cost,
                                           time.monotonic() - t_dec)
+                            if conn.reactor is not None:
+                                conn.reactor.rx_msgs += 1
                         except Exception as e:
                             # undecodable (type/version skew): poison-
                             # discard so replay can't redeliver it forever
@@ -1924,7 +2807,27 @@ class Messenger:
                             if seq:
                                 conn.in_seq = seq
                                 conn.queue_ack(seq)
-                            self.dispatch_throttle.put(cost)
+                            conn.throttle.put(cost)
+                            continue
+                        if isinstance(msg, MLaneHello):
+                            # lane negotiation frame: messenger-internal
+                            # — binds this connection into its lane
+                            # group, never reaches the daemon
+                            self._bind_lane(conn, msg)
+                            if seq:
+                                conn.in_seq = max(conn.in_seq, seq)
+                                conn.queue_ack(seq)
+                            conn.throttle.put(cost)
+                            continue
+                        if conn.lane_group is not None:
+                            # striped session: the LaneGroup restores
+                            # gseq order, reassembles fragments, and
+                            # dispatches through its single pump — ack
+                            # per frame (the flush window coalesces)
+                            if seq:
+                                conn.in_seq = max(conn.in_seq, seq)
+                                conn.queue_ack(seq)
+                            conn.lane_group.rx_push(conn, msg, cost)
                             continue
                         batch.append((seq, msg))
                         costs.append(cost)
@@ -1946,12 +2849,12 @@ class Messenger:
                             # dispatcher is installed — a group-only
                             # daemon must not have isolated frames
                             # consumed-and-acked undispatched.
-                            await self.group_dispatcher(
+                            await self._dispatch_group_home(
                                 conn, [m for _, m in batch])
                         elif self.dispatcher is not None:
                             for _, msg in batch:
                                 try:
-                                    await self.dispatcher(conn, msg)
+                                    await self._dispatch_home(conn, msg)
                                 except (asyncio.CancelledError,
                                         GeneratorExit):
                                     raise
@@ -1970,15 +2873,29 @@ class Messenger:
                         conn.queue_ack(top_seq)
                 finally:
                     for c in costs:
-                        self.dispatch_throttle.put(c)
+                        conn.throttle.put(c)
         except (asyncio.IncompleteReadError, ConnectionError, BadFrame):
             pass
         finally:
             await conn.close(gen)
+            group = conn.lane_group
+            if group is not None:
+                # lane death: a LOSSLESS lane revives in place (its
+                # unacked frames — and only its — replay on the fresh
+                # transport while the other lanes keep draining); a
+                # lossy lane group dies wholesale, like a lossy conn
+                if (conn.outbound and conn.closed and not self._shutdown
+                        and not group.closed):
+                    coro = (self._revive_lane(group, conn)
+                            if conn.policy.replay
+                            else self._group_fatal(group))
+                    t = asyncio.get_running_loop().create_task(coro)
+                    self._tasks.add(t)
+                    t.add_done_callback(self._tasks.discard)
             # lossless sessions reconnect from the initiator side so queued
             # frames (ours AND the acceptor's pending replies) replay even
             # when no further application send would trigger it
-            if (conn.outbound and conn.policy.replay and conn.closed
+            elif (conn.outbound and conn.policy.replay and conn.closed
                     and not self._shutdown):
                 t = asyncio.get_running_loop().create_task(self._reconnect(conn))
                 self._tasks.add(t)
@@ -2003,15 +2920,123 @@ class Messenger:
         if self._conns.get(conn.peer) is conn:
             self._conns.pop(conn.peer, None)
 
+    # -- lane plane ----------------------------------------------------------
+
+    def _bind_lane(self, conn: Connection, m: "MLaneHello") -> None:
+        """Acceptor side of lane negotiation: an MLaneHello (first frame
+        on every lane) attaches the carrying connection to its group,
+        creating the group on lane 0's hello."""
+        evicted = []
+        with self._lane_lock:
+            group = self._lane_groups.get(m.group)
+            if group is None:
+                group = LaneGroup(self, conn.peer, m.group,
+                                  max(2, m.n_lanes), outbound=False,
+                                  policy=conn.policy)
+                self._lane_groups[m.group] = group
+                while len(self._lane_groups) > MAX_SESSIONS:
+                    _, old = self._lane_groups.popitem(last=False)
+                    evicted.append(old)
+            else:
+                self._lane_groups.move_to_end(m.group)
+        for old in evicted:
+            # full close on the home loop (lanes + pump + queued
+            # throttle costs), not just a flag — _bind_lane may run on
+            # a reactor serve loop, so hop
+            old.closed = True
+            home = self.home_loop
+            if home is not None and not home.is_closed():
+                home.call_soon_threadsafe(
+                    lambda g=old: home.create_task(g.close()))
+        group.bind_lane(conn, m.lane)
+
+    async def _revive_lane(self, group: LaneGroup, conn: Connection) -> None:
+        """Initiator-side failover for one dead lossless lane: redial on
+        the lane's own loop (the stable worker hash put us here), adopt
+        the fresh transport into the SAME lane session — its pinned
+        unacked frames (and only its) replay; the gseq reorder buffer on
+        the far side absorbs the refilled hole.  An acceptor that lost
+        the lane session (restart/eviction) is group-fatal: per-lane
+        dedupe floors can't be trusted across it, so the whole group is
+        torn down and the next send dials a fresh one."""
+        key = (id(conn),)
+        if key in group._reviving:
+            return
+        group._reviving.add(key)
+        try:
+            delay = 0.02
+            for _ in range(10):
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 1.0)
+                if self._shutdown or group.closed:
+                    return
+                if not conn.closed:
+                    return  # already revived
+                try:
+                    reader, writer = await asyncio.open_connection(
+                        *group.peer)
+                except (ConnectionError, OSError):
+                    continue
+                try:
+                    (peer_name, resumed, peer_ckind, lanes_ok, ring_id,
+                     reader, writer) = await self._handshake_out(
+                        reader, writer, True, conn.session_id)
+                    if ring_id:
+                        ring_abandon(ring_id)
+                except TRANSPORT_ERRORS:
+                    try:
+                        writer.close()
+                    except Exception:
+                        pass
+                    continue
+                if not resumed:
+                    try:
+                        writer.close()
+                    except Exception:
+                        pass
+                    await self._group_fatal(group)
+                    return
+                conn.crc_fn = self._negotiated_crc(peer_ckind)
+                await conn.adopt_transport(reader, writer)
+                self.perf.inc("lane_revivals")
+                t = asyncio.get_running_loop().create_task(
+                    self._serve(conn))
+                self._tasks.add(t)
+                t.add_done_callback(self._tasks.discard)
+                return
+            await self._group_fatal(group)
+        finally:
+            group._reviving.discard(key)
+
+    async def _group_fatal(self, group: LaneGroup) -> None:
+        """Tear a lane group down wholesale (lossy lane death, peer gone
+        for good, acceptor session loss): the next send dials fresh."""
+        if group.closed:
+            return
+        group.closed = True
+        if self._conns.get(group.peer) is group:
+            self._conns.pop(group.peer, None)
+        await group.close()
+
     # -- outbound ------------------------------------------------------------
 
     async def connect(self, addr: Tuple[str, int],
                       peer_type: str = "osd") -> Connection:
-        """Get (or create) an ordered connection to a peer.  A cached dead
+        """Get (or create) an ordered session with a peer.  A cached dead
         lossless connection is revived in place (same session state, fresh
         transport, unacked replay); dead lossy connections are replaced.
-        Serialized per addr so concurrent senders share one session."""
+        Serialized per addr so concurrent senders share one session.
+
+        Wire-plane negotiation happens here: a colocated peer that
+        matches our process token gets the in-process ring transport
+        (RingConnection); a lanes-capable peer gets ``ms_lanes_per_peer``
+        parallel lanes (LaneGroup) with data lanes bound to reactor
+        workers by the stable hash; anything else falls back to the
+        single TCP Connection — transparently, the caller just gets an
+        object with ``send``."""
         addr = tuple(addr)
+        if self.home_loop is None:
+            self.home_loop = asyncio.get_running_loop()
         conn = self._conns.get(addr)
         if conn is not None and not conn.closed:
             return conn
@@ -2021,17 +3046,37 @@ class Messenger:
             if conn is not None and not conn.closed:
                 return conn
             policy = self.policy_for(peer_type)
-            reviving = conn is not None and conn.policy.replay
-            session_id = conn.session_id if reviving else random.randbytes(8).hex()
+            reviving = (isinstance(conn, Connection)
+                        and conn.lane_group is None and conn.policy.replay)
+            session_id = conn.session_id if reviving \
+                else random.randbytes(8).hex()
             reader, writer = await asyncio.open_connection(*addr)
             try:
-                (peer_name, resumed, peer_ckind, reader,
-                 writer) = await self._handshake_out(
-                    reader, writer, policy.replay, session_id
+                (peer_name, resumed, peer_ckind, lanes_ok, ring_id,
+                 reader, writer) = await self._handshake_out(
+                    reader, writer, policy.replay, session_id,
+                    want_ring=self._ring_ok,
                 )
             except Exception:
                 writer.close()
                 raise
+            if ring_id:
+                pair = ring_claim(ring_id)
+                if pair is not None:
+                    # colocated ring negotiated: zero-serialization
+                    # in-process transport; the TCP socket retires
+                    rx, tx = pair
+                    rconn = RingConnection(self, addr, peer_name, rx, tx,
+                                           outbound=True)
+                    self._ring_conns.append(rconn)
+                    rconn.start_pump()
+                    try:
+                        writer.close()
+                    except Exception:
+                        pass
+                    self._conns[addr] = rconn
+                    return rconn
+                # offer vanished (shutdown race): TCP fallback, transparent
             crc_fn = self._negotiated_crc(peer_ckind)
             if reviving:
                 if not resumed:
@@ -2043,17 +3088,90 @@ class Messenger:
                     conn.in_seq = 0
                 conn.crc_fn = crc_fn
                 await conn.adopt_transport(reader, writer)
-            else:
-                conn = Connection(self, reader, writer, addr, policy,
-                                  peer_name, outbound=True)
-                conn.crc_fn = crc_fn
-                conn.session_id = session_id
-                self._conns[addr] = conn
-            # serve replies arriving on the outbound connection too
-            task = asyncio.get_running_loop().create_task(self._serve(conn))
+                task = asyncio.get_running_loop().create_task(
+                    self._serve(conn))
+                self._tasks.add(task)
+                task.add_done_callback(self._tasks.discard)
+                return conn
+            base = Connection(self, reader, writer, addr, policy,
+                              peer_name, outbound=True)
+            base.crc_fn = crc_fn
+            base.session_id = session_id
+            want_lanes = self.lanes_per_peer if lanes_ok else 1
+            if want_lanes <= 1:
+                self._conns[addr] = base
+                task = asyncio.get_running_loop().create_task(
+                    self._serve(base))
+                self._tasks.add(task)
+                task.add_done_callback(self._tasks.discard)
+                return base
+            # multi-lane session: lane 0 (this conn) is the control
+            # lane on the home loop; data lanes ride reactor shards
+            group = LaneGroup(self, addr, random.randbytes(8).hex(),
+                              want_lanes, outbound=True, policy=policy)
+            group.bind_lane(base, 0)
+            task = asyncio.get_running_loop().create_task(self._serve(base))
             self._tasks.add(task)
             task.add_done_callback(self._tasks.discard)
-            return conn
+            await base.send(MLaneHello(group=group.group_id, lane=0,
+                                       n_lanes=want_lanes,
+                                       proc=PROC_TOKEN[:8]))
+            results = await asyncio.gather(
+                *[self._dial_lane(group, k)
+                  for k in range(1, want_lanes)],
+                return_exceptions=True)
+            errs = [r for r in results if isinstance(r, BaseException)]
+            if errs:
+                await self._group_fatal(group)
+                raise errs[0] if isinstance(errs[0], Exception) \
+                    else ConnectionError("lane dial failed")
+            self._conns[addr] = group
+            return group
+
+    async def _dial_lane(self, group: LaneGroup, lane_idx: int) -> None:
+        """Open one data lane of a lane group, on the reactor worker the
+        stable hash binds it to (home loop without a pool)."""
+        worker = None
+        if self.reactors is not None:
+            self.reactors.start()
+            worker = self.reactors.worker_for(group.peer, lane_idx)
+
+        async def _do():
+            reader, writer = await asyncio.open_connection(*group.peer)
+            session_id = random.randbytes(8).hex()
+            try:
+                (peer_name, _resumed, peer_ckind, _lanes_ok, ring_id,
+                 reader, writer) = await self._handshake_out(
+                    reader, writer, group.policy.replay, session_id)
+                if ring_id:
+                    ring_abandon(ring_id)
+            except Exception:
+                writer.close()
+                raise
+            conn = Connection(self, reader, writer, group.peer,
+                              group.policy, peer_name, outbound=True)
+            conn.crc_fn = self._negotiated_crc(peer_ckind)
+            conn.session_id = session_id
+            if worker is not None:
+                conn.reactor = worker
+                worker.sockets += 1
+                worker.dialed += 1
+            group.bind_lane(conn, lane_idx)
+            # the lane's first frame binds it on the acceptor — before
+            # any striped data can ride it
+            await conn.send(MLaneHello(group=group.group_id,
+                                       lane=lane_idx,
+                                       n_lanes=group.n_lanes,
+                                       proc=PROC_TOKEN[:8]))
+            task = asyncio.get_running_loop().create_task(
+                self._serve(conn))
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+
+        if worker is not None:
+            await worker.submit(_do())
+        else:
+            await _do()
 
     async def send(self, addr: Tuple[str, int], msg: Any, retries: int = 3,
                    peer_type: str = "osd") -> None:
@@ -2099,17 +3217,73 @@ class Messenger:
             await lconn.close()
         self._local_conns.clear()
         # cancel serve loops FIRST: in py3.12 Server.wait_closed() waits for
-        # all connection handlers, so live inbound loops would deadlock it
+        # all connection handlers, so live inbound loops would deadlock it.
+        # Tasks living on reactor loops must be cancelled FROM their own
+        # loop (Task.cancel is not thread-safe across loops).
+        here = asyncio.get_running_loop()
         for t in list(self._tasks):
-            t.cancel()
+            t_loop = t.get_loop()
+            if t_loop is here:
+                t.cancel()
+            elif not t_loop.is_closed():
+                try:
+                    t_loop.call_soon_threadsafe(t.cancel)
+                except RuntimeError:
+                    pass  # loop shut down under us
         for conn in list(self._conns.values()):
-            await conn.close()
-        for conn in list(self._sessions.values()):
-            await conn.close()
-        self._sessions.clear()
+            if isinstance(conn, LaneGroup):
+                await conn.close()
+            else:
+                await self._conn_close(conn)
+        for rconn in list(self._ring_conns):
+            await rconn.close()
+        self._ring_conns.clear()
+        with self._lane_lock:
+            groups = list(self._lane_groups.values())
+            self._lane_groups.clear()
+        for g in groups:
+            await g.close()
+        with self._sessions_lock:
+            sessions = list(self._sessions.values())
+            self._sessions.clear()
+        for conn in sessions:
+            await self._conn_close(conn)
         if self.server is not None:
             self.server.close()
             try:
                 await asyncio.wait_for(self.server.wait_closed(), timeout=1.0)
             except asyncio.TimeoutError:
                 pass
+        if self.reactors is not None:
+            self.reactors.shutdown()
+
+    # -- wire-plane introspection --------------------------------------------
+
+    def dump_reactors(self) -> Dict[str, Any]:
+        """asok ``dump_reactors`` payload: per-reactor socket shards and
+        per-peer lane/ring state (rendered by ``ceph daemon``)."""
+        peers = []
+        rings = []
+        seen = set()
+        groups = [c for c in self._conns.values()
+                  if isinstance(c, LaneGroup)]
+        with self._lane_lock:
+            for g in self._lane_groups.values():
+                groups.append(g)
+        for g in groups:
+            if id(g) in seen:
+                continue
+            seen.add(id(g))
+            peers.append(g.dump())
+        for c in self._ring_conns:
+            rings.append(c.dump())
+        return {
+            "op_threads": (self.reactors.n_workers
+                           if self.reactors is not None else 0),
+            "lanes_per_peer": self.lanes_per_peer,
+            "colocated_ring": self._ring_ok,
+            "workers": (self.reactors.dump()
+                        if self.reactors is not None else []),
+            "peers": peers,
+            "rings": rings,
+        }

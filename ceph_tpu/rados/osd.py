@@ -78,7 +78,7 @@ from ceph_tpu.rados.peering import (
 )
 from ceph_tpu.rados.pglog import ZERO, LogEntry, PGLog, pack_eversion
 from ceph_tpu.rados.qos import (QosParams, QosTracker, build_scheduler_perf,
-                                pool_qos, tenant_class)
+                                pool_qos, qos_op_cost, tenant_class)
 from ceph_tpu.rados.scheduler import (
     CLASS_BEST_EFFORT,
     CLASS_CLIENT,
@@ -533,6 +533,10 @@ class OSD:
         self.ctx.asok.register(
             "dump_op_queue", lambda a: self.dump_op_queue(),
             "per-class/per-client queue depths and dmClock tags")
+        self.ctx.asok.register(
+            "dump_reactors", lambda a: self.messenger.dump_reactors(),
+            "wire plane: reactor worker shards, per-peer lane state, "
+            "colocated rings")
         asok_dir = self.conf.get("admin_socket_dir")
         if asok_dir:
             self.ctx.asok.register(
@@ -941,13 +945,20 @@ class OSD:
             # scheduler shard
             client = getattr(msg, "client", "")
             qos_params: Optional[QosParams] = None
+            # byte-COST of this op in dmClock tag units (qos.qos_op_cost
+            # — 1 + bytes/osd_qos_cost_per_io): both the admission
+            # tracker and the per-client scheduler tags advance by it,
+            # so a bandwidth hog issuing few large ops cannot escape a
+            # limit declared in ops/sec
+            qcost = qos_op_cost(len(msg.data) if msg.data else 0,
+                                self.conf)
             if client and op_class == CLASS_CLIENT:
                 pool = self.osdmap.pools.get(msg.pool_id) \
                     if self.osdmap else None
                 qos_params = pool_qos(pool, client, self.conf) \
                     if pool is not None else None
                 if qos_params is not None:
-                    self.qos.observe(client, qos_params)
+                    self.qos.observe(client, qos_params, cost=qcost)
             # arrival-side saturation shed: a saturated OSD drops-and-
             # blocks HERE, before the op consumes a queue slot — the
             # post-dequeue point would drop a whole admitted burst in
@@ -964,7 +975,7 @@ class OSD:
                     pg_key, lambda: self._handle_client_op(conn, msg),
                     op_class, cost=max(1, len(msg.data) // 4096),
                     client=client if qos_params is not None else "",
-                    qos=qos_params,
+                    qos=qos_params, qos_cost=qcost,
                 )
             except BaseException:
                 # cancelled (or failed) while parked on a full queue:
@@ -2078,6 +2089,23 @@ class OSD:
                     # only — internal reads via _do_read must not heat
                     # the working set)
                     self._tier_observe_read(op, reply)
+                # byte-COST catch-up for reads: the op carried no
+                # payload at arrival (cost observed as 1 IO), but the
+                # served bytes are the bandwidth a read hog consumes —
+                # charge the admission tracker the byte increment now
+                # so a few-large-GETs tenant ranks by its true load
+                # (the reference mClock costs reads by length too)
+                if reply.ok and reply.data is not None \
+                        and getattr(op, "client", ""):
+                    nbytes = len(reply.data)
+                    if nbytes:
+                        pool = self.osdmap.pools.get(op.pool_id) \
+                            if self.osdmap else None
+                        if pool is not None:
+                            params = pool_qos(pool, op.client, self.conf)
+                            self.qos.observe(
+                                op.client, params,
+                                cost=qos_op_cost(nbytes, self.conf) - 1.0)
             elif op.op == "delete":
                 reply = await self._do_delete(op)
             elif op.op == "snap-trim":

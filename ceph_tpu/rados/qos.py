@@ -120,6 +120,34 @@ def validate_pool_qos(key: str, value: str) -> bool:
     return False
 
 
+def qos_op_cost(nbytes: int, conf: Optional[Any] = None) -> float:
+    """Byte-COST of one op in dmClock tag units (IOPS-equivalents): a
+    B-byte op costs ``1 + B / osd_qos_cost_per_io`` — the base IO plus a
+    per-byte increment normalized to the configured bytes-per-IO.  This
+    closes the bandwidth-hog hole of pure per-op tagging: a tenant
+    issuing few LARGE ops (e.g. 25 x 4MiB/s against a 100 ops/s limit)
+    tags as its true IOPS-equivalent load instead of escaping its limit
+    (reference mClock cost model: osd_mclock_cost_per_io +
+    cost_per_byte, src/osd/scheduler/mClockScheduler.cc
+    calc_scaled_cost).  ``osd_qos_cost_per_io = 0`` restores pure
+    per-op tagging.
+
+    Writes are costed at ARRIVAL (the payload length is in hand).
+    Reads carry no payload at arrival, so the OSD charges the
+    admission tracker the byte increment at REPLY time (osd.py read
+    path) — the shed ranking sees a read hog's true bandwidth; the
+    per-client scheduler tags for reads stay per-op (enqueue time
+    cannot know the response size)."""
+    conf = conf or {}
+    try:
+        per_io = float(conf.get("osd_qos_cost_per_io", 65536) or 0)
+    except (TypeError, ValueError):
+        per_io = 65536.0
+    if per_io <= 0 or nbytes <= 0:
+        return 1.0
+    return 1.0 + nbytes / per_io
+
+
 def tenant_class(client: str) -> str:
     """Tenant class of an entity name: ``client.<class>.<id>`` -> the
     middle token; two-part names (``client.17``) and anonymous ("") map

@@ -94,9 +94,10 @@ class WPQScheduler:
 
     def enqueue(self, op_class: str, run, cost: int = 1,
                 priority: Optional[int] = None, order_key: Any = None,
-                client: str = "", qos: Optional[QosParams] = None) -> None:
-        # WPQ has no per-client state: client/qos are accepted (one
-        # enqueue signature across schedulers) and ignored
+                client: str = "", qos: Optional[QosParams] = None,
+                qos_cost: Optional[float] = None) -> None:
+        # WPQ has no per-client state: client/qos/qos_cost are accepted
+        # (one enqueue signature across schedulers) and ignored
         prio = priority if priority is not None else self.PRIORITIES.get(
             op_class, 1)
         item = _Item(sort_key=(next(_seq),), run=run, op_class=op_class,
@@ -179,7 +180,8 @@ class MClockScheduler:
 
     def enqueue(self, op_class: str, run, cost: int = 1,
                 priority: Optional[int] = None, order_key: Any = None,
-                client: str = "", qos: Optional[QosParams] = None) -> None:
+                client: str = "", qos: Optional[QosParams] = None,
+                qos_cost: Optional[float] = None) -> None:
         if priority is not None and priority >= self.STRICT_CUTOFF:
             self._strict.append(_Item(sort_key=(next(_seq),), run=run,
                                       op_class=op_class, cost=cost,
@@ -189,11 +191,15 @@ class MClockScheduler:
         now = self.clock()
         if op_class == CLASS_CLIENT and client:
             # per-client dmClock state, created/refreshed from the op's
-            # resolved pool profile; tags advance by ONE op (IOPS)
+            # resolved pool profile; tags advance by the op's byte-COST
+            # (qos.qos_op_cost: 1 + bytes/osd_qos_cost_per_io) so a
+            # bandwidth hog issuing few large ops pays its true
+            # IOPS-equivalent load instead of escaping its limit
             c = self.clients.get(
                 client, qos if qos is not None else QosParams(
                     *self.DEFAULT_PROFILE[CLASS_CLIENT]), now)
-            tag_cost = 1
+            tag_cost = max(1.0, float(qos_cost)) \
+                if qos_cost is not None else 1
         else:
             c = self.classes.setdefault(
                 op_class, _MClockClass(1.0, 1.0, 0.0))
@@ -347,14 +353,15 @@ class ShardedOpQueue:
     async def enqueue(self, pg_key: int, run: Callable[[], Awaitable[None]],
                       op_class: str = CLASS_CLIENT, cost: int = 1,
                       priority: Optional[int] = None, client: str = "",
-                      qos: Optional[QosParams] = None) -> None:
+                      qos: Optional[QosParams] = None,
+                      qos_cost: Optional[float] = None) -> None:
         cost = max(1, cost)
         await self._budget.get(cost)  # blocks when queues are full
         self.inflight_ops += 1
         shard = self.shard_of(pg_key)
         self._scheds[shard].enqueue(op_class, run, cost, priority=priority,
                                     order_key=pg_key, client=client,
-                                    qos=qos)
+                                    qos=qos, qos_cost=qos_cost)
         if self.perf is not None:
             self.perf.inc("op_queued")
         if self.sched_perf is not None:

@@ -662,7 +662,7 @@ class MConfigReply:
 # Client <-> primary OSD
 
 
-@message(20, version=6)
+@message(20, version=7)
 class MOSDOp:
     op: str = "read"  # write | read | delete | list | repair | deep-scrub | call | multi
     pool_id: int = 0
@@ -728,9 +728,15 @@ class MOSDOp:
     # "" = anonymous (pre-v6 frames, admin fan-outs) rides the pool's
     # default client profile.
     client: str = ""
+    # multi-lane striping order key (messenger LaneGroup): stamped by the
+    # sender's lane group when this message stripes across data lanes;
+    # the receiver reassembles dispatch order from it.  0 = not striped
+    # (single-lane sessions, control lane, pre-lane frames — the
+    # truncated-tail fixed decode defaults it).
+    gseq: int = 0
 
 
-@message(21, version=2)
+@message(21, version=3)
 class MOSDOpReply:
     ok: bool = True
     error: str = ""
@@ -757,6 +763,7 @@ class MOSDOpReply:
     # degraded) the client fetches AT LEAST this epoch before
     # re-targeting (the Objecter's epoch barrier, Objecter.cc:2764)
     map_epoch: int = 0
+    gseq: int = 0  # lane striping order key (see MOSDOp.gseq)
 
 
 @message(65, version=2)
@@ -857,7 +864,7 @@ class MHealthMute:
 # reference src/osd/ECMsgTypes.h:23,105)
 
 
-@message(30, version=5)
+@message(30, version=6)
 class MECSubWrite:
     pool_id: int = 0
     pg: int = 0
@@ -898,9 +905,10 @@ class MECSubWrite:
     # shard peer joins a child `ec_sub_write` span under it
     trace_id: str = ""
     span_id: str = ""
+    gseq: int = 0  # lane striping order key (see MOSDOp.gseq)
 
 
-@message(31, version=2)
+@message(31, version=3)
 class MECSubWriteReply:
     tid: str = ""
     shard: int = 0
@@ -909,9 +917,10 @@ class MECSubWriteReply:
     # straggler reply with the op's trace without a tid lookup
     trace_id: str = ""
     span_id: str = ""
+    gseq: int = 0  # lane striping order key (see MOSDOp.gseq)
 
 
-@message(32, version=3)
+@message(32, version=4)
 class MECSubRead:
     pool_id: int = 0
     pg: int = 0
@@ -927,9 +936,10 @@ class MECSubRead:
     # attach the stored hinfo record to the reply (recovery stat probes
     # only — hot-path sub-reads skip the xattr lookup + wire bytes)
     want_hinfo: bool = False
+    gseq: int = 0  # lane striping order key (see MOSDOp.gseq)
 
 
-@message(33, version=3)
+@message(33, version=4)
 class MECSubReadReply:
     tid: str = ""
     shard: int = 0
@@ -946,9 +956,10 @@ class MECSubReadReply:
     # messenger reuses it as the frame's blob crc (BLOB_CRC_ATTR) so a
     # full-blob sub-read reply ships without a checksum pass
     chunk_crc: int = 0
+    gseq: int = 0  # lane striping order key (see MOSDOp.gseq)
 
 
-@message(34, version=2)
+@message(34, version=3)
 class MECSubDelete:
     pool_id: int = 0
     pg: int = 0
@@ -959,9 +970,10 @@ class MECSubDelete:
     # pickled LogEntry: acting-set members log the delete (empty for the
     # stray-sweep broadcast to non-acting peers)
     log_entry: bytes = b""
+    gseq: int = 0  # lane striping order key (see MOSDOp.gseq)
 
 
-@message(35, version=3)
+@message(35, version=4)
 class MPushShard:
     """Recovery push of a reconstructed shard (reference PushOp).  Carries
     the object's cls xattr state so a backfilled OSD can serve class calls
@@ -977,6 +989,7 @@ class MPushShard:
     object_size: int = 0
     xattrs: Dict[str, bytes] = field(default_factory=dict)
     hinfo: bytes = b""
+    gseq: int = 0  # lane striping order key (see MOSDOp.gseq)
 
 
 @message(36, version=2)
@@ -1115,7 +1128,7 @@ class MScrubShard:
     reply_to: Tuple[str, int] = ("", 0)
 
 
-@message(46, version=2)
+@message(46, version=3)
 class MSetXattrs:
     """Primary -> acting peers: replicate object-class xattr state so a
     failover primary still sees locks/refcounts (cls durability)."""
@@ -1125,12 +1138,13 @@ class MSetXattrs:
     shard: int = 0
     xattrs: Dict[str, bytes] = field(default_factory=dict)
     removals: List[str] = field(default_factory=list)
+    gseq: int = 0  # lane striping order key (see MOSDOp.gseq)
 
 
 # watch/notify (reference src/osd/Watch.{h,cc}, librados watch2/notify2)
 
 
-@message(49)
+@message(49, version=2)
 class MSetOmap:
     """Primary -> acting peers: replicate object omap mutations applied by
     a compound (multi) op, so a failover primary serves the same omap
@@ -1143,6 +1157,7 @@ class MSetOmap:
     clear: bool = False  # applied before entries/removals
     entries: Dict[str, bytes] = field(default_factory=dict)
     removals: List[str] = field(default_factory=list)
+    gseq: int = 0  # lane striping order key (see MOSDOp.gseq)
 
 
 @message(47)
@@ -1245,6 +1260,9 @@ MOSDOp.FIXED_FIELDS = [
     # v6 tail: client entity name (golden pre-v6 frames replayed by the
     # corpus check and tests/test_qos.py decode with the "" default)
     ("client", "s"),
+    # v7 tail: lane striping order key (golden pre-lane frames under
+    # corpus/wire/golden decode with the 0 default)
+    ("gseq", "Q"),
 ]
 # a compound op vector (multi) carries arbitrary typed kwargs: pickle
 MOSDOp.FIXED_WHEN = staticmethod(lambda m: not m.ops)
@@ -1252,6 +1270,7 @@ MOSDOpReply.FIXED_FIELDS = [
     ("ok", "?"), ("error", "s"), ("code", "q"), ("data", "y"),
     ("oids", "s*"), ("cursor", "s"), ("backoff", "d"), ("reqid", "s"),
     ("version", "Q"), ("map_epoch", "q"),
+    ("gseq", "Q"),  # v3 tail (append-only rule)
 ]
 MOSDOpReply.FIXED_WHEN = staticmethod(
     lambda m: isinstance(m.data, (bytes, bytearray, memoryview, BufferList)))
@@ -1262,24 +1281,50 @@ MECSubWrite.FIXED_FIELDS = [
     ("reply_to", "addr"), ("log_entry", "y"), ("chunk_off", "q"),
     ("shard_size", "q"), ("prior_version", "Q"), ("hinfo", "y"),
     ("trace_id", "s"), ("span_id", "s"),  # v5 tail (append-only rule)
+    ("gseq", "Q"),  # v6 tail (append-only rule)
 ]
 MECSubWriteReply.FIXED_FIELDS = [
     ("tid", "s"), ("shard", "q"), ("ok", "?"),
     ("trace_id", "s"), ("span_id", "s"),  # v2 tail (append-only rule)
+    ("gseq", "Q"),  # v3 tail (append-only rule)
 ]
 MECSubRead.FIXED_FIELDS = [
     ("pool_id", "q"), ("pg", "q"), ("oid", "s"), ("shard", "q"),
     ("tid", "s"), ("reply_to", "addr"), ("extents", "qq*"),
     ("want_hinfo", "?"),
+    ("gseq", "Q"),  # v4 tail (append-only rule)
 ]
 MECSubReadReply.FIXED_FIELDS = [
     ("tid", "s"), ("shard", "q"), ("ok", "?"), ("chunk", "y"),
     ("version", "Q"), ("object_size", "q"), ("hinfo", "y"),
+    ("gseq", "Q"),  # v4 tail (append-only rule)
 ]
 MPushShard.FIXED_FIELDS = [
     ("pool_id", "q"), ("pg", "q"), ("oid", "s"), ("shard", "q"),
     ("chunk", "y"), ("version", "Q"), ("object_size", "q"),
     ("hinfo", "y"),
+    ("gseq", "Q"),  # v4 tail (append-only rule)
 ]
 # xattr pushes carry an arbitrary dict: pickle those
 MPushShard.FIXED_WHEN = staticmethod(lambda m: not m.xattrs)
+
+# LANE_STRIPE: the data-plane set a multi-lane peer session stripes
+# across its data lanes (messenger LaneGroup): stamped with the
+# connection-global `gseq` order key, round-robined over lanes 1..N-1,
+# fragmented when the blob is large.  Control-plane types stay on lane 0
+# and are never queued behind data.
+# The full OBJECT-MUTATION plane stripes — a delete or xattr/omap
+# replication overtaking a parked striped write on the control lane
+# would reorder mutations to the same object (these three are pickled
+# payloads, so gseq rides the dict; old frames decode without it and
+# getattr defaults to 0)
+MECSubDelete.LANE_STRIPE = True
+MSetXattrs.LANE_STRIPE = True
+MSetOmap.LANE_STRIPE = True
+MOSDOp.LANE_STRIPE = True
+MOSDOpReply.LANE_STRIPE = True
+MECSubWrite.LANE_STRIPE = True
+MECSubWriteReply.LANE_STRIPE = True
+MECSubRead.LANE_STRIPE = True
+MECSubReadReply.LANE_STRIPE = True
+MPushShard.LANE_STRIPE = True

@@ -38,6 +38,15 @@ class Cluster:
         if "ms_local_fastpath" not in self.conf \
                 and not any(self.conf.get(k) for k in wire_keys):
             self.conf["ms_local_fastpath"] = True
+        # colocated ring transport (messenger/reactor negotiation): the
+        # connect-time fallback for anything the fastpath's send-time
+        # registry check misses.  Follows the SAME decision as the
+        # fastpath: a conf that explicitly turned the fastpath off is
+        # asking for the real wire (rx batching, sheds, traces over
+        # TCP), so the ring must not silently replace it either.
+        if "ms_colocated_ring" not in self.conf \
+                and self.conf.get("ms_local_fastpath"):
+            self.conf["ms_colocated_ring"] = True
         self.n_osds = n_osds
         self.n_mons = n_mons
         self.with_mgr = with_mgr

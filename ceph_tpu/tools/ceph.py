@@ -81,6 +81,51 @@ def render_op_queue(dump: Dict) -> List[str]:
     return lines
 
 
+def render_reactors(dump: Dict) -> List[str]:
+    """Render a daemon's ``dump_reactors`` answer (messenger
+    dump_reactors: reactor worker shards, per-peer lane groups, and
+    colocated rings).  Pure so tests can pin the layout."""
+    lines = [f"wire plane: {dump.get('op_threads', 0)} reactor workers, "
+             f"{dump.get('lanes_per_peer', 1)} lanes/peer, colocated ring "
+             f"{'on' if dump.get('colocated_ring') else 'off'}"]
+    workers = dump.get("workers") or []
+    if workers:
+        lines.append("  reactors:")
+        for w in workers:
+            lines.append(
+                f"    worker {w.get('id')}: sockets {w.get('sockets', 0)} "
+                f"(accepted {w.get('accepted', 0)}, dialed "
+                f"{w.get('dialed', 0)})  rx_msgs {w.get('rx_msgs', 0)}")
+    for peer in dump.get("peers") or []:
+        host, port = (peer.get("peer") or ["?", 0])[:2]
+        lines.append(
+            f"  peer {host}:{port} group {peer.get('group', '')[:8]} "
+            f"({'out' if peer.get('outbound') else 'in'}): "
+            f"{peer.get('n_lanes', 0)} lanes, tx_gseq "
+            f"{peer.get('tx_gseq', 0)}, rx parked {peer.get('rx_parked', 0)}"
+            f", reassembling {peer.get('reassembling', 0)}")
+        for ln in peer.get("lanes") or []:
+            if ln.get("state") == "absent":
+                lines.append(f"    lane {ln.get('lane')}: absent")
+                continue
+            role = "ctl " if ln.get("control") else "data"
+            reactor = ln.get("reactor")
+            lines.append(
+                f"    lane {ln.get('lane')} [{role}] {ln.get('state')}: "
+                f"outbox {ln.get('outbox_frames', 0)}f/"
+                f"{ln.get('outbox_bytes', 0)}B  unacked "
+                f"{ln.get('unacked', 0)}  seq {ln.get('out_seq', 0)}/"
+                f"{ln.get('in_seq', 0)}"
+                + (f"  reactor {reactor}" if reactor is not None else ""))
+    for ring in dump.get("rings") or []:
+        host, port = (ring.get("peer") or ["?", 0])[:2]
+        lines.append(f"  ring {host}:{port} ({ring.get('peer_name', '')}): "
+                     f"depth rx {ring.get('rx_depth', 0)} / tx "
+                     f"{ring.get('tx_depth', 0)}"
+                     + (" closed" if ring.get("closed") else ""))
+    return lines
+
+
 def _pg_states(osdmap) -> List[Dict]:
     """Per-PG rows derived from the map: acting set, primary, state
     (active+clean, or degraded when acting has holes) — the map-derived
@@ -199,10 +244,12 @@ async def run(args) -> int:
             prefix += " " + rest.pop(0)
         kwargs = dict(kv.split("=", 1) for kv in rest)
         result = await asok_command(path, prefix, **kwargs)
-        if args.format == "json" or prefix != "dump_op_queue":
+        renderers = {"dump_op_queue": render_op_queue,
+                     "dump_reactors": render_reactors}
+        if args.format == "json" or prefix not in renderers:
             print(json.dumps(result, indent=1, default=repr))
         else:
-            for line in render_op_queue(result):
+            for line in renderers[prefix](result):
                 print(line)
         return 0
     if not args.mon:

@@ -159,41 +159,113 @@ def _bench_metrics(path: str) -> dict:
 
 
 def run_wire_floor(args) -> int:
-    """Warn-only daemon-wire throughput floor: every round compares its
-    fresh BENCH record's daemon_wire_put/get_MBps against the previous
-    round's, so a wire-path regression surfaces in the round it lands
-    (the byte-exact corpus above pins ENCODINGS over time; this pins the
-    data plane's measured throughput the same way).  Warn-only because
-    bench hosts swing run to run — the floor flags, a human judges."""
-    if not args.bench or not args.prev:
-        print("--wire-floor needs --bench and --prev", file=sys.stderr)
+    """FAILING daemon-wire gate, two halves:
+
+    1. Throughput floor: the fresh BENCH record's
+       daemon_wire_put/get_MBps against the previous round's — a
+       wire-path regression fails CI the round it lands (promoted from
+       warn-only now that the multi-lane plane moves the numbers the
+       repo's claims rest on).  Skipped when no records are supplied.
+    2. Lane byte-identity: an in-process TCP cluster with
+       ``ms_lanes_per_peer=4`` + fragmentation must serve every object
+       byte-identical to a forced single-lane run of the same payloads —
+       the striping/reassembly seam may never change bytes.  Runs
+       whenever --wire-floor is requested (no BENCH records needed).
+
+        python -m ceph_tpu.tools.non_regression --wire-floor \\
+            [--bench BENCH_rNN.json --prev BENCH_rMM.json]
+    """
+    rc = 0
+    if args.bench and args.prev:
+        try:
+            cur = _bench_metrics(args.bench)
+            prev = _bench_metrics(args.prev)
+        except (OSError, ValueError) as e:
+            print(f"wire-floor: unreadable BENCH record: {e}",
+                  file=sys.stderr)
+            return 1
+        for key in ("daemon_wire_put_MBps", "daemon_wire_get_MBps"):
+            c = float(cur.get(key, 0.0) or 0.0)
+            p = float(prev.get(key, 0.0) or 0.0)
+            if p <= 0:
+                print(f"wire-floor: no previous {key}; skipping")
+                continue
+            floor = p * args.floor
+            if c < floor:
+                rc = 1
+                print(f"FAIL wire-floor: {key} {c:.1f} MB/s < "
+                      f"{args.floor:.2f} x previous {p:.1f} "
+                      f"(floor {floor:.1f})")
+            else:
+                print(f"wire-floor: {key} {c:.1f} MB/s vs previous "
+                      f"{p:.1f} ok")
+    elif args.bench or args.prev:
+        print("wire-floor: need BOTH --bench and --prev for the "
+              "throughput half; running lane identity only")
+    lane_rc = _wire_lane_identity()
+    return rc or lane_rc
+
+
+def _wire_lane_identity() -> int:
+    """Multi-lane vs single-lane byte-identity (the --wire-floor lane
+    half): same seeded payloads through a lanes=4 cluster and a forced
+    lanes=1 cluster; every get must match the source bytes in both."""
+    import asyncio
+    import hashlib
+
+    import numpy as np
+
+    from ceph_tpu.rados.vstart import Cluster
+
+    rng = np.random.default_rng(1234)
+    payloads = {
+        f"obj-{i}": rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        for i, size in enumerate((512, 96 << 10, (1 << 20) + 13,
+                                  5 << 20))
+    }
+
+    async def serve(lanes: int) -> dict:
+        cluster = Cluster(n_osds=4, conf={
+            "osd_auto_repair": False,
+            "ms_local_fastpath": False,
+            "ms_lanes_per_peer": lanes,
+        })
+        await cluster.start()
+        try:
+            c = await cluster.client()
+            pool = await c.create_pool("lanes", profile={
+                "plugin": "jerasure", "technique": "reed_sol_van",
+                "k": "2", "m": "1"})
+            out = {}
+            for oid, data in payloads.items():
+                await c.put(pool, oid, data)
+            for oid in payloads:
+                got = await c.get(pool, oid)
+                out[oid] = hashlib.sha256(bytes(got)).hexdigest()
+            await c.stop()
+            return out
+        finally:
+            await cluster.stop()
+
+    want = {oid: hashlib.sha256(data).hexdigest()
+            for oid, data in payloads.items()}
+    multi = asyncio.run(serve(4))
+    single = asyncio.run(serve(1))
+    bad = 0
+    for oid in payloads:
+        if multi.get(oid) != want[oid]:
+            print(f"FAIL wire-floor: lanes=4 read of {oid} not "
+                  f"byte-identical to source", file=sys.stderr)
+            bad += 1
+        if single.get(oid) != want[oid]:
+            print(f"FAIL wire-floor: lanes=1 read of {oid} not "
+                  f"byte-identical to source", file=sys.stderr)
+            bad += 1
+    if bad:
         return 1
-    try:
-        cur = _bench_metrics(args.bench)
-        prev = _bench_metrics(args.prev)
-    except (OSError, ValueError) as e:
-        print(f"wire-floor: unreadable BENCH record: {e}", file=sys.stderr)
-        return 1
-    warned = False
-    for key in ("daemon_wire_put_MBps", "daemon_wire_get_MBps"):
-        c = float(cur.get(key, 0.0) or 0.0)
-        p = float(prev.get(key, 0.0) or 0.0)
-        if p <= 0:
-            print(f"wire-floor: no previous {key}; skipping")
-            continue
-        floor = p * args.floor
-        if c < floor:
-            warned = True
-            print(f"WARN wire-floor: {key} {c:.1f} MB/s < "
-                  f"{args.floor:.2f} x previous {p:.1f} "
-                  f"(floor {floor:.1f})")
-        else:
-            print(f"wire-floor: {key} {c:.1f} MB/s vs previous {p:.1f} ok")
-    if warned:
-        print("WARN wire throughput regressed vs the previous BENCH "
-              "record (warn-only; investigate before claiming "
-              "cluster-path numbers)")
-    return 0  # warn-only by design
+    print(f"wire-floor: {len(payloads)} objects byte-identical across "
+          f"multi-lane (4) and single-lane runs")
+    return 0
 
 
 def run_chaos(args) -> int:

@@ -50,6 +50,8 @@ def _sample_messages() -> List[Any]:
     arch.record("corpus/hot", now=102.5)  # rotates the first interval
     arch.record("corpus/warm", now=102.6)
 
+    from ceph_tpu.rados.messenger import MLaneHello, MLaneSegment
+
     return [
         t.MOSDOp(op="write", pool_id=3, oid="corpus/oid", data=b"payload",
                  epoch=11, reqid="req-1", offset=4096, cls="lock",
@@ -57,36 +59,37 @@ def _sample_messages() -> List[Any]:
                  snap_read=7, snap_id=5, pg=12, cursor="after",
                  max_entries=64, nspace="blue", fadvise="willneed",
                  trace_id="deadbeefcafef00d", span_id="0123456789abcdef",
-                 client="client.gold.7"),
+                 client="client.gold.7", gseq=17),
         t.MOSDOp(op="multi", pool_id=1, oid="m", reqid="r2",
                  ops=[("setxattr", {"name": "a", "value": b"v"}),
                       ("omap_set", {"entries": {"k": b"x"}})]),
         t.MOSDOpReply(ok=False, error="nope", code=-17, data=b"reply",
                       oids=["a", "b"], cursor="cur", backoff=0.25,
-                      reqid="rq", version=(7 << 32) | 3, map_epoch=21),
+                      reqid="rq", version=(7 << 32) | 3, map_epoch=21,
+                      gseq=18),
         t.MECSubWrite(pool_id=2, pg=5, from_osd=3, epoch=13, oid="obj",
                       shard=4, chunk=b"chunkdata", version=99,
                       object_size=1234, chunk_crc=0xDEAD, tid="t1",
                       reply_to=("127.0.0.1", 6800), log_entry=b"LE",
                       chunk_off=8192, shard_size=65536, prior_version=42,
                       hinfo=b"HINFO", trace_id="deadbeefcafef00d",
-                      span_id="fedcba9876543210"),
+                      span_id="fedcba9876543210", gseq=19),
         t.MECSubWriteReply(tid="t1", shard=4, ok=False,
                            trace_id="deadbeefcafef00d",
-                           span_id="fedcba9876543210"),
+                           span_id="fedcba9876543210", gseq=20),
         t.MECSubRead(pool_id=2, pg=5, oid="obj", shard=1, tid="t2",
                      reply_to=("host", 1), extents=[(0, 4096), (8192, 64)],
-                     want_hinfo=True),
+                     want_hinfo=True, gseq=21),
         # chunk_crc stays default: it is SENDER-LOCAL (not in
         # FIXED_FIELDS — the frame's blob-crc slot carries it), so the
         # decoded archive must see the dataclass default
         t.MECSubReadReply(tid="t2", shard=1, ok=True, chunk=b"bytes",
-                          version=7, object_size=55, hinfo=b"H"),
+                          version=7, object_size=55, hinfo=b"H", gseq=22),
         t.MECSubDelete(pool_id=1, pg=2, oid="gone", shard=0, tid="t3",
                        reply_to=("h", 2)),
         t.MPushShard(pool_id=1, pg=0, oid="pushed", shard=2,
                      chunk=b"recovered", version=3, object_size=9,
-                     hinfo=b"HH"),
+                     hinfo=b"HH", gseq=23),
         t.MPushShard(pool_id=1, pg=0, oid="pushed2", shard=2,
                      chunk=b"r2", version=3, object_size=2,
                      xattrs={"lock.x": b"owner"}),
@@ -127,6 +130,14 @@ def _sample_messages() -> List[Any]:
             "muted": {}}),
         t.MHealthMute(check="SLOW_OPS", ttl=30.0, unmute=False,
                       tid="t13"),
+        # wire-plane negotiation + fragmentation types (messenger.py):
+        # the lane-handshake fields and the striped-segment layout are
+        # corpus-pinned like every other data-plane type
+        MLaneHello(group="aabbccdd00112233", lane=2, n_lanes=4,
+                   proc="feedface", flags=1),
+        MLaneSegment(gseq=24, idx=1, nfrags=3, total=48, off=16,
+                     type_id=30, version=6, fixed=True,
+                     header=b"HDRBYTES", chunk=b"C" * 16),
     ]
 
 

@@ -11,7 +11,8 @@ import pytest
 
 from ceph_tpu.rados.qos import (ClientRegistry, QosParams, QosTracker,
                                 parse_class_profile, pool_qos,
-                                tenant_class, validate_pool_qos)
+                                qos_op_cost, tenant_class,
+                                validate_pool_qos)
 from ceph_tpu.rados.scheduler import CLASS_CLIENT, MClockScheduler
 
 
@@ -91,6 +92,75 @@ class TestTagMath:
         assert abs(counts["A"] - 60) <= 3, counts
         assert abs(counts["B"] - 20) <= 3, counts
         assert abs(counts["C"] - 10) <= 3, counts
+
+    def test_byte_cost_tags_cap_bandwidth_hog(self):
+        """Byte-COST (r12 follow-up): a tenant issuing FEW large ops
+        must not escape a limit declared in ops/sec — tags advance by
+        1 + bytes/osd_qos_cost_per_io, so 4 large ops can cost as much
+        as 40 small ones."""
+        clock = FakeClock()
+        s = MClockScheduler({}, clock=clock)
+        # hog: 1 MiB ops, cost 1 + 1MiB/64KiB = 17 tag units each;
+        # small: 4 KiB ops, cost ~1.06 — both limited to 20 units/s
+        hog_cost = qos_op_cost(1 << 20, {})
+        small_cost = qos_op_cost(4096, {})
+        assert hog_cost == pytest.approx(17.0)
+        assert small_cost == pytest.approx(1.0625)
+        for i in range(100):
+            s.enqueue(CLASS_CLIENT, f"H{i}", client="client.hog.1",
+                      qos=QosParams(0.0, 10.0, 20.0),
+                      qos_cost=hog_cost)
+            s.enqueue(CLASS_CLIENT, f"S{i}", client="client.small.1",
+                      qos=QosParams(0.0, 10.0, 20.0),
+                      qos_cost=small_cost)
+        served = _drain(s, 60, 30.0, clock)  # two virtual seconds
+        hog = [x for x in served if x.startswith("H")]
+        small = [x for x in served if x.startswith("S")]
+        # 2s * 20 units/s = 40 units: ~2-3 hog ops vs ~37 small ops
+        assert len(hog) <= 5, f"bandwidth hog escaped: {len(hog)}"
+        assert len(small) >= 30, small
+
+    def test_byte_cost_normalization_and_knob(self):
+        # cost = 1 + bytes/osd_qos_cost_per_io, floor 1, knob-scaled
+        assert qos_op_cost(0, {}) == 1.0
+        assert qos_op_cost(65536, {}) == 2.0
+        assert qos_op_cost(4 << 20, {}) == 65.0
+        assert qos_op_cost(1 << 20,
+                           {"osd_qos_cost_per_io": 1 << 20}) == 2.0
+        # 0 disables the byte dimension entirely (pure per-op tagging)
+        assert qos_op_cost(8 << 20, {"osd_qos_cost_per_io": 0}) == 1.0
+        # garbage conf never wedges admission
+        assert qos_op_cost(123, {"osd_qos_cost_per_io": "bogus"}) == \
+            pytest.approx(1.0 + 123 / 65536)
+
+    def test_byte_cost_tag_math_deterministic(self):
+        """Exact L-tag arithmetic with byte costs under a fake clock."""
+        clock = FakeClock(100.0)
+        s = MClockScheduler({}, clock=clock)
+        q = QosParams(0.0, 1.0, 10.0)  # limit 10 units/s
+        s.enqueue(CLASS_CLIENT, "a", client="client.x.1", qos=q,
+                  qos_cost=5.0)
+        st = s.clients.states["client.x.1"]
+        # first op: max(0 + 5/10, now) clamps to now (tags are absolute)
+        assert st.l_tag == pytest.approx(100.0)
+        s.enqueue(CLASS_CLIENT, "b", client="client.x.1", qos=q,
+                  qos_cost=25.0)
+        assert st.l_tag == pytest.approx(102.5)  # +25/10
+        # default (no qos_cost) still advances by exactly one op
+        s.enqueue(CLASS_CLIENT, "c", client="client.x.1", qos=q)
+        assert st.l_tag == pytest.approx(102.6)
+
+    def test_tracker_observes_byte_cost(self):
+        clock = FakeClock(0.0)
+        t = QosTracker(clock=clock, arrears_cap=10.0)
+        p = QosParams(0.0, 1.0, 10.0)
+        # one 4 MiB op (cost 65) builds the arrears of 65 small ones
+        t.observe("client.hog.1", p, cost=qos_op_cost(4 << 20, {}))
+        assert t.excess("client.hog.1") == pytest.approx(6.5)
+        t2 = QosTracker(clock=clock, arrears_cap=10.0)
+        for _ in range(65):
+            t2.observe("client.small.1", p, cost=1.0)
+        assert t2.excess("client.small.1") == pytest.approx(6.5)
 
     def test_serving_split_counters(self):
         from ceph_tpu.rados.qos import build_scheduler_perf

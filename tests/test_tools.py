@@ -558,8 +558,10 @@ def test_benchmark_decode_verification_caps_signatures(monkeypatch):
 
 
 class TestWireFloor:
-    """non_regression --wire-floor: warn-only daemon-wire throughput
-    floor against the previous round's BENCH record."""
+    """non_regression --wire-floor: the FAILING daemon-wire gate — a
+    throughput floor against the previous round's BENCH record plus the
+    multi-lane byte-identity loop (stubbed here; the real loop is
+    exercised by the CI invocation and the lane tests)."""
 
     def _write(self, path, put, get, wrapped=False):
         import json
@@ -569,22 +571,43 @@ class TestWireFloor:
             rec = {"n": 5, "parsed": rec}
         path.write_text(json.dumps(rec))
 
-    def test_ok_and_warn_paths_both_exit_zero(self, tmp_path, capsys):
+    @pytest.fixture(autouse=True)
+    def _stub_lane_identity(self, monkeypatch):
+        # the cluster-spinning lane half is its own integration surface;
+        # these tests pin the record-comparison half's exit codes
+        self.lane_calls = []
+        monkeypatch.setattr(non_regression, "_wire_lane_identity",
+                            lambda: self.lane_calls.append(1) or 0)
+
+    def test_regression_fails_healthy_passes(self, tmp_path, capsys):
         prev = tmp_path / "prev.json"
         cur = tmp_path / "cur.json"
         self._write(prev, 200.0, 300.0, wrapped=True)
-        # regression on get only
+        # regression on get only: now a FAILING gate (was warn-only)
         self._write(cur, 210.0, 100.0)
         argv = ["--wire-floor", "--bench", str(cur), "--prev", str(prev)]
-        assert non_regression.main(argv) == 0  # warn-only
+        assert non_regression.main(argv) == 1
         out = capsys.readouterr().out
-        assert "WARN wire-floor: daemon_wire_get_MBps" in out
+        assert "FAIL wire-floor: daemon_wire_get_MBps" in out
         assert "daemon_wire_put_MBps 210.0" in out
-        # healthy record: no warning
+        # healthy record: green, and the lane-identity half ran too
         self._write(cur, 210.0, 290.0)
         assert non_regression.main(argv) == 0
         out = capsys.readouterr().out
-        assert "WARN" not in out
+        assert "FAIL" not in out
+        assert len(self.lane_calls) == 2
+
+    def test_lane_identity_failure_fails_gate(self, tmp_path,
+                                              monkeypatch):
+        prev = tmp_path / "prev.json"
+        cur = tmp_path / "cur.json"
+        self._write(prev, 200.0, 300.0)
+        self._write(cur, 210.0, 290.0)
+        monkeypatch.setattr(non_regression, "_wire_lane_identity",
+                            lambda: 1)
+        assert non_regression.main(
+            ["--wire-floor", "--bench", str(cur),
+             "--prev", str(prev)]) == 1
 
     def test_missing_previous_metric_skips(self, tmp_path, capsys):
         prev = tmp_path / "prev.json"
@@ -594,6 +617,10 @@ class TestWireFloor:
         assert non_regression.main(
             ["--wire-floor", "--bench", str(cur), "--prev", str(prev)]) == 0
         assert "skipping" in capsys.readouterr().out
+
+    def test_lane_identity_runs_without_records(self, capsys):
+        assert non_regression.main(["--wire-floor"]) == 0
+        assert len(self.lane_calls) == 1
 
     def test_unreadable_record_fails(self, tmp_path):
         cur = tmp_path / "cur.json"
