@@ -785,7 +785,8 @@ def main() -> int:
     # DAEMON-PATH throughput: rados put+get of a 64 MiB object through a
     # 6-OSD in-process cluster on the CPU backend (scrubbed child: the
     # Python messenger tax, not the accelerator, is what this measures).
-    got = _run_child_bench("--daemon-path", timeout=600)
+    got = _run_child_bench("--daemon-path", timeout=600,
+                           parse_on_fail=True)
     daemon_put_mbps = got.get("put_MBps", 0.0)
     daemon_get_mbps = got.get("get_MBps", 0.0)
     daemon_wire_put_mbps = got.get("wire_put_MBps", 0.0)
@@ -796,6 +797,8 @@ def main() -> int:
     daemon_wire_plane: dict = got.get("wire_plane", {})
     daemon_objecter_perf: dict = got.get("objecter_perf", {})
     daemon_phase_pcts: dict = got.get("op_phase_percentiles", {})
+    daemon_cluster_log: dict = got.get("cluster_log", {})
+    daemon_arm_failed = bool(got.get("_failed"))
 
     # multi-lane scaling curve (1/2/4/8 lanes): recorded every run so
     # the lane plane's scaling is a trajectory, not a one-off claim
@@ -933,7 +936,18 @@ def main() -> int:
         "tier_cold_read_MBps": round(tier_cold_mbps, 1),
         "tier_hot_vs_cold": round(tier_ratio, 2),
         "tier_perf": tier_perf,
+        # cluster-log tail summary of the daemon arms (warning+ counts
+        # by channel) + every crash report the bench mons collected —
+        # a crashed daemon FAILS the bench below instead of passing as
+        # a noisy sample inside the ±40% band
+        "cluster_log": daemon_cluster_log,
     }))
+    crashed = (daemon_cluster_log.get("crashes") or []) \
+        if isinstance(daemon_cluster_log, dict) else []
+    if crashed or daemon_arm_failed:
+        print(f"FAIL bench: daemon crashed mid-bench "
+              f"({[c.get('entity') for c in crashed]})", file=sys.stderr)
+        return 1
     return 0
 
 
@@ -1008,11 +1022,15 @@ def _wire_perf_summary(dumps) -> dict:
 
 
 def _run_child_bench(flag: str, timeout: int = 300,
-                     extra_env: dict = None) -> dict:
+                     extra_env: dict = None,
+                     parse_on_fail: bool = False) -> dict:
     """Run one scrubbed child-bench arm of this file (--daemon-path,
     --lanes-sweep, --hot-read, --onhost-overlap) and parse the JSON on
     its last stdout line; {} on any failure — a broken arm must never
-    take the whole BENCH record down."""
+    take the whole BENCH record down.  ``parse_on_fail`` still parses a
+    nonzero-exit child's record (tagged ``_failed``): the daemon arm
+    exits nonzero when a daemon CRASHED mid-bench, and that verdict —
+    with its cluster_log evidence — must reach the caller, not vanish."""
     import subprocess
 
     from ceph_tpu.utils.jaxdev import scrub_accelerator_env
@@ -1023,8 +1041,12 @@ def _run_child_bench(flag: str, timeout: int = 300,
         child = subprocess.run(
             [sys.executable, os.path.abspath(__file__), flag],
             env=env, capture_output=True, text=True, timeout=timeout)
-        if child.returncode == 0 and child.stdout.strip():
-            return json.loads(child.stdout.strip().splitlines()[-1])
+        if (child.returncode == 0 or parse_on_fail) \
+                and child.stdout.strip():
+            out = json.loads(child.stdout.strip().splitlines()[-1])
+            if child.returncode != 0 and isinstance(out, dict):
+                out["_failed"] = True
+            return out
     except Exception:
         pass
     return {}
@@ -1150,20 +1172,39 @@ def daemon_path_bench() -> int:
                 for i in range(burst):
                     await c.get(pool, f"p{i}")
                 phase_pcts["get"] = _collect()
+            # cluster-log + crash summary of this arm (read straight off
+            # the in-process mon's LogMonitor): a daemon that died
+            # mid-bench must FAIL the run, not hide as throughput noise
+            # in the ±40% band
+            clog = {
+                "warn_counts_by_channel":
+                    cluster.mon.logm.channel_counts(),
+                "crashes": cluster.mon.logm.crash_ls(),
+            }
             await c.stop()
             return (put_dt, get_dt, wire_perf, objecter_perf, phase_pcts,
-                    wire_plane)
+                    wire_plane, clog)
         finally:
             await cluster.stop()
 
-    put_dt, get_dt, _, _, _, _ = asyncio.run(go(True))
+    put_dt, get_dt, _, _, _, _, clog_fast = asyncio.run(go(True))
     (wire_put_dt, wire_get_dt, wire_perf, objecter_perf,
-     phase_pcts, wire_plane) = asyncio.run(
+     phase_pcts, wire_plane, clog_wire) = asyncio.run(
         go(False, WIRE_PLANE_CONF, want_plane=True))
     # colocated ring arm: fastpath OFF, ring ON — the negotiated
     # in-process transport serves every byte
-    (local_put_dt, local_get_dt, local_perf, _, _, _) = asyncio.run(
-        go(False, {"ms_colocated_ring": True}))
+    (local_put_dt, local_get_dt, local_perf, _, _, _,
+     clog_local) = asyncio.run(go(False, {"ms_colocated_ring": True}))
+    # merge the three arms' cluster-log summaries; ANY crash fails the
+    # bench (a silently dead OSD must not pass as a noisy sample)
+    warn_counts: dict = {}
+    crashes: list = []
+    for arm, cl in (("fastpath", clog_fast), ("wire", clog_wire),
+                    ("ring", clog_local)):
+        for ch, n in (cl.get("warn_counts_by_channel") or {}).items():
+            warn_counts[ch] = warn_counts.get(ch, 0) + n
+        for cr in cl.get("crashes") or []:
+            crashes.append({"arm": arm, **cr})
     print(json.dumps({
         "put_MBps": round(size / put_dt / 1e6, 1),
         "get_MBps": round(size / get_dt / 1e6, 1),
@@ -1185,7 +1226,17 @@ def daemon_path_bench() -> int:
         "objecter_perf": objecter_perf,
         # per-phase p50/p99/p999 (µs) from the TCP arm's op trackers +
         # wire histograms — where each op's time goes, as tails
-        "op_phase_percentiles": phase_pcts}))
+        "op_phase_percentiles": phase_pcts,
+        # cluster-log summary of the bench clusters (warning+ entry
+        # counts per channel) and every crash report the mon collected:
+        # the fleet-forensics view of the measured window
+        "cluster_log": {"warn_counts_by_channel": warn_counts,
+                        "crashes": crashes}}))
+    if crashes:
+        print(f"FAIL daemon-path bench: {len(crashes)} daemon crash"
+              f"(es) during the measured window: "
+              f"{[c['entity'] for c in crashes]}", file=sys.stderr)
+        return 1
     return 0
 
 

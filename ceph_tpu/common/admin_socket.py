@@ -43,6 +43,10 @@ class AdminSocket:
                       "get one option")
         self.register("log flush", self._log_flush, "drain async log writes")
         self.register("log dump", self._log_dump, "dump in-memory log ring")
+        # reference command name (`ceph daemon X log dump_recent`): same
+        # ring, including the separately pinned error entries
+        self.register("log dump_recent", self._log_dump,
+                      "dump in-memory log ring (alias of log dump)")
 
     # -- hooks ---------------------------------------------------------------
 

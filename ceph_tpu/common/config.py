@@ -245,7 +245,10 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
     Option("mon_lease", OPT_SECS, 5.0),
     Option("mon_election_timeout", OPT_SECS, 1.0),
     Option("paxos_propose_interval", OPT_SECS, 0.05),
-    # logging (src/common/dout.h per-subsys levels)
+    # logging (src/common/dout.h per-subsys levels; all RUNTIME-mutable —
+    # `ceph tell <daemon> config set debug_ms 10` / asok `config set` is
+    # the live-diagnosis workflow, the Log level cache invalidates via a
+    # debug_* observer)
     Option("log_max_recent", OPT_INT, 500),
     Option("debug_osd", OPT_INT, 1, level=LEVEL_DEV),
     Option("debug_mon", OPT_INT, 1, level=LEVEL_DEV),
@@ -253,6 +256,32 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
     Option("debug_ec", OPT_INT, 1, level=LEVEL_DEV),
     Option("debug_bluestore", OPT_INT, 1, level=LEVEL_DEV),
     Option("debug_client", OPT_INT, 1, level=LEVEL_DEV),
+    Option("debug_clog", OPT_INT, 1, level=LEVEL_DEV,
+           desc="local-log mirror level of cluster-log entries"),
+    # cluster log + crash telemetry (reference mon_cluster_log_*,
+    # mon_client_log_interval, mgr/crash warn_recent_interval)
+    Option("mon_cluster_log_entries", OPT_INT, 500,
+           desc="cluster-log tail the mon retains (paxos-replicated; "
+                "`ceph log last` serves from it)"),
+    Option("mon_client_log_interval", OPT_SECS, 0.25,
+           desc="LogClient flush cadence; errors flush immediately"),
+    Option("clog_max_pending", OPT_INT, 2048,
+           desc="unacked cluster-log entries a daemon holds before "
+                "dropping oldest (drop count kept)"),
+    Option("mon_crash_warn_age", OPT_SECS, 14 * 24 * 3600.0,
+           desc="unarchived crashes newer than this raise RECENT_CRASH"),
+    Option("mon_crash_max", OPT_INT, 64,
+           desc="crash reports the mon retains (oldest pruned)"),
+    Option("mon_crash_recent_max_bytes", OPT_SIZE, 32 << 10,
+           desc="per-crash dump_recent ring byte budget in the mon's "
+                "registry (newest entries kept; the registry rides "
+                "every paxos snapshot)"),
+    Option("crash_dir", OPT_STR, "", flags=(FLAG_STARTUP,),
+           desc="spool dir for crash reports the mon could not take "
+                "(replayed at next boot); empty disables spooling"),
+    Option("osd_debug_inject_crash", OPT_BOOL, False, level=LEVEL_DEV,
+           desc="raise a fatal exception in the OSD's next ping tick "
+                "(crash-telemetry CI gate)"),
 )
 
 
@@ -359,6 +388,12 @@ class Config:
     def _notify(self, changed: Set[str]) -> None:
         for handler, keys in list(self._observers):
             hit = changed & set(keys)
+            # a trailing-* key subscribes to a PREFIX (the debug_* family:
+            # per-subsystem level options are open-ended, and the log's
+            # level cache must invalidate on any of them)
+            for k in keys:
+                if k.endswith("*"):
+                    hit |= {c for c in changed if c.startswith(k[:-1])}
             if hit:
                 handler(self, hit)
 

@@ -51,6 +51,14 @@ class Context:
         self.op_tracker.register_asok(self.asok)
         self.tracer = Tracer(service=name)
         self.tracer.register_asok(self.asok)
+        # runtime debug levels: the Log caches per-subsystem levels (one
+        # dict lookup per dout); any debug_* change — asok `config set`,
+        # `ceph tell ... config set`, a mon-pushed layer — invalidates it
+        self.conf.add_observer(self._on_debug_change,
+                               ("debug_*", "log_max_recent"))
+
+    def _on_debug_change(self, conf, changed) -> None:
+        self.log.invalidate_levels()
 
     def dout(self, subsys: str, level: int, message: str) -> None:
         self.log.dout(subsys, level, message)

@@ -24,8 +24,17 @@ import json
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ceph_tpu.common.context import Context
 from ceph_tpu.common.perf_counters import PerfCountersCollection
+from ceph_tpu.rados.clog import LogClient
 from ceph_tpu.rados.messenger import Messenger, message
+from ceph_tpu.rados.monclient import MonTargets
+from ceph_tpu.rados.types import (  # noqa: F401 — re-export (old import site)
+    MCommand,
+    MCommandReply,
+    MCrashReport,
+    MLogAck,
+)
 
 
 @message(50)
@@ -38,17 +47,20 @@ class MMgrReport:
     stamp: float = 0.0
 
 
-@message(51)
-class MCrashReport:
-    name: str = ""
-    crash_id: str = ""
-    payload: Dict = None
-
-
 class MgrDaemon:
     def __init__(self, conf: Optional[dict] = None, mon_addrs=None):
         self.conf = conf or {}
         self.messenger = Messenger("mgr", self.conf, entity_type="mgr")
+        # observability bundle (CephContext role): config proxy + local
+        # log + admin socket, like every other daemon
+        self.ctx = Context("mgr", conf if isinstance(conf, dict) else None)
+        self.messenger.log = self.ctx.log
+        # cluster-log client: mgr module failures and lifecycle events
+        # land in the mon's cluster log, not just local stderr
+        self.clog: Optional[LogClient] = (
+            LogClient(self.messenger, MonTargets(mon_addrs), "mgr",
+                      self.conf, local_log=self.ctx.log)
+            if mon_addrs else None)
         self.reports: Dict[str, MMgrReport] = {}
         self.crashes: Dict[str, Dict] = {}
         self.addr: Optional[Tuple[str, int]] = None
@@ -88,9 +100,18 @@ class MgrDaemon:
         if self.mon_addrs:
             self._health_task = asyncio.get_running_loop().create_task(
                 self._poll_health())
+        if self.clog is not None:
+            self.clog.start()
+            self.clog.info("mgr daemon started")
+        asok_dir = self.conf.get("admin_socket_dir")
+        if asok_dir:
+            await self.ctx.asok.start(f"{asok_dir}/mgr.asok")
         return self.addr
 
     async def stop(self) -> None:
+        if self.clog is not None:
+            await self.clog.stop()
+        await self.ctx.shutdown()
         if self._modules_task:
             self._modules_task.cancel()
         if self._health_task:
@@ -196,7 +217,36 @@ class MgrDaemon:
         if isinstance(msg, MMgrReport):
             self.reports[msg.name] = msg
         elif isinstance(msg, MCrashReport):
-            self.crashes[msg.crash_id] = {"name": msg.name, **(msg.payload or {})}
+            # daemons report crashes to the MON (the authority behind
+            # `ceph crash` and RECENT_CRASH); the mgr keeps accepting
+            # directly posted reports for its /crash endpoints
+            self.crashes[msg.crash_id] = {
+                "name": msg.entity, "entity": msg.entity,
+                "crash_id": msg.crash_id, "timestamp": msg.stamp,
+                "exception": msg.exception, "backtrace": msg.backtrace}
+        elif isinstance(msg, MLogAck):
+            if self.clog is not None:
+                self.clog.handle_ack(msg)
+        elif isinstance(msg, MCommand):
+            # `ceph tell mgr ...`: run the admin-socket command
+            # in-process (same auth gate as the OSD/mon handlers)
+            if self.conf.get("auth_cephx", False) and \
+                    getattr(conn, "auth_kind", "none") == "none":
+                reply = MCommandReply(tid=msg.tid, ok=False,
+                                      error="EPERM: unauthenticated tell")
+            else:
+                try:
+                    result = self.ctx.asok.execute(msg.prefix,
+                                                   **(msg.args or {}))
+                    reply = MCommandReply(tid=msg.tid, ok=True,
+                                          result=result)
+                except Exception as e:
+                    reply = MCommandReply(tid=msg.tid, ok=False,
+                                          error=f"{type(e).__name__}: {e}")
+            try:
+                await conn.send(reply)
+            except (ConnectionError, OSError):
+                pass
 
     # -- queries -------------------------------------------------------------
 
@@ -395,14 +445,16 @@ class MgrDaemon:
 
 
 def crash_dump(exc: BaseException, name: str) -> Dict:
-    """Build a crash payload (ceph-crash agent's meta file role)."""
-    import traceback
-    import uuid
+    """Legacy dict shape of a crash payload; the wire plane now uses
+    clog.build_crash_report -> MCrashReport (fixed layout, spooled +
+    mon-collected).  Kept for callers that want a JSON-ish record."""
+    from ceph_tpu.rados.clog import build_crash_report
 
+    r = build_crash_report(exc, name)
     return {
-        "crash_id": f"{time.strftime('%Y-%m-%d_%H%M%S')}_{uuid.uuid4().hex[:8]}",
-        "timestamp": time.time(),
-        "entity_name": name,
-        "exception": repr(exc),
-        "backtrace": traceback.format_exception(exc),
+        "crash_id": r.crash_id,
+        "timestamp": r.stamp,
+        "entity_name": r.entity,
+        "exception": r.exception,
+        "backtrace": r.backtrace.splitlines(keepends=True),
     }

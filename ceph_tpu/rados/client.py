@@ -43,15 +43,24 @@ from typing import Dict, List, Optional, Tuple
 
 from ceph_tpu.common.perf_counters import PerfCounters, PerfCountersBuilder
 from ceph_tpu.common.tracing import Tracer
+from ceph_tpu.rados.clog import ClogEntry, LogClient, decode_entries
 from ceph_tpu.rados.messenger import BufferList, Messenger
 from ceph_tpu.rados.monclient import MonTargets
 from ceph_tpu.rados.types import (
     MAuthTicket,
     MAuthTicketReply,
+    MCommand,
+    MCommandReply,
     MConfigGet,
+    MCrashQuery,
+    MCrashQueryReply,
     MGetHealth,
     MHealthMute,
     MHealthReply,
+    MLog,
+    MLogAck,
+    MLogReply,
+    MLogSubscribe,
     MNotifyAck,
     MWatchNotify,
     MConfigReply,
@@ -232,6 +241,27 @@ class RadosClient:
         self._watch_primaries: Dict[Tuple[int, int], Optional[int]] = {}
         self._relinger_task: Optional[asyncio.Task] = None
         self._linger_poll_task: Optional[asyncio.Task] = None
+        # cluster-log watch (`ceph -w`): callback fed by inbound MLog
+        # stream frames after watch_cluster_log() subscribed
+        self._clog_cb = None
+        # tid -> future for `ceph tell` MCommand round-trips
+        self._tell_futs: Dict[str, asyncio.Future] = {}
+        # lazy LogClient: client-side tools clog too (audit trails,
+        # harness annotations) — created on first .clog use
+        self._clog: Optional[LogClient] = None
+
+    @property
+    def clog(self) -> LogClient:
+        """This client's cluster-log submitter (LogClient role for
+        client-side tools); lazily created, flushed on stop()."""
+        if self._clog is None:
+            self._clog = LogClient(self.messenger, self.mons, self.name,
+                                   self.conf)
+            try:
+                self._clog.start()
+            except RuntimeError:
+                pass  # no running loop yet: entries queue, flush() later
+        return self._clog
 
     async def start(self) -> None:
         self.messenger.dispatcher = self._dispatch
@@ -263,6 +293,8 @@ class RadosClient:
         self.messenger.session_key = bytes.fromhex(reply.session_key)
 
     async def stop(self) -> None:
+        if self._clog is not None:
+            await self._clog.stop()
         for t in (self._linger_poll_task, self._relinger_task):
             if t is not None and not t.done():
                 t.cancel()
@@ -312,8 +344,32 @@ class RadosClient:
 
                     traceback.print_exc()  # a broken callback must be loud
             return
+        if isinstance(msg, MLog):
+            # mon -> watcher stream frame (`ceph -w` subscription)
+            cb = self._clog_cb
+            if cb is not None:
+                for e in decode_entries(msg.entries):
+                    try:
+                        res = cb(e)
+                        if asyncio.iscoroutine(res):
+                            await res
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()  # broken callback: be loud
+            return
+        if isinstance(msg, MLogAck):
+            if self._clog is not None:
+                self._clog.handle_ack(msg)
+            return
+        if isinstance(msg, MCommandReply):
+            fut = self._tell_futs.pop(msg.tid, None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
+            return
         if isinstance(msg, (MMapReply, MCreatePoolReply, MConfigReply,
-                            MAuthTicketReply, MSnapOpReply, MHealthReply)):
+                            MAuthTicketReply, MSnapOpReply, MHealthReply,
+                            MLogReply, MCrashQueryReply)):
             # the mon echoes our per-RPC tid (like MOSDOp's reqid): a reply
             # landing after its RPC timed out has a stale tid and is dropped
             # instead of fulfilling the next RPC's future
@@ -535,6 +591,104 @@ class RadosClient:
         reply = await self._mon_rpc(
             MHealthMute(check=check, ttl=float(ttl), unmute=bool(unmute)))
         return reply.health
+
+    async def log_last(self, n: int = 0, level: int = 0,
+                       channel: str = "") -> List[ClogEntry]:
+        """`ceph log last [n] [level] [channel]`: the mon's retained
+        cluster-log tail (paxos-replicated), oldest first."""
+        reply = await self._mon_rpc(
+            MLogSubscribe(last_n=n, level=level, channel=channel))
+        return decode_entries(reply.entries)
+
+    async def watch_cluster_log(self, callback, level: int = 0,
+                                channel: str = "",
+                                last_n: int = 16) -> List[ClogEntry]:
+        """`ceph -w`: subscribe this session to the cluster log — the
+        mon streams every newly committed matching entry as MLog frames
+        and ``callback(entry)`` runs per entry (sync or async).  Returns
+        the current tail (the part `ceph -w` prints before following)."""
+        self._clog_cb = callback
+        reply = await self._mon_rpc(
+            MLogSubscribe(last_n=last_n, level=level, channel=channel,
+                          sub=True))
+        return decode_entries(reply.entries)
+
+    async def crash_ls(self) -> List[Dict]:
+        """`ceph crash ls`: crash-report summaries, oldest first."""
+        reply = await self._mon_rpc(MCrashQuery(op="ls"))
+        if not reply.ok:
+            raise RadosError(reply.error)
+        return reply.crashes
+
+    async def crash_info(self, crash_id: str) -> Dict:
+        """`ceph crash info <id>`: one report in full, the spooled
+        dump_recent ring decoded."""
+        reply = await self._mon_rpc(MCrashQuery(op="info",
+                                                crash_id=crash_id))
+        if not reply.ok:
+            raise RadosError(reply.error)
+        return reply.crashes[0]
+
+    async def crash_archive(self, crash_id: str = "") -> List[Dict]:
+        """`ceph crash archive <id>` ('' = archive-all): acknowledged
+        crashes stop raising RECENT_CRASH but stay listable."""
+        reply = await self._mon_rpc(MCrashQuery(
+            op="archive" if crash_id else "archive-all",
+            crash_id=crash_id))
+        if not reply.ok:
+            raise RadosError(reply.error)
+        return reply.crashes
+
+    async def crash_prune(self, keep_seconds: float) -> List[Dict]:
+        """`ceph crash prune`: drop reports older than keep_seconds."""
+        reply = await self._mon_rpc(MCrashQuery(op="prune",
+                                                keep=keep_seconds))
+        if not reply.ok:
+            raise RadosError(reply.error)
+        return reply.crashes
+
+    async def tell(self, target: str, prefix: str, timeout: float = 5.0,
+                   **args):
+        """`ceph tell <target> <cmd> [k=v...]` (reference MCommand):
+        run an admin-socket command on a remote daemon.  Targets:
+        ``osd.N`` (resolved via the osdmap), ``mon`` / ``mon.N`` (the
+        monmap), ``mgr`` (the mgr_addr config key)."""
+        if target.startswith("osd."):
+            if self.osdmap is None:
+                await self.refresh_map()
+            osd_id = int(target.split(".", 1)[1])
+            info = self.osdmap.osds.get(osd_id)
+            if info is None or not info.up:
+                raise RadosError(f"{target} is not up")
+            addr = tuple(info.addr)
+        elif target == "mon" or target.startswith("mon."):
+            rank = int(target.split(".", 1)[1]) if "." in target else 0
+            addr = self.mons.addrs[rank % len(self.mons.addrs)]
+        elif target == "mgr":
+            raw = str(self.conf.get("mgr_addr", "") or "")
+            if not raw:
+                reply = await self.config_get("mgr_addr")
+                raw = reply.get("mgr_addr", "")
+            if not raw:
+                raise RadosError("no mgr_addr known")
+            host, port = raw.rsplit(":", 1)
+            addr = (host, int(port))
+        else:
+            raise RadosError(f"bad tell target {target!r} "
+                             f"(want osd.N / mon[.N] / mgr)")
+        tid = uuid.uuid4().hex
+        fut = asyncio.get_running_loop().create_future()
+        self._tell_futs[tid] = fut
+        try:
+            await self.messenger.send(
+                addr, MCommand(tid=tid, target=target, prefix=prefix,
+                               args=dict(args)))
+            reply = await asyncio.wait_for(fut, timeout=timeout)
+        finally:
+            self._tell_futs.pop(tid, None)
+        if not reply.ok:
+            raise RadosError(reply.error)
+        return reply.result
 
     async def osd_set_flag(self, flag: str, on: bool = True) -> None:
         """`ceph osd set/unset <flag>` role: toggle a cluster-wide op

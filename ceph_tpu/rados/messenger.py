@@ -1893,6 +1893,10 @@ class LaneGroup:
                 # liveness backstop: force-drain in gseq order rather
                 # than grow without bound (a hole this old means the
                 # owning lane session is gone for good)
+                self.messenger.dout(
+                    1, f"lane group {self.group_id[:8]}: PARK_CAP "
+                       f"({self.PARK_CAP}) exceeded at gseq hole "
+                       f"{self._rx_next}; force-draining reorder buffer")
                 keys = sorted(self._parked)
                 out = [self._parked.pop(k) for k in keys]
                 self._rx_next = keys[-1] + 1
@@ -2161,6 +2165,13 @@ class Messenger:
         # the `wire` counter set (framing vs socket-io split; schema in
         # _build_wire_perf) — owning daemons add it to their collection
         self.perf = _build_wire_perf()
+        # per-daemon log (debug_ms levels): daemons attach their
+        # Context's Log; raw messengers stay silent.  Per-frame douts are
+        # call-site guarded with log.wants("ms", 20) so a disabled level
+        # costs one cached compare on the hot path — turning up debug_ms
+        # at runtime (asok / `ceph tell ... config set`) is the
+        # diagnostic workflow.
+        self.log = None
         self.dispatcher: Optional[Callable] = None
         # optional group-dispatch hook: group_dispatcher(conn, msgs) gets
         # a whole rx batch (frames that were already buffered) so the
@@ -2237,6 +2248,13 @@ class Messenger:
 
     def policy_for(self, peer_type: str) -> Policy:
         return self.policies.get(peer_type, Policy.lossy_client())
+
+    def dout(self, level: int, message: str) -> None:
+        """debug_ms-leveled dout into the owning daemon's log (no-op on
+        raw messengers).  Hot paths guard with ``self.log.wants`` first."""
+        log = self.log
+        if log is not None:
+            log.dout("ms", level, message)
 
     # -- cross-loop plumbing (reactor plane) ---------------------------------
 
@@ -2555,6 +2573,7 @@ class Messenger:
         self.server = await asyncio.start_server(self._accept, host, port)
         self.addr = self.server.sockets[0].getsockname()[:2]
         if self.reactors is not None:
+            self.reactors.log = self.log
             # shard the listening socket across the reactor workers:
             # inbound sockets are owned by whichever reactor accepts
             self.reactors.start()
@@ -2566,6 +2585,9 @@ class Messenger:
         if self._local_fastpath:
             self._loop = asyncio.get_running_loop()
             _LOCAL_REGISTRY[tuple(self.addr)] = self
+        self.dout(1, f"bind {self.addr[0]}:{self.addr[1]} (reactors "
+                     f"{self.reactors.n_workers if self.reactors else 0}, "
+                     f"lanes/peer {self.lanes_per_peer})")
         return self.addr
 
     @staticmethod
@@ -2798,6 +2820,16 @@ class Messenger:
                                           time.monotonic() - t_dec)
                             if conn.reactor is not None:
                                 conn.reactor.rx_msgs += 1
+                            log = self.log
+                            if log is not None and log.wants("ms", 20):
+                                # per-frame rx trace: debug_ms 20 only
+                                # (the wants() guard keeps the hot path
+                                # at one cached compare)
+                                log.dout(
+                                    "ms", 20,
+                                    f"rx {type(msg).__name__} seq={seq} "
+                                    f"{cost}B from {conn.peer[0]}:"
+                                    f"{conn.peer[1]}")
                         except Exception as e:
                             # undecodable (type/version skew): poison-
                             # discard so replay can't redeliver it forever
@@ -2878,6 +2910,11 @@ class Messenger:
             pass
         finally:
             await conn.close(gen)
+            if conn.closed:
+                self.dout(1, f"connection {conn.peer[0]}:{conn.peer[1]} "
+                             f"({conn.peer_name or '?'}) closed"
+                             + (" [lane]" if conn.lane_group is not None
+                                else ""))
             group = conn.lane_group
             if group is not None:
                 # lane death: a LOSSLESS lane revives in place (its
@@ -2948,6 +2985,8 @@ class Messenger:
             if home is not None and not home.is_closed():
                 home.call_soon_threadsafe(
                     lambda g=old: home.create_task(g.close()))
+        self.dout(4, f"lane {m.lane}/{m.n_lanes} bound for group "
+                     f"{m.group[:8]} from {conn.peer[0]}:{conn.peer[1]}")
         group.bind_lane(conn, m.lane)
 
     async def _revive_lane(self, group: LaneGroup, conn: Connection) -> None:
@@ -2999,6 +3038,10 @@ class Messenger:
                 conn.crc_fn = self._negotiated_crc(peer_ckind)
                 await conn.adopt_transport(reader, writer)
                 self.perf.inc("lane_revivals")
+                self.dout(1, f"lane revived in place for group "
+                             f"{group.group_id[:8]} peer "
+                             f"{group.peer[0]}:{group.peer[1]} (unacked "
+                             f"frames replayed)")
                 t = asyncio.get_running_loop().create_task(
                     self._serve(conn))
                 self._tasks.add(t)
@@ -3065,6 +3108,9 @@ class Messenger:
                 if pair is not None:
                     # colocated ring negotiated: zero-serialization
                     # in-process transport; the TCP socket retires
+                    self.dout(1, f"colocated ring negotiated with "
+                                 f"{peer_name or '?'} at "
+                                 f"{addr[0]}:{addr[1]}")
                     rx, tx = pair
                     rconn = RingConnection(self, addr, peer_name, rx, tx,
                                            outbound=True)

@@ -31,13 +31,33 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ceph_tpu.common.context import Context
 from ceph_tpu.ec.interface import ErasureCodeError
 from ceph_tpu.ec.registry import registry
 from ceph_tpu.rados.auth import KeyServer
+from ceph_tpu.rados.clog import (
+    CLOG_ERROR,
+    CLOG_INFO,
+    CLOG_WARN,
+    LogMonitor,
+    decode_entries,
+    describe_command,
+    encode_entries,
+)
 from ceph_tpu.rados.crush import CRUSH_ITEM_NONE, CrushMap
 from ceph_tpu.rados.messenger import TRANSPORT_ERRORS, Messenger
 from ceph_tpu.rados.paxos import ElectionLogic, MonitorDBStore, Paxos
 from ceph_tpu.rados.types import (
+    MCommand,
+    MCommandReply,
+    MCrashQuery,
+    MCrashQueryReply,
+    MCrashReport,
+    MCrashReportAck,
+    MLog,
+    MLogAck,
+    MLogReply,
+    MLogSubscribe,
     MAuthRotating,
     MAuthRotatingReply,
     MAuthTicket,
@@ -123,6 +143,19 @@ class Monitor:
         # BEFORE the state recovery below, which may restore them.
         self._health_reports: Dict[int, Dict] = {}  # osd -> {checks, stamp}
         self._health_mutes: Dict[str, float] = {}
+        # per-daemon observability bundle (CephContext role): local log
+        # (messenger/paxos douts ride it), admin socket, config proxy —
+        # the mon is a daemon like any other now
+        self.ctx = Context(f"mon.{rank}",
+                           conf if isinstance(conf, dict) else None)
+        self.messenger.log = self.ctx.log
+        # cluster log + crash registry (reference LogMonitor + mgr/crash):
+        # state rides the paxos snapshot below, so it MUST exist before
+        # the state recovery; watchers (`ceph -w` sessions) are
+        # per-monitor runtime state and stream from _apply_committed
+        self.logm = LogMonitor(self.conf, local_log=self.ctx.log,
+                               name=f"mon.{rank}")
+        self._log_watchers: Dict[int, Dict] = {}  # id(conn) -> sub state
         # (epoch, checks) memo for the per-PG degradation sweep — a pure
         # function of the map, recomputed only when the epoch moves (the
         # mgr polls health at ~1 Hz)
@@ -177,6 +210,7 @@ class Monitor:
                 "auth_keys": (self.keyserver.current_id,
                               self.keyserver.export_keys()),
                 "health_mutes": mutes,
+                "clog": self.logm.snapshot(),
             },
             protocol=5,
         )
@@ -195,6 +229,10 @@ class Monitor:
             self._health_mutes = {
                 name: (float("inf") if rem is None else now + rem)
                 for name, rem in mutes.items()}
+        clog = state.get("clog")
+        if clog is not None:
+            self.logm.load(clog)
+            self._stream_committed_log()
         auth = state.get("auth_keys")
         if auth and auth[0] >= self.keyserver.current_id:
             # adopt the quorum's rotating secrets: every mon must seal and
@@ -256,6 +294,20 @@ class Monitor:
                 self._run_election()
             )
         self._tick_task = asyncio.get_running_loop().create_task(self._tick())
+        # admin socket (asok `log flush`/`log dump_recent`/`config set`
+        # work on the mon like on every daemon); in-process execute()
+        # works without the unix socket
+        self.ctx.asok.register(
+            "quorum_status", lambda a: self.quorum_status(),
+            "election epoch, quorum, leader")
+        self.ctx.asok.register(
+            "log last",
+            lambda a: [e.render() for e in self.logm.tail(
+                int(a.get("n", 0) or 0))],
+            "tail of the cluster log")
+        asok_dir = self.conf.get("admin_socket_dir")
+        if asok_dir:
+            await self.ctx.asok.start(f"{asok_dir}/mon.{self.rank}.asok")
         return self.addr
 
     async def stop(self) -> None:
@@ -263,6 +315,7 @@ class Monitor:
         for t in (self._tick_task, self._election_task):
             if t:
                 t.cancel()
+        await self.ctx.shutdown()
         await self.messenger.shutdown()
 
     @property
@@ -285,6 +338,62 @@ class Monitor:
             "map_epoch": self.osdmap.epoch,
             "paxos_version": self.store.last_committed,
         }
+
+    # -- cluster-log streaming (`ceph -w` sessions) --------------------------
+
+    def _stream_committed_log(self) -> None:
+        """Push newly committed cluster-log entries to subscribed
+        sessions.  Runs on EVERY mon from _apply_committed (the paxos
+        snapshot carries the tail), so a watcher subscribed at a peon
+        streams within one commit window of the leader taking the
+        entry."""
+        if not self._log_watchers:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # boot-time state recovery: no loop, no watchers yet
+        for key, w in list(self._log_watchers.items()):
+            ents = self.logm.since(w["idx"], level=w["level"] or None,
+                                   channel=w["channel"])
+            if not ents:
+                # keep the cursor moving past filtered-out entries
+                w["idx"] = max(w["idx"], self.logm.last_idx)
+                continue
+            w["idx"] = max(e.idx for e in ents)
+            t = loop.create_task(self._send_log_stream(key, w, ents))
+            self._forward_tasks.add(t)
+            t.add_done_callback(self._forward_tasks.discard)
+
+    async def _send_log_stream(self, key, w, ents) -> None:
+        try:
+            await w["conn"].send(
+                MLog(who=f"mon.{self.rank}", entries=encode_entries(ents)))
+        except (ConnectionError, OSError):
+            self._log_watchers.pop(key, None)  # watcher went away
+
+    def _crash_query_read(self, msg: MCrashQuery) -> MCrashQueryReply:
+        """The read half of `ceph crash` (ls/info), servable at any mon."""
+        if msg.op == "ls":
+            return MCrashQueryReply(tid=msg.tid,
+                                    crashes=self.logm.crash_ls())
+        info = self.logm.crash_info(msg.crash_id)
+        if info is None:
+            return MCrashQueryReply(tid=msg.tid, ok=False,
+                                    error=f"no crash {msg.crash_id!r}")
+        return MCrashQueryReply(tid=msg.tid, crashes=[info])
+
+    def _handle_log_subscribe(self, conn, msg: MLogSubscribe) -> MLogReply:
+        tail = self.logm.tail(msg.last_n or 0,
+                              level=msg.level or None,
+                              channel=msg.channel)
+        if msg.sub:
+            self._log_watchers[id(conn)] = {
+                "conn": conn, "channel": msg.channel,
+                "level": msg.level, "idx": self.logm.last_idx}
+            while len(self._log_watchers) > 64:
+                self._log_watchers.pop(next(iter(self._log_watchers)))
+        return MLogReply(tid=msg.tid, entries=encode_entries(tail))
 
     # -- health (HealthMonitor role, reference src/mon/HealthMonitor.cc) ----
 
@@ -407,6 +516,9 @@ class Monitor:
                 del self._health_mutes[name]
         checks = self._map_health_checks()
         checks.update(self._daemon_health_checks())
+        # RECENT_CRASH (crash registry): unarchived crashes keep warning
+        # until `ceph crash archive` acknowledges them
+        checks.update(self.logm.health_checks())
         if not detail:
             for c in checks.values():
                 c.pop("detail", None)
@@ -517,7 +629,8 @@ class Monitor:
 
     async def _handle_forward(self, msg: MForward) -> None:
         try:
-            reply = await self._process_write(pickle.loads(msg.inner))
+            reply = await self._process_write(pickle.loads(msg.inner),
+                                              who=getattr(msg, "who", ""))
             await self._send_rank(
                 msg.from_rank,
                 MForwardReply(tid=msg.tid,
@@ -641,6 +754,31 @@ class Monitor:
     # -- ticks: leases, liveness --------------------------------------------
 
     async def _tick(self) -> None:
+        """Crash-guarded driver loop (daemon guard role): an unexpected
+        exception becomes a crash report — spooled to crash_dir (a mon
+        cannot file a report with itself) with the dump_recent ring —
+        instead of a silently dead task."""
+        try:
+            await self._tick_inner()
+        except asyncio.CancelledError:
+            raise
+        except BaseException as e:
+            from ceph_tpu.rados.clog import build_crash_report, spool_crash
+
+            report = build_crash_report(e, f"mon.{self.rank}",
+                                        version=self.ctx.version,
+                                        log=self.ctx.log)
+            crash_dir = self.conf.get("crash_dir", "")
+            if crash_dir:
+                try:
+                    spool_crash(crash_dir, report)
+                except OSError:
+                    pass
+            self.ctx.log.error("mon", f"tick loop crashed: {e!r} "
+                                      f"(crash id {report.crash_id})")
+            raise
+
+    async def _tick_inner(self) -> None:
         while not self._stopped:
             await asyncio.sleep(min(self._grace / 3, self._lease / 3))
             now = time.monotonic()
@@ -674,6 +812,14 @@ class Monitor:
                         info.up = False
                         info.in_cluster = False  # auto-out for remap
                         changed = True
+                        # the cluster log IS the operator's record of a
+                        # daemon death (a crashed OSD simply stops
+                        # pinging; its crash report may arrive via the
+                        # spool much later)
+                        self.logm.log(
+                            "cluster", CLOG_WARN,
+                            f"osd.{osd_id} marked down (no ping for "
+                            f"{now - last:.1f}s)")
                 if changed:
                     self.osdmap.epoch += 1
                     try:
@@ -735,11 +881,21 @@ class Monitor:
     # MGetHealth/MHealthMute ride the leader-forward path too: only the
     # leader holds the OSD-pushed health reports (pings forward there),
     # so a peon answering from its own empty report map would render a
-    # degraded cluster HEALTH_OK
+    # degraded cluster HEALTH_OK.  MLog/MCrashReport/MCrashQuery are
+    # LogMonitor state: replicated, so leader-only mutations.
     WRITE_TYPES = (MOsdBoot, MCreatePool, MDeletePool, MMarkDown,
                    MConfigSet, MOSDFailure,
                    MOSDPGTemp, MSetUpmap, MPoolSet, MSnapOp, MOSDSetFlag,
-                   MGetHealth, MHealthMute)
+                   MGetHealth, MHealthMute, MLog, MCrashReport,
+                   MCrashQuery)
+
+    # admin mutations mirrored to the `audit` channel (who/what) before
+    # execution — daemon-internal traffic (boots, failure reports,
+    # pg_temp churn, log pushes) would drown the channel and is not an
+    # operator action
+    AUDIT_TYPES = (MCreatePool, MDeletePool, MMarkDown, MConfigSet,
+                   MSetUpmap, MPoolSet, MSnapOp, MOSDSetFlag,
+                   MHealthMute, MCrashQuery)
 
     @staticmethod
     def _conn_is_daemon(conn) -> bool:
@@ -811,11 +967,41 @@ class Monitor:
             values = ({msg.key: self.cluster_conf.get(msg.key, "")}
                       if msg.key else dict(self.cluster_conf))
             await conn.send(MConfigReply(tid=msg.tid, values=values))
+        elif isinstance(msg, MLogSubscribe):
+            # log tail/subscription is a READ served by ANY mon: every
+            # mon's LogMonitor tracks the committed tail via the paxos
+            # snapshot, and _apply_committed streams to local watchers
+            await conn.send(self._handle_log_subscribe(conn, msg))
+        elif isinstance(msg, MCrashQuery) and msg.op in ("ls", "info"):
+            # crash ls/info are READS (any mon holds the registry via
+            # the snapshot): served locally — no leader forward, no
+            # state backup, and crucially no audit entry, or a crash-ls
+            # poll loop would evict real events from the bounded tail
+            await conn.send(self._crash_query_read(msg))
+        elif isinstance(msg, MCommand):
+            # `ceph tell mon.N ...`: run the admin-socket command here.
+            # Same gate as the OSD handler — with auth configured, an
+            # unauthenticated peer may not drive runtime config
+            if self.conf.get("auth_cephx", False) and \
+                    getattr(conn, "auth_kind", "none") == "none":
+                reply = MCommandReply(tid=msg.tid, ok=False,
+                                      error="EPERM: unauthenticated tell")
+            else:
+                try:
+                    result = self.ctx.asok.execute(msg.prefix,
+                                                   **(msg.args or {}))
+                    reply = MCommandReply(tid=msg.tid, ok=True,
+                                          result=result)
+                except Exception as e:
+                    reply = MCommandReply(tid=msg.tid, ok=False,
+                                          error=f"{type(e).__name__}: {e}")
+            await conn.send(reply)
         elif isinstance(msg, MPing):
             await self._handle_ping(conn, msg)
         elif isinstance(msg, self.WRITE_TYPES):
+            who = getattr(conn, "peer_name", "") or ""
             if self.is_leader:
-                reply = await self._process_write(msg)
+                reply = await self._process_write(msg, who=who)
                 try:
                     await conn.send(reply)
                 except (ConnectionError, OSError):
@@ -827,7 +1013,8 @@ class Monitor:
                     await self._send_rank(
                         self.logic.leader,
                         MForward(tid=tid, from_rank=self.rank,
-                                 inner=pickle.dumps(msg, protocol=5)),
+                                 inner=pickle.dumps(msg, protocol=5),
+                                 who=who),
                     )
                 except (ConnectionError, OSError):
                     self._pending_forwards.pop(tid, None)
@@ -893,7 +1080,7 @@ class Monitor:
 
     # -- writes (leader only) ------------------------------------------------
 
-    async def _process_write(self, msg: Any) -> Any:
+    async def _process_write(self, msg: Any, who: str = "") -> Any:
         """Apply one mutating request and replicate; returns the reply.
         Re-executions (messenger replay, forward retry) are suppressed by
         tid; a failed consensus round rolls the in-memory state back so a
@@ -909,6 +1096,17 @@ class Monitor:
         if tid and tid in self._applied_tids:
             return self._applied_tids[tid]
         backup = self._snapshot_state()
+        if isinstance(msg, self.AUDIT_TYPES) \
+                and not (isinstance(msg, MCrashQuery)
+                         and msg.op in ("ls", "info")):
+            # every admin MUTATION is mirrored to the `audit` channel
+            # (reference: the mon audit log) BEFORE execution, so the
+            # entry rides the same commit the handler performs (reads —
+            # crash ls/info — never audit: a poll loop must not evict
+            # real events from the bounded tail)
+            self.logm.log("audit", CLOG_INFO,
+                          f"from='{who or 'unknown'}' "
+                          f"cmd='{describe_command(msg)}': dispatch")
         try:
             reply = await self._process_write_inner(msg)
         except NoQuorum as e:
@@ -929,6 +1127,12 @@ class Monitor:
         self.cluster_conf = state["cluster_conf"]
         self._next_osd_id = state["next_osd_id"]
         self._next_pool_id = state["next_pool_id"]
+        # the cluster log deliberately does NOT roll back: the failed
+        # write's audit line says "dispatch" (an attempt, not an
+        # outcome), while a strict rewind would erase entries a
+        # CONCURRENT write committed after this backup was taken — and
+        # a NoQuorum failure usually means we are about to be deposed
+        # and resync from the new leader anyway
         # mutes roll back too: a mute whose commit failed must not leak
         # into a later snapshot (the operator was told it failed)
         mutes = state.get("health_mutes")
@@ -952,6 +1156,42 @@ class Monitor:
             # (the snapshot carries rebased remaining-ttls)
             await self._commit_state()
             return reply
+        if isinstance(msg, MLog):
+            # cluster-log batch from a daemon's LogClient: per-sender seq
+            # dedupe makes ack-loss resends idempotent; the tail rides
+            # the paxos snapshot and _apply_committed streams it to
+            # `ceph -w` watchers on every mon
+            last = self.logm.submit(msg.who, decode_entries(msg.entries))
+            await self._commit_state()
+            return MLogAck(who=msg.who, last_seq=last)
+        if isinstance(msg, MCrashReport):
+            if self.logm.add_crash(msg):
+                self.logm.log(
+                    "cluster", CLOG_ERROR,
+                    f"{msg.entity} crashed: {msg.exception} "
+                    f"(crash id {msg.crash_id})")
+                await self._commit_state()
+            return MCrashReportAck(tid=msg.tid, ok=True)
+        if isinstance(msg, MCrashQuery):
+            if msg.op in ("ls", "info"):
+                # normally served read-side in _dispatch; kept here for
+                # forwarded frames from older peers
+                return self._crash_query_read(msg)
+            if msg.op in ("archive", "archive-all"):
+                n = self.logm.crash_archive(
+                    "" if msg.op == "archive-all" else msg.crash_id)
+                if n:
+                    await self._commit_state()
+                return MCrashQueryReply(tid=msg.tid,
+                                        crashes=self.logm.crash_ls())
+            if msg.op == "prune":
+                n = self.logm.crash_prune(msg.keep)
+                if n:
+                    await self._commit_state()
+                return MCrashQueryReply(tid=msg.tid,
+                                        crashes=self.logm.crash_ls())
+            return MCrashQueryReply(tid=msg.tid, ok=False,
+                                    error=f"bad crash op {msg.op!r}")
         if isinstance(msg, MOsdBoot):
             return await self._process_boot(msg)
         if isinstance(msg, MCreatePool):
@@ -986,6 +1226,8 @@ class Monitor:
                 info.in_cluster = False
                 self._last_ping[msg.osd_id] = -1e9
                 self.osdmap.epoch += 1
+                self.logm.log("cluster", CLOG_WARN,
+                              f"osd.{msg.osd_id} marked down (admin)")
                 await self._commit_state()
             return MMapReply(osdmap=self.osdmap, tid=msg.tid)
         if isinstance(msg, MOSDFailure):
@@ -1006,6 +1248,10 @@ class Monitor:
                 self._last_ping[msg.target_osd] = -1e9
                 self.osdmap.epoch += 1
                 self._failure_reports.pop(msg.target_osd, None)
+                self.logm.log(
+                    "cluster", CLOG_WARN,
+                    f"osd.{msg.target_osd} marked down "
+                    f"(reported failed by osd.{msg.from_osd})")
                 await self._commit_state()
             return MMapReply(osdmap=self.osdmap)
         if isinstance(msg, MOSDPGTemp):
@@ -1300,6 +1546,13 @@ class Monitor:
             return MHealthReply(tid=tid, health=h)
         if isinstance(msg, MConfigSet):
             return MConfigReply(tid=tid, ok=False, error=error)
+        if isinstance(msg, MLog):
+            # last_seq 0 acks nothing: the LogClient resends next flush
+            return MLogAck(who=msg.who, last_seq=0)
+        if isinstance(msg, MCrashReport):
+            return MCrashReportAck(tid=tid, ok=False)
+        if isinstance(msg, MCrashQuery):
+            return MCrashQueryReply(tid=tid, ok=False, error=error)
         if isinstance(msg, (MMarkDown, MGetMap, MPing, MOSDFailure,
                             MOSDPGTemp, MSetUpmap, MPoolSet, MOSDSetFlag)):
             return MMapReply(osdmap=self.osdmap, tid=tid)
@@ -1322,6 +1575,9 @@ class Monitor:
             info.in_cluster = True
         self._last_ping[osd_id] = time.monotonic()
         self.osdmap.epoch += 1
+        self.logm.log("cluster", CLOG_INFO,
+                      f"osd.{osd_id} boot (addr "
+                      f"{msg.addr[0]}:{msg.addr[1]})")
         await self._commit_state()
         return MBootReply(osd_id=osd_id, osdmap=self.osdmap, tid=msg.tid,
                           cluster_conf=dict(self.cluster_conf))

@@ -60,6 +60,8 @@ from ceph_tpu.rados.ecutil import (HashInfo, StripeInfo,
                                    decode_object_async,
                                    planar_eligible, planar_encode_async,
                                    planar_object_bytes, planar_rows)
+from ceph_tpu.rados.clog import (LogClient, build_crash_report,
+                                 replay_crash_spool, spool_crash)
 from ceph_tpu.rados.messenger import (TRANSPORT_ERRORS, BufferList,
                                       Messenger, as_bytes)
 from ceph_tpu.rados.monclient import MonTargets
@@ -98,9 +100,13 @@ from ceph_tpu.rados.types import (
     MAuthTicketReply,
     MBackfillReserve,
     MBackfillReserveReply,
+    MCommand,
+    MCommandReply,
+    MCrashReportAck,
     MECSubRollback,
     MBootReply,
     MGetMap,
+    MLogAck,
     MECSubDelete,
     MECSubRead,
     MECSubReadReply,
@@ -258,6 +264,21 @@ class OSD:
         # the admin socket starts only when admin_socket_dir is configured
         self.ctx = Context(f"osd.{osd_id}",
                            conf if isinstance(conf, dict) else None)
+        # the messenger's douts ride this daemon's log (debug_ms levels,
+        # runtime-mutable via asok/`ceph tell` config set)
+        self.messenger.log = self.ctx.log
+        # cluster-log client (LogClient role): clog.info/warn/error land
+        # in the mon's paxos-replicated cluster log; renamed + started
+        # once the boot reply fixes our id
+        self.clog = LogClient(self.messenger, self.mons, f"osd.{osd_id}",
+                              self.conf, local_log=self.ctx.log)
+        # crash telemetry: reports spool here when the mon is
+        # unreachable (replayed at next boot); the dev inject flag makes
+        # the next ping tick die — the crash-plane CI gate's trigger
+        self._crash_dir = str(self.conf.get("crash_dir", "") or "")
+        self._inject_crash = bool(
+            self.conf.get("osd_debug_inject_crash", False))
+        self._fatal_task: Optional[asyncio.Task] = None
         # stamp trace-id/parent-span context onto outbound data-plane
         # messages (cross-daemon stitching); decode always tolerates
         # absent fields, so this only gates the SENDING side
@@ -508,11 +529,23 @@ class OSD:
         self._on_map(reply.osdmap)
         interval = self.conf.get("osd_heartbeat_interval", 0.3)
         loop = asyncio.get_running_loop()
-        self._ping_task = loop.create_task(self._ping_loop(interval))
-        self._hb_task = loop.create_task(self._heartbeat_loop(interval))
+        # the driver loops run under the daemon crash guard: an
+        # unexpected exception becomes a crash report + clog entry +
+        # clean shutdown, not a silently dead task
+        self._ping_task = loop.create_task(
+            self._guarded(self._ping_loop, interval))
+        self._hb_task = loop.create_task(
+            self._guarded(self._heartbeat_loop, interval))
         self.op_queue.start()
         self.ctx.name = f"osd.{self.osd_id}"
+        self.ctx.log.name = f"osd.{self.osd_id}"
         self.ctx.tracer.service = f"osd.{self.osd_id}"
+        self.clog.name = f"osd.{self.osd_id}"
+        self.clog.start()
+        if self._crash_dir:
+            # replay reports spooled while the mon was unreachable
+            # (cephadm crash-dir flow); acked entries leave the spool
+            await replay_crash_spool(self._crash_dir, self._send_crash)
         # mon-distributed config landed after the Context was built:
         # re-apply the op-tracker thresholds it governs
         self.ctx.op_tracker.slow_threshold = float(
@@ -537,6 +570,10 @@ class OSD:
             "dump_reactors", lambda a: self.messenger.dump_reactors(),
             "wire plane: reactor worker shards, per-peer lane state, "
             "colocated rings")
+        self.ctx.asok.register(
+            "inject_crash", lambda a: self.inject_crash(),
+            "raise a fatal exception in the next ping tick "
+            "(crash-telemetry exercise)")
         asok_dir = self.conf.get("admin_socket_dir")
         if asok_dir:
             self.ctx.asok.register(
@@ -560,8 +597,63 @@ class OSD:
         out["admission"] = self.qos.dump()
         return out
 
+    # -- daemon crash guard (the ceph-crash agent role) ----------------------
+
+    async def _guarded(self, fn, *args) -> None:
+        """Top-level exception hook around a serve loop: capture the
+        dump_recent ring + backtrace + identity into a crash report,
+        deliver it to the mon (spool to crash_dir when unreachable),
+        shout on the cluster log, and stop the daemon — a dying OSD must
+        leave a trace an operator (and `non_regression --crash`) can
+        query."""
+        try:
+            await fn(*args)
+        except asyncio.CancelledError:
+            raise
+        except BaseException as e:
+            await self._on_fatal(e)
+
+    async def _on_fatal(self, exc: BaseException) -> None:
+        entity = f"osd.{self.osd_id}"
+        self.ctx.log.error("osd", f"fatal: {exc!r}")
+        report = build_crash_report(exc, entity, version=self.ctx.version,
+                                    log=self.ctx.log)
+        self.clog.error(f"{entity} crashed: {exc!r} "
+                        f"(crash id {report.crash_id})")
+        delivered = await self._send_crash(report)
+        if not delivered and self._crash_dir:
+            try:
+                spool_crash(self._crash_dir, report)
+            except OSError:
+                pass
+        try:
+            await self.clog.flush_now()
+        except Exception:
+            pass
+        # the daemon dies (we may be running inside a task stop()
+        # cancels, so the shutdown detaches)
+        if not self._stopped:
+            self._fatal_task = asyncio.get_running_loop().create_task(
+                self.stop())
+
+    async def _send_crash(self, report) -> bool:
+        """Deliver one crash report to the mon; True only on a durable
+        ack (the spool-replay contract)."""
+        try:
+            ack = await self._mon_rpc(report, MCrashReportAck)
+            return bool(getattr(ack, "ok", False))
+        except Exception:
+            return False
+
+    def inject_crash(self) -> dict:
+        """Dev/CI hook (asok ``inject_crash`` / osd_debug_inject_crash):
+        the next ping tick raises, exercising the whole crash plane."""
+        self._inject_crash = True
+        return {"injected": True, "osd": self.osd_id}
+
     async def stop(self) -> None:
         self._stopped = True
+        await self.clog.stop()
         for t in (self._ping_task, self._hb_task, self._repair_task,
                   self._meta_repl_task):
             if t:
@@ -659,6 +751,12 @@ class OSD:
     async def _ping_loop(self, interval: float) -> None:
         ticks = 0
         while not self._stopped:
+            if self._inject_crash:
+                # dev/CI crash injection: a REAL unexpected exception in
+                # the daemon's driver loop, caught only by the guard
+                self._inject_crash = False
+                raise RuntimeError(
+                    "injected crash (osd_debug_inject_crash)")
             try:
                 await self.messenger.send(
                     self.mons.current,
@@ -1036,6 +1134,34 @@ class OSD:
                     self.store.omap_rm(key, msg.removals)
             except NotImplementedError:
                 pass
+        elif isinstance(msg, MLogAck):
+            self.clog.handle_ack(msg)
+        elif isinstance(msg, MCommand):
+            # `ceph tell osd.N <cmd>` (reference MCommand): run the
+            # admin-socket command in-process — config set/get (runtime
+            # debug levels), perf dump, dump_ops_in_flight, ... — and
+            # reply on the same connection.  With auth configured, only
+            # authenticated peers may drive it.
+            if self.conf.get("auth_cephx", False) and \
+                    getattr(conn, "auth_kind", "none") == "none":
+                reply = MCommandReply(tid=msg.tid, ok=False,
+                                      error="EPERM: unauthenticated tell")
+            else:
+                try:
+                    result = self.ctx.asok.execute(msg.prefix,
+                                                   **(msg.args or {}))
+                    reply = MCommandReply(tid=msg.tid, ok=True,
+                                          result=result)
+                except Exception as e:
+                    reply = MCommandReply(
+                        tid=msg.tid, ok=False,
+                        error=f"{type(e).__name__}: {e}")
+            try:
+                await conn.send(reply)
+            except (ConnectionError, OSError):
+                pass
+        elif isinstance(msg, MCrashReportAck):
+            self._resolve_monrpc(msg)
         elif isinstance(msg, MOSDPGHitSet):
             self._handle_pg_hit_set(msg)
         elif isinstance(msg, MPGLogReply) and not msg.tid:
@@ -1376,6 +1502,13 @@ class OSD:
         key = (pool.pool_id, pg)
         log = self._pglog(pool.pool_id, pg)
         pushed = 0
+        if self.ctx.log.wants("osd", 10):
+            # guarded: peering passes are frequent under thrash, and the
+            # whole point of debug_osd 10 is turning THIS on at runtime
+            self.ctx.dout("osd", 10,
+                          f"peering pg {pool.pool_id}.{pg:x} pass start: "
+                          f"epoch {epoch} acting {acting} "
+                          f"log head {log.head}")
         # -- GetInfo: every acting peer's last_update ------------------------
         m.transition(GET_INFO)
         infos, backfill = await self._peer_pg(pool, pg, acting)
@@ -1941,6 +2074,11 @@ class OSD:
         if qos_directed:
             self.sched_perf.inc("qos_shed")
         pg = self.osdmap.object_to_pg(pool, op.oid)
+        self.ctx.dout(
+            "osd", 2,
+            f"qos shed {'directed' if qos_directed else 'legacy'}: "
+            f"client={getattr(op, 'client', '')!r} op={op.op} "
+            f"pg={op.pool_id}.{pg:x} inflight={self.op_queue.inflight_ops}")
         await self._send_queue_block(conn, (op.pool_id, pg), op)
         return True
 
@@ -4829,6 +4967,9 @@ class OSD:
             self.tier_perf.inc("agent_skip")
             return
         self.tier_perf.inc("agent_pass")
+        self.ctx.dout("osd", 5,
+                      f"tier agent pass: resident {store.resident_bytes} "
+                      f"> high {high} (target {target})")
         excess = store.resident_bytes - high
         mine = [(k, b) for k, b in store.entries_snapshot()
                 if isinstance(k, tuple) and len(k) == 3

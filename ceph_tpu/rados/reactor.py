@@ -131,12 +131,22 @@ class ReactorPool:
             ReactorWorker(name, i) for i in range(self.n_workers)]
         self._servers: List[Tuple[ReactorWorker, Any]] = []
         self._started = False
+        # the owning daemon's Log (debug_ms douts); attached by the
+        # messenger when the daemon wires its Context in
+        self.log = None
+
+    def dout(self, level: int, message: str) -> None:
+        log = self.log
+        if log is not None:
+            log.dout("ms", level, message)
 
     def start(self) -> None:
         if not self._started:
             self._started = True
             for w in self.workers:
                 w.ensure_started()
+            self.dout(1, f"reactor pool {self.name}: "
+                         f"{self.n_workers} workers started")
 
     def worker_for(self, addr: Tuple[str, int], lane: int = 0) -> ReactorWorker:
         key = f"{addr[0]}:{addr[1]}:{lane}".encode()

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import hashlib
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ceph_tpu.rados.crush import CRUSH_ITEM_NONE, CrushMap
 from ceph_tpu.rados.crush import _mix as _crush_mix
@@ -516,13 +516,17 @@ class MMonPaxos:
     payload: Dict = field(default_factory=dict)  # op/version/value/...
 
 
-@message(12)
+@message(12, version=2)
 class MForward:
     """Peon -> leader relay of a client request (reference MForward)."""
 
     tid: str = ""
     from_rank: int = 0
     inner: bytes = b""  # pickled client message
+    # v2: the originating connection's peer identity, so the leader's
+    # audit-channel entry names the actual requester, not the peon
+    # (read with getattr — v1 pickles lack the field)
+    who: str = ""
 
 
 @message(13)
@@ -858,6 +862,141 @@ class MHealthMute:
     ttl: float = 0.0
     unmute: bool = False
     tid: str = ""
+
+
+# Cluster log + crash telemetry plane (reference src/messages/MLog.h,
+# MLogAck.h; the crash module's report flow).  Entry blobs use the
+# append-only ClogEntry codec (ceph_tpu/rados/clog.py), corpus-pinned.
+
+
+@message(73)
+class MLog:
+    """Daemon -> mon cluster-log batch (LogClient flush), and mon ->
+    subscriber stream frame (`ceph -w`).  ``entries`` is the ClogEntry
+    binary blob; ``who`` is the submitting entity (the mon's per-sender
+    seq-dedupe key — resent batches after a lost ack are idempotent)."""
+
+    who: str = ""
+    entries: bytes = b""
+
+    FIXED_FIELDS = [("who", "s"), ("entries", "y")]
+
+
+@message(74)
+class MLogAck:
+    """Mon -> daemon: everything from ``who`` up to ``last_seq`` is
+    durably in the cluster log (reference MLogAck); the LogClient drops
+    acked entries and resends the rest."""
+
+    who: str = ""
+    last_seq: int = 0
+
+    FIXED_FIELDS = [("who", "s"), ("last_seq", "Q")]
+
+
+@message(75)
+class MLogSubscribe:
+    """`ceph log last` / `ceph -w` query: the reply is an MLogReply
+    carrying the newest ``last_n`` retained entries at prio >= ``level``
+    on ``channel`` ('' = all).  With ``sub`` the serving mon ALSO
+    registers the connection as a log watcher and streams every newly
+    committed matching entry as MLog frames until the conn dies."""
+
+    tid: str = ""
+    channel: str = ""
+    level: int = 0
+    last_n: int = 0
+    sub: bool = False
+
+    FIXED_FIELDS = [("tid", "s"), ("channel", "s"), ("level", "q"),
+                    ("last_n", "q"), ("sub", "?")]
+
+
+@message(76)
+class MLogReply:
+    tid: str = ""
+    entries: bytes = b""
+
+    FIXED_FIELDS = [("tid", "s"), ("entries", "y")]
+
+
+@message(51, version=2)
+class MCrashReport:
+    """Daemon -> mon crash report (the ceph-crash meta file as a wire
+    frame; v1 was the mgr-plane pickled prototype): identity + version,
+    the exception and its backtrace, and the daemon's full
+    ``dump_recent`` ring at max verbosity (``recent``, ClogEntry-coded).
+    Spooled to the crash dir when the mon is unreachable and replayed at
+    next boot; the mon's LogMonitor registers it for `ceph crash ls/
+    info` and the RECENT_CRASH health check."""
+
+    entity: str = ""
+    crash_id: str = ""
+    stamp: float = 0.0
+    version: str = ""
+    exception: str = ""
+    backtrace: str = ""
+    recent: bytes = b""
+    tid: str = ""
+
+    FIXED_FIELDS = [("entity", "s"), ("crash_id", "s"), ("stamp", "d"),
+                    ("version", "s"), ("exception", "s"),
+                    ("backtrace", "s"), ("recent", "y"), ("tid", "s")]
+
+
+@message(77)
+class MCrashReportAck:
+    tid: str = ""
+    ok: bool = True
+
+    FIXED_FIELDS = [("tid", "s"), ("ok", "?")]
+
+
+@message(78)
+class MCrashQuery:
+    """`ceph crash ls|info|archive|archive-all|prune` (reference
+    mgr/crash commands, served here by the mon's LogMonitor).  ``keep``
+    is seconds for prune; archive/prune are replicated writes."""
+
+    tid: str = ""
+    op: str = "ls"  # ls | info | archive | archive-all | prune
+    crash_id: str = ""
+    keep: float = 0.0
+
+    FIXED_FIELDS = [("tid", "s"), ("op", "s"), ("crash_id", "s"),
+                    ("keep", "d")]
+
+
+@message(79)
+class MCrashQueryReply:
+    """Control-plane reply (pickled, like MHealthReply): ``crashes`` is
+    a list of crash summary/info dicts."""
+
+    tid: str = ""
+    ok: bool = True
+    error: str = ""
+    crashes: List[Dict] = field(default_factory=list)
+
+
+@message(80)
+class MCommand:
+    """`ceph tell <daemon> <cmd>` (reference MCommand.h): execute one
+    admin-socket command on a remote daemon over the cluster messenger —
+    the runtime-reconfiguration path (`tell osd.0 config set debug_ms
+    10`) and remote introspection without unix-socket access."""
+
+    tid: str = ""
+    target: str = ""
+    prefix: str = ""
+    args: Dict = field(default_factory=dict)
+
+
+@message(81)
+class MCommandReply:
+    tid: str = ""
+    ok: bool = True
+    error: str = ""
+    result: Any = None
 
 
 # Primary OSD <-> shard OSDs (ECSubWrite/ECSubRead equivalents,

@@ -47,6 +47,11 @@ class Cluster:
         if "ms_colocated_ring" not in self.conf \
                 and self.conf.get("ms_local_fastpath"):
             self.conf["ms_colocated_ring"] = True
+        # crash telemetry: a disk-backed cluster gets a crash spool dir
+        # by default (cephadm /var/lib/ceph/crash role) so daemon deaths
+        # while the mon is down still leave collectable reports
+        if data_dir and "crash_dir" not in self.conf:
+            self.conf["crash_dir"] = f"{data_dir}/crash"
         self.n_osds = n_osds
         self.n_mons = n_mons
         self.with_mgr = with_mgr

@@ -33,15 +33,31 @@ def parse_args(argv=None):
     p.add_argument("--yes-i-really-really-mean-it", action="store_true",
                    dest="confirm_destroy",
                    help="required acknowledgement for `osd pool rm`")
-    p.add_argument("words", nargs="+",
+    p.add_argument("-w", "--watch", action="store_true",
+                   help="subscribe to the cluster log and stream new "
+                        "entries (the `ceph -w` follow mode)")
+    p.add_argument("--watch-channel", default="",
+                   help="-w: only this channel (cluster, audit, ...)")
+    p.add_argument("--watch-level", default="",
+                   help="-w: minimum priority (debug/info/warn/error)")
+    p.add_argument("--run-for", type=float, default=0.0,
+                   help="-w: stop after this many seconds (0 = forever)")
+    p.add_argument("words", nargs="*",
                    help="status | health [detail] | "
                         "health mute CHECK [TTL] | health unmute CHECK | "
+                        "log last [N] [LEVEL] [CHANNEL] | "
+                        "crash ls | crash info ID | crash archive ID | "
+                        "crash archive-all | crash prune KEEP_DAYS | "
+                        "tell TARGET CMD [k=v...] | "
                         "df | osd df | osd tree | pg dump | "
                         "osd pool ls | osd pool create NAME [k=v...] | "
                         "osd pool set NAME KEY VALUE | "
                         "osd pool rm NAME NAME --yes-i-really-really-mean-it"
                         " | daemon ASOK_PATH CMD [k=v...]")
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if not args.words and not args.watch:
+        p.error("a command (or -w) is required")
+    return args
 
 
 def render_op_queue(dump: Dict) -> List[str]:
@@ -124,6 +140,60 @@ def render_reactors(dump: Dict) -> List[str]:
                      f"{ring.get('tx_depth', 0)}"
                      + (" closed" if ring.get("closed") else ""))
     return lines
+
+
+def render_log_dump(entries: List[Dict]) -> List[str]:
+    """Render an asok ``log dump`` / ``log dump_recent`` answer (the
+    daemon's in-memory ring incl. pinned errors).  Pure so tests can pin
+    the layout."""
+    out = []
+    for e in entries:
+        out.append(f"{e.get('stamp', 0.0):.6f} {e.get('level', 0):3d} "
+                   f"{e.get('subsys', '?')}: {e.get('message', '')}")
+    return out
+
+
+def render_crash_info(info: Dict) -> List[str]:
+    """Render `ceph crash info` (reference layout in miniature): the
+    report header, the backtrace, then the captured dump_recent ring."""
+    import time as _time
+
+    lines = [
+        f"crash_id: {info.get('crash_id', '')}",
+        f"entity:   {info.get('entity', '')}",
+        f"stamp:    "
+        f"{_time.strftime('%Y-%m-%dT%H:%M:%S', _time.localtime(info.get('stamp', 0.0)))}",
+        f"version:  {info.get('version', '')}",
+        f"archived: {bool(info.get('archived'))}",
+        f"exception: {info.get('exception', '')}",
+        "backtrace:",
+    ]
+    for ln in str(info.get("backtrace", "")).splitlines():
+        lines.append(f"    {ln}")
+    recent = info.get("recent") or []
+    lines.append(f"recent events ({len(recent)}):")
+    for e in recent:
+        lines.append(f"    {e.get('stamp', 0.0):.6f} "
+                     f"{e.get('level', 0):3d} {e.get('subsys', '?')}: "
+                     f"{e.get('message', '')}")
+    return lines
+
+
+# admin-command renderers, shared by `ceph daemon ASOK CMD` and
+# `ceph tell TARGET CMD` (same command surface, two transports)
+ASOK_RENDERERS = {"dump_op_queue": render_op_queue,
+                  "dump_reactors": render_reactors,
+                  "log dump": render_log_dump,
+                  "log dump_recent": render_log_dump}
+
+
+def print_asok_result(prefix: str, result, fmt: str) -> None:
+    renderer = ASOK_RENDERERS.get(prefix)
+    if fmt == "json" or renderer is None:
+        print(json.dumps(result, indent=1, default=repr))
+    else:
+        for line in renderer(result):
+            print(line)
 
 
 def _pg_states(osdmap) -> List[Dict]:
@@ -227,7 +297,7 @@ async def _df(client) -> List[Dict]:
 async def run(args) -> int:
     from ceph_tpu.rados.client import RadosClient
 
-    if args.words[0] == "daemon":
+    if args.words and args.words[0] == "daemon":
         # `ceph daemon ASOK CMD [k=v...]` role: one admin-socket command
         # against a running daemon — no mon needed
         if len(args.words) < 3:
@@ -244,13 +314,7 @@ async def run(args) -> int:
             prefix += " " + rest.pop(0)
         kwargs = dict(kv.split("=", 1) for kv in rest)
         result = await asok_command(path, prefix, **kwargs)
-        renderers = {"dump_op_queue": render_op_queue,
-                     "dump_reactors": render_reactors}
-        if args.format == "json" or prefix not in renderers:
-            print(json.dumps(result, indent=1, default=repr))
-        else:
-            for line in renderers[prefix](result):
-                print(line)
+        print_asok_result(prefix, result, args.format)
         return 0
     if not args.mon:
         print("--mon is required for cluster commands", file=sys.stderr)
@@ -262,6 +326,104 @@ async def run(args) -> int:
         await client.refresh_map()
         m = client.osdmap
         cmd = " ".join(args.words)
+        if args.watch:
+            # `ceph -w`: print the retained tail, then follow the stream
+            from ceph_tpu.rados.clog import PRIO_BY_NAME
+
+            level = PRIO_BY_NAME.get(args.watch_level.lower(), 0) \
+                if args.watch_level else 0
+
+            def _print(entry):
+                print(entry.render(), flush=True)
+
+            tail = await client.watch_cluster_log(
+                _print, level=level, channel=args.watch_channel)
+            for e in tail:
+                print(e.render())
+            try:
+                if args.run_for > 0:
+                    await asyncio.sleep(args.run_for)
+                else:
+                    while True:
+                        await asyncio.sleep(3600)
+            except (KeyboardInterrupt, asyncio.CancelledError):
+                pass
+            return 0
+        if args.words[:2] == ["log", "last"]:
+            from ceph_tpu.rados.clog import PRIO_BY_NAME
+
+            rest = args.words[2:]
+            n = int(rest.pop(0)) if rest and rest[0].isdigit() else 0
+            level = 0
+            if rest and rest[0].lower() in PRIO_BY_NAME:
+                level = PRIO_BY_NAME[rest.pop(0).lower()]
+            channel = rest.pop(0) if rest else ""
+            entries = await client.log_last(n=n, level=level,
+                                            channel=channel)
+            if args.format == "json":
+                print(json.dumps([vars(e) for e in entries]))
+            else:
+                for e in entries:
+                    print(e.render())
+            return 0
+        if args.words and args.words[0] == "crash":
+            sub = args.words[1] if len(args.words) > 1 else "ls"
+            if sub == "ls":
+                rows = await client.crash_ls()
+                if args.format == "json":
+                    print(json.dumps(rows))
+                else:
+                    import time as _time
+
+                    for r in rows:
+                        ts = _time.strftime(
+                            "%Y-%m-%dT%H:%M:%S",
+                            _time.localtime(r.get("stamp", 0.0)))
+                        print(f"{r['crash_id']:<44} {r['entity']:<10} "
+                              f"{ts}"
+                              + ("  (archived)" if r.get("archived")
+                                 else ""))
+                return 0
+            if sub == "info" and len(args.words) == 3:
+                info = await client.crash_info(args.words[2])
+                if args.format == "json":
+                    print(json.dumps(info, default=repr))
+                else:
+                    for line in render_crash_info(info):
+                        print(line)
+                return 0
+            if sub == "archive" and len(args.words) == 3:
+                await client.crash_archive(args.words[2])
+                print(f"archived {args.words[2]}")
+                return 0
+            if sub == "archive-all":
+                rows = await client.crash_archive()
+                print(f"archived {len(rows)} crash reports")
+                return 0
+            if sub == "prune" and len(args.words) == 3:
+                rows = await client.crash_prune(
+                    float(args.words[2]) * 24 * 3600.0)
+                print(f"{len(rows)} crash reports remain")
+                return 0
+            print("usage: crash ls | info ID | archive ID | archive-all"
+                  " | prune KEEP_DAYS", file=sys.stderr)
+            return 2
+        if args.words and args.words[0] == "tell":
+            # `ceph tell TARGET CMD [k=v...]`: remote asok command —
+            # `tell osd.0 config set key=debug_ms value=10` is the
+            # runtime-verbosity workflow
+            if len(args.words) < 3:
+                print("usage: tell TARGET COMMAND [k=v...]",
+                      file=sys.stderr)
+                return 2
+            target, prefix = args.words[1], args.words[2]
+            rest = args.words[3:]
+            while rest and "=" not in rest[0]:
+                prefix += " " + rest.pop(0)
+            kwargs = dict(kv.split("=", 1) for kv in rest)
+            result = await client.tell(target, prefix, **kwargs)
+            print_asok_result(prefix, result, args.format)
+            return 0
         pg_rows = _pg_states(m)
         if cmd == "status":
             # health comes from the MON's aggregation (HealthMonitor

@@ -60,6 +60,15 @@ def parse_args(argv=None):
     p.add_argument("--qos-seconds", type=float, default=3.0,
                    help="length of each qos traffic window")
     p.add_argument("--qos-osds", type=int, default=4)
+    # crash-telemetry gate (CI): inject a fatal exception into one OSD
+    # of a live cluster; a crash report must land in `ceph crash ls`
+    # (with the dump_recent ring), RECENT_CRASH must raise in health and
+    # clear on `crash archive`, and the cluster log must show the
+    # daemon death — nonzero exit otherwise
+    p.add_argument("--crash", action="store_true")
+    p.add_argument("--crash-seconds", type=float, default=15.0,
+                   help="ceiling on each crash-plane wait")
+    p.add_argument("--crash-osds", type=int, default=3)
     # tier smoke (CI): promote/evict/read loop against an in-process
     # cluster; exit nonzero on ANY content mismatch between a
     # resident-hit read and the cold decode path for the same object
@@ -581,6 +590,121 @@ def run_qos(args) -> int:
     return asyncio.run(go())
 
 
+def run_crash(args) -> int:
+    """Crash-telemetry gate (CI): the acceptance bar of the cluster-log
+    + crash plane, runnable as one command:
+
+        python -m ceph_tpu.tools.non_regression --crash
+
+    Injects a fatal exception into one OSD of a live cluster and then
+    asserts, in order: a crash report lands in `ceph crash ls` whose
+    `crash info` carries the injected exception, a backtrace, and the
+    daemon's dump_recent ring; `ceph health detail` raises RECENT_CRASH;
+    the cluster log records the daemon death (and the mon's subsequent
+    mark-down); `crash archive` clears RECENT_CRASH.  Any miss exits
+    nonzero."""
+    import asyncio
+    import time as _time
+
+    from ceph_tpu.rados.vstart import Cluster
+
+    async def go() -> int:
+        conf = {"osd_auto_repair": False,
+                "osd_heartbeat_interval": 0.1,
+                "mon_osd_report_grace": 1.0}
+        cluster = Cluster(n_osds=max(2, args.crash_osds), conf=conf)
+        await cluster.start()
+        failures = []
+        try:
+            c = await cluster.client()
+            pool = await c.create_pool("crash", profile={
+                "plugin": "jerasure", "technique": "reed_sol_van",
+                "k": "2", "m": "1"})
+            # some traffic first, so the victim's dump_recent ring has
+            # history worth spooling
+            import os as _os
+
+            for i in range(4):
+                await c.put(pool, f"o{i}", _os.urandom(8192))
+            victim = sorted(cluster.osds)[-1]
+            cluster.osds[victim].inject_crash()
+            # 1) the crash report must land in `ceph crash ls`
+            report = None
+            deadline = _time.monotonic() + args.crash_seconds
+            while _time.monotonic() < deadline:
+                ls = await c.crash_ls()
+                mine = [r for r in ls if r["entity"] == f"osd.{victim}"]
+                if mine:
+                    report = mine[-1]
+                    break
+                await asyncio.sleep(0.1)
+            if report is None:
+                failures.append(f"no crash report for osd.{victim} in "
+                                f"`crash ls` after injection")
+            else:
+                info = await c.crash_info(report["crash_id"])
+                if "injected crash" not in info.get("exception", ""):
+                    failures.append("crash info lost the exception: "
+                                    f"{info.get('exception')!r}")
+                if "Traceback" not in info.get("backtrace", ""):
+                    failures.append("crash info carries no backtrace")
+                if not info.get("recent"):
+                    failures.append("crash info carries no dump_recent "
+                                    "ring")
+            # 2) RECENT_CRASH raises in health detail
+            raised = False
+            deadline = _time.monotonic() + args.crash_seconds
+            while _time.monotonic() < deadline:
+                h = await c.get_health(detail=True)
+                if "RECENT_CRASH" in (h.get("checks") or {}):
+                    raised = True
+                    break
+                await asyncio.sleep(0.1)
+            if not raised:
+                failures.append("RECENT_CRASH never raised in "
+                                "`health detail`")
+            # 3) the cluster log shows the daemon death
+            deadline = _time.monotonic() + args.crash_seconds
+            crash_line = down_line = False
+            while _time.monotonic() < deadline:
+                tail = await c.log_last(level=3)  # warn+
+                crash_line = any("crashed" in e.message
+                                 and f"osd.{victim}" in e.message
+                                 for e in tail)
+                down_line = any("marked down" in e.message
+                                and f"osd.{victim}" in e.message
+                                for e in tail)
+                if crash_line and down_line:
+                    break
+                await asyncio.sleep(0.1)
+            if not crash_line:
+                failures.append("cluster log has no crash entry for "
+                                f"osd.{victim}")
+            if not down_line:
+                failures.append("cluster log has no mark-down entry for "
+                                f"osd.{victim}")
+            # 4) archive clears RECENT_CRASH
+            if report is not None:
+                await c.crash_archive(report["crash_id"])
+                h = await c.get_health()
+                if "RECENT_CRASH" in (h.get("checks") or {}):
+                    failures.append("RECENT_CRASH still raised after "
+                                    "`crash archive`")
+            print(f"crash: victim osd.{victim}, report "
+                  f"{'found' if report else 'MISSING'}, "
+                  f"RECENT_CRASH {'raised' if raised else 'MISSING'}, "
+                  f"clog crash/{crash_line} down/{down_line}, "
+                  f"{len(failures)} failures")
+            await c.stop()
+        finally:
+            await cluster.stop()
+        for f in failures:
+            print(f"FAIL {f}", file=sys.stderr)
+        return 1 if failures else 0
+
+    return asyncio.run(go())
+
+
 def run_tier(args) -> int:
     """Tier smoke mode (CI): a promote/evict/read loop against an
     in-process cluster with the device-residency tier forced on.  Every
@@ -728,6 +852,8 @@ def main(argv=None) -> int:
     args = parse_args(argv)
     if args.slow_ops:
         return run_slow_ops(args)
+    if args.crash:
+        return run_crash(args)
     if args.qos:
         return run_qos(args)
     if args.tier:

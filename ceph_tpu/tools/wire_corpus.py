@@ -51,6 +51,18 @@ def _sample_messages() -> List[Any]:
     arch.record("corpus/warm", now=102.6)
 
     from ceph_tpu.rados.messenger import MLaneHello, MLaneSegment
+    from ceph_tpu.rados.clog import ClogEntry, encode_entries
+
+    # deterministic cluster-log blob: the MLog/MLogReply/MCrashReport
+    # frames below pin the ClogEntry BINARY codec (append-only records)
+    # alongside the message layouts
+    clog_blob = encode_entries([
+        ClogEntry(stamp=1700000000.25, name="osd.3", channel="cluster",
+                  prio=3, seq=9001, message="corpus warn line", idx=41),
+        ClogEntry(stamp=1700000001.5, name="mon.0", channel="audit",
+                  prio=1, seq=77, message="from='client' cmd='MPoolSet'",
+                  idx=42),
+    ])
 
     return [
         t.MOSDOp(op="write", pool_id=3, oid="corpus/oid", data=b"payload",
@@ -130,6 +142,26 @@ def _sample_messages() -> List[Any]:
             "muted": {}}),
         t.MHealthMute(check="SLOW_OPS", ttl=30.0, unmute=False,
                       tid="t13"),
+        # cluster log + crash telemetry plane (clog.py): the ClogEntry
+        # blob codec and every frame of the plane are corpus-pinned
+        t.MLog(who="osd.3", entries=clog_blob),
+        t.MLogAck(who="osd.3", last_seq=9001),
+        t.MLogSubscribe(tid="t14", channel="audit", level=3, last_n=20,
+                        sub=True),
+        t.MLogReply(tid="t14", entries=clog_blob),
+        t.MCrashReport(entity="osd.3", crash_id="2026-08-03_12:00:00Z_abc",
+                       stamp=1700000002.75, version="1.0.0-tpu",
+                       exception="RuntimeError('corpus')",
+                       backtrace="Traceback...\n  corpus frame\n",
+                       recent=clog_blob, tid="t15"),
+        t.MCrashReportAck(tid="t15", ok=False),
+        t.MCrashQuery(tid="t16", op="prune", crash_id="2026-08-03_x",
+                      keep=86400.0),
+        t.MCrashQueryReply(tid="t16", ok=False, error="no crash",
+                           crashes=[{"crash_id": "c1", "entity": "osd.1"}]),
+        t.MCommand(tid="t17", target="osd.0", prefix="config set",
+                   args={"key": "debug_ms", "value": "10"}),
+        t.MCommandReply(tid="t17", ok=True, result={"success": True}),
         # wire-plane negotiation + fragmentation types (messenger.py):
         # the lane-handshake fields and the striped-segment layout are
         # corpus-pinned like every other data-plane type

@@ -1,0 +1,729 @@
+"""Cluster log + crash telemetry plane (reference LogClient/LogMonitor +
+the crash module): ClogEntry codec append-only discipline, LogMonitor
+bounding / seq dedupe / channel filtering / paxos persistence, audit
+entries for mon commands, `ceph -w` streaming, the crash report flow
+(inject -> crash ls/info -> RECENT_CRASH -> archive), spool-and-replay
+when the mon is down, runtime debug-level mutation via asok and
+`ceph tell`, golden old-frame decode, and the Log level-cache +
+pinned-error satellites."""
+
+import asyncio
+import io
+import json
+import os
+import struct
+import time
+
+import pytest
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.common.log import Log
+from ceph_tpu.rados.clog import (
+    CLOG_ERROR,
+    CLOG_INFO,
+    CLOG_WARN,
+    ClogEntry,
+    LogClient,
+    LogMonitor,
+    build_crash_report,
+    clear_spooled,
+    decode_entries,
+    encode_entries,
+    list_spooled,
+    replay_crash_spool,
+    spool_crash,
+)
+from ceph_tpu.rados.types import MCrashReport, MLog, MLogAck
+from ceph_tpu.rados.vstart import Cluster
+
+# real TCP (fastpath off): the e2e tests must push MLog/MCrashReport/
+# MCommand through the actual fixed-layout wire encode, not the
+# by-reference local dispatch
+CONF = {
+    "mon_osd_report_grace": 5.0,
+    "osd_heartbeat_interval": 0.1,
+    "osd_auto_repair": False,
+    "ms_local_fastpath": False,
+}
+
+PROFILE = {"plugin": "jerasure", "technique": "reed_sol_van",
+           "k": "2", "m": "1"}
+
+
+def run(coro, timeout=120):
+    asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+# -- ClogEntry binary codec ---------------------------------------------------
+
+
+class TestClogCodec:
+    def test_roundtrip(self):
+        ents = [
+            ClogEntry(stamp=1.25, name="osd.1", channel="cluster",
+                      prio=CLOG_WARN, seq=7, message="warn line", idx=3),
+            ClogEntry(stamp=2.5, name="mon.0", channel="audit",
+                      prio=CLOG_INFO, seq=8, message="cmd", idx=4),
+        ]
+        back = decode_entries(encode_entries(ents))
+        assert [vars(e) for e in back] == [vars(e) for e in ents]
+
+    def test_empty(self):
+        assert decode_entries(b"") == []
+        assert decode_entries(encode_entries([])) == []
+
+    def test_truncated_tail_record_decodes_with_defaults(self):
+        """A record from an OLDER build (fewer trailing fields) decodes;
+        the missing tail takes dataclass defaults — the append-only
+        discipline future fields rely on."""
+        blob = encode_entries([ClogEntry(
+            stamp=9.0, name="osd.2", channel="cluster", prio=CLOG_ERROR,
+            seq=11, message="boom", idx=5)])
+        # strip the trailing idx (8 bytes) from the single record
+        (reclen,) = struct.unpack_from("<I", blob, 5)
+        rec = blob[9:9 + reclen]
+        short = blob[:1] + struct.pack("<I", 1) \
+            + struct.pack("<I", reclen - 8) + rec[:-8]
+        [e] = decode_entries(short)
+        assert e.message == "boom" and e.seq == 11
+        assert e.idx == 0  # defaulted
+
+    def test_future_fields_appended_are_skipped(self):
+        """A record from a NEWER build (extra trailing bytes) decodes
+        today: reclen framing lets old decoders skip the unknown tail."""
+        blob = encode_entries([ClogEntry(stamp=1.0, name="a", seq=1,
+                                         message="m", idx=2)])
+        (reclen,) = struct.unpack_from("<I", blob, 5)
+        rec = blob[9:9 + reclen]
+        longer = blob[:1] + struct.pack("<I", 1) \
+            + struct.pack("<I", reclen + 12) + rec + b"\x00" * 12
+        [e] = decode_entries(longer)
+        assert e.message == "m" and e.idx == 2
+
+
+# -- LogMonitor state machine -------------------------------------------------
+
+
+class TestLogMonitor:
+    def _entries(self, who, n, start_seq=1, prio=CLOG_INFO,
+                 channel="cluster"):
+        return [ClogEntry(stamp=float(i), name=who, channel=channel,
+                          prio=prio, seq=start_seq + i,
+                          message=f"m{i}") for i in range(n)]
+
+    def test_bounded_tail(self):
+        lm = LogMonitor({"mon_cluster_log_entries": 10})
+        lm.submit("osd.0", self._entries("osd.0", 50))
+        assert len(lm.entries) == 10
+        # the newest survive
+        assert lm.tail()[-1].message == "m49"
+
+    def test_seq_dedupe_makes_resends_idempotent(self):
+        lm = LogMonitor()
+        batch = self._entries("osd.0", 5)
+        last = lm.submit("osd.0", batch)
+        assert last == 5
+        before = len(lm.entries)
+        # the whole batch resent (lost ack): nothing duplicates
+        assert lm.submit("osd.0", batch) == 5
+        assert len(lm.entries) == before
+        # a partially-new batch takes only the new entries
+        lm.submit("osd.0", self._entries("osd.0", 7))
+        assert len(lm.entries) == 7
+
+    def test_channel_and_level_filtering(self):
+        lm = LogMonitor()
+        lm.submit("osd.0", self._entries("osd.0", 3))
+        lm.submit("osd.1", self._entries("osd.1", 2, start_seq=100,
+                                         prio=CLOG_WARN,
+                                         channel="cluster"))
+        lm.log("audit", CLOG_INFO, "from='x' cmd='y'")
+        assert len(lm.tail(channel="audit")) == 1
+        assert len(lm.tail(level=CLOG_WARN)) == 2
+        assert len(lm.tail(n=2)) == 2
+        assert [e.message for e in lm.tail(n=2)] == \
+            [e.message for e in lm.tail()[-2:]]
+
+    def test_global_idx_monotonic_and_since(self):
+        lm = LogMonitor()
+        lm.submit("osd.0", self._entries("osd.0", 3))
+        cut = lm.last_idx
+        lm.submit("osd.1", self._entries("osd.1", 2, start_seq=50))
+        fresh = lm.since(cut)
+        assert len(fresh) == 2
+        assert all(e.idx > cut for e in fresh)
+
+    def test_snapshot_load_roundtrip_and_merge(self):
+        lm = LogMonitor()
+        lm.submit("osd.0", self._entries("osd.0", 4))
+        snap = lm.snapshot()
+        # a concurrent append AFTER the snapshot must survive load()
+        lm.log("cluster", CLOG_WARN, "late entry")
+        lm.load(snap)
+        msgs = [e.message for e in lm.tail()]
+        assert "late entry" in msgs and "m3" in msgs
+        # a fresh monitor loading the snapshot sees exactly the snapshot
+        lm2 = LogMonitor()
+        lm2.load(snap)
+        assert [e.message for e in lm2.tail()] == [f"m{i}"
+                                                   for i in range(4)]
+        # and keeps deduping resends by the restored last_seq
+        lm2.submit("osd.0", self._entries("osd.0", 4))
+        assert len(lm2.entries) == 4
+
+    def test_load_never_erases_post_snapshot_appends(self):
+        """Entries appended after a snapshot (a concurrent write's
+        audit line, a mon event) survive load() — a failed round's
+        rollback must not erase another write's committed entries, so
+        the mon never strict-rewinds the log."""
+        lm = LogMonitor()
+        lm.submit("osd.0", self._entries("osd.0", 2))
+        snap = lm.snapshot()
+        lm.log("audit", CLOG_INFO, "concurrent write's audit line")
+        lm.load(snap)
+        assert [e.message for e in lm.tail(channel="audit")] == \
+            ["concurrent write's audit line"]
+
+    def test_channel_counts(self):
+        lm = LogMonitor()
+        lm.log("cluster", CLOG_WARN, "w1")
+        lm.log("cluster", CLOG_ERROR, "e1")
+        lm.log("audit", CLOG_INFO, "info only")
+        assert lm.channel_counts() == {"cluster": 2}
+
+    def test_crash_registry_lifecycle(self):
+        lm = LogMonitor()
+        try:
+            raise RuntimeError("unit boom")
+        except RuntimeError as e:
+            report = build_crash_report(e, "osd.3", version="v1")
+        assert lm.add_crash(report)
+        assert not lm.add_crash(report)  # replay/resend dedupe
+        assert lm.health_checks().get("RECENT_CRASH", {}).get("count") == 1
+        [row] = lm.crash_ls()
+        assert row["entity"] == "osd.3" and not row["archived"]
+        info = lm.crash_info(row["crash_id"])
+        assert "unit boom" in info["exception"]
+        assert "Traceback" in info["backtrace"]
+        assert lm.crash_archive(row["crash_id"]) == 1
+        assert lm.health_checks() == {}
+        assert lm.crash_ls()[0]["archived"]
+        # prune drops it for good
+        assert lm.crash_prune(0.0) == 1
+        assert lm.crash_ls() == []
+
+    def test_crash_recent_ring_capped_keeps_newest(self):
+        """The stored ring is bounded (it rides every paxos snapshot):
+        over-budget reports keep their NEWEST entries."""
+        lm = LogMonitor({"mon_crash_recent_max_bytes": 2048})
+        log = Log(Config({"log_max_recent": 500}), sink=io.StringIO())
+        for i in range(400):
+            log.dout("osd", 5, f"breadcrumb {i:04d} " + "x" * 40)
+        try:
+            raise RuntimeError("big ring")
+        except RuntimeError as e:
+            report = build_crash_report(e, "osd.7", log=log)
+        assert len(report.recent) > 2048
+        lm.add_crash(report)
+        stored = lm.crashes[report.crash_id]["recent"]
+        assert 0 < len(stored) <= 2048
+        msgs = [r["message"]
+                for r in lm.crash_info(report.crash_id)["recent"]]
+        assert any("0399" in m for m in msgs)  # newest survived
+        assert not any("0000" in m for m in msgs)  # oldest trimmed
+
+    def test_describe_command_keeps_meaningful_zeros(self):
+        """`osd down 0` must record its target: audit rendering includes
+        scalar fields even when falsy (0 is a valid osd id)."""
+        from ceph_tpu.rados.clog import describe_command
+        from ceph_tpu.rados.types import MMarkDown
+
+        assert "osd_id=0" in describe_command(MMarkDown(osd_id=0))
+
+    def test_crash_report_carries_recent_ring(self):
+        log = Log(Config(), sink=io.StringIO(), name="osd.9")
+        log.dout("osd", 20, "high verbosity breadcrumb")
+        log.error("osd", "the precipitating error")
+        try:
+            raise ValueError("ring test")
+        except ValueError as e:
+            report = build_crash_report(e, "osd.9", log=log)
+        lm = LogMonitor()
+        lm.add_crash(report)
+        info = lm.crash_info(report.crash_id)
+        msgs = [r["message"] for r in info["recent"]]
+        assert "high verbosity breadcrumb" in msgs
+        assert "the precipitating error" in msgs
+
+
+# -- LogClient ----------------------------------------------------------------
+
+
+class TestLogClient:
+    def test_pending_bound_and_ack(self):
+        lc = LogClient(messenger=None, mons=None, name="osd.0",
+                       conf={"clog_max_pending": 4})
+        for i in range(10):
+            lc.info(f"m{i}")
+        assert lc.pending == 4 and lc.dropped == 6
+        seqs = sorted(lc._pending)
+        lc.handle_ack(MLogAck(who="osd.0", last_seq=seqs[1]))
+        assert lc.pending == 2
+        # an ack for some other daemon is ignored
+        lc.handle_ack(MLogAck(who="osd.1", last_seq=seqs[-1]))
+        assert lc.pending == 2
+
+    def test_seqs_monotonic_across_instances(self):
+        """A restarted daemon's fresh LogClient starts past its old
+        life's seqs (boot-time epoch), so the mon's last_seq dedupe
+        cannot swallow post-restart entries."""
+        a = LogClient(None, None, "osd.0")
+        e1 = a.do_log("cluster", CLOG_INFO, "before restart")
+        time.sleep(0.002)  # any real restart is far slower than this
+        b = LogClient(None, None, "osd.0")
+        e2 = b.do_log("cluster", CLOG_INFO, "after restart")
+        assert e2.seq > e1.seq
+
+
+# -- crash spool --------------------------------------------------------------
+
+
+class TestCrashSpool:
+    def _report(self, msg="spool boom"):
+        try:
+            raise RuntimeError(msg)
+        except RuntimeError as e:
+            return build_crash_report(e, "osd.5", version="v")
+
+    def test_spool_list_clear(self, tmp_path):
+        d = str(tmp_path / "crash")
+        r = self._report()
+        spool_crash(d, r)
+        [back] = list_spooled(d)
+        assert back.crash_id == r.crash_id
+        assert back.exception == r.exception
+        assert bytes(back.recent) == bytes(r.recent)
+        clear_spooled(d, r.crash_id)
+        assert list_spooled(d) == []
+
+    def test_replay_removes_only_acked(self, tmp_path):
+        d = str(tmp_path / "crash")
+        r1, r2 = self._report("one"), self._report("two")
+        spool_crash(d, r1)
+        spool_crash(d, r2)
+
+        async def send(report):
+            return "one" in report.exception  # only r1 gets acked
+
+        async def go():
+            n = await replay_crash_spool(d, send)
+            assert n == 1
+            left = list_spooled(d)
+            assert len(left) == 1 and "two" in left[0].exception
+
+        run(go())
+
+    def test_unreadable_entry_skipped(self, tmp_path):
+        d = tmp_path / "crash"
+        (d / "garbage").mkdir(parents=True)
+        (d / "garbage" / "meta").write_text("{not json")
+        assert list_spooled(str(d)) == []
+
+
+# -- Log satellites: level cache + pinned errors ------------------------------
+
+
+class TestLogLevels:
+    def test_gather_level_cached_and_invalidated(self):
+        conf = Config({"debug_ms": 0})
+        log = Log(conf, sink=io.StringIO())
+        assert not log.wants("ms", 10)
+        # a raw conf change without invalidation keeps the cached level
+        conf.set("debug_ms", 10)
+        log.invalidate_levels()
+        assert log.wants("ms", 10)
+
+    def test_context_observer_invalidates_on_debug_change(self):
+        from ceph_tpu.common.context import Context
+
+        ctx = Context("osd.t", {"debug_ms": 0})
+        assert not ctx.log.wants("ms", 10)
+        ctx.conf.set("debug_ms", "10")  # the asok `config set` path
+        assert ctx.log.wants("ms", 10)
+        ctx.conf.set("debug_ms", "0")
+        assert not ctx.log.wants("ms", 10)
+
+    def test_dump_recent_keeps_errors_past_ring_wrap(self):
+        log = Log(Config({"log_max_recent": 8}), sink=io.StringIO())
+        log.error("osd", "the error that explains everything")
+        for i in range(50):  # wrap the main ring completely
+            log.dout("osd", 5, f"noise {i}")
+        msgs = [m for _, _, _, m in log.dump_recent()]
+        assert "the error that explains everything" in msgs
+        # stamps stay sorted after the merge
+        stamps = [s for s, _, _, _ in log.dump_recent()]
+        assert stamps == sorted(stamps)
+
+    def test_dump_recent_no_duplicate_when_error_still_in_ring(self):
+        log = Log(Config(), sink=io.StringIO())
+        log.error("osd", "once")
+        msgs = [m for _, _, _, m in log.dump_recent()]
+        assert msgs.count("once") == 1
+
+
+# -- golden old-frame decode --------------------------------------------------
+
+
+class TestGoldenFrames:
+    def test_truncated_fixed_frames_decode(self):
+        """Frames from builds predating trailing FIXED_FIELDS decode
+        with defaults (the corpus golden dir holds the same layouts)."""
+        from ceph_tpu.rados.messenger import _pack_fixed, decode_message
+
+        blob = encode_entries([ClogEntry(stamp=1.0, name="osd.0",
+                                         seq=3, message="old")])
+        m = MLog(who="osd.0", entries=blob)
+        payload = _pack_fixed(m, MLog.FIXED_FIELDS[:1])  # who only
+        back = decode_message(MLog.TYPE_ID, 1, payload, None, True)
+        assert back.who == "osd.0" and back.entries == b""
+        r = MCrashReport(entity="osd.1", crash_id="cid", stamp=2.0,
+                         version="v", exception="X()")
+        payload = _pack_fixed(r, MCrashReport.FIXED_FIELDS[:5])
+        back = decode_message(MCrashReport.TYPE_ID, 2, payload, None,
+                              True)
+        assert back.entity == "osd.1" and back.exception == "X()"
+        assert back.backtrace == "" and back.recent == b""
+
+    def test_corpus_golden_dir_has_plane_frames(self):
+        golden = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "corpus", "wire", "golden")
+        names = os.listdir(golden)
+        assert any(n.startswith("MLog.") for n in names)
+        assert any(n.startswith("MCrashReport.") for n in names)
+
+
+# -- end to end on a live cluster --------------------------------------------
+
+
+class TestClusterLogE2E:
+    def test_clog_lands_in_log_last_and_streams_to_watcher(self):
+        """An OSD clog entry reaches `ceph log last` AND a subscribed
+        `ceph -w` session within one flush+commit window; channel
+        filters apply to both."""
+        async def go():
+            cluster = Cluster(n_osds=3, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                # boots are already in the tail
+                tail = await c.log_last()
+                boots = [e for e in tail if "boot" in e.message]
+                assert len(boots) >= 3
+                got = []
+                await c.watch_cluster_log(lambda e: got.append(e))
+                osd = next(iter(cluster.osds.values()))
+                osd.clog.warn("e2e stream probe")
+                for _ in range(100):
+                    if any("e2e stream probe" in e.message for e in got):
+                        break
+                    await asyncio.sleep(0.05)
+                assert any("e2e stream probe" in e.message for e in got)
+                # and it is durably in the tail, attributed to the osd
+                tail = await c.log_last(level=CLOG_WARN)
+                [probe] = [e for e in tail
+                           if "e2e stream probe" in e.message]
+                assert probe.name == f"osd.{osd.osd_id}"
+                assert probe.channel == "cluster"
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_watch_channel_filter(self):
+        async def go():
+            cluster = Cluster(n_osds=2, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                got = []
+                await c.watch_cluster_log(lambda e: got.append(e),
+                                          channel="audit")
+                osd = next(iter(cluster.osds.values()))
+                osd.clog.warn("cluster-channel noise")
+                # an audited admin command
+                pool = await c.create_pool("audited", profile=PROFILE)
+                assert pool
+                for _ in range(100):
+                    if any(e.channel == "audit" for e in got):
+                        break
+                    await asyncio.sleep(0.05)
+                assert got and all(e.channel == "audit" for e in got)
+                assert any("MCreatePool" in e.message for e in got)
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_audit_channel_records_mon_commands(self):
+        async def go():
+            cluster = Cluster(n_osds=2, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("auditpool", profile=PROFILE)
+                await c.pool_set(pool, "qos_weight", "5")
+                await c.osd_set_flag("pausewr", True)
+                await c.osd_set_flag("pausewr", False)
+                audit = await c.log_last(channel="audit")
+                msgs = [e.message for e in audit]
+                assert any("MCreatePool" in m and "auditpool" in m
+                           for m in msgs)
+                assert any("MPoolSet" in m and "qos_weight" in m
+                           for m in msgs)
+                assert any("MOSDSetFlag" in m and "pausewr" in m
+                           for m in msgs)
+                # requester identity is recorded
+                assert all(m.startswith("from='") for m in msgs)
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_log_last_persists_across_mon_restart(self, tmp_path):
+        """The cluster-log tail rides the mon's paxos store: a restarted
+        mon serves the pre-restart entries from disk."""
+        async def go():
+            store = str(tmp_path / "mon-store.db")
+            from ceph_tpu.rados.mon import Monitor
+
+            mon = Monitor(dict(CONF), data_path=store)
+            await mon.start()
+            mon.logm.log("cluster", CLOG_WARN, "survives restart")
+            await mon._commit_state()
+            await mon.stop()
+            mon2 = Monitor(dict(CONF), data_path=store)
+            await mon2.start()
+            try:
+                msgs = [e.message for e in mon2.logm.tail()]
+                assert "survives restart" in msgs
+            finally:
+                await mon2.stop()
+
+        run(go())
+
+    def test_crash_flow_end_to_end(self):
+        """inject -> report in `crash ls` (with ring + backtrace) ->
+        RECENT_CRASH in health detail -> cluster log shows the death ->
+        archive clears the check."""
+        async def go():
+            conf = dict(CONF)
+            conf["mon_osd_report_grace"] = 1.0
+            cluster = Cluster(n_osds=3, conf=conf)
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                victim = sorted(cluster.osds)[-1]
+                cluster.osds[victim].inject_crash()
+                report = None
+                for _ in range(150):
+                    ls = await c.crash_ls()
+                    mine = [r for r in ls
+                            if r["entity"] == f"osd.{victim}"]
+                    if mine:
+                        report = mine[-1]
+                        break
+                    await asyncio.sleep(0.1)
+                assert report is not None, "crash report never landed"
+                info = await c.crash_info(report["crash_id"])
+                assert "injected crash" in info["exception"]
+                assert "Traceback" in info["backtrace"]
+                assert info["recent"], "dump_recent ring missing"
+                h = await c.get_health(detail=True)
+                assert "RECENT_CRASH" in h["checks"]
+                assert any(f"osd.{victim}" in d
+                           for d in h["checks"]["RECENT_CRASH"]["detail"])
+                tail = await c.log_last(level=CLOG_ERROR)
+                assert any("crashed" in e.message
+                           and f"osd.{victim}" in e.message for e in tail)
+                await c.crash_archive(report["crash_id"])
+                h = await c.get_health()
+                assert "RECENT_CRASH" not in (h.get("checks") or {})
+                # still listable, flagged archived
+                ls = await c.crash_ls()
+                assert any(r["crash_id"] == report["crash_id"]
+                           and r["archived"] for r in ls)
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_crash_spools_when_mon_down_and_replays_at_boot(self,
+                                                           tmp_path):
+        """An OSD dying while the mon is unreachable spools its report;
+        the next OSD boot replays the spool into `crash ls`."""
+        async def go():
+            crash_dir = str(tmp_path / "crash")
+            conf = dict(CONF)
+            conf["crash_dir"] = crash_dir
+            cluster = Cluster(n_osds=2, conf=conf)
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                victim_id = sorted(cluster.osds)[-1]
+                victim = cluster.osds[victim_id]
+                # make the mon unreachable from the victim's viewpoint
+                victim.mons.addrs = [("127.0.0.1", 1)]
+                victim.inject_crash()
+                for _ in range(150):
+                    if list_spooled(crash_dir):
+                        break
+                    await asyncio.sleep(0.1)
+                spooled = list_spooled(crash_dir)
+                assert spooled, "crash never spooled with mon down"
+                assert (await c.crash_ls()) == []
+                # next boot replays the spool
+                await cluster.add_osd()
+                for _ in range(100):
+                    ls = await c.crash_ls()
+                    if ls:
+                        break
+                    await asyncio.sleep(0.1)
+                assert any(r["crash_id"] == spooled[0].crash_id
+                           for r in ls)
+                assert list_spooled(crash_dir) == []  # acked -> cleared
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_tell_config_set_changes_runtime_verbosity(self):
+        """`ceph tell osd.N config set debug_ms 10` flips emitted
+        verbosity at runtime, no restart: guarded messenger douts start
+        landing in the OSD's ring."""
+        async def go():
+            cluster = Cluster(n_osds=2, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                osd = cluster.osds[0]
+                assert not osd.ctx.log.wants("ms", 10)
+                r = await c.tell("osd.0", "config set",
+                                 key="debug_ms", value="10")
+                assert r["success"]
+                assert osd.ctx.log.wants("ms", 10)
+                got = await c.tell("osd.0", "config get", key="debug_ms")
+                assert int(got["debug_ms"]) == 10
+                # perf dump over tell (remote introspection path)
+                perf = await c.tell("osd.0", "perf dump")
+                assert "osd" in perf
+                # bad command surfaces as a typed error
+                from ceph_tpu.rados.client import RadosError
+
+                with pytest.raises(RadosError):
+                    await c.tell("osd.0", "no-such-command")
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_mon_and_mgr_answer_tell_and_asok_log_commands(self):
+        async def go():
+            cluster = Cluster(n_osds=2, conf=dict(CONF), with_mgr=True)
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                q = await c.tell("mon.0", "quorum_status")
+                assert q["is_leader"]
+                # every daemon answers the asok log surface in-process
+                for ctx in (cluster.mon.ctx, cluster.mgr.ctx,
+                            cluster.osds[0].ctx):
+                    assert ctx.asok.execute("log flush")["success"]
+                    ring = ctx.asok.execute("log dump_recent")
+                    assert isinstance(ring, list)
+                ver = await c.tell("mgr", "version")
+                assert ver["version"]
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+
+class TestCephWCli:
+    def test_ceph_w_streams_and_log_last_renders(self, capsys):
+        """The actual `ceph -w` / `ceph log last` CLI against a live
+        cluster (argparse -w flag, tail print + follow)."""
+        async def go():
+            cluster = Cluster(n_osds=2, conf=dict(CONF))
+            await cluster.start()
+            try:
+                from ceph_tpu.tools import ceph as ceph_cli
+
+                osd = next(iter(cluster.osds.values()))
+                osd.clog.warn("cli visible line")
+                await asyncio.sleep(0.8)  # one flush+commit window
+                mon_addr = f"127.0.0.1:{cluster.mon.addr[1]}"
+                rc = await ceph_cli.run(ceph_cli.parse_args(
+                    ["--mon", mon_addr, "log", "last", "20", "warn"]))
+                assert rc == 0
+                out = capsys.readouterr().out
+                assert "cli visible line" in out and "[WRN]" in out
+                # -w: subscribe, then a new entry arrives mid-watch
+                async def emit_later():
+                    await asyncio.sleep(0.5)
+                    osd.clog.error("mid watch entry")
+
+                emit = asyncio.get_running_loop().create_task(
+                    emit_later())
+                rc = await ceph_cli.run(ceph_cli.parse_args(
+                    ["--mon", mon_addr, "-w", "--run-for", "2.5"]))
+                await emit
+                assert rc == 0
+                out = capsys.readouterr().out
+                assert "mid watch entry" in out
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_crash_info_renderer(self):
+        from ceph_tpu.tools.ceph import render_crash_info
+
+        lines = render_crash_info({
+            "crash_id": "cid-1", "entity": "osd.2", "stamp": 0.0,
+            "version": "v", "archived": False,
+            "exception": "RuntimeError('x')",
+            "backtrace": "Traceback\n  line",
+            "recent": [{"stamp": 1.0, "subsys": "osd", "level": 5,
+                        "message": "breadcrumb"}]})
+        text = "\n".join(lines)
+        assert "cid-1" in text and "osd.2" in text
+        assert "breadcrumb" in text and "Traceback" in text
+
+    def test_log_dump_renderer(self):
+        from ceph_tpu.tools.ceph import render_log_dump
+
+        lines = render_log_dump([{"stamp": 2.5, "subsys": "ms",
+                                  "level": 1, "message": "bound"}])
+        assert lines == ["2.500000   1 ms: bound"]
+
+
+class TestBenchClusterLogSummary:
+    def test_channel_counts_feed_bench_record(self):
+        """The shape bench.py embeds: warning+ counts by channel and
+        the crash list, straight off the mon's LogMonitor."""
+        lm = LogMonitor()
+        lm.log("cluster", CLOG_WARN, "osd.1 marked down")
+        lm.log("cluster", CLOG_ERROR, "osd.1 crashed")
+        lm.log("audit", CLOG_INFO, "cmd")
+        summary = {"warn_counts_by_channel": lm.channel_counts(),
+                   "crashes": lm.crash_ls()}
+        assert summary["warn_counts_by_channel"] == {"cluster": 2}
+        assert summary["crashes"] == []
+        assert json.dumps(summary)  # JSON-serializable for the record

@@ -87,18 +87,18 @@ class TestMgr:
                 st = _json.loads(sjson[sjson.index(b"{"):])
                 assert st["num_daemons"] >= 3
                 assert any(n.startswith("osd.") for n in st["daemons"])
-                # crash flow
-                from ceph_tpu.mgr.daemon import MCrashReport, crash_dump
+                # crash flow (the fixed-layout MCrashReport frame the
+                # mon plane uses; the mgr keeps accepting direct posts)
+                from ceph_tpu.mgr.daemon import MCrashReport
+                from ceph_tpu.rados.clog import build_crash_report
 
                 try:
                     raise RuntimeError("daemon exploded")
                 except RuntimeError as e:
-                    payload = crash_dump(e, "osd.0")
+                    report = build_crash_report(e, "osd.0")
                 some_osd = next(iter(cluster.osds.values()))
-                await some_osd.messenger.send(
-                    mgr.addr, MCrashReport(name="osd.0",
-                                           crash_id=payload["crash_id"],
-                                           payload=payload))
+                assert isinstance(report, MCrashReport)
+                await some_osd.messenger.send(mgr.addr, report)
                 for _ in range(50):
                     if mgr.crash_ls():
                         break
