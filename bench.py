@@ -798,6 +798,7 @@ def main() -> int:
     daemon_objecter_perf: dict = got.get("objecter_perf", {})
     daemon_phase_pcts: dict = got.get("op_phase_percentiles", {})
     daemon_cluster_log: dict = got.get("cluster_log", {})
+    daemon_fullness: dict = got.get("fullness", {})
     daemon_arm_failed = bool(got.get("_failed"))
 
     # multi-lane scaling curve (1/2/4/8 lanes): recorded every run so
@@ -941,6 +942,10 @@ def main() -> int:
         # a crashed daemon FAILS the bench below instead of passing as
         # a noisy sample inside the ±40% band
         "cluster_log": daemon_cluster_log,
+        # per-OSD utilization + fullness states of the measured window
+        # (the mon's aggregated `osd df` view): a bench run on a
+        # nearfull host explains its own anomalies
+        "fullness": daemon_fullness,
     }))
     crashed = (daemon_cluster_log.get("crashes") or []) \
         if isinstance(daemon_cluster_log, dict) else []
@@ -1181,20 +1186,25 @@ def daemon_path_bench() -> int:
                     cluster.mon.logm.channel_counts(),
                 "crashes": cluster.mon.logm.crash_ls(),
             }
+            # per-OSD utilization + fullness of the measured window
+            # (the mon's aggregated view, straight off the in-process
+            # leader): embedded in the BENCH record
+            fullness = {str(osd_id): row for osd_id, row in
+                        cluster.mon._osd_utilization().items()}
             await c.stop()
             return (put_dt, get_dt, wire_perf, objecter_perf, phase_pcts,
-                    wire_plane, clog)
+                    wire_plane, clog, fullness)
         finally:
             await cluster.stop()
 
-    put_dt, get_dt, _, _, _, _, clog_fast = asyncio.run(go(True))
+    put_dt, get_dt, _, _, _, _, clog_fast, _ = asyncio.run(go(True))
     (wire_put_dt, wire_get_dt, wire_perf, objecter_perf,
-     phase_pcts, wire_plane, clog_wire) = asyncio.run(
+     phase_pcts, wire_plane, clog_wire, fullness) = asyncio.run(
         go(False, WIRE_PLANE_CONF, want_plane=True))
     # colocated ring arm: fastpath OFF, ring ON — the negotiated
     # in-process transport serves every byte
     (local_put_dt, local_get_dt, local_perf, _, _, _,
-     clog_local) = asyncio.run(go(False, {"ms_colocated_ring": True}))
+     clog_local, _) = asyncio.run(go(False, {"ms_colocated_ring": True}))
     # merge the three arms' cluster-log summaries; ANY crash fails the
     # bench (a silently dead OSD must not pass as a noisy sample)
     warn_counts: dict = {}
@@ -1231,7 +1241,10 @@ def daemon_path_bench() -> int:
         # counts per channel) and every crash report the mon collected:
         # the fleet-forensics view of the measured window
         "cluster_log": {"warn_counts_by_channel": warn_counts,
-                        "crashes": crashes}}))
+                        "crashes": crashes},
+        # per-OSD utilization + fullness states of the wire arm's
+        # cluster (mon aggregated view) — the capacity-plane snapshot
+        "fullness": fullness}))
     if crashes:
         print(f"FAIL daemon-path bench: {len(crashes)} daemon crash"
               f"(es) during the measured window: "

@@ -234,6 +234,40 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
            level=LEVEL_DEV),
     Option("osd_debug_inject_dispatch_delay_duration", OPT_SECS, 0.1,
            level=LEVEL_DEV),
+    # capacity / fullness plane (reference mon_osd_nearfull_ratio /
+    # backfillfull / full ratios in the OSDMap + osd_failsafe_full_ratio;
+    # the mon derives per-OSD NEARFULL/BACKFILLFULL/FULL states from the
+    # statfs piggybacked on liveness pings)
+    Option("osd_store_capacity_bytes", OPT_SIZE, 0,
+           desc="byte ceiling every object store reports via statfs "
+                "(0 = unlimited, the pre-capacity behavior); "
+                "vstart seeds each OSD's store from it"),
+    Option("osd_failsafe_full_ratio", OPT_FLOAT, 0.97,
+           desc="last-resort store guard: a write that would push used "
+                "bytes past this fraction of capacity is refused with a "
+                "typed ENOSPC BEFORE anything mutates"),
+    Option("mon_osd_nearfull_ratio", OPT_FLOAT, 0.85,
+           desc="default nearfull ratio seeded into new OSDMaps "
+                "(`ceph osd set-nearfull-ratio` overrides live)"),
+    Option("mon_osd_backfillfull_ratio", OPT_FLOAT, 0.90,
+           desc="default backfillfull ratio seeded into new OSDMaps "
+                "(backfill reservations refuse onto OSDs past it)"),
+    Option("mon_osd_full_ratio", OPT_FLOAT, 0.95,
+           desc="default full ratio seeded into new OSDMaps (writes to "
+                "PGs with a FULL acting member fail typed ENOSPC; "
+                "deletes are exempt)"),
+    Option("mon_osd_full_hysteresis", OPT_FLOAT, 0.01,
+           desc="utilization must drop this far below a fullness "
+                "threshold before the mon auto-clears the state "
+                "(flap damping on the ping cadence)"),
+    Option("osd_backfill_toofull_retry", OPT_SECS, 1.0,
+           desc="retry cadence for a backfill parked on a BACKFILLFULL "
+                "target (resumes when the target frees space)"),
+    Option("osd_debug_inject_full", OPT_STR, "", level=LEVEL_DEV,
+           desc="force reported utilization: 'RATIO' (this OSD) or "
+                "'ID:RATIO[,ID:RATIO...]' — drives the fullness ladder "
+                "in CI without writing gigabytes "
+                "(CEPH_TPU_INJECT_FULL env equivalent)"),
     # objectstore
     Option("bluestore_csum_type", OPT_STR, "crc32c",
            enum_values=("none", "crc32c")),

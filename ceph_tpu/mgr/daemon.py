@@ -407,6 +407,32 @@ class MgrDaemon:
                 typed("ceph_health_check_muted", "gauge")
                 lines.append(
                     f'ceph_health_check_muted{{check="{name}"}} 1')
+            # per-OSD utilization + fullness state (the mon's aggregated
+            # `osd df` view riding the health document): the capacity
+            # plane's alerting surface — dashboards graph utilization,
+            # alert rules match state != ""
+            util = health.get("osd_utilization") or {}
+            if util:
+                typed("ceph_osd_utilization_ratio", "gauge")
+                typed("ceph_osd_used_bytes", "gauge")
+                typed("ceph_osd_total_bytes", "gauge")
+                typed("ceph_osd_full_state", "gauge")
+                state_code = {"": 0, "nearfull": 1, "backfillfull": 2,
+                              "full": 3}
+                for osd_id, row in sorted(util.items()):
+                    st = row.get("state", "") or ""
+                    lines.append(
+                        f'ceph_osd_utilization_ratio{{osd="{osd_id}"}} '
+                        f'{row.get("ratio", 0.0)}')
+                    lines.append(f'ceph_osd_used_bytes{{osd="{osd_id}"}} '
+                                 f'{row.get("used", 0)}')
+                    lines.append(
+                        f'ceph_osd_total_bytes{{osd="{osd_id}"}} '
+                        f'{row.get("total", 0)}')
+                    lines.append(
+                        f'ceph_osd_full_state{{osd="{osd_id}",'
+                        f'state="{st or "ok"}"}} '
+                        f'{state_code.get(st, 0)}')
         lines.append(f"ceph_mgr_daemons_reporting {len(self.reports)}")
         return "\n".join(lines) + "\n"
 

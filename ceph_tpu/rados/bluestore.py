@@ -46,7 +46,8 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from ceph_tpu.rados.kv import KeyValueDB, MemDB, WalDB, WriteBatch
-from ceph_tpu.rados.store import (Key, ObjectStore, ShardMeta, Transaction,
+from ceph_tpu.rados.store import (ENOSPCError,  # noqa: F401 (re-export)
+                                  Key, ObjectStore, ShardMeta, Transaction,
                                   unwrap as store_unwrap)
 
 PREFIX_OBJ = "O"  # object metadata (extents, csums, ShardMeta, xattrs)
@@ -195,6 +196,13 @@ class BlueStore(ObjectStore):
             self._block = None
             self._blob: Dict[int, bytes] = {}  # off -> data (RAM mode)
         self.alloc = Allocator(0)
+        # configured byte ceiling + failsafe (reference bluestore
+        # bluefs/statfs capacity + osd_failsafe_full_ratio): 0 = grow
+        # forever (the pre-capacity behavior, default)
+        self.capacity_bytes = int(self.conf.get(
+            "osd_store_capacity_bytes", 0) or 0)
+        self.failsafe_ratio = float(self.conf.get(
+            "osd_failsafe_full_ratio", 0.97) or 0.97)
         self._onodes: Dict[Key, _Onode] = {}
         # per-pool store options pushed from the OSDMap (pg_pool_t::opts
         # role): compression_mode/algorithm/ratio/min_blob_size
@@ -270,6 +278,14 @@ class BlueStore(ObjectStore):
         with register_on_commit semantics)."""
         prefer_deferred = int(self.conf.get("bluestore_prefer_deferred_size",
                                             32768) or 0)
+        # failsafe BEFORE any mutation (KV batch, allocator, block file):
+        # a refused transaction leaves the store byte-identical.  The
+        # common no-ceiling config skips both sums (the free-list walk
+        # would otherwise tax every write for a guaranteed no-op check).
+        if self.capacity_bytes:
+            self._check_failsafe(
+                sum(len(store_unwrap(c)) for _k, c, _m in txn.writes),
+                self.alloc.size - sum(l for _, l in self.alloc.free))
         batch = WriteBatch()
         freed: List[Tuple[int, int]] = []
         for key in txn.deletes:
@@ -491,9 +507,14 @@ class BlueStore(ObjectStore):
 
     def statfs(self) -> Dict[str, int]:
         free = sum(l for _, l in self.alloc.free)
-        return {"size": self.alloc.size, "free": free,
-                "used": self.alloc.size - free,
-                "num_objects": len(self._onodes)}
+        used = self.alloc.size - free
+        total = int(self.capacity_bytes or 0)
+        # uniform shape first (total/used/avail, total==0 = unlimited);
+        # size/free kept for the allocator-view consumers
+        return {"total": total, "used": used,
+                "avail": max(0, total - used) if total else 0,
+                "num_objects": len(self._onodes),
+                "size": self.alloc.size, "free": free}
 
     def close(self) -> None:
         self.flush_deferred_batch()

@@ -72,6 +72,8 @@ from ceph_tpu.rados.types import (
     MMapReply,
     MOSDBackoff,
     MOSDSetFlag,
+    MSetFullRatio,
+    is_delete_only_multi,
     MPoolSet,
     MSetUpmap,
     MMarkDown,
@@ -104,6 +106,11 @@ _DEFINITIVE_CODES = frozenset((
     # compound-op asserts: cmpxattr mismatch / missing xattr are verdicts
     # about object state, not transients (reference rados_exec rvals)
     -errno.ECANCELED, -errno.ENODATA,
+    # capacity: a FULL acting member / failsafe-full store refused the
+    # write — resending into a full cluster cannot succeed (the cure is
+    # deleting, which stays exempt from every fullness gate), so ENOSPC
+    # surfaces typed and FAST instead of burning the op deadline
+    -errno.ENOSPC,
 ))
 # -ESTALE (not primary): the placement this op was computed on is WRONG —
 # re-target only after fencing past our own epoch (a newer map exists or
@@ -699,6 +706,53 @@ class RadosClient:
         await self._mon_rpc(MOSDSetFlag(flag=flag, set=bool(on)))
         await self.refresh_map()
 
+    async def osd_set_full_ratio(self, which: str, ratio: float) -> None:
+        """`ceph osd set-nearfull-ratio / set-backfillfull-ratio /
+        set-full-ratio`: install a fullness threshold in the OSDMap.
+        The mon validates the ladder ordering and answers a typed
+        error on violation."""
+        reply = await self._mon_rpc(
+            MSetFullRatio(which=which, ratio=float(ratio)))
+        if not getattr(reply, "ok", True):
+            raise RadosError(reply.error, code=-errno.EINVAL)
+        await self.refresh_map()
+
+    async def osd_df(self) -> Dict[int, Dict]:
+        """Per-OSD utilization + fullness from the MON's aggregated
+        view (ONE MGetHealth-style query instead of N per-OSD statfs
+        ops).  Falls back to direct per-OSD polling when the mon
+        predates the fullness plane (no osd_utilization in its health
+        document)."""
+        health = await self.get_health()
+        util = health.get("osd_utilization")
+        if util is not None:
+            return {int(k): dict(v) for k, v in util.items()}
+        # old mon: poll each up OSD directly, CONCURRENTLY — one
+        # unresponsive OSD must cost one timeout, not serialize the
+        # sweep (the discipline of the pre-aggregation fan-out)
+        await self.refresh_map()
+
+        async def one(osd_id: int, info) -> Tuple[int, Dict]:
+            row: Dict = {"up": info.up, "weight": info.weight,
+                         "state": ""}
+            if info.up:
+                try:
+                    st = await self.osd_statfs(osd_id)
+                    total = int(st.get("total", 0) or 0)
+                    used = int(st.get("used", 0) or 0)
+                    row.update(
+                        total=total, used=used,
+                        avail=int(st.get("avail", 0) or 0),
+                        num_objects=int(st.get("num_objects", 0) or 0),
+                        ratio=round(used / total, 4) if total else 0.0)
+                except Exception as e:
+                    row["error"] = str(e)
+            return osd_id, row
+
+        return dict(await asyncio.gather(
+            *(one(osd_id, info)
+              for osd_id, info in sorted(self.osdmap.osds.items()))))
+
     # -- data ops -------------------------------------------------------------
 
     def _calc_target(self, op: MOSDOp) -> Tuple[Optional[int], Optional[int]]:
@@ -723,8 +777,15 @@ class RadosClient:
 
     def _paused_for(self, op: MOSDOp) -> bool:
         """Is this op gated by the map's pause flags? (reference
-        Objecter::target_should_be_paused)"""
+        Objecter::target_should_be_paused)  DELETES are exempt from the
+        write gates: when the cluster pauses because it is FULL,
+        deleting is the only way out — the delete path must thread
+        through pausewr/full like it threads through the OSD's fullness
+        gates."""
         flags = getattr(self.osdmap, "flags", None) or ()
+        if op.op in ("delete", "snap-trim") \
+                or (op.op == "multi" and is_delete_only_multi(op)):
+            return False
         if op.op in _WRITE_OPS:
             return "pausewr" in flags or "full" in flags
         return "pauserd" in flags

@@ -84,10 +84,12 @@ from ceph_tpu.rados.types import (
     MOsdBoot,
     MOSDSetFlag,
     MPoolSet,
+    MSetFullRatio,
     MSetUpmap,
     MSnapOp,
     MSnapOpReply,
     MPing,
+    FULL_SEVERITY,
     OSDMap,
     OSDMapIncremental,
     OsdInfo,
@@ -114,8 +116,24 @@ class Monitor:
         self.logic = ElectionLogic(rank, n)
         self.paxos = Paxos(self.store, rank, self._paxos_send)
         self.paxos.on_commit = self._apply_committed
-        # replicated state machine
-        self.osdmap = OSDMap(epoch=1, crush=CrushMap.flat([]))
+        # replicated state machine; fullness thresholds seed from conf
+        # (reference mon_osd_*_ratio defaults baked into new OSDMaps;
+        # `ceph osd set-*full-ratio` moves them live)
+        self.osdmap = OSDMap(
+            epoch=1, crush=CrushMap.flat([]),
+            nearfull_ratio=float(
+                self.conf.get("mon_osd_nearfull_ratio", 0.85) or 0.85),
+            backfillfull_ratio=float(
+                self.conf.get("mon_osd_backfillfull_ratio", 0.90) or 0.90),
+            full_ratio=float(
+                self.conf.get("mon_osd_full_ratio", 0.95) or 0.95))
+        # per-OSD statfs from the latest liveness ping (leader-only, like
+        # _health_reports — pings forward to the leader): the raw
+        # utilization `ceph osd df` / mgr metrics render, and the input
+        # the fullness-state derivation runs on.  NOT in the osdmap:
+        # utilization moves every ping, states move rarely — only state
+        # TRANSITIONS bump the map epoch.
+        self._osd_statfs: Dict[int, Dict] = {}
         self.cluster_conf: Dict[str, str] = {}
         self._next_osd_id = 0
         self._next_pool_id = 1
@@ -421,6 +439,25 @@ class Monitor:
                 "severity": "warning",
                 "summary": f"flags set: {','.join(flags)}",
                 "flags": flags}
+        # fullness ladder (reference OSD_NEARFULL/OSD_BACKFILLFULL/
+        # OSD_FULL health checks off the OSDMap full sets)
+        by_state: Dict[str, List[int]] = {}
+        for osd_id, st in sorted((getattr(m, "full_osds", None)
+                                  or {}).items()):
+            by_state.setdefault(st, []).append(osd_id)
+        nf, bf, fl = m.fullness_ratios()
+        for st, check, thr, sev in (
+                ("nearfull", "OSD_NEARFULL", nf, "warning"),
+                ("backfillfull", "OSD_BACKFILLFULL", bf, "warning"),
+                ("full", "OSD_FULL", fl, "error")):
+            ids = by_state.get(st)
+            if ids:
+                checks[check] = {
+                    "severity": sev,
+                    "summary": f"{len(ids)} {st} osd(s): {ids}",
+                    "osds": ids,
+                    "detail": [f"osd.{i} has crossed the {st} "
+                               f"threshold ({thr:g})" for i in ids]}
         checks.update(self._pg_health_checks())
         return checks
 
@@ -435,10 +472,22 @@ class Monitor:
             return {k: dict(v) for k, v in self._pg_health_memo[1].items()}
         degraded: List[str] = []
         incomplete: List[str] = []
+        # a pool is FULL when the cluster-wide "full" flag gates it, or
+        # when ANY of its PGs' acting sets contains a FULL OSD — writes
+        # to that pool fail typed ENOSPC (reference POOL_FULL off the
+        # pool full flag); computed in the SAME sweep, same epoch memo
+        flag_full = "full" in (getattr(m, "flags", []) or [])
+        full_osds = {o for o, s in (getattr(m, "full_osds", None)
+                                    or {}).items() if s == "full"}
+        full_pools: List[str] = []
         for pool in m.pools.values():
+            pool_full = flag_full
             for pg in range(pool.pg_num):
                 acting = m.pg_to_acting(pool, pg)
                 live = [a for a in acting if a != CRUSH_ITEM_NONE]
+                if not pool_full and full_osds \
+                        and any(a in full_osds for a in live):
+                    pool_full = True
                 if len(live) == len(acting):
                     continue
                 pgid = f"{pool.pool_id}.{pg:x}"
@@ -446,7 +495,18 @@ class Monitor:
                     degraded.append(pgid)
                 else:
                     incomplete.append(pgid)
+            if pool_full:
+                full_pools.append(pool.name)
         checks: Dict[str, Dict] = {}
+        if full_pools:
+            checks["POOL_FULL"] = {
+                "severity": "error",
+                "summary": f"{len(full_pools)} pool(s) full: "
+                           f"{sorted(full_pools)}",
+                "pools": sorted(full_pools),
+                "detail": [f"pool '{p}' is full (writes fail ENOSPC; "
+                           f"deletes still served)"
+                           for p in sorted(full_pools)]}
         if degraded:
             checks["PG_DEGRADED"] = {
                 "severity": "warning",
@@ -537,7 +597,10 @@ class Monitor:
         else:
             status = "HEALTH_OK"
         return {"status": status, "checks": checks, "muted": muted,
-                "mutes": sorted(self._health_mutes)}
+                "mutes": sorted(self._health_mutes),
+                # per-OSD utilization + fullness (the `ceph osd df` /
+                # mgr-metrics aggregated view: one query, not N statfs)
+                "osd_utilization": self._osd_utilization()}
 
     def _handle_health_mute(self, msg: MHealthMute) -> MHealthReply:
         if msg.unmute:
@@ -886,6 +949,7 @@ class Monitor:
     WRITE_TYPES = (MOsdBoot, MCreatePool, MDeletePool, MMarkDown,
                    MConfigSet, MOSDFailure,
                    MOSDPGTemp, MSetUpmap, MPoolSet, MSnapOp, MOSDSetFlag,
+                   MSetFullRatio,
                    MGetHealth, MHealthMute, MLog, MCrashReport,
                    MCrashQuery)
 
@@ -895,7 +959,7 @@ class Monitor:
     # operator action
     AUDIT_TYPES = (MCreatePool, MDeletePool, MMarkDown, MConfigSet,
                    MSetUpmap, MPoolSet, MSnapOp, MOSDSetFlag,
-                   MHealthMute, MCrashQuery)
+                   MSetFullRatio, MHealthMute, MCrashQuery)
 
     @staticmethod
     def _conn_is_daemon(conn) -> bool:
@@ -1061,22 +1125,109 @@ class Monitor:
                     "checks": dict(health), "stamp": time.monotonic()}
             else:
                 self._health_reports.pop(msg.osd_id, None)
+        # store utilization rides the ping too (v4 field): the fullness
+        # plane's input.  A state TRANSITION (nearfull/backfillfull/full
+        # crossed, or cleared past the hysteresis margin) mutates the
+        # map; mere utilization drift does not.
+        statfs = getattr(msg, "statfs", None)
+        if statfs:
+            self._osd_statfs[msg.osd_id] = dict(statfs)
+        changed = self._derive_fullness()
         info = self.osdmap.osds.get(msg.osd_id)
-        if info is not None and not info.up:
+        rejoined = info is not None and not info.up
+        if rejoined:
             info.up = True
             info.in_cluster = True
+            changed = True
+        if changed:
             self.osdmap.epoch += 1
             try:
                 await self._commit_state()
             except NoQuorum:
                 return
             # push the new map straight to the rejoining OSD
-            if msg.addr and msg.addr[0]:
+            if rejoined and msg.addr and msg.addr[0]:
                 try:
                     await self.messenger.send(tuple(msg.addr),
                                               MMapReply(osdmap=self.osdmap))
                 except (ConnectionError, OSError):
                     pass
+
+    def _derive_fullness(self) -> bool:
+        """Derive per-OSD NEARFULL/BACKFILLFULL/FULL states from the
+        latest statfs reports vs the map's settable ratios (reference
+        OSDMonitor::update_osd_stat + the full/backfillfull/nearfull
+        sets).  Promotion is immediate; demotion requires utilization to
+        drop mon_osd_full_hysteresis BELOW the state's threshold, so a
+        ratio oscillating on the line cannot flap the map every ping.
+        Returns True when the state map changed (caller bumps the epoch
+        and commits)."""
+        m = self.osdmap
+        nf, bf, fl = m.fullness_ratios()
+        thr = {"nearfull": nf, "backfillfull": bf, "full": fl}
+        hyst = float(self.conf.get("mon_osd_full_hysteresis", 0.01) or 0.0)
+        cur = dict(getattr(m, "full_osds", None) or {})
+        new: Dict[int, str] = {}
+        for osd_id, st in self._osd_statfs.items():
+            if osd_id not in m.osds:
+                continue
+            total = int(st.get("total", 0) or 0)
+            if total <= 0:
+                continue  # no configured capacity: never full
+            ratio = int(st.get("used", 0) or 0) / total
+            state = m.state_for_ratio(ratio)  # the ONE ladder cascade
+            prev = cur.get(osd_id, "")
+            if prev and FULL_SEVERITY[state] < FULL_SEVERITY[prev] \
+                    and ratio >= thr[prev] - hyst:
+                state = prev  # sticky until clearly below the threshold
+            if state:
+                new[osd_id] = state
+        # an OSD with a state but no report THIS leadership (leader
+        # change lost the runtime statfs; down OSD stopped pinging)
+        # keeps its last-known state — auto-clear must come from an
+        # actual below-threshold report, never from missing data
+        for osd_id, prev in cur.items():
+            if osd_id in m.osds and osd_id not in new \
+                    and osd_id not in self._osd_statfs:
+                new[osd_id] = prev
+        if new == cur:
+            return False
+        m.full_osds = new
+        for osd_id in sorted(set(new) | set(cur)):
+            a, b = cur.get(osd_id, ""), new.get(osd_id, "")
+            if a == b:
+                continue
+            if b:
+                self.logm.log(
+                    "cluster",
+                    CLOG_ERROR if b == "full" else CLOG_WARN,
+                    f"osd.{osd_id} is {b}")
+            else:
+                self.logm.log("cluster", CLOG_INFO,
+                              f"osd.{osd_id} fullness cleared (was {a})")
+        return True
+
+    def _osd_utilization(self) -> Dict[int, Dict]:
+        """Per-OSD utilization + fullness view served inside the health
+        document (`ceph osd df` renders it; the mgr exports it to
+        /metrics) — one MGetHealth instead of N per-OSD statfs ops."""
+        m = self.osdmap
+        out: Dict[int, Dict] = {}
+        for osd_id, info in sorted(m.osds.items()):
+            st = self._osd_statfs.get(osd_id) or {}
+            total = int(st.get("total", 0) or 0)
+            used = int(st.get("used", 0) or 0)
+            out[osd_id] = {
+                "up": bool(info.up),
+                "weight": info.weight,
+                "total": total,
+                "used": used,
+                "avail": int(st.get("avail", 0) or 0),
+                "num_objects": int(st.get("num_objects", 0) or 0),
+                "ratio": round(used / total, 4) if total else 0.0,
+                "state": m.full_state(osd_id),
+            }
+        return out
 
     # -- writes (leader only) ------------------------------------------------
 
@@ -1299,6 +1450,48 @@ class Monitor:
                 self.osdmap.epoch += 1
                 await self._commit_state()
             return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+        if isinstance(msg, MSetFullRatio):
+            # `ceph osd set-nearfull-ratio / set-backfillfull-ratio /
+            # set-full-ratio` (OSDMonitor "osd set-*full-ratio"): the
+            # ORDERING is validated against the candidate ladder —
+            # 0 < nearfull <= backfillfull <= full < failsafe — so one
+            # typo cannot invert enforcement cluster-wide
+            if msg.which not in ("nearfull", "backfillfull", "full"):
+                return MConfigReply(
+                    tid=msg.tid, ok=False,
+                    error=f"EINVAL: unknown ratio {msg.which!r} (want "
+                          f"nearfull|backfillfull|full)")
+            try:
+                ratio = float(msg.ratio)
+            except (TypeError, ValueError):
+                return MConfigReply(tid=msg.tid, ok=False,
+                                    error=f"EINVAL: bad ratio "
+                                          f"{msg.ratio!r}")
+            nf, bf, fl = self.osdmap.fullness_ratios()
+            cand = {"nearfull": nf, "backfillfull": bf, "full": fl,
+                    msg.which: ratio}
+            failsafe = float(self.conf.get("osd_failsafe_full_ratio",
+                                           0.97) or 0.97)
+            if not (0.0 < cand["nearfull"] <= cand["backfillfull"]
+                    <= cand["full"] < failsafe):
+                return MConfigReply(
+                    tid=msg.tid, ok=False,
+                    error=f"EINVAL: ratio ordering violated: need "
+                          f"0 < nearfull <= backfillfull <= full < "
+                          f"failsafe ({failsafe:g}), got "
+                          f"nearfull={cand['nearfull']:g} "
+                          f"backfillfull={cand['backfillfull']:g} "
+                          f"full={cand['full']:g}")
+            self.osdmap.nearfull_ratio = cand["nearfull"]
+            self.osdmap.backfillfull_ratio = cand["backfillfull"]
+            self.osdmap.full_ratio = cand["full"]
+            # states may move under the new thresholds right away
+            self._derive_fullness()
+            self.osdmap.epoch += 1
+            await self._commit_state()
+            return MConfigReply(
+                tid=msg.tid, ok=True,
+                values={f"{msg.which}_ratio": f"{ratio:g}"})
         if isinstance(msg, MSetUpmap):
             # balancer-installed persistent override (pg-upmap role)
             key = (msg.pool_id, msg.pg)
@@ -1544,7 +1737,7 @@ class Monitor:
                 "severity": "error", "summary": error}
             h["status"] = "HEALTH_ERR"
             return MHealthReply(tid=tid, health=h)
-        if isinstance(msg, MConfigSet):
+        if isinstance(msg, (MConfigSet, MSetFullRatio)):
             return MConfigReply(tid=tid, ok=False, error=error)
         if isinstance(msg, MLog):
             # last_seq 0 acks nothing: the LogClient resends next flush

@@ -36,6 +36,7 @@ import asyncio
 import contextlib
 import errno
 import json
+import os
 import pickle
 import random
 import threading
@@ -87,8 +88,8 @@ from ceph_tpu.rados.scheduler import (
     CLASS_RECOVERY,
     ShardedOpQueue,
 )
-from ceph_tpu.rados.store import (MemStore, ObjectStore, ShardMeta,
-                                  Transaction, shard_crc,
+from ceph_tpu.rados.store import (ENOSPCError, MemStore, ObjectStore,
+                                  ShardMeta, Transaction, shard_crc,
                                   Owned as StoreOwned)
 from ceph_tpu.rados.tiering import (HitSetArchive, PromoteThrottle,
                                     build_tier_perf, eviction_candidates)
@@ -130,6 +131,9 @@ from ceph_tpu.rados.types import (
     MPGLogReply,
     MPGLogReq,
     MPing,
+    FULL_SEVERITY,
+    is_delete_only_multi,
+    is_read_only_multi,
     MPushShard,
     MNotifyAck,
     MScrubShard,
@@ -321,6 +325,12 @@ class OSD:
                              "overflow (replica stale until scrub)")
             .add_u64_counter("op_unexpected_error",
                              "ops failed by an unclassified exception")
+            .add_u64_counter("full_rejects",
+                             "writes refused typed ENOSPC (FULL acting "
+                             "member or local failsafe)")
+            .add_u64_counter("backfill_toofull_refusals",
+                             "backfill reservations refused because this "
+                             "OSD is past its backfillfull ratio")
             .add_u64("ec_batch_ops",
                      "requests submitted to the shared queue (gauge)")
             .add_u64("ec_batch_dispatches",
@@ -746,7 +756,147 @@ class OSD:
                     "resident_bytes": resident,
                     "target_bytes": target,
                 }
+        toofull = sorted(
+            f"{k[0]}.{k[1]:x}" for k, m in self._pg_machines.items()
+            if getattr(m, "backfill_toofull", False))
+        if toofull:
+            # the `backfill_toofull` PG state (reference PG_BACKFILL_FULL
+            # health check): reservation refused by a BACKFILLFULL
+            # target; the PG parks and retries until space frees
+            checks["PG_BACKFILL_FULL"] = {
+                "severity": "warning",
+                "summary": f"{len(toofull)} pg(s) backfill_toofull "
+                           f"(reservation refused by a backfillfull "
+                           f"target)",
+                "count": len(toofull),
+                "pgs": toofull,
+                "detail": [f"pg {p} backfill parked: target past its "
+                           f"backfillfull ratio; retrying" for p in
+                           toofull],
+            }
         return checks
+
+    # -- capacity / fullness plane -------------------------------------------
+
+    def _inject_full_ratio(self) -> Optional[float]:
+        """Dev knob: force this OSD's REPORTED utilization so CI can
+        drive the whole fullness ladder without writing gigabytes.
+        Sources (first match wins): conf ``osd_debug_inject_full``, the
+        daemon Context's config layer (asok / `ceph tell ... config
+        set` mutate THAT one live — a dict-conf'd vstart daemon keeps a
+        separate Config there), then the ``CEPH_TPU_INJECT_FULL`` env.
+        Value: ``RATIO`` (applies to this OSD) or
+        ``ID:RATIO[,ID:RATIO...]`` (in-process clusters share one
+        conf/env, so the ladder needs per-OSD aim)."""
+        ctx_conf = getattr(self.ctx, "conf", None)
+        for raw in (self.conf.get("osd_debug_inject_full", ""),
+                    ctx_conf.get("osd_debug_inject_full", "")
+                    if ctx_conf is not None
+                    and ctx_conf is not self.conf else "",
+                    os.environ.get("CEPH_TPU_INJECT_FULL", "")):
+            if not raw:
+                continue
+            for part in str(raw).split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                sid, sep, r = part.partition(":")
+                try:
+                    if not sep:
+                        return float(part)
+                    if int(sid) == self.osd_id:
+                        return float(r)
+                except (TypeError, ValueError):
+                    continue
+        return None
+
+    def _statfs(self) -> Dict[str, int]:
+        """Effective store utilization: every store implements the
+        uniform statfs shape now (total == 0 = no configured capacity),
+        with the fullness-injection knob applied on top."""
+        st = dict(self.store.statfs())
+        missing = {"total", "used", "avail", "num_objects"} - set(st)
+        assert not missing, \
+            f"{type(self.store).__name__}.statfs() missing {missing}"
+        inj = self._inject_full_ratio()
+        if inj is not None and inj >= 0:
+            total = int(st.get("total") or 0) or (1 << 30)
+            st["total"] = total
+            st["used"] = int(total * inj)
+            st["avail"] = max(0, total - st["used"])
+            st["injected"] = True
+        return st
+
+    def _failsafe_full(self, extra_bytes: int = 0) -> bool:
+        """Would accepting ``extra_bytes`` more cross the failsafe
+        ceiling (osd_failsafe_full_ratio of capacity)?  The last-resort
+        guard protecting the store itself; injection-aware so CI can
+        exercise it."""
+        # hot path (every shard write): the common no-ceiling,
+        # no-injection case must not pay a statfs sweep
+        if not int(getattr(self.store, "capacity_bytes", 0) or 0) \
+                and self._inject_full_ratio() is None:
+            return False
+        st = self._statfs()
+        total = int(st.get("total") or 0)
+        if total <= 0:
+            return False
+        ratio = float(self.conf.get("osd_failsafe_full_ratio", 0.97)
+                      or 0.97)
+        return int(st.get("used") or 0) + extra_bytes > int(total * ratio)
+
+    def _my_full_state(self) -> str:
+        """This OSD's fullness state: the mon-derived map state, or the
+        LOCAL effective ratio vs the map thresholds when that is more
+        severe (the local view leads the mon by up to a ping)."""
+        if self.osdmap is None:
+            return ""
+        state = self.osdmap.full_state(self.osd_id)
+        st = self._statfs()
+        total = int(st.get("total") or 0)
+        if total > 0:
+            local = self.osdmap.state_for_ratio(
+                int(st.get("used") or 0) / total)
+            if FULL_SEVERITY[local] > FULL_SEVERITY[state]:
+                state = local
+        return state
+
+    def _full_block_reply(self, op: MOSDOp) -> Optional[MOSDOpReply]:
+        """Typed-ENOSPC write gate (reference PrimaryLogPG check_full +
+        the osdmap full handling): a mutation targeting a PG whose
+        acting set contains a FULL OSD — or arriving at a failsafe-full
+        primary — fails FAST with ENOSPC (definitive at the client; no
+        eternal resend loop).  Reads are untouched.  DELETES are
+        explicitly exempt (op delete, snap-trim, and delete-only
+        multis): deleting is the only way out of full, so the delete
+        path threads through every gate."""
+        if self.osdmap is None or op.op not in ("write", "multi", "call"):
+            return None
+        if op.op == "multi" and (is_delete_only_multi(op)
+                                 or is_read_only_multi(op)):
+            # delete-only compounds drain; read-only compounds observe —
+            # neither adds bytes, neither is gated
+            return None
+        pool = self.osdmap.pools.get(op.pool_id)
+        if pool is None or not op.oid:
+            return None
+        pg = self.osdmap.object_to_pg(pool, op.oid)
+        acting = self.osdmap.pg_to_acting(pool, pg)
+        full = [a for a in acting if a != CRUSH_ITEM_NONE
+                and self.osdmap.full_state(a) == "full"]
+        if full:
+            self.perf.inc("full_rejects")
+            return MOSDOpReply(
+                ok=False, code=-errno.ENOSPC,
+                error=f"ENOSPC: pg {op.pool_id}.{pg:x} acting set has "
+                      f"full osd(s) {full}; delete data or raise the "
+                      f"full ratio")
+        if self._failsafe_full(len(op.data) if op.data else 0):
+            self.perf.inc("full_rejects")
+            return MOSDOpReply(
+                ok=False, code=-errno.ENOSPC,
+                error=f"ENOSPC: osd.{self.osd_id} past failsafe ratio")
+        return None
 
     async def _ping_loop(self, interval: float) -> None:
         ticks = 0
@@ -763,7 +913,10 @@ class OSD:
                     MPing(osd_id=self.osd_id,
                           epoch=self.osdmap.epoch if self.osdmap else 0,
                           addr=self.addr or ("", 0),
-                          health=self._health_checks()),
+                          health=self._health_checks(),
+                          # statfs piggybacks the liveness ping (v4):
+                          # the mon's fullness derivation runs on it
+                          statfs=self._statfs()),
                 )
             except TRANSPORT_ERRORS:
                 self.mons.rotate()  # that mon looks dead
@@ -1468,6 +1621,16 @@ class OSD:
                 delay = self.conf.get("osd_recovery_retry", 1.0)
                 continue  # interval advanced: re-peer immediately
             if m.reserve_blocked:
+                if getattr(m, "backfill_toofull", False):
+                    # a BACKFILLFULL target refused: space frees on the
+                    # delete/agent cadence, not the slot cadence — park
+                    # longer (liveness: the retry keeps running until
+                    # the target drops below its ratio)
+                    retry = float(self.conf.get(
+                        "osd_backfill_toofull_retry", 1.0) or 1.0)
+                    await asyncio.sleep(retry * (0.75 + 0.5
+                                                 * random.random()))
+                    continue
                 # a reservation was refused, not a verification failure:
                 # slots free in O(one backfill) — retry quickly, with
                 # jitter so colliding primaries don't re-collide forever
@@ -1723,14 +1886,25 @@ class OSD:
             # partial-grant livelock here would leave objects one failure
             # from loss while primaries politely retry)
             if not degraded:
+                toofull = False
                 for osd in targets:
-                    if await self._remote_reserve(pool.pool_id, pg, osd):
+                    ok, reason = await self._remote_reserve(
+                        pool.pool_id, pg, osd)
+                    if ok:
                         granted.append(osd)
+                    elif reason == "toofull":
+                        toofull = True
                 if len(granted) < len(targets):
-                    # partial grant: back off rather than hog slots
+                    # partial grant: back off rather than hog slots.
+                    # A toofull refusal parks the PG as
+                    # backfill_toofull (surfaced in health detail);
+                    # the retry loop re-requests with backoff and the
+                    # reservation succeeds once the target frees space.
                     m.transition(ACTIVE)
                     m.reserve_blocked = True
+                    m.backfill_toofull = toofull
                     return False, 0, False
+            m.backfill_toofull = False
             m.transition(BACKFILLING)
             # renew remote leases while the sweep runs: grant times refresh
             # on re-request, so only holders that actually died (and can't
@@ -1760,7 +1934,11 @@ class OSD:
             for osd in granted:
                 await self._remote_release(pool.pool_id, pg, osd)
 
-    async def _remote_reserve(self, pool_id: int, pg: int, osd: int) -> bool:
+    async def _remote_reserve(self, pool_id: int, pg: int,
+                              osd: int) -> Tuple[bool, str]:
+        """Request one backfill slot on ``osd``: (granted, refusal
+        reason).  reason == "toofull" marks a BACKFILLFULL target (the
+        caller parks the PG rather than hammering the slot queue)."""
         tid = uuid.uuid4().hex
         q = self._collector(tid)
         try:
@@ -1771,10 +1949,10 @@ class OSD:
                                  reply_to=self.addr))
         except TRANSPORT_ERRORS:
             self._collectors.pop(tid, None)
-            return False
+            return False, ""
         for r in await self._gather(tid, q, 1, timeout=0.8):
-            return bool(r.ok)
-        return False
+            return bool(r.ok), str(getattr(r, "reason", "") or "")
+        return False, ""
 
     async def _remote_release(self, pool_id: int, pg: int, osd: int) -> None:
         try:
@@ -1810,6 +1988,28 @@ class OSD:
         key = (msg.pool_id, msg.pg)
         if msg.op == "release":
             self._remote_reserver.release(key)
+            return
+        if FULL_SEVERITY[self._my_full_state()] >= \
+                FULL_SEVERITY["backfillfull"]:
+            # BACKFILLFULL (or worse): refuse the reservation — backfill
+            # onto an OSD that cannot hold the data would burn the wire
+            # and then fail at the failsafe (reference
+            # PeeringState::Active react RemoteBackfillReserved
+            # TOO_FULL).  The primary parks the PG backfill_toofull and
+            # retries with backoff; renewals for ALREADY-granted slots
+            # refuse too, so a sweep racing the threshold stops at the
+            # next lease renewal.
+            self.perf.inc("backfill_toofull_refusals")
+            self.ctx.dout("osd", 2,
+                          f"backfill reserve pg {msg.pool_id}.{msg.pg:x} "
+                          f"refused: {self._my_full_state()}")
+            try:
+                await self.messenger.send(
+                    tuple(msg.reply_to),
+                    MBackfillReserveReply(tid=msg.tid, osd_id=self.osd_id,
+                                          ok=False, reason="toofull"))
+            except TRANSPORT_ERRORS:
+                pass
             return
         was_held = key in self._remote_reserver.held
         if not was_held and len(self._remote_reserver.held) >= \
@@ -2058,6 +2258,13 @@ class OSD:
         dropped."""
         if self.osdmap is None or op.op not in self._BACKOFF_OPS:
             return False
+        if op.op == "delete" or (op.op == "multi"
+                                 and is_delete_only_multi(op)):
+            # deletes thread through every gate (pausewr, the full
+            # check, AND this shed): under capacity pressure they are
+            # the only way out, and a saturated-because-full OSD
+            # shedding its deletes would deadlock the drain
+            return False
         qmax = int(self.conf.get("osd_backoff_queue_depth", 0) or 0)
         if not qmax or self.op_queue.inflight_ops <= qmax:
             return False
@@ -2217,7 +2424,13 @@ class OSD:
             if await self._maybe_backoff(conn, op):
                 tracked.mark_event("backoff")
                 return  # dropped: the client parks and resends on release
-            if op.op == "write":
+            full_reply = self._full_block_reply(op)
+            if full_reply is not None:
+                # fullness gate: typed ENOSPC, definitive at the client
+                # (reads and deletes never land here)
+                tracked.mark_event("full_reject")
+                reply = full_reply
+            elif op.op == "write":
                 reply = await self._do_write(op)
             elif op.op == "read":
                 reply = await self._snap_routed(op, self._do_read)
@@ -2281,15 +2494,11 @@ class OSD:
                     reply = MOSDOpReply(ok=True, data=pickle.dumps(summary))
             elif op.op == "statfs":
                 # per-OSD store utilization (reference
-                # ObjectStore::statfs feeding `ceph osd df`); stores
-                # without the hook (memstore) report object counts only
-                fn = getattr(self.store, "statfs", None)
-                if fn is not None:
-                    stats = dict(fn())
-                else:
-                    n = sum(1 for p in self.store.list_pools()
-                            for _ in self.store.list_objects(p))
-                    stats = {"num_objects": n}
+                # ObjectStore::statfs feeding `ceph osd df`): every
+                # store implements the uniform {total, used, avail,
+                # num_objects} shape now (total == 0 = no configured
+                # capacity); _statfs asserts it and applies injection
+                stats = self._statfs()
                 stats["store"] = type(self.store).__name__
                 reply = MOSDOpReply(ok=True,
                                     data=json.dumps(stats).encode())
@@ -2301,6 +2510,14 @@ class OSD:
             # profile violation): deterministic, so definitive
             reply = MOSDOpReply(ok=False, code=-errno.EBADMSG,
                                 error=f"ec error: {e}")
+        except ENOSPCError as e:
+            # the failsafe (OSD-level or the store's own last-resort
+            # guard) refused BEFORE mutating anything: typed and
+            # definitive — resending into a full store cannot succeed,
+            # deleting is the cure
+            self.perf.inc("full_rejects")
+            reply = MOSDOpReply(ok=False, code=-errno.ENOSPC,
+                                error=f"ENOSPC: {e.strerror}")
         except Exception as e:
             # unexpected: conservatively retryable (transient state races
             # dominate here; a true logic bug surfaces in the counter)
@@ -4042,6 +4259,14 @@ class OSD:
         shard_size: int = 0, hinfo: bytes = b"", prior_version: int = 0,
         chunk_crc: Optional[int] = None,
     ) -> bool:
+        # failsafe FIRST — before the rollback-slot read, the in-memory
+        # PG-log append, and the store transaction: a refused write must
+        # leave both the store AND the in-memory log byte-identical
+        # (injection-aware, so CI exercises this without filling disks)
+        if self._failsafe_full(len(chunk)):
+            raise ENOSPCError(
+                f"osd.{self.osd_id} failsafe full: refusing "
+                f"{len(chunk)}-byte shard write")
         txn = Transaction()
         # retain the outgoing version in the rollback slot (same txn):
         # reads fall back to it when a newer write never completed
@@ -4199,17 +4424,31 @@ class OSD:
                 if entry is not None:
                     entry.version = tuple(entry.version)
                     entry.prior_version = tuple(entry.prior_version)
-                ok = self._apply_shard_write(
-                    msg.pool_id, msg.oid, msg.shard, msg.chunk, msg.version,
-                    msg.object_size, pg=msg.pg, entry=entry,
-                    chunk_off=msg.chunk_off, shard_size=msg.shard_size,
-                    hinfo=msg.hinfo, prior_version=msg.prior_version,
-                    # just verified against the frame: reuse, don't re-crc
-                    chunk_crc=msg.chunk_crc or None,
-                )
+                enospc = False
+                try:
+                    ok = self._apply_shard_write(
+                        msg.pool_id, msg.oid, msg.shard, msg.chunk,
+                        msg.version,
+                        msg.object_size, pg=msg.pg, entry=entry,
+                        chunk_off=msg.chunk_off,
+                        shard_size=msg.shard_size,
+                        hinfo=msg.hinfo, prior_version=msg.prior_version,
+                        # just verified against the frame: reuse, don't
+                        # re-crc
+                        chunk_crc=msg.chunk_crc or None,
+                    )
+                except ENOSPCError:
+                    # this shard's store is failsafe-full: refuse (one
+                    # missing ack at the primary), never mutate
+                    ok = False
+                    enospc = True
                 # another primary wrote this object: cached decode is stale
                 self._cache_drop(msg.pool_id, msg.oid)
-                tracked.mark_event("applied" if ok else "refused_splice")
+                # ONE event per outcome: an ENOSPC refusal must not also
+                # count as a splice/crc refusal in the op timeline
+                tracked.mark_event("applied" if ok
+                                   else "refused_enospc" if enospc
+                                   else "refused_splice")
                 if ok:
                     self.perf.inc("subop_w")
         finally:
@@ -4431,10 +4670,17 @@ class OSD:
                 return
             self.perf.inc("recovery_push")
             self._cache_drop(msg.pool_id, msg.oid)
-            self._apply_shard_write(
-                msg.pool_id, msg.oid, msg.shard, msg.chunk, msg.version,
-                msg.object_size, hinfo=msg.hinfo,
-            )
+            try:
+                self._apply_shard_write(
+                    msg.pool_id, msg.oid, msg.shard, msg.chunk,
+                    msg.version, msg.object_size, hinfo=msg.hinfo,
+                )
+            except ENOSPCError:
+                # failsafe-full: even recovery stops at the last-resort
+                # line (the store must survive) — the primary's next
+                # sweep re-pushes once space frees
+                tracked.mark_event("refused_enospc")
+                return
             tracked.mark_event("applied")
             if msg.xattrs:
                 try:
@@ -4963,6 +5209,12 @@ class OSD:
         if target <= 0:
             return
         high = int(target * self._tier_full_ratio())
+        if self._my_full_state():
+            # NEARFULL (or worse) is eviction pressure on top of
+            # cache_target_full_ratio (the reference agent scales effort
+            # with fullness): halve the high-water mark so the tier
+            # sheds residency while the store drains
+            high = min(high, int(target * 0.5))
         if store.resident_bytes <= high:
             self.tier_perf.inc("agent_skip")
             return

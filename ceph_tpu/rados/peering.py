@@ -87,6 +87,11 @@ class PGMachine:
         # last backfill attempt was refused a reservation slot (the retry
         # loop polls quickly instead of backing off)
         self.reserve_blocked = False
+        # a BACKFILLFULL target refused the reservation (reference
+        # backfill_toofull PG state): surfaced in health detail; the
+        # retry loop parks on the slower toofull cadence until the
+        # target frees space
+        self.backfill_toofull = False
 
     def transition(self, state: str) -> None:
         if state not in _EDGES.get(self.state, set()) and state not in _ALWAYS:
@@ -110,6 +115,7 @@ class PGMachine:
             self.peer_info.clear()
             self.missing.clear()
             self.backfill_targets = []
+            self.backfill_toofull = False  # stale verdict: new interval
             self.transition(GET_INFO)
         return changed
 
@@ -129,6 +135,7 @@ class PGMachine:
                       for k, v in self.peer_info.items()},
             "missing_counts": {str(k): len(v) for k, v in self.missing.items()},
             "backfill_targets": self.backfill_targets,
+            "backfill_toofull": self.backfill_toofull,
             "history": [
                 {"at": ts, "state": s, "epoch": e} for ts, s, e in self.history
             ],

@@ -10,6 +10,7 @@ tests survive process death.
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import zlib
@@ -17,6 +18,17 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
 
 Key = Tuple[int, str, int]  # (pool_id, oid, shard)
+
+
+class ENOSPCError(OSError):
+    """Typed out-of-space failure (reference -ENOSPC from
+    BlueStore::_do_alloc_write past osd_failsafe_full_ratio): raised by a
+    store BEFORE it mutates anything, so a refused transaction leaves the
+    store byte-identical.  The OSD turns this into a typed ENOSPC reply
+    the client treats as definitive (no resend loop)."""
+
+    def __init__(self, message: str):
+        super().__init__(errno.ENOSPC, message)
 
 
 class Owned:
@@ -72,8 +84,41 @@ class Transaction:
 
 
 class ObjectStore:
+    # byte ceiling (0 = unlimited) + the last-resort guard protecting the
+    # store itself (reference osd_failsafe_full_ratio): a transaction
+    # whose writes would push used bytes past failsafe_ratio * capacity
+    # is refused with a typed ENOSPCError BEFORE anything mutates.
+    # Deletes always pass — they are the only way back out of full.
+    capacity_bytes: int = 0
+    failsafe_ratio: float = 0.97
+
     def queue_transaction(self, txn: Transaction, on_commit=None) -> None:
         raise NotImplementedError
+
+    def statfs(self) -> Dict[str, int]:
+        """Uniform utilization shape every store reports (reference
+        ObjectStore::statfs): {total, used, avail, num_objects}.
+        total == 0 means no configured capacity (unlimited)."""
+        n = sum(1 for p in self.list_pools()
+                for _ in self.list_objects(p))
+        return {"total": int(self.capacity_bytes), "used": 0,
+                "avail": int(self.capacity_bytes), "num_objects": n}
+
+    def _check_failsafe(self, incoming_bytes: int, used_bytes: int) -> None:
+        """Refuse (typed ENOSPC) when accepting ``incoming_bytes`` more
+        would cross the failsafe ceiling.  Conservative: freed bytes from
+        same-transaction deletes/overwrites are not credited — near the
+        failsafe line the store errs on refusal (delete-only transactions
+        carry no writes and always pass)."""
+        cap = int(self.capacity_bytes or 0)
+        if cap <= 0 or incoming_bytes <= 0:
+            return
+        ceiling = int(cap * float(self.failsafe_ratio))
+        if used_bytes + incoming_bytes > ceiling:
+            raise ENOSPCError(
+                f"failsafe full: used {used_bytes} + incoming "
+                f"{incoming_bytes} > {ceiling} "
+                f"({self.failsafe_ratio:g} of {cap})")
 
     def read(self, key: Key) -> Optional[Tuple[bytes, ShardMeta]]:
         raise NotImplementedError
@@ -110,14 +155,27 @@ class ObjectStore:
 
 
 class MemStore(ObjectStore):
-    def __init__(self) -> None:
+    def __init__(self, capacity_bytes: int = 0,
+                 failsafe_ratio: float = 0.97) -> None:
+        self.capacity_bytes = int(capacity_bytes or 0)
+        self.failsafe_ratio = float(failsafe_ratio or 0.97)
         self._data: Dict[Key, Tuple[bytes, ShardMeta]] = {}
         self._omap: Dict[Key, Dict[str, bytes]] = {}
         self._xattrs: Dict[Key, Dict[str, bytes]] = {}
+        self._used_bytes = 0  # data bytes held (incremental, O(1) statfs)
 
     def queue_transaction(self, txn: Transaction, on_commit=None) -> None:
+        # failsafe BEFORE any mutation: a refused transaction must leave
+        # the store byte-identical (the test pins this).  Guarded like
+        # the disk stores: the unlimited config skips even the cheap sum.
+        if self.capacity_bytes:
+            self._check_failsafe(
+                sum(len(unwrap(c)) for _k, c, _m in txn.writes),
+                self._used_bytes)
         for key in txn.deletes:
-            self._data.pop(key, None)
+            old = self._data.pop(key, None)
+            if old is not None:
+                self._used_bytes -= len(old[0])
             self._omap.pop(key, None)
         for key, chunk, meta in txn.writes:
             if isinstance(chunk, Owned):
@@ -130,6 +188,10 @@ class MemStore(ObjectStore):
                 # the RAM store must copy too or later buffer reuse
                 # would corrupt "persisted" data
                 chunk = bytes(chunk)
+            prev = self._data.get(key)
+            if prev is not None:
+                self._used_bytes -= len(prev[0])
+            self._used_bytes += len(chunk)
             self._data[key] = (chunk, meta)
         for key, entries in txn.omap_sets:
             self._omap.setdefault(key, {}).update(entries)
@@ -176,13 +238,23 @@ class MemStore(ObjectStore):
     def list_pools(self):
         return sorted({pid for (pid, _o, _s) in self._data})
 
+    def statfs(self) -> Dict[str, int]:
+        total = int(self.capacity_bytes or 0)
+        used = self._used_bytes
+        return {"total": total, "used": used,
+                "avail": max(0, total - used) if total else 0,
+                "num_objects": len(self._data)}
+
 
 class DirStore(ObjectStore):
     """File-per-shard store with a sidecar json for metadata; writes are
     tmp+rename atomic."""
 
-    def __init__(self, path: str) -> None:
+    def __init__(self, path: str, capacity_bytes: int = 0,
+                 failsafe_ratio: float = 0.97) -> None:
         self.path = path
+        self.capacity_bytes = int(capacity_bytes or 0)
+        self.failsafe_ratio = float(failsafe_ratio or 0.97)
         os.makedirs(path, exist_ok=True)
 
     def _file(self, key: Key) -> str:
@@ -192,6 +264,12 @@ class DirStore(ObjectStore):
         return os.path.join(self.path, f"{pid}__{oid.encode().hex()}__{shard}")
 
     def queue_transaction(self, txn: Transaction, on_commit=None) -> None:
+        if self.capacity_bytes:
+            # _used_bytes is a directory sweep: only pay it when a
+            # ceiling is actually configured
+            self._check_failsafe(
+                sum(len(unwrap(c)) for _k, c, _m in txn.writes),
+                self._used_bytes())
         for key in txn.deletes:
             for suffix in ("", ".meta"):
                 try:
@@ -244,6 +322,26 @@ class DirStore(ObjectStore):
             if sep and pid.isdigit():
                 pools.add(int(pid))
         return sorted(pools)
+
+    def _used_bytes(self) -> int:
+        used = n = 0
+        for name in os.listdir(self.path):
+            if name.endswith((".meta", ".tmp")):
+                continue
+            try:
+                used += os.stat(os.path.join(self.path, name)).st_size
+                n += 1
+            except OSError:
+                pass
+        self._last_count = n
+        return used
+
+    def statfs(self) -> Dict[str, int]:
+        total = int(self.capacity_bytes or 0)
+        used = self._used_bytes()
+        return {"total": total, "used": used,
+                "avail": max(0, total - used) if total else 0,
+                "num_objects": getattr(self, "_last_count", 0)}
 
 
 def shard_crc(chunk: bytes) -> int:

@@ -40,6 +40,44 @@ def is_snap_clone(oid: str) -> bool:
     return SNAP_SEP in oid
 
 
+# fullness-state severity order (shared by the mon's derivation, the
+# OSD's local lead, and the hysteresis demotion rule)
+FULL_SEVERITY = {"": 0, "nearfull": 1, "backfillfull": 2, "full": 3}
+
+
+def is_delete_only_multi(op: "MOSDOp") -> bool:
+    """Is this compound op purely space-freeing (remove / rm-class
+    sub-ops)?  Such multis ride the delete exemption through every
+    fullness gate — client pause flags, the OSD's QoS shed, and the
+    full check itself."""
+    ops = getattr(op, "ops", None) or []
+    return bool(ops) and all(
+        name == "remove" or name.startswith("rm")
+        or name.startswith("omap_rm")
+        for name, _kw in ops)
+
+
+# read-class multi sub-ops (asserts included: they observe state, they
+# never add bytes) — a compound of ONLY these is a read for the
+# fullness gate ("reads are untouched"); plain `call` stays gated like
+# the reference's CEPH_OSD_OP_CALL WR classification (a class method's
+# writes are invisible from the outside)
+_READ_MULTI_OPS = frozenset({
+    "read", "stat", "getxattr", "getxattrs",
+    "assert_exists", "assert_version", "cmpxattr",
+})
+
+
+def is_read_only_multi(op: "MOSDOp") -> bool:
+    """Is this compound op purely observational (read/stat/getxattr/
+    assert sub-ops)?  Such multis must pass the fullness write gate —
+    reads are untouched by full."""
+    ops = getattr(op, "ops", None) or []
+    return bool(ops) and all(
+        name in _READ_MULTI_OPS or name.startswith("omap_get")
+        for name, _kw in ops)
+
+
 # -- rados namespaces ---------------------------------------------------------
 
 # object identity is (nspace, name) (reference object_locator_t nspace,
@@ -218,6 +256,15 @@ class OSDMap:
     # Read with getattr(map, "flags", []) — maps pickled before this
     # field existed have no attribute.
     flags: List[str] = field(default_factory=list)
+    # per-OSD fullness states derived by the mon from ping-piggybacked
+    # statfs (reference OSDMap full/backfillfull/nearfull sets +
+    # mon_osd_*_ratio in the map): osd_id -> "nearfull" | "backfillfull"
+    # | "full".  Read via full_state()/fullness_ratios() — maps pickled
+    # before these fields have no attributes.
+    full_osds: Dict[int, str] = field(default_factory=dict)
+    nearfull_ratio: float = 0.85
+    backfillfull_ratio: float = 0.90
+    full_ratio: float = 0.95
     pg_temp: Dict[Tuple[int, int], List[int]] = field(default_factory=dict)
     # persistent placement overrides installed by the balancer (reference
     # pg_upmap_items): applied over the crush result, NOT auto-cleared by
@@ -230,6 +277,31 @@ class OSDMap:
             if p.name == name:
                 return p
         return None
+
+    def full_state(self, osd_id: int) -> str:
+        """This OSD's mon-derived fullness state: "" | "nearfull" |
+        "backfillfull" | "full" (getattr-safe for pre-fullness pickles)."""
+        return (getattr(self, "full_osds", None) or {}).get(osd_id, "")
+
+    def fullness_ratios(self) -> Tuple[float, float, float]:
+        """(nearfull, backfillfull, full) thresholds, getattr-safe."""
+        return (float(getattr(self, "nearfull_ratio", 0.85)),
+                float(getattr(self, "backfillfull_ratio", 0.90)),
+                float(getattr(self, "full_ratio", 0.95)))
+
+    def state_for_ratio(self, ratio: float) -> str:
+        """The fullness state a utilization ratio lands in under THIS
+        map's thresholds — the ONE copy of the ladder cascade (the mon's
+        derivation and the OSD's local lead both call it, so they can
+        never disagree about where the lines are)."""
+        nf, bf, fl = self.fullness_ratios()
+        if ratio >= fl:
+            return "full"
+        if ratio >= bf:
+            return "backfillfull"
+        if ratio >= nf:
+            return "nearfull"
+        return ""
 
     def object_to_pg(self, pool: PoolInfo, oid: str) -> int:
         # snapshot clones hash by their HEAD name so every clone lives in
@@ -325,6 +397,13 @@ class OSDMap:
         new_flags = getattr(inc, "new_flags", None)
         if new_flags is not None:
             self.flags = list(new_flags)
+        new_full = getattr(inc, "new_full_osds", None)
+        if new_full is not None:
+            self.full_osds = dict(new_full)
+        new_ratios = getattr(inc, "new_full_ratios", None)
+        if new_ratios is not None:
+            (self.nearfull_ratio, self.backfillfull_ratio,
+             self.full_ratio) = new_ratios
         self.epoch = inc.epoch
         return True
 
@@ -347,6 +426,9 @@ class OSDMapIncremental:
     crush: Optional[CrushMap] = None
     # None = flags unchanged; a list (possibly empty) replaces them
     new_flags: Optional[List[str]] = None
+    # None = unchanged; a dict (possibly empty) / tuple replaces them
+    new_full_osds: Optional[Dict[int, str]] = None
+    new_full_ratios: Optional[Tuple[float, float, float]] = None
 
     @classmethod
     def diff(cls, old: "OSDMap", new: "OSDMap") -> "OSDMapIncremental":
@@ -381,6 +463,11 @@ class OSDMapIncremental:
         if list(getattr(old, "flags", []) or []) \
                 != list(getattr(new, "flags", []) or []):
             inc.new_flags = list(getattr(new, "flags", []) or [])
+        if dict(getattr(old, "full_osds", None) or {}) \
+                != dict(getattr(new, "full_osds", None) or {}):
+            inc.new_full_osds = dict(getattr(new, "full_osds", None) or {})
+        if old.fullness_ratios() != new.fullness_ratios():
+            inc.new_full_ratios = new.fullness_ratios()
         for osd_id, aff in new.primary_affinity.items():
             if old.primary_affinity.get(osd_id) != aff:
                 inc.new_primary_affinity[osd_id] = aff
@@ -453,7 +540,7 @@ class MDeletePool:
     confirm_name: str = ""  # must equal the pool's name
 
 
-@message(7, version=3)
+@message(7, version=4)
 class MPing:
     osd_id: int = 0
     epoch: int = 0
@@ -464,6 +551,12 @@ class MPing:
     # the mon drops a check the next ping omits it (raise/clear follows
     # the ping cadence).  Read with getattr — v2 pickles lack the field.
     health: Dict[str, Dict] = field(default_factory=dict)
+    # v4: store utilization piggybacked on the liveness ping (reference
+    # osd_stat_t riding MOSDBeacon/pg stats): {total, used, avail,
+    # num_objects}, total == 0 meaning no configured capacity.  The mon
+    # derives per-OSD NEARFULL/BACKFILLFULL/FULL states from it.  Read
+    # with getattr — v3 pickles lack the field (truncated-tail rule).
+    statfs: Dict[str, int] = field(default_factory=dict)
 
 
 @message(8)
@@ -607,6 +700,19 @@ class MOSDSetFlag:
 
     flag: str = ""
     set: bool = True
+    tid: str = ""
+
+
+@message(82)
+class MSetFullRatio:
+    """`ceph osd set-nearfull-ratio / set-backfillfull-ratio /
+    set-full-ratio` (reference OSDMonitor prepare_command_impl
+    "osd set-*full-ratio"): install a fullness threshold in the OSDMap.
+    The mon validates the ORDERING (nearfull <= backfillfull <= full
+    < the OSDs' failsafe) so a typo can never invert the ladder."""
+
+    which: str = ""  # nearfull | backfillfull | full
+    ratio: float = 0.0
     tid: str = ""
 
 
@@ -1172,11 +1278,15 @@ class MBackfillReserve:
     reply_to: Tuple[str, int] = ("", 0)
 
 
-@message(54)
+@message(54, version=2)
 class MBackfillReserveReply:
     tid: str = ""
     osd_id: int = 0
     ok: bool = False
+    # v2: why a reservation was refused ("toofull" = target past its
+    # backfillfull ratio — the primary parks the PG as backfill_toofull
+    # and retries with backoff).  Read with getattr: v1 pickles lack it.
+    reason: str = ""
 
 
 @message(37, version=2)

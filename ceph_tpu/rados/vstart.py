@@ -121,10 +121,16 @@ class Cluster:
         raise TimeoutError("mon quorum did not form")
 
     async def add_osd(self) -> OSD:
+        # capacity seeding (the fullness plane's byte ceiling): BlueStore
+        # reads osd_store_capacity_bytes from the conf itself; the RAM
+        # store gets it passed explicitly.  0 = unlimited (default).
+        capacity = int(self.conf.get("osd_store_capacity_bytes", 0) or 0)
+        failsafe = float(self.conf.get("osd_failsafe_full_ratio", 0.97)
+                         or 0.97)
         store = (
             BlueStore(f"{self.data_dir}/osd.{self._next_store}", self.conf)
             if self.data_dir
-            else MemStore()
+            else MemStore(capacity_bytes=capacity, failsafe_ratio=failsafe)
         )
         self._next_store += 1
         osd = OSD(self.mon_addrs, store=store, conf=self.conf)

@@ -50,6 +50,9 @@ def parse_args(argv=None):
                         "crash archive-all | crash prune KEEP_DAYS | "
                         "tell TARGET CMD [k=v...] | "
                         "df | osd df | osd tree | pg dump | "
+                        "osd set-nearfull-ratio R | "
+                        "osd set-backfillfull-ratio R | "
+                        "osd set-full-ratio R | "
                         "osd pool ls | osd pool create NAME [k=v...] | "
                         "osd pool set NAME KEY VALUE | "
                         "osd pool rm NAME NAME --yes-i-really-really-mean-it"
@@ -241,6 +244,44 @@ def render_health(health: Dict, detail: bool = False) -> List[str]:
         extra = (f" (expires in {c['expires_in']:g}s)"
                  if c.get("expires_in") else "")
         lines.append(f"  (muted) {name}: {c.get('summary', '')}{extra}")
+    return lines
+
+
+def render_osd_df(rows: List[Dict], osdmap=None) -> List[str]:
+    """Render `ceph osd df` from the mon's aggregated utilization view
+    (client.osd_df rows): size/use/avail, %USE, and the fullness STATE
+    with nearfull/backfillfull/FULL highlighting.  Pure so tests can pin
+    the layout."""
+    lines = [f"{'ID':<4} {'STATUS':<7} {'WEIGHT':>7} {'SIZE':>12} "
+             f"{'USE':>12} {'AVAIL':>12} {'%USE':>7} {'OBJECTS':>8}  "
+             f"STATE"]
+    total_bytes = used_bytes = 0
+    for r in rows:
+        status = "up" if r.get("up", True) else "down"
+        if r.get("error"):
+            status = "error"
+        total = int(r.get("total", 0) or 0)
+        used = int(r.get("used", 0) or 0)
+        if total:  # TOTAL %USE only over capacity-bearing OSDs
+            total_bytes += total
+            used_bytes += used
+        pct = f"{100.0 * used / total:6.2f}%" if total else "      -"
+        state = r.get("state", "") or "-"
+        if state == "full":
+            state = "FULL"  # the one that blocks writes stands out
+        lines.append(
+            f"{r.get('id', '?'):<4} {status:<7} "
+            f"{float(r.get('weight', 1.0)):>7.4f} {total:>12} "
+            f"{used:>12} {int(r.get('avail', 0) or 0):>12} {pct:>7} "
+            f"{int(r.get('num_objects', 0) or 0):>8}  {state}")
+    if total_bytes:
+        pct = f"{100.0 * used_bytes / total_bytes:6.2f}%"
+        lines.append(f"TOTAL {'':<13} {total_bytes:>12} {used_bytes:>12} "
+                     f"{max(0, total_bytes - used_bytes):>12} {pct:>7}")
+    if osdmap is not None:
+        nf, bf, fl = osdmap.fullness_ratios()
+        lines.append(f"ratios: nearfull {nf:g}  backfillfull {bf:g}  "
+                     f"full {fl:g}")
     return lines
 
 
@@ -572,37 +613,36 @@ async def run(args) -> int:
             print(f"set pool {name} {key} = {value}")
             return 0
         if cmd == "osd df":
-            # per-OSD utilization (reference `ceph osd df`): statfs
-            # fan-out to every UP osd, CONCURRENTLY — one unresponsive
-            # OSD must cost one timeout, not serialize the sweep
-            import asyncio as _aio
-
-            async def one(osd_id, info):
-                if not info.up:
-                    return {"id": osd_id, "status": "down"}
-                try:
-                    stats = await client.osd_statfs(osd_id)
-                except Exception as e:
-                    return {"id": osd_id, "status": f"error: {e}"}
-                return {"id": osd_id, "status": "up",
-                        "weight": info.weight, **stats}
-
-            rows = list(await _aio.gather(
-                *(one(osd_id, info)
-                  for osd_id, info in sorted(m.osds.items()))))
+            # per-OSD utilization + fullness (reference `ceph osd df`):
+            # ONE aggregated query against the mon (the view its
+            # fullness derivation runs on) instead of N direct per-OSD
+            # statfs ops; client.osd_df falls back to direct polling
+            # when the mon is old
+            util = await client.osd_df()
+            rows = [{"id": osd_id, **r}
+                    for osd_id, r in sorted(util.items())]
             if args.format == "json":
                 print(json.dumps(rows))
             else:
-                print(f"{'ID':<4} {'STATUS':<8} {'STORE':<12} "
-                      f"{'SIZE':>12} {'USED':>12} {'FREE':>12} "
-                      f"{'OBJECTS':>8}")
-                for r in rows:
-                    print(f"{r['id']:<4} {r.get('status', ''):<8} "
-                          f"{r.get('store', '-'):<12} "
-                          f"{r.get('size', 0):>12} "
-                          f"{r.get('used', 0):>12} "
-                          f"{r.get('free', 0):>12} "
-                          f"{r.get('num_objects', 0):>8}")
+                for line in render_osd_df(rows, m):
+                    print(line)
+            return 0
+        if len(args.words) == 3 and args.words[0] == "osd" \
+                and args.words[1] in ("set-nearfull-ratio",
+                                      "set-backfillfull-ratio",
+                                      "set-full-ratio"):
+            which = args.words[1][len("set-"):-len("-ratio")]
+            try:
+                ratio = float(args.words[2])
+            except ValueError:
+                print(f"bad ratio {args.words[2]!r}", file=sys.stderr)
+                return 2
+            try:
+                await client.osd_set_full_ratio(which, ratio)
+            except Exception as e:
+                print(f"Error: {e}", file=sys.stderr)
+                return 1
+            print(f"osd set-{which}-ratio {ratio:g}")
             return 0
         if args.words[:3] in (["osd", "pool", "mksnap"],
                               ["osd", "pool", "rmsnap"]):

@@ -76,6 +76,15 @@ def parse_args(argv=None):
     p.add_argument("--tier-seconds", type=float, default=6.0,
                    help="length of the tier promote/evict/read loop")
     p.add_argument("--tier-osds", type=int, default=3)
+    # fullness-ladder gate (CI, FAILING): drive nearfull -> backfillfull
+    # -> full -> failsafe against a live cluster (injection + a real
+    # capacity-bounded store); typed ENOSPC on writes, reads/deletes
+    # served, zero acked-op loss, auto-clear after the drain, backfill
+    # completing after a backfillfull target frees space
+    p.add_argument("--full", action="store_true")
+    p.add_argument("--full-seconds", type=float, default=12.0,
+                   help="ceiling on each fullness-ladder wait")
+    p.add_argument("--full-osds", type=int, default=4)
     return p.parse_args(argv)
 
 
@@ -848,6 +857,298 @@ def run_tier(args) -> int:
     return asyncio.run(go())
 
 
+def run_full(args) -> int:
+    """Fullness-ladder gate (CI), the acceptance bar of the capacity
+    plane, runnable as one FAILING command:
+
+        python -m ceph_tpu.tools.non_regression --full
+
+    Three legs:
+
+    1. INJECTED LADDER (no gigabytes written): force one OSD's reported
+       utilization through nearfull -> full; assert OSD_NEARFULL warns,
+       OSD_FULL + POOL_FULL raise, writes into PGs holding the full OSD
+       fail TYPED ENOSPC, reads of every acked object stay
+       byte-identical (zero acked-op loss), deletes are still served;
+       clear the injection and assert the flags auto-clear and writes
+       resume.
+    2. REAL CAPACITY: a store with a genuine byte ceiling fills until
+       the failsafe refuses (typed ENOSPC, store untouched); deleting
+       drains below the ratio, states auto-clear, writes resume —
+       the delete-is-the-way-out contract on real bytes.
+    3. BACKFILLFULL: a backfill whose target is past its backfillfull
+       ratio parks as `backfill_toofull` (PG_BACKFILL_FULL in health);
+       freeing the target lets the backfill complete with data intact.
+    """
+    import asyncio
+    import errno as _errno
+    import os as _os
+    import time as _time
+
+    from ceph_tpu.rados.client import RadosError
+    from ceph_tpu.rados.vstart import Cluster
+
+    async def wait_for(pred, seconds, what, failures):
+        deadline = _time.monotonic() + seconds
+        while _time.monotonic() < deadline:
+            if await pred():
+                return True
+            await asyncio.sleep(0.1)
+        failures.append(f"timed out waiting for {what}")
+        return False
+
+    async def verify_acked(c, pool, acked, failures, stage):
+        """Zero acked-op loss: every acked object reads byte-identical."""
+        for oid, want in acked.items():
+            try:
+                got = await c.get(pool, oid)
+            except Exception as e:
+                failures.append(f"[{stage}] acked {oid} unreadable: {e}")
+                continue
+            if bytes(got) != want:
+                failures.append(f"[{stage}] acked {oid} corrupted")
+
+    async def leg_injected(failures) -> None:
+        conf = {"osd_auto_repair": True, "osd_heartbeat_interval": 0.1,
+                "mon_osd_report_grace": 2.0,
+                "client_op_timeout": 5.0, "client_op_deadline": 6.0}
+        cluster = Cluster(n_osds=max(3, args.full_osds), conf=conf)
+        await cluster.start()
+        try:
+            c = await cluster.client()
+            pool = await c.create_pool("fullpool", profile={
+                "plugin": "jerasure", "technique": "reed_sol_van",
+                "k": "2", "m": "1"})
+            acked = {}
+            for i in range(10):
+                blob = _os.urandom(48_000 + 997 * i)
+                await c.put(pool, f"o{i}", blob)
+                acked[f"o{i}"] = blob
+            victim = sorted(cluster.osds)[0]
+
+            async def state_is(want):
+                h = await c.get_health(detail=True)
+                util = h.get("osd_utilization") or {}
+                return (util.get(victim) or {}).get("state") == want
+
+            # nearfull: warn raises, writes still flow
+            cluster.conf["osd_debug_inject_full"] = f"{victim}:0.87"
+            await wait_for(lambda: state_is("nearfull"), args.full_seconds,
+                           "nearfull state", failures)
+            h = await c.get_health()
+            if "OSD_NEARFULL" not in (h.get("checks") or {}):
+                failures.append("OSD_NEARFULL never raised")
+            await c.put(pool, "nearfull-write", b"x" * 1000)
+            acked["nearfull-write"] = b"x" * 1000
+            # full: OSD_FULL(+POOL_FULL) raise; writes typed-ENOSPC
+            cluster.conf["osd_debug_inject_full"] = f"{victim}:0.96"
+            await wait_for(lambda: state_is("full"), args.full_seconds,
+                           "full state", failures)
+            h = await c.get_health()
+            for check in ("OSD_FULL", "POOL_FULL"):
+                if check not in (h.get("checks") or {}):
+                    failures.append(f"{check} never raised")
+            # an oid whose PG's acting set holds the victim
+            await c.refresh_map()
+            p = c.osdmap.pools[pool]
+            target_oid = None
+            for i in range(256):
+                oid = f"fullprobe{i}"
+                pg = c.osdmap.object_to_pg(p, oid)
+                if victim in c.osdmap.pg_to_acting(p, pg):
+                    target_oid = oid
+                    break
+            if target_oid is None:
+                failures.append("no PG maps onto the full OSD?")
+            else:
+                t0 = _time.monotonic()
+                try:
+                    await c.put(pool, target_oid, b"y" * 2000)
+                    failures.append("write into a FULL acting set "
+                                    "succeeded")
+                except RadosError as e:
+                    if e.code != -_errno.ENOSPC:
+                        failures.append(
+                            f"write failed untyped (code {e.code}, "
+                            f"want ENOSPC): {e}")
+                    elif _time.monotonic() - t0 > 3.0:
+                        failures.append(
+                            "ENOSPC took the slow retry path "
+                            f"({_time.monotonic() - t0:.1f}s): not "
+                            "fail-fast")
+                # reads + deletes still served at FULL
+                await verify_acked(c, pool, acked, failures, "full")
+                await c.delete(pool, "o0")
+                del acked["o0"]
+                try:
+                    await c.get(pool, "o0")
+                    failures.append("deleted o0 still readable")
+                except RadosError:
+                    pass
+            # the drain: injection cleared = utilization dropped
+            cluster.conf["osd_debug_inject_full"] = ""
+            await wait_for(lambda: state_is(""), args.full_seconds,
+                           "full state to auto-clear", failures)
+
+            async def no_full_checks():
+                h = await c.get_health()
+                checks = h.get("checks") or {}
+                return not ({"OSD_FULL", "POOL_FULL", "OSD_NEARFULL"}
+                            & set(checks))
+
+            await wait_for(no_full_checks, args.full_seconds,
+                           "fullness health checks to clear", failures)
+            if target_oid is not None:
+                blob = _os.urandom(3000)
+                await c.put(pool, target_oid, blob)  # writes resume
+                acked[target_oid] = blob
+            await verify_acked(c, pool, acked, failures, "cleared")
+            await c.stop()
+        finally:
+            cluster.conf["osd_debug_inject_full"] = ""
+            await cluster.stop()
+
+    async def leg_capacity(failures) -> None:
+        # one OSD, one replica, a REAL 1 MiB ceiling: the failsafe must
+        # refuse before the store bursts, deletes must drain it
+        cap = 1 << 20
+        conf = {"osd_auto_repair": False, "osd_heartbeat_interval": 0.1,
+                "osd_store_capacity_bytes": cap,
+                "client_op_timeout": 5.0, "client_op_deadline": 6.0}
+        cluster = Cluster(n_osds=1, conf=conf)
+        await cluster.start()
+        try:
+            c = await cluster.client()
+            pool = await c.create_pool("cap", pool_type="replicated",
+                                       profile={"size": "1"}, pg_num=8)
+            acked = {}
+            blocked = None
+            for i in range(64):
+                oid = f"c{i}"
+                blob = _os.urandom(48 << 10)
+                try:
+                    await c.put(pool, oid, blob)
+                    acked[oid] = blob
+                except RadosError as e:
+                    blocked = e
+                    break
+            if blocked is None:
+                failures.append(
+                    f"64 x 48KiB writes into a {cap}-byte store never "
+                    f"hit the failsafe")
+            elif blocked.code != -_errno.ENOSPC:
+                failures.append(f"failsafe refusal untyped "
+                                f"(code {blocked.code}): {blocked}")
+            osd = next(iter(cluster.osds.values()))
+            st = osd.store.statfs()
+            if st["used"] > int(cap * 0.98):
+                failures.append(f"store burst past the failsafe: "
+                                f"used {st['used']} of {cap}")
+            await verify_acked(c, pool, acked, failures, "capacity-full")
+            # the ONLY way out: delete (exempt from every gate)
+            for oid in list(acked)[: len(acked) * 2 // 3]:
+                await c.delete(pool, oid)
+                del acked[oid]
+
+            async def can_write():
+                try:
+                    await c.put(pool, "after-drain", b"z" * 4096)
+                    return True
+                except RadosError:
+                    return False
+
+            if await wait_for(can_write, args.full_seconds,
+                              "writes to resume after the drain",
+                              failures):
+                acked["after-drain"] = b"z" * 4096
+            await verify_acked(c, pool, acked, failures, "drained")
+            await c.stop()
+        finally:
+            await cluster.stop()
+
+    async def leg_backfillfull(failures) -> None:
+        conf = {"osd_auto_repair": True, "osd_heartbeat_interval": 0.1,
+                "mon_osd_report_grace": 1.0,
+                "osd_backfill_toofull_retry": 0.3,
+                "osd_repair_delay": 0.1,
+                "client_op_timeout": 5.0, "client_op_deadline": 6.0}
+        cluster = Cluster(n_osds=max(4, args.full_osds), conf=conf)
+        await cluster.start()
+        try:
+            c = await cluster.client()
+            pool = await c.create_pool("bf", profile={
+                "plugin": "jerasure", "technique": "reed_sol_van",
+                "k": "2", "m": "1"})
+            acked = {}
+            for i in range(8):
+                blob = _os.urandom(40_000 + 531 * i)
+                await c.put(pool, f"b{i}", blob)
+                acked[f"b{i}"] = blob
+            ids = sorted(cluster.osds)
+            target, dead = ids[0], ids[-1]
+            cluster.conf["osd_debug_inject_full"] = f"{target}:0.92"
+
+            async def target_backfillfull():
+                h = await c.get_health()
+                util = h.get("osd_utilization") or {}
+                return (util.get(target)
+                        or {}).get("state") == "backfillfull"
+
+            await wait_for(target_backfillfull, args.full_seconds,
+                           "backfillfull state", failures)
+            # force backfill whose reservations land on the injected OSD
+            await cluster.kill_osd(dead)
+
+            async def parked():
+                h = await c.get_health(detail=True)
+                return "PG_BACKFILL_FULL" in (h.get("checks") or {})
+
+            await wait_for(parked, args.full_seconds,
+                           "PG_BACKFILL_FULL (backfill_toofull park)",
+                           failures)
+            # the target frees space -> the parked reservation retries
+            # through and backfill completes
+            cluster.conf["osd_debug_inject_full"] = ""
+
+            async def resumed():
+                h = await c.get_health(detail=True)
+                checks = set(h.get("checks") or {})
+                return not ({"PG_BACKFILL_FULL", "OSD_BACKFILLFULL"}
+                            & checks)
+
+            await wait_for(resumed, max(args.full_seconds, 15.0),
+                           "backfill to resume after the target freed "
+                           "space", failures)
+            await verify_acked(c, pool, acked, failures, "backfilled")
+            await c.stop()
+        finally:
+            cluster.conf["osd_debug_inject_full"] = ""
+            await cluster.stop()
+
+    async def go() -> int:
+        failures: list = []
+        for name, leg in (("injected-ladder", leg_injected),
+                          ("real-capacity", leg_capacity),
+                          ("backfillfull", leg_backfillfull)):
+            t0 = _time.monotonic()
+            try:
+                await leg(failures)
+            except Exception as e:
+                import traceback
+
+                traceback.print_exc()
+                failures.append(f"[{name}] leg crashed: "
+                                f"{type(e).__name__}: {e}")
+            print(f"full: leg {name} done in "
+                  f"{_time.monotonic() - t0:.1f}s "
+                  f"({len(failures)} cumulative failures)")
+        for f in failures:
+            print(f"FAIL {f}", file=sys.stderr)
+        return 1 if failures else 0
+
+    return asyncio.run(go())
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     if args.slow_ops:
@@ -858,6 +1159,8 @@ def main(argv=None) -> int:
         return run_qos(args)
     if args.tier:
         return run_tier(args)
+    if args.full:
+        return run_full(args)
     if args.chaos:
         return run_chaos(args)
     if args.wire_floor:

@@ -125,6 +125,20 @@ def _sample_messages() -> List[Any]:
         t.MNotifyAck(notify_id="n1", watcher=("h", 3)),
         t.MBackfillReserve(pool_id=1, pg=3, op="request", from_osd=2,
                            tid="t10", reply_to=("h", 4)),
+        # v2 carries the refusal reason ("toofull" = backfillfull
+        # target); v1 frames decode with reason defaulting (golden)
+        t.MBackfillReserveReply(tid="t10", osd_id=4, ok=False,
+                                reason="toofull"),
+        # liveness ping v4: health checks + the statfs the mon's
+        # fullness derivation runs on (v3 golden pins truncated decode)
+        t.MPing(osd_id=3, epoch=21, addr=("127.0.0.1", 6801),
+                health={"SLOW_OPS": {"severity": "warning",
+                                     "summary": "1 slow ops",
+                                     "count": 1}},
+                statfs={"total": 1 << 30, "used": 900 << 20,
+                        "avail": (1 << 30) - (900 << 20),
+                        "num_objects": 12}),
+        t.MSetFullRatio(which="backfillfull", ratio=0.9, tid="t18"),
         t.MOSDFailure(target_osd=4, from_osd=1, failed_for=12.5,
                       tid="t11"),
         t.MOSDBackoff(op="unblock", pool_id=2, pg=9, id="bk-1", epoch=33,

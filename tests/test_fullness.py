@@ -1,0 +1,577 @@
+"""Capacity-aware graceful degradation: the per-OSD fullness plane.
+
+Covers the PR's acceptance surface: the uniform store statfs shape +
+capacity ceilings, the failsafe refusing with a TYPED ENOSPC before
+mutating anything (store byte-identical after a refused write), the
+mon's ratio-ordering validation and auto-set/auto-clear hysteresis, the
+MPing v4 golden truncated-tail decode (old frames still decode), the
+deletes-allowed-when-full contract end to end, `backfill_toofull`
+park/retry liveness, the injection knob, `osd df` from the mon's
+aggregated view, and the mgr's per-OSD utilization metrics.
+"""
+
+import asyncio
+import errno
+import os
+import struct
+import time
+
+import pytest
+
+from ceph_tpu.rados.bluestore import BlueStore
+from ceph_tpu.rados.store import (ENOSPCError, DirStore, MemStore,
+                                  ShardMeta, Transaction)
+from ceph_tpu.rados.types import (MPing, MSetFullRatio, OSDMap,
+                                  OSDMapIncremental, OsdInfo)
+from ceph_tpu.rados.vstart import Cluster
+
+PROFILE = {"plugin": "jerasure", "technique": "reed_sol_van",
+           "k": "2", "m": "1"}
+
+UNIFORM = {"total", "used", "avail", "num_objects"}
+
+
+def run(coro, timeout=120):
+    asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def _txn(key, blob):
+    t = Transaction()
+    t.write(key, blob, ShardMeta(version=1, object_size=len(blob)))
+    return t
+
+
+# -- store layer --------------------------------------------------------------
+
+
+class TestStoreFullness:
+    def test_memstore_statfs_tracks_bytes(self):
+        s = MemStore(capacity_bytes=10_000)
+        assert UNIFORM <= set(s.statfs())
+        assert s.statfs()["used"] == 0
+        s.queue_transaction(_txn((1, "a", 0), b"x" * 600))
+        s.queue_transaction(_txn((1, "b", 0), b"y" * 400))
+        st = s.statfs()
+        assert st["used"] == 1000 and st["total"] == 10_000
+        assert st["avail"] == 9000 and st["num_objects"] == 2
+        # overwrite replaces, not accumulates
+        s.queue_transaction(_txn((1, "a", 0), b"z" * 100))
+        assert s.statfs()["used"] == 500
+        t = Transaction()
+        t.delete((1, "b", 0))
+        s.queue_transaction(t)
+        assert s.statfs()["used"] == 100
+
+    def test_statfs_uniform_shape_everywhere(self, tmp_path):
+        # every store implements statfs() now — the osd.py getattr
+        # guard is gone, so the SHAPE is the contract
+        stores = [MemStore(), DirStore(str(tmp_path / "d")),
+                  BlueStore(str(tmp_path / "b"), {})]
+        for s in stores:
+            st = s.statfs()
+            assert UNIFORM <= set(st), type(s).__name__
+            assert st["total"] == 0  # no capacity configured = unlimited
+
+    def test_failsafe_rejects_before_mutation(self):
+        s = MemStore(capacity_bytes=1000, failsafe_ratio=0.9)
+        s.queue_transaction(_txn((1, "a", 0), b"x" * 800))
+        s.omap_set((1, "a", 0), {"k": b"v"})
+        s.setattr((1, "a", 0), "x", b"1")
+        before = (dict(s._data), {k: dict(v) for k, v in s._omap.items()},
+                  {k: dict(v) for k, v in s._xattrs.items()},
+                  s.statfs())
+        # this txn would cross 0.9 * 1000; it also carries a delete and
+        # an omap mutation — NONE of it may land
+        t = Transaction()
+        t.write((1, "b", 0), b"y" * 200,
+                ShardMeta(version=1, object_size=200))
+        t.delete((1, "a", 0))
+        t.omap_set((1, "b", 0), {"m": b"n"})
+        with pytest.raises(ENOSPCError) as ei:
+            s.queue_transaction(t)
+        assert ei.value.errno == errno.ENOSPC
+        after = (dict(s._data), {k: dict(v) for k, v in s._omap.items()},
+                 {k: dict(v) for k, v in s._xattrs.items()}, s.statfs())
+        assert after == before  # byte-identical: refused BEFORE mutating
+
+    def test_delete_only_txn_passes_at_full(self):
+        s = MemStore(capacity_bytes=1000, failsafe_ratio=0.5)
+        s.queue_transaction(_txn((1, "a", 0), b"x" * 500))  # exactly at
+        t = Transaction()
+        t.delete((1, "a", 0))
+        s.queue_transaction(t)  # deletes are the way OUT: never refused
+        assert s.statfs()["used"] == 0
+
+    def test_bluestore_capacity_and_failsafe(self, tmp_path):
+        conf = {"osd_store_capacity_bytes": 4096,
+                "osd_failsafe_full_ratio": 0.9}
+        s = BlueStore(str(tmp_path / "bs"), conf)
+        s.queue_transaction(_txn((1, "a", 0), b"x" * 3000))
+        st = s.statfs()
+        assert st["total"] == 4096 and st["used"] >= 3000
+        with pytest.raises(ENOSPCError):
+            s.queue_transaction(_txn((1, "b", 0), b"y" * 2000))
+        # the refused write left the existing object readable
+        data, meta = s.read((1, "a", 0))
+        assert data == b"x" * 3000
+        # delete drains; the write then fits
+        t = Transaction()
+        t.delete((1, "a", 0))
+        s.queue_transaction(t)
+        s.queue_transaction(_txn((1, "b", 0), b"y" * 2000))
+        assert s.read((1, "b", 0))[0] == b"y" * 2000
+        s.close()
+
+
+# -- map / incremental plumbing ----------------------------------------------
+
+
+class TestMapFullness:
+    def test_full_state_getattr_safe(self):
+        m = OSDMap()
+        assert m.full_state(3) == ""
+        assert m.fullness_ratios() == (0.85, 0.90, 0.95)
+        m.full_osds[3] = "full"
+        assert m.full_state(3) == "full"
+        # a map object missing the new attributes (old pickle shape)
+        del m.full_osds, m.nearfull_ratio
+        assert m.full_state(3) == ""
+        assert m.fullness_ratios()[0] == 0.85
+
+    def test_incremental_carries_fullness(self):
+        old = OSDMap(epoch=1)
+        new = OSDMap(epoch=2, full_osds={1: "nearfull"},
+                     nearfull_ratio=0.8)
+        inc = OSDMapIncremental.diff(old, new)
+        assert inc.new_full_osds == {1: "nearfull"}
+        assert inc.new_full_ratios == (0.8, 0.90, 0.95)
+        m = OSDMap(epoch=1)
+        assert m.apply_incremental(inc)
+        assert m.full_state(1) == "nearfull"
+        assert m.fullness_ratios()[0] == 0.8
+        # unchanged fullness diffs to None (no churn in the delta)
+        inc2 = OSDMapIncremental.diff(new, new)
+        assert inc2.new_full_osds is None
+        assert inc2.new_full_ratios is None
+
+
+# -- mon: derivation, hysteresis, ratio validation ---------------------------
+
+
+def _leader_mon():
+    from ceph_tpu.rados.mon import Monitor
+
+    mon = Monitor()
+    mon.logic.start()
+    mon.logic.acked_by = {0}
+    mon.logic.declare_victory()
+    for i in range(3):
+        mon.osdmap.osds[i] = OsdInfo(osd_id=i, addr=("h", 1 + i))
+    return mon
+
+
+def _ping(mon, osd_id, ratio, total=1 << 30):
+    used = int(total * ratio)
+    asyncio.run(mon._process_ping(MPing(
+        osd_id=osd_id, epoch=mon.osdmap.epoch,
+        statfs={"total": total, "used": used, "avail": total - used,
+                "num_objects": 1})))
+
+
+class TestMonFullness:
+    def test_auto_set_auto_clear_hysteresis(self):
+        mon = _leader_mon()
+        _ping(mon, 0, 0.50)
+        assert mon.osdmap.full_state(0) == ""
+        _ping(mon, 0, 0.86)
+        assert mon.osdmap.full_state(0) == "nearfull"
+        # inside the hysteresis band (0.85 - 0.01): still nearfull
+        _ping(mon, 0, 0.845)
+        assert mon.osdmap.full_state(0) == "nearfull"
+        # clearly below: auto-clears
+        _ping(mon, 0, 0.83)
+        assert mon.osdmap.full_state(0) == ""
+        # promotion is immediate, straight to the worst crossed state
+        _ping(mon, 0, 0.97)
+        assert mon.osdmap.full_state(0) == "full"
+        # demotion to backfillfull once clearly below full
+        _ping(mon, 0, 0.91)
+        assert mon.osdmap.full_state(0) == "backfillfull"
+
+    def test_state_transitions_bump_epoch_only_on_change(self):
+        mon = _leader_mon()
+        _ping(mon, 0, 0.5)
+        e0 = mon.osdmap.epoch
+        _ping(mon, 0, 0.6)  # drift without a transition: no epoch churn
+        assert mon.osdmap.epoch == e0
+        _ping(mon, 0, 0.96)
+        assert mon.osdmap.epoch > e0
+
+    def test_unlimited_store_never_full(self):
+        mon = _leader_mon()
+        asyncio.run(mon._process_ping(MPing(
+            osd_id=0, epoch=mon.osdmap.epoch,
+            statfs={"total": 0, "used": 1 << 40, "avail": 0,
+                    "num_objects": 9})))
+        assert mon.osdmap.full_state(0) == ""
+
+    def test_health_checks_and_utilization(self):
+        mon = _leader_mon()
+        _ping(mon, 0, 0.86)
+        _ping(mon, 1, 0.91)
+        _ping(mon, 2, 0.96)
+        h = mon.health_summary(detail=True)
+        checks = h["checks"]
+        assert checks["OSD_NEARFULL"]["osds"] == [0]
+        assert checks["OSD_BACKFILLFULL"]["osds"] == [1]
+        assert checks["OSD_FULL"]["osds"] == [2]
+        assert checks["OSD_FULL"]["severity"] == "error"
+        assert h["status"] == "HEALTH_ERR"
+        util = h["osd_utilization"]
+        assert util[2]["state"] == "full"
+        assert util[0]["ratio"] == pytest.approx(0.86, abs=0.001)
+        assert UNIFORM <= set(util[1])
+
+    def test_ratio_ordering_validation(self):
+        mon = _leader_mon()
+
+        def set_ratio(which, ratio):
+            return asyncio.run(mon._process_write(
+                MSetFullRatio(which=which, ratio=ratio, tid=os.urandom(4).hex())))
+
+        # inversions are refused with a typed error reply
+        r = set_ratio("nearfull", 0.93)  # > backfillfull 0.90
+        assert not r.ok and "ordering" in r.error
+        r = set_ratio("full", 0.98)  # >= failsafe 0.97
+        assert not r.ok
+        r = set_ratio("backfillfull", 0.80)  # < nearfull 0.85
+        assert not r.ok
+        r = set_ratio("sideways", 0.5)
+        assert not r.ok
+        assert mon.osdmap.fullness_ratios() == (0.85, 0.90, 0.95)
+        # a valid move lands and re-derives states immediately
+        _ping(mon, 0, 0.80)
+        assert mon.osdmap.full_state(0) == ""
+        r = set_ratio("nearfull", 0.75)
+        assert r.ok
+        assert mon.osdmap.fullness_ratios()[0] == 0.75
+        assert mon.osdmap.full_state(0) == "nearfull"
+
+    def test_mping_v3_golden_truncated_decode(self):
+        """Old frames still decode (the truncated-tail rule): a v3 MPing
+        encoded WITHOUT the statfs field — archived under
+        corpus/wire/golden — must decode today and flow through the
+        mon's ping path without a fullness verdict."""
+        from ceph_tpu.rados.messenger import decode_message
+
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "corpus", "wire", "golden",
+            "MPing.v3_prefullness.frame")
+        hdr = struct.Struct("<HHBI")
+        with open(path, "rb") as f:
+            raw = f.read()
+        type_id, version, fixed, plen = hdr.unpack_from(raw, 0)
+        assert version == 3
+        payload = raw[hdr.size:hdr.size + plen]
+        msg = decode_message(type_id, version, payload, None, bool(fixed))
+        assert isinstance(msg, MPing)
+        assert "statfs" not in msg.__dict__  # the old layout, verbatim
+        mon = _leader_mon()
+        asyncio.run(mon._process_ping(msg))  # getattr default: no crash
+        assert mon.osdmap.full_state(msg.osd_id) == ""
+        assert msg.osd_id not in mon._osd_statfs
+
+
+# -- OSD: injection knob + gates ---------------------------------------------
+
+
+class TestInjectionKnob:
+    def _osd(self, osd_id=0, conf=None):
+        from ceph_tpu.rados.osd import OSD
+
+        return OSD(("h", 1), conf=conf or {}, osd_id=osd_id)
+
+    def test_conf_and_env_parse(self, monkeypatch):
+        osd = self._osd(osd_id=2, conf={"osd_debug_inject_full":
+                                        "1:0.5,2:0.91"})
+        assert osd._inject_full_ratio() == pytest.approx(0.91)
+        osd = self._osd(osd_id=3, conf={"osd_debug_inject_full": "0.7"})
+        assert osd._inject_full_ratio() == pytest.approx(0.7)
+        osd = self._osd(osd_id=3)
+        assert osd._inject_full_ratio() is None
+        monkeypatch.setenv("CEPH_TPU_INJECT_FULL", "3:0.88")
+        assert osd._inject_full_ratio() == pytest.approx(0.88)
+        # conf beats env
+        osd.conf["osd_debug_inject_full"] = "3:0.2"
+        assert osd._inject_full_ratio() == pytest.approx(0.2)
+
+    def test_injection_synthesizes_statfs(self):
+        osd = self._osd(conf={"osd_debug_inject_full": "0.96"})
+        st = osd._statfs()
+        assert st["total"] > 0
+        assert st["used"] / st["total"] == pytest.approx(0.96, abs=0.01)
+        assert osd._failsafe_full() is False  # 0.96 < 0.97
+        osd.conf["osd_debug_inject_full"] = "0.99"
+        assert osd._failsafe_full() is True
+
+
+class TestClientGates:
+    def test_delete_exempt_from_pause_flags(self):
+        from ceph_tpu.rados.client import RadosClient
+        from ceph_tpu.rados.types import MOSDOp
+
+        c = RadosClient(("h", 1))
+        c.osdmap = OSDMap(flags=["pausewr", "full"])
+        assert c._paused_for(MOSDOp(op="write"))
+        assert c._paused_for(MOSDOp(op="call"))
+        assert not c._paused_for(MOSDOp(op="read"))
+        assert not c._paused_for(MOSDOp(op="delete"))  # the way out
+        assert not c._paused_for(MOSDOp(op="snap-trim"))
+        # delete-only compounds ride the same exemption; mixed ones gate
+        assert not c._paused_for(MOSDOp(op="multi",
+                                        ops=[("remove", {}),
+                                             ("rmxattr", {"name": "a"})]))
+        assert c._paused_for(MOSDOp(op="multi",
+                                    ops=[("remove", {}),
+                                         ("write", {"data": b"x"})]))
+
+    def test_enospc_is_definitive(self):
+        from ceph_tpu.rados.client import _DEFINITIVE_CODES
+
+        assert -errno.ENOSPC in _DEFINITIVE_CODES
+
+    def test_full_gate_multi_classification(self):
+        """Reads are untouched by full: a read-only compound passes the
+        OSD's fullness write gate; a mixed one is gated; a delete-only
+        one drains."""
+        from ceph_tpu.rados.crush import CrushMap
+        from ceph_tpu.rados.osd import OSD
+        from ceph_tpu.rados.types import MOSDOp, PoolInfo
+
+        osd = OSD(("h", 1), osd_id=0)
+        # every OSD full: ANY acting set trips the gate for mutations
+        m = OSDMap(epoch=2, full_osds={i: "full" for i in range(3)},
+                   crush=CrushMap.flat([0, 1, 2]))
+        m.osds = {i: OsdInfo(osd_id=i, addr=("h", i + 1))
+                  for i in range(3)}
+        m.pools[7] = PoolInfo(pool_id=7, name="p", pool_type="ec",
+                              pg_num=4, size=3, min_size=2)
+        osd.osdmap = m
+        # sanity: the object's acting set is non-empty
+        assert any(a >= 0 for a in m.pg_to_acting(
+            m.pools[7], m.object_to_pg(m.pools[7], "o")))
+
+        def verdict(ops):
+            return osd._full_block_reply(
+                MOSDOp(op="multi", pool_id=7, oid="o", ops=ops))
+
+        read_only = [("read", {}), ("stat", {}),
+                     ("assert_exists", {}), ("omap_get_keys", {})]
+        assert verdict(read_only) is None
+        delete_only = [("remove", {})]
+        assert verdict(delete_only) is None
+        mixed = [("read", {}), ("write", {"data": b"x"})]
+        got = verdict(mixed)
+        assert got is not None and got.code == -errno.ENOSPC
+        # plain reads were never candidates
+        assert osd._full_block_reply(
+            MOSDOp(op="read", pool_id=7, oid="o")) is None
+
+
+# -- e2e: the ladder against a live cluster ----------------------------------
+
+
+CONF = {"osd_auto_repair": False, "osd_heartbeat_interval": 0.1,
+        "client_op_timeout": 5.0, "client_op_deadline": 6.0}
+
+
+class TestFullnessE2E:
+    def test_deletes_allowed_when_full(self):
+        async def go():
+            cluster = Cluster(n_osds=3, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("fp", profile=PROFILE)
+                blobs = {}
+                for i in range(6):
+                    blobs[f"o{i}"] = os.urandom(20_000 + i)
+                    await c.put(pool, f"o{i}", blobs[f"o{i}"])
+                # EVERY osd reports full (bare ratio = all)
+                cluster.conf["osd_debug_inject_full"] = "0.96"
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    await c.refresh_map()
+                    if all(c.osdmap.full_state(o) == "full"
+                           for o in cluster.osds):
+                        break
+                    await asyncio.sleep(0.1)
+                from ceph_tpu.rados.client import RadosError
+
+                t0 = time.monotonic()
+                with pytest.raises(RadosError) as ei:
+                    await c.put(pool, "o0", b"overwrite")
+                assert ei.value.code == -errno.ENOSPC
+                assert time.monotonic() - t0 < 3.0  # fail FAST
+                # reads untouched; every acked byte still served
+                for oid, want in blobs.items():
+                    assert bytes(await c.get(pool, oid)) == want
+                # deletes explicitly exempt: the only way out
+                await c.delete(pool, "o5")
+                with pytest.raises(RadosError):
+                    await c.get(pool, "o5")
+                # the drain: clear -> states auto-clear -> writes resume
+                cluster.conf["osd_debug_inject_full"] = ""
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    await c.refresh_map()
+                    if all(not c.osdmap.full_state(o)
+                           for o in cluster.osds):
+                        break
+                    await asyncio.sleep(0.1)
+                await c.put(pool, "o5", b"resumed")
+                assert bytes(await c.get(pool, "o5")) == b"resumed"
+                await c.stop()
+            finally:
+                cluster.conf["osd_debug_inject_full"] = ""
+                await cluster.stop()
+
+        run(go())
+
+    def test_backfill_toofull_parks_and_retries(self):
+        async def go():
+            conf = dict(CONF)
+            conf.update({"osd_auto_repair": True,
+                         "mon_osd_report_grace": 1.0,
+                         "osd_repair_delay": 0.1,
+                         "osd_backfill_toofull_retry": 0.3})
+            cluster = Cluster(n_osds=4, conf=conf)
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("bf", profile=PROFILE)
+                blobs = {}
+                for i in range(6):
+                    blobs[f"b{i}"] = os.urandom(30_000 + i)
+                    await c.put(pool, f"b{i}", blobs[f"b{i}"])
+                ids = sorted(cluster.osds)
+                target, dead = ids[0], ids[-1]
+                cluster.conf["osd_debug_inject_full"] = f"{target}:0.92"
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    h = await c.get_health()
+                    if (h.get("osd_utilization") or {}).get(
+                            target, {}).get("state") == "backfillfull":
+                        break
+                    await asyncio.sleep(0.1)
+                await cluster.kill_osd(dead)
+                # the PG parks: PG_BACKFILL_FULL surfaces via health
+                seen = False
+                deadline = time.monotonic() + 15
+                while time.monotonic() < deadline:
+                    h = await c.get_health(detail=True)
+                    if "PG_BACKFILL_FULL" in (h.get("checks") or {}):
+                        seen = True
+                        break
+                    await asyncio.sleep(0.1)
+                assert seen, "backfill_toofull never surfaced in health"
+                # park/retry LIVENESS: freeing the target resumes it
+                cluster.conf["osd_debug_inject_full"] = ""
+                cleared = False
+                deadline = time.monotonic() + 20
+                while time.monotonic() < deadline:
+                    h = await c.get_health(detail=True)
+                    if not ({"PG_BACKFILL_FULL", "OSD_BACKFILLFULL"}
+                            & set(h.get("checks") or {})):
+                        cleared = True
+                        break
+                    await asyncio.sleep(0.1)
+                assert cleared, "backfill never resumed after the free"
+                for oid, want in blobs.items():
+                    assert bytes(await c.get(pool, oid)) == want
+                await c.stop()
+            finally:
+                cluster.conf["osd_debug_inject_full"] = ""
+                await cluster.stop()
+
+        run(go())
+
+    def test_osd_df_aggregated_and_fallback(self):
+        async def go():
+            cluster = Cluster(n_osds=3, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("dfp", profile=PROFILE)
+                await c.put(pool, "x", os.urandom(10_000))
+                cluster.conf["osd_debug_inject_full"] = "1:0.87"
+                deadline = time.monotonic() + 10
+                rows = {}
+                while time.monotonic() < deadline:
+                    rows = await c.osd_df()
+                    if rows.get(1, {}).get("state") == "nearfull":
+                        break
+                    await asyncio.sleep(0.1)
+                assert rows[1]["state"] == "nearfull"
+                assert rows[1]["total"] > 0
+                assert 0.86 <= rows[1]["ratio"] <= 0.88
+                # the statfs op itself reports the uniform shape + store
+                st = await c.osd_statfs(sorted(cluster.osds)[0])
+                assert UNIFORM <= set(st) and "store" in st
+                # rendering: %USE column + the highlighted state
+                from ceph_tpu.tools.ceph import render_osd_df
+
+                lines = render_osd_df(
+                    [{"id": k, **v} for k, v in sorted(rows.items())],
+                    c.osdmap)
+                assert any("%USE" in ln for ln in lines)
+                assert any("nearfull" in ln for ln in lines)
+                assert any("ratios: nearfull" in ln for ln in lines)
+                # fallback: a mon without osd_utilization (old mon) ->
+                # direct per-OSD statfs polling still answers
+                real_get_health = c.get_health
+
+                async def old_mon_health(detail=False):
+                    h = await real_get_health(detail=detail)
+                    h.pop("osd_utilization", None)
+                    return h
+
+                c.get_health = old_mon_health
+                rows2 = await c.osd_df()
+                assert set(rows2) == set(cluster.osds)
+                assert all("ratio" in r for r in rows2.values()
+                           if r.get("up"))
+                await c.stop()
+            finally:
+                cluster.conf["osd_debug_inject_full"] = ""
+                await cluster.stop()
+
+        run(go())
+
+
+# -- mgr metrics --------------------------------------------------------------
+
+
+class TestMgrFullnessMetrics:
+    def test_prometheus_renders_utilization(self):
+        from ceph_tpu.mgr.daemon import MgrDaemon
+
+        mgr = MgrDaemon({})
+        mgr.latest_health = {
+            "status": "HEALTH_WARN",
+            "checks": {"OSD_NEARFULL": {"severity": "warning",
+                                        "count": 1}},
+            "osd_utilization": {
+                0: {"total": 1000, "used": 870, "avail": 130,
+                    "ratio": 0.87, "state": "nearfull",
+                    "num_objects": 3, "up": True, "weight": 1.0},
+                1: {"total": 1000, "used": 100, "avail": 900,
+                    "ratio": 0.1, "state": "", "num_objects": 1,
+                    "up": True, "weight": 1.0}}}
+        mgr._health_stamp = time.monotonic()
+        text = mgr.prometheus_text()
+        assert 'ceph_osd_utilization_ratio{osd="0"} 0.87' in text
+        assert 'ceph_osd_used_bytes{osd="0"} 870' in text
+        assert 'ceph_osd_total_bytes{osd="1"} 1000' in text
+        assert 'ceph_osd_full_state{osd="0",state="nearfull"} 1' in text
+        assert 'ceph_osd_full_state{osd="1",state="ok"} 0' in text

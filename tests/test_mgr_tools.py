@@ -236,16 +236,21 @@ class TestDencoder:
 
 class TestOsdDf:
     def test_osd_df_reports_store_utilization(self, tmp_path, capsys):
-        """`ceph osd df` (reference role): per-OSD statfs fan-out —
-        BlueStore reports sizes, down OSDs report down."""
+        """`ceph osd df` (reference role): utilization from the MON's
+        aggregated view (statfs rides the liveness pings — one query,
+        not an N-OSD statfs fan-out); down OSDs render down."""
         import json as _json
+        import time as _time
 
         async def go():
             from ceph_tpu.rados.vstart import Cluster
             from ceph_tpu.tools.ceph import parse_args
             from ceph_tpu.tools.ceph import run as ceph_run
 
-            cluster = Cluster(n_osds=3, conf={"osd_auto_repair": False},
+            cluster = Cluster(n_osds=3,
+                              conf={"osd_auto_repair": False,
+                                    "osd_heartbeat_interval": 0.1,
+                                    "mon_osd_report_grace": 1.0},
                               data_dir=str(tmp_path))
             await cluster.start()
             try:
@@ -254,27 +259,43 @@ class TestOsdDf:
                 await c.put(pool, "obj", b"x" * 100_000)
                 mon = f"{cluster.mons[0].addr[0]}:" \
                       f"{cluster.mons[0].addr[1]}"
-                capsys.readouterr()
-                rc = await ceph_run(parse_args(
-                    ["--mon", mon, "--format", "json", "osd", "df"]))
-                assert rc == 0
-                rows = _json.loads(capsys.readouterr().out)
-                assert len(rows) == 3
-                assert all(r["status"] == "up" for r in rows)
-                assert all(r["store"] == "BlueStore" for r in rows)
+
+                async def df_rows():
+                    capsys.readouterr()
+                    rc = await ceph_run(parse_args(
+                        ["--mon", mon, "--format", "json", "osd", "df"]))
+                    assert rc == 0
+                    return _json.loads(capsys.readouterr().out)
+
+                # the mon's view fills on the ping cadence: poll until
                 # the replicated object's bytes show up as usage
+                rows = []
+                deadline = _time.monotonic() + 10
+                while _time.monotonic() < deadline:
+                    rows = await df_rows()
+                    if sum(r.get("used", 0) for r in rows) >= 100_000 \
+                            and all(r.get("num_objects", 0) >= 1
+                                    for r in rows):
+                        break
+                    await asyncio.sleep(0.1)
+                assert len(rows) == 3
+                assert all(r["up"] for r in rows)
                 assert sum(r.get("used", 0) for r in rows) >= 100_000
                 assert all(r.get("num_objects", 0) >= 1 for r in rows)
-                # a down OSD reports down instead of erroring the sweep
+                # no capacity configured: unlimited, never a state
+                assert all(r.get("total", 0) == 0 for r in rows)
+                assert all(not r.get("state") for r in rows)
+                # a down OSD renders down instead of erroring the sweep
                 victim = sorted(cluster.osds)[0]
                 await cluster.kill_osd(victim)
-                capsys.readouterr()
-                rc = await ceph_run(parse_args(
-                    ["--mon", mon, "--format", "json", "osd", "df"]))
-                rows = _json.loads(capsys.readouterr().out)
-                by_id = {r["id"]: r for r in rows}
-                assert by_id[victim]["status"].startswith(
-                    ("down", "error"))
+                deadline = _time.monotonic() + 10
+                while _time.monotonic() < deadline:
+                    rows = await df_rows()
+                    by_id = {r["id"]: r for r in rows}
+                    if not by_id[victim]["up"]:
+                        break
+                    await asyncio.sleep(0.1)
+                assert not by_id[victim]["up"]
                 await c.stop()
             finally:
                 await cluster.stop()
