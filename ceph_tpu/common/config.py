@@ -123,8 +123,10 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
     # messenger (global.yaml.in:1240-1265)
     Option("ms_inject_socket_failures", OPT_INT, 0, level=LEVEL_DEV),
     Option("ms_inject_delay_max", OPT_SECS, 0.0, level=LEVEL_DEV),
-    Option("ms_inject_internal_delays", OPT_SECS, 0.0, level=LEVEL_DEV),
     Option("ms_crc_data", OPT_BOOL, True),
+    Option("ms_local_fastpath", OPT_BOOL, False,
+           desc="colocated vstart daemons skip the wire for same-process "
+                "peers (implies ms_colocated_ring unless set explicitly)"),
     Option("ms_compress_min_size", OPT_SIZE, 0,
            desc="compress frames >= this size; 0 disables on-wire compression"),
     Option("ms_dispatch_throttle_bytes", OPT_SIZE, 100 << 20),
@@ -148,13 +150,103 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
     Option("ms_colocated_ring", OPT_BOOL, False,
            desc="negotiate a zero-serialization in-process ring with "
                 "colocated peers at connect time (falls back to TCP)"),
+    # auth (reference auth_supported / cephx ticket lifetime)
+    Option("auth_cephx", OPT_BOOL, False,
+           desc="require cephx-style ticket auth on daemon connections"),
+    Option("auth_ticket_ttl", OPT_SECS, 3600.0,
+           desc="service-ticket lifetime the mon seals into tickets"),
+    # client / objecter (reference objecter_timeout, rados_osd_op_timeout)
+    Option("client_name", OPT_STR, "",
+           desc="entity name stamped on MOSDOp ops (QoS tenant identity; "
+                "empty = anonymous, riding the pool default profile)"),
+    Option("client_op_timeout", OPT_SECS, 10.0,
+           desc="per-attempt op timeout before the client retargets"),
+    Option("client_op_deadline", OPT_SECS, 0.0,
+           desc="overall op deadline across retries (0 = retry forever)"),
+    Option("client_backoff_base", OPT_SECS, 0.1,
+           desc="first retry delay for retryable op errors"),
+    Option("client_backoff_cap", OPT_SECS, 2.0,
+           desc="retry delay ceiling (exponential backoff cap)"),
+    Option("client_backoff_park_max", OPT_SECS, 3.0,
+           desc="default park ceiling for an MOSDBackoff block whose "
+                "unblock is lost (the server's duration wins when set)"),
+    Option("client_linger_poll", OPT_SECS, 1.0,
+           desc="watch re-register / linger ping cadence"),
+    # mgr (reference mgr module tick / target per-PG object count)
+    Option("mgr_addr", OPT_STR, "",
+           desc="host:port the mgr's metrics endpoint binds (daemons "
+                "learn it via the centralized config)"),
+    Option("mgr_balancer", OPT_BOOL, False,
+           desc="enable the upmap balancer module"),
+    Option("mgr_pg_autoscaler", OPT_BOOL, False,
+           desc="enable the pg_num autoscaler module"),
+    Option("mgr_module_interval", OPT_SECS, 5.0,
+           desc="mgr module tick cadence (balancer/autoscaler)"),
+    Option("mgr_health_interval", OPT_SECS, 1.0,
+           desc="mgr health-poll cadence against the mon"),
+    Option("mgr_target_objects_per_pg", OPT_INT, 32,
+           desc="autoscaler split threshold, objects per PG"),
+    # mon (reference mon_osd_min_down_reporters / reporter grace)
+    Option("mon_osd_report_grace", OPT_SECS, 1.5,
+           desc="seconds without a ping before the mon marks an OSD down"),
+    Option("mon_osd_min_down_reporters", OPT_INT, 1,
+           desc="distinct OSD failure reports required before the mon "
+                "marks the target down ahead of its own grace"),
+    Option("crush_num_hosts", OPT_INT, 0,
+           desc="vstart: spread OSDs over this many synthetic hosts in "
+                "the crush map (0 = flat osd-level map)"),
+    Option("admin_socket_dir", OPT_STR, "", flags=(FLAG_STARTUP,),
+           desc="directory for per-daemon asok sockets; empty disables "
+                "the admin socket"),
     # osd
     Option("osd_heartbeat_interval", OPT_SECS, 0.3),
     Option("osd_heartbeat_grace", OPT_SECS, 2.0),
     Option("osd_auto_repair", OPT_BOOL, True),
     Option("osd_repair_delay", OPT_SECS, 0.5),
+    Option("osd_repair_full_sweep", OPT_BOOL, True,
+           desc="repair re-peers with a forced backfill sweep (full "
+                "listing) instead of log-only recovery"),
     Option("osd_op_num_shards", OPT_INT, 4),
     Option("osd_op_queue", OPT_STR, "wpq", enum_values=("wpq", "mclock")),
+    Option("osd_pg_op_concurrency", OPT_INT, 4,
+           desc="per-PG chain width: ops on one PG beyond this queue"),
+    Option("osd_min_pg_log_entries", OPT_INT, 500,
+           desc="PG log tail retained past the last-complete horizon"),
+    Option("osd_max_backfills", OPT_INT, 4,
+           desc="concurrent backfill reservations an OSD grants (the "
+                "AsyncReserver slot count)"),
+    Option("osd_backfill_reserve_lease", OPT_SECS, 300.0,
+           desc="remote backfill reservation auto-expiry (a primary that "
+                "died holding a slot cannot wedge the target forever)"),
+    Option("osd_recovery_retry", OPT_SECS, 1.0,
+           desc="retry cadence for recovery steps parked on missing "
+                "peers or reservations"),
+    Option("osd_backoff_secs", OPT_SECS, 0.5,
+           desc="base MOSDBackoff block duration for a busy PG"),
+    Option("osd_backoff_max", OPT_SECS, 3.0,
+           desc="MOSDBackoff block duration ceiling under escalation"),
+    Option("osd_deep_scrub_interval", OPT_SECS, 3600.0,
+           desc="auto deep-scrub cadence per PG (osd_scrub_auto)"),
+    Option("osd_auto_revert_unfound", OPT_BOOL, True,
+           desc="auto-revert objects confirmed unfound to their rollback "
+                "version (mark_unfound_lost revert role)"),
+    Option("osd_unfound_revert_grace", OPT_SECS, 30.0,
+           desc="how long an object must stay unfound (over complete "
+                "listings) before auto-revert"),
+    # EC device service (ceph_tpu/parallel seams)
+    Option("osd_ec_stripe_unit", OPT_SIZE, 4096,
+           desc="per-chunk stripe unit EC pools default to"),
+    Option("osd_ec_batching", OPT_BOOL, True,
+           desc="route codec work through the process-shared "
+                "BatchingQueue (device dispatch coalescing)"),
+    Option("osd_ec_dispatch_timeout", OPT_SECS, 0.0,
+           desc="BatchingQueue device-dispatch watchdog (0 disables); "
+                "trips the circuit breaker on a wedged device"),
+    Option("osd_ec_planar_residency", OPT_BOOL, True,
+           desc="keep encoded shard rows planar-resident on the device "
+                "(PlanarShardStore cache tier)"),
+    Option("osd_ec_planar_bytes", OPT_SIZE, 0,
+           desc="planar residency byte budget (0 = store default)"),
     # multi-tenant QoS (reference mClockScheduler client profiles; pool
     # opts qos_reservation/qos_weight/qos_limit + qos_class:<name>
     # override these cluster defaults per pool)
@@ -229,10 +321,11 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
                 "of the target"),
     Option("osd_tier_agent_interval", OPT_SECS, 0.5,
            desc="tier agent due-scan cadence (0 disables the agent)"),
-    Option("osd_debug_inject_read_err", OPT_BOOL, False, level=LEVEL_DEV),
-    Option("osd_debug_inject_dispatch_delay_probability", OPT_FLOAT, 0.0,
-           level=LEVEL_DEV),
-    Option("osd_debug_inject_dispatch_delay_duration", OPT_SECS, 0.1,
+    # the one name the OSD actually reads (the old *_probability/
+    # *_duration pair was never consumed — a lint dead-option finding):
+    # seconds every BatchingQueue device dispatch sleeps, aging in-flight
+    # ops past the SLOW_OPS complaint threshold in CI
+    Option("osd_debug_inject_dispatch_delay", OPT_SECS, 0.0,
            level=LEVEL_DEV),
     # capacity / fullness plane (reference mon_osd_nearfull_ratio /
     # backfillfull / full ratios in the OSDMap + osd_failsafe_full_ratio;
@@ -275,10 +368,18 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
     Option("bluestore_debug_inject_csum_err_probability", OPT_FLOAT, 0.0,
            level=LEVEL_DEV),
     Option("bluestore_prefer_deferred_size", OPT_SIZE, 32768),
+    # on-disk compression (reference bluestore_compression_* options;
+    # per-pool compression_* opts override these store-wide defaults)
+    Option("bluestore_compression_mode", OPT_STR, "none",
+           enum_values=("none", "passive", "aggressive", "force")),
+    Option("bluestore_compression_algorithm", OPT_STR, "zlib"),
+    Option("bluestore_compression_min_blob_size", OPT_SIZE, 4096),
+    Option("bluestore_compression_required_ratio", OPT_FLOAT, 0.875,
+           desc="keep the compressed blob only when it shrinks to at "
+                "most this fraction of the raw bytes"),
     # mon
     Option("mon_lease", OPT_SECS, 5.0),
     Option("mon_election_timeout", OPT_SECS, 1.0),
-    Option("paxos_propose_interval", OPT_SECS, 0.05),
     # logging (src/common/dout.h per-subsys levels; all RUNTIME-mutable —
     # `ceph tell <daemon> config set debug_ms 10` / asok `config set` is
     # the live-diagnosis workflow, the Log level cache invalidates via a

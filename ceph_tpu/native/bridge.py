@@ -21,28 +21,65 @@ _LIB = os.path.join(_BUILD, "libceph_tpu_ec.so")
 
 _lib: Optional[ctypes.CDLL] = None
 
+# the tree compiles warning-clean and must stay that way (CMake enforces
+# the same set via CEPH_TPU_WERROR, ON by default).  The env
+# CEPH_TPU_NATIVE_WERROR=0 drops -Werror only — the escape hatch for a
+# future compiler whose new warning class would otherwise brick lib()'s
+# on-demand build (CMake users have -DCEPH_TPU_WERROR=OFF).
+WARN_FLAGS = ["-Wall", "-Wextra"] + (
+    ["-Werror"] if os.environ.get("CEPH_TPU_NATIVE_WERROR") != "0" else [])
 
-def build(force: bool = False) -> str:
+# ASan/UBSan build flavor (CMake: -DCEPH_TPU_SANITIZE=ON, or the env
+# CEPH_TPU_NATIVE_SANITIZE=1; tests/test_native.py's slow sanitize leg
+# reuses exactly this flag set).  UBSan is -fno-sanitize-recover so the
+# first finding aborts the process instead of scrolling past in a log.
+SANITIZE_FLAGS = ["-fsanitize=address,undefined",
+                  "-fno-sanitize-recover=all",
+                  "-fno-omit-frame-pointer", "-g"]
+
+_LIB_SRCS = ("gf256.cc", "rs.cc", "registry.cc", "capi.cc", "crc32c.cc")
+
+
+def build(force: bool = False, sanitize: Optional[bool] = None) -> str:
     """Compile the native library (idempotent; rebuilds when any source
     is newer than the .so, so an old build can never miss symbols the
-    bridge expects)."""
-    srcs = [os.path.join(_NATIVE, f)
-            for f in ("gf256.cc", "rs.cc", "registry.cc", "capi.cc",
-                      "crc32c.cc")]
-    if os.path.exists(_LIB) and not force:
-        lib_mtime = os.path.getmtime(_LIB)
+    bridge expects).
+
+    ``sanitize`` (default: the CEPH_TPU_NATIVE_SANITIZE=1 env) emits an
+    ASan/UBSan flavor into build/sanitize/ — a SEPARATE artifact,
+    because an asan .so cannot be dlopen'd into a plain python process
+    (the asan runtime must be first in the initial library list);
+    ``lib()`` below only ever loads the plain build.
+    """
+    if sanitize is None:
+        sanitize = os.environ.get("CEPH_TPU_NATIVE_SANITIZE") == "1"
+    srcs = [os.path.join(_NATIVE, f) for f in _LIB_SRCS]
+    out = os.path.join(_BUILD, "sanitize", "libceph_tpu_ec.so") \
+        if sanitize else _LIB
+    if os.path.exists(out) and not force:
+        lib_mtime = os.path.getmtime(out)
         hdrs = [os.path.join(_NATIVE, f)
                 for f in ("gf256.h", "rs.h", "ec_api.h", "plugin_common.h")]
         if all(os.path.getmtime(s) <= lib_mtime
                for s in srcs + hdrs if os.path.exists(s)):
-            return _LIB
-    os.makedirs(_BUILD, exist_ok=True)
+            return out
+    os.makedirs(os.path.dirname(out), exist_ok=True)
     cmd = [
         "g++", "-std=c++17", "-O3", "-march=native", "-fPIC", "-shared",
-        "-o", _LIB, *srcs, "-ldl", "-pthread",
+        *WARN_FLAGS, *(SANITIZE_FLAGS if sanitize else []),
+        "-o", out, *srcs, "-ldl", "-pthread",
     ]
-    subprocess.run(cmd, check=True, capture_output=True)
-    return _LIB
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+    except subprocess.CalledProcessError as e:
+        # surface the compiler diagnostics (capture_output would swallow
+        # them) and the -Werror escape hatch
+        raise RuntimeError(
+            f"native build failed (rc {e.returncode}); if these are "
+            f"warnings from a newer compiler, set "
+            f"CEPH_TPU_NATIVE_WERROR=0:\n"
+            f"{(e.stderr or b'').decode(errors='replace')}") from e
+    return out
 
 
 def lib() -> ctypes.CDLL:
@@ -50,12 +87,13 @@ def lib() -> ctypes.CDLL:
     if _lib is None:
         # configure on a LOCAL before publishing: a failure mid-setup
         # (e.g. a stale .so missing a symbol) must not leave a
-        # half-configured CDLL behind for the next caller
-        _local = ctypes.CDLL(build())
+        # half-configured CDLL behind for the next caller.  Always the
+        # plain flavor — see build() on why sanitize cannot load here.
+        _local = ctypes.CDLL(build(sanitize=False))
         try:
             _configure(_local)
         except AttributeError:
-            _local = ctypes.CDLL(build(force=True))
+            _local = ctypes.CDLL(build(force=True, sanitize=False))
             _configure(_local)
         _lib = _local
     return _lib

@@ -1805,6 +1805,14 @@ class Monitor:
         if self.osdmap.pool_by_name(msg.name) is not None:
             return MCreatePoolReply(ok=False, error=f"pool {msg.name} exists")
         profile = dict(msg.profile)
+        if msg.pool_type == "ec" and not profile:
+            # profile-less `osd pool create NAME erasure` rides the
+            # cluster default (reference osd_pool_default_erasure_code_
+            # profile; same space-separated k=v encoding as the option)
+            default = str(self.conf.get(
+                "osd_pool_default_erasure_code_profile", "") or "")
+            profile = dict(kv.split("=", 1)
+                           for kv in default.split() if "=" in kv)
         if msg.pool_type == "ec":
             plugin = profile.get("plugin", "jerasure")
             try:

@@ -4,8 +4,16 @@ src/test/encoding/readable.sh: every archived past version must stay
 decodable, so an accidental field rename / layout change is caught the
 round it happens, not at the first mixed-version cluster).
 
-    python -m ceph_tpu.tools.wire_corpus --create   # archive current
-    python -m ceph_tpu.tools.wire_corpus --check    # replay archive
+    python -m ceph_tpu.tools.wire_corpus --create          # archive current
+    python -m ceph_tpu.tools.wire_corpus --check           # replay archive
+    python -m ceph_tpu.tools.wire_corpus --check --strict  # + coverage walk
+
+``--strict`` additionally fails on any FIXED message type missing
+corpus coverage (no archived frame), dencoder coverage (its fixed codec
+must round-trip a default instance), or — for versioned (v2+) types — a
+golden old-build frame under corpus/wire/golden.  The walk lives in
+``coverage_gaps()`` so the tpu-lint wire-ABI family reuses the SAME
+implementation (one source of truth for what "covered" means).
 
 Each archived frame is a self-contained binary file:
 
@@ -317,15 +325,126 @@ def check(directory: str = CORPUS_DIR) -> int:
     return 0
 
 
+class CoverageGap:
+    """One FIXED message type missing one leg of its safety net."""
+
+    __slots__ = ("type_name", "kind", "file", "line", "message")
+
+    def __init__(self, type_name: str, kind: str, file: str, line: int,
+                 message: str):
+        self.type_name = type_name
+        self.kind = kind  # "corpus" | "dencoder" | "golden"
+        self.file = file
+        self.line = line
+        self.message = message
+
+
+def _decl_site(cls) -> Tuple[str, int]:
+    """(repo-relative file, line) a message class is declared at."""
+    import inspect
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        src = inspect.getsourcefile(cls)
+        _, line = inspect.getsourcelines(cls)
+        return os.path.relpath(src, repo), line
+    except (OSError, TypeError):
+        return "corpus/wire", 1
+
+
+def fixed_types() -> Dict[int, type]:
+    """Registered message types with a FIXED binary layout (the
+    data-plane set whose bytes the corpus pins).  Scoped to classes
+    declared inside the ceph_tpu package: tests register fixture
+    messages into the same process-global registry, and those are not
+    wire ABI."""
+    import ceph_tpu.mgr.daemon  # noqa: F401 — registers mgr types
+    import ceph_tpu.rados.types  # noqa: F401 — registers the core set
+    from ceph_tpu.rados.messenger import _MSG_TYPES
+
+    return {tid: cls for tid, cls in _MSG_TYPES.items()
+            if getattr(cls, "FIXED_FIELDS", None) is not None
+            and cls.__module__.startswith("ceph_tpu.")}
+
+
+def coverage_gaps(directory: str = CORPUS_DIR) -> List[CoverageGap]:
+    """The coverage walk ``--strict`` and tpu-lint share: every FIXED
+    type needs an archived frame, a dencoder round-trip, and (when
+    versioned) a golden old-build frame."""
+    from ceph_tpu.rados.messenger import decode_message, \
+        encode_payload_parts
+
+    gaps: List[CoverageGap] = []
+    frames = set(os.listdir(directory)) if os.path.isdir(directory) \
+        else set()
+    golden_dir = os.path.join(directory, "golden")
+    golden = set(os.listdir(golden_dir)) if os.path.isdir(golden_dir) \
+        else set()
+    for tid, cls in sorted(fixed_types().items()):
+        name = cls.__name__
+        file, line = _decl_site(cls)
+        if not any(f == f"{name}.frame"
+                   or (f.startswith(f"{name}.alt") and f.endswith(".frame"))
+                   for f in frames):
+            gaps.append(CoverageGap(
+                name, "corpus", file, line,
+                f"FIXED message {name} (id {tid}) has no archived frame "
+                f"in corpus/wire — run `wire_corpus --create` after "
+                f"adding it to _sample_messages()"))
+        try:
+            msg = cls()
+            payload, blob, fixed = encode_payload_parts(msg)
+            back = decode_message(
+                tid, cls.VERSION, payload,
+                None if blob is None else bytes(blob), fixed)
+            if {k: _norm(v) for k, v in back.__dict__.items()} \
+                    != {k: _norm(v) for k, v in msg.__dict__.items()}:
+                raise ValueError("default instance did not round-trip "
+                                 "field-identically")
+        except Exception as e:
+            gaps.append(CoverageGap(
+                name, "dencoder", file, line,
+                f"FIXED message {name} fails the dencoder round-trip: "
+                f"{type(e).__name__}: {e}"))
+        if cls.VERSION >= 2 and not any(
+                f.startswith(f"{name}.") and f.endswith(".frame")
+                for f in golden):
+            gaps.append(CoverageGap(
+                name, "golden", file, line,
+                f"FIXED message {name} is v{cls.VERSION} but has no "
+                f"golden old-build frame under corpus/wire/golden — "
+                f"archive a pre-bump frame so the truncated-tail decode "
+                f"rule stays replay-guarded"))
+    return gaps
+
+
+def check_strict(directory: str = CORPUS_DIR) -> int:
+    gaps = coverage_gaps(directory)
+    for g in gaps:
+        print(f"FAIL {g.file}:{g.line}: [{g.kind}] {g.message}",
+              file=sys.stderr)
+    if not gaps:
+        print(f"{len(fixed_types())} FIXED types fully covered "
+              f"(corpus + dencoder + golden where versioned)")
+    return 1 if gaps else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description="wire-format corpus")
     p.add_argument("--create", action="store_true")
     p.add_argument("--check", action="store_true")
+    p.add_argument("--strict", action="store_true",
+                   help="with --check: also fail on FIXED types missing "
+                        "corpus/dencoder/golden coverage")
     p.add_argument("--dir", default=CORPUS_DIR)
     args = p.parse_args(argv)
     if args.create:
         return create(args.dir)
-    return check(args.dir)
+    rc = check(args.dir)
+    if args.strict:
+        rc = check_strict(args.dir) or rc
+    return rc
 
 
 if __name__ == "__main__":

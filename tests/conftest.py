@@ -6,6 +6,14 @@ __graft_entry__.dryrun_multichip).  Must run before jax initializes."""
 
 import os
 
+# Run the whole suite with runtime lockdep armed (common/lockdep.py):
+# every make_mutex/make_async_mutex lock joins the global order graph and
+# an ABBA inversion raises LockOrderError the first time the ORDER is
+# violated, not the run the threads actually deadlock.  setdefault, so
+# perf-sensitive invocations opt out with CEPH_TPU_LOCKDEP=0 (and tests
+# that measure hot-path latency can monkeypatch lockdep.disable()).
+os.environ.setdefault("CEPH_TPU_LOCKDEP", "1")
+
 # Hard-set (not setdefault): the container env pins JAX_PLATFORMS=axon for
 # the real-TPU bench path; tests must never depend on the TPU tunnel.
 # NOTE this does not fully banish the accelerator on hosts whose
@@ -22,3 +30,10 @@ os.environ.setdefault("CEPH_TPU_PROBE_TIMEOUT", "300")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m "not slow"`; register the marker so slow legs
+    # (e.g. the sanitized native rebuild) don't warn as unknown
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 fast suite")

@@ -23,6 +23,24 @@ class TestConfig:
         assert conf.get("osd_pool_erasure_code_stripe_unit") == 4096
         assert conf.get("ms_crc_data") is True
 
+    def test_schema_names_match_what_daemons_read(self):
+        """Regression pin for a lint registry finding: the schema once
+        declared osd_debug_inject_dispatch_delay_{probability,duration}
+        while osd.py read `osd_debug_inject_dispatch_delay` — the typed
+        declaration was dead and the consumed key rode the untyped
+        passthrough.  The one real name must be schema'd (typed OPT_SECS,
+        so `config set ... 250ms` parses) and the dead pair gone."""
+        from ceph_tpu.common.config import DEFAULT_SCHEMA
+
+        assert "osd_debug_inject_dispatch_delay" in DEFAULT_SCHEMA
+        assert "osd_debug_inject_dispatch_delay_probability" \
+            not in DEFAULT_SCHEMA
+        assert "osd_debug_inject_dispatch_delay_duration" \
+            not in DEFAULT_SCHEMA
+        conf = Config()
+        conf.set("osd_debug_inject_dispatch_delay", "250ms")
+        assert conf.get("osd_debug_inject_dispatch_delay") == 0.25
+
     def test_typed_parse_size_and_secs(self):
         conf = Config()
         conf.set("osd_pool_erasure_code_stripe_unit", "64K")

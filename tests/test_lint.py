@@ -1,0 +1,468 @@
+"""tpu-lint: the tier-1 static-analysis gate plus deliberate fixture
+violations proving each checker family actually fires.
+
+The gate half runs the full suite over ceph_tpu/ exactly as CI does:
+zero findings (or, if the tree ever needs one, a baselined finding with
+a committed one-line justification).  The fixture half feeds each family
+a doctored source — a non-append FIXED field insert, a lock held across
+an await, an unknown config key, a missing corpus entry — and asserts
+the specific finding, so a checker that silently stops firing fails
+here, not in the field."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from ceph_tpu.tools.lint import (BASELINE_PATH, WIRE_LOCK_PATH, LintReport,
+                                 run_lint)
+from ceph_tpu.tools.lint import async_safety, codec, registry, wire_abi
+from ceph_tpu.tools.lint.findings import Baseline, BaselineEntry, Finding
+
+
+def _checks(findings):
+    return {f.check for f in findings}
+
+
+# -- the tier-1 gate ---------------------------------------------------------
+
+
+def test_shipped_tree_is_clean():
+    """The whole point: `python -m ceph_tpu.tools.lint` must exit 0 on
+    the shipped tree — every finding fixed or baselined-with-reason."""
+    report = run_lint()
+    assert report.files_scanned > 50
+    assert report.findings == [], "\n".join(
+        f.render() for f in report.findings)
+
+
+def test_cli_exit_status_and_json():
+    out = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.tools.lint", "--json"],
+        capture_output=True, cwd=REPO, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stdout.decode() + out.stderr.decode()
+    doc = json.loads(out.stdout)
+    assert doc["ok"] is True
+    assert doc["findings"] == []
+
+
+def test_wire_lockfile_is_committed_and_current():
+    """ABI.lock must exist AND match the tree (a layout change without
+    --update-wire-lock fails the wire-abi family above; this pins the
+    reverse — a stale lockfile regenerates byte-identically)."""
+    assert os.path.exists(WIRE_LOCK_PATH)
+    sources = []
+    for rel in wire_abi.WIRE_SOURCES:
+        with open(os.path.join(REPO, rel), encoding="utf-8") as fh:
+            sources.append((rel, fh.read()))
+    current = wire_abi.make_lock(wire_abi.extract(sources))
+    with open(WIRE_LOCK_PATH, encoding="utf-8") as fh:
+        committed = json.load(fh)
+    assert current["messages"] == committed["messages"]
+
+
+# -- wire-abi fixtures (doctored types.py vs the REAL lockfile) --------------
+
+
+def _types_sources(mutate):
+    sources = []
+    for rel in wire_abi.WIRE_SOURCES:
+        with open(os.path.join(REPO, rel), encoding="utf-8") as fh:
+            text = fh.read()
+        if rel.endswith("types.py"):
+            text = mutate(text)
+        sources.append((rel, text))
+    return sources
+
+
+def _wire_check(sources):
+    return wire_abi.check(REPO, lock_path=WIRE_LOCK_PATH, sources=sources,
+                          coverage=False)
+
+
+def test_wire_abi_clean_on_real_sources():
+    assert _wire_check(_types_sources(lambda t: t)) == []
+
+
+def test_wire_abi_catches_field_reorder():
+    """Swapping two FIXED fields of MECSubWrite (a layout reorder an
+    innocent refactor could make) must fail the append-only rule."""
+    def mutate(text):
+        needle = '("pool_id", "q"), ("pg", "q"), ("from_osd", "q"), ("epoch", "q"),'
+        assert needle in text
+        return text.replace(
+            needle,
+            '("pg", "q"), ("pool_id", "q"), ("from_osd", "q"), ("epoch", "q"),')
+
+    findings = _wire_check(_types_sources(mutate))
+    assert any(f.check == "wire-abi/layout-break" and f.key == "MECSubWrite"
+               for f in findings), findings
+
+
+def test_wire_abi_catches_field_removal():
+    def mutate(text):
+        needle = '("snap_read", "Q"), ("snap_id", "Q"),'
+        assert needle in text
+        return text.replace(needle, '("snap_id", "Q"),')
+
+    findings = _wire_check(_types_sources(mutate))
+    assert any(f.check == "wire-abi/layout-break" and f.key == "MOSDOp"
+               for f in findings), findings
+
+
+def test_wire_abi_catches_tail_without_version_bump():
+    """Appending a field is LEGAL — but only with a version bump, or old
+    decoders can't know the tail may be truncated."""
+    def mutate(text):
+        needle = '    ("gseq", "Q"),\n]\n# a compound op vector'
+        assert needle in text
+        return text.replace(
+            needle, '    ("gseq", "Q"),\n    ("sneaky", "Q"),\n]\n'
+                    '# a compound op vector')
+
+    findings = _wire_check(_types_sources(mutate))
+    assert any(f.check == "wire-abi/tail-without-version-bump"
+               and f.key == "MOSDOp" for f in findings), findings
+    # the same append WITH a bump (and a field default) is clean
+    def mutate_ok(text):
+        text = mutate(text)
+        text = text.replace("@message(20, version=7)",
+                            "@message(20, version=8)")
+        return text.replace("    gseq: int = 0\n\n\n@message(21",
+                            "    gseq: int = 0\n    sneaky: int = 0\n\n\n"
+                            "@message(21")
+
+    findings = _wire_check(_types_sources(mutate_ok))
+    assert not any(f.key == "MOSDOp" for f in findings), findings
+
+
+def test_wire_abi_catches_duplicate_and_changed_id():
+    findings = _wire_check(_types_sources(
+        lambda t: t.replace("@message(48)", "@message(47)")))
+    assert any(f.check == "wire-abi/duplicate-id" for f in findings)
+    # MNotifyAck also no longer matches its locked id 48
+    assert any(f.check == "wire-abi/id-changed" and f.key == "MNotifyAck"
+               for f in findings)
+
+
+def test_wire_abi_catches_message_removal_and_unlocked_addition():
+    def drop_mping(text):
+        return text.replace("@message(17)\nclass MOSDPing:",
+                            "class MOSDPing:")
+
+    findings = _wire_check(_types_sources(drop_mping))
+    assert any(f.check == "wire-abi/removed" and f.key == "MOSDPing"
+               for f in findings), findings
+
+    def add_new(text):
+        return text + ("\n\n@message(9999)\nclass MBrandNew:\n"
+                       "    tid: str = \"\"\n")
+
+    findings = _wire_check(_types_sources(add_new))
+    assert any(f.check == "wire-abi/unlocked" and f.key == "MBrandNew"
+               for f in findings), findings
+
+
+def test_wire_abi_missing_corpus_entry(tmp_path):
+    """Coverage walk: an empty corpus dir means every FIXED type reports
+    a missing archived frame (and versioned ones a missing golden)."""
+    from ceph_tpu.tools import wire_corpus
+
+    gaps = wire_corpus.coverage_gaps(str(tmp_path))
+    kinds = {(g.type_name, g.kind) for g in gaps}
+    assert ("MOSDOp", "corpus") in kinds
+    assert ("MOSDOp", "golden") in kinds  # v7: golden required
+    assert ("MLaneHello", "corpus") in kinds
+    assert ("MLaneHello", "golden") not in kinds  # v1: no golden needed
+    # the real corpus has no gaps (also exercised by --strict in CI)
+    assert wire_corpus.coverage_gaps() == []
+    # and the lint surfaces the same walk as findings
+    findings = wire_abi.check(REPO, lock_path=WIRE_LOCK_PATH,
+                              corpus_dir=str(tmp_path))
+    assert any(f.check == "wire-abi/coverage"
+               and f.key == "MOSDOp:corpus" for f in findings)
+
+
+def test_wire_corpus_strict_cli(tmp_path):
+    from ceph_tpu.tools import wire_corpus
+
+    assert wire_corpus.check_strict() == 0
+    assert wire_corpus.check_strict(str(tmp_path)) == 1
+
+
+# -- async-safety fixtures ---------------------------------------------------
+
+
+def _async_findings(src):
+    return async_safety.check([("fixture.py", src)])
+
+
+def test_async_catches_blocking_sleep():
+    findings = _async_findings(
+        "import time\n"
+        "async def tick():\n"
+        "    time.sleep(1.0)\n")
+    assert _checks(findings) == {"async-safety/blocking-call"}
+    # the async form is clean
+    assert _async_findings(
+        "import asyncio\n"
+        "async def tick():\n"
+        "    await asyncio.sleep(1.0)\n") == []
+    # sync functions may sleep
+    assert _async_findings(
+        "import time\n"
+        "def worker():\n"
+        "    time.sleep(1.0)\n") == []
+
+
+def test_async_catches_blocking_acquire():
+    findings = _async_findings(
+        "async def go(self):\n"
+        "    self._lock.acquire()\n")
+    assert _checks(findings) == {"async-safety/blocking-call"}
+    assert _async_findings(
+        "async def go(self):\n"
+        "    await self._alock.acquire()\n") == []
+
+
+def test_async_catches_lock_across_await():
+    findings = _async_findings(
+        "async def go(self):\n"
+        "    with self._lock:\n"
+        "        await self.flush()\n")
+    assert _checks(findings) == {"async-safety/lock-across-await"}
+    # release-before-await and non-lock contexts are clean
+    assert _async_findings(
+        "async def go(self):\n"
+        "    with self._lock:\n"
+        "        n = self.count\n"
+        "    await self.flush(n)\n") == []
+    assert _async_findings(
+        "async def go(self):\n"
+        "    with open('f') as fh:\n"
+        "        await self.flush(fh)\n") == []
+
+
+def test_async_catches_cross_loop_call():
+    findings = _async_findings(
+        "def on_thread(self, coro):\n"
+        "    self.loop.create_task(coro)\n")
+    assert _checks(findings) == {"async-safety/cross-loop-call"}
+    # the three sanctioned idioms are clean: threadsafe wrap, running
+    # loop, a local provably assigned from get_running_loop
+    assert _async_findings(
+        "def on_thread(self, coro):\n"
+        "    self.loop.call_soon_threadsafe(\n"
+        "        lambda: self.loop.create_task(coro))\n") == []
+    assert _async_findings(
+        "import asyncio\n"
+        "def sync_cb(self, coro):\n"
+        "    asyncio.get_running_loop().create_task(coro)\n") == []
+    assert _async_findings(
+        "import asyncio\n"
+        "def sync_cb(self, coro):\n"
+        "    loop = asyncio.get_running_loop()\n"
+        "    loop.create_task(coro)\n") == []
+
+
+# -- registry fixtures -------------------------------------------------------
+
+
+def test_registry_catches_unknown_config_key():
+    findings = registry.check(REPO, [(
+        "fixture.py",
+        "def f(self):\n"
+        "    return self.conf.get(\"osd_definitely_not_an_option\", 1)\n")])
+    assert any(f.check == "registry/unknown-config-key"
+               and f.key == "osd_definitely_not_an_option"
+               for f in findings), findings
+    # plain-dict .get must NOT match (the rgw `cfg` false-positive class)
+    findings = registry.check(REPO, [(
+        "fixture.py",
+        "def f(cfg):\n"
+        "    return cfg.get(\"Status\")\n")])
+    assert not any(f.check == "registry/unknown-config-key"
+                   for f in findings), findings
+
+
+def test_registry_catches_undeclared_perf_counter():
+    findings = registry.check(REPO, [(
+        "fixture.py",
+        "def f(self):\n"
+        "    self.perf.inc(\"no_such_counter_xyz\")\n")])
+    assert any(f.check == "registry/undeclared-perf-counter"
+               and f.key == "no_such_counter_xyz" for f in findings)
+
+
+def test_registry_catches_orphan_asok_renderer():
+    findings = registry.check(REPO, [(
+        os.path.join("ceph_tpu", "tools", "ceph.py"),
+        "ASOK_RENDERERS = {\"dump_ghost_cmd\": None}\n")])
+    assert any(f.check == "registry/orphan-asok-renderer"
+               and f.key == "dump_ghost_cmd" for f in findings)
+
+
+# -- codec fixtures ----------------------------------------------------------
+
+
+def test_codec_catches_struct_arity():
+    findings = codec.check([(
+        "fixture.py",
+        "import struct\n"
+        "def f(a, b):\n"
+        "    return struct.pack(\"<HH\", a, b, 3)\n")])
+    assert any(f.check == "codec/struct-arity" for f in findings)
+    assert codec.check([(
+        "fixture.py",
+        "import struct\n"
+        "HDR = struct.Struct(\"<HHBI\")\n"
+        "def f(a, b, c, d):\n"
+        "    return HDR.pack(a, b, c, d)\n")]) == []
+    findings = codec.check([(
+        "fixture.py",
+        "import struct\n"
+        "HDR = struct.Struct(\"<HHBI\")\n"
+        "def f(a, b, c):\n"
+        "    return HDR.pack(a, b, c)\n")])
+    assert any(f.check == "codec/struct-arity" for f in findings)
+
+
+def test_codec_catches_fixed_field_hygiene():
+    src = (
+        "@message(9000, version=2)\n"
+        "class MBad:\n"
+        "    a: int = 0\n"
+        "    FIXED_FIELDS = [(\"a\", \"q\"), (\"ghost\", \"s\"),\n"
+        "                    (\"a\", \"zz\")]\n")
+    findings = codec.check([], wire_sources=[("fixture.py", src)])
+    keys = {f.key for f in findings if f.check == "codec/fixed-field"}
+    assert "MBad.ghost:undeclared" in keys
+    assert "MBad.a:kind" in keys
+    # a v2 message with a default-less field breaks truncated-tail decode
+    src = (
+        "@message(9001, version=2)\n"
+        "class MNoDefault:\n"
+        "    a: int\n"
+        "    FIXED_FIELDS = [(\"a\", \"q\")]\n")
+    findings = codec.check([], wire_sources=[("fixture.py", src)])
+    assert any(f.check == "codec/fixed-tail-default" for f in findings)
+
+
+# -- baseline mechanics ------------------------------------------------------
+
+
+def test_baseline_suppresses_and_stales(tmp_path):
+    bl_path = tmp_path / "baseline.json"
+    Baseline([BaselineEntry(
+        check="registry/unknown-config-key", file="fixture.py",
+        key="osd_definitely_not_an_option",
+        reason="fixture: proving suppression works")]).save(str(bl_path))
+
+    loaded = Baseline.load(str(bl_path))
+    hit = Finding(check="registry/unknown-config-key", file="fixture.py",
+                  line=3, key="osd_definitely_not_an_option", message="x")
+    assert loaded.match(hit) == "fixture: proving suppression works"
+    # line number is NOT part of identity (edits above must not stale)
+    hit.line = 99
+    assert loaded.match(hit) is not None
+    miss = Finding(check="registry/unknown-config-key", file="other.py",
+                   line=3, key="osd_definitely_not_an_option", message="x")
+    assert loaded.match(miss) is None
+
+    # an empty reason is rejected at load
+    bl_path.write_text(json.dumps({"suppressions": [
+        {"check": "c", "file": "f", "key": "k", "reason": "  "}]}))
+    with pytest.raises(ValueError, match="justification"):
+        Baseline.load(str(bl_path))
+
+
+def test_stale_baseline_entry_is_a_finding(tmp_path):
+    """A suppression that no longer matches anything must surface on a
+    FULL run — the committed baseline can only shrink."""
+    bl_path = tmp_path / "baseline.json"
+    Baseline([BaselineEntry(
+        check="registry/unknown-config-key", file="gone.py",
+        key="long_fixed_key", reason="was fixed in r16")]).save(str(bl_path))
+    report = run_lint(baseline_path=str(bl_path), checks=("registry",))
+    assert any(f.check == "baseline/stale" for f in report.findings)
+    # ...but a --checks subset that never ran the entry's family, or a
+    # path-scoped run that never scanned its file, cannot judge it
+    # stale (they would demand removing a needed suppression)
+    report = run_lint(baseline_path=str(bl_path), checks=("codec",))
+    assert not any(f.check == "baseline/stale" for f in report.findings)
+    report = run_lint(baseline_path=str(bl_path),
+                      paths=[os.path.join(REPO, "ceph_tpu", "tools",
+                                          "lint")],
+                      checks=("registry",))
+    assert not any(f.check == "baseline/stale" for f in report.findings)
+
+
+def test_todo_baseline_reason_is_a_finding(tmp_path):
+    """--update-baseline stamps TODO reasons; leaving one in place must
+    fail CI even though the suppression itself matches."""
+    fx = tmp_path / "fixture.py"
+    fx.write_text("import struct\n"
+                  "def f(a):\n"
+                  "    return struct.pack(\"<HH\", a)\n")
+    bl_path = tmp_path / "baseline.json"
+    entry = BaselineEntry(
+        check="codec/struct-arity", file="fixture.py", key="<HH@L3",
+        reason="TODO: justify this suppression in one line")
+    Baseline([entry]).save(str(bl_path))
+    report = run_lint(root=str(tmp_path), paths=[str(fx)],
+                      checks=("codec",), baseline_path=str(bl_path))
+    assert [f.check for f in report.findings] == ["baseline/unjustified"]
+    assert [f.check for f in report.suppressed] == ["codec/struct-arity"]
+    # with a real reason the same baseline passes clean
+    entry.reason = "fixture: deliberate arity mismatch for this test"
+    Baseline([entry]).save(str(bl_path))
+    report = run_lint(root=str(tmp_path), paths=[str(fx)],
+                      checks=("codec",), baseline_path=str(bl_path))
+    assert report.findings == []
+
+
+def test_cli_nonzero_on_violations(tmp_path):
+    """The CLI contract's other half: a tree with violations exits 1."""
+    rados = tmp_path / "ceph_tpu" / "rados"
+    rados.mkdir(parents=True)
+    (rados / "types.py").write_text(
+        "@message(1)\nclass MA:\n    a: int = 0\n\n"
+        "@message(1)\nclass MB:\n    b: int = 0\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.tools.lint", "--root",
+         str(tmp_path), "--no-baseline", "--checks", "codec",
+         str(rados)],
+        capture_output=True, cwd=REPO, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    # codec family alone sees no violation in this snippet -> exit 0...
+    assert out.returncode == 0, out.stderr.decode()
+    (rados / "types.py").write_text(
+        "import struct\n"
+        "def f(a):\n"
+        "    return struct.pack(\"<HH\", a)\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.tools.lint", "--root",
+         str(tmp_path), "--no-baseline", "--checks", "codec",
+         str(rados)],
+        capture_output=True, cwd=REPO, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 1
+    assert b"struct-arity" in out.stderr
+
+
+def test_shipped_baseline_is_loadable():
+    Baseline.load(BASELINE_PATH)  # malformed/reason-less entries raise
+
+
+def test_report_json_shape():
+    report = LintReport()
+    report.findings.append(Finding(
+        check="x/y", file="f.py", line=1, key="k", message="m"))
+    doc = report.to_json()
+    assert doc["ok"] is False
+    assert doc["findings"][0]["key"] == "k"

@@ -372,6 +372,38 @@ class TestConfigMonitor:
 
         run(go())
 
+    def test_profile_less_ec_pool_rides_the_default_profile(self):
+        """Regression pin for a lint dead-option finding: the schema
+        declared osd_pool_default_erasure_code_profile but pool creation
+        never consumed it — a profile-less `osd pool create NAME
+        erasure` silently fell back to the codec's own k=2 m=1 defaults.
+        The mon must seed an empty EC profile from the option (reference
+        OSDMonitor default-profile semantics)."""
+        async def go():
+            conf = dict(FAST)
+            # k=3 m=2 is NOT the jerasure codec's own default (k=2 m=1),
+            # so the assertion below can only pass via the option
+            conf["osd_pool_default_erasure_code_profile"] = (
+                "plugin=jerasure technique=reed_sol_van k=3 m=2")
+            cluster = Cluster(n_osds=5, conf=conf, n_mons=1)
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("defprof")  # no profile arg
+                info = cluster.mons[0].osdmap.pools[pool]
+                assert info.profile.get("plugin") == "jerasure"
+                assert info.profile.get("k") == "3"
+                assert info.profile.get("m") == "2"
+                assert info.size == 5
+                await c.put(pool, "obj", b"default-profile bytes" * 64)
+                assert await c.get(pool, "obj") \
+                    == b"default-profile bytes" * 64
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
 
 class TestMonStoreRecovery:
     def test_single_mon_restart_recovers_state(self, tmp_path):

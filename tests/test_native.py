@@ -203,3 +203,58 @@ def test_mt_encode_byte_identical_and_reports_threads():
                                            nthreads=nthreads)
             assert used >= 1
             assert np.array_equal(p1, p2), f"chunk={chunk} nt={nthreads}"
+
+
+@pytest.mark.slow
+def test_sanitized_native_build_runs_clean(tmp_path):
+    """Satellite sanitizer gate: rebuild the native tree as the
+    ASan/UBSan flavor (bridge.SANITIZE_FLAGS — the same set CMake's
+    CEPH_TPU_SANITIZE / the CEPH_TPU_NATIVE_SANITIZE=1 env enables) and
+    run encode + decode workloads under it.  Any heap misuse, UB, or
+    leak in the gf/rs/registry/capi core aborts the bench nonzero.
+
+    Skips cleanly when the toolchain cannot link the sanitizers (probe
+    compile), since CI images vary."""
+    from ceph_tpu.native import bridge
+
+    # probe: can this toolchain produce a runnable sanitized binary?
+    probe = tmp_path / "probe.cc"
+    probe.write_text("int main() { return 0; }\n")
+    r = subprocess.run(
+        ["g++", *bridge.SANITIZE_FLAGS, "-o", str(tmp_path / "probe"),
+         str(probe)], capture_output=True)
+    if r.returncode != 0 or subprocess.run(
+            [str(tmp_path / "probe")], capture_output=True).returncode != 0:
+        pytest.skip("toolchain lacks a runnable ASan/UBSan")
+
+    sdir = tmp_path / "sanitize"
+    sdir.mkdir()
+    srcs = [os.path.join(NATIVE, s) for s in bridge._LIB_SRCS]
+    # bench + the whole core in ONE sanitized exe; -rdynamic so the
+    # dlopen'd plugin resolves ec_registry_add from the exe's symtab
+    subprocess.run(
+        ["g++", "-std=c++17", "-O1", *bridge.WARN_FLAGS,
+         *bridge.SANITIZE_FLAGS, "-rdynamic", "-o", str(sdir / "bench"),
+         os.path.join(NATIVE, "bench.cc"), *srcs, "-ldl", "-pthread"],
+        check=True, capture_output=True)
+    subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-fPIC", "-shared",
+         *bridge.WARN_FLAGS, *bridge.SANITIZE_FLAGS,
+         "-o", str(sdir / "libec_jerasure.so"),
+         os.path.join(NATIVE, "plugin_jerasure.cc"),
+         os.path.join(NATIVE, "gf256.cc"), os.path.join(NATIVE, "rs.cc")],
+        check=True, capture_output=True)
+    for workload, extra in (("encode", []), ("decode", ["-e", "2"])):
+        out = subprocess.run(
+            [str(sdir / "bench"), "-p", "jerasure", "-w", workload,
+             "-i", "3", "-s", "65536", "-d", str(sdir),
+             "-P", "k=4", "-P", "m=2", *extra],
+            capture_output=True, timeout=300)
+        assert out.returncode == 0, (
+            f"sanitized {workload} failed:\n{out.stderr.decode()}")
+
+    # the bridge's own sanitize flavor builds into a separate artifact
+    # (never the one lib() loads)
+    so = bridge.build(sanitize=True)
+    assert so.endswith(os.path.join("sanitize", "libceph_tpu_ec.so"))
+    assert os.path.exists(so)

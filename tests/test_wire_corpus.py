@@ -85,3 +85,40 @@ class TestWireCorpus:
             "renamed control-plane field slipped through:\n"
             + proc.stdout + proc.stderr)
         assert "MSnapOp" in proc.stderr
+
+
+class TestStrictCoverage:
+    def test_strict_cli_passes_on_shipped_corpus(self):
+        """`wire_corpus --check --strict` is the failing coverage gate:
+        every FIXED type archived + dencoder-round-tripping + golden
+        where versioned."""
+        from ceph_tpu.tools import wire_corpus
+
+        assert wire_corpus.main(["--check", "--strict"]) == 0
+
+    def test_strict_fails_on_missing_coverage(self, tmp_path):
+        """A corpus dir missing frames for registered FIXED types must
+        fail strict — plain --check only replays what IS archived, so a
+        brand-new data-plane message with no frame sails through it."""
+        from ceph_tpu.tools import wire_corpus
+
+        # seed the dir with ONE real frame so plain --check passes...
+        for name in ("MOSDOp.frame", "MOSDOp.json"):
+            src = os.path.join(wire_corpus.CORPUS_DIR, name)
+            with open(src, "rb") as f, \
+                    open(os.path.join(tmp_path, name), "wb") as g:
+                g.write(f.read())
+        assert wire_corpus.check(str(tmp_path)) == 0
+        # ...but strict still fails: every OTHER fixed type is uncovered
+        assert wire_corpus.main(
+            ["--check", "--strict", "--dir", str(tmp_path)]) == 1
+
+    def test_gap_objects_name_the_declaring_site(self, tmp_path):
+        from ceph_tpu.tools import wire_corpus
+
+        gaps = wire_corpus.coverage_gaps(str(tmp_path))
+        lane = [g for g in gaps if g.type_name == "MLaneSegment"]
+        assert lane and lane[0].file.endswith("messenger.py")
+        op = [g for g in gaps if g.type_name == "MOSDOp"
+              and g.kind == "corpus"]
+        assert op and op[0].file.endswith("types.py")
