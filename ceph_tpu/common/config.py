@@ -150,6 +150,11 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
     Option("ms_colocated_ring", OPT_BOOL, False,
            desc="negotiate a zero-serialization in-process ring with "
                 "colocated peers at connect time (falls back to TCP)"),
+    Option("ms_wirepath_native", OPT_BOOL, True, flags=(FLAG_STARTUP,),
+           desc="run the messenger's per-byte hot loop (frame crc, "
+                "scatter/gather, writev) through the released-GIL native "
+                "wirepath when it builds; False forces the python arm "
+                "(the CEPH_TPU_WIREPATH=0 env forces it process-wide)"),
     # auth (reference auth_supported / cephx ticket lifetime)
     Option("auth_cephx", OPT_BOOL, False,
            desc="require cephx-style ticket auth on daemon connections"),

@@ -37,7 +37,8 @@ SANITIZE_FLAGS = ["-fsanitize=address,undefined",
                   "-fno-sanitize-recover=all",
                   "-fno-omit-frame-pointer", "-g"]
 
-_LIB_SRCS = ("gf256.cc", "rs.cc", "registry.cc", "capi.cc", "crc32c.cc")
+_LIB_SRCS = ("gf256.cc", "rs.cc", "registry.cc", "capi.cc", "crc32c.cc",
+             "wirepath.cc")
 
 
 def build(force: bool = False, sanitize: Optional[bool] = None) -> str:
@@ -59,7 +60,8 @@ def build(force: bool = False, sanitize: Optional[bool] = None) -> str:
     if os.path.exists(out) and not force:
         lib_mtime = os.path.getmtime(out)
         hdrs = [os.path.join(_NATIVE, f)
-                for f in ("gf256.h", "rs.h", "ec_api.h", "plugin_common.h")]
+                for f in ("gf256.h", "rs.h", "ec_api.h", "plugin_common.h",
+                          "wirepath.h")]
         if all(os.path.getmtime(s) <= lib_mtime
                for s in srcs + hdrs if os.path.exists(s)):
             return out
@@ -134,6 +136,35 @@ def _configure(_lib: ctypes.CDLL) -> None:
         ctypes.c_int, ctypes.POINTER(ctypes.c_int),
         ctypes.c_char_p, ctypes.c_size_t,
     ]
+    # -- wirepath (native/wirepath.h): the messenger hot loop ------------
+    _pp = ctypes.POINTER(ctypes.c_void_p)
+    _sp = ctypes.POINTER(ctypes.c_size_t)
+    _ip = ctypes.POINTER(ctypes.c_int32)
+    _up = ctypes.POINTER(ctypes.c_uint32)
+    _lib.ceph_tpu_wirepath_kind.restype = ctypes.c_char_p
+    _lib.ceph_tpu_wirepath_kind.argtypes = []
+    _lib.ceph_tpu_wire_crc_batch.restype = ctypes.c_int32
+    _lib.ceph_tpu_wire_crc_batch.argtypes = [
+        _pp, _sp, ctypes.c_int32, _ip, ctypes.c_int32, _up, _up]
+    _lib.ceph_tpu_wire_gather.restype = ctypes.c_int64
+    _lib.ceph_tpu_wire_gather.argtypes = [
+        _pp, _sp, ctypes.c_int32, ctypes.c_char_p, ctypes.c_size_t]
+    _lib.ceph_tpu_wire_copy_crc32c.restype = ctypes.c_uint32
+    _lib.ceph_tpu_wire_copy_crc32c.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32]
+    _lib.ceph_tpu_wire_writev.restype = ctypes.c_int64
+    _lib.ceph_tpu_wire_writev.argtypes = [
+        ctypes.c_int, _pp, _sp, ctypes.c_int32, ctypes.c_size_t]
+    _lib.ceph_tpu_wire_scatter.restype = ctypes.c_int32
+    _lib.ceph_tpu_wire_scatter.argtypes = [
+        _pp, _sp, ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_void_p, ctypes.c_size_t, _up, ctypes.c_int32, _ip]
+    _lib.ceph_tpu_wire_verify_regions.restype = ctypes.c_int32
+    _lib.ceph_tpu_wire_verify_regions.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int64), _sp, _up, ctypes.c_int32]
+    _lib.ceph_tpu_wirepath_selftest.restype = ctypes.c_int32
+    _lib.ceph_tpu_wirepath_selftest.argtypes = []
 
 
 def gf_mul(a: int, b: int) -> int:
@@ -260,3 +291,307 @@ def crc32c(data, seed: int = 0) -> int:
 
 def crc32c_kind() -> str:
     return lib().ceph_tpu_crc32c_kind().decode()
+
+
+# -- wirepath (native/wirepath.h): messenger hot-loop batch calls ------------
+# Segment arguments accept bytes / bytearray / contiguous 1-D memoryview /
+# numpy arrays.  The CALLER keeps every segment alive across the call (the
+# address is of the segment's own buffer — nothing is copied here).
+
+
+def _seg_addr(s, writable: bool = False) -> tuple:
+    """(address, nbytes) of a contiguous byte buffer, zero-copy.  With
+    ``writable`` the buffer must be mutable — destinations the C side
+    will memcpy into refuse bytes/readonly views HERE, mirroring the
+    PyBUF_WRITABLE refusal of the wirepy arm (a readonly dst silently
+    corrupted through its raw address is the worst failure mode)."""
+    if isinstance(s, bytes):
+        if writable:
+            raise TypeError("destination buffer is readonly (bytes)")
+        if not s:
+            return 0, 0
+        return ctypes.cast(ctypes.c_char_p(s), ctypes.c_void_p).value, len(s)
+    mv = s if isinstance(s, memoryview) else memoryview(s)
+    if mv.ndim != 1 or mv.itemsize != 1:
+        mv = mv.cast("B")
+    if writable and mv.readonly:
+        raise TypeError("destination buffer is readonly")
+    if not mv.nbytes:
+        return 0, 0
+    # np.frombuffer wraps readonly AND writable buffers; .ctypes.data is
+    # the address of the ORIGINAL memory either way
+    return int(np.frombuffer(mv, dtype=np.uint8).ctypes.data), mv.nbytes
+
+
+def _seg_arrays(segs):
+    n = len(segs)
+    ptrs = (ctypes.c_void_p * n)()
+    lens = (ctypes.c_size_t * n)()
+    total = 0
+    for i, s in enumerate(segs):
+        a, ln = _seg_addr(s)
+        ptrs[i] = a
+        lens[i] = ln
+        total += ln
+    return ptrs, lens, total
+
+
+def wirepath_kind() -> str:
+    """"native" when the wirepath symbols loaded — the arm gauge the
+    BENCH record and /metrics report (crc32c_kind's sibling)."""
+    return lib().ceph_tpu_wirepath_kind().decode()
+
+
+def wirepath_selftest() -> int:
+    """The in-library adversarial geometry battery (0 = clean); also run
+    under ASan/UBSan by the slow native test leg."""
+    return lib().ceph_tpu_wirepath_selftest()
+
+
+def wire_crc_batch(groups, seeds=None):
+    """Chained crc32c per group of segments, ONE released-GIL call for
+    the whole batch: groups is a list of segment lists (a frame's crc
+    sections, a flush window's blobs), seeds an optional per-group seed
+    list.  Returns the list of crcs."""
+    flat: list = []
+    starts = (ctypes.c_int32 * (len(groups) + 1))()
+    for g, segs in enumerate(groups):
+        starts[g] = len(flat)
+        flat.extend(segs)
+    starts[len(groups)] = len(flat)
+    ptrs, lens, _ = _seg_arrays(flat)
+    out = (ctypes.c_uint32 * len(groups))()
+    sd = None
+    if seeds is not None:
+        sd = (ctypes.c_uint32 * len(groups))(
+            *(s & 0xFFFFFFFF for s in seeds))
+    rc = lib().ceph_tpu_wire_crc_batch(
+        ptrs, lens, len(flat), starts, len(groups), sd, out)
+    if rc != 0:
+        raise ValueError(f"wire_crc_batch failed ({rc})")
+    return list(out)
+
+
+def wire_gather(segs, out) -> int:
+    """Gather segments into the writable buffer ``out`` (native memcpy
+    walk); returns total bytes.  Raises when out is too small."""
+    ptrs, lens, total = _seg_arrays(segs)
+    dst, cap = _seg_addr(out, writable=True)
+    rc = lib().ceph_tpu_wire_gather(ptrs, lens, len(segs),
+                                    ctypes.c_char_p(dst), cap)
+    if rc < 0:
+        raise ValueError(f"wire_gather failed ({rc}): {total} > {cap}")
+    return int(rc)
+
+
+def wire_copy_crc32c(src, dst, seed: int = 0) -> int:
+    """Fused copy+crc32c: land ``src`` in ``dst`` (None = checksum only)
+    and return the chained crc of the bytes, one released-GIL pass."""
+    sa, n = _seg_addr(src)
+    da = 0
+    if dst is not None:
+        da, dn = _seg_addr(dst, writable=True)
+        if dn < n:
+            raise ValueError(f"wire_copy_crc32c: dst {dn} < src {n}")
+    return int(lib().ceph_tpu_wire_copy_crc32c(sa, da, n,
+                                               seed & 0xFFFFFFFF))
+
+
+def wire_writev(fd: int, segs, skip: int = 0) -> int:
+    """writev the segment list onto a nonblocking fd — partial writes,
+    EINTR and IOV_MAX batching loop natively with the GIL released.
+    Returns bytes written (0 = would-block); raises OSError on a hard
+    socket error (the sendmsg surface CorkedWriter expects)."""
+    ptrs, lens, _ = _seg_arrays(segs)
+    rc = lib().ceph_tpu_wire_writev(fd, ptrs, lens, len(segs), skip)
+    if rc < 0:
+        err = int(-rc)
+        raise OSError(err, os.strerror(err))
+    return int(rc)
+
+
+def wire_verify_regions(base, offs, lens, wants) -> int:
+    """Burst crc verify over regions of ONE buffer (the rx backlog):
+    region i is base[offs[i]:offs[i]+lens[i]] and must crc32c to
+    wants[i].  Returns -1 when every region matches, else the first
+    mismatching index.  Offsets are plain ints — no per-region buffer
+    marshalling, so the Python-side cost is O(1) small arrays."""
+    ba, blen = _seg_addr(base)
+    n = len(offs)
+    rc = lib().ceph_tpu_wire_verify_regions(
+        ba, blen, (ctypes.c_int64 * n)(*offs),
+        (ctypes.c_size_t * n)(*lens),
+        (ctypes.c_uint32 * n)(*(w & 0xFFFFFFFF for w in wants)), n)
+    if rc < -1:
+        raise ValueError(f"wire_verify_regions bad geometry ({rc})")
+    return rc
+
+
+def wire_scatter(srcs, offs, dst, want_crcs=None) -> tuple:
+    """Guarded scatter of fragments into ``dst`` at ``offs`` with
+    optional per-fragment crc verification (crc runs over the source
+    BEFORE any copy).  Returns (rc, bad_idx): rc == len(srcs) on
+    success, else -22 (geometry: bounds/overlap) or -74 (crc) with
+    bad_idx naming the refused fragment."""
+    n = len(srcs)
+    ptrs, lens, _ = _seg_arrays(srcs)
+    o = (ctypes.c_int64 * n)(*offs)
+    da, dlen = _seg_addr(dst, writable=True)
+    crcs = None
+    if want_crcs is not None:
+        crcs = (ctypes.c_uint32 * n)(*(c & 0xFFFFFFFF for c in want_crcs))
+    bad = ctypes.c_int32(-1)
+    rc = lib().ceph_tpu_wire_scatter(
+        ptrs, lens, n, o, da, dlen, crcs,
+        1 if want_crcs is not None else 0, ctypes.byref(bad))
+    return int(rc), int(bad.value)
+
+
+# -- wirepy: the PyDLL shim (native/wirepath_py.cc) --------------------------
+# Separate .so because it needs Python headers; loaded via ctypes.PyDLL
+# so the C side parses the SEGMENT LIST itself (PyObject_GetBuffer walk,
+# ~100ns/segment under the held GIL) and then releases the GIL around
+# the byte work.  Building per-segment pointer arrays in ctypes costs
+# more than the syscall it feeds — this shim is why the tx hot loop can
+# afford a native call per flush window at all.
+
+_PYLIB = os.path.join(_BUILD, "libceph_tpu_wirepy.so")
+_WIREPY_SRCS = ("wirepath_py.cc", "wirepath.cc", "crc32c.cc")
+
+_pylib: Optional[ctypes.PyDLL] = None
+_pylib_failed = False
+
+
+def build_wirepy(force: bool = False) -> Optional[str]:
+    """Compile the PyDLL shim (idempotent, like build()); None when the
+    host lacks Python development headers — the base library and the
+    pure-ctypes entry points keep working without it."""
+    import sysconfig
+
+    inc = sysconfig.get_paths().get("include") or ""
+    if not os.path.exists(os.path.join(inc, "Python.h")):
+        return None
+    srcs = [os.path.join(_NATIVE, f) for f in _WIREPY_SRCS]
+    hdrs = [os.path.join(_NATIVE, "wirepath.h")]
+    if os.path.exists(_PYLIB) and not force:
+        lib_mtime = os.path.getmtime(_PYLIB)
+        if all(os.path.getmtime(s) <= lib_mtime
+               for s in srcs + hdrs if os.path.exists(s)):
+            return _PYLIB
+    os.makedirs(os.path.dirname(_PYLIB), exist_ok=True)
+    cmd = [
+        "g++", "-std=c++17", "-O3", "-march=native", "-fPIC", "-shared",
+        *WARN_FLAGS, f"-I{inc}", "-o", _PYLIB, *srcs,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            f"wirepy build failed (rc {e.returncode}); if these are "
+            f"warnings from a newer compiler, set "
+            f"CEPH_TPU_NATIVE_WERROR=0:\n"
+            f"{(e.stderr or b'').decode(errors='replace')}") from e
+    return _PYLIB
+
+
+def pylib() -> Optional[ctypes.PyDLL]:
+    """The PyDLL shim, or None when it cannot build (missing Python
+    headers / compiler): callers fall back to the pure arms."""
+    global _pylib, _pylib_failed
+    if _pylib is None and not _pylib_failed:
+        try:
+            path = build_wirepy()
+            if path is None:
+                _pylib_failed = True
+                return None
+            _l = ctypes.PyDLL(path)
+            _l.ceph_tpu_wirepy_writev.restype = ctypes.c_longlong
+            _l.ceph_tpu_wirepy_writev.argtypes = [
+                ctypes.c_int, ctypes.py_object, ctypes.c_ulonglong]
+            _l.ceph_tpu_wirepy_crc_chain.restype = ctypes.c_longlong
+            _l.ceph_tpu_wirepy_crc_chain.argtypes = [
+                ctypes.py_object, ctypes.c_uint]
+            _l.ceph_tpu_wirepy_gather.restype = ctypes.c_longlong
+            _l.ceph_tpu_wirepy_gather.argtypes = [
+                ctypes.py_object, ctypes.py_object]
+            _l.ceph_tpu_wirepy_verify_regions.restype = ctypes.c_longlong
+            _l.ceph_tpu_wirepy_verify_regions.argtypes = [
+                ctypes.py_object, ctypes.py_object, ctypes.py_object,
+                ctypes.py_object]
+            _l.ceph_tpu_wirepy_scatter_from.restype = ctypes.c_longlong
+            _l.ceph_tpu_wirepy_scatter_from.argtypes = [
+                ctypes.py_object, ctypes.py_object, ctypes.py_object]
+            _pylib = _l
+        except Exception:
+            _pylib_failed = True
+    return _pylib
+
+
+def has_wirepy() -> bool:
+    return pylib() is not None
+
+
+def _pyl() -> ctypes.PyDLL:
+    l = pylib()
+    if l is None:
+        # a host with g++ but no Python.h builds the CDLL arm yet not
+        # this shim: fail with the actual condition, not an
+        # AttributeError off the None
+        raise RuntimeError("wirepy shim unavailable (missing Python "
+                           "headers or compiler)")
+    return l
+
+
+def wirepy_writev(fd: int, segs, skip: int = 0) -> int:
+    """One PyDLL call writev's the whole segment LIST onto a nonblocking
+    fd: segment parsing happens in C under the held GIL, the I/O loop
+    runs with it released.  Returns bytes written (0 = would-block);
+    raises OSError on a hard socket error."""
+    rc = _pyl().ceph_tpu_wirepy_writev(fd, segs, skip)
+    if rc < 0:
+        err = int(-rc)
+        raise OSError(err, os.strerror(err))
+    return int(rc)
+
+
+def wirepy_crc_chain(segs, seed: int = 0) -> int:
+    """Chained crc32c over a LIST of buffers in one PyDLL call (a
+    BufferList's pieces) — no per-piece ctypes round-trips."""
+    rc = _pyl().ceph_tpu_wirepy_crc_chain(segs, seed & 0xFFFFFFFF)
+    if rc < 0:
+        raise ValueError(f"wirepy_crc_chain failed ({rc})")
+    return int(rc)
+
+
+def wirepy_gather(segs, out) -> int:
+    """Gather a LIST of buffers into writable ``out`` in one PyDLL
+    call; returns total bytes, raises when out is too small."""
+    rc = _pyl().ceph_tpu_wirepy_gather(segs, out)
+    if rc < 0:
+        raise ValueError(f"wirepy_gather failed ({rc})")
+    return int(rc)
+
+
+def wirepy_verify_regions(base, offs, lens, wants) -> int:
+    """Burst crc32c verify over regions of ONE buffer: region i is
+    base[offs[i]:offs[i]+lens[i]] and must checksum to wants[i].  The
+    geometry rides plain Python int LISTS (C-side walk, no ctypes
+    array builds) and the crc loop runs with the GIL released.
+    Returns -1 when every region matches, else the first mismatching
+    index; raises on out-of-bounds geometry."""
+    rc = _pyl().ceph_tpu_wirepy_verify_regions(base, offs, lens, wants)
+    if rc < -1:
+        raise ValueError(f"wirepy_verify_regions bad geometry ({rc})")
+    return int(rc)
+
+
+def wirepy_scatter_from(base, soffs, dsts) -> int:
+    """Burst scatter OUT of one source buffer: fill each writable
+    buffer dsts[i] (its own length) from base[soffs[i]:] — a whole rx
+    burst's blob bytes leave the backlog in one released-GIL memcpy
+    loop.  Bounds are validated before any byte moves; returns total
+    bytes copied, raises on bad geometry."""
+    rc = _pyl().ceph_tpu_wirepy_scatter_from(base, soffs, dsts)
+    if rc < 0:
+        raise ValueError(f"wirepy_scatter_from bad geometry ({rc})")
+    return int(rc)

@@ -133,6 +133,7 @@ import numpy as np
 
 from ceph_tpu.common.perf_counters import PerfCounters, PerfCountersBuilder
 from ceph_tpu.common.throttle import Throttle
+from ceph_tpu.utils import wirepath as _wirepath
 from ceph_tpu.rados.reactor import (PROC_TOKEN, ReactorPool, RingConnection,
                                     ring_abandon, ring_claim, ring_offer)
 
@@ -172,6 +173,11 @@ def _build_wire_perf() -> PerfCounters:
                                        app-level crc (no recompute pass)
       rx_batches           u64         multi-frame rx batches drained
       rx_batch_msgs        histogram   messages per rx dispatch batch
+      wirepath_kind        u64 gauge   1 = native wirepath, 0 = python arm
+      native_tx_calls      u64         released-GIL tx wirepath calls
+      native_rx_calls      u64         released-GIL rx wirepath calls
+      native_bytes         u64         bytes touched by native wirepath
+                                       passes (counted once per pass)
       tx_<Type> / rx_<Type>        u64  per-message-type counts (dynamic)
       tx_bytes_<Type> / rx_bytes_<Type>  u64  per-type frame bytes
 
@@ -215,6 +221,20 @@ def _build_wire_perf() -> PerfCounters:
     b.add_u64_counter("lane_frag_overflow",
                       "fragments refused by the reassembly memory cap")
     b.add_u64_counter("lane_revivals", "dead lanes redialed and replayed")
+    # native wirepath (utils/wirepath.py): which arm ran and how much of
+    # the per-byte hot loop it carried — wirepath_kind is the arm gauge
+    # (1 = native, 0 = python; BENCH records the string alongside)
+    b.add_u64("wirepath_kind", "wirepath arm: 1 = native, 0 = python")
+    b.add_u64_counter("native_tx_calls",
+                      "released-GIL wirepath calls on the tx side "
+                      "(whole-window writev, batch blob crc)")
+    b.add_u64_counter("native_rx_calls",
+                      "released-GIL wirepath calls on the rx side "
+                      "(burst crc verify, fused copy+crc, scatter)")
+    b.add_u64_counter("native_bytes",
+                      "bytes touched by native wirepath passes (each "
+                      "pass counts: a byte crc-verified then scattered "
+                      "counts once per pass)")
     # µs histograms of the socket-io longrunavgs: tail-latency
     # percentiles (p50/p99/p999) come out of the power-of-2 buckets, so
     # the BENCH record reports wire tx/rx TAILS, not just means
@@ -762,17 +782,26 @@ class FrameReceiver(asyncio.BufferedProtocol):
     # small backlog cap: bytes that arrive before a readexactly() is
     # waiting land in _pending and must be COPIED out, so the transport
     # pauses early — the single-copy path is bytes landing directly in
-    # the registered destination buffer
+    # the registered destination buffer.  The native wirepath inverts
+    # the tradeoff (Connection._rx_drain_native verifies AND lands the
+    # whole backlog below the GIL), so enable_fast_read sizes the
+    # backlog UP when that arm is live: a burst of bulk frames must fit
+    # complete frames in _pending for the batch drain to engage at all.
     _LIMIT = 128 << 10
+    _NATIVE_LIMIT = 1 << 20
+    _NATIVE_SCRATCH = 256 << 10
 
-    def __init__(self, transport, stream_protocol, leftover: bytes = b""):
+    def __init__(self, transport, stream_protocol, leftover: bytes = b"",
+                 limit: Optional[int] = None, scratch: Optional[int] = None):
         self._transport = transport
         self._stream_protocol = stream_protocol
         self._pending = bytearray(leftover)
         self._off = 0  # consumed prefix of _pending (O(1) front-consume)
         self._dest = None  # memoryview being filled by get_buffer
         self._dest_pos = 0
-        self._scratch = bytearray(64 * 1024)
+        if limit is not None:
+            self._LIMIT = limit  # instance override of the class cap
+        self._scratch = bytearray(scratch or (64 * 1024))
         self._scratch_view = memoryview(self._scratch)
         self._waiter: Optional[asyncio.Future] = None
         self._eof = False
@@ -981,10 +1010,15 @@ class CorkedWriter:
 
     IOV_MAX = 512  # segments per sendmsg call (conservative vs UIO_MAXIOV)
 
-    def __init__(self, transport, sock, stream_writer):
+    def __init__(self, transport, sock, stream_writer, wp=None, perf=None):
         self._transport = transport
         self._sock = sock
         self._sw = stream_writer  # close/wait_closed/extra-info delegate
+        # native wirepath arm: one released-GIL writev call drains the
+        # whole backlog (partial writes, EINTR, IOV batching loop in C)
+        # instead of the Python sendmsg walk below; perf counts the arm
+        self._wp = wp
+        self._perf = perf
         loop = asyncio.get_running_loop()
         self._loop = loop
         # the PRIVATE writer registration transports themselves use: the
@@ -1046,6 +1080,23 @@ class CorkedWriter:
 
     def _do_send(self) -> None:
         try:
+            if self._wp is not None and self._segs:
+                # ONE foreign call writes the whole backlog with the
+                # GIL released — wirepy_writev loops partial writes /
+                # EINTR / IOV_MAX internally and returns only on
+                # completion or EAGAIN (the PyDLL shim parses the
+                # segment list itself, so the Python side pays a bare
+                # list() per call)
+                written = self._wp.wirepy_writev(self._fd,
+                                                 list(self._segs))
+                if self._perf is not None:
+                    self._perf.inc("native_tx_calls")
+                    if written:
+                        self._perf.inc("native_bytes", written)
+                if written:
+                    self._advance(written)
+                if self._segs:
+                    raise BlockingIOError  # kernel buffer full
             while self._segs:
                 if len(self._segs) > self.IOV_MAX:
                     batch = list(itertools.islice(self._segs, self.IOV_MAX))
@@ -1176,6 +1227,16 @@ class Connection:
         # otherwise — a silent per-host resolver difference must degrade,
         # not deadlock (set by the handshake; default local resolver)
         self.crc_fn = checksum
+        # native wirepath arm (messenger-resolved): rx drains consult it
+        # together with crc_fn — a zlib-negotiated connection keeps the
+        # python arm so frame bytes stay identical either way
+        self.wp = messenger.wirepath
+        # frames pre-verified + pre-scattered by _rx_drain_native,
+        # awaiting read_frame pops (each entry is read_frame's tuple);
+        # _rx_error raises once the stash drains (a bad frame mid-burst
+        # fails the connection AFTER its valid predecessors dispatch)
+        self._rx_stash: Deque = collections.deque()
+        self._rx_error: Optional[BaseException] = None
 
     def enable_fast_read(self) -> None:
         """Swap the StreamReader for the zero-copy FrameReceiver when the
@@ -1192,7 +1253,20 @@ class Connection:
             proto = transport.get_protocol()
             leftover = bytes(r._buffer)
             r._buffer.clear()
-            receiver = FrameReceiver(transport, proto, leftover)
+            if self.wp is not None and (self.crc_fn is checksum
+                                        or not self.crc_enabled):
+                # native rx drain (same predicate read_frame gates the
+                # drain on — a zlib-negotiated connection stays on the
+                # python arm and must keep the small backlog): complete
+                # frames must BUFFER for the burst verify+scatter to
+                # batch, and the backlog-copy penalty the small default
+                # guards against runs below the GIL on this arm
+                receiver = FrameReceiver(
+                    transport, proto, leftover,
+                    limit=FrameReceiver._NATIVE_LIMIT,
+                    scratch=FrameReceiver._NATIVE_SCRATCH)
+            else:
+                receiver = FrameReceiver(transport, proto, leftover)
             if r.at_eof():
                 receiver._eof = True  # FIN landed before the swap
             transport.set_protocol(receiver)
@@ -1239,12 +1313,20 @@ class Connection:
             segs = [blob]
             blob_len = len(blob)
         if blob_crc is None:
-            if self.crc_enabled:
+            if not self.crc_enabled:
+                blob_crc = 0
+            elif self.wp is not None and len(segs) > 1 \
+                    and self.crc_fn is checksum:
+                # multi-piece BufferList: ONE released-GIL call chains
+                # the crc across every piece (was one ctypes round-trip
+                # per piece)
+                blob_crc = self.wp.wirepy_crc_chain(segs)
+                self.messenger.perf.inc("native_tx_calls")
+                self.messenger.perf.inc("native_bytes", blob_len)
+            else:
                 blob_crc = 0
                 for s in segs:
                     blob_crc = self.crc_fn(s, blob_crc)
-            else:
-                blob_crc = 0
         else:
             self.messenger.perf.inc("tx_crc_reused")
         prefix = _BLOB_PFX.pack(len(pickled), blob_crc)
@@ -1431,7 +1513,9 @@ class Connection:
             loop = asyncio.get_running_loop()
             if not hasattr(loop, "_add_writer"):
                 return  # non-selector loop: keep the stream writer
-            corked = CorkedWriter(transport, sock, w)
+            corked = CorkedWriter(transport, sock, w,
+                                  wp=self.messenger.wirepath,
+                                  perf=self.messenger.perf)
             proto = transport.get_protocol()
             if isinstance(proto, FrameReceiver):
                 proto.corked = corked  # connection_lost fails its waiters
@@ -1539,6 +1623,175 @@ class Connection:
         while self.unacked and self.unacked[0][0] <= seq:
             self.unacked.popleft()
 
+    def buffered_frame_len(self) -> Optional[int]:
+        """Payload length of the next COMPLETE frame in hand: a frame
+        pre-verified into the rx stash by the native drain first, else
+        whatever is fully buffered on the reader — the serve loop's rx
+        batching predicate (batch only what needs no network wait)."""
+        if self._rx_stash:
+            return self._rx_stash[0][4]
+        return Messenger._buffered_frame_len(self.reader)
+
+    def _rx_drain_native(self) -> None:
+        """Native rx burst: parse every COMPLETE frame already buffered
+        in the FrameReceiver backlog, verify ALL their crc sections in
+        ONE released-GIL call (wirepy_verify_regions — the geometry
+        rides plain int lists, walked in C), land every verified
+        frame's blob bytes with ONE more released-GIL scatter call
+        (wirepy_scatter_from) — lane fragments straight into their
+        slice of the group assembly buffer (frag_view) — and stash
+        read_frame-ready tuples.  The python arm pays 2-4 awaits plus
+        1-2 ctypes crc round-trips plus an interpreter copy per frame;
+        this pays two foreign calls per BURST, and the GIL is released
+        while the burst's bytes are checksummed and moved.
+
+        A crc-failing frame mid-burst stashes its valid predecessors,
+        consumes through the bad frame, and parks the BadFrame in
+        _rx_error — read_frame raises it once the stash drains, exactly
+        the slow path's fail-after-the-good-frames order."""
+        r = self.reader
+        pend = r._pending
+        base = r._off
+        end = len(pend)
+        if end - base < _HDR.size or self._rx_error is not None:
+            return
+        crc_on = self.crc_enabled
+        t0 = time.monotonic()
+        voffs: list = []    # crc regions: offsets/lengths INTO pend
+        vlens: list = []
+        vwants: list = []
+        expect: list = []   # (frame_index, is_blob) per crc region
+        frames: list = []   # [type_id, version, seq, payload, length,
+        #                      blob, fixed, verified, flags, src_off]
+        pos = base
+        error: Optional[BaseException] = None
+        error_end = pos
+        # one export for the whole drain: bytes(mv[a:b]) is a single
+        # copy, where bytes(pend[a:b]) would copy twice (bytearray
+        # slice, then bytes).  Released before _consume — a live export
+        # blocks the bytearray resize.
+        mv = memoryview(pend)
+        try:
+            while end - pos >= _HDR.size:
+                length, type_id, version, flags, crc, seq = \
+                    _HDR.unpack_from(pend, pos)
+                if end - pos - _HDR.size < length:
+                    break
+                fstart = pos + _HDR.size
+                fend = fstart + length
+                blob = None
+                verified = False
+                src_off = -1
+                if flags & FLAG_BLOB:
+                    if _BLOB_PFX.size > length:
+                        error = BadFrame(f"bad blob prefix on type {type_id}")
+                        error_end = fend
+                        break
+                    plen, blob_crc = _BLOB_PFX.unpack_from(pend, fstart)
+                    if _BLOB_PFX.size + plen > length:
+                        # a corrupt plen would desync the stream — reject
+                        # (the slow path refuses before any read; either
+                        # way the frame is consumed and the session dies)
+                        error = BadFrame(f"bad blob prefix on type {type_id}")
+                        error_end = fend
+                        break
+                    hdr_end = fstart + _BLOB_PFX.size + plen
+                    payload = bytes(mv[fstart + _BLOB_PFX.size:hdr_end])
+                    blob_len = length - _BLOB_PFX.size - plen
+                    if crc and crc_on:
+                        # one region covers prefix+pickled: crc32c over the
+                        # contiguous span == the chained tx-side crc
+                        voffs.append(fstart)
+                        vlens.append(hdr_end - fstart)
+                        vwants.append(crc)
+                        expect.append((len(frames), False))
+                    cls = _MSG_TYPES.get(type_id)
+                    dest = None
+                    if cls is MLaneSegment and self.lane_group is not None \
+                            and (flags & FLAG_FIXED) and blob_len \
+                            and not (seq and seq <= self.in_seq):
+                        # the in_seq guard: see the slow path — a replayed
+                        # duplicate must not re-open reassembly state
+                        try:
+                            seg = _unpack_fixed(cls, payload, None)
+                            dest = self.lane_group.frag_view(seg, blob_len)
+                        except Exception:
+                            dest = None
+                    if dest is not None:
+                        blob = dest
+                    elif getattr(cls, "BLOB_VIEW_OK", False):
+                        blob = memoryview(
+                            np.empty(blob_len, dtype=np.uint8)).cast("B")
+                    else:
+                        blob = bytearray(blob_len)
+                    src_off = hdr_end
+                    if blob_crc and crc_on:
+                        voffs.append(hdr_end)
+                        vlens.append(blob_len)
+                        vwants.append(blob_crc)
+                        expect.append((len(frames), True))
+                        verified = True
+                else:
+                    payload = bytes(mv[fstart:fend])
+                    if crc and crc_on:
+                        voffs.append(fstart)
+                        vlens.append(length)
+                        vwants.append(crc)
+                        expect.append((len(frames), False))
+                frames.append([type_id, version, seq, payload, length, blob,
+                               bool(flags & FLAG_FIXED), verified, flags,
+                               src_off])
+                pos = fend
+            if not frames and error is None:
+                return
+            perf = self.messenger.perf
+            bad_idx = len(frames)
+            if voffs:
+                bad_region = self.wp.wirepy_verify_regions(
+                    pend, voffs, vlens, vwants)
+                perf.inc("native_rx_calls")
+                perf.inc("native_bytes", sum(vlens))
+                if bad_region >= 0:
+                    fidx, is_blob = expect[bad_region]
+                    if fidx < bad_idx:
+                        bad_idx = fidx
+                        error = BadFrame(
+                            ("blob crc mismatch on type {}" if is_blob
+                             else "crc mismatch on frame type {}").format(
+                                frames[fidx][0]))
+                        error_end = base + sum(
+                            _HDR.size + f[4] for f in frames[:fidx + 1])
+            consumed = pos - base
+            soffs: list = []
+            dsts: list = []
+            for f in frames[:bad_idx]:
+                if f[9] >= 0:
+                    # verified-then-copied: a crc-refused frame never lands
+                    # a byte (the slow path lands then kills; the failure
+                    # surface — BadFrame, session death — is identical, the
+                    # assembly buffer just stays cleaner)
+                    soffs.append(f[9])
+                    dsts.append(f[5])
+                flags = f[8]
+                payload = f[3]
+                if flags & FLAG_COMPRESSED and not (flags & FLAG_BLOB):
+                    payload = zlib.decompress(payload)
+                self._rx_stash.append((f[0], f[1], f[2], payload, f[4],
+                                       f[5], f[6], f[7]))
+            if soffs:
+                copied = self.wp.wirepy_scatter_from(pend, soffs, dsts)
+                perf.inc("native_rx_calls")
+                perf.inc("native_bytes", copied)
+            if error is not None:
+                self._rx_error = error
+                consumed = error_end - base
+        finally:
+            mv.release()
+        r._consume(consumed)
+        rx_dt = time.monotonic() - t0
+        perf.tinc("rx_io", rx_dt)
+        perf.hinc("rx_io_us", rx_dt * 1e6)
+
     async def read_frame(self) -> Tuple[int, int, int, bytes, int, Any,
                                         bool, bool]:
         """Returns (type_id, version, seq, payload, cost, blob, fixed,
@@ -1550,6 +1803,23 @@ class Connection:
         ``blob_verified`` says that check actually ran (crc enabled and
         present), so handlers holding an app-level crc of the same bytes
         (MECSubWrite.chunk_crc) can skip their own verify pass."""
+        stash = self._rx_stash
+        if not stash and self.wp is not None \
+                and isinstance(self.reader, FrameReceiver) \
+                and (self.crc_fn is checksum or not self.crc_enabled):
+            # native burst drain: every fully-buffered frame verifies in
+            # one released-GIL call and lands pre-scattered in the stash
+            self._rx_drain_native()
+        if stash:
+            (type_id, version, seq, payload, cost, blob, fixed,
+             verified) = stash.popleft()
+            await self.throttle.get(cost)
+            self.messenger.perf.inc("rx_bytes", _HDR.size + cost)
+            return (type_id, version, seq, payload, cost, blob, fixed,
+                    verified)
+        if self._rx_error is not None:
+            err, self._rx_error = self._rx_error, None
+            raise err
         hdr = await self.reader.readexactly(_HDR.size)
         length, type_id, version, flags, crc, seq = _HDR.unpack(hdr)
         cost = length
@@ -1641,6 +1911,11 @@ class Connection:
             self.writer = writer
             self.closed = False
             self.transport_gen += 1
+            # pre-verified frames from the DEAD transport: never
+            # dispatched, never acked — the peer replays them on this
+            # transport, and the in_seq dedupe floor keeps it exactly-once
+            self._rx_stash.clear()
+            self._rx_error = None
             try:
                 old_writer.close()
             except Exception:
@@ -1997,13 +2272,17 @@ class LaneGroup:
                     # consuming its slot — a valid retransmission of
                     # this index must still be able to land
                     return None
-                view = memoryview(buf).cast("B")
                 mv = chunk if isinstance(chunk, memoryview) \
                     else memoryview(as_bytes(chunk)
                                     if isinstance(chunk, BufferList)
                                     else chunk)
                 if mv.ndim != 1 or mv.itemsize != 1:
                     mv = mv.cast("B")
+                # single-fragment landing: the bounds/overlap guard above
+                # already enforced everything the C-side guard would, and
+                # one slice-assign is cheaper than a ctypes segment-list
+                # round-trip (batched fragments ride the native drain)
+                view = memoryview(buf).cast("B")
                 view[frag.off:frag.off + mv.nbytes] = mv
             chunks[frag.idx] = True
             ranges[frag.idx] = (frag.off, nbytes)
@@ -2162,9 +2441,23 @@ class Messenger:
         # resolve the frame checksum NOW (may g++-build the native
         # library, seconds): daemon construction, never the hot path
         checksum_kind()
+        # native wirepath arm for this messenger (utils/wirepath.py):
+        # the bridge module when the native hot loop resolved AND the
+        # config allows it, else None (pure-python arm).  Resolved here
+        # for the same reason as the checksum — never on the hot path.
+        self.wirepath = (_wirepath.impl()
+                         if bool(_cget(self.conf, "ms_wirepath_native",
+                                       True)) else None)
         # the `wire` counter set (framing vs socket-io split; schema in
         # _build_wire_perf) — owning daemons add it to their collection
         self.perf = _build_wire_perf()
+        self.perf.set("wirepath_kind", 1 if self.wirepath is not None
+                      else 0)
+        # gauge survives `perf reset` (bench/tests zero the window's
+        # counters; the ARM doesn't change) — the resync hook restores
+        # it, the service-plane gauge discipline
+        self.perf.resync = lambda: self.perf.set(
+            "wirepath_kind", 1 if self.wirepath is not None else 0)
         # per-daemon log (debug_ms levels): daemons attach their
         # Context's Log; raw messengers stay silent.  Per-frame douts are
         # call-site guarded with log.wants("ms", 20) so a disabled level
@@ -2783,7 +3076,7 @@ class Messenger:
                     while (len(batch) < self.RX_BATCH_MSGS
                            and sum(costs) < self.RX_BATCH_BYTES):
                         if batch:
-                            nxt = self._buffered_frame_len(conn.reader)
+                            nxt = conn.buffered_frame_len()
                             if nxt is None or not \
                                     conn.throttle.would_admit(nxt):
                                 # nothing fully buffered, or the throttle
@@ -3328,6 +3621,7 @@ class Messenger:
                            if self.reactors is not None else 0),
             "lanes_per_peer": self.lanes_per_peer,
             "colocated_ring": self._ring_ok,
+            "wirepath": "native" if self.wirepath is not None else "python",
             "workers": (self.reactors.dump()
                         if self.reactors is not None else []),
             "peers": peers,

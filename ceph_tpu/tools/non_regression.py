@@ -202,20 +202,47 @@ def run_wire_floor(args) -> int:
             print(f"wire-floor: unreadable BENCH record: {e}",
                   file=sys.stderr)
             return 1
+        # like-for-like arms only (ISSUE 12): the headline
+        # daemon_wire_* pair rides whichever wirepath arm the host
+        # resolved (`wirepath_kind`), so a native-arm record compared
+        # against a python-arm record would hide a real wire
+        # regression behind the arm speedup (or fail a healthy python
+        # host against a native record).  When the arms differ, both
+        # records' forced-python numbers (daemon_wire_*_MBps_python,
+        # measured every run since ISSUE 12; records older than that
+        # ARE the python arm) are the comparable pair.
+        ckind = str(cur.get("wirepath_kind") or "python")
+        pkind = str(prev.get("wirepath_kind") or "python")
         for key in ("daemon_wire_put_MBps", "daemon_wire_get_MBps"):
-            c = float(cur.get(key, 0.0) or 0.0)
-            p = float(prev.get(key, 0.0) or 0.0)
+            if ckind == pkind:
+                c = float(cur.get(key, 0.0) or 0.0)
+                p = float(prev.get(key, 0.0) or 0.0)
+                label = f"{key} [{ckind} arms]"
+            else:
+                c = float(cur.get(
+                    f"{key}_python" if ckind == "native" else key,
+                    0.0) or 0.0)
+                p = float(prev.get(
+                    f"{key}_python" if pkind == "native" else key,
+                    0.0) or 0.0)
+                label = (f"{key} [python arms; wirepath_kind differs: "
+                         f"cur={ckind} prev={pkind}]")
             if p <= 0:
-                print(f"wire-floor: no previous {key}; skipping")
+                print(f"wire-floor: no previous {label}; skipping")
+                continue
+            if c <= 0:
+                rc = 1
+                print(f"FAIL wire-floor: {label} missing in the "
+                      f"current record")
                 continue
             floor = p * args.floor
             if c < floor:
                 rc = 1
-                print(f"FAIL wire-floor: {key} {c:.1f} MB/s < "
+                print(f"FAIL wire-floor: {label} {c:.1f} MB/s < "
                       f"{args.floor:.2f} x previous {p:.1f} "
                       f"(floor {floor:.1f})")
             else:
-                print(f"wire-floor: {key} {c:.1f} MB/s vs previous "
+                print(f"wire-floor: {label} {c:.1f} MB/s vs previous "
                       f"{p:.1f} ok")
     elif args.bench or args.prev:
         print("wire-floor: need BOTH --bench and --prev for the "

@@ -591,3 +591,218 @@ class TestBlobCrcReuse:
             await server.shutdown()
 
         run(go())
+
+
+# -- native wirepath (ISSUE 12): drain semantics + arm parity ----------------
+
+def _wirepath_native() -> bool:
+    from ceph_tpu.utils import wirepath
+
+    return wirepath.kind() == "native"
+
+
+def _drain_conn(raw: bytes):
+    """A minimal Connection wired to a detached FrameReceiver holding
+    ``raw`` as its buffered backlog — the unit under test is
+    _rx_drain_native alone (parse + one-call verify + one-call scatter),
+    with no transport or serve loop underneath."""
+    import collections
+
+    from ceph_tpu.native import bridge
+    from ceph_tpu.rados.messenger import (Connection, FrameReceiver,
+                                          _build_wire_perf)
+
+    class _Msgr:
+        perf = _build_wire_perf()
+
+    conn = object.__new__(Connection)
+    conn.reader = FrameReceiver(None, None, leftover=raw)
+    conn.messenger = _Msgr()
+    conn.crc_enabled = True
+    conn.wp = bridge
+    conn.lane_group = None
+    conn.in_seq = 0
+    conn._rx_stash = collections.deque()
+    conn._rx_error = None
+    return conn
+
+
+def _mk_frame(msg, seq: int) -> bytes:
+    from ceph_tpu.utils.checksum import checksum
+
+    payload = encode_payload(msg)
+    crc = checksum(payload) & 0xFFFFFFFF
+    return _HDR.pack(len(payload), 900, 1, 0, crc, seq) + payload
+
+
+from ceph_tpu.rados.messenger import encode_payload  # noqa: E402
+
+
+@pytest.mark.skipif(not _wirepath_native(), reason="native wirepath absent")
+class TestNativeRxDrain:
+    def test_burst_stashes_every_complete_frame(self):
+        frames = [MTest(text=f"t{i}", seqno=i) for i in range(5)]
+        raw = b"".join(_mk_frame(m, i + 1) for i, m in enumerate(frames))
+        # a trailing HALF frame must stay buffered, not parse
+        raw += _mk_frame(MTest(text="partial"), 9)[:-7]
+        conn = _drain_conn(raw)
+        conn._rx_drain_native()
+        assert len(conn._rx_stash) == 5
+        assert conn._rx_error is None
+        for i, (type_id, version, seq, payload, cost, blob, fixed,
+                verified) in enumerate(conn._rx_stash):
+            assert type_id == 900 and seq == i + 1
+            from ceph_tpu.rados.messenger import decode_message
+
+            m = decode_message(type_id, version, payload, blob, fixed)
+            assert m.text == f"t{i}" and m.seqno == i
+        # the half frame is still pending for the slow path
+        r = conn.reader
+        assert len(r._pending) - r._off == len(_mk_frame(
+            MTest(text="partial"), 9)) - 7
+
+    def test_corrupt_mid_burst_fails_after_the_good_frames(self):
+        """The slow path dispatches every frame before the corrupt one,
+        then kills the session — the native burst must keep exactly
+        that order: predecessors stash, the BadFrame parks, nothing
+        after the corrupt frame is touched."""
+        from ceph_tpu.rados.messenger import BadFrame
+
+        good0 = _mk_frame(MTest(text="ok0"), 1)
+        bad = bytearray(_mk_frame(MTest(text="dead"), 2))
+        bad[-1] ^= 0xFF  # corrupt the payload tail: crc must catch it
+        good1 = _mk_frame(MTest(text="ok1"), 3)
+        conn = _drain_conn(good0 + bytes(bad) + good1)
+        conn._rx_drain_native()
+        assert len(conn._rx_stash) == 1  # only the pre-corruption frame
+        assert isinstance(conn._rx_error, BadFrame)
+        # consumed THROUGH the bad frame; the trailing good frame stays
+        # unconsumed (the session dies before it would be read)
+        r = conn.reader
+        assert len(r._pending) - r._off == len(good1)
+        # a second drain is a no-op while the error is parked
+        conn._rx_drain_native()
+        assert len(conn._rx_stash) == 1
+
+    def test_blob_frame_lands_and_verifies(self):
+        from ceph_tpu.rados.messenger import decode_message
+        from ceph_tpu.utils.checksum import checksum
+
+        blob = bytes(range(256)) * 300  # 75 KiB
+        crc = checksum(blob) & 0xFFFFFFFF
+        raw = b"".join(_mk_frame(MTest(text=f"x{i}"), i + 1)
+                       for i in range(2))
+        conn0 = _drain_conn(raw)
+        conn0._rx_drain_native()
+        base = conn0.messenger.perf.dump()["native_rx_calls"]
+        assert base >= 1  # the verify call ran
+        # now a blob frame: prefix + pickled + raw blob, blob crc in
+        # the prefix (the scatter call must land it byte-identical)
+        import pickle
+
+        from ceph_tpu.rados.messenger import FLAG_BLOB, _BLOB_PFX
+
+        pickled = pickle.dumps({"chunk_crc": crc})
+        prefix = _BLOB_PFX.pack(len(pickled), crc)
+        head = prefix + pickled
+        hcrc = checksum(head) & 0xFFFFFFFF
+        frame = _HDR.pack(len(head) + len(blob), 910, 1, FLAG_BLOB,
+                          hcrc, 1) + head + blob
+        conn = _drain_conn(frame)
+        conn._rx_drain_native()
+        assert conn._rx_error is None
+        assert len(conn._rx_stash) == 1
+        (type_id, version, seq, payload, cost, got_blob, fixed,
+         verified) = conn._rx_stash[0]
+        assert verified  # the blob crc section was checked natively
+        out = decode_message(type_id, version, payload, got_blob, fixed)
+        assert bytes(out.chunk) == blob
+
+    def test_corrupt_blob_never_lands_a_byte(self):
+        """crc runs over the backlog BEFORE the scatter: a corrupt blob
+        frame must park the error without copying anything."""
+        import pickle
+
+        from ceph_tpu.rados.messenger import (BadFrame, FLAG_BLOB,
+                                              _BLOB_PFX)
+        from ceph_tpu.utils.checksum import checksum
+
+        blob = b"Q" * 70000
+        pickled = pickle.dumps({"chunk_crc": 0})
+        wrong = (checksum(blob) ^ 1) & 0xFFFFFFFF
+        prefix = _BLOB_PFX.pack(len(pickled), wrong)
+        head = prefix + pickled
+        frame = _HDR.pack(len(head) + len(blob), 910, 1, FLAG_BLOB,
+                          checksum(head) & 0xFFFFFFFF, 1) + head + blob
+        conn = _drain_conn(frame)
+        conn._rx_drain_native()
+        assert isinstance(conn._rx_error, BadFrame)
+        assert not conn._rx_stash
+
+
+class TestWirepathParity:
+    """Satellite (ISSUE 12): the injected-failure replay loops must
+    behave identically — same exactly-once dispatch, byte-identical
+    payloads — with the wirepath forced native and forced python."""
+
+    N = 48
+
+    def _arm(self, native: bool):
+        async def go():
+            conf = {"ms_wirepath_native": native,
+                    "ms_inject_socket_failures": 9,
+                    "ms_inject_dup_frames": 5}
+            server, client, addr = await _pair(dict(conf), dict(conf))
+            got = []
+            async def dispatch(conn, msg):
+                got.append((msg.seqno, bytes(msg.blob)))
+            server.dispatcher = dispatch
+            for i in range(self.N):
+                blob = bytes([(i * 7 + j) & 0xFF for j in range(512)]) \
+                    * (1 + i % 3)
+                await client.send(addr, MTest(seqno=i, blob=blob),
+                                  retries=10)
+            for _ in range(200):
+                if len({s for s, _ in got}) == self.N:
+                    break
+                await asyncio.sleep(0.05)
+            tx_native = client.perf.dump()["native_tx_calls"]
+            await client.shutdown()
+            await server.shutdown()
+            return got, tx_native
+
+        return run(go())
+
+    def test_native_and_python_arms_dispatch_identically(self):
+        native_got, native_tx = self._arm(True)
+        python_got, python_tx = self._arm(False)
+        want = [(i, bytes([(i * 7 + j) & 0xFF for j in range(512)])
+                 * (1 + i % 3)) for i in range(self.N)]
+        # exactly-once, in order, byte-identical — on BOTH arms
+        assert native_got == want
+        assert python_got == want
+        assert python_tx == 0  # the forced-python arm stayed python
+        if _wirepath_native():
+            assert native_tx > 0  # the native arm actually ran native
+
+    def test_env_knob_forces_python_arm(self, monkeypatch):
+        """CEPH_TPU_WIREPATH=0 (the CI parity knob) must force the
+        python arm process-wide, whatever the config says."""
+        from ceph_tpu.utils import wirepath
+
+        monkeypatch.setenv("CEPH_TPU_WIREPATH", "0")
+        wirepath._reset_for_tests()
+        try:
+            assert wirepath.kind() == "python"
+            assert wirepath.impl() is None
+            m = Messenger("knob", {"ms_wirepath_native": True})
+            assert m.wirepath is None
+            assert m.perf.dump()["wirepath_kind"] == 0
+        finally:
+            monkeypatch.delenv("CEPH_TPU_WIREPATH")
+            wirepath._reset_for_tests()
+
+    def test_config_knob_forces_python_arm(self):
+        m = Messenger("off", {"ms_wirepath_native": False})
+        assert m.wirepath is None
+        assert m.perf.dump()["wirepath_kind"] == 0

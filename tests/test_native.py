@@ -253,8 +253,178 @@ def test_sanitized_native_build_runs_clean(tmp_path):
         assert out.returncode == 0, (
             f"sanitized {workload} failed:\n{out.stderr.decode()}")
 
+    # wirepath leg (ISSUE 12): the scatter/gather + crc entry points
+    # under ASan/UBSan, driven by the in-library adversarial battery
+    # (truncated, overlapping, corrupt-offset and oversize fragment
+    # geometries — wirepath.cc's selftest).  An asan .so cannot be
+    # dlopen'd into a plain python process, so a sanitized exe wraps
+    # the battery, same discipline as the bench exe above.
+    wrapper = tmp_path / "wirepath_main.cc"
+    wrapper.write_text(
+        '#include <cstdint>\n'
+        '#include <cstdio>\n'
+        'extern "C" int32_t ceph_tpu_wirepath_selftest();\n'
+        'int main() {\n'
+        '  int32_t rc = ceph_tpu_wirepath_selftest();\n'
+        '  if (rc) std::fprintf(stderr, "wirepath selftest case %d "\n'
+        '                       "failed\\n", rc);\n'
+        '  return rc;\n'
+        '}\n')
+    subprocess.run(
+        ["g++", "-std=c++17", "-O1", *bridge.WARN_FLAGS,
+         *bridge.SANITIZE_FLAGS, "-o", str(sdir / "wirepath_selftest"),
+         str(wrapper), os.path.join(NATIVE, "wirepath.cc"),
+         os.path.join(NATIVE, "crc32c.cc")],
+        check=True, capture_output=True)
+    out = subprocess.run([str(sdir / "wirepath_selftest")],
+                         capture_output=True, timeout=300)
+    assert out.returncode == 0, (
+        f"sanitized wirepath battery failed:\n{out.stderr.decode()}")
+
     # the bridge's own sanitize flavor builds into a separate artifact
     # (never the one lib() loads)
     so = bridge.build(sanitize=True)
     assert so.endswith(os.path.join("sanitize", "libceph_tpu_ec.so"))
     assert os.path.exists(so)
+
+
+# -- native wirepath (ISSUE 12) ----------------------------------------------
+
+CORPUS_WIRE = os.path.join(os.path.dirname(NATIVE), "corpus", "wire")
+
+
+def test_wirepath_smoke_corpus_byte_identity():
+    """Tier-1 smoke: build-or-skip the wirepath symbols, then pin the
+    native arm against the python arm on a fixed sample of the golden
+    frame corpus — every crc the native batch computes and every byte
+    the native gather/scatter moves must equal the per-segment
+    interpreter loop's result on the same frames."""
+    from ceph_tpu.native import bridge
+
+    try:
+        bridge.build()
+        assert bridge.wirepath_kind() == "native"
+    except Exception as e:
+        pytest.skip(f"native wirepath unavailable: {e}")
+    assert bridge.wirepath_selftest() == 0
+    # a host with g++ but no Python.h has the CDLL arm only (the
+    # resolver runs such hosts on the python arm): still smoke the
+    # CDLL entry points, skip the shim's
+    wirepy = bridge.has_wirepy()
+
+    names = sorted(n for n in os.listdir(CORPUS_WIRE)
+                   if n.endswith(".frame"))[:12]
+    assert len(names) >= 8, "frame corpus sample missing"
+    frames = []
+    for n in names:
+        with open(os.path.join(CORPUS_WIRE, n), "rb") as f:
+            frames.append(f.read())
+
+    for raw in frames:
+        # split into awkward segments (odd boundaries, empty tail)
+        cut1, cut2 = max(1, len(raw) // 3), max(2, (2 * len(raw)) // 3)
+        segs = [raw[:cut1], raw[cut1:cut2], raw[cut2:], b""]
+        # python arm: one interpreter iteration + crc call per segment
+        py_crc = 0
+        for s in segs:
+            py_crc = bridge.crc32c(s, py_crc)
+        # native arms: one batched call each
+        assert bridge.wire_crc_batch([segs]) == [py_crc]
+        if wirepy:
+            assert bridge.wirepy_crc_chain(list(segs)) == py_crc
+        # gather == join, both entry points
+        out = bytearray(len(raw))
+        assert bridge.wire_gather(segs, out) == len(raw)
+        assert bytes(out) == raw
+        if wirepy:
+            out2 = bytearray(len(raw))
+            assert bridge.wirepy_gather(list(segs), out2) == len(raw)
+            assert bytes(out2) == raw
+        # fused copy+crc == copy then crc
+        dst = bytearray(len(raw))
+        assert bridge.wire_copy_crc32c(raw, dst) == bridge.crc32c(raw)
+        assert bytes(dst) == raw
+        # region verify over the original frame's own geometry
+        offs = [0, cut1, cut2]
+        lens = [cut1, cut2 - cut1, len(raw) - cut2]
+        wants = [bridge.crc32c(raw[o:o + ln]) for o, ln in zip(offs, lens)]
+        assert bridge.wire_verify_regions(raw, offs, lens, wants) == -1
+        if wirepy:
+            assert bridge.wirepy_verify_regions(raw, offs, lens,
+                                                wants) == -1
+        # scatter reassembly (arrival order != offset order) lands the
+        # frame byte-identical through the guarded path
+        back = bytearray(len(raw))
+        rc, bad = bridge.wire_scatter(
+            [segs[2], segs[0], segs[1]], [cut2, 0, cut1], back,
+            want_crcs=[bridge.crc32c(segs[2]), bridge.crc32c(segs[0]),
+                       bridge.crc32c(segs[1])])
+        assert (rc, bad) == (3, -1)
+        assert bytes(back) == raw
+        if wirepy:
+            back2 = [bytearray(ln) for ln in lens]
+            assert bridge.wirepy_scatter_from(raw, offs,
+                                              back2) == sum(lens)
+            assert b"".join(bytes(b) for b in back2) == raw
+
+
+def test_wirepath_hostile_geometry_refused():
+    """The FRAG_MAX overlap guard must hold in C: overlapping,
+    out-of-bounds, and corrupt-offset fragment geometries are refused
+    before a byte moves, on every scatter/gather entry point."""
+    from ceph_tpu.native import bridge
+
+    try:
+        bridge.build()
+    except Exception as e:
+        pytest.skip(f"native wirepath unavailable: {e}")
+    wirepy = bridge.has_wirepy()
+    data = bytes(range(256)) * 16
+    dst = bytearray(len(data))
+    # overlap within one batch
+    rc, bad = bridge.wire_scatter([data[:2048], data[:2048]], [0, 1024],
+                                  dst)
+    assert rc == -22 and bad == 1
+    # out-of-bounds tail
+    rc, bad = bridge.wire_scatter([data], [len(data) - 100], dst)
+    assert rc == -22 and bad == 0
+    # negative offset
+    rc, bad = bridge.wire_scatter([data[:16]], [-1], dst)
+    assert rc == -22 and bad == 0
+    # crc mismatch refuses BEFORE the copy
+    marker = bytearray(b"\x55" * len(data))
+    rc, bad = bridge.wire_scatter([data], [0], marker,
+                                  want_crcs=[bridge.crc32c(data) ^ 1])
+    assert rc == -74 and bad == 0
+    assert bytes(marker) == b"\x55" * len(data)
+    # gather into an undersized destination refuses, never spills
+    with pytest.raises(ValueError):
+        bridge.wire_gather([data], bytearray(len(data) - 1))
+    if wirepy:
+        with pytest.raises(ValueError):
+            bridge.wirepy_gather([data], bytearray(len(data) - 1))
+    # a READONLY destination refuses on every arm: the ctypes entry
+    # points must not silently memcpy into an immutable buffer's
+    # address (the wirepy arm refuses via PyBUF_WRITABLE)
+    ro = bytes(len(data))
+    with pytest.raises(TypeError):
+        bridge.wire_scatter([data[:16]], [0], ro)
+    with pytest.raises(TypeError):
+        bridge.wire_gather([data[:16]], ro)
+    with pytest.raises(TypeError):
+        bridge.wire_copy_crc32c(data[:16], ro)
+    if wirepy:
+        with pytest.raises(ValueError):
+            bridge.wirepy_gather([data[:16]], ro)
+        with pytest.raises(ValueError):
+            bridge.wirepy_scatter_from(data, [0], [ro[:16]])
+    # verify regions past the buffer refuse before any read
+    with pytest.raises(ValueError):
+        bridge.wire_verify_regions(data, [len(data) - 8], [64], [0])
+    if wirepy:
+        with pytest.raises(ValueError):
+            bridge.wirepy_verify_regions(data, [len(data) - 8], [64],
+                                         [0])
+        with pytest.raises(ValueError):
+            bridge.wirepy_scatter_from(data, [len(data) - 8],
+                                       [bytearray(64)])

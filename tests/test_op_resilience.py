@@ -167,7 +167,13 @@ class TestBackoffParkRelease:
         interval (unknown prior primary) BLOCKS mutations via
         MOSDBackoff and releases them when peering completes."""
         async def go():
-            cluster = Cluster(n_osds=4, conf=dict(CONF))
+            # the op deadline must comfortably outlast this test's own
+            # timeline (0.6s forge window + a get + the 10s release
+            # wait): under full-suite load a slow get let the 12s
+            # deadline expire while the put was still parked, failing
+            # the op with the backoff error instead of releasing it
+            conf = dict(CONF, client_op_deadline=40.0)
+            cluster = Cluster(n_osds=4, conf=conf)
             await cluster.start()
             try:
                 c = await cluster.client()

@@ -563,10 +563,17 @@ class TestWireFloor:
     multi-lane byte-identity loop (stubbed here; the real loop is
     exercised by the CI invocation and the lane tests)."""
 
-    def _write(self, path, put, get, wrapped=False):
+    def _write(self, path, put, get, wrapped=False, kind=None,
+               put_py=None, get_py=None):
         import json
 
         rec = {"daemon_wire_put_MBps": put, "daemon_wire_get_MBps": get}
+        if kind is not None:
+            rec["wirepath_kind"] = kind
+        if put_py is not None:
+            rec["daemon_wire_put_MBps_python"] = put_py
+        if get_py is not None:
+            rec["daemon_wire_get_MBps_python"] = get_py
         if wrapped:
             rec = {"n": 5, "parsed": rec}
         path.write_text(json.dumps(rec))
@@ -589,7 +596,7 @@ class TestWireFloor:
         assert non_regression.main(argv) == 1
         out = capsys.readouterr().out
         assert "FAIL wire-floor: daemon_wire_get_MBps" in out
-        assert "daemon_wire_put_MBps 210.0" in out
+        assert "daemon_wire_put_MBps [python arms] 210.0" in out
         # healthy record: green, and the lane-identity half ran too
         self._write(cur, 210.0, 290.0)
         assert non_regression.main(argv) == 0
@@ -628,3 +635,58 @@ class TestWireFloor:
         assert non_regression.main(
             ["--wire-floor", "--bench", str(cur),
              "--prev", str(tmp_path / "nope.json")]) == 1
+
+    def test_differing_arms_compare_python_numbers(self, tmp_path,
+                                                   capsys):
+        """Satellite (ISSUE 12): a native-arm record against a
+        python-arm record must compare the python numbers of each —
+        the arm speedup must not mask a real wire regression."""
+        prev = tmp_path / "prev.json"
+        cur = tmp_path / "cur.json"
+        # pre-ISSUE-12 record: no wirepath_kind == the python arm
+        self._write(prev, 200.0, 300.0)
+        # native headline LOOKS healthy (400 > 200) but the python arm
+        # of the same window regressed (90 < 0.8 * 200) — must FAIL
+        self._write(cur, 400.0, 500.0, kind="native",
+                    put_py=90.0, get_py=290.0)
+        argv = ["--wire-floor", "--bench", str(cur), "--prev", str(prev)]
+        assert non_regression.main(argv) == 1
+        out = capsys.readouterr().out
+        assert "wirepath_kind differs" in out
+        assert "FAIL wire-floor: daemon_wire_put_MBps" in out
+        # healthy python arm: green even though arms differ
+        self._write(cur, 400.0, 500.0, kind="native",
+                    put_py=195.0, get_py=290.0)
+        assert non_regression.main(argv) == 0
+        assert "FAIL" not in capsys.readouterr().out
+
+    def test_matching_native_arms_compare_headline(self, tmp_path,
+                                                   capsys):
+        prev = tmp_path / "prev.json"
+        cur = tmp_path / "cur.json"
+        self._write(prev, 400.0, 500.0, wrapped=True, kind="native",
+                    put_py=200.0, get_py=250.0)
+        # both native: the headline pair is like-for-like; a native-arm
+        # regression fails even with a healthy python arm
+        self._write(cur, 250.0, 480.0, kind="native",
+                    put_py=210.0, get_py=260.0)
+        argv = ["--wire-floor", "--bench", str(cur), "--prev", str(prev)]
+        assert non_regression.main(argv) == 1
+        out = capsys.readouterr().out
+        assert "[native arms]" in out
+        assert "FAIL wire-floor: daemon_wire_put_MBps" in out
+
+    def test_native_record_missing_python_arm_fails(self, tmp_path,
+                                                    capsys):
+        """A native-arm record that never measured its python arm
+        cannot be compared like-for-like against a python record —
+        that's a broken record, not a pass."""
+        prev = tmp_path / "prev.json"
+        cur = tmp_path / "cur.json"
+        self._write(prev, 200.0, 300.0)
+        self._write(cur, 400.0, 500.0, kind="native")
+        assert non_regression.main(
+            ["--wire-floor", "--bench", str(cur),
+             "--prev", str(prev)]) == 1
+        assert "missing in the current record" in \
+            capsys.readouterr().out
