@@ -827,6 +827,12 @@ def main() -> int:
     tier_ratio = got.get("tier_hot_vs_cold", 0.0)
     tier_perf: dict = got.get("tier_perf", {})
 
+    # ELASTIC-MEMBERSHIP arm: MB/s moved and the reserved client's p99
+    # impact DURING an out -> rebalance -> in cycle (CLASS_REBALANCE
+    # dmClock-throttled drain) — the operational cost of a membership
+    # change, measured, not assumed
+    rebalance: dict = _run_child_bench("--rebalance", timeout=600)
+
     print(json.dumps({
         "metric": f"ec_encode_GBps_k{K}m{M}_1MiB_stripes_batch{N_STRIPES}"
                   f"_packedbit_resident_{backend}",
@@ -966,6 +972,15 @@ def main() -> int:
         "tier_cold_read_MBps": round(tier_cold_mbps, 1),
         "tier_hot_vs_cold": round(tier_ratio, 2),
         "tier_perf": tier_perf,
+        # elastic-membership arm: data-movement rate and the reserved
+        # client's p99 while an out -> rebalance -> in cycle drains and
+        # refills one OSD under the background dmClock classes; the
+        # full child record (window, bytes, class counters, solo p99)
+        # rides in "rebalance"
+        "rebalance_MBps_moved": rebalance.get("rebalance_MBps_moved", 0.0),
+        "client_get_p99_ms_during_rebalance": rebalance.get(
+            "client_get_p99_ms_during_rebalance", 0.0),
+        "rebalance": rebalance,
         # cluster-log tail summary of the daemon arms (warning+ counts
         # by channel) + every crash report the bench mons collected —
         # a crashed daemon FAILS the bench below instead of passing as
@@ -1632,6 +1647,163 @@ def hot_read_bench() -> int:
     return 0
 
 
+def rebalance_bench() -> int:
+    """Elastic-membership arm (bench.py --rebalance): the number
+    operators actually care about — MB/s of data moved and the reserved
+    client's p99 impact DURING an out -> rebalance -> in cycle, not in a
+    quiet cluster.  A reserved tenant (qos_class:gold) paces gets
+    against a 5-OSD mclock cluster; its solo p99 is measured first, then
+    one OSD is marked out and the same traffic runs while CLASS_REBALANCE
+    sweeps drain the leaver (throttled by the background dmClock
+    profile).  MB/s moved = the OSDs' rebalance_bytes_moved delta over
+    the drain window.  The cycle completes with `osd in` + refill and
+    every byte verified."""
+    import asyncio
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from ceph_tpu.rados.vstart import Cluster
+
+    # enough data volume that the drain window is seconds, not
+    # milliseconds — the during-rebalance p99 needs a real sample count
+    n_objects = 48
+    obj_size = 256 << 10
+
+    async def go():
+        cluster = Cluster(n_osds=5, conf={
+            "osd_op_queue": "mclock",
+            "osd_mclock_profile": "balanced",
+            "osd_auto_repair": True,
+            "osd_heartbeat_interval": 0.1,
+            "osd_repair_delay": 0.1,
+            "osd_recovery_retry": 0.3,
+            "ms_local_fastpath": False,
+            "mon_osd_report_grace": 2.0,
+            "client_op_timeout": 30.0,
+            "client_op_deadline": 60.0})
+        await cluster.start()
+        try:
+            c = await cluster.client()
+            pool = await c.create_pool("rebal", profile={
+                "plugin": "jerasure", "technique": "reed_sol_van",
+                "k": "2", "m": "1"})
+            await c.pool_set(pool, "qos_class:gold", "100:20:0:0.5")
+            rng = np.random.default_rng(13)
+            blobs = {f"r{i}": rng.integers(0, 256, obj_size,
+                                           dtype=np.uint8).tobytes()
+                     for i in range(n_objects)}
+            for oid, blob in blobs.items():
+                await c.put(pool, oid, blob)
+            gold = await cluster.client()
+
+            async def traffic(samples, stop):
+                oids = list(blobs)
+                i = 0
+                while not stop.is_set():
+                    oid = oids[i % len(oids)]
+                    i += 1
+                    t0 = time.perf_counter()
+                    got = await gold.get(pool, oid,
+                                         client="client.gold.0")
+                    samples.append(time.perf_counter() - t0)
+                    assert bytes(got) == blobs[oid]
+                    await asyncio.sleep(0.02)  # ~50 ops/s paced
+
+            async def run_window(seconds_or_pred):
+                samples: list = []
+                stop = asyncio.Event()
+                t = asyncio.get_running_loop().create_task(
+                    traffic(samples, stop))
+                t0 = time.perf_counter()
+                if callable(seconds_or_pred):
+                    while not seconds_or_pred() \
+                            and time.perf_counter() - t0 < 60.0:
+                        await asyncio.sleep(0.1)
+                else:
+                    await asyncio.sleep(seconds_or_pred)
+                stop.set()
+                await t
+                return samples, time.perf_counter() - t0
+
+            victim_id = sorted(cluster.osds)[0]
+            victim = cluster.osds[victim_id]
+
+            def victim_shards():
+                return sum(1 for (p, _o, _s) in victim.store._data
+                           if p == pool)
+
+            for _ in range(100):
+                if victim_shards():
+                    break
+                await asyncio.sleep(0.05)
+            shards_before = victim_shards()
+
+            solo_samples, _ = await run_window(3.0)
+
+            # the measured window is the FULL cycle: out -> drain
+            # converged -> in -> refill converged, all with the gold
+            # client reading throughout
+            moved0 = sum(o.perf.get("rebalance_bytes_moved")
+                         for o in cluster.osds.values())
+            drained = {"ok": False}
+
+            async def cycle():
+                await c.osd_out(victim_id)
+                for _ in range(600):
+                    if victim_shards() == 0:
+                        break
+                    await asyncio.sleep(0.1)
+                drained["ok"] = victim_shards() == 0
+                await c.osd_in(victim_id)
+                for _ in range(600):
+                    if victim_shards() >= max(1, shards_before // 2):
+                        break
+                    await asyncio.sleep(0.1)
+
+            cyc = asyncio.get_running_loop().create_task(cycle())
+            rebal_samples, window_s = await run_window(
+                lambda: cyc.done())
+            await cyc
+            moved = sum(o.perf.get("rebalance_bytes_moved")
+                        for o in cluster.osds.values()) - moved0
+            converged = drained["ok"] and victim_shards() > 0
+            for oid, blob in blobs.items():
+                assert bytes(await c.get(pool, oid)) == blob
+
+            classed = {
+                cls: sum(o.sched_perf.get(f"enqueue_{cls}")
+                         for o in cluster.osds.values())
+                for cls in ("rebalance", "recovery", "scrub")}
+            await gold.stop()
+            await c.stop()
+            return (solo_samples, rebal_samples, window_s, moved,
+                    converged, classed)
+        finally:
+            await cluster.stop()
+
+    (solo_samples, rebal_samples, window_s, moved, converged,
+     classed) = asyncio.run(go())
+
+    def p99_ms(samples):
+        if not samples:
+            return 0.0
+        return round(float(np.percentile(np.array(samples), 99)) * 1e3, 2)
+
+    solo_p99 = p99_ms(solo_samples)
+    rebal_p99 = p99_ms(rebal_samples)
+    print(json.dumps({
+        "rebalance_MBps_moved": round(moved / max(window_s, 1e-9) / 1e6, 2),
+        "rebalance_bytes_moved": int(moved),
+        "rebalance_window_s": round(window_s, 2),
+        "rebalance_converged": bool(converged),
+        "client_get_p99_ms_solo": solo_p99,
+        "client_get_p99_ms_during_rebalance": rebal_p99,
+        "rebalance_p99_impact": round(rebal_p99 / solo_p99, 2)
+        if solo_p99 else 0.0,
+        "rebalance_sched_classes": classed,
+    }))
+    return 0 if converged else 1
+
+
 def macro_bench() -> int:
     """Multi-tenant macro traffic arm (bench.py --macro): thousands of
     simulated tenants over a handful of client processes drive zipfian
@@ -1877,6 +2049,8 @@ if __name__ == "__main__":
         sys.exit(msgr_stream_bench())
     if "--hot-read" in sys.argv:
         sys.exit(hot_read_bench())
+    if "--rebalance" in sys.argv:
+        sys.exit(rebalance_bench())
     if "--macro" in sys.argv:
         sys.exit(macro_bench())
     if "--onhost-overlap" in sys.argv:

@@ -80,10 +80,24 @@ class AdminSocket:
     # -- direct (in-process) execution --------------------------------------
 
     def execute(self, prefix: str, **args: Any) -> Any:
+        """Run one hook.  May return an AWAITABLE when the hook is an
+        async def (long-running commands like `pg scrub`): async
+        callers (the unix-socket server, the MCommand tell handlers)
+        await it; sync callers get the coroutine and must drive it."""
         hook = self._hooks.get(prefix)
         if hook is None:
             raise KeyError(f"unknown admin command {prefix!r}")
         return hook(args)
+
+    async def execute_async(self, prefix: str, **args: Any) -> Any:
+        """execute(), with awaitable results awaited — the one call
+        async transports (asok server, MCommand) should use."""
+        import inspect
+
+        result = self.execute(prefix, **args)
+        if inspect.isawaitable(result):
+            result = await result
+        return result
 
     # -- unix socket server --------------------------------------------------
 
@@ -120,7 +134,7 @@ class AdminSocket:
                 try:
                     req = json.loads(line)
                     prefix = req.pop("prefix")
-                    result = self.execute(prefix, **req)
+                    result = await self.execute_async(prefix, **req)
                     reply = {"ok": True, "result": result}
                 except Exception as e:  # command errors go back to the caller
                     reply = {"ok": False, "error": f"{type(e).__name__}: {e}"}

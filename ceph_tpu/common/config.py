@@ -282,6 +282,26 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
     Option("osd_mclock_max_clients", OPT_INT, 1024,
            desc="per-shard bound on per-client dmClock states (idle "
                 "states pruned oldest-first)"),
+    Option("osd_mclock_profile", OPT_STR, "balanced",
+           enum_values=("balanced", "high_client_ops",
+                        "high_recovery_ops"),
+           desc="background dmClock profile set: how the mClock "
+                "scheduler splits IOPS between client, recovery, "
+                "rebalance, scrub and best-effort classes "
+                "(mclock_<class>_res/wgt/lim/burst override "
+                "individual values)"),
+    Option("osd_qos_burst_allowance", OPT_FLOAT, 0.0,
+           desc="default rho/delta burst credit (seconds) a client "
+                "profile banks while idle when the pool declares no "
+                "qos_burst — burst*rate immediately-eligible ops"),
+    Option("osd_qos_normalize_spread", OPT_BOOL, True,
+           desc="divide per-client reservation/limit by the pool's "
+                "primary spread so a tenant served by N OSDs gets its "
+                "nominal profile cluster-wide instead of N x it"),
+    Option("osd_background_qos", OPT_BOOL, True,
+           desc="route backfill/recovery/scrub per-object work through "
+                "the sharded op queue under background dmClock classes "
+                "(off: background sweeps run unthrottled)"),
     Option("osd_qos_max_clients", OPT_INT, 4096,
            desc="bound on the admission tracker's per-client states"),
     # op tracking + slow-op health (reference osd_op_complaint_time /

@@ -77,6 +77,7 @@ from ceph_tpu.rados.types import (
     MPoolSet,
     MSetUpmap,
     MMarkDown,
+    MOsdMembership,
     MOSDOp,
     MOSDOpReply,
     MSnapOp,
@@ -581,6 +582,68 @@ class RadosClient:
         """Admin: immediately mark an OSD down+out (test/thrash hook)."""
         await self._mon_rpc(MMarkDown(osd_id=osd_id))
         await self.refresh_map()
+
+    async def _osd_membership(self, op: str, osd_id: int,
+                              weight: float = 1.0) -> None:
+        await self._mon_rpc(
+            MOsdMembership(op=op, osd_id=int(osd_id),
+                           weight=float(weight)))
+        await self.refresh_map()
+
+    async def osd_out(self, osd_id: int) -> None:
+        """`ceph osd out <id>`: drop the OSD from placement (weight 0
+        through the in_cluster gate) while it stays up — CRUSH remaps
+        its PGs minimally and backfill drains it.  Sticky across the
+        OSD's reboots until `osd in`."""
+        await self._osd_membership("out", osd_id)
+
+    async def osd_in(self, osd_id: int) -> None:
+        """`ceph osd in <id>`: restore an out OSD to placement."""
+        await self._osd_membership("in", osd_id)
+
+    async def osd_reweight(self, osd_id: int, weight: float) -> None:
+        """`ceph osd reweight <id> <0..1>`: the reweight overlay — a
+        fractional multiplier on the OSD's crush weight (0 behaves
+        like out)."""
+        await self._osd_membership("reweight", osd_id, weight)
+
+    async def osd_crush_reweight(self, osd_id: int,
+                                 weight: float) -> None:
+        """`ceph osd crush reweight osd.<id> <w>`: the straw2 crush
+        weight (nominal device capacity share)."""
+        await self._osd_membership("crush-reweight", osd_id, weight)
+
+    def _parse_pgid(self, pgid: str) -> Tuple[int, int]:
+        pool_part, pg_part = str(pgid).split(".", 1)
+        return int(pool_part), int(pg_part, 16)
+
+    async def _pg_tell(self, pgid: str, prefix: str,
+                       timeout: float = 60.0):
+        """Route a single-PG admin command to the PG's primary via the
+        MCommand tell path (`ceph pg scrub/repair <pgid>`)."""
+        if self.osdmap is None:
+            await self.refresh_map()
+        try:
+            pool_id, pg = self._parse_pgid(pgid)
+        except ValueError:
+            raise RadosError(f"bad pgid {pgid!r} (want <pool>.<hexpg>)")
+        pool = self.osdmap.pools.get(pool_id)
+        if pool is None or pg < 0 or pg >= pool.pg_num:
+            raise RadosError(f"no such pg {pgid!r}")
+        primary = self._pg_primary(pool_id, pg)
+        if primary is None:
+            raise RadosError(f"pg {pgid} has no live primary")
+        return await self.tell(f"osd.{primary}", prefix,
+                               timeout=timeout, pgid=f"{pool_id}.{pg:x}")
+
+    async def pg_scrub(self, pgid: str) -> Dict:
+        """`ceph pg scrub <pgid>`: deep-scrub one PG on its primary."""
+        return await self._pg_tell(pgid, "pg scrub")
+
+    async def pg_repair(self, pgid: str) -> Dict:
+        """`ceph pg repair <pgid>`: scrub + repair + verify one PG;
+        a clean verify pass clears its PG_INCONSISTENT record."""
+        return await self._pg_tell(pgid, "pg repair")
 
     async def get_health(self, detail: bool = False) -> Dict:
         """Cluster health from the mon's aggregation (reference `ceph

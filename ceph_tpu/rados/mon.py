@@ -82,6 +82,7 @@ from ceph_tpu.rados.types import (
     MOSDFailure,
     MOSDPGTemp,
     MOsdBoot,
+    MOsdMembership,
     MOSDSetFlag,
     MPoolSet,
     MSetFullRatio,
@@ -94,6 +95,7 @@ from ceph_tpu.rados.types import (
     OSDMapIncremental,
     OsdInfo,
     PoolInfo,
+    osd_crush_weight,
 )
 
 DEFAULT_STRIPE_UNIT = 4096  # reference osd_pool_erasure_code_stripe_unit
@@ -161,6 +163,12 @@ class Monitor:
         # BEFORE the state recovery below, which may restore them.
         self._health_reports: Dict[int, Dict] = {}  # osd -> {checks, stamp}
         self._health_mutes: Dict[str, float] = {}
+        # OSDs an ADMIN marked out (`ceph osd out`): sticky across the
+        # OSD's reboots — a booting/rejoining daemon is auto-marked in
+        # only when not admin-out (reference noin semantics for the one
+        # OSD).  Paxos-replicated (rides the snapshot below) so a
+        # leader change cannot silently pull a draining OSD back in.
+        self._admin_out: Set[int] = set()
         # per-daemon observability bundle (CephContext role): local log
         # (messenger/paxos douts ride it), admin socket, config proxy —
         # the mon is a daemon like any other now
@@ -228,6 +236,7 @@ class Monitor:
                 "auth_keys": (self.keyserver.current_id,
                               self.keyserver.export_keys()),
                 "health_mutes": mutes,
+                "admin_out": sorted(self._admin_out),
                 "clog": self.logm.snapshot(),
             },
             protocol=5,
@@ -241,6 +250,9 @@ class Monitor:
         self.cluster_conf = state["cluster_conf"]
         self._next_osd_id = max(self._next_osd_id, state["next_osd_id"])
         self._next_pool_id = max(self._next_pool_id, state["next_pool_id"])
+        admin_out = state.get("admin_out")
+        if admin_out is not None:
+            self._admin_out = set(admin_out)
         mutes = state.get("health_mutes")
         if mutes is not None:
             now = time.monotonic()
@@ -947,6 +959,7 @@ class Monitor:
     # degraded cluster HEALTH_OK.  MLog/MCrashReport/MCrashQuery are
     # LogMonitor state: replicated, so leader-only mutations.
     WRITE_TYPES = (MOsdBoot, MCreatePool, MDeletePool, MMarkDown,
+                   MOsdMembership,
                    MConfigSet, MOSDFailure,
                    MOSDPGTemp, MSetUpmap, MPoolSet, MSnapOp, MOSDSetFlag,
                    MSetFullRatio,
@@ -957,7 +970,8 @@ class Monitor:
     # execution — daemon-internal traffic (boots, failure reports,
     # pg_temp churn, log pushes) would drown the channel and is not an
     # operator action
-    AUDIT_TYPES = (MCreatePool, MDeletePool, MMarkDown, MConfigSet,
+    AUDIT_TYPES = (MCreatePool, MDeletePool, MMarkDown, MOsdMembership,
+                   MConfigSet,
                    MSetUpmap, MPoolSet, MSnapOp, MOSDSetFlag,
                    MSetFullRatio, MHealthMute, MCrashQuery)
 
@@ -1052,8 +1066,8 @@ class Monitor:
                                       error="EPERM: unauthenticated tell")
             else:
                 try:
-                    result = self.ctx.asok.execute(msg.prefix,
-                                                   **(msg.args or {}))
+                    result = await self.ctx.asok.execute_async(
+                        msg.prefix, **(msg.args or {}))
                     reply = MCommandReply(tid=msg.tid, ok=True,
                                           result=result)
                 except Exception as e:
@@ -1137,7 +1151,7 @@ class Monitor:
         rejoined = info is not None and not info.up
         if rejoined:
             info.up = True
-            info.in_cluster = True
+            info.in_cluster = msg.osd_id not in self._admin_out
             changed = True
         if changed:
             self.osdmap.epoch += 1
@@ -1219,7 +1233,13 @@ class Monitor:
             used = int(st.get("used", 0) or 0)
             out[osd_id] = {
                 "up": bool(info.up),
+                "in": bool(info.in_cluster),
+                # WEIGHT = crush weight, REWEIGHT = the 0..1 overlay
+                # (the `ceph osd df` column pair); "weight" keeps the
+                # historic meaning (the overlay) for old renderers
                 "weight": info.weight,
+                "crush_weight": osd_crush_weight(info),
+                "reweight": info.weight,
                 "total": total,
                 "used": used,
                 "avail": int(st.get("avail", 0) or 0),
@@ -1380,6 +1400,56 @@ class Monitor:
                 self.logm.log("cluster", CLOG_WARN,
                               f"osd.{msg.osd_id} marked down (admin)")
                 await self._commit_state()
+            return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+        if isinstance(msg, MOsdMembership):
+            # `ceph osd out/in/reweight/crush reweight` (reference
+            # OSDMonitor prepare_command): audited admin membership
+            # mutation.  Every arm replies with the (possibly bumped)
+            # map; invalid requests leave the map untouched — the CLI
+            # validates and reports, the mon never half-applies.
+            info = self.osdmap.osds.get(msg.osd_id)
+            if info is None:
+                return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+            changed = False
+            if msg.op == "out":
+                self._admin_out.add(msg.osd_id)
+                if info.in_cluster:
+                    # up stays as-is: the OSD keeps serving (and later
+                    # drains via stray purge); only placement weight
+                    # drops to zero through the in_cluster gate
+                    info.in_cluster = False
+                    changed = True
+                    self.logm.log("cluster", CLOG_WARN,
+                                  f"osd.{msg.osd_id} marked out (admin)")
+            elif msg.op == "in":
+                self._admin_out.discard(msg.osd_id)
+                if not info.in_cluster:
+                    info.in_cluster = True
+                    changed = True
+                    self.logm.log("cluster", CLOG_INFO,
+                                  f"osd.{msg.osd_id} marked in (admin)")
+            elif msg.op == "reweight":
+                # the 0..1 overlay (reference: reweight is clamped)
+                w = min(1.0, max(0.0, float(msg.weight)))
+                if info.weight != w:
+                    info.weight = w
+                    changed = True
+                    self.logm.log("cluster", CLOG_INFO,
+                                  f"osd.{msg.osd_id} reweighted to {w:g}")
+            elif msg.op == "crush-reweight":
+                w = max(0.0, float(msg.weight))
+                if osd_crush_weight(info) != w:
+                    info.crush_weight = w
+                    self.osdmap.crush.set_weight(msg.osd_id, w)
+                    changed = True
+                    self.logm.log("cluster", CLOG_INFO,
+                                  f"osd.{msg.osd_id} crush weight set "
+                                  f"to {w:g}")
+            if changed:
+                self.osdmap.epoch += 1
+            # admin_out stickiness changed even when the map did not
+            # (out of an already-out OSD): replicate either way
+            await self._commit_state()
             return MMapReply(osdmap=self.osdmap, tid=msg.tid)
         if isinstance(msg, MOSDFailure):
             # OSD-observed failure report (OSDMonitor::prepare_failure):
@@ -1747,7 +1817,8 @@ class Monitor:
         if isinstance(msg, MCrashQuery):
             return MCrashQueryReply(tid=tid, ok=False, error=error)
         if isinstance(msg, (MMarkDown, MGetMap, MPing, MOSDFailure,
-                            MOSDPGTemp, MSetUpmap, MPoolSet, MOSDSetFlag)):
+                            MOSDPGTemp, MSetUpmap, MPoolSet, MOSDSetFlag,
+                            MOsdMembership)):
             return MMapReply(osdmap=self.osdmap, tid=tid)
         if isinstance(msg, MOsdBoot):
             return MBootReply(osd_id=-1, osdmap=self.osdmap, tid=tid)
@@ -1765,7 +1836,10 @@ class Monitor:
         else:
             info.addr = tuple(msg.addr)
             info.up = True
-            info.in_cluster = True
+            # auto-mark-in on boot — EXCEPT an admin-out OSD: the
+            # operator's `osd out` survives the daemon's restarts until
+            # an explicit `osd in` (reference noin discipline)
+            info.in_cluster = osd_id not in self._admin_out
         self._last_ping[osd_id] = time.monotonic()
         self.osdmap.epoch += 1
         self.logm.log("cluster", CLOG_INFO,
@@ -1779,13 +1853,20 @@ class Monitor:
 
     def _rebuild_crush(self) -> None:
         """Rebuild the crush tree over the current OSD set (flat by
-        default; host buckets when crush_num_hosts is configured) and
-        re-register every pool's rule with its failure domain."""
+        default; host buckets when crush_num_hosts is configured),
+        re-apply stored per-device crush weights, and re-register every
+        pool's rule with its failure domain."""
         ids = sorted(self.osdmap.osds)
         n_hosts = int(self.conf.get("crush_num_hosts", 0) or 0)
         self.osdmap.crush = (
             CrushMap.with_hosts(ids, n_hosts) if n_hosts else CrushMap.flat(ids)
         )
+        # a rebuild (new OSD boot) must not reset `osd crush reweight`:
+        # the authoritative weights live on the OsdInfo records
+        for osd_id, info in self.osdmap.osds.items():
+            w = osd_crush_weight(info)
+            if w != 1.0:
+                self.osdmap.crush.set_weight(osd_id, w)
         for pool in self.osdmap.pools.values():
             self.osdmap.crush.add_simple_rule(
                 pool.rule,

@@ -81,11 +81,14 @@ from ceph_tpu.rados.peering import (
 )
 from ceph_tpu.rados.pglog import ZERO, LogEntry, PGLog, pack_eversion
 from ceph_tpu.rados.qos import (QosParams, QosTracker, build_scheduler_perf,
-                                pool_qos, qos_op_cost, tenant_class)
+                                pool_qos, primary_spread, qos_op_cost,
+                                tenant_class)
 from ceph_tpu.rados.scheduler import (
     CLASS_BEST_EFFORT,
     CLASS_CLIENT,
+    CLASS_REBALANCE,
     CLASS_RECOVERY,
+    CLASS_SCRUB,
     ShardedOpQueue,
 )
 from ceph_tpu.rados.store import (ENOSPCError, MemStore, ObjectStore,
@@ -143,6 +146,7 @@ from ceph_tpu.rados.types import (
     MWatchNotify,
     OSDMap,
     PoolInfo,
+    osd_crush_weight,
     ALL_NSPACES,
     is_snap_clone,
     snap_clone_oid,
@@ -331,6 +335,22 @@ class OSD:
             .add_u64_counter("backfill_toofull_refusals",
                              "backfill reservations refused because this "
                              "OSD is past its backfillfull ratio")
+            .add_u64_counter("backfill_bytes_moved",
+                             "shard bytes pushed by backfill/recovery "
+                             "sweeps this OSD led")
+            .add_u64_counter("rebalance_push",
+                             "shards pushed by pure REBALANCE sweeps "
+                             "(membership/weight change, no redundancy "
+                             "loss)")
+            .add_u64_counter("rebalance_bytes_moved",
+                             "shard bytes moved by pure rebalance sweeps "
+                             "(the bench arm's MB/s-moved numerator)")
+            .add_u64_counter("scrub_errors_found",
+                             "shard mismatches found by deep scrub "
+                             "(crc/hinfo/absence)")
+            .add_u64_counter("scrub_repaired",
+                             "scrub-found shards repaired by re-encode "
+                             "+ push")
             .add_u64("ec_batch_ops",
                      "requests submitted to the shared queue (gauge)")
             .add_u64("ec_batch_dispatches",
@@ -409,6 +429,17 @@ class OSD:
         self._last_scrub: Dict[Tuple[int, int], float] = {}
         self._last_scrub_scan = 0.0
         self._scrub_task: Optional[asyncio.Task] = None
+        # scrub-found inconsistency per PG this OSD leads: (pool, pg) ->
+        # {"errors", "repaired", "stamp"} for the most recent scrub pass
+        # that found mismatches.  Rides the MPing health field as
+        # OSD_SCRUB_ERRORS / PG_INCONSISTENT; CLEARED when a later
+        # scrub/repair pass of the PG verifies zero mismatches (repair
+        # confirmed — the raise/clear lifecycle `ceph pg repair` drives).
+        self._scrub_errors: Dict[Tuple[int, int], Dict[str, float]] = {}
+        # (epoch, {pool_id: distinct primaries}) memo for the cross-OSD
+        # QoS normalization divisor (qos.primary_spread): O(pg_num)
+        # CRUSH work, recomputed only when the map moves
+        self._spread_memo: Tuple[int, Dict[int, int]] = (-1, {})
         # active MOSDBackoff blocks this primary holds on clients:
         # (pool, pg) -> {"id": block id, "conns": {id(conn): conn}} —
         # released (unblock sent to every registered conn) when the PG's
@@ -584,6 +615,20 @@ class OSD:
             "inject_crash", lambda a: self.inject_crash(),
             "raise a fatal exception in the next ping tick "
             "(crash-telemetry exercise)")
+        # single-PG scrub/repair (reference `ceph pg scrub/repair
+        # <pgid>`): reached via the MCommand tell path aimed at the
+        # PG's primary — the hooks are async; execute_async awaits them
+        self.ctx.asok.register(
+            "pg scrub",
+            lambda a: self._pg_admin_scrub(a.get("pgid", ""),
+                                           repair=False),
+            "deep-scrub one PG this OSD leads (pgid=<pool>.<hex>)")
+        self.ctx.asok.register(
+            "pg repair",
+            lambda a: self._pg_admin_scrub(a.get("pgid", ""),
+                                           repair=True),
+            "scrub + repair + verify one PG this OSD leads "
+            "(pgid=<pool>.<hex>)")
         asok_dir = self.conf.get("admin_socket_dir")
         if asok_dir:
             self.ctx.asok.register(
@@ -665,7 +710,7 @@ class OSD:
         self._stopped = True
         await self.clog.stop()
         for t in (self._ping_task, self._hb_task, self._repair_task,
-                  self._meta_repl_task):
+                  self._meta_repl_task, self._scrub_task):
             if t:
                 t.cancel()
         for m in self._pg_machines.values():
@@ -756,6 +801,36 @@ class OSD:
                     "resident_bytes": resident,
                     "target_bytes": target,
                 }
+        if self._scrub_errors:
+            # scrub-found inconsistency (reference OSD_SCRUB_ERRORS +
+            # PG_INCONSISTENT off scrub stats): raised while any PG this
+            # OSD leads had mismatches on its last scrub; cleared when a
+            # later scrub/repair pass verifies the PG clean (the next
+            # ping omits the check and the mon drops it)
+            keys = sorted(self._scrub_errors)  # numeric (pool, pg) order
+            pgs = [f"{k[0]}.{k[1]:x}" for k in keys]
+            n_err = int(sum(rec.get("errors", 0)
+                            for rec in self._scrub_errors.values()))
+            checks["OSD_SCRUB_ERRORS"] = {
+                "severity": "error",
+                "summary": f"{n_err} scrub errors",
+                "count": n_err,
+            }
+            checks["PG_INCONSISTENT"] = {
+                "severity": "error",
+                "summary": f"{len(pgs)} pg(s) inconsistent "
+                           f"(scrub found shard mismatches)",
+                "count": len(pgs),
+                "pgs": pgs,
+                "detail": [
+                    f"pg {pgid} inconsistent: "
+                    f"{int(rec.get('errors', 0))} mismatched shard(s), "
+                    f"{int(rec.get('repaired', 0))} repaired; run "
+                    f"`ceph pg repair {pgid}` (or wait for the next "
+                    f"scrub) to verify and clear"
+                    for pgid, rec in zip(pgs, (
+                        self._scrub_errors[k] for k in keys))],
+            }
         toofull = sorted(
             f"{k[0]}.{k[1]:x}" for k, m in self._pg_machines.items()
             if getattr(m, "backfill_toofull", False))
@@ -1174,11 +1249,16 @@ class OSD:
             # classes; a full queue blocks HERE so the messenger stops
             # reading and backpressure reaches the sender
             pg_key = self._pg_key_of(msg)
-            if msg.op == "notify":
+            if msg.op in ("notify", "deep-scrub", "repair"):
                 # notify gathers watcher acks for seconds and touches no
                 # PG state: it runs as its OWN task so neither the shard
                 # worker nor this serve loop blocks (a watcher callback
-                # may issue ops through both)
+                # may issue ops through both).  deep-scrub/repair are
+                # multi-second fan-out sweeps whose per-object work now
+                # waits its dmClock turn (CLASS_SCRUB/CLASS_RECOVERY)
+                # through _background_throttle — run them OUTSIDE the
+                # queue so a sweep never holds a shard slot hostage
+                # while its own throttle items wait behind it
                 t = asyncio.get_running_loop().create_task(
                     self._handle_client_op(conn, msg))
                 self.messenger._tasks.add(t)
@@ -1209,6 +1289,13 @@ class OSD:
                 qos_params = pool_qos(pool, client, self.conf) \
                     if pool is not None else None
                 if qos_params is not None:
+                    # cross-OSD normalization: the declared profile is
+                    # the tenant's CLUSTER-WIDE entitlement; this OSD
+                    # enforces its 1/spread share so N independent
+                    # primaries sum to the nominal rate, not N x it
+                    if self.conf.get("osd_qos_normalize_spread", True):
+                        qos_params = qos_params.normalized(
+                            self._primary_spread(pool))
                     self.qos.observe(client, qos_params, cost=qcost)
             # arrival-side saturation shed: a saturated OSD drops-and-
             # blocks HERE, before the op consumes a queue slot — the
@@ -1301,8 +1388,8 @@ class OSD:
                                       error="EPERM: unauthenticated tell")
             else:
                 try:
-                    result = self.ctx.asok.execute(msg.prefix,
-                                                   **(msg.args or {}))
+                    result = await self.ctx.asok.execute_async(
+                        msg.prefix, **(msg.args or {}))
                     reply = MCommandReply(tid=msg.tid, ok=True,
                                           result=result)
                 except Exception as e:
@@ -1440,7 +1527,8 @@ class OSD:
             # prune intervals of deleted pools (bounded memory)
             for d in (self._prior_acting, self._past_members,
                       self._pg_machines, self._partial_newer,
-                      self._hit_sets, self._hit_set_epochs):
+                      self._hit_sets, self._hit_set_epochs,
+                      self._scrub_errors):
                 for key in [k for k in d if k[0] not in osdmap.pools]:
                     d.pop(key, None)
         elif old is None:
@@ -1495,6 +1583,16 @@ class OSD:
                     pool, key[1],
                     osdmap.pg_to_acting(pool, key[1])) != self.osd_id:
                 self._release_backoffs(key)
+        # drop scrub-error records for PGs we no longer lead: only the
+        # primary scrubs, so a record held past primaryship loss (or a
+        # pool deletion) would raise PG_INCONSISTENT forever with no
+        # pass left to clear it — the new primary's scrub owns the state
+        for key in list(self._scrub_errors):
+            pool = osdmap.pools.get(key[0])
+            if pool is None or key[1] >= pool.pg_num or self._primary(
+                    pool, key[1],
+                    osdmap.pg_to_acting(pool, key[1])) != self.osd_id:
+                self._scrub_errors.pop(key, None)
         # event-driven recovery (reference AdvMap/ActMap): kick the peering
         # statechart for exactly the PGs whose mapping changed — repair
         # traffic for one failed OSD touches only that OSD's PGs
@@ -1521,8 +1619,9 @@ class OSD:
         if old.osds.keys() != new.osds.keys():
             return True
         return any(
-            (o.up, o.in_cluster, o.weight)
-            != (new.osds[i].up, new.osds[i].in_cluster, new.osds[i].weight)
+            (o.up, o.in_cluster, o.weight, osd_crush_weight(o))
+            != (new.osds[i].up, new.osds[i].in_cluster,
+                new.osds[i].weight, osd_crush_weight(new.osds[i]))
             for i, o in old.osds.items()
         )
 
@@ -1716,6 +1815,13 @@ class OSD:
             finally:
                 if got_slot:
                     self._local_reserver.release(key)
+            # an interval change mid-push may have reset the statechart
+            # to GetInfo under us (new_interval runs lock-free from
+            # _kick_peering; only m.task is cancelled, and THIS pass may
+            # be the repair/admin one) — never transition out of a dead
+            # interval
+            if m.is_stale(epoch):
+                return False, pushed
             m.transition(ACTIVE)
         if m.is_stale(epoch):
             return False, pushed
@@ -1728,8 +1834,15 @@ class OSD:
         # shard j) — its log is current, so log recovery skips it, but its
         # data is wrong for its seat.  Only the backfill sweep compares
         # data-at-position; run it until a verified-clean pass pops the
-        # interval record.
+        # interval record.  _past_members forces the sweep for the same
+        # reason even after _prior_acting was popped (pg_temp clearing
+        # pops it): a LEAVER of the interval (an out/reweighted-away
+        # member) may still hold strays, and only the sweep's listing
+        # sees and purges them — without this, the pass after a pg_temp
+        # clear would skip straight to Clean and strand the leaver's
+        # shards forever.
         backfill |= key in self._prior_acting
+        backfill |= key in self._past_members
         covered = True
         if backfill:
             await self._maybe_request_pg_temp(pool, pg, acting)
@@ -1813,6 +1926,10 @@ class OSD:
                     shard_of_peer = shard
                     break
             for oid, entry in miss.items():
+                # log-driven recovery is classed work too: each push
+                # waits its CLASS_RECOVERY dmClock turn
+                await self._background_throttle(
+                    CLASS_RECOVERY, (pool.pool_id << 20) | pg)
                 if entry.op == "delete":
                     try:
                         await self.messenger.send(
@@ -1839,6 +1956,8 @@ class OSD:
                 try:
                     await self.messenger.send(self.osdmap.addr_of(osd), push)
                     pushed += 1
+                    self._note_backfill_push(len(push.chunk),
+                                             rebalance=False)
                 except TRANSPORT_ERRORS:
                     pass
             # the peer now holds the objects: advance its log so the next
@@ -1866,7 +1985,11 @@ class OSD:
         m.transition(WAIT_LOCAL_RESERVE)
         if not await self._local_reserver.acquire(
                 key, priority=2 if degraded else 0, timeout=15.0):
-            m.transition(ACTIVE)
+            # the acquire waited: an interval change may have reset the
+            # statechart to GetInfo lock-free underneath this pass —
+            # transitions out of a dead interval are illegal
+            if not m.is_stale(epoch):
+                m.transition(ACTIVE)
             m.reserve_blocked = True
             return False, 0, False
         targets: List[int] = []
@@ -1894,6 +2017,8 @@ class OSD:
                         granted.append(osd)
                     elif reason == "toofull":
                         toofull = True
+                if m.is_stale(epoch):
+                    return False, 0, False
                 if len(granted) < len(targets):
                     # partial grant: back off rather than hog slots.
                     # A toofull refusal parks the PG as
@@ -1924,6 +2049,8 @@ class OSD:
             finally:
                 if renewer is not None:
                     renewer.cancel()
+            if m.is_stale(epoch):
+                return False, pushed, False
             m.transition(ACTIVE)
             return True, pushed, covered
         finally:
@@ -2165,6 +2292,47 @@ class OSD:
         if pool is None:
             return op.pool_id
         return (op.pool_id << 20) | self.osdmap.object_to_pg(pool, op.oid)
+
+    def _primary_spread(self, pool: PoolInfo) -> int:
+        """Distinct primaries across ``pool``'s PGs under the current
+        map (qos.primary_spread), memoized per epoch — the cross-OSD
+        QoS normalization divisor resolved on every client op."""
+        epoch = self.osdmap.epoch if self.osdmap else 0
+        memo_epoch, by_pool = self._spread_memo
+        if memo_epoch != epoch:
+            by_pool = {}
+            self._spread_memo = (epoch, by_pool)
+        spread = by_pool.get(pool.pool_id)
+        if spread is None:
+            spread = by_pool[pool.pool_id] = primary_spread(
+                self.osdmap, pool)
+        return spread
+
+    async def _background_throttle(self, op_class: str, pg_key: int,
+                                   cost: int = 1) -> None:
+        """One unit of background work (a scrub'd object, a backfill
+        push) waits its dmClock turn in the sharded op queue under its
+        background class (reference: recovery/scrub ops ride the op
+        queue with osd_mclock_profile service classes).  The waiter
+        carries NO order_key — background sweeps need scheduling
+        arbitration against client ops, not the per-PG ordering chain
+        (chaining onto a PG's client tail from inside a long-running
+        sweep could deadlock the sweep against its own queue slot).
+        Under mClock the class's (r, w, l, burst) profile shapes when
+        the slot is granted; an idle OSD grants immediately through the
+        work-conserving fallback.  WPQ arbitrates by class priority.
+        No-op when osd_background_qos is off or the OSD is stopping."""
+        if self._stopped or not self.conf.get("osd_background_qos", True):
+            return
+        fut = asyncio.get_running_loop().create_future()
+
+        async def _granted() -> None:
+            if not fut.done():
+                fut.set_result(None)
+
+        await self.op_queue.enqueue(pg_key, _granted, op_class=op_class,
+                                    cost=max(1, cost), ordered=False)
+        await fut
 
     def _track_client_op(self, op: MOSDOp):
         """TrackedOp + trace span for one arriving client op.  The span
@@ -5324,12 +5492,63 @@ class OSD:
         """Deep scrub the objects of ONE PG this OSD leads."""
         return await self.deep_scrub_pool(pool, only_pg=pg)
 
+    async def _pg_admin_scrub(self, pgid: str,
+                              repair: bool = False) -> Dict[str, object]:
+        """`ceph pg scrub/repair <pgid>` (MCommand tell aimed at the
+        primary).  Scrub: one deep-scrub pass of the PG (mismatches
+        raise PG_INCONSISTENT and self-repair).  Repair: scrub, then a
+        forced-backfill statechart pass (catches silently-missing
+        shards the logs cannot see), then a VERIFY re-scrub — zero
+        mismatches on the verify pass clears the PG's inconsistency
+        record."""
+        try:
+            pool_part, pg_part = str(pgid).split(".", 1)
+            pool_id, pg = int(pool_part), int(pg_part, 16)
+        except (ValueError, AttributeError):
+            raise ValueError(f"bad pgid {pgid!r} (want <pool>.<hexpg>)")
+        pool = self.osdmap.pools.get(pool_id) if self.osdmap else None
+        if pool is None or pg < 0 or pg >= pool.pg_num:
+            raise ValueError(f"no such pg {pgid!r}")
+        acting = self.osdmap.pg_to_acting(pool, pg)
+        primary = self._primary(pool, pg, acting)
+        if primary != self.osd_id:
+            raise ValueError(
+                f"osd.{self.osd_id} is not primary of {pgid} "
+                f"(primary is osd.{primary})")
+        summary: Dict[str, object] = dict(
+            await self._deep_scrub_pg(pool, pg))
+        if repair:
+            m = self._machine(pool_id, pg)
+            try:
+                await self._peer_and_recover_pg(
+                    m, pool, pg, acting, force_backfill=True,
+                    reset_interval=True)
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                pass  # verify scrub below judges the outcome
+            verify = await self._deep_scrub_pg(pool, pg)
+            summary["repaired"] = (int(summary.get("repaired", 0))
+                                   + verify["repaired"])
+            summary["errors_after_repair"] = verify["errors"]
+            summary["verified_clean"] = verify["errors"] == 0
+        summary["pgid"] = f"{pool_id}.{pg:x}"
+        return summary
+
     async def deep_scrub_pool(self, pool: PoolInfo,
                               only_pg: int = -1) -> Dict[str, int]:
         """Primary-led deep scrub: every acting shard of every object this
         OSD is primary for recomputes its crc against stored meta; bad or
-        missing shards are repaired by re-encode + push."""
+        missing shards are repaired by re-encode + push.
+
+        Per-object work waits its dmClock turn under CLASS_SCRUB (the
+        background-profile ride), mismatches are counted PER PG into
+        ``_scrub_errors`` (-> OSD_SCRUB_ERRORS / PG_INCONSISTENT on the
+        ping health field), and a pass that verifies a previously
+        inconsistent PG clean CLEARS its entry — the repair-confirmed
+        lifecycle `ceph pg repair` drives."""
         scrubbed = errors = repaired = 0
+        pg_errors: Dict[int, int] = {}
+        pg_repaired: Dict[int, int] = {}
+        pgs_scanned: Set[int] = set()
         oids = sorted({
             oid for oid, _ in self._list_pool_objects(pool.pool_id)
             if only_pg < 0
@@ -5346,6 +5565,11 @@ class OSD:
                 continue
             if only_pg >= 0 and pg != only_pg:
                 continue
+            # classed background work: each object's scrub fan-out waits
+            # its CLASS_SCRUB turn against client/recovery traffic
+            await self._background_throttle(
+                CLASS_SCRUB, (pool.pool_id << 20) | pg)
+            pgs_scanned.add(pg)
             scrubbed += 1
             bad: List[Tuple[int, int]] = []  # (shard, osd)
             tid = uuid.uuid4().hex
@@ -5418,6 +5642,8 @@ class OSD:
                     self.store.queue_transaction(txn)
             if bad:
                 errors += len(bad)
+                pg_errors[pg] = pg_errors.get(pg, 0) + len(bad)
+                self.perf.inc("scrub_errors_found", len(bad))
                 # repair: reconstruct WITHOUT the damaged shards and
                 # re-push them
                 read = await self._do_read(
@@ -5442,7 +5668,33 @@ class OSD:
                                     self.osdmap.addr_of(osd), push)
                                 repaired += 1
                             except TRANSPORT_ERRORS:
-                                pass
+                                continue
+                        pg_repaired[pg] = pg_repaired.get(pg, 0) + 1
+                        self.perf.inc("scrub_repaired")
+        # raise/clear the per-PG inconsistency record this pass proved.
+        # Mismatches RAISE (the repair that just ran is unverified until
+        # a later pass re-reads the pushed shards); a scanned PG with
+        # zero mismatches whose entry was raised earlier is repair-
+        # confirmed — CLEAR it (the next ping omits the check).
+        now = time.time()
+        for pg in pgs_scanned:
+            key = (pool.pool_id, pg)
+            n_err = pg_errors.get(pg, 0)
+            if n_err:
+                first = key not in self._scrub_errors
+                self._scrub_errors[key] = {
+                    "errors": n_err,
+                    "repaired": pg_repaired.get(pg, 0),
+                    "stamp": now}
+                if first:
+                    self.clog.error(
+                        f"pg {pool.pool_id}.{pg:x} deep-scrub: "
+                        f"{n_err} inconsistent shard(s), "
+                        f"{pg_repaired.get(pg, 0)} repaired")
+            elif self._scrub_errors.pop(key, None) is not None:
+                self.clog.info(
+                    f"pg {pool.pool_id}.{pg:x} repair verified clean "
+                    f"(PG_INCONSISTENT cleared)")
         return {"scrubbed": scrubbed, "errors": errors, "repaired": repaired}
 
     async def _list_all_shards(self, pool_id: int, pg: int = -1):
@@ -5836,7 +6088,7 @@ class OSD:
         return None
 
     async def _push_reencoded(self, pool: PoolInfo, pg: int,
-                              items) -> int:
+                              items, rebalance: bool = False) -> int:
         """Re-encode a recovery round's worth of objects and push their
         missing shards.  Every object without a planar-resident (or
         replicated) fast path rides ONE group-aware EC submit
@@ -5889,6 +6141,7 @@ class OSD:
                     except TRANSPORT_ERRORS:
                         continue
                 pushed += 1
+                self._note_backfill_push(len(push.chunk), rebalance)
         return pushed
 
     @staticmethod
@@ -5946,6 +6199,16 @@ class OSD:
             merged.update(holdings)
         return pushed, merged
 
+    def _note_backfill_push(self, nbytes: int, rebalance: bool) -> None:
+        """Account one pushed shard: backfill_bytes_moved always; the
+        rebalance pair only for pure placement moves (the bench arm's
+        MB/s-moved numerator — recovery of lost redundancy is a
+        different operator question than rebalance cost)."""
+        self.perf.inc("backfill_bytes_moved", nbytes)
+        if rebalance:
+            self.perf.inc("rebalance_push")
+            self.perf.inc("rebalance_bytes_moved", nbytes)
+
     async def _backfill_pg(
         self, pool: PoolInfo, pg: int,
     ) -> Tuple[int, Dict[str, Set[Tuple[int, int, int]]], bool]:
@@ -5953,8 +6216,20 @@ class OSD:
         the PG's possible holders only, reconstruct and push whatever is
         missing from the up-set positions, and purge strays once the
         up-set is fully covered.  Returns (shards_pushed, the gathered
-        holdings, fully_covered)."""
+        holdings, fully_covered).
+
+        Classing: a sweep over a DEGRADED acting set (holes — lost
+        redundancy) is CLASS_RECOVERY; a sweep moving data because
+        membership/weights changed with full redundancy intact (out /
+        in / reweight / crush reweight) is CLASS_REBALANCE — per-object
+        work waits its dmClock turn so client traffic keeps its
+        reservation while data moves."""
         gather_epoch = self.osdmap.epoch
+        bg_class = (CLASS_RECOVERY
+                    if any(a == CRUSH_ITEM_NONE for a in
+                           self.osdmap.pg_to_acting(pool, pg))
+                    else CLASS_REBALANCE)
+        rebalance = bg_class == CLASS_REBALANCE
         # snapshot BEFORE the gather: the revert decision must be made
         # about the cluster as it was when the listing was taken.  A
         # holder that was down during the gather (never queried) but up
@@ -5979,6 +6254,10 @@ class OSD:
         # under-replicated: never declare coverage (or purge) on one
         fully_covered = listing_ok
         for oid, locs in holdings.items():
+            # classed background work: each object's reconstruct+push
+            # waits its turn under the sweep's dmClock class
+            await self._background_throttle(
+                bg_class, (pool.pool_id << 20) | pg)
             acting = self.osdmap.pg_to_acting(pool, pg)
             # newest COMPLETE version wins; shards newer than it are
             # uncommitted leftovers of a failed write -> roll them back
@@ -6061,6 +6340,7 @@ class OSD:
                         except TRANSPORT_ERRORS:
                             continue
                     pushed += 1
+                    self._note_backfill_push(len(blob), rebalance)
                     continue
             # READING: gather k chunks (degraded-read machinery); the
             # re-encode is DEFERRED so every object this round joins one
@@ -6077,7 +6357,8 @@ class OSD:
         # re-encodes of this round ride ONE group-aware submit
         # (BatchingQueue.submit_group) — the recovery half of the
         # whole-stripe-group handoff.
-        pushed += await self._push_reencoded(pool, pg, pending_encode)
+        pushed += await self._push_reencoded(pool, pg, pending_encode,
+                                             rebalance=rebalance)
         if listing_ok and holders_all_up:
             # refresh the partial-version watchlist: entries keep their
             # first-seen time across sweeps (the grace clock), entries no
@@ -6099,30 +6380,45 @@ class OSD:
             # incomplete visibility invalidates any accrued grace
             self._partial_newer.pop((pool.pool_id, pg), None)
         if fully_covered and not self.osdmap.pg_temp.get((pool.pool_id, pg)):
-            await self._purge_strays(pool, pg, holdings, gather_epoch)
+            # strays seen this pass block Clean like in-flight pushes do:
+            # deletes are fire-and-forget (and the purge skips entirely
+            # when the epoch moved mid-gather — routine while OTHER PGs'
+            # pg_temp churn bumps the map), so Clean — which pops the
+            # _past_members scope that makes the stray OSD visible at
+            # all — must wait for a later pass to VERIFY the listing
+            # shows nothing outside the up set.  Without this, an `osd
+            # out` drain races the map churn of its own rebalance and
+            # strands the out OSD's shards forever.
+            if await self._purge_strays(pool, pg, holdings, gather_epoch):
+                fully_covered = False
         return pushed, holdings, fully_covered
 
     async def _purge_strays(
         self, pool: PoolInfo, pg: int,
         holdings: Dict[str, Set[Tuple[int, int, int]]],
         gather_epoch: int,
-    ) -> None:
+    ) -> bool:
         """Once every up-set position holds the newest complete version
         and no override is serving, copies on OSDs OUTSIDE the up set are
         strays from prior intervals: delete them (reference stray purge
         after activation, PG::purge_strays).  Without this, moved-away
         shards would linger forever and the shard hunt could resurrect a
-        deleted object from them.  Skipped when the map moved since the
-        holdings were gathered — a "stray" under the old map may be an
-        acting member under the new one."""
-        if self.osdmap.epoch != gather_epoch:
-            return
+        deleted object from them.  Delete-sending is skipped when the map
+        moved since the holdings were gathered — a "stray" under the old
+        map may be an acting member under the new one.  Returns True when
+        the listing contained ANY stray shard (purged or deferred): the
+        caller must not declare Clean until a later pass verifies the
+        strays gone."""
         up = {osd for osd in self._raw_up(pool, pg) if osd != CRUSH_ITEM_NONE}
         stray_osds: Dict[int, Set[str]] = {}
         for oid, locs in holdings.items():
             for _shard, osd, _v in locs:
                 if osd not in up:
                     stray_osds.setdefault(osd, set()).add(oid)
+        if not stray_osds:
+            return False
+        if self.osdmap.epoch != gather_epoch:
+            return True  # defer: re-gather under the settled map
         for osd, oids in stray_osds.items():
             for oid in oids:
                 try:
@@ -6133,3 +6429,4 @@ class OSD:
                     self.perf.inc("stray_purged")
                 except TRANSPORT_ERRORS:
                     pass
+        return True

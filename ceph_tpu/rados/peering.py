@@ -105,19 +105,26 @@ class PGMachine:
     def new_interval(self, epoch: int, acting: List[int]) -> bool:
         """A map change altered this PG's acting set: reset peering state
         (reference AdvMap -> Reset).  Returns True when the interval really
-        advanced (same-acting epochs are ignored)."""
+        advanced (re-delivery of the current interval is ignored).
+
+        The machine's own acting memory must NOT veto the reset when the
+        epoch advanced: kicks are issued by a caller that OBSERVED an
+        acting change between its old and new map, and a primary that
+        skipped intervals (batched map catch-up while it was not the
+        primary) can see acting "unchanged" while the world moved
+        A -> B -> A underneath it — e.g. an out OSD re-promoted by a
+        pg_temp override naming its old interval.  Trusting the stale
+        memory there swallows the kick and strands the PG's backfill."""
         if epoch <= self.interval_epoch and acting == self.acting:
             return False
-        changed = acting != self.acting or self.state == INITIAL
         self.interval_epoch = epoch
         self.acting = list(acting)
-        if changed:
-            self.peer_info.clear()
-            self.missing.clear()
-            self.backfill_targets = []
-            self.backfill_toofull = False  # stale verdict: new interval
-            self.transition(GET_INFO)
-        return changed
+        self.peer_info.clear()
+        self.missing.clear()
+        self.backfill_targets = []
+        self.backfill_toofull = False  # stale verdict: new interval
+        self.transition(GET_INFO)
+        return True
 
     def is_stale(self, epoch: int) -> bool:
         """True when a newer interval superseded the one a running peering

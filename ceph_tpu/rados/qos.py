@@ -63,21 +63,42 @@ from ceph_tpu.common.perf_counters import PerfCounters, PerfCountersBuilder
 # pool opts the mon validates and every OSD reads through pool.opts
 # (reference pg_pool_t::opts QoS analog): defaults for every client of
 # the pool, plus per-tenant-class overrides under "qos_class:<name>"
-QOS_POOL_KEYS = ("qos_reservation", "qos_weight", "qos_limit")
+QOS_POOL_KEYS = ("qos_reservation", "qos_weight", "qos_limit",
+                 "qos_burst")
 QOS_CLASS_PREFIX = "qos_class:"
 
 
 @dataclass(frozen=True)
 class QosParams:
     """One dmClock profile: reservation (ops/sec guaranteed), weight
-    (share of surplus), limit (ops/sec hard cap; 0 = unlimited)."""
+    (share of surplus), limit (ops/sec hard cap; 0 = unlimited), burst
+    (seconds of rho/delta credit an idle client may bank — see
+    module docstring tag math; 0 = strict pacing)."""
 
     reservation: float = 0.0
     weight: float = 1.0
     limit: float = 0.0
+    burst: float = 0.0
 
     def encode(self) -> str:
-        return f"{self.reservation:g}:{self.weight:g}:{self.limit:g}"
+        base = f"{self.reservation:g}:{self.weight:g}:{self.limit:g}"
+        return base + (f":{self.burst:g}" if self.burst else "")
+
+    def normalized(self, spread: int) -> "QosParams":
+        """Cross-OSD profile normalization (the dmClock distributed-
+        enforcement correction): a tenant whose primaries span N OSDs
+        meets N independent enforcers, so each must grant 1/N of the
+        declared rates or the tenant gets N x its nominal profile
+        cluster-wide.  Reservation and limit divide by the primary
+        spread; weight is a RATIO (per-OSD arbitration between local
+        competitors) and burst is a TIME allowance — both stay."""
+        spread = max(1, int(spread))
+        if spread == 1:
+            return self
+        return QosParams(reservation=self.reservation / spread,
+                         weight=self.weight,
+                         limit=self.limit / spread,
+                         burst=self.burst)
 
 
 # the OSD-config fallback when a pool declares nothing (matches the
@@ -87,15 +108,32 @@ DEFAULT_CLIENT_QOS = QosParams(reservation=100.0, weight=10.0, limit=0.0)
 
 
 def parse_class_profile(value: str) -> QosParams:
-    """``"r:w:l"`` -> QosParams; raises ValueError on anything the mon
-    must refuse (non-numeric, weight <= 0, negative rates)."""
+    """``"r:w:l"`` or ``"r:w:l:b"`` -> QosParams; raises ValueError on
+    anything the mon must refuse (non-numeric, weight <= 0, negative
+    rates/burst)."""
     parts = str(value).split(":")
-    if len(parts) != 3:
-        raise ValueError(f"qos profile {value!r} is not r:w:l")
-    r, w, l = (float(p) for p in parts)
-    if r < 0 or l < 0 or w <= 0:
-        raise ValueError(f"qos profile {value!r}: need r>=0, w>0, l>=0")
-    return QosParams(reservation=r, weight=w, limit=l)
+    if len(parts) not in (3, 4):
+        raise ValueError(f"qos profile {value!r} is not r:w:l[:b]")
+    r, w, l = (float(p) for p in parts[:3])
+    b = float(parts[3]) if len(parts) == 4 else 0.0
+    if r < 0 or l < 0 or w <= 0 or b < 0:
+        raise ValueError(
+            f"qos profile {value!r}: need r>=0, w>0, l>=0, b>=0")
+    return QosParams(reservation=r, weight=w, limit=l, burst=b)
+
+
+def primary_spread(osdmap: Any, pool: Any) -> int:
+    """How many distinct OSDs serve as primaries across one pool's PGs
+    under ``osdmap`` — the cross-OSD normalization divisor.  A tenant's
+    ops hash uniformly over the pool's PGs, so its offered load meets
+    this many independent per-OSD enforcers."""
+    primaries = set()
+    for pg in range(pool.pg_num):
+        acting = osdmap.pg_to_acting(pool, pg)
+        p = osdmap.primary_of(acting, seed=(pool.pool_id << 20) | pg)
+        if p is not None:
+            primaries.add(p)
+    return max(1, len(primaries))
 
 
 def validate_pool_qos(key: str, value: str) -> bool:
@@ -104,7 +142,7 @@ def validate_pool_qos(key: str, value: str) -> bool:
     try:
         if key == "qos_weight":
             return float(value) > 0
-        if key in ("qos_reservation", "qos_limit"):
+        if key in ("qos_reservation", "qos_limit", "qos_burst"):
             return float(value) >= 0
         if key.startswith(QOS_CLASS_PREFIX):
             name = key[len(QOS_CLASS_PREFIX):]
@@ -191,6 +229,8 @@ def pool_qos(pool: Any, client: str,
                               DEFAULT_CLIENT_QOS.weight)),
         limit=_num("qos_limit", "osd_qos_default_limit",
                    DEFAULT_CLIENT_QOS.limit),
+        burst=max(0.0, _num("qos_burst", "osd_qos_burst_allowance",
+                            DEFAULT_CLIENT_QOS.burst)),
     )
 
 
@@ -206,18 +246,25 @@ class ClientState:
     r_tag: float = 0.0
     p_tag: float = 0.0
     l_tag: float = 0.0
+    # rho/delta burst allowance (seconds): how far behind `now` an idle
+    # state's LIMIT tag may fall — banked credit for burst*limit
+    # immediately-eligible ops (R/P stay clamped to now: banked
+    # reservation credit would invert the reservation guarantee)
+    burst: float = 0.0
     queue: List[Any] = field(default_factory=list)
     last_active: float = 0.0
 
     def apply_params(self, params: QosParams) -> None:
-        """Refresh r/w/l in place (a `pool set` mid-stream applies to
-        live states; accumulated tags keep their meaning — they are
+        """Refresh r/w/l/burst in place (a `pool set` mid-stream applies
+        to live states; accumulated tags keep their meaning — they are
         absolute times)."""
-        if (self.reservation, self.weight, self.limit) != (
-                params.reservation, params.weight, params.limit):
+        if (self.reservation, self.weight, self.limit, self.burst) != (
+                params.reservation, params.weight, params.limit,
+                params.burst):
             self.reservation = params.reservation
             self.weight = max(1e-9, params.weight)
             self.limit = params.limit
+            self.burst = max(0.0, params.burst)
 
 
 class ClientRegistry:
@@ -241,7 +288,8 @@ class ClientRegistry:
             st = self.states[client] = ClientState(
                 reservation=params.reservation,
                 weight=max(1e-9, params.weight),
-                limit=params.limit)
+                limit=params.limit,
+                burst=max(0.0, params.burst))
         else:
             st.apply_params(params)
         st.last_active = now
@@ -300,11 +348,18 @@ class QosTracker:
         if st is None:
             if len(self._state) >= self.max_clients:
                 self._prune(now)
-            st = self._state[client] = [now, params.limit, now]
+            # a fresh (or long-idle, pruned) client opens with its full
+            # burst credit banked — the same floor the update applies
+            st = self._state[client] = [
+                now - max(0.0, params.burst), params.limit, now]
         st[2] = now
         if params.limit > 0:
             st[1] = params.limit
-            st[0] = min(max(st[0] + cost / params.limit, now),
+            # the rho/delta burst floor: an idle client's L-tag may lag
+            # `now` by up to burst seconds (banked credit for
+            # burst*limit immediate ops) instead of clamping to now
+            st[0] = min(max(st[0] + cost / params.limit,
+                            now - max(0.0, params.burst)),
                         now + self.arrears_cap)
             w = self._state.get(self._worst) if self._worst else None
             if w is None or w[1] <= 0 or st[0] >= w[0]:
@@ -404,7 +459,7 @@ def build_scheduler_perf() -> PerfCounters:
                                                pruned by the bound
     """
     b = PerfCountersBuilder("osd_scheduler")
-    for cls in ("client", "recovery", "best_effort"):
+    for cls in ("client", "recovery", "rebalance", "scrub", "best_effort"):
         b.add_u64_counter(f"enqueue_{cls}", f"{cls} ops enqueued")
         b.add_u64_counter(f"dequeue_{cls}", f"{cls} ops dequeued")
     b.add_u64("queue_depth", "ops queued across shards (gauge)")

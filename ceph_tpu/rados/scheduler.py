@@ -63,7 +63,42 @@ from ceph_tpu.rados.qos import ClientRegistry, ClientState, QosParams
 
 CLASS_CLIENT = "client"
 CLASS_RECOVERY = "recovery"
+CLASS_REBALANCE = "rebalance"
+CLASS_SCRUB = "scrub"
 CLASS_BEST_EFFORT = "best_effort"
+
+# Background dmClock profiles by operator intent (reference
+# osd_mclock_profile: balanced / high_client_ops / high_recovery_ops
+# allocate the OSD's IOPS between client and background service
+# classes).  Per class: (reservation ops/s, weight, limit ops/s,
+# rho/delta burst seconds — how much idle credit the class may bank, so
+# a background sweep waking under client load gets a short head start
+# instead of trickling one op per 1/limit).  Rebalance (CRUSH-driven
+# data movement after out/in/reweight) is classed BELOW recovery:
+# restoring redundancy outranks restoring placement.
+MCLOCK_PROFILES = {
+    "balanced": {
+        CLASS_CLIENT: (100.0, 10.0, 0.0, 0.5),
+        CLASS_RECOVERY: (10.0, 3.0, 50.0, 1.0),
+        CLASS_REBALANCE: (5.0, 2.0, 30.0, 1.0),
+        CLASS_SCRUB: (1.0, 1.0, 20.0, 1.0),
+        CLASS_BEST_EFFORT: (1.0, 1.0, 20.0, 0.0),
+    },
+    "high_client_ops": {
+        CLASS_CLIENT: (150.0, 20.0, 0.0, 0.5),
+        CLASS_RECOVERY: (5.0, 2.0, 25.0, 0.5),
+        CLASS_REBALANCE: (2.0, 1.0, 15.0, 0.5),
+        CLASS_SCRUB: (1.0, 1.0, 10.0, 0.5),
+        CLASS_BEST_EFFORT: (1.0, 1.0, 10.0, 0.0),
+    },
+    "high_recovery_ops": {
+        CLASS_CLIENT: (50.0, 5.0, 0.0, 0.5),
+        CLASS_RECOVERY: (40.0, 8.0, 100.0, 2.0),
+        CLASS_REBALANCE: (20.0, 4.0, 60.0, 2.0),
+        CLASS_SCRUB: (2.0, 2.0, 30.0, 1.0),
+        CLASS_BEST_EFFORT: (1.0, 1.0, 20.0, 0.0),
+    },
+}
 
 _seq = itertools.count()
 
@@ -84,7 +119,8 @@ class WPQScheduler:
     """Weighted priority queue: higher priority drained proportionally more
     often; strict classes (priority >= cutoff) always first."""
 
-    PRIORITIES = {CLASS_CLIENT: 63, CLASS_RECOVERY: 10, CLASS_BEST_EFFORT: 5}
+    PRIORITIES = {CLASS_CLIENT: 63, CLASS_RECOVERY: 10,
+                  CLASS_REBALANCE: 8, CLASS_SCRUB: 5, CLASS_BEST_EFFORT: 5}
     STRICT_CUTOFF = 196  # reference osd_op_queue_cut_off high
 
     def __init__(self, conf: Optional[dict] = None):
@@ -148,6 +184,8 @@ class MClockScheduler:
     ops carrying an entity name (the module docstring's dmClock tag
     discipline)."""
 
+    # historic default (== MCLOCK_PROFILES["balanced"] sans burst);
+    # kept as the name tests and the per-client fallback import
     DEFAULT_PROFILE = {
         CLASS_CLIENT: (100.0, 10.0, 0.0),
         CLASS_RECOVERY: (10.0, 3.0, 50.0),
@@ -162,11 +200,19 @@ class MClockScheduler:
         self.clock = clock  # injectable for deterministic tag-math tests
         self.perf = perf
         self.classes: Dict[str, _MClockClass] = {}
-        for name, (r, w, l) in self.DEFAULT_PROFILE.items():
+        # per-class (r, w, l, burst) from the selected osd_mclock_profile
+        # (reference osd_mclock_profile), with the historic
+        # mclock_<class>_res/wgt/lim conf keys overriding individual
+        # values on top (the "custom" escape hatch works on any profile)
+        profile = MCLOCK_PROFILES.get(
+            str(conf.get("osd_mclock_profile", "balanced") or "balanced"),
+            MCLOCK_PROFILES["balanced"])
+        for name, (r, w, l, burst) in profile.items():
             r = float(conf.get(f"mclock_{name}_res", r))
             w = float(conf.get(f"mclock_{name}_wgt", w))
             l = float(conf.get(f"mclock_{name}_lim", l))
-            self.classes[name] = _MClockClass(r, w, l)
+            burst = float(conf.get(f"mclock_{name}_burst", burst))
+            self.classes[name] = _MClockClass(r, w, l, burst=burst)
         # per-client tag states (reference client_profile_id_map),
         # bounded; only CLASS_CLIENT ops with an identity land here
         self.clients = ClientRegistry(
@@ -204,10 +250,21 @@ class MClockScheduler:
             c = self.classes.setdefault(
                 op_class, _MClockClass(1.0, 1.0, 0.0))
             tag_cost = max(1, cost)
+        # rho/delta burst floor: the L tag of an idle state may lag `now`
+        # by up to its burst allowance — banked LIMIT credit worth
+        # burst*limit immediately-eligible ops (a background sweep waking
+        # under client load is not paced down to one op per 1/limit
+        # before it even starts).  R and P clamp to now as in strict
+        # dmClock: reservation ordering is relative to ACTIVE competitors
+        # — banked R-credit would let a background backlog outrank client
+        # reservations at wake-up, the exact inversion the reservation
+        # guarantee exists to prevent.
+        floor = now - max(0.0, getattr(c, "burst", 0.0))
         c.r_tag = max(c.r_tag + tag_cost / c.reservation, now) \
             if c.reservation else 1e18
         c.p_tag = max(c.p_tag + tag_cost / c.weight, now)
-        c.l_tag = max(c.l_tag + tag_cost / c.limit, now) if c.limit else 0.0
+        c.l_tag = max(c.l_tag + tag_cost / c.limit, floor) \
+            if c.limit else 0.0
         # sort_key = (R, P, seq, L): the item's OWN tags — phase 1 serves
         # a due head R, phase 2 skips a class whose head L is still in
         # the future (the strict dmClock limit check; the class-level
@@ -277,7 +334,7 @@ class MClockScheduler:
             # now (negative = due).  0.0 = never enqueued: unset (None).
             return {"depth": len(c.queue),
                     "reservation": c.reservation, "weight": c.weight,
-                    "limit": c.limit,
+                    "limit": c.limit, "burst": getattr(c, "burst", 0.0),
                     "r_tag": round(c.r_tag - now, 6)
                     if c.r_tag and c.r_tag < 1e17 else None,
                     "p_tag": round(c.p_tag - now, 6) if c.p_tag else None,
@@ -354,13 +411,19 @@ class ShardedOpQueue:
                       op_class: str = CLASS_CLIENT, cost: int = 1,
                       priority: Optional[int] = None, client: str = "",
                       qos: Optional[QosParams] = None,
-                      qos_cost: Optional[float] = None) -> None:
+                      qos_cost: Optional[float] = None,
+                      ordered: bool = True) -> None:
         cost = max(1, cost)
         await self._budget.get(cost)  # blocks when queues are full
         self.inflight_ops += 1
         shard = self.shard_of(pg_key)
+        # ordered=False: shard by PG but skip the per-key ordering chain
+        # (background throttle waiters need scheduling arbitration only;
+        # chaining them onto a PG's client tail from inside a sweep that
+        # itself waits on the grant could deadlock the sweep)
         self._scheds[shard].enqueue(op_class, run, cost, priority=priority,
-                                    order_key=pg_key, client=client,
+                                    order_key=pg_key if ordered else None,
+                                    client=client,
                                     qos=qos, qos_cost=qos_cost)
         if self.perf is not None:
             self.perf.inc("op_queued")

@@ -236,7 +236,21 @@ class OsdInfo:
     addr: Tuple[str, int]
     up: bool = True
     in_cluster: bool = True
+    # the REWEIGHT overlay (reference osd_weight_t, `ceph osd reweight`):
+    # a 0..1 multiplier on the crush weight; 0 behaves like out.  Admin
+    # `osd out` drops in_cluster instead (weight is preserved for `in`).
     weight: float = 1.0
+    # the CRUSH weight (reference `ceph osd crush reweight`, nominally
+    # device capacity in TiB-ish units): the device's share of the straw2
+    # draw.  Effective placement weight = crush_weight * weight.  Read
+    # with osd_crush_weight() — pre-r18 pickles lack the attribute.
+    crush_weight: float = 1.0
+
+
+def osd_crush_weight(info: "OsdInfo") -> float:
+    """Crush weight of an OsdInfo, tolerant of pre-crush_weight pickles
+    (maps snapshotted by older builds restore without the attribute)."""
+    return float(getattr(info, "crush_weight", 1.0))
 
 
 @dataclass
@@ -317,14 +331,24 @@ class OSDMap:
         upmap = self.pg_upmap.get((pool.pool_id, pg))
         return list(upmap) if upmap is not None else self.pg_to_raw(pool, pg)
 
-    def pg_to_raw(self, pool: PoolInfo, pg: int) -> List[int]:
-        """CRUSH output before up/pg_temp filtering (_pg_to_raw_osds)."""
-        weights = {
-            o.osd_id: (o.weight if o.in_cluster else 0.0)
+    def osd_effective_weights(self) -> Dict[int, float]:
+        """The straw2 weight overlay placement runs on: per-OSD
+        crush_weight x reweight, zero for out members (reference
+        _pg_to_osds applying osd_weight over the crush map).  This is
+        the ONE place the two weight planes compose, so `osd out`,
+        `osd reweight` and `osd crush reweight` all move placement
+        through the same minimal-movement straw2 draw."""
+        return {
+            o.osd_id: (osd_crush_weight(o) * o.weight
+                       if o.in_cluster else 0.0)
             for o in self.osds.values()
         }
+
+    def pg_to_raw(self, pool: PoolInfo, pg: int) -> List[int]:
+        """CRUSH output before up/pg_temp filtering (_pg_to_raw_osds)."""
         x = (pool.pool_id << 20) | pg
-        return self.crush.do_rule(pool.rule or "default-ec", x, pool.size, weights)
+        return self.crush.do_rule(pool.rule or "default-ec", x, pool.size,
+                                  self.osd_effective_weights())
 
     def pg_to_acting(self, pool: PoolInfo, pg: int) -> List[int]:
         """Acting set for a PG: crush indep over in+weighted OSDs; up=false
@@ -438,8 +462,10 @@ class OSDMapIncremental:
                 inc.new_osds[osd_id] = info
             else:
                 o = old.osds[osd_id]
-                if (o.addr, o.weight) != (info.addr, info.weight):
-                    # addr/weight change (e.g. restart on a new port) ships
+                if (o.addr, o.weight, osd_crush_weight(o)) != (
+                        info.addr, info.weight, osd_crush_weight(info)):
+                    # addr/weight/crush-weight change (restart on a new
+                    # port, `osd reweight`, `osd crush reweight`) ships
                     # the whole record — state-only deltas stay compact
                     inc.new_osds[osd_id] = info
                 elif (o.up, o.in_cluster) != (info.up, info.in_cluster):
@@ -487,9 +513,13 @@ class MGetMap:
     tid: str = ""
 
 
-@message(2, version=2)
+@message(2, version=3)
 class MMapReply:
-    # either a full map or a chain of incrementals from the requester's epoch
+    # either a full map or a chain of incrementals from the requester's
+    # epoch.  v3: the embedded OsdInfo records (full map and incremental
+    # new_osds alike) grew a crush_weight tail — decoded getattr-safe via
+    # osd_crush_weight(), with the pre-change layout replay-guarded by
+    # corpus/wire/golden/MMapReply.v2_precrushweight.frame
     osdmap: OSDMap = None
     incrementals: List["OSDMapIncremental"] = field(default_factory=list)
     tid: str = ""
@@ -562,6 +592,23 @@ class MPing:
 @message(8)
 class MMarkDown:
     osd_id: int = 0
+    tid: str = ""
+
+
+@message(83)
+class MOsdMembership:
+    """Admin membership mutation (reference OSDMonitor `osd out` /
+    `osd in` / `osd reweight` / `osd crush reweight`): audited,
+    osdmap-replicated, answered with an MMapReply carrying the bumped
+    map.  ``out`` drops in_cluster (weight preserved, the OSD stays up
+    and drains through backfill); ``in`` restores it; ``reweight`` sets
+    the 0..1 overlay; ``crush-reweight`` sets the straw2 crush weight.
+    An admin ``out`` is sticky across reboots (the mon remembers it;
+    a booting OSD is auto-marked in only when not admin-out)."""
+
+    op: str = "out"  # out | in | reweight | crush-reweight
+    osd_id: int = 0
+    weight: float = 1.0  # reweight / crush-reweight operand
     tid: str = ""
 
 

@@ -50,6 +50,9 @@ def parse_args(argv=None):
                         "crash archive-all | crash prune KEEP_DAYS | "
                         "tell TARGET CMD [k=v...] | "
                         "df | osd df | osd tree | pg dump | "
+                        "pg scrub PGID | pg repair PGID | "
+                        "osd out ID... | osd in ID... | "
+                        "osd reweight ID W | osd crush reweight osd.ID W | "
                         "osd set-nearfull-ratio R | "
                         "osd set-backfillfull-ratio R | "
                         "osd set-full-ratio R | "
@@ -249,17 +252,20 @@ def render_health(health: Dict, detail: bool = False) -> List[str]:
 
 def render_osd_df(rows: List[Dict], osdmap=None) -> List[str]:
     """Render `ceph osd df` from the mon's aggregated utilization view
-    (client.osd_df rows): size/use/avail, %USE, and the fullness STATE
-    with nearfull/backfillfull/FULL highlighting.  Pure so tests can pin
-    the layout."""
-    lines = [f"{'ID':<4} {'STATUS':<7} {'WEIGHT':>7} {'SIZE':>12} "
-             f"{'USE':>12} {'AVAIL':>12} {'%USE':>7} {'OBJECTS':>8}  "
-             f"STATE"]
+    (client.osd_df rows): crush WEIGHT and the 0..1 REWEIGHT overlay
+    (the `osd out/in/reweight` plane), size/use/avail, %USE, and the
+    fullness STATE with nearfull/backfillfull/FULL highlighting.  Pure
+    so tests can pin the layout."""
+    lines = [f"{'ID':<4} {'STATUS':<7} {'WEIGHT':>7} {'REWEIGHT':>8} "
+             f"{'SIZE':>12} {'USE':>12} {'AVAIL':>12} {'%USE':>7} "
+             f"{'OBJECTS':>8}  STATE"]
     total_bytes = used_bytes = 0
     for r in rows:
         status = "up" if r.get("up", True) else "down"
         if r.get("error"):
             status = "error"
+        if not r.get("in", True):
+            status += "/out"
         total = int(r.get("total", 0) or 0)
         used = int(r.get("used", 0) or 0)
         if total:  # TOTAL %USE only over capacity-bearing OSDs
@@ -269,14 +275,18 @@ def render_osd_df(rows: List[Dict], osdmap=None) -> List[str]:
         state = r.get("state", "") or "-"
         if state == "full":
             state = "FULL"  # the one that blocks writes stands out
+        # WEIGHT = crush weight; REWEIGHT = the 0..1 overlay (rows from
+        # a pre-r18 mon carry only the historic "weight" = overlay)
+        reweight = float(r.get("reweight", r.get("weight", 1.0)))
+        crush_w = float(r.get("crush_weight", 1.0))
         lines.append(
             f"{r.get('id', '?'):<4} {status:<7} "
-            f"{float(r.get('weight', 1.0)):>7.4f} {total:>12} "
+            f"{crush_w:>7.4f} {reweight:>8.4f} {total:>12} "
             f"{used:>12} {int(r.get('avail', 0) or 0):>12} {pct:>7} "
             f"{int(r.get('num_objects', 0) or 0):>8}  {state}")
     if total_bytes:
         pct = f"{100.0 * used_bytes / total_bytes:6.2f}%"
-        lines.append(f"TOTAL {'':<13} {total_bytes:>12} {used_bytes:>12} "
+        lines.append(f"TOTAL {'':<22} {total_bytes:>12} {used_bytes:>12} "
                      f"{max(0, total_bytes - used_bytes):>12} {pct:>7}")
     if osdmap is not None:
         nf, bf, fl = osdmap.fullness_ratios()
@@ -287,10 +297,27 @@ def render_osd_df(rows: List[Dict], osdmap=None) -> List[str]:
 
 def _osd_tree(osdmap) -> List[Dict]:
     """Flattened crush tree rows (reference `ceph osd tree` layout):
-    buckets depth-first, devices with up/in status and weight."""
+    buckets depth-first, devices with up/in status, crush WEIGHT and
+    the 0..1 REWEIGHT overlay."""
+    from ceph_tpu.rados.types import osd_crush_weight
+
     crush = osdmap.crush
     rows: List[Dict] = []
     seen = set()
+
+    def device_row(osd_id: int, depth: int) -> Dict:
+        info = osdmap.osds.get(osd_id)
+        return {
+            "id": osd_id, "name": f"osd.{osd_id}", "type": "osd",
+            "depth": depth,
+            # WEIGHT = crush weight (the OsdInfo record is authoritative
+            # — bucket weights reset on crush rebuilds); REWEIGHT = the
+            # admin overlay
+            "weight": osd_crush_weight(info) if info else 1.0,
+            "reweight": info.weight if info else 1.0,
+            "status": "up" if info and info.up else "down",
+            "in": bool(info and info.in_cluster),
+        }
 
     def walk(bid: int, depth: int) -> None:
         b = crush.buckets.get(bid)
@@ -303,23 +330,32 @@ def _osd_tree(osdmap) -> List[Dict]:
             if item < 0:
                 walk(item, depth + 1)
             else:
-                info = osdmap.osds.get(item)
-                rows.append({
-                    "id": item, "name": f"osd.{item}", "type": "osd",
-                    "depth": depth + 1,
-                    "weight": b.weights.get(item, 1.0),
-                    "status": "up" if info and info.up else "down",
-                    "in": bool(info and info.in_cluster),
-                })
+                rows.append(device_row(item, depth + 1))
     walk(crush.root_id, 0)
     # stray devices not in any bucket (flat maps place all under root)
-    for osd_id, info in sorted(osdmap.osds.items()):
+    for osd_id in sorted(osdmap.osds):
         if not any(r.get("name") == f"osd.{osd_id}" for r in rows):
-            rows.append({"id": osd_id, "name": f"osd.{osd_id}",
-                         "type": "osd", "depth": 1, "weight": info.weight,
-                         "status": "up" if info.up else "down",
-                         "in": info.in_cluster})
+            rows.append(device_row(osd_id, 1))
     return rows
+
+
+def render_osd_tree(rows: List[Dict]) -> List[str]:
+    """Render `ceph osd tree` rows (_osd_tree): bucket lines, then
+    device lines with WEIGHT / REWEIGHT / status and the (out) marker.
+    Pure so tests can pin the layout."""
+    lines = [f"{'ID':>4} {'WEIGHT':>8} {'REWEIGHT':>8}  NAME/STATUS"]
+    for r in rows:
+        pad = "  " * r.get("depth", 0)
+        if r["type"] == "osd":
+            lines.append(
+                f"{r['id']:>4} {r.get('weight', 1.0):>8.4f} "
+                f"{r.get('reweight', 1.0):>8.4f}  {pad}{r['name']:<12}"
+                f"{r['status']}"
+                f"{'' if r.get('in', True) else ' (out)'}")
+        else:
+            lines.append(f"{r['id']:>4} {'':>8} {'':>8}  "
+                         f"{pad}{r['type']} {r['name']}")
+    return lines
 
 
 async def _df(client) -> List[Dict]:
@@ -527,15 +563,8 @@ async def run(args) -> int:
             if args.format == "json":
                 print(json.dumps(rows))
             else:
-                for r in rows:
-                    pad = "  " * r["depth"]
-                    if r["type"] == "osd":
-                        print(f"{r['id']:>4} {pad}{r['name']:<12}"
-                              f"{r.get('weight', 1.0):>8.4f}  "
-                              f"{r['status']}"
-                              f"{'' if r['in'] else ' (out)'}")
-                    else:
-                        print(f"{r['id']:>4} {pad}{r['type']} {r['name']}")
+                for line in render_osd_tree(rows):
+                    print(line)
             return 0
         if cmd == "pg dump":
             if args.format == "json":
@@ -643,6 +672,87 @@ async def run(args) -> int:
                 print(f"Error: {e}", file=sys.stderr)
                 return 1
             print(f"osd set-{which}-ratio {ratio:g}")
+            return 0
+        if args.words[:2] in (["osd", "out"], ["osd", "in"]) \
+                and len(args.words) >= 3:
+            # `ceph osd out/in ID [ID...]` — elastic membership
+            verb = args.words[1]
+            ids = []
+            for raw in args.words[2:]:
+                # validate the WHOLE list before mutating anything: a
+                # typo mid-list must not leave the first ids draining
+                try:
+                    osd_id = int(raw.split(".")[-1])
+                except ValueError:
+                    print(f"bad osd id {raw!r}", file=sys.stderr)
+                    return 2
+                if osd_id not in m.osds:
+                    print(f"no osd.{osd_id}", file=sys.stderr)
+                    return 2
+                ids.append(osd_id)
+            for osd_id in ids:
+                if verb == "out":
+                    await client.osd_out(osd_id)
+                else:
+                    await client.osd_in(osd_id)
+                print(f"marked {verb} osd.{osd_id}")
+            return 0
+        if args.words[:2] == ["osd", "reweight"] and len(args.words) == 4:
+            try:
+                osd_id = int(args.words[2].split(".")[-1])
+                weight = float(args.words[3])
+            except ValueError:
+                print("usage: osd reweight ID WEIGHT(0..1)",
+                      file=sys.stderr)
+                return 2
+            if osd_id not in m.osds or not (0.0 <= weight <= 1.0):
+                print(f"need an existing osd id and weight in [0,1]",
+                      file=sys.stderr)
+                return 2
+            await client.osd_reweight(osd_id, weight)
+            print(f"reweighted osd.{osd_id} to {weight:g}")
+            return 0
+        if args.words[:3] == ["osd", "crush", "reweight"] \
+                and len(args.words) == 5:
+            try:
+                osd_id = int(args.words[3].split(".")[-1])
+                weight = float(args.words[4])
+            except ValueError:
+                print("usage: osd crush reweight osd.ID WEIGHT",
+                      file=sys.stderr)
+                return 2
+            if osd_id not in m.osds or weight < 0:
+                print("need an existing osd id and weight >= 0",
+                      file=sys.stderr)
+                return 2
+            await client.osd_crush_reweight(osd_id, weight)
+            print(f"crush reweighted osd.{osd_id} to {weight:g}")
+            return 0
+        if args.words[:2] in (["pg", "scrub"], ["pg", "repair"]) \
+                and len(args.words) == 3:
+            # `ceph pg scrub/repair PGID` — MCommand tell at the primary
+            try:
+                if args.words[1] == "scrub":
+                    result = await client.pg_scrub(args.words[2])
+                else:
+                    result = await client.pg_repair(args.words[2])
+            except Exception as e:
+                print(f"Error: {e}", file=sys.stderr)
+                return 1
+            if args.format == "json":
+                print(json.dumps(result, default=repr))
+            else:
+                extra = ""
+                if "verified_clean" in result:
+                    extra = (", verified clean"
+                             if result["verified_clean"]
+                             else f", {result.get('errors_after_repair')}"
+                                  f" errors REMAIN after repair")
+                print(f"pg {result.get('pgid', args.words[2])} "
+                      f"{args.words[1]}: "
+                      f"{result.get('scrubbed', 0)} objects, "
+                      f"{result.get('errors', 0)} errors, "
+                      f"{result.get('repaired', 0)} repaired{extra}")
             return 0
         if args.words[:3] in (["osd", "pool", "mksnap"],
                               ["osd", "pool", "rmsnap"]):

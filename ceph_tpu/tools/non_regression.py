@@ -85,6 +85,16 @@ def parse_args(argv=None):
     p.add_argument("--full-seconds", type=float, default=12.0,
                    help="ceiling on each fullness-ladder wait")
     p.add_argument("--full-osds", type=int, default=4)
+    # elastic-membership coexistence gate (CI, FAILING): an out ->
+    # backfill -> in -> reweight cycle with CONCURRENT deep scrub and
+    # reserved-tenant client traffic — zero acked-op loss, byte-identical
+    # data after convergence, reserved p99 bounded vs its solo run,
+    # plus backfill parking at a backfillfull target and resuming when
+    # space frees
+    p.add_argument("--rebalance", action="store_true")
+    p.add_argument("--rebalance-seconds", type=float, default=20.0,
+                   help="ceiling on each membership-cycle wait")
+    p.add_argument("--rebalance-osds", type=int, default=4)
     return p.parse_args(argv)
 
 
@@ -1176,6 +1186,303 @@ def run_full(args) -> int:
     return asyncio.run(go())
 
 
+def run_rebalance(args) -> int:
+    """Elastic-membership coexistence gate (CI), the acceptance bar of
+    the r18 plane, runnable as one FAILING command:
+
+        python -m ceph_tpu.tools.non_regression --rebalance
+
+    Two legs:
+
+    1. COEXISTENCE CYCLE: an `osd out` -> backfill-drain -> `osd in` ->
+       refill -> `osd reweight` cycle runs while a RESERVED tenant
+       (qos_class:gold) and a best-effort tenant drive verified
+       read/write traffic AND pool-wide deep scrub fans out — the
+       scrub + rebalance + client coexistence the background dmClock
+       classes exist for.  Fails unless: the cycle converges (the out
+       OSD drains to zero shards, refills after `in`), the reserved
+       tenant has ZERO acked-op failures and every read was
+       byte-identical, all data is byte-identical after convergence,
+       the sweeps were CLASSED (rebalance/scrub dmClock enqueues moved),
+       data actually moved, no PG_INCONSISTENT is left raised, and the
+       reserved tenant's p99 during the cycle stays bounded:
+       <= max(2x its solo p99, 1.5x the best-effort p99 of the SAME
+       window, 250ms).  The best-effort and absolute terms absorb
+       1-2-core CI hosts where process-wide CPU contention inflates
+       every op (one event loop carries the whole cluster) — a real
+       throttling regression shows gold >> best-effort in the same
+       window and blows past all three terms.
+
+    2. BACKFILLFULL PARK: the same out-drain aimed at a target past its
+       backfillfull ratio parks (PG_BACKFILL_FULL raises) instead of
+       stampeding the full disk, then resumes and completes when the
+       target frees space — rebalance rides the r15 fullness gates.
+    """
+    import asyncio
+    import os as _os
+    import time as _time
+
+    from ceph_tpu.rados.vstart import Cluster
+    from ceph_tpu.tools.traffic import TenantClass, TrafficHarness
+
+    async def wait_for(pred, seconds, what, failures):
+        deadline = _time.monotonic() + seconds
+        while _time.monotonic() < deadline:
+            r = pred()
+            if asyncio.iscoroutine(r):
+                r = await r
+            if r:
+                return True
+            await asyncio.sleep(0.1)
+        failures.append(f"timed out waiting for {what}")
+        return False
+
+    def shards_on(osd, pool):
+        return sum(1 for (p, _o, _s) in osd.store._data if p == pool)
+
+    async def leg_coexistence(failures) -> None:
+        conf = {"osd_op_queue": "mclock",
+                "osd_mclock_profile": "balanced",
+                "osd_auto_repair": True,
+                "osd_heartbeat_interval": 0.1,
+                "osd_repair_delay": 0.1,
+                "osd_recovery_retry": 0.3,
+                "mon_osd_report_grace": 2.0,
+                "client_op_timeout": 30.0,
+                "client_op_deadline": 60.0}
+        cluster = Cluster(n_osds=max(4, args.rebalance_osds), conf=conf)
+        await cluster.start()
+        try:
+            c0 = await cluster.client()
+            pool = await c0.create_pool("rebal", profile={
+                "plugin": "jerasure", "technique": "reed_sol_van",
+                "k": "2", "m": "1"})
+            await c0.pool_set(pool, "qos_class:gold", "100:20:0:0.5")
+            c_gold = await cluster.client()
+            c_be = await cluster.client()
+            gold = TenantClass("gold", c_gold, tenants=1, workers=4,
+                               rate=40.0)
+            be = TenantClass("", c_be, tenants=8, workers=2, rate=20.0)
+            h = TrafficHarness([gold, be], pool, n_objects=24,
+                               obj_size=24 << 10, verify=True)
+            await h.preload()
+            victim_id = sorted(cluster.osds)[0]
+            victim = cluster.osds[victim_id]
+            await wait_for(lambda: shards_on(victim, pool) > 0, 10.0,
+                           "the victim to hold shards", failures)
+            shards_before = shards_on(victim, pool)
+
+            solo = await h.run_phase("solo", 3.0, 0.25, classes=[gold])
+            solo_p99 = solo.summary().get("gold", {}).get(
+                "get", {}).get("p99_us", 0.0)
+
+            moved0 = sum(o.perf.get("rebalance_bytes_moved")
+                         for o in cluster.osds.values())
+            scrub_stats = {"scrubbed": 0, "errors": 0}
+            cycle_done = asyncio.Event()
+
+            async def scrub_loop():
+                # pool-wide deep scrub fanning out CONCURRENTLY with the
+                # rebalance and the client traffic — the coexistence
+                # under test
+                while not cycle_done.is_set():
+                    try:
+                        res = await c0.deep_scrub(pool)
+                        scrub_stats["scrubbed"] += res.get("scrubbed", 0)
+                        scrub_stats["errors"] += res.get("errors", 0)
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0.2)
+
+            async def cycle():
+                try:
+                    await c0.osd_out(victim_id)
+                    await wait_for(
+                        lambda: shards_on(victim, pool) == 0,
+                        args.rebalance_seconds,
+                        "the out OSD to drain", failures)
+                    await c0.osd_in(victim_id)
+                    await wait_for(
+                        lambda: shards_on(victim, pool)
+                        >= max(1, shards_before // 2),
+                        args.rebalance_seconds,
+                        "the re-added OSD to refill", failures)
+                    await c0.osd_reweight(victim_id, 0.5)
+                    await asyncio.sleep(0.5)  # remap settles under load
+                    await c0.osd_reweight(victim_id, 1.0)
+                finally:
+                    cycle_done.set()
+
+            loop = asyncio.get_running_loop()
+            scrub_task = loop.create_task(scrub_loop())
+            cycle_task = loop.create_task(cycle())
+            # the during-cycle traffic window: runs at least as long as
+            # the cycle itself (phases repeat until the cycle finishes;
+            # the FIRST phase overlaps the drain and carries the bound)
+            during = await h.run_phase("rebalance", 4.0, 0.25)
+            phases = [during]
+            while not cycle_task.done():
+                phases.append(await h.run_phase("rebalance-tail", 2.0,
+                                                0.25))
+            await cycle_task
+            await scrub_task
+            moved = sum(o.perf.get("rebalance_bytes_moved")
+                        for o in cluster.osds.values()) - moved0
+
+            dur_s = during.summary()
+            gold_p99 = dur_s.get("gold", {}).get("get", {}).get(
+                "p99_us", 0.0)
+            be_p99 = dur_s.get("default", {}).get("get", {}).get(
+                "p99_us", 0.0)
+            gold_fail = (solo.summary().get("gold", {}).get("failures", 0)
+                         + sum(ph.summary().get("gold", {}).get(
+                             "failures", 0) for ph in phases))
+            if gold_fail:
+                failures.append(f"reserved tenant had {gold_fail} "
+                                "acked-op failures during the cycle "
+                                "(must be 0)")
+            if moved <= 0:
+                failures.append("no rebalance bytes were moved "
+                                "(rebalance_bytes_moved stayed 0)")
+            classed = sum(o.sched_perf.get("enqueue_rebalance")
+                          for o in cluster.osds.values())
+            scrub_classed = sum(o.sched_perf.get("enqueue_scrub")
+                                for o in cluster.osds.values())
+            if classed <= 0:
+                failures.append("rebalance sweeps were never CLASSED "
+                                "(enqueue_rebalance stayed 0)")
+            if scrub_classed <= 0:
+                failures.append("scrub sweeps were never CLASSED "
+                                "(enqueue_scrub stayed 0)")
+            if scrub_stats["scrubbed"] <= 0:
+                failures.append("deep scrub never ran during the cycle")
+            bound = max(2.0 * solo_p99, 1.5 * be_p99, 250_000.0)
+            if not solo_p99 or not gold_p99:
+                failures.append("reserved tenant percentiles missing "
+                                f"(solo={solo_p99}, during={gold_p99})")
+            elif gold_p99 > bound:
+                failures.append(
+                    f"reserved get p99 unbounded during rebalance: "
+                    f"{gold_p99:.0f}us > max(2x solo {solo_p99:.0f}us, "
+                    f"1.5x best-effort {be_p99:.0f}us, 250ms)")
+            # convergence: every byte identical to the harness's
+            # deterministic expectation
+            for oid, want in h.blobs.items():
+                try:
+                    got = await c0.get(pool, oid)
+                except Exception as e:
+                    failures.append(f"{oid} unreadable after "
+                                    f"convergence: {e}")
+                    continue
+                if bytes(got) != want:
+                    failures.append(f"{oid} NOT byte-identical after "
+                                    "convergence")
+            h2 = await c0.get_health(detail=True)
+            if "PG_INCONSISTENT" in (h2.get("checks") or {}):
+                failures.append("PG_INCONSISTENT left raised after the "
+                                "cycle (scrub found lasting damage)")
+            window_s = during.seconds or 1.0
+            print(f"rebalance: moved {moved / 1e6:.2f} MB "
+                  f"({moved / window_s / 1e6:.2f} MB/s over the "
+                  f"{window_s:.1f}s window), gold p99 solo "
+                  f"{solo_p99:.0f}us -> during {gold_p99:.0f}us "
+                  f"(best-effort {be_p99:.0f}us), rebalance enqueues "
+                  f"{classed}, scrub enqueues {scrub_classed}, scrubbed "
+                  f"{scrub_stats['scrubbed']} objects, "
+                  f"{len(failures)} failures")
+            for c in (c0, c_gold, c_be):
+                await c.stop()
+        finally:
+            await cluster.stop()
+
+    async def leg_backfillfull(failures) -> None:
+        conf = {"osd_op_queue": "mclock",
+                "osd_auto_repair": True,
+                "osd_heartbeat_interval": 0.1,
+                "osd_repair_delay": 0.1,
+                "osd_recovery_retry": 0.3,
+                "osd_backfill_toofull_retry": 0.3,
+                "mon_osd_report_grace": 2.0,
+                "client_op_timeout": 10.0, "client_op_deadline": 20.0}
+        cluster = Cluster(n_osds=max(4, args.rebalance_osds), conf=conf)
+        await cluster.start()
+        try:
+            c = await cluster.client()
+            pool = await c.create_pool("rebalbf", profile={
+                "plugin": "jerasure", "technique": "reed_sol_van",
+                "k": "2", "m": "1"})
+            acked = {}
+            for i in range(8):
+                blob = _os.urandom(40_000 + 531 * i)
+                await c.put(pool, f"b{i}", blob)
+                acked[f"b{i}"] = blob
+            ids = sorted(cluster.osds)
+            victim_id, target = ids[0], ids[1]
+            victim = cluster.osds[victim_id]
+            await wait_for(lambda: shards_on(victim, pool) > 0, 10.0,
+                           "the victim to hold shards", failures)
+            # a rebalance target past its backfillfull ratio: the drain
+            # must PARK, not stampede the full disk
+            cluster.conf["osd_debug_inject_full"] = f"{target}:0.92"
+
+            async def target_backfillfull():
+                h = await c.get_health()
+                util = h.get("osd_utilization") or {}
+                return (util.get(target)
+                        or {}).get("state") == "backfillfull"
+
+            await wait_for(target_backfillfull, args.rebalance_seconds,
+                           "backfillfull state", failures)
+            await c.osd_out(victim_id)
+
+            async def parked():
+                h = await c.get_health(detail=True)
+                return "PG_BACKFILL_FULL" in (h.get("checks") or {})
+
+            await wait_for(parked, args.rebalance_seconds,
+                           "PG_BACKFILL_FULL (rebalance parked at the "
+                           "backfillfull target)", failures)
+            # space frees -> the parked rebalance resumes and completes
+            cluster.conf["osd_debug_inject_full"] = ""
+            await wait_for(lambda: shards_on(victim, pool) == 0,
+                           max(args.rebalance_seconds, 20.0),
+                           "the drain to resume and complete after the "
+                           "target freed space", failures)
+            for oid, want in acked.items():
+                got = await c.get(pool, oid)
+                if bytes(got) != want:
+                    failures.append(f"{oid} NOT byte-identical after "
+                                    "the parked-then-resumed drain")
+            print(f"rebalance-backfillfull: parked and resumed, "
+                  f"{len(failures)} cumulative failures")
+            await c.stop()
+        finally:
+            cluster.conf["osd_debug_inject_full"] = ""
+            await cluster.stop()
+
+    async def go() -> int:
+        failures: list = []
+        for name, leg in (("coexistence", leg_coexistence),
+                          ("backfillfull-park", leg_backfillfull)):
+            t0 = _time.monotonic()
+            try:
+                await leg(failures)
+            except Exception as e:
+                import traceback
+
+                traceback.print_exc()
+                failures.append(f"[{name}] leg crashed: "
+                                f"{type(e).__name__}: {e}")
+            print(f"rebalance: leg {name} done in "
+                  f"{_time.monotonic() - t0:.1f}s "
+                  f"({len(failures)} cumulative failures)")
+        for f in failures:
+            print(f"FAIL {f}", file=sys.stderr)
+        return 1 if failures else 0
+
+    return asyncio.run(go())
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     if args.slow_ops:
@@ -1188,6 +1495,8 @@ def main(argv=None) -> int:
         return run_tier(args)
     if args.full:
         return run_full(args)
+    if args.rebalance:
+        return run_rebalance(args)
     if args.chaos:
         return run_chaos(args)
     if args.wire_floor:

@@ -138,14 +138,32 @@ def _sample_messages() -> List[Any]:
         t.MBackfillReserveReply(tid="t10", osd_id=4, ok=False,
                                 reason="toofull"),
         # liveness ping v4: health checks + the statfs the mon's
-        # fullness derivation runs on (v3 golden pins truncated decode)
+        # fullness derivation runs on (v3 golden pins truncated decode;
+        # the scrub-era health SHAPE — OSD_SCRUB_ERRORS/PG_INCONSISTENT
+        # riding the dict — is pinned here, with the pre-scrub-era
+        # content replay-guarded by golden MPing.v4_prescrubera)
         t.MPing(osd_id=3, epoch=21, addr=("127.0.0.1", 6801),
                 health={"SLOW_OPS": {"severity": "warning",
                                      "summary": "1 slow ops",
-                                     "count": 1}},
+                                     "count": 1},
+                        "OSD_SCRUB_ERRORS": {"severity": "error",
+                                             "summary": "2 scrub errors",
+                                             "count": 2},
+                        "PG_INCONSISTENT": {
+                            "severity": "error",
+                            "summary": "1 pg(s) inconsistent",
+                            "count": 1, "pgs": ["1.3"]}},
                 statfs={"total": 1 << 30, "used": 900 << 20,
                         "avail": (1 << 30) - (900 << 20),
                         "num_objects": 12}),
+        # v3: the embedded OsdInfo/incremental records grew the
+        # crush_weight tail (golden MMapReply.v2_precrushweight pins
+        # the pre-change decode).  Archived with default payloads —
+        # the map itself is not JSON-able; the sidecar pins the field
+        # NAMES and the golden frame pins a real-map decode.
+        t.MMapReply(tid="t19"),
+        t.MOsdMembership(op="crush-reweight", osd_id=4, weight=2.5,
+                         tid="t20"),
         t.MSetFullRatio(which="backfillfull", ratio=0.9, tid="t18"),
         t.MOSDFailure(target_osd=4, from_osd=1, failed_for=12.5,
                       tid="t11"),
