@@ -805,12 +805,15 @@ def main() -> int:
     daemon_phase_pcts: dict = got.get("op_phase_percentiles", {})
     daemon_cluster_log: dict = got.get("cluster_log", {})
     daemon_fullness: dict = got.get("fullness", {})
+    daemon_reactor_mode: str = str(got.get("reactor_mode") or "thread")
     daemon_arm_failed = bool(got.get("_failed"))
 
-    # multi-lane scaling curve (1/2/4/8 lanes): recorded every run so
-    # the lane plane's scaling is a trajectory, not a one-off claim
+    # multi-lane scaling curve (1/2/4/8 lanes) on BOTH reactor modes
+    # (thread + process): recorded every run so the lane plane's
+    # scaling is a trajectory, not a one-off claim — 16 cluster
+    # bring-ups, hence the longer leash
     lanes_sweep: dict = _run_child_bench(
-        "--lanes-sweep", timeout=600).get("lanes_sweep", {})
+        "--lanes-sweep", timeout=1500).get("lanes_sweep", {})
 
     # pure-messenger single-stream: native wirepath arm vs forced-python
     # arm in one child process/window (the ISSUE 12 acceptance ratio)
@@ -933,6 +936,10 @@ def main() -> int:
         "daemon_wire_put_MBps_python": round(daemon_wire_put_py_mbps, 1),
         "daemon_wire_get_MBps_python": round(daemon_wire_get_py_mbps, 1),
         "wirepath_kind": daemon_wirepath_kind,
+        # which reactor substrate the daemon_wire_* arm ran (thread |
+        # process): non_regression --wire-floor compares like-for-like
+        # modes only, mirroring the wirepath-arm rule above
+        "reactor_mode": daemon_reactor_mode,
         # pure-messenger single-stream, native vs forced-python arm in
         # one process/window — the GIL-escape ratio itself, without the
         # EC/OSD layers around it
@@ -1109,6 +1116,20 @@ def _run_child_bench(flag: str, timeout: int = 300,
     except Exception:
         pass
     return {}
+
+
+def _bench_reactor_mode(conf: dict = None) -> str:
+    """The reactor substrate a bench cluster's messengers resolve:
+    CEPH_TPU_REACTOR overrides, then the conf's ms_reactor_mode,
+    default thread — the same precedence Messenger applies."""
+    env = os.environ.get("CEPH_TPU_REACTOR", "").strip().lower()
+    if env in ("thread", "process"):
+        return env
+    if conf is None:  # None = "the daemon-path shape"; {} = no conf
+        conf = WIRE_PLANE_CONF
+    m = str(conf.get("ms_reactor_mode", "thread")
+            or "thread").strip().lower()
+    return m if m in ("thread", "process") else "thread"
 
 
 # the production wire shape for THIS bench host: 2 lanes per peer
@@ -1291,6 +1312,10 @@ def daemon_path_bench() -> int:
         "wire_get_MBps_python": round(size / wire_py_get_dt / 1e6, 1),
         # which wirepath arm the headline wire numbers ran on
         "wirepath_kind": _wp.kind(),
+        # which reactor substrate the wire arm's messengers ran
+        # (CEPH_TPU_REACTOR / ms_reactor_mode; wire-floor compares
+        # like-for-like modes only)
+        "reactor_mode": _bench_reactor_mode(),
         # negotiated colocated ring (no TCP, no framing): acceptance bar
         # is within 1.5x of the no-wire fastpath put/get above
         "local_put_MBps": round(size / local_put_dt / 1e6, 1),
@@ -1331,8 +1356,13 @@ def daemon_path_bench() -> int:
 def lanes_sweep_bench() -> int:
     """``--lanes-sweep``: the multi-lane scaling curve (1/2/4/8 lanes,
     reactor pool on) — 32 MiB put+get through a 6-OSD TCP cluster per
-    lane count, best-of-2.  Recorded every bench run so lane scaling is
-    a tracked trajectory, not a one-off claim."""
+    lane count, best-of-2 — measured on BOTH reactor substrates
+    (``ms_reactor_mode=thread`` and ``process``), so the process-sharded
+    plane's scaling shape lands next to the thread arm's in every BENCH
+    record.  On a 2-core host the thread curve collapses past 2 lanes
+    (the interpreter halves of the shards contend); the process arm is
+    the one that can spread when cores exist.  Recorded every bench run
+    so lane scaling is a tracked trajectory, not a one-off claim."""
     import asyncio
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -1340,11 +1370,12 @@ def lanes_sweep_bench() -> int:
 
     size = 32 << 20
 
-    async def run_lanes(lanes: int):
+    async def run_lanes(mode: str, lanes: int):
         cluster = Cluster(n_osds=6, conf={
             "osd_auto_repair": False,
             "ms_local_fastpath": False,
             "ms_colocated_ring": False,
+            "ms_reactor_mode": mode,
             "ms_lanes_per_peer": lanes,
             "ms_async_op_threads": 2})
         await cluster.start()
@@ -1371,14 +1402,17 @@ def lanes_sweep_bench() -> int:
             await cluster.stop()
 
     sweep = {}
-    for lanes in (1, 2, 4, 8):
-        try:
-            put_dt, get_dt = asyncio.run(run_lanes(lanes))
-            sweep[str(lanes)] = {
-                "put_MBps": round(size / put_dt / 1e6, 1),
-                "get_MBps": round(size / get_dt / 1e6, 1)}
-        except Exception as e:  # one bad arm must not hide the others
-            sweep[str(lanes)] = {"error": f"{type(e).__name__}: {e}"}
+    for mode in ("thread", "process"):
+        curve = {}
+        for lanes in (1, 2, 4, 8):
+            try:
+                put_dt, get_dt = asyncio.run(run_lanes(mode, lanes))
+                curve[str(lanes)] = {
+                    "put_MBps": round(size / put_dt / 1e6, 1),
+                    "get_MBps": round(size / get_dt / 1e6, 1)}
+            except Exception as e:  # one bad arm must not hide the others
+                curve[str(lanes)] = {"error": f"{type(e).__name__}: {e}"}
+        sweep[mode] = {"reactor_mode": mode, "curve": curve}
     print(json.dumps({"lanes_sweep": sweep}))
     return 0
 
@@ -1481,6 +1515,7 @@ def msgr_stream_bench() -> int:
         "frame_bytes": frame,
         "stream_bytes": size,
         "wirepath_kind": wp.kind(),
+        "reactor_mode": _bench_reactor_mode({}),
         "native": arms["native"],
         "python": arms["python"],
         "native_vs_python": round(ratio, 2),

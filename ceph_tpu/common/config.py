@@ -139,7 +139,19 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
     # pool, src/msg/async/AsyncMessenger.h ms_async_op_threads)
     Option("ms_async_op_threads", OPT_INT, 0, flags=(FLAG_STARTUP,),
            desc="reactor workers per messenger, each its own event loop "
-                "owning a socket shard (0 = single-loop legacy path)"),
+                "owning a socket shard (0 = single-loop legacy path; "
+                "in ms_reactor_mode=process, 0 defaults to 2 workers)"),
+    Option("ms_reactor_mode", OPT_STR, "thread", flags=(FLAG_STARTUP,),
+           desc="reactor worker substrate: 'thread' (N event-loop "
+                "threads sharing the interpreter, the r13 plane) or "
+                "'process' (forked wire workers, each owning its socket "
+                "shard + its own wirepath arm; frames cross via "
+                "shared-memory rings into the home-loop dispatch pump). "
+                "The CEPH_TPU_REACTOR env overrides process-wide."),
+    Option("ms_shm_ring_bytes", OPT_SIZE, 4 << 20, flags=(FLAG_STARTUP,),
+           desc="per-direction shared-memory ring capacity of one "
+                "process-delegated connection; oversized frames stream "
+                "through in bounded pieces instead of deadlocking"),
     Option("ms_lanes_per_peer", OPT_INT, 1, flags=(FLAG_STARTUP,), min=1,
            desc="parallel lanes per peer session (negotiated; lane 0 is "
                 "control-only, data stripes across the rest; 1 = single "

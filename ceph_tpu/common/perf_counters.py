@@ -49,6 +49,11 @@ class PerfCounters:
         # zeroing misreports until the next mutation — the owner re-sets
         # them here so `perf reset` restarts rates without lying gauges
         self.resync: Optional[Any] = None
+        # optional owner callback invoked BEFORE dump(): counters whose
+        # source of truth lives outside this process (the reactor worker
+        # processes' shared-memory blocks) refresh here so every dump
+        # reports the whole plane without a polling loop
+        self.presample: Optional[Any] = None
 
     # -- hot path ------------------------------------------------------------
 
@@ -139,6 +144,11 @@ class PerfCounters:
     # -- dump ----------------------------------------------------------------
 
     def dump(self) -> Dict[str, Any]:
+        if self.presample is not None:
+            try:
+                self.presample()  # outside the lock: presample calls set()
+            except Exception:
+                pass
         out: Dict[str, Any] = {}
         # snapshot under the lock: ensure() may add counters concurrently
         with self._lock:

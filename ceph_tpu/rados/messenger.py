@@ -119,8 +119,10 @@ import hashlib
 import hmac
 import itertools
 import json
+import os
 import pickle
 import random
+import socket as socket_mod
 import struct
 import threading
 import time
@@ -136,6 +138,14 @@ from ceph_tpu.common.throttle import Throttle
 from ceph_tpu.utils import wirepath as _wirepath
 from ceph_tpu.rados.reactor import (PROC_TOKEN, ReactorPool, RingConnection,
                                     ring_abandon, ring_claim, ring_offer)
+from ceph_tpu.rados.reactor_proc import ShmConnEndpoint, delegate_socket
+from ceph_tpu.rados.shm_ring import (FRAME_HDR as _SHM_FRAME_HDR,
+                                     REC_EOF as _SHM_REC_EOF,
+                                     REC_ERR as _SHM_REC_ERR,
+                                     REC_FRAME as _SHM_REC_FRAME,
+                                     RF_BLOB as _SHM_RF_BLOB,
+                                     RF_FIXED as _SHM_RF_FIXED,
+                                     RF_VERIFIED as _SHM_RF_VERIFIED)
 
 
 def _build_wire_perf() -> PerfCounters:
@@ -240,6 +250,28 @@ def _build_wire_perf() -> PerfCounters:
     # the BENCH record reports wire tx/rx TAILS, not just means
     b.add_histogram("tx_io_us", "socket write+drain µs per flush window")
     b.add_histogram("rx_io_us", "payload read µs per frame")
+    # process-sharded reactor plane (ms_reactor_mode=process): the
+    # byte-loop counters now live in the WORKER PROCESSES' counter
+    # blocks; these proc_* aggregates are refreshed from shared memory
+    # at dump time (perf.presample) so `perf dump`, /metrics and BENCH
+    # see the whole plane, not just the parent's share.  Values are
+    # ABSOLUTE since worker spawn (a perf reset does not zero a worker).
+    b.add_u64("proc_workers", "live reactor worker processes")
+    b.add_u64("proc_delegated_conns",
+              "connections delegated to worker processes (absolute)")
+    b.add_u64("proc_rx_frames",
+              "frames parsed+verified in worker processes (absolute)")
+    b.add_u64("proc_rx_bytes", "frame bytes received by workers (absolute)")
+    b.add_u64("proc_tx_calls", "socket write passes by workers (absolute)")
+    b.add_u64("proc_tx_bytes", "bytes written by workers (absolute)")
+    b.add_u64("proc_native_rx_calls",
+              "released-GIL rx wirepath calls in workers (absolute)")
+    b.add_u64("proc_native_tx_calls",
+              "released-GIL tx wirepath calls in workers (absolute)")
+    b.add_u64("proc_native_bytes",
+              "bytes touched by worker wirepath passes (absolute)")
+    b.add_u64("proc_worker_respawns",
+              "worker processes respawned after death (absolute)")
     return b.create_perf_counters()
 
 BANNER = b"ceph_tpu msgr v2\n"
@@ -1191,6 +1223,9 @@ class Connection:
         except RuntimeError:
             self.loop = None
         self.reactor = None  # ReactorWorker owning this socket's shard
+        # process mode: the reactor worker PROCESS this connection's
+        # socket was delegated to (reader/writer are ShmConnEndpoints)
+        self.shm_worker = None
         self.lane_group: Optional["LaneGroup"] = None
         self.lane_idx = 0
         # dispatch throttle for THIS connection's loop: the home loop
@@ -1627,9 +1662,16 @@ class Connection:
         """Payload length of the next COMPLETE frame in hand: a frame
         pre-verified into the rx stash by the native drain first, else
         whatever is fully buffered on the reader — the serve loop's rx
-        batching predicate (batch only what needs no network wait)."""
+        batching predicate (batch only what needs no network wait).
+        Delegated connections peek the shm ring instead: a fully
+        buffered record needs no worker round-trip."""
         if self._rx_stash:
             return self._rx_stash[0][4]
+        if isinstance(self.reader, ShmConnEndpoint):
+            n = self.reader.complete_record_len()
+            if n is None:
+                return None
+            return max(0, n - _SHM_FRAME_HDR.size)
         return Messenger._buffered_frame_len(self.reader)
 
     def _rx_drain_native(self) -> None:
@@ -1820,6 +1862,8 @@ class Connection:
         if self._rx_error is not None:
             err, self._rx_error = self._rx_error, None
             raise err
+        if isinstance(self.reader, ShmConnEndpoint):
+            return await self._read_frame_shm()
         hdr = await self.reader.readexactly(_HDR.size)
         length, type_id, version, flags, crc, seq = _HDR.unpack(hdr)
         cost = length
@@ -1900,6 +1944,70 @@ class Connection:
         perf.inc("rx_bytes", _HDR.size + length)
         return (type_id, version, seq, payload, cost, blob,
                 bool(flags & FLAG_FIXED), blob_verified)
+
+    async def _read_frame_shm(self) -> Tuple[int, int, int, bytes, int,
+                                             Any, bool, bool]:
+        """Delegated-connection read_frame: the worker process already
+        parsed, crc-verified (its own wirepath arm) and decompressed the
+        frame; this side consumes the record from the shm ring.  Same
+        contract as read_frame: throttle charged before the payload is
+        copied out (and RETURNED on every error path — the r13 cost
+        discipline extended to the process plane), lane fragments land
+        straight in their slice of the group assembly buffer, EOF and
+        crc failure surface exactly like the socket path's."""
+        ep = self.reader
+        kind, length = await ep.read_record_hdr()
+        if kind == _SHM_REC_EOF:
+            raise ConnectionResetError("delegated transport eof")
+        if kind == _SHM_REC_ERR:
+            raise BadFrame(
+                (await ep.read_exact(length)).decode("utf-8", "replace"))
+        if kind != _SHM_REC_FRAME:
+            raise BadFrame(f"unknown shm record kind {kind}")
+        fh = await ep.read_exact(_SHM_FRAME_HDR.size)
+        type_id, version, rflags, seq, plen, blen = _SHM_FRAME_HDR.unpack(fh)
+        cost = plen + blen
+        await self.throttle.get(cost)
+        t_io = time.monotonic()
+        try:
+            payload = await ep.read_exact(plen)
+            blob = None
+            if rflags & _SHM_RF_BLOB:
+                cls = _MSG_TYPES.get(type_id)
+                dest = None
+                if cls is MLaneSegment and self.lane_group is not None \
+                        and (rflags & _SHM_RF_FIXED) and blen \
+                        and not (seq and seq <= self.in_seq):
+                    # zero-copy reassembly across the process seam: the
+                    # fragment's chunk reads shm -> its assembly slice
+                    # (in_seq guard as in the socket paths — a replayed
+                    # duplicate must not re-open reassembly state)
+                    try:
+                        seg = _unpack_fixed(cls, payload, None)
+                        dest = self.lane_group.frag_view(seg, blen)
+                    except Exception:
+                        dest = None
+                if dest is not None:
+                    await ep.read_into(dest, blen)
+                    blob = dest
+                elif getattr(cls, "BLOB_VIEW_OK", False):
+                    blob = memoryview(
+                        np.empty(blen, dtype=np.uint8)).cast("B")
+                    await ep.read_into(blob, blen)
+                else:
+                    blob = bytearray(blen)
+                    await ep.read_into(blob, blen)
+        except BaseException:
+            self.throttle.put(cost)
+            raise
+        perf = self.messenger.perf
+        rx_dt = time.monotonic() - t_io
+        perf.tinc("rx_io", rx_dt)
+        perf.hinc("rx_io_us", rx_dt * 1e6)
+        perf.inc("rx_bytes", _HDR.size + cost)
+        return (type_id, version, seq, payload, cost, blob,
+                bool(rflags & _SHM_RF_FIXED),
+                bool(rflags & _SHM_RF_VERIFIED))
 
     async def adopt_transport(self, reader, writer) -> None:
         """Adopt a fresh transport into this session and replay unacked
@@ -2414,7 +2522,11 @@ class LaneGroup:
                 "unacked": len(c.unacked),
                 "out_seq": c.out_seq, "in_seq": c.in_seq,
                 "reactor": c.reactor.index if c.reactor is not None
-                else None})
+                else None,
+                # process mode: worker pid + per-shard shm-ring depths
+                "shm": (c.reader.dump()
+                        if isinstance(c.reader, ShmConnEndpoint)
+                        else None)})
         with self._lock:
             parked = len(self._parked)
             fifo = len(self._fifo)
@@ -2510,9 +2622,34 @@ class Messenger:
         # -- sharded multi-reactor wire plane (module docstring) -------------
         # the daemon's dispatch loop; reactor-owned serve loops hop here
         self.home_loop: Optional[asyncio.AbstractEventLoop] = None
+        # reactor substrate: thread shards (r13) or forked worker
+        # PROCESSES (ms_reactor_mode=process / CEPH_TPU_REACTOR=) whose
+        # sockets run on truly independent cores, frames crossing via
+        # shm rings into the home-loop dispatch pump (reactor_proc.py)
+        mode = str(_cget(self.conf, "ms_reactor_mode", "thread")
+                   or "thread").strip().lower()
+        env_mode = os.environ.get("CEPH_TPU_REACTOR", "").strip().lower()
+        if env_mode in ("thread", "process"):
+            mode = env_mode
+        elif env_mode in ("0", "off"):
+            mode = "thread"
+        if mode not in ("thread", "process"):
+            mode = "thread"
+        if mode == "process" and not hasattr(os, "fork"):
+            mode = "thread"  # non-posix host: degrade, never fail
+        self.reactor_mode = mode
         n_reactors = int(_cget(self.conf, "ms_async_op_threads", 0) or 0)
+        if mode == "process" and n_reactors <= 0:
+            n_reactors = 2  # process mode implies a pool
         self.reactors: Optional[ReactorPool] = (
-            ReactorPool(name, n_reactors) if n_reactors > 0 else None)
+            ReactorPool(name, n_reactors, mode=mode,
+                        use_native=self.wirepath is not None)
+            if n_reactors > 0 else None)
+        self.shm_ring_bytes = int(
+            _cget(self.conf, "ms_shm_ring_bytes", 4 << 20) or (4 << 20))
+        self._conn_ids = itertools.count(1)
+        # worker-process counters fold into this set at dump time
+        self.perf.presample = self._refresh_proc_perf
         self.lanes_per_peer = max(1, int(
             _cget(self.conf, "ms_lanes_per_peer", 1) or 1))
         # colocated ring transport: negotiated at connect time; never
@@ -2634,6 +2771,182 @@ class Messenger:
         fut = asyncio.run_coroutine_threadsafe(
             self.group_dispatcher(conn, msgs), self.home_loop)
         await asyncio.wrap_future(fut)
+
+    # -- process-sharded reactor plane (delegation seam) ---------------------
+
+    def _delegatable(self) -> bool:
+        return (self.reactors is not None
+                and self.reactors.mode == "process")
+
+    def _crc_mode_for(self, crc_fn, crc_enabled: bool) -> str:
+        if not crc_enabled:
+            return "off"
+        return "shared" if crc_fn is checksum else "zlib"
+
+    def _delegate_transport(self, reader, writer, worker, crc_fn,
+                            crc_enabled: bool):
+        """Hand a live plaintext transport to a reactor worker PROCESS:
+        extract the raw socket + any already-buffered rx bytes, build
+        the shm ring pair, send the fd over the worker's ctrl channel,
+        and close the parent's copy (the worker's dup now OWNS the
+        socket — worker death = transport death, the revival signal).
+        Returns (reader, writer) shm endpoints, or None when this
+        transport can't delegate (secure stream, no raw socket, pending
+        tx bytes, worker unavailable) — the caller keeps the in-process
+        transport, a graceful fallback never an error."""
+        pool = self.reactors
+        if not self._delegatable() or not pool.ensure_worker(worker):
+            return None
+        # raw socket extraction (plaintext only — a SecureStream has no
+        # transport to hand across; delegation happens below the AES
+        # layer or not at all)
+        if isinstance(writer, CorkedWriter):
+            transport, sock = writer._transport, writer._sock
+            if writer._buffered:
+                return None  # unsent segments would interleave
+        elif isinstance(writer, asyncio.StreamWriter):
+            transport = writer.transport
+            sock = transport.get_extra_info("socket") \
+                if transport is not None else None
+            sock = getattr(sock, "_sock", sock)
+        else:
+            return None
+        if transport is None or sock is None or transport.is_closing():
+            return None
+        try:
+            if transport.get_write_buffer_size() != 0:
+                return None  # buffered tx would race the worker's writes
+        except Exception:
+            return None
+        # leftover rx bytes: captured only after the ctrl handoff
+        # succeeds, so a failed delegation leaves the reader intact
+        if isinstance(reader, FrameReceiver):
+            leftover = bytes(memoryview(reader._pending)[reader._off:])
+        elif isinstance(reader, asyncio.StreamReader):
+            leftover = bytes(reader._buffer)
+        else:
+            return None
+        try:
+            transport.pause_reading()
+        except Exception:
+            pass
+        conn_id = next(self._conn_ids)
+        try:
+            ep = delegate_socket(worker, conn_id, sock.fileno(), leftover,
+                                 self.shm_ring_bytes,
+                                 self._crc_mode_for(crc_fn, crc_enabled),
+                                 wp=self.wirepath, perf=self.perf)
+        except OSError:
+            ep = None
+        if ep is None:
+            try:
+                transport.resume_reading()
+            except Exception:
+                pass
+            return None
+        # handoff complete: the worker owns a dup of the fd.  Clear the
+        # captured bytes from the parent reader and close our copy.
+        if isinstance(reader, FrameReceiver):
+            reader._pending.clear()
+            reader._off = 0
+        else:
+            reader._buffer.clear()
+        if isinstance(writer, CorkedWriter):
+            writer._detach()
+        try:
+            transport.close()
+        except Exception:
+            pass
+        # proc_delegated_conns has ONE owner: the presample refresh
+        # (worker.sockets tally) — no inc here, two sources would drift
+        self.dout(4, f"conn {conn_id} delegated to reactor worker "
+                     f"{worker.index} (pid {worker.pid})")
+        return ep, ep
+
+    async def _delegate_conn(self, conn: "Connection", lane: int) -> None:
+        """Delegate a LIVE connection (acceptor side, right after its
+        MLaneHello bound it into a lane group).  Runs under the send
+        lock so an in-flight flush window can't race the writer swap;
+        the caller is the connection's own serve loop, so no reader
+        race exists."""
+        if isinstance(conn.reader, ShmConnEndpoint) or conn.closed:
+            return
+        worker = self.reactors.worker_for(conn.peer, lane)
+        async with conn._send_lock:
+            if conn.closed or isinstance(conn.reader, ShmConnEndpoint):
+                return
+            pair = self._delegate_transport(conn.reader, conn.writer,
+                                            worker, conn.crc_fn,
+                                            conn.crc_enabled)
+            if pair is None:
+                return
+            conn.reader, conn.writer = pair
+            conn.shm_worker = worker
+
+    def _accepted_fd_cb(self, fd: int, worker) -> None:
+        """A worker's accept loop forwarded a fresh inbound socket: run
+        the normal handshake/accept path on the home loop (auth,
+        session resume and ring negotiation need parent state)."""
+        loop = self.home_loop
+        if loop is None or loop.is_closed() or self._shutdown:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+            return
+
+        async def _adopt():
+            try:
+                sock = socket_mod.socket(fileno=fd)
+            except OSError:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+                return
+            try:
+                sock.setblocking(False)
+                reader, writer = await asyncio.open_connection(sock=sock)
+            except OSError:
+                # close via the OBJECT (it owns the fd now): a raw
+                # os.close here would double-close a number the socket
+                # destructor closes again later — onto whoever reused it
+                sock.close()
+                return
+            await self._accept(reader, writer)
+
+        def _spawn():
+            # runs ON the home loop (call_soon_threadsafe below)
+            t = asyncio.get_running_loop().create_task(_adopt())
+            self._tasks.add(t)
+            t.add_done_callback(self._tasks.discard)
+
+        loop.call_soon_threadsafe(_spawn)
+
+    def _refresh_proc_perf(self) -> None:
+        """perf presample hook: fold the worker processes' counter
+        blocks into the wire set so the daemon's `perf dump` (and with
+        it /metrics and BENCH) reports the WHOLE reactor plane."""
+        pool = self.reactors
+        if pool is None or pool.mode != "process":
+            return
+        agg = pool.counters_sum()
+        if not agg:
+            return
+        p = self.perf
+        p.set("proc_workers",
+              sum(1 for w in pool.workers if w.is_alive()))
+        p.set("proc_delegated_conns",
+              sum(w.sockets for w in pool.workers))
+        p.set("proc_worker_respawns",
+              sum(w.respawns for w in pool.workers))
+        p.set("proc_rx_frames", agg.get("rx_frames", 0))
+        p.set("proc_rx_bytes", agg.get("rx_bytes", 0))
+        p.set("proc_tx_calls", agg.get("tx_calls", 0))
+        p.set("proc_tx_bytes", agg.get("tx_bytes", 0))
+        p.set("proc_native_rx_calls", agg.get("native_rx_calls", 0))
+        p.set("proc_native_tx_calls", agg.get("native_tx_calls", 0))
+        p.set("proc_native_bytes", agg.get("native_bytes", 0))
 
     # -- wire accounting -----------------------------------------------------
 
@@ -2871,8 +3184,14 @@ class Messenger:
             # inbound sockets are owned by whichever reactor accepts
             self.reactors.start()
             try:
-                await self.reactors.serve_shards(
-                    self.server.sockets[0], self._accept)
+                if self.reactors.mode == "process":
+                    # worker processes accept on dup'd listening fds
+                    # and forward fresh sockets here for the handshake
+                    self.reactors.serve_shards_process(
+                        self.server.sockets[0], self._accepted_fd_cb)
+                else:
+                    await self.reactors.serve_shards(
+                        self.server.sockets[0], self._accept)
             except (OSError, NotImplementedError):
                 pass  # platform without dup'd-fd accept: home loop only
         if self._local_fastpath:
@@ -2968,7 +3287,27 @@ class Messenger:
                 if conn.reader is not reader:
                     # session reconnect: adopt the new socket, replay our
                     # un-acked frames (e.g. replies lost in the drop)
-                    await conn.adopt_transport(reader, writer)
+                    pair = None
+                    if (self._delegatable() and conn.lane_group is not None
+                            and conn.lane_idx >= 1):
+                        # revived acceptor-side data lane: its byte work
+                        # goes back to a worker process (pending replies
+                        # replay through the ring inside adopt)
+                        w = self.reactors.worker_for(conn.peer,
+                                                     conn.lane_idx)
+                        pair = self._delegate_transport(
+                            reader, writer, w,
+                            self._negotiated_crc(peer_ckind),
+                            conn.crc_enabled)
+                        if pair is not None:
+                            reader, writer = pair
+                            conn.shm_worker = w
+                    try:
+                        await conn.adopt_transport(reader, writer)
+                    except BaseException:
+                        if pair is not None:
+                            pair[0].close()
+                        raise
             else:
                 conn = Connection(self, reader, writer, peer,
                                   Policy.lossy_client(), peer_name)
@@ -3143,6 +3482,13 @@ class Messenger:
                                 conn.in_seq = max(conn.in_seq, seq)
                                 conn.queue_ack(seq)
                             conn.throttle.put(cost)
+                            if msg.lane >= 1 and self._delegatable():
+                                # process mode: a freshly bound DATA
+                                # lane's socket moves to its worker
+                                # process; this serve loop keeps
+                                # running, now pulling records off the
+                                # shm ring instead of the socket
+                                await self._delegate_conn(conn, msg.lane)
                             continue
                         if conn.lane_group is not None:
                             # striped session: the LaneGroup restores
@@ -3329,7 +3675,29 @@ class Messenger:
                     await self._group_fatal(group)
                     return
                 conn.crc_fn = self._negotiated_crc(peer_ckind)
-                await conn.adopt_transport(reader, writer)
+                pair = None
+                if self._delegatable() and conn.lane_idx >= 1:
+                    # the shard revives in a worker PROCESS (a fresh one
+                    # if the old worker died — ensure_worker respawns
+                    # the slot); the pinned unacked frames replay
+                    # through the new shm ring inside adopt_transport
+                    worker = self.reactors.worker_for(group.peer,
+                                                      conn.lane_idx)
+                    pair = self._delegate_transport(reader, writer,
+                                                    worker, conn.crc_fn,
+                                                    conn.crc_enabled)
+                    if pair is not None:
+                        reader, writer = pair
+                        conn.shm_worker = worker
+                try:
+                    await conn.adopt_transport(reader, writer)
+                except BaseException:
+                    # adopt failed/cancelled AFTER the handoff: the shm
+                    # pair must not outlive it (teardown returns parked
+                    # budget + unlinks the shared memory)
+                    if pair is not None:
+                        pair[0].close()
+                    raise
                 self.perf.inc("lane_revivals")
                 self.dout(1, f"lane revived in place for group "
                              f"{group.group_id[:8]} peer "
@@ -3469,8 +3837,12 @@ class Messenger:
 
     async def _dial_lane(self, group: LaneGroup, lane_idx: int) -> None:
         """Open one data lane of a lane group, on the reactor worker the
-        stable hash binds it to (home loop without a pool)."""
+        stable hash binds it to: in thread mode the dial runs ON the
+        worker's loop; in process mode the handshake runs here and the
+        socket is then DELEGATED to the worker process (home loop
+        without a pool)."""
         worker = None
+        proc_mode = self._delegatable()
         if self.reactors is not None:
             self.reactors.start()
             worker = self.reactors.worker_for(group.peer, lane_idx)
@@ -3487,11 +3859,23 @@ class Messenger:
             except Exception:
                 writer.close()
                 raise
+            crc_fn = self._negotiated_crc(peer_ckind)
+            shm_worker = None
+            if proc_mode:
+                pair = self._delegate_transport(
+                    reader, writer, worker, crc_fn,
+                    bool(_cget(self.conf, "ms_crc_data", True)))
+                if pair is not None:
+                    reader, writer = pair
+                    shm_worker = worker
             conn = Connection(self, reader, writer, group.peer,
                               group.policy, peer_name, outbound=True)
-            conn.crc_fn = self._negotiated_crc(peer_ckind)
+            conn.crc_fn = crc_fn
             conn.session_id = session_id
-            if worker is not None:
+            if shm_worker is not None:
+                conn.shm_worker = shm_worker
+                worker.dialed += 1
+            elif worker is not None and not proc_mode:
                 conn.reactor = worker
                 worker.sockets += 1
                 worker.dialed += 1
@@ -3507,7 +3891,7 @@ class Messenger:
             self._tasks.add(task)
             task.add_done_callback(self._tasks.discard)
 
-        if worker is not None:
+        if worker is not None and not proc_mode:
             await worker.submit(_do())
         else:
             await _do()
@@ -3616,9 +4000,10 @@ class Messenger:
             peers.append(g.dump())
         for c in self._ring_conns:
             rings.append(c.dump())
-        return {
+        out = {
             "op_threads": (self.reactors.n_workers
                            if self.reactors is not None else 0),
+            "reactor_mode": self.reactor_mode,
             "lanes_per_peer": self.lanes_per_peer,
             "colocated_ring": self._ring_ok,
             "wirepath": "native" if self.wirepath is not None else "python",
@@ -3627,3 +4012,10 @@ class Messenger:
             "peers": peers,
             "rings": rings,
         }
+        if self._delegatable():
+            # whole-plane view: worker pids + the shm aggregate the
+            # perf presample folds into `perf dump`
+            self._refresh_proc_perf()
+            out["worker_pids"] = [w.pid for w in self.reactors.workers]
+            out["proc_perf"] = self.reactors.counters_sum()
+        return out

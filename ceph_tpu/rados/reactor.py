@@ -122,18 +122,45 @@ class ReactorPool:
     """The messenger's worker pool (AsyncMessenger ``workers`` +
     ``get_worker`` role).  ``worker_for(addr, lane)`` is the STABLE HASH
     binding: the same (peer, lane) always lands on the same worker, so a
-    lane's revival redials on the loop that owns its session state."""
+    lane's revival redials on the loop (thread mode) or re-delegates to
+    the shard slot (process mode) that owns its session state.
 
-    def __init__(self, name: str, n_workers: int):
+    ``mode`` selects the execution substrate (``ms_reactor_mode``):
+
+    - ``thread`` (default): N ReactorWorker threads, each its own event
+      loop owning a socket shard — the r13 plane;
+    - ``process``: N forked reactor worker PROCESSES
+      (reactor_proc.ReactorProcessWorker), each owning its socket shard
+      outright with its own interpreter and its own copy of the native
+      wirepath; frames cross via shared-memory rings (shm_ring.py) into
+      the daemon's single home-loop dispatch pump.  A dead worker slot
+      respawns on demand (ensure_worker) and every fork is reaped."""
+
+    def __init__(self, name: str, n_workers: int, mode: str = "thread",
+                 use_native: bool = True):
         self.name = name
+        self.mode = mode if mode in ("thread", "process") else "thread"
         self.n_workers = max(1, int(n_workers))
-        self.workers: List[ReactorWorker] = [
-            ReactorWorker(name, i) for i in range(self.n_workers)]
+        if self.mode == "process":
+            from ceph_tpu.rados.reactor_proc import ReactorProcessWorker
+
+            self.workers: List[Any] = [
+                ReactorProcessWorker(name, i, use_native=use_native)
+                for i in range(self.n_workers)]
+        else:
+            self.workers = [
+                ReactorWorker(name, i) for i in range(self.n_workers)]
         self._servers: List[Tuple[ReactorWorker, Any]] = []
         self._started = False
         # the owning daemon's Log (debug_ms douts); attached by the
         # messenger when the daemon wires its Context in
         self.log = None
+        # process-mode accept fan-out state: the listening socket the
+        # workers hold dups of, the parent-side accepted-fd callback,
+        # and the home loop the ctrl readers are registered on
+        self._listen_sock = None
+        self._on_fd = None
+        self._ctrl_loop = None
 
     def dout(self, level: int, message: str) -> None:
         log = self.log
@@ -144,21 +171,118 @@ class ReactorPool:
         if not self._started:
             self._started = True
             for w in self.workers:
-                w.ensure_started()
-            self.dout(1, f"reactor pool {self.name}: "
-                         f"{self.n_workers} workers started")
+                if self.mode == "process":
+                    w.start()
+                else:
+                    w.ensure_started()
+            self.dout(1, f"reactor pool {self.name}: {self.n_workers} "
+                         f"{self.mode} workers started"
+                      + (f" (pids {[w.pid for w in self.workers]})"
+                         if self.mode == "process" else ""))
 
-    def worker_for(self, addr: Tuple[str, int], lane: int = 0) -> ReactorWorker:
+    def worker_for(self, addr: Tuple[str, int], lane: int = 0):
         key = f"{addr[0]}:{addr[1]}:{lane}".encode()
         h = int.from_bytes(hashlib.blake2b(key, digest_size=8).digest(),
                            "little")
         return self.workers[h % self.n_workers]
 
+    # -- process mode --------------------------------------------------------
+
+    def ensure_worker(self, worker) -> bool:
+        """Process mode: make sure the slot has a live child, respawning
+        (and reaping the old pid) after a worker death — the shard slot
+        identity survives, the way a revived lane keeps its session."""
+        if self.mode != "process":
+            return True
+        self.start()
+        if worker.is_alive():
+            return True
+        self.dout(1, f"reactor pool {self.name}: worker {worker.index} "
+                     f"died; respawning shard slot")
+        try:
+            worker.restart()
+        except OSError:
+            return False
+        if self._listen_sock is not None:
+            worker.listen(self._listen_sock)
+        self._register_ctrl_reader(worker)
+        return worker.is_alive()
+
+    def serve_shards_process(self, base_sock, on_fd: Callable) -> None:
+        """Process-mode inbound sharding: every worker gets a dup of the
+        listening socket and accepts on it; accepted fds forward to the
+        parent (``on_fd``) whose home loop runs the handshake — the
+        parent owns auth/session state, the workers own the byte work
+        once the connection is delegated."""
+        import asyncio as _asyncio
+
+        self.start()
+        self._listen_sock = base_sock
+        self._on_fd = on_fd
+        self._ctrl_loop = _asyncio.get_event_loop()
+        for w in self.workers:
+            w.listen(base_sock)
+            self._register_ctrl_reader(w)
+
+    def _register_ctrl_reader(self, worker) -> None:
+        """Watch the worker's ctrl socket for forwarded accepted fds."""
+        loop = self._ctrl_loop
+        if loop is None or worker.ctrl is None or loop.is_closed():
+            return
+        import socket as _socket
+
+        ctrl = worker.ctrl
+        fd = ctrl.fileno()
+
+        def _on_readable(w=worker, c=ctrl, fdnum=fd):
+            while True:
+                try:
+                    msg, fds, _fl, _ad = _socket.recv_fds(c, 65536, 8)
+                except (BlockingIOError, InterruptedError):
+                    return
+                except OSError:
+                    msg, fds = b"", []
+                if not msg:
+                    try:
+                        loop.remove_reader(fdnum)
+                    except (OSError, ValueError):
+                        pass
+                    return
+                if b"accepted" in msg and fds and self._on_fd is not None:
+                    w.accepted += 1
+                    self._on_fd(fds[0], w)
+                    for extra in fds[1:]:
+                        import os as _os
+
+                        _os.close(extra)
+                else:
+                    import os as _os
+
+                    for f in fds:
+                        _os.close(f)
+
+        try:
+            loop.add_reader(fd, _on_readable)
+        except (OSError, ValueError):
+            pass
+
+    def counters_sum(self) -> Dict[str, int]:
+        """Aggregate the per-process counter blocks (perf-dump seam)."""
+        agg: Dict[str, int] = {}
+        if self.mode != "process":
+            return agg
+        for w in self.workers:
+            for k, v in w.counters_dict().items():
+                agg[k] = agg.get(k, 0) + v
+        return agg
+
     async def serve_shards(self, base_sock, accept_cb: Callable) -> None:
         """Register the listening socket with EVERY worker loop (dup'd
         fd per worker): whichever reactor's selector wins the accept
         race owns the new socket — inbound sockets shard across workers
-        without a handoff (the reference's per-worker Processor)."""
+        without a handoff (the reference's per-worker Processor).
+        Thread mode only; process mode shards accepts through
+        :meth:`serve_shards_process`."""
         self.start()
         for w in self.workers:
             dup = base_sock.dup()
@@ -180,6 +304,22 @@ class ReactorPool:
             except Exception:
                 pass
         self._servers.clear()
+        if self.mode == "process":
+            loop = self._ctrl_loop
+            for w in self.workers:
+                if loop is not None and w.ctrl is not None \
+                        and not loop.is_closed():
+                    try:
+                        loop.remove_reader(w.ctrl.fileno())
+                    except (OSError, ValueError):
+                        pass
+                # graceful stop + guaranteed reap: daemon shutdown must
+                # leave no zombies (worker.shutdown SIGKILLs stragglers
+                # and waitpids them)
+                w.shutdown()
+            self._listen_sock = None
+            self._on_fd = None
+            return
         for w in self.workers:
             w.stop()
 

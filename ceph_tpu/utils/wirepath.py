@@ -18,6 +18,15 @@ which arm actually ran.  ``CEPH_TPU_WIREPATH=0`` forces the python arm
 process-wide (the CI parity knob); the per-messenger config option
 ``ms_wirepath_native`` gates it per daemon.
 
+Per-process arm resolution under the process-sharded reactor plane
+(``ms_reactor_mode=process``): ReactorProcessWorker.start() resolves
+the arm in the PARENT before forking, so every worker child inherits a
+loaded, probed bridge (ctypes handles survive fork) and never pays —
+or races — a g++ build of its own.  After the fork the cached
+resolution is genuinely per-process state: each worker runs its own
+copy of the native wirepath, its ``wirepath_kind`` counter slot
+reporting which arm that process carries.
+
 The native arm only engages when the process checksum resolver is
 crc32c (checksum.checksum_kind() == "crc32c"): the wirepath's crc
 entry points compute crc32c, and a zlib-resolved host must keep
