@@ -829,6 +829,14 @@ def main() -> int:
     tier_cold_mbps = got.get("tier_cold_read_MBps", 0.0)
     tier_ratio = got.get("tier_hot_vs_cold", 0.0)
     tier_perf: dict = got.get("tier_perf", {})
+    tier_pagestore: dict = got.get("tier_pagestore") or {}
+
+    # MIXED-SIZE-POPULATION arm: a working set whose monolithic (pow2-
+    # bucketed) residency footprint exceeds the tier budget must fit
+    # entirely under the paged layout (frag_saved_bytes > 0, bounded
+    # pages_used) — the page table's acceptance criterion
+    tier_mixed: dict = _run_child_bench(
+        "--tier-mixed", extra_env={"CEPH_TPU_FORCE_BATCH": "1"})
 
     # ELASTIC-MEMBERSHIP arm: MB/s moved and the reserved client's p99
     # impact DURING an out -> rebalance -> in cycle (CLASS_REBALANCE
@@ -979,6 +987,12 @@ def main() -> int:
         "tier_cold_read_MBps": round(tier_cold_mbps, 1),
         "tier_hot_vs_cold": round(tier_ratio, 2),
         "tier_perf": tier_perf,
+        # `pagestore` occupancy snapshot of the hot-read arm (page
+        # pool / dirty / frag_saved gauges while the set is resident)
+        "tier_pagestore": tier_pagestore,
+        # mixed-size-population arm: monolithic-equivalent vs paged
+        # footprint of the same residents, and whether the set fits
+        "tier_mixed": tier_mixed,
         # elastic-membership arm: data-movement rate and the reserved
         # client's p99 while an out -> rebalance -> in cycle drains and
         # refills one OSD under the background dmClock classes; the
@@ -1665,12 +1679,14 @@ def hot_read_bench() -> int:
                             k, {"sum_s": 0.0, "count": 0})
                         agg["sum_s"] += v.get("sum", 0.0)
                         agg["count"] += v.get("avgcount", 0)
+            pagestore = (store.page_stats()
+                         if hasattr(store, "page_stats") else None)
             await c.stop()
-            return cold_dt, hot_dt, hits, tier_perf
+            return cold_dt, hot_dt, hits, tier_perf, pagestore
         finally:
             await cluster.stop()
 
-    cold_dt, hot_dt, hits, tier_perf = asyncio.run(go())
+    cold_dt, hot_dt, hits, tier_perf, pagestore = asyncio.run(go())
     total = n_reads * obj_size
     print(json.dumps({
         "tier_hot_read_MBps": round(total / hot_dt / 1e6, 1),
@@ -1678,7 +1694,120 @@ def hot_read_bench() -> int:
         "tier_hot_vs_cold": round(cold_dt / hot_dt, 2),
         "tier_resident_hits_in_window": hits,
         "tier_window_reads": n_reads,
+        # page-pool occupancy snapshot while the hot set is resident
+        # (None = monolithic store forced via CEPH_TPU_PAGESTORE=0)
+        "tier_pagestore": pagestore,
         "tier_perf": tier_perf}))
+    return 0
+
+
+def tier_mixed_bench() -> int:
+    """Mixed-size-population arm (bench.py --tier-mixed): the paged
+    layout's reason to exist.  A working set of mixed object sizes is
+    chosen so its FULL-STRIPE residency footprint — the only shape the
+    monolithic r10 store can hold, all k+m shard rows or nothing —
+    exceeds the tier budget, while its data-row footprint fits.  The
+    paged store's agent resolves the pressure at O(page) granularity:
+    it SHEDS the parity-row page suffixes of cold residents (partial-
+    stripe residency) so every object stays read-resident at ~k/n of
+    its full footprint; the monolithic store at the same budget must
+    evict whole objects forever.  The arm promotes the set, lets the
+    agent settle, re-promotes anything dropped in the churn, and then
+    asserts: every read is byte-identical, every object is resident,
+    frag_saved_bytes > 0 (full-stripe-equivalent minus actual pages),
+    and pages_used is bounded by the pool."""
+    import asyncio
+
+    os.environ["CEPH_TPU_FORCE_BATCH"] = "1"
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from ceph_tpu.rados.vstart import Cluster
+    import ceph_tpu.rados.osd as osdmod
+
+    # ~24 objects x 144..240 KiB at k=2,m=1: full-stripe residency
+    # needs ~7.1 MiB, the data rows alone ~4.7 MiB — a budget of 6 MiB
+    # holds the whole set only with parity shed
+    capacity = 6 << 20
+    page_bytes = 16 << 10
+    n_obj = 24
+    sizes = [(144 << 10) + 4096 * i for i in range(n_obj)]
+
+    async def go():
+        cluster = Cluster(n_osds=3, conf={
+            "osd_auto_repair": False,
+            "client_op_timeout": 60.0,
+            "osd_hit_set_period": 5.0,
+            "osd_min_read_recency_for_promote": 1,
+            "osd_tier_promote_max_objects_sec": 256,
+            "osd_tier_promote_max_bytes_sec": 1 << 30,
+            "osd_ec_planar_bytes": capacity,
+            "osd_tier_page_bytes": page_bytes,
+            "osd_tier_target_max_bytes": capacity,
+            "osd_cache_target_full_ratio": 0.9,
+            "osd_tier_agent_interval": 0.1})
+        await cluster.start()
+        try:
+            c = await cluster.client()
+            pool = await c.create_pool("mixed", profile={
+                "plugin": "jerasure", "technique": "reed_sol_van",
+                "k": "2", "m": "1"})
+            store = osdmod.shared_planar_store()
+            assert store is not None
+            rng = np.random.default_rng(11)
+            blobs = {}
+            for i, size in enumerate(sizes):
+                oid = f"m{i}"
+                blobs[oid] = rng.integers(0, 256, size,
+                                          dtype=np.uint8).tobytes()
+                await c.put(pool, oid, blobs[oid])
+
+            def residents():
+                return sum(
+                    1 for oid in blobs
+                    if any(o._planar is not None
+                           and o._planar_key(pool, oid) in store
+                           for o in cluster.osds.values()))
+
+            # promote rounds: the first pass over-commits (full-stripe
+            # installs), the agent sheds parity on its cadence, and
+            # re-reads re-promote whatever churned out — converges to
+            # everything-resident-data-only within a few rounds
+            for _ in range(6):
+                for oid, blob in blobs.items():
+                    got = await c.get(pool, oid, fadvise="willneed")
+                    assert got == blob
+                await asyncio.sleep(0.4)
+                if residents() == n_obj \
+                        and store.resident_bytes <= capacity:
+                    break
+            for oid, blob in blobs.items():  # resident-hit identity
+                assert await c.get(pool, oid) == blob
+            stats = store.stats()
+            pagestore = (store.page_stats()
+                         if hasattr(store, "page_stats") else None)
+            held = residents()
+            await c.stop()
+            return stats, pagestore, held
+        finally:
+            await cluster.stop()
+
+    stats, pagestore, residents = asyncio.run(go())
+    mono = int(stats.get("monolithic_equiv_bytes", 0))
+    paged_bytes = int(stats.get("resident_bytes", 0))
+    print(json.dumps({
+        "tier_mixed_objects": n_obj,
+        "tier_mixed_residents_held": residents,
+        "tier_mixed_capacity_bytes": capacity,
+        "tier_mixed_page_bytes": page_bytes,
+        # the acceptance pair: what the SAME residents would cost as
+        # monolithic full-stripe buffers vs what the pages actually
+        # hold after parity shed
+        "tier_mixed_monolithic_equiv_bytes": mono,
+        "tier_mixed_paged_bytes": paged_bytes,
+        "tier_mixed_frag_saved_bytes": max(0, mono - paged_bytes),
+        "tier_mixed_fits_paged": paged_bytes <= capacity
+        and residents == n_obj,
+        "tier_mixed_fits_monolithic": mono <= capacity,
+        "tier_mixed_pagestore": pagestore}))
     return 0
 
 
@@ -2084,6 +2213,8 @@ if __name__ == "__main__":
         sys.exit(msgr_stream_bench())
     if "--hot-read" in sys.argv:
         sys.exit(hot_read_bench())
+    if "--tier-mixed" in sys.argv:
+        sys.exit(tier_mixed_bench())
     if "--rebalance" in sys.argv:
         sys.exit(rebalance_bench())
     if "--macro" in sys.argv:

@@ -346,6 +346,33 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
     Option("osd_min_read_recency_for_promote", OPT_INT, 1,
            desc="consecutive newest hit sets an object must appear in "
                 "before a read promotes it (0 = always)"),
+    Option("osd_min_write_recency_for_promote", OPT_INT, 1,
+           desc="consecutive newest hit sets an object must appear in "
+                "before a write installs a resident (0 = always; the "
+                "r10 behavior was an unconditional install)"),
+    Option("osd_tier_pagestore", OPT_BOOL, True,
+           desc="back the residency tier with the paged store "
+                "(page table + ragged tails + dirty bits) instead of "
+                "monolithic per-object buffers"),
+    Option("osd_tier_page_bytes", OPT_SIZE, 64 << 10,
+           desc="page size of the paged resident store (u32-word "
+                "pages; eviction and dirty tracking are per page)"),
+    Option("osd_tier_cache_mode", OPT_STR, "writethrough",
+           desc="default cache mode for tiered pools (pool opt "
+                "cache_mode overrides): writethrough applies local "
+                "shards synchronously, writeback defers them to dirty "
+                "pages flushed by the agent"),
+    Option("osd_cache_target_dirty_ratio", OPT_FLOAT, 0.4,
+           desc="agent flushes dirty pages when dirty bytes exceed "
+                "this fraction of the tier target"),
+    Option("osd_tier_flush_age", OPT_SECS, 5.0,
+           desc="dirty residents older than this flush on the next "
+                "agent pass regardless of the dirty ratio (0 = "
+                "ratio/pressure-driven only)"),
+    Option("osd_tier_full_target_factor", OPT_FLOAT, 0.5,
+           desc="fullness pressure: NEARFULL or worse on the backing "
+                "store scales the tier's effective target by this "
+                "factor (and forces dirty flush ahead of eviction)"),
     Option("osd_tier_promote_max_objects_sec", OPT_INT, 32,
            desc="promotion rate ceiling, objects/sec (0 = unthrottled)"),
     Option("osd_tier_promote_max_bytes_sec", OPT_SIZE, 64 << 20,

@@ -1357,6 +1357,38 @@ class PlanarShardStore:
         self.perf.inc("hit" if ent is not None else "miss")
         return ent
 
+    # -- the residency protocol shared with PagedResidentStore ---------------
+    # (ceph_tpu/rados/pagestore.py): ecutil's planar_* helpers and the
+    # OSD tier paths speak these four shapes so either store can sit
+    # behind the cache tier.
+
+    def touch(self, key: Any):
+        """(w, n_rows, meta) with LRU refresh + hit/miss counting,
+        materializing nothing."""
+        ent = self.get_planar(key)
+        return None if ent is None else (ent[1], ent[2], ent[3])
+
+    def entry_info(self, key: Any):
+        """(w, n_rows, meta) without LRU/counter side effects."""
+        with self._lock:
+            ent = self._entries.get(key)
+        return None if ent is None else (ent[1], ent[2], ent[3])
+
+    def resident_meta(self, key: Any):
+        """The entry's caller meta, or None — the policy probe shape."""
+        info = self.entry_info(key)
+        return None if info is None else info[2]
+
+    def gather_rows(self, key: Any, r0: int, r1: int):
+        """The resident's bit-rows [r0, r1) (a device-buffer slice
+        here; the paged store gathers from its page table), or None.
+        No LRU side effects — ``touch`` owns those."""
+        with self._lock:
+            ent = self._entries.get(key)
+        if ent is None or r1 > ent[0].shape[0]:
+            return None
+        return ent[0][r0:r1]
+
     def apply(self, key: Any, mbits: np.ndarray, out_rows: int,
               out_key: Any = None):
         """Apply a bit-matrix to the resident planar rows (encode with a
@@ -1394,11 +1426,13 @@ class PlanarShardStore:
             self.put_planar(out_key, out, w=w, n_rows=out_rows)
         return out
 
-    def drop(self, key: Any) -> bool:
+    def drop(self, key: Any, force: bool = False) -> bool:
         """Remove `key` if resident; True when an entry was actually
         dropped.  Dropping an absent key is a supported no-op (the tier
         agent races the LRU here: either side may have evicted first,
-        and the loser must count a no-op, not error)."""
+        and the loser must count a no-op, not error).  ``force`` is the
+        paged store's dirty-override knob — a no-op here, where nothing
+        is ever dirty — accepted so callers can speak one surface."""
         with self._lock:
             dropped = key in self._entries
             if dropped:

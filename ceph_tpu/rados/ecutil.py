@@ -615,28 +615,54 @@ async def planar_encode_async(codec, sinfo: StripeInfo, data: bytes,
     return blobs, all_bits, n, L, w
 
 
-def planar_rows(store, key, version) -> Optional[List[np.ndarray]]:
-    """All n shard rows packed from the planar resident under `key`, or
-    None when absent or at a different version.  ONE device pack serves
-    recovery/repair re-encodes with no matmul at all — the resident IS
-    the encoded object."""
-    got = store.get_planar(key)
-    if got is None:
-        return None
-    bits, w, n_rows, meta = got
-    if not meta or meta[0] != version:
-        return None
-    L = meta[1]
+def _pack_rows(bits, w: int, n_rows: int, L: int) -> np.ndarray:
+    """Resident bit-rows -> packed [n_rows, L] uint8 (the one exit
+    boundary, shared by every planar_* helper; dtype tells the packed-bit
+    u32 lane apart from int8 planes)."""
     if np.dtype(bits.dtype) == np.uint32:
-        # packed-bit resident (u32 plane words, the production lane)
         from ceph_tpu.ops.gf2 import from_packedbit
 
-        rows = np.asarray(from_packedbit(bits, n_rows))[:, :L]
-    else:
-        from ceph_tpu.ops.gf2 import from_planar
+        return np.asarray(from_packedbit(bits, n_rows))[:, :L]
+    from ceph_tpu.ops.gf2 import from_planar
 
-        rows = np.asarray(from_planar(bits, w, n_rows))[:, :L]
+    return np.asarray(from_planar(bits, w, n_rows))[:, :L]
+
+
+def planar_rows(store, key, version) -> Optional[List[np.ndarray]]:
+    """All n shard rows packed from the planar resident under `key`, or
+    None when absent, at a different version, or PARTIAL (a paged
+    resident whose parity pages were shed serves object reads but not
+    whole-stripe re-encodes).  ONE device pack serves recovery/repair
+    re-encodes with no matmul at all — the resident IS the encoded
+    object."""
+    got = store.touch(key)
+    if got is None:
+        return None
+    w, n_rows, meta = got
+    if not meta or meta[0] != version:
+        return None
+    bits = store.gather_rows(key, 0, n_rows * w)
+    if bits is None:
+        return None
+    rows = _pack_rows(bits, w, n_rows, meta[1])
     return [rows[i] for i in range(n_rows)]
+
+
+def planar_shard_bytes(store, key, version, shard: int) -> Optional[bytes]:
+    """ONE shard's packed bytes from the resident's bit-rows — the
+    writeback flush/sub-read shape: a dirty resident's deferred local
+    shard apply materializes exactly the blob the write-through path
+    would have stored (byte-identity of the packed-bit lane)."""
+    got = store.entry_info(key)
+    if got is None:
+        return None
+    w, _n_rows, meta = got
+    if not meta or meta[0] != version:
+        return None
+    bits = store.gather_rows(key, shard * w, (shard + 1) * w)
+    if bits is None:
+        return None
+    return _pack_rows(bits, w, 1, meta[1]).reshape(-1).tobytes()
 
 
 def planar_object_bytes(store, key, version, k: int, cs: int,
@@ -647,11 +673,13 @@ def planar_object_bytes(store, key, version, k: int, cs: int,
     exit-boundary memo (dies with the entry / on version change), so a
     cache-tier resident read many times pays the device pack ONCE —
     the store's 'pack once per resident lifetime' contract held under
-    repeated reads."""
-    got = store.get_planar(key)
+    repeated reads.  Served through the shared residency protocol
+    (touch/gather_rows), so a PAGED resident whose parity pages were
+    shed still answers from its data-row prefix."""
+    got = store.touch(key)
     if got is None:
         return None
-    bits, w, n_rows, meta = got
+    w, _n_rows, meta = got
     if not meta or meta[0] != version:
         return None
     memo_get = getattr(store, "memo_get", None)
@@ -659,16 +687,11 @@ def planar_object_bytes(store, key, version, k: int, cs: int,
         cached = memo_get(key, version)
         if cached is not None:
             return cached
+    data_bits = store.gather_rows(key, 0, k * w)
+    if data_bits is None:
+        return None
     L = meta[1]
-    data_bits = bits[:k * w]
-    if np.dtype(bits.dtype) == np.uint32:
-        from ceph_tpu.ops.gf2 import from_packedbit
-
-        rows = np.asarray(from_packedbit(data_bits, k))[:, :L]
-    else:
-        from ceph_tpu.ops.gf2 import from_planar
-
-        rows = np.asarray(from_planar(data_bits, w, k))[:, :L]
+    rows = _pack_rows(data_bits, w, k, L)
     n_stripes = max(1, L // cs)
     out = rows.reshape(k, n_stripes, cs).transpose(1, 0, 2)
     result = out.reshape(-1)[:object_size].tobytes()

@@ -1686,8 +1686,11 @@ class Monitor:
             if msg.key in ("hit_set_period", "hit_set_count",
                            "hit_set_fpp", "hit_set_target_size",
                            "min_read_recency_for_promote",
+                           "min_write_recency_for_promote",
                            "target_max_bytes",
-                           "cache_target_full_ratio"):
+                           "cache_target_full_ratio",
+                           "cache_target_dirty_ratio",
+                           "cache_mode"):
                 # cache-tier pool parameters (reference `ceph osd pool
                 # set NAME hit_set_period ...`, pg_pool_t hit_set_*
                 # and the tier agent knobs): validated here, read by
@@ -1700,9 +1703,18 @@ class Monitor:
                     "hit_set_target_size": lambda v: int(v) >= 1,
                     "min_read_recency_for_promote":
                         lambda v: int(v) >= 0,
+                    "min_write_recency_for_promote":
+                        lambda v: int(v) >= 0,
                     "target_max_bytes": lambda v: int(v) >= 0,
                     "cache_target_full_ratio":
                         lambda v: 0.0 < float(v) <= 1.0,
+                    "cache_target_dirty_ratio":
+                        lambda v: 0.0 < float(v) <= 1.0,
+                    # writeback defers local shard applies to dirty
+                    # pages (flush-before-evict pinned OSD-side);
+                    # anything else is a typo that must not half-engage
+                    "cache_mode":
+                        lambda v: v in ("writeback", "writethrough"),
                 }
                 try:
                     if not validators[msg.key](msg.value):

@@ -60,7 +60,8 @@ from ceph_tpu.rados.ecutil import (HashInfo, StripeInfo,
                                    batched_encode_group_async,
                                    decode_object_async,
                                    planar_eligible, planar_encode_async,
-                                   planar_object_bytes, planar_rows)
+                                   planar_object_bytes, planar_rows,
+                                   planar_shard_bytes)
 from ceph_tpu.rados.clog import (LogClient, build_crash_report,
                                  replay_crash_spool, spool_crash)
 from ceph_tpu.rados.messenger import (TRANSPORT_ERRORS, BufferList,
@@ -213,22 +214,43 @@ def shared_batching_queue():
 _PLANAR_STORE = None
 
 
-def shared_planar_store(capacity_bytes: int = 0):
-    """The process-wide planar shard store (bit-planar HBM residency,
-    ceph_tpu/parallel/service.py PlanarShardStore).  Engages under the
-    same conditions as the batching queue — an accelerator backend (or
-    CEPH_TPU_FORCE_BATCH=1 for CPU tests); None otherwise.  All
-    in-process OSDs share one HBM budget; keys are namespaced per OSD."""
+def shared_planar_store(capacity_bytes: int = 0, page_bytes: int = 0,
+                        paged: Optional[bool] = None):
+    """The process-wide resident store behind the cache tier.  Engages
+    under the same conditions as the batching queue — an accelerator
+    backend (or CEPH_TPU_FORCE_BATCH=1 for CPU tests); None otherwise.
+    All in-process OSDs share one HBM budget; keys are namespaced per
+    OSD.
+
+    Two flavors behind one surface (the residency protocol:
+    put_planar/touch/gather_rows/drop/memo): the PAGED store
+    (ceph_tpu/rados/pagestore.py — page table, ragged tails, per-page
+    dirty bits; the default, and the only flavor that can run
+    writeback) and the r10 monolithic PlanarShardStore
+    (osd_tier_pagestore=false or CEPH_TPU_PAGESTORE=0 — the bench A/B
+    arm).  The FIRST creator decides the flavor for the process; later
+    callers only ever raise the shared byte budget."""
     global _PLANAR_STORE
     queue = shared_batching_queue()
     if queue is None:
         return None
     with _BATCH_QUEUE_LOCK:
         if _PLANAR_STORE is None:
-            from ceph_tpu.parallel.service import PlanarShardStore
+            use_paged = True if paged is None else bool(paged)
+            if os.environ.get("CEPH_TPU_PAGESTORE", "") == "0":
+                use_paged = False
+            if use_paged:
+                from ceph_tpu.rados.pagestore import PagedResidentStore
 
-            _PLANAR_STORE = PlanarShardStore(
-                capacity_bytes=capacity_bytes or (256 << 20), queue=queue)
+                _PLANAR_STORE = PagedResidentStore(
+                    capacity_bytes=capacity_bytes or (256 << 20),
+                    page_bytes=page_bytes or (64 << 10), queue=queue)
+            else:
+                from ceph_tpu.parallel.service import PlanarShardStore
+
+                _PLANAR_STORE = PlanarShardStore(
+                    capacity_bytes=capacity_bytes or (256 << 20),
+                    queue=queue)
         elif capacity_bytes and capacity_bytes > _PLANAR_STORE.capacity_bytes:
             # the budget is one shared HBM pool: any daemon asking for
             # more raises it (first-wins would silently drop the knob)
@@ -471,7 +493,10 @@ class OSD:
         # pack/unpack boundary is paid once per resident lifetime
         self._planar = (
             shared_planar_store(
-                int(self.conf.get("osd_ec_planar_bytes", 0) or 0))
+                int(self.conf.get("osd_ec_planar_bytes", 0) or 0),
+                page_bytes=int(
+                    self.conf.get("osd_tier_page_bytes", 64 << 10) or 0),
+                paged=bool(self.conf.get("osd_tier_pagestore", True)))
             if self.conf.get("osd_ec_planar_residency", True) else None)
         # cache-tier policy state (ceph_tpu/rados/tiering.py): per-PG
         # bloom hit-set archives, the promotion rate throttle, and the
@@ -1545,6 +1570,12 @@ class OSD:
                 if not created or created < osdmap.epoch:
                     self._prior_acting.setdefault((pool.pool_id, pg), [])
         self.osdmap = osdmap
+        # writeback demote fence: any dirty resident whose PG we no
+        # longer lead flushes NOW — the next primary's sub-reads hit our
+        # backing store, and "writeback is never the only copy of acked
+        # data" means a demoted primary may not keep deferred local
+        # applies parked in HBM pages
+        self._tier_flush_demoted()
         # primaryship may have moved: cached decodes can silently go stale
         # across an interval we didn't serve (ExtentCache is per-interval)
         self._extent_cache.clear()
@@ -2248,11 +2279,22 @@ class OSD:
     def _cache_drop(self, pool_id: int, oid: str) -> None:
         self._extent_cache.drop((pool_id, oid))
         if self._planar is not None:
-            self._planar.drop(self._planar_key(pool_id, oid))
+            # force past the dirty guard: every _cache_drop site is a
+            # delete, a pool purge, or failed-write cleanup — the data
+            # the dirty pages were protecting is itself going away (or
+            # was never acked), so flush-before-evict does not apply
+            self._planar.drop(self._planar_key(pool_id, oid), force=True)
 
     def _planar_key(self, pool_id: int, oid: str):
         # namespaced per OSD: in-process clusters share one store/budget
         return (self.osd_id, pool_id, oid)
+
+    def _paged_store(self):
+        """The shared resident store WHEN it is the paged flavor (dirty
+        tracking / page table / writeback live only there); None under
+        the monolithic r10 store or no store at all."""
+        s = self._planar
+        return s if (s is not None and hasattr(s, "dirty_items")) else None
 
     def _purge_pool(self, pool_id: int) -> None:
         """Delete every locally stored object of a pool removed from the
@@ -3029,6 +3071,20 @@ class OSD:
         if op.offset >= 0:
             span.event("rmw read")
             mark("rmw_read")
+            # writeback fence: a partial overwrite splices against the
+            # STORED shard blobs, and a dirty resident means the stored
+            # local shard is behind the acked bytes — flush it first so
+            # the splice precondition (prior_version match) composes
+            # with reality instead of degrading every RMW to a full
+            # rewrite
+            _ps = self._paged_store()
+            if _ps is not None \
+                    and _ps.is_dirty(self._planar_key(op.pool_id, op.oid)):
+                if self._tier_flush_key(
+                        self._planar_key(op.pool_id, op.oid)):
+                    self.tier_perf.inc("flush_rmw")
+                else:
+                    self.tier_perf.inc("flush_error")
             # partial overwrite: read ONLY the stripes the write touches
             # (try_state_to_reads, ECBackend.cc:1915); the extent cache
             # pins recently decoded objects so back-to-back partial writes
@@ -3092,8 +3148,16 @@ class OSD:
         # task / unsolicited log reply) advancing the head across an await
         # would invalidate a version handed out earlier.
         planar = None
+        # write heat + the install decision (the r10 OPEN tail): writes
+        # record into the hit set like reads, and residency on write
+        # rides the same recency/throttle gate as read promotion — a
+        # refused install takes the cheaper non-resident encode lane
+        install = self._tier_write_install(op, pool, pg, acting,
+                                           len(data),
+                                           full=chunk_off < 0)
         mark("ec_encode_dispatched")
-        if self._planar is not None and chunk_off < 0:
+        if install is not None and self._planar is not None \
+                and chunk_off < 0:
             # full-object write: leave the shard rows planar-resident so
             # later decodes / repair re-encodes skip the unpack boundary
             planar = await planar_encode_async(codec, sinfo, data,
@@ -3126,11 +3190,43 @@ class OSD:
         entry_blob = entry.encode()
         tid = uuid.uuid4().hex
         local_ok = 0
+        wb_shards: set = set()
+        if chunk_off < 0 and planar is None and self._planar is not None:
+            # gated / ineligible / empty full write: it supersedes any
+            # existing resident, and the resident must die NOW, dirty
+            # included — the write-through applies below land the newer
+            # version, and a surviving writeback record would later
+            # replay its OLD deferred shard bytes over them (the flush
+            # validates against the resident's own meta; same
+            # synchronous window as the applies, so the agent cannot
+            # interleave)
+            self._planar.drop(self._planar_key(op.pool_id, op.oid),
+                              force=True)
+        if install == "writeback" and planar is not None:
+            # writeback: the local shard applies defer into dirty pages
+            # (log entry commits NOW, flush replays the applies later);
+            # still synchronous — no await between the eversion above
+            # and here, so the head cannot move underneath the install
+            locals_ = [s for s, o_ in enumerate(acting)
+                       if o_ == self.osd_id]
+            if locals_:
+                wb_shards = self._tier_writeback_install(
+                    op, pool, pg, planar, version, object_size, entry,
+                    locals_, shard_crcs, hinfo_blob, data)
+                if wb_shards:
+                    span.event(f"writeback install ({len(wb_shards)} "
+                               f"local applies deferred)")
         remote: List[Tuple[int, int]] = []  # (shard, osd)
         for shard, osd in enumerate(acting):
             if osd == CRUSH_ITEM_NONE:
                 continue
             if osd == self.osd_id:
+                if shard in wb_shards:
+                    # deferred to flush: the dirty page IS this shard's
+                    # copy until then (counted acked — same durability
+                    # as the store apply, both are process-local)
+                    local_ok += 1
+                    continue
                 # the local shard gets a sub-write span of its own, so
                 # the stitched trace shows ALL k+m shard applies (the
                 # remote peers record theirs in their own rings)
@@ -3208,20 +3304,21 @@ class OSD:
             # recovers promptly; waiting for the next interval change
             # would leave the object one failure from loss
             self._kick_recovery(pool, pg)
-        if planar is not None:
+        if planar is not None and not wb_shards:
             # install the residency only once the write is DURABLE (and
             # under the version it landed as): a failed write must not
-            # leave resident rows that reads would serve
-            _, all_bits, n_rows, n_cols, pw = planar
+            # leave resident rows that reads would serve.  (A writeback
+            # install already landed — dirty, pre-fan-out — because its
+            # pages ARE the deferred local applies.)
             pkey = self._planar_key(op.pool_id, op.oid)
-            self._planar.put_planar(
-                pkey, all_bits,
-                w=pw, n_rows=n_rows, meta=(version, n_cols, object_size))
-            # seed the exit-boundary memo with the just-written bytes:
-            # the first resident-hit read serves host bytes instead of
-            # paying a device pack (see PlanarShardStore.memo_put)
-            if isinstance(data, bytes) and len(data) == object_size:
-                self._planar.memo_put(pkey, version, data)
+            k_ = codec.get_data_chunk_count()
+            if self._install_resident(pkey, planar, version,
+                                      object_size, k_):
+                # seed the exit-boundary memo with the just-written
+                # bytes: the first resident-hit read serves host bytes
+                # instead of paying a device pack (memo_put contract)
+                if isinstance(data, bytes) and len(data) == object_size:
+                    self._planar.memo_put(pkey, version, data)
         if full_for_cache is not None:
             self._cache_put(op.pool_id, op.oid, version, full_for_cache)
         elif chunk_off >= 0:
@@ -3355,10 +3452,12 @@ class OSD:
             # repair must observe the STORED shards, not our cache.
             ent = self._pglog(op.pool_id, pg).latest_entry(op.oid)
             if ent is not None and ent.op == "write":
-                got = self._planar.get_planar(
+                # meta-only probe (no gather): the paged store would pay
+                # a page-table gather for a get_planar here, and the
+                # memo inside planar_object_bytes serves the common case
+                meta = self._planar.resident_meta(
                     self._planar_key(op.pool_id, op.oid))
-                if got is not None:
-                    meta = got[3]
+                if meta is not None:
                     if (meta and len(meta) >= 3
                             and meta[0] == ent.object_version):
                         data = planar_object_bytes(
@@ -4665,6 +4764,7 @@ class OSD:
             # primary reconstructs from other shards (the behavior
             # qa/standalone/erasure-code/test-erasure-eio.sh exercises)
             got = None
+        got = self._dirty_subread_fence(msg, got)
         if got is None:
             reply = MECSubReadReply(tid=msg.tid, shard=msg.shard, ok=False)
         else:
@@ -5068,9 +5168,10 @@ class OSD:
             return cast(default)
 
     def _tier_archive(self, pool: PoolInfo, pg: int) -> HitSetArchive:
-        """The PG's hit-set archive, (re)built when the pool's hit-set
-        tunables changed (old intervals were sized for different
-        guarantees, so they do not carry over)."""
+        """The PG's hit-set archive; a pool-param change RETUNES it in
+        place (HitSetArchive.retune) so temperature history survives —
+        rebuilding from scratch (the r10 behavior) read every resident
+        as cold and the next agent pass evicted the working set."""
         key = (pool.pool_id, pg)
         period = max(1e-3, self._tier_opt(pool, "hit_set_period", 2.0,
                                           float))
@@ -5078,13 +5179,306 @@ class OSD:
         target = self._tier_opt(pool, "hit_set_target_size", 128, int)
         fpp = self._tier_opt(pool, "hit_set_fpp", 0.05, float)
         arch = self._hit_sets.get(key)
-        if arch is None or arch.params_key() != (period, count, target,
-                                                 fpp):
+        if arch is None:
             arch = HitSetArchive(period, count, target, fpp,
                                  seed=(pool.pool_id << 20) | pg)
             self._hit_sets[key] = arch
             self.tier_perf.set("hit_sets", len(self._hit_sets))
+        elif arch.params_key() != (period, count, target, fpp):
+            arch.retune(period, count, target, fpp)
         return arch
+
+    def _tier_cache_mode(self, pool: PoolInfo) -> str:
+        """The pool's cache mode (mon-validated pool opt `cache_mode`
+        over the osd_tier_cache_mode default).  writeback engages only
+        with the paged store underneath (dirty bits live there); an
+        unknown value reads as writethrough — never half-engage."""
+        opts = getattr(pool, "opts", {}) or {}
+        mode = opts.get("cache_mode") or self.conf.get(
+            "osd_tier_cache_mode", "writethrough")
+        return mode if mode in ("writeback", "writethrough") \
+            else "writethrough"
+
+    def _tier_dirty_ratio(self) -> float:
+        """Dirty high-water as a fraction of the tier target (reference
+        cache_target_dirty_ratio): tightest of the OSD default and any
+        pool's mon-set opt, same composition rule as the full ratio."""
+        ratio = float(self.conf.get("osd_cache_target_dirty_ratio", 0.4)
+                      or 0.4)
+        if self.osdmap is not None:
+            for pool in self.osdmap.pools.values():
+                raw = (getattr(pool, "opts", {}) or {}).get(
+                    "cache_target_dirty_ratio")
+                if raw:
+                    try:
+                        ratio = min(ratio, float(raw))
+                    except (TypeError, ValueError):
+                        pass
+        return min(max(ratio, 0.01), 1.0)
+
+    def _install_resident(self, pkey, planar, version: int,
+                          object_size: int, k: int) -> bool:
+        """Install a planar_encode_async product as a CLEAN resident.
+        The paged store gets the trim (drop the encode lane's pow2 pad
+        before paging — the fragmentation win) and the data-row
+        boundary (shed_parity's partial-eviction line); the monolithic
+        store keeps its r10 shape.  False = paged refusal (pool full of
+        dirty / oversized), the caller stays cold."""
+        _, all_bits, n_rows, n_cols, pw = planar
+        store = self._planar
+        if self._paged_store() is not None:
+            return store.put_planar(
+                pkey, all_bits, w=pw, n_rows=n_rows,
+                meta=(version, n_cols, object_size),
+                trim=n_cols, data_rows=k * pw)
+        store.put_planar(pkey, all_bits, w=pw, n_rows=n_rows,
+                         meta=(version, n_cols, object_size))
+        return True
+
+    def _tier_write_install(self, op: MOSDOp, pool: PoolInfo, pg: int,
+                            acting: List[int], nbytes: int,
+                            full: bool) -> Optional[str]:
+        """Write-path tier hook, the r10 OPEN tail closed: writes record
+        hits in the PG hit set like reads do (write heat is heat), and
+        resident installation goes through the SAME recency/throttle
+        gate as read promotion — no more unconditional installs making a
+        hot write set indistinguishable from a cold one under pressure.
+        Returns None (no residency), "clean" (install after commit, the
+        write-through shape) or "writeback" (install dirty pages and
+        defer the local shard store apply to flush)."""
+        if not self._tier_enabled(pool):
+            # residency predates the tier: a disabled tier keeps the
+            # unconditional EC-pipeline install (and records nothing)
+            return "clean" if full and self._planar is not None else None
+        if getattr(op, "fadvise", "") == "dontneed":
+            return None
+        arch = self._tier_archive(pool, pg)
+        rotated = arch.record(op.oid)
+        self.tier_perf.inc("write_hits_recorded")
+        if rotated:
+            self.tier_perf.inc("hitset_rotations")
+            worst = max((a.estimated_fpp()
+                         for a in self._hit_sets.values()), default=0.0)
+            self.tier_perf.set("hitset_fpp_ppm", int(worst * 1e6))
+            self._replicate_hit_set(pool, pg, acting, arch)
+        if not full or self._planar is None or not nbytes:
+            return None
+        recency_min = self._tier_opt(
+            pool, "min_write_recency_for_promote", 1, int)
+        if getattr(op, "fadvise", "") != "willneed" \
+                and arch.recency(op.oid) < recency_min:
+            self.tier_perf.inc("write_install_gated")
+            return None
+        if not planar_eligible(self._codec(pool)):
+            return None  # the encode will skip planing anyway
+        if not self._promote_throttle.allow(nbytes):
+            self.tier_perf.inc("write_install_throttled")
+            return None
+        self.tier_perf.inc("write_installs")
+        if self._tier_cache_mode(pool) == "writeback" \
+                and self._paged_store() is not None:
+            return "writeback"
+        return "clean"
+
+    def _tier_writeback_install(self, op: MOSDOp, pool: PoolInfo,
+                                pg: int, planar, version: int,
+                                object_size: int, entry,
+                                local_shards: List[int], shard_crcs,
+                                hinfo_blob: bytes, data) -> set:
+        """Writeback install: the local shards' store applies are
+        DEFERRED — the PG log entry commits now (same txn discipline as
+        the write-through apply), the shard bytes live in resident
+        pages marked dirty, and the flush contract (WritebackRecord)
+        rides the entry so flush-before-evict / demote / scrub / RMW
+        can replay the apply byte-identically later.  Returns the set
+        of shards whose apply was deferred; empty = the paged pool
+        refused (caller falls back to write-through).  Durability is
+        UNCHANGED versus write-through: the remote k+m-1 shards commit
+        exactly as before, the log entry is persisted, and losing this
+        process loses its local shards either way (store and pages are
+        both process-local) — what writeback buys is the local crc +
+        store transaction off the hot write path, batched into the
+        agent's flush cadence."""
+        from ceph_tpu.rados.pagestore import WritebackRecord
+
+        store = self._paged_store()
+        _, all_bits, n_rows, n_cols, pw = planar
+        # failsafe BEFORE any mutation, exactly like _apply_shard_write:
+        # a write whose eventual flush could not land must refuse now,
+        # not wedge as unflushable dirt
+        if self._failsafe_full(len(local_shards) * n_cols):
+            raise ENOSPCError(
+                f"osd.{self.osd_id} failsafe full: refusing "
+                f"writeback install of {len(local_shards)} shards")
+        k = self._codec(pool).get_data_chunk_count()
+        rec = WritebackRecord(
+            pool_id=op.pool_id, oid=op.oid, pg=pg, version=version,
+            object_size=object_size, hinfo=hinfo_blob,
+            shards=tuple(local_shards),
+            crcs={s: shard_crcs[s] for s in local_shards
+                  if shard_crcs is not None})
+        pkey = self._planar_key(op.pool_id, op.oid)
+        ok = store.put_planar(
+            pkey, all_bits, w=pw, n_rows=n_rows,
+            meta=(version, n_cols, object_size),
+            trim=n_cols, data_rows=k * pw,
+            dirty_rows=[(s * pw, (s + 1) * pw) for s in local_shards],
+            dirty_info=rec)
+        if not ok:
+            return set()
+        # the log entry commits in its own txn NOW — flush replays only
+        # the data apply, never the log (the log is what reads validate
+        # the resident against)
+        txn = Transaction()
+        self._log_in_txn(txn, op.pool_id, pg, entry)
+        self.store.queue_transaction(txn)
+        if isinstance(data, bytes) and len(data) == object_size:
+            store.memo_put(pkey, version, data)
+        return set(local_shards)
+
+    def _tier_flush_key(self, pkey) -> bool:
+        """Flush one dirty resident: replay the deferred local shard
+        applies from its pages (byte-identical to the write-through
+        path — same version, hinfo, crc) and clear the dirty bits.
+        Generation-tokened: an overwrite that re-installed mid-flush
+        keeps ITS dirt.  False leaves the entry dirty (ENOSPC, raced
+        install) — eviction stays refused."""
+        store = self._paged_store()
+        if store is None:
+            return True
+        snap = store.peek_dirty(pkey)
+        if snap is None:
+            return True
+        info, gen = snap
+        einfo = store.entry_info(pkey)
+        if einfo is None or not einfo[2] or einfo[2][0] != info.version:
+            return False  # raced a re-install; the new dirt flushes later
+        # defense in depth: the PG log head is the authority on the
+        # object's newest version.  A record the log has moved past
+        # (a newer write or delete landed write-through) must NEVER
+        # replay — it would stamp old bytes over the committed newer
+        # shard.  The superseding op owns the object now; the dirt is
+        # moot, clear it.
+        ent = self._pglog(info.pool_id, info.pg).latest_entry(info.oid)
+        if ent is not None and (ent.op != "write"
+                                or ent.object_version != info.version):
+            store.clear_dirty(pkey, gen)
+            return True
+        total = 0
+        for shard in info.shards:
+            blob = planar_shard_bytes(store, pkey, info.version, shard)
+            if blob is None:
+                return False
+            try:
+                if not self._apply_shard_write(
+                        info.pool_id, info.oid, shard, blob,
+                        info.version, info.object_size,
+                        hinfo=info.hinfo,
+                        chunk_crc=info.crcs.get(shard)):
+                    return False
+            except ENOSPCError:
+                return False
+            total += len(blob)
+        if store.clear_dirty(pkey, gen):
+            store.perf.inc("flushes")
+            store.perf.inc("flush_bytes", total)
+        return True
+
+    def _my_dirty_items(self, store, pool_id: Optional[int] = None,
+                        pg: int = -1):
+        """THIS OSD's dirty residents ((key, WritebackRecord, gen,
+        dirty_since), oldest-dirty first), optionally scoped to one
+        pool / PG.  The one home for the shared-store key-namespace
+        rule (keys are (osd_id, pool_id, oid) — see _planar_key): the
+        flush planes must never flush, or skip, another colocated
+        OSD's dirt."""
+        out = []
+        for key, info, gen, since in store.dirty_items():
+            if not (isinstance(key, tuple) and len(key) == 3
+                    and key[0] == self.osd_id) or info is None:
+                continue
+            if pool_id is not None and info.pool_id != pool_id:
+                continue
+            if pg >= 0 and info.pg != pg:
+                continue
+            out.append((key, info, gen, since))
+        return out
+
+    def _tier_flush_pass(self, store, target: int, forced: bool) -> None:
+        """The agent's flush plane: dirty residents flush when dirty
+        bytes exceed cache_target_dirty_ratio x target, when they age
+        past osd_tier_flush_age, or unconditionally under fullness
+        pressure (NEARFULL on the backing store forces dirty flush
+        ahead of eviction — the r15 hook)."""
+        if not store.has_dirty():
+            return
+        ratio = self._tier_dirty_ratio()
+        age = float(self.conf.get("osd_tier_flush_age", 5.0) or 0)
+        now = time.monotonic()
+        dirty_target = int(target * ratio)
+        for key, _info, _gen, since in self._my_dirty_items(store):
+            over = store.dirty_bytes > dirty_target
+            aged = age > 0 and (now - since) >= age
+            if not (forced or over or aged):
+                continue
+            if self._tier_flush_key(key):
+                self.tier_perf.inc("flush_agent")
+            else:
+                self.tier_perf.inc("flush_error")
+
+    def _dirty_subread_fence(self, msg, got):
+        """Writeback fence for peer sub-reads: when this OSD's local
+        shard apply is still deferred in dirty pages, a peer asking for
+        the shard (shard hunt, recovery pull, a new primary's quorum
+        read) must see the ACKED bytes, not the stale/absent store
+        blob.  Someone reading the backing store ends the deferral:
+        flush the resident and serve the fresh store read — version,
+        crc, and hinfo all land consistent in one move."""
+        store = self._paged_store()
+        if store is None:
+            return got
+        pkey = self._planar_key(msg.pool_id, msg.oid)
+        snap = store.peek_dirty(pkey)
+        if snap is None or snap[0] is None:
+            return got
+        rec = snap[0]
+        if msg.shard not in rec.shards:
+            return got
+        if got is not None and got[1].version >= rec.version:
+            return got
+        if not self._tier_flush_key(pkey):
+            self.tier_perf.inc("flush_error")
+            return got
+        self.tier_perf.inc("dirty_subread_served")
+        try:
+            return self.store.read((msg.pool_id, msg.oid, msg.shard))
+        except IOError:
+            return got
+
+    def _tier_flush_demoted(self) -> None:
+        """Flush every dirty resident whose PG this OSD no longer leads
+        (map-change hook).  Writeback must never be the only copy of
+        acked data once primaryship moved: the new primary's sub-reads
+        and recovery hit our BACKING store, so the deferred applies
+        land before we stop answering for the PG."""
+        store = self._paged_store()
+        if store is None or not store.has_dirty() or self.osdmap is None:
+            return
+        for key, info, _gen, _since in self._my_dirty_items(store):
+            pool = self.osdmap.pools.get(info.pool_id)
+            if pool is None:
+                store.drop(key, force=True)  # pool gone: data gone too
+                continue
+            if info.pg >= pool.pg_num:
+                if self._tier_flush_key(key):
+                    self.tier_perf.inc("flush_demote")
+                continue
+            acting = self.osdmap.pg_to_acting(pool, info.pg)
+            if self._primary(pool, info.pg, acting) != self.osd_id:
+                if self._tier_flush_key(key):
+                    self.tier_perf.inc("flush_demote")
+                else:
+                    self.tier_perf.inc("flush_error")
 
     def _tier_observe_read(self, op: MOSDOp, reply: MOSDOpReply) -> None:
         """Read-path tier hook (reference PrimaryLogPG::maybe_promote):
@@ -5113,11 +5507,12 @@ class OSD:
             self._replicate_hit_set(pool, pg, acting, arch)
         if self._planar is None:
             return
-        # already resident at this version?  peek: a policy probe must
-        # not refresh LRU position or pollute the hit/miss ratio
+        # already resident at this version?  resident_meta: a policy
+        # probe must not refresh LRU position, pollute the hit/miss
+        # ratio, or (paged store) pay a page-table gather
         pkey = self._planar_key(op.pool_id, op.oid)
-        ent = self._planar.peek(pkey)
-        if ent is not None and ent[3] and ent[3][0] == reply.version:
+        rmeta = self._planar.resident_meta(pkey)
+        if rmeta and rmeta[0] == reply.version:
             return
         if pkey in self._promoting:
             return  # racing reads fund one encode, not N
@@ -5190,11 +5585,15 @@ class OSD:
                 self.tier_perf.inc("promote_stale")
                 tracked.mark_event("stale")
                 return
-            _, all_bits, n_rows, n_cols, pw = planar
             pkey = self._planar_key(pool.pool_id, oid)
-            self._planar.put_planar(
-                pkey, all_bits, w=pw,
-                n_rows=n_rows, meta=(version, n_cols, len(data)))
+            if not self._install_resident(
+                    pkey, planar, version, len(data),
+                    self._codec(pool).get_data_chunk_count()):
+                # paged pool full of dirty / oversized resident: the
+                # promotion stays cold and retries on a later read
+                self.tier_perf.inc("promote_skipped")
+                tracked.mark_event("refused")
+                return
             # the promoted bytes ARE the pack of the resident's data
             # rows at this version: seed the exit-boundary memo so the
             # first resident hit serves host bytes with zero device
@@ -5363,26 +5762,40 @@ class OSD:
             tracked.finish()
 
     def _tier_agent_once(self) -> None:
-        """One flush/evict pass: when the planar store's resident bytes
-        exceed cache_target_full_ratio of the effective target, evict
-        this OSD's residents coldest-temperature-first until back under.
-        An entry the LRU already dropped underneath the plan is a
-        COUNTED no-op (agent_evict_noop), never an error — either side
-        may win that race."""
+        """One flush/evict pass.  Flush plane first (paged store only):
+        dirty residents flush on the dirty-ratio / age / fullness
+        triggers, and ALWAYS before their eviction — writeback pages are
+        never dropped unflushed.  Then eviction: when resident bytes
+        exceed cache_target_full_ratio of the effective target (which
+        fullness pressure on the backing store SHRINKS by
+        osd_tier_full_target_factor — the r15 nearfull hook), evict this
+        OSD's residents coldest-temperature-first until back under, at
+        O(page) granularity on the paged store: a candidate first sheds
+        its parity-row page suffix (the data prefix keeps serving reads)
+        and is fully dropped only if still needed.  An entry the LRU
+        already dropped underneath the plan is a COUNTED no-op
+        (agent_evict_noop), never an error — either side may win that
+        race."""
         store = self._planar
         if store is None:
             return
         target = self._tier_effective_target()
+        full_state = self._my_full_state()
+        if full_state:
+            # NEARFULL (or worse) on the backing store is eviction
+            # pressure: the tier's effective target shrinks so residency
+            # sheds while the store drains, and dirty pages flush AHEAD
+            # of the eviction that needs them clean
+            factor = float(self.conf.get("osd_tier_full_target_factor",
+                                         0.5) or 0.5)
+            target = int(target * min(max(factor, 0.0), 1.0))
         self.tier_perf.set("resident_target_bytes", target)
         if target <= 0:
             return
+        paged = self._paged_store()
+        if paged is not None:
+            self._tier_flush_pass(paged, target, forced=bool(full_state))
         high = int(target * self._tier_full_ratio())
-        if self._my_full_state():
-            # NEARFULL (or worse) is eviction pressure on top of
-            # cache_target_full_ratio (the reference agent scales effort
-            # with fullness): halve the high-water mark so the tier
-            # sheds residency while the store drains
-            high = min(high, int(target * 0.5))
         if store.resident_bytes <= high:
             self.tier_perf.inc("agent_skip")
             return
@@ -5413,17 +5826,52 @@ class OSD:
                 (pool_id, self.osdmap.object_to_pg(pool, oid)))
             return arch.temperature(oid) if arch is not None else 0.0
 
-        for key, nbytes in eviction_candidates(mine, temp_of, need):
+        freed = 0
+        # the FULL coldest-first ranking (need=my_bytes covers every
+        # entry): pages let eviction run in two tiers of violence —
+        # first shed only PARITY page suffixes across the cold tail
+        # (data prefixes keep serving resident reads at k/n footprint;
+        # parity reconstructs from the store on demand), and only if
+        # that cannot cover the excess, drop whole entries
+        ranked = eviction_candidates(mine, temp_of, max(my_bytes, 1))
+        if paged is not None:
+            for key, _nb in ranked:
+                if freed >= need:
+                    break
+                freed += paged.shed_parity(key)
+        shed_total = freed
+        for key, nbytes in ranked:
+            if freed >= need:
+                break
+            if paged is not None:
+                if paged.is_dirty(key):
+                    # flush-before-evict: an unflushable dirty entry is
+                    # skipped, never dropped
+                    if self._tier_flush_key(key):
+                        self.tier_perf.inc("flush_evict")
+                    else:
+                        self.tier_perf.inc("flush_error")
+                        continue
+                # nbytes was snapshotted before the shed phase freed
+                # this entry's parity pages
+                nbytes = min(nbytes, paged.entry_nbytes(key))
             if store.drop(key):
+                freed += nbytes
                 self.tier_perf.inc("agent_evict")
                 self.tier_perf.inc("agent_evict_bytes", nbytes)
             else:
                 self.tier_perf.inc("agent_evict_noop")
+        if shed_total:
+            self.ctx.dout("osd", 5,
+                          f"tier agent shed {shed_total} parity bytes "
+                          f"(partial residency), dropped "
+                          f"{max(0, freed - shed_total)} more")
 
     def tier_status(self) -> dict:
         """`tier status` admin-socket shape."""
         store = self._planar
-        return {
+        paged = self._paged_store()
+        out = {
             "enabled": bool(self.conf.get("osd_tier_enabled", True)),
             "device_residency": store is not None,
             "resident_bytes": store.resident_bytes if store else 0,
@@ -5432,9 +5880,18 @@ class OSD:
             if store else 0,
             "target_max_bytes": self._tier_effective_target(),
             "cache_target_full_ratio": self._tier_full_ratio(),
+            "cache_target_dirty_ratio": self._tier_dirty_ratio(),
+            "cache_mode": {
+                pool.name: self._tier_cache_mode(pool)
+                for pool in (self.osdmap.pools.values()
+                             if self.osdmap else [])
+                if pool.pool_type == "ec"},
             "hit_set_archives": len(self._hit_sets),
+            # page occupancy / dirty bytes (None = monolithic r10 store)
+            "pagestore": paged.page_stats() if paged is not None else None,
             "perf": self.tier_perf.dump(),
         }
+        return out
 
     def _dump_hit_sets(self) -> dict:
         return {f"{pool_id}.{pg}": arch.dump()
@@ -5545,6 +6002,18 @@ class OSD:
         ping health field), and a pass that verifies a previously
         inconsistent PG clean CLEARS its entry — the repair-confirmed
         lifecycle `ceph pg repair` drives."""
+        # writeback fence: scrub compares STORED shards, and a dirty
+        # resident means our local shard's apply is still deferred —
+        # flush first or every dirty object reads as a mismatch and
+        # kicks a repair storm against bytes that were never wrong
+        ps = self._paged_store()
+        if ps is not None and ps.has_dirty():
+            for key, _info, _gen, _since in self._my_dirty_items(
+                    ps, pool_id=pool.pool_id, pg=only_pg):
+                if self._tier_flush_key(key):
+                    self.tier_perf.inc("flush_scrub")
+                else:
+                    self.tier_perf.inc("flush_error")
         scrubbed = errors = repaired = 0
         pg_errors: Dict[int, int] = {}
         pg_repaired: Dict[int, int] = {}

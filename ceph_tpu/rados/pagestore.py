@@ -1,0 +1,709 @@
+"""Paged resident store: page-table HBM residency for the cache tier.
+
+Role-equivalent of the KV-cache page pool in a production inference
+stack (the Ragged Paged Attention idiom, arXiv:2604.15464: fixed-size
+pages, a per-object page table, ragged last pages) applied to EC shard
+residency.  The r10 PlanarShardStore holds every resident as ONE
+monolithic device buffer whose width was pow2-bucketed for the encode
+lane — mixed object sizes fragment the budget (a 68 KiB stripe pays for
+128 KiB) and eviction is all-or-nothing per object.  Here the budget is
+ONE preallocated u32 slab carved into fixed-size pages
+(``osd_tier_page_bytes``): a resident's packed-bit plane words are
+TRIMMED to their true width and flattened row-major across a page table
+(ordered page ids, ragged last page), so
+
+- millions of mixed-size objects share the pool at O(page) granularity
+  (the pow2 pad never lands; ``frag_saved_bytes`` gauges the win),
+- eviction frees exactly the pages it needs — including PARTIAL
+  eviction: ``shed_parity`` drops the page suffix holding the parity
+  rows while the data-row prefix keeps serving reads,
+- every page carries a DIRTY bit, the substrate for writeback cache
+  mode: a writeback install pins a :class:`WritebackRecord` (the
+  deferred local store apply) with its dirty pages, ``drop`` refuses
+  dirty entries until the owner flushes (flush-before-evict), and
+  ``clear_dirty`` is generation-tokened so a flush that raced an
+  overwrite can never mark the NEWER write clean.
+
+The slab is committed lazily (fixed-size sub-slabs allocate on first
+touch) and is device-placeable by construction — one contiguous pool
+indexed by page id, the exact layout a ``dynamic_update_slice`` device
+path wants.  In this build the slab is host-side numpy and the
+pack/unpack device boundaries (``to_packedbit``/``from_packedbit``)
+are paid at the page-table edge; the exit-boundary memo (inherited from
+the r10 store, accounted at PAGE granularity here) keeps repeated
+resident reads free of even that.
+
+Thread-safe under one mutex, same discipline as PlanarShardStore; the
+OSD event loop, the batching worker, and tests may touch it
+concurrently.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from collections import OrderedDict
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from ceph_tpu.common.perf_counters import PerfCounters, PerfCountersBuilder
+
+_SLAB_SHIFT = 8  # 2**8 pages per lazily-committed sub-slab
+
+
+@dataclass
+class WritebackRecord:
+    """The flush contract a writeback install pins with its dirty pages:
+    everything the owner needs to replay the DEFERRED local store apply
+    later — byte-identically to the write-through path — without the
+    original write in hand.  Opaque to the store itself."""
+
+    pool_id: int
+    oid: str
+    pg: int
+    version: int
+    object_size: int
+    hinfo: bytes
+    shards: Tuple[int, ...]           # local shards whose apply deferred
+    crcs: Dict[int, int] = field(default_factory=dict)
+
+
+class _Entry:
+    __slots__ = ("pages", "dtype", "rows", "cols", "itemsize", "w",
+                 "n_rows", "meta", "trim", "data_rows", "mono_bytes",
+                 "total_words", "live_pages", "dirty", "dirty_info",
+                 "dirty_since", "dirty_gen")
+
+
+def build_pagestore_perf() -> PerfCounters:
+    """The `pagestore` counter set (perf dump -> mgr /metrics -> BENCH)."""
+    return (
+        PerfCountersBuilder("pagestore")
+        .add_u64_counter("admit", "residents installed into pages")
+        .add_u64_counter("hit", "resident lookups served")
+        .add_u64_counter("miss", "lookups that fell to the cold path")
+        .add_u64_counter("evict", "whole residents evicted")
+        .add_u64_counter("page_evictions", "pages freed by eviction "
+                                           "(partial sheds included)")
+        .add_u64_counter("parity_sheds",
+                         "partial evictions that dropped only the "
+                         "parity-row page suffix (data keeps serving)")
+        .add_u64_counter("writeback_installs",
+                         "dirty installs that deferred a local store "
+                         "apply to flush")
+        .add_u64_counter("flushes", "dirty residents flushed clean")
+        .add_u64_counter("flush_bytes", "shard bytes written back by "
+                                        "flushes")
+        .add_u64_counter("evict_refused_dirty",
+                         "drops refused because pages were dirty "
+                         "(flush-before-evict held)")
+        .add_u64_counter("install_refused",
+                         "installs refused (pool full of dirty or "
+                         "oversized resident)")
+        .add_u64("pages_total", "page pool size (gauge)")
+        .add_u64("pages_used", "pages currently owned by residents "
+                               "(gauge)")
+        .add_u64("dirty_pages", "pages carrying unflushed writeback "
+                                "data (gauge)")
+        .add_u64("dirty_bytes", "page bytes carrying unflushed "
+                                "writeback data (gauge)")
+        .add_u64("resident_bytes", "page bytes held by residents "
+                                   "(gauge)")
+        .add_u64("entries", "resident objects (gauge)")
+        .add_u64("memo_bytes", "exit-boundary memo footprint, "
+                               "page-rounded (gauge)")
+        .add_u64("frag_saved_bytes",
+                 "bytes the paged layout saves vs the monolithic "
+                 "pow2-bucketed layout for the live residents (gauge, "
+                 "floored at 0)")
+        .add_time_avg("pack_s", "device->host pack seconds at the exit "
+                                "boundary")
+        .add_time_avg("unpack_s", "host->device unpack seconds at "
+                                  "admission")
+        .create_perf_counters()
+    )
+
+
+class PagedResidentStore:
+    """Drop-in residency manager behind the tier (PlanarShardStore
+    surface: put_planar/get_planar/touch/gather_rows/drop/peek/memo),
+    backed by the page pool above instead of per-object buffers."""
+
+    def __init__(self, capacity_bytes: int = 256 << 20,
+                 page_bytes: int = 64 << 10, queue: Optional[Any] = None):
+        from ceph_tpu.common.lockdep import make_mutex
+
+        page_bytes = max(256, int(page_bytes))
+        page_bytes -= page_bytes % 4  # whole u32 words per page
+        self.page_bytes = page_bytes
+        self.page_words = page_bytes // 4
+        self._pages_total = max(1, int(capacity_bytes) // page_bytes)
+        self.queue = queue
+        self._lock = make_mutex("pagestore")
+        self._slabs: List[Optional[np.ndarray]] = []
+        self._free: List[int] = []
+        self._next_page = 0
+        self._entries: "OrderedDict[Any, _Entry]" = OrderedDict()
+        self._memo: Dict[Any, Tuple[Any, Any]] = {}
+        self.memo_bytes = 0          # page-rounded (the r10 gauge could
+        self._memo_raw: Dict[Any, int] = {}   # drift from residency)
+        self._pages_used = 0
+        self._dirty_page_count = 0
+        self._gen = 0  # install generations: flush tokens never collide
+        self._mono_bytes = 0         # monolithic-equivalent footprint
+        self.admits = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.perf = build_pagestore_perf()
+        self.perf.set("pages_total", self._pages_total)
+        self.perf.resync = self._resync_gauges
+
+    # -- capacity ------------------------------------------------------------
+
+    @property
+    def capacity_bytes(self) -> int:
+        return self._pages_total * self.page_bytes
+
+    @capacity_bytes.setter
+    def capacity_bytes(self, value: int) -> None:
+        # the budget is one shared pool: it only ever GROWS (the
+        # shared_planar_store raise-the-budget rule); sub-slabs commit
+        # lazily so raising the ceiling costs nothing up front
+        with self._lock:
+            self._pages_total = max(self._pages_total,
+                                    max(1, int(value) // self.page_bytes))
+            self.perf.set("pages_total", self._pages_total)
+
+    @property
+    def pages_total(self) -> int:
+        return self._pages_total
+
+    @property
+    def pages_used(self) -> int:
+        return self._pages_used
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._pages_used * self.page_bytes
+
+    @property
+    def dirty_pages(self) -> int:
+        return self._dirty_page_count
+
+    @property
+    def dirty_bytes(self) -> int:
+        return self._dirty_page_count * self.page_bytes
+
+    # -- page pool (callers hold the lock) -----------------------------------
+
+    def _page(self, pid: int) -> np.ndarray:
+        slab = pid >> _SLAB_SHIFT
+        while len(self._slabs) <= slab:
+            self._slabs.append(None)
+        if self._slabs[slab] is None:
+            self._slabs[slab] = np.empty(
+                (1 << _SLAB_SHIFT, self.page_words), dtype=np.uint32)
+        return self._slabs[slab][pid & ((1 << _SLAB_SHIFT) - 1)]
+
+    def _available_pages(self) -> int:
+        return len(self._free) + (self._pages_total - self._next_page)
+
+    def _alloc_page(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        if self._next_page < self._pages_total:
+            pid = self._next_page
+            self._next_page += 1
+            return pid
+        return None
+
+    def _free_entry_pages(self, e: _Entry) -> int:
+        freed = 0
+        for i, pid in enumerate(e.pages):
+            if pid is not None:
+                self._free.append(pid)
+                e.pages[i] = None
+                freed += 1
+        self._pages_used -= freed
+        self._dirty_page_count -= len(e.dirty)
+        e.dirty.clear()
+        e.live_pages = 0
+        return freed
+
+    def _remove_entry(self, key: Any) -> int:
+        """Free a key's pages and bookkeeping; lock held.  Returns pages
+        freed."""
+        e = self._entries.pop(key, None)
+        if e is None:
+            return 0
+        freed = self._free_entry_pages(e)
+        self._mono_bytes -= e.mono_bytes
+        self._memo_discard(key)
+        return freed
+
+    def _sync_gauges(self) -> None:
+        """Lock held."""
+        self.perf.set("pages_used", self._pages_used)
+        self.perf.set("dirty_pages", self._dirty_page_count)
+        self.perf.set("dirty_bytes",
+                      self._dirty_page_count * self.page_bytes)
+        self.perf.set("resident_bytes",
+                      self._pages_used * self.page_bytes)
+        self.perf.set("entries", len(self._entries))
+        self.perf.set("memo_bytes", self.memo_bytes)
+        self.perf.set("pages_total", self._pages_total)
+        self.perf.set("frag_saved_bytes", max(0, self.frag_saved_signed))
+
+    def _resync_gauges(self) -> None:
+        with self._lock:
+            self._sync_gauges()
+
+    @property
+    def frag_saved_signed(self) -> int:
+        """Monolithic-equivalent footprint minus actual page footprint.
+        Positive = the pow2 pad the paged layout never allocated minus
+        the ragged-tail waste it did; can go (slightly) negative for
+        tiny residents whose tail waste exceeds their pad."""
+        return self._mono_bytes - self._pages_used * self.page_bytes
+
+    # -- install -------------------------------------------------------------
+
+    @staticmethod
+    def _trim_cols(dtype: np.dtype, cols: int, trim: Optional[int]) -> int:
+        """Array columns to keep for a pre-pad packed byte width of
+        ``trim``: u32 plane words carry 32 packed byte columns each;
+        int8 plane columns are byte columns, rounded up to whole words
+        so any bit-row range stays word-aligned in the flattened pool."""
+        if not trim or trim <= 0:
+            return cols
+        if np.dtype(dtype) == np.uint32:
+            return min(cols, -(-int(trim) // 32))
+        return min(cols, ((int(trim) + 3) // 4) * 4)
+
+    def put_planar(self, key: Any, bits, w: int = 8,
+                   n_rows: Optional[int] = None, meta: Any = None,
+                   trim: Optional[int] = None,
+                   data_rows: Optional[int] = None,
+                   dirty_rows: Optional[Iterable[Tuple[int, int]]] = None,
+                   dirty_info: Any = None,
+                   now: Optional[float] = None) -> bool:
+        """Install a resident into pages.  ``trim`` (pre-pad packed byte
+        width) drops the encode lane's pow2 pad before paging — the
+        fragmentation win.  ``data_rows`` marks the bit-row prefix that
+        is data (shed_parity boundary).  ``dirty_rows`` marks bit-row
+        ranges whose backing-store apply is DEFERRED (writeback);
+        ``dirty_info`` carries the owner's flush contract.  Returns
+        False — nothing installed — when the pool cannot fit the
+        resident even after evicting every clean colder entry (the
+        caller falls back to the uninstalled path; refusal is counted,
+        never an error)."""
+        arr = np.asarray(bits)
+        if n_rows is None:
+            n_rows = arr.shape[0] // w
+        rows, cols_full = int(arr.shape[0]), int(arr.shape[1])
+        itemsize = arr.dtype.itemsize
+        mono_bytes = rows * cols_full * itemsize
+        cols = self._trim_cols(arr.dtype, cols_full, trim)
+        if cols < cols_full:
+            arr = arr[:, :cols]
+        if np.dtype(arr.dtype) != np.uint32 and cols % 4:
+            # non-u32 rows must stay word-aligned in the flattened pool
+            # (gather addresses bit-rows as cols*itemsize//4 words) —
+            # pad the row width up to whole words; `trim` keeps the
+            # true byte width for read()'s final slice
+            pad = 4 - cols % 4
+            arr = np.pad(np.asarray(arr), ((0, 0), (0, pad)))
+            cols += pad
+        total_bytes = rows * cols * itemsize
+        flat = np.ascontiguousarray(arr).reshape(-1)
+        if flat.dtype != np.uint32:
+            flat = flat.view(np.uint32)  # rows % 4 == 0 (w >= 4)
+        total_words = int(flat.size)
+        npages = max(1, -(-total_words // self.page_words))
+        with self.perf.time_avg("unpack_s"), self._lock:
+            self._remove_entry(key)
+            if npages > self._pages_total:
+                self.perf.inc("install_refused")
+                self._sync_gauges()
+                return False
+            while self._available_pages() < npages:
+                victim = None
+                for k, e in self._entries.items():  # LRU-oldest first
+                    if not e.dirty:
+                        victim = k
+                        break
+                if victim is None:
+                    self.perf.inc("install_refused")
+                    self._sync_gauges()
+                    return False
+                freed = self._remove_entry(victim)
+                self.evictions += 1
+                self.perf.inc("evict")
+                self.perf.inc("page_evictions", freed)
+            e = _Entry()
+            e.pages = []
+            off = 0
+            while off < total_words:
+                pid = self._alloc_page()
+                assert pid is not None  # _available_pages said so
+                n = min(self.page_words, total_words - off)
+                self._page(pid)[:n] = flat[off:off + n]
+                e.pages.append(pid)
+                off += n
+            e.dtype = arr.dtype
+            e.rows = rows
+            e.cols = cols
+            e.itemsize = itemsize
+            e.w = w
+            e.n_rows = n_rows
+            e.meta = meta
+            e.trim = trim
+            e.data_rows = data_rows
+            e.mono_bytes = mono_bytes
+            e.total_words = total_words
+            e.live_pages = len(e.pages)
+            e.dirty = set()
+            e.dirty_info = dirty_info
+            e.dirty_since = time.monotonic() if now is None else now
+            self._gen += 1
+            e.dirty_gen = self._gen
+            self._pages_used += len(e.pages)
+            self._mono_bytes += mono_bytes
+            if dirty_rows:
+                row_words = cols * itemsize // 4
+                for r0, r1 in dirty_rows:
+                    p0 = (r0 * row_words) // self.page_words
+                    p1 = -(-(r1 * row_words) // self.page_words)
+                    e.dirty.update(range(p0, min(p1, len(e.pages))))
+                self._dirty_page_count += len(e.dirty)
+            self._entries[key] = e
+            self._entries.move_to_end(key)
+            self.admits += 1
+            self._sync_gauges()
+        self.perf.inc("admit")
+        if dirty_rows and e.dirty:
+            self.perf.inc("writeback_installs")
+        return True
+
+    # -- lookup --------------------------------------------------------------
+
+    def _gather_locked(self, e: _Entry, r0: int, r1: int):
+        row_words = e.cols * e.itemsize // 4
+        w0, w1 = r0 * row_words, r1 * row_words
+        if w1 > e.total_words or w0 < 0 or w1 <= w0:
+            return None
+        p0, p1 = w0 // self.page_words, -(-w1 // self.page_words)
+        span = e.pages[p0:p1]
+        if any(p is None for p in span):
+            return None
+        out = np.empty(w1 - w0, dtype=np.uint32)
+        pos = 0
+        for i, pid in enumerate(span):
+            page = self._page(pid)
+            start = (w0 - p0 * self.page_words) if i == 0 else 0
+            avail = min(self.page_words,
+                        e.total_words - (p0 + i) * self.page_words)
+            take = min(avail - start, (w1 - w0) - pos)
+            out[pos:pos + take] = page[start:start + take]
+            pos += take
+        if np.dtype(e.dtype) != np.uint32:
+            return out.view(e.dtype).reshape(r1 - r0, e.cols)
+        return out.reshape(r1 - r0, e.cols)
+
+    def gather_rows(self, key: Any, r0: int, r1: int):
+        """[r1-r0, cols] array gathered from the page table, or None
+        when the entry is absent or any needed page was evicted (a
+        partial resident can still serve any fully-covered row range —
+        the data-row prefix after a parity shed).  No LRU side effects
+        (``touch`` owns those)."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return None
+            return self._gather_locked(e, r0, r1)
+
+    def touch(self, key: Any):
+        """(w, n_rows, meta) with LRU refresh + hit/miss counting — the
+        read path's entry probe, materializing nothing."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self.misses += 1
+            else:
+                self._entries.move_to_end(key)
+                self.hits += 1
+        self.perf.inc("hit" if e is not None else "miss")
+        return None if e is None else (e.w, e.n_rows, e.meta)
+
+    def entry_info(self, key: Any):
+        """(w, n_rows, meta) without LRU/counter side effects."""
+        with self._lock:
+            e = self._entries.get(key)
+        return None if e is None else (e.w, e.n_rows, e.meta)
+
+    def resident_meta(self, key: Any):
+        """The entry's caller meta (the OSD stores (version, n_cols,
+        object_size)), or None — the policy probe shape."""
+        info = self.entry_info(key)
+        return None if info is None else info[2]
+
+    def get_planar(self, key: Any):
+        """(bits, w, n_rows, meta) or None; refreshes LRU position.
+        Gathers the WHOLE resident — None when partial (parity shed)."""
+        got = self.touch(key)
+        if got is None:
+            return None
+        w, n_rows, meta = got
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return None
+            bits = self._gather_locked(e, 0, e.rows)
+        if bits is None:
+            return None
+        return (bits, w, n_rows, meta)
+
+    def peek(self, key: Any):
+        """get_planar without LRU order / counter side effects."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return None
+            bits = self._gather_locked(e, 0, e.rows)
+        if bits is None:
+            return None
+        return (bits, e.w, e.n_rows, e.meta)
+
+    def __contains__(self, key: Any) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def entry_nbytes(self, key: Any) -> int:
+        """Live page footprint of one entry (0 when absent)."""
+        with self._lock:
+            e = self._entries.get(key)
+            return e.live_pages * self.page_bytes if e is not None else 0
+
+    def entries_snapshot(self) -> List[Tuple[Any, int]]:
+        """(key, page-footprint bytes) in LRU order, oldest first — the
+        tier agent's eviction-candidate input."""
+        with self._lock:
+            return [(k, e.live_pages * self.page_bytes)
+                    for k, e in self._entries.items()]
+
+    # -- host boundary (test/bench parity with PlanarShardStore) -------------
+
+    def admit(self, key: Any, rows: np.ndarray, w: int = 8,
+              meta: Any = None, layout: str = "planes"):
+        """Unpack packed [n, B] uint8 rows and keep them page-resident
+        (PlanarShardStore.admit contract)."""
+        if layout == "packedbit":
+            from ceph_tpu.ops.gf2 import to_packedbit
+
+            assert w == 8, "packed-bit residency is the w=8 byte layout"
+            B = rows.shape[1]
+            buf = np.ascontiguousarray(rows)
+            if B % 32:
+                buf = np.pad(buf, ((0, 0), (0, 32 - B % 32)))
+            bits = to_packedbit(buf)
+            self.put_planar(key, bits, w=w, n_rows=rows.shape[0],
+                            meta=meta, trim=B)
+        else:
+            from ceph_tpu.ops.gf2 import to_planar
+
+            bits = to_planar(np.ascontiguousarray(rows), w)
+            self.put_planar(key, bits, w=w, n_rows=rows.shape[0],
+                            meta=meta, trim=rows.shape[1])
+        return bits
+
+    def read(self, key: Any) -> Optional[np.ndarray]:
+        """Pack the resident rows back to [n, B] uint8 host bytes; None
+        when absent or partial."""
+        got = self.get_planar(key)
+        if got is None:
+            return None
+        bits, w, n_rows, _meta = got
+        with self._lock:
+            e = self._entries.get(key)
+            trim = e.trim if e is not None else None
+        if np.dtype(bits.dtype) == np.uint32:
+            from ceph_tpu.ops.gf2 import from_packedbit
+
+            with self.perf.time_avg("pack_s"):
+                out = np.asarray(from_packedbit(bits, n_rows))
+        else:
+            from ceph_tpu.ops.gf2 import from_planar
+
+            with self.perf.time_avg("pack_s"):
+                out = np.asarray(from_planar(bits, w, n_rows))
+        return out if trim is None else out[:, :trim]
+
+    # -- eviction ------------------------------------------------------------
+
+    def drop(self, key: Any, force: bool = False) -> bool:
+        """Remove `key` if resident; True when an entry was actually
+        dropped.  A DIRTY entry refuses (flush-before-evict: writeback
+        pages must never be the only copy of acked data) unless
+        ``force`` — deletes and overwrite-failure cleanup force, because
+        there the data itself is going away.  Dropping an absent key is
+        a supported no-op (the agent/LRU race rule)."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self._memo_discard(key)
+                self._sync_gauges()
+                return False
+            if e.dirty and not force:
+                self.perf.inc("evict_refused_dirty")
+                return False
+            freed = self._remove_entry(key)
+            self.evictions += 1
+            self._sync_gauges()
+        self.perf.inc("evict")
+        self.perf.inc("page_evictions", freed)
+        return True
+
+    def shed_parity(self, key: Any) -> int:
+        """Partial eviction: free the CLEAN page suffix past the
+        data-row boundary (the parity rows).  The data prefix keeps
+        serving reads through gather_rows; get_planar/planar_rows see a
+        partial resident and fall back.  Returns bytes freed (0 when no
+        boundary was recorded, nothing to shed, or the suffix holds
+        dirty pages)."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.data_rows is None or e.data_rows >= e.rows:
+                return 0
+            row_words = e.cols * e.itemsize // 4
+            boundary = -(-(e.data_rows * row_words) // self.page_words)
+            freed = 0
+            for i in range(boundary, len(e.pages)):
+                if e.pages[i] is None or i in e.dirty:
+                    continue
+                self._free.append(e.pages[i])
+                e.pages[i] = None
+                e.live_pages -= 1
+                freed += 1
+            self._pages_used -= freed
+            if freed:
+                self._sync_gauges()
+        if freed:
+            self.perf.inc("parity_sheds")
+            self.perf.inc("page_evictions", freed)
+        return freed * self.page_bytes
+
+    # -- dirty lifecycle (writeback) -----------------------------------------
+
+    def is_dirty(self, key: Any) -> bool:
+        with self._lock:
+            e = self._entries.get(key)
+            return bool(e is not None and e.dirty)
+
+    def has_dirty(self) -> bool:
+        return self._dirty_page_count > 0
+
+    def peek_dirty(self, key: Any):
+        """(dirty_info, generation token) or None.  The token pins the
+        exact install the caller is about to flush."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or not e.dirty:
+                return None
+            return (e.dirty_info, e.dirty_gen)
+
+    def dirty_items(self) -> List[Tuple[Any, Any, int, float]]:
+        """Snapshot of (key, dirty_info, generation, dirty_since),
+        oldest-dirty first — the flush agent's input."""
+        with self._lock:
+            items = [(k, e.dirty_info, e.dirty_gen, e.dirty_since)
+                     for k, e in self._entries.items() if e.dirty]
+        items.sort(key=lambda t: t[3])
+        return items
+
+    def clear_dirty(self, key: Any, gen: int) -> bool:
+        """Mark the entry clean after a successful flush — only when
+        ``gen`` still names the install the caller flushed (an
+        overwrite re-installed and bumped the generation: its dirt is
+        NOT flushed, and clearing it would lose acked data)."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.dirty_gen != gen or not e.dirty:
+                return False
+            self._dirty_page_count -= len(e.dirty)
+            e.dirty.clear()
+            e.dirty_info = None
+            self._gen += 1
+            e.dirty_gen = self._gen
+            self._sync_gauges()
+        return True
+
+    # -- exit-boundary memo (page-granular accounting) -----------------------
+
+    def _memo_charge(self, nbytes: int) -> int:
+        return -(-nbytes // self.page_bytes) * self.page_bytes
+
+    def _memo_discard(self, key: Any) -> None:
+        """Lock held."""
+        got = self._memo.pop(key, None)
+        if got is not None:
+            self.memo_bytes -= self._memo_charge(self._memo_raw.pop(key))
+
+    def memo_get(self, key: Any, version: Any):
+        with self._lock:
+            if key not in self._entries:
+                return None
+            got = self._memo.get(key)
+        if got is None or got[0] != version:
+            return None
+        return got[1]
+
+    def memo_put(self, key: Any, version: Any, value: Any) -> None:
+        """As PlanarShardStore.memo_put, but the cap accounting is in
+        PAGE units against the pool's byte size — the memo gauge can
+        never drift from the granularity actual residency is budgeted
+        in."""
+        charge = self._memo_charge(len(value))
+        with self._lock:
+            if key not in self._entries:
+                return
+            self._memo_discard(key)
+            if self.memo_bytes + charge > self.capacity_bytes:
+                self.perf.set("memo_bytes", self.memo_bytes)
+                return
+            self._memo[key] = (version, value)
+            self._memo_raw[key] = len(value)
+            self.memo_bytes += charge
+            self.perf.set("memo_bytes", self.memo_bytes)
+
+    # -- introspection -------------------------------------------------------
+
+    def page_stats(self) -> Dict[str, int]:
+        with self._lock:
+            partial = sum(1 for e in self._entries.values()
+                          if e.live_pages < len(e.pages))
+            return {
+                "page_bytes": self.page_bytes,
+                "pages_total": self._pages_total,
+                "pages_used": self._pages_used,
+                "dirty_pages": self._dirty_page_count,
+                "dirty_bytes": self._dirty_page_count * self.page_bytes,
+                "dirty_entries": sum(1 for e in self._entries.values()
+                                     if e.dirty),
+                "partial_residents": partial,
+                "frag_saved_bytes": max(0, self.frag_saved_signed),
+                "monolithic_equiv_bytes": self._mono_bytes,
+            }
+
+    def stats(self) -> Dict[str, int]:
+        return {"resident_bytes": self.resident_bytes,
+                "memo_bytes": self.memo_bytes,
+                "entries": len(self._entries), "admits": self.admits,
+                "hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions,
+                "pages_total": self._pages_total,
+                "pages_used": self._pages_used,
+                "dirty_pages": self._dirty_page_count,
+                "frag_saved_bytes": self.frag_saved_signed,
+                "monolithic_equiv_bytes": self._mono_bytes}

@@ -204,9 +204,27 @@ class HitSetArchive:
                            seed=(self.seed << 16) ^ self._gen)
 
     def params_key(self) -> Tuple:
-        """Identity of the tunables: a pool-opt change rebuilds archives
-        (old intervals were sized for different guarantees)."""
+        """Identity of the tunables: a pool-opt change retunes archives
+        (see :meth:`retune`)."""
         return (self.period, self.count, self.target_size, self.fpp)
+
+    def retune(self, period: float, count: int, target_size: int,
+               fpp: float) -> None:
+        """Adopt new tunables WITHOUT discarding temperature history.
+        The r10 behavior rebuilt the archive from scratch on any pool
+        param change, flash-freezing the whole working set cold (every
+        resident read as temperature 0 and the next agent pass evicted
+        the lot).  Old intervals were sized for different guarantees,
+        but they are still EVIDENCE of heat — they keep scoring; only
+        future intervals are sized to the new params, and the archive
+        re-bounds to the new count (oldest intervals expire first)."""
+        self.period = max(1e-3, float(period))
+        self.count = max(1, int(count))
+        self.target_size = int(target_size)
+        self.fpp = float(fpp)
+        if self.archived.maxlen != self.count:
+            keep = list(self.archived)[:self.count]
+            self.archived = deque(keep, maxlen=self.count)
 
     # -- recording -----------------------------------------------------------
 
@@ -441,6 +459,18 @@ def build_tier_perf() -> PerfCounters:
         PerfCountersBuilder("tier")
         .add_u64_counter("read_hits_recorded", "client reads recorded "
                                                "into the PG hit sets")
+        .add_u64_counter("write_hits_recorded",
+                         "client writes recorded into the PG hit sets "
+                         "(write heat drives promotion like read heat)")
+        .add_u64_counter("write_installs",
+                         "writes that installed a resident through the "
+                         "recency/throttle gate")
+        .add_u64_counter("write_install_gated",
+                         "write installs refused by the write-recency "
+                         "gate (cold write set stays cold)")
+        .add_u64_counter("write_install_throttled",
+                         "write installs refused by the promote "
+                         "throttle")
         .add_u64_counter("hitset_rotations", "hit-set intervals archived")
         .add_u64_counter("resident_hit",
                          "reads served from a device resident "
@@ -466,6 +496,27 @@ def build_tier_perf() -> PerfCounters:
         .add_u64_counter("agent_pass", "agent passes that ran")
         .add_u64_counter("agent_skip",
                          "agent passes that found residency under target")
+        .add_u64_counter("flush_agent",
+                         "dirty residents flushed by the agent "
+                         "(dirty-ratio / age / fullness pressure)")
+        .add_u64_counter("flush_evict",
+                         "dirty residents flushed to unblock an "
+                         "eviction (flush-before-evict)")
+        .add_u64_counter("flush_demote",
+                         "dirty residents flushed on primaryship loss "
+                         "(writeback is never the only copy)")
+        .add_u64_counter("flush_rmw",
+                         "dirty residents flushed ahead of a partial "
+                         "(RMW) overwrite")
+        .add_u64_counter("flush_scrub",
+                         "dirty residents flushed ahead of a deep "
+                         "scrub of their PG")
+        .add_u64_counter("flush_error",
+                         "flush attempts that failed (ENOSPC / raced "
+                         "install) and left the entry dirty")
+        .add_u64_counter("dirty_subread_served",
+                         "peer sub-reads answered from dirty resident "
+                         "pages (store copy was deferred)")
         .add_time_avg("agent_pass_s", "agent pass wall seconds")
         .add_u64("resident_target_bytes",
                  "effective target_max_bytes (gauge)")

@@ -203,12 +203,47 @@ def render_crash_info(info: Dict) -> List[str]:
     return lines
 
 
+def render_tier_status(status: Dict) -> List[str]:
+    """Render an asok ``tier status`` answer: residency totals, page
+    occupancy, dirty bytes, and per-pool cache_mode.  Pure so tests can
+    pin the layout."""
+    lines = [
+        f"tier: {'enabled' if status.get('enabled') else 'disabled'}"
+        f" residency={'on' if status.get('device_residency') else 'off'}",
+        f"  resident: {status.get('resident_entries', 0)} entries / "
+        f"{status.get('resident_bytes', 0)} B (memo "
+        f"{status.get('memo_bytes', 0)} B) target "
+        f"{status.get('target_max_bytes', 0)} B "
+        f"full_ratio {status.get('cache_target_full_ratio', 0)} "
+        f"dirty_ratio {status.get('cache_target_dirty_ratio', 0)}",
+    ]
+    modes = status.get("cache_mode") or {}
+    if modes:
+        lines.append("  cache_mode: " + "  ".join(
+            f"{pool}={mode}" for pool, mode in sorted(modes.items())))
+    ps = status.get("pagestore")
+    if ps:
+        lines.append(
+            f"  pages: {ps.get('pages_used', 0)}/{ps.get('pages_total', 0)}"
+            f" x {ps.get('page_bytes', 0)} B  dirty "
+            f"{ps.get('dirty_pages', 0)}p/{ps.get('dirty_bytes', 0)}B "
+            f"({ps.get('dirty_entries', 0)} entries)  partial "
+            f"{ps.get('partial_residents', 0)}  frag_saved "
+            f"{ps.get('frag_saved_bytes', 0)}B")
+    else:
+        lines.append("  pages: (monolithic resident store)")
+    lines.append(f"  hit_set_archives: "
+                 f"{status.get('hit_set_archives', 0)}")
+    return lines
+
+
 # admin-command renderers, shared by `ceph daemon ASOK CMD` and
 # `ceph tell TARGET CMD` (same command surface, two transports)
 ASOK_RENDERERS = {"dump_op_queue": render_op_queue,
                   "dump_reactors": render_reactors,
                   "log dump": render_log_dump,
-                  "log dump_recent": render_log_dump}
+                  "log dump_recent": render_log_dump,
+                  "tier status": render_tier_status}
 
 
 def print_asok_result(prefix: str, result, fmt: str) -> None:

@@ -798,7 +798,11 @@ def run_tier(args) -> int:
                 "osd_min_read_recency_for_promote": 1,
                 "osd_tier_agent_interval": 0.1,
                 "osd_tier_target_max_bytes": target_bytes,
-                "osd_cache_target_full_ratio": 0.8}
+                "osd_cache_target_full_ratio": 0.8,
+                # writeback legs: dirty residents must flush on the
+                # agent cadence (age-driven) so dirty_pages is bounded
+                # after settling — the failing gate below
+                "osd_tier_flush_age": 0.3}
         cluster = Cluster(n_osds=max(3, args.tier_osds), conf=conf)
         await cluster.start()
         failures = []
@@ -883,6 +887,87 @@ def run_tier(args) -> int:
                 failures.append(
                     f"resident_bytes {store.resident_bytes} exceeds "
                     f"target {target_bytes} after settling")
+            # -- writeback legs (paged store only): put under
+            # cache_mode=writeback -> dirty pages -> agent flush ->
+            # evict -> re-read byte identity, with bounded dirty_pages
+            # after settling as the failing gate
+            if hasattr(store, "dirty_items"):
+                await c.pool_set(pool, "cache_mode", "writeback")
+                for o in cluster.osds.values():
+                    # pool-opt propagation: poll each OSD's map
+                    for _ in range(100):
+                        p = (o.osdmap.pools.get(pool)
+                             if o.osdmap else None)
+                        if p is not None and (getattr(p, "opts", {})
+                                              or {}).get("cache_mode") \
+                                == "writeback":
+                            break
+                        await asyncio.sleep(0.02)
+                wb_blobs = {}
+                saw_dirty = False
+                pinned = {}
+                for i in range(6):
+                    oid = f"wb{i}"
+                    wb_blobs[oid] = _os.urandom(120_000 + 1024 * i)
+                    await c.put(pool, oid, wb_blobs[oid])
+                    # sample dirt per put: the agent (0.1s cadence,
+                    # 0.3s flush age) may legitimately drain earlier
+                    # puts' pages while later puts run on a slow host —
+                    # an after-the-loop snapshot would false-fail
+                    saw_dirty = saw_dirty or store.dirty_pages > 0
+                    for key, info, _g, _s in store.dirty_items():
+                        if info is not None:
+                            pinned[key] = info
+                pinned = sorted(pinned.items())
+                if not saw_dirty or not pinned:
+                    failures.append(
+                        "writeback puts left no dirty pages (writeback "
+                        "never engaged)")
+                for oid, want in wb_blobs.items():
+                    got = await c.get(pool, oid)
+                    if got != want:
+                        failures.append(
+                            f"writeback resident read mismatch on {oid}")
+                # agent settling: age-driven flush must bound dirty
+                for _ in range(100):
+                    if not store.has_dirty():
+                        break
+                    await asyncio.sleep(0.05)
+                if store.dirty_pages != 0:
+                    failures.append(
+                        f"dirty_pages {store.dirty_pages} not bounded "
+                        f"after agent settling (flush never drained)")
+                # the deferred local applies LANDED at their versions
+                for key, info in pinned:
+                    osd = cluster.osds.get(key[0])
+                    if osd is None:
+                        continue
+                    for shard in info.shards:
+                        got_s = osd._store_read(
+                            (info.pool_id, info.oid, shard))
+                        if got_s is None or got_s[1].version < info.version:
+                            failures.append(
+                                f"flush of {info.oid} shard {shard} on "
+                                f"osd.{key[0]} never reached the store")
+                # evict everything, then cold re-reads must serve the
+                # flushed bytes (flush-before-evict byte identity)
+                for oid in wb_blobs:
+                    drop_residents(oid)
+                for oid, want in wb_blobs.items():
+                    got = await c.get(pool, oid, fadvise="dontneed")
+                    if got != want:
+                        failures.append(
+                            f"post-flush cold read mismatch on {oid}")
+                wb_perf = store.perf.dump()
+                print(f"tier writeback: {len(wb_blobs)} puts, "
+                      f"flushes={wb_perf.get('flushes', 0)} "
+                      f"flush_bytes={wb_perf.get('flush_bytes', 0)} "
+                      f"dirty_pages={store.dirty_pages} "
+                      f"page_evictions={wb_perf.get('page_evictions', 0)} "
+                      f"frag_saved={wb_perf.get('frag_saved_bytes', 0)}")
+            else:
+                print("tier writeback: SKIPPED (monolithic resident "
+                      "store forced; writeback needs the pagestore)")
             tier = {}
             for o in cluster.osds.values():
                 for k, v in o.tier_perf.dump().items():

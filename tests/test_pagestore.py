@@ -1,0 +1,591 @@
+"""Paged resident store (ceph_tpu/rados/pagestore.py) + writeback tier
+semantics: page-table math and ragged tails, trim/fragmentation
+accounting, per-page dirty bits with the flush-before-evict discipline,
+partial (parity-shed) residency, page-granular memo accounting, the
+generic planar_* helpers over the paged protocol, and the end-to-end
+writeback lifecycle — dirty install, agent flush byte identity,
+primary-failover flush-on-demote, the write-heat gate, and the
+mon-validated cache_mode/dirty-ratio pool opts."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.rados import osd as osdmod
+from ceph_tpu.rados.ecutil import (planar_object_bytes, planar_rows,
+                                   planar_shard_bytes)
+from ceph_tpu.rados.pagestore import PagedResidentStore, WritebackRecord
+from ceph_tpu.rados.tiering import HitSetArchive
+from ceph_tpu.rados.vstart import Cluster
+
+PROFILE = {"plugin": "jerasure", "technique": "reed_sol_van",
+           "k": "2", "m": "1"}
+
+
+def run(coro, timeout=180):
+    asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+@pytest.fixture()
+def force_batching(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_FORCE_BATCH", "1")
+
+
+def _rows(n, B, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, (n, B), dtype=np.uint8)
+
+
+# -- page table / ragged tails -----------------------------------------------
+
+
+class TestPageTable:
+    def test_ragged_tail_roundtrip_non_page_multiple(self):
+        """Satellite pin: residents whose byte size is NOT a multiple of
+        the page size round-trip byte-identically through the ragged
+        last page, at several awkward widths."""
+        store = PagedResidentStore(capacity_bytes=1 << 20,
+                                   page_bytes=4096)
+        for i, B in enumerate((3000, 4096, 4128, 12256)):
+            rows = _rows(3, B, seed=i)
+            store.admit(f"o{i}", rows, w=8, layout="packedbit")
+            got = store.read(f"o{i}")
+            assert got is not None
+            np.testing.assert_array_equal(got, rows)
+        assert store.pages_used <= store.pages_total
+
+    def test_planes_layout_word_aligns_odd_widths(self):
+        """Review pin: an int8 'planes' resident whose byte width is
+        not a multiple of 4 must still gather/read — row widths pad up
+        to whole pool words, trim restores the true width."""
+        store = PagedResidentStore(capacity_bytes=1 << 20,
+                                   page_bytes=4096)
+        rows = _rows(3, 3001, seed=13)
+        store.admit("o", rows, w=8, layout="planes")
+        got = store.read("o")
+        assert got is not None
+        np.testing.assert_array_equal(got, rows)
+        assert store.gather_rows("o", 8, 16) is not None
+
+    def test_pages_used_matches_ceil_of_footprint(self):
+        store = PagedResidentStore(capacity_bytes=1 << 20,
+                                   page_bytes=4096)
+        rows = _rows(3, 4096)  # packedbit: 24 bit-rows x 128 words
+        store.admit("o", rows, w=8, layout="packedbit")
+        total_words = 24 * (4096 // 32)
+        want = -(-total_words * 4 // 4096)
+        assert store.pages_used == want
+        assert store.resident_bytes == want * 4096
+
+    def test_trim_drops_pad_and_counts_frag(self):
+        """put_planar(trim=) stores only the true columns; the
+        monolithic-equivalent accounting keeps the padded width, so
+        frag_saved goes positive when the pad was real."""
+        from ceph_tpu.ops.gf2 import to_packedbit
+
+        store = PagedResidentStore(capacity_bytes=1 << 20,
+                                   page_bytes=4096)
+        B, B_padded = 4096, 8192  # a pow2-padded encode output
+        rows = _rows(3, B, seed=3)
+        padded = np.zeros((3, B_padded), dtype=np.uint8)
+        padded[:, :B] = rows
+        bits = np.asarray(to_packedbit(padded))
+        assert store.put_planar("o", bits, w=8, n_rows=3,
+                                meta=(1, B, B * 2), trim=B)
+        # gather excludes the pad
+        got = store.gather_rows("o", 0, 24)
+        assert got.shape[1] == B // 32
+        assert store.stats()["monolithic_equiv_bytes"] == 24 * (B_padded
+                                                                // 32) * 4
+        assert store.frag_saved_signed > 0
+
+    def test_gather_rows_partial_ranges(self):
+        store = PagedResidentStore(capacity_bytes=1 << 20,
+                                   page_bytes=4096)
+        rows = _rows(4, 2048, seed=4)
+        store.admit("o", rows, w=8, layout="packedbit")
+        from ceph_tpu.ops.gf2 import from_packedbit
+
+        mid = store.gather_rows("o", 8, 16)  # rows 1..2's bit-rows
+        got = np.asarray(from_packedbit(mid, 1))
+        np.testing.assert_array_equal(got[0], rows[1])
+
+    def test_lru_eviction_makes_room(self):
+        store = PagedResidentStore(capacity_bytes=64 << 10,
+                                   page_bytes=4096)
+        # each resident: 24 bit-rows x 64 words x 4B = 6144 B -> 2 pages
+        for i in range(12):
+            store.admit(f"o{i}", _rows(3, 2048, seed=i), w=8,
+                        layout="packedbit")
+        assert store.pages_used <= store.pages_total
+        assert store.evictions > 0
+        assert "o0" not in store  # oldest went first
+        assert "o11" in store
+
+    def test_oversized_install_refused(self):
+        store = PagedResidentStore(capacity_bytes=8 << 10,
+                                   page_bytes=4096)
+        bits = np.zeros((24, 1024), dtype=np.uint32)  # 96 KiB > pool
+        assert not store.put_planar("big", bits, w=8, n_rows=3,
+                                    meta=(1, 1024 * 32, 0))
+        assert "big" not in store
+        assert store.perf.get("install_refused") == 1
+
+    def test_capacity_only_grows(self):
+        store = PagedResidentStore(capacity_bytes=64 << 10,
+                                   page_bytes=4096)
+        store.capacity_bytes = 128 << 10
+        assert store.pages_total == 32
+        store.capacity_bytes = 4096  # shrink attempts are ignored
+        assert store.pages_total == 32
+
+
+# -- dirty lifecycle ---------------------------------------------------------
+
+
+def _dirty_install(store, key="o", seed=9, version=7):
+    from ceph_tpu.ops.gf2 import to_packedbit
+
+    rows = _rows(3, 2048, seed=seed)
+    bits = np.asarray(to_packedbit(rows))
+    rec = WritebackRecord(pool_id=1, oid=key, pg=0, version=version,
+                          object_size=4096, hinfo=b"", shards=(1,))
+    assert store.put_planar(key, bits, w=8, n_rows=3,
+                            meta=(version, 2048, 4096), trim=2048,
+                            data_rows=16,
+                            dirty_rows=[(8, 16)], dirty_info=rec)
+    return rows, rec
+
+
+class TestDirtyLifecycle:
+    def test_dirty_install_refuses_drop_until_clean(self):
+        store = PagedResidentStore(capacity_bytes=1 << 20,
+                                   page_bytes=4096)
+        _dirty_install(store)
+        assert store.dirty_pages > 0
+        assert store.is_dirty("o")
+        assert not store.drop("o")  # flush-before-evict holds
+        assert store.perf.get("evict_refused_dirty") == 1
+        info, gen = store.peek_dirty("o")
+        assert info.shards == (1,)
+        assert store.clear_dirty("o", gen)
+        assert not store.is_dirty("o")
+        assert store.dirty_pages == 0
+        assert store.drop("o")
+
+    def test_force_drop_overrides_dirty(self):
+        store = PagedResidentStore(capacity_bytes=1 << 20,
+                                   page_bytes=4096)
+        _dirty_install(store)
+        assert store.drop("o", force=True)
+        assert store.dirty_pages == 0
+
+    def test_stale_flush_token_cannot_clear_new_dirt(self):
+        """An overwrite that re-installed mid-flush keeps ITS dirt: the
+        old generation token is refused."""
+        store = PagedResidentStore(capacity_bytes=1 << 20,
+                                   page_bytes=4096)
+        _dirty_install(store, seed=1, version=7)
+        _info, old_gen = store.peek_dirty("o")
+        _dirty_install(store, seed=2, version=8)  # overwrite, new dirt
+        assert not store.clear_dirty("o", old_gen)
+        assert store.is_dirty("o")
+        _info2, new_gen = store.peek_dirty("o")
+        assert new_gen != old_gen
+        assert store.clear_dirty("o", new_gen)
+
+    def test_install_refused_when_pool_all_dirty(self):
+        store = PagedResidentStore(capacity_bytes=16 << 10,
+                                   page_bytes=4096)
+        _dirty_install(store, key="a", seed=1)  # 2 pages, dirty
+        _dirty_install(store, key="b", seed=2)
+        # nothing clean to evict: a third install must refuse, and both
+        # dirty entries must survive untouched
+        from ceph_tpu.ops.gf2 import to_packedbit
+
+        bits = np.asarray(to_packedbit(_rows(3, 2048, seed=3)))
+        assert not store.put_planar("c", bits, w=8, n_rows=3,
+                                    meta=(1, 2048, 0))
+        assert store.is_dirty("a") and store.is_dirty("b")
+
+
+# -- partial residency (parity shed) -----------------------------------------
+
+
+class TestParityShed:
+    def test_shed_frees_suffix_data_keeps_serving(self):
+        store = PagedResidentStore(capacity_bytes=1 << 20,
+                                   page_bytes=4096)
+        rows = _rows(3, 4096, seed=5)
+        from ceph_tpu.ops.gf2 import to_packedbit
+
+        bits = np.asarray(to_packedbit(rows))
+        assert store.put_planar("o", bits, w=8, n_rows=3,
+                                meta=(1, 4096, 8192), trim=4096,
+                                data_rows=16)  # k=2 of n=3
+        before = store.entry_nbytes("o")
+        freed = store.shed_parity("o")
+        assert freed > 0
+        assert store.entry_nbytes("o") == before - freed
+        assert store.perf.get("parity_sheds") == 1
+        # data rows still gather; the whole resident does not
+        assert store.gather_rows("o", 0, 16) is not None
+        assert store.get_planar("o") is None
+        assert store.page_stats()["partial_residents"] == 1
+        # second shed is a no-op
+        assert store.shed_parity("o") == 0
+
+    def test_shed_skips_dirty_pages(self):
+        store = PagedResidentStore(capacity_bytes=1 << 20,
+                                   page_bytes=4096)
+        _dirty_install(store)  # shard 1 (parity range rows 8..16) dirty
+        from ceph_tpu.ops.gf2 import to_packedbit  # noqa: F401
+
+        # data_rows=16 -> parity suffix overlaps the dirty rows: the
+        # dirty pages must survive the shed
+        dirty_before = store.dirty_pages
+        store.shed_parity("o")
+        assert store.dirty_pages == dirty_before
+
+
+# -- memo accounting ---------------------------------------------------------
+
+
+class TestMemo:
+    def test_memo_page_rounded_and_dies_with_entry(self):
+        store = PagedResidentStore(capacity_bytes=64 << 10,
+                                   page_bytes=4096)
+        store.admit("o", _rows(3, 2048, seed=6), w=8, layout="packedbit",
+                    meta=(5, 2048, 4000))
+        store.memo_put("o", 5, b"x" * 100)
+        assert store.memo_bytes == 4096  # page-rounded charge
+        assert store.memo_get("o", 5) == b"x" * 100
+        assert store.memo_get("o", 6) is None  # version-tagged
+        store.drop("o")
+        assert store.memo_bytes == 0
+        assert store.memo_get("o", 5) is None
+
+    def test_memo_cap_refuses_over_budget(self):
+        store = PagedResidentStore(capacity_bytes=8 << 10,
+                                   page_bytes=4096)
+        store.admit("o", _rows(1, 32, seed=7), w=8, layout="packedbit")
+        store.memo_put("o", None, b"y" * 9000)  # 3 pages > 2-page pool
+        assert store.memo_bytes == 0
+
+
+# -- generic planar_* helpers over the paged protocol ------------------------
+
+
+class TestPlanarHelpersOverPages:
+    def test_shard_and_object_bytes_match_rows(self):
+        store = PagedResidentStore(capacity_bytes=1 << 20,
+                                   page_bytes=4096)
+        k, n, B, cs = 2, 3, 4096, 1024
+        rows = _rows(n, B, seed=8)
+        store.admit("o", rows, w=8, layout="packedbit",
+                    meta=(42, B, k * B))
+        for s in range(n):
+            assert planar_shard_bytes(store, "o", 42, s) \
+                == rows[s].tobytes()
+        assert planar_shard_bytes(store, "o", 41, 0) is None  # stale
+        got = planar_object_bytes(store, "o", 42, k, cs, k * B)
+        want = rows[:k].reshape(k, B // cs, cs).transpose(1, 0, 2) \
+            .reshape(-1).tobytes()
+        assert got == want
+        # memoized second read
+        assert planar_object_bytes(store, "o", 42, k, cs, k * B) == want
+        lst = planar_rows(store, "o", 42)
+        assert lst is not None and len(lst) == n
+        np.testing.assert_array_equal(lst[2], rows[2])
+
+    def test_object_bytes_survive_parity_shed_rows_do_not(self):
+        store = PagedResidentStore(capacity_bytes=1 << 20,
+                                   page_bytes=4096)
+        k, B, cs = 2, 4096, 1024
+        rows = _rows(3, B, seed=9)
+        from ceph_tpu.ops.gf2 import to_packedbit
+
+        bits = np.asarray(to_packedbit(rows))
+        store.put_planar("o", bits, w=8, n_rows=3, meta=(7, B, k * B),
+                         trim=B, data_rows=k * 8)
+        store.shed_parity("o")
+        want = rows[:k].reshape(k, B // cs, cs).transpose(1, 0, 2) \
+            .reshape(-1).tobytes()
+        assert planar_object_bytes(store, "o", 7, k, cs, k * B) == want
+        assert planar_rows(store, "o", 7) is None  # parity gone
+
+
+# -- temperatures survive pool param changes ---------------------------------
+
+
+class TestRetune:
+    def test_retune_preserves_heat(self):
+        arch = HitSetArchive(period=10.0, count=8, now=0.0)
+        arch.record("hot", now=1.0)
+        arch.rotate(now=2.0)
+        arch.record("hot", now=3.0)
+        t_before = arch.temperature("hot")
+        assert t_before > 0
+        arch.retune(period=5.0, count=4, target_size=256, fpp=0.01)
+        # the archived interval still scores; future sizing changed
+        assert arch.temperature("hot") == t_before
+        assert arch.params_key() == (5.0, 4, 256, 0.01)
+        assert arch.archived.maxlen == 4
+
+
+# -- end-to-end: writeback lifecycle -----------------------------------------
+
+
+WB_CONF = {"osd_auto_repair": False, "client_op_timeout": 60.0,
+           "osd_hit_set_period": 30.0,
+           "osd_min_read_recency_for_promote": 1,
+           "osd_tier_cache_mode": "writeback",
+           "osd_tier_agent_interval": 0.1,
+           "osd_tier_flush_age": 0.4}
+
+
+class TestWritebackEndToEnd:
+    def test_dirty_flush_evict_reread_byte_identity(self, force_batching):
+        """The writeback lifecycle gate: a put installs DIRTY pages and
+        defers the local store apply; the resident serves reads; the
+        agent's age-driven flush lands the deferred applies at the
+        exact pinned versions; evicting then re-reading cold serves the
+        flushed bytes."""
+        async def go():
+            cluster = Cluster(n_osds=3, conf=dict(WB_CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("wb", profile=dict(PROFILE))
+                store = osdmod.shared_planar_store()
+                assert store is not None and hasattr(store, "dirty_items")
+                blob = os.urandom(120_000)
+                await c.put(pool, "obj", blob)
+                assert store.dirty_pages > 0, \
+                    "writeback put left no dirty pages"
+                pinned = [(key, info) for key, info, _g, _s
+                          in store.dirty_items()]
+                assert pinned
+                # resident read serves the acked (dirty) bytes
+                assert await c.get(pool, "obj") == blob
+                # age-driven agent flush drains the dirt
+                for _ in range(200):
+                    if not store.has_dirty():
+                        break
+                    await asyncio.sleep(0.05)
+                assert store.dirty_pages == 0, "flush never drained"
+                # the deferred applies landed at their pinned versions
+                flushed = 0
+                for key, info in pinned:
+                    o = cluster.osds[key[0]]
+                    for shard in info.shards:
+                        got = o._store_read((info.pool_id, info.oid,
+                                             shard))
+                        assert got is not None
+                        assert got[1].version >= info.version
+                        flushed += 1
+                assert flushed > 0
+                # evict everything; the cold path must serve the
+                # flushed bytes byte-identically
+                for o in cluster.osds.values():
+                    if o._planar is not None:
+                        o._planar.drop(o._planar_key(pool, "obj"),
+                                       force=True)
+                assert await c.get(pool, "obj",
+                                   fadvise="dontneed") == blob
+                assert sum(o._planar.perf.get("flushes")
+                           for o in cluster.osds.values()
+                           if o._planar is not None) > 0
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_flush_on_demote_primary_failover(self, force_batching):
+        """Satellite pin: a primary holding dirty residents that loses
+        primaryship (admin out) flushes them on the map change —
+        writeback is never the only copy once the PG moved — and the
+        new primary serves the acked bytes."""
+        async def go():
+            conf = dict(WB_CONF)
+            conf["osd_tier_flush_age"] = 60.0  # only demote may flush
+            cluster = Cluster(n_osds=4, conf=conf)
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("wb", profile=dict(PROFILE))
+                store = osdmod.shared_planar_store()
+                blobs = {f"o{i}": os.urandom(90_000) for i in range(6)}
+                for oid, blob in blobs.items():
+                    await c.put(pool, oid, blob)
+                dirty = store.dirty_items()
+                assert dirty, "no writeback dirt to fail over"
+                victim = dirty[0][0][0]  # osd id of a dirty primary
+                await c.osd_out(victim)
+                # the demoted primary must flush ITS dirt on the map
+                for _ in range(200):
+                    if not any(key[0] == victim for key, *_ in
+                               store.dirty_items()):
+                        break
+                    await asyncio.sleep(0.05)
+                assert not any(key[0] == victim
+                               for key, *_ in store.dirty_items()), \
+                    "demoted primary kept dirty residents"
+                assert cluster.osds[victim].tier_perf.get(
+                    "flush_demote") > 0
+                # acked bytes survive the failover
+                for oid, blob in blobs.items():
+                    assert await c.get(pool, oid) == blob
+                await c.osd_in(victim)
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_gated_overwrite_supersedes_dirty_resident(
+            self, force_batching):
+        """Review pin: a full overwrite whose resident install is GATED
+        must kill the previous write's dirty resident — otherwise the
+        agent's later flush would replay the OLD deferred shard bytes
+        over the newer committed write (version regression)."""
+        async def go():
+            conf = dict(WB_CONF)
+            conf["osd_tier_flush_age"] = 60.0  # keep v1's dirt parked
+            cluster = Cluster(n_osds=3, conf=conf)
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("sv", profile=dict(PROFILE))
+                store = osdmod.shared_planar_store()
+                v1 = os.urandom(100_000)
+                await c.put(pool, "obj", v1)
+                assert store.dirty_pages > 0
+                (key, info), = [(k, i) for k, i, _g, _s
+                                in store.dirty_items()]
+                # gate the SECOND write's install at runtime
+                await c.pool_set(pool, "min_write_recency_for_promote",
+                                 "99")
+                o = cluster.osds[key[0]]
+                for _ in range(100):
+                    p = o.osdmap.pools.get(pool) if o.osdmap else None
+                    if p is not None and (getattr(p, "opts", {})
+                                          or {}).get(
+                            "min_write_recency_for_promote") == "99":
+                        break
+                    await asyncio.sleep(0.02)
+                v2 = os.urandom(104_000)
+                await c.put(pool, "obj", v2)
+                # the superseded dirty resident died with the overwrite
+                assert not store.is_dirty(key), \
+                    "stale writeback dirt survived a gated overwrite"
+                assert key not in store
+                # the local shards hold v2, and no later agent pass may
+                # regress them
+                await asyncio.sleep(0.5)
+                for shard in info.shards:
+                    got = o._store_read((info.pool_id, info.oid, shard))
+                    assert got is not None
+                    assert got[1].version > info.version, \
+                        "local shard regressed to the superseded version"
+                assert await c.get(pool, "obj") == v2
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_write_heat_gate_blocks_cold_write_installs(
+            self, force_batching):
+        """Satellite pin (the r10 OPEN tail): with
+        min_write_recency_for_promote=2 a cold object's writes do NOT
+        install residents (gated, counted), while reads stay correct."""
+        async def go():
+            conf = {"osd_auto_repair": False, "client_op_timeout": 60.0,
+                    "osd_hit_set_period": 30.0,
+                    "osd_min_write_recency_for_promote": 2}
+            cluster = Cluster(n_osds=3, conf=conf)
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("g", profile=dict(PROFILE))
+                store = osdmod.shared_planar_store()
+                blob = os.urandom(60_000)
+                await c.put(pool, "obj", blob)
+                await c.put(pool, "obj", blob)  # same interval: still 1
+                assert not any(
+                    o._planar is not None
+                    and o._planar_key(pool, "obj") in store
+                    for o in cluster.osds.values()), \
+                    "cold write installed a resident through the gate"
+                gated = sum(o.tier_perf.get("write_install_gated")
+                            for o in cluster.osds.values())
+                recorded = sum(o.tier_perf.get("write_hits_recorded")
+                               for o in cluster.osds.values())
+                assert gated >= 2 and recorded >= 2
+                assert await c.get(pool, "obj") == blob
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_mon_validates_writeback_pool_opts(self, force_batching):
+        async def go():
+            cluster = Cluster(n_osds=3, conf={
+                "osd_auto_repair": False, "client_op_timeout": 60.0})
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("m", profile=dict(PROFILE))
+                await c.pool_set(pool, "cache_mode", "bogus")
+                await c.refresh_map()
+                opts = getattr(c.osdmap.pools[pool], "opts", {}) or {}
+                assert opts.get("cache_mode") is None
+                for key, val in (("cache_mode", "writeback"),
+                                 ("cache_target_dirty_ratio", "0.5"),
+                                 ("min_write_recency_for_promote", "3")):
+                    await c.pool_set(pool, key, val)
+                await c.refresh_map()
+                opts = getattr(c.osdmap.pools[pool], "opts", {}) or {}
+                assert opts.get("cache_mode") == "writeback"
+                assert opts.get("cache_target_dirty_ratio") == "0.5"
+                assert opts.get("min_write_recency_for_promote") == "3"
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_tier_status_carries_pages_and_cache_mode(
+            self, force_batching):
+        async def go():
+            cluster = Cluster(n_osds=3, conf=dict(WB_CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("s", profile=dict(PROFILE))
+                await c.put(pool, "obj", os.urandom(50_000))
+                osd = next(iter(cluster.osds.values()))
+                status = osd.tier_status()
+                ps = status["pagestore"]
+                assert ps is not None
+                for key in ("page_bytes", "pages_total", "pages_used",
+                            "dirty_pages", "dirty_bytes",
+                            "frag_saved_bytes", "partial_residents"):
+                    assert key in ps
+                assert status["cache_mode"].get("s") == "writeback"
+                assert "cache_target_dirty_ratio" in status
+                from ceph_tpu.tools.ceph import render_tier_status
+
+                lines = render_tier_status(status)
+                assert any("pages:" in ln for ln in lines)
+                assert any("cache_mode" in ln for ln in lines)
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())

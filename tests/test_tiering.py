@@ -551,7 +551,11 @@ class TestTierEndToEnd:
                 "osd_min_read_recency_for_promote": 1,
                 # one object per 5 seconds: of a 4-read burst exactly
                 # one promotion is admitted; the rest are refused and
-                # counted (a refill can't sneak in on a slow host)
+                # counted (a refill can't sneak in on a slow host).
+                # Write installs ride the SAME throttle since the
+                # write-heat gate landed — gate them off so the seed
+                # writes can't spend the one token this test counts
+                "osd_min_write_recency_for_promote": 99,
                 "osd_tier_promote_max_objects_sec": 0.2,
                 "osd_tier_promote_max_bytes_sec": 0})
             await cluster.start()
