@@ -831,6 +831,21 @@ def main() -> int:
     tier_perf: dict = got.get("tier_perf", {})
     tier_pagestore: dict = got.get("tier_pagestore") or {}
 
+    # SLAB-ARM e2e arm: the SAME put -> resident-read workload run once
+    # per slab arm (CEPH_TPU_DEVICE_SLAB=1 child vs =0 child, same
+    # BENCH window) — e2e_device_GBps vs e2e_host_GBps is the measured
+    # cost/win of the jitted device-slab path on this host; on a CPU-
+    # only host both ride the jax-cpu backend (call-structure parity,
+    # honest numbers, no pretend-HBM)
+    e2e_device: dict = _run_child_bench(
+        "--e2e-device", extra_env={"CEPH_TPU_FORCE_BATCH": "1",
+                                   "CEPH_TPU_DEVICE_SLAB": "1"}
+    ).get("e2e", {})
+    e2e_host: dict = _run_child_bench(
+        "--e2e-device", extra_env={"CEPH_TPU_FORCE_BATCH": "1",
+                                   "CEPH_TPU_DEVICE_SLAB": "0"}
+    ).get("e2e", {})
+
     # MIXED-SIZE-POPULATION arm: a working set whose monolithic (pow2-
     # bucketed) residency footprint exceeds the tier budget must fit
     # entirely under the paged layout (frag_saved_bytes > 0, bounded
@@ -990,6 +1005,14 @@ def main() -> int:
         # `pagestore` occupancy snapshot of the hot-read arm (page
         # pool / dirty / frag_saved gauges while the set is resident)
         "tier_pagestore": tier_pagestore,
+        # slab-arm e2e: put -> resident-read GB/s per slab arm, same
+        # workload same record — the device-datapath claim is judged
+        # here (and each arm's pagestore snapshot proves which install/
+        # gather path ran: device_installs vs h2d, d2h_gathers)
+        "e2e_device_GBps": e2e_device.get("e2e_GBps", 0.0),
+        "e2e_host_GBps": e2e_host.get("e2e_GBps", 0.0),
+        "e2e_device": e2e_device,
+        "e2e_host": e2e_host,
         # mixed-size-population arm: monolithic-equivalent vs paged
         # footprint of the same residents, and whether the set fits
         "tier_mixed": tier_mixed,
@@ -1701,6 +1724,101 @@ def hot_read_bench() -> int:
     return 0
 
 
+def e2e_device_bench() -> int:
+    """Slab-arm end-to-end arm (bench.py --e2e-device): put ->
+    resident-read through a real TCP cluster with the pagestore's slab
+    arm pinned by CEPH_TPU_DEVICE_SLAB (the parent runs this child once
+    per arm, SAME workload, so the two windows compare the SLAB PATH —
+    install/gather kernels — not the wire).  Byte identity asserted on
+    every measured read.  ``e2e_GBps`` is total bytes moved over the
+    put+read window; the per-window rates ride alongside, with the
+    pagestore snapshot (device_slabs / h2d_installs / device_installs /
+    d2h_gathers) as evidence of WHICH path actually ran."""
+    import asyncio
+
+    os.environ["CEPH_TPU_FORCE_BATCH"] = "1"
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from ceph_tpu.rados.vstart import Cluster
+    import ceph_tpu.rados.osd as osdmod
+
+    n_hot = 8
+    obj_size = 2 << 20
+    n_reads = 48
+
+    async def go():
+        cluster = Cluster(n_osds=4, conf={
+            "osd_auto_repair": False,
+            "ms_local_fastpath": False,
+            "client_op_timeout": 60.0,
+            "osd_hit_set_period": 1.0,
+            "osd_min_read_recency_for_promote": 1,
+            "osd_tier_promote_max_objects_sec": 64,
+            "osd_tier_promote_max_bytes_sec": 512 << 20,
+            "osd_tier_agent_interval": 0.5})
+        await cluster.start()
+        try:
+            c = await cluster.client()
+            pool = await c.create_pool("e2e", profile={
+                "plugin": "jerasure", "technique": "reed_sol_van",
+                "k": "2", "m": "1"})
+            store = osdmod.shared_planar_store()
+            assert store is not None
+            rng = np.random.default_rng(11)
+            blobs = {f"e{i}": rng.integers(0, 256, obj_size,
+                                           dtype=np.uint8).tobytes()
+                     for i in range(n_hot)}
+            # connection warmup outside the windows
+            await c.put(pool, "warm", b"x" * 4096)
+
+            # PUT window: encode + wire + install
+            t0 = time.perf_counter()
+            for oid, blob in blobs.items():
+                await c.put(pool, oid, blob)
+            put_dt = time.perf_counter() - t0
+
+            def resident(oid):
+                return any(o._planar is not None
+                           and o._planar_key(pool, oid) in store
+                           for o in cluster.osds.values())
+
+            for oid in blobs:
+                await c.get(pool, oid, fadvise="willneed")
+            for _ in range(200):
+                if all(resident(oid) for oid in blobs):
+                    break
+                await asyncio.sleep(0.02)
+            schedule = [f"e{i}" for i in rng.integers(
+                0, n_hot, size=n_reads)]
+
+            # RESIDENT-READ window: slab gather -> pack -> wire
+            t0 = time.perf_counter()
+            for oid in schedule:
+                got = await c.get(pool, oid)
+                assert got == blobs[oid]
+            read_dt = time.perf_counter() - t0
+
+            pagestore = (store.page_stats()
+                         if hasattr(store, "page_stats") else None)
+            await c.stop()
+            return put_dt, read_dt, pagestore
+        finally:
+            await cluster.stop()
+
+    put_dt, read_dt, pagestore = asyncio.run(go())
+    put_bytes = n_hot * obj_size
+    read_bytes = n_reads * obj_size
+    arm = "device" if (pagestore or {}).get("device_arm") else "host"
+    print(json.dumps({"e2e": {
+        "arm": arm,
+        "put_MBps": round(put_bytes / put_dt / 1e6, 1),
+        "resident_read_MBps": round(read_bytes / read_dt / 1e6, 1),
+        "e2e_GBps": round((put_bytes + read_bytes)
+                          / (put_dt + read_dt) / 1e9, 3),
+        "put_bytes": put_bytes, "read_bytes": read_bytes,
+        "pagestore": pagestore}}))
+    return 0
+
+
 def tier_mixed_bench() -> int:
     """Mixed-size-population arm (bench.py --tier-mixed): the paged
     layout's reason to exist.  A working set of mixed object sizes is
@@ -2213,6 +2331,8 @@ if __name__ == "__main__":
         sys.exit(msgr_stream_bench())
     if "--hot-read" in sys.argv:
         sys.exit(hot_read_bench())
+    if "--e2e-device" in sys.argv:
+        sys.exit(e2e_device_bench())
     if "--tier-mixed" in sys.argv:
         sys.exit(tier_mixed_bench())
     if "--rebalance" in sys.argv:

@@ -357,6 +357,12 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
     Option("osd_tier_page_bytes", OPT_SIZE, 64 << 10,
            desc="page size of the paged resident store (u32-word "
                 "pages; eviction and dirty tracking are per page)"),
+    Option("osd_tier_device_slab", OPT_BOOL, True,
+           desc="allow the paged resident store's device arm "
+                "(jax.Array sub-slabs, jitted in-place installs and "
+                "gathers) when a real device backend is live; false "
+                "pins the host-numpy arm. CEPH_TPU_DEVICE_SLAB=1/0 "
+                "overrides in either direction"),
     Option("osd_tier_cache_mode", OPT_STR, "writethrough",
            desc="default cache mode for tiered pools (pool opt "
                 "cache_mode overrides): writethrough applies local "

@@ -1125,9 +1125,25 @@ class BatchingQueue:
         return widths, packed, planes, sharded, nbytes
 
     def _complete_packedbit_resident(self, g: _Group, state) -> None:
+        # DONATION SAFETY: every fan-out below is a device-side SLICE of
+        # the one batched `planes` product — consumers (the pagestore's
+        # device-arm install, ceph_tpu/ops/slab.py) must never donate
+        # the DATA argument of their kernels, because sibling requests
+        # alias the same underlying buffer; only the slab argument,
+        # which this plane never hands out, is donatable.
         widths, packed, planes, sharded, nbytes = state
         packed = np.asarray(packed)  # blocks until ready
         self._note_dispatch(nbytes, sharded)
+        if len(g.requests) == 1 and packed.shape[1] == widths[0]:
+            # single-request group covering the full (unpadded) batch:
+            # hand the whole product back — no slice op on the device
+            # graph, and the install's flatten sees one contiguous
+            # buffer
+            try:
+                g.requests[0].future.set_result((packed, planes))
+            except InvalidStateError:
+                pass
+            return
         off = 0
         for width, req in zip(widths, g.requests):
             try:

@@ -615,17 +615,31 @@ async def planar_encode_async(codec, sinfo: StripeInfo, data: bytes,
     return blobs, all_bits, n, L, w
 
 
-def _pack_rows(bits, w: int, n_rows: int, L: int) -> np.ndarray:
+# the codec/slab-host-roundtrip lint exemption: _pack_rows IS the
+# declared device->host exit for slab-gather results in this module
+SLAB_IO_BOUNDARY = ("_pack_rows",)
+
+
+def _pack_rows(bits, w: int, n_rows: int, L: int,
+               store=None) -> np.ndarray:
     """Resident bit-rows -> packed [n_rows, L] uint8 (the one exit
     boundary, shared by every planar_* helper; dtype tells the packed-bit
-    u32 lane apart from int8 planes)."""
+    u32 lane apart from int8 planes).  On a device-arm paged store the
+    gather result is a device array and the np.asarray here is the
+    single d2h of the read — counted on the store (``d2h_gathers``)
+    when the caller hands it in."""
     if np.dtype(bits.dtype) == np.uint32:
         from ceph_tpu.ops.gf2 import from_packedbit
 
-        return np.asarray(from_packedbit(bits, n_rows))[:, :L]
-    from ceph_tpu.ops.gf2 import from_planar
+        out = np.asarray(from_packedbit(bits, n_rows))[:, :L]
+    else:
+        from ceph_tpu.ops.gf2 import from_planar
 
-    return np.asarray(from_planar(bits, w, n_rows))[:, :L]
+        out = np.asarray(from_planar(bits, w, n_rows))[:, :L]
+    note = getattr(store, "note_d2h", None)
+    if note is not None:
+        note()
+    return out
 
 
 def planar_rows(store, key, version) -> Optional[List[np.ndarray]]:
@@ -644,7 +658,7 @@ def planar_rows(store, key, version) -> Optional[List[np.ndarray]]:
     bits = store.gather_rows(key, 0, n_rows * w)
     if bits is None:
         return None
-    rows = _pack_rows(bits, w, n_rows, meta[1])
+    rows = _pack_rows(bits, w, n_rows, meta[1], store=store)
     return [rows[i] for i in range(n_rows)]
 
 
@@ -662,7 +676,8 @@ def planar_shard_bytes(store, key, version, shard: int) -> Optional[bytes]:
     bits = store.gather_rows(key, shard * w, (shard + 1) * w)
     if bits is None:
         return None
-    return _pack_rows(bits, w, 1, meta[1]).reshape(-1).tobytes()
+    return _pack_rows(bits, w, 1, meta[1],
+                      store=store).reshape(-1).tobytes()
 
 
 def planar_object_bytes(store, key, version, k: int, cs: int,
@@ -691,7 +706,7 @@ def planar_object_bytes(store, key, version, k: int, cs: int,
     if data_bits is None:
         return None
     L = meta[1]
-    rows = _pack_rows(data_bits, w, k, L)
+    rows = _pack_rows(data_bits, w, k, L, store=store)
     n_stripes = max(1, L // cs)
     out = rows.reshape(k, n_stripes, cs).transpose(1, 0, 2)
     result = out.reshape(-1)[:object_size].tobytes()

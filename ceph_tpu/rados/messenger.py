@@ -1991,8 +1991,14 @@ class Connection:
                     await ep.read_into(dest, blen)
                     blob = dest
                 elif getattr(cls, "BLOB_VIEW_OK", False):
-                    blob = memoryview(
-                        np.empty(blen, dtype=np.uint8)).cast("B")
+                    # rx -> install staging: page-aligned so a
+                    # writeback install's h2d reads an aligned source
+                    # (pinnable where pinned DMA exists) — the ring
+                    # views native-gather straight into it, zero
+                    # parent-side per-byte passes after the kernel
+                    from ceph_tpu.rados.pagestore import install_staging
+
+                    blob = install_staging(blen)
                     await ep.read_into(blob, blen)
                 else:
                     blob = bytearray(blen)

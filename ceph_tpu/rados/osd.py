@@ -215,7 +215,8 @@ _PLANAR_STORE = None
 
 
 def shared_planar_store(capacity_bytes: int = 0, page_bytes: int = 0,
-                        paged: Optional[bool] = None):
+                        paged: Optional[bool] = None,
+                        device: Optional[bool] = None):
     """The process-wide resident store behind the cache tier.  Engages
     under the same conditions as the batching queue — an accelerator
     backend (or CEPH_TPU_FORCE_BATCH=1 for CPU tests); None otherwise.
@@ -229,7 +230,13 @@ def shared_planar_store(capacity_bytes: int = 0, page_bytes: int = 0,
     writeback) and the r10 monolithic PlanarShardStore
     (osd_tier_pagestore=false or CEPH_TPU_PAGESTORE=0 — the bench A/B
     arm).  The FIRST creator decides the flavor for the process; later
-    callers only ever raise the shared byte budget."""
+    callers only ever raise the shared byte budget.
+
+    ``device`` gates the paged store's DEVICE arm (jax.Array sub-slabs,
+    jitted installs/gathers — ceph_tpu/ops/slab.py): None = auto
+    (device arm iff a real backend is live), False = pinned host arm
+    (osd_tier_device_slab=false); CEPH_TPU_DEVICE_SLAB=1/0 overrides
+    either way inside the store."""
     global _PLANAR_STORE
     queue = shared_batching_queue()
     if queue is None:
@@ -244,7 +251,8 @@ def shared_planar_store(capacity_bytes: int = 0, page_bytes: int = 0,
 
                 _PLANAR_STORE = PagedResidentStore(
                     capacity_bytes=capacity_bytes or (256 << 20),
-                    page_bytes=page_bytes or (64 << 10), queue=queue)
+                    page_bytes=page_bytes or (64 << 10), queue=queue,
+                    device=device)
             else:
                 from ceph_tpu.parallel.service import PlanarShardStore
 
@@ -496,7 +504,11 @@ class OSD:
                 int(self.conf.get("osd_ec_planar_bytes", 0) or 0),
                 page_bytes=int(
                     self.conf.get("osd_tier_page_bytes", 64 << 10) or 0),
-                paged=bool(self.conf.get("osd_tier_pagestore", True)))
+                paged=bool(self.conf.get("osd_tier_pagestore", True)),
+                # None = auto (device arm iff a real backend is live);
+                # an explicit false config pins the host arm
+                device=(None if self.conf.get("osd_tier_device_slab",
+                                              True) else False))
             if self.conf.get("osd_ec_planar_residency", True) else None)
         # cache-tier policy state (ceph_tpu/rados/tiering.py): per-PG
         # bloom hit-set archives, the promotion rate throttle, and the

@@ -25,21 +25,38 @@ TRIMMED to their true width and flattened row-major across a page table
   overwrite can never mark the NEWER write clean.
 
 The slab is committed lazily (fixed-size sub-slabs allocate on first
-touch) and is device-placeable by construction — one contiguous pool
-indexed by page id, the exact layout a ``dynamic_update_slice`` device
-path wants.  In this build the slab is host-side numpy and the
-pack/unpack device boundaries (``to_packedbit``/``from_packedbit``)
-are paid at the page-table edge; the exit-boundary memo (inherited from
-the r10 store, accounted at PAGE granularity here) keeps repeated
-resident reads free of even that.
+touch) and has TWO arms behind one page table:
+
+- the HOST arm: sub-slabs are numpy arrays, installs/gathers are
+  memcpys, the pack/unpack device boundaries
+  (``to_packedbit``/``from_packedbit``) are paid at the page-table
+  edge.  Byte-identical to the r20 behavior, and the only arm when no
+  device backend is live.
+- the DEVICE arm (``osd_tier_device_slab`` / ``CEPH_TPU_DEVICE_SLAB``,
+  auto-on when a real device backend is live): sub-slabs are
+  ``jax.Array``s and installs/gathers run through the jitted,
+  donation-annotated scatter/take kernels in ``ceph_tpu/ops/slab.py``
+  (the Ragged Paged Attention idiom, arXiv:2604.15464).  A promote's
+  pack->install is ONE async H2D (``h2d_installs``); a queue-produced
+  resident (``all_bits`` from the encode lane) installs device-native
+  with ZERO host copies (``device_installs``); gathers stay on device
+  and feed decode through the jitted ``from_packedbit`` path, so bytes
+  leave HBM only at the declared exit boundaries (``d2h_gathers`` —
+  see ``SLAB_IO_BOUNDARY`` and the codec/slab-host-roundtrip lint
+  rule).  Eviction, dirty bits, shed_parity and the memo are PAGE
+  TABLE bookkeeping — identical across both arms by construction.
 
 Thread-safe under one mutex, same discipline as PlanarShardStore; the
 OSD event loop, the batching worker, and tests may touch it
-concurrently.
+concurrently.  Device kernel dispatches run under that mutex too — the
+lock sequences donated installs against gathers, which is what makes
+donation safe (a gather can only ever see the pre- or post-install
+slab reference, never the donated buffer after it was consumed).
 """
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from collections import OrderedDict
@@ -50,6 +67,49 @@ import numpy as np
 from ceph_tpu.common.perf_counters import PerfCounters, PerfCountersBuilder
 
 _SLAB_SHIFT = 8  # 2**8 pages per lazily-committed sub-slab
+
+# functions allowed to materialize slab-gather results on the host (the
+# codec/slab-host-roundtrip lint rule's per-module exemption list): the
+# pagestore's own packed-byte exit is read()
+SLAB_IO_BOUNDARY = ("read",)
+
+_STAGING_ALIGN = 4096
+
+
+def install_staging(nbytes: int) -> memoryview:
+    """Page-aligned host staging for rx->install payloads (the shm
+    messenger's blob landing zone).  Alignment matters twice: the shm
+    consumer's native gather lands ring views on page boundaries, and a
+    later device install's H2D reads a page-aligned source — the
+    pinnable shape where pinned DMA exists; on a CPU-only host it is
+    honestly just aligned host memory.  The returned view keeps its
+    backing allocation alive (numpy base chain)."""
+    n = int(nbytes)
+    raw = np.empty(n + _STAGING_ALIGN, dtype=np.uint8)
+    off = (-raw.ctypes.data) % _STAGING_ALIGN
+    return memoryview(raw[off:off + n]).cast("B")
+
+
+def device_slab_resolved(flag: Optional[bool] = None) -> bool:
+    """Whether the store's device arm engages.  CEPH_TPU_DEVICE_SLAB=1
+    forces it on (CPU-backend tests exercise the jitted kernels on
+    jax-cpu arrays), =0 forces the host arm; otherwise the config flag
+    (``osd_tier_device_slab``; False pins the host arm) gates the AUTO
+    rule — device arm only when a real device backend is live (an
+    explicit JAX_PLATFORMS=cpu is an operator decision and wins, the
+    shared_batching_queue discipline)."""
+    env = os.environ.get("CEPH_TPU_DEVICE_SLAB", "")
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    if flag is not None and not flag:
+        return False
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return False
+    from ceph_tpu.utils.jaxdev import probe_backend
+
+    return probe_backend() not in ("cpu", "unavailable")
 
 
 @dataclass
@@ -117,6 +177,17 @@ def build_pagestore_perf() -> PerfCounters:
                  "bytes the paged layout saves vs the monolithic "
                  "pow2-bucketed layout for the live residents (gauge, "
                  "floored at 0)")
+        .add_u64("device_slabs", "committed device sub-slabs (gauge; 0 "
+                                 "on the host arm)")
+        .add_u64_counter("h2d_installs",
+                         "installs whose page image crossed host->device "
+                         "as ONE async copy (host-sourced bytes)")
+        .add_u64_counter("device_installs",
+                         "installs consumed device-native (queue-"
+                         "produced residents; zero host copies)")
+        .add_u64_counter("d2h_gathers",
+                         "device->host materializations of gathered "
+                         "slab bytes at the declared exit boundaries")
         .add_time_avg("pack_s", "device->host pack seconds at the exit "
                                 "boundary")
         .add_time_avg("unpack_s", "host->device unpack seconds at "
@@ -131,7 +202,8 @@ class PagedResidentStore:
     backed by the page pool above instead of per-object buffers."""
 
     def __init__(self, capacity_bytes: int = 256 << 20,
-                 page_bytes: int = 64 << 10, queue: Optional[Any] = None):
+                 page_bytes: int = 64 << 10, queue: Optional[Any] = None,
+                 device: Optional[bool] = None):
         from ceph_tpu.common.lockdep import make_mutex
 
         page_bytes = max(256, int(page_bytes))
@@ -141,7 +213,23 @@ class PagedResidentStore:
         self._pages_total = max(1, int(capacity_bytes) // page_bytes)
         self.queue = queue
         self._lock = make_mutex("pagestore")
+        # arm selection: env override wins both ways, then an EXPLICIT
+        # constructor choice (tests force the device arm on jax-cpu),
+        # then the auto rule (device arm iff a real backend is live);
+        # callers resolving a config flag pass device=None (auto) or
+        # False (pinned host) via device_slab_resolved
+        env = os.environ.get("CEPH_TPU_DEVICE_SLAB", "")
+        if env in ("0", "1"):
+            self.device_arm = env == "1"
+        elif device is not None:
+            self.device_arm = bool(device)
+        else:
+            self.device_arm = device_slab_resolved(None)
         self._slabs: List[Optional[np.ndarray]] = []
+        self._dev_slabs: List[Optional[Any]] = []
+        self.h2d_installs = 0
+        self.device_installs = 0
+        self.d2h_gathers = 0
         self._free: List[int] = []
         self._next_page = 0
         self._entries: "OrderedDict[Any, _Entry]" = OrderedDict()
@@ -207,6 +295,23 @@ class PagedResidentStore:
                 (1 << _SLAB_SHIFT, self.page_words), dtype=np.uint32)
         return self._slabs[slab][pid & ((1 << _SLAB_SHIFT) - 1)]
 
+    def _dev_slab(self, s: int):
+        """Lazily-committed device sub-slab ``s`` (lock held).  The
+        device arm's sibling of :meth:`_page`'s host commit — zeroed so
+        the ragged install tail is well-defined."""
+        from ceph_tpu.ops.slab import new_subslab
+
+        while len(self._dev_slabs) <= s:
+            self._dev_slabs.append(None)
+        if self._dev_slabs[s] is None:
+            self._dev_slabs[s] = new_subslab(1 << _SLAB_SHIFT,
+                                             self.page_words)
+            self.perf.set("device_slabs", self._device_slab_count())
+        return self._dev_slabs[s]
+
+    def _device_slab_count(self) -> int:
+        return sum(1 for x in self._dev_slabs if x is not None)
+
     def _available_pages(self) -> int:
         return len(self._free) + (self._pages_total - self._next_page)
 
@@ -255,6 +360,7 @@ class PagedResidentStore:
         self.perf.set("memo_bytes", self.memo_bytes)
         self.perf.set("pages_total", self._pages_total)
         self.perf.set("frag_saved_bytes", max(0, self.frag_saved_signed))
+        self.perf.set("device_slabs", self._device_slab_count())
 
     def _resync_gauges(self) -> None:
         with self._lock:
@@ -282,6 +388,63 @@ class PagedResidentStore:
             return min(cols, -(-int(trim) // 32))
         return min(cols, ((int(trim) + 3) // 4) * 4)
 
+    def _install_pages_locked(self, flat, total_words: int,
+                              from_device: bool) -> List[Optional[int]]:
+        """Device-arm install (lock held): allocate page ids, land the
+        flat word image as page rows via ONE scatter kernel per touched
+        sub-slab (ceph_tpu.ops.slab.slab_install, donation-annotated),
+        and swap the donated sub-slab references under the lock.  A
+        host-sourced image crosses h2d as ONE async copy of the whole
+        zero-padded page image; a device-native image never touches
+        host memory.  Returns the page-id list."""
+        import jax.numpy as jnp
+
+        from ceph_tpu.ops.slab import slab_install
+
+        npages = -(-total_words // self.page_words) if total_words else 0
+        pages: List[Optional[int]] = []
+        for _ in range(npages):
+            pid = self._alloc_page()
+            assert pid is not None  # _available_pages said so
+            pages.append(pid)
+        if not npages:
+            return pages
+        pad = npages * self.page_words - total_words
+        if from_device:
+            buf = flat
+            if pad:
+                buf = jnp.concatenate(
+                    [buf, jnp.zeros(pad, dtype=jnp.uint32)])
+            self.device_installs += 1
+            self.perf.inc("device_installs")
+        else:
+            host = np.zeros(npages * self.page_words, dtype=np.uint32)
+            host[:total_words] = flat
+            buf = jnp.asarray(host)  # the ONE h2d of the install
+            self.h2d_installs += 1
+            self.perf.inc("h2d_installs")
+        rows = buf.reshape(npages, self.page_words)
+        mask = (1 << _SLAB_SHIFT) - 1
+        groups: "OrderedDict[int, List[int]]" = OrderedDict()
+        for i, pid in enumerate(pages):
+            groups.setdefault(pid >> _SLAB_SHIFT, []).append(i)
+        for s, order in groups.items():
+            idx = np.array([pages[i] & mask for i in order],
+                           dtype=np.int32)
+            if len(order) == npages:
+                data = rows
+            else:
+                data = jnp.take(rows,
+                                jnp.asarray(np.array(order,
+                                                     dtype=np.int32)),
+                                axis=0)
+            # the old sub-slab reference is dropped HERE, under the
+            # lock, before any gather can observe it — the donation
+            # safety contract (slab.py docstring)
+            self._dev_slabs[s] = slab_install(self._dev_slab(s), data,
+                                              idx)
+        return pages
+
     def put_planar(self, key: Any, bits, w: int = 8,
                    n_rows: Optional[int] = None, meta: Any = None,
                    trim: Optional[int] = None,
@@ -299,28 +462,49 @@ class PagedResidentStore:
         resident even after evicting every clean colder entry (the
         caller falls back to the uninstalled path; refusal is counted,
         never an error)."""
-        arr = np.asarray(bits)
-        if n_rows is None:
-            n_rows = arr.shape[0] // w
-        rows, cols_full = int(arr.shape[0]), int(arr.shape[1])
-        itemsize = arr.dtype.itemsize
-        mono_bytes = rows * cols_full * itemsize
-        cols = self._trim_cols(arr.dtype, cols_full, trim)
-        if cols < cols_full:
-            arr = arr[:, :cols]
-        if np.dtype(arr.dtype) != np.uint32 and cols % 4:
-            # non-u32 rows must stay word-aligned in the flattened pool
-            # (gather addresses bit-rows as cols*itemsize//4 words) —
-            # pad the row width up to whole words; `trim` keeps the
-            # true byte width for read()'s final slice
-            pad = 4 - cols % 4
-            arr = np.pad(np.asarray(arr), ((0, 0), (0, pad)))
-            cols += pad
-        total_bytes = rows * cols * itemsize
-        flat = np.ascontiguousarray(arr).reshape(-1)
-        if flat.dtype != np.uint32:
-            flat = flat.view(np.uint32)  # rows % 4 == 0 (w >= 4)
-        total_words = int(flat.size)
+        from_device = False
+        if self.device_arm:
+            from ceph_tpu.ops.slab import is_device_array
+
+            from_device = (is_device_array(bits)
+                           and str(bits.dtype) == "uint32")
+        if from_device:
+            # device-native install: a queue-produced resident (the
+            # encode lane's packed-bit planes) never bounces through
+            # host numpy — trim/flatten are device ops and the scatter
+            # below consumes the same buffers
+            rows, cols_full = int(bits.shape[0]), int(bits.shape[1])
+            if n_rows is None:
+                n_rows = rows // w
+            itemsize = 4
+            dtype = np.dtype(np.uint32)
+            mono_bytes = rows * cols_full * itemsize
+            cols = self._trim_cols(dtype, cols_full, trim)
+            flat = (bits[:, :cols] if cols < cols_full else bits)
+            flat = flat.reshape(-1)
+        else:
+            arr = np.asarray(bits)
+            if n_rows is None:
+                n_rows = arr.shape[0] // w
+            rows, cols_full = int(arr.shape[0]), int(arr.shape[1])
+            itemsize = arr.dtype.itemsize
+            mono_bytes = rows * cols_full * itemsize
+            cols = self._trim_cols(arr.dtype, cols_full, trim)
+            if cols < cols_full:
+                arr = arr[:, :cols]
+            if np.dtype(arr.dtype) != np.uint32 and cols % 4:
+                # non-u32 rows must stay word-aligned in the flattened
+                # pool (gather addresses bit-rows as cols*itemsize//4
+                # words) — pad the row width up to whole words; `trim`
+                # keeps the true byte width for read()'s final slice
+                pad = 4 - cols % 4
+                arr = np.pad(np.asarray(arr), ((0, 0), (0, pad)))
+                cols += pad
+            dtype = np.dtype(arr.dtype)
+            flat = np.ascontiguousarray(arr).reshape(-1)
+            if flat.dtype != np.uint32:
+                flat = flat.view(np.uint32)  # rows % 4 == 0 (w >= 4)
+        total_words = rows * cols * itemsize // 4
         npages = max(1, -(-total_words // self.page_words))
         with self.perf.time_avg("unpack_s"), self._lock:
             self._remove_entry(key)
@@ -343,16 +527,20 @@ class PagedResidentStore:
                 self.perf.inc("evict")
                 self.perf.inc("page_evictions", freed)
             e = _Entry()
-            e.pages = []
-            off = 0
-            while off < total_words:
-                pid = self._alloc_page()
-                assert pid is not None  # _available_pages said so
-                n = min(self.page_words, total_words - off)
-                self._page(pid)[:n] = flat[off:off + n]
-                e.pages.append(pid)
-                off += n
-            e.dtype = arr.dtype
+            if self.device_arm:
+                e.pages = self._install_pages_locked(flat, total_words,
+                                                     from_device)
+            else:
+                e.pages = []
+                off = 0
+                while off < total_words:
+                    pid = self._alloc_page()
+                    assert pid is not None  # _available_pages said so
+                    n = min(self.page_words, total_words - off)
+                    self._page(pid)[:n] = flat[off:off + n]
+                    e.pages.append(pid)
+                    off += n
+            e.dtype = dtype
             e.rows = rows
             e.cols = cols
             e.itemsize = itemsize
@@ -398,6 +586,9 @@ class PagedResidentStore:
         span = e.pages[p0:p1]
         if any(p is None for p in span):
             return None
+        if self.device_arm:
+            return self._gather_device_locked(e, r0, r1, w0, w1, p0,
+                                              span)
         out = np.empty(w1 - w0, dtype=np.uint32)
         pos = 0
         for i, pid in enumerate(span):
@@ -410,6 +601,43 @@ class PagedResidentStore:
             pos += take
         if np.dtype(e.dtype) != np.uint32:
             return out.view(e.dtype).reshape(r1 - r0, e.cols)
+        return out.reshape(r1 - r0, e.cols)
+
+    def _gather_device_locked(self, e: _Entry, r0: int, r1: int,
+                              w0: int, w1: int, p0: int,
+                              span: List[int]):
+        """Device-arm gather (lock held): one take kernel per touched
+        sub-slab run, concatenated and sliced ON DEVICE.  The result is
+        a fresh device buffer (never a slab view) — it stays valid
+        across later donated installs and feeds the jitted decode path
+        without leaving HBM; the host exit is read()/ecutil's
+        ``_pack_rows`` (counted as ``d2h_gathers`` via note_d2h)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ceph_tpu.ops.slab import slab_gather
+
+        mask = (1 << _SLAB_SHIFT) - 1
+        parts = []
+        i = 0
+        while i < len(span):
+            s = span[i] >> _SLAB_SHIFT
+            idx = []
+            while i < len(span) and (span[i] >> _SLAB_SHIFT) == s:
+                idx.append(span[i] & mask)
+                i += 1
+            parts.append(slab_gather(self._dev_slab(s),
+                                     np.array(idx, dtype=np.int32)))
+        block = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        flat = block.reshape(-1)
+        start = w0 - p0 * self.page_words
+        out = flat[start:start + (w1 - w0)]
+        if np.dtype(e.dtype) != np.uint32:
+            # little-endian u32 -> byte planes: bitcast appends a
+            # trailing dim of 4 (LSB first), matching numpy .view on
+            # the LE hosts this runs on (itemsize is 1 here — the
+            # planes layout)
+            out = jax.lax.bitcast_convert_type(out, jnp.int8)
         return out.reshape(r1 - r0, e.cols)
 
     def gather_rows(self, key: Any, r0: int, r1: int):
@@ -518,9 +746,19 @@ class PagedResidentStore:
                             meta=meta, trim=rows.shape[1])
         return bits
 
+    def note_d2h(self) -> None:
+        """Count ONE device->host materialization at a declared exit
+        boundary (this module's read(); ecutil's ``_pack_rows``
+        callers).  No-op on the host arm — nothing left the device."""
+        if self.device_arm:
+            self.d2h_gathers += 1
+            self.perf.inc("d2h_gathers")
+
     def read(self, key: Any) -> Optional[np.ndarray]:
         """Pack the resident rows back to [n, B] uint8 host bytes; None
-        when absent or partial."""
+        when absent or partial.  On the device arm the gather feeds the
+        jitted unpack on device and np.asarray here is the single d2h
+        (the SLAB_IO_BOUNDARY exit)."""
         got = self.get_planar(key)
         if got is None:
             return None
@@ -538,6 +776,7 @@ class PagedResidentStore:
 
             with self.perf.time_avg("pack_s"):
                 out = np.asarray(from_planar(bits, w, n_rows))
+        self.note_d2h()
         return out if trim is None else out[:, :trim]
 
     # -- eviction ------------------------------------------------------------
@@ -694,6 +933,11 @@ class PagedResidentStore:
                 "partial_residents": partial,
                 "frag_saved_bytes": max(0, self.frag_saved_signed),
                 "monolithic_equiv_bytes": self._mono_bytes,
+                "device_arm": int(self.device_arm),
+                "device_slabs": self._device_slab_count(),
+                "h2d_installs": self.h2d_installs,
+                "device_installs": self.device_installs,
+                "d2h_gathers": self.d2h_gathers,
             }
 
     def stats(self) -> Dict[str, int]:
@@ -706,4 +950,9 @@ class PagedResidentStore:
                 "pages_used": self._pages_used,
                 "dirty_pages": self._dirty_page_count,
                 "frag_saved_bytes": self.frag_saved_signed,
-                "monolithic_equiv_bytes": self._mono_bytes}
+                "monolithic_equiv_bytes": self._mono_bytes,
+                "device_arm": int(self.device_arm),
+                "device_slabs": self._device_slab_count(),
+                "h2d_installs": self.h2d_installs,
+                "device_installs": self.device_installs,
+                "d2h_gathers": self.d2h_gathers}

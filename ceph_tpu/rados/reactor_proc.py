@@ -876,7 +876,13 @@ class ShmConnEndpoint:
         return await self.rx.read_exact(n)
 
     async def read_into(self, dest, n: int) -> None:
-        await self.rx.read_into(dest, n)
+        # rx half of the crossing's single copy: ring views gather into
+        # the caller's assembly buffer / install staging below the GIL
+        # (the drain() mirror) — no parent-side per-byte pass remains
+        await self.rx.read_into(dest, n, wp=self._wp)
+        if self._wp is not None and self._perf is not None:
+            self._perf.inc("native_rx_calls")
+            self._perf.inc("native_bytes", n)
 
     def complete_record_len(self):
         return self.rx.complete_record_len()

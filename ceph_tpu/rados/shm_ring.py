@@ -392,8 +392,15 @@ class ShmRingPipe:
         if pre_fill >= self.capacity - self._PUBLISH_CHUNK:
             self._ding()
 
-    async def read_into(self, dest, n: int) -> None:
-        """Consume exactly n bytes into dest (writable buffer)."""
+    async def read_into(self, dest, n: int, wp=None) -> None:
+        """Consume exactly n bytes into dest (writable buffer).  With a
+        wirepath module (``wp``) the ring views land in dest through
+        ONE released-GIL native gather per wait cycle — the consumer
+        sibling of send_gather's producer-side copy, and the last
+        parent-side per-byte pass on the rx plane when dest is the
+        frame assembly buffer / install staging.  Error paths (closed /
+        peer-closed ring) are identical with or without wp: the torn
+        ring raises before any partial-cycle accounting."""
         assert not self.producer
         mv = dest if isinstance(dest, memoryview) else memoryview(dest)
         if mv.ndim != 1 or mv.itemsize != 1:
@@ -414,9 +421,15 @@ class ShmRingPipe:
             take = min(avail, n - off)
             pos = tail % cap
             first = min(take, cap - pos)
-            mv[off:off + first] = data[pos:pos + first]
-            if take > first:
-                mv[off + first:off + take] = data[:take - first]
+            if wp is not None:
+                pieces = [data[pos:pos + first]]
+                if take > first:
+                    pieces.append(data[:take - first])
+                wp.wirepy_gather(pieces, mv[off:off + take])
+            else:
+                mv[off:off + first] = data[pos:pos + first]
+                if take > first:
+                    mv[off + first:off + take] = data[:take - first]
             self._set_tail(tail + take)
             self._consumer_ding(avail)
             off += take

@@ -19,6 +19,13 @@ check it statically:
   frame undecodable;
 - ``fixed-tail-default``: post-v1 FIXED messages must keep ALL fields
   defaulted (the truncated-tail rule instantiates ``cls()``);
+- ``slab-host-roundtrip``: a name bound from a slab gather
+  (``*.gather_rows(...)`` / ``slab_gather(...)``) may be a DEVICE array
+  on the pagestore's device arm; materializing it on the host
+  (``np.asarray`` / ``np.frombuffer`` / ``.copy()``) outside the
+  module's declared ``SLAB_IO_BOUNDARY`` helpers silently reintroduces
+  the per-read d2h the device arm exists to delete — declare the exit
+  or stay on device;
 - unparsable files are reported here (one family owns the syntax check).
 """
 
@@ -127,6 +134,102 @@ class _StructScanner(ast.NodeVisitor):
                         f"corrupt every frame downstream"))
 
 
+_SLAB_GATHER_ATTRS = {"gather_rows"}
+_SLAB_GATHER_NAMES = {"slab_gather"}
+_HOST_MATERIALIZERS = {"asarray", "frombuffer"}
+
+
+def _slab_boundary(tree: ast.Module) -> set:
+    """Module-level ``SLAB_IO_BOUNDARY = ("fn", ...)`` — the declared
+    host-exit helpers this module is allowed to materialize slab-gather
+    results in."""
+    names: set = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "SLAB_IO_BOUNDARY" \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) \
+                        and isinstance(elt.value, str):
+                    names.add(elt.value)
+    return names
+
+
+def _is_gather_call(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _SLAB_GATHER_ATTRS:
+        return True
+    return isinstance(f, ast.Name) and f.id in _SLAB_GATHER_NAMES
+
+
+class _SlabScanner(ast.NodeVisitor):
+    """codec/slab-host-roundtrip (see module docstring).  Purely
+    name-local: a gather result is tracked per enclosing function, and
+    only the three materializer shapes the device arm actually pays for
+    are flagged — no alias chasing, no cross-function flow."""
+
+    def __init__(self, relpath: str, boundary: set,
+                 findings: List[Finding]):
+        self.relpath = relpath
+        self.boundary = boundary
+        self.findings = findings
+        self._fn: List[str] = []
+        self._tainted: List[set] = []
+
+    def _visit_fn(self, node):
+        self._fn.append(node.name)
+        self._tainted.append(set())
+        self.generic_visit(node)
+        self._fn.pop()
+        self._tainted.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def _flag(self, node, what: str) -> None:
+        if self._fn and self._fn[-1] in self.boundary:
+            return
+        self.findings.append(Finding(
+            check="codec/slab-host-roundtrip", file=self.relpath,
+            line=node.lineno,
+            key=f"{self._fn[-1] if self._fn else '<module>'}"
+                f"@L{node.lineno}",
+            message=f"{what} on a slab-gather result outside the "
+                    f"declared SLAB_IO_BOUNDARY helpers — on the "
+                    f"device arm this is a hidden per-read d2h; keep "
+                    f"the result on device or declare the exit"))
+
+    def visit_Assign(self, node):
+        if self._tainted and _is_gather_call(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self._tainted[-1].add(tgt.id)
+        self.generic_visit(node)
+
+    def _arg_tainted(self, arg) -> bool:
+        if isinstance(arg, ast.Name) and self._tainted \
+                and arg.id in self._tainted[-1]:
+            return True
+        return _is_gather_call(arg)
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            recv = f.value
+            if f.attr in _HOST_MATERIALIZERS \
+                    and isinstance(recv, ast.Name) \
+                    and recv.id in ("np", "numpy") and node.args \
+                    and self._arg_tainted(node.args[0]):
+                self._flag(node, f"np.{f.attr}")
+            elif f.attr == "copy" and not node.args \
+                    and self._arg_tainted(recv):
+                self._flag(node, ".copy()")
+        self.generic_visit(node)
+
+
 def check(sources: List[Tuple[str, str]],
           wire_sources: Optional[List[Tuple[str, str]]] = None
           ) -> List[Finding]:
@@ -143,6 +246,7 @@ def check(sources: List[Tuple[str, str]],
             continue
         parsed.append((relpath, text))
         _StructScanner(relpath, findings).visit(tree)
+        _SlabScanner(relpath, _slab_boundary(tree), findings).visit(tree)
 
     # FIXED layout hygiene over the wire-declaring modules (or the
     # doctored override a test feeds in)

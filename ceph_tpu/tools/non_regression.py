@@ -95,6 +95,15 @@ def parse_args(argv=None):
     p.add_argument("--rebalance-seconds", type=float, default=20.0,
                    help="ceiling on each membership-cycle wait")
     p.add_argument("--rebalance-osds", type=int, default=4)
+    # pagestore slab-arm parity (CI): the writeback
+    # dirty->flush->evict->cold-re-read cycle run once per slab arm
+    # (CEPH_TPU_DEVICE_SLAB=1 child vs =0 child, same deterministic
+    # content), digests compared byte-for-byte — the device-arm
+    # byte-identity gate, FAILING on any divergence
+    p.add_argument("--device-parity", action="store_true")
+    p.add_argument("--device-parity-child", action="store_true",
+                   help="internal: one slab arm's writeback cycle "
+                        "(arm picked by CEPH_TPU_DEVICE_SLAB)")
     return p.parse_args(argv)
 
 
@@ -1583,6 +1592,171 @@ def run_rebalance(args) -> int:
     return asyncio.run(go())
 
 
+def run_device_parity_child(args) -> int:
+    """ONE slab arm's writeback lifecycle (the arm is whatever
+    CEPH_TPU_DEVICE_SLAB says when the store builds): deterministic
+    puts under cache_mode=writeback -> dirty pages -> agent flush ->
+    evict -> cold re-read, byte identity checked at every read, and a
+    ``DEVICE_PARITY {json}`` digest line for the parent to compare
+    across arms."""
+    import asyncio
+    import hashlib
+    import json
+    import os as _os
+
+    _os.environ["CEPH_TPU_FORCE_BATCH"] = "1"
+
+    from ceph_tpu.rados.vstart import Cluster
+    import ceph_tpu.rados.osd as osdmod
+
+    async def go() -> int:
+        conf = {"osd_auto_repair": False, "client_op_timeout": 60.0,
+                "osd_heartbeat_interval": 0.1,
+                "osd_hit_set_period": 0.5,
+                "osd_min_read_recency_for_promote": 1,
+                "osd_tier_agent_interval": 0.1,
+                "osd_tier_target_max_bytes": 8 << 20,
+                "osd_cache_target_full_ratio": 0.8,
+                "osd_tier_flush_age": 0.3}
+        cluster = Cluster(n_osds=3, conf=conf)
+        await cluster.start()
+        failures = []
+        digests = {}
+        snap = {}
+        try:
+            c = await cluster.client()
+            pool = await c.create_pool("devp", profile={
+                "plugin": "jerasure", "technique": "reed_sol_van",
+                "k": "2", "m": "1"})
+            store = osdmod.shared_planar_store()
+            if store is None or not hasattr(store, "dirty_items"):
+                print("FAIL paged planar store did not engage",
+                      file=sys.stderr)
+                return 1
+            await c.pool_set(pool, "cache_mode", "writeback")
+            for o in cluster.osds.values():
+                for _ in range(100):
+                    p = (o.osdmap.pools.get(pool) if o.osdmap else None)
+                    if p is not None and (getattr(p, "opts", {})
+                                          or {}).get("cache_mode") \
+                            == "writeback":
+                        break
+                    await asyncio.sleep(0.02)
+            # DETERMINISTIC content: both arms must produce the same
+            # bytes at every stage or the parent's digest compare fails
+            rng = np.random.default_rng(20260806)
+            blobs = {
+                f"wb{i}": rng.integers(
+                    0, 256, 120_000 + 4096 * i,
+                    dtype=np.uint8).tobytes()
+                for i in range(6)}
+            saw_dirty = False
+            for oid, data in blobs.items():
+                await c.put(pool, oid, data)
+                saw_dirty = saw_dirty or store.dirty_pages > 0
+            if not saw_dirty:
+                failures.append("writeback puts left no dirty pages")
+            for oid, want in blobs.items():
+                got = await c.get(pool, oid)
+                if got != want:
+                    failures.append(
+                        f"dirty resident read mismatch on {oid}")
+            for _ in range(200):
+                if not store.has_dirty():
+                    break
+                await asyncio.sleep(0.05)
+            if store.dirty_pages:
+                failures.append(
+                    f"dirty_pages {store.dirty_pages} never drained")
+            for o in cluster.osds.values():
+                if o._planar is not None:
+                    for oid in blobs:
+                        o._planar.drop(o._planar_key(pool, oid))
+            for oid, want in blobs.items():
+                got = await c.get(pool, oid, fadvise="dontneed")
+                if got != want:
+                    failures.append(
+                        f"post-flush cold read mismatch on {oid}")
+                digests[oid] = hashlib.sha256(got).hexdigest()
+            if hasattr(store, "page_stats"):
+                snap = store.page_stats()
+            await c.stop()
+        finally:
+            await cluster.stop()
+        print("DEVICE_PARITY " + json.dumps({
+            "digests": digests,
+            "device_arm": snap.get("device_arm", 0),
+            "device_slabs": snap.get("device_slabs", 0),
+            "h2d_installs": snap.get("h2d_installs", 0),
+            "device_installs": snap.get("device_installs", 0),
+            "d2h_gathers": snap.get("d2h_gathers", 0)}))
+        for f in failures:
+            print(f"FAIL {f}", file=sys.stderr)
+        return 1 if failures else 0
+
+    return asyncio.run(go())
+
+
+def run_device_parity(args) -> int:
+    """Slab-arm parity gate (CI), FAILING and runnable as one command:
+
+        python -m ceph_tpu.tools.non_regression --device-parity
+
+    Two children run the identical writeback cycle — one with
+    CEPH_TPU_DEVICE_SLAB=1 (jitted device-arm kernels; on a CPU-only
+    host they run on the jax-cpu backend, the exact device call
+    structure) and one with =0 (the r20 host-numpy arm, the fallback
+    when JAX has no device backend).  Every cold-re-read digest must
+    match across arms, the device child must actually have engaged the
+    device arm, and the host child must not have."""
+    import json
+    import subprocess
+
+    results = {}
+    for arm, env_val in (("device", "1"), ("host", "0")):
+        env = dict(os.environ)
+        env["CEPH_TPU_DEVICE_SLAB"] = env_val
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["CEPH_TPU_FORCE_BATCH"] = "1"
+        proc = subprocess.run(
+            [sys.executable, "-m", "ceph_tpu.tools.non_regression",
+             "--device-parity-child"],
+            env=env, capture_output=True, text=True, timeout=600)
+        sys.stderr.write(proc.stderr)
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if ln.startswith("DEVICE_PARITY ")), None)
+        if proc.returncode != 0 or line is None:
+            print(f"FAIL {arm}-arm child rc={proc.returncode}",
+                  file=sys.stderr)
+            print(proc.stdout[-2000:], file=sys.stderr)
+            return 1
+        results[arm] = json.loads(line[len("DEVICE_PARITY "):])
+    dev, host = results["device"], results["host"]
+    failures = []
+    if dev["digests"] != host["digests"]:
+        diff = [oid for oid in dev["digests"]
+                if dev["digests"].get(oid) != host["digests"].get(oid)]
+        failures.append(
+            f"device vs host arm cold-re-read digests diverge on "
+            f"{diff} — the byte-identity gate")
+    if not dev["device_arm"]:
+        failures.append("CEPH_TPU_DEVICE_SLAB=1 child did not engage "
+                        "the device arm")
+    if host["device_arm"]:
+        failures.append("CEPH_TPU_DEVICE_SLAB=0 child engaged the "
+                        "device arm")
+    if not (dev["h2d_installs"] + dev["device_installs"]):
+        failures.append("device arm recorded no installs (kernels "
+                        "never ran)")
+    print(f"device parity: {len(dev['digests'])} writeback objects "
+          f"byte-identical across slab arms; device arm "
+          f"slabs={dev['device_slabs']} h2d={dev['h2d_installs']} "
+          f"native={dev['device_installs']} d2h={dev['d2h_gathers']}")
+    for f in failures:
+        print(f"FAIL {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     if args.slow_ops:
@@ -1591,6 +1765,10 @@ def main(argv=None) -> int:
         return run_crash(args)
     if args.qos:
         return run_qos(args)
+    if args.device_parity:
+        return run_device_parity(args)
+    if args.device_parity_child:
+        return run_device_parity_child(args)
     if args.tier:
         return run_tier(args)
     if args.full:

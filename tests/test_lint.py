@@ -353,6 +353,51 @@ def test_codec_catches_fixed_field_hygiene():
     assert any(f.check == "codec/fixed-tail-default" for f in findings)
 
 
+def test_codec_catches_slab_host_roundtrip():
+    # np.asarray on a gather_rows-bound name outside the boundary
+    findings = codec.check([(
+        "fixture.py",
+        "import numpy as np\n"
+        "def serve(store, key):\n"
+        "    bits = store.gather_rows(key, 0, 8)\n"
+        "    return np.asarray(bits)\n")])
+    assert any(f.check == "codec/slab-host-roundtrip"
+               for f in findings)
+    # .copy() and the direct-call form are the same hidden d2h
+    findings = codec.check([(
+        "fixture.py",
+        "def serve(store, key):\n"
+        "    bits = store.gather_rows(key, 0, 8)\n"
+        "    return bits.copy()\n")])
+    assert any(f.check == "codec/slab-host-roundtrip"
+               for f in findings)
+    findings = codec.check([(
+        "fixture.py",
+        "import numpy as np\n"
+        "def serve(store, key):\n"
+        "    return np.frombuffer(store.gather_rows(key, 0, 8))\n")])
+    assert any(f.check == "codec/slab-host-roundtrip"
+               for f in findings)
+    # a declared SLAB_IO_BOUNDARY helper is the sanctioned exit
+    assert codec.check([(
+        "fixture.py",
+        "import numpy as np\n"
+        "SLAB_IO_BOUNDARY = (\"serve\",)\n"
+        "def serve(store, key):\n"
+        "    bits = store.gather_rows(key, 0, 8)\n"
+        "    return np.asarray(bits)\n")]) == []
+    # untainted names and device-side flow stay silent
+    assert codec.check([(
+        "fixture.py",
+        "import numpy as np\n"
+        "def serve(store, key, other):\n"
+        "    bits = store.gather_rows(key, 0, 8)\n"
+        "    decode(bits)\n"
+        "    return np.asarray(other)\n"
+        "def decode(bits):\n"
+        "    return bits\n")]) == []
+
+
 # -- baseline mechanics ------------------------------------------------------
 
 

@@ -370,10 +370,29 @@ class TestMembershipCluster:
                           osd_id=victim_id)
                 await osd.start()
                 cluster.osds[victim_id] = osd
-                await c.refresh_map()
-                info = c.osdmap.osds[victim_id]
+                # up flaps for a beat after the reboot: a peer's failure
+                # report about the KILLED instance can down the id until
+                # the new daemon's next ping rejoins it — poll to a
+                # deadline.  The sticky property (never auto-in) must
+                # hold at every observation along the way.
+                deadline = asyncio.get_event_loop().time() + 5.0
+                while True:
+                    await c.refresh_map()
+                    info = c.osdmap.osds[victim_id]
+                    assert not info.in_cluster
+                    if info.up or \
+                            asyncio.get_event_loop().time() > deadline:
+                        break
+                    await asyncio.sleep(0.1)
                 assert info.up and not info.in_cluster
                 await c.osd_in(victim_id)
+                # same flap window applies to the in-mark: a racing
+                # report-down clears it until the rejoin ping restores
+                # it (now off the admin-out list)
+                while not c.osdmap.osds[victim_id].in_cluster and \
+                        asyncio.get_event_loop().time() < deadline:
+                    await asyncio.sleep(0.1)
+                    await c.refresh_map()
                 assert c.osdmap.osds[victim_id].in_cluster
                 await c.stop()
             finally:

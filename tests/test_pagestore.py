@@ -589,3 +589,192 @@ class TestWritebackEndToEnd:
                 await cluster.stop()
 
         run(go())
+
+
+# -- device arm (jitted slab kernels on jax-cpu) ------------------------------
+
+
+def _fresh_slab_cache():
+    from ceph_tpu.ops import slab
+
+    slab._reset_for_tests()
+
+
+class TestDeviceArm:
+    """The pagestore's DEVICE arm forced onto the jax-cpu backend: the
+    exact jitted install/gather call structure a real device runs, with
+    byte-identity pinned against the host-numpy arm."""
+
+    WIDTHS = (100, 3000, 4096, 4128, 12256, 13)
+
+    def test_device_host_parity_ragged_tails(self):
+        """Satellite pin: non-page-multiple sizes round-trip through
+        the device arm's zero-padded ragged tail byte-identically to
+        the host arm, on every gather shape."""
+        _fresh_slab_cache()
+        host = PagedResidentStore(capacity_bytes=1 << 20,
+                                  page_bytes=4096, device=False)
+        dev = PagedResidentStore(capacity_bytes=1 << 20,
+                                 page_bytes=4096, device=True)
+        for i, B in enumerate(self.WIDTHS):
+            rows = _rows(6, B, seed=i)
+            for st in (host, dev):
+                st.admit(f"o{i}", rows, w=8, layout="packedbit")
+        for i in range(len(self.WIDTHS)):
+            h, d = host.read(f"o{i}"), dev.read(f"o{i}")
+            assert h is not None and d is not None
+            np.testing.assert_array_equal(h, d)
+            hg = host.gather_rows(f"o{i}", 8, 40)
+            dg = dev.gather_rows(f"o{i}", 8, 40)
+            np.testing.assert_array_equal(np.asarray(hg),
+                                          np.asarray(dg))
+        s = dev.stats()
+        assert s["device_arm"] == 1 and s["device_slabs"] >= 1
+        assert s["h2d_installs"] + s["device_installs"] >= len(self.WIDTHS)
+        assert s["d2h_gathers"] >= len(self.WIDTHS)
+        assert host.stats()["device_arm"] == 0
+
+    def test_device_planes_layout_parity(self):
+        """int8 planes residents ride the bitcast path on gathers."""
+        _fresh_slab_cache()
+        host = PagedResidentStore(capacity_bytes=1 << 20,
+                                  page_bytes=4096, device=False)
+        dev = PagedResidentStore(capacity_bytes=1 << 20,
+                                 page_bytes=4096, device=True)
+        rows = _rows(8, 3001, seed=21)
+        for st in (host, dev):
+            st.admit("pl", rows, w=8, layout="planes")
+        np.testing.assert_array_equal(host.read("pl"), dev.read("pl"))
+        np.testing.assert_array_equal(
+            np.asarray(host.gather_rows("pl", 8, 16)),
+            np.asarray(dev.gather_rows("pl", 8, 16)))
+
+    @pytest.mark.filterwarnings("ignore:.*[Dd]onat.*")
+    def test_donation_safety_gather_survives_later_install(self,
+                                                           monkeypatch):
+        """A gather result is a FRESH buffer: installs that later donate
+        the same sub-slab must not invalidate it (the jax-cpu backend
+        ignores donation but runs the identical call structure)."""
+        monkeypatch.setenv("CEPH_TPU_SLAB_DONATE", "1")
+        _fresh_slab_cache()
+        store = PagedResidentStore(capacity_bytes=1 << 20,
+                                   page_bytes=4096, device=True)
+        rows_a = _rows(3, 2048, seed=31)
+        store.admit("a", rows_a, w=8, layout="packedbit")
+        early = store.gather_rows("a", 0, 24)
+        early_np = np.asarray(early)  # materialize the pre-install view
+        # a burst of donated installs into the SAME sub-slab
+        for i in range(8):
+            store.admit(f"b{i}", _rows(3, 2048, seed=40 + i), w=8,
+                        layout="packedbit")
+        np.testing.assert_array_equal(np.asarray(early), early_np)
+        np.testing.assert_array_equal(store.read("a"), rows_a)
+
+    @pytest.mark.filterwarnings("ignore:.*[Dd]onat.*")
+    def test_install_racing_gather_same_subslab(self, monkeypatch):
+        """Threads hammering donated installs while readers gather a
+        pinned key on the same sub-slab: every gather must return the
+        pinned key's exact bytes (the lock sequences donation)."""
+        import threading
+
+        monkeypatch.setenv("CEPH_TPU_SLAB_DONATE", "1")
+        _fresh_slab_cache()
+        store = PagedResidentStore(capacity_bytes=1 << 20,
+                                   page_bytes=4096, device=True)
+        rows = _rows(3, 2048, seed=50)
+        store.admit("pin", rows, w=8, layout="packedbit")
+        want = store.read("pin")
+        errors = []
+        stop = threading.Event()
+
+        def installer():
+            i = 0
+            while not stop.is_set():
+                store.admit(f"w{i % 4}", _rows(3, 2048, seed=60 + i % 4),
+                            w=8, layout="packedbit")
+                i += 1
+
+        def reader():
+            while not stop.is_set():
+                got = store.read("pin")
+                if got is None or not np.array_equal(got, want):
+                    errors.append("torn read")
+                    return
+
+        threads = [threading.Thread(target=installer),
+                   threading.Thread(target=reader),
+                   threading.Thread(target=reader)]
+        for t in threads:
+            t.start()
+        import time as _time
+
+        _time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+
+    def test_device_dirty_flush_replay_identity(self):
+        """Writeback flush replay (planar_shard_bytes) off the device
+        arm is byte-identical to the host arm's — the flush path's
+        gather rides the same kernels as reads."""
+        _fresh_slab_cache()
+        host = PagedResidentStore(capacity_bytes=1 << 20,
+                                  page_bytes=4096, device=False)
+        dev = PagedResidentStore(capacity_bytes=1 << 20,
+                                 page_bytes=4096, device=True)
+        _dirty_install(host, seed=9)
+        _dirty_install(dev, seed=9)
+        for shard in range(3):
+            hb = planar_shard_bytes(host, "o", 7, shard)
+            db = planar_shard_bytes(dev, "o", 7, shard)
+            assert hb is not None and hb == db
+        info, gen = dev.peek_dirty("o")
+        assert dev.clear_dirty("o", gen)
+        assert dev.drop("o")
+
+    def test_device_shed_parity_data_keeps_serving(self):
+        _fresh_slab_cache()
+        from ceph_tpu.ops.gf2 import to_packedbit
+
+        dev = PagedResidentStore(capacity_bytes=1 << 20,
+                                 page_bytes=4096, device=True)
+        rows = _rows(3, 4096, seed=5)
+        bits = np.asarray(to_packedbit(rows))
+        assert dev.put_planar("o", bits, w=8, n_rows=3,
+                              meta=(1, 4096, 8192), trim=4096,
+                              data_rows=16)
+        assert dev.shed_parity("o") > 0
+        assert dev.get_planar("o") is None  # whole resident is partial
+        got = dev.gather_rows("o", 0, 16)  # data prefix still serves
+        assert got is not None
+        from ceph_tpu.ops.gf2 import from_packedbit
+
+        data = np.asarray(from_packedbit(got, 2))[:, :4096]
+        np.testing.assert_array_equal(data, rows[:2])
+
+    def test_device_native_install_from_queue_product(self):
+        """A jax-array (queue-shaped) input installs device-native —
+        no host bounce, counted as device_installs."""
+        _fresh_slab_cache()
+        from ceph_tpu.ops.gf2 import to_packedbit
+
+        dev = PagedResidentStore(capacity_bytes=1 << 20,
+                                 page_bytes=4096, device=True)
+        rows = _rows(3, 2048, seed=77)
+        bits = to_packedbit(rows)  # stays a jax array
+        assert dev.put_planar("q", bits, w=8, n_rows=3,
+                              meta=(1, 2048, 0), trim=2048)
+        assert dev.stats()["device_installs"] == 1
+        assert dev.stats()["h2d_installs"] == 0
+        np.testing.assert_array_equal(dev.read("q"), rows)
+
+    def test_env_override_pins_arms(self, monkeypatch):
+        monkeypatch.setenv("CEPH_TPU_DEVICE_SLAB", "0")
+        st = PagedResidentStore(capacity_bytes=1 << 20,
+                                page_bytes=4096, device=True)
+        assert not st.device_arm
+        monkeypatch.setenv("CEPH_TPU_DEVICE_SLAB", "1")
+        st = PagedResidentStore(capacity_bytes=1 << 20,
+                                page_bytes=4096, device=False)
+        assert st.device_arm

@@ -621,12 +621,22 @@ class TestTierEndToEnd:
                 for i in range(40):  # ~8 MB logical >> 2 MB target
                     blobs[f"o{i}"] = os.urandom(200_000)
                     await c.put(pool, f"o{i}", blobs[f"o{i}"])
-                await asyncio.sleep(0.6)  # several agent passes
+                # enforcement is on the agent cadence (0.1s passes, one
+                # at a time through the best-effort queue): poll to a
+                # deadline instead of a fixed sleep — a loaded host can
+                # leave the agent a pass behind at any fixed instant
+                async def settle():
+                    deadline = asyncio.get_event_loop().time() + 6.0
+                    while store.resident_bytes > target:
+                        if asyncio.get_event_loop().time() > deadline:
+                            break
+                        await asyncio.sleep(0.1)
+                await settle()
                 assert store.resident_bytes <= target, (
                     f"agent failed: {store.resident_bytes} > {target}")
                 for oid, blob in blobs.items():
                     assert await c.get(pool, oid) == blob
-                await asyncio.sleep(0.6)
+                await settle()
                 assert store.resident_bytes <= target
                 evicted = sum(o.tier_perf.get("agent_evict")
                               for o in cluster.osds.values())
