@@ -1770,11 +1770,22 @@ def e2e_device_bench() -> int:
             # connection warmup outside the windows
             await c.put(pool, "warm", b"x" * 4096)
 
-            # PUT window: encode + wire + install
+            # PUT window: encode + wire + install.  The slab kernels
+            # were pre-warmed at store build (osd_tier_slab_prewarm),
+            # so the compile-counter delta across the window is the
+            # AOT-discipline evidence: 0 in-line XLA compiles.
+            from ceph_tpu.ops.slab import SLAB_PERF
+            prewarmed = bool(getattr(store, "prewarmed", False))
+            c0 = SLAB_PERF.get("compile")
             t0 = time.perf_counter()
             for oid, blob in blobs.items():
                 await c.put(pool, oid, blob)
             put_dt = time.perf_counter() - t0
+            put_compiles = int(SLAB_PERF.get("compile") - c0)
+            if prewarmed:
+                assert put_compiles == 0, \
+                    f"{put_compiles} in-line slab compiles in the put " \
+                    f"window despite pre-warm"
 
             def resident(oid):
                 return any(o._planar is not None
@@ -1800,11 +1811,11 @@ def e2e_device_bench() -> int:
             pagestore = (store.page_stats()
                          if hasattr(store, "page_stats") else None)
             await c.stop()
-            return put_dt, read_dt, pagestore
+            return put_dt, read_dt, pagestore, prewarmed, put_compiles
         finally:
             await cluster.stop()
 
-    put_dt, read_dt, pagestore = asyncio.run(go())
+    put_dt, read_dt, pagestore, prewarmed, put_compiles = asyncio.run(go())
     put_bytes = n_hot * obj_size
     read_bytes = n_reads * obj_size
     arm = "device" if (pagestore or {}).get("device_arm") else "host"
@@ -1815,6 +1826,8 @@ def e2e_device_bench() -> int:
         "e2e_GBps": round((put_bytes + read_bytes)
                           / (put_dt + read_dt) / 1e9, 3),
         "put_bytes": put_bytes, "read_bytes": read_bytes,
+        "slab_prewarmed": prewarmed,
+        "put_window_compiles": put_compiles,
         "pagestore": pagestore}}))
     return 0
 
@@ -1909,9 +1922,88 @@ def tier_mixed_bench() -> int:
             await cluster.stop()
 
     stats, pagestore, residents = asyncio.run(go())
+
+    # -- same-window put-mode comparison: the replicated-writeback fast
+    # ack (raw object on a cache quorum, EC encode deferred to the
+    # background flush) vs the synchronous write-through shape (inline
+    # k+m encode + sub-write fan-out, ack at pool min_size).  Same
+    # cluster, same pool, same object size, distinct oid sets; the mode
+    # flips via the mon-validated `cache_mode` pool opt with per-OSD
+    # propagation polling so neither window straddles the switch.
+    put_obj = 256 << 10
+    n_put = 12
+
+    async def go_putmode():
+        cluster = Cluster(n_osds=4, conf={
+            "osd_auto_repair": False,
+            "client_op_timeout": 60.0,
+            "osd_hit_set_period": 30.0,
+            "osd_min_read_recency_for_promote": 1,
+            "osd_tier_promote_max_objects_sec": 256,
+            "osd_tier_promote_max_bytes_sec": 1 << 30,
+            # destage stays out of both measured windows; dropped for
+            # the drain below
+            "osd_tier_flush_age": 60.0,
+            "osd_tier_agent_interval": 0.2})
+        await cluster.start()
+        try:
+            c = await cluster.client()
+            pool = await c.create_pool("putmode", profile={
+                "plugin": "jerasure", "technique": "reed_sol_van",
+                "k": "2", "m": "1"})
+            store = osdmod.shared_planar_store()
+            rng = np.random.default_rng(7)
+            payloads: dict = {}
+            rates: dict = {}
+            for mode, prefix in (("writethrough", "wt"),
+                                 ("writeback", "wb")):
+                await c.pool_set(pool, "cache_mode", mode)
+                for _ in range(200):
+                    if all((getattr(o.osdmap.pools.get(pool), "opts",
+                                    {}) or {}).get("cache_mode") == mode
+                           for o in cluster.osds.values()):
+                        break
+                    await asyncio.sleep(0.02)
+                blobs = {f"{prefix}{i}": rng.integers(
+                    0, 256, put_obj, dtype=np.uint8).tobytes()
+                    for i in range(n_put)}
+                payloads.update(blobs)
+                await c.put(pool, f"{prefix}-warm", b"x" * 4096)
+                t0 = time.perf_counter()
+                for oid, blob in blobs.items():
+                    await c.put(pool, oid, blob)
+                dt = time.perf_counter() - t0
+                rates[mode] = n_put * put_obj / dt / 1e6
+            for oid, blob in payloads.items():  # acked-read identity
+                assert await c.get(pool, oid) == blob
+            # drain the fast-ack dirt (the deferred EC destage) before
+            # teardown, then re-verify the flushed bytes
+            for o in cluster.osds.values():
+                o.conf["osd_tier_flush_age"] = 0.1
+            for _ in range(300):
+                if store is None or not any(
+                        True for _k, _i, _g, _s in store.dirty_items()):
+                    break
+                await asyncio.sleep(0.05)
+            for oid, blob in payloads.items():
+                assert await c.get(pool, oid) == blob
+            await c.stop()
+            return rates
+        finally:
+            await cluster.stop()
+
+    rates = asyncio.run(go_putmode())
+    wb = rates.get("writeback", 0.0)
+    wt = rates.get("writethrough", 0.0)
+
     mono = int(stats.get("monolithic_equiv_bytes", 0))
     paged_bytes = int(stats.get("resident_bytes", 0))
     print(json.dumps({
+        "writeback_put_MBps": round(wb, 1),
+        "writethrough_put_MBps": round(wt, 1),
+        "writeback_vs_writethrough": round(wb / wt, 2) if wt else 0.0,
+        "put_window_objects": n_put,
+        "put_window_object_bytes": put_obj,
         "tier_mixed_objects": n_obj,
         "tier_mixed_residents_held": residents,
         "tier_mixed_capacity_bytes": capacity,

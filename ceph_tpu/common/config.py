@@ -368,6 +368,16 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
                 "cache_mode overrides): writethrough applies local "
                 "shards synchronously, writeback defers them to dirty "
                 "pages flushed by the agent"),
+    Option("osd_cache_min_size", OPT_INT, 2,
+           desc="writeback fast-ack quorum: a put acks once the raw "
+                "dirty object is committed on this many cache-tier "
+                "processes (primary + min_size-1 acting peers); fewer "
+                "live acting members falls back to synchronous "
+                "writethrough for that op"),
+    Option("osd_tier_slab_prewarm", OPT_BOOL, True,
+           desc="compile the paged store's device-arm install/gather "
+                "kernels for the configured page geometry (all pow2 row "
+                "buckets) at store build, off the put path"),
     Option("osd_cache_target_dirty_ratio", OPT_FLOAT, 0.4,
            desc="agent flushes dirty pages when dirty bytes exceed "
                 "this fraction of the tier target"),

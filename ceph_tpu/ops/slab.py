@@ -193,6 +193,27 @@ def slab_gather(slab, idx: np.ndarray):
     return out if nb == n else out[:n]
 
 
+def prewarm(page_words: int, max_rows: int = 256) -> int:
+    """Compile the install/gather kernels for every pow2 row bucket up
+    to ``max_rows`` (one sub-slab's worth) at store build, OFF the put
+    path — the AOT discipline: the put window must never pay an in-line
+    XLA compile for a geometry the configured page size makes
+    inevitable.  Chained through one scratch sub-slab so donation stays
+    exercised exactly as the live path will.  Returns the number of
+    kernels compiled (0 when everything was already cached)."""
+    before = SLAB_PERF.get("compile")
+    slab = new_subslab(max_rows, page_words)
+    nb = 1
+    while nb <= max_rows:
+        idx = np.arange(nb, dtype=np.int32) % max_rows
+        data = jnp.zeros((nb, page_words), dtype=jnp.uint32)
+        slab = slab_install(slab, data, idx)
+        jax.block_until_ready(slab_gather(slab, idx))
+        nb <<= 1
+    jax.block_until_ready(slab)
+    return int(SLAB_PERF.get("compile") - before)
+
+
 def new_subslab(n_pages: int, page_words: int):
     """A zeroed device sub-slab.  Zeroing (vs uninitialized) costs one
     fill but makes the ragged install tail well-defined: the flat page

@@ -80,6 +80,7 @@ from ceph_tpu.rados.peering import (
     PGMachine,
     ReservationSlots,
 )
+from ceph_tpu.rados.pagestore import CacheDirtyRecord
 from ceph_tpu.rados.pglog import ZERO, LogEntry, PGLog, pack_eversion
 from ceph_tpu.rados.qos import (QosParams, QosTracker, build_scheduler_perf,
                                 pool_qos, primary_spread, qos_op_cost,
@@ -87,6 +88,7 @@ from ceph_tpu.rados.qos import (QosParams, QosTracker, build_scheduler_perf,
 from ceph_tpu.rados.scheduler import (
     CLASS_BEST_EFFORT,
     CLASS_CLIENT,
+    CLASS_FLUSH,
     CLASS_REBALANCE,
     CLASS_RECOVERY,
     CLASS_SCRUB,
@@ -105,6 +107,8 @@ from ceph_tpu.rados.types import (
     MAuthTicketReply,
     MBackfillReserve,
     MBackfillReserveReply,
+    MCacheDirty,
+    MCacheDirtyAck,
     MCommand,
     MCommandReply,
     MCrashReportAck,
@@ -216,7 +220,8 @@ _PLANAR_STORE = None
 
 def shared_planar_store(capacity_bytes: int = 0, page_bytes: int = 0,
                         paged: Optional[bool] = None,
-                        device: Optional[bool] = None):
+                        device: Optional[bool] = None,
+                        prewarm: bool = False):
     """The process-wide resident store behind the cache tier.  Engages
     under the same conditions as the batching queue — an accelerator
     backend (or CEPH_TPU_FORCE_BATCH=1 for CPU tests); None otherwise.
@@ -252,7 +257,7 @@ def shared_planar_store(capacity_bytes: int = 0, page_bytes: int = 0,
                 _PLANAR_STORE = PagedResidentStore(
                     capacity_bytes=capacity_bytes or (256 << 20),
                     page_bytes=page_bytes or (64 << 10), queue=queue,
-                    device=device)
+                    device=device, prewarm=prewarm)
             else:
                 from ceph_tpu.parallel.service import PlanarShardStore
 
@@ -508,7 +513,8 @@ class OSD:
                 # None = auto (device arm iff a real backend is live);
                 # an explicit false config pins the host arm
                 device=(None if self.conf.get("osd_tier_device_slab",
-                                              True) else False))
+                                              True) else False),
+                prewarm=bool(self.conf.get("osd_tier_slab_prewarm", True)))
             if self.conf.get("osd_ec_planar_residency", True) else None)
         # cache-tier policy state (ceph_tpu/rados/tiering.py): per-PG
         # bloom hit-set archives, the promotion rate throttle, and the
@@ -533,6 +539,10 @@ class OSD:
         # promotions in flight, keyed by planar key: N hot reads racing
         # before the first install must fund ONE encode, not N
         self._promoting: Set[Tuple[int, int, str]] = set()
+        # fast-ack raw destage single-flight: a key being flushed by
+        # one plane (agent / fence / recovery replay) must not be
+        # re-encoded concurrently by another
+        self._raw_flush_inflight: Set[Tuple[int, int, str]] = set()
         # EC data-plane observability: ONE `perf dump` on this daemon
         # carries the whole pipeline breakdown — the messenger's `wire`
         # set (framing vs socket io), the shared queue's `ec_tpu` set
@@ -756,6 +766,19 @@ class OSD:
         await self.op_queue.stop()
         await self.ctx.shutdown()
         await self.messenger.shutdown()
+        if self._planar is not None:
+            # the shared store is process-global but keys are namespaced
+            # per OSD: a stopped daemon's residents — dirty fast-ack
+            # copies included — are process memory that a real dead OSD
+            # loses, so drop them (kill_osd honesty: a revived id must
+            # re-earn its pages, and surviving replicas' copies are the
+            # ONLY cache-tier copies of its acked writebacks)
+            snap = getattr(self._planar, "entries_snapshot", None)
+            if snap is not None:
+                for key, _nb in snap():
+                    if isinstance(key, tuple) and key \
+                            and key[0] == self.osd_id:
+                        self._planar.drop(key, force=True)
         close = getattr(self.store, "close", None)
         if close is not None:
             close()
@@ -1367,6 +1390,8 @@ class OSD:
                 raise
         elif isinstance(msg, MECSubWrite):
             await self._handle_sub_write(msg)
+        elif isinstance(msg, MCacheDirty):
+            await self._handle_cache_dirty(msg)
         elif isinstance(msg, MECSubRead):
             await self._handle_sub_read(msg)
         elif isinstance(msg, MECSubDelete):
@@ -1455,7 +1480,7 @@ class OSD:
         elif isinstance(
             msg, (MECSubWriteReply, MECSubReadReply, MListShardsReply,
                   MFetchShardsReply, MPGInfoReply, MPGLogReply,
-                  MScrubShardReply, MBackfillReserveReply)
+                  MScrubShardReply, MBackfillReserveReply, MCacheDirtyAck)
         ):
             q = self._collectors.get(msg.tid)
             if q is not None:
@@ -1588,6 +1613,11 @@ class OSD:
         # data" means a demoted primary may not keep deferred local
         # applies parked in HBM pages
         self._tier_flush_demoted()
+        # fast-ack replay sweep: raw dirty copies whose recorded primary
+        # is no longer this PG's primary either flush HERE (we inherited
+        # primaryship — complete the dead primary's deferred destage) or
+        # get pushed to the new primary (we hold a replica copy it needs)
+        self._tier_raw_replay_sweep()
         # primaryship may have moved: cached decodes can silently go stale
         # across an interval we didn't serve (ExtentCache is per-interval)
         self._extent_cache.clear()
@@ -3092,7 +3122,7 @@ class OSD:
             _ps = self._paged_store()
             if _ps is not None \
                     and _ps.is_dirty(self._planar_key(op.pool_id, op.oid)):
-                if self._tier_flush_key(
+                if await self._tier_flush_any(
                         self._planar_key(op.pool_id, op.oid)):
                     self.tier_perf.inc("flush_rmw")
                 else:
@@ -3167,6 +3197,20 @@ class OSD:
         install = self._tier_write_install(op, pool, pg, acting,
                                            len(data),
                                            full=chunk_off < 0)
+        if install == "writeback" and chunk_off < 0:
+            # replicated-writeback fast ack: commit the RAW object on
+            # the cache quorum (our dirty pages + osd_cache_min_size-1
+            # acting peers' adopted copies) and ack NOW — the k+m
+            # encode and the sub-write fan-out move wholesale into the
+            # flush path (_tier_flush_raw_key).  None = quorum short /
+            # store refusal: fall through to the synchronous
+            # write-through shape below, counted wb_quorum_short.
+            fast = await self._tier_fast_ack_write(
+                op, pool, pg, acting, data, object_size, span, mark)
+            if fast is not None:
+                span.finish()
+                return fast
+            install = "clean"
         mark("ec_encode_dispatched")
         if install is not None and self._planar is not None \
                 and chunk_off < 0:
@@ -3477,6 +3521,14 @@ class OSD:
                             self._planar_key(op.pool_id, op.oid),
                             ent.object_version, k,
                             self._sinfo(pool).chunk_size, meta[2])
+                        if data is None:
+                            # raw fast-ack resident (w=0, whole-object
+                            # bytes, no planar rows): the memo inside
+                            # planar_object_bytes missed — gather the
+                            # object straight off the page table
+                            rr = getattr(self._planar, "read_raw", None)
+                            data = rr(self._planar_key(
+                                op.pool_id, op.oid)) if rr else None
                         if data is not None:
                             self.perf.inc("planar_read_hits")
                             self.tier_perf.inc("resident_hit")
@@ -4721,8 +4773,26 @@ class OSD:
                     # missing ack at the primary), never mutate
                     ok = False
                     enospc = True
-                # another primary wrote this object: cached decode is stale
-                self._cache_drop(msg.pool_id, msg.oid)
+                # another primary wrote this object: cached decode is
+                # stale.  EXCEPTION: an adopted raw fast-ack copy at (or
+                # past) this sub-write's version IS the cache-tier
+                # durability of an ACKED write — this sub-write is that
+                # write's own flush landing, and force-dropping the copy
+                # here would reopen the acked-data-loss window the
+                # replication closed (primary dies mid-flush).  The copy
+                # is released only by the owner's post-flush clear.
+                self._extent_cache.drop((msg.pool_id, msg.oid))
+                _pkey = self._planar_key(msg.pool_id, msg.oid)
+                _spare = False
+                _ps = self._paged_store()
+                if _ps is not None:
+                    _snap = _ps.peek_dirty(_pkey)
+                    if _snap is not None \
+                            and isinstance(_snap[0], CacheDirtyRecord) \
+                            and _snap[0].version >= msg.version:
+                        _spare = True
+                if not _spare and self._planar is not None:
+                    self._planar.drop(_pkey, force=True)
                 # ONE event per outcome: an ENOSPC refusal must not also
                 # count as a splice/crc refusal in the op timeline
                 tracked.mark_event("applied" if ok
@@ -4776,6 +4846,11 @@ class OSD:
             # primary reconstructs from other shards (the behavior
             # qa/standalone/erasure-code/test-erasure-eio.sh exercises)
             got = None
+        _ps = self._paged_store()
+        if _ps is not None:
+            _snap = _ps.peek_dirty(self._planar_key(msg.pool_id, msg.oid))
+            if _snap is not None and isinstance(_snap[0], CacheDirtyRecord):
+                got = await self._raw_subread_fence(msg, _snap[0], got)
         got = self._dirty_subread_fence(msg, got)
         if got is None:
             reply = MECSubReadReply(tid=msg.tid, shard=msg.shard, ok=False)
@@ -5362,6 +5437,11 @@ class OSD:
         if snap is None:
             return True
         info, gen = snap
+        if isinstance(info, CacheDirtyRecord):
+            # raw fast-ack record: no deferred shard applies to replay —
+            # only the async destage plane (_tier_flush_raw_key) may
+            # move it (it owns the encode and the k+m fan-out)
+            return False
         einfo = store.entry_info(pkey)
         if einfo is None or not einfo[2] or einfo[2][0] != info.version:
             return False  # raced a re-install; the new dirt flushes later
@@ -5429,6 +5509,8 @@ class OSD:
         now = time.monotonic()
         dirty_target = int(target * ratio)
         for key, _info, _gen, since in self._my_dirty_items(store):
+            if isinstance(_info, CacheDirtyRecord):
+                continue  # raw records destage via _tier_flush_raw_pass
             over = store.dirty_bytes > dirty_target
             aged = age > 0 and (now - since) >= age
             if not (forced or over or aged):
@@ -5454,6 +5536,8 @@ class OSD:
         if snap is None or snap[0] is None:
             return got
         rec = snap[0]
+        if isinstance(rec, CacheDirtyRecord):
+            return got  # raw record: _raw_subread_fence already ran
         if msg.shard not in rec.shards:
             return got
         if got is not None and got[1].version >= rec.version:
@@ -5481,6 +5565,11 @@ class OSD:
             if pool is None:
                 store.drop(key, force=True)  # pool gone: data gone too
                 continue
+            if isinstance(info, CacheDirtyRecord):
+                # raw fast-ack dirt moves by REPLICATION, not local
+                # flush: _tier_raw_replay_sweep (same map hook) pushes
+                # the copy to the new primary / destages inherited dirt
+                continue
             if info.pg >= pool.pg_num:
                 if self._tier_flush_key(key):
                     self.tier_perf.inc("flush_demote")
@@ -5491,6 +5580,572 @@ class OSD:
                     self.tier_perf.inc("flush_demote")
                 else:
                     self.tier_perf.inc("flush_error")
+
+    # -- replicated-writeback fast ack (r22): a full-object put under
+    #    cache_mode writeback commits the RAW object on a cache quorum
+    #    (primary dirty pages + osd_cache_min_size-1 acting peers'
+    #    adopted copies, MCacheDirty/MCacheDirtyAck) and acks there; the
+    #    k+m encode and sub-write fan-out run later as a classed
+    #    background op (CLASS_FLUSH).  Primary death before flush is
+    #    recovered by the new primary replaying the freshest replica
+    #    copy (_tier_raw_replay_sweep) and completing the destage. ----
+
+    async def _tier_fast_ack_write(self, op: MOSDOp, pool: PoolInfo,
+                                   pg: int, acting: List[int], data,
+                                   object_size: int, span,
+                                   mark) -> Optional[MOSDOpReply]:
+        """The fast-ack put: install the raw dirty object locally,
+        replicate it to the first cache_min_size-1 live acting peers,
+        ack at that quorum.  None = the quorum cannot form or the store
+        refused — the caller falls back to synchronous write-through
+        (the degradation contract, counted wb_quorum_short)."""
+        store = self._paged_store()
+        if store is None:
+            return None
+        cache_min = max(1, self._tier_opt(pool, "cache_min_size", 2, int))
+        peers: List[int] = []
+        for osd in acting:
+            if osd in (CRUSH_ITEM_NONE, self.osd_id) or osd in peers:
+                continue  # pg_to_acting already holed-out down members
+            peers.append(osd)
+        peers = peers[:cache_min - 1]
+        if len(peers) < cache_min - 1:
+            self.tier_perf.inc("wb_quorum_short")
+            return None
+        # failsafe BEFORE any mutation (the _apply_shard_write rule): a
+        # put whose eventual flush could not land must refuse now, not
+        # wedge as unflushable dirt
+        if self._failsafe_full(object_size):
+            return None
+        raw = bytes(data)
+        pkey = self._planar_key(op.pool_id, op.oid)
+        log = self._pglog(op.pool_id, pg)
+        # synchronous window: eversion -> raw install -> log txn with
+        # no awaits, the same discipline as the EC path — a concurrent
+        # log merge cannot advance the head under a version we already
+        # handed out
+        entry = LogEntry(version=log.next_version(self.osdmap.epoch),
+                         op="write", oid=op.oid, prior_version=log.head,
+                         reqid=op.reqid)
+        version = pack_eversion(entry.version)
+        entry.object_version = version
+        entry.cache_peers = (self.osd_id,) + tuple(peers)
+        rec = CacheDirtyRecord(
+            pool_id=op.pool_id, oid=op.oid, pg=pg, version=version,
+            object_size=object_size, primary=self.osd_id,
+            peers=(self.osd_id,) + tuple(peers))
+        if not store.put_raw(pkey, raw, meta=(version, -1, object_size),
+                             dirty_info=rec):
+            self.tier_perf.inc("wb_quorum_short")
+            return None  # paged pool refused: write-through instead
+        entry_blob = entry.encode()
+        txn = Transaction()
+        self._log_in_txn(txn, op.pool_id, pg, entry)
+        self.store.queue_transaction(txn)
+        store.memo_put(pkey, version, raw)
+        span.event("raw dirty installed")
+        mark("wb_raw_installed")
+        tid = uuid.uuid4().hex
+        q = self._collector(tid)
+        sends = []
+        for osd in peers:
+            sends.append(self.messenger.send(
+                self.osdmap.addr_of(osd),
+                MCacheDirty(
+                    pool_id=op.pool_id, pg=pg, oid=op.oid, op="install",
+                    data=raw, version=version, object_size=object_size,
+                    tid=tid, reply_to=self.addr, log_entry=entry_blob,
+                    peers=list(rec.peers), from_osd=self.osd_id,
+                    epoch=self.osdmap.epoch)))
+        sent = 0
+        for got in await asyncio.gather(*sends, return_exceptions=True):
+            if got is None:
+                sent += 1
+            elif not isinstance(got, TRANSPORT_ERRORS):
+                raise got
+        mark("cache_repl_sent")
+        replies = await self._gather(tid, q, sent)
+        acks = 1 + sum(1 for r in replies if r.ok)  # self + adopters
+        span.event(f"cache quorum {acks}/{cache_min}")
+        if acks < cache_min:
+            # an adopter refused or died mid-replication: the raw copy
+            # is NOT on cache_min_size processes, so the fast ack's
+            # durability claim does not hold.  Degrade THIS op to the
+            # synchronous bar: destage the EC shards inline and ack only
+            # if that lands at pool min_size (write-through durability).
+            self.tier_perf.inc("wb_quorum_short")
+            if await self._tier_flush_raw_key(pkey):
+                self._cache_put(op.pool_id, op.oid, version, raw)
+                mark("wb_inline_flushed")
+                return MOSDOpReply(ok=True)
+            self._mark_failed_write(op.reqid)
+            self._cache_drop(op.pool_id, op.oid)
+            self._tier_raw_clear_peers(rec)
+            return MOSDOpReply(
+                ok=False, code=-errno.EBUSY,
+                error=f"writeback acked by {acks} < cache min_size "
+                      f"{cache_min} and inline flush failed")
+        self.tier_perf.inc("wb_repl_acks")
+        self.tier_perf.inc("wb_repl_bytes", len(raw) * len(peers))
+        self._update_flush_backlog()
+        self._cache_put(op.pool_id, op.oid, version, raw)
+        mark("wb_acked")
+        return MOSDOpReply(ok=True)
+
+    async def _handle_cache_dirty(self, msg: MCacheDirty) -> None:
+        """Receiver half of the fast-ack pair.  op=install adopts the
+        raw dirty copy (pages + memo + the PG log entry — the durability
+        unit the ack claims); op=clear is the owner's post-flush (or
+        failed-write) release, version-fenced so a newer adopted copy
+        keeps its dirt.  An install landing on the PG's CURRENT primary
+        from a non-primary sender is a recovery push: adopt, then
+        complete the dead installer's deferred destage."""
+        store = self._paged_store()
+        pkey = self._planar_key(msg.pool_id, msg.oid)
+        if msg.op == "clear":
+            if store is not None:
+                snap = store.peek_dirty(pkey)
+                if snap is not None \
+                        and isinstance(snap[0], CacheDirtyRecord) \
+                        and snap[0].version <= msg.version:
+                    store.clear_dirty(pkey, snap[1])
+                    store.drop(pkey, force=True)
+                self._update_flush_backlog()
+            return
+        ok = store is not None and self.osdmap is not None
+        recovery_push = False
+        if ok:
+            # interval fence (the _apply_sub_write rule): catch up when
+            # the sender's map is newer, refuse a deposed sender
+            if msg.epoch > self.osdmap.epoch:
+                await self._fetch_full_map()
+            pool = self.osdmap.pools.get(msg.pool_id)
+            if pool is None:
+                ok = False
+            else:
+                acting = self.osdmap.pg_to_acting(pool, msg.pg)
+                prim = self._primary(pool, msg.pg, acting)
+                if prim == self.osd_id and msg.from_osd != self.osd_id:
+                    recovery_push = True
+                elif prim not in (msg.from_osd, None):
+                    ok = False
+        if ok:
+            cur = store.resident_meta(pkey)
+            if cur and cur[0] >= msg.version:
+                # duplicate / stale push: our copy is already at (or
+                # past) this version — adopting would rewind.  Ack ok:
+                # the sender's durability claim holds either way.
+                pass
+            else:
+                raw = as_bytes(msg.data)
+                peers = tuple(int(x) for x in (msg.peers or ()))
+                rec = CacheDirtyRecord(
+                    pool_id=msg.pool_id, oid=msg.oid, pg=msg.pg,
+                    version=msg.version, object_size=msg.object_size,
+                    primary=(self.osd_id if recovery_push
+                             else msg.from_osd),
+                    peers=peers or (msg.from_osd, self.osd_id))
+                if store.put_raw(pkey, raw,
+                                 meta=(msg.version, -1, msg.object_size),
+                                 dirty_info=rec):
+                    if msg.log_entry:
+                        entry = LogEntry.decode(msg.log_entry)
+                        entry.version = tuple(entry.version)
+                        entry.prior_version = tuple(entry.prior_version)
+                        txn = Transaction()
+                        self._log_in_txn(txn, msg.pool_id, msg.pg, entry)
+                        self.store.queue_transaction(txn)
+                    store.memo_put(pkey, msg.version, raw)
+                    # a stale decode of the OLD version must die, but
+                    # NOT the raw pages we just installed — so the
+                    # extent cache only, never _cache_drop
+                    self._extent_cache.drop((msg.pool_id, msg.oid))
+                    self.tier_perf.inc("wb_dirty_adopted")
+                    self._update_flush_backlog()
+                else:
+                    ok = False
+        if msg.tid:
+            try:
+                await self.messenger.send(
+                    tuple(msg.reply_to),
+                    MCacheDirtyAck(tid=msg.tid, osd=self.osd_id, ok=ok))
+            except TRANSPORT_ERRORS:
+                pass
+        if ok and recovery_push:
+            # we are the PG's new primary holding a pushed copy of a
+            # dead primary's acked write: finish its flush
+            self._spawn_tier_task(self._tier_flush_raw_key(pkey))
+
+    def _tier_raw_clear_peers(self, rec: CacheDirtyRecord) -> None:
+        """Fire-and-forget release of the peers' adopted copies (post
+        flush, or failed-write cleanup).  Version-fenced at the
+        receiver; a lost clear is mopped up by the adopted-copy GC in
+        _tier_flush_raw_pass."""
+        if self.osdmap is None:
+            return
+
+        async def _clear_one(osd: int) -> None:
+            try:
+                await self.messenger.send(
+                    self.osdmap.addr_of(osd),
+                    MCacheDirty(pool_id=rec.pool_id, pg=rec.pg,
+                                oid=rec.oid, op="clear",
+                                version=rec.version,
+                                from_osd=self.osd_id,
+                                epoch=self.osdmap.epoch))
+            except TRANSPORT_ERRORS:
+                pass
+
+        for osd in rec.peers:
+            if osd == self.osd_id or osd not in self.osdmap.osds:
+                continue
+            self._spawn_tier_task(_clear_one(osd))
+
+    async def _tier_flush_any(self, pkey) -> bool:
+        """Route one dirty resident to its flush plane: raw fast-ack
+        records destage through the async encode+fan-out path, legacy
+        WritebackRecords replay synchronously.  The one entry point for
+        the RMW / scrub fences (both async contexts)."""
+        store = self._paged_store()
+        if store is None:
+            return True
+        snap = store.peek_dirty(pkey)
+        if snap is None:
+            return True
+        if isinstance(snap[0], CacheDirtyRecord):
+            return await self._tier_flush_raw_key(pkey)
+        return self._tier_flush_key(pkey)
+
+    async def _tier_flush_raw_key(self, pkey,
+                                  background: bool = False) -> bool:
+        """Destage one raw fast-ack record: k+m encode the raw object,
+        fan the sub-writes out exactly as the write path would have, and
+        clear the dirt at pool min_size acks.  Generation-tokened like
+        _tier_flush_key: an overwrite that re-installed mid-encode keeps
+        ITS dirt (we simply stop owning the flush).  False leaves the
+        entry dirty for the next pass."""
+        store = self._paged_store()
+        if store is None:
+            return True
+        if pkey in self._raw_flush_inflight:
+            return False  # single-flight: another plane is destaging
+        snap = store.peek_dirty(pkey)
+        if snap is None:
+            return True
+        rec, gen = snap
+        if not isinstance(rec, CacheDirtyRecord):
+            return self._tier_flush_key(pkey)
+        if self.osdmap is None:
+            return False
+        pool = self.osdmap.pools.get(rec.pool_id)
+        if pool is None or rec.pg >= pool.pg_num:
+            store.drop(pkey, force=True)  # pool gone: data gone too
+            return True
+        acting = self.osdmap.pg_to_acting(pool, rec.pg)
+        if self._primary(pool, rec.pg, acting) != self.osd_id:
+            return False  # not ours: the replay sweep routes it
+        # PG-log-head defense (the _tier_flush_key rule): a record the
+        # log moved past must never stamp old bytes over newer shards
+        ent = self._pglog(rec.pool_id, rec.pg).latest_entry(rec.oid)
+        if ent is not None and (ent.op != "write"
+                                or ent.object_version != rec.version):
+            # superseded (newer write / delete landed): the dirt is moot
+            store.clear_dirty(pkey, gen)
+            store.drop(pkey, force=True)
+            self._update_flush_backlog()
+            return True
+        # ent None (trimmed window) still flushes: the record itself is
+        # the durability contract, the entry just rides along when held
+        data = store.memo_get(pkey, rec.version)
+        if data is None:
+            data = store.read_raw(pkey)
+        if data is None:
+            return False  # raced a drop/re-install; next pass re-peeks
+        self._raw_flush_inflight.add(pkey)
+        try:
+            return await self._tier_flush_raw_inner(
+                pkey, store, rec, gen, pool, acting, ent, bytes(data),
+                background)
+        finally:
+            self._raw_flush_inflight.discard(pkey)
+
+    async def _tier_flush_raw_inner(self, pkey, store,
+                                    rec: CacheDirtyRecord, gen: int,
+                                    pool: PoolInfo, acting: List[int],
+                                    ent, data: bytes,
+                                    background: bool) -> bool:
+        if background:
+            # classed background op: the destage waits its dmClock turn
+            # under CLASS_FLUSH (above best_effort — the backlog holds
+            # acked client data), cost scaled to the encode size
+            await self._background_throttle(
+                CLASS_FLUSH, (rec.pool_id << 20) | rec.pg,
+                cost=max(1, len(data) // 65536))
+        codec = self._codec(pool)
+        sinfo = self._sinfo(pool)
+        planar = await planar_encode_async(codec, sinfo, data,
+                                           queue=self._ec_queue)
+        if planar is not None:
+            blobs = planar[0]
+        else:
+            blobs = await batched_encode_async(codec, sinfo, data,
+                                               queue=self._ec_queue)
+        # revalidate after the awaits: an overwrite that re-installed
+        # mid-encode owns the dirt now (gen moved), and a map change may
+        # have deposed us (the sweep re-routes)
+        snap = store.peek_dirty(pkey)
+        if snap is None or snap[1] != gen:
+            return True  # superseded: this flush is no longer needed
+        acting = self.osdmap.pg_to_acting(pool, rec.pg)
+        if self._primary(pool, rec.pg, acting) != self.osd_id:
+            return False
+        n = codec.get_chunk_count()
+        shard_crcs = [shard_crc(blobs[i]) for i in range(n)]
+        hinfo_blob = self._hinfo_for(pool, blobs, crcs=shard_crcs)
+        entry_blob = ent.encode() if ent is not None else b""
+        self.tier_perf.inc("flush_encodes")
+        tid = uuid.uuid4().hex
+        local_ok = 0
+        remote: List[Tuple[int, int]] = []
+        for shard, osd in enumerate(acting):
+            if osd == CRUSH_ITEM_NONE:
+                continue
+            if osd == self.osd_id:
+                try:
+                    if self._apply_shard_write(
+                            rec.pool_id, rec.oid, shard,
+                            memoryview(np.ascontiguousarray(blobs[shard])),
+                            rec.version, rec.object_size, pg=rec.pg,
+                            entry=ent, hinfo=hinfo_blob,
+                            chunk_crc=shard_crcs[shard]):
+                        local_ok += 1
+                except ENOSPCError:
+                    return False
+            else:
+                remote.append((shard, osd))
+        q = self._collector(tid)
+        sends = []
+        for shard, osd in remote:
+            chunk = memoryview(np.ascontiguousarray(blobs[shard]))
+            sends.append(self.messenger.send(
+                self.osdmap.addr_of(osd),
+                MECSubWrite(
+                    pool_id=rec.pool_id, pg=rec.pg, oid=rec.oid,
+                    shard=shard, chunk=chunk, version=rec.version,
+                    object_size=rec.object_size,
+                    chunk_crc=shard_crcs[shard], tid=tid,
+                    reply_to=self.addr, log_entry=entry_blob,
+                    hinfo=hinfo_blob, from_osd=self.osd_id,
+                    epoch=self.osdmap.epoch)))
+        sent = 0
+        for got in await asyncio.gather(*sends, return_exceptions=True):
+            if got is None:
+                sent += 1
+            elif not isinstance(got, TRANSPORT_ERRORS):
+                raise got
+        replies = await self._gather(tid, q, sent)
+        acks = local_ok + sum(1 for r in replies if r.ok)
+        if acks < pool.min_size:
+            return False  # stays dirty; the next pass retries
+        if store.clear_dirty(pkey, gen):
+            store.perf.inc("flushes")
+            store.perf.inc("flush_bytes", len(data))
+            if planar is not None:
+                # the raw entry served its purpose: swap the planar
+                # rows in as a CLEAN resident (reads keep their
+                # zero-shard-read path) and re-seed the memo
+                if self._install_resident(pkey, planar, rec.version,
+                                          rec.object_size,
+                                          codec.get_data_chunk_count()):
+                    store.memo_put(pkey, rec.version, data)
+            self._tier_raw_clear_peers(rec)
+        self._update_flush_backlog()
+        return True
+
+    async def _tier_flush_raw_pass(self) -> None:
+        """The agent's raw destage plane: fast-ack records flush on the
+        same dirty-ratio / age / fullness triggers as the legacy plane,
+        throttled as CLASS_FLUSH background work; adopted copies whose
+        write our PG log shows superseded (a lost clear) are GC'd."""
+        self._update_flush_backlog()
+        store = self._paged_store()
+        if store is None or not store.has_dirty() or self.osdmap is None:
+            return
+        ratio = self._tier_dirty_ratio()
+        age = float(self.conf.get("osd_tier_flush_age", 5.0) or 0)
+        target = self._tier_effective_target()
+        forced = bool(self._my_full_state())
+        dirty_target = int(target * ratio)
+        now = time.monotonic()
+        for key, rec, gen, since in self._my_dirty_items(store):
+            if not isinstance(rec, CacheDirtyRecord):
+                continue
+            pool = self.osdmap.pools.get(rec.pool_id)
+            if pool is None:
+                store.drop(key, force=True)
+                continue
+            acting = self.osdmap.pg_to_acting(pool, rec.pg)
+            prim = self._primary(pool, rec.pg, acting)
+            if prim != self.osd_id:
+                # adopted copy: our only job is holding it until the
+                # owner's clear.  GC when OUR log proves the write
+                # superseded (delete / newer write landed) — the clear
+                # was lost, the copy is moot.
+                ent = self._pglog(rec.pool_id, rec.pg).latest_entry(
+                    rec.oid)
+                if ent is not None and (ent.op != "write"
+                                        or ent.object_version
+                                        > rec.version):
+                    store.clear_dirty(key, gen)
+                    store.drop(key, force=True)
+                continue
+            over = store.dirty_bytes > dirty_target
+            aged = age > 0 and (now - since) >= age
+            # inherited raw dirt (we lead the PG but the record names a
+            # dead installer as primary — possible when the replay
+            # sweep's one-shot recovery flush failed transiently, e.g.
+            # min_size short mid-recovery) is a dead primary's acked
+            # write: destage it NOW, not at the age/ratio leisure
+            inherited = rec.primary != self.osd_id
+            if not (forced or over or aged or inherited):
+                continue
+            if await self._tier_flush_raw_key(key, background=True):
+                self.tier_perf.inc("flush_agent")
+            else:
+                self.tier_perf.inc("flush_error")
+        self._update_flush_backlog()
+
+    def _tier_raw_replay_sweep(self) -> None:
+        """Map-change hook for raw fast-ack dirt — the durability half
+        of the replicated-writeback contract.  A cache peer that
+        outlived the writeback primary PUSHES its adopted copy to the
+        PG's new primary; a new primary holding inherited raw dirt (its
+        own adopted copy) completes the dead installer's deferred
+        destage.  Steady state (the installer still leads the PG) is a
+        no-op."""
+        store = self._paged_store()
+        if store is None or not store.has_dirty() or self.osdmap is None:
+            return
+        for key, rec, _gen, _since in self._my_dirty_items(store):
+            if not isinstance(rec, CacheDirtyRecord):
+                continue
+            pool = self.osdmap.pools.get(rec.pool_id)
+            if pool is None or rec.pg >= pool.pg_num:
+                store.drop(key, force=True)
+                continue
+            acting = self.osdmap.pg_to_acting(pool, rec.pg)
+            prim = self._primary(pool, rec.pg, acting)
+            if prim is None:
+                continue
+            if prim == self.osd_id:
+                if rec.primary != self.osd_id:
+                    self._spawn_tier_task(self._tier_flush_raw_key(key))
+            elif rec.primary != prim:
+                # the installer lost the PG (died, or we were demoted
+                # holding our own record): hand the copy to the new
+                # primary so it can replay and destage
+                self._spawn_tier_task(self._tier_raw_push(key, rec, prim))
+
+    async def _tier_raw_push(self, pkey, rec: CacheDirtyRecord,
+                             target: int) -> None:
+        """Push our raw dirty copy to ``target`` (the PG's new primary).
+        Our copy stays dirty until the destaging primary's post-flush
+        clear — the push hands over the bytes, not the custody."""
+        store = self._paged_store()
+        if store is None or self.osdmap is None \
+                or target not in self.osdmap.osds:
+            return
+        data = store.memo_get(pkey, rec.version)
+        if data is None:
+            data = store.read_raw(pkey)
+        if data is None:
+            return
+        ent = self._pglog(rec.pool_id, rec.pg).latest_entry(rec.oid)
+        blob = ent.encode() if ent is not None and getattr(
+            ent, "object_version", 0) == rec.version else b""
+        try:
+            await self.messenger.send(
+                self.osdmap.addr_of(target),
+                MCacheDirty(
+                    pool_id=rec.pool_id, pg=rec.pg, oid=rec.oid,
+                    op="install", data=bytes(data), version=rec.version,
+                    object_size=rec.object_size, log_entry=blob,
+                    peers=list(rec.peers), from_osd=self.osd_id,
+                    epoch=self.osdmap.epoch))
+            self.tier_perf.inc("wb_repl_bytes", len(data))
+        except TRANSPORT_ERRORS:
+            pass
+
+    async def _raw_subread_fence(self, msg, rec: CacheDirtyRecord, got):
+        """Raw-record sibling of _dirty_subread_fence: the acked bytes
+        exist only as a raw dirty object — no EC shard of this version
+        exists anywhere yet.  On the record's OWNER a peer reading the
+        backing store ends the deferral (flush, then serve the fresh
+        store read); on a holder of an ADOPTED copy the requested shard
+        is synthesized from the raw bytes without mutating anything —
+        the store stays untouched and the copy stays dirty until the
+        owner's clear (a new primary's quorum read must see the acked
+        write without stealing custody)."""
+        if got is not None and got[1].version >= rec.version:
+            return got
+        pkey = self._planar_key(msg.pool_id, msg.oid)
+        pool = self.osdmap.pools.get(msg.pool_id) if self.osdmap else None
+        if pool is None:
+            return got
+        if rec.primary == self.osd_id:
+            if not await self._tier_flush_raw_key(pkey):
+                self.tier_perf.inc("flush_error")
+                return got
+            self.tier_perf.inc("dirty_subread_served")
+            try:
+                return self.store.read((msg.pool_id, msg.oid, msg.shard))
+            except IOError:
+                return got
+        store = self._paged_store()
+        if store is None:
+            return got
+        data = store.memo_get(pkey, rec.version)
+        if data is None:
+            data = store.read_raw(pkey)
+        if data is None:
+            return got
+        planar = await planar_encode_async(self._codec(pool),
+                                           self._sinfo(pool),
+                                           bytes(data), queue=None)
+        if planar is None or msg.shard >= self._codec(
+                pool).get_chunk_count():
+            return got
+        blob = bytes(np.ascontiguousarray(planar[0][msg.shard]))
+        self.tier_perf.inc("dirty_subread_served")
+        return (blob, ShardMeta(version=rec.version,
+                                object_size=rec.object_size))
+
+    def _update_flush_backlog(self) -> None:
+        """flush_backlog_bytes gauge: acked-but-not-EC-durable raw
+        dirty bytes this OSD currently holds (own records + adopted
+        copies)."""
+        store = self._paged_store()
+        if store is None:
+            return
+        total = 0
+        for _key, rec, _gen, _since in self._my_dirty_items(store):
+            if isinstance(rec, CacheDirtyRecord):
+                total += rec.object_size
+        self.tier_perf.set("flush_backlog_bytes", total)
+
+    def _spawn_tier_task(self, coro) -> None:
+        """Fire-and-forget a tier coroutine on the running loop, tracked
+        in the messenger's task set (the _tier_observe_read idiom).  No
+        loop (sync test context): close the coroutine and skip — every
+        caller is a best-effort hook whose next trigger retries."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            coro.close()
+            return
+        t = loop.create_task(coro)
+        self.messenger._tasks.add(t)
+        t.add_done_callback(self.messenger._tasks.discard)
 
     def _tier_observe_read(self, op: MOSDOp, reply: MOSDOpReply) -> None:
         """Read-path tier hook (reference PrimaryLogPG::maybe_promote):
@@ -5767,6 +6422,11 @@ class OSD:
         tracked = self.ctx.op_tracker.create("tier_agent_pass")
         try:
             with self.tier_perf.time_avg("agent_pass_s"):
+                # raw destage plane first: fast-ack dirt is acked client
+                # data whose EC durability is still pending — it always
+                # outranks eviction housekeeping (and eviction needs the
+                # entries clean anyway)
+                await self._tier_flush_raw_pass()
                 self._tier_agent_once()
             tracked.mark_event("evicted")
         finally:
@@ -5857,6 +6517,12 @@ class OSD:
                 break
             if paged is not None:
                 if paged.is_dirty(key):
+                    _snap = paged.peek_dirty(key)
+                    if _snap is not None \
+                            and isinstance(_snap[0], CacheDirtyRecord):
+                        # acked raw copy: only the async destage plane
+                        # (or the owner's post-flush clear) releases it
+                        continue
                     # flush-before-evict: an unflushable dirty entry is
                     # skipped, never dropped
                     if self._tier_flush_key(key):
@@ -6022,7 +6688,7 @@ class OSD:
         if ps is not None and ps.has_dirty():
             for key, _info, _gen, _since in self._my_dirty_items(
                     ps, pool_id=pool.pool_id, pg=only_pg):
-                if self._tier_flush_key(key):
+                if await self._tier_flush_any(key):
                     self.tier_perf.inc("flush_scrub")
                 else:
                     self.tier_perf.inc("flush_error")

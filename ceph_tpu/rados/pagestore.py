@@ -129,6 +129,27 @@ class WritebackRecord:
     crcs: Dict[int, int] = field(default_factory=dict)
 
 
+@dataclass
+class CacheDirtyRecord:
+    """The flush contract a fast-ack writeback put pins with its RAW
+    dirty object (w=0 entry, whole-object bytes — no EC encode happened
+    yet): the k+m encode and sub-write fan-out are deferred entirely to
+    the flush path.  ``primary`` names the OSD that installed the write
+    (on a replica's adopted copy it is the writeback primary, not the
+    holder); ``peers`` is the full cache replica set, primary included —
+    the new primary replays the freshest copy from it after a primary
+    death.  Generation-tokened and version-fenced exactly like
+    :class:`WritebackRecord`; opaque to the store itself."""
+
+    pool_id: int
+    oid: str
+    pg: int
+    version: int
+    object_size: int
+    primary: int
+    peers: Tuple[int, ...] = ()
+
+
 class _Entry:
     __slots__ = ("pages", "dtype", "rows", "cols", "itemsize", "w",
                  "n_rows", "meta", "trim", "data_rows", "mono_bytes",
@@ -203,7 +224,8 @@ class PagedResidentStore:
 
     def __init__(self, capacity_bytes: int = 256 << 20,
                  page_bytes: int = 64 << 10, queue: Optional[Any] = None,
-                 device: Optional[bool] = None):
+                 device: Optional[bool] = None,
+                 prewarm: bool = False):
         from ceph_tpu.common.lockdep import make_mutex
 
         page_bytes = max(256, int(page_bytes))
@@ -247,6 +269,15 @@ class PagedResidentStore:
         self.perf = build_pagestore_perf()
         self.perf.set("pages_total", self._pages_total)
         self.perf.resync = self._resync_gauges
+        self.prewarmed = False
+        if prewarm and self.device_arm:
+            # compile the install/gather kernels for this page geometry
+            # (every pow2 row bucket) at store build — the put window
+            # must never pay an in-line XLA compile
+            from ceph_tpu.ops.slab import prewarm as _slab_prewarm
+
+            _slab_prewarm(self.page_words)
+            self.prewarmed = True
 
     # -- capacity ------------------------------------------------------------
 
@@ -575,6 +606,48 @@ class PagedResidentStore:
             self.perf.inc("writeback_installs")
         return True
 
+    # -- raw dirty objects (writeback fast-ack path) -------------------------
+
+    def put_raw(self, key: Any, data: bytes, meta: Any = None,
+                dirty_info: Any = None,
+                now: Optional[float] = None) -> bool:
+        """Install the WHOLE-OBJECT bytes as a raw dirty resident — the
+        writeback fast-ack path's unit of replication (no EC encode has
+        happened; the flush path owns the k+m destage).  Layout: one
+        uint8 bit-row padded to a whole word, ``w=0`` as the raw
+        sentinel (planar_rows/planar_shard_bytes see a zero-height
+        gather range and fall through; ``trim`` keeps the true byte
+        length).  Every page is dirty.  Same refusal contract as
+        put_planar."""
+        raw = np.frombuffer(bytes(data), dtype=np.uint8)
+        if len(raw) % 4:
+            raw = np.pad(raw, (0, 4 - len(raw) % 4))
+        return self.put_planar(key, raw.reshape(1, -1), w=0, n_rows=1,
+                               meta=meta, trim=len(data),
+                               dirty_rows=[(0, 1)], dirty_info=dirty_info,
+                               now=now)
+
+    def is_raw(self, key: Any) -> bool:
+        with self._lock:
+            e = self._entries.get(key)
+            return e is not None and e.w == 0
+
+    def read_raw(self, key: Any) -> Optional[bytes]:
+        """The raw entry's object bytes (None when absent, partial, or
+        not a raw entry).  On the device arm the single materialization
+        here is the declared d2h exit."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.w != 0:
+                return None
+            trim = e.trim
+            bits = self._gather_locked(e, 0, e.rows)
+        if bits is None:
+            return None
+        out = np.asarray(bits).view(np.uint8).reshape(-1)
+        self.note_d2h()
+        return out[:trim].tobytes()
+
     # -- lookup --------------------------------------------------------------
 
     def _gather_locked(self, e: _Entry, r0: int, r1: int):
@@ -766,6 +839,12 @@ class PagedResidentStore:
         with self._lock:
             e = self._entries.get(key)
             trim = e.trim if e is not None else None
+        if w == 0:
+            # raw whole-object entry (put_raw): no planar decode exists;
+            # the single uint8 bit-row IS the bytes
+            out = np.asarray(bits).view(np.uint8).reshape(1, -1)
+            self.note_d2h()
+            return out if trim is None else out[:, :trim]
         if np.dtype(bits.dtype) == np.uint32:
             from ceph_tpu.ops.gf2 import from_packedbit
 

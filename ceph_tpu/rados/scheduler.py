@@ -66,6 +66,11 @@ CLASS_RECOVERY = "recovery"
 CLASS_REBALANCE = "rebalance"
 CLASS_SCRUB = "scrub"
 CLASS_BEST_EFFORT = "best_effort"
+# cache-tier flush destage (dirty raw replicas -> k+m EC shards): classed
+# ABOVE best_effort — flush backlog holds acked-but-not-EC-durable client
+# data, so destaging outranks eviction/scrub housekeeping but still
+# yields to client reservations
+CLASS_FLUSH = "flush"
 
 # Background dmClock profiles by operator intent (reference
 # osd_mclock_profile: balanced / high_client_ops / high_recovery_ops
@@ -81,6 +86,7 @@ MCLOCK_PROFILES = {
         CLASS_CLIENT: (100.0, 10.0, 0.0, 0.5),
         CLASS_RECOVERY: (10.0, 3.0, 50.0, 1.0),
         CLASS_REBALANCE: (5.0, 2.0, 30.0, 1.0),
+        CLASS_FLUSH: (8.0, 3.0, 40.0, 1.0),
         CLASS_SCRUB: (1.0, 1.0, 20.0, 1.0),
         CLASS_BEST_EFFORT: (1.0, 1.0, 20.0, 0.0),
     },
@@ -88,6 +94,7 @@ MCLOCK_PROFILES = {
         CLASS_CLIENT: (150.0, 20.0, 0.0, 0.5),
         CLASS_RECOVERY: (5.0, 2.0, 25.0, 0.5),
         CLASS_REBALANCE: (2.0, 1.0, 15.0, 0.5),
+        CLASS_FLUSH: (4.0, 2.0, 20.0, 0.5),
         CLASS_SCRUB: (1.0, 1.0, 10.0, 0.5),
         CLASS_BEST_EFFORT: (1.0, 1.0, 10.0, 0.0),
     },
@@ -95,6 +102,7 @@ MCLOCK_PROFILES = {
         CLASS_CLIENT: (50.0, 5.0, 0.0, 0.5),
         CLASS_RECOVERY: (40.0, 8.0, 100.0, 2.0),
         CLASS_REBALANCE: (20.0, 4.0, 60.0, 2.0),
+        CLASS_FLUSH: (15.0, 4.0, 60.0, 1.0),
         CLASS_SCRUB: (2.0, 2.0, 30.0, 1.0),
         CLASS_BEST_EFFORT: (1.0, 1.0, 20.0, 0.0),
     },
@@ -120,7 +128,8 @@ class WPQScheduler:
     often; strict classes (priority >= cutoff) always first."""
 
     PRIORITIES = {CLASS_CLIENT: 63, CLASS_RECOVERY: 10,
-                  CLASS_REBALANCE: 8, CLASS_SCRUB: 5, CLASS_BEST_EFFORT: 5}
+                  CLASS_REBALANCE: 8, CLASS_FLUSH: 7,
+                  CLASS_SCRUB: 5, CLASS_BEST_EFFORT: 5}
     STRICT_CUTOFF = 196  # reference osd_op_queue_cut_off high
 
     def __init__(self, conf: Optional[dict] = None):

@@ -517,7 +517,27 @@ def build_tier_perf() -> PerfCounters:
         .add_u64_counter("dirty_subread_served",
                          "peer sub-reads answered from dirty resident "
                          "pages (store copy was deferred)")
+        .add_u64_counter("wb_repl_acks",
+                         "writeback puts fast-acked at the cache quorum "
+                         "(raw dirty replicas on osd_cache_min_size "
+                         "processes; EC encode deferred to flush)")
+        .add_u64_counter("wb_repl_bytes",
+                         "raw dirty bytes replicated to cache peers on "
+                         "the fast-ack path")
+        .add_u64_counter("wb_dirty_adopted",
+                         "raw dirty replicas adopted from a writeback "
+                         "primary (replica-side MCacheDirty installs)")
+        .add_u64_counter("wb_quorum_short",
+                         "writeback puts that fell back to synchronous "
+                         "writethrough (acting cache peers below "
+                         "osd_cache_min_size, or replica acks short)")
+        .add_u64_counter("flush_encodes",
+                         "deferred k+m EC encodes performed by the "
+                         "flush path (one per raw dirty object destaged)")
         .add_time_avg("agent_pass_s", "agent pass wall seconds")
+        .add_u64("flush_backlog_bytes",
+                 "acked-but-not-EC-durable raw dirty bytes awaiting "
+                 "flush on this OSD (gauge)")
         .add_u64("resident_target_bytes",
                  "effective target_max_bytes (gauge)")
         .add_u64("hitset_fpp_ppm",

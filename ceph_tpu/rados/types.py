@@ -1212,6 +1212,52 @@ class MECSubWriteReply:
     gseq: int = 0  # lane striping order key (see MOSDOp.gseq)
 
 
+@message(84)
+class MCacheDirty:
+    """Writeback fast-ack replication (cache-tier durability quorum,
+    reference cache-tier/primary-log idiom): the primary ships the RAW
+    dirty object — no EC encode happened yet — to the first
+    ``osd_cache_min_size - 1`` acting peers, who pin it dirty in their
+    pagestores and append the cache-committed log entry; the client is
+    acked when the quorum commits and the k+m encode moves wholesale to
+    the flush path.  op="install" carries the bytes; op="clear" is the
+    post-flush broadcast releasing the replicas' copies (version-fenced,
+    no ack).  On primary failover a surviving replica re-sends its copy
+    to the new primary as op="install" (from_osd then names the sender,
+    not the pg primary — the recovery push)."""
+
+    pool_id: int = 0
+    pg: int = 0
+    # interval fence, as MECSubWrite: sender osd id + map epoch; a peer
+    # whose map shows a different primary refuses a deposed primary's
+    # install
+    from_osd: int = -1
+    epoch: int = 0
+    oid: str = ""
+    op: str = "install"  # install | clear
+    data: bytes = b""    # raw object bytes (empty on clear)
+    version: int = 0
+    object_size: int = 0
+    tid: str = ""
+    reply_to: Tuple[str, int] = ("", 0)
+    # pickled pglog.LogEntry (cache-committed, cache_peers stamped): the
+    # replica appends it in the same breath as the dirty install, so a
+    # failover primary's log already names the write and its replica set
+    log_entry: bytes = b""
+    # the full cache replica set, primary first — the adopted record's
+    # replay roster
+    peers: List[int] = field(default_factory=list)
+    gseq: int = 0  # lane striping order key (see MOSDOp.gseq)
+
+
+@message(85)
+class MCacheDirtyAck:
+    tid: str = ""
+    osd: int = 0
+    ok: bool = True
+    gseq: int = 0  # lane striping order key (see MOSDOp.gseq)
+
+
 @message(32, version=4)
 class MECSubRead:
     pool_id: int = 0
@@ -1510,6 +1556,7 @@ MOSDOpReply.BLOB_ATTR = "data"
 MECSubWrite.BLOB_ATTR = "chunk"
 MECSubReadReply.BLOB_ATTR = "chunk"
 MPushShard.BLOB_ATTR = "chunk"
+MCacheDirty.BLOB_ATTR = "data"
 
 # BLOB_CRC_ATTR: this field holds a crc32c the sender ALREADY computed
 # over exactly the blob bytes (the primary's per-shard pass, a stored
@@ -1529,6 +1576,9 @@ MECSubReadReply.BLOB_CRC_ATTR = "chunk_crc"
 # classes, MOSDOpReply.data to client code) must NOT set this.
 MECSubWrite.BLOB_VIEW_OK = True
 MECSubReadReply.BLOB_VIEW_OK = True
+# MCacheDirty.data: consumers are put_raw (np.frombuffer) and bytes()
+# normalization on the adopt path — buffer-safe end to end
+MCacheDirty.BLOB_VIEW_OK = True
 # MOSDOp.data: the WRITE path is buffer-safe end to end (pad_to_stripe,
 # splice slicing, np.frombuffer encode, bytes() cache copy); the OSD
 # dispatcher normalizes data to bytes for every OTHER op (multi/call/...)
@@ -1595,6 +1645,16 @@ MECSubReadReply.FIXED_FIELDS = [
     ("version", "Q"), ("object_size", "q"), ("hinfo", "y"),
     ("gseq", "Q"),  # v4 tail (append-only rule)
 ]
+MCacheDirty.FIXED_FIELDS = [
+    ("pool_id", "q"), ("pg", "q"), ("from_osd", "q"), ("epoch", "q"),
+    ("oid", "s"), ("op", "s"), ("data", "y"), ("version", "Q"),
+    ("object_size", "q"), ("tid", "s"), ("reply_to", "addr"),
+    ("log_entry", "y"), ("peers", "Q*"), ("gseq", "Q"),
+]
+MCacheDirtyAck.FIXED_FIELDS = [
+    ("tid", "s"), ("osd", "q"), ("ok", "?"),
+    ("gseq", "Q"),
+]
 MPushShard.FIXED_FIELDS = [
     ("pool_id", "q"), ("pg", "q"), ("oid", "s"), ("shard", "q"),
     ("chunk", "y"), ("version", "Q"), ("object_size", "q"),
@@ -1624,3 +1684,5 @@ MECSubWriteReply.LANE_STRIPE = True
 MECSubRead.LANE_STRIPE = True
 MECSubReadReply.LANE_STRIPE = True
 MPushShard.LANE_STRIPE = True
+MCacheDirty.LANE_STRIPE = True
+MCacheDirtyAck.LANE_STRIPE = True

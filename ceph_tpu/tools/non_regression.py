@@ -812,7 +812,11 @@ def run_tier(args) -> int:
                 # agent cadence (age-driven) so dirty_pages is bounded
                 # after settling — the failing gate below
                 "osd_tier_flush_age": 0.3}
-        cluster = Cluster(n_osds=max(3, args.tier_osds), conf=conf)
+        # 4-OSD floor: the kill-primary leg below needs a spare OSD so
+        # CRUSH can rebuild a FULL acting set after the kill — with
+        # k+m == n_osds the surviving set keeps a permanent hole and no
+        # destage can ever reach min_size acks
+        cluster = Cluster(n_osds=max(4, args.tier_osds), conf=conf)
         await cluster.start()
         failures = []
         resident_reads = cold_reads = 0
@@ -946,12 +950,24 @@ def run_tier(args) -> int:
                     failures.append(
                         f"dirty_pages {store.dirty_pages} not bounded "
                         f"after agent settling (flush never drained)")
-                # the deferred local applies LANDED at their versions
+                # the deferred local applies LANDED at their versions.
+                # A WritebackRecord pins its deferred local shards; a
+                # fast-ack CacheDirtyRecord defers the WHOLE k+m encode
+                # (the flush lands the installer's acting shards), and
+                # its ADOPTED copies on cache peers pin nothing locally.
                 for key, info in pinned:
                     osd = cluster.osds.get(key[0])
                     if osd is None:
                         continue
-                    for shard in info.shards:
+                    shards = getattr(info, "shards", None)
+                    if shards is None:
+                        if getattr(info, "primary", key[0]) != key[0]:
+                            continue  # adopted copy: owner destages
+                        p = osd.osdmap.pools[info.pool_id]
+                        acting = osd.osdmap.pg_to_acting(p, info.pg)
+                        shards = [s for s, o_id in enumerate(acting)
+                                  if o_id == key[0]]
+                    for shard in shards:
                         got_s = osd._store_read(
                             (info.pool_id, info.oid, shard))
                         if got_s is None or got_s[1].version < info.version:
@@ -974,6 +990,79 @@ def run_tier(args) -> int:
                       f"dirty_pages={store.dirty_pages} "
                       f"page_evictions={wb_perf.get('page_evictions', 0)} "
                       f"frag_saved={wb_perf.get('frag_saved_bytes', 0)}")
+                # -- kill-primary-before-flush (the fast-ack durability
+                # gate): a put acked at the CACHE quorum, its primary
+                # SIGKILLed before any flush, must survive — a replica
+                # replays its raw dirty copy to the PG's new primary,
+                # who destages it; the cold re-read is byte-identical
+                for o in cluster.osds.values():
+                    o.conf["osd_tier_flush_age"] = 120.0  # park dirt
+                kp_blob = _os.urandom(130_000)
+                await c.put(pool, "wbkill", kp_blob)
+                owned = [(k, info) for k, info, _g, _s
+                         in store.dirty_items()
+                         if info is not None and info.oid == "wbkill"
+                         and getattr(info, "primary", None) == k[0]]
+                if not owned:
+                    failures.append(
+                        "kill-primary leg: fast-ack put left no owned "
+                        "raw dirty record (fast ack never engaged)")
+                else:
+                    (kp_key, kp_rec), = owned
+                    adopters = [p for p in kp_rec.peers
+                                if p != kp_key[0]
+                                and store.is_dirty((p, pool, "wbkill"))]
+                    if not adopters:
+                        failures.append(
+                            "kill-primary leg: no cache peer adopted "
+                            "the dirty copy before the kill")
+                    await cluster.kill_osd(kp_key[0])
+                    got_kp = None
+                    for _ in range(300):
+                        await asyncio.sleep(0.1)
+                        try:
+                            got_kp = await c.get(pool, "wbkill")
+                            if got_kp == kp_blob:
+                                break
+                        except Exception:
+                            continue
+                    if got_kp != kp_blob:
+                        failures.append(
+                            "kill-primary leg: acked write lost after "
+                            "primary SIGKILL before flush")
+                    # the survivors' replay destaged and released the
+                    # adopted copies
+                    for _ in range(100):
+                        if not any(info is not None
+                                   and info.oid == "wbkill"
+                                   for _k, info, _g, _s
+                                   in store.dirty_items()):
+                            break
+                        await asyncio.sleep(0.1)
+                    if any(info is not None and info.oid == "wbkill"
+                           for _k, info, _g, _s in store.dirty_items()):
+                        failures.append(
+                            "kill-primary leg: adopted dirty copies "
+                            "never destaged after the failover")
+                    drop_residents("wbkill")
+                    try:
+                        cold_kp = await c.get(pool, "wbkill",
+                                              fadvise="dontneed")
+                        if cold_kp != kp_blob:
+                            failures.append(
+                                "kill-primary leg: cold re-read after "
+                                "replay is not byte-identical")
+                    except Exception as e:
+                        failures.append(
+                            f"kill-primary leg: cold re-read failed: {e}")
+                    tier_enc = sum(o.tier_perf.get("flush_encodes")
+                                   for o in cluster.osds.values())
+                    print(f"tier kill-primary: victim osd.{kp_key[0]}, "
+                          f"{len(adopters)} adopter(s), replay "
+                          f"flush_encodes={tier_enc}, re-read "
+                          f"{'ok' if got_kp == kp_blob else 'LOST'}")
+                for o in cluster.osds.values():
+                    o.conf["osd_tier_flush_age"] = 0.3
             else:
                 print("tier writeback: SKIPPED (monolithic resident "
                       "store forced; writeback needs the pagestore)")

@@ -107,6 +107,18 @@ def _sample_messages() -> List[Any]:
                           version=7, object_size=55, hinfo=b"H", gseq=22),
         t.MECSubDelete(pool_id=1, pg=2, oid="gone", shard=0, tid="t3",
                        reply_to=("h", 2)),
+        # writeback fast-ack plane: the raw-dirty install (every field
+        # non-default) plus the post-flush clear broadcast — both legs
+        # of the cache-tier durability quorum are corpus-pinned
+        t.MCacheDirty(pool_id=3, pg=6, from_osd=1, epoch=27,
+                      oid="wb/obj", op="install", data=b"rawdirty",
+                      version=41, object_size=8, tid="t-wb1",
+                      reply_to=("127.0.0.1", 6802), log_entry=b"LE",
+                      peers=[1, 2, 3], gseq=25),
+        t.MCacheDirty(pool_id=3, pg=6, from_osd=1, epoch=28,
+                      oid="wb/obj", op="clear", version=41,
+                      object_size=8, gseq=26),
+        t.MCacheDirtyAck(tid="t-wb1", osd=2, ok=False, gseq=27),
         t.MPushShard(pool_id=1, pg=0, oid="pushed", shard=2,
                      chunk=b"recovered", version=3, object_size=9,
                      hinfo=b"HH", gseq=23),

@@ -376,11 +376,20 @@ class TestWritebackEndToEnd:
                         break
                     await asyncio.sleep(0.05)
                 assert store.dirty_pages == 0, "flush never drained"
-                # the deferred applies landed at their pinned versions
+                # the deferred applies landed at their pinned versions.
+                # A WritebackRecord pins its deferred local shards; a
+                # fast-ack CacheDirtyRecord defers the WHOLE k+m encode,
+                # so the flush lands this OSD's acting shards.
                 flushed = 0
                 for key, info in pinned:
                     o = cluster.osds[key[0]]
-                    for shard in info.shards:
+                    shards = getattr(info, "shards", None)
+                    if shards is None:
+                        p = o.osdmap.pools[info.pool_id]
+                        acting = o.osdmap.pg_to_acting(p, info.pg)
+                        shards = [s for s, osd in enumerate(acting)
+                                  if osd == key[0]]
+                    for shard in shards:
                         got = o._store_read((info.pool_id, info.oid,
                                              shard))
                         assert got is not None
@@ -424,18 +433,35 @@ class TestWritebackEndToEnd:
                 dirty = store.dirty_items()
                 assert dirty, "no writeback dirt to fail over"
                 victim = dirty[0][0][0]  # osd id of a dirty primary
+
+                def victim_owned():
+                    # dirt the victim INSTALLED as primary (an adopted
+                    # copy it holds for a live primary legitimately
+                    # stays until that owner's flush + clear)
+                    return [key for key, info, _g, _s
+                            in store.dirty_items()
+                            if key[0] == victim
+                            and getattr(info, "primary", victim)
+                            == victim]
+
+                assert victim_owned(), "victim owned no writeback dirt"
                 await c.osd_out(victim)
-                # the demoted primary must flush ITS dirt on the map
+                # the demoted primary's own dirt must move on the map
+                # change: sync flush (WritebackRecord) or push to the
+                # new primary, who destages and clears (fast-ack raw)
                 for _ in range(200):
-                    if not any(key[0] == victim for key, *_ in
-                               store.dirty_items()):
+                    if not victim_owned():
                         break
                     await asyncio.sleep(0.05)
-                assert not any(key[0] == victim
-                               for key, *_ in store.dirty_items()), \
-                    "demoted primary kept dirty residents"
-                assert cluster.osds[victim].tier_perf.get(
-                    "flush_demote") > 0
+                assert not victim_owned(), \
+                    "demoted primary kept dirty residents it installed"
+                # the dirt moved by one of the two demote planes:
+                # legacy sync flush (WritebackRecord) or the fast-ack
+                # replay — push to the new primary, who encodes there
+                assert (cluster.osds[victim].tier_perf.get(
+                            "flush_demote") > 0
+                        or sum(o.tier_perf.get("flush_encodes")
+                               for o in cluster.osds.values()) > 0)
                 # acked bytes survive the failover
                 for oid, blob in blobs.items():
                     assert await c.get(pool, oid) == blob
@@ -464,8 +490,11 @@ class TestWritebackEndToEnd:
                 v1 = os.urandom(100_000)
                 await c.put(pool, "obj", v1)
                 assert store.dirty_pages > 0
-                (key, info), = [(k, i) for k, i, _g, _s
-                                in store.dirty_items()]
+                # the primary's own record (a fast-ack put also leaves
+                # ADOPTED copies on cache peers — same oid, other osds)
+                key, info = next(
+                    (k, i) for k, i, _g, _s in store.dirty_items()
+                    if getattr(i, "primary", k[0]) == k[0])
                 # gate the SECOND write's install at runtime
                 await c.pool_set(pool, "min_write_recency_for_promote",
                                  "99")
@@ -483,10 +512,21 @@ class TestWritebackEndToEnd:
                 assert not store.is_dirty(key), \
                     "stale writeback dirt survived a gated overwrite"
                 assert key not in store
-                # the local shards hold v2, and no later agent pass may
-                # regress them
+                # ...and so did every peer's adopted copy of v1 (the
+                # v2 sub-write's version-aware drop): no process may
+                # later replay v1 bytes anywhere
                 await asyncio.sleep(0.5)
-                for shard in info.shards:
+                assert not any(i.oid == info.oid
+                               for _k, i, _g, _s in store.dirty_items()
+                               if i is not None), \
+                    "stale adopted copy survived a gated overwrite"
+                shards = getattr(info, "shards", None)
+                if shards is None:
+                    p = o.osdmap.pools[info.pool_id]
+                    acting = o.osdmap.pg_to_acting(p, info.pg)
+                    shards = [s for s, osd in enumerate(acting)
+                              if osd == key[0]]
+                for shard in shards:
                     got = o._store_read((info.pool_id, info.oid, shard))
                     assert got is not None
                     assert got[1].version > info.version, \
@@ -570,6 +610,13 @@ class TestWritebackEndToEnd:
                 pool = await c.create_pool("s", profile=dict(PROFILE))
                 await c.put(pool, "obj", os.urandom(50_000))
                 osd = next(iter(cluster.osds.values()))
+                # the fast-ack put returns before the pool's map has
+                # necessarily reached every OSD: wait for this one
+                for _ in range(200):
+                    if osd.osdmap is not None \
+                            and pool in osd.osdmap.pools:
+                        break
+                    await asyncio.sleep(0.02)
                 status = osd.tier_status()
                 ps = status["pagestore"]
                 assert ps is not None
@@ -589,6 +636,298 @@ class TestWritebackEndToEnd:
                 await cluster.stop()
 
         run(go())
+
+
+# -- fast-ack replicated writeback -------------------------------------------
+
+
+class TestFastAckWriteback:
+    """The r18 tentpole: a writeback put acks at the CACHE quorum
+    (raw dirty copies on osd_cache_min_size processes), the k+m encode
+    moves wholesale to the flush path.  These legs pin the durability
+    surgery: replica adoption + kill-primary replay, the flush/overwrite
+    generation race, quorum-short degradation to write-through, the
+    RMW/sub-read fences, and the MCacheDirty truncated-tail ABI."""
+
+    def test_replica_adopt_and_kill_primary_replay(self, force_batching):
+        """A fast-ack put leaves the raw object dirty on the primary
+        AND adopted on cache_min_size-1 peers; SIGKILLing the primary
+        before any flush must not lose the acked write — a surviving
+        replica replays its copy to the PG's new primary, who destages
+        and serves the bytes."""
+        async def go():
+            conf = dict(WB_CONF)
+            conf["osd_tier_flush_age"] = 60.0  # park: only replay flushes
+            conf["mon_osd_report_grace"] = 0.8
+            conf["osd_heartbeat_interval"] = 0.2
+            conf["client_op_timeout"] = 5.0
+            cluster = Cluster(n_osds=4, conf=conf)
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("ka", profile=dict(PROFILE))
+                store = osdmod.shared_planar_store()
+                blob = os.urandom(120_000)
+                await c.put(pool, "obj", blob)
+                # the primary's own record names its replica roster
+                owned = [(k, i) for k, i, _g, _s in store.dirty_items()
+                         if getattr(i, "primary", None) == k[0]
+                         and i.oid == "obj"]
+                assert owned, "fast-ack put left no owned dirty record"
+                (pkey, rec), = owned
+                primary = pkey[0]
+                assert rec.peers[0] == primary and len(rec.peers) >= 2
+                # every non-primary roster member adopted the raw copy
+                for peer in rec.peers[1:]:
+                    assert store.is_dirty((peer, pool, "obj")), \
+                        f"peer {peer} never adopted the dirty copy"
+                assert sum(o.tier_perf.get("wb_dirty_adopted")
+                           for o in cluster.osds.values()) \
+                    >= len(rec.peers) - 1
+                assert cluster.osds[primary].tier_perf.get(
+                    "wb_repl_acks") >= 1
+                assert cluster.osds[primary].tier_perf.get(
+                    "wb_repl_bytes") >= len(blob) * (len(rec.peers) - 1)
+                await cluster.kill_osd(primary)
+                # detection -> replay sweep -> recovery destage: the
+                # acked bytes must come back from a surviving replica
+                got = None
+                for _ in range(300):
+                    await asyncio.sleep(0.1)
+                    try:
+                        got = await c.get(pool, "obj")
+                        if got == blob:
+                            break
+                    except Exception:
+                        continue
+                assert got == blob, \
+                    "acked write lost after kill-primary-before-flush"
+                # the destage's clear broadcast releases the survivors'
+                # adopted copies (the dead primary's keys were dropped
+                # by its stop)
+                for _ in range(100):
+                    if not any(i.oid == "obj"
+                               for _k, i, _g, _s in store.dirty_items()
+                               if i is not None):
+                        break
+                    await asyncio.sleep(0.1)
+                assert not any(i.oid == "obj"
+                               for _k, i, _g, _s in store.dirty_items()
+                               if i is not None), \
+                    "adopted copies never released after the replay"
+                assert sum(o.tier_perf.get("flush_encodes")
+                           for o in cluster.osds.values()) > 0, \
+                    "no survivor destaged the replayed copy"
+                # the destaged shards serve the bytes cold, with every
+                # resident evicted
+                for o in cluster.osds.values():
+                    if o._planar is not None:
+                        o._planar.drop(o._planar_key(pool, "obj"),
+                                       force=True)
+                assert await c.get(pool, "obj",
+                                   fadvise="dontneed") == blob
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_raw_flush_race_overwrite_generation_token(
+            self, force_batching):
+        """A destage whose encode raced a newer fast-ack overwrite must
+        neither stamp the OLD bytes over any shard nor clear the NEW
+        write's dirt — the generation token moved, so the in-flight
+        flush stands down and the overwrite keeps custody."""
+        async def go():
+            conf = dict(WB_CONF)
+            conf["osd_tier_flush_age"] = 60.0
+            cluster = Cluster(n_osds=3, conf=conf)
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("rc", profile=dict(PROFILE))
+                store = osdmod.shared_planar_store()
+                v1 = os.urandom(100_000)
+                await c.put(pool, "obj", v1)
+                pkey, rec1 = next(
+                    ((k, i) for k, i, _g, _s in store.dirty_items()
+                     if getattr(i, "primary", None) == k[0]))
+                o = cluster.osds[pkey[0]]
+                snap = store.peek_dirty(pkey)
+                assert snap is not None
+                gen1 = snap[1]
+                p = o.osdmap.pools[rec1.pool_id]
+                acting = o.osdmap.pg_to_acting(p, rec1.pg)
+                ent1 = o._pglog(rec1.pool_id, rec1.pg).latest_entry("obj")
+                # the overwrite lands while the (captured) flush state
+                # is mid-encode
+                v2 = os.urandom(100_000)
+                await c.put(pool, "obj", v2)
+                snap2 = store.peek_dirty(pkey)
+                assert snap2 is not None and snap2[1] != gen1, \
+                    "overwrite did not re-dirty under a new generation"
+                # replay the stale flush exactly as the in-flight task
+                # would resume: it must detect the moved token and bow
+                # out without clearing or fanning out v1's shards
+                done = await o._tier_flush_raw_inner(
+                    pkey, store, rec1, gen1, p, acting, ent1, v1, False)
+                assert done is True
+                snap3 = store.peek_dirty(pkey)
+                assert snap3 is not None and snap3[1] == snap2[1] \
+                    and snap3[0].version == snap2[0].version, \
+                    "stale flush disturbed the overwrite's dirt"
+                assert await c.get(pool, "obj") == v2
+                # the legitimate flush destages v2, and no shard ever
+                # regressed to v1
+                assert await o._tier_flush_raw_key(pkey)
+                for shard, osd in enumerate(acting):
+                    if osd < 0:
+                        continue
+                    got = cluster.osds[osd]._store_read(
+                        (rec1.pool_id, "obj", shard))
+                    assert got is not None
+                    assert got[1].version > rec1.version
+                for oo in cluster.osds.values():
+                    if oo._planar is not None:
+                        oo._planar.drop(oo._planar_key(pool, "obj"),
+                                        force=True)
+                assert await c.get(pool, "obj",
+                                   fadvise="dontneed") == v2
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_quorum_short_degrades_to_writethrough(self, force_batching):
+        """When fewer than osd_cache_min_size-1 live peers exist the
+        fast ack's durability claim cannot hold: the put must degrade
+        to the synchronous write-through bar (counted wb_quorum_short),
+        leaving no deferred dirt behind — and still ack correct bytes."""
+        async def go():
+            conf = dict(WB_CONF)
+            conf["osd_cache_min_size"] = 4  # > acting size: never forms
+            cluster = Cluster(n_osds=3, conf=conf)
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("qs", profile=dict(PROFILE))
+                store = osdmod.shared_planar_store()
+                blob = os.urandom(90_000)
+                await c.put(pool, "obj", blob)
+                assert sum(o.tier_perf.get("wb_quorum_short")
+                           for o in cluster.osds.values()) >= 1
+                # no raw fast-ack dirt anywhere: the write went through
+                # the synchronous EC path
+                from ceph_tpu.rados.pagestore import CacheDirtyRecord
+                assert not any(isinstance(i, CacheDirtyRecord)
+                               for _k, i, _g, _s in store.dirty_items())
+                assert sum(o.tier_perf.get("wb_repl_acks")
+                           for o in cluster.osds.values()) == 0
+                assert await c.get(pool, "obj") == blob
+                # the shards are already EC-durable (write-through)
+                placed = 0
+                for o in cluster.osds.values():
+                    p = o.osdmap.pools.get(pool) if o.osdmap else None
+                    if p is None:
+                        continue
+                    acting = o.osdmap.pg_to_acting(
+                        p, o.osdmap.object_to_pg(p, "obj"))
+                    for shard, osd in enumerate(acting):
+                        if osd == o.osd_id and o._store_read(
+                                (pool, "obj", shard)) is not None:
+                            placed += 1
+                assert placed >= int(PROFILE["k"])
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_rmw_and_subread_fences_flush_first(self, force_batching):
+        """Fence ordering: a partial overwrite (RMW) against parked raw
+        dirt must destage the acked full-object write FIRST, then apply
+        the patch — and a cold sub-read path against dirty replicas
+        serves the acked version, never stale or torn bytes."""
+        async def go():
+            conf = dict(WB_CONF)
+            conf["osd_tier_flush_age"] = 60.0  # park: only fences flush
+            cluster = Cluster(n_osds=3, conf=conf)
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("fe", profile=dict(PROFILE))
+                store = osdmod.shared_planar_store()
+                base = bytearray(os.urandom(96_000))
+                await c.put(pool, "obj", bytes(base))
+                assert any(getattr(i, "primary", None) == k[0]
+                           and i.oid == "obj"
+                           for k, i, _g, _s in store.dirty_items())
+                # cold read while the dirt is parked: the sub-read
+                # fence must serve the acked bytes
+                assert await c.get(pool, "obj",
+                                   fadvise="dontneed") == bytes(base)
+                patch = os.urandom(1024)
+                off = 40_000
+                await c.put(pool, "obj", patch, offset=off)
+                base[off:off + len(patch)] = patch
+                # the RMW fence destaged the raw record before patching
+                assert sum(o.tier_perf.get("flush_encodes")
+                           + o._planar.perf.get("flushes")
+                           for o in cluster.osds.values()
+                           if o._planar is not None) > 0, \
+                    "partial overwrite never forced the destage"
+                from ceph_tpu.rados.pagestore import CacheDirtyRecord
+                assert not any(isinstance(i, CacheDirtyRecord)
+                               and i.oid == "obj"
+                               for _k, i, _g, _s in store.dirty_items()), \
+                    "raw dirt survived the RMW fence"
+                assert await c.get(pool, "obj") == bytes(base)
+                for o in cluster.osds.values():
+                    if o._planar is not None:
+                        o._planar.drop(o._planar_key(pool, "obj"),
+                                       force=True)
+                assert await c.get(pool, "obj",
+                                   fadvise="dontneed") == bytes(base)
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_mcachedirty_truncated_tail_golden_decode(self):
+        """ABI pin: the archived pre-tail MCacheDirty frame (packed
+        without the peers/gseq tail) must decode under TODAY's field
+        list with the trailing fields defaulting — the append-only
+        rule that lets a mixed-version cluster run the fast-ack
+        plane."""
+        import struct
+
+        import ceph_tpu.rados.types as t
+        from ceph_tpu.rados.messenger import decode_message
+
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "corpus", "wire", "golden",
+            "MCacheDirty.v_pretail.frame")
+        with open(path, "rb") as f:
+            raw = f.read()
+        hdr = struct.Struct("<HHBI")
+        type_id, version, fixed, plen = hdr.unpack_from(raw, 0)
+        assert type_id == t.MCacheDirty.TYPE_ID
+        off = hdr.size
+        payload = raw[off:off + plen]
+        off += plen
+        (blen,) = struct.unpack_from("<I", raw, off)
+        blob = raw[off + 4:off + 4 + blen] if blen else None
+        msg = decode_message(type_id, version, payload, blob,
+                             bool(fixed))
+        assert isinstance(msg, t.MCacheDirty)
+        assert msg.oid == "wb/obj" and msg.op == "install"
+        assert bytes(msg.data) == b"rawdirty" and msg.version == 41
+        assert msg.reply_to == ("127.0.0.1", 6802)
+        # the truncated tail defaults — never garbage, never a shifted
+        # mis-read of earlier fields
+        assert msg.peers == [] and msg.gseq == 0
 
 
 # -- device arm (jitted slab kernels on jax-cpu) ------------------------------
