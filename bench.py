@@ -1305,28 +1305,37 @@ def daemon_path_bench() -> int:
             # leader): embedded in the BENCH record
             fullness = {str(osd_id): row for osd_id, row in
                         cluster.mon._osd_utilization().items()}
+            # mon membership/lifecycle counters of the same window
+            # (auto-outs, crush moves, safety-predicate traffic): all
+            # four should be ZERO on a healthy bench host — a nonzero
+            # auto_outs means an OSD went dark mid-window
+            membership = {k: cluster.mon.perf.get(k) for k in
+                          ("auto_outs", "crush_moves",
+                           "predicate_queries", "predicate_refusals")}
             await c.stop()
             return (put_dt, get_dt, wire_perf, objecter_perf, phase_pcts,
-                    wire_plane, clog, fullness)
+                    wire_plane, clog, fullness, membership)
         finally:
             await cluster.stop()
 
     from ceph_tpu.utils import wirepath as _wp
 
-    put_dt, get_dt, _, _, _, _, clog_fast, _ = asyncio.run(go(True))
+    put_dt, get_dt, _, _, _, _, clog_fast, _, _ = asyncio.run(go(True))
     (wire_put_dt, wire_get_dt, wire_perf, objecter_perf,
-     phase_pcts, wire_plane, clog_wire, fullness) = asyncio.run(
-        go(False, WIRE_PLANE_CONF, want_plane=True))
+     phase_pcts, wire_plane, clog_wire, fullness,
+     membership) = asyncio.run(go(False, WIRE_PLANE_CONF,
+                                  want_plane=True))
     # forced-python wirepath arm, same window: BOTH arms land in every
     # BENCH record (when the native wirepath never built, the two arms
     # are the same code path and the record says so via wirepath_kind)
     (wire_py_put_dt, wire_py_get_dt, wire_py_perf, _, _, _,
-     clog_wire_py, _) = asyncio.run(
+     clog_wire_py, _, _) = asyncio.run(
         go(False, dict(WIRE_PLANE_CONF, ms_wirepath_native=False)))
     # colocated ring arm: fastpath OFF, ring ON — the negotiated
     # in-process transport serves every byte
     (local_put_dt, local_get_dt, local_perf, _, _, _,
-     clog_local, _) = asyncio.run(go(False, {"ms_colocated_ring": True}))
+     clog_local, _, _) = asyncio.run(go(False,
+                                        {"ms_colocated_ring": True}))
     # merge the arms' cluster-log summaries; ANY crash fails the
     # bench (a silently dead OSD must not pass as a noisy sample)
     warn_counts: dict = {}
@@ -1381,7 +1390,12 @@ def daemon_path_bench() -> int:
                         "crashes": crashes},
         # per-OSD utilization + fullness states of the wire arm's
         # cluster (mon aggregated view) — the capacity-plane snapshot
-        "fullness": fullness}))
+        "fullness": fullness,
+        # mon membership-plane counters of the wire arm (auto-outs,
+        # crush moves, safety-predicate queries/refusals): all zero on
+        # a healthy bench host; a nonzero auto_outs means an OSD went
+        # dark mid-window and the throughput sample is suspect
+        "mon_membership": membership}))
     if crashes:
         print(f"FAIL daemon-path bench: {len(crashes)} daemon crash"
               f"(es) during the measured window: "

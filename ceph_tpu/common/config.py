@@ -209,6 +209,19 @@ DEFAULT_SCHEMA: Dict[str, Option] = _opts(
     Option("mon_osd_min_down_reporters", OPT_INT, 1,
            desc="distinct OSD failure reports required before the mon "
                 "marks the target down ahead of its own grace"),
+    Option("mon_osd_down_out_interval", OPT_SECS, 0.6,
+           desc="seconds an OSD stays down before the mon auto-marks it "
+                "out (0 disables auto-out; the `noout` osdmap flag and "
+                "mon_osd_min_in_ratio both gate the transition)"),
+    Option("mon_osd_min_in_ratio", OPT_FLOAT, 0.0, min=0.0,
+           desc="auto-out floor: the mon refuses to auto-out an OSD when "
+                "the in-fraction of the cluster would drop below this "
+                "(a partition must not auto-out half the map; 0 disables "
+                "— test-scaled default, the reference ships 0.75)"),
+    Option("osd_crush_chooseleaf_type", OPT_STR, "osd",
+           desc="default crush failure domain for new pool rules when "
+                "the profile names none (chooseleaf bucket type; 'osd' "
+                "keeps device-level placement)"),
     Option("crush_num_hosts", OPT_INT, 0,
            desc="vstart: spread OSDs over this many synthetic hosts in "
                 "the crush map (0 = flat osd-level map)"),

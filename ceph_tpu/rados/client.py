@@ -78,6 +78,10 @@ from ceph_tpu.rados.types import (
     MSetUpmap,
     MMarkDown,
     MOsdMembership,
+    MCrushOp,
+    MCrushOpReply,
+    MOsdPredicate,
+    MOsdPredicateReply,
     MOSDOp,
     MOSDOpReply,
     MSnapOp,
@@ -377,7 +381,8 @@ class RadosClient:
             return
         if isinstance(msg, (MMapReply, MCreatePoolReply, MConfigReply,
                             MAuthTicketReply, MSnapOpReply, MHealthReply,
-                            MLogReply, MCrashQueryReply)):
+                            MLogReply, MCrashQueryReply,
+                            MCrushOpReply, MOsdPredicateReply)):
             # the mon echoes our per-RPC tid (like MOSDOp's reqid): a reply
             # landing after its RPC timed out has a stale tid and is dropped
             # instead of fulfilling the next RPC's future
@@ -612,6 +617,49 @@ class RadosClient:
         """`ceph osd crush reweight osd.<id> <w>`: the straw2 crush
         weight (nominal device capacity share)."""
         await self._osd_membership("crush-reweight", osd_id, weight)
+
+    async def osd_crush_op(self, op: str, name: str, *,
+                           bucket_type: str = "", dest: str = "",
+                           weight: float = 1.0,
+                           force: bool = False) -> int:
+        """`ceph osd crush add-bucket/add/set/move/rm`: runtime CRUSH
+        hierarchy surgery.  Raises RadosError on refusal (validation is
+        mon-side; a failure means the map is untouched); returns the
+        post-mutation epoch."""
+        reply = await self._mon_rpc(
+            MCrushOp(op=op, name=name, bucket_type=bucket_type,
+                     dest=dest, weight=float(weight), force=force))
+        if not reply.ok:
+            raise RadosError(reply.error)
+        await self.refresh_map(min_epoch=reply.epoch)
+        return reply.epoch
+
+    async def osd_purge(self, osd_id: int, force: bool = False) -> None:
+        """`ceph osd purge <id>`: remove the OSD from the map and crush
+        permanently.  The mon refuses while the OSD is up or (unless
+        ``force``) while safe-to-destroy says data could be lost; a
+        refusal surfaces as RadosError (the id survives in the replied
+        map)."""
+        await self._osd_membership("purge-force" if force else "purge",
+                                   osd_id)
+        if self.osdmap is not None and osd_id in self.osdmap.osds:
+            raise RadosError(
+                f"osd.{osd_id} purge refused by the mon (still up, or "
+                f"not safe-to-destroy — see the cluster log)")
+
+    async def osd_predicate(self, op: str, osd_ids: List[int]):
+        """`ceph osd safe-to-destroy / ok-to-stop`: the data-safety
+        predicates, served as reads at ANY mon.  Returns the typed
+        MOsdPredicateReply (safe, unsafe_ids, reasons, pgs_checked,
+        dirty_blocked, dirty_keys)."""
+        return await self._mon_rpc(
+            MOsdPredicate(op=op, osd_ids=[int(i) for i in osd_ids]))
+
+    async def osd_safe_to_destroy(self, osd_id: int):
+        return await self.osd_predicate("safe-to-destroy", [osd_id])
+
+    async def osd_ok_to_stop(self, *osd_ids: int):
+        return await self.osd_predicate("ok-to-stop", list(osd_ids))
 
     def _parse_pgid(self, pgid: str) -> Tuple[int, int]:
         pool_part, pg_part = str(pgid).split(".", 1)

@@ -147,6 +147,69 @@ class CrushMap:
             i for b in self.buckets.values() for i in b.items if i >= 0
         )
 
+    def parent_of(self, item: int) -> Optional[int]:
+        """Containing bucket id, or None for the root / detached items."""
+        for b in self.buckets.values():
+            if item in b.items:
+                return b.id
+        return None
+
+    def in_subtree(self, root: int, item: int) -> bool:
+        """True when `item` sits anywhere under bucket `root` (the cycle
+        guard for `crush move`: a bucket must never move under its own
+        descendant)."""
+        seen: Set[int] = set()
+        stack = [root]
+        while stack:
+            bid = stack.pop()
+            if bid >= 0 or bid in seen:
+                continue
+            seen.add(bid)
+            b = self.buckets.get(bid)
+            if b is None:
+                continue
+            if item in b.items:
+                return True
+            stack.extend(i for i in b.items if i < 0)
+        return False
+
+    def subtree_devices(self, item: int) -> List[int]:
+        """Every device id under `item` (a device is its own subtree)."""
+        if item >= 0:
+            return [item]
+        out: List[int] = []
+        seen: Set[int] = set()
+        stack = [item]
+        while stack:
+            bid = stack.pop()
+            if bid in seen:
+                continue
+            seen.add(bid)
+            b = self.buckets.get(bid)
+            if b is None:
+                continue
+            for i in b.items:
+                if i >= 0:
+                    out.append(i)
+                else:
+                    stack.append(i)
+        return sorted(out)
+
+    def sig(self) -> Tuple:
+        """Canonical topology signature — buckets (type/name/membership/
+        stored weights), device weights, rule names.  OSDMapIncremental
+        compares signatures so bucket-only edits (`crush move`,
+        `crush add-bucket`) ship the crush map even when the device set
+        itself did not change."""
+        return (
+            tuple(sorted(
+                (bid, b.type, b.name, tuple(b.items),
+                 tuple(sorted(b.weights.items())))
+                for bid, b in self.buckets.items())),
+            tuple(sorted(self.device_weights.items())),
+            tuple(sorted(self.rules)),
+        )
+
     # -- rules ---------------------------------------------------------------
 
     def add_simple_rule(

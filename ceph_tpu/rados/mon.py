@@ -32,6 +32,7 @@ import uuid
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ceph_tpu.common.context import Context
+from ceph_tpu.common.perf_counters import PerfCountersBuilder
 from ceph_tpu.ec.interface import ErasureCodeError
 from ceph_tpu.ec.registry import registry
 from ceph_tpu.rados.auth import KeyServer
@@ -68,6 +69,8 @@ from ceph_tpu.rados.types import (
     MConfigSet,
     MCreatePool,
     MCreatePoolReply,
+    MCrushOp,
+    MCrushOpReply,
     MDeletePool,
     MForward,
     MForwardReply,
@@ -83,6 +86,8 @@ from ceph_tpu.rados.types import (
     MOSDPGTemp,
     MOsdBoot,
     MOsdMembership,
+    MOsdPredicate,
+    MOsdPredicateReply,
     MOSDSetFlag,
     MPoolSet,
     MSetFullRatio,
@@ -175,6 +180,21 @@ class Monitor:
         self.ctx = Context(f"mon.{rank}",
                            conf if isinstance(conf, dict) else None)
         self.messenger.log = self.ctx.log
+        # membership-lifecycle observability (rides perf dump -> the
+        # mon's MMgrReport push -> mgr /metrics -> BENCH record)
+        self.perf = self.ctx.perf.add(
+            PerfCountersBuilder("mon")
+            .add_u64_counter("auto_outs",
+                             "down OSDs auto-marked out after "
+                             "mon_osd_down_out_interval")
+            .add_u64_counter("crush_moves",
+                             "crush topology mutations applied "
+                             "(add-bucket/add/set/move/rm)")
+            .add_u64_counter("predicate_queries",
+                             "safe-to-destroy / ok-to-stop reads served")
+            .add_u64_counter("predicate_refusals",
+                             "predicate reads answered unsafe")
+            .create_perf_counters())
         # cluster log + crash registry (reference LogMonitor + mgr/crash):
         # state rides the paxos snapshot below, so it MUST exist before
         # the state recovery; watchers (`ceph -w` sessions) are
@@ -207,6 +227,16 @@ class Monitor:
         self._applied_tids: "Dict[str, Any]" = {}
         # target_osd -> {reporter: stamp} (OSD failure reports)
         self._failure_reports: Dict[int, Dict[int, float]] = {}
+        # osd -> monotonic stamp it went down (the auto-out countdown).
+        # Leader-runtime like _last_ping: a leader change restarts the
+        # countdown — hysteresis, never premature outs.
+        self._down_since: Dict[int, float] = {}
+        # osd -> latest unflushed-dirt roster from MPing v5
+        # [("pool:oid", [holders]), ...].  EVERY mon records it (peons
+        # snoop the pings they forward), so the safe-to-destroy read
+        # serves at any mon without a leader round-trip.
+        self._osd_dirty: Dict[int, List] = {}
+        self._mgr_ticks = 0
         self._last_rotation = time.monotonic()
         # peer rank -> reachability EMA (ConnectionTracker role)
         self._conn_scores: Dict[int, float] = {}
@@ -622,6 +652,107 @@ class Monitor:
                 time.monotonic() + msg.ttl if msg.ttl > 0 else float("inf"))
         return MHealthReply(tid=msg.tid, health=self.health_summary())
 
+    # -- data-safety predicates (reference OSDMonitor ok-to-stop /
+    # safe-to-destroy, OSDMonitor.cc) ---------------------------------------
+
+    def _predicate_reply(self, msg: MOsdPredicate) -> MOsdPredicateReply:
+        self.perf.inc("predicate_queries")
+        if msg.op not in ("safe-to-destroy", "ok-to-stop"):
+            self.perf.inc("predicate_refusals")
+            return MOsdPredicateReply(
+                tid=msg.tid, op=msg.op, safe=False,
+                reasons=[f"EINVAL: unknown predicate {msg.op!r}"])
+        if not msg.osd_ids:
+            self.perf.inc("predicate_refusals")
+            return MOsdPredicateReply(
+                tid=msg.tid, op=msg.op, safe=False,
+                reasons=["EINVAL: no osd ids"])
+        v = self._predicate_verdict(msg.op, list(msg.osd_ids))
+        if not v["safe"]:
+            self.perf.inc("predicate_refusals")
+        return MOsdPredicateReply(
+            tid=msg.tid, op=msg.op, safe=v["safe"],
+            unsafe_ids=v["unsafe_ids"], reasons=v["reasons"],
+            pgs_checked=v["pgs_checked"],
+            dirty_blocked=v["dirty_blocked"], dirty_keys=v["dirty_keys"])
+
+    def _predicate_verdict(self, op: str, ids: List[int]) -> Dict[str, Any]:
+        """ok-to-stop: would stopping these OSDs leave every PG at or
+        above min_size?  safe-to-destroy: is NO shard's last copy on the
+        targets — not mapped to any PG, every PG fully recovered (a hole
+        anywhere may be data that lives only on the target), and no
+        unflushed dirty object whose last live copy the targets hold
+        (the r22 fast-ack clause: raw dirty replicas are acked client
+        data that exists nowhere else until destage)."""
+        m = self.osdmap
+        targets = sorted({int(i) for i in ids})
+        unknown = [t for t in targets if t not in m.osds]
+        if unknown:
+            return {"safe": False, "unsafe_ids": unknown,
+                    "reasons": [f"ENOENT: osd.{t} not in the osdmap"
+                                for t in unknown],
+                    "pgs_checked": 0, "dirty_blocked": 0, "dirty_keys": []}
+        reasons: List[str] = []
+        unsafe: Set[int] = set()
+        pgs = 0
+        tset = set(targets)
+        stop = op == "ok-to-stop"
+        for pool in m.pools.values():
+            for pg in range(pool.pg_num):
+                pgs += 1
+                acting = m.pg_to_acting(pool, pg)
+                live = [a for a in acting if a != CRUSH_ITEM_NONE]
+                if stop:
+                    after = [a for a in live if a not in tset]
+                    if len(after) < pool.min_size and len(after) < len(live):
+                        hit = sorted(set(live) & tset)
+                        unsafe.update(hit)
+                        if len(reasons) < 8:
+                            reasons.append(
+                                f"pg {pool.pool_id}.{pg:x} would drop to "
+                                f"{len(after)} live < min_size "
+                                f"{pool.min_size} without osd {hit}")
+                    continue
+                hit = sorted(set(live) & tset)
+                if hit:
+                    unsafe.update(hit)
+                    if len(reasons) < 8:
+                        reasons.append(
+                            f"pg {pool.pool_id}.{pg:x} still maps to "
+                            f"osd {hit} (out + drain first)")
+                elif len(live) < pool.size:
+                    # conservatively unsafe: an unrecovered hole may be
+                    # a shard whose only copy sits on the target
+                    unsafe.update(targets)
+                    if len(reasons) < 8:
+                        reasons.append(
+                            f"pg {pool.pool_id}.{pg:x} not fully "
+                            f"recovered ({len(live)}/{pool.size} live)")
+        # the cache-dirt clause: a target holding the LAST live copy of
+        # un-destaged dirt blocks both predicates (dirty pages are acked
+        # client data; the other holders are the only survivors)
+        dirty_blocked = 0
+        dirty_keys: List[str] = []
+        up = {o for o, i in m.osds.items() if i.up}
+        for t in targets:
+            for key, holders in (self._osd_dirty.get(t) or []):
+                others = [h for h in holders
+                          if h != t and h not in tset and h in up]
+                if not others:
+                    dirty_blocked += 1
+                    unsafe.add(t)
+                    if len(dirty_keys) < 8:
+                        dirty_keys.append(f"{key}@osd.{t}")
+        if dirty_blocked:
+            reasons.append(
+                f"{dirty_blocked} unflushed dirty object(s) whose last "
+                f"live copy sits on the target(s) — flush the cache tier "
+                f"first")
+        return {"safe": not unsafe and not reasons,
+                "unsafe_ids": sorted(unsafe), "reasons": reasons,
+                "pgs_checked": pgs, "dirty_blocked": dirty_blocked,
+                "dirty_keys": dirty_keys}
+
     # -- elections -----------------------------------------------------------
 
     async def _run_election(self) -> None:
@@ -876,8 +1007,13 @@ class Monitor:
                                 peer, {"op": "lease", "epoch": self.logic.epoch,
                                        "quorum": sorted(self.logic.quorum),
                                        "version": self.store.last_committed})
-                # OSD liveness: mark laggards down+out (countdown starts at
-                # first observation, so a never-pinging OSD still expires)
+                # OSD liveness: mark laggards down (countdown starts at
+                # first observation, so a never-pinging OSD still
+                # expires).  DOWN is immediate at the grace; OUT is the
+                # auto-out pass's separate decision after
+                # mon_osd_down_out_interval — down PGs hole instantly,
+                # placement only redraws when the interval (plus the
+                # noout/min_in_ratio gates) says the death is real.
                 changed = False
                 for osd_id, info in self.osdmap.osds.items():
                     if not info.up:
@@ -885,7 +1021,7 @@ class Monitor:
                     last = self._last_ping.setdefault(osd_id, now)
                     if now - last > self._grace:
                         info.up = False
-                        info.in_cluster = False  # auto-out for remap
+                        self._down_since.setdefault(osd_id, now)
                         changed = True
                         # the cluster log IS the operator's record of a
                         # daemon death (a crashed OSD simply stops
@@ -895,6 +1031,7 @@ class Monitor:
                             "cluster", CLOG_WARN,
                             f"osd.{osd_id} marked down (no ping for "
                             f"{now - last:.1f}s)")
+                changed |= self._auto_out_pass(now)
                 if changed:
                     self.osdmap.epoch += 1
                     try:
@@ -916,6 +1053,81 @@ class Monitor:
                 for tid, (_fconn, t0) in list(self._pending_forwards.items()):
                     if t0 < cutoff:
                         self._pending_forwards.pop(tid, None)
+            # push perf/status to the mgr on the OSD's cadence (every
+            # third tick) so the membership counters reach /metrics
+            self._mgr_ticks += 1
+            if self._mgr_ticks % 3 == 0:
+                await self._report_to_mgr()
+
+    def _auto_out_pass(self, now: float) -> bool:
+        """Auto-out of persistently-down OSDs (reference OSDMonitor tick,
+        mon_osd_down_out_interval), gated three ways: the interval itself
+        (0 disables), the `noout` osdmap flag (marking freezes; the
+        countdown keeps running), and the mon_osd_min_in_ratio floor so a
+        partition cannot auto-out half the map.  Admin-out stickiness is
+        NOT set: a rejoining OSD auto-marks in again (reference
+        auto-out/auto-in pairing).  Returns True when the map changed
+        (caller bumps the epoch and commits)."""
+        interval = float(
+            self.conf.get("mon_osd_down_out_interval", 0.6) or 0.0)
+        if interval <= 0:
+            return False
+        if "noout" in (getattr(self.osdmap, "flags", []) or []):
+            return False
+        changed = False
+        total = len(self.osdmap.osds)
+        n_in = sum(1 for o in self.osdmap.osds.values() if o.in_cluster)
+        floor = float(self.conf.get("mon_osd_min_in_ratio", 0.0) or 0.0)
+        for osd_id, info in sorted(self.osdmap.osds.items()):
+            if info.up or not info.in_cluster:
+                continue
+            since = self._down_since.setdefault(osd_id, now)
+            if now - since < interval:
+                continue
+            if floor > 0 and total and (n_in - 1) / total < floor:
+                self.logm.log(
+                    "cluster", CLOG_WARN,
+                    f"osd.{osd_id} down {now - since:.1f}s but NOT "
+                    f"auto-marked out: in-ratio {n_in - 1}/{total} would "
+                    f"drop below mon_osd_min_in_ratio ({floor:g})")
+                # restart the countdown so the refusal re-logs once per
+                # interval instead of every tick
+                self._down_since[osd_id] = now
+                continue
+            info.in_cluster = False
+            n_in -= 1
+            changed = True
+            self.perf.inc("auto_outs")
+            self.logm.log(
+                "cluster", CLOG_WARN,
+                f"osd.{osd_id} auto-marked out after being down "
+                f"{max(0.0, now - since):.1f}s "
+                f"(mon_osd_down_out_interval)")
+        return changed
+
+    async def _report_to_mgr(self) -> None:
+        """Push perf/status to the mgr (MMgrReport flow, the OSD's
+        _report_to_mgr discipline) when one is configured."""
+        raw = self.conf.get("mgr_addr", "")
+        if not raw:
+            return
+        try:
+            host, port = str(raw).rsplit(":", 1)
+            from ceph_tpu.mgr.daemon import MMgrReport
+
+            await asyncio.wait_for(
+                self.messenger.send(
+                    (host, int(port)),
+                    MMgrReport(name=f"mon.{self.rank}",
+                               perf=self.ctx.perf.dump(),
+                               status=self.quorum_status(),
+                               stamp=time.time()),
+                    peer_type="mgr"),
+                timeout=2.0)  # a stalled mgr must not starve the tick
+        except TRANSPORT_ERRORS:
+            pass
+        except asyncio.TimeoutError:
+            pass
 
     # -- mon-mon send helpers ------------------------------------------------
 
@@ -959,7 +1171,7 @@ class Monitor:
     # degraded cluster HEALTH_OK.  MLog/MCrashReport/MCrashQuery are
     # LogMonitor state: replicated, so leader-only mutations.
     WRITE_TYPES = (MOsdBoot, MCreatePool, MDeletePool, MMarkDown,
-                   MOsdMembership,
+                   MOsdMembership, MCrushOp,
                    MConfigSet, MOSDFailure,
                    MOSDPGTemp, MSetUpmap, MPoolSet, MSnapOp, MOSDSetFlag,
                    MSetFullRatio,
@@ -971,7 +1183,7 @@ class Monitor:
     # pg_temp churn, log pushes) would drown the channel and is not an
     # operator action
     AUDIT_TYPES = (MCreatePool, MDeletePool, MMarkDown, MOsdMembership,
-                   MConfigSet,
+                   MCrushOp, MConfigSet,
                    MSetUpmap, MPoolSet, MSnapOp, MOSDSetFlag,
                    MSetFullRatio, MHealthMute, MCrashQuery)
 
@@ -1074,6 +1286,13 @@ class Monitor:
                     reply = MCommandReply(tid=msg.tid, ok=False,
                                           error=f"{type(e).__name__}: {e}")
             await conn.send(reply)
+        elif isinstance(msg, MOsdPredicate):
+            # safe-to-destroy / ok-to-stop are READS served at ANY mon:
+            # the map replicates via paxos and every mon snoops the
+            # dirt roster off the pings it sees or forwards — no leader
+            # round-trip, no audit entry (a predicate poll loop must not
+            # evict real events from the bounded audit tail)
+            await conn.send(self._predicate_reply(msg))
         elif isinstance(msg, MPing):
             await self._handle_ping(conn, msg)
         elif isinstance(msg, self.WRITE_TYPES):
@@ -1106,6 +1325,12 @@ class Monitor:
 
     async def _handle_ping(self, conn, msg: MPing) -> None:
         if not self.is_leader:
+            # snoop the dirt roster before relaying: predicates are READS
+            # served at any mon, and this peon's copy of the v5 tail is
+            # what makes its safe-to-destroy answer honest
+            dirty = getattr(msg, "cache_dirty", None)
+            if dirty is not None:
+                self._osd_dirty[msg.osd_id] = list(dirty)
             # relay liveness to the leader (fire and forget; a dead leader
             # is the lease-lapse path's problem, not the ping's)
             if self.leader_addr is not None:
@@ -1146,12 +1371,20 @@ class Monitor:
         statfs = getattr(msg, "statfs", None)
         if statfs:
             self._osd_statfs[msg.osd_id] = dict(statfs)
+        # unflushed-dirt roster (v5 field): the safe-to-destroy input.
+        # The LATEST report wins; an empty list actively clears it
+        # (destage completed) — a missing field (old daemon) leaves the
+        # last report standing, conservatively.
+        dirty = getattr(msg, "cache_dirty", None)
+        if dirty is not None:
+            self._osd_dirty[msg.osd_id] = list(dirty)
         changed = self._derive_fullness()
         info = self.osdmap.osds.get(msg.osd_id)
         rejoined = info is not None and not info.up
         if rejoined:
             info.up = True
             info.in_cluster = msg.osd_id not in self._admin_out
+            self._down_since.pop(msg.osd_id, None)  # auto-out hysteresis
             changed = True
         if changed:
             self.osdmap.epoch += 1
@@ -1394,13 +1627,25 @@ class Monitor:
             info = self.osdmap.osds.get(msg.osd_id)
             if info is not None and info.up:
                 info.up = False
-                info.in_cluster = False
                 self._last_ping[msg.osd_id] = -1e9
-                self.osdmap.epoch += 1
+                # backdate the auto-out countdown so an admin mark-down
+                # outs immediately — but still through _auto_out_pass,
+                # so `noout` and the min_in_ratio floor are honored
+                self._down_since[msg.osd_id] = -1e9
                 self.logm.log("cluster", CLOG_WARN,
                               f"osd.{msg.osd_id} marked down (admin)")
+                self._auto_out_pass(time.monotonic())
+                self.osdmap.epoch += 1
                 await self._commit_state()
             return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+        if isinstance(msg, MCrushOp):
+            reply = self._apply_crush_op(msg)
+            if reply.ok:
+                self.osdmap.epoch += 1
+                reply.epoch = self.osdmap.epoch
+                self.perf.inc("crush_moves")
+                await self._commit_state()
+            return reply
         if isinstance(msg, MOsdMembership):
             # `ceph osd out/in/reweight/crush reweight` (reference
             # OSDMonitor prepare_command): audited admin membership
@@ -1445,6 +1690,39 @@ class Monitor:
                     self.logm.log("cluster", CLOG_INFO,
                                   f"osd.{msg.osd_id} crush weight set "
                                   f"to {w:g}")
+            elif msg.op in ("purge", "purge-force"):
+                # `ceph osd purge`: remove the OSD from map and crush for
+                # good (OSDMonitor "osd purge").  Refused while the OSD is
+                # up, and — unless forced — while safe-to-destroy says the
+                # target may hold the last copy of anything.  Refusal is
+                # signalled by the id surviving in the replied map.
+                if info.up:
+                    self.logm.log(
+                        "cluster", CLOG_WARN,
+                        f"osd.{msg.osd_id} purge refused: still up "
+                        f"(stop it first)")
+                    return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+                if msg.op != "purge-force":
+                    v = self._predicate_verdict("safe-to-destroy",
+                                                [msg.osd_id])
+                    if not v["safe"]:
+                        self.logm.log(
+                            "cluster", CLOG_WARN,
+                            f"osd.{msg.osd_id} purge refused: "
+                            f"{'; '.join(v['reasons'][:2]) or 'not safe'}")
+                        return MMapReply(osdmap=self.osdmap, tid=msg.tid)
+                self.osdmap.crush.remove_item(msg.osd_id)
+                del self.osdmap.osds[msg.osd_id]
+                self._admin_out.discard(msg.osd_id)
+                for d in (self._osd_statfs, self._osd_dirty,
+                          self._down_since, self._last_ping,
+                          getattr(self.osdmap, "full_osds", None) or {}):
+                    d.pop(msg.osd_id, None)
+                changed = True
+                self.logm.log("cluster", CLOG_INFO,
+                              f"osd.{msg.osd_id} purged"
+                              + (" (forced)"
+                                 if msg.op == "purge-force" else ""))
             if changed:
                 self.osdmap.epoch += 1
             # admin_out stickiness changed even when the map did not
@@ -1464,9 +1742,12 @@ class Monitor:
             need = int(self.conf.get("mon_osd_min_down_reporters", 1) or 1)
             info = self.osdmap.osds.get(msg.target_osd)
             if info is not None and info.up and len(reporters) >= need:
+                # down only — `out` follows later via _auto_out_pass once
+                # mon_osd_down_out_interval elapses (hysteresis: a blip
+                # re-pings back in before any data moves)
                 info.up = False
-                info.in_cluster = False
                 self._last_ping[msg.target_osd] = -1e9
+                self._down_since.setdefault(msg.target_osd, now)
                 self.osdmap.epoch += 1
                 self._failure_reports.pop(msg.target_osd, None)
                 self.logm.log(
@@ -1828,6 +2109,9 @@ class Monitor:
             return MCrashReportAck(tid=tid, ok=False)
         if isinstance(msg, MCrashQuery):
             return MCrashQueryReply(tid=tid, ok=False, error=error)
+        if isinstance(msg, MCrushOp):
+            return MCrushOpReply(tid=tid, ok=False, error=error,
+                                 epoch=self.osdmap.epoch)
         if isinstance(msg, (MMarkDown, MGetMap, MPing, MOSDFailure,
                             MOSDPGTemp, MSetUpmap, MPoolSet, MOSDSetFlag,
                             MOsdMembership)):
@@ -1844,7 +2128,7 @@ class Monitor:
         info = self.osdmap.osds.get(osd_id)
         if info is None:
             self.osdmap.osds[osd_id] = OsdInfo(osd_id=osd_id, addr=tuple(msg.addr))
-            self._rebuild_crush()
+            self._crush_add_osd(osd_id)
         else:
             info.addr = tuple(msg.addr)
             info.up = True
@@ -1852,6 +2136,7 @@ class Monitor:
             # operator's `osd out` survives the daemon's restarts until
             # an explicit `osd in` (reference noin discipline)
             info.in_cluster = osd_id not in self._admin_out
+        self._down_since.pop(osd_id, None)  # auto-out hysteresis reset
         self._last_ping[osd_id] = time.monotonic()
         self.osdmap.epoch += 1
         self.logm.log("cluster", CLOG_INFO,
@@ -1863,28 +2148,164 @@ class Monitor:
 
     # -- pool / profile lifecycle -------------------------------------------
 
-    def _rebuild_crush(self) -> None:
-        """Rebuild the crush tree over the current OSD set (flat by
-        default; host buckets when crush_num_hosts is configured),
-        re-apply stored per-device crush weights, and re-register every
-        pool's rule with its failure domain."""
-        ids = sorted(self.osdmap.osds)
+    def _crush_add_osd(self, osd_id: int) -> None:
+        """Incrementally place a freshly-allocated OSD into the crush
+        tree — topology-preserving: runtime `osd crush` surgery (moved
+        hosts, operator buckets) survives later boots, unlike a
+        from-scratch rebuild.  Default placement mirrors the old
+        bootstrap shapes: under `host{id % crush_num_hosts}` when hosts
+        are configured, else directly under the root."""
+        crush = self.osdmap.crush
+        if osd_id in crush.devices():
+            return
+        if crush.root_id == 0:
+            crush.add_bucket("root", "default")
         n_hosts = int(self.conf.get("crush_num_hosts", 0) or 0)
-        self.osdmap.crush = (
-            CrushMap.with_hosts(ids, n_hosts) if n_hosts else CrushMap.flat(ids)
-        )
-        # a rebuild (new OSD boot) must not reset `osd crush reweight`:
-        # the authoritative weights live on the OsdInfo records
-        for osd_id, info in self.osdmap.osds.items():
-            w = osd_crush_weight(info)
-            if w != 1.0:
-                self.osdmap.crush.set_weight(osd_id, w)
-        for pool in self.osdmap.pools.values():
-            self.osdmap.crush.add_simple_rule(
-                pool.rule,
-                failure_domain=pool.profile.get("crush-failure-domain", "osd"),
-                mode="indep" if pool.pool_type == "ec" else "firstn",
-            )
+        dest = crush.root_id
+        if n_hosts:
+            hname = f"host{osd_id % n_hosts}"
+            host = crush.bucket_by_name(hname)
+            if host is None:
+                hid = crush.add_bucket("host", hname)
+                crush.add_item(crush.root_id, hid, 0.0)
+                host = crush.buckets[hid]
+            dest = host.id
+        info = self.osdmap.osds[osd_id]
+        crush.add_item(dest, osd_id, osd_crush_weight(info))
+
+    def _parse_crush_item(self, name: str) -> Optional[int]:
+        """'osd.N' -> device id N; bucket name -> (negative) bucket id;
+        None when the name resolves to nothing."""
+        if name.startswith("osd."):
+            try:
+                return int(name[4:])
+            except ValueError:
+                return None
+        b = self.osdmap.crush.bucket_by_name(name)
+        return b.id if b is not None else None
+
+    def _apply_crush_op(self, msg: MCrushOp) -> MCrushOpReply:
+        """`ceph osd crush add-bucket/add/set/move/rm` (reference
+        OSDMonitor prepare_command crush arms).  Validates fully before
+        mutating — an error reply means the map is untouched."""
+        crush = self.osdmap.crush
+        ok = MCrushOpReply(tid=msg.tid, ok=True, epoch=self.osdmap.epoch)
+
+        def err(e: str) -> MCrushOpReply:
+            return MCrushOpReply(tid=msg.tid, ok=False, error=e,
+                                 epoch=self.osdmap.epoch)
+
+        if msg.op == "add-bucket":
+            if not msg.name or not msg.bucket_type:
+                return err("EINVAL: add-bucket needs <name> <type>")
+            if msg.bucket_type == CrushMap.DEVICE_TYPE:
+                return err("EINVAL: bucket type may not be 'osd'")
+            if msg.name.startswith("osd.") \
+                    or crush.bucket_by_name(msg.name) is not None:
+                return err(f"EEXIST: {msg.name!r} already names an item")
+            dest_id = crush.root_id
+            if msg.dest:
+                dest = self._parse_crush_item(msg.dest)
+                if dest is None or dest >= 0:
+                    return err(f"ENOENT: no bucket {msg.dest!r}")
+                dest_id = dest
+            bid = crush.add_bucket(msg.bucket_type, msg.name)
+            # stored weight on the parent edge is informational — the
+            # placement weight of a bucket is always its subtree sum
+            crush.add_item(dest_id, bid, 0.0)
+            self.logm.log("cluster", CLOG_INFO,
+                          f"crush add-bucket {msg.name} "
+                          f"({msg.bucket_type}) under "
+                          f"{msg.dest or 'default'}")
+            return ok
+
+        if msg.op in ("add", "set"):
+            item = self._parse_crush_item(msg.name)
+            if item is None or item < 0:
+                return err(f"EINVAL: {msg.op} places a device "
+                           f"('osd.N'), got {msg.name!r}")
+            if item not in self.osdmap.osds:
+                return err(f"ENOENT: osd.{item} not in the osdmap")
+            if msg.op == "add" and item in crush.devices():
+                return err(f"EEXIST: osd.{item} already placed "
+                           f"(use `crush set` or `crush move`)")
+            dest_id = crush.root_id
+            if msg.dest:
+                dest = self._parse_crush_item(msg.dest)
+                if dest is None or dest >= 0:
+                    return err(f"ENOENT: no bucket {msg.dest!r}")
+                dest_id = dest
+            w = max(0.0, float(msg.weight))
+            crush.move_item(item, dest_id, w)
+            self.osdmap.osds[item].crush_weight = w
+            self.logm.log("cluster", CLOG_INFO,
+                          f"crush {msg.op} osd.{item} weight {w:g} "
+                          f"under {msg.dest or 'default'}")
+            return ok
+
+        if msg.op == "move":
+            item = self._parse_crush_item(msg.name)
+            if item is None:
+                return err(f"ENOENT: no item {msg.name!r}")
+            if item < 0 and item not in crush.buckets:
+                return err(f"ENOENT: no bucket {msg.name!r}")
+            if item >= 0 and item not in crush.devices():
+                return err(f"ENOENT: osd.{item} not in the crush map")
+            if item == crush.root_id:
+                return err("EINVAL: cannot move the root")
+            dest = self._parse_crush_item(msg.dest)
+            if dest is None or dest >= 0 or dest not in crush.buckets:
+                return err(f"ENOENT: no destination bucket {msg.dest!r}")
+            if item < 0 and (item == dest
+                             or crush.in_subtree(item, dest)):
+                return err(f"EINVAL: moving {msg.name} under "
+                           f"{msg.dest} would create a cycle")
+            if item >= 0:
+                w = osd_crush_weight(self.osdmap.osds[item]) \
+                    if item in self.osdmap.osds \
+                    else crush.device_weights.get(item, 1.0)
+            else:
+                w = 0.0  # bucket placement weight = subtree sum
+            crush.move_item(item, dest, w)
+            self.logm.log("cluster", CLOG_INFO,
+                          f"crush move {msg.name} -> {msg.dest}")
+            return ok
+
+        if msg.op == "rm":
+            item = self._parse_crush_item(msg.name)
+            if item is None:
+                return err(f"ENOENT: no item {msg.name!r}")
+            if item >= 0:
+                if item not in crush.devices():
+                    return err(f"ENOENT: osd.{item} not in the crush map")
+                crush.remove_item(item)
+                self.logm.log("cluster", CLOG_INFO,
+                              f"crush rm osd.{item}")
+                return ok
+            if item not in crush.buckets:
+                return err(f"ENOENT: no bucket {msg.name!r}")
+            if item == crush.root_id:
+                return err("EINVAL: cannot remove the root")
+            bucket = crush.buckets[item]
+            if bucket.items and not msg.force:
+                return err(f"ENOTEMPTY: bucket {msg.name} holds "
+                           f"{len(bucket.items)} item(s) "
+                           f"(--force re-homes them to the parent)")
+            parent = crush.parent_of(item) or crush.root_id
+            rehomed = list(bucket.items)
+            for child in rehomed:
+                cw = (crush.device_weights.get(child, 1.0)
+                      if child >= 0 else 0.0)
+                crush.move_item(child, parent, cw)
+            crush.remove_item(item)
+            del crush.buckets[item]
+            self.logm.log("cluster", CLOG_INFO,
+                          f"crush rm bucket {msg.name}"
+                          + (f" (forced, {len(rehomed)} re-homed)"
+                             if rehomed else ""))
+            return ok
+
+        return err(f"EINVAL: unknown crush op {msg.op!r}")
 
     def _create_pool(self, msg: MCreatePool) -> MCreatePoolReply:
         try:
@@ -1923,7 +2344,9 @@ class Monitor:
             size = int(profile.get("size", "3"))
             min_size = max(1, size // 2 + 1)
             stripe_width = 0
-        fd = profile.get("crush-failure-domain", "osd")
+        # profile wins; else the cluster-wide chooseleaf default
+        fd = profile.get("crush-failure-domain") or str(
+            self.conf.get("osd_crush_chooseleaf_type", "osd") or "osd")
         if fd != "osd" and not any(
             b.type == fd for b in self.osdmap.crush.buckets.values()
         ):

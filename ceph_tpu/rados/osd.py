@@ -1051,7 +1051,10 @@ class OSD:
                           health=self._health_checks(),
                           # statfs piggybacks the liveness ping (v4):
                           # the mon's fullness derivation runs on it
-                          statfs=self._statfs()),
+                          statfs=self._statfs(),
+                          # v5: unflushed-dirt roster for the mon's
+                          # safe-to-destroy / ok-to-stop predicates
+                          cache_dirty=self._cache_dirty_summary()),
                 )
             except TRANSPORT_ERRORS:
                 self.mons.rotate()  # that mon looks dead
@@ -5494,6 +5497,27 @@ class OSD:
             if pg >= 0 and info.pg != pg:
                 continue
             out.append((key, info, gen, since))
+        return out
+
+    def _cache_dirty_summary(self) -> List[Tuple[str, List[int]]]:
+        """The safe-to-destroy roster riding MPing (v5): every
+        un-destaged dirty object this OSD holds, with the full live-copy
+        holder set.  Raw fast-ack records carry their cache replica
+        roster (the acked bytes exist ONLY on those peers until
+        destage); deferred-apply WritebackRecords are purely local dirt.
+        The mon's predicates refuse destroy/stop while a target is the
+        last live holder of any key."""
+        store = self._paged_store()
+        if store is None:
+            return []
+        out: List[Tuple[str, List[int]]] = []
+        for _key, info, _gen, _since in self._my_dirty_items(store):
+            key = f"{info.pool_id}:{info.oid}"
+            if isinstance(info, CacheDirtyRecord):
+                holders = sorted({*info.peers, info.primary, self.osd_id})
+            else:
+                holders = [self.osd_id]
+            out.append((key, holders))
         return out
 
     def _tier_flush_pass(self, store, target: int, forced: bool) -> None:

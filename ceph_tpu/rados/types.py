@@ -400,6 +400,10 @@ class OSDMap:
             if osd_id in self.osds:
                 self.osds[osd_id].up = up
                 self.osds[osd_id].in_cluster = in_cluster
+        for osd_id in getattr(inc, "removed_osds", None) or []:
+            # `osd purge` removes the record entirely (not just a state
+            # flip); subscribers applying the delta must drop it too
+            self.osds.pop(osd_id, None)
         for pool_id, pool in inc.new_pools.items():
             self.pools[pool_id] = pool
         for pool_id in inc.removed_pools:
@@ -442,6 +446,7 @@ class OSDMapIncremental:
     base_epoch: int = 0
     new_osds: Dict[int, OsdInfo] = field(default_factory=dict)
     osd_states: Dict[int, Tuple[bool, bool]] = field(default_factory=dict)
+    removed_osds: List[int] = field(default_factory=list)  # `osd purge`
     new_pools: Dict[int, PoolInfo] = field(default_factory=dict)
     removed_pools: List[int] = field(default_factory=list)
     new_pg_temp: Dict[Tuple[int, int], List[int]] = field(default_factory=dict)
@@ -470,6 +475,7 @@ class OSDMapIncremental:
                     inc.new_osds[osd_id] = info
                 elif (o.up, o.in_cluster) != (info.up, info.in_cluster):
                     inc.osd_states[osd_id] = (info.up, info.in_cluster)
+        inc.removed_osds = [o for o in old.osds if o not in new.osds]
         for pool_id, pool in new.pools.items():
             if pool_id not in old.pools or old.pools[pool_id] != pool:
                 inc.new_pools[pool_id] = pool
@@ -497,8 +503,15 @@ class OSDMapIncremental:
         for osd_id, aff in new.primary_affinity.items():
             if old.primary_affinity.get(osd_id) != aff:
                 inc.new_primary_affinity[osd_id] = aff
-        if (new.crush.devices() != old.crush.devices()
-                or new.crush.rules.keys() != old.crush.rules.keys()):
+        # full topology signature, not just the device/rule sets: a
+        # bucket-only edit (`crush move` of a host, `crush add-bucket`)
+        # changes placement and MUST ship, or incremental subscribers
+        # would keep mapping with the old tree (sig() is the canonical
+        # form; getattr guards maps pickled before it existed)
+        old_sig = getattr(old.crush, "sig", None)
+        new_sig = getattr(new.crush, "sig", None)
+        if (old_sig is None or new_sig is None
+                or old_sig() != new_sig()):
             inc.crush = new.crush
         return inc
 
@@ -570,7 +583,7 @@ class MDeletePool:
     confirm_name: str = ""  # must equal the pool's name
 
 
-@message(7, version=4)
+@message(7, version=5)
 class MPing:
     osd_id: int = 0
     epoch: int = 0
@@ -587,6 +600,14 @@ class MPing:
     # derives per-OSD NEARFULL/BACKFILLFULL/FULL states from it.  Read
     # with getattr — v3 pickles lack the field (truncated-tail rule).
     statfs: Dict[str, int] = field(default_factory=dict)
+    # v5: unflushed-dirt summary for the safe-to-destroy predicate —
+    # [("pool_id:oid", [holder osd ids...]), ...] naming every raw dirty
+    # copy this OSD pins (fast-ack CacheDirtyRecord adoptions AND local
+    # writeback dirt, whose only durable copy is the dirty page set).
+    # The mon refuses `osd safe-to-destroy` while the target holds the
+    # LAST live copy of any entry.  Read with getattr — v4 pickles lack
+    # the field (truncated-tail rule).
+    cache_dirty: List[Tuple[str, List[int]]] = field(default_factory=list)
 
 
 @message(8)
@@ -606,10 +627,78 @@ class MOsdMembership:
     An admin ``out`` is sticky across reboots (the mon remembers it;
     a booting OSD is auto-marked in only when not admin-out)."""
 
-    op: str = "out"  # out | in | reweight | crush-reweight
+    op: str = "out"  # out | in | reweight | crush-reweight | purge | purge-force
     osd_id: int = 0
     weight: float = 1.0  # reweight / crush-reweight operand
     tid: str = ""
+
+
+@message(86, version=2)
+class MCrushOp:
+    """Runtime CRUSH topology mutation (reference OSDMonitor `osd crush
+    add-bucket/add/set/move/rm`): audited, mon-validated, replicated
+    through the osdmap — bucket-only edits ship via the incremental's
+    crush-signature diff.  Operand meaning by op:
+
+    - ``add-bucket``: create bucket `name` of `bucket_type`; attached
+      under `dest` when given (else left detached until a `move`).
+    - ``add`` / ``set``: place device `name` ("osd.N") under bucket
+      `dest` with crush weight `weight` (`add` refuses an existing
+      placement, `set` upserts — reference semantics).
+    - ``move``: re-parent `name` (device or bucket) under `dest`;
+      refused when it would create a cycle.
+    - ``rm``: detach `name` from the hierarchy (buckets must be empty
+      unless `force`)."""
+
+    op: str = ""        # add-bucket | add | set | move | rm
+    name: str = ""      # "osd.N" or a bucket name
+    bucket_type: str = ""  # add-bucket operand (host/rack/...)
+    dest: str = ""      # destination bucket name
+    weight: float = 1.0
+    tid: str = ""
+    # v2 tail: `rm` of a non-empty bucket needs an explicit override
+    # (decoders default a truncated v1 frame to False — append-only rule)
+    force: bool = False
+
+
+@message(87)
+class MCrushOpReply:
+    """Typed verdict for MCrushOp: ok + the epoch the edit landed in, or
+    a validation error with the map untouched."""
+
+    tid: str = ""
+    ok: bool = True
+    error: str = ""
+    epoch: int = 0
+
+
+@message(88)
+class MOsdPredicate:
+    """Data-safety predicate query (reference OSDMonitor `osd
+    safe-to-destroy` / `osd ok-to-stop`): a READ served at any mon —
+    computed from PG acting sets, min_size margins, and the unflushed
+    dirty-copy roster riding MPing v5."""
+
+    op: str = "safe-to-destroy"  # safe-to-destroy | ok-to-stop
+    osd_ids: List[int] = field(default_factory=list)
+    tid: str = ""
+
+
+@message(89, version=2)
+class MOsdPredicateReply:
+    """Render-friendly predicate verdict: safe/unsafe plus the blocking
+    reasons (capped), the per-osd unsafe subset, and the sweep size."""
+
+    tid: str = ""
+    op: str = ""
+    safe: bool = False
+    unsafe_ids: List[int] = field(default_factory=list)
+    reasons: List[str] = field(default_factory=list)
+    pgs_checked: int = 0
+    # v2 tail: the cache-dirt clause (r22 fast-ack raised the stakes —
+    # a v1 reply was map-only; truncated v1 frames default these)
+    dirty_blocked: int = 0
+    dirty_keys: List[str] = field(default_factory=list)
 
 
 # OSD <-> OSD heartbeats + failure reports (reference MOSDPing.h,
@@ -1654,6 +1743,25 @@ MCacheDirty.FIXED_FIELDS = [
 MCacheDirtyAck.FIXED_FIELDS = [
     ("tid", "s"), ("osd", "q"), ("ok", "?"),
     ("gseq", "Q"),
+]
+# membership-lifecycle control frames: typed fixed layouts (a malformed
+# admin frame must not execute code on decode), control lane, no stripe
+MCrushOp.FIXED_FIELDS = [
+    ("op", "s"), ("name", "s"), ("bucket_type", "s"), ("dest", "s"),
+    ("weight", "d"), ("tid", "s"),
+    ("force", "?"),  # v2 tail (append-only rule; v1 frames default False)
+]
+MCrushOpReply.FIXED_FIELDS = [
+    ("tid", "s"), ("ok", "?"), ("error", "s"), ("epoch", "q"),
+]
+MOsdPredicate.FIXED_FIELDS = [
+    ("op", "s"), ("osd_ids", "Q*"), ("tid", "s"),
+]
+MOsdPredicateReply.FIXED_FIELDS = [
+    ("tid", "s"), ("op", "s"), ("safe", "?"), ("unsafe_ids", "Q*"),
+    ("reasons", "s*"), ("pgs_checked", "q"),
+    # v2 tail: cache-dirt clause (truncated v1 frames default to 0/[])
+    ("dirty_blocked", "q"), ("dirty_keys", "s*"),
 ]
 MPushShard.FIXED_FIELDS = [
     ("pool_id", "q"), ("pg", "q"), ("oid", "s"), ("shard", "q"),

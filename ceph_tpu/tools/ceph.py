@@ -53,6 +53,11 @@ def parse_args(argv=None):
                         "pg scrub PGID | pg repair PGID | "
                         "osd out ID... | osd in ID... | "
                         "osd reweight ID W | osd crush reweight osd.ID W | "
+                        "osd crush add-bucket NAME TYPE [ROOT] | "
+                        "osd crush add|set osd.N W [BUCKET] | "
+                        "osd crush move NAME BUCKET | osd crush rm NAME | "
+                        "osd safe-to-destroy ID... | osd ok-to-stop ID... | "
+                        "osd purge ID | "
                         "osd set-nearfull-ratio R | "
                         "osd set-backfillfull-ratio R | "
                         "osd set-full-ratio R | "
@@ -372,13 +377,24 @@ def _osd_tree(osdmap) -> List[Dict]:
             "in": bool(info and info.in_cluster),
         }
 
+    def subtree_weight(bid: int) -> float:
+        # a bucket's placement weight IS its subtree sum (stored parent
+        # edge weights are informational) — same rule the straw2 draw
+        # applies via _effective_weight
+        total = 0.0
+        for d in crush.subtree_devices(bid):
+            info = osdmap.osds.get(d)
+            total += osd_crush_weight(info) if info \
+                else crush.device_weights.get(d, 1.0)
+        return total
+
     def walk(bid: int, depth: int) -> None:
         b = crush.buckets.get(bid)
         if b is None or bid in seen:
             return
         seen.add(bid)
         rows.append({"id": b.id, "name": b.name, "type": b.type,
-                     "depth": depth})
+                     "depth": depth, "weight": subtree_weight(bid)})
         for item in b.items:
             if item < 0:
                 walk(item, depth + 1)
@@ -406,8 +422,26 @@ def render_osd_tree(rows: List[Dict]) -> List[str]:
                 f"{r['status']}"
                 f"{'' if r.get('in', True) else ' (out)'}")
         else:
-            lines.append(f"{r['id']:>4} {'':>8} {'':>8}  "
-                         f"{pad}{r['type']} {r['name']}")
+            lines.append(f"{r['id']:>4} {r.get('weight', 0.0):>8.4f} "
+                         f"{'':>8}  {pad}{r['type']} {r['name']}")
+    return lines
+
+
+def render_predicate_reply(reply) -> List[str]:
+    """Render an MOsdPredicateReply (`osd safe-to-destroy` /
+    `osd ok-to-stop`).  Pure so tests can pin the layout."""
+    lines = [f"{reply.op}: {'SAFE' if reply.safe else 'NOT SAFE'} "
+             f"({reply.pgs_checked} pgs checked)"]
+    if reply.unsafe_ids:
+        lines.append("  unsafe: "
+                     + ", ".join(f"osd.{i}" for i in reply.unsafe_ids))
+    for r in reply.reasons:
+        lines.append(f"  - {r}")
+    if getattr(reply, "dirty_blocked", 0):
+        lines.append(f"  unflushed dirty objects at risk: "
+                     f"{reply.dirty_blocked}")
+        for k in getattr(reply, "dirty_keys", ()) or ():
+            lines.append(f"    * {k}")
     return lines
 
 
@@ -780,6 +814,85 @@ async def run(args) -> int:
                 return 2
             await client.osd_crush_reweight(osd_id, weight)
             print(f"crush reweighted osd.{osd_id} to {weight:g}")
+            return 0
+        if args.words[:2] == ["osd", "crush"] and len(args.words) >= 3 \
+                and args.words[2] in ("add-bucket", "add", "set",
+                                      "move", "rm"):
+            # `ceph osd crush add-bucket NAME TYPE [ROOT]`
+            # `ceph osd crush add|set osd.N WEIGHT [BUCKET]`
+            # `ceph osd crush move NAME BUCKET`
+            # `ceph osd crush rm NAME [--force via confirm flag]`
+            op, rest = args.words[2], args.words[3:]
+            kw = {}
+            try:
+                if op == "add-bucket":
+                    if len(rest) not in (2, 3):
+                        raise ValueError(
+                            "usage: osd crush add-bucket NAME TYPE [ROOT]")
+                    kw = dict(name=rest[0], bucket_type=rest[1],
+                              dest=rest[2] if len(rest) == 3 else "")
+                elif op in ("add", "set"):
+                    if len(rest) not in (2, 3):
+                        raise ValueError(
+                            f"usage: osd crush {op} osd.N WEIGHT [BUCKET]")
+                    kw = dict(name=rest[0], weight=float(rest[1]),
+                              dest=rest[2] if len(rest) == 3 else "")
+                elif op == "move":
+                    if len(rest) != 2:
+                        raise ValueError(
+                            "usage: osd crush move NAME BUCKET")
+                    kw = dict(name=rest[0], dest=rest[1])
+                else:  # rm
+                    if len(rest) != 1:
+                        raise ValueError("usage: osd crush rm NAME")
+                    kw = dict(name=rest[0],
+                              force=bool(args.confirm_destroy))
+            except ValueError as e:
+                print(str(e), file=sys.stderr)
+                return 2
+            try:
+                epoch = await client.osd_crush_op(op, **kw)
+            except Exception as e:
+                print(f"Error: {e}", file=sys.stderr)
+                return 1
+            print(f"crush {op} {kw['name']} done (epoch {epoch})")
+            return 0
+        if args.words[:2] in (["osd", "safe-to-destroy"],
+                              ["osd", "ok-to-stop"]) \
+                and len(args.words) >= 3:
+            try:
+                ids = [int(w.split(".")[-1]) for w in args.words[2:]]
+            except ValueError:
+                print(f"usage: osd {args.words[1]} ID [ID...]",
+                      file=sys.stderr)
+                return 2
+            reply = await client.osd_predicate(args.words[1], ids)
+            if args.format == "json":
+                print(json.dumps({
+                    "op": reply.op, "safe": reply.safe,
+                    "unsafe_ids": reply.unsafe_ids,
+                    "reasons": reply.reasons,
+                    "pgs_checked": reply.pgs_checked,
+                    "dirty_blocked": reply.dirty_blocked,
+                    "dirty_keys": reply.dirty_keys}))
+            else:
+                for line in render_predicate_reply(reply):
+                    print(line)
+            return 0 if reply.safe else 1
+        if args.words[:2] == ["osd", "purge"] and len(args.words) == 3:
+            try:
+                osd_id = int(args.words[2].split(".")[-1])
+            except ValueError:
+                print("usage: osd purge ID [--yes-i-really-really-"
+                      "mean-it to force]", file=sys.stderr)
+                return 2
+            try:
+                await client.osd_purge(osd_id,
+                                       force=bool(args.confirm_destroy))
+            except Exception as e:
+                print(f"Error: {e}", file=sys.stderr)
+                return 1
+            print(f"purged osd.{osd_id}")
             return 0
         if args.words[:2] in (["pg", "scrub"], ["pg", "repair"]) \
                 and len(args.words) == 3:

@@ -95,6 +95,15 @@ def parse_args(argv=None):
     p.add_argument("--rebalance-seconds", type=float, default=20.0,
                    help="ceiling on each membership-cycle wait")
     p.add_argument("--rebalance-osds", type=int, default=4)
+    # node-lifecycle thrash (CI): the full membership arc — add a host
+    # bucket, crush move, rebalance converges, kill an OSD, auto-out
+    # fires (noout honored first), drain, safe-to-destroy flips green,
+    # purge, byte-identity sweep — under client traffic with zero
+    # acked-op loss, FAILING on any step
+    p.add_argument("--lifecycle", action="store_true")
+    p.add_argument("--lifecycle-seconds", type=float, default=25.0,
+                   help="ceiling on each lifecycle-step wait")
+    p.add_argument("--lifecycle-osds", type=int, default=5)
     # pagestore slab-arm parity (CI): the writeback
     # dirty->flush->evict->cold-re-read cycle run once per slab arm
     # (CEPH_TPU_DEVICE_SLAB=1 child vs =0 child, same deterministic
@@ -812,10 +821,10 @@ def run_tier(args) -> int:
                 # agent cadence (age-driven) so dirty_pages is bounded
                 # after settling — the failing gate below
                 "osd_tier_flush_age": 0.3}
-        # 4-OSD floor: the kill-primary leg below needs a spare OSD so
-        # CRUSH can rebuild a FULL acting set after the kill — with
-        # k+m == n_osds the surviving set keeps a permanent hole and no
-        # destage can ever reach min_size acks
+        # 4-OSD floor: the kill-primary leg needs a SPARE device — the
+        # mon auto-outs the dead OSD (mon_osd_down_out_interval) and
+        # CRUSH rebuilds a full acting set, but only if one exists
+        # (k+m == n_osds leaves a hole no auto-out can fill)
         cluster = Cluster(n_osds=max(4, args.tier_osds), conf=conf)
         await cluster.start()
         failures = []
@@ -1393,7 +1402,9 @@ def run_rebalance(args) -> int:
     Two legs:
 
     1. COEXISTENCE CYCLE: an `osd out` -> backfill-drain -> `osd in` ->
-       refill -> `osd reweight` cycle runs while a RESERVED tenant
+       refill -> `osd reweight` -> crush bucket-move (a host bucket
+       appears and the victim migrates into it, mid-traffic, remap
+       converging to zero degraded PGs) cycle runs while a RESERVED tenant
        (qos_class:gold) and a best-effort tenant drive verified
        read/write traffic AND pool-wide deep scrub fans out — the
        scrub + rebalance + client coexistence the background dmClock
@@ -1508,6 +1519,29 @@ def run_rebalance(args) -> int:
                     await c0.osd_reweight(victim_id, 0.5)
                     await asyncio.sleep(0.5)  # remap settles under load
                     await c0.osd_reweight(victim_id, 1.0)
+                    # bucket-move leg: runtime crush surgery mid-traffic
+                    # — a host bucket appears and the victim migrates
+                    # into it, the remap drains/refills through the same
+                    # recovery machinery, still under the reserved
+                    # tenant's zero-failure bar
+                    await c0.osd_crush_op("add-bucket", "rebal-host",
+                                          bucket_type="host")
+                    await c0.osd_crush_op("move", f"osd.{victim_id}",
+                                          dest="rebal-host")
+
+                    async def move_clean():
+                        # converged AND re-verified: a scrub racing the
+                        # remap can transiently flag (and auto-repair)
+                        # mid-backfill shards — hold the cycle open
+                        # until a clean scrub clears the check
+                        h = await c0.get_health()
+                        checks = h.get("checks") or {}
+                        return ("PG_DEGRADED" not in checks
+                                and "PG_INCONSISTENT" not in checks)
+                    await wait_for(move_clean, args.rebalance_seconds,
+                                   "the bucket-move remap to converge "
+                                   "and re-verify clean",
+                                   failures)
                 finally:
                     cycle_done.set()
 
@@ -1677,6 +1711,194 @@ def run_rebalance(args) -> int:
         for f in failures:
             print(f"FAIL {f}", file=sys.stderr)
         return 1 if failures else 0
+
+    return asyncio.run(go())
+
+
+def run_lifecycle(args) -> int:
+    """Node-lifecycle thrash gate (CI), the acceptance bar of the
+    membership lifecycle plane, runnable as one FAILING command:
+
+        python -m ceph_tpu.tools.non_regression --lifecycle
+
+    One arc, every step verified, all of it under continuous verified
+    client traffic:
+
+      1. `osd crush add-bucket` a host, `osd crush move` an OSD into it
+         — the remap converges to zero degraded PGs mid-traffic.
+      2. Kill a DIFFERENT OSD.  With `noout` set the mon must NOT
+         auto-out it (the freeze flag); after `osd unset noout` the
+         auto-out fires on its own (mon_osd_down_out_interval).
+      3. Recovery drains the dead member: acting sets rebuild full,
+         `osd safe-to-destroy` flips green (it REFUSED while PGs still
+         mapped to the victim or weren't fully recovered).
+      4. `osd purge` removes the victim from map + crush; `osd tree`
+         no longer shows it.
+      5. Byte-identity sweep over every object; the traffic harness
+         must report ZERO acked-op failures across the whole arc.
+    """
+    import asyncio
+    import time as _time
+
+    from ceph_tpu.rados.vstart import Cluster
+    from ceph_tpu.tools.traffic import TenantClass, TrafficHarness
+
+    async def wait_for(pred, seconds, what, failures):
+        deadline = _time.monotonic() + seconds
+        while _time.monotonic() < deadline:
+            r = pred()
+            if asyncio.iscoroutine(r):
+                r = await r
+            if r:
+                return True
+            await asyncio.sleep(0.1)
+        failures.append(f"timed out waiting for {what}")
+        return False
+
+    async def go() -> int:
+        failures: list = []
+        conf = {"osd_auto_repair": True,
+                "osd_heartbeat_interval": 0.1,
+                "osd_repair_delay": 0.1,
+                "osd_recovery_retry": 0.3,
+                "mon_osd_report_grace": 1.5,
+                "mon_osd_down_out_interval": 0.6,
+                "mon_osd_min_in_ratio": 0.3,
+                "client_op_timeout": 30.0,
+                "client_op_deadline": 60.0}
+        cluster = Cluster(n_osds=max(5, args.lifecycle_osds), conf=conf)
+        await cluster.start()
+        try:
+            c = await cluster.client()
+            pool = await c.create_pool("life", profile={
+                "plugin": "jerasure", "technique": "reed_sol_van",
+                "k": "2", "m": "1"})
+            c_t = await cluster.client()
+            traffic = TenantClass("", c_t, tenants=4, workers=2,
+                                  rate=25.0)
+            h = TrafficHarness([traffic], pool, n_objects=24,
+                               obj_size=24 << 10, verify=True)
+            await h.preload()
+            ids = sorted(cluster.osds)
+            moved_id, victim_id = ids[0], ids[1]
+
+            arc_done = asyncio.Event()
+            arc_failures: list = []
+
+            async def arc():
+                try:
+                    # 1. crush surgery + convergence
+                    await c.osd_crush_op("add-bucket", "life-host",
+                                         bucket_type="host")
+                    await c.osd_crush_op("move", f"osd.{moved_id}",
+                                         dest="life-host")
+                    if c.osdmap.crush.parent_of(moved_id) != \
+                            c.osdmap.crush.bucket_by_name("life-host").id:
+                        arc_failures.append(
+                            "crush move did not re-parent the OSD")
+
+                    async def clean():
+                        hh = await c.get_health()
+                        return "PG_DEGRADED" not in (hh.get("checks")
+                                                     or {})
+                    await wait_for(clean, args.lifecycle_seconds,
+                                   "the bucket-move remap to converge",
+                                   arc_failures)
+                    # 2. kill under noout: the freeze flag must hold
+                    await c.osd_set_flag("noout", True)
+                    await cluster.kill_osd(victim_id)
+                    await wait_for(
+                        lambda: _refresh_not_up(c, victim_id),
+                        args.lifecycle_seconds,
+                        "the mon to mark the victim down", arc_failures)
+                    await asyncio.sleep(1.5)  # > down_out_interval
+                    await c.refresh_map()
+                    if not c.osdmap.osds[victim_id].in_cluster:
+                        arc_failures.append(
+                            "auto-out fired UNDER noout (the freeze "
+                            "flag must block it)")
+                    # safe-to-destroy must refuse while PGs still map
+                    # to (or are degraded by) the down victim
+                    r = await c.osd_safe_to_destroy(victim_id)
+                    if r.safe:
+                        arc_failures.append(
+                            "safe-to-destroy said SAFE while the "
+                            "victim's PGs were still degraded")
+                    # 3. unset -> auto-out fires on its own
+                    await c.osd_set_flag("noout", False)
+
+                    async def outed():
+                        await c.refresh_map()
+                        i = c.osdmap.osds[victim_id]
+                        return (not i.up) and (not i.in_cluster)
+                    await wait_for(outed, args.lifecycle_seconds,
+                                   "auto-out after noout cleared",
+                                   arc_failures)
+                    # drain: recovery rebuilds full acting sets
+
+                    async def std_green():
+                        await c.refresh_map()
+                        return (await c.osd_safe_to_destroy(
+                            victim_id)).safe
+                    await wait_for(std_green,
+                                   max(args.lifecycle_seconds, 40.0),
+                                   "safe-to-destroy to flip green",
+                                   arc_failures)
+                    # 4. purge: gone from map AND crush
+                    await c.osd_purge(victim_id)
+                    await c.refresh_map()
+                    if victim_id in c.osdmap.osds:
+                        arc_failures.append("victim still in the "
+                                            "osdmap after purge")
+                    if victim_id in c.osdmap.crush.devices():
+                        arc_failures.append("victim still in the "
+                                            "crush map after purge")
+                finally:
+                    arc_done.set()
+
+            loop = asyncio.get_running_loop()
+            arc_task = loop.create_task(arc())
+            phases = [await h.run_phase("lifecycle", 4.0, 0.25)]
+            while not arc_task.done():
+                phases.append(await h.run_phase("lifecycle-tail", 2.0,
+                                                0.25))
+            await arc_task
+            failures.extend(arc_failures)
+            # 5. zero acked-op loss + byte identity
+            lost = sum(ph.summary().get("default", {}).get(
+                "failures", 0) for ph in phases)
+            if lost:
+                failures.append(f"{lost} acked-op failures during the "
+                                f"lifecycle arc (must be 0)")
+            for oid, want in h.blobs.items():
+                try:
+                    got = await c.get(pool, oid)
+                except Exception as e:
+                    failures.append(f"{oid} unreadable after the arc: "
+                                    f"{e}")
+                    continue
+                if bytes(got) != want:
+                    failures.append(f"{oid} NOT byte-identical after "
+                                    "the lifecycle arc")
+            auto_outs = cluster.mon.perf.get("auto_outs")
+            if auto_outs < 1:
+                failures.append("mon auto_outs counter never moved")
+            print(f"lifecycle: arc complete, auto_outs {auto_outs}, "
+                  f"crush_moves {cluster.mon.perf.get('crush_moves')}, "
+                  f"predicate_queries "
+                  f"{cluster.mon.perf.get('predicate_queries')}, "
+                  f"{len(failures)} failures")
+            for cl in (c, c_t):
+                await cl.stop()
+        finally:
+            await cluster.stop()
+        for f in failures:
+            print(f"FAIL {f}", file=sys.stderr)
+        return 1 if failures else 0
+
+    async def _refresh_not_up(c, osd_id) -> bool:
+        await c.refresh_map()
+        return not c.osdmap.osds[osd_id].up
 
     return asyncio.run(go())
 
@@ -1864,6 +2086,8 @@ def main(argv=None) -> int:
         return run_full(args)
     if args.rebalance:
         return run_rebalance(args)
+    if args.lifecycle:
+        return run_lifecycle(args)
     if args.chaos:
         return run_chaos(args)
     if args.wire_floor:

@@ -167,7 +167,11 @@ def _sample_messages() -> List[Any]:
                             "count": 1, "pgs": ["1.3"]}},
                 statfs={"total": 1 << 30, "used": 900 << 20,
                         "avail": (1 << 30) - (900 << 20),
-                        "num_objects": 12}),
+                        "num_objects": 12},
+                # v5: the unflushed-dirt roster the mon's
+                # safe-to-destroy / ok-to-stop predicates consume
+                cache_dirty=[("3:wb/obj", [1, 2, 3]),
+                             ("1:solo", [3])]),
         # v3: the embedded OsdInfo/incremental records grew the
         # crush_weight tail (golden MMapReply.v2_precrushweight pins
         # the pre-change decode).  Archived with default payloads —
@@ -176,6 +180,21 @@ def _sample_messages() -> List[Any]:
         t.MMapReply(tid="t19"),
         t.MOsdMembership(op="crush-reweight", osd_id=4, weight=2.5,
                          tid="t20"),
+        # runtime crush topology plane: the hierarchy-surgery command
+        # (v2 tail: force) and its typed reply
+        t.MCrushOp(op="move", name="host2", bucket_type="host",
+                   dest="rack1", weight=3.5, tid="t21", force=True),
+        t.MCrushOpReply(tid="t21", ok=False,
+                        error="EINVAL: would create a cycle", epoch=55),
+        # data-safety predicates: the query and the render-friendly
+        # reply (v2 tail: the cache-dirt clause counters/keys)
+        t.MOsdPredicate(op="ok-to-stop", osd_ids=[2, 5], tid="t22"),
+        t.MOsdPredicateReply(tid="t22", op="ok-to-stop", safe=False,
+                             unsafe_ids=[5],
+                             reasons=["pg 1.3 would drop below "
+                                      "min_size"],
+                             pgs_checked=16, dirty_blocked=2,
+                             dirty_keys=["3:wb/obj@osd.5"]),
         t.MSetFullRatio(which="backfillfull", ratio=0.9, tid="t18"),
         t.MOSDFailure(target_osd=4, from_osd=1, failed_for=12.5,
                       tid="t11"),

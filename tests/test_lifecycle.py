@@ -1,0 +1,526 @@
+"""Topology-aware membership + the data-safety lifecycle plane (r19).
+
+Covers: runtime CRUSH surgery at the mon (`osd crush
+add-bucket/add/set/move/rm` validation, the cycle guard, forced rm
+re-homing, error replies leaving the map untouched), the auto-out pass
+(interval hysteresis, the `noout` flag, the mon_osd_min_in_ratio
+floor), the data-safety predicate verdicts (`ok-to-stop` /
+`safe-to-destroy`, including the fast-ack dirty-replica clause), the
+`osd_crush_chooseleaf_type` default failure domain on pool create, the
+predicate/tree renderers — and the dedicated end-to-end proof that
+safe-to-destroy REFUSES while the target holds the last live raw
+replica of un-destaged cache dirt, then relents after destage.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from ceph_tpu.rados.crush import CRUSH_ITEM_NONE
+from ceph_tpu.rados.mon import Monitor
+from ceph_tpu.rados.types import (MCrushOp, MOsdPredicate, OsdInfo, PoolInfo,
+                                  osd_crush_weight)
+from ceph_tpu.rados.vstart import Cluster
+
+PROFILE = {"plugin": "jerasure", "technique": "reed_sol_van",
+           "k": "2", "m": "1"}
+
+
+def run(coro, timeout=180):
+    asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+async def wait_for(pred, seconds=20.0, what="condition"):
+    deadline = asyncio.get_running_loop().time() + seconds
+    while asyncio.get_running_loop().time() < deadline:
+        r = pred()
+        if asyncio.iscoroutine(r):
+            r = await r
+        if r:
+            return
+        await asyncio.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture()
+def force_batching(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_FORCE_BATCH", "1")
+
+
+def _bare_mon(n=0, conf=None):
+    """An unstarted Monitor: the crush-op / auto-out / predicate arms
+    are all synchronous map surgery, unit-testable without a network."""
+    mon = Monitor(conf=dict(conf or {}))
+    for i in range(n):
+        mon.osdmap.osds[i] = OsdInfo(osd_id=i,
+                                     addr=("127.0.0.1", 6800 + i))
+        mon._crush_add_osd(i)
+    return mon
+
+
+def _add_pool(mon, pg_num=32, size=3, min_size=2):
+    pool = PoolInfo(pool_id=1, name="p", pool_type="ec", pg_num=pg_num,
+                    size=size, min_size=min_size, rule="r")
+    mon.osdmap.pools[1] = pool
+    mon.osdmap.crush.add_simple_rule("r")
+    return pool
+
+
+# -- mon crush surgery (`ceph osd crush ...`) --------------------------------
+
+
+class TestCrushOps:
+    def test_add_bucket_and_move_device(self):
+        mon = _bare_mon(n=2)
+        r = mon._apply_crush_op(MCrushOp(op="add-bucket", name="rackA",
+                                         bucket_type="rack", tid="t"))
+        assert r.ok, r.error
+        crush = mon.osdmap.crush
+        rack = crush.bucket_by_name("rackA")
+        assert rack is not None and crush.parent_of(rack.id) == crush.root_id
+        r = mon._apply_crush_op(MCrushOp(op="move", name="osd.0",
+                                         dest="rackA", tid="t"))
+        assert r.ok, r.error
+        assert crush.parent_of(0) == rack.id
+        # device weight survives the move
+        assert crush.device_weights[0] == osd_crush_weight(
+            mon.osdmap.osds[0])
+
+    def test_add_bucket_validation(self):
+        mon = _bare_mon(n=1)
+        sig = mon.osdmap.crush.sig()
+        for op in (MCrushOp(op="add-bucket", name="", bucket_type="rack"),
+                   MCrushOp(op="add-bucket", name="x", bucket_type="osd"),
+                   MCrushOp(op="add-bucket", name="default",
+                            bucket_type="rack"),
+                   MCrushOp(op="add-bucket", name="osd.9",
+                            bucket_type="rack"),
+                   MCrushOp(op="add-bucket", name="x", bucket_type="rack",
+                            dest="nowhere")):
+            r = mon._apply_crush_op(op)
+            assert not r.ok and r.error
+        # every refusal left the map untouched
+        assert mon.osdmap.crush.sig() == sig
+
+    def test_set_reweights_and_add_refuses_placed(self):
+        mon = _bare_mon(n=2)
+        r = mon._apply_crush_op(MCrushOp(op="add", name="osd.0",
+                                         weight=2.0, tid="t"))
+        assert not r.ok and "EEXIST" in r.error  # boot already placed it
+        r = mon._apply_crush_op(MCrushOp(op="set", name="osd.0",
+                                         weight=2.5, tid="t"))
+        assert r.ok
+        assert osd_crush_weight(mon.osdmap.osds[0]) == 2.5
+        assert mon.osdmap.crush.device_weights[0] == 2.5
+        # unknown device / not-an-osd name
+        assert not mon._apply_crush_op(
+            MCrushOp(op="set", name="osd.7", weight=1.0)).ok
+        assert not mon._apply_crush_op(
+            MCrushOp(op="add", name="default", weight=1.0)).ok
+
+    def test_move_cycle_and_root_guards(self):
+        mon = _bare_mon(n=1)
+        crush = mon.osdmap.crush
+        for name, btype, dest in (("rackA", "rack", ""),
+                                  ("hostA", "host", "rackA")):
+            assert mon._apply_crush_op(MCrushOp(
+                op="add-bucket", name=name, bucket_type=btype,
+                dest=dest)).ok
+        sig = crush.sig()
+        r = mon._apply_crush_op(MCrushOp(op="move", name="rackA",
+                                         dest="hostA"))
+        assert not r.ok and "cycle" in r.error
+        assert not mon._apply_crush_op(
+            MCrushOp(op="move", name="default", dest="rackA")).ok
+        assert not mon._apply_crush_op(
+            MCrushOp(op="move", name="osd.0", dest="nowhere")).ok
+        assert crush.sig() == sig
+
+    def test_rm_refuses_nonempty_then_force_rehomes(self):
+        mon = _bare_mon(n=2)
+        crush = mon.osdmap.crush
+        assert mon._apply_crush_op(MCrushOp(
+            op="add-bucket", name="rackA", bucket_type="rack")).ok
+        assert mon._apply_crush_op(MCrushOp(
+            op="add-bucket", name="hostA", bucket_type="host",
+            dest="rackA")).ok
+        assert mon._apply_crush_op(MCrushOp(
+            op="move", name="osd.1", dest="hostA")).ok
+        r = mon._apply_crush_op(MCrushOp(op="rm", name="rackA"))
+        assert not r.ok  # non-empty without force
+        assert not mon._apply_crush_op(
+            MCrushOp(op="rm", name="default", force=True)).ok  # the root
+        host = crush.bucket_by_name("hostA")
+        r = mon._apply_crush_op(MCrushOp(op="rm", name="rackA",
+                                         force=True))
+        assert r.ok, r.error
+        assert crush.bucket_by_name("rackA") is None
+        # the child bucket re-homed to the removed bucket's parent
+        assert crush.parent_of(host.id) == crush.root_id
+        assert crush.parent_of(1) == host.id  # its device rode along
+        # rm of a device drops it from the map
+        assert mon._apply_crush_op(MCrushOp(op="rm", name="osd.0")).ok
+        assert 0 not in crush.devices()
+
+
+# -- auto-out of persistently-down OSDs --------------------------------------
+
+
+class TestAutoOut:
+    def _down(self, mon, osd_id, since):
+        mon.osdmap.osds[osd_id].up = False
+        mon._down_since[osd_id] = since
+
+    def test_fires_after_interval_with_hysteresis(self):
+        mon = _bare_mon(n=4, conf={"mon_osd_down_out_interval": 0.6})
+        self._down(mon, 1, since=100.0)
+        assert not mon._auto_out_pass(100.5)  # still inside the window
+        assert mon.osdmap.osds[1].in_cluster
+        assert mon._auto_out_pass(100.7)
+        assert not mon.osdmap.osds[1].in_cluster
+        assert mon.perf.get("auto_outs") == 1
+        # already out: a later pass is a no-op
+        assert not mon._auto_out_pass(200.0)
+
+    def test_unseeded_down_starts_countdown_not_out(self):
+        mon = _bare_mon(n=2, conf={"mon_osd_down_out_interval": 0.6})
+        mon.osdmap.osds[0].up = False  # no _down_since seed
+        assert not mon._auto_out_pass(50.0)
+        assert mon._down_since[0] == 50.0  # countdown armed, not fired
+        assert mon.osdmap.osds[0].in_cluster
+
+    def test_zero_interval_disables(self):
+        mon = _bare_mon(n=2, conf={"mon_osd_down_out_interval": 0})
+        self._down(mon, 0, since=0.0)
+        assert not mon._auto_out_pass(1e9)
+        assert mon.osdmap.osds[0].in_cluster
+
+    def test_noout_flag_freezes_marking(self):
+        mon = _bare_mon(n=2, conf={"mon_osd_down_out_interval": 0.6})
+        mon.osdmap.flags = ["noout"]
+        self._down(mon, 0, since=0.0)
+        assert not mon._auto_out_pass(100.0)
+        assert mon.osdmap.osds[0].in_cluster
+        mon.osdmap.flags = []
+        assert mon._auto_out_pass(100.0)  # thaw: fires on the next pass
+        assert not mon.osdmap.osds[0].in_cluster
+
+    def test_min_in_ratio_floor_blocks_and_relogs(self):
+        mon = _bare_mon(n=4, conf={"mon_osd_down_out_interval": 0.6,
+                                   "mon_osd_min_in_ratio": 0.8})
+        self._down(mon, 2, since=100.0)
+        assert not mon._auto_out_pass(101.0)  # 3/4 < 0.8: blocked
+        assert mon.osdmap.osds[2].in_cluster
+        # the refusal restarts the countdown (one log line per interval)
+        assert mon._down_since[2] == 101.0
+        warns = [e for e in mon.logm.entries
+                 if "mon_osd_min_in_ratio" in e.message]
+        assert len(warns) == 1
+        # a permissive floor lets the same state fire
+        mon.conf["mon_osd_min_in_ratio"] = 0.5
+        assert mon._auto_out_pass(102.0)
+        assert not mon.osdmap.osds[2].in_cluster
+
+
+# -- data-safety predicate verdicts ------------------------------------------
+
+
+class TestPredicateVerdicts:
+    def test_unknown_id_is_enoent(self):
+        mon = _bare_mon(n=2)
+        v = mon._predicate_verdict("safe-to-destroy", [7])
+        assert not v["safe"] and v["unsafe_ids"] == [7]
+        assert any("ENOENT" in r for r in v["reasons"])
+
+    def test_ok_to_stop_min_size_margin(self):
+        mon = _bare_mon(n=5)
+        pool = _add_pool(mon, size=3, min_size=2)
+        v = mon._predicate_verdict("ok-to-stop", [0])
+        assert v["safe"], v  # 2 live >= min_size everywhere
+        assert v["pgs_checked"] == pool.pg_num
+        v = mon._predicate_verdict("ok-to-stop", [0, 1, 2])
+        assert not v["safe"]
+        assert any("min_size" in r for r in v["reasons"])
+        assert set(v["unsafe_ids"]) <= {0, 1, 2}
+
+    def test_safe_to_destroy_mapped_then_drained(self):
+        mon = _bare_mon(n=5)
+        _add_pool(mon)
+        v = mon._predicate_verdict("safe-to-destroy", [0])
+        assert not v["safe"]
+        assert any("still maps" in r for r in v["reasons"])
+        # out + drained: acting remaps to the other 4, still full-size
+        mon.osdmap.osds[0].in_cluster = False
+        v = mon._predicate_verdict("safe-to-destroy", [0])
+        assert v["safe"], v
+
+    def test_safe_to_destroy_unrecovered_hole_is_unsafe(self):
+        # 3 devices, size-3 pool: taking one out leaves a hole no
+        # remap can fill — conservatively unsafe (the hole may be a
+        # shard whose only copy sits on the target)
+        mon = _bare_mon(n=3)
+        _add_pool(mon)
+        mon.osdmap.osds[2].in_cluster = False
+        v = mon._predicate_verdict("safe-to-destroy", [2])
+        assert not v["safe"]
+        assert any("not fully recovered" in r for r in v["reasons"])
+
+    def test_dirty_replica_clause(self):
+        mon = _bare_mon(n=5)
+        _add_pool(mon)
+        mon.osdmap.osds[0].in_cluster = False  # drained baseline: safe
+        assert mon._predicate_verdict("safe-to-destroy", [0])["safe"]
+        # the target holds the LAST live copy of un-destaged dirt
+        mon._osd_dirty[0] = [("1:obj", [0])]
+        v = mon._predicate_verdict("safe-to-destroy", [0])
+        assert not v["safe"] and v["dirty_blocked"] == 1
+        assert v["dirty_keys"] == ["1:obj@osd.0"]
+        assert any("flush the cache tier" in r for r in v["reasons"])
+        # another UP holder survives the destroy: clause relents
+        mon._osd_dirty[0] = [("1:obj", [0, 3])]
+        assert mon._predicate_verdict("safe-to-destroy", [0])["safe"]
+        # ... unless that holder is DOWN
+        mon.osdmap.osds[3].up = False
+        assert not mon._predicate_verdict("safe-to-destroy", [0])["safe"]
+        mon.osdmap.osds[3].up = True
+        # ... or is itself among the targets (destroying both loses it)
+        mon.osdmap.osds[3].in_cluster = False
+        v = mon._predicate_verdict("safe-to-destroy", [0, 3])
+        assert not v["safe"] and v["dirty_blocked"] == 1
+
+    def test_predicate_reply_validation_and_counters(self):
+        mon = _bare_mon(n=2)
+        _add_pool(mon, size=2, min_size=1)
+        r = mon._predicate_reply(MOsdPredicate(op="bogus", osd_ids=[0],
+                                               tid="t"))
+        assert not r.safe and "EINVAL" in r.reasons[0]
+        r = mon._predicate_reply(MOsdPredicate(op="ok-to-stop",
+                                               osd_ids=[], tid="t"))
+        assert not r.safe and "EINVAL" in r.reasons[0]
+        r = mon._predicate_reply(MOsdPredicate(op="ok-to-stop",
+                                               osd_ids=[0], tid="t"))
+        assert r.safe and r.pgs_checked > 0
+        assert mon.perf.get("predicate_queries") == 3
+        assert mon.perf.get("predicate_refusals") == 2
+
+
+# -- renderers ----------------------------------------------------------------
+
+
+class TestRenderers:
+    def test_render_predicate_reply_shapes(self):
+        from ceph_tpu.rados.types import MOsdPredicateReply
+        from ceph_tpu.tools.ceph import render_predicate_reply
+
+        ok = MOsdPredicateReply(tid="t", op="ok-to-stop", safe=True,
+                                pgs_checked=32)
+        lines = render_predicate_reply(ok)
+        assert lines == ["ok-to-stop: SAFE (32 pgs checked)"]
+        bad = MOsdPredicateReply(
+            tid="t", op="safe-to-destroy", safe=False, unsafe_ids=[3],
+            reasons=["pg 1.0 still maps to osd [3] (out + drain first)"],
+            pgs_checked=32, dirty_blocked=1,
+            dirty_keys=["1:wb/obj@osd.3"])
+        lines = render_predicate_reply(bad)
+        assert lines[0] == "safe-to-destroy: NOT SAFE (32 pgs checked)"
+        assert "  unsafe: osd.3" in lines
+        assert any(ln.startswith("  - pg 1.0") for ln in lines)
+        assert "  unflushed dirty objects at risk: 1" in lines
+        assert "    * 1:wb/obj@osd.3" in lines
+
+    def test_osd_tree_bucket_weight_is_subtree_sum(self):
+        from ceph_tpu.tools.ceph import _osd_tree, render_osd_tree
+
+        mon = _bare_mon(n=3)
+        assert mon._apply_crush_op(MCrushOp(
+            op="add-bucket", name="hostA", bucket_type="host")).ok
+        assert mon._apply_crush_op(MCrushOp(
+            op="move", name="osd.1", dest="hostA")).ok
+        assert mon._apply_crush_op(MCrushOp(
+            op="set", name="osd.1", weight=2.5, dest="hostA")).ok
+        rows = _osd_tree(mon.osdmap)
+        host = next(r for r in rows if r.get("name") == "hostA")
+        assert host["weight"] == 2.5
+        root = next(r for r in rows if r.get("name") == "default")
+        assert root["weight"] == 4.5  # 1 + 1 + the reweighted 2.5
+        lines = render_osd_tree(rows)
+        host_line = next(ln for ln in lines if "hostA" in ln)
+        assert "2.5000" in host_line
+
+
+# -- cluster: client plumbing + chooseleaf default ---------------------------
+
+
+CONF = {"osd_auto_repair": False, "osd_heartbeat_interval": 0.1,
+        "mon_osd_report_grace": 2.0, "client_op_timeout": 5.0,
+        "client_op_deadline": 10.0}
+
+
+class TestLifecycleCluster:
+    def test_crush_ops_end_to_end(self):
+        async def go():
+            cluster = Cluster(n_osds=3, conf=dict(CONF))
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                from ceph_tpu.rados.client import RadosError
+
+                e0 = c.osdmap.epoch
+                e1 = await c.osd_crush_op("add-bucket", "rackZ",
+                                          bucket_type="rack")
+                assert e1 > e0
+                await c.osd_crush_op("move", "osd.1", dest="rackZ")
+                crush = c.osdmap.crush
+                rack = crush.bucket_by_name("rackZ")
+                assert rack is not None and crush.parent_of(1) == rack.id
+                # a mon-side refusal surfaces as RadosError and the
+                # refreshed map is untouched
+                sig = crush.sig()
+                with pytest.raises(RadosError):
+                    await c.osd_crush_op("move", "default", dest="rackZ")
+                await c.refresh_map()
+                assert c.osdmap.crush.sig() == sig
+                # predicates served end to end with typed replies
+                # (no pools yet: nothing at risk, trivially safe)
+                r = await c.osd_ok_to_stop(0, 1, 2)
+                assert r.safe and r.pgs_checked == 0
+                assert cluster.mon.perf.get("crush_moves") >= 2
+                assert cluster.mon.perf.get("predicate_queries") >= 1
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+    def test_chooseleaf_type_conf_sets_default_failure_domain(self):
+        async def go():
+            conf = dict(CONF)
+            conf["crush_num_hosts"] = 4
+            conf["osd_crush_chooseleaf_type"] = "host"
+            cluster = Cluster(n_osds=8, conf=conf)
+            await cluster.start()
+            try:
+                c = await cluster.client()
+                # NO per-pool crush-failure-domain: the cluster default
+                # must put the spread over hosts
+                pool = await c.create_pool("cl", profile=dict(PROFILE))
+                blob = os.urandom(20_000)
+                await c.put(pool, "obj", blob)
+                p = c.osdmap.pools[pool]
+                crush = c.osdmap.crush
+                for pg in range(p.pg_num):
+                    acting = c.osdmap.pg_to_acting(p, pg)
+                    live = [a for a in acting if a != CRUSH_ITEM_NONE]
+                    hosts = {crush.parent_of(a) for a in live}
+                    assert len(hosts) == len(live), \
+                        f"pg {pg}: two shards share a host: {acting}"
+                assert await c.get(pool, "obj") == blob
+                await c.stop()
+            finally:
+                await cluster.stop()
+
+        run(go())
+
+
+# -- the dedicated dirty-replica refusal proof --------------------------------
+
+
+WB_CONF = {"osd_auto_repair": False, "client_op_timeout": 60.0,
+           "osd_heartbeat_interval": 0.1,
+           "mon_osd_report_grace": 1.5,
+           "mon_osd_down_out_interval": 0,  # manual membership control
+           "osd_hit_set_period": 30.0,
+           "osd_min_read_recency_for_promote": 1,
+           "osd_tier_cache_mode": "writeback",
+           "osd_tier_agent_interval": 0.1,
+           "osd_tier_flush_age": 600.0}  # park the dirt
+
+
+class TestSafeToDestroyDirtyReplica:
+    def test_refuses_last_live_dirty_holder_until_destage(
+            self, force_batching):
+        """The r22 fast-ack durability clause, end to end: a put acked
+        at the CACHE quorum leaves raw dirty replicas on (primary,
+        adopter).  With the primary dead the adopter holds the LAST
+        live copy of acked client data — safe-to-destroy and ok-to-stop
+        must both REFUSE it (dirty_blocked, named key), and relent only
+        after the replay/destage lands the bytes in the EC store."""
+        from ceph_tpu.rados import osd as osdmod
+
+        async def go():
+            cluster = Cluster(n_osds=4, conf=dict(WB_CONF))
+            await cluster.start()
+            saved_sweep = osdmod.OSD._tier_raw_replay_sweep
+            try:
+                c = await cluster.client()
+                pool = await c.create_pool("wb", profile=dict(PROFILE))
+                blob = os.urandom(130_000)
+                await c.put(pool, "obj", blob)
+                mon = cluster.mon
+                key = f"{pool}:obj"
+
+                def holders_at_mon():
+                    out = {}
+                    for osd_id, items in mon._osd_dirty.items():
+                        for k, hs in items:
+                            if k == key:
+                                out[osd_id] = list(hs)
+                    return out
+
+                # the ping snoop delivers the dirt summary to the mon
+                await wait_for(lambda: len(holders_at_mon()) >= 2, 15,
+                               "mon to learn the dirty replica set")
+                holders = sorted(holders_at_mon())
+                # destroying/stopping the WHOLE replica set together is
+                # refused even with every holder alive
+                v = await c.osd_predicate("safe-to-destroy", holders)
+                assert not v.safe and v.dirty_blocked >= 1
+                assert any(key in k for k in v.dirty_keys)
+                # kill the primary; park the replay so the adopter stays
+                # the last live holder deterministically (the sweep is
+                # the recovery plane under test in test_pagestore — here
+                # the mon's refusal while it hasn't run yet is the gate)
+                rec = next(info for _k, info, _g, _s
+                           in osdmod.shared_planar_store().dirty_items()
+                           if info is not None
+                           and getattr(info, "oid", "") == "obj")
+                primary, adopters = rec.primary, \
+                    [h for h in rec.peers if h != rec.primary]
+                assert adopters, rec
+
+                def noop_sweep(self):
+                    return None
+
+                osdmod.OSD._tier_raw_replay_sweep = noop_sweep
+                await cluster.kill_osd(primary)
+                await wait_for(
+                    lambda: not mon.osdmap.osds[primary].up, 15,
+                    "the mon to mark the dead primary down")
+                target = adopters[0]
+                for op in ("safe-to-destroy", "ok-to-stop"):
+                    v = await c.osd_predicate(op, [target])
+                    assert not v.safe, (op, v)
+                    assert v.dirty_blocked >= 1, (op, v)
+                    assert any(key in k for k in v.dirty_keys)
+                assert cluster.mon.perf.get("predicate_refusals") >= 3
+                # un-park: the replay sweep pushes the raw copy to the
+                # new primary, who destages; the clause must relent
+                osdmod.OSD._tier_raw_replay_sweep = saved_sweep
+                await c.osd_out(primary)  # map change triggers the sweep
+
+                def dirt_gone():
+                    return target not in holders_at_mon()
+
+                await wait_for(dirt_gone, 30,
+                               "destage to clear the adopter's dirt")
+                v = await c.osd_safe_to_destroy(target)
+                assert v.dirty_blocked == 0 and not v.dirty_keys
+                # the acked bytes survived the whole arc
+                assert bytes(await c.get(pool, "obj")) == blob
+                await c.stop()
+            finally:
+                osdmod.OSD._tier_raw_replay_sweep = saved_sweep
+                await cluster.stop()
+
+        run(go())
